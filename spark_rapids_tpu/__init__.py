@@ -1,0 +1,36 @@
+"""spark_rapids_tpu — TPU-native columnar SQL acceleration framework.
+
+A ground-up TPU/XLA re-design of the capabilities of the RAPIDS Accelerator
+for Apache Spark (reference at /root/reference): a columnar dataframe/SQL
+engine whose physical plans are rewritten so that supported operators execute
+on TPUs as columnar batches via JAX/XLA (with Pallas kernels for hot ops),
+falling back to a host (Arrow/numpy) engine per-operator when anything is
+unsupported, while targeting bit-identical results to the host engine.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Spark semantics are 64-bit (bigint, double, timestamp-micros); JAX defaults
+# to 32-bit, so x64 must be on before any array is created.
+_jax.config.update("jax_enable_x64", True)
+
+from .types import (  # noqa: F401
+    BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING, BINARY, DATE,
+    TIMESTAMP, NULL, ArrayType, BinaryType, BooleanType, ByteType, DataType,
+    DateType, DecimalType, DoubleType, FloatType, IntegerType, LongType,
+    MapType, NullType, ShortType, StringType, StructField, StructType,
+    TimestampType)
+from .config import RapidsConf  # noqa: F401
+from .columnar import ColumnarBatch, DeviceColumn  # noqa: F401
+
+
+def session(conf=None, **conf_kwargs):
+    """Create (or get) the TpuSession — entry point of the user API."""
+    try:
+        from .sql.session import TpuSession
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "the sql session layer is not available in this build") from e
+    return TpuSession.get_or_create(conf, **conf_kwargs)

@@ -1,0 +1,13 @@
+from .column import (DeviceColumn, bucket_capacity, bucket_width,
+                     make_fixed_column, make_string_column, null_column,
+                     scalar_column)
+from .batch import ColumnarBatch
+from .convert import (arrow_to_device, device_to_arrow, arrow_to_device_column,
+                      device_column_to_arrow, pandas_to_device, device_to_pandas)
+
+__all__ = [
+    "DeviceColumn", "ColumnarBatch", "bucket_capacity", "bucket_width",
+    "make_fixed_column", "make_string_column", "null_column", "scalar_column",
+    "arrow_to_device", "device_to_arrow", "arrow_to_device_column",
+    "device_column_to_arrow", "pandas_to_device", "device_to_pandas",
+]

@@ -1,0 +1,206 @@
+"""Device column layout — the TPU-native analog of cuDF's ``ColumnVector``
+(reference consumes it as ``ai.rapids.cudf.ColumnVector`` via
+``GpuColumnVector.java``; see SURVEY §2.10).
+
+Layout rules (XLA-first):
+
+* Every column is padded to a power-of-two row **capacity** so that XLA
+  compiles one program per (schema, capacity-bucket) instead of one per row
+  count.  Rows at index >= ``num_rows`` (tracked on the batch) are dead:
+  their validity is False and their data is zero.
+* Fixed-width types: ``data[capacity]`` with the type's numpy carrier dtype,
+  ``validity[capacity]`` bool (True = valid; nulls hold zeroed data).
+* STRING/BINARY: ``data[capacity, width]`` uint8 byte matrix (width is a
+  power-of-two bucket) + ``lengths[capacity]`` int32.  This trades memory for
+  static shapes and vectorizable string kernels on the VPU — the TPU answer
+  to cuDF's offset+chars layout, which would force dynamic shapes under XLA.
+* STRUCT: no own data, only ``children`` columns + own validity.
+* ARRAY: ``data[capacity, width]`` is replaced by a child column holding
+  ``capacity * width`` flattened elements plus ``lengths``; width buckets the
+  max list length (same padding trick one level down).
+* DECIMAL(p<=18): scaled int64 in ``data``. DECIMAL(p>18): ``data`` is the
+  low 64 bits, ``aux`` the high 64 bits (Aggregation128Utils equivalent).
+
+Columns are registered as JAX pytrees, so whole batches flow through ``jit``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import (ArrayType, BinaryType, DataType, DecimalType, MapType,
+                     NullType, StringType, StructType)
+
+_MIN_CAPACITY = 8
+_MIN_WIDTH = 4
+
+
+def bucket_capacity(num_rows: int, minimum: int = _MIN_CAPACITY) -> int:
+    """Smallest power-of-two >= max(num_rows, minimum)."""
+    n = max(int(num_rows), minimum, 1)
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_width(max_len: int, minimum: int = _MIN_WIDTH) -> int:
+    n = max(int(max_len), minimum, 1)
+    return 1 << (n - 1).bit_length()
+
+
+def is_string_like(dt: DataType) -> bool:
+    return isinstance(dt, (StringType, BinaryType))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceColumn:
+    """One logical column resident in device memory."""
+
+    dtype: DataType
+    data: Optional[jnp.ndarray] = None          # None for STRUCT
+    validity: Optional[jnp.ndarray] = None      # bool[capacity]
+    lengths: Optional[jnp.ndarray] = None       # int32[capacity] strings/lists
+    aux: Optional[jnp.ndarray] = None           # decimal128 high words
+    children: Tuple["DeviceColumn", ...] = ()
+
+    # --- pytree protocol --------------------------------------------------
+    def tree_flatten(self):
+        return ((self.data, self.validity, self.lengths, self.aux,
+                 self.children), self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, dtype, leaves):
+        data, validity, lengths, aux, children = leaves
+        return cls(dtype, data, validity, lengths, aux, children)
+
+    # --- shape info -------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        if self.data is not None:
+            return int(self.data.shape[0])
+        if self.validity is not None:
+            return int(self.validity.shape[0])
+        return self.children[0].capacity
+
+    @property
+    def width(self) -> Optional[int]:
+        if self.data is not None and self.data.ndim == 2:
+            return int(self.data.shape[1])
+        return None
+
+    def with_validity(self, validity: jnp.ndarray) -> "DeviceColumn":
+        return replace(self, validity=validity)
+
+    def mask_dead_rows(self, row_mask: jnp.ndarray) -> "DeviceColumn":
+        """Clear validity (and zero data) for rows beyond num_rows."""
+        v = self.validity & row_mask if self.validity is not None else row_mask
+        return replace(self, validity=v)
+
+    # --- constructors for padding changes ---------------------------------
+    def slice_capacity(self, new_capacity: int) -> "DeviceColumn":
+        """Narrow or grow the capacity padding (device-side)."""
+        def fix(arr, fill=0):
+            if arr is None:
+                return None
+            cap = arr.shape[0]
+            if cap == new_capacity:
+                return arr
+            if cap > new_capacity:
+                return arr[:new_capacity]
+            pad = [(0, new_capacity - cap)] + [(0, 0)] * (arr.ndim - 1)
+            return jnp.pad(arr, pad, constant_values=fill)
+
+        return DeviceColumn(
+            self.dtype, fix(self.data),
+            fix(self.validity, False),
+            fix(self.lengths),
+            fix(self.aux),
+            tuple(c.slice_capacity(new_capacity) for c in self.children))
+
+    def gather(self, idx: jnp.ndarray, idx_valid: Optional[jnp.ndarray] = None
+               ) -> "DeviceColumn":
+        """Select rows by index (the JoinGatherer primitive).  ``idx`` may
+        contain out-of-range sentinels; ``idx_valid`` marks which produce a
+        valid row (False -> null output row, e.g. outer-join misses)."""
+        safe = jnp.clip(idx, 0, self.capacity - 1)
+        data = self.data[safe] if self.data is not None else None
+        lengths = self.lengths[safe] if self.lengths is not None else None
+        aux = self.aux[safe] if self.aux is not None else None
+        validity = (self.validity[safe] if self.validity is not None
+                    else jnp.ones(idx.shape[0], dtype=bool))
+        if idx_valid is not None:
+            validity = validity & idx_valid
+        children = tuple(c.gather(idx, idx_valid) for c in self.children)
+        return DeviceColumn(self.dtype, data, validity, lengths, aux, children)
+
+
+def make_fixed_column(dtype: DataType, data: jnp.ndarray,
+                      validity: Optional[jnp.ndarray] = None) -> DeviceColumn:
+    if validity is None:
+        validity = jnp.ones(data.shape[0], dtype=bool)
+    return DeviceColumn(dtype, data, validity)
+
+
+def make_string_column(dtype: DataType, chars: jnp.ndarray,
+                       lengths: jnp.ndarray,
+                       validity: Optional[jnp.ndarray] = None) -> DeviceColumn:
+    if validity is None:
+        validity = jnp.ones(chars.shape[0], dtype=bool)
+    return DeviceColumn(dtype, chars, validity, lengths=lengths)
+
+
+def null_column(dtype: DataType, capacity: int) -> DeviceColumn:
+    """All-null column of the given type."""
+    validity = jnp.zeros(capacity, dtype=bool)
+    if isinstance(dtype, StructType):
+        children = tuple(null_column(f.data_type, capacity) for f in dtype.fields)
+        return DeviceColumn(dtype, None, validity, children=children)
+    if is_string_like(dtype):
+        chars = jnp.zeros((capacity, _MIN_WIDTH), dtype=jnp.uint8)
+        lengths = jnp.zeros(capacity, dtype=jnp.int32)
+        return DeviceColumn(dtype, chars, validity, lengths=lengths)
+    np_dtype = dtype.np_dtype if dtype.np_dtype is not None else np.dtype(np.int8)
+    data = jnp.zeros(capacity, dtype=np_dtype)
+    aux = jnp.zeros(capacity, dtype=jnp.int64) if (
+        isinstance(dtype, DecimalType) and not dtype.is_long_backed) else None
+    return DeviceColumn(dtype, data, validity, aux=aux)
+
+
+def scalar_column(dtype: DataType, value: Any, capacity: int) -> DeviceColumn:
+    """Broadcast a host scalar to a device column (cudf ``Scalar`` analog)."""
+    if value is None:
+        return null_column(dtype, capacity)
+    validity = jnp.ones(capacity, dtype=bool)
+    if is_string_like(dtype):
+        raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        width = bucket_width(len(raw))
+        row = np.zeros(width, dtype=np.uint8)
+        row[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        chars = jnp.broadcast_to(jnp.asarray(row), (capacity, width))
+        lengths = jnp.full(capacity, len(raw), dtype=jnp.int32)
+        return DeviceColumn(dtype, chars, validity, lengths=lengths)
+    if isinstance(dtype, DecimalType):
+        import decimal
+        unscaled = int(decimal.Decimal(value).scaleb(dtype.scale).to_integral_value())
+        if dtype.is_long_backed:
+            data = jnp.full(capacity, unscaled, dtype=jnp.int64)
+            return DeviceColumn(dtype, data, validity)
+        lo = unscaled & ((1 << 64) - 1)
+        lo = lo - (1 << 64) if lo >= (1 << 63) else lo
+        hi = unscaled >> 64
+        return DeviceColumn(dtype, jnp.full(capacity, lo, dtype=jnp.int64),
+                            validity, aux=jnp.full(capacity, hi, dtype=jnp.int64))
+    import datetime as _dt
+    from ..types import DateType, TimestampType
+    if isinstance(dtype, DateType) and isinstance(value, _dt.date):
+        value = (value - _dt.date(1970, 1, 1)).days
+    elif isinstance(dtype, TimestampType) and isinstance(value, _dt.datetime):
+        if value.tzinfo is None:
+            value = value.replace(tzinfo=_dt.timezone.utc)
+        value = int(value.timestamp() * 1_000_000)
+    data = jnp.full(capacity, value, dtype=dtype.np_dtype)
+    return DeviceColumn(dtype, data, validity)

@@ -1,0 +1,233 @@
+"""Host(Arrow) <-> device(JAX) batch conversion.
+
+This is the TPU analog of the reference's transition layer:
+``HostColumnarToGpu`` / ``GpuColumnarToRowExec`` / ``GpuRowToColumnarExec``
+(SURVEY §2.2) with Arrow as the host columnar format.  Host decode is
+vectorized numpy over Arrow buffers (no per-row Python) and the device upload
+is a single ``jnp.asarray`` per buffer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from .batch import ColumnarBatch
+from .column import (DeviceColumn, bucket_capacity, bucket_width,
+                     is_string_like, null_column)
+
+
+# --------------------------------------------------------------------------
+# Arrow -> device
+# --------------------------------------------------------------------------
+
+def arrow_to_device(table: pa.Table, capacity: Optional[int] = None
+                    ) -> ColumnarBatch:
+    n = table.num_rows
+    cap = capacity or bucket_capacity(n)
+    cols = [arrow_to_device_column(table.column(i), cap)
+            for i in range(table.num_columns)]
+    return ColumnarBatch.make(table.column_names, cols, n)
+
+
+def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    dtype = T.from_arrow(arr.type)
+    n = len(arr)
+    valid_np = np.zeros(capacity, dtype=bool)
+    if n:
+        valid_np[:n] = _valid_mask(arr)
+    validity = jnp.asarray(valid_np)
+
+    if isinstance(dtype, T.NullType):
+        return null_column(dtype, capacity).with_validity(validity)
+
+    if isinstance(dtype, (T.ArrayType, T.MapType)):
+        raise NotImplementedError(
+            f"device layout for {dtype.simple_string()} columns is not yet "
+            "implemented; keep this column on the host (CPU fallback)")
+
+    if isinstance(dtype, T.StructType):
+        children = tuple(arrow_to_device_column(arr.field(i), capacity)
+                         for i in range(arr.type.num_fields))
+        return DeviceColumn(dtype, None, validity, children=children)
+
+    if is_string_like(dtype):
+        chars, lengths = _strings_to_matrix(arr, capacity)
+        return DeviceColumn(dtype, jnp.asarray(chars), validity,
+                            lengths=jnp.asarray(lengths))
+
+    if isinstance(dtype, T.DecimalType):
+        lo, hi = _decimal_words(arr, capacity)
+        aux = jnp.asarray(hi) if not dtype.is_long_backed else None
+        return DeviceColumn(dtype, jnp.asarray(lo), validity, aux=aux)
+
+    np_data = _fixed_to_numpy(arr, dtype)
+    out = np.zeros(capacity, dtype=dtype.np_dtype)
+    out[:n] = np_data
+    out[:n][~valid_np[:n]] = 0  # dead data zeroed for deterministic kernels
+    return DeviceColumn(dtype, jnp.asarray(out), validity)
+
+
+def _valid_mask(arr: pa.Array) -> np.ndarray:
+    if arr.null_count == 0:
+        return np.ones(len(arr), dtype=bool)
+    return np.asarray(arr.is_valid())
+
+
+def _fixed_to_numpy(arr: pa.Array, dtype: T.DataType) -> np.ndarray:
+    if isinstance(dtype, T.DateType):
+        arr = arr.cast(pa.int32())
+    elif isinstance(dtype, T.TimestampType):
+        arr = arr.cast(pa.timestamp("us")).cast(pa.int64())
+    elif isinstance(dtype, T.BooleanType):
+        pass
+    if arr.null_count:
+        zero = pa.scalar(False if pa.types.is_boolean(arr.type) else 0, type=arr.type)
+        arr = arr.fill_null(zero)
+    return np.asarray(arr.to_numpy(zero_copy_only=False)).astype(
+        dtype.np_dtype, copy=False)
+
+
+def _strings_to_matrix(arr: pa.Array, capacity: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    if pa.types.is_large_string(arr.type):
+        arr = arr.cast(pa.string())
+    elif pa.types.is_large_binary(arr.type):
+        arr = arr.cast(pa.binary())
+    n = len(arr)
+    if arr.null_count:
+        arr = arr.fill_null("" if pa.types.is_string(arr.type) else b"")
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], dtype=np.int32,
+                            count=(arr.offset + n + 1))[arr.offset:]
+    data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None else \
+        np.zeros(0, dtype=np.uint8)
+    starts = offsets[:-1].astype(np.int64)  # absolute buffer positions
+    lengths_np = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    width = bucket_width(int(lengths_np.max()) if n else 0)
+    chars = np.zeros((capacity, width), dtype=np.uint8)
+    total = int(lengths_np.sum())
+    if total:
+        # within-row byte index is relative to each row's own start, not to
+        # the raw buffer offset (which is nonzero for sliced arrays)
+        local_starts = np.zeros(n, dtype=np.int64)
+        np.cumsum(lengths_np[:-1], out=local_starts[1:])
+        row_idx = np.repeat(np.arange(n), lengths_np)
+        within = np.arange(total) - np.repeat(local_starts, lengths_np)
+        chars[row_idx, within] = data[np.repeat(starts, lengths_np) + within]
+    lengths = np.zeros(capacity, dtype=np.int32)
+    lengths[:n] = lengths_np
+    return chars, lengths
+
+
+def _decimal_words(arr: pa.Array, capacity: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    n = len(arr)
+    bufs = arr.buffers()
+    words = (np.frombuffer(bufs[1], dtype=np.int64)
+             [(arr.offset * 2):(arr.offset + n) * 2]
+             if bufs[1] is not None else np.zeros(0, dtype=np.int64))
+    lo = np.zeros(capacity, dtype=np.int64)
+    hi = np.zeros(capacity, dtype=np.int64)
+    if n:
+        lo[:n] = words[0::2]
+        hi[:n] = words[1::2]
+        mask = ~_valid_mask(arr)
+        lo[:n][mask] = 0
+        hi[:n][mask] = 0
+    return lo, hi
+
+
+# --------------------------------------------------------------------------
+# device -> Arrow
+# --------------------------------------------------------------------------
+
+def device_to_arrow(batch: ColumnarBatch) -> pa.Table:
+    n = batch.num_rows_int
+    arrays = [device_column_to_arrow(c, n) for c in batch.columns]
+    return pa.table(arrays, names=list(batch.names))
+
+
+def device_column_to_arrow(col: DeviceColumn, n: int) -> pa.Array:
+    dtype = col.dtype
+    valid = np.asarray(col.validity)[:n] if col.validity is not None else \
+        np.ones(n, dtype=bool)
+    mask = ~valid  # pyarrow mask semantics: True = null
+
+    if isinstance(dtype, T.NullType):
+        return pa.nulls(n)
+
+    if isinstance(dtype, T.StructType):
+        children = [device_column_to_arrow(c, n) for c in col.children]
+        return pa.StructArray.from_arrays(
+            children, names=list(dtype.names),
+            mask=pa.array(mask) if mask.any() else None)
+
+    if is_string_like(dtype):
+        return _matrix_to_strings(col, n, mask,
+                                  binary=isinstance(dtype, T.BinaryType))
+
+    if isinstance(dtype, T.DecimalType):
+        lo = np.asarray(col.data)[:n]
+        hi = (np.asarray(col.aux)[:n] if col.aux is not None
+              else np.where(lo < 0, -1, 0).astype(np.int64))
+        words = np.empty(n * 2, dtype=np.int64)
+        words[0::2] = lo
+        words[1::2] = hi
+        return pa.Array.from_buffers(
+            pa.decimal128(dtype.precision, dtype.scale), n,
+            [_bitmap(valid), pa.py_buffer(words.tobytes())])
+
+    data = np.asarray(col.data)[:n]
+    if isinstance(dtype, T.DateType):
+        return pa.array(data.astype(np.int32), type=pa.date32(),
+                        mask=mask if mask.any() else None)
+    if isinstance(dtype, T.TimestampType):
+        return pa.array(data.astype(np.int64),
+                        type=pa.timestamp("us", tz="UTC"),
+                        mask=mask if mask.any() else None)
+    return pa.array(data, type=T.to_arrow(dtype),
+                    mask=mask if mask.any() else None)
+
+
+def _bitmap(valid: np.ndarray) -> Optional[pa.Buffer]:
+    if valid.all():
+        return None
+    return pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
+
+
+def _matrix_to_strings(col: DeviceColumn, n: int, mask: np.ndarray,
+                       binary: bool) -> pa.Array:
+    chars = np.asarray(col.data)[:n]
+    lengths = np.asarray(col.lengths)[:n].astype(np.int64)
+    lengths = np.where(mask, 0, lengths)
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    total = int(offsets[-1])
+    flat = np.zeros(total, dtype=np.uint8)
+    if total:
+        row_idx = np.repeat(np.arange(n), lengths)
+        col_idx = np.arange(total) - np.repeat(offsets[:-1].astype(np.int64), lengths)
+        flat[:] = chars[row_idx, col_idx]
+    at = pa.binary() if binary else pa.utf8()
+    return pa.Array.from_buffers(
+        at, n, [_bitmap(~mask), pa.py_buffer(offsets.tobytes()),
+                pa.py_buffer(flat.tobytes())])
+
+
+# --------------------------------------------------------------------------
+# pandas convenience
+# --------------------------------------------------------------------------
+
+def pandas_to_device(df) -> ColumnarBatch:
+    return arrow_to_device(pa.Table.from_pandas(df, preserve_index=False))
+
+
+def device_to_pandas(batch: ColumnarBatch):
+    return device_to_arrow(batch).to_pandas()

@@ -1,0 +1,320 @@
+"""Typed configuration registry — the TPU equivalent of the reference's
+``RapidsConf.scala`` (Spark-style ``ConfEntry`` builder with docs, defaults,
+``internal()``/``startupOnly()``/``commonlyUsed()`` attributes; reference
+``RapidsConf.scala:120+``, 197 ``spark.rapids.*`` keys).
+
+Keys keep the ``spark.rapids.*`` naming so a user of the reference finds the
+same knobs; TPU-specific keys live under ``spark.rapids.tpu.*``.
+``RapidsConf.help()`` -> :func:`help_text` emits the markdown config docs the
+same way the reference's docgen does (``RapidsConf.scala:2057-2103``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ConfEntry", "RapidsConf", "register", "ENTRIES", "help_text"]
+
+
+@dataclass
+class ConfEntry:
+    key: str
+    doc: str
+    default: Any
+    type_: type
+    internal: bool = False
+    startup_only: bool = False
+    commonly_used: bool = False
+    checker: Optional[Callable[[Any], bool]] = None
+
+    def convert(self, raw: Any) -> Any:
+        if raw is None:
+            return self.default
+        if self.type_ is bool:
+            if isinstance(raw, bool):
+                return raw
+            return str(raw).strip().lower() in ("true", "1", "yes")
+        if self.type_ is int:
+            return int(raw)
+        if self.type_ is float:
+            return float(raw)
+        if self.type_ is list:
+            if isinstance(raw, (list, tuple)):
+                return list(raw)
+            return [s.strip() for s in str(raw).split(",") if s.strip()]
+        return str(raw)
+
+
+ENTRIES: Dict[str, ConfEntry] = {}
+
+
+def register(key: str, doc: str, default: Any, type_: Optional[type] = None,
+             internal: bool = False, startup_only: bool = False,
+             commonly_used: bool = False) -> ConfEntry:
+    e = ConfEntry(key, doc, default,
+                  type_ or (type(default) if default is not None else str),
+                  internal, startup_only, commonly_used)
+    ENTRIES[key] = e
+    return e
+
+
+# --- SQL behavior (names follow reference RapidsConf.scala) -----------------
+SQL_ENABLED = register(
+    "spark.rapids.sql.enabled",
+    "Enable or disable TPU acceleration of SQL operations.", True,
+    commonly_used=True)
+SQL_MODE = register(
+    "spark.rapids.sql.mode",
+    "executeOnGPU runs supported ops on the accelerator; explainOnly plans and "
+    "reports what would run without touching the device.", "executeongpu")
+EXPLAIN = register(
+    "spark.rapids.sql.explain",
+    "NONE | NOT_ON_GPU | ALL: log why operators are or are not placed on the "
+    "accelerator.", "NOT_ON_GPU", commonly_used=True)
+BATCH_SIZE_BYTES = register(
+    "spark.rapids.sql.batchSizeBytes",
+    "Target size in bytes for accelerator columnar batches "
+    "(reference default 1 GiB; TPU default tuned for HBM slices).",
+    1 << 30, commonly_used=True)
+BATCH_SIZE_ROWS = register(
+    "spark.rapids.sql.batchSizeRows",
+    "Target row count cap per columnar batch (shape-bucketing granularity).",
+    1 << 20)
+MAX_READER_BATCH_SIZE_ROWS = register(
+    "spark.rapids.sql.reader.batchSizeRows",
+    "Soft cap on rows per batch produced by readers.", (1 << 31) - 1)
+MAX_READER_BATCH_SIZE_BYTES = register(
+    "spark.rapids.sql.reader.batchSizeBytes",
+    "Soft cap on bytes per batch produced by readers.", (1 << 31) - 1)
+CONCURRENT_TASKS = register(
+    "spark.rapids.sql.concurrentGpuTasks",
+    "Number of tasks that may hold the device semaphore concurrently "
+    "(reference GpuSemaphore, RapidsConf.scala:535).", 1, commonly_used=True)
+TIERED_PROJECT = register(
+    "spark.rapids.sql.tiered.project.enabled",
+    "Dedup common subexpressions via tiered projection.", True)
+IMPROVED_FLOAT = register(
+    "spark.rapids.sql.improvedFloatOps.enabled",
+    "Allow float ops whose results may differ from CPU in ULPs.", True)
+HAS_NANS = register(
+    "spark.rapids.sql.hasNans",
+    "Assume floating point data may contain NaNs.", True)
+ANSI_ENABLED = register(
+    "spark.sql.ansi.enabled",
+    "ANSI mode: overflow/invalid-cast raise instead of null/wrap.", False)
+CASE_SENSITIVE = register(
+    "spark.sql.caseSensitive", "Case sensitive column resolution.", False)
+SESSION_TIMEZONE = register(
+    "spark.sql.session.timeZone", "Session timezone (UTC only on device, "
+    "mirroring the reference's UTC-only timezone check).", "UTC")
+SHUFFLE_PARTITIONS = register(
+    "spark.sql.shuffle.partitions", "Default shuffle partition count.", 8)
+
+# --- memory / runtime -------------------------------------------------------
+ALLOC_FRACTION = register(
+    "spark.rapids.memory.gpu.allocFraction",
+    "Fraction of device HBM the buffer pool may use.", 0.85)
+RESERVE_BYTES = register(
+    "spark.rapids.memory.gpu.reserve",
+    "Device memory reserved for XLA scratch/system.", 640 << 20)
+HOST_SPILL_STORAGE_SIZE = register(
+    "spark.rapids.memory.host.spillStorageSize",
+    "Host memory budget for spilled device buffers.", 1 << 30)
+PINNED_POOL_SIZE = register(
+    "spark.rapids.memory.pinnedPool.size",
+    "Pinned host pool size for H2D/D2H staging.", 0)
+SPILL_DIR = register(
+    "spark.rapids.memory.spillDir", "Directory for the disk spill tier.",
+    "/tmp/rapids_tpu_spill")
+OOM_RETRY_ENABLED = register(
+    "spark.rapids.sql.oomRetry.enabled",
+    "Enable the retry-on-OOM state machine (withRetry framework).", True)
+TEST_INJECT_RETRY_OOM = register(
+    "spark.rapids.sql.test.injectRetryOOM",
+    "Test hook: make the Nth retryable block throw a synthetic RetryOOM "
+    "(reference RapidsConf.scala:1371).", 0, internal=True)
+TEST_INJECT_SPLIT_OOM = register(
+    "spark.rapids.sql.test.injectSplitAndRetryOOM",
+    "Test hook: make the Nth retryable block throw SplitAndRetryOOM.",
+    0, internal=True)
+
+# --- shuffle ---------------------------------------------------------------
+SHUFFLE_MODE = register(
+    "spark.rapids.shuffle.mode",
+    "UCX|MULTITHREADED|SORT in the reference; here ICI|MULTITHREADED|SORT — "
+    "ICI keeps partitions in device memory and exchanges over the "
+    "interconnect with XLA collectives.", "MULTITHREADED")
+SHUFFLE_WRITER_THREADS = register(
+    "spark.rapids.shuffle.multiThreaded.writer.threads",
+    "Threads for the multithreaded shuffle writer.", 8)
+SHUFFLE_READER_THREADS = register(
+    "spark.rapids.shuffle.multiThreaded.reader.threads",
+    "Threads for the multithreaded shuffle reader.", 8)
+SHUFFLE_COMPRESSION_CODEC = register(
+    "spark.rapids.shuffle.compression.codec",
+    "Shuffle batch compression codec: none|zstd|lz4hc.", "zstd")
+SHUFFLE_MAX_BYTES_IN_FLIGHT = register(
+    "spark.rapids.shuffle.maxBytesInFlight",
+    "Cap on in-flight fetched shuffle bytes.", 128 << 20)
+
+# --- I/O -------------------------------------------------------------------
+PARQUET_READER_TYPE = register(
+    "spark.rapids.sql.format.parquet.reader.type",
+    "AUTO|PERFILE|MULTITHREADED|COALESCING multi-file reader strategy "
+    "(reference GpuMultiFileReader.scala:176-373).", "AUTO")
+MULTITHREAD_READ_NUM_THREADS = register(
+    "spark.rapids.sql.multiThreadedRead.numThreads",
+    "Thread pool size for multithreaded file reads.", 20)
+PARQUET_ENABLED = register(
+    "spark.rapids.sql.format.parquet.enabled", "Accelerate Parquet.", True)
+ORC_ENABLED = register(
+    "spark.rapids.sql.format.orc.enabled", "Accelerate ORC.", True)
+CSV_ENABLED = register(
+    "spark.rapids.sql.format.csv.enabled", "Accelerate CSV.", True)
+JSON_ENABLED = register(
+    "spark.rapids.sql.format.json.enabled", "Accelerate JSON.", False)
+AVRO_ENABLED = register(
+    "spark.rapids.sql.format.avro.enabled", "Accelerate Avro.", False)
+
+# --- optimizer -------------------------------------------------------------
+OPTIMIZER_ENABLED = register(
+    "spark.rapids.sql.optimizer.enabled",
+    "Cost-based CPU-vs-TPU optimizer (off by default like the reference).",
+    False)
+OPTIMIZER_DEFAULT_CPU_COST = register(
+    "spark.rapids.sql.optimizer.cpu.exec.default",
+    "Default CPU cost per row per op (seconds).", 0.0002)
+OPTIMIZER_DEFAULT_GPU_COST = register(
+    "spark.rapids.sql.optimizer.gpu.exec.default",
+    "Default accelerator cost per row per op (seconds).", 0.0001)
+
+# --- metrics / debug -------------------------------------------------------
+METRICS_LEVEL = register(
+    "spark.rapids.sql.metrics.level",
+    "ESSENTIAL|MODERATE|DEBUG operator metric verbosity.", "MODERATE")
+TRACE_ENABLED = register(
+    "spark.rapids.tpu.trace.enabled",
+    "Emit jax.profiler TraceMe ranges around operator execution "
+    "(NVTX-range equivalent).", False)
+DUMP_ON_ERROR_PATH = register(
+    "spark.rapids.sql.debug.dumpPath",
+    "If set, dump failing batches to parquet here (DumpUtils equivalent).",
+    "")
+STABLE_SORT = register(
+    "spark.rapids.sql.stableSort.enabled", "Force stable device sorts.", False)
+
+# --- TPU-specific ----------------------------------------------------------
+BUCKET_MIN_ROWS = register(
+    "spark.rapids.tpu.shapeBucket.minRows",
+    "Smallest shape bucket; batches are padded up to power-of-two row "
+    "capacities so XLA compiles one program per (schema, bucket).", 16)
+STRING_MAX_BYTES = register(
+    "spark.rapids.tpu.string.maxBytes",
+    "Per-bucket cap on padded string width (bytes per row).", 8192)
+DEVICE_MESH_AXES = register(
+    "spark.rapids.tpu.mesh.axes",
+    "Comma list of mesh axis names for distributed exchange.", "data")
+EXPLAIN_ONLY_PLATFORM = register(
+    "spark.rapids.tpu.explainOnly.platform",
+    "Platform assumed when planning in explainOnly mode without a TPU.",
+    "tpu", internal=True)
+
+
+class RapidsConf:
+    """Immutable-ish snapshot of config values, resolved from defaults +
+    overrides + ``SPARK_RAPIDS_*`` style environment variables."""
+
+    _global_lock = threading.Lock()
+    _global: Optional["RapidsConf"] = None
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = {}
+        overrides = dict(overrides or {})
+        for key, entry in ENTRIES.items():
+            env_key = key.upper().replace(".", "_")
+            raw = overrides.pop(key, os.environ.get(env_key))
+            self._values[key] = entry.convert(raw)
+        # unknown keys are kept verbatim (forward compat, like SQLConf)
+        self._extra = overrides
+
+    def get(self, key_or_entry, default: Any = None) -> Any:
+        key = key_or_entry.key if isinstance(key_or_entry, ConfEntry) else key_or_entry
+        if key in self._values:
+            return self._values[key]
+        return self._extra.get(key, default)
+
+    def set(self, key: str, value: Any) -> "RapidsConf":
+        if key in ENTRIES:
+            self._values[key] = ENTRIES[key].convert(value)
+        else:
+            self._extra[key] = value
+        return self
+
+    def copy(self, overrides: Optional[Dict[str, Any]] = None) -> "RapidsConf":
+        c = RapidsConf()
+        c._values = dict(self._values)
+        c._extra = dict(self._extra)
+        for k, v in (overrides or {}).items():
+            c.set(k, v)
+        return c
+
+    # Convenience typed accessors used across the engine -------------------
+    @property
+    def is_sql_enabled(self) -> bool:
+        return bool(self.get(SQL_ENABLED))
+
+    @property
+    def is_explain_only(self) -> bool:
+        return str(self.get(SQL_MODE)).lower() == "explainonly"
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return int(self.get(BATCH_SIZE_BYTES))
+
+    @property
+    def batch_size_rows(self) -> int:
+        return int(self.get(BATCH_SIZE_ROWS))
+
+    @property
+    def ansi_enabled(self) -> bool:
+        return bool(self.get(ANSI_ENABLED))
+
+    @property
+    def concurrent_tasks(self) -> int:
+        return int(self.get(CONCURRENT_TASKS))
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return int(self.get(SHUFFLE_PARTITIONS))
+
+    @classmethod
+    def get_global(cls) -> "RapidsConf":
+        with cls._global_lock:
+            if cls._global is None:
+                cls._global = RapidsConf()
+            return cls._global
+
+    @classmethod
+    def set_global(cls, conf: "RapidsConf") -> None:
+        with cls._global_lock:
+            cls._global = conf
+
+
+def help_text(include_internal: bool = False) -> str:
+    """Markdown config documentation, mirroring RapidsConf.help() docgen
+    (reference RapidsConf.scala:2057-2103 emits docs/configs.md)."""
+    lines = ["# Configuration", "",
+             "Name | Description | Default Value", "-----|-------------|--------------"]
+    for key in sorted(ENTRIES):
+        e = ENTRIES[key]
+        if e.internal and not include_internal:
+            continue
+        lines.append(f"{e.key} | {e.doc} | {e.default}")
+    return "\n".join(lines) + "\n"

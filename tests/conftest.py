@@ -1,0 +1,39 @@
+"""Test bootstrap: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding logic is exercised without TPU hardware (the driver
+separately dry-runs the multichip path).
+
+Note: the ambient environment registers the "axon" real-TPU tunnel backend
+from sitecustomize and forces ``jax_platforms=axon,cpu`` via jax.config (so
+env vars can't override it).  Tests must flip the *config* back to cpu before
+any backend initializes, or the first jax.devices() blocks on the tunnel.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture()
+def session():
+    import spark_rapids_tpu as srt
+    s = srt.session()
+    yield s

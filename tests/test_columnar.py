@@ -1,0 +1,137 @@
+"""Round-trip and layout tests for the columnar batch model."""
+
+import datetime
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import (ColumnarBatch, arrow_to_device,
+                                       bucket_capacity, bucket_width,
+                                       device_to_arrow, scalar_column)
+
+
+def roundtrip(table: pa.Table) -> pa.Table:
+    return device_to_arrow(arrow_to_device(table))
+
+
+def assert_tables_equal(a: pa.Table, b: pa.Table):
+    assert a.schema.names == b.schema.names
+    for name in a.schema.names:
+        ca, cb = a.column(name), b.column(name)
+        assert ca.to_pylist() == cb.to_pylist(), name
+
+
+def test_bucketing():
+    assert bucket_capacity(0) == 8
+    assert bucket_capacity(8) == 8
+    assert bucket_capacity(9) == 16
+    assert bucket_capacity(1000) == 1024
+    assert bucket_width(0) == 4
+    assert bucket_width(5) == 8
+
+
+def test_fixed_width_roundtrip():
+    t = pa.table({
+        "i32": pa.array([1, 2, None, -4], type=pa.int32()),
+        "i64": pa.array([10, None, 30, 40], type=pa.int64()),
+        "f64": pa.array([1.5, None, float("nan"), -0.0]),
+        "b": pa.array([True, False, None, True]),
+        "i8": pa.array([1, -1, None, 127], type=pa.int8()),
+    })
+    out = roundtrip(t)
+    assert out.column("i32").to_pylist() == [1, 2, None, -4]
+    assert out.column("i64").to_pylist() == [10, None, 30, 40]
+    assert out.column("b").to_pylist() == [True, False, None, True]
+    f = out.column("f64").to_pylist()
+    assert f[0] == 1.5 and f[1] is None and np.isnan(f[2]) and f[3] == -0.0
+
+
+def test_string_roundtrip():
+    vals = ["hello", "", None, "日本語テキスト", "x" * 100]
+    t = pa.table({"s": pa.array(vals)})
+    out = roundtrip(t)
+    assert out.column("s").to_pylist() == vals
+
+
+def test_binary_roundtrip():
+    vals = [b"\x00\x01", b"", None, b"abcdef"]
+    t = pa.table({"b": pa.array(vals, type=pa.binary())})
+    out = roundtrip(t)
+    assert out.column("b").to_pylist() == vals
+
+
+def test_date_timestamp_roundtrip():
+    d = [datetime.date(2020, 1, 1), None, datetime.date(1969, 12, 31)]
+    ts = [datetime.datetime(2021, 6, 1, 12, 30, 15, 123456,
+                            tzinfo=datetime.timezone.utc), None,
+          datetime.datetime(1960, 1, 1, tzinfo=datetime.timezone.utc)]
+    t = pa.table({"d": pa.array(d, type=pa.date32()),
+                  "ts": pa.array(ts, type=pa.timestamp("us", tz="UTC"))})
+    out = roundtrip(t)
+    assert out.column("d").to_pylist() == d
+    assert out.column("ts").to_pylist() == ts
+
+
+def test_decimal_roundtrip():
+    vals = [decimal.Decimal("123.45"), None, decimal.Decimal("-0.01"),
+            decimal.Decimal("99999999.99")]
+    t = pa.table({"dec": pa.array(vals, type=pa.decimal128(10, 2))})
+    out = roundtrip(t)
+    assert out.column("dec").to_pylist() == vals
+
+
+def test_decimal128_roundtrip():
+    vals = [decimal.Decimal("12345678901234567890123.456"), None,
+            decimal.Decimal("-98765432109876543210.999")]
+    t = pa.table({"dec": pa.array(vals, type=pa.decimal128(30, 3))})
+    out = roundtrip(t)
+    assert out.column("dec").to_pylist() == vals
+
+
+def test_struct_roundtrip():
+    vals = [{"a": 1, "b": "x"}, None, {"a": None, "b": "z"}]
+    t = pa.table({"st": pa.array(vals, type=pa.struct(
+        [("a", pa.int64()), ("b", pa.string())]))})
+    out = roundtrip(t)
+    assert out.column("st").to_pylist() == vals
+
+
+def test_slice_and_concat():
+    t = pa.table({"x": pa.array(range(100), type=pa.int64()),
+                  "s": pa.array([f"v{i}" for i in range(100)])})
+    b = arrow_to_device(t)
+    s1 = b.sliced(0, 40)
+    s2 = b.sliced(40, 60)
+    assert s1.num_rows_int == 40 and s2.num_rows_int == 60
+    cat = ColumnarBatch.concat([s1, s2])
+    assert_tables_equal(device_to_arrow(cat), t)
+
+
+def test_scalar_column():
+    c = scalar_column(__import__("spark_rapids_tpu").STRING, "abc", 16)
+    assert c.capacity == 16
+    import spark_rapids_tpu.columnar.convert as cv
+    arr = cv.device_column_to_arrow(c, 3)
+    assert arr.to_pylist() == ["abc", "abc", "abc"]
+
+
+def test_empty_table():
+    t = pa.table({"x": pa.array([], type=pa.int64()),
+                  "s": pa.array([], type=pa.string())})
+    out = roundtrip(t)
+    assert out.num_rows == 0
+
+
+def test_sliced_arrow_string_input():
+    # regression: offsets buffer not starting at 0 (sliced arrays)
+    import spark_rapids_tpu.columnar.convert as cv
+    arr = pa.array(["aa", "bbb", "cccc", "dd"]).slice(1)
+    col = cv.arrow_to_device_column(arr, 8)
+    assert cv.device_column_to_arrow(col, 3).to_pylist() == ["bbb", "cccc", "dd"]
+
+
+def test_list_column_clear_error():
+    with pytest.raises(NotImplementedError):
+        arrow_to_device(pa.table({"l": pa.array([[1, 2], [3]])}))
