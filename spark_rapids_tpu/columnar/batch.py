@@ -113,6 +113,8 @@ class ColumnarBatch:
     def concat(batches: Sequence["ColumnarBatch"]) -> "ColumnarBatch":
         """Concatenate batches (cudf ``Table.concatenate`` analog).  Uses a
         gather per input into a fresh bucket so string widths re-align."""
+        if not batches:
+            raise ValueError("ColumnarBatch.concat requires at least one batch")
         batches = [b for b in batches if b.num_rows_int > 0] or list(batches[:1])
         if len(batches) == 1:
             return batches[0]
@@ -155,6 +157,8 @@ def _concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[int],
 
 
 def _concat_1d(arrs, counts, out_capacity, fill):
+    if getattr(arrs[0], "dtype", None) == object:  # host nested columns
+        return _concat_object(arrs, counts, out_capacity)
     live = [a[:n] for a, n in zip(arrs, counts)]
     cat = jnp.concatenate(live) if live else arrs[0][:0]
     pad = out_capacity - cat.shape[0]
@@ -162,7 +166,19 @@ def _concat_1d(arrs, counts, out_capacity, fill):
 
 
 def _concat_nd(arrs, counts, out_capacity):
+    if getattr(arrs[0], "dtype", None) == object:  # host nested columns
+        return _concat_object(arrs, counts, out_capacity)
     live = [a[:n] for a, n in zip(arrs, counts)]
     cat = jnp.concatenate(live, axis=0) if live else arrs[0][:0]
     pad = [(0, out_capacity - cat.shape[0])] + [(0, 0)] * (cat.ndim - 1)
     return jnp.pad(cat, pad)
+
+
+def _concat_object(arrs, counts, out_capacity):
+    import numpy as np
+    out = np.empty(out_capacity, dtype=object)
+    pos = 0
+    for a, n in zip(arrs, counts):
+        out[pos:pos + n] = np.asarray(a)[:n]
+        pos += n
+    return out

@@ -111,6 +111,10 @@ class DeviceColumn:
                 return arr
             if cap > new_capacity:
                 return arr[:new_capacity]
+            if getattr(arr, "dtype", None) == object:  # host nested column
+                out = np.empty(new_capacity, dtype=object)
+                out[:cap] = np.asarray(arr)
+                return out
             pad = [(0, new_capacity - cap)] + [(0, 0)] * (arr.ndim - 1)
             return jnp.pad(arr, pad, constant_values=fill)
 

@@ -48,9 +48,13 @@ def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:
         return null_column(dtype, capacity).with_validity(validity)
 
     if isinstance(dtype, (T.ArrayType, T.MapType)):
-        raise NotImplementedError(
-            f"device layout for {dtype.simple_string()} columns is not yet "
-            "implemented; keep this column on the host (CPU fallback)")
+        # host-object column: the CPU fallback engine carries nested data as
+        # a numpy object array; any attempt to upload it to the device fails
+        # loudly (the overrides layer keeps such columns on the host)
+        vals = np.empty(capacity, dtype=object)
+        if n:
+            vals[:n] = arr.to_pylist()
+        return DeviceColumn(dtype, vals, validity)
 
     if isinstance(dtype, T.StructType):
         children = tuple(arrow_to_device_column(arr.field(i), capacity)
@@ -162,6 +166,11 @@ def device_column_to_arrow(col: DeviceColumn, n: int) -> pa.Array:
 
     if isinstance(dtype, T.NullType):
         return pa.nulls(n)
+
+    if isinstance(dtype, (T.ArrayType, T.MapType)):
+        vals = [None if not v else x
+                for v, x in zip(valid, list(np.asarray(col.data)[:n]))]
+        return pa.array(vals, type=T.to_arrow(dtype))
 
     if isinstance(dtype, T.StructType):
         children = [device_column_to_arrow(c, n) for c in col.children]

@@ -316,5 +316,6 @@ def help_text(include_internal: bool = False) -> str:
         e = ENTRIES[key]
         if e.internal and not include_internal:
             continue
-        lines.append(f"{e.key} | {e.doc} | {e.default}")
+        doc = e.doc.replace("|", "\\|")
+        lines.append(f"{e.key} | {doc} | {e.default}")
     return "\n".join(lines) + "\n"

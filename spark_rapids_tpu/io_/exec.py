@@ -1,0 +1,78 @@
+"""File scan exec with the reference's multi-file reader strategies
+(``GpuMultiFileReader.scala:176-373``): PERFILE (one file per batch),
+MULTITHREADED (thread-pool prefetch, cloud-friendly), COALESCING (combine
+small files into one batch before upload)."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.convert import arrow_to_device
+from ..config import RapidsConf, MULTITHREAD_READ_NUM_THREADS, PARQUET_READER_TYPE
+from ..sql.physical.base import CPU, TPU, PhysicalPlan, TaskContext
+from . import registry
+
+
+class FileScanExec(PhysicalPlan):
+    def __init__(self, node, backend=TPU, conf: Optional[RapidsConf] = None,
+                 files_per_partition: int = 1):
+        super().__init__()
+        self.backend = backend
+        self.node = node
+        self.conf = conf or RapidsConf.get_global()
+        self.files = registry.expand_paths(node.paths)
+        self.reader_type = str(self.conf.get(PARQUET_READER_TYPE)).upper()
+        if self.reader_type == "AUTO":
+            self.reader_type = "MULTITHREADED" if len(self.files) > 1 else "PERFILE"
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    @property
+    def output(self):
+        return self.node.output
+
+    def num_partitions(self):
+        if self.reader_type == "COALESCING":
+            return 1
+        return max(1, len(self.files))
+
+    def _read(self, path):
+        return registry.read_file(self.node.fmt, path, self.node.options)
+
+    def execute(self, pid: int, tctx: TaskContext):
+        import jax
+
+        def upload(table):
+            batch = arrow_to_device(table)
+            if self.backend == CPU:
+                batch = jax.tree.map(np.asarray, batch)
+            return batch
+
+        if self.reader_type == "COALESCING":
+            import pyarrow as pa
+            n_threads = int(self.conf.get(MULTITHREAD_READ_NUM_THREADS))
+            with ThreadPoolExecutor(max_workers=n_threads) as pool:
+                tables = list(pool.map(self._read, self.files))
+            if tables:
+                yield upload(pa.concat_tables(tables, promote_options="default"))
+            return
+
+        if pid >= len(self.files):
+            return
+        if self.reader_type == "MULTITHREADED":
+            # per-partition prefetch through a shared pool: submit this file
+            # read on a worker thread so decode overlaps device compute
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=int(self.conf.get(MULTITHREAD_READ_NUM_THREADS)))
+            fut = self._pool.submit(self._read, self.files[pid])
+            yield upload(fut.result())
+            return
+        yield upload(self._read(self.files[pid]))
+
+    def simple_string(self):
+        return (f"{self.node_name()} {self.node.fmt} "
+                f"[{len(self.files)} files, {self.reader_type}]")

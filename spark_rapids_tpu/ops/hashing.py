@@ -1,0 +1,262 @@
+"""Spark-exact hash kernels (murmur3-x86-32 and xxhash64), vectorized for
+TPU/VPU execution.  These mirror the semantics of the reference's JNI
+``Hash`` kernels (``com.nvidia.spark.rapids.jni.Hash`` — murmur3/xxhash64
+"Spark-compatible"; SURVEY §2.10): hash partitioning and the hash()/xxhash64()
+SQL functions must produce the very values CPU Spark produces, or shuffles
+and tests diverge.
+
+All functions take/return arrays under either jnp or numpy (``xp``).
+Integer ops are done in uint32/uint64 with wrapping arithmetic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_SEED = 42
+
+_C1 = np.uint32(0xcc9e2d51)
+_C2 = np.uint32(0x1b873593)
+_M5 = np.uint32(0xe6546b64)
+_FX1 = np.uint32(0x85ebca6b)
+_FX2 = np.uint32(0xc2b2ae35)
+
+
+def _rotl32(xp, x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mix_k1(xp, k1):
+    k1 = (k1 * _C1).astype(xp.uint32)
+    k1 = _rotl32(xp, k1, 15)
+    return (k1 * _C2).astype(xp.uint32)
+
+
+def _mix_h1(xp, h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(xp, h1, 13)
+    return (h1 * np.uint32(5) + _M5).astype(xp.uint32)
+
+
+def _fmix(xp, h1, length):
+    h1 = h1 ^ xp.asarray(length, dtype=xp.uint32)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = (h1 * _FX1).astype(xp.uint32)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = (h1 * _FX2).astype(xp.uint32)
+    return h1 ^ (h1 >> np.uint32(16))
+
+
+def murmur3_int(xp, values_i32, seed_u32):
+    """hashInt: values int32 array, seed uint32 array/scalar -> int32."""
+    k1 = _mix_k1(xp, values_i32.astype(xp.uint32))
+    h1 = _mix_h1(xp, xp.asarray(seed_u32, dtype=xp.uint32), k1)
+    return _fmix(xp, h1, 4).astype(xp.int32)
+
+
+def murmur3_long(xp, values_i64, seed_u32):
+    low = values_i64.astype(xp.uint32)
+    high = (values_i64.astype(xp.uint64) >> np.uint64(32)).astype(xp.uint32)
+    h1 = _mix_h1(xp, xp.asarray(seed_u32, dtype=xp.uint32), _mix_k1(xp, low))
+    h1 = _mix_h1(xp, h1, _mix_k1(xp, high))
+    return _fmix(xp, h1, 8).astype(xp.int32)
+
+
+def murmur3_bytes(xp, chars_u8, lengths_i32, seed_u32):
+    """Spark hashUnsafeBytes: 4-byte little-endian blocks, then the tail
+    processed one SIGNED byte at a time (Spark-specific, not standard
+    murmur3 tail)."""
+    rows, width = chars_u8.shape
+    nblocks = (lengths_i32 // 4).astype(xp.int32)
+    h1 = xp.broadcast_to(xp.asarray(seed_u32, dtype=xp.uint32), (rows,)).astype(xp.uint32)
+    c = chars_u8.astype(xp.uint32)
+    max_blocks = width // 4
+    for j in range(max_blocks):
+        block = (c[:, 4 * j] | (c[:, 4 * j + 1] << np.uint32(8))
+                 | (c[:, 4 * j + 2] << np.uint32(16))
+                 | (c[:, 4 * j + 3] << np.uint32(24)))
+        mixed = _mix_h1(xp, h1, _mix_k1(xp, block))
+        h1 = xp.where(j < nblocks, mixed, h1)
+    sbytes = chars_u8.astype(xp.int8).astype(xp.int32)
+    for p in range(width):
+        is_tail = (p >= 4 * nblocks) & (p < lengths_i32)
+        mixed = _mix_h1(xp, h1, _mix_k1(xp, sbytes[:, p].astype(xp.uint32)))
+        h1 = xp.where(is_tail, mixed, h1)
+    return _fmix(xp, h1, lengths_i32.astype(xp.uint32)).astype(xp.int32)
+
+
+# --------------------------------------------------------------------------
+# xxhash64 (Spark XxHash64 expression semantics, seed 42)
+# --------------------------------------------------------------------------
+
+_P1 = np.uint64(0x9E3779B185EBCA87)
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_P5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(xp, x, r):
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def _xx_fmix(xp, h):
+    h = h ^ (h >> np.uint64(33))
+    h = (h * _P2).astype(xp.uint64)
+    h = h ^ (h >> np.uint64(29))
+    h = (h * _P3).astype(xp.uint64)
+    return h ^ (h >> np.uint64(32))
+
+
+def _xx_process_long(xp, h, k):
+    k = (k * _P2).astype(xp.uint64)
+    k = _rotl64(xp, k, 31)
+    k = (k * _P1).astype(xp.uint64)
+    h = h ^ k
+    h = _rotl64(xp, h, 27)
+    return (h * _P1 + _P4).astype(xp.uint64)
+
+
+def _xx_process_int(xp, h, k_u32):
+    h = h ^ ((k_u32.astype(xp.uint64) * _P1).astype(xp.uint64))
+    h = _rotl64(xp, h, 23)
+    return (h * _P2 + _P3).astype(xp.uint64)
+
+
+def _xx_process_byte(xp, h, b_u8):
+    h = h ^ ((b_u8.astype(xp.uint64) * _P5).astype(xp.uint64))
+    h = _rotl64(xp, h, 11)
+    return (h * _P1).astype(xp.uint64)
+
+
+def xxhash64_long(xp, values_i64, seed_u64):
+    h = (xp.asarray(seed_u64, dtype=xp.uint64) + _P5 + np.uint64(8)).astype(xp.uint64)
+    h = _xx_process_long(xp, h, values_i64.astype(xp.uint64))
+    return _xx_fmix(xp, h).astype(xp.int64)
+
+
+def xxhash64_int(xp, values_i32, seed_u64):
+    # Spark promotes int-ish types to long before hashing
+    return xxhash64_long(xp, values_i32.astype(xp.int64), seed_u64)
+
+
+def xxhash64_bytes(xp, chars_u8, lengths_i32, seed_u64):
+    """Standard XXH64 over each row's bytes (Spark hashUnsafeBytes for
+    xxhash64): 32-byte stripes with 4 accumulators, then 8/4/1-byte tails."""
+    rows, width = chars_u8.shape
+    length = lengths_i32.astype(xp.uint64)
+    seed = xp.broadcast_to(xp.asarray(seed_u64, dtype=xp.uint64), (rows,)).astype(xp.uint64)
+    c = chars_u8.astype(xp.uint64)
+
+    def get64(start_col):
+        out = xp.zeros((rows,), dtype=xp.uint64)
+        for b in range(8):
+            col = start_col + b
+            if col < width:
+                out = out | (c[:, col] << np.uint64(8 * b))
+        return out
+
+    def get32(start_col):
+        out = xp.zeros((rows,), dtype=xp.uint64)
+        for b in range(4):
+            col = start_col + b
+            if col < width:
+                out = out | (c[:, col] << np.uint64(8 * b))
+        return out.astype(xp.uint32)
+
+    n_stripes = (lengths_i32 // 32).astype(xp.int32)
+    max_stripes = (width + 31) // 32
+
+    v1 = (seed + _P1 + _P2).astype(xp.uint64)
+    v2 = (seed + _P2).astype(xp.uint64)
+    v3 = seed
+    v4 = (seed - _P1).astype(xp.uint64)
+
+    def round_(acc, inp):
+        acc = (acc + (inp * _P2).astype(xp.uint64)).astype(xp.uint64)
+        acc = _rotl64(xp, acc, 31)
+        return (acc * _P1).astype(xp.uint64)
+
+    any_stripe = False
+    for s in range(max_stripes):
+        base = 32 * s
+        if base + 32 > width:
+            break
+        any_stripe = True
+        m = s < n_stripes
+        v1 = xp.where(m, round_(v1, get64(base)), v1)
+        v2 = xp.where(m, round_(v2, get64(base + 8)), v2)
+        v3 = xp.where(m, round_(v3, get64(base + 16)), v3)
+        v4 = xp.where(m, round_(v4, get64(base + 24)), v4)
+
+    merged = (_rotl64(xp, v1, 1) + _rotl64(xp, v2, 7)
+              + _rotl64(xp, v3, 12) + _rotl64(xp, v4, 18)).astype(xp.uint64)
+
+    def merge(acc, v):
+        acc = acc ^ round_(xp.zeros_like(acc), v)
+        return (acc * _P1 + _P4).astype(xp.uint64)
+
+    merged = merge(merged, v1)
+    merged = merge(merged, v2)
+    merged = merge(merged, v3)
+    merged = merge(merged, v4)
+
+    small = (seed + _P5).astype(xp.uint64)
+    has_stripes = n_stripes > 0
+    h = xp.where(has_stripes, merged, small)
+    h = (h + length).astype(xp.uint64)
+
+    # tail: 8-byte chunks
+    stripe_end = (n_stripes * 32).astype(xp.int32)
+    max_longs = width // 8
+    for j in range(max_longs + 1):
+        pos = None
+        # position of the j-th tail long for each row is stripe_end + 8*j
+        start = stripe_end + 8 * j
+        m = (start + 8) <= lengths_i32
+        if not _may_be_true(xp, m):
+            continue
+        k = _gather64(xp, c, start, width)
+        h = xp.where(m, _xx_process_long(xp, h, k), h)
+    # 4-byte chunk
+    longs_done = ((lengths_i32 - stripe_end) // 8) * 8
+    pos4 = stripe_end + longs_done
+    m4 = (pos4 + 4) <= lengths_i32
+    k4 = _gather32(xp, c, pos4, width)
+    h = xp.where(m4, _xx_process_int(xp, h, k4), h)
+    pos_b = pos4 + xp.where(m4, 4, 0)
+    # remaining single bytes
+    for b in range(8):
+        p = pos_b + b
+        m = p < lengths_i32
+        if not _may_be_true(xp, m):
+            continue
+        byte = _gather8(xp, c, p, width)
+        h = xp.where(m, _xx_process_byte(xp, h, byte), h)
+    return _xx_fmix(xp, h).astype(xp.int64)
+
+
+def _may_be_true(xp, m):
+    if xp.__name__ == "numpy":
+        return bool(np.any(m))
+    return True  # traced: keep the op, XLA prunes nothing but it's correct
+
+
+def _gather8(xp, c_u64, pos, width):
+    idx = xp.clip(pos, 0, width - 1)
+    rows = xp.arange(c_u64.shape[0])
+    return c_u64[rows, idx]
+
+
+def _gather64(xp, c_u64, start, width):
+    out = xp.zeros((c_u64.shape[0],), dtype=xp.uint64)
+    for b in range(8):
+        out = out | (_gather8(xp, c_u64, start + b, width) << np.uint64(8 * b))
+    return out
+
+
+def _gather32(xp, c_u64, start, width):
+    out = xp.zeros((c_u64.shape[0],), dtype=xp.uint64)
+    for b in range(4):
+        out = out | (_gather8(xp, c_u64, start + b, width) << np.uint64(8 * b))
+    return out.astype(xp.uint32)

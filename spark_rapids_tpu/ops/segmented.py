@@ -1,0 +1,40 @@
+"""Segmented reductions over sorted group ids — the TPU replacement for
+cuDF's hash-based ``Table.groupBy().aggregate(...)`` (reference
+``aggregate.scala`` AggHelper).  Works under jnp (scatter-add lowered by XLA)
+and numpy (ufunc.at)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def seg_sum(xp, data, seg_ids, num_segments, dtype=None):
+    out = xp.zeros((num_segments,), dtype=dtype or data.dtype)
+    if xp.__name__ == "numpy":
+        np.add.at(out, seg_ids, data.astype(out.dtype))
+        return out
+    return out.at[seg_ids].add(data.astype(out.dtype))
+
+
+def seg_min(xp, data, seg_ids, num_segments, init):
+    out = xp.full((num_segments,), init, dtype=data.dtype)
+    if xp.__name__ == "numpy":
+        np.minimum.at(out, seg_ids, data)
+        return out
+    return out.at[seg_ids].min(data)
+
+
+def seg_max(xp, data, seg_ids, num_segments, init):
+    out = xp.full((num_segments,), init, dtype=data.dtype)
+    if xp.__name__ == "numpy":
+        np.maximum.at(out, seg_ids, data)
+        return out
+    return out.at[seg_ids].max(data)
+
+
+def seg_any(xp, mask, seg_ids, num_segments):
+    return seg_sum(xp, mask.astype(xp.int32), seg_ids, num_segments) > 0
+
+
+def seg_count(xp, mask, seg_ids, num_segments):
+    return seg_sum(xp, mask.astype(xp.int64), seg_ids, num_segments)

@@ -1,0 +1,45 @@
+"""Total-order sort over columnar batches (reference ``GpuSortExec``/
+``SortUtils.scala``, backed there by cudf radix sort).
+
+TPU approach: multi-pass stable argsort over per-column integer sort keys
+(least-significant key first), which XLA lowers to its native sort.  Handles
+asc/desc, nulls-first/last, Spark float ordering (NaN largest, -0.0 == 0.0),
+strings (big-endian chunk keys) and dead-row padding (always sorted last).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ..columnar.column import DeviceColumn
+from .ranks import column_sort_keys, stable_argsort
+
+
+def sort_permutation(xp, specs: Sequence[Tuple[DeviceColumn, bool, bool]],
+                     row_mask) -> "xp.ndarray":
+    """specs: [(column, ascending, nulls_first), ...] in sort-priority order
+    (most significant first).  row_mask: bool[capacity] live-row mask.
+    Returns int32 permutation putting rows in order, dead rows last."""
+    n = row_mask.shape[0]
+    perm = xp.arange(n, dtype=xp.int64)
+
+    # least-significant first: iterate specs in reverse
+    for col, asc, nulls_first in reversed(list(specs)):
+        keys = column_sort_keys(xp, col)  # most-significant first
+        for k in reversed(keys):
+            k = k[perm]
+            if not asc:
+                k = -k
+            p = stable_argsort(xp, k)
+            perm = perm[p]
+        # null ordering pass (most significant within this column)
+        null_key = (~col.validity).astype(xp.int8)[perm]
+        if nulls_first:
+            null_key = -null_key
+        p = stable_argsort(xp, null_key)
+        perm = perm[p]
+
+    # dead rows last (most significant overall)
+    dead = (~row_mask).astype(xp.int8)[perm]
+    p = stable_argsort(xp, dead)
+    return perm[p].astype(xp.int32)

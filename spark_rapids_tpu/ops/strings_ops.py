@@ -1,0 +1,52 @@
+"""Low-level string kernels over the padded byte-matrix layout.
+
+These are the TPU equivalents of cuDF's string primitives (reference consumes
+them as ``ai.rapids.cudf.ColumnVector`` string ops).  All kernels are
+vectorized over [rows, width] uint8 matrices + int32 lengths and work under
+both jnp (device, traceable) and numpy (host) backends.
+"""
+
+from __future__ import annotations
+
+
+def masked_bytes(xp, chars, lengths, sentinel=-1):
+    """int16[rows, width]: byte values inside the string, sentinel beyond its
+    length — makes padded bytes inert for comparisons."""
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    return xp.where(pos < lengths[:, None], chars.astype(xp.int16),
+                    xp.asarray(sentinel, dtype=xp.int16))
+
+
+def _align(xp, a_chars, b_chars):
+    wa, wb = a_chars.shape[1], b_chars.shape[1]
+    w = max(wa, wb)
+    if wa < w:
+        a_chars = xp.pad(a_chars, ((0, 0), (0, w - wa)))
+    if wb < w:
+        b_chars = xp.pad(b_chars, ((0, 0), (0, w - wb)))
+    return a_chars, b_chars
+
+
+def string_compare(xp, a_chars, a_lens, b_chars, b_lens):
+    """Lexicographic byte compare -> int32 in {-1, 0, 1} per row (unsigned
+    byte order, which matches UTF-8 codepoint order)."""
+    a_chars, b_chars = _align(xp, a_chars, b_chars)
+    av = masked_bytes(xp, a_chars, a_lens)
+    bv = masked_bytes(xp, b_chars, b_lens)
+    neq = av != bv
+    any_neq = xp.any(neq, axis=1)
+    first = xp.argmax(neq, axis=1)
+    rows = xp.arange(a_chars.shape[0])
+    d = av[rows, first] - bv[rows, first]
+    return xp.where(any_neq, xp.sign(d).astype(xp.int32), 0)
+
+
+def string_equals(xp, a_chars, a_lens, b_chars, b_lens):
+    a_chars, b_chars = _align(xp, a_chars, b_chars)
+    same_len = a_lens == b_lens
+    width = a_chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    in_str = pos < a_lens[:, None]
+    byte_eq = (a_chars == b_chars) | ~in_str
+    return same_len & xp.all(byte_eq, axis=1)

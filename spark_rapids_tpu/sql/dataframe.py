@@ -1,0 +1,548 @@
+"""User-facing DataFrame / Column API (PySpark-flavored), the zero-code-change
+surface the reference preserves (``spark.rapids.sql.enabled`` semantics: same
+queries, accelerated transparently; SURVEY §1 user-visible API)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from .. import types as T
+from . import plan as P
+from .expressions import arithmetic as A
+from .expressions import predicates as PR
+from .expressions.cast import Cast
+from .expressions.core import (Alias, AttributeReference, Expression, Literal)
+
+
+def _to_expr(v) -> Expression:
+    if isinstance(v, Column):
+        return v.expr
+    if isinstance(v, Expression):
+        return v
+    return Literal(v)
+
+
+def _binary(cls, a, b, swap=False):
+    ea, eb = _to_expr(a), _to_expr(b)
+    if swap:
+        ea, eb = eb, ea
+    ea, eb = _coerce_pair(ea, eb)
+    return Column(cls(ea, eb))
+
+
+def _is_unresolved(e: Expression) -> bool:
+    return bool(e.collect(lambda x: getattr(x, "_unresolved", False)))
+
+
+def _coerce_pair(a: Expression, b: Expression) -> Tuple[Expression, Expression]:
+    """Insert casts for mismatched-but-coercible types (analyzer-lite)."""
+    if _is_unresolved(a) or _is_unresolved(b):
+        return a, b  # re-coerced after name resolution
+    try:
+        ta, tb = a.data_type, b.data_type
+    except NotImplementedError:
+        return a, b
+    if ta == tb:
+        return a, b
+    ct = T.common_type(ta, tb)
+    if ct is None:
+        return a, b
+    if ta != ct:
+        a = Cast(a, ct)
+    if tb != ct:
+        b = Cast(b, ct)
+    return a, b
+
+
+def _resolve_expr(e: Expression, plan: P.LogicalPlan) -> Expression:
+    """Replace F.col() unresolved attributes with the plan's output attrs,
+    then re-run binary type coercion bottom-up."""
+    attrs = plan.output
+
+    def sub(node):
+        if getattr(node, "_unresolved", False):
+            for a in attrs:
+                if a.name.lower() == node.name.lower():
+                    return a
+            raise KeyError(f"cannot resolve column '{node.name}' among "
+                           f"{[a.name for a in attrs]}")
+        return None
+    e = e.transform(sub)
+
+    from .expressions.arithmetic import BinaryArithmetic
+    from .expressions.predicates import BinaryComparison
+
+    def coerce(node):
+        if isinstance(node, (BinaryArithmetic, BinaryComparison)):
+            a, b = _coerce_pair(node.children[0], node.children[1])
+            if (a, b) != (node.children[0], node.children[1]):
+                return node.with_children((a, b))
+        return None
+    return e.transform(coerce)
+
+
+class Column:
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # arithmetic
+    def __add__(self, o):
+        return _binary(A.Add, self, o)
+
+    def __radd__(self, o):
+        return _binary(A.Add, self, o, swap=True)
+
+    def __sub__(self, o):
+        return _binary(A.Subtract, self, o)
+
+    def __rsub__(self, o):
+        return _binary(A.Subtract, self, o, swap=True)
+
+    def __mul__(self, o):
+        return _binary(A.Multiply, self, o)
+
+    def __rmul__(self, o):
+        return _binary(A.Multiply, self, o, swap=True)
+
+    def __truediv__(self, o):
+        c = _binary(A.Divide, self, o)
+        e = c.expr
+        if not isinstance(e.children[0].data_type, (T.FloatType, T.DoubleType,
+                                                    T.DecimalType)):
+            e = A.Divide(Cast(e.children[0], T.DOUBLE),
+                         Cast(e.children[1], T.DOUBLE))
+        return Column(e)
+
+    def __rtruediv__(self, o):
+        return Column(A.Divide(Cast(_to_expr(o), T.DOUBLE),
+                               Cast(self.expr, T.DOUBLE)))
+
+    def __mod__(self, o):
+        return _binary(A.Remainder, self, o)
+
+    def __neg__(self):
+        return Column(A.UnaryMinus(self.expr))
+
+    # comparisons
+    def __eq__(self, o):  # type: ignore[override]
+        return _binary(PR.EqualTo, self, o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Column(PR.Not(_binary(PR.EqualTo, self, o).expr))
+
+    def __lt__(self, o):
+        return _binary(PR.LessThan, self, o)
+
+    def __le__(self, o):
+        return _binary(PR.LessThanOrEqual, self, o)
+
+    def __gt__(self, o):
+        return _binary(PR.GreaterThan, self, o)
+
+    def __ge__(self, o):
+        return _binary(PR.GreaterThanOrEqual, self, o)
+
+    def eqNullSafe(self, o):
+        return _binary(PR.EqualNullSafe, self, o)
+
+    # boolean
+    def __and__(self, o):
+        return _binary(PR.And, self, o)
+
+    def __or__(self, o):
+        return _binary(PR.Or, self, o)
+
+    def __invert__(self):
+        return Column(PR.Not(self.expr))
+
+    # misc
+    def alias(self, name: str) -> "Column":
+        return Column(Alias(self.expr, name))
+
+    name = alias
+
+    def cast(self, dtype) -> "Column":
+        if isinstance(dtype, str):
+            dtype = _parse_type(dtype)
+        return Column(Cast(self.expr, dtype))
+
+    def isNull(self):
+        return Column(PR.IsNull(self.expr))
+
+    def isNotNull(self):
+        return Column(PR.IsNotNull(self.expr))
+
+    def isin(self, *vals):
+        items = vals[0] if len(vals) == 1 and isinstance(vals[0], (list, tuple)) \
+            else vals
+        dt = self.expr.data_type
+        return Column(PR.In(self.expr, tuple(Literal(v, dt) for v in items)))
+
+    def between(self, lo, hi):
+        return (self >= lo) & (self <= hi)
+
+    def asc(self):
+        return P.SortOrder(self.expr, True)
+
+    def desc(self):
+        return P.SortOrder(self.expr, False)
+
+    def asc_nulls_last(self):
+        return P.SortOrder(self.expr, True, False)
+
+    def desc_nulls_first(self):
+        return P.SortOrder(self.expr, False, True)
+
+    def __repr__(self):
+        return f"Column<{self.expr.sql()}>"
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise ValueError("Cannot convert Column to bool; use & | ~ operators")
+
+
+_TYPE_NAMES = {
+    "boolean": T.BOOLEAN, "bool": T.BOOLEAN, "tinyint": T.BYTE, "byte": T.BYTE,
+    "smallint": T.SHORT, "short": T.SHORT, "int": T.INT, "integer": T.INT,
+    "bigint": T.LONG, "long": T.LONG, "float": T.FLOAT, "double": T.DOUBLE,
+    "string": T.STRING, "binary": T.BINARY, "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+}
+
+
+def _parse_type(s: str) -> T.DataType:
+    s = s.strip().lower()
+    if s in _TYPE_NAMES:
+        return _TYPE_NAMES[s]
+    if s.startswith("decimal"):
+        import re
+        m = re.match(r"decimal\((\d+),\s*(\d+)\)", s)
+        if m:
+            return T.DecimalType(int(m.group(1)), int(m.group(2)))
+        return T.DecimalType(10, 0)
+    raise ValueError(f"unknown type string: {s}")
+
+
+class DataFrame:
+    def __init__(self, plan: P.LogicalPlan, session):
+        self._plan = plan
+        self._session = session
+
+    # --- column access ----------------------------------------------------
+    def __getitem__(self, name: str) -> Column:
+        return self._col(name)
+
+    def __getattr__(self, name: str) -> Column:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self._col(name)
+        except KeyError:
+            raise AttributeError(name)
+
+    def _col(self, name: str) -> Column:
+        for a in self._plan.output:
+            if a.name.lower() == name.lower():
+                return Column(a)
+        raise KeyError(name)
+
+    @property
+    def columns(self) -> List[str]:
+        return [a.name for a in self._plan.output]
+
+    @property
+    def schema(self) -> T.StructType:
+        return self._plan.schema
+
+    # --- transformations --------------------------------------------------
+    def _resolve(self, c) -> Expression:
+        if isinstance(c, str):
+            if c == "*":
+                raise ValueError("use select('*') via df.select(df.columns)")
+            return self._col(c).expr
+        return _resolve_expr(_to_expr(c), self._plan)
+
+    def select(self, *cols) -> "DataFrame":
+        if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+            cols = tuple(cols[0])
+        exprs = tuple(self._resolve(c) for c in cols)
+        return DataFrame(P.Project(exprs, self._plan), self._session)
+
+    def withColumn(self, name: str, col: Column) -> "DataFrame":
+        exprs = []
+        replaced = False
+        for a in self._plan.output:
+            if a.name.lower() == name.lower():
+                exprs.append(Alias(_resolve_expr(_to_expr(col), self._plan), name))
+                replaced = True
+            else:
+                exprs.append(a)
+        if not replaced:
+            exprs.append(Alias(_resolve_expr(_to_expr(col), self._plan), name))
+        return DataFrame(P.Project(tuple(exprs), self._plan), self._session)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [Alias(a, new) if a.name.lower() == old.lower() else a
+                 for a in self._plan.output]
+        return DataFrame(P.Project(tuple(exprs), self._plan), self._session)
+
+    def drop(self, *names: str) -> "DataFrame":
+        lower = {n.lower() for n in names}
+        exprs = tuple(a for a in self._plan.output if a.name.lower() not in lower)
+        return DataFrame(P.Project(exprs, self._plan), self._session)
+
+    def filter(self, cond) -> "DataFrame":
+        if isinstance(cond, str):
+            raise NotImplementedError("SQL string predicates not yet supported")
+        return DataFrame(P.Filter(_resolve_expr(_to_expr(cond), self._plan),
+                                  self._plan), self._session)
+
+    where = filter
+
+    def groupBy(self, *cols) -> "GroupedData":
+        exprs = tuple(self._resolve(c) for c in cols)
+        return GroupedData(self, exprs)
+
+    groupby = groupBy
+
+    def agg(self, *cols) -> "DataFrame":
+        return GroupedData(self, ()).agg(*cols)
+
+    def orderBy(self, *cols) -> "DataFrame":
+        orders = []
+        for c in cols:
+            if isinstance(c, P.SortOrder):
+                orders.append(c)
+            elif isinstance(c, str):
+                orders.append(P.SortOrder(self._col(c).expr, True))
+            else:
+                orders.append(P.SortOrder(
+                    _resolve_expr(_to_expr(c), self._plan), True))
+        return DataFrame(P.Sort(tuple(orders), True, self._plan), self._session)
+
+    sort = orderBy
+
+    def sortWithinPartitions(self, *cols) -> "DataFrame":
+        df = self.orderBy(*cols)
+        df._plan.is_global = False
+        return df
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(P.Limit(n, 0, self._plan), self._session)
+
+    def offset(self, n: int) -> "DataFrame":
+        return DataFrame(P.Limit((1 << 30), n, self._plan), self._session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(P.Union((self._plan, other._plan)), self._session)
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        attrs = tuple(self._plan.output)
+        return DataFrame(P.Aggregate(attrs, attrs, self._plan), self._session)
+
+    def dropDuplicates(self, subset: Optional[Sequence[str]] = None):
+        if not subset:
+            return self.distinct()
+        from .expressions.aggregates import First
+        keys = tuple(self._col(c).expr for c in subset)
+        lower = {c.lower() for c in subset}
+        outs: List[Expression] = []
+        for a in self._plan.output:
+            if a.name.lower() in lower:
+                outs.append(a)
+            else:
+                outs.append(Alias(First(a, ignore_nulls=False), a.name))
+        return DataFrame(P.Aggregate(keys, tuple(outs), self._plan),
+                         self._session)
+
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        exprs = tuple(self._resolve(c) for c in cols)
+        return DataFrame(P.Repartition(n, exprs, self._plan), self._session)
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return DataFrame(P.Repartition(n, (), self._plan), self._session)
+
+    def sample(self, fraction: float, seed: int = 0,
+               withReplacement: bool = False) -> "DataFrame":
+        return DataFrame(P.Sample(0.0, fraction, withReplacement, seed,
+                                  self._plan), self._session)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"outer": "full", "full_outer": "full", "leftouter": "left",
+               "left_outer": "left", "rightouter": "right",
+               "right_outer": "right", "semi": "left_semi",
+               "anti": "left_anti", "leftsemi": "left_semi",
+               "leftanti": "left_anti", "crossjoin": "cross"}.get(
+                   how.lower().replace("_", ""), how.lower())
+        lk: List[Expression] = []
+        rk: List[Expression] = []
+        cond = None
+        drop_dup = []
+        if on is None:
+            how = "cross" if how == "inner" else how
+        elif isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            for name in on:
+                lk.append(self._col(name).expr)
+                rk.append(other._col(name).expr)
+            drop_dup = list(on)
+        elif isinstance(on, Column):
+            joined = P.Join(self._plan, other._plan, "cross")
+            resolved = _resolve_expr(on.expr, joined)
+            lk, rk, cond = _extract_equi_keys(resolved, self._plan, other._plan)
+        j = P.Join(self._plan, other._plan, how, tuple(lk), tuple(rk), cond)
+        df = DataFrame(j, self._session)
+        if drop_dup and how in ("inner", "left", "right", "full"):
+            # USING-column semantics: single key column in output
+            keep: List[Expression] = []
+            seen = set()
+            left_names = {a.name.lower() for a in self._plan.output}
+            for a in j.output:
+                nl = a.name.lower()
+                if nl in (d.lower() for d in drop_dup):
+                    if nl in seen:
+                        continue
+                    seen.add(nl)
+                    if how == "right":
+                        # take right side's column
+                        continue
+                keep.append(a)
+            df = DataFrame(P.Project(tuple(keep), j), self._session)
+        return df
+
+    crossJoin = lambda self, other: self.join(other, None, "cross")
+
+    # --- actions ----------------------------------------------------------
+    def collect(self):
+        """Returns a pyarrow Table (columnar-native collect)."""
+        return self._session._execute(self._plan)
+
+    def toArrow(self):
+        return self.collect()
+
+    def toPandas(self):
+        return self.collect().to_pandas()
+
+    def count(self) -> int:
+        from .expressions.aggregates import Count
+        agg = P.Aggregate((), (Alias(Count(), "count"),), self._plan)
+        t = self._session._execute(agg)
+        return t.column("count").to_pylist()[0]
+
+    def show(self, n: int = 20):
+        print(self.limit(n).collect().to_pandas().to_string(index=False))
+
+    def explain(self, mode: str = "formatted") -> None:
+        print(self._session.explain(self))
+
+    def head(self, n: int = 1):
+        rows = self.limit(n).collect().to_pylist()
+        return rows[0] if n == 1 and rows else rows
+
+    first = head
+
+    def cache(self) -> "DataFrame":
+        """Materialize once (ParquetCachedBatchSerializer analog: cached as
+        an in-memory arrow relation)."""
+        table = self.collect()
+        return self._session.create_dataframe(table)
+
+    persist = cache
+
+
+def _extract_equi_keys(cond: Expression, left_plan, right_plan):
+    """Split a join condition into equi-keys + residual, like the
+    reference's join key extraction."""
+    from .expressions.predicates import And, EqualTo
+    left_ids = {a.expr_id for a in left_plan.output}
+    right_ids = {a.expr_id for a in right_plan.output}
+
+    def side(e: Expression):
+        ids = {r.expr_id for r in e.references()}
+        if ids and ids <= left_ids:
+            return "l"
+        if ids and ids <= right_ids:
+            return "r"
+        return "?"
+
+    conjuncts: List[Expression] = []
+
+    def flatten(e):
+        if isinstance(e, And):
+            flatten(e.children[0])
+            flatten(e.children[1])
+        else:
+            conjuncts.append(e)
+    flatten(cond)
+
+    lk, rk, residual = [], [], []
+    for c in conjuncts:
+        if isinstance(c, EqualTo):
+            a, b = c.children
+            sa, sb = side(a), side(b)
+            if sa == "l" and sb == "r":
+                lk.append(a)
+                rk.append(b)
+                continue
+            if sa == "r" and sb == "l":
+                lk.append(b)
+                rk.append(a)
+                continue
+        residual.append(c)
+    res = None
+    for r in residual:
+        res = r if res is None else And(res, r)
+    return lk, rk, res
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, grouping: Tuple[Expression, ...]):
+        self._df = df
+        self._grouping = grouping
+
+    def agg(self, *cols) -> DataFrame:
+        outs: List[Expression] = []
+        for g in self._grouping:
+            if isinstance(g, (AttributeReference, Alias)):
+                outs.append(g)
+            else:
+                outs.append(Alias(g, g.sql()))
+        for c in cols:
+            e = _resolve_expr(_to_expr(c), self._df._plan)
+            if not isinstance(e, Alias):
+                e = Alias(e, e.sql())
+            outs.append(e)
+        return DataFrame(P.Aggregate(self._grouping, tuple(outs),
+                                     self._df._plan), self._df._session)
+
+    def count(self) -> DataFrame:
+        from .expressions.aggregates import Count
+        return self.agg(Column(Alias(Count(), "count")))
+
+    def sum(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Sum
+        return self.agg(*[Column(Alias(Sum(self._df._col(n).expr),
+                                       f"sum({n})")) for n in names])
+
+    def avg(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Average
+        return self.agg(*[Column(Alias(Average(self._df._col(n).expr),
+                                       f"avg({n})")) for n in names])
+
+    mean = avg
+
+    def min(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Min
+        return self.agg(*[Column(Alias(Min(self._df._col(n).expr),
+                                       f"min({n})")) for n in names])
+
+    def max(self, *names: str) -> DataFrame:
+        from .expressions.aggregates import Max
+        return self.agg(*[Column(Alias(Max(self._df._col(n).expr),
+                                       f"max({n})")) for n in names])

@@ -1,0 +1,19 @@
+"""Expression engine.
+
+The TPU analog of the reference's expression layer
+(``GpuExpressions.scala`` ``columnarEval``, SURVEY §2.4): an expression tree
+evaluates over a ColumnarBatch and returns a DeviceColumn.  Each expression
+is written ONCE against an ``xp`` array backend — ``jax.numpy`` on the device
+path (so a whole Project/Filter stage traces into one fused XLA program) and
+``numpy`` on the host path (the CPU-fallback engine, which doubles as the
+test oracle the way CPU Spark does for the reference).
+"""
+
+from .core import (Expression, AttributeReference, BoundReference, Alias,
+                   Literal, EvalContext, bind_references, resolve_expression)
+from . import arithmetic, predicates, math_fns, conditional, cast, hashing  # noqa: F401
+from .registry import EXPRESSION_REGISTRY  # noqa: F401
+
+__all__ = ["Expression", "AttributeReference", "BoundReference", "Alias",
+           "Literal", "EvalContext", "bind_references", "resolve_expression",
+           "EXPRESSION_REGISTRY"]

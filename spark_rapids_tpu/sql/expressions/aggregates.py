@@ -1,0 +1,357 @@
+"""Aggregate functions (reference ``AggregateFunctions.scala`` 2277 LoC,
+``aggregate.scala`` AggHelper).
+
+Declarative model: every aggregate describes buffer *slots*; each slot is a
+(segmented-reduce op, input-value expression) pair.  The physical aggregate
+evaluates the inputs, scatter-reduces them by group rank (ops/segmented.py),
+and calls ``evaluate`` on the reduced buffers.  The same slot description
+drives the merge (PartialMerge/Final) phase, so distributed two-phase
+aggregation falls out of the declaration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from .core import EvalContext, Expression, fixed
+
+# segmented ops understood by the physical layer
+SUM, MIN, MAX, COUNT, FIRST, LAST = "sum", "min", "max", "count", "first", "last"
+
+
+@dataclass
+class BufferSlot:
+    name: str
+    dtype: T.DataType
+    op: str           # one of the segmented ops
+    merge_op: str     # op used when merging partial buffers
+
+
+class AggregateFunction(Expression):
+    """Base class.  ``children`` are the input value expressions."""
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def slots(self) -> List[BufferSlot]:
+        raise NotImplementedError
+
+    def update_values(self, ctx: EvalContext, input_cols: Sequence[DeviceColumn]
+                      ) -> List[Tuple[DeviceColumn, "object"]]:
+        """Per-slot (value column, contribution mask) pairs.  The mask gates
+        which rows contribute to the reduction; the column's own validity is
+        carried through (matters for FIRST/LAST with ignore_nulls=False)."""
+        raise NotImplementedError
+
+    def evaluate(self, ctx: EvalContext, buffers: Sequence[DeviceColumn]
+                 ) -> DeviceColumn:
+        raise NotImplementedError
+
+    def pretty_name(self):
+        return type(self).__name__.lower()
+
+
+def _sum_result_type(dt: T.DataType) -> T.DataType:
+    if isinstance(dt, T.DecimalType):
+        return T.DecimalType.bounded(dt.precision + 10, dt.scale)
+    if T.is_integral(dt):
+        return T.LONG
+    return T.DOUBLE
+
+
+class Sum(AggregateFunction):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Sum(children[0])
+
+    @property
+    def data_type(self):
+        return _sum_result_type(self.children[0].data_type)
+
+    def slots(self):
+        dt = self.data_type
+        return [BufferSlot("sum", dt, SUM, SUM),
+                BufferSlot("cnt", T.LONG, COUNT, SUM)]
+
+    def update_values(self, ctx, cols):
+        c = cols[0]
+        xp = ctx.xp
+        target = self.data_type.np_dtype
+        data = c.data.astype(target)
+        return [(DeviceColumn(self.data_type, data, c.validity), c.validity),
+                (DeviceColumn(T.LONG, xp.ones_like(c.validity, dtype=xp.int64),
+                              c.validity), c.validity)]
+
+    def evaluate(self, ctx, buffers):
+        s, cnt = buffers
+        return fixed(self.data_type, s.data, cnt.data > 0)
+
+
+class Count(AggregateFunction):
+    """count(expr) / count(*) (children empty)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Count(*children)
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def slots(self):
+        return [BufferSlot("count", T.LONG, COUNT, SUM)]
+
+    def update_values(self, ctx, cols):
+        xp = ctx.xp
+        if not cols:
+            ones = xp.ones((ctx.capacity,), dtype=xp.int64)
+            all_true = xp.ones((ctx.capacity,), dtype=bool)
+            return [(DeviceColumn(T.LONG, ones, all_true), all_true)]
+        valid = cols[0].validity
+        for c in cols[1:]:
+            valid = valid & c.validity
+        return [(DeviceColumn(T.LONG, xp.ones_like(valid, dtype=xp.int64),
+                              valid), valid)]
+
+    def evaluate(self, ctx, buffers):
+        xp = ctx.xp
+        c = buffers[0]
+        return fixed(T.LONG, c.data, xp.ones_like(c.data, dtype=bool))
+
+
+class _MinMax(AggregateFunction):
+    _op = MIN
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def slots(self):
+        return [BufferSlot("val", self.data_type, self._op, self._op),
+                BufferSlot("cnt", T.LONG, COUNT, SUM)]
+
+    def update_values(self, ctx, cols):
+        c = cols[0]
+        xp = ctx.xp
+        return [(c, c.validity),
+                (DeviceColumn(T.LONG, xp.ones_like(c.validity, dtype=xp.int64),
+                              c.validity), c.validity)]
+
+    def evaluate(self, ctx, buffers):
+        v, cnt = buffers
+        return DeviceColumn(self.data_type, v.data, cnt.data > 0,
+                            v.lengths, v.aux, v.children)
+
+
+class Min(_MinMax):
+    _op = MIN
+
+
+class Max(_MinMax):
+    _op = MAX
+
+
+class Average(AggregateFunction):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return Average(children[0])
+
+    @property
+    def data_type(self):
+        ct = self.children[0].data_type
+        if isinstance(ct, T.DecimalType):
+            return T.DecimalType.bounded(ct.precision + 4, ct.scale + 4)
+        return T.DOUBLE
+
+    def slots(self):
+        ct = self.children[0].data_type
+        sum_t = _sum_result_type(ct)
+        return [BufferSlot("sum", sum_t, SUM, SUM),
+                BufferSlot("cnt", T.LONG, COUNT, SUM)]
+
+    def update_values(self, ctx, cols):
+        c = cols[0]
+        sum_t = _sum_result_type(self.children[0].data_type)
+        return [(DeviceColumn(sum_t, c.data.astype(sum_t.np_dtype), c.validity),
+                 c.validity),
+                (DeviceColumn(T.LONG,
+                              ctx.xp.ones_like(c.validity, dtype=ctx.xp.int64),
+                              c.validity), c.validity)]
+
+    def evaluate(self, ctx, buffers):
+        xp = ctx.xp
+        s, cnt = buffers
+        valid = cnt.data > 0
+        denom = xp.where(valid, cnt.data, 1)
+        dt = self.data_type
+        if isinstance(dt, T.DecimalType):
+            ct: T.DecimalType = _sum_result_type(self.children[0].data_type)  # type: ignore
+            # rescale sum to result scale then divide rounding HALF_UP
+            shift = dt.scale - ct.scale
+            num = s.data * (10 ** shift)
+            q = num // denom
+            r = num - q * denom
+            q = xp.where((num < 0) & (r != 0), q + 1, q)
+            r = xp.where((num < 0) & (r != 0), r - denom, r)
+            rup = 2 * xp.abs(r) >= denom
+            q = q + xp.where(rup, xp.sign(num) * xp.sign(denom), 0).astype(q.dtype)
+            return fixed(dt, q, valid)
+        return fixed(T.DOUBLE, s.data.astype(xp.float64)
+                     / denom.astype(xp.float64), valid)
+
+
+class _FirstLast(AggregateFunction):
+    _op = FIRST
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.children = (child,)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return type(self)(children[0], self.ignore_nulls)
+
+    def _key_extras(self):
+        return (self.ignore_nulls,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def slots(self):
+        return [BufferSlot("val", self.data_type, self._op, self._op)]
+
+    def update_values(self, ctx, cols):
+        c = cols[0]
+        xp = ctx.xp
+        # eligibility: valid rows only when ignore_nulls, else every live row;
+        # the winning row's own validity flows to the result either way
+        contrib = c.validity if self.ignore_nulls else \
+            xp.ones_like(c.validity, dtype=bool)
+        return [(c, contrib)]
+
+    def evaluate(self, ctx, buffers):
+        return buffers[0]
+
+
+class First(_FirstLast):
+    _op = FIRST
+
+
+class Last(_FirstLast):
+    _op = LAST
+
+
+class _CentralMoment(AggregateFunction):
+    """Variance/stddev via (n, sum, sum_sq) buffers.  Results can differ from
+    Spark's Welford updates in the last ULPs (reference marks similar cases
+    approximate_float)."""
+    _sample = True
+    _sqrt = False
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def slots(self):
+        return [BufferSlot("n", T.DOUBLE, SUM, SUM),
+                BufferSlot("sum", T.DOUBLE, SUM, SUM),
+                BufferSlot("sumsq", T.DOUBLE, SUM, SUM)]
+
+    def update_values(self, ctx, cols):
+        c = cols[0]
+        xp = ctx.xp
+        x = c.data.astype(xp.float64)
+        one = xp.ones_like(x)
+        return [(DeviceColumn(T.DOUBLE, one, c.validity), c.validity),
+                (DeviceColumn(T.DOUBLE, x, c.validity), c.validity),
+                (DeviceColumn(T.DOUBLE, x * x, c.validity), c.validity)]
+
+    def evaluate(self, ctx, buffers):
+        xp = ctx.xp
+        n, s, sq = (b.data for b in buffers)
+        denom = n - 1.0 if self._sample else n
+        ok = n > (1.0 if self._sample else 0.0)
+        safe = xp.where(ok, denom, 1.0)
+        m2 = sq - s * s / xp.where(n > 0, n, 1.0)
+        var = xp.maximum(m2, 0.0) / safe
+        out = xp.sqrt(var) if self._sqrt else var
+        # Spark: stddev_samp of a single row returns NaN (not null)
+        single = (n == 1.0) & self._sample
+        out = xp.where(single, xp.asarray(float("nan")), out)
+        valid = (n > 0) if not self._sample else (n >= 1.0)
+        return fixed(T.DOUBLE, out, valid)
+
+
+class VarianceSamp(_CentralMoment):
+    _sample, _sqrt = True, False
+
+
+class VariancePop(_CentralMoment):
+    _sample, _sqrt = False, False
+
+
+class StddevSamp(_CentralMoment):
+    _sample, _sqrt = True, True
+
+
+class StddevPop(_CentralMoment):
+    _sample, _sqrt = False, True
+
+
+@dataclass(eq=False)
+class AggregateExpression(Expression):
+    """Wrapper carrying mode/distinct/filter, like Catalyst's."""
+    func: AggregateFunction = None  # type: ignore
+    mode: str = "complete"  # partial | final | complete
+    is_distinct: bool = False
+    filter: Optional[Expression] = None
+
+    def __post_init__(self):
+        self.children = (self.func,)
+
+    def with_children(self, children):
+        return AggregateExpression(children[0], self.mode, self.is_distinct,
+                                   self.filter)
+
+    @property
+    def data_type(self):
+        return self.func.data_type
+
+    @property
+    def nullable(self):
+        return self.func.nullable
+
+    def _key_extras(self):
+        return (self.mode, self.is_distinct)
+
+    def sql(self):
+        d = "DISTINCT " if self.is_distinct else ""
+        return f"{self.func.pretty_name()}({d}{', '.join(c.sql() for c in self.func.children)})"

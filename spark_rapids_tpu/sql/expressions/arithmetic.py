@@ -1,0 +1,373 @@
+"""Arithmetic & bitwise expressions (reference: ``arithmetic.scala``,
+``GpuOverrides.scala`` expr rules Add/Subtract/Multiply/Divide/
+IntegralDivide/Remainder/Pmod/UnaryMinus/Abs/Least/Greatest/Bitwise*/Shift*).
+
+Semantics notes (non-ANSI mode, matching Spark/JVM):
+* integral overflow wraps (two's complement) — both jnp and numpy do this;
+* `/` is floating (or decimal) division: IEEE inf/NaN for doubles,
+  null-on-zero for decimals;
+* `div`/`%`/`pmod` on integers are truncated (Java) division and null on
+  zero divisor; `%` on doubles is C fmod (NaN on zero);
+* Least/Greatest skip nulls and order NaN greater than any double.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from .core import (EvalContext, Expression, fixed, null_safe_binary,
+                   null_safe_unary, valid_and, zero_fill)
+
+
+def trunc_div(xp, a, b_safe):
+    """Java-style truncated integer division (Python // floors)."""
+    q = a // b_safe
+    r = a - q * b_safe
+    # floor and trunc differ when signs differ and remainder nonzero
+    adjust = ((r != 0) & ((a < 0) != (b_safe < 0)))
+    return q + adjust.astype(q.dtype)
+
+
+def trunc_mod(xp, a, b_safe):
+    return a - trunc_div(xp, a, b_safe) * b_safe
+
+
+def ordering_lt(xp, x, y, floating: bool):
+    """Spark total-order less-than: NaN is greater than everything."""
+    if floating:
+        return (x < y) | (xp.isnan(y) & ~xp.isnan(x))
+    return x < y
+
+
+@dataclass(eq=False)
+class BinaryArithmetic(Expression):
+    left: Expression = None  # type: ignore
+    right: Expression = None  # type: ignore
+    symbol = "?"
+
+    def __post_init__(self):
+        self.children = (self.left, self.right)
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def sql(self) -> str:
+        return f"({self.children[0].sql()} {self.symbol} {self.children[1].sql()})"
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    @property
+    def data_type(self):
+        lt = self.children[0].data_type
+        if isinstance(lt, T.DecimalType):
+            rt = self.children[1].data_type
+            return T.DecimalType.bounded(
+                max(lt.precision - lt.scale, rt.precision - rt.scale)
+                + max(lt.scale, rt.scale) + 1, max(lt.scale, rt.scale))
+        return lt
+
+    def kernel(self, ctx, a, b):
+        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x + y)
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+    data_type = Add.data_type
+
+    def kernel(self, ctx, a, b):
+        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x - y)
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    @property
+    def data_type(self):
+        lt = self.children[0].data_type
+        if isinstance(lt, T.DecimalType):
+            rt = self.children[1].data_type
+            return T.DecimalType.bounded(lt.precision + rt.precision + 1,
+                                         lt.scale + rt.scale)
+        return lt
+
+    def kernel(self, ctx, a, b):
+        if isinstance(self.data_type, T.DecimalType):
+            # children keep their own scales; product scale = s1+s2 already
+            return null_safe_binary(ctx, self.data_type, a, b,
+                                    lambda x, y: x * y)
+        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x * y)
+
+
+class Divide(BinaryArithmetic):
+    """Floating or decimal division (analyzer coerces int inputs to double)."""
+    symbol = "/"
+
+    @property
+    def data_type(self):
+        lt = self.children[0].data_type
+        if isinstance(lt, T.DecimalType):
+            rt = self.children[1].data_type
+            scale = max(6, lt.scale + rt.precision + 1)
+            prec = lt.precision - lt.scale + rt.scale + scale
+            return T.DecimalType.bounded(prec, scale)
+        return lt
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        dt = self.data_type
+        if isinstance(dt, T.DecimalType):
+            lt: T.DecimalType = self.children[0].data_type  # type: ignore
+            rt: T.DecimalType = self.children[1].data_type  # type: ignore
+            valid = valid_and(xp, a, b) & (b.data != 0)
+            bd = xp.where(b.data == 0, xp.asarray(1, dtype=b.data.dtype), b.data)
+            # rescale numerator so unscaled result has target scale:
+            # (a/10^ls) / (b/10^rs) * 10^ts  == a * 10^(ts - ls + rs) / b
+            shift = dt.scale - lt.scale + rt.scale
+            num = a.data * xp.asarray(10 ** shift, dtype=xp.int64)
+            q = trunc_div(xp, num, bd)
+            r = trunc_mod(xp, num, bd)
+            # round half-up away from zero
+            round_up = (2 * xp.abs(r) >= xp.abs(bd))
+            q = q + xp.where(round_up, xp.sign(num) * xp.sign(bd), 0).astype(q.dtype)
+            return fixed(dt, q, valid)
+        return null_safe_binary(ctx, dt, a, b, lambda x, y: x / y)
+
+
+class IntegralDivide(BinaryArithmetic):
+    symbol = "div"
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        valid = valid_and(xp, a, b) & (b.data != 0)
+        bs = xp.where(b.data == 0, xp.asarray(1, dtype=b.data.dtype), b.data)
+        q = trunc_div(xp, a.data.astype(xp.int64), bs.astype(xp.int64))
+        return fixed(T.LONG, q, valid)
+
+
+class Remainder(BinaryArithmetic):
+    symbol = "%"
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        dt = self.data_type
+        if T.is_floating(dt):
+            valid = valid_and(xp, a, b)
+            return fixed(dt, xp.fmod(a.data, b.data), valid)
+        valid = valid_and(xp, a, b) & (b.data != 0)
+        bs = xp.where(b.data == 0, xp.asarray(1, dtype=b.data.dtype), b.data)
+        return fixed(dt, trunc_mod(xp, a.data, bs), valid)
+
+
+class Pmod(BinaryArithmetic):
+    symbol = "pmod"
+
+    def pretty_name(self):
+        return "pmod"
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        dt = self.data_type
+        if T.is_floating(dt):
+            valid = valid_and(xp, a, b)
+            r = xp.fmod(a.data, b.data)
+            r = xp.where((r != 0) & ((r < 0) != (b.data < 0)), r + b.data, r)
+            return fixed(dt, r, valid)
+        valid = valid_and(xp, a, b) & (b.data != 0)
+        bs = xp.where(b.data == 0, xp.asarray(1, dtype=b.data.dtype), b.data)
+        r = trunc_mod(xp, a.data, bs)
+        r = xp.where((r != 0) & ((r < 0) != (bs < 0)), r + bs, r)
+        return fixed(dt, r, valid)
+
+
+@dataclass(eq=False)
+class UnaryMinus(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return UnaryMinus(children[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, c):
+        return null_safe_unary(ctx, self.data_type, c, lambda x: -x)
+
+    def sql(self):
+        return f"(- {self.children[0].sql()})"
+
+
+@dataclass(eq=False)
+class UnaryPositive(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return UnaryPositive(children[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+
+@dataclass(eq=False)
+class Abs(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return Abs(children[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, c):
+        return null_safe_unary(ctx, self.data_type, c, ctx.xp.abs)
+
+
+@dataclass(eq=False)
+class _MinMaxOfN(Expression):
+    """Least/Greatest base: null-skipping fold over children."""
+    exprs: Tuple[Expression, ...] = ()
+    _greatest = False
+
+    def __post_init__(self):
+        self.children = tuple(self.exprs)
+
+    def with_children(self, children):
+        return type(self)(tuple(children))
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        floating = T.is_floating(self.data_type)
+        acc_d, acc_v = cols[0].data, cols[0].validity
+        for c in cols[1:]:
+            if self._greatest:
+                better = ordering_lt(xp, acc_d, c.data, floating)
+            else:
+                better = ordering_lt(xp, c.data, acc_d, floating)
+            take = (~acc_v) | (c.validity & better)
+            take = take & c.validity
+            acc_d = xp.where(take, c.data, acc_d)
+            acc_v = acc_v | c.validity
+        return fixed(self.data_type, acc_d, acc_v)
+
+
+class Least(_MinMaxOfN):
+    _greatest = False
+
+
+class Greatest(_MinMaxOfN):
+    _greatest = True
+
+
+# --- bitwise ---------------------------------------------------------------
+
+class BitwiseAnd(BinaryArithmetic):
+    symbol = "&"
+
+    def kernel(self, ctx, a, b):
+        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x & y)
+
+
+class BitwiseOr(BinaryArithmetic):
+    symbol = "|"
+
+    def kernel(self, ctx, a, b):
+        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x | y)
+
+
+class BitwiseXor(BinaryArithmetic):
+    symbol = "^"
+
+    def kernel(self, ctx, a, b):
+        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x ^ y)
+
+
+@dataclass(eq=False)
+class BitwiseNot(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return BitwiseNot(children[0])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, c):
+        return null_safe_unary(ctx, self.data_type, c, lambda x: ~x)
+
+
+class _Shift(BinaryArithmetic):
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def _bits(self):
+        return 64 if isinstance(self.data_type, T.LongType) else 32
+
+
+class ShiftLeft(_Shift):
+    symbol = "<<"
+
+    def kernel(self, ctx, a, b):
+        mask = self._bits() - 1
+        return null_safe_binary(
+            ctx, self.data_type, a, b,
+            lambda x, y: x << (y.astype(x.dtype) & mask))
+
+
+class ShiftRight(_Shift):
+    symbol = ">>"
+
+    def kernel(self, ctx, a, b):
+        mask = self._bits() - 1
+        return null_safe_binary(
+            ctx, self.data_type, a, b,
+            lambda x, y: x >> (y.astype(x.dtype) & mask))
+
+
+class ShiftRightUnsigned(_Shift):
+    symbol = ">>>"
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        bits = self._bits()
+        udt = xp.uint64 if bits == 64 else xp.uint32
+        mask = bits - 1
+
+        def f(x, y):
+            return (x.astype(udt) >> (y.astype(udt) & mask)).astype(x.dtype)
+        return null_safe_binary(ctx, self.data_type, a, b, f)

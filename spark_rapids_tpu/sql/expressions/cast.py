@@ -1,0 +1,317 @@
+"""Cast expression (reference ``GpuCast.scala`` + JNI ``CastStrings``,
+SURVEY §2.4 cast matrix).
+
+Device path covers the numeric/temporal/bool/decimal matrix with Java/Spark
+(non-ANSI) semantics: wrapping integral narrowing, clamping float->integral,
+null-on-overflow decimals.  String<->X casts run on the host path for now
+(the reference gates many of these behind ``spark.rapids.sql.cast*`` flags
+for the same reason: exact Spark string-cast semantics are gnarly); the
+overrides layer routes expressions accordingly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from .core import EvalContext, UnaryExpression, fixed
+
+_I64_MIN_F = float(-(2 ** 63))
+_I64_MAX_F = float(2 ** 63)  # exclusive bound, exactly representable
+
+
+class Cast(UnaryExpression):
+    def __init__(self, child, to: T.DataType):
+        super().__init__(child)
+        self.to = to
+
+    def with_children(self, children):
+        return Cast(children[0], self.to)
+
+    @property
+    def data_type(self):
+        return self.to
+
+    def _key_extras(self):
+        return (self.to,)
+
+    def sql(self):
+        return f"CAST({self.children[0].sql()} AS {self.to.simple_string()})"
+
+    # ------------------------------------------------------------------
+    def kernel(self, ctx: EvalContext, c: DeviceColumn) -> DeviceColumn:
+        ft, tt = self.children[0].data_type, self.to
+        xp = ctx.xp
+        if ft == tt:
+            return c
+        if isinstance(ft, T.NullType):
+            from .conditional import _null_like
+            return _null_like(ctx, tt, c)
+        if isinstance(ft, T.StringType) or isinstance(tt, T.StringType):
+            if ctx.is_device:
+                raise NotImplementedError(
+                    f"cast {ft} -> {tt} runs on the host path")
+            return _host_string_cast(ctx, c, ft, tt)
+        data, valid = _cast_fixed(xp, c, ft, tt)
+        return fixed(tt, data, valid)
+
+
+def _int_bounds(dt: T.DataType):
+    return {1: (-2**7, 2**7 - 1), 2: (-2**15, 2**15 - 1),
+            4: (-2**31, 2**31 - 1), 8: (-2**63, 2**63 - 1)}[dt.np_dtype.itemsize]
+
+
+def _cast_fixed(xp, c: DeviceColumn, ft: T.DataType, tt: T.DataType):
+    x, valid = c.data, c.validity
+
+    # --- from bool
+    if isinstance(ft, T.BooleanType):
+        if isinstance(tt, T.BooleanType):
+            return x, valid
+        if isinstance(tt, T.DecimalType):
+            return x.astype(xp.int64) * (10 ** tt.scale), valid
+        return x.astype(tt.np_dtype), valid
+
+    # --- from decimal
+    if isinstance(ft, T.DecimalType):
+        return _from_decimal(xp, x, valid, ft, tt)
+
+    # --- temporal sources
+    if isinstance(ft, T.DateType):
+        if isinstance(tt, T.TimestampType):
+            return x.astype(xp.int64) * 86_400_000_000, valid
+        # date -> numeric not allowed in Spark 3; treat as unsupported
+        raise NotImplementedError(f"cast date -> {tt}")
+    if isinstance(ft, T.TimestampType):
+        if isinstance(tt, T.DateType):
+            return (x // 86_400_000_000).astype(xp.int32), valid
+        if isinstance(tt, T.LongType):
+            return x // 1_000_000, valid  # floor seconds
+        if T.is_integral(tt):
+            secs = x // 1_000_000
+            return secs.astype(tt.np_dtype), valid  # wraps like long->int
+        if T.is_floating(tt):
+            return (x.astype(xp.float64) / 1e6).astype(tt.np_dtype), valid
+        raise NotImplementedError(f"cast timestamp -> {tt}")
+
+    # --- numeric sources
+    if isinstance(tt, T.BooleanType):
+        return x != 0, valid
+    if isinstance(tt, T.TimestampType):
+        if T.is_integral(ft):
+            return x.astype(xp.int64) * 1_000_000, valid
+        secs = x.astype(xp.float64) * 1e6
+        data, ok = _float_to_int(xp, secs, (-2**63, 2**63 - 1), xp.int64)
+        return data, valid & ok
+    if isinstance(tt, T.DateType):
+        raise NotImplementedError("cast numeric -> date")
+    if isinstance(tt, T.DecimalType):
+        return _to_decimal(xp, x, valid, ft, tt)
+    if T.is_integral(tt):
+        if T.is_integral(ft):
+            return x.astype(tt.np_dtype), valid  # wrap (Java narrowing)
+        data, _ = _float_to_int(xp, x.astype(xp.float64), _int_bounds(tt),
+                                tt.np_dtype)
+        return data, valid
+    if T.is_floating(tt):
+        return x.astype(tt.np_dtype), valid
+    raise NotImplementedError(f"cast {ft} -> {tt}")
+
+
+def _float_to_int(xp, x, bounds, np_dtype):
+    """Java (long)/(int) cast of a double: trunc toward zero, NaN -> 0,
+    saturate at bounds."""
+    lo, hi = bounds
+    t = xp.trunc(x)
+    t = xp.where(xp.isnan(x), 0.0, t)
+    over = t >= float(hi) + 1 if hi != 2**63 - 1 else t >= _I64_MAX_F
+    under = t <= float(lo) - 1 if lo != -2**63 else t < _I64_MIN_F
+    t = xp.clip(t, _I64_MIN_F, _I64_MAX_F - 2**10)  # keep astype in-range
+    out = t.astype(xp.int64)
+    out = xp.where(over, hi, out)
+    out = xp.where(under, lo, out)
+    return out.astype(np_dtype), xp.ones_like(over)
+
+
+def _to_decimal(xp, x, valid, ft: T.DataType, tt: T.DecimalType):
+    limit = 10 ** tt.precision
+    if T.is_integral(ft):
+        ux = x.astype(xp.int64)
+        scaled = ux * (10 ** tt.scale)
+        ok = xp.abs(ux) < (limit // (10 ** tt.scale) + 1)
+        ok = ok & (xp.abs(scaled) < limit)
+        return scaled, valid & ok
+    # float -> decimal: round HALF_UP at target scale
+    f = x.astype(xp.float64) * (10.0 ** tt.scale)
+    r = xp.sign(f) * xp.floor(xp.abs(f) + 0.5)
+    ok = xp.isfinite(f) & (xp.abs(r) < float(limit))
+    data, _ = _float_to_int(xp, r, (-2**63, 2**63 - 1), xp.int64)
+    return data, valid & ok
+
+
+def _from_decimal(xp, x, valid, ft: T.DecimalType, tt: T.DataType):
+    scale_f = 10 ** ft.scale
+    if isinstance(tt, T.DecimalType):
+        if tt.scale >= ft.scale:
+            mult = 10 ** (tt.scale - ft.scale)
+            data = x * mult
+            ok = xp.abs(data) < 10 ** tt.precision
+            return data, valid & ok
+        div = 10 ** (ft.scale - tt.scale)
+        q = x // div
+        r = x - q * div
+        # HALF_UP with truncated division on negatives
+        q = xp.where((x < 0) & (r != 0), q + 1, q)
+        r = xp.where((x < 0) & (r != 0), r - div, r)
+        rup = 2 * xp.abs(r) >= div
+        data = q + xp.where(rup, xp.sign(x), 0).astype(q.dtype)
+        ok = xp.abs(data) < 10 ** tt.precision
+        return data, valid & ok
+    if T.is_floating(tt):
+        return (x.astype(xp.float64) / scale_f).astype(tt.np_dtype), valid
+    if isinstance(tt, T.BooleanType):
+        return x != 0, valid
+    if T.is_integral(tt):
+        q = x // scale_f
+        r = x - q * scale_f
+        q = xp.where((x < 0) & (r != 0), q + 1, q)  # trunc toward zero
+        lo, hi = _int_bounds(tt)
+        ok = (q >= lo) & (q <= hi)
+        return q.astype(tt.np_dtype), valid & ok
+    raise NotImplementedError(f"cast {ft} -> {tt}")
+
+
+# --------------------------------------------------------------------------
+# Host-only string casts (exactness over speed; device CastStrings-style
+# kernels are a later milestone)
+# --------------------------------------------------------------------------
+
+def _host_string_cast(ctx, c: DeviceColumn, ft, tt) -> DeviceColumn:
+    from ...columnar.convert import device_column_to_arrow
+    n = c.capacity
+    arr = device_column_to_arrow(c, n)
+    vals = arr.to_pylist()
+
+    if isinstance(tt, T.StringType):
+        out = [None if v is None else _to_java_string(v, ft) for v in vals]
+        import pyarrow as pa
+        from ...columnar.convert import arrow_to_device_column
+        col = arrow_to_device_column(pa.array(out, type=pa.string()), n)
+        return _as_host(col)
+
+    # string -> X
+    out = [None if v is None else _parse_string(v, tt) for v in vals]
+    import pyarrow as pa
+    from ...columnar.convert import arrow_to_device_column
+    col = arrow_to_device_column(pa.array(out, type=T.to_arrow(tt)), n)
+    # preserve original null mask AND parse failures
+    col = _as_host(col)
+    return col
+
+
+def _as_host(col: DeviceColumn) -> DeviceColumn:
+    return DeviceColumn(
+        col.dtype,
+        None if col.data is None else np.asarray(col.data),
+        None if col.validity is None else np.asarray(col.validity),
+        None if col.lengths is None else np.asarray(col.lengths),
+        None if col.aux is None else np.asarray(col.aux),
+        col.children)
+
+
+def _to_java_string(v, ft) -> str:
+    if isinstance(ft, T.BooleanType):
+        return "true" if v else "false"
+    if isinstance(ft, (T.FloatType, T.DoubleType)):
+        return _java_double_str(float(v))
+    if isinstance(ft, T.TimestampType):
+        s = v.strftime("%Y-%m-%d %H:%M:%S")
+        if v.microsecond:
+            s += (".%06d" % v.microsecond).rstrip("0")
+        return s
+    if isinstance(ft, T.DateType):
+        return v.strftime("%Y-%m-%d")
+    return str(v)
+
+
+def _java_double_str(x: float) -> str:
+    """Java Double.toString semantics (scientific for |x|>=1e7 or <1e-3)."""
+    import math
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0:
+        return "-0.0" if math.copysign(1, x) < 0 else "0.0"
+    ax = abs(x)
+    if 1e-3 <= ax < 1e7:
+        s = repr(x)
+        if "e" in s or "E" in s:
+            s = f"{x:.17g}"
+        if "." not in s:
+            s += ".0"
+        return s
+    m, e = f"{x:.17e}".split("e")
+    m = m.rstrip("0")
+    exp = int(e)
+    m_val = repr(float(f"{x:e}".split("e")[0]))
+    mant = repr(x).replace("e", "E")
+    if "E" in mant:
+        base, ex = mant.split("E")
+        if "." not in base:
+            base += ".0"
+        return f"{base}E{int(ex)}"
+    return f"{float(x):.17g}"
+
+
+def _parse_string(s: str, tt):
+    s = s.strip()
+    try:
+        if isinstance(tt, T.BooleanType):
+            ls = s.lower()
+            if ls in ("t", "true", "y", "yes", "1"):
+                return True
+            if ls in ("f", "false", "n", "no", "0"):
+                return False
+            return None
+        if T.is_integral(tt):
+            v = int(s, 10)
+            lo, hi = _int_bounds(tt)
+            return v if lo <= v <= hi else None
+        if T.is_floating(tt):
+            ls = s.lower()
+            if ls in ("nan",):
+                return float("nan")
+            if ls in ("inf", "+inf", "infinity", "+infinity"):
+                return float("inf")
+            if ls in ("-inf", "-infinity"):
+                return float("-inf")
+            return float(s)
+        if isinstance(tt, T.DecimalType):
+            import decimal
+            with decimal.localcontext() as dctx:
+                dctx.prec = 50
+                d = decimal.Decimal(s).quantize(
+                    decimal.Decimal(1).scaleb(-tt.scale),
+                    rounding=decimal.ROUND_HALF_UP)
+            if abs(d.scaleb(tt.scale).to_integral_value()) >= 10 ** tt.precision:
+                return None
+            return d
+        if isinstance(tt, T.DateType):
+            import datetime
+            return datetime.date.fromisoformat(s[:10])
+        if isinstance(tt, T.TimestampType):
+            import datetime
+            txt = s.replace("T", " ")
+            for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S",
+                        "%Y-%m-%d"):
+                try:
+                    return datetime.datetime.strptime(txt, fmt).replace(
+                        tzinfo=datetime.timezone.utc)
+                except ValueError:
+                    continue
+            return None
+    except (ValueError, ArithmeticError):
+        return None
+    return None

@@ -1,0 +1,199 @@
+"""Conditional expressions (reference ``conditionalExpressions.scala``,
+``nullExpressions.scala``): If, CaseWhen, Coalesce, Nvl family, NaNvl,
+normalization wrappers."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from .core import (BinaryExpression, EvalContext, Expression, UnaryExpression,
+                   fixed)
+
+
+def choose(xp, mask, a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
+    """Per-row select: mask ? a : b.  Handles all column layouts."""
+    def sel(x, y, expand=False):
+        if x is None or y is None:
+            return None
+        m = mask[:, None] if (expand and x.ndim == 2) else mask
+        if x.ndim == 2 and y.ndim == 2 and x.shape[1] != y.shape[1]:
+            w = max(x.shape[1], y.shape[1])
+            x = xp.pad(x, ((0, 0), (0, w - x.shape[1])))
+            y = xp.pad(y, ((0, 0), (0, w - y.shape[1])))
+        return xp.where(m, x, y)
+
+    children = tuple(choose(xp, mask, ca, cb)
+                     for ca, cb in zip(a.children, b.children))
+    return DeviceColumn(
+        a.dtype,
+        sel(a.data, b.data, expand=True),
+        sel(a.validity, b.validity),
+        sel(a.lengths, b.lengths),
+        sel(a.aux, b.aux),
+        children)
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, t: Expression, f: Expression):
+        self.children = (pred, t, f)
+
+    def with_children(self, children):
+        return If(*children)
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    def kernel(self, ctx, p, t, f):
+        take_true = p.validity & p.data  # null predicate -> else branch
+        return choose(ctx.xp, take_true, t, f)
+
+    def sql(self):
+        p, t, f = self.children
+        return f"if({p.sql()}, {t.sql()}, {f.sql()})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 ... ELSE e END.  children = [c1, v1, c2, v2, ...,
+    (else)]; odd count means an explicit else."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        flat: List[Expression] = []
+        for c, v in branches:
+            flat += [c, v]
+        if else_value is not None:
+            flat.append(else_value)
+        self.children = tuple(flat)
+        self._n_branches = len(branches)
+        self._has_else = else_value is not None
+
+    def with_children(self, children):
+        n = self._n_branches
+        branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        else_v = children[2 * n] if self._has_else else None
+        return CaseWhen(branches, else_v)
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    def _key_extras(self):
+        return (self._n_branches, self._has_else)
+
+    def kernel(self, ctx, *cols):
+        from ...columnar.column import null_column
+        xp = ctx.xp
+        n = self._n_branches
+        if self._has_else:
+            acc = cols[2 * n]
+        else:
+            acc = _null_like(ctx, self.data_type, cols[1])
+        for i in reversed(range(n)):
+            p, v = cols[2 * i], cols[2 * i + 1]
+            acc = choose(xp, p.validity & p.data, v, acc)
+        return acc
+
+
+def _null_like(ctx, dtype, template: DeviceColumn) -> DeviceColumn:
+    from ...columnar.column import null_column
+    col = null_column(dtype, template.capacity)
+    if not ctx.is_device:
+        import numpy as np
+        col = DeviceColumn(
+            col.dtype,
+            None if col.data is None else np.asarray(col.data),
+            None if col.validity is None else np.asarray(col.validity),
+            None if col.lengths is None else np.asarray(col.lengths),
+            None if col.aux is None else np.asarray(col.aux),
+            col.children)
+    return col
+
+
+class Coalesce(Expression):
+    def __init__(self, *exprs: Expression):
+        self.children = tuple(exprs)
+
+    def with_children(self, children):
+        return Coalesce(*children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    @property
+    def nullable(self):
+        return all(c.nullable for c in self.children)
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        acc = cols[-1]
+        for c in reversed(cols[:-1]):
+            acc = choose(xp, c.validity, c, acc)
+        return acc
+
+
+class NaNvl(BinaryExpression):
+    """nanvl(a, b): b when a is NaN else a."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        return choose(xp, a.validity & ~xp.isnan(a.data), a, b)
+
+
+class KnownNotNull(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize NaN bit patterns and -0.0 (pre-grouping/join pass)."""
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        x = c.data
+        x = xp.where(xp.isnan(x), xp.asarray(float("nan"), dtype=x.dtype), x)
+        x = xp.where(x == 0, xp.asarray(0.0, dtype=x.dtype), x)
+        return fixed(self.data_type, x, c.validity)
+
+
+class RaiseError(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.NULL
+
+    def kernel(self, ctx, c):
+        if not ctx.is_device:
+            raise RuntimeError("raise_error invoked")
+        # device path cannot raise inside a traced program; the exec layer
+        # checks a sentinel after execution (like the reference's deferred
+        # CUDA error checks)
+        import numpy as _np
+        return _null_like(ctx, T.NULL, c)

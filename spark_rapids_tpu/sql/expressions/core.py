@@ -1,0 +1,373 @@
+"""Expression core: evaluation model, references, literals, aliases.
+
+Design: one expression tree, two array backends.  ``EvalContext.xp`` is
+``jax.numpy`` on the device path — the whole expression tree traces into a
+single fused XLA program per operator — and ``numpy`` on the host path, which
+is the CPU-fallback engine (and test oracle).  This replaces the reference's
+split between cudf kernels and CPU Spark (``GpuExpressions.scala:113-171``).
+
+Columns flowing between expressions are ``DeviceColumn``s; on the host path
+the same dataclass simply holds numpy arrays (identical padded layout), so
+every kernel written against ``xp`` runs on both backends.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.batch import ColumnarBatch
+from ...columnar.column import DeviceColumn, is_string_like
+from ...config import RapidsConf
+
+_expr_id_counter = itertools.count()
+
+
+class EvalContext:
+    """Per-batch evaluation context."""
+
+    def __init__(self, batch: ColumnarBatch, xp=None, conf: Optional[RapidsConf] = None):
+        if xp is None:
+            import jax.numpy as jnp
+            xp = jnp
+        self.batch = batch
+        self.xp = xp
+        self.is_device = xp.__name__ != "numpy"
+        self.conf = conf or RapidsConf.get_global()
+
+    @property
+    def capacity(self) -> int:
+        return self.batch.capacity
+
+    def row_mask(self):
+        return self.batch.row_mask() if self.is_device else (
+            np.arange(self.batch.capacity) < np.asarray(self.batch.num_rows))
+
+
+class Expression:
+    """Base expression.  Subclasses set ``children`` and implement
+    ``kernel(ctx, *child_columns) -> DeviceColumn`` plus ``data_type``."""
+
+    children: Tuple["Expression", ...] = ()
+
+    # --- schema ----------------------------------------------------------
+    @property
+    def data_type(self) -> T.DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children) if self.children else True
+
+    @property
+    def foldable(self) -> bool:
+        return bool(self.children) and all(c.foldable for c in self.children)
+
+    def pretty_name(self) -> str:
+        return type(self).__name__.lower()
+
+    def sql(self) -> str:
+        args = ", ".join(c.sql() for c in self.children)
+        return f"{self.pretty_name()}({args})"
+
+    # --- evaluation ------------------------------------------------------
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        cols = [c.eval(ctx) for c in self.children]
+        return self.kernel(ctx, *cols)
+
+    def kernel(self, ctx: EvalContext, *cols: DeviceColumn) -> DeviceColumn:
+        raise NotImplementedError(type(self).__name__)
+
+    # --- tree utilities --------------------------------------------------
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        import copy
+        c = copy.copy(self)
+        c.children = tuple(children)
+        return c
+
+    def transform(self, fn: Callable[["Expression"], Optional["Expression"]]
+                  ) -> "Expression":
+        """Bottom-up rewrite; fn returns a replacement or None."""
+        new_children = tuple(c.transform(fn) for c in self.children)
+        node = self if new_children == self.children else self.with_children(new_children)
+        out = fn(node)
+        return out if out is not None else node
+
+    def collect(self, pred: Callable[["Expression"], bool]) -> List["Expression"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    def references(self) -> List["AttributeReference"]:
+        return self.collect(lambda e: isinstance(e, AttributeReference))  # type: ignore
+
+    # --- semantic identity (powers tiered-project CSE) -------------------
+    def semantic_key(self) -> Tuple:
+        return (type(self).__name__, self._key_extras(),
+                tuple(c.semantic_key() for c in self.children))
+
+    def _key_extras(self) -> Tuple:
+        return ()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return self.sql()
+
+
+class LeafExpression(Expression):
+    children: Tuple[Expression, ...] = ()
+
+
+class UnaryExpression(Expression):
+    """Base with standard (child,) plumbing."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+
+class BinaryExpression(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+
+class Unevaluable(Expression):
+    def eval(self, ctx):  # pragma: no cover
+        raise RuntimeError(f"{type(self).__name__} cannot be evaluated")
+
+
+@dataclass(eq=False)
+class AttributeReference(LeafExpression):
+    """Named column reference (pre-binding)."""
+    name: str
+    dtype: T.DataType
+    _nullable: bool = True
+    expr_id: int = field(default_factory=lambda: next(_expr_id_counter))
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def sql(self) -> str:
+        return self.name
+
+    def _key_extras(self) -> Tuple:
+        return (self.name, self.expr_id)
+
+    def renamed(self, name: str) -> "AttributeReference":
+        return AttributeReference(name, self.dtype, self._nullable, self.expr_id)
+
+
+@dataclass(eq=False)
+class BoundReference(LeafExpression):
+    """Column reference resolved to a batch ordinal."""
+    ordinal: int
+    dtype: T.DataType
+    _nullable: bool = True
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        return ctx.batch.columns[self.ordinal]
+
+    def sql(self) -> str:
+        return f"input[{self.ordinal}]"
+
+    def _key_extras(self) -> Tuple:
+        return (self.ordinal,)
+
+
+@dataclass(eq=False)
+class Alias(Expression):
+    child: Expression = None  # type: ignore
+    name: str = ""
+    expr_id: int = field(default_factory=lambda: next(_expr_id_counter))
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return Alias(children[0], self.name, self.expr_id)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    @property
+    def nullable(self) -> bool:
+        return self.children[0].nullable
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        return self.children[0].eval(ctx)
+
+    def sql(self) -> str:
+        return f"{self.children[0].sql()} AS {self.name}"
+
+    def to_attribute(self) -> AttributeReference:
+        return AttributeReference(self.name, self.data_type, self.nullable,
+                                  self.expr_id)
+
+    def _key_extras(self) -> Tuple:
+        return ()  # alias is transparent for CSE
+
+
+@dataclass(eq=False)
+class Literal(LeafExpression):
+    value: Any = None
+    dtype: Optional[T.DataType] = None
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = T.python_value_type(self.value)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    @property
+    def foldable(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        return literal_column(ctx, self.dtype, self.value)
+
+    def sql(self) -> str:
+        if isinstance(self.value, str):
+            return f"'{self.value}'"
+        return str(self.value)
+
+    def _key_extras(self) -> Tuple:
+        return (repr(self.value), self.dtype)
+
+
+def literal_column(ctx: EvalContext, dtype: T.DataType, value: Any
+                   ) -> DeviceColumn:
+    """Backend-aware scalar broadcast (cudf Scalar analog)."""
+    cap = ctx.capacity
+    if ctx.is_device:
+        from ...columnar.column import scalar_column
+        return scalar_column(dtype, value, cap)
+    # host backend: same layout, numpy arrays
+    from ...columnar.column import scalar_column
+    dev = scalar_column(dtype, value, cap)
+    return DeviceColumn(
+        dev.dtype,
+        None if dev.data is None else np.asarray(dev.data),
+        None if dev.validity is None else np.asarray(dev.validity),
+        None if dev.lengths is None else np.asarray(dev.lengths),
+        None if dev.aux is None else np.asarray(dev.aux),
+        dev.children)
+
+
+# --------------------------------------------------------------------------
+# Binding / resolution
+# --------------------------------------------------------------------------
+
+def bind_references(expr: Expression, schema_attrs: Sequence[AttributeReference],
+                    case_sensitive: bool = False) -> Expression:
+    """Replace AttributeReferences with BoundReferences against the given
+    input attribute list (by expr_id first, then by name)."""
+    def _bind(e: Expression):
+        if isinstance(e, AttributeReference):
+            for i, a in enumerate(schema_attrs):
+                if a.expr_id == e.expr_id:
+                    return BoundReference(i, a.dtype, a._nullable)
+            name = e.name if case_sensitive else e.name.lower()
+            for i, a in enumerate(schema_attrs):
+                an = a.name if case_sensitive else a.name.lower()
+                if an == name:
+                    return BoundReference(i, a.dtype, a._nullable)
+            raise KeyError(
+                f"cannot resolve column '{e.name}' among "
+                f"{[a.name for a in schema_attrs]}")
+        return None
+    return expr.transform(_bind)
+
+
+def resolve_expression(e: Any) -> Expression:
+    """Lift Python values / Column wrappers to Expressions."""
+    if isinstance(e, Expression):
+        return e
+    from ..dataframe import Column
+    if isinstance(e, Column):
+        return e.expr
+    return Literal(e)
+
+
+# --------------------------------------------------------------------------
+# Kernel helpers shared by expression families
+# --------------------------------------------------------------------------
+
+def valid_and(xp, *cols: DeviceColumn):
+    v = None
+    for c in cols:
+        cv = c.validity
+        if cv is None:
+            continue
+        v = cv if v is None else (v & cv)
+    if v is None:
+        raise ValueError("no validity masks")
+    return v
+
+
+def fixed(dtype: T.DataType, data, validity) -> DeviceColumn:
+    return DeviceColumn(dtype, data, validity)
+
+
+def null_safe_unary(ctx: EvalContext, dtype: T.DataType, col: DeviceColumn,
+                    fn) -> DeviceColumn:
+    return fixed(dtype, fn(col.data), col.validity)
+
+
+def null_safe_binary(ctx: EvalContext, dtype: T.DataType, a: DeviceColumn,
+                     b: DeviceColumn, fn) -> DeviceColumn:
+    return fixed(dtype, fn(a.data, b.data), valid_and(ctx.xp, a, b))
+
+
+def zero_fill(xp, col: DeviceColumn, fill=0):
+    """Replace data in invalid lanes with a safe value (avoids div-by-zero
+    poison in dead lanes)."""
+    return xp.where(col.validity, col.data, xp.asarray(fill, dtype=col.data.dtype))

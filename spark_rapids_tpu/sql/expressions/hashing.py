@@ -1,0 +1,150 @@
+"""hash()/xxhash64() expressions (reference ``HashFunctions.scala`` + JNI
+``Hash``).  Null fields leave the running hash unchanged, exactly like Spark.
+Also the basis of hash partitioning (GpuHashPartitioningBase parity)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from ...ops import hashing as H
+from .core import EvalContext, Expression, fixed
+
+
+def _bitcast(xp, x, to_dtype):
+    if xp.__name__ == "numpy":
+        return x.view(to_dtype)
+    import jax
+    return jax.lax.bitcast_convert_type(x, to_dtype)
+
+
+def _float_bits32(xp, x):
+    x = xp.where(x == 0.0, xp.asarray(0.0, dtype=x.dtype), x)  # -0.0 -> 0.0
+    bits = _bitcast(xp, x.astype(xp.float32), xp.int32)
+    return xp.where(xp.isnan(x), xp.asarray(0x7fc00000, dtype=xp.int32), bits)
+
+
+def _float_bits64(xp, x):
+    x = xp.where(x == 0.0, xp.asarray(0.0, dtype=x.dtype), x)
+    bits = _bitcast(xp, x.astype(xp.float64), xp.int64)
+    return xp.where(xp.isnan(x),
+                    xp.asarray(0x7ff8000000000000, dtype=xp.int64), bits)
+
+
+def _update_murmur3(xp, h_u32, col: DeviceColumn):
+    dt = col.dtype
+    if col.lengths is not None:
+        new = H.murmur3_bytes(xp, col.data, col.lengths, h_u32).astype(xp.uint32)
+    elif isinstance(dt, T.BooleanType):
+        new = H.murmur3_int(xp, col.data.astype(xp.int32), h_u32).astype(xp.uint32)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        new = H.murmur3_int(xp, col.data.astype(xp.int32), h_u32).astype(xp.uint32)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        new = H.murmur3_long(xp, col.data, h_u32).astype(xp.uint32)
+    elif isinstance(dt, T.FloatType):
+        new = H.murmur3_int(xp, _float_bits32(xp, col.data), h_u32).astype(xp.uint32)
+    elif isinstance(dt, T.DoubleType):
+        new = H.murmur3_long(xp, _float_bits64(xp, col.data), h_u32).astype(xp.uint32)
+    elif isinstance(dt, T.DecimalType) and dt.is_long_backed:
+        new = H.murmur3_long(xp, col.data, h_u32).astype(xp.uint32)
+    elif isinstance(dt, T.StructType):
+        new = h_u32
+        for ch in col.children:
+            new = _update_murmur3(xp, new, _mask_child(xp, ch, col.validity))
+        return xp.where(col.validity, new, h_u32)
+    else:
+        raise NotImplementedError(f"murmur3 over {dt}")
+    return xp.where(col.validity, new, h_u32)
+
+
+def _mask_child(xp, child: DeviceColumn, parent_valid) -> DeviceColumn:
+    from dataclasses import replace
+    return replace(child, validity=child.validity & parent_valid)
+
+
+def _update_xxhash64(xp, h_u64, col: DeviceColumn):
+    dt = col.dtype
+    if col.lengths is not None:
+        new = H.xxhash64_bytes(xp, col.data, col.lengths, h_u64)
+    elif isinstance(dt, T.BooleanType):
+        new = H.xxhash64_long(xp, col.data.astype(xp.int64), h_u64)
+    elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType,
+                         T.LongType, T.TimestampType)):
+        new = H.xxhash64_long(xp, col.data.astype(xp.int64), h_u64)
+    elif isinstance(dt, T.FloatType):
+        new = H.xxhash64_long(xp, _float_bits32(xp, col.data).astype(xp.int64), h_u64)
+    elif isinstance(dt, T.DoubleType):
+        new = H.xxhash64_long(xp, _float_bits64(xp, col.data), h_u64)
+    elif isinstance(dt, T.DecimalType) and dt.is_long_backed:
+        new = H.xxhash64_long(xp, col.data, h_u64)
+    elif isinstance(dt, T.StructType):
+        new = h_u64
+        for ch in col.children:
+            new = _update_xxhash64(xp, new, _mask_child(xp, ch, col.validity))
+        return xp.where(col.validity, new, h_u64)
+    else:
+        raise NotImplementedError(f"xxhash64 over {dt}")
+    return xp.where(col.validity, new.astype(xp.uint64), h_u64)
+
+
+class Murmur3Hash(Expression):
+    def __init__(self, *exprs: Expression, seed: int = H.DEFAULT_SEED):
+        self.children = tuple(exprs)
+        self.seed = seed
+
+    def with_children(self, children):
+        return Murmur3Hash(*children, seed=self.seed)
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def _key_extras(self):
+        return (self.seed,)
+
+    def pretty_name(self):
+        return "hash"
+
+    def kernel(self, ctx: EvalContext, *cols):
+        xp = ctx.xp
+        cap = cols[0].capacity if cols else ctx.capacity
+        h = xp.full((cap,), np.uint32(self.seed), dtype=xp.uint32)
+        for c in cols:
+            h = _update_murmur3(xp, h, c)
+        return fixed(T.INT, h.astype(xp.int32), xp.ones(cap, dtype=bool))
+
+
+class XxHash64(Expression):
+    def __init__(self, *exprs: Expression, seed: int = H.DEFAULT_SEED):
+        self.children = tuple(exprs)
+        self.seed = seed
+
+    def with_children(self, children):
+        return XxHash64(*children, seed=self.seed)
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def _key_extras(self):
+        return (self.seed,)
+
+    def pretty_name(self):
+        return "xxhash64"
+
+    def kernel(self, ctx: EvalContext, *cols):
+        xp = ctx.xp
+        cap = cols[0].capacity if cols else ctx.capacity
+        h = xp.full((cap,), np.uint64(self.seed), dtype=xp.uint64)
+        for c in cols:
+            h = _update_xxhash64(xp, h, c)
+        return fixed(T.LONG, h.astype(xp.int64), xp.ones(cap, dtype=bool))

@@ -1,0 +1,272 @@
+"""Math expressions (reference ``mathExpressions.scala``).
+
+Spark-specific semantics preserved:
+* ``log``/``log10``/``log2``/``log1p`` return NULL for out-of-domain input
+  (not -inf/NaN);
+* ``ceil``/``floor`` on doubles return LONG;
+* ``round`` is HALF_UP, ``bround`` is HALF_EVEN;
+* ``signum`` returns double.
+"""
+
+from __future__ import annotations
+
+import math as _pymath
+from dataclasses import dataclass
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from .core import (BinaryExpression, EvalContext, Expression, LeafExpression,
+                   UnaryExpression, fixed, null_safe_binary, null_safe_unary,
+                   valid_and)
+
+
+class UnaryMath(UnaryExpression):
+    """double -> double elementwise; subclasses set _fn name and optional
+    domain predicate (out-of-domain -> NULL, matching Spark)."""
+    _fn: str = ""
+    _domain = None  # callable(xp, x) -> bool array of valid domain
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        x = c.data.astype(xp.float64)
+        fn = getattr(xp, self._fn)
+        valid = c.validity
+        if self._domain is not None:
+            ok = type(self)._domain(xp, x)
+            valid = valid & ok
+            x = xp.where(ok, x, xp.asarray(1.0, dtype=x.dtype))
+        return fixed(T.DOUBLE, fn(x), valid)
+
+    def pretty_name(self):
+        return type(self).__name__.lower()
+
+
+def _make_unary(name, fn, domain=None, extra=None):
+    cls = type(name, (UnaryMath,), {"_fn": fn, "_domain": staticmethod(domain) if domain else None})
+    globals()[name] = cls
+    return cls
+
+
+_make_unary("Acos", "arccos")
+_make_unary("Acosh", "arccosh")
+_make_unary("Asin", "arcsin")
+_make_unary("Asinh", "arcsinh")
+_make_unary("Atan", "arctan")
+_make_unary("Atanh", "arctanh")
+_make_unary("Cos", "cos")
+_make_unary("Cosh", "cosh")
+_make_unary("Sin", "sin")
+_make_unary("Sinh", "sinh")
+_make_unary("Tan", "tan")
+_make_unary("Tanh", "tanh")
+_make_unary("Exp", "exp")
+_make_unary("Expm1", "expm1")
+_make_unary("Sqrt", "sqrt")
+_make_unary("Cbrt", "cbrt")
+_make_unary("Rint", "rint")
+_make_unary("Log", "log", domain=lambda xp, x: x > 0)
+_make_unary("Log10", "log10", domain=lambda xp, x: x > 0)
+_make_unary("Log2", "log2", domain=lambda xp, x: x > 0)
+_make_unary("Log1p", "log1p", domain=lambda xp, x: x > -1)
+_make_unary("ToDegrees", "degrees")
+_make_unary("ToRadians", "radians")
+
+
+class Cot(UnaryMath):
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        x = c.data.astype(xp.float64)
+        return fixed(T.DOUBLE, 1.0 / xp.tan(x), c.validity)
+
+
+class Signum(UnaryMath):
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        return fixed(T.DOUBLE, xp.sign(c.data.astype(xp.float64)), c.validity)
+
+
+class _CeilFloorBase(UnaryExpression):
+    _fn = ""
+
+    @property
+    def data_type(self):
+        ct = self.child.data_type
+        if isinstance(ct, T.DecimalType):
+            return T.DecimalType.bounded(ct.precision - ct.scale + 1, 0)
+        if isinstance(ct, (T.FloatType, T.DoubleType)):
+            return T.LONG
+        return ct  # integral: identity
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        ct = self.child.data_type
+        dt = self.data_type
+        if isinstance(ct, T.DecimalType):
+            f = 10 ** ct.scale
+            q = c.data // f if self._fn == "floor" else -((-c.data) // f)
+            return fixed(dt, q, c.validity)
+        if T.is_integral(ct):
+            return fixed(dt, c.data, c.validity)
+        fn = getattr(xp, self._fn)
+        return fixed(T.LONG, fn(c.data).astype(xp.int64), c.validity)
+
+
+class Ceil(_CeilFloorBase):
+    _fn = "ceil"
+
+
+class Floor(_CeilFloorBase):
+    _fn = "floor"
+
+
+class _RoundBase(Expression):
+    """round(x, d) — HALF_UP; bround — HALF_EVEN."""
+    _even = False
+
+    def __init__(self, child: Expression, scale: Expression):
+        self.children = (child, scale)
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def data_type(self):
+        ct = self.children[0].data_type
+        if isinstance(ct, T.DecimalType):
+            from .core import Literal
+            d = self.children[1].value if isinstance(self.children[1], Literal) else 0
+            d = max(0, min(int(d), ct.scale))
+            return T.DecimalType.bounded(ct.precision - ct.scale + d + 1, d)
+        return ct
+
+    def kernel(self, ctx, c, s):
+        xp = ctx.xp
+        ct = self.children[0].data_type
+        d = s.data  # scale per-row (normally a broadcast literal)
+        if isinstance(ct, T.DecimalType):
+            dt: T.DecimalType = self.data_type  # type: ignore
+            shift = ct.scale - dt.scale
+            f = xp.asarray(10 ** max(shift, 0), dtype=xp.int64)
+            q = c.data // f
+            r = c.data - q * f
+            if self._even:
+                half = f // 2
+                rup = (xp.abs(r) > half) | ((xp.abs(r) == half) & (q % 2 != 0))
+            else:
+                rup = 2 * xp.abs(r) >= f
+            q = q + xp.where(rup & (c.data < 0), -1, 0) + \
+                xp.where(rup & (c.data >= 0), 1, 0)
+            return fixed(dt, q, c.validity)
+        if T.is_integral(ct):
+            # rounding integers to negative scales
+            p = xp.maximum(-d, 0).astype(xp.int64)
+            f = (10 ** p).astype(c.data.dtype)
+            q = c.data // f
+            r = c.data - q * f
+            if self._even:
+                half = f // 2
+                rup = (xp.abs(r) > half) | ((xp.abs(r) == half) & (q % 2 != 0))
+            else:
+                rup = 2 * xp.abs(r) >= f
+            sign = xp.where(c.data < 0, -1, 1).astype(c.data.dtype)
+            q = (q + xp.where(rup, sign, 0)) * f
+            return fixed(ct, xp.where(d >= 0, c.data, q), c.validity)
+        x = c.data.astype(xp.float64)
+        f = xp.power(10.0, d.astype(xp.float64))
+        if self._even:
+            out = xp.round(x * f) / f  # round-half-even
+        else:
+            scaled = x * f
+            out = xp.sign(scaled) * xp.floor(xp.abs(scaled) + 0.5) / f
+        out = xp.where(xp.isfinite(x), out, x)
+        return fixed(ct, out.astype(c.data.dtype), c.validity)
+
+    def _key_extras(self):
+        return (self._even,)
+
+
+class Round(_RoundBase):
+    _even = False
+
+
+class BRound(_RoundBase):
+    _even = True
+
+
+class Pow(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        return null_safe_binary(
+            ctx, T.DOUBLE, a, b,
+            lambda x, y: xp.power(x.astype(xp.float64), y.astype(xp.float64)))
+
+
+class Hypot(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        return null_safe_binary(ctx, T.DOUBLE, a, b, xp.hypot)
+
+
+class Atan2(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        return null_safe_binary(ctx, T.DOUBLE, a, b, xp.arctan2)
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x) — null outside domain."""
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def kernel(self, ctx, base, x):
+        xp = ctx.xp
+        b = base.data.astype(xp.float64)
+        v = x.data.astype(xp.float64)
+        ok = (v > 0) & (b > 0) & (b != 1.0)
+        valid = valid_and(xp, base, x) & ok
+        b = xp.where(ok, b, 2.0)
+        v = xp.where(ok, v, 1.0)
+        return fixed(T.DOUBLE, xp.log(v) / xp.log(b), valid)
+
+
+@dataclass(eq=False)
+class Pi(LeafExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def kernel(self, ctx):
+        from .core import literal_column
+        return literal_column(ctx, T.DOUBLE, _pymath.pi)
+
+    def eval(self, ctx):
+        return self.kernel(ctx)
+
+
+@dataclass(eq=False)
+class E(LeafExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def eval(self, ctx):
+        from .core import literal_column
+        return literal_column(ctx, T.DOUBLE, _pymath.e)

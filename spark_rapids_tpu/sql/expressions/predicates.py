@@ -1,0 +1,369 @@
+"""Predicates & comparisons (reference ``predicates.scala``,
+``nullExpressions.scala``, ``GpuInSet.scala``).
+
+Spark comparison semantics preserved: three-valued logic for AND/OR;
+NaN equals itself and sorts greater than everything; null-safe equal (<=>)
+never returns null; IN returns null when no match but a null is present.
+Strings compare bytewise (UTF-8 order) via the padded-matrix kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Tuple
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from ...ops.strings_ops import string_compare, string_equals
+from .core import (EvalContext, Expression, Literal, fixed, valid_and)
+
+
+def _is_floating_expr(e: Expression) -> bool:
+    return T.is_floating(e.data_type)
+
+
+def compare_columns(ctx: EvalContext, a: DeviceColumn, b: DeviceColumn,
+                    floating: bool):
+    """Returns (lt, eq, gt) boolean arrays with Spark NaN semantics."""
+    xp = ctx.xp
+    if a.lengths is not None:  # strings
+        cmp = string_compare(xp, a.data, a.lengths, b.data, b.lengths)
+        return cmp < 0, cmp == 0, cmp > 0
+    x, y = a.data, b.data
+    if floating:
+        xn, yn = xp.isnan(x), xp.isnan(y)
+        eq = (x == y) | (xn & yn)
+        lt = (x < y) | (~xn & yn)
+        gt = (x > y) | (xn & ~yn)
+        return lt, eq, gt
+    if a.data.dtype == bool:
+        x = x.astype(xp.int8)
+        y = y.astype(xp.int8)
+    return x < y, x == y, x > y
+
+
+@dataclass(eq=False)
+class BinaryComparison(Expression):
+    left: Expression = None  # type: ignore
+    right: Expression = None  # type: ignore
+    symbol = "?"
+
+    def __post_init__(self):
+        self.children = (self.left, self.right)
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def sql(self):
+        return f"({self.children[0].sql()} {self.symbol} {self.children[1].sql()})"
+
+    def _pick(self, lt, eq, gt):
+        raise NotImplementedError
+
+    def kernel(self, ctx, a, b):
+        lt, eq, gt = compare_columns(ctx, a, b,
+                                     _is_floating_expr(self.children[0]))
+        return fixed(T.BOOLEAN, self._pick(lt, eq, gt), valid_and(ctx.xp, a, b))
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def _pick(self, lt, eq, gt):
+        return eq
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def _pick(self, lt, eq, gt):
+        return lt
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def _pick(self, lt, eq, gt):
+        return lt | eq
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def _pick(self, lt, eq, gt):
+        return gt
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def _pick(self, lt, eq, gt):
+        return gt | eq
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=> — nulls compare equal; never returns null."""
+    symbol = "<=>"
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        _, eq, _ = compare_columns(ctx, a, b,
+                                   _is_floating_expr(self.children[0]))
+        both_valid = a.validity & b.validity
+        both_null = ~a.validity & ~b.validity
+        data = (both_valid & eq) | both_null
+        return fixed(T.BOOLEAN, data, xp.ones_like(data, dtype=bool))
+
+
+@dataclass(eq=False)
+class And(Expression):
+    left: Expression = None  # type: ignore
+    right: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.left, self.right)
+
+    def with_children(self, children):
+        return And(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, a, b):
+        # 3VL: false AND null = false
+        at = a.validity & a.data
+        af = a.validity & ~a.data
+        bt = b.validity & b.data
+        bf = b.validity & ~b.data
+        data = at & bt
+        valid = af | bf | (at & bt)
+        return fixed(T.BOOLEAN, data, valid)
+
+    def sql(self):
+        return f"({self.children[0].sql()} AND {self.children[1].sql()})"
+
+
+@dataclass(eq=False)
+class Or(Expression):
+    left: Expression = None  # type: ignore
+    right: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.left, self.right)
+
+    def with_children(self, children):
+        return Or(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, a, b):
+        at = a.validity & a.data
+        bt = b.validity & b.data
+        data = at | bt
+        valid = at | bt | (a.validity & b.validity)
+        return fixed(T.BOOLEAN, data, valid)
+
+    def sql(self):
+        return f"({self.children[0].sql()} OR {self.children[1].sql()})"
+
+
+@dataclass(eq=False)
+class Not(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return Not(children[0])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, c):
+        return fixed(T.BOOLEAN, ~c.data, c.validity)
+
+    def sql(self):
+        return f"(NOT {self.children[0].sql()})"
+
+
+@dataclass(eq=False)
+class IsNull(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return IsNull(children[0])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        # dead (padding) rows must still look null-free to reductions; the
+        # exec layer masks by row_mask where it matters
+        return fixed(T.BOOLEAN, ~c.validity, xp.ones(c.capacity, dtype=bool))
+
+    def sql(self):
+        return f"({self.children[0].sql()} IS NULL)"
+
+
+@dataclass(eq=False)
+class IsNotNull(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return IsNotNull(children[0])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        return fixed(T.BOOLEAN, c.validity, xp.ones(c.capacity, dtype=bool))
+
+    def sql(self):
+        return f"({self.children[0].sql()} IS NOT NULL)"
+
+
+@dataclass(eq=False)
+class IsNaN(Expression):
+    child: Expression = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    def with_children(self, children):
+        return IsNaN(children[0])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        data = xp.isnan(c.data) & c.validity
+        return fixed(T.BOOLEAN, data, xp.ones(c.capacity, dtype=bool))
+
+
+@dataclass(eq=False)
+class AtLeastNNonNulls(Expression):
+    n: int = 1
+    exprs: Tuple[Expression, ...] = ()
+
+    def __post_init__(self):
+        self.children = tuple(self.exprs)
+
+    def with_children(self, children):
+        return AtLeastNNonNulls(self.n, tuple(children))
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def _key_extras(self):
+        return (self.n,)
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        count = None
+        for c in cols:
+            ok = c.validity
+            if T.is_floating(c.dtype):
+                ok = ok & ~xp.isnan(c.data)
+            cnt = ok.astype(xp.int32)
+            count = cnt if count is None else count + cnt
+        data = count >= self.n
+        return fixed(T.BOOLEAN, data, xp.ones(data.shape[0], dtype=bool))
+
+
+@dataclass(eq=False)
+class In(Expression):
+    """value IN (list of expressions, typically literals)."""
+    value: Expression = None  # type: ignore
+    items: Tuple[Expression, ...] = ()
+
+    def __post_init__(self):
+        self.children = (self.value,) + tuple(self.items)
+
+    def with_children(self, children):
+        return In(children[0], tuple(children[1:]))
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, v, *item_cols):
+        xp = ctx.xp
+        floating = _is_floating_expr(self.children[0])
+        match = xp.zeros(v.capacity, dtype=bool)
+        any_null_item = xp.zeros(v.capacity, dtype=bool)
+        for c in item_cols:
+            if v.lengths is not None:
+                eq = string_equals(xp, v.data, v.lengths, c.data, c.lengths)
+            else:
+                _, eq, _ = compare_columns(ctx, v, c, floating)
+            match = match | (eq & c.validity)
+            any_null_item = any_null_item | ~c.validity
+        data = match
+        valid = v.validity & (match | ~any_null_item)
+        return fixed(T.BOOLEAN, data, valid)
+
+    def sql(self):
+        items = ", ".join(c.sql() for c in self.children[1:])
+        return f"({self.children[0].sql()} IN ({items}))"
+
+
+class InSet(In):
+    """Optimized IN over a literal set — same semantics; the device kernel
+    broadcasts the set as a [set_size] constant and reduces, rather than
+    looping columns (reference ``GpuInSet.scala``)."""
+
+    def kernel(self, ctx, v, *item_cols):
+        xp = ctx.xp
+        if v.lengths is not None or not item_cols:
+            return super().kernel(ctx, v, *item_cols)
+        values = xp.stack([c.data[0] for c in item_cols])
+        valids = xp.stack([c.validity[0] for c in item_cols])
+        floating = _is_floating_expr(self.children[0])
+        x = v.data[:, None]
+        y = values[None, :]
+        if floating:
+            eq = (x == y) | (xp.isnan(x) & xp.isnan(y))
+        else:
+            eq = x == y
+        match = xp.any(eq & valids[None, :], axis=1)
+        any_null = xp.any(~valids)
+        valid = v.validity & (match | ~any_null)
+        return fixed(T.BOOLEAN, match, valid)

@@ -1,0 +1,43 @@
+"""Registry of expression classes — the analog of the reference's
+``GpuOverrides.expressions`` map of 212 expr rules (``GpuOverrides.scala:894,
+3622``).  The overrides layer consults this to tag expressions supported on
+the device; anything absent falls back to the host engine."""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from .core import Alias, AttributeReference, BoundReference, Expression, Literal
+from . import arithmetic as A
+from . import cast as C
+from . import conditional as Cond
+from . import hashing as Hsh
+from . import math_fns as M
+from . import predicates as P
+
+EXPRESSION_REGISTRY: Dict[str, Type[Expression]] = {}
+
+
+def _reg(*classes):
+    for cls in classes:
+        EXPRESSION_REGISTRY[cls.__name__] = cls
+
+
+_reg(Alias, AttributeReference, BoundReference, Literal)
+_reg(A.Add, A.Subtract, A.Multiply, A.Divide, A.IntegralDivide, A.Remainder,
+     A.Pmod, A.UnaryMinus, A.UnaryPositive, A.Abs, A.Least, A.Greatest,
+     A.BitwiseAnd, A.BitwiseOr, A.BitwiseXor, A.BitwiseNot, A.ShiftLeft,
+     A.ShiftRight, A.ShiftRightUnsigned)
+_reg(P.EqualTo, P.EqualNullSafe, P.LessThan, P.LessThanOrEqual, P.GreaterThan,
+     P.GreaterThanOrEqual, P.And, P.Or, P.Not, P.IsNull, P.IsNotNull, P.IsNaN,
+     P.AtLeastNNonNulls, P.In, P.InSet)
+_reg(M.Acos, M.Acosh, M.Asin, M.Asinh, M.Atan, M.Atanh, M.Cos, M.Cosh, M.Sin,
+     M.Sinh, M.Tan, M.Tanh, M.Exp, M.Expm1, M.Sqrt, M.Cbrt, M.Rint, M.Log,
+     M.Log10, M.Log2, M.Log1p, M.ToDegrees, M.ToRadians, M.Cot, M.Signum,
+     M.Ceil, M.Floor, M.Round, M.BRound, M.Pow, M.Hypot, M.Atan2, M.Logarithm,
+     M.Pi, M.E)
+_reg(Cond.If, Cond.CaseWhen, Cond.Coalesce, Cond.NaNvl, Cond.KnownNotNull,
+     Cond.KnownFloatingPointNormalized, Cond.NormalizeNaNAndZero,
+     Cond.RaiseError)
+_reg(C.Cast)
+_reg(Hsh.Murmur3Hash, Hsh.XxHash64)

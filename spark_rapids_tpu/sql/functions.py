@@ -1,0 +1,200 @@
+"""pyspark.sql.functions-compatible function surface (F.*)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import types as T
+from .dataframe import Column, _to_expr
+from .expressions import arithmetic as A
+from .expressions import conditional as CO
+from .expressions import hashing as H
+from .expressions import math_fns as M
+from .expressions import predicates as P
+from .expressions import aggregates as AG
+from .expressions.cast import Cast
+from .expressions.core import Alias, AttributeReference, Expression, Literal
+
+
+def col(name: str) -> Column:
+    # unresolved reference: dtype filled by binding against the plan; we use
+    # a late-bound marker resolved in DataFrame._resolve via name match.
+    return Column(_UnresolvedAttribute(name))
+
+
+class _UnresolvedAttribute(AttributeReference):
+    def __init__(self, name: str):
+        super().__init__(name, T.NULL)
+        self._unresolved = True
+
+
+column = col
+
+
+def lit(v: Any) -> Column:
+    return Column(Literal(v))
+
+
+def _c(x) -> Expression:
+    return _to_expr(x)
+
+
+def expr_fn(cls):
+    def f(*args):
+        return Column(cls(*[_c(a) for a in args]))
+    return f
+
+
+# math / arithmetic
+abs = expr_fn(A.Abs)  # noqa: A001
+sqrt = expr_fn(M.Sqrt)
+cbrt = expr_fn(M.Cbrt)
+exp = expr_fn(M.Exp)
+expm1 = expr_fn(M.Expm1)
+log = expr_fn(M.Log)
+log10 = expr_fn(M.Log10)
+log2 = expr_fn(M.Log2)
+log1p = expr_fn(M.Log1p)
+sin = expr_fn(M.Sin)
+cos = expr_fn(M.Cos)
+tan = expr_fn(M.Tan)
+cot = expr_fn(M.Cot)
+asin = expr_fn(M.Asin)
+acos = expr_fn(M.Acos)
+atan = expr_fn(M.Atan)
+sinh = expr_fn(M.Sinh)
+cosh = expr_fn(M.Cosh)
+tanh = expr_fn(M.Tanh)
+asinh = expr_fn(M.Asinh)
+acosh = expr_fn(M.Acosh)
+atanh = expr_fn(M.Atanh)
+degrees = expr_fn(M.ToDegrees)
+radians = expr_fn(M.ToRadians)
+signum = expr_fn(M.Signum)
+rint = expr_fn(M.Rint)
+hypot = expr_fn(M.Hypot)
+atan2 = expr_fn(M.Atan2)
+pow = expr_fn(M.Pow)  # noqa: A001
+ceil = expr_fn(M.Ceil)
+floor = expr_fn(M.Floor)
+
+
+def round(c, scale: int = 0):  # noqa: A001
+    return Column(M.Round(_c(c), Literal(scale, T.INT)))
+
+
+def bround(c, scale: int = 0):
+    return Column(M.BRound(_c(c), Literal(scale, T.INT)))
+
+
+def pmod(a, b):
+    return Column(A.Pmod(_c(a), _c(b)))
+
+
+def shiftleft(c, n: int):
+    return Column(A.ShiftLeft(_c(c), Literal(n, T.INT)))
+
+
+def shiftright(c, n: int):
+    return Column(A.ShiftRight(_c(c), Literal(n, T.INT)))
+
+
+def shiftrightunsigned(c, n: int):
+    return Column(A.ShiftRightUnsigned(_c(c), Literal(n, T.INT)))
+
+
+def least(*cols):
+    return Column(A.Least(tuple(_c(c) for c in cols)))
+
+
+def greatest(*cols):
+    return Column(A.Greatest(tuple(_c(c) for c in cols)))
+
+
+# null / conditional
+def isnull(c):
+    return Column(P.IsNull(_c(c)))
+
+
+def isnan(c):
+    return Column(P.IsNaN(_c(c)))
+
+
+def coalesce(*cols):
+    return Column(CO.Coalesce(*[_c(c) for c in cols]))
+
+
+def nanvl(a, b):
+    return Column(CO.NaNvl(_c(a), _c(b)))
+
+
+def nvl(a, b):
+    return Column(CO.Coalesce(_c(a), _c(b)))
+
+
+class _WhenColumn(Column):
+    def __init__(self, branches, else_value=None):
+        self._branches = branches
+        self._else = else_value
+        super().__init__(CO.CaseWhen(branches, else_value))
+
+    def when(self, cond: Column, value) -> "_WhenColumn":
+        return _WhenColumn(self._branches + [(_c(cond), _c(value))], self._else)
+
+    def otherwise(self, value) -> Column:
+        return Column(CO.CaseWhen(self._branches, _c(value)))
+
+
+def when(cond: Column, value) -> _WhenColumn:
+    return _WhenColumn([(_c(cond), _c(value))])
+
+
+def expr(sql: str):
+    raise NotImplementedError("SQL expression strings are not yet supported")
+
+
+# hash
+def hash(*cols):  # noqa: A001
+    return Column(H.Murmur3Hash(*[_c(c) for c in cols]))
+
+
+def xxhash64(*cols):
+    return Column(H.XxHash64(*[_c(c) for c in cols]))
+
+
+# aggregates
+def _agg1(cls):
+    def f(c):
+        return Column(cls(_c(c)))
+    return f
+
+
+sum = _agg1(AG.Sum)  # noqa: A001
+min = _agg1(AG.Min)  # noqa: A001
+max = _agg1(AG.Max)  # noqa: A001
+avg = _agg1(AG.Average)
+mean = avg
+stddev = _agg1(AG.StddevSamp)
+stddev_samp = _agg1(AG.StddevSamp)
+stddev_pop = _agg1(AG.StddevPop)
+variance = _agg1(AG.VarianceSamp)
+var_samp = _agg1(AG.VarianceSamp)
+var_pop = _agg1(AG.VariancePop)
+
+
+def count(c="*"):
+    if isinstance(c, str) and c == "*":
+        return Column(AG.Count())
+    return Column(AG.Count(_c(c)))
+
+
+def countDistinct(c):
+    return Column(AG.AggregateExpression(AG.Count(_c(c)), is_distinct=True))
+
+
+def first(c, ignorenulls: bool = False):
+    return Column(AG.First(_c(c), ignorenulls))
+
+
+def last(c, ignorenulls: bool = False):
+    return Column(AG.Last(_c(c), ignorenulls))

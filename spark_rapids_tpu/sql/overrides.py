@@ -1,0 +1,201 @@
+"""TpuOverrides — the plan-rewrite/placement engine, the analog of the
+reference's ``GpuOverrides``/``RapidsMeta`` (SURVEY §2.2, §3.2).
+
+Every logical node and expression is wrapped in a Meta carrying tag state
+("will not work on TPU because ...").  Tagging consults the expression
+registry, per-op TypeSigs, and config kill-switches; the planner then places
+each operator on the device or the host engine accordingly, and explain()
+reports placements exactly like ``spark.rapids.sql.explain=ALL``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from .. import types as T
+from ..config import RapidsConf
+from . import plan as P
+from . import typesig as TS
+from .expressions import aggregates as AGG
+from .expressions.cast import Cast
+from .expressions.core import (Alias, AttributeReference, BoundReference,
+                               Expression, Literal)
+from .expressions.registry import EXPRESSION_REGISTRY
+
+# per-expression TypeSig overrides (default: ALL_DEVICE)
+_EXPR_SIGS: Dict[str, TS.TypeSig] = {
+    "Murmur3Hash": TS.BASIC + TS.STRUCT,
+    "XxHash64": TS.BASIC + TS.STRUCT,
+}
+
+# expressions that are registered but must run on the host in some forms
+_HOST_ONLY_EXPRS = {"RaiseError"}
+
+# config kill-switches per exec family (subset of the reference's
+# spark.rapids.sql.exec.* flags)
+_EXEC_ENABLE_KEYS = {
+    "Project": "spark.rapids.sql.exec.ProjectExec",
+    "Filter": "spark.rapids.sql.exec.FilterExec",
+    "Aggregate": "spark.rapids.sql.exec.HashAggregateExec",
+    "Sort": "spark.rapids.sql.exec.SortExec",
+    "Join": "spark.rapids.sql.exec.ShuffledHashJoinExec",
+}
+
+_SUPPORTED_AGGS = (AGG.Sum, AGG.Count, AGG.Min, AGG.Max, AGG.Average,
+                   AGG.First, AGG.Last, AGG.StddevPop, AGG.StddevSamp,
+                   AGG.VariancePop, AGG.VarianceSamp)
+
+
+class ExprMeta:
+    def __init__(self, expr: Expression, conf: RapidsConf):
+        self.expr = expr
+        self.conf = conf
+        self.reasons: List[str] = []
+        self.children = [ExprMeta(c, conf) for c in expr.children]
+
+    def will_not_work(self, reason: str):
+        self.reasons.append(reason)
+
+    def tag(self):
+        e = self.expr
+        cls_name = type(e).__name__
+        if isinstance(e, (AttributeReference, BoundReference, Literal, Alias)):
+            pass
+        elif isinstance(e, AGG.AggregateExpression):
+            if not isinstance(e.func, _SUPPORTED_AGGS):
+                self.will_not_work(
+                    f"aggregate {type(e.func).__name__} is not supported on TPU")
+            if e.is_distinct:
+                self.will_not_work("DISTINCT aggregates are not yet supported "
+                                   "on TPU")
+        elif isinstance(e, AGG.AggregateFunction):
+            if not isinstance(e, _SUPPORTED_AGGS):
+                self.will_not_work(
+                    f"aggregate {cls_name} is not supported on TPU")
+        elif cls_name not in EXPRESSION_REGISTRY:
+            self.will_not_work(f"expression {cls_name} is not supported on TPU")
+        elif cls_name in _HOST_ONLY_EXPRS:
+            self.will_not_work(f"expression {cls_name} runs on the host only")
+        # type checks
+        sig = _EXPR_SIGS.get(cls_name, TS.ALL_DEVICE)
+        for node in [e] + list(e.children):
+            try:
+                dt = node.data_type
+            except NotImplementedError:
+                continue
+            r = sig.supports(dt)
+            if r:
+                self.will_not_work(f"{cls_name}: {r}")
+                break
+        if isinstance(e, Cast):
+            ft = e.children[0].data_type
+            if isinstance(ft, T.StringType) or isinstance(e.to, T.StringType):
+                if not isinstance(ft, T.StringType) or not isinstance(
+                        e.to, T.StringType):
+                    self.will_not_work(
+                        f"cast {ft.simple_string()} -> "
+                        f"{e.to.simple_string()} runs on the host "
+                        "(CastStrings-equivalent device kernel pending)")
+        for c in self.children:
+            c.tag()
+
+    def all_reasons(self) -> List[str]:
+        out = list(self.reasons)
+        for c in self.children:
+            out.extend(c.all_reasons())
+        return out
+
+
+class PlanMeta:
+    def __init__(self, node: P.LogicalPlan, conf: RapidsConf):
+        self.node = node
+        self.conf = conf
+        self.reasons: List[str] = []
+        self.children = [PlanMeta(c, conf) for c in node.children]
+        self.backend = "tpu"
+
+    def will_not_work(self, reason: str):
+        self.reasons.append(reason)
+
+    def _expressions(self) -> List[Expression]:
+        n = self.node
+        if isinstance(n, P.Project):
+            return list(n.exprs)
+        if isinstance(n, P.Filter):
+            return [n.condition]
+        if isinstance(n, P.Aggregate):
+            return list(n.grouping) + list(n.aggregates)
+        if isinstance(n, P.Sort):
+            return [o.child for o in n.orders]
+        if isinstance(n, P.Join):
+            out = list(n.left_keys) + list(n.right_keys)
+            if n.condition is not None:
+                out.append(n.condition)
+            return out
+        if isinstance(n, P.Expand):
+            return [e for proj in n.projections for e in proj]
+        if isinstance(n, P.Generate):
+            return [n.generator]
+        return []
+
+    def tag(self):
+        if not self.conf.is_sql_enabled:
+            self.will_not_work("spark.rapids.sql.enabled is false")
+        key = _EXEC_ENABLE_KEYS.get(type(self.node).__name__)
+        if key and str(self.conf.get(key, "true")).lower() == "false":
+            self.will_not_work(f"{key} is disabled")
+        # output AND input schema types must have a device layout (the
+        # reference's ExecChecks covers input attributes the same way)
+        for a in self.node.output:
+            r = TS.ALL_DEVICE.supports(a.dtype)
+            if r:
+                self.will_not_work(f"output column '{a.name}': {r}")
+                break
+        for child in self.node.children:
+            for a in child.output:
+                r = TS.ALL_DEVICE.supports(a.dtype)
+                if r:
+                    self.will_not_work(f"input column '{a.name}': {r}")
+                    break
+        if isinstance(self.node, P.Generate):
+            self.will_not_work("Generate (explode) is not yet supported on "
+                               "TPU")
+        for e in self._expressions():
+            em = ExprMeta(e, self.conf)
+            em.tag()
+            for reason in em.all_reasons():
+                self.will_not_work(reason)
+        for c in self.children:
+            c.tag()
+        self.backend = "cpu" if self.reasons else "tpu"
+
+    def explain(self, all_ops: bool = False, level: int = 0) -> str:
+        mark = "*" if self.backend == "tpu" else "!"
+        pad = "  " * level
+        lines = []
+        if all_ops or self.backend != "tpu":
+            lines.append(f"{pad}{mark}{type(self.node).__name__} "
+                         f"{'will run on TPU' if self.backend == 'tpu' else 'cannot run on TPU because ' + '; '.join(dict.fromkeys(self.reasons))}")
+        for c in self.children:
+            sub = c.explain(all_ops, level + 1)
+            if sub:
+                lines.append(sub)
+        return "\n".join([l for l in lines if l])
+
+
+class TpuOverrides:
+    """Entry point: wrap + tag a logical plan, yielding placement info the
+    planner consumes (GpuOverrides.apply analog)."""
+
+    @staticmethod
+    def apply(plan: P.LogicalPlan, conf: Optional[RapidsConf] = None) -> PlanMeta:
+        conf = conf or RapidsConf.get_global()
+        meta = PlanMeta(plan, conf)
+        meta.tag()
+        return meta
+
+
+def explain_potential_plan(df, all_ops: bool = True) -> str:
+    """Public explain API (reference ``ExplainPlan.explainPotentialGpuPlan``)."""
+    meta = TpuOverrides.apply(df._plan, df._session.conf)
+    return meta.explain(all_ops)

@@ -1,0 +1,3 @@
+from .base import PhysicalPlan, TaskContext
+
+__all__ = ["PhysicalPlan", "TaskContext"]

@@ -1,0 +1,333 @@
+"""Hash aggregate exec (reference ``aggregate.scala`` GpuHashAggregateExec).
+
+TPU algorithm — no hash table, all static shapes:
+1. group keys -> exact dense ranks (ops/ranks.py: integer sorts + pair
+   densification); the rank IS the segment id;
+2. every aggregate buffer slot scatter-reduces by rank (ops/segmented.py);
+3. group key values are gathered from each group's first row;
+4. output batch keeps the input capacity, ``num_rows`` = #groups (traced).
+
+Two-phase distributed aggregation (partial -> exchange -> final/merge) reuses
+the same kernel with each slot's merge op, like the reference's
+Partial/PartialMerge modes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.batch import ColumnarBatch
+from ...columnar.column import DeviceColumn
+from ...ops.ranks import dense_rank_columns, dense_rank_pairs
+from ...ops.segmented import seg_count, seg_max, seg_min, seg_sum
+from ..expressions.aggregates import (COUNT, FIRST, LAST, MAX, MIN, SUM,
+                                      AggregateExpression, AggregateFunction,
+                                      BufferSlot)
+from ..expressions.core import (Alias, AttributeReference, EvalContext,
+                                Expression, bind_references)
+from .base import TPU, PhysicalPlan, TaskContext
+
+
+def _min_sentinel(xp, dtype: T.DataType):
+    if T.is_floating(dtype):
+        return float("inf")
+    if isinstance(dtype, T.BooleanType):
+        return True
+    return np.iinfo(dtype.np_dtype).max
+
+
+def _max_sentinel(xp, dtype: T.DataType):
+    if T.is_floating(dtype):
+        return float("-inf")
+    if isinstance(dtype, T.BooleanType):
+        return False
+    return np.iinfo(dtype.np_dtype).min
+
+
+def _gather_col(col: DeviceColumn, idx, idx_valid):
+    return col.gather(idx, idx_valid)
+
+
+def _reduce_slot(xp, col: DeviceColumn, contrib, op: str, rank, cap, row_idx):
+    """Reduce one buffer slot by group rank; returns a DeviceColumn indexed
+    by group id."""
+    any_contrib = seg_sum(xp, contrib.astype(xp.int32), rank, cap) > 0
+    if op == SUM:
+        z = xp.asarray(0, dtype=col.data.dtype)
+        data = seg_sum(xp, xp.where(contrib, col.data, z), rank, cap)
+        return DeviceColumn(col.dtype, data, any_contrib)
+    if op == COUNT:
+        data = seg_sum(xp, contrib.astype(xp.int64), rank, cap)
+        return DeviceColumn(T.LONG, data, xp.ones_like(any_contrib))
+    if op in (MIN, MAX):
+        if col.lengths is not None or col.children:
+            # order via dense rank then argmin/argmax of (rank, row) pairs
+            from ...ops.ranks import dense_rank_columns as drc
+            r = drc(xp, [col])
+            combined = r * cap + row_idx
+            if op == MIN:
+                combined = xp.where(contrib, combined, cap * cap)
+                best = seg_min(xp, combined, rank, cap, cap * cap)
+            else:
+                combined = xp.where(contrib, combined, -1)
+                best = seg_max(xp, combined, rank, cap, -1)
+            widx = (best % cap).astype(xp.int32)
+            ok = any_contrib
+            return _gather_col(col, xp.clip(widx, 0, cap - 1), ok)
+        if op == MIN:
+            s = xp.asarray(_min_sentinel(xp, col.dtype), dtype=col.data.dtype)
+            data = seg_min(xp, xp.where(contrib, col.data, s), rank, cap, s)
+        else:
+            s = xp.asarray(_max_sentinel(xp, col.dtype), dtype=col.data.dtype)
+            data = seg_max(xp, xp.where(contrib, col.data, s), rank, cap, s)
+        return DeviceColumn(col.dtype, data, any_contrib)
+    if op in (FIRST, LAST):
+        if op == FIRST:
+            widx = seg_min(xp, xp.where(contrib, row_idx, cap), rank, cap, cap)
+        else:
+            widx = seg_max(xp, xp.where(contrib, row_idx, -1), rank, cap, -1)
+        ok = any_contrib
+        return _gather_col(col, xp.clip(widx, 0, cap - 1).astype(xp.int32), ok)
+    raise ValueError(op)
+
+
+def groupby_reduce(xp, key_cols: Sequence[DeviceColumn],
+                   slot_cols: Sequence[Tuple[DeviceColumn, "object"]],
+                   ops: Sequence[str], row_mask):
+    """Core groupby: returns (grouped_key_cols, reduced_slot_cols, n_groups).
+    Output arrays are capacity-sized; group g lives at index g."""
+    cap = row_mask.shape[0]
+    row_idx = xp.arange(cap, dtype=xp.int64)
+    if key_cols:
+        rank64 = dense_rank_columns(xp, key_cols, row_mask)
+    else:
+        rank64 = xp.where(row_mask, 0, 1).astype(xp.int64)  # one global group
+    rank = rank64.astype(xp.int32)
+    live_rank = xp.where(row_mask, rank64, -1)
+    n_groups = (xp.max(live_rank) + 1).astype(xp.int32)
+    if not key_cols:
+        # global aggregate: always exactly one output row, even with empty
+        # input (SQL semantics: SELECT sum(x) over zero rows -> one null row)
+        n_groups = xp.maximum(n_groups, 1)
+
+    first_idx = seg_min(xp, xp.where(row_mask, row_idx, cap), rank, cap, cap)
+    first_idx = xp.clip(first_idx, 0, cap - 1).astype(xp.int32)
+    group_ok = xp.arange(cap, dtype=xp.int32) < n_groups
+    out_keys = [_gather_col(k, first_idx, group_ok) for k in key_cols]
+
+    out_slots = []
+    for (col, contrib), op in zip(slot_cols, ops):
+        contrib = contrib & row_mask
+        r = _reduce_slot(xp, col, contrib, op, rank, cap, row_idx)
+        # clamp validity to existing groups
+        out_slots.append(r.with_validity(r.validity & group_ok))
+    return out_keys, out_slots, n_groups
+
+
+class HashAggregateExec(PhysicalPlan):
+    """mode: complete | partial | final.
+
+    Output contract for partial mode: [key cols...] + [slot cols...] with
+    generated names; final mode consumes that layout.
+    """
+
+    def __init__(self, grouping: Sequence[Expression],
+                 agg_out: Sequence[Expression], mode: str,
+                 child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.mode = mode
+        self.grouping = list(grouping)
+        self.agg_out = list(agg_out)
+
+        # split outputs into group refs and aggregate expressions
+        self._agg_funcs: List[AggregateFunction] = []
+        self._out_spec: List[Tuple[str, int, str]] = []  # (kind, idx, name)
+        group_keys = [g.semantic_key() for g in self.grouping]
+        for e in self.agg_out:
+            name = e.name if isinstance(e, Alias) else (
+                e.name if isinstance(e, AttributeReference) else e.sql())
+            inner = e.children[0] if isinstance(e, Alias) else e
+            aggs = inner.collect(lambda x: isinstance(x, (AggregateExpression,
+                                                          AggregateFunction)))
+            if aggs:
+                func = aggs[0]
+                if isinstance(func, AggregateExpression):
+                    func = func.func
+                self._out_spec.append(("agg", len(self._agg_funcs), name))
+                self._agg_funcs.append(func)
+            else:
+                sk = inner.semantic_key()
+                if sk in group_keys:
+                    self._out_spec.append(("group", group_keys.index(sk), name))
+                else:
+                    raise ValueError(
+                        f"aggregate output {e.sql()} is neither a grouping "
+                        "expression nor an aggregate")
+
+        child_attrs = child.output
+        if mode == "final":
+            # child emits [keys..., slots...]
+            nk = len(self.grouping)
+            self._key_refs = child_attrs[:nk]
+            self._slot_attrs = child_attrs[nk:]
+        else:
+            self._bound_grouping = [bind_references(g, child_attrs)
+                                    for g in self.grouping]
+            self._bound_inputs = [
+                [bind_references(c, child_attrs) for c in f.children]
+                for f in self._agg_funcs]
+
+        self._partial_fn = self._jit(self._partial_compute)
+        self._merge_fn = self._jit(self._merge_compute)
+
+    # --- schema -----------------------------------------------------------
+    @property
+    def output(self):
+        if self.mode == "partial":
+            out = []
+            for i, g in enumerate(self.grouping):
+                out.append(AttributeReference(f"_g{i}", g.data_type, True))
+            si = 0
+            for f in self._agg_funcs:
+                for s in f.slots():
+                    out.append(AttributeReference(f"_s{si}", s.dtype, True))
+                    si += 1
+            return out
+        out = []
+        for kind, idx, name in self._out_spec:
+            if kind == "group":
+                g = self.grouping[idx]
+                out.append(AttributeReference(name, g.data_type, g.nullable))
+            else:
+                f = self._agg_funcs[idx]
+                out.append(AttributeReference(name, f.data_type, f.nullable))
+        return out
+
+    # --- compute ----------------------------------------------------------
+    def _partial_compute(self, batch: ColumnarBatch):
+        """update + first reduce over one input batch -> [keys..., slots...]"""
+        xp = self.xp
+        ctx = EvalContext(batch, xp=xp)
+        keys = [g.eval(ctx) for g in self._bound_grouping]
+        slot_pairs = []
+        ops = []
+        for f, inputs in zip(self._agg_funcs, self._bound_inputs):
+            in_cols = [e.eval(ctx) for e in inputs]
+            pairs = f.update_values(ctx, in_cols)
+            slot_pairs.extend(pairs)
+            ops.extend(s.op for s in f.slots())
+        gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, batch.row_mask())
+        names = tuple(f"_g{i}" for i in range(len(gk))) + \
+            tuple(f"_s{i}" for i in range(len(gs)))
+        return ColumnarBatch(names, tuple(gk) + tuple(gs), n)
+
+    def _merge_compute(self, batch: ColumnarBatch):
+        """merge partial layout [keys..., slots...] -> same layout."""
+        xp = self.xp
+        nk = len(self.grouping)
+        keys = list(batch.columns[:nk])
+        slots = list(batch.columns[nk:])
+        ops, contribs = [], []
+        si = 0
+        for f in self._agg_funcs:
+            for s in f.slots():
+                ops.append(s.merge_op)
+                col = slots[si]
+                if s.merge_op in (FIRST, LAST):
+                    contribs.append(batch.row_mask())
+                else:
+                    contribs.append(col.validity)
+                si += 1
+        pairs = list(zip(slots, contribs))
+        gk, gs, n = groupby_reduce(xp, keys, pairs, ops, batch.row_mask())
+        return ColumnarBatch(batch.names, tuple(gk) + tuple(gs), n)
+
+    def _finalize(self, batch: ColumnarBatch):
+        """evaluate result expressions over merged [keys..., slots...]"""
+        xp = self.xp
+        ctx = EvalContext(batch, xp=xp)
+        nk = len(self.grouping)
+        keys = list(batch.columns[:nk])
+        slots = list(batch.columns[nk:])
+        # per-func slot ranges
+        results = []
+        si = 0
+        func_results = []
+        for f in self._agg_funcs:
+            cnt = len(f.slots())
+            func_results.append(f.evaluate(ctx, slots[si:si + cnt]))
+            si += cnt
+        cols, names = [], []
+        for kind, idx, name in self._out_spec:
+            names.append(name)
+            cols.append(keys[idx] if kind == "group" else func_results[idx])
+        return ColumnarBatch(tuple(names), tuple(cols), batch.num_rows)
+
+    _finalize_jit = None
+
+    # --- execute ----------------------------------------------------------
+    def execute(self, pid: int, tctx: TaskContext):
+        child = self.children[0]
+        if self.mode == "final":
+            batches = list(child.execute(pid, tctx))
+            if not batches:
+                yield self._empty_output()
+                return
+            merged = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
+            merged = self._merge_fn(merged)
+            if self._finalize_jit is None:
+                self._finalize_jit = self._jit(self._finalize)
+            yield self._finalize_jit(merged)
+            return
+
+        partials = []
+        for batch in child.execute(pid, tctx):
+            partials.append(self._partial_fn(batch))
+        if not partials:
+            yield self._empty_output()
+            return
+        merged = ColumnarBatch.concat(partials) if len(partials) > 1 else partials[0]
+        if len(partials) > 1:
+            merged = self._merge_fn(merged)
+        if self.mode == "partial":
+            yield merged
+        else:  # complete
+            if self._finalize_jit is None:
+                self._finalize_jit = self._jit(self._finalize)
+            yield self._finalize_jit(merged)
+
+    def _empty_output(self):
+        """Zero-group output; global aggregate over empty input still yields
+        one row (Spark semantics) — handled by faking one empty-keyed group."""
+        xp = self.xp
+        if self.grouping or self.mode == "partial":
+            schema = T.StructType(tuple(
+                T.StructField(a.name, a.dtype, True) for a in self.output))
+            b = ColumnarBatch.empty(schema)
+            if self.backend != TPU:
+                import jax
+                b = jax.tree.map(np.asarray, b)
+            return b
+        # global agg over empty input: evaluate over an all-dead batch
+        from ...columnar.column import null_column
+        cap = 8
+        slots = []
+        for f in self._agg_funcs:
+            for s in f.slots():
+                c = null_column(s.dtype, cap)
+                if s.op == COUNT:
+                    c = DeviceColumn(T.LONG, xp.zeros(cap, dtype=xp.int64),
+                                     xp.ones(cap, dtype=bool))
+                slots.append(c)
+        names = tuple(f"_s{i}" for i in range(len(slots)))
+        fake = ColumnarBatch(names, tuple(slots), xp.asarray(1, dtype=xp.int32))
+        return self._finalize(fake)
+
+    def simple_string(self):
+        g = ", ".join(e.sql() for e in self.grouping)
+        a = ", ".join(e.sql() for e in self.agg_out)
+        return f"{self.node_name()}({self.mode}) keys=[{g}] aggs=[{a}]"

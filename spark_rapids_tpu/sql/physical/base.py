@@ -1,0 +1,107 @@
+"""Physical plan base — the analog of the reference's ``GpuExec``
+(``GpuExec.scala:197``): an operator DAG whose nodes produce iterators of
+columnar batches per partition.
+
+Placement model: every exec carries ``backend`` ∈ {"tpu", "cpu"}.  TPU execs
+run jitted jnp kernels on device batches; CPU execs run the *same* kernels
+eagerly under numpy on host batches (the per-operator fallback the reference
+gets from leaving nodes on CPU Spark).  Transitions (transitions.py) move
+batches across.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ...columnar.batch import ColumnarBatch
+from ...config import RapidsConf
+from ..expressions.core import AttributeReference
+
+TPU, CPU = "tpu", "cpu"
+
+
+class TaskContext:
+    """Per-task context: metrics + conf + partition id (GpuTaskMetrics /
+    TaskContext analog)."""
+
+    def __init__(self, partition_id: int, conf: Optional[RapidsConf] = None):
+        self.partition_id = partition_id
+        self.conf = conf or RapidsConf.get_global()
+        self.metrics: Dict[str, float] = {}
+
+    def inc_metric(self, name: str, value: float = 1.0):
+        self.metrics[name] = self.metrics.get(name, 0.0) + value
+
+
+class PhysicalPlan:
+    backend: str = TPU
+
+    def __init__(self, *children: "PhysicalPlan"):
+        self.children: tuple = tuple(children)
+        self.metrics: Dict[str, float] = {}
+        self._placement_reasons: List[str] = []
+
+    # --- schema -----------------------------------------------------------
+    @property
+    def output(self) -> List[AttributeReference]:
+        raise NotImplementedError(type(self).__name__)
+
+    # --- partitioning -----------------------------------------------------
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions()
+        return 1
+
+    # --- execution --------------------------------------------------------
+    def execute(self, pid: int, tctx: TaskContext) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError(type(self).__name__)
+
+    def execute_all(self, conf: Optional[RapidsConf] = None
+                    ) -> List[ColumnarBatch]:
+        """Run every partition serially (local mode driver)."""
+        out: List[ColumnarBatch] = []
+        for pid in range(self.num_partitions()):
+            tctx = TaskContext(pid, conf)
+            with np.errstate(all="ignore"):
+                out.extend(self.execute(pid, tctx))
+        return out
+
+    # --- jit plumbing for device execs ------------------------------------
+    def _jit(self, fn):
+        """jit on the tpu backend, eager numpy on cpu."""
+        if self.backend == TPU:
+            import jax
+            return jax.jit(fn)
+        return fn
+
+    @property
+    def xp(self):
+        if self.backend == TPU:
+            import jax.numpy as jnp
+            return jnp
+        return np
+
+    # --- explain ----------------------------------------------------------
+    def node_name(self) -> str:
+        base = type(self).__name__.replace("Exec", "")
+        return ("Tpu" if self.backend == TPU else "Cpu") + base
+
+    def simple_string(self) -> str:
+        return self.node_name()
+
+    def tree_string(self, level: int = 0) -> str:
+        pad = "  " * level + ("+- " if level else "")
+        lines = [pad + self.simple_string()]
+        for r in self._placement_reasons:
+            lines.append("  " * (level + 1) + "! " + r)
+        for c in self.children:
+            lines.append(c.tree_string(level + 1))
+        return "\n".join(lines)
+
+
+def eval_context(plan: PhysicalPlan, batch: ColumnarBatch, conf=None):
+    from ..expressions.core import EvalContext
+    return EvalContext(batch, xp=plan.xp, conf=conf)

@@ -1,0 +1,350 @@
+"""Basic physical operators: scan/project/filter/range/union/limit/sample/
+expand (reference ``basicPhysicalOperators.scala``, ``GpuExpandExec.scala``,
+``limit.scala``)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...columnar.batch import ColumnarBatch
+from ...columnar.column import DeviceColumn
+from ... import types as T
+from ..expressions.core import (Alias, AttributeReference, BoundReference,
+                                EvalContext, Expression, bind_references)
+from ..plan import SortOrder
+from .base import CPU, TPU, PhysicalPlan, TaskContext
+
+
+def _to_backend_batch(batch: ColumnarBatch, backend: str) -> ColumnarBatch:
+    """Move a batch's arrays to the target backend (device upload / fetch)."""
+    import jax
+    import jax.numpy as jnp
+    conv = jnp.asarray if backend == TPU else np.asarray
+    return jax.tree.map(conv, batch)
+
+
+class InMemoryScanExec(PhysicalPlan):
+    """Scan over pre-partitioned pyarrow tables (Relation leaf +
+    HostColumnarToGpu fused: decode on host, upload once)."""
+
+    def __init__(self, attrs, partitions, backend=TPU):
+        super().__init__()
+        self.backend = backend
+        self._attrs = list(attrs)
+        self._parts = partitions  # List[pa.Table]
+
+    @property
+    def output(self):
+        return self._attrs
+
+    def num_partitions(self):
+        return len(self._parts)
+
+    def execute(self, pid: int, tctx: TaskContext):
+        from ...columnar.convert import arrow_to_device
+        table = self._parts[pid]
+        if table.num_rows == 0 and len(self._parts) > pid:
+            from ...columnar.batch import ColumnarBatch as CB
+            batch = arrow_to_device(table)
+        else:
+            batch = arrow_to_device(table)
+        yield _to_backend_batch(batch, self.backend)
+
+    def simple_string(self):
+        return f"{self.node_name()} [{', '.join(a.name for a in self._attrs)}]"
+
+
+class ProjectExec(PhysicalPlan):
+    def __init__(self, exprs: Sequence[Expression], child: PhysicalPlan,
+                 backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.exprs = list(exprs)
+        self._bound = [bind_references(e, child.output) for e in self.exprs]
+        self._out = []
+        for e in self.exprs:
+            if isinstance(e, Alias):
+                self._out.append(e.to_attribute())
+            elif isinstance(e, AttributeReference):
+                self._out.append(e)
+            else:
+                self._out.append(AttributeReference(e.sql(), e.data_type,
+                                                    e.nullable))
+        self._fn = self._jit(self._compute)
+
+    @property
+    def output(self):
+        return self._out
+
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        ctx = EvalContext(batch, xp=self.xp)
+        cols = [e.eval(ctx) for e in self._bound]
+        return ColumnarBatch(tuple(a.name for a in self._out), tuple(cols),
+                             batch.num_rows)
+
+    def execute(self, pid, tctx):
+        for batch in self.children[0].execute(pid, tctx):
+            yield self._fn(batch)
+
+    def simple_string(self):
+        return f"{self.node_name()} [{', '.join(e.sql() for e in self.exprs)}]"
+
+
+class FilterExec(PhysicalPlan):
+    """Predicate + row compaction (stable partition of live rows to the
+    front, the static-shape analog of cudf ``Table.filter``)."""
+
+    def __init__(self, condition: Expression, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.condition = condition
+        self._bound = bind_references(condition, child.output)
+        self._fn = self._jit(self._compute)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        xp = self.xp
+        ctx = EvalContext(batch, xp=xp)
+        cond = self._bound.eval(ctx)
+        keep = cond.validity & cond.data & batch.row_mask()
+        new_n = xp.sum(keep).astype(xp.int32)
+        if xp is np:
+            perm = np.argsort(~keep, kind="stable")
+        else:
+            perm = xp.argsort(~keep, stable=True)
+        cols = tuple(c.gather(perm.astype(xp.int32), keep[perm])
+                     for c in batch.columns)
+        return ColumnarBatch(batch.names, cols, new_n)
+
+    def execute(self, pid, tctx):
+        for batch in self.children[0].execute(pid, tctx):
+            yield self._fn(batch)
+
+    def simple_string(self):
+        return f"{self.node_name()} ({self.condition.sql()})"
+
+
+class RangeExec(PhysicalPlan):
+    def __init__(self, start, end, step, num_slices, backend=TPU,
+                 batch_rows: int = 1 << 20):
+        super().__init__()
+        self.backend = backend
+        self.start, self.end, self.step = start, end, step
+        self.num_slices = max(1, num_slices)
+        self.batch_rows = batch_rows
+        self._attrs = [AttributeReference("id", T.LONG, False)]
+
+    @property
+    def output(self):
+        return self._attrs
+
+    def num_partitions(self):
+        return self.num_slices
+
+    def execute(self, pid, tctx):
+        from ...columnar.column import bucket_capacity
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.num_slices)
+        lo = min(pid * per, total)
+        hi = min(lo + per, total)
+        xp = self.xp
+        pos = lo
+        while pos < hi:
+            n = min(self.batch_rows, hi - pos)
+            cap = bucket_capacity(n)
+            ids = (self.start
+                   + (xp.arange(cap, dtype=xp.int64) + pos) * self.step)
+            col = DeviceColumn(T.LONG, ids, xp.ones(cap, dtype=bool))
+            yield ColumnarBatch.make(["id"], [col], n)
+            pos += n
+
+    def simple_string(self):
+        return f"{self.node_name()} ({self.start}, {self.end}, {self.step})"
+
+
+class UnionExec(PhysicalPlan):
+    def __init__(self, children: Sequence[PhysicalPlan], backend=TPU):
+        super().__init__(*children)
+        self.backend = backend
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self):
+        return sum(c.num_partitions() for c in self.children)
+
+    def execute(self, pid, tctx):
+        for c in self.children:
+            n = c.num_partitions()
+            if pid < n:
+                out_names = tuple(a.name for a in self.output)
+                for b in c.execute(pid, tctx):
+                    yield ColumnarBatch(out_names, b.columns, b.num_rows)
+                return
+            pid -= n
+        raise IndexError("partition out of range")
+
+
+class LocalLimitExec(PhysicalPlan):
+    def __init__(self, n: int, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.n = n
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def execute(self, pid, tctx):
+        remaining = self.n
+        for batch in self.children[0].execute(pid, tctx):
+            if remaining <= 0:
+                return
+            rows = batch.num_rows_int
+            if rows <= remaining:
+                remaining -= rows
+                yield batch
+            else:
+                yield batch.sliced(0, remaining)
+                return
+
+    def simple_string(self):
+        return f"{self.node_name()} {self.n}"
+
+
+class GlobalLimitExec(PhysicalPlan):
+    """Single-partition limit with offset (planner inserts a gather-to-one
+    exchange below)."""
+
+    def __init__(self, n: int, offset: int, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.n, self.offset = n, offset
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self):
+        return 1
+
+    def execute(self, pid, tctx):
+        skipped = 0
+        remaining = self.n
+        for batch in self.children[0].execute(pid, tctx):
+            rows = batch.num_rows_int
+            if skipped < self.offset:
+                drop = min(rows, self.offset - skipped)
+                skipped += drop
+                if drop == rows:
+                    continue
+                batch = batch.sliced(drop, rows - drop)
+                rows = batch.num_rows_int
+            if remaining <= 0:
+                return
+            if rows <= remaining:
+                remaining -= rows
+                yield batch
+            else:
+                yield batch.sliced(0, remaining)
+                return
+
+
+class SampleExec(PhysicalPlan):
+    """Bernoulli sampling without replacement (reference SampleExec uses
+    per-row uniforms; with-replacement via GpuPoissonSampler is host-side)."""
+
+    def __init__(self, lower, upper, seed, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.lower, self.upper, self.seed = lower, upper, seed
+        self._fn = self._jit(self._compute) if backend == TPU else self._compute
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def _uniforms(self, batch, pid, batch_idx):
+        cap = batch.capacity
+        if self.backend == TPU:
+            import jax
+            key = jax.random.key(self.seed + pid * 1000003 + batch_idx)
+            return jax.random.uniform(key, (cap,))
+        rng = np.random.default_rng(self.seed + pid * 1000003 + batch_idx)
+        return rng.random(cap)
+
+    def _compute(self, batch, u):
+        xp = self.xp
+        keep = (u >= self.lower) & (u < self.upper) & batch.row_mask()
+        new_n = xp.sum(keep).astype(xp.int32)
+        if xp is np:
+            perm = np.argsort(~keep, kind="stable")
+        else:
+            perm = xp.argsort(~keep, stable=True)
+        cols = tuple(c.gather(perm.astype(xp.int32), keep[perm])
+                     for c in batch.columns)
+        return ColumnarBatch(batch.names, cols, new_n)
+
+    def execute(self, pid, tctx):
+        for i, batch in enumerate(self.children[0].execute(pid, tctx)):
+            u = self._uniforms(batch, pid, i)
+            yield self._fn(batch, u) if self.backend == TPU else \
+                self._compute(batch, u)
+
+
+class ExpandExec(PhysicalPlan):
+    """N projections per input row (grouping sets / rollup / cube)."""
+
+    def __init__(self, projections, out_attrs, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.projections = [
+            [bind_references(e, child.output) for e in proj]
+            for proj in projections]
+        self._out = list(out_attrs)
+        self._fns = [self._jit(self._make_compute(p)) for p in self.projections]
+
+    @property
+    def output(self):
+        return self._out
+
+    def _make_compute(self, bound_proj):
+        def compute(batch):
+            ctx = EvalContext(batch, xp=self.xp)
+            cols = [e.eval(ctx) for e in bound_proj]
+            return ColumnarBatch(tuple(a.name for a in self._out),
+                                 tuple(cols), batch.num_rows)
+        return compute
+
+    def execute(self, pid, tctx):
+        for batch in self.children[0].execute(pid, tctx):
+            for fn in self._fns:
+                yield fn(batch)
+
+
+class CoalescePartitionsExec(PhysicalPlan):
+    """Collapse N partitions into one (CoalesceExec with shuffle=false)."""
+
+    def __init__(self, n: int, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.n = max(1, n)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self):
+        return min(self.n, self.children[0].num_partitions())
+
+    def execute(self, pid, tctx):
+        child_n = self.children[0].num_partitions()
+        mine = range(pid, child_n, self.num_partitions())
+        for cpid in mine:
+            yield from self.children[0].execute(cpid, tctx)

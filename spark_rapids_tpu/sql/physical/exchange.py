@@ -1,0 +1,159 @@
+"""Shuffle & broadcast exchanges (reference ``GpuShuffleExchangeExecBase``,
+``GpuBroadcastExchangeExec``, SURVEY §2.8/§3.4).
+
+Local-mode data plane: rows are routed by a partitioner id column and
+compacted per target with static-shape gathers (the contiguousSplit analog).
+Multi-chip data plane (parallel/shuffle.py) swaps this loop for an ICI
+all-to-all under shard_map; the exec contract (materialize once, serve
+per-partition) is identical, mirroring the reference's shuffle-manager SPI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...columnar.batch import ColumnarBatch
+from ...parallel.partitioning import (HashPartitioning, Partitioning,
+                                      RangePartitioning, RoundRobinPartitioning,
+                                      SinglePartitioning)
+from ..expressions.core import EvalContext
+from .base import TPU, PhysicalPlan, TaskContext
+
+
+class ShuffleExchangeExec(PhysicalPlan):
+    def __init__(self, partitioning: Partitioning, child: PhysicalPlan,
+                 backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.partitioning = partitioning.bind(child.output)
+        self._materialized: Optional[List[List[ColumnarBatch]]] = None
+        self._split_fn = self._jit(self._split_one)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self):
+        return self.partitioning.num_partitions
+
+    # --- device kernels ---------------------------------------------------
+    def _split_one(self, batch: ColumnarBatch, pids, target: int):
+        xp = self.xp
+        keep = (pids == target) & batch.row_mask()
+        n = xp.sum(keep).astype(xp.int32)
+        if xp is np:
+            perm = np.argsort(~keep, kind="stable")
+        else:
+            perm = xp.argsort(~keep, stable=True)
+        cols = tuple(c.gather(perm.astype(xp.int32), keep[perm])
+                     for c in batch.columns)
+        return ColumnarBatch(batch.names, cols, n)
+
+    # --- materialization --------------------------------------------------
+    def _ensure_materialized(self, tctx: TaskContext):
+        if self._materialized is not None:
+            return
+        child = self.children[0]
+        nt = self.num_partitions()
+        out: List[List[ColumnarBatch]] = [[] for _ in range(nt)]
+
+        if isinstance(self.partitioning, RangePartitioning):
+            self._compute_range_bounds(tctx)
+
+        for cpid in range(child.num_partitions()):
+            for batch in child.execute(cpid, TaskContext(cpid, tctx.conf)):
+                ctx = EvalContext(batch, xp=self.xp)
+                pids = self.partitioning.partition_ids(ctx, batch, cpid)
+                if nt == 1:
+                    out[0].append(batch)
+                    continue
+                for t in range(nt):
+                    piece = self._split_fn(batch, pids, t)
+                    if piece.num_rows_int > 0:
+                        out[t].append(piece)
+        self._materialized = out
+
+    def _compute_range_bounds(self, tctx: TaskContext):
+        """Sample child output, sort sample by the orders, take quantile rows
+        as bounds (reference GpuRangePartitioner.createRangeBounds)."""
+        from .sortlimit import SortExec
+        child = self.children[0]
+        part: RangePartitioning = self.partitioning  # type: ignore
+        samples = []
+        for cpid in range(child.num_partitions()):
+            for batch in child.execute(cpid, TaskContext(cpid, tctx.conf)):
+                n = batch.num_rows_int
+                if n > 4096:  # cheap deterministic sample
+                    batch = batch.sliced(0, 4096)
+                samples.append(batch)
+        if not samples:
+            schema = self.children[0].output
+            from ... import types as T
+            from ...columnar.batch import ColumnarBatch as CB
+            empty = CB.empty(T.StructType(tuple(
+                T.StructField(a.name, a.dtype, True) for a in schema)))
+            part.set_bounds(empty)
+            return
+        merged = ColumnarBatch.concat(samples) if len(samples) > 1 else samples[0]
+        sorter = SortExec(part.orders, self.children[0], self.backend)
+        merged = sorter._fn(merged)
+        # evaluate sort keys over the sorted batch, pick boundary rows
+        ctx = EvalContext(merged, xp=self.xp)
+        key_cols = tuple(o.child.eval(ctx) for o in sorter._bound)
+        names = tuple(f"_k{i}" for i in range(len(key_cols)))
+        keys_batch = ColumnarBatch(names, key_cols, merged.num_rows)
+        n = merged.num_rows_int
+        nparts = part.num_partitions
+        idxs = [min(n - 1, max(0, (i + 1) * n // nparts))
+                for i in range(nparts - 1)] if n else []
+        rows = [keys_batch.sliced(i, 1) for i in idxs]
+        bounds = ColumnarBatch.concat(rows) if len(rows) > 1 else (
+            rows[0] if rows else keys_batch.sliced(0, 0))
+        part.set_bounds(bounds)
+
+    def execute(self, pid, tctx):
+        self._ensure_materialized(tctx)
+        yield from self._materialized[pid]
+
+    def simple_string(self):
+        return f"{self.node_name()} {self.partitioning.simple_string()}"
+
+
+class BroadcastExchangeExec(PhysicalPlan):
+    """Materialize the (small) child once as a single concatenated batch,
+    served to every consumer partition (reference serializes to host and
+    re-uploads per task; locally the device batch is just shared)."""
+
+    def __init__(self, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self._cached: Optional[ColumnarBatch] = None
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self):
+        return 1
+
+    def broadcast_batch(self, tctx: TaskContext) -> ColumnarBatch:
+        if self._cached is None:
+            batches = []
+            for cpid in range(self.children[0].num_partitions()):
+                batches.extend(self.children[0].execute(
+                    cpid, TaskContext(cpid, tctx.conf)))
+            if not batches:
+                from ... import types as T
+                schema = T.StructType(tuple(
+                    T.StructField(a.name, a.dtype, True)
+                    for a in self.output))
+                self._cached = ColumnarBatch.empty(schema)
+            else:
+                self._cached = (ColumnarBatch.concat(batches)
+                                if len(batches) > 1 else batches[0])
+        return self._cached
+
+    def execute(self, pid, tctx):
+        yield self.broadcast_batch(tctx)

@@ -1,0 +1,96 @@
+"""Sort exec (reference ``GpuSortExec.scala``: full + out-of-core sort).
+Round 1: full in-partition sort (concat batches -> one permutation gather);
+the out-of-core split/merge path arrives with the spill framework."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ...columnar.batch import ColumnarBatch
+from ...ops.sorting import sort_permutation
+from ..expressions.core import EvalContext, bind_references
+from ..plan import SortOrder
+from .base import TPU, PhysicalPlan
+
+
+class SortExec(PhysicalPlan):
+    def __init__(self, orders: Sequence[SortOrder], child: PhysicalPlan,
+                 backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.orders = list(orders)
+        self._bound = [SortOrder(bind_references(o.child, child.output),
+                                 o.ascending, o.nulls_first)
+                       for o in self.orders]
+        self._fn = self._jit(self._compute)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        xp = self.xp
+        ctx = EvalContext(batch, xp=xp)
+        specs = [(o.child.eval(ctx), o.ascending, o.nulls_first)
+                 for o in self._bound]
+        perm = sort_permutation(xp, specs, batch.row_mask())
+        live = xp.arange(batch.capacity, dtype=xp.int32) < batch.num_rows
+        cols = tuple(c.gather(perm, live) for c in batch.columns)
+        return ColumnarBatch(batch.names, cols, batch.num_rows)
+
+    def execute(self, pid, tctx):
+        batches = list(self.children[0].execute(pid, tctx))
+        if not batches:
+            return
+        merged = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
+        yield self._fn(merged)
+
+    def simple_string(self):
+        return f"{self.node_name()} [{', '.join(o.sql() for o in self.orders)}]"
+
+
+class TakeOrderedAndProjectExec(PhysicalPlan):
+    """ORDER BY + LIMIT fusion (reference composes TopN in the rule,
+    ``GpuOverrides.scala:3880-3904``)."""
+
+    def __init__(self, n: int, orders, project_exprs, child, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.n = n
+        self._sort = SortExec(orders, child, backend)
+        self.project_exprs = project_exprs
+
+    @property
+    def output(self):
+        if self.project_exprs is None:
+            return self.children[0].output
+        from .basic import ProjectExec
+        return ProjectExec(self.project_exprs, self.children[0],
+                           self.backend).output
+
+    def num_partitions(self):
+        return 1
+
+    def execute(self, pid, tctx):
+        # local top-n per child partition, then merge
+        tops = []
+        for cpid in range(self.children[0].num_partitions()):
+            for b in self._sort.execute(cpid, tctx):
+                tops.append(b.sliced(0, min(self.n, b.num_rows_int)))
+        if not tops:
+            return
+        merged = ColumnarBatch.concat(tops) if len(tops) > 1 else tops[0]
+        final = self._sort._fn(merged)
+        final = final.sliced(0, min(self.n, final.num_rows_int))
+        if self.project_exprs is not None:
+            from .basic import ProjectExec
+            from ..expressions.core import EvalContext
+            bound = [bind_references(e, self.children[0].output)
+                     for e in self.project_exprs]
+            ctx = EvalContext(final, xp=self.xp)
+            cols = tuple(e.eval(ctx) for e in bound)
+            names = tuple(a.name for a in self.output)
+            final = ColumnarBatch(names, cols, final.num_rows)
+        yield final

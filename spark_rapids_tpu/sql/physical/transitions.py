@@ -1,0 +1,103 @@
+"""Backend transitions + batch coalescing (reference
+``GpuRowToColumnarExec``/``GpuColumnarToRowExec``/``HostColumnarToGpu``/
+``GpuCoalesceBatches``; SURVEY §2.2).
+
+Here both backends are columnar (host = numpy, device = jnp), so transitions
+are pure buffer moves: one ``device_put`` per column upload, one fetch per
+download — no row format in the middle.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from ...columnar.batch import ColumnarBatch
+from .base import CPU, TPU, PhysicalPlan, TaskContext
+
+
+def batch_nbytes(batch: ColumnarBatch) -> int:
+    total = 0
+    for c in batch.columns:
+        for arr in (c.data, c.validity, c.lengths, c.aux):
+            if arr is not None:
+                total += arr.size * arr.dtype.itemsize
+    return total
+
+
+class HostToDeviceExec(PhysicalPlan):
+    backend = TPU
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__(child)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def execute(self, pid, tctx):
+        import jax
+        import jax.numpy as jnp
+        for batch in self.children[0].execute(pid, tctx):
+            tctx.inc_metric("h2d_bytes", batch_nbytes(batch))
+            yield jax.tree.map(jnp.asarray, batch)
+
+    def node_name(self):
+        return "HostToDevice"
+
+
+class DeviceToHostExec(PhysicalPlan):
+    backend = CPU
+
+    def __init__(self, child: PhysicalPlan):
+        super().__init__(child)
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def execute(self, pid, tctx):
+        import jax
+        for batch in self.children[0].execute(pid, tctx):
+            tctx.inc_metric("d2h_bytes", batch_nbytes(batch))
+            yield jax.tree.map(np.asarray, batch)
+
+    def node_name(self):
+        return "DeviceToHost"
+
+
+class CoalesceBatchesExec(PhysicalPlan):
+    """Accumulate small batches up to a target size before handing them to
+    size-sensitive operators (the central batching invariant of the
+    reference, ``GpuCoalesceBatches.scala`` TargetSize goal)."""
+
+    def __init__(self, child: PhysicalPlan, target_rows: int = 1 << 20,
+                 target_bytes: int = 1 << 30, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.target_rows = target_rows
+        self.target_bytes = target_bytes
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def execute(self, pid, tctx):
+        pending: List[ColumnarBatch] = []
+        rows = 0
+        nbytes = 0
+        for batch in self.children[0].execute(pid, tctx):
+            n = batch.num_rows_int
+            if n == 0:
+                continue
+            pending.append(batch)
+            rows += n
+            nbytes += batch_nbytes(batch)
+            if rows >= self.target_rows or nbytes >= self.target_bytes:
+                yield (ColumnarBatch.concat(pending) if len(pending) > 1
+                       else pending[0])
+                pending, rows, nbytes = [], 0, 0
+        if pending:
+            yield (ColumnarBatch.concat(pending) if len(pending) > 1
+                   else pending[0])

@@ -1,0 +1,323 @@
+"""Logical plan nodes.
+
+The reference plugs into Spark Catalyst and rewrites *physical* plans
+(SURVEY §2.2); standalone, we own the whole stack, so this module is the
+Catalyst-equivalent logical algebra the DataFrame API builds, the analyzer
+resolves, and the planner lowers to physical execs.  Node set mirrors the
+exec coverage in ``GpuOverrides.scala:3805-4184``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from .expressions.core import (Alias, AttributeReference, Expression, Literal)
+
+
+@dataclass(eq=False)
+class SortOrder:
+    child: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # default: nulls first iff ascending
+
+    def __post_init__(self):
+        if self.nulls_first is None:
+            self.nulls_first = self.ascending
+
+    def sql(self):
+        d = "ASC" if self.ascending else "DESC"
+        n = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
+        return f"{self.child.sql()} {d} {n}"
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def output(self) -> List[AttributeReference]:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def schema(self) -> T.StructType:
+        return T.StructType(tuple(
+            T.StructField(a.name, a.dtype, a.nullable) for a in self.output))
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def simple_string(self) -> str:
+        return self.node_name()
+
+    def tree_string(self, level: int = 0) -> str:
+        s = "  " * level + ("+- " if level else "") + self.simple_string()
+        return "\n".join([s] + [c.tree_string(level + 1) for c in self.children])
+
+
+@dataclass(eq=False)
+class Relation(LogicalPlan):
+    """In-memory relation over a pyarrow Table (optionally pre-partitioned)."""
+    table: Any = None  # pa.Table
+    partitions: Optional[List[Any]] = None  # list of pa.Table
+
+    @property
+    def output(self):
+        if not hasattr(self, "_output"):
+            self._output = [
+                AttributeReference(f.name, T.from_arrow(f.type), f.nullable)
+                for f in self.table.schema]
+        return self._output
+
+    def simple_string(self):
+        return f"Relation [{', '.join(a.name for a in self.output)}]"
+
+
+@dataclass(eq=False)
+class ScanRelation(LogicalPlan):
+    """File-source relation (Parquet/ORC/CSV/JSON/Avro)."""
+    fmt: str = "parquet"
+    paths: Tuple[str, ...] = ()
+    read_schema: Optional[T.StructType] = None
+    options: dict = field(default_factory=dict)
+
+    @property
+    def output(self):
+        if not hasattr(self, "_output"):
+            if self.read_schema is None:
+                from ..io_.registry import infer_schema
+                self.read_schema = infer_schema(self.fmt, self.paths,
+                                                self.options)
+            self._output = [AttributeReference(f.name, f.data_type, f.nullable)
+                            for f in self.read_schema.fields]
+        return self._output
+
+    def simple_string(self):
+        return f"Scan {self.fmt} {list(self.paths)[:1]}"
+
+
+@dataclass(eq=False)
+class Range(LogicalPlan):
+    start: int = 0
+    end: int = 0
+    step: int = 1
+    num_slices: int = 1
+
+    @property
+    def output(self):
+        if not hasattr(self, "_output"):
+            self._output = [AttributeReference("id", T.LONG, False)]
+        return self._output
+
+    def simple_string(self):
+        return f"Range ({self.start}, {self.end}, step={self.step})"
+
+
+@dataclass(eq=False)
+class Project(LogicalPlan):
+    exprs: Tuple[Expression, ...] = ()
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        out = []
+        for e in self.exprs:
+            if isinstance(e, Alias):
+                out.append(e.to_attribute())
+            elif isinstance(e, AttributeReference):
+                out.append(e)
+            else:
+                out.append(AttributeReference(e.sql(), e.data_type, e.nullable))
+        return out
+
+    def simple_string(self):
+        return f"Project [{', '.join(e.sql() for e in self.exprs)}]"
+
+
+@dataclass(eq=False)
+class Filter(LogicalPlan):
+    condition: Expression = None  # type: ignore
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def simple_string(self):
+        return f"Filter ({self.condition.sql()})"
+
+
+@dataclass(eq=False)
+class Aggregate(LogicalPlan):
+    grouping: Tuple[Expression, ...] = ()
+    aggregates: Tuple[Expression, ...] = ()  # output exprs incl. group refs
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        out = []
+        for e in self.aggregates:
+            if isinstance(e, Alias):
+                out.append(e.to_attribute())
+            elif isinstance(e, AttributeReference):
+                out.append(e)
+            else:
+                out.append(AttributeReference(e.sql(), e.data_type, e.nullable))
+        return out
+
+    def simple_string(self):
+        g = ", ".join(e.sql() for e in self.grouping)
+        a = ", ".join(e.sql() for e in self.aggregates)
+        return f"Aggregate [{g}] [{a}]"
+
+
+@dataclass(eq=False)
+class Sort(LogicalPlan):
+    orders: Tuple[SortOrder, ...] = ()
+    is_global: bool = True
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def simple_string(self):
+        return f"Sort [{', '.join(o.sql() for o in self.orders)}] global={self.is_global}"
+
+
+@dataclass(eq=False)
+class Limit(LogicalPlan):
+    n: int = 0
+    offset: int = 0
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def simple_string(self):
+        return f"Limit {self.n}"
+
+
+@dataclass(eq=False)
+class Union(LogicalPlan):
+    inputs: Tuple[LogicalPlan, ...] = ()
+
+    def __post_init__(self):
+        self.children = tuple(self.inputs)
+
+    @property
+    def output(self):
+        first = self.children[0].output
+        return [AttributeReference(a.name, a.dtype,
+                                   any(c.output[i].nullable for c in self.children))
+                for i, a in enumerate(first)]
+
+
+@dataclass(eq=False)
+class Join(LogicalPlan):
+    left: LogicalPlan = None  # type: ignore
+    right: LogicalPlan = None  # type: ignore
+    how: str = "inner"  # inner|left|right|full|left_semi|left_anti|cross
+    left_keys: Tuple[Expression, ...] = ()
+    right_keys: Tuple[Expression, ...] = ()
+    condition: Optional[Expression] = None  # non-equi residual
+
+    def __post_init__(self):
+        self.children = (self.left, self.right)
+
+    @property
+    def output(self):
+        how = self.how
+        lo = list(self.left.output)
+        ro = list(self.right.output)
+        if how in ("left_semi", "left_anti"):
+            return lo
+        if how == "left":
+            ro = [AttributeReference(a.name, a.dtype, True, a.expr_id) for a in ro]
+        if how == "right":
+            lo = [AttributeReference(a.name, a.dtype, True, a.expr_id) for a in lo]
+        if how == "full":
+            lo = [AttributeReference(a.name, a.dtype, True, a.expr_id) for a in lo]
+            ro = [AttributeReference(a.name, a.dtype, True, a.expr_id) for a in ro]
+        return lo + ro
+
+    def simple_string(self):
+        keys = ", ".join(f"{l.sql()}={r.sql()}" for l, r in
+                         zip(self.left_keys, self.right_keys))
+        return f"Join {self.how} [{keys}]"
+
+
+@dataclass(eq=False)
+class Expand(LogicalPlan):
+    projections: Tuple[Tuple[Expression, ...], ...] = ()
+    out_attrs: Tuple[AttributeReference, ...] = ()
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return list(self.out_attrs)
+
+
+@dataclass(eq=False)
+class Sample(LogicalPlan):
+    lower: float = 0.0
+    upper: float = 0.1
+    with_replacement: bool = False
+    seed: int = 0
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return self.child.output
+
+
+@dataclass(eq=False)
+class Repartition(LogicalPlan):
+    num_partitions: int = 0
+    exprs: Tuple[Expression, ...] = ()  # empty -> round robin
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return self.child.output
+
+
+@dataclass(eq=False)
+class Generate(LogicalPlan):
+    """explode/posexplode over array columns."""
+    generator: Expression = None  # type: ignore
+    outer: bool = False
+    gen_output: Tuple[AttributeReference, ...] = ()
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return list(self.child.output) + list(self.gen_output)

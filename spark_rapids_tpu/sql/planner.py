@@ -1,0 +1,150 @@
+"""Logical -> physical planning with device placement and transition
+insertion (the reference splits this across Catalyst planning +
+``GpuOverrides.doConvertPlan`` + ``GpuTransitionOverrides``; SURVEY §3.2)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import RapidsConf
+from ..parallel.partitioning import (HashPartitioning, RangePartitioning,
+                                     RoundRobinPartitioning, SinglePartitioning)
+from . import plan as P
+from .expressions.core import AttributeReference
+from .overrides import PlanMeta, TpuOverrides
+from .physical.aggregate import HashAggregateExec
+from .physical.base import CPU, TPU, PhysicalPlan
+from .physical.basic import (CoalescePartitionsExec, ExpandExec, FilterExec,
+                             GlobalLimitExec, InMemoryScanExec, LocalLimitExec,
+                             ProjectExec, RangeExec, SampleExec, UnionExec)
+from .physical.exchange import BroadcastExchangeExec, ShuffleExchangeExec
+from .physical.sortlimit import SortExec, TakeOrderedAndProjectExec
+from .physical.transitions import (CoalesceBatchesExec, DeviceToHostExec,
+                                   HostToDeviceExec)
+
+
+class Planner:
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self.conf = conf or RapidsConf.get_global()
+
+    # ------------------------------------------------------------------
+    def plan(self, logical: P.LogicalPlan) -> PhysicalPlan:
+        meta = TpuOverrides.apply(logical, self.conf)
+        if self.conf.is_explain_only:
+            _force_cpu(meta)
+        phys = self._convert(meta)
+        phys = _insert_transitions(phys)
+        return phys
+
+    def plan_for_collect(self, logical: P.LogicalPlan) -> PhysicalPlan:
+        phys = self.plan(logical)
+        if phys.backend == TPU:
+            phys = DeviceToHostExec(phys)
+        return phys
+
+    # ------------------------------------------------------------------
+    def _convert(self, meta: PlanMeta) -> PhysicalPlan:
+        node = meta.node
+        be = meta.backend
+        kids = [self._convert(c) for c in meta.children]
+
+        if isinstance(node, P.Relation):
+            parts = node.partitions if node.partitions is not None else [node.table]
+            exec_ = InMemoryScanExec(node.output, parts, backend=be)
+        elif isinstance(node, P.ScanRelation):
+            from ..io_.exec import FileScanExec
+            exec_ = FileScanExec(node, backend=be, conf=self.conf)
+        elif isinstance(node, P.Range):
+            exec_ = RangeExec(node.start, node.end, node.step, node.num_slices,
+                              backend=be)
+        elif isinstance(node, P.Project):
+            exec_ = ProjectExec(node.exprs, kids[0], backend=be)
+        elif isinstance(node, P.Filter):
+            exec_ = FilterExec(node.condition, kids[0], backend=be)
+        elif isinstance(node, P.Sample):
+            exec_ = SampleExec(node.lower, node.upper, node.seed, kids[0],
+                               backend=be)
+        elif isinstance(node, P.Expand):
+            exec_ = ExpandExec(node.projections, node.out_attrs, kids[0],
+                               backend=be)
+        elif isinstance(node, P.Union):
+            kids = [_coerce_backend(k, kids[0].backend) for k in kids]
+            exec_ = UnionExec(kids, backend=kids[0].backend)
+        elif isinstance(node, P.Aggregate):
+            exec_ = self._plan_aggregate(node, kids[0], be)
+        elif isinstance(node, P.Sort):
+            exec_ = self._plan_sort(node, kids[0], be)
+        elif isinstance(node, P.Limit):
+            exec_ = self._plan_limit(node, kids[0], be)
+        elif isinstance(node, P.Repartition):
+            if node.exprs:
+                part = HashPartitioning(node.exprs, node.num_partitions)
+            else:
+                part = RoundRobinPartitioning(node.num_partitions)
+            exec_ = ShuffleExchangeExec(part, kids[0], backend=kids[0].backend)
+        elif isinstance(node, P.Join):
+            from .physical.join import plan_join
+            exec_ = plan_join(node, kids[0], kids[1], be, self.conf)
+        else:
+            raise NotImplementedError(
+                f"no physical plan for {type(node).__name__}")
+
+        exec_._placement_reasons = list(dict.fromkeys(meta.reasons))
+        return exec_
+
+    # ------------------------------------------------------------------
+    def _plan_aggregate(self, node: P.Aggregate, child: PhysicalPlan, be):
+        nparts = child.num_partitions()
+        if nparts <= 1:
+            return HashAggregateExec(node.grouping, node.aggregates,
+                                     "complete", child, backend=be)
+        partial = HashAggregateExec(node.grouping, node.aggregates, "partial",
+                                    child, backend=be)
+        if node.grouping:
+            key_refs = partial.output[:len(node.grouping)]
+            part = HashPartitioning(
+                key_refs, int(self.conf.shuffle_partitions))
+        else:
+            part = SinglePartitioning()
+        shuffled = ShuffleExchangeExec(part, partial, backend=be)
+        return HashAggregateExec(node.grouping, node.aggregates, "final",
+                                 shuffled, backend=be)
+
+    def _plan_sort(self, node: P.Sort, child: PhysicalPlan, be):
+        if node.is_global and child.num_partitions() > 1:
+            part = RangePartitioning(node.orders, child.num_partitions())
+            child = ShuffleExchangeExec(part, child, backend=be)
+        return SortExec(node.orders, child, backend=be)
+
+    def _plan_limit(self, node: P.Limit, child: PhysicalPlan, be):
+        local = LocalLimitExec(node.n + node.offset, child, backend=be)
+        if child.num_partitions() > 1:
+            gathered = ShuffleExchangeExec(SinglePartitioning(), local,
+                                           backend=be)
+        else:
+            gathered = local
+        return GlobalLimitExec(node.n, node.offset, gathered, backend=be)
+
+
+def _force_cpu(meta: PlanMeta):
+    meta.backend = "cpu"
+    for c in meta.children:
+        _force_cpu(c)
+
+
+def _coerce_backend(plan: PhysicalPlan, backend: str) -> PhysicalPlan:
+    if plan.backend == backend:
+        return plan
+    return HostToDeviceExec(plan) if backend == TPU else DeviceToHostExec(plan)
+
+
+def _insert_transitions(plan: PhysicalPlan) -> PhysicalPlan:
+    new_children = tuple(_insert_transitions(c) for c in plan.children)
+    fixed = []
+    for c in new_children:
+        if c.backend != plan.backend and not isinstance(
+                plan, (DeviceToHostExec, HostToDeviceExec)):
+            c = HostToDeviceExec(c) if plan.backend == TPU else DeviceToHostExec(c)
+        fixed.append(c)
+    plan.children = tuple(fixed)
+    return plan

@@ -1,0 +1,185 @@
+"""TpuSession — the SparkSession-equivalent entry point (reference:
+``SQLPlugin`` + driver/executor plugin init, SURVEY §2.1, recast for a
+standalone engine: device init happens lazily on first TPU exec)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..config import RapidsConf
+from . import plan as P
+from .dataframe import DataFrame
+from .planner import Planner
+
+
+class SessionConf:
+    def __init__(self, conf: RapidsConf):
+        self._conf = conf
+
+    def set(self, key: str, value) -> None:
+        self._conf.set(key, value)
+
+    def get(self, key: str, default=None):
+        return self._conf.get(key, default)
+
+
+class TpuSession:
+    _lock = threading.Lock()
+    _active: Optional["TpuSession"] = None
+
+    def __init__(self, conf: Optional[RapidsConf] = None, **conf_kwargs):
+        base = conf or RapidsConf.get_global()
+        self._conf = base.copy(conf_kwargs or None)
+        self.conf = SessionConf(self._conf)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def get_or_create(cls, conf=None, **conf_kwargs) -> "TpuSession":
+        with cls._lock:
+            if cls._active is None or conf is not None or conf_kwargs:
+                cls._active = TpuSession(conf, **conf_kwargs)
+            return cls._active
+
+    # ------------------------------------------------------------------
+    # data sources
+    # ------------------------------------------------------------------
+    def create_dataframe(self, data, schema=None, num_partitions: int = 1
+                         ) -> DataFrame:
+        table = _to_arrow_table(data, schema)
+        parts = _split_table(table, num_partitions)
+        rel = P.Relation(table, parts if num_partitions > 1 else None)
+        return DataFrame(rel, self)
+
+    createDataFrame = create_dataframe
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_slices: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(P.Range(start, end, step, num_slices), self)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _execute(self, logical: P.LogicalPlan) -> pa.Table:
+        from ..columnar.convert import device_to_arrow
+        planner = Planner(self._conf)
+        phys = planner.plan_for_collect(logical)
+        batches = phys.execute_all(self._conf)
+        tables = [device_to_arrow(b) for b in batches if b.num_rows_int > 0]
+        arrow_schema = pa.schema([
+            pa.field(a.name, T.to_arrow(a.dtype)) for a in logical.output])
+        if not tables:
+            return arrow_schema.empty_table()
+        out = pa.concat_tables([t.cast(arrow_schema) for t in tables])
+        return out
+
+    def physical_plan(self, df: DataFrame):
+        return Planner(self._conf).plan_for_collect(df._plan)
+
+    def explain(self, df: DataFrame, all_ops: bool = True) -> str:
+        """Placement report (spark.rapids.sql.explain=ALL equivalent) plus
+        the physical tree."""
+        from .overrides import TpuOverrides
+        meta = TpuOverrides.apply(df._plan, self._conf)
+        phys = Planner(self._conf).plan_for_collect(df._plan)
+        return (meta.explain(all_ops) + "\n\nPhysical plan:\n"
+                + phys.tree_string())
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSession):
+        self._session = session
+        self._options: Dict[str, Any] = {}
+        self._schema: Optional[T.StructType] = None
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def options(self, **kwargs) -> "DataFrameReader":
+        self._options.update(kwargs)
+        return self
+
+    def schema(self, s: T.StructType) -> "DataFrameReader":
+        self._schema = s
+        return self
+
+    def _scan(self, fmt: str, paths) -> DataFrame:
+        if isinstance(paths, str):
+            paths = [paths]
+        rel = P.ScanRelation(fmt, tuple(paths), self._schema,
+                             dict(self._options))
+        return DataFrame(rel, self._session)
+
+    def parquet(self, *paths) -> DataFrame:
+        return self._scan("parquet", list(paths))
+
+    def orc(self, *paths) -> DataFrame:
+        return self._scan("orc", list(paths))
+
+    def csv(self, *paths) -> DataFrame:
+        return self._scan("csv", list(paths))
+
+    def json(self, *paths) -> DataFrame:
+        return self._scan("json", list(paths))
+
+    def avro(self, *paths) -> DataFrame:
+        return self._scan("avro", list(paths))
+
+    def format(self, fmt: str):
+        reader = self
+
+        class _F:
+            def load(self_inner, *paths):
+                return reader._scan(fmt, list(paths))
+        return _F()
+
+
+def _to_arrow_table(data, schema) -> pa.Table:
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):
+        return pa.table(data)
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(data, list):
+        if schema is None:
+            raise ValueError("schema required for list-of-rows input")
+        if isinstance(schema, (list, tuple)):
+            names = list(schema)
+            cols = list(zip(*data)) if data else [[] for _ in names]
+            return pa.table({n: list(c) for n, c in zip(names, cols)})
+        arrow_schema = pa.schema([
+            pa.field(f.name, T.to_arrow(f.data_type), f.nullable)
+            for f in schema.fields])
+        cols = list(zip(*data)) if data else [[] for _ in schema.fields]
+        arrays = [pa.array(list(c), type=fldt.type)
+                  for c, fldt in zip(cols, arrow_schema)]
+        return pa.Table.from_arrays(arrays, schema=arrow_schema)
+    raise TypeError(f"cannot create DataFrame from {type(data)}")
+
+
+def _split_table(table: pa.Table, n: int) -> List[pa.Table]:
+    n = max(1, n)
+    rows = table.num_rows
+    per = -(-rows // n) if rows else 0
+    parts = []
+    for i in range(n):
+        lo = min(i * per, rows)
+        hi = min(lo + per, rows)
+        parts.append(table.slice(lo, hi - lo))
+    return parts

@@ -1,0 +1,78 @@
+"""TypeSig — declarative per-op type-support matrix (reference
+``TypeChecks.scala`` 2441 LoC: powers tagging, docs and the tools CSVs).
+
+A TypeSig names which logical types an operator/expression supports on the
+accelerator.  Checks produce human-readable reasons used by explain() and
+the fallback tagging, exactly like the reference's ``willNotWorkOnGpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set, Type
+
+from .. import types as T
+
+_ALL_BASIC = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+              T.LongType, T.FloatType, T.DoubleType, T.StringType,
+              T.BinaryType, T.DateType, T.TimestampType, T.DecimalType,
+              T.NullType)
+
+
+class TypeSig:
+    def __init__(self, classes: Iterable[type], nested: Optional["TypeSig"] = None,
+                 note: str = ""):
+        self.classes = tuple(classes)
+        self.nested = nested
+        self.note = note
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(tuple(set(self.classes + other.classes)),
+                       self.nested or other.nested)
+
+    def supports(self, dt: T.DataType) -> Optional[str]:
+        """None if supported, else a reason string."""
+        if isinstance(dt, T.StructType):
+            if T.StructType not in self.classes:
+                return f"{dt.simple_string()} is not supported"
+            inner = self.nested or self
+            for f in dt.fields:
+                r = inner.supports(f.data_type)
+                if r:
+                    return r
+            return None
+        if isinstance(dt, (T.ArrayType, T.MapType)):
+            if type(dt) not in self.classes:
+                return f"{dt.simple_string()} is not supported"
+            inner = self.nested or self
+            if isinstance(dt, T.ArrayType):
+                return inner.supports(dt.element_type)
+            return (inner.supports(dt.key_type)
+                    or inner.supports(dt.value_type))
+        if isinstance(dt, self.classes):
+            return None
+        return f"{dt.simple_string()} is not supported"
+
+
+def sig(*classes) -> TypeSig:
+    return TypeSig(classes)
+
+
+BOOLEAN = sig(T.BooleanType)
+INTEGRAL = sig(T.ByteType, T.ShortType, T.IntegerType, T.LongType)
+FP = sig(T.FloatType, T.DoubleType)
+DECIMAL = sig(T.DecimalType)
+NUMERIC = INTEGRAL + FP + DECIMAL
+STRING = sig(T.StringType)
+BINARY = sig(T.BinaryType)
+DATETIME = sig(T.DateType, T.TimestampType)
+NULL = sig(T.NullType)
+ORDERABLE = NUMERIC + STRING + DATETIME + BOOLEAN + NULL
+COMPARABLE = ORDERABLE
+BASIC = TypeSig(_ALL_BASIC)
+STRUCT = sig(T.StructType)
+ALL_DEVICE = BASIC + TypeSig((T.StructType,), nested=BASIC)
+# host engine supports everything incl. arrays/maps
+EVERYTHING = ALL_DEVICE + TypeSig((T.ArrayType, T.MapType),
+                                  nested=TypeSig(_ALL_BASIC + (T.ArrayType,
+                                                               T.StructType,
+                                                               T.MapType)))

@@ -132,6 +132,19 @@ def test_sliced_arrow_string_input():
     assert cv.device_column_to_arrow(col, 3).to_pylist() == ["bbb", "cccc", "dd"]
 
 
-def test_list_column_clear_error():
-    with pytest.raises(NotImplementedError):
-        arrow_to_device(pa.table({"l": pa.array([[1, 2], [3]])}))
+def test_list_column_host_object_roundtrip():
+    # nested arrays ride as host object columns (CPU fallback path)
+    vals = [[1, 2], None, [3]]
+    t = pa.table({"l": pa.array(vals)})
+    assert roundtrip(t).column("l").to_pylist() == vals
+
+
+def test_object_column_concat_and_repad():
+    # host nested columns must survive concat/slice/repad (code-review regression)
+    vals = [[1, 2], None, [3], [4, 5, 6]]
+    b = arrow_to_device(pa.table({"l": pa.array(vals)}))
+    cat = ColumnarBatch.concat([b.sliced(0, 2), b.sliced(2, 2)])
+    assert device_to_arrow(cat).column("l").to_pylist() == vals
+    assert device_to_arrow(b.repadded(16)).column("l").to_pylist() == vals
+    with pytest.raises(ValueError):
+        ColumnarBatch.concat([])
