@@ -112,6 +112,11 @@ SESSION_TIMEZONE = register(
     "mirroring the reference's UTC-only timezone check).", "UTC")
 SHUFFLE_PARTITIONS = register(
     "spark.sql.shuffle.partitions", "Default shuffle partition count.", 8)
+AUTO_BROADCAST_THRESHOLD = register(
+    "spark.rapids.sql.autoBroadcastJoinThreshold",
+    "Maximum build-side size in bytes for which an equi-join uses a "
+    "broadcast hash join instead of a shuffled hash join.",
+    10 * 1024 * 1024, commonly_used=True)
 
 # --- memory / runtime -------------------------------------------------------
 ALLOC_FRACTION = register(

@@ -1,0 +1,194 @@
+"""Equi-join kernels — the TPU replacement for cuDF's hash join.
+
+The reference builds device gather maps with a hash join
+(``GpuHashJoin.scala:298``) and then gathers output rows lazily in
+target-sized chunks (``JoinGatherer.scala``).  Hash tables don't map to
+XLA (dynamic shapes, scatter contention), so key equality is established
+with *exact dense ranks* (ops/ranks.py): concatenate both sides' key
+columns, dense-rank the union — equal rank <=> equal key, collision-free —
+then find each probe row's match range in the rank-sorted build side with
+two vectorized binary searches.  Pair enumeration is a third binary search
+over the prefix-sum of match counts.  Everything is static-shape sorts,
+searches and gathers that XLA lowers well to TPU.
+
+Two phases, mirroring the reference's count-then-gather contract:
+* ``join_build`` (jittable per capacity pair) -> match info + output-size
+  scalars the host reads to pick the output capacity bucket;
+* ``gather_pairs`` (jittable per output bucket) -> left/right gather maps
+  with validity (False = null side of an outer-join miss).
+
+Join-key NULL semantics: SQL equality never matches NULL, so live rows with
+a null key get sentinel ranks (-1 probe / -2 build) that cannot collide.
+Dead padding rows are likewise sentineled out.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from ..columnar.column import DeviceColumn
+from .ranks import dense_rank_columns, stable_argsort
+
+
+def concat_full_columns(xp, a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
+    """Concatenate two columns at FULL capacity (padding rows included) —
+    static-shape, so it is legal inside jit.  Dead rows are masked by the
+    caller via the combined row mask."""
+    data = None
+    if a.data is not None:
+        da, db = a.data, b.data
+        if da.ndim == 2:
+            w = max(da.shape[1], db.shape[1])
+            if da.shape[1] < w:
+                da = xp.pad(da, ((0, 0), (0, w - da.shape[1])))
+            if db.shape[1] < w:
+                db = xp.pad(db, ((0, 0), (0, w - db.shape[1])))
+        data = xp.concatenate([da, db], axis=0)
+    validity = xp.concatenate([a.validity, b.validity])
+    lengths = (xp.concatenate([a.lengths, b.lengths])
+               if a.lengths is not None else None)
+    aux = xp.concatenate([a.aux, b.aux]) if a.aux is not None else None
+    children = tuple(concat_full_columns(xp, ca, cb)
+                     for ca, cb in zip(a.children, b.children))
+    return DeviceColumn(a.dtype, data, validity, lengths, aux, children)
+
+
+def compact_indices(xp, flags):
+    """int32 indices of True flags, compacted to the front (stable)."""
+    perm = stable_argsort(xp, (~flags).astype(xp.int8))
+    return perm.astype(xp.int32)
+
+
+class JoinInfo(NamedTuple):
+    """Device-resident match info between one probe batch and the build
+    table (all arrays static-shape in (probe_cap, build_cap))."""
+    counts: "np.ndarray"        # int64[lcap] matches per probe row
+    csum: "np.ndarray"          # int64[lcap] inclusive prefix sum of counts
+    lo: "np.ndarray"            # int64[lcap] match-range start in sorted build
+    perm_b: "np.ndarray"        # int32[rcap] build rows sorted by rank
+    l_unmatched: "np.ndarray"   # bool[lcap] live probe rows with no match
+    b_unmatched: "np.ndarray"   # bool[rcap] live build rows with no match
+    total: "np.ndarray"         # int64 scalar: total inner pairs
+    n_unmatched_l: "np.ndarray"  # int64 scalar
+    n_unmatched_b: "np.ndarray"  # int64 scalar
+
+
+def _sentinel_ranks(xp, rank, key_cols: Sequence[DeviceColumn], mask, sentinel):
+    """Replace ranks of dead rows and null-keyed rows with a sentinel that
+    cannot match the other side."""
+    bad = ~mask
+    for c in key_cols:
+        if c.validity is not None:
+            bad = bad | ~c.validity
+    return xp.where(bad, xp.asarray(sentinel, dtype=rank.dtype), rank)
+
+
+def join_build(xp, lkeys: Sequence[DeviceColumn], rkeys: Sequence[DeviceColumn],
+               lmask, rmask, null_safe: bool = False) -> JoinInfo:
+    """Phase 1: compute match structure.  Jittable; host reads the three
+    scalar totals to size the output bucket.  ``null_safe=True`` gives <=>
+    semantics (null keys equal each other)."""
+    lcap = lmask.shape[0]
+    rcap = rmask.shape[0]
+    combined = [concat_full_columns(xp, a, b) for a, b in zip(lkeys, rkeys)]
+    mask = xp.concatenate([lmask, rmask])
+    rank = dense_rank_columns(xp, combined, mask)
+    if null_safe:
+        lrank = _sentinel_ranks(xp, rank[:lcap], [], lmask, -1)
+        rrank = _sentinel_ranks(xp, rank[lcap:], [], rmask, -2)
+    else:
+        lrank = _sentinel_ranks(xp, rank[:lcap], lkeys, lmask, -1)
+        rrank = _sentinel_ranks(xp, rank[lcap:], rkeys, rmask, -2)
+
+    perm_b = stable_argsort(xp, rrank).astype(xp.int32)
+    sb = rrank[perm_b]
+    lo = xp.searchsorted(sb, lrank, side="left")
+    hi = xp.searchsorted(sb, lrank, side="right")
+    counts = (hi - lo).astype(xp.int64)
+    csum = xp.cumsum(counts)
+    total = csum[lcap - 1] if lcap else xp.asarray(0, dtype=xp.int64)
+
+    sp = xp.sort(lrank)
+    plo = xp.searchsorted(sp, rrank, side="left")
+    phi = xp.searchsorted(sp, rrank, side="right")
+    b_matched = (phi - plo) > 0
+    l_unmatched = lmask & (counts == 0)
+    b_unmatched = rmask & ~b_matched
+    n_unl = xp.sum(l_unmatched.astype(xp.int64))
+    n_unb = xp.sum(b_unmatched.astype(xp.int64))
+    return JoinInfo(counts, csum, lo, perm_b, l_unmatched, b_unmatched,
+                    total, n_unl, n_unb)
+
+
+class PairMaps(NamedTuple):
+    """Gather maps for a join output batch of static capacity out_cap."""
+    l_idx: "np.ndarray"   # int32[out_cap]
+    r_idx: "np.ndarray"   # int32[out_cap]
+    l_ok: "np.ndarray"    # bool[out_cap]  False -> left side null (right/full)
+    r_ok: "np.ndarray"    # bool[out_cap]  False -> right side null (left/full)
+    num_out: "np.ndarray"  # int32 scalar
+
+
+def gather_pairs(xp, info: JoinInfo, out_cap: int,
+                 with_unmatched_left: bool = False,
+                 with_unmatched_right: bool = False) -> PairMaps:
+    """Phase 2: enumerate output rows.  Layout: [inner pairs][unmatched left]
+    [unmatched right] — segment starts are traced scalars, segment membership
+    is a per-slot compare, so the whole thing stays static-shape."""
+    lcap = info.counts.shape[0]
+    rcap = info.perm_b.shape[0]
+    k = xp.arange(out_cap, dtype=xp.int64)
+
+    i = xp.searchsorted(info.csum, k, side="right")
+    i = xp.clip(i, 0, max(lcap - 1, 0)).astype(xp.int32)
+    start = info.csum[i] - info.counts[i]
+    j_local = k - start
+    j = info.perm_b[xp.clip(info.lo[i] + j_local, 0, max(rcap - 1, 0))]
+
+    inner = k < info.total
+    l_idx = xp.where(inner, i, 0).astype(xp.int32)
+    r_idx = xp.where(inner, j, 0).astype(xp.int32)
+    l_ok = inner
+    r_ok = inner
+    num_out = info.total
+
+    if with_unmatched_left:
+        ul = compact_indices(xp, info.l_unmatched)
+        sel = (k >= num_out) & (k < num_out + info.n_unmatched_l)
+        t = xp.clip(k - num_out, 0, max(lcap - 1, 0)).astype(xp.int32)
+        l_idx = xp.where(sel, ul[t], l_idx)
+        l_ok = l_ok | sel
+        num_out = num_out + info.n_unmatched_l
+
+    if with_unmatched_right:
+        ub = compact_indices(xp, info.b_unmatched)
+        sel = (k >= num_out) & (k < num_out + info.n_unmatched_b)
+        t = xp.clip(k - num_out, 0, max(rcap - 1, 0)).astype(xp.int32)
+        r_idx = xp.where(sel, ub[t], r_idx)
+        r_ok = r_ok | sel
+        num_out = num_out + info.n_unmatched_b
+
+    return PairMaps(l_idx, r_idx, l_ok, r_ok, num_out.astype(xp.int32))
+
+
+def cross_pairs(xp, n_left, n_right, out_cap: int) -> PairMaps:
+    """All (i, j) combinations for nested-loop/cartesian joins.  n_left and
+    n_right may be traced scalars; out_cap must cover n_left*n_right."""
+    k = xp.arange(out_cap, dtype=xp.int64)
+    nr = xp.maximum(xp.asarray(n_right, dtype=xp.int64), 1)
+    i = (k // nr).astype(xp.int32)
+    j = (k % nr).astype(xp.int32)
+    total = (xp.asarray(n_left, dtype=xp.int64)
+             * xp.asarray(n_right, dtype=xp.int64))
+    ok = k < total
+    return PairMaps(xp.where(ok, i, 0), xp.where(ok, j, 0), ok, ok,
+                    total.astype(xp.int32))
+
+
+def matched_per_row(xp, pass_mask, idx, cap: int):
+    """#passing pairs per source row (for condition-join fixups): segment-sum
+    of the residual-condition pass mask over a gather map."""
+    from .segmented import seg_sum
+    return seg_sum(xp, pass_mask.astype(xp.int32), idx, cap)

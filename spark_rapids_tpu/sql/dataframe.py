@@ -399,18 +399,30 @@ class DataFrame:
         j = P.Join(self._plan, other._plan, how, tuple(lk), tuple(rk), cond)
         df = DataFrame(j, self._session)
         if drop_dup and how in ("inner", "left", "right", "full"):
-            # USING-column semantics: single key column in output
+            # USING-column semantics: single key column in output.  The
+            # surviving copy is the left one, except right joins (the right
+            # copy carries the preserved side's values); full joins coalesce
+            # both copies so unmatched rows on either side keep their key.
             keep: List[Expression] = []
-            seen = set()
-            left_names = {a.name.lower() for a in self._plan.output}
+            dropset = {d.lower() for d in drop_dup}
+            occurrence: dict = {}
             for a in j.output:
                 nl = a.name.lower()
-                if nl in (d.lower() for d in drop_dup):
-                    if nl in seen:
+                if nl in dropset:
+                    occ = occurrence.get(nl, 0)
+                    occurrence[nl] = occ + 1
+                    if occ != 0:
+                        continue  # drop the right-side duplicate position
+                    other = next(b for b in reversed(j.output)
+                                 if b.name.lower() == nl and b is not a)
+                    if how == "full":
+                        # either side may be null on a miss: coalesce copies
+                        from .expressions.conditional import Coalesce
+                        keep.append(Alias(Coalesce(a, other), a.name))
                         continue
-                    seen.add(nl)
                     if how == "right":
-                        # take right side's column
+                        # preserved side's values, at the left position
+                        keep.append(Alias(other, a.name))
                         continue
                 keep.append(a)
             df = DataFrame(P.Project(tuple(keep), j), self._session)

@@ -36,6 +36,11 @@ def lit(v: Any) -> Column:
 
 
 def _c(x) -> Expression:
+    """Column-position argument: a bare string is a column NAME (pyspark
+    convention).  Literal-position string arguments (e.g. format patterns)
+    must not go through this helper."""
+    if isinstance(x, str):
+        return _UnresolvedAttribute(x)
     return _to_expr(x)
 
 
@@ -139,14 +144,16 @@ class _WhenColumn(Column):
         super().__init__(CO.CaseWhen(branches, else_value))
 
     def when(self, cond: Column, value) -> "_WhenColumn":
-        return _WhenColumn(self._branches + [(_c(cond), _c(value))], self._else)
+        return _WhenColumn(self._branches + [(_c(cond), _to_expr(value))],
+                           self._else)
 
     def otherwise(self, value) -> Column:
-        return Column(CO.CaseWhen(self._branches, _c(value)))
+        # value position: strings are LITERALS here (pyspark semantics)
+        return Column(CO.CaseWhen(self._branches, _to_expr(value)))
 
 
 def when(cond: Column, value) -> _WhenColumn:
-    return _WhenColumn([(_c(cond), _c(value))])
+    return _WhenColumn([(_c(cond), _to_expr(value))])
 
 
 def expr(sql: str):

@@ -55,6 +55,14 @@ class PhysicalPlan:
             return self.children[0].num_partitions()
         return 1
 
+    def estimate_bytes(self) -> Optional[int]:
+        """Size estimate for broadcast decisions (reference relies on
+        Spark statistics); None when unknown."""
+        ests = [c.estimate_bytes() for c in self.children]
+        if len(ests) == 1:
+            return ests[0]
+        return None
+
     # --- execution --------------------------------------------------------
     def execute(self, pid: int, tctx: TaskContext) -> Iterator[ColumnarBatch]:
         raise NotImplementedError(type(self).__name__)

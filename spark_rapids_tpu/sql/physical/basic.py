@@ -42,6 +42,9 @@ class InMemoryScanExec(PhysicalPlan):
     def num_partitions(self):
         return len(self._parts)
 
+    def estimate_bytes(self):
+        return sum(t.nbytes for t in self._parts)
+
     def execute(self, pid: int, tctx: TaskContext):
         from ...columnar.convert import arrow_to_device
         table = self._parts[pid]

@@ -1,0 +1,434 @@
+"""Join execs — the analog of the reference's join family (SURVEY §2.3):
+``GpuShuffledHashJoinExec`` (440 LoC), ``GpuBroadcastHashJoinExecBase``,
+``GpuBroadcastNestedLoopJoinExecBase``, ``GpuCartesianProductExec``,
+``ExistenceJoin``, with gather-map construction in ``GpuHashJoin.scala:298``
+and chunked output via ``JoinGatherer.scala``.
+
+TPU shape discipline: phase 1 (``ops/join.join_build``) is one compiled
+program per (probe-cap, build-cap); the host reads three scalar totals to
+pick an output capacity bucket; phase 2 gathers + evaluates any residual
+(non-equi) condition + assembles the join-type-specific output, one compiled
+program per (caps, out-cap).  Sort-merge joins are replaced by shuffled hash
+joins exactly like the reference (``GpuSortMergeJoinMeta.scala``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.batch import ColumnarBatch
+from ...columnar.column import bucket_capacity
+from ...ops.join import (JoinInfo, compact_indices, cross_pairs, gather_pairs,
+                         join_build, matched_per_row, PairMaps)
+from ..expressions.core import (AttributeReference, EvalContext, Expression,
+                                bind_references)
+from .base import TPU, PhysicalPlan, TaskContext
+from .exchange import BroadcastExchangeExec
+
+_PAIR_JOINS = ("inner", "left", "full", "cross")
+_FILTER_JOINS = ("left_semi", "left_anti", "existence")
+
+
+class BaseJoinExec(PhysicalPlan):
+    """Shared machinery: side normalization (right joins flip to left),
+    output schema, pair gathering, residual-condition assembly."""
+
+    def __init__(self, how: str, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 condition: Optional[Expression],
+                 left: PhysicalPlan, right: PhysicalPlan, backend=TPU):
+        super().__init__(left, right)
+        self.backend = backend
+        self.how = how
+        self.condition = condition
+        self._flipped = how == "right"
+        if self._flipped:
+            # right outer == left outer with sides swapped + column reorder
+            self._probe, self._build = right, left
+            self._probe_keys, self._build_keys = list(right_keys), list(left_keys)
+            self._norm_how = "left"
+        else:
+            self._probe, self._build = left, right
+            self._probe_keys, self._build_keys = list(left_keys), list(right_keys)
+            self._norm_how = how
+
+        self._out_left = list(left.output)
+        self._out_right = list(right.output)
+        self._bound_pkeys = [bind_references(e, self._probe.output)
+                             for e in self._probe_keys]
+        self._bound_bkeys = [bind_references(e, self._build.output)
+                             for e in self._build_keys]
+        # pair-batch layout: [probe cols][build cols]
+        pair_attrs = list(self._probe.output) + list(self._build.output)
+        self._bound_cond = (bind_references(condition, pair_attrs)
+                            if condition is not None else None)
+        self._build_fn = self._jit(self._build_info)
+        self._gather_cache: Dict[int, object] = {}
+
+    # --- schema -----------------------------------------------------------
+    @property
+    def output(self) -> List[AttributeReference]:
+        how = self.how
+        lo = list(self._out_left)
+        ro = list(self._out_right)
+        if how in ("left_semi", "left_anti"):
+            return lo
+        if how == "existence":
+            return lo + [AttributeReference("exists", T.BOOLEAN, False)]
+        def _nullable(attrs):
+            return [AttributeReference(a.name, a.dtype, True, a.expr_id)
+                    for a in attrs]
+        if how == "left":
+            ro = _nullable(ro)
+        elif how == "right":
+            lo = _nullable(lo)
+        elif how == "full":
+            lo, ro = _nullable(lo), _nullable(ro)
+        return lo + ro
+
+    # --- phase 1 ----------------------------------------------------------
+    def _build_info(self, probe: ColumnarBatch, build: ColumnarBatch
+                    ) -> JoinInfo:
+        xp = self.xp
+        pctx = EvalContext(probe, xp=xp)
+        bctx = EvalContext(build, xp=xp)
+        pkeys = [e.eval(pctx) for e in self._bound_pkeys]
+        bkeys = [e.eval(bctx) for e in self._bound_bkeys]
+        return join_build(xp, pkeys, bkeys, probe.row_mask(), build.row_mask())
+
+    # --- phase 2 ----------------------------------------------------------
+    def _gather_fn(self, out_cap: int):
+        fn = self._gather_cache.get(out_cap)
+        if fn is None:
+            def impl(probe, build, info):
+                return self._gather_impl(probe, build, info, out_cap)
+            fn = self._jit(impl)
+            self._gather_cache[out_cap] = fn
+        return fn
+
+    def _pair_batch(self, probe: ColumnarBatch, build: ColumnarBatch,
+                    maps: PairMaps) -> ColumnarBatch:
+        lb = probe.gather(maps.l_idx, maps.l_ok, maps.num_out)
+        rb = build.gather(maps.r_idx, maps.r_ok, maps.num_out)
+        names = tuple(a.name for a in self._probe.output) + \
+            tuple(a.name for a in self._build.output)
+        return ColumnarBatch(names, lb.columns + rb.columns, maps.num_out)
+
+    def _eval_condition(self, pair: ColumnarBatch, inner_ok):
+        xp = self.xp
+        ctx = EvalContext(pair, xp=xp)
+        c = self._bound_cond.eval(ctx)
+        return c.data & c.validity & inner_ok
+
+    def _gather_impl(self, probe: ColumnarBatch, build: ColumnarBatch,
+                     info: JoinInfo, out_cap: int) -> ColumnarBatch:
+        xp = self.xp
+        how = self._norm_how
+        cond = self._bound_cond
+        lcap, rcap = probe.capacity, build.capacity
+
+        if how in _FILTER_JOINS and cond is None:
+            matched = info.counts > 0
+            return self._emit_filter_join(probe, matched)
+
+        if cond is None:
+            maps = gather_pairs(xp, info, out_cap,
+                                with_unmatched_left=how in ("left", "full"),
+                                with_unmatched_right=how == "full")
+            pair = self._pair_batch(probe, build, maps)
+            return self._project_output(pair, maps)
+
+        # residual condition: inner pairs -> pass mask -> reassemble
+        maps = gather_pairs(xp, info, out_cap)
+        pair = self._pair_batch(probe, build, maps)
+        pass_mask = self._eval_condition(pair, maps.l_ok)
+
+        if how in _FILTER_JOINS:
+            matched = matched_per_row(xp, pass_mask, maps.l_idx, lcap) > 0
+            return self._emit_filter_join(probe, matched)
+
+        final = self._assemble_with_pass(probe, build, maps, pass_mask,
+                                         out_cap)
+        pair = self._pair_batch(probe, build, final)
+        return self._project_output(pair, final)
+
+    def _assemble_with_pass(self, probe: ColumnarBatch, build: ColumnarBatch,
+                            maps: PairMaps, pass_mask, out_cap: int
+                            ) -> PairMaps:
+        """Compact pairs surviving the residual condition to the front, then
+        append unmatched-left/right rows per the (normalized) join type."""
+        xp = self.xp
+        how = self._norm_how
+        lcap, rcap = probe.capacity, build.capacity
+        cp = compact_indices(xp, pass_mask)
+        n_pass = xp.sum(pass_mask).astype(xp.int64)
+        k = xp.arange(out_cap, dtype=xp.int64)
+        sel_pair = k < n_pass
+        src = cp[xp.clip(k, 0, cp.shape[0] - 1).astype(xp.int32)]
+        l_idx = xp.where(sel_pair, maps.l_idx[src], 0)
+        r_idx = xp.where(sel_pair, maps.r_idx[src], 0)
+        l_ok = sel_pair
+        r_ok = sel_pair
+        num_out = n_pass
+
+        if how in ("left", "full"):
+            m = matched_per_row(xp, pass_mask, maps.l_idx, lcap) > 0
+            unl = probe.row_mask() & ~m
+            n_unl = xp.sum(unl.astype(xp.int64))
+            ul = compact_indices(xp, unl)
+            sel = (k >= num_out) & (k < num_out + n_unl)
+            t = xp.clip(k - num_out, 0, lcap - 1).astype(xp.int32)
+            l_idx = xp.where(sel, ul[t], l_idx)
+            l_ok = l_ok | sel
+            num_out = num_out + n_unl
+        if how == "full":
+            mb = matched_per_row(xp, pass_mask, maps.r_idx, rcap) > 0
+            unb = build.row_mask() & ~mb
+            n_unb = xp.sum(unb.astype(xp.int64))
+            ub = compact_indices(xp, unb)
+            sel = (k >= num_out) & (k < num_out + n_unb)
+            t = xp.clip(k - num_out, 0, rcap - 1).astype(xp.int32)
+            r_idx = xp.where(sel, ub[t], r_idx)
+            r_ok = r_ok | sel
+            num_out = num_out + n_unb
+
+        return PairMaps(l_idx.astype(xp.int32), r_idx.astype(xp.int32),
+                        l_ok, r_ok, num_out.astype(xp.int32))
+
+    def _emit_filter_join(self, probe: ColumnarBatch, matched):
+        """semi/anti/existence output (left rows only)."""
+        xp = self.xp
+        how = self._norm_how
+        lmask = probe.row_mask()
+        if how == "existence":
+            from ...columnar.column import DeviceColumn
+            ex = DeviceColumn(T.BOOLEAN, matched & lmask,
+                              xp.ones_like(matched))
+            names = tuple(a.name for a in self._out_left) + ("exists",)
+            return ColumnarBatch(names, probe.columns + (ex,), probe.num_rows)
+        keep = lmask & (matched if how == "left_semi" else ~matched)
+        n = xp.sum(keep).astype(xp.int32)
+        perm = compact_indices(xp, keep)
+        cols = tuple(c.gather(perm, keep[perm]) for c in probe.columns)
+        return ColumnarBatch(tuple(a.name for a in self._out_left), cols, n)
+
+    def _project_output(self, pair: ColumnarBatch, maps: PairMaps
+                        ) -> ColumnarBatch:
+        """Reorder pair columns [probe][build] into [left][right] output."""
+        np_, nb = len(self._probe.output), len(self._build.output)
+        if self._flipped:
+            idx = list(range(np_, np_ + nb)) + list(range(np_))
+        else:
+            idx = list(range(np_ + nb))
+        names = tuple(a.name for a in self.output)
+        cols = tuple(pair.columns[i] for i in idx)
+        return ColumnarBatch(names, cols, maps.num_out)
+
+    # --- sizing -----------------------------------------------------------
+    def _out_capacity(self, info: JoinInfo, n_probe: int, n_build: int) -> int:
+        how = self._norm_how
+        if how in _FILTER_JOINS and self._bound_cond is None:
+            return 8  # unused; filter joins reuse the probe capacity
+        total = int(info.total)
+        if self._bound_cond is not None:
+            extra = (n_probe if how in ("left", "full") else 0) + \
+                (n_build if how == "full" else 0)
+            return bucket_capacity(total + extra)
+        extra = (int(info.n_unmatched_l) if how in ("left", "full") else 0) + \
+            (int(info.n_unmatched_b) if how == "full" else 0)
+        return bucket_capacity(total + extra)
+
+    def _join_one(self, probe: ColumnarBatch, build: ColumnarBatch
+                  ) -> ColumnarBatch:
+        info = self._build_fn(probe, build)
+        out_cap = self._out_capacity(info, probe.num_rows_int,
+                                     build.num_rows_int)
+        return self._gather_fn(out_cap)(probe, build, info)
+
+    # --- helpers ----------------------------------------------------------
+    def _empty_batch(self, attrs) -> ColumnarBatch:
+        schema = T.StructType(tuple(
+            T.StructField(a.name, a.dtype, True) for a in attrs))
+        b = ColumnarBatch.empty(schema)
+        if self.backend != TPU:
+            import jax
+            b = jax.tree.map(np.asarray, b)
+        return b
+
+    def _concat_or_empty(self, batches, attrs) -> ColumnarBatch:
+        if not batches:
+            return self._empty_batch(attrs)
+        return ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
+
+    def simple_string(self):
+        keys = ", ".join(f"{l.sql()}={r.sql()}" for l, r in
+                         zip(self._probe_keys, self._build_keys))
+        c = f" cond={self.condition.sql()}" if self.condition is not None else ""
+        return f"{self.node_name()} {self.how} [{keys}]{c}"
+
+
+class ShuffledHashJoinExec(BaseJoinExec):
+    """Both sides co-partitioned by key hash (planner inserts the
+    exchanges); per partition the build side is concatenated and each probe
+    batch is joined against it (reference ``GpuShuffledHashJoinExec``)."""
+
+    def num_partitions(self):
+        return self._probe.num_partitions()
+
+    def execute(self, pid: int, tctx: TaskContext):
+        build = self._concat_or_empty(
+            list(self._build.execute(pid, TaskContext(pid, tctx.conf))),
+            self._build.output)
+        probes = list(self._probe.execute(pid, tctx))
+        how = self._norm_how
+        if how == "full" and len(probes) > 1:
+            # unmatched-build rows must be emitted once per partition,
+            # not once per probe batch
+            probes = [ColumnarBatch.concat(probes)]
+        if not probes:
+            probes = [self._empty_batch(self._probe.output)]
+        for probe in probes:
+            yield self._join_one(probe, build)
+
+
+class BroadcastHashJoinExec(BaseJoinExec):
+    """Build side is a broadcast exchange shared across all probe
+    partitions (reference ``GpuBroadcastHashJoinExecBase``).  Only valid
+    for join types whose build side is not preserved (inner/left/semi/
+    anti/existence with build=right) — the planner enforces this."""
+
+    def num_partitions(self):
+        return self._probe.num_partitions()
+
+    def execute(self, pid: int, tctx: TaskContext):
+        assert isinstance(self._build, BroadcastExchangeExec)
+        build = self._build.broadcast_batch(tctx)
+        probes = list(self._probe.execute(pid, tctx))
+        if not probes:
+            probes = [self._empty_batch(self._probe.output)]
+        for probe in probes:
+            yield self._join_one(probe, build)
+
+
+class NestedLoopJoinExec(BaseJoinExec):
+    """Cartesian product + optional condition (reference
+    ``GpuBroadcastNestedLoopJoinExecBase`` / ``GpuCartesianProductExec``).
+    The build side is broadcast; pair space is all (i, j) combinations."""
+
+    def num_partitions(self):
+        return self._probe.num_partitions()
+
+    def _build_info(self, probe, build):  # not used
+        raise NotImplementedError
+
+    def _join_one(self, probe: ColumnarBatch, build: ColumnarBatch
+                  ) -> ColumnarBatch:
+        n_probe = probe.num_rows_int
+        n_build = build.num_rows_int
+        how = self._norm_how
+        # outer no-key joins need slack for null-extended rows even without
+        # a condition (e.g. left join against an empty build side)
+        extra = (n_probe if how in ("left", "full") else 0) + \
+            (n_build if how == "full" else 0)
+        out_cap = bucket_capacity(n_probe * n_build + extra)
+        return self._nl_fn(out_cap)(probe, build)
+
+    def _nl_fn(self, out_cap: int):
+        fn = self._gather_cache.get(out_cap)
+        if fn is None:
+            def impl(probe, build):
+                return self._nl_impl(probe, build, out_cap)
+            fn = self._jit(impl)
+            self._gather_cache[out_cap] = fn
+        return fn
+
+    def _nl_impl(self, probe: ColumnarBatch, build: ColumnarBatch,
+                 out_cap: int) -> ColumnarBatch:
+        xp = self.xp
+        how = self._norm_how
+        lcap, rcap = probe.capacity, build.capacity
+        maps = cross_pairs(xp, probe.num_rows, build.num_rows, out_cap)
+        pair = self._pair_batch(probe, build, maps)
+        if self._bound_cond is None and how in ("inner", "cross"):
+            return self._project_output(pair, maps)
+        pass_mask = (self._eval_condition(pair, maps.l_ok)
+                     if self._bound_cond is not None else maps.l_ok)
+
+        if how in _FILTER_JOINS:
+            matched = matched_per_row(xp, pass_mask, maps.l_idx, lcap) > 0
+            return self._emit_filter_join(probe, matched)
+
+        final = self._assemble_with_pass(probe, build, maps, pass_mask,
+                                         out_cap)
+        pair = self._pair_batch(probe, build, final)
+        return self._project_output(pair, final)
+
+    def execute(self, pid: int, tctx: TaskContext):
+        if isinstance(self._build, BroadcastExchangeExec):
+            build = self._build.broadcast_batch(tctx)
+        else:
+            # every probe partition needs the whole build stream
+            batches = []
+            for bpid in range(self._build.num_partitions()):
+                batches.extend(self._build.execute(
+                    bpid, TaskContext(bpid, tctx.conf)))
+            build = self._concat_or_empty(batches, self._build.output)
+        probes = list(self._probe.execute(pid, tctx))
+        how = self._norm_how
+        if how == "full" and len(probes) > 1:
+            probes = [ColumnarBatch.concat(probes)]
+        if not probes:
+            probes = [self._empty_batch(self._probe.output)]
+        for probe in probes:
+            yield self._join_one(probe, build)
+
+
+# --------------------------------------------------------------------------
+# planning
+# --------------------------------------------------------------------------
+
+def plan_join(node, left: PhysicalPlan, right: PhysicalPlan, backend,
+              conf) -> PhysicalPlan:
+    """Join strategy selection (the reference's exec rules for
+    BroadcastHashJoinExec / ShuffledHashJoinExec / SortMergeJoinExec /
+    CartesianProductExec / BroadcastNestedLoopJoinExec)."""
+    from ...parallel.partitioning import HashPartitioning, SinglePartitioning
+    from .exchange import ShuffleExchangeExec
+
+    how = node.how
+    if not node.left_keys:
+        # condition-only / cross join -> nested loop with broadcast build.
+        # right/full preserve the build side, so the probe must see the
+        # whole stream exactly once -> coalesce to a single partition.
+        if how in ("right", "full") and left.num_partitions() > 1:
+            left = ShuffleExchangeExec(SinglePartitioning(), left,
+                                       backend=backend)
+        build = BroadcastExchangeExec(right, backend=backend)
+        return NestedLoopJoinExec(how, (), (), node.condition, left, build,
+                                  backend=backend)
+
+    from ...config import AUTO_BROADCAST_THRESHOLD
+    threshold = int(conf.get(AUTO_BROADCAST_THRESHOLD))
+    build_bytes = right.estimate_bytes()
+    can_broadcast = (how in ("inner", "left", "left_semi", "left_anti",
+                             "existence")
+                     and build_bytes is not None
+                     and build_bytes <= threshold)
+    if can_broadcast and left.num_partitions() > 1:
+        build = BroadcastExchangeExec(right, backend=backend)
+        return BroadcastHashJoinExec(how, node.left_keys, node.right_keys,
+                                     node.condition, left, build,
+                                     backend=backend)
+
+    nparts = max(left.num_partitions(), right.num_partitions())
+    if nparts > 1:
+        n = int(conf.shuffle_partitions)
+        left = ShuffleExchangeExec(
+            HashPartitioning(node.left_keys, n), left, backend=backend)
+        right = ShuffleExchangeExec(
+            HashPartitioning(node.right_keys, n), right, backend=backend)
+    return ShuffledHashJoinExec(how, node.left_keys, node.right_keys,
+                                node.condition, left, right, backend=backend)

@@ -1,0 +1,217 @@
+"""Join tests — every join type on TPU vs the host engine, plus pandas
+merge as an independent oracle (the reference's integration suite joins the
+same frames on CPU Spark)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+from test_dataframe import assert_tpu_and_cpu_equal
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def _left_table():
+    return pa.table({
+        "k": pa.array([1, 2, 2, 3, None, 5], type=pa.int64()),
+        "lv": pa.array([10, 20, 21, 30, 40, 50], type=pa.int64()),
+    })
+
+
+def _right_table():
+    return pa.table({
+        "k": pa.array([2, 2, 3, 4, None], type=pa.int64()),
+        "rv": pa.array([200, 201, 300, 400, 500], type=pa.int64()),
+    })
+
+
+def _none_key(rows):
+    return sorted(rows, key=lambda t: tuple((v is None, v) for v in t))
+
+
+def _pandas_oracle(how):
+    """SQL-correct oracle (pandas merge matches NaN keys, SQL does not)."""
+    l = _left_table().to_pandas()
+    r = _right_table().to_pandas()
+    ln, rn = l[l.k.notna()], r[r.k.notna()]
+    m = ln.merge(rn, on="k", how="inner")
+    rows = [(int(k), int(lv), int(rv))
+            for k, lv, rv in m[["k", "lv", "rv"]].itertuples(index=False)]
+    if how in ("left", "full"):
+        matched = set(rn.k.dropna())
+        for k, lv in l[["k", "lv"]].itertuples(index=False):
+            if pd.isna(k) or k not in matched:
+                rows.append((None if pd.isna(k) else int(k), int(lv), None))
+    if how in ("right", "full"):
+        matched = set(ln.k.dropna())
+        for k, rv in r[["k", "rv"]].itertuples(index=False):
+            if pd.isna(k) or k not in matched:
+                rows.append((None if pd.isna(k) else int(k), None, int(rv)))
+    return _none_key(rows)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+@pytest.mark.parametrize("nparts", [1, 3])
+def test_equi_join_vs_pandas(sess, how, nparts):
+    l = sess.create_dataframe(_left_table(), num_partitions=nparts)
+    r = sess.create_dataframe(_right_table(), num_partitions=nparts)
+    out = assert_tpu_and_cpu_equal(l.join(r, "k", how), sort_by=["k", "lv", "rv"])
+    got = _none_key([
+        tuple(None if v is None else int(v) for v in (row["k"], row["lv"],
+                                                      row["rv"]))
+        for row in out.to_pylist()])
+    assert got == _pandas_oracle(how)
+
+
+@pytest.mark.parametrize("how", ["left_semi", "left_anti"])
+def test_semi_anti_join(sess, how):
+    l = sess.create_dataframe(_left_table())
+    r = sess.create_dataframe(_right_table())
+    out = assert_tpu_and_cpu_equal(l.join(r, "k", how), sort_by=["lv"])
+    lvs = sorted(row["lv"] for row in out.to_pylist())
+    if how == "left_semi":
+        assert lvs == [20, 21, 30]  # k in {2, 3}; nulls never match
+    else:
+        assert lvs == [10, 40, 50]  # k=1, k=None, k=5
+
+
+def test_cross_join(sess):
+    l = sess.create_dataframe(pa.table({"a": [1, 2, 3]}))
+    r = sess.create_dataframe(pa.table({"b": [10, 20]}))
+    out = assert_tpu_and_cpu_equal(l.crossJoin(r), sort_by=["a", "b"])
+    assert len(out) == 6
+
+
+def test_join_with_condition(sess):
+    l = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2, 2], "x": [1, 5, 1, 5]}))
+    r = sess.create_dataframe(pa.table({
+        "k2": [1, 2], "y": [3, 3]}))
+    cond = (F.col("k") == F.col("k2")) & (F.col("x") < F.col("y"))
+    out = assert_tpu_and_cpu_equal(l.join(r, cond, "inner"),
+                                   sort_by=["k", "x"])
+    rows = [(row["k"], row["x"]) for row in out.to_pylist()]
+    assert sorted(rows) == [(1, 1), (2, 1)]
+
+
+def test_left_join_with_condition(sess):
+    l = sess.create_dataframe(pa.table({"k": [1, 2, 3], "x": [0, 9, 0]}))
+    r = sess.create_dataframe(pa.table({"k2": [1, 2, 3], "y": [5, 5, 5]}))
+    cond = (F.col("k") == F.col("k2")) & (F.col("x") < F.col("y"))
+    out = assert_tpu_and_cpu_equal(l.join(r, cond, "left"),
+                                   sort_by=["k", "x"])
+    rows = sorted((row["k"], row["y"]) for row in out.to_pylist())
+    # k=2 fails the residual (9 < 5 false) -> null right side
+    assert rows == [(1, 5), (2, None), (3, 5)]
+
+
+def test_string_key_join(sess):
+    l = sess.create_dataframe(pa.table({
+        "name": ["alice", "bob", "carol", None],
+        "v": [1, 2, 3, 4]}))
+    r = sess.create_dataframe(pa.table({
+        "name": ["bob", "carol", "dave", None],
+        "w": [20, 30, 40, 50]}))
+    out = assert_tpu_and_cpu_equal(l.join(r, "name", "inner"),
+                                   sort_by=["name"])
+    rows = sorted((row["name"], row["v"], row["w"])
+                  for row in out.to_pylist())
+    assert rows == [("bob", 2, 20), ("carol", 3, 30)]
+
+
+def test_many_to_many_join(sess):
+    rng = np.random.default_rng(7)
+    lk = rng.integers(0, 20, 300)
+    rk = rng.integers(0, 20, 200)
+    l = sess.create_dataframe(pa.table({
+        "k": lk, "lv": np.arange(300)}), num_partitions=4)
+    r = sess.create_dataframe(pa.table({
+        "k": rk, "rv": np.arange(200)}), num_partitions=2)
+    out = assert_tpu_and_cpu_equal(l.join(r, "k", "inner"),
+                                   sort_by=["k", "lv", "rv"])
+    expected = pd.DataFrame({"k": lk, "lv": np.arange(300)}).merge(
+        pd.DataFrame({"k": rk, "rv": np.arange(200)}), on="k")
+    assert len(out) == len(expected)
+    got = sorted(map(tuple, out.to_pydict().values().__iter__().__next__()
+                 .__class__ and [
+        (row["k"], row["lv"], row["rv"]) for row in out.to_pylist()]))
+    exp = sorted(map(tuple, expected[["k", "lv", "rv"]].itertuples(
+        index=False)))
+    assert got == exp
+
+
+def test_broadcast_join_path(sess):
+    """Small build side + partitioned probe -> broadcast hash join."""
+    l = sess.create_dataframe(pa.table({
+        "k": np.arange(100) % 10, "lv": np.arange(100)}), num_partitions=4)
+    r = sess.create_dataframe(pa.table({
+        "k": np.arange(5), "rv": np.arange(5) * 100}))
+    df = l.join(r, "k", "inner")
+    from spark_rapids_tpu.sql.planner import Planner
+    plan = Planner(sess._conf).plan(df._plan).tree_string()
+    assert "BroadcastHashJoin" in plan
+    out = assert_tpu_and_cpu_equal(df, sort_by=["k", "lv"])
+    assert len(out) == 50
+
+
+def test_join_then_aggregate(sess):
+    """TPC-H-style join + groupby pipeline."""
+    l = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2, 2, 3], "v": [1.0, 2.0, 3.0, 4.0, 5.0]}),
+        num_partitions=2)
+    r = sess.create_dataframe(pa.table({
+        "k": [1, 2, 3], "grp": ["a", "b", "a"]}))
+    df = (l.join(r, "k", "inner")
+          .groupBy("grp").agg(F.sum("v").alias("s")))
+    out = assert_tpu_and_cpu_equal(df, sort_by=["grp"])
+    rows = {row["grp"]: row["s"] for row in out.to_pylist()}
+    assert rows == {"a": 8.0, "b": 7.0}
+
+
+def test_outer_nested_loop_empty_build(sess):
+    """Left no-key join against an empty build side must keep every probe
+    row (regression: out_cap was sized without unmatched slack)."""
+    l = sess.create_dataframe(pa.table({"a": list(range(20))}))
+    r = sess.create_dataframe(pa.table({"b": pa.array([], type=pa.int64())}))
+    out = assert_tpu_and_cpu_equal(l.join(r, None, "left"), sort_by=["a"])
+    assert len(out) == 20
+    assert all(row["b"] is None for row in out.to_pylist())
+
+
+def test_right_join_column_order(sess):
+    """USING-column right join keeps pyspark's column order."""
+    l = sess.create_dataframe(pa.table({"k": [1, 2], "lv": [10, 20]}))
+    r = sess.create_dataframe(pa.table({"k": [2, 3], "rv": [200, 300]}))
+    out = assert_tpu_and_cpu_equal(l.join(r, "k", "right"), sort_by=["k"])
+    assert out.column_names == ["k", "lv", "rv"]
+    rows = _none_key([(row["k"], row["lv"], row["rv"])
+                      for row in out.to_pylist()])
+    assert rows == [(2, 20, 200), (3, None, 300)]
+
+
+def test_when_otherwise_string_literals(sess):
+    """F.when value-position strings are literals, not column names."""
+    df = sess.create_dataframe(pa.table({"a": [5, 15]}))
+    out = df.select(F.when(F.col("a") > 10, "big")
+                    .otherwise("small").alias("sz")).collect()
+    assert out.column("sz").to_pylist() == ["small", "big"]
+
+
+def test_full_join_nulls_both_sides(sess):
+    l = sess.create_dataframe(pa.table({
+        "k": pa.array([None, None, 1], type=pa.int64()),
+        "lv": [1, 2, 3]}))
+    r = sess.create_dataframe(pa.table({
+        "k": pa.array([None, 2], type=pa.int64()),
+        "rv": [10, 20]}))
+    out = assert_tpu_and_cpu_equal(l.join(r, "k", "full"),
+                                   sort_by=["lv", "rv"])
+    # nulls never match: 3 unmatched left + 2 unmatched right + 0 matches
+    assert len(out) == 5
