@@ -1,0 +1,29 @@
+"""Memory & scheduling runtime — the TPU redesign of the reference's
+RMM-centered heart (SURVEY §2.6): device pool accounting over PjRt buffers,
+a DEVICE→HOST→DISK spill catalog (``RapidsBufferCatalog.scala:62`` /
+``RapidsBuffer.scala:59-63``), the retry-on-OOM state machine
+(``RmmRapidsRetryIterator.scala:33``), the device task semaphore
+(``GpuSemaphore.scala:34``), and deduped task-completion callbacks
+(``ScalableTaskCompletion.scala:43``).
+"""
+
+from .device import DeviceManager
+from .retry import (OomInjectionState, RetryOOM, SplitAndRetryOOM,
+                    arm_oom_injection, split_spillable_in_half, with_retry,
+                    with_retry_no_split)
+from .semaphore import TpuSemaphore
+from .spill import (ACTIVE_BATCHING_PRIORITY, ACTIVE_ON_DECK_PRIORITY,
+                    BufferCatalog, HOST_MEMORY_PRIORITY,
+                    OUTPUT_FOR_SHUFFLE_PRIORITY, SpillableColumnarBatch,
+                    batch_device_bytes)
+from .completion import ScalableTaskCompletion
+
+__all__ = [
+    "DeviceManager", "TpuSemaphore", "BufferCatalog",
+    "SpillableColumnarBatch", "batch_device_bytes",
+    "RetryOOM", "SplitAndRetryOOM", "with_retry", "with_retry_no_split",
+    "split_spillable_in_half", "arm_oom_injection", "OomInjectionState",
+    "ScalableTaskCompletion",
+    "ACTIVE_ON_DECK_PRIORITY", "ACTIVE_BATCHING_PRIORITY",
+    "OUTPUT_FOR_SHUFFLE_PRIORITY", "HOST_MEMORY_PRIORITY",
+]

@@ -1,0 +1,56 @@
+"""Deduped per-task completion callbacks — ``ScalableTaskCompletion.scala:43``
+analog.  Operators register cleanup (close spillables, release the
+semaphore) keyed by an owner object; re-registering the same owner for the
+same task is a no-op, so iterator chains can defensively register without
+stacking duplicate callbacks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Tuple
+
+
+class ScalableTaskCompletion:
+    _instance = None
+    _class_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # task id -> list of (owner key, callback)
+        self._callbacks: Dict[int, List[Tuple[int, Callable[[], None]]]] = {}
+
+    @classmethod
+    def get(cls) -> "ScalableTaskCompletion":
+        with cls._class_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def on_task_completion(self, task_id: int, owner: Any,
+                           cb: Callable[[], None]) -> bool:
+        """Register ``cb`` to run when the task completes; deduped by
+        ``owner`` identity.  Returns False when already registered."""
+        key = id(owner)
+        with self._lock:
+            cbs = self._callbacks.setdefault(task_id, [])
+            if any(k == key for k, _ in cbs):
+                return False
+            cbs.append((key, cb))
+            return True
+
+    def task_completed(self, task_id: int):
+        with self._lock:
+            cbs = self._callbacks.pop(task_id, [])
+        errors = []
+        for _, cb in cbs:
+            try:
+                cb()
+            except Exception as e:  # run all callbacks even if one fails
+                errors.append(e)
+        if errors:
+            raise errors[0]
+
+    def pending(self, task_id: int) -> int:
+        with self._lock:
+            return len(self._callbacks.get(task_id, ()))

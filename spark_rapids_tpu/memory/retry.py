@@ -1,0 +1,140 @@
+"""Retry-on-OOM framework — the TPU port of ``RmmRapidsRetryIterator.scala``
+(`:33,341,410,484,514`): device work is expressed as attempts over spillable
+inputs; an attempt that raises :class:`RetryOOM` is re-run after a
+synchronous spill, and one that raises :class:`SplitAndRetryOOM` has its
+input split in half and each half retried (`:371,439`).  Synthetic OOM
+injection for tests mirrors ``spark.rapids.sql.test.injectRetryOOM``
+(`RapidsConf.scala:1371`, throw site `RmmRapidsRetryIterator.scala:562`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
+
+from .spill import BufferCatalog, SpillableColumnarBatch
+
+A = TypeVar("A")
+B = TypeVar("B")
+
+_MAX_RETRIES = 32
+
+
+class RetryOOM(MemoryError):
+    """Device allocation failed; the attempt may succeed after a spill."""
+
+
+class SplitAndRetryOOM(MemoryError):
+    """Device allocation failed and spilling is not enough; the input must
+    be split into smaller pieces."""
+
+
+class OomInjectionState(threading.local):
+    """Thread-local synthetic-OOM arming (conftest ``inject_oom`` marker
+    analog)."""
+
+    def __init__(self):
+        self.retry_ooms = 0
+        self.split_ooms = 0
+
+    def arm(self, retry: int = 0, split: int = 0):
+        self.retry_ooms = int(retry)
+        self.split_ooms = int(split)
+
+    def maybe_throw(self):
+        if self.retry_ooms > 0:
+            self.retry_ooms -= 1
+            raise RetryOOM("injected RetryOOM (test hook)")
+        if self.split_ooms > 0:
+            self.split_ooms -= 1
+            raise SplitAndRetryOOM("injected SplitAndRetryOOM (test hook)")
+
+
+_injection = OomInjectionState()
+
+
+def arm_oom_injection(retry: int = 0, split: int = 0):
+    """Arm synthetic OOMs for the current thread; next `retry` attempts
+    throw RetryOOM and the following `split` attempts SplitAndRetryOOM."""
+    _injection.arm(retry, split)
+
+
+def injection_state() -> OomInjectionState:
+    return _injection
+
+
+def split_spillable_in_half(sb: SpillableColumnarBatch
+                            ) -> List[SpillableColumnarBatch]:
+    """Default split policy (``RmmRapidsRetryIterator.splitSpillableInHalfByRows``).
+    Halves inherit the parent's catalog and spill priority."""
+    batch = sb.get()
+    n = batch.num_rows_int
+    if n < 2:
+        raise SplitAndRetryOOM(
+            f"cannot split a {n}-row batch any further (GpuOOM)")
+    half = n // 2
+    left = batch.sliced(0, half)
+    right = batch.sliced(half, n - half)
+    out = [SpillableColumnarBatch.create(left, sb.priority, sb.catalog),
+           SpillableColumnarBatch.create(right, sb.priority, sb.catalog)]
+    sb.close()
+    return out
+
+
+def with_retry(inputs: Iterable[A], fn: Callable[[A], B],
+               split: Optional[Callable[[A], List[A]]] = None,
+               catalog: Optional[BufferCatalog] = None) -> Iterator[B]:
+    """Run ``fn`` over each input with OOM rollback.  ``inputs`` should be
+    spillable (typically :class:`SpillableColumnarBatch`) so that a spill
+    between attempts actually frees device memory.  With a ``split`` policy,
+    SplitAndRetryOOM replaces the failing input by its pieces; without one it
+    propagates (``withRetryNoSplit`` semantics).  Takes ownership of the
+    inputs: each is closed once its attempt succeeds, like the reference's
+    AutoCloseable contract."""
+    catalog = catalog or BufferCatalog.get()
+    stack: List[A] = list(inputs)
+    stack.reverse()
+    item: Optional[A] = None
+
+    def _close(x):
+        if x is not None and hasattr(x, "close"):
+            x.close()
+
+    try:
+        while stack:
+            item = stack.pop()
+            attempts = 0
+            while True:
+                attempts += 1
+                if attempts > _MAX_RETRIES:
+                    raise MemoryError(
+                        f"giving up after {_MAX_RETRIES} OOM retries (GpuOOM)")
+                try:
+                    _injection.maybe_throw()
+                    result = fn(item)
+                    _close(item)
+                    item = None
+                    yield result
+                    break
+                except RetryOOM:
+                    catalog.spill_all_device()
+                except SplitAndRetryOOM:
+                    if split is None:
+                        raise
+                    pieces = split(item)  # split closes the parent
+                    item = None
+                    pieces.reverse()
+                    stack.extend(pieces)
+                    item = stack.pop()
+    finally:
+        # ownership contract: on any failure or abandoned generator, close
+        # the in-flight item and everything still queued
+        _close(item)
+        for rest in stack:
+            _close(rest)
+
+
+def with_retry_no_split(item: A, fn: Callable[[A], B],
+                        catalog: Optional[BufferCatalog] = None) -> B:
+    """Single-input, no-split retry (``withRetryNoSplit`` `:484`)."""
+    return next(iter(with_retry([item], fn, split=None, catalog=catalog)))

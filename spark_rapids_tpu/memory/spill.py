@@ -1,0 +1,298 @@
+"""Spill framework — DEVICE→HOST→DISK tiers behind one catalog, the TPU
+equivalent of ``RapidsBufferCatalog.scala:62`` + the three stores
+(``RapidsDeviceMemoryStore``/``RapidsHostMemoryStore``/``RapidsDiskStore``)
+and ``SpillableColumnarBatch.scala:29``.
+
+A registered batch lives in exactly one tier:
+
+* DEVICE — the live jax arrays (accounted against the DeviceManager pool);
+* HOST   — numpy copies (accounted against the host spill budget,
+  ``spark.rapids.memory.host.spillStorageSize``);
+* DISK   — one pickle file per buffer under ``spark.rapids.memory.spillDir``.
+
+``synchronous_spill`` walks buffers lowest-priority-first (the
+``SpillPriorities.scala`` contract) device→host, overflowing host→disk when
+the host budget is exceeded.  ``get`` transparently unspills
+(``RapidsBufferCatalog.unspill`` `:633`).  Everything is thread-safe: the
+multithreaded shuffle and IO pools touch the catalog concurrently.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch
+from ..config import HOST_SPILL_STORAGE_SIZE, SPILL_DIR, RapidsConf
+from .device import DeviceManager
+
+# spill order: lower value spills first (SpillPriorities.scala:83 semantics,
+# inverted to "priority = keep-on-device desire")
+OUTPUT_FOR_SHUFFLE_PRIORITY = -100
+HOST_MEMORY_PRIORITY = -50
+ACTIVE_BATCHING_PRIORITY = 0
+ACTIVE_ON_DECK_PRIORITY = 100
+
+DEVICE, HOST, DISK = "device", "host", "disk"
+
+
+def batch_device_bytes(batch: ColumnarBatch) -> int:
+    """Accounted size: sum of leaf array nbytes."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+@dataclass
+class _Buffer:
+    handle: int
+    tier: str
+    size: int
+    priority: int
+    treedef: Any = None
+    leaves: Optional[List[Any]] = None     # device or host arrays
+    disk_path: Optional[str] = None
+    was_device: bool = True                # False for host-backend batches
+    seq: int = 0                           # tie-break: older spills first
+
+
+class BufferCatalog:
+    """Handle registry + tiered stores + spill policy (singleton per
+    process, like the reference's ``RapidsBufferCatalog.singleton``)."""
+
+    _instance: Optional["BufferCatalog"] = None
+    _class_lock = threading.Lock()
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        conf = conf or RapidsConf.get_global()
+        self._lock = threading.RLock()
+        self._buffers: Dict[int, _Buffer] = {}
+        self._next_handle = 1
+        self._seq = 0
+        self.host_limit = int(conf.get(HOST_SPILL_STORAGE_SIZE))
+        self.spill_dir = str(conf.get(SPILL_DIR))
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.disk_bytes = 0
+        self.spill_count = 0
+        self.unspill_count = 0
+
+    @classmethod
+    def get(cls) -> "BufferCatalog":
+        with cls._class_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls, conf: Optional[RapidsConf] = None) -> "BufferCatalog":
+        with cls._class_lock:
+            if cls._instance is not None:
+                cls._instance.close_all()
+            cls._instance = cls(conf)
+            return cls._instance
+
+    # --- registration ------------------------------------------------------
+    def add_batch(self, batch: ColumnarBatch,
+                  priority: int = ACTIVE_BATCHING_PRIORITY) -> int:
+        """Register a batch.  Device-resident batches are charged against the
+        accounted pool (spilling others first if needed); host-backend
+        (numpy-leaf) batches start at the HOST tier and never count as HBM."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        was_device = any(isinstance(l, jax.Array) for l in leaves)
+        size = batch_device_bytes(batch)
+        if was_device:
+            self.ensure_headroom(size)
+        with self._lock:
+            h = self._next_handle
+            self._next_handle += 1
+            self._seq += 1
+            tier = DEVICE if was_device else HOST
+            self._buffers[h] = _Buffer(h, tier, size, priority, treedef,
+                                       list(leaves), was_device=was_device,
+                                       seq=self._seq)
+            if was_device:
+                self.device_bytes += size
+            else:
+                self.host_bytes += size
+        return h
+
+    def get_batch(self, handle: int) -> ColumnarBatch:
+        """Materialize on the original backend, unspilling if needed."""
+        import jax
+        with self._lock:
+            buf = self._buffers[handle]
+            if buf.tier == DISK:
+                self._disk_to_host(buf)
+            if buf.tier == HOST and buf.was_device:
+                self._host_to_device(buf)
+            leaves = buf.leaves
+            treedef = buf.treedef
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def remove(self, handle: int):
+        with self._lock:
+            buf = self._buffers.pop(handle, None)
+            if buf is None:
+                return
+            if buf.tier == DEVICE:
+                self.device_bytes -= buf.size
+            elif buf.tier == HOST:
+                self.host_bytes -= buf.size
+            elif buf.tier == DISK:
+                self.disk_bytes -= buf.size
+                if buf.disk_path and os.path.exists(buf.disk_path):
+                    os.unlink(buf.disk_path)
+
+    def close_all(self):
+        with self._lock:
+            for h in list(self._buffers):
+                self.remove(h)
+
+    def tier_of(self, handle: int) -> str:
+        with self._lock:
+            return self._buffers[handle].tier
+
+    # --- spill policy ------------------------------------------------------
+    def synchronous_spill(self, target_device_bytes: int) -> int:
+        """Spill device buffers (lowest priority, oldest first) until
+        accounted device usage <= target.  Returns bytes spilled
+        (``RapidsBufferCatalog.synchronousSpill`` `:589`)."""
+        spilled = 0
+        with self._lock:
+            candidates = sorted(
+                (b for b in self._buffers.values() if b.tier == DEVICE),
+                key=lambda b: (b.priority, b.seq))
+            for buf in candidates:
+                if self.device_bytes <= target_device_bytes:
+                    break
+                self._device_to_host(buf)
+                spilled += buf.size
+                self.spill_count += 1
+        return spilled
+
+    def ensure_headroom(self, request_bytes: int) -> bool:
+        """Make room for an incoming allocation; the DeviceMemoryEventHandler
+        equivalent.  Returns True if the request now fits the pool."""
+        limit = DeviceManager.get().pool_limit_bytes()
+        with self._lock:
+            if self.device_bytes + request_bytes <= limit:
+                return True
+            self.synchronous_spill(max(0, limit - request_bytes))
+            return self.device_bytes + request_bytes <= limit
+
+    def spill_all_device(self) -> int:
+        return self.synchronous_spill(0)
+
+    # --- tier movement (callers hold the lock) -----------------------------
+    def _device_to_host(self, buf: _Buffer):
+        buf.leaves = [np.asarray(l) if hasattr(l, "dtype") else l
+                      for l in buf.leaves]
+        buf.tier = HOST
+        self.device_bytes -= buf.size
+        self.host_bytes += buf.size
+        if self.host_bytes > self.host_limit:
+            self._overflow_host_to_disk()
+
+    def _overflow_host_to_disk(self):
+        candidates = sorted(
+            (b for b in self._buffers.values() if b.tier == HOST),
+            key=lambda b: (b.priority, b.seq))
+        for buf in candidates:
+            if self.host_bytes <= self.host_limit:
+                break
+            self._host_to_disk(buf)
+
+    def _host_to_disk(self, buf: _Buffer):
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, f"buf-{uuid.uuid4().hex}.spill")
+        with open(path, "wb") as f:
+            pickle.dump(buf.leaves, f, protocol=pickle.HIGHEST_PROTOCOL)
+        buf.leaves = None
+        buf.disk_path = path
+        buf.tier = DISK
+        self.host_bytes -= buf.size
+        self.disk_bytes += buf.size
+
+    def _disk_to_host(self, buf: _Buffer):
+        with open(buf.disk_path, "rb") as f:
+            buf.leaves = pickle.load(f)
+        os.unlink(buf.disk_path)
+        buf.disk_path = None
+        buf.tier = HOST
+        self.disk_bytes -= buf.size
+        self.host_bytes += buf.size
+        self.unspill_count += 1
+
+    def _host_to_device(self, buf: _Buffer):
+        import jax
+        self.ensure_headroom(buf.size)
+        buf.leaves = [jax.device_put(l) if isinstance(l, np.ndarray) else l
+                      for l in buf.leaves]
+        buf.tier = DEVICE
+        self.host_bytes -= buf.size
+        self.device_bytes += buf.size
+        self.unspill_count += 1
+
+
+class SpillableColumnarBatch:
+    """Owns a batch registered with the catalog; the working-set currency of
+    out-of-core operators (``SpillableColumnarBatch.scala:29,192``).  While
+    an operator isn't actively computing on a batch it holds one of these,
+    so the catalog may demote it under memory pressure."""
+
+    def __init__(self, handle: int, num_rows: int, size: int,
+                 catalog: BufferCatalog,
+                 priority: int = ACTIVE_BATCHING_PRIORITY):
+        self._handle: Optional[int] = handle
+        self.num_rows = num_rows
+        self.size_bytes = size
+        self.priority = priority
+        self._catalog = catalog
+
+    @staticmethod
+    def create(batch: ColumnarBatch,
+               priority: int = ACTIVE_BATCHING_PRIORITY,
+               catalog: Optional[BufferCatalog] = None
+               ) -> "SpillableColumnarBatch":
+        catalog = catalog or BufferCatalog.get()
+        size = batch_device_bytes(batch)
+        h = catalog.add_batch(batch, priority)
+        return SpillableColumnarBatch(h, batch.num_rows_int, size, catalog,
+                                      priority)
+
+    @property
+    def catalog(self) -> BufferCatalog:
+        return self._catalog
+
+    def get(self) -> ColumnarBatch:
+        if self._handle is None:
+            raise ValueError("SpillableColumnarBatch already closed")
+        return self._catalog.get_batch(self._handle)
+
+    def get_and_close(self) -> ColumnarBatch:
+        b = self.get()
+        self.close()
+        return b
+
+    def close(self):
+        if self._handle is not None:
+            self._catalog.remove(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -269,30 +269,92 @@ class HashAggregateExec(PhysicalPlan):
 
     _finalize_jit = None
 
+    def _merge_spillables(self, spillables, fanin=8):
+        """Tree-merge partial layouts under the retry framework, bounding
+        peak device residency to ``fanin`` batches per attempt — the TPU
+        answer to the reference's incremental merge with sort/repartition
+        fallbacks (``aggregate.scala:711-792``).  A SplitAndRetryOOM halves
+        the failing group (or the batch itself when the group is one batch),
+        so recovery degrades gracefully down to two-row merges."""
+        from ...memory.retry import split_spillable_in_half, with_retry
+        from ...memory.spill import (ACTIVE_BATCHING_PRIORITY,
+                                     SpillableColumnarBatch)
+
+        class _Group:
+            def __init__(self, parts):
+                self.parts = list(parts)
+
+            def close(self):
+                for p in self.parts:
+                    p.close()
+                self.parts = []
+
+        def merge_group(g: "_Group"):
+            batches = [p.get() for p in g.parts]
+            if len(batches) == 1:
+                return batches[0]
+            return self._merge_fn(ColumnarBatch.concat(batches))
+
+        def split_group(g: "_Group"):
+            if len(g.parts) >= 2:
+                mid = len(g.parts) // 2
+                out = [_Group(g.parts[:mid]), _Group(g.parts[mid:])]
+            else:
+                halves = split_spillable_in_half(g.parts[0])
+                out = [_Group([h]) for h in halves]
+            g.parts = []  # ownership moved to the pieces
+            return out
+
+        level = list(spillables)
+        while len(level) > 1:
+            groups = [_Group(level[i:i + fanin])
+                      for i in range(0, len(level), fanin)]
+            level = [SpillableColumnarBatch.create(out, ACTIVE_BATCHING_PRIORITY)
+                     for out in with_retry(groups, merge_group,
+                                           split=split_group)]
+        return level[0]
+
     # --- execute ----------------------------------------------------------
     def execute(self, pid: int, tctx: TaskContext):
+        """Out-of-core contract (``GpuMergeAggregateIterator``
+        ``aggregate.scala:711-792``): inputs are registered as spillable the
+        moment they arrive, and every device kernel runs under the retry
+        framework so a RetryOOM spills-and-reruns and a SplitAndRetryOOM
+        halves the failing batch."""
+        from ...memory.retry import split_spillable_in_half, with_retry
+        from ...memory.spill import (ACTIVE_BATCHING_PRIORITY,
+                                     ACTIVE_ON_DECK_PRIORITY,
+                                     SpillableColumnarBatch)
         child = self.children[0]
         if self.mode == "final":
-            batches = list(child.execute(pid, tctx))
-            if not batches:
+            partials = [SpillableColumnarBatch.create(b, ACTIVE_BATCHING_PRIORITY)
+                        for b in child.execute(pid, tctx)]
+            if not partials:
                 yield self._empty_output()
                 return
-            merged = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
-            merged = self._merge_fn(merged)
+            merged = self._merge_spillables(partials).get_and_close()
             if self._finalize_jit is None:
                 self._finalize_jit = self._jit(self._finalize)
             yield self._finalize_jit(merged)
             return
 
         partials = []
-        for batch in child.execute(pid, tctx):
-            partials.append(self._partial_fn(batch))
+        try:
+            for batch in child.execute(pid, tctx):
+                sb = SpillableColumnarBatch.create(batch, ACTIVE_ON_DECK_PRIORITY)
+                for out in with_retry([sb], lambda s: self._partial_fn(s.get()),
+                                      split=split_spillable_in_half):
+                    tctx.inc_metric("aggPartialBatches")
+                    partials.append(SpillableColumnarBatch.create(
+                        out, ACTIVE_BATCHING_PRIORITY))
+        except BaseException:
+            for p in partials:
+                p.close()
+            raise
         if not partials:
             yield self._empty_output()
             return
-        merged = ColumnarBatch.concat(partials) if len(partials) > 1 else partials[0]
-        if len(partials) > 1:
-            merged = self._merge_fn(merged)
+        merged = self._merge_spillables(partials).get_and_close()
         if self.mode == "partial":
             yield merged
         else:  # complete

@@ -69,12 +69,36 @@ class PhysicalPlan:
 
     def execute_all(self, conf: Optional[RapidsConf] = None
                     ) -> List[ColumnarBatch]:
-        """Run every partition serially (local mode driver)."""
+        """Run every partition serially (local mode driver).  Each task
+        acquires the device semaphore, arms test OOM injection
+        (conftest.py:113-265 analog), and fires completion callbacks."""
+        from ...config import TEST_INJECT_RETRY_OOM, TEST_INJECT_SPLIT_OOM
+        from ...memory.completion import ScalableTaskCompletion
+        from ...memory.retry import arm_oom_injection
+        from ...memory.semaphore import TpuSemaphore
         out: List[ColumnarBatch] = []
+        sem = TpuSemaphore.get()
+        stc = ScalableTaskCompletion.get()
         for pid in range(self.num_partitions()):
             tctx = TaskContext(pid, conf)
-            with np.errstate(all="ignore"):
-                out.extend(self.execute(pid, tctx))
+            arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
+                              int(tctx.conf.get(TEST_INJECT_SPLIT_OOM)))
+            sem.acquire_if_necessary(pid, tctx)
+            failed = False
+            try:
+                with np.errstate(all="ignore"):
+                    out.extend(self.execute(pid, tctx))
+            except BaseException:
+                failed = True
+                raise
+            finally:
+                sem.release_if_necessary(pid)
+                try:
+                    stc.task_completed(pid)
+                except Exception:
+                    # never mask the task's own failure with a cleanup error
+                    if not failed:
+                        raise
         return out
 
     # --- jit plumbing for device execs ------------------------------------

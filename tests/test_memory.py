@@ -1,0 +1,248 @@
+"""Memory runtime tests — spill tiers, retry framework, semaphore, task
+completion (reference suites: RapidsDiskStoreSuite, RapidsHostMemoryStoreSuite,
+WithRetrySuite, GpuSortRetrySuite; SURVEY §4 tier 2)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import make_fixed_column
+from spark_rapids_tpu.config import (HOST_SPILL_STORAGE_SIZE, RapidsConf,
+                                     SPILL_DIR, TEST_INJECT_RETRY_OOM,
+                                     TEST_INJECT_SPLIT_OOM)
+from spark_rapids_tpu.memory import (BufferCatalog, DeviceManager, RetryOOM,
+                                     ScalableTaskCompletion,
+                                     SpillableColumnarBatch,
+                                     SplitAndRetryOOM, TpuSemaphore,
+                                     arm_oom_injection, batch_device_bytes,
+                                     split_spillable_in_half, with_retry,
+                                     with_retry_no_split)
+
+
+def make_batch(n=100, seed=0):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(seed)
+    from spark_rapids_tpu.columnar.column import bucket_capacity
+    cap = bucket_capacity(n)
+    a = np.zeros(cap, dtype=np.int64)
+    a[:n] = rng.integers(0, 1000, n)
+    b = np.zeros(cap, dtype=np.float64)
+    b[:n] = rng.random(n)
+    cols = (make_fixed_column(T.LONG, jnp.asarray(a)),
+            make_fixed_column(T.DOUBLE, jnp.asarray(b)))
+    return ColumnarBatch.make(("a", "b"), cols, n)
+
+
+def batches_equal(x: ColumnarBatch, y: ColumnarBatch) -> bool:
+    if x.num_rows_int != y.num_rows_int:
+        return False
+    n = x.num_rows_int
+    for cx, cy in zip(x.columns, y.columns):
+        if not np.array_equal(np.asarray(cx.data)[:n], np.asarray(cy.data)[:n]):
+            return False
+        if not np.array_equal(np.asarray(cx.validity)[:n],
+                              np.asarray(cy.validity)[:n]):
+            return False
+    return True
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    conf = RapidsConf({SPILL_DIR.key: str(tmp_path)})
+    cat = BufferCatalog.reset(conf)
+    yield cat
+    cat.close_all()
+    BufferCatalog.reset()
+
+
+class TestSpillFramework:
+    def test_roundtrip_device(self, catalog):
+        b = make_batch(50)
+        h = catalog.add_batch(b)
+        assert catalog.tier_of(h) == "device"
+        assert batches_equal(catalog.get_batch(h), b)
+        catalog.remove(h)
+        assert catalog.device_bytes == 0
+
+    def test_spill_to_host_and_unspill(self, catalog):
+        b = make_batch(200)
+        h = catalog.add_batch(b)
+        spilled = catalog.synchronous_spill(0)
+        assert spilled > 0
+        assert catalog.tier_of(h) == "host"
+        assert catalog.device_bytes == 0
+        got = catalog.get_batch(h)           # unspill back to device
+        assert catalog.tier_of(h) == "device"
+        assert batches_equal(got, b)
+        assert catalog.unspill_count >= 1
+
+    def test_host_overflow_to_disk(self, tmp_path):
+        conf = RapidsConf({SPILL_DIR.key: str(tmp_path),
+                           HOST_SPILL_STORAGE_SIZE.key: 1})  # 1 byte budget
+        cat = BufferCatalog.reset(conf)
+        try:
+            b = make_batch(500)
+            h = cat.add_batch(b)
+            cat.synchronous_spill(0)
+            assert cat.tier_of(h) == "disk"
+            assert cat.disk_bytes > 0
+            assert batches_equal(cat.get_batch(h), b)  # disk -> host -> device
+            assert cat.tier_of(h) == "device"
+        finally:
+            cat.close_all()
+            BufferCatalog.reset()
+
+    def test_spill_priority_order(self, catalog):
+        from spark_rapids_tpu.memory import (ACTIVE_ON_DECK_PRIORITY,
+                                             OUTPUT_FOR_SHUFFLE_PRIORITY)
+        hi = catalog.add_batch(make_batch(50, 1), ACTIVE_ON_DECK_PRIORITY)
+        lo = catalog.add_batch(make_batch(50, 2), OUTPUT_FOR_SHUFFLE_PRIORITY)
+        # spill just enough for one buffer: the low-priority one must go
+        one = batch_device_bytes(make_batch(50, 2))
+        catalog.synchronous_spill(catalog.device_bytes - one)
+        assert catalog.tier_of(lo) == "host"
+        assert catalog.tier_of(hi) == "device"
+
+    def test_ensure_headroom_spills(self, tmp_path):
+        conf = RapidsConf({SPILL_DIR.key: str(tmp_path)})
+        cat = BufferCatalog.reset(conf)
+        b = make_batch(100)
+        size = batch_device_bytes(b)
+        DeviceManager.initialize(pool_limit_override=int(size * 1.5))
+        try:
+            h1 = cat.add_batch(make_batch(100, 1))
+            assert cat.ensure_headroom(size)      # must evict h1
+            assert cat.tier_of(h1) == "host"
+        finally:
+            DeviceManager.shutdown()
+            cat.close_all()
+            BufferCatalog.reset()
+
+    def test_spillable_batch_wrapper(self, catalog):
+        b = make_batch(77)
+        sb = SpillableColumnarBatch.create(b, catalog=catalog)
+        assert sb.num_rows == 77
+        catalog.synchronous_spill(0)
+        assert batches_equal(sb.get(), b)
+        sb.close()
+        with pytest.raises(ValueError):
+            sb.get()
+
+
+class TestRetryFramework:
+    def test_retry_oom_recovers(self, catalog):
+        b = make_batch(64)
+        sb = SpillableColumnarBatch.create(b, catalog=catalog)
+        calls = {"n": 0}
+
+        def fn(s):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RetryOOM("synthetic")
+            return s.get().num_rows_int
+
+        assert with_retry_no_split(sb, fn, catalog=catalog) == 64
+        assert calls["n"] == 3
+
+    def test_split_and_retry(self, catalog):
+        b = make_batch(64)
+        sb = SpillableColumnarBatch.create(b, catalog=catalog)
+        failed = {"first": True}
+
+        def fn(s):
+            if failed["first"]:
+                failed["first"] = False
+                raise SplitAndRetryOOM("synthetic")
+            return s.get().num_rows_int
+
+        out = list(with_retry([sb], fn, split=split_spillable_in_half,
+                              catalog=catalog))
+        assert out == [32, 32]
+
+    def test_split_below_one_row_raises(self, catalog):
+        sb = SpillableColumnarBatch.create(make_batch(1), catalog=catalog)
+        with pytest.raises(SplitAndRetryOOM):
+            split_spillable_in_half(sb)
+
+    def test_injection_armed(self, catalog):
+        arm_oom_injection(retry=1)
+        sb = SpillableColumnarBatch.create(make_batch(10), catalog=catalog)
+        calls = {"n": 0}
+
+        def fn(s):
+            calls["n"] += 1
+            return s.num_rows
+
+        assert with_retry_no_split(sb, fn, catalog=catalog) == 10
+        assert calls["n"] == 1  # injection throws before fn on attempt 1
+
+    def test_query_correct_under_oom_injection(self):
+        """End-to-end: inject RetryOOM + SplitAndRetryOOM into an aggregate
+        query and require identical results (integration-test inject_oom
+        marker behavior)."""
+        data = {"k": np.arange(1000) % 7, "v": np.arange(1000, dtype=np.float64)}
+        from spark_rapids_tpu.sql import functions as F
+        s = srt.session()
+        df = s.create_dataframe(data)
+        expected = df.groupBy("k").agg(F.sum("v").alias("s")) \
+                     .orderBy("k").collect()
+        conf = RapidsConf({TEST_INJECT_RETRY_OOM.key: 1,
+                           TEST_INJECT_SPLIT_OOM.key: 1})
+        s2 = srt.session(conf=conf)
+        df2 = s2.create_dataframe(data)
+        got = df2.groupBy("k").agg(F.sum("v").alias("s")) \
+                 .orderBy("k").collect()
+        assert got.equals(expected)
+
+
+class TestSemaphore:
+    def test_limits_concurrency(self):
+        sem = TpuSemaphore(2)
+        active, peak = [0], [0]
+        lock = threading.Lock()
+
+        def task(tid):
+            sem.acquire_if_necessary(tid)
+            with lock:
+                active[0] += 1
+                peak[0] = max(peak[0], active[0])
+            time.sleep(0.02)
+            with lock:
+                active[0] -= 1
+            sem.release_if_necessary(tid)
+
+        threads = [threading.Thread(target=task, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert peak[0] <= 2
+        assert sem.active_tasks() == 0
+
+    def test_reentrant_per_task(self):
+        sem = TpuSemaphore(1)
+        sem.acquire_if_necessary(7)
+        sem.acquire_if_necessary(7)   # no deadlock: deduped
+        assert sem.holds(7)
+        sem.release_if_necessary(7)
+        assert sem.holds(7)           # still held (depth 2)
+        sem.release_if_necessary(7)
+        assert not sem.holds(7)
+
+
+class TestTaskCompletion:
+    def test_dedup_and_fire(self):
+        stc = ScalableTaskCompletion()
+        fired = []
+        owner = object()
+        assert stc.on_task_completion(1, owner, lambda: fired.append("a"))
+        assert not stc.on_task_completion(1, owner, lambda: fired.append("b"))
+        assert stc.on_task_completion(1, object(), lambda: fired.append("c"))
+        stc.task_completed(1)
+        assert fired == ["a", "c"]
+        assert stc.pending(1) == 0
