@@ -50,3 +50,541 @@ def string_equals(xp, a_chars, a_lens, b_chars, b_lens):
     in_str = pos < a_lens[:, None]
     byte_eq = (a_chars == b_chars) | ~in_str
     return same_len & xp.all(byte_eq, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Shared scatter/scan helpers (backend-agnostic over jnp / numpy)
+# ---------------------------------------------------------------------------
+
+def _is_np(xp) -> bool:
+    return xp.__name__ == "numpy"
+
+
+def scatter_set(xp, arr, rows, cols, vals):
+    """arr[rows, cols] = vals on either backend.  Callers must ensure index
+    collisions only happen at intentionally-discarded positions."""
+    if _is_np(xp):
+        arr = arr.copy()
+        arr[rows, cols] = vals
+        return arr
+    return arr.at[rows, cols].set(vals)
+
+
+def scatter_min(xp, arr, rows, cols, vals):
+    if _is_np(xp):
+        import numpy as np
+        arr = arr.copy()
+        np.minimum.at(arr, (rows, cols), vals)
+        return arr
+    return arr.at[rows, cols].min(vals)
+
+
+def scatter_bytes(xp, out_rows, out_width, rows, pos, vals, mask):
+    """Scatter byte values into a fresh [out_rows, out_width] uint8 matrix;
+    masked-out entries are redirected into a trash column."""
+    ext = xp.zeros((out_rows, out_width + 1), dtype=xp.uint8)
+    safe = xp.where(mask, xp.clip(pos, 0, out_width - 1), out_width)
+    ext = scatter_set(xp, ext, rows, safe, vals.astype(xp.uint8))
+    return ext[:, :out_width]
+
+
+def greedy_nonoverlap(xp, match_at, plens):
+    """Greedy left-to-right non-overlapping selection of match positions:
+    chosen[p] = match_at[p] and no chosen match covers p.  Sequential over
+    width — compiled as one ``lax.scan`` on the device backend."""
+    rows, w = match_at.shape
+    if _is_np(xp):
+        import numpy as np
+        chosen = np.zeros_like(match_at)
+        next_ok = np.zeros(rows, dtype=np.int32)
+        for p in range(w):
+            c = match_at[:, p] & (p >= next_ok)
+            chosen[:, p] = c
+            next_ok = np.where(c, p + plens, next_ok)
+        return chosen
+    import jax
+
+    def step(next_ok, x):
+        m, p = x
+        c = m & (p >= next_ok)
+        return xp.where(c, p + plens, next_ok), c
+
+    _, chosen_t = jax.lax.scan(
+        step, xp.zeros(rows, dtype=xp.int32),
+        (match_at.T, xp.arange(w, dtype=xp.int32)))
+    return chosen_t.T
+
+
+# ---------------------------------------------------------------------------
+# UTF-8 structure
+# ---------------------------------------------------------------------------
+
+def utf8_char_starts(xp, chars, lens):
+    """bool[rows, width]: byte starts a UTF-8 code point (and is in-string)."""
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    in_str = pos < lens[:, None]
+    return in_str & ((chars & 0xC0) != 0x80)
+
+
+def utf8_char_count(xp, chars, lens):
+    """Character (code point) count per row — Spark ``length()``."""
+    return xp.sum(utf8_char_starts(xp, chars, lens), axis=1).astype(xp.int32)
+
+
+def char_index_of_byte(xp, chars, lens):
+    """int32[rows, width]: 0-based character ordinal each byte belongs to
+    (garbage beyond the string)."""
+    starts = utf8_char_starts(xp, chars, lens)
+    return xp.cumsum(starts.astype(xp.int32), axis=1) - 1
+
+
+def byte_of_char(xp, chars, lens):
+    """int32[rows, width+1]: byte offset where character k begins; entries at
+    k >= char_count hold the byte length (so slicing [a, b) in chars maps to
+    bytes [map[a], map[b]))."""
+    rows, width = chars.shape
+    starts = utf8_char_starts(xp, chars, lens)
+    cidx = xp.cumsum(starts.astype(xp.int32), axis=1) - 1
+    init = xp.broadcast_to(lens[:, None], (rows, width + 1)).astype(xp.int32)
+    row_idx = xp.broadcast_to(xp.arange(rows)[:, None], (rows, width))
+    pos = xp.broadcast_to(xp.arange(width, dtype=xp.int32)[None, :],
+                          (rows, width))
+    # chars beyond the count scatter into slot `width` (trash); invalid cidx
+    # (continuation bytes) too
+    target = xp.where(starts, xp.clip(cidx, 0, width - 1), width)
+    ext = xp.concatenate([init, xp.full((rows, 1), 2**30, dtype=xp.int32)],
+                         axis=1)
+    ext = scatter_min(xp, ext, row_idx, target, pos)
+    return ext[:, :width + 1]
+
+
+# ---------------------------------------------------------------------------
+# Slicing / building
+# ---------------------------------------------------------------------------
+
+def gather_bytes(xp, chars, byte_start, byte_len, out_width):
+    """out[r, j] = chars[r, byte_start[r] + j] for j < byte_len[r]."""
+    rows, width = chars.shape
+    j = xp.arange(out_width, dtype=xp.int32)[None, :]
+    src = byte_start[:, None] + j
+    keep = j < byte_len[:, None]
+    src = xp.clip(src, 0, width - 1)
+    out = xp.take_along_axis(chars, src, axis=1)
+    return xp.where(keep, out, 0).astype(xp.uint8), byte_len.astype(xp.int32)
+
+
+def substring_chars(xp, chars, lens, pos, sublen=None):
+    """Spark ``substring(str, pos[, len])`` — character-based, 1-indexed,
+    negative pos counts from the end (UTF8String.substringSQL semantics:
+    a negative start that underflows shortens the result)."""
+    nchars = utf8_char_count(xp, chars, lens)
+    start = xp.where(pos > 0, pos - 1,
+                     xp.where(pos < 0, nchars + pos, 0)).astype(xp.int32)
+    if sublen is None:
+        end = nchars
+    else:
+        big = xp.asarray(2**30, dtype=xp.int64)
+        end = xp.minimum(start.astype(xp.int64) +
+                         xp.maximum(sublen, 0).astype(xp.int64), big)
+        end = end.astype(xp.int32)
+    start_c = xp.clip(start, 0, nchars)
+    end_c = xp.clip(end, 0, nchars)
+    end_c = xp.maximum(start_c, end_c)
+    bmap = byte_of_char(xp, chars, lens)
+    width = chars.shape[1]
+    bs = xp.take_along_axis(bmap, start_c[:, None], axis=1)[:, 0]
+    be = xp.take_along_axis(bmap, end_c[:, None], axis=1)[:, 0]
+    return gather_bytes(xp, chars, bs, be - bs, width)
+
+
+def concat_bytes(xp, pieces, out_width):
+    """Concatenate per-row byte strings: pieces = [(chars, lens), ...]."""
+    rows = pieces[0][0].shape[0]
+    total = None
+    offset = xp.zeros(rows, dtype=xp.int32)
+    out = xp.zeros((rows, out_width + 1), dtype=xp.uint8)
+    row_idx2 = None
+    for chars, lens in pieces:
+        w = chars.shape[1]
+        j = xp.arange(w, dtype=xp.int32)[None, :]
+        pos = offset[:, None] + j
+        mask = (j < lens[:, None]) & (pos < out_width)
+        safe = xp.where(mask, xp.clip(pos, 0, out_width - 1), out_width)
+        rows_idx = xp.broadcast_to(xp.arange(rows)[:, None], (rows, w))
+        out = scatter_set(xp, out, rows_idx, safe, chars)
+        offset = offset + lens.astype(xp.int32)
+    # clamp: an output that would overflow the width bucket is truncated,
+    # keeping the lens <= width layout invariant
+    return out[:, :out_width], xp.minimum(offset, out_width)
+
+
+# ---------------------------------------------------------------------------
+# Search
+# ---------------------------------------------------------------------------
+
+def match_positions(xp, chars, lens, pat, plens):
+    """bool[rows, width]: pattern matches starting at each byte position
+    (empty pattern matches everywhere inside the string)."""
+    rows, width = chars.shape
+    pw = pat.shape[1]
+    ext = xp.concatenate(
+        [chars, xp.zeros((rows, max(pw, 1)), dtype=xp.uint8)], axis=1)
+    ok = xp.ones((rows, width), dtype=bool)
+    for j in range(pw):
+        cmp = ext[:, j:j + width] == pat[:, j:j + 1]
+        ok = ok & (cmp | (j >= plens[:, None]))
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    fits = pos + plens[:, None] <= lens[:, None]
+    return ok & fits
+
+
+def find_bytes(xp, chars, lens, pat, plens, start=None):
+    """First byte index >= start where pat occurs, else -1 (str.indexOf)."""
+    m = match_positions(xp, chars, lens, pat, plens)
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    if start is not None:
+        m = m & (pos >= start[:, None])
+    any_m = xp.any(m, axis=1)
+    first = xp.argmax(m, axis=1).astype(xp.int32)
+    return xp.where(any_m, first, -1)
+
+
+def starts_with(xp, chars, lens, pat, plens):
+    m = match_positions(xp, chars, lens, pat, plens)
+    return m[:, 0] | (plens == 0)
+
+
+def ends_with(xp, chars, lens, pat, plens):
+    m = match_positions(xp, chars, lens, pat, plens)
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    at_end = pos == (lens - plens)[:, None]
+    return xp.any(m & at_end, axis=1) | (plens == 0)
+
+
+def contains_bytes(xp, chars, lens, pat, plens):
+    return find_bytes(xp, chars, lens, pat, plens) >= 0
+
+
+# ---------------------------------------------------------------------------
+# Transforms
+# ---------------------------------------------------------------------------
+
+def ascii_upper(xp, chars, lens):
+    is_lower = (chars >= 97) & (chars <= 122)
+    return xp.where(is_lower, chars - 32, chars), lens
+
+
+def ascii_lower(xp, chars, lens):
+    is_upper = (chars >= 65) & (chars <= 90)
+    return xp.where(is_upper, chars + 32, chars), lens
+
+
+def initcap(xp, chars, lens):
+    """Spark ``initcap``: first character of each space-separated word is
+    title-cased, the rest lower-cased (ASCII subset)."""
+    rows, width = chars.shape
+    prev = xp.concatenate(
+        [xp.full((rows, 1), 32, dtype=xp.uint8), chars[:, :-1]], axis=1)
+    word_start = prev == 32
+    up, _ = ascii_upper(xp, chars, lens)
+    lo, _ = ascii_lower(xp, chars, lens)
+    return xp.where(word_start, up, lo), lens
+
+
+def reverse_chars(xp, chars, lens):
+    """Reverse by character (multi-byte UTF-8 sequences stay intact).
+    Input char c spans bytes [bmap[c], bmap[c+1]); in the reversed output it
+    lands at offset len - bmap[c+1]."""
+    rows, width = chars.shape
+    cidx = char_index_of_byte(xp, chars, lens)
+    bmap = byte_of_char(xp, chars, lens)
+    pos = xp.broadcast_to(xp.arange(width, dtype=xp.int32)[None, :],
+                          (rows, width))
+    in_str = pos < lens[:, None]
+    safe_c = xp.clip(cidx, 0, width - 1)
+    src_base = xp.take_along_axis(bmap, safe_c, axis=1)
+    src_end = xp.take_along_axis(bmap, safe_c + 1, axis=1)
+    out_pos = (lens[:, None] - src_end) + (pos - src_base)
+    rows_idx = xp.broadcast_to(xp.arange(rows)[:, None], (rows, width))
+    out = scatter_bytes(xp, rows, width, rows_idx, out_pos, chars, in_str)
+    return out, lens
+
+
+def repeat_bytes(xp, chars, lens, n, out_width):
+    """str * n (n per-row, >= 0): out[j] = chars[j % len] for j < len*n."""
+    rows, width = chars.shape
+    n = xp.maximum(n, 0).astype(xp.int64)
+    new_len = xp.minimum(lens.astype(xp.int64) * n, out_width).astype(xp.int32)
+    j = xp.arange(out_width, dtype=xp.int32)[None, :]
+    safe_len = xp.maximum(lens[:, None], 1)
+    src = (j % safe_len).astype(xp.int32)
+    src = xp.clip(src, 0, width - 1)
+    out = xp.take_along_axis(
+        xp.pad(chars, ((0, 0), (0, max(0, out_width - width)))), src, axis=1) \
+        if width < out_width else xp.take_along_axis(chars, src, axis=1)
+    keep = j < new_len[:, None]
+    return xp.where(keep, out, 0).astype(xp.uint8), new_len
+
+
+def pad_bytes(xp, chars, lens, target, pad, plens, out_width, left: bool):
+    """Spark lpad/rpad (byte-level; exact for ASCII pad/target semantics).
+    Truncates to ``target`` when the input is longer."""
+    rows, width = chars.shape
+    target = xp.maximum(target.astype(xp.int32), 0)
+    trunc_len = xp.minimum(lens, target)
+    n_pad = xp.maximum(target - lens, 0)
+    n_pad = xp.where(plens > 0, n_pad, 0)
+    new_len = trunc_len + n_pad
+    j = xp.arange(out_width, dtype=xp.int32)[None, :]
+    safe_plen = xp.maximum(plens[:, None], 1)
+    if left:
+        in_pad = j < n_pad[:, None]
+        pad_src = (j % safe_plen).astype(xp.int32)
+        str_src = j - n_pad[:, None]
+    else:
+        in_pad = (j >= trunc_len[:, None]) & (j < new_len[:, None])
+        pad_src = ((j - trunc_len[:, None]) % safe_plen).astype(xp.int32)
+        str_src = j
+    pw = pad.shape[1]
+    pad_vals = xp.take_along_axis(pad, xp.clip(pad_src, 0, pw - 1), axis=1)
+    str_vals = xp.take_along_axis(chars, xp.clip(str_src, 0, width - 1), axis=1)
+    in_str = (str_src >= 0) & (str_src < trunc_len[:, None])
+    out = xp.where(in_pad, pad_vals, xp.where(in_str, str_vals, 0))
+    keep = j < new_len[:, None]
+    return xp.where(keep, out, 0).astype(xp.uint8), new_len
+
+
+def trim_bytes(xp, chars, lens, trim_lut, left=True, right=True):
+    """Trim leading/trailing bytes found in ``trim_lut`` (bool[256])."""
+    rows, width = chars.shape
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    in_str = pos < lens[:, None]
+    in_set = xp.take(trim_lut, chars.astype(xp.int32)) & in_str
+    if left:
+        lead_run = xp.cumprod(in_set.astype(xp.int32), axis=1)
+        n_lead = xp.sum(lead_run * in_str, axis=1).astype(xp.int32)
+    else:
+        n_lead = xp.zeros(chars.shape[0], dtype=xp.int32)
+    if right:
+        # trailing in-set run within the string: walk from the right by
+        # treating out-of-string positions as in-set
+        rset = xp.flip(in_set | ~in_str, axis=1)
+        trail_run = xp.cumprod(rset.astype(xp.int32), axis=1)
+        n_trail_total = xp.sum(trail_run, axis=1).astype(xp.int32)
+        n_trail = n_trail_total - (width - lens)
+    else:
+        n_trail = xp.zeros(chars.shape[0], dtype=xp.int32)
+    n_lead = xp.minimum(n_lead, lens)
+    new_len = xp.maximum(lens - n_lead - n_trail, 0)
+    return gather_bytes(xp, chars, n_lead, new_len, width)
+
+
+def replace_bytes(xp, chars, lens, pat, plens, rep, rlens, out_width):
+    """Replace all non-overlapping occurrences of pat with rep
+    (str.replace; empty pattern = no-op like Spark)."""
+    rows, width = chars.shape
+    m = match_positions(xp, chars, lens, pat, plens) & (plens > 0)[:, None]
+    chosen = greedy_nonoverlap(xp, m, plens)
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    in_str = pos < lens[:, None]
+    # inside[p]: p is covered by a chosen match (skip these bytes)
+    # cumulative covered-end: for each p, was there a chosen match at q with
+    # q <= p < q+plen?  end_run[p] = max over q<=p of (q+plen if chosen else 0)
+    start_end = xp.where(chosen, pos + plens[:, None], 0)
+    if _is_np(xp):
+        import numpy as np
+        run_end = np.maximum.accumulate(start_end, axis=1)
+    else:
+        import jax
+        run_end = jax.lax.associative_scan(xp.maximum, start_end, axis=1)
+    inside = pos < run_end
+    copy_mask = in_str & ~inside
+    contrib = xp.where(chosen, rlens[:, None],
+                       xp.where(copy_mask, 1, 0)).astype(xp.int32)
+    out_off = xp.cumsum(contrib, axis=1) - contrib  # exclusive prefix sum
+    new_len = xp.minimum(xp.sum(contrib, axis=1), out_width).astype(xp.int32)
+    rows_idx = xp.broadcast_to(xp.arange(rows)[:, None], (rows, width))
+    out = scatter_bytes(xp, rows, out_width, rows_idx, out_off, chars,
+                        copy_mask & (out_off < out_width))
+    rw = rep.shape[1]
+    for j in range(rw):
+        mask_j = chosen & (j < rlens[:, None]) & (out_off + j < out_width)
+        vals = xp.broadcast_to(rep[:, j:j + 1], (rows, width))
+        ext = xp.concatenate(
+            [out, xp.zeros((rows, 1), dtype=xp.uint8)], axis=1)
+        safe = xp.where(mask_j, xp.clip(out_off + j, 0, out_width - 1),
+                        out_width)
+        ext = scatter_set(xp, ext, rows_idx, safe, vals)
+        out = ext[:, :out_width]
+    return out, new_len
+
+
+def translate_bytes(xp, chars, lens, lut):
+    """Apply a 256-entry byte map; entries of -1 delete the byte (ASCII
+    translate)."""
+    rows, width = chars.shape
+    mapped = xp.take(lut, chars.astype(xp.int32))
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    in_str = pos < lens[:, None]
+    keep = in_str & (mapped >= 0)
+    out_off = xp.cumsum(keep.astype(xp.int32), axis=1) - keep.astype(xp.int32)
+    new_len = xp.sum(keep, axis=1).astype(xp.int32)
+    rows_idx = xp.broadcast_to(xp.arange(rows)[:, None], (rows, width))
+    out = scatter_bytes(xp, rows, width, rows_idx, out_off,
+                        mapped.astype(xp.uint8), keep)
+    return out, new_len
+
+
+def substring_index_bytes(xp, chars, lens, pat, plens, count):
+    """Spark substring_index(str, delim, count): everything before the
+    count-th delimiter (from the left for count>0, right for count<0);
+    the whole string when |count| exceeds the occurrence count."""
+    rows, width = chars.shape
+    m = match_positions(xp, chars, lens, pat, plens) & (plens > 0)[:, None]
+    cnt = count.astype(xp.int32)
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    # positive counts: greedy left-to-right occurrence selection; negative
+    # counts: greedy right-to-left (Spark lastIndexOf walks from the end,
+    # which differs on self-overlapping delimiters like 'aa' in 'aaa')
+    chosen = greedy_nonoverlap(xp, m, plens)
+    chosen_r = xp.flip(greedy_nonoverlap(xp, xp.flip(m, axis=1), plens),
+                       axis=1)
+    occ = xp.cumsum(chosen.astype(xp.int32), axis=1)
+    total = occ[:, -1] if width > 0 else xp.zeros(rows, dtype=xp.int32)
+    occ_r = xp.flip(xp.cumsum(xp.flip(chosen_r.astype(xp.int32), axis=1),
+                              axis=1), axis=1)
+    total_r = occ_r[:, 0] if width > 0 else xp.zeros(rows, dtype=xp.int32)
+    # position of k-th (1-based) chosen match from the left
+    pos_kth = xp.where(chosen & (occ == cnt[:, None]), pos, width)
+    kth = xp.min(pos_kth, axis=1).astype(xp.int32)
+    # position of |count|-th chosen match from the right
+    pos_kr = xp.where(chosen_r & (occ_r == (-cnt)[:, None]), pos, -1)
+    kr = xp.max(pos_kr, axis=1).astype(xp.int32)
+    have_left = (cnt > 0) & (total >= cnt)
+    have_right = (cnt < 0) & (total_r >= -cnt)
+    start = xp.where(have_right, kr + plens, 0)
+    end = xp.where(have_left, kth, lens)
+    zero = cnt == 0
+    start = xp.where(zero, 0, start)
+    end = xp.where(zero, 0, end)
+    return gather_bytes(xp, chars, start, xp.maximum(end - start, 0), width)
+
+
+def byte_pos_to_char_pos(xp, chars, lens, byte_pos):
+    """Convert 0-based byte position to 0-based char ordinal (-1 stays -1)."""
+    cidx = char_index_of_byte(xp, chars, lens)
+    width = chars.shape[1]
+    safe = xp.clip(byte_pos, 0, width - 1)
+    c = xp.take_along_axis(cidx, safe[:, None], axis=1)[:, 0]
+    return xp.where(byte_pos < 0, -1, c)
+
+
+def char_pos_to_byte_pos(xp, chars, lens, char_pos):
+    bmap = byte_of_char(xp, chars, lens)
+    width = chars.shape[1]
+    safe = xp.clip(char_pos, 0, width)
+    return xp.take_along_axis(bmap, safe[:, None], axis=1)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# SQL LIKE (host-compiled pattern, device-executed chunk search)
+# ---------------------------------------------------------------------------
+
+def parse_like_pattern(pattern: str, escape: str = "\\"):
+    """Split a LIKE pattern into literal chunks separated by %.  Each chunk
+    is a list of (byte, is_wildcard) where is_wildcard marks ``_``.
+    Returns (chunks, leading_pct, trailing_pct).  Raises ValueError on a
+    dangling escape (Spark throws too)."""
+    chunks, cur = [], []
+    leading = False
+    trailing = False
+    i = 0
+    b = pattern.encode("utf-8")
+    esc = escape.encode("utf-8")[0] if escape else None
+    while i < len(b):
+        c = b[i]
+        if esc is not None and c == esc:
+            if i + 1 >= len(b):
+                raise ValueError(f"invalid escape at end of LIKE pattern "
+                                 f"{pattern!r}")
+            cur.append((b[i + 1], False))
+            trailing = False
+            i += 2
+            continue
+        if c == 0x25:  # %
+            if not cur and not chunks:
+                leading = True
+            if cur:
+                chunks.append(cur)
+                cur = []
+            trailing = True  # stands until a later token clears it
+            i += 1
+            continue
+        if c == 0x5F:  # _
+            cur.append((0, True))
+        else:
+            cur.append((c, False))
+        trailing = False
+        i += 1
+    if cur:
+        chunks.append(cur)
+    return chunks, leading, trailing
+
+
+def _match_chunk(xp, chars, lens, chunk):
+    """bool[rows, width]: chunk (host constant) matches at each position."""
+    rows, width = chars.shape
+    clen = len(chunk)
+    ext = xp.concatenate(
+        [chars, xp.zeros((rows, max(clen, 1)), dtype=xp.uint8)], axis=1)
+    ok = xp.ones((rows, width), dtype=bool)
+    for j, (byte, wild) in enumerate(chunk):
+        if wild:
+            continue
+        ok = ok & (ext[:, j:j + width] == byte)
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    return ok & (pos + clen <= lens[:, None])
+
+
+def like_match(xp, chars, lens, pattern: str, escape: str = "\\"):
+    """Vectorized LIKE: ordered chunk search with anchored first/last chunk.
+    Literal chunks compare bytes, which is exact for any UTF-8 data; ``_``
+    however consumes one BYTE, so the overrides layer routes patterns
+    containing ``_`` (and non-ASCII patterns) to the host engine where a
+    character-exact matcher runs."""
+    chunks, leading, trailing = parse_like_pattern(pattern, escape)
+    rows, width = chars.shape
+    ok = xp.ones(rows, dtype=bool)
+    if not chunks:
+        # pattern was only % signs (or empty)
+        if "%" in pattern:
+            return ok
+        return lens == 0
+    pos = xp.zeros(rows, dtype=xp.int32)
+    n = len(chunks)
+    for i, chunk in enumerate(chunks):
+        clen = len(chunk)
+        m = _match_chunk(xp, chars, lens, chunk)
+        first_anchored = (i == 0 and not leading)
+        last_anchored = (i == n - 1 and not trailing)
+        if last_anchored:
+            at = xp.clip(lens - clen, 0, width - 1)
+            hit = xp.take_along_axis(m, at[:, None], axis=1)[:, 0]
+            ok = ok & hit & (lens - clen >= pos)
+            if first_anchored:  # no % at all: exact-shape match
+                ok = ok & (lens == clen)
+            pos = lens
+        elif first_anchored:
+            ok = ok & (m[:, 0] if width > 0 else lens == 0)
+            pos = xp.full(rows, clen, dtype=xp.int32)
+        else:
+            p = xp.arange(width, dtype=xp.int32)[None, :]
+            cand = m & (p >= pos[:, None])
+            any_m = xp.any(cand, axis=1)
+            first = xp.argmax(cand, axis=1).astype(xp.int32)
+            ok = ok & any_m
+            pos = first + clen
+    return ok

@@ -14,6 +14,7 @@ from . import conditional as Cond
 from . import hashing as Hsh
 from . import math_fns as M
 from . import predicates as P
+from . import strings as Str
 
 EXPRESSION_REGISTRY: Dict[str, Type[Expression]] = {}
 
@@ -41,3 +42,10 @@ _reg(Cond.If, Cond.CaseWhen, Cond.Coalesce, Cond.NaNvl, Cond.KnownNotNull,
      Cond.RaiseError)
 _reg(C.Cast)
 _reg(Hsh.Murmur3Hash, Hsh.XxHash64)
+_reg(Str.Length, Str.OctetLength, Str.BitLength, Str.Upper, Str.Lower,
+     Str.InitCap, Str.Reverse, Str.Substring, Str.SubstringIndex, Str.Concat,
+     Str.ConcatWs, Str.Contains, Str.StartsWith, Str.EndsWith, Str.Like,
+     Str.StringInstr, Str.StringLocate, Str.StringReplace, Str.StringTranslate,
+     Str.StringRepeat, Str.StringLPad, Str.StringRPad, Str.StringTrim,
+     Str.StringTrimLeft, Str.StringTrimRight, Str.FormatNumber, Str.Conv,
+     Str.Md5)

@@ -1,0 +1,785 @@
+"""String expression family — the TPU port of the reference's
+``org/apache/spark/sql/rapids/stringFunctions.scala`` (2737 LoC; SURVEY
+§2.4).  Compute runs on the padded byte-matrix layout via the vectorized
+kernels in ``ops/strings_ops.py`` under either backend; a handful of exact
+corner cases (FormatNumber, Conv, Md5) run host-side like the reference's
+incompat-flagged ops.
+
+Unicode stance: length/substring/reverse/instr/locate are fully UTF-8-aware
+(character-based).  upper/lower/initcap and LIKE ``_`` operate on
+ASCII — non-ASCII inputs pass through unchanged — mirroring the reference's
+documented compatibility corners.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn, bucket_width
+from ...ops import strings_ops as S
+from .core import (BinaryExpression, EvalContext, Expression, LeafExpression,
+                   Literal, UnaryExpression, valid_and)
+
+_MAX_STR_BYTES = 1 << 14
+
+
+def _sl(col: DeviceColumn) -> Tuple:
+    """(chars, lens) view of a string column."""
+    return col.data, col.lengths
+
+
+def _mk(dtype, chars, lens, validity) -> DeviceColumn:
+    return DeviceColumn(dtype, chars, validity, lengths=lens)
+
+
+def _lit_str(e: Expression) -> Optional[str]:
+    if isinstance(e, Literal) and isinstance(e.value, str):
+        return e.value
+    return None
+
+
+def _require_literal(e: Expression, what: str) -> Optional[str]:
+    """tag_for_device helper: reason string when e is not a string literal."""
+    if _lit_str(e) is None:
+        return f"{what} must be a literal string to run on the device"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Measures
+# ---------------------------------------------------------------------------
+
+class Length(UnaryExpression):
+    """Character count (UTF-8 aware), Spark ``length``."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, c):
+        n = S.utf8_char_count(ctx.xp, *_sl(c))
+        return DeviceColumn(T.INT, n, c.validity)
+
+
+class OctetLength(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, c):
+        return DeviceColumn(T.INT, c.lengths.astype(ctx.xp.int32), c.validity)
+
+
+class BitLength(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, c):
+        return DeviceColumn(T.INT, (c.lengths * 8).astype(ctx.xp.int32),
+                            c.validity)
+
+
+# ---------------------------------------------------------------------------
+# Case / shape transforms
+# ---------------------------------------------------------------------------
+
+class _StringTransform(UnaryExpression):
+    _kernel_fn = None
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, c):
+        chars, lens = type(self)._kernel_fn(ctx.xp, *_sl(c))
+        return _mk(T.STRING, chars, lens, c.validity)
+
+
+class Upper(_StringTransform):
+    _kernel_fn = staticmethod(S.ascii_upper)
+
+
+class Lower(_StringTransform):
+    _kernel_fn = staticmethod(S.ascii_lower)
+
+
+class InitCap(_StringTransform):
+    _kernel_fn = staticmethod(S.initcap)
+
+
+class Reverse(_StringTransform):
+    """String reverse (array reverse lives in collections)."""
+    _kernel_fn = staticmethod(S.reverse_chars)
+
+
+# ---------------------------------------------------------------------------
+# Substrings
+# ---------------------------------------------------------------------------
+
+class Substring(Expression):
+    def __init__(self, child, pos, length=None):
+        from .core import resolve_expression as r
+        self.children = ((r(child), r(pos)) if length is None
+                         else (r(child), r(pos), r(length)))
+
+    def with_children(self, children):
+        out = object.__new__(Substring)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, c, p, l=None):
+        xp = ctx.xp
+        sublen = None if l is None else l.data.astype(xp.int64)
+        chars, lens = S.substring_chars(xp, *_sl(c), p.data.astype(xp.int32),
+                                        sublen)
+        cols = [c, p] if l is None else [c, p, l]
+        return _mk(T.STRING, chars, lens, valid_and(xp, *cols))
+
+
+class SubstringIndex(Expression):
+    def __init__(self, child, delim, count):
+        from .core import resolve_expression as r
+        self.children = (r(child), r(delim), r(count))
+
+    def with_children(self, children):
+        out = object.__new__(SubstringIndex)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, c, d, n):
+        xp = ctx.xp
+        chars, lens = S.substring_index_bytes(
+            xp, *_sl(c), d.data, d.lengths, n.data.astype(xp.int32))
+        return _mk(T.STRING, chars, lens, valid_and(xp, c, d, n))
+
+
+# ---------------------------------------------------------------------------
+# Concatenation
+# ---------------------------------------------------------------------------
+
+class Concat(Expression):
+    """String concat; null if any input is null (Spark Concat)."""
+
+    def __init__(self, *children):
+        from .core import resolve_expression as r
+        self.children = tuple(r(c) for c in children)
+
+    def with_children(self, children):
+        out = object.__new__(Concat)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        if not cols:
+            from .core import literal_column
+            return literal_column(ctx, T.STRING, "")
+        out_width = bucket_width(sum(c.data.shape[1] for c in cols))
+        out_width = min(out_width, _MAX_STR_BYTES)
+        chars, lens = S.concat_bytes(xp, [_sl(c) for c in cols], out_width)
+        return _mk(T.STRING, chars, lens, valid_and(xp, *cols))
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, ...): null inputs are skipped; null only when the
+    separator is null (Spark semantics)."""
+
+    def __init__(self, sep, *children):
+        from .core import resolve_expression as r
+        self.children = (r(sep),) + tuple(r(c) for c in children)
+
+    def with_children(self, children):
+        out = object.__new__(ConcatWs)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def kernel(self, ctx, sep, *cols):
+        xp = ctx.xp
+        rows = sep.data.shape[0]
+        widths = sep.data.shape[1] * max(len(cols), 1) + sum(
+            c.data.shape[1] for c in cols)
+        out_width = min(bucket_width(widths), _MAX_STR_BYTES)
+        pieces = []
+        has_prev = xp.zeros(rows, dtype=bool)
+        for c in cols:
+            v = c.validity
+            # separator slot before this piece: emitted iff piece valid and
+            # something came before
+            sep_lens = xp.where(has_prev & v, sep.lengths, 0)
+            pieces.append((sep.data, sep_lens))
+            pieces.append((c.data, xp.where(v, c.lengths, 0)))
+            has_prev = has_prev | v
+        if not pieces:
+            pieces = [(sep.data, xp.zeros(rows, dtype=xp.int32))]
+        chars, lens = S.concat_bytes(xp, pieces, out_width)
+        return _mk(T.STRING, chars, lens, sep.validity)
+
+
+# ---------------------------------------------------------------------------
+# Predicates / search
+# ---------------------------------------------------------------------------
+
+class _StringPredicate(BinaryExpression):
+    _kernel_fn = None
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        r = type(self)._kernel_fn(xp, a.data, a.lengths, b.data, b.lengths)
+        return DeviceColumn(T.BOOLEAN, r, valid_and(xp, a, b))
+
+
+class Contains(_StringPredicate):
+    _kernel_fn = staticmethod(S.contains_bytes)
+
+
+class StartsWith(_StringPredicate):
+    _kernel_fn = staticmethod(S.starts_with)
+
+
+class EndsWith(_StringPredicate):
+    _kernel_fn = staticmethod(S.ends_with)
+
+
+class Like(BinaryExpression):
+    def __init__(self, left, right, escape: str = "\\"):
+        super().__init__(left, right)
+        self.escape = escape
+
+    def with_children(self, children):
+        return Like(children[0], children[1], self.escape)
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _key_extras(self):
+        return (self.escape,)
+
+    def tag_for_device(self) -> Optional[str]:
+        r = _require_literal(self.children[1], "LIKE pattern")
+        if r:
+            return r
+        pat = _lit_str(self.children[1])
+        if any(ord(ch) > 127 for ch in pat):
+            return "non-ASCII LIKE patterns run on the host"
+        if "_" in pat.replace(self.escape + "_", ""):
+            # '_' must consume one CHARACTER; the byte-matcher can't on
+            # arbitrary UTF-8 column data
+            return "LIKE patterns with `_` run on the host (character-exact)"
+        return None
+
+    @staticmethod
+    def _host_like(s: str, pt: str, escape: str) -> bool:
+        import re
+        rx, i = [], 0
+        while i < len(pt):
+            ch = pt[i]
+            if escape and ch == escape and i + 1 < len(pt):
+                rx.append(re.escape(pt[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                rx.append(".*")
+            elif ch == "_":
+                rx.append(".")
+            else:
+                rx.append(re.escape(ch))
+            i += 1
+        return re.fullmatch("".join(rx), s, re.DOTALL) is not None
+
+    def kernel(self, ctx, c, p):
+        pat = _lit_str(self.children[1])
+        if not ctx.is_device:
+            # character-exact host matcher (fallback target for `_`,
+            # non-ASCII, and non-literal patterns)
+            out = np.zeros(c.data.shape[0], dtype=bool)
+            for i in range(c.data.shape[0]):
+                s = bytes(np.asarray(c.data)[i, :int(np.asarray(c.lengths)[i])]
+                          ).decode("utf-8", "replace")
+                pt = pat if pat is not None else bytes(
+                    np.asarray(p.data)[i, :int(np.asarray(p.lengths)[i])]
+                ).decode("utf-8", "replace")
+                out[i] = self._host_like(s, pt, self.escape)
+            return DeviceColumn(T.BOOLEAN, out, valid_and(ctx.xp, c, p))
+        if pat is None:
+            raise RuntimeError("LIKE with non-literal pattern on device")
+        r = S.like_match(ctx.xp, c.data, c.lengths, pat, self.escape)
+        return DeviceColumn(T.BOOLEAN, r, valid_and(ctx.xp, c, p))
+
+
+class StringInstr(BinaryExpression):
+    """instr(str, substr): 1-based character position, 0 when absent."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, c, sub):
+        xp = ctx.xp
+        bpos = S.find_bytes(xp, c.data, c.lengths, sub.data, sub.lengths)
+        cpos = S.byte_pos_to_char_pos(xp, c.data, c.lengths, bpos)
+        return DeviceColumn(T.INT, (cpos + 1).astype(xp.int32),
+                            valid_and(xp, c, sub))
+
+
+class StringLocate(Expression):
+    """locate(substr, str, start): like instr with a 1-based start char."""
+
+    def __init__(self, substr, strc, start=None):
+        from .core import resolve_expression as r
+        start = Literal(1) if start is None else r(start)
+        self.children = (r(substr), r(strc), start)
+
+    def with_children(self, children):
+        out = object.__new__(StringLocate)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, sub, c, start):
+        xp = ctx.xp
+        start_c = xp.maximum(start.data.astype(xp.int32), 1) - 1
+        bstart = S.char_pos_to_byte_pos(xp, c.data, c.lengths, start_c)
+        bpos = S.find_bytes(xp, c.data, c.lengths, sub.data, sub.lengths,
+                            bstart)
+        cpos = S.byte_pos_to_char_pos(xp, c.data, c.lengths, bpos)
+        # Spark: locate with start<=0 returns 0; null substr/str -> null
+        res = xp.where(start.data > 0, (cpos + 1).astype(xp.int32), 0)
+        return DeviceColumn(T.INT, res, valid_and(xp, sub, c, start))
+
+
+# ---------------------------------------------------------------------------
+# Editing
+# ---------------------------------------------------------------------------
+
+class StringReplace(Expression):
+    def __init__(self, child, search, replace):
+        from .core import resolve_expression as r
+        self.children = (r(child), r(search), r(replace))
+
+    def with_children(self, children):
+        out = object.__new__(StringReplace)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, c, s, r):
+        xp = ctx.xp
+        ls, lr = _lit_str(self.children[1]), _lit_str(self.children[2])
+        if ls is not None and lr is not None and len(ls.encode()) > 0:
+            # literal pattern: tight bound on growth
+            bound = (c.data.shape[1] // len(ls.encode())) * len(lr.encode()) \
+                + c.data.shape[1]
+        else:
+            bound = c.data.shape[1] * max(1, r.data.shape[1])
+        out_width = min(bucket_width(max(bound, 1)), _MAX_STR_BYTES)
+        chars, lens = S.replace_bytes(xp, c.data, c.lengths, s.data, s.lengths,
+                                      r.data, r.lengths, out_width)
+        return _mk(T.STRING, chars, lens, valid_and(xp, c, s, r))
+
+
+class StringTranslate(Expression):
+    def __init__(self, child, from_s, to_s):
+        from .core import resolve_expression as r
+        self.children = (r(child), r(from_s), r(to_s))
+
+    def with_children(self, children):
+        out = object.__new__(StringTranslate)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self) -> Optional[str]:
+        for i, what in ((1, "translate from-set"), (2, "translate to-set")):
+            r = _require_literal(self.children[i], what)
+            if r:
+                return r
+            if any(ord(ch) > 127 for ch in _lit_str(self.children[i])):
+                return "non-ASCII translate runs on the host"
+        return None
+
+    def kernel(self, ctx, c, f, t):
+        xp = ctx.xp
+        fs, ts = _lit_str(self.children[1]), _lit_str(self.children[2])
+        if fs is None or ts is None or not (fs + ts).isascii():
+            if ctx.is_device:
+                raise RuntimeError("non-literal/non-ASCII translate on device")
+            return self._host_kernel(ctx, c, f, t)
+        lut = np.arange(256, dtype=np.int32)
+        seen = set()
+        for i, ch in enumerate(fs):
+            b = ord(ch)
+            if b < 256 and b not in seen:  # first mapping wins (Spark)
+                seen.add(b)
+                lut[b] = ord(ts[i]) if i < len(ts) else -1
+        chars, lens = S.translate_bytes(xp, c.data, c.lengths,
+                                        xp.asarray(lut))
+        return _mk(T.STRING, chars, lens, valid_and(xp, c, f, t))
+
+    def _host_kernel(self, ctx, c, f, t):
+        helper = _HostStringExpr()
+        strs = list(helper._host_rows(ctx, c))
+        froms = list(helper._host_rows(ctx, f))
+        tos = list(helper._host_rows(ctx, t))
+        out = []
+        for s_, fr, to in zip(strs, froms, tos):
+            if s_ is None or fr is None or to is None:
+                out.append(None)
+                continue
+            table, seen = {}, set()
+            for i, ch in enumerate(fr):
+                if ch not in seen:
+                    seen.add(ch)
+                    table[ord(ch)] = to[i] if i < len(to) else None
+            out.append(s_.translate(table))
+        valid = (np.asarray(c.validity) & np.asarray(f.validity)
+                 & np.asarray(t.validity))
+        return helper._pack(ctx, out, ctx.xp.asarray(valid))
+
+
+class StringRepeat(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self) -> Optional[str]:
+        n = self.children[1]
+        if not (isinstance(n, Literal) and isinstance(n.value, int)):
+            return "repeat count must be a literal to run on the device"
+        return None
+
+    def kernel(self, ctx, c, n):
+        xp = ctx.xp
+        lit = self.children[1]
+        if isinstance(lit, Literal) and isinstance(lit.value, int):
+            max_n = max(int(lit.value), 0)
+        else:
+            max_n = int(np.max(np.maximum(np.asarray(n.data), 0)))
+        out_width = min(bucket_width(max(c.data.shape[1] * max_n, 1)),
+                        _MAX_STR_BYTES)
+        chars, lens = S.repeat_bytes(xp, c.data, c.lengths, n.data, out_width)
+        return _mk(T.STRING, chars, lens, valid_and(xp, c, n))
+
+
+class _PadBase(Expression):
+    _left = True
+
+    def __init__(self, child, length, pad=None):
+        from .core import resolve_expression as r
+        pad = Literal(" ") if pad is None else r(pad)
+        self.children = (r(child), r(length), pad)
+
+    def with_children(self, children):
+        out = object.__new__(type(self))
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, c, l, p):
+        xp = ctx.xp
+        lit = self.children[1]
+        if isinstance(lit, Literal) and isinstance(lit.value, int):
+            max_t = max(int(lit.value), c.data.shape[1])
+        else:
+            max_t = max(int(np.max(np.asarray(l.data), initial=0)),
+                        c.data.shape[1])
+        out_width = min(bucket_width(max(max_t, 1)), _MAX_STR_BYTES)
+        chars, lens = S.pad_bytes(xp, c.data, c.lengths,
+                                  l.data.astype(xp.int32), p.data, p.lengths,
+                                  out_width, left=self._left)
+        return _mk(T.STRING, chars, lens, valid_and(xp, c, l, p))
+
+    def tag_for_device(self) -> Optional[str]:
+        lit = self.children[1]
+        if not (isinstance(lit, Literal) and isinstance(lit.value, int)):
+            return "pad target length must be a literal to run on the device"
+        return None
+
+
+class StringLPad(_PadBase):
+    _left = True
+
+
+class StringRPad(_PadBase):
+    _left = False
+
+
+class _TrimBase(Expression):
+    _left = True
+    _right = True
+
+    def __init__(self, child, trim_str=None):
+        from .core import resolve_expression as r
+        self.children = ((r(child),) if trim_str is None
+                         else (r(child), r(trim_str)))
+
+    def with_children(self, children):
+        out = object.__new__(type(self))
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self) -> Optional[str]:
+        if len(self.children) > 1:
+            r = _require_literal(self.children[1], "trim character set")
+            if r:
+                return r
+            if any(ord(ch) > 127 for ch in _lit_str(self.children[1])):
+                return "non-ASCII trim sets run on the host"
+        return None
+
+    def kernel(self, ctx, c, t=None):
+        xp = ctx.xp
+        trim_lit = " " if t is None else _lit_str(self.children[1])
+        if trim_lit is None or not trim_lit.isascii():
+            if ctx.is_device:
+                raise RuntimeError("non-literal/non-ASCII trim set on device")
+            return self._host_kernel(ctx, c, t)
+        lut = np.zeros(256, dtype=bool)
+        for ch in trim_lit:
+            lut[ord(ch)] = True
+        chars, lens = S.trim_bytes(xp, c.data, c.lengths, xp.asarray(lut),
+                                   left=self._left, right=self._right)
+        v = c.validity if t is None else valid_and(xp, c, t)
+        return _mk(T.STRING, chars, lens, v)
+
+    def _host_kernel(self, ctx, c, t):
+        helper = _HostStringExpr()
+        strs = list(helper._host_rows(ctx, c))
+        trims = list(helper._host_rows(ctx, t))
+        out = []
+        for s_, tr in zip(strs, trims):
+            if s_ is None or tr is None:
+                out.append(None)
+                continue
+            if self._left and self._right:
+                out.append(s_.strip(tr))
+            elif self._left:
+                out.append(s_.lstrip(tr))
+            else:
+                out.append(s_.rstrip(tr))
+        valid = np.asarray(c.validity) & np.asarray(t.validity)
+        return helper._pack(ctx, out, ctx.xp.asarray(valid))
+
+
+class StringTrim(_TrimBase):
+    _left = _right = True
+
+
+class StringTrimLeft(_TrimBase):
+    _left, _right = True, False
+
+
+class StringTrimRight(_TrimBase):
+    _left, _right = False, True
+
+
+# ---------------------------------------------------------------------------
+# Host-exact long tail (FormatNumber / Conv / Md5) — the reference flags
+# these incompat or implements them in JNI; we run them on the host engine
+# ---------------------------------------------------------------------------
+
+class _HostStringExpr(Expression):
+    """Evaluated row-at-a-time on host (device plans fall back per-op)."""
+
+    def tag_for_device(self) -> Optional[str]:
+        return f"{type(self).__name__} runs on the host engine"
+
+    def _host_rows(self, ctx, col: DeviceColumn):
+        n = col.data.shape[0]
+        chars = np.asarray(col.data)
+        lens = np.asarray(col.lengths) if col.lengths is not None else None
+        valid = np.asarray(col.validity)
+        for i in range(n):
+            if not valid[i]:
+                yield None
+            elif lens is not None:
+                yield bytes(chars[i, :int(lens[i])]).decode("utf-8", "replace")
+            else:
+                yield chars[i]
+
+    def _pack(self, ctx, strs, validity):
+        width = bucket_width(max([len(s.encode()) for s in strs if s is not None]
+                                 + [1]))
+        rows = len(strs)
+        chars = np.zeros((rows, width), dtype=np.uint8)
+        lens = np.zeros(rows, dtype=np.int32)
+        for i, s_ in enumerate(strs):
+            if s_ is None:
+                continue
+            b = s_.encode("utf-8")
+            chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+            lens[i] = len(b)
+        xp = ctx.xp
+        return _mk(T.STRING, xp.asarray(chars), xp.asarray(lens), validity)
+
+
+class FormatNumber(BinaryExpression):
+    """format_number(x, d): grouped thousands with d decimal places."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self):
+        return "FormatNumber runs on the host engine"
+
+    def kernel(self, ctx, x, d):
+        xv = np.asarray(x.data)
+        dv = np.asarray(d.data)
+        valid = np.asarray(x.validity) & np.asarray(d.validity) & (dv >= 0)
+        out = []
+        for i in range(xv.shape[0]):
+            if not valid[i]:
+                out.append(None)
+                continue
+            out.append(f"{xv[i]:,.{int(dv[i])}f}")
+        helper = _HostStringExpr()
+        return helper._pack(ctx, out, ctx.xp.asarray(valid))
+
+
+class Conv(Expression):
+    """conv(num_str, from_base, to_base) — host-exact like the JNI kernel."""
+
+    def __init__(self, num, from_base, to_base):
+        from .core import resolve_expression as r
+        self.children = (r(num), r(from_base), r(to_base))
+
+    def with_children(self, children):
+        out = object.__new__(Conv)
+        out.children = tuple(children)
+        return out
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self):
+        return "Conv runs on the host engine"
+
+    def kernel(self, ctx, c, fb, tb):
+        helper = _HostStringExpr()
+        strs = list(helper._host_rows(ctx, c))
+        fbv, tbv = np.asarray(fb.data), np.asarray(tb.data)
+        valid = (np.asarray(c.validity) & np.asarray(fb.validity)
+                 & np.asarray(tb.validity))
+        out = []
+        res_valid = np.asarray(valid).copy()
+        for i, s_ in enumerate(strs):
+            if not valid[i] or s_ is None:
+                out.append(None)
+                res_valid[i] = False
+                continue
+            r_ = _number_convert(s_, int(fbv[i]), int(tbv[i]))
+            out.append(r_)
+            if r_ is None:
+                res_valid[i] = False
+        return helper._pack(ctx, out, ctx.xp.asarray(res_valid))
+
+
+_U64 = 1 << 64
+
+
+def _number_convert(s: str, from_base: int, to_base: int) -> Optional[str]:
+    """Spark NumberConverter semantics: parse the longest valid-digit prefix
+    (null when none), accumulate into an unsigned 64-bit value saturating at
+    2^64-1, fold a leading '-' through two's complement when to_base > 0,
+    and render signed when to_base < 0."""
+    digs = "0123456789abcdefghijklmnopqrstuvwxyz"
+    if not (2 <= from_base <= 36 and 2 <= abs(to_base) <= 36):
+        return None
+    s = s.strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    v, any_digit = 0, False
+    for ch in s.lower():
+        d = digs.find(ch)
+        if d < 0 or d >= from_base:
+            break
+        any_digit = True
+        v = v * from_base + d
+        if v >= _U64:
+            v = _U64 - 1  # saturate like NumberConverter's bound check
+    if not any_digit:
+        return None
+    if neg:
+        if to_base > 0:
+            v = (_U64 - v) % _U64  # reinterpret as unsigned
+        # to_base < 0: keep magnitude, render with '-'
+    sign = "-" if (neg and to_base < 0) else ""
+    base = abs(to_base)
+    r_ = ""
+    while True:
+        r_ = digs[v % base] + r_
+        v //= base
+        if v == 0:
+            break
+    return sign + r_.upper()
+
+
+class Md5(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self):
+        return "Md5 runs on the host engine"
+
+    def kernel(self, ctx, c):
+        helper = _HostStringExpr()
+        chars = np.asarray(c.data)
+        lens = np.asarray(c.lengths)
+        valid = np.asarray(c.validity)
+        out = []
+        for i in range(chars.shape[0]):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append(hashlib.md5(
+                    bytes(chars[i, :int(lens[i])])).hexdigest())
+        return helper._pack(ctx, out, ctx.xp.asarray(valid))

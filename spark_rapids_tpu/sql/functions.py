@@ -205,3 +205,141 @@ def first(c, ignorenulls: bool = False):
 
 def last(c, ignorenulls: bool = False):
     return Column(AG.Last(_c(c), ignorenulls))
+
+
+# --- string functions (stringFunctions.scala family) ------------------------
+from .expressions import strings as STR  # noqa: E402
+
+
+def upper(c):
+    return Column(STR.Upper(_c(c)))
+
+
+def lower(c):
+    return Column(STR.Lower(_c(c)))
+
+
+def initcap(c):
+    return Column(STR.InitCap(_c(c)))
+
+
+def reverse(c):
+    return Column(STR.Reverse(_c(c)))
+
+
+def length(c):
+    return Column(STR.Length(_c(c)))
+
+
+def octet_length(c):
+    return Column(STR.OctetLength(_c(c)))
+
+
+def bit_length(c):
+    return Column(STR.BitLength(_c(c)))
+
+
+def substring(c, pos, length_):
+    return Column(STR.Substring(_c(c), Literal(pos) if isinstance(pos, int)
+                                else _c(pos),
+                                Literal(length_) if isinstance(length_, int)
+                                else _c(length_)))
+
+
+substr = substring
+
+
+def substring_index(c, delim: str, count: int):
+    return Column(STR.SubstringIndex(_c(c), Literal(delim), Literal(count)))
+
+
+def concat(*cols):
+    return Column(STR.Concat(*[_c(c) for c in cols]))
+
+
+def concat_ws(sep: str, *cols):
+    return Column(STR.ConcatWs(Literal(sep), *[_c(c) for c in cols]))
+
+
+def contains(c, sub):
+    return Column(STR.Contains(_c(c), _lit_or_col(sub)))
+
+
+def startswith(c, sub):
+    return Column(STR.StartsWith(_c(c), _lit_or_col(sub)))
+
+
+def endswith(c, sub):
+    return Column(STR.EndsWith(_c(c), _lit_or_col(sub)))
+
+
+def like(c, pattern: str, escape: str = "\\"):
+    return Column(STR.Like(_c(c), Literal(pattern), escape))
+
+
+def instr(c, sub: str):
+    return Column(STR.StringInstr(_c(c), Literal(sub)))
+
+
+def locate(sub: str, c, pos: int = 1):
+    return Column(STR.StringLocate(Literal(sub), _c(c), Literal(pos)))
+
+
+def replace(c, search, replacement):
+    return Column(STR.StringReplace(_c(c), _lit_or_col(search),
+                                    _lit_or_col(replacement)))
+
+
+regexp_replace = None  # installed by the regex module
+
+
+def translate(c, matching: str, replace_: str):
+    return Column(STR.StringTranslate(_c(c), Literal(matching),
+                                      Literal(replace_)))
+
+
+def repeat(c, n: int):
+    return Column(STR.StringRepeat(_c(c), Literal(n)))
+
+
+def lpad(c, length_: int, pad: str = " "):
+    return Column(STR.StringLPad(_c(c), Literal(length_), Literal(pad)))
+
+
+def rpad(c, length_: int, pad: str = " "):
+    return Column(STR.StringRPad(_c(c), Literal(length_), Literal(pad)))
+
+
+def trim(c, trim_str: Optional[str] = None):
+    return Column(STR.StringTrim(_c(c), None if trim_str is None
+                                 else Literal(trim_str)))
+
+
+def ltrim(c, trim_str: Optional[str] = None):
+    return Column(STR.StringTrimLeft(_c(c), None if trim_str is None
+                                     else Literal(trim_str)))
+
+
+def rtrim(c, trim_str: Optional[str] = None):
+    return Column(STR.StringTrimRight(_c(c), None if trim_str is None
+                                      else Literal(trim_str)))
+
+
+def format_number(c, d: int):
+    return Column(STR.FormatNumber(_c(c), Literal(d)))
+
+
+def conv(c, from_base: int, to_base: int):
+    return Column(STR.Conv(_c(c), Literal(from_base), Literal(to_base)))
+
+
+def md5(c):
+    return Column(STR.Md5(_c(c)))
+
+
+def _lit_or_col(x):
+    """String-or-column argument position: bare str is a LITERAL here
+    (matches pyspark's contains/startswith/endswith/replace)."""
+    if isinstance(x, str):
+        return Literal(x)
+    return _to_expr(x)

@@ -76,6 +76,12 @@ class ExprMeta:
             self.will_not_work(f"expression {cls_name} is not supported on TPU")
         elif cls_name in _HOST_ONLY_EXPRS:
             self.will_not_work(f"expression {cls_name} runs on the host only")
+        elif hasattr(e, "tag_for_device"):
+            # per-expression device-capability hook (literal-only args,
+            # ASCII-only patterns, host-exact long-tail ops, ...)
+            reason = e.tag_for_device()
+            if reason:
+                self.will_not_work(f"{cls_name}: {reason}")
         # type checks
         sig = _EXPR_SIGS.get(cls_name, TS.ALL_DEVICE)
         for node in [e] + list(e.children):

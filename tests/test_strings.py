@@ -1,0 +1,289 @@
+"""String expression tests — device (jitted jnp) vs host (numpy) backends vs
+a pure-Python oracle (reference model: ``integration_tests/src/main/python/
+string_test.py`` CPU-vs-GPU comparisons)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.expressions import strings as S
+from spark_rapids_tpu.sql.expressions.core import (AttributeReference,
+                                                   Literal)
+import spark_rapids_tpu.types as T
+
+from test_expressions import eval_both, make_batch, to_host_batch
+
+
+def eval_host(expr, table):
+    """Host-engine-only evaluation, for expressions tagged host-only (the
+    planner never jits these; tag_for_device routes them to CPU)."""
+    import numpy as np
+    from spark_rapids_tpu.columnar import device_column_to_arrow
+    from spark_rapids_tpu.sql.expressions.core import (EvalContext,
+                                                       bind_references)
+    batch = to_host_batch(make_batch(table))
+    attrs = [AttributeReference(n, c.dtype)
+             for n, c in zip(batch.names, batch.columns)]
+    bound = bind_references(expr, attrs)
+    assert bound.tag_for_device(), "host-only expr must self-tag"
+    col = bound.eval(EvalContext(batch, xp=np))
+    return device_column_to_arrow(col, table.num_rows).to_pylist()
+
+STRS = ["hello world", "", "  padded  ", "UPPER lower", "héllo wörld",
+        "a,b,,c,d", "日本語テキスト", "x", None, "the quick brown fox",
+        "aaa", "ab" * 20]
+
+
+def tbl(vals=STRS, name="s"):
+    return pa.table({name: pa.array(vals, type=pa.string())})
+
+
+def s_attr(name="s"):
+    return AttributeReference(name, T.STRING)
+
+
+def oracle(fn, vals=STRS):
+    return [None if v is None else fn(v) for v in vals]
+
+
+class TestMeasures:
+    def test_length(self):
+        assert eval_both(S.Length(s_attr()), tbl()) == oracle(len)
+
+    def test_octet_length(self):
+        assert eval_both(S.OctetLength(s_attr()), tbl()) == \
+            oracle(lambda s: len(s.encode()))
+
+    def test_bit_length(self):
+        assert eval_both(S.BitLength(s_attr()), tbl()) == \
+            oracle(lambda s: 8 * len(s.encode()))
+
+
+class TestTransforms:
+    def test_upper_ascii(self):
+        got = eval_both(S.Upper(s_attr()), tbl())
+        exp = oracle(lambda s: "".join(
+            c.upper() if c.isascii() else c for c in s))
+        assert got == exp
+
+    def test_lower_ascii(self):
+        got = eval_both(S.Lower(s_attr()), tbl())
+        exp = oracle(lambda s: "".join(
+            c.lower() if c.isascii() else c for c in s))
+        assert got == exp
+
+    def test_reverse_utf8(self):
+        assert eval_both(S.Reverse(s_attr()), tbl()) == \
+            oracle(lambda s: s[::-1])
+
+    def test_initcap(self):
+        vals = ["hello world", "FOO bar", "", " x", "a  b"]
+        got = eval_both(S.InitCap(s_attr()), tbl(vals))
+        assert got == ["Hello World", "Foo Bar", "", " X", "A  B"]
+
+
+class TestSubstring:
+    @pytest.mark.parametrize("pos,ln", [(1, 3), (3, 100), (0, 2), (-3, 2),
+                                        (-100, 3), (5, 0), (2, None)])
+    def test_substring(self, pos, ln):
+        e = S.Substring(s_attr(), Literal(pos),
+                        None if ln is None else Literal(ln))
+
+        def exp(s):
+            # UTF8String.substringSQL semantics
+            n = len(s)
+            start = pos - 1 if pos > 0 else (n + pos if pos < 0 else 0)
+            end = n if ln is None else min(start + max(ln, 0), 2 ** 30)
+            start_c = max(start, 0)
+            return s[start_c:max(end, start_c)] if end > 0 else ""
+
+        assert eval_both(e, tbl()) == oracle(exp)
+
+    @pytest.mark.parametrize("count", [1, 2, -1, -2, 0, 10])
+    def test_substring_index(self, count):
+        vals = ["a.b.c.d", "abc", ".x.", "", "..", None]
+        e = S.SubstringIndex(s_attr(), Literal("."), Literal(count))
+
+        def exp(s):
+            if count == 0:
+                return ""
+            parts = s.split(".")
+            if count > 0:
+                return s if count >= len(parts) else ".".join(parts[:count])
+            return s if -count >= len(parts) else ".".join(parts[count:])
+
+        assert eval_both(e, tbl(vals)) == oracle(exp, vals)
+
+
+class TestConcat:
+    def test_concat(self):
+        t = pa.table({"a": ["x", "yy", None, ""],
+                      "b": ["1", None, "2", "33"]})
+        e = S.Concat(AttributeReference("a", T.STRING),
+                     AttributeReference("b", T.STRING))
+        assert eval_both(e, t) == ["x1", None, None, "33"]
+
+    def test_concat_ws_skips_nulls(self):
+        t = pa.table({"a": ["x", None, None, "q"],
+                      "b": ["y", "z", None, None]})
+        e = S.ConcatWs(Literal("-"), AttributeReference("a", T.STRING),
+                       AttributeReference("b", T.STRING))
+        assert eval_both(e, t) == ["x-y", "z", "", "q"]
+
+
+class TestPredicates:
+    def test_contains(self):
+        e = S.Contains(s_attr(), Literal("lo"))
+        assert eval_both(e, tbl()) == oracle(lambda s: "lo" in s)
+
+    def test_starts_ends(self):
+        assert eval_both(S.StartsWith(s_attr(), Literal("he")), tbl()) == \
+            oracle(lambda s: s.startswith("he"))
+        assert eval_both(S.EndsWith(s_attr(), Literal("ld")), tbl()) == \
+            oracle(lambda s: s.endswith("ld"))
+
+    @pytest.mark.parametrize("pat,rx", [
+        ("hello%", r"hello.*"), ("%world", r".*world"), ("%lo w%", r".*lo w.*"),
+        ("h_llo%", r"h.llo.*"), ("x", r"x"), ("%", r".*"), ("", r""),
+        ("_____", r"....."), ("a%b%c", r"a.*b.*c."[:-1]),
+    ])
+    def test_like(self, pat, rx):
+        import re
+        vals = [v for v in STRS if v is None or v.isascii()]
+        e = S.Like(s_attr(), Literal(pat))
+        exp = oracle(lambda s: re.fullmatch(rx, s, re.DOTALL) is not None,
+                     vals)
+        assert eval_both(e, tbl(vals)) == exp
+
+
+class TestSearch:
+    def test_instr(self):
+        e = S.StringInstr(s_attr(), Literal("o"))
+        assert eval_both(e, tbl()) == oracle(lambda s: s.find("o") + 1)
+
+    def test_instr_utf8_position(self):
+        # instr returns CHARACTER positions on multi-byte strings
+        vals = ["日本語テキスト", "héllo"]
+        e = S.StringInstr(s_attr(), Literal("語"))
+        assert eval_both(e, tbl(vals)) == [3, 0]
+
+    @pytest.mark.parametrize("start", [1, 3, 0])
+    def test_locate(self, start):
+        e = S.StringLocate(Literal("o"), s_attr(), Literal(start))
+
+        def exp(s):
+            if start <= 0:
+                return 0
+            return s.find("o", start - 1) + 1
+
+        assert eval_both(e, tbl()) == oracle(exp)
+
+
+class TestEditing:
+    def test_replace(self):
+        e = S.StringReplace(s_attr(), Literal("o"), Literal("0"))
+        assert eval_both(e, tbl()) == oracle(lambda s: s.replace("o", "0"))
+
+    def test_replace_grow(self):
+        e = S.StringReplace(s_attr(), Literal("l"), Literal("LLL"))
+        assert eval_both(e, tbl()) == oracle(lambda s: s.replace("l", "LLL"))
+
+    def test_replace_empty_search_is_noop(self):
+        e = S.StringReplace(s_attr(), Literal(""), Literal("X"))
+        assert eval_both(e, tbl()) == oracle(lambda s: s)
+
+    def test_translate(self):
+        e = S.StringTranslate(s_attr(), Literal("lo"), Literal("01"))
+        assert eval_both(e, tbl()) == \
+            oracle(lambda s: s.translate(str.maketrans("lo", "01")))
+
+    def test_translate_delete(self):
+        e = S.StringTranslate(s_attr(), Literal("aeiou"), Literal(""))
+        assert eval_both(e, tbl()) == \
+            oracle(lambda s: s.translate(str.maketrans("", "", "aeiou")))
+
+    @pytest.mark.parametrize("n", [0, 1, 3])
+    def test_repeat(self, n):
+        e = S.StringRepeat(s_attr(), Literal(n))
+        assert eval_both(e, tbl()) == oracle(lambda s: s * n)
+
+    @pytest.mark.parametrize("left", [True, False])
+    def test_pad(self, left):
+        cls = S.StringLPad if left else S.StringRPad
+        e = cls(s_attr(), Literal(8), Literal("*-"))
+        vals = ["abc", "", "12345678", "123456789x"]
+
+        def exp(s):
+            if len(s) >= 8:
+                return s[:8]
+            pad = ("*-" * 8)[:8 - len(s)]
+            return pad + s if left else s + pad
+
+        assert eval_both(e, tbl(vals)) == oracle(exp, vals)
+
+    def test_trim_family(self):
+        vals = ["  hi  ", "xxhixx", "hi", "   ", ""]
+        assert eval_both(S.StringTrim(s_attr()), tbl(vals)) == \
+            oracle(lambda s: s.strip(" "), vals)
+        assert eval_both(S.StringTrimLeft(s_attr()), tbl(vals)) == \
+            oracle(lambda s: s.lstrip(" "), vals)
+        assert eval_both(S.StringTrimRight(s_attr()), tbl(vals)) == \
+            oracle(lambda s: s.rstrip(" "), vals)
+        assert eval_both(S.StringTrim(s_attr(), Literal("x")), tbl(vals)) == \
+            oracle(lambda s: s.strip("x"), vals)
+
+
+class TestHostTail:
+    def test_format_number(self):
+        t = pa.table({"x": pa.array([1234567.891, 0.5, -42.0, None])})
+        e = S.FormatNumber(AttributeReference("x", T.DOUBLE), Literal(2))
+        assert eval_host(e, t) == ["1,234,567.89", "0.50", "-42.00", None]
+
+    def test_conv(self):
+        # Spark NumberConverter: '-' folds through unsigned 64-bit when
+        # to_base > 0; invalid prefixes parse their leading digits; no
+        # digits at all -> NULL
+        t = pa.table({"s": ["255", "ff", "-10", None, "11abc", "zz"]})
+        got = eval_host(S.Conv(s_attr(), Literal(16), Literal(10)), t)
+        assert got == ["597", "255", "18446744073709551600", None, "72380",
+                       None]
+
+    def test_conv_signed_output_and_prefix(self):
+        t = pa.table({"s": ["11abc", "-11"]})
+        # to_base=16: leading digits parse, negative wraps unsigned;
+        # to_base=-16: negative renders signed
+        assert eval_host(S.Conv(s_attr(), Literal(10), Literal(16)), t) == \
+            ["B", "FFFFFFFFFFFFFFF5"]
+        assert eval_host(S.Conv(s_attr(), Literal(10), Literal(-16)), t) == \
+            ["B", "-B"]
+
+    def test_md5(self):
+        import hashlib
+        e = S.Md5(s_attr())
+        vals = ["abc", "", "hello"]
+        assert eval_host(e, tbl(vals)) == \
+            oracle(lambda s: hashlib.md5(s.encode()).hexdigest(), vals)
+
+
+class TestDataFrameIntegration:
+    def test_string_pipeline(self):
+        import spark_rapids_tpu as srt
+        from spark_rapids_tpu.sql import functions as F
+        s = srt.session()
+        df = s.create_dataframe({"name": ["alice", "BOB", "  carol "],
+                                 "city": ["nyc", "sf", None]})
+        out = df.select(
+            F.upper(F.col("name")).alias("u"),
+            F.concat_ws("/", F.trim(F.col("name")), F.col("city")).alias("j"),
+            F.length(F.col("name")).alias("n"),
+        ).collect()
+        assert out.column("u").to_pylist() == ["ALICE", "BOB", "  CAROL "]
+        assert out.column("j").to_pylist() == ["alice/nyc", "BOB/sf", "carol"]
+        assert out.column("n").to_pylist() == [5, 3, 8]
+
+    def test_filter_on_like(self):
+        import spark_rapids_tpu as srt
+        from spark_rapids_tpu.sql import functions as F
+        s = srt.session()
+        df = s.create_dataframe({"s": ["apple", "banana", "cherry", "avocado"]})
+        out = df.filter(F.like(F.col("s"), "a%")).collect()
+        assert out.column("s").to_pylist() == ["apple", "avocado"]
