@@ -201,10 +201,8 @@ def substring_chars(xp, chars, lens, pos, sublen=None):
 def concat_bytes(xp, pieces, out_width):
     """Concatenate per-row byte strings: pieces = [(chars, lens), ...]."""
     rows = pieces[0][0].shape[0]
-    total = None
     offset = xp.zeros(rows, dtype=xp.int32)
     out = xp.zeros((rows, out_width + 1), dtype=xp.uint8)
-    row_idx2 = None
     for chars, lens in pieces:
         w = chars.shape[1]
         j = xp.arange(w, dtype=xp.int32)[None, :]
@@ -410,16 +408,16 @@ def replace_bytes(xp, chars, lens, pat, plens, rep, rlens, out_width):
     out = scatter_bytes(xp, rows, out_width, rows_idx, out_off, chars,
                         copy_mask & (out_off < out_width))
     rw = rep.shape[1]
+    # one trash column absorbs all masked-off scatters; slice it away once
+    ext = xp.concatenate(
+        [out, xp.zeros((rows, 1), dtype=xp.uint8)], axis=1)
     for j in range(rw):
         mask_j = chosen & (j < rlens[:, None]) & (out_off + j < out_width)
         vals = xp.broadcast_to(rep[:, j:j + 1], (rows, width))
-        ext = xp.concatenate(
-            [out, xp.zeros((rows, 1), dtype=xp.uint8)], axis=1)
         safe = xp.where(mask_j, xp.clip(out_off + j, 0, out_width - 1),
                         out_width)
         ext = scatter_set(xp, ext, rows_idx, safe, vals)
-        out = ext[:, :out_width]
-    return out, new_len
+    return ext[:, :out_width], new_len
 
 
 def translate_bytes(xp, chars, lens, lut):
@@ -479,6 +477,9 @@ def byte_pos_to_char_pos(xp, chars, lens, byte_pos):
     width = chars.shape[1]
     safe = xp.clip(byte_pos, 0, width - 1)
     c = xp.take_along_axis(cidx, safe[:, None], axis=1)[:, 0]
+    # byte 0 is always char 0 (zero chars precede it) — covers empty rows,
+    # where char_index_of_byte has no valid entry to map through
+    c = xp.where(byte_pos == 0, 0, c)
     return xp.where(byte_pos < 0, -1, c)
 
 

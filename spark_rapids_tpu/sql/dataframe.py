@@ -467,6 +467,72 @@ class DataFrame:
 
     persist = cache
 
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+
+class DataFrameWriter:
+    """``df.write`` — drives the write job through the physical engine
+    (reference: ``GpuInsertIntoHadoopFsRelationCommand`` +
+    ``GpuFileFormatDataWriter``; SURVEY §2.5 writers)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._mode = "errorifexists"
+        self._options: dict = {}
+        self._partition_by: List[str] = []
+        self._format = "parquet"
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[key] = value
+        return self
+
+    def options(self, **kwargs) -> "DataFrameWriter":
+        self._options.update(kwargs)
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = [c for group in cols
+                              for c in (group if isinstance(group, (list, tuple))
+                                        else [group])]
+        return self
+
+    def format(self, fmt: str) -> "DataFrameWriter":
+        self._format = fmt
+        return self
+
+    def save(self, path: str):
+        from ..io_.writers import run_write_job
+        from .planner import Planner
+        sess = self._df._session
+        missing = [c for c in self._partition_by
+                   if c not in self._df.columns]
+        if missing:
+            raise KeyError(f"partitionBy columns not in schema: {missing}")
+        child = Planner(sess._conf).plan_for_collect(self._df._plan)
+        return run_write_job(child, self._format, path, self._mode,
+                             self._partition_by, self._options, sess._conf)
+
+    def parquet(self, path: str):
+        return self.format("parquet").save(path)
+
+    def orc(self, path: str):
+        return self.format("orc").save(path)
+
+    def csv(self, path: str):
+        return self.format("csv").save(path)
+
+    def json(self, path: str):
+        return self.format("json").save(path)
+
+    def avro(self, path: str):
+        return self.format("avro").save(path)
+
 
 def _extract_equi_keys(cond: Expression, left_plan, right_plan):
     """Split a join condition into equi-keys + residual, like the

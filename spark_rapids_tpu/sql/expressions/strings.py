@@ -291,10 +291,18 @@ class Like(BinaryExpression):
         pat = _lit_str(self.children[1])
         if any(ord(ch) > 127 for ch in pat):
             return "non-ASCII LIKE patterns run on the host"
-        if "_" in pat.replace(self.escape + "_", ""):
-            # '_' must consume one CHARACTER; the byte-matcher can't on
-            # arbitrary UTF-8 column data
-            return "LIKE patterns with `_` run on the host (character-exact)"
+        # '_' must consume one CHARACTER; the byte-matcher can't on
+        # arbitrary UTF-8 column data.  Scan with escape handling so
+        # escaped escapes don't hide a following wildcard.
+        i = 0
+        while i < len(pat):
+            if self.escape and pat[i] == self.escape and i + 1 < len(pat):
+                i += 2
+                continue
+            if pat[i] == "_":
+                return ("LIKE patterns with `_` run on the host "
+                        "(character-exact)")
+            i += 1
         return None
 
     @staticmethod
@@ -303,7 +311,10 @@ class Like(BinaryExpression):
         rx, i = [], 0
         while i < len(pt):
             ch = pt[i]
-            if escape and ch == escape and i + 1 < len(pt):
+            if escape and ch == escape:
+                if i + 1 >= len(pt):
+                    raise ValueError(
+                        f"the pattern '{pt}' is invalid: dangling escape")
                 rx.append(re.escape(pt[i + 1]))
                 i += 2
                 continue
@@ -455,10 +466,9 @@ class StringTranslate(Expression):
         return _mk(T.STRING, chars, lens, valid_and(xp, c, f, t))
 
     def _host_kernel(self, ctx, c, f, t):
-        helper = _HostStringExpr()
-        strs = list(helper._host_rows(ctx, c))
-        froms = list(helper._host_rows(ctx, f))
-        tos = list(helper._host_rows(ctx, t))
+        strs = list(_host_rows(ctx, c))
+        froms = list(_host_rows(ctx, f))
+        tos = list(_host_rows(ctx, t))
         out = []
         for s_, fr, to in zip(strs, froms, tos):
             if s_ is None or fr is None or to is None:
@@ -472,7 +482,7 @@ class StringTranslate(Expression):
             out.append(s_.translate(table))
         valid = (np.asarray(c.validity) & np.asarray(f.validity)
                  & np.asarray(t.validity))
-        return helper._pack(ctx, out, ctx.xp.asarray(valid))
+        return _pack(ctx, out, ctx.xp.asarray(valid))
 
 
 class StringRepeat(BinaryExpression):
@@ -588,9 +598,8 @@ class _TrimBase(Expression):
         return _mk(T.STRING, chars, lens, v)
 
     def _host_kernel(self, ctx, c, t):
-        helper = _HostStringExpr()
-        strs = list(helper._host_rows(ctx, c))
-        trims = list(helper._host_rows(ctx, t))
+        strs = list(_host_rows(ctx, c))
+        trims = list(_host_rows(ctx, t))
         out = []
         for s_, tr in zip(strs, trims):
             if s_ is None or tr is None:
@@ -603,7 +612,7 @@ class _TrimBase(Expression):
             else:
                 out.append(s_.rstrip(tr))
         valid = np.asarray(c.validity) & np.asarray(t.validity)
-        return helper._pack(ctx, out, ctx.xp.asarray(valid))
+        return _pack(ctx, out, ctx.xp.asarray(valid))
 
 
 class StringTrim(_TrimBase):
@@ -623,39 +632,37 @@ class StringTrimRight(_TrimBase):
 # these incompat or implements them in JNI; we run them on the host engine
 # ---------------------------------------------------------------------------
 
-class _HostStringExpr(Expression):
-    """Evaluated row-at-a-time on host (device plans fall back per-op)."""
+def _host_rows(ctx, col: DeviceColumn):
+    """Iterate a column's rows as python strings (None for nulls) — the
+    row-at-a-time bridge for host-exact expressions."""
+    n = col.data.shape[0]
+    chars = np.asarray(col.data)
+    lens = np.asarray(col.lengths) if col.lengths is not None else None
+    valid = np.asarray(col.validity)
+    for i in range(n):
+        if not valid[i]:
+            yield None
+        elif lens is not None:
+            yield bytes(chars[i, :int(lens[i])]).decode("utf-8", "replace")
+        else:
+            yield chars[i]
 
-    def tag_for_device(self) -> Optional[str]:
-        return f"{type(self).__name__} runs on the host engine"
 
-    def _host_rows(self, ctx, col: DeviceColumn):
-        n = col.data.shape[0]
-        chars = np.asarray(col.data)
-        lens = np.asarray(col.lengths) if col.lengths is not None else None
-        valid = np.asarray(col.validity)
-        for i in range(n):
-            if not valid[i]:
-                yield None
-            elif lens is not None:
-                yield bytes(chars[i, :int(lens[i])]).decode("utf-8", "replace")
-            else:
-                yield chars[i]
-
-    def _pack(self, ctx, strs, validity):
-        width = bucket_width(max([len(s.encode()) for s in strs if s is not None]
-                                 + [1]))
-        rows = len(strs)
-        chars = np.zeros((rows, width), dtype=np.uint8)
-        lens = np.zeros(rows, dtype=np.int32)
-        for i, s_ in enumerate(strs):
-            if s_ is None:
-                continue
-            b = s_.encode("utf-8")
-            chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-            lens[i] = len(b)
-        xp = ctx.xp
-        return _mk(T.STRING, xp.asarray(chars), xp.asarray(lens), validity)
+def _pack(ctx, strs, validity):
+    """Pack python strings back into the padded byte-matrix layout."""
+    width = bucket_width(max([len(s.encode()) for s in strs if s is not None]
+                             + [1]))
+    rows = len(strs)
+    chars = np.zeros((rows, width), dtype=np.uint8)
+    lens = np.zeros(rows, dtype=np.int32)
+    for i, s_ in enumerate(strs):
+        if s_ is None:
+            continue
+        b = s_.encode("utf-8")
+        chars[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lens[i] = len(b)
+    xp = ctx.xp
+    return _mk(T.STRING, xp.asarray(chars), xp.asarray(lens), validity)
 
 
 class FormatNumber(BinaryExpression):
@@ -678,8 +685,7 @@ class FormatNumber(BinaryExpression):
                 out.append(None)
                 continue
             out.append(f"{xv[i]:,.{int(dv[i])}f}")
-        helper = _HostStringExpr()
-        return helper._pack(ctx, out, ctx.xp.asarray(valid))
+        return _pack(ctx, out, ctx.xp.asarray(valid))
 
 
 class Conv(Expression):
@@ -702,8 +708,7 @@ class Conv(Expression):
         return "Conv runs on the host engine"
 
     def kernel(self, ctx, c, fb, tb):
-        helper = _HostStringExpr()
-        strs = list(helper._host_rows(ctx, c))
+        strs = list(_host_rows(ctx, c))
         fbv, tbv = np.asarray(fb.data), np.asarray(tb.data)
         valid = (np.asarray(c.validity) & np.asarray(fb.validity)
                  & np.asarray(tb.validity))
@@ -718,7 +723,7 @@ class Conv(Expression):
             out.append(r_)
             if r_ is None:
                 res_valid[i] = False
-        return helper._pack(ctx, out, ctx.xp.asarray(res_valid))
+        return _pack(ctx, out, ctx.xp.asarray(res_valid))
 
 
 _U64 = 1 << 64
@@ -771,7 +776,6 @@ class Md5(UnaryExpression):
         return "Md5 runs on the host engine"
 
     def kernel(self, ctx, c):
-        helper = _HostStringExpr()
         chars = np.asarray(c.data)
         lens = np.asarray(c.lengths)
         valid = np.asarray(c.validity)
@@ -782,4 +786,4 @@ class Md5(UnaryExpression):
             else:
                 out.append(hashlib.md5(
                     bytes(chars[i, :int(lens[i])])).hexdigest())
-        return helper._pack(ctx, out, ctx.xp.asarray(valid))
+        return _pack(ctx, out, ctx.xp.asarray(valid))

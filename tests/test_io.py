@@ -1,0 +1,172 @@
+"""I/O tests: readers (incl. the in-repo Avro container parser) and the
+write stack (dynamic partitioning, save modes, stats) — reference coverage
+model: integration_tests parquet/orc/csv/json/avro round-trip suites."""
+
+import datetime
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def sample_table(n=100, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "i": pa.array(rng.integers(-1000, 1000, n), type=pa.int64()),
+        "f": pa.array(rng.random(n), type=pa.float64()),
+        "s": pa.array([f"row-{k}" if k % 7 else None for k in range(n)]),
+        "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "part": pa.array(rng.integers(0, 3, n), type=pa.int32()),
+    })
+
+
+FORMATS = ["parquet", "orc", "csv", "json", "avro"]
+
+
+@pytest.mark.parametrize("fmt", FORMATS)
+def test_write_read_roundtrip(sess, fmt, tmp_path):
+    t = sample_table()
+    df = sess.create_dataframe(t)
+    out = str(tmp_path / f"out_{fmt}")
+    stats = getattr(df.write.mode("overwrite"), fmt)(out)
+    assert stats.num_rows == t.num_rows
+    assert stats.num_files >= 1
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+
+    back = getattr(sess.read, fmt)(out).collect()
+    assert back.num_rows == t.num_rows
+    gi = sorted(back.column("i").to_pylist())
+    assert gi == sorted(t.column("i").to_pylist())
+    got_f = sorted(x for x in back.column("f").to_pylist())
+    exp_f = sorted(t.column("f").to_pylist())
+    assert np.allclose(got_f, exp_f)
+    # strings: csv cannot distinguish null from empty; allow either there
+    got_s = sorted((x or "") for x in back.column("s").to_pylist())
+    exp_s = sorted((x or "") for x in t.column("s").to_pylist())
+    assert got_s == exp_s
+
+
+def test_dynamic_partitioned_write(sess, tmp_path):
+    t = sample_table()
+    df = sess.create_dataframe(t)
+    out = str(tmp_path / "pq_parts")
+    stats = df.write.mode("overwrite").partitionBy("part").parquet(out)
+    dirs = sorted(d for d in os.listdir(out) if d.startswith("part="))
+    assert dirs == ["part=0", "part=1", "part=2"]
+    assert sorted(stats.partition_paths) == dirs
+    # read back one partition dir: data columns only
+    sub = sess.read.parquet(os.path.join(out, "part=1")).collect()
+    assert "part" not in sub.column_names
+    mask = np.asarray(t.column("part")) == 1
+    assert sub.num_rows == int(mask.sum())
+
+
+def test_save_modes(sess, tmp_path):
+    t = sample_table(20)
+    df = sess.create_dataframe(t)
+    out = str(tmp_path / "modes")
+    df.write.parquet(out)
+    with pytest.raises(FileExistsError):
+        df.write.parquet(out)
+    df.write.mode("ignore").parquet(out)  # no-op
+    df.write.mode("append").parquet(out)
+    assert sess.read.parquet(out).collect().num_rows == 2 * t.num_rows
+    df.write.mode("overwrite").parquet(out)
+    assert sess.read.parquet(out).collect().num_rows == t.num_rows
+
+
+def test_avro_reader_features(tmp_path):
+    """Exercise the container parser directly: deflate codec, nullable
+    unions, logical date/timestamp types, multi-block files."""
+    from spark_rapids_tpu.io_.avro_reader import read_avro, write_avro
+
+    t = pa.table({
+        "id": pa.array(range(500), type=pa.int64()),
+        "name": pa.array([None if i % 9 == 0 else f"n{i}" for i in range(500)]),
+        "d": pa.array([datetime.date(2020, 1, 1) + datetime.timedelta(days=i)
+                       for i in range(500)]),
+        "ts": pa.array([datetime.datetime(2021, 5, 4, 3, 2, 1)
+                        + datetime.timedelta(seconds=i) for i in range(500)],
+                       type=pa.timestamp("us")),
+        "tags": pa.array([[f"t{i}", "x"] if i % 2 else []
+                          for i in range(500)]),
+    })
+    path = str(tmp_path / "f.avro")
+    write_avro(t, path)
+    back = read_avro(path)
+    assert back.column("id").to_pylist() == list(range(500))
+    assert back.column("name").to_pylist() == t.column("name").to_pylist()
+    assert back.column("d").to_pylist() == t.column("d").to_pylist()
+    assert back.column("ts").to_pylist() == t.column("ts").to_pylist()
+    assert back.column("tags").to_pylist() == t.column("tags").to_pylist()
+
+
+def test_avro_deflate_interop(tmp_path):
+    """If the avro python package (or fastavro) is around, cross-check;
+    otherwise verify our deflate read path against a hand-built file."""
+    import struct
+    import zlib
+
+    # hand-build a 2-block deflate file with one long field
+    schema = {"type": "record", "name": "r",
+              "fields": [{"name": "v", "type": "long"}]}
+
+    def zz(v):
+        out = bytearray()
+        u = ((v << 1) ^ (v >> 63)) & ((1 << 64) - 1)
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            out.append(b | 0x80 if u else b)
+            if not u:
+                return bytes(out)
+
+    sync = b"0123456789abcdef"
+    hdr = bytearray(b"Obj\x01")
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": b"deflate"}
+    hdr += zz(len(meta))
+    for k, v in meta.items():
+        kb = k.encode()
+        hdr += zz(len(kb)) + kb + zz(len(v)) + v
+    hdr += zz(0) + sync
+    body = bytearray()
+    for block_vals in ([1, 2, 3], [40, 50]):
+        raw = b"".join(zz(v) for v in block_vals)
+        comp = zlib.compress(raw)[2:-4]  # raw deflate
+        body += zz(len(block_vals)) + zz(len(comp)) + comp + sync
+    path = str(tmp_path / "d.avro")
+    with open(path, "wb") as fh:
+        fh.write(bytes(hdr) + bytes(body))
+
+    from spark_rapids_tpu.io_.avro_reader import read_avro
+    back = read_avro(path)
+    assert back.column("v").to_pylist() == [1, 2, 3, 40, 50]
+
+
+def test_write_from_query(sess, tmp_path):
+    """Write the output of a device-side query (scan->filter->agg->write)."""
+    from spark_rapids_tpu.sql import functions as F
+    t = sample_table(1000)
+    df = sess.create_dataframe(t)
+    q = (df.filter(df.i > 0).groupBy("part")
+         .agg(F.sum(F.col("i")).alias("s"), F.count("*").alias("c")))
+    out = str(tmp_path / "agg_out")
+    stats = q.write.mode("overwrite").parquet(out)
+    assert stats.num_rows <= 3
+    back = sess.read.parquet(out).collect()
+    import pandas as pd
+    pdf = t.to_pandas()
+    pdf = pdf[pdf.i > 0].groupby("part").agg(s=("i", "sum"), c=("i", "count"))
+    got = back.to_pandas().set_index("part").sort_index()
+    assert (got["s"] == pdf["s"]).all()
+    assert (got["c"] == pdf["c"]).all()
