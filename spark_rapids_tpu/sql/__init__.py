@@ -1,0 +1,1 @@
+from .window_api import Window, WindowSpec  # noqa: F401

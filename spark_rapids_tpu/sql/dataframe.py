@@ -181,6 +181,11 @@ class Column:
     def between(self, lo, hi):
         return (self >= lo) & (self <= hi)
 
+    def over(self, window_spec) -> "Column":
+        from .expressions.windows import WindowExpression
+        return Column(WindowExpression(self.expr,
+                                       window_spec.to_definition()))
+
     def asc(self):
         return P.SortOrder(self.expr, True)
 
@@ -268,7 +273,8 @@ class DataFrame:
         if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
             cols = tuple(cols[0])
         exprs = tuple(self._resolve(c) for c in cols)
-        return DataFrame(P.Project(exprs, self._plan), self._session)
+        exprs, plan = _extract_windows(exprs, self._plan)
+        return DataFrame(P.Project(exprs, plan), self._session)
 
     def withColumn(self, name: str, col: Column) -> "DataFrame":
         exprs = []
@@ -281,7 +287,8 @@ class DataFrame:
                 exprs.append(a)
         if not replaced:
             exprs.append(Alias(_resolve_expr(_to_expr(col), self._plan), name))
-        return DataFrame(P.Project(tuple(exprs), self._plan), self._session)
+        exprs, plan = _extract_windows(tuple(exprs), self._plan)
+        return DataFrame(P.Project(tuple(exprs), plan), self._session)
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
         exprs = [Alias(a, new) if a.name.lower() == old.lower() else a
@@ -532,6 +539,34 @@ class DataFrameWriter:
 
     def avro(self, path: str):
         return self.format("avro").save(path)
+
+
+def _extract_windows(exprs, plan):
+    """Pull WindowExpressions out of projection exprs into Window logical
+    nodes (Spark's ExtractWindowExpressions analysis rule).  Expressions
+    sharing a (partition, order) spec share one Window node."""
+    from .expressions.windows import WindowExpression
+    win_aliases = {}   # semantic key -> Alias (dedup identical windows)
+    groups = {}        # spec_key -> [Alias] in discovery order
+
+    def repl(e):
+        if isinstance(e, WindowExpression):
+            k = e.semantic_key()
+            if k not in win_aliases:
+                a = Alias(e, f"_we{len(win_aliases)}")
+                win_aliases[k] = a
+                groups.setdefault(e.spec.spec_key(), []).append(a)
+            return win_aliases[k].to_attribute()
+        return None
+
+    new_exprs = tuple(e.transform(repl) for e in exprs)
+    if not win_aliases:
+        return exprs, plan
+    for aliases in groups.values():
+        spec = aliases[0].child.spec
+        plan = P.Window(tuple(aliases), spec.partition_spec,
+                        spec.order_spec, plan)
+    return new_exprs, plan
 
 
 def _extract_equi_keys(cond: Expression, left_plan, right_plan):

@@ -1,0 +1,246 @@
+"""Window expressions — the analog of the reference's
+``GpuWindowExpression.scala`` (1904 LoC) + ``GpuWindowExec`` batching
+(SURVEY §2.3).  ``WindowExpression`` nodes are unevaluable in normal
+projection; ``WindowExec`` pattern-matches on them and computes the whole
+window family with the sorted-frame kernels in ``ops/window_ops.py``."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+from ... import types as T
+from ..plan import SortOrder
+from .core import Expression, LeafExpression, Literal, Unevaluable, \
+    resolve_expression
+
+# Frame boundary sentinels (match pyspark's Window constants)
+UNBOUNDED_PRECEDING = -(1 << 63)
+UNBOUNDED_FOLLOWING = (1 << 63) - 1
+CURRENT_ROW = 0
+
+
+@dataclass(frozen=True)
+class WindowFrame:
+    """ROWS or RANGE frame with integer bounds (sentinels above).
+
+    For RANGE, only UNBOUNDED/CURRENT_ROW bounds plus numeric offsets over a
+    single numeric order key are supported on the device — the same shape
+    the reference supports in its batched range windows."""
+    frame_type: str = "range"  # 'rows' | 'range'
+    lower: int = UNBOUNDED_PRECEDING
+    upper: int = CURRENT_ROW
+
+    def sql(self) -> str:
+        def b(v, side):
+            if v == UNBOUNDED_PRECEDING:
+                return "UNBOUNDED PRECEDING"
+            if v == UNBOUNDED_FOLLOWING:
+                return "UNBOUNDED FOLLOWING"
+            if v == 0:
+                return "CURRENT ROW"
+            return f"{abs(v)} {'PRECEDING' if v < 0 else 'FOLLOWING'}"
+        return (f"{self.frame_type.upper()} BETWEEN {b(self.lower, 'l')} "
+                f"AND {b(self.upper, 'u')}")
+
+
+DEFAULT_FRAME = WindowFrame("range", UNBOUNDED_PRECEDING, CURRENT_ROW)
+ENTIRE_FRAME = WindowFrame("rows", UNBOUNDED_PRECEDING, UNBOUNDED_FOLLOWING)
+
+
+class WindowSpecDefinition:
+    """partition + order + frame (Catalyst WindowSpecDefinition)."""
+
+    def __init__(self, partition_spec: Sequence[Expression] = (),
+                 order_spec: Sequence[SortOrder] = (),
+                 frame: Optional[WindowFrame] = None):
+        self.partition_spec = tuple(partition_spec)
+        self.order_spec = tuple(order_spec)
+        self.frame = frame
+
+    def effective_frame(self, fn: Expression) -> WindowFrame:
+        if isinstance(fn, RankLike):
+            # rank functions fix their own frame semantics
+            return DEFAULT_FRAME
+        if self.frame is not None:
+            return self.frame
+        if self.order_spec:
+            return DEFAULT_FRAME
+        return ENTIRE_FRAME
+
+    def spec_key(self) -> Tuple:
+        """Grouping key: window exprs with the same key share one WindowExec
+        pass (Spark groups by [partition, order])."""
+        return (tuple(e.semantic_key() for e in self.partition_spec),
+                tuple((o.child.semantic_key(), o.ascending, o.nulls_first)
+                      for o in self.order_spec))
+
+    def sql(self) -> str:
+        parts = []
+        if self.partition_spec:
+            parts.append("PARTITION BY " +
+                         ", ".join(e.sql() for e in self.partition_spec))
+        if self.order_spec:
+            parts.append("ORDER BY " +
+                         ", ".join(o.sql() for o in self.order_spec))
+        if self.frame is not None:
+            parts.append(self.frame.sql())
+        return "(" + " ".join(parts) + ")"
+
+
+class WindowExpression(Unevaluable):
+    """function OVER spec.
+
+    The spec's partition/order expressions are exposed as children so that
+    tree rewrites (attribute resolution, binding) reach them — otherwise
+    string-named spec columns would never resolve against the child plan."""
+
+    def __init__(self, function: Expression, spec: WindowSpecDefinition):
+        self.children = (function,) + tuple(spec.partition_spec) + tuple(
+            o.child for o in spec.order_spec)
+        self.spec = spec
+
+    @property
+    def function(self) -> Expression:
+        return self.children[0]
+
+    def with_children(self, children):
+        np_ = len(self.spec.partition_spec)
+        parts = tuple(children[1:1 + np_])
+        orders = tuple(
+            SortOrder(c, o.ascending, o.nulls_first)
+            for c, o in zip(children[1 + np_:], self.spec.order_spec))
+        return WindowExpression(
+            children[0],
+            WindowSpecDefinition(parts, orders, self.spec.frame))
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.function.data_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def sql(self) -> str:
+        return f"{self.function.sql()} OVER {self.spec.sql()}"
+
+    def _key_extras(self):
+        return (self.spec.spec_key(),
+                None if self.spec.frame is None else self.spec.frame)
+
+
+# ---------------------------------------------------------------------------
+# Ranking / offset window functions
+# ---------------------------------------------------------------------------
+
+class WindowFunction(LeafExpression):
+    """Marker base for expressions only valid inside WindowExpression."""
+
+    def eval(self, ctx):  # pragma: no cover
+        raise RuntimeError(f"{type(self).__name__} outside a window")
+
+
+class RankLike(WindowFunction):
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+
+class RowNumber(RankLike):
+    pass
+
+
+class Rank(RankLike):
+    pass
+
+
+class DenseRank(RankLike):
+    pass
+
+
+class PercentRank(RankLike):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+
+class CumeDist(RankLike):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+
+class NTile(RankLike):
+    def __init__(self, n: int = 4):
+        self.n = int(n)
+        if self.n < 1:
+            raise ValueError("ntile bucket count must be >= 1")
+
+    def _key_extras(self):
+        return (self.n,)
+
+
+class OffsetWindowFunction(WindowFunction):
+    """lead/lag: value at a fixed row offset within the partition."""
+
+    offset_sign = 1
+
+    def __init__(self, child, offset: int = 1, default=None):
+        self.children = (resolve_expression(child),)
+        self.offset = int(offset)
+        self.default = default
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def with_children(self, children):
+        out = type(self)(children[0], self.offset, self.default)
+        return out
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def _key_extras(self):
+        return (self.offset, repr(self.default))
+
+    def sql(self):
+        return (f"{type(self).__name__.lower()}({self.child.sql()}, "
+                f"{self.offset})")
+
+
+class Lead(OffsetWindowFunction):
+    offset_sign = 1
+
+
+class Lag(OffsetWindowFunction):
+    offset_sign = -1
+
+
+class NthValue(WindowFunction):
+    def __init__(self, child, n: int, ignore_nulls: bool = False):
+        self.children = (resolve_expression(child),)
+        self.n = int(n)
+        self.ignore_nulls = bool(ignore_nulls)
+        if self.n < 1:
+            raise ValueError("nth_value n must be >= 1")
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def with_children(self, children):
+        return NthValue(children[0], self.n, self.ignore_nulls)
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def _key_extras(self):
+        return (self.n, self.ignore_nulls)

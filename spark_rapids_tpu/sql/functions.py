@@ -207,6 +207,46 @@ def last(c, ignorenulls: bool = False):
     return Column(AG.Last(_c(c), ignorenulls))
 
 
+# --- window functions (GpuWindowExpression.scala family) --------------------
+from .expressions import windows as WIN  # noqa: E402
+
+
+def row_number():
+    return Column(WIN.RowNumber())
+
+
+def rank():
+    return Column(WIN.Rank())
+
+
+def dense_rank():
+    return Column(WIN.DenseRank())
+
+
+def percent_rank():
+    return Column(WIN.PercentRank())
+
+
+def cume_dist():
+    return Column(WIN.CumeDist())
+
+
+def ntile(n: int):
+    return Column(WIN.NTile(n))
+
+
+def lead(c, offset: int = 1, default=None):
+    return Column(WIN.Lead(_c(c), offset, default))
+
+
+def lag(c, offset: int = 1, default=None):
+    return Column(WIN.Lag(_c(c), offset, default))
+
+
+def nth_value(c, n: int, ignoreNulls: bool = False):
+    return Column(WIN.NthValue(_c(c), n, ignoreNulls))
+
+
 # --- string functions (stringFunctions.scala family) ------------------------
 from .expressions import strings as STR  # noqa: E402
 

@@ -142,6 +142,11 @@ class PlanMeta:
             return [e for proj in n.projections for e in proj]
         if isinstance(n, P.Generate):
             return [n.generator]
+        if isinstance(n, P.Window):
+            out = list(n.partition_spec) + [o.child for o in n.order_spec]
+            for a in n.window_exprs:
+                out.extend(a.child.function.children)
+            return out
         return []
 
     def tag(self):
@@ -166,6 +171,8 @@ class PlanMeta:
         if isinstance(self.node, P.Generate):
             self.will_not_work("Generate (explode) is not yet supported on "
                                "TPU")
+        if isinstance(self.node, P.Window):
+            self._tag_window()
         for e in self._expressions():
             em = ExprMeta(e, self.conf)
             em.tag()
@@ -174,6 +181,43 @@ class PlanMeta:
         for c in self.children:
             c.tag()
         self.backend = "cpu" if self.reasons else "tpu"
+
+    def _tag_window(self):
+        """Window capability checks (reference GpuWindowExpression tagging
+        in GpuOverrides: supported functions, frames, types)."""
+        from .expressions import windows as WX
+        n = self.node
+        supported = (WX.RankLike, WX.Lead, WX.Lag, WX.NthValue, AGG.Sum,
+                     AGG.Count, AGG.Min, AGG.Max, AGG.Average, AGG.First,
+                     AGG.Last)
+        for a in n.window_exprs:
+            fn = a.child.function
+            if not isinstance(fn, supported):
+                self.will_not_work(
+                    f"window function {type(fn).__name__} is not supported")
+                continue
+            if isinstance(fn, (AGG.Sum, AGG.Average, AGG.Min, AGG.Max)):
+                dt = fn.children[0].data_type
+                if not (T.is_numeric(dt) and not isinstance(dt, T.DecimalType)):
+                    self.will_not_work(
+                        f"window {type(fn).__name__} over "
+                        f"{dt.simple_string()} is not supported on the device")
+            frame = a.child.spec.effective_frame(fn)
+            if frame.frame_type == "range" and (
+                    frame.lower not in (WX.UNBOUNDED_PRECEDING, WX.CURRENT_ROW)
+                    or frame.upper not in (WX.UNBOUNDED_FOLLOWING,
+                                           WX.CURRENT_ROW)):
+                if len(n.order_spec) != 1:
+                    self.will_not_work(
+                        "RANGE frame with offsets needs exactly one "
+                        "order column")
+                else:
+                    odt = n.order_spec[0].child.data_type
+                    if not (T.is_numeric(odt)
+                            and not isinstance(odt, T.DecimalType)):
+                        self.will_not_work(
+                            "RANGE frame offsets need a numeric order "
+                            f"column, got {odt.simple_string()}")
 
     def explain(self, all_ops: bool = False, level: int = 0) -> str:
         mark = "*" if self.backend == "tpu" else "!"

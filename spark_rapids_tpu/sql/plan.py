@@ -321,3 +321,26 @@ class Generate(LogicalPlan):
     @property
     def output(self):
         return list(self.child.output) + list(self.gen_output)
+
+
+@dataclass(eq=False)
+class Window(LogicalPlan):
+    """Window operator: child columns plus one output column per window
+    expression (Catalyst Window; reference GpuWindowExec SURVEY §2.3).
+    ``window_exprs`` are Alias(WindowExpression) sharing one (partition,
+    order) spec."""
+    window_exprs: Tuple[Alias, ...] = ()
+    partition_spec: Tuple[Expression, ...] = ()
+    order_spec: Tuple[SortOrder, ...] = ()
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return list(self.child.output) + [
+            a.to_attribute() for a in self.window_exprs]
+
+    def simple_string(self):
+        return (f"Window [{', '.join(a.child.sql() for a in self.window_exprs)}]")

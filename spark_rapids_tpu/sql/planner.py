@@ -72,6 +72,8 @@ class Planner:
             exec_ = UnionExec(kids, backend=kids[0].backend)
         elif isinstance(node, P.Aggregate):
             exec_ = self._plan_aggregate(node, kids[0], be)
+        elif isinstance(node, P.Window):
+            exec_ = self._plan_window(node, kids[0], be)
         elif isinstance(node, P.Sort):
             exec_ = self._plan_sort(node, kids[0], be)
         elif isinstance(node, P.Limit):
@@ -109,6 +111,23 @@ class Planner:
         shuffled = ShuffleExchangeExec(part, partial, backend=be)
         return HashAggregateExec(node.grouping, node.aggregates, "final",
                                  shuffled, backend=be)
+
+    def _plan_window(self, node: P.Window, child: PhysicalPlan, be):
+        from ..sql.plan import SortOrder
+        from .physical.window import WindowExec
+        if child.num_partitions() > 1:
+            if node.partition_spec:
+                part = HashPartitioning(list(node.partition_spec),
+                                        child.num_partitions())
+            else:
+                part = SinglePartitioning()
+            child = ShuffleExchangeExec(part, child, backend=be)
+        orders = ([SortOrder(e) for e in node.partition_spec]
+                  + list(node.order_spec))
+        if orders:
+            child = SortExec(orders, child, backend=be)
+        return WindowExec(node.window_exprs, node.partition_spec,
+                          node.order_spec, child, backend=be)
 
     def _plan_sort(self, node: P.Sort, child: PhysicalPlan, be):
         if node.is_global and child.num_partitions() > 1:

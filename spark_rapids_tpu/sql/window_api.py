@@ -1,0 +1,86 @@
+"""User-facing window spec builder — mirrors ``pyspark.sql.Window`` so
+reference workloads port unchanged (SURVEY §1 user-visible API)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .expressions.core import Expression, resolve_expression
+from .expressions.windows import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
+                                  UNBOUNDED_PRECEDING, WindowFrame,
+                                  WindowSpecDefinition)
+from .plan import SortOrder
+
+
+def _orders(cols) -> list:
+    out = []
+    for c in cols:
+        e = c.expr if hasattr(c, "expr") else c
+        if isinstance(e, SortOrder):
+            out.append(e)
+        elif isinstance(e, Expression):
+            so = getattr(e, "_sort_order", None)
+            out.append(so if so is not None else SortOrder(e))
+        else:
+            out.append(SortOrder(resolve_expression(e)))
+    return out
+
+
+class WindowSpec:
+    def __init__(self, partition=(), order=(), frame=None):
+        self._partition = tuple(partition)
+        self._order = tuple(order)
+        self._frame = frame
+
+    def partitionBy(self, *cols) -> "WindowSpec":
+        exprs = []
+        for c in cols:
+            from .dataframe import Column
+            if isinstance(c, str):
+                from .functions import col as col_fn
+                c = col_fn(c)
+            exprs.append(c.expr if isinstance(c, Column) else
+                         resolve_expression(c))
+        return WindowSpec(exprs, self._order, self._frame)
+
+    def orderBy(self, *cols) -> "WindowSpec":
+        cols = [(_str_col(c) if isinstance(c, str) else c) for c in cols]
+        return WindowSpec(self._partition, _orders(cols), self._frame)
+
+    def rowsBetween(self, start: int, end: int) -> "WindowSpec":
+        return WindowSpec(self._partition, self._order,
+                          WindowFrame("rows", int(start), int(end)))
+
+    def rangeBetween(self, start: int, end: int) -> "WindowSpec":
+        return WindowSpec(self._partition, self._order,
+                          WindowFrame("range", int(start), int(end)))
+
+    def to_definition(self) -> WindowSpecDefinition:
+        return WindowSpecDefinition(self._partition, self._order, self._frame)
+
+
+def _str_col(name: str):
+    from .functions import col
+    return col(name)
+
+
+class Window:
+    unboundedPreceding = UNBOUNDED_PRECEDING
+    unboundedFollowing = UNBOUNDED_FOLLOWING
+    currentRow = CURRENT_ROW
+
+    @staticmethod
+    def partitionBy(*cols) -> WindowSpec:
+        return WindowSpec().partitionBy(*cols)
+
+    @staticmethod
+    def orderBy(*cols) -> WindowSpec:
+        return WindowSpec().orderBy(*cols)
+
+    @staticmethod
+    def rowsBetween(start: int, end: int) -> WindowSpec:
+        return WindowSpec().rowsBetween(start, end)
+
+    @staticmethod
+    def rangeBetween(start: int, end: int) -> WindowSpec:
+        return WindowSpec().rangeBetween(start, end)

@@ -1,0 +1,302 @@
+"""Window exec + expression tests — reference coverage model:
+integration_tests window_function_test.py (rank family, lead/lag, frame
+aggregations, range frames), cross-checked against pandas and the host
+engine."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import Window
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def make_df(sess, n=500, seed=3, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(-50, 50, n).astype("float64")
+    nulls = (rng.random(n) < 0.1) if with_nulls else np.zeros(n, bool)
+    t = pa.table({
+        "g": pa.array(rng.integers(0, 7, n), type=pa.int64()),
+        "o": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+        "v": pa.array([None if nu else float(v)
+                       for v, nu in zip(vals, nulls)], type=pa.float64()),
+        "u": pa.array(np.arange(n), type=pa.int64()),  # unique tiebreak
+    })
+    return sess.create_dataframe(t), t.to_pandas()
+
+
+def both_engines(df, sort_cols):
+    sess = df._session
+    tpu = df.collect().to_pandas().sort_values(sort_cols).reset_index(drop=True)
+    sess.conf.set("spark.rapids.sql.enabled", False)
+    try:
+        cpu = df.collect().to_pandas().sort_values(sort_cols).reset_index(drop=True)
+    finally:
+        sess.conf.set("spark.rapids.sql.enabled", True)
+    pd.testing.assert_frame_equal(tpu, cpu, check_dtype=False)
+    return tpu
+
+
+def test_rank_family(sess):
+    df, pdf = make_df(sess)
+    w = Window.partitionBy("g").orderBy("o")
+    out = df.select(
+        df.u, df.g, df.o,
+        F.row_number().over(w).alias("rn"),
+        F.rank().over(w).alias("rk"),
+        F.dense_rank().over(w).alias("dr"),
+        F.percent_rank().over(w).alias("pr"),
+        F.cume_dist().over(w).alias("cd"),
+        F.ntile(4).over(w).alias("nt"),
+    )
+    got = both_engines(out, ["u"])
+
+    g = pdf.sort_values(["g", "o", "u"]).groupby("g")
+    exp = pdf.copy()
+    exp["rk"] = g["o"].rank(method="min").astype(int)
+    exp["dr"] = g["o"].rank(method="dense").astype(int)
+    exp = exp.sort_values("u").reset_index(drop=True)
+    assert (got["rk"] == exp["rk"]).all()
+    assert (got["dr"] == exp["dr"]).all()
+    # row_number is unique 1..len within each partition
+    for _, grp in got.groupby("g"):
+        assert sorted(grp["rn"]) == list(range(1, len(grp) + 1))
+    # percent_rank = (rank-1)/(n-1)
+    sizes = got.groupby("g")["u"].transform("count")
+    expected_pr = np.where(sizes > 1, (got["rk"] - 1) / (sizes - 1), 0.0)
+    assert np.allclose(got["pr"], expected_pr)
+    # cume_dist in (0, 1]
+    assert ((got["cd"] > 0) & (got["cd"] <= 1)).all()
+    # ntile buckets 1..4
+    assert got["nt"].between(1, 4).all()
+
+
+def test_lead_lag(sess):
+    df, pdf = make_df(sess)
+    w = Window.partitionBy("g").orderBy("u")
+    out = df.select(
+        df.u, df.g, df.v,
+        F.lag(df.v, 1).over(w).alias("lag1"),
+        F.lead(df.v, 2).over(w).alias("lead2"),
+        F.lag(df.v, 1, -999.0).over(w).alias("lag_d"),
+    )
+    got = both_engines(out, ["u"])
+    exp = pdf.sort_values(["g", "u"]).copy()
+    grp = exp.groupby("g")["v"]
+    exp["lag1"] = grp.shift(1)
+    exp["lead2"] = grp.shift(-2)
+    exp["lag_d"] = grp.shift(1).where(grp.shift(1).notna() |
+                                      grp.transform("cumcount").eq(0) == False)
+    exp = exp.sort_values("u").reset_index(drop=True)
+    assert np.allclose(got["lag1"].fillna(1e18), exp["lag1"].fillna(1e18))
+    assert np.allclose(got["lead2"].fillna(1e18), exp["lead2"].fillna(1e18))
+    # default fills only out-of-partition positions (first row per group)
+    first_rows = exp.groupby("g")["u"].transform("min") == exp["u"]
+    assert (got.loc[first_rows.values, "lag_d"] == -999.0).all()
+
+
+def test_running_and_sliding_aggs(sess):
+    df, pdf = make_df(sess)
+    # deterministic order: unique key u
+    running = Window.partitionBy("g").orderBy("u")
+    sliding = Window.partitionBy("g").orderBy("u").rowsBetween(-2, 2)
+    out = df.select(
+        df.u, df.g, df.v,
+        F.sum(df.v).over(running).alias("rsum"),
+        F.count(df.v).over(running).alias("rcnt"),
+        F.sum(df.v).over(sliding).alias("ssum"),
+        F.min(df.v).over(sliding).alias("smin"),
+        F.max(df.v).over(sliding).alias("smax"),
+        F.avg(df.v).over(sliding).alias("savg"),
+    )
+    got = both_engines(out, ["u"])
+    exp = pdf.sort_values(["g", "u"]).copy()
+    grp = exp.groupby("g")["v"]
+    # null-skipping running sum (Spark semantics; pandas cumsum propagates NaN)
+    exp["rsum"] = grp.transform(lambda s: s.expanding().sum())
+    exp["rcnt"] = grp.expanding().count().reset_index(level=0, drop=True)
+    exp["ssum"] = grp.transform(
+        lambda s: s.rolling(5, center=True, min_periods=1).sum())
+    exp["smin"] = grp.transform(
+        lambda s: s.rolling(5, center=True, min_periods=1).min())
+    exp["smax"] = grp.transform(
+        lambda s: s.rolling(5, center=True, min_periods=1).max())
+    exp["savg"] = grp.transform(
+        lambda s: s.rolling(5, center=True, min_periods=1).mean())
+    exp = exp.sort_values("u").reset_index(drop=True)
+    for c in ("rsum", "ssum", "smin", "smax", "savg"):
+        assert np.allclose(got[c].fillna(1e18), exp[c].fillna(1e18)), c
+    assert (got["rcnt"] == exp["rcnt"].fillna(0).astype(int)).all()
+
+
+def test_range_frame_peers(sess):
+    """Default frame (RANGE unbounded->current) includes peer rows."""
+    df, pdf = make_df(sess, with_nulls=False)
+    w = Window.partitionBy("g").orderBy("o")  # ties in o => peers
+    out = df.select(df.u, df.g, df.o, df.v,
+                    F.sum(df.v).over(w).alias("s"))
+    got = both_engines(out, ["u"])
+    # oracle: for each row, sum of v over rows in same g with o <= o_i
+    exp = []
+    for _, r in got.iterrows():
+        m = pdf[(pdf.g == r.g) & (pdf.o <= r.o)]
+        exp.append(m.v.sum())
+    assert np.allclose(got["s"], exp)
+
+
+def test_range_frame_numeric_offsets(sess):
+    df, pdf = make_df(sess, with_nulls=False)
+    w = Window.partitionBy("g").orderBy("o").rangeBetween(-5, 5)
+    out = df.select(df.u, df.g, df.o, df.v,
+                    F.sum(df.v).over(w).alias("s"),
+                    F.count(df.v).over(w).alias("c"))
+    got = both_engines(out, ["u"])
+    for _, r in got.sample(60, random_state=0).iterrows():
+        m = pdf[(pdf.g == r.g) & (pdf.o >= r.o - 5) & (pdf.o <= r.o + 5)]
+        assert np.isclose(r["s"], m.v.sum()), (r.g, r.o)
+        assert r["c"] == m.v.count()
+
+
+def test_range_frame_desc(sess):
+    df, pdf = make_df(sess, n=200, with_nulls=False)
+    w = Window.partitionBy("g").orderBy(df.o.desc()).rangeBetween(-3, 0)
+    out = df.select(df.u, df.g, df.o, df.v,
+                    F.count(df.v).over(w).alias("c"))
+    got = both_engines(out, ["u"])
+    for _, r in got.sample(40, random_state=1).iterrows():
+        # desc: "preceding 3" means o in [o_i, o_i + 3]
+        m = pdf[(pdf.g == r.g) & (pdf.o <= r.o + 3) & (pdf.o >= r.o)]
+        assert r["c"] == m.v.count(), (r.g, r.o)
+
+
+def test_first_last_nth(sess):
+    df, pdf = make_df(sess)
+    w = (Window.partitionBy("g").orderBy("u")
+         .rowsBetween(Window.unboundedPreceding, Window.unboundedFollowing))
+    out = df.select(
+        df.u, df.g, df.v,
+        F.first(df.v).over(w).alias("f"),
+        F.last(df.v).over(w).alias("l"),
+        F.first(df.v, ignorenulls=True).over(w).alias("fnn"),
+        F.nth_value(df.v, 3).over(w).alias("n3"),
+    )
+    got = both_engines(out, ["u"])
+    exp = pdf.sort_values(["g", "u"])
+    for gv, grp in exp.groupby("g"):
+        rows = got[got.g == gv]
+        seq = grp["v"].tolist()
+        assert all(_eq(x, seq[0]) for x in rows["f"])
+        assert all(_eq(x, seq[-1]) for x in rows["l"])
+        nn = grp["v"].dropna()
+        if len(nn):
+            assert all(_eq(x, nn.iloc[0]) for x in rows["fnn"])
+        n3 = seq[2] if len(seq) >= 3 else None
+        assert all(_eq(x, n3) for x in rows["n3"])
+
+
+def _eq(a, b):
+    an = a is None or (isinstance(a, float) and np.isnan(a))
+    bn = b is None or (isinstance(b, float) and np.isnan(b))
+    if an or bn:
+        return an and bn
+    return np.isclose(a, b)
+
+
+def test_no_partition_window(sess):
+    df, pdf = make_df(sess, n=100)
+    w = Window.orderBy("u")
+    out = df.select(df.u, F.row_number().over(w).alias("rn"),
+                    F.sum(df.v).over(w).alias("s"))
+    got = both_engines(out, ["u"])
+    assert (got["rn"] == np.arange(1, 101)).all()
+    exp = pdf.sort_values("u")["v"].expanding().sum()
+    assert np.allclose(got["s"].fillna(1e18), exp.fillna(1e18).values)
+
+
+def test_multiple_specs_chain(sess):
+    """Two different (partition, order) specs => chained Window nodes."""
+    df, pdf = make_df(sess, n=150)
+    w1 = Window.partitionBy("g").orderBy("u")
+    w2 = Window.orderBy("u")
+    out = df.select(df.u, df.g,
+                    F.row_number().over(w1).alias("rn_g"),
+                    F.row_number().over(w2).alias("rn_all"))
+    got = both_engines(out, ["u"])
+    assert (got["rn_all"] == np.arange(1, 151)).all()
+    for _, grp in got.groupby("g"):
+        assert sorted(grp["rn_g"]) == list(range(1, len(grp) + 1))
+
+
+def test_window_explain_placement(sess):
+    df, _ = make_df(sess, n=50)
+    w = Window.partitionBy("g").orderBy("u")
+    out = df.select(df.u, F.row_number().over(w).alias("rn"))
+    report = sess.explain(out)
+    assert "TpuWindow" in report
+
+
+def test_string_spec_resolution_stays_on_device(sess):
+    """String-named spec columns must resolve (not leave void attrs that
+    silently force host fallback)."""
+    df, _ = make_df(sess, n=50, with_nulls=False)
+    w = Window.partitionBy("g").orderBy("o").rangeBetween(-5, 5)
+    out = df.select(df.u, F.sum(df.v).over(w).alias("s"))
+    from spark_rapids_tpu.sql.overrides import TpuOverrides
+    meta = TpuOverrides.apply(out._plan, sess._conf)
+    def backends(m):
+        yield type(m.node).__name__, m.backend, m.reasons
+        for c in m.children:
+            yield from backends(c)
+    for name, be, reasons in backends(meta):
+        assert be == "tpu", (name, reasons)
+
+
+def test_lag_string_with_default(sess):
+    t = pa.table({"g": [1, 1, 1, 2, 2], "u": [1, 2, 3, 4, 5],
+                  "s": ["aa", "bbbb", "c", "dd", "e"]})
+    df = sess.create_dataframe(t)
+    w = Window.partitionBy("g").orderBy("u")
+    got = df.select(df.u, F.lag(df.s, 1, "zzz").over(w).alias("p")) \
+        .collect().to_pandas().sort_values("u")
+    assert got["p"].tolist() == ["zzz", "aa", "bbbb", "zzz", "dd"]
+
+
+def test_range_frame_int64_precision(sess):
+    base = 10_000_000_000_000_000  # beyond float64 integer precision
+    t = pa.table({"g": [1] * 4, "o": [base, base + 1, base + 2, base + 3],
+                  "v": [1.0, 1.0, 1.0, 1.0]})
+    df = sess.create_dataframe(t)
+    w = Window.partitionBy("g").orderBy("o").rangeBetween(-1, 0)
+    got = df.select(df.o, F.count(df.v).over(w).alias("c")) \
+        .collect().to_pandas().sort_values("o")
+    assert got["c"].tolist() == [1, 2, 2, 2]
+
+
+def test_ntile_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        F.ntile(0)
+
+
+def test_identical_specs_share_one_window_node(sess):
+    df, _ = make_df(sess, n=30)
+    out = df.select(
+        df.u,
+        F.row_number().over(Window.partitionBy("g").orderBy("o")).alias("a"),
+        F.rank().over(Window.partitionBy("g").orderBy("o")).alias("b"))
+    import spark_rapids_tpu.sql.plan as P
+    n_windows = 0
+    node = out._plan
+    stack = [node]
+    while stack:
+        nd = stack.pop()
+        if isinstance(nd, P.Window):
+            n_windows += 1
+        stack.extend(nd.children)
+    assert n_windows == 1
