@@ -1,0 +1,559 @@
+"""Datetime expression family — reference ``datetimeExpressions.scala``
+(1170 LoC) + ``DateUtils.scala`` (SURVEY §2.4).  All extraction/arithmetic
+runs on-device via the integer civil-date kernels in ``ops/datetime_ops``.
+
+Timezone stance: like the reference (which validates executor TZ and
+restricts timezone-aware expressions to UTC), the device path supports the
+UTC session timezone; other zones tag to the host."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn, bucket_width
+from ...ops import datetime_ops as DT
+from .core import (BinaryExpression, EvalContext, Expression, Literal,
+                   UnaryExpression, fixed, resolve_expression, valid_and)
+
+_UTC_NAMES = {"utc", "gmt", "z", "etc/utc", "gmt+0", "utc+0", "+00:00"}
+
+
+def _tz_reason(ctx_conf_tz: str) -> Optional[str]:
+    if str(ctx_conf_tz).lower() not in _UTC_NAMES:
+        return (f"session timezone {ctx_conf_tz!r} is not UTC; "
+                "timezone-aware datetime ops run on the host")
+    return None
+
+
+class _TimezoneAware:
+    """Mixin: tag non-UTC sessions to the host (Plugin.scala:373-384
+    timezone validation analog)."""
+
+    def tag_for_device(self, conf=None) -> Optional[str]:
+        from ...config import RapidsConf, SESSION_TIMEZONE
+        conf = conf or RapidsConf.get_global()
+        return _tz_reason(conf.get(SESSION_TIMEZONE))
+
+
+def _days(ctx, col: DeviceColumn):
+    """Days-since-epoch view of a DATE or TIMESTAMP column."""
+    if isinstance(col.dtype, T.TimestampType):
+        return DT.timestamp_to_date_days(ctx.xp, col.data)
+    return col.data
+
+
+class _TzIfTimestamp(_TimezoneAware):
+    """Date-field ops are timezone-free on DATE inputs but timezone-aware on
+    TIMESTAMP inputs (the local civil date depends on the zone)."""
+
+    def tag_for_device(self, conf=None) -> Optional[str]:
+        if any(isinstance(c.data_type, T.TimestampType)
+               for c in self.children):
+            return _TimezoneAware.tag_for_device(self, conf)
+        return None
+
+
+class _DateField(_TzIfTimestamp, UnaryExpression):
+    """Extract an int field from a date/timestamp column."""
+    _fn = None
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, c):
+        days = _days(ctx, c)
+        return fixed(T.INT, type(self)._fn(ctx.xp, days), c.validity)
+
+
+class Year(_DateField):
+    _fn = staticmethod(lambda xp, d: DT.civil_from_days(xp, d)[0])
+
+
+class Month(_DateField):
+    _fn = staticmethod(lambda xp, d: DT.civil_from_days(xp, d)[1])
+
+
+class DayOfMonth(_DateField):
+    _fn = staticmethod(lambda xp, d: DT.civil_from_days(xp, d)[2])
+
+
+class DayOfWeek(_DateField):
+    _fn = staticmethod(DT.day_of_week)
+
+
+class WeekDay(_DateField):
+    _fn = staticmethod(DT.weekday)
+
+
+class DayOfYear(_DateField):
+    _fn = staticmethod(DT.day_of_year)
+
+
+class WeekOfYear(_DateField):
+    _fn = staticmethod(DT.week_of_year)
+
+
+class Quarter(_DateField):
+    _fn = staticmethod(
+        lambda xp, d: ((DT.civil_from_days(xp, d)[1] - 1) // 3 + 1)
+        .astype(xp.int32))
+
+
+class LastDay(_TzIfTimestamp, UnaryExpression):
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def kernel(self, ctx, c):
+        return fixed(T.DATE, DT.last_day(ctx.xp, _days(ctx, c)), c.validity)
+
+
+class _TimeField(_TimezoneAware, UnaryExpression):
+    _fn = None
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, c):
+        return fixed(T.INT, type(self)._fn(ctx.xp, c.data), c.validity)
+
+
+class Hour(_TimeField):
+    _fn = staticmethod(DT.extract_hour)
+
+
+class Minute(_TimeField):
+    _fn = staticmethod(DT.extract_minute)
+
+
+class Second(_TimeField):
+    _fn = staticmethod(DT.extract_second)
+
+
+# ---------------------------------------------------------------------------
+# Date arithmetic
+# ---------------------------------------------------------------------------
+
+class DateAdd(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def kernel(self, ctx, d, n):
+        xp = ctx.xp
+        out = (d.data.astype(xp.int64) + n.data.astype(xp.int64))
+        return fixed(T.DATE, out.astype(xp.int32), valid_and(xp, d, n))
+
+
+class DateSub(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def kernel(self, ctx, d, n):
+        xp = ctx.xp
+        out = (d.data.astype(xp.int64) - n.data.astype(xp.int64))
+        return fixed(T.DATE, out.astype(xp.int32), valid_and(xp, d, n))
+
+
+class DateDiff(_TzIfTimestamp, BinaryExpression):
+    """datediff(end, start) in days."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def kernel(self, ctx, end, start):
+        xp = ctx.xp
+        de = _days(ctx, end)
+        ds = _days(ctx, start)
+        return fixed(T.INT, (de - ds).astype(xp.int32),
+                     valid_and(xp, end, start))
+
+
+class AddMonths(_TzIfTimestamp, BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def kernel(self, ctx, d, n):
+        xp = ctx.xp
+        return fixed(T.DATE, DT.add_months(xp, _days(ctx, d), n.data),
+                     valid_and(xp, d, n))
+
+
+class MonthsBetween(_TzIfTimestamp, Expression):
+    def __init__(self, ts1, ts2, round_off=True):
+        self.children = (resolve_expression(ts1), resolve_expression(ts2))
+        self.round_off = bool(round_off)
+
+    def with_children(self, children):
+        return MonthsBetween(children[0], children[1], self.round_off)
+
+    def _key_extras(self):
+        return (self.round_off,)
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+
+        def micros(col):
+            if isinstance(col.dtype, T.DateType):
+                return col.data.astype(xp.int64) * DT.MICROS_PER_DAY
+            return col.data
+        out = DT.months_between(xp, micros(a), micros(b), self.round_off)
+        return fixed(T.DOUBLE, out, valid_and(xp, a, b))
+
+
+class TruncDate(_TzIfTimestamp, Expression):
+    """trunc(date, 'unit')."""
+
+    def __init__(self, date, fmt):
+        self.children = (resolve_expression(date), resolve_expression(fmt))
+
+    def with_children(self, children):
+        return TruncDate(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def tag_for_device(self, conf=None):
+        r = _TzIfTimestamp.tag_for_device(self, conf)
+        if r:
+            return r
+        f = self.children[1]
+        if not isinstance(f, Literal) or not isinstance(f.value, str):
+            return "trunc unit must be a literal string"
+        try:
+            import numpy as _np
+            DT.trunc_date(_np, _np.zeros(1, _np.int32), f.value)
+        except ValueError as e:
+            return str(e)
+        return None
+
+    def kernel(self, ctx, d, f):
+        unit = self.children[1].value
+        xp = ctx.xp
+        try:
+            out = DT.trunc_date(xp, _days(ctx, d), unit)
+            return fixed(T.DATE, out, valid_and(xp, d, f))
+        except ValueError:
+            return fixed(T.DATE, ctx.xp.zeros_like(d.data),
+                         ctx.xp.zeros_like(d.validity))
+
+
+class TimeAdd(Expression):
+    """timestamp + literal interval (micros only, like the reference's
+    GpuTimeAdd literal restriction)."""
+
+    def __init__(self, ts, interval_micros):
+        self.children = (resolve_expression(ts),)
+        self.interval_micros = int(interval_micros)
+
+    def with_children(self, children):
+        return TimeAdd(children[0], self.interval_micros)
+
+    def _key_extras(self):
+        return (self.interval_micros,)
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def kernel(self, ctx, c):
+        return fixed(T.TIMESTAMP, c.data + self.interval_micros, c.validity)
+
+
+class DateAddInterval(Expression):
+    """date + literal interval (months/days; micros must be zero)."""
+
+    def __init__(self, date, months=0, days=0, micros=0):
+        self.children = (resolve_expression(date),)
+        self.months, self.days, self.micros = int(months), int(days), int(micros)
+
+    def with_children(self, children):
+        return DateAddInterval(children[0], self.months, self.days,
+                               self.micros)
+
+    def _key_extras(self):
+        return (self.months, self.days, self.micros)
+
+    def tag_for_device(self, conf=None):
+        if self.micros != 0:
+            return "INTERVAL with sub-day parts on DATE runs on the host"
+        return None
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        d = c.data
+        if self.months:
+            d = DT.add_months(xp, d, xp.full_like(d, self.months))
+        return fixed(T.DATE, (d + self.days).astype(xp.int32), c.validity)
+
+
+# ---------------------------------------------------------------------------
+# Epoch conversions
+# ---------------------------------------------------------------------------
+
+class _ToTimestamp(UnaryExpression):
+    _scale = 1
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        out = c.data.astype(xp.int64) * type(self)._scale
+        return fixed(T.TIMESTAMP, out, c.validity)
+
+
+class MicrosToTimestamp(_ToTimestamp):
+    _scale = 1
+
+
+class MillisToTimestamp(_ToTimestamp):
+    _scale = 1_000
+
+
+class SecondsToTimestamp(_ToTimestamp):
+    _scale = 1_000_000
+
+
+class PreciseTimestampConversion(Expression):
+    """Internal long<->timestamp used by window range frames in Spark."""
+
+    def __init__(self, child, from_type, to_type):
+        self.children = (resolve_expression(child),)
+        self.from_type, self.to_type = from_type, to_type
+
+    def with_children(self, children):
+        return PreciseTimestampConversion(children[0], self.from_type,
+                                          self.to_type)
+
+    @property
+    def data_type(self):
+        return self.to_type
+
+    def kernel(self, ctx, c):
+        return fixed(self.to_type, c.data, c.validity)
+
+
+class UnixMicros(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def kernel(self, ctx, c):
+        return fixed(T.LONG, c.data.astype(ctx.xp.int64), c.validity)
+
+
+_DEFAULT_FMT = "yyyy-MM-dd HH:mm:ss"
+
+
+def _flexible_parse_micros(s: str) -> Optional[int]:
+    """Spark cast-to-timestamp parsing (date-only, 'T' or space separator,
+    optional fraction) — the host path behind to_timestamp's default."""
+    import datetime as _dt
+    s = s.strip()
+    epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+    try:
+        if len(s) == 10:
+            d = _dt.date.fromisoformat(s)
+            return (d - _dt.date(1970, 1, 1)).days * DT.MICROS_PER_DAY
+        v = _dt.datetime.fromisoformat(s.replace("T", " ", 1))
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=_dt.timezone.utc)
+        return (v - epoch) // _dt.timedelta(microseconds=1)
+    except ValueError:
+        return None
+
+
+class _FormatBase(_TimezoneAware):
+    def _fmt(self) -> Optional[str]:
+        f = self.children[1]
+        if isinstance(f, Literal) and isinstance(f.value, str):
+            return f.value
+        return None
+
+    def _is_flexible(self) -> bool:
+        f = self.children[1]
+        return isinstance(f, Literal) and f.value is None
+
+    def tag_for_device(self, conf=None):
+        r = _TimezoneAware.tag_for_device(self, conf)
+        if r:
+            return r
+        if self._is_flexible():
+            return ("default (flexible) datetime parsing runs on the host "
+                    "engine")
+        fmt = self._fmt()
+        if fmt is None:
+            return "datetime pattern must be a literal string"
+        if DT.compile_format(fmt) is None:
+            return (f"datetime pattern {fmt!r} has variable-width or "
+                    "unsupported tokens; runs on the host")
+        return None
+
+    def _parse_column(self, ctx, c, f):
+        """string column -> (micros int64, ok mask); flexible or fixed."""
+        xp = ctx.xp
+        if self._is_flexible():
+            chars = np.asarray(c.data)
+            lens = np.asarray(c.lengths)
+            micros = np.zeros(chars.shape[0], dtype=np.int64)
+            ok = np.zeros(chars.shape[0], dtype=bool)
+            for i in range(chars.shape[0]):
+                v = _flexible_parse_micros(
+                    bytes(chars[i, :int(lens[i])]).decode("utf-8", "replace"))
+                if v is not None:
+                    micros[i] = v
+                    ok[i] = True
+            return xp.asarray(micros), xp.asarray(ok)
+        return DT.parse_timestamp(xp, c.data, c.lengths, self._fmt())
+
+
+class DateFormatClass(_FormatBase, BinaryExpression):
+    """date_format(ts, fmt) -> string."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, c, f):
+        xp = ctx.xp
+        fmt = self._fmt()
+        micros = c.data if isinstance(c.dtype, T.TimestampType) else \
+            c.data.astype(xp.int64) * DT.MICROS_PER_DAY
+        tlen = len(DT.compile_format(fmt)[0])
+        chars, lens = DT.format_timestamp(xp, micros, fmt,
+                                          bucket_width(tlen))
+        return DeviceColumn(T.STRING, chars, valid_and(xp, c, f),
+                            lengths=lens)
+
+
+class FromUnixTime(_FormatBase, BinaryExpression):
+    """from_unixtime(seconds, fmt) -> string."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def kernel(self, ctx, c, f):
+        xp = ctx.xp
+        fmt = self._fmt()
+        micros = c.data.astype(xp.int64) * DT.MICROS_PER_SEC
+        tlen = len(DT.compile_format(fmt)[0])
+        chars, lens = DT.format_timestamp(xp, micros, fmt,
+                                          bucket_width(tlen))
+        return DeviceColumn(T.STRING, chars, valid_and(xp, c, f),
+                            lengths=lens)
+
+
+class ToUnixTimestamp(_FormatBase, BinaryExpression):
+    """to_unix_timestamp(expr, fmt) -> long seconds."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def tag_for_device(self, conf=None):
+        ch = self.children[0]
+        if isinstance(ch.data_type, T.StringType):
+            return _FormatBase.tag_for_device(self, conf)
+        return _TimezoneAware.tag_for_device(self, conf)
+
+    def kernel(self, ctx, c, f):
+        xp = ctx.xp
+        if isinstance(c.dtype, T.TimestampType):
+            return fixed(T.LONG,
+                         xp.floor_divide(c.data, DT.MICROS_PER_SEC),
+                         valid_and(xp, c, f))
+        if isinstance(c.dtype, T.DateType):
+            return fixed(T.LONG, c.data.astype(xp.int64) * 86400,
+                         valid_and(xp, c, f))
+        micros, ok = self._parse_column(ctx, c, f)
+        valid = c.validity if self._is_flexible() else valid_and(xp, c, f)
+        return fixed(T.LONG, xp.floor_divide(micros, DT.MICROS_PER_SEC),
+                     valid & ok)
+
+
+class UnixTimestamp(ToUnixTimestamp):
+    pass
+
+
+class GetTimestamp(_FormatBase, BinaryExpression):
+    """to_timestamp(string, fmt) (Spark's internal GetTimestamp)."""
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def kernel(self, ctx, c, f):
+        xp = ctx.xp
+        if isinstance(c.dtype, T.TimestampType):
+            return c
+        if isinstance(c.dtype, T.DateType):
+            return fixed(T.TIMESTAMP,
+                         c.data.astype(xp.int64) * DT.MICROS_PER_DAY,
+                         valid_and(xp, c, f))
+        micros, ok = self._parse_column(ctx, c, f)
+        valid = c.validity if self._is_flexible() else valid_and(xp, c, f)
+        return fixed(T.TIMESTAMP, micros, valid & ok)
+
+
+class FromUTCTimestamp(Expression):
+    """from_utc_timestamp(ts, tz): shift UTC instant to wall-clock of tz.
+    Device path supports fixed-offset zones and UTC aliases (reference
+    supports UTC only, GpuFromUTCTimestamp)."""
+
+    def __init__(self, ts, tz):
+        self.children = (resolve_expression(ts), resolve_expression(tz))
+
+    def with_children(self, children):
+        return FromUTCTimestamp(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def _offset_micros(self) -> Optional[int]:
+        tz = self.children[1]
+        if not (isinstance(tz, Literal) and isinstance(tz.value, str)):
+            return None
+        name = tz.value.strip()
+        if name.lower() in _UTC_NAMES:
+            return 0
+        import re
+        m = re.fullmatch(r"(?:GMT|UTC)?([+-])(\d{1,2})(?::(\d{2}))?", name)
+        if not m:
+            return None
+        sign = 1 if m.group(1) == "+" else -1
+        hours = int(m.group(2))
+        mins = int(m.group(3) or 0)
+        return sign * (hours * 3600 + mins * 60) * DT.MICROS_PER_SEC
+
+    def tag_for_device(self, conf=None):
+        if self._offset_micros() is None:
+            return ("from_utc_timestamp supports literal UTC/fixed-offset "
+                    "zones on the device; region zones run on the host")
+        return None
+
+    def kernel(self, ctx, c, tz):
+        off = self._offset_micros()
+        if off is None:
+            raise RuntimeError("non-literal timezone on device")
+        return fixed(T.TIMESTAMP, c.data + off, valid_and(ctx.xp, c, tz))

@@ -11,6 +11,7 @@ from .core import Alias, AttributeReference, BoundReference, Expression, Literal
 from . import arithmetic as A
 from . import cast as C
 from . import conditional as Cond
+from . import datetime as Dt
 from . import hashing as Hsh
 from . import math_fns as M
 from . import predicates as P
@@ -41,6 +42,14 @@ _reg(Cond.If, Cond.CaseWhen, Cond.Coalesce, Cond.NaNvl, Cond.KnownNotNull,
      Cond.KnownFloatingPointNormalized, Cond.NormalizeNaNAndZero,
      Cond.RaiseError)
 _reg(C.Cast)
+_reg(Dt.Year, Dt.Month, Dt.DayOfMonth, Dt.DayOfWeek, Dt.WeekDay,
+     Dt.DayOfYear, Dt.WeekOfYear, Dt.Quarter, Dt.LastDay, Dt.Hour, Dt.Minute,
+     Dt.Second, Dt.DateAdd, Dt.DateSub, Dt.DateDiff, Dt.AddMonths,
+     Dt.MonthsBetween, Dt.TruncDate, Dt.TimeAdd, Dt.DateAddInterval,
+     Dt.MicrosToTimestamp, Dt.MillisToTimestamp, Dt.SecondsToTimestamp,
+     Dt.PreciseTimestampConversion, Dt.UnixMicros, Dt.DateFormatClass,
+     Dt.FromUnixTime, Dt.ToUnixTimestamp, Dt.UnixTimestamp, Dt.GetTimestamp,
+     Dt.FromUTCTimestamp)
 _reg(Hsh.Murmur3Hash, Hsh.XxHash64)
 _reg(Str.Length, Str.OctetLength, Str.BitLength, Str.Upper, Str.Lower,
      Str.InitCap, Str.Reverse, Str.Substring, Str.SubstringIndex, Str.Concat,

@@ -284,7 +284,7 @@ class Like(BinaryExpression):
     def _key_extras(self):
         return (self.escape,)
 
-    def tag_for_device(self) -> Optional[str]:
+    def tag_for_device(self, conf=None) -> Optional[str]:
         r = _require_literal(self.children[1], "LIKE pattern")
         if r:
             return r
@@ -438,7 +438,7 @@ class StringTranslate(Expression):
     def data_type(self):
         return T.STRING
 
-    def tag_for_device(self) -> Optional[str]:
+    def tag_for_device(self, conf=None) -> Optional[str]:
         for i, what in ((1, "translate from-set"), (2, "translate to-set")):
             r = _require_literal(self.children[i], what)
             if r:
@@ -490,7 +490,7 @@ class StringRepeat(BinaryExpression):
     def data_type(self):
         return T.STRING
 
-    def tag_for_device(self) -> Optional[str]:
+    def tag_for_device(self, conf=None) -> Optional[str]:
         n = self.children[1]
         if not (isinstance(n, Literal) and isinstance(n.value, int)):
             return "repeat count must be a literal to run on the device"
@@ -540,7 +540,7 @@ class _PadBase(Expression):
                                   out_width, left=self._left)
         return _mk(T.STRING, chars, lens, valid_and(xp, c, l, p))
 
-    def tag_for_device(self) -> Optional[str]:
+    def tag_for_device(self, conf=None) -> Optional[str]:
         lit = self.children[1]
         if not (isinstance(lit, Literal) and isinstance(lit.value, int)):
             return "pad target length must be a literal to run on the device"
@@ -573,7 +573,7 @@ class _TrimBase(Expression):
     def data_type(self):
         return T.STRING
 
-    def tag_for_device(self) -> Optional[str]:
+    def tag_for_device(self, conf=None) -> Optional[str]:
         if len(self.children) > 1:
             r = _require_literal(self.children[1], "trim character set")
             if r:
@@ -672,7 +672,7 @@ class FormatNumber(BinaryExpression):
     def data_type(self):
         return T.STRING
 
-    def tag_for_device(self):
+    def tag_for_device(self, conf=None):
         return "FormatNumber runs on the host engine"
 
     def kernel(self, ctx, x, d):
@@ -704,7 +704,7 @@ class Conv(Expression):
     def data_type(self):
         return T.STRING
 
-    def tag_for_device(self):
+    def tag_for_device(self, conf=None):
         return "Conv runs on the host engine"
 
     def kernel(self, ctx, c, fb, tb):
@@ -772,7 +772,7 @@ class Md5(UnaryExpression):
     def data_type(self):
         return T.STRING
 
-    def tag_for_device(self):
+    def tag_for_device(self, conf=None):
         return "Md5 runs on the host engine"
 
     def kernel(self, ctx, c):

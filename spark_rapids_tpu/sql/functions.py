@@ -207,6 +207,88 @@ def last(c, ignorenulls: bool = False):
     return Column(AG.Last(_c(c), ignorenulls))
 
 
+# --- datetime functions (datetimeExpressions.scala family) ------------------
+from .expressions import datetime as DTE  # noqa: E402
+
+year = expr_fn(DTE.Year)
+quarter = expr_fn(DTE.Quarter)
+month = expr_fn(DTE.Month)
+dayofmonth = expr_fn(DTE.DayOfMonth)
+dayofweek = expr_fn(DTE.DayOfWeek)
+weekday = expr_fn(DTE.WeekDay)
+dayofyear = expr_fn(DTE.DayOfYear)
+weekofyear = expr_fn(DTE.WeekOfYear)
+hour = expr_fn(DTE.Hour)
+minute = expr_fn(DTE.Minute)
+second = expr_fn(DTE.Second)
+last_day = expr_fn(DTE.LastDay)
+
+
+def date_add(c, n):
+    return Column(DTE.DateAdd(_c(c), _to_expr(n)))
+
+
+def date_sub(c, n):
+    return Column(DTE.DateSub(_c(c), _to_expr(n)))
+
+
+def datediff(end, start):
+    return Column(DTE.DateDiff(_c(end), _c(start)))
+
+
+def add_months(c, n):
+    return Column(DTE.AddMonths(_c(c), _to_expr(n)))
+
+
+def months_between(a, b, roundOff: bool = True):
+    return Column(DTE.MonthsBetween(_c(a), _c(b), roundOff))
+
+
+def trunc(c, fmt: str):
+    return Column(DTE.TruncDate(_c(c), Literal(fmt)))
+
+
+def date_format(c, fmt: str):
+    return Column(DTE.DateFormatClass(_c(c), Literal(fmt)))
+
+
+def from_unixtime(c, fmt: str = DTE._DEFAULT_FMT):
+    return Column(DTE.FromUnixTime(_c(c), Literal(fmt)))
+
+
+def unix_timestamp(c, fmt: str = DTE._DEFAULT_FMT):
+    return Column(DTE.UnixTimestamp(_c(c), Literal(fmt)))
+
+
+def to_unix_timestamp(c, fmt: str = DTE._DEFAULT_FMT):
+    return Column(DTE.ToUnixTimestamp(_c(c), Literal(fmt)))
+
+
+def to_timestamp(c, fmt=None):
+    """fmt=None follows pyspark: flexible cast-style parsing (host path)."""
+    return Column(DTE.GetTimestamp(_c(c), Literal(fmt)))
+
+
+def timestamp_micros(c):
+    return Column(DTE.MicrosToTimestamp(_c(c)))
+
+
+def timestamp_millis(c):
+    return Column(DTE.MillisToTimestamp(_c(c)))
+
+
+def timestamp_seconds(c):
+    return Column(DTE.SecondsToTimestamp(_c(c)))
+
+
+def unix_micros(c):
+    return Column(DTE.UnixMicros(_c(c)))
+
+
+def from_utc_timestamp(c, tz: str):
+    return Column(DTE.FromUTCTimestamp(_c(c), Literal(tz)))
+
+
 # --- window functions (GpuWindowExpression.scala family) --------------------
 from .expressions import windows as WIN  # noqa: E402
 

@@ -78,8 +78,9 @@ class ExprMeta:
             self.will_not_work(f"expression {cls_name} runs on the host only")
         elif hasattr(e, "tag_for_device"):
             # per-expression device-capability hook (literal-only args,
-            # ASCII-only patterns, host-exact long-tail ops, ...)
-            reason = e.tag_for_device()
+            # ASCII-only patterns, timezone checks, host-exact long-tail
+            # ops, ...); uniform signature tag_for_device(conf)
+            reason = e.tag_for_device(self.conf)
             if reason:
                 self.will_not_work(f"{cls_name}: {reason}")
         # type checks

@@ -1,0 +1,200 @@
+"""Datetime expression tests vs pandas/python datetime oracles — reference
+coverage model: integration_tests date_time_test.py."""
+
+import datetime as dt
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def date_df(sess, n=400, seed=11):
+    rng = np.random.default_rng(seed)
+    days = rng.integers(-30000, 40000, n)  # ~1888..2079
+    micros = days * 86_400_000_000 + rng.integers(0, 86_400_000_000, n)
+    t = pa.table({
+        "d": pa.array(days.astype("int32"), type=pa.date32()),
+        "ts": pa.array(micros, type=pa.timestamp("us")),
+        "n": pa.array(rng.integers(-100, 100, n), type=pa.int32()),
+        "u": pa.array(np.arange(n), type=pa.int64()),
+    })
+    return sess.create_dataframe(t), t.to_pandas()
+
+
+def run_both(df, sort_col="u"):
+    sess = df._session
+    a = df.collect().to_pandas().sort_values(sort_col).reset_index(drop=True)
+    sess.conf.set("spark.rapids.sql.enabled", False)
+    try:
+        b = df.collect().to_pandas().sort_values(sort_col).reset_index(drop=True)
+    finally:
+        sess.conf.set("spark.rapids.sql.enabled", True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    return a
+
+
+def test_date_fields(sess):
+    df, pdf = date_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.year(df.d).alias("y"), F.month(df.d).alias("m"),
+        F.dayofmonth(df.d).alias("dom"), F.dayofweek(df.d).alias("dow"),
+        F.weekday(df.d).alias("wd"), F.dayofyear(df.d).alias("doy"),
+        F.quarter(df.d).alias("q"), F.weekofyear(df.d).alias("woy"),
+        F.last_day(df.d).alias("ld"),
+    ))
+    ser = pdf["d"].map(pd.Timestamp)
+    assert (out["y"] == ser.dt.year.values).all()
+    assert (out["m"] == ser.dt.month.values).all()
+    assert (out["dom"] == ser.dt.day.values).all()
+    # Spark dayofweek: 1=Sunday; pandas dayofweek: 0=Monday
+    assert (out["dow"] == ((ser.dt.dayofweek.values + 1) % 7) + 1).all()
+    assert (out["wd"] == ser.dt.dayofweek.values).all()
+    assert (out["doy"] == ser.dt.dayofyear.values).all()
+    assert (out["q"] == ser.dt.quarter.values).all()
+    assert (out["woy"] == ser.dt.isocalendar().week.values).all()
+    exp_ld = ser + pd.offsets.MonthEnd(0)
+    exp_ld = ser.where(ser == exp_ld, exp_ld)
+    assert (pd.to_datetime(out["ld"]).values == exp_ld.values).all()
+
+
+def test_time_fields(sess):
+    df, pdf = date_df(sess)
+    out = run_both(df.select(
+        df.u, F.hour(df.ts).alias("h"), F.minute(df.ts).alias("mi"),
+        F.second(df.ts).alias("s")))
+    ser = pdf["ts"]
+    assert (out["h"] == ser.dt.hour.values).all()
+    assert (out["mi"] == ser.dt.minute.values).all()
+    assert (out["s"] == ser.dt.second.values).all()
+
+
+def test_date_arithmetic(sess):
+    df, pdf = date_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.date_add(df.d, 30).alias("p30"),
+        F.date_sub(df.d, 15).alias("m15"),
+        F.datediff(df.d, F.lit(dt.date(2020, 1, 1))).alias("dd"),
+        F.add_months(df.d, df.n).alias("am"),
+    ))
+    ser = pdf["d"].map(pd.Timestamp)
+    assert (pd.to_datetime(out["p30"]).values ==
+            (ser + pd.Timedelta(days=30)).values).all()
+    assert (pd.to_datetime(out["m15"]).values ==
+            (ser - pd.Timedelta(days=15)).values).all()
+    exp_dd = (ser - pd.Timestamp("2020-01-01")).dt.days
+    assert (out["dd"] == exp_dd.values).all()
+    exp_am = ser + pdf["n"].map(lambda k: pd.DateOffset(months=int(k)))
+    assert (pd.to_datetime(out["am"]).values == exp_am.values).all()
+
+
+def test_trunc(sess):
+    df, pdf = date_df(sess)
+    out = run_both(df.select(
+        df.u, F.trunc(df.d, "year").alias("ty"),
+        F.trunc(df.d, "month").alias("tm"),
+        F.trunc(df.d, "week").alias("tw"),
+        F.trunc(df.d, "quarter").alias("tq")))
+    ser = pdf["d"].map(pd.Timestamp)
+    assert (pd.to_datetime(out["ty"]).values ==
+            ser.dt.to_period("Y").dt.start_time.values).all()
+    assert (pd.to_datetime(out["tm"]).values ==
+            ser.dt.to_period("M").dt.start_time.values).all()
+    assert (pd.to_datetime(out["tw"]).values ==
+            ser.dt.to_period("W").dt.start_time.values).all()
+    assert (pd.to_datetime(out["tq"]).values ==
+            ser.dt.to_period("Q").dt.start_time.values).all()
+
+
+def test_format_and_parse_roundtrip(sess):
+    df, pdf = date_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.date_format(df.ts, "yyyy-MM-dd HH:mm:ss").alias("s"),
+        F.unix_timestamp(F.date_format(df.ts, "yyyy-MM-dd HH:mm:ss"))
+         .alias("back"),
+    ))
+    exp = pdf["ts"].dt.strftime("%Y-%m-%d %H:%M:%S")
+    # negative years not representable in strftime; restrict to CE dates
+    ok = pdf["ts"].dt.year >= 1
+    assert (out.loc[ok.values, "s"] == exp[ok].values).all()
+    exp_secs = pdf["ts"].astype("int64") // 1_000_000
+    assert (out.loc[ok.values, "back"] ==
+            (exp_secs[ok]).values).all()
+
+
+def test_epoch_conversions(sess):
+    df, pdf = date_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.unix_micros(df.ts).alias("um"),
+        F.to_unix_timestamp(df.ts).alias("uts"),
+        F.timestamp_seconds(F.to_unix_timestamp(df.ts)).alias("rt"),
+    ))
+    exp_um = pdf["ts"].astype("int64")
+    assert (out["um"] == exp_um.values).all()
+    assert (out["uts"] == (exp_um // 1_000_000).values).all()
+    exp_rt = (exp_um // 1_000_000) * 1_000_000
+    assert (out["rt"].astype("int64") // 1000 * 1000 ==
+            (exp_rt // 1000 * 1000).values).all()
+
+
+def test_from_utc_timestamp_fixed_offset(sess):
+    df, pdf = date_df(sess, n=50)
+    out = run_both(df.select(
+        df.u, F.from_utc_timestamp(df.ts, "+05:30").alias("ist")))
+    exp = pdf["ts"] + pd.Timedelta(hours=5, minutes=30)
+    assert (out["ist"].values == exp.values).all()
+
+
+def test_parse_invalid_strings_yield_null(sess):
+    t = pa.table({"s": ["2021-03-04 05:06:07", "not a date",
+                        "2021-13-04 05:06:07", "2021-02-30 00:00:00", None],
+                  "u": list(range(5))})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(df.u, F.unix_timestamp(df.s).alias("ts")))
+    vals = out["ts"].tolist()
+    assert vals[0] == 1614834367
+    assert all(pd.isna(v) for v in vals[1:])
+
+
+def test_nonutc_timezone_falls_back(sess):
+    df, _ = date_df(sess, n=20)
+    sess.conf.set("spark.sql.session.timeZone", "America/New_York")
+    try:
+        q = df.select(df.u, F.hour(df.ts).alias("h"))
+        report = sess.explain(q)
+        assert "not UTC" in report
+    finally:
+        sess.conf.set("spark.sql.session.timeZone", "UTC")
+
+
+def test_to_timestamp_flexible_default(sess):
+    t = pa.table({"s": ["2021-03-04", "2021-03-04 05:06:07",
+                        "2021-03-04T05:06:07.123456", "garbage", None],
+                  "u": list(range(5))})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(df.u, F.to_timestamp(df.s).alias("ts")))
+    vals = out["ts"].tolist()
+    assert vals[0] == pd.Timestamp("2021-03-04", tz="UTC")
+    assert vals[1] == pd.Timestamp("2021-03-04 05:06:07", tz="UTC")
+    assert vals[2] == pd.Timestamp("2021-03-04 05:06:07.123456", tz="UTC")
+    assert pd.isna(vals[3]) and pd.isna(vals[4])
+
+
+def test_time_only_pattern_epoch_base(sess):
+    t = pa.table({"s": ["05:06:07"], "u": [0]})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(
+        df.u, F.unix_timestamp(df.s, "HH:mm:ss").alias("ts")))
+    assert out["ts"].tolist() == [5 * 3600 + 6 * 60 + 7]
