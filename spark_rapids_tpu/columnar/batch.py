@@ -136,6 +136,21 @@ def _concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[int],
                     out_capacity: int) -> DeviceColumn:
     from .column import DeviceColumn as DC
     dtype = cols[0].dtype
+    if cols[0].is_array_like:
+        # align slot widths, then concat children at width-scaled counts
+        # (each parent row owns a contiguous width-sized child block)
+        width = max(c.array_width for c in cols)
+        cols = [c.with_array_width(width) for c in cols]
+        children = tuple(
+            _concat_columns([c.children[k] for c in cols],
+                            [n * width for n in counts],
+                            out_capacity * width)
+            for k in range(len(cols[0].children)))
+        validity = _concat_1d([c.validity for c in cols], counts,
+                              out_capacity, False)
+        lengths = _concat_1d([c.lengths for c in cols], counts,
+                             out_capacity, 0)
+        return DC(dtype, None, validity, lengths, None, children)
     if cols[0].data is None:  # struct
         children = tuple(
             _concat_columns([c.children[k] for c in cols], counts, out_capacity)

@@ -92,6 +92,18 @@ class DeviceColumn:
             return int(self.data.shape[1])
         return None
 
+    @property
+    def is_array_like(self) -> bool:
+        return isinstance(self.dtype, (ArrayType, MapType))
+
+    @property
+    def array_width(self) -> int:
+        """Max-list-length bucket: the element child holds
+        ``capacity * array_width`` flattened rows (row r's slots at
+        ``r*w .. r*w+w-1``)."""
+        assert self.is_array_like
+        return self.children[0].capacity // max(self.capacity, 1)
+
     def with_validity(self, validity: jnp.ndarray) -> "DeviceColumn":
         return replace(self, validity=validity)
 
@@ -103,6 +115,16 @@ class DeviceColumn:
     # --- constructors for padding changes ---------------------------------
     def slice_capacity(self, new_capacity: int) -> "DeviceColumn":
         """Narrow or grow the capacity padding (device-side)."""
+        if self.is_array_like:
+            w = self.array_width
+            return DeviceColumn(
+                self.dtype, None,
+                _fix_1d(self.validity, new_capacity, False),
+                _fix_1d(self.lengths, new_capacity, 0),
+                None,
+                tuple(c.slice_capacity(new_capacity * w)
+                      for c in self.children))
+
         def fix(arr, fill=0):
             if arr is None:
                 return None
@@ -131,15 +153,68 @@ class DeviceColumn:
         contain out-of-range sentinels; ``idx_valid`` marks which produce a
         valid row (False -> null output row, e.g. outer-join misses)."""
         safe = jnp.clip(idx, 0, self.capacity - 1)
-        data = self.data[safe] if self.data is not None else None
         lengths = self.lengths[safe] if self.lengths is not None else None
-        aux = self.aux[safe] if self.aux is not None else None
         validity = (self.validity[safe] if self.validity is not None
                     else jnp.ones(idx.shape[0], dtype=bool))
         if idx_valid is not None:
             validity = validity & idx_valid
+        if self.is_array_like:
+            # row blocks: child row r*w+j follows its parent row
+            w = self.array_width
+            j = jnp.arange(w, dtype=safe.dtype)[None, :]
+            child_idx = (safe[:, None] * w + j).reshape(-1)
+            child_valid = (jnp.broadcast_to(
+                validity[:, None], (idx.shape[0], w)).reshape(-1)
+                if idx_valid is not None else None)
+            children = tuple(c.gather(child_idx, child_valid)
+                             for c in self.children)
+            return DeviceColumn(self.dtype, None, validity, lengths, None,
+                                children)
+        data = self.data[safe] if self.data is not None else None
+        aux = self.aux[safe] if self.aux is not None else None
         children = tuple(c.gather(idx, idx_valid) for c in self.children)
         return DeviceColumn(self.dtype, data, validity, lengths, aux, children)
+
+    def with_array_width(self, new_width: int) -> "DeviceColumn":
+        """Re-bucket an array column's slot width (grow or shrink)."""
+        assert self.is_array_like
+        w = self.array_width
+        if new_width == w:
+            return self
+        cap = self.capacity
+        r = jnp.arange(cap, dtype=jnp.int32)[:, None]
+        j = jnp.arange(new_width, dtype=jnp.int32)[None, :]
+        in_range = j < w
+        child_idx = jnp.where(in_range, r * w + jnp.minimum(j, w - 1),
+                              0).reshape(-1)
+        child_valid = (in_range & (j < self.lengths[:, None])).reshape(-1)
+        children = tuple(c.gather(child_idx, child_valid)
+                         for c in self.children)
+        lengths = jnp.minimum(self.lengths, new_width)
+        return DeviceColumn(self.dtype, None, self.validity, lengths, None,
+                            children)
+
+
+def _fix_1d(arr, new_capacity: int, fill):
+    if arr is None:
+        return None
+    cap = arr.shape[0]
+    if cap == new_capacity:
+        return arr
+    if cap > new_capacity:
+        return arr[:new_capacity]
+    return jnp.pad(arr, (0, new_capacity - cap), constant_values=fill)
+
+
+def make_array_column(dtype: DataType, lengths: jnp.ndarray,
+                      children: Tuple["DeviceColumn", ...],
+                      validity: Optional[jnp.ndarray] = None) -> DeviceColumn:
+    """ARRAY/MAP column: ``children`` hold capacity*width flattened rows
+    (one child for arrays; (keys, values) for maps)."""
+    if validity is None:
+        validity = jnp.ones(lengths.shape[0], dtype=bool)
+    return DeviceColumn(dtype, None, validity, lengths=lengths,
+                        children=tuple(children))
 
 
 def make_fixed_column(dtype: DataType, data: jnp.ndarray,
@@ -160,6 +235,15 @@ def make_string_column(dtype: DataType, chars: jnp.ndarray,
 def null_column(dtype: DataType, capacity: int) -> DeviceColumn:
     """All-null column of the given type."""
     validity = jnp.zeros(capacity, dtype=bool)
+    if isinstance(dtype, ArrayType):
+        child = null_column(dtype.element_type, capacity * _MIN_WIDTH)
+        return make_array_column(dtype, jnp.zeros(capacity, dtype=jnp.int32),
+                                 (child,), validity)
+    if isinstance(dtype, MapType):
+        keys = null_column(dtype.key_type, capacity * _MIN_WIDTH)
+        vals = null_column(dtype.value_type, capacity * _MIN_WIDTH)
+        return make_array_column(dtype, jnp.zeros(capacity, dtype=jnp.int32),
+                                 (keys, vals), validity)
     if isinstance(dtype, StructType):
         children = tuple(null_column(f.data_type, capacity) for f in dtype.fields)
         return DeviceColumn(dtype, None, validity, children=children)

@@ -48,13 +48,7 @@ def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:
         return null_column(dtype, capacity).with_validity(validity)
 
     if isinstance(dtype, (T.ArrayType, T.MapType)):
-        # host-object column: the CPU fallback engine carries nested data as
-        # a numpy object array; any attempt to upload it to the device fails
-        # loudly (the overrides layer keeps such columns on the host)
-        vals = np.empty(capacity, dtype=object)
-        if n:
-            vals[:n] = arr.to_pylist()
-        return DeviceColumn(dtype, vals, validity)
+        return _list_to_device(arr, dtype, capacity, validity, n)
 
     if isinstance(dtype, T.StructType):
         children = tuple(arrow_to_device_column(arr.field(i), capacity)
@@ -76,6 +70,56 @@ def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:
     out[:n] = np_data
     out[:n][~valid_np[:n]] = 0  # dead data zeroed for deterministic kernels
     return DeviceColumn(dtype, jnp.asarray(out), validity)
+
+
+def _list_to_device(arr, dtype, capacity: int, validity, n: int
+                    ) -> DeviceColumn:
+    """Arrow List/Map -> padded row-block layout: child element r*w+j is
+    slot j of row r; slots past the row's length are dead."""
+    from .column import make_array_column
+    if isinstance(arr.type, pa.MapType):
+        arr = arr.cast(pa.map_(arr.type.key_type, arr.type.item_type))
+        offsets = np.asarray(arr.offsets)
+        child_arrays = [arr.keys, arr.items]
+    else:
+        if pa.types.is_large_list(arr.type):
+            arr = arr.cast(pa.list_(arr.type.value_type))
+        offsets = np.asarray(arr.offsets)
+        child_arrays = [arr.values]
+    lengths_np = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    valid_np = np.asarray(validity)[:n]
+    lengths_np = np.where(valid_np, lengths_np, 0)
+    width = bucket_width(int(lengths_np.max()) if n else 0)
+    # take-index into the flattened arrow child; None -> null (dead slot)
+    take = np.full(capacity * width, -1, dtype=np.int64)
+    if n:
+        row = np.repeat(np.arange(n), lengths_np)
+        slot = np.arange(lengths_np.sum()) - np.repeat(
+            np.cumsum(lengths_np) - lengths_np, lengths_np)
+        src = np.repeat(offsets[:-1].astype(np.int64), lengths_np) + slot
+        take[row * width + slot] = src
+    import pyarrow.compute as pc
+    idx = _null_take_indices(take)
+    children = []
+    for ch in child_arrays:
+        if isinstance(ch, pa.ChunkedArray):
+            ch = ch.combine_chunks()
+        children.append(arrow_to_device_column(pc.take(ch, idx),
+                                               capacity * width))
+    lengths = np.zeros(capacity, dtype=np.int32)
+    lengths[:n] = lengths_np
+    return make_array_column(dtype, jnp.asarray(lengths), tuple(children),
+                             validity)
+
+
+def _null_take_indices(take: np.ndarray) -> pa.Array:
+    """int64 indices with nulls where take < 0 (pyarrow take -> null)."""
+    mask = take < 0
+    safe = np.where(mask, 0, take)
+    return pa.Array.from_buffers(
+        pa.int64(), len(take),
+        [pa.py_buffer(np.packbits(~mask, bitorder="little").tobytes()),
+         pa.py_buffer(safe.astype(np.int64).tobytes())])
 
 
 def _valid_mask(arr: pa.Array) -> np.ndarray:
@@ -168,9 +212,29 @@ def device_column_to_arrow(col: DeviceColumn, n: int) -> pa.Array:
         return pa.nulls(n)
 
     if isinstance(dtype, (T.ArrayType, T.MapType)):
-        vals = [None if not v else x
-                for v, x in zip(valid, list(np.asarray(col.data)[:n]))]
-        return pa.array(vals, type=T.to_arrow(dtype))
+        w = col.array_width
+        lens = np.asarray(col.lengths)[:n].astype(np.int64)
+        lens = np.where(valid, lens, 0)
+        total = int(lens.sum())
+        # child rows live at r*w .. r*w+len-1
+        starts = np.cumsum(lens) - lens
+        row = np.repeat(np.arange(n), lens)
+        slot = np.arange(total) - np.repeat(starts, lens)
+        child_idx = row * w + slot
+        offsets = np.zeros(n + 1, dtype=np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        kids = []
+        for ch in col.children:
+            flat = device_column_to_arrow(ch, ch.capacity)
+            kids.append(flat.take(pa.array(child_idx, type=pa.int64())))
+        # null rows: nulls in the offsets array mark null lists/maps
+        off = pa.array(offsets,
+                       mask=np.append(mask, False) if mask.any() else None)
+        if isinstance(dtype, T.MapType):
+            out = pa.MapArray.from_arrays(off, kids[0], kids[1])
+        else:
+            out = pa.ListArray.from_arrays(off, kids[0])
+        return out.cast(T.to_arrow(dtype))
 
     if isinstance(dtype, T.StructType):
         children = [device_column_to_arrow(c, n) for c in col.children]

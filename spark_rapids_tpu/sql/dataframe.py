@@ -273,7 +273,8 @@ class DataFrame:
         if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
             cols = tuple(cols[0])
         exprs = tuple(self._resolve(c) for c in cols)
-        exprs, plan = _extract_windows(exprs, self._plan)
+        exprs, plan = _extract_generators(exprs, self._plan)
+        exprs, plan = _extract_windows(exprs, plan)
         return DataFrame(P.Project(exprs, plan), self._session)
 
     def withColumn(self, name: str, col: Column) -> "DataFrame":
@@ -539,6 +540,39 @@ class DataFrameWriter:
 
     def avro(self, path: str):
         return self.format("avro").save(path)
+
+
+def _extract_generators(exprs, plan):
+    """Turn F.explode()/F.posexplode() projection entries into a Generate
+    node (Spark's ExtractGenerator analysis rule; one generator per
+    select)."""
+    from .expressions.collections import Explode
+    new_exprs: List[Expression] = []
+    gen = None
+    gen_attrs = None
+    for e in exprs:
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, Explode):
+            if gen is not None:
+                raise ValueError(
+                    "only one generator (explode) allowed per select")
+            attrs = inner.gen_output_attrs()
+            if isinstance(e, Alias):
+                if len(attrs) != 1:
+                    raise ValueError(
+                        f"a single alias cannot name the {len(attrs)} "
+                        "output columns of this generator")
+                attrs = [attrs[0].renamed(e.name)]
+            gen = inner
+            gen_attrs = attrs
+            new_exprs.extend(attrs)
+        else:
+            new_exprs.append(e)
+    if gen is None:
+        return exprs, plan
+    plan = P.Generate(gen, getattr(gen, "outer", False), tuple(gen_attrs),
+                      plan)
+    return tuple(new_exprs), plan
 
 
 def _extract_windows(exprs, plan):

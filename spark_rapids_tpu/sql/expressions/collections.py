@@ -1,0 +1,1197 @@
+"""Collection/struct/map expressions + higher-order functions — reference
+``collectionOperations.scala`` (1543), ``complexTypeExtractors.scala`` (386),
+``complexTypeCreator.scala`` (239), ``higherOrderFunctions.scala`` (597),
+``GpuMapUtils.scala`` (SURVEY §2.4).
+
+Device layout recap (columnar/column.py): an ARRAY/MAP column has
+``lengths[cap]`` plus flattened child column(s) of ``cap * w`` rows, row r's
+slots at ``r*w .. r*w+w-1``.  Kernels reshape views to ``[cap, w]``, mask
+dead slots, and compute with static shapes; per-row compaction (filter,
+distinct, set ops) is an argsort along the slot axis.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.batch import ColumnarBatch
+from ...columnar.column import (DeviceColumn, bucket_width,
+                                is_string_like, make_array_column,
+                                null_column)
+from .core import (EvalContext, Expression, LeafExpression, Literal,
+                   UnaryExpression, fixed, resolve_expression, valid_and)
+
+_lambda_id = itertools.count()
+
+
+# ---------------------------------------------------------------------------
+# Shared slot helpers
+# ---------------------------------------------------------------------------
+
+def _slots(xp, col: DeviceColumn):
+    """(elem_children, w, slot_valid[cap, w]) for an array/map column."""
+    w = col.array_width
+    cap = col.capacity
+    j = xp.arange(w, dtype=xp.int32)[None, :]
+    slot_valid = (j < col.lengths[:, None]) & col.validity[:, None]
+    return col.children, w, slot_valid
+
+
+def _elem_2d(xp, elem: DeviceColumn, cap: int, w: int):
+    """Element data as [cap, w] (fixed) view."""
+    return elem.data.reshape(cap, w)
+
+
+def _elem_valid_2d(xp, elem: DeviceColumn, cap: int, w: int):
+    return elem.validity.reshape(cap, w)
+
+
+def _slot_equal_value(xp, elem: DeviceColumn, cap: int, w: int,
+                      val: DeviceColumn):
+    """[cap, w] equality of each slot against a per-row value column."""
+    if elem.lengths is not None:  # string elements
+        sw = elem.data.shape[1]
+        vw = val.data.shape[1]
+        cw = max(sw, vw)
+        e = xp.pad(elem.data, ((0, 0), (0, cw - sw))).reshape(cap, w, cw)
+        v = xp.pad(val.data, ((0, 0), (0, cw - vw)))[:, None, :]
+        same_len = elem.lengths.reshape(cap, w) == val.lengths[:, None]
+        pos = xp.arange(cw, dtype=xp.int32)[None, None, :]
+        in_len = pos < elem.lengths.reshape(cap, w)[:, :, None]
+        eq = xp.all((e == v) | ~in_len, axis=2)
+        return same_len & eq
+    return _elem_2d(xp, elem, cap, w) == val.data[:, None]
+
+
+def _slot_pair_equal(xp, a: DeviceColumn, ca, wa, b: DeviceColumn, cb, wb):
+    """[cap, wa, wb] cross equality between two arrays' slots (same rows)."""
+    if a.lengths is not None:
+        sw, vw = a.data.shape[1], b.data.shape[1]
+        cw = max(sw, vw)
+        ea = xp.pad(a.data, ((0, 0), (0, cw - sw))).reshape(ca, wa, 1, cw)
+        eb = xp.pad(b.data, ((0, 0), (0, cw - vw))).reshape(cb, 1, wb, cw)
+        la = a.lengths.reshape(ca, wa, 1)
+        lb = b.lengths.reshape(cb, 1, wb)
+        pos = xp.arange(cw, dtype=xp.int32)[None, None, None, :]
+        in_len = pos < la[:, :, :, None]
+        eq = xp.all((ea == eb) | ~in_len, axis=3)
+        return (la == lb) & eq
+    ea = a.data.reshape(ca, wa, 1)
+    eb = b.data.reshape(cb, 1, wb)
+    return ea == eb
+
+
+def _compact_rows(xp, col: DeviceColumn, keep_2d, cap: int, w: int
+                  ) -> Tuple[DeviceColumn, "object"]:
+    """Per-row stable compaction of kept slots to the front.  Returns
+    (new elem column, new lengths)."""
+    if xp.__name__ == "numpy":
+        order = np.argsort(~keep_2d, axis=1, kind="stable")
+    else:
+        order = xp.argsort(~keep_2d, axis=1, stable=True)
+    flat_idx = (xp.arange(cap, dtype=xp.int32)[:, None] * w + order).reshape(-1)
+    kept = xp.take_along_axis(keep_2d, order, axis=1).reshape(-1)
+    new_elem = col.gather(flat_idx, kept)
+    new_lengths = xp.sum(keep_2d, axis=1).astype(xp.int32)
+    return new_elem, new_lengths
+
+
+def _interleave_columns(xp, cols: Sequence[DeviceColumn], width: int
+                        ) -> DeviceColumn:
+    """Build the element child for CreateArray/CreateMap: slot j of row r is
+    cols[j] at row r; slots >= len(cols) dead."""
+    cap = cols[0].capacity
+    n = len(cols)
+    c0 = cols[0]
+    if c0.lengths is not None:  # string elements
+        sw = max(c.data.shape[1] for c in cols)
+        padded = [xp.pad(c.data, ((0, 0), (0, sw - c.data.shape[1])))
+                  for c in cols]
+        chars = xp.stack(
+            padded + [xp.zeros_like(padded[0])] * (width - n), axis=1
+        ).reshape(cap * width, sw)
+        lens = xp.stack(
+            [c.lengths for c in cols]
+            + [xp.zeros_like(c0.lengths)] * (width - n), axis=1
+        ).reshape(cap * width)
+        valid = xp.stack(
+            [c.validity for c in cols]
+            + [xp.zeros_like(c0.validity)] * (width - n), axis=1
+        ).reshape(cap * width)
+        return DeviceColumn(c0.dtype, chars, valid, lengths=lens)
+    data = xp.stack(
+        [c.data for c in cols] + [xp.zeros_like(c0.data)] * (width - n),
+        axis=1).reshape(cap * width)
+    valid = xp.stack(
+        [c.validity for c in cols]
+        + [xp.zeros_like(c0.validity)] * (width - n),
+        axis=1).reshape(cap * width)
+    aux = None
+    if c0.aux is not None:
+        aux = xp.stack(
+            [c.aux for c in cols] + [xp.zeros_like(c0.aux)] * (width - n),
+            axis=1).reshape(cap * width)
+    return DeviceColumn(c0.dtype, data, valid, aux=aux)
+
+
+_DEVICE_ELEM = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+                T.LongType, T.FloatType, T.DoubleType, T.DateType,
+                T.TimestampType)
+
+
+def _fixed_elem_reason(dt: T.DataType, what: str) -> Optional[str]:
+    if isinstance(dt, T.ArrayType):
+        dt = dt.element_type
+    if not isinstance(dt, _DEVICE_ELEM):
+        return (f"{what} over {dt.simple_string()} elements runs on the "
+                "host")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Basic array expressions
+# ---------------------------------------------------------------------------
+
+class Size(UnaryExpression):
+    """size(array/map); null input -> -1 (spark.sql.legacy.sizeOfNull)."""
+
+    def __init__(self, child, legacy_null=-1):
+        super().__init__(resolve_expression(child))
+        self.legacy_null = legacy_null
+
+    def with_children(self, children):
+        return Size(children[0], self.legacy_null)
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        out = xp.where(c.validity, c.lengths.astype(xp.int32),
+                       xp.asarray(self.legacy_null, xp.int32))
+        return fixed(T.INT, out, xp.ones_like(c.validity))
+
+
+class GetArrayItem(Expression):
+    """arr[idx] (0-based)."""
+
+    def __init__(self, arr, idx):
+        self.children = (resolve_expression(arr), resolve_expression(idx))
+
+    def with_children(self, children):
+        return GetArrayItem(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def kernel(self, ctx, c, i):
+        xp = ctx.xp
+        w = c.array_width
+        cap = c.capacity
+        idx = i.data.astype(xp.int32)
+        ok = c.validity & i.validity & (idx >= 0) & (idx < c.lengths)
+        flat = xp.arange(cap, dtype=xp.int32) * w + xp.clip(idx, 0, w - 1)
+        return c.children[0].gather(flat, ok)
+
+
+class ElementAt(Expression):
+    """element_at(arr, i) 1-based (negative = from end); element_at(map, k)."""
+
+    def __init__(self, coll, key):
+        self.children = (resolve_expression(coll), resolve_expression(key))
+
+    def with_children(self, children):
+        return ElementAt(children[0], children[1])
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        if isinstance(dt, T.MapType):
+            return dt.value_type
+        return dt.element_type
+
+    def kernel(self, ctx, c, k):
+        xp = ctx.xp
+        if isinstance(c.dtype, T.MapType):
+            return _map_lookup(ctx, c, k)
+        w = c.array_width
+        cap = c.capacity
+        i = k.data.astype(xp.int32)
+        pos = xp.where(i > 0, i - 1, c.lengths + i)
+        ok = c.validity & k.validity & (pos >= 0) & (pos < c.lengths) & (i != 0)
+        flat = xp.arange(cap, dtype=xp.int32) * w + xp.clip(pos, 0, w - 1)
+        return c.children[0].gather(flat, ok)
+
+
+class ArrayContains(Expression):
+    def __init__(self, arr, value):
+        self.children = (resolve_expression(arr), resolve_expression(value))
+
+    def with_children(self, children):
+        return ArrayContains(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, c, v):
+        xp = ctx.xp
+        _, w, slot_valid = _slots(xp, c)
+        elem = c.children[0]
+        eq = _slot_equal_value(xp, elem, c.capacity, w, v)
+        ev = _elem_valid_2d(xp, elem, c.capacity, w)
+        hit = xp.any(eq & slot_valid & ev, axis=1)
+        has_null_elem = xp.any(slot_valid & ~ev, axis=1)
+        # Spark: null if no hit but array contains null elements
+        validity = c.validity & v.validity & (hit | ~has_null_elem)
+        return fixed(T.BOOLEAN, hit, validity)
+
+
+class ArrayPosition(Expression):
+    """array_position(arr, v): 1-based first position, 0 when absent."""
+
+    def __init__(self, arr, value):
+        self.children = (resolve_expression(arr), resolve_expression(value))
+
+    def with_children(self, children):
+        return ArrayPosition(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def kernel(self, ctx, c, v):
+        xp = ctx.xp
+        _, w, slot_valid = _slots(xp, c)
+        elem = c.children[0]
+        eq = _slot_equal_value(xp, elem, c.capacity, w, v) & slot_valid & \
+            _elem_valid_2d(xp, elem, c.capacity, w)
+        any_hit = xp.any(eq, axis=1)
+        first = xp.argmax(eq, axis=1).astype(xp.int64) + 1
+        out = xp.where(any_hit, first, 0)
+        return fixed(T.LONG, out, c.validity & v.validity)
+
+
+class _ArrayMinMax(UnaryExpression):
+    _is_min = True
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def tag_for_device(self, conf=None):
+        return _fixed_elem_reason(self.children[0].data_type,
+                                  self.pretty_name())
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        elem = c.children[0]
+        live = slot_valid & _elem_valid_2d(xp, elem, cap, w)
+        data = _elem_2d(xp, elem, cap, w)
+        dt = elem.data.dtype
+        if np.issubdtype(np.dtype(dt), np.floating):
+            ident = xp.asarray(xp.inf if self._is_min else -xp.inf, dt)
+        else:
+            info = np.iinfo(np.dtype(dt))
+            ident = xp.asarray(info.max if self._is_min else info.min, dt)
+        vals = xp.where(live, data, ident)
+        out = xp.min(vals, axis=1) if self._is_min else xp.max(vals, axis=1)
+        has = xp.any(live, axis=1)
+        return fixed(self.data_type, out, c.validity & has)
+
+
+class ArrayMin(_ArrayMinMax):
+    _is_min = True
+
+
+class ArrayMax(_ArrayMinMax):
+    _is_min = False
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): nulls first when asc (Spark)."""
+
+    def __init__(self, arr, asc=True):
+        a = resolve_expression(asc) if not isinstance(asc, bool) else \
+            Literal(asc)
+        self.children = (resolve_expression(arr), a)
+
+    def with_children(self, children):
+        return SortArray(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def tag_for_device(self, conf=None):
+        if not isinstance(self.children[1], Literal):
+            return "sort order must be a literal"
+        return _fixed_elem_reason(self.children[0].data_type, "sort_array")
+
+    def kernel(self, ctx, c, asc_col):
+        xp = ctx.xp
+        asc = bool(self.children[1].value)
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        elem = c.children[0]
+        live = slot_valid & _elem_valid_2d(xp, elem, cap, w)
+        # exact int64 sort keys (floats via order-preserving bit tricks, so
+        # inf/nan/-0 order correctly and int64 keeps full precision)
+        from ...ops.ranks import orderable_int64
+        key = orderable_int64(xp, elem).reshape(cap, w)
+        key = key if asc else ~key  # ~k is order-reversed for signed ints
+        # two-pass per-row lexsort: value first, then category
+        # (0 = null-first, 1 = value, 2 = null-last, 3 = dead slot)
+        if xp.__name__ == "numpy":
+            order1 = np.argsort(key, axis=1, kind="stable")
+        else:
+            order1 = xp.argsort(key, axis=1, stable=True)
+        null_cat = 0 if asc else 2  # Spark: nulls first asc, last desc
+        cat = xp.where(live, 1, null_cat)
+        cat = xp.where(slot_valid, cat, 3)
+        cat1 = xp.take_along_axis(cat, order1, axis=1)
+        if xp.__name__ == "numpy":
+            order2 = np.argsort(cat1, axis=1, kind="stable")
+        else:
+            order2 = xp.argsort(cat1, axis=1, stable=True)
+        order = xp.take_along_axis(order1, order2, axis=1)
+        flat = (xp.arange(cap, dtype=xp.int32)[:, None] * w
+                + order.astype(xp.int32)).reshape(-1)
+        keep = xp.take_along_axis(slot_valid, order, axis=1).reshape(-1)
+        new_elem = elem.gather(flat, keep)
+        return make_array_column(c.dtype, c.lengths, (new_elem,), c.validity)
+
+
+class ArrayRepeat(Expression):
+    """array_repeat(elem, n) — literal n on the device (static width)."""
+
+    def __init__(self, elem, n):
+        self.children = (resolve_expression(elem), resolve_expression(n))
+
+    def with_children(self, children):
+        return ArrayRepeat(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def tag_for_device(self, conf=None):
+        n = self.children[1]
+        if not (isinstance(n, Literal) and n.value is not None):
+            return "array_repeat count must be a literal on the device"
+        return None
+
+    def kernel(self, ctx, v, n):
+        xp = ctx.xp
+        cnt = max(int(self.children[1].value), 0)
+        w = bucket_width(cnt)
+        elem = _interleave_columns(xp, [v] * max(cnt, 1), w)
+        if cnt == 0:
+            elem = elem.with_validity(xp.zeros_like(elem.validity))
+        cap = v.capacity
+        lengths = xp.full(cap, cnt, dtype=xp.int32)
+        return make_array_column(self.data_type, lengths, (elem,),
+                                 xp.ones(cap, dtype=bool))
+
+
+class Sequence(Expression):
+    """sequence(start, stop[, step]) — runs on the host (output width is
+    data-dependent, which XLA static shapes cannot express; the reference
+    computes it with a device scan, we fall back like its incompat ops)."""
+
+    def __init__(self, start, stop, step=None):
+        ch = [resolve_expression(start), resolve_expression(stop)]
+        if step is not None:
+            ch.append(resolve_expression(step))
+        self.children = tuple(ch)
+
+    def with_children(self, children):
+        return Sequence(*children)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def tag_for_device(self, conf=None):
+        return "sequence output width is data-dependent; runs on the host"
+
+    def kernel(self, ctx, start, stop, step=None):
+        xp = ctx.xp
+        s = np.asarray(start.data)
+        e = np.asarray(stop.data)
+        st = np.asarray(step.data) if step is not None else \
+            np.where(e >= s, 1, -1)
+        st = np.where(st == 0, 1, st)
+        cols = [start, stop] + ([step] if step is not None else [])
+        valid = np.asarray(valid_and(xp, *cols))
+        n = np.where(valid, ((e - s) // st) + 1, 0)
+        n = np.clip(n, 0, None)
+        w = bucket_width(int(n.max()) if n.size else 0)
+        cap = s.shape[0]
+        j = np.arange(w)[None, :]
+        data = (s[:, None] + j * st[:, None]).reshape(-1)
+        ev = (j < n[:, None]).reshape(-1)
+        elem = DeviceColumn(self.children[0].data_type,
+                            xp.asarray(data.astype(s.dtype)),
+                            xp.asarray(ev))
+        return make_array_column(self.data_type,
+                                 xp.asarray(n.astype(np.int32)), (elem,),
+                                 xp.asarray(valid))
+
+
+class CreateArray(Expression):
+    def __init__(self, *children):
+        self.children = tuple(resolve_expression(c) for c in children)
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    @property
+    def data_type(self):
+        et = self.children[0].data_type if self.children else T.NULL
+        for c in self.children[1:]:
+            et = T.common_type(et, c.data_type) or et
+        return T.ArrayType(et)
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        n = len(cols)
+        cap = cols[0].capacity if cols else ctx.capacity
+        w = bucket_width(n)
+        if not cols:
+            elem = null_column(T.NULL, cap * w)
+            return make_array_column(self.data_type,
+                                     xp.zeros(cap, dtype=xp.int32), (elem,),
+                                     xp.ones(cap, dtype=bool))
+        elem = _interleave_columns(xp, list(cols), w)
+        lengths = xp.full(cap, n, dtype=xp.int32)
+        return make_array_column(self.data_type, lengths, (elem,),
+                                 xp.ones(cap, dtype=bool))
+
+
+# ---------------------------------------------------------------------------
+# Set-like array ops
+# ---------------------------------------------------------------------------
+
+class _ArraySetOp(Expression):
+    """Pairwise-equality based per-row set ops (distinct semantics like
+    Spark: result has no duplicates, order = first-occurrence)."""
+
+    def __init__(self, *children):
+        self.children = tuple(resolve_expression(c) for c in children)
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def tag_for_device(self, conf=None):
+        return _fixed_elem_reason(self.children[0].data_type,
+                                  self.pretty_name())
+
+
+def _dedup_mask(xp, a: DeviceColumn, cap, w, slot_valid):
+    """keep-first-occurrence mask [cap, w] (null elements: first null kept)."""
+    eq = _slot_pair_equal(xp, a.children[0], cap, w, a.children[0], cap, w)
+    ev = _elem_valid_2d(xp, a.children[0], cap, w)
+    both_null = (~ev[:, :, None]) & (~ev[:, None, :])
+    same = (eq & ev[:, :, None] & ev[:, None, :]) | both_null
+    j1 = xp.arange(w)[:, None]
+    j2 = xp.arange(w)[None, :]
+    earlier = (j2 < j1)[None, :, :]
+    dup = xp.any(same & earlier & slot_valid[:, None, :], axis=2)
+    return slot_valid & ~dup
+
+
+class ArrayDistinct(_ArraySetOp):
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        keep = _dedup_mask(xp, c, cap, w, slot_valid)
+        elem, lengths = _compact_rows(xp, c.children[0], keep, cap, w)
+        return make_array_column(c.dtype, lengths, (elem,), c.validity)
+
+
+class ArrayRemove(_ArraySetOp):
+    def kernel(self, ctx, c, v):
+        xp = ctx.xp
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        elem = c.children[0]
+        eq = _slot_equal_value(xp, elem, cap, w, v) & \
+            _elem_valid_2d(xp, elem, cap, w) & v.validity[:, None]
+        keep = slot_valid & ~eq
+        new_elem, lengths = _compact_rows(xp, elem, keep, cap, w)
+        return make_array_column(c.dtype, lengths, (new_elem,), c.validity)
+
+
+class ArraysOverlap(_ArraySetOp):
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        _, wa, sva = _slots(xp, a)
+        _, wb, svb = _slots(xp, b)
+        cap = a.capacity
+        ea, eb = a.children[0], b.children[0]
+        eq = _slot_pair_equal(xp, ea, cap, wa, eb, cap, wb)
+        eva = _elem_valid_2d(xp, ea, cap, wa)
+        evb = _elem_valid_2d(xp, eb, cap, wb)
+        live_pair = sva[:, :, None] & svb[:, None, :] & \
+            eva[:, :, None] & evb[:, None, :]
+        hit = xp.any(eq & live_pair, axis=(1, 2))
+        has_null = xp.any(sva & ~eva, axis=1) | xp.any(svb & ~evb, axis=1)
+        non_empty = (a.lengths > 0) & (b.lengths > 0)
+        validity = a.validity & b.validity & (hit | ~(has_null & non_empty))
+        return fixed(T.BOOLEAN, hit, validity)
+
+
+class _ArrayBinarySetOp(_ArraySetOp):
+    def _combine(self, xp, in_a, in_b):
+        raise NotImplementedError
+
+    def kernel(self, ctx, a, b):
+        xp = ctx.xp
+        _, wa, sva = _slots(xp, a)
+        _, wb, svb = _slots(xp, b)
+        cap = a.capacity
+        ea, eb = a.children[0], b.children[0]
+        eva = _elem_valid_2d(xp, ea, cap, wa)
+        evb = _elem_valid_2d(xp, eb, cap, wb)
+        eq = _slot_pair_equal(xp, ea, cap, wa, eb, cap, wb)
+        null_pair = (~eva[:, :, None]) & (~evb[:, None, :])
+        same = (eq & eva[:, :, None] & evb[:, None, :]) | null_pair
+        a_in_b = xp.any(same & svb[:, None, :], axis=2)        # [cap, wa]
+        if isinstance(self, ArrayUnion):
+            keep_a = _dedup_mask(xp, a, cap, wa, sva)
+            dup_b = _dedup_mask(xp, b, cap, wb, svb)
+            b_in_a = xp.any(
+                xp.swapaxes(same, 1, 2) & sva[:, None, :], axis=2)
+            keep_b = dup_b & ~b_in_a
+            # concat a's kept slots then b's kept slots
+            wu = bucket_width(wa + wb)
+            elem_a, len_a = _compact_rows(xp, ea, keep_a, cap, wa)
+            elem_b, len_b = _compact_rows(xp, eb, keep_b, cap, wb)
+            arr_a = make_array_column(a.dtype, len_a, (elem_a,), a.validity)
+            arr_b = make_array_column(b.dtype, len_b, (elem_b,), b.validity)
+            return _concat_arrays(xp, arr_a, arr_b, wu,
+                                  a.validity & b.validity)
+        dedup = _dedup_mask(xp, a, cap, wa, sva)
+        if isinstance(self, ArrayIntersect):
+            keep = dedup & a_in_b
+        else:  # ArrayExcept
+            keep = dedup & ~a_in_b
+        elem, lengths = _compact_rows(xp, ea, keep, cap, wa)
+        return make_array_column(a.dtype, lengths, (elem,),
+                                 a.validity & b.validity)
+
+
+class ArrayIntersect(_ArrayBinarySetOp):
+    pass
+
+
+class ArrayExcept(_ArrayBinarySetOp):
+    pass
+
+
+class ArrayUnion(_ArrayBinarySetOp):
+    pass
+
+
+def _concat_arrays(xp, a: DeviceColumn, b: DeviceColumn, out_w: int,
+                   validity) -> DeviceColumn:
+    """Per-row concatenation of two array columns into width out_w."""
+    cap = a.capacity
+    wa, wb = a.array_width, b.array_width
+    j = xp.arange(out_w, dtype=xp.int32)[None, :]
+    la = a.lengths[:, None]
+    from_a = j < la
+    src_a = xp.arange(cap, dtype=xp.int32)[:, None] * wa + \
+        xp.clip(j, 0, wa - 1)
+    jb = xp.clip(j - la, 0, wb - 1)
+    src_b = xp.arange(cap, dtype=xp.int32)[:, None] * wb + jb
+    new_len = xp.minimum(a.lengths + b.lengths, out_w).astype(xp.int32)
+    live = j < new_len[:, None]
+    ga = a.children[0].gather(src_a.reshape(-1), (from_a & live).reshape(-1))
+    gb = b.children[0].gather(src_b.reshape(-1), (~from_a & live).reshape(-1))
+    # merge the two gathers slotwise
+    from ..physical.window import _select_column
+    elem = _select_column(xp, from_a.reshape(-1), ga, gb)
+    return make_array_column(a.dtype, new_len, (elem,), validity)
+
+
+class Concat_Arrays(Expression):
+    """concat() over array columns (string concat lives in strings.py;
+    the F.concat wrapper dispatches on input type)."""
+
+    def __init__(self, *children):
+        self.children = tuple(resolve_expression(c) for c in children)
+
+    def with_children(self, children):
+        return Concat_Arrays(*children)
+
+    def pretty_name(self):
+        return "concat"
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        out = cols[0]
+        total_w = sum(c.array_width for c in cols)
+        validity = valid_and(xp, *cols)
+        for c in cols[1:]:
+            out = _concat_arrays(xp, out, c, bucket_width(total_w), validity)
+        return out
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based start (negative from end)."""
+
+    def __init__(self, arr, start, length):
+        self.children = (resolve_expression(arr), resolve_expression(start),
+                         resolve_expression(length))
+
+    def with_children(self, children):
+        return Slice(*children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, c, s, ln):
+        xp = ctx.xp
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        start = s.data.astype(xp.int32)
+        start0 = xp.where(start > 0, start - 1, c.lengths + start)
+        cnt = xp.clip(ln.data.astype(xp.int32), 0, None)
+        j = xp.arange(w, dtype=xp.int32)[None, :]
+        keep = (j >= start0[:, None]) & (j < (start0 + cnt)[:, None]) & \
+            slot_valid
+        elem, lengths = _compact_rows(xp, c.children[0], keep, cap, w)
+        validity = valid_and(xp, c, s, ln) & (start != 0) & (start0 >= -0)
+        validity = validity & (ln.data >= 0)
+        return make_array_column(c.dtype, lengths, (elem,), validity)
+
+
+class ArrayReverse(UnaryExpression):
+    """reverse() on arrays (F.reverse dispatches by type)."""
+
+    def pretty_name(self):
+        return "reverse"
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        w = c.array_width
+        cap = c.capacity
+        j = xp.arange(w, dtype=xp.int32)[None, :]
+        src_j = xp.clip(c.lengths[:, None] - 1 - j, 0, w - 1)
+        live = j < c.lengths[:, None]
+        flat = (xp.arange(cap, dtype=xp.int32)[:, None] * w + src_j)
+        elem = c.children[0].gather(flat.reshape(-1), live.reshape(-1))
+        return make_array_column(c.dtype, c.lengths, (elem,), c.validity)
+
+
+class ArraysZip(Expression):
+    def __init__(self, *children):
+        self.children = tuple(resolve_expression(c) for c in children)
+        self.names = [str(i) for i in range(len(self.children))]
+
+    def with_children(self, children):
+        out = ArraysZip(*children)
+        out.names = self.names
+        return out
+
+    @property
+    def data_type(self):
+        fields = [T.StructField(n, c.data_type.element_type, True)
+                  for n, c in zip(self.names, self.children)]
+        return T.ArrayType(T.StructType(tuple(fields)))
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        cap = cols[0].capacity
+        new_len = cols[0].lengths
+        for c in cols[1:]:
+            new_len = xp.maximum(new_len, c.lengths)
+        w = max(c.array_width for c in cols)
+        kids = []
+        for c in cols:
+            cw = c.array_width
+            j = xp.arange(w, dtype=xp.int32)[None, :]
+            flat = xp.arange(cap, dtype=xp.int32)[:, None] * cw + \
+                xp.clip(j, 0, cw - 1)
+            live = j < c.lengths[:, None]
+            kids.append(c.children[0].gather(flat.reshape(-1),
+                                             live.reshape(-1)))
+        struct_elem = DeviceColumn(
+            self.data_type.element_type, None,
+            xp.ones(cap * w, dtype=bool), children=tuple(kids))
+        return make_array_column(self.data_type, new_len, (struct_elem,),
+                                 valid_and(xp, *cols))
+
+
+# ---------------------------------------------------------------------------
+# Structs
+# ---------------------------------------------------------------------------
+
+class GetStructField(Expression):
+    def __init__(self, child, ordinal: int, name: Optional[str] = None):
+        self.children = (resolve_expression(child),)
+        self.ordinal = int(ordinal)
+        self.name = name
+
+    def with_children(self, children):
+        return GetStructField(children[0], self.ordinal, self.name)
+
+    def _key_extras(self):
+        return (self.ordinal,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.fields[self.ordinal].data_type
+
+    def sql(self):
+        return f"{self.children[0].sql()}.{self.name or self.ordinal}"
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        f = c.children[self.ordinal]
+        return f.with_validity(f.validity & c.validity)
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(name1, val1, ...) — names are literal children in
+    Spark; we carry (names, value exprs)."""
+
+    def __init__(self, names: Sequence[str], values: Sequence):
+        self.names = list(names)
+        self.children = tuple(resolve_expression(v) for v in values)
+
+    def with_children(self, children):
+        return CreateNamedStruct(self.names, children)
+
+    def _key_extras(self):
+        return tuple(self.names)
+
+    @property
+    def data_type(self):
+        return T.StructType(tuple(
+            T.StructField(n, v.data_type, v.nullable)
+            for n, v in zip(self.names, self.children)))
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        cap = cols[0].capacity if cols else ctx.capacity
+        return DeviceColumn(self.data_type, None,
+                            xp.ones(cap, dtype=bool), children=tuple(cols))
+
+
+# ---------------------------------------------------------------------------
+# Maps
+# ---------------------------------------------------------------------------
+
+def _map_lookup(ctx, m: DeviceColumn, k: DeviceColumn) -> DeviceColumn:
+    xp = ctx.xp
+    _, w, slot_valid = _slots(xp, m)
+    cap = m.capacity
+    keys, values = m.children
+    eq = _slot_equal_value(xp, keys, cap, w, k) & slot_valid & \
+        _elem_valid_2d(xp, keys, cap, w)
+    hit = xp.any(eq, axis=1)
+    pos = xp.argmax(eq, axis=1).astype(xp.int32)
+    flat = xp.arange(cap, dtype=xp.int32) * w + pos
+    return values.gather(flat, hit & m.validity & k.validity)
+
+
+class GetMapValue(Expression):
+    def __init__(self, m, key):
+        self.children = (resolve_expression(m), resolve_expression(key))
+
+    def with_children(self, children):
+        return GetMapValue(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.value_type
+
+    def kernel(self, ctx, m, k):
+        return _map_lookup(ctx, m, k)
+
+
+class MapKeys(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type.key_type, False)
+
+    def kernel(self, ctx, m):
+        return make_array_column(self.data_type, m.lengths,
+                                 (m.children[0],), m.validity)
+
+
+class MapValues(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type.value_type)
+
+    def kernel(self, ctx, m):
+        return make_array_column(self.data_type, m.lengths,
+                                 (m.children[1],), m.validity)
+
+
+class MapEntries(UnaryExpression):
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        st = T.StructType((T.StructField("key", mt.key_type, False),
+                           T.StructField("value", mt.value_type, True)))
+        return T.ArrayType(st, False)
+
+    def kernel(self, ctx, m):
+        xp = ctx.xp
+        keys, values = m.children
+        elem = DeviceColumn(self.data_type.element_type, None,
+                            keys.validity | values.validity,
+                            children=(keys, values))
+        return make_array_column(self.data_type, m.lengths, (elem,),
+                                 m.validity)
+
+
+class CreateMap(Expression):
+    def __init__(self, *kv):
+        self.children = tuple(resolve_expression(c) for c in kv)
+        if len(self.children) % 2:
+            raise ValueError("map() needs an even number of args")
+
+    def with_children(self, children):
+        return CreateMap(*children)
+
+    @property
+    def data_type(self):
+        ks = self.children[0::2]
+        vs = self.children[1::2]
+        kt = ks[0].data_type if ks else T.NULL
+        vt = vs[0].data_type if vs else T.NULL
+        return T.MapType(kt, vt)
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        ks = list(cols[0::2])
+        vs = list(cols[1::2])
+        n = len(ks)
+        w = bucket_width(n)
+        key_elem = _interleave_columns(xp, ks, w)
+        val_elem = _interleave_columns(xp, vs, w)
+        cap = cols[0].capacity
+        lengths = xp.full(cap, n, dtype=xp.int32)
+        return make_array_column(self.data_type, lengths,
+                                 (key_elem, val_elem),
+                                 xp.ones(cap, dtype=bool))
+
+
+# ---------------------------------------------------------------------------
+# Higher-order functions (lambdas)
+# ---------------------------------------------------------------------------
+
+class NamedLambdaVariable(LeafExpression):
+    def __init__(self, name: str, dtype: T.DataType = T.NULL,
+                 var_id: Optional[int] = None):
+        self.name = name
+        self.dtype = dtype
+        self.var_id = var_id if var_id is not None else next(_lambda_id)
+
+    @property
+    def data_type(self):
+        return self.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def sql(self):
+        return self.name
+
+    def _key_extras(self):
+        return (self.var_id,)
+
+    def eval(self, ctx):
+        env = getattr(ctx, "lambda_env", None)
+        if env is None or self.var_id not in env:
+            raise RuntimeError(f"unbound lambda variable {self.name}")
+        return env[self.var_id]
+
+
+class LambdaFunction(Expression):
+    def __init__(self, body: Expression, args: Sequence[NamedLambdaVariable]):
+        self.children = (body,)
+        self.args = tuple(args)
+
+    @property
+    def body(self):
+        return self.children[0]
+
+    def with_children(self, children):
+        return LambdaFunction(children[0], self.args)
+
+    @property
+    def data_type(self):
+        return self.body.data_type
+
+    def sql(self):
+        a = ", ".join(v.name for v in self.args)
+        return f"lambda ({a}) -> {self.body.sql()}"
+
+
+def _eval_lambda(ctx, fn: LambdaFunction, bindings, w: int):
+    """Evaluate the lambda body over the flattened element rows.  Outer
+    column references keep working: the sub-batch repeats every parent
+    column w times (slot j of row r sees row r), so BoundReference
+    ordinals resolve unchanged."""
+    xp = ctx.xp
+    cap = ctx.batch.capacity
+    row_idx = (xp.arange(cap * w, dtype=xp.int32) // w)
+    repeated = tuple(c.gather(row_idx) for c in ctx.batch.columns)
+    sub_batch = ColumnarBatch(ctx.batch.names, repeated, cap * w)
+    sub = EvalContext(sub_batch, xp=xp, conf=ctx.conf)
+    sub.lambda_env = {v.var_id: col for v, col in bindings.items()}
+    return fn.body.eval(sub)
+
+
+def _index_column(xp, cap, w):
+    j = xp.broadcast_to(xp.arange(w, dtype=xp.int32)[None, :],
+                        (cap, w)).reshape(-1)
+    return DeviceColumn(T.INT, j, xp.ones(cap * w, dtype=bool))
+
+
+class _HigherOrder(Expression):
+    def __init__(self, arr, fn: LambdaFunction):
+        self.children = (resolve_expression(arr), fn)
+        self._fix_lambda_types()
+
+    def _fix_lambda_types(self):
+        """Propagate the collection's element types onto the lambda's
+        variables (Spark does this in analysis); mutation is safe because
+        the variables are local to this lambda."""
+        arr, fn = self.children
+        try:
+            dt = arr.data_type
+        except (NotImplementedError, AttributeError, IndexError):
+            return
+        if isinstance(dt, T.ArrayType) and fn.args:
+            fn.args[0].dtype = dt.element_type
+            if len(fn.args) > 1:
+                fn.args[1].dtype = T.INT
+        elif isinstance(dt, T.MapType) and len(fn.args) >= 2:
+            fn.args[0].dtype = dt.key_type
+            fn.args[1].dtype = dt.value_type
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def function(self) -> LambdaFunction:
+        return self.children[1]
+
+    def eval(self, ctx):
+        # children[1] is the lambda: evaluated specially, not as a column
+        c = self.children[0].eval(ctx)
+        return self.kernel_hof(ctx, c)
+
+
+class ArrayTransform(_HigherOrder):
+    """transform(arr, x -> expr) / transform(arr, (x, i) -> expr)."""
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.function.data_type)
+
+    def kernel_hof(self, ctx, c):
+        xp = ctx.xp
+        fn = self.function
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        bindings = {fn.args[0]: c.children[0]}
+        if len(fn.args) > 1:
+            bindings[fn.args[1]] = _index_column(xp, cap, w)
+        out = _eval_lambda(ctx, fn, bindings, w)
+        out = out.with_validity(out.validity & slot_valid.reshape(-1))
+        return make_array_column(self.data_type, c.lengths, (out,),
+                                 c.validity)
+
+
+class ArrayFilter(_HigherOrder):
+    """filter(arr, x -> bool)."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel_hof(self, ctx, c):
+        xp = ctx.xp
+        fn = self.function
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        bindings = {fn.args[0]: c.children[0]}
+        if len(fn.args) > 1:
+            bindings[fn.args[1]] = _index_column(xp, cap, w)
+        pred = _eval_lambda(ctx, fn, bindings, w)
+        keep = (pred.data & pred.validity).reshape(cap, w) & slot_valid
+        elem, lengths = _compact_rows(xp, c.children[0], keep, cap, w)
+        return make_array_column(c.dtype, lengths, (elem,), c.validity)
+
+
+class ArrayExists(_HigherOrder):
+    """Spark three-valued logic: true if any true; null if some predicate
+    was null and none true; else false."""
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel_hof(self, ctx, c):
+        xp = ctx.xp
+        fn = self.function
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        pred = _eval_lambda(ctx, fn, {fn.args[0]: c.children[0]}, w)
+        p_true = (pred.data & pred.validity).reshape(cap, w) & slot_valid
+        p_null = (~pred.validity).reshape(cap, w) & slot_valid
+        any_true = xp.any(p_true, axis=1)
+        any_null = xp.any(p_null, axis=1)
+        return fixed(T.BOOLEAN, any_true,
+                     c.validity & (any_true | ~any_null))
+
+
+class ArrayForAll(_HigherOrder):
+    """false if any false; null if some null and none false; else true."""
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel_hof(self, ctx, c):
+        xp = ctx.xp
+        fn = self.function
+        _, w, slot_valid = _slots(xp, c)
+        cap = c.capacity
+        pred = _eval_lambda(ctx, fn, {fn.args[0]: c.children[0]}, w)
+        p_false = ((~pred.data) & pred.validity).reshape(cap, w) & slot_valid
+        p_null = (~pred.validity).reshape(cap, w) & slot_valid
+        any_false = xp.any(p_false, axis=1)
+        any_null = xp.any(p_null, axis=1)
+        return fixed(T.BOOLEAN, ~any_false & ~any_null,
+                     c.validity & (any_false | ~any_null))
+
+
+class TransformValues(_HigherOrder):
+    """transform_values(map, (k, v) -> expr)."""
+
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        return T.MapType(mt.key_type, self.function.data_type)
+
+    def kernel_hof(self, ctx, m):
+        xp = ctx.xp
+        fn = self.function
+        keys, values = m.children
+        _, w, slot_valid = _slots(xp, m)
+        out = _eval_lambda(ctx, fn, {fn.args[0]: keys, fn.args[1]: values}, w)
+        out = out.with_validity(out.validity & slot_valid.reshape(-1))
+        return make_array_column(self.data_type, m.lengths, (keys, out),
+                                 m.validity)
+
+
+class TransformKeys(_HigherOrder):
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        return T.MapType(self.function.data_type, mt.value_type)
+
+    def kernel_hof(self, ctx, m):
+        xp = ctx.xp
+        fn = self.function
+        keys, values = m.children
+        _, w, slot_valid = _slots(xp, m)
+        out = _eval_lambda(ctx, fn, {fn.args[0]: keys, fn.args[1]: values}, w)
+        out = out.with_validity(out.validity & slot_valid.reshape(-1))
+        return make_array_column(self.data_type, m.lengths, (out, values),
+                                 m.validity)
+
+
+class MapFilter(_HigherOrder):
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def kernel_hof(self, ctx, m):
+        xp = ctx.xp
+        fn = self.function
+        keys, values = m.children
+        _, w, slot_valid = _slots(xp, m)
+        cap = m.capacity
+        pred = _eval_lambda(ctx, fn, {fn.args[0]: keys, fn.args[1]: values}, w)
+        keep = (pred.data & pred.validity).reshape(cap, w) & slot_valid
+        new_k, lengths = _compact_rows(xp, keys, keep, cap, w)
+        new_v, _ = _compact_rows(xp, values, keep, cap, w)
+        return make_array_column(m.dtype, lengths, (new_k, new_v),
+                                 m.validity)
+
+
+# ---------------------------------------------------------------------------
+# Generators (explode family) — evaluated by GenerateExec
+# ---------------------------------------------------------------------------
+
+class Explode(UnaryExpression):
+    """explode(arr) / explode(map) -> rows.  position=False."""
+
+    with_position = False
+
+    @property
+    def data_type(self):
+        dt = self.children[0].data_type
+        if isinstance(dt, T.MapType):
+            return T.StructType((T.StructField("key", dt.key_type, False),
+                                 T.StructField("value", dt.value_type, True)))
+        return dt.element_type
+
+    def gen_output_attrs(self):
+        from .core import AttributeReference
+        dt = self.children[0].data_type
+        out = []
+        if self.with_position:
+            out.append(AttributeReference("pos", T.INT, False))
+        if isinstance(dt, T.MapType):
+            out.append(AttributeReference("key", dt.key_type, False))
+            out.append(AttributeReference("value", dt.value_type, True))
+        else:
+            out.append(AttributeReference("col", dt.element_type, True))
+        return out
+
+
+class PosExplode(Explode):
+    with_position = True

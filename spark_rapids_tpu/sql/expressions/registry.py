@@ -10,6 +10,7 @@ from typing import Dict, Type
 from .core import Alias, AttributeReference, BoundReference, Expression, Literal
 from . import arithmetic as A
 from . import cast as C
+from . import collections as Col
 from . import conditional as Cond
 from . import datetime as Dt
 from . import hashing as Hsh
@@ -51,6 +52,17 @@ _reg(Dt.Year, Dt.Month, Dt.DayOfMonth, Dt.DayOfWeek, Dt.WeekDay,
      Dt.FromUnixTime, Dt.ToUnixTimestamp, Dt.UnixTimestamp, Dt.GetTimestamp,
      Dt.FromUTCTimestamp)
 _reg(Hsh.Murmur3Hash, Hsh.XxHash64)
+_reg(Col.Size, Col.GetArrayItem, Col.ElementAt, Col.ArrayContains,
+     Col.ArrayPosition, Col.ArrayMin, Col.ArrayMax, Col.SortArray,
+     Col.ArrayRepeat, Col.Sequence, Col.CreateArray, Col.ArrayDistinct,
+     Col.ArrayRemove, Col.ArraysOverlap, Col.ArrayIntersect, Col.ArrayExcept,
+     Col.ArrayUnion, Col.Concat_Arrays, Col.Slice, Col.ArrayReverse,
+     Col.ArraysZip, Col.GetStructField, Col.CreateNamedStruct,
+     Col.GetMapValue, Col.MapKeys, Col.MapValues, Col.MapEntries,
+     Col.CreateMap, Col.NamedLambdaVariable, Col.LambdaFunction,
+     Col.ArrayTransform, Col.ArrayFilter, Col.ArrayExists, Col.ArrayForAll,
+     Col.TransformKeys, Col.TransformValues, Col.MapFilter, Col.Explode,
+     Col.PosExplode)
 _reg(Str.Length, Str.OctetLength, Str.BitLength, Str.Upper, Str.Lower,
      Str.InitCap, Str.Reverse, Str.Substring, Str.SubstringIndex, Str.Concat,
      Str.ConcatWs, Str.Contains, Str.StartsWith, Str.EndsWith, Str.Like,

@@ -207,6 +207,183 @@ def last(c, ignorenulls: bool = False):
     return Column(AG.Last(_c(c), ignorenulls))
 
 
+# --- collections / structs / maps (collectionOperations.scala family) -------
+from .expressions import collections as CL  # noqa: E402
+
+
+def _make_lambda(f) -> CL.LambdaFunction:
+    import inspect
+    names = list(inspect.signature(f).parameters)
+    vars_ = [CL.NamedLambdaVariable(nm) for nm in names]
+    body = f(*[Column(v) for v in vars_])
+    return CL.LambdaFunction(_to_expr(body), vars_)
+
+
+def array(*cols):
+    if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+        cols = tuple(cols[0])
+    return Column(CL.CreateArray(*[_c(c) for c in cols]))
+
+
+def size(c):
+    return Column(CL.Size(_c(c)))
+
+
+def element_at(c, v):
+    return Column(CL.ElementAt(_c(c), _to_expr(v)))
+
+
+def get(c, i):
+    return Column(CL.GetArrayItem(_c(c), _to_expr(i)))
+
+
+def array_contains(c, v):
+    return Column(CL.ArrayContains(_c(c), _to_expr(v)))
+
+
+def array_position(c, v):
+    return Column(CL.ArrayPosition(_c(c), _to_expr(v)))
+
+
+def array_min(c):
+    return Column(CL.ArrayMin(_c(c)))
+
+
+def array_max(c):
+    return Column(CL.ArrayMax(_c(c)))
+
+
+def array_distinct(c):
+    return Column(CL.ArrayDistinct(_c(c)))
+
+
+def array_remove(c, v):
+    return Column(CL.ArrayRemove(_c(c), _to_expr(v)))
+
+
+def array_repeat(c, n):
+    return Column(CL.ArrayRepeat(_c(c), _to_expr(n)))
+
+
+def array_except(a, b):
+    return Column(CL.ArrayExcept(_c(a), _c(b)))
+
+
+def array_intersect(a, b):
+    return Column(CL.ArrayIntersect(_c(a), _c(b)))
+
+
+def array_union(a, b):
+    return Column(CL.ArrayUnion(_c(a), _c(b)))
+
+
+def arrays_overlap(a, b):
+    return Column(CL.ArraysOverlap(_c(a), _c(b)))
+
+
+def arrays_zip(*cols):
+    exprs = [_c(c) for c in cols]
+    out = CL.ArraysZip(*exprs)
+    # struct fields take the source column names (Spark naming)
+    out.names = [getattr(e, "name", None) or str(i)
+                 for i, e in enumerate(exprs)]
+    return Column(out)
+
+
+def sort_array(c, asc: bool = True):
+    return Column(CL.SortArray(_c(c), asc))
+
+
+def sequence(start, stop, step=None):
+    return Column(CL.Sequence(_c(start), _c(stop),
+                              None if step is None else _c(step)))
+
+
+def slice(c, start, length):  # noqa: A001
+    return Column(CL.Slice(_c(c), _to_expr(start), _to_expr(length)))
+
+
+def struct(*cols):
+    names, vals = [], []
+    for c in cols:
+        e = _c(c)
+        names.append(getattr(e, "name", None) or f"col{len(names) + 1}")
+        vals.append(e)
+    return Column(CL.CreateNamedStruct(names, vals))
+
+
+def named_struct(*name_value_pairs):
+    names = [p for p in name_value_pairs[0::2]]
+    vals = [_c(v) for v in name_value_pairs[1::2]]
+    return Column(CL.CreateNamedStruct(names, vals))
+
+
+def create_map(*kv):
+    # key/value positions: bare strings are literals (pyspark convention
+    # differs from column-position args here)
+    return Column(CL.CreateMap(*[_to_expr(c) for c in kv]))
+
+
+def map_keys(c):
+    return Column(CL.MapKeys(_c(c)))
+
+
+def map_values(c):
+    return Column(CL.MapValues(_c(c)))
+
+
+def map_entries(c):
+    return Column(CL.MapEntries(_c(c)))
+
+
+def transform(c, f):
+    return Column(CL.ArrayTransform(_c(c), _make_lambda(f)))
+
+
+def filter(c, f):  # noqa: A001
+    return Column(CL.ArrayFilter(_c(c), _make_lambda(f)))
+
+
+def exists(c, f):
+    return Column(CL.ArrayExists(_c(c), _make_lambda(f)))
+
+
+def forall(c, f):
+    return Column(CL.ArrayForAll(_c(c), _make_lambda(f)))
+
+
+def transform_keys(c, f):
+    return Column(CL.TransformKeys(_c(c), _make_lambda(f)))
+
+
+def transform_values(c, f):
+    return Column(CL.TransformValues(_c(c), _make_lambda(f)))
+
+
+def map_filter(c, f):
+    return Column(CL.MapFilter(_c(c), _make_lambda(f)))
+
+
+def explode(c):
+    return Column(CL.Explode(_c(c)))
+
+
+def posexplode(c):
+    return Column(CL.PosExplode(_c(c)))
+
+
+def explode_outer(c):
+    e = CL.Explode(_c(c))
+    e.outer = True
+    return Column(e)
+
+
+def posexplode_outer(c):
+    e = CL.PosExplode(_c(c))
+    e.outer = True
+    return Column(e)
+
+
 # --- datetime functions (datetimeExpressions.scala family) ------------------
 from .expressions import datetime as DTE  # noqa: E402
 

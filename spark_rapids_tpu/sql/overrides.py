@@ -169,9 +169,6 @@ class PlanMeta:
                 if r:
                     self.will_not_work(f"input column '{a.name}': {r}")
                     break
-        if isinstance(self.node, P.Generate):
-            self.will_not_work("Generate (explode) is not yet supported on "
-                               "TPU")
         if isinstance(self.node, P.Window):
             self._tag_window()
         for e in self._expressions():

@@ -1,0 +1,93 @@
+"""Generate exec (explode/posexplode) — reference ``GpuGenerateExec.scala``
+(793 LoC; SURVEY §2.3).
+
+TPU shape strategy: an exploded batch has at most ``cap * w`` rows (every
+slot of every row), a static bound.  We build the flat slot grid, compact
+live slots to the front with one stable argsort (keeping row-major order =
+Spark's output order), and gather both the repeated input columns and the
+element column through the same permutation."""
+
+from __future__ import annotations
+
+from ... import types as T
+from ...columnar.batch import ColumnarBatch
+from ...columnar.column import DeviceColumn
+from ..expressions.collections import Explode
+from ..expressions.core import EvalContext, bind_references
+from .base import TPU, PhysicalPlan
+
+
+class GenerateExec(PhysicalPlan):
+    def __init__(self, generator: Explode, outer: bool, gen_output,
+                 child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.generator = generator
+        self.outer = outer
+        self.gen_output = list(gen_output)
+        self._bound = bind_references(generator, child.output)
+        self._fn = self._jit(self._compute)
+
+    @property
+    def output(self):
+        return list(self.children[0].output) + self.gen_output
+
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        xp = self.xp
+        ctx = EvalContext(batch, xp=xp)
+        arr = self._bound.children[0].eval(ctx)
+        cap = batch.capacity
+        w = arr.array_width
+        live_rows = batch.row_mask()
+
+        j = xp.arange(w, dtype=xp.int32)[None, :]
+        slot_live = (j < arr.lengths[:, None]) & arr.validity[:, None] & \
+            live_rows[:, None]
+        if self.outer:
+            # rows with empty/null collections still emit one all-null row
+            empty = live_rows & (~arr.validity | (arr.lengths == 0))
+            slot_live = slot_live | (empty[:, None] & (j == 0))
+        flat_keep = slot_live.reshape(-1)
+
+        # stable compaction keeps (row, slot) order
+        if xp.__name__ == "numpy":
+            import numpy as np
+            perm = np.argsort(~flat_keep, kind="stable")
+        else:
+            perm = xp.argsort(~flat_keep, stable=True)
+        perm = perm.astype(xp.int32)
+        n_out = xp.sum(flat_keep).astype(xp.int32)
+        kept = flat_keep[perm]
+
+        # repeated input columns: source row = perm // w
+        row_idx = perm // w
+        out_cols = [c.gather(row_idx, kept) for c in batch.columns]
+
+        elem_valid_mask = kept
+        if self.outer:
+            # synthetic slots (empty/null collections) yield all-null
+            # generator outputs, including pos (Spark emits (null, null))
+            real = (arr.validity[row_idx] &
+                    ((perm % w) < arr.lengths[row_idx]))
+            elem_valid_mask = kept & real
+        gen_cols = []
+        if self.generator.with_position:
+            pos = (perm % w).astype(xp.int32)
+            gen_cols.append(DeviceColumn(T.INT, pos, elem_valid_mask))
+        if isinstance(arr.dtype, T.MapType):
+            gen_cols.append(arr.children[0].gather(perm, elem_valid_mask))
+            gen_cols.append(arr.children[1].gather(perm, elem_valid_mask))
+        else:
+            gen_cols.append(arr.children[0].gather(perm, elem_valid_mask))
+
+        names = tuple(a.name for a in self.output)
+        return ColumnarBatch(names, tuple(out_cols) + tuple(gen_cols), n_out)
+
+    def execute(self, pid, tctx):
+        for batch in self.children[0].execute(pid, tctx):
+            out = self._fn(batch)
+            if out.num_rows_int:
+                yield out
+
+    def simple_string(self):
+        return f"{self.node_name()} [{self.generator.sql()}]"

@@ -74,6 +74,10 @@ class Planner:
             exec_ = self._plan_aggregate(node, kids[0], be)
         elif isinstance(node, P.Window):
             exec_ = self._plan_window(node, kids[0], be)
+        elif isinstance(node, P.Generate):
+            from .physical.generate import GenerateExec
+            exec_ = GenerateExec(node.generator, node.outer,
+                                 node.gen_output, kids[0], backend=be)
         elif isinstance(node, P.Sort):
             exec_ = self._plan_sort(node, kids[0], be)
         elif isinstance(node, P.Limit):

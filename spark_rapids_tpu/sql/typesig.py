@@ -70,7 +70,11 @@ ORDERABLE = NUMERIC + STRING + DATETIME + BOOLEAN + NULL
 COMPARABLE = ORDERABLE
 BASIC = TypeSig(_ALL_BASIC)
 STRUCT = sig(T.StructType)
-ALL_DEVICE = BASIC + TypeSig((T.StructType,), nested=BASIC)
+# device layout supports arrays/maps of basic (and struct-of-basic) element
+# types via the padded row-block layout (columnar/column.py)
+_NESTABLE = TypeSig(_ALL_BASIC + (T.StructType, T.ArrayType, T.MapType))
+ALL_DEVICE = BASIC + TypeSig((T.StructType, T.ArrayType, T.MapType),
+                             nested=_NESTABLE)
 # host engine supports everything incl. arrays/maps
 EVERYTHING = ALL_DEVICE + TypeSig((T.ArrayType, T.MapType),
                                   nested=TypeSig(_ALL_BASIC + (T.ArrayType,

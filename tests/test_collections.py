@@ -1,0 +1,306 @@
+"""Collection/struct/map expression + explode tests — reference coverage
+model: integration_tests array_test.py / map_test.py / struct_test.py /
+collection_ops_test.py / generate_expr_test.py."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def arr_df(sess):
+    t = pa.table({
+        "u": pa.array(range(6), type=pa.int64()),
+        "a": pa.array([[1, 2, 3], [], None, [4, 4, 5], [None, 7], [9]],
+                      type=pa.list_(pa.int64())),
+        "b": pa.array([[3, 9], [1], [2], [4], [7, 8], []],
+                      type=pa.list_(pa.int64())),
+        "s": pa.array([["x", "yy"], ["zzz"], None, [], ["x", "x"], ["q"]],
+                      type=pa.list_(pa.string())),
+        "m": pa.array([{"k1": 1, "k2": 2}, {}, None, {"k3": 3},
+                       {"k1": 9}, {"z": 0}],
+                      type=pa.map_(pa.string(), pa.int64())),
+        "v": pa.array([10, 20, 30, 40, 50, 60], type=pa.int64()),
+    })
+    return sess.create_dataframe(t), t
+
+
+def run_both(df, sort_col="u"):
+    sess = df._session
+    a = df.collect()
+    sess.conf.set("spark.rapids.sql.enabled", False)
+    try:
+        b = df.collect()
+    finally:
+        sess.conf.set("spark.rapids.sql.enabled", True)
+    assert a.to_pylist() == b.to_pylist(), "device/host mismatch"
+    return a
+
+
+def test_size_and_item_access(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u, F.size(df.a).alias("sz"),
+        F.get(df.a, F.lit(1)).alias("i1"),
+        F.element_at(df.a, 1).alias("e1"),
+        F.element_at(df.a, -1).alias("em1"),
+        F.element_at(df.m, "k1").alias("mk"),
+    )).to_pylist()
+    assert [r["sz"] for r in out] == [3, 0, -1, 3, 2, 1]
+    assert [r["i1"] for r in out] == [2, None, None, 4, 7, None]
+    assert [r["e1"] for r in out] == [1, None, None, 4, None, 9]
+    assert [r["em1"] for r in out] == [3, None, None, 5, 7, 9]
+    assert [r["mk"] for r in out] == [1, None, None, None, 9, None]
+
+
+def test_contains_position_minmax(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u, F.array_contains(df.a, F.lit(4)).alias("c4"),
+        F.array_position(df.a, F.lit(4)).alias("p4"),
+        F.array_min(df.a).alias("mn"), F.array_max(df.a).alias("mx"),
+    )).to_pylist()
+    # a: [1,2,3], [], None, [4,4,5], [None,7], [9]
+    assert [r["c4"] for r in out] == [False, False, None, True, None, False]
+    assert [r["p4"] for r in out] == [0, 0, None, 1, 0, 0]
+    assert [r["mn"] for r in out] == [1, None, None, 4, 7, 9]
+    assert [r["mx"] for r in out] == [3, None, None, 5, 7, 9]
+
+
+def test_string_array_contains(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u, F.array_contains(df.s, F.lit("x")).alias("cx"))).to_pylist()
+    assert [r["cx"] for r in out] == [True, False, None, False, True, False]
+
+
+def test_sort_distinct_remove(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u, F.sort_array(df.a).alias("sorted"),
+        F.sort_array(df.a, asc=False).alias("rsorted"),
+        F.array_distinct(df.a).alias("dist"),
+        F.array_remove(df.a, F.lit(4)).alias("rm4"),
+    )).to_pylist()
+    assert [r["sorted"] for r in out] == [
+        [1, 2, 3], [], None, [4, 4, 5], [None, 7], [9]]
+    assert [r["rsorted"] for r in out] == [
+        [3, 2, 1], [], None, [5, 4, 4], [7, None], [9]]
+    assert [r["dist"] for r in out] == [
+        [1, 2, 3], [], None, [4, 5], [None, 7], [9]]
+    assert [r["rm4"] for r in out] == [
+        [1, 2, 3], [], None, [5], [None, 7], [9]]
+
+
+def test_set_ops(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.arrays_overlap(df.a, df.b).alias("ov"),
+        F.array_intersect(df.a, df.b).alias("ix"),
+        F.array_except(df.a, df.b).alias("ex"),
+        F.array_union(df.a, df.b).alias("un"),
+    )).to_pylist()
+    # a: [1,2,3] b: [3,9] -> overlap True, intersect [3], except [1,2]
+    assert out[0]["ov"] is True
+    assert out[0]["ix"] == [3]
+    assert out[0]["ex"] == [1, 2]
+    assert out[0]["un"] == [1, 2, 3, 9]
+    assert out[1]["ov"] is False and out[1]["ix"] == [] \
+        and out[1]["un"] == [1]
+    assert out[3]["ix"] == [4] and out[3]["ex"] == [5] \
+        and out[3]["un"] == [4, 5]
+
+
+def test_create_repeat_slice_reverse_zip(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.array(df.v, df.v + 1, F.lit(0)).alias("mk"),
+        F.array_repeat(df.v, 3).alias("rep"),
+        F.slice(df.a, 1, 2).alias("sl"),
+        F.slice(df.a, -2, 2).alias("sl2"),
+    )).to_pylist()
+    assert out[0]["mk"] == [10, 11, 0]
+    assert out[2]["rep"] == [30, 30, 30]
+    assert out[0]["sl"] == [1, 2]
+    assert out[3]["sl2"] == [4, 5]
+
+
+def test_sequence(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u, F.sequence(F.lit(1), df.u + 1).alias("sq"))).to_pylist()
+    assert [r["sq"] for r in out] == [
+        list(range(1, k + 2)) for k in range(6)]
+
+
+def test_struct_ops(sess):
+    df, t = arr_df(sess)
+    q = df.select(df.u, F.struct(df.u, df.v).alias("st"))
+    out = run_both(q).to_pylist()
+    assert out[0]["st"] == {"u": 0, "v": 10}
+    q2 = q.select(q.u, q.st.getField("v").alias("vv")) \
+        if hasattr(q.st, "getField") else None
+    # GetStructField via expression API
+    from spark_rapids_tpu.sql.expressions.collections import GetStructField
+    from spark_rapids_tpu.sql.dataframe import Column
+    q3 = q.select(q.u, Column(GetStructField(q.st.expr, 1, "v")).alias("vv"))
+    out3 = run_both(q3).to_pylist()
+    assert [r["vv"] for r in out3] == [10, 20, 30, 40, 50, 60]
+
+
+def test_map_ops(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u, F.map_keys(df.m).alias("mk"), F.map_values(df.m).alias("mv"),
+        F.map_entries(df.m).alias("me"),
+        F.create_map("a", df.v, "b", df.u).alias("cm"),
+    )).to_pylist()
+    assert out[0]["mk"] == ["k1", "k2"]
+    assert out[0]["mv"] == [1, 2]
+    assert out[0]["me"] == [{"key": "k1", "value": 1},
+                            {"key": "k2", "value": 2}]
+    assert dict(out[0]["cm"]) == {"a": 10, "b": 0}
+    assert out[2]["mk"] is None
+
+
+def test_higher_order_functions(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.transform(df.a, lambda x: x * 2).alias("x2"),
+        F.transform(df.a, lambda x, i: x + i).alias("xi"),
+        F.filter(df.a, lambda x: x > 2).alias("gt2"),
+        F.exists(df.a, lambda x: x == 4).alias("h4"),
+        F.forall(df.a, lambda x: x < 100).alias("all"),
+    )).to_pylist()
+    assert [r["x2"] for r in out] == [
+        [2, 4, 6], [], None, [8, 8, 10], [None, 14], [18]]
+    assert [r["xi"] for r in out] == [
+        [1, 3, 5], [], None, [4, 5, 7], [None, 8], [9]]
+    assert [r["gt2"] for r in out] == [[3], [], None, [4, 4, 5], [7], [9]]
+    assert [r["h4"] for r in out] == [False, False, None, True, None, False]
+    # forall: null element -> null predicate -> null result (3-valued logic)
+    assert [r["all"] for r in out] == [True, True, None, True, None, True]
+
+
+def test_map_higher_order(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.transform_values(df.m, lambda k, v: v * 10).alias("tv"),
+        F.map_filter(df.m, lambda k, v: v > 1).alias("mf"),
+    )).to_pylist()
+    assert dict(out[0]["tv"]) == {"k1": 10, "k2": 20}
+    assert dict(out[0]["mf"]) == {"k2": 2}
+    assert out[2]["tv"] is None
+
+
+def test_explode(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(df.u, F.explode(df.a).alias("e")),
+                   sort_col=None).to_pylist()
+    exp = []
+    for u, arr in zip(t.column("u").to_pylist(), t.column("a").to_pylist()):
+        for x in (arr or []):
+            exp.append({"u": u, "e": x})
+    assert out == exp
+
+
+def test_explode_outer_and_pos(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(df.u, F.explode_outer(df.a).alias("e"))
+                   ).to_pylist()
+    exp = []
+    for u, arr in zip(t.column("u").to_pylist(), t.column("a").to_pylist()):
+        if not arr:
+            exp.append({"u": u, "e": None})
+        else:
+            for x in arr:
+                exp.append({"u": u, "e": x})
+    assert out == exp
+
+    out2 = run_both(df.select(df.u, F.posexplode(df.a))).to_pylist()
+    exp2 = []
+    for u, arr in zip(t.column("u").to_pylist(), t.column("a").to_pylist()):
+        for i, x in enumerate(arr or []):
+            exp2.append({"u": u, "pos": i, "col": x})
+    assert out2 == exp2
+
+
+def test_explode_map(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(df.u, F.explode(df.m))).to_pylist()
+    exp = []
+    for u, m in zip(t.column("u").to_pylist(), t.column("m").to_pylist()):
+        for k, v in (m or []):
+            exp.append({"u": u, "key": k, "value": v})
+    assert out == exp
+
+
+def test_explode_then_aggregate(sess):
+    """Pipeline: explode -> groupBy, validating downstream composition."""
+    df, t = arr_df(sess)
+    q = (df.select(df.u, F.explode(df.a).alias("e"))
+         .groupBy("e").agg(F.count("*").alias("c")))
+    out = {r["e"]: r["c"] for r in run_both(q, sort_col=None).to_pylist()}
+    flat = [x for arr in t.column("a").to_pylist() if arr for x in arr]
+    exp = pd.Series([x for x in flat if x is not None]).value_counts()
+    for k, v in exp.items():
+        assert out[k] == v
+    if None in flat:
+        assert out.get(None) == flat.count(None)
+
+
+def test_lambda_outer_column_reference(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u, F.transform(df.a, lambda x: x + df.v).alias("xv"))).to_pylist()
+    assert out[0]["xv"] == [11, 12, 13]
+    assert out[3]["xv"] == [44, 44, 45]
+    assert out[4]["xv"] == [None, 57]
+
+
+def test_posexplode_outer_null_pos(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(df.u, F.posexplode_outer(df.a))).to_pylist()
+    for r in out:
+        if r["u"] in (1, 2):  # empty and null arrays
+            assert r["pos"] is None and r["col"] is None
+
+
+def test_sort_array_int64_precision(sess):
+    big = 9007199254740993  # 2**53 + 1: collapses under float64
+    t = pa.table({"u": [0], "a": pa.array([[big, big - 1]],
+                                          type=pa.list_(pa.int64()))})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(df.u, F.sort_array(df.a).alias("s"))).to_pylist()
+    assert out[0]["s"] == [big - 1, big]
+
+
+def test_arrays_zip_field_names(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(df.u,
+                             F.arrays_zip(df.a, df.b).alias("z"))).to_pylist()
+    assert out[0]["z"][0] == {"a": 1, "b": 3}
+
+
+def test_posexplode_alias_rejected(sess):
+    df, t = arr_df(sess)
+    with pytest.raises(ValueError):
+        df.select(F.posexplode(df.a).alias("z"))
+
+
+def test_empty_array_literal(sess):
+    df, t = arr_df(sess)
+    out = run_both(df.select(df.u, F.array().alias("e"))).to_pylist()
+    assert all(r["e"] == [] for r in out)

@@ -196,14 +196,17 @@ def test_explain_placement(sess):
 
 
 def test_explain_fallback_reason(sess):
+    # FormatNumber is a host-exact op (reference marks it incompat)
     df = sess.create_dataframe(pa.table({
-        "l": pa.array([[1, 2], [3]])}))  # array type -> host only
-    s = sess.explain(df.filter(F.col("l").isNotNull()))
+        "x": pa.array([1234.5, 6.7])}))
+    from spark_rapids_tpu.sql.expressions.strings import FormatNumber
+    from spark_rapids_tpu.sql.dataframe import Column
+    q = df.select(Column(FormatNumber(df.x.expr, F.lit(2).expr)).alias("s"))
+    s = sess.explain(q)
     assert "cannot run on TPU" in s
-    assert "not supported" in s
-    # and it still executes via the host engine
-    out = df.filter(F.col("l").isNotNull()).collect()
-    assert out.num_rows == 2
+    assert "host" in s
+    out = q.collect()
+    assert out.column("s").to_pylist() == ["1,234.50", "6.70"]
 
 
 def test_sql_disabled_conf(sess):
