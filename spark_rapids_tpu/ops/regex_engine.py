@@ -1,0 +1,675 @@
+"""TPU-native regex engine — the analog of the reference's
+``RegexParser.scala`` / ``CudfRegexTranspiler`` (1994 LoC; SURVEY §2.4).
+
+The reference transpiles Java regexes into cuDF's device regex dialect,
+rejecting unsupported constructs so those expressions fall back.  The TPU
+has no regex runtime at all, so we go one level deeper:
+
+  pattern --parse--> AST --Thompson--> NFA --subset--> DFA
+                                                        |
+                     device: byte-class transition table [nstates, nclasses]
+                     executed as a scan over the padded byte matrix
+
+All device work is gathers over int32 tables — static shapes, VPU-friendly.
+Matching semantics are POSIX leftmost-longest (a DFA cannot express Java's
+backtracking preferences); patterns where that detectably differs
+(backreferences, lookaround, lazy/possessive quantifiers) are REJECTED at
+compile time so the expression is tagged to the host, mirroring the
+reference's transpiler rejections (`RegexParser.scala:686+`).
+
+Byte-level caveat: classes and ``.`` operate on bytes; non-ASCII literal
+characters match as their UTF-8 byte sequences, but ``.`` and negated
+classes count bytes, not code points (documented compat corner, same family
+of caveats as the reference's transpiled dialect).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+import numpy as np
+
+
+class RegexUnsupported(Exception):
+    """Raised for constructs the DFA engine cannot express."""
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RLit:
+    byte: int
+
+
+@dataclass
+class RClass:
+    bytes_: FrozenSet[int]
+
+
+@dataclass
+class RSeq:
+    parts: List
+
+
+@dataclass
+class RAlt:
+    options: List
+
+
+@dataclass
+class RRep:
+    node: object
+    lo: int
+    hi: Optional[int]   # None = unbounded
+
+
+@dataclass
+class RAnchor:
+    kind: str  # '^' or '$'
+
+
+_DOT = frozenset(b for b in range(256) if b != 0x0A)
+_DIGIT = frozenset(range(ord("0"), ord("9") + 1))
+_WORD = frozenset(list(range(ord("a"), ord("z") + 1))
+                  + list(range(ord("A"), ord("Z") + 1))
+                  + list(_DIGIT) + [ord("_")])
+_SPACE = frozenset([0x20, 0x09, 0x0A, 0x0B, 0x0C, 0x0D])
+_ALL = frozenset(range(256))
+
+_MAX_REP = 16            # {m,n} expansion cap (keeps NFA small)
+_MAX_DFA_STATES = 256
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.ngroups = 0
+
+    def error(self, msg):
+        raise RegexUnsupported(f"{msg} at {self.i} in {self.p!r}")
+
+    def peek(self):
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def next(self):
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    def parse(self):
+        node = self.alternation()
+        if self.i < len(self.p):
+            self.error(f"unexpected {self.p[self.i]!r}")
+        return node
+
+    def alternation(self):
+        opts = [self.sequence()]
+        while self.peek() == "|":
+            self.next()
+            opts.append(self.sequence())
+        return opts[0] if len(opts) == 1 else RAlt(opts)
+
+    def sequence(self):
+        parts = []
+        while True:
+            ch = self.peek()
+            if ch is None or ch in "|)":
+                break
+            parts.append(self.quantified())
+        return RSeq(parts)
+
+    def quantified(self):
+        atom = self.atom()
+        while True:
+            ch = self.peek()
+            if ch == "*":
+                self.next()
+                atom = RRep(atom, 0, None)
+            elif ch == "+":
+                self.next()
+                atom = RRep(atom, 1, None)
+            elif ch == "?":
+                self.next()
+                atom = RRep(atom, 0, 1)
+            elif ch == "{":
+                atom = self.counted(atom)
+            else:
+                return atom
+            nxt = self.peek()
+            if nxt in ("?", "+") and isinstance(atom, RRep):
+                # lazy / possessive quantifier: changes which match Java
+                # picks; a DFA cannot honor it
+                self.error(f"lazy/possessive quantifier '{nxt}'")
+
+    def counted(self, atom):
+        j = self.p.find("}", self.i)
+        if j < 0:
+            self.error("unterminated {")
+        body = self.p[self.i + 1:j]
+        self.i = j + 1
+        if "," in body:
+            lo_s, hi_s = body.split(",", 1)
+            lo = int(lo_s) if lo_s else 0
+            hi = int(hi_s) if hi_s else None
+        else:
+            lo = hi = int(body)
+        if lo < 0 or (hi is not None and hi < lo):
+            # Java treats malformed counted braces as literal text
+            self.error(f"malformed repetition {{{body}}}")
+        if lo > _MAX_REP or (hi is not None and hi > _MAX_REP):
+            self.error(f"repetition bound > {_MAX_REP}")
+        return RRep(atom, lo, hi)
+
+    def atom(self):
+        ch = self.next()
+        if ch == "(":
+            if self.peek() == "?":
+                self.next()
+                k = self.peek()
+                if k == ":":
+                    self.next()
+                else:
+                    self.error(f"group construct (?{k}")
+            else:
+                self.ngroups += 1
+            node = self.alternation()
+            if self.peek() != ")":
+                self.error("unbalanced (")
+            self.next()
+            return node
+        if ch == "[":
+            return self.char_class()
+        if ch == ".":
+            return RClass(_DOT)
+        if ch == "^":
+            return RAnchor("^")
+        if ch == "$":
+            return RAnchor("$")
+        if ch == "\\":
+            return self.escape()
+        if ch in "*+?{":
+            self.error(f"dangling quantifier {ch!r}")
+        b = ch.encode("utf-8")
+        if len(b) == 1:
+            return RLit(b[0])
+        return RSeq([RLit(x) for x in b])
+
+    def escape(self):
+        if self.peek() is None:
+            self.error("dangling escape")
+        ch = self.next()
+        simple = {"d": _DIGIT, "D": _ALL - _DIGIT, "w": _WORD,
+                  "W": _ALL - _WORD, "s": _SPACE, "S": _ALL - _SPACE}
+        if ch in simple:
+            return RClass(frozenset(simple[ch]))
+        if ch in "bBAzZG":
+            self.error(f"anchor \\{ch}")
+        if ch.isdigit():
+            self.error("backreference")
+        ctl = {"n": 0x0A, "t": 0x09, "r": 0x0D, "f": 0x0C, "a": 0x07,
+               "e": 0x1B, "0": 0x00}
+        if ch in ctl:
+            return RLit(ctl[ch])
+        if ch == "x":
+            h = self.p[self.i:self.i + 2]
+            self.i += 2
+            return RLit(int(h, 16))
+        if ch in "pP":
+            self.error("unicode property class")
+        b = ch.encode("utf-8")
+        if len(b) == 1:
+            return RLit(b[0])
+        return RSeq([RLit(x) for x in b])
+
+    def char_class(self):
+        negate = False
+        if self.peek() == "^":
+            self.next()
+            negate = True
+        members: Set[int] = set()
+        first = True
+        while True:
+            ch = self.peek()
+            if ch is None:
+                self.error("unterminated [")
+            if ch == "]" and not first:
+                self.next()
+                break
+            first = False
+            self.next()
+            if ch == "\\":
+                node = self.escape()
+                if isinstance(node, RClass):
+                    members |= node.bytes_
+                    continue
+                if isinstance(node, RSeq):
+                    self.error("multi-byte char in class")
+                lo_b = node.byte
+            else:
+                eb = ch.encode("utf-8")
+                if len(eb) > 1:
+                    self.error("non-ASCII char in class")
+                lo_b = eb[0]
+            if self.peek() == "-" and self.i + 1 < len(self.p) and \
+                    self.p[self.i + 1] != "]":
+                self.next()
+                hi_ch = self.next()
+                if hi_ch == "\\":
+                    hi_node = self.escape()
+                    if not isinstance(hi_node, RLit):
+                        self.error("bad range end")
+                    hi_b = hi_node.byte
+                else:
+                    hb = hi_ch.encode("utf-8")
+                    if len(hb) > 1:
+                        self.error("non-ASCII char in class")
+                    hi_b = hb[0]
+                members |= set(range(lo_b, hi_b + 1))
+            else:
+                members.add(lo_b)
+        # NB: padding bytes are excluded by the j < lens live mask in the
+        # executors, so negated classes may legitimately include byte 0
+        out = (_ALL - members) if negate else members
+        return RClass(frozenset(out))
+
+
+# ---------------------------------------------------------------------------
+# NFA (Thompson construction)
+# ---------------------------------------------------------------------------
+
+class _NFA:
+    def __init__(self):
+        self.eps: List[Set[int]] = []
+        self.trans: List[Dict[int, Set[int]]] = []  # state -> byte -> states
+        self.start_anchor: Set[int] = set()  # states requiring pos == 0
+        self.end_accept_anchor: Set[int] = set()
+
+    def new_state(self) -> int:
+        self.eps.append(set())
+        self.trans.append({})
+        return len(self.eps) - 1
+
+    def add_eps(self, a, b):
+        self.eps[a].add(b)
+
+    def add_trans(self, a, bytes_, b):
+        for x in bytes_:
+            self.trans[a].setdefault(x, set()).add(b)
+
+
+def _build(nfa: _NFA, node, start: int) -> Tuple[int, bool, bool]:
+    """Builds node between start and a fresh end state.  Returns
+    (end_state, has_start_anchor, has_end_anchor)."""
+    if isinstance(node, RLit):
+        e = nfa.new_state()
+        nfa.add_trans(start, [node.byte], e)
+        return e, False, False
+    if isinstance(node, RClass):
+        e = nfa.new_state()
+        nfa.add_trans(start, node.bytes_, e)
+        return e, False, False
+    if isinstance(node, RAnchor):
+        # anchors only supported at the very ends of the pattern; validated
+        # by the caller via position bookkeeping
+        raise RegexUnsupported("anchor in unsupported position")
+    if isinstance(node, RSeq):
+        cur = start
+        for p in node.parts:
+            cur, _, _ = _build(nfa, p, cur)
+        return cur, False, False
+    if isinstance(node, RAlt):
+        e = nfa.new_state()
+        for opt in node.options:
+            s2 = nfa.new_state()
+            nfa.add_eps(start, s2)
+            oe, _, _ = _build(nfa, opt, s2)
+            nfa.add_eps(oe, e)
+        return e, False, False
+    if isinstance(node, RRep):
+        cur = start
+        for _ in range(node.lo):
+            cur, _, _ = _build(nfa, node.node, cur)
+        if node.hi is None:
+            loop_in = nfa.new_state()
+            nfa.add_eps(cur, loop_in)
+            le, _, _ = _build(nfa, node.node, loop_in)
+            nfa.add_eps(le, loop_in)
+            return loop_in, False, False
+        opt_ends = [cur]
+        for _ in range(node.hi - node.lo):
+            cur, _, _ = _build(nfa, node.node, cur)
+            opt_ends.append(cur)
+        e = nfa.new_state()
+        for oe in opt_ends:
+            nfa.add_eps(oe, e)
+        return e, False, False
+    raise RegexUnsupported(f"node {node}")
+
+
+def _strip_anchors(node) -> Tuple[object, bool, bool]:
+    """Pull ^ / $ off the pattern edges (only positions we support)."""
+    anchored_start = anchored_end = False
+    if isinstance(node, RSeq):
+        parts = list(node.parts)
+        if parts and isinstance(parts[0], RAnchor) and parts[0].kind == "^":
+            anchored_start = True
+            parts = parts[1:]
+        if parts and isinstance(parts[-1], RAnchor) and parts[-1].kind == "$":
+            anchored_end = True
+            parts = parts[:-1]
+        for p in parts:
+            if isinstance(p, RAnchor):
+                raise RegexUnsupported("interior anchor")
+            _reject_nested_anchor(p)
+        return RSeq(parts), anchored_start, anchored_end
+    if isinstance(node, RAnchor):
+        return RSeq([]), node.kind == "^", node.kind == "$"
+    _reject_nested_anchor(node)
+    return node, False, False
+
+
+def _reject_nested_anchor(node):
+    kids = []
+    if isinstance(node, RSeq):
+        kids = node.parts
+    elif isinstance(node, RAlt):
+        kids = node.options
+    elif isinstance(node, RRep):
+        kids = [node.node]
+    for k in kids:
+        if isinstance(k, RAnchor):
+            raise RegexUnsupported("nested anchor")
+        _reject_nested_anchor(k)
+
+
+# ---------------------------------------------------------------------------
+# DFA (subset construction over byte-equivalence classes)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CompiledRegex:
+    table: np.ndarray       # [nstates, nclasses] int32 next-state
+    byte_class: np.ndarray  # [256] int32
+    accept: np.ndarray      # [nstates] bool
+    start: int
+    dead: int
+    anchored_start: bool
+    anchored_end: bool
+    ngroups: int
+
+
+def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
+    out = set(states)
+    stack = list(states)
+    while stack:
+        s = stack.pop()
+        for t in nfa.eps[s]:
+            if t not in out:
+                out.add(t)
+                stack.append(t)
+    return frozenset(out)
+
+
+def compile_regex(pattern: str, search_prefix: bool = False) -> CompiledRegex:
+    """Compile to a DFA.  ``search_prefix`` prepends an implicit ``.*?``
+    (any byte loop) for single-pass unanchored search (RLike)."""
+    parser = _Parser(pattern)
+    ast = parser.parse()
+    ast, anc_s, anc_e = _strip_anchors(ast)
+
+    nfa = _NFA()
+    start = nfa.new_state()
+    entry = start
+    if search_prefix and not anc_s:
+        # .* loop at the start (any byte incl. newline)
+        nfa.add_trans(start, _ALL, start)
+    end, _, _ = _build(nfa, ast, entry)
+    accept_nfa = {end}
+
+    # byte-equivalence classes: bytes with identical outgoing behavior
+    sig: Dict[int, List] = {}
+    for b in range(256):
+        key = []
+        for s in range(len(nfa.trans)):
+            tg = nfa.trans[s].get(b)
+            key.append(frozenset(tg) if tg else None)
+        sig[b] = key
+    classes: Dict[Tuple, int] = {}
+    byte_class = np.zeros(256, dtype=np.int32)
+    for b in range(256):
+        k = tuple((i, fs) for i, fs in enumerate(sig[b]) if fs)
+        if k not in classes:
+            classes[k] = len(classes)
+        byte_class[b] = classes[k]
+    nclasses = len(classes)
+    class_rep = {}
+    for b in range(256):
+        class_rep.setdefault(int(byte_class[b]), b)
+
+    start_set = _eps_closure(nfa, frozenset([start]))
+    dfa_states: Dict[FrozenSet[int], int] = {start_set: 0}
+    table_rows: List[List[int]] = []
+    accept_flags: List[bool] = [bool(start_set & accept_nfa)]
+    worklist = [start_set]
+    while worklist:
+        cur = worklist.pop()
+        row = [0] * nclasses
+        for cls in range(nclasses):
+            b = class_rep[cls]
+            nxt = set()
+            for s in cur:
+                nxt |= nfa.trans[s].get(b, set())
+            nxt_c = _eps_closure(nfa, frozenset(nxt)) if nxt else frozenset()
+            if nxt_c not in dfa_states:
+                if len(dfa_states) >= _MAX_DFA_STATES:
+                    raise RegexUnsupported("DFA state explosion")
+                dfa_states[nxt_c] = len(dfa_states)
+                accept_flags.append(bool(nxt_c & accept_nfa))
+                worklist.append(nxt_c)
+                table_rows.append(None)  # placeholder, fixed below
+            row[cls] = dfa_states[nxt_c]
+        idx = dfa_states[cur]
+        while len(table_rows) <= idx:
+            table_rows.append(None)
+        table_rows[idx] = row
+
+    n = len(dfa_states)
+    table = np.zeros((n, nclasses), dtype=np.int32)
+    for i, row in enumerate(table_rows):
+        table[i] = row
+    dead = dfa_states.get(frozenset(), -1)
+    return CompiledRegex(table, byte_class, np.array(accept_flags),
+                        0, dead, anc_s, anc_e, parser.ngroups)
+
+
+# ---------------------------------------------------------------------------
+# Device execution
+# ---------------------------------------------------------------------------
+
+def _classes_of(xp, rx: CompiledRegex, chars):
+    return xp.take(xp.asarray(rx.byte_class), chars.astype(xp.int32))
+
+
+def dfa_search(xp, rx: CompiledRegex, chars, lens):
+    """RLike: does the pattern match anywhere in each row?  rx must be
+    compiled with search_prefix=True (or anchored).  jax path uses
+    lax.scan over the byte axis (one compiled step, not width-unrolled)."""
+    rows, width = chars.shape
+    cls = _classes_of(xp, rx, chars)
+    table = xp.asarray(rx.table)
+    accept = xp.asarray(rx.accept)
+    state0 = xp.full((rows,), rx.start, dtype=xp.int32)
+    hit0 = accept[state0]
+    if rx.anchored_end:
+        hit0 = hit0 & (lens == 0)
+
+    def step(carry, inp):
+        state, hit = carry
+        j, cls_j = inp
+        live = j < lens
+        state = xp.where(live, table[state, cls_j], state)
+        acc = accept[state] & live
+        if rx.anchored_end:
+            acc = acc & (j == lens - 1)
+        return (state, hit | acc), None
+
+    if xp.__name__ == "numpy":
+        carry = (state0, hit0)
+        for j in range(width):
+            carry, _ = step(carry, (j, cls[:, j]))
+        return carry[1]
+    import jax
+    js = xp.arange(width, dtype=xp.int32)
+    (state, hit), _ = jax.lax.scan(step, (state0, hit0), (js, cls.T))
+    return hit
+
+
+def dfa_match_spans(xp, rx: CompiledRegex, chars, lens):
+    """Leftmost-longest non-overlapping matches.
+
+    Returns (starts_mask[rows, width+1], match_len[rows, width+1]):
+    position p starts a chosen match of length match_len[p] (0-length
+    matches allowed at p == lens for $-style patterns are excluded).
+
+    Strategy: simulate the DFA from EVERY start position simultaneously
+    ([rows, width+1] state lanes), recording for each start the longest
+    accepting end.  Then select non-overlapping matches left-to-right with
+    a host-side-free cummax trick."""
+    rows, width = chars.shape
+    cls = _classes_of(xp, rx, chars)
+    table = xp.asarray(rx.table)
+    accept = xp.asarray(rx.accept)
+    ns = width + 1
+    starts = xp.arange(ns, dtype=xp.int32)[None, :]        # start positions
+    state0 = xp.full((rows, ns), rx.start, dtype=xp.int32)
+    # longest accepting end per start (exclusive end); -1 = no match
+    be0 = xp.where(accept[rx.start] & (starts <= lens[:, None]), starts, -1)
+    be0 = xp.broadcast_to(be0, (rows, ns)) + xp.zeros((rows, ns), xp.int32)
+
+    def sim_step(carry, inp):
+        state, best_end = carry
+        j, cls_j = inp
+        active = (starts <= j) & (j < lens[:, None])
+        state = xp.where(active, table[state, cls_j[:, None]], state)
+        acc = accept[state] & active
+        best_end = xp.where(acc, j + 1, best_end)
+        return (state, best_end), None
+
+    if xp.__name__ == "numpy":
+        carry = (state0, be0)
+        for j in range(width):
+            carry, _ = sim_step(carry, (j, cls[:, j]))
+        state, best_end = carry
+    else:
+        import jax
+        js = xp.arange(width, dtype=xp.int32)
+        (state, best_end), _ = jax.lax.scan(sim_step, (state0, be0),
+                                            (js, cls.T))
+    if rx.anchored_start:
+        best_end = xp.where(starts == 0, best_end, -1)
+    if rx.anchored_end:
+        best_end = xp.where((best_end == lens[:, None]) & (best_end >= 0),
+                            best_end, -1)
+    mlen = xp.where(best_end >= 0, best_end - starts, -1)
+
+    # choose non-overlapping matches left-to-right.  next_free starts at 0;
+    # position p is chosen iff p >= next_free and mlen[p] >= 0; then
+    # next_free = p + max(mlen, 1).  Sequential over positions -> python
+    # loop over width (static).
+    def pick_step(next_free, inp):
+        p, mlen_p = inp
+        can = (next_free <= p) & (mlen_p >= 0) & (p <= lens)
+        adv = xp.where(can, p + xp.maximum(mlen_p, 1), next_free)
+        return xp.maximum(next_free, adv), (can, xp.where(can, mlen_p, 0))
+
+    nf0 = xp.zeros((rows,), dtype=xp.int32)
+    ps = xp.arange(ns, dtype=xp.int32)
+    if xp.__name__ == "numpy":
+        next_free = nf0
+        cans, lns = [], []
+        for p in range(ns):
+            next_free, (can, ln) = pick_step(next_free, (p, mlen[:, p]))
+            cans.append(can)
+            lns.append(ln)
+        return np.stack(cans, axis=1), np.stack(lns, axis=1)
+    import jax
+    _, (cans, lns) = jax.lax.scan(pick_step, nf0, (ps, mlen.T))
+    return cans.T, lns.T
+
+
+# ---------------------------------------------------------------------------
+# Span-consuming device ops (replace / extract / split)
+# ---------------------------------------------------------------------------
+
+def replace_matches(xp, chars, lens, chosen, span_len, rep_chars, rep_lens,
+                    out_width: int):
+    """regexp_replace: substitute every chosen span with the replacement.
+    ``chosen``/``span_len`` are [rows, width+1] from dfa_match_spans; the
+    replacement is a per-row byte string (usually a broadcast literal).
+    Zero-length matches insert the replacement and keep the byte."""
+    from .strings_ops import scatter_set
+    rows, width = chars.shape
+    ns = width + 1
+    pos = xp.arange(ns, dtype=xp.int32)[None, :]
+    in_str = pos < lens[:, None]
+
+    # inside = byte position covered by a chosen span (start exclusive of
+    # zero-length matches)
+    start_end = xp.where(chosen, pos + span_len, 0)
+    run_end = _cummax_axis1(xp, start_end)
+    inside = pos < run_end
+
+    contrib = xp.where(chosen, rep_lens[:, None], 0) + \
+        xp.where(in_str & ~inside, 1, 0)
+    out_off = xp.cumsum(contrib, axis=1) - contrib
+    new_len = xp.minimum(xp.sum(contrib, axis=1), out_width).astype(xp.int32)
+
+    out = xp.zeros((rows, out_width + 1), dtype=xp.uint8)
+    rows_idx = xp.broadcast_to(xp.arange(rows)[:, None], (rows, ns))
+    # copied source bytes land after any replacement inserted at the same pos
+    copy_off = out_off + xp.where(chosen, rep_lens[:, None], 0)
+    copy_mask = in_str & ~inside & (copy_off < out_width)
+    src = xp.pad(chars, ((0, 0), (0, 1)))
+    safe = xp.where(copy_mask, xp.clip(copy_off, 0, out_width - 1), out_width)
+    out = scatter_set(xp, out, rows_idx, safe, src)
+    # replacement bytes
+    rw = rep_chars.shape[1]
+    for j in range(rw):
+        mask_j = chosen & (j < rep_lens[:, None]) & (out_off + j < out_width)
+        vals = xp.broadcast_to(rep_chars[:, j:j + 1], (rows, ns))
+        safe = xp.where(mask_j, xp.clip(out_off + j, 0, out_width - 1),
+                        out_width)
+        out = scatter_set(xp, out, rows_idx, safe, vals)
+    return out[:, :out_width], new_len
+
+
+def _cummax_axis1(xp, v):
+    if xp.__name__ == "numpy":
+        return np.maximum.accumulate(v, axis=1)
+    import jax
+    return jax.lax.associative_scan(xp.maximum, v, axis=1)
+
+
+def first_match_span(xp, chosen, span_len, lens):
+    """(start, length, found) of the leftmost match per row."""
+    ns = chosen.shape[1]
+    pos = xp.arange(ns, dtype=xp.int32)[None, :]
+    cand = xp.where(chosen, pos, ns)
+    start = xp.min(cand, axis=1)
+    found = start < ns
+    safe = xp.clip(start, 0, ns - 1)
+    ln = xp.take_along_axis(span_len, safe[:, None], axis=1)[:, 0]
+    return xp.where(found, start, 0), xp.where(found, ln, 0), found
+
+
+def match_index_positions(xp, chosen, k: int):
+    """Position of the (k+1)-th chosen match per row; (pos, exists)."""
+    ranks = xp.cumsum(chosen.astype(xp.int32), axis=1)
+    target = chosen & (ranks == (k + 1))
+    exists = xp.any(target, axis=1)
+    pos = xp.argmax(target, axis=1).astype(xp.int32)
+    return pos, exists

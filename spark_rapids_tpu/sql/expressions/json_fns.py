@@ -1,0 +1,269 @@
+"""JSON expressions — GetJsonObject / JsonTuple / JsonToStructs /
+StructsToJson (reference ``GpuJsonToStructs.scala``, ``GpuJsonTuple.scala``,
+``GpuGetJsonObject.scala``; SURVEY §2.4 JSON family).
+
+The reference delegates to spark-rapids-jni JSON kernels and gates many
+shapes behind incompat flags.  Here the parse is host-exact (Python json,
+row-at-a-time) and every op is tagged to the host engine; the padded device
+layout receives the parsed result so downstream ops stay on-device."""
+
+from __future__ import annotations
+
+import json as _json
+import re as _re
+from typing import List, Optional
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn, bucket_width
+from .core import (Expression, Literal, resolve_expression, valid_and)
+from .strings import _host_rows, _pack, _lit_str
+
+_PATH_RX = _re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\['([^']+)'\]")
+
+
+def parse_json_path(path: str) -> Optional[List]:
+    """'$.a.b[0]' -> ['a', 'b', 0]; None when malformed."""
+    if not path.startswith("$"):
+        return None
+    out: List = []
+    i = 1
+    while i < len(path):
+        m = _PATH_RX.match(path, i)
+        if not m:
+            return None
+        if m.group(1) is not None:
+            out.append(m.group(1))
+        elif m.group(2) is not None:
+            out.append(int(m.group(2)))
+        else:
+            out.append(m.group(3))
+        i = m.end()
+    return out
+
+
+def _walk(obj, steps):
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(obj, list) or s >= len(obj):
+                return None
+            obj = obj[s]
+        else:
+            if not isinstance(obj, dict) or s not in obj:
+                return None
+            obj = obj[s]
+    return obj
+
+
+def _render(v) -> Optional[str]:
+    """Spark get_json_object rendering: scalars bare, composites as JSON."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return _json.dumps(v)
+    return _json.dumps(v, separators=(",", ":"))
+
+
+class GetJsonObject(Expression):
+    def __init__(self, js, path):
+        self.children = (resolve_expression(js), resolve_expression(path))
+
+    def with_children(self, children):
+        return GetJsonObject(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self, conf=None):
+        if _lit_str(self.children[1]) is None:
+            return "JSON path must be a literal string"
+        return "get_json_object runs on the host engine"
+
+    def kernel(self, ctx, c, p):
+        steps = parse_json_path(_lit_str(self.children[1]) or "")
+        out = []
+        for s in _host_rows(ctx, c):
+            if s is None or steps is None:
+                out.append(None)
+                continue
+            try:
+                out.append(_render(_walk(_json.loads(s), steps)))
+            except (ValueError, TypeError):
+                out.append(None)
+        validity = valid_and(ctx.xp, c, p) & ctx.xp.asarray(
+            np.array([x is not None for x in out]))
+        return _pack(ctx, out, validity)
+
+
+class JsonTuple(Expression):
+    """json_tuple(json, f1, f2, ...) -> struct<c0, c1, ...> of strings.
+    (Spark models this as a generator emitting columns c0..cN; the struct
+    form carries the same values and projects cleanly.)"""
+
+    def __init__(self, js, *fields):
+        self.children = (resolve_expression(js),) + tuple(
+            resolve_expression(f) for f in fields)
+
+    def with_children(self, children):
+        return JsonTuple(children[0], *children[1:])
+
+    @property
+    def data_type(self):
+        return T.StructType(tuple(
+            T.StructField(f"c{i}", T.STRING, True)
+            for i in range(len(self.children) - 1)))
+
+    def tag_for_device(self, conf=None):
+        for f in self.children[1:]:
+            if _lit_str(f) is None:
+                return "json_tuple fields must be literal strings"
+        return "json_tuple runs on the host engine"
+
+    def kernel(self, ctx, c, *fcols):
+        fields = [_lit_str(f) for f in self.children[1:]]
+        outs: List[List[Optional[str]]] = [[] for _ in fields]
+        for s in _host_rows(ctx, c):
+            parsed = None
+            if s is not None:
+                try:
+                    parsed = _json.loads(s)
+                except ValueError:
+                    parsed = None
+            for k, f in enumerate(fields):
+                v = parsed.get(f) if isinstance(parsed, dict) else None
+                outs[k].append(_render(v))
+        xp = ctx.xp
+        kids = []
+        for vals in outs:
+            validity = xp.asarray(np.array([x is not None for x in vals]))
+            kids.append(_pack(ctx, vals, validity))
+        return DeviceColumn(self.data_type, None, c.validity,
+                            children=tuple(kids))
+
+
+def _json_value_to_type(v, dt: T.DataType):
+    import datetime
+    if v is None:
+        return None
+    try:
+        if isinstance(dt, T.StringType):
+            return v if isinstance(v, str) else _render(v)
+        if isinstance(dt, T.BooleanType):
+            return bool(v) if isinstance(v, bool) else None
+        if T.is_integral(dt):
+            return int(v) if not isinstance(v, bool) else None
+        if T.is_floating(dt):
+            return float(v)
+        if isinstance(dt, T.DateType):
+            return datetime.date.fromisoformat(v)
+        if isinstance(dt, T.TimestampType):
+            return datetime.datetime.fromisoformat(v)
+        if isinstance(dt, T.ArrayType):
+            if not isinstance(v, list):
+                return None
+            return [_json_value_to_type(x, dt.element_type) for x in v]
+        if isinstance(dt, T.StructType):
+            if not isinstance(v, dict):
+                return None
+            return {f.name: _json_value_to_type(v.get(f.name), f.data_type)
+                    for f in dt.fields}
+        if isinstance(dt, T.MapType):
+            if not isinstance(v, dict):
+                return None
+            return {k: _json_value_to_type(x, dt.value_type)
+                    for k, x in v.items()}
+    except (ValueError, TypeError):
+        return None
+    return None
+
+
+class JsonToStructs(Expression):
+    """from_json(json, schema)."""
+
+    def __init__(self, js, schema: T.DataType):
+        self.children = (resolve_expression(js),)
+        self.schema = schema
+
+    def with_children(self, children):
+        return JsonToStructs(children[0], self.schema)
+
+    def _key_extras(self):
+        return (str(self.schema),)
+
+    @property
+    def data_type(self):
+        return self.schema
+
+    def tag_for_device(self, conf=None):
+        return "from_json runs on the host engine"
+
+    def kernel(self, ctx, c):
+        import pyarrow as pa
+        from ...columnar.convert import arrow_to_device_column
+        rows = []
+        for s in _host_rows(ctx, c):
+            parsed = None
+            if s is not None:
+                try:
+                    parsed = _json_value_to_type(_json.loads(s), self.schema)
+                except ValueError:
+                    parsed = None
+            rows.append(parsed)
+        arr = pa.array(rows, type=T.to_arrow(self.schema))
+        col = arrow_to_device_column(arr, c.capacity)
+        return col.with_validity(col.validity & c.validity)
+
+
+class StructsToJson(Expression):
+    """to_json(struct/array/map column)."""
+
+    def __init__(self, child):
+        self.children = (resolve_expression(child),)
+
+    def with_children(self, children):
+        return StructsToJson(children[0])
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self, conf=None):
+        return "to_json runs on the host engine"
+
+    def kernel(self, ctx, c):
+        import datetime
+        import decimal
+        from ...columnar.convert import device_column_to_arrow
+        n = c.capacity
+        arr = device_column_to_arrow(c, n)
+
+        def default(o):
+            if isinstance(o, (datetime.date, datetime.datetime)):
+                return o.isoformat()
+            if isinstance(o, decimal.Decimal):
+                return float(o)
+            if isinstance(o, bytes):
+                return o.decode("utf-8", "replace")
+            raise TypeError(type(o))
+
+        def clean(v):
+            if isinstance(v, dict):
+                return {k: clean(x) for k, x in v.items() if x is not None}
+            if isinstance(v, list):
+                if v and isinstance(v[0], tuple):  # map entries
+                    return {k: clean(x) for k, x in v}
+                return [clean(x) for x in v]
+            return v
+
+        out = []
+        for i, v in enumerate(arr.to_pylist()):
+            out.append(None if v is None else
+                       _json.dumps(clean(v), default=default,
+                                   separators=(",", ":")))
+        return _pack(ctx, out, c.validity)

@@ -1,0 +1,419 @@
+"""Regex expressions — RLike / RegExpReplace / RegExpExtract(All) /
+StringSplit / StringToMap (reference ``stringFunctions.scala`` +
+``GpuRegExpReplaceMeta.scala``; SURVEY §2.4).
+
+Device path: patterns compile through ``ops/regex_engine`` (NFA->DFA with
+POSIX leftmost-longest semantics); constructs a DFA cannot honor are
+rejected at tagging time and run on the host engine via Python ``re``
+(row-at-a-time), mirroring the reference's transpile-or-fallback split."""
+
+from __future__ import annotations
+
+import re as _pyre
+from typing import Optional
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn, bucket_width, make_array_column
+from ...ops import regex_engine as RX
+from ...ops import strings_ops as S
+from .core import (Expression, Literal, fixed, resolve_expression, valid_and)
+from .strings import _host_rows, _pack, _lit_str
+
+_MAX_OUT = 1 << 14
+
+
+def _compile_or_reason(pattern: Optional[str], search: bool):
+    if pattern is None:
+        return None, "regex pattern must be a literal string"
+    try:
+        return RX.compile_regex(pattern, search_prefix=search), None
+    except RX.RegexUnsupported as e:
+        return None, f"pattern not supported by the device regex engine: {e}"
+    except Exception as e:  # noqa: BLE001 — malformed pattern
+        return None, f"invalid regex: {e}"
+
+
+class _RegexExpr(Expression):
+    _search_mode = False
+
+    def _pattern(self) -> Optional[str]:
+        return _lit_str(self.children[1])
+
+    def _compiled(self):
+        if not hasattr(self, "_rx_cache"):
+            self._rx_cache = _compile_or_reason(self._pattern(),
+                                                self._search_mode)
+        return self._rx_cache
+
+    def tag_for_device(self, conf=None):
+        rx, reason = self._compiled()
+        return reason
+
+
+class RLike(_RegexExpr):
+    _search_mode = True
+
+    def __init__(self, left, right):
+        self.children = (resolve_expression(left), resolve_expression(right))
+
+    def with_children(self, children):
+        return RLike(children[0], children[1])
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def kernel(self, ctx, c, p):
+        xp = ctx.xp
+        rx, reason = self._compiled()
+        if rx is None:  # host fallback (unsupported pattern)
+            pat = _pyre.compile(self._pattern() or "")
+            out = np.array([bool(pat.search(s)) if s is not None else False
+                            for s in _host_rows(ctx, c)])
+            return fixed(T.BOOLEAN, out, valid_and(xp, c, p))
+        hit = RX.dfa_search(xp, rx, c.data, c.lengths)
+        return fixed(T.BOOLEAN, hit, valid_and(xp, c, p))
+
+
+class RegExpReplace(_RegexExpr):
+    def __init__(self, subject, pattern, rep):
+        self.children = (resolve_expression(subject),
+                         resolve_expression(pattern),
+                         resolve_expression(rep))
+
+    def with_children(self, children):
+        return RegExpReplace(*children)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def tag_for_device(self, conf=None):
+        rx, reason = self._compiled()
+        if reason:
+            return reason
+        rep = _lit_str(self.children[2])
+        if rep is None:
+            return "replacement must be a literal string on the device"
+        if "$" in rep or "\\" in rep:
+            return ("group references in the replacement run on the host "
+                    "(GpuRegExpReplaceMeta equivalent restriction)")
+        return None
+
+    def kernel(self, ctx, c, p, r):
+        xp = ctx.xp
+        rx, reason = self._compiled()
+        rep = _lit_str(self.children[2])
+        if rx is None or rep is None or "$" in (rep or "") or \
+                "\\" in (rep or ""):
+            pat = _pyre.compile(self._pattern() or "")
+            java_rep = _lit_str(self.children[2]) or ""
+            py_rep = _pyre.sub(r"\$(\d+)", r"\\\1", java_rep)
+            out = [None if s is None else pat.sub(py_rep, s)
+                   for s in _host_rows(ctx, c)]
+            return _pack(ctx, out, valid_and(xp, c, p, r))
+        chosen, mlen = RX.dfa_match_spans(xp, rx, c.data, c.lengths)
+        rep_b = rep.encode("utf-8")
+        rw = max(bucket_width(len(rep_b)), 4)
+        rep_row = np.zeros(rw, dtype=np.uint8)
+        rep_row[:len(rep_b)] = np.frombuffer(rep_b, np.uint8)
+        rows = c.data.shape[0]
+        rep_chars = xp.broadcast_to(xp.asarray(rep_row), (rows, rw))
+        rep_lens = xp.full((rows,), len(rep_b), dtype=xp.int32)
+        # worst case: a zero-length match at every position (width+1 of
+        # them) inserts the replacement AND every source byte is kept
+        width_in = c.data.shape[1]
+        out_w = min(bucket_width((width_in + 1) * max(len(rep_b), 1)
+                                 + width_in), _MAX_OUT)
+        chars, lens = RX.replace_matches(xp, c.data, c.lengths, chosen, mlen,
+                                         rep_chars, rep_lens, out_w)
+        return DeviceColumn(T.STRING, chars, valid_and(xp, c, p, r),
+                            lengths=lens)
+
+
+class RegExpExtract(_RegexExpr):
+    """regexp_extract(str, pattern, idx).  Device path: idx=0, or idx=1
+    when the whole pattern is one capturing group.  No match -> ''."""
+
+    def __init__(self, subject, pattern, idx=1):
+        self.children = (resolve_expression(subject),
+                         resolve_expression(pattern),
+                         resolve_expression(idx))
+
+    def with_children(self, children):
+        return RegExpExtract(*children)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _device_group_ok(self) -> bool:
+        idx = self.children[2]
+        if not isinstance(idx, Literal):
+            return False
+        if idx.value == 0:
+            return True
+        pat = self._pattern() or ""
+        rx, _ = self._compiled()
+        return (idx.value == 1 and rx is not None and rx.ngroups == 1
+                and pat.startswith("(") and pat.endswith(")")
+                and _balanced_whole(pat))
+
+    def tag_for_device(self, conf=None):
+        rx, reason = self._compiled()
+        if reason:
+            return reason
+        if not self._device_group_ok():
+            return ("capture-group extraction beyond the whole match runs "
+                    "on the host")
+        return None
+
+    def kernel(self, ctx, c, p, i):
+        xp = ctx.xp
+        rx, _ = self._compiled()
+        if rx is None or not self._device_group_ok():
+            pat = _pyre.compile(self._pattern() or "")
+            gi = self.children[2].value if isinstance(self.children[2],
+                                                      Literal) else 1
+            out = []
+            for s in _host_rows(ctx, c):
+                if s is None:
+                    out.append(None)
+                    continue
+                m = pat.search(s)
+                out.append("" if not m or m.group(gi) is None
+                           else m.group(gi))
+            return _pack(ctx, out, valid_and(xp, c, p, i))
+        chosen, mlen = RX.dfa_match_spans(xp, rx, c.data, c.lengths)
+        start, ln, found = RX.first_match_span(xp, chosen, mlen, c.lengths)
+        width = c.data.shape[1]
+        chars, _ = S.gather_bytes(xp, c.data, start,
+                                  xp.where(found, ln, 0), width)
+        lens = xp.where(found, ln, 0).astype(xp.int32)
+        return DeviceColumn(T.STRING, chars, valid_and(xp, c, p, i),
+                            lengths=lens)
+
+
+def _balanced_whole(pat: str) -> bool:
+    """True if pat[0] '(' pairs with pat[-1] ')'."""
+    depth = 0
+    for k, ch in enumerate(pat):
+        if ch == "(" and (k == 0 or pat[k - 1] != "\\"):
+            depth += 1
+        elif ch == ")" and pat[k - 1] != "\\":
+            depth -= 1
+            if depth == 0:
+                return k == len(pat) - 1
+    return False
+
+
+class RegExpExtractAll(_RegexExpr):
+    """regexp_extract_all — host engine (array-of-groups output)."""
+
+    def __init__(self, subject, pattern, idx=1):
+        self.children = (resolve_expression(subject),
+                         resolve_expression(pattern),
+                         resolve_expression(idx))
+
+    def with_children(self, children):
+        return RegExpExtractAll(*children)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.STRING)
+
+    def tag_for_device(self, conf=None):
+        return "regexp_extract_all runs on the host engine"
+
+    def kernel(self, ctx, c, p, i):
+        xp = ctx.xp
+        pat = _pyre.compile(self._pattern() or "")
+        gi = self.children[2].value if isinstance(self.children[2], Literal) \
+            else 1
+        rows = []
+        for s in _host_rows(ctx, c):
+            if s is None:
+                rows.append(None)
+            else:
+                rows.append([m.group(gi) or "" for m in pat.finditer(s)])
+        return _strings_list_column(ctx, rows, valid_and(xp, c, p, i))
+
+
+def _strings_list_column(ctx, rows, validity):
+    """Host-built array<string> column in the device layout."""
+    xp = ctx.xp
+    n = len(rows)
+    w = bucket_width(max((len(r) for r in rows if r), default=0))
+    flat = []
+    for r in rows:
+        items = list(r) if r else []
+        flat.extend(items + [None] * (w - len(items)))
+    ev = np.array([x is not None for x in flat], dtype=bool)
+    sw = bucket_width(max((len(x.encode()) for x in flat if x is not None),
+                          default=1))
+    chars = np.zeros((n * w, sw), dtype=np.uint8)
+    lens = np.zeros(n * w, dtype=np.int32)
+    for k, x in enumerate(flat):
+        if x is None:
+            continue
+        b = x.encode()
+        chars[k, :len(b)] = np.frombuffer(b, np.uint8)
+        lens[k] = len(b)
+    elem = DeviceColumn(T.STRING, xp.asarray(chars), xp.asarray(ev),
+                        lengths=xp.asarray(lens))
+    lengths = xp.asarray(np.array(
+        [len(r) if r else 0 for r in rows], dtype=np.int32))
+    return make_array_column(T.ArrayType(T.STRING), lengths, (elem,),
+                             validity)
+
+
+class StringSplit(_RegexExpr):
+    """split(str, regex, limit).  Device path needs a pattern that cannot
+    match the empty string (Java's zero-width split rules are positional)."""
+
+    def __init__(self, subject, pattern, limit=-1):
+        self.children = (resolve_expression(subject),
+                         resolve_expression(pattern),
+                         resolve_expression(limit))
+
+    def with_children(self, children):
+        return StringSplit(*children)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.STRING)
+
+    def tag_for_device(self, conf=None):
+        rx, reason = self._compiled()
+        if reason:
+            return reason
+        if bool(rx.accept[rx.start]):
+            return ("patterns that can match the empty string run on the "
+                    "host (Java zero-width split rules)")
+        if not isinstance(self.children[2], Literal):
+            return "split limit must be a literal"
+        return None
+
+    def kernel(self, ctx, c, p, l):
+        xp = ctx.xp
+        rx, _ = self._compiled()
+        limit = self.children[2].value if isinstance(self.children[2],
+                                                     Literal) else -1
+        if rx is None or bool(rx.accept[rx.start]):
+            pat = _pyre.compile(self._pattern() or "")
+            rows = []
+            for s in _host_rows(ctx, c):
+                if s is None:
+                    rows.append(None)
+                    continue
+                parts = pat.split(s, maxsplit=0 if limit <= 0
+                                  else limit - 1)
+                if limit == 0:
+                    while len(parts) > 1 and parts[-1] == "":
+                        parts.pop()  # Java drops trailing empties
+                    if parts == [""] and s != "":
+                        parts = []
+                rows.append(parts)
+            return _strings_list_column(ctx, rows, valid_and(xp, c, p, l))
+
+        chosen, mlen = RX.dfa_match_spans(xp, rx, c.data, c.lengths)
+        width = c.data.shape[1]
+        cap = c.data.shape[0]
+        ns = width + 1
+        nmatch = xp.sum(chosen & (mlen > 0), axis=1).astype(xp.int32)
+        if limit > 0:
+            nmatch = xp.minimum(nmatch, limit - 1)
+        nparts = nmatch + 1
+        w_out = bucket_width(width + 1)
+        strip_trailing = (limit == 0)
+
+        # k-th match position via stable compaction of chosen flags
+        if xp.__name__ == "numpy":
+            order = np.argsort(~chosen, axis=1, kind="stable")
+        else:
+            order = xp.argsort(~chosen, axis=1, stable=True)
+        mpos = order[:, :w_out].astype(xp.int32)       # [cap, w_out]
+        if w_out > ns:
+            mpos = xp.pad(mpos, ((0, 0), (0, w_out - ns)))
+        mlen_k = xp.take_along_axis(mlen, xp.clip(mpos, 0, ns - 1),
+                                    axis=1)[:, :w_out]
+        k_idx = xp.arange(w_out, dtype=xp.int32)[None, :]
+        use = k_idx < nmatch[:, None]
+        # part k: [end of match k-1, start of match k) clamped to the string
+        end_k = xp.where(use, mpos, c.lengths[:, None])
+        prev_end = xp.concatenate(
+            [xp.zeros((cap, 1), xp.int32),
+             xp.where(use, mpos + mlen_k, c.lengths[:, None])[:, :-1]],
+            axis=1)
+        plen = xp.clip(end_k - prev_end, 0, width)
+        # one 3-D gather for every part's bytes
+        j = xp.arange(width, dtype=xp.int32)[None, None, :]
+        src = xp.clip(prev_end[:, :, None] + j, 0, width - 1)
+        expanded = xp.broadcast_to(c.data[:, None, :], (cap, w_out, width))
+        pc = xp.take_along_axis(expanded, src, axis=2)
+        pc = xp.where(j < plen[:, :, None], pc, 0).astype(xp.uint8)
+        if strip_trailing:
+            # Java limit==0: drop trailing empty parts (whole-result empties
+            # collapse to []); a no-match split keeps the one original part
+            nonempty = (plen > 0) & (k_idx < nparts[:, None])
+            last_ne = xp.max(xp.where(nonempty, k_idx,
+                                      xp.asarray(-1, xp.int32)), axis=1)
+            nparts = xp.where(nmatch == 0, nparts, last_ne + 1)
+        chars = pc.reshape(cap * w_out, width)
+        lens = plen.astype(xp.int32).reshape(cap * w_out)
+        ev = (k_idx < nparts[:, None]).reshape(cap * w_out)
+        elem = DeviceColumn(T.STRING, chars, ev, lengths=lens)
+        return make_array_column(T.ArrayType(T.STRING), nparts, (elem,),
+                                 valid_and(xp, c, p, l))
+
+
+class StringToMap(_RegexExpr):
+    """str_to_map(str, pairDelim, keyValueDelim) — host engine build over
+    Python re (the reference uses two device splits; our device split
+    composition lands with a later milestone)."""
+
+    def __init__(self, subject, pair_delim=",", kv_delim=":"):
+        self.children = (resolve_expression(subject),
+                         resolve_expression(pair_delim),
+                         resolve_expression(kv_delim))
+
+    def with_children(self, children):
+        return StringToMap(*children)
+
+    @property
+    def data_type(self):
+        return T.MapType(T.STRING, T.STRING)
+
+    def tag_for_device(self, conf=None):
+        return "str_to_map runs on the host engine"
+
+    def kernel(self, ctx, c, pd, kd):
+        xp = ctx.xp
+        pd_s = _lit_str(self.children[1]) or ","
+        kd_s = _lit_str(self.children[2]) or ":"
+        pd_re = _pyre.compile(pd_s)
+        kd_re = _pyre.compile(kd_s)
+        rows_k, rows_v = [], []
+        for s in _host_rows(ctx, c):
+            if s is None:
+                rows_k.append(None)
+                rows_v.append(None)
+                continue
+            ks, vs = [], []
+            for entry in pd_re.split(s):
+                kv = kd_re.split(entry, maxsplit=1)
+                ks.append(kv[0])
+                vs.append(kv[1] if len(kv) > 1 else None)
+            rows_k.append(ks)
+            rows_v.append(vs)
+        validity = valid_and(xp, c, pd, kd)
+        karr = _strings_list_column(ctx, rows_k, validity)
+        varr = _strings_list_column(ctx, rows_v, validity)
+        w = max(karr.array_width, varr.array_width)
+        karr = karr.with_array_width(w)
+        varr = varr.with_array_width(w)
+        return make_array_column(self.data_type, karr.lengths,
+                                 (karr.children[0], varr.children[0]),
+                                 validity)

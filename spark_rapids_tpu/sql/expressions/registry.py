@@ -16,6 +16,8 @@ from . import datetime as Dt
 from . import hashing as Hsh
 from . import math_fns as M
 from . import predicates as P
+from . import json_fns as J
+from . import regexp as Rx
 from . import strings as Str
 
 EXPRESSION_REGISTRY: Dict[str, Type[Expression]] = {}
@@ -52,6 +54,9 @@ _reg(Dt.Year, Dt.Month, Dt.DayOfMonth, Dt.DayOfWeek, Dt.WeekDay,
      Dt.FromUnixTime, Dt.ToUnixTimestamp, Dt.UnixTimestamp, Dt.GetTimestamp,
      Dt.FromUTCTimestamp)
 _reg(Hsh.Murmur3Hash, Hsh.XxHash64)
+_reg(J.GetJsonObject, J.JsonTuple, J.JsonToStructs, J.StructsToJson)
+_reg(Rx.RLike, Rx.RegExpReplace, Rx.RegExpExtract, Rx.RegExpExtractAll,
+     Rx.StringSplit, Rx.StringToMap)
 _reg(Col.Size, Col.GetArrayItem, Col.ElementAt, Col.ArrayContains,
      Col.ArrayPosition, Col.ArrayMin, Col.ArrayMax, Col.SortArray,
      Col.ArrayRepeat, Col.Sequence, Col.CreateArray, Col.ArrayDistinct,

@@ -207,6 +207,60 @@ def last(c, ignorenulls: bool = False):
     return Column(AG.Last(_c(c), ignorenulls))
 
 
+# --- regex (RegexParser.scala / stringFunctions.scala family) ---------------
+from .expressions import regexp as RXE  # noqa: E402
+
+
+def rlike(c, pattern: str):
+    return Column(RXE.RLike(_c(c), Literal(pattern)))
+
+
+def regexp_replace(c, pattern: str, replacement: str):
+    return Column(RXE.RegExpReplace(_c(c), Literal(pattern),
+                                    Literal(replacement)))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1):
+    return Column(RXE.RegExpExtract(_c(c), Literal(pattern), Literal(idx)))
+
+
+def regexp_extract_all(c, pattern: str, idx: int = 1):
+    return Column(RXE.RegExpExtractAll(_c(c), Literal(pattern),
+                                       Literal(idx)))
+
+
+def split(c, pattern: str, limit: int = -1):
+    return Column(RXE.StringSplit(_c(c), Literal(pattern), Literal(limit)))
+
+
+def str_to_map(c, pairDelim: str = ",", keyValueDelim: str = ":"):
+    return Column(RXE.StringToMap(_c(c), Literal(pairDelim),
+                                  Literal(keyValueDelim)))
+
+
+# --- JSON (GpuJsonToStructs / GpuGetJsonObject family) ----------------------
+from .expressions import json_fns as JF  # noqa: E402
+
+
+def get_json_object(c, path: str):
+    return Column(JF.GetJsonObject(_c(c), Literal(path)))
+
+
+def json_tuple(c, *fields):
+    return Column(JF.JsonTuple(_c(c), *[Literal(f) for f in fields]))
+
+
+def from_json(c, schema):
+    if isinstance(schema, str):
+        from .dataframe import _parse_type
+        schema = _parse_type(schema)
+    return Column(JF.JsonToStructs(_c(c), schema))
+
+
+def to_json(c):
+    return Column(JF.StructsToJson(_c(c)))
+
+
 # --- collections / structs / maps (collectionOperations.scala family) -------
 from .expressions import collections as CL  # noqa: E402
 
@@ -589,7 +643,6 @@ def replace(c, search, replacement):
                                     _lit_or_col(replacement)))
 
 
-regexp_replace = None  # installed by the regex module
 
 
 def translate(c, matching: str, replace_: str):

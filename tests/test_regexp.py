@@ -1,0 +1,244 @@
+"""Regex engine + expression tests — reference coverage model:
+RegularExpressionTranspilerSuite + integration_tests regexp_test.py.
+Oracle: Python re (for supported common patterns, Java and Python agree)."""
+
+import re as pyre
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.ops.regex_engine import (RegexUnsupported,
+                                               compile_regex)
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+STRS = ["hello world", "abc123def", "", "aaa", "2021-03-04",
+        "foo@bar.com", "x,y,,z", "   spaces   ", "MixedCASE99",
+        "tab\there", "dot.dot.dot", "a1b2c3d4"]
+
+
+def str_df(sess):
+    t = pa.table({"u": list(range(len(STRS))), "s": STRS})
+    return sess.create_dataframe(t), t
+
+
+def run_both(df, sort_col="u"):
+    sess = df._session
+    a = df.collect()
+    sess.conf.set("spark.rapids.sql.enabled", False)
+    try:
+        b = df.collect()
+    finally:
+        sess.conf.set("spark.rapids.sql.enabled", True)
+    assert a.to_pylist() == b.to_pylist(), "device/host mismatch"
+    return a
+
+
+@pytest.mark.parametrize("pat", [
+    r"\d+", r"[a-c]+", r"^a", r"o$", r"world", r"(foo|dot)", r"a{2,3}",
+    r"\w+@\w+\.\w+", r"\d{4}-\d{2}-\d{2}", r"\s+", r"[^,]+", r".",
+    r"(?:ab)+c?", r"[A-Z][a-z]+",
+])
+def test_rlike_matches_python_re(sess, pat):
+    df, t = str_df(sess)
+    out = run_both(df.select(df.u, F.rlike(df.s, pat).alias("m"))).to_pylist()
+    exp = [bool(pyre.search(pat, s)) for s in STRS]
+    assert [r["m"] for r in out] == exp, pat
+
+
+def test_rlike_runs_on_device(sess):
+    df, t = str_df(sess)
+    report = sess.explain(df.select(df.u, F.rlike(df.s, r"\d+").alias("m")))
+    assert "cannot run" not in report
+
+
+def test_unsupported_patterns_fall_back(sess):
+    df, t = str_df(sess)
+    for pat, frag in [(r"(a)\1", "backreference"),
+                      (r"a(?=b)", "group construct"),
+                      (r"a*?b", "lazy"),
+                      (r"\bword", "anchor")]:
+        q = df.select(df.u, F.rlike(df.s, pat).alias("m"))
+        report = sess.explain(q)
+        assert "cannot run on TPU" in report, pat
+        assert frag in report, (pat, report)
+
+
+@pytest.mark.parametrize("pat,rep", [
+    (r"\d+", "#"), (r"o", "0"), (r"\s+", "_"), (r"[aeiou]", ""),
+    (r"z*y", "Q"),
+])
+def test_regexp_replace(sess, pat, rep):
+    df, t = str_df(sess)
+    out = run_both(df.select(
+        df.u, F.regexp_replace(df.s, pat, rep).alias("r"))).to_pylist()
+    exp = [pyre.sub(pat, rep, s) for s in STRS]
+    assert [r["r"] for r in out] == exp, (pat, rep)
+
+
+def test_regexp_replace_group_ref_host(sess):
+    df, t = str_df(sess)
+    q = df.select(df.u,
+                  F.regexp_replace(df.s, r"(\d)", "[$1]").alias("r"))
+    assert "cannot run on TPU" in sess.explain(q)
+    out = run_both(q).to_pylist()
+    exp = [pyre.sub(r"(\d)", r"[\1]", s) for s in STRS]
+    assert [r["r"] for r in out] == exp
+
+
+def test_regexp_extract(sess):
+    df, t = str_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.regexp_extract(df.s, r"\d+", 0).alias("whole"),
+        F.regexp_extract(df.s, r"(\d+)", 1).alias("g1"),
+        F.regexp_extract(df.s, r"(\w+)@(\w+)", 2).alias("g2"),
+    )).to_pylist()
+    for r, s in zip(out, STRS):
+        m = pyre.search(r"\d+", s)
+        assert r["whole"] == (m.group(0) if m else "")
+        assert r["g1"] == (m.group(0) if m else "")
+        m2 = pyre.search(r"(\w+)@(\w+)", s)
+        assert r["g2"] == (m2.group(2) if m2 else "")
+
+
+def test_regexp_extract_all(sess):
+    df, t = str_df(sess)
+    out = run_both(df.select(
+        df.u, F.regexp_extract_all(df.s, r"(\d+)", 1).alias("all")
+    )).to_pylist()
+    exp = [pyre.findall(r"(\d+)", s) for s in STRS]
+    assert [r["all"] for r in out] == exp
+
+
+def test_split(sess):
+    df, t = str_df(sess)
+    out = run_both(df.select(
+        df.u, F.split(df.s, ",").alias("parts"),
+        F.split(df.s, r"\s+").alias("ws"),
+        F.split(df.s, ",", 2).alias("lim"),
+    )).to_pylist()
+    for r, s in zip(out, STRS):
+        assert r["parts"] == s.split(","), s
+        assert r["ws"] == pyre.split(r"\s+", s), s
+        assert r["lim"] == s.split(",", 1), s
+
+
+def test_split_device_placement(sess):
+    df, t = str_df(sess)
+    q = df.select(df.u, F.split(df.s, ",").alias("p"))
+    assert "cannot run" not in sess.explain(q)
+
+
+def test_str_to_map(sess):
+    t = pa.table({"u": [0, 1, 2],
+                  "s": ["a:1,b:2", "x:9", "novalue"]})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(df.u, F.str_to_map(df.s).alias("m"))).to_pylist()
+    assert dict(out[0]["m"]) == {"a": "1", "b": "2"}
+    assert dict(out[1]["m"]) == {"x": "9"}
+    assert dict(out[2]["m"]) == {"novalue": None}
+
+
+def test_split_then_explode(sess):
+    """regex split composes with explode downstream on the device."""
+    t = pa.table({"u": [0, 1], "s": ["a,b,c", "x,y"]})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(
+        df.u, F.explode(F.split(df.s, ",")).alias("part"))).to_pylist()
+    assert [r["part"] for r in out] == ["a", "b", "c", "x", "y"]
+
+
+def test_dfa_rejects_state_explosion():
+    with pytest.raises(RegexUnsupported):
+        # classic exponential-DFA pattern
+        compile_regex("(a|b)*a(a|b){15}")
+
+
+# --- JSON expressions (host-exact family) ----------------------------------
+
+def test_get_json_object(sess):
+    t = pa.table({"u": [0, 1, 2, 3],
+                  "j": ['{"a": {"b": [1, 2, 3]}, "s": "hi"}',
+                        '{"a": 5}', 'not json', None]})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(
+        df.u,
+        F.get_json_object(df.j, "$.a.b[1]").alias("ab1"),
+        F.get_json_object(df.j, "$.s").alias("s"),
+        F.get_json_object(df.j, "$.a").alias("a"),
+        F.get_json_object(df.j, "$.missing").alias("mi"),
+    )).to_pylist()
+    assert out[0]["ab1"] == "2"
+    assert out[0]["s"] == "hi"
+    assert out[0]["a"] == '{"b":[1,2,3]}'
+    assert out[0]["mi"] is None
+    assert out[1]["a"] == "5"
+    assert out[2]["ab1"] is None and out[3]["ab1"] is None
+
+
+def test_json_tuple(sess):
+    t = pa.table({"u": [0, 1], "j": ['{"k1": "v1", "k2": 7}', '{"k2": null}']})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(
+        df.u, F.json_tuple(df.j, "k1", "k2").alias("t"))).to_pylist()
+    assert out[0]["t"] == {"c0": "v1", "c1": "7"}
+    assert out[1]["t"] == {"c0": None, "c1": None}
+
+
+def test_from_json_to_json(sess):
+    import spark_rapids_tpu.types as T
+    t = pa.table({"u": [0, 1, 2],
+                  "j": ['{"x": 1, "y": "a", "zs": [1, 2]}',
+                        '{"x": 2}', 'bad']})
+    df = sess.create_dataframe(t)
+    schema = T.StructType((T.StructField("x", T.LONG, True),
+                           T.StructField("y", T.STRING, True),
+                           T.StructField("zs", T.ArrayType(T.LONG), True)))
+    q = df.select(df.u, F.from_json(df.j, schema).alias("st"))
+    out = run_both(q).to_pylist()
+    assert out[0]["st"] == {"x": 1, "y": "a", "zs": [1, 2]}
+    assert out[1]["st"]["x"] == 2 and out[1]["st"]["y"] is None
+    assert out[2]["st"] is None
+
+    q2 = q.select(q.u, F.to_json(F.col("st")).alias("back"))
+    out2 = run_both(q2).to_pylist()
+    assert out2[0]["back"] == '{"x":1,"y":"a","zs":[1,2]}'
+
+
+def test_split_limit_zero_java_semantics(sess):
+    t = pa.table({"u": [0, 1, 2, 3], "s": ["a,b,,", ",,", "", "a,b"]})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(df.u, F.split(df.s, ",", 0).alias("p"))
+                   ).to_pylist()
+    assert [r["p"] for r in out] == [["a", "b"], [], [""], ["a", "b"]]
+
+
+def test_regexp_replace_empty_match_no_truncation(sess):
+    t = pa.table({"u": [0], "s": ["bbbbbbbb"]})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(
+        df.u, F.regexp_replace(df.s, "z*", "Q").alias("r"))).to_pylist()
+    assert out[0]["r"] == "".join("Q" + ch for ch in "bbbbbbbb") + "Q"
+
+
+def test_negated_class_matches_nul_byte(sess):
+    t = pa.table({"u": [0], "s": ["a\x00b"]})
+    df = sess.create_dataframe(t)
+    out = run_both(df.select(
+        df.u, F.rlike(df.s, "a[^x]b").alias("m"))).to_pylist()
+    assert out[0]["m"] is True
+
+
+def test_malformed_counted_brace_falls_back():
+    with pytest.raises(RegexUnsupported):
+        compile_regex("a{-1}")
+    with pytest.raises(RegexUnsupported):
+        compile_regex("a{3,1}")
