@@ -1,0 +1,97 @@
+"""ICI shuffle data plane — the on-pod replacement for the reference's
+UCX peer-to-peer transfers (SURVEY §2.8 TPU-native note): rows move between
+chips INSIDE the compiled program via ``jax.lax.all_to_all`` over a device
+mesh, so the exchange rides ICI links with XLA-scheduled overlap instead of
+host round-trips.
+
+Mechanics (static shapes throughout):
+
+* each shard buckets its rows by target chip and packs them into a
+  ``[n_dev, quota]`` tile (quota = local capacity, the worst case of every
+  row routing to one target);
+* one tiled ``all_to_all`` flips the tile axis: row-block t of shard s
+  lands on shard t as block s;
+* the receiver compacts the ``n_dev * quota`` candidate rows (valid-mask
+  argsort) back into a single local batch.
+
+Works for any pytree of row-major arrays (1-D fixed columns, 2-D byte
+matrices), which is exactly the device column layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def build_ici_shuffle(mesh, axis_name: str, n_dev: int, quota: int):
+    """Returns a function usable inside shard_map:
+    (arrays: dict[str, [rows(,k)]], valid: [rows], pids: [rows]) ->
+    (arrays received, valid received) with capacity n_dev*quota."""
+    import jax
+    import jax.numpy as jnp
+
+    def exchange(arrays: Dict[str, "jnp.ndarray"], valid, pids):
+        rows = valid.shape[0]
+        if quota < rows:
+            # a hot bucket could overflow its tile and silently drop rows
+            raise ValueError(
+                f"ici shuffle quota {quota} < shard rows {rows}: a skewed "
+                "bucket would overflow; size quota to the shard capacity")
+        # rank rows within their target bucket (stable order); int64 key —
+        # int32 would overflow at large shard*device counts
+        order = jnp.argsort(
+            jnp.where(valid, pids, n_dev).astype(jnp.int64) * (rows + 1)
+            + jnp.arange(rows, dtype=jnp.int64), stable=True)
+        pids_s = pids[order]
+        valid_s = valid[order]
+        # position within bucket
+        same = jnp.concatenate(
+            [jnp.zeros(1, bool), pids_s[1:] == pids_s[:-1]])
+        seg_pos = jnp.arange(rows) - jax.lax.associative_scan(
+            jnp.maximum,
+            jnp.where(~same, jnp.arange(rows), -1))
+        # scatter each row into tile [n_dev, quota]
+        slot = jnp.where(valid_s & (seg_pos < quota),
+                         pids_s.astype(jnp.int32) * quota + seg_pos,
+                         n_dev * quota)  # trash slot
+
+        def pack(a):
+            a_s = a[order]
+            shape = (n_dev * quota + 1,) + a.shape[1:]
+            out = jnp.zeros(shape, dtype=a.dtype)
+            return out.at[slot].set(a_s)[:-1].reshape(
+                (n_dev, quota) + a.shape[1:])
+
+        tiles = {k: pack(a) for k, a in arrays.items()}
+        # NB: pack() permutes internally — feed the UNSORTED validity like
+        # every data array (valid_s here would be permuted twice)
+        vtile = pack(valid.astype(jnp.int8)).astype(bool)
+
+        recv = {k: jax.lax.all_to_all(t, axis_name, 0, 0, tiled=True)
+                for k, t in tiles.items()}
+        rvalid = jax.lax.all_to_all(vtile, axis_name, 0, 0, tiled=True)
+
+        out = {k: t.reshape((n_dev * quota,) + t.shape[2:])
+               for k, t in recv.items()}
+        return out, rvalid.reshape(n_dev * quota)
+
+    return exchange
+
+
+def ici_hash_shuffle_step(mesh, axis_name: str, n_dev: int):
+    """Builds the distributed query-shuffle step used by the multichip
+    dryrun: local partial state -> hash-routed all_to_all -> merge.  This
+    is the data-plane pattern every multi-chip exchange follows."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.hashing import murmur3_long
+
+    def route_targets(keys):
+        h = murmur3_long(jnp, keys.astype(jnp.int64), jnp.uint32(42))
+        t = h % np.int32(n_dev)
+        return jnp.where(t < 0, t + n_dev, t).astype(jnp.int32)
+
+    return route_targets

@@ -1,0 +1,9 @@
+from .serializer import (deserialize_batch, serialize_batch,
+                         concat_serialized)
+from .manager import ShuffleManager, get_shuffle_manager
+from .transport import (LocalTransport, ShuffleHeartbeatManager,
+                        ShuffleTransport)
+
+__all__ = ["serialize_batch", "deserialize_batch", "concat_serialized",
+           "ShuffleManager", "get_shuffle_manager", "ShuffleTransport",
+           "LocalTransport", "ShuffleHeartbeatManager"]

@@ -1,0 +1,211 @@
+"""Shuffle manager triad — the analog of
+``RapidsShuffleInternalManagerBase.scala:1046-1362`` + ``GpuShuffleEnv``
+(SURVEY §2.8): the same three operating modes as the reference, selected by
+``spark.rapids.shuffle.mode``:
+
+* SORT          — serialize to per-(map, reduce) files on disk via the spill
+                  directory (stock-Spark-shuffle analog); readers host-concat
+                  serialized tables before one device upload.
+* MULTITHREADED — same layout, but writer/reader fan out over thread pools
+                  (``RapidsShuffleThreadedWriter/Reader``).
+* ICI           — blocks stay in an in-memory buffer catalog
+                  (``ShuffleBufferCatalog``) and move through the transport
+                  SPI (device-direct/UCX analog; on-pod exchanges ride XLA
+                  collectives inside the compiled program instead).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from ..columnar.batch import ColumnarBatch
+from ..config import (RapidsConf, SHUFFLE_MODE, SHUFFLE_READER_THREADS,
+                      SHUFFLE_WRITER_THREADS, SPILL_DIR)
+from .serializer import concat_serialized, serialize_batch
+from .transport import (BlockId, LocalTransport, PeerInfo,
+                        ShuffleHeartbeatManager, ShuffleTransport)
+
+
+class ShuffleManager:
+    """One per 'executor'; local mode uses a single instance."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 transport: Optional[ShuffleTransport] = None,
+                 executor_id: str = "exec-0",
+                 heartbeats: Optional[ShuffleHeartbeatManager] = None):
+        self.conf = conf or RapidsConf.get_global()
+        self.mode = str(self.conf.get(SHUFFLE_MODE)).upper()
+        self.executor_id = executor_id
+        self.transport = transport or LocalTransport()
+        self.heartbeats = heartbeats or ShuffleHeartbeatManager()
+        self.peers = self.heartbeats.register(executor_id, "local")
+        self._next_shuffle = 0
+        self._lock = threading.Lock()
+        self._files: Dict[BlockId, str] = {}
+        self._writer_pool = ThreadPoolExecutor(
+            max_workers=int(self.conf.get(SHUFFLE_WRITER_THREADS)),
+            thread_name_prefix="shuffle-writer")
+        self._reader_pool = ThreadPoolExecutor(
+            max_workers=int(self.conf.get(SHUFFLE_READER_THREADS)),
+            thread_name_prefix="shuffle-reader")
+        base = str(self.conf.get(SPILL_DIR))
+        self._dir = os.path.join(base, f"shuffle-{uuid.uuid4().hex[:8]}")
+
+    # ------------------------------------------------------------------
+    def new_shuffle_id(self) -> int:
+        with self._lock:
+            self._next_shuffle += 1
+            return self._next_shuffle
+
+    # --- write side -----------------------------------------------------
+    def map_writer(self, shuffle_id: int, map_id: int) -> "MapTaskWriter":
+        """Streaming writer: serialize each split piece to host bytes the
+        moment it is produced (bounding device residency to one batch),
+        then commit the frames per reduce partition."""
+        return MapTaskWriter(self, shuffle_id, map_id)
+
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         pieces: List[Optional[ColumnarBatch]]) -> None:
+        """Convenience one-shot form of map_writer()."""
+        w = self.map_writer(shuffle_id, map_id)
+        for r, b in enumerate(pieces):
+            if b is not None and b.num_rows_int > 0:
+                w.add(r, b)
+        w.commit()
+
+    def _store_blob(self, block: BlockId, blob: bytes) -> None:
+        if self.mode == "ICI":
+            self.transport.publish(self.executor_id, block, blob)
+            return
+        os.makedirs(self._dir, exist_ok=True)
+        path = os.path.join(
+            self._dir,
+            f"s{block.shuffle_id}-m{block.map_id}-r{block.reduce_id}.bin")
+        with open(path, "wb") as fh:
+            fh.write(blob)
+        with self._lock:
+            self._files[block] = path
+
+    # --- read side ------------------------------------------------------
+    def read_reduce_partition(self, shuffle_id: int, num_maps: int,
+                              reduce_id: int) -> Optional[ColumnarBatch]:
+        blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
+
+        def read_one(block: BlockId) -> Optional[bytes]:
+            if self.mode == "ICI":
+                me = PeerInfo(self.executor_id, "local")
+                frame = self.transport.fetch(me, block)
+                if frame is None:
+                    for peer in self.heartbeats.heartbeat(self.executor_id):
+                        frame = self.transport.fetch(peer, block)
+                        if frame is not None:
+                            break
+                return frame
+            with self._lock:
+                path = self._files.get(block)
+            if path is None:
+                return None
+            with open(path, "rb") as fh:
+                return fh.read()
+
+        if self.mode == "MULTITHREADED" and len(blocks) > 1:
+            blobs = list(self._reader_pool.map(read_one, blocks))
+        else:
+            blobs = [read_one(b) for b in blocks]
+        frames = [f for blob in blobs if blob is not None
+                  for f in split_frames(blob)]
+        if not frames:
+            return None
+        return concat_serialized(frames)
+
+    # ------------------------------------------------------------------
+    def cleanup(self, shuffle_id: Optional[int] = None):
+        if isinstance(self.transport, LocalTransport):
+            self.transport.clear(shuffle_id)
+        with self._lock:
+            victims = [b for b in self._files
+                       if shuffle_id is None or b.shuffle_id == shuffle_id]
+            for b in victims:
+                try:
+                    os.unlink(self._files.pop(b))
+                except OSError:
+                    pass
+
+
+    def close(self) -> None:
+        """Release pools, transport blocks and shuffle files."""
+        self.cleanup()
+        self._writer_pool.shutdown(wait=False)
+        self._reader_pool.shutdown(wait=False)
+        self.transport.close()
+
+
+import struct as _struct
+
+
+def pack_frames(frames: List[bytes]) -> bytes:
+    """Length-prefixed frame stream: one blob may carry several serialized
+    batches (one per map-side input batch — the streaming writer's unit)."""
+    out = bytearray()
+    for f in frames:
+        out.extend(_struct.pack("<Q", len(f)))
+        out.extend(f)
+    return bytes(out)
+
+
+def split_frames(blob: bytes) -> List[bytes]:
+    frames = []
+    pos = 0
+    while pos < len(blob):
+        (n,) = _struct.unpack_from("<Q", blob, pos)
+        pos += 8
+        frames.append(blob[pos:pos + n])
+        pos += n
+    return frames
+
+
+class MapTaskWriter:
+    def __init__(self, mgr: ShuffleManager, shuffle_id: int, map_id: int):
+        self.mgr = mgr
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self._frames: Dict[int, List[bytes]] = {}
+        self._futures = []
+
+    def add(self, reduce_id: int, batch: ColumnarBatch) -> None:
+        def ser(b=batch):
+            return serialize_batch(b, self.mgr.conf)
+        if self.mgr.mode == "MULTITHREADED":
+            # serialization (D2H + compress) overlaps with the next split
+            fut = self.mgr._writer_pool.submit(ser)
+            self._futures.append((reduce_id, fut))
+        else:
+            self._frames.setdefault(reduce_id, []).append(ser())
+
+    def commit(self) -> None:
+        for reduce_id, fut in self._futures:
+            self._frames.setdefault(reduce_id, []).append(fut.result())
+        self._futures = []
+        for reduce_id, frames in self._frames.items():
+            block = BlockId(self.shuffle_id, self.map_id, reduce_id)
+            self.mgr._store_blob(block, pack_frames(frames))
+        self._frames = {}
+
+
+_global_manager: Optional[ShuffleManager] = None
+_global_lock = threading.Lock()
+
+
+def get_shuffle_manager(conf: Optional[RapidsConf] = None) -> ShuffleManager:
+    global _global_manager
+    with _global_lock:
+        mode = str((conf or RapidsConf.get_global()).get(SHUFFLE_MODE)).upper()
+        if _global_manager is None or _global_manager.mode != mode:
+            if _global_manager is not None:
+                _global_manager.close()
+            _global_manager = ShuffleManager(conf)
+        return _global_manager

@@ -1,0 +1,247 @@
+"""Columnar batch wire serializer — the analog of cuDF's
+``JCudfSerialization`` + ``GpuColumnarBatchSerializer.scala:82,170-180``
+(SURVEY §2.8 mode 1).
+
+Frame layout (little-endian):
+
+  magic 'TPUB' | version u16 | flags u16 | num_rows u32 | num_cols u32
+  | schema blob (json: names + type strings) u32-prefixed
+  | per column: validity bitmap, then layout-dependent buffers, each
+    u64-length-prefixed
+
+Buffers are written packed to live rows only (capacity padding is NOT
+shipped); the reader re-pads into a fresh capacity bucket.  Optional
+whole-frame compression (zstd) mirrors the reference's nvcomp codecs
+(``TableCompressionCodec.scala``)."""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import DeviceColumn, bucket_capacity, make_array_column
+
+_MAGIC = b"TPUB"
+_VERSION = 1
+
+_FLAG_ZSTD = 1
+
+
+def _codec(conf) -> str:
+    from ..config import SHUFFLE_COMPRESSION_CODEC, RapidsConf
+    conf = conf or RapidsConf.get_global()
+    c = str(conf.get(SHUFFLE_COMPRESSION_CODEC)).lower()
+    return "zstd" if c in ("zstd", "lz4hc", "lz4") else "none"
+
+
+def _write_buf(out: io.BytesIO, arr: Optional[np.ndarray]):
+    if arr is None:
+        out.write(struct.pack("<Q", 0xFFFFFFFFFFFFFFFF))
+        return
+    raw = np.ascontiguousarray(arr).tobytes()
+    out.write(struct.pack("<Q", len(raw)))
+    out.write(raw)
+
+
+def _read_buf(buf: memoryview, pos: int, dtype, shape
+              ) -> Tuple[Optional[np.ndarray], int]:
+    (n,) = struct.unpack_from("<Q", buf, pos)
+    pos += 8
+    if n == 0xFFFFFFFFFFFFFFFF:
+        return None, pos
+    arr = np.frombuffer(buf, dtype=dtype, count=n // np.dtype(dtype).itemsize,
+                        offset=pos).reshape(shape)
+    return arr, pos + n
+
+
+def _type_str(dt: T.DataType) -> str:
+    return dt.json_repr() if hasattr(dt, "json_repr") else dt.simple_string()
+
+
+def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
+                      meta: dict):
+    """Packed (live rows only) column write; meta collects shape info."""
+    validity = np.asarray(col.validity)[:n] if col.validity is not None \
+        else np.ones(n, dtype=bool)
+    _write_buf(out, np.packbits(validity, bitorder="little"))
+    if col.is_array_like:
+        w = col.array_width
+        meta["w"] = w
+        _write_buf(out, np.asarray(col.lengths)[:n].astype(np.int32))
+        kids = []
+        for ch in col.children:
+            km: dict = {}
+            _serialize_column(out, ch, n * w, km)
+            kids.append(km)
+        meta["children"] = kids
+        return
+    if col.data is None:  # struct
+        kids = []
+        for ch in col.children:
+            km = {}
+            _serialize_column(out, ch, n, km)
+            kids.append(km)
+        meta["children"] = kids
+        return
+    data = np.asarray(col.data)[:n]
+    if data.ndim == 2:
+        meta["sw"] = int(data.shape[1])
+    _write_buf(out, data)
+    _write_buf(out, np.asarray(col.lengths)[:n].astype(np.int32)
+               if col.lengths is not None else None)
+    _write_buf(out, np.asarray(col.aux)[:n] if col.aux is not None else None)
+
+
+def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
+    n = batch.num_rows_int
+    body = io.BytesIO()
+    metas = []
+    for col in batch.columns:
+        m: dict = {}
+        _serialize_column(body, col, n, m)
+        metas.append(m)
+    schema = {
+        "names": list(batch.names),
+        "metas": metas,
+        "specs": [_spec_of(c.dtype) for c in batch.columns],
+    }
+    sj = json.dumps(schema).encode()
+    payload = body.getvalue()
+    flags = 0
+    raw = sj + payload
+    if _codec(conf) == "zstd":
+        import zstandard
+        raw = zstandard.ZstdCompressor(level=1).compress(raw)
+        flags |= _FLAG_ZSTD
+    head = struct.pack("<4sHHII", _MAGIC, _VERSION, flags, n,
+                       batch.num_cols)
+    return head + struct.pack("<I", len(sj)) + raw
+
+
+def _spec_of(dt: T.DataType):
+    if isinstance(dt, T.ArrayType):
+        return {"k": "array", "e": _spec_of(dt.element_type)}
+    if isinstance(dt, T.MapType):
+        return {"k": "map", "key": _spec_of(dt.key_type),
+                "v": _spec_of(dt.value_type)}
+    if isinstance(dt, T.StructType):
+        return {"k": "struct",
+                "fields": [[f.name, _spec_of(f.data_type)]
+                           for f in dt.fields]}
+    if isinstance(dt, T.DecimalType):
+        return {"k": "decimal", "p": dt.precision, "s": dt.scale}
+    return {"k": type(dt).__name__}
+
+
+_SIMPLE = {c.__name__: c for c in (
+    T.BooleanType, T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+    T.FloatType, T.DoubleType, T.StringType, T.BinaryType, T.DateType,
+    T.TimestampType, T.NullType)}
+
+
+def _spec_to_type(spec) -> T.DataType:
+    k = spec["k"]
+    if k == "array":
+        return T.ArrayType(_spec_to_type(spec["e"]))
+    if k == "map":
+        return T.MapType(_spec_to_type(spec["key"]), _spec_to_type(spec["v"]))
+    if k == "struct":
+        return T.StructType(tuple(
+            T.StructField(n, _spec_to_type(s), True)
+            for n, s in spec["fields"]))
+    if k == "decimal":
+        return T.DecimalType(spec["p"], spec["s"])
+    return _SIMPLE[k]()
+
+
+def _deserialize_column(buf: memoryview, pos: int, dt: T.DataType, n: int,
+                        cap: int, meta: dict) -> Tuple[DeviceColumn, int]:
+    # host (numpy) buffers: the device upload happens naturally when a
+    # jitted exec traces the batch (jnp.asarray on trace), so host-side
+    # consumers never see device arrays
+    bits, pos = _read_buf(buf, pos, np.uint8, (-1,))
+    validity = np.zeros(cap, dtype=bool)
+    if n:
+        validity[:n] = np.unpackbits(bits, count=n, bitorder="little") \
+            .astype(bool)
+    v = validity
+    if isinstance(dt, (T.ArrayType, T.MapType)):
+        w = meta["w"]
+        lens_np, pos = _read_buf(buf, pos, np.int32, (-1,))
+        lens = np.zeros(cap, dtype=np.int32)
+        lens[:n] = lens_np
+        kids = []
+        child_types = [dt.element_type] if isinstance(dt, T.ArrayType) else \
+            [dt.key_type, dt.value_type]
+        for ct, km in zip(child_types, meta["children"]):
+            ch, pos = _deserialize_column(buf, pos, ct, n * w, cap * w, km)
+            kids.append(ch)
+        return make_array_column(dt, lens, tuple(kids), v), pos
+    if isinstance(dt, T.StructType):
+        kids = []
+        for f, km in zip(dt.fields, meta["children"]):
+            ch, pos = _deserialize_column(buf, pos, f.data_type, n, cap, km)
+            kids.append(ch)
+        return DeviceColumn(dt, None, v, children=tuple(kids)), pos
+    sw = meta.get("sw")
+    if sw is not None:
+        data_np, pos = _read_buf(buf, pos, np.uint8, (n, sw))
+        data = np.zeros((cap, sw), dtype=np.uint8)
+        data[:n] = data_np
+    else:
+        np_dtype = dt.np_dtype if dt.np_dtype is not None else np.int8
+        data_np, pos = _read_buf(buf, pos, np_dtype, (-1,))
+        data = np.zeros(cap, dtype=np_dtype)
+        data[:n] = data_np[:n] if data_np is not None else 0
+    lens_np, pos = _read_buf(buf, pos, np.int32, (-1,))
+    lengths = None
+    if lens_np is not None:
+        lengths = np.zeros(cap, dtype=np.int32)
+        lengths[:n] = lens_np
+    aux_np, pos = _read_buf(buf, pos, np.int64, (-1,))
+    aux = None
+    if aux_np is not None:
+        aux = np.zeros(cap, dtype=np.int64)
+        aux[:n] = aux_np
+    return DeviceColumn(dt, data, v, lengths, aux), pos
+
+
+def deserialize_batch(frame: bytes, capacity: Optional[int] = None
+                     ) -> ColumnarBatch:
+    head = struct.unpack_from("<4sHHII", frame, 0)
+    if head[0] != _MAGIC:
+        raise ValueError("bad shuffle frame magic")
+    flags, n, ncols = head[2], head[3], head[4]
+    (sj_len,) = struct.unpack_from("<I", frame, 16)
+    raw = frame[20:]
+    if flags & _FLAG_ZSTD:
+        import zstandard
+        raw = zstandard.ZstdDecompressor().decompress(raw)
+    schema = json.loads(raw[:sj_len])
+    buf = memoryview(raw)[sj_len:]
+    cap = capacity or bucket_capacity(n)
+    cols = []
+    pos = 0
+    for spec, meta in zip(schema["specs"], schema["metas"]):
+        dt = _spec_to_type(spec)
+        col, pos = _deserialize_column(buf, pos, dt, n, cap, meta)
+        cols.append(col)
+    return ColumnarBatch.make(tuple(schema["names"]), cols, n)
+
+
+def concat_serialized(frames: Sequence[bytes]) -> Optional[ColumnarBatch]:
+    """Host-side concat of serialized tables before one device upload
+    (``GpuShuffleCoalesceExec.scala:36-56`` analog)."""
+    batches = [deserialize_batch(f) for f in frames]
+    batches = [b for b in batches if b.num_rows_int > 0]
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    return ColumnarBatch.concat(batches)

@@ -1,0 +1,124 @@
+"""Shuffle transport SPI + peer discovery — the analog of
+``RapidsShuffleTransport`` (SPI, reflective load), ``RapidsShuffleClient/
+Server``, and ``RapidsShuffleHeartbeatManager`` (driver RPC peer registry);
+SURVEY §2.8 mode 3.
+
+The reference moves device buffers executor-to-executor over UCX/RDMA with
+flatbuffers metadata.  The TPU-native equivalents:
+
+* intra-slice exchanges ride ICI via XLA collectives (parallel/shuffle.py —
+  the data plane is *inside* the compiled program, which is the idiomatic
+  TPU answer to peer-to-peer device copies);
+* cross-process fetches go through this SPI; ``LocalTransport`` is the
+  in-process implementation (and the mock seam for tests, matching the
+  reference's transport-mock unit-test strategy
+  ``RapidsShuffleClientSuite.scala:449``)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class BlockId:
+    """(shuffle, map task, reduce partition) — wire metadata key, the
+    TableMeta/flatbuffers analog."""
+    shuffle_id: int
+    map_id: int
+    reduce_id: int
+
+
+@dataclass
+class PeerInfo:
+    executor_id: str
+    endpoint: str        # opaque address (host:port for a real transport)
+    last_heartbeat: float = 0.0
+
+
+class ShuffleTransport:
+    """SPI: how serialized shuffle blocks move between executors."""
+
+    def publish(self, executor_id: str, block: BlockId, frame: bytes) -> None:
+        raise NotImplementedError
+
+    def fetch(self, peer: PeerInfo, block: BlockId) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def fetch_many(self, peer: PeerInfo, blocks: List[BlockId]
+                   ) -> List[Optional[bytes]]:
+        return [self.fetch(peer, b) for b in blocks]
+
+    def close(self) -> None:
+        pass
+
+
+class LocalTransport(ShuffleTransport):
+    """In-process transport: one store shared by all 'executors' of a local
+    session.  Doubles as the unit-test seam (inject fetch failures etc.)."""
+
+    def __init__(self):
+        self._store: Dict[Tuple[str, BlockId], bytes] = {}
+        self._lock = threading.Lock()
+        self.fetch_hook: Optional[Callable[[PeerInfo, BlockId],
+                                           Optional[bytes]]] = None
+
+    def publish(self, executor_id: str, block: BlockId, frame: bytes) -> None:
+        with self._lock:
+            self._store[(executor_id, block)] = frame
+
+    def fetch(self, peer: PeerInfo, block: BlockId) -> Optional[bytes]:
+        if self.fetch_hook is not None:
+            hooked = self.fetch_hook(peer, block)
+            if hooked is not None:
+                return hooked
+        with self._lock:
+            return self._store.get((peer.executor_id, block))
+
+    def blocks_of(self, executor_id: str) -> List[BlockId]:
+        with self._lock:
+            return [b for (e, b) in self._store if e == executor_id]
+
+    def clear(self, shuffle_id: Optional[int] = None):
+        with self._lock:
+            if shuffle_id is None:
+                self._store.clear()
+            else:
+                for k in [k for k in self._store
+                          if k[1].shuffle_id == shuffle_id]:
+                    del self._store[k]
+
+
+class ShuffleHeartbeatManager:
+    """Driver-side peer registry: executors register + heartbeat, receive
+    the current peer set (``RapidsShuffleHeartbeatManager.scala:255`` +
+    driver RPC receive ``Plugin.scala:290-301``)."""
+
+    def __init__(self, heartbeat_timeout_s: float = 60.0):
+        self._peers: Dict[str, PeerInfo] = {}
+        self._lock = threading.Lock()
+        self._timeout = heartbeat_timeout_s
+
+    def register(self, executor_id: str, endpoint: str) -> List[PeerInfo]:
+        with self._lock:
+            info = PeerInfo(executor_id, endpoint, time.monotonic())
+            self._peers[executor_id] = info
+            return [p for e, p in self._peers.items() if e != executor_id]
+
+    def heartbeat(self, executor_id: str) -> List[PeerInfo]:
+        with self._lock:
+            now = time.monotonic()
+            if executor_id in self._peers:
+                self._peers[executor_id].last_heartbeat = now
+            # expire dead peers so fetches fail fast and retry elsewhere
+            dead = [e for e, p in self._peers.items()
+                    if now - p.last_heartbeat > self._timeout]
+            for e in dead:
+                del self._peers[e]
+            return [p for e, p in self._peers.items() if e != executor_id]
+
+    def executors(self) -> List[str]:
+        with self._lock:
+            return list(self._peers)

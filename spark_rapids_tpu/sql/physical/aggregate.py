@@ -290,10 +290,13 @@ class HashAggregateExec(PhysicalPlan):
                 self.parts = []
 
         def merge_group(g: "_Group"):
+            # NB: a single batch still needs the merge pass — a shuffled
+            # batch is a host-concat of several maps' partial rows with
+            # duplicate keys (merging already-merged groups is idempotent)
             batches = [p.get() for p in g.parts]
-            if len(batches) == 1:
-                return batches[0]
-            return self._merge_fn(ColumnarBatch.concat(batches))
+            merged = batches[0] if len(batches) == 1 else \
+                ColumnarBatch.concat(batches)
+            return self._merge_fn(merged)
 
         def split_group(g: "_Group"):
             if len(g.parts) >= 2:
@@ -306,7 +309,9 @@ class HashAggregateExec(PhysicalPlan):
             return out
 
         level = list(spillables)
-        while len(level) > 1:
+        needs_pass = True  # even one batch may hold unmerged duplicate keys
+        while len(level) > 1 or needs_pass:
+            needs_pass = False
             groups = [_Group(level[i:i + fanin])
                       for i in range(0, len(level), fanin)]
             level = [SpillableColumnarBatch.create(out, ACTIVE_BATCHING_PRIORITY)

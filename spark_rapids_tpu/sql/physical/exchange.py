@@ -53,26 +53,42 @@ class ShuffleExchangeExec(PhysicalPlan):
 
     # --- materialization --------------------------------------------------
     def _ensure_materialized(self, tctx: TaskContext):
+        """Map side: split each child batch by target and hand the pieces to
+        the shuffle manager (serializer + SORT/MULTITHREADED/ICI data
+        plane); reduce side then fetches + host-concats per partition
+        (SURVEY §3.4 write/read paths)."""
         if self._materialized is not None:
             return
+        from ...shuffle import get_shuffle_manager
         child = self.children[0]
         nt = self.num_partitions()
-        out: List[List[ColumnarBatch]] = [[] for _ in range(nt)]
+        mgr = get_shuffle_manager(tctx.conf)
+        shuffle_id = mgr.new_shuffle_id()
 
         if isinstance(self.partitioning, RangePartitioning):
             self._compute_range_bounds(tctx)
 
-        for cpid in range(child.num_partitions()):
-            for batch in child.execute(cpid, TaskContext(cpid, tctx.conf)):
-                ctx = EvalContext(batch, xp=self.xp)
-                pids = self.partitioning.partition_ids(ctx, batch, cpid)
-                if nt == 1:
-                    out[0].append(batch)
-                    continue
-                for t in range(nt):
-                    piece = self._split_fn(batch, pids, t)
-                    if piece.num_rows_int > 0:
-                        out[t].append(piece)
+        num_maps = child.num_partitions()
+        for cpid in range(num_maps):
+            map_batches = list(child.execute(cpid,
+                                             TaskContext(cpid, tctx.conf)))
+            if not map_batches:
+                continue
+            merged = ColumnarBatch.concat(map_batches) \
+                if len(map_batches) > 1 else map_batches[0]
+            if nt == 1:
+                pieces: List[Optional[ColumnarBatch]] = [merged]
+            else:
+                ctx = EvalContext(merged, xp=self.xp)
+                pids = self.partitioning.partition_ids(ctx, merged, cpid)
+                pieces = [self._split_fn(merged, pids, t) for t in range(nt)]
+            mgr.write_map_output(shuffle_id, cpid, pieces)
+
+        out: List[List[ColumnarBatch]] = []
+        for t in range(nt):
+            got = mgr.read_reduce_partition(shuffle_id, num_maps, t)
+            out.append([got] if got is not None else [])
+        mgr.cleanup(shuffle_id)
         self._materialized = out
 
     def _compute_range_bounds(self, tctx: TaskContext):
