@@ -93,6 +93,16 @@ class ColumnarBatch:
         cols = tuple(c.slice_capacity(new_capacity) for c in self.columns)
         return ColumnarBatch(self.names, cols, self.num_rows)
 
+    def shrunk(self) -> "ColumnarBatch":
+        """Drop excess capacity padding down to the row count's bucket.
+        Host-side decision (syncs on num_rows); call at exec boundaries
+        where the live row count can collapse (post-agg, post-split) so
+        downstream kernels/serializers don't chew dead padding."""
+        cap = bucket_capacity(self.num_rows_int)
+        if cap >= self.capacity:
+            return self
+        return self.repadded(cap)
+
     def sliced(self, start: int, length: int) -> "ColumnarBatch":
         """Host-side slice: returns a batch viewing rows [start, start+len).
         Implemented as a gather so the result is bucket-padded."""

@@ -96,6 +96,12 @@ CONCURRENT_TASKS = register(
 TIERED_PROJECT = register(
     "spark.rapids.sql.tiered.project.enabled",
     "Dedup common subexpressions via tiered projection.", True)
+FUSION_ENABLED = register(
+    "spark.rapids.tpu.sql.fusion.enabled",
+    "Fuse filter/project chains (and their terminal hash aggregate) into "
+    "one compiled XLA program per pipeline stage — whole-stage codegen, "
+    "the TPU analog of the reference's tiered projection + kernel reuse "
+    "(basicPhysicalOperators.scala:500, SURVEY §3.3).", True)
 IMPROVED_FLOAT = register(
     "spark.rapids.sql.improvedFloatOps.enabled",
     "Allow float ops whose results may differ from CPU in ULPs.", True)

@@ -1,0 +1,128 @@
+"""Exact hash-based group ids — the TPU-native replacement for cuDF's hash
+groupby (reference ``Table.groupBy`` device hash tables; SURVEY §2.10) on
+the path where we previously used sort-based dense ranks.
+
+Group-by does not need *ordered* ranks, only exact ids with
+``equal keys ⇔ equal id``.  A sort costs O(n log n) with a big constant in
+XLA; this kernel is O(n) per probe round:
+
+1. mix all key words into a 32-bit hash per row (murmur3-style);
+2. leader election into a power-of-two table of 2×capacity slots:
+   unresolved rows scatter-min their row index into ``table[slot]``;
+3. every row compares its full key (all key words — exact, not hashed)
+   against the slot owner's; equal rows adopt the owner as their group
+   representative, the rest linear-probe the next slot (``lax.while_loop``);
+   same-key rows always move in lockstep, so each key resolves exactly once.
+4. representatives get dense ids by cumsum over the row order
+   (first-occurrence order, deterministic).
+
+Dead (padding) rows get id == capacity: XLA drops out-of-bounds scatters,
+and every caller masks their contributions.
+
+The numpy backend keeps the independent sort-based path (ops/ranks.py), so
+host-vs-device comparisons exercise two different grouping algorithms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..columnar.column import DeviceColumn
+from .ranks import column_sort_keys, dense_rank_columns
+
+
+def _hash_words(jnp, keys):
+    """murmur3-style mix of the rows' key words into uint32."""
+    h = jnp.full(keys[0].shape[0], np.uint32(0x9747b28c), dtype=jnp.uint32)
+    for k in keys:
+        words = [k.astype(jnp.uint32)]
+        if k.dtype.itemsize == 8:
+            words.append((k >> 32).astype(jnp.uint32))
+        for w in words:
+            w = w * np.uint32(0xcc9e2d51)
+            w = (w << 15) | (w >> 17)
+            w = w * np.uint32(0x1b873593)
+            h = h ^ w
+            h = (h << 13) | (h >> 19)
+            h = h * np.uint32(5) + np.uint32(0xe6546b64)
+    h = h ^ (h >> 16)
+    h = h * np.uint32(0x85ebca6b)
+    h = h ^ (h >> 13)
+    return h
+
+
+def group_ids(xp, cols, row_mask):
+    """int64[cap] exact group ids over the key columns.
+
+    Live rows with equal keys (nulls equal nulls, Spark semantics — the
+    validity word is part of the key) share one id; ids are dense in
+    ``[0, n_groups)`` in first-occurrence order on BOTH backends (so host
+    and device agree on group order bit-for-bit).  Dead rows get
+    id == cap - 1, which is provably unused by live groups whenever dead
+    rows exist (n_groups <= cap - n_dead).
+    """
+    keys = []
+    for c in cols:
+        keys.append((~c.validity).astype(xp.int64))
+        keys.extend(column_sort_keys(xp, c))
+    cap_n = int(row_mask.shape[0])
+    if xp.__name__ == "numpy":
+        # independent sort-based host path, remapped from sorted-key order
+        # to the same first-occurrence order the device hash table produces
+        rank = dense_rank_columns(xp, cols, row_mask)
+        row_idx = np.arange(cap_n, dtype=np.int64)
+        first_row = np.full(cap_n, cap_n, dtype=np.int64)
+        live = np.asarray(row_mask)
+        np.minimum.at(first_row, rank[live], row_idx[live])
+        order = np.argsort(first_row, kind="stable")
+        remap = np.empty(cap_n, dtype=np.int64)
+        remap[order] = np.arange(cap_n, dtype=np.int64)
+        ids = remap[rank]
+        return np.where(live, ids, cap_n - 1)
+    import jax
+    import jax.numpy as jnp
+
+    cap = int(row_mask.shape[0])
+    M = 1 << (max(2 * cap, 16) - 1).bit_length()
+    mask_m = np.uint32(M - 1)
+    h = _hash_words(jnp, keys)
+    row_idx = jnp.arange(cap, dtype=jnp.int32)
+    sentinel = jnp.asarray(cap, dtype=jnp.int32)
+    # one [cap, k] matrix so the per-round owner compare is a single
+    # row gather instead of k scattered 1-D gathers
+    key_mat = jnp.stack(keys, axis=1)
+
+    def cond(state):
+        _table, rep, off, rounds = state
+        return jnp.any(rep < 0) & (rounds < M)
+
+    def body(state):
+        table, rep, off, rounds = state
+        unresolved = rep < 0
+        slot = ((h + off) & mask_m).astype(jnp.int32)
+        cand = jnp.where(unresolved, row_idx, sentinel)
+        table = table.at[slot].min(cand)
+        owner = table[slot]
+        safe_owner = jnp.clip(owner, 0, cap - 1)
+        eq = (owner < cap) & jnp.all(key_mat == key_mat[safe_owner], axis=1)
+        newly = unresolved & eq
+        rep = jnp.where(newly, owner, rep)
+        off = jnp.where(unresolved & ~eq, off + np.uint32(1), off)
+        return table, rep, off, rounds + 1
+
+    table0 = jnp.full(M, cap, dtype=jnp.int32)
+    # dead rows resolve to themselves immediately (masked out by callers)
+    rep0 = jnp.where(row_mask, -1, row_idx)
+    off0 = jnp.zeros(cap, dtype=jnp.uint32)
+    _table, rep, _off, _r = jax.lax.while_loop(
+        cond, body, (table0, rep0, off0, jnp.asarray(0, dtype=jnp.int32)))
+
+    # defensive: the M-round bound guarantees resolution (a cohort visits
+    # every slot within M probes); if that invariant ever broke, making the
+    # row its own group keeps results mergeable instead of corrupting them
+    rep = jnp.where(rep < 0, row_idx, rep)
+
+    is_rep = row_mask & (rep == row_idx)
+    dense = jnp.cumsum(is_rep.astype(jnp.int64)) - 1
+    ids = dense[jnp.clip(rep, 0, cap - 1)]
+    return jnp.where(row_mask, ids, cap - 1)

@@ -56,9 +56,21 @@ def concat_full_columns(xp, a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
 
 
 def compact_indices(xp, flags):
-    """int32 indices of True flags, compacted to the front (stable)."""
-    perm = stable_argsort(xp, (~flags).astype(xp.int8))
-    return perm.astype(xp.int32)
+    """int32 indices of True flags, compacted to the front (stable); False
+    flags' indices follow, also in order.  O(n) cumsum + scatter instead of
+    an argsort — the compaction primitive behind filter, split, and join
+    assembly (cuDF ``apply_boolean_mask`` analog)."""
+    n = flags.shape[0]
+    idx = xp.arange(n, dtype=xp.int32)
+    kept_pos = xp.cumsum(flags.astype(xp.int32))
+    n_keep = kept_pos[-1] if n else xp.asarray(0, dtype=xp.int32)
+    dead_pos = xp.cumsum((~flags).astype(xp.int32))
+    dest = xp.where(flags, kept_pos - 1, n_keep + dead_pos - 1)
+    if xp.__name__ == "numpy":
+        out = np.empty(n, dtype=np.int32)
+        out[dest] = idx
+        return out
+    return xp.zeros(n, dtype=xp.int32).at[dest].set(idx)
 
 
 class JoinInfo(NamedTuple):
@@ -94,7 +106,8 @@ def join_build(xp, lkeys: Sequence[DeviceColumn], rkeys: Sequence[DeviceColumn],
     rcap = rmask.shape[0]
     combined = [concat_full_columns(xp, a, b) for a, b in zip(lkeys, rkeys)]
     mask = xp.concatenate([lmask, rmask])
-    rank = dense_rank_columns(xp, combined, mask)
+    from .hash_group import group_ids
+    rank = group_ids(xp, combined, mask)
     if null_safe:
         lrank = _sentinel_ranks(xp, rank[:lcap], [], lmask, -1)
         rrank = _sentinel_ranks(xp, rank[lcap:], [], rmask, -2)

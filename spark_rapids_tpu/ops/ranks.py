@@ -33,29 +33,53 @@ def _apply_perm(xp, perm, *arrays):
     return tuple(a[perm] for a in arrays)
 
 
+def lex_sort(xp, keys):
+    """ONE stable lexicographic sort over multiple key arrays
+    (most-significant first).  Returns (perm, sorted_keys).
+
+    This is the workhorse primitive: XLA's variadic ``lax.sort`` compares
+    whole key tuples in a single fused sort pass (``num_keys``), so a k-key
+    sort costs one O(n log n) pass instead of k chained argsorts — the
+    difference between beating and trailing a host engine on group-by/sort
+    heavy queries.  numpy path uses the equivalent ``np.lexsort``.
+    """
+    keys = list(keys)
+    if xp.__name__ == "numpy":
+        perm = np.lexsort(tuple(reversed(keys)))  # lexsort: LAST key primary
+        return perm, [k[perm] for k in keys]
+    import jax
+    n = keys[0].shape[0]
+    iota = xp.arange(n, dtype=xp.int32)
+    out = jax.lax.sort(tuple(keys) + (iota,), num_keys=len(keys),
+                       is_stable=True)
+    return out[-1], list(out[:-1])
+
+
 def dense_rank_from_sorted(xp, sorted_boundary_flags):
     """Given boundary flags in sorted order (True at the first row of each
     distinct key), returns 0-based dense ranks in sorted order."""
     return xp.cumsum(sorted_boundary_flags.astype(xp.int64)) - 1
 
 
-def dense_rank_pairs(xp, a, b):
-    """Dense rank of lexicographic (a, b) pairs.  a, b int64 arrays.
-    Returns (rank, num_distinct_upper_bound_unused)."""
-    n = a.shape[0]
-    p1 = stable_argsort(xp, b)
-    a1, b1 = _apply_perm(xp, p1, a, b)
-    p2 = stable_argsort(xp, a1)
-    perm = p1[p2]
-    a2, b2 = a1[p2], b1[p2]
-    first = xp.concatenate([xp.ones((1,), dtype=bool),
-                            (a2[1:] != a2[:-1]) | (b2[1:] != b2[:-1])])
+def _ranks_from_lex(xp, perm, sorted_keys):
+    """Dense ranks (unsorted order) from a lex_sort result."""
+    n = perm.shape[0]
+    diff = xp.zeros((n - 1,), dtype=bool) if n > 1 else xp.zeros((0,), dtype=bool)
+    for k in sorted_keys:
+        diff = diff | (k[1:] != k[:-1])
+    first = xp.concatenate([xp.ones((1,), dtype=bool), diff])
     ranks_sorted = dense_rank_from_sorted(xp, first)
     out = xp.zeros((n,), dtype=xp.int64)
     if xp.__name__ == "numpy":
         out[perm] = ranks_sorted
         return out
     return out.at[perm].set(ranks_sorted)
+
+
+def dense_rank_pairs(xp, a, b):
+    """Dense rank of lexicographic (a, b) pairs.  a, b int64 arrays."""
+    perm, sorted_keys = lex_sort(xp, [a, b])
+    return _ranks_from_lex(xp, perm, sorted_keys)
 
 
 def _float_orderable_bits(xp, x, bits_dtype, canonical_nan):
@@ -138,7 +162,8 @@ def dense_rank_columns(xp, cols, num_rows_mask=None):
     for c in cols:
         keys.append((~c.validity).astype(xp.int64))
         keys.extend(column_sort_keys(xp, c))
-    rank = keys[0]
-    for k in keys[1:]:
-        rank = dense_rank_pairs(xp, rank, k)
-    return rank
+    if len(keys) == 1 and num_rows_mask is not None:
+        # no key columns: mask is the only key (0 live / 1 dead)
+        return keys[0]
+    perm, sorted_keys = lex_sort(xp, keys)
+    return _ranks_from_lex(xp, perm, sorted_keys)

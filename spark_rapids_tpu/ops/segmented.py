@@ -32,6 +32,32 @@ def seg_max(xp, data, seg_ids, num_segments, init):
     return out.at[seg_ids].max(data)
 
 
+def seg_sum2(xp, data2, seg_ids, num_segments):
+    """Batched segmented sum: one scatter-add for a [n, s] slot matrix
+    (s slots reduced in a single kernel pass)."""
+    out = xp.zeros((num_segments, data2.shape[1]), dtype=data2.dtype)
+    if xp.__name__ == "numpy":
+        np.add.at(out, seg_ids, data2)
+        return out
+    return out.at[seg_ids].add(data2)
+
+
+def seg_min2(xp, data2, seg_ids, num_segments, init):
+    out = xp.full((num_segments, data2.shape[1]), init, dtype=data2.dtype)
+    if xp.__name__ == "numpy":
+        np.minimum.at(out, seg_ids, data2)
+        return out
+    return out.at[seg_ids].min(data2)
+
+
+def seg_max2(xp, data2, seg_ids, num_segments, init):
+    out = xp.full((num_segments, data2.shape[1]), init, dtype=data2.dtype)
+    if xp.__name__ == "numpy":
+        np.maximum.at(out, seg_ids, data2)
+        return out
+    return out.at[seg_ids].max(data2)
+
+
 def seg_any(xp, mask, seg_ids, num_segments):
     return seg_sum(xp, mask.astype(xp.int32), seg_ids, num_segments) > 0
 

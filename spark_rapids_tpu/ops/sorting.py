@@ -1,10 +1,12 @@
 """Total-order sort over columnar batches (reference ``GpuSortExec``/
 ``SortUtils.scala``, backed there by cudf radix sort).
 
-TPU approach: multi-pass stable argsort over per-column integer sort keys
-(least-significant key first), which XLA lowers to its native sort.  Handles
-asc/desc, nulls-first/last, Spark float ordering (NaN largest, -0.0 == 0.0),
-strings (big-endian chunk keys) and dead-row padding (always sorted last).
+TPU approach: ONE fused variadic stable sort (``lax.sort`` with
+``num_keys``; ``np.lexsort`` on host) over per-column integer sort keys,
+most-significant first.  Handles asc/desc, nulls-first/last, Spark float
+ordering (NaN largest, -0.0 == 0.0), strings (big-endian chunk keys) and
+dead-row padding (always sorted last).  Descending uses bitwise NOT (order
+reversal without the int64-min negation overflow).
 """
 
 from __future__ import annotations
@@ -12,7 +14,7 @@ from __future__ import annotations
 from typing import List, Sequence, Tuple
 
 from ..columnar.column import DeviceColumn
-from .ranks import column_sort_keys, stable_argsort
+from .ranks import column_sort_keys, lex_sort
 
 
 def sort_permutation(xp, specs: Sequence[Tuple[DeviceColumn, bool, bool]],
@@ -20,26 +22,11 @@ def sort_permutation(xp, specs: Sequence[Tuple[DeviceColumn, bool, bool]],
     """specs: [(column, ascending, nulls_first), ...] in sort-priority order
     (most significant first).  row_mask: bool[capacity] live-row mask.
     Returns int32 permutation putting rows in order, dead rows last."""
-    n = row_mask.shape[0]
-    perm = xp.arange(n, dtype=xp.int64)
-
-    # least-significant first: iterate specs in reverse
-    for col, asc, nulls_first in reversed(list(specs)):
-        keys = column_sort_keys(xp, col)  # most-significant first
-        for k in reversed(keys):
-            k = k[perm]
-            if not asc:
-                k = -k
-            p = stable_argsort(xp, k)
-            perm = perm[p]
-        # null ordering pass (most significant within this column)
-        null_key = (~col.validity).astype(xp.int8)[perm]
-        if nulls_first:
-            null_key = -null_key
-        p = stable_argsort(xp, null_key)
-        perm = perm[p]
-
-    # dead rows last (most significant overall)
-    dead = (~row_mask).astype(xp.int8)[perm]
-    p = stable_argsort(xp, dead)
-    return perm[p].astype(xp.int32)
+    keys = [(~row_mask).astype(xp.int64)]  # dead rows last, most significant
+    for col, asc, nulls_first in specs:
+        null_flag = (~col.validity).astype(xp.int64)
+        keys.append(-null_flag if nulls_first else null_flag)
+        for k in column_sort_keys(xp, col):  # most-significant first
+            keys.append(k if asc else ~k)
+    perm, _ = lex_sort(xp, keys)
+    return perm.astype(xp.int32)

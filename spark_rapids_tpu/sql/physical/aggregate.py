@@ -94,6 +94,17 @@ def _reduce_slot(xp, col: DeviceColumn, contrib, op: str, rank, cap, row_idx):
     raise ValueError(op)
 
 
+def _use_batched_reduce(xp) -> bool:
+    """Batched 2-D scatters win on TPU (vectorized row scatter) but lose to
+    per-slot 1-D scatters on XLA CPU — measured 58ms vs 34ms for 8 f32
+    slots at 1M rows — so batch only on real device backends.  Module-level
+    so tests can force the batched path on CPU."""
+    if xp.__name__ == "numpy":
+        return False
+    import jax
+    return jax.default_backend() not in ("cpu",)
+
+
 def groupby_reduce(xp, key_cols: Sequence[DeviceColumn],
                    slot_cols: Sequence[Tuple[DeviceColumn, "object"]],
                    ops: Sequence[str], row_mask):
@@ -102,7 +113,8 @@ def groupby_reduce(xp, key_cols: Sequence[DeviceColumn],
     cap = row_mask.shape[0]
     row_idx = xp.arange(cap, dtype=xp.int64)
     if key_cols:
-        rank64 = dense_rank_columns(xp, key_cols, row_mask)
+        from ...ops.hash_group import group_ids
+        rank64 = group_ids(xp, key_cols, row_mask)
     else:
         rank64 = xp.where(row_mask, 0, 1).astype(xp.int64)  # one global group
     rank = rank64.astype(xp.int32)
@@ -118,12 +130,64 @@ def groupby_reduce(xp, key_cols: Sequence[DeviceColumn],
     group_ok = xp.arange(cap, dtype=xp.int32) < n_groups
     out_keys = [_gather_col(k, first_idx, group_ok) for k in key_cols]
 
-    out_slots = []
-    for (col, contrib), op in zip(slot_cols, ops):
+    # Split slots into "simple" (plain 1-D numeric data + batchable op) and
+    # the general path.  Simple slots of one (op-kind, dtype) reduce with a
+    # SINGLE 2-D scatter kernel — s slots per pass instead of 2 scatters per
+    # slot (one kernel launch per op per batch, SURVEY §3.3).
+    from ...ops.segmented import seg_max2, seg_min2, seg_sum2
+    n_slots = len(slot_cols)
+    out_slots: List = [None] * n_slots
+    batch_ok = _use_batched_reduce(xp)
+    simple = []  # (slot_idx, op, col, contrib)
+    for i, ((col, contrib), op) in enumerate(zip(slot_cols, ops)):
         contrib = contrib & row_mask
-        r = _reduce_slot(xp, col, contrib, op, rank, cap, row_idx)
-        # clamp validity to existing groups
-        out_slots.append(r.with_validity(r.validity & group_ok))
+        if (batch_ok and op in (SUM, COUNT, MIN, MAX) and col.data is not None
+                and col.data.ndim == 1 and col.lengths is None
+                and col.aux is None and not col.children):
+            simple.append((i, op, col, contrib))
+        else:
+            r = _reduce_slot(xp, col, contrib, op, rank, cap, row_idx)
+            out_slots[i] = r.with_validity(r.validity & group_ok)
+
+    if simple:
+        contrib_mat = xp.stack([c for (_, _, _, c) in simple], axis=1)
+        any_mat = seg_sum2(xp, contrib_mat.astype(xp.int32), rank, cap) > 0
+        by_kind: dict = {}
+        for j, (i, op, col, contrib) in enumerate(simple):
+            if op == COUNT:
+                kind = ("add", np.dtype(np.int64))
+            elif op == SUM:
+                kind = ("add", np.dtype(col.data.dtype))
+            else:
+                kind = ("min" if op == MIN else "max",
+                        np.dtype(col.data.dtype))
+            by_kind.setdefault(kind, []).append((j, i, op, col, contrib))
+        for (kind, dt), items in by_kind.items():
+            if kind == "add":
+                cols2 = [contrib.astype(dt) if op == COUNT
+                         else xp.where(contrib, col.data,
+                                       xp.asarray(0, dtype=dt))
+                         for (_, _, op, col, contrib) in items]
+                red = seg_sum2(xp, xp.stack(cols2, axis=1), rank, cap)
+            else:
+                is_min = kind == "min"
+                sent = (_min_sentinel if is_min else _max_sentinel)(
+                    xp, items[0][3].dtype)
+                sent = xp.asarray(sent, dtype=dt)
+                cols2 = [xp.where(contrib, col.data, sent)
+                         for (_, _, op, col, contrib) in items]
+                stacked = xp.stack(cols2, axis=1)
+                red = (seg_min2 if is_min else seg_max2)(
+                    xp, stacked, rank, cap, sent)
+            for out_col, (j, i, op, col, contrib) in enumerate(items):
+                if op == COUNT:
+                    out_slots[i] = DeviceColumn(
+                        T.LONG, red[:, out_col],
+                        xp.ones(cap, dtype=bool) & group_ok)
+                else:
+                    out_slots[i] = DeviceColumn(
+                        col.dtype, red[:, out_col],
+                        any_mat[:, j] & group_ok)
     return out_keys, out_slots, n_groups
 
 
@@ -181,8 +245,46 @@ class HashAggregateExec(PhysicalPlan):
                 [bind_references(c, child_attrs) for c in f.children]
                 for f in self._agg_funcs]
 
-        self._partial_fn = self._jit(self._partial_compute)
-        self._merge_fn = self._jit(self._merge_compute)
+        from .kernel_cache import exprs_key
+        self._pre_steps: List = []  # fused upstream filter/project chain
+        slots_key = tuple(
+            (type(f).__name__, f._key_extras(),
+             tuple((s.op, s.merge_op, s.dtype) for s in f.slots()))
+            for f in self._agg_funcs)
+        self._slots_key = slots_key
+        if mode != "final":
+            self._partial_key = (
+                "partial", exprs_key(self._bound_grouping),
+                tuple(zip(slots_key,
+                          (exprs_key(i) for i in self._bound_inputs))))
+            self._partial_fn = self._jit(self._make_partial_fn(()),
+                                         key=self._partial_key)
+        merge_key = ("merge", len(self.grouping), slots_key)
+        self._merge_fn = self._jit(self._merge_compute, key=merge_key)
+        self._finalize_key = ("finalize", len(self.grouping), slots_key,
+                              tuple(self._out_spec))
+
+    def _make_partial_fn(self, steps):
+        """Build the partial kernel over an IMMUTABLE pre-step tuple.  The
+        steps must be baked into the closure (not read from self) because
+        the jitted wrapper is shared process-wide under its cache key —
+        mutating instance state after registration would change the cached
+        program's behavior for unrelated queries."""
+        steps = tuple(steps)
+
+        def fn(batch):
+            return self._partial_compute(batch, steps)
+        return fn
+
+    def absorb_pre_steps(self, steps, new_child):
+        """Whole-stage fusion: inline an upstream Filter/Project chain into
+        the partial kernel (fusion.py).  The chain reproduces the old
+        child's schema, so existing bound expressions stay valid; fused
+        filters contribute a live-row mask instead of compacting."""
+        self._pre_steps = list(steps)
+        self.children = (new_child,)
+        key = self._partial_key + tuple(s._fuse_key() for s in steps)
+        self._partial_fn = self._jit(self._make_partial_fn(steps), key=key)
 
     # --- schema -----------------------------------------------------------
     @property
@@ -208,9 +310,13 @@ class HashAggregateExec(PhysicalPlan):
         return out
 
     # --- compute ----------------------------------------------------------
-    def _partial_compute(self, batch: ColumnarBatch):
-        """update + first reduce over one input batch -> [keys..., slots...]"""
+    def _partial_compute(self, batch: ColumnarBatch, pre_steps=()):
+        """update + first reduce over one input batch -> [keys..., slots...]
+        (with any fused upstream filter/project chain applied inline)"""
         xp = self.xp
+        mask = batch.row_mask()
+        for step in pre_steps:
+            batch, mask = step._fuse_step(batch, mask, xp)
         ctx = EvalContext(batch, xp=xp)
         keys = [g.eval(ctx) for g in self._bound_grouping]
         slot_pairs = []
@@ -220,7 +326,7 @@ class HashAggregateExec(PhysicalPlan):
             pairs = f.update_values(ctx, in_cols)
             slot_pairs.extend(pairs)
             ops.extend(s.op for s in f.slots())
-        gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, batch.row_mask())
+        gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, mask)
         names = tuple(f"_g{i}" for i in range(len(gk))) + \
             tuple(f"_s{i}" for i in range(len(gs)))
         return ColumnarBatch(names, tuple(gk) + tuple(gs), n)
@@ -296,7 +402,7 @@ class HashAggregateExec(PhysicalPlan):
             batches = [p.get() for p in g.parts]
             merged = batches[0] if len(batches) == 1 else \
                 ColumnarBatch.concat(batches)
-            return self._merge_fn(merged)
+            return self._merge_fn(merged).shrunk()
 
         def split_group(g: "_Group"):
             if len(g.parts) >= 2:
@@ -339,7 +445,8 @@ class HashAggregateExec(PhysicalPlan):
                 return
             merged = self._merge_spillables(partials).get_and_close()
             if self._finalize_jit is None:
-                self._finalize_jit = self._jit(self._finalize)
+                self._finalize_jit = self._jit(self._finalize,
+                                               key=self._finalize_key)
             yield self._finalize_jit(merged)
             return
 
@@ -351,7 +458,7 @@ class HashAggregateExec(PhysicalPlan):
                                       split=split_spillable_in_half):
                     tctx.inc_metric("aggPartialBatches")
                     partials.append(SpillableColumnarBatch.create(
-                        out, ACTIVE_BATCHING_PRIORITY))
+                        out.shrunk(), ACTIVE_BATCHING_PRIORITY))
         except BaseException:
             for p in partials:
                 p.close()
@@ -364,7 +471,8 @@ class HashAggregateExec(PhysicalPlan):
             yield merged
         else:  # complete
             if self._finalize_jit is None:
-                self._finalize_jit = self._jit(self._finalize)
+                self._finalize_jit = self._jit(self._finalize,
+                                               key=self._finalize_key)
             yield self._finalize_jit(merged)
 
     def _empty_output(self):

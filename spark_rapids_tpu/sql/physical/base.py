@@ -102,9 +102,20 @@ class PhysicalPlan:
         return out
 
     # --- jit plumbing for device execs ------------------------------------
-    def _jit(self, fn):
-        """jit on the tpu backend, eager numpy on cpu."""
+    def _jit(self, fn, key=None):
+        """jit on the tpu backend, eager numpy on cpu.
+
+        When ``key`` is given, the jitted wrapper is shared process-wide via
+        the kernel cache (kernel_cache.py) so repeated ``collect()`` calls of
+        the same query reuse compiled programs instead of re-tracing — the
+        reference's kernel-reuse model (SURVEY §3.3).  The key must capture
+        everything that affects the traced computation besides the input
+        batch itself (bound expressions, static params, output names).
+        """
         if self.backend == TPU:
+            if key is not None:
+                from .kernel_cache import cached_jit
+                return cached_jit((type(self).__name__,) + tuple(key), fn)
             import jax
             return jax.jit(fn)
         return fn

@@ -25,6 +25,40 @@ def _to_backend_batch(batch: ColumnarBatch, backend: str) -> ColumnarBatch:
     return jax.tree.map(conv, batch)
 
 
+def compact_batch(xp, batch: ColumnarBatch, keep) -> ColumnarBatch:
+    """Stable-compact live ``keep`` rows to the front (cuDF
+    ``apply_boolean_mask`` analog; O(n) cumsum+scatter, no sort)."""
+    from ...ops.join import compact_indices
+    new_n = xp.sum(keep).astype(xp.int32)
+    perm = compact_indices(xp, keep)
+    valid = xp.arange(batch.capacity, dtype=xp.int32) < new_n
+    cols = tuple(c.gather(perm, valid) for c in batch.columns)
+    return ColumnarBatch(batch.names, cols, new_n)
+
+
+_UPLOAD_CACHE: dict = {}
+
+
+def _cached_upload(table, backend: str) -> ColumnarBatch:
+    """Decode+pad+upload a pyarrow table once per (table, backend); repeat
+    scans of the same in-memory relation reuse the resident batch (the
+    engine-side analog of Spark's InMemoryRelation staying cached — and the
+    TPU-idiomatic move: keep hot data in HBM instead of re-uploading)."""
+    import weakref
+    from ...columnar.convert import arrow_to_device
+    key = id(table)
+    ent = _UPLOAD_CACHE.get(key)
+    if ent is None or ent[0]() is not table:
+        ref = weakref.ref(table, lambda _r, k=key: _UPLOAD_CACHE.pop(k, None))
+        ent = (ref, {})
+        _UPLOAD_CACHE[key] = ent
+    per_backend = ent[1]
+    if backend not in per_backend:
+        per_backend[backend] = _to_backend_batch(arrow_to_device(table),
+                                                 backend)
+    return per_backend[backend]
+
+
 class InMemoryScanExec(PhysicalPlan):
     """Scan over pre-partitioned pyarrow tables (Relation leaf +
     HostColumnarToGpu fused: decode on host, upload once)."""
@@ -46,14 +80,7 @@ class InMemoryScanExec(PhysicalPlan):
         return sum(t.nbytes for t in self._parts)
 
     def execute(self, pid: int, tctx: TaskContext):
-        from ...columnar.convert import arrow_to_device
-        table = self._parts[pid]
-        if table.num_rows == 0 and len(self._parts) > pid:
-            from ...columnar.batch import ColumnarBatch as CB
-            batch = arrow_to_device(table)
-        else:
-            batch = arrow_to_device(table)
-        yield _to_backend_batch(batch, self.backend)
+        yield _cached_upload(self._parts[pid], self.backend)
 
     def simple_string(self):
         return f"{self.node_name()} [{', '.join(a.name for a in self._attrs)}]"
@@ -75,7 +102,10 @@ class ProjectExec(PhysicalPlan):
             else:
                 self._out.append(AttributeReference(e.sql(), e.data_type,
                                                     e.nullable))
-        self._fn = self._jit(self._compute)
+        from .kernel_cache import exprs_key
+        self._fn = self._jit(self._compute,
+                             key=(exprs_key(self._bound),
+                                  tuple(a.name for a in self._out)))
 
     @property
     def output(self):
@@ -86,6 +116,17 @@ class ProjectExec(PhysicalPlan):
         cols = [e.eval(ctx) for e in self._bound]
         return ColumnarBatch(tuple(a.name for a in self._out), tuple(cols),
                              batch.num_rows)
+
+    # --- whole-stage fusion protocol --------------------------------------
+    def _fuse_step(self, batch: ColumnarBatch, mask, xp):
+        ctx = EvalContext(batch, xp=xp)
+        cols = [e.eval(ctx) for e in self._bound]
+        return (ColumnarBatch(tuple(a.name for a in self._out), tuple(cols),
+                              batch.num_rows), mask)
+
+    def _fuse_key(self):
+        from .kernel_cache import exprs_key
+        return ("P", exprs_key(self._bound), tuple(a.name for a in self._out))
 
     def execute(self, pid, tctx):
         for batch in self.children[0].execute(pid, tctx):
@@ -104,7 +145,8 @@ class FilterExec(PhysicalPlan):
         self.backend = backend
         self.condition = condition
         self._bound = bind_references(condition, child.output)
-        self._fn = self._jit(self._compute)
+        from .kernel_cache import expr_key
+        self._fn = self._jit(self._compute, key=(expr_key(self._bound),))
 
     @property
     def output(self):
@@ -115,14 +157,20 @@ class FilterExec(PhysicalPlan):
         ctx = EvalContext(batch, xp=xp)
         cond = self._bound.eval(ctx)
         keep = cond.validity & cond.data & batch.row_mask()
-        new_n = xp.sum(keep).astype(xp.int32)
-        if xp is np:
-            perm = np.argsort(~keep, kind="stable")
-        else:
-            perm = xp.argsort(~keep, stable=True)
-        cols = tuple(c.gather(perm.astype(xp.int32), keep[perm])
-                     for c in batch.columns)
-        return ColumnarBatch(batch.names, cols, new_n)
+        return compact_batch(xp, batch, keep)
+
+    # --- whole-stage fusion protocol --------------------------------------
+    def _fuse_step(self, batch: ColumnarBatch, mask, xp):
+        """Fused filters never compact: the predicate just ANDs into the
+        live mask; the stage terminal (agg mask / one final compaction)
+        realizes it."""
+        ctx = EvalContext(batch, xp=xp)
+        cond = self._bound.eval(ctx)
+        return batch, mask & cond.validity & cond.data
+
+    def _fuse_key(self):
+        from .kernel_cache import expr_key
+        return ("F", expr_key(self._bound))
 
     def execute(self, pid, tctx):
         for batch in self.children[0].execute(pid, tctx):
@@ -267,7 +315,8 @@ class SampleExec(PhysicalPlan):
         super().__init__(child)
         self.backend = backend
         self.lower, self.upper, self.seed = lower, upper, seed
-        self._fn = self._jit(self._compute) if backend == TPU else self._compute
+        self._fn = (self._jit(self._compute, key=(self.lower, self.upper))
+                    if backend == TPU else self._compute)
 
     @property
     def output(self):
@@ -285,14 +334,7 @@ class SampleExec(PhysicalPlan):
     def _compute(self, batch, u):
         xp = self.xp
         keep = (u >= self.lower) & (u < self.upper) & batch.row_mask()
-        new_n = xp.sum(keep).astype(xp.int32)
-        if xp is np:
-            perm = np.argsort(~keep, kind="stable")
-        else:
-            perm = xp.argsort(~keep, stable=True)
-        cols = tuple(c.gather(perm.astype(xp.int32), keep[perm])
-                     for c in batch.columns)
-        return ColumnarBatch(batch.names, cols, new_n)
+        return compact_batch(xp, batch, keep)
 
     def execute(self, pid, tctx):
         for i, batch in enumerate(self.children[0].execute(pid, tctx)):
@@ -311,7 +353,11 @@ class ExpandExec(PhysicalPlan):
             [bind_references(e, child.output) for e in proj]
             for proj in projections]
         self._out = list(out_attrs)
-        self._fns = [self._jit(self._make_compute(p)) for p in self.projections]
+        from .kernel_cache import exprs_key
+        out_names = tuple(a.name for a in self._out)
+        self._fns = [self._jit(self._make_compute(p),
+                               key=(exprs_key(p), out_names))
+                     for p in self.projections]
 
     @property
     def output(self):

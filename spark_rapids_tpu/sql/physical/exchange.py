@@ -29,7 +29,7 @@ class ShuffleExchangeExec(PhysicalPlan):
         self.backend = backend
         self.partitioning = partitioning.bind(child.output)
         self._materialized: Optional[List[List[ColumnarBatch]]] = None
-        self._split_fn = self._jit(self._split_one)
+        self._split_fn = self._jit(self._split_one, key=("split",))
 
     @property
     def output(self):
@@ -39,17 +39,10 @@ class ShuffleExchangeExec(PhysicalPlan):
         return self.partitioning.num_partitions
 
     # --- device kernels ---------------------------------------------------
-    def _split_one(self, batch: ColumnarBatch, pids, target: int):
-        xp = self.xp
+    def _split_one(self, batch: ColumnarBatch, pids, target):
+        from .basic import compact_batch
         keep = (pids == target) & batch.row_mask()
-        n = xp.sum(keep).astype(xp.int32)
-        if xp is np:
-            perm = np.argsort(~keep, kind="stable")
-        else:
-            perm = xp.argsort(~keep, stable=True)
-        cols = tuple(c.gather(perm.astype(xp.int32), keep[perm])
-                     for c in batch.columns)
-        return ColumnarBatch(batch.names, cols, n)
+        return compact_batch(self.xp, batch, keep)
 
     # --- materialization --------------------------------------------------
     def _ensure_materialized(self, tctx: TaskContext):
@@ -81,7 +74,8 @@ class ShuffleExchangeExec(PhysicalPlan):
             else:
                 ctx = EvalContext(merged, xp=self.xp)
                 pids = self.partitioning.partition_ids(ctx, merged, cpid)
-                pieces = [self._split_fn(merged, pids, t) for t in range(nt)]
+                pieces = [self._split_fn(merged, pids, t).shrunk()
+                          for t in range(nt)]
             mgr.write_map_output(shuffle_id, cpid, pieces)
 
         out: List[List[ColumnarBatch]] = []

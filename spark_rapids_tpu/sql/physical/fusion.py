@@ -1,0 +1,97 @@
+"""Whole-stage fusion — one compiled XLA program per pipeline segment.
+
+The reference gets kernel fusion two ways: cuDF fuses within a kernel, and
+tiered projection dedups subexpressions (``basicPhysicalOperators.scala:500``).
+On TPU the equivalent (and bigger) lever is compiling a whole
+filter→project→…[→partial-agg] chain as ONE jitted program:
+
+* fused filters don't compact — the predicate ANDs into a live-row mask that
+  threads through the stage (one compaction at the stage end, or none at all
+  when the terminal is a hash aggregate, which consumes the mask directly);
+* XLA fuses the elementwise project math into its consumers;
+* no intermediate batch materialization between member ops.
+
+The planner pass (``fuse_stages``) runs after transition insertion and only
+touches same-backend TPU chains; the CPU fallback path keeps per-op
+execution, which also keeps it a more independent oracle.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ...columnar.batch import ColumnarBatch
+from .base import TPU, PhysicalPlan
+from .basic import FilterExec, ProjectExec, compact_batch
+
+
+class FusedStageExec(PhysicalPlan):
+    """A chain of Filter/Project members compiled as one program with a
+    single terminal compaction."""
+
+    def __init__(self, members: List[PhysicalPlan], child: PhysicalPlan):
+        super().__init__(child)
+        self.backend = TPU
+        self.members = members  # producer -> consumer order
+        key = ("stage",) + tuple(m._fuse_key() for m in members)
+        self._fn = self._jit(self._compute, key=key)
+
+    @property
+    def output(self):
+        return self.members[-1].output
+
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        xp = self.xp
+        mask = batch.row_mask()
+        for m in self.members:
+            batch, mask = m._fuse_step(batch, mask, xp)
+        return compact_batch(xp, batch, mask)
+
+    def execute(self, pid, tctx):
+        for batch in self.children[0].execute(pid, tctx):
+            tctx.inc_metric("fusedStageBatches")
+            yield self._fn(batch)
+
+    def simple_string(self):
+        inner = " -> ".join(m.node_name() for m in self.members)
+        return f"{self.node_name()} [{inner}]"
+
+
+def _fusible(plan: PhysicalPlan) -> bool:
+    return (isinstance(plan, (FilterExec, ProjectExec))
+            and plan.backend == TPU
+            and not plan._placement_reasons)
+
+
+def _collect_chain(plan: PhysicalPlan):
+    """Walk down through fusible ops; returns (members bottom-up, child)."""
+    chain = []
+    node = plan
+    while _fusible(node):
+        chain.append(node)
+        node = node.children[0]
+    chain.reverse()  # producer first
+    return chain, node
+
+
+def fuse_stages(plan: PhysicalPlan) -> PhysicalPlan:
+    """Bottom-up rewrite: absorb Filter/Project chains into their terminal
+    hash aggregate's partial kernel, and collapse remaining chains of >= 2
+    map ops into a FusedStageExec."""
+    from .aggregate import HashAggregateExec
+
+    if (isinstance(plan, HashAggregateExec) and plan.backend == TPU
+            and plan.mode in ("partial", "complete")):
+        chain, below = _collect_chain(plan.children[0])
+        if chain:
+            plan.absorb_pre_steps(chain, below)
+
+    if _fusible(plan):
+        chain, below = _collect_chain(plan)
+        if len(chain) >= 2:
+            fused = FusedStageExec(chain, below)
+            fused.children = (fuse_stages(below),)
+            return fused
+
+    plan.children = tuple(fuse_stages(c) for c in plan.children)
+    return plan

@@ -26,7 +26,11 @@ class GenerateExec(PhysicalPlan):
         self.outer = outer
         self.gen_output = list(gen_output)
         self._bound = bind_references(generator, child.output)
-        self._fn = self._jit(self._compute)
+        from .kernel_cache import expr_key
+        self._fn = self._jit(
+            self._compute,
+            key=(expr_key(self._bound), self.outer,
+                 tuple(a.name for a in self.gen_output)))
 
     @property
     def output(self):

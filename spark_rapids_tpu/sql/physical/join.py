@@ -65,7 +65,15 @@ class BaseJoinExec(PhysicalPlan):
         pair_attrs = list(self._probe.output) + list(self._build.output)
         self._bound_cond = (bind_references(condition, pair_attrs)
                             if condition is not None else None)
-        self._build_fn = self._jit(self._build_info)
+        from .kernel_cache import expr_key, exprs_key
+        self._sig = (self._norm_how, self._flipped,
+                     exprs_key(self._bound_pkeys),
+                     exprs_key(self._bound_bkeys),
+                     expr_key(self._bound_cond)
+                     if self._bound_cond is not None else None,
+                     tuple(a.name for a in self.output))
+        self._build_fn = self._jit(self._build_info,
+                                   key=("build", self._sig))
         self._gather_cache: Dict[int, object] = {}
 
     # --- schema -----------------------------------------------------------
@@ -105,7 +113,7 @@ class BaseJoinExec(PhysicalPlan):
         if fn is None:
             def impl(probe, build, info):
                 return self._gather_impl(probe, build, info, out_cap)
-            fn = self._jit(impl)
+            fn = self._jit(impl, key=("gather", self._sig, out_cap))
             self._gather_cache[out_cap] = fn
         return fn
 
@@ -341,7 +349,7 @@ class NestedLoopJoinExec(BaseJoinExec):
         if fn is None:
             def impl(probe, build):
                 return self._nl_impl(probe, build, out_cap)
-            fn = self._jit(impl)
+            fn = self._jit(impl, key=("nl", self._sig, out_cap))
             self._gather_cache[out_cap] = fn
         return fn
 

@@ -1,0 +1,81 @@
+"""Module-level compiled-kernel cache.
+
+The reference's whole perf model is one kernel launch per op per batch with
+*reused* compiled kernels (``RapidsConf.scala:550``, SURVEY §3.3) — cuDF
+kernels are compiled once per process.  Here the analog is: one ``jax.jit``
+wrapper per *program identity* (exec type + bound expression tree + static
+params), shared across every exec instance and every ``collect()``.  XLA's
+own trace cache then keys on input avals (schema dtypes, capacity buckets,
+batch names), so repeated queries hit compiled code instead of re-tracing.
+
+Program identity keys are built from ``Expression.semantic_key()`` over
+*bound* expression trees (BoundReference → ordinal), so two plans of the
+same query constructed at different times share kernels.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Tuple
+
+#: LRU bound — each entry pins its exec instance (and that exec's child
+#: subtree) via the jitted closure, and keys embed literal values, so an
+#: unbounded cache would grow with every distinct constant a long-running
+#: session ever used.  Reference analog: cuDF kernels are per-op, not
+#: per-literal; bounding the per-literal programs keeps the same spirit.
+_MAX_ENTRIES = int(os.environ.get("SRT_KERNEL_CACHE_SIZE", "1024"))
+
+_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_LOCK = threading.Lock()
+_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def cached_jit(key: Tuple, fn: Callable) -> Callable:
+    """Return the process-wide jitted callable for ``key``.
+
+    ``fn`` is jitted and cached on first sight of ``key``; later callers get
+    the cached wrapper (their own ``fn`` is dropped — the key must capture
+    everything that affects the trace).  Least-recently-used entries are
+    evicted past ``_MAX_ENTRIES``.
+    """
+    with _LOCK:
+        cached = _CACHE.get(key)
+        if cached is not None:
+            _STATS["hits"] += 1
+            _CACHE.move_to_end(key)
+            return cached
+        _STATS["misses"] += 1
+        import jax
+        wrapper = jax.jit(fn)
+        _CACHE[key] = wrapper
+        while len(_CACHE) > _MAX_ENTRIES:
+            _CACHE.popitem(last=False)
+            _STATS["evictions"] += 1
+        return wrapper
+
+
+def cache_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_STATS, size=len(_CACHE))
+
+
+def clear_cache() -> None:
+    with _LOCK:
+        _CACHE.clear()
+        _STATS["hits"] = 0
+        _STATS["misses"] = 0
+        _STATS["evictions"] = 0
+
+
+def expr_key(e) -> Tuple:
+    """Stable structural key for a bound expression (or SortOrder)."""
+    from ..plan import SortOrder
+    if isinstance(e, SortOrder):
+        return ("SortOrder", expr_key(e.child), e.ascending, e.nulls_first)
+    return e.semantic_key()
+
+
+def exprs_key(exprs) -> Tuple:
+    return tuple(expr_key(e) for e in exprs)

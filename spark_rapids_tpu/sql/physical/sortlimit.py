@@ -24,7 +24,8 @@ class SortExec(PhysicalPlan):
         self._bound = [SortOrder(bind_references(o.child, child.output),
                                  o.ascending, o.nulls_first)
                        for o in self.orders]
-        self._fn = self._jit(self._compute)
+        from .kernel_cache import exprs_key
+        self._fn = self._jit(self._compute, key=(exprs_key(self._bound),))
 
     @property
     def output(self):

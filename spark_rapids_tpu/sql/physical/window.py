@@ -81,7 +81,13 @@ class WindowExec(PhysicalPlan):
         self._bound_orders = [
             SortOrder(bind_references(o.child, out), o.ascending,
                       o.nulls_first) for o in self.order_spec]
-        self._fn = self._jit(self._compute)
+        from .kernel_cache import exprs_key
+        self._fn = self._jit(
+            self._compute,
+            key=(exprs_key(a.child for a in self._bound_exprs),
+                 tuple(a.name for a in self.window_exprs),
+                 exprs_key(self._bound_parts),
+                 exprs_key(self._bound_orders)))
 
     @property
     def output(self):

@@ -34,6 +34,10 @@ class Planner:
             _force_cpu(meta)
         phys = self._convert(meta)
         phys = _insert_transitions(phys)
+        from ..config import FUSION_ENABLED
+        if bool(self.conf.get(FUSION_ENABLED)):
+            from .physical.fusion import fuse_stages
+            phys = fuse_stages(phys)
         return phys
 
     def plan_for_collect(self, logical: P.LogicalPlan) -> PhysicalPlan:
