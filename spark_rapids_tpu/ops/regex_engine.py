@@ -414,12 +414,84 @@ def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
     return frozenset(out)
 
 
-def compile_regex(pattern: str, search_prefix: bool = False) -> CompiledRegex:
+def _length_range(node) -> Tuple[int, Optional[int]]:
+    """(min, max) match byte-length of a node; max None = unbounded."""
+    if isinstance(node, (RLit, RClass)):
+        return 1, 1
+    if isinstance(node, RAnchor):
+        return 0, 0
+    if isinstance(node, RSeq):
+        lo = hi = 0
+        for p in node.parts:
+            pl, ph = _length_range(p)
+            lo += pl
+            hi = None if (hi is None or ph is None) else hi + ph
+        return lo, hi
+    if isinstance(node, RAlt):
+        los, his = zip(*(_length_range(o) for o in node.options))
+        return min(los), (None if any(h is None for h in his) else max(his))
+    if isinstance(node, RRep):
+        ul, uh = _length_range(node.node)
+        lo = node.lo * ul
+        hi = None if (node.hi is None or uh is None) else node.hi * uh
+        return lo, hi
+    raise RegexUnsupported(f"node {node}")
+
+
+def _fixed_length(node) -> bool:
+    lo, hi = _length_range(node)
+    return hi is not None and lo == hi
+
+
+def _extent_safe(node) -> bool:
+    """True when Java's leftmost-first preference provably picks the same
+    match *extent* as this engine's POSIX leftmost-longest at every start
+    position (ADVICE r1: 'a|ab' matched 'ab' on device vs Java's 'a').
+
+    Sound conservative rules:
+      - literals/classes/anchors: single possible length.
+      - alternation: safe only when every branch is safe and the whole alt
+        is fixed-length (all branches match exactly the same length, so the
+        branch choice cannot change the extent).
+      - greedy repetition of a fixed-length unit: Java tries counts from
+        the maximum down, i.e. longest-first — agrees with POSIX.
+      - sequence: safe when all parts are safe and at most ONE part is
+        variable-length (Java backtracks that one part longest-first while
+        the fixed remainder cannot trade length between parts).
+    Lazy/possessive quantifiers are already rejected by the parser.
+    """
+    if isinstance(node, (RLit, RClass, RAnchor)):
+        return True
+    if isinstance(node, RAlt):
+        return _fixed_length(node) and all(_extent_safe(o)
+                                           for o in node.options)
+    if isinstance(node, RRep):
+        return _extent_safe(node.node) and _fixed_length(node.node)
+    if isinstance(node, RSeq):
+        if not all(_extent_safe(p) for p in node.parts):
+            return False
+        variable = sum(1 for p in node.parts if not _fixed_length(p))
+        return variable <= 1
+    return False
+
+
+def compile_regex(pattern: str, search_prefix: bool = False,
+                  extent_exact: bool = False) -> CompiledRegex:
     """Compile to a DFA.  ``search_prefix`` prepends an implicit ``.*?``
-    (any byte loop) for single-pass unanchored search (RLike)."""
+    (any byte loop) for single-pass unanchored search (RLike).
+
+    ``extent_exact`` — required by span-consuming callers (replace /
+    extract / split): rejects patterns where the DFA's leftmost-longest
+    match could have a different extent than Java's leftmost-first, so
+    those expressions fall back to the host engine instead of silently
+    diverging from Spark results."""
     parser = _Parser(pattern)
     ast = parser.parse()
     ast, anc_s, anc_e = _strip_anchors(ast)
+    if extent_exact and not _extent_safe(ast):
+        raise RegexUnsupported(
+            "alternation/quantifier shape where Java leftmost-first and "
+            "POSIX leftmost-longest may pick different match extents")
 
     nfa = _NFA()
     start = nfa.new_state()

@@ -665,7 +665,11 @@ class Concat_Arrays(Expression):
 
 
 class Slice(Expression):
-    """slice(arr, start, length): 1-based start (negative from end)."""
+    """slice(arr, start, length): 1-based start (negative from end).
+
+    Spark returns an EMPTY array when |start| exceeds the array length
+    (ADVICE r1), and raises for start=0 or length<0; kernels cannot raise
+    per-row, so those error rows become NULL (documented divergence)."""
 
     def __init__(self, arr, start, length):
         self.children = (resolve_expression(arr), resolve_expression(start),
@@ -685,12 +689,13 @@ class Slice(Expression):
         start = s.data.astype(xp.int32)
         start0 = xp.where(start > 0, start - 1, c.lengths + start)
         cnt = xp.clip(ln.data.astype(xp.int32), 0, None)
+        # negative start reaching before the array head -> empty result
+        cnt = xp.where(start0 < 0, 0, cnt)
         j = xp.arange(w, dtype=xp.int32)[None, :]
         keep = (j >= start0[:, None]) & (j < (start0 + cnt)[:, None]) & \
             slot_valid
         elem, lengths = _compact_rows(xp, c.children[0], keep, cap, w)
-        validity = valid_and(xp, c, s, ln) & (start != 0) & (start0 >= -0)
-        validity = validity & (ln.data >= 0)
+        validity = valid_and(xp, c, s, ln) & (start != 0) & (ln.data >= 0)
         return make_array_column(c.dtype, lengths, (elem,), validity)
 
 

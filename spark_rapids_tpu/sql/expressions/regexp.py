@@ -24,11 +24,13 @@ from .strings import _host_rows, _pack, _lit_str
 _MAX_OUT = 1 << 14
 
 
-def _compile_or_reason(pattern: Optional[str], search: bool):
+def _compile_or_reason(pattern: Optional[str], search: bool,
+                       extent: bool = False):
     if pattern is None:
         return None, "regex pattern must be a literal string"
     try:
-        return RX.compile_regex(pattern, search_prefix=search), None
+        return RX.compile_regex(pattern, search_prefix=search,
+                                extent_exact=extent), None
     except RX.RegexUnsupported as e:
         return None, f"pattern not supported by the device regex engine: {e}"
     except Exception as e:  # noqa: BLE001 — malformed pattern
@@ -37,6 +39,9 @@ def _compile_or_reason(pattern: Optional[str], search: bool):
 
 class _RegexExpr(Expression):
     _search_mode = False
+    # span-consuming expressions (replace/extract/split) need the device
+    # match extent to equal Java's leftmost-first extent (ADVICE r1)
+    _extent_sensitive = False
 
     def _pattern(self) -> Optional[str]:
         return _lit_str(self.children[1])
@@ -44,7 +49,8 @@ class _RegexExpr(Expression):
     def _compiled(self):
         if not hasattr(self, "_rx_cache"):
             self._rx_cache = _compile_or_reason(self._pattern(),
-                                                self._search_mode)
+                                                self._search_mode,
+                                                self._extent_sensitive)
         return self._rx_cache
 
     def tag_for_device(self, conf=None):
@@ -78,6 +84,8 @@ class RLike(_RegexExpr):
 
 
 class RegExpReplace(_RegexExpr):
+    _extent_sensitive = True
+
     def __init__(self, subject, pattern, rep):
         self.children = (resolve_expression(subject),
                          resolve_expression(pattern),
@@ -106,8 +114,15 @@ class RegExpReplace(_RegexExpr):
         xp = ctx.xp
         rx, reason = self._compiled()
         rep = _lit_str(self.children[2])
+        # worst case: a zero-length match at every position (width+1 of
+        # them) inserts the replacement AND every source byte is kept.
+        # Batches whose worst-case output exceeds the device width cap run
+        # on the host instead of silently truncating (ADVICE r1).
+        width_in = c.data.shape[1]
+        rep_b = (rep or "").encode("utf-8")
+        out_w = bucket_width((width_in + 1) * max(len(rep_b), 1) + width_in)
         if rx is None or rep is None or "$" in (rep or "") or \
-                "\\" in (rep or ""):
+                "\\" in (rep or "") or out_w > _MAX_OUT:
             pat = _pyre.compile(self._pattern() or "")
             java_rep = _lit_str(self.children[2]) or ""
             py_rep = _pyre.sub(r"\$(\d+)", r"\\\1", java_rep)
@@ -115,18 +130,12 @@ class RegExpReplace(_RegexExpr):
                    for s in _host_rows(ctx, c)]
             return _pack(ctx, out, valid_and(xp, c, p, r))
         chosen, mlen = RX.dfa_match_spans(xp, rx, c.data, c.lengths)
-        rep_b = rep.encode("utf-8")
         rw = max(bucket_width(len(rep_b)), 4)
         rep_row = np.zeros(rw, dtype=np.uint8)
         rep_row[:len(rep_b)] = np.frombuffer(rep_b, np.uint8)
         rows = c.data.shape[0]
         rep_chars = xp.broadcast_to(xp.asarray(rep_row), (rows, rw))
         rep_lens = xp.full((rows,), len(rep_b), dtype=xp.int32)
-        # worst case: a zero-length match at every position (width+1 of
-        # them) inserts the replacement AND every source byte is kept
-        width_in = c.data.shape[1]
-        out_w = min(bucket_width((width_in + 1) * max(len(rep_b), 1)
-                                 + width_in), _MAX_OUT)
         chars, lens = RX.replace_matches(xp, c.data, c.lengths, chosen, mlen,
                                          rep_chars, rep_lens, out_w)
         return DeviceColumn(T.STRING, chars, valid_and(xp, c, p, r),
@@ -136,6 +145,8 @@ class RegExpReplace(_RegexExpr):
 class RegExpExtract(_RegexExpr):
     """regexp_extract(str, pattern, idx).  Device path: idx=0, or idx=1
     when the whole pattern is one capturing group.  No match -> ''."""
+
+    _extent_sensitive = True
 
     def __init__(self, subject, pattern, idx=1):
         self.children = (resolve_expression(subject),
@@ -272,6 +283,8 @@ def _strings_list_column(ctx, rows, validity):
 class StringSplit(_RegexExpr):
     """split(str, regex, limit).  Device path needs a pattern that cannot
     match the empty string (Java's zero-width split rules are positional)."""
+
+    _extent_sensitive = True
 
     def __init__(self, subject, pattern, limit=-1):
         self.children = (resolve_expression(subject),

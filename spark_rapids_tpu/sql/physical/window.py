@@ -177,7 +177,8 @@ class WindowExec(PhysicalPlan):
             has_valid = xp.any(oc.validity)
             vmax = xp.where(has_valid, vmax, 0)
             vmin = xp.where(has_valid, vmin, 0)
-            pad = abs(int(lo)) + abs(int(up)) + 1
+            pad = (abs(int(lo)) if lo not in simple else 0) + \
+                  (abs(int(up)) if up not in simple else 0) + 1
             span = (vmax - vmin) + 2 * pad
             null_v = (vmin - pad) if self._bound_orders[0].nulls_first \
                 else (vmax + pad)

@@ -304,3 +304,24 @@ def test_empty_array_literal(sess):
     df, t = arr_df(sess)
     out = run_both(df.select(df.u, F.array().alias("e"))).to_pylist()
     assert all(r["e"] == [] for r in out)
+
+
+def test_slice_out_of_range_returns_empty(sess):
+    """ADVICE r1: |start| > length must give an EMPTY array (not NULL),
+    matching Spark; start=0 / negative length stay NULL (kernels cannot
+    raise per-row — documented divergence)."""
+    df, t = arr_df(sess)
+    out = run_both(df.select(
+        df.u,
+        F.slice(df.a, -10, 2).alias("neg_far"),
+        F.slice(df.a, 10, 2).alias("pos_far"),
+        F.slice(df.a, 0, 2).alias("zero_start"),
+        F.slice(df.a, 1, -1).alias("neg_len"),
+    )).to_pylist()
+    for r in out:
+        if r["u"] == 2:  # null array row stays null
+            continue
+        assert r["neg_far"] == [], r
+        assert r["pos_far"] == [], r
+        assert r["zero_start"] is None, r
+        assert r["neg_len"] is None, r

@@ -242,3 +242,44 @@ def test_malformed_counted_brace_falls_back():
         compile_regex("a{-1}")
     with pytest.raises(RegexUnsupported):
         compile_regex("a{3,1}")
+
+
+def test_alternation_extent_divergence_falls_back(sess):
+    """ADVICE r1 (medium): 'a|ab' is leftmost-first in Java ('a') but
+    leftmost-longest in the DFA ('ab') — replace/extract/split must fall
+    back to the host so results match Spark."""
+    t = pa.table({"u": [0, 1, 2], "s": ["ab", "aab", "b"]})
+    df = sess.create_dataframe(t)
+    q = df.select(df.u, F.regexp_replace(df.s, r"a|ab", "X").alias("r"))
+    assert "cannot run on TPU" in sess.explain(q)
+    out = run_both(q).to_pylist()
+    # Java/Python leftmost-first: 'ab' -> 'Xb'
+    assert [r["r"] for r in out] == ["Xb", "XXb", "b"]
+
+
+def test_alternation_same_length_stays_on_device(sess):
+    df, t = str_df(sess)
+    q = df.select(df.u, F.regexp_replace(df.s, r"foo|dot", "X").alias("r"))
+    assert "cannot run" not in sess.explain(q)
+    out = run_both(q).to_pylist()
+    exp = [pyre.sub(r"foo|dot", "X", s) for s in STRS]
+    assert [r["r"] for r in out] == exp
+
+
+def test_rlike_alternation_still_on_device(sess):
+    """Boolean search is extent-insensitive: 'a|ab' stays on device."""
+    df, t = str_df(sess)
+    q = df.select(df.u, F.rlike(df.s, r"a|ab").alias("m"))
+    assert "cannot run" not in sess.explain(q)
+    out = run_both(q).to_pylist()
+    assert [r["m"] for r in out] == [bool(pyre.search(r"a|ab", s))
+                                     for s in STRS]
+
+
+def test_variable_alternation_split_falls_back(sess):
+    t = pa.table({"u": [0, 1], "s": ["xaby", "xay"]})
+    df = sess.create_dataframe(t)
+    q = df.select(df.u, F.split(df.s, r"a|ab").alias("p"))
+    assert "cannot run on TPU" in sess.explain(q)
+    out = run_both(q).to_pylist()
+    assert out[0]["p"] == pyre.compile(r"a|ab").split("xaby")

@@ -300,3 +300,31 @@ def test_identical_specs_share_one_window_node(sess):
             n_windows += 1
         stack.extend(nd.children)
     assert n_windows == 1
+
+
+def test_range_frame_mixed_unbounded_numeric(sess):
+    """ADVICE r1 (high): integral RANGE frame mixing an unbounded bound with
+    a numeric bound must not overflow on the +/-2^63 sentinel."""
+    df, pdf = make_df(sess, with_nulls=False)
+    w = (Window.partitionBy("g").orderBy("o")
+         .rangeBetween(Window.unboundedPreceding, 2))
+    out = df.select(df.u, df.g, df.o, df.v,
+                    F.sum(df.v).over(w).alias("s"),
+                    F.count(df.v).over(w).alias("c"))
+    got = both_engines(out, ["u"])
+    for _, r in got.sample(40, random_state=2).iterrows():
+        m = pdf[(pdf.g == r.g) & (pdf.o <= r.o + 2)]
+        assert np.isclose(r["s"], m.v.sum()), (r.g, r.o)
+        assert r["c"] == m.v.count()
+
+
+def test_range_frame_numeric_to_unbounded(sess):
+    df, pdf = make_df(sess, n=200, with_nulls=False)
+    w = (Window.partitionBy("g").orderBy("o")
+         .rangeBetween(-3, Window.unboundedFollowing))
+    out = df.select(df.u, df.g, df.o, df.v,
+                    F.count(df.v).over(w).alias("c"))
+    got = both_engines(out, ["u"])
+    for _, r in got.sample(40, random_state=3).iterrows():
+        m = pdf[(pdf.g == r.g) & (pdf.o >= r.o - 3)]
+        assert r["c"] == m.v.count(), (r.g, r.o)
