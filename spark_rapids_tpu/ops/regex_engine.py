@@ -400,6 +400,7 @@ class CompiledRegex:
     anchored_start: bool
     anchored_end: bool
     ngroups: int
+    min_len: int = 0    # shortest possible match (output-bound estimation)
 
 
 def _eps_closure(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
@@ -554,8 +555,9 @@ def compile_regex(pattern: str, search_prefix: bool = False,
     for i, row in enumerate(table_rows):
         table[i] = row
     dead = dfa_states.get(frozenset(), -1)
+    min_len, _ = _length_range(ast)
     return CompiledRegex(table, byte_class, np.array(accept_flags),
-                        0, dead, anc_s, anc_e, parser.ngroups)
+                        0, dead, anc_s, anc_e, parser.ngroups, min_len)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,217 @@
+"""Device-mesh management + the engine-level ICI shuffle data plane.
+
+This is where a *planned* query's ``ShuffleExchangeExec`` leaves the host
+loop: the N map-side batches become one mesh-sharded global batch, and a
+single compiled ``shard_map`` program routes every row to its owner chip
+with ``lax.all_to_all`` over ICI (``parallel/shuffle.py``'s tile protocol),
+compacting received rows on-chip.  The reference reaches the same point
+through the UCX peer-to-peer transport (``RapidsShuffleClient.scala:476`` /
+``UCX.scala:1119``); on TPU the interconnect is driven by XLA collectives
+inside the program instead of host-driven RDMA.
+
+Batches are pytrees of row-major leaves.  Every leaf's leading dim is a
+multiple of the batch capacity (struct children: cap; array children:
+cap*width; string matrices: [cap, width]), so each leaf reshapes to
+[cap, k, ...] for the row-exchange and back afterwards — nested types ride
+the same plane as flat columns.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class MeshShuffleUnsupported(Exception):
+    """Raised when a batch cannot ride the mesh data plane (object-dtype
+    host columns, ragged leaves); callers fall back to the local plane."""
+
+
+#: observability: exchanges that actually rode the mesh plane (tests assert
+#: on this; the metrics layer reads it for the shuffle mode report)
+STATS = {"mesh_exchanges": 0, "fallbacks": 0}
+
+
+_mesh_lock = threading.Lock()
+_mesh_cache: dict = {}
+
+
+def device_mesh(n_devices: Optional[int] = None):
+    """A 1-D ``jax.sharding.Mesh`` over the local devices (axis "data"),
+    or None when only one device is visible.  Cached per size."""
+    import jax
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n < 2 or len(devs) < n:
+        return None
+    with _mesh_lock:
+        m = _mesh_cache.get(n)
+        if m is None:
+            from jax.sharding import Mesh
+            m = Mesh(np.array(devs[:n]), ("data",))
+            _mesh_cache[n] = m
+        return m
+
+
+# ---------------------------------------------------------------------------
+# batch alignment (shards must agree on every leaf shape)
+# ---------------------------------------------------------------------------
+
+def _align_columns(cols: Sequence):
+    """Align one column position across shards: byte-matrix widths and
+    array slot widths to the max, recursively."""
+    from ..columnar.column import DeviceColumn
+
+    c0 = cols[0]
+    if c0.is_array_like:
+        w = max(c.array_width for c in cols)
+        cols = [c.with_array_width(w) for c in cols]
+        kids = [_align_columns([c.children[k] for c in cols])
+                for k in range(len(cols[0].children))]
+        return [
+            DeviceColumn(c.dtype, c.data, c.validity, c.lengths, c.aux,
+                         tuple(kids[k][i] for k in range(len(kids))))
+            for i, c in enumerate(cols)]
+    if c0.data is None and c0.children:  # struct
+        kids = [_align_columns([c.children[k] for c in cols])
+                for k in range(len(cols[0].children))]
+        return [
+            DeviceColumn(c.dtype, None, c.validity, c.lengths, c.aux,
+                         tuple(kids[k][i] for k in range(len(kids))))
+            for i, c in enumerate(cols)]
+    if c0.data is not None and c0.data.ndim == 2:
+        import jax.numpy as jnp
+        w = max(int(c.data.shape[1]) for c in cols)
+        return [
+            c if int(c.data.shape[1]) == w else
+            DeviceColumn(c.dtype, jnp.pad(
+                c.data, ((0, 0), (0, w - int(c.data.shape[1])))),
+                c.validity, c.lengths, c.aux, c.children)
+            for c in cols]
+    return list(cols)
+
+
+def align_batches(batches: List) -> List:
+    """Repad a list of same-schema batches to one shared shape signature
+    (common capacity bucket, common string/array widths)."""
+    from ..columnar.batch import ColumnarBatch
+
+    cap = max(b.capacity for b in batches)
+    batches = [b.repadded(cap) if b.capacity != cap else b for b in batches]
+    ncols = batches[0].num_cols
+    per_col = [_align_columns([b.columns[ci] for b in batches])
+               for ci in range(ncols)]
+    return [ColumnarBatch(batches[0].names,
+                          tuple(per_col[ci][i] for ci in range(ncols)),
+                          b.num_rows)
+            for i, b in enumerate(batches)]
+
+
+# ---------------------------------------------------------------------------
+# the mesh exchange
+# ---------------------------------------------------------------------------
+
+def _leaf_fold(leaf, cap: int):
+    """Reshape a row-major leaf to [cap, k, ...]; returns (folded, k)."""
+    if getattr(leaf, "dtype", None) == object:
+        raise MeshShuffleUnsupported("object-dtype host column")
+    m = int(leaf.shape[0])
+    if m == cap:
+        return leaf, 1
+    if m % cap != 0:
+        raise MeshShuffleUnsupported(
+            f"leaf leading dim {m} not a multiple of capacity {cap}")
+    k = m // cap
+    return leaf.reshape((cap, k) + tuple(leaf.shape[1:])), k
+
+
+def mesh_shuffle_batches(mesh, batches: List, pids: List, nt: int) -> List:
+    """Exchange ``n_dev`` per-shard batches into ``nt == n_dev`` target
+    partitions through one compiled all_to_all program over ``mesh``.
+
+    ``batches`` must be shape-aligned (``align_batches``); ``pids[i]`` is an
+    int32 [capacity] array of target partitions for shard i's rows (dead
+    rows' ids are ignored).  Returns one (shrunk) batch per target.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from ..columnar.batch import ColumnarBatch
+    from ..ops.join import compact_indices
+    from .shuffle import build_ici_shuffle
+
+    n_dev = len(batches)
+    if nt != n_dev:
+        raise MeshShuffleUnsupported(
+            f"targets {nt} != mesh devices {n_dev}")
+    cap = batches[0].capacity
+    names = batches[0].names
+
+    leaves0, treedef = jax.tree.flatten(batches[0].columns)
+    folded_per_shard: List[List] = []
+    ks: List[int] = []
+    for b in batches:
+        leaves, td = jax.tree.flatten(b.columns)
+        if td != treedef or len(leaves) != len(leaves0):
+            raise MeshShuffleUnsupported("shards disagree on batch treedef")
+        folded = []
+        for j, leaf in enumerate(leaves):
+            f, k = _leaf_fold(leaf, cap)
+            if len(ks) <= j:
+                ks.append(k)
+            folded.append(f)
+        folded_per_shard.append(folded)
+
+    # stack shards into mesh-global arrays: [n_dev*cap, k, ...]
+    g_leaves = [jnp.concatenate([folded_per_shard[i][j]
+                                 for i in range(n_dev)])
+                for j in range(len(leaves0))]
+    g_pids = jnp.concatenate([jnp.asarray(p).astype(jnp.int32)
+                              for p in pids])
+    g_valid = jnp.concatenate([b.row_mask() for b in batches])
+
+    exchange = build_ici_shuffle(mesh, "data", n_dev, cap)
+    out_cap = n_dev * cap
+    nleaves = len(g_leaves)
+
+    def step(valid, pids_, *leaves):
+        arrays = {str(j): leaf for j, leaf in enumerate(leaves)}
+        recv, rvalid = exchange(arrays, valid, pids_)
+        # on-chip compaction: received rows to the front, count live
+        perm = compact_indices(jnp, rvalid)
+        out = [jnp.take(recv[str(j)], perm, axis=0) for j in range(nleaves)]
+        count = jnp.sum(rvalid).astype(jnp.int32)
+        return (count[None], *out)
+
+    # one compiled program per (mesh size, capacity, leaf signature) —
+    # repeated collects of the same query reuse it (kernel_cache model)
+    from ..sql.physical.kernel_cache import cached_jit
+    key = ("mesh_shuffle", n_dev, cap,
+           tuple((tuple(g.shape), str(g.dtype)) for g in g_leaves))
+
+    jitted = cached_jit(key, shard_map(
+        step, mesh=mesh,
+        in_specs=(P("data"),) * (2 + nleaves),
+        out_specs=(P("data"),) * (1 + nleaves)))
+    with mesh:
+        counts, *outs = jitted(g_valid, g_pids, *g_leaves)
+    counts = np.asarray(counts)
+    STATS["mesh_exchanges"] += 1
+
+    result = []
+    for t in range(nt):
+        leaves_t = []
+        for j, g in enumerate(outs):
+            leaf = g[t * out_cap:(t + 1) * out_cap]
+            if ks[j] != 1:
+                leaf = leaf.reshape((out_cap * ks[j],)
+                                    + tuple(leaf.shape[2:]))
+            leaves_t.append(leaf)
+        cols = jax.tree.unflatten(treedef, leaves_t)
+        result.append(ColumnarBatch.make(names, cols,
+                                         int(counts[t])).shrunk())
+    return result

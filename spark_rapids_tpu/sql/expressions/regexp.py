@@ -114,13 +114,19 @@ class RegExpReplace(_RegexExpr):
         xp = ctx.xp
         rx, reason = self._compiled()
         rep = _lit_str(self.children[2])
-        # worst case: a zero-length match at every position (width+1 of
-        # them) inserts the replacement AND every source byte is kept.
-        # Batches whose worst-case output exceeds the device width cap run
-        # on the host instead of silently truncating (ADVICE r1).
+        # worst-case output width: patterns that can match empty insert the
+        # replacement at every position (width+1 of them) and keep every
+        # source byte; min_len>=1 patterns fit at most width//min_len
+        # matches.  Batches whose worst case exceeds the device width cap
+        # run on the host instead of silently truncating (ADVICE r1).
         width_in = c.data.shape[1]
         rep_b = (rep or "").encode("utf-8")
-        out_w = bucket_width((width_in + 1) * max(len(rep_b), 1) + width_in)
+        if rx is not None and rx.min_len >= 1:
+            nmatch = width_in // rx.min_len
+            worst = width_in + nmatch * max(len(rep_b) - rx.min_len, 0)
+        else:
+            worst = (width_in + 1) * max(len(rep_b), 1) + width_in
+        out_w = bucket_width(worst)
         if rx is None or rep is None or "$" in (rep or "") or \
                 "\\" in (rep or "") or out_w > _MAX_OUT:
             pat = _pyre.compile(self._pattern() or "")

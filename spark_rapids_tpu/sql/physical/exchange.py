@@ -22,6 +22,13 @@ from ..expressions.core import EvalContext
 from .base import TPU, PhysicalPlan, TaskContext
 
 
+def empty_batch_for(attrs) -> ColumnarBatch:
+    """Zero-row batch with the schema of an exec's output attributes."""
+    from ... import types as T
+    return ColumnarBatch.empty(T.StructType(tuple(
+        T.StructField(a.name, a.dtype, True) for a in attrs)))
+
+
 class ShuffleExchangeExec(PhysicalPlan):
     def __init__(self, partitioning: Partitioning, child: PhysicalPlan,
                  backend=TPU):
@@ -49,7 +56,12 @@ class ShuffleExchangeExec(PhysicalPlan):
         """Map side: split each child batch by target and hand the pieces to
         the shuffle manager (serializer + SORT/MULTITHREADED/ICI data
         plane); reduce side then fetches + host-concats per partition
-        (SURVEY §3.4 write/read paths)."""
+        (SURVEY §3.4 write/read paths).
+
+        ICI mode with a live multi-device mesh routes the whole exchange
+        through ONE compiled all_to_all program instead
+        (parallel/mesh.py) — the planned-query analog of the reference's
+        UCX device-direct path."""
         if self._materialized is not None:
             return
         from ...shuffle import get_shuffle_manager
@@ -58,17 +70,26 @@ class ShuffleExchangeExec(PhysicalPlan):
         mgr = get_shuffle_manager(tctx.conf)
         shuffle_id = mgr.new_shuffle_id()
 
-        if isinstance(self.partitioning, RangePartitioning):
-            self._compute_range_bounds(tctx)
-
+        # run the child plan exactly ONCE; every downstream consumer
+        # (range-bounds sampling, mesh plane, local plane) shares the
+        # collected map outputs
         num_maps = child.num_partitions()
+        map_out: List[Optional[ColumnarBatch]] = []
         for cpid in range(num_maps):
-            map_batches = list(child.execute(cpid,
-                                             TaskContext(cpid, tctx.conf)))
-            if not map_batches:
+            got = list(child.execute(cpid, TaskContext(cpid, tctx.conf)))
+            map_out.append(ColumnarBatch.concat(got) if len(got) > 1
+                           else (got[0] if got else None))
+
+        if isinstance(self.partitioning, RangePartitioning):
+            self._compute_range_bounds(map_out)
+
+        if mgr.mode == "ICI" and self.backend == TPU and nt > 1:
+            if self._try_mesh_materialize(map_out, nt):
+                return
+
+        for cpid, merged in enumerate(map_out):
+            if merged is None:
                 continue
-            merged = ColumnarBatch.concat(map_batches) \
-                if len(map_batches) > 1 else map_batches[0]
             if nt == 1:
                 pieces: List[Optional[ColumnarBatch]] = [merged]
             else:
@@ -85,26 +106,60 @@ class ShuffleExchangeExec(PhysicalPlan):
         mgr.cleanup(shuffle_id)
         self._materialized = out
 
-    def _compute_range_bounds(self, tctx: TaskContext):
-        """Sample child output, sort sample by the orders, take quantile rows
-        as bounds (reference GpuRangePartitioner.createRangeBounds)."""
+    def _empty_batch(self) -> ColumnarBatch:
+        return empty_batch_for(self.output)
+
+    def _try_mesh_materialize(self, map_out: List[Optional[ColumnarBatch]],
+                              nt: int) -> bool:
+        """Run the exchange through the compiled mesh all_to_all plane.
+        Returns False (clean fallback to the local plane) when no multi-
+        device mesh exists or the batch layout cannot ride it."""
+        from ...parallel.mesh import (MeshShuffleUnsupported, align_batches,
+                                      device_mesh, mesh_shuffle_batches)
+        mesh = device_mesh(nt)
+        if mesh is None:
+            return False
+        n_dev = nt
+
+        # group map outputs onto the n_dev shards (m -> m % n_dev)
+        shard_batches: List[List[ColumnarBatch]] = [[] for _ in range(n_dev)]
+        for cpid, b in enumerate(map_out):
+            if b is not None:
+                shard_batches[cpid % n_dev].append(b)
+        merged = [ColumnarBatch.concat(bs) if len(bs) > 1
+                  else (bs[0] if bs else self._empty_batch())
+                  for bs in shard_batches]
+        try:
+            aligned = align_batches(merged)
+            pids = []
+            for i, b in enumerate(aligned):
+                ctx = EvalContext(b, xp=self.xp)
+                pids.append(self.partitioning.partition_ids(ctx, b, i))
+            out = mesh_shuffle_batches(mesh, aligned, pids, nt)
+        except MeshShuffleUnsupported:
+            from ...parallel.mesh import STATS
+            STATS["fallbacks"] += 1
+            return False
+        self._materialized = [[b] if b.num_rows_int > 0 else []
+                              for b in out]
+        return True
+
+    def _compute_range_bounds(self, map_out: List[Optional[ColumnarBatch]]):
+        """Sample the collected map outputs, sort the sample by the orders,
+        take quantile rows as bounds (reference
+        GpuRangePartitioner.createRangeBounds)."""
         from .sortlimit import SortExec
-        child = self.children[0]
         part: RangePartitioning = self.partitioning  # type: ignore
         samples = []
-        for cpid in range(child.num_partitions()):
-            for batch in child.execute(cpid, TaskContext(cpid, tctx.conf)):
-                n = batch.num_rows_int
-                if n > 4096:  # cheap deterministic sample
-                    batch = batch.sliced(0, 4096)
-                samples.append(batch)
+        for batch in map_out:
+            if batch is None:
+                continue
+            n = batch.num_rows_int
+            if n > 4096:  # cheap deterministic sample
+                batch = batch.sliced(0, 4096)
+            samples.append(batch)
         if not samples:
-            schema = self.children[0].output
-            from ... import types as T
-            from ...columnar.batch import ColumnarBatch as CB
-            empty = CB.empty(T.StructType(tuple(
-                T.StructField(a.name, a.dtype, True) for a in schema)))
-            part.set_bounds(empty)
+            part.set_bounds(self._empty_batch())
             return
         merged = ColumnarBatch.concat(samples) if len(samples) > 1 else samples[0]
         sorter = SortExec(part.orders, self.children[0], self.backend)
@@ -155,11 +210,7 @@ class BroadcastExchangeExec(PhysicalPlan):
                 batches.extend(self.children[0].execute(
                     cpid, TaskContext(cpid, tctx.conf)))
             if not batches:
-                from ... import types as T
-                schema = T.StructType(tuple(
-                    T.StructField(a.name, a.dtype, True)
-                    for a in self.output))
-                self._cached = ColumnarBatch.empty(schema)
+                self._cached = empty_batch_for(self.output)
             else:
                 self._cached = (ColumnarBatch.concat(batches)
                                 if len(batches) > 1 else batches[0])

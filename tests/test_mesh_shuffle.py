@@ -1,0 +1,122 @@
+"""Planned queries over the 8-device virtual mesh: ShuffleExchangeExec
+routes through the compiled all_to_all data plane (parallel/mesh.py), the
+engine-level analog of the reference's UCX device-direct shuffle
+(RapidsShuffleClient.scala / GpuShuffleExchangeExecBase.scala:266-277).
+
+Oracle: the same query on the default (local) shuffle plane + pandas."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.parallel import mesh as M
+from spark_rapids_tpu.sql import functions as F
+
+ICI_CONF = {"spark.rapids.shuffle.mode": "ICI",
+            "spark.sql.shuffle.partitions": 8}
+
+
+@pytest.fixture()
+def ici_sess():
+    return srt.session(**ICI_CONF)
+
+
+def make_tables(rng, n=4000):
+    left = pa.table({
+        "k": rng.integers(0, 200, n),
+        "v": rng.random(n),
+        "s": [f"name{i % 101}" for i in range(n)],
+    })
+    right = pa.table({
+        "k": pa.array(np.arange(150), type=pa.int64()),
+        "w": pa.array(np.arange(150) * 10.0),
+    })
+    return left, right
+
+
+def test_mesh_groupby_agg_matches_local(ici_sess, rng):
+    left, _ = make_tables(rng)
+    before = M.STATS["mesh_exchanges"]
+    df = ici_sess.create_dataframe(left, num_partitions=8)
+    got = (df.groupBy("k")
+           .agg(F.sum(df.v).alias("sv"), F.count("*").alias("c"),
+                F.max(df.v).alias("mx"))
+           .orderBy("k").collect().to_pandas())
+    assert M.STATS["mesh_exchanges"] > before, "exchange did not ride mesh"
+    exp = (left.to_pandas().groupby("k")
+           .agg(sv=("v", "sum"), c=("v", "size"), mx=("v", "max"))
+           .reset_index())
+    assert np.array_equal(got["k"], exp["k"])
+    assert np.array_equal(got["c"], exp["c"])
+    assert np.allclose(got["sv"], exp["sv"])
+    assert np.allclose(got["mx"], exp["mx"])
+
+
+def test_mesh_shuffled_join_matches_pandas(ici_sess, rng):
+    left, right = make_tables(rng)
+    before = M.STATS["mesh_exchanges"]
+    # force a shuffled hash join (defeat broadcast with a tiny threshold)
+    sess = srt.session(**ICI_CONF,
+                       **{"spark.rapids.sql.autoBroadcastJoinThreshold": 1})
+    l = sess.create_dataframe(left, num_partitions=8)
+    r = sess.create_dataframe(right, num_partitions=4)
+    got = (l.join(r, on="k", how="inner")
+           .select(l.k, l.v, r.w)
+           .orderBy("k", "v").collect().to_pandas())
+    assert M.STATS["mesh_exchanges"] > before
+    exp = (left.to_pandas().merge(right.to_pandas(), on="k", how="inner")
+           .sort_values(["k", "v"]).reset_index(drop=True))
+    assert len(got) == len(exp)
+    assert np.array_equal(got["k"], exp["k"])
+    assert np.allclose(got["v"], exp["v"])
+    assert np.allclose(got["w"], exp["w"])
+
+
+def test_mesh_sort_range_partitioned(ici_sess, rng):
+    """orderBy over the mesh: RangePartitioning pids + all_to_all."""
+    left, _ = make_tables(rng)
+    before = M.STATS["mesh_exchanges"]
+    df = ici_sess.create_dataframe(left, num_partitions=8)
+    got = df.orderBy("k", "v").select(df.k, df.v).collect().to_pandas()
+    exp = (left.to_pandas()[["k", "v"]]
+           .sort_values(["k", "v"]).reset_index(drop=True))
+    assert np.array_equal(got["k"], exp["k"])
+    assert np.allclose(got["v"], exp["v"])
+    # global sort may use range exchange or a single-partition merge —
+    # only assert mesh usage when a multi-partition exchange happened
+    assert M.STATS["mesh_exchanges"] >= before
+
+
+def test_mesh_string_and_null_columns_roundtrip(ici_sess, rng):
+    n = 1000
+    ks = rng.integers(0, 40, n)
+    vs = rng.random(n)
+    vs_null = [None if i % 7 == 0 else float(v) for i, v in enumerate(vs)]
+    t = pa.table({"k": ks, "v": pa.array(vs_null, type=pa.float64()),
+                  "s": [f"x{'y' * (i % 13)}{i % 5}" for i in range(n)]})
+    before = M.STATS["mesh_exchanges"]
+    df = ici_sess.create_dataframe(t, num_partitions=8)
+    got = (df.groupBy("s").agg(F.count(df.v).alias("c"),
+                               F.sum(df.v).alias("sv"))
+           .orderBy("s").collect().to_pandas())
+    assert M.STATS["mesh_exchanges"] > before
+    exp = (t.to_pandas().groupby("s")
+           .agg(c=("v", "count"), sv=("v", "sum")).reset_index())
+    assert list(got["s"]) == list(exp["s"])
+    assert np.array_equal(got["c"], exp["c"])
+    assert np.allclose(got["sv"], exp["sv"])
+
+
+def test_mesh_repartition_preserves_rows(ici_sess, rng):
+    n = 3000
+    t = pa.table({"k": rng.integers(0, 1000, n), "v": rng.random(n)})
+    before = M.STATS["mesh_exchanges"]
+    df = ici_sess.create_dataframe(t, num_partitions=8)
+    got = df.repartition(8, "k").collect()
+    assert M.STATS["mesh_exchanges"] > before
+    assert got.num_rows == n
+    a = sorted(zip(got["k"].to_pylist(), got["v"].to_pylist()))
+    b = sorted(zip(t["k"].to_pylist(), t["v"].to_pylist()))
+    assert a == b
