@@ -89,6 +89,17 @@ MAX_READER_BATCH_SIZE_ROWS = register(
 MAX_READER_BATCH_SIZE_BYTES = register(
     "spark.rapids.sql.reader.batchSizeBytes",
     "Soft cap on bytes per batch produced by readers.", (1 << 31) - 1)
+SORT_OOC_TARGET_ROWS = register(
+    "spark.rapids.sql.sort.outOfCore.targetRows",
+    "Row budget per device-resident chunk in the out-of-core sort "
+    "(reference GpuOutOfCoreSortIterator, GpuSortExec.scala:242): inputs "
+    "larger than this are sorted as spillable runs and k-way merged in "
+    "chunks of at most this many rows.", 1 << 22)
+JOIN_OUTPUT_CHUNK_ROWS = register(
+    "spark.rapids.sql.join.outputChunkRows",
+    "Join outputs larger than this many rows are gathered in chunks of "
+    "this size instead of one worst-case buffer (reference "
+    "JoinGatherer.scala:730 lazy chunked gather).", 1 << 22)
 CONCURRENT_TASKS = register(
     "spark.rapids.sql.concurrentGpuTasks",
     "Number of tasks that may hold the device semaphore concurrently "
@@ -158,6 +169,23 @@ SHUFFLE_MODE = register(
     "UCX|MULTITHREADED|SORT in the reference; here ICI|MULTITHREADED|SORT — "
     "ICI keeps partitions in device memory and exchanges over the "
     "interconnect with XLA collectives.", "MULTITHREADED")
+SHUFFLE_TRANSPORT_CLASS = register(
+    "spark.rapids.shuffle.transport.type",
+    "LOCAL (in-process store) or TCP (cross-process block server + driver "
+    "registry, the UCX-transport analog for cross-host fetches; "
+    "RapidsShuffleTransport SPI).", "LOCAL")
+SHUFFLE_TCP_DRIVER_ENDPOINT = register(
+    "spark.rapids.shuffle.tcp.driverEndpoint",
+    "host:port of the driver heartbeat registry for the TCP transport "
+    "(RapidsShuffleHeartbeatManager analog); empty = standalone.", "")
+SHUFFLE_TCP_BIND_HOST = register(
+    "spark.rapids.shuffle.tcp.bindHost",
+    "Address the TCP shuffle block server binds and advertises; set to "
+    "this host's reachable address for multi-host deployments.",
+    "127.0.0.1")
+SHUFFLE_EXECUTOR_ID = register(
+    "spark.rapids.shuffle.executorId",
+    "This process's executor id for shuffle peer discovery.", "exec-0")
 SHUFFLE_WRITER_THREADS = register(
     "spark.rapids.shuffle.multiThreaded.writer.threads",
     "Threads for the multithreaded shuffle writer.", 8)

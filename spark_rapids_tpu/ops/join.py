@@ -146,13 +146,19 @@ class PairMaps(NamedTuple):
 
 def gather_pairs(xp, info: JoinInfo, out_cap: int,
                  with_unmatched_left: bool = False,
-                 with_unmatched_right: bool = False) -> PairMaps:
+                 with_unmatched_right: bool = False,
+                 offset=0) -> PairMaps:
     """Phase 2: enumerate output rows.  Layout: [inner pairs][unmatched left]
     [unmatched right] — segment starts are traced scalars, segment membership
-    is a per-slot compare, so the whole thing stays static-shape."""
+    is a per-slot compare, so the whole thing stays static-shape.
+
+    ``offset`` (traced scalar ok) selects the window [offset, offset+out_cap)
+    of the global output — the chunked-gather contract of the reference's
+    ``JoinGatherer.scala:730``: one compiled program per chunk capacity
+    serves every chunk of an arbitrarily large join output."""
     lcap = info.counts.shape[0]
     rcap = info.perm_b.shape[0]
-    k = xp.arange(out_cap, dtype=xp.int64)
+    k = xp.arange(out_cap, dtype=xp.int64) + xp.asarray(offset, dtype=xp.int64)
 
     i = xp.searchsorted(info.csum, k, side="right")
     i = xp.clip(i, 0, max(lcap - 1, 0)).astype(xp.int32)
@@ -183,21 +189,24 @@ def gather_pairs(xp, info: JoinInfo, out_cap: int,
         r_ok = r_ok | sel
         num_out = num_out + info.n_unmatched_b
 
-    return PairMaps(l_idx, r_idx, l_ok, r_ok, num_out.astype(xp.int32))
+    local = xp.clip(num_out - xp.asarray(offset, dtype=xp.int64), 0, out_cap)
+    return PairMaps(l_idx, r_idx, l_ok, r_ok, local.astype(xp.int32))
 
 
-def cross_pairs(xp, n_left, n_right, out_cap: int) -> PairMaps:
+def cross_pairs(xp, n_left, n_right, out_cap: int, offset=0) -> PairMaps:
     """All (i, j) combinations for nested-loop/cartesian joins.  n_left and
-    n_right may be traced scalars; out_cap must cover n_left*n_right."""
-    k = xp.arange(out_cap, dtype=xp.int64)
+    n_right may be traced scalars; ``offset`` windows the pair space like
+    :func:`gather_pairs`."""
+    k = xp.arange(out_cap, dtype=xp.int64) + xp.asarray(offset, dtype=xp.int64)
     nr = xp.maximum(xp.asarray(n_right, dtype=xp.int64), 1)
     i = (k // nr).astype(xp.int32)
     j = (k % nr).astype(xp.int32)
     total = (xp.asarray(n_left, dtype=xp.int64)
              * xp.asarray(n_right, dtype=xp.int64))
     ok = k < total
+    local = xp.clip(total - xp.asarray(offset, dtype=xp.int64), 0, out_cap)
     return PairMaps(xp.where(ok, i, 0), xp.where(ok, j, 0), ok, ok,
-                    total.astype(xp.int32))
+                    local.astype(xp.int32))
 
 
 def matched_per_row(xp, pass_mask, idx, cap: int):

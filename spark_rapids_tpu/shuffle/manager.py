@@ -23,11 +23,29 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from ..columnar.batch import ColumnarBatch
-from ..config import (RapidsConf, SHUFFLE_MODE, SHUFFLE_READER_THREADS,
-                      SHUFFLE_WRITER_THREADS, SPILL_DIR)
+from ..config import (RapidsConf, SHUFFLE_EXECUTOR_ID, SHUFFLE_MODE,
+                      SHUFFLE_READER_THREADS, SHUFFLE_TCP_DRIVER_ENDPOINT,
+                      SHUFFLE_TRANSPORT_CLASS, SHUFFLE_WRITER_THREADS,
+                      SPILL_DIR)
 from .serializer import concat_serialized, serialize_batch
 from .transport import (BlockId, LocalTransport, PeerInfo,
                         ShuffleHeartbeatManager, ShuffleTransport)
+
+
+def _transport_from_conf(conf: RapidsConf, executor_id: str):
+    """Build (transport, heartbeats) per the conf: LOCAL in-process store,
+    or the TCP block server + driver registry client (shuffle/tcp.py)."""
+    kind = str(conf.get(SHUFFLE_TRANSPORT_CLASS)).upper()
+    if kind == "TCP":
+        from ..config import SHUFFLE_TCP_BIND_HOST
+        from .tcp import TcpHeartbeatClient, TcpShuffleTransport
+        transport = TcpShuffleTransport(
+            executor_id, host=str(conf.get(SHUFFLE_TCP_BIND_HOST)))
+        driver = str(conf.get(SHUFFLE_TCP_DRIVER_ENDPOINT))
+        heartbeats = (TcpHeartbeatClient(driver) if driver
+                      else ShuffleHeartbeatManager())
+        return transport, heartbeats
+    return LocalTransport(), ShuffleHeartbeatManager()
 
 
 class ShuffleManager:
@@ -35,14 +53,19 @@ class ShuffleManager:
 
     def __init__(self, conf: Optional[RapidsConf] = None,
                  transport: Optional[ShuffleTransport] = None,
-                 executor_id: str = "exec-0",
+                 executor_id: Optional[str] = None,
                  heartbeats: Optional[ShuffleHeartbeatManager] = None):
         self.conf = conf or RapidsConf.get_global()
         self.mode = str(self.conf.get(SHUFFLE_MODE)).upper()
+        executor_id = executor_id or str(self.conf.get(SHUFFLE_EXECUTOR_ID))
         self.executor_id = executor_id
+        if transport is None and heartbeats is None:
+            transport, heartbeats = _transport_from_conf(self.conf,
+                                                         executor_id)
         self.transport = transport or LocalTransport()
         self.heartbeats = heartbeats or ShuffleHeartbeatManager()
-        self.peers = self.heartbeats.register(executor_id, "local")
+        self.peers = self.heartbeats.register(
+            executor_id, getattr(self.transport, "endpoint", "local"))
         self._next_shuffle = 0
         self._lock = threading.Lock()
         self._files: Dict[BlockId, str] = {}
@@ -100,10 +123,20 @@ class ShuffleManager:
                 me = PeerInfo(self.executor_id, "local")
                 frame = self.transport.fetch(me, block)
                 if frame is None:
+                    # a network failure must not masquerade as an empty
+                    # partition: only "every reachable peer says missing"
+                    # may return None (FetchFailed contract, tcp.py)
+                    last_err: Optional[Exception] = None
                     for peer in self.heartbeats.heartbeat(self.executor_id):
-                        frame = self.transport.fetch(peer, block)
+                        try:
+                            frame = self.transport.fetch(peer, block)
+                        except ConnectionError as e:
+                            last_err = e
+                            continue
                         if frame is not None:
                             break
+                    if frame is None and last_err is not None:
+                        raise last_err
                 return frame
             with self._lock:
                 path = self._files.get(block)
@@ -124,7 +157,7 @@ class ShuffleManager:
 
     # ------------------------------------------------------------------
     def cleanup(self, shuffle_id: Optional[int] = None):
-        if isinstance(self.transport, LocalTransport):
+        if hasattr(self.transport, "clear"):
             self.transport.clear(shuffle_id)
         with self._lock:
             victims = [b for b in self._files

@@ -1,0 +1,342 @@
+"""Cross-process TCP shuffle transport + driver heartbeat endpoint — the
+host-network analog of the reference's UCX peer-to-peer plane
+(``RapidsShuffleClient.scala:476``, ``RapidsShuffleServer.scala:445``,
+``UCX.scala:1119`` mgmt-port handshake) with the driver-side peer registry
+(``RapidsShuffleHeartbeatManager.scala:255``, RPC receive
+``Plugin.scala:290-301``).
+
+On-pod exchanges ride ICI inside compiled programs (parallel/mesh.py); this
+transport is the cross-host data plane those collectives cannot reach (the
+DCN/gRPC tier of SURVEY §2.8's TPU note), and the SPI seam the reference's
+transport-mock tests model.
+
+Wire protocol (all big-endian):
+
+* block fetch:  request  ``magic u32 | op u8 | shuffle i64 | map i64 |
+  reduce i64``; response ``status u8 | len u64 | payload``.
+* registry ops: request ``magic u32 | op u8 | len u32 | json``;
+  response ``len u32 | json`` (peer list).  One driver process serves the
+  registry; executors register their (executor_id, host:port) and poll.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .transport import BlockId, PeerInfo, ShuffleTransport
+
+_MAGIC = 0x53525054  # "SRPT"
+_OP_FETCH = 1
+_OP_REGISTER = 2
+_OP_HEARTBEAT = 3
+
+_REQ = struct.Struct(">IBqqq")
+_RESP_HEAD = struct.Struct(">BQ")
+_JSON_HEAD = struct.Struct(">IBI")
+_JSON_RESP = struct.Struct(">I")
+
+_FOUND, _MISSING = 0, 1
+
+
+class ShuffleFetchFailed(ConnectionError):
+    """Network-level fetch failure (the reference's FetchFailed analog) —
+    distinct from a peer authoritatively reporting the block missing
+    (which is legitimate: empty reduce partitions are never published)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise ConnectionError("peer closed mid-message")
+        buf.extend(got)
+    return bytes(buf)
+
+
+class _Server:
+    """Minimal threaded accept loop shared by the block server and the
+    driver registry (one handler thread per connection, connections are
+    reused for many requests — the UCX progress-thread analog is the OS)."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"srt-shuffle-server-{self.port}",
+                             daemon=True)
+        t.start()
+        self._accept_thread = t
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            with conn:
+                while not self._closed:
+                    head = _recv_exact(conn, _REQ.size)
+                    magic, op, a, b, c = _REQ.unpack(head)
+                    if magic != _MAGIC:
+                        return
+                    if op == _OP_FETCH:
+                        payload = self._handler(op, BlockId(a, b, c), None)
+                        if payload is None:
+                            conn.sendall(_RESP_HEAD.pack(_MISSING, 0))
+                        else:
+                            conn.sendall(_RESP_HEAD.pack(_FOUND, len(payload))
+                                         + payload)
+                    else:  # registry op: a carries the json length
+                        body = _recv_exact(conn, a)
+                        out = self._handler(op, None, json.loads(body))
+                        blob = json.dumps(out).encode()
+                        conn.sendall(_JSON_RESP.pack(len(blob)) + blob)
+        except (ConnectionError, OSError):
+            return
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpShuffleTransport(ShuffleTransport):
+    """Each executor runs one block server; ``publish`` stores frames in
+    the local serving store, ``fetch`` pulls from the peer's endpoint over
+    a pooled connection (own blocks short-circuit to the local store)."""
+
+    def __init__(self, executor_id: str = "exec-0", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.executor_id = executor_id
+        self._store: Dict[BlockId, bytes] = {}
+        self._lock = threading.Lock()
+        self._server = _Server(self._handle, host, port)
+        self._conns: Dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        # request-response pairs must not interleave on a pooled socket
+        self._endpoint_locks: Dict[str, threading.Lock] = {}
+
+    @property
+    def endpoint(self) -> str:
+        return self._server.endpoint
+
+    # --- server side ------------------------------------------------------
+    def _handle(self, op: int, block: Optional[BlockId], _js):
+        if op != _OP_FETCH:
+            return {"error": "not a registry endpoint"}
+        with self._lock:
+            return self._store.get(block)
+
+    # --- SPI --------------------------------------------------------------
+    def publish(self, executor_id: str, block: BlockId, frame: bytes) -> None:
+        with self._lock:
+            self._store[block] = frame
+
+    def fetch(self, peer: PeerInfo, block: BlockId) -> Optional[bytes]:
+        """Returns the frame, None when the peer authoritatively reports
+        the block missing, and raises :class:`ShuffleFetchFailed` on
+        network failure — callers must NOT treat a failure as an empty
+        partition (silent data loss)."""
+        if peer.executor_id == self.executor_id or peer.endpoint in (
+                "local", self.endpoint):
+            with self._lock:
+                return self._store.get(block)
+        with self._conn_lock:
+            ep_lock = self._endpoint_locks.setdefault(peer.endpoint,
+                                                      threading.Lock())
+        with ep_lock:
+            for attempt in (0, 1):  # one reconnect on a stale pooled socket
+                sock = self._connection(peer.endpoint, fresh=attempt > 0)
+                if sock is None:
+                    continue
+                try:
+                    sock.sendall(_REQ.pack(_MAGIC, _OP_FETCH,
+                                           block.shuffle_id, block.map_id,
+                                           block.reduce_id))
+                    status, n = _RESP_HEAD.unpack(
+                        _recv_exact(sock, _RESP_HEAD.size))
+                    if status == _MISSING:
+                        return None
+                    return _recv_exact(sock, n)
+                except (ConnectionError, OSError):
+                    self._drop_connection(peer.endpoint)
+        raise ShuffleFetchFailed(
+            f"cannot fetch block {block} from {peer.executor_id} "
+            f"({peer.endpoint})")
+
+    # --- connection pool --------------------------------------------------
+    def _connection(self, endpoint: str, fresh: bool = False
+                    ) -> Optional[socket.socket]:
+        with self._conn_lock:
+            if fresh:
+                self._drop_connection(endpoint)
+            sock = self._conns.get(endpoint)
+            if sock is not None:
+                return sock
+            try:
+                host, port = endpoint.rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)), timeout=10)
+            except OSError:
+                return None
+            self._conns[endpoint] = sock
+            return sock
+
+    def _drop_connection(self, endpoint: str):
+        sock = self._conns.pop(endpoint, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def blocks_of(self, executor_id: str) -> List[BlockId]:
+        with self._lock:
+            return list(self._store)
+
+    def clear(self, shuffle_id: Optional[int] = None):
+        with self._lock:
+            if shuffle_id is None:
+                self._store.clear()
+            else:
+                for b in [b for b in self._store
+                          if b.shuffle_id == shuffle_id]:
+                    del self._store[b]
+
+    def close(self) -> None:
+        self._server.close()
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+class TcpHeartbeatServer:
+    """Driver-side registry served over TCP: executors REGISTER once and
+    HEARTBEAT periodically; both return the live peer set.  Peers missing
+    their heartbeat past the timeout are expired (the reference expires
+    via ``RapidsShuffleHeartbeatManager`` bookkeeping)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout_s: float = 60.0):
+        self._peers: Dict[str, PeerInfo] = {}
+        self._lock = threading.Lock()
+        self._timeout = heartbeat_timeout_s
+        self._server = _Server(self._handle, host, port)
+
+    @property
+    def endpoint(self) -> str:
+        return self._server.endpoint
+
+    def _handle(self, op: int, _block, js):
+        if op not in (_OP_REGISTER, _OP_HEARTBEAT):
+            return {"error": "bad op"}
+        eid = js["executor_id"]
+        now = time.monotonic()
+        with self._lock:
+            if op == _OP_REGISTER or eid not in self._peers:
+                # heartbeats re-register executors whose entry expired
+                # during a long stall (compile/GC pause) so they regain
+                # visibility instead of being invisible forever
+                endpoint = js.get("endpoint", "")
+                if op == _OP_REGISTER or endpoint:
+                    self._peers[eid] = PeerInfo(eid, endpoint, now)
+            else:
+                self._peers[eid].last_heartbeat = now
+            dead = [e for e, p in self._peers.items()
+                    if now - p.last_heartbeat > self._timeout]
+            for e in dead:
+                del self._peers[e]
+            return {"peers": [
+                {"executor_id": p.executor_id, "endpoint": p.endpoint}
+                for e, p in self._peers.items() if e != eid]}
+
+    def executors(self) -> List[str]:
+        with self._lock:
+            return list(self._peers)
+
+    def close(self):
+        self._server.close()
+
+
+class TcpHeartbeatClient:
+    """Executor-side view of the driver registry; duck-types
+    ``ShuffleHeartbeatManager`` (register/heartbeat -> peer list) so the
+    shuffle manager is transport-agnostic."""
+
+    def __init__(self, driver_endpoint: str):
+        self._endpoint = driver_endpoint
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._my_endpoint = ""  # remembered at register for re-registration
+
+    def _request(self, op: int, payload: dict) -> List[PeerInfo]:
+        body = json.dumps(payload).encode()
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        host, port = self._endpoint.rsplit(":", 1)
+                        self._sock = socket.create_connection(
+                            (host, int(port)), timeout=10)
+                    self._sock.sendall(
+                        _REQ.pack(_MAGIC, op, len(body), 0, 0) + body)
+                    (n,) = _JSON_RESP.unpack(
+                        _recv_exact(self._sock, _JSON_RESP.size))
+                    out = json.loads(_recv_exact(self._sock, n))
+                    return [PeerInfo(p["executor_id"], p["endpoint"])
+                            for p in out.get("peers", [])]
+                except (ConnectionError, OSError):
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+        return []
+
+    def register(self, executor_id: str, endpoint: str) -> List[PeerInfo]:
+        self._my_endpoint = endpoint
+        return self._request(_OP_REGISTER, {"executor_id": executor_id,
+                                            "endpoint": endpoint})
+
+    def heartbeat(self, executor_id: str) -> List[PeerInfo]:
+        return self._request(_OP_HEARTBEAT,
+                             {"executor_id": executor_id,
+                              "endpoint": self._my_endpoint})
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

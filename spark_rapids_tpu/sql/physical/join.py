@@ -31,6 +31,9 @@ from .exchange import BroadcastExchangeExec
 _PAIR_JOINS = ("inner", "left", "full", "cross")
 _FILTER_JOINS = ("left_semi", "left_anti", "existence")
 
+#: observability for tests
+STATS = {"chunked_joins": 0}
+
 
 class BaseJoinExec(PhysicalPlan):
     """Shared machinery: side normalization (right joins flip to left),
@@ -249,12 +252,67 @@ class BaseJoinExec(PhysicalPlan):
             (int(info.n_unmatched_b) if how == "full" else 0)
         return bucket_capacity(total + extra)
 
+    def _cached_kernel(self, tag: str, chunk_cap: int, make_impl):
+        """Get-or-build the jitted windowed kernel for (tag, chunk_cap) —
+        shared by the hash-join and nested-loop chunked gathers."""
+        key = (tag, chunk_cap)
+        fn = self._gather_cache.get(key)
+        if fn is None:
+            fn = self._jit(make_impl(), key=(tag, self._sig, chunk_cap))
+            self._gather_cache[key] = fn
+        return fn
+
+    def _chunk_fn(self, chunk_cap: int):
+        """Windowed gather (JoinGatherer.scala:730 analog): one compiled
+        program per chunk capacity; the window offset is a traced scalar."""
+        how = self._norm_how
+
+        def make():
+            def impl(probe, build, info, offset):
+                maps = gather_pairs(
+                    self.xp, info, chunk_cap,
+                    with_unmatched_left=how in ("left", "full"),
+                    with_unmatched_right=how == "full",
+                    offset=offset)
+                pair = self._pair_batch(probe, build, maps)
+                return self._project_output(pair, maps)
+            return impl
+        return self._cached_kernel("gather_chunk", chunk_cap, make)
+
     def _join_one(self, probe: ColumnarBatch, build: ColumnarBatch
                   ) -> ColumnarBatch:
         info = self._build_fn(probe, build)
         out_cap = self._out_capacity(info, probe.num_rows_int,
                                      build.num_rows_int)
         return self._gather_fn(out_cap)(probe, build, info)
+
+    def _join_batches(self, probe: ColumnarBatch, build: ColumnarBatch,
+                      tctx: TaskContext):
+        """Yield the join output, chunked when it exceeds the configured
+        chunk rows (condition/filter joins keep the single-buffer path —
+        their residual bookkeeping spans the whole pair space)."""
+        how = self._norm_how
+        if (self._bound_cond is not None or how in _FILTER_JOINS):
+            yield self._join_one(probe, build)
+            return
+        from ...config import JOIN_OUTPUT_CHUNK_ROWS
+        chunk = int(tctx.conf.get(JOIN_OUTPUT_CHUNK_ROWS))
+        info = self._build_fn(probe, build)
+        total_out = int(info.total) + \
+            (int(info.n_unmatched_l) if how in ("left", "full") else 0) + \
+            (int(info.n_unmatched_b) if how == "full" else 0)
+        if total_out <= chunk:
+            out_cap = self._out_capacity(info, probe.num_rows_int,
+                                         build.num_rows_int)
+            yield self._gather_fn(out_cap)(probe, build, info)
+            return
+        STATS["chunked_joins"] += 1
+        chunk_cap = bucket_capacity(chunk)
+        fn = self._chunk_fn(chunk_cap)
+        xp = self.xp
+        for off in range(0, total_out, chunk_cap):
+            yield fn(probe, build, info,
+                     xp.asarray(off, dtype=xp.int64)).shrunk()
 
     # --- helpers ----------------------------------------------------------
     def _empty_batch(self, attrs) -> ColumnarBatch:
@@ -299,7 +357,7 @@ class ShuffledHashJoinExec(BaseJoinExec):
         if not probes:
             probes = [self._empty_batch(self._probe.output)]
         for probe in probes:
-            yield self._join_one(probe, build)
+            yield from self._join_batches(probe, build, tctx)
 
 
 class BroadcastHashJoinExec(BaseJoinExec):
@@ -318,7 +376,7 @@ class BroadcastHashJoinExec(BaseJoinExec):
         if not probes:
             probes = [self._empty_batch(self._probe.output)]
         for probe in probes:
-            yield self._join_one(probe, build)
+            yield from self._join_batches(probe, build, tctx)
 
 
 class NestedLoopJoinExec(BaseJoinExec):
@@ -352,6 +410,35 @@ class NestedLoopJoinExec(BaseJoinExec):
             fn = self._jit(impl, key=("nl", self._sig, out_cap))
             self._gather_cache[out_cap] = fn
         return fn
+
+    def _join_batches(self, probe: ColumnarBatch, build: ColumnarBatch,
+                      tctx: TaskContext):
+        """Chunk the (probe x build) pair space for condition-free
+        inner/cross products; everything else keeps the one-buffer path."""
+        how = self._norm_how
+        if self._bound_cond is not None or how not in ("inner", "cross"):
+            yield self._join_one(probe, build)
+            return
+        from ...config import JOIN_OUTPUT_CHUNK_ROWS
+        chunk = int(tctx.conf.get(JOIN_OUTPUT_CHUNK_ROWS))
+        total = probe.num_rows_int * build.num_rows_int
+        if total <= chunk:
+            yield self._join_one(probe, build)
+            return
+        STATS["chunked_joins"] += 1
+        chunk_cap = bucket_capacity(chunk)
+
+        def make():
+            def impl(probe_, build_, offset):
+                maps = cross_pairs(self.xp, probe_.num_rows,
+                                   build_.num_rows, chunk_cap, offset=offset)
+                pair = self._pair_batch(probe_, build_, maps)
+                return self._project_output(pair, maps)
+            return impl
+        fn = self._cached_kernel("nl_chunk", chunk_cap, make)
+        xp = self.xp
+        for off in range(0, total, chunk_cap):
+            yield fn(probe, build, xp.asarray(off, dtype=xp.int64)).shrunk()
 
     def _nl_impl(self, probe: ColumnarBatch, build: ColumnarBatch,
                  out_cap: int) -> ColumnarBatch:
@@ -391,7 +478,7 @@ class NestedLoopJoinExec(BaseJoinExec):
         if not probes:
             probes = [self._empty_batch(self._probe.output)]
         for probe in probes:
-            yield self._join_one(probe, build)
+            yield from self._join_batches(probe, build, tctx)
 
 
 # --------------------------------------------------------------------------

@@ -1,9 +1,21 @@
 """Sort exec (reference ``GpuSortExec.scala``: full + out-of-core sort).
-Round 1: full in-partition sort (concat batches -> one permutation gather);
-the out-of-core split/merge path arrives with the spill framework."""
+
+Two paths:
+
+* full sort — concat the partition's batches, one permutation gather;
+* out-of-core (``GpuOutOfCoreSortIterator`` analog, ``GpuSortExec.scala:242``)
+  — when the input exceeds ``spark.rapids.sql.sort.outOfCore.targetRows``:
+  each batch is sorted under the OOM-retry framework and cut into
+  target-row SPILLABLE chunks (runs); output is produced by a k-way
+  prefix merge that only ever holds one chunk per run on device: the
+  first T rows of the union of run-head chunks are globally the smallest
+  T rows (each head is its run's prefix), so every merge step emits one
+  target-sized sorted batch and advances the consumed runs.
+"""
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Sequence
 
 import numpy as np
@@ -13,6 +25,9 @@ from ...ops.sorting import sort_permutation
 from ..expressions.core import EvalContext, bind_references
 from ..plan import SortOrder
 from .base import TPU, PhysicalPlan
+
+#: observability for tests: counts of out-of-core engagements
+STATS = {"ooc_sorts": 0, "merge_steps": 0}
 
 
 class SortExec(PhysicalPlan):
@@ -45,8 +60,117 @@ class SortExec(PhysicalPlan):
         batches = list(self.children[0].execute(pid, tctx))
         if not batches:
             return
+        from ...config import SORT_OOC_TARGET_ROWS
+        target = int(tctx.conf.get(SORT_OOC_TARGET_ROWS))
+        total = sum(b.num_rows_int for b in batches)
+        if total > target and len(batches) >= 1:
+            yield from self._out_of_core(batches, target)
+            return
         merged = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
         yield self._fn(merged)
+
+    # --- out-of-core path -------------------------------------------------
+    def _out_of_core(self, batches, target: int):
+        from ...memory.retry import split_spillable_in_half, with_retry
+        from ...memory.spill import (ACTIVE_BATCHING_PRIORITY,
+                                     SpillableColumnarBatch)
+        STATS["ooc_sorts"] += 1
+
+        # phase 1: sort each input under retry; cut sorted runs into
+        # target-row spillable chunks (a SplitAndRetryOOM halves an input,
+        # which simply yields two smaller sorted runs)
+        spillables = [SpillableColumnarBatch.create(
+            b, ACTIVE_BATCHING_PRIORITY) for b in batches
+            if b.num_rows_int > 0]
+        runs: list = []
+        for sorted_b in with_retry(spillables,
+                                   lambda sb: self._fn(sb.get()),
+                                   split_spillable_in_half):
+            run: deque = deque()
+            n = sorted_b.num_rows_int
+            for off in range(0, n, target):
+                piece = sorted_b.sliced(off, min(target, n - off))
+                run.append(SpillableColumnarBatch.create(
+                    piece, ACTIVE_BATCHING_PRIORITY))
+            if run:
+                runs.append(run)
+
+        if len(runs) == 1:
+            # one sorted run: its chunks ARE the output, no merge needed
+            run = runs[0]
+            try:
+                while run:
+                    yield run.popleft().get_and_close()
+            finally:
+                for sb in run:
+                    sb.close()
+            return
+
+        # phase 2: k-way prefix merge.  Each run contributes a prefix of at
+        # least ``target`` rows (or its whole remainder) — that invariant
+        # makes the first <=target rows of the sorted union globally the
+        # smallest.  Tag prefixes with their run id, sort the union, emit,
+        # advance each run by its consumed count.  The finally-close keeps
+        # catalog accounting honest when the consumer abandons the
+        # generator or a merge step raises (with_retry's ownership model).
+        xp = self.xp
+        run_col = "__ooc_run__"
+        from ... import types as T
+        from ...columnar.column import DeviceColumn
+        try:
+            while runs:
+                runs = [r for r in runs if r]
+                if not runs:
+                    break
+                STATS["merge_steps"] += 1
+                heads = []
+                for ridx, r in enumerate(runs):
+                    # top up the prefix to >= target rows (or the whole run)
+                    pieces = [r.popleft()]
+                    rows = pieces[0].num_rows
+                    while rows < target and r:
+                        pieces.append(r.popleft())
+                        rows += pieces[-1].num_rows
+                    got = [p.get_and_close() for p in pieces]
+                    hb = ColumnarBatch.concat(got) if len(got) > 1 else got[0]
+                    rid = DeviceColumn(
+                        T.INT, xp.full(hb.capacity, ridx, dtype=xp.int32),
+                        xp.ones(hb.capacity, dtype=bool))
+                    heads.append(ColumnarBatch(
+                        hb.names + (run_col,), hb.columns + (rid,),
+                        hb.num_rows))
+                union = (ColumnarBatch.concat(heads) if len(heads) > 1
+                         else heads[0])
+                merged = self._fn(union)
+                e = min(target, merged.num_rows_int)
+                emit = merged.sliced(0, e)
+                # consumed rows per run (host bincount over emitted prefix)
+                rid_sorted = np.asarray(merged.column(run_col).data[:e])
+                consumed = np.bincount(rid_sorted, minlength=len(runs))
+                survivors = []
+                for ridx, (r, head) in enumerate(zip(runs, heads)):
+                    c = int(consumed[ridx])
+                    n_head = head.num_rows_int
+                    if c < n_head:
+                        rest = head.sliced(c, n_head - c)
+                        names = tuple(n for n in rest.names if n != run_col)
+                        cols = tuple(cc for n, cc
+                                     in zip(rest.names, rest.columns)
+                                     if n != run_col)
+                        r.appendleft(SpillableColumnarBatch.create(
+                            ColumnarBatch(names, cols, rest.num_rows),
+                            ACTIVE_BATCHING_PRIORITY))
+                    if r:
+                        survivors.append(r)
+                runs = survivors
+                names = tuple(n for n in emit.names if n != run_col)
+                cols = tuple(c for n, c in zip(emit.names, emit.columns)
+                             if n != run_col)
+                yield ColumnarBatch(names, cols, emit.num_rows)
+        finally:
+            for r in runs:
+                for sb in r:
+                    sb.close()
 
     def simple_string(self):
         return f"{self.node_name()} [{', '.join(o.sql() for o in self.orders)}]"

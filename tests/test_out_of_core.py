@@ -1,0 +1,147 @@
+"""Out-of-core sort + chunked join gather (reference
+GpuSortExec.scala:242 GpuOutOfCoreSortIterator, JoinGatherer.scala:730),
+exercised with tiny chunk budgets and OOM injection."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.physical import join as J
+from spark_rapids_tpu.sql.physical import sortlimit as SL
+
+
+def _sorted_df(sess, rng, n=6000, parts=6):
+    t = pa.table({
+        "k": rng.integers(0, 500, n),
+        "v": rng.random(n),
+        "s": [f"row{i % 97:03d}" for i in range(n)],
+    })
+    return sess.create_dataframe(t, num_partitions=parts), t
+
+
+def test_out_of_core_sort_matches_pandas(rng):
+    sess = srt.session(
+        **{"spark.rapids.sql.sort.outOfCore.targetRows": 512})
+    df, t = _sorted_df(sess, rng)
+    before = SL.STATS["ooc_sorts"]
+    got = df.orderBy("k", "v").collect().to_pandas()
+    assert SL.STATS["ooc_sorts"] > before, "out-of-core path not engaged"
+    exp = t.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    assert np.array_equal(got["k"], exp["k"])
+    assert np.allclose(got["v"], exp["v"])
+    assert list(got["s"]) == list(exp["s"])
+
+
+def test_out_of_core_sort_desc_with_nulls(rng):
+    n = 3000
+    vals = [None if i % 11 == 0 else float(v)
+            for i, v in enumerate(rng.random(n))]
+    t = pa.table({"u": list(range(n)),
+                  "v": pa.array(vals, type=pa.float64())})
+    sess = srt.session(
+        **{"spark.rapids.sql.sort.outOfCore.targetRows": 256})
+    df = sess.create_dataframe(t, num_partitions=4)
+    before = SL.STATS["ooc_sorts"]
+    got = df.orderBy(df.v.desc()).collect().to_pandas()
+    assert SL.STATS["ooc_sorts"] > before
+    exp = (t.to_pandas().sort_values("v", ascending=False,
+                                     na_position="last")
+           .reset_index(drop=True))
+    # nulls-last for desc (Spark default desc_nulls_last)
+    gv, ev = got["v"].to_numpy(), exp["v"].to_numpy()
+    assert len(gv) == len(ev)
+    m = ~np.isnan(ev)
+    assert np.allclose(gv[m], ev[m]) and np.isnan(gv[~m]).all()
+
+
+def test_out_of_core_sort_with_oom_injection(rng):
+    sess = srt.session(**{
+        "spark.rapids.sql.sort.outOfCore.targetRows": 512,
+        "spark.rapids.sql.test.injectRetryOOM": 2,
+        "spark.rapids.sql.test.injectSplitAndRetryOOM": 4,
+    })
+    df, t = _sorted_df(sess, rng, n=4000, parts=4)
+    got = df.orderBy("k", "v").collect().to_pandas()
+    exp = t.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    assert np.array_equal(got["k"], exp["k"])
+    assert np.allclose(got["v"], exp["v"])
+
+
+def test_chunked_join_output_matches_pandas(rng):
+    n = 2000
+    left = pa.table({"k": rng.integers(0, 40, n), "v": rng.random(n)})
+    right = pa.table({"k": pa.array(np.arange(40), type=pa.int64()),
+                      "w": pa.array(np.arange(40) * 1.5)})
+    sess = srt.session(
+        **{"spark.rapids.sql.join.outputChunkRows": 256})
+    l = sess.create_dataframe(left)
+    r = sess.create_dataframe(right)
+    before = J.STATS["chunked_joins"]
+    got = (l.join(r, on="k", how="inner").select(l.k, l.v, r.w)
+           .orderBy("k", "v").collect().to_pandas())
+    assert J.STATS["chunked_joins"] > before, "chunked gather not engaged"
+    exp = (left.to_pandas().merge(right.to_pandas(), on="k")
+           .sort_values(["k", "v"]).reset_index(drop=True))
+    assert len(got) == len(exp)
+    assert np.array_equal(got["k"], exp["k"])
+    assert np.allclose(got["v"], exp["v"])
+    assert np.allclose(got["w"], exp["w"])
+
+
+def test_chunked_left_join_with_unmatched(rng):
+    n = 1500
+    left = pa.table({"k": rng.integers(0, 60, n), "v": rng.random(n)})
+    right = pa.table({"k": pa.array(np.arange(30), type=pa.int64()),
+                      "w": pa.array(np.arange(30) * 2.0)})
+    sess = srt.session(
+        **{"spark.rapids.sql.join.outputChunkRows": 128})
+    l = sess.create_dataframe(left)
+    r = sess.create_dataframe(right)
+    before = J.STATS["chunked_joins"]
+    got = (l.join(r, on="k", how="left").select(l.k, l.v, r.w)
+           .orderBy("k", "v").collect().to_pandas())
+    assert J.STATS["chunked_joins"] > before
+    exp = (left.to_pandas().merge(right.to_pandas(), on="k", how="left")
+           .sort_values(["k", "v"]).reset_index(drop=True))
+    assert len(got) == len(exp)
+    assert np.array_equal(got["k"], exp["k"])
+    gw, ew = got["w"].to_numpy(), exp["w"].to_numpy()
+    m = ~np.isnan(ew)
+    assert np.allclose(gw[m], ew[m]) and np.isnan(gw[~m]).all()
+
+
+def test_chunked_cross_join(rng):
+    left = pa.table({"a": list(range(70))})
+    right = pa.table({"b": list(range(50))})
+    sess = srt.session(
+        **{"spark.rapids.sql.join.outputChunkRows": 512})
+    l = sess.create_dataframe(left)
+    r = sess.create_dataframe(right)
+    before = J.STATS["chunked_joins"]
+    got = l.crossJoin(r).collect()
+    assert J.STATS["chunked_joins"] > before
+    assert got.num_rows == 70 * 50
+    pairs = set(zip(got["a"].to_pylist(), got["b"].to_pylist()))
+    assert len(pairs) == 70 * 50
+
+
+def test_chunked_join_with_oom_injection(rng):
+    n = 1200
+    left = pa.table({"k": rng.integers(0, 30, n), "v": rng.random(n)})
+    right = pa.table({"k": pa.array(np.arange(30), type=pa.int64()),
+                      "w": pa.array(np.arange(30) * 3.0)})
+    sess = srt.session(**{
+        "spark.rapids.sql.join.outputChunkRows": 256,
+        "spark.rapids.sql.test.injectRetryOOM": 3,
+    })
+    l = sess.create_dataframe(left)
+    r = sess.create_dataframe(right)
+    got = (l.join(r, on="k", how="inner").select(l.k, l.v, r.w)
+           .orderBy("k", "v").collect().to_pandas())
+    exp = (left.to_pandas().merge(right.to_pandas(), on="k")
+           .sort_values(["k", "v"]).reset_index(drop=True))
+    assert len(got) == len(exp)
+    assert np.allclose(got["v"], exp["v"])
