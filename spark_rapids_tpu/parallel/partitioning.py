@@ -123,17 +123,24 @@ class RangePartitioning(Partitioning):
                     xp.broadcast_to(bc.aux[b], col.aux.shape))
                 lt, eq, gtc = compare_columns(
                     None or ctx, col, bval, T.is_floating(col.dtype))
-                # null ordering
+                if not o.ascending:
+                    lt, gtc = gtc, lt
+                # null ordering — applied AFTER the direction swap, since
+                # nulls_first is a sort-POSITION property: a null key must
+                # override the data-compare of its zeroed backing storage
+                # in BOTH directions (caught by the pandas-oracle sorts)
                 cn, bn = ~col.validity, ~bval.validity
                 if o.nulls_first:
                     lt = xp.where(cn & ~bn, True, lt)
+                    gtc = xp.where(cn & ~bn, False, gtc)
                     gtc = xp.where(~cn & bn, True, gtc)
+                    lt = xp.where(~cn & bn, False, lt)
                 else:
                     lt = xp.where(~cn & bn, True, lt)
+                    gtc = xp.where(~cn & bn, False, gtc)
                     gtc = xp.where(cn & ~bn, True, gtc)
+                    lt = xp.where(cn & ~bn, False, lt)
                 eq = xp.where(cn & bn, True, eq & col.validity & bval.validity)
-                if not o.ascending:
-                    lt, gtc = gtc, lt
                 gt = gt | (~decided & gtc)
                 decided = decided | gtc | lt
             pid_out = pid_out + gt.astype(xp.int32)

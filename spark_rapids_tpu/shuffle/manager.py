@@ -118,16 +118,23 @@ class ShuffleManager:
                               reduce_id: int) -> Optional[ColumnarBatch]:
         blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
 
+        peers_cache: List[Optional[List[PeerInfo]]] = [None]
+
         def read_one(block: BlockId) -> Optional[bytes]:
             if self.mode == "ICI":
                 me = PeerInfo(self.executor_id, "local")
                 frame = self.transport.fetch(me, block)
                 if frame is None:
+                    # one heartbeat per reduce read, not per block (the
+                    # driver registry round-trip is not free over TCP)
+                    if peers_cache[0] is None:
+                        peers_cache[0] = self.heartbeats.heartbeat(
+                            self.executor_id)
                     # a network failure must not masquerade as an empty
                     # partition: only "every reachable peer says missing"
                     # may return None (FetchFailed contract, tcp.py)
                     last_err: Optional[Exception] = None
-                    for peer in self.heartbeats.heartbeat(self.executor_id):
+                    for peer in peers_cache[0]:
                         try:
                             frame = self.transport.fetch(peer, block)
                         except ConnectionError as e:
@@ -236,9 +243,17 @@ _global_lock = threading.Lock()
 def get_shuffle_manager(conf: Optional[RapidsConf] = None) -> ShuffleManager:
     global _global_manager
     with _global_lock:
-        mode = str((conf or RapidsConf.get_global()).get(SHUFFLE_MODE)).upper()
-        if _global_manager is None or _global_manager.mode != mode:
+        c = conf or RapidsConf.get_global()
+        # any shuffle-topology conf change rebuilds the manager (mode alone
+        # would silently keep a stale transport)
+        key = (str(c.get(SHUFFLE_MODE)).upper(),
+               str(c.get(SHUFFLE_TRANSPORT_CLASS)).upper(),
+               str(c.get(SHUFFLE_TCP_DRIVER_ENDPOINT)),
+               str(c.get(SHUFFLE_EXECUTOR_ID)))
+        if _global_manager is None or getattr(_global_manager, "_key",
+                                              None) != key:
             if _global_manager is not None:
                 _global_manager.close()
-            _global_manager = ShuffleManager(conf)
+            _global_manager = ShuffleManager(c)
+            _global_manager._key = key
         return _global_manager

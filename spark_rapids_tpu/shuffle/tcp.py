@@ -72,7 +72,6 @@ class _Server:
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
         self._closed = False
-        self._threads: List[threading.Thread] = []
         t = threading.Thread(target=self._accept_loop,
                              name=f"srt-shuffle-server-{self.port}",
                              daemon=True)
@@ -89,10 +88,8 @@ class _Server:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket):
         try:
@@ -320,7 +317,10 @@ class TcpHeartbeatClient:
                         except OSError:
                             pass
                         self._sock = None
-        return []
+        # an unreachable registry must not look like "no peers" — that
+        # would make remote blocks appear authoritatively missing
+        raise ShuffleFetchFailed(
+            f"driver heartbeat registry unreachable at {self._endpoint}")
 
     def register(self, executor_id: str, endpoint: str) -> List[PeerInfo]:
         self._my_endpoint = endpoint

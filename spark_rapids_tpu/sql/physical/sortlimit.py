@@ -63,7 +63,7 @@ class SortExec(PhysicalPlan):
         from ...config import SORT_OOC_TARGET_ROWS
         target = int(tctx.conf.get(SORT_OOC_TARGET_ROWS))
         total = sum(b.num_rows_int for b in batches)
-        if total > target and len(batches) >= 1:
+        if total > target:
             yield from self._out_of_core(batches, target)
             return
         merged = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
@@ -78,33 +78,36 @@ class SortExec(PhysicalPlan):
 
         # phase 1: sort each input under retry; cut sorted runs into
         # target-row spillable chunks (a SplitAndRetryOOM halves an input,
-        # which simply yields two smaller sorted runs)
+        # which simply yields two smaller sorted runs).  Chunks created
+        # before a later failure are closed by the phase-2 finally below.
         spillables = [SpillableColumnarBatch.create(
             b, ACTIVE_BATCHING_PRIORITY) for b in batches
             if b.num_rows_int > 0]
         runs: list = []
-        for sorted_b in with_retry(spillables,
-                                   lambda sb: self._fn(sb.get()),
-                                   split_spillable_in_half):
-            run: deque = deque()
-            n = sorted_b.num_rows_int
-            for off in range(0, n, target):
-                piece = sorted_b.sliced(off, min(target, n - off))
-                run.append(SpillableColumnarBatch.create(
-                    piece, ACTIVE_BATCHING_PRIORITY))
-            if run:
-                runs.append(run)
+        try:
+            for sorted_b in with_retry(spillables,
+                                       lambda sb: self._fn(sb.get()),
+                                       split_spillable_in_half):
+                run: deque = deque()
+                n = sorted_b.num_rows_int
+                for off in range(0, n, target):
+                    piece = sorted_b.sliced(off, min(target, n - off))
+                    run.append(SpillableColumnarBatch.create(
+                        piece, ACTIVE_BATCHING_PRIORITY))
+                if run:
+                    runs.append(run)
 
-        if len(runs) == 1:
-            # one sorted run: its chunks ARE the output, no merge needed
-            run = runs[0]
-            try:
+            if len(runs) == 1:
+                # one sorted run: its chunks ARE the output, no merge
+                run = runs[0]
                 while run:
                     yield run.popleft().get_and_close()
-            finally:
-                for sb in run:
+                return
+        except BaseException:
+            for r in runs:
+                for sb in r:
                     sb.close()
-            return
+            raise
 
         # phase 2: k-way prefix merge.  Each run contributes a prefix of at
         # least ``target`` rows (or its whole remainder) — that invariant

@@ -1,0 +1,14 @@
+"""Test tooling shipped with the framework — the analog of the reference's
+``integration_tests`` datagen layer (``data_gen.py:38-751`` design) and the
+``datagen/`` scale-data module."""
+
+from .datagen import (ArrayGen, BooleanGen, ByteGen, DataGen, DateGen,
+                      DecimalGen, DoubleGen, FloatGen, IntegerGen, LongGen,
+                      MapGen, ShortGen, StringGen, StructGen, TimestampGen,
+                      gen_table)
+
+__all__ = [
+    "DataGen", "BooleanGen", "ByteGen", "ShortGen", "IntegerGen", "LongGen",
+    "FloatGen", "DoubleGen", "DecimalGen", "StringGen", "DateGen",
+    "TimestampGen", "ArrayGen", "MapGen", "StructGen", "gen_table",
+]
