@@ -163,6 +163,30 @@ TEST_INJECT_SPLIT_OOM = register(
     "Test hook: make the Nth retryable block throw SplitAndRetryOOM.",
     0, internal=True)
 
+# --- adaptive execution + cost optimizer -----------------------------------
+ADAPTIVE_ENABLED = register(
+    "spark.sql.adaptive.enabled",
+    "Adaptive query execution: joins re-decide broadcast-vs-shuffle from "
+    "the build side's OBSERVED size at runtime (reference AQE integration, "
+    "GpuOverrides.scala:4392-4452 + GpuCustomShuffleReaderExec).", True)
+OPTIMIZER_ENABLED = register(
+    "spark.rapids.sql.optimizer.enabled",
+    "Cost-based optimizer: flips subtrees back to the host engine when the "
+    "estimated device benefit does not cover transition costs (reference "
+    "CostBasedOptimizer.scala:54; off by default like the reference).",
+    False)
+OPTIMIZER_CPU_COST = register(
+    "spark.rapids.sql.optimizer.cpu.exec.default",
+    "Default CPU cost (seconds/row) per operator "
+    "(RapidsConf.scala:1870).", 0.0002)
+OPTIMIZER_GPU_COST = register(
+    "spark.rapids.sql.optimizer.gpu.exec.default",
+    "Default device cost (seconds/row) per operator "
+    "(RapidsConf.scala:1882-1886).", 0.0001)
+OPTIMIZER_TRANSITION_COST = register(
+    "spark.rapids.sql.optimizer.transition.default",
+    "Cost (seconds/row) of a host<->device transition boundary.", 0.0001)
+
 # --- shuffle ---------------------------------------------------------------
 SHUFFLE_MODE = register(
     "spark.rapids.shuffle.mode",

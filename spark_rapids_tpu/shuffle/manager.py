@@ -252,8 +252,11 @@ def get_shuffle_manager(conf: Optional[RapidsConf] = None) -> ShuffleManager:
                str(c.get(SHUFFLE_EXECUTOR_ID)))
         if _global_manager is None or getattr(_global_manager, "_key",
                                               None) != key:
-            if _global_manager is not None:
-                _global_manager.close()
-            _global_manager = ShuffleManager(c)
-            _global_manager._key = key
+            old = _global_manager
+            _global_manager = None  # a failed rebuild must not leave a
+            if old is not None:     # closed manager installed
+                old.close()
+            mgr = ShuffleManager(c)
+            mgr._key = key
+            _global_manager = mgr
         return _global_manager

@@ -481,6 +481,128 @@ class NestedLoopJoinExec(BaseJoinExec):
             yield from self._join_batches(probe, build, tctx)
 
 
+class MaterializedExec(PhysicalPlan):
+    """Leaf serving pre-computed batches per partition — the runtime-stats
+    carrier AQE re-plans over (GpuCustomShuffleReaderExec's shuffle-stage
+    analog).  Batches are registered with the spill catalog so the stage's
+    working set can be demoted off-device between the size observation and
+    the chosen plan's execution (the reference keeps materialized stages
+    in the spillable shuffle catalog for the same reason)."""
+
+    def __init__(self, attrs, parts: List[List[ColumnarBatch]], backend=TPU):
+        super().__init__()
+        self.backend = backend
+        self._attrs = list(attrs)
+        self._nbytes = 0
+        if backend == TPU:
+            from ...memory.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY,
+                                         SpillableColumnarBatch,
+                                         batch_device_bytes)
+            self._nbytes = sum(batch_device_bytes(b)
+                               for bs in parts for b in bs)
+            self._parts = [[SpillableColumnarBatch.create(
+                b, OUTPUT_FOR_SHUFFLE_PRIORITY) for b in bs]
+                for bs in parts]
+        else:
+            self._parts = parts
+
+    @property
+    def output(self):
+        return self._attrs
+
+    def num_partitions(self):
+        return max(1, len(self._parts))
+
+    def estimate_bytes(self):
+        if self.backend != TPU:
+            from ...memory.spill import batch_device_bytes
+            return sum(batch_device_bytes(b)
+                       for bs in self._parts for b in bs)
+        return self._nbytes
+
+    def execute(self, pid, tctx):
+        if pid < len(self._parts):
+            for item in self._parts[pid]:
+                yield item.get() if hasattr(item, "get") else item
+
+
+class AdaptiveJoinExec(PhysicalPlan):
+    """AQE join: defer the broadcast-vs-shuffle decision until the build
+    side's ACTUAL size is observed at execution time (the reference's AQE
+    integration re-plans query stages from materialized shuffle statistics,
+    ``GpuOverrides.scala:4392-4452``).  The static planner falls back to
+    this when its estimates say "shuffle"; if the materialized build side
+    turns out to fit the broadcast threshold, the cheaper broadcast hash
+    join is picked instead — a provably different plan on mis-estimated
+    inputs."""
+
+    def __init__(self, node, left: PhysicalPlan, right: PhysicalPlan,
+                 backend, conf):
+        super().__init__(left, right)
+        self.backend = backend
+        self._node = node
+        self._conf = conf
+        self._chosen: Optional[PhysicalPlan] = None
+        self.chosen_strategy: Optional[str] = None
+        # static shape only (output schema / explain); never executed
+        self._shape = ShuffledHashJoinExec(
+            node.how, node.left_keys, node.right_keys, node.condition,
+            left, right, backend=backend)
+
+    @property
+    def output(self):
+        return self._shape.output
+
+    def num_partitions(self):
+        return int(self._conf.shuffle_partitions)
+
+    def _choose(self, tctx: TaskContext):
+        if self._chosen is not None:
+            return
+        from ...config import AUTO_BROADCAST_THRESHOLD
+        node, left, right = self._node, self.children[0], self.children[1]
+        parts = [list(right.execute(p, TaskContext(p, tctx.conf)))
+                 for p in range(right.num_partitions())]
+        right_m = MaterializedExec(right.output, parts, backend=self.backend)
+        threshold = int(self._conf.get(AUTO_BROADCAST_THRESHOLD))
+        can_broadcast = (node.how in ("inner", "left", "left_semi",
+                                      "left_anti", "existence")
+                         and right_m.estimate_bytes() <= threshold)
+        if can_broadcast:
+            build = BroadcastExchangeExec(right_m, backend=self.backend)
+            self._chosen = BroadcastHashJoinExec(
+                node.how, node.left_keys, node.right_keys, node.condition,
+                left, build, backend=self.backend)
+            self.chosen_strategy = "broadcast"
+        else:
+            n = self.num_partitions()
+            from ...parallel.partitioning import HashPartitioning
+            from .exchange import ShuffleExchangeExec
+            lx = ShuffleExchangeExec(
+                HashPartitioning(node.left_keys, n), left,
+                backend=self.backend)
+            rx = ShuffleExchangeExec(
+                HashPartitioning(node.right_keys, n), right_m,
+                backend=self.backend)
+            self._chosen = ShuffledHashJoinExec(
+                node.how, node.left_keys, node.right_keys, node.condition,
+                lx, rx, backend=self.backend)
+            self.chosen_strategy = "shuffle"
+
+    def execute(self, pid, tctx):
+        self._choose(tctx)
+        n = self.num_partitions()
+        m = self._chosen.num_partitions()
+        # serve the chosen plan's m partitions through our fixed n pids
+        for p in range(pid, m, n) if m > n else (
+                [pid] if pid < m else []):
+            yield from self._chosen.execute(p, TaskContext(p, tctx.conf))
+
+    def simple_string(self):
+        tag = self.chosen_strategy or "undecided"
+        return f"{self.node_name()} {self._node.how} [aqe: {tag}]"
+
+
 # --------------------------------------------------------------------------
 # planning
 # --------------------------------------------------------------------------
@@ -518,7 +640,14 @@ def plan_join(node, left: PhysicalPlan, right: PhysicalPlan, backend,
                                      node.condition, left, build,
                                      backend=backend)
 
+    from ...config import ADAPTIVE_ENABLED
     nparts = max(left.num_partitions(), right.num_partitions())
+    if (bool(conf.get(ADAPTIVE_ENABLED)) and nparts > 1
+            and how in ("inner", "left", "left_semi", "left_anti",
+                        "existence")):
+        # the static estimate said "shuffle" (or was unknown): let AQE
+        # re-decide from the materialized build side at runtime
+        return AdaptiveJoinExec(node, left, right, backend, conf)
     if nparts > 1:
         n = int(conf.shuffle_partitions)
         left = ShuffleExchangeExec(

@@ -32,6 +32,10 @@ class Planner:
         meta = TpuOverrides.apply(logical, self.conf)
         if self.conf.is_explain_only:
             _force_cpu(meta)
+        from ..config import OPTIMIZER_ENABLED
+        if bool(self.conf.get(OPTIMIZER_ENABLED)):
+            from .optimizer import apply_cost_optimizer
+            apply_cost_optimizer(meta, self.conf)
         phys = self._convert(meta)
         phys = _insert_transitions(phys)
         from ..config import FUSION_ENABLED

@@ -91,6 +91,11 @@ class TpuSession:
         the physical tree."""
         from .overrides import TpuOverrides
         meta = TpuOverrides.apply(df._plan, self._conf)
+        from ..config import OPTIMIZER_ENABLED
+        if bool(self._conf.get(OPTIMIZER_ENABLED)):
+            # keep the placement report consistent with the physical plan
+            from .optimizer import apply_cost_optimizer
+            apply_cost_optimizer(meta, self._conf)
         phys = Planner(self._conf).plan_for_collect(df._plan)
         return (meta.explain(all_ops) + "\n\nPhysical plan:\n"
                 + phys.tree_string())

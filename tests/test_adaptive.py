@@ -1,0 +1,140 @@
+"""AQE (runtime broadcast-vs-shuffle re-decision) + cost-based optimizer
+(reference GpuOverrides.scala:4392-4452 AQE integration,
+CostBasedOptimizer.scala:54)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.physical.join import AdaptiveJoinExec
+from spark_rapids_tpu.sql.planner import Planner
+
+
+def _find(p, cls):
+    if isinstance(p, cls):
+        return p
+    for c in p.children:
+        f = _find(c, cls)
+        if f is not None:
+            return f
+    return None
+
+
+def _tables(rng, n=20000):
+    left = pa.table({"k": rng.integers(0, 1000, n), "v": rng.random(n)})
+    right = pa.table({"k": pa.array(np.arange(n) % 1000, type=pa.int64()),
+                      "w": pa.array(rng.random(n))})
+    return left, right
+
+
+def test_aqe_switches_misestimated_join_to_broadcast(rng):
+    """Static estimate (~320KB relation) refuses broadcast under a 50KB
+    threshold, but the filtered build side is ~8 rows at runtime — AQE
+    provably picks a different plan than the static planner."""
+    left, right = _tables(rng)
+    sess = srt.session(
+        **{"spark.rapids.sql.autoBroadcastJoinThreshold": 50_000})
+    l = sess.create_dataframe(left, num_partitions=4)
+    r = sess.create_dataframe(right, num_partitions=4)
+    rf = r.filter(r.k < 8).groupBy("k").agg(F.max(r.w).alias("w"))
+    q = l.join(rf, on="k", how="inner").select(l.k, l.v, rf.w)
+
+    phys = Planner(sess._conf).plan_for_collect(q._plan)
+    aqe = _find(phys, AdaptiveJoinExec)
+    assert aqe is not None and aqe.chosen_strategy is None
+    out = phys.execute_all(sess._conf)
+    assert aqe.chosen_strategy == "broadcast"
+    exp = (left.to_pandas().merge(
+        right.to_pandas().query("k < 8").groupby("k")
+        .agg(w=("w", "max")).reset_index(), on="k"))
+    assert sum(b.num_rows_int for b in out) == len(exp)
+
+
+def test_aqe_keeps_shuffle_for_big_build(rng):
+    left, right = _tables(rng)
+    sess = srt.session(
+        **{"spark.rapids.sql.autoBroadcastJoinThreshold": 50_000})
+    l = sess.create_dataframe(left, num_partitions=4)
+    r = sess.create_dataframe(right, num_partitions=4)
+    q = l.join(r, on="k", how="inner").select(l.k, l.v, r.w)
+    phys = Planner(sess._conf).plan_for_collect(q._plan)
+    aqe = _find(phys, AdaptiveJoinExec)
+    assert aqe is not None
+    out = phys.execute_all(sess._conf)
+    assert aqe.chosen_strategy == "shuffle"
+    exp = left.to_pandas().merge(right.to_pandas(), on="k")
+    assert sum(b.num_rows_int for b in out) == len(exp)
+
+
+def test_aqe_disabled_plans_statically(rng):
+    left, right = _tables(rng)
+    sess = srt.session(**{
+        "spark.sql.adaptive.enabled": False,
+        "spark.rapids.sql.autoBroadcastJoinThreshold": 50_000})
+    l = sess.create_dataframe(left, num_partitions=4)
+    r = sess.create_dataframe(right, num_partitions=4)
+    q = l.join(r, on="k", how="inner")
+    phys = Planner(sess._conf).plan_for_collect(q._plan)
+    assert _find(phys, AdaptiveJoinExec) is None
+
+
+def test_aqe_result_equivalence(rng):
+    """Same query, AQE on vs off — identical results."""
+    left, right = _tables(rng, n=5000)
+    res = {}
+    for flag in (True, False):
+        sess = srt.session(**{
+            "spark.sql.adaptive.enabled": flag,
+            "spark.rapids.sql.autoBroadcastJoinThreshold": 10_000})
+        l = sess.create_dataframe(left, num_partitions=4)
+        r = sess.create_dataframe(right, num_partitions=4)
+        rf = r.filter(r.k < 50)
+        got = (l.join(rf, on="k", how="left_semi")
+               .orderBy("k", "v").collect().to_pandas())
+        res[flag] = got
+    assert np.array_equal(res[True]["k"], res[False]["k"])
+    assert np.allclose(res[True]["v"], res[False]["v"])
+
+
+def test_cost_optimizer_demotes_when_device_expensive():
+    t = pa.table({"a": list(range(100)), "b": [float(i) for i in range(100)]})
+    sess = srt.session(**{
+        "spark.rapids.sql.optimizer.enabled": True,
+        "spark.rapids.sql.optimizer.gpu.exec.default": 100.0})
+    df = sess.create_dataframe(t)
+    q = df.select((df.a + 1).alias("a1"))
+    rep = sess.explain(q)
+    assert "CpuProject" in rep and "cost-based optimizer" in rep
+    out = q.collect().to_pylist()
+    assert out[5]["a1"] == 6
+
+
+def test_cost_optimizer_keeps_device_when_cheap():
+    t = pa.table({"a": list(range(100))})
+    sess = srt.session(**{"spark.rapids.sql.optimizer.enabled": True})
+    df = sess.create_dataframe(t)
+    rep = sess.explain(df.select((df.a + 1).alias("a1")))
+    assert "TpuProject" in rep
+
+
+def test_cost_optimizer_off_by_default():
+    t = pa.table({"a": list(range(10))})
+    sess = srt.session(**{
+        "spark.rapids.sql.optimizer.gpu.exec.default": 100.0})
+    df = sess.create_dataframe(t)
+    rep = sess.explain(df.select((df.a + 1).alias("a1")))
+    assert "TpuProject" in rep  # optimizer disabled -> no demotion
+
+
+def test_cost_optimizer_unknown_stats_keep_device(tmp_path):
+    """File scans have no row statistics; unknown stats must not demote
+    (0 >= 0 would flip every file-based query to the host)."""
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": list(range(50))}), p)
+    sess = srt.session(**{"spark.rapids.sql.optimizer.enabled": True})
+    df = sess.read.parquet(p)
+    rep = sess.explain(df.select((df.a + 1).alias("a1")))
+    assert "CpuProject" not in rep
