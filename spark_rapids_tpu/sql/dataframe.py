@@ -22,6 +22,34 @@ def _to_expr(v) -> Expression:
     return Literal(v)
 
 
+_DDL_TYPES = {
+    "boolean": T.BOOLEAN, "bool": T.BOOLEAN, "byte": T.BYTE,
+    "tinyint": T.BYTE, "short": T.SHORT, "smallint": T.SHORT,
+    "int": T.INT, "integer": T.INT, "long": T.LONG, "bigint": T.LONG,
+    "float": T.FLOAT, "real": T.FLOAT, "double": T.DOUBLE,
+    "string": T.STRING, "binary": T.BINARY, "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+}
+
+
+def _to_struct_type(schema) -> T.StructType:
+    """StructType, or a DDL-ish string 'name type, name type' (the pyspark
+    mapInPandas/applyInPandas schema argument forms)."""
+    if isinstance(schema, T.StructType):
+        return schema
+    if isinstance(schema, str):
+        fields = []
+        for part in schema.split(","):
+            name, _, tname = part.strip().partition(" ")
+            dt = _DDL_TYPES.get(tname.strip().lower())
+            if dt is None:
+                raise ValueError(f"unsupported type in schema DDL: {part!r}")
+            fields.append(T.StructField(name, dt, True))
+        return T.StructType(tuple(fields))
+    raise TypeError(f"schema must be StructType or DDL string, got "
+                    f"{type(schema).__name__}")
+
+
 def _binary(cls, a, b, swap=False):
     ea, eb = _to_expr(a), _to_expr(b)
     if swap:
@@ -314,6 +342,12 @@ class DataFrame:
         return GroupedData(self, exprs)
 
     groupby = groupBy
+
+    def mapInPandas(self, func, schema) -> "DataFrame":
+        """Apply ``func(Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame]``
+        per partition (reference GpuMapInPandasExec, SURVEY §2.9)."""
+        return DataFrame(P.MapInPandas(func, _to_struct_type(schema),
+                                       self._plan), self._session)
 
     def agg(self, *cols) -> "DataFrame":
         return GroupedData(self, ()).agg(*cols)
@@ -667,6 +701,20 @@ class GroupedData:
             outs.append(e)
         return DataFrame(P.Aggregate(self._grouping, tuple(outs),
                                      self._df._plan), self._df._session)
+
+    def applyInPandas(self, func, schema) -> DataFrame:
+        """``func(pd.DataFrame) -> pd.DataFrame`` per key group
+        (reference GpuFlatMapGroupsInPandasExec).  Grouping keys must be
+        plain columns (the pandas groupby downstream groups by NAME)."""
+        for g in self._grouping:
+            base = g.child if isinstance(g, Alias) else g
+            if not isinstance(base, AttributeReference):
+                raise ValueError(
+                    "applyInPandas grouping keys must be plain columns, "
+                    f"got expression {g.sql()!r} — project it first")
+        return DataFrame(P.FlatMapGroupsInPandas(
+            self._grouping, func, _to_struct_type(schema), self._df._plan),
+            self._df._session)
 
     def count(self) -> DataFrame:
         from .expressions.aggregates import Count

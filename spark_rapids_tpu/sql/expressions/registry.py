@@ -75,3 +75,7 @@ _reg(Str.Length, Str.OctetLength, Str.BitLength, Str.Upper, Str.Lower,
      Str.StringRepeat, Str.StringLPad, Str.StringRPad, Str.StringTrim,
      Str.StringTrimLeft, Str.StringTrimRight, Str.FormatNumber, Str.Conv,
      Str.Md5)
+
+from . import udf as U  # noqa: E402
+
+_reg(U.PythonUDF, U.PandasUDF, U.DeviceUDF)

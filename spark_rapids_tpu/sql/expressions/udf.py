@@ -1,0 +1,334 @@
+"""User-defined functions — the analog of the reference's UDF stack
+(SURVEY §2.9):
+
+* :class:`DeviceUDF` — the ``com.nvidia.spark.RapidsUDF`` SPI analog: the
+  user supplies a function over the backend array namespace (jnp/np) that
+  runs INSIDE the compiled program on device.
+* :class:`PythonUDF` — plain row-at-a-time Python UDF; tagged to the host
+  engine and fed through Arrow (``GpuScalaUDF``/row-UDF fallback analog).
+* :class:`PandasUDF` — vectorized scalar pandas UDF over zero-copy Arrow
+  columns (``GpuArrowEvalPythonExec``'s data path, in-process).
+* :func:`compile_python_udf` — the udf-compiler analog
+  (``udf-compiler/.../CatalystExpressionBuilder.scala``): translates simple
+  Python lambdas/functions into native engine expressions via the Python
+  AST, so the UDF body runs fully on the device with no Python in the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn, bucket_capacity
+from .core import (Expression, Literal, fixed, resolve_expression, valid_and)
+
+
+def _col_to_pylist(ctx, col: DeviceColumn, n: int) -> list:
+    from ...columnar.convert import device_column_to_arrow
+    import jax
+    host = jax.tree.map(np.asarray, col)
+    return device_column_to_arrow(host, n).to_pylist()
+
+
+def _col_from_pylist(ctx, values: list, dtype: T.DataType,
+                     capacity: int) -> DeviceColumn:
+    import pyarrow as pa
+    from ...columnar.convert import arrow_to_device_column
+    arr = pa.array(values, type=T.to_arrow(dtype))
+    col = arrow_to_device_column(arr, capacity)
+    if ctx.xp.__name__ != "numpy":
+        import jax
+        col = jax.tree.map(ctx.xp.asarray, col)
+    return col
+
+
+class PythonUDF(Expression):
+    """Row-at-a-time Python UDF (host engine; null in -> null out unless
+    the function handles None itself — Spark calls the function with None
+    arguments, so we do too)."""
+
+    def __init__(self, func: Callable, return_type: T.DataType, *args):
+        self.func = func
+        self.return_type = return_type
+        self.children = tuple(resolve_expression(a) for a in args)
+
+    def with_children(self, children):
+        return PythonUDF(self.func, self.return_type, *children)
+
+    @property
+    def data_type(self):
+        return self.return_type
+
+    def pretty_name(self):
+        return getattr(self.func, "__name__", "udf")
+
+    def tag_for_device(self, conf=None):
+        return ("python UDF runs on the host engine (row-at-a-time; "
+                "use srt.device_udf or a compilable lambda for the device)")
+
+    def semantic_key(self):
+        return ("PythonUDF", id(self.func), str(self.return_type))
+
+    def kernel(self, ctx, *cols):
+        n = int(ctx.batch.num_rows)
+        lists = [_col_to_pylist(ctx, c, n) for c in cols]
+        # user exceptions propagate (PySpark PythonException contract) —
+        # silently nulling failures would corrupt results
+        out = [self.func(*row) for row in zip(*lists)] if lists else \
+            [self.func() for _ in range(n)]
+        cap = cols[0].capacity if cols else bucket_capacity(n)
+        return _col_from_pylist(ctx, out + [None] * (cap - n),
+                                self.return_type, cap)
+
+
+class PandasUDF(Expression):
+    """Vectorized scalar pandas UDF: children flow to the function as
+    pandas Series through Arrow (zero host-loop)."""
+
+    def __init__(self, func: Callable, return_type: T.DataType, *args):
+        self.func = func
+        self.return_type = return_type
+        self.children = tuple(resolve_expression(a) for a in args)
+
+    def with_children(self, children):
+        return PandasUDF(self.func, self.return_type, *children)
+
+    @property
+    def data_type(self):
+        return self.return_type
+
+    def pretty_name(self):
+        return getattr(self.func, "__name__", "pandas_udf")
+
+    def tag_for_device(self, conf=None):
+        return ("pandas UDF evaluates in the Python worker (Arrow "
+                "exchange, GpuArrowEvalPythonExec analog)")
+
+    def semantic_key(self):
+        return ("PandasUDF", id(self.func), str(self.return_type))
+
+    def kernel(self, ctx, *cols):
+        import pyarrow as pa
+        from ...columnar.convert import device_column_to_arrow
+        import jax
+        n = int(ctx.batch.num_rows)
+        series = [device_column_to_arrow(jax.tree.map(np.asarray, c), n)
+                  .to_pandas() for c in cols]
+        result = self.func(*series)
+        vals = list(result)
+        if len(vals) != n:
+            raise ValueError(
+                f"pandas UDF {self.pretty_name()} returned {len(vals)} "
+                f"values for a {n}-row batch (result length must match)")
+        cap = cols[0].capacity if cols else bucket_capacity(n)
+        return _col_from_pylist(ctx, vals + [None] * (cap - n),
+                                self.return_type, cap)
+
+
+class DeviceUDF(Expression):
+    """Columnar device UDF SPI (``com.nvidia.spark.RapidsUDF`` analog):
+    ``func(xp, *(data, validity) pairs) -> (data, validity)`` must be
+    XLA-traceable with static shapes; it runs inside the compiled program
+    like any built-in expression."""
+
+    def __init__(self, func: Callable, return_type: T.DataType, *args):
+        self.func = func
+        self.return_type = return_type
+        self.children = tuple(resolve_expression(a) for a in args)
+
+    def with_children(self, children):
+        return DeviceUDF(self.func, self.return_type, *children)
+
+    @property
+    def data_type(self):
+        return self.return_type
+
+    def pretty_name(self):
+        return getattr(self.func, "__name__", "device_udf")
+
+    def semantic_key(self):
+        return ("DeviceUDF", id(self.func), str(self.return_type))
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        pairs = [(c.data, c.validity) for c in cols]
+        out = self.func(xp, *pairs)
+        if isinstance(out, tuple):
+            data, validity = out
+        else:
+            data, validity = out, valid_and(xp, *cols)
+        return fixed(self.return_type, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# udf-compiler analog: Python AST -> engine expressions
+# ---------------------------------------------------------------------------
+
+_BINOPS = {
+    ast.Add: "Add", ast.Sub: "Subtract", ast.Mult: "Multiply",
+    ast.Div: "Divide", ast.Mod: "Remainder", ast.Pow: "Pow",
+    ast.FloorDiv: "IntegralDivide",
+}
+_CMPOPS = {
+    ast.Eq: "EqualTo", ast.NotEq: None, ast.Lt: "LessThan",
+    ast.LtE: "LessThanOrEqual", ast.Gt: "GreaterThan",
+    ast.GtE: "GreaterThanOrEqual",
+}
+_MATH_CALLS = {
+    "abs": "Abs", "sqrt": "Sqrt", "exp": "Exp", "log": "Log",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "floor": "Floor",
+    "ceil": "Ceil",
+}
+
+
+class _Untranslatable(Exception):
+    pass
+
+
+def _is_boolean_ast(node) -> bool:
+    """Structurally boolean-producing AST node (value == truth value)."""
+    if isinstance(node, ast.Compare):
+        return True
+    if isinstance(node, ast.BoolOp):
+        return all(_is_boolean_ast(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _is_boolean_ast(node.operand)
+    if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+        return True
+    return False
+
+
+def compile_python_udf(func: Callable,
+                       args: Sequence[Expression]) -> Optional[Expression]:
+    """Translate a simple Python lambda/function into a native engine
+    expression tree (runs fully on device).  Returns None when the body
+    uses anything beyond arithmetic/comparisons/conditionals/math calls —
+    callers then fall back to :class:`PythonUDF`, exactly like the
+    reference's udf-compiler opt-in (``LogicalPlanRules.scala``).
+
+    Documented caveat (shared with the reference's udf-compiler): the
+    compiled expression uses SQL NULL semantics — a comparison against a
+    NULL input yields NULL (row filtered/propagated) where the Python
+    function would have been called with ``None``.  Compilation refuses
+    and/or/not/if-tests over non-boolean operands, where Python's
+    value-returning truthiness differs from SQL booleans."""
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+        tree = ast.parse(src)
+        is_lambda = func.__name__ == "<lambda>"
+        if is_lambda:
+            lambdas = [n for n in ast.walk(tree)
+                       if isinstance(n, ast.Lambda)]
+            # two lambdas on one source line: getsource cannot tell which
+            # one `func` is — refuse rather than compile the wrong body
+            if len(lambdas) != 1:
+                return None
+            fn_node = lambdas[0]
+        else:
+            defs = [n for n in ast.walk(tree)
+                    if isinstance(n, ast.FunctionDef)
+                    and n.name == func.__name__]
+            if len(defs) != 1:
+                return None
+            fn_node = defs[0]
+        params = [a.arg for a in fn_node.args.args]
+        if params != list(func.__code__.co_varnames[:len(params)]) or \
+                len(params) != len(args):
+            return None
+        env = dict(zip(params, args))
+        if isinstance(fn_node, ast.Lambda):
+            body = fn_node.body
+        else:
+            stmts = [s for s in fn_node.body
+                     if not isinstance(s, (ast.Expr,))]  # skip docstrings
+            if len(stmts) != 1 or not isinstance(stmts[0], ast.Return):
+                return None
+            body = stmts[0].value
+        return _translate(body, env)
+    except (_Untranslatable, OSError, TypeError, SyntaxError):
+        return None
+
+
+def _translate(node, env) -> Expression:
+    from . import arithmetic as A
+    from . import conditional as Cond
+    from . import math_fns as M
+    from . import predicates as P
+    from .registry import EXPRESSION_REGISTRY
+
+    def cls(name):
+        c = EXPRESSION_REGISTRY.get(name)
+        if c is None:
+            raise _Untranslatable(name)
+        return c
+
+    if isinstance(node, ast.Name):
+        if node.id not in env:
+            raise _Untranslatable(node.id)
+        return env[node.id]
+    if isinstance(node, ast.Constant):
+        if node.value is None or isinstance(node.value, (int, float, bool,
+                                                         str)):
+            return Literal(node.value)
+        raise _Untranslatable(repr(node.value))
+    if isinstance(node, ast.BinOp):
+        op = _BINOPS.get(type(node.op))
+        if op is None:
+            raise _Untranslatable(ast.dump(node.op))
+        return cls(op)(_translate(node.left, env),
+                       _translate(node.right, env))
+    if isinstance(node, ast.UnaryOp):
+        if isinstance(node.op, ast.USub):
+            return A.UnaryMinus(_translate(node.operand, env))
+        if isinstance(node.op, ast.Not):
+            if not _is_boolean_ast(node.operand):
+                raise _Untranslatable("not over a non-boolean operand")
+            return P.Not(_translate(node.operand, env))
+        raise _Untranslatable(ast.dump(node.op))
+    if isinstance(node, ast.Compare):
+        if len(node.ops) != 1:
+            raise _Untranslatable("chained comparison")
+        opt = type(node.ops[0])
+        left = _translate(node.left, env)
+        right = _translate(node.comparators[0], env)
+        if opt is ast.NotEq:
+            return P.Not(P.EqualTo(left, right))
+        op = _CMPOPS.get(opt)
+        if op is None:
+            raise _Untranslatable(ast.dump(node.ops[0]))
+        return cls(op)(left, right)
+    if isinstance(node, ast.BoolOp):
+        # Python and/or return OPERANDS, not booleans; only compile when
+        # every operand is structurally boolean (comparison/bool-op/not),
+        # where the value and truth semantics coincide
+        if not all(_is_boolean_ast(v) for v in node.values):
+            raise _Untranslatable("and/or over non-boolean operands")
+        parts = [_translate(v, env) for v in node.values]
+        out = parts[0]
+        c = P.And if isinstance(node.op, ast.And) else P.Or
+        for p in parts[1:]:
+            out = c(out, p)
+        return out
+    if isinstance(node, ast.IfExp):
+        if not _is_boolean_ast(node.test):
+            raise _Untranslatable("conditional test is not boolean")
+        return Cond.If(_translate(node.test, env),
+                       _translate(node.body, env),
+                       _translate(node.orelse, env))
+    if isinstance(node, ast.Call):
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):  # math.sqrt etc.
+            name = node.func.attr
+        op = _MATH_CALLS.get(name or "")
+        if op is None or node.keywords:
+            raise _Untranslatable(f"call {name}")
+        kids = [_translate(a, env) for a in node.args]
+        return cls(op)(*kids)
+    raise _Untranslatable(type(node).__name__)

@@ -695,3 +695,62 @@ def _lit_or_col(x):
     if isinstance(x, str):
         return Literal(x)
     return _to_expr(x)
+
+
+# --- user-defined functions (reference UDF stack, SURVEY §2.9) --------------
+
+def udf(f=None, returnType=T.DOUBLE):
+    """Plain Python UDF (pyspark F.udf).  Simple lambdas/functions over
+    arithmetic, comparisons, conditionals and math calls are COMPILED into
+    native device expressions (the udf-compiler analog); everything else
+    runs row-at-a-time on the host engine."""
+    from .expressions import udf as U
+
+    def make(func):
+        def call(*cols):
+            args = [_c(c) for c in cols]
+            compiled = U.compile_python_udf(func, args)
+            if compiled is not None:
+                # declared returnType governs the schema regardless of
+                # whether compilation succeeded
+                return Column(Alias(Cast(compiled, returnType),
+                                    getattr(func, "__name__", "udf")))
+            return Column(U.PythonUDF(func, returnType, *args))
+        call.__name__ = getattr(func, "__name__", "udf")
+        return call
+    if f is not None:
+        return make(f)
+    return make
+
+
+def pandas_udf(f=None, returnType=T.DOUBLE):
+    """Vectorized scalar pandas UDF (pyspark F.pandas_udf): children reach
+    the function as pandas Series via Arrow."""
+    from .expressions import udf as U
+
+    def make(func):
+        def call(*cols):
+            return Column(U.PandasUDF(func, returnType,
+                                      *[_c(c) for c in cols]))
+        call.__name__ = getattr(func, "__name__", "pandas_udf")
+        return call
+    if f is not None:
+        return make(f)
+    return make
+
+
+def device_udf(f=None, returnType=T.DOUBLE):
+    """Columnar device UDF (RapidsUDF SPI analog): ``f(xp, (data, valid),
+    ...) -> (data, valid)`` must be XLA-traceable; runs inside the compiled
+    program like a built-in expression."""
+    from .expressions import udf as U
+
+    def make(func):
+        def call(*cols):
+            return Column(U.DeviceUDF(func, returnType,
+                                      *[_c(c) for c in cols]))
+        call.__name__ = getattr(func, "__name__", "device_udf")
+        return call
+    if f is not None:
+        return make(f)
+    return make

@@ -1,0 +1,137 @@
+"""Python-worker execs — the analog of the reference's
+``org/apache/spark/sql/rapids/execution/python/`` family (SURVEY §2.9):
+``GpuMapInPandasExec`` and ``GpuFlatMapGroupsInPandasExec``.  Batches move
+to the Python function as pandas DataFrames through Arrow; the device
+semaphore is released while user Python runs (the reference's
+``GpuArrowPythonRunner`` releases it while waiting on the worker,
+``GpuArrowEvalPythonExec.scala:172``) so device-bound tasks can overlap
+with Python time."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator, List
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.batch import ColumnarBatch
+from .base import TPU, PhysicalPlan, TaskContext
+
+
+@contextmanager
+def _semaphore_released(backend: str, tctx: TaskContext):
+    """Release the device semaphore around user Python ONLY if this task
+    holds it — execs driven inside another task's materialization (e.g. a
+    downstream exchange) run under the OUTER task's permit, and acquiring
+    a second one here would deadlock a permits=1 chip."""
+    if backend != TPU:
+        yield
+        return
+    from ...memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore.get()
+    held = sem.holds(tctx.partition_id)
+    if held:
+        sem.release_if_necessary(tctx.partition_id)
+    try:
+        yield
+    finally:
+        if held:
+            sem.acquire_if_necessary(tctx.partition_id, tctx)
+
+
+def _to_pandas(batch: ColumnarBatch):
+    from ...columnar.convert import device_to_arrow
+    return device_to_arrow(batch).to_pandas()
+
+
+def _from_pandas(pdf, schema: T.StructType, backend: str) -> ColumnarBatch:
+    import pyarrow as pa
+    from ...columnar.convert import arrow_to_device
+    table = pa.Table.from_pandas(pdf, preserve_index=False).cast(
+        pa.schema([pa.field(f.name, T.to_arrow(f.data_type))
+                   for f in schema.fields]))
+    batch = arrow_to_device(table)
+    if backend != TPU:
+        import jax
+        batch = jax.tree.map(np.asarray, batch)
+    return batch
+
+
+class MapInPandasExec(PhysicalPlan):
+    """User fn: Iterator[pd.DataFrame] -> Iterator[pd.DataFrame]."""
+
+    def __init__(self, func, out_schema: T.StructType, child: PhysicalPlan,
+                 backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.func = func
+        self.out_schema = out_schema
+
+    @property
+    def output(self):
+        from ..expressions.core import AttributeReference
+        return [AttributeReference(f.name, f.data_type, True)
+                for f in self.out_schema.fields]
+
+    def execute(self, pid: int, tctx: TaskContext):
+        # device->host transfer happens BEFORE the semaphore is released
+        # (GpuArrowPythonRunner ordering); user Python then runs without
+        # holding the chip
+        pdfs = [_to_pandas(b)
+                for b in self.children[0].execute(pid, tctx)]
+        if not pdfs:
+            return
+        with _semaphore_released(self.backend, tctx):
+            outs = [pdf for pdf in self.func(iter(pdfs))
+                    if pdf is not None and len(pdf)]
+        for pdf in outs:
+            yield _from_pandas(pdf, self.out_schema, self.backend)
+
+    def simple_string(self):
+        return (f"{self.node_name()} "
+                f"{getattr(self.func, '__name__', '<fn>')}")
+
+
+class FlatMapGroupsInPandasExec(PhysicalPlan):
+    """groupBy(keys).applyInPandas: one pandas DataFrame per key group in,
+    one out; groups are formed per partition (the planner hash-partitions
+    the child by the grouping keys first, so groups are complete)."""
+
+    def __init__(self, grouping_names: List[str], func,
+                 out_schema: T.StructType, child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.grouping_names = list(grouping_names)
+        self.func = func
+        self.out_schema = out_schema
+
+    @property
+    def output(self):
+        from ..expressions.core import AttributeReference
+        return [AttributeReference(f.name, f.data_type, True)
+                for f in self.out_schema.fields]
+
+    def execute(self, pid: int, tctx: TaskContext):
+        batches = list(self.children[0].execute(pid, tctx))
+        if not batches:
+            return
+        merged = (ColumnarBatch.concat(batches) if len(batches) > 1
+                  else batches[0])
+        pdf = _to_pandas(merged)
+        if not len(pdf):
+            return
+        outs = []
+        with _semaphore_released(self.backend, tctx):
+            for _, group in pdf.groupby(self.grouping_names, sort=False,
+                                        dropna=False):
+                out = self.func(group)
+                if out is not None and len(out):
+                    outs.append(out)
+        for out in outs:
+            yield _from_pandas(out, self.out_schema, self.backend)
+
+    def simple_string(self):
+        keys = ", ".join(self.grouping_names)
+        return (f"{self.node_name()} [{keys}] "
+                f"{getattr(self.func, '__name__', '<fn>')}")

@@ -344,3 +344,37 @@ class Window(LogicalPlan):
 
     def simple_string(self):
         return (f"Window [{', '.join(a.child.sql() for a in self.window_exprs)}]")
+
+
+@dataclass(eq=False)
+class MapInPandas(LogicalPlan):
+    """mapInPandas: user fn over an iterator of pandas DataFrames
+    (reference GpuMapInPandasExec, SURVEY §2.9 Python execs)."""
+    func: object = None
+    out_schema: "T.StructType" = None  # type: ignore
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return [AttributeReference(f.name, f.data_type, True)
+                for f in self.out_schema.fields]
+
+
+@dataclass(eq=False)
+class FlatMapGroupsInPandas(LogicalPlan):
+    """groupBy(...).applyInPandas (reference GpuFlatMapGroupsInPandasExec)."""
+    grouping: Tuple[Expression, ...] = ()
+    func: object = None
+    out_schema: "T.StructType" = None  # type: ignore
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        return [AttributeReference(f.name, f.data_type, True)
+                for f in self.out_schema.fields]

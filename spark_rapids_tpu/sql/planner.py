@@ -99,6 +99,23 @@ class Planner:
         elif isinstance(node, P.Join):
             from .physical.join import plan_join
             exec_ = plan_join(node, kids[0], kids[1], be, self.conf)
+        elif isinstance(node, P.MapInPandas):
+            from .physical.python_execs import MapInPandasExec
+            exec_ = MapInPandasExec(node.func, node.out_schema, kids[0],
+                                    backend=be)
+        elif isinstance(node, P.FlatMapGroupsInPandas):
+            from .physical.python_execs import FlatMapGroupsInPandasExec
+            child = kids[0]
+            if child.num_partitions() > 1:
+                # groups must be complete per partition
+                child = ShuffleExchangeExec(
+                    HashPartitioning(list(node.grouping),
+                                     child.num_partitions()),
+                    child, backend=child.backend)
+            names = [getattr(g, "name", str(g)) for g in node.grouping]
+            exec_ = FlatMapGroupsInPandasExec(names, node.func,
+                                              node.out_schema, child,
+                                              backend=be)
         else:
             raise NotImplementedError(
                 f"no physical plan for {type(node).__name__}")

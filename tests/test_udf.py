@@ -1,0 +1,188 @@
+"""UDF stack: compiled lambdas (udf-compiler analog), row Python UDFs,
+pandas UDFs, device columnar UDFs (RapidsUDF SPI analog), mapInPandas and
+applyInPandas (reference SURVEY §2.9 Python exec family)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def make_df(sess):
+    t = pa.table({"a": [1., 2., 3., 4.], "b": [10., 20., 30., 40.],
+                  "g": [1, 1, 2, 2]})
+    return sess.create_dataframe(t), t
+
+
+def test_compilable_lambda_runs_on_device(sess):
+    df, t = make_df(sess)
+    f1 = F.udf(lambda a, b: a * 2.0 + b if a > 2.0 else b - a,
+               returnType=T.DOUBLE)
+    q = df.select(f1(df.a, df.b).alias("r"))
+    rep = sess.explain(q)
+    assert "PythonUDF" not in rep, rep  # compiled into native expressions
+    assert "cannot run" not in rep, rep
+    out = [r["r"] for r in q.collect().to_pylist()]
+    assert out == [9.0, 18.0, 36.0, 48.0]
+
+
+def test_compiled_function_with_math(sess):
+    df, t = make_df(sess)
+
+    def my_fn(a):
+        return abs(a - 3.0) + sqrt_stub(a)
+
+    # a plain def with an unknown call must NOT compile -> host UDF
+    def sqrt_stub(a):  # pragma: no cover - never called on device
+        return 0.0
+    f = F.udf(my_fn, returnType=T.DOUBLE)
+    q = df.select(f(df.a).alias("r"))
+    assert "host engine" in sess.explain(q)
+
+
+def test_row_udf_on_host(sess):
+    df, t = make_df(sess)
+    f2 = F.udf(lambda a: float(str(a).count("1")), returnType=T.DOUBLE)
+    q = df.select(f2(df.a).alias("c"))
+    assert "host engine" in sess.explain(q)
+    out = [r["c"] for r in q.collect().to_pylist()]
+    assert out == [1.0, 0.0, 0.0, 0.0]
+
+
+def test_row_udf_null_handling(sess):
+    t = pa.table({"x": pa.array([1.0, None, 3.0], type=pa.float64())})
+    df = sess.create_dataframe(t)
+    f = F.udf(lambda x: -1.0 if x is None else x + 1, returnType=T.DOUBLE)
+    out = [r["r"] for r in df.select(f(df.x).alias("r"))
+           .collect().to_pylist()]
+    assert out == [2.0, -1.0, 4.0]
+
+
+def test_pandas_udf(sess):
+    df, t = make_df(sess)
+    p1 = F.pandas_udf(lambda s: s * 10, returnType=T.DOUBLE)
+    out = [r["p"] for r in df.select(p1(df.a).alias("p"))
+           .collect().to_pylist()]
+    assert out == [10., 20., 30., 40.]
+
+
+def test_pandas_udf_two_args(sess):
+    df, t = make_df(sess)
+    p = F.pandas_udf(lambda a, b: a + b.cumsum() * 0, returnType=T.DOUBLE)
+    out = [r["p"] for r in df.select(p(df.a, df.b).alias("p"))
+           .collect().to_pylist()]
+    assert out == [1., 2., 3., 4.]
+
+
+def test_device_udf_traceable(sess):
+    df, t = make_df(sess)
+
+    def saxpy(xp, a, b):
+        (ad, av), (bd, bv) = a, b
+        return ad * 2.0 + bd, av & bv
+    d1 = F.device_udf(saxpy, returnType=T.DOUBLE)
+    q = df.select(d1(df.a, df.b).alias("s"))
+    assert "cannot run" not in sess.explain(q)
+    out = [r["s"] for r in q.collect().to_pylist()]
+    assert out == [12., 24., 36., 48.]
+
+
+def test_map_in_pandas(sess):
+    df, t = make_df(sess)
+
+    def mapper(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["a2"] = pdf["a"] * 100
+            yield pdf[["a2"]]
+    out = df.mapInPandas(mapper, "a2 double").collect().to_pylist()
+    assert sorted(r["a2"] for r in out) == [100., 200., 300., 400.]
+
+
+def test_apply_in_pandas_groups(sess):
+    df, t = make_df(sess)
+
+    def norm(pdf):
+        pdf = pdf.copy()
+        pdf["z"] = pdf["a"] - pdf["a"].mean()
+        return pdf[["g", "z"]]
+    out = (df.groupBy("g").applyInPandas(norm, "g long, z double")
+           .orderBy("g", "z").collect().to_pylist())
+    assert [r["z"] for r in out] == [-0.5, 0.5, -0.5, 0.5]
+
+
+def test_apply_in_pandas_multi_partition(sess):
+    rng = np.random.default_rng(5)
+    n = 3000
+    t = pa.table({"g": rng.integers(0, 20, n), "v": rng.random(n)})
+    df = sess.create_dataframe(t, num_partitions=4)
+
+    def stats(pdf):
+        return pd.DataFrame({"g": [pdf["g"].iloc[0]],
+                             "s": [pdf["v"].sum()],
+                             "c": [float(len(pdf))]})
+    got = (df.groupBy("g").applyInPandas(stats, "g long, s double, c double")
+           .orderBy("g").collect().to_pandas())
+    exp = (t.to_pandas().groupby("g")
+           .agg(s=("v", "sum"), c=("v", "size")).reset_index())
+    assert np.array_equal(got["g"], exp["g"])
+    assert np.allclose(got["s"], exp["s"])
+    assert np.array_equal(got["c"], exp["c"].astype(float))
+
+
+def test_two_lambdas_one_line_not_miscompiled(sess):
+    df, t = make_df(sess)
+    fs = [F.udf(lambda x: x + 1.0, returnType=T.DOUBLE), F.udf(lambda x: x * 2.0, returnType=T.DOUBLE)]  # noqa: E501
+    out = df.select(fs[0](df.a).alias("p"), fs[1](df.a).alias("q")) \
+        .collect().to_pylist()
+    assert [r["p"] for r in out] == [2.0, 3.0, 4.0, 5.0]
+    assert [r["q"] for r in out] == [2.0, 4.0, 6.0, 8.0]
+
+
+def test_truthy_and_or_not_compiled(sess):
+    """Python and/or over non-boolean operands returns operands — must
+    fall back to the host UDF, not compile to SQL booleans."""
+    df, t = make_df(sess)
+    f = F.udf(lambda a, b: a and b, returnType=T.DOUBLE)
+    q = df.select(f(df.a, df.b).alias("r"))
+    assert "host engine" in sess.explain(q)
+    out = [r["r"] for r in q.collect().to_pylist()]
+    assert out == [10., 20., 30., 40.]  # a is truthy -> b
+
+
+def test_compiled_udf_respects_return_type(sess):
+    df, t = make_df(sess)
+    f = F.udf(lambda a: a > 2.0, returnType=T.DOUBLE)
+    out = df.select(f(df.a).alias("r")).collect()
+    import pyarrow as pa
+    assert out.schema.field("r").type == pa.float64()
+    assert [r["r"] for r in out.to_pylist()] == [0.0, 0.0, 1.0, 1.0]
+
+
+def test_row_udf_exception_propagates(sess):
+    df, t = make_df(sess)
+    f = F.udf(lambda a: {}[a], returnType=T.DOUBLE)  # KeyError per row
+    with pytest.raises(KeyError):
+        df.select(f(df.a).alias("r")).collect()
+
+
+def test_pandas_udf_wrong_length_raises(sess):
+    df, t = make_df(sess)
+    p = F.pandas_udf(lambda s: pd.Series([s.sum()]), returnType=T.DOUBLE)
+    with pytest.raises(ValueError, match="length"):
+        df.select(p(df.a).alias("r")).collect()
+
+
+def test_apply_in_pandas_rejects_expression_keys(sess):
+    df, t = make_df(sess)
+    with pytest.raises(ValueError, match="plain columns"):
+        df.groupBy(df.g + 1).applyInPandas(lambda p: p, "g long")
