@@ -1,0 +1,66 @@
+"""Real-allocator hookup — the ``DeviceMemoryEventHandler.scala:37``
+analog.  XLA owns HBM, so there is no RMM callback to install; instead
+every compiled kernel invocation runs under this guard: a runtime
+RESOURCE_EXHAUSTED from the device triggers a synchronous spill of the
+catalog's device buffers and ONE retry; a second failure surfaces as
+``SplitAndRetryOOM`` so the retry framework can halve the operator's
+spillable inputs (``RmmRapidsRetryIterator`` contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+#: observability for tests/metrics
+STATS = {"oom_caught": 0, "oom_retry_ok": 0, "oom_split_raised": 0}
+
+
+def is_device_oom(exc: BaseException) -> bool:
+    """Heuristic match of PjRt/XLA allocation failures (the error type
+    lives in jaxlib and its message carries RESOURCE_EXHAUSTED / OOM)."""
+    name = type(exc).__name__
+    msg = str(exc)
+    if name == "XlaRuntimeError" or "XlaRuntimeError" in name:
+        return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                or "out of memory" in msg or "OOM" in msg)
+    return False
+
+
+def guard_device_oom(fn: Callable) -> Callable:
+    """Wrap a compiled kernel: on device OOM, spill-all + retry once, then
+    escalate to SplitAndRetryOOM (input halving)."""
+
+    def _sync(result):
+        # jit dispatch is ASYNC: an execution-time OOM surfaces when the
+        # result is consumed, which would be outside this guard — force
+        # materialization so the failure lands in our try block
+        try:
+            import jax
+            return jax.block_until_ready(result)
+        except ImportError:  # pragma: no cover
+            return result
+
+    def wrapped(*args, **kwargs):
+        try:
+            return _sync(fn(*args, **kwargs))
+        except Exception as e:  # noqa: BLE001 — filtered below
+            if not is_device_oom(e):
+                raise
+            STATS["oom_caught"] += 1
+            from .spill import BufferCatalog
+            BufferCatalog.get().spill_all_device()
+            try:
+                result = _sync(fn(*args, **kwargs))
+            except Exception as e2:  # noqa: BLE001
+                if is_device_oom(e2):
+                    STATS["oom_split_raised"] += 1
+                    from .retry import SplitAndRetryOOM
+                    raise SplitAndRetryOOM(
+                        f"device OOM persisted after spilling all "
+                        f"buffers: {e2}") from None
+                raise
+            STATS["oom_retry_ok"] += 1
+            return result
+
+    wrapped.__name__ = getattr(fn, "__name__", "kernel")
+    return wrapped

@@ -113,11 +113,13 @@ class PhysicalPlan:
         batch itself (bound expressions, static params, output names).
         """
         if self.backend == TPU:
+            from ...memory.oom_guard import guard_device_oom
             if key is not None:
                 from .kernel_cache import cached_jit
-                return cached_jit((type(self).__name__,) + tuple(key), fn)
+                return guard_device_oom(
+                    cached_jit((type(self).__name__,) + tuple(key), fn))
             import jax
-            return jax.jit(fn)
+            return guard_device_oom(jax.jit(fn))
         return fn
 
     @property

@@ -246,3 +246,102 @@ class TestTaskCompletion:
         stc.task_completed(1)
         assert fired == ["a", "c"]
         assert stc.pending(1) == 0
+
+
+class TestRealAllocatorHookup:
+    """DeviceMemoryEventHandler analog: real allocation failure -> spill ->
+    retry -> split (VERDICT r1 #10)."""
+
+    def test_add_batch_raises_split_when_batch_exceeds_pool(self, tmp_path):
+        from spark_rapids_tpu.config import SPILL_DIR, RapidsConf
+        from spark_rapids_tpu.memory.device import DeviceManager
+        from spark_rapids_tpu.memory.retry import SplitAndRetryOOM
+        conf = RapidsConf({SPILL_DIR.key: str(tmp_path)})
+        cat = BufferCatalog.reset(conf)
+        b = make_batch(100)
+        size = batch_device_bytes(b)
+        DeviceManager.initialize(pool_limit_override=size // 2)
+        try:
+            with pytest.raises(SplitAndRetryOOM):
+                cat.add_batch(b)
+        finally:
+            DeviceManager.shutdown()
+            cat.close_all()
+            BufferCatalog.reset()
+
+    def test_oversized_input_survives_via_retry_split(self, tmp_path):
+        """with_retry + split halves a batch that cannot fit the pool."""
+        from spark_rapids_tpu.config import SPILL_DIR, RapidsConf
+        from spark_rapids_tpu.memory.device import DeviceManager
+        from spark_rapids_tpu.memory.retry import (split_spillable_in_half,
+                                                   with_retry)
+        conf = RapidsConf({SPILL_DIR.key: str(tmp_path)})
+        cat = BufferCatalog.reset(conf)
+        big = make_batch(400)
+        DeviceManager.initialize(
+            pool_limit_override=batch_device_bytes(big) * 4)
+        try:
+            sb = SpillableColumnarBatch.create(big, catalog=cat)
+            seen_rows = []
+
+            def consume(s):
+                got = s.get()
+                # registering a copy simulates an op output that must fit
+                h = cat.add_batch(got)
+                cat.remove(h)
+                seen_rows.append(got.num_rows_int)
+                return got.num_rows_int
+
+            # shrink the pool below ONE whole batch so the copy can only
+            # ever fit after the input is split in half
+            DeviceManager.initialize(
+                pool_limit_override=int(batch_device_bytes(big) * 0.9))
+            total = sum(with_retry([sb], consume, split_spillable_in_half))
+            assert total == 400
+            assert len(seen_rows) >= 2  # was split at least once
+        finally:
+            DeviceManager.shutdown()
+            cat.close_all()
+            BufferCatalog.reset()
+
+    def test_device_oom_guard_spills_and_retries(self):
+        from spark_rapids_tpu.memory import oom_guard as G
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory "
+                                      "allocating 1048576 bytes")
+            return 42
+
+        before = G.STATS["oom_retry_ok"]
+        assert G.guard_device_oom(flaky)() == 42
+        assert calls["n"] == 2
+        assert G.STATS["oom_retry_ok"] == before + 1
+
+    def test_device_oom_guard_escalates_to_split(self):
+        from spark_rapids_tpu.memory import oom_guard as G
+        from spark_rapids_tpu.memory.retry import SplitAndRetryOOM
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        def always_oom():
+            raise XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+
+        with pytest.raises(SplitAndRetryOOM):
+            G.guard_device_oom(always_oom)()
+
+    def test_guard_passes_through_other_errors(self):
+        from spark_rapids_tpu.memory import oom_guard as G
+
+        def boom():
+            raise ValueError("not an oom")
+
+        with pytest.raises(ValueError):
+            G.guard_device_oom(boom)()
