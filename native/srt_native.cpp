@@ -1,0 +1,206 @@
+// srt_native — host-side native kernels for spark_rapids_tpu, the analog
+// of the reference's JNI layer around cuDF host utilities (SURVEY §2.10):
+// row<->columnar string packing (RowConversion analog), Spark-exact hash
+// reference implementations (com.nvidia.spark.rapids.jni.Hash), and the
+// xxhash64 frame checksum used by the shuffle serializer.
+//
+// Exposed as a plain C ABI consumed through ctypes (no pybind11 in this
+// toolchain); every function operates on caller-owned buffers.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// string byte-matrix packing
+// ---------------------------------------------------------------------------
+
+// flat: concatenated UTF-8 bytes; offsets: int64[n+1] into flat.
+// out_matrix: zeroed uint8[n * width]; out_lens: int32[n].
+// Rows longer than width are truncated (callers size width to the max).
+void srt_pack_strings(const uint8_t* flat, const int64_t* offsets,
+                      int64_t n, int64_t width,
+                      uint8_t* out_matrix, int32_t* out_lens) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t start = offsets[i];
+        int64_t len = offsets[i + 1] - start;
+        if (len > width) len = width;
+        std::memcpy(out_matrix + i * width, flat + start,
+                    static_cast<size_t>(len));
+        out_lens[i] = static_cast<int32_t>(len);
+    }
+}
+
+// inverse: matrix rows back to concatenated bytes; returns total length.
+// out_flat must hold sum(lens); out_offsets: int64[n+1].
+int64_t srt_unpack_strings(const uint8_t* matrix, const int32_t* lens,
+                           int64_t n, int64_t width,
+                           uint8_t* out_flat, int64_t* out_offsets) {
+    int64_t pos = 0;
+    out_offsets[0] = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t len = lens[i];
+        if (len > width) len = static_cast<int32_t>(width);
+        std::memcpy(out_flat + pos, matrix + i * width,
+                    static_cast<size_t>(len));
+        pos += len;
+        out_offsets[i + 1] = pos;
+    }
+    return pos;
+}
+
+// ---------------------------------------------------------------------------
+// Spark-exact murmur3-x86-32 (reference jni.Hash semantics) — the
+// independent host oracle the device kernels are validated against.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k1(uint32_t k1) {
+    k1 *= 0xcc9e2d51u;
+    k1 = rotl32(k1, 15);
+    k1 *= 0x1b873593u;
+    return k1;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    return h1 * 5u + 0xe6546b64u;
+}
+
+static inline uint32_t fmix(uint32_t h1, uint32_t len) {
+    h1 ^= len;
+    h1 ^= h1 >> 16;
+    h1 *= 0x85ebca6bu;
+    h1 ^= h1 >> 13;
+    h1 *= 0xc2b2ae35u;
+    h1 ^= h1 >> 16;
+    return h1;
+}
+
+void srt_murmur3_i32(const int32_t* vals, int64_t n, uint32_t seed,
+                     int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t h1 = mix_h1(seed, mix_k1(static_cast<uint32_t>(vals[i])));
+        out[i] = static_cast<int32_t>(fmix(h1, 4));
+    }
+}
+
+void srt_murmur3_i64(const int64_t* vals, int64_t n, uint32_t seed,
+                     int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t v = static_cast<uint64_t>(vals[i]);
+        uint32_t low = static_cast<uint32_t>(v);
+        uint32_t high = static_cast<uint32_t>(v >> 32);
+        uint32_t h1 = mix_h1(seed, mix_k1(low));
+        h1 = mix_h1(h1, mix_k1(high));
+        out[i] = static_cast<int32_t>(fmix(h1, 8));
+    }
+}
+
+// Spark murmur3 for UTF-8 strings: 4-byte little-endian blocks, then
+// SIGNED-byte tail mixing (Spark's hashUnsafeBytes semantics).
+int32_t srt_murmur3_bytes(const uint8_t* data, int64_t len, uint32_t seed) {
+    uint32_t h1 = seed;
+    int64_t nblocks = len / 4;
+    for (int64_t b = 0; b < nblocks; ++b) {
+        uint32_t k1;
+        std::memcpy(&k1, data + b * 4, 4);  // little-endian hosts only
+        h1 = mix_h1(h1, mix_k1(k1));
+    }
+    for (int64_t i = nblocks * 4; i < len; ++i) {
+        int32_t sb = static_cast<int8_t>(data[i]);  // sign-extended
+        h1 = mix_h1(h1, mix_k1(static_cast<uint32_t>(sb)));
+    }
+    return static_cast<int32_t>(fmix(h1, static_cast<uint32_t>(len)));
+}
+
+// ---------------------------------------------------------------------------
+// xxhash64 over raw bytes — shuffle frame integrity checksum
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t round1(uint64_t acc, uint64_t input) {
+    acc += input * P2;
+    acc = rotl64(acc, 31);
+    return acc * P1;
+}
+
+static inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+    acc ^= round1(0, val);
+    return acc * P1 + P4;
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    return v;
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+uint64_t srt_xxhash64_bytes(const uint8_t* data, int64_t len, uint64_t seed) {
+    const uint8_t* p = data;
+    const uint8_t* end = data + len;
+    uint64_t h;
+    if (len >= 32) {
+        uint64_t v1 = seed + P1 + P2;
+        uint64_t v2 = seed + P2;
+        uint64_t v3 = seed;
+        uint64_t v4 = seed - P1;
+        do {
+            v1 = round1(v1, read64(p)); p += 8;
+            v2 = round1(v2, read64(p)); p += 8;
+            v3 = round1(v3, read64(p)); p += 8;
+            v4 = round1(v4, read64(p)); p += 8;
+        } while (p + 32 <= end);
+        h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+        h = merge_round(h, v1);
+        h = merge_round(h, v2);
+        h = merge_round(h, v3);
+        h = merge_round(h, v4);
+    } else {
+        h = seed + P5;
+    }
+    h += static_cast<uint64_t>(len);
+    while (p + 8 <= end) {
+        h ^= round1(0, read64(p));
+        h = rotl64(h, 27) * P1 + P4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h ^= static_cast<uint64_t>(read32(p)) * P1;
+        h = rotl64(h, 23) * P2 + P3;
+        p += 4;
+    }
+    while (p < end) {
+        h ^= (*p) * P5;
+        h = rotl64(h, 11) * P1;
+        ++p;
+    }
+    h ^= h >> 33;
+    h *= P2;
+    h ^= h >> 29;
+    h *= P3;
+    h ^= h >> 32;
+    return h;
+}
+
+}  // extern "C"

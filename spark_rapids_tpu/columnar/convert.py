@@ -159,6 +159,14 @@ def _strings_to_matrix(arr: pa.Array, capacity: int
     starts = offsets[:-1].astype(np.int64)  # absolute buffer positions
     lengths_np = (offsets[1:] - offsets[:-1]).astype(np.int32)
     width = bucket_width(int(lengths_np.max()) if n else 0)
+    if n:
+        # native single-pass pack (no O(total-bytes) index temporaries);
+        # the numpy path below is the toolchain-free fallback
+        from ..native import pack_strings as _native_pack
+        packed = _native_pack(data, offsets.astype(np.int64), width,
+                              capacity)
+        if packed is not None:
+            return packed
     chars = np.zeros((capacity, width), dtype=np.uint8)
     total = int(lengths_np.sum())
     if total:

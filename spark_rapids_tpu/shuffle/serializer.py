@@ -31,6 +31,7 @@ _MAGIC = b"TPUB"
 _VERSION = 1
 
 _FLAG_ZSTD = 1
+_FLAG_CRC = 2   # trailing xxhash64 of the (possibly compressed) payload
 
 
 def _codec(conf) -> str:
@@ -119,9 +120,30 @@ def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
         import zstandard
         raw = zstandard.ZstdCompressor(level=1).compress(raw)
         flags |= _FLAG_ZSTD
+    # xxhash64 frame checksum — corruption on the wire/disk fails loudly
+    # instead of deserializing garbage.  "auto" only engages the native
+    # library (the pure-Python fallback would dominate the hot path).
+    tail = b""
+    if _checksum_on(conf):
+        from ..native import xxhash64_bytes
+        crc = xxhash64_bytes(raw, seed=len(raw))
+        flags |= _FLAG_CRC
+        tail = struct.pack("<Q", crc)
     head = struct.pack("<4sHHII", _MAGIC, _VERSION, flags, n,
                        batch.num_cols)
-    return head + struct.pack("<I", len(sj)) + raw
+    return head + struct.pack("<I", len(sj)) + raw + tail
+
+
+def _checksum_on(conf) -> bool:
+    from ..config import SHUFFLE_CHECKSUM, RapidsConf
+    conf = conf or RapidsConf.get_global()
+    mode = str(conf.get(SHUFFLE_CHECKSUM)).lower()
+    if mode == "true":
+        return True
+    if mode == "false":
+        return False
+    from ..native import available
+    return available()
 
 
 def _spec_of(dt: T.DataType):
@@ -220,6 +242,15 @@ def deserialize_batch(frame: bytes, capacity: Optional[int] = None
     flags, n, ncols = head[2], head[3], head[4]
     (sj_len,) = struct.unpack_from("<I", frame, 16)
     raw = frame[20:]
+    if flags & _FLAG_CRC:
+        raw, tail = raw[:-8], raw[-8:]
+        from ..native import xxhash64_bytes
+        (want,) = struct.unpack("<Q", tail)
+        got = xxhash64_bytes(raw, seed=len(raw))
+        if got != want:
+            raise ValueError(
+                f"shuffle frame checksum mismatch "
+                f"(got {got:#x}, want {want:#x}) — corrupt frame")
     if flags & _FLAG_ZSTD:
         import zstandard
         raw = zstandard.ZstdDecompressor().decompress(raw)
