@@ -93,11 +93,19 @@ class ColumnarBatch:
         cols = tuple(c.slice_capacity(new_capacity) for c in self.columns)
         return ColumnarBatch(self.names, cols, self.num_rows)
 
+    #: capacities at or below this skip shrinking entirely: the serializer
+    #: ships live rows only, so small padding is free — while the
+    #: num_rows sync shrunk() needs costs a full host round-trip (an RTT
+    #: over the TPU tunnel)
+    _SHRINK_MIN_CAPACITY = 4096
+
     def shrunk(self) -> "ColumnarBatch":
         """Drop excess capacity padding down to the row count's bucket.
         Host-side decision (syncs on num_rows); call at exec boundaries
         where the live row count can collapse (post-agg, post-split) so
         downstream kernels/serializers don't chew dead padding."""
+        if self.capacity <= self._SHRINK_MIN_CAPACITY:
+            return self
         cap = bucket_capacity(self.num_rows_int)
         if cap >= self.capacity:
             return self

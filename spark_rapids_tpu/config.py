@@ -169,6 +169,12 @@ ADAPTIVE_ENABLED = register(
     "Adaptive query execution: joins re-decide broadcast-vs-shuffle from "
     "the build side's OBSERVED size at runtime (reference AQE integration, "
     "GpuOverrides.scala:4392-4452 + GpuCustomShuffleReaderExec).", True)
+ADAPTIVE_COALESCE_ROWS = register(
+    "spark.sql.adaptive.coalescePartitions.minRows",
+    "Exchanges whose total map output has at most this many rows route "
+    "everything to one reduce partition (AQE partition coalescing, "
+    "GpuCustomShuffleReaderExec analog): tiny post-aggregation states "
+    "stop paying per-partition split/launch/sync overhead.", 1 << 16)
 OPTIMIZER_ENABLED = register(
     "spark.rapids.sql.optimizer.enabled",
     "Cost-based optimizer: flips subtrees back to the host engine when the "

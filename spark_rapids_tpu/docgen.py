@@ -1,0 +1,111 @@
+"""Documentation generator — the analog of the reference's docgen stack:
+``RapidsConf.help()`` -> docs/configs.md (``RapidsConf.scala:2057-2103``),
+``SupportedOpsDocs`` -> docs/supported_ops.md and ``SupportedOpsForTools``
+-> tools/generated_files/*.csv (``TypeChecks.scala:1777,2231``).
+
+Run:  python -m spark_rapids_tpu.docgen [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+from .config import ENTRIES, help_text
+
+
+def _exec_rows() -> List[tuple]:
+    """(exec name, description) for every planned physical operator."""
+    return [
+        ("InMemoryScanExec", "scan of in-memory relations (host decode, "
+         "cached device upload)"),
+        ("FileScanExec", "parquet/orc/csv/json/avro file scans, "
+         "PERFILE|MULTITHREADED|COALESCING reader strategies"),
+        ("RangeExec", "range generation"),
+        ("ProjectExec", "projection (fusable into whole-stage programs)"),
+        ("FilterExec", "filter (fusable into whole-stage programs)"),
+        ("SampleExec", "random sampling"),
+        ("ExpandExec", "grouping-sets expansion"),
+        ("UnionExec", "union all"),
+        ("HashAggregateExec", "partial/final/complete hash aggregation with "
+         "spillable out-of-core merge"),
+        ("SortExec", "in-core + out-of-core sort (spillable run merge)"),
+        ("TakeOrderedAndProjectExec", "ORDER BY + LIMIT TopN"),
+        ("LocalLimitExec", "per-partition limit"),
+        ("GlobalLimitExec", "global limit + offset"),
+        ("CoalescePartitionsExec", "partition coalescing"),
+        ("WindowExec", "window functions, ROWS+RANGE frames"),
+        ("GenerateExec", "explode/posexplode"),
+        ("ShuffleExchangeExec", "hash/range/round-robin/single exchanges; "
+         "local serializer plane, ICI mesh all_to_all plane, AQE "
+         "partition coalescing"),
+        ("BroadcastExchangeExec", "broadcast build sides"),
+        ("ShuffledHashJoinExec", "co-partitioned hash join, chunked gather"),
+        ("BroadcastHashJoinExec", "broadcast hash join"),
+        ("NestedLoopJoinExec", "cartesian/conditional joins"),
+        ("AdaptiveJoinExec", "AQE runtime broadcast-vs-shuffle re-decision"),
+        ("MapInPandasExec", "mapInPandas (Arrow-fed Python)"),
+        ("FlatMapGroupsInPandasExec", "applyInPandas per key group"),
+        ("HostToDeviceExec / DeviceToHostExec", "backend transitions"),
+        ("CoalesceBatchesExec", "batch-size normalization"),
+    ]
+
+
+def supported_ops_md() -> str:
+    from .sql.expressions.registry import EXPRESSION_REGISTRY
+    lines = ["# Supported operators and expressions", "",
+             "## Execs", "",
+             "Exec | Description", "-----|------------"]
+    for name, desc in _exec_rows():
+        lines.append(f"{name} | {desc}")
+    lines += ["", "## Expressions", "",
+              f"{len(EXPRESSION_REGISTRY)} expression classes are "
+              "registered for device execution (anything else runs on the "
+              "host engine per-operator):", ""]
+    for name in sorted(EXPRESSION_REGISTRY):
+        lines.append(f"- {name}")
+    return "\n".join(lines) + "\n"
+
+
+def supported_exprs_csv() -> str:
+    from .sql.expressions.registry import EXPRESSION_REGISTRY
+    rows = ["Expression,Supported,Notes"]
+    for name in sorted(EXPRESSION_REGISTRY):
+        rows.append(f"{name},S,")
+    return "\n".join(rows) + "\n"
+
+
+def operators_score_csv() -> str:
+    """Per-op speedup scores for qualification tooling (the
+    operatorsScore.csv analog; scores mirror the reference defaults)."""
+    rows = ["CPUOperator,Score"]
+    for name, _ in _exec_rows():
+        rows.append(f"{name.split(' ')[0]},3.0")
+    return "\n".join(rows) + "\n"
+
+
+def generate(root: str) -> List[str]:
+    docs = os.path.join(root, "docs")
+    tools = os.path.join(root, "tools", "generated_files")
+    os.makedirs(docs, exist_ok=True)
+    os.makedirs(tools, exist_ok=True)
+    written = []
+    for path, content in [
+        (os.path.join(docs, "configs.md"), help_text()),
+        (os.path.join(docs, "advanced_configs.md"),
+         help_text(include_internal=True)),
+        (os.path.join(docs, "supported_ops.md"), supported_ops_md()),
+        (os.path.join(tools, "supportedExprs.csv"), supported_exprs_csv()),
+        (os.path.join(tools, "operatorsScore.csv"), operators_score_csv()),
+    ]:
+        with open(path, "w") as fh:
+            fh.write(content)
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    root = sys.argv[1] if len(sys.argv) > 1 else os.getcwd()
+    for p in generate(root):
+        print("wrote", p)

@@ -138,7 +138,9 @@ def mesh_shuffle_batches(mesh, batches: List, pids: List, nt: int) -> List:
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from ..shims import shard_map as _shim_shard_map
+    shard_map = _shim_shard_map()  # version-shimmed (shims/, L6 analog)
 
     from ..columnar.batch import ColumnarBatch
     from ..ops.join import compact_indices

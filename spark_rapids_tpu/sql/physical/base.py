@@ -23,16 +23,32 @@ from ..expressions.core import AttributeReference
 TPU, CPU = "tpu", "cpu"
 
 
+#: metric verbosity ranks (GpuExec.scala:49-141 ESSENTIAL/MODERATE/DEBUG)
+_METRIC_RANK = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+
 class TaskContext:
     """Per-task context: metrics + conf + partition id (GpuTaskMetrics /
-    TaskContext analog)."""
+    TaskContext analog).  Metrics above the configured verbosity level
+    are dropped at the increment site (spark.rapids.sql.metrics.level)."""
 
-    def __init__(self, partition_id: int, conf: Optional[RapidsConf] = None):
+    def __init__(self, partition_id: int, conf: Optional[RapidsConf] = None,
+                 parent: Optional["TaskContext"] = None):
         self.partition_id = partition_id
         self.conf = conf or RapidsConf.get_global()
-        self.metrics: Dict[str, float] = {}
+        # contexts spawned INSIDE another task (exchange map side, join
+        # build collection) share the parent's metrics dict, so the work
+        # below an exchange still shows up in last_query_metrics
+        self.metrics: Dict[str, float] = (parent.metrics if parent is not None
+                                          else {})
+        from ...config import METRICS_LEVEL
+        self._rank = _METRIC_RANK.get(
+            str(self.conf.get(METRICS_LEVEL)).upper(), 1)
 
-    def inc_metric(self, name: str, value: float = 1.0):
+    def inc_metric(self, name: str, value: float = 1.0,
+                   level: str = "MODERATE"):
+        if _METRIC_RANK.get(level, 1) > self._rank:
+            return
         self.metrics[name] = self.metrics.get(name, 0.0) + value
 
 
@@ -71,14 +87,20 @@ class PhysicalPlan:
                     ) -> List[ColumnarBatch]:
         """Run every partition serially (local mode driver).  Each task
         acquires the device semaphore, arms test OOM injection
-        (conftest.py:113-265 analog), and fires completion callbacks."""
-        from ...config import TEST_INJECT_RETRY_OOM, TEST_INJECT_SPLIT_OOM
+        (conftest.py:113-265 analog), and fires completion callbacks.
+        With ``spark.rapids.tpu.trace.enabled`` each task runs inside a
+        ``jax.profiler`` TraceAnnotation (NVTX-range analog); task metrics
+        accumulate onto ``self.metrics`` for the session to report."""
+        from ...config import (DUMP_ON_ERROR_PATH, TEST_INJECT_RETRY_OOM,
+                               TEST_INJECT_SPLIT_OOM, TRACE_ENABLED)
         from ...memory.completion import ScalableTaskCompletion
         from ...memory.retry import arm_oom_injection
         from ...memory.semaphore import TpuSemaphore
         out: List[ColumnarBatch] = []
         sem = TpuSemaphore.get()
         stc = ScalableTaskCompletion.get()
+        cfg = conf or RapidsConf.get_global()
+        tracing = bool(cfg.get(TRACE_ENABLED))
         for pid in range(self.num_partitions()):
             tctx = TaskContext(pid, conf)
             arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
@@ -87,12 +109,23 @@ class PhysicalPlan:
             failed = False
             try:
                 with np.errstate(all="ignore"):
-                    out.extend(self.execute(pid, tctx))
-            except BaseException:
+                    if tracing:
+                        import jax.profiler
+                        with jax.profiler.TraceAnnotation(
+                                f"{self.node_name()}:task{pid}"):
+                            out.extend(self.execute(pid, tctx))
+                    else:
+                        out.extend(self.execute(pid, tctx))
+            except BaseException as e:
                 failed = True
+                dump_dir = str(tctx.conf.get(DUMP_ON_ERROR_PATH))
+                if dump_dir:
+                    _dump_failure(dump_dir, self, pid, e, out)
                 raise
             finally:
                 sem.release_if_necessary(pid)
+                for k, v in tctx.metrics.items():
+                    self.metrics[k] = self.metrics.get(k, 0.0) + v
                 try:
                     stc.task_completed(pid)
                 except Exception:
@@ -150,3 +183,25 @@ class PhysicalPlan:
 def eval_context(plan: PhysicalPlan, batch: ColumnarBatch, conf=None):
     from ..expressions.core import EvalContext
     return EvalContext(batch, xp=plan.xp, conf=conf)
+
+
+def _dump_failure(dump_dir: str, plan: PhysicalPlan, pid: int,
+                  exc: BaseException, batches: Sequence[ColumnarBatch]):
+    """DumpUtils analog: on task failure, write the batches produced so
+    far as parquet plus the plan/error text for offline repro."""
+    import os
+    import time
+    try:
+        stamp = f"{int(time.time())}-{type(plan).__name__}-p{pid}"
+        d = os.path.join(dump_dir, stamp)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "error.txt"), "w") as fh:
+            fh.write(f"{type(exc).__name__}: {exc}\n\nplan:\n"
+                     f"{plan.tree_string()}\n")
+        import pyarrow.parquet as pq
+        from ...columnar.convert import device_to_arrow
+        for i, b in enumerate(batches[-4:]):  # last few batches
+            pq.write_table(device_to_arrow(b),
+                           os.path.join(d, f"batch-{i}.parquet"))
+    except Exception:
+        pass  # dumping must never mask the original failure

@@ -31,10 +31,16 @@ def empty_batch_for(attrs) -> ColumnarBatch:
 
 class ShuffleExchangeExec(PhysicalPlan):
     def __init__(self, partitioning: Partitioning, child: PhysicalPlan,
-                 backend=TPU):
+                 backend=TPU, coalescible: bool = True):
         super().__init__(child)
         self.backend = backend
         self.partitioning = partitioning.bind(child.output)
+        #: AQE partition coalescing is only sound when no sibling exchange
+        #: must stay aligned with this one — the two exchanges feeding a
+        #: co-partitioned join decide INDEPENDENTLY, so one coalescing
+        #: while the other keeps hashing would silently mis-join; the join
+        #: planner passes coalescible=False for both sides
+        self._coalescible = coalescible
         self._materialized: Optional[List[List[ColumnarBatch]]] = None
         self._split_fn = self._jit(self._split_one, key=("split",))
 
@@ -76,21 +82,34 @@ class ShuffleExchangeExec(PhysicalPlan):
         num_maps = child.num_partitions()
         map_out: List[Optional[ColumnarBatch]] = []
         for cpid in range(num_maps):
-            got = list(child.execute(cpid, TaskContext(cpid, tctx.conf)))
+            got = list(child.execute(cpid, TaskContext(cpid, tctx.conf, parent=tctx)))
             map_out.append(ColumnarBatch.concat(got) if len(got) > 1
                            else (got[0] if got else None))
 
-        if isinstance(self.partitioning, RangePartitioning):
+        # AQE partition coalescing: a tiny total map output routes whole
+        # to reduce partition 0 — equal keys stay co-located (trivially)
+        # and a range order is trivially preserved, while the downstream
+        # plan stops paying nt-1 empty split/launch/sync rounds
+        # (GpuCustomShuffleReaderExec coalesced-partitions analog)
+        from ...config import ADAPTIVE_COALESCE_ROWS, ADAPTIVE_ENABLED
+        coalesce = (nt > 1 and self._coalescible
+                    and bool(tctx.conf.get(ADAPTIVE_ENABLED))
+                    and sum(b.num_rows_int for b in map_out
+                            if b is not None)
+                    <= int(tctx.conf.get(ADAPTIVE_COALESCE_ROWS)))
+
+        if isinstance(self.partitioning, RangePartitioning) and not coalesce:
             self._compute_range_bounds(map_out)
 
-        if mgr.mode == "ICI" and self.backend == TPU and nt > 1:
+        if (mgr.mode == "ICI" and self.backend == TPU and nt > 1
+                and not coalesce):
             if self._try_mesh_materialize(map_out, nt):
                 return
 
         for cpid, merged in enumerate(map_out):
             if merged is None:
                 continue
-            if nt == 1:
+            if nt == 1 or coalesce:
                 pieces: List[Optional[ColumnarBatch]] = [merged]
             else:
                 ctx = EvalContext(merged, xp=self.xp)
@@ -208,7 +227,7 @@ class BroadcastExchangeExec(PhysicalPlan):
             batches = []
             for cpid in range(self.children[0].num_partitions()):
                 batches.extend(self.children[0].execute(
-                    cpid, TaskContext(cpid, tctx.conf)))
+                    cpid, TaskContext(cpid, tctx.conf, parent=tctx)))
             if not batches:
                 self._cached = empty_batch_for(self.output)
             else:

@@ -346,7 +346,7 @@ class ShuffledHashJoinExec(BaseJoinExec):
 
     def execute(self, pid: int, tctx: TaskContext):
         build = self._concat_or_empty(
-            list(self._build.execute(pid, TaskContext(pid, tctx.conf))),
+            list(self._build.execute(pid, TaskContext(pid, tctx.conf, parent=tctx))),
             self._build.output)
         probes = list(self._probe.execute(pid, tctx))
         how = self._norm_how
@@ -561,7 +561,7 @@ class AdaptiveJoinExec(PhysicalPlan):
             return
         from ...config import AUTO_BROADCAST_THRESHOLD
         node, left, right = self._node, self.children[0], self.children[1]
-        parts = [list(right.execute(p, TaskContext(p, tctx.conf)))
+        parts = [list(right.execute(p, TaskContext(p, tctx.conf, parent=tctx)))
                  for p in range(right.num_partitions())]
         right_m = MaterializedExec(right.output, parts, backend=self.backend)
         threshold = int(self._conf.get(AUTO_BROADCAST_THRESHOLD))
@@ -580,10 +580,10 @@ class AdaptiveJoinExec(PhysicalPlan):
             from .exchange import ShuffleExchangeExec
             lx = ShuffleExchangeExec(
                 HashPartitioning(node.left_keys, n), left,
-                backend=self.backend)
+                backend=self.backend, coalescible=False)
             rx = ShuffleExchangeExec(
                 HashPartitioning(node.right_keys, n), right_m,
-                backend=self.backend)
+                backend=self.backend, coalescible=False)
             self._chosen = ShuffledHashJoinExec(
                 node.how, node.left_keys, node.right_keys, node.condition,
                 lx, rx, backend=self.backend)
@@ -596,7 +596,7 @@ class AdaptiveJoinExec(PhysicalPlan):
         # serve the chosen plan's m partitions through our fixed n pids
         for p in range(pid, m, n) if m > n else (
                 [pid] if pid < m else []):
-            yield from self._chosen.execute(p, TaskContext(p, tctx.conf))
+            yield from self._chosen.execute(p, TaskContext(p, tctx.conf, parent=tctx))
 
     def simple_string(self):
         tag = self.chosen_strategy or "undecided"
@@ -651,8 +651,10 @@ def plan_join(node, left: PhysicalPlan, right: PhysicalPlan, backend,
     if nparts > 1:
         n = int(conf.shuffle_partitions)
         left = ShuffleExchangeExec(
-            HashPartitioning(node.left_keys, n), left, backend=backend)
+            HashPartitioning(node.left_keys, n), left, backend=backend,
+            coalescible=False)
         right = ShuffleExchangeExec(
-            HashPartitioning(node.right_keys, n), right, backend=backend)
+            HashPartitioning(node.right_keys, n), right, backend=backend,
+            coalescible=False)
     return ShuffledHashJoinExec(how, node.left_keys, node.right_keys,
                                 node.condition, left, right, backend=backend)

@@ -36,6 +36,7 @@ class TpuSession:
         base = conf or RapidsConf.get_global()
         self._conf = base.copy(conf_kwargs or None)
         self.conf = SessionConf(self._conf)
+        self.last_query_metrics: dict = {}
 
     # ------------------------------------------------------------------
     @classmethod
@@ -75,6 +76,14 @@ class TpuSession:
         planner = Planner(self._conf)
         phys = planner.plan_for_collect(logical)
         batches = phys.execute_all(self._conf)
+        metrics: dict = {}
+        stack = [phys]
+        while stack:
+            node = stack.pop()
+            for k, v in node.metrics.items():
+                metrics[k] = metrics.get(k, 0.0) + v
+            stack.extend(node.children)
+        self.last_query_metrics = metrics
         tables = [device_to_arrow(b) for b in batches if b.num_rows_int > 0]
         arrow_schema = pa.schema([
             pa.field(a.name, T.to_arrow(a.dtype)) for a in logical.output])

@@ -15,7 +15,10 @@ from spark_rapids_tpu.parallel import mesh as M
 from spark_rapids_tpu.sql import functions as F
 
 ICI_CONF = {"spark.rapids.shuffle.mode": "ICI",
-            "spark.sql.shuffle.partitions": 8}
+            "spark.sql.shuffle.partitions": 8,
+            # small test shapes must still exercise the mesh data plane
+            # (AQE would rightly coalesce them to one partition)
+            "spark.sql.adaptive.coalescePartitions.minRows": 0}
 
 
 @pytest.fixture()
