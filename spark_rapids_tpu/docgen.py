@@ -81,7 +81,9 @@ def operators_score_csv() -> str:
     operatorsScore.csv analog; scores mirror the reference defaults)."""
     rows = ["CPUOperator,Score"]
     for name, _ in _exec_rows():
-        rows.append(f"{name.split(' ')[0]},3.0")
+        # combined rows ("A / B") expand to one CSV row per exec
+        for part in name.split(" / "):
+            rows.append(f"{part.strip()},3.0")
     return "\n".join(rows) + "\n"
 
 

@@ -28,7 +28,18 @@ def expand_paths(paths: Sequence[str]) -> List[str]:
     return out
 
 
+def _normalize_fmt(fmt: str, options: Dict) -> str:
+    """hive text tables are ^A-delimited headerless csv (reference
+    GpuHiveTextFileFormat, org/apache/spark/sql/hive/rapids)."""
+    if fmt in ("hivetext", "hive-text", "hive"):
+        options.setdefault("sep", "\x01")
+        options.setdefault("header", "false")
+        return "csv"
+    return fmt
+
+
 def infer_schema(fmt: str, paths: Sequence[str], options: Dict) -> T.StructType:
+    fmt = _normalize_fmt(fmt, options)
     files = expand_paths(paths)
     if not files:
         raise FileNotFoundError(f"no input files for {paths}")
@@ -58,6 +69,7 @@ def infer_schema(fmt: str, paths: Sequence[str], options: Dict) -> T.StructType:
 def read_file(fmt: str, path: str, options: Dict,
               columns: Optional[List[str]] = None,
               head_rows: Optional[int] = None) -> pa.Table:
+    fmt = _normalize_fmt(fmt, options)
     if fmt == "parquet":
         import pyarrow.parquet as pq
         return pq.read_table(path, columns=columns)

@@ -29,7 +29,8 @@ from ..config import RapidsConf
 from ..sql.physical.base import PhysicalPlan, TaskContext
 
 _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv",
-        "json": ".json", "avro": ".avro"}
+        "json": ".json", "avro": ".avro", "hivetext": ".txt",
+        "hive-text": ".txt", "hive": ".txt"}
 
 
 # --------------------------------------------------------------------------
@@ -37,6 +38,8 @@ _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv",
 # --------------------------------------------------------------------------
 
 def write_table(fmt: str, table: pa.Table, path: str, options: Dict) -> None:
+    from .registry import _normalize_fmt
+    fmt = _normalize_fmt(fmt, options)
     if fmt == "parquet":
         import pyarrow.parquet as pq
         codec = options.get("compression", "snappy")

@@ -502,10 +502,19 @@ class DataFrame:
     first = head
 
     def cache(self) -> "DataFrame":
-        """Materialize once (ParquetCachedBatchSerializer analog: cached as
-        an in-memory arrow relation)."""
+        """Materialize once (ParquetCachedBatchSerializer analog: the
+        collected result is stored as COMPRESSED parquet bytes and decoded
+        lazily on re-read, so a cached-but-idle dataframe costs parquet
+        bytes rather than live arrow/device memory)."""
+        import io as _io
+        import pyarrow.parquet as _pq
         table = self.collect()
-        return self._session.create_dataframe(table)
+        buf = _io.BytesIO()
+        _pq.write_table(table, buf, compression="zstd")
+        fields = tuple(T.StructField(a.name, a.dtype, a.nullable)
+                       for a in self._plan.output)
+        return DataFrame(P.CachedRelation(buf.getvalue(), fields),
+                         self._session)
 
     persist = cache
 
@@ -552,6 +561,29 @@ class DataFrameWriter:
         from ..io_.writers import run_write_job
         from .planner import Planner
         sess = self._df._session
+        if self._format == "delta":
+            from ..delta import DeltaTable
+            exists = DeltaTable.is_delta_table(path)
+            if exists and self._mode in ("error", "errorifexists"):
+                raise FileExistsError(
+                    f"delta table already exists at {path} "
+                    "(mode=errorifexists)")
+            if exists and self._mode == "ignore":
+                return None
+            missing = [c for c in self._partition_by
+                       if c not in self._df.columns]
+            if missing:
+                raise KeyError(
+                    f"partitionBy columns not in schema: {missing}")
+            mode = "overwrite" if self._mode == "overwrite" else "append"
+            if not exists:
+                import os as _os
+                _os.makedirs(path, exist_ok=True)
+                dt = DeltaTable(sess, path)
+            else:
+                dt = DeltaTable.forPath(sess, path)
+            return dt.write_df(self._df, mode,
+                               partition_by=self._partition_by)
         missing = [c for c in self._partition_by
                    if c not in self._df.columns]
         if missing:

@@ -74,6 +74,33 @@ class Relation(LogicalPlan):
 
 
 @dataclass(eq=False)
+class CachedRelation(LogicalPlan):
+    """df.persist() backing store: the collected result held as COMPRESSED
+    parquet bytes, decoded lazily on first scan (the
+    ParquetCachedBatchSerializer analog — cached data costs parquet bytes,
+    not live arrow/device memory, until it is read again)."""
+    blob: bytes = b""
+    schema_fields: Tuple = ()
+
+    @property
+    def table(self):
+        if not hasattr(self, "_table"):
+            import io as _io
+            import pyarrow.parquet as _pq
+            self._table = _pq.read_table(_io.BytesIO(self.blob))
+        return self._table
+
+    @property
+    def output(self):
+        return [AttributeReference(f.name, f.data_type, True)
+                for f in self.schema_fields]
+
+    def simple_string(self):
+        return (f"CachedRelation [{', '.join(a.name for a in self.output)}] "
+                f"({len(self.blob)} parquet bytes)")
+
+
+@dataclass(eq=False)
 class ScanRelation(LogicalPlan):
     """File-source relation (Parquet/ORC/CSV/JSON/Avro)."""
     fmt: str = "parquet"

@@ -59,6 +59,8 @@ class Planner:
         if isinstance(node, P.Relation):
             parts = node.partitions if node.partitions is not None else [node.table]
             exec_ = InMemoryScanExec(node.output, parts, backend=be)
+        elif isinstance(node, P.CachedRelation):
+            exec_ = InMemoryScanExec(node.output, [node.table], backend=be)
         elif isinstance(node, P.ScanRelation):
             from ..io_.exec import FileScanExec
             exec_ = FileScanExec(node, backend=be, conf=self.conf)
@@ -95,7 +97,11 @@ class Planner:
                 part = HashPartitioning(node.exprs, node.num_partitions)
             else:
                 part = RoundRobinPartitioning(node.num_partitions)
-            exec_ = ShuffleExchangeExec(part, kids[0], backend=kids[0].backend)
+            # USER-requested repartitioning is exempt from AQE coalescing
+            # (Spark likewise honors explicit repartition under AQE)
+            exec_ = ShuffleExchangeExec(part, kids[0],
+                                        backend=kids[0].backend,
+                                        coalescible=False)
         elif isinstance(node, P.Join):
             from .physical.join import plan_join
             exec_ = plan_join(node, kids[0], kids[1], be, self.conf)

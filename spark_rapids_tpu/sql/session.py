@@ -154,7 +154,17 @@ class DataFrameReader:
         reader = self
 
         class _F:
+            def option(self_inner, key, value):
+                reader._options[key] = value
+                return self_inner
+
             def load(self_inner, *paths):
+                if fmt == "delta":
+                    from ..delta import DeltaTable
+                    version = reader._options.get("versionAsOf")
+                    dt = DeltaTable.forPath(reader._session, paths[0])
+                    return dt.toDF(int(version)
+                                   if version is not None else None)
                 return reader._scan(fmt, list(paths))
         return _F()
 

@@ -1,0 +1,267 @@
+"""Delta-analog ACID table layer (reference delta-lake/ module: txn log,
+snapshot reads, time travel, DELETE/UPDATE/MERGE, OPTIMIZE ZORDER,
+VACUUM, optimistic concurrency)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.delta import DeltaLog, DeltaTable
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def make_table(sess, path, n=100):
+    t = pa.table({"id": pa.array(range(n), type=pa.int64()),
+                  "v": pa.array([float(i) * 1.5 for i in range(n)]),
+                  "s": [f"row{i:03d}" for i in range(n)]})
+    df = sess.create_dataframe(t)
+    return DeltaTable.create(sess, str(path), df), t
+
+
+def test_create_read_roundtrip(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "t1")
+    got = dt.toDF().orderBy("id").collect()
+    assert got.num_rows == 100
+    assert got["id"].to_pylist() == list(range(100))
+    assert DeltaTable.is_delta_table(str(tmp_path / "t1"))
+    assert not DeltaTable.is_delta_table(str(tmp_path))
+
+
+def test_append_and_time_travel(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "t2")
+    more = sess.create_dataframe(pa.table({
+        "id": pa.array(range(100, 150), type=pa.int64()),
+        "v": pa.array([0.0] * 50), "s": ["x"] * 50}))
+    dt.write_df(more, mode="append")
+    assert dt.toDF().count() == 150
+    # version 0 still shows the original 100 rows
+    assert dt.toDF(version=0).count() == 100
+    # reader API: format("delta") + versionAsOf
+    df_v0 = (sess.read.format("delta").option("versionAsOf", 0)
+             .load(str(tmp_path / "t2")))
+    assert df_v0.count() == 100
+    df_now = sess.read.format("delta").load(str(tmp_path / "t2"))
+    assert df_now.count() == 150
+
+
+def test_writer_format_delta(sess, tmp_path):
+    t = pa.table({"a": [1, 2, 3]})
+    df = sess.create_dataframe(t)
+    df.write.format("delta").save(str(tmp_path / "t3"))
+    df.write.format("delta").mode("append").save(str(tmp_path / "t3"))
+    assert DeltaTable.forPath(sess, str(tmp_path / "t3")).toDF().count() == 6
+    df.write.format("delta").mode("overwrite").save(str(tmp_path / "t3"))
+    assert DeltaTable.forPath(sess, str(tmp_path / "t3")).toDF().count() == 3
+
+
+def test_delete(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "t4")
+    n = dt.delete(lambda df: df.id < 30)
+    assert n == 30
+    got = dt.toDF().orderBy("id").collect()
+    assert got.num_rows == 70
+    assert got["id"].to_pylist() == list(range(30, 100))
+    # history records the operations
+    ops = [h["operation"] for h in dt.history()]
+    assert ops[0] == "DELETE"
+
+
+def test_update(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "t5")
+    n = dt.update(lambda df: df.id >= 95, set={"v": lambda df: df.v * 0.0})
+    assert n == 5
+    got = dt.toDF().orderBy("id").collect().to_pandas()
+    assert (got[got.id >= 95]["v"] == 0.0).all()
+    assert (got[got.id < 95]["v"] != 0.0).sum() > 90
+
+
+def test_merge_update_and_insert(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "t6", n=50)
+    src = sess.create_dataframe(pa.table({
+        "id": pa.array([10, 20, 99, 100], type=pa.int64()),
+        "v": pa.array([-1.0, -2.0, -3.0, -4.0]),
+        "s": ["u10", "u20", "n99", "n100"]}))
+    stats = (dt.merge(src, on=["id"])
+             .whenMatchedUpdate(set={"v": F.lit(-7.0)})
+             .whenNotMatchedInsertAll()
+             .execute())
+    assert stats["updated"] == 2 and stats["inserted"] == 2
+    got = dt.toDF().orderBy("id").collect().to_pandas()
+    assert len(got) == 52
+    assert got[got.id == 10]["v"].iloc[0] == -7.0
+    assert got[got.id == 20]["v"].iloc[0] == -7.0
+    assert got[got.id == 99]["v"].iloc[0] == -3.0
+    assert got[got.id == 100]["v"].iloc[0] == -4.0
+
+
+def test_merge_delete(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "t7", n=30)
+    src = sess.create_dataframe(pa.table({
+        "id": pa.array([5, 6, 7], type=pa.int64())}))
+    stats = dt.merge(src, on=["id"]).whenMatchedDelete().execute()
+    assert stats["deleted"] == 3
+    ids = dt.toDF().collect()["id"].to_pylist()
+    assert 5 not in ids and 6 not in ids and 7 not in ids
+    assert len(ids) == 27
+
+
+def test_optimize_zorder_and_vacuum(sess, tmp_path):
+    path = tmp_path / "t8"
+    dt, t = make_table(sess, path, n=200)
+    # append more files so OPTIMIZE has something to compact
+    for k in range(3):
+        dt.write_df(sess.create_dataframe(pa.table({
+            "id": pa.array(range(200 + k * 10, 210 + k * 10),
+                           type=pa.int64()),
+            "v": pa.array([1.0] * 10), "s": ["a"] * 10})))
+    before = len(dt.log.snapshot().file_paths)
+    assert before == 4
+    compacted = dt.optimize_zorder(["id", "v"], target_files=1)
+    assert compacted == 4
+    snap = dt.log.snapshot()
+    assert len(snap.file_paths) == 1
+    # contents unchanged
+    got = dt.toDF().orderBy("id").collect()
+    assert got.num_rows == 230
+    # old files are unreferenced now; vacuum removes them from disk
+    removed = dt.vacuum()
+    assert len(removed) == 4
+    assert dt.toDF().count() == 230
+
+
+def test_zorder_clusters_rows(sess, tmp_path):
+    """Rows close on the z-curve of (x, y) land close in row order."""
+    from spark_rapids_tpu.delta.zorder import zorder_indices
+    rng = np.random.default_rng(0)
+    t = pa.table({"x": rng.integers(0, 100, 1000),
+                  "y": rng.integers(0, 100, 1000)})
+    order = zorder_indices(t, ["x", "y"])
+    clustered = t.take(pa.array(order)).to_pandas()
+    # quadrant purity: the first quarter of rows must be dominated by the
+    # low-x/low-y quadrant (a random order would give ~25%)
+    q = clustered.iloc[:250]
+    frac = ((q.x < 50) & (q.y < 50)).mean()
+    assert frac > 0.8, frac
+
+
+def test_concurrent_append_both_commit(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "t9", n=10)
+    log2 = DeltaLog(str(tmp_path / "t9"))
+    # two writers race an append: both must land (blind appends never
+    # conflict, OptimisticTransaction semantics)
+    a = sess.create_dataframe(pa.table({
+        "id": pa.array([100], type=pa.int64()), "v": [1.0], "s": ["a"]}))
+    b = sess.create_dataframe(pa.table({
+        "id": pa.array([101], type=pa.int64()), "v": [2.0], "s": ["b"]}))
+    dt.write_df(a)
+    DeltaTable(sess, str(tmp_path / "t9")).write_df(b)
+    assert dt.toDF().count() == 12
+    versions = dt.log.versions()
+    assert versions == sorted(set(versions))
+
+
+def test_cache_parquet_serializer(sess):
+    """df.persist() holds compressed parquet bytes, decoded on re-read
+    (ParquetCachedBatchSerializer analog)."""
+    import spark_rapids_tpu.sql.plan as P
+    t = pa.table({"a": list(range(1000)),
+                  "s": [f"value-{i % 13}" for i in range(1000)]})
+    df = sess.create_dataframe(t)
+    cached = df.filter(df.a < 500).cache()
+    assert isinstance(cached._plan, P.CachedRelation)
+    assert len(cached._plan.blob) > 0
+    got = cached.orderBy("a").collect()
+    assert got.num_rows == 500
+    assert got["a"].to_pylist() == list(range(500))
+    # cached frame is re-queryable through the engine
+    assert cached.filter(cached.a >= 250).count() == 250
+
+
+def test_hive_text_roundtrip(sess, tmp_path):
+    """hive-text: ^A-delimited headerless files (GpuHiveTextFileFormat)."""
+    t = pa.table({"a": pa.array([1, 2, 3], type=pa.int64()),
+                  "b": ["x", "y", "z"]})
+    df = sess.create_dataframe(t)
+    out = str(tmp_path / "hive_tbl")
+    df.write.format("hivetext").mode("overwrite").save(out)
+    got = (sess.read.format("hivetext").load(out)
+           .orderBy("_c0").collect())
+    assert got.num_rows == 3
+    assert got["_c0"].to_pylist() == [1, 2, 3]
+    assert got["_c1"].to_pylist() == ["x", "y", "z"]
+    # raw file uses the ^A delimiter
+    import glob
+    files = glob.glob(out + "/*.txt")
+    assert files, "no hive-text data files written"
+    raw = open(files[0], "rb").read()
+    assert b"\x01" in raw
+
+
+def test_delete_preserves_null_condition_rows(sess, tmp_path):
+    """SQL three-valued logic: DELETE WHERE v > 5 must NOT delete rows
+    whose v is NULL (review r2 finding)."""
+    t = pa.table({"id": pa.array([1, 2, 3], type=pa.int64()),
+                  "v": pa.array([10.0, None, 1.0], type=pa.float64())})
+    dt = DeltaTable.create(sess, str(tmp_path / "tn"),
+                           sess.create_dataframe(t))
+    n = dt.delete(lambda df: df.v > 5)
+    assert n == 1
+    got = dt.toDF().orderBy("id").collect().to_pandas()
+    assert got["id"].tolist() == [2, 3]  # the NULL row survives
+
+
+def test_merge_duplicate_source_keys_raises(sess, tmp_path):
+    dt, t = make_table(sess, tmp_path / "td", n=5)
+    src = sess.create_dataframe(pa.table({
+        "id": pa.array([2, 2], type=pa.int64()),
+        "v": [0.0, 1.0], "s": ["a", "b"]}))
+    with pytest.raises(ValueError, match="duplicate"):
+        dt.merge(src, on=["id"]).whenMatchedUpdate(
+            set={"v": F.lit(0.0)}).execute()
+
+
+def test_delta_save_modes(sess, tmp_path):
+    p = str(tmp_path / "tm")
+    df = sess.create_dataframe(pa.table({"a": [1, 2]}))
+    df.write.format("delta").save(p)
+    with pytest.raises(FileExistsError):
+        df.write.format("delta").save(p)  # default errorifexists
+    df.write.format("delta").mode("ignore").save(p)  # no-op
+    assert DeltaTable.forPath(sess, p).toDF().count() == 2
+
+
+def test_delta_partitioned_write(sess, tmp_path):
+    p = str(tmp_path / "tp")
+    t = pa.table({"g": ["x", "y", "x", "y"], "v": [1, 2, 3, 4]})
+    df = sess.create_dataframe(t)
+    df.write.format("delta").partitionBy("g").save(p)
+    snap = DeltaTable.forPath(sess, p).log.snapshot()
+    assert snap.partition_columns == ("g",)
+    assert all("g=" in f for f in snap.file_paths)
+    got = DeltaTable.forPath(sess, p).toDF().orderBy("v").collect()
+    assert got["v"].to_pylist() == [1, 2, 3, 4]
+    with pytest.raises(KeyError):
+        df.write.format("delta").mode("overwrite") \
+            .partitionBy("nope").save(p)
+
+
+def test_explicit_repartition_not_coalesced(sess):
+    """User repartition(n) is exempt from AQE partition coalescing."""
+    t = pa.table({"a": list(range(1000))})
+    df = sess.create_dataframe(t).repartition(4)
+
+    def mapper(it):
+        pdfs = list(it)
+        yield pd.DataFrame({"n": [float(sum(len(p) for p in pdfs))]})
+    counts = df.mapInPandas(mapper, "n double").collect()["n"].to_pylist()
+    assert len(counts) == 4, counts  # one output per partition
+    assert sum(counts) == 1000
