@@ -287,7 +287,6 @@ class MergeBuilder:
                 "row would be updated/deleted ambiguously")
         key_sets = set(key_rows)
 
-        src_pdf = src.collect()
         for rel in snap.file_paths:
             df = t._file_df(rel)
             tkeys = df.select(*keys).collect()

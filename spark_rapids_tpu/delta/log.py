@@ -139,18 +139,22 @@ class DeltaLog:
         payload = "\n".join(json.dumps(a) for a in [info] + actions) + "\n"
         blind_append = all("remove" not in a for a in actions)
         for _ in range(max_retries):
-            v = self.latest_version() + 1
+            latest = self.latest_version()
+            # a non-append commit whose read snapshot is stale must fail
+            # even when it would win a FRESH version number — otherwise a
+            # DELETE racing another DELETE silently resurrects rows
+            if read_version is not None and not blind_append \
+                    and latest > read_version:
+                raise ConcurrentModificationException(
+                    f"table advanced to v{latest} past read version "
+                    f"{read_version} during a non-append commit")
+            v = latest + 1
             try:
                 with open(self._version_file(v), "x") as fh:
                     fh.write(payload)
                 return v
             except FileExistsError:
-                # someone else won this version
-                if read_version is not None and not blind_append:
-                    raise ConcurrentModificationException(
-                        f"table advanced past read version "
-                        f"{read_version} during a non-append commit")
-                continue
+                continue  # someone else won this version; re-validate
         raise ConcurrentModificationException(
             f"could not commit after {max_retries} attempts")
 

@@ -561,6 +561,10 @@ class DataFrameWriter:
         from ..io_.writers import run_write_job
         from .planner import Planner
         sess = self._df._session
+        missing = [c for c in self._partition_by
+                   if c not in self._df.columns]
+        if missing:
+            raise KeyError(f"partitionBy columns not in schema: {missing}")
         if self._format == "delta":
             from ..delta import DeltaTable
             exists = DeltaTable.is_delta_table(path)
@@ -570,11 +574,6 @@ class DataFrameWriter:
                     "(mode=errorifexists)")
             if exists and self._mode == "ignore":
                 return None
-            missing = [c for c in self._partition_by
-                       if c not in self._df.columns]
-            if missing:
-                raise KeyError(
-                    f"partitionBy columns not in schema: {missing}")
             mode = "overwrite" if self._mode == "overwrite" else "append"
             if not exists:
                 import os as _os
@@ -584,10 +583,6 @@ class DataFrameWriter:
                 dt = DeltaTable.forPath(sess, path)
             return dt.write_df(self._df, mode,
                                partition_by=self._partition_by)
-        missing = [c for c in self._partition_by
-                   if c not in self._df.columns]
-        if missing:
-            raise KeyError(f"partitionBy columns not in schema: {missing}")
         child = Planner(sess._conf).plan_for_collect(self._df._plan)
         return run_write_job(child, self._format, path, self._mode,
                              self._partition_by, self._options, sess._conf)
@@ -734,6 +729,11 @@ class GroupedData:
         return DataFrame(P.Aggregate(self._grouping, tuple(outs),
                                      self._df._plan), self._df._session)
 
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Pair two grouped frames for cogrouped applyInPandas
+        (reference GpuFlatMapCoGroupsInPandasExec)."""
+        return CoGroupedData(self, other)
+
     def applyInPandas(self, func, schema) -> DataFrame:
         """``func(pd.DataFrame) -> pd.DataFrame`` per key group
         (reference GpuFlatMapGroupsInPandasExec).  Grouping keys must be
@@ -773,3 +773,27 @@ class GroupedData:
         from .expressions.aggregates import Max
         return self.agg(*[Column(Alias(Max(self._df._col(n).expr),
                                        f"max({n})")) for n in names])
+
+
+class CoGroupedData:
+    """Two grouped frames paired for cogrouped applyInPandas (the
+    pyspark GroupedData.cogroup surface)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self._left = left
+        self._right = right
+
+    def applyInPandas(self, func, schema) -> DataFrame:
+        """``func(left_pdf, right_pdf) -> pd.DataFrame`` per key group;
+        either side may be empty for a key present only on the other."""
+        for grouping in (self._left._grouping, self._right._grouping):
+            for g in grouping:
+                base = g.child if isinstance(g, Alias) else g
+                if not isinstance(base, AttributeReference):
+                    raise ValueError(
+                        "cogroup grouping keys must be plain columns, "
+                        f"got expression {g.sql()!r}")
+        return DataFrame(P.FlatMapCoGroupsInPandas(
+            self._left._grouping, self._right._grouping, func,
+            _to_struct_type(schema), self._left._df._plan,
+            self._right._df._plan), self._left._df._session)

@@ -1,0 +1,109 @@
+"""Dynamic partition pruning — the analog of the reference's
+``GpuSubqueryBroadcastExec`` + DPP integration (SURVEY §2.7 #3, exec rule
+``SubqueryBroadcastExec``): when a hive-partitioned file scan is joined on
+its partition column against a broadcast build side, the build side's
+OBSERVED key values prune whole files before any byte is read.
+
+The broadcast exchange doubles as the subquery broadcast: its materialized
+batch is scanned once for the distinct key values, then each scan
+partition whose ``col=value`` path segment cannot match is skipped."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Set
+
+from .base import TPU, PhysicalPlan, TaskContext
+from .exchange import BroadcastExchangeExec
+
+#: observability for tests/metrics
+STATS = {"files_pruned": 0, "dpp_applied": 0}
+
+
+def _partition_value(path: str, col: str) -> Optional[str]:
+    for seg in path.split(os.sep):
+        if seg.startswith(col + "="):
+            return seg[len(col) + 1:]
+    return None
+
+
+class DppFileScanExec(PhysicalPlan):
+    """Wraps a per-file scan; prunes partitions by the broadcast keys."""
+
+    def __init__(self, scan, part_col: str,
+                 build: BroadcastExchangeExec, build_key: str):
+        super().__init__(scan)
+        self.backend = scan.backend
+        self.part_col = part_col
+        self.build = build
+        self.build_key = build_key
+        self._allowed: Optional[Set[str]] = None
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def num_partitions(self):
+        return self.children[0].num_partitions()
+
+    def _allowed_values(self, tctx: TaskContext) -> Set[str]:
+        if self._allowed is None:
+            from ...columnar.convert import device_to_arrow
+            batch = self.build.broadcast_batch(tctx)
+            table = device_to_arrow(batch)
+            vals = table[self.build_key].to_pylist()
+            self._allowed = {str(v) for v in vals if v is not None}
+        return self._allowed
+
+    def execute(self, pid: int, tctx: TaskContext):
+        scan = self.children[0]
+        files = getattr(scan, "files", None)
+        if files is not None and pid < len(files):
+            value = _partition_value(files[pid], self.part_col)
+            if value is not None and \
+                    value not in self._allowed_values(tctx):
+                STATS["files_pruned"] += 1
+                tctx.inc_metric("dppFilesPruned")
+                return
+        yield from scan.execute(pid, tctx)
+
+    def simple_string(self):
+        return (f"{self.node_name()} [{self.part_col} IN "
+                f"broadcast({self.build_key})]")
+
+
+def _hive_partitioned_on(scan, col: str) -> bool:
+    files = getattr(scan, "files", None)
+    if not files:
+        return False
+    return all(_partition_value(f, col) is not None for f in files)
+
+
+def apply_dpp(plan: PhysicalPlan, left_keys, right_keys,
+              build: BroadcastExchangeExec) -> PhysicalPlan:
+    """Rewrite the probe subtree: a hive-partitioned FileScanExec under
+    row-preserving ops (filter/project) whose partition column is a join
+    key gets wrapped for runtime pruning.  Returns the (possibly) new
+    subtree."""
+    from ...io_.exec import FileScanExec
+    from .basic import FilterExec, ProjectExec
+
+    if len(left_keys) != 1 or len(right_keys) != 1:
+        return plan
+    key = getattr(left_keys[0], "name", None)
+    build_key = getattr(right_keys[0], "name", None)
+    if key is None or build_key is None:
+        return plan
+
+    def rewrite(node: PhysicalPlan) -> PhysicalPlan:
+        if isinstance(node, FileScanExec) and \
+                _hive_partitioned_on(node, key):
+            STATS["dpp_applied"] += 1
+            return DppFileScanExec(node, key, build, build_key)
+        if isinstance(node, (FilterExec, ProjectExec)) and node.children:
+            new_child = rewrite(node.children[0])
+            if new_child is not node.children[0]:
+                node.children = (new_child,) + tuple(node.children[1:])
+        return node
+
+    return rewrite(plan)

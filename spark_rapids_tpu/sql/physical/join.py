@@ -636,6 +636,14 @@ def plan_join(node, left: PhysicalPlan, right: PhysicalPlan, backend,
                      and build_bytes <= threshold)
     if can_broadcast and left.num_partitions() > 1:
         build = BroadcastExchangeExec(right, backend=backend)
+        # dynamic partition pruning: a hive-partitioned probe scan joined
+        # on its partition column skips files the broadcast keys rule out.
+        # ONLY probe-filtering joins qualify — outer/anti/existence joins
+        # must emit probe rows with NO build match, which are exactly the
+        # rows pruning would drop
+        if how in ("inner", "left_semi"):
+            from .dpp import apply_dpp
+            left = apply_dpp(left, node.left_keys, node.right_keys, build)
         return BroadcastHashJoinExec(how, node.left_keys, node.right_keys,
                                      node.condition, left, build,
                                      backend=backend)

@@ -135,3 +135,74 @@ class FlatMapGroupsInPandasExec(PhysicalPlan):
         keys = ", ".join(self.grouping_names)
         return (f"{self.node_name()} [{keys}] "
                 f"{getattr(self.func, '__name__', '<fn>')}")
+
+
+class FlatMapCoGroupsInPandasExec(PhysicalPlan):
+    """cogroup().applyInPandas: per key group, the user fn receives BOTH
+    sides' pandas DataFrames (either may be empty); both children are
+    hash-co-partitioned by the planner so groups are complete."""
+
+    def __init__(self, left_names: List[str], right_names: List[str], func,
+                 out_schema: T.StructType, left: PhysicalPlan,
+                 right: PhysicalPlan, backend=TPU):
+        super().__init__(left, right)
+        self.backend = backend
+        self.left_names = list(left_names)
+        self.right_names = list(right_names)
+        self.grouping_names = self.left_names  # display
+        self.func = func
+        self.out_schema = out_schema
+
+    @property
+    def output(self):
+        from ..expressions.core import AttributeReference
+        return [AttributeReference(f.name, f.data_type, True)
+                for f in self.out_schema.fields]
+
+    def num_partitions(self):
+        return self.children[0].num_partitions()
+
+    def _side_groups(self, child: PhysicalPlan, names: List[str], pid: int,
+                     tctx: TaskContext):
+        """Groups keyed by VALUE tuple (sides may use different key
+        names); an empty side still carries the child's full schema so
+        the user function can touch any column (PySpark contract)."""
+        import pandas as pd
+        batches = list(child.execute(pid, TaskContext(pid, tctx.conf,
+                                                      parent=tctx)))
+        if batches:
+            merged = (ColumnarBatch.concat(batches) if len(batches) > 1
+                      else batches[0])
+            pdf = _to_pandas(merged)
+        else:
+            pdf = pd.DataFrame({a.name: pd.Series(dtype="object")
+                                for a in child.output})
+        groups = {}
+        if len(pdf):
+            for k, g in pdf.groupby(names, sort=False, dropna=False):
+                groups[k if isinstance(k, tuple) else (k,)] = g
+        return pdf.iloc[0:0], groups
+
+    def execute(self, pid: int, tctx: TaskContext):
+        lempty, lgroups = self._side_groups(self.children[0],
+                                            self.left_names, pid, tctx)
+        rempty, rgroups = self._side_groups(self.children[1],
+                                            self.right_names, pid, tctx)
+        if not lgroups and not rgroups:
+            return
+        keys = list(dict.fromkeys(list(lgroups) + list(rgroups)))
+        outs = []
+        with _semaphore_released(self.backend, tctx):
+            for k in keys:
+                lg = lgroups.get(k, lempty)
+                rg = rgroups.get(k, rempty)
+                out = self.func(lg, rg)
+                if out is not None and len(out):
+                    outs.append(out)
+        for out in outs:
+            yield _from_pandas(out, self.out_schema, self.backend)
+
+    def simple_string(self):
+        keys = ", ".join(self.grouping_names)
+        return (f"{self.node_name()} [{keys}] "
+                f"{getattr(self.func, '__name__', '<fn>')}")

@@ -88,6 +88,8 @@ class CachedRelation(LogicalPlan):
             import io as _io
             import pyarrow.parquet as _pq
             self._table = _pq.read_table(_io.BytesIO(self.blob))
+            self._blob_len = len(self.blob)
+            self.blob = b""  # decoded form replaces the bytes — never both
         return self._table
 
     @property
@@ -96,8 +98,9 @@ class CachedRelation(LogicalPlan):
                 for f in self.schema_fields]
 
     def simple_string(self):
+        nbytes = len(self.blob) or getattr(self, "_blob_len", 0)
         return (f"CachedRelation [{', '.join(a.name for a in self.output)}] "
-                f"({len(self.blob)} parquet bytes)")
+                f"({nbytes} parquet bytes)")
 
 
 @dataclass(eq=False)
@@ -400,6 +403,26 @@ class FlatMapGroupsInPandas(LogicalPlan):
 
     def __post_init__(self):
         self.children = (self.child,)
+
+    @property
+    def output(self):
+        return [AttributeReference(f.name, f.data_type, True)
+                for f in self.out_schema.fields]
+
+
+@dataclass(eq=False)
+class FlatMapCoGroupsInPandas(LogicalPlan):
+    """a.groupBy(k).cogroup(b.groupBy(k)).applyInPandas (reference
+    GpuFlatMapCoGroupsInPandasExec)."""
+    left_grouping: Tuple[Expression, ...] = ()
+    right_grouping: Tuple[Expression, ...] = ()
+    func: object = None
+    out_schema: "T.StructType" = None  # type: ignore
+    left: LogicalPlan = None  # type: ignore
+    right: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.left, self.right)
 
     @property
     def output(self):

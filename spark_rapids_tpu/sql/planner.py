@@ -122,6 +122,25 @@ class Planner:
             exec_ = FlatMapGroupsInPandasExec(names, node.func,
                                               node.out_schema, child,
                                               backend=be)
+        elif isinstance(node, P.FlatMapCoGroupsInPandas):
+            from .physical.python_execs import FlatMapCoGroupsInPandasExec
+            lk, rk = kids
+            n = max(lk.num_partitions(), rk.num_partitions())
+            if n > 1:
+                # co-partition BOTH sides identically; never coalesced
+                lk = ShuffleExchangeExec(
+                    HashPartitioning(list(node.left_grouping), n), lk,
+                    backend=lk.backend, coalescible=False)
+                rk = ShuffleExchangeExec(
+                    HashPartitioning(list(node.right_grouping), n), rk,
+                    backend=rk.backend, coalescible=False)
+            lnames = [getattr(g, "name", str(g))
+                      for g in node.left_grouping]
+            rnames = [getattr(g, "name", str(g))
+                      for g in node.right_grouping]
+            exec_ = FlatMapCoGroupsInPandasExec(lnames, rnames, node.func,
+                                                node.out_schema, lk, rk,
+                                                backend=be)
         else:
             raise NotImplementedError(
                 f"no physical plan for {type(node).__name__}")

@@ -265,3 +265,19 @@ def test_explicit_repartition_not_coalesced(sess):
     counts = df.mapInPandas(mapper, "n double").collect()["n"].to_pylist()
     assert len(counts) == 4, counts  # one output per partition
     assert sum(counts) == 1000
+
+
+def test_concurrent_delete_conflict_detected(sess, tmp_path):
+    """Two DELETEs from the same snapshot: the second must raise instead
+    of silently resurrecting the first one's deleted rows."""
+    from spark_rapids_tpu.delta import ConcurrentModificationException
+    from spark_rapids_tpu.delta.log import remove_action
+    dt, t = make_table(sess, tmp_path / "tc", n=20)
+    snap = dt.log.snapshot()
+    # writer B commits a non-append first (from the same snapshot)
+    dt.log.commit([remove_action(snap.file_paths[0])], "DELETE",
+                  read_version=snap.version)
+    # writer A (stale read_version) must now fail its non-append commit
+    with pytest.raises(ConcurrentModificationException):
+        dt.log.commit([remove_action(snap.file_paths[0])], "DELETE",
+                      read_version=snap.version)

@@ -1,0 +1,90 @@
+"""Dynamic partition pruning (GpuSubqueryBroadcastExec/DPP analog): a
+hive-partitioned scan joined on its partition column against a broadcast
+build side must skip files the build keys rule out — and produce exactly
+the same results as without pruning."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.delta import DeltaTable
+from spark_rapids_tpu.sql.physical import dpp as D
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def _partitioned_table(sess, tmp_path, n=2000, cats=10):
+    rng = np.random.default_rng(6)
+    t = pa.table({"cat": pa.array([f"c{i % cats}" for i in range(n)]),
+                  "v": rng.random(n)})
+    df = sess.create_dataframe(t)
+    path = str(tmp_path / "facts")
+    df.write.format("delta").partitionBy("cat").save(path)
+    return path, t
+
+
+def test_dpp_prunes_files_and_matches_oracle(sess, tmp_path):
+    path, t = _partitioned_table(sess, tmp_path)
+    facts = sess.read.format("delta").load(path)
+    dims = sess.create_dataframe(pa.table({
+        "cat": ["c1", "c3"], "w": [10.0, 20.0]}))
+
+    before_applied = D.STATS["dpp_applied"]
+    before_pruned = D.STATS["files_pruned"]
+    got = (facts.join(dims, on="cat", how="inner")
+           .groupBy("cat").agg(F.count("*").alias("n"),
+                               F.sum(facts.v).alias("sv"))
+           .orderBy("cat").collect().to_pandas())
+    assert D.STATS["dpp_applied"] > before_applied, "DPP not planned"
+    # 10 partition files, 2 allowed -> 8 pruned
+    assert D.STATS["files_pruned"] - before_pruned == 8
+
+    pdf = t.to_pandas()
+    exp = (pdf[pdf.cat.isin(["c1", "c3"])]
+           .groupby("cat").agg(n=("v", "size"), sv=("v", "sum"))
+           .reset_index())
+    assert list(got["cat"]) == list(exp["cat"])
+    assert np.array_equal(got["n"], exp["n"])
+    assert np.allclose(got["sv"], exp["sv"])
+
+
+def test_dpp_not_applied_on_non_partition_key(sess, tmp_path):
+    path, t = _partitioned_table(sess, tmp_path)
+    facts = sess.read.format("delta").load(path)
+    dims = sess.create_dataframe(pa.table({
+        "v": [0.5], "w": [1.0]}))
+    before = D.STATS["dpp_applied"]
+    # join on v (not the partition column): no pruning, still correct
+    out = facts.join(dims, on="v", how="left_semi").collect()
+    assert D.STATS["dpp_applied"] == before
+    assert out.num_rows <= 2000
+
+
+def test_dpp_with_filter_above_scan(sess, tmp_path):
+    path, t = _partitioned_table(sess, tmp_path)
+    facts = sess.read.format("delta").load(path)
+    dims = sess.create_dataframe(pa.table({"cat": ["c2"], "w": [1.0]}))
+    before = D.STATS["files_pruned"]
+    got = (facts.filter(facts.v < 0.5).join(dims, on="cat", how="inner")
+           .collect().to_pandas())
+    assert D.STATS["files_pruned"] - before == 9
+    pdf = t.to_pandas()
+    exp = pdf[(pdf.cat == "c2") & (pdf.v < 0.5)]
+    assert len(got) == len(exp)
+
+
+def test_dpp_not_applied_to_outer_or_anti_joins(sess, tmp_path):
+    """Outer/anti joins must emit probe rows WITHOUT build matches —
+    pruning those files would drop them (review r2 finding)."""
+    path, t = _partitioned_table(sess, tmp_path, n=100, cats=5)
+    facts = sess.read.format("delta").load(path)
+    dims = sess.create_dataframe(pa.table({"cat": ["c1"], "w": [1.0]}))
+    anti = facts.join(dims, on="cat", how="left_anti").collect()
+    assert anti.num_rows == 80  # all non-c1 rows survive
+    left = facts.join(dims, on="cat", how="left").collect()
+    assert left.num_rows == 100

@@ -186,3 +186,86 @@ def test_apply_in_pandas_rejects_expression_keys(sess):
     df, t = make_df(sess)
     with pytest.raises(ValueError, match="plain columns"):
         df.groupBy(df.g + 1).applyInPandas(lambda p: p, "g long")
+
+
+def test_cogroup_apply_in_pandas(sess):
+    left = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2, 3], "v": [1.0, 2.0, 3.0, 4.0]}))
+    right = sess.create_dataframe(pa.table({
+        "k": [1, 2, 2, 4], "w": [10.0, 20.0, 30.0, 40.0]}))
+
+    def summarize(l, r):
+        k = l["k"].iloc[0] if len(l) else r["k"].iloc[0]
+        return pd.DataFrame({"k": [k], "lv": [l["v"].sum() if len(l) else 0.0],
+                             "rw": [r["w"].sum() if len(r) else 0.0]})
+    got = (left.groupBy("k").cogroup(right.groupBy("k"))
+           .applyInPandas(summarize, "k long, lv double, rw double")
+           .orderBy("k").collect().to_pylist())
+    assert got == [
+        {"k": 1, "lv": 3.0, "rw": 10.0},
+        {"k": 2, "lv": 3.0, "rw": 50.0},
+        {"k": 3, "lv": 4.0, "rw": 0.0},
+        {"k": 4, "lv": 0.0, "rw": 40.0},
+    ]
+
+
+def test_cogroup_multi_partition(sess):
+    rng = np.random.default_rng(8)
+    n = 2000
+    left = sess.create_dataframe(pa.table({
+        "k": rng.integers(0, 30, n), "v": rng.random(n)}),
+        num_partitions=4)
+    right = sess.create_dataframe(pa.table({
+        "k": rng.integers(0, 30, n), "w": rng.random(n)}),
+        num_partitions=3)
+
+    def stats(l, r):
+        k = l["k"].iloc[0] if len(l) else r["k"].iloc[0]
+        return pd.DataFrame({"k": [k], "c": [float(len(l) + len(r))]})
+    got = (left.groupBy("k").cogroup(right.groupBy("k"))
+           .applyInPandas(stats, "k long, c double")
+           .orderBy("k").collect().to_pandas())
+    import collections
+    cnt = collections.Counter(
+        list(left.collect()["k"].to_pylist())
+        + list(right.collect()["k"].to_pylist()))
+    assert dict(zip(got["k"], got["c"])) == {
+        k: float(v) for k, v in cnt.items()}
+
+
+def test_cogroup_different_key_names(sess):
+    left = sess.create_dataframe(pa.table({
+        "a": [1, 2], "v": [1.0, 2.0]}))
+    right = sess.create_dataframe(pa.table({
+        "b": [2, 3], "w": [20.0, 30.0]}))
+
+    def f(l, r):
+        k = l["a"].iloc[0] if len(l) else r["b"].iloc[0]
+        return pd.DataFrame({"k": [k],
+                             "lv": [l["v"].sum() if len(l) else 0.0],
+                             "rw": [r["w"].sum() if len(r) else 0.0]})
+    got = (left.groupBy("a").cogroup(right.groupBy("b"))
+           .applyInPandas(f, "k long, lv double, rw double")
+           .orderBy("k").collect().to_pylist())
+    assert got == [{"k": 1, "lv": 1.0, "rw": 0.0},
+                   {"k": 2, "lv": 2.0, "rw": 20.0},
+                   {"k": 3, "lv": 0.0, "rw": 30.0}]
+
+
+def test_cogroup_empty_side_has_full_schema(sess):
+    left = sess.create_dataframe(pa.table({
+        "k": [1, 2, 3, 4], "v": [1.0, 2.0, 3.0, 4.0]}),
+        num_partitions=2)
+    right = sess.create_dataframe(pa.table({
+        "k": [1], "w": [10.0]}))
+
+    def f(l, r):
+        # touching the non-key column of a possibly-empty side must work
+        return pd.DataFrame({"k": [l["k"].iloc[0] if len(l)
+                                   else r["k"].iloc[0]],
+                             "rw": [float(r["w"].sum())]})
+    got = (left.groupBy("k").cogroup(right.groupBy("k"))
+           .applyInPandas(f, "k long, rw double")
+           .orderBy("k").collect().to_pylist())
+    assert got == [{"k": 1, "rw": 10.0}, {"k": 2, "rw": 0.0},
+                   {"k": 3, "rw": 0.0}, {"k": 4, "rw": 0.0}]
