@@ -126,6 +126,25 @@ def run_engine(data) -> tuple:
     return min(times), out
 
 
+def _device_responsive(timeout_s: float) -> bool:
+    """Probe the ambient device backend from a daemon thread; a hung TPU
+    tunnel must not take the whole bench (and its JSON line) with it."""
+    ok: list = []
+
+    def probe():
+        try:
+            import jax.numpy as jnp
+            float(jnp.sum(jnp.ones(8)))
+            ok.append(True)
+        except BaseException:
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return bool(ok)
+
+
 def main():
     wd = threading.Timer(BUDGET_S, _watchdog)
     wd.daemon = True
@@ -138,6 +157,23 @@ def main():
     if plat:
         import jax
         jax.config.update("jax_platforms", plat)
+    elif not _device_responsive(60.0):
+        # tunnel hung: re-exec onto the CPU platform so the bench still
+        # produces a real number (noted as the fallback it is)
+        import subprocess
+        env = dict(os.environ)
+        env["BENCH_PLATFORM"] = "cpu"
+        env["BENCH_BUDGET_S"] = str(max(BUDGET_S - 90, 60))
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env, stdout=subprocess.PIPE, timeout=BUDGET_S - 75)
+        line = proc.stdout.decode().strip().splitlines()
+        out = json.loads(line[-1]) if line else {}
+        out["note"] = ("device backend unresponsive; CPU-platform "
+                       "fallback numbers")
+        sys.stdout.write(json.dumps(out) + "\n")
+        sys.stdout.flush()
+        os._exit(0)
 
     try:
         data = make_data(ROWS)
@@ -168,6 +204,19 @@ def main():
     rows_per_sec = ROWS / eng_time
     _result.update(value=round(rows_per_sec),
                    vs_baseline=round(cpu_time / eng_time, 3))
+    # context: each host<->device sync over the axon tunnel costs a full
+    # network round trip; with N sequential pipeline stages the floor is
+    # N*rtt regardless of device speed, so report the measured rtt
+    try:
+        import jax
+        import jax.numpy as jnp
+        x = jnp.ones(8)
+        float(jnp.sum(x) + 1.0)  # warm the EXACT timed expression
+        t0 = time.perf_counter()
+        float(jnp.sum(x) + 1.0)
+        _result["sync_rtt_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    except Exception:
+        pass
     if note:
         _emit(note=note)
     else:

@@ -48,7 +48,7 @@ class FileScanExec(PhysicalPlan):
         def upload(table):
             batch = arrow_to_device(table)
             if self.backend == CPU:
-                batch = jax.tree.map(np.asarray, batch)
+                batch = jax.device_get(batch)
             return batch
 
         if self.reader_type == "COALESCING":

@@ -222,8 +222,10 @@ class BufferCatalog:
 
     # --- tier movement (callers hold the lock) -----------------------------
     def _device_to_host(self, buf: _Buffer):
-        buf.leaves = [np.asarray(l) if hasattr(l, "dtype") else l
-                      for l in buf.leaves]
+        import jax
+        # one concurrent D2H for all leaves (per-array pulls each cost a
+        # full tunnel round trip)
+        buf.leaves = list(jax.device_get(buf.leaves))
         buf.tier = HOST
         self.device_bytes -= buf.size
         self.host_bytes += buf.size

@@ -100,6 +100,9 @@ def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
 
 
 def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
+    import jax
+    # one concurrent D2H for all buffers (see device_to_arrow)
+    batch = jax.device_get(batch)
     n = batch.num_rows_int
     body = io.BytesIO()
     metas = []

@@ -21,8 +21,6 @@ import inspect
 import textwrap
 from typing import Callable, List, Optional, Sequence
 
-import numpy as np
-
 from ... import types as T
 from ...columnar.column import DeviceColumn, bucket_capacity
 from .core import (Expression, Literal, fixed, resolve_expression, valid_and)
@@ -31,7 +29,7 @@ from .core import (Expression, Literal, fixed, resolve_expression, valid_and)
 def _col_to_pylist(ctx, col: DeviceColumn, n: int) -> list:
     from ...columnar.convert import device_column_to_arrow
     import jax
-    host = jax.tree.map(np.asarray, col)
+    host = jax.device_get(col)
     return device_column_to_arrow(host, n).to_pylist()
 
 
@@ -117,7 +115,7 @@ class PandasUDF(Expression):
         from ...columnar.convert import device_column_to_arrow
         import jax
         n = int(ctx.batch.num_rows)
-        series = [device_column_to_arrow(jax.tree.map(np.asarray, c), n)
+        series = [device_column_to_arrow(jax.device_get(c), n)
                   .to_pandas() for c in cols]
         result = self.func(*series)
         vals = list(result)

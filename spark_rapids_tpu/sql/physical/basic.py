@@ -18,11 +18,14 @@ from .base import CPU, TPU, PhysicalPlan, TaskContext
 
 
 def _to_backend_batch(batch: ColumnarBatch, backend: str) -> ColumnarBatch:
-    """Move a batch's arrays to the target backend (device upload / fetch)."""
+    """Move a batch's arrays to the target backend (device upload / fetch).
+    Fetches go through ONE device_get (concurrent copies — per-leaf pulls
+    each cost a full tunnel round trip)."""
     import jax
     import jax.numpy as jnp
-    conv = jnp.asarray if backend == TPU else np.asarray
-    return jax.tree.map(conv, batch)
+    if backend == TPU:
+        return jax.tree.map(jnp.asarray, batch)
+    return jax.device_get(batch)
 
 
 def compact_batch(xp, batch: ColumnarBatch, keep) -> ColumnarBatch:

@@ -16,8 +16,6 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
-
 from ... import types as T
 from ...columnar.batch import ColumnarBatch
 from ...columnar.column import bucket_capacity
@@ -321,7 +319,7 @@ class BaseJoinExec(PhysicalPlan):
         b = ColumnarBatch.empty(schema)
         if self.backend != TPU:
             import jax
-            b = jax.tree.map(np.asarray, b)
+            b = jax.device_get(b)
         return b
 
     def _concat_or_empty(self, batches, attrs) -> ColumnarBatch:

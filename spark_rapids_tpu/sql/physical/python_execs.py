@@ -12,8 +12,6 @@ from __future__ import annotations
 from contextlib import contextmanager
 from typing import Iterator, List
 
-import numpy as np
-
 from ... import types as T
 from ...columnar.batch import ColumnarBatch
 from .base import TPU, PhysicalPlan, TaskContext
@@ -54,7 +52,7 @@ def _from_pandas(pdf, schema: T.StructType, backend: str) -> ColumnarBatch:
     batch = arrow_to_device(table)
     if backend != TPU:
         import jax
-        batch = jax.tree.map(np.asarray, batch)
+        batch = jax.device_get(batch)
     return batch
 
 

@@ -61,7 +61,7 @@ class DeviceToHostExec(PhysicalPlan):
         import jax
         for batch in self.children[0].execute(pid, tctx):
             tctx.inc_metric("d2h_bytes", batch_nbytes(batch))
-            yield jax.tree.map(np.asarray, batch)
+            yield jax.device_get(batch)  # ONE concurrent D2H for all leaves
 
     def node_name(self):
         return "DeviceToHost"

@@ -41,7 +41,11 @@ def data():
 
 @pytest.fixture(scope="module")
 def sess():
-    return srt.session(**OOM_CONF)
+    yield srt.session(**OOM_CONF)
+    # drop the injection-armed session so later modules' srt.session()
+    # doesn't inherit synthetic OOMs (they can land on unsplittable
+    # 1-row batches and fail unrelated tests)
+    srt.session(**{k: 0 for k in OOM_CONF})
 
 
 def _df(sess, data):
