@@ -1,0 +1,174 @@
+"""Scale-test harness — the analog of the reference's
+``integration_tests/.../scaletest/QuerySpecs.scala`` + ``datagen/``
+(SURVEY §4 tier 4): a deterministic query suite over generated join/agg/
+window-shaped data with controllable scale, each query checked against a
+pandas oracle and timed.
+
+Run standalone:  python -m spark_rapids_tpu.testing.scaletest [rows]
+(CI runs it small through tests/test_scale.py; crank ``rows`` for a rig.)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from .datagen import (DoubleGen, IntegerGen, LongGen, StringGen, gen_table)
+
+
+def build_tables(rows: int, seed: int = 17) -> Dict[str, pa.Table]:
+    """fact + two dimensions with skewed keys (the reference's datagen
+    controls cardinality/skew the same way)."""
+    rng = np.random.default_rng(seed)
+    # skew: 20% of fact rows land on 1% of keys
+    n_keys = max(rows // 100, 10)
+    hot = rng.integers(0, max(n_keys // 100, 1), rows // 5)
+    cold = rng.integers(0, n_keys, rows - rows // 5)
+    keys = np.concatenate([hot, cold])
+    rng.shuffle(keys)
+    fact = gen_table({
+        "v": DoubleGen(no_nans=True, no_extremes=True),
+        "q": IntegerGen(0, 100, nullable=False),
+        "s": StringGen(max_len=12),
+    }, rows, seed=seed)
+    fact = fact.append_column("k", pa.array(keys, type=pa.int64()))
+    dim = gen_table({
+        "w": DoubleGen(no_nans=True, no_extremes=True, nullable=False),
+        "cat": IntegerGen(0, 8, nullable=False),
+    }, n_keys, seed=seed + 1)
+    dim = dim.append_column("k", pa.array(np.arange(n_keys),
+                                          type=pa.int64()))
+    return {"fact": fact, "dim": dim}
+
+
+def _q1(sess, t, F):
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    got = (fact.filter(fact.q < 50)
+           .groupBy("q").agg(F.sum(fact.v).alias("sv"),
+                             F.count("*").alias("c"))
+           .orderBy("q").collect().to_pandas())
+    pdf = t["fact"].to_pandas()
+    pdf = pdf[pdf.q < 50]
+    exp = pdf.groupby("q").agg(sv=("v", "sum"), c=("q", "size")).reset_index()
+    assert np.array_equal(got["q"], exp["q"])
+    assert np.allclose(got["sv"].fillna(0), exp["sv"].fillna(0))
+    assert np.array_equal(got["c"], exp["c"])
+
+
+def _q2(sess, t, F):
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    dim = sess.create_dataframe(t["dim"], num_partitions=2)
+    got = (fact.join(dim, on="k", how="inner")
+           .groupBy("cat").agg(F.count("*").alias("n"),
+                               F.sum(fact.v).alias("sv"))
+           .orderBy("cat").collect().to_pandas())
+    exp = (t["fact"].to_pandas().merge(t["dim"].to_pandas(), on="k")
+           .groupby("cat").agg(n=("k", "size"), sv=("v", "sum"))
+           .reset_index())
+    assert np.array_equal(got["cat"], exp["cat"])
+    assert np.array_equal(got["n"], exp["n"])
+    assert np.allclose(got["sv"].fillna(0), exp["sv"].fillna(0))
+
+
+def _q3(sess, t, F):
+    """skewed join: the hot keys stress partition balance."""
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    dim = sess.create_dataframe(t["dim"], num_partitions=2)
+    got = (fact.join(dim, on="k", how="left")
+           .filter(fact.q >= 90).select(fact.k, fact.v, dim.w)
+           .orderBy("k", "v").collect().to_pandas())
+    pdf = t["fact"].to_pandas()
+    exp = (pdf[pdf.q >= 90].merge(t["dim"].to_pandas(), on="k", how="left")
+           .sort_values(["k", "v"]).reset_index(drop=True))
+    assert len(got) == len(exp)
+    assert np.array_equal(got["k"], exp["k"])
+    gw, ew = got["w"].to_numpy(), exp["w"].to_numpy()
+    m = ~np.isnan(ew)
+    assert np.allclose(gw[m], ew[m]) and np.isnan(gw[~m]).all()
+
+
+def _q4(sess, t, F):
+    from ..sql.window_api import Window
+    fact = sess.create_dataframe(t["fact"], num_partitions=2)
+    w = Window.partitionBy("q").orderBy("v")
+    got = (fact.select(fact.q, fact.v,
+                       F.row_number().over(w).alias("rn"))
+           .filter(F.col("rn") <= 3)
+           .collect().to_pandas())
+    pdf = t["fact"].to_pandas().dropna(subset=["v"])
+    exp = (pdf.sort_values(["q", "v"]).groupby("q").head(3))
+    # row_number over possibly-null v: compare counts per q
+    got_counts = got.groupby("q").size()
+    exp_counts = exp.groupby("q").size()
+    assert got_counts.max() <= 3  # the rn<=3 filter actually filtered
+    for q in exp_counts.index:
+        assert got_counts.get(q, 0) >= min(3, exp_counts[q]) - 1
+
+
+def _q5(sess, t, F):
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    got = (fact.orderBy(fact.v.desc_nulls_first(), "k")
+           .select(fact.k, fact.v).collect().to_pandas())
+    assert len(got) == t["fact"].num_rows
+    vals = got["v"].to_numpy()
+    nn = vals[~np.isnan(vals)]
+    assert np.all(np.diff(nn) <= 1e-12)  # descending
+
+
+def _q6(sess, t, F):
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    got = (fact.select(F.upper(fact.s).alias("u"),
+                       F.length(fact.s).alias("ln"))
+           .filter(F.col("ln") > 4).count())
+    pdf = t["fact"].to_pandas()
+    exp = int((pdf.s.str.len() > 4).sum())
+    assert got == exp
+
+
+QUERIES: List[Tuple[str, Callable]] = [
+    ("q1_filter_agg", _q1),
+    ("q2_join_agg", _q2),
+    ("q3_skewed_left_join", _q3),
+    ("q4_window_topn", _q4),
+    ("q5_global_sort", _q5),
+    ("q6_strings", _q6),
+]
+
+
+def run_suite(rows: int = 50_000, queries=None, tables=None,
+              sess=None) -> List[dict]:
+    """Runs the selected queries; pass ``tables``/``sess`` to amortize
+    datagen and session setup across calls.  ``seconds`` includes compile
+    plus the pandas oracle check; ``warm_seconds`` is the second run with
+    compiles amortized — the number to compare across rigs."""
+    import spark_rapids_tpu as srt
+    from ..sql import functions as F
+    t = tables if tables is not None else build_tables(rows)
+    sess = sess or srt.session()
+    report = []
+    for name, fn in QUERIES:
+        if queries and name not in queries:
+            continue
+        t0 = time.perf_counter()
+        fn(sess, t, F)
+        total = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fn(sess, t, F)  # warm engine + oracle again; compile amortized
+        warm = time.perf_counter() - t0
+        report.append({"query": name,
+                       "seconds": round(total, 3),
+                       "warm_seconds": round(warm, 3),
+                       "rows": rows})
+    return report
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    for entry in run_suite(rows):
+        print(json.dumps(entry))

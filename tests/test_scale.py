@@ -1,0 +1,27 @@
+"""Scale-test suite (reference QuerySpecs q1-q38 model + datagen rig,
+SURVEY §4 tier 4) at CI size; crank SRT_SCALE_ROWS for a perf rig."""
+
+import os
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.testing.scaletest import QUERIES, build_tables, run_suite
+
+ROWS = int(os.environ.get("SRT_SCALE_ROWS", "30000"))
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return build_tables(ROWS)
+
+
+@pytest.fixture(scope="module")
+def sess():
+    return srt.session()
+
+
+@pytest.mark.parametrize("name", [n for n, _ in QUERIES])
+def test_scale_query(name, tables, sess):
+    report = run_suite(ROWS, queries={name}, tables=tables, sess=sess)
+    assert report and report[0]["query"] == name
