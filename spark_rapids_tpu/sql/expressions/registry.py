@@ -79,3 +79,16 @@ _reg(Str.Length, Str.OctetLength, Str.BitLength, Str.Upper, Str.Lower,
 from . import udf as U  # noqa: E402
 
 _reg(U.PythonUDF, U.PandasUDF, U.DeviceUDF)
+
+# aggregate + window classes run through dedicated exec kernels rather
+# than Expression.kernel, but they ARE device-supported — register them so
+# the supported-ops docgen/CSVs reflect real coverage
+from . import aggregates as Agg  # noqa: E402
+from . import windows as W  # noqa: E402
+
+_reg(Agg.AggregateExpression, Agg.Sum, Agg.Count, Agg.Min, Agg.Max,
+     Agg.Average, Agg.First, Agg.Last, Agg.VarianceSamp, Agg.VariancePop,
+     Agg.StddevSamp, Agg.StddevPop)
+_reg(W.WindowExpression, W.WindowSpecDefinition, W.RowNumber, W.Rank,
+     W.DenseRank, W.PercentRank, W.CumeDist, W.NTile, W.Lead, W.Lag,
+     W.NthValue)
