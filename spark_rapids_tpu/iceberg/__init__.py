@@ -1,0 +1,19 @@
+"""Iceberg-analog table format (reference: the GPU Iceberg read path under
+``sql-plugin/src/main/java/com/nvidia/spark/rapids/iceberg/``, ~6k LoC).
+
+Public surface:
+
+* :class:`IcebergTable` — create / append / scan / schema evolution /
+  position deletes / time travel / expire_snapshots
+* ``session.read.format("iceberg").load(path)`` integration (session.py)
+* transforms (identity, bucket, truncate, year/month/day/hour, void) with
+  pruning predicates
+"""
+
+from .metadata import (ConcurrentCommitException, IceSchema, IceSnapshot,
+                       PartitionSpec, TableMetadata)
+from .table import IcebergTable
+from .transforms import parse_transform
+
+__all__ = ["IcebergTable", "IceSchema", "IceSnapshot", "PartitionSpec",
+           "TableMetadata", "ConcurrentCommitException", "parse_transform"]

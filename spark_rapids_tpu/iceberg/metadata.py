@@ -1,0 +1,443 @@
+"""Iceberg-analog table metadata model.
+
+The reference ships a GPU Iceberg *read* path as a Java port of Iceberg's
+reader internals (``sql-plugin/src/main/java/com/nvidia/spark/rapids/iceberg/``,
+~6k LoC: Spark scan glue, schema/field-id pruning, partition-spec handling,
+metrics).  This module is the TPU build's equivalent metadata layer, written
+to the Iceberg v2 spec *shape* — JSON table metadata with schema-ids and
+field-ids, avro manifest lists and manifests (via the repo's own avro
+container codec, ``io_/avro_reader.py``), snapshot log with time travel —
+so the scan layer (``table.py``) can do the same planning work the
+reference's ``GpuSparkBatchQueryScan`` does: snapshot selection, partition
+pruning through transforms, column-bound file skipping, field-id column
+projection, and position-delete application.
+
+Layout on disk (per Iceberg conventions):
+
+    <table>/metadata/v<N>.metadata.json     table metadata, versioned
+    <table>/metadata/snap-<id>.avro         manifest list, one per snapshot
+    <table>/metadata/manifest-<uuid>.avro   manifest: data/delete file entries
+    <table>/data/**.parquet                 data + position-delete files
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import types as T
+
+FORMAT_VERSION = 2
+
+#: manifest entry / data file content codes (Iceberg spec)
+DATA = 0
+POSITION_DELETES = 1
+
+STATUS_EXISTING = 0
+STATUS_ADDED = 1
+STATUS_DELETED = 2
+
+
+# ---------------------------------------------------------------------------
+# schema with field ids
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NestedField:
+    field_id: int
+    name: str
+    type_str: str          # primitive type name, e.g. "long", "string"
+    required: bool = False
+
+    def to_json(self) -> dict:
+        return {"id": self.field_id, "name": self.name,
+                "required": self.required, "type": self.type_str}
+
+    @staticmethod
+    def from_json(d: dict) -> "NestedField":
+        return NestedField(d["id"], d["name"], d["type"],
+                           d.get("required", False))
+
+
+_TYPE_TO_ICE = {
+    T.BOOLEAN: "boolean", T.INT: "int", T.LONG: "long", T.FLOAT: "float",
+    T.DOUBLE: "double", T.STRING: "string", T.DATE: "date",
+    T.TIMESTAMP: "timestamptz", T.BINARY: "binary",
+}
+_ICE_TO_TYPE = {v: k for k, v in _TYPE_TO_ICE.items()}
+_ICE_TO_TYPE["timestamp"] = T.TIMESTAMP
+
+
+def type_to_ice(dt) -> str:
+    if dt in _TYPE_TO_ICE:
+        return _TYPE_TO_ICE[dt]
+    s = str(dt).lower()
+    if s.startswith("decimal"):
+        return s
+    raise ValueError(f"unsupported iceberg type: {dt}")
+
+
+def ice_to_type(s: str):
+    if s in _ICE_TO_TYPE:
+        return _ICE_TO_TYPE[s]
+    if s.startswith("decimal"):
+        import re
+        m = re.match(r"decimal\((\d+),\s*(\d+)\)", s)
+        if m:
+            return T.DecimalType(int(m.group(1)), int(m.group(2)))
+    raise ValueError(f"unsupported iceberg type string: {s}")
+
+
+@dataclass
+class IceSchema:
+    schema_id: int
+    fields: List[NestedField] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"type": "struct", "schema-id": self.schema_id,
+                "fields": [f.to_json() for f in self.fields]}
+
+    @staticmethod
+    def from_json(d: dict) -> "IceSchema":
+        return IceSchema(d.get("schema-id", 0),
+                         [NestedField.from_json(f) for f in d["fields"]])
+
+    def field_by_name(self, name: str) -> Optional[NestedField]:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        return None
+
+    def field_by_id(self, fid: int) -> Optional[NestedField]:
+        for f in self.fields:
+            if f.field_id == fid:
+                return f
+        return None
+
+    def to_struct_type(self) -> T.StructType:
+        return T.StructType([
+            T.StructField(f.name, ice_to_type(f.type_str), not f.required)
+            for f in self.fields])
+
+    def max_field_id(self) -> int:
+        return max((f.field_id for f in self.fields), default=0)
+
+
+# ---------------------------------------------------------------------------
+# partition spec
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PartitionField:
+    source_id: int        # field id in the table schema
+    field_id: int         # partition field id (>= 1000)
+    transform: str        # identity | bucket[N] | truncate[W] | year | ...
+    name: str
+
+    def to_json(self) -> dict:
+        return {"source-id": self.source_id, "field-id": self.field_id,
+                "transform": self.transform, "name": self.name}
+
+    @staticmethod
+    def from_json(d: dict) -> "PartitionField":
+        return PartitionField(d["source-id"], d["field-id"], d["transform"],
+                              d["name"])
+
+
+@dataclass
+class PartitionSpec:
+    spec_id: int
+    fields: List[PartitionField] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"spec-id": self.spec_id,
+                "fields": [f.to_json() for f in self.fields]}
+
+    @staticmethod
+    def from_json(d: dict) -> "PartitionSpec":
+        return PartitionSpec(d.get("spec-id", 0),
+                             [PartitionField.from_json(f)
+                              for f in d["fields"]])
+
+    @property
+    def is_unpartitioned(self) -> bool:
+        return not self.fields
+
+
+# ---------------------------------------------------------------------------
+# snapshots
+# ---------------------------------------------------------------------------
+
+@dataclass
+class IceSnapshot:
+    snapshot_id: int
+    timestamp_ms: int
+    manifest_list: str             # path relative to table root
+    parent_id: Optional[int] = None
+    schema_id: int = 0
+    summary: Dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        d = {"snapshot-id": self.snapshot_id,
+             "timestamp-ms": self.timestamp_ms,
+             "manifest-list": self.manifest_list,
+             "schema-id": self.schema_id,
+             "summary": self.summary}
+        if self.parent_id is not None:
+            d["parent-snapshot-id"] = self.parent_id
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "IceSnapshot":
+        return IceSnapshot(d["snapshot-id"], d["timestamp-ms"],
+                           d["manifest-list"],
+                           d.get("parent-snapshot-id"),
+                           d.get("schema-id", 0), d.get("summary", {}))
+
+
+# ---------------------------------------------------------------------------
+# manifests (avro)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DataFile:
+    """One data (or position-delete) file tracked by a manifest."""
+    file_path: str                           # relative to table root
+    content: int = DATA
+    record_count: int = 0
+    file_size: int = 0
+    spec_id: int = 0
+    # partition tuple: transform-result value per spec field (JSON-encoded
+    # in the avro row; None for unpartitioned)
+    partition: Tuple = ()
+    # per-field-id min/max for file skipping (numeric/str only)
+    lower_bounds: Dict[int, Any] = field(default_factory=dict)
+    upper_bounds: Dict[int, Any] = field(default_factory=dict)
+    null_counts: Dict[int, int] = field(default_factory=dict)
+
+
+@dataclass
+class ManifestEntry:
+    status: int
+    snapshot_id: int
+    data_file: DataFile
+
+
+def _bounds_json(b: Dict[int, Any]) -> str:
+    return json.dumps({str(k): v for k, v in b.items()})
+
+
+def _bounds_unjson(s: str) -> Dict[int, Any]:
+    return {int(k): v for k, v in json.loads(s or "{}").items()}
+
+
+_MANIFEST_COLS = ["status", "snapshot_id", "content", "file_path",
+                  "record_count", "file_size", "spec_id", "partition",
+                  "lower_bounds", "upper_bounds", "null_counts"]
+
+
+def write_manifest(table_root: str, entries: Sequence[ManifestEntry]) -> str:
+    """Write entries as one avro manifest; returns path relative to root."""
+    from ..io_.avro_reader import write_avro
+    rel = f"metadata/manifest-{uuid.uuid4().hex}.avro"
+    rows = {
+        "status": [e.status for e in entries],
+        "snapshot_id": [e.snapshot_id for e in entries],
+        "content": [e.data_file.content for e in entries],
+        "file_path": [e.data_file.file_path for e in entries],
+        "record_count": [e.data_file.record_count for e in entries],
+        "file_size": [e.data_file.file_size for e in entries],
+        "spec_id": [e.data_file.spec_id for e in entries],
+        "partition": [json.dumps(list(e.data_file.partition))
+                      for e in entries],
+        "lower_bounds": [_bounds_json(e.data_file.lower_bounds)
+                         for e in entries],
+        "upper_bounds": [_bounds_json(e.data_file.upper_bounds)
+                         for e in entries],
+        "null_counts": [_bounds_json(e.data_file.null_counts)
+                        for e in entries],
+    }
+    tab = pa.table({c: rows[c] for c in _MANIFEST_COLS})
+    write_avro(tab, os.path.join(table_root, rel))
+    return rel
+
+
+def read_manifest(table_root: str, rel_path: str) -> List[ManifestEntry]:
+    from ..io_.avro_reader import read_avro
+    tab = read_avro(os.path.join(table_root, rel_path))
+    out = []
+    for i in range(tab.num_rows):
+        row = {c: tab[c][i].as_py() for c in _MANIFEST_COLS}
+        df = DataFile(
+            file_path=row["file_path"], content=int(row["content"]),
+            record_count=int(row["record_count"]),
+            file_size=int(row["file_size"]), spec_id=int(row["spec_id"]),
+            partition=tuple(json.loads(row["partition"] or "[]")),
+            lower_bounds=_bounds_unjson(row["lower_bounds"]),
+            upper_bounds=_bounds_unjson(row["upper_bounds"]),
+            null_counts={k: int(v) for k, v in
+                         _bounds_unjson(row["null_counts"]).items()})
+        out.append(ManifestEntry(int(row["status"]),
+                                 int(row["snapshot_id"]), df))
+    return out
+
+
+def write_manifest_list(table_root: str, snapshot_id: int,
+                        manifest_rels: Sequence[str]) -> str:
+    from ..io_.avro_reader import write_avro
+    rel = f"metadata/snap-{snapshot_id}.avro"
+    tab = pa.table({"manifest_path": list(manifest_rels)})
+    write_avro(tab, os.path.join(table_root, rel))
+    return rel
+
+
+def read_manifest_list(table_root: str, rel_path: str) -> List[str]:
+    from ..io_.avro_reader import read_avro
+    tab = read_avro(os.path.join(table_root, rel_path))
+    return [v.as_py() for v in tab["manifest_path"]]
+
+
+# ---------------------------------------------------------------------------
+# table metadata
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TableMetadata:
+    location: str
+    table_uuid: str
+    last_updated_ms: int = 0
+    last_column_id: int = 0
+    current_schema_id: int = 0
+    schemas: List[IceSchema] = field(default_factory=list)
+    default_spec_id: int = 0
+    partition_specs: List[PartitionSpec] = field(default_factory=list)
+    current_snapshot_id: Optional[int] = None
+    snapshots: List[IceSnapshot] = field(default_factory=list)
+    snapshot_log: List[dict] = field(default_factory=list)
+    properties: Dict[str, str] = field(default_factory=dict)
+
+    # --- accessors --------------------------------------------------------
+    def schema(self, schema_id: Optional[int] = None) -> IceSchema:
+        sid = self.current_schema_id if schema_id is None else schema_id
+        for s in self.schemas:
+            if s.schema_id == sid:
+                return s
+        raise KeyError(f"schema-id {sid} not found")
+
+    def spec(self, spec_id: Optional[int] = None) -> PartitionSpec:
+        sid = self.default_spec_id if spec_id is None else spec_id
+        for s in self.partition_specs:
+            if s.spec_id == sid:
+                return s
+        raise KeyError(f"spec-id {sid} not found")
+
+    def snapshot(self, snapshot_id: Optional[int] = None
+                 ) -> Optional[IceSnapshot]:
+        sid = self.current_snapshot_id if snapshot_id is None else snapshot_id
+        if sid is None:
+            return None
+        for s in self.snapshots:
+            if s.snapshot_id == sid:
+                return s
+        raise KeyError(f"snapshot-id {sid} not found")
+
+    def snapshot_as_of(self, ts_ms: int) -> Optional[IceSnapshot]:
+        best = None
+        for entry in self.snapshot_log:
+            if entry["timestamp-ms"] <= ts_ms:
+                best = entry["snapshot-id"]
+        return self.snapshot(best) if best is not None else None
+
+    # --- serialization ----------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "format-version": FORMAT_VERSION,
+            "table-uuid": self.table_uuid,
+            "location": self.location,
+            "last-updated-ms": self.last_updated_ms,
+            "last-column-id": self.last_column_id,
+            "current-schema-id": self.current_schema_id,
+            "schemas": [s.to_json() for s in self.schemas],
+            "default-spec-id": self.default_spec_id,
+            "partition-specs": [s.to_json() for s in self.partition_specs],
+            "current-snapshot-id": self.current_snapshot_id,
+            "snapshots": [s.to_json() for s in self.snapshots],
+            "snapshot-log": self.snapshot_log,
+            "properties": self.properties,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "TableMetadata":
+        return TableMetadata(
+            location=d["location"], table_uuid=d["table-uuid"],
+            last_updated_ms=d.get("last-updated-ms", 0),
+            last_column_id=d.get("last-column-id", 0),
+            current_schema_id=d.get("current-schema-id", 0),
+            schemas=[IceSchema.from_json(s) for s in d.get("schemas", [])],
+            default_spec_id=d.get("default-spec-id", 0),
+            partition_specs=[PartitionSpec.from_json(s)
+                             for s in d.get("partition-specs", [])],
+            current_snapshot_id=d.get("current-snapshot-id"),
+            snapshots=[IceSnapshot.from_json(s)
+                       for s in d.get("snapshots", [])],
+            snapshot_log=d.get("snapshot-log", []),
+            properties=d.get("properties", {}))
+
+
+def metadata_dir(table_path: str) -> str:
+    return os.path.join(table_path, "metadata")
+
+
+def _version_of(fname: str) -> int:
+    # v<N>.metadata.json
+    return int(fname[1:].split(".", 1)[0])
+
+
+def latest_metadata_version(table_path: str) -> Optional[int]:
+    d = metadata_dir(table_path)
+    if not os.path.isdir(d):
+        return None
+    versions = [_version_of(f) for f in os.listdir(d)
+                if f.startswith("v") and f.endswith(".metadata.json")]
+    return max(versions) if versions else None
+
+
+def read_table_metadata(table_path: str,
+                        version: Optional[int] = None) -> TableMetadata:
+    v = latest_metadata_version(table_path) if version is None else version
+    if v is None:
+        raise FileNotFoundError(f"not an iceberg table: {table_path}")
+    with open(os.path.join(metadata_dir(table_path),
+                           f"v{v}.metadata.json")) as fh:
+        return TableMetadata.from_json(json.load(fh))
+
+
+def write_table_metadata(table_path: str, meta: TableMetadata) -> int:
+    """Atomic-rename commit of the next metadata version (the Iceberg
+    optimistic-concurrency primitive; a concurrent writer of the same
+    version loses the rename race and must retry)."""
+    prev = latest_metadata_version(table_path)
+    v = 0 if prev is None else prev + 1
+    meta.last_updated_ms = int(time.time() * 1000)
+    d = metadata_dir(table_path)
+    os.makedirs(d, exist_ok=True)
+    target = os.path.join(d, f"v{v}.metadata.json")
+    try:
+        # exclusive create IS the commit: the losing concurrent writer of
+        # the same version gets FileExistsError, never a silent overwrite
+        with open(target, "x") as fh:
+            json.dump(meta.to_json(), fh, indent=1)
+    except FileExistsError:
+        raise ConcurrentCommitException(
+            f"version {v} already committed") from None
+    return v
+
+
+class ConcurrentCommitException(Exception):
+    pass

@@ -1,0 +1,285 @@
+"""Iceberg partition transforms (spec: identity, bucket[N], truncate[W],
+year, month, day, hour, void) with the pruning contract the reference's
+scan layer relies on: for each transform, given a column-level predicate we
+can decide whether a partition value can possibly contain matching rows.
+
+Bucket hashing follows the Iceberg single-value hash spec shape
+(murmur3_x86_32 over the value's canonical byte encoding: 8-byte
+little-endian for int/long/date/timestamp, UTF-8 for strings), implemented
+here on the host since transforms run at planning time, not on the device
+(reference: iceberg PartitionSpec/Transforms, consumed by
+``GpuSparkBatchQueryScan``'s file filtering).
+"""
+
+from __future__ import annotations
+
+import struct
+from datetime import date, datetime, timezone
+from typing import Any, Optional
+
+_EPOCH = date(1970, 1, 1)
+
+
+def _murmur3_32(data: bytes, seed: int = 0) -> int:
+    c1, c2 = 0xcc9e2d51, 0x1b873593
+    h = seed & 0xffffffff
+    n = len(data)
+    for i in range(0, n - n % 4, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xffffffff
+        k = ((k << 15) | (k >> 17)) & 0xffffffff
+        k = (k * c2) & 0xffffffff
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xffffffff
+        h = (h * 5 + 0xe6546b64) & 0xffffffff
+    tail = data[n - n % 4:]
+    if tail:
+        k = int.from_bytes(tail, "little")
+        k = (k * c1) & 0xffffffff
+        k = ((k << 15) | (k >> 17)) & 0xffffffff
+        k = (k * c2) & 0xffffffff
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85ebca6b) & 0xffffffff
+    h ^= h >> 13
+    h = (h * 0xc2b2ae35) & 0xffffffff
+    h ^= h >> 16
+    return h
+
+
+def _hash_value(v: Any) -> int:
+    if isinstance(v, bool):
+        raise ValueError("bucket over boolean is not supported")
+    if isinstance(v, int):
+        return _murmur3_32(struct.pack("<q", v))
+    if isinstance(v, str):
+        return _murmur3_32(v.encode("utf-8"))
+    if isinstance(v, bytes):
+        return _murmur3_32(v)
+    if isinstance(v, datetime):
+        micros = int(v.replace(tzinfo=v.tzinfo or timezone.utc)
+                     .timestamp() * 1_000_000)
+        return _murmur3_32(struct.pack("<q", micros))
+    if isinstance(v, date):
+        return _murmur3_32(struct.pack("<q", (v - _EPOCH).days))
+    raise ValueError(f"unsupported bucket value type: {type(v)}")
+
+
+def _to_days(v) -> int:
+    if isinstance(v, datetime):
+        v = v.date()
+    if isinstance(v, date):
+        return (v - _EPOCH).days
+    return int(v)
+
+
+def _to_datetime(v) -> datetime:
+    if isinstance(v, datetime):
+        return v
+    if isinstance(v, date):
+        return datetime(v.year, v.month, v.day)
+    if isinstance(v, (int, float)):  # micros since epoch
+        return datetime.fromtimestamp(v / 1e6, tz=timezone.utc)
+    raise ValueError(f"cannot interpret {v!r} as a timestamp")
+
+
+class Transform:
+    """Apply + prune interface.  ``apply`` maps a source value to the
+    partition value; ``possible`` answers "could a row with source value
+    satisfying (op, literal) live in a partition with this value?" —
+    conservative True when unknown."""
+
+    name = "identity"
+
+    def apply(self, v: Any) -> Any:
+        raise NotImplementedError
+
+    def possible(self, part_value: Any, op: str, literal: Any) -> bool:
+        return True  # conservative default: cannot prune
+
+
+class IdentityTransform(Transform):
+    name = "identity"
+
+    def apply(self, v):
+        return v
+
+    def possible(self, part_value, op, literal):
+        if part_value is None:
+            return op in ("isnull",)
+        if op == "=":
+            return part_value == literal
+        if op == "!=":
+            # identity partitioning: every row in the file shares the value
+            return part_value != literal
+        if op == "<":
+            return part_value < literal
+        if op == "<=":
+            return part_value <= literal
+        if op == ">":
+            return part_value > literal
+        if op == ">=":
+            return part_value >= literal
+        if op == "in":
+            return part_value in literal
+        if op == "isnull":
+            return part_value is None
+        if op == "isnotnull":
+            return part_value is not None
+        return True
+
+
+class BucketTransform(Transform):
+    def __init__(self, n: int):
+        self.n = n
+        self.name = f"bucket[{n}]"
+
+    def apply(self, v):
+        if v is None:
+            return None
+        return (_hash_value(v) & 0x7fffffff) % self.n
+
+    def possible(self, part_value, op, literal):
+        if op == "=":
+            return part_value == self.apply(literal)
+        if op == "in":
+            return part_value in {self.apply(x) for x in literal}
+        if op == "isnull":
+            return part_value is None
+        return True
+
+
+class TruncateTransform(Transform):
+    def __init__(self, w: int):
+        self.w = w
+        self.name = f"truncate[{w}]"
+
+    def apply(self, v):
+        if v is None:
+            return None
+        if isinstance(v, int):
+            return v - (v % self.w)
+        if isinstance(v, str):
+            return v[:self.w]
+        if isinstance(v, bytes):
+            return v[:self.w]
+        raise ValueError(f"truncate of {type(v)} unsupported")
+
+    def possible(self, part_value, op, literal):
+        if part_value is None:
+            return op == "isnull"
+        t = self.apply(literal)
+        if op == "=":
+            return part_value == t
+        if op == "in":
+            return part_value in {self.apply(x) for x in literal}
+        if isinstance(literal, int):
+            if op in ("<", "<="):
+                return part_value <= t
+            if op in (">", ">="):
+                return part_value + self.w > t
+        if isinstance(literal, str):
+            if op in ("<", "<="):
+                return part_value <= t
+            if op in (">", ">="):
+                return part_value >= t[:self.w] if t else True
+        return True
+
+
+class _TimeTransform(Transform):
+    """year/month/day/hour — ordered integral partition values, so range
+    predicates prune directly on the transformed literal."""
+
+    def _ord(self, v) -> int:
+        raise NotImplementedError
+
+    def apply(self, v):
+        return None if v is None else self._ord(v)
+
+    def possible(self, part_value, op, literal):
+        if part_value is None:
+            return op == "isnull"
+        try:
+            t = self._ord(literal)
+        except Exception:
+            return True
+        if op == "=":
+            return part_value == t
+        if op == "<":
+            return part_value <= t
+        if op == "<=":
+            return part_value <= t
+        if op == ">":
+            return part_value >= t
+        if op == ">=":
+            return part_value >= t
+        if op == "in":
+            return part_value in {self._ord(x) for x in literal}
+        return True
+
+
+class YearTransform(_TimeTransform):
+    name = "year"
+
+    def _ord(self, v):
+        if isinstance(v, (date, datetime)):
+            return v.year - 1970
+        return _to_datetime(v).year - 1970
+
+
+class MonthTransform(_TimeTransform):
+    name = "month"
+
+    def _ord(self, v):
+        if isinstance(v, (date, datetime)):
+            return (v.year - 1970) * 12 + (v.month - 1)
+        d = _to_datetime(v)
+        return (d.year - 1970) * 12 + (d.month - 1)
+
+
+class DayTransform(_TimeTransform):
+    name = "day"
+
+    def _ord(self, v):
+        return _to_days(v)
+
+
+class HourTransform(_TimeTransform):
+    name = "hour"
+
+    def _ord(self, v):
+        d = _to_datetime(v)
+        if d.tzinfo is None:
+            # naive values are UTC everywhere in this module (_hash_value
+            # does the same); never let the process TZ leak into partition
+            # ordinals
+            d = d.replace(tzinfo=timezone.utc)
+        return int(d.timestamp() // 3600)
+
+
+class VoidTransform(Transform):
+    name = "void"
+
+    def apply(self, v):
+        return None
+
+
+def parse_transform(name: str) -> Transform:
+    if name == "identity":
+        return IdentityTransform()
+    if name == "void":
+        return VoidTransform()
+    if name == "year":
+        return YearTransform()
+    if name == "month":
+        return MonthTransform()
+    if name == "day":
+        return DayTransform()
+    if name == "hour":
+        return HourTransform()
+    if name.startswith("bucket[") and name.endswith("]"):
+        return BucketTransform(int(name[7:-1]))
+    if name.startswith("truncate[") and name.endswith("]"):
+        return TruncateTransform(int(name[9:-1]))
+    raise ValueError(f"unknown transform: {name}")

@@ -49,11 +49,15 @@ class TpuSession:
     # ------------------------------------------------------------------
     # data sources
     # ------------------------------------------------------------------
-    def create_dataframe(self, data, schema=None, num_partitions: int = 1
-                         ) -> DataFrame:
+    def create_dataframe(self, data, schema=None, num_partitions: int = 1,
+                         partitions=None) -> DataFrame:
         table = _to_arrow_table(data, schema)
-        parts = _split_table(table, num_partitions)
-        rel = P.Relation(table, parts if num_partitions > 1 else None)
+        if partitions is not None:
+            parts = list(partitions)
+        else:
+            parts = _split_table(table, num_partitions) \
+                if num_partitions > 1 else None
+        rel = P.Relation(table, parts)
         return DataFrame(rel, self)
 
     createDataFrame = create_dataframe
@@ -165,6 +169,15 @@ class DataFrameReader:
                     dt = DeltaTable.forPath(reader._session, paths[0])
                     return dt.toDF(int(version)
                                    if version is not None else None)
+                if fmt == "iceberg":
+                    from ..iceberg import IcebergTable
+                    it = IcebergTable.for_path(reader._session, paths[0])
+                    snap = reader._options.get("snapshot-id")
+                    ts = reader._options.get("as-of-timestamp")
+                    return it.to_df(
+                        snapshot_id=int(snap) if snap is not None else None,
+                        as_of_timestamp_ms=int(ts) if ts is not None
+                        else None)
                 return reader._scan(fmt, list(paths))
         return _F()
 
