@@ -36,9 +36,16 @@ class ColumnarBatch:
     @staticmethod
     def make(names: Sequence[str], columns: Sequence[DeviceColumn],
              num_rows) -> "ColumnarBatch":
+        known = None
         if not isinstance(num_rows, jnp.ndarray):
+            known = int(num_rows)
             num_rows = jnp.asarray(num_rows, dtype=jnp.int32)
-        return ColumnarBatch(tuple(names), tuple(columns), num_rows)
+        b = ColumnarBatch(tuple(names), tuple(columns), num_rows)
+        if known is not None:
+            # host-constructed count: num_rows_int must not pay a device
+            # round trip to read back what the host just wrote
+            b._nrows_host = known
+        return b
 
     @staticmethod
     def empty(schema: StructType) -> "ColumnarBatch":
@@ -60,8 +67,22 @@ class ColumnarBatch:
 
     @property
     def num_rows_int(self) -> int:
-        """Host-side row count (forces a device sync if traced output)."""
-        return int(self.num_rows)
+        """Host-side row count.  Forces ONE device sync per batch, then
+        memoizes — on the TPU tunnel every sync is a full network round
+        trip (~65ms), so producers that already know the count on the host
+        (two-phase aggregate, slicing) pre-seed it via
+        :meth:`with_known_rows`."""
+        cached = getattr(self, "_nrows_host", None)
+        if cached is None:
+            cached = int(self.num_rows)
+            self._nrows_host = cached
+        return cached
+
+    def with_known_rows(self, n: int) -> "ColumnarBatch":
+        """Record the host-known row count (skips the sync in
+        ``num_rows_int``).  Caller contract: ``n == int(self.num_rows)``."""
+        self._nrows_host = int(n)
+        return self
 
     def row_mask(self) -> jnp.ndarray:
         """bool[capacity]: True for live rows."""
@@ -91,7 +112,11 @@ class ColumnarBatch:
     # --- reshaping (host-orchestrated, device-executed) -------------------
     def repadded(self, new_capacity: int) -> "ColumnarBatch":
         cols = tuple(c.slice_capacity(new_capacity) for c in self.columns)
-        return ColumnarBatch(self.names, cols, self.num_rows)
+        b = ColumnarBatch(self.names, cols, self.num_rows)
+        cached = getattr(self, "_nrows_host", None)
+        if cached is not None:
+            b._nrows_host = cached
+        return b
 
     #: capacities at or below this skip shrinking entirely: the serializer
     #: ships live rows only, so small padding is free — while the

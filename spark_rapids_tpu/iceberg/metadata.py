@@ -229,6 +229,33 @@ class ManifestEntry:
     data_file: DataFile
 
 
+def _part_encode(v: Any):
+    """JSON-safe encoding for partition values (identity/truncate output
+    date, timestamp and binary values that json can't represent)."""
+    import base64
+    import datetime as _dt
+    if isinstance(v, _dt.datetime):
+        return {"__ts__": v.isoformat()}
+    if isinstance(v, _dt.date):
+        return {"__date__": v.isoformat()}
+    if isinstance(v, (bytes, bytearray)):
+        return {"__b64__": base64.b64encode(bytes(v)).decode("ascii")}
+    return v
+
+
+def _part_decode(v: Any):
+    import base64
+    import datetime as _dt
+    if isinstance(v, dict):
+        if "__ts__" in v:
+            return _dt.datetime.fromisoformat(v["__ts__"])
+        if "__date__" in v:
+            return _dt.date.fromisoformat(v["__date__"])
+        if "__b64__" in v:
+            return base64.b64decode(v["__b64__"])
+    return v
+
+
 def _bounds_json(b: Dict[int, Any]) -> str:
     return json.dumps({str(k): v for k, v in b.items()})
 
@@ -254,7 +281,8 @@ def write_manifest(table_root: str, entries: Sequence[ManifestEntry]) -> str:
         "record_count": [e.data_file.record_count for e in entries],
         "file_size": [e.data_file.file_size for e in entries],
         "spec_id": [e.data_file.spec_id for e in entries],
-        "partition": [json.dumps(list(e.data_file.partition))
+        "partition": [json.dumps([_part_encode(v)
+                                  for v in e.data_file.partition])
                       for e in entries],
         "lower_bounds": [_bounds_json(e.data_file.lower_bounds)
                          for e in entries],
@@ -278,7 +306,8 @@ def read_manifest(table_root: str, rel_path: str) -> List[ManifestEntry]:
             file_path=row["file_path"], content=int(row["content"]),
             record_count=int(row["record_count"]),
             file_size=int(row["file_size"]), spec_id=int(row["spec_id"]),
-            partition=tuple(json.loads(row["partition"] or "[]")),
+            partition=tuple(_part_decode(v)
+                            for v in json.loads(row["partition"] or "[]")),
             lower_bounds=_bounds_unjson(row["lower_bounds"]),
             upper_bounds=_bounds_unjson(row["upper_bounds"]),
             null_counts={k: int(v) for k, v in
@@ -415,27 +444,37 @@ def read_table_metadata(table_path: str,
         raise FileNotFoundError(f"not an iceberg table: {table_path}")
     with open(os.path.join(metadata_dir(table_path),
                            f"v{v}.metadata.json")) as fh:
-        return TableMetadata.from_json(json.load(fh))
+        meta = TableMetadata.from_json(json.load(fh))
+    meta.loaded_version = v
+    return meta
 
 
-def write_table_metadata(table_path: str, meta: TableMetadata) -> int:
-    """Atomic-rename commit of the next metadata version (the Iceberg
-    optimistic-concurrency primitive; a concurrent writer of the same
-    version loses the rename race and must retry)."""
-    prev = latest_metadata_version(table_path)
-    v = 0 if prev is None else prev + 1
+def write_table_metadata(table_path: str, meta: TableMetadata,
+                         base_version: Optional[int] = None) -> int:
+    """Exclusive-create commit of metadata version ``base_version + 1``
+    (the Iceberg optimistic-concurrency primitive).  ``base_version`` is
+    the version the writer's metadata was READ from (``loaded_version``;
+    None for table creation) — committing against the read version, not
+    the directory's current tip, makes a lost concurrent commit surface as
+    :class:`ConcurrentCommitException` instead of silently dropping the
+    other writer's snapshots."""
+    if base_version is None:
+        base_version = getattr(meta, "loaded_version", None)
+    v = 0 if base_version is None else base_version + 1
     meta.last_updated_ms = int(time.time() * 1000)
     d = metadata_dir(table_path)
     os.makedirs(d, exist_ok=True)
     target = os.path.join(d, f"v{v}.metadata.json")
     try:
-        # exclusive create IS the commit: the losing concurrent writer of
-        # the same version gets FileExistsError, never a silent overwrite
+        # exclusive create IS the commit: any concurrent writer that read
+        # the same base loses the create race, never a silent overwrite
         with open(target, "x") as fh:
             json.dump(meta.to_json(), fh, indent=1)
     except FileExistsError:
         raise ConcurrentCommitException(
-            f"version {v} already committed") from None
+            f"metadata version {v} already committed (read your base "
+            f"v{base_version} stale; refresh and retry)") from None
+    meta.loaded_version = v
     return v
 
 

@@ -715,17 +715,63 @@ class GroupedData:
         self._grouping = grouping
 
     def agg(self, *cols) -> DataFrame:
+        from .expressions.udf import GroupedAggPandasUDF
         outs: List[Expression] = []
         for g in self._grouping:
             if isinstance(g, (AttributeReference, Alias)):
                 outs.append(g)
             else:
                 outs.append(Alias(g, g.sql()))
+        resolved = []
         for c in cols:
             e = _resolve_expr(_to_expr(c), self._df._plan)
             if not isinstance(e, Alias):
                 e = Alias(e, e.sql())
-            outs.append(e)
+            resolved.append(e)
+        udf_aggs = [e for e in resolved
+                    if isinstance(e.child, GroupedAggPandasUDF)]
+        if udf_aggs:
+            if len(udf_aggs) != len(resolved):
+                raise ValueError(
+                    "grouped-agg pandas UDFs cannot be mixed with built-in "
+                    "aggregates in one agg() (Spark restriction)")
+            for g in self._grouping:
+                base = g.child if isinstance(g, Alias) else g
+                if not isinstance(base, AttributeReference):
+                    raise ValueError(
+                        "grouped-agg pandas UDF grouping keys must be "
+                        f"plain columns, got {g.sql()!r} — project first")
+            # pre-project: the exec addresses columns by NAME, so every
+            # UDF argument expression becomes its own projected column
+            proj: List[Expression] = []
+            seen = set()
+            for g in self._grouping:
+                base = g.child if isinstance(g, Alias) else g
+                if base.name not in seen:
+                    seen.add(base.name)
+                    proj.append(base)
+            new_udfs = []
+            for e in udf_aggs:
+                u = e.child
+                new_args = []
+                for a in u.children:
+                    if isinstance(a, AttributeReference):
+                        if a.name not in seen:
+                            seen.add(a.name)
+                            proj.append(a)
+                        new_args.append(a)
+                    else:
+                        nm = f"__aip_arg{len(proj)}"
+                        proj.append(Alias(a, nm))
+                        new_args.append(
+                            AttributeReference(nm, a.data_type, True))
+                new_udfs.append((e.name, GroupedAggPandasUDF(
+                    u.func, u.return_type, *new_args)))
+            child_plan = P.Project(tuple(proj), self._df._plan)
+            return DataFrame(P.AggregateInPandas(
+                self._grouping, tuple(new_udfs), child_plan),
+                self._df._session)
+        outs.extend(resolved)
         return DataFrame(P.Aggregate(self._grouping, tuple(outs),
                                      self._df._plan), self._df._session)
 

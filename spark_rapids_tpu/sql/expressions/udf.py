@@ -128,6 +128,36 @@ class PandasUDF(Expression):
                                 self.return_type, cap)
 
 
+class GroupedAggPandasUDF(Expression):
+    """Grouped-aggregate pandas UDF (pyspark ``functionType=GROUPED_AGG``;
+    reference ``GpuAggregateInPandasExec``): ``func(*pd.Series) -> scalar``
+    per key group.  Never evaluated as a row expression — GroupedData.agg
+    routes plans containing it to :class:`AggregateInPandasExec`."""
+
+    def __init__(self, func: Callable, return_type: T.DataType, *args):
+        self.func = func
+        self.return_type = return_type
+        self.children = tuple(resolve_expression(a) for a in args)
+
+    def with_children(self, children):
+        return GroupedAggPandasUDF(self.func, self.return_type, *children)
+
+    @property
+    def data_type(self):
+        return self.return_type
+
+    def pretty_name(self):
+        return getattr(self.func, "__name__", "grouped_agg_udf")
+
+    def semantic_key(self):
+        return ("GroupedAggPandasUDF", id(self.func), str(self.return_type))
+
+    def kernel(self, ctx, *cols):
+        raise RuntimeError(
+            "grouped-agg pandas UDF is only valid inside "
+            "groupBy(...).agg(...)")
+
+
 class DeviceUDF(Expression):
     """Columnar device UDF SPI (``com.nvidia.spark.RapidsUDF`` analog):
     ``func(xp, *(data, validity) pairs) -> (data, validity)`` must be

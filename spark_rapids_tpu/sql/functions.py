@@ -723,15 +723,19 @@ def udf(f=None, returnType=T.DOUBLE):
     return make
 
 
-def pandas_udf(f=None, returnType=T.DOUBLE):
-    """Vectorized scalar pandas UDF (pyspark F.pandas_udf): children reach
-    the function as pandas Series via Arrow."""
+def pandas_udf(f=None, returnType=T.DOUBLE, functionType: str = "scalar"):
+    """Vectorized pandas UDF (pyspark F.pandas_udf): children reach the
+    function as pandas Series via Arrow.  ``functionType="scalar"``
+    (default) evaluates per row (GpuArrowEvalPythonExec analog);
+    ``"grouped_agg"`` reduces each group to one value and is only valid
+    inside ``groupBy(...).agg(...)`` (GpuAggregateInPandasExec analog)."""
     from .expressions import udf as U
 
     def make(func):
         def call(*cols):
-            return Column(U.PandasUDF(func, returnType,
-                                      *[_c(c) for c in cols]))
+            cls = (U.GroupedAggPandasUDF if functionType == "grouped_agg"
+                   else U.PandasUDF)
+            return Column(cls(func, returnType, *[_c(c) for c in cols]))
         call.__name__ = getattr(func, "__name__", "pandas_udf")
         return call
     if f is not None:

@@ -444,9 +444,15 @@ class HashAggregateExec(PhysicalPlan):
             return self._partial_fn(batch)
         from ...columnar.column import bucket_capacity
         batch2, mask, rank64, ng = self._group_fn(batch)
-        n = max(int(ng), 1)
+        ng_host = int(ng)
+        n = max(ng_host, 1)
         out_size = min(bucket_capacity(n, minimum=64), batch2.capacity)
-        return self._reduce_fn(out_size)(batch2, mask, rank64, ng)
+        out = self._reduce_fn(out_size)(batch2, mask, rank64, ng)
+        # output row count == observed group count (ng already folds in the
+        # one-row floor for global aggregates), known on the host — seed it
+        # so downstream num_rows_int (spill registration, sort sizing)
+        # doesn't pay another tunnel round trip
+        return out.with_known_rows(ng_host)
 
     def _merge_finalize_fn(self):
         if getattr(self, "_mf_jit", None) is None:
@@ -607,6 +613,13 @@ class HashAggregateExec(PhysicalPlan):
             raise
         if not partials:
             yield self._empty_output()
+            return
+        if self.mode == "partial" and len(partials) == 1:
+            # a single _run_partial output has unique keys by construction
+            # (one row per group) — the cross-batch merge pass would be an
+            # identity costing one kernel + one row-count sync; downstream
+            # final/merge stages handle any cross-partition duplicates
+            yield partials[0].get_and_close()
             return
         merged = self._merge_spillables(partials).get_and_close()
         if self.mode == "partial":

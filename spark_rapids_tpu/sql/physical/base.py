@@ -123,6 +123,9 @@ class PhysicalPlan:
                     _dump_failure(dump_dir, self, pid, e, out)
                 raise
             finally:
+                # disarm: unconsumed synthetic OOMs must not leak into the
+                # next task or into direct with_retry callers (tests)
+                arm_oom_injection(0, 0)
                 sem.release_if_necessary(pid)
                 for k, v in tctx.metrics.items():
                     self.metrics[k] = self.metrics.get(k, 0.0) + v

@@ -135,6 +135,66 @@ class FlatMapGroupsInPandasExec(PhysicalPlan):
                 f"{getattr(self.func, '__name__', '<fn>')}")
 
 
+class AggregateInPandasExec(PhysicalPlan):
+    """groupBy(keys).agg(grouped-agg pandas UDFs): each UDF reduces its
+    argument Series to ONE scalar per key group (reference
+    ``GpuAggregateInPandasExec``).  The planner hash-partitions the child
+    by the keys, so each partition holds complete groups; the device
+    semaphore is released while user Python runs (the reference's
+    semaphore-aware Arrow exchange, ``GpuArrowEvalPythonExec:97``)."""
+
+    def __init__(self, grouping_names: List[str], agg_udfs,
+                 child: PhysicalPlan, backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.grouping_names = list(grouping_names)
+        self.agg_udfs = list(agg_udfs)  # (name, GroupedAggPandasUDF)
+
+    @property
+    def output(self):
+        from ..expressions.core import AttributeReference
+        child_out = self.children[0].output
+        keys = [a for n in self.grouping_names
+                for a in child_out if a.name == n]
+        aggs = [AttributeReference(name, u.return_type, True)
+                for name, u in self.agg_udfs]
+        return keys + aggs
+
+    def execute(self, pid: int, tctx: TaskContext):
+        import pandas as pd
+        batches = list(self.children[0].execute(pid, tctx))
+        if not batches:
+            return
+        merged = (ColumnarBatch.concat(batches) if len(batches) > 1
+                  else batches[0])
+        pdf = _to_pandas(merged)
+        if not len(pdf):
+            return
+        # argument column names per udf (children are resolved attributes)
+        arg_names = []
+        for _name, u in self.agg_udfs:
+            arg_names.append([getattr(c, "name", str(c)) for c in u.children])
+        rows = []
+        with _semaphore_released(self.backend, tctx):
+            for key, group in pdf.groupby(self.grouping_names, sort=False,
+                                          dropna=False):
+                if not isinstance(key, tuple):
+                    key = (key,)
+                row = dict(zip(self.grouping_names, key))
+                for (name, u), cols in zip(self.agg_udfs, arg_names):
+                    row[name] = u.func(*[group[c] for c in cols])
+                rows.append(row)
+        out_schema = T.StructType(tuple(
+            T.StructField(a.name, a.data_type, True) for a in self.output))
+        out_pdf = pd.DataFrame(rows)
+        yield _from_pandas(out_pdf, out_schema, self.backend)
+
+    def simple_string(self):
+        keys = ", ".join(self.grouping_names)
+        fns = ", ".join(n for n, _ in self.agg_udfs)
+        return f"{self.node_name()} [{keys}] aggs=[{fns}]"
+
+
 class FlatMapCoGroupsInPandasExec(PhysicalPlan):
     """cogroup().applyInPandas: per key group, the user fn receives BOTH
     sides' pandas DataFrames (either may be empty); both children are

@@ -67,7 +67,11 @@ class SortExec(PhysicalPlan):
             yield from self._out_of_core(batches, target)
             return
         merged = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
-        yield self._fn(merged)
+        out = self._fn(merged)
+        known = getattr(merged, "_nrows_host", None)
+        if known is not None:
+            out.with_known_rows(known)  # sort permutes, never drops rows
+        yield out
 
     # --- out-of-core path -------------------------------------------------
     def _out_of_core(self, batches, target: int):

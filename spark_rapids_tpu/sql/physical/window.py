@@ -352,3 +352,95 @@ class WindowExec(PhysicalPlan):
     def simple_string(self):
         return (f"{self.node_name()} "
                 f"[{', '.join(a.child.sql() for a in self.window_exprs)}]")
+
+
+class WindowGroupLimitExec(PhysicalPlan):
+    """Rank-limit pushdown (reference: shim ``WindowGroupLimitExec``,
+    Spark 3.5+, merged via ``SparkShimImpl.getExecs``): when a filter
+    ``rank_like <= k`` sits above a window, each map-side partition only
+    needs its per-group top-k rows — everything ranked deeper can never
+    pass the filter, whatever the other partitions hold.  The planner
+    inserts this BELOW the window's exchange, shrinking shuffle volume;
+    the window + filter above still compute exact results.
+
+    Kept rows per (partition-keys) group, ordered by the window order:
+    row_number keeps k rows; rank/dense_rank keep every row whose rank
+    <= k (ties may keep more).
+    """
+
+    def __init__(self, partition_spec, order_spec: Sequence[SortOrder],
+                 rank_kind: str, limit: int, child: PhysicalPlan,
+                 backend=TPU):
+        super().__init__(child)
+        self.backend = backend
+        self.partition_spec = list(partition_spec)
+        self.order_spec = list(order_spec)
+        self.rank_kind = rank_kind  # row_number | rank | dense_rank
+        self.limit = int(limit)
+        out = child.output
+        self._bound_parts = [bind_references(e, out)
+                             for e in self.partition_spec]
+        self._bound_orders = [SortOrder(bind_references(o.child, out),
+                                        o.ascending, o.nulls_first)
+                              for o in self.order_spec]
+        from .kernel_cache import exprs_key
+        self._fn = self._jit(
+            self._compute,
+            key=("wgl", exprs_key(self._bound_parts),
+                 exprs_key(self._bound_orders), rank_kind, self.limit))
+
+    @property
+    def output(self):
+        return self.children[0].output
+
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        from ...ops.sorting import sort_permutation
+        from .basic import compact_batch
+        xp = self.xp
+        ctx = EvalContext(batch, xp=xp)
+        live0 = batch.row_mask()
+        # sort by (partition keys asc, order spec) so groups are contiguous
+        specs = [(e.eval(ctx), True, True) for e in self._bound_parts]
+        specs += [(o.child.eval(ctx), o.ascending, o.nulls_first)
+                  for o in self._bound_orders]
+        perm = sort_permutation(xp, specs, live0)
+        n = batch.capacity
+        valid = xp.arange(n, dtype=xp.int32) < batch.num_rows
+        cols = tuple(c.gather(perm, valid) for c in batch.columns)
+        sorted_b = ColumnarBatch(batch.names, cols, batch.num_rows)
+
+        sctx = EvalContext(sorted_b, xp=xp)
+        idx = xp.arange(n, dtype=xp.int32)
+        live = idx < sorted_b.num_rows
+        seg_keys: List = [(~live).astype(xp.int64)]
+        for e in self._bound_parts:
+            c = e.eval(sctx)
+            seg_keys.append((~c.validity).astype(xp.int64))
+            seg_keys.extend(column_sort_keys(xp, c))
+        is_seg_start = W.boundary_flags(xp, seg_keys)
+        seg_start, _seg_end = W.segment_bounds(xp, is_seg_start)
+        if self.rank_kind == "row_number":
+            rank = idx - seg_start + 1
+        else:
+            peer_keys = list(seg_keys)
+            for o in self._bound_orders:
+                c = o.child.eval(sctx)
+                peer_keys.append((~c.validity).astype(xp.int64))
+                peer_keys.extend(column_sort_keys(xp, c))
+            is_peer_start = W.boundary_flags(xp, peer_keys)
+            peer_start, _pe = W.segment_bounds(xp, is_peer_start)
+            if self.rank_kind == "rank":
+                rank = peer_start - seg_start + 1
+            else:  # dense_rank
+                cpeer = xp.cumsum(is_peer_start.astype(xp.int32))
+                rank = cpeer - cpeer[xp.clip(seg_start, 0, None)] + 1
+        keep = live & (rank <= self.limit)
+        return compact_batch(xp, sorted_b, keep)
+
+    def execute(self, pid, tctx):
+        for batch in self.children[0].execute(pid, tctx):
+            tctx.inc_metric("windowGroupLimitBatches")
+            yield self._fn(batch)
+
+    def simple_string(self):
+        return (f"{self.node_name()} [{self.rank_kind} <= {self.limit}]")

@@ -411,6 +411,28 @@ class FlatMapGroupsInPandas(LogicalPlan):
 
 
 @dataclass(eq=False)
+class AggregateInPandas(LogicalPlan):
+    """groupBy(...).agg(grouped-agg pandas UDFs) — one scalar per UDF per
+    key group (reference GpuAggregateInPandasExec)."""
+    grouping: Tuple[Expression, ...] = ()
+    # (output name, GroupedAggPandasUDF) in output order after the keys
+    agg_udfs: Tuple = ()
+    child: LogicalPlan = None  # type: ignore
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def output(self):
+        keys = [AttributeReference(getattr(g, "name", g.sql()),
+                                   g.data_type, True)
+                for g in self.grouping]
+        aggs = [AttributeReference(name, u.return_type, True)
+                for name, u in self.agg_udfs]
+        return keys + aggs
+
+
+@dataclass(eq=False)
 class FlatMapCoGroupsInPandas(LogicalPlan):
     """a.groupBy(k).cogroup(b.groupBy(k)).applyInPandas (reference
     GpuFlatMapCoGroupsInPandasExec)."""

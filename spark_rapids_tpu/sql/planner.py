@@ -29,6 +29,8 @@ class Planner:
 
     # ------------------------------------------------------------------
     def plan(self, logical: P.LogicalPlan) -> PhysicalPlan:
+        self._window_group_limits = {}
+        _annotate_window_group_limits(logical, self._window_group_limits)
         meta = TpuOverrides.apply(logical, self.conf)
         if self.conf.is_explain_only:
             _force_cpu(meta)
@@ -109,6 +111,17 @@ class Planner:
             from .physical.python_execs import MapInPandasExec
             exec_ = MapInPandasExec(node.func, node.out_schema, kids[0],
                                     backend=be)
+        elif isinstance(node, P.AggregateInPandas):
+            from .physical.python_execs import AggregateInPandasExec
+            child = kids[0]
+            if child.num_partitions() > 1:
+                child = ShuffleExchangeExec(
+                    HashPartitioning(list(node.grouping),
+                                     child.num_partitions()),
+                    child, backend=child.backend)
+            names = [getattr(g, "name", str(g)) for g in node.grouping]
+            exec_ = AggregateInPandasExec(names, list(node.agg_udfs),
+                                          child, backend=be)
         elif isinstance(node, P.FlatMapGroupsInPandas):
             from .physical.python_execs import FlatMapGroupsInPandasExec
             child = kids[0]
@@ -168,7 +181,16 @@ class Planner:
 
     def _plan_window(self, node: P.Window, child: PhysicalPlan, be):
         from ..sql.plan import SortOrder
-        from .physical.window import WindowExec
+        from .physical.window import WindowExec, WindowGroupLimitExec
+        gl = getattr(self, "_window_group_limits", {}).get(id(node))
+        if gl is not None and be == TPU and child.backend == TPU:
+            kind, k = gl
+            # below the exchange: per-map-partition top-k per group is a
+            # superset of the global top-k, so the window+filter above stay
+            # exact while the shuffle moves only surviving rows
+            child = WindowGroupLimitExec(list(node.partition_spec),
+                                         list(node.order_spec), kind, k,
+                                         child, backend=be)
         if child.num_partitions() > 1:
             if node.partition_spec:
                 part = HashPartitioning(list(node.partition_spec),
@@ -221,3 +243,92 @@ def _insert_transitions(plan: PhysicalPlan) -> PhysicalPlan:
         fixed.append(c)
     plan.children = tuple(fixed)
     return plan
+
+
+def _annotate_window_group_limits(node, out) -> None:
+    """Logical pre-pass: mark Window nodes sitting under a rank-limit
+    filter (``rank()/row_number()/dense_rank() <= k``) so _plan_window can
+    insert a WindowGroupLimitExec below the exchange (reference: Spark
+    3.5's WindowGroupLimitExec, accelerated via the version shims and
+    merged through ``SparkShimImpl.getExecs``)."""
+    from .expressions.core import AttributeReference, Literal
+    from .expressions.predicates import (And, EqualTo, LessThan,
+                                         LessThanOrEqual)
+    from .expressions.windows import (DenseRank, Rank, RowNumber,
+                                      WindowExpression)
+
+    for c in getattr(node, "children", ()):
+        _annotate_window_group_limits(c, out)
+    if not isinstance(node, P.Filter):
+        return
+    # see through projections that pass the rank column along untouched
+    # (withColumn/select insert these between the filter and the window)
+    from .expressions.core import Alias
+    below = node.child
+    projects = []
+    while isinstance(below, P.Project):
+        projects.append(below)
+        below = below.child
+
+    def resolve_name(name):
+        """Map a filter-level column name down through the project chain to
+        the window-output name (withColumn aliases `_weN` to the user
+        name); None if any projection rebuilds it with an expression."""
+        for pr in projects:
+            nxt = None
+            for e in pr.exprs:
+                if getattr(e, "name", None) != name:
+                    continue
+                if isinstance(e, AttributeReference):
+                    nxt = e.name
+                elif isinstance(e, Alias) and isinstance(
+                        e.child, AttributeReference):
+                    nxt = e.child.name
+                break
+            if nxt is None:
+                return None
+            name = nxt
+        return name
+    if not isinstance(below, P.Window):
+        return
+    win = below
+    if not win.order_spec:
+        return
+
+    def conjuncts(e):
+        if isinstance(e, And):
+            for ch in e.children:
+                yield from conjuncts(ch)
+        else:
+            yield e
+
+    # Spark's InferWindowGroupLimit precondition: EVERY window expression
+    # on the node must be rank-like.  A lead()/full-frame aggregate sharing
+    # the spec would be computed over the truncated input and produce wrong
+    # values on surviving rows.
+    rank_outputs = {}
+    for a in win.window_exprs:
+        we = a.child
+        if not isinstance(we, WindowExpression):
+            return
+        kind = {RowNumber: "row_number", Rank: "rank",
+                DenseRank: "dense_rank"}.get(type(we.function))
+        if kind is None:
+            return
+        rank_outputs[a.name] = kind
+
+    for conj in conjuncts(node.condition):
+        if not (isinstance(conj, (LessThan, LessThanOrEqual, EqualTo))
+                and isinstance(conj.children[0], AttributeReference)
+                and isinstance(conj.children[1], Literal)):
+            continue
+        name = resolve_name(conj.children[0].name)
+        lit = conj.children[1].value
+        if name is None or name not in rank_outputs \
+                or not isinstance(lit, (int,)) or isinstance(lit, bool):
+            continue
+        k = lit - 1 if isinstance(conj, LessThan) else lit
+        if k <= 0:
+            continue
+        out[id(win)] = (rank_outputs[name], int(k))
+        return

@@ -223,3 +223,33 @@ def test_engine_query_over_iceberg(sess, tmp_path):
     assert out.num_rows == 1
     assert out["s"].to_pylist() == [sum(range(50, 100))]
     assert out["c"].to_pylist() == [50]
+
+
+def test_concurrent_commit_detected(sess, tmp_path):
+    """A writer holding stale metadata must get ConcurrentCommitException,
+    not silently drop the other writer's snapshot."""
+    from spark_rapids_tpu.iceberg import ConcurrentCommitException
+    t = IcebergTable.create(sess, str(tmp_path / "t"), SCHEMA)
+    t.append(make_batch(0, 10))
+    a = IcebergTable.for_path(sess, str(tmp_path / "t"))
+    b = IcebergTable.for_path(sess, str(tmp_path / "t"))
+    a.append(make_batch(10, 20))
+    with pytest.raises(ConcurrentCommitException):
+        b.append(make_batch(20, 30))
+    # loser refreshes and retries; winner's rows survive
+    b.refresh().append(make_batch(20, 30))
+    assert IcebergTable.for_path(sess, str(tmp_path / "t")).to_df().count() == 30
+
+
+def test_identity_partition_on_date(sess, tmp_path):
+    sch = T.StructType([T.StructField("d", T.DATE, True),
+                        T.StructField("x", T.LONG, True)])
+    t = IcebergTable.create(sess, str(tmp_path / "t"), sch,
+                            partition_by=[("d", "identity")])
+    d1, d2 = datetime.date(2024, 1, 1), datetime.date(2024, 2, 1)
+    t.append(pa.table({"d": pa.array([d1, d1, d2]),
+                       "x": pa.array([1, 2, 3], type=pa.int64())}))
+    assert len(t.planned_files()) == 2
+    assert len(t.planned_files([("d", "=", d1)])) == 1
+    got = t.to_df(filters=[("d", "=", d1)]).collect()
+    assert sorted(got["x"].to_pylist()) == [1, 2]

@@ -269,3 +269,61 @@ def test_cogroup_empty_side_has_full_schema(sess):
            .orderBy("k").collect().to_pylist())
     assert got == [{"k": 1, "rw": 10.0}, {"k": 2, "rw": 0.0},
                    {"k": 3, "rw": 0.0}, {"k": 4, "rw": 0.0}]
+
+
+# --- grouped-agg pandas UDFs (GpuAggregateInPandasExec analog) -------------
+
+def test_grouped_agg_pandas_udf(sess):
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    df = sess.create_dataframe(pa.table({
+        "k": ["a", "a", "b", "b", "b"],
+        "v": [1.0, 2.0, 3.0, 4.0, 5.0]}), num_partitions=2)
+    wmean = F.pandas_udf(lambda s: float(s.mean()), T.DOUBLE,
+                         functionType="grouped_agg")
+    out = df.groupBy("k").agg(wmean(df.v).alias("m")).orderBy("k").collect()
+    assert out.to_pylist() == [{"k": "a", "m": 1.5}, {"k": "b", "m": 4.0}]
+
+
+def test_grouped_agg_pandas_udf_multi_arg_multi_udf(sess):
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2, 2],
+        "x": [1.0, 3.0, 10.0, 30.0],
+        "w": [1.0, 3.0, 1.0, 1.0]}), num_partitions=3)
+    wavg = F.pandas_udf(lambda v, w: float((v * w).sum() / w.sum()),
+                        T.DOUBLE, functionType="grouped_agg")
+    mx = F.pandas_udf(lambda v: float(v.max()), T.DOUBLE,
+                      functionType="grouped_agg")
+    out = (df.groupBy("k")
+           .agg(wavg(df.x, df.w).alias("wa"), mx(df.x).alias("mx"))
+           .orderBy("k").collect())
+    assert out.to_pylist() == [
+        {"k": 1, "wa": 2.5, "mx": 3.0}, {"k": 2, "wa": 20.0, "mx": 30.0}]
+
+
+def test_grouped_agg_udf_rejects_mixing_with_builtin(sess):
+    import pyarrow as pa
+    import pytest as _pytest
+    from spark_rapids_tpu import types as T
+    df = sess.create_dataframe(pa.table({"k": [1], "v": [1.0]}))
+    g = F.pandas_udf(lambda s: float(s.sum()), T.DOUBLE,
+                     functionType="grouped_agg")
+    with _pytest.raises(ValueError, match="mixed"):
+        df.groupBy("k").agg(g(df.v).alias("a"),
+                            F.sum(F.col("v")).alias("b"))
+
+
+def test_grouped_agg_udf_expression_args(sess):
+    """UDF arguments may be full expressions (pre-projected by the
+    planner), not just plain columns."""
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2], "v": [1.0, 2.0, 10.0]}), num_partitions=2)
+    s = F.pandas_udf(lambda x: float(x.sum()), T.DOUBLE,
+                     functionType="grouped_agg")
+    out = (df.groupBy("k").agg(s(df.v * 2.0 + 1.0).alias("t"))
+           .orderBy("k").collect())
+    assert out.to_pylist() == [{"k": 1, "t": 8.0}, {"k": 2, "t": 21.0}]

@@ -328,3 +328,91 @@ def test_range_frame_numeric_to_unbounded(sess):
     for _, r in got.sample(40, random_state=3).iterrows():
         m = pdf[(pdf.g == r.g) & (pdf.o >= r.o - 3)]
         assert r["c"] == m.v.count(), (r.g, r.o)
+
+
+# --- WindowGroupLimitExec (rank-limit pushdown, Spark 3.5 shim exec) -------
+
+def _wgl_data(sess, n=8000, groups=40):
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.default_rng(7)
+    t = pa.table({"g": rng.integers(0, groups, n), "v": rng.random(n)})
+    return sess.create_dataframe(t, num_partitions=4), t.to_pandas()
+
+
+def test_window_group_limit_planned_and_exact(sess):
+    from spark_rapids_tpu.sql.window_api import Window
+    df, pdf = _wgl_data(sess)
+    w = Window.partitionBy("g").orderBy(F.col("v").desc())
+    q = df.withColumn("r", F.row_number().over(w)).filter(F.col("r") <= 5)
+    assert "WindowGroupLimit" in sess.explain(q)
+    out = q.collect()
+    want = (pdf.sort_values(["g", "v"], ascending=[True, False])
+            .groupby("g").head(5))
+    assert out.num_rows == len(want)
+    got = out.to_pandas().sort_values(["g", "v"]).reset_index(drop=True)
+    want = want.sort_values(["g", "v"]).reset_index(drop=True)
+    assert (got["g"].values == want["g"].values).all()
+    assert abs(got["v"].values - want["v"].values).max() < 1e-12
+
+
+def test_window_group_limit_rank_ties(sess):
+    import pyarrow as pa
+    from spark_rapids_tpu.sql.window_api import Window
+    t = pa.table({"g": [1, 1, 1, 1, 2, 2],
+                  "v": [5.0, 5.0, 4.0, 3.0, 9.0, 9.0]})
+    df = sess.create_dataframe(t, num_partitions=2)
+    w = Window.partitionBy("g").orderBy(F.col("v").desc())
+    q = df.withColumn("r", F.rank().over(w)).filter(F.col("r") <= 1)
+    assert "WindowGroupLimit" in sess.explain(q)
+    out = q.collect().to_pandas().sort_values(["g", "v"])
+    # rank()<=1 keeps ALL tied-top rows
+    assert out["v"].tolist() == [5.0, 5.0, 9.0, 9.0]
+
+
+def test_window_group_limit_not_planned_without_rank(sess):
+    from spark_rapids_tpu.sql.window_api import Window
+    df, _ = _wgl_data(sess)
+    w = Window.partitionBy("g").orderBy(F.col("v").desc())
+    # sum() over a window is not a rank function: no pushdown
+    q = df.withColumn("s", F.sum(F.col("v")).over(w)).filter(
+        F.col("s") <= 2.0)
+    assert "WindowGroupLimit" not in sess.explain(q)
+
+
+def test_window_group_limit_strict_less(sess):
+    from spark_rapids_tpu.sql.window_api import Window
+    df, pdf = _wgl_data(sess)
+    w = Window.partitionBy("g").orderBy(F.col("v").desc())
+    q = df.withColumn("r", F.row_number().over(w)).filter(F.col("r") < 3)
+    assert "WindowGroupLimit" in sess.explain(q)
+    want = (pdf.sort_values(["g", "v"], ascending=[True, False])
+            .groupby("g").head(2))
+    assert q.collect().num_rows == len(want)
+
+
+def test_window_group_limit_not_planned_with_mixed_functions(sess):
+    """lead()/aggregates sharing the spec forbid the pushdown (they'd see
+    truncated input)."""
+    from spark_rapids_tpu.sql.window_api import Window
+    df, _ = _wgl_data(sess)
+    w = Window.partitionBy("g").orderBy(F.col("v").desc())
+    q = (df.withColumn("r", F.row_number().over(w))
+           .withColumn("nxt", F.lead(F.col("v")).over(w))
+           .filter(F.col("r") <= 3))
+    assert "WindowGroupLimit" not in sess.explain(q)
+
+
+def test_window_group_limit_does_not_leak_to_unfiltered_plan(sess):
+    """Planning the filtered query must not mutate the shared logical
+    Window node: collecting the UNfiltered base afterwards returns all
+    rows."""
+    from spark_rapids_tpu.sql.window_api import Window
+    df, pdf = _wgl_data(sess, n=2000, groups=10)
+    w = Window.partitionBy("g").orderBy(F.col("v").desc())
+    base = df.withColumn("r", F.row_number().over(w))
+    top = base.filter(F.col("r") <= 5)
+    assert "WindowGroupLimit" in sess.explain(top)
+    assert top.collect().num_rows == 50
+    assert base.collect().num_rows == len(pdf)  # no silent row loss
+    assert "WindowGroupLimit" not in sess.explain(base)
