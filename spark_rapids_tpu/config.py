@@ -252,6 +252,45 @@ JSON_ENABLED = register(
     "spark.rapids.sql.format.json.enabled", "Accelerate JSON.", False)
 AVRO_ENABLED = register(
     "spark.rapids.sql.format.avro.enabled", "Accelerate Avro.", False)
+PARQUET_PUSHDOWN_ENABLED = register(
+    "spark.rapids.sql.format.parquet.filterPushdown.enabled",
+    "Prune parquet row groups with footer column statistics against "
+    "scan-adjacent filter conjuncts before decode (reference "
+    "GpuParquetScan footer parse + block filtering, "
+    "GpuParquetScan.scala:2765).", True)
+READER_CHUNKED = register(
+    "spark.rapids.sql.reader.chunked",
+    "Read input files in multiple output batches (one per row-group run) "
+    "instead of one batch per file, bounding peak memory (reference "
+    "chunked readers, RapidsConf.scala:568).", True)
+READER_CHUNKED_TARGET_ROWS = register(
+    "spark.rapids.sql.reader.chunked.targetRows",
+    "Row threshold that closes a chunk when chunked reading is on.",
+    1 << 21)
+FILECACHE_ENABLED = register(
+    "spark.rapids.filecache.enabled",
+    "Cache input data files on local disk keyed by (path, size, mtime) — "
+    "the reference's file-cache feature (hook points "
+    "GpuParquetScan/GpuOrcDataReader; impl shipped in the private jar).",
+    False)
+FILECACHE_PATH = register(
+    "spark.rapids.filecache.path",
+    "Directory for the local file cache (empty = system temp).", "")
+FILECACHE_MAX_BYTES = register(
+    "spark.rapids.filecache.maxBytes",
+    "Evict least-recently-used cached files past this total size.",
+    16 << 30)
+CONCURRENT_PYTHON_WORKERS = register(
+    "spark.rapids.python.concurrentPythonWorkers",
+    "Max concurrently-running user-Python sections (pandas UDFs, "
+    "applyInPandas, mapInPandas) — bounds host memory held by parallel "
+    "Arrow/pandas materializations (reference PythonWorkerSemaphore).", 4)
+IO_REPLACE_PATHS = register(
+    "spark.rapids.tpu.io.replacePaths",
+    "Comma-separated 'scheme://old->new' prefix rewrites applied to scan "
+    "paths before reading — the Alluxio path-replacement analog "
+    "(reference AlluxioUtils.scala:671 spark.rapids.alluxio.pathsToReplace).",
+    "")
 
 # --- optimizer -------------------------------------------------------------
 OPTIMIZER_ENABLED = register(

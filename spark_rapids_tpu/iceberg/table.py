@@ -375,26 +375,15 @@ class IcebergTable:
                             break
                 if not keep:
                     break
-                # min/max skipping
+                # min/max skipping (same overlap predicate as parquet
+                # row-group pruning)
+                from ..io_.pushdown import stats_possible
                 lo = df.lower_bounds.get(f.field_id)
                 hi = df.upper_bounds.get(f.field_id)
-                if lo is not None and hi is not None:
-                    try:
-                        if op == "=" and not (lo <= lit <= hi):
-                            keep = False
-                        elif op == "<" and not (lo < lit):
-                            keep = False
-                        elif op == "<=" and not (lo <= lit):
-                            keep = False
-                        elif op == ">" and not (hi > lit):
-                            keep = False
-                        elif op == ">=" and not (hi >= lit):
-                            keep = False
-                        elif op == "in" and not any(
-                                lo <= x <= hi for x in lit):
-                            keep = False
-                    except TypeError:
-                        pass
+                if lo is not None and hi is not None and \
+                        op in ("=", "<", "<=", ">", ">=", "in") and \
+                        not stats_possible(lo, hi, op, lit):
+                    keep = False
                 if not keep:
                     break
             if keep:

@@ -1,7 +1,12 @@
 """File scan exec with the reference's multi-file reader strategies
 (``GpuMultiFileReader.scala:176-373``): PERFILE (one file per batch),
 MULTITHREADED (thread-pool prefetch, cloud-friendly), COALESCING (combine
-small files into one batch before upload)."""
+small files into one batch before upload).  Parquet reads add the
+reference's host-side scan pipeline: path replacement + file cache
+(``filecache.py``), footer-statistics row-group pruning against pushed
+filter conjuncts (``pushdown.py``; ``GpuParquetScan.scala:2765``), and
+chunked multi-batch reads (``spark.rapids.sql.reader.chunked``,
+``RapidsConf.scala:568``)."""
 
 from __future__ import annotations
 
@@ -12,9 +17,12 @@ import numpy as np
 
 from .. import types as T
 from ..columnar.convert import arrow_to_device
-from ..config import RapidsConf, MULTITHREAD_READ_NUM_THREADS, PARQUET_READER_TYPE
+from ..config import (MULTITHREAD_READ_NUM_THREADS, PARQUET_PUSHDOWN_ENABLED,
+                      PARQUET_READER_TYPE, READER_CHUNKED,
+                      READER_CHUNKED_TARGET_ROWS, RapidsConf)
 from ..sql.physical.base import CPU, TPU, PhysicalPlan, TaskContext
 from . import registry
+from .filecache import resolve_read_path
 
 
 class FileScanExec(PhysicalPlan):
@@ -29,6 +37,10 @@ class FileScanExec(PhysicalPlan):
         if self.reader_type == "AUTO":
             self.reader_type = "MULTITHREADED" if len(self.files) > 1 else "PERFILE"
         self._pool: Optional[ThreadPoolExecutor] = None
+        #: (col, op, literal) conjuncts attached by the planner from a
+        #: scan-adjacent filter; used for row-group pruning only — the
+        #: device filter above still applies the full predicate
+        self.pushed_filters: List = []
 
     @property
     def output(self):
@@ -39,8 +51,56 @@ class FileScanExec(PhysicalPlan):
             return 1
         return max(1, len(self.files))
 
-    def _read(self, path):
+    def _read(self, path, tctx: Optional[TaskContext] = None):
+        path = resolve_read_path(path, self.conf)
+        if self.node.fmt == "parquet" and self.pushed_filters and \
+                bool(self.conf.get(PARQUET_PUSHDOWN_ENABLED)):
+            import pyarrow.parquet as pq
+            from .pushdown import prune_row_groups
+            pf = pq.ParquetFile(path)
+            keep = prune_row_groups(pf, self.pushed_filters)
+            if keep is not None:
+                total = pf.metadata.num_row_groups
+                if tctx is not None:
+                    tctx.inc_metric("rowGroupsTotal", total)
+                    tctx.inc_metric("rowGroupsPruned", total - len(keep))
+                if not keep:
+                    return pf.schema_arrow.empty_table()
+                return pf.read_row_groups(keep)
         return registry.read_file(self.node.fmt, path, self.node.options)
+
+    def _read_chunked(self, path, tctx: TaskContext):
+        """Yield one pa.Table per run of row groups up to the chunk-row
+        target (parquet PERFILE path only): peak memory is bounded by the
+        chunk, not the file."""
+        import pyarrow.parquet as pq
+        from .pushdown import prune_row_groups
+        path = resolve_read_path(path, self.conf)
+        pf = pq.ParquetFile(path)
+        keep = None
+        if self.pushed_filters and bool(
+                self.conf.get(PARQUET_PUSHDOWN_ENABLED)):
+            keep = prune_row_groups(pf, self.pushed_filters)
+        groups = list(range(pf.metadata.num_row_groups)) \
+            if keep is None else keep
+        if tctx is not None and keep is not None:
+            tctx.inc_metric("rowGroupsTotal", pf.metadata.num_row_groups)
+            tctx.inc_metric("rowGroupsPruned",
+                            pf.metadata.num_row_groups - len(keep))
+        if not groups:
+            yield pf.schema_arrow.empty_table()
+            return
+        target = int(self.conf.get(READER_CHUNKED_TARGET_ROWS))
+        run: List[int] = []
+        rows = 0
+        for rg in groups:
+            run.append(rg)
+            rows += pf.metadata.row_group(rg).num_rows
+            if rows >= target:
+                yield pf.read_row_groups(run)
+                run, rows = [], 0
+        if run:
+            yield pf.read_row_groups(run)
 
     def execute(self, pid: int, tctx: TaskContext):
         import jax
@@ -55,7 +115,8 @@ class FileScanExec(PhysicalPlan):
             import pyarrow as pa
             n_threads = int(self.conf.get(MULTITHREAD_READ_NUM_THREADS))
             with ThreadPoolExecutor(max_workers=n_threads) as pool:
-                tables = list(pool.map(self._read, self.files))
+                tables = list(pool.map(lambda p: self._read(p, tctx),
+                                       self.files))
             if tables:
                 yield upload(pa.concat_tables(tables, promote_options="default"))
             return
@@ -68,11 +129,22 @@ class FileScanExec(PhysicalPlan):
             if self._pool is None:
                 self._pool = ThreadPoolExecutor(
                     max_workers=int(self.conf.get(MULTITHREAD_READ_NUM_THREADS)))
-            fut = self._pool.submit(self._read, self.files[pid])
+            fut = self._pool.submit(self._read, self.files[pid], tctx)
             yield upload(fut.result())
             return
-        yield upload(self._read(self.files[pid]))
+        if self.node.fmt == "parquet" and bool(
+                self.conf.get(READER_CHUNKED)):
+            for table in self._read_chunked(self.files[pid], tctx):
+                tctx.inc_metric("chunkedReadBatches")
+                yield upload(table)
+            return
+        yield upload(self._read(self.files[pid], tctx))
 
     def simple_string(self):
+        extra = ""
+        if self.pushed_filters:
+            fs = ", ".join(f"{c} {op} {v!r}" for c, op, v in
+                           self.pushed_filters)
+            extra = f" pushed=[{fs}]"
         return (f"{self.node_name()} {self.node.fmt} "
-                f"[{len(self.files)} files, {self.reader_type}]")
+                f"[{len(self.files)} files, {self.reader_type}]{extra}")

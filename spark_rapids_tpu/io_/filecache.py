@@ -1,0 +1,149 @@
+"""Local disk file cache + path-replacement rules.
+
+Reference parity targets:
+
+* the file-cache feature (``spark.rapids.filecache.*``; hook points in
+  ``GpuParquetScan.scala`` / ``GpuOrcDataReader`` — the implementation
+  ships in the closed ``rapids-4-spark-private`` jar, so this is a clean
+  re-design, not a port): cache input files on fast local disk keyed by
+  (path, size, mtime), LRU-evicted under a byte budget, so repeated scans
+  of remote data pay the fetch once;
+* Alluxio path replacement (``AlluxioUtils.scala:671``,
+  ``spark.rapids.alluxio.pathsToReplace``): rewrite configured path
+  prefixes to a co-located cache mount before reading.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..config import (FILECACHE_ENABLED, FILECACHE_MAX_BYTES, FILECACHE_PATH,
+                      IO_REPLACE_PATHS, RapidsConf)
+
+#: observability (tests / metrics)
+STATS = {"hits": 0, "misses": 0, "evictions": 0, "rewrites": 0}
+
+
+def rewrite_path(path: str, conf: Optional[RapidsConf] = None) -> str:
+    """Apply ``spark.rapids.tpu.io.replacePaths`` prefix rules
+    ('old->new', comma-separated; first match wins)."""
+    conf = conf or RapidsConf.get_global()
+    rules = str(conf.get(IO_REPLACE_PATHS) or "")
+    if not rules:
+        return path
+    for rule in rules.split(","):
+        rule = rule.strip()
+        if "->" not in rule:
+            continue
+        old, new = rule.split("->", 1)
+        if old and path.startswith(old):
+            STATS["rewrites"] += 1
+            return new + path[len(old):]
+    return path
+
+
+class FileCache:
+    """LRU disk cache of input files.  ``get_local`` returns a path that
+    is guaranteed local: a cache copy when caching is on (and the source
+    exists), else the source path itself."""
+
+    _instance: Optional["FileCache"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, root: str, max_bytes: int):
+        self.root = root
+        self.max_bytes = max_bytes
+        os.makedirs(root, exist_ok=True)
+        self._mu = threading.Lock()
+        # key -> (cached_path, size); insertion order = LRU order
+        self._entries: Dict[str, List] = {}
+        self._total = 0
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsConf] = None) -> "FileCache":
+        conf = conf or RapidsConf.get_global()
+        with cls._lock:
+            if cls._instance is None:
+                root = str(conf.get(FILECACHE_PATH) or "")
+                if not root:
+                    root = os.path.join(tempfile.gettempdir(),
+                                        "srt-filecache")
+                cls._instance = FileCache(
+                    root, int(conf.get(FILECACHE_MAX_BYTES)))
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def _key(self, path: str) -> Optional[str]:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        import hashlib
+        raw = f"{os.path.abspath(path)}|{st.st_size}|{int(st.st_mtime_ns)}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:32]
+
+    #: grace period before an entry may be evicted: a reader that was just
+    #: handed a path must get to open it before LRU removal (the budget may
+    #: transiently overshoot by the grace window's working set)
+    _EVICT_GRACE_S = 60.0
+
+    def get_local(self, path: str) -> str:
+        import time
+        key = self._key(path)
+        if key is None:
+            return path
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is not None and os.path.exists(ent[0]):
+                STATS["hits"] += 1
+                # refresh LRU position + last-touch time
+                self._entries.pop(key)
+                ent[2] = time.monotonic()
+                self._entries[key] = ent
+                return ent[0]
+            STATS["misses"] += 1
+        # copy outside the lock (large files)
+        dst = os.path.join(self.root, key + "-" + os.path.basename(path))
+        tmp = dst + f".tmp-{threading.get_ident()}"
+        shutil.copyfile(path, tmp)
+        os.replace(tmp, dst)
+        size = os.path.getsize(dst)
+        with self._mu:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._total -= old[1]  # concurrent miss on the same key
+            self._entries[key] = [dst, size, time.monotonic()]
+            self._total += size
+            now = time.monotonic()
+            while self._total > self.max_bytes and len(self._entries) > 1:
+                old_key = next(iter(self._entries))
+                opath, osize, otouch = self._entries[old_key]
+                if old_key == key or now - otouch < self._EVICT_GRACE_S:
+                    break  # recently handed out: a reader may not have
+                    # opened it yet (entries are LRU-ordered, so nothing
+                    # older remains)
+                self._entries.pop(old_key)
+                self._total -= osize
+                STATS["evictions"] += 1
+                try:
+                    os.remove(opath)
+                except OSError:
+                    pass
+        return dst
+
+
+def resolve_read_path(path: str, conf: Optional[RapidsConf] = None) -> str:
+    """Path-replacement rules, then the file cache when enabled."""
+    conf = conf or RapidsConf.get_global()
+    path = rewrite_path(path, conf)
+    if bool(conf.get(FILECACHE_ENABLED)):
+        return FileCache.get(conf).get_local(path)
+    return path

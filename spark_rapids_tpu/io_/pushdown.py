@@ -1,0 +1,138 @@
+"""Parquet footer statistics pruning — the host-side half of the
+reference's scan pipeline (``GpuParquetScan.scala``: host threads parse the
+footer, filter row groups by predicate + statistics, assemble surviving
+blocks; ``ParquetPartitionReader:2765``).
+
+The planner attaches scan-adjacent filter conjuncts of the shape
+``col <op> literal`` to the FileScanExec (``pushed_filters``); this module
+evaluates them against each row group's column chunk min/max/null-count
+statistics.  Pruning is conservative: a row group is skipped only when the
+statistics PROVE no row can match; the full filter still runs on the
+device afterwards, so pushdown never changes results."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+#: (column name, op, literal) with op in  = != < <= > >= in isnull isnotnull
+PushedFilter = Tuple[str, str, Any]
+
+
+def extract_pushable(condition, output) -> List[PushedFilter]:
+    """Split a filter condition into pushable (col op literal) conjuncts.
+    Unpushable conjuncts are simply not pushed (the device filter stays)."""
+    from ..sql.expressions.core import AttributeReference, Literal
+    from ..sql.expressions.predicates import (And, EqualTo, GreaterThan,
+                                              GreaterThanOrEqual, In, IsNotNull,
+                                              IsNull, LessThan,
+                                              LessThanOrEqual)
+
+    from ..sql.expressions.cast import Cast
+
+    names = {a.name for a in output}
+    out: List[PushedFilter] = []
+
+    def as_literal(e):
+        """Literal, possibly under type-coercion casts (the analyzer wraps
+        int literals compared against bigint columns in CAST).  The python
+        value is unchanged by a widening cast, which is the only coercion
+        the analyzer inserts on the literal side."""
+        while isinstance(e, Cast):
+            e = e.children[0]
+        return e if isinstance(e, Literal) else None
+
+    def visit(e):
+        if isinstance(e, And):
+            for c in e.children:
+                visit(c)
+            return
+        if isinstance(e, IsNull) and isinstance(e.children[0],
+                                                AttributeReference):
+            out.append((e.children[0].name, "isnull", None))
+            return
+        if isinstance(e, IsNotNull) and isinstance(e.children[0],
+                                                   AttributeReference):
+            out.append((e.children[0].name, "isnotnull", None))
+            return
+        ops = {EqualTo: "=", LessThan: "<", LessThanOrEqual: "<=",
+               GreaterThan: ">", GreaterThanOrEqual: ">="}
+        for cls, op in ops.items():
+            if isinstance(e, cls):
+                l, r = e.children
+                ll, rl = as_literal(l), as_literal(r)
+                if isinstance(l, AttributeReference) and rl is not None:
+                    out.append((l.name, op, rl.value))
+                elif isinstance(r, AttributeReference) and ll is not None:
+                    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                            "=": "="}
+                    out.append((r.name, flip[op], ll.value))
+                return
+        if isinstance(e, In):
+            l = e.children[0]
+            vals = [as_literal(v) for v in e.children[1:]]
+            if isinstance(l, AttributeReference) and all(
+                    v is not None for v in vals):
+                out.append((l.name, "in", tuple(v.value for v in vals)))
+            return
+
+    visit(condition)
+    return [f for f in out if f[0] in names]
+
+
+def stats_possible(lo, hi, op: str, lit) -> bool:
+    """Could any value in [lo, hi] satisfy (op, lit)?  The one shared
+    min/max-overlap predicate behind every statistics skip (parquet row
+    groups here; iceberg data-file bounds in ``iceberg/table.py``).
+    Conservative: unknown comparisons (TypeError) keep the unit."""
+    try:
+        if op == "=":
+            return lo <= lit <= hi
+        if op == "<":
+            return lo < lit
+        if op == "<=":
+            return lo <= lit
+        if op == ">":
+            return hi > lit
+        if op == ">=":
+            return hi >= lit
+        if op == "in":
+            return any(lo <= x <= hi for x in lit)
+    except TypeError:
+        return True
+    return True
+
+
+def _rg_possible(stats, op: str, lit) -> bool:
+    """Can any row in a row group with these column statistics match?"""
+    if stats is None or not stats.has_min_max:
+        return True
+    if op == "isnull":
+        return stats.null_count is None or stats.null_count > 0
+    if op == "isnotnull":
+        return stats.num_values is None or stats.num_values > 0
+    return stats_possible(stats.min, stats.max, op, lit)
+
+
+def prune_row_groups(pf, filters: Sequence[PushedFilter]) -> Optional[List[int]]:
+    """Surviving row-group indices for a ``pyarrow.parquet.ParquetFile``
+    under the pushed filters; None = keep everything (no stats/filters)."""
+    if not filters:
+        return None
+    md = pf.metadata
+    name_to_col = {md.schema.column(i).name: i
+                   for i in range(md.num_columns)}
+    keep: List[int] = []
+    for rg in range(md.num_row_groups):
+        g = md.row_group(rg)
+        ok = True
+        for col, op, lit in filters:
+            ci = name_to_col.get(col)
+            if ci is None:
+                continue
+            stats = g.column(ci).statistics
+            if not _rg_possible(stats, op, lit):
+                ok = False
+                break
+        if ok:
+            keep.append(rg)
+    return keep

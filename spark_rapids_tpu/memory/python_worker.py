@@ -1,0 +1,62 @@
+"""Python-worker semaphore — caps how many user-Python evaluations (pandas
+UDFs, applyInPandas groups, mapInPandas iterators) run concurrently, the
+``PythonWorkerSemaphore`` analog
+(``com/nvidia/spark/rapids/python/PythonWorkerSemaphore.scala``; cap conf
+``spark.rapids.python.concurrentPythonWorkers``).
+
+The reference throttles GPU-sharing PySpark worker *processes*; here the
+python execs release the DEVICE semaphore while user code runs
+(``python_execs._semaphore_released``), so this semaphore bounds the other
+resource those sections consume: host memory held by concurrent
+pandas/Arrow materializations.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from ..config import CONCURRENT_PYTHON_WORKERS, RapidsConf
+
+#: observability for tests
+STATS = {"acquires": 0, "peak": 0, "current": 0}
+_stats_lock = threading.Lock()
+
+
+class PythonWorkerSemaphore:
+    _instance: Optional["PythonWorkerSemaphore"] = None
+    _class_lock = threading.Lock()
+
+    def __init__(self, permits: int):
+        self.permits = max(1, int(permits))
+        self._sem = threading.BoundedSemaphore(self.permits)
+
+    @classmethod
+    def get(cls, conf: Optional[RapidsConf] = None
+            ) -> "PythonWorkerSemaphore":
+        conf = conf or RapidsConf.get_global()
+        with cls._class_lock:
+            want = int(conf.get(CONCURRENT_PYTHON_WORKERS))
+            if cls._instance is None or cls._instance.permits != max(1, want):
+                cls._instance = cls(want)
+            return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._class_lock:
+            cls._instance = None
+
+    @contextmanager
+    def running_python(self):
+        self._sem.acquire()
+        with _stats_lock:
+            STATS["acquires"] += 1
+            STATS["current"] += 1
+            STATS["peak"] = max(STATS["peak"], STATS["current"])
+        try:
+            yield
+        finally:
+            with _stats_lock:
+                STATS["current"] -= 1
+            self._sem.release()

@@ -746,10 +746,12 @@ class GroupedData:
             proj: List[Expression] = []
             seen = set()
             for g in self._grouping:
-                base = g.child if isinstance(g, Alias) else g
-                if base.name not in seen:
-                    seen.add(base.name)
-                    proj.append(base)
+                # project keys under their OUTPUT names (an aliased key
+                # like df.k.alias('kk') must exist as 'kk' for the exec's
+                # by-name groupby)
+                if g.name not in seen:
+                    seen.add(g.name)
+                    proj.append(g)
             new_udfs = []
             for e in udf_aggs:
                 u = e.child
@@ -768,8 +770,13 @@ class GroupedData:
                 new_udfs.append((e.name, GroupedAggPandasUDF(
                     u.func, u.return_type, *new_args)))
             child_plan = P.Project(tuple(proj), self._df._plan)
+            # grouping exprs must reference the PROJECTED child's output
+            # (an aliased key exists there only under its output name)
+            group_attrs = tuple(
+                g.to_attribute() if isinstance(g, Alias) else g
+                for g in self._grouping)
             return DataFrame(P.AggregateInPandas(
-                self._grouping, tuple(new_udfs), child_plan),
+                group_attrs, tuple(new_udfs), child_plan),
                 self._df._session)
         outs.extend(resolved)
         return DataFrame(P.Aggregate(self._grouping, tuple(outs),

@@ -22,9 +22,14 @@ def _semaphore_released(backend: str, tctx: TaskContext):
     """Release the device semaphore around user Python ONLY if this task
     holds it — execs driven inside another task's materialization (e.g. a
     downstream exchange) run under the OUTER task's permit, and acquiring
-    a second one here would deadlock a permits=1 chip."""
+    a second one here would deadlock a permits=1 chip.  While the device
+    permit is out, a PYTHON-worker permit bounds how many user-Python
+    sections run at once (reference PythonWorkerSemaphore)."""
+    from ...memory.python_worker import PythonWorkerSemaphore
+    pysem = PythonWorkerSemaphore.get(tctx.conf)
     if backend != TPU:
-        yield
+        with pysem.running_python():
+            yield
         return
     from ...memory.semaphore import TpuSemaphore
     sem = TpuSemaphore.get()
@@ -32,7 +37,8 @@ def _semaphore_released(backend: str, tctx: TaskContext):
     if held:
         sem.release_if_necessary(tctx.partition_id)
     try:
-        yield
+        with pysem.running_python():
+            yield
     finally:
         if held:
             sem.acquire_if_necessary(tctx.partition_id, tctx)
@@ -176,14 +182,21 @@ class AggregateInPandasExec(PhysicalPlan):
             arg_names.append([getattr(c, "name", str(c)) for c in u.children])
         rows = []
         with _semaphore_released(self.backend, tctx):
-            for key, group in pdf.groupby(self.grouping_names, sort=False,
-                                          dropna=False):
-                if not isinstance(key, tuple):
-                    key = (key,)
-                row = dict(zip(self.grouping_names, key))
+            if not self.grouping_names:
+                # global aggregation: one group spanning the whole input
+                row = {}
                 for (name, u), cols in zip(self.agg_udfs, arg_names):
-                    row[name] = u.func(*[group[c] for c in cols])
+                    row[name] = u.func(*[pdf[c] for c in cols])
                 rows.append(row)
+            else:
+                for key, group in pdf.groupby(self.grouping_names,
+                                              sort=False, dropna=False):
+                    if not isinstance(key, tuple):
+                        key = (key,)
+                    row = dict(zip(self.grouping_names, key))
+                    for (name, u), cols in zip(self.agg_udfs, arg_names):
+                        row[name] = u.func(*[group[c] for c in cols])
+                    rows.append(row)
         out_schema = T.StructType(tuple(
             T.StructField(a.name, a.data_type, True) for a in self.output))
         out_pdf = pd.DataFrame(rows)

@@ -30,7 +30,10 @@ class Planner:
     # ------------------------------------------------------------------
     def plan(self, logical: P.LogicalPlan) -> PhysicalPlan:
         self._window_group_limits = {}
-        _annotate_window_group_limits(logical, self._window_group_limits)
+        parents: dict = {}
+        _count_parents(logical, parents, set())
+        _annotate_window_group_limits(logical, self._window_group_limits,
+                                      parents)
         meta = TpuOverrides.apply(logical, self.conf)
         if self.conf.is_explain_only:
             _force_cpu(meta)
@@ -72,6 +75,15 @@ class Planner:
         elif isinstance(node, P.Project):
             exec_ = ProjectExec(node.exprs, kids[0], backend=be)
         elif isinstance(node, P.Filter):
+            from ..io_.exec import FileScanExec
+            if isinstance(kids[0], FileScanExec):
+                # scan-adjacent filter: push prunable conjuncts into the
+                # scan for footer-statistics row-group skipping (reference
+                # predicate pushdown, GpuParquetScan.scala:2765); the
+                # device filter above keeps the full predicate
+                from ..io_.pushdown import extract_pushable
+                kids[0].pushed_filters = extract_pushable(
+                    node.condition, kids[0].output)
             exec_ = FilterExec(node.condition, kids[0], backend=be)
         elif isinstance(node, P.Sample):
             exec_ = SampleExec(node.lower, node.upper, node.seed, kids[0],
@@ -115,10 +127,11 @@ class Planner:
             from .physical.python_execs import AggregateInPandasExec
             child = kids[0]
             if child.num_partitions() > 1:
-                child = ShuffleExchangeExec(
-                    HashPartitioning(list(node.grouping),
-                                     child.num_partitions()),
-                    child, backend=child.backend)
+                part = (HashPartitioning(list(node.grouping),
+                                         child.num_partitions())
+                        if node.grouping else SinglePartitioning())
+                child = ShuffleExchangeExec(part, child,
+                                            backend=child.backend)
             names = [getattr(g, "name", str(g)) for g in node.grouping]
             exec_ = AggregateInPandasExec(names, list(node.agg_udfs),
                                           child, backend=be)
@@ -245,7 +258,18 @@ def _insert_transitions(plan: PhysicalPlan) -> PhysicalPlan:
     return plan
 
 
-def _annotate_window_group_limits(node, out) -> None:
+def _count_parents(node, counts, seen_edges) -> None:
+    """Parent-edge counts per logical node id (the logical plan is a DAG:
+    a DataFrame reused in two branches shares subtree objects)."""
+    for c in getattr(node, "children", ()):
+        edge = (id(node), id(c))
+        if edge not in seen_edges:
+            seen_edges.add(edge)
+            counts[id(c)] = counts.get(id(c), 0) + 1
+        _count_parents(c, counts, seen_edges)
+
+
+def _annotate_window_group_limits(node, out, parents) -> None:
     """Logical pre-pass: mark Window nodes sitting under a rank-limit
     filter (``rank()/row_number()/dense_rank() <= k``) so _plan_window can
     insert a WindowGroupLimitExec below the exchange (reference: Spark
@@ -258,7 +282,7 @@ def _annotate_window_group_limits(node, out) -> None:
                                       WindowExpression)
 
     for c in getattr(node, "children", ()):
-        _annotate_window_group_limits(c, out)
+        _annotate_window_group_limits(c, out, parents)
     if not isinstance(node, P.Filter):
         return
     # see through projections that pass the rank column along untouched
@@ -293,6 +317,12 @@ def _annotate_window_group_limits(node, out) -> None:
         return
     win = below
     if not win.order_spec:
+        return
+    # the pushdown drops rows below the window, which is only sound when
+    # EVERY consumer of the window (and of each pass-through project) sits
+    # behind this rank filter — a shared unfiltered branch must see all rows
+    chain_nodes = [win] + projects
+    if any(parents.get(id(n), 0) > 1 for n in chain_nodes):
         return
 
     def conjuncts(e):

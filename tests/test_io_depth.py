@@ -1,0 +1,130 @@
+"""I/O depth: parquet row-group pruning from pushed filters, chunked
+reads, the local file cache, and path-replacement rules (reference
+GpuParquetScan footer pruning, chunked readers RapidsConf.scala:568,
+file-cache feature, AlluxioUtils path replacement)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def write_parquet(path, n=100_000, row_group_size=10_000):
+    t = pa.table({
+        "id": pa.array(range(n), type=pa.int64()),
+        "v": pa.array(np.arange(n, dtype=np.float64) * 0.5),
+        "s": [f"r{i:06d}" for i in range(n)],
+    })
+    pq.write_table(t, str(path), row_group_size=row_group_size)
+    return t
+
+
+def test_row_group_pruning_metrics_and_results(sess, tmp_path):
+    p = tmp_path / "t.parquet"
+    write_parquet(p)
+    df = sess.read.parquet(str(p))
+    q = df.filter(df.id >= 95_000)
+    assert "pushed=" in sess.explain(q)
+    out = q.collect()
+    assert out.num_rows == 5_000
+    m = sess.last_query_metrics
+    assert m.get("rowGroupsTotal", 0) == 10
+    assert m.get("rowGroupsPruned", 0) == 9  # only the last group survives
+    assert sorted(out["id"].to_pylist()) == list(range(95_000, 100_000))
+
+
+def test_pruning_never_changes_results(sess, tmp_path):
+    p = tmp_path / "t.parquet"
+    write_parquet(p, n=50_000, row_group_size=7_000)
+    df = sess.read.parquet(str(p))
+    on = df.filter((df.id >= 11_111) & (df.id < 33_333)).collect()
+    sess2 = srt.session(**{
+        "spark.rapids.sql.format.parquet.filterPushdown.enabled": False})
+    df2 = sess2.read.parquet(str(p))
+    off = df2.filter((df2.id >= 11_111) & (df2.id < 33_333)).collect()
+    assert sorted(on["id"].to_pylist()) == sorted(off["id"].to_pylist())
+    assert on.num_rows == 33_333 - 11_111
+
+
+def test_pruning_all_groups_empty_result(sess, tmp_path):
+    p = tmp_path / "t.parquet"
+    write_parquet(p, n=1_000, row_group_size=100)
+    df = sess.read.parquet(str(p))
+    out = df.filter(df.id > 10_000_000).collect()
+    assert out.num_rows == 0
+    assert set(out.column_names) == {"id", "v", "s"}
+
+
+def test_chunked_read_multiple_batches(sess, tmp_path):
+    p = tmp_path / "t.parquet"
+    write_parquet(p, n=60_000, row_group_size=5_000)
+    s = srt.session(**{
+        "spark.rapids.sql.reader.chunked": True,
+        "spark.rapids.sql.reader.chunked.targetRows": 20_000})
+    df = s.read.parquet(str(p))
+    out = df.collect()
+    assert out.num_rows == 60_000
+    assert s.last_query_metrics.get("chunkedReadBatches", 0) == 3
+    # aggregate over chunked scan stays exact
+    agg = df.agg(F.sum(F.col("id")).alias("s")).collect()
+    assert agg["s"].to_pylist() == [sum(range(60_000))]
+
+
+def test_file_cache_hit_and_reuse(tmp_path):
+    from spark_rapids_tpu.io_ import filecache as FC
+    FC.FileCache.reset()
+    p = tmp_path / "t.parquet"
+    write_parquet(p, n=1_000, row_group_size=500)
+    s = srt.session(**{
+        "spark.rapids.filecache.enabled": True,
+        "spark.rapids.filecache.path": str(tmp_path / "cache")})
+    before = dict(FC.STATS)
+    assert s.read.parquet(str(p)).count() == 1_000
+    assert s.read.parquet(str(p)).count() == 1_000
+    assert FC.STATS["misses"] - before["misses"] >= 1
+    assert FC.STATS["hits"] - before["hits"] >= 1
+    assert os.listdir(str(tmp_path / "cache"))
+    FC.FileCache.reset()
+
+
+def test_file_cache_invalidated_on_change(tmp_path):
+    from spark_rapids_tpu.io_ import filecache as FC
+    FC.FileCache.reset()
+    p = tmp_path / "t.parquet"
+    write_parquet(p, n=100, row_group_size=50)
+    s = srt.session(**{
+        "spark.rapids.filecache.enabled": True,
+        "spark.rapids.filecache.path": str(tmp_path / "cache")})
+    assert s.read.parquet(str(p)).count() == 100
+    # rewrite with different contents -> new mtime/size -> fresh copy
+    t2 = pa.table({"id": pa.array(range(7), type=pa.int64()),
+                   "v": pa.array([0.0] * 7),
+                   "s": ["x"] * 7})
+    os.remove(str(p))
+    pq.write_table(t2, str(p))
+    assert s.read.parquet(str(p)).count() == 7
+    FC.FileCache.reset()
+
+
+def test_path_rewrite_rules(tmp_path):
+    from spark_rapids_tpu.io_.filecache import rewrite_path
+    p = tmp_path / "t.parquet"
+    write_parquet(p, n=10, row_group_size=5)
+    s = srt.session(**{
+        "spark.rapids.tpu.io.replacePaths":
+            f"s3://bucket/data->{tmp_path}"})
+    # the configured prefix rewrites to the local dir and the read works
+    assert rewrite_path("s3://bucket/data/t.parquet", s.conf) == \
+        str(tmp_path / "t.parquet")
+    unchanged = rewrite_path("/local/t.parquet", s.conf)
+    assert unchanged == "/local/t.parquet"

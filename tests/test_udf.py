@@ -327,3 +327,54 @@ def test_grouped_agg_udf_expression_args(sess):
     out = (df.groupBy("k").agg(s(df.v * 2.0 + 1.0).alias("t"))
            .orderBy("k").collect())
     assert out.to_pylist() == [{"k": 1, "t": 8.0}, {"k": 2, "t": 21.0}]
+
+
+def test_python_worker_semaphore_bounds_concurrency(sess):
+    """Parallel user-Python sections never exceed the configured cap."""
+    import pyarrow as pa
+    import threading
+    from spark_rapids_tpu.memory import python_worker as PW
+    from spark_rapids_tpu import types as T
+    PW.PythonWorkerSemaphore.shutdown()
+    s = srt.session(**{"spark.rapids.python.concurrentPythonWorkers": 2})
+    PW.STATS.update(acquires=0, peak=0, current=0)
+    df = s.create_dataframe(pa.table({
+        "k": list(range(8)), "v": [float(i) for i in range(8)]}),
+        num_partitions=8)
+
+    import time as _t
+    def slow(pdf):
+        _t.sleep(0.05)
+        return pdf
+
+    out = df.groupBy("k").applyInPandas(
+        slow, T.StructType((T.StructField("k", T.LONG, True),
+                            T.StructField("v", T.DOUBLE, True))))
+    # run partitions on threads to create real concurrency
+    results = []
+    threads = [threading.Thread(target=lambda: results.append(
+        out.collect().num_rows)) for _ in range(2)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    assert results == [8, 8]
+    # one acquire per python section (AQE may coalesce partitions, so the
+    # count is per-exec-invocation, not per input partition)
+    assert PW.STATS["acquires"] >= 2
+    assert PW.STATS["peak"] <= 2
+    PW.PythonWorkerSemaphore.shutdown()
+
+
+def test_grouped_agg_udf_global_and_aliased_key(sess):
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2], "v": [1.0, 2.0, 9.0]}), num_partitions=2)
+    s = F.pandas_udf(lambda x: float(x.sum()), T.DOUBLE,
+                     functionType="grouped_agg")
+    # global aggregation (no keys)
+    out = df.agg(s(df.v).alias("t")).collect()
+    assert out.to_pylist() == [{"t": 12.0}]
+    # aliased grouping key
+    out2 = (df.groupBy(df.k.alias("kk")).agg(s(df.v).alias("t"))
+            .orderBy("kk").collect())
+    assert out2.to_pylist() == [{"kk": 1, "t": 3.0}, {"kk": 2, "t": 9.0}]

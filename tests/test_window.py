@@ -416,3 +416,16 @@ def test_window_group_limit_does_not_leak_to_unfiltered_plan(sess):
     assert top.collect().num_rows == 50
     assert base.collect().num_rows == len(pdf)  # no silent row loss
     assert "WindowGroupLimit" not in sess.explain(base)
+
+
+def test_window_group_limit_shared_node_with_unfiltered_branch(sess):
+    """A Window consumed by BOTH a rank-filtered branch and an unfiltered
+    branch in ONE plan must not get the pushdown."""
+    from spark_rapids_tpu.sql.window_api import Window
+    df, pdf = _wgl_data(sess, n=1000, groups=5)
+    w = Window.partitionBy("g").orderBy(F.col("v").desc())
+    base = df.withColumn("r", F.row_number().over(w))
+    top = base.filter(F.col("r") <= 2)
+    both = top.union(base)
+    assert "WindowGroupLimit" not in sess.explain(both)
+    assert both.collect().num_rows == 10 + len(pdf)
