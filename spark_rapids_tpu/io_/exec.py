@@ -123,6 +123,15 @@ class FileScanExec(PhysicalPlan):
 
         if pid >= len(self.files):
             return
+        # input_file_name()/block expressions read these off the task
+        # (reference InputFileName gated by InputFileBlockRule)
+        tctx.input_file = self.files[pid]
+        tctx.input_block_start = 0
+        try:
+            import os as _os
+            tctx.input_block_length = _os.path.getsize(self.files[pid])
+        except OSError:
+            tctx.input_block_length = -1
         if self.reader_type == "MULTITHREADED":
             # per-partition prefetch through a shared pool: submit this file
             # read on a worker thread so decode overlaps device compute

@@ -1,17 +1,28 @@
 """Segmented reductions over sorted group ids — the TPU replacement for
 cuDF's hash-based ``Table.groupBy().aggregate(...)`` (reference
 ``aggregate.scala`` AggHelper).  Works under jnp (scatter-add lowered by XLA)
-and numpy (ufunc.at)."""
+and numpy (ufunc.at).
+
+Out-of-bounds segment ids are DROPPED on both backends (XLA scatter
+semantics; the numpy paths mask explicitly) — callers rely on this to park
+dead rows at ``capacity - 1``/``capacity`` while reducing into small
+``num_segments`` tables."""
 
 from __future__ import annotations
 
 import numpy as np
 
 
+def _inb(seg_ids, num_segments):
+    ids = np.asarray(seg_ids)
+    return ids, (ids >= 0) & (ids < num_segments)
+
+
 def seg_sum(xp, data, seg_ids, num_segments, dtype=None):
     out = xp.zeros((num_segments,), dtype=dtype or data.dtype)
     if xp.__name__ == "numpy":
-        np.add.at(out, seg_ids, data.astype(out.dtype))
+        ids, m = _inb(seg_ids, num_segments)
+        np.add.at(out, ids[m], np.asarray(data.astype(out.dtype))[m])
         return out
     return out.at[seg_ids].add(data.astype(out.dtype))
 
@@ -19,7 +30,8 @@ def seg_sum(xp, data, seg_ids, num_segments, dtype=None):
 def seg_min(xp, data, seg_ids, num_segments, init):
     out = xp.full((num_segments,), init, dtype=data.dtype)
     if xp.__name__ == "numpy":
-        np.minimum.at(out, seg_ids, data)
+        ids, m = _inb(seg_ids, num_segments)
+        np.minimum.at(out, ids[m], np.asarray(data)[m])
         return out
     return out.at[seg_ids].min(data)
 
@@ -27,7 +39,8 @@ def seg_min(xp, data, seg_ids, num_segments, init):
 def seg_max(xp, data, seg_ids, num_segments, init):
     out = xp.full((num_segments,), init, dtype=data.dtype)
     if xp.__name__ == "numpy":
-        np.maximum.at(out, seg_ids, data)
+        ids, m = _inb(seg_ids, num_segments)
+        np.maximum.at(out, ids[m], np.asarray(data)[m])
         return out
     return out.at[seg_ids].max(data)
 
@@ -37,7 +50,8 @@ def seg_sum2(xp, data2, seg_ids, num_segments):
     (s slots reduced in a single kernel pass)."""
     out = xp.zeros((num_segments, data2.shape[1]), dtype=data2.dtype)
     if xp.__name__ == "numpy":
-        np.add.at(out, seg_ids, data2)
+        ids, m = _inb(seg_ids, num_segments)
+        np.add.at(out, ids[m], np.asarray(data2)[m])
         return out
     return out.at[seg_ids].add(data2)
 
@@ -45,7 +59,8 @@ def seg_sum2(xp, data2, seg_ids, num_segments):
 def seg_min2(xp, data2, seg_ids, num_segments, init):
     out = xp.full((num_segments, data2.shape[1]), init, dtype=data2.dtype)
     if xp.__name__ == "numpy":
-        np.minimum.at(out, seg_ids, data2)
+        ids, m = _inb(seg_ids, num_segments)
+        np.minimum.at(out, ids[m], np.asarray(data2)[m])
         return out
     return out.at[seg_ids].min(data2)
 
@@ -53,7 +68,8 @@ def seg_min2(xp, data2, seg_ids, num_segments, init):
 def seg_max2(xp, data2, seg_ids, num_segments, init):
     out = xp.full((num_segments, data2.shape[1]), init, dtype=data2.dtype)
     if xp.__name__ == "numpy":
-        np.maximum.at(out, seg_ids, data2)
+        ids, m = _inb(seg_ids, num_segments)
+        np.maximum.at(out, ids[m], np.asarray(data2)[m])
         return out
     return out.at[seg_ids].max(data2)
 

@@ -787,6 +787,22 @@ class GroupedData:
         (reference GpuFlatMapCoGroupsInPandasExec)."""
         return CoGroupedData(self, other)
 
+    def pivot(self, pivot_col: str, values: Optional[Sequence] = None
+              ) -> "PivotedGroupedData":
+        """groupBy(...).pivot(col[, values]).agg(...) — lowered to one
+        conditional aggregate per pivot value, the same rewrite the
+        reference accelerates as ``PivotFirst`` (GpuOverrides expr rule).
+        Without ``values`` the distinct pivot values are collected eagerly
+        (Spark does the same)."""
+        if values is None:
+            vals_df = self._df.select(self._df._col(pivot_col)).distinct()
+            tab = vals_df.collect()
+            vals = tab[pivot_col].to_pylist()
+            values = sorted(v for v in vals if v is not None)
+            if any(v is None for v in vals):
+                values.append(None)  # Spark emits a 'null' pivot column
+        return PivotedGroupedData(self, pivot_col, list(values))
+
     def applyInPandas(self, func, schema) -> DataFrame:
         """``func(pd.DataFrame) -> pd.DataFrame`` per key group
         (reference GpuFlatMapGroupsInPandasExec).  Grouping keys must be
@@ -826,6 +842,78 @@ class GroupedData:
         from .expressions.aggregates import Max
         return self.agg(*[Column(Alias(Max(self._df._col(n).expr),
                                        f"max({n})")) for n in names])
+
+
+class PivotedGroupedData:
+    """groupBy(keys).pivot(col, values): agg calls produce one output
+    column per (pivot value, aggregate) via conditional aggregates —
+    ``agg(expr)`` becomes ``agg(expr over If(pivot == v, child, null))``
+    per value (reference PivotFirst lowering)."""
+
+    def __init__(self, grouped: GroupedData, pivot_col: str,
+                 values: List):
+        self._grouped = grouped
+        self._pivot_col = pivot_col
+        self._values = values
+
+    def agg(self, *cols) -> DataFrame:
+        from .expressions.aggregates import AggregateFunction
+        from .expressions.conditional import If
+        df = self._grouped._df
+        pivot_attr = df._col(self._pivot_col).expr
+        outs = []
+        multi = len(cols) > 1
+        for v in self._values:
+            for c in cols:
+                e = _resolve_expr(_to_expr(c), df._plan)
+                base_name = e.name if isinstance(e, Alias) else e.sql()
+                inner = e.child if isinstance(e, Alias) else e
+                # a None pivot value matches via IS NULL (x = NULL is
+                # never true)
+                cond = (PR.IsNull(pivot_attr) if v is None
+                        else PR.EqualTo(pivot_attr, Literal(v)))
+
+                def gate(x):
+                    if isinstance(x, AggregateFunction) and x.children:
+                        return x.with_children(tuple(
+                            If(cond, ch, Literal(None, ch.data_type))
+                            for ch in x.children))
+                    if isinstance(x, AggregateFunction):
+                        # count(*): count rows matching the pivot value
+                        from .expressions.aggregates import Count
+                        return Count(If(cond, Literal(1, T.INT),
+                                        Literal(None, T.INT)))
+                    if not x.children:
+                        return x
+                    return x.with_children(tuple(
+                        gate(ch) for ch in x.children))
+                gated = gate(inner)
+                vname = "null" if v is None else str(v)
+                name = f"{vname}_{base_name}" if multi else vname
+                outs.append(Column(Alias(gated, name)))
+        return self._grouped.agg(*outs)
+
+    def sum(self, *names: str) -> DataFrame:
+        from .functions import sum as _sum  # lazy: functions imports us
+        return self.agg(*[_sum(n) for n in names])
+
+    def count(self) -> DataFrame:
+        from .expressions.aggregates import Count
+        return self.agg(Column(Alias(Count(), "count")))
+
+    def avg(self, *names: str) -> DataFrame:
+        from .functions import avg as _avg
+        return self.agg(*[_avg(n) for n in names])
+
+    mean = avg
+
+    def min(self, *names: str) -> DataFrame:
+        from .functions import min as _min
+        return self.agg(*[_min(n) for n in names])
+
+    def max(self, *names: str) -> DataFrame:
+        from .functions import max as _max
+        return self.agg(*[_max(n) for n in names])
 
 
 class CoGroupedData:

@@ -355,3 +355,116 @@ class AggregateExpression(Expression):
     def sql(self):
         d = "DISTINCT " if self.is_distinct else ""
         return f"{self.func.pretty_name()}({d}{', '.join(c.sql() for c in self.func.children)})"
+
+
+class _ShuffleCompleteAggregate(AggregateFunction):
+    """Aggregates whose grouped result is built from the RAW rows of one
+    batch rather than mergeable scalar slots (collect_list/collect_set/
+    approx_percentile).  The planner shuffles rows by key and runs ONE
+    complete-mode aggregate per partition (the reference reaches the same
+    ops via cuDF collect/t-digest GroupByAggregations;
+    ``AggregateFunctions.scala:2277``, ``GpuApproximatePercentile.scala``).
+    """
+
+    requires_shuffle_complete = True
+
+    def slots(self):
+        return []  # no mergeable scalar buffers
+
+    def update_values(self, ctx, cols):  # pragma: no cover
+        raise RuntimeError(f"{type(self).__name__} has no scalar slots")
+
+    def evaluate(self, ctx, buffers):  # pragma: no cover
+        raise RuntimeError(f"{type(self).__name__} evaluates via "
+                           "compute_grouped")
+
+
+class CollectList(_ShuffleCompleteAggregate):
+    """collect_list(col): non-null values per group, insertion order."""
+
+    _distinct = False
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def max_width(self, max_group_count: int) -> int:
+        return max_group_count
+
+    def compute_grouped(self, ctx, in_col, rank, OUT: int, W: int,
+                        row_mask, group_ok):
+        from ...ops.collect_ops import collect_into_arrays
+        return collect_into_arrays(ctx.xp, in_col, rank, row_mask, OUT, W,
+                                   self._distinct, group_ok)
+
+
+class CollectSet(CollectList):
+    """collect_set(col): distinct non-null values per group."""
+
+    _distinct = True
+
+
+class ApproximatePercentile(_ShuffleCompleteAggregate):
+    """approx_percentile(col, percentage[, accuracy]).  Implemented as
+    EXACT sorted selection (Spark's percentile ordinal rule); the
+    reference's t-digest is approximate and documented incompat, so exact
+    is a strictly tighter answer.  ``accuracy`` is accepted and ignored."""
+
+    def __init__(self, child: Expression, percentage, accuracy=10000):
+        self.children = (child,)
+        if isinstance(percentage, (list, tuple)):
+            self.percentages = [float(p) for p in percentage]
+            self._scalar = False
+        else:
+            self.percentages = [float(percentage)]
+            self._scalar = True
+        for p in self.percentages:
+            if not (0.0 <= p <= 1.0):
+                raise ValueError(f"percentage {p} not in [0, 1]")
+        self.accuracy = int(accuracy)
+
+    def with_children(self, children):
+        out = type(self)(children[0],
+                         self.percentages if not self._scalar
+                         else self.percentages[0], self.accuracy)
+        return out
+
+    def _key_extras(self):
+        return (tuple(self.percentages), self._scalar)
+
+    @property
+    def data_type(self):
+        et = self.children[0].data_type
+        return et if self._scalar else T.ArrayType(et)
+
+    def max_width(self, max_group_count: int) -> int:
+        return 1 if self._scalar else len(self.percentages)
+
+    def pretty_name(self):
+        return "approx_percentile"
+
+    def compute_grouped(self, ctx, in_col, rank, OUT: int, W: int,
+                        row_mask, group_ok):
+        from ...ops.collect_ops import grouped_percentiles
+        xp = ctx.xp
+        cols, counts = grouped_percentiles(xp, in_col, rank, row_mask, OUT,
+                                           self.percentages, group_ok)
+        if self._scalar:
+            return cols[0]
+        from ...columnar.column import make_array_column
+        w = len(cols)
+        # interleave the per-percentile gathers into width-w slots
+        # (percentile inputs are numeric, so data is always 1-D)
+        elem0 = cols[0]
+        stacked = xp.stack([c.data for c in cols], axis=1).reshape(-1)
+        ev = xp.stack([c.validity for c in cols], axis=1).reshape(-1)
+        elem = DeviceColumn(elem0.dtype, stacked, ev)
+        lengths = xp.where(counts > 0, w, 0).astype(xp.int32)
+        return make_array_column(T.ArrayType(elem0.dtype), lengths, (elem,),
+                                 group_ok & (counts > 0))

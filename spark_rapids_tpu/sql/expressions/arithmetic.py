@@ -19,7 +19,8 @@ from typing import Tuple
 from ... import types as T
 from ...columnar.column import DeviceColumn
 from .core import (EvalContext, Expression, fixed, null_safe_binary,
-                   null_safe_unary, valid_and, zero_fill)
+                   null_safe_unary, resolve_expression, valid_and,
+                   zero_fill)
 
 
 def trunc_div(xp, a, b_safe):
@@ -371,3 +372,73 @@ class ShiftRightUnsigned(_Shift):
         def f(x, y):
             return (x.astype(udt) >> (y.astype(udt) & mask)).astype(x.dtype)
         return null_safe_binary(ctx, self.data_type, a, b, f)
+
+
+class UnscaledValue(Expression):
+    """Decimal -> raw unscaled LONG (reference ``decimalExpressions.scala``
+    GpuUnscaledValue; only long-backed decimals, precision <= 18, reach
+    it — Spark inserts it around decimal aggregation internals)."""
+
+    def __init__(self, child):
+        self.children = (resolve_expression(child),)
+
+    def with_children(self, children):
+        return UnscaledValue(children[0])
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def pretty_name(self):
+        return "unscaled_value"
+
+    def tag_for_device(self, conf=None):
+        dt = self.children[0].data_type
+        if isinstance(dt, T.DecimalType) and not dt.is_long_backed:
+            return ("UnscaledValue over decimal128 would truncate the "
+                    "high word")
+        return None
+
+    def kernel(self, ctx, c):
+        return DeviceColumn(T.LONG, c.data.astype(ctx.xp.int64), c.validity)
+
+
+class MakeDecimal(Expression):
+    """LONG unscaled -> decimal(p, s) (reference GpuMakeDecimal,
+    ``decimalExpressions.scala``); null when the unscaled value overflows
+    the target precision (Spark nullOnOverflow=true default)."""
+
+    def __init__(self, child, precision: int, scale: int):
+        self.children = (resolve_expression(child),)
+        self.precision = int(precision)
+        self.scale = int(scale)
+
+    def with_children(self, children):
+        return MakeDecimal(children[0], self.precision, self.scale)
+
+    def _key_extras(self):
+        return (self.precision, self.scale)
+
+    @property
+    def data_type(self):
+        return T.DecimalType(self.precision, self.scale)
+
+    def pretty_name(self):
+        return "make_decimal"
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        data = c.data.astype(xp.int64)
+        if self.precision > 18:
+            # any int64 unscaled value fits precision >= 19 (10^19 > 2^63)
+            valid = c.validity
+        else:
+            bound = 10 ** self.precision - 1
+            fits = (data >= -bound) & (data <= bound)
+            valid = c.validity & fits
+        dt = self.data_type
+        if dt.is_long_backed:
+            return DeviceColumn(dt, data, valid)
+        hi = xp.where(data < 0, xp.asarray(-1, dtype=xp.int64),
+                      xp.asarray(0, dtype=xp.int64))
+        return DeviceColumn(dt, data, valid, aux=hi)

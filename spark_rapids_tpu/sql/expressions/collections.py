@@ -1200,3 +1200,79 @@ class Explode(UnaryExpression):
 
 class PosExplode(Explode):
     with_position = True
+
+
+class Flatten(Expression):
+    """flatten(array<array<T>>) -> array<T> (reference
+    ``collectionOperations.scala`` GpuFlatten).  Spark semantics: NULL when
+    the outer array is null or ANY inner array slot in range is null.
+
+    Slot-layout kernel: inner lengths reshape to [cap, W1]; an exclusive
+    prefix sum gives each inner array's start offset in the flattened
+    output; one scatter builds the flat slot->source map over the
+    innermost child (capacity cap*W1*W2) and one gather materializes it —
+    output width is the static W1*W2, no host sync."""
+
+    def __init__(self, child):
+        self.children = (resolve_expression(child),)
+
+    def with_children(self, children):
+        return Flatten(children[0])
+
+    @property
+    def data_type(self):
+        et = self.children[0].data_type
+        if isinstance(et, T.ArrayType) and isinstance(et.element_type,
+                                                      T.ArrayType):
+            return et.element_type
+        return et  # tagged off-device / analysis error upstream
+
+    def tag_for_device(self, conf=None):
+        et = self.children[0].data_type
+        if not (isinstance(et, T.ArrayType)
+                and isinstance(et.element_type, T.ArrayType)):
+            return "flatten requires array<array<_>> input"
+        return None
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        cap = c.capacity
+        w1 = c.array_width
+        inner = c.children[0]              # ArrayType column, cap*w1 rows
+        w2 = inner.array_width
+        innermost = inner.children[0]      # element column, cap*w1*w2 rows
+        wo = w1 * w2
+
+        outer_len = c.lengths[:, None]                       # [cap, 1]
+        j = xp.arange(w1, dtype=xp.int32)[None, :]           # [1, w1]
+        in_range = j < outer_len                             # [cap, w1]
+        l_in = inner.lengths.reshape(cap, w1)
+        inner_valid = inner.validity.reshape(cap, w1)
+        l_eff = xp.where(in_range & inner_valid, l_in, 0)
+        # NULL if any in-range inner array is null (Spark flatten)
+        row_valid = c.validity & ~xp.any(in_range & ~inner_valid, axis=1)
+        starts = xp.cumsum(l_eff, axis=1) - l_eff            # exclusive
+        total = xp.sum(l_eff, axis=1).astype(xp.int32)
+
+        # scatter: innermost element (r, j, i) -> output slot r*wo+start+i
+        i = xp.arange(w2, dtype=xp.int32)[None, None, :]     # [1,1,w2]
+        e_valid = (i < l_eff[:, :, None]) & in_range[:, :, None]
+        tgt = (xp.arange(cap, dtype=xp.int32)[:, None, None] * wo
+               + starts[:, :, None] + i)
+        src = xp.arange(cap * w1 * w2, dtype=xp.int32).reshape(cap, w1, w2)
+        flat_tgt = xp.where(e_valid, tgt, cap * wo).reshape(-1)
+        slot_source = xp.zeros(cap * wo, dtype=xp.int32)
+        slot_valid = xp.zeros(cap * wo, dtype=bool)
+        if xp.__name__ == "numpy":
+            import numpy as _np
+            m = flat_tgt < cap * wo
+            slot_source[flat_tgt[m]] = src.reshape(-1)[m]
+            slot_valid[flat_tgt[m]] = True
+        else:
+            slot_source = slot_source.at[flat_tgt].set(src.reshape(-1))
+            slot_valid = slot_valid.at[flat_tgt].set(
+                xp.ones(cap * w1 * w2, dtype=bool))
+        elem = innermost.gather(slot_source, slot_valid)
+        return make_array_column(self.data_type,
+                                 xp.where(row_valid, total, 0), (elem,),
+                                 row_valid)

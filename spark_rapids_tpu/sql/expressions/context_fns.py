@@ -1,0 +1,214 @@
+"""Task-context leaf expressions — values that depend on WHERE a row is
+being processed rather than on the row itself (reference:
+``GpuMonotonicallyIncreasingID.scala``, ``GpuSparkPartitionID.scala``,
+``randomExpressions``, ``InputFileName`` family gated by
+``InputFileBlockRule.scala``).
+
+These evaluate on the HOST engine (tag_for_device returns a placement
+reason): their value comes from the live ``TaskContext`` via the
+thread-local ``TaskContext.current()``, which a compiled XLA program
+cannot observe — baking the tracing-time partition id into a cached kernel
+would silently serve partition 0's ids to every partition.  Host placement
+costs nothing here: each is O(rows) of trivial numpy work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import types as T
+from ...columnar.column import DeviceColumn
+from .core import EvalContext, Expression, LeafExpression
+
+
+def _task():
+    from ...sql.physical.base import TaskContext
+    t = TaskContext.current()
+    if t is None:
+        raise RuntimeError("task-context expression evaluated outside a "
+                           "running task")
+    return t
+
+
+def _batch_row_offset(t, ctx: EvalContext) -> int:
+    """Offset of this batch's first row within the task's partition.
+    Keyed by batch identity so EVERY expression evaluating over the same
+    batch sees the same offset (Spark: two monotonically_increasing_id()
+    columns in one select are identical)."""
+    n = int(ctx.batch.num_rows_int if hasattr(ctx.batch, "num_rows_int")
+            else ctx.batch.num_rows)
+    state = getattr(t, "_row_offset_state", None)
+    bid = id(ctx.batch)
+    if state is not None and state[0] == bid:
+        return state[1]
+    next_off = state[2] if state is not None else 0
+    t._row_offset_state = (bid, next_off, next_off + n)
+    return next_off
+
+
+def _const_column(ctx: EvalContext, dtype, value) -> DeviceColumn:
+    xp = ctx.xp
+    cap = ctx.capacity
+    import numpy as _np
+    np_dt = {T.INT: _np.int32, T.LONG: _np.int64}.get(dtype, _np.int64)
+    data = xp.full(cap, value, dtype=np_dt)
+    return DeviceColumn(dtype, data, ctx.row_mask())
+
+
+class SparkPartitionID(LeafExpression):
+    """spark_partition_id() (``GpuSparkPartitionID.scala:53``)."""
+
+    children = ()
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def tag_for_device(self, conf=None):
+        return ("partition id comes from the live TaskContext, which a "
+                "cached compiled kernel cannot read")
+
+    def semantic_key(self):
+        return ("SparkPartitionID",)
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        return _const_column(ctx, T.INT, _task().partition_id)
+
+
+class MonotonicallyIncreasingID(LeafExpression):
+    """monotonically_increasing_id(): (partition id << 33) + row index
+    within the partition (``GpuMonotonicallyIncreasingID.scala:75``,
+    Spark's documented layout)."""
+
+    children = ()
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def tag_for_device(self, conf=None):
+        return ("monotonic id needs the task's running row offset, host "
+                "state a cached compiled kernel cannot read")
+
+    def semantic_key(self):
+        return ("MonotonicallyIncreasingID",)
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        t = _task()
+        xp = ctx.xp
+        cap = ctx.capacity
+        offset = _batch_row_offset(t, ctx)
+        base = (t.partition_id << 33) + offset
+        data = base + xp.arange(cap, dtype=xp.int64)
+        return DeviceColumn(T.LONG, data, ctx.row_mask())
+
+
+class Rand(Expression):
+    """rand([seed]): uniform [0,1) doubles.  Spark semantics: the seed is
+    fixed at analysis time (random when omitted), every partition draws
+    from a (seed, partition id) stream, and two rand(seed) columns with
+    the same seed are identical.  Positioned generation (PCG64.advance to
+    the batch's row offset) keeps repeated evaluations and same-seed
+    expressions bit-identical (``randomExpressions`` family)."""
+
+    def __init__(self, seed=None):
+        if seed is None:
+            import secrets
+            seed = secrets.randbelow(1 << 31)  # Spark picks a random seed
+        self.seed = int(seed)
+        self.children = ()
+
+    def with_children(self, children):
+        return Rand(self.seed)
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def foldable(self):
+        return False
+
+    def tag_for_device(self, conf=None):
+        return ("rand() draws a positioned host RNG stream (seeded per "
+                "partition); a cached kernel would replay one stream")
+
+    def semantic_key(self):
+        return ("Rand", self.seed)
+
+    def pretty_name(self):
+        return "rand"
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        t = _task()
+        offset = _batch_row_offset(t, ctx)
+        bitgen = np.random.PCG64((self.seed << 16) ^ t.partition_id)
+        bitgen.advance(offset)  # position: one 64-bit draw per double
+        vals = np.random.Generator(bitgen).random(ctx.capacity)
+        xp = ctx.xp
+        return DeviceColumn(T.DOUBLE, vals if xp.__name__ == "numpy"
+                            else xp.asarray(vals), ctx.row_mask())
+
+
+class _InputFileLeaf(LeafExpression):
+    children = ()
+    _attr = "input_file"
+    _default: object = ""
+
+    @property
+    def nullable(self):
+        return False
+
+    def tag_for_device(self, conf=None):
+        return ("input file info lives on the task context (reference "
+                "gates these via InputFileBlockRule)")
+
+    def semantic_key(self):
+        return (type(self).__name__,)
+
+
+class InputFileName(_InputFileLeaf):
+    """input_file_name() — current scan file path, '' elsewhere."""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        import pyarrow as pa
+        from ...columnar.convert import arrow_to_device_column
+        name = getattr(_task(), "input_file", None) or ""
+        arr = pa.array([name] * ctx.capacity, type=pa.string())
+        col = arrow_to_device_column(arr, ctx.capacity)
+        return col.with_validity(ctx.row_mask())
+
+
+class InputFileBlockStart(_InputFileLeaf):
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        v = getattr(_task(), "input_block_start", None)
+        return _const_column(ctx, T.LONG, -1 if v is None else v)
+
+
+class InputFileBlockLength(_InputFileLeaf):
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        v = getattr(_task(), "input_block_length", None)
+        return _const_column(ctx, T.LONG, -1 if v is None else v)

@@ -213,7 +213,10 @@ class BoundReference(LeafExpression):
         return f"input[{self.ordinal}]"
 
     def _key_extras(self) -> Tuple:
-        return (self.ordinal,)
+        # dtype is part of the program identity: expression trees bake
+        # their result dtype into the traced kernel (column metadata), so
+        # input[0]:bigint and input[0]:string must never share a cache key
+        return (self.ordinal, str(self.dtype))
 
 
 @dataclass(eq=False)

@@ -92,3 +92,24 @@ _reg(Agg.AggregateExpression, Agg.Sum, Agg.Count, Agg.Min, Agg.Max,
 _reg(W.WindowExpression, W.WindowSpecDefinition, W.RowNumber, W.Rank,
      W.DenseRank, W.PercentRank, W.CumeDist, W.NTile, W.Lead, W.Lag,
      W.NthValue)
+
+# task-context leaves (host-evaluated: values come from the live task,
+# which a cached compiled kernel cannot observe)
+from . import context_fns as Ctx  # noqa: E402
+
+_reg(Ctx.SparkPartitionID, Ctx.MonotonicallyIncreasingID, Ctx.Rand,
+     Ctx.InputFileName, Ctx.InputFileBlockStart, Ctx.InputFileBlockLength)
+
+# sort/frame spec nodes consumed by the sort/window planners (registered
+# for supported-ops parity with GpuOverrides' SortOrder/SpecifiedWindowFrame
+# rules)
+from ..plan import SortOrder as _SortOrder  # noqa: E402
+
+EXPRESSION_REGISTRY["SortOrder"] = _SortOrder
+from .windows import WindowFrame as _WindowFrame  # noqa: E402
+
+EXPRESSION_REGISTRY["SpecifiedWindowFrame"] = _WindowFrame
+
+_reg(Agg.CollectList, Agg.CollectSet, Agg.ApproximatePercentile)
+
+_reg(Col.Flatten, A.UnscaledValue, A.MakeDecimal)

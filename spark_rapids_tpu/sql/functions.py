@@ -758,3 +758,62 @@ def device_udf(f=None, returnType=T.DOUBLE):
     if f is not None:
         return make(f)
     return make
+
+
+# --- task-context functions (GpuMonotonicallyIncreasingID /
+# GpuSparkPartitionID / randomExpressions / InputFileName analogs) ----------
+
+def monotonically_increasing_id() -> Column:
+    """64-bit id: (partition id << 33) + row position in the partition."""
+    from .expressions.context_fns import MonotonicallyIncreasingID
+    return Column(MonotonicallyIncreasingID())
+
+
+def spark_partition_id() -> Column:
+    from .expressions.context_fns import SparkPartitionID
+    return Column(SparkPartitionID())
+
+
+def rand(seed=None) -> Column:
+    """Uniform [0,1) doubles from a per-partition stream."""
+    from .expressions.context_fns import Rand
+    return Column(Rand(seed))
+
+
+def input_file_name() -> Column:
+    from .expressions.context_fns import InputFileName
+    return Column(InputFileName())
+
+
+def input_file_block_start() -> Column:
+    from .expressions.context_fns import InputFileBlockStart
+    return Column(InputFileBlockStart())
+
+
+def input_file_block_length() -> Column:
+    from .expressions.context_fns import InputFileBlockLength
+    return Column(InputFileBlockLength())
+
+
+def collect_list(c) -> Column:
+    """Non-null values per group, insertion order."""
+    return Column(AG.CollectList(_c(c)))
+
+
+def collect_set(c) -> Column:
+    """Distinct non-null values per group."""
+    return Column(AG.CollectSet(_c(c)))
+
+
+def percentile_approx(c, percentage, accuracy: int = 10000) -> Column:
+    """Grouped percentile (exact sorted selection; the accuracy knob is
+    accepted for API parity)."""
+    return Column(AG.ApproximatePercentile(_c(c), percentage, accuracy))
+
+
+approx_percentile = percentile_approx
+
+
+def flatten(c) -> Column:
+    """array<array<T>> -> array<T> (one nesting level removed)."""
+    return Column(CL.Flatten(_c(c)))

@@ -305,6 +305,13 @@ class HashAggregateExec(PhysicalPlan):
                 [bind_references(c, child_attrs) for c in f.children]
                 for f in self._agg_funcs]
 
+        #: indices of shuffle-complete aggregates (collect_list/set,
+        #: approx_percentile): grouped results built from raw rows, no
+        #: mergeable slots — planner shuffles rows by key and runs ONE
+        #: complete pass (reference cuDF collect/t-digest aggregations)
+        self._special = [i for i, f in enumerate(self._agg_funcs)
+                         if getattr(f, "requires_shuffle_complete", False)]
+
         from .kernel_cache import exprs_key
         self._pre_steps: List = []  # fused upstream filter/project chain
         slots_key = tuple(
@@ -556,6 +563,107 @@ class HashAggregateExec(PhysicalPlan):
                                            split=split_group)]
         return level[0]
 
+    # --- shuffle-complete (collect/percentile) path ------------------------
+    def _special_impl(self, OUT: int, widths):
+        """Kernel over (batch, mask, rank64, ng) with static OUT + per-
+        special array widths: grouped keys + normal slots via
+        groupby_reduce, specials via their compute_grouped."""
+        special = set(self._special)
+
+        def impl(batch, mask, rank64, ng):
+            xp = self.xp
+            ctx = EvalContext(batch, xp=xp)
+            keys = [g.eval(ctx) for g in self._bound_grouping]
+            slot_pairs, ops = [], []
+            ranges = {}
+            for fi, (f, inputs) in enumerate(zip(self._agg_funcs,
+                                                 self._bound_inputs)):
+                if fi in special:
+                    continue
+                in_cols = [e.eval(ctx) for e in inputs]
+                pairs = f.update_values(ctx, in_cols)
+                ranges[fi] = (len(slot_pairs), len(slot_pairs) + len(pairs))
+                slot_pairs.extend(pairs)
+                ops.extend(s.op for s in f.slots())
+            gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, mask,
+                                       rank64=rank64, n_groups=ng,
+                                       out_size=OUT)
+            group_ok = xp.arange(OUT, dtype=xp.int32) < n
+            rank = rank64.astype(xp.int32)
+            results = {}
+            for fi, f in enumerate(self._agg_funcs):
+                if fi in special:
+                    in_col = self._bound_inputs[fi][0].eval(ctx)
+                    results[fi] = f.compute_grouped(
+                        ctx, in_col, rank, OUT, widths[fi], mask, group_ok)
+                else:
+                    lo, hi = ranges[fi]
+                    r = f.evaluate(ctx, gs[lo:hi])
+                    results[fi] = r.with_validity(r.validity & group_ok)
+            cols, names = [], []
+            for kind, idx, name in self._out_spec:
+                names.append(name)
+                cols.append(gk[idx] if kind == "group" else results[idx])
+            return ColumnarBatch(tuple(names), tuple(cols), n)
+        return impl
+
+    def _execute_special(self, pid: int, tctx: TaskContext):
+        from ...columnar.column import bucket_capacity, bucket_width
+        child = self.children[0]
+        batches = list(child.execute(pid, tctx))
+        batches = [b for b in batches if b.num_rows_int > 0]
+        if not batches:
+            yield self._empty_output()
+            return
+        merged = ColumnarBatch.concat(batches) if len(batches) > 1 \
+            else batches[0]
+        tctx.inc_metric("aggSpecialBatches")
+        if self.backend != TPU:
+            # eager numpy path: exact sizes, no bucketing needed
+            import numpy as np_
+            mask = np.asarray(merged.row_mask()) \
+                if hasattr(merged, "row_mask") else None
+            b2 = merged
+            for step in self._pre_steps:
+                b2, mask = step._fuse_step(b2, mask, self.xp)
+            from .aggregate import group_phase  # self-module (clarity)
+            rank64, ng = group_phase(self.xp, [
+                g.eval(EvalContext(b2, xp=self.xp))
+                for g in self._bound_grouping], mask)
+            OUT = max(int(ng), 1)
+            maxc = self._max_group_count(self.xp, rank64, mask, OUT)
+            widths = {fi: max(self._agg_funcs[fi].max_width(maxc), 1)
+                      for fi in self._special}
+            yield self._special_impl(OUT, widths)(b2, mask, rank64, ng)
+            return
+        batch2, mask, rank64, ng = self._group_fn(merged)
+        ng0 = int(ng)  # ONE sync; global aggregates already floored to 1
+        maxc = self._max_group_count(self.xp, rank64, mask,
+                                     batch2.capacity)
+        OUT = min(bucket_capacity(max(ng0, 1), minimum=64),
+                  batch2.capacity)
+        widths = {fi: bucket_width(
+            max(self._agg_funcs[fi].max_width(maxc), 1))
+            for fi in self._special}
+        key = ("special", OUT, tuple(sorted(widths.items())),
+               tuple(self._out_spec), self._partial_key)
+        fn = self._jit(self._special_impl(OUT, widths), key=key)
+        out = fn(batch2, mask, rank64, ng)
+        # unfloored: a fully-filtered partition reports 0 rows, not 1
+        yield out.with_known_rows(ng0)
+
+    def _max_group_count(self, xp, rank64, mask, bound: int) -> int:
+        """Host-synced max rows in any one group (sizes collect widths)."""
+        counts = xp.zeros(bound, dtype=xp.int32)
+        tgt = xp.where(mask, rank64, bound)
+        if xp.__name__ == "numpy":
+            import numpy as np_
+            sel = np_.asarray(tgt) < bound
+            np_.add.at(counts, np_.asarray(tgt)[sel], 1)
+            return int(counts.max()) if bound else 0
+        counts = counts.at[tgt].add(1)
+        return int(xp.max(counts))
+
     # --- execute ----------------------------------------------------------
     def execute(self, pid: int, tctx: TaskContext):
         """Out-of-core contract (``GpuMergeAggregateIterator``
@@ -568,6 +676,13 @@ class HashAggregateExec(PhysicalPlan):
                                      ACTIVE_ON_DECK_PRIORITY,
                                      SpillableColumnarBatch)
         child = self.children[0]
+        if self._special:
+            if self.mode != "complete":
+                raise RuntimeError(
+                    "collect/percentile aggregates require shuffle-"
+                    "complete planning (planner bug)")
+            yield from self._execute_special(pid, tctx)
+            return
         if self.mode == "final":
             partials = [SpillableColumnarBatch.create(b, ACTIVE_BATCHING_PRIORITY)
                         for b in child.execute(pid, tctx)]

@@ -51,6 +51,34 @@ class TaskContext:
             return
         self.metrics[name] = self.metrics.get(name, 0.0) + value
 
+    # --- thread-local current task (Spark TaskContext.get() analog) -------
+    _tls = threading.local()
+
+    @classmethod
+    def current(cls) -> Optional["TaskContext"]:
+        """The task running on this thread (None outside a task).  Used by
+        task-context expressions (spark_partition_id(), rand(), ...)."""
+        return getattr(cls._tls, "ctx", None)
+
+    @classmethod
+    def _set_current(cls, ctx: Optional["TaskContext"]):
+        cls._tls.ctx = ctx
+
+    def as_current(self):
+        """Context manager installing this task as the thread's current one
+        (nested map-side tasks under exchanges/joins restore the outer)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _cm():
+            prev = TaskContext.current()
+            TaskContext._set_current(self)
+            try:
+                yield self
+            finally:
+                TaskContext._set_current(prev)
+        return _cm()
+
 
 class PhysicalPlan:
     backend: str = TPU
@@ -103,6 +131,7 @@ class PhysicalPlan:
         tracing = bool(cfg.get(TRACE_ENABLED))
         for pid in range(self.num_partitions()):
             tctx = TaskContext(pid, conf)
+            TaskContext._set_current(tctx)
             arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
                               int(tctx.conf.get(TEST_INJECT_SPLIT_OOM)))
             sem.acquire_if_necessary(pid, tctx)
@@ -126,6 +155,7 @@ class PhysicalPlan:
                 # disarm: unconsumed synthetic OOMs must not leak into the
                 # next task or into direct with_retry callers (tests)
                 arm_oom_injection(0, 0)
+                TaskContext._set_current(None)
                 sem.release_if_necessary(pid)
                 for k, v in tctx.metrics.items():
                     self.metrics[k] = self.metrics.get(k, 0.0) + v

@@ -82,7 +82,9 @@ class ShuffleExchangeExec(PhysicalPlan):
         num_maps = child.num_partitions()
         map_out: List[Optional[ColumnarBatch]] = []
         for cpid in range(num_maps):
-            got = list(child.execute(cpid, TaskContext(cpid, tctx.conf, parent=tctx)))
+            ctctx = TaskContext(cpid, tctx.conf, parent=tctx)
+            with ctctx.as_current():
+                got = list(child.execute(cpid, ctctx))
             map_out.append(ColumnarBatch.concat(got) if len(got) > 1
                            else (got[0] if got else None))
 
@@ -226,8 +228,9 @@ class BroadcastExchangeExec(PhysicalPlan):
         if self._cached is None:
             batches = []
             for cpid in range(self.children[0].num_partitions()):
-                batches.extend(self.children[0].execute(
-                    cpid, TaskContext(cpid, tctx.conf, parent=tctx)))
+                ctctx = TaskContext(cpid, tctx.conf, parent=tctx)
+                with ctctx.as_current():
+                    batches.extend(self.children[0].execute(cpid, ctctx))
             if not batches:
                 self._cached = empty_batch_for(self.output)
             else:

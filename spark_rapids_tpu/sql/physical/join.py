@@ -343,9 +343,10 @@ class ShuffledHashJoinExec(BaseJoinExec):
         return self._probe.num_partitions()
 
     def execute(self, pid: int, tctx: TaskContext):
-        build = self._concat_or_empty(
-            list(self._build.execute(pid, TaskContext(pid, tctx.conf, parent=tctx))),
-            self._build.output)
+        btctx = TaskContext(pid, tctx.conf, parent=tctx)
+        with btctx.as_current():
+            build_batches = list(self._build.execute(pid, btctx))
+        build = self._concat_or_empty(build_batches, self._build.output)
         probes = list(self._probe.execute(pid, tctx))
         how = self._norm_how
         if how == "full" and len(probes) > 1:
@@ -466,8 +467,9 @@ class NestedLoopJoinExec(BaseJoinExec):
             # every probe partition needs the whole build stream
             batches = []
             for bpid in range(self._build.num_partitions()):
-                batches.extend(self._build.execute(
-                    bpid, TaskContext(bpid, tctx.conf)))
+                btctx = TaskContext(bpid, tctx.conf)
+                with btctx.as_current():
+                    batches.extend(self._build.execute(bpid, btctx))
             build = self._concat_or_empty(batches, self._build.output)
         probes = list(self._probe.execute(pid, tctx))
         how = self._norm_how
@@ -559,8 +561,11 @@ class AdaptiveJoinExec(PhysicalPlan):
             return
         from ...config import AUTO_BROADCAST_THRESHOLD
         node, left, right = self._node, self.children[0], self.children[1]
-        parts = [list(right.execute(p, TaskContext(p, tctx.conf, parent=tctx)))
-                 for p in range(right.num_partitions())]
+        parts = []
+        for p in range(right.num_partitions()):
+            rtctx = TaskContext(p, tctx.conf, parent=tctx)
+            with rtctx.as_current():
+                parts.append(list(right.execute(p, rtctx)))
         right_m = MaterializedExec(right.output, parts, backend=self.backend)
         threshold = int(self._conf.get(AUTO_BROADCAST_THRESHOLD))
         can_broadcast = (node.how in ("inner", "left", "left_semi",
@@ -594,7 +599,10 @@ class AdaptiveJoinExec(PhysicalPlan):
         # serve the chosen plan's m partitions through our fixed n pids
         for p in range(pid, m, n) if m > n else (
                 [pid] if pid < m else []):
-            yield from self._chosen.execute(p, TaskContext(p, tctx.conf, parent=tctx))
+            ctctx = TaskContext(p, tctx.conf, parent=tctx)
+            with ctctx.as_current():
+                got = list(self._chosen.execute(p, ctctx))
+            yield from got
 
     def simple_string(self):
         tag = self.chosen_strategy or "undecided"

@@ -239,8 +239,9 @@ class FlatMapCoGroupsInPandasExec(PhysicalPlan):
         names); an empty side still carries the child's full schema so
         the user function can touch any column (PySpark contract)."""
         import pandas as pd
-        batches = list(child.execute(pid, TaskContext(pid, tctx.conf,
-                                                      parent=tctx)))
+        stctx = TaskContext(pid, tctx.conf, parent=tctx)
+        with stctx.as_current():
+            batches = list(child.execute(pid, stctx))
         if batches:
             merged = (ColumnarBatch.concat(batches) if len(batches) > 1
                       else batches[0])

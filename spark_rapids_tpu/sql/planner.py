@@ -176,7 +176,23 @@ class Planner:
 
     # ------------------------------------------------------------------
     def _plan_aggregate(self, node: P.Aggregate, child: PhysicalPlan, be):
+        from .expressions.aggregates import AggregateFunction
         nparts = child.num_partitions()
+        special = any(
+            getattr(f, "requires_shuffle_complete", False)
+            for e in node.aggregates
+            for f in e.collect(lambda x: isinstance(x, AggregateFunction)))
+        if special:
+            # collect_list/collect_set/approx_percentile: results build
+            # from raw rows (no mergeable partial slots) — shuffle rows by
+            # key, then ONE complete aggregate per partition
+            if nparts > 1:
+                part = (HashPartitioning(list(node.grouping), nparts)
+                        if node.grouping else SinglePartitioning())
+                child = ShuffleExchangeExec(part, child,
+                                            backend=child.backend)
+            return HashAggregateExec(node.grouping, node.aggregates,
+                                     "complete", child, backend=be)
         if nparts <= 1:
             return HashAggregateExec(node.grouping, node.aggregates,
                                      "complete", child, backend=be)

@@ -1,0 +1,136 @@
+"""collect_list / collect_set / approx_percentile — shuffle-complete
+aggregates (reference cuDF collect aggregations via
+AggregateFunctions.scala and GpuApproximatePercentile)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def test_collect_list_basic(sess):
+    df = sess.create_dataframe(pa.table({
+        "k": ["a", "a", "a", "b", "b"],
+        "v": [3.0, 1.0, 3.0, 5.0, 4.0]}), num_partitions=2)
+    out = (df.groupBy("k").agg(F.collect_list(df.v).alias("l"))
+           .orderBy("k").collect().to_pylist())
+    assert sorted(out[0]["l"]) == [1.0, 3.0, 3.0]
+    assert sorted(out[1]["l"]) == [4.0, 5.0]
+
+
+def test_collect_set_dedups(sess):
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 1, 1, 2],
+        "v": [7, 7, 8, 7, 9]}), num_partitions=3)
+    out = (df.groupBy("k").agg(F.collect_set(df.v).alias("s"))
+           .orderBy("k").collect().to_pylist())
+    assert sorted(out[0]["s"]) == [7, 8]
+    assert out[1]["s"] == [9]
+
+
+def test_collect_skips_nulls(sess):
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 1],
+        "v": pa.array([1.0, None, 2.0], type=pa.float64())}))
+    out = df.groupBy("k").agg(
+        F.collect_list(df.v).alias("l"),
+        F.collect_set(df.v).alias("s")).collect().to_pylist()
+    assert sorted(out[0]["l"]) == [1.0, 2.0]
+    assert sorted(out[0]["s"]) == [1.0, 2.0]
+
+
+def test_collect_strings(sess):
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2], "v": ["xx", "yy", "zz"]}), num_partitions=2)
+    out = (df.groupBy("k").agg(F.collect_set(df.v).alias("s"))
+           .orderBy("k").collect().to_pylist())
+    assert sorted(out[0]["s"]) == ["xx", "yy"]
+    assert out[1]["s"] == ["zz"]
+
+
+def test_collect_at_scale_vs_pandas(sess):
+    rng = np.random.default_rng(3)
+    n = 20_000
+    t = pa.table({"g": rng.integers(0, 100, n),
+                  "v": rng.integers(0, 50, n)})
+    df = sess.create_dataframe(t, num_partitions=4)
+    out = (df.groupBy("g").agg(F.collect_list(df.v).alias("l"),
+                               F.collect_set(df.v).alias("s"))
+           .collect().to_pandas().set_index("g"))
+    pdf = t.to_pandas()
+    want_counts = pdf.groupby("g")["v"].count()
+    want_sets = pdf.groupby("g")["v"].agg(lambda s: sorted(set(s)))
+    for g in want_counts.index:
+        assert len(out.loc[g, "l"]) == want_counts[g]
+        assert sorted(out.loc[g, "s"]) == list(want_sets[g])
+        # multiset equality for the list
+        assert sorted(out.loc[g, "l"]) == sorted(
+            pdf[pdf.g == g]["v"].tolist())
+
+
+def test_global_collect_list(sess):
+    df = sess.create_dataframe(pa.table({"v": [1, 2, 3]}),
+                               num_partitions=2)
+    out = df.agg(F.collect_list(df.v).alias("l")).collect().to_pylist()
+    assert sorted(out[0]["l"]) == [1, 2, 3]
+
+
+def test_percentile_approx_scalar_and_array(sess):
+    rng = np.random.default_rng(11)
+    n = 5_000
+    t = pa.table({"g": rng.integers(0, 8, n), "v": rng.random(n)})
+    df = sess.create_dataframe(t, num_partitions=3)
+    out = (df.groupBy("g")
+           .agg(F.percentile_approx(df.v, 0.5).alias("p50"),
+                F.percentile_approx(df.v, [0.25, 0.75]).alias("pq"))
+           .collect().to_pandas().set_index("g"))
+    pdf = t.to_pandas()
+    for g, grp in pdf.groupby("g"):
+        vals = np.sort(grp["v"].values)
+        cnt = len(vals)
+        def spark_pct(p):
+            return vals[max(int(np.ceil(p * cnt)) - 1, 0)]
+        assert out.loc[g, "p50"] == spark_pct(0.5)
+        assert list(out.loc[g, "pq"]) == [spark_pct(0.25), spark_pct(0.75)]
+
+
+def test_percentile_mixed_with_builtin_aggs(sess):
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2, 2, 2], "v": [1.0, 3.0, 10.0, 20.0, 30.0]}),
+        num_partitions=2)
+    out = (df.groupBy("k")
+           .agg(F.sum(F.col("v")).alias("s"),
+                F.percentile_approx(df.v, 0.5).alias("p"),
+                F.collect_list(df.v).alias("l"))
+           .orderBy("k").collect().to_pylist())
+    assert out[0]["s"] == 4.0 and out[0]["p"] == 1.0
+    assert out[1]["s"] == 60.0 and out[1]["p"] == 20.0
+    assert sorted(out[1]["l"]) == [10.0, 20.0, 30.0]
+
+
+def test_collect_cpu_oracle_agrees(sess):
+    """Device path vs the independent numpy engine."""
+    rng = np.random.default_rng(5)
+    n = 2_000
+    t = pa.table({"g": rng.integers(0, 20, n),
+                  "v": rng.integers(-100, 100, n)})
+    q = lambda s: (s.create_dataframe(t, num_partitions=2).groupBy("g")
+                   .agg(F.collect_set(F.col("v")).alias("s"),
+                        F.percentile_approx(F.col("v"), 0.5).alias("p"))
+                   .collect().to_pandas().set_index("g").sort_index())
+    try:
+        a = q(srt.session())
+        b = q(srt.session(**{"spark.rapids.sql.enabled": False}))
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
+    assert (a["p"].values == b["p"].values).all()
+    for g in a.index:
+        assert sorted(a.loc[g, "s"]) == sorted(b.loc[g, "s"])

@@ -325,3 +325,22 @@ def test_slice_out_of_range_returns_empty(sess):
         assert r["pos_far"] == [], r
         assert r["zero_start"] is None, r
         assert r["neg_len"] is None, r
+
+
+# --- flatten (GpuFlatten, collectionOperations.scala) ----------------------
+
+def test_flatten_basic(sess):
+    t = pa.table({"a": pa.array([[[1, 2], [3]], [[4]], [], [[5, 6], None],
+                                 None],
+                                type=pa.list_(pa.list_(pa.int64())))})
+    df = sess.create_dataframe(t)
+    out = df.select(F.flatten(df.a).alias("f")).collect()
+    assert out["f"].to_pylist() == [[1, 2, 3], [4], [], None, None]
+
+
+def test_flatten_strings(sess):
+    t = pa.table({"a": pa.array([[["x"], ["yy", "z"]], [[]]],
+                                type=pa.list_(pa.list_(pa.string())))})
+    df = sess.create_dataframe(t)
+    out = df.select(F.flatten(df.a).alias("f")).collect()
+    assert out["f"].to_pylist() == [["x", "yy", "z"], []]

@@ -272,3 +272,37 @@ def test_dropduplicates_subset(sess):
         "v": pa.array(["x", "y", "z"])}))
     out = df.dropDuplicates(["k"]).collect()
     assert out.num_rows == 2
+
+
+# --- pivot (PivotFirst lowering) -------------------------------------------
+
+def test_pivot_infers_values():
+    sess = srt.session()
+    df = sess.create_dataframe(pa.table({
+        "y": [1, 1, 2, 2, 2], "q": ["a", "b", "a", "a", "b"],
+        "v": [10.0, 20.0, 30.0, 5.0, 40.0]}), num_partitions=2)
+    out = (df.groupBy("y").pivot("q").agg(F.sum(F.col("v")))
+           .orderBy("y").collect().to_pylist())
+    assert out == [{"y": 1, "a": 10.0, "b": 20.0},
+                   {"y": 2, "a": 35.0, "b": 40.0}]
+
+
+def test_pivot_explicit_values_multi_agg():
+    sess = srt.session()
+    df = sess.create_dataframe(pa.table({
+        "y": [1, 1, 2], "q": ["a", "b", "a"], "v": [1.0, 2.0, 3.0]}))
+    out = (df.groupBy("y").pivot("q", ["a"])
+           .agg(F.sum(F.col("v")).alias("s"), F.count("*").alias("c"))
+           .orderBy("y").collect().to_pylist())
+    assert out == [{"y": 1, "a_s": 1.0, "a_c": 1},
+                   {"y": 2, "a_s": 3.0, "a_c": 1}]
+
+
+def test_pivot_missing_combination_is_null():
+    sess = srt.session()
+    df = sess.create_dataframe(pa.table({
+        "y": [1, 2], "q": ["a", "b"], "v": [1.0, 2.0]}))
+    out = (df.groupBy("y").pivot("q", ["a", "b"]).agg(F.sum(F.col("v")))
+           .orderBy("y").collect().to_pylist())
+    assert out == [{"y": 1, "a": 1.0, "b": None},
+                   {"y": 2, "a": None, "b": 2.0}]

@@ -322,3 +322,50 @@ def test_xxhash64_long_known():
     t = pa.table({"l": pa.array(longs, type=pa.int64())})
     got = eval_both(H.XxHash64(attr("l", T.LONG)), t)
     assert got == [xxh64_long(v) for v in longs]
+
+
+from spark_rapids_tpu.sql import functions as F  # noqa: E402
+
+
+# --- task-context leaf expressions -----------------------------------------
+
+def test_spark_partition_id_and_mono_id(session):
+    df = session.create_dataframe(pa.table({"x": list(range(20))}),
+                               num_partitions=4)
+    out = df.select(df.x, F.spark_partition_id().alias("p"),
+                    F.monotonically_increasing_id().alias("m")).collect()
+    assert set(out["p"].to_pylist()) == {0, 1, 2, 3}
+    ms = out["m"].to_pylist()
+    assert len(set(ms)) == 20
+    # id layout: partition in the high bits
+    for p, m in zip(out["p"].to_pylist(), ms):
+        assert m >> 33 == p
+
+
+def test_rand_deterministic_per_seed(session):
+    df = session.create_dataframe(pa.table({"x": list(range(100))}),
+                               num_partitions=2)
+    a = df.select(F.rand(7).alias("r")).collect()["r"].to_pylist()
+    b = df.select(F.rand(7).alias("r")).collect()["r"].to_pylist()
+    assert a == b  # same seed -> same stream
+    c = df.select(F.rand(8).alias("r")).collect()["r"].to_pylist()
+    assert a != c
+    assert all(0.0 <= v < 1.0 for v in a)
+
+
+def test_unscaled_value_and_make_decimal(session):
+    import decimal as D
+    from spark_rapids_tpu.sql.expressions.arithmetic import (MakeDecimal,
+                                                             UnscaledValue)
+    from spark_rapids_tpu.sql.dataframe import Column
+    t = pa.table({"d": pa.array([D.Decimal("12.34"), D.Decimal("-0.01"),
+                                 None], type=pa.decimal128(9, 2))})
+    df = session.create_dataframe(t)
+    out = df.select(Column(UnscaledValue(df.d.expr)).alias("u")).collect()
+    assert out["u"].to_pylist() == [1234, -1, None]
+    df2 = session.create_dataframe(pa.table({"l": pa.array([1234, -1, None],
+                                                        type=pa.int64())}))
+    back = df2.select(Column(MakeDecimal(df2.l.expr, 9, 2)).alias("d")) \
+        .collect()
+    assert back["d"].to_pylist() == [D.Decimal("12.34"),
+                                     D.Decimal("-0.01"), None]
