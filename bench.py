@@ -150,6 +150,19 @@ def main():
     wd.daemon = True
     wd.start()
 
+    # Persistent XLA compilation cache: first-compile on the TPU tunnel
+    # costs 20-40s per program; caching under the repo amortizes it across
+    # driver runs (harmless no-op where unsupported).
+    try:
+        import jax
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     # Local-dev override: the ambient sitecustomize forces the axon tunnel
     # platform via jax.config (env vars can't override it).  The driver
     # leaves this unset so the real chip is used.

@@ -70,18 +70,20 @@ def slot_index_map(xp, perm, r_s, pos, keep_mask, OUT: int, W: int):
     ok = keep_mask & (r_s < OUT) & (pos < W)
     tgt = xp.where(ok, flat, OUT * W)  # OOB scatters drop
     slot_source = xp.zeros(OUT * W, dtype=xp.int32).at[tgt].set(
-        perm) if xp.__name__ != "numpy" else _np_scatter(
+        perm) if xp.__name__ != "numpy" else np_scatter_set(
         np.zeros(OUT * W, dtype=np.int32), tgt, perm)
     sv = xp.zeros(OUT * W, dtype=bool)
     ones = xp.ones(cap, dtype=bool)
     slot_valid = sv.at[tgt].set(ones) if xp.__name__ != "numpy" else \
-        _np_scatter(np.zeros(OUT * W, dtype=bool), tgt, ones)
+        np_scatter_set(np.zeros(OUT * W, dtype=bool), tgt, ones)
     return slot_source, slot_valid
 
 
-def _np_scatter(out, idx, vals):
+def np_scatter_set(out, idx, vals, bound=None):
+    """Bounded numpy scatter-set with drop semantics (the one shared
+    masking-scatter helper; jnp paths rely on XLA's high-side drop)."""
     idx = np.asarray(idx)
-    m = idx < out.shape[0]
+    m = idx < (out.shape[0] if bound is None else bound)
     out[idx[m]] = np.asarray(vals)[m]
     return out
 
@@ -117,7 +119,6 @@ def collect_into_arrays(xp, value_col: DeviceColumn, rank, contrib,
         dup = same_group & eq_prev
         keep = keep & ~dup
         # recompute dense positions over survivors
-        sidx = xp.arange(r_s.shape[0], dtype=xp.int64)
         kept_before = xp.cumsum(keep.astype(xp.int64)) - keep.astype(xp.int64)
         seg_start_kept = _cummax(
             xp, xp.where(is_start, kept_before, 0))

@@ -3,10 +3,11 @@ cuDF's hash-based ``Table.groupBy().aggregate(...)`` (reference
 ``aggregate.scala`` AggHelper).  Works under jnp (scatter-add lowered by XLA)
 and numpy (ufunc.at).
 
-Out-of-bounds segment ids are DROPPED on both backends (XLA scatter
-semantics; the numpy paths mask explicitly) — callers rely on this to park
-dead rows at ``capacity - 1``/``capacity`` while reducing into small
-``num_segments`` tables."""
+Out-of-bounds segment ids are DROPPED on both backends — callers rely on
+this to park dead rows at ``capacity - 1``/``capacity`` while reducing into
+small ``num_segments`` tables.  XLA scatter drops only the HIGH side
+(negative indices wrap), so the jnp paths remap negatives to
+``num_segments`` first; the numpy paths mask both sides explicitly."""
 
 from __future__ import annotations
 
@@ -18,13 +19,19 @@ def _inb(seg_ids, num_segments):
     return ids, (ids >= 0) & (ids < num_segments)
 
 
+def _nowrap(xp, seg_ids, num_segments):
+    """jnp scatters WRAP negative indices; remap them out of bounds so
+    they drop like the numpy paths."""
+    return xp.where(seg_ids < 0, num_segments, seg_ids)
+
+
 def seg_sum(xp, data, seg_ids, num_segments, dtype=None):
     out = xp.zeros((num_segments,), dtype=dtype or data.dtype)
     if xp.__name__ == "numpy":
         ids, m = _inb(seg_ids, num_segments)
         np.add.at(out, ids[m], np.asarray(data.astype(out.dtype))[m])
         return out
-    return out.at[seg_ids].add(data.astype(out.dtype))
+    return out.at[_nowrap(xp, seg_ids, num_segments)].add(data.astype(out.dtype))
 
 
 def seg_min(xp, data, seg_ids, num_segments, init):
@@ -33,7 +40,7 @@ def seg_min(xp, data, seg_ids, num_segments, init):
         ids, m = _inb(seg_ids, num_segments)
         np.minimum.at(out, ids[m], np.asarray(data)[m])
         return out
-    return out.at[seg_ids].min(data)
+    return out.at[_nowrap(xp, seg_ids, num_segments)].min(data)
 
 
 def seg_max(xp, data, seg_ids, num_segments, init):
@@ -42,7 +49,7 @@ def seg_max(xp, data, seg_ids, num_segments, init):
         ids, m = _inb(seg_ids, num_segments)
         np.maximum.at(out, ids[m], np.asarray(data)[m])
         return out
-    return out.at[seg_ids].max(data)
+    return out.at[_nowrap(xp, seg_ids, num_segments)].max(data)
 
 
 def seg_sum2(xp, data2, seg_ids, num_segments):
@@ -53,7 +60,7 @@ def seg_sum2(xp, data2, seg_ids, num_segments):
         ids, m = _inb(seg_ids, num_segments)
         np.add.at(out, ids[m], np.asarray(data2)[m])
         return out
-    return out.at[seg_ids].add(data2)
+    return out.at[_nowrap(xp, seg_ids, num_segments)].add(data2)
 
 
 def seg_min2(xp, data2, seg_ids, num_segments, init):
@@ -62,7 +69,7 @@ def seg_min2(xp, data2, seg_ids, num_segments, init):
         ids, m = _inb(seg_ids, num_segments)
         np.minimum.at(out, ids[m], np.asarray(data2)[m])
         return out
-    return out.at[seg_ids].min(data2)
+    return out.at[_nowrap(xp, seg_ids, num_segments)].min(data2)
 
 
 def seg_max2(xp, data2, seg_ids, num_segments, init):
@@ -71,7 +78,7 @@ def seg_max2(xp, data2, seg_ids, num_segments, init):
         ids, m = _inb(seg_ids, num_segments)
         np.maximum.at(out, ids[m], np.asarray(data2)[m])
         return out
-    return out.at[seg_ids].max(data2)
+    return out.at[_nowrap(xp, seg_ids, num_segments)].max(data2)
 
 
 def seg_any(xp, mask, seg_ids, num_segments):

@@ -446,6 +446,12 @@ class ApproximatePercentile(_ShuffleCompleteAggregate):
     def max_width(self, max_group_count: int) -> int:
         return 1 if self._scalar else len(self.percentages)
 
+    def tag_for_device(self, conf=None):
+        dt = self.children[0].data_type
+        if not T.is_numeric(dt):
+            return "approx_percentile requires a numeric column"
+        return None
+
     def pretty_name(self):
         return "approx_percentile"
 

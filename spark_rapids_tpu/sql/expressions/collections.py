@@ -1264,7 +1264,6 @@ class Flatten(Expression):
         slot_source = xp.zeros(cap * wo, dtype=xp.int32)
         slot_valid = xp.zeros(cap * wo, dtype=bool)
         if xp.__name__ == "numpy":
-            import numpy as _np
             m = flat_tgt < cap * wo
             slot_source[flat_tgt[m]] = src.reshape(-1)[m]
             slot_valid[flat_tgt[m]] = True
@@ -1276,3 +1275,147 @@ class Flatten(Expression):
         return make_array_column(self.data_type,
                                  xp.where(row_valid, total, 0), (elem,),
                                  row_valid)
+
+
+class GetArrayStructFields(Expression):
+    """arr_of_structs.field -> array of field values (Catalyst
+    GetArrayStructFields; reference ``complexTypeExtractors.scala``).
+    Slot layout makes this a metadata operation: the output array shares
+    the parent's lengths and the struct child's field column becomes the
+    element (validity ANDed with the struct slots')."""
+
+    def __init__(self, child, ordinal: int, name: Optional[str] = None):
+        self.children = (resolve_expression(child),)
+        self.ordinal = int(ordinal)
+        self.name = name
+
+    def with_children(self, children):
+        return GetArrayStructFields(children[0], self.ordinal, self.name)
+
+    def _key_extras(self):
+        return (self.ordinal,)
+
+    @property
+    def data_type(self):
+        et = self.children[0].data_type.element_type
+        return T.ArrayType(et.fields[self.ordinal].data_type)
+
+    def tag_for_device(self, conf=None):
+        et = self.children[0].data_type
+        if not (isinstance(et, T.ArrayType)
+                and isinstance(et.element_type, T.StructType)):
+            return "input is not array<struct<...>>"
+        return None
+
+    def sql(self):
+        return f"{self.children[0].sql()}.{self.name or self.ordinal}"
+
+    def kernel(self, ctx, c):
+        struct_elem = c.children[0]
+        f = struct_elem.children[self.ordinal]
+        elem = f.with_validity(f.validity & struct_elem.validity)
+        return make_array_column(self.data_type, c.lengths, (elem,),
+                                 c.validity)
+
+
+class MapConcat(Expression):
+    """map_concat(m1, m2, ...) (reference GpuMapConcat,
+    ``collectionOperations.scala``).  Entries concatenate left-to-right
+    via a flatten-style slot remap.  NOTE: Spark's default
+    EXCEPTION-on-duplicate-key policy is not enforced on the device (the
+    reference documents the same class of divergence for map ops); with
+    duplicate keys the result keeps both entries, and lookups hit the
+    FIRST, matching LAST_WIN only when later maps don't collide."""
+
+    def __init__(self, *maps):
+        self.children = tuple(resolve_expression(m) for m in maps)
+
+    def with_children(self, children):
+        return MapConcat(*children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type if self.children else T.NULL
+
+    def tag_for_device(self, conf=None):
+        if not self.children:
+            return "map_concat() needs at least one argument"
+        return None
+
+    def kernel(self, ctx, *cols):
+        xp = ctx.xp
+        cap = cols[0].capacity
+        widths = [c.array_width for c in cols]
+        wo = bucket_width(sum(widths))
+        total = cols[0].lengths
+        valid = cols[0].validity
+        for c in cols[1:]:
+            total = total + c.lengths
+            valid = valid & c.validity
+        total = xp.minimum(total, wo)
+        # per input map: entry j of row r lands at offset(prev maps) + j
+        n_children = len(cols[0].children)  # (keys, values)
+        slot_valid = xp.zeros(cap * wo, dtype=bool)
+        slot_source = xp.zeros(cap * wo, dtype=xp.int32)
+        base = xp.zeros(cap, dtype=xp.int32)
+        offset_elems = 0
+        for c, w in zip(cols, widths):
+            j = xp.arange(w, dtype=xp.int32)[None, :]
+            in_r = j < c.lengths[:, None]
+            tgt = (xp.arange(cap, dtype=xp.int32)[:, None] * wo
+                   + base[:, None] + j)
+            tgt = xp.where(in_r & (base[:, None] + j < wo), tgt, cap * wo)
+            src = (offset_elems
+                   + xp.arange(cap, dtype=xp.int32)[:, None] * w + j)
+            slot_source = slot_source.at[tgt.reshape(-1)].set(
+                src.reshape(-1)) if xp.__name__ != "numpy" else \
+                _np_set(slot_source, tgt.reshape(-1), src.reshape(-1),
+                        cap * wo)
+            slot_valid = slot_valid.at[tgt.reshape(-1)].set(
+                xp.ones(cap * w, dtype=bool)) if xp.__name__ != "numpy" \
+                else _np_set(slot_valid, tgt.reshape(-1),
+                             np.ones(cap * w, dtype=bool), cap * wo)
+            base = base + c.lengths
+            offset_elems += cap * w
+        out_children = []
+        for ci in range(n_children):
+            stacked = _concat_child_slots(xp, [c.children[ci]
+                                               for c in cols])
+            out_children.append(stacked.gather(slot_source, slot_valid))
+        return make_array_column(self.data_type,
+                                 xp.where(valid, total, 0),
+                                 tuple(out_children), valid)
+
+
+def _np_set(out, idx, vals, bound):
+    from ...ops.collect_ops import np_scatter_set
+    return np_scatter_set(out, idx, vals, bound)
+
+
+def _concat_child_slots(xp, children):
+    """Concatenate element-child columns along capacity so one gather can
+    address any input's slots by global index."""
+    if len(children) == 1:
+        return children[0]
+    from ...columnar.column import DeviceColumn as DC
+    vals = [c.validity for c in children]
+    first = children[0]
+    datas = [c.data for c in children]
+    if first.data is not None and first.data.ndim == 2:
+        # string byte-matrices: pad every input to the widest
+        wmax = max(int(d.shape[1]) for d in datas)
+        datas = [xp.pad(d, ((0, 0), (0, wmax - d.shape[1])))
+                 if d.shape[1] < wmax else d for d in datas]
+    data = xp.concatenate(datas, axis=0) if first.data is not None else None
+    validity = xp.concatenate(vals, axis=0)
+    lengths = (xp.concatenate([c.lengths for c in children])
+               if first.lengths is not None else None)
+    aux = (xp.concatenate([c.aux for c in children])
+           if first.aux is not None else None)
+    kids = ()
+    if first.children:
+        kids = tuple(_concat_child_slots(xp, [c.children[i]
+                                              for c in children])
+                     for i in range(len(first.children)))
+    return DC(first.dtype, data, validity, lengths=lengths, aux=aux,
+              children=kids)

@@ -32,18 +32,22 @@ def _task():
 
 def _batch_row_offset(t, ctx: EvalContext) -> int:
     """Offset of this batch's first row within the task's partition.
-    Keyed by batch identity so EVERY expression evaluating over the same
-    batch sees the same offset (Spark: two monotonically_increasing_id()
-    columns in one select are identical)."""
+    Memoized ON the batch object (not keyed by id(), which CPython reuses
+    after GC) so EVERY expression evaluating over the same batch sees the
+    same offset (Spark: two monotonically_increasing_id() columns in one
+    select are identical)."""
+    cached = getattr(ctx.batch, "_ctx_row_offset", None)
+    if cached is not None:
+        return cached
     n = int(ctx.batch.num_rows_int if hasattr(ctx.batch, "num_rows_int")
             else ctx.batch.num_rows)
-    state = getattr(t, "_row_offset_state", None)
-    bid = id(ctx.batch)
-    if state is not None and state[0] == bid:
-        return state[1]
-    next_off = state[2] if state is not None else 0
-    t._row_offset_state = (bid, next_off, next_off + n)
-    return next_off
+    off = getattr(t, "_ctx_next_offset", 0)
+    t._ctx_next_offset = off + n
+    try:
+        ctx.batch._ctx_row_offset = off
+    except AttributeError:  # pragma: no cover - frozen batch variants
+        pass
+    return off
 
 
 def _const_column(ctx: EvalContext, dtype, value) -> DeviceColumn:

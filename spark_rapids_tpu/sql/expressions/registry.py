@@ -113,3 +113,5 @@ EXPRESSION_REGISTRY["SpecifiedWindowFrame"] = _WindowFrame
 _reg(Agg.CollectList, Agg.CollectSet, Agg.ApproximatePercentile)
 
 _reg(Col.Flatten, A.UnscaledValue, A.MakeDecimal)
+
+_reg(Col.GetArrayStructFields, Col.MapConcat)

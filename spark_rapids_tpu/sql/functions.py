@@ -817,3 +817,7 @@ approx_percentile = percentile_approx
 def flatten(c) -> Column:
     """array<array<T>> -> array<T> (one nesting level removed)."""
     return Column(CL.Flatten(_c(c)))
+
+
+def map_concat(*cols) -> Column:
+    return Column(CL.MapConcat(*[_c(c) for c in cols]))

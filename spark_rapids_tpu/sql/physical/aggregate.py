@@ -613,14 +613,20 @@ class HashAggregateExec(PhysicalPlan):
         batches = list(child.execute(pid, tctx))
         batches = [b for b in batches if b.num_rows_int > 0]
         if not batches:
-            yield self._empty_output()
-            return
+            if self.grouping:
+                yield self._empty_output()
+                return
+            # global aggregate over empty input: one row (empty arrays /
+            # null percentiles / zero counts) — run the kernel on an
+            # empty batch; _ShuffleCompleteAggregate can't finalize from
+            # scalar slots so _empty_output's path would raise
+            from .exchange import empty_batch_for
+            batches = [empty_batch_for(child.output)]
         merged = ColumnarBatch.concat(batches) if len(batches) > 1 \
             else batches[0]
         tctx.inc_metric("aggSpecialBatches")
         if self.backend != TPU:
             # eager numpy path: exact sizes, no bucketing needed
-            import numpy as np_
             mask = np.asarray(merged.row_mask()) \
                 if hasattr(merged, "row_mask") else None
             b2 = merged
@@ -640,7 +646,7 @@ class HashAggregateExec(PhysicalPlan):
         ng0 = int(ng)  # ONE sync; global aggregates already floored to 1
         maxc = self._max_group_count(self.xp, rank64, mask,
                                      batch2.capacity)
-        OUT = min(bucket_capacity(max(ng0, 1), minimum=64),
+        OUT = min(bucket_capacity(max(ng0, 1), minimum=1),
                   batch2.capacity)
         widths = {fi: bucket_width(
             max(self._agg_funcs[fi].max_width(maxc), 1))

@@ -134,3 +134,30 @@ def test_collect_cpu_oracle_agrees(sess):
     assert (a["p"].values == b["p"].values).all()
     for g in a.index:
         assert sorted(a.loc[g, "s"]) == sorted(b.loc[g, "s"])
+
+
+def test_global_collect_over_empty_input(sess):
+    df = sess.create_dataframe(pa.table({
+        "v": pa.array([], type=pa.float64())}))
+    out = df.agg(F.collect_list(df.v).alias("l"),
+                 F.percentile_approx(df.v, 0.5).alias("p")).collect()
+    assert out.num_rows == 1
+    assert out["l"].to_pylist() == [[]]
+    assert out["p"].to_pylist() == [None]
+
+
+def test_grouped_collect_over_empty_input(sess):
+    df = sess.create_dataframe(pa.table({
+        "k": pa.array([], type=pa.int64()),
+        "v": pa.array([], type=pa.float64())}))
+    out = df.groupBy("k").agg(F.collect_list(df.v).alias("l")).collect()
+    assert out.num_rows == 0
+
+
+def test_percentile_non_numeric_falls_back_to_host(sess):
+    df = sess.create_dataframe(pa.table({"k": [1], "s": ["x"]}))
+    q = df.groupBy("k").agg(
+        F.percentile_approx(F.col("s"), 0.5).alias("p"))
+    assert "CpuHashAggregate" in sess.explain(q)
+    # and the host engine still answers (single string = its own median)
+    assert q.collect().to_pylist() == [{"k": 1, "p": "x"}]

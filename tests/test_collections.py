@@ -344,3 +344,30 @@ def test_flatten_strings(sess):
     df = sess.create_dataframe(t)
     out = df.select(F.flatten(df.a).alias("f")).collect()
     assert out["f"].to_pylist() == [["x", "yy", "z"], []]
+
+
+def test_map_concat(sess):
+    t = pa.table({
+        "m1": pa.array([{"a": 1}, {"b": 2}, None],
+                       type=pa.map_(pa.string(), pa.int64())),
+        "m2": pa.array([{"c": 3}, {}, {"d": 4}],
+                       type=pa.map_(pa.string(), pa.int64()))})
+    df = sess.create_dataframe(t)
+    out = df.select(F.map_concat(df.m1, df.m2).alias("m")).collect()
+    assert out["m"].to_pylist() == [[("a", 1), ("c", 3)], [("b", 2)], None]
+
+
+def test_get_array_struct_fields(sess):
+    from spark_rapids_tpu.sql.expressions.collections import \
+        GetArrayStructFields
+    from spark_rapids_tpu.sql.dataframe import Column
+    t = pa.table({"a": pa.array(
+        [[{"x": 1, "y": "p"}, {"x": None, "y": "q"}], [], None],
+        type=pa.list_(pa.struct([("x", pa.int64()), ("y", pa.string())])))})
+    df = sess.create_dataframe(t)
+    out = df.select(
+        Column(GetArrayStructFields(df.a.expr, 1, "y")).alias("ys"),
+        Column(GetArrayStructFields(df.a.expr, 0, "x")).alias("xs"),
+    ).collect()
+    assert out["ys"].to_pylist() == [["p", "q"], [], None]
+    assert out["xs"].to_pylist() == [[1, None], [], None]

@@ -90,3 +90,15 @@ def test_shim_version_ranges():
     assert JaxModernShim.matches((0, 6, 0))
     assert JaxModernShim.matches((0, 7, 1))
     assert not JaxModernShim.matches((0, 5, 9))
+
+
+def test_api_validation_contract_clean():
+    """api_validation analog (reference ApiValidation.scala): the current
+    build satisfies its recorded exec/expression contract and the running
+    jax exposes every entry point the shims lean on."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import api_validation as av
+    problems = av.check()
+    assert problems == [], problems
