@@ -359,6 +359,15 @@ class RapidsConf:
             return self._values[key]
         return self._extra.get(key, default)
 
+    def get_bool(self, key: str, default: bool = True) -> bool:
+        """Boolean read of a possibly-unregistered key (per-expression /
+        per-exec enable flags are dynamic: one per registered rule, like
+        the reference's auto-generated conf-per-rule entries)."""
+        raw = self.get(key, default)
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).strip().lower() in ("true", "1", "yes")
+
     def set(self, key: str, value: Any) -> "RapidsConf":
         if key in ENTRIES:
             self._values[key] = ENTRIES[key].convert(value)

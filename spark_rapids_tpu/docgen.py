@@ -87,6 +87,24 @@ def operators_score_csv() -> str:
     return "\n".join(rows) + "\n"
 
 
+def per_rule_flags_md() -> str:
+    """One enable flag per registered expression/exec rule — the analog of
+    the reference auto-generating a ``spark.rapids.sql.expression.*`` /
+    ``.exec.*`` conf per GpuOverrides rule; all honored by the tagging
+    layer (overrides.py) via ``RapidsConf.get_bool``."""
+    from .sql.expressions.registry import EXPRESSION_REGISTRY
+    from .sql.overrides import _EXEC_ENABLE_KEYS
+    lines = ["", "## Per-rule enable flags", "",
+             "Each registered rule has a boolean enable flag (default "
+             "true); setting it false forces that op to the host engine.",
+             "", "Name | Default", "-----|--------"]
+    for key in sorted(set(_EXEC_ENABLE_KEYS.values())):
+        lines.append(f"{key} | true")
+    for name in sorted(EXPRESSION_REGISTRY):
+        lines.append(f"spark.rapids.sql.expression.{name} | true")
+    return "\n".join(lines) + "\n"
+
+
 def generate(root: str) -> List[str]:
     docs = os.path.join(root, "docs")
     tools = os.path.join(root, "tools", "generated_files")
@@ -94,9 +112,10 @@ def generate(root: str) -> List[str]:
     os.makedirs(tools, exist_ok=True)
     written = []
     for path, content in [
-        (os.path.join(docs, "configs.md"), help_text()),
+        (os.path.join(docs, "configs.md"),
+         help_text() + per_rule_flags_md()),
         (os.path.join(docs, "advanced_configs.md"),
-         help_text(include_internal=True)),
+         help_text(include_internal=True) + per_rule_flags_md()),
         (os.path.join(docs, "supported_ops.md"), supported_ops_md()),
         (os.path.join(tools, "supportedExprs.csv"), supported_exprs_csv()),
         (os.path.join(tools, "operatorsScore.csv"), operators_score_csv()),

@@ -1297,14 +1297,22 @@ class GetArrayStructFields(Expression):
 
     @property
     def data_type(self):
-        et = self.children[0].data_type.element_type
-        return T.ArrayType(et.fields[self.ordinal].data_type)
+        # planning reads output dtypes BEFORE tag_for_device runs; a
+        # malformed input must fall back gracefully, not crash here
+        dt = self.children[0].data_type
+        if (isinstance(dt, T.ArrayType)
+                and isinstance(dt.element_type, T.StructType)
+                and self.ordinal < len(dt.element_type.fields)):
+            return T.ArrayType(
+                dt.element_type.fields[self.ordinal].data_type)
+        return T.NULL
 
     def tag_for_device(self, conf=None):
         et = self.children[0].data_type
         if not (isinstance(et, T.ArrayType)
-                and isinstance(et.element_type, T.StructType)):
-            return "input is not array<struct<...>>"
+                and isinstance(et.element_type, T.StructType)
+                and self.ordinal < len(et.element_type.fields)):
+            return "input is not array<struct<...>> with that field"
         return None
 
     def sql(self):

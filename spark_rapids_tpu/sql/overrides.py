@@ -33,17 +33,35 @@ _HOST_ONLY_EXPRS = {"RaiseError"}
 
 # config kill-switches per exec family (subset of the reference's
 # spark.rapids.sql.exec.* flags)
+#: per-exec enable flags keyed by logical node, named after the Spark
+#: exec class the reference's rule covers (GpuOverrides auto-generates
+#: one ``spark.rapids.sql.exec.*`` conf per exec rule)
 _EXEC_ENABLE_KEYS = {
     "Project": "spark.rapids.sql.exec.ProjectExec",
     "Filter": "spark.rapids.sql.exec.FilterExec",
     "Aggregate": "spark.rapids.sql.exec.HashAggregateExec",
     "Sort": "spark.rapids.sql.exec.SortExec",
     "Join": "spark.rapids.sql.exec.ShuffledHashJoinExec",
+    "Range": "spark.rapids.sql.exec.RangeExec",
+    "Union": "spark.rapids.sql.exec.UnionExec",
+    "Expand": "spark.rapids.sql.exec.ExpandExec",
+    "Sample": "spark.rapids.sql.exec.SampleExec",
+    "Limit": "spark.rapids.sql.exec.GlobalLimitExec",
+    "Window": "spark.rapids.sql.exec.WindowExec",
+    "Generate": "spark.rapids.sql.exec.GenerateExec",
+    "Repartition": "spark.rapids.sql.exec.ShuffleExchangeExec",
+    "ScanRelation": "spark.rapids.sql.exec.FileSourceScanExec",
+    "MapInPandas": "spark.rapids.sql.exec.MapInPandasExec",
+    "FlatMapGroupsInPandas": "spark.rapids.sql.exec.FlatMapGroupsInPandasExec",
+    "FlatMapCoGroupsInPandas":
+        "spark.rapids.sql.exec.FlatMapCoGroupsInPandasExec",
+    "AggregateInPandas": "spark.rapids.sql.exec.AggregateInPandasExec",
 }
 
 _SUPPORTED_AGGS = (AGG.Sum, AGG.Count, AGG.Min, AGG.Max, AGG.Average,
                    AGG.First, AGG.Last, AGG.StddevPop, AGG.StddevSamp,
-                   AGG.VariancePop, AGG.VarianceSamp)
+                   AGG.VariancePop, AGG.VarianceSamp, AGG.CollectList,
+                   AGG.CollectSet, AGG.ApproximatePercentile)
 
 
 class ExprMeta:
@@ -62,9 +80,20 @@ class ExprMeta:
         if isinstance(e, (AttributeReference, BoundReference, Literal, Alias)):
             pass
         elif isinstance(e, AGG.AggregateExpression):
+            fname = type(e.func).__name__
             if not isinstance(e.func, _SUPPORTED_AGGS):
                 self.will_not_work(
-                    f"aggregate {type(e.func).__name__} is not supported on TPU")
+                    f"aggregate {fname} is not supported on TPU")
+            elif not self.conf.get_bool(
+                    f"spark.rapids.sql.expression.{fname}", True):
+                self.will_not_work(
+                    f"aggregate {fname} disabled by "
+                    f"spark.rapids.sql.expression.{fname}")
+            elif hasattr(e.func, "tag_for_device"):
+                reason = e.func.tag_for_device(self.conf)
+                if reason:
+                    self.will_not_work(
+                        f"{type(e.func).__name__}: {reason}")
             if e.is_distinct:
                 self.will_not_work("DISTINCT aggregates are not yet supported "
                                    "on TPU")
@@ -72,10 +101,26 @@ class ExprMeta:
             if not isinstance(e, _SUPPORTED_AGGS):
                 self.will_not_work(
                     f"aggregate {cls_name} is not supported on TPU")
+            elif not self.conf.get_bool(
+                    f"spark.rapids.sql.expression.{cls_name}", True):
+                self.will_not_work(
+                    f"aggregate {cls_name} disabled by "
+                    f"spark.rapids.sql.expression.{cls_name}")
+            elif hasattr(e, "tag_for_device"):
+                reason = e.tag_for_device(self.conf)
+                if reason:
+                    self.will_not_work(f"{cls_name}: {reason}")
         elif cls_name not in EXPRESSION_REGISTRY:
             self.will_not_work(f"expression {cls_name} is not supported on TPU")
         elif cls_name in _HOST_ONLY_EXPRS:
             self.will_not_work(f"expression {cls_name} runs on the host only")
+        elif not self.conf.get_bool(
+                f"spark.rapids.sql.expression.{cls_name}", True):
+            # per-expression enable flag (reference: one auto-generated
+            # conf per expr rule, honored by GpuOverrides tagging)
+            self.will_not_work(
+                f"expression {cls_name} disabled by "
+                f"spark.rapids.sql.expression.{cls_name}")
         elif hasattr(e, "tag_for_device"):
             # per-expression device-capability hook (literal-only args,
             # ASCII-only patterns, timezone checks, host-exact long-tail
@@ -154,7 +199,7 @@ class PlanMeta:
         if not self.conf.is_sql_enabled:
             self.will_not_work("spark.rapids.sql.enabled is false")
         key = _EXEC_ENABLE_KEYS.get(type(self.node).__name__)
-        if key and str(self.conf.get(key, "true")).lower() == "false":
+        if key and not self.conf.get_bool(key, True):
             self.will_not_work(f"{key} is disabled")
         # output AND input schema types must have a device layout (the
         # reference's ExecChecks covers input attributes the same way)

@@ -646,7 +646,11 @@ class HashAggregateExec(PhysicalPlan):
         ng0 = int(ng)  # ONE sync; global aggregates already floored to 1
         maxc = self._max_group_count(self.xp, rank64, mask,
                                      batch2.capacity)
-        OUT = min(bucket_capacity(max(ng0, 1), minimum=1),
+        # grouped queries keep the 64-group floor so fluctuating group
+        # counts share one compiled program (OUT is in the jit key; TPU
+        # first-compile is 20-40s); the global path sizes exactly
+        OUT = min(bucket_capacity(max(ng0, 1),
+                                  minimum=64 if self.grouping else 1),
                   batch2.capacity)
         widths = {fi: bucket_width(
             max(self._agg_funcs[fi].max_width(maxc), 1))

@@ -102,3 +102,32 @@ def test_api_validation_contract_clean():
     import api_validation as av
     problems = av.check()
     assert problems == [], problems
+
+
+def test_per_rule_enable_flags():
+    """Per-expression and per-exec enable flags force host placement
+    (reference: auto-generated conf per GpuOverrides rule)."""
+    import pyarrow as pa
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql import functions as F
+    try:
+        s = srt.session(**{"spark.rapids.sql.expression.Upper": False})
+        df = s.create_dataframe(pa.table({"s": ["ab"]}))
+        q = df.select(F.upper(df.s).alias("u"))
+        assert "disabled" in s.explain(q)
+        assert q.collect()["u"].to_pylist() == ["AB"]  # host still answers
+        s2 = srt.session(**{"spark.rapids.sql.exec.ProjectExec": False})
+        df2 = s2.create_dataframe(pa.table({"x": [1]}))
+        assert "disabled" in s2.explain(df2.select((df2.x + 1).alias("y")))
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
+
+
+def test_collect_aggs_planned_on_device():
+    import pyarrow as pa
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql import functions as F
+    s = srt.session()
+    df = s.create_dataframe(pa.table({"k": [1], "v": [1.0]}))
+    ex = s.explain(df.groupBy("k").agg(F.collect_list(df.v).alias("l")))
+    assert "TpuHashAggregate" in ex
