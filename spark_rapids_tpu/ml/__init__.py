@@ -1,0 +1,84 @@
+"""ML handoff — the ``ColumnarRdd`` / ``InternalColumnarRddConverter``
+analog (reference ``ColumnarRdd.scala``, ``README.md:48-56``,
+``org/apache/spark/sql/rapids/execution/InternalColumnarRddConverter.scala:611``;
+BASELINE milestone 5 "accelerated XGBoost handoff").
+
+The reference exports a query's GPU columnar batches to ML frameworks
+without bouncing through rows.  Here the analog is stronger: engine
+batches already ARE jax device arrays, so the handoff is zero-copy by
+construction — a query's output flows straight into jax/flax/optax
+training without leaving the device.
+
+* :func:`columnar_rdd` — per-partition device ``ColumnarBatch`` list, the
+  raw export (GpuBringBackToHost never inserted).
+* :func:`to_features` — (X, y) dense jax matrices for model training:
+  live rows only, features column-stacked, one configurable dtype.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..columnar.batch import ColumnarBatch
+
+
+def columnar_rdd(df) -> List[ColumnarBatch]:
+    """Execute ``df`` and return its DEVICE batches (one per partition,
+    jax-array columns, padded layout preserved).  The planner runs the
+    normal placement pipeline but skips the final DeviceToHost transition
+    (``GpuBringBackToHost`` analog stays out of the plan)."""
+    from ..sql.planner import Planner
+    from ..sql.physical.base import TPU
+
+    session = df._session
+    planner = Planner(session._conf)
+    phys = planner.plan(df._plan)
+    if phys.backend != TPU:
+        raise ValueError(
+            "columnar_rdd requires the query to end on the device; the "
+            f"plan ends on {phys.backend} — check session.explain(df)")
+    return [b for b in phys.execute_all(session._conf)
+            if b.num_rows_int > 0]
+
+
+def to_features(df, feature_cols: Sequence[str],
+                label_col: Optional[str] = None, dtype=None
+                ) -> Tuple:
+    """Dense (X, y) jax arrays from a query's device output: X is
+    ``[n_rows, n_features]``, y is ``[n_rows]`` (None when no label
+    column is named).  Rows are compacted (padding stripped); features
+    cast to ``dtype`` (default float32, the TPU-native width)."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+    cols = list(feature_cols) + ([label_col] if label_col else [])
+    batches = columnar_rdd(df.select(*cols))
+    if not batches:
+        empty = jnp.zeros((0, len(feature_cols)), dtype=dtype)
+        return empty, (jnp.zeros((0,), dtype=dtype) if label_col else None)
+    xs, ys = [], []
+    for b in batches:
+        n = b.num_rows_int
+        name_to_col = dict(zip(b.names, b.columns))
+
+        def dense(name):
+            col = name_to_col[name]
+            if col.data is None or col.data.ndim != 1:
+                raise ValueError(f"column {name!r} is not numeric")
+            if col.validity is not None and not bool(
+                    col.validity[:n].all()):
+                # silent 0.0-for-NULL would corrupt training data
+                raise ValueError(
+                    f"column {name!r} contains NULLs — filter or fill "
+                    "them in the query before the handoff")
+            return col.data[:n].astype(dtype)
+
+        xs.append(jnp.stack([dense(c) for c in feature_cols], axis=1))
+        if label_col:
+            ys.append(dense(label_col))
+    X = jnp.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+    y = None
+    if label_col:
+        y = jnp.concatenate(ys, axis=0) if len(ys) > 1 else ys[0]
+    return X, y

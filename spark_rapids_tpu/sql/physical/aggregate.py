@@ -129,6 +129,12 @@ def group_phase(xp, key_cols: Sequence[DeviceColumn], row_mask):
     return rank64, n_groups
 
 
+#: speculated group-table size per partial-program key: after the first
+#: batch of a query reveals its group count, later batches fuse group+
+#: reduce into one program sized to it (bounded: keys embed literals, so
+#: reuse the kernel cache's eviction philosophy at small scale)
+_OUT_SPECULATION: dict = {}
+
 #: largest group table served by the one-hot matmul reduction (the
 #: [rows, OUT] one-hot must stay cheap even if XLA doesn't fuse it away)
 _MATMUL_MAX_GROUPS = 256
@@ -442,18 +448,64 @@ class HashAggregateExec(PhysicalPlan):
             self._reduce_fns[out_size] = fn
         return fn
 
+    def _fused_partial_fn(self, out_size: int):
+        """Speculative ONE-program partial: group phase + reductions fused
+        under a host-guessed group-table size.  Returns (partial, ng); the
+        caller validates ng <= out_size on the host and falls back to the
+        exact two-phase path on mis-speculation (scatters past out_size
+        drop, so a mis-speculated result is discarded, never used)."""
+        steps = tuple(self._pre_steps)
+
+        def impl(batch):
+            xp = self.xp
+            mask = batch.row_mask()
+            for step in steps:
+                batch, mask = step._fuse_step(batch, mask, xp)
+            ctx = EvalContext(batch, xp=xp)
+            keys = [g.eval(ctx) for g in self._bound_grouping]
+            rank64, ng = group_phase(xp, keys, mask)
+            slot_pairs, ops = self._eval_slots(ctx)
+            gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, mask,
+                                       rank64=rank64, n_groups=ng,
+                                       out_size=out_size)
+            names = tuple(f"_g{i}" for i in range(len(gk))) + \
+                tuple(f"_s{i}" for i in range(len(gs)))
+            return ColumnarBatch(names, tuple(gk) + tuple(gs), n), ng
+        key = ("fusedpartial", out_size, self._partial_key) + \
+            tuple(s._fuse_key() for s in self._pre_steps)
+        return self._jit(impl, key=key)
+
     def _run_partial(self, batch: ColumnarBatch) -> ColumnarBatch:
         """One input batch -> partial [keys..., slots...].  On the device
         backend this is the two-phase path: group ids first, ONE host sync
         for the observed group count, then reductions into a group table
-        sized to it (5x cheaper scatters; matmul path for small tables)."""
+        sized to it (5x cheaper scatters; matmul path for small tables).
+        Once a query has observed its group count, later batches SPECULATE
+        that size and run group+reduce as ONE program with ONE sync — on
+        the TPU tunnel every extra program boundary and sync is a full
+        network round trip."""
         if self.backend != TPU:
             return self._partial_fn(batch)
         from ...columnar.column import bucket_capacity
+        spec = _OUT_SPECULATION.get(self._partial_key)
+        if spec is not None and spec <= batch.capacity:
+            out, ng = self._fused_partial_fn(spec)(batch)
+            ng_host = int(ng)
+            if ng_host <= spec:
+                return out.with_known_rows(ng_host)
+            # mis-speculation: groups past `spec` were dropped — discard
+            # and take the exact path below (which re-records the size)
         batch2, mask, rank64, ng = self._group_fn(batch)
         ng_host = int(ng)
         n = max(ng_host, 1)
         out_size = min(bucket_capacity(n, minimum=64), batch2.capacity)
+        # max-join: a small tail batch must not clobber the spec a large
+        # batch needs (that would make every later large batch
+        # mis-speculate and execute twice, forever)
+        prev = _OUT_SPECULATION.get(self._partial_key, 0)
+        if len(_OUT_SPECULATION) > 1024:
+            _OUT_SPECULATION.clear()  # unbounded keys embed literals
+        _OUT_SPECULATION[self._partial_key] = max(prev, out_size)
         out = self._reduce_fn(out_size)(batch2, mask, rank64, ng)
         # output row count == observed group count (ng already folds in the
         # one-row floor for global aggregates), known on the host — seed it

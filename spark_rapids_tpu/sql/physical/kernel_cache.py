@@ -67,6 +67,11 @@ def clear_cache() -> None:
         _STATS["hits"] = 0
         _STATS["misses"] = 0
         _STATS["evictions"] = 0
+    # stale group-size speculations point at programs just dropped; a
+    # speculated miss would recompile a size that may immediately
+    # mis-speculate
+    from .aggregate import _OUT_SPECULATION
+    _OUT_SPECULATION.clear()
 
 
 def expr_key(e) -> Tuple:

@@ -39,15 +39,22 @@ def test_repeat_collect_hits_cache(session):
     cold = time.perf_counter() - t0
     misses_after_first = cache_stats()["misses"]
 
-    t0 = time.perf_counter()
     second = q.collect()
+    stats = cache_stats()
+    # the second run may compile exactly ONE new program: the speculative
+    # fused group+reduce sized to the group count the first run observed
+    assert stats["misses"] - misses_after_first <= 1, \
+        "second collect() compiled new kernels instead of reusing cached ones"
+    misses_after_second = stats["misses"]
+
+    t0 = time.perf_counter()
+    third = q.collect()
     warm = time.perf_counter() - t0
     stats = cache_stats()
-
-    assert stats["misses"] == misses_after_first, \
-        "second collect() compiled new kernels instead of reusing cached ones"
+    assert stats["misses"] == misses_after_second, \
+        "steady-state collect() must be fully cached"
     assert stats["hits"] > 0
-    assert first.to_pylist() == second.to_pylist()
+    assert first.to_pylist() == second.to_pylist() == third.to_pylist()
     # compile amortization: warm run must be dramatically faster
     assert warm * 20 < cold, f"cold={cold:.3f}s warm={warm:.3f}s"
 

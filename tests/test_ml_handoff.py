@@ -1,0 +1,100 @@
+"""ML handoff (ColumnarRdd / InternalColumnarRddConverter analog +
+BASELINE milestone 5's ml-integration path): a query's device output
+flows zero-copy into jax training."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import ml
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def test_columnar_rdd_returns_device_batches(sess):
+    import jax
+    df = sess.create_dataframe(pa.table({
+        "a": [1.0, 2.0, 3.0], "b": [4.0, 5.0, 6.0]}), num_partitions=2)
+    batches = ml.columnar_rdd(df.select((df.a * 2).alias("a2"), df.b))
+    assert sum(b.num_rows_int for b in batches) == 3
+    for b in batches:
+        for c in b.columns:
+            assert isinstance(c.data, jax.Array)  # device-resident
+    vals = sorted(v for b in batches
+                  for v in np.asarray(b.columns[0].data[:b.num_rows_int])
+                  .tolist())
+    assert vals == [2.0, 4.0, 6.0]
+
+
+def test_columnar_rdd_rejects_host_plans(sess):
+    s = srt.session(**{"spark.rapids.sql.enabled": False})
+    try:
+        df = s.create_dataframe(pa.table({"a": [1.0]}))
+        with pytest.raises(ValueError, match="device"):
+            ml.columnar_rdd(df.select((df.a + 1).alias("b")))
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
+
+
+def test_to_features_shapes_and_values(sess):
+    df = sess.create_dataframe(pa.table({
+        "x1": [1.0, 2.0, 3.0, 4.0], "x2": [0.5, 1.5, 2.5, 3.5],
+        "y": [1.0, 0.0, 1.0, 0.0]}), num_partitions=2)
+    X, y = ml.to_features(df, ["x1", "x2"], "y")
+    assert X.shape == (4, 2) and y.shape == (4,)
+    assert sorted(np.asarray(X[:, 0]).tolist()) == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_end_to_end_training_on_engine_output(sess):
+    """Engine query -> zero-copy features -> jax gradient descent learns
+    the planted linear relationship."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    n = 4000
+    x1 = rng.random(n); x2 = rng.random(n)
+    noise = rng.normal(0, 0.01, n)
+    t = pa.table({"x1": x1, "x2": x2,
+                  "target": 3.0 * x1 - 2.0 * x2 + 0.5 + noise,
+                  "grp": rng.integers(0, 4, n)})
+    df = sess.create_dataframe(t, num_partitions=4)
+    # feature engineering THROUGH the engine, then handoff
+    feats = df.filter(df.grp >= 0).select(
+        df.x1, df.x2, (df.x1 * df.x2).alias("x1x2"), df.target)
+    X, y = ml.to_features(feats, ["x1", "x2", "x1x2"], "target")
+    Xb = jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
+
+    def loss(w):
+        return jnp.mean((Xb @ w - y) ** 2)
+
+    g = jax.jit(jax.grad(loss))
+    w = jnp.zeros(4, X.dtype)
+    for _ in range(800):
+        w = w - 0.5 * g(w)
+    w = np.asarray(w)
+    assert abs(w[0] - 3.0) < 0.1, w
+    assert abs(w[1] + 2.0) < 0.1, w
+    assert abs(w[2]) < 0.2, w
+    assert abs(w[3] - 0.5) < 0.15, w
+
+
+def test_to_features_rejects_nulls(sess):
+    df = sess.create_dataframe(pa.table({
+        "x": pa.array([1.0, None, 3.0], type=pa.float64()),
+        "y": [1.0, 2.0, 3.0]}))
+    with pytest.raises(ValueError, match="NULL"):
+        ml.to_features(df, ["x"], "y")
+    # filtering the nulls in the query makes it fine
+    X, y = ml.to_features(df.filter(df.x.isNotNull()), ["x"], "y")
+    assert X.shape == (2, 1)
+
+
+def test_to_features_rejects_string_label(sess):
+    df = sess.create_dataframe(pa.table({"x": [1.0], "s": ["a"]}))
+    with pytest.raises(ValueError, match="not numeric"):
+        ml.to_features(df, ["x"], "s")
