@@ -98,12 +98,7 @@ def per_rule_flags_md() -> str:
              "Each registered rule has a boolean enable flag (default "
              "true); setting it false forces that op to the host engine.",
              "", "Name | Default", "-----|--------"]
-    from .sql.overrides import _HOST_ONLY_EXPRS
-    # names whose tagging path never consults a flag (structural nodes,
-    # the AggregateExpression wrapper — its FUNCTION's flag IS honored —
-    # and unconditionally host-only expressions)
-    unflagged = {"Alias", "AttributeReference", "BoundReference",
-                 "Literal", "AggregateExpression"} | set(_HOST_ONLY_EXPRS)
+    from .sql.overrides import UNFLAGGED_EXPRS as unflagged
     for key in sorted(set(_EXEC_ENABLE_KEYS.values())):
         lines.append(f"{key} | true")
     for name in sorted(EXPRESSION_REGISTRY):

@@ -37,8 +37,18 @@ def columnar_rdd(df) -> List[ColumnarBatch]:
         raise ValueError(
             "columnar_rdd requires the query to end on the device; the "
             f"plan ends on {phys.backend} — check session.explain(df)")
-    return [b for b in phys.execute_all(session._conf)
-            if b.num_rows_int > 0]
+    batches = [b for b in phys.execute_all(session._conf)
+               if b.num_rows_int > 0]
+    # same per-query metrics contract as session._execute
+    metrics: dict = {}
+    stack = [phys]
+    while stack:
+        node = stack.pop()
+        for k, v in node.metrics.items():
+            metrics[k] = metrics.get(k, 0.0) + v
+        stack.extend(node.children)
+    session.last_query_metrics = metrics
+    return batches
 
 
 def to_features(df, feature_cols: Sequence[str],

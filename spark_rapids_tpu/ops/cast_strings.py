@@ -1,0 +1,301 @@
+"""Device string<->number/date/bool casts — the ``CastStrings`` analog
+(reference ``com.nvidia.spark.rapids.jni.CastStrings``: Spark-exact
+string casts as native kernels; consumed by ``GpuCast.scala``).
+
+All kernels are vectorized over the padded byte-matrix layout
+([rows, width] uint8 + int32 lengths) and traceable under jnp, so string
+casts fuse into whole-stage programs instead of bouncing to the host.
+Spark (non-ANSI) semantics: unparsable input -> NULL, overflow -> NULL
+for string->integral, whitespace trimmed.
+
+Shapes are static: the parse runs positionally over the width dimension
+with masks — no data-dependent control flow, MXU/VPU-friendly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SP = 32  # space
+_PLUS, _MINUS, _DOT = 43, 45, 46
+_ZERO, _NINE = 48, 57
+_E_LO, _E_UP = 101, 69
+
+
+def _trimmed(xp, chars, lengths):
+    """(start, end) of the content after trimming ASCII whitespace
+    (space, \\t..\\r) on both sides; chars int16-safe."""
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    c = chars.astype(xp.int32)
+    in_str = pos < lengths[:, None]
+    is_ws = in_str & ((c == _SP) | ((c >= 9) & (c <= 13)))
+    non_ws = in_str & ~is_ws
+    any_content = xp.any(non_ws, axis=1)
+    big = xp.asarray(width, dtype=xp.int32)
+    first = xp.min(xp.where(non_ws, pos, big), axis=1)
+    last = xp.max(xp.where(non_ws, pos, -1), axis=1)
+    start = xp.where(any_content, first, 0)
+    end = xp.where(any_content, last + 1, 0)
+    return start.astype(xp.int32), end.astype(xp.int32)
+
+
+def parse_long(xp, chars, lengths, validity):
+    """(int64 values, ok mask): Spark-exact string -> long.  Accepts
+    optional +/- then 1..19 digits; anything else (or 64-bit overflow)
+    is not-ok.  Also accepts a trailing fractional part ('12.9' -> 12,
+    truncation like Spark's cast to integral... Spark 3 casts '12.9' to
+    NULL for integral targets, so we reject dots)."""
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    c = chars.astype(xp.int32)
+    start, end = _trimmed(xp, chars, lengths)
+    n = end - start
+    has_sign = (n > 0) & ((_take(xp, c, start) == _PLUS)
+                          | (_take(xp, c, start) == _MINUS))
+    neg = (n > 0) & (_take(xp, c, start) == _MINUS)
+    dstart = start + has_sign.astype(xp.int32)
+    ndig = end - dstart
+    in_digits = (pos >= dstart[:, None]) & (pos < end[:, None])
+    is_digit = (c >= _ZERO) & (c <= _NINE)
+    all_digits = xp.all(~in_digits | is_digit, axis=1)
+    ok = validity & (ndig >= 1) & (ndig <= 19) & all_digits
+    # accumulate value * 10^(digits after) — uint64 wraps on overflow,
+    # which the 19-digit magnitude check below catches
+    digit = xp.where(in_digits & is_digit, (c - _ZERO), 0)
+    # place value: 10^(end-1-pos) for positions inside the digit run
+    exp = xp.clip(end[:, None] - 1 - pos, 0, 18)
+    pow10 = xp.asarray((10 ** np.arange(19, dtype=np.uint64))
+                   .astype(np.uint64))
+    place = pow10[exp]
+    acc = xp.sum(xp.where(in_digits, digit.astype(xp.uint64) * place,
+                          xp.asarray(0, dtype=xp.uint64)), axis=1)
+    # 19-digit values may exceed int64: detect via uint64 comparison
+    lim_pos = xp.asarray(np.uint64(2**63 - 1))
+    lim_neg = xp.asarray(np.uint64(2**63))
+    fits = xp.where(neg, acc <= lim_neg, acc <= lim_pos)
+    ok = ok & fits
+    signed = xp.where(neg, (~acc + xp.asarray(1, dtype=xp.uint64)),
+                      acc).astype(xp.int64)
+    return signed, ok
+
+
+def _take(xp, c, idx):
+    """c[row, idx[row]] with idx clipped into width."""
+    width = c.shape[1]
+    rows = xp.arange(c.shape[0], dtype=xp.int32)
+    return c[rows, xp.clip(idx, 0, width - 1)]
+
+
+def parse_double(xp, chars, lengths, validity):
+    """(float64 values, ok): string -> double for the standard decimal
+    forms [+-]digits[.digits][eE[+-]digits].  Magnitudes are accumulated
+    in float64 positionally (same error class as any float parse that
+    rounds once per digit; exactly round-tripped values used in practice
+    match numpy's parse on round numbers).  Infinity/NaN words follow
+    Spark: 'Infinity', '-Infinity', 'NaN' (case-sensitive prefix rules
+    are relaxed to case-insensitive like Spark's CastStringToDouble)."""
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    c = chars.astype(xp.int32)
+    start, end = _trimmed(xp, chars, lengths)
+    n = end - start
+    has_sign = (n > 0) & ((_take(xp, c, start) == _PLUS)
+                          | (_take(xp, c, start) == _MINUS))
+    neg = (n > 0) & (_take(xp, c, start) == _MINUS)
+    dstart = start + has_sign.astype(xp.int32)
+
+    lower = xp.where((c >= 65) & (c <= 90), c + 32, c)
+
+    def word_at(word, at):
+        m = xp.ones(c.shape[0], dtype=bool)
+        for i, ch in enumerate(word):
+            m = m & (_take(xp, lower, at + i) == ord(ch))
+        return m & (end - at == len(word))
+
+    is_inf = word_at("infinity", dstart) | word_at("inf", dstart)
+    is_nan = word_at("nan", start)
+
+    # exponent marker position (first e/E inside content), else end
+    is_e = ((lower == _E_LO)) & (pos >= dstart[:, None]) & \
+        (pos < end[:, None])
+    big = xp.asarray(width, dtype=xp.int32)
+    e_pos = xp.min(xp.where(is_e, pos, big), axis=1).astype(xp.int32)
+    has_e = e_pos < end
+    mant_end = xp.where(has_e, e_pos, end)
+    # dot position inside mantissa, else mant_end
+    is_dot = (c == _DOT) & (pos >= dstart[:, None]) & \
+        (pos < mant_end[:, None])
+    dot_pos = xp.min(xp.where(is_dot, pos, big), axis=1).astype(xp.int32)
+    has_dot = dot_pos < mant_end
+    n_dots = xp.sum(is_dot.astype(xp.int32), axis=1)
+
+    int_end = xp.where(has_dot, dot_pos, mant_end)
+    in_int = (pos >= dstart[:, None]) & (pos < int_end[:, None])
+    in_frac = has_dot[:, None] & (pos > dot_pos[:, None]) & \
+        (pos < mant_end[:, None])
+    is_digit = (c >= _ZERO) & (c <= _NINE)
+    digits_ok = xp.all(~(in_int | in_frac) | is_digit, axis=1)
+    n_mant_digits = xp.sum((in_int | in_frac).astype(xp.int32), axis=1)
+
+    dig = xp.where(is_digit, c - _ZERO, 0).astype(xp.float64)
+    # integer part: digit * 10^(int_end-1-pos)
+    iexp = xp.clip(int_end[:, None] - 1 - pos, 0, 308)
+    int_val = xp.sum(xp.where(in_int, dig * xp.power(
+        xp.asarray(10.0, dtype=xp.float64), iexp.astype(xp.float64)), 0.0),
+        axis=1)
+    # fraction: digit * 10^-(pos-dot_pos)
+    fexp = xp.clip(pos - dot_pos[:, None], 0, 308)
+    frac_val = xp.sum(xp.where(in_frac, dig * xp.power(
+        xp.asarray(10.0, dtype=xp.float64), -fexp.astype(xp.float64)), 0.0),
+        axis=1)
+    mant = int_val + frac_val
+
+    # exponent: optional sign + digits after e
+    easturt = e_pos + 1
+    e_sign_ch = _take(xp, c, easturt)
+    e_has_sign = has_e & ((e_sign_ch == _PLUS) | (e_sign_ch == _MINUS))
+    e_neg = has_e & (e_sign_ch == _MINUS)
+    ed_start = easturt + e_has_sign.astype(xp.int32)
+    in_exp = has_e[:, None] & (pos >= ed_start[:, None]) & \
+        (pos < end[:, None])
+    exp_digits_ok = xp.all(~in_exp | is_digit, axis=1)
+    n_exp_digits = xp.sum(in_exp.astype(xp.int32), axis=1)
+    eexp = xp.clip(end[:, None] - 1 - pos, 0, 18)
+    exp_val = xp.sum(xp.where(in_exp, (c - _ZERO).astype(xp.float64)
+                              * xp.power(xp.asarray(10.0, xp.float64),
+                                         eexp.astype(xp.float64)), 0.0),
+                     axis=1)
+    exp_val = xp.where(e_neg, -exp_val, exp_val)
+    exp_val = xp.clip(exp_val, -400.0, 400.0)
+
+    val = mant * xp.power(xp.asarray(10.0, dtype=xp.float64), exp_val)
+    val = xp.where(neg, -val, val)
+
+    plain_ok = (validity & (n > 0) & digits_ok & exp_digits_ok
+                & (n_mant_digits >= 1) & (n_dots <= 1)
+                & (~has_e | (n_exp_digits >= 1)))
+    inf = xp.where(neg, -xp.inf, xp.inf)
+    out = xp.where(is_inf, inf, xp.where(is_nan, xp.nan, val))
+    ok = validity & (is_inf | is_nan | plain_ok)
+    return out, ok
+
+
+def parse_date(xp, chars, lengths, validity):
+    """(int32 days-since-epoch, ok): 'yyyy-MM-dd' / 'yyyy-M-d' plus bare
+    'yyyy' and 'yyyy-MM' (Spark accepts those, defaulting month/day 1)."""
+    c = chars.astype(xp.int32)
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    start, end = _trimmed(xp, chars, lengths)
+    # Spark's stringToDate accepts a trailing time section ('T...' or
+    # ' ...'): the date part ends at the first T/space after the start
+    bigw = xp.asarray(width, dtype=xp.int32)
+    t_or_sp = ((c == 84) | (c == _SP)) & (pos > start[:, None]) & \
+        (pos < end[:, None])
+    cut = xp.min(xp.where(t_or_sp, pos, bigw), axis=1).astype(xp.int32)
+    end = xp.minimum(end, cut)
+    is_digit = (c >= _ZERO) & (c <= _NINE)
+    dash = c == _MINUS
+    in_str = (pos >= start[:, None]) & (pos < end[:, None])
+    # dash positions (first two)
+    big = xp.asarray(width, dtype=xp.int32)
+    d_mask = dash & in_str & (pos > start[:, None])  # leading '-' unsupported
+    d1 = xp.min(xp.where(d_mask, pos, big), axis=1).astype(xp.int32)
+    d2_mask = d_mask & (pos > d1[:, None])
+    d2 = xp.min(xp.where(d2_mask, pos, big), axis=1).astype(xp.int32)
+    has_d1 = d1 < end
+    has_d2 = d2 < end
+
+    def seg_val(lo, hi):
+        """numeric value of digits in [lo, hi); (value, ok, len)."""
+        seg = (pos >= lo[:, None]) & (pos < hi[:, None])
+        okd = xp.all(~seg | is_digit, axis=1)
+        ln = hi - lo
+        e = xp.clip(hi[:, None] - 1 - pos, 0, 8)
+        v = xp.sum(xp.where(seg, (c - _ZERO) * xp.power(10, e), 0), axis=1)
+        return v.astype(xp.int32), okd, ln
+
+    y_end = xp.where(has_d1, d1, end)
+    y, y_ok, y_len = seg_val(start, y_end)
+    m_end = xp.where(has_d2, d2, end)
+    m, m_ok, m_len = seg_val(xp.where(has_d1, d1 + 1, end), m_end)
+    d, d_ok, d_len = seg_val(xp.where(has_d2, d2 + 1, end), end)
+    m = xp.where(has_d1, m, 1)
+    d = xp.where(has_d2, d, 1)
+    ok = (validity & (end > start) & y_ok & m_ok & d_ok
+          & (y_len == 4)
+          & (~has_d1 | ((m_len >= 1) & (m_len <= 2)))
+          & (~has_d2 | ((d_len >= 1) & (d_len <= 2)))
+          & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31))
+    days, cal_ok = _civil_to_days(xp, y, m, d)
+    return days.astype(xp.int32), ok & cal_ok
+
+
+def _civil_to_days(xp, y, m, d):
+    """Days since 1970-01-01 for proleptic-Gregorian (y, m, d) + validity
+    of the day-of-month (Howard Hinnant's civil algorithm, branch-free)."""
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    mdays = xp.asarray(np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31,
+                                 30, 31], dtype=np.int32))
+    md = mdays[xp.clip(m - 1, 0, 11)]
+    md = xp.where((m == 2) & leap, 29, md)
+    ok = d <= md
+    yy = y - (m <= 2)
+    era = xp.where(yy >= 0, yy, yy - 399) // 400
+    yoe = yy - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468, ok
+
+
+def parse_bool(xp, chars, lengths, validity):
+    """Spark string->boolean: true/t/yes/y/1 and false/f/no/n/0
+    (case-insensitive, trimmed)."""
+    c = chars.astype(xp.int32)
+    lower = xp.where((c >= 65) & (c <= 90), c + 32, c)
+    start, end = _trimmed(xp, chars, lengths)
+    n = end - start
+
+    def word(word_s):
+        m = n == len(word_s)
+        for i, ch in enumerate(word_s):
+            m = m & (_take(xp, lower, start + i) == ord(ch))
+        return m
+
+    t = word("true") | word("t") | word("yes") | word("y") | word("1")
+    f = word("false") | word("f") | word("no") | word("n") | word("0")
+    return t, validity & (t | f)
+
+
+def format_long(xp, vals, validity, width: int = 20):
+    """int64 -> byte matrix (Spark number->string): minus sign + digits,
+    no padding.  Returns (chars uint8[n, width], lengths int32[n])."""
+    neg = vals < 0
+    # magnitude as uint64 (abs of INT64_MIN is representable there)
+    mag = xp.where(neg, (~vals.astype(xp.uint64))
+                   + xp.asarray(1, dtype=xp.uint64),
+                   vals.astype(xp.uint64))
+    pow10 = xp.asarray((10 ** np.arange(19, dtype=np.uint64))
+                   .astype(np.uint64))
+    # digits most-significant-first over 19 positions
+    digs = (mag[:, None] // pow10[None, ::-1]) % xp.asarray(
+        10, dtype=xp.uint64)
+    ndig = xp.maximum(
+        xp.sum((mag[:, None] >= pow10[None, :]).astype(xp.int32), axis=1),
+        1)
+    lengths = ndig + neg.astype(xp.int32)
+    # layout: row i writes sign at 0 (if neg) then its ndig digits
+    out_pos = xp.arange(width, dtype=xp.int32)[None, :]
+    # digit index d (0 = most significant of the VALUE) sits at
+    # out position neg + d; source digit column = 19 - ndig + d
+    d_idx = out_pos - neg.astype(xp.int32)[:, None]
+    src_col = 19 - ndig[:, None] + d_idx
+    in_digits = (d_idx >= 0) & (d_idx < ndig[:, None])
+    gathered = xp.take_along_axis(
+        digs, xp.clip(src_col, 0, 18).astype(xp.int32), axis=1)
+    chars = xp.where(in_digits, gathered.astype(xp.uint8) + _ZERO, 0)
+    chars = xp.where((out_pos == 0) & neg[:, None],
+                     xp.asarray(_MINUS, dtype=xp.uint8), chars)
+    return chars.astype(xp.uint8), xp.where(validity, lengths, 0)

@@ -50,6 +50,9 @@ class Cast(UnaryExpression):
             return _null_like(ctx, tt, c)
         if isinstance(ft, T.StringType) or isinstance(tt, T.StringType):
             if ctx.is_device:
+                out = _device_string_cast(ctx, c, ft, tt)
+                if out is not None:
+                    return out
                 raise NotImplementedError(
                     f"cast {ft} -> {tt} runs on the host path")
             return _host_string_cast(ctx, c, ft, tt)
@@ -60,6 +63,63 @@ class Cast(UnaryExpression):
 def _int_bounds(dt: T.DataType):
     return {1: (-2**7, 2**7 - 1), 2: (-2**15, 2**15 - 1),
             4: (-2**31, 2**31 - 1), 8: (-2**63, 2**63 - 1)}[dt.np_dtype.itemsize]
+
+
+#: (from, to) string-cast families served by the DEVICE kernels in
+#: ops/cast_strings.py (the CastStrings analog); everything else bounces
+#: to the host path and is tagged accordingly in overrides.py
+def device_string_cast_supported(ft, tt) -> bool:
+    if isinstance(ft, T.StringType):
+        return (T.is_integral(tt) or isinstance(tt, (T.FloatType,
+                                                     T.DoubleType,
+                                                     T.BooleanType,
+                                                     T.DateType)))
+    if isinstance(tt, T.StringType):
+        return T.is_integral(ft) or isinstance(ft, T.BooleanType)
+    return False
+
+
+def _device_string_cast(ctx, c: DeviceColumn, ft, tt):
+    """Device string casts over the byte matrix; None = unsupported combo
+    (caller falls to the host path)."""
+    from ...ops import cast_strings as CS
+    xp = ctx.xp
+    if isinstance(ft, T.StringType):
+        chars, lengths, valid = c.data, c.lengths, c.validity
+        if T.is_integral(tt):
+            v, ok = CS.parse_long(xp, chars, lengths, valid)
+            if tt.np_dtype.itemsize < 8:
+                lo, hi = _int_bounds(tt)
+                ok = ok & (v >= lo) & (v <= hi)
+            return fixed(tt, v.astype(tt.np_dtype), ok)
+        if isinstance(tt, (T.FloatType, T.DoubleType)):
+            v, ok = CS.parse_double(xp, chars, lengths, valid)
+            return fixed(tt, v.astype(tt.np_dtype), ok)
+        if isinstance(tt, T.BooleanType):
+            v, ok = CS.parse_bool(xp, chars, lengths, valid)
+            return fixed(tt, v, ok)
+        if isinstance(tt, T.DateType):
+            v, ok = CS.parse_date(xp, chars, lengths, valid)
+            return fixed(tt, v, ok)
+        return None
+    if isinstance(tt, T.StringType):
+        if isinstance(ft, T.BooleanType):
+            # 'true'/'false': format via two fixed byte rows
+            width = 5
+            t_row = np.zeros(width, dtype=np.uint8)
+            t_row[:4] = np.frombuffer(b"true", dtype=np.uint8)
+            f_row = np.frombuffer(b"false", dtype=np.uint8)
+            chars = xp.where(c.data[:, None],
+                             xp.asarray(t_row), xp.asarray(f_row))
+            lengths = xp.where(c.data, 4, 5).astype(xp.int32)
+            return DeviceColumn(tt, chars.astype(xp.uint8), c.validity,
+                                lengths=xp.where(c.validity, lengths, 0))
+        if T.is_integral(ft):
+            chars, lengths = CS.format_long(
+                xp, c.data.astype(xp.int64), c.validity)
+            return DeviceColumn(tt, chars, c.validity, lengths=lengths)
+        return None
+    return None
 
 
 def _cast_fixed(xp, c: DeviceColumn, ft: T.DataType, tt: T.DataType):

@@ -31,6 +31,14 @@ _EXPR_SIGS: Dict[str, TS.TypeSig] = {
 # expressions that are registered but must run on the host in some forms
 _HOST_ONLY_EXPRS = {"RaiseError"}
 
+#: registry names whose tagging path never consults a per-rule enable
+#: flag: structural pass-throughs (the isinstance fast path in
+#: ExprMeta.tag) and the AggregateExpression wrapper (its FUNCTION's
+#: flag is honored).  docgen imports this so the documented flag list
+#: stays in lockstep with what tagging consults.
+UNFLAGGED_EXPRS = {"Alias", "AttributeReference", "BoundReference",
+                   "Literal", "AggregateExpression"} | _HOST_ONLY_EXPRS
+
 # config kill-switches per exec family (subset of the reference's
 # spark.rapids.sql.exec.* flags)
 #: per-exec enable flags keyed by logical node, named after the Spark
@@ -140,14 +148,17 @@ class ExprMeta:
                 self.will_not_work(f"{cls_name}: {r}")
                 break
         if isinstance(e, Cast):
+            from .expressions.cast import device_string_cast_supported
             ft = e.children[0].data_type
             if isinstance(ft, T.StringType) or isinstance(e.to, T.StringType):
-                if not isinstance(ft, T.StringType) or not isinstance(
-                        e.to, T.StringType):
+                string_string = isinstance(ft, T.StringType) and isinstance(
+                    e.to, T.StringType)
+                if not string_string and not device_string_cast_supported(
+                        ft, e.to):
                     self.will_not_work(
                         f"cast {ft.simple_string()} -> "
                         f"{e.to.simple_string()} runs on the host "
-                        "(CastStrings-equivalent device kernel pending)")
+                        "(outside the device CastStrings-analog matrix)")
         for c in self.children:
             c.tag()
 

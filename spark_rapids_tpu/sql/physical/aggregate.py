@@ -335,6 +335,7 @@ class HashAggregateExec(PhysicalPlan):
             self._group_fn = self._jit(self._make_group_fn(()),
                                        key=("grp",) + self._partial_key)
             self._reduce_fns: dict = {}
+            self._fused_fns: dict = {}
         merge_key = ("merge", len(self.grouping), slots_key)
         self._merge_fn = self._jit(self._merge_compute, key=merge_key)
         self._finalize_key = ("finalize", len(self.grouping), slots_key,
@@ -364,6 +365,7 @@ class HashAggregateExec(PhysicalPlan):
         self._group_fn = self._jit(self._make_group_fn(steps),
                                    key=("grp",) + key)
         self._reduce_fns = {}
+        self._fused_fns = {}
 
     # --- schema -----------------------------------------------------------
     @property
@@ -487,9 +489,14 @@ class HashAggregateExec(PhysicalPlan):
         if self.backend != TPU:
             return self._partial_fn(batch)
         from ...columnar.column import bucket_capacity
-        spec = _OUT_SPECULATION.get(self._partial_key)
+        spec_key = self._partial_key + tuple(
+            s._fuse_key() for s in self._pre_steps)
+        spec = _OUT_SPECULATION.get(spec_key)
         if spec is not None and spec <= batch.capacity:
-            out, ng = self._fused_partial_fn(spec)(batch)
+            fused = self._fused_fns.get(spec)
+            if fused is None:
+                fused = self._fused_fns[spec] = self._fused_partial_fn(spec)
+            out, ng = fused(batch)
             ng_host = int(ng)
             if ng_host <= spec:
                 return out.with_known_rows(ng_host)
@@ -502,10 +509,10 @@ class HashAggregateExec(PhysicalPlan):
         # max-join: a small tail batch must not clobber the spec a large
         # batch needs (that would make every later large batch
         # mis-speculate and execute twice, forever)
-        prev = _OUT_SPECULATION.get(self._partial_key, 0)
+        prev = _OUT_SPECULATION.get(spec_key, 0)
         if len(_OUT_SPECULATION) > 1024:
             _OUT_SPECULATION.clear()  # unbounded keys embed literals
-        _OUT_SPECULATION[self._partial_key] = max(prev, out_size)
+        _OUT_SPECULATION[spec_key] = max(prev, out_size)
         out = self._reduce_fn(out_size)(batch2, mask, rank64, ng)
         # output row count == observed group count (ng already folds in the
         # one-row floor for global aggregates), known on the host — seed it

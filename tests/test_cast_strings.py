@@ -1,0 +1,133 @@
+"""Device string<->number/date/bool casts (CastStrings analog,
+ops/cast_strings.py; reference com.nvidia.spark.rapids.jni.CastStrings
+consumed by GpuCast.scala).  Spark non-ANSI semantics: bad input -> NULL,
+overflow -> NULL, whitespace trimmed."""
+
+import datetime as D
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def test_string_to_long_edge_cases(sess):
+    df = sess.create_dataframe(pa.table({"s": [
+        "123", " -456 ", "+7", "9223372036854775807",
+        "9223372036854775808", "-9223372036854775808", "12.5", "abc",
+        "", None, "  42  ", "-", "+", "1 2"]}))
+    q = df.select(df.s.cast("bigint").alias("l"))
+    assert "host" not in sess.explain(q)  # device kernel, no fallback
+    got = q.collect()["l"].to_pylist()
+    assert got == [123, -456, 7, 9223372036854775807, None,
+                   -9223372036854775808, None, None, None, None, 42,
+                   None, None, None]
+
+
+def test_string_to_narrow_ints_overflow_nulls(sess):
+    df = sess.create_dataframe(pa.table({"s": ["127", "128", "-128",
+                                               "-129", "32768", "70000"]}))
+    got_b = df.select(df.s.cast("tinyint").alias("v")).collect()["v"]
+    assert got_b.to_pylist() == [127, None, -128, None, None, None]
+    got_s = df.select(df.s.cast("smallint").alias("v")).collect()["v"]
+    assert got_s.to_pylist() == [127, 128, -128, -129, None, None]
+
+
+def test_string_to_double_forms(sess):
+    df = sess.create_dataframe(pa.table({"s": [
+        "1.5", "-2.25e2", "1e-3", "Infinity", "-inf", "NaN", ".5", "5.",
+        "1e", "x", None, "  3.14  ", "1.2.3", "2E+4"]}))
+    got = df.select(df.s.cast("double").alias("d")).collect()["d"] \
+        .to_pylist()
+    assert got[0] == 1.5 and got[1] == -225.0
+    assert abs(got[2] - 1e-3) < 1e-18
+    assert got[3] == math.inf and got[4] == -math.inf
+    assert math.isnan(got[5])
+    assert got[6] == 0.5 and got[7] == 5.0
+    assert got[8] is None and got[9] is None and got[10] is None
+    assert abs(got[11] - 3.14) < 1e-15
+    assert got[12] is None and got[13] == 2e4
+
+
+def test_string_to_date(sess):
+    df = sess.create_dataframe(pa.table({"s": [
+        "2024-02-29", "2023-02-29", "2024-1-5", "2024", "2024-06",
+        "2024-13-01", "1969-12-31", "0001-01-01", "bad", None]}))
+    got = df.select(df.s.cast("date").alias("d")).collect()["d"] \
+        .to_pylist()
+    assert got == [D.date(2024, 2, 29), None, D.date(2024, 1, 5),
+                   D.date(2024, 1, 1), D.date(2024, 6, 1), None,
+                   D.date(1969, 12, 31), D.date(1, 1, 1), None, None]
+
+
+def test_string_to_boolean(sess):
+    df = sess.create_dataframe(pa.table({"s": [
+        "true", "F", "YES", "0", "1", "n", "maybe", " t ", None]}))
+    got = df.select(df.s.cast("boolean").alias("b")).collect()["b"] \
+        .to_pylist()
+    assert got == [True, False, True, False, True, False, None, True,
+                   None]
+
+
+def test_integral_to_string_roundtrip(sess):
+    vals = [0, 5, -17, 9223372036854775807, -9223372036854775808, None,
+            1000000, -1]
+    df = sess.create_dataframe(pa.table({
+        "l": pa.array(vals, type=pa.int64())}))
+    got = df.select(df.l.cast("string").alias("s")).collect()["s"] \
+        .to_pylist()
+    assert got == [None if v is None else str(v) for v in vals]
+    # and parse back
+    back = (df.select(df.l.cast("string").cast("bigint").alias("v"))
+            .collect()["v"].to_pylist())
+    assert back == vals
+
+
+def test_bool_to_string(sess):
+    df = sess.create_dataframe(pa.table({"b": [True, False, None]}))
+    got = df.select(df.b.cast("string").alias("s")).collect()["s"] \
+        .to_pylist()
+    assert got == ["true", "false", None]
+
+
+def test_long_parse_fuzz_vs_python(sess):
+    rng = np.random.default_rng(9)
+    vals = [str(int(v)) for v in
+            rng.integers(-(2**62), 2**62, 3000)]
+    extras = ["  %d " % v for v in rng.integers(-10**9, 10**9, 100)]
+    df = sess.create_dataframe(pa.table({"s": vals + extras}))
+    got = df.select(df.s.cast("bigint").alias("l")).collect()["l"] \
+        .to_pylist()
+    want = [int(s) for s in vals + extras]
+    assert got == want
+
+
+def test_double_parse_fuzz_vs_python(sess):
+    rng = np.random.default_rng(10)
+    nums = rng.random(2000) * 10.0 ** rng.integers(-10, 10, 2000)
+    strs = [f"{v:.12g}" for v in nums]
+    df = sess.create_dataframe(pa.table({"s": strs}))
+    got = np.array(df.select(df.s.cast("double").alias("d"))
+                   .collect()["d"].to_pylist())
+    want = np.array([float(s) for s in strs])
+    # positional digit accumulation: one rounding per digit, so allow
+    # a few ULPs of drift against the exact libc parse
+    rel = np.abs(got - want) / np.maximum(np.abs(want), 1e-300)
+    assert rel.max() < 1e-13, rel.max()
+
+
+def test_string_to_date_with_time_suffix(sess):
+    """Spark's stringToDate accepts a trailing time section."""
+    df = sess.create_dataframe(pa.table({"s": [
+        "2020-03-18T12:03:17", "2020-03-18 12:03:17",
+        "2020-03-18Tjunk", "2020-03-18"]}))
+    got = df.select(df.s.cast("date").alias("d")).collect()["d"] \
+        .to_pylist()
+    assert got == [D.date(2020, 3, 18)] * 4
