@@ -37,17 +37,10 @@ def columnar_rdd(df) -> List[ColumnarBatch]:
         raise ValueError(
             "columnar_rdd requires the query to end on the device; the "
             f"plan ends on {phys.backend} — check session.explain(df)")
+    from ..sql.physical.base import collect_metrics
     batches = [b for b in phys.execute_all(session._conf)
                if b.num_rows_int > 0]
-    # same per-query metrics contract as session._execute
-    metrics: dict = {}
-    stack = [phys]
-    while stack:
-        node = stack.pop()
-        for k, v in node.metrics.items():
-            metrics[k] = metrics.get(k, 0.0) + v
-        stack.extend(node.children)
-    session.last_query_metrics = metrics
+    session.last_query_metrics = collect_metrics(phys)
     return batches
 
 

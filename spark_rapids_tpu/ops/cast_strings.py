@@ -59,7 +59,14 @@ def parse_long(xp, chars, lengths, validity):
     in_digits = (pos >= dstart[:, None]) & (pos < end[:, None])
     is_digit = (c >= _ZERO) & (c <= _NINE)
     all_digits = xp.all(~in_digits | is_digit, axis=1)
-    ok = validity & (ndig >= 1) & (ndig <= 19) & all_digits
+    # magnitude bound counts SIGNIFICANT digits — leading zeros are legal
+    # at any length ('0...01' parses as 1; zero digits also make the
+    # clipped place values beyond 10^18 harmless: 0 * anything = 0)
+    nonzero = in_digits & is_digit & (c != _ZERO)
+    bigw = xp.asarray(width, dtype=xp.int32)
+    first_sig = xp.min(xp.where(nonzero, pos, bigw), axis=1).astype(xp.int32)
+    n_sig = xp.maximum(end - xp.minimum(first_sig, end), 0)
+    ok = validity & (ndig >= 1) & (n_sig <= 19) & all_digits
     # accumulate value * 10^(digits after) — uint64 wraps on overflow,
     # which the 19-digit magnitude check below catches
     digit = xp.where(in_digits & is_digit, (c - _ZERO), 0)

@@ -336,6 +336,7 @@ class HashAggregateExec(PhysicalPlan):
                                        key=("grp",) + self._partial_key)
             self._reduce_fns: dict = {}
             self._fused_fns: dict = {}
+            self._spec_key = self._partial_key  # no pre-steps yet
         merge_key = ("merge", len(self.grouping), slots_key)
         self._merge_fn = self._jit(self._merge_compute, key=merge_key)
         self._finalize_key = ("finalize", len(self.grouping), slots_key,
@@ -366,6 +367,8 @@ class HashAggregateExec(PhysicalPlan):
                                    key=("grp",) + key)
         self._reduce_fns = {}
         self._fused_fns = {}
+        self._spec_key = self._partial_key + tuple(
+            s._fuse_key() for s in steps)
 
     # --- schema -----------------------------------------------------------
     @property
@@ -489,8 +492,7 @@ class HashAggregateExec(PhysicalPlan):
         if self.backend != TPU:
             return self._partial_fn(batch)
         from ...columnar.column import bucket_capacity
-        spec_key = self._partial_key + tuple(
-            s._fuse_key() for s in self._pre_steps)
+        spec_key = self._spec_key
         spec = _OUT_SPECULATION.get(spec_key)
         if spec is not None and spec <= batch.capacity:
             fused = self._fused_fns.get(spec)

@@ -213,6 +213,20 @@ class PhysicalPlan:
         return "\n".join(lines)
 
 
+def collect_metrics(phys: "PhysicalPlan") -> Dict[str, float]:
+    """Accumulate every node's metrics over the physical tree (the
+    per-query metrics contract shared by session collect and the ML
+    handoff)."""
+    metrics: Dict[str, float] = {}
+    stack = [phys]
+    while stack:
+        node = stack.pop()
+        for k, v in node.metrics.items():
+            metrics[k] = metrics.get(k, 0.0) + v
+        stack.extend(node.children)
+    return metrics
+
+
 def eval_context(plan: PhysicalPlan, batch: ColumnarBatch, conf=None):
     from ..expressions.core import EvalContext
     return EvalContext(batch, xp=plan.xp, conf=conf)

@@ -80,14 +80,8 @@ class TpuSession:
         planner = Planner(self._conf)
         phys = planner.plan_for_collect(logical)
         batches = phys.execute_all(self._conf)
-        metrics: dict = {}
-        stack = [phys]
-        while stack:
-            node = stack.pop()
-            for k, v in node.metrics.items():
-                metrics[k] = metrics.get(k, 0.0) + v
-            stack.extend(node.children)
-        self.last_query_metrics = metrics
+        from .physical.base import collect_metrics
+        self.last_query_metrics = collect_metrics(phys)
         tables = [device_to_arrow(b) for b in batches if b.num_rows_int > 0]
         arrow_schema = pa.schema([
             pa.field(a.name, T.to_arrow(a.dtype)) for a in logical.output])

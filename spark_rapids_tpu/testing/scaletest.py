@@ -129,6 +129,149 @@ def _q6(sess, t, F):
     assert got == exp
 
 
+def build_tpch_tables(rows: int, seed: int = 23) -> Dict[str, pa.Table]:
+    """lineitem-shaped table for the TPC-H q1/q6 milestones (BASELINE
+    config 2) — column shapes and value ranges follow the spec's
+    lineitem, scaled by ``rows``."""
+    rng = np.random.default_rng(seed)
+    base = np.datetime64("1992-01-01")
+    ship = base + rng.integers(0, 2526, rows).astype("timedelta64[D]")
+    lineitem = pa.table({
+        "l_quantity": pa.array(rng.integers(1, 51, rows).astype(np.float64)),
+        "l_extendedprice": pa.array(np.round(rng.random(rows) * 104949 + 901,
+                                             2)),
+        "l_discount": pa.array(np.round(rng.integers(0, 11, rows) * 0.01,
+                                        2)),
+        "l_tax": pa.array(np.round(rng.integers(0, 9, rows) * 0.01, 2)),
+        "l_returnflag": pa.array(rng.choice(["A", "N", "R"], rows)),
+        "l_linestatus": pa.array(rng.choice(["O", "F"], rows)),
+        "l_shipdate": pa.array(ship.astype("datetime64[D]")),
+    })
+    return {"lineitem": lineitem}
+
+
+def _tpch_q1(sess, t, F):
+    """TPC-H q1: pricing summary report (BASELINE milestone 2)."""
+    import datetime
+    li = sess.create_dataframe(t["lineitem"], num_partitions=4)
+    cutoff = datetime.date(1998, 9, 2)
+    got = (li.filter(li.l_shipdate <= F.lit(cutoff))
+           .withColumn("disc_price",
+                       li.l_extendedprice * (1.0 - li.l_discount))
+           .withColumn("charge", li.l_extendedprice
+                       * (1.0 - li.l_discount) * (1.0 + li.l_tax))
+           .groupBy("l_returnflag", "l_linestatus")
+           .agg(F.sum(F.col("l_quantity")).alias("sum_qty"),
+                F.sum(F.col("l_extendedprice")).alias("sum_base_price"),
+                F.sum(F.col("disc_price")).alias("sum_disc_price"),
+                F.sum(F.col("charge")).alias("sum_charge"),
+                F.avg(F.col("l_quantity")).alias("avg_qty"),
+                F.avg(F.col("l_extendedprice")).alias("avg_price"),
+                F.avg(F.col("l_discount")).alias("avg_disc"),
+                F.count("*").alias("count_order"))
+           .orderBy("l_returnflag", "l_linestatus")
+           .collect().to_pandas())
+    pdf = t["lineitem"].to_pandas()
+    pdf = pdf[pdf.l_shipdate <= cutoff]  # date32 -> date objects
+    dp = pdf.l_extendedprice * (1.0 - pdf.l_discount)
+    ch = dp * (1.0 + pdf.l_tax)
+    exp = (pd.DataFrame({
+        "rf": pdf.l_returnflag, "ls": pdf.l_linestatus,
+        "q": pdf.l_quantity, "p": pdf.l_extendedprice, "dp": dp,
+        "ch": ch, "d": pdf.l_discount})
+        .groupby(["rf", "ls"])
+        .agg(sum_qty=("q", "sum"), sum_base_price=("p", "sum"),
+             sum_disc_price=("dp", "sum"), sum_charge=("ch", "sum"),
+             avg_qty=("q", "mean"), avg_price=("p", "mean"),
+             avg_disc=("d", "mean"), count_order=("q", "size"))
+        .sort_index().reset_index())
+    assert list(got["l_returnflag"]) == list(exp["rf"])
+    assert list(got["l_linestatus"]) == list(exp["ls"])
+    for col in ("sum_qty", "sum_base_price", "sum_disc_price",
+                "sum_charge", "avg_qty", "avg_price", "avg_disc"):
+        assert np.allclose(got[col], exp[col]), col
+    assert np.array_equal(got["count_order"], exp["count_order"])
+
+
+def _tpch_q6(sess, t, F):
+    """TPC-H q6: forecast revenue change (BASELINE milestone 2)."""
+    import datetime
+    li = sess.create_dataframe(t["lineitem"], num_partitions=4)
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    got = (li.filter((li.l_shipdate >= F.lit(lo))
+                     & (li.l_shipdate < F.lit(hi))
+                     & (li.l_discount >= 0.05) & (li.l_discount <= 0.07)
+                     & (li.l_quantity < 24.0))
+           .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
+                .alias("revenue"))
+           .collect().to_pandas())
+    pdf = t["lineitem"].to_pandas()
+    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi)
+         & (pdf.l_discount >= 0.05) & (pdf.l_discount <= 0.07)
+         & (pdf.l_quantity < 24.0))
+    exp = float((pdf.l_extendedprice[m] * pdf.l_discount[m]).sum())
+    assert np.allclose(got["revenue"].fillna(0.0), exp)
+
+
+def build_tpcds_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
+    """store_sales star schema subset for the hash-join-heavy TPC-DS
+    milestone queries (BASELINE config 3: q3/q7/q19/q42 shapes)."""
+    rng = np.random.default_rng(seed)
+    n_items = max(rows // 50, 20)
+    n_dates = 365 * 5
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(rng.integers(0, n_dates, rows),
+                                    type=pa.int64()),
+        "ss_item_sk": pa.array(rng.integers(0, n_items, rows),
+                               type=pa.int64()),
+        "ss_ext_sales_price": pa.array(
+            np.round(rng.random(rows) * 1000, 2)),
+    })
+    date_dim = pa.table({
+        "d_date_sk": pa.array(np.arange(n_dates), type=pa.int64()),
+        "d_year": pa.array(1998 + (np.arange(n_dates) // 365),
+                           type=pa.int32()),
+        "d_moy": pa.array(1 + (np.arange(n_dates) % 365) // 31 % 12,
+                          type=pa.int32()),
+    })
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(n_items), type=pa.int64()),
+        "i_manufact_id": pa.array(rng.integers(0, 100, n_items),
+                                  type=pa.int32()),
+        "i_brand_id": pa.array(rng.integers(0, 40, n_items),
+                               type=pa.int32()),
+    })
+    return {"store_sales": store_sales, "date_dim": date_dim,
+            "item": item}
+
+
+def _tpcds_q3(sess, t, F):
+    """TPC-DS q3 shape: star join store_sales x date_dim x item with a
+    manufacturer + month filter, grouped revenue by (year, brand)."""
+    ss = sess.create_dataframe(t["store_sales"], num_partitions=4)
+    dd = sess.create_dataframe(t["date_dim"], num_partitions=2)
+    it = sess.create_dataframe(t["item"], num_partitions=2)
+    got = (ss.join(dd, ss.ss_sold_date_sk == dd.d_date_sk)
+           .join(it, ss.ss_item_sk == it.i_item_sk)
+           .filter((it.i_manufact_id == 7) & (dd.d_moy == 11))
+           .groupBy("d_year", "i_brand_id")
+           .agg(F.sum(F.col("ss_ext_sales_price")).alias("sum_agg"))
+           .orderBy("d_year", "i_brand_id")
+           .collect().to_pandas())
+    pdf = (t["store_sales"].to_pandas()
+           .merge(t["date_dim"].to_pandas(), left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+           .merge(t["item"].to_pandas(), left_on="ss_item_sk",
+                  right_on="i_item_sk"))
+    pdf = pdf[(pdf.i_manufact_id == 7) & (pdf.d_moy == 11)]
+    exp = (pdf.groupby(["d_year", "i_brand_id"])
+           .agg(sum_agg=("ss_ext_sales_price", "sum"))
+           .sort_index().reset_index())
+    assert np.array_equal(got["d_year"], exp["d_year"])
+    assert np.array_equal(got["i_brand_id"], exp["i_brand_id"])
+    assert np.allclose(got["sum_agg"], exp["sum_agg"])
+
+
 QUERIES: List[Tuple[str, Callable]] = [
     ("q1_filter_agg", _q1),
     ("q2_join_agg", _q2),
@@ -136,7 +279,14 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("q4_window_topn", _q4),
     ("q5_global_sort", _q5),
     ("q6_strings", _q6),
+    ("tpch_q1", _tpch_q1),
+    ("tpch_q6", _tpch_q6),
+    ("tpcds_q3_star_join", _tpcds_q3),
 ]
+
+#: table-set builders per query prefix (run_suite routes each query to
+#: the tables it expects)
+_TABLE_SETS = {"tpch": build_tpch_tables, "tpcds": build_tpcds_tables}
 
 
 def run_suite(rows: int = 50_000, queries=None, tables=None,
@@ -147,12 +297,20 @@ def run_suite(rows: int = 50_000, queries=None, tables=None,
     compiles amortized — the number to compare across rigs."""
     import spark_rapids_tpu as srt
     from ..sql import functions as F
-    t = tables if tables is not None else build_tables(rows)
+    base_tables = tables if tables is not None else build_tables(rows)
+    extra: Dict[str, Dict[str, pa.Table]] = {}
     sess = sess or srt.session()
     report = []
     for name, fn in QUERIES:
         if queries and name not in queries:
             continue
+        prefix = name.split("_", 1)[0]
+        if prefix in _TABLE_SETS:
+            if prefix not in extra:
+                extra[prefix] = _TABLE_SETS[prefix](rows)
+            t = extra[prefix]
+        else:
+            t = base_tables
         t0 = time.perf_counter()
         fn(sess, t, F)
         total = time.perf_counter() - t0
@@ -168,7 +326,17 @@ def run_suite(rows: int = 50_000, queries=None, tables=None,
 
 if __name__ == "__main__":
     import json
+    import os
     import sys
+
+    # the ambient sitecustomize forces the axon TPU tunnel via jax.config
+    # (env vars can't override it) and a hung tunnel would block this rig
+    # forever — flip the CONFIG to the host platform unless the caller
+    # explicitly asks for the chip (SRT_SCALE_PLATFORM=axon)
+    plat = os.environ.get("SRT_SCALE_PLATFORM", "cpu")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     for entry in run_suite(rows):
         print(json.dumps(entry))

@@ -131,3 +131,12 @@ def test_string_to_date_with_time_suffix(sess):
     got = df.select(df.s.cast("date").alias("d")).collect()["d"] \
         .to_pylist()
     assert got == [D.date(2020, 3, 18)] * 4
+
+
+def test_string_to_long_leading_zeros(sess):
+    df = sess.create_dataframe(pa.table({"s": [
+        "00000000000000000001", "0000000000000000000000",
+        "-000000000000000000009223372036854775807", "007"]}))
+    got = df.select(df.s.cast("bigint").alias("l")).collect()["l"] \
+        .to_pylist()
+    assert got == [1, 0, -9223372036854775807, 7]
