@@ -188,6 +188,18 @@ def parse_double(xp, chars, lengths, validity):
     return out, ok
 
 
+def _date_section_end(xp, c, pos, start, end):
+    """Position of the first 'T'/space after ``start`` (else ``end``) —
+    the boundary between the date part and an optional time section.
+    Shared by parse_date and parse_timestamp so the split rule can't
+    drift; inside one jit XLA CSEs the duplicate trim/cut subgraphs."""
+    width = c.shape[1]
+    bigw = xp.asarray(width, dtype=xp.int32)
+    t_or_sp = ((c == 84) | (c == _SP)) & (pos > start[:, None]) & \
+        (pos < end[:, None])
+    return xp.min(xp.where(t_or_sp, pos, bigw), axis=1).astype(xp.int32)
+
+
 def parse_date(xp, chars, lengths, validity):
     """(int32 days-since-epoch, ok): 'yyyy-MM-dd' / 'yyyy-M-d' plus bare
     'yyyy' and 'yyyy-MM' (Spark accepts those, defaulting month/day 1)."""
@@ -197,11 +209,7 @@ def parse_date(xp, chars, lengths, validity):
     start, end = _trimmed(xp, chars, lengths)
     # Spark's stringToDate accepts a trailing time section ('T...' or
     # ' ...'): the date part ends at the first T/space after the start
-    bigw = xp.asarray(width, dtype=xp.int32)
-    t_or_sp = ((c == 84) | (c == _SP)) & (pos > start[:, None]) & \
-        (pos < end[:, None])
-    cut = xp.min(xp.where(t_or_sp, pos, bigw), axis=1).astype(xp.int32)
-    end = xp.minimum(end, cut)
+    end = xp.minimum(end, _date_section_end(xp, c, pos, start, end))
     is_digit = (c >= _ZERO) & (c <= _NINE)
     dash = c == _MINUS
     in_str = (pos >= start[:, None]) & (pos < end[:, None])
@@ -306,3 +314,120 @@ def format_long(xp, vals, validity, width: int = 20):
     chars = xp.where((out_pos == 0) & neg[:, None],
                      xp.asarray(_MINUS, dtype=xp.uint8), chars)
     return chars.astype(xp.uint8), xp.where(validity, lengths, 0)
+
+
+def parse_timestamp(xp, chars, lengths, validity):
+    """(int64 micros-since-epoch UTC, ok): 'yyyy[-M[-d]][ |T HH:mm[:ss
+    [.fraction]]][zone]' where zone is 'Z', 'UTC', 'GMT' or a numeric
+    offset [+-]HH[:MM] (applied to UTC).  Named region zones and other
+    layouts return NULL — same rows the engine's host path rejects (the
+    engine runs in UTC; there is no per-row host fallback)."""
+    days, date_ok = parse_date(xp, chars, lengths, validity)
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    c = chars.astype(xp.int32)
+    start, end = _trimmed(xp, chars, lengths)
+    cut = _date_section_end(xp, c, pos, start, end)
+    has_time = cut < end
+    ts = cut + 1  # first char of the time section
+
+    is_digit = (c >= _ZERO) & (c <= _NINE)
+
+    def two_digits(at):
+        """1-2 digit group starting at `at`: (value, ndigits); ndigits=0
+        when the first char is not a digit (no partial matches)."""
+        d0 = _take(xp, c, at)
+        d1 = _take(xp, c, at + 1)
+        d0_ok = (at < end) & (d0 >= _ZERO) & (d0 <= _NINE)
+        d1_ok = d0_ok & (at + 1 < end) & (d1 >= _ZERO) & (d1 <= _NINE)
+        v = xp.where(d1_ok, (d0 - _ZERO) * 10 + (d1 - _ZERO),
+                     d0 - _ZERO)
+        n = xp.where(d1_ok, 2, xp.where(d0_ok, 1, 0))
+        return xp.where(d0_ok, v, 0), n
+
+    hh, hn = two_digits(ts)
+    c1 = ts + hn
+    has_min = has_time & (hn >= 1) & (_take(xp, c, c1) == 58) & (c1 < end)
+    mm, mn = two_digits(c1 + 1)
+    c2 = xp.where(has_min, c1 + 1 + mn, c1)
+    has_sec = has_min & (mn >= 1) & (_take(xp, c, c2) == 58) & (c2 < end)
+    ss_v, sn = two_digits(c2 + 1)
+    c3 = xp.where(has_sec, c2 + 1 + sn, c2)
+    has_frac = has_sec & (sn >= 1) & (_take(xp, c, c3) == _DOT) & (c3 < end)
+    # fraction: up to 6 digits of micros (deeper digits truncate; a bare
+    # trailing dot is legal, matching Spark's fraction segment)
+    fstart = c3 + 1
+    in_frac = has_frac[:, None] & (pos >= fstart[:, None]) & \
+        (pos < end[:, None]) & is_digit
+    # fraction digits run until the first non-digit (zone may follow)
+    non_digit_after = has_frac[:, None] & (pos >= fstart[:, None]) & \
+        (pos < end[:, None]) & ~is_digit
+    bigw = xp.asarray(width, dtype=xp.int32)
+    frac_stop = xp.min(xp.where(non_digit_after, pos, bigw),
+                       axis=1).astype(xp.int32)
+    frac_stop = xp.minimum(frac_stop, end)
+    in_frac = in_frac & (pos < frac_stop[:, None])
+    n_frac = xp.sum(in_frac.astype(xp.int32), axis=1)
+    fidx = pos - fstart[:, None]  # 0-based fraction digit index
+    fweight = xp.where((fidx >= 0) & (fidx < 6),
+                       xp.asarray(
+                           np.array([100000, 10000, 1000, 100, 10, 1],
+                                    dtype=np.int64))[xp.clip(fidx, 0, 5)],
+                       0)
+    micros_frac = xp.sum(xp.where(in_frac, (c - _ZERO) * fweight, 0),
+                         axis=1).astype(xp.int64)
+
+    time_end = xp.where(has_frac, xp.where(has_frac, frac_stop, c3),
+                        xp.where(has_sec, c2 + 1 + sn,
+                                 xp.where(has_min, c1 + 1 + mn,
+                                          ts + hn)))
+
+    # rows without a time section have no time_end; anchor it at end so
+    # the zone logic below sees "no zone" for bare dates
+    time_end = xp.where(has_time, time_end, end)
+
+    # zone suffix after the time: Z | UTC | GMT | [+-]HH[:MM], with one
+    # optional space before it ('... 12:03:17 UTC')
+    lower = xp.where((c >= 65) & (c <= 90), c + 32, c)
+
+    def word_is(at, word_s):
+        m = (end - at) == len(word_s)
+        for i, ch in enumerate(word_s):
+            m = m & (_take(xp, lower, at + i) == ord(ch))
+        return m
+
+    z_at = time_end + ((_take(xp, c, time_end) == _SP)
+                       & (time_end < end)).astype(xp.int32)
+    no_zone = z_at == end
+    z_named = word_is(z_at, "z") | word_is(z_at, "utc") | \
+        word_is(z_at, "gmt")
+    sign_ch = _take(xp, c, z_at)
+    z_sign = (sign_ch == _PLUS) | (sign_ch == _MINUS)
+    oh, ohn = two_digits(z_at + 1)
+    oc1 = z_at + 1 + ohn
+    off_has_min = z_sign & (ohn >= 1) & (_take(xp, c, oc1) == 58)
+    om, omn = two_digits(oc1 + 1)
+    off_end = xp.where(off_has_min, oc1 + 1 + omn, oc1)
+    z_offset_ok = (z_sign & (ohn >= 1) & (oh <= 18) & (off_end == end)
+                   & (~off_has_min | ((omn == 2) & (om <= 59))))
+    om = xp.where(off_has_min, om, 0)
+    offset_us = (oh.astype(xp.int64) * 3_600_000_000
+                 + om.astype(xp.int64) * 60_000_000)
+    offset_us = xp.where(sign_ch == _MINUS, -offset_us, offset_us)
+    zone_ok = no_zone | (has_time & (z_named | z_offset_ok))
+    offset_us = xp.where(has_time & z_offset_ok, offset_us, 0)
+
+    time_ok = zone_ok & ((~has_time) | (
+        (hn >= 1) & (hh <= 23)
+        & (~has_min | (mm <= 59))
+        & (~has_sec | (ss_v <= 59))
+        & has_min))  # Spark needs at least HH:mm after the separator
+    mm = xp.where(has_min, mm, 0)
+    ss_v = xp.where(has_sec, ss_v, 0)
+    micros = (days.astype(xp.int64) * 86_400_000_000
+              + xp.where(has_time, hh.astype(xp.int64), 0) * 3_600_000_000
+              + xp.where(has_time, mm.astype(xp.int64), 0) * 60_000_000
+              + xp.where(has_time, ss_v.astype(xp.int64), 0) * 1_000_000
+              + xp.where(has_time, micros_frac, 0)
+              - offset_us)
+    return micros, date_ok & time_ok

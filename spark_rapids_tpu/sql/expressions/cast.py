@@ -73,7 +73,8 @@ def device_string_cast_supported(ft, tt) -> bool:
         return (T.is_integral(tt) or isinstance(tt, (T.FloatType,
                                                      T.DoubleType,
                                                      T.BooleanType,
-                                                     T.DateType)))
+                                                     T.DateType,
+                                                     T.TimestampType)))
     if isinstance(tt, T.StringType):
         return T.is_integral(ft) or isinstance(ft, T.BooleanType)
     return False
@@ -100,6 +101,9 @@ def _device_string_cast(ctx, c: DeviceColumn, ft, tt):
             return fixed(tt, v, ok)
         if isinstance(tt, T.DateType):
             v, ok = CS.parse_date(xp, chars, lengths, valid)
+            return fixed(tt, v, ok)
+        if isinstance(tt, T.TimestampType):
+            v, ok = CS.parse_timestamp(xp, chars, lengths, valid)
             return fixed(tt, v, ok)
         return None
     if isinstance(tt, T.StringType):

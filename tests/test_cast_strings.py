@@ -140,3 +140,41 @@ def test_string_to_long_leading_zeros(sess):
     got = df.select(df.s.cast("bigint").alias("l")).collect()["l"] \
         .to_pylist()
     assert got == [1, 0, -9223372036854775807, 7]
+
+
+def test_string_to_timestamp(sess):
+    df = sess.create_dataframe(pa.table({"s": [
+        "2024-03-18 12:03:17", "2024-03-18T00:00:00.5",
+        "2024-03-18T23:59:59.123456", "2024-03-18", "2024-03-18 25:00:00",
+        "2024-03-18 12:03", "2024-03-18 12", "bad", None]}))
+    q = df.select(df.s.cast("timestamp").alias("t"))
+    assert "host" not in sess.explain(q)
+    got = [None if v is None else v.replace(tzinfo=None)
+           for v in q.collect()["t"].to_pylist()]  # engine runs UTC
+    assert got[0] == D.datetime(2024, 3, 18, 12, 3, 17)
+    assert got[1] == D.datetime(2024, 3, 18, 0, 0, 0, 500000)
+    assert got[2] == D.datetime(2024, 3, 18, 23, 59, 59, 123456)
+    assert got[3] == D.datetime(2024, 3, 18)  # bare date
+    assert got[4] is None          # hour out of range
+    assert got[5] == D.datetime(2024, 3, 18, 12, 3)
+    assert got[6] is None          # bare hour not accepted (Spark)
+    assert got[7] is None and got[8] is None
+
+
+def test_string_to_timestamp_zones(sess):
+    df = sess.create_dataframe(pa.table({"s": [
+        "2024-03-18T12:03:17Z", "2024-03-18 12:03:17+01:00",
+        "2024-03-18 12:03:17-05:30", "2024-03-18 12:03:17 UTC",
+        "2024-03-18 12:03:17 GMT", "2024-03-18 12:03:17.",
+        "2024-03-18 12:x5", "2024-03-18 12:03:17 Mars"]}))
+    got = [None if v is None else v.replace(tzinfo=None)
+           for v in df.select(df.s.cast("timestamp").alias("t"))
+           .collect()["t"].to_pylist()]
+    assert got[0] == D.datetime(2024, 3, 18, 12, 3, 17)
+    assert got[1] == D.datetime(2024, 3, 18, 11, 3, 17)  # +01:00 -> UTC
+    assert got[2] == D.datetime(2024, 3, 18, 17, 33, 17)
+    assert got[3] == D.datetime(2024, 3, 18, 12, 3, 17)
+    assert got[4] == D.datetime(2024, 3, 18, 12, 3, 17)
+    assert got[5] == D.datetime(2024, 3, 18, 12, 3, 17)  # trailing dot
+    assert got[6] is None   # malformed minute: NULL, never zero-filled
+    assert got[7] is None   # named region zone: unsupported -> NULL
