@@ -94,55 +94,92 @@ def _take(xp, c, idx):
     return c[rows, xp.clip(idx, 0, width - 1)]
 
 
-def parse_double(xp, chars, lengths, validity):
-    """(float64 values, ok): string -> double for the standard decimal
-    forms [+-]digits[.digits][eE[+-]digits].  Magnitudes are accumulated
-    in float64 positionally (same error class as any float parse that
-    rounds once per digit; exactly round-tripped values used in practice
-    match numpy's parse on round numbers).  Infinity/NaN words follow
-    Spark: 'Infinity', '-Infinity', 'NaN' (case-sensitive prefix rules
-    are relaxed to case-insensitive like Spark's CastStringToDouble)."""
-    width = chars.shape[1]
-    pos = xp.arange(width, dtype=xp.int32)[None, :]
-    c = chars.astype(xp.int32)
-    start, end = _trimmed(xp, chars, lengths)
+def _word_is(xp, lower, at, end, word_s):
+    """True where the content from ``at`` to ``end`` is exactly
+    ``word_s`` (lowercased chars) — the one fixed-word matcher shared by
+    bool/double/timestamp parsing."""
+    m = (end - at) == len(word_s)
+    for i, ch in enumerate(word_s):
+        m = m & (_take(xp, lower, at + i) == ord(ch))
+    return m
+
+
+def _mantissa_parts(xp, c, pos, start, end):
+    """Shared decimal-number scaffolding for parse_double/parse_decimal:
+    sign, mantissa span, dot/exponent positions and digit masks — ONE
+    copy of the split rules so the two parsers cannot drift."""
+    width = c.shape[1]
     n = end - start
     has_sign = (n > 0) & ((_take(xp, c, start) == _PLUS)
                           | (_take(xp, c, start) == _MINUS))
     neg = (n > 0) & (_take(xp, c, start) == _MINUS)
     dstart = start + has_sign.astype(xp.int32)
-
     lower = xp.where((c >= 65) & (c <= 90), c + 32, c)
-
-    def word_at(word, at):
-        m = xp.ones(c.shape[0], dtype=bool)
-        for i, ch in enumerate(word):
-            m = m & (_take(xp, lower, at + i) == ord(ch))
-        return m & (end - at == len(word))
-
-    is_inf = word_at("infinity", dstart) | word_at("inf", dstart)
-    is_nan = word_at("nan", start)
-
-    # exponent marker position (first e/E inside content), else end
-    is_e = ((lower == _E_LO)) & (pos >= dstart[:, None]) & \
+    is_digit = (c >= _ZERO) & (c <= _NINE)
+    bigw = xp.asarray(width, dtype=xp.int32)
+    is_e = (lower == _E_LO) & (pos >= dstart[:, None]) & \
         (pos < end[:, None])
-    big = xp.asarray(width, dtype=xp.int32)
-    e_pos = xp.min(xp.where(is_e, pos, big), axis=1).astype(xp.int32)
+    e_pos = xp.min(xp.where(is_e, pos, bigw), axis=1).astype(xp.int32)
     has_e = e_pos < end
     mant_end = xp.where(has_e, e_pos, end)
-    # dot position inside mantissa, else mant_end
     is_dot = (c == _DOT) & (pos >= dstart[:, None]) & \
         (pos < mant_end[:, None])
-    dot_pos = xp.min(xp.where(is_dot, pos, big), axis=1).astype(xp.int32)
+    dot_pos = xp.min(xp.where(is_dot, pos, bigw), axis=1).astype(xp.int32)
     has_dot = dot_pos < mant_end
     n_dots = xp.sum(is_dot.astype(xp.int32), axis=1)
-
     int_end = xp.where(has_dot, dot_pos, mant_end)
     in_int = (pos >= dstart[:, None]) & (pos < int_end[:, None])
     in_frac = has_dot[:, None] & (pos > dot_pos[:, None]) & \
         (pos < mant_end[:, None])
-    is_digit = (c >= _ZERO) & (c <= _NINE)
     digits_ok = xp.all(~(in_int | in_frac) | is_digit, axis=1)
+    return dict(n=n, neg=neg, dstart=dstart, lower=lower,
+                is_digit=is_digit, e_pos=e_pos, has_e=has_e,
+                mant_end=mant_end, dot_pos=dot_pos, has_dot=has_dot,
+                n_dots=n_dots, int_end=int_end, in_int=in_int,
+                in_frac=in_frac, digits_ok=digits_ok)
+
+
+def _exponent_value(xp, c, pos, mp, end):
+    """(exp int32, exp_ok): the [eE][+-]digits suffix.  n_exp_digits is
+    capped at 9 so the int32 digit sum cannot wrap back into range."""
+    es = mp["e_pos"] + 1
+    e_sign_ch = _take(xp, c, es)
+    e_has_sign = mp["has_e"] & ((e_sign_ch == _PLUS)
+                                | (e_sign_ch == _MINUS))
+    e_neg = mp["has_e"] & (e_sign_ch == _MINUS)
+    ed = es + e_has_sign.astype(xp.int32)
+    in_exp = mp["has_e"][:, None] & (pos >= ed[:, None]) & \
+        (pos < end[:, None])
+    exp_digits_ok = xp.all(~in_exp | mp["is_digit"], axis=1)
+    n_exp = xp.sum(in_exp.astype(xp.int32), axis=1)
+    eexp = xp.clip(end[:, None] - 1 - pos, 0, 8)
+    mag = xp.sum(xp.where(in_exp, (c - _ZERO) * xp.power(10, eexp), 0),
+                 axis=1).astype(xp.int32)
+    exp_ok = ~mp["has_e"] | ((n_exp >= 1) & (n_exp <= 9)
+                             & exp_digits_ok)
+    return xp.where(e_neg, -mag, mag), exp_ok
+
+
+def parse_double(xp, chars, lengths, validity):
+    """(float64 values, ok): string -> double for the standard decimal
+    forms [+-]digits[.digits][eE[+-]digits] plus Infinity/inf/NaN words
+    (case-insensitive, Spark CastStringToDouble).  Magnitudes accumulate
+    positionally in float64 — one rounding per digit, a few ULPs against
+    libc's exact parse (documented error class, fuzz-bounded <1e-13)."""
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    c = chars.astype(xp.int32)
+    start, end = _trimmed(xp, chars, lengths)
+    mp = _mantissa_parts(xp, c, pos, start, end)
+    neg, dstart, lower = mp["neg"], mp["dstart"], mp["lower"]
+    is_digit = mp["is_digit"]
+
+    is_inf = _word_is(xp, lower, dstart, end, "infinity") | \
+        _word_is(xp, lower, dstart, end, "inf")
+    is_nan = _word_is(xp, lower, start, end, "nan")
+
+    in_int, in_frac = mp["in_int"], mp["in_frac"]
+    dot_pos, int_end = mp["dot_pos"], mp["int_end"]
     n_mant_digits = xp.sum((in_int | in_frac).astype(xp.int32), axis=1)
 
     dig = xp.where(is_digit, c - _ZERO, 0).astype(xp.float64)
@@ -158,30 +195,14 @@ def parse_double(xp, chars, lengths, validity):
         axis=1)
     mant = int_val + frac_val
 
-    # exponent: optional sign + digits after e
-    easturt = e_pos + 1
-    e_sign_ch = _take(xp, c, easturt)
-    e_has_sign = has_e & ((e_sign_ch == _PLUS) | (e_sign_ch == _MINUS))
-    e_neg = has_e & (e_sign_ch == _MINUS)
-    ed_start = easturt + e_has_sign.astype(xp.int32)
-    in_exp = has_e[:, None] & (pos >= ed_start[:, None]) & \
-        (pos < end[:, None])
-    exp_digits_ok = xp.all(~in_exp | is_digit, axis=1)
-    n_exp_digits = xp.sum(in_exp.astype(xp.int32), axis=1)
-    eexp = xp.clip(end[:, None] - 1 - pos, 0, 18)
-    exp_val = xp.sum(xp.where(in_exp, (c - _ZERO).astype(xp.float64)
-                              * xp.power(xp.asarray(10.0, xp.float64),
-                                         eexp.astype(xp.float64)), 0.0),
-                     axis=1)
-    exp_val = xp.where(e_neg, -exp_val, exp_val)
-    exp_val = xp.clip(exp_val, -400.0, 400.0)
+    exp_val, exp_ok = _exponent_value(xp, c, pos, mp, end)
+    exp_f = xp.clip(exp_val.astype(xp.float64), -400.0, 400.0)
 
-    val = mant * xp.power(xp.asarray(10.0, dtype=xp.float64), exp_val)
+    val = mant * xp.power(xp.asarray(10.0, dtype=xp.float64), exp_f)
     val = xp.where(neg, -val, val)
 
-    plain_ok = (validity & (n > 0) & digits_ok & exp_digits_ok
-                & (n_mant_digits >= 1) & (n_dots <= 1)
-                & (~has_e | (n_exp_digits >= 1)))
+    plain_ok = (validity & (mp["n"] > 0) & mp["digits_ok"] & exp_ok
+                & (n_mant_digits >= 1) & (mp["n_dots"] <= 1))
     inf = xp.where(neg, -xp.inf, xp.inf)
     out = xp.where(is_inf, inf, xp.where(is_nan, xp.nan, val))
     ok = validity & (is_inf | is_nan | plain_ok)
@@ -271,13 +292,9 @@ def parse_bool(xp, chars, lengths, validity):
     c = chars.astype(xp.int32)
     lower = xp.where((c >= 65) & (c <= 90), c + 32, c)
     start, end = _trimmed(xp, chars, lengths)
-    n = end - start
 
-    def word(word_s):
-        m = n == len(word_s)
-        for i, ch in enumerate(word_s):
-            m = m & (_take(xp, lower, start + i) == ord(ch))
-        return m
+    def word(w):
+        return _word_is(xp, lower, start, end, w)
 
     t = word("true") | word("t") | word("yes") | word("y") | word("1")
     f = word("false") | word("f") | word("no") | word("n") | word("0")
@@ -377,7 +394,7 @@ def parse_timestamp(xp, chars, lengths, validity):
     micros_frac = xp.sum(xp.where(in_frac, (c - _ZERO) * fweight, 0),
                          axis=1).astype(xp.int64)
 
-    time_end = xp.where(has_frac, xp.where(has_frac, frac_stop, c3),
+    time_end = xp.where(has_frac, frac_stop,
                         xp.where(has_sec, c2 + 1 + sn,
                                  xp.where(has_min, c1 + 1 + mn,
                                           ts + hn)))
@@ -389,18 +406,12 @@ def parse_timestamp(xp, chars, lengths, validity):
     # zone suffix after the time: Z | UTC | GMT | [+-]HH[:MM], with one
     # optional space before it ('... 12:03:17 UTC')
     lower = xp.where((c >= 65) & (c <= 90), c + 32, c)
-
-    def word_is(at, word_s):
-        m = (end - at) == len(word_s)
-        for i, ch in enumerate(word_s):
-            m = m & (_take(xp, lower, at + i) == ord(ch))
-        return m
-
     z_at = time_end + ((_take(xp, c, time_end) == _SP)
                        & (time_end < end)).astype(xp.int32)
     no_zone = z_at == end
-    z_named = word_is(z_at, "z") | word_is(z_at, "utc") | \
-        word_is(z_at, "gmt")
+    z_named = _word_is(xp, lower, z_at, end, "z") | \
+        _word_is(xp, lower, z_at, end, "utc") | \
+        _word_is(xp, lower, z_at, end, "gmt")
     sign_ch = _take(xp, c, z_at)
     z_sign = (sign_ch == _PLUS) | (sign_ch == _MINUS)
     oh, ohn = two_digits(z_at + 1)
@@ -409,7 +420,9 @@ def parse_timestamp(xp, chars, lengths, validity):
     om, omn = two_digits(oc1 + 1)
     off_end = xp.where(off_has_min, oc1 + 1 + omn, oc1)
     z_offset_ok = (z_sign & (ohn >= 1) & (oh <= 18) & (off_end == end)
-                   & (~off_has_min | ((omn == 2) & (om <= 59))))
+                   & (~off_has_min | ((omn == 2) & (om <= 59)))
+                   # Java ZoneOffset caps at exactly +-18:00
+                   & ((oh < 18) | (xp.where(off_has_min, om, 0) == 0)))
     om = xp.where(off_has_min, om, 0)
     offset_us = (oh.astype(xp.int64) * 3_600_000_000
                  + om.astype(xp.int64) * 60_000_000)
@@ -421,6 +434,7 @@ def parse_timestamp(xp, chars, lengths, validity):
         (hn >= 1) & (hh <= 23)
         & (~has_min | (mm <= 59))
         & (~has_sec | (ss_v <= 59))
+        & (~has_frac | (n_frac <= 9))  # Spark caps the fraction segment
         & has_min))  # Spark needs at least HH:mm after the separator
     mm = xp.where(has_min, mm, 0)
     ss_v = xp.where(has_sec, ss_v, 0)
@@ -431,3 +445,112 @@ def parse_timestamp(xp, chars, lengths, validity):
               + xp.where(has_time, micros_frac, 0)
               - offset_us)
     return micros, date_ok & time_ok
+
+
+def parse_decimal(xp, chars, lengths, validity, precision: int,
+                  scale: int):
+    """(int64 unscaled values, ok): string -> decimal(p<=18, s), exact
+    integer arithmetic (no float round trip).  Accepts
+    [+-]digits[.digits][eE[+-]digits]; the value rounds HALF_UP to
+    ``scale``; overflow of ``precision`` digits -> not-ok (Spark
+    null-on-overflow).  Only the first 19 SIGNIFICANT mantissa digits
+    enter the integer accumulator; deeper digits fold into the scale
+    shift (they are below the rounding ulp except exact-half ties)."""
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    c = chars.astype(xp.int32)
+    start, end = _trimmed(xp, chars, lengths)
+    mp = _mantissa_parts(xp, c, pos, start, end)
+    in_mant = mp["in_int"] | mp["in_frac"]
+    is_digit = mp["is_digit"]
+    n_frac = xp.sum(mp["in_frac"].astype(xp.int32), axis=1)
+    n_mant = xp.sum(in_mant.astype(xp.int32), axis=1)
+    bigw = xp.asarray(width, dtype=xp.int32)
+
+    # significant digits (leading zeros free); only the first 19 enter
+    # the accumulator — deeper ones shift the exponent instead
+    nonzero = in_mant & is_digit & (c != _ZERO)
+    first_sig = xp.min(xp.where(nonzero, pos, bigw), axis=1) \
+        .astype(xp.int32)
+    sig = in_mant & (pos >= first_sig[:, None])
+    sig_idx = xp.cumsum(sig.astype(xp.int32), axis=1) - sig.astype(
+        xp.int32)  # 0-based ordinal among significant digits
+    kept = sig & (sig_idx < 19)
+    n_sig = xp.sum(sig.astype(xp.int32), axis=1)
+    n_kept = xp.minimum(n_sig, 19)
+    dropped = n_sig - n_kept  # trailing sig digits folded into the shift
+    after = (xp.cumsum(kept[:, ::-1].astype(xp.int32), axis=1)[:, ::-1]
+             - kept.astype(xp.int32))
+    pow10 = xp.asarray((10 ** np.arange(20, dtype=np.uint64))
+                       .astype(np.uint64))
+    place = pow10[xp.clip(after, 0, 18)]
+    mant = xp.sum(xp.where(kept, (c - _ZERO).astype(xp.uint64) * place,
+                           xp.asarray(0, dtype=xp.uint64)), axis=1)
+
+    exp_val, exp_ok = _exponent_value(xp, c, pos, mp, end)
+
+    # unscaled = mant * 10^shift, HALF_UP when shift < 0
+    shift = scale - n_frac + exp_val + dropped
+    # below -19 the value rounds to zero (mant < 10^19 => mant/10^20 < .1)
+    rounds_to_zero = shift < -19
+    shift_c = xp.clip(shift, -19, 18)
+    up = pow10[xp.clip(shift_c, 0, 18)]
+    down = pow10[xp.clip(-shift_c, 0, 19)]
+    scaled_up = mant * up
+    q = mant // down
+    r = mant - q * down
+    q = q + ((2 * r >= down) & (shift_c < 0)).astype(xp.uint64)
+    unscaled = xp.where(rounds_to_zero, xp.asarray(0, dtype=xp.uint64),
+                        xp.where(shift_c >= 0, scaled_up, q))
+    bound = xp.asarray(np.uint64(10 ** min(precision, 18) - 1))
+    # positive shifts must keep the product inside the 19-digit table
+    headroom_ok = rounds_to_zero | \
+        ((n_kept + xp.maximum(shift_c, 0)) <= 19)
+    ok = (validity & (n_mant >= 1) & (mp["n_dots"] <= 1)
+          & mp["digits_ok"] & exp_ok
+          & headroom_ok & (unscaled <= bound))
+    signed = xp.where(mp["neg"],
+                      (~unscaled + xp.asarray(1, dtype=xp.uint64)),
+                      unscaled).astype(xp.int64)
+    return signed, ok
+
+
+def format_decimal(xp, unscaled, validity, scale: int, width: int = 24):
+    """int64 unscaled decimal(p<=18, s) -> byte matrix: sign, integer
+    digits (at least one), '.' + exactly ``scale`` fraction digits when
+    scale > 0 (Java BigDecimal.toPlainString shapes)."""
+    neg = unscaled < 0
+    mag = xp.where(neg, (~unscaled.astype(xp.uint64))
+                   + xp.asarray(1, dtype=xp.uint64),
+                   unscaled.astype(xp.uint64))
+    pow10 = xp.asarray((10 ** np.arange(19, dtype=np.uint64))
+                       .astype(np.uint64))
+    digs = (mag[:, None] // pow10[None, ::-1]) % xp.asarray(
+        10, dtype=xp.uint64)  # 19 digits, most significant first
+    ndig = xp.maximum(
+        xp.sum((mag[:, None] >= pow10[None, :]).astype(xp.int32), axis=1),
+        1)
+    n_int = xp.maximum(ndig - scale, 1)  # integer digits incl. lone 0
+    total = n_int + (1 + scale if scale > 0 else 0) + neg.astype(xp.int32)
+    out_pos = xp.arange(width, dtype=xp.int32)[None, :]
+    sgn = neg.astype(xp.int32)[:, None]
+    # layout: [sign][int digits][. frac digits]
+    dot_at = sgn + n_int[:, None]
+    is_sign = (out_pos == 0) & neg[:, None]
+    is_dot = (scale > 0) & (out_pos == dot_at)
+    # digit ordinal (0 = most significant of the PRINTED number, which has
+    # max(ndig, scale+1) digits)
+    n_print = xp.maximum(ndig, scale + 1)
+    d_idx = xp.where(out_pos < dot_at, out_pos - sgn,
+                     out_pos - sgn - 1)  # skip the dot
+    in_digits = (out_pos >= sgn) & ~is_dot & \
+        (d_idx < n_print[:, None]) & (d_idx >= 0) & \
+        (out_pos < total[:, None])
+    src_col = 19 - n_print[:, None] + d_idx
+    gathered = xp.take_along_axis(
+        digs, xp.clip(src_col, 0, 18).astype(xp.int32), axis=1)
+    chars = xp.where(in_digits, gathered.astype(xp.uint8) + _ZERO, 0)
+    chars = xp.where(is_sign, xp.asarray(_MINUS, dtype=xp.uint8), chars)
+    chars = xp.where(is_dot & (out_pos < total[:, None]),
+                     xp.asarray(_DOT, dtype=xp.uint8), chars)
+    return chars.astype(xp.uint8), xp.where(validity, total, 0)

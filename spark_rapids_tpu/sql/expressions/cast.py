@@ -55,6 +55,14 @@ class Cast(UnaryExpression):
                     return out
                 raise NotImplementedError(
                     f"cast {ft} -> {tt} runs on the host path")
+            # host path: the byte-matrix kernels run under numpy too —
+            # using THE SAME parser on both backends keeps host fallback
+            # results identical to device results (the reference's
+            # CPU/GPU-identical contract); combos outside the kernel
+            # matrix keep the python-object path
+            out = _device_string_cast(ctx, c, ft, tt)
+            if out is not None:
+                return out
             return _host_string_cast(ctx, c, ft, tt)
         data, valid = _cast_fixed(xp, c, ft, tt)
         return fixed(tt, data, valid)
@@ -70,12 +78,16 @@ def _int_bounds(dt: T.DataType):
 #: to the host path and is tagged accordingly in overrides.py
 def device_string_cast_supported(ft, tt) -> bool:
     if isinstance(ft, T.StringType):
+        if isinstance(tt, T.DecimalType):
+            return tt.is_long_backed  # decimal128 parse stays host-side
         return (T.is_integral(tt) or isinstance(tt, (T.FloatType,
                                                      T.DoubleType,
                                                      T.BooleanType,
                                                      T.DateType,
                                                      T.TimestampType)))
     if isinstance(tt, T.StringType):
+        if isinstance(ft, T.DecimalType):
+            return ft.is_long_backed
         return T.is_integral(ft) or isinstance(ft, T.BooleanType)
     return False
 
@@ -105,6 +117,10 @@ def _device_string_cast(ctx, c: DeviceColumn, ft, tt):
         if isinstance(tt, T.TimestampType):
             v, ok = CS.parse_timestamp(xp, chars, lengths, valid)
             return fixed(tt, v, ok)
+        if isinstance(tt, T.DecimalType) and tt.is_long_backed:
+            v, ok = CS.parse_decimal(xp, chars, lengths, valid,
+                                     tt.precision, tt.scale)
+            return fixed(tt, v, ok)
         return None
     if isinstance(tt, T.StringType):
         if isinstance(ft, T.BooleanType):
@@ -121,6 +137,10 @@ def _device_string_cast(ctx, c: DeviceColumn, ft, tt):
         if T.is_integral(ft):
             chars, lengths = CS.format_long(
                 xp, c.data.astype(xp.int64), c.validity)
+            return DeviceColumn(tt, chars, c.validity, lengths=lengths)
+        if isinstance(ft, T.DecimalType) and ft.is_long_backed:
+            chars, lengths = CS.format_decimal(
+                xp, c.data.astype(xp.int64), c.validity, ft.scale)
             return DeviceColumn(tt, chars, c.validity, lengths=lengths)
         return None
     return None

@@ -159,6 +159,16 @@ class ExprMeta:
                         f"cast {ft.simple_string()} -> "
                         f"{e.to.simple_string()} runs on the host "
                         "(outside the device CastStrings-analog matrix)")
+                elif isinstance(e.to, T.TimestampType) or isinstance(
+                        ft, T.TimestampType):
+                    # zoneless strings parse in the SESSION timezone;
+                    # the device kernel is UTC-only (same gate as the
+                    # timezone-aware datetime ops)
+                    from .expressions.datetime import _tz_reason
+                    from ..config import SESSION_TIMEZONE
+                    reason = _tz_reason(self.conf.get(SESSION_TIMEZONE))
+                    if reason:
+                        self.will_not_work(f"cast: {reason}")
         for c in self.children:
             c.tag()
 

@@ -178,3 +178,75 @@ def test_string_to_timestamp_zones(sess):
     assert got[5] == D.datetime(2024, 3, 18, 12, 3, 17)  # trailing dot
     assert got[6] is None   # malformed minute: NULL, never zero-filled
     assert got[7] is None   # named region zone: unsupported -> NULL
+
+
+def test_string_to_decimal(sess):
+    import decimal as DEC
+    df = sess.create_dataframe(pa.table({"s": [
+        "12.34", "-0.005", "1e2", "2.5e-1", "12.345", "12.344",
+        "99999999.99", "100000000.00", "0", ".5", "abc", None,
+        "  7.1  "]}))
+    q = df.select(df.s.cast("decimal(10,2)").alias("d"))
+    assert "host" not in sess.explain(q)
+    got = q.collect()["d"].to_pylist()
+    D2 = lambda s: DEC.Decimal(s)
+    assert got == [D2("12.34"), D2("-0.01"), D2("100.00"), D2("0.25"),
+                   D2("12.35"), D2("12.34"), D2("99999999.99"), None,
+                   D2("0.00"), D2("0.50"), None, None, D2("7.10")]
+
+
+def test_decimal_to_string(sess):
+    import decimal as DEC
+    df = sess.create_dataframe(pa.table({"d": pa.array(
+        [DEC.Decimal("12.34"), DEC.Decimal("-0.05"), DEC.Decimal("0.00"),
+         DEC.Decimal("-123456.78"), None], type=pa.decimal128(10, 2))}))
+    q = df.select(df.d.cast("string").alias("s"))
+    assert "host" not in sess.explain(q)
+    got = q.collect()["s"].to_pylist()
+    assert got == ["12.34", "-0.05", "0.00", "-123456.78", None]
+
+
+def test_decimal_string_roundtrip_fuzz(sess):
+    import decimal as DEC
+    rng = np.random.default_rng(12)
+    vals = [DEC.Decimal(int(v)) / 100 for v in
+            rng.integers(-10**12, 10**12, 2000)]
+    df = sess.create_dataframe(pa.table({"d": pa.array(
+        vals, type=pa.decimal128(14, 2))}))
+    back = (df.select(df.d.cast("string").cast("decimal(14,2)").alias("r"))
+            .collect()["r"].to_pylist())
+    assert back == vals
+
+
+def test_host_and_device_string_casts_agree(sess):
+    """The numpy host path runs the SAME byte-matrix parsers, so host
+    fallback and device placement return identical rows (the reference's
+    CPU/GPU-identical contract)."""
+    strs = ["2024-03-18T12:03", "2024-03-18 12:03:17+01:00", "12.5",
+            " -7 ", "1e3", "9223372036854775808", "2024-02-30", "t",
+            None, "  3.25  "]
+    t = pa.table({"s": strs})
+
+    def run(s):
+        df = s.create_dataframe(t)
+        return (df.select(df.s.cast("timestamp").alias("ts"),
+                          df.s.cast("bigint").alias("l"),
+                          df.s.cast("double").alias("d"),
+                          df.s.cast("boolean").alias("b"))
+                .collect().to_pylist())
+    try:
+        dev = run(srt.session())
+        host = run(srt.session(**{"spark.rapids.sql.enabled": False}))
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
+    assert dev == host
+
+
+def test_timestamp_cast_non_utc_session_falls_back(sess):
+    s = srt.session(**{"spark.sql.session.timeZone": "America/New_York"})
+    try:
+        df = s.create_dataframe(pa.table({"s": ["2024-03-18 12:00:00"]}))
+        rep = s.explain(df.select(df.s.cast("timestamp").alias("t")))
+        assert "timezone" in rep
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
