@@ -280,6 +280,17 @@ FILECACHE_MAX_BYTES = register(
     "spark.rapids.filecache.maxBytes",
     "Evict least-recently-used cached files past this total size.",
     16 << 30)
+FATAL_DUMP_PATH = register(
+    "spark.rapids.tpu.fatalDump.path",
+    "Directory for fatal-device-error diagnostics bundles (exception, "
+    "backend/device state, spill catalog) — the GpuCoreDumpHandler "
+    "analog; empty disables capture.", "")
+FATAL_ERROR_EXIT = register(
+    "spark.rapids.tpu.fatalErrorExit",
+    "Self-terminate the process with exit code 20 on a fatal device "
+    "error so an external scheduler replaces it (the reference "
+    "executor's behavior, Plugin.scala:515-539). Off by default: this "
+    "engine usually runs inside the user's process.", False)
 CONCURRENT_PYTHON_WORKERS = register(
     "spark.rapids.python.concurrentPythonWorkers",
     "Max concurrently-running user-Python sections (pandas UDFs, "

@@ -1,0 +1,105 @@
+"""Fatal device-error handling — the ``GpuCoreDumpHandler`` /
+fatal-``CudaFatalException`` analog (reference ``Plugin.scala:515-539``:
+a fatal CUDA error makes the executor capture a GPU core dump
+(``GpuCoreDumpHandler.scala:57+``), log nvidia-smi state, and
+self-terminate with exit code 20 so Spark reschedules the work on a
+healthy executor; non-fatal errors stay task-local).
+
+TPU analog: a runtime ``XlaRuntimeError`` that is NOT a memory condition
+means the device/tunnel is in an unknown state.  The guard captures a
+diagnostics bundle (exception, backend/device info, spill-catalog state,
+live config) to ``spark.rapids.tpu.fatalDump.path`` and raises
+:class:`FatalDeviceError`; with ``spark.rapids.tpu.fatalErrorExit`` the
+process self-terminates with exit code 20 like the reference executor
+(off by default — this engine usually runs in the user's process)."""
+
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Optional
+
+#: reference exit code for fatal device errors (Plugin.scala:515-539)
+FATAL_EXIT_CODE = 20
+
+#: observability for tests
+STATS = {"fatal_errors": 0, "dumps_written": 0}
+
+
+class FatalDeviceError(RuntimeError):
+    """The device runtime failed outside the OOM protocol; computation
+    state is unknown and the query must not be retried in-process."""
+
+    def __init__(self, message: str, dump_path: Optional[str] = None):
+        super().__init__(message)
+        self.dump_path = dump_path
+
+
+def is_fatal_device_error(exc: BaseException) -> bool:
+    """XlaRuntimeError that is NOT a memory condition (those go through
+    the spill/retry protocol in oom_guard)."""
+    from .oom_guard import is_device_oom
+    name = type(exc).__name__
+    if "XlaRuntimeError" not in name:
+        return False
+    return not is_device_oom(exc)
+
+
+def _diagnostics(exc: BaseException) -> str:
+    lines = [f"fatal device error at {time.strftime('%Y-%m-%dT%H:%M:%S')}",
+             "", "exception:",
+             "".join(traceback.format_exception(exc)).rstrip(), ""]
+    try:
+        import jax
+        lines.append(f"jax {jax.__version__}, backend "
+                     f"{jax.default_backend()}")
+        for d in jax.devices():
+            lines.append(f"  device: {d}")
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if stats:
+                lines.append(f"    memory_stats: {stats}")
+    except Exception as e:  # the backend may be the thing that died
+        lines.append(f"(device enumeration failed: {type(e).__name__}: {e})")
+    try:
+        from .spill import BufferCatalog
+        cat = BufferCatalog.get()
+        lines.append(f"spill catalog: device={cat.device_bytes}B "
+                     f"host={cat.host_bytes}B spills={cat.spill_count} "
+                     f"unspills={cat.unspill_count}")
+    except Exception:
+        pass
+    return "\n".join(lines) + "\n"
+
+
+def handle_fatal(exc: BaseException, conf=None) -> "FatalDeviceError":
+    """Capture diagnostics and build the FatalDeviceError to raise; exits
+    the process instead when fatalErrorExit is set (reference executor
+    behavior)."""
+    from ..config import (FATAL_DUMP_PATH, FATAL_ERROR_EXIT, RapidsConf)
+    conf = conf or RapidsConf.get_global()
+    STATS["fatal_errors"] += 1
+    dump_path = None
+    target = str(conf.get(FATAL_DUMP_PATH) or "")
+    if target:
+        try:
+            os.makedirs(target, exist_ok=True)
+            import tempfile
+            fd, dump_path = tempfile.mkstemp(
+                prefix=f"fatal-{int(time.time())}-{os.getpid()}-",
+                suffix=".txt", dir=target)
+            with os.fdopen(fd, "w") as fh:
+                fh.write(_diagnostics(exc))
+            STATS["dumps_written"] += 1
+        except OSError:
+            dump_path = None
+    err = FatalDeviceError(
+        f"fatal device error (diagnostics: {dump_path or 'not captured'})"
+        f": {type(exc).__name__}: {exc}", dump_path)
+    if bool(conf.get(FATAL_ERROR_EXIT)):
+        # the reference executor exits so the scheduler replaces it
+        import sys
+        sys.stderr.write(str(err) + "\n")
+        sys.stderr.flush()
+        os._exit(FATAL_EXIT_CODE)
+    return err

@@ -45,6 +45,14 @@ def guard_device_oom(fn: Callable) -> Callable:
             return _sync(fn(*args, **kwargs))
         except Exception as e:  # noqa: BLE001 — filtered below
             if not is_device_oom(e):
+                from .fatal import handle_fatal, is_fatal_device_error
+                if is_fatal_device_error(e):
+                    # device/tunnel state unknown: capture diagnostics,
+                    # don't enter the spill/retry protocol
+                    from ..sql.physical.base import TaskContext
+                    task = TaskContext.current()
+                    raise handle_fatal(
+                        e, conf=task.conf if task else None) from e
                 raise
             STATS["oom_caught"] += 1
             from .spill import BufferCatalog
@@ -58,6 +66,14 @@ def guard_device_oom(fn: Callable) -> Callable:
                     raise SplitAndRetryOOM(
                         f"device OOM persisted after spilling all "
                         f"buffers: {e2}") from None
+                # the retry itself may hit a WEDGED device (the exact
+                # scenario fatal handling exists for)
+                from .fatal import handle_fatal, is_fatal_device_error
+                if is_fatal_device_error(e2):
+                    from ..sql.physical.base import TaskContext
+                    task = TaskContext.current()
+                    raise handle_fatal(
+                        e2, conf=task.conf if task else None) from e2
                 raise
             STATS["oom_retry_ok"] += 1
             return result

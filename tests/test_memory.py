@@ -1,6 +1,7 @@
 """Memory runtime tests — spill tiers, retry framework, semaphore, task
 completion (reference suites: RapidsDiskStoreSuite, RapidsHostMemoryStoreSuite,
 WithRetrySuite, GpuSortRetrySuite; SURVEY §4 tier 2)."""
+import os
 
 import threading
 import time
@@ -345,3 +346,54 @@ class TestRealAllocatorHookup:
 
         with pytest.raises(ValueError):
             G.guard_device_oom(boom)()
+
+
+class TestFatalDeviceErrors:
+    """GpuCoreDumpHandler analog: fatal XlaRuntimeErrors capture a
+    diagnostics bundle and surface as FatalDeviceError (never entering
+    the OOM spill/retry protocol)."""
+
+    def _fake_xla_error(self, msg):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        return XlaRuntimeError(msg)
+
+    def test_fatal_classification(self):
+        from spark_rapids_tpu.memory.fatal import is_fatal_device_error
+        assert is_fatal_device_error(self._fake_xla_error("INTERNAL: boom"))
+        assert not is_fatal_device_error(
+            self._fake_xla_error("RESOURCE_EXHAUSTED: out of memory"))
+        assert not is_fatal_device_error(ValueError("x"))
+
+    def test_guard_raises_fatal_with_dump(self, tmp_path):
+        import spark_rapids_tpu as srt
+        from spark_rapids_tpu.memory.fatal import FatalDeviceError
+        from spark_rapids_tpu.memory.oom_guard import guard_device_oom
+        s = srt.session(**{"spark.rapids.tpu.fatalDump.path": str(tmp_path)})
+        try:
+            err = self._fake_xla_error("INTERNAL: compilation blew up")
+
+            def kernel():
+                raise err
+            from spark_rapids_tpu.sql.physical.base import TaskContext
+            with pytest.raises(FatalDeviceError) as ei, \
+                    TaskContext(0, s._conf).as_current():
+                guard_device_oom(kernel)()
+            assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+            body = open(ei.value.dump_path).read()
+            assert "compilation blew up" in body
+            assert "spill catalog" in body
+        finally:
+            srt.session(**{"spark.rapids.sql.enabled": True})
+
+    def test_oom_still_routes_to_retry_protocol(self):
+        from spark_rapids_tpu.memory import fatal as FT
+        from spark_rapids_tpu.memory.oom_guard import guard_device_oom
+        from spark_rapids_tpu.memory.retry import SplitAndRetryOOM
+        before = FT.STATS["fatal_errors"]
+        err = self._fake_xla_error("RESOURCE_EXHAUSTED: out of memory")
+
+        def kernel():
+            raise err
+        with pytest.raises(SplitAndRetryOOM):
+            guard_device_oom(kernel)()
+        assert FT.STATS["fatal_errors"] == before  # not classified fatal
