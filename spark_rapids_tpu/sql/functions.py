@@ -821,3 +821,11 @@ def flatten(c) -> Column:
 
 def map_concat(*cols) -> Column:
     return Column(CL.MapConcat(*[_c(c) for c in cols]))
+
+
+def sumDistinct(c):
+    return Column(AG.AggregateExpression(AG.Sum(_c(c)), is_distinct=True))
+
+
+sum_distinct = sumDistinct
+count_distinct = countDistinct

@@ -102,9 +102,8 @@ class ExprMeta:
                 if reason:
                     self.will_not_work(
                         f"{type(e.func).__name__}: {reason}")
-            if e.is_distinct:
-                self.will_not_work("DISTINCT aggregates are not yet supported "
-                                   "on TPU")
+            # DISTINCT support is a PLAN-shape property (dedup-then-
+            # aggregate rewrite); PlanMeta.tag checks the whole node
         elif isinstance(e, AGG.AggregateFunction):
             if not isinstance(e, _SUPPORTED_AGGS):
                 self.will_not_work(

@@ -286,6 +286,10 @@ class HashAggregateExec(PhysicalPlan):
             if aggs:
                 func = aggs[0]
                 if isinstance(func, AggregateExpression):
+                    if func.is_distinct:
+                        raise NotImplementedError(
+                            "DISTINCT aggregate reached the exec without "
+                            "the planner's dedup rewrite")
                     func = func.func
                 self._out_spec.append(("agg", len(self._agg_funcs), name))
                 self._agg_funcs.append(func)

@@ -177,6 +177,18 @@ class Planner:
     # ------------------------------------------------------------------
     def _plan_aggregate(self, node: P.Aggregate, child: PhysicalPlan, be):
         from .expressions.aggregates import AggregateFunction
+        distinct, regular = _collect_distinct(node)
+        if distinct:
+            if not distinct_rewrite_applies(node, (distinct, regular)):
+                raise NotImplementedError(
+                    "DISTINCT aggregates are only supported when every "
+                    "aggregate in the statement is DISTINCT over the same "
+                    "columns, with plain-column grouping keys and no "
+                    "FILTER clause (mixed forms need Spark's Expand plan, "
+                    "which no engine path implements yet)")
+            inner, outer = self._rewrite_distinct(node)
+            inner_exec = self._plan_aggregate(inner, child, be)
+            return self._plan_aggregate(outer, inner_exec, be)
         nparts = child.num_partitions()
         special = any(
             getattr(f, "requires_shuffle_complete", False)
@@ -207,6 +219,52 @@ class Planner:
         shuffled = ShuffleExchangeExec(part, partial, backend=be)
         return HashAggregateExec(node.grouping, node.aggregates, "final",
                                  shuffled, backend=be)
+
+    def _rewrite_distinct(self, node: P.Aggregate):
+        """count/sum/avg(DISTINCT x[, y...]) GROUP BY k  ->
+        (inner dedup aggregate over (k, x, y...), outer aggregate of the
+        plain functions over the deduped rows).  Returns (inner, outer)
+        logical nodes, or None when the node has no DISTINCT aggregates
+        or the mixed shape that needs Spark's Expand (stays on host)."""
+        from .expressions.aggregates import AggregateExpression
+        from .expressions.core import Alias
+        distinct, _ = _collect_distinct(node)
+        dchildren = list(distinct[0].func.children)
+        # inner: dedup via group-by over grouping + distinct children
+        # (grouping keys are plain attributes — distinct_rewrite_applies
+        # guarantees it, so outer outputs rebind by name)
+        inner_outs = list(node.grouping)
+        dnames = []
+        for j, ch in enumerate(dchildren):
+            nm = f"__dv{j}"
+            dnames.append(nm)
+            inner_outs.append(Alias(ch, nm))
+        inner = P.Aggregate(tuple(node.grouping) + tuple(dchildren),
+                            tuple(inner_outs), node.children[0])
+        inner_attrs = inner.output
+        key_attrs = inner_attrs[:len(node.grouping)]
+        d_attrs = inner_attrs[len(node.grouping):]
+
+        # outer: original outputs with DISTINCT dropped and children
+        # rebound to the deduped columns
+        def rewrite(e):
+            if isinstance(e, AggregateExpression) and e.is_distinct:
+                f = e.func.with_children(tuple(d_attrs))
+                return AggregateExpression(f, e.mode, False, e.filter)
+            if not getattr(e, "children", ()):  # leaf (incl. grouping ref)
+                return e
+            return e.with_children(tuple(rewrite(c) for c in e.children))
+
+        outer_outs = []
+        for e in node.aggregates:
+            if isinstance(e, AttributeReference):
+                # grouping passthrough: rebind by name to the inner output
+                match = [a for a in key_attrs if a.name == e.name]
+                outer_outs.append(match[0] if match else e)
+            else:
+                outer_outs.append(rewrite(e))
+        outer = P.Aggregate(tuple(key_attrs), tuple(outer_outs), inner)
+        return inner, outer
 
     def _plan_window(self, node: P.Window, child: PhysicalPlan, be):
         from ..sql.plan import SortOrder
@@ -378,3 +436,44 @@ def _annotate_window_group_limits(node, out, parents) -> None:
             continue
         out[id(win)] = (rank_outputs[name], int(k))
         return
+
+
+def _collect_distinct(node: "P.Aggregate"):
+    """(distinct AggregateExpressions, regular agg funcs) in the node."""
+    from .expressions.aggregates import (AggregateExpression,
+                                         AggregateFunction)
+    distinct, regular = [], []
+    for e in node.aggregates:
+        wrapped = e.collect(lambda x: isinstance(x, AggregateExpression))
+        for a in wrapped:
+            (distinct if a.is_distinct else regular).append(a)
+        wrapped_funcs = {id(a.func) for a in wrapped}
+        for a in e.collect(lambda x: isinstance(x, AggregateFunction)):
+            if id(a) not in wrapped_funcs:
+                regular.append(a)  # bare function, never DISTINCT
+    return distinct, regular
+
+
+def distinct_rewrite_applies(node: "P.Aggregate",
+                             precollected=None):
+    """DISTINCT aggregates plan as dedup-then-aggregate when every
+    aggregate in the node is DISTINCT over the SAME child expressions
+    with no FILTER clause, and the grouping keys are plain columns (the
+    common count(DISTINCT x)/sum(DISTINCT x) shapes).  Anything else —
+    mixed DISTINCT+plain (Spark's Expand plan), differing children,
+    filtered or expression-keyed forms — raises at planning: no engine
+    path computes those correctly yet, and a silent non-distinct answer
+    is worse than an error."""
+    distinct, regular = (precollected if precollected is not None
+                         else _collect_distinct(node))
+    if not distinct:
+        return False
+    if regular:
+        return False
+    if any(d.filter is not None for d in distinct):
+        return False
+    if not all(isinstance(g, AttributeReference) for g in node.grouping):
+        return False
+    keys = {tuple(c.semantic_key() for c in d.func.children)
+            for d in distinct}
+    return len(keys) == 1 and all(d.func.children for d in distinct)

@@ -369,3 +369,66 @@ def test_unscaled_value_and_make_decimal(session):
         .collect()
     assert back["d"].to_pylist() == [D.Decimal("12.34"),
                                      D.Decimal("-0.01"), None]
+
+
+# --- DISTINCT aggregates (dedup-then-aggregate rewrite) --------------------
+
+def test_count_distinct_on_device(session):
+    df = session.create_dataframe(pa.table({
+        "k": [1, 1, 1, 2, 2, 2],
+        "v": pa.array([5, 5, None, 9, 9, 8], type=pa.int64())}),
+        num_partitions=3)
+    q = df.groupBy("k").agg(F.countDistinct(F.col("v")).alias("c"))
+    ex = session.explain(q)
+    assert "host" not in ex, ex
+    out = q.orderBy("k").collect().to_pylist()
+    # count(DISTINCT v) ignores nulls (Spark)
+    assert out == [{"k": 1, "c": 1}, {"k": 2, "c": 2}]
+
+
+def test_sum_distinct_and_strings(session):
+    df = session.create_dataframe(pa.table({
+        "k": ["a", "a", "b", "b", "b"],
+        "v": [2.0, 2.0, 3.0, 3.0, 4.0]}), num_partitions=2)
+    out = (df.groupBy("k").agg(F.sumDistinct(F.col("v")).alias("s"))
+           .orderBy("k").collect().to_pylist())
+    assert out == [{"k": "a", "s": 2.0}, {"k": "b", "s": 7.0}]
+
+
+def test_count_distinct_single_column(session):
+    df = session.create_dataframe(pa.table({
+        "k": [1, 1, 1, 1], "a": [1, 1, 2, 2]}))
+    out = (df.groupBy("k").agg(F.countDistinct(F.col("a")).alias("c"))
+           .collect().to_pylist())
+    assert out == [{"k": 1, "c": 2}]
+
+
+def test_mixed_distinct_raises_loudly(session):
+    """Mixed DISTINCT + plain aggregates need Spark's Expand plan; no
+    engine path computes them yet, so planning raises instead of silently
+    returning the non-distinct answer."""
+    df = session.create_dataframe(pa.table({"k": [1, 1], "v": [5.0, 5.0]}))
+    q = df.groupBy("k").agg(F.countDistinct(F.col("v")).alias("c"),
+                            F.sum(F.col("v")).alias("s"))
+    with pytest.raises(NotImplementedError, match="DISTINCT"):
+        q.collect()
+
+
+def test_distinct_device_vs_host_oracle(session):
+    rng = np.random.default_rng(21)
+    t = pa.table({"g": rng.integers(0, 10, 5000),
+                  "v": rng.integers(0, 30, 5000)})
+    q = lambda s: (s.create_dataframe(t, num_partitions=4).groupBy("g")
+                   .agg(F.countDistinct(F.col("v")).alias("c"))
+                   .orderBy("g").collect().to_pylist())
+    import spark_rapids_tpu as srt
+    try:
+        dev = q(srt.session())
+        host = q(srt.session(**{"spark.rapids.sql.enabled": False}))
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
+    assert dev == host
+    pdf = t.to_pandas()
+    want = pdf.groupby("g")["v"].nunique()
+    for r in dev:
+        assert r["c"] == want[r["g"]]
