@@ -195,8 +195,12 @@ def count(c="*"):
     return Column(AG.Count(_c(c)))
 
 
-def countDistinct(c):
-    return Column(AG.AggregateExpression(AG.Count(_c(c)), is_distinct=True))
+def countDistinct(*cols):
+    """count(DISTINCT a[, b...]): distinct fully-non-null tuples."""
+    if not cols:
+        raise TypeError("countDistinct() requires at least one column")
+    return Column(AG.AggregateExpression(
+        AG.Count(*[_c(c) for c in cols]), is_distinct=True))
 
 
 def first(c, ignorenulls: bool = False):

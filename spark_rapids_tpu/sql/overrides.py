@@ -102,8 +102,9 @@ class ExprMeta:
                 if reason:
                     self.will_not_work(
                         f"{type(e.func).__name__}: {reason}")
-            # DISTINCT support is a PLAN-shape property (dedup-then-
-            # aggregate rewrite); PlanMeta.tag checks the whole node
+            # DISTINCT support is a PLAN-shape property: the planner's
+            # dedup-then-aggregate rewrite handles the uniform shape and
+            # raises (never silently de-DISTINCTs) on the rest
         elif isinstance(e, AGG.AggregateFunction):
             if not isinstance(e, _SUPPORTED_AGGS):
                 self.will_not_work(

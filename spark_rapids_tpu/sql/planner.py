@@ -180,13 +180,8 @@ class Planner:
         distinct, regular = _collect_distinct(node)
         if distinct:
             if not distinct_rewrite_applies(node, (distinct, regular)):
-                raise NotImplementedError(
-                    "DISTINCT aggregates are only supported when every "
-                    "aggregate in the statement is DISTINCT over the same "
-                    "columns, with plain-column grouping keys and no "
-                    "FILTER clause (mixed forms need Spark's Expand plan, "
-                    "which no engine path implements yet)")
-            inner, outer = self._rewrite_distinct(node)
+                raise NotImplementedError(UNSUPPORTED_DISTINCT_MSG)
+            inner, outer = self._rewrite_distinct(node, distinct)
             inner_exec = self._plan_aggregate(inner, child, be)
             return self._plan_aggregate(outer, inner_exec, be)
         nparts = child.num_partitions()
@@ -220,15 +215,13 @@ class Planner:
         return HashAggregateExec(node.grouping, node.aggregates, "final",
                                  shuffled, backend=be)
 
-    def _rewrite_distinct(self, node: P.Aggregate):
+    def _rewrite_distinct(self, node: P.Aggregate, distinct):
         """count/sum/avg(DISTINCT x[, y...]) GROUP BY k  ->
         (inner dedup aggregate over (k, x, y...), outer aggregate of the
-        plain functions over the deduped rows).  Returns (inner, outer)
-        logical nodes, or None when the node has no DISTINCT aggregates
-        or the mixed shape that needs Spark's Expand (stays on host)."""
+        plain functions over the deduped rows).  Caller has established
+        distinct_rewrite_applies(); ``distinct`` is its collected list."""
         from .expressions.aggregates import AggregateExpression
         from .expressions.core import Alias
-        distinct, _ = _collect_distinct(node)
         dchildren = list(distinct[0].func.children)
         # inner: dedup via group-by over grouping + distinct children
         # (grouping keys are plain attributes — distinct_rewrite_applies
@@ -258,9 +251,14 @@ class Planner:
         outer_outs = []
         for e in node.aggregates:
             if isinstance(e, AttributeReference):
-                # grouping passthrough: rebind by name to the inner output
-                match = [a for a in key_attrs if a.name == e.name]
-                outer_outs.append(match[0] if match else e)
+                # grouping passthrough: POSITIONAL rebind (name matching
+                # would pick the wrong column under duplicate names)
+                idx = [j for j, g in enumerate(node.grouping) if g is e
+                       or (isinstance(g, AttributeReference)
+                           and g.expr_id == e.expr_id)]
+                if not idx:
+                    raise NotImplementedError(UNSUPPORTED_DISTINCT_MSG)
+                outer_outs.append(key_attrs[idx[0]])
             else:
                 outer_outs.append(rewrite(e))
         outer = P.Aggregate(tuple(key_attrs), tuple(outer_outs), inner)
@@ -436,6 +434,13 @@ def _annotate_window_group_limits(node, out, parents) -> None:
             continue
         out[id(win)] = (rank_outputs[name], int(k))
         return
+
+
+UNSUPPORTED_DISTINCT_MSG = (
+    "DISTINCT aggregates are only supported when every aggregate in the "
+    "statement is DISTINCT over the same non-empty column list, with "
+    "plain-column grouping keys and no FILTER clause (mixed forms need "
+    "Spark's Expand plan, which no engine path implements yet)")
 
 
 def _collect_distinct(node: "P.Aggregate"):

@@ -103,9 +103,15 @@ class TpuSession:
             # keep the placement report consistent with the physical plan
             from .optimizer import apply_cost_optimizer
             apply_cost_optimizer(meta, self._conf)
-        phys = Planner(self._conf).plan_for_collect(df._plan)
+        try:
+            phys_str = Planner(self._conf).plan_for_collect(
+                df._plan).tree_string()
+        except NotImplementedError as e:
+            # diagnostics must not crash on unplannable queries (e.g.
+            # unsupported DISTINCT shapes) — report the reason instead
+            phys_str = f"<unplannable: {e}>"
         return (meta.explain(all_ops) + "\n\nPhysical plan:\n"
-                + phys.tree_string())
+                + phys_str)
 
 
 class DataFrameReader:

@@ -432,3 +432,16 @@ def test_distinct_device_vs_host_oracle(session):
     want = pdf.groupby("g")["v"].nunique()
     for r in dev:
         assert r["c"] == want[r["g"]]
+
+
+def test_multi_column_count_distinct_on_device(session):
+    df = session.create_dataframe(pa.table({
+        "k": [1, 1, 1, 1, 1],
+        "a": pa.array([1, 1, 2, 2, None], type=pa.int64()),
+        "b": pa.array([1, 1, 1, 2, 3], type=pa.int64())}),
+        num_partitions=2)
+    q = df.groupBy("k").agg(
+        F.countDistinct(F.col("a"), F.col("b")).alias("c"))
+    assert "host" not in session.explain(q)
+    # distinct non-null tuples: (1,1), (2,1), (2,2); (None,3) excluded
+    assert q.collect().to_pylist() == [{"k": 1, "c": 3}]

@@ -128,3 +128,18 @@ def test_path_rewrite_rules(tmp_path):
         str(tmp_path / "t.parquet")
     unchanged = rewrite_path("/local/t.parquet", s.conf)
     assert unchanged == "/local/t.parquet"
+
+
+def test_orc_chunked_read(tmp_path):
+    import pyarrow.orc as orc
+    t = pa.table({"x": pa.array(range(100_000), type=pa.int64())})
+    orc.write_table(t, str(tmp_path / "t.orc"), stripe_size=64 * 1024)
+    s = srt.session(**{
+        "spark.rapids.sql.reader.chunked": True,
+        "spark.rapids.sql.reader.chunked.targetRows": 20_000})
+    df = s.read.orc(str(tmp_path / "t.orc"))
+    out = df.agg(F.sum(F.col("x")).alias("s"),
+                 F.count("*").alias("c")).collect()
+    assert out["s"].to_pylist() == [sum(range(100_000))]
+    assert out["c"].to_pylist() == [100_000]
+    assert s.last_query_metrics.get("chunkedReadBatches", 0) >= 2
