@@ -95,6 +95,13 @@ SORT_OOC_TARGET_ROWS = register(
     "(reference GpuOutOfCoreSortIterator, GpuSortExec.scala:242): inputs "
     "larger than this are sorted as spillable runs and k-way merged in "
     "chunks of at most this many rows.", 1 << 22)
+WINDOW_BATCH_TARGET_ROWS = register(
+    "spark.rapids.sql.window.batchTargetRows",
+    "Window inputs larger than this many rows are processed in "
+    "key-complete chunks (every chunk holds whole partitions, cut at "
+    "partition-key boundaries) instead of one concatenated batch — the "
+    "reference's key-batched windows (GpuKeyBatchingIterator.scala). "
+    "Bounded by the largest single partition.", 1 << 22)
 JOIN_OUTPUT_CHUNK_ROWS = register(
     "spark.rapids.sql.join.outputChunkRows",
     "Join outputs larger than this many rows are gathered in chunks of "

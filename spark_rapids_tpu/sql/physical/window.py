@@ -95,6 +95,18 @@ class WindowExec(PhysicalPlan):
             a.to_attribute() for a in self.window_exprs]
 
     # ------------------------------------------------------------------
+    def _partition_seg_keys(self, ctx, live):
+        """Sort-key words identifying the row's window PARTITION — the
+        one recipe shared by the compute kernel and the key-batching cut
+        scan, so chunk boundaries can never disagree with segments."""
+        xp = ctx.xp
+        seg_keys: List = [(~live).astype(xp.int64)]
+        for e in self._bound_parts:
+            c = e.eval(ctx)
+            seg_keys.append((~c.validity).astype(xp.int64))
+            seg_keys.extend(column_sort_keys(xp, c))
+        return seg_keys
+
     def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
         xp = self.xp
         ctx = EvalContext(batch, xp=xp)
@@ -103,11 +115,7 @@ class WindowExec(PhysicalPlan):
         live = idx < batch.num_rows
 
         # --- segment (partition) and peer (order-tie) bounds -----------
-        seg_keys: List = [(~live).astype(xp.int64)]
-        for e in self._bound_parts:
-            c = e.eval(ctx)
-            seg_keys.append((~c.validity).astype(xp.int64))
-            seg_keys.extend(column_sort_keys(xp, c))
+        seg_keys = self._partition_seg_keys(ctx, live)
         is_seg_start = W.boundary_flags(xp, seg_keys)
         seg_start, seg_end = W.segment_bounds(xp, is_seg_start)
 
@@ -341,12 +349,115 @@ class WindowExec(PhysicalPlan):
             f"window function {type(fn).__name__} not supported")
 
     # ------------------------------------------------------------------
+    # --- key-batched out-of-core path ---------------------------------
+    def _boundary_fn(self):
+        """(last partition start <= limit, first partition start > 0) of
+        a sorted batch — the two cut candidates for key-complete
+        chunking.  -1 / num_rows when absent."""
+        def impl(batch, limit):
+            xp = self.xp
+            ctx = EvalContext(batch, xp=xp)
+            n = batch.capacity
+            idx = xp.arange(n, dtype=xp.int32)
+            live = idx < batch.num_rows
+            is_start = W.boundary_flags(
+                xp, self._partition_seg_keys(ctx, live)) & live
+            last_le = xp.max(xp.where(is_start & (idx <= limit), idx, -1))
+            first_gt = xp.min(xp.where(is_start & (idx > 0), idx,
+                                       batch.num_rows))
+            return last_le, first_gt
+        from .kernel_cache import exprs_key
+        return self._jit(impl, key=("wbound",
+                                    exprs_key(self._bound_parts)))
+
+    def _execute_key_batched(self, pid, tctx, target: int):
+        """Process sorted input in key-complete chunks (reference
+        ``GpuKeyBatchingIterator.scala``): every chunk holds whole
+        partitions and at most ~``target`` rows (grown to the largest
+        single partition when one exceeds it), with carried tails held
+        spillable between chunks."""
+        import numpy as np_
+        from ...memory.retry import with_retry
+        from ...memory.spill import (ACTIVE_ON_DECK_PRIORITY,
+                                     SpillableColumnarBatch)
+        boundary = self._boundary_fn()
+        carry: List[SpillableColumnarBatch] = []
+        carry_rows = 0
+
+        def process(head):
+            sb = SpillableColumnarBatch.create(head,
+                                               ACTIVE_ON_DECK_PRIORITY)
+            return with_retry([sb], lambda s: self._fn(s.get()))
+
+        def emit_chunks(final: bool):
+            nonlocal carry, carry_rows
+            while carry_rows >= target:
+                pieces = [sb.get() for sb in carry]
+                merged = (ColumnarBatch.concat(pieces)
+                          if len(pieces) > 1 else pieces[0])
+                m = merged.num_rows_int
+                last_le, first_gt = boundary(
+                    merged, np_.int32(min(target, m - 1)))
+                cut = int(last_le)
+                if cut <= 0:
+                    cut = int(first_gt)  # first partition exceeds target
+                if cut <= 0 or cut >= m:
+                    # one partition spans the whole carry: grow.  Keep the
+                    # CONCATENATED batch as the single carry piece so the
+                    # next round doesn't re-merge and re-scan these rows
+                    # (a P-row partition would otherwise cost O(P^2))
+                    if len(carry) > 1:
+                        for sb in carry:
+                            sb.close()
+                        carry = [SpillableColumnarBatch.create(
+                            merged, ACTIVE_ON_DECK_PRIORITY)]
+                    break
+                head = merged.sliced(0, cut)
+                tail = merged.sliced(cut, m - cut)
+                for sb in carry:
+                    sb.close()
+                carry = [SpillableColumnarBatch.create(
+                    tail, ACTIVE_ON_DECK_PRIORITY)]
+                carry_rows = m - cut
+                tctx.inc_metric("windowKeyBatches")
+                yield from process(head)
+            if final and carry:
+                pieces = [sb.get() for sb in carry]
+                merged = (ColumnarBatch.concat(pieces)
+                          if len(pieces) > 1 else pieces[0])
+                for sb in carry:
+                    sb.close()
+                carry, carry_rows = [], 0
+                tctx.inc_metric("windowKeyBatches")
+                yield from process(merged)
+
+        try:
+            for batch in self.children[0].execute(pid, tctx):
+                n = batch.num_rows_int
+                if n == 0:
+                    continue
+                carry.append(SpillableColumnarBatch.create(
+                    batch, ACTIVE_ON_DECK_PRIORITY))
+                carry_rows += n
+                yield from emit_chunks(final=False)
+            yield from emit_chunks(final=True)
+        finally:
+            for sb in carry:
+                sb.close()
+
     def execute(self, pid, tctx):
+        from ...config import WINDOW_BATCH_TARGET_ROWS
+        target = int(tctx.conf.get(WINDOW_BATCH_TARGET_ROWS))
+        if self._bound_parts:
+            yield from self._execute_key_batched(pid, tctx, target)
+            return
+        # no partition keys: every row is one global window partition —
+        # key batching cannot cut anywhere
         batches = list(self.children[0].execute(pid, tctx))
         if not batches:
             return
-        merged = ColumnarBatch.concat(batches) if len(batches) > 1 \
-            else batches[0]
+        merged = (ColumnarBatch.concat(batches) if len(batches) > 1
+                  else batches[0])
         yield self._fn(merged)
 
     def simple_string(self):

@@ -429,3 +429,74 @@ def test_window_group_limit_shared_node_with_unfiltered_branch(sess):
     both = top.union(base)
     assert "WindowGroupLimit" not in sess.explain(both)
     assert both.collect().num_rows == 10 + len(pdf)
+
+
+# --- key-batched out-of-core windows (GpuKeyBatchingIterator analog) -------
+
+def test_window_key_batched_matches_in_core(sess):
+    """Tiny chunk target forces many key-complete chunks; results must be
+    identical to the one-batch path."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.sql.window_api import Window
+    rng = np.random.default_rng(13)
+    n = 20_000
+    t = pa.table({"g": np.sort(rng.integers(0, 300, n)),
+                  "v": rng.random(n)})
+    w = Window.partitionBy("g").orderBy("v")
+
+    def q(s):
+        df = s.create_dataframe(t, num_partitions=1)
+        return (df.withColumn("r", F.row_number().over(w))
+                .withColumn("s", F.sum(F.col("v")).over(w))
+                .orderBy("g", "v").collect().to_pandas())
+    small = srt.session(**{"spark.rapids.sql.window.batchTargetRows": 500})
+    try:
+        got = q(small)
+        assert small.last_query_metrics.get("windowKeyBatches", 0) > 5
+        big = srt.session(
+            **{"spark.rapids.sql.window.batchTargetRows": 1 << 22})
+        want = q(big)
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
+    assert (got["r"].values == want["r"].values).all()
+    assert abs(got["s"].values - want["s"].values).max() < 1e-9
+
+
+def test_window_key_batched_single_giant_partition(sess):
+    """One partition larger than the target cannot be cut: the chunk
+    grows to hold it and results stay exact."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.sql.window_api import Window
+    n = 3_000
+    t = pa.table({"g": [1] * n, "v": list(range(n))})
+    w = Window.partitionBy("g").orderBy("v")
+    s = srt.session(**{"spark.rapids.sql.window.batchTargetRows": 100})
+    try:
+        df = s.create_dataframe(t, num_partitions=1)
+        out = (df.withColumn("r", F.row_number().over(w))
+               .orderBy("v").collect())
+        assert out["r"].to_pylist() == list(range(1, n + 1))
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
+
+
+def test_window_key_batched_with_oom_injection(sess):
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.sql.window_api import Window
+    rng = np.random.default_rng(14)
+    n = 5_000
+    t = pa.table({"g": np.sort(rng.integers(0, 50, n)),
+                  "v": rng.random(n)})
+    w = Window.partitionBy("g").orderBy("v")
+    s = srt.session(**{
+        "spark.rapids.sql.window.batchTargetRows": 400,
+        "spark.rapids.sql.test.injectRetryOOM": 2})
+    try:
+        df = s.create_dataframe(t, num_partitions=1)
+        out = (df.withColumn("r", F.row_number().over(w)).collect())
+        assert out.num_rows == n
+    finally:
+        srt.session(**{"spark.rapids.sql.enabled": True})
