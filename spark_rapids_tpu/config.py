@@ -158,6 +158,12 @@ PINNED_POOL_SIZE = register(
 SPILL_DIR = register(
     "spark.rapids.memory.spillDir", "Directory for the disk spill tier.",
     "/tmp/rapids_tpu_spill")
+GPU_DEBUG = register(
+    "spark.rapids.memory.gpu.debug",
+    "Log every spill-catalog registration/removal with the owning call "
+    "site — the reference's RMM debug allocation logging analog "
+    "(RapidsConf.scala:366); leak_report() names still-registered "
+    "handles and their origins.", False)
 OOM_RETRY_ENABLED = register(
     "spark.rapids.sql.oomRetry.enabled",
     "Enable the retry-on-OOM state machine (withRetry framework).", True)

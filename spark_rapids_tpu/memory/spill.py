@@ -64,6 +64,7 @@ class _Buffer:
     disk_path: Optional[str] = None
     was_device: bool = True                # False for host-backend batches
     seq: int = 0                           # tie-break: older spills first
+    origin: str = ""                       # registration site (debug mode)
 
 
 class BufferCatalog:
@@ -86,6 +87,8 @@ class BufferCatalog:
         self.disk_bytes = 0
         self.spill_count = 0
         self.unspill_count = 0
+        from ..config import GPU_DEBUG
+        self.debug = bool(conf.get(GPU_DEBUG))
 
     @classmethod
     def get(cls) -> "BufferCatalog":
@@ -101,6 +104,18 @@ class BufferCatalog:
                 cls._instance.close_all()
             cls._instance = cls(conf)
             return cls._instance
+
+    def leak_report(self):
+        """Still-registered buffers — the MemoryCleaner leak-tracking
+        analog (reference Plugin.scala:425-440): after a query finishes
+        every SpillableColumnarBatch must have been closed, so anything
+        listed here is a leaked handle.  Entries carry the registration
+        site when spark.rapids.memory.gpu.debug is on."""
+        with self._lock:
+            return [{"handle": b.handle, "size": b.size, "tier": b.tier,
+                     "origin": b.origin or "(enable "
+                     "spark.rapids.memory.gpu.debug for call sites)"}
+                    for b in self._buffers.values()]
 
     # --- registration ------------------------------------------------------
     def add_batch(self, batch: ColumnarBatch,
@@ -122,6 +137,14 @@ class BufferCatalog:
             raise SplitAndRetryOOM(
                 f"batch of {size} bytes cannot fit the device pool "
                 f"(limit {DeviceManager.get().pool_limit_bytes()})")
+        origin = ""
+        if self.debug:
+            import traceback
+            for frame in reversed(traceback.extract_stack(limit=8)):
+                if "memory/spill.py" not in frame.filename:
+                    origin = (f"{frame.filename}:{frame.lineno} "
+                              f"{frame.name}")
+                    break
         with self._lock:
             h = self._next_handle
             self._next_handle += 1
@@ -129,11 +152,15 @@ class BufferCatalog:
             tier = DEVICE if was_device else HOST
             self._buffers[h] = _Buffer(h, tier, size, priority, treedef,
                                        list(leaves), was_device=was_device,
-                                       seq=self._seq)
+                                       seq=self._seq, origin=origin)
             if was_device:
                 self.device_bytes += size
             else:
                 self.host_bytes += size
+        if self.debug:
+            import logging
+            logging.getLogger("spark_rapids_tpu.memory").info(
+                "buffer +%d %dB tier=%s at %s", h, size, tier, origin)
         return h
 
     def get_batch(self, handle: int) -> ColumnarBatch:
@@ -162,6 +189,11 @@ class BufferCatalog:
                 self.disk_bytes -= buf.size
                 if buf.disk_path and os.path.exists(buf.disk_path):
                     os.unlink(buf.disk_path)
+
+        if self.debug:
+            import logging
+            logging.getLogger("spark_rapids_tpu.memory").info(
+                "buffer -%d %dB tier=%s", handle, buf.size, buf.tier)
 
     def close_all(self):
         with self._lock:

@@ -376,9 +376,9 @@ class WindowExec(PhysicalPlan):
         partitions and at most ~``target`` rows (grown to the largest
         single partition when one exceeds it), with carried tails held
         spillable between chunks."""
-        import numpy as np_
         from ...memory.retry import with_retry
-        from ...memory.spill import (ACTIVE_ON_DECK_PRIORITY,
+        from ...memory.spill import (ACTIVE_BATCHING_PRIORITY,
+                                     ACTIVE_ON_DECK_PRIORITY,
                                      SpillableColumnarBatch)
         boundary = self._boundary_fn()
         carry: List[SpillableColumnarBatch] = []
@@ -397,7 +397,7 @@ class WindowExec(PhysicalPlan):
                           if len(pieces) > 1 else pieces[0])
                 m = merged.num_rows_int
                 last_le, first_gt = boundary(
-                    merged, np_.int32(min(target, m - 1)))
+                    merged, np.int32(min(target, m - 1)))
                 cut = int(last_le)
                 if cut <= 0:
                     cut = int(first_gt)  # first partition exceeds target
@@ -410,14 +410,14 @@ class WindowExec(PhysicalPlan):
                         for sb in carry:
                             sb.close()
                         carry = [SpillableColumnarBatch.create(
-                            merged, ACTIVE_ON_DECK_PRIORITY)]
+                            merged, ACTIVE_BATCHING_PRIORITY)]
                     break
                 head = merged.sliced(0, cut)
                 tail = merged.sliced(cut, m - cut)
                 for sb in carry:
                     sb.close()
                 carry = [SpillableColumnarBatch.create(
-                    tail, ACTIVE_ON_DECK_PRIORITY)]
+                    tail, ACTIVE_BATCHING_PRIORITY)]
                 carry_rows = m - cut
                 tctx.inc_metric("windowKeyBatches")
                 yield from process(head)
@@ -437,7 +437,7 @@ class WindowExec(PhysicalPlan):
                 if n == 0:
                     continue
                 carry.append(SpillableColumnarBatch.create(
-                    batch, ACTIVE_ON_DECK_PRIORITY))
+                    batch, ACTIVE_BATCHING_PRIORITY))
                 carry_rows += n
                 yield from emit_chunks(final=False)
             yield from emit_chunks(final=True)

@@ -397,3 +397,45 @@ class TestFatalDeviceErrors:
         with pytest.raises(SplitAndRetryOOM):
             guard_device_oom(kernel)()
         assert FT.STATS["fatal_errors"] == before  # not classified fatal
+
+
+class TestLeakDetection:
+    """Spill-catalog leak tracking (MemoryCleaner analog): queries must
+    leave no registered buffers behind, and debug mode names the site."""
+
+    def test_queries_leak_no_buffers(self):
+        import pyarrow as pa
+        from spark_rapids_tpu.memory.spill import BufferCatalog
+        import spark_rapids_tpu as srt
+        from spark_rapids_tpu.sql import functions as F
+        BufferCatalog.reset()
+        s = srt.session()
+        df = s.create_dataframe(pa.table({
+            "k": list(range(100)), "v": [float(i) for i in range(100)]}),
+            num_partitions=4)
+        (df.filter(df.v > 10).groupBy("k")
+         .agg(F.sum(F.col("v")).alias("s")).orderBy("k").collect())
+        leaks = BufferCatalog.get().leak_report()
+        assert leaks == [], leaks
+
+    def test_debug_mode_records_origin(self):
+        import numpy as np
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar.column import make_fixed_column
+        from spark_rapids_tpu.memory.spill import (BufferCatalog,
+                                                   SpillableColumnarBatch)
+        import spark_rapids_tpu as srt
+        try:
+            s = srt.session(**{"spark.rapids.memory.gpu.debug": True})
+            cat = BufferCatalog.reset(s._conf)
+            col = make_fixed_column(T.LONG, np.arange(8))
+            b = ColumnarBatch.make(("x",), (col,), 8)
+            sb = SpillableColumnarBatch.create(b, catalog=cat)
+            rep = cat.leak_report()
+            assert len(rep) == 1
+            assert "test_memory" in rep[0]["origin"]
+            sb.close()
+            assert cat.leak_report() == []
+        finally:
+            srt.session(**{"spark.rapids.sql.enabled": True})
+            BufferCatalog.reset()
