@@ -97,6 +97,19 @@ class BufferCatalog:
                 cls._instance = cls()
             return cls._instance
 
+    def _debug_enabled(self) -> bool:
+        """Live flag: the running task's session conf wins (sessions
+        don't mutate the process-global conf — test isolation depends on
+        that), else whatever the catalog was constructed with."""
+        if self.debug:
+            return True
+        from ..sql.physical.base import TaskContext
+        t = TaskContext.current()
+        if t is None:
+            return False
+        from ..config import GPU_DEBUG
+        return bool(t.conf.get(GPU_DEBUG))
+
     @classmethod
     def reset(cls, conf: Optional[RapidsConf] = None) -> "BufferCatalog":
         with cls._class_lock:
@@ -138,7 +151,8 @@ class BufferCatalog:
                 f"batch of {size} bytes cannot fit the device pool "
                 f"(limit {DeviceManager.get().pool_limit_bytes()})")
         origin = ""
-        if self.debug:
+        debug = self._debug_enabled()
+        if debug:
             import traceback
             for frame in reversed(traceback.extract_stack(limit=8)):
                 if "memory/spill.py" not in frame.filename:
@@ -157,7 +171,7 @@ class BufferCatalog:
                 self.device_bytes += size
             else:
                 self.host_bytes += size
-        if self.debug:
+        if debug:
             import logging
             logging.getLogger("spark_rapids_tpu.memory").info(
                 "buffer +%d %dB tier=%s at %s", h, size, tier, origin)
@@ -190,7 +204,7 @@ class BufferCatalog:
                 if buf.disk_path and os.path.exists(buf.disk_path):
                     os.unlink(buf.disk_path)
 
-        if self.debug:
+        if self._debug_enabled():
             import logging
             logging.getLogger("spark_rapids_tpu.memory").info(
                 "buffer -%d %dB tier=%s", handle, buf.size, buf.tier)

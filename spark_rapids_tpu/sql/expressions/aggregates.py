@@ -474,3 +474,55 @@ class ApproximatePercentile(_ShuffleCompleteAggregate):
         lengths = xp.where(counts > 0, w, 0).astype(xp.int32)
         return make_array_column(T.ArrayType(elem0.dtype), lengths, (elem,),
                                  group_ok & (counts > 0))
+
+
+class PreMergedAggregate(AggregateFunction):
+    """Wraps an aggregate whose PARTIAL slot values already exist as
+    input columns: update applies each slot's MERGE op directly, so a
+    second-level aggregate can re-group partial results under coarser
+    keys.  This is what makes the mixed DISTINCT plan work — the inner
+    per-(keys, distinct-values) aggregate emits partial slots, and the
+    outer per-(keys) aggregate merges them while separately aggregating
+    the deduped distinct values (same layering as the engine's own
+    partial->final modes)."""
+
+    def __init__(self, func: AggregateFunction, *slot_attrs):
+        self.func = func
+        self.children = tuple(slot_attrs)
+
+    def with_children(self, children):
+        return PreMergedAggregate(self.func, *children)
+
+    @property
+    def data_type(self):
+        return self.func.data_type
+
+    @property
+    def nullable(self):
+        return self.func.nullable
+
+    def _key_extras(self):
+        return ("premerged", type(self.func).__name__,
+                self.func._key_extras())
+
+    def pretty_name(self):
+        return f"merge_{self.func.pretty_name()}"
+
+    def slots(self):
+        return [BufferSlot(s.name, s.dtype, s.merge_op, s.merge_op)
+                for s in self.func.slots()]
+
+    def update_values(self, ctx, cols):
+        # contribution rule mirrors the exec's merge pass
+        # (_merge_compute): FIRST/LAST contribute every live row, the
+        # rest contribute where the slot value is valid
+        out = []
+        for s, col in zip(self.func.slots(), cols):
+            if s.merge_op in (FIRST, LAST):
+                out.append((col, ctx.row_mask()))
+            else:
+                out.append((col, col.validity))
+        return out
+
+    def evaluate(self, ctx, buffers):
+        return self.func.evaluate(ctx, buffers)

@@ -303,7 +303,7 @@ class HashAggregateExec(PhysicalPlan):
                         "expression nor an aggregate")
 
         child_attrs = child.output
-        if mode == "final":
+        if mode in ("final", "merge"):
             # child emits [keys..., slots...]
             nk = len(self.grouping)
             self._key_refs = child_attrs[:nk]
@@ -329,7 +329,7 @@ class HashAggregateExec(PhysicalPlan):
              tuple((s.op, s.merge_op, s.dtype) for s in f.slots()))
             for f in self._agg_funcs)
         self._slots_key = slots_key
-        if mode != "final":
+        if mode not in ("final", "merge"):
             self._partial_key = (
                 "partial", exprs_key(self._bound_grouping),
                 tuple(zip(slots_key,
@@ -377,6 +377,8 @@ class HashAggregateExec(PhysicalPlan):
     # --- schema -----------------------------------------------------------
     @property
     def output(self):
+        if self.mode == "merge":
+            return list(self.children[0].output)
         if self.mode == "partial":
             out = []
             for i, g in enumerate(self.grouping):
@@ -758,11 +760,18 @@ class HashAggregateExec(PhysicalPlan):
                     "complete planning (planner bug)")
             yield from self._execute_special(pid, tctx)
             return
-        if self.mode == "final":
+        if self.mode in ("final", "merge"):
             partials = [SpillableColumnarBatch.create(b, ACTIVE_BATCHING_PRIORITY)
                         for b in child.execute(pid, tctx)]
             if not partials:
-                yield self._empty_output()
+                if self.mode == "final":
+                    yield self._empty_output()
+                return
+            if self.mode == "merge":
+                # merge-only (the mixed-DISTINCT middle stage): group the
+                # partial layout by its keys, KEEPING slots mergeable —
+                # every (keys...) tuple becomes unique in this partition
+                yield self._merge_spillables(partials).get_and_close()
                 return
             if len(partials) == 1:
                 # single partial (the common post-AQE-coalesce shape):

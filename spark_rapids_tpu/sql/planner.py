@@ -179,11 +179,14 @@ class Planner:
         from .expressions.aggregates import AggregateFunction
         distinct, regular = _collect_distinct(node)
         if distinct:
-            if not distinct_rewrite_applies(node, (distinct, regular)):
-                raise NotImplementedError(UNSUPPORTED_DISTINCT_MSG)
-            inner, outer = self._rewrite_distinct(node, distinct)
-            inner_exec = self._plan_aggregate(inner, child, be)
-            return self._plan_aggregate(outer, inner_exec, be)
+            if distinct_rewrite_applies(node, (distinct, regular)):
+                inner, outer = self._rewrite_distinct(node, distinct)
+                inner_exec = self._plan_aggregate(inner, child, be)
+                return self._plan_aggregate(outer, inner_exec, be)
+            if _mixed_distinct_applies(node, distinct, regular):
+                return self._plan_mixed_distinct(node, child, be, distinct,
+                                                 regular)
+            raise NotImplementedError(UNSUPPORTED_DISTINCT_MSG)
         nparts = child.num_partitions()
         special = any(
             getattr(f, "requires_shuffle_complete", False)
@@ -263,6 +266,93 @@ class Planner:
                 outer_outs.append(rewrite(e))
         outer = P.Aggregate(tuple(key_attrs), tuple(outer_outs), inner)
         return inner, outer
+
+    def _plan_mixed_distinct(self, node: P.Aggregate, child, be,
+                             distinct, regular):
+        """Mixed DISTINCT + plain aggregates, e.g.
+        ``agg(countDistinct(v), sum(w)) GROUP BY k``:
+
+        1. INNER partial aggregate grouped by (k, v): plain funcs update
+           into their mergeable slot layout; one row per (k, v) group.
+        2. Hash-exchange the partial rows by k.
+        3. OUTER complete aggregate grouped by k: the distinct funcs run
+           as PLAIN funcs over the deduped v values, and each plain func
+           re-merges its partial slots via PreMergedAggregate — exactly
+           the partial->final layering the engine already trusts, just
+           under coarser keys (Spark reaches the same result via Expand).
+        """
+        from .expressions.aggregates import (AggregateExpression,
+                                             AggregateFunction,
+                                             PreMergedAggregate)
+        from .expressions.core import Alias
+        dchildren = list(distinct[0].func.children)
+        nk, nd = len(node.grouping), len(dchildren)
+
+        # inner: partial agg grouped by keys + distinct children, with the
+        # REGULAR funcs as its aggregates (order = their slot order)
+        inner_aggs = tuple(Alias(AggregateExpression(f)
+                                 if not isinstance(f, AggregateExpression)
+                                 else f, f"__r{i}")
+                           for i, f in enumerate(regular))
+        inner = HashAggregateExec(
+            tuple(node.grouping) + tuple(dchildren), inner_aggs, "partial",
+            child, backend=be)
+        mid = inner
+        if child.num_partitions() > 1:
+            key_refs = inner.output[:nk]
+            part = (HashPartitioning(key_refs,
+                                     int(self.conf.shuffle_partitions))
+                    if node.grouping else SinglePartitioning())
+            exchanged = ShuffleExchangeExec(part, inner, backend=be)
+            # different map partitions each hold their own partial row for
+            # the same (keys, distinct-values) tuple: a merge-only stage
+            # re-groups by the full tuple so the outer's distinct count
+            # sees each tuple exactly once (slots stay mergeable)
+            mid = HashAggregateExec(
+                tuple(node.grouping) + tuple(dchildren), inner_aggs,
+                "merge", exchanged, backend=be)
+
+        key_attrs = inner.output[:nk]
+        d_attrs = inner.output[nk:nk + nd]
+        slot_attrs = inner.output[nk + nd:]
+        # slot range per regular func, in inner_aggs order
+        ranges = {}
+        off = 0
+        for f in regular:
+            base = f.func if isinstance(f, AggregateExpression) else f
+            n = len(base.slots())
+            ranges[id(f)] = (off, off + n)
+            off += n
+
+        def rewrite(e):
+            if isinstance(e, AggregateExpression):
+                if e.is_distinct:
+                    return e.func.with_children(tuple(d_attrs))
+                lo, hi = ranges[id(e)]
+                base = e.func
+                return PreMergedAggregate(base, *slot_attrs[lo:hi])
+            if isinstance(e, AggregateFunction):
+                if id(e) in ranges:
+                    lo, hi = ranges[id(e)]
+                    return PreMergedAggregate(e, *slot_attrs[lo:hi])
+                return e
+            if not getattr(e, "children", ()):
+                return e
+            return e.with_children(tuple(rewrite(c) for c in e.children))
+
+        outer_outs = []
+        for e in node.aggregates:
+            if isinstance(e, AttributeReference):
+                idx = [j for j, g in enumerate(node.grouping) if g is e
+                       or (isinstance(g, AttributeReference)
+                           and g.expr_id == e.expr_id)]
+                if not idx:
+                    raise NotImplementedError(UNSUPPORTED_DISTINCT_MSG)
+                outer_outs.append(Alias(key_attrs[idx[0]], e.name))
+            else:
+                outer_outs.append(rewrite(e))
+        return HashAggregateExec(tuple(key_attrs), tuple(outer_outs),
+                                 "complete", mid, backend=be)
 
     def _plan_window(self, node: P.Window, child: PhysicalPlan, be):
         from ..sql.plan import SortOrder
@@ -459,6 +549,18 @@ def _collect_distinct(node: "P.Aggregate"):
     return distinct, regular
 
 
+def _distinct_shape_ok(node: "P.Aggregate", distinct) -> bool:
+    """Checks shared by both DISTINCT plans: no FILTER clauses, plain-
+    column grouping keys, one shared non-empty DISTINCT child set."""
+    if any(d.filter is not None for d in distinct):
+        return False
+    if not all(isinstance(g, AttributeReference) for g in node.grouping):
+        return False
+    keys = {tuple(c.semantic_key() for c in d.func.children)
+            for d in distinct}
+    return len(keys) == 1 and all(d.func.children for d in distinct)
+
+
 def distinct_rewrite_applies(node: "P.Aggregate",
                              precollected=None):
     """DISTINCT aggregates plan as dedup-then-aggregate when every
@@ -471,14 +573,23 @@ def distinct_rewrite_applies(node: "P.Aggregate",
     is worse than an error."""
     distinct, regular = (precollected if precollected is not None
                          else _collect_distinct(node))
-    if not distinct:
+    if not distinct or regular:
         return False
-    if regular:
+    return _distinct_shape_ok(node, distinct)
+
+
+def _mixed_distinct_applies(node: "P.Aggregate", distinct, regular) -> bool:
+    """The mixed plan needs: one shared DISTINCT child set, no FILTER
+    clauses, plain-column grouping keys, and slot-based regular funcs
+    (shuffle-complete collect/percentile aggregates have no mergeable
+    slots)."""
+    from .expressions.aggregates import AggregateExpression
+    if not _distinct_shape_ok(node, distinct):
         return False
-    if any(d.filter is not None for d in distinct):
-        return False
-    if not all(isinstance(g, AttributeReference) for g in node.grouping):
-        return False
-    keys = {tuple(c.semantic_key() for c in d.func.children)
-            for d in distinct}
-    return len(keys) == 1 and all(d.func.children for d in distinct)
+    for f in regular:
+        base = f.func if isinstance(f, AggregateExpression) else f
+        if getattr(base, "requires_shuffle_complete", False):
+            return False
+        if isinstance(f, AggregateExpression) and f.filter is not None:
+            return False
+    return True

@@ -403,15 +403,13 @@ def test_count_distinct_single_column(session):
     assert out == [{"k": 1, "c": 2}]
 
 
-def test_mixed_distinct_raises_loudly(session):
-    """Mixed DISTINCT + plain aggregates need Spark's Expand plan; no
-    engine path computes them yet, so planning raises instead of silently
-    returning the non-distinct answer."""
+def test_mixed_distinct_basic(session):
+    """Mixed DISTINCT + plain aggregates: the duplicate-heavy two-row
+    case that the old silent host fallback used to get wrong (c=2)."""
     df = session.create_dataframe(pa.table({"k": [1, 1], "v": [5.0, 5.0]}))
     q = df.groupBy("k").agg(F.countDistinct(F.col("v")).alias("c"),
                             F.sum(F.col("v")).alias("s"))
-    with pytest.raises(NotImplementedError, match="DISTINCT"):
-        q.collect()
+    assert q.collect().to_pylist() == [{"k": 1, "c": 1, "s": 10.0}]
 
 
 def test_distinct_device_vs_host_oracle(session):
@@ -445,3 +443,64 @@ def test_multi_column_count_distinct_on_device(session):
     assert "host" not in session.explain(q)
     # distinct non-null tuples: (1,1), (2,1), (2,2); (None,3) excluded
     assert q.collect().to_pylist() == [{"k": 1, "c": 3}]
+
+
+def test_mixed_distinct_with_plain_aggs(session):
+    """Mixed DISTINCT + plain aggregates: inner partial agg over
+    (keys, distinct values), outer merge of partial slots + plain agg of
+    deduped values (PreMergedAggregate layering)."""
+    import numpy as np
+    rng = np.random.default_rng(17)
+    n = 8000
+    t = pa.table({"k": rng.integers(0, 30, n),
+                  "v": rng.integers(0, 15, n),
+                  "w": rng.random(n)})
+    df = session.create_dataframe(t, num_partitions=3)
+    q = (df.groupBy("k").agg(F.countDistinct(F.col("v")).alias("cd"),
+                             F.sum(F.col("w")).alias("sw"),
+                             F.min(F.col("v")).alias("mv"),
+                             F.avg(F.col("w")).alias("aw"),
+                             F.count("*").alias("c"))
+         .orderBy("k"))
+    assert "host" not in session.explain(q)
+    got = q.collect().to_pandas().set_index("k")
+    pdf = t.to_pandas()
+    exp = pdf.groupby("k").agg(cd=("v", "nunique"), sw=("w", "sum"),
+                               mv=("v", "min"), aw=("w", "mean"),
+                               c=("w", "size"))
+    assert (got.index == exp.index).all()
+    assert (got["cd"].values == exp["cd"].values).all()
+    assert np.allclose(got["sw"], exp["sw"])
+    assert (got["mv"].values == exp["mv"].values).all()
+    assert np.allclose(got["aw"], exp["aw"])
+    assert (got["c"].values == exp["c"].values).all()
+
+
+def test_mixed_distinct_stddev_and_strings(session):
+    import numpy as np
+    rng = np.random.default_rng(18)
+    n = 3000
+    t = pa.table({"k": rng.integers(0, 10, n),
+                  "v": rng.integers(0, 8, n),
+                  "s": [f"x{i % 7}" for i in range(n)],
+                  "w": rng.random(n)})
+    df = session.create_dataframe(t, num_partitions=2)
+    q = (df.groupBy("k").agg(F.countDistinct(F.col("v")).alias("cd"),
+                             F.stddev(F.col("w")).alias("sd"),
+                             F.max(F.col("s")).alias("mx"))
+         .orderBy("k"))
+    got = q.collect().to_pandas().set_index("k")
+    pdf = t.to_pandas()
+    exp = pdf.groupby("k").agg(cd=("v", "nunique"), sd=("w", "std"),
+                               mx=("s", "max"))
+    assert (got["cd"].values == exp["cd"].values).all()
+    assert np.allclose(got["sd"], exp["sd"], rtol=1e-9)
+    assert (got["mx"].values == exp["mx"].values).all()
+
+
+def test_mixed_distinct_with_collect_still_raises(session):
+    df = session.create_dataframe(pa.table({"k": [1], "v": [1.0]}))
+    q = df.groupBy("k").agg(F.countDistinct(F.col("v")).alias("c"),
+                            F.collect_list(F.col("v")).alias("l"))
+    with pytest.raises(NotImplementedError, match="DISTINCT"):
+        q.collect()
