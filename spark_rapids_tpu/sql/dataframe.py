@@ -386,6 +386,78 @@ class DataFrame:
         attrs = tuple(self._plan.output)
         return DataFrame(P.Aggregate(attrs, attrs, self._plan), self._session)
 
+    def describe(self, *cols) -> "DataFrame":
+        """Basic statistics per numeric column (count/mean/stddev/min/max;
+        pyspark DataFrame.describe), computed as ONE aggregate pass
+        through the engine."""
+        return self._stats_frame(cols, ["count", "mean", "stddev", "min",
+                                        "max"])
+
+    def summary(self, *stats) -> "DataFrame":
+        """pyspark DataFrame.summary: like describe plus percentiles
+        (25%/50%/75% via the exact grouped-percentile kernel)."""
+        wanted = list(stats) or ["count", "mean", "stddev", "min", "25%",
+                                 "50%", "75%", "max"]
+        return self._stats_frame((), wanted)
+
+    def _stats_frame(self, cols, stats) -> "DataFrame":
+        import pyarrow as _pa
+        from . import functions as F
+        from .. import types as T
+        targets = [a.name for a in self._plan.output
+                   if T.is_numeric(a.data_type)]
+        if cols:
+            targets = [c for c in cols if c in targets]
+        if not targets:
+            return self._session.create_dataframe(
+                _pa.table({"summary": _pa.array(stats,
+                                                type=_pa.string())}))
+        aggs = []
+        for c in targets:
+            col = self._col(c)
+            aggs += [F.count(col).alias(f"__cnt_{c}"),
+                     F.avg(col).alias(f"__avg_{c}"),
+                     F.stddev(col).alias(f"__std_{c}"),
+                     F.min(col).alias(f"__min_{c}"),
+                     F.max(col).alias(f"__max_{c}")]
+            if any(s.endswith("%") for s in stats):
+                pcts = sorted({float(s[:-1]) / 100.0 for s in stats
+                               if s.endswith("%")})
+                aggs.append(F.percentile_approx(col, pcts)
+                            .alias(f"__pct_{c}"))
+        row = self.agg(*aggs).collect().to_pylist()[0]
+        out_rows = {"summary": stats}
+        for c in targets:
+            vals = []
+            pcts = sorted({float(s[:-1]) / 100.0 for s in stats
+                           if s.endswith("%")})
+            for s in stats:
+                if s == "count":
+                    vals.append(str(row[f"__cnt_{c}"]))
+                elif s == "mean":
+                    v = row[f"__avg_{c}"]
+                    vals.append(None if v is None else str(v))
+                elif s == "stddev":
+                    v = row[f"__std_{c}"]
+                    vals.append(None if v is None else str(v))
+                elif s == "min":
+                    v = row[f"__min_{c}"]
+                    vals.append(None if v is None else str(v))
+                elif s == "max":
+                    v = row[f"__max_{c}"]
+                    vals.append(None if v is None else str(v))
+                elif s.endswith("%"):
+                    arr = row.get(f"__pct_{c}")
+                    if arr is None:
+                        vals.append(None)
+                    else:
+                        v = arr[pcts.index(float(s[:-1]) / 100.0)]
+                        vals.append(None if v is None else str(v))
+                else:
+                    vals.append(None)
+            out_rows[c] = vals
+        return self._session.create_dataframe(_pa.table(out_rows))
+
     def dropDuplicates(self, subset: Optional[Sequence[str]] = None):
         if not subset:
             return self.distinct()

@@ -833,3 +833,18 @@ def sumDistinct(c):
 
 sum_distinct = sumDistinct
 count_distinct = countDistinct
+
+
+def approx_count_distinct(c, rsd: float = 0.05) -> Column:
+    """Spark's HyperLogLog-based estimate; computed EXACTLY here via the
+    distinct-aggregate plan (strictly tighter than the reference's HLL,
+    same stance as percentile_approx; rsd accepted for API parity)."""
+    return countDistinct(c)
+
+
+def avgDistinct(c) -> Column:
+    return Column(AG.AggregateExpression(AG.Average(_c(c)),
+                                         is_distinct=True))
+
+
+avg_distinct = avgDistinct

@@ -306,3 +306,33 @@ def test_pivot_missing_combination_is_null():
            .orderBy("y").collect().to_pylist())
     assert out == [{"y": 1, "a": 1.0, "b": None},
                    {"y": 2, "a": None, "b": 2.0}]
+
+
+# --- describe / summary (pyspark API parity) -------------------------------
+
+def test_describe_and_summary():
+    sess = srt.session()
+    df = sess.create_dataframe(pa.table({
+        "x": [1.0, 2.0, 3.0, 4.0], "s": ["a", "b", "c", "d"],
+        "y": [10, 20, 30, 40]}))
+    d = {r["summary"]: r for r in df.describe().collect().to_pylist()}
+    assert d["count"]["x"] == "4" and d["count"]["y"] == "4"
+    assert d["mean"]["x"] == "2.5" and d["min"]["y"] == "10"
+    assert d["max"]["x"] == "4.0"
+    assert "s" not in d["count"]  # non-numeric columns excluded
+    sm = {r["summary"]: r for r in df.summary().collect().to_pylist()}
+    assert sm["50%"]["x"] == "2.0" and sm["75%"]["y"] == "30"
+    # explicit stats selection
+    only = df.summary("min", "max").collect().to_pylist()
+    assert [r["summary"] for r in only] == ["min", "max"]
+
+
+def test_approx_count_distinct_and_avg_distinct():
+    sess = srt.session()
+    df = sess.create_dataframe(pa.table({
+        "k": [1, 1, 2, 2], "v": [5.0, 5.0, 7.0, 9.0]}), num_partitions=2)
+    out = (df.groupBy("k")
+           .agg(F.approx_count_distinct(F.col("v")).alias("c"),
+                F.avgDistinct(F.col("v")).alias("a"))
+           .orderBy("k").collect().to_pylist())
+    assert out == [{"k": 1, "c": 1, "a": 5.0}, {"k": 2, "c": 2, "a": 8.0}]
