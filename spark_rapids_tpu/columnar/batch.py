@@ -84,6 +84,27 @@ class ColumnarBatch:
         self._nrows_host = int(n)
         return self
 
+    @property
+    def num_rows_bound(self) -> int:
+        """Host-known UPPER BOUND on the row count, without ever pulling
+        from the device: the exact count when known, a producer-recorded
+        bound (``with_rows_bound``), else the padded capacity.  Use for
+        conservative control-flow decisions (out-of-core engagement,
+        coalescing) where a sync per batch would serialize the tunnel."""
+        cached = getattr(self, "_nrows_host", None)
+        if cached is not None:
+            return cached
+        bound = getattr(self, "_nrows_bound", None)
+        if bound is not None:
+            return bound
+        return self.capacity
+
+    def with_rows_bound(self, n: int) -> "ColumnarBatch":
+        """Record a host-known row-count upper bound (e.g. the speculated
+        group-table size) for pull-free sizing decisions."""
+        self._nrows_bound = int(n)
+        return self
+
     def row_mask(self) -> jnp.ndarray:
         """bool[capacity]: True for live rows."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows

@@ -22,6 +22,254 @@ from .column import (DeviceColumn, bucket_capacity, bucket_width,
 
 
 # --------------------------------------------------------------------------
+# Bulk device -> host fetch (single-pull D2H)
+# --------------------------------------------------------------------------
+
+#: compiled pack programs keyed by the leaf signature
+_PACK_CACHE: dict = {}
+
+
+def _word_packable(dt: str) -> bool:
+    """Dtypes the pack program can turn into uint32 words on the TPU
+    toolchain.  64-bit types can't use bitcast-convert (the X64-rewrite
+    pass doesn't implement it) — ints split arithmetically and f64 goes
+    through :func:`_f64_bits` (arithmetic IEEE-754 bit extraction)."""
+    if dt == "bool":
+        return True
+    d = np.dtype(dt)
+    if d.kind == "f":
+        if d.itemsize == 4:
+            return True
+        if d.itemsize == 8:
+            # exact bits on CPU; double-float pair on TPU unless the user
+            # opted into storage-fidelity fetches
+            return not _f64_as_pair() or _pack_f64_enabled()
+        return False
+    return d.kind in ("i", "u") and d.itemsize in (1, 2, 4, 8)
+
+
+def _f64_bits(x):
+    """IEEE-754 bit pattern of float64 as uint64, WITHOUT bitcast-convert
+    (traced; exact).  The exponent is recovered by a 10-step power-of-two
+    binary search — every multiply is by an exact power of two, so the
+    normalized mantissa m ∈ [1,2) is the value's own 53-bit mantissa and
+    ``(m-1)*2^52`` converts to uint64 exactly.  NaNs canonicalize to the
+    quiet NaN (payloads are not preserved — Spark normalizes NaNs).
+    Denormals encode as signed zero: XLA flushes f64 denormals to zero in
+    EVERY operation on these backends (DAZ — even ``x == 0`` is true for
+    them), so this matches the engine's own arithmetic semantics."""
+    ax = jnp.abs(x)
+    neg_zero = (x == 0.0) & (1.0 / x < 0)
+    sign = jnp.where((x < 0) | neg_zero, jnp.uint64(1), jnp.uint64(0))
+    m = ax
+    e = jnp.zeros(x.shape, jnp.int32)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        big = m >= (2.0 ** k)
+        m = jnp.where(big, m * (2.0 ** -k), m)
+        e = e + jnp.where(big, k, 0)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        small = m < (2.0 ** (1 - k))
+        m = jnp.where(small, m * (2.0 ** k), m)
+        e = e - jnp.where(small, k, 0)
+    normal = e >= -1022
+    exp_field = jnp.where(normal, (e + 1023).astype(jnp.uint64),
+                          jnp.uint64(0))
+    mant = jnp.where(normal, ((m - 1.0) * (2.0 ** 52)).astype(jnp.uint64),
+                     jnp.uint64(0))
+    bits = (sign << jnp.uint64(63)) | (exp_field << jnp.uint64(52)) | mant
+    bits = jnp.where(ax == 0.0, sign << jnp.uint64(63), bits)
+    bits = jnp.where(jnp.isinf(x),
+                     (sign << jnp.uint64(63)) | jnp.uint64(0x7FF0000000000000),
+                     bits)
+    bits = jnp.where(jnp.isnan(x), jnp.uint64(0x7FF8000000000000), bits)
+    return bits
+
+
+def _to_words(a):
+    """Flatten one device array to little-endian uint32 words (traced).
+    64-bit types are split arithmetically — the TPU toolchain's X64
+    rewrite does not implement 64-bit bitcast-convert; sub-32-bit types
+    pad to 4 bytes and pack 4 per word."""
+    import jax
+    a = a.reshape(-1)
+    if a.dtype == jnp.bool_:
+        a = a.astype(jnp.uint8)
+    isz = a.dtype.itemsize
+    if isz == 4:
+        return jax.lax.bitcast_convert_type(a, jnp.uint32)
+    if isz == 8:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            if jax.default_backend() == "cpu":
+                u = _f64_bits(a)  # native f64: exact bit extraction
+            else:
+                # TPU "f64" is a double-float (f32 hi/lo pair — values
+                # beyond f32 exponent range are already inf ON DEVICE and
+                # plain device_get can't round-trip true f64 either).
+                # The (hi, lo) pair IS the device's exact representation.
+                # lo is rescaled by an exact power of two picked from
+                # |hi|'s magnitude so it never lands in the f32-denormal
+                # range (the TPU flushes those to zero); the host decoder
+                # re-derives the same scale from hi.
+                hi32 = a.astype(jnp.float32)
+                ahi = jnp.abs(hi32)
+                scale = jnp.where(ahi < 2.0 ** -30, 2.0 ** 64,
+                                  jnp.where(ahi > 2.0 ** 97, 2.0 ** -64,
+                                            1.0)).astype(a.dtype)
+                lo32 = jnp.where(jnp.isfinite(hi32),
+                                 ((a - hi32.astype(a.dtype)) * scale)
+                                 .astype(jnp.float32),
+                                 jnp.float32(0))
+                pair = jnp.stack([hi32, lo32], axis=-1).reshape(-1)
+                return jax.lax.bitcast_convert_type(pair, jnp.uint32)
+        else:
+            u = a.astype(jnp.uint64)
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+        return jnp.stack([lo, hi], axis=-1).reshape(-1)
+    # 1- or 2-byte: widen to u32 lanes via [m,4]-u8 -> u32 bitcast
+    b = jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1) \
+        if isz > 1 else a.astype(jnp.uint8)
+    pad = (-b.size) % 4
+    if pad:
+        b = jnp.concatenate([b, jnp.zeros(pad, jnp.uint8)])
+    return jax.lax.bitcast_convert_type(b.reshape(-1, 4), jnp.uint32)
+
+
+def pack_leaves_traced(arrs, sig):
+    """Traced body: pack device leaves into (words, other0, other1, ...).
+
+    Word-packable leaves (bools, ints, f32) become ONE uint32 vector with
+    8-byte-aligned segments; every other dtype (f64, ...) concatenates
+    into one flat vector per dtype (sorted-dtype order) — no bitcast, so
+    the X64-rewrite restriction doesn't apply.  Composable inside larger
+    jitted programs (the whole-query tail fusion) or jitted alone."""
+    other_dts = sorted({dt for _, dt in sig if not _word_packable(dt)})
+    parts = []
+    groups = {dt: [] for dt in other_dts}
+    for a, (_, dt) in zip(arrs, sig):
+        if not _word_packable(dt):
+            groups[dt].append(a.reshape(-1))
+            continue
+        w = _to_words(a).reshape(-1)
+        if w.size % 2:  # 8-byte-align segments (2 words)
+            w = jnp.concatenate([w, jnp.zeros(1, jnp.uint32)])
+        parts.append(w)
+    words = (jnp.concatenate(parts) if len(parts) > 1
+             else parts[0] if parts else jnp.zeros(0, jnp.uint32))
+    others = []
+    for dt in other_dts:
+        g = groups[dt]
+        others.append(jnp.concatenate(g) if len(g) > 1
+                      else g[0] if g else jnp.zeros(0, np.dtype(dt)))
+    return (words,) + tuple(others)
+
+
+def unpack_buffers(host_bufs, sig):
+    """Invert :func:`pack_leaves_traced` on fetched numpy buffers; returns
+    the host leaves in signature order."""
+    words = host_bufs[0].view(np.uint8)
+    other_dts = sorted({dt for _, dt in sig if not _word_packable(dt)})
+    other_buf = dict(zip(other_dts, host_bufs[1:]))
+    other_off = {dt: 0 for dt in other_dts}
+    out = []
+    off = 0
+    for shape, dt in sig:
+        count = 1
+        for s in shape:
+            count *= s
+        if not _word_packable(dt):
+            o = other_off[dt]
+            out.append(other_buf[dt][o:o + count].reshape(shape))
+            other_off[dt] = o + count
+            continue
+        want_bool = dt == "bool"
+        np_dt = np.dtype("uint8") if want_bool else np.dtype(dt)
+        seg = count * np_dt.itemsize
+        if np_dt == np.float64 and _f64_as_pair():
+            pair = np.frombuffer(words, np.float32, count=2 * count,
+                                 offset=off).reshape(-1, 2)
+            hi = pair[:, 0].astype(np.float64)
+            ahi = np.abs(pair[:, 0])
+            scale = np.where(ahi < 2.0 ** -30, 2.0 ** -64,
+                             np.where(ahi > 2.0 ** 97, 2.0 ** 64, 1.0))
+            a = hi + pair[:, 1] * scale
+        else:
+            a = np.frombuffer(words, np_dt, count=count, offset=off)
+        if want_bool:
+            a = a.view(np.bool_)
+        out.append(a.reshape(shape))
+        off += seg + ((-seg) % 8)
+    return out
+
+
+def _f64_as_pair() -> bool:
+    """Whether f64 words were packed as (hi, lo) float32 pairs (non-CPU
+    backends — see :func:`_to_words`).  The pair is bit-faithful to every
+    f64 the device can COMPUTE (its arithmetic flushes f32-denormal low
+    components exactly like the extraction does); only raw storage of
+    uploaded tiny values (~<1e-29) differs, gated by
+    ``spark.rapids.tpu.d2h.packFloat64``."""
+    import jax
+    return jax.default_backend() != "cpu"
+
+
+def _pack_f64_enabled() -> bool:
+    from ..config import D2H_PACK_F64, RapidsConf
+    try:
+        return bool(RapidsConf.get_global().get(D2H_PACK_F64))
+    except Exception:  # pragma: no cover
+        return True
+
+
+def _pack_program(sig):
+    """Compiled pack program for :func:`bulk_device_get` (signature-keyed)."""
+    import jax
+    return jax.jit(lambda *arrs: pack_leaves_traced(arrs, sig))
+
+
+def bulk_device_get(tree):
+    """``jax.device_get`` with one transfer for the whole pytree: device
+    leaves are byte-packed by a compiled kernel and unpacked from the one
+    fetched buffer on the host; non-device leaves pass through unchanged."""
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    dev_idx = [i for i, l in enumerate(leaves)
+               if isinstance(l, jax.Array) and not isinstance(l, np.ndarray)]
+    if not dev_idx:
+        return tree
+    devs = [leaves[i] for i in dev_idx]
+    sig = tuple((l.shape, str(l.dtype)) for l in devs)
+    for _, dt in sig:
+        if dt == "bool":
+            continue
+        try:
+            np.dtype(dt)
+        except TypeError:
+            return jax.device_get(tree)  # e.g. bfloat16: numpy can't view it
+    # layout depends on the f64 encoding mode (backend + packFloat64
+    # config), which can change mid-session — it must be part of the key
+    cache_key = (sig, _f64_as_pair(), _pack_f64_enabled())
+    pack = _PACK_CACHE.get(cache_key)
+    if pack is None:
+        pack = _PACK_CACHE[cache_key] = _pack_program(sig)
+        if len(_PACK_CACHE) > 512:
+            _PACK_CACHE.clear()
+            _PACK_CACHE[cache_key] = pack
+    try:
+        bufs = pack(*devs)
+        for b in bufs:  # overlap the (few) transfers: one latency, not N
+            b.copy_to_host_async()
+        host = [np.asarray(b) for b in bufs]
+    except Exception:
+        # e.g. an exotic dtype the pack program can't lower on this
+        # toolchain — correctness first, one pull per leaf as before
+        return jax.device_get(tree)
+    for i, leaf in zip(dev_idx, unpack_buffers(host, sig)):
+        leaves[i] = leaf
+    return jax.tree.unflatten(treedef, leaves)
+
+
+# --------------------------------------------------------------------------
 # Arrow -> device
 # --------------------------------------------------------------------------
 

@@ -149,6 +149,28 @@ ALLOC_FRACTION = register(
 RESERVE_BYTES = register(
     "spark.rapids.memory.gpu.reserve",
     "Device memory reserved for XLA scratch/system.", 640 << 20)
+OOM_SYNC_MODE = register(
+    "spark.rapids.memory.oom.syncMode",
+    "When the per-kernel OOM guard forces device synchronization: "
+    "'always' blocks after every kernel (every execution-time OOM lands "
+    "inside the guard, at one host-device round trip per kernel), 'never' "
+    "lets dispatch stay asynchronous (OOM surfaces at the next "
+    "materialization point), 'auto' syncs only under memory pressure — "
+    "accounted pool usage above oom.syncWatermark, armed test OOM "
+    "injection, or a recently observed device OOM.", "auto")
+D2H_PACK_F64 = register(
+    "spark.rapids.tpu.d2h.packFloat64",
+    "Include float64 columns in the packed single-transfer D2H fetch. "
+    "On TPU, f64 is an emulated double-float; the packed encoding is "
+    "bit-faithful to every value the device can itself COMPUTE, but an "
+    "uploaded-and-untouched f64 below ~1e-29 whose low component falls "
+    "in the f32-denormal range loses those low bits (device arithmetic "
+    "flushes them identically).  Set false to fetch f64 columns with "
+    "full storage fidelity at one extra transfer round trip each.", True)
+OOM_SYNC_WATERMARK = register(
+    "spark.rapids.memory.oom.syncWatermark",
+    "Accounted-pool usage fraction above which syncMode=auto blocks "
+    "after every kernel to catch allocation failures eagerly.", 0.6)
 HOST_SPILL_STORAGE_SIZE = register(
     "spark.rapids.memory.host.spillStorageSize",
     "Host memory budget for spilled device buffers.", 1 << 30)

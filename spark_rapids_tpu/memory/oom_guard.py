@@ -12,7 +12,57 @@ from __future__ import annotations
 from typing import Callable
 
 #: observability for tests/metrics
-STATS = {"oom_caught": 0, "oom_retry_ok": 0, "oom_split_raised": 0}
+STATS = {"oom_caught": 0, "oom_retry_ok": 0, "oom_split_raised": 0,
+         "eager_syncs": 0, "lazy_dispatches": 0}
+
+#: wall-clock until which the guard stays in eager-sync mode after a real
+#: device OOM (a sick device earns per-kernel supervision for a while)
+_defensive_until = 0.0
+_DEFENSIVE_WINDOW_S = 300.0
+
+
+def _should_sync() -> bool:
+    """Decide whether to pay a blocking device sync after this kernel.
+
+    On the TPU tunnel every ``block_until_ready`` is a full network round
+    trip, and XLA pipelines async dispatches — so blocking after every
+    kernel serializes the whole query on RTT.  ``syncMode=auto`` keeps the
+    async pipeline when memory pressure is low and flips to per-kernel
+    supervision when an OOM is plausible: accounted pool usage above the
+    watermark, armed test injection, or a recent real OOM.  A deferred OOM
+    surfaces at the next materialization point (the D2H transition or a
+    host pull), where the producing kernel can no longer be re-run; the
+    session's collect loop recovers with a WHOLE-QUERY retry — by then the
+    guard is in its defensive window, so the re-run syncs eagerly and any
+    recurring OOM lands inside the failing kernel's own spill-and-retry.
+    """
+    import time
+
+    from ..config import OOM_SYNC_MODE, OOM_SYNC_WATERMARK, RapidsConf
+    conf = RapidsConf.get_global()
+    mode = str(conf.get(OOM_SYNC_MODE)).lower()
+    if mode == "always":
+        return True
+    if mode == "never":
+        return False
+    # auto:
+    if time.monotonic() < _defensive_until:
+        return True
+    from .retry import injection_state
+    st = injection_state()
+    if st.retry_ooms or st.split_ooms:
+        return True
+    try:
+        from .device import DeviceManager
+        from .spill import BufferCatalog
+        cat = BufferCatalog.get()
+        limit = DeviceManager.get().pool_limit_bytes()
+        if limit > 0 and cat.device_bytes >= limit * float(
+                conf.get(OOM_SYNC_WATERMARK)):
+            return True
+    except Exception:  # pragma: no cover — accounting must never kill a query
+        return True
+    return False
 
 
 def is_device_oom(exc: BaseException) -> bool:
@@ -30,10 +80,18 @@ def guard_device_oom(fn: Callable) -> Callable:
     """Wrap a compiled kernel: on device OOM, spill-all + retry once, then
     escalate to SplitAndRetryOOM (input halving)."""
 
-    def _sync(result):
+    def _sync(result, force: bool = False):
         # jit dispatch is ASYNC: an execution-time OOM surfaces when the
         # result is consumed, which would be outside this guard — force
-        # materialization so the failure lands in our try block
+        # materialization so the failure lands in our try block.  Under
+        # low memory pressure (syncMode=auto) the sync is skipped so the
+        # dispatch pipeline stays async over the tunnel; a deferred OOM is
+        # caught at the next materialization point and flips the guard
+        # into a defensive eager window.
+        if not force and not _should_sync():
+            STATS["lazy_dispatches"] += 1
+            return result
+        STATS["eager_syncs"] += 1
         try:
             import jax
             return jax.block_until_ready(result)
@@ -55,10 +113,13 @@ def guard_device_oom(fn: Callable) -> Callable:
                         e, conf=task.conf if task else None) from e
                 raise
             STATS["oom_caught"] += 1
+            global _defensive_until
+            import time as _time
+            _defensive_until = _time.monotonic() + _DEFENSIVE_WINDOW_S
             from .spill import BufferCatalog
             BufferCatalog.get().spill_all_device()
             try:
-                result = _sync(fn(*args, **kwargs))
+                result = _sync(fn(*args, **kwargs), force=True)
             except Exception as e2:  # noqa: BLE001
                 if is_device_oom(e2):
                     STATS["oom_split_raised"] += 1

@@ -330,14 +330,23 @@ class SpillableColumnarBatch:
     an operator isn't actively computing on a batch it holds one of these,
     so the catalog may demote it under memory pressure."""
 
-    def __init__(self, handle: int, num_rows: int, size: int,
+    def __init__(self, handle: int, num_rows: Optional[int], size: int,
                  catalog: BufferCatalog,
                  priority: int = ACTIVE_BATCHING_PRIORITY):
         self._handle: Optional[int] = handle
-        self.num_rows = num_rows
+        self._num_rows = num_rows
         self.size_bytes = size
         self.priority = priority
         self._catalog = catalog
+
+    @property
+    def num_rows(self) -> int:
+        """Host row count, pulled LAZILY: registering a batch whose count
+        only exists on the device must not cost a tunnel round trip unless
+        someone actually needs the number."""
+        if self._num_rows is None:
+            self._num_rows = self.get().num_rows_int
+        return self._num_rows
 
     @staticmethod
     def create(batch: ColumnarBatch,
@@ -347,8 +356,8 @@ class SpillableColumnarBatch:
         catalog = catalog or BufferCatalog.get()
         size = batch_device_bytes(batch)
         h = catalog.add_batch(batch, priority)
-        return SpillableColumnarBatch(h, batch.num_rows_int, size, catalog,
-                                      priority)
+        return SpillableColumnarBatch(h, getattr(batch, "_nrows_host", None),
+                                      size, catalog, priority)
 
     @property
     def catalog(self) -> BufferCatalog:

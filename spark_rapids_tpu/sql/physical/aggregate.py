@@ -14,7 +14,7 @@ Partial/PartialMerge modes.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -134,6 +134,19 @@ def group_phase(xp, key_cols: Sequence[DeviceColumn], row_mask):
 #: reduce into one program sized to it (bounded: keys embed literals, so
 #: reuse the kernel cache's eviction philosophy at small scale)
 _OUT_SPECULATION: dict = {}
+
+
+def record_speculation(spec_key, ng_host: int, minimum: int) -> None:
+    """Record an observed group count as the speculated table size for
+    this program key (max-join: a small tail batch must not clobber the
+    size a large batch needs, which would make every later large batch
+    mis-speculate and execute twice, forever)."""
+    from ...columnar.column import bucket_capacity
+    prev = _OUT_SPECULATION.get(spec_key, 0)
+    if len(_OUT_SPECULATION) > 1024:
+        _OUT_SPECULATION.clear()  # unbounded keys embed literals
+    _OUT_SPECULATION[spec_key] = max(
+        prev, bucket_capacity(max(int(ng_host), 1), minimum=minimum))
 
 #: largest group table served by the one-hot matmul reduction (the
 #: [rows, OUT] one-hot must stay cheap even if XLA doesn't fuse it away)
@@ -340,6 +353,7 @@ class HashAggregateExec(PhysicalPlan):
                                        key=("grp",) + self._partial_key)
             self._reduce_fns: dict = {}
             self._fused_fns: dict = {}
+            self._fused_complete_fns: dict = {}
             self._spec_key = self._partial_key  # no pre-steps yet
         merge_key = ("merge", len(self.grouping), slots_key)
         self._merge_fn = self._jit(self._merge_compute, key=merge_key)
@@ -371,6 +385,7 @@ class HashAggregateExec(PhysicalPlan):
                                    key=("grp",) + key)
         self._reduce_fns = {}
         self._fused_fns = {}
+        self._fused_complete_fns = {}
         self._spec_key = self._partial_key + tuple(
             s._fuse_key() for s in steps)
 
@@ -485,6 +500,79 @@ class HashAggregateExec(PhysicalPlan):
         key = ("fusedpartial", out_size, self._partial_key) + \
             tuple(s._fuse_key() for s in self._pre_steps)
         return self._jit(impl, key=key)
+
+    def _fused_complete_body(self, out_size: int):
+        """TRACEABLE speculative complete aggregate: fused pre-steps +
+        group phase + reductions + finalize under a host-guessed
+        group-table size.  Returns (result, ng).  Composable into larger
+        programs (whole-query tail fusion) or jitted alone."""
+        steps = tuple(self._pre_steps)
+
+        def impl(batch):
+            xp = self.xp
+            mask = batch.row_mask()
+            for step in steps:
+                batch, mask = step._fuse_step(batch, mask, xp)
+            ctx = EvalContext(batch, xp=xp)
+            keys = [g.eval(ctx) for g in self._bound_grouping]
+            rank64, ng = group_phase(xp, keys, mask)
+            slot_pairs, ops = self._eval_slots(ctx)
+            gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, mask,
+                                       rank64=rank64, n_groups=ng,
+                                       out_size=out_size)
+            names = tuple(f"_g{i}" for i in range(len(gk))) + \
+                tuple(f"_s{i}" for i in range(len(gs)))
+            partial = ColumnarBatch(names, tuple(gk) + tuple(gs), n)
+            # a single batch's partial has unique keys by construction, so
+            # the cross-batch merge is an identity — finalize directly
+            return self._finalize(partial), ng
+        return impl
+
+    def _fused_complete_key(self, out_size: int):
+        return ("fusedcomplete", out_size, self._partial_key,
+                self._finalize_key) + \
+            tuple(s._fuse_key() for s in self._pre_steps)
+
+    def _fused_complete_fn(self, out_size: int):
+        """Jitted :meth:`_fused_complete_body`.  With deferred validation
+        (speculation.py) the whole query needs ZERO host pulls until the
+        final D2H fetch, which bundles ``ng`` — mis-speculation is
+        detected there and the query re-runs on the exact path."""
+        return self._jit(self._fused_complete_body(out_size),
+                         key=self._fused_complete_key(out_size))
+
+    def _try_deferred_complete(self, batches):
+        """Zero-pull complete aggregate over a single input batch (the
+        common single-partition shape).  Returns the result batch or None
+        when the speculative path does not apply (no recorded size yet,
+        multiple batches, specials, or deferral disabled)."""
+        from . import speculation as SPEC
+        if self.backend != TPU or self._special:
+            return None
+        if not SPEC.deferral_enabled():
+            return None
+        live = [b for b in batches if b.num_rows_bound > 0]
+        if len(live) != 1:
+            return None
+        batch = live[0]
+        spec = _OUT_SPECULATION.get(self._spec_key)
+        if spec is None or spec > batch.capacity:
+            return None
+        fused = self._fused_complete_fns.get(spec)
+        if fused is None:
+            fused = self._fused_complete_fns[spec] = \
+                self._fused_complete_fn(spec)
+        from ...memory.retry import SplitAndRetryOOM
+        try:
+            out, ng = fused(batch)
+        except SplitAndRetryOOM:
+            return None  # memory pressure: take the spillable exact path
+        spec_key = self._spec_key
+        minimum = 64 if self.grouping else 1
+        SPEC.register(spec, ng,
+                      lambda ng_host, sk=spec_key, m=minimum:
+                      record_speculation(sk, ng_host, m))
+        return out.with_rows_bound(spec)
 
     def _run_partial(self, batch: ColumnarBatch) -> ColumnarBatch:
         """One input batch -> partial [keys..., slots...].  On the device
@@ -796,9 +884,28 @@ class HashAggregateExec(PhysicalPlan):
             yield self._finalize_jit(merged)
             return
 
+        if self.mode == "complete":
+            # zero-pull speculative path (single batch + recorded size +
+            # deferral enabled); falls through to the exact path otherwise.
+            # Peek ONE batch only — a many-batch child must keep streaming
+            # into spillables, not sit pinned on device in a list.
+            src = child.execute(pid, tctx)
+            first = next(src, None)
+            second = next(src, None) if first is not None else None
+            if first is not None and second is None:
+                fast = self._try_deferred_complete([first])
+                if fast is not None:
+                    tctx.inc_metric("aggDeferredComplete")
+                    yield fast
+                    return
+            from itertools import chain
+            head = [b for b in (first, second) if b is not None]
+            source: Iterator = chain(head, src)
+        else:
+            source = child.execute(pid, tctx)
         partials = []
         try:
-            for batch in child.execute(pid, tctx):
+            for batch in source:
                 sb = SpillableColumnarBatch.create(batch, ACTIVE_ON_DECK_PRIORITY)
                 for out in with_retry([sb],
                                       lambda s: self._run_partial(s.get()),

@@ -1,0 +1,225 @@
+"""Whole-query tail fusion — ONE compiled program from scan output to the
+packed device→host transfer.
+
+On the TPU tunnel the cost model is inverted from a local chip: compute is
+effectively free, while every dependent program launch and every host pull
+costs a network round trip (~65ms measured).  A q1-shaped query planned as
+``DeviceToHost(Sort(HashAggregate(complete)))`` pays three launches and a
+fetch.  This pass collapses the tail into one exec whose jitted program is
+
+    fused filters/projects -> group phase -> reductions -> finalize
+    -> sort permutation -> byte-pack (convert.pack_leaves_traced)
+
+and whose host side does a single overlapped fetch, unpacks numpy leaves,
+and resolves the speculation check from the bundled group count — so a
+warm collect costs ONE program launch + ONE fetch latency.
+
+Falls back to the wrapped subtree whenever the speculative preconditions
+don't hold (multiple input batches, no recorded group-table size, deferral
+disabled, first run).  Reference analog: none — the reference's per-op
+kernel-launch model (SURVEY §3.3) is the thing this replaces on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...columnar.batch import ColumnarBatch
+from .aggregate import (_OUT_SPECULATION, HashAggregateExec,
+                        record_speculation)
+from .base import CPU, PhysicalPlan
+from .sortlimit import SortExec
+from .transitions import DeviceToHostExec, batch_nbytes
+
+#: observability for tests/metrics
+STATS = {"fused_collects": 0, "fallbacks": 0}
+
+
+class _ReplaySource(PhysicalPlan):
+    """Feeds already-materialized batches to the fallback subtree."""
+
+    def __init__(self, like: PhysicalPlan, batches: List[ColumnarBatch]):
+        super().__init__()
+        self.backend = like.backend
+        self._like = like
+        self._batches = batches
+
+    @property
+    def output(self):
+        return self._like.output
+
+    def execute(self, pid, tctx):
+        return iter(self._batches)
+
+    def node_name(self):
+        return "Replay"
+
+
+class FusedCollectExec(PhysicalPlan):
+    """``DeviceToHost(Sort?(HashAggregate(complete)))`` as one program.
+
+    Children: the aggregate's child (the device-side source).  The wrapped
+    original subtree is kept for the fallback path.
+    """
+
+    backend = CPU  # emits host batches, like the D2H transition it replaces
+
+    def __init__(self, agg: HashAggregateExec, sort: Optional[SortExec],
+                 fallback: DeviceToHostExec):
+        super().__init__(agg.children[0])
+        self._agg = agg
+        self._sort = sort
+        self._fallback = fallback
+        self._programs: dict = {}  # (spec, capacity) -> (fn, sig, treedef)
+
+    @property
+    def output(self):
+        return self._fallback.output
+
+    def _build(self, spec: int, batch: ColumnarBatch):
+        """Compose agg body + sort + pack into one jitted fn for this
+        (speculated size, input signature)."""
+        import jax
+
+        from ...columnar.convert import pack_leaves_traced
+        from .kernel_cache import cached_jit
+        agg_body = self._agg._fused_complete_body(spec)
+        sort_compute = self._sort._compute if self._sort is not None else None
+
+        def tail_body(b):
+            fin, ng = agg_body(b)
+            if sort_compute is not None:
+                fin = sort_compute(fin)
+            return fin, ng
+
+        # learn the result-tree structure without executing
+        fin_sd, ng_sd = jax.eval_shape(tail_body, batch)
+        leaves_sd, treedef = jax.tree.flatten(fin_sd)
+        sig = tuple((tuple(sd.shape), str(sd.dtype)) for sd in leaves_sd)
+        sig = sig + ((tuple(ng_sd.shape), str(ng_sd.dtype)),)
+
+        def full(b):
+            fin, ng = tail_body(b)
+            leaves = jax.tree.flatten(fin)[0] + [ng]
+            return pack_leaves_traced(leaves, sig)
+
+        from ...columnar.convert import _f64_as_pair, _pack_f64_enabled
+        from .kernel_cache import exprs_key
+        sort_key = (exprs_key(self._sort._bound)
+                    if self._sort is not None else None)
+        key = ("tailcollect", spec, batch.capacity,
+               self._agg._fused_complete_key(spec), sort_key,
+               _f64_as_pair(), _pack_f64_enabled())
+        fn = cached_jit(key, full)
+        return fn, sig, treedef
+
+    def execute(self, pid, tctx):
+        from ...memory.oom_guard import guard_device_oom
+        from ...memory.retry import SplitAndRetryOOM
+        from ...columnar.convert import unpack_buffers
+        from . import speculation as SPEC
+        agg = self._agg
+        if not SPEC.deferral_enabled() or agg._special:
+            STATS["fallbacks"] += 1
+            yield from self._fallback.execute(pid, tctx)
+            return
+        # peek one batch only — a many-batch child keeps streaming into
+        # the fallback subtree's spillables, never pinned in a list here
+        src = self.children[0].execute(pid, tctx)
+        first = next(src, None)
+        second = next(src, None) if first is not None else None
+        spec = _OUT_SPECULATION.get(agg._spec_key)
+        single = (first is not None and second is None
+                  and first.num_rows_bound > 0)
+        if not single or spec is None or spec > first.capacity:
+            from itertools import chain
+            head = [b for b in (first, second) if b is not None]
+            STATS["fallbacks"] += 1
+            yield from self._run_fallback_on(chain(head, src), pid, tctx)
+            return
+        batch = first
+        from ...columnar.convert import _f64_as_pair, _pack_f64_enabled
+        pkey = (spec, batch.capacity, _f64_as_pair(), _pack_f64_enabled())
+        prog = self._programs.get(pkey)
+        if prog is None:
+            prog = self._programs[pkey] = self._build(spec, batch)
+        fn, sig, treedef = prog
+        run = guard_device_oom(fn)
+        try:
+            bufs = run(batch)
+        except SplitAndRetryOOM:
+            STATS["fallbacks"] += 1
+            yield from self._run_fallback_on([batch], pid, tctx)
+            return
+        for b in bufs:  # overlap transfers: one latency, not N
+            b.copy_to_host_async()
+        host = [np.asarray(b) for b in bufs]
+        leaves = unpack_buffers(host, sig)
+        ng_host = int(leaves[-1])
+        # record/validate the speculation through the standard registry so
+        # the session's post-run validation and re-run loop apply
+        minimum = 64 if agg.grouping else 1
+        SPEC.register(spec, None,
+                      lambda ng, sk=agg._spec_key, m=minimum:
+                      record_speculation(sk, ng, m)).resolve(ng_host)
+        if ng_host > spec:
+            return  # wrong result discarded; session re-runs
+        STATS["fused_collects"] += 1
+        tctx.inc_metric("fusedCollects")
+        import jax
+        out = jax.tree.unflatten(treedef, leaves[:-1])
+        tctx.inc_metric("d2h_bytes", batch_nbytes(out))
+        yield out.with_known_rows(ng_host)
+
+    def _run_fallback_on(self, batches, pid, tctx):
+        """Run the wrapped subtree, feeding it the already-started child
+        stream (the child must not execute twice)."""
+        import copy
+        replay = _ReplaySource(self.children[0], batches)
+        agg2 = copy.copy(self._agg)
+        agg2.children = (replay,)
+        node: PhysicalPlan = agg2
+        if self._sort is not None:
+            sort2 = copy.copy(self._sort)
+            sort2.children = (node,)
+            node = sort2
+        d2h2 = copy.copy(self._fallback)
+        d2h2.children = (node,)
+        yield from d2h2.execute(pid, tctx)
+
+    def node_name(self):
+        return "TpuFusedCollect"
+
+    def simple_string(self):
+        inner = self._agg.simple_string()
+        if self._sort is not None:
+            inner = f"{self._sort.simple_string()} <- {inner}"
+        return f"{self.node_name()} [{inner}]"
+
+    def tree_string(self, level: int = 0) -> str:
+        pad = "  " * level + ("+- " if level else "")
+        lines = [pad + self.simple_string()]
+        for c in self.children:
+            lines.append(c.tree_string(level + 1))
+        return "\n".join(lines)
+
+
+def fuse_collect_tail(phys: PhysicalPlan) -> PhysicalPlan:
+    """Planner pass: replace ``DeviceToHost(Sort?(HashAggregate(complete)))``
+    (sort orders referencing output columns only, TPU backend throughout)
+    with :class:`FusedCollectExec`."""
+    if not isinstance(phys, DeviceToHostExec):
+        return phys
+    inner = phys.children[0]
+    sort = None
+    agg = inner
+    if isinstance(inner, SortExec) and inner.backend != CPU:
+        sort = inner
+        agg = inner.children[0]
+    if not isinstance(agg, HashAggregateExec):
+        return phys
+    if agg.backend == CPU or agg.mode != "complete" or agg._special:
+        return phys
+    return FusedCollectExec(agg, sort, phys)

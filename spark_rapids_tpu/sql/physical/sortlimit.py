@@ -62,7 +62,10 @@ class SortExec(PhysicalPlan):
             return
         from ...config import SORT_OOC_TARGET_ROWS
         target = int(tctx.conf.get(SORT_OOC_TARGET_ROWS))
-        total = sum(b.num_rows_int for b in batches)
+        # pull-free conservative sizing: the bound is exact when known,
+        # else the padded capacity — engaging out-of-core a bit early is
+        # cheaper than one device sync per batch on the tunnel
+        total = sum(b.num_rows_bound for b in batches)
         if total > target:
             yield from self._out_of_core(batches, target)
             return
@@ -71,6 +74,10 @@ class SortExec(PhysicalPlan):
         known = getattr(merged, "_nrows_host", None)
         if known is not None:
             out.with_known_rows(known)  # sort permutes, never drops rows
+        else:
+            bound = getattr(merged, "_nrows_bound", None)
+            if bound is not None:
+                out.with_rows_bound(bound)
         yield out
 
     # --- out-of-core path -------------------------------------------------

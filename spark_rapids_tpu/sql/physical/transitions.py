@@ -58,10 +58,28 @@ class DeviceToHostExec(PhysicalPlan):
         return self.children[0].output
 
     def execute(self, pid, tctx):
-        import jax
+        from ...columnar.convert import bulk_device_get
+        from ...memory.oom_guard import guard_device_oom
+        from . import speculation
+        # the fetch is a materialization point: with syncMode=auto a
+        # deferred execution-time OOM surfaces HERE, so it runs under the
+        # guard's spill-and-retry protocol like any kernel.  bulk_device_get
+        # byte-packs the whole batch into ONE device->host transfer
+        fetch = guard_device_oom(bulk_device_get)
         for batch in self.children[0].execute(pid, tctx):
             tctx.inc_metric("d2h_bytes", batch_nbytes(batch))
-            yield jax.device_get(batch)  # ONE concurrent D2H for all leaves
+            # bundle pending speculation scalars into the SAME pull as the
+            # result — on the tunnel each separate pull is a ~65ms round
+            # trip, and this one was happening anyway
+            pending = speculation.unresolved()
+            if pending:
+                host_b, vals = fetch((batch, [c.ng for c in pending]))
+                for c, v in zip(pending, vals):
+                    c.resolve(int(v))
+                speculation.STATS["bundled_fetches"] += 1
+                yield host_b
+            else:
+                yield fetch(batch)  # ONE concurrent D2H for all leaves
 
     def node_name(self):
         return "DeviceToHost"

@@ -53,6 +53,10 @@ class Planner:
         phys = self.plan(logical)
         if phys.backend == TPU:
             phys = DeviceToHostExec(phys)
+        from ..config import FUSION_ENABLED
+        if bool(self.conf.get(FUSION_ENABLED)):
+            from .physical.collect_fusion import fuse_collect_tail
+            phys = fuse_collect_tail(phys)
         return phys
 
     # ------------------------------------------------------------------

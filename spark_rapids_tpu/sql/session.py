@@ -77,9 +77,52 @@ class TpuSession:
     # ------------------------------------------------------------------
     def _execute(self, logical: P.LogicalPlan) -> pa.Table:
         from ..columnar.convert import device_to_arrow
+        from .physical import speculation
         planner = Planner(self._conf)
         phys = planner.plan_for_collect(logical)
-        batches = phys.execute_all(self._conf)
+        # collect has no side effects, so speculative results may be
+        # validated AFTER the fetch (zero extra pulls); a mis-speculation
+        # recorded the corrected group-table size — re-plan and re-run
+        speculation.clear()
+        try:
+            oom_retried = False
+            attempt = 0
+            while True:
+                # final attempt runs exact (deferral off) so the loop
+                # always terminates with a validated result
+                speculation.set_deferral(attempt < 2)
+                try:
+                    batches = phys.execute_all(self._conf)
+                except Exception as e:
+                    # with syncMode=auto a deferred execution-time OOM can
+                    # surface at the D2H fetch, where the kernel guard
+                    # cannot re-run the producing kernel.  Recovery is a
+                    # whole-query retry: the guard already entered its
+                    # defensive window (eager per-kernel sync), so the
+                    # re-run lands any OOM inside the failing kernel's
+                    # own spill-and-retry protocol.
+                    from ..memory.oom_guard import is_device_oom
+                    from ..memory.retry import RetryOOM, SplitAndRetryOOM
+                    retriable = isinstance(e, (RetryOOM, SplitAndRetryOOM)) \
+                        or is_device_oom(e)
+                    if not retriable or oom_retried:
+                        raise
+                    oom_retried = True
+                    from ..memory.spill import BufferCatalog
+                    BufferCatalog.get().spill_all_device()
+                    speculation.clear()
+                    phys = planner.plan_for_collect(logical)
+                    continue
+                checks = speculation.drain()
+                bad = [c for c in checks if c.failed]
+                if not bad or attempt >= 2:
+                    break
+                attempt += 1
+                speculation.STATS["mis_speculations"] += len(bad)
+                speculation.STATS["reruns"] += 1
+                phys = planner.plan_for_collect(logical)
+        finally:
+            speculation.set_deferral(False)
         from .physical.base import collect_metrics
         self.last_query_metrics = collect_metrics(phys)
         tables = [device_to_arrow(b) for b in batches if b.num_rows_int > 0]
