@@ -240,9 +240,43 @@ def build_tpcds_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
                                   type=pa.int32()),
         "i_brand_id": pa.array(rng.integers(0, 40, n_items),
                                type=pa.int32()),
+        "i_category_id": pa.array(rng.integers(0, 10, n_items),
+                                  type=pa.int32()),
+        "i_manager_id": pa.array(rng.integers(0, 100, n_items),
+                                 type=pa.int32()),
     })
+    n_cd = 200
+    customer_demographics = pa.table({
+        "cd_demo_sk": pa.array(np.arange(n_cd), type=pa.int64()),
+        "cd_gender": pa.array(rng.choice(["M", "F"], n_cd)),
+        "cd_marital_status": pa.array(rng.choice(["S", "M", "D", "W"],
+                                                 n_cd)),
+        "cd_education_status": pa.array(rng.choice(
+            ["College", "Primary", "Secondary", "Advanced Degree"], n_cd)),
+    })
+    n_promo = 50
+    promotion = pa.table({
+        "p_promo_sk": pa.array(np.arange(n_promo), type=pa.int64()),
+        "p_channel_email": pa.array(rng.choice(["Y", "N"], n_promo)),
+        "p_channel_event": pa.array(rng.choice(["Y", "N"], n_promo)),
+    })
+    # fact foreign keys into the new dims
+    store_sales = store_sales.append_column(
+        "ss_cdemo_sk", pa.array(rng.integers(0, n_cd, rows),
+                                type=pa.int64()))
+    store_sales = store_sales.append_column(
+        "ss_promo_sk", pa.array(rng.integers(0, n_promo, rows),
+                                type=pa.int64()))
+    store_sales = store_sales.append_column(
+        "ss_quantity", pa.array(rng.integers(1, 100, rows),
+                                type=pa.int32()))
+    store_sales = store_sales.append_column(
+        "ss_list_price", pa.array(np.round(rng.random(rows) * 200, 2)))
+    store_sales = store_sales.append_column(
+        "ss_coupon_amt", pa.array(np.round(rng.random(rows) * 50, 2)))
     return {"store_sales": store_sales, "date_dim": date_dim,
-            "item": item}
+            "item": item, "customer_demographics": customer_demographics,
+            "promotion": promotion}
 
 
 def _tpcds_q3(sess, t, F):
@@ -272,6 +306,114 @@ def _tpcds_q3(sess, t, F):
     assert np.allclose(got["sum_agg"], exp["sum_agg"])
 
 
+def _tpcds_q7(sess, t, F):
+    """TPC-DS q7 shape: 4-way star join (store_sales x cdemo x date x
+    item x promotion) with demographic + promo-channel filters, four AVGs
+    by item (BASELINE config 3)."""
+    ss = sess.create_dataframe(t["store_sales"], num_partitions=4)
+    cd = sess.create_dataframe(t["customer_demographics"], num_partitions=2)
+    dd = sess.create_dataframe(t["date_dim"], num_partitions=2)
+    it = sess.create_dataframe(t["item"], num_partitions=2)
+    pr = sess.create_dataframe(t["promotion"], num_partitions=2)
+    got = (ss.join(cd, ss.ss_cdemo_sk == cd.cd_demo_sk)
+           .join(dd, ss.ss_sold_date_sk == dd.d_date_sk)
+           .join(it, ss.ss_item_sk == it.i_item_sk)
+           .join(pr, ss.ss_promo_sk == pr.p_promo_sk)
+           .filter((cd.cd_gender == "M")
+                   & (cd.cd_marital_status == "S")
+                   & (cd.cd_education_status == "College")
+                   & ((pr.p_channel_email == "N")
+                      | (pr.p_channel_event == "N"))
+                   & (dd.d_year == 2000))
+           .groupBy("i_item_sk")
+           .agg(F.avg(F.col("ss_quantity")).alias("agg1"),
+                F.avg(F.col("ss_list_price")).alias("agg2"),
+                F.avg(F.col("ss_coupon_amt")).alias("agg3"),
+                F.avg(F.col("ss_ext_sales_price")).alias("agg4"))
+           .orderBy("i_item_sk")
+           .collect().to_pandas())
+    pdf = (t["store_sales"].to_pandas()
+           .merge(t["customer_demographics"].to_pandas(),
+                  left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+           .merge(t["date_dim"].to_pandas(), left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+           .merge(t["item"].to_pandas(), left_on="ss_item_sk",
+                  right_on="i_item_sk")
+           .merge(t["promotion"].to_pandas(), left_on="ss_promo_sk",
+                  right_on="p_promo_sk"))
+    pdf = pdf[(pdf.cd_gender == "M") & (pdf.cd_marital_status == "S")
+              & (pdf.cd_education_status == "College")
+              & ((pdf.p_channel_email == "N") | (pdf.p_channel_event == "N"))
+              & (pdf.d_year == 2000)]
+    exp = (pdf.groupby("i_item_sk")
+           .agg(agg1=("ss_quantity", "mean"),
+                agg2=("ss_list_price", "mean"),
+                agg3=("ss_coupon_amt", "mean"),
+                agg4=("ss_ext_sales_price", "mean"))
+           .sort_index().reset_index())
+    assert np.array_equal(got["i_item_sk"], exp["i_item_sk"])
+    for c in ("agg1", "agg2", "agg3", "agg4"):
+        assert np.allclose(got[c], exp[c]), c
+
+
+def _tpcds_q19(sess, t, F):
+    """TPC-DS q19 shape: brand revenue for a (year, month) window with a
+    manager filter — join order stresses the broadcast-vs-shuffle
+    decision (BASELINE config 3)."""
+    ss = sess.create_dataframe(t["store_sales"], num_partitions=4)
+    dd = sess.create_dataframe(t["date_dim"], num_partitions=2)
+    it = sess.create_dataframe(t["item"], num_partitions=2)
+    got = (dd.join(ss, ss.ss_sold_date_sk == dd.d_date_sk)
+           .join(it, ss.ss_item_sk == it.i_item_sk)
+           .filter((it.i_manager_id == 8) & (dd.d_moy == 11)
+                   & (dd.d_year == 1999))
+           .groupBy("i_brand_id")
+           .agg(F.sum(F.col("ss_ext_sales_price")).alias("ext_price"))
+           .orderBy(F.col("ext_price").desc(), "i_brand_id")
+           .collect().to_pandas())
+    pdf = (t["store_sales"].to_pandas()
+           .merge(t["date_dim"].to_pandas(), left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+           .merge(t["item"].to_pandas(), left_on="ss_item_sk",
+                  right_on="i_item_sk"))
+    pdf = pdf[(pdf.i_manager_id == 8) & (pdf.d_moy == 11)
+              & (pdf.d_year == 1999)]
+    exp = (pdf.groupby("i_brand_id")
+           .agg(ext_price=("ss_ext_sales_price", "sum")).reset_index()
+           .sort_values(["ext_price", "i_brand_id"],
+                        ascending=[False, True]).reset_index(drop=True))
+    assert np.array_equal(got["i_brand_id"], exp["i_brand_id"])
+    assert np.allclose(got["ext_price"], exp["ext_price"])
+
+
+def _tpcds_q42(sess, t, F):
+    """TPC-DS q42 shape: (year, category) revenue for one month
+    (BASELINE config 3)."""
+    ss = sess.create_dataframe(t["store_sales"], num_partitions=4)
+    dd = sess.create_dataframe(t["date_dim"], num_partitions=2)
+    it = sess.create_dataframe(t["item"], num_partitions=2)
+    got = (dd.join(ss, ss.ss_sold_date_sk == dd.d_date_sk)
+           .join(it, ss.ss_item_sk == it.i_item_sk)
+           .filter((dd.d_moy == 12) & (dd.d_year == 2000))
+           .groupBy("d_year", "i_category_id")
+           .agg(F.sum(F.col("ss_ext_sales_price")).alias("total"))
+           .orderBy(F.col("total").desc(), "d_year", "i_category_id")
+           .collect().to_pandas())
+    pdf = (t["store_sales"].to_pandas()
+           .merge(t["date_dim"].to_pandas(), left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+           .merge(t["item"].to_pandas(), left_on="ss_item_sk",
+                  right_on="i_item_sk"))
+    pdf = pdf[(pdf.d_moy == 12) & (pdf.d_year == 2000)]
+    exp = (pdf.groupby(["d_year", "i_category_id"])
+           .agg(total=("ss_ext_sales_price", "sum")).reset_index()
+           .sort_values(["total", "d_year", "i_category_id"],
+                        ascending=[False, True, True])
+           .reset_index(drop=True))
+    assert np.array_equal(got["i_category_id"], exp["i_category_id"])
+    assert np.allclose(got["total"], exp["total"])
+
+
 QUERIES: List[Tuple[str, Callable]] = [
     ("q1_filter_agg", _q1),
     ("q2_join_agg", _q2),
@@ -282,6 +424,9 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("tpch_q1", _tpch_q1),
     ("tpch_q6", _tpch_q6),
     ("tpcds_q3_star_join", _tpcds_q3),
+    ("tpcds_q7_star4_avgs", _tpcds_q7),
+    ("tpcds_q19_brand_rev", _tpcds_q19),
+    ("tpcds_q42_cat_rev", _tpcds_q42),
 ]
 
 #: table-set builders per query prefix (run_suite routes each query to
