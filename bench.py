@@ -28,11 +28,14 @@ import time
 import numpy as np
 
 #: TPC-H SF1 lineitem is ~6M rows; 8M keeps the workload representative
-#: of the actual benchmark target while fitting the driver budget.
+#: of the actual benchmark target.  The bench banks a result at 1M first
+#: (fast even with a cold XLA compile cache), then upgrades to the full
+#: size if budget remains — the watchdog emits the best result so far.
 try:
     ROWS = int(float(sys.argv[1])) if len(sys.argv) > 1 else 8_000_000
 except ValueError:
     ROWS = 8_000_000
+WARM_ROWS = min(1_000_000, ROWS)
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "270"))
 
@@ -190,35 +193,44 @@ def main():
         sys.stdout.flush()
         os._exit(0)
 
-    try:
-        data = make_data(ROWS)
-        cpu_time, cpu_result = run_pandas(data)
-    except BaseException as e:
-        _emit(note=f"setup/baseline failed: {type(e).__name__}: {e}")
-        return
     tol = 2e-3  # float32 accumulation vs pandas float64
-
-    try:
-        eng_time, eng_result = run_engine(data)
-    except Exception as e:
-        _emit(note=f"engine failed: {type(e).__name__}: {e}")
-        return
-
     note = None
-    try:
-        got = {(r["returnflag"], r["linestatus"]): r
-               for r in eng_result.to_pylist()}
-        for (rf, ls), row in cpu_result.iterrows():
-            g = got[(rf, ls)]
-            assert g["count"] == int(row["count"]), "count mismatch"
-            rel = abs(g["sum_qty"] - row["sum_qty"]) / max(1.0, abs(row["sum_qty"]))
-            assert rel < tol, f"sum_qty rel err {rel}"
-    except Exception as e:
-        note = f"cross-check failed: {type(e).__name__}: {e}"
 
-    rows_per_sec = ROWS / eng_time
-    _result.update(value=round(rows_per_sec),
-                   vs_baseline=round(cpu_time / eng_time, 3))
+    def measure(rows: int):
+        """Bank one measurement into _result; returns the note (if any).
+        Called smallest-size first so a budget/watchdog cutoff mid-way
+        through the big size still reports a real number."""
+        nonlocal note
+        data = make_data(rows)
+        cpu_time, cpu_result = run_pandas(data)
+        eng_time, eng_result = run_engine(data)
+        try:
+            got = {(r["returnflag"], r["linestatus"]): r
+                   for r in eng_result.to_pylist()}
+            for (rf, ls), row in cpu_result.iterrows():
+                g = got[(rf, ls)]
+                assert g["count"] == int(row["count"]), "count mismatch"
+                rel = abs(g["sum_qty"] - row["sum_qty"]) \
+                    / max(1.0, abs(row["sum_qty"]))
+                assert rel < tol, f"sum_qty rel err {rel}"
+        except Exception as e:
+            note = f"cross-check failed at {rows} rows: " \
+                   f"{type(e).__name__}: {e}"
+        _result.update(value=round(rows / eng_time),
+                       vs_baseline=round(cpu_time / eng_time, 3),
+                       rows=rows)
+
+    try:
+        measure(WARM_ROWS)
+        if ROWS > WARM_ROWS:
+            measure(ROWS)
+    except BaseException as e:
+        if _result.get("rows"):
+            note = (note or "") + f"; larger size failed: " \
+                f"{type(e).__name__}: {e}"
+        else:
+            _emit(note=f"engine failed: {type(e).__name__}: {e}")
+            return
     # context: each host<->device sync over the axon tunnel costs a full
     # network round trip; with N sequential pipeline stages the floor is
     # N*rtt regardless of device speed, so report the measured rtt
