@@ -36,6 +36,11 @@ from .transitions import DeviceToHostExec, batch_nbytes
 #: observability for tests/metrics
 STATS = {"fused_collects": 0, "fallbacks": 0}
 
+#: process-wide (fn, sig, treedef) per tail key — the planner builds a
+#: fresh FusedCollectExec per collect, so an instance cache would pay
+#: eval_shape + jit-wrapper lookup every query
+_TAIL_PROGRAMS: dict = {}
+
 
 class _ReplaySource(PhysicalPlan):
     """Feeds already-materialized batches to the fallback subtree."""
@@ -72,13 +77,21 @@ class FusedCollectExec(PhysicalPlan):
         self._agg = agg
         self._sort = sort
         self._fallback = fallback
-        self._programs: dict = {}  # (spec, capacity) -> (fn, sig, treedef)
 
     @property
     def output(self):
         return self._fallback.output
 
-    def _build(self, spec: int, batch: ColumnarBatch):
+    def _tail_key(self, spec: int, capacity: int):
+        from ...columnar.convert import _f64_as_pair, _pack_f64_enabled
+        from .kernel_cache import exprs_key
+        sort_key = (exprs_key(self._sort._bound)
+                    if self._sort is not None else None)
+        return ("tailcollect", spec, capacity,
+                self._agg._fused_complete_key(spec), sort_key,
+                _f64_as_pair(), _pack_f64_enabled())
+
+    def _build(self, spec: int, batch: ColumnarBatch, key):
         """Compose agg body + sort + pack into one jitted fn for this
         (speculated size, input signature)."""
         import jax
@@ -105,13 +118,6 @@ class FusedCollectExec(PhysicalPlan):
             leaves = jax.tree.flatten(fin)[0] + [ng]
             return pack_leaves_traced(leaves, sig)
 
-        from ...columnar.convert import _f64_as_pair, _pack_f64_enabled
-        from .kernel_cache import exprs_key
-        sort_key = (exprs_key(self._sort._bound)
-                    if self._sort is not None else None)
-        key = ("tailcollect", spec, batch.capacity,
-               self._agg._fused_complete_key(spec), sort_key,
-               _f64_as_pair(), _pack_f64_enabled())
         fn = cached_jit(key, full)
         return fn, sig, treedef
 
@@ -140,11 +146,12 @@ class FusedCollectExec(PhysicalPlan):
             yield from self._run_fallback_on(chain(head, src), pid, tctx)
             return
         batch = first
-        from ...columnar.convert import _f64_as_pair, _pack_f64_enabled
-        pkey = (spec, batch.capacity, _f64_as_pair(), _pack_f64_enabled())
-        prog = self._programs.get(pkey)
+        pkey = self._tail_key(spec, batch.capacity)
+        prog = _TAIL_PROGRAMS.get(pkey)
         if prog is None:
-            prog = self._programs[pkey] = self._build(spec, batch)
+            if len(_TAIL_PROGRAMS) > 512:
+                _TAIL_PROGRAMS.clear()
+            prog = _TAIL_PROGRAMS[pkey] = self._build(spec, batch, pkey)
         fn, sig, treedef = prog
         run = guard_device_oom(fn)
         try:
