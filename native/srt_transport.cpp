@@ -1,0 +1,519 @@
+// Native cross-process shuffle data plane — the C++ core behind
+// spark_rapids_tpu/shuffle/native_tcp.py (reference analog: the UCX
+// transport module, shuffle-plugin/UCX.scala — native data movement with
+// a single progress thread; here the progress thread is an epoll loop).
+//
+// Wire protocol (identical to the Python TcpShuffleTransport in
+// spark_rapids_tpu/shuffle/tcp.py, so native and Python peers interop):
+//   request : u32 magic | u8 op | i64 shuffle | i64 map | i64 reduce  (BE)
+//   response: u8 status | u64 len | payload                           (BE)
+// Only the block-fetch op (1) is served here; the JSON registry ops stay
+// on the Python driver (control plane in Python, data plane native —
+// mirroring the reference's Spark-RPC control / UCX data split).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x53525054;  // "SRPT"
+constexpr uint8_t kOpFetch = 1;
+constexpr uint8_t kFound = 0;
+constexpr uint8_t kMissing = 1;
+constexpr size_t kReqSize = 4 + 1 + 8 * 3;
+
+inline uint64_t bswap64(uint64_t v) { return __builtin_bswap64(v); }
+
+inline int64_t read_i64_be(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return static_cast<int64_t>(bswap64(v));
+}
+
+struct BlockKey {
+  int64_t shuffle, map, reduce;
+  bool operator==(const BlockKey& o) const {
+    return shuffle == o.shuffle && map == o.map && reduce == o.reduce;
+  }
+};
+
+struct BlockKeyHash {
+  size_t operator()(const BlockKey& k) const {
+    uint64_t h = 0x9e3779b97f4a7c15ull;
+    for (int64_t v : {k.shuffle, k.map, k.reduce}) {
+      h ^= static_cast<uint64_t>(v) + 0x9e3779b97f4a7c15ull + (h << 6) +
+           (h >> 2);
+    }
+    return static_cast<size_t>(h);
+  }
+};
+
+using BlockPtr = std::shared_ptr<std::vector<uint8_t>>;
+
+struct Conn {
+  int fd = -1;
+  std::vector<uint8_t> in;   // partial request bytes
+  std::string out;           // pending response bytes
+  size_t out_off = 0;
+};
+
+// --------------------------------------------------------------------------
+// Server: one epoll progress thread serving block fetches.
+// --------------------------------------------------------------------------
+struct Server {
+  int lfd = -1, efd = -1, wake_fd = -1;
+  int port = 0;
+  std::thread th;
+  std::mutex mu;  // guards store
+  std::unordered_map<BlockKey, BlockPtr, BlockKeyHash> store;
+  std::unordered_map<int, std::unique_ptr<Conn>> conns;
+  bool stopping = false;
+
+  bool start(const char* host, int want_port) {
+    lfd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (lfd < 0) return false;
+    int one = 1;
+    ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return fail();
+    if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      return fail();
+    if (::listen(lfd, 128) != 0) return fail();
+    socklen_t alen = sizeof(addr);
+    ::getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    efd = ::epoll_create1(0);
+    wake_fd = ::eventfd(0, EFD_NONBLOCK);
+    if (efd < 0 || wake_fd < 0) return fail();
+    add_fd(lfd, EPOLLIN);
+    add_fd(wake_fd, EPOLLIN);
+    th = std::thread([this] { loop(); });
+    return true;
+  }
+
+  bool fail() {
+    if (lfd >= 0) ::close(lfd);
+    if (efd >= 0) ::close(efd);
+    if (wake_fd >= 0) ::close(wake_fd);
+    lfd = efd = wake_fd = -1;
+    return false;
+  }
+
+  void add_fd(int fd, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.fd = fd;
+    ::epoll_ctl(efd, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void mod_fd(int fd, uint32_t events) {
+    epoll_event ev{};
+    ev.events = events;
+    ev.data.fd = fd;
+    ::epoll_ctl(efd, EPOLL_CTL_MOD, fd, &ev);
+  }
+
+  void close_conn(int fd) {
+    ::epoll_ctl(efd, EPOLL_CTL_DEL, fd, nullptr);
+    ::close(fd);
+    conns.erase(fd);
+  }
+
+  void loop() {
+    epoll_event evs[64];
+    while (true) {
+      int n = ::epoll_wait(efd, evs, 64, -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        return;
+      }
+      for (int i = 0; i < n; i++) {
+        int fd = evs[i].data.fd;
+        if (fd == wake_fd) {
+          // stop signal
+          uint64_t v;
+          (void)!::read(wake_fd, &v, 8);
+          shutdown_all();
+          return;
+        }
+        if (fd == lfd) {
+          accept_all();
+          continue;
+        }
+        auto it = conns.find(fd);
+        if (it == conns.end()) continue;
+        Conn* c = it->second.get();
+        bool dead = false;
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
+        if (!dead && (evs[i].events & EPOLLIN)) dead = !on_readable(c);
+        if (!dead && (evs[i].events & EPOLLOUT)) dead = !on_writable(c);
+        if (dead) close_conn(fd);
+      }
+    }
+  }
+
+  void shutdown_all() {
+    for (auto& kv : conns) ::close(kv.first);
+    conns.clear();
+    ::close(lfd);
+    ::close(efd);
+    ::close(wake_fd);
+  }
+
+  void accept_all() {
+    while (true) {
+      int cfd = ::accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+      if (cfd < 0) return;
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto c = std::make_unique<Conn>();
+      c->fd = cfd;
+      conns[cfd] = std::move(c);
+      add_fd(cfd, EPOLLIN);
+    }
+  }
+
+  // returns false when the connection must close
+  bool on_readable(Conn* c) {
+    uint8_t buf[16384];
+    while (true) {
+      ssize_t got = ::recv(c->fd, buf, sizeof(buf), 0);
+      if (got > 0) {
+        c->in.insert(c->in.end(), buf, buf + got);
+        continue;
+      }
+      if (got == 0) return false;  // peer closed
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return false;
+    }
+    while (c->in.size() >= kReqSize) {
+      const uint8_t* p = c->in.data();
+      uint32_t magic;
+      std::memcpy(&magic, p, 4);
+      magic = ntohl(magic);
+      uint8_t op = p[4];
+      if (magic != kMagic || op != kOpFetch) return false;
+      BlockKey key{read_i64_be(p + 5), read_i64_be(p + 13),
+                   read_i64_be(p + 21)};
+      c->in.erase(c->in.begin(), c->in.begin() + kReqSize);
+      BlockPtr blk;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        auto it = store.find(key);
+        if (it != store.end()) blk = it->second;
+      }
+      uint8_t head[9];
+      head[0] = blk ? kFound : kMissing;
+      uint64_t len = bswap64(blk ? blk->size() : 0);
+      std::memcpy(head + 1, &len, 8);
+      c->out.append(reinterpret_cast<char*>(head), 9);
+      if (blk)
+        c->out.append(reinterpret_cast<const char*>(blk->data()),
+                      blk->size());
+    }
+    if (!c->out.empty() && !on_writable(c)) return false;
+    return true;
+  }
+
+  bool on_writable(Conn* c) {
+    while (c->out_off < c->out.size()) {
+      ssize_t sent = ::send(c->fd, c->out.data() + c->out_off,
+                            c->out.size() - c->out_off, MSG_NOSIGNAL);
+      if (sent > 0) {
+        c->out_off += static_cast<size_t>(sent);
+        continue;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        mod_fd(c->fd, EPOLLIN | EPOLLOUT);
+        return true;
+      }
+      return false;
+    }
+    c->out.clear();
+    c->out_off = 0;
+    mod_fd(c->fd, EPOLLIN);
+    return true;
+  }
+
+  void stop() {
+    uint64_t one = 1;
+    (void)!::write(wake_fd, &one, 8);
+    if (th.joinable()) th.join();
+  }
+};
+
+// --------------------------------------------------------------------------
+// Client: pooled blocking fetches (timeouts; one reconnect per fetch).
+// --------------------------------------------------------------------------
+struct Client {
+  std::mutex mu;
+  std::unordered_map<std::string, int> conns;
+
+  int connect_to(const std::string& host, int port) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    timeval tv{10, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1 ||
+        ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+            0) {
+      ::close(fd);
+      return -1;
+    }
+    return fd;
+  }
+
+  static bool send_all(int fd, const uint8_t* p, size_t n) {
+    while (n) {
+      ssize_t s = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (s <= 0) return false;
+      p += s;
+      n -= static_cast<size_t>(s);
+    }
+    return true;
+  }
+
+  static bool recv_all(int fd, uint8_t* p, size_t n) {
+    while (n) {
+      ssize_t g = ::recv(fd, p, n, 0);
+      if (g <= 0) return false;
+      p += g;
+      n -= static_cast<size_t>(g);
+    }
+    return true;
+  }
+
+  // status: 0 found, 1 missing, 2 network failure
+  int fetch(const std::string& host, int port, const BlockKey& key,
+            uint8_t** out, uint64_t* out_len) {
+    std::string ep = host + ":" + std::to_string(port);
+    std::lock_guard<std::mutex> g(mu);
+    for (int attempt = 0; attempt < 2; attempt++) {
+      int fd;
+      auto it = conns.find(ep);
+      if (attempt == 0 && it != conns.end()) {
+        fd = it->second;
+      } else {
+        if (it != conns.end()) {
+          ::close(it->second);
+          conns.erase(it);
+        }
+        fd = connect_to(host, port);
+        if (fd < 0) continue;
+        conns[ep] = fd;
+      }
+      uint8_t req[kReqSize];
+      uint32_t magic = htonl(kMagic);
+      std::memcpy(req, &magic, 4);
+      req[4] = kOpFetch;
+      for (int i = 0; i < 3; i++) {
+        int64_t v = i == 0 ? key.shuffle : i == 1 ? key.map : key.reduce;
+        uint64_t be = bswap64(static_cast<uint64_t>(v));
+        std::memcpy(req + 5 + 8 * i, &be, 8);
+      }
+      uint8_t head[9];
+      if (!send_all(fd, req, kReqSize) || !recv_all(fd, head, 9)) {
+        ::close(fd);
+        conns.erase(ep);
+        continue;
+      }
+      if (head[0] == kMissing) return 1;
+      uint64_t len;
+      std::memcpy(&len, head + 1, 8);
+      len = bswap64(len);
+      uint8_t* buf = static_cast<uint8_t*>(::malloc(len ? len : 1));
+      if (len && !recv_all(fd, buf, len)) {
+        ::free(buf);
+        ::close(fd);
+        conns.erase(ep);
+        continue;
+      }
+      *out = buf;
+      *out_len = len;
+      return 0;
+    }
+    return 2;
+  }
+
+  void close_all() {
+    std::lock_guard<std::mutex> g(mu);
+    for (auto& kv : conns) ::close(kv.second);
+    conns.clear();
+  }
+};
+
+std::mutex g_mu;
+int64_t g_next = 1;
+std::unordered_map<int64_t, std::unique_ptr<Server>> g_servers;
+std::unordered_map<int64_t, std::unique_ptr<Client>> g_clients;
+
+Server* server_of(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_servers.find(h);
+  return it == g_servers.end() ? nullptr : it->second.get();
+}
+
+Client* client_of(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second.get();
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t srt_shuffle_server_start(const char* host, int port) {
+  auto s = std::make_unique<Server>();
+  if (!s->start(host, port)) return -1;
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_servers[h] = std::move(s);
+  return h;
+}
+
+int srt_shuffle_server_port(int64_t h) {
+  Server* s = server_of(h);
+  return s ? s->port : -1;
+}
+
+void srt_shuffle_server_publish(int64_t h, int64_t shuffle, int64_t map,
+                                int64_t reduce, const uint8_t* data,
+                                uint64_t len) {
+  Server* s = server_of(h);
+  if (!s) return;
+  auto blk = std::make_shared<std::vector<uint8_t>>(data, data + len);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->store[BlockKey{shuffle, map, reduce}] = std::move(blk);
+}
+
+// local short-circuit; returns 0 found / 1 missing
+int srt_shuffle_server_get(int64_t h, int64_t shuffle, int64_t map,
+                           int64_t reduce, uint8_t** out,
+                           uint64_t* out_len) {
+  Server* s = server_of(h);
+  if (!s) return 1;
+  BlockPtr blk;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    auto it = s->store.find(BlockKey{shuffle, map, reduce});
+    if (it != s->store.end()) blk = it->second;
+  }
+  if (!blk) return 1;
+  uint8_t* buf = static_cast<uint8_t*>(::malloc(blk->size() ? blk->size()
+                                                            : 1));
+  std::memcpy(buf, blk->data(), blk->size());
+  *out = buf;
+  *out_len = blk->size();
+  return 0;
+}
+
+int64_t srt_shuffle_server_block_count(int64_t h, int64_t shuffle) {
+  Server* s = server_of(h);
+  if (!s) return 0;
+  std::lock_guard<std::mutex> g(s->mu);
+  if (shuffle < 0) return static_cast<int64_t>(s->store.size());
+  int64_t n = 0;
+  for (auto& kv : s->store)
+    if (kv.first.shuffle == shuffle) n++;
+  return n;
+}
+
+// fills out[3*i .. 3*i+2] with (shuffle, map, reduce); returns count
+int64_t srt_shuffle_server_block_list(int64_t h, int64_t shuffle,
+                                      int64_t* out, int64_t cap_blocks) {
+  Server* s = server_of(h);
+  if (!s) return 0;
+  std::lock_guard<std::mutex> g(s->mu);
+  int64_t n = 0;
+  for (auto& kv : s->store) {
+    if (shuffle >= 0 && kv.first.shuffle != shuffle) continue;
+    if (n >= cap_blocks) break;
+    out[3 * n] = kv.first.shuffle;
+    out[3 * n + 1] = kv.first.map;
+    out[3 * n + 2] = kv.first.reduce;
+    n++;
+  }
+  return n;
+}
+
+void srt_shuffle_server_clear(int64_t h, int64_t shuffle) {
+  Server* s = server_of(h);
+  if (!s) return;
+  std::lock_guard<std::mutex> g(s->mu);
+  if (shuffle < 0) {
+    s->store.clear();
+    return;
+  }
+  for (auto it = s->store.begin(); it != s->store.end();) {
+    if (it->first.shuffle == shuffle)
+      it = s->store.erase(it);
+    else
+      ++it;
+  }
+}
+
+void srt_shuffle_server_stop(int64_t h) {
+  std::unique_ptr<Server> s;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_servers.find(h);
+    if (it == g_servers.end()) return;
+    s = std::move(it->second);
+    g_servers.erase(it);
+  }
+  s->stop();
+}
+
+int64_t srt_shuffle_client_new() {
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_clients[h] = std::make_unique<Client>();
+  return h;
+}
+
+int srt_shuffle_client_fetch(int64_t h, const char* host, int port,
+                             int64_t shuffle, int64_t map, int64_t reduce,
+                             uint8_t** out, uint64_t* out_len) {
+  Client* c = client_of(h);
+  if (!c) return 2;
+  return c->fetch(host, port, BlockKey{shuffle, map, reduce}, out, out_len);
+}
+
+void srt_shuffle_client_close(int64_t h) {
+  std::unique_ptr<Client> c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(h);
+    if (it == g_clients.end()) return;
+    c = std::move(it->second);
+    g_clients.erase(it);
+  }
+  c->close_all();
+}
+
+void srt_transport_buf_free(uint8_t* p) { ::free(p); }
+
+}  // extern "C"

@@ -248,6 +248,12 @@ SHUFFLE_TCP_BIND_HOST = register(
     "Address the TCP shuffle block server binds and advertises; set to "
     "this host's reachable address for multi-host deployments.",
     "127.0.0.1")
+SHUFFLE_TCP_NATIVE = register(
+    "spark.rapids.shuffle.tcp.native.enabled",
+    "Serve the TCP shuffle data plane from the native C++ transport "
+    "(epoll block server + pooled client, native/srt_transport.cpp — the "
+    "UCX-module analog); wire-compatible with the Python transport, "
+    "which remains the fallback when the library can't build.", True)
 SHUFFLE_EXECUTOR_ID = register(
     "spark.rapids.shuffle.executorId",
     "This process's executor id for shuffle peer discovery.", "exec-0")

@@ -37,10 +37,22 @@ def _transport_from_conf(conf: RapidsConf, executor_id: str):
     or the TCP block server + driver registry client (shuffle/tcp.py)."""
     kind = str(conf.get(SHUFFLE_TRANSPORT_CLASS)).upper()
     if kind == "TCP":
-        from ..config import SHUFFLE_TCP_BIND_HOST
+        from ..config import SHUFFLE_TCP_BIND_HOST, SHUFFLE_TCP_NATIVE
         from .tcp import TcpHeartbeatClient, TcpShuffleTransport
-        transport = TcpShuffleTransport(
-            executor_id, host=str(conf.get(SHUFFLE_TCP_BIND_HOST)))
+        host = str(conf.get(SHUFFLE_TCP_BIND_HOST))
+        transport = None
+        if conf.get_bool(SHUFFLE_TCP_NATIVE.key, True):
+            # C++ data plane (epoll block server + pooled client); wire-
+            # compatible with the Python transport, so mixed jobs interop
+            from . import native_tcp
+            if native_tcp.available():
+                try:
+                    transport = native_tcp.NativeTcpShuffleTransport(
+                        executor_id, host=host)
+                except RuntimeError:
+                    transport = None
+        if transport is None:
+            transport = TcpShuffleTransport(executor_id, host=host)
         driver = str(conf.get(SHUFFLE_TCP_DRIVER_ENDPOINT))
         heartbeats = (TcpHeartbeatClient(driver) if driver
                       else ShuffleHeartbeatManager())

@@ -109,15 +109,25 @@ def _use_batched_reduce(xp) -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
-def group_phase(xp, key_cols: Sequence[DeviceColumn], row_mask):
+def group_phase(xp, key_cols: Sequence[DeviceColumn], row_mask,
+                expected_groups: Optional[int] = None):
     """Phase A of the two-phase device aggregate: group ids + count.
     Splitting this from the reductions lets the host size the output
     table to the OBSERVED group count — scatters into a 64-4096-slot
     table are ~5x cheaper on TPU than capacity-sized ones, and small
-    tables unlock the one-hot-matmul (MXU) reduction path."""
+    tables unlock the one-hot-matmul (MXU) reduction path.
+
+    ``expected_groups`` (the speculated table size) switches the id
+    kernel to a small-table bounded probe whose overflow inflates the
+    observed count past the speculation — detected by the same check
+    that validates table sizing (hash_group.group_ids_small)."""
     if key_cols:
-        from ...ops.hash_group import group_ids
-        rank64 = group_ids(xp, key_cols, row_mask)
+        from ...ops.hash_group import group_ids, group_ids_small
+        if expected_groups is not None:
+            rank64 = group_ids_small(xp, key_cols, row_mask,
+                                     expected_groups)
+        else:
+            rank64 = group_ids(xp, key_cols, row_mask)
     else:
         rank64 = xp.where(row_mask, 0, 1).astype(xp.int64)  # one global group
     live_rank = xp.where(row_mask, rank64, -1)
@@ -489,7 +499,8 @@ class HashAggregateExec(PhysicalPlan):
                 batch, mask = step._fuse_step(batch, mask, xp)
             ctx = EvalContext(batch, xp=xp)
             keys = [g.eval(ctx) for g in self._bound_grouping]
-            rank64, ng = group_phase(xp, keys, mask)
+            rank64, ng = group_phase(xp, keys, mask,
+                                     expected_groups=out_size)
             slot_pairs, ops = self._eval_slots(ctx)
             gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, mask,
                                        rank64=rank64, n_groups=ng,
@@ -515,7 +526,8 @@ class HashAggregateExec(PhysicalPlan):
                 batch, mask = step._fuse_step(batch, mask, xp)
             ctx = EvalContext(batch, xp=xp)
             keys = [g.eval(ctx) for g in self._bound_grouping]
-            rank64, ng = group_phase(xp, keys, mask)
+            rank64, ng = group_phase(xp, keys, mask,
+                                     expected_groups=out_size)
             slot_pairs, ops = self._eval_slots(ctx)
             gk, gs, n = groupby_reduce(xp, keys, slot_pairs, ops, mask,
                                        rank64=rank64, n_groups=ng,

@@ -129,3 +129,100 @@ def test_two_process_block_fetch(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# native (C++) transport — same SPI, same wire protocol
+# ---------------------------------------------------------------------------
+
+def _native_available():
+    from spark_rapids_tpu.shuffle import native_tcp
+    return native_tcp.available()
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native transport library unavailable")
+class TestNativeTransport:
+    def test_native_fetch_round_trip(self):
+        from spark_rapids_tpu.shuffle.native_tcp import \
+            NativeTcpShuffleTransport
+        a = NativeTcpShuffleTransport("exec-a")
+        b = NativeTcpShuffleTransport("exec-b")
+        try:
+            blk = BlockId(1, 0, 3)
+            a.publish("exec-a", blk, b"native-frame")
+            peer_a = PeerInfo("exec-a", a.endpoint)
+            assert b.fetch(peer_a, blk) == b"native-frame"
+            assert b.fetch(peer_a, BlockId(1, 0, 4)) is None
+            # local short-circuit
+            b.publish("exec-b", BlockId(2, 1, 1), b"mine")
+            assert b.fetch(PeerInfo("exec-b", b.endpoint),
+                           BlockId(2, 1, 1)) == b"mine"
+            # connection reuse + large frames
+            big = bytes(range(256)) * 4096  # 1 MiB
+            for i in range(8):
+                a.publish("exec-a", BlockId(3, i, 0), big)
+            for i in range(8):
+                assert b.fetch(peer_a, BlockId(3, i, 0)) == big
+            # blocks_of / clear bookkeeping
+            assert len(a.blocks_of("exec-a")) == 9
+            a.clear(3)
+            assert len(a.blocks_of("exec-a")) == 1
+            a.clear()
+            assert a.blocks_of("exec-a") == []
+        finally:
+            a.close()
+            b.close()
+
+    def test_native_and_python_interop(self):
+        """The wire protocol is shared: a Python client fetches from the
+        native server and vice versa (mixed deployments)."""
+        from spark_rapids_tpu.shuffle.native_tcp import \
+            NativeTcpShuffleTransport
+        native = NativeTcpShuffleTransport("exec-n")
+        py = TcpShuffleTransport("exec-p")
+        try:
+            native.publish("exec-n", BlockId(7, 1, 2), b"from-native")
+            py.publish("exec-p", BlockId(7, 2, 1), b"from-python")
+            assert py.fetch(PeerInfo("exec-n", native.endpoint),
+                            BlockId(7, 1, 2)) == b"from-native"
+            assert native.fetch(PeerInfo("exec-p", py.endpoint),
+                                BlockId(7, 2, 1)) == b"from-python"
+            assert py.fetch(PeerInfo("exec-n", native.endpoint),
+                            BlockId(7, 9, 9)) is None
+            assert native.fetch(PeerInfo("exec-p", py.endpoint),
+                                BlockId(7, 9, 9)) is None
+        finally:
+            native.close()
+            py.close()
+
+    def test_native_fetch_failure_raises(self):
+        from spark_rapids_tpu.shuffle.native_tcp import \
+            NativeTcpShuffleTransport
+        from spark_rapids_tpu.shuffle.tcp import ShuffleFetchFailed
+        t = NativeTcpShuffleTransport("exec-x")
+        try:
+            with pytest.raises(ShuffleFetchFailed):
+                t.fetch(PeerInfo("gone", "127.0.0.1:9"), BlockId(1, 1, 1))
+        finally:
+            t.close()
+
+    def test_manager_selects_native_when_enabled(self):
+        from spark_rapids_tpu.config import RapidsConf
+        from spark_rapids_tpu.shuffle.manager import _transport_from_conf
+        from spark_rapids_tpu.shuffle.native_tcp import \
+            NativeTcpShuffleTransport
+        conf = RapidsConf.get_global().copy(
+            {"spark.rapids.shuffle.transport.type": "TCP"})
+        tr, hb = _transport_from_conf(conf, "exec-sel")
+        try:
+            assert isinstance(tr, NativeTcpShuffleTransport)
+        finally:
+            tr.close()
+        conf = conf.copy(
+            {"spark.rapids.shuffle.tcp.native.enabled": False})
+        tr, hb = _transport_from_conf(conf, "exec-sel2")
+        try:
+            assert isinstance(tr, TcpShuffleTransport)
+        finally:
+            tr.close()

@@ -229,3 +229,43 @@ class TestOomSyncPolicy:
             assert G._should_sync()
         finally:
             G._defensive_until = old
+
+
+# ---------------------------------------------------------------------------
+# speculative small-table grouping
+# ---------------------------------------------------------------------------
+
+class TestGroupIdsSmall:
+    def _cols(self, keys):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar.column import DeviceColumn
+        return [DeviceColumn(T.LONG, jnp.asarray(keys),
+                             jnp.ones(len(keys), bool))]
+
+    def test_matches_exact_kernel_when_table_fits(self):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops.hash_group import group_ids, \
+            group_ids_small
+        rng = np.random.default_rng(11)
+        keys = rng.integers(0, 37, 4096)
+        mask = jnp.asarray(rng.random(4096) < 0.8)
+        cols = self._cols(keys)
+        exact = np.asarray(group_ids(jnp, cols, mask))
+        small = np.asarray(group_ids_small(jnp, cols, mask, 64))
+        assert np.array_equal(exact, small)
+
+    def test_overflow_inflates_group_count(self):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops.hash_group import group_ids_small
+        rng = np.random.default_rng(12)
+        keys = rng.permutation(4096)  # 4096 distinct keys
+        mask = jnp.ones(4096, bool)
+        expected = 4
+        ids = np.asarray(group_ids_small(jnp, self._cols(keys), mask,
+                                         expected))
+        ng = int(ids.max()) + 1
+        assert ng > expected, "overflow must be visible in the count"
