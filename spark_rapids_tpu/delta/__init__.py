@@ -10,6 +10,7 @@ copy-on-write, the reference's touched-file strategy)."""
 
 from __future__ import annotations
 
+import json
 import os
 import uuid
 from typing import Dict, List, Optional, Sequence
@@ -25,11 +26,47 @@ from .zorder import zorder_indices
 __all__ = ["DeltaTable", "DeltaLog", "ConcurrentModificationException"]
 
 
+def _collect_stats(table: pa.Table) -> dict:
+    """Per-file column statistics for data skipping (real Delta's `stats`
+    JSON; the reference GPU-computes these in its write stats trackers,
+    delta-lake/common GpuStatisticsCollection analog)."""
+    import pyarrow.compute as pc
+    mins: Dict[str, object] = {}
+    maxs: Dict[str, object] = {}
+    nulls: Dict[str, int] = {}
+    for i, field_ in enumerate(table.schema):
+        col = table.column(i)
+        nulls[field_.name] = col.null_count
+        t = field_.type
+        if not (pa.types.is_integer(t) or pa.types.is_floating(t)
+                or pa.types.is_string(t) or pa.types.is_date(t)
+                or pa.types.is_timestamp(t) or pa.types.is_decimal(t)):
+            continue
+        if col.null_count == len(col):
+            continue
+        try:
+            mm = pc.min_max(col)
+            lo, hi = mm["min"].as_py(), mm["max"].as_py()
+        except pa.ArrowNotImplementedError:
+            continue
+        if lo is None:
+            continue
+        for d, v in ((mins, lo), (maxs, hi)):
+            if hasattr(v, "isoformat"):
+                v = v.isoformat()
+            elif type(v).__name__ == "Decimal":
+                v = str(v)
+            d[field_.name] = v
+    return {"numRecords": table.num_rows, "minValues": mins,
+            "maxValues": maxs, "nullCount": nulls}
+
+
 def _write_data_file(table_path: str, table: pa.Table) -> dict:
     name = f"part-{uuid.uuid4().hex}.parquet"
     full = os.path.join(table_path, name)
     pq.write_table(table, full)
-    return add_action(name, os.path.getsize(full), table.num_rows)
+    return add_action(name, os.path.getsize(full), table.num_rows,
+                      stats=_collect_stats(table))
 
 
 class DeltaTable:
@@ -72,6 +109,22 @@ class DeltaTable:
             empty = snap.schema.empty_arrow_table() if hasattr(
                 snap.schema, "empty_arrow_table") else self._empty(snap)
             return self._session.create_dataframe(empty)
+        # schema evolution: files written before a mergeSchema append lack
+        # the new columns — align them with nulls (real Delta fills
+        # missing columns at read); same-schema tables take the scan path
+        want = self._empty(snap).schema
+        if any(pq.read_schema(p).names != want.names for p in paths):
+            pieces = []
+            for p in paths:
+                t = pq.read_table(p)
+                arrays = []
+                for f in want:
+                    if f.name in t.column_names:
+                        arrays.append(t.column(f.name).cast(f.type))
+                    else:
+                        arrays.append(pa.nulls(t.num_rows, f.type))
+                pieces.append(pa.table(dict(zip(want.names, arrays))))
+            return self._session.create_dataframe(pa.concat_tables(pieces))
         reader = self._session.read
         return reader.parquet(*paths)
 
@@ -88,7 +141,8 @@ class DeltaTable:
 
     # --- append / overwrite -------------------------------------------------
     def write_df(self, df, mode: str = "append",
-                 partition_by: Sequence[str] = ()):
+                 partition_by: Sequence[str] = (),
+                 merge_schema: bool = False):
         data = df.collect()
         snap = self.log.snapshot() if self.log.exists() else None
         part_cols = (tuple(partition_by) if partition_by
@@ -96,6 +150,24 @@ class DeltaTable:
         actions: List[dict] = []
         if snap is None or snap.schema is None:
             actions.append(metadata_action(df.schema, part_cols))
+        elif merge_schema:
+            new_fields = [f for f in df.schema.fields
+                          if f.name not in snap.schema.names]
+            if new_fields:
+                from .. import types as T
+                unioned = T.StructType(tuple(snap.schema.fields)
+                                       + tuple(new_fields))
+                actions.append(metadata_action(
+                    unioned, part_cols, snap.configuration))
+        else:
+            extra = [n for n in data.schema.names
+                     if n not in snap.schema.names]
+            if extra:
+                raise ValueError(
+                    f"schema mismatch: new columns {extra} (pass "
+                    f"merge_schema=True to evolve the table schema)")
+        if snap is not None:
+            self._enforce_constraints(snap, data)
         if mode == "overwrite" and snap is not None:
             actions.extend(remove_action(p) for p in snap.file_paths)
         if data.num_rows:
@@ -126,8 +198,120 @@ class DeltaTable:
             full = os.path.join(self.path, name)
             pq.write_table(piece, full)
             actions.append(add_action(name, os.path.getsize(full),
-                                      piece.num_rows))
+                                      piece.num_rows,
+                                      stats=_collect_stats(piece)))
         return actions
+
+    # --- constraints --------------------------------------------------------
+    def add_not_null_constraint(self, *columns: str):
+        """NOT NULL invariants (reference GpuCheckDeltaInvariant analog),
+        enforced on every write/update/merge."""
+        snap = self.log.snapshot()
+        cfg = dict(snap.configuration)
+        existing = json.loads(cfg.get("delta.constraints.notNull", "[]"))
+        cfg["delta.constraints.notNull"] = json.dumps(
+            sorted(set(existing) | set(columns)))
+        self.log.commit(
+            [metadata_action(snap.schema, snap.partition_columns, cfg)],
+            "ADD CONSTRAINT", read_version=snap.version)
+        return self
+
+    def add_check_constraint(self, name: str, column: str, op: str,
+                             value) -> "DeltaTable":
+        """CHECK (col <op> literal) constraint, serialized into the table
+        configuration and enforced on writes.  NULL column values PASS
+        (SQL CHECK semantics: only FALSE violates)."""
+        if op not in ("=", "<", "<=", ">", ">="):
+            raise ValueError(f"unsupported CHECK operator {op!r}")
+        snap = self.log.snapshot()
+        cfg = dict(snap.configuration)
+        cfg[f"delta.constraints.{name}"] = json.dumps(
+            {"column": column, "op": op, "value": value})
+        self.log.commit(
+            [metadata_action(snap.schema, snap.partition_columns, cfg)],
+            "ADD CONSTRAINT", read_version=snap.version)
+        return self
+
+    def _enforce_constraints(self, snap: Snapshot, data: pa.Table):
+        if not snap.configuration or data.num_rows == 0:
+            return
+        import pyarrow.compute as pc
+        for key, raw in snap.configuration.items():
+            if not key.startswith("delta.constraints."):
+                continue
+            if key == "delta.constraints.notNull":
+                for col in json.loads(raw):
+                    if col in data.column_names \
+                            and data.column(col).null_count:
+                        raise ValueError(
+                            f"NOT NULL constraint violated for column "
+                            f"{col}")
+                continue
+            spec = json.loads(raw)
+            col = spec["column"]
+            if col not in data.column_names:
+                continue
+            ops = {"=": pc.equal, "<": pc.less, "<=": pc.less_equal,
+                   ">": pc.greater, ">=": pc.greater_equal}
+            ok = ops[spec["op"]](data.column(col), spec["value"])
+            # NULL passes (three-valued CHECK); count FALSE only
+            violations = pc.sum(pc.equal(ok, False)).as_py() or 0
+            if violations:
+                raise ValueError(
+                    f"CHECK constraint {key.rsplit('.', 1)[1]} violated "
+                    f"by {violations} row(s): {col} {spec['op']} "
+                    f"{spec['value']!r}")
+
+    # --- data skipping ------------------------------------------------------
+    def _files_matching(self, snap: Snapshot, cond) -> List[str]:
+        """File paths whose stats admit a match for the condition — the
+        data-skipping read of the per-file `stats` (files without stats
+        or non-pushable predicates are conservatively kept)."""
+        expr = getattr(cond, "expr", None)
+        if expr is None or snap.schema is None:
+            return snap.file_paths
+        from ..io_.pushdown import extract_pushable, stats_possible
+        from ..sql.expressions.core import AttributeReference
+        attrs = [AttributeReference(f.name, f.data_type, True)
+                 for f in snap.schema.fields]
+        try:
+            filters = extract_pushable(expr, attrs)
+        except Exception:
+            return snap.file_paths
+        if not filters:
+            return snap.file_paths
+        out = []
+        for p in snap.file_paths:
+            st = snap.files[p].stats
+            if not st:
+                out.append(p)
+                continue
+            mins = st.get("minValues", {})
+            maxs = st.get("maxValues", {})
+            nullc = st.get("nullCount", {})
+            nrec = st.get("numRecords")
+            keep = True
+            for col, op, lit in filters:
+                if op == "isnull":
+                    if nullc.get(col) == 0:
+                        keep = False
+                        break
+                    continue
+                if op == "isnotnull":
+                    nc = nullc.get(col)
+                    if nc is not None and nrec is not None and nc >= nrec:
+                        keep = False
+                        break
+                    continue
+                lo, hi = mins.get(col), maxs.get(col)
+                if lo is None or hi is None:
+                    continue
+                if not stats_possible(lo, hi, op, lit):
+                    keep = False
+                    break
+            if keep:
+                out.append(p)
+        return out
 
     # --- DML ----------------------------------------------------------------
     def _file_df(self, rel_path: str):
@@ -139,7 +323,12 @@ class DeltaTable:
         snap = self.log.snapshot()
         actions: List[dict] = []
         deleted = 0
-        for rel in snap.file_paths:
+        candidates = snap.file_paths
+        if condition is not None:
+            dummy = self._session.create_dataframe(self._empty(snap))
+            cond0 = condition(dummy) if callable(condition) else condition
+            candidates = self._files_matching(snap, cond0)
+        for rel in candidates:
             df = self._file_df(rel)
             if condition is None:
                 deleted += df.count()
@@ -168,7 +357,9 @@ class DeltaTable:
         snap = self.log.snapshot()
         actions: List[dict] = []
         updated = 0
-        for rel in snap.file_paths:
+        dummy = self._session.create_dataframe(self._empty(snap))
+        cond0 = condition(dummy) if callable(condition) else condition
+        for rel in self._files_matching(snap, cond0):
             df = self._file_df(rel)
             cond = condition(df) if callable(condition) else condition
             hits = df.filter(cond).count()
@@ -184,9 +375,10 @@ class DeltaTable:
                                 .otherwise(df[name]).alias(name))
                 else:
                     cols.append(df[name])
+            out = df.select(*cols).collect()
+            self._enforce_constraints(snap, out)
             actions.append(remove_action(rel))
-            actions.append(_write_data_file(self.path,
-                                            df.select(*cols).collect()))
+            actions.append(_write_data_file(self.path, out))
         if actions:
             self.log.commit(actions, "UPDATE", read_version=snap.version)
         return updated
@@ -313,6 +505,7 @@ class MergeBuilder:
                     else:
                         cols.append(df[name])
                 updated = matched.select(*cols).collect()
+                t._enforce_constraints(snap, updated)
                 untouched = df.join(src, on=keys, how="left_anti").collect()
                 out = (pa.concat_tables([untouched, updated])
                        if untouched.num_rows else updated)
@@ -330,6 +523,7 @@ class MergeBuilder:
             if new_rows.num_rows:
                 cols = snap.schema.names if snap.schema else new_rows.schema.names
                 new_rows = new_rows.select([c for c in cols])
+                t._enforce_constraints(snap, new_rows)
                 actions.append(_write_data_file(t.path, new_rows))
                 stats["inserted"] += new_rows.num_rows
         if actions:

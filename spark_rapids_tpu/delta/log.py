@@ -44,6 +44,9 @@ class AddFile:
     num_records: int
     data_change: bool = True
     modification_time: int = 0
+    #: per-file column statistics for data skipping (real Delta's `stats`
+    #: JSON: numRecords / minValues / maxValues / nullCount)
+    stats: Optional[dict] = None
 
 
 @dataclass
@@ -52,6 +55,9 @@ class Snapshot:
     schema: Optional[T.StructType]
     partition_columns: Tuple[str, ...]
     files: Dict[str, AddFile]      # path -> AddFile (live set)
+    #: table properties (real Delta's metaData.configuration — carries
+    #: constraints as `delta.constraints.<name>` entries)
+    configuration: Dict[str, str] = field(default_factory=dict)
 
     @property
     def file_paths(self) -> List[str]:
@@ -90,6 +96,57 @@ class DeltaLog:
         with open(self._version_file(version)) as fh:
             return [json.loads(line) for line in fh if line.strip()]
 
+    # --- checkpoints --------------------------------------------------------
+    #: write a parquet checkpoint every N commits (real Delta default 10)
+    checkpoint_interval = 10
+
+    def _checkpoint_file(self, v: int) -> str:
+        return os.path.join(self.log_path, f"{v:020d}.checkpoint.parquet")
+
+    def _last_checkpoint_path(self) -> str:
+        return os.path.join(self.log_path, "_last_checkpoint")
+
+    def last_checkpoint_version(self) -> Optional[int]:
+        try:
+            with open(self._last_checkpoint_path()) as fh:
+                return int(json.load(fh)["version"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def write_checkpoint(self, version: Optional[int] = None) -> int:
+        """Materialize the snapshot's reconstructing actions at `version`
+        as one parquet file + the `_last_checkpoint` pointer, so replay
+        reads O(interval) json files instead of the whole log (real
+        Delta's `{v}.checkpoint.parquet` protocol shape)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        snap = self.snapshot(version)
+        actions: List[dict] = []
+        if snap.schema is not None:
+            actions.append(metadata_action(
+                snap.schema, snap.partition_columns, snap.configuration))
+        for f in snap.files.values():
+            a = add_action(f.path, f.size, f.num_records, f.data_change,
+                           stats=f.stats)
+            a["add"]["modificationTime"] = f.modification_time
+            actions.append(a)
+        tbl = pa.table({"action": pa.array([json.dumps(a) for a in actions],
+                                           type=pa.string())})
+        pq.write_table(tbl, self._checkpoint_file(snap.version))
+        tmp = self._last_checkpoint_path() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"version": snap.version, "size": len(actions)}, fh)
+        os.replace(tmp, self._last_checkpoint_path())
+        return snap.version
+
+    def _read_checkpoint(self, v: int) -> Optional[List[dict]]:
+        import pyarrow.parquet as pq
+        try:
+            tbl = pq.read_table(self._checkpoint_file(v))
+        except OSError:
+            return None
+        return [json.loads(s) for s in tbl.column("action").to_pylist()]
+
     # --- snapshot ----------------------------------------------------------
     def snapshot(self, version: Optional[int] = None) -> Snapshot:
         vs = self.versions()
@@ -103,24 +160,50 @@ class DeltaLog:
         schema = None
         part_cols: Tuple[str, ...] = ()
         files: Dict[str, AddFile] = {}
+        configuration: Dict[str, str] = {}
+        start = 0
+        ckpt = self.last_checkpoint_version()
+        base_actions: List[dict] = []
+        if ckpt is not None and ckpt <= version:
+            loaded = self._read_checkpoint(ckpt)
+            if loaded is not None:
+                base_actions = loaded
+                start = ckpt + 1
+
+        def apply(action: dict):
+            nonlocal schema, part_cols, configuration
+            if "metaData" in action:
+                md = action["metaData"]
+                schema = _spec_to_schema(md["schema"])
+                part_cols = tuple(md.get("partitionColumns", ()))
+                configuration = dict(md.get("configuration", {}))
+            elif "add" in action:
+                a = action["add"]
+                stats = a.get("stats")
+                if isinstance(stats, str):
+                    try:
+                        stats = json.loads(stats)
+                    except ValueError:
+                        stats = None
+                files[a["path"]] = AddFile(
+                    a["path"], a.get("size", 0),
+                    a.get("numRecords", -1),
+                    a.get("dataChange", True),
+                    a.get("modificationTime", 0),
+                    stats)
+            elif "remove" in action:
+                files.pop(action["remove"]["path"], None)
+
+        for action in base_actions:
+            apply(action)
         for v in vs:
+            if v < start:
+                continue
             if v > version:
                 break
             for action in self.read_actions(v):
-                if "metaData" in action:
-                    md = action["metaData"]
-                    schema = _spec_to_schema(md["schema"])
-                    part_cols = tuple(md.get("partitionColumns", ()))
-                elif "add" in action:
-                    a = action["add"]
-                    files[a["path"]] = AddFile(
-                        a["path"], a.get("size", 0),
-                        a.get("numRecords", -1),
-                        a.get("dataChange", True),
-                        a.get("modificationTime", 0))
-                elif "remove" in action:
-                    files.pop(action["remove"]["path"], None)
-        return Snapshot(version, schema, part_cols, files)
+                apply(action)
+        return Snapshot(version, schema, part_cols, files, configuration)
 
     # --- commit ------------------------------------------------------------
     def commit(self, actions: List[dict], operation: str,
@@ -152,6 +235,12 @@ class DeltaLog:
             try:
                 with open(self._version_file(v), "x") as fh:
                     fh.write(payload)
+                if self.checkpoint_interval and v > 0 \
+                        and v % self.checkpoint_interval == 0:
+                    try:
+                        self.write_checkpoint(v)
+                    except Exception:
+                        pass  # checkpoints are an optimization, never fatal
                 return v
             except FileExistsError:
                 continue  # someone else won this version; re-validate
@@ -171,21 +260,26 @@ class DeltaLog:
         return out
 
 
-def metadata_action(schema: T.StructType,
-                    partition_columns=()) -> dict:
+def metadata_action(schema: T.StructType, partition_columns=(),
+                    configuration: Optional[Dict[str, str]] = None) -> dict:
     return {"metaData": {
         "id": uuid.uuid4().hex,
         "schema": _schema_to_spec(schema),
         "partitionColumns": list(partition_columns),
+        "configuration": dict(configuration or {}),
         "createdTime": int(time.time() * 1000),
     }}
 
 
 def add_action(path: str, size: int, num_records: int,
-               data_change: bool = True) -> dict:
-    return {"add": {"path": path, "size": size, "numRecords": num_records,
-                    "dataChange": data_change,
-                    "modificationTime": int(time.time() * 1000)}}
+               data_change: bool = True,
+               stats: Optional[dict] = None) -> dict:
+    a = {"path": path, "size": size, "numRecords": num_records,
+         "dataChange": data_change,
+         "modificationTime": int(time.time() * 1000)}
+    if stats is not None:
+        a["stats"] = json.dumps(stats)
+    return {"add": a}
 
 
 def remove_action(path: str, data_change: bool = True) -> dict:

@@ -281,3 +281,97 @@ def test_concurrent_delete_conflict_detected(sess, tmp_path):
     with pytest.raises(ConcurrentModificationException):
         dt.log.commit([remove_action(snap.file_paths[0])], "DELETE",
                       read_version=snap.version)
+
+
+# ---------------------------------------------------------------------------
+# round-2 late additions: stats/skipping, checkpoints, evolution, constraints
+# ---------------------------------------------------------------------------
+
+def test_add_actions_carry_stats(sess, tmp_path):
+    dt, _ = make_table(sess, tmp_path / "t", n=50)
+    snap = dt.log.snapshot()
+    (path,) = snap.file_paths
+    st = snap.files[path].stats
+    assert st["numRecords"] == 50
+    assert st["minValues"]["id"] == 0 and st["maxValues"]["id"] == 49
+    assert st["minValues"]["s"] == "row000"
+    assert st["nullCount"]["v"] == 0
+
+
+def test_data_skipping_limits_rewritten_files(sess, tmp_path):
+    dt, _ = make_table(sess, tmp_path / "t", n=10)
+    # three more files with disjoint id ranges
+    for lo in (100, 200, 300):
+        t = pa.table({"id": pa.array(range(lo, lo + 10), type=pa.int64()),
+                      "v": [1.0] * 10, "s": ["x"] * 10})
+        dt.write_df(sess.create_dataframe(t))
+    snap = dt.log.snapshot()
+    assert len(snap.file_paths) == 4
+    dummy = sess.create_dataframe(dt.toDF().collect().slice(0, 0))
+    matching = dt._files_matching(snap, dummy.id >= 300)
+    assert len(matching) == 1
+    # delete touches only the matching file; others keep their add files
+    before = set(snap.file_paths)
+    deleted = dt.delete(lambda df: df.id >= 300)
+    assert deleted == 10
+    after = set(dt.log.snapshot().file_paths)
+    assert len(before - after) == 1  # exactly one file rewritten/removed
+    assert dt.toDF().count() == 30
+
+
+def test_checkpoint_written_and_replayed(sess, tmp_path):
+    dt, _ = make_table(sess, tmp_path / "t", n=4)
+    for i in range(12):  # cross the checkpoint interval (10)
+        t = pa.table({"id": pa.array([1000 + i], type=pa.int64()),
+                      "v": [0.5], "s": ["a"]})
+        dt.write_df(sess.create_dataframe(t))
+    ck = dt.log.last_checkpoint_version()
+    assert ck is not None and ck >= 10
+    assert os.path.exists(dt.log._checkpoint_file(ck))
+    # snapshot built via checkpoint replay equals full-log replay
+    snap = dt.log.snapshot()
+    assert dt.toDF().count() == 4 + 12
+    # time travel before the checkpoint still works (full replay path)
+    assert dt.toDF(version=0).count() == 4
+    assert snap.schema is not None
+
+
+def test_schema_evolution_merge_schema(sess, tmp_path):
+    dt, _ = make_table(sess, tmp_path / "t", n=3)
+    t2 = pa.table({"id": pa.array([10, 11], type=pa.int64()),
+                   "v": [1.0, 2.0], "s": ["a", "b"],
+                   "extra": pa.array([7, 8], type=pa.int64())})
+    with pytest.raises(ValueError):
+        dt.write_df(sess.create_dataframe(t2))
+    dt.write_df(sess.create_dataframe(t2), merge_schema=True)
+    out = dt.toDF().collect().to_pandas().sort_values("id")
+    assert list(out.columns) == ["id", "v", "s", "extra"]
+    assert out["extra"].isna().sum() == 3  # old rows null-filled
+    assert set(out["extra"].dropna()) == {7, 8}
+
+
+def test_constraints_enforced(sess, tmp_path):
+    dt, _ = make_table(sess, tmp_path / "t", n=5)
+    dt.add_check_constraint("v_nonneg", "v", ">=", 0.0)
+    dt.add_not_null_constraint("s")
+    bad = pa.table({"id": pa.array([99], type=pa.int64()),
+                    "v": [-1.0], "s": ["z"]})
+    with pytest.raises(ValueError, match="CHECK constraint"):
+        dt.write_df(sess.create_dataframe(bad))
+    bad2 = pa.table({"id": pa.array([99], type=pa.int64()),
+                     "v": [1.0], "s": pa.array([None], type=pa.string())})
+    with pytest.raises(ValueError, match="NOT NULL"):
+        dt.write_df(sess.create_dataframe(bad2))
+    ok = pa.table({"id": pa.array([99], type=pa.int64()),
+                   "v": [1.0], "s": ["ok"]})
+    dt.write_df(sess.create_dataframe(ok))
+    assert dt.toDF().count() == 6
+    # NULL check-column value passes (three-valued CHECK semantics)
+    nullv = pa.table({"id": pa.array([100], type=pa.int64()),
+                      "v": pa.array([None], type=pa.float64()),
+                      "s": ["n"]})
+    dt.write_df(sess.create_dataframe(nullv))
+    assert dt.toDF().count() == 7
+    # UPDATE violating the constraint is rejected
+    with pytest.raises(ValueError, match="CHECK constraint"):
+        dt.update(lambda df: df.id == 99, {"v": -5.0})
