@@ -85,3 +85,86 @@ def to_features(df, feature_cols: Sequence[str],
     if label_col:
         y = jnp.concatenate(ys, axis=0) if len(ys) > 1 else ys[0]
     return X, y
+
+
+def to_torch(df, feature_cols: Sequence[str],
+             label_col: Optional[str] = None) -> Tuple:
+    """(X, y) as torch tensors — the XGBoost-style host-framework handoff
+    (the reference's ColumnarRdd feeds XGBoost4J; torch stands in as the
+    resident host-ML framework in this image).  The device→host move is
+    one packed transfer (bulk_device_get) and the torch tensors wrap the
+    fetched numpy buffers zero-copy."""
+    import numpy as np
+    import torch
+
+    from ..columnar.convert import bulk_device_get
+    X, y = to_features(df, feature_cols, label_col)
+    host = bulk_device_get({"X": X, "y": y})
+    tx = torch.from_numpy(np.ascontiguousarray(host["X"]))
+    ty = (torch.from_numpy(np.ascontiguousarray(host["y"]))
+          if host["y"] is not None else None)
+    return tx, ty
+
+
+def minibatches(df, feature_cols: Sequence[str], label_col: str,
+                batch_size: int, *, epochs: int = 1, seed: int = 0,
+                drop_remainder: bool = True):
+    """Device-resident minibatch iterator over a query's output: the ETL
+    stays in the engine, training data never leaves HBM, and each epoch
+    reshuffles with a deterministic key — the idiomatic jax input
+    pipeline over SQL results."""
+    import jax
+    import jax.numpy as jnp
+
+    X, y = to_features(df, feature_cols, label_col)
+    n = X.shape[0]
+    if n == 0:
+        return
+    key = jax.random.PRNGKey(seed)
+    for _ in range(epochs):
+        key, sub = jax.random.split(key)
+        perm = jax.random.permutation(sub, n)
+        Xp, yp = X[perm], y[perm]
+        end = n - (n % batch_size) if drop_remainder else n
+        for off in range(0, end, batch_size):
+            yield Xp[off:off + batch_size], yp[off:off + batch_size]
+
+
+def fit_linear_regression(df, feature_cols: Sequence[str], label_col: str,
+                          *, steps: int = 200, lr: float = 0.1,
+                          l2: float = 0.0):
+    """End-to-end SQL→ML demonstration (BASELINE milestone 5's
+    "accelerated XGBoost handoff" spirit): least-squares fit by jitted
+    full-batch gradient descent over the query's DEVICE output — the ETL
+    result is consumed by an optax-style training loop without ever
+    leaving the accelerator.  Returns (weights, bias, final_mse)."""
+    import jax
+    import jax.numpy as jnp
+
+    X, y = to_features(df, feature_cols, label_col)
+    n, d = X.shape
+    if n == 0:
+        raise ValueError("cannot fit on an empty query result")
+    # standardize for a well-conditioned fixed learning rate
+    mu, sd = X.mean(axis=0), X.std(axis=0) + 1e-12
+    Xs = (X - mu) / sd
+
+    def loss(params):
+        w, b = params
+        pred = Xs @ w + b
+        return jnp.mean((pred - y) ** 2) + l2 * jnp.sum(w * w)
+
+    @jax.jit
+    def step(params):
+        g = jax.grad(loss)(params)
+        return jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+
+    params = (jnp.zeros(d, X.dtype), jnp.asarray(0.0, X.dtype))
+    for _ in range(steps):
+        params = step(params)
+    w_s, b_s = params
+    # un-standardize back to input space
+    w = w_s / sd
+    b = b_s - jnp.sum(w_s * mu / sd)
+    mse = float(jnp.mean((X @ w + b - y) ** 2))
+    return w, b, mse

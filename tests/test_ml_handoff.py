@@ -98,3 +98,46 @@ def test_to_features_rejects_string_label(sess):
     df = sess.create_dataframe(pa.table({"x": [1.0], "s": ["a"]}))
     with pytest.raises(ValueError, match="not numeric"):
         ml.to_features(df, ["x"], "s")
+
+
+def test_to_torch_handoff(sess):
+    import torch
+    rng = np.random.default_rng(4)
+    t = pa.table({"a": rng.random(200), "b": rng.random(200),
+                  "y": rng.random(200)})
+    df = sess.create_dataframe(t)
+    X, y = ml.to_torch(df, ["a", "b"], "y")
+    assert isinstance(X, torch.Tensor) and X.shape == (200, 2)
+    assert isinstance(y, torch.Tensor) and y.shape == (200,)
+    assert np.allclose(X[:, 0].numpy(), t["a"].to_numpy().astype(np.float32))
+
+
+def test_minibatch_iterator_shuffles_per_epoch(sess):
+    rng = np.random.default_rng(5)
+    t = pa.table({"a": rng.random(64), "y": rng.random(64)})
+    df = sess.create_dataframe(t)
+    batches = list(ml.minibatches(df, ["a"], "y", batch_size=16, epochs=2))
+    assert len(batches) == 8  # 4 per epoch x 2 epochs
+    assert all(x.shape == (16, 1) and yy.shape == (16,)
+               for x, yy in batches)
+    e1 = np.concatenate([np.asarray(yy) for _, yy in batches[:4]])
+    e2 = np.concatenate([np.asarray(yy) for _, yy in batches[4:]])
+    assert sorted(e1.tolist()) == sorted(e2.tolist())  # same data...
+    assert not np.array_equal(e1, e2)  # ...different order per epoch
+
+
+def test_fit_linear_regression_recovers_weights(sess):
+    rng = np.random.default_rng(6)
+    n = 2000
+    a = rng.random(n).astype(np.float32)
+    b = rng.random(n).astype(np.float32)
+    y = 3.0 * a - 2.0 * b + 0.5
+    t = pa.table({"a": a, "b": b, "y": y})
+    # ETL in the engine (filter keeps it a real query), training on device
+    df = sess.create_dataframe(t).filter(F.col("a") >= 0.0)
+    w, bias, mse = ml.fit_linear_regression(df, ["a", "b"], "y",
+                                            steps=400, lr=0.3)
+    assert mse < 1e-3
+    assert abs(float(w[0]) - 3.0) < 0.05
+    assert abs(float(w[1]) + 2.0) < 0.05
+    assert abs(float(bias) - 0.5) < 0.05
