@@ -386,6 +386,80 @@ class DataFrame:
         attrs = tuple(self._plan.output)
         return DataFrame(P.Aggregate(attrs, attrs, self._plan), self._session)
 
+    # --- set operations (Spark's ReplaceSetOps rewrites) -------------------
+    def _tagged_counts(self, other: "DataFrame"):
+        """UNION of both sides tagged with per-side indicator columns,
+        grouped by all columns with per-side counts L/R — the shared core
+        of Spark's INTERSECT/EXCEPT rewrites (NULLs group equal, matching
+        SQL set-operation semantics)."""
+        from . import functions as F
+        cols = self.columns
+        if other.columns != cols:
+            raise ValueError(
+                f"set operation requires identical schemas: {cols} vs "
+                f"{other.columns}")
+        left = self.select(*cols).withColumn(
+            "__l__", F.lit(1)).withColumn("__r__", F.lit(0))
+        right = other.select(*cols).withColumn(
+            "__l__", F.lit(0)).withColumn("__r__", F.lit(1))
+        return (left.union(right).groupBy(*cols)
+                .agg(F.sum(F.col("__l__")).alias("__L__"),
+                     F.sum(F.col("__r__")).alias("__R__")), cols)
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """INTERSECT DISTINCT (rows present on both sides, deduplicated)."""
+        from . import functions as F
+        counts, cols = self._tagged_counts(other)
+        return (counts.filter((F.col("__L__") >= 1) & (F.col("__R__") >= 1))
+                .select(*cols))
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT DISTINCT (rows of self absent from other, deduplicated;
+        pyspark ``subtract``)."""
+        from . import functions as F
+        counts, cols = self._tagged_counts(other)
+        return (counts.filter((F.col("__L__") >= 1) & (F.col("__R__") == 0))
+                .select(*cols))
+
+    exceptDistinct = subtract
+
+    def _replicate_rows(self, kept: "DataFrame", n: "Column",
+                        cols) -> "DataFrame":
+        """Emit each row of ``kept`` ``n`` times — the engine's take on
+        Spark's ReplicateRows generator: a nested-loop join against a
+        numbers table bounded by max(n) (all device-side; the bound costs
+        one tiny aggregate query)."""
+        from . import functions as F
+        tagged = kept.withColumn("__n__", n)
+        mrow = tagged.agg(F.max(F.col("__n__")).alias("m")).collect()
+        m = mrow["m"][0].as_py() if mrow.num_rows else None
+        if not m or int(m) <= 0:
+            return tagged.filter(F.lit(False)).select(*cols)
+        nums = self._session.range(1, int(m) + 1)
+        num_col = nums.columns[0]
+        joined = tagged.join(
+            nums, on=nums[num_col] <= tagged["__n__"], how="inner")
+        return joined.select(*cols)
+
+    def intersectAll(self, other: "DataFrame") -> "DataFrame":
+        """INTERSECT ALL: each common row min(L, R) times (Spark's
+        RewriteIntersectAll count plan, replication per
+        :meth:`_replicate_rows`)."""
+        from . import functions as F
+        counts, cols = self._tagged_counts(other)
+        kept = counts.filter((F.col("__L__") >= 1) & (F.col("__R__") >= 1))
+        return self._replicate_rows(
+            kept, F.least(F.col("__L__"), F.col("__R__")), cols)
+
+    def exceptAll(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT ALL: each row max(L - R, 0) times (Spark's
+        RewriteExceptAll sum-of-tags plan shape)."""
+        from . import functions as F
+        counts, cols = self._tagged_counts(other)
+        kept = counts.filter((F.col("__L__") - F.col("__R__")) > 0)
+        return self._replicate_rows(
+            kept, F.col("__L__") - F.col("__R__"), cols)
+
     def describe(self, *cols) -> "DataFrame":
         """Basic statistics per numeric column (count/mean/stddev/min/max;
         pyspark DataFrame.describe), computed as ONE aggregate pass

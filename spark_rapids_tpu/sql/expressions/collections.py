@@ -429,13 +429,26 @@ class Sequence(Expression):
 
     def kernel(self, ctx, start, stop, step=None):
         xp = ctx.xp
-        s = np.asarray(start.data)
-        e = np.asarray(stop.data)
-        st = np.asarray(step.data) if step is not None else \
-            np.where(e >= s, 1, -1)
-        st = np.where(st == 0, 1, st)
         cols = [start, stop] + ([step] if step is not None else [])
-        valid = np.asarray(valid_and(xp, *cols))
+        valid = np.atleast_1d(np.asarray(valid_and(xp, *cols)))
+        cap_ = max([valid.shape[0]]
+                   + [np.atleast_1d(np.asarray(c.data)).shape[0]
+                      for c in cols])
+        valid = np.broadcast_to(valid, (cap_,))
+
+        def num(col):
+            # host batches mix widths (scalar agg slots, empty partitions)
+            # and padding slots may hold None — broadcast to one cap and
+            # mask invalid slots to 0 before arithmetic
+            a = np.broadcast_to(np.atleast_1d(np.asarray(col.data)),
+                                (cap_,))
+            if a.dtype == object:
+                a = np.where(valid, a, 0).astype(np.int64)
+            return a
+        s = num(start)
+        e = num(stop)
+        st = num(step) if step is not None else np.where(e >= s, 1, -1)
+        st = np.where(st == 0, 1, st)
         n = np.where(valid, ((e - s) // st) + 1, 0)
         n = np.clip(n, 0, None)
         w = bucket_width(int(n.max()) if n.size else 0)
@@ -1200,6 +1213,21 @@ class Explode(UnaryExpression):
 
 class PosExplode(Explode):
     with_position = True
+
+
+class ReplicateRows(Explode):
+    """Spark's INTERSECT ALL / EXCEPT ALL multiplicity generator
+    (reference expr rule ``ReplicateRows`` executed by
+    ``GpuGenerateExec``; ``GpuOverrides.scala`` Appendix-A list):
+    replicates each input row ``n`` times, lowered as
+    ``explode(sequence(1, n))`` — the width-data-dependent sequence
+    shares :class:`Sequence`'s documented host fallback while the
+    replication itself runs in the device Generate kernel."""
+
+    def __init__(self, n):
+        from .core import Literal
+        super().__init__(Sequence(Literal(1, T.LONG),
+                                  resolve_expression(n)))
 
 
 class Flatten(Expression):

@@ -169,6 +169,21 @@ class KnownFloatingPointNormalized(UnaryExpression):
         return self.children[0].eval(ctx)
 
 
+class DynamicPruningExpression(UnaryExpression):
+    """Wrapper marking a runtime-pruning subquery filter (Spark's DPP;
+    reference expr rule ``DynamicPruningExpression``).  Semantically a
+    pass-through over the materialized pruning predicate — the engine's
+    plan-level DPP (sql/physical/dpp.py) rewrites the scan; when the
+    wrapper survives into an ordinary filter it evaluates its child."""
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+
 class NormalizeNaNAndZero(UnaryExpression):
     """Canonicalize NaN bit patterns and -0.0 (pre-grouping/join pass)."""
 

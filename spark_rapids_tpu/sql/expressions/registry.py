@@ -67,7 +67,8 @@ _reg(Col.Size, Col.GetArrayItem, Col.ElementAt, Col.ArrayContains,
      Col.CreateMap, Col.NamedLambdaVariable, Col.LambdaFunction,
      Col.ArrayTransform, Col.ArrayFilter, Col.ArrayExists, Col.ArrayForAll,
      Col.TransformKeys, Col.TransformValues, Col.MapFilter, Col.Explode,
-     Col.PosExplode)
+     Col.PosExplode, Col.ReplicateRows)
+_reg(Cond.DynamicPruningExpression)
 _reg(Str.Length, Str.OctetLength, Str.BitLength, Str.Upper, Str.Lower,
      Str.InitCap, Str.Reverse, Str.Substring, Str.SubstringIndex, Str.Concat,
      Str.ConcatWs, Str.Contains, Str.StartsWith, Str.EndsWith, Str.Like,

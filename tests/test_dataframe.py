@@ -336,3 +336,57 @@ def test_approx_count_distinct_and_avg_distinct():
                 F.avgDistinct(F.col("v")).alias("a"))
            .orderBy("k").collect().to_pylist())
     assert out == [{"k": 1, "c": 1, "a": 5.0}, {"k": 2, "c": 2, "a": 8.0}]
+
+
+class TestSetOperations:
+    """INTERSECT/EXCEPT [ALL] (Spark's ReplaceSetOps rewrites; the ALL
+    variants replicate multiplicities the way RewriteIntersectAll/
+    RewriteExceptAll do, with NULLs comparing equal)."""
+
+    def _frames(self, sess):
+        l = sess.create_dataframe(pa.table(
+            {"a": [1, 2, 2, 3, 3, 3, None],
+             "b": ["x", "y", "y", "z", "z", "z", None]}))
+        r = sess.create_dataframe(pa.table(
+            {"a": [2, 3, 3, 9, None], "b": ["y", "z", "z", "q", None]}))
+        return l, r
+
+    @staticmethod
+    def _rows(df):
+        p = df.collect().to_pandas()
+        return sorted(map(tuple,
+                          p.where(p.notna(), None).itertuples(index=False)),
+                      key=str)
+
+    def test_intersect_distinct(self, session):
+        l, r = self._frames(session)
+        got = self._rows(l.intersect(r))
+        assert len(got) == 3  # (2,y), (3,z), (null,null)
+
+    def test_subtract(self, session):
+        l, r = self._frames(session)
+        assert self._rows(l.subtract(r)) == [(1, "x")]
+
+    def test_intersect_all_multiplicities(self, session):
+        l, r = self._frames(session)
+        got = self._rows(l.intersectAll(r))
+        # min multiplicities: (2,y)x1, (3,z)x2, (null,null)x1
+        assert len(got) == 4
+        assert sum(1 for t in got if t[0] == 3.0) == 2
+
+    def test_except_all_multiplicities(self, session):
+        l, r = self._frames(session)
+        got = self._rows(l.exceptAll(r))
+        assert got == [(1, "x"), (2, "y"), (3, "z")]
+
+    def test_schema_mismatch_rejected(self, session):
+        l, _ = self._frames(session)
+        other = session.create_dataframe(pa.table({"c": [1]}))
+        with pytest.raises(ValueError, match="identical schemas"):
+            l.intersect(other)
+
+    def test_replicate_rows_expression_registered(self):
+        from spark_rapids_tpu.sql.expressions.registry import \
+            EXPRESSION_REGISTRY
+        assert "ReplicateRows" in EXPRESSION_REGISTRY
+        assert "DynamicPruningExpression" in EXPRESSION_REGISTRY
