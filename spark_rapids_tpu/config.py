@@ -364,6 +364,11 @@ TRACE_ENABLED = register(
     "spark.rapids.tpu.trace.enabled",
     "Emit jax.profiler TraceMe ranges around operator execution "
     "(NVTX-range equivalent).", False)
+PROFILE_ENABLED = register(
+    "spark.rapids.tpu.profile.enabled",
+    "Record per-exec wall time + batch counts during execution; read the "
+    "report with session.profile_last_query() (the SQL-UI per-op "
+    "GpuMetric view).", False)
 DUMP_ON_ERROR_PATH = register(
     "spark.rapids.sql.debug.dumpPath",
     "If set, dump failing batches to parquet here (DumpUtils equivalent).",

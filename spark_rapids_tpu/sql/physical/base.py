@@ -80,6 +80,12 @@ class TaskContext:
         return _cm()
 
 
+#: process-wide profiling switch, flipped per query by the session from
+#: spark.rapids.tpu.profile.enabled (single-driver model, like the
+#: reference's per-query GpuMetric wiring)
+PROFILING = {"on": False}
+
+
 class PhysicalPlan:
     backend: str = TPU
 
@@ -87,6 +93,43 @@ class PhysicalPlan:
         self.children: tuple = tuple(children)
         self.metrics: Dict[str, float] = {}
         self._placement_reasons: List[str] = []
+        self._prof_ns = 0       # inclusive time spent producing batches
+        self._prof_batches = 0
+
+    def __init_subclass__(cls, **kw):
+        """Wrap every exec's ``execute`` with the profiling shim (the
+        SQL-UI per-op metric plumbing of ``GpuExec.scala:49-141``): when
+        profiling is on, time spent pulling each batch from this node's
+        iterator (children included) accrues to the node; the report
+        derives self-time as inclusive minus children."""
+        super().__init_subclass__(**kw)
+        orig = cls.__dict__.get("execute")
+        if orig is None or getattr(orig, "_profiled", False):
+            return
+
+        def execute(self, pid, tctx, _orig=orig):
+            if not PROFILING["on"]:
+                return _orig(self, pid, tctx)
+            import time as _t
+
+            def gen():
+                t0 = _t.perf_counter_ns()
+                it = iter(_orig(self, pid, tctx))
+                self._prof_ns += _t.perf_counter_ns() - t0
+                while True:
+                    t1 = _t.perf_counter_ns()
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        self._prof_ns += _t.perf_counter_ns() - t1
+                        return
+                    self._prof_ns += _t.perf_counter_ns() - t1
+                    self._prof_batches += 1
+                    yield b
+            return gen()
+
+        execute._profiled = True
+        cls.execute = execute
 
     # --- schema -----------------------------------------------------------
     @property
@@ -211,6 +254,28 @@ class PhysicalPlan:
         for c in self.children:
             lines.append(c.tree_string(level + 1))
         return "\n".join(lines)
+
+
+def profile_report(phys: "PhysicalPlan") -> str:
+    """Formatted per-exec profile of the last execution: inclusive and
+    self wall time plus batch counts (the SQL-UI per-op metric view the
+    reference publishes via GpuMetric; enable with
+    spark.rapids.tpu.profile.enabled)."""
+    lines = ["exec                                     incl_ms   self_ms  "
+             "batches"]
+
+    def walk(node: "PhysicalPlan", level: int):
+        incl = node._prof_ns / 1e6
+        self_ms = (node._prof_ns
+                   - sum(c._prof_ns for c in node.children)) / 1e6
+        name = "  " * level + node.node_name()
+        lines.append(f"{name:<40} {incl:>8.2f}  {max(self_ms, 0.0):>8.2f}  "
+                     f"{node._prof_batches:>7d}")
+        for c in node.children:
+            walk(c, level + 1)
+
+    walk(phys, 0)
+    return "\n".join(lines)
 
 
 def collect_metrics(phys: "PhysicalPlan") -> Dict[str, float]:

@@ -77,7 +77,10 @@ class TpuSession:
     # ------------------------------------------------------------------
     def _execute(self, logical: P.LogicalPlan) -> pa.Table:
         from ..columnar.convert import device_to_arrow
+        from ..config import PROFILE_ENABLED
         from .physical import speculation
+        from .physical.base import PROFILING
+        PROFILING["on"] = bool(self._conf.get(PROFILE_ENABLED))
         planner = Planner(self._conf)
         phys = planner.plan_for_collect(logical)
         # collect has no side effects, so speculative results may be
@@ -125,6 +128,7 @@ class TpuSession:
             speculation.set_deferral(False)
         from .physical.base import collect_metrics
         self.last_query_metrics = collect_metrics(phys)
+        self._last_phys = phys
         tables = [device_to_arrow(b) for b in batches if b.num_rows_int > 0]
         arrow_schema = pa.schema([
             pa.field(a.name, T.to_arrow(a.dtype)) for a in logical.output])
@@ -135,6 +139,15 @@ class TpuSession:
 
     def physical_plan(self, df: DataFrame):
         return Planner(self._conf).plan_for_collect(df._plan)
+
+    def profile_last_query(self) -> str:
+        """Per-exec wall-time/batch profile of the most recent collect
+        (requires spark.rapids.tpu.profile.enabled during execution)."""
+        phys = getattr(self, "_last_phys", None)
+        if phys is None:
+            return "no query executed yet"
+        from .physical.base import profile_report
+        return profile_report(phys)
 
     def explain(self, df: DataFrame, all_ops: bool = True) -> str:
         """Placement report (spark.rapids.sql.explain=ALL equivalent) plus

@@ -131,3 +131,27 @@ def test_collect_aggs_planned_on_device():
     df = s.create_dataframe(pa.table({"k": [1], "v": [1.0]}))
     ex = s.explain(df.groupBy("k").agg(F.collect_list(df.v).alias("l")))
     assert "TpuHashAggregate" in ex
+
+
+def test_query_profile_report(session):
+    import numpy as np
+    import pyarrow as pa
+
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql import functions as F
+    sess = srt.session(**{"spark.rapids.tpu.profile.enabled": True})
+    rng = np.random.default_rng(0)
+    df = sess.create_dataframe(pa.table({"k": rng.integers(0, 5, 10_000),
+                                         "v": rng.random(10_000)}))
+    q = df.filter(df.v > 0.5).groupBy("k").agg(F.sum(df.v).alias("s"))
+    q.collect()
+    report = sess.profile_last_query()
+    lines = report.splitlines()
+    assert "incl_ms" in lines[0] and "batches" in lines[0]
+    assert len(lines) >= 3  # at least a sink + a scan
+    assert "Scan" in report
+    # profiling off -> no accounting overhead path
+    sess2 = srt.session()
+    df2 = sess2.create_dataframe(pa.table({"a": [1, 2]}))
+    df2.collect()
+    assert "exec" in sess2.profile_last_query()
