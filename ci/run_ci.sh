@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# CI harness — the analog of the reference's jenkins/spark-premerge-build.sh
+# + spark-tests.sh pipeline (SURVEY §2.11): build native libs, validate the
+# API contract, regenerate docs (drift check), run the unit+integration
+# suite on the virtual 8-device CPU mesh, run the scale rig, and finish
+# with the driver entry checks (single-chip compile + multichip dryrun).
+#
+# Usage: ci/run_ci.sh [quick]
+#   quick = skip the scale rig and use -x fail-fast on the suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+
+echo "=== [1/6] native libraries ==="
+make -C native
+
+echo "=== [2/6] API contract validation ==="
+timeout 300 python tools/api_validation.py
+
+echo "=== [3/6] docgen drift check ==="
+timeout 300 python -m spark_rapids_tpu.docgen
+if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
+    echo "WARNING: generated docs drifted from the committed copies:"
+    git --no-pager diff --stat -- docs tools/generated_files || true
+fi
+
+echo "=== [4/6] test suite (virtual 8-device CPU mesh) ==="
+if [ "$MODE" = quick ]; then
+    python -m pytest tests/ -x -q
+else
+    python -m pytest tests/ -q
+fi
+
+if [ "$MODE" != quick ]; then
+    echo "=== [5/6] scale rig ==="
+    SRT_SCALE_PLATFORM=cpu timeout 1200 \
+        python -m spark_rapids_tpu.testing.scaletest 100000
+else
+    echo "=== [5/6] scale rig skipped (quick) ==="
+fi
+
+echo "=== [6/6] driver entry checks ==="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
+    python __graft_entry__.py
+
+echo "CI PASSED"

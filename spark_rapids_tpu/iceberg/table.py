@@ -494,6 +494,77 @@ class IcebergTable:
                  "operation": s.summary.get("operation")}
                 for i, s in enumerate(self.meta.snapshots)]
 
+    # --- metadata tables (Spark's `db.table.snapshots` / `.files`) --------
+    def snapshots_df(self):
+        """The `<table>.snapshots` metadata table as a DataFrame
+        (reference exposes these through its Iceberg read path)."""
+        rows = {
+            "snapshot_id": [], "parent_id": [], "timestamp_ms": [],
+            "operation": [], "schema_id": [],
+        }
+        for s in self.meta.snapshots:
+            rows["snapshot_id"].append(s.snapshot_id)
+            rows["parent_id"].append(s.parent_id)
+            rows["timestamp_ms"].append(s.timestamp_ms)
+            rows["operation"].append(s.summary.get("operation"))
+            rows["schema_id"].append(s.schema_id)
+        return self._session.create_dataframe(pa.table({
+            "snapshot_id": pa.array(rows["snapshot_id"], pa.int64()),
+            "parent_id": pa.array(rows["parent_id"], pa.int64()),
+            "timestamp_ms": pa.array(rows["timestamp_ms"], pa.int64()),
+            "operation": pa.array(rows["operation"], pa.string()),
+            "schema_id": pa.array(rows["schema_id"], pa.int32()),
+        }))
+
+    def files_df(self):
+        """The `<table>.files` metadata table: live data files of the
+        current snapshot with record counts, sizes and partition values."""
+        snap = self.meta.snapshot()
+        files = self._live_data_files(snap) if snap is not None else []
+        return self._session.create_dataframe(pa.table({
+            "file_path": pa.array([f.file_path for f in files],
+                                  pa.string()),
+            "record_count": pa.array([f.record_count for f in files],
+                                     pa.int64()),
+            "file_size_bytes": pa.array([f.file_size for f in files],
+                                        pa.int64()),
+            "partition": pa.array([str(f.partition) for f in files],
+                                  pa.string()),
+        }))
+
+    def rewrite_data_files(self, target_files: int = 1) -> int:
+        """Compaction (`rewrite_data_files` action): concatenate the
+        current snapshot's live rows (position deletes applied) into
+        ``target_files`` new files and commit a REPLACE snapshot.
+        Returns the number of files compacted away."""
+        snap = self.meta.snapshot()
+        if snap is None:
+            return 0
+        old_files = self._live_data_files(snap)
+        if len(old_files) <= target_files:
+            return 0
+        schema = self.meta.schema(snap.schema_id)
+        parts = self.scan()
+        if not parts:
+            return 0
+        whole = pa.concat_tables(parts)
+        n = max(1, int(target_files))
+        per = -(-whole.num_rows // n)
+        entries: List[ManifestEntry] = []
+        for off in range(0, whole.num_rows, per):
+            piece = whole.slice(off, min(per, whole.num_rows - off))
+            rel = self._write_parquet(piece, schema)
+            lower, upper, nulls = self._column_bounds(schema, piece)
+            entries.append(ManifestEntry(STATUS_ADDED, 0, DataFile(
+                file_path=rel, content=DATA, record_count=piece.num_rows,
+                file_size=os.path.getsize(os.path.join(self.path, rel)),
+                spec_id=self.meta.spec().spec_id,
+                lower_bounds=lower, upper_bounds=upper,
+                null_counts=nulls)))
+        # REPLACE: no carried manifests — old data + delete files retire
+        self._commit_snapshot(entries, [], "replace")
+        return len(old_files)
+
     def expire_snapshots(self, older_than_ms: int) -> int:
         """Drop snapshot metadata older than the cutoff (keeping current);
         returns count removed."""

@@ -2,6 +2,9 @@
 ``integration_tests`` datagen layer (``data_gen.py:38-751`` design) and the
 ``datagen/`` scale-data module."""
 
+from .asserts import (assert_equal_with_pandas,
+                      assert_tpu_and_cpu_are_equal_collect,
+                      assert_tpu_fallback_collect, run_with_cpu_and_tpu)
 from .datagen import (ArrayGen, BooleanGen, ByteGen, DataGen, DateGen,
                       DecimalGen, DoubleGen, FloatGen, IntegerGen, LongGen,
                       MapGen, ShortGen, StringGen, StructGen, TimestampGen,
@@ -11,4 +14,6 @@ __all__ = [
     "DataGen", "BooleanGen", "ByteGen", "ShortGen", "IntegerGen", "LongGen",
     "FloatGen", "DoubleGen", "DecimalGen", "StringGen", "DateGen",
     "TimestampGen", "ArrayGen", "MapGen", "StructGen", "gen_table",
+    "assert_tpu_and_cpu_are_equal_collect", "assert_tpu_fallback_collect",
+    "assert_equal_with_pandas", "run_with_cpu_and_tpu",
 ]

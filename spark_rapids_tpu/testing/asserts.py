@@ -1,0 +1,111 @@
+"""Public assertion framework — the analog of the reference's
+``integration_tests/src/main/python/asserts.py`` tier-1 harness
+(``assert_gpu_and_cpu_are_equal_collect`` at ``asserts.py:560``,
+``assert_gpu_fallback_collect`` at ``:422``, ``run_with_cpu_and_gpu`` at
+``:525``; sessions toggled like ``spark_session.py:112-118``).
+
+Philosophy preserved: run the same query with acceleration ON and OFF and
+require equal results.  The OFF path executes eagerly under numpy — a
+different code path from the jitted device kernels — and callers can add
+a pandas oracle for full independence (``assert_equal_with_pandas``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Sequence
+
+__all__ = [
+    "assert_tpu_and_cpu_are_equal_collect",
+    "assert_tpu_fallback_collect",
+    "run_with_cpu_and_tpu",
+    "assert_equal_with_pandas",
+]
+
+
+def _norm(rows):
+    out = []
+    for r in rows:
+        nr = {}
+        for k, v in r.items():
+            if isinstance(v, float):
+                nr[k] = "NaN" if math.isnan(v) else round(v, 9)
+            else:
+                nr[k] = v
+        out.append(nr)
+    return out
+
+
+def _sorted_rows(rows, sort_by):
+    if not sort_by:
+        return rows
+    return sorted(rows, key=lambda r: tuple(
+        (r[k] is None, str(r[k])) for k in sort_by))
+
+
+def run_with_cpu_and_tpu(df):
+    """Collect ``df`` twice — acceleration on, then off — and return
+    (tpu_table, cpu_table)."""
+    sess = df._session
+    tpu = df.collect()
+    old = sess.conf.get("spark.rapids.sql.enabled")
+    sess.conf.set("spark.rapids.sql.enabled", False)
+    try:
+        cpu = df.collect()
+    finally:
+        sess.conf.set("spark.rapids.sql.enabled", old)
+    return tpu, cpu
+
+
+def assert_tpu_and_cpu_are_equal_collect(df, sort_by: Optional[
+        Sequence[str]] = None):
+    """The tier-1 equality assertion; returns the accelerated result."""
+    tpu, cpu = run_with_cpu_and_tpu(df)
+    t = _sorted_rows(tpu.to_pylist(), sort_by)
+    c = _sorted_rows(cpu.to_pylist(), sort_by)
+    assert _norm(t) == _norm(c), "TPU and CPU results differ"
+    return tpu
+
+
+def assert_tpu_fallback_collect(df, fallback_exec: str):
+    """Assert the query RUNS but the named exec did NOT place on the
+    device (the reference's assert_gpu_fallback_collect): the physical
+    plan must contain a Cpu- node for it."""
+    sess = df._session
+    plan = sess.physical_plan(df).tree_string()
+    assert f"Cpu{fallback_exec}" in plan, (
+        f"expected {fallback_exec} to fall back to CPU; plan:\n{plan}")
+    return df.collect()
+
+
+def assert_equal_with_pandas(df, expected, sort_by: Optional[
+        Sequence[str]] = None, rtol: float = 1e-7):
+    """Compare a query result against an independently computed pandas
+    frame (the genuinely independent oracle the reference gets from CPU
+    Spark)."""
+    import numpy as np
+
+    got = df.collect().to_pandas()
+    exp = expected.reset_index(drop=True)
+    if sort_by:
+        got = got.sort_values(list(sort_by)).reset_index(drop=True)
+        exp = exp.sort_values(list(sort_by)).reset_index(drop=True)
+    assert list(got.columns) == list(exp.columns), (
+        f"column mismatch: {list(got.columns)} vs {list(exp.columns)}")
+    assert len(got) == len(exp), f"row count {len(got)} vs {len(exp)}"
+    for col in got.columns:
+        g, e = got[col].to_numpy(), exp[col].to_numpy()
+        if g.dtype.kind == "f" or e.dtype.kind == "f":
+            ga, ea = g.astype(float), e.astype(float)
+            nan_equal = np.isnan(ga) == np.isnan(ea)
+            ok = nan_equal & (np.isnan(ga) | np.isclose(ga, ea, rtol=rtol))
+            assert ok.all(), f"column {col} differs"
+        else:
+            assert (pd_isna_eq(g, e)), f"column {col} differs"
+
+
+def pd_isna_eq(g, e) -> bool:
+    import pandas as pd
+    gs, es = pd.Series(g), pd.Series(e)
+    both_na = gs.isna() & es.isna()
+    return bool((both_na | (gs == es)).all())

@@ -253,3 +253,34 @@ def test_identity_partition_on_date(sess, tmp_path):
     assert len(t.planned_files([("d", "=", d1)])) == 1
     got = t.to_df(filters=[("d", "=", d1)]).collect()
     assert sorted(got["x"].to_pylist()) == [1, 2]
+
+
+def test_metadata_tables_and_compaction(sess, tmp_path):
+    import pyarrow as pa
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.iceberg import IcebergTable
+    schema = T.StructType((T.StructField("id", T.LONG, False),
+                           T.StructField("v", T.DOUBLE, True)))
+    tab = IcebergTable.create(sess, str(tmp_path / "ice"), schema)
+    for i in range(3):
+        tab.append(pa.table({"id": pa.array([i * 10, i * 10 + 1],
+                                            type=pa.int64()),
+                             "v": [1.0 * i, 2.0 * i]}))
+    snaps = tab.snapshots_df().collect().to_pandas()
+    assert len(snaps) == 3 and set(snaps["operation"]) == {"append"}
+    files = tab.files_df().collect().to_pandas()
+    assert len(files) == 3
+    assert files["record_count"].sum() == 6
+    # delete one row, then compact everything into one file
+    tab.delete_where(("id", "=", 21))
+    compacted = tab.rewrite_data_files(target_files=1)
+    assert compacted == 3
+    tab = tab.refresh()
+    files = tab.files_df().collect().to_pandas()
+    assert len(files) == 1
+    out = tab.to_df().collect().to_pandas().sort_values("id")
+    assert list(out["id"]) == [0, 1, 10, 11, 20]
+    # history keeps all operations incl. the replace
+    ops = [h["operation"] for h in tab.history()]
+    assert ops[-1] == "replace" and "delete" in ops

@@ -155,3 +155,31 @@ def test_query_profile_report(session):
     df2 = sess2.create_dataframe(pa.table({"a": [1, 2]}))
     df2.collect()
     assert "exec" in sess2.profile_last_query()
+
+
+def test_public_assert_framework(session):
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.testing import (
+        assert_equal_with_pandas, assert_tpu_and_cpu_are_equal_collect)
+    from spark_rapids_tpu.sql import functions as F
+    rng = np.random.default_rng(1)
+    t = pa.table({"k": rng.integers(0, 4, 500), "v": rng.random(500)})
+    df = session.create_dataframe(t)
+    q = df.groupBy("k").agg(F.sum(df.v).alias("s"))
+    assert_tpu_and_cpu_are_equal_collect(q, sort_by=["k"])
+    exp = (t.to_pandas().groupby("k").agg(s=("v", "sum")).reset_index())
+    assert_equal_with_pandas(q, exp, sort_by=["k"], rtol=1e-6)
+
+
+def test_fallback_assert(session):
+    import pyarrow as pa
+
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.testing import assert_tpu_fallback_collect
+    df = session.create_dataframe(pa.table({"a": [2, 3]}))
+    # sequence is documented host-only -> its Generate falls back
+    q = df.select(F.explode(F.sequence(F.lit(1), df.a)).alias("x"))
+    out = assert_tpu_fallback_collect(q, "Generate")
+    assert out.num_rows == 5
