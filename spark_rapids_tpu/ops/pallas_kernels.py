@@ -96,6 +96,69 @@ def murmur3_long_pallas(vals_i64, seed, interpret: bool = False):
     return out.reshape(-1)[:n]
 
 
+def _seg_sum_kernel(out_groups: int):
+    """Grid-accumulating MXU kernel: per block, build the (block*lanes,
+    OUT) one-hot of the group ranks and reduce all slots with ONE matmul
+    — the segmented-sum hot loop of the fused aggregate expressed as an
+    explicit systolic-array program (TPU grids run sequentially, so
+    ``out_ref += ...`` accumulates across blocks)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(v_ref, r_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        v = v_ref[...]                      # (s, block, lanes)
+        r = r_ref[...]                      # (block, lanes)
+        onehot = (r[..., None] == jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, out_groups), 2)).astype(jnp.float32)
+        flat_v = v.reshape(v.shape[0], -1)           # (s, block*lanes)
+        flat_o = onehot.reshape(-1, out_groups)      # (block*lanes, OUT)
+        out_ref[...] += jax.lax.dot(
+            flat_v, flat_o,
+            preferred_element_type=jnp.float32)      # (s, OUT) on the MXU
+
+    return kernel
+
+
+def seg_sum_f32_pallas(values, rank, out_size: int,
+                       interpret: bool = False):
+    """float32[s, n] slot values + int32[n] group ranks -> float32[s,
+    out_size] per-group sums as a Pallas TPU program (rank >= out_size
+    contributes nothing — the dead-row convention of groupby_reduce).
+    Accumulation order is block-major, the same error class as the
+    engine's one-hot-matmul reduction path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    s, n = values.shape
+    OUT = -(-int(out_size) // _LANES) * _LANES  # lane-pad the group dim
+    rows = -(-n // _LANES)
+    block = min(64, max(8, rows))
+    padded_rows = -(-rows // block) * block
+    pad = padded_rows * _LANES - n
+    v = jnp.pad(values, ((0, 0), (0, pad))).reshape(s, padded_rows, _LANES)
+    # pad ranks with OUT (out of range -> all-false one-hot)
+    r = jnp.pad(rank.astype(jnp.int32), (0, pad),
+                constant_values=OUT).reshape(padded_rows, _LANES)
+    r = jnp.where(r < int(out_size), r, OUT)  # oversize ranks drop too
+
+    grid = padded_rows // block
+    out = pl.pallas_call(
+        _seg_sum_kernel(OUT),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((s, block, _LANES), lambda i: (0, i, 0)),
+                  pl.BlockSpec((block, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((s, OUT), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((s, OUT), jnp.float32),
+        interpret=interpret,
+    )(v, r)
+    return out[:, :int(out_size)]
+
+
 def on_tpu() -> bool:
     try:
         import jax

@@ -228,6 +228,17 @@ def groupby_reduce(xp, key_cols: Sequence[DeviceColumn],
         if onehot is not None and (
                 dt == np.dtype(np.float32)
                 or (flags and cap < (1 << 24))):
+            from ...ops import pallas_kernels as PK
+            if PK.on_tpu():
+                # explicit MXU program (same accumulation error class as
+                # the one-hot matmul below, same dead-rank convention)
+                stacked = xp.stack([c.astype(xp.float32) for c in cols2],
+                                   axis=0)
+                try:
+                    return PK.seg_sum_f32_pallas(
+                        stacked, rank, OUT).T.astype(dt)
+                except Exception:
+                    pass  # Mosaic/lowering gap: fall through to XLA
             stacked = xp.stack([c.astype(xp.float32) for c in cols2],
                                axis=1)
             return (onehot.T @ stacked).astype(dt)

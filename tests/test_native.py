@@ -104,3 +104,33 @@ def test_serializer_checksum_detects_corruption():
     bad[len(bad) // 2] ^= 0xFF
     with pytest.raises(ValueError, match="checksum"):
         deserialize_batch(bytes(bad))
+
+
+def test_pallas_seg_sum_interpret_matches(rng):
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.pallas_kernels import seg_sum_f32_pallas
+    n, s, out = 10_000, 4, 37
+    vals = rng.random((s, n)).astype(np.float32)
+    rank = rng.integers(0, out + 5, n).astype(np.int32)  # incl. dead ranks
+    got = np.asarray(seg_sum_f32_pallas(jnp.asarray(vals),
+                                        jnp.asarray(rank), out,
+                                        interpret=True))
+    exp = np.zeros((s, out), np.float64)
+    live = rank < out
+    for i in range(s):
+        np.add.at(exp[i], rank[live], vals[i][live].astype(np.float64))
+    assert got.shape == (s, out)
+    assert np.allclose(got, exp, rtol=1e-5)
+
+
+def test_pallas_seg_sum_single_slot_and_tiny(rng):
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.pallas_kernels import seg_sum_f32_pallas
+    vals = np.asarray([[1.0, 2.0, 4.0]], np.float32)
+    rank = np.asarray([0, 1, 0], np.int32)
+    got = np.asarray(seg_sum_f32_pallas(jnp.asarray(vals),
+                                        jnp.asarray(rank), 2,
+                                        interpret=True))
+    assert np.allclose(got, [[5.0, 2.0]])
