@@ -165,3 +165,28 @@ def on_tpu() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+_SEG_SUM_OK = {}
+
+
+def seg_sum_available() -> bool:
+    """One-time end-to-end probe of the segmented-sum kernel on this
+    backend (compile + execute + check a known answer).  A Mosaic
+    lowering gap raises at COMPILE time — outside any try/except around
+    the traced call site — so callers must gate on this probe rather
+    than catching at dispatch."""
+    import jax
+    key = jax.default_backend()
+    ok = _SEG_SUM_OK.get(key)
+    if ok is None:
+        try:
+            import jax.numpy as jnp
+            out = np.asarray(seg_sum_f32_pallas(
+                jnp.ones((1, 300), jnp.float32),
+                jnp.zeros(300, jnp.int32), 8))
+            ok = abs(float(out[0, 0]) - 300.0) < 1e-3
+        except Exception:
+            ok = False
+        _SEG_SUM_OK[key] = ok
+    return ok

@@ -229,16 +229,16 @@ def groupby_reduce(xp, key_cols: Sequence[DeviceColumn],
                 dt == np.dtype(np.float32)
                 or (flags and cap < (1 << 24))):
             from ...ops import pallas_kernels as PK
-            if PK.on_tpu():
+            if PK.on_tpu() and PK.seg_sum_available():
                 # explicit MXU program (same accumulation error class as
-                # the one-hot matmul below, same dead-rank convention)
+                # the one-hot matmul below, same dead-rank convention);
+                # availability probed end-to-end once per backend —
+                # lowering gaps surface at compile time, outside any
+                # try/except around this traced call
                 stacked = xp.stack([c.astype(xp.float32) for c in cols2],
                                    axis=0)
-                try:
-                    return PK.seg_sum_f32_pallas(
-                        stacked, rank, OUT).T.astype(dt)
-                except Exception:
-                    pass  # Mosaic/lowering gap: fall through to XLA
+                return PK.seg_sum_f32_pallas(
+                    stacked, rank, OUT).T.astype(dt)
             stacked = xp.stack([c.astype(xp.float32) for c in cols2],
                                axis=1)
             return (onehot.T @ stacked).astype(dt)
