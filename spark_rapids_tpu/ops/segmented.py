@@ -52,15 +52,33 @@ def seg_max(xp, data, seg_ids, num_segments, init):
     return out.at[_nowrap(xp, seg_ids, num_segments)].max(data)
 
 
+def _prefer_column_scatters(xp) -> bool:
+    """XLA CPU lowers a [n, s] 2-D scatter ~3x slower than s separate
+    1-D scatters (measured 810ms vs 277ms at 8M x 8 f64); on TPU the
+    batched form amortizes the kernel pass.  Trace-time host decision."""
+    if xp.__name__ == "numpy":
+        return False
+    try:
+        import jax
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return False
+
+
 def seg_sum2(xp, data2, seg_ids, num_segments):
-    """Batched segmented sum: one scatter-add for a [n, s] slot matrix
-    (s slots reduced in a single kernel pass)."""
+    """Batched segmented sum for a [n, s] slot matrix: one kernel pass on
+    TPU; per-column 1-D scatters on XLA CPU (see _prefer_column_scatters)."""
     out = xp.zeros((num_segments, data2.shape[1]), dtype=data2.dtype)
     if xp.__name__ == "numpy":
         ids, m = _inb(seg_ids, num_segments)
         np.add.at(out, ids[m], np.asarray(data2)[m])
         return out
-    return out.at[_nowrap(xp, seg_ids, num_segments)].add(data2)
+    ids = _nowrap(xp, seg_ids, num_segments)
+    if _prefer_column_scatters(xp):
+        cols = [xp.zeros(num_segments, dtype=data2.dtype).at[ids]
+                .add(data2[:, j]) for j in range(data2.shape[1])]
+        return xp.stack(cols, axis=1)
+    return out.at[ids].add(data2)
 
 
 def seg_min2(xp, data2, seg_ids, num_segments, init):
@@ -69,7 +87,12 @@ def seg_min2(xp, data2, seg_ids, num_segments, init):
         ids, m = _inb(seg_ids, num_segments)
         np.minimum.at(out, ids[m], np.asarray(data2)[m])
         return out
-    return out.at[_nowrap(xp, seg_ids, num_segments)].min(data2)
+    ids = _nowrap(xp, seg_ids, num_segments)
+    if _prefer_column_scatters(xp):
+        cols = [xp.full(num_segments, init, dtype=data2.dtype).at[ids]
+                .min(data2[:, j]) for j in range(data2.shape[1])]
+        return xp.stack(cols, axis=1)
+    return out.at[ids].min(data2)
 
 
 def seg_max2(xp, data2, seg_ids, num_segments, init):
@@ -78,7 +101,12 @@ def seg_max2(xp, data2, seg_ids, num_segments, init):
         ids, m = _inb(seg_ids, num_segments)
         np.maximum.at(out, ids[m], np.asarray(data2)[m])
         return out
-    return out.at[_nowrap(xp, seg_ids, num_segments)].max(data2)
+    ids = _nowrap(xp, seg_ids, num_segments)
+    if _prefer_column_scatters(xp):
+        cols = [xp.full(num_segments, init, dtype=data2.dtype).at[ids]
+                .max(data2[:, j]) for j in range(data2.shape[1])]
+        return xp.stack(cols, axis=1)
+    return out.at[ids].max(data2)
 
 
 def seg_any(xp, mask, seg_ids, num_segments):
