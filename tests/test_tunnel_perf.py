@@ -269,3 +269,44 @@ class TestGroupIdsSmall:
                                          expected))
         ng = int(ids.max()) + 1
         assert ng > expected, "overflow must be visible in the count"
+
+
+class TestSegmentedReductionBackends:
+    def test_seg2_column_split_matches_batched(self):
+        """The XLA-CPU per-column scatter split must be value-identical
+        to the batched 2-D scatter form."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.ops import segmented as S
+        rng = np.random.default_rng(9)
+        n, s, out = 50_000, 6, 64
+        data = jnp.asarray(rng.random((n, s)))
+        ids = jnp.asarray(rng.integers(0, out + 3, n).astype(np.int64))
+        a = np.asarray(S.seg_sum2(jnp, data, ids, out))
+        exp = np.zeros((out, s))
+        live = np.asarray(ids) < out
+        np.add.at(exp, np.asarray(ids)[live], np.asarray(data)[live])
+        assert np.allclose(a, exp)
+        mn = np.asarray(S.seg_min2(jnp, data, ids, out, np.inf))
+        mx = np.asarray(S.seg_max2(jnp, data, ids, out, -np.inf))
+        for g in range(out):
+            sel = np.asarray(ids) == g
+            if sel.any():
+                assert np.allclose(mn[g], np.asarray(data)[sel].min(axis=0))
+                assert np.allclose(mx[g], np.asarray(data)[sel].max(axis=0))
+
+
+class TestSyncModeNever:
+    def test_never_mode_skips_all_syncs(self, session):
+        import spark_rapids_tpu.memory.oom_guard as G
+        from spark_rapids_tpu.config import OOM_SYNC_MODE, RapidsConf
+        conf = RapidsConf.get_global()
+        old = conf.get(OOM_SYNC_MODE)
+        conf.set(OOM_SYNC_MODE.key, "never")
+        try:
+            before = G.STATS["eager_syncs"]
+            wrapped = G.guard_device_oom(lambda: np.float32(2.0))
+            assert wrapped() == np.float32(2.0)
+            assert G.STATS["eager_syncs"] == before
+        finally:
+            conf.set(OOM_SYNC_MODE.key, old)
