@@ -173,8 +173,12 @@ def group_ids_small(xp, cols, row_mask, expected_groups: int):
         cand = jnp.where(unresolved, row_idx, sentinel)
         table = table.at[slot].min(cand)
         owner = table[slot]
-        safe_owner = jnp.clip(owner, 0, cap - 1)
-        eq = (owner < cap) & jnp.all(key_mat == key_mat[safe_owner], axis=1)
+        # gather each slot WINNER's keys once into the tiny [M, k] table,
+        # then compare rows against win_keys[slot] — streaming reads of
+        # key_mat plus cache-resident table lookups, instead of a cap-wide
+        # random gather into key_mat (the big kernel's cost)
+        win_keys = key_mat[jnp.clip(table, 0, cap - 1)]
+        eq = (owner < cap) & jnp.all(key_mat == win_keys[slot], axis=1)
         newly = unresolved & eq
         rep = jnp.where(newly, owner, rep)
         off = jnp.where(unresolved & ~eq, off + np.uint32(1), off)
