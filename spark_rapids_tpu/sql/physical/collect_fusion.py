@@ -72,10 +72,12 @@ class FusedCollectExec(PhysicalPlan):
     backend = CPU  # emits host batches, like the D2H transition it replaces
 
     def __init__(self, agg: HashAggregateExec, sort: Optional[SortExec],
-                 fallback: DeviceToHostExec):
+                 fallback: DeviceToHostExec,
+                 topn: Optional["TakeOrderedAndProjectExec"] = None):
         super().__init__(agg.children[0])
         self._agg = agg
         self._sort = sort
+        self._topn = topn
         self._fallback = fallback
 
     @property
@@ -87,8 +89,15 @@ class FusedCollectExec(PhysicalPlan):
         from .kernel_cache import exprs_key
         sort_key = (exprs_key(self._sort._bound)
                     if self._sort is not None else None)
+        topn_key = None
+        if self._topn is not None:
+            t = self._topn
+            topn_key = (int(t.n),
+                        exprs_key(t.project_exprs)
+                        if t.project_exprs is not None else None,
+                        tuple(a.name for a in t.output))
         return ("tailcollect", spec, capacity,
-                self._agg._fused_complete_key(spec), sort_key,
+                self._agg._fused_complete_key(spec), sort_key, topn_key,
                 _f64_as_pair(), _pack_f64_enabled())
 
     def _build(self, spec: int, batch: ColumnarBatch, key):
@@ -100,11 +109,14 @@ class FusedCollectExec(PhysicalPlan):
         from .kernel_cache import cached_jit
         agg_body = self._agg._fused_complete_body(spec)
         sort_compute = self._sort._compute if self._sort is not None else None
+        topn_step = self._topn_step(spec) if self._topn is not None else None
 
         def tail_body(b):
             fin, ng = agg_body(b)
             if sort_compute is not None:
                 fin = sort_compute(fin)
+            if topn_step is not None:
+                fin = topn_step(fin)
             return fin, ng
 
         # learn the result-tree structure without executing
@@ -121,13 +133,57 @@ class FusedCollectExec(PhysicalPlan):
         fn = cached_jit(key, full)
         return fn, sig, treedef
 
+    def _topn_step(self, spec: int):
+        """Traced TopN tail (TakeOrderedAndProjectExec composed into the
+        program): static head-slice of the sorted batch to the limit's
+        capacity bucket, then the optional projection."""
+        import jax.numpy as jnp
+
+        from ...columnar.column import DeviceColumn, bucket_capacity
+        from ..expressions.core import EvalContext, bind_references
+        t = self._topn
+        n = int(t.n)
+        cap2 = min(bucket_capacity(max(n, 1)), spec)
+        bound = None
+        if t.project_exprs is not None:
+            bound = [bind_references(e, t.children[0].output)
+                     for e in t.project_exprs]
+        out_names = tuple(a.name for a in t.output)
+
+        def step(fin):
+            cols = tuple(
+                DeviceColumn(c.dtype, c.data[:cap2], c.validity[:cap2])
+                for c in fin.columns)
+            head = ColumnarBatch(fin.names, cols,
+                                 jnp.minimum(fin.num_rows, n))
+            if bound is None:
+                return head
+            ctx = EvalContext(head, xp=jnp)
+            pcols = tuple(e.eval(ctx) for e in bound)
+            return ColumnarBatch(out_names, pcols, head.num_rows)
+
+        return step
+
+    def _topn_fusable(self) -> bool:
+        """Only simple 1-D columns head-slice cleanly (strings/arrays use
+        flattened slot layouts whose first axis is not rows)."""
+        t = self._topn
+        if t is None:
+            return True
+        from ... import types as T
+        simple = (T.LONG, T.INT, T.SHORT, T.BYTE, T.DOUBLE, T.FLOAT,
+                  T.BOOLEAN, T.DATE, T.TIMESTAMP)
+        attrs = list(t.children[0].output) + list(t.output)
+        return all(a.dtype in simple for a in attrs)
+
     def execute(self, pid, tctx):
         from ...memory.oom_guard import guard_device_oom
         from ...memory.retry import SplitAndRetryOOM
         from ...columnar.convert import unpack_buffers
         from . import speculation as SPEC
         agg = self._agg
-        if not SPEC.deferral_enabled() or agg._special:
+        if not SPEC.deferral_enabled() or agg._special \
+                or not self._topn_fusable():
             STATS["fallbacks"] += 1
             yield from self._fallback.execute(pid, tctx)
             return
@@ -178,7 +234,9 @@ class FusedCollectExec(PhysicalPlan):
         import jax
         out = jax.tree.unflatten(treedef, leaves[:-1])
         tctx.inc_metric("d2h_bytes", batch_nbytes(out))
-        yield out.with_known_rows(ng_host)
+        rows_out = (min(ng_host, int(self._topn.n))
+                    if self._topn is not None else ng_host)
+        yield out.with_known_rows(rows_out)
 
     def _run_fallback_on(self, batches, pid, tctx):
         """Run the wrapped subtree, feeding it the already-started child
@@ -188,7 +246,13 @@ class FusedCollectExec(PhysicalPlan):
         agg2 = copy.copy(self._agg)
         agg2.children = (replay,)
         node: PhysicalPlan = agg2
-        if self._sort is not None:
+        if self._topn is not None:
+            topn2 = copy.copy(self._topn)
+            topn2.children = (node,)
+            topn2._sort = copy.copy(self._topn._sort)
+            topn2._sort.children = (node,)
+            node = topn2
+        elif self._sort is not None:
             sort2 = copy.copy(self._sort)
             sort2.children = (node,)
             node = sort2
@@ -201,7 +265,10 @@ class FusedCollectExec(PhysicalPlan):
 
     def simple_string(self):
         inner = self._agg.simple_string()
-        if self._sort is not None:
+        if self._topn is not None:
+            inner = (f"TakeOrdered(n={self._topn.n}) <- "
+                     f"{self._sort.simple_string()} <- {inner}")
+        elif self._sort is not None:
             inner = f"{self._sort.simple_string()} <- {inner}"
         return f"{self.node_name()} [{inner}]"
 
@@ -215,18 +282,24 @@ class FusedCollectExec(PhysicalPlan):
 
 def fuse_collect_tail(phys: PhysicalPlan) -> PhysicalPlan:
     """Planner pass: replace ``DeviceToHost(Sort?(HashAggregate(complete)))``
-    (sort orders referencing output columns only, TPU backend throughout)
-    with :class:`FusedCollectExec`."""
+    or ``DeviceToHost(TakeOrderedAndProject(HashAggregate(complete)))``
+    (TPU backend throughout) with :class:`FusedCollectExec`."""
+    from .sortlimit import TakeOrderedAndProjectExec
     if not isinstance(phys, DeviceToHostExec):
         return phys
     inner = phys.children[0]
     sort = None
+    topn = None
     agg = inner
-    if isinstance(inner, SortExec) and inner.backend != CPU:
+    if isinstance(inner, TakeOrderedAndProjectExec) and inner.backend != CPU:
+        topn = inner
+        sort = inner._sort
+        agg = inner.children[0]
+    elif isinstance(inner, SortExec) and inner.backend != CPU:
         sort = inner
         agg = inner.children[0]
     if not isinstance(agg, HashAggregateExec):
         return phys
     if agg.backend == CPU or agg.mode != "complete" or agg._special:
         return phys
-    return FusedCollectExec(agg, sort, phys)
+    return FusedCollectExec(agg, sort, phys, topn=topn)

@@ -391,6 +391,19 @@ class Planner:
         return SortExec(node.orders, child, backend=be)
 
     def _plan_limit(self, node: P.Limit, child: PhysicalPlan, be):
+        # TopN composition (the reference builds TakeOrderedAndProject in
+        # the rule, GpuOverrides.scala:3880-3904): Limit directly over a
+        # Sort becomes per-partition top-n + merge, skipping the range
+        # exchange a global sort would otherwise need
+        if node.offset == 0 and isinstance(child, SortExec) \
+                and child.backend == be:
+            inner = child.children[0]
+            from .physical.exchange import ShuffleExchangeExec
+            if isinstance(inner, ShuffleExchangeExec) and isinstance(
+                    inner.partitioning, RangePartitioning):
+                inner = inner.children[0]  # top-n needs no range exchange
+            return TakeOrderedAndProjectExec(node.n, child.orders, None,
+                                             inner, backend=be)
         local = LocalLimitExec(node.n + node.offset, child, backend=be)
         if child.num_partitions() > 1:
             gathered = ShuffleExchangeExec(SinglePartitioning(), local,

@@ -310,3 +310,36 @@ class TestSyncModeNever:
             assert G.STATS["eager_syncs"] == before
         finally:
             conf.set(OOM_SYNC_MODE.key, old)
+
+
+class TestTopNTailFusion:
+    def test_orderby_limit_fuses_and_matches(self, session):
+        import spark_rapids_tpu.sql.physical.collect_fusion as CF
+        from spark_rapids_tpu.sql import functions as F
+        rng = np.random.default_rng(13)
+        t = pa.table({"k": rng.integers(0, 40, 20_000),
+                      "v": rng.random(20_000)})
+        df = session.create_dataframe(t)
+        q = (df.groupBy("k").agg(F.sum(df.v).alias("s"))
+             .orderBy(F.col("s").desc()).limit(6))
+        plan = session.physical_plan(q).tree_string()
+        assert "FusedCollect" in plan and "TakeOrdered" in plan
+        q.collect()
+        before = CF.STATS["fused_collects"]
+        got = q.collect().to_pandas()
+        assert CF.STATS["fused_collects"] > before
+        exp = (t.to_pandas().groupby("k").agg(s=("v", "sum")).reset_index()
+               .sort_values("s", ascending=False).head(6)
+               .reset_index(drop=True))
+        assert np.array_equal(np.asarray(got["k"]), np.asarray(exp["k"]))
+        assert np.allclose(np.asarray(got["s"]), np.asarray(exp["s"]))
+
+    def test_limit_with_offset_keeps_generic_path(self, session):
+        from spark_rapids_tpu.sql import functions as F
+        t = pa.table({"a": list(range(20))})
+        df = session.create_dataframe(t)
+        q = df.orderBy(F.col("a").desc()).offset(3).limit(4)
+        got = sorted(q.collect().to_pandas()["a"])
+        # offset paths can't take the TakeOrdered composition; results
+        # must still be exact
+        assert got == [13, 14, 15, 16]
