@@ -164,26 +164,13 @@ class FusedCollectExec(PhysicalPlan):
 
         return step
 
-    def _topn_fusable(self) -> bool:
-        """Only simple 1-D columns head-slice cleanly (strings/arrays use
-        flattened slot layouts whose first axis is not rows)."""
-        t = self._topn
-        if t is None:
-            return True
-        from ... import types as T
-        simple = (T.LONG, T.INT, T.SHORT, T.BYTE, T.DOUBLE, T.FLOAT,
-                  T.BOOLEAN, T.DATE, T.TIMESTAMP)
-        attrs = list(t.children[0].output) + list(t.output)
-        return all(a.dtype in simple for a in attrs)
-
     def execute(self, pid, tctx):
         from ...memory.oom_guard import guard_device_oom
         from ...memory.retry import SplitAndRetryOOM
         from ...columnar.convert import unpack_buffers
         from . import speculation as SPEC
         agg = self._agg
-        if not SPEC.deferral_enabled() or agg._special \
-                or not self._topn_fusable():
+        if not SPEC.deferral_enabled() or agg._special:
             STATS["fallbacks"] += 1
             yield from self._fallback.execute(pid, tctx)
             return
@@ -249,8 +236,7 @@ class FusedCollectExec(PhysicalPlan):
         if self._topn is not None:
             topn2 = copy.copy(self._topn)
             topn2.children = (node,)
-            topn2._sort = copy.copy(self._topn._sort)
-            topn2._sort.children = (node,)
+            topn2._sort_cache = None  # lazily re-derives from the replay
             node = topn2
         elif self._sort is not None:
             sort2 = copy.copy(self._sort)
@@ -302,4 +288,17 @@ def fuse_collect_tail(phys: PhysicalPlan) -> PhysicalPlan:
         return phys
     if agg.backend == CPU or agg.mode != "complete" or agg._special:
         return phys
+    if topn is not None and not _topn_fusable(topn):
+        return phys
     return FusedCollectExec(agg, sort, phys, topn=topn)
+
+
+def _topn_fusable(t) -> bool:
+    """Only simple 1-D columns head-slice cleanly (strings/arrays use
+    flattened slot layouts whose first axis is not rows) — a static plan
+    property, so ineligible plans are never wrapped at all."""
+    from ... import types as T
+    simple = (T.LONG, T.INT, T.SHORT, T.BYTE, T.DOUBLE, T.FLOAT,
+              T.BOOLEAN, T.DATE, T.TIMESTAMP)
+    attrs = list(t.children[0].output) + list(t.output)
+    return all(a.dtype in simple for a in attrs)

@@ -32,9 +32,12 @@ STATS = {"ooc_sorts": 0, "merge_steps": 0}
 
 class SortExec(PhysicalPlan):
     def __init__(self, orders: Sequence[SortOrder], child: PhysicalPlan,
-                 backend=TPU):
+                 backend=TPU, is_global: bool = True):
         super().__init__(child)
         self.backend = backend
+        #: False for sortWithinPartitions — a following Limit must NOT
+        #: compose into a global TopN over a merely-local sort
+        self.is_global = is_global
         self.orders = list(orders)
         self._bound = [SortOrder(bind_references(o.child, child.output),
                                  o.ascending, o.nulls_first)
@@ -198,8 +201,21 @@ class TakeOrderedAndProjectExec(PhysicalPlan):
         super().__init__(child)
         self.backend = backend
         self.n = n
-        self._sort = SortExec(orders, child, backend)
+        self.orders = list(orders)
         self.project_exprs = project_exprs
+        self._sort_cache: "SortExec" = None
+
+    @property
+    def _sort(self) -> "SortExec":
+        """Derived lazily from the CURRENT child: planner passes that
+        rewrite ``self.children`` (backend transitions, stage fusion)
+        must flow into the internal sort, not a child frozen at
+        construction time."""
+        child = self.children[0]
+        if self._sort_cache is None or \
+                self._sort_cache.children[0] is not child:
+            self._sort_cache = SortExec(self.orders, child, self.backend)
+        return self._sort_cache
 
     @property
     def output(self):

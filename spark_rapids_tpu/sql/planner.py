@@ -388,7 +388,8 @@ class Planner:
         if node.is_global and child.num_partitions() > 1:
             part = RangePartitioning(node.orders, child.num_partitions())
             child = ShuffleExchangeExec(part, child, backend=be)
-        return SortExec(node.orders, child, backend=be)
+        return SortExec(node.orders, child, backend=be,
+                        is_global=node.is_global)
 
     def _plan_limit(self, node: P.Limit, child: PhysicalPlan, be):
         # TopN composition (the reference builds TakeOrderedAndProject in
@@ -396,9 +397,8 @@ class Planner:
         # Sort becomes per-partition top-n + merge, skipping the range
         # exchange a global sort would otherwise need
         if node.offset == 0 and isinstance(child, SortExec) \
-                and child.backend == be:
+                and child.backend == be and child.is_global:
             inner = child.children[0]
-            from .physical.exchange import ShuffleExchangeExec
             if isinstance(inner, ShuffleExchangeExec) and isinstance(
                     inner.partitioning, RangePartitioning):
                 inner = inner.children[0]  # top-n needs no range exchange

@@ -343,3 +343,16 @@ class TestTopNTailFusion:
         # offset paths can't take the TakeOrdered composition; results
         # must still be exact
         assert got == [13, 14, 15, 16]
+
+    def test_sort_within_partitions_limit_not_globalized(self, session):
+        """sortWithinPartitions + limit must NOT compose into a global
+        TopN (the limit takes rows from the locally-sorted stream)."""
+        import pyarrow as pa
+        if not hasattr(session.create_dataframe(
+                pa.table({"a": [1]})), "sortWithinPartitions"):
+            pytest.skip("sortWithinPartitions not exposed")
+        t = pa.table({"a": [5, 1, 9, 3, 7, 2]})
+        df = session.create_dataframe(t, num_partitions=2)
+        q = df.sortWithinPartitions("a").limit(2)
+        plan = session.physical_plan(q).tree_string()
+        assert "TakeOrdered" not in plan
