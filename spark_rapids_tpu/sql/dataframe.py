@@ -647,6 +647,13 @@ class DataFrame:
 
     first = head
 
+    def take(self, n: int):
+        """First n rows as a list of dicts (pyspark take)."""
+        return self.limit(n).collect().to_pylist()
+
+    def isEmpty(self) -> bool:
+        return self.limit(1).count() == 0
+
     def cache(self) -> "DataFrame":
         """Materialize once (ParquetCachedBatchSerializer analog: the
         collected result is stored as COMPRESSED parquet bytes and decoded
