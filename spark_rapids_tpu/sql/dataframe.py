@@ -331,11 +331,38 @@ class DataFrame:
 
     def filter(self, cond) -> "DataFrame":
         if isinstance(cond, str):
-            raise NotImplementedError("SQL string predicates not yet supported")
+            from .sqlparser import parse_expr
+            cond = parse_expr(cond)
         return DataFrame(P.Filter(_resolve_expr(_to_expr(cond), self._plan),
                                   self._plan), self._session)
 
     where = filter
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        """SQL expression strings as a projection (pyspark selectExpr)."""
+        from .sqlparser import Star, parse_select_item
+        cols: List[Any] = []
+        for s in exprs:
+            item = parse_select_item(s)
+            if isinstance(item.expr, Star):
+                if item.expr.qualifier is not None:
+                    raise ValueError(
+                        "qualified '*' is only valid inside session.sql")
+                cols.extend(self._plan.output)
+            elif item.alias:
+                cols.append(Column(Alias(item.expr, item.alias)))
+            else:
+                cols.append(Column(item.expr))
+        return self.select(*cols)
+
+    def createOrReplaceTempView(self, name: str) -> None:
+        """Register this frame in the session catalog for session.sql."""
+        self._session._temp_views[name.lower()] = self
+
+    def createTempView(self, name: str) -> None:
+        if name.lower() in self._session._temp_views:
+            raise ValueError(f"temp view {name!r} already exists")
+        self._session._temp_views[name.lower()] = self
 
     def groupBy(self, *cols) -> "GroupedData":
         exprs = tuple(self._resolve(c) for c in cols)

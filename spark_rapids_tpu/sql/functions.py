@@ -156,8 +156,11 @@ def when(cond: Column, value) -> _WhenColumn:
     return _WhenColumn([(_c(cond), _to_expr(value))])
 
 
-def expr(sql: str):
-    raise NotImplementedError("SQL expression strings are not yet supported")
+def expr(sql: str) -> Column:
+    """SQL expression string -> Column (the Catalyst-parser analog;
+    `sqlparser.py`)."""
+    from .sqlparser import parse_expr
+    return parse_expr(sql)
 
 
 # hash

@@ -37,6 +37,7 @@ class TpuSession:
         self._conf = base.copy(conf_kwargs or None)
         self.conf = SessionConf(self._conf)
         self.last_query_metrics: dict = {}
+        self._temp_views: dict = {}
 
     # ------------------------------------------------------------------
     @classmethod
@@ -71,6 +72,25 @@ class TpuSession:
     @property
     def read(self) -> "DataFrameReader":
         return DataFrameReader(self)
+
+    # ------------------------------------------------------------------
+    # SQL surface (Catalyst-parser analog; sqlparser.py)
+    # ------------------------------------------------------------------
+    def sql(self, query: str) -> DataFrame:
+        """Run a SQL query over registered temp views — the same planning
+        and execution path as the DataFrame API."""
+        from .sqlparser import parse_query
+        return parse_query(self, query)
+
+    def table(self, name: str) -> DataFrame:
+        view = self._temp_views.get(name.lower())
+        if view is None:
+            raise ValueError(f"table or view not found: {name}")
+        return DataFrame(view._plan, self)
+
+    @property
+    def catalog(self) -> "Catalog":
+        return Catalog(self)
 
     # ------------------------------------------------------------------
     # execution
@@ -252,6 +272,10 @@ def _to_arrow_table(data, schema) -> pa.Table:
     if isinstance(data, list):
         if schema is None:
             raise ValueError("schema required for list-of-rows input")
+        if isinstance(schema, str):
+            # DDL string 'name type, name type' (pyspark createDataFrame)
+            from .dataframe import _to_struct_type
+            schema = _to_struct_type(schema)
         if isinstance(schema, (list, tuple)):
             names = list(schema)
             cols = list(zip(*data)) if data else [[] for _ in names]
@@ -276,3 +300,19 @@ def _split_table(table: pa.Table, n: int) -> List[pa.Table]:
         hi = min(lo + per, rows)
         parts.append(table.slice(lo, hi - lo))
     return parts
+
+
+class Catalog:
+    """Minimal pyspark-Catalog surface over the session's temp views."""
+
+    def __init__(self, session: TpuSession):
+        self._session = session
+
+    def listTables(self) -> List[str]:
+        return sorted(self._session._temp_views)
+
+    def tableExists(self, name: str) -> bool:
+        return name.lower() in self._session._temp_views
+
+    def dropTempView(self, name: str) -> bool:
+        return self._session._temp_views.pop(name.lower(), None) is not None

@@ -1,0 +1,1496 @@
+"""SQL front-end: text -> logical plans over the existing algebra.
+
+The reference accelerates Spark SQL transparently — every query surface
+(``spark.sql(...)``, ``df.filter("a > 1")``, ``selectExpr``) is SQL text
+compiled by Catalyst before the plugin ever sees a physical plan
+(SURVEY §1 user-visible API; ``Plugin.scala:46-53`` hooks run *after* SQL
+parsing).  Standalone, we own that parsing step too: this module is the
+Catalyst-parser equivalent, a recursive-descent SQL parser producing the
+same ``Column``/``LogicalPlan`` objects the DataFrame API builds, so SQL
+text and DataFrame calls share one planning/execution path.
+
+Scope: SELECT [DISTINCT] with expressions/functions/CASE/CAST/window
+functions, FROM with joins (INNER/LEFT/RIGHT/FULL/SEMI/ANTI/CROSS, ON and
+USING), WHERE, GROUP BY (exprs/ordinals/aliases), HAVING, ORDER BY
+(exprs/ordinals/aliases, ASC/DESC, NULLS FIRST/LAST), LIMIT/OFFSET,
+UNION [ALL]/EXCEPT/INTERSECT, WITH ctes, subqueries in FROM, temp views,
+and direct file relations (``parquet.`/path/to/file```).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from .expressions.aggregates import AggregateExpression, AggregateFunction
+from .expressions.core import Alias, AttributeReference, Expression, Literal
+from .expressions.windows import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
+                                  UNBOUNDED_PRECEDING, WindowFrame,
+                                  WindowSpecDefinition, WindowExpression,
+                                  WindowFunction)
+from .plan import SortOrder
+
+
+# --------------------------------------------------------------------------
+# Lexer
+# --------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<num>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?[dDlLfF]?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qident>`[^`]*`|"[^"]*")
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<op><=>|==|!=|<>|<=|>=|\|\||<<|>>>|>>|[-+*/%(),.<>=&|^~])
+""", re.VERBOSE)
+
+
+@dataclass
+class Tok:
+    kind: str   # num|str|ident|qident|op|eof
+    text: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.text.upper()
+
+
+def tokenize(sql: str) -> List[Tok]:
+    out: List[Tok] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if m is None:
+            raise SqlParseError(f"unexpected character {sql[i]!r} at {i} in {sql!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        out.append(Tok(kind, m.group(), m.start()))
+    out.append(Tok("eof", "", len(sql)))
+    return out
+
+
+class SqlParseError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# AST for statements (expressions become live Expression trees immediately)
+# --------------------------------------------------------------------------
+
+@dataclass
+class Star:
+    qualifier: Optional[str] = None
+
+
+@dataclass
+class SelectItem:
+    expr: Any           # Expression | Star
+    alias: Optional[str] = None
+
+
+@dataclass
+class TableRef:
+    name: str                       # view/table name, or format for files
+    alias: Optional[str] = None
+    path: Optional[str] = None      # direct file relation
+
+
+@dataclass
+class SubqueryRef:
+    stmt: "Any"
+    alias: Optional[str] = None
+
+
+@dataclass
+class JoinStep:
+    how: str
+    right: Any                      # TableRef | SubqueryRef
+    on: Optional[Expression] = None
+    using: Optional[List[str]] = None
+
+
+@dataclass
+class OrderItem:
+    expr: Any                       # Expression | int (ordinal)
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass
+class SelectStmt:
+    items: List[SelectItem] = field(default_factory=list)
+    distinct: bool = False
+    from_: Optional[Any] = None     # TableRef | SubqueryRef
+    joins: List[JoinStep] = field(default_factory=list)
+    where: Optional[Expression] = None
+    group_by: List[Any] = field(default_factory=list)   # Expression | int
+    having: Optional[Expression] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: Dict[str, "Any"] = field(default_factory=dict)
+
+
+@dataclass
+class SetOpStmt:
+    op: str                         # union|except|intersect
+    all: bool
+    left: Any
+    right: Any
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: Dict[str, "Any"] = field(default_factory=dict)
+
+
+class UnresolvedQualified(Expression):
+    """``t.a`` — bound to the aliased relation's attribute by the builder.
+    Never reaches execution; data_type raises to catch leaks.  Marked
+    ``_unresolved`` so the analyzer-lite coercion defers until binding
+    (outside session.sql, ``_resolve_expr`` falls back to by-name
+    resolution, pyspark ``expr("t.a")`` style)."""
+
+    children: Tuple[Expression, ...] = ()
+    _unresolved = True
+
+    def __init__(self, qualifier: str, name: str):
+        self.qualifier = qualifier
+        self.name = name
+
+    @property
+    def data_type(self):
+        raise SqlParseError(
+            f"unresolved qualified reference {self.qualifier}.{self.name} "
+            "(qualified names are only valid inside session.sql queries)")
+
+    def sql(self) -> str:
+        return f"{self.qualifier}.{self.name}"
+
+    def with_children(self, children):
+        return self
+
+    def _key_extras(self):
+        return (self.qualifier, self.name)
+
+
+# --------------------------------------------------------------------------
+# Function registry: SQL name -> callable over Columns
+# --------------------------------------------------------------------------
+
+#: public helpers in functions.py that are NOT SQL functions (constructors,
+#: decorators, sort helpers) — calling them with SQL args would crash with
+#: confusing internal errors instead of "unknown SQL function"
+_NON_SQL_FUNCTIONS = {
+    "col", "column", "lit", "expr", "expr_fn", "when", "udf", "pandas_udf",
+    "device_udf", "broadcast", "asc", "desc", "window",
+}
+
+
+def _function_table():
+    from . import functions as F
+    tbl: Dict[str, Any] = {}
+    for name in dir(F):
+        if name.startswith("_") or name in _NON_SQL_FUNCTIONS:
+            continue
+        fn = getattr(F, name)
+        # only functions DEFINED in functions.py — dir() also surfaces its
+        # imports (e.g. typing.Optional), which are not SQL functions
+        if callable(fn) and not isinstance(fn, type) and \
+                getattr(fn, "__module__", None) == F.__name__:
+            tbl[name.lower()] = fn
+    # SQL spellings that differ from the pyspark function names
+    alias = {
+        "power": "pow", "ceiling": "ceil", "ln": "log", "ucase": "upper",
+        "lcase": "lower", "char_length": "length",
+        "character_length": "length", "sign": "signum",
+        "day": "dayofmonth", "position": "locate", "ifnull": "nvl",
+        "regexp_like": "rlike", "std": "stddev",
+        "approx_percentile": "percentile_approx",
+        "array_agg": "collect_list",
+    }
+    for sql_name, py_name in alias.items():
+        fn = tbl.get(py_name.lower())
+        if fn is not None:
+            tbl[sql_name] = fn
+    return tbl
+
+
+#: argument positions that are plain python values in the pyspark function
+#: signatures (format strings, pad chars, counts...) — a parsed Literal in
+#: one of these positions is unwrapped to its raw value before the call.
+_LITERAL_POS: Dict[str, set] = {
+    "substring_index": {1, 2}, "instr": {1}, "translate": {1, 2},
+    "repeat": {1}, "lpad": {1, 2}, "rpad": {1, 2}, "trim": {1},
+    "ltrim": {1}, "rtrim": {1}, "format_number": {1}, "conv": {1, 2},
+    "round": {1}, "bround": {1}, "shiftleft": {1}, "shiftright": {1},
+    "shiftrightunsigned": {1}, "rlike": {1}, "regexp_like": {1},
+    "regexp_replace": {1, 2}, "regexp_extract": {1, 2},
+    "regexp_extract_all": {1, 2}, "split": {1, 2}, "str_to_map": {1, 2},
+    "get_json_object": {1}, "json_tuple": {1, 2, 3, 4, 5, 6, 7, 8},
+    "date_format": {1}, "trunc": {1}, "from_unixtime": {1},
+    "unix_timestamp": {1}, "to_unix_timestamp": {1}, "to_timestamp": {1},
+    "months_between": {2}, "from_utc_timestamp": {1}, "lead": {1, 2},
+    "lag": {1, 2}, "nth_value": {1, 2}, "ntile": {0}, "first": {1},
+    "last": {1}, "sort_array": {1}, "like": {1, 2},
+    "locate": {0, 2}, "position": {0, 2}, "concat_ws": {0},
+    "slice": {1, 2}, "percentile_approx": {1, 2},
+    "approx_count_distinct": {1},
+}
+
+
+_FN_TABLE = None
+
+
+def _functions():
+    global _FN_TABLE
+    if _FN_TABLE is None:
+        _FN_TABLE = _function_table()
+    return _FN_TABLE
+
+
+def _parse_type_tokens(p: "Parser") -> T.DataType:
+    name = p.expect_ident().lower()
+    if name in ("decimal", "dec", "numeric"):
+        prec, scale = 10, 0
+        if p.accept_op("("):
+            prec = p.expect_int()
+            if p.accept_op(","):
+                scale = p.expect_int()
+            p.expect_op(")")
+        return T.DecimalType(prec, scale)
+    from .dataframe import _parse_type
+    return _parse_type(name)
+
+
+# --------------------------------------------------------------------------
+# Parser
+# --------------------------------------------------------------------------
+
+_RESERVED_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
+    "EXCEPT", "INTERSECT", "MINUS", "JOIN", "INNER", "LEFT", "RIGHT", "FULL",
+    "CROSS", "ON", "USING", "AS", "WHEN", "THEN", "ELSE", "END", "AND", "OR",
+    "NOT", "IS", "IN", "BETWEEN", "LIKE", "RLIKE", "ASC", "DESC", "NULLS",
+    "BY", "SELECT", "DISTINCT", "ALL", "WITH", "OVER", "PARTITION", "ROWS",
+    "RANGE", "PRECEDING", "FOLLOWING", "CURRENT", "UNBOUNDED", "SEMI", "ANTI",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # --- token helpers ----------------------------------------------------
+    def peek(self, ahead: int = 0) -> Tok:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in kws
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise SqlParseError(
+                f"expected {kw} at {self.peek().pos} in {self.sql!r}, "
+                f"got {self.peek().text!r}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.text in ops
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise SqlParseError(
+                f"expected {op!r} at {self.peek().pos} in {self.sql!r}, "
+                f"got {self.peek().text!r}")
+
+    def expect_kind(self, kind: str) -> Tok:
+        t = self.peek()
+        if t.kind != kind:
+            raise SqlParseError(
+                f"expected {kind} at {t.pos} in {self.sql!r}, got {t.text!r}")
+        return self.next()
+
+    def expect_ident(self) -> str:
+        t = self.peek()
+        if t.kind == "ident":
+            return self.next().text
+        if t.kind == "qident":
+            return self.next().text[1:-1]
+        raise SqlParseError(
+            f"expected identifier at {t.pos} in {self.sql!r}, got {t.text!r}")
+
+    def expect_int(self) -> int:
+        t = self.expect_kind("num")
+        if not t.text.isdigit():
+            raise SqlParseError(
+                f"expected an integer at {t.pos} in {self.sql!r}, "
+                f"got {t.text!r}")
+        return int(t.text)
+
+    # --- expressions ------------------------------------------------------
+    def parse_expression(self) -> Expression:
+        return self._or()
+
+    def _or(self) -> Expression:
+        from .expressions.predicates import Or
+        e = self._and()
+        while self.accept_kw("OR"):
+            e = Or(e, self._and())
+        return e
+
+    def _and(self) -> Expression:
+        from .expressions.predicates import And
+        e = self._not()
+        while self.accept_kw("AND"):
+            e = And(e, self._not())
+        return e
+
+    def _not(self) -> Expression:
+        from .expressions.predicates import Not
+        if self.accept_kw("NOT"):
+            return Not(self._not())
+        return self._predicate()
+
+    def _predicate(self) -> Expression:
+        from .expressions import predicates as PR
+        from .expressions import strings as STR
+        from .expressions import regexp as RXE
+        e = self._comparison()
+        while True:
+            negate = False
+            save = self.i
+            if self.accept_kw("NOT"):
+                negate = True
+            if self.accept_kw("BETWEEN"):
+                lo = self._comparison()
+                self.expect_kw("AND")
+                hi = self._comparison()
+                e2 = PR.And(self._cmp(PR.GreaterThanOrEqual, e, lo),
+                            self._cmp(PR.LessThanOrEqual, e, hi))
+            elif self.accept_kw("IN"):
+                self.expect_op("(")
+                vals = [self.parse_expression()]
+                while self.accept_op(","):
+                    vals.append(self.parse_expression())
+                self.expect_op(")")
+                e2 = PR.In(e, tuple(vals))
+            elif self.accept_kw("LIKE"):
+                pat = self._comparison()
+                if not isinstance(pat, Literal):
+                    raise SqlParseError("LIKE pattern must be a literal")
+                e2 = STR.Like(e, pat)
+            elif self.accept_kw("RLIKE", "REGEXP"):
+                pat = self._comparison()
+                if not isinstance(pat, Literal):
+                    raise SqlParseError("RLIKE pattern must be a literal")
+                e2 = RXE.RLike(e, pat.value)
+            elif self.accept_kw("IS"):
+                neg2 = self.accept_kw("NOT")
+                if self.accept_kw("NULL"):
+                    e2 = PR.IsNull(e)
+                elif self.accept_kw("DISTINCT"):
+                    self.expect_kw("FROM")
+                    rhs = self._comparison()
+                    e2 = PR.Not(PR.EqualNullSafe(e, rhs))
+                elif self.accept_kw("TRUE"):
+                    e2 = PR.EqualNullSafe(e, Literal(True))
+                elif self.accept_kw("FALSE"):
+                    e2 = PR.EqualNullSafe(e, Literal(False))
+                else:
+                    raise SqlParseError(
+                        f"expected NULL/TRUE/FALSE/DISTINCT after IS at "
+                        f"{self.peek().pos}")
+                if neg2:
+                    e2 = PR.Not(e2)
+                if negate:
+                    raise SqlParseError("NOT IS is not valid SQL")
+                e = e2
+                continue
+            else:
+                self.i = save
+                return e
+            e = PR.Not(e2) if negate else e2
+
+    @staticmethod
+    def _cmp(cls, a: Expression, b: Expression) -> Expression:
+        from .dataframe import _coerce_pair
+        a, b = _coerce_pair(a, b)
+        return cls(a, b)
+
+    def _comparison(self) -> Expression:
+        from .expressions import predicates as PR
+        e = self._bitor()
+        ops = {"=": PR.EqualTo, "==": PR.EqualTo, "<": PR.LessThan,
+               "<=": PR.LessThanOrEqual, ">": PR.GreaterThan,
+               ">=": PR.GreaterThanOrEqual, "<=>": PR.EqualNullSafe}
+        t = self.peek()
+        if t.kind == "op" and t.text in ops:
+            self.next()
+            rhs = self._bitor()
+            return self._cmp(ops[t.text], e, rhs)
+        if t.kind == "op" and t.text in ("!=", "<>"):
+            self.next()
+            rhs = self._bitor()
+            return PR.Not(self._cmp(PR.EqualTo, e, rhs))
+        return e
+
+    # value-operator precedence, tightest to loosest (Spark SqlBase.g4):
+    #   *,/,%,DIV > +,- > || > <<,>>,>>> > & > ^ > |
+    def _bitor(self) -> Expression:
+        from .expressions import arithmetic as A
+        e = self._bitxor()
+        while self.accept_op("|"):
+            e = self._arith(A.BitwiseOr, e, self._bitxor())
+        return e
+
+    def _bitxor(self) -> Expression:
+        from .expressions import arithmetic as A
+        e = self._bitand()
+        while self.accept_op("^"):
+            e = self._arith(A.BitwiseXor, e, self._bitand())
+        return e
+
+    def _bitand(self) -> Expression:
+        from .expressions import arithmetic as A
+        e = self._shift()
+        while self.accept_op("&"):
+            e = self._arith(A.BitwiseAnd, e, self._shift())
+        return e
+
+    def _shift(self) -> Expression:
+        from .expressions import arithmetic as A
+        e = self._concat()
+        while True:
+            if self.accept_op("<<"):
+                e = A.ShiftLeft(e, self._concat())
+            elif self.accept_op(">>>"):
+                e = A.ShiftRightUnsigned(e, self._concat())
+            elif self.accept_op(">>"):
+                e = A.ShiftRight(e, self._concat())
+            else:
+                return e
+
+    def _concat(self) -> Expression:
+        from .expressions import strings as STR
+        e = self._additive()
+        while self.accept_op("||"):
+            e = STR.Concat(_as_string(e), _as_string(self._additive()))
+        return e
+
+    def _additive(self) -> Expression:
+        from .expressions import arithmetic as A
+        e = self._multiplicative()
+        while True:
+            if self.accept_op("+"):
+                e = self._arith(A.Add, e, self._multiplicative())
+            elif self.accept_op("-"):
+                e = self._arith(A.Subtract, e, self._multiplicative())
+            else:
+                return e
+
+    @staticmethod
+    def _arith(cls, a: Expression, b: Expression) -> Expression:
+        from .dataframe import _coerce_pair
+        a, b = _coerce_pair(a, b)
+        return cls(a, b)
+
+    def _multiplicative(self) -> Expression:
+        from .expressions import arithmetic as A
+        e = self._unary()
+        while True:
+            if self.accept_op("*"):
+                e = self._arith(A.Multiply, e, self._unary())
+            elif self.accept_op("/"):
+                e = self._arith(A.Divide, e, self._unary())
+            elif self.accept_op("%"):
+                e = self._arith(A.Remainder, e, self._unary())
+            elif self.at_kw("DIV"):
+                self.next()
+                e = self._arith(A.IntegralDivide, e, self._unary())
+            else:
+                return e
+
+    def _unary(self) -> Expression:
+        from .expressions import arithmetic as A
+        if self.accept_op("-"):
+            child = self._unary()
+            if isinstance(child, Literal) and isinstance(
+                    child.value, (int, float)) and not isinstance(
+                    child.value, bool):
+                return Literal(-child.value, child.dtype)
+            return A.UnaryMinus(child)
+        if self.accept_op("+"):
+            return self._unary()
+        if self.accept_op("~"):
+            return A.BitwiseNot(self._unary())
+        return self._primary()
+
+    def _primary(self) -> Expression:
+        from . import functions as F
+        t = self.peek()
+        if t.kind == "num":
+            return self._number(self.next().text)
+        if t.kind == "str":
+            self.next()
+            return Literal(t.text[1:-1].replace("''", "'"))
+        if self.accept_op("("):
+            e = self.parse_expression()
+            self.expect_op(")")
+            return e
+        if t.kind == "op" and t.text == "*":
+            self.next()
+            return Star()           # only valid in select-list / count(*)
+        if t.kind in ("ident", "qident"):
+            up = t.upper
+            if up == "NULL" and t.kind == "ident":
+                self.next()
+                return Literal(None)
+            if up in ("TRUE", "FALSE") and t.kind == "ident":
+                self.next()
+                return Literal(up == "TRUE")
+            if up == "CAST" and t.kind == "ident" and \
+                    self.peek(1).kind == "op" and self.peek(1).text == "(":
+                return self._cast()
+            if up == "CASE" and t.kind == "ident":
+                return self._case()
+            if up == "INTERVAL" and t.kind == "ident":
+                raise SqlParseError("INTERVAL literals are not supported; "
+                                    "use date_add/add_months functions")
+            name = self.expect_ident()
+            # function call?
+            if self.at_op("(") and t.kind == "ident":
+                return self._call(name)
+            # qualified: t.a, t.*
+            if self.accept_op("."):
+                if self.accept_op("*"):
+                    return Star(qualifier=name)
+                sub = self.expect_ident()
+                return UnresolvedQualified(name, sub)
+            return F.col(name).expr
+        raise SqlParseError(
+            f"unexpected token {t.text!r} at {t.pos} in {self.sql!r}")
+
+    @staticmethod
+    def _number(text: str) -> Literal:
+        suffix = text[-1] if text[-1] in "dDlLfF" else ""
+        if suffix:
+            text = text[:-1]
+        if (suffix and suffix in "dDfF") or "." in text \
+                or "e" in text or "E" in text:
+            return Literal(float(text))
+        if suffix:                      # 42L — explicit bigint
+            return Literal(int(text), T.LONG)
+        return Literal(int(text))
+
+    def _cast(self) -> Expression:
+        from .expressions.cast import Cast
+        self.next()             # CAST
+        self.expect_op("(")
+        e = self.parse_expression()
+        self.expect_kw("AS")
+        dt = _parse_type_tokens(self)
+        self.expect_op(")")
+        return Cast(e, dt)
+
+    def _case(self) -> Expression:
+        from .expressions.conditional import CaseWhen
+        from .expressions import predicates as PR
+        self.next()             # CASE
+        subject = None
+        if not self.at_kw("WHEN"):
+            subject = self.parse_expression()
+        branches = []
+        while self.accept_kw("WHEN"):
+            cond = self.parse_expression()
+            if subject is not None:
+                cond = self._cmp(PR.EqualTo, subject, cond)
+            self.expect_kw("THEN")
+            branches.append((cond, self.parse_expression()))
+        else_v = None
+        if self.accept_kw("ELSE"):
+            else_v = self.parse_expression()
+        self.expect_kw("END")
+        return CaseWhen(branches, else_v)
+
+    def _call(self, name: str) -> Expression:
+        from . import functions as F
+        from .dataframe import Column
+        from .expressions.aggregates import (AggregateExpression, Average,
+                                             Count, Max, Min, Sum)
+        self.expect_op("(")
+        lname = name.lower()
+        distinct = False
+        if self.accept_kw("DISTINCT"):
+            distinct = True
+        args: List[Expression] = []
+        if not self.at_op(")"):
+            args.append(self.parse_expression())
+            while self.accept_op(","):
+                args.append(self.parse_expression())
+        self.expect_op(")")
+
+        if lname == "count" and len(args) == 1 and isinstance(args[0], Star):
+            if distinct:
+                raise SqlParseError("count(DISTINCT *) is not supported")
+            e: Expression = Count()
+        elif lname == "count" and distinct:
+            e = AggregateExpression(Count(*args), is_distinct=True)
+        elif distinct and lname in ("sum", "avg", "mean", "min", "max"):
+            base = {"sum": Sum, "avg": Average, "mean": Average,
+                    "min": Min, "max": Max}[lname](args[0])
+            e = AggregateExpression(base, is_distinct=True)
+        elif lname in ("if", "iff"):
+            from .expressions.conditional import If
+            if len(args) != 3:
+                raise SqlParseError("if() takes exactly 3 arguments")
+            e = If(args[0], args[1], args[2])
+        elif lname == "nullif":
+            from .expressions.conditional import CaseWhen
+            from .expressions import predicates as PR
+            e = CaseWhen([(self._cmp(PR.EqualTo, args[0], args[1]),
+                           Literal(None))], args[0])
+        else:
+            fn = _functions().get(lname)
+            if fn is None:
+                raise SqlParseError(f"unknown SQL function {name!r}")
+            if distinct:
+                raise SqlParseError(
+                    f"DISTINCT is not supported inside {name}()")
+            unwrap = _LITERAL_POS.get(lname, ())
+            call_args: List[Any] = []
+            for idx, a in enumerate(args):
+                if idx in unwrap and isinstance(a, Literal):
+                    call_args.append(a.value)
+                else:
+                    call_args.append(Column(a))
+            res = fn(*call_args)
+            e = res.expr if isinstance(res, Column) else res
+        if self.at_kw("OVER"):
+            e = self._over(e)
+        return e
+
+    def _over(self, fn_expr: Expression) -> Expression:
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition: List[Expression] = []
+        orders: List[SortOrder] = []
+        frame = None
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self.parse_expression())
+            while self.accept_op(","):
+                partition.append(self.parse_expression())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            orders.append(self._sort_order())
+            while self.accept_op(","):
+                orders.append(self._sort_order())
+        if self.at_kw("ROWS", "RANGE"):
+            mode = self.next().text.lower()
+            self.expect_kw("BETWEEN")
+            lo = self._frame_bound()
+            self.expect_kw("AND")
+            hi = self._frame_bound()
+            frame = WindowFrame(mode, lo, hi)
+        self.expect_op(")")
+        spec = WindowSpecDefinition(tuple(partition), tuple(orders), frame)
+        if isinstance(fn_expr, Alias):
+            return Alias(WindowExpression(fn_expr.child, spec), fn_expr.name)
+        return WindowExpression(fn_expr, spec)
+
+    def _frame_bound(self) -> int:
+        if self.accept_kw("UNBOUNDED"):
+            if self.accept_kw("PRECEDING"):
+                return UNBOUNDED_PRECEDING
+            self.expect_kw("FOLLOWING")
+            return UNBOUNDED_FOLLOWING
+        if self.accept_kw("CURRENT"):
+            self.expect_kw("ROW")
+            return CURRENT_ROW
+        sign = -1 if self.accept_op("-") else 1
+        n = self.expect_int() * sign
+        if self.accept_kw("PRECEDING"):
+            return -n
+        self.expect_kw("FOLLOWING")
+        return n
+
+    def _sort_order(self) -> SortOrder:
+        e = self.parse_expression()
+        asc = True
+        if self.accept_kw("ASC"):
+            asc = True
+        elif self.accept_kw("DESC"):
+            asc = False
+        nulls_first = None
+        if self.accept_kw("NULLS"):
+            if self.accept_kw("FIRST"):
+                nulls_first = True
+            else:
+                self.expect_kw("LAST")
+                nulls_first = False
+        return SortOrder(e, asc, nulls_first)
+
+    # --- statements -------------------------------------------------------
+    def parse_statement(self):
+        ctes: Dict[str, Any] = {}
+        if self.accept_kw("WITH"):
+            while True:
+                name = self.expect_ident()
+                self.expect_kw("AS")
+                self.expect_op("(")
+                sub = self._query_term(ctes)
+                self.expect_op(")")
+                ctes[name.lower()] = sub
+                if not self.accept_op(","):
+                    break
+        stmt = self._query_term(ctes)
+        stmt.ctes = ctes
+        tail = self.peek()
+        if tail.kind != "eof":
+            raise SqlParseError(
+                f"unexpected trailing input {tail.text!r} at {tail.pos} "
+                f"in {self.sql!r}")
+        return stmt
+
+    def _set_op_modifier(self) -> bool:
+        is_all = self.accept_kw("ALL")
+        if self.accept_kw("DISTINCT") and is_all:
+            raise SqlParseError("cannot combine ALL and DISTINCT in a "
+                                "set operation")
+        return is_all
+
+    def _query_term(self, ctes) -> Any:
+        # INTERSECT binds tighter than UNION/EXCEPT (SQL standard)
+        left = self._intersect_term(ctes)
+        while self.at_kw("UNION", "EXCEPT", "MINUS"):
+            op = self.next().upper
+            if op == "MINUS":
+                op = "EXCEPT"
+            is_all = self._set_op_modifier()
+            right = self._intersect_term(ctes)
+            left = SetOpStmt(op.lower(), is_all, left, right)
+        # ORDER BY / LIMIT terminate the whole query term (a set-op branch
+        # cannot carry its own trailing clauses without parentheses)
+        ob = self._order_by_clause()
+        lim, off = self._limit_clause()
+        if ob:
+            if left.order_by:
+                raise SqlParseError("multiple ORDER BY clauses")
+            left.order_by = ob
+        if lim is not None or off is not None:
+            if left.limit is not None or left.offset is not None:
+                raise SqlParseError("multiple LIMIT/OFFSET clauses")
+            left.limit, left.offset = lim, off
+        return left
+
+    def _intersect_term(self, ctes) -> Any:
+        left = self._query_primary(ctes)
+        while self.at_kw("INTERSECT"):
+            self.next()
+            is_all = self._set_op_modifier()
+            right = self._query_primary(ctes)
+            left = SetOpStmt("intersect", is_all, left, right)
+        return left
+
+    def _query_primary(self, ctes) -> Any:
+        if self.accept_op("("):
+            q = self._query_term(ctes)
+            self.expect_op(")")
+            return q
+        return self._select(ctes)
+
+    def _select(self, ctes) -> SelectStmt:
+        self.expect_kw("SELECT")
+        stmt = SelectStmt()
+        if self.accept_kw("DISTINCT"):
+            stmt.distinct = True
+        else:
+            self.accept_kw("ALL")
+        stmt.items.append(self._select_item())
+        while self.accept_op(","):
+            stmt.items.append(self._select_item())
+        if self.accept_kw("FROM"):
+            stmt.from_ = self._table_ref(ctes)
+            while True:
+                step = self._join_step(ctes)
+                if step is None:
+                    break
+                stmt.joins.append(step)
+        if self.accept_kw("WHERE"):
+            stmt.where = self.parse_expression()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            stmt.group_by.append(self._group_item())
+            while self.accept_op(","):
+                stmt.group_by.append(self._group_item())
+        if self.accept_kw("HAVING"):
+            stmt.having = self.parse_expression()
+        # ORDER BY / LIMIT are parsed at the query-term level so they bind
+        # to a whole set-operation result, never to its last branch
+        return stmt
+
+    def _group_item(self):
+        t = self.peek()
+        if t.kind == "num" and t.text.isdigit():
+            self.next()
+            return int(t.text)
+        return self.parse_expression()
+
+    def _order_by_clause(self) -> List[OrderItem]:
+        out: List[OrderItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                t = self.peek()
+                if t.kind == "num" and t.text.isdigit():
+                    self.next()
+                    e: Any = int(t.text)
+                else:
+                    e = self.parse_expression()
+                asc = True
+                if self.accept_kw("DESC"):
+                    asc = False
+                else:
+                    self.accept_kw("ASC")
+                nf = None
+                if self.accept_kw("NULLS"):
+                    if self.accept_kw("FIRST"):
+                        nf = True
+                    else:
+                        self.expect_kw("LAST")
+                        nf = False
+                out.append(OrderItem(e, asc, nf))
+                if not self.accept_op(","):
+                    break
+        return out
+
+    def _limit_clause(self) -> Tuple[Optional[int], Optional[int]]:
+        limit = offset = None
+        if self.accept_kw("LIMIT"):
+            if self.accept_kw("ALL"):
+                limit = None
+            else:
+                limit = self.expect_int()
+        if self.accept_kw("OFFSET"):
+            offset = self.expect_int()
+        return limit, offset
+
+    def _select_item(self) -> SelectItem:
+        e = self.parse_expression()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.expect_ident()
+        elif (self.peek().kind == "qident"
+              or (self.peek().kind == "ident"
+                  and self.peek().upper not in _RESERVED_STOP)):
+            alias = self.expect_ident()
+        return SelectItem(e, alias)
+
+    def _table_ref(self, ctes) -> Any:
+        if self.accept_op("("):
+            q = self._query_term(ctes)
+            self.expect_op(")")
+            alias = self._table_alias()
+            return SubqueryRef(q, alias)
+        name = self.expect_ident()
+        # direct file relation: parquet.`/path`
+        if name.lower() in ("parquet", "orc", "csv", "json", "avro") and \
+                self.at_op(".") and self.peek(1).kind == "qident":
+            self.next()
+            path = self.expect_ident()
+            return TableRef(name.lower(), self._table_alias(), path=path)
+        return TableRef(name, self._table_alias())
+
+    def _table_alias(self) -> Optional[str]:
+        if self.accept_kw("AS"):
+            return self.expect_ident()
+        t = self.peek()
+        if t.kind == "qident" or (t.kind == "ident"
+                                  and t.upper not in _RESERVED_STOP):
+            return self.expect_ident()
+        return None
+
+    def _join_step(self, ctes) -> Optional[JoinStep]:
+        how = None
+        if self.accept_op(","):
+            how = "cross"
+        elif self.at_kw("JOIN"):
+            self.next()
+            how = "inner"
+        elif self.at_kw("INNER") and self.peek(1).upper == "JOIN":
+            self.next(); self.next()
+            how = "inner"
+        elif self.at_kw("CROSS") and self.peek(1).upper == "JOIN":
+            self.next(); self.next()
+            how = "cross"
+        elif self.at_kw("LEFT", "RIGHT", "FULL"):
+            side = self.next().upper.lower()
+            if self.accept_kw("OUTER"):
+                pass
+            elif side == "left" and self.accept_kw("SEMI"):
+                side = "left_semi"
+            elif side == "left" and self.accept_kw("ANTI"):
+                side = "left_anti"
+            self.expect_kw("JOIN")
+            how = {"full": "full"}.get(side, side)
+        if how is None:
+            return None
+        right = self._table_ref(ctes)
+        on = None
+        using = None
+        if self.accept_kw("ON"):
+            on = self.parse_expression()
+        elif self.accept_kw("USING"):
+            self.expect_op("(")
+            using = [self.expect_ident()]
+            while self.accept_op(","):
+                using.append(self.expect_ident())
+            self.expect_op(")")
+        return JoinStep(how, right, on, using)
+
+
+# --------------------------------------------------------------------------
+# Public expression-string entry points
+# --------------------------------------------------------------------------
+
+def parse_expr(sql: str):
+    """``F.expr("...")`` — expression string to a Column (plain column
+    names stay unresolved, resolved later against the target frame)."""
+    from .dataframe import Column
+    p = Parser(sql)
+    e = p.parse_expression()
+    alias = None
+    if p.accept_kw("AS"):
+        alias = p.expect_ident()
+    tail = p.peek()
+    if tail.kind != "eof":
+        raise SqlParseError(
+            f"unexpected trailing input {tail.text!r} in expression "
+            f"{sql!r}")
+    if isinstance(e, Star):
+        raise SqlParseError("'*' is only valid in a select list")
+    if alias:
+        e = Alias(e, alias)
+    return Column(e)
+
+
+def parse_select_item(sql: str):
+    """One selectExpr entry: expression with optional alias, or '*'."""
+    p = Parser(sql)
+    item = p._select_item()
+    tail = p.peek()
+    if tail.kind != "eof":
+        raise SqlParseError(
+            f"unexpected trailing input {tail.text!r} in {sql!r}")
+    return item
+
+
+# --------------------------------------------------------------------------
+# Query builder: statement AST -> DataFrame
+# --------------------------------------------------------------------------
+
+class QueryBuilder:
+    """Builds DataFrames from parsed statements against a session's
+    temp-view catalog (the Catalyst analyzer+planner front half)."""
+
+    def __init__(self, session):
+        self.session = session
+        self._subq = 0
+
+    # --- entry ------------------------------------------------------------
+    def build(self, stmt, outer_ctes: Optional[Dict[str, Any]] = None):
+        ctes = dict(outer_ctes or {})
+        ctes.update({k: ("stmt", v) for k, v in stmt.ctes.items()})
+        if isinstance(stmt, SetOpStmt):
+            return self._build_setop(stmt, ctes)
+        return self._build_select(stmt, ctes)
+
+    def _build_setop(self, stmt: SetOpStmt, ctes):
+        left = self._build_sub(stmt.left, ctes)
+        right = self._build_sub(stmt.right, ctes)
+        if stmt.op == "union":
+            df = left.union(right)
+            if not stmt.all:
+                df = df.distinct()
+        elif stmt.op == "intersect":
+            df = left.intersectAll(right) if stmt.all else \
+                left.intersect(right)
+        else:
+            df = left.exceptAll(right) if stmt.all else left.subtract(right)
+        df = self._apply_order_limit(df, stmt.order_by, stmt.limit,
+                                     stmt.offset, items=None)
+        return df
+
+    def _build_sub(self, stmt, ctes):
+        if isinstance(stmt, SetOpStmt):
+            return self._build_setop(stmt, ctes)
+        return self._build_select(stmt, ctes)
+
+    # --- FROM -------------------------------------------------------------
+    def _resolve_relation(self, ref, ctes):
+        from .dataframe import DataFrame
+        if isinstance(ref, SubqueryRef):
+            df = self._build_sub(ref.stmt, ctes)
+            self._subq += 1
+            alias = ref.alias or f"__subquery{self._subq}"
+            return self._fresh(df), alias
+        assert isinstance(ref, TableRef)
+        if ref.path is not None:
+            reader = self.session.read
+            df = getattr(reader, ref.name)(ref.path)
+            return self._fresh(df), ref.alias or ref.name
+        key = ref.name.lower()
+        if key in ctes:
+            kind, payload = ctes[key]
+            df = self._build_sub(payload, ctes) if kind == "stmt" else payload
+            return self._fresh(df), ref.alias or ref.name
+        view = self.session._temp_views.get(key)
+        if view is None:
+            raise SqlParseError(f"table or view not found: {ref.name}")
+        return self._fresh(DataFrame(view._plan, self.session)), \
+            ref.alias or ref.name
+
+    def _fresh(self, df):
+        """Re-alias every output column under fresh expression ids, so two
+        references to the same relation (self-join ``t a JOIN t b``) have
+        distinct attributes (Catalyst's deduplicateRelations)."""
+        from . import plan as P
+        from .dataframe import DataFrame
+        exprs = tuple(Alias(a, a.name) for a in df._plan.output)
+        return DataFrame(P.Project(exprs, df._plan), self.session)
+
+    # --- SELECT -----------------------------------------------------------
+    def _build_select(self, stmt: SelectStmt, ctes):
+        from . import plan as P
+        from .dataframe import Column, DataFrame
+
+        scope: Dict[str, Any] = {}      # alias -> DataFrame
+        if stmt.from_ is None:
+            df = self.session.range(1)
+        else:
+            df, alias = self._resolve_relation(stmt.from_, ctes)
+            scope[alias.lower()] = df
+            for step in stmt.joins:
+                rdf, ralias = self._resolve_relation(step.right, ctes)
+                if ralias.lower() in scope:
+                    raise SqlParseError(
+                        f"duplicate relation alias {ralias!r}")
+                scope[ralias.lower()] = rdf
+                if step.using:
+                    df = df.join(rdf, on=list(step.using), how=step.how)
+                elif step.on is not None:
+                    cond = self._bind_quals(step.on, scope)
+                    df = df.join(rdf, on=Column(cond), how=step.how)
+                else:
+                    if step.how not in ("cross", "inner"):
+                        raise SqlParseError(
+                            f"{step.how} join requires ON or USING")
+                    df = df.crossJoin(rdf)
+
+        if stmt.where is not None:
+            cond = self._bind_quals(stmt.where, scope)
+            if _has_agg(cond):
+                raise SqlParseError(
+                    "aggregate functions are not allowed in WHERE")
+            if _has_window(cond):
+                raise SqlParseError(
+                    "window functions are not allowed in WHERE")
+            df = DataFrame(P.Filter(_resolve_or_err(cond, df._plan),
+                                    df._plan), self.session)
+
+        # resolve select list against the (joined, filtered) frame
+        items: List[Tuple[str, Expression]] = []
+        for it in stmt.items:
+            if isinstance(it.expr, Star):
+                if it.expr.qualifier is not None:
+                    src = scope.get(it.expr.qualifier.lower())
+                    if src is None:
+                        raise SqlParseError(
+                            f"unknown relation {it.expr.qualifier!r} "
+                            "for qualified star")
+                    for a in src._plan.output:
+                        items.append((a.name, a))
+                else:
+                    for a in df._plan.output:
+                        items.append((a.name, a))
+                continue
+            e = self._bind_quals(it.expr, scope)
+            e = _resolve_or_err(e, df._plan)
+            items.append((it.alias or _auto_name(it.expr, e), e))
+
+        having = None
+        if stmt.having is not None:
+            having = _resolve_or_err(self._bind_quals(stmt.having, scope),
+                                     df._plan)
+
+        aggregating = bool(stmt.group_by) or having is not None or \
+            any(_has_agg(e) for _, e in items)
+
+        pre_orders = None
+        if aggregating:
+            df, items, pre_orders = self._build_aggregate(
+                df, stmt, items, having, scope)
+        return self._finish(df, items, stmt, scope, pre_orders)
+
+    # --- aggregation ------------------------------------------------------
+    def _build_aggregate(self, df, stmt, items, having, scope):
+        from . import plan as P
+        from .dataframe import DataFrame, _resolve_expr
+
+        # group expressions: ordinals, select aliases, or raw expressions
+        groups: List[Expression] = []
+        for g in stmt.group_by:
+            if isinstance(g, int):
+                if not (1 <= g <= len(items)):
+                    raise SqlParseError(
+                        f"GROUP BY position {g} is out of range")
+                ge = items[g - 1][1]
+                if _has_agg(ge):
+                    raise SqlParseError(
+                        "aggregate functions are not allowed in GROUP BY")
+                groups.append(ge)
+                continue
+            ge = self._bind_quals(g, scope)
+            try:
+                ge = _resolve_expr(ge, df._plan)
+            except KeyError:
+                # select-list alias (GROUP BY alias) — Spark resolves the
+                # child column first, the alias second
+                name = ge.sql().lower() if not isinstance(
+                    ge, AttributeReference) else ge.name.lower()
+                match = [e for n, e in items if n.lower() == name]
+                if not match:
+                    raise SqlParseError(
+                        f"cannot resolve GROUP BY expression {g.sql()!r}"
+                    ) from None
+                ge = match[0]
+            if _has_agg(ge):
+                raise SqlParseError(
+                    "aggregate functions are not allowed in GROUP BY")
+            groups.append(ge)
+
+        group_outs: List[Expression] = []
+        group_attrs: List[AttributeReference] = []
+        for i, g in enumerate(groups):
+            if isinstance(g, AttributeReference):
+                group_outs.append(g)
+                group_attrs.append(g)
+            else:
+                a = Alias(g, f"__group_{i}")
+                group_outs.append(a)
+                group_attrs.append(a.to_attribute())
+        group_keys = [g.semantic_key() for g in groups]
+
+        agg_aliases: Dict[Tuple, Alias] = {}
+
+        def strip(e: Expression) -> Expression:
+            for key, attr in zip(group_keys, group_attrs):
+                if e.semantic_key() == key:
+                    return attr
+            if isinstance(e, WindowExpression):
+                raise SqlParseError(
+                    "window functions cannot be combined with GROUP BY in "
+                    "the same query block — aggregate in a subquery first")
+            if isinstance(e, (AggregateFunction, AggregateExpression)):
+                key = e.semantic_key()
+                if key not in agg_aliases:
+                    agg_aliases[key] = Alias(e, f"__agg_{len(agg_aliases)}")
+                return agg_aliases[key].to_attribute()
+            if not e.children:
+                return e
+            return e.with_children(tuple(strip(c) for c in e.children))
+
+        new_items = [(name, strip(e)) for name, e in items]
+        new_having = strip(having) if having is not None else None
+
+        # ORDER BY must be stripped BEFORE the Aggregate plan is frozen so
+        # aggregates that appear only in the sort (ORDER BY sum(x)) get
+        # buffer slots too
+        pre_orders: List[SortOrder] = []
+        out_by_name = {n.lower(): e for n, e in reversed(new_items)}
+        for oi in stmt.order_by:
+            if isinstance(oi.expr, int):
+                if not (1 <= oi.expr <= len(new_items)):
+                    raise SqlParseError(
+                        f"ORDER BY position {oi.expr} is out of range")
+                target = new_items[oi.expr - 1][1]
+            else:
+                e = oi.expr
+                if isinstance(e, AttributeReference) and getattr(
+                        e, "_unresolved", False) and \
+                        e.name.lower() in out_by_name:
+                    target = out_by_name[e.name.lower()]
+                else:
+                    target = strip(_resolve_or_err(
+                        self._bind_quals(e, scope), df._plan))
+                    for r in target.references():
+                        if r.expr_id not in {a.expr_id for a in group_attrs}\
+                                and r.expr_id not in {
+                                    al.expr_id
+                                    for al in agg_aliases.values()}:
+                            raise SqlParseError(
+                                f"ORDER BY column {r.name!r} must appear in "
+                                "GROUP BY or be inside an aggregate "
+                                "function")
+            pre_orders.append(SortOrder(target, oi.ascending,
+                                        oi.nulls_first))
+
+        # every remaining column reference must be a group key or an
+        # aggregate result
+        allowed = {a.expr_id for a in group_attrs}
+        allowed.update(al.expr_id for al in agg_aliases.values())
+        for name, e in new_items:
+            for r in e.references():
+                if r.expr_id not in allowed:
+                    raise SqlParseError(
+                        f"column {r.name!r} must appear in GROUP BY or be "
+                        "inside an aggregate function")
+        if new_having is not None:
+            for r in new_having.references():
+                if r.expr_id not in allowed:
+                    raise SqlParseError(
+                        f"HAVING column {r.name!r} must appear in GROUP BY "
+                        "or be inside an aggregate function")
+
+        plan = P.Aggregate(tuple(groups),
+                           tuple(group_outs) + tuple(agg_aliases.values()),
+                           df._plan)
+        adf = DataFrame(plan, self.session)
+        if new_having is not None:
+            adf = DataFrame(P.Filter(new_having, adf._plan), self.session)
+        return adf, new_items, pre_orders
+
+    # --- ORDER BY / DISTINCT / LIMIT tail ---------------------------------
+    def _finish(self, df, items, stmt: SelectStmt, scope,
+                pre_orders: Optional[List[SortOrder]] = None):
+        from . import plan as P
+        from .dataframe import DataFrame, _resolve_expr
+
+        if pre_orders is not None:
+            orders = pre_orders
+        else:
+            orders = []
+            out_by_name = {}
+            for n, e in items:
+                out_by_name.setdefault(n.lower(), e)
+            for oi in stmt.order_by:
+                if isinstance(oi.expr, int):
+                    if not (1 <= oi.expr <= len(items)):
+                        raise SqlParseError(
+                            f"ORDER BY position {oi.expr} is out of range")
+                    target = items[oi.expr - 1][1]
+                else:
+                    e = oi.expr
+                    name = e.name.lower() if isinstance(
+                        e, AttributeReference) and getattr(
+                        e, "_unresolved", False) else None
+                    if name is not None and name in out_by_name:
+                        target = out_by_name[name]
+                    else:
+                        target = _resolve_or_err(self._bind_quals(e, scope),
+                                                 df._plan)
+                orders.append(SortOrder(target, oi.ascending,
+                                        oi.nulls_first))
+
+        project_exprs = tuple(
+            e if (isinstance(e, AttributeReference) and e.name == n)
+            else Alias(e, n)
+            for n, e in items)
+        out_attrs = [pe if isinstance(pe, AttributeReference)
+                     else pe.to_attribute() for pe in project_exprs]
+
+        def make_project(exprs, plan):
+            # same window/generator extraction hook as DataFrame.select
+            from .dataframe import _extract_generators, _extract_windows
+            exprs, plan = _extract_generators(tuple(exprs), plan)
+            exprs, plan = _extract_windows(tuple(exprs), plan)
+            return P.Project(tuple(exprs), plan)
+
+        # rewrite order targets that exactly match a projected expression
+        # to reference the projected output (post-projection sort)
+        def to_output(e: Expression) -> Optional[Expression]:
+            for pe, attr in zip(project_exprs, out_attrs):
+                src = pe.child if isinstance(pe, Alias) else pe
+                if e.semantic_key() == src.semantic_key():
+                    return attr
+            return None
+
+        sortable_post = []
+        needs_hidden = False
+        for so in orders:
+            mapped = to_output(so.child)
+            if mapped is not None:
+                sortable_post.append(SortOrder(mapped, so.ascending,
+                                               so.nulls_first))
+            else:
+                needs_hidden = True
+                sortable_post.append(so)
+
+        if stmt.distinct and needs_hidden:
+            raise SqlParseError(
+                "ORDER BY with SELECT DISTINCT must reference select-list "
+                "expressions")
+
+        if not needs_hidden:
+            result = DataFrame(make_project(project_exprs, df._plan),
+                               self.session)
+            if stmt.distinct:
+                result = result.distinct()
+            if sortable_post:
+                result = DataFrame(
+                    P.Sort(tuple(sortable_post), True, result._plan),
+                    self.session)
+        else:
+            # project select list + hidden sort keys, sort, project away
+            hidden = []
+            full_orders = []
+            for so in sortable_post:
+                if any(so.child.semantic_key() == a.semantic_key()
+                       for a in out_attrs):
+                    full_orders.append(so)
+                    continue
+                h = Alias(so.child, f"__sort_{len(hidden)}")
+                hidden.append(h)
+                full_orders.append(SortOrder(h.to_attribute(), so.ascending,
+                                             so.nulls_first))
+            wide = DataFrame(
+                make_project(project_exprs + tuple(hidden), df._plan),
+                self.session)
+            sorted_df = DataFrame(P.Sort(tuple(full_orders), True,
+                                         wide._plan), self.session)
+            result = DataFrame(P.Project(tuple(out_attrs), sorted_df._plan),
+                               self.session)
+
+        if stmt.offset:
+            lim = stmt.limit if stmt.limit is not None else (1 << 30)
+            result = DataFrame(P.Limit(lim, stmt.offset, result._plan),
+                               self.session)
+        elif stmt.limit is not None:
+            result = result.limit(stmt.limit)
+        return result
+
+    def _apply_order_limit(self, df, order_by, limit, offset, items):
+        from . import plan as P
+        from .dataframe import DataFrame
+        if order_by:
+            orders = []
+            attrs = df._plan.output
+            for oi in order_by:
+                if isinstance(oi.expr, int):
+                    if not (1 <= oi.expr <= len(attrs)):
+                        raise SqlParseError(
+                            f"ORDER BY position {oi.expr} is out of range")
+                    target: Expression = attrs[oi.expr - 1]
+                else:
+                    target = _resolve_or_err(oi.expr, df._plan)
+                orders.append(SortOrder(target, oi.ascending,
+                                        oi.nulls_first))
+            df = DataFrame(P.Sort(tuple(orders), True, df._plan),
+                           self.session)
+        if offset:
+            lim = limit if limit is not None else (1 << 30)
+            df = DataFrame(P.Limit(lim, offset, df._plan), self.session)
+        elif limit is not None:
+            df = df.limit(limit)
+        return df
+
+    # --- qualified-name binding ------------------------------------------
+    def _bind_quals(self, e: Expression, scope) -> Expression:
+        if isinstance(e, Star):
+            raise SqlParseError("'*' is only valid in a select list")
+
+        def walk(node: Expression) -> Expression:
+            if isinstance(node, UnresolvedQualified):
+                src = scope.get(node.qualifier.lower())
+                if src is None:
+                    raise SqlParseError(
+                        f"unknown relation alias {node.qualifier!r} "
+                        f"(known: {sorted(scope)})")
+                for a in src._plan.output:
+                    if a.name.lower() == node.name.lower():
+                        return a
+                raise SqlParseError(
+                    f"column {node.name!r} not found in relation "
+                    f"{node.qualifier!r}")
+            if not node.children:
+                return node
+            return node.with_children(tuple(walk(c) for c in node.children))
+        return walk(e)
+
+
+def _as_string(e: Expression) -> Expression:
+    """Implicit cast for the ``||`` operator (Spark casts both concat
+    operands to string).  Unresolved refs keep the cast — string->string
+    casting is the identity."""
+    from .expressions.cast import Cast
+    try:
+        if e.data_type == T.STRING:
+            return e
+    except (NotImplementedError, SqlParseError):
+        pass
+    return Cast(e, T.STRING)
+
+
+def _resolve_or_err(e: Expression, plan) -> Expression:
+    """Name resolution with the module's error contract (SqlParseError,
+    never a bare KeyError)."""
+    from .dataframe import _resolve_expr
+    try:
+        return _resolve_expr(e, plan)
+    except KeyError as exc:
+        raise SqlParseError(str(exc.args[0]) if exc.args else str(exc)) \
+            from None
+
+
+def _has_window(e: Expression) -> bool:
+    return bool(e.collect(lambda n: isinstance(n, WindowExpression)))
+
+
+def _has_agg(e: Expression) -> bool:
+    """True if e contains a grouping aggregate (sum() OVER (...) is a
+    window computation, not an aggregation — don't descend into specs)."""
+    if isinstance(e, WindowExpression):
+        return False
+    if isinstance(e, (AggregateFunction, AggregateExpression)):
+        return True
+    return any(_has_agg(c) for c in e.children)
+
+
+def _auto_name(raw: Expression, resolved: Expression) -> str:
+    if isinstance(resolved, AttributeReference):
+        return resolved.name
+    if isinstance(resolved, Alias):
+        return resolved.name
+    return raw.sql()
+
+
+def parse_query(session, sql: str):
+    """``session.sql(...)`` entry point."""
+    stmt = Parser(sql).parse_statement()
+    return QueryBuilder(session).build(stmt)
