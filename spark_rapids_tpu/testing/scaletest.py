@@ -213,6 +213,76 @@ def _tpch_q6(sess, t, F):
     assert np.allclose(got["revenue"].fillna(0.0), exp)
 
 
+#: TPC-H q1 as SQL text (spec form; the interval-arithmetic cutoff is the
+#: spec's DATE '1998-12-01' - 90 days, written as the resolved literal)
+_TPCH_Q1_SQL = """
+SELECT l_returnflag, l_linestatus,
+       sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       avg(l_quantity) AS avg_qty,
+       avg(l_extendedprice) AS avg_price,
+       avg(l_discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= CAST('1998-09-02' AS date)
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+_TPCH_Q6_SQL = """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= CAST('1994-01-01' AS date)
+  AND l_shipdate < CAST('1995-01-01' AS date)
+  AND l_discount BETWEEN 0.05 AND 0.07
+  AND l_quantity < 24
+"""
+
+
+def _tpch_q1_sql(sess, t, F):
+    """TPC-H q1 executed from SQL text — the reference's actual query
+    surface (Spark SQL in; SURVEY §1) — checked against a pandas oracle."""
+    sess.create_dataframe(t["lineitem"], num_partitions=4) \
+        .createOrReplaceTempView("lineitem")
+    got = sess.sql(_TPCH_Q1_SQL).collect().to_pandas()
+    pdf = t["lineitem"].to_pandas()
+    pdf = pdf[pdf.l_shipdate <= pd.Timestamp("1998-09-02").date()]
+    dp = pdf.l_extendedprice * (1.0 - pdf.l_discount)
+    exp = (pd.DataFrame({
+        "rf": pdf.l_returnflag, "ls": pdf.l_linestatus,
+        "q": pdf.l_quantity, "p": pdf.l_extendedprice, "dp": dp,
+        "ch": dp * (1.0 + pdf.l_tax), "d": pdf.l_discount})
+        .groupby(["rf", "ls"])
+        .agg(sum_qty=("q", "sum"), sum_base_price=("p", "sum"),
+             sum_disc_price=("dp", "sum"), sum_charge=("ch", "sum"),
+             avg_qty=("q", "mean"), avg_price=("p", "mean"),
+             avg_disc=("d", "mean"), count_order=("q", "size"))
+        .sort_index().reset_index())
+    assert list(got["l_returnflag"]) == list(exp["rf"])
+    assert list(got["l_linestatus"]) == list(exp["ls"])
+    for col in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+                "avg_qty", "avg_price", "avg_disc"):
+        assert np.allclose(got[col], exp[col]), col
+    assert np.array_equal(got["count_order"], exp["count_order"])
+
+
+def _tpch_q6_sql(sess, t, F):
+    """TPC-H q6 from SQL text, pandas-oracle checked."""
+    sess.create_dataframe(t["lineitem"], num_partitions=4) \
+        .createOrReplaceTempView("lineitem")
+    got = sess.sql(_TPCH_Q6_SQL).collect().to_pandas()
+    pdf = t["lineitem"].to_pandas()
+    lo = pd.Timestamp("1994-01-01").date()
+    hi = pd.Timestamp("1995-01-01").date()
+    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi)
+         & (pdf.l_discount >= 0.05) & (pdf.l_discount <= 0.07)
+         & (pdf.l_quantity < 24.0))
+    exp = float((pdf.l_extendedprice[m] * pdf.l_discount[m]).sum())
+    assert np.allclose(got["revenue"].fillna(0.0), exp)
+
+
 def build_tpcds_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
     """store_sales star schema subset for the hash-join-heavy TPC-DS
     milestone queries (BASELINE config 3: q3/q7/q19/q42 shapes)."""
@@ -423,6 +493,8 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("q6_strings", _q6),
     ("tpch_q1", _tpch_q1),
     ("tpch_q6", _tpch_q6),
+    ("tpch_q1_sql", _tpch_q1_sql),
+    ("tpch_q6_sql", _tpch_q6_sql),
     ("tpcds_q3_star_join", _tpcds_q3),
     ("tpcds_q7_star4_avgs", _tpcds_q7),
     ("tpcds_q19_brand_rev", _tpcds_q19),
