@@ -16,6 +16,47 @@ import jax as _jax
 # to 32-bit, so x64 must be on before any array is created.
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: a first compile on the TPU tunnel costs
+# 20-60s per program (remote compiler — docs/perf_notes.md), so every entry
+# point into the engine must amortize compiles across processes/runs, not
+# just bench.py.  Harmless no-op on backends without cache support.
+import os as _os
+
+def _host_fingerprint() -> str:
+    """XLA:CPU AOT results are machine-feature specific but the cache key
+    is not — loading an entry compiled on a wider-ISA machine risks SIGILL
+    (observed as 'Target machine feature ... not supported' warnings).
+    Scope the cache dir to this host's CPU flags."""
+    import hashlib
+    import platform
+    feat = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    feat += " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(feat.encode()).hexdigest()[:12]
+
+
+try:  # pragma: no cover - depends on jax version/backend
+    if not (_jax.config.jax_compilation_cache_dir
+            or _os.environ.get("JAX_COMPILATION_CACHE_DIR")):
+        # defer to any user-configured cache; otherwise default to a
+        # host-scoped dir next to the package checkout
+        _cache_dir = _os.environ.get(
+            "SPARK_RAPIDS_TPU_JAX_CACHE",
+            _os.path.join(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__))), ".jax_cache",
+                _host_fingerprint()))
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 from .types import (  # noqa: F401
     BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING, BINARY, DATE,
     TIMESTAMP, NULL, ArrayType, BinaryType, BooleanType, ByteType, DataType,

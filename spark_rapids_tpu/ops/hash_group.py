@@ -51,6 +51,129 @@ def _hash_words(jnp, keys):
     return h
 
 
+#: compact-code fast path: product of per-word value ranges must fit this
+#: many codes.  64Ki keeps every intermediate product < 2^32 (no int64
+#: overflow) and the remap tables cache-resident.
+_COMPACT_MAX_CODES = 1 << 16
+
+
+def _compact_prelude(jnp, col_words, row_mask):
+    """Range-compaction feasibility + per-row codes (cheap, always run).
+    ``col_words``: per key column, ``(null_flags_bool, [int64 words])`` —
+    computed once by the caller and shared with the fallback kernel.
+
+    Treats every key word as a mixed-radix digit:
+    ``code = Σ (word_i - min_i) * stride_i`` where ``stride`` is the
+    running product of the per-word ranges.  Null flags are {0,1} digits
+    whose range comes from a boolean ``any`` (4-8x cheaper than an int64
+    min/max pass).  Returns ``(ok, codes)`` — ``ok`` is a traced scalar
+    that is True iff every range is sane and the total code space fits
+    ``_COMPACT_MAX_CODES``; ``codes`` are exact collision-free group codes
+    when ``ok`` holds (garbage otherwise — callers must gate on ``ok``
+    via ``lax.cond``).
+
+    Cost: two fused reductions per data word plus one elementwise pass —
+    no serial probe rounds.  This is the common case for real group-bys
+    (low-cardinality ints/dates/bools/flags); wide ranges (floats,
+    strings, ids) fail ``ok`` and take the fallback kernel instead.
+    """
+    B = _COMPACT_MAX_CODES
+    cap = int(row_mask.shape[0])
+    any_live = jnp.any(row_mask)
+    imax = np.int64(np.iinfo(np.int64).max)
+    imin = np.int64(np.iinfo(np.int64).min)
+    one = jnp.asarray(1, dtype=jnp.int64)
+    ok = jnp.asarray(True)
+    p = one
+    codes = jnp.zeros(cap, dtype=jnp.int64)
+
+    def add_digit(digit, r, okd):
+        nonlocal ok, p, codes
+        ok = ok & okd
+        codes = codes + digit * p
+        p_next = p * jnp.clip(r, 1, B)  # clip: bounded even pre-check
+        ok = ok & (p_next <= B)
+        p = jnp.where(ok, p_next, one)
+
+    for col_nulls, words in col_words:
+        nulls = col_nulls & row_mask
+        has_null = jnp.any(nulls)
+        # null digit: 1 for null rows; range 2 only when nulls exist
+        add_digit(nulls.astype(jnp.int64),
+                  jnp.where(has_null, 2, 1).astype(jnp.int64),
+                  jnp.asarray(True))
+        for w in words:
+            wmin = jnp.min(jnp.where(row_mask, w, imax))
+            wmax = jnp.max(jnp.where(row_mask, w, imin))
+            r = jnp.where(any_live, wmax - wmin + 1, one)
+            # r >= 1 also rejects int64 wraparound (a true range near 2^64
+            # wraps to a value <= 0, never to a small positive)
+            add_digit(w - wmin, r, (r >= 1) & (r <= B))
+    return ok, codes
+
+
+def _first_occurrence_ids(jnp, slot_of_row, row_mask, table_size):
+    """Dense first-occurrence group ids from any collision-free per-row
+    slot assignment (compact codes, sorted-order ranks, ...).
+
+    One scatter-min finds each slot's first row; a row-order cumsum over
+    "this row IS its slot's first" numbers the groups in first-occurrence
+    order — no sort needed (an argsort-based remap here doubled TPU
+    compile time; sorts are the expensive op for the remote compiler).
+    ``slot_of_row`` must be in [0, table_size) for live rows."""
+    cap = int(row_mask.shape[0])
+    row_idx = jnp.arange(cap, dtype=jnp.int32)
+    slot_live = jnp.where(row_mask, slot_of_row,
+                          table_size).astype(jnp.int32)
+    first_row = jnp.full(table_size, cap, dtype=jnp.int32
+                         ).at[slot_live].min(row_idx)
+    fr_of_row = first_row[jnp.clip(slot_live, 0, table_size - 1)]
+    is_first = row_mask & (fr_of_row == row_idx)
+    dense = jnp.cumsum(is_first.astype(jnp.int64)) - 1
+    ids = dense[jnp.clip(fr_of_row, 0, cap - 1)]
+    return jnp.where(row_mask, ids, cap - 1)
+
+
+def _compact_finish(jnp, codes, row_mask):
+    """Exact dense first-occurrence group ids from in-range codes —
+    bit-identical to the probing kernel's numbering, so either branch of
+    the ``lax.cond`` agrees with the host path."""
+    B = _COMPACT_MAX_CODES
+    return _first_occurrence_ids(jnp, jnp.clip(codes, 0, B), row_mask, B)
+
+
+def _probe_beats_sort(jnp) -> bool:
+    """Trace-time fallback choice for codes that don't compact: the
+    leader-election probe loop wins on XLA CPU (0.55s vs ~1.5s sort-based
+    at 4M rows), but serial while_loop rounds of scatters are catastrophic
+    on TPU (measured 4.7s at 4M rows vs 0.45s for the sort-based path —
+    lax.sort is a tuned TPU kernel, the probe loop is not)."""
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def _sorted_ids(jnp, keys, row_mask):
+    """Exact first-occurrence-dense group ids via ONE variadic lex sort —
+    the high-cardinality fallback on backends where sorts beat probe
+    rounds (TPU).  Identical output to the probe kernel: dense ids in
+    [0, n_groups) in first-occurrence order, dead rows parked at cap-1."""
+    from .ranks import lex_sort
+    cap = int(row_mask.shape[0])
+    # liveness leads the sort key: live rows sort first, so live ranks are
+    # exactly [0, n_groups)
+    sort_keys = [(~row_mask).astype(jnp.int64)] + list(keys)
+    perm, skeys = lex_sort(jnp, sort_keys)
+    diff = jnp.zeros((cap - 1,), dtype=bool)
+    for k in skeys:
+        diff = diff | (k[1:] != k[:-1])
+    first = jnp.concatenate([jnp.ones((1,), dtype=bool), diff])
+    ranks_sorted = jnp.cumsum(first.astype(jnp.int64)) - 1
+    rank = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(ranks_sorted)
+    # remap sorted-key rank order -> first-occurrence order (the probe
+    # kernel's order, and the host path's) without a second sort
+    return _first_occurrence_ids(jnp, jnp.clip(rank, 0, cap), row_mask, cap)
+
+
 def group_ids(xp, cols, row_mask):
     """int64[cap] exact group ids over the key columns.
 
@@ -61,10 +184,6 @@ def group_ids(xp, cols, row_mask):
     id == cap - 1, which is provably unused by live groups whenever dead
     rows exist (n_groups <= cap - n_dead).
     """
-    keys = []
-    for c in cols:
-        keys.append((~c.validity).astype(xp.int64))
-        keys.extend(column_sort_keys(xp, c))
     cap_n = int(row_mask.shape[0])
     if xp.__name__ == "numpy":
         # independent sort-based host path, remapped from sorted-key order
@@ -83,49 +202,65 @@ def group_ids(xp, cols, row_mask):
     import jax.numpy as jnp
 
     cap = int(row_mask.shape[0])
-    M = 1 << (max(2 * cap, 16) - 1).bit_length()
-    mask_m = np.uint32(M - 1)
-    h = _hash_words(jnp, keys)
-    row_idx = jnp.arange(cap, dtype=jnp.int32)
-    sentinel = jnp.asarray(cap, dtype=jnp.int32)
-    # one [cap, k] matrix so the per-round owner compare is a single
-    # row gather instead of k scattered 1-D gathers
-    key_mat = jnp.stack(keys, axis=1)
+    # each key word computed ONCE, shared by the prelude and the fallback
+    col_words = [((~c.validity), column_sort_keys(jnp, c)) for c in cols]
+    keys = [w for nulls, ws in col_words
+            for w in (nulls.astype(jnp.int64), *ws)]
+    compact_ok, compact_codes = _compact_prelude(jnp, col_words, row_mask)
 
-    def cond(state):
-        _table, rep, off, rounds = state
-        return jnp.any(rep < 0) & (rounds < M)
+    def probe(_):
+        M = 1 << (max(2 * cap, 16) - 1).bit_length()
+        mask_m = np.uint32(M - 1)
+        h = _hash_words(jnp, keys)
+        row_idx = jnp.arange(cap, dtype=jnp.int32)
+        sentinel = jnp.asarray(cap, dtype=jnp.int32)
+        # one [cap, k] matrix so the per-round owner compare is a single
+        # row gather instead of k scattered 1-D gathers
+        key_mat = jnp.stack(keys, axis=1)
 
-    def body(state):
-        table, rep, off, rounds = state
-        unresolved = rep < 0
-        slot = ((h + off) & mask_m).astype(jnp.int32)
-        cand = jnp.where(unresolved, row_idx, sentinel)
-        table = table.at[slot].min(cand)
-        owner = table[slot]
-        safe_owner = jnp.clip(owner, 0, cap - 1)
-        eq = (owner < cap) & jnp.all(key_mat == key_mat[safe_owner], axis=1)
-        newly = unresolved & eq
-        rep = jnp.where(newly, owner, rep)
-        off = jnp.where(unresolved & ~eq, off + np.uint32(1), off)
-        return table, rep, off, rounds + 1
+        def cond(state):
+            _table, rep, off, rounds = state
+            return jnp.any(rep < 0) & (rounds < M)
 
-    table0 = jnp.full(M, cap, dtype=jnp.int32)
-    # dead rows resolve to themselves immediately (masked out by callers)
-    rep0 = jnp.where(row_mask, -1, row_idx)
-    off0 = jnp.zeros(cap, dtype=jnp.uint32)
-    _table, rep, _off, _r = jax.lax.while_loop(
-        cond, body, (table0, rep0, off0, jnp.asarray(0, dtype=jnp.int32)))
+        def body(state):
+            table, rep, off, rounds = state
+            unresolved = rep < 0
+            slot = ((h + off) & mask_m).astype(jnp.int32)
+            cand = jnp.where(unresolved, row_idx, sentinel)
+            table = table.at[slot].min(cand)
+            owner = table[slot]
+            safe_owner = jnp.clip(owner, 0, cap - 1)
+            eq = (owner < cap) & jnp.all(key_mat == key_mat[safe_owner],
+                                         axis=1)
+            newly = unresolved & eq
+            rep = jnp.where(newly, owner, rep)
+            off = jnp.where(unresolved & ~eq, off + np.uint32(1), off)
+            return table, rep, off, rounds + 1
 
-    # defensive: the M-round bound guarantees resolution (a cohort visits
-    # every slot within M probes); if that invariant ever broke, making the
-    # row its own group keeps results mergeable instead of corrupting them
-    rep = jnp.where(rep < 0, row_idx, rep)
+        table0 = jnp.full(M, cap, dtype=jnp.int32)
+        # dead rows resolve to themselves immediately (masked by callers)
+        rep0 = jnp.where(row_mask, -1, row_idx)
+        off0 = jnp.zeros(cap, dtype=jnp.uint32)
+        _table, rep, _off, _r = jax.lax.while_loop(
+            cond, body, (table0, rep0, off0, jnp.asarray(0, dtype=jnp.int32)))
 
-    is_rep = row_mask & (rep == row_idx)
-    dense = jnp.cumsum(is_rep.astype(jnp.int64)) - 1
-    ids = dense[jnp.clip(rep, 0, cap - 1)]
-    return jnp.where(row_mask, ids, cap - 1)
+        # defensive: the M-round bound guarantees resolution (a cohort
+        # visits every slot within M probes); if that invariant ever broke,
+        # making the row its own group keeps results mergeable instead of
+        # corrupting them
+        rep = jnp.where(rep < 0, row_idx, rep)
+
+        is_rep = row_mask & (rep == row_idx)
+        dense = jnp.cumsum(is_rep.astype(jnp.int64)) - 1
+        ids = dense[jnp.clip(rep, 0, cap - 1)]
+        return jnp.where(row_mask, ids, cap - 1)
+
+    fallback = probe if _probe_beats_sort(jnp) else (
+        lambda _: _sorted_ids(jnp, keys, row_mask))
+    return jax.lax.cond(compact_ok,
+                        lambda _: _compact_finish(jnp, compact_codes,
+                                                  row_mask),
+                        fallback, None)
 
 
 def group_ids_small(xp, cols, row_mask, expected_groups: int):
@@ -143,61 +278,78 @@ def group_ids_small(xp, cols, row_mask, expected_groups: int):
     (too many distinct keys OR pathological clustering) is detected by
     the SAME group-count check that guards table sizing.
     """
-    keys = []
-    for c in cols:
-        keys.append((~c.validity).astype(xp.int64))
-        keys.extend(column_sort_keys(xp, c))
     cap = int(row_mask.shape[0])
     if xp.__name__ == "numpy":  # host path has no table to size
         return group_ids(xp, cols, row_mask)
     import jax
     import jax.numpy as jnp
 
-    M = 1 << (max(4 * int(expected_groups), 64) - 1).bit_length()
-    M = min(M, 1 << (max(2 * cap, 16) - 1).bit_length())
-    max_rounds = min(M, 64)
-    mask_m = np.uint32(M - 1)
-    h = _hash_words(jnp, keys)
-    row_idx = jnp.arange(cap, dtype=jnp.int32)
-    sentinel = jnp.asarray(cap, dtype=jnp.int32)
-    key_mat = jnp.stack(keys, axis=1)
+    # each key word computed ONCE, shared by the prelude and the fallback
+    col_words = [((~c.validity), column_sort_keys(jnp, c)) for c in cols]
+    keys = [w for nulls, ws in col_words
+            for w in (nulls.astype(jnp.int64), *ws)]
+    compact_ok, compact_codes = _compact_prelude(jnp, col_words, row_mask)
 
-    def cond(state):
-        _table, rep, off, rounds = state
-        return jnp.any(rep < 0) & (rounds < max_rounds)
+    def probe(_):
+        M = 1 << (max(4 * int(expected_groups), 64) - 1).bit_length()
+        M2 = min(M, 1 << (max(2 * cap, 16) - 1).bit_length())
+        max_rounds = min(M2, 64)
+        mask_m = np.uint32(M2 - 1)
+        h = _hash_words(jnp, keys)
+        row_idx = jnp.arange(cap, dtype=jnp.int32)
+        sentinel = jnp.asarray(cap, dtype=jnp.int32)
+        key_mat = jnp.stack(keys, axis=1)
 
-    def body(state):
-        table, rep, off, rounds = state
-        unresolved = rep < 0
-        slot = ((h + off) & mask_m).astype(jnp.int32)
-        cand = jnp.where(unresolved, row_idx, sentinel)
-        table = table.at[slot].min(cand)
-        owner = table[slot]
-        # gather each slot WINNER's keys once into the tiny [M, k] table,
-        # then compare rows against win_keys[slot] — streaming reads of
-        # key_mat plus cache-resident table lookups, instead of a cap-wide
-        # random gather into key_mat (the big kernel's cost)
-        win_keys = key_mat[jnp.clip(table, 0, cap - 1)]
-        eq = (owner < cap) & jnp.all(key_mat == win_keys[slot], axis=1)
-        newly = unresolved & eq
-        rep = jnp.where(newly, owner, rep)
-        off = jnp.where(unresolved & ~eq, off + np.uint32(1), off)
-        return table, rep, off, rounds + 1
+        def cond(state):
+            _table, rep, off, rounds = state
+            return jnp.any(rep < 0) & (rounds < max_rounds)
 
-    table0 = jnp.full(M, cap, dtype=jnp.int32)
-    rep0 = jnp.where(row_mask, -1, row_idx)
-    off0 = jnp.zeros(cap, dtype=jnp.uint32)
-    _table, rep, _off, _r = jax.lax.while_loop(
-        cond, body, (table0, rep0, off0, jnp.asarray(0, dtype=jnp.int32)))
+        def body(state):
+            table, rep, off, rounds = state
+            unresolved = rep < 0
+            slot = ((h + off) & mask_m).astype(jnp.int32)
+            cand = jnp.where(unresolved, row_idx, sentinel)
+            table = table.at[slot].min(cand)
+            owner = table[slot]
+            # gather each slot WINNER's keys once into the tiny [M, k]
+            # table, then compare rows against win_keys[slot] — streaming
+            # reads of key_mat plus cache-resident table lookups, instead
+            # of a cap-wide random gather into key_mat (the big kernel's
+            # cost)
+            win_keys = key_mat[jnp.clip(table, 0, cap - 1)]
+            eq = (owner < cap) & jnp.all(key_mat == win_keys[slot], axis=1)
+            newly = unresolved & eq
+            rep = jnp.where(newly, owner, rep)
+            off = jnp.where(unresolved & ~eq, off + np.uint32(1), off)
+            return table, rep, off, rounds + 1
 
-    overflow = row_mask & (rep < 0)
-    rep = jnp.where(rep < 0, row_idx, rep)
-    is_rep = row_mask & (rep == row_idx)
-    dense = jnp.cumsum(is_rep.astype(jnp.int64)) - 1
-    ids = dense[jnp.clip(rep, 0, cap - 1)]
-    # unresolved rows: burn the count so ng > any speculation <= expected
-    # (their own ids are representatives already counted by the cumsum;
-    # adding `expected_groups` to them guarantees the overflow is visible
-    # in max(rank)+1 regardless of how many groups resolved)
-    ids = jnp.where(overflow, ids + int(expected_groups), ids)
-    return jnp.where(row_mask, ids, cap - 1)
+        table0 = jnp.full(M2, cap, dtype=jnp.int32)
+        rep0 = jnp.where(row_mask, -1, row_idx)
+        off0 = jnp.zeros(cap, dtype=jnp.uint32)
+        _table, rep, _off, _r = jax.lax.while_loop(
+            cond, body, (table0, rep0, off0, jnp.asarray(0, dtype=jnp.int32)))
+
+        overflow = row_mask & (rep < 0)
+        rep = jnp.where(rep < 0, row_idx, rep)
+        is_rep = row_mask & (rep == row_idx)
+        dense = jnp.cumsum(is_rep.astype(jnp.int64)) - 1
+        ids = dense[jnp.clip(rep, 0, cap - 1)]
+        # unresolved rows: burn the count so ng > any speculation <=
+        # expected (their own ids are representatives already counted by
+        # the cumsum; adding `expected_groups` to them guarantees the
+        # overflow is visible in max(rank)+1 regardless of how many groups
+        # resolved)
+        ids = jnp.where(overflow, ids + int(expected_groups), ids)
+        return jnp.where(row_mask, ids, cap - 1)
+
+    # compact branch is EXACT (no burning needed): whenever the code space
+    # fits, the ids are the true dense first-occurrence ids, and a count
+    # above the speculated table size is caught by the same ng check.
+    # The sorted fallback (TPU) is likewise exact — overflow burning only
+    # applies to the bounded probe.
+    fallback = probe if _probe_beats_sort(jnp) else (
+        lambda _: _sorted_ids(jnp, keys, row_mask))
+    return jax.lax.cond(compact_ok,
+                        lambda _: _compact_finish(jnp, compact_codes,
+                                                  row_mask),
+                        fallback, None)

@@ -150,6 +150,43 @@ def build_tpch_tables(rows: int, seed: int = 23) -> Dict[str, pa.Table]:
     return {"lineitem": lineitem}
 
 
+def _q1_oracle_check(got, lineitem_table):
+    """Shared pandas oracle for TPC-H q1 (DataFrame-API and SQL forms)."""
+    import datetime
+    pdf = lineitem_table.to_pandas()
+    pdf = pdf[pdf.l_shipdate <= datetime.date(1998, 9, 2)]
+    dp = pdf.l_extendedprice * (1.0 - pdf.l_discount)
+    ch = dp * (1.0 + pdf.l_tax)
+    exp = (pd.DataFrame({
+        "rf": pdf.l_returnflag, "ls": pdf.l_linestatus,
+        "q": pdf.l_quantity, "p": pdf.l_extendedprice, "dp": dp,
+        "ch": ch, "d": pdf.l_discount})
+        .groupby(["rf", "ls"])
+        .agg(sum_qty=("q", "sum"), sum_base_price=("p", "sum"),
+             sum_disc_price=("dp", "sum"), sum_charge=("ch", "sum"),
+             avg_qty=("q", "mean"), avg_price=("p", "mean"),
+             avg_disc=("d", "mean"), count_order=("q", "size"))
+        .sort_index().reset_index())
+    assert list(got["l_returnflag"]) == list(exp["rf"])
+    assert list(got["l_linestatus"]) == list(exp["ls"])
+    for col in ("sum_qty", "sum_base_price", "sum_disc_price",
+                "sum_charge", "avg_qty", "avg_price", "avg_disc"):
+        assert np.allclose(got[col], exp[col]), col
+    assert np.array_equal(got["count_order"], exp["count_order"])
+
+
+def _q6_oracle_check(got, lineitem_table):
+    """Shared pandas oracle for TPC-H q6 (DataFrame-API and SQL forms)."""
+    import datetime
+    pdf = lineitem_table.to_pandas()
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi)
+         & (pdf.l_discount >= 0.05) & (pdf.l_discount <= 0.07)
+         & (pdf.l_quantity < 24.0))
+    exp = float((pdf.l_extendedprice[m] * pdf.l_discount[m]).sum())
+    assert np.allclose(got["revenue"].fillna(0.0), exp)
+
+
 def _tpch_q1(sess, t, F):
     """TPC-H q1: pricing summary report (BASELINE milestone 2)."""
     import datetime
@@ -171,26 +208,7 @@ def _tpch_q1(sess, t, F):
                 F.count("*").alias("count_order"))
            .orderBy("l_returnflag", "l_linestatus")
            .collect().to_pandas())
-    pdf = t["lineitem"].to_pandas()
-    pdf = pdf[pdf.l_shipdate <= cutoff]  # date32 -> date objects
-    dp = pdf.l_extendedprice * (1.0 - pdf.l_discount)
-    ch = dp * (1.0 + pdf.l_tax)
-    exp = (pd.DataFrame({
-        "rf": pdf.l_returnflag, "ls": pdf.l_linestatus,
-        "q": pdf.l_quantity, "p": pdf.l_extendedprice, "dp": dp,
-        "ch": ch, "d": pdf.l_discount})
-        .groupby(["rf", "ls"])
-        .agg(sum_qty=("q", "sum"), sum_base_price=("p", "sum"),
-             sum_disc_price=("dp", "sum"), sum_charge=("ch", "sum"),
-             avg_qty=("q", "mean"), avg_price=("p", "mean"),
-             avg_disc=("d", "mean"), count_order=("q", "size"))
-        .sort_index().reset_index())
-    assert list(got["l_returnflag"]) == list(exp["rf"])
-    assert list(got["l_linestatus"]) == list(exp["ls"])
-    for col in ("sum_qty", "sum_base_price", "sum_disc_price",
-                "sum_charge", "avg_qty", "avg_price", "avg_disc"):
-        assert np.allclose(got[col], exp[col]), col
-    assert np.array_equal(got["count_order"], exp["count_order"])
+    _q1_oracle_check(got, t["lineitem"])
 
 
 def _tpch_q6(sess, t, F):
@@ -205,12 +223,7 @@ def _tpch_q6(sess, t, F):
            .agg(F.sum(F.col("l_extendedprice") * F.col("l_discount"))
                 .alias("revenue"))
            .collect().to_pandas())
-    pdf = t["lineitem"].to_pandas()
-    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi)
-         & (pdf.l_discount >= 0.05) & (pdf.l_discount <= 0.07)
-         & (pdf.l_quantity < 24.0))
-    exp = float((pdf.l_extendedprice[m] * pdf.l_discount[m]).sum())
-    assert np.allclose(got["revenue"].fillna(0.0), exp)
+    _q6_oracle_check(got, t["lineitem"])
 
 
 #: TPC-H q1 as SQL text (spec form; the interval-arithmetic cutoff is the
@@ -247,25 +260,7 @@ def _tpch_q1_sql(sess, t, F):
     sess.create_dataframe(t["lineitem"], num_partitions=4) \
         .createOrReplaceTempView("lineitem")
     got = sess.sql(_TPCH_Q1_SQL).collect().to_pandas()
-    pdf = t["lineitem"].to_pandas()
-    pdf = pdf[pdf.l_shipdate <= pd.Timestamp("1998-09-02").date()]
-    dp = pdf.l_extendedprice * (1.0 - pdf.l_discount)
-    exp = (pd.DataFrame({
-        "rf": pdf.l_returnflag, "ls": pdf.l_linestatus,
-        "q": pdf.l_quantity, "p": pdf.l_extendedprice, "dp": dp,
-        "ch": dp * (1.0 + pdf.l_tax), "d": pdf.l_discount})
-        .groupby(["rf", "ls"])
-        .agg(sum_qty=("q", "sum"), sum_base_price=("p", "sum"),
-             sum_disc_price=("dp", "sum"), sum_charge=("ch", "sum"),
-             avg_qty=("q", "mean"), avg_price=("p", "mean"),
-             avg_disc=("d", "mean"), count_order=("q", "size"))
-        .sort_index().reset_index())
-    assert list(got["l_returnflag"]) == list(exp["rf"])
-    assert list(got["l_linestatus"]) == list(exp["ls"])
-    for col in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
-                "avg_qty", "avg_price", "avg_disc"):
-        assert np.allclose(got[col], exp[col]), col
-    assert np.array_equal(got["count_order"], exp["count_order"])
+    _q1_oracle_check(got, t["lineitem"])
 
 
 def _tpch_q6_sql(sess, t, F):
@@ -273,14 +268,7 @@ def _tpch_q6_sql(sess, t, F):
     sess.create_dataframe(t["lineitem"], num_partitions=4) \
         .createOrReplaceTempView("lineitem")
     got = sess.sql(_TPCH_Q6_SQL).collect().to_pandas()
-    pdf = t["lineitem"].to_pandas()
-    lo = pd.Timestamp("1994-01-01").date()
-    hi = pd.Timestamp("1995-01-01").date()
-    m = ((pdf.l_shipdate >= lo) & (pdf.l_shipdate < hi)
-         & (pdf.l_discount >= 0.05) & (pdf.l_discount <= 0.07)
-         & (pdf.l_quantity < 24.0))
-    exp = float((pdf.l_extendedprice[m] * pdf.l_discount[m]).sum())
-    assert np.allclose(got["revenue"].fillna(0.0), exp)
+    _q6_oracle_check(got, t["lineitem"])
 
 
 def build_tpcds_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
