@@ -157,21 +157,35 @@ def _sorted_ids(jnp, keys, row_mask):
     the high-cardinality fallback on backends where sorts beat probe
     rounds (TPU).  Identical output to the probe kernel: dense ids in
     [0, n_groups) in first-occurrence order, dead rows parked at cap-1."""
-    from .ranks import lex_sort
+    from .ranks import _ranks_from_lex, lex_sort
     cap = int(row_mask.shape[0])
     # liveness leads the sort key: live rows sort first, so live ranks are
     # exactly [0, n_groups)
     sort_keys = [(~row_mask).astype(jnp.int64)] + list(keys)
     perm, skeys = lex_sort(jnp, sort_keys)
-    diff = jnp.zeros((cap - 1,), dtype=bool)
-    for k in skeys:
-        diff = diff | (k[1:] != k[:-1])
-    first = jnp.concatenate([jnp.ones((1,), dtype=bool), diff])
-    ranks_sorted = jnp.cumsum(first.astype(jnp.int64)) - 1
-    rank = jnp.zeros(cap, dtype=jnp.int64).at[perm].set(ranks_sorted)
+    rank = _ranks_from_lex(jnp, perm, skeys)
     # remap sorted-key rank order -> first-occurrence order (the probe
     # kernel's order, and the host path's) without a second sort
     return _first_occurrence_ids(jnp, jnp.clip(rank, 0, cap), row_mask, cap)
+
+
+def _device_ids(jnp, cols, row_mask, make_probe):
+    """Shared device-path scaffolding for :func:`group_ids` /
+    :func:`group_ids_small`: build each key word ONCE (shared by the
+    compact prelude and the fallback), then dispatch
+    ``lax.cond(compact_ok, compact, fallback)`` where the fallback is the
+    caller's probe kernel on XLA CPU or the sorted kernel on TPU."""
+    import jax
+    col_words = [((~c.validity), column_sort_keys(jnp, c)) for c in cols]
+    keys = [w for nulls, ws in col_words
+            for w in (nulls.astype(jnp.int64), *ws)]
+    compact_ok, compact_codes = _compact_prelude(jnp, col_words, row_mask)
+    fallback = make_probe(keys) if _probe_beats_sort(jnp) else (
+        lambda _: _sorted_ids(jnp, keys, row_mask))
+    return jax.lax.cond(compact_ok,
+                        lambda _: _compact_finish(jnp, compact_codes,
+                                                  row_mask),
+                        fallback, None)
 
 
 def group_ids(xp, cols, row_mask):
@@ -202,13 +216,11 @@ def group_ids(xp, cols, row_mask):
     import jax.numpy as jnp
 
     cap = int(row_mask.shape[0])
-    # each key word computed ONCE, shared by the prelude and the fallback
-    col_words = [((~c.validity), column_sort_keys(jnp, c)) for c in cols]
-    keys = [w for nulls, ws in col_words
-            for w in (nulls.astype(jnp.int64), *ws)]
-    compact_ok, compact_codes = _compact_prelude(jnp, col_words, row_mask)
 
-    def probe(_):
+    def make_probe(keys):
+        return lambda _: _probe_impl(keys)
+
+    def _probe_impl(keys):
         M = 1 << (max(2 * cap, 16) - 1).bit_length()
         mask_m = np.uint32(M - 1)
         h = _hash_words(jnp, keys)
@@ -255,12 +267,7 @@ def group_ids(xp, cols, row_mask):
         ids = dense[jnp.clip(rep, 0, cap - 1)]
         return jnp.where(row_mask, ids, cap - 1)
 
-    fallback = probe if _probe_beats_sort(jnp) else (
-        lambda _: _sorted_ids(jnp, keys, row_mask))
-    return jax.lax.cond(compact_ok,
-                        lambda _: _compact_finish(jnp, compact_codes,
-                                                  row_mask),
-                        fallback, None)
+    return _device_ids(jnp, cols, row_mask, make_probe)
 
 
 def group_ids_small(xp, cols, row_mask, expected_groups: int):
@@ -284,13 +291,10 @@ def group_ids_small(xp, cols, row_mask, expected_groups: int):
     import jax
     import jax.numpy as jnp
 
-    # each key word computed ONCE, shared by the prelude and the fallback
-    col_words = [((~c.validity), column_sort_keys(jnp, c)) for c in cols]
-    keys = [w for nulls, ws in col_words
-            for w in (nulls.astype(jnp.int64), *ws)]
-    compact_ok, compact_codes = _compact_prelude(jnp, col_words, row_mask)
+    def make_probe(keys):
+        return lambda _: _probe_impl(keys)
 
-    def probe(_):
+    def _probe_impl(keys):
         M = 1 << (max(4 * int(expected_groups), 64) - 1).bit_length()
         M2 = min(M, 1 << (max(2 * cap, 16) - 1).bit_length())
         max_rounds = min(M2, 64)
@@ -347,9 +351,4 @@ def group_ids_small(xp, cols, row_mask, expected_groups: int):
     # above the speculated table size is caught by the same ng check.
     # The sorted fallback (TPU) is likewise exact — overflow burning only
     # applies to the bounded probe.
-    fallback = probe if _probe_beats_sort(jnp) else (
-        lambda _: _sorted_ids(jnp, keys, row_mask))
-    return jax.lax.cond(compact_ok,
-                        lambda _: _compact_finish(jnp, compact_codes,
-                                                  row_mask),
-                        fallback, None)
+    return _device_ids(jnp, cols, row_mask, make_probe)
