@@ -123,3 +123,30 @@ def test_mesh_repartition_preserves_rows(ici_sess, rng):
     a = sorted(zip(got["k"].to_pylist(), got["v"].to_pylist()))
     b = sorted(zip(t["k"].to_pylist(), t["v"].to_pylist()))
     assert a == b
+
+
+@pytest.fixture(scope="module")
+def tpcds_rig():
+    """TPC-DS tables + ICI session amortized across the star-join cases
+    (same pattern as scaletest.run_suite's table cache)."""
+    from spark_rapids_tpu.testing import scaletest as ST
+    t = ST.build_tpcds_tables(6000)
+    sess = srt.session(**ICI_CONF,
+                       **{"spark.rapids.sql.autoBroadcastJoinThreshold": 1})
+    return ST, t, sess
+
+
+@pytest.mark.parametrize("qname", ["tpcds_q3_star_join",
+                                   "tpcds_q19_brand_rev",
+                                   "tpcds_q42_cat_rev"])
+def test_mesh_tpcds_star_joins(qname, tpcds_rig):
+    """BASELINE milestone-3 analog: TPC-DS star-join query shapes executed
+    over the 8-device mesh — every shuffle exchange rides the compiled
+    all_to_all ICI plane, results checked against the rig's pandas oracle
+    (reference target: TPC-DS join subset on 8 chips, BASELINE.md)."""
+    ST, t, sess = tpcds_rig
+    fn = dict(ST.QUERIES)[qname]
+    before = M.STATS["mesh_exchanges"]
+    fn(sess, t, F)  # oracle asserts inside
+    assert M.STATS["mesh_exchanges"] > before, \
+        "star join did not ride the mesh data plane"
