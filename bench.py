@@ -163,6 +163,17 @@ def main():
     wd.daemon = True
     wd.start()
 
+    # Local-dev override: the ambient sitecustomize forces the axon tunnel
+    # platform via jax.config (env vars can't override it).  The driver
+    # leaves this unset so the real chip is used.  MUST run before the
+    # package import below — its persistent-cache setup is platform-gated
+    # (CPU AOT cache entries are a SIGILL hazard; TPU remote compiles are
+    # the thing worth caching).
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
     # Persistent XLA compilation cache: first-compile on the TPU tunnel
     # costs 20-60s per program; the package configures a host-scoped cache
     # dir under the repo, amortizing compiles across driver runs.
@@ -171,14 +182,7 @@ def main():
     except Exception:
         pass
 
-    # Local-dev override: the ambient sitecustomize forces the axon tunnel
-    # platform via jax.config (env vars can't override it).  The driver
-    # leaves this unset so the real chip is used.
-    plat = os.environ.get("BENCH_PLATFORM")
-    if plat:
-        import jax
-        jax.config.update("jax_platforms", plat)
-    elif not _device_responsive(60.0):
+    if not plat and not _device_responsive(60.0):
         # tunnel hung: re-exec onto the CPU platform so the bench still
         # produces a real number (noted as the fallback it is)
         import subprocess

@@ -42,6 +42,14 @@ def _host_fingerprint() -> str:
 
 
 try:  # pragma: no cover - depends on jax version/backend
+    # CPU-platform processes skip the cache entirely: XLA:CPU AOT entries
+    # embed compile-machine pseudo-features (+prefer-no-scatter/-gather)
+    # that fail the loader's host check — observed as SIGILL-class fatal
+    # crashes mid-suite — and CPU compiles are cheap to redo.  The cache
+    # exists for the REMOTE TPU compiler (20-60s per program).
+    _plat = str(_jax.config.jax_platforms or "")
+    if _plat.split(",")[0] == "cpu":
+        raise RuntimeError("cpu platform: persistent compile cache skipped")
     if not (_jax.config.jax_compilation_cache_dir
             or _os.environ.get("JAX_COMPILATION_CACHE_DIR")):
         # defer to any user-configured cache; otherwise default to a

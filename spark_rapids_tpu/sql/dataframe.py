@@ -209,6 +209,31 @@ class Column:
     def between(self, lo, hi):
         return (self >= lo) & (self <= hi)
 
+    # string predicates (pyspark Column API: bare str args are literals)
+    def startswith(self, other):
+        from . import functions as F
+        return F.startswith(self, other)
+
+    def endswith(self, other):
+        from . import functions as F
+        return F.endswith(self, other)
+
+    def contains(self, other):
+        from . import functions as F
+        return F.contains(self, other)
+
+    def like(self, pattern: str):
+        from . import functions as F
+        return F.like(self, pattern)
+
+    def rlike(self, pattern: str):
+        from . import functions as F
+        return F.rlike(self, pattern)
+
+    def substr(self, startPos, length_):
+        from . import functions as F
+        return F.substring(self, startPos, length_)
+
     def over(self, window_spec) -> "Column":
         from .expressions.windows import WindowExpression
         return Column(WindowExpression(self.expr,
@@ -369,6 +394,22 @@ class DataFrame:
         return GroupedData(self, exprs)
 
     groupby = groupBy
+
+    def rollup(self, *cols) -> "GroupedData":
+        """Hierarchical grouping sets: rollup(a, b) aggregates at
+        (a, b), (a) and () levels (reference GpuExpandExec — Spark lowers
+        rollup/cube to Expand + grouping-id aggregation)."""
+        exprs = tuple(self._resolve(c) for c in cols)
+        sets = [frozenset(range(i)) for i in range(len(exprs), -1, -1)]
+        return GroupedData(self, exprs, grouping_sets=sets)
+
+    def cube(self, *cols) -> "GroupedData":
+        """All-subsets grouping sets over the given keys."""
+        exprs = tuple(self._resolve(c) for c in cols)
+        n = len(exprs)
+        sets = [frozenset(i for i in range(n) if not (m >> (n - 1 - i)) & 1)
+                for m in range(1 << n)]
+        return GroupedData(self, exprs, grouping_sets=sets)
 
     def mapInPandas(self, func, schema) -> "DataFrame":
         """Apply ``func(Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame]``
@@ -889,13 +930,104 @@ def _extract_equi_keys(cond: Expression, left_plan, right_plan):
     return lk, rk, res
 
 
+def rollup_sets(n: int):
+    """Grouping sets for rollup(k0..kn-1): prefixes from full to empty."""
+    return [frozenset(range(i)) for i in range(n, -1, -1)]
+
+
+def cube_sets(n: int):
+    """Grouping sets for cube: every subset of the keys."""
+    return [frozenset(i for i in range(n) if not (m >> (n - 1 - i)) & 1)
+            for m in range(1 << n)]
+
+
+def grouping_sets_expand(plan: P.LogicalPlan, keys: Tuple[Expression, ...],
+                         sets) -> Tuple[P.Expand, Tuple[AttributeReference,
+                                                        ...],
+                                        AttributeReference]:
+    """Spark's grouping-sets lowering, shared by the DataFrame rollup/cube
+    API and the SQL GROUP BY ROLLUP/CUBE path: an Expand replicates each
+    input row once per grouping set (excluded keys nulled) and appends a
+    grouping-id column whose bit i (MSB = first key) is 1 when key i is
+    rolled up — the id keeps rollup-nulls distinct from genuinely-null
+    key values.  Returns (expand_plan, gset_key_attrs, grouping_id_attr);
+    callers group by ``gset_key_attrs + (grouping_id_attr,)``."""
+    nk = len(keys)
+    child_attrs = tuple(plan.output)
+    gkeys = tuple(AttributeReference(f"__gset_k{i}", keys[i].data_type, True)
+                  for i in range(nk))
+    gid_attr = AttributeReference("__grouping_id", T.LONG, False)
+    projections = []
+    for s in sets:
+        gid = sum(1 << (nk - 1 - i) for i in range(nk) if i not in s)
+        projections.append(child_attrs + tuple(
+            keys[i] if i in s else Literal(None, keys[i].data_type)
+            for i in range(nk)) + (Literal(gid, T.LONG),))
+    expanded = P.Expand(tuple(projections),
+                        child_attrs + gkeys + (gid_attr,), plan)
+    return expanded, gkeys, gid_attr
+
+
+def grouping_mark_resolver(keys: Tuple[Expression, ...],
+                           gid_attr: AttributeReference):
+    """transform() callback resolving grouping_id()/grouping(col) markers
+    against the lowered grouping-id column."""
+    from . import functions as F
+    nk = len(keys)
+
+    def resolve(x):
+        if isinstance(x, F.GroupingIDExpr):
+            return gid_attr
+        if isinstance(x, F.GroupingExpr):
+            tk = x.children[0].semantic_key()
+            for i, g in enumerate(keys):
+                if g.semantic_key() == tk:
+                    return Cast(A.BitwiseAnd(
+                        A.ShiftRight(gid_attr, Literal(nk - 1 - i)),
+                        Literal(1, T.LONG)), T.BYTE)
+            raise ValueError("grouping() argument is not a grouping column")
+        return None
+    return resolve
+
+
 class GroupedData:
-    def __init__(self, df: DataFrame, grouping: Tuple[Expression, ...]):
+    def __init__(self, df: DataFrame, grouping: Tuple[Expression, ...],
+                 grouping_sets=None):
         self._df = df
         self._grouping = grouping
+        #: rollup/cube: list of frozensets of included key positions
+        self._grouping_sets = grouping_sets
+
+    def _agg_grouping_sets(self, cols) -> DataFrame:
+        """rollup/cube lowering (reference: GpuExpandExec feeding
+        GpuHashAggregateExec) — see :func:`grouping_sets_expand`."""
+        keys = self._grouping
+        expanded, gkeys, gid_attr = grouping_sets_expand(
+            self._df._plan, keys, self._grouping_sets)
+        outs: List[Expression] = []
+        for i, g in enumerate(keys):
+            name = g.name if isinstance(g, (AttributeReference, Alias)) \
+                else g.sql()
+            outs.append(Alias(gkeys[i], name))
+        resolve_marks = grouping_mark_resolver(keys, gid_attr)
+        for c in cols:
+            e = _resolve_expr(_to_expr(c), self._df._plan)
+            if not isinstance(e, Alias):
+                e = Alias(e, e.sql())
+            outs.append(e.transform(resolve_marks))
+        return DataFrame(P.Aggregate(gkeys + (gid_attr,), tuple(outs),
+                                     expanded), self._df._session)
+
+    def _reject_grouping_sets(self, what: str) -> None:
+        if self._grouping_sets is not None:
+            raise ValueError(
+                f"rollup/cube grouping sets only support agg(); {what} "
+                "would silently drop the rolled-up levels")
 
     def agg(self, *cols) -> DataFrame:
         from .expressions.udf import GroupedAggPandasUDF
+        if self._grouping_sets is not None:
+            return self._agg_grouping_sets(cols)
         outs: List[Expression] = []
         for g in self._grouping:
             if isinstance(g, (AttributeReference, Alias)):
@@ -965,6 +1097,7 @@ class GroupedData:
     def cogroup(self, other: "GroupedData") -> "CoGroupedData":
         """Pair two grouped frames for cogrouped applyInPandas
         (reference GpuFlatMapCoGroupsInPandasExec)."""
+        self._reject_grouping_sets("cogroup()")
         return CoGroupedData(self, other)
 
     def pivot(self, pivot_col: str, values: Optional[Sequence] = None
@@ -974,6 +1107,7 @@ class GroupedData:
         reference accelerates as ``PivotFirst`` (GpuOverrides expr rule).
         Without ``values`` the distinct pivot values are collected eagerly
         (Spark does the same)."""
+        self._reject_grouping_sets("pivot()")
         if values is None:
             vals_df = self._df.select(self._df._col(pivot_col)).distinct()
             tab = vals_df.collect()
@@ -987,6 +1121,7 @@ class GroupedData:
         """``func(pd.DataFrame) -> pd.DataFrame`` per key group
         (reference GpuFlatMapGroupsInPandasExec).  Grouping keys must be
         plain columns (the pandas groupby downstream groups by NAME)."""
+        self._reject_grouping_sets("applyInPandas()")
         for g in self._grouping:
             base = g.child if isinstance(g, Alias) else g
             if not isinstance(base, AttributeReference):

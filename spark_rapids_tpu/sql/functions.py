@@ -198,6 +198,45 @@ def count(c="*"):
     return Column(AG.Count(_c(c)))
 
 
+class GroupingIDExpr(Expression):
+    """Marker resolved by rollup/cube lowering to the grouping-id column;
+    invalid anywhere else (Spark: grouping_id() outside grouping sets is
+    an analysis error)."""
+    children = ()
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LONG
+
+    def eval(self, ctx):
+        raise ValueError("grouping_id() is only valid in a rollup/cube/"
+                         "grouping-sets aggregate")
+
+
+class GroupingExpr(Expression):
+    """Marker for grouping(col): 1 when the key is rolled up (nulled by
+    the grouping set), else 0."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BYTE
+
+    def eval(self, ctx):
+        raise ValueError("grouping() is only valid in a rollup/cube/"
+                         "grouping-sets aggregate")
+
+
+def grouping_id():
+    return Column(GroupingIDExpr())
+
+
+def grouping(c):
+    return Column(GroupingExpr(_c(c)))
+
+
 def countDistinct(*cols):
     """count(DISTINCT a[, b...]): distinct fully-non-null tuples."""
     if not cols:

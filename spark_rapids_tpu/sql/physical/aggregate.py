@@ -26,8 +26,8 @@ from ...ops.segmented import seg_count, seg_max, seg_min, seg_sum
 from ..expressions.aggregates import (COUNT, FIRST, LAST, MAX, MIN, SUM,
                                       AggregateExpression, AggregateFunction,
                                       BufferSlot)
-from ..expressions.core import (Alias, AttributeReference, EvalContext,
-                                Expression, bind_references)
+from ..expressions.core import (Alias, AttributeReference, BoundReference,
+                                EvalContext, Expression, bind_references)
 from .base import TPU, PhysicalPlan, TaskContext
 
 
@@ -307,34 +307,98 @@ class HashAggregateExec(PhysicalPlan):
         self.grouping = list(grouping)
         self.agg_out = list(agg_out)
 
-        # split outputs into group refs and aggregate expressions
+        # split outputs into group refs, plain aggregates, and COMPOUND
+        # post-aggregation expressions (e.g. sum(a) * 100 / sum(b)): the
+        # latter register every contained aggregate as a slot source and
+        # keep the surrounding tree, re-evaluated over the finalized
+        # results (reference: Spark's resultExpressions on HashAggregate)
         self._agg_funcs: List[AggregateFunction] = []
-        self._out_spec: List[Tuple[str, int, str]] = []  # (kind, idx, name)
+        self._out_spec: List[Tuple[str, object, str]] = []  # (kind, idx, name)
+        self._post_exprs: List[Expression] = []  # for kind == "expr"
         group_keys = [g.semantic_key() for g in self.grouping]
+        nk_out = len(self.grouping)
+
+        seen_funcs: dict = {}
+
+        def register_agg(x) -> int:
+            """Returns the slot-source index, deduplicating semantically
+            identical aggregates (Spark's distinct aggregateExpressions:
+            count(*) repeated across outputs computes/ships ONE slot)."""
+            func = x
+            fk = func.semantic_key()
+            if isinstance(x, AggregateExpression):
+                if x.is_distinct:
+                    raise NotImplementedError(
+                        "DISTINCT aggregate reached the exec without "
+                        "the planner's dedup rewrite")
+                func = x.func
+                # FILTER (WHERE ...) clauses make otherwise-equal funcs
+                # distinct slot sources
+                fk = (func.semantic_key(),
+                      x.filter.semantic_key() if x.filter is not None
+                      else None)
+            else:
+                fk = (fk, None)
+            if fk in seen_funcs:
+                return seen_funcs[fk]
+            idx = len(self._agg_funcs)
+            seen_funcs[fk] = idx
+            self._agg_funcs.append(func)
+            return idx
+
+        def rewrite_post(x) -> Expression:
+            """Top-down: aggregate nodes -> bound refs into the finalized
+            layout [keys..., agg results...]; grouping subtrees -> key
+            refs.  Never descends INTO an aggregate (its children are
+            pre-aggregation inputs)."""
+            if isinstance(x, (AggregateExpression, AggregateFunction)):
+                idx = register_agg(x)
+                return BoundReference(nk_out + idx,
+                                      self._agg_funcs[idx].data_type, True)
+            sk = x.semantic_key()
+            if sk in group_keys:
+                gi = group_keys.index(sk)
+                g = self.grouping[gi]
+                return BoundReference(gi, g.data_type, True)
+            if isinstance(x, AttributeReference):
+                raise ValueError(
+                    f"column {x.name!r} in aggregate output is neither "
+                    "inside an aggregate nor a grouping expression")
+            if not x.children:
+                return x
+            return x.with_children(tuple(rewrite_post(c)
+                                         for c in x.children))
+
         for e in self.agg_out:
             name = e.name if isinstance(e, Alias) else (
                 e.name if isinstance(e, AttributeReference) else e.sql())
             inner = e.children[0] if isinstance(e, Alias) else e
             aggs = inner.collect(lambda x: isinstance(x, (AggregateExpression,
                                                           AggregateFunction)))
-            if aggs:
-                func = aggs[0]
-                if isinstance(func, AggregateExpression):
-                    if func.is_distinct:
-                        raise NotImplementedError(
-                            "DISTINCT aggregate reached the exec without "
-                            "the planner's dedup rewrite")
-                    func = func.func
-                self._out_spec.append(("agg", len(self._agg_funcs), name))
-                self._agg_funcs.append(func)
+            if aggs and inner is aggs[0]:
+                # plain aggregate output (possibly AggregateExpression-
+                # wrapped): one slot source, no surrounding arithmetic
+                self._out_spec.append(("agg", register_agg(inner), name))
+            elif aggs:
+                self._out_spec.append(("expr", len(self._post_exprs), name))
+                self._post_exprs.append(rewrite_post(inner))
             else:
                 sk = inner.semantic_key()
                 if sk in group_keys:
                     self._out_spec.append(("group", group_keys.index(sk), name))
                 else:
-                    raise ValueError(
-                        f"aggregate output {e.sql()} is neither a grouping "
-                        "expression nor an aggregate")
+                    # aggregate-free expression OVER grouping keys (e.g.
+                    # rollup's grouping() bit math): post-evaluate it;
+                    # rewrite_post raises if any column is not a key
+                    try:
+                        rewritten = rewrite_post(inner)
+                    except ValueError:
+                        raise ValueError(
+                            f"aggregate output {e.sql()} is neither a "
+                            "grouping expression nor an aggregate") from None
+                    self._out_spec.append(
+                        ("expr", len(self._post_exprs), name))
+                    self._post_exprs.append(rewritten)
 
         child_attrs = child.output
         if mode in ("final", "merge"):
@@ -378,8 +442,11 @@ class HashAggregateExec(PhysicalPlan):
             self._spec_key = self._partial_key  # no pre-steps yet
         merge_key = ("merge", len(self.grouping), slots_key)
         self._merge_fn = self._jit(self._merge_compute, key=merge_key)
-        self._finalize_key = ("finalize", len(self.grouping), slots_key,
-                              tuple(self._out_spec))
+        from .kernel_cache import exprs_key as _ek
+        self._finalize_key = (
+            "finalize", len(self.grouping), slots_key,
+            tuple((k, _ek([self._post_exprs[i]]) if k == "expr" else i, n)
+                  for k, i, n in self._out_spec))
 
     def _make_partial_fn(self, steps):
         """Build the partial kernel over an IMMUTABLE pre-step tuple.  The
@@ -430,6 +497,9 @@ class HashAggregateExec(PhysicalPlan):
             if kind == "group":
                 g = self.grouping[idx]
                 out.append(AttributeReference(name, g.data_type, g.nullable))
+            elif kind == "expr":
+                e = self._post_exprs[idx]
+                out.append(AttributeReference(name, e.data_type, True))
             else:
                 f = self._agg_funcs[idx]
                 out.append(AttributeReference(name, f.data_type, f.nullable))
@@ -683,10 +753,24 @@ class HashAggregateExec(PhysicalPlan):
             cnt = len(f.slots())
             func_results.append(f.evaluate(ctx, slots[si:si + cnt]))
             si += cnt
+        post_ctx = None
+        if any(kind == "expr" for kind, _, _ in self._out_spec):
+            # compound outputs evaluate over the finalized layout
+            # [keys..., agg results...] via pre-bound references
+            synth = ColumnarBatch(
+                tuple(f"__fin{i}" for i in
+                      range(len(keys) + len(func_results))),
+                tuple(keys) + tuple(func_results), batch.num_rows)
+            post_ctx = EvalContext(synth, xp=xp)
         cols, names = [], []
         for kind, idx, name in self._out_spec:
             names.append(name)
-            cols.append(keys[idx] if kind == "group" else func_results[idx])
+            if kind == "group":
+                cols.append(keys[idx])
+            elif kind == "agg":
+                cols.append(func_results[idx])
+            else:
+                cols.append(self._post_exprs[idx].eval(post_ctx))
         return ColumnarBatch(tuple(names), tuple(cols), batch.num_rows)
 
     _finalize_jit = None
@@ -778,10 +862,25 @@ class HashAggregateExec(PhysicalPlan):
                     lo, hi = ranges[fi]
                     r = f.evaluate(ctx, gs[lo:hi])
                     results[fi] = r.with_validity(r.validity & group_ok)
+            post_ctx = None
+            if self._post_exprs:
+                # compound outputs: evaluate over [keys..., agg results...]
+                synth = ColumnarBatch(
+                    tuple(f"__fin{i}" for i in
+                          range(len(gk) + len(self._agg_funcs))),
+                    tuple(gk) + tuple(results[fi]
+                                      for fi in range(len(self._agg_funcs))),
+                    n)
+                post_ctx = EvalContext(synth, xp=xp)
             cols, names = [], []
             for kind, idx, name in self._out_spec:
                 names.append(name)
-                cols.append(gk[idx] if kind == "group" else results[idx])
+                if kind == "group":
+                    cols.append(gk[idx])
+                elif kind == "expr":
+                    cols.append(self._post_exprs[idx].eval(post_ctx))
+                else:
+                    cols.append(results[idx])
             return ColumnarBatch(tuple(names), tuple(cols), n)
         return impl
 
@@ -833,8 +932,10 @@ class HashAggregateExec(PhysicalPlan):
         widths = {fi: bucket_width(
             max(self._agg_funcs[fi].max_width(maxc), 1))
             for fi in self._special}
+        from .kernel_cache import exprs_key as _ek
         key = ("special", OUT, tuple(sorted(widths.items())),
-               tuple(self._out_spec), self._partial_key)
+               tuple(self._out_spec), _ek(self._post_exprs),
+               self._partial_key)
         fn = self._jit(self._special_impl(OUT, widths), key=key)
         out = fn(batch2, mask, rank64, ng)
         # unfloored: a fully-filtered partition reports 0 rows, not 1

@@ -319,14 +319,22 @@ class Planner:
         key_attrs = inner.output[:nk]
         d_attrs = inner.output[nk:nk + nd]
         slot_attrs = inner.output[nk + nd:]
-        # slot range per regular func, in inner_aggs order
+        # slot range per regular func, in inner_aggs order.  The exec
+        # DEDUPS semantically identical aggregates into one slot set
+        # (HashAggregateExec.register_agg), so identical funcs must map
+        # to the SAME range here (no FILTER clauses on this path —
+        # _mixed_distinct_applies rejects them)
         ranges = {}
+        seen_ranges = {}
         off = 0
         for f in regular:
             base = f.func if isinstance(f, AggregateExpression) else f
-            n = len(base.slots())
-            ranges[id(f)] = (off, off + n)
-            off += n
+            fk = base.semantic_key()
+            if fk not in seen_ranges:
+                n = len(base.slots())
+                seen_ranges[fk] = (off, off + n)
+                off += n
+            ranges[id(f)] = seen_ranges[fk]
 
         def rewrite(e):
             if isinstance(e, AggregateExpression):

@@ -129,6 +129,7 @@ class SelectStmt:
     joins: List[JoinStep] = field(default_factory=list)
     where: Optional[Expression] = None
     group_by: List[Any] = field(default_factory=list)   # Expression | int
+    group_by_mode: Optional[str] = None                 # None|rollup|cube
     having: Optional[Expression] = None
     order_by: List[OrderItem] = field(default_factory=list)
     limit: Optional[int] = None
@@ -846,9 +847,19 @@ class Parser:
             stmt.where = self.parse_expression()
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            stmt.group_by.append(self._group_item())
-            while self.accept_op(","):
+            if self.at_kw("ROLLUP", "CUBE") and \
+                    self.peek(1).kind == "op" and self.peek(1).text == "(":
+                stmt.group_by_mode = self.peek().upper.lower()
+                self.next()
+                self.expect_op("(")
                 stmt.group_by.append(self._group_item())
+                while self.accept_op(","):
+                    stmt.group_by.append(self._group_item())
+                self.expect_op(")")
+            else:
+                stmt.group_by.append(self._group_item())
+                while self.accept_op(","):
+                    stmt.group_by.append(self._group_item())
         if self.accept_kw("HAVING"):
             stmt.having = self.parse_expression()
         # ORDER BY / LIMIT are parsed at the query-term level so they bind
@@ -1195,17 +1206,42 @@ class QueryBuilder:
                     "aggregate functions are not allowed in GROUP BY")
             groups.append(ge)
 
+        group_keys = [g.semantic_key() for g in groups]
         group_outs: List[Expression] = []
         group_attrs: List[AttributeReference] = []
-        for i, g in enumerate(groups):
-            if isinstance(g, AttributeReference):
-                group_outs.append(g)
-                group_attrs.append(g)
-            else:
-                a = Alias(g, f"__group_{i}")
+        gid_out = None
+        if stmt.group_by_mode:
+            # ROLLUP/CUBE: shared Expand lowering + grouping()/grouping_id()
+            # marker resolution (dataframe.grouping_sets_expand)
+            from .dataframe import (cube_sets, grouping_mark_resolver,
+                                    grouping_sets_expand, rollup_sets)
+            nk = len(groups)
+            sets = rollup_sets(nk) if stmt.group_by_mode == "rollup" \
+                else cube_sets(nk)
+            expanded, gkeys, gid_attr = grouping_sets_expand(
+                df._plan, tuple(groups), sets)
+            df = DataFrame(expanded, self.session)
+            resolve_marks = grouping_mark_resolver(tuple(groups), gid_attr)
+            items = [(n, e.transform(resolve_marks)) for n, e in items]
+            if having is not None:
+                having = having.transform(resolve_marks)
+            for i, g in enumerate(groups):
+                name = g.name if isinstance(g, AttributeReference) \
+                    else f"__group_{i}"
+                a = Alias(gkeys[i], name)
                 group_outs.append(a)
                 group_attrs.append(a.to_attribute())
-        group_keys = [g.semantic_key() for g in groups]
+            groups = list(gkeys) + [gid_attr]
+            gid_out = gid_attr
+        else:
+            for i, g in enumerate(groups):
+                if isinstance(g, AttributeReference):
+                    group_outs.append(g)
+                    group_attrs.append(g)
+                else:
+                    a = Alias(g, f"__group_{i}")
+                    group_outs.append(a)
+                    group_attrs.append(a.to_attribute())
 
         agg_aliases: Dict[Tuple, Alias] = {}
 
@@ -1265,6 +1301,8 @@ class QueryBuilder:
         # aggregate result
         allowed = {a.expr_id for a in group_attrs}
         allowed.update(al.expr_id for al in agg_aliases.values())
+        if gid_out is not None:
+            allowed.add(gid_out.expr_id)
         for name, e in new_items:
             for r in e.references():
                 if r.expr_id not in allowed:
@@ -1278,8 +1316,10 @@ class QueryBuilder:
                         f"HAVING column {r.name!r} must appear in GROUP BY "
                         "or be inside an aggregate function")
 
+        extra = (gid_out,) if gid_out is not None else ()
         plan = P.Aggregate(tuple(groups),
-                           tuple(group_outs) + tuple(agg_aliases.values()),
+                           tuple(group_outs) + extra
+                           + tuple(agg_aliases.values()),
                            df._plan)
         adf = DataFrame(plan, self.session)
         if new_having is not None:

@@ -146,8 +146,34 @@ def build_tpch_tables(rows: int, seed: int = 23) -> Dict[str, pa.Table]:
         "l_returnflag": pa.array(rng.choice(["A", "N", "R"], rows)),
         "l_linestatus": pa.array(rng.choice(["O", "F"], rows)),
         "l_shipdate": pa.array(ship.astype("datetime64[D]")),
+        # q4/q14 columns: order/part FKs + commit-vs-receipt lateness
+        "l_orderkey": pa.array(rng.integers(0, max(rows // 4, 1), rows)),
+        "l_partkey": pa.array(rng.integers(0, max(rows // 8, 1), rows)),
+        "l_commitdate": pa.array(
+            (ship + rng.integers(-30, 31, rows).astype("timedelta64[D]"))
+            .astype("datetime64[D]")),
+        "l_receiptdate": pa.array(
+            (ship + rng.integers(1, 31, rows).astype("timedelta64[D]"))
+            .astype("datetime64[D]")),
     })
-    return {"lineitem": lineitem}
+    n_ord = max(rows // 4, 1)
+    odate = base + rng.integers(0, 2406, n_ord).astype("timedelta64[D]")
+    orders = pa.table({
+        "o_orderkey": pa.array(np.arange(n_ord)),
+        "o_orderdate": pa.array(odate.astype("datetime64[D]")),
+        "o_orderpriority": pa.array(rng.choice(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"],
+            n_ord)),
+    })
+    n_part = max(rows // 8, 1)
+    part = pa.table({
+        "p_partkey": pa.array(np.arange(n_part)),
+        "p_type": pa.array(rng.choice(
+            ["PROMO BURNISHED COPPER", "PROMO PLATED BRASS",
+             "STANDARD POLISHED TIN", "ECONOMY ANODIZED STEEL",
+             "MEDIUM BRUSHED NICKEL"], n_part)),
+    })
+    return {"lineitem": lineitem, "orders": orders, "part": part}
 
 
 def _q1_oracle_check(got, lineitem_table):
@@ -224,6 +250,56 @@ def _tpch_q6(sess, t, F):
                 .alias("revenue"))
            .collect().to_pandas())
     _q6_oracle_check(got, t["lineitem"])
+
+
+def _tpch_q4(sess, t, F):
+    """TPC-H q4 shape: EXISTS subquery as a LEFT SEMI join (late lineitems
+    per order), priority counts — exercises the semi-join planning path on
+    a benchmark query (reference: semi joins via GpuHashJoin)."""
+    import datetime
+    lo, hi = datetime.date(1993, 7, 1), datetime.date(1993, 10, 1)
+    o = sess.create_dataframe(t["orders"], num_partitions=4)
+    li = sess.create_dataframe(t["lineitem"], num_partitions=4)
+    late = li.filter(li.l_commitdate < li.l_receiptdate)
+    got = (o.filter((o.o_orderdate >= F.lit(lo)) & (o.o_orderdate < F.lit(hi)))
+           .join(late, o.o_orderkey == late.l_orderkey, how="left_semi")
+           .groupBy("o_orderpriority")
+           .agg(F.count("*").alias("order_count"))
+           .orderBy("o_orderpriority")
+           .collect().to_pandas())
+    op = t["orders"].to_pandas()
+    lp = t["lineitem"].to_pandas()
+    late_keys = set(lp.l_orderkey[lp.l_commitdate < lp.l_receiptdate])
+    op = op[(op.o_orderdate >= lo) & (op.o_orderdate < hi)
+            & op.o_orderkey.isin(late_keys)]
+    exp = (op.groupby("o_orderpriority").size()
+           .sort_index().reset_index(name="order_count"))
+    assert list(got["o_orderpriority"]) == list(exp["o_orderpriority"])
+    assert np.array_equal(got["order_count"], exp["order_count"])
+
+
+def _tpch_q14(sess, t, F):
+    """TPC-H q14 shape: join + conditional aggregation (CASE WHEN p_type
+    LIKE 'PROMO%') — promo revenue percentage."""
+    import datetime
+    lo, hi = datetime.date(1995, 9, 1), datetime.date(1995, 10, 1)
+    li = sess.create_dataframe(t["lineitem"], num_partitions=4)
+    p = sess.create_dataframe(t["part"], num_partitions=2)
+    j = (li.filter((li.l_shipdate >= F.lit(lo)) & (li.l_shipdate < F.lit(hi)))
+         .join(p, li.l_partkey == p.p_partkey))
+    rev = j.l_extendedprice * (1.0 - j.l_discount)
+    got = (j.agg((F.sum(F.when(j.p_type.startswith("PROMO"), rev)
+                        .otherwise(0.0)) * 100.0
+                  / F.sum(rev)).alias("promo_revenue"))
+           .collect().to_pandas())
+    lp = t["lineitem"].to_pandas()
+    pp = t["part"].to_pandas()
+    m = (lp.l_shipdate >= lo) & (lp.l_shipdate < hi)
+    jp = lp[m].merge(pp, left_on="l_partkey", right_on="p_partkey")
+    r = jp.l_extendedprice * (1.0 - jp.l_discount)
+    promo = r[jp.p_type.str.startswith("PROMO")].sum()
+    exp = 100.0 * promo / r.sum()
+    assert np.allclose(got["promo_revenue"].fillna(0.0), exp)
 
 
 #: TPC-H q1 as SQL text (spec form; the interval-arithmetic cutoff is the
@@ -480,7 +556,9 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("q5_global_sort", _q5),
     ("q6_strings", _q6),
     ("tpch_q1", _tpch_q1),
+    ("tpch_q4_semi_join", _tpch_q4),
     ("tpch_q6", _tpch_q6),
+    ("tpch_q14_promo_case", _tpch_q14),
     ("tpch_q1_sql", _tpch_q1_sql),
     ("tpch_q6_sql", _tpch_q6_sql),
     ("tpcds_q3_star_join", _tpcds_q3),

@@ -27,6 +27,23 @@ import pyarrow as pa  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs():
+    """Free XLA executables between test modules.
+
+    A full-suite run compiles thousands of programs into one process;
+    past ~90% of the suite the XLA:CPU JIT segfaulted inside
+    backend_compile_and_load (reproduced twice, never in any module run
+    alone — accumulated compiled-code state, not a specific test).
+    Dropping the engine's kernel wrappers AND jax's executable caches per
+    module keeps the compiler's footprint bounded; modules recompile
+    their shared kernels, which is noise next to the crash it prevents."""
+    yield
+    from spark_rapids_tpu.sql.physical import kernel_cache
+    kernel_cache.clear_cache()
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

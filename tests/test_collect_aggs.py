@@ -161,3 +161,76 @@ def test_percentile_non_numeric_falls_back_to_host(sess):
     assert "CpuHashAggregate" in sess.explain(q)
     # and the host engine still answers (single string = its own median)
     assert q.collect().to_pylist() == [{"k": 1, "p": "x"}]
+
+
+def test_compound_agg_expression_global(sess):
+    """Arithmetic AROUND aggregates (sum(a)*100/sum(b)) must evaluate the
+    whole tree, not just the first aggregate (TPC-H q14 shape)."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+    n = 5000
+    t = pa.table({"a": rng.random(n), "b": rng.random(n) + 0.5})
+    df = sess.create_dataframe(t)
+    got = df.agg((F.sum(df.a) * 100.0 / F.sum(df.b)).alias("r")) \
+            .collect().to_pylist()[0]["r"]
+    pdf = t.to_pandas()
+    assert np.isclose(got, 100.0 * pdf.a.sum() / pdf.b.sum())
+
+
+def test_compound_agg_expression_grouped_with_key(sess):
+    """Compound outputs may also reference grouping expressions."""
+    import numpy as np
+    rng = np.random.default_rng(12)
+    n = 4000
+    t = pa.table({"k": rng.integers(0, 6, n), "v": rng.random(n)})
+    df = sess.create_dataframe(t)
+    got = (df.groupBy("k")
+           .agg((F.sum(df.v) / F.count("*")).alias("mean_v"),
+                (F.max(df.v) - F.min(df.v)).alias("range_v"),
+                (F.col("k") * 1000 + F.count("*")).alias("k_tag"))
+           .orderBy("k").collect().to_pandas())
+    pdf = t.to_pandas().groupby("k").agg(
+        mean_v=("v", "mean"), range_v=("v", lambda s: s.max() - s.min()),
+        c=("v", "size")).reset_index()
+    assert np.allclose(got["mean_v"], pdf["mean_v"])
+    assert np.allclose(got["range_v"], pdf["range_v"])
+    assert np.array_equal(got["k_tag"], pdf["k"] * 1000 + pdf["c"])
+
+
+def test_compound_agg_mixed_with_collect_list(sess):
+    """Compound outputs must stay correct when the node also carries a
+    shuffle-complete aggregate (routes through _execute_special)."""
+    import numpy as np
+    rng = np.random.default_rng(13)
+    n = 2000
+    t = pa.table({"k": rng.integers(0, 4, n), "v": rng.random(n)})
+    df = sess.create_dataframe(t)
+    got = (df.groupBy("k")
+           .agg(F.collect_list(df.v).alias("lst"),
+                (F.sum(df.v) / F.count("*")).alias("mean_v"))
+           .orderBy("k").collect().to_pandas())
+    pdf = t.to_pandas().groupby("k").agg(
+        mean_v=("v", "mean"), c=("v", "size")).reset_index()
+    assert np.allclose(got["mean_v"], pdf["mean_v"])
+    assert [len(x) for x in got["lst"]] == list(pdf["c"])
+
+
+def test_mixed_distinct_with_duplicate_regular_aggs(sess):
+    """Duplicate regular aggregates dedup to ONE slot set; the mixed
+    DISTINCT planner path must map both outputs to the same slot range."""
+    import numpy as np
+    rng = np.random.default_rng(31)
+    n = 6000
+    t = pa.table({"k": rng.integers(0, 5, n), "v": rng.integers(0, 50, n),
+                  "w": rng.random(n)})
+    df = sess.create_dataframe(t, num_partitions=4)
+    got = (df.groupBy("k")
+           .agg(F.countDistinct("v").alias("d"), F.sum(df.w).alias("a"),
+                F.sum(df.w).alias("b"), F.max(df.w).alias("m"))
+           .orderBy("k").collect().to_pandas())
+    pdf = t.to_pandas().groupby("k").agg(
+        d=("v", "nunique"), a=("w", "sum"), m=("w", "max")).reset_index()
+    assert np.array_equal(got["d"], pdf["d"])
+    assert np.allclose(got["a"], pdf["a"])
+    assert np.allclose(got["b"], pdf["a"])
+    assert np.allclose(got["m"], pdf["m"])

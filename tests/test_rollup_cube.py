@@ -1,0 +1,118 @@
+"""rollup/cube grouping sets — Spark lowers these to Expand + one
+aggregate keyed by (keys..., grouping_id); the reference accelerates the
+Expand (GpuExpandExec.scala) and the aggregate.  Oracle: pandas per-level
+group-bys."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.default_rng(21)
+    n = 10_000
+    return pa.table({"a": rng.integers(0, 3, n),
+                     "b": rng.integers(0, 4, n),
+                     "v": rng.random(n)})
+
+
+def _levels(t):
+    pdf = t.to_pandas()
+    l0 = pdf.groupby(["a", "b"]).agg(sv=("v", "sum")).reset_index()
+    l1 = pdf.groupby(["a"]).agg(sv=("v", "sum")).reset_index()
+    return pdf, l0, l1
+
+
+def test_rollup_dataframe(sess, data):
+    pdf, l0, l1 = _levels(data)
+    got = (sess.create_dataframe(data).rollup("a", "b")
+           .agg(F.sum(F.col("v")).alias("sv"),
+                F.grouping_id().alias("gid"),
+                F.grouping(F.col("b")).alias("gb"))
+           .orderBy("gid", "a", "b").collect().to_pandas())
+    assert len(got) == len(l0) + len(l1) + 1
+    g0 = got[got.gid == 0]
+    assert np.allclose(sorted(g0["sv"]), sorted(l0["sv"]))
+    assert g0["gb"].eq(0).all()
+    g1 = got[got.gid == 1]
+    assert np.allclose(sorted(g1["sv"]), sorted(l1["sv"]))
+    assert g1["b"].isna().all() and g1["gb"].eq(1).all()
+    g3 = got[got.gid == 3]
+    assert len(g3) == 1 and np.isclose(g3["sv"].iloc[0], pdf.v.sum())
+
+
+def test_rollup_distinguishes_real_null_keys(sess, data):
+    """A genuinely-NULL key value must not merge with the rollup total."""
+    t = pa.table({"a": pa.array([1, 1, None, None], type=pa.int64()),
+                  "v": [1.0, 2.0, 4.0, 8.0]})
+    got = (sess.create_dataframe(t).rollup("a")
+           .agg(F.sum(F.col("v")).alias("sv"),
+                F.grouping_id().alias("gid"))
+           .orderBy("gid", "a").collect().to_pandas())
+    # levels: (a=1: 3), (a=NULL: 12), (total: 15)
+    assert len(got) == 3
+    fine = got[got.gid == 0]
+    assert sorted(fine["sv"]) == [3.0, 12.0]
+    assert float(got[got.gid == 1]["sv"].iloc[0]) == 15.0
+
+
+def test_cube_dataframe(sess, data):
+    pdf, l0, l1 = _levels(data)
+    got = (sess.create_dataframe(data).cube("a", "b")
+           .agg(F.count("*").alias("c")).collect().to_pandas())
+    assert len(got) == len(l0) + len(l1) + pdf.b.nunique() + 1
+    assert got["c"].sum() == 4 * len(pdf)
+
+
+def test_rollup_sql(sess, data):
+    pdf, l0, l1 = _levels(data)
+    sess.create_dataframe(data).createOrReplaceTempView("t_rollup")
+    got = sess.sql(
+        "SELECT a, b, sum(v) AS sv FROM t_rollup "
+        "GROUP BY ROLLUP(a, b) ORDER BY a, b").collect().to_pandas()
+    assert len(got) == len(l0) + len(l1) + 1
+    tot = got[got.a.isna() & got.b.isna()]
+    assert np.isclose(tot["sv"].iloc[0], pdf.v.sum())
+    sub = got[got.a.notna() & got.b.isna()].sort_values("a")
+    assert np.allclose(sub["sv"], l1.sort_values("a")["sv"])
+
+
+def test_cube_sql_with_having(sess, data):
+    pdf, l0, l1 = _levels(data)
+    sess.create_dataframe(data).createOrReplaceTempView("t_cube")
+    got = sess.sql(
+        "SELECT a, b, count(*) AS c FROM t_cube "
+        "GROUP BY CUBE(a, b) HAVING count(*) > 0").collect()
+    assert got.num_rows == len(l0) + len(l1) + pdf.b.nunique() + 1
+
+
+def test_sql_grouping_markers(sess, data):
+    """grouping_id()/grouping() resolve in the SQL ROLLUP path too."""
+    pdf = data.to_pandas()
+    sess.create_dataframe(data).createOrReplaceTempView("t_gmark")
+    got = sess.sql(
+        "SELECT a, grouping_id() AS gid, grouping(a) AS ga, sum(v) AS sv "
+        "FROM t_gmark GROUP BY ROLLUP(a) ORDER BY gid, a"
+    ).collect().to_pandas()
+    assert got[got.gid == 0]["ga"].eq(0).all()
+    tot = got[got.gid == 1]
+    assert len(tot) == 1 and tot["ga"].iloc[0] == 1
+    assert np.isclose(tot["sv"].iloc[0], pdf.v.sum())
+
+
+def test_grouping_sets_reject_non_agg_consumers(sess, data):
+    df = sess.create_dataframe(data)
+    for call in (lambda g: g.applyInPandas(lambda p: p, "a long"),
+                 lambda g: g.pivot("b"),
+                 lambda g: g.cogroup(df.groupBy("a"))):
+        with pytest.raises(ValueError, match="rollup/cube"):
+            call(df.rollup("a"))
