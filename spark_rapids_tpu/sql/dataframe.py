@@ -400,16 +400,14 @@ class DataFrame:
         (a, b), (a) and () levels (reference GpuExpandExec — Spark lowers
         rollup/cube to Expand + grouping-id aggregation)."""
         exprs = tuple(self._resolve(c) for c in cols)
-        sets = [frozenset(range(i)) for i in range(len(exprs), -1, -1)]
-        return GroupedData(self, exprs, grouping_sets=sets)
+        return GroupedData(self, exprs,
+                           grouping_sets=rollup_sets(len(exprs)))
 
     def cube(self, *cols) -> "GroupedData":
         """All-subsets grouping sets over the given keys."""
         exprs = tuple(self._resolve(c) for c in cols)
-        n = len(exprs)
-        sets = [frozenset(i for i in range(n) if not (m >> (n - 1 - i)) & 1)
-                for m in range(1 << n)]
-        return GroupedData(self, exprs, grouping_sets=sets)
+        return GroupedData(self, exprs,
+                           grouping_sets=cube_sets(len(exprs)))
 
     def mapInPandas(self, func, schema) -> "DataFrame":
         """Apply ``func(Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame]``
@@ -615,6 +613,8 @@ class DataFrame:
         return DataFrame(P.Aggregate(keys, tuple(outs), self._plan),
                          self._session)
 
+    drop_duplicates = dropDuplicates
+
     def repartition(self, n: int, *cols) -> "DataFrame":
         exprs = tuple(self._resolve(c) for c in cols)
         return DataFrame(P.Repartition(n, exprs, self._plan), self._session)
@@ -702,6 +702,184 @@ class DataFrame:
         agg = P.Aggregate((), (Alias(Count(), "count"),), self._plan)
         t = self._session._execute(agg)
         return t.column("count").to_pylist()[0]
+
+    def tail(self, n: int) -> List[dict]:
+        """Last n rows (pyspark tail: collects, keeps the tail)."""
+        rows = self.collect().to_pylist()
+        return rows[-n:] if n > 0 else []
+
+    def toDF(self, *names: str) -> "DataFrame":
+        """Rename ALL columns positionally (pyspark toDF)."""
+        attrs = self._plan.output
+        if len(names) != len(attrs):
+            raise ValueError(
+                f"toDF() got {len(names)} names for {len(attrs)} columns")
+        return self.select(*[Column(Alias(a, n))
+                             for a, n in zip(attrs, names)])
+
+    def transform(self, func, *args, **kwargs) -> "DataFrame":
+        """Chainable df.transform(fn): fn(df, *args, **kwargs) -> df."""
+        out = func(self, *args, **kwargs)
+        if not isinstance(out, DataFrame):
+            raise TypeError("transform function must return a DataFrame")
+        return out
+
+    def colRegex(self, regex: str) -> List[Column]:
+        """Columns whose name matches the (java-style) regex.  pyspark
+        returns a single Column usable in select; a list selects the
+        same set here: ``df.select(*df.colRegex("`v.*`"))``."""
+        import re as _re
+        pat = regex.strip("`")
+        rx = _re.compile(pat)
+        return [Column(a) for a in self._plan.output
+                if rx.fullmatch(a.name)]
+
+    def unionByName(self, other: "DataFrame",
+                    allowMissingColumns: bool = False) -> "DataFrame":
+        """Union resolving columns BY NAME (pyspark unionByName)."""
+        from . import functions as F
+        mine = {a.name.lower(): a for a in self._plan.output}
+        theirs = {a.name.lower(): a for a in other._plan.output}
+        names = [a.name for a in self._plan.output]
+        extra = [a.name for a in other._plan.output
+                 if a.name.lower() not in mine]
+        if not allowMissingColumns:
+            if extra or len(mine) != len(theirs):
+                raise ValueError(
+                    "unionByName: column sets differ "
+                    f"(missing/extra: {extra or sorted(set(mine) - set(theirs))}); "
+                    "pass allowMissingColumns=True to null-fill")
+            left = self
+        else:
+            names = names + extra
+            left = self.select(*(
+                [Column(a) for a in self._plan.output]
+                + [F.lit(None).cast(theirs[n.lower()].dtype).alias(n)
+                   for n in extra]))
+        right_cols = []
+        for n in names:
+            a = theirs.get(n.lower())
+            if a is not None:
+                right_cols.append(Column(a).alias(n))
+            elif allowMissingColumns:
+                right_cols.append(
+                    F.lit(None).cast(mine[n.lower()].dtype).alias(n))
+            else:
+                raise ValueError(f"unionByName: column {n!r} missing from "
+                                 "the right side")
+        return left.union(other.select(*right_cols))
+
+    def randomSplit(self, weights: Sequence[float], seed: int = 0
+                    ) -> List["DataFrame"]:
+        """Disjoint random splits: one rand(seed) draw per row, threshold
+        filters per normalized weight bucket (rand is positionally
+        deterministic, so the splits partition the rows exactly)."""
+        from . import functions as F
+        if any(w < 0 for w in weights) or sum(weights) <= 0:
+            raise ValueError("randomSplit weights must be non-negative "
+                             "and sum > 0")
+        total = float(sum(weights))
+        r = F.rand(seed)
+        out, lo = [], 0.0
+        for i, w in enumerate(weights):
+            hi = 1.0 if i == len(weights) - 1 else lo + w / total
+            cond = (r >= F.lit(lo)) & (r < F.lit(hi))
+            out.append(self.filter(cond))
+            lo = hi
+        return out
+
+    def unpivot(self, ids, values=None, variableColumnName: str = "variable",
+                valueColumnName: str = "value") -> "DataFrame":
+        """Wide -> long (pyspark unpivot/melt): one Expand projection per
+        value column emitting (ids..., name-literal, value) — the same
+        Expand exec that powers rollup/cube."""
+        if isinstance(ids, str):
+            ids = [ids]
+        id_attrs = [self._resolve(c) for c in ids]
+        id_names = {a.name.lower() for a in id_attrs
+                    if isinstance(a, AttributeReference)}
+        if values is None:
+            values = [a.name for a in self._plan.output
+                      if a.name.lower() not in id_names]
+        elif isinstance(values, str):
+            values = [values]
+        val_attrs = [self._resolve(c) for c in values]
+        if not val_attrs:
+            raise ValueError("unpivot: no value columns (every column is "
+                             "an id column)")
+        vt = val_attrs[0].data_type
+        for a in val_attrs[1:]:
+            ct = T.common_type(vt, a.data_type)
+            if ct is None:
+                raise ValueError(
+                    "unpivot value columns have incompatible types: "
+                    f"{vt} vs {a.data_type}")
+            vt = ct
+        out_attrs = tuple(
+            AttributeReference(a.name if isinstance(a, AttributeReference)
+                               else f"_id{i}", a.data_type, a.nullable)
+            for i, a in enumerate(id_attrs)) + (
+            AttributeReference(variableColumnName, T.STRING, False),
+            AttributeReference(valueColumnName, vt, True))
+        projections = []
+        for name, a in zip(values, val_attrs):
+            v = a if a.data_type == vt else Cast(a, vt)
+            projections.append(tuple(id_attrs) + (Literal(str(name)), v))
+        return DataFrame(P.Expand(tuple(projections), out_attrs,
+                                  self._plan), self._session)
+
+    melt = unpivot
+
+    def foreach(self, f) -> None:
+        for row in self.collect().to_pylist():
+            f(row)
+
+    def foreachPartition(self, f) -> None:
+        """Invoke f once PER PARTITION with an iterator of row dicts
+        (pyspark contract: per-partition resource setup must see each
+        partition separately)."""
+        def runner(it):
+            rows = []
+            for pdf in it:
+                rows.extend(pdf.to_dict("records"))
+            f(iter(rows))
+            return iter(())
+        self.mapInPandas(runner, "p long").count()
+
+    # --- na / stat accessors (pyspark df.na / df.stat) -------------------
+    @property
+    def na(self) -> "DataFrameNaFunctions":
+        return DataFrameNaFunctions(self)
+
+    def fillna(self, value, subset=None) -> "DataFrame":
+        return DataFrameNaFunctions(self).fill(value, subset)
+
+    def dropna(self, how: str = "any", thresh: Optional[int] = None,
+               subset=None) -> "DataFrame":
+        return DataFrameNaFunctions(self).drop(how, thresh, subset)
+
+    def replace(self, to_replace, value=None, subset=None) -> "DataFrame":
+        return DataFrameNaFunctions(self).replace(to_replace, value, subset)
+
+    @property
+    def stat(self) -> "DataFrameStatFunctions":
+        return DataFrameStatFunctions(self)
+
+    def corr(self, col1: str, col2: str, method: str = "pearson") -> float:
+        return DataFrameStatFunctions(self).corr(col1, col2, method)
+
+    def cov(self, col1: str, col2: str) -> float:
+        return DataFrameStatFunctions(self).cov(col1, col2)
+
+    def approxQuantile(self, col, probabilities, relativeError=0.0):
+        return DataFrameStatFunctions(self).approxQuantile(
+            col, probabilities, relativeError)
+
+    def crosstab(self, col1: str, col2: str) -> "DataFrame":
+        return DataFrameStatFunctions(self).crosstab(col1, col2)
+
+    def freqItems(self, cols, support: float = 0.01) -> "DataFrame":
+        return DataFrameStatFunctions(self).freqItems(cols, support)
 
     def show(self, n: int = 20):
         print(self.limit(n).collect().to_pandas().to_string(index=False))
@@ -928,6 +1106,192 @@ def _extract_equi_keys(cond: Expression, left_plan, right_plan):
     for r in residual:
         res = r if res is None else And(res, r)
     return lk, rk, res
+
+
+class DataFrameNaFunctions:
+    """df.na — null handling (pyspark DataFrameNaFunctions)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+
+    @staticmethod
+    def _value_matches(value, dtype: T.DataType) -> bool:
+        if isinstance(value, bool):
+            return isinstance(dtype, T.BooleanType)
+        if isinstance(value, (int, float)):
+            return T.is_numeric(dtype)
+        if isinstance(value, str):
+            return isinstance(dtype, T.StringType)
+        return False
+
+    def fill(self, value, subset=None) -> DataFrame:
+        from . import functions as F
+        df = self._df
+        if isinstance(value, dict):
+            per_col = {k.lower(): v for k, v in value.items()}
+            subset = None
+        else:
+            per_col = None
+        names = None if subset is None else {
+            (s if isinstance(s, str) else str(s)).lower() for s in subset}
+        outs = []
+        for a in df._plan.output:
+            v = per_col.get(a.name.lower()) if per_col is not None else value
+            applies = v is not None and self._value_matches(v, a.dtype) \
+                and (names is None or a.name.lower() in names)
+            if applies:
+                outs.append(F.coalesce(Column(a),
+                                       F.lit(v).cast(a.dtype)).alias(a.name))
+            else:
+                outs.append(Column(a))
+        return df.select(*outs)
+
+    def drop(self, how: str = "any", thresh: Optional[int] = None,
+             subset=None) -> DataFrame:
+        from . import functions as F
+        df = self._df
+        attrs = df._plan.output
+        if subset is not None:
+            names = {s.lower() for s in subset}
+            attrs = [a for a in attrs if a.name.lower() in names]
+        if not attrs:
+            return df
+        if thresh is None:
+            if how not in ("any", "all"):
+                raise ValueError(
+                    f"how must be 'any' or 'all', got {how!r}")
+            thresh = len(attrs) if how == "any" else 1
+        cnt = None
+        for a in attrs:
+            term = Column(a).isNotNull().cast(T.INT)
+            cnt = term if cnt is None else cnt + term
+        return df.filter(cnt >= F.lit(thresh))
+
+    def replace(self, to_replace, value=None, subset=None) -> DataFrame:
+        from . import functions as F
+        df = self._df
+        if isinstance(to_replace, dict):
+            mapping = to_replace
+        elif isinstance(to_replace, (list, tuple)):
+            if not isinstance(value, (list, tuple)) \
+                    or len(value) != len(to_replace):
+                raise ValueError("replace: value list must match "
+                                 "to_replace list length")
+            mapping = dict(zip(to_replace, value))
+        else:
+            mapping = {to_replace: value}
+        names = None if subset is None else {s.lower() for s in subset}
+        outs = []
+        for a in df._plan.output:
+            if names is not None and a.name.lower() not in names:
+                outs.append(Column(a))
+                continue
+            col = Column(a)
+            expr = None
+            for old, new in mapping.items():
+                if not self._value_matches(old, a.dtype):
+                    continue
+                base = expr if expr is not None else F.when(
+                    col == F.lit(old).cast(a.dtype),
+                    F.lit(new).cast(a.dtype) if new is not None
+                    else F.lit(None).cast(a.dtype))
+                if expr is not None:
+                    base = expr.when(
+                        col == F.lit(old).cast(a.dtype),
+                        F.lit(new).cast(a.dtype) if new is not None
+                        else F.lit(None).cast(a.dtype))
+                expr = base
+            outs.append(col if expr is None
+                        else expr.otherwise(col).alias(a.name))
+        return df.select(*outs)
+
+
+class DataFrameStatFunctions:
+    """df.stat — statistics helpers (pyspark DataFrameStatFunctions)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+
+    def _moments(self, col1: str, col2: str):
+        from . import functions as F
+        df = self._df
+        x, y = df._col(col1), df._col(col2)
+        both = x.isNotNull() & y.isNotNull()
+        xd = F.when(both, x.cast(T.DOUBLE))
+        yd = F.when(both, y.cast(T.DOUBLE))
+        row = df.agg(
+            F.count(xd).alias("n"), F.sum(xd).alias("sx"),
+            F.sum(yd).alias("sy"), F.sum(xd * yd).alias("sxy"),
+            F.sum(xd * xd).alias("sxx"), F.sum(yd * yd).alias("syy"),
+        ).collect().to_pylist()[0]
+        return row
+
+    def cov(self, col1: str, col2: str) -> float:
+        """Sample covariance (Spark cov = covar_samp)."""
+        m = self._moments(col1, col2)
+        n = m["n"] or 0
+        if n < 2:
+            return float("nan")  # sample covariance undefined (Spark: null)
+        return (m["sxy"] - m["sx"] * m["sy"] / n) / (n - 1)
+
+    def corr(self, col1: str, col2: str, method: str = "pearson") -> float:
+        if method != "pearson":
+            raise ValueError("only pearson correlation is supported")
+        import math
+        m = self._moments(col1, col2)
+        n = m["n"] or 0
+        if n < 2:
+            return float("nan")
+        cov = m["sxy"] - m["sx"] * m["sy"] / n
+        vx = m["sxx"] - m["sx"] * m["sx"] / n
+        vy = m["syy"] - m["sy"] * m["sy"] / n
+        if vx <= 0 or vy <= 0:
+            return float("nan")
+        return cov / math.sqrt(vx * vy)
+
+    def approxQuantile(self, col, probabilities, relativeError=0.0):
+        from . import functions as F
+        cols = [col] if isinstance(col, str) else list(col)
+        probs = list(probabilities)
+        aggs = [F.percentile_approx(F.col(c), probs).alias(f"__q{i}")
+                for i, c in enumerate(cols)]
+        row = self._df.agg(*aggs).collect().to_pylist()[0]
+        out = [list(row[f"__q{i}"]) if row[f"__q{i}"] is not None
+               else [None] * len(probs) for i in range(len(cols))]
+        return out[0] if isinstance(col, str) else out
+
+    def crosstab(self, col1: str, col2: str) -> DataFrame:
+        """Pairwise frequency table (pyspark crosstab): one row per
+        distinct col1 value, one column per distinct col2 value."""
+        from . import functions as F
+        df = self._df
+        piv = df.groupBy(col1).pivot(col2).agg(F.count("*"))
+        count_cols = [a.name for a in piv._plan.output[1:]]
+        piv = piv.na.fill(0, subset=count_cols)
+        first = piv._plan.output[0]
+        # pyspark labels a NULL key 'null', distinct from a real 0/'0' key
+        renamed = [F.coalesce(Column(first).cast(T.STRING), F.lit("null"))
+                   .alias(f"{col1}_{col2}")]
+        renamed += [Column(a) for a in piv._plan.output[1:]]
+        return piv.select(*renamed)
+
+    def freqItems(self, cols, support: float = 0.01) -> DataFrame:
+        """Frequent items per column (single-row result of arrays).
+        Exact counts stand in for pyspark's sketch: items with frequency
+        >= support * count(*)."""
+        import pyarrow as pa
+        from . import functions as F
+        df = self._df
+        total = df.count()
+        floor = max(1, int(support * max(total, 1)))
+        arrays = {}
+        for c in cols:
+            counts = (df.groupBy(c).agg(F.count("*").alias("__n"))
+                      .collect().to_pylist())
+            arrays[f"{c}_freqItems"] = [
+                [r[c] for r in counts
+                 if r["__n"] >= floor and r[c] is not None]]
+        return df._session.create_dataframe(pa.table(arrays))
 
 
 def rollup_sets(n: int):

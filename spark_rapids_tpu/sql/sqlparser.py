@@ -1210,6 +1210,7 @@ class QueryBuilder:
         group_outs: List[Expression] = []
         group_attrs: List[AttributeReference] = []
         gid_out = None
+        resolve_marks = None
         if stmt.group_by_mode:
             # ROLLUP/CUBE: shared Expand lowering + grouping()/grouping_id()
             # marker resolution (dataframe.grouping_sets_expand)
@@ -1283,13 +1284,18 @@ class QueryBuilder:
                         e.name.lower() in out_by_name:
                     target = out_by_name[e.name.lower()]
                 else:
-                    target = strip(_resolve_or_err(
-                        self._bind_quals(e, scope), df._plan))
+                    target = _resolve_or_err(
+                        self._bind_quals(e, scope), df._plan)
+                    if resolve_marks is not None:
+                        # ORDER BY grouping_id()/grouping() in rollup/cube
+                        target = target.transform(resolve_marks)
+                    target = strip(target)
+                    ok_ids = {a.expr_id for a in group_attrs}
+                    ok_ids.update(al.expr_id for al in agg_aliases.values())
+                    if gid_out is not None:
+                        ok_ids.add(gid_out.expr_id)
                     for r in target.references():
-                        if r.expr_id not in {a.expr_id for a in group_attrs}\
-                                and r.expr_id not in {
-                                    al.expr_id
-                                    for al in agg_aliases.values()}:
+                        if r.expr_id not in ok_ids:
                             raise SqlParseError(
                                 f"ORDER BY column {r.name!r} must appear in "
                                 "GROUP BY or be inside an aggregate "

@@ -1,0 +1,131 @@
+"""pyspark API-parity batch: na/stat accessors, unionByName, unpivot,
+randomSplit, toDF/transform/colRegex/tail, crosstab/freqItems — thin
+compositions over existing execs, oracle-checked against pandas."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.default_rng(9)
+    n = 4000
+    v = pa.array([None if i % 7 == 0 else float(x)
+                  for i, x in enumerate(rng.random(n))])
+    return pa.table({"k": rng.integers(0, 4, n), "v": v,
+                     "w": rng.random(n),
+                     "s": [f"s{i % 3}" for i in range(n)]})
+
+
+def test_na_fill_drop_replace(sess, data):
+    df = sess.create_dataframe(data)
+    pdf = data.to_pandas()
+    assert df.fillna(0.0).filter(F.col("v").isNull()).count() == 0
+    assert df.na.drop(subset=["v"]).count() == int(pdf.v.notna().sum())
+    r = (df.replace(0, 99, subset=["k"]).groupBy("k")
+         .agg(F.count("*").alias("c")).collect().to_pandas())
+    assert 0 not in set(r["k"]) and 99 in set(r["k"])
+    # dict form + how=all
+    assert df.na.fill({"v": 1.5}).filter(F.col("v").isNull()).count() == 0
+    assert df.na.drop(how="all", subset=["v", "w"]).count() == len(pdf)
+
+
+def test_union_by_name(sess, data):
+    n = data.num_rows
+    df = sess.create_dataframe(data)
+    d2 = df.select(F.col("w"), F.col("k"), F.col("v"), F.col("s"))
+    assert df.unionByName(d2).count() == 2 * n
+    um = df.select("k", "v").unionByName(
+        d2.select("k", "w"), allowMissingColumns=True)
+    assert um.count() == 2 * n
+    assert set(um.collect().column_names) == {"k", "v", "w"}
+    with pytest.raises(ValueError):
+        df.select("k", "v").unionByName(d2.select("k", "w"))
+
+
+def test_todf_transform_colregex_tail(sess, data):
+    df = sess.create_dataframe(data)
+    assert df.toDF("a", "b", "c", "d").collect().column_names \
+        == ["a", "b", "c", "d"]
+    assert df.transform(lambda d: d.limit(5)).count() == 5
+    assert [c.expr.name for c in df.colRegex("`[kv]`")] == ["k", "v"]
+    assert len(df.tail(3)) == 3
+
+
+def test_random_split_partitions_rows(sess, data):
+    n = data.num_rows
+    df = sess.create_dataframe(data)
+    a, b = df.randomSplit([0.7, 0.3], seed=5)
+    ca, cb = a.count(), b.count()
+    assert ca + cb == n
+    assert 0.6 * n < ca < 0.8 * n
+
+
+def test_unpivot_matches_pandas(sess, data):
+    n = data.num_rows
+    df = sess.create_dataframe(data)
+    pdf = data.to_pandas()
+    up = df.unpivot(["k"], ["v", "w"]).collect().to_pandas()
+    assert len(up) == 2 * n
+    assert set(up["variable"]) == {"v", "w"}
+    assert np.allclose(sorted(up[up.variable == "w"]["value"]),
+                       sorted(pdf["w"]))
+
+
+def test_stat_functions(sess, data):
+    df = sess.create_dataframe(data)
+    pdf = data.to_pandas()
+    sub = pdf[["v", "w"]].dropna()
+    assert np.isclose(df.stat.corr("v", "w"), sub.v.corr(sub.w), atol=1e-9)
+    assert np.isclose(df.stat.cov("v", "w"), sub.v.cov(sub.w), atol=1e-9)
+    q = df.approxQuantile("w", [0.25, 0.5, 0.75], 0.0)
+    assert q[0] < q[1] < q[2]
+    ct = df.crosstab("k", "s").collect().to_pandas()
+    assert len(ct) == 4
+    assert ct.drop(columns=["k_s"]).to_numpy().sum() == len(pdf)
+    fi = df.freqItems(["k"], 0.1).collect().to_pylist()[0]
+    assert set(fi["k_freqItems"]) == {0, 1, 2, 3}
+
+
+def test_api_parity_edge_cases(sess, data):
+    import math
+    df = sess.create_dataframe(data)
+    # invalid how rejected; unpivot with no value columns rejected
+    with pytest.raises(ValueError):
+        df.na.drop(how="bogus")
+    with pytest.raises(ValueError):
+        df.select("k").unpivot("k")
+    # sample covariance undefined at n=1 (Spark: null)
+    one = sess.create_dataframe(pa.table({"x": [1.0], "y": [2.0]}))
+    assert math.isnan(one.stat.cov("x", "y"))
+    # crosstab: NULL key labeled 'null', distinct from a real 0 key
+    t2 = pa.table({"k": pa.array([1, 1, None, 0], type=pa.int64()),
+                   "s": ["a", "b", "a", "a"]})
+    ct = sess.create_dataframe(t2).stat.crosstab("k", "s") \
+        .collect().to_pandas()
+    assert {"null", "0", "1"} <= set(ct["k_s"])
+
+
+def test_foreach_partition_sees_each_partition(sess):
+    df = sess.create_dataframe(pa.table({"x": np.arange(100)}),
+                               num_partitions=4)
+    calls = []
+    df.foreachPartition(lambda it: calls.append(len(list(it))))
+    assert len(calls) == 4 and sum(calls) == 100
+
+
+def test_sql_rollup_order_by_grouping_id(sess, data):
+    sess.create_dataframe(data).createOrReplaceTempView("t_ob")
+    got = sess.sql(
+        "SELECT k, sum(w) AS sw FROM t_ob GROUP BY ROLLUP(k) "
+        "ORDER BY grouping_id(), k").collect().to_pandas()
+    assert np.isclose(got["sw"].iloc[-1], data.to_pandas().w.sum())
