@@ -769,7 +769,7 @@ class DataFrame:
                                  "the right side")
         return left.union(other.select(*right_cols))
 
-    def randomSplit(self, weights: Sequence[float], seed: int = 0
+    def randomSplit(self, weights: Sequence[float], seed=None
                     ) -> List["DataFrame"]:
         """Disjoint random splits: one rand(seed) draw per row, threshold
         filters per normalized weight bucket (rand is positionally
@@ -822,9 +822,11 @@ class DataFrame:
             AttributeReference(variableColumnName, T.STRING, False),
             AttributeReference(valueColumnName, vt, True))
         projections = []
-        for name, a in zip(values, val_attrs):
+        for raw, a in zip(values, val_attrs):
+            label = raw if isinstance(raw, str) else (
+                a.name if isinstance(a, AttributeReference) else a.sql())
             v = a if a.data_type == vt else Cast(a, vt)
-            projections.append(tuple(id_attrs) + (Literal(str(name)), v))
+            projections.append(tuple(id_attrs) + (Literal(label), v))
         return DataFrame(P.Expand(tuple(projections), out_attrs,
                                   self._plan), self._session)
 
@@ -1108,6 +1110,16 @@ def _extract_equi_keys(cond: Expression, left_plan, right_plan):
     return lk, rk, res
 
 
+def _subset_names(subset) -> Optional[set]:
+    """pyspark subset arg: str | tuple | list (a bare string is ONE
+    column name, not an iterable of characters)."""
+    if subset is None:
+        return None
+    if isinstance(subset, str):
+        subset = [subset]
+    return {str(s).lower() for s in subset}
+
+
 class DataFrameNaFunctions:
     """df.na — null handling (pyspark DataFrameNaFunctions)."""
 
@@ -1132,8 +1144,7 @@ class DataFrameNaFunctions:
             subset = None
         else:
             per_col = None
-        names = None if subset is None else {
-            (s if isinstance(s, str) else str(s)).lower() for s in subset}
+        names = _subset_names(subset)
         outs = []
         for a in df._plan.output:
             v = per_col.get(a.name.lower()) if per_col is not None else value
@@ -1151,8 +1162,8 @@ class DataFrameNaFunctions:
         from . import functions as F
         df = self._df
         attrs = df._plan.output
-        if subset is not None:
-            names = {s.lower() for s in subset}
+        names = _subset_names(subset)
+        if names is not None:
             attrs = [a for a in attrs if a.name.lower() in names]
         if not attrs:
             return df
@@ -1180,7 +1191,7 @@ class DataFrameNaFunctions:
             mapping = dict(zip(to_replace, value))
         else:
             mapping = {to_replace: value}
-        names = None if subset is None else {s.lower() for s in subset}
+        names = _subset_names(subset)
         outs = []
         for a in df._plan.output:
             if names is not None and a.name.lower() not in names:
@@ -1191,16 +1202,10 @@ class DataFrameNaFunctions:
             for old, new in mapping.items():
                 if not self._value_matches(old, a.dtype):
                     continue
-                base = expr if expr is not None else F.when(
-                    col == F.lit(old).cast(a.dtype),
-                    F.lit(new).cast(a.dtype) if new is not None
-                    else F.lit(None).cast(a.dtype))
-                if expr is not None:
-                    base = expr.when(
-                        col == F.lit(old).cast(a.dtype),
-                        F.lit(new).cast(a.dtype) if new is not None
-                        else F.lit(None).cast(a.dtype))
-                expr = base
+                cond = col == F.lit(old).cast(a.dtype)
+                val = F.lit(new).cast(a.dtype)
+                expr = F.when(cond, val) if expr is None \
+                    else expr.when(cond, val)
             outs.append(col if expr is None
                         else expr.otherwise(col).alias(a.name))
         return df.select(*outs)
@@ -1282,12 +1287,15 @@ class DataFrameStatFunctions:
         import pyarrow as pa
         from . import functions as F
         df = self._df
-        total = df.count()
-        floor = max(1, int(support * max(total, 1)))
         arrays = {}
+        floor = None
         for c in cols:
             counts = (df.groupBy(c).agg(F.count("*").alias("__n"))
                       .collect().to_pylist())
+            if floor is None:
+                # total row count = sum of any one column's group counts
+                total = sum(r["__n"] for r in counts)
+                floor = max(1, int(support * max(total, 1)))
             arrays[f"{c}_freqItems"] = [
                 [r[c] for r in counts
                  if r["__n"] >= floor and r[c] is not None]]
@@ -1308,28 +1316,34 @@ def cube_sets(n: int):
 def grouping_sets_expand(plan: P.LogicalPlan, keys: Tuple[Expression, ...],
                          sets) -> Tuple[P.Expand, Tuple[AttributeReference,
                                                         ...],
-                                        AttributeReference]:
+                                        Tuple[AttributeReference,
+                                              AttributeReference]]:
     """Spark's grouping-sets lowering, shared by the DataFrame rollup/cube
-    API and the SQL GROUP BY ROLLUP/CUBE path: an Expand replicates each
-    input row once per grouping set (excluded keys nulled) and appends a
-    grouping-id column whose bit i (MSB = first key) is 1 when key i is
-    rolled up — the id keeps rollup-nulls distinct from genuinely-null
-    key values.  Returns (expand_plan, gset_key_attrs, grouping_id_attr);
-    callers group by ``gset_key_attrs + (grouping_id_attr,)``."""
+    API and the SQL GROUP BY ROLLUP/CUBE/GROUPING SETS path: an Expand
+    replicates each input row once per grouping set (excluded keys
+    nulled) and appends two columns — the SET POSITION (unique per set,
+    so duplicate sets like GROUPING SETS((a),(a)) produce duplicate
+    result rows, Spark semantics) and the grouping-id bitmask (bit i,
+    MSB = first key, is 1 when key i is rolled up) that grouping()/
+    grouping_id() read.  Returns (expand_plan, gset_key_attrs,
+    (pos_attr, gid_attr)); callers group by
+    ``gset_key_attrs + (pos_attr, gid_attr)``."""
     nk = len(keys)
     child_attrs = tuple(plan.output)
     gkeys = tuple(AttributeReference(f"__gset_k{i}", keys[i].data_type, True)
                   for i in range(nk))
+    pos_attr = AttributeReference("__gset_pos", T.LONG, False)
     gid_attr = AttributeReference("__grouping_id", T.LONG, False)
     projections = []
-    for s in sets:
+    for pos, s in enumerate(sets):
         gid = sum(1 << (nk - 1 - i) for i in range(nk) if i not in s)
         projections.append(child_attrs + tuple(
             keys[i] if i in s else Literal(None, keys[i].data_type)
-            for i in range(nk)) + (Literal(gid, T.LONG),))
+            for i in range(nk)) + (Literal(pos, T.LONG),
+                                   Literal(gid, T.LONG)))
     expanded = P.Expand(tuple(projections),
-                        child_attrs + gkeys + (gid_attr,), plan)
-    return expanded, gkeys, gid_attr
+                        child_attrs + gkeys + (pos_attr, gid_attr), plan)
+    return expanded, gkeys, (pos_attr, gid_attr)
 
 
 def grouping_mark_resolver(keys: Tuple[Expression, ...],
@@ -1366,7 +1380,7 @@ class GroupedData:
         """rollup/cube lowering (reference: GpuExpandExec feeding
         GpuHashAggregateExec) — see :func:`grouping_sets_expand`."""
         keys = self._grouping
-        expanded, gkeys, gid_attr = grouping_sets_expand(
+        expanded, gkeys, (pos_attr, gid_attr) = grouping_sets_expand(
             self._df._plan, keys, self._grouping_sets)
         outs: List[Expression] = []
         for i, g in enumerate(keys):
@@ -1379,8 +1393,9 @@ class GroupedData:
             if not isinstance(e, Alias):
                 e = Alias(e, e.sql())
             outs.append(e.transform(resolve_marks))
-        return DataFrame(P.Aggregate(gkeys + (gid_attr,), tuple(outs),
-                                     expanded), self._df._session)
+        return DataFrame(P.Aggregate(gkeys + (pos_attr, gid_attr),
+                                     tuple(outs), expanded),
+                         self._df._session)
 
     def _reject_grouping_sets(self, what: str) -> None:
         if self._grouping_sets is not None:

@@ -129,7 +129,8 @@ class SelectStmt:
     joins: List[JoinStep] = field(default_factory=list)
     where: Optional[Expression] = None
     group_by: List[Any] = field(default_factory=list)   # Expression | int
-    group_by_mode: Optional[str] = None                 # None|rollup|cube
+    group_by_mode: Optional[str] = None           # None|rollup|cube|sets
+    grouping_sets_raw: List[List[Any]] = field(default_factory=list)
     having: Optional[Expression] = None
     order_by: List[OrderItem] = field(default_factory=list)
     limit: Optional[int] = None
@@ -847,19 +848,9 @@ class Parser:
             stmt.where = self.parse_expression()
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            if self.at_kw("ROLLUP", "CUBE") and \
-                    self.peek(1).kind == "op" and self.peek(1).text == "(":
-                stmt.group_by_mode = self.peek().upper.lower()
-                self.next()
-                self.expect_op("(")
-                stmt.group_by.append(self._group_item())
-                while self.accept_op(","):
-                    stmt.group_by.append(self._group_item())
-                self.expect_op(")")
-            else:
-                stmt.group_by.append(self._group_item())
-                while self.accept_op(","):
-                    stmt.group_by.append(self._group_item())
+            self._group_element(stmt)
+            while self.accept_op(","):
+                self._group_element(stmt)
         if self.accept_kw("HAVING"):
             stmt.having = self.parse_expression()
         # ORDER BY / LIMIT are parsed at the query-term level so they bind
@@ -872,6 +863,52 @@ class Parser:
             self.next()
             return int(t.text)
         return self.parse_expression()
+
+    def _group_element(self, stmt: "SelectStmt") -> None:
+        """One GROUP BY element: a plain item (always-grouped base key)
+        or ONE ROLLUP/CUBE/GROUPING SETS construct, mixable with base
+        keys (Spark 3 partial grouping: GROUP BY a, ROLLUP(b) =
+        {a} x rollup sets)."""
+        def one_construct(mode: str):
+            if stmt.group_by_mode is not None:
+                raise SqlParseError(
+                    "only one ROLLUP/CUBE/GROUPING SETS construct is "
+                    "supported per GROUP BY")
+            stmt.group_by_mode = mode
+        if self.at_kw("ROLLUP", "CUBE") and \
+                self.peek(1).kind == "op" and self.peek(1).text == "(":
+            one_construct(self.peek().upper.lower())
+            self.next()
+            self.expect_op("(")
+            exprs = [self._group_item()]
+            while self.accept_op(","):
+                exprs.append(self._group_item())
+            self.expect_op(")")
+            stmt.grouping_sets_raw = [exprs]
+            return
+        if self.at_kw("GROUPING") and self.peek(1).upper == "SETS" \
+                and self.peek(2).kind == "op" and self.peek(2).text == "(":
+            one_construct("sets")
+            self.next()
+            self.next()
+            self.expect_op("(")
+            while True:
+                one: List[Any] = []
+                if self.accept_op("("):
+                    # parenthesized (possibly empty) key list
+                    if not self.accept_op(")"):
+                        one.append(self._group_item())
+                        while self.accept_op(","):
+                            one.append(self._group_item())
+                        self.expect_op(")")
+                else:
+                    one.append(self._group_item())  # bare single key
+                stmt.grouping_sets_raw.append(one)
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return
+        stmt.group_by.append(self._group_item())
 
     def _order_by_clause(self) -> List[OrderItem]:
         out: List[OrderItem] = []
@@ -1175,36 +1212,63 @@ class QueryBuilder:
         from .dataframe import DataFrame, _resolve_expr
 
         # group expressions: ordinals, select aliases, or raw expressions
-        groups: List[Expression] = []
-        for g in stmt.group_by:
+        def resolve_group(g) -> Expression:
             if isinstance(g, int):
                 if not (1 <= g <= len(items)):
                     raise SqlParseError(
                         f"GROUP BY position {g} is out of range")
                 ge = items[g - 1][1]
-                if _has_agg(ge):
-                    raise SqlParseError(
-                        "aggregate functions are not allowed in GROUP BY")
-                groups.append(ge)
-                continue
-            ge = self._bind_quals(g, scope)
-            try:
-                ge = _resolve_expr(ge, df._plan)
-            except KeyError:
-                # select-list alias (GROUP BY alias) — Spark resolves the
-                # child column first, the alias second
-                name = ge.sql().lower() if not isinstance(
-                    ge, AttributeReference) else ge.name.lower()
-                match = [e for n, e in items if n.lower() == name]
-                if not match:
-                    raise SqlParseError(
-                        f"cannot resolve GROUP BY expression {g.sql()!r}"
-                    ) from None
-                ge = match[0]
+            else:
+                ge = self._bind_quals(g, scope)
+                try:
+                    ge = _resolve_expr(ge, df._plan)
+                except KeyError:
+                    # select-list alias (GROUP BY alias) — Spark resolves
+                    # the child column first, the alias second
+                    name = ge.sql().lower() if not isinstance(
+                        ge, AttributeReference) else ge.name.lower()
+                    match = [e for n, e in items if n.lower() == name]
+                    if not match:
+                        raise SqlParseError(
+                            f"cannot resolve GROUP BY expression "
+                            f"{g.sql()!r}") from None
+                    ge = match[0]
             if _has_agg(ge):
                 raise SqlParseError(
                     "aggregate functions are not allowed in GROUP BY")
-            groups.append(ge)
+            return ge
+
+        # base keys (GROUP BY a, ... before/around any construct) are
+        # included in EVERY grouping set (Spark 3 partial grouping sets)
+        groups: List[Expression] = [resolve_group(g) for g in stmt.group_by]
+        base_idx = frozenset(range(len(groups)))
+        explicit_sets = None
+        if stmt.group_by_mode:
+            from .dataframe import cube_sets, rollup_sets
+            keys_seen: Dict[Tuple, int] = {
+                g.semantic_key(): i for i, g in enumerate(groups)}
+
+            def key_index(ge: Expression) -> int:
+                k = ge.semantic_key()
+                if k not in keys_seen:
+                    keys_seen[k] = len(groups)
+                    groups.append(ge)
+                return keys_seen[k]
+
+            if stmt.group_by_mode == "sets":
+                # GROUPING SETS ((a,b),(a),()) — keys = union of the sets
+                # in first-appearance order; each set selects positions
+                explicit_sets = [
+                    base_idx | frozenset(key_index(resolve_group(g))
+                                         for g in raw)
+                    for raw in stmt.grouping_sets_raw]
+            else:
+                cidx = [key_index(resolve_group(g))
+                        for g in stmt.grouping_sets_raw[0]]
+                subs = rollup_sets(len(cidx)) \
+                    if stmt.group_by_mode == "rollup" else cube_sets(len(cidx))
+                explicit_sets = [
+                    base_idx | frozenset(cidx[i] for i in s) for s in subs]
 
         group_keys = [g.semantic_key() for g in groups]
         group_outs: List[Expression] = []
@@ -1212,15 +1276,11 @@ class QueryBuilder:
         gid_out = None
         resolve_marks = None
         if stmt.group_by_mode:
-            # ROLLUP/CUBE: shared Expand lowering + grouping()/grouping_id()
-            # marker resolution (dataframe.grouping_sets_expand)
-            from .dataframe import (cube_sets, grouping_mark_resolver,
-                                    grouping_sets_expand, rollup_sets)
-            nk = len(groups)
-            sets = rollup_sets(nk) if stmt.group_by_mode == "rollup" \
-                else cube_sets(nk)
-            expanded, gkeys, gid_attr = grouping_sets_expand(
-                df._plan, tuple(groups), sets)
+            # shared Expand lowering + grouping()/grouping_id() marker
+            # resolution (dataframe.grouping_sets_expand)
+            from .dataframe import grouping_mark_resolver, grouping_sets_expand
+            expanded, gkeys, (pos_attr, gid_attr) = grouping_sets_expand(
+                df._plan, tuple(groups), explicit_sets)
             df = DataFrame(expanded, self.session)
             resolve_marks = grouping_mark_resolver(tuple(groups), gid_attr)
             items = [(n, e.transform(resolve_marks)) for n, e in items]
@@ -1232,7 +1292,7 @@ class QueryBuilder:
                 a = Alias(gkeys[i], name)
                 group_outs.append(a)
                 group_attrs.append(a.to_attribute())
-            groups = list(gkeys) + [gid_attr]
+            groups = list(gkeys) + [pos_attr, gid_attr]
             gid_out = gid_attr
         else:
             for i, g in enumerate(groups):

@@ -116,3 +116,73 @@ def test_grouping_sets_reject_non_agg_consumers(sess, data):
                  lambda g: g.cogroup(df.groupBy("a"))):
         with pytest.raises(ValueError, match="rollup/cube"):
             call(df.rollup("a"))
+
+
+def test_sql_grouping_sets_explicit(sess, data):
+    """GROUP BY GROUPING SETS ((a,b),(a),()) — explicit set list."""
+    pdf = data.to_pandas()
+    sess.create_dataframe(data).createOrReplaceTempView("t_gsets")
+    got = sess.sql(
+        "SELECT a, b, sum(v) AS sv FROM t_gsets "
+        "GROUP BY GROUPING SETS ((a, b), (a), ()) ORDER BY a, b"
+    ).collect().to_pandas()
+    l0 = pdf.groupby(["a", "b"]).agg(sv=("v", "sum")).reset_index()
+    l1 = pdf.groupby(["a"]).agg(sv=("v", "sum")).reset_index()
+    assert len(got) == len(l0) + len(l1) + 1
+    tot = got[got.a.isna() & got.b.isna()]
+    assert np.isclose(tot["sv"].iloc[0], pdf.v.sum())
+
+
+def test_sql_grouping_sets_partial(sess, data):
+    """Sets that never group by the full tuple: ((a),(b))."""
+    pdf = data.to_pandas()
+    sess.create_dataframe(data).createOrReplaceTempView("t_gsets2")
+    got = sess.sql(
+        "SELECT a, b, count(*) AS c FROM t_gsets2 "
+        "GROUP BY GROUPING SETS ((a), (b))").collect().to_pandas()
+    assert len(got) == pdf.a.nunique() + pdf.b.nunique()
+    assert got["c"].sum() == 2 * len(pdf)
+
+
+def test_sql_grouping_sets_spark_semantics(sess, data):
+    """Duplicate sets produce duplicate rows (correct values, not doubled);
+    bare single-key elements and ordinals parse; base keys mix with a
+    construct (GROUP BY a, ROLLUP(b))."""
+    pdf = data.to_pandas()
+    sess.create_dataframe(data).createOrReplaceTempView("t_sem")
+    dup = sess.sql("SELECT a, sum(v) AS sv FROM t_sem "
+                   "GROUP BY GROUPING SETS ((a), (a))").collect().to_pandas()
+    l1 = pdf.groupby("a").agg(sv=("v", "sum")).reset_index()
+    assert len(dup) == 2 * len(l1)
+    assert np.allclose(sorted(dup["sv"]), sorted(list(l1["sv"]) * 2))
+
+    bare = sess.sql("SELECT a, b, count(*) AS c FROM t_sem "
+                    "GROUP BY GROUPING SETS (a, (a, b), ())"
+                    ).collect().to_pandas()
+    assert len(bare) == pdf.a.nunique() + len(pdf.groupby(["a", "b"])) + 1
+
+    mixed = sess.sql("SELECT a, b, sum(v) AS sv FROM t_sem "
+                     "GROUP BY a, ROLLUP(b) ORDER BY a, b"
+                     ).collect().to_pandas()
+    l0 = pdf.groupby(["a", "b"]).agg(sv=("v", "sum")).reset_index()
+    assert len(mixed) == len(l0) + len(l1)
+    suba = mixed[mixed.b.isna()].sort_values("a")
+    assert np.allclose(suba["sv"], l1.sort_values("a")["sv"])
+
+    ordn = sess.sql("SELECT a, count(*) AS c FROM t_sem "
+                    "GROUP BY GROUPING SETS ((1), ())").collect()
+    assert ordn.num_rows == pdf.a.nunique() + 1
+
+
+def test_na_subset_accepts_bare_string(sess, data):
+    df = sess.create_dataframe(data)
+    pdf = data.to_pandas()
+    assert df.na.drop(subset="v").count() == int(pdf.v.notna().sum())
+    assert df.fillna(0.0, subset="v").filter(
+        F.col("v").isNull()).count() == 0
+
+
+def test_unpivot_accepts_column_values(sess, data):
+    df = sess.create_dataframe(data)
+    up = df.unpivot(["a"], [F.col("b"), F.col("v")]).collect().to_pandas()
+    assert set(up["variable"]) == {"b", "v"}
