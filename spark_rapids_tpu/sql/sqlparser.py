@@ -150,6 +150,57 @@ class SetOpStmt:
     ctes: Dict[str, "Any"] = field(default_factory=dict)
 
 
+class ExistsSubquery(Expression):
+    """WHERE EXISTS (SELECT ...) marker — rewritten by the builder into a
+    LEFT SEMI join (NOT EXISTS -> LEFT ANTI), the same lowering Spark's
+    RewritePredicateSubquery performs before the reference plugin sees the
+    plan (semi/anti joins then run on GpuHashJoin)."""
+
+    children: Tuple[Expression, ...] = ()
+    _unresolved = True  # must never reach resolution/execution
+
+    def __init__(self, stmt):
+        self.stmt = stmt
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def sql(self) -> str:
+        return "EXISTS(<subquery>)"
+
+    def with_children(self, children):
+        return self
+
+    def _key_extras(self):
+        return (id(self.stmt),)
+
+
+class InSubquery(Expression):
+    """``expr IN (SELECT ...)`` marker — LEFT SEMI join on equality;
+    NOT IN is the null-aware LEFT ANTI form (SQL 3-valued logic: a null
+    needle or any null in the subquery result filters the row)."""
+
+    _unresolved = True
+
+    def __init__(self, needle: Expression, stmt):
+        self.children = (needle,)
+        self.stmt = stmt
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def sql(self) -> str:
+        return f"{self.children[0].sql()} IN (<subquery>)"
+
+    def with_children(self, children):
+        return InSubquery(children[0], self.stmt)
+
+    def _key_extras(self):
+        return (id(self.stmt),)
+
+
 class UnresolvedQualified(Expression):
     """``t.a`` — bound to the aliased relation's attribute by the builder.
     Never reaches execution; data_type raises to catch leaks.  Marked
@@ -397,11 +448,16 @@ class Parser:
                             self._cmp(PR.LessThanOrEqual, e, hi))
             elif self.accept_kw("IN"):
                 self.expect_op("(")
-                vals = [self.parse_expression()]
-                while self.accept_op(","):
-                    vals.append(self.parse_expression())
-                self.expect_op(")")
-                e2 = PR.In(e, tuple(vals))
+                if self.at_kw("SELECT"):
+                    q = self._query_term({})
+                    self.expect_op(")")
+                    e2 = InSubquery(e, q)
+                else:
+                    vals = [self.parse_expression()]
+                    while self.accept_op(","):
+                        vals.append(self.parse_expression())
+                    self.expect_op(")")
+                    e2 = PR.In(e, tuple(vals))
             elif self.accept_kw("LIKE"):
                 pat = self._comparison()
                 if not isinstance(pat, Literal):
@@ -556,6 +612,13 @@ class Parser:
     def _primary(self) -> Expression:
         from . import functions as F
         t = self.peek()
+        if t.kind == "ident" and t.upper == "EXISTS" \
+                and self.peek(1).kind == "op" and self.peek(1).text == "(":
+            self.next()
+            self.expect_op("(")
+            q = self._query_term({})
+            self.expect_op(")")
+            return ExistsSubquery(q)
         if t.kind == "num":
             return self._number(self.next().text)
         if t.kind == "str":
@@ -1133,6 +1196,118 @@ class QueryBuilder:
         exprs = tuple(Alias(a, a.name) for a in df._plan.output)
         return DataFrame(P.Project(exprs, df._plan), self.session)
 
+    # --- subquery predicates (EXISTS / IN) --------------------------------
+    @staticmethod
+    def _relation_aliases(stmt) -> set:
+        """Lower-cased relation aliases visible inside a SelectStmt's own
+        FROM clause (for telling correlated references apart)."""
+        out = set()
+        if not isinstance(stmt, SelectStmt):
+            return out
+        refs = ([stmt.from_] if stmt.from_ is not None else []) \
+            + [j.right for j in stmt.joins]
+        for r in refs:
+            if isinstance(r, TableRef):
+                out.add((r.alias or r.name).lower())
+                out.add(r.name.lower())
+            elif isinstance(r, SubqueryRef) and r.alias:
+                out.add(r.alias.lower())
+        return out
+
+    def _apply_subquery_predicate(self, df, pred, negated: bool,
+                                  scope, ctes):
+        """Rewrite one EXISTS/IN subquery predicate into a semi/anti join
+        (Spark's RewritePredicateSubquery)."""
+        from . import functions as F
+        from .dataframe import Column
+        from .expressions import predicates as PR
+
+        if isinstance(pred, InSubquery):
+            inner = self._fresh(self._build_sub(pred.stmt, ctes))
+            if len(inner._plan.output) != 1:
+                raise SqlParseError(
+                    "IN subquery must return exactly one column")
+            key = Column(inner._plan.output[0])
+            needle = Column(_resolve_or_err(pred.children[0], df._plan))
+            if not negated:
+                return df.join(inner, on=needle == key, how="left_semi")
+            # null-aware NOT IN (3-valued logic): a null needle is
+            # disqualified only when the subquery has rows (empty set:
+            # NOT IN is TRUE even for null); ANY null in the subquery
+            # result disqualifies every row
+            df = df.join(inner.limit(1), on=needle.isNull(),
+                         how="left_anti")
+            nonnull = inner.filter(key.isNotNull())
+            df = df.join(nonnull,
+                         on=needle == Column(nonnull._plan.output[0]),
+                         how="left_anti")
+            nulls = inner.filter(key.isNull()).limit(1)
+            return df.join(nulls, on=F.lit(True), how="left_anti")
+
+        # EXISTS: extract equality correlation (inner.col = outer.col via
+        # outer-alias-qualified references) into join keys
+        q = pred.stmt
+        inner_aliases = self._relation_aliases(q)
+
+        def outer_quals(e):
+            return e.collect(
+                lambda x: isinstance(x, UnresolvedQualified)
+                and x.qualifier.lower() not in inner_aliases)
+
+        corr_pairs = []
+        inner_conj = []
+        if isinstance(q, SelectStmt) and q.where is not None:
+            for c in _split_and(q.where):
+                oq = outer_quals(c)
+                if not oq:
+                    inner_conj.append(c)
+                    continue
+                if not isinstance(c, PR.EqualTo):
+                    raise SqlParseError(
+                        "correlated EXISTS supports only AND-connected "
+                        f"equality predicates, got {c.sql()!r}")
+                a, b = c.children
+                if outer_quals(a) and not outer_quals(b):
+                    corr_pairs.append((a, b))
+                elif outer_quals(b) and not outer_quals(a):
+                    corr_pairs.append((b, a))
+                else:
+                    raise SqlParseError(
+                        "correlated EXISTS equality must compare an outer "
+                        f"expression to an inner one: {c.sql()!r}")
+        if corr_pairs:
+            import dataclasses
+            if q.group_by or q.having is not None or q.group_by_mode:
+                raise SqlParseError(
+                    "correlated EXISTS with GROUP BY/HAVING is not "
+                    "supported — aggregate in a FROM subquery instead")
+            # LIMIT/OFFSET in a correlated EXISTS are per-OUTER-row in SQL
+            # semantics; after decorrelation they would apply globally and
+            # drop join keys.  LIMIT n>0 is a no-op for EXISTS; LIMIT 0
+            # means the subquery is always empty.
+            limit = q.limit
+            q2 = dataclasses.replace(
+                q,
+                where=_and_all(inner_conj),
+                items=[SelectItem(ie, f"__corr{i}")
+                       for i, (_, ie) in enumerate(corr_pairs)],
+                order_by=[], distinct=False, limit=None, offset=None)
+            if limit is not None and limit <= 0:
+                return df.filter(F.lit(negated))
+            inner = self._fresh(self._build_sub(q2, ctes))
+            cond = None
+            for i, (oe, _) in enumerate(corr_pairs):
+                outer_col = Column(_resolve_or_err(
+                    self._bind_quals(oe, scope), df._plan))
+                term = outer_col == Column(inner._plan.output[i])
+                cond = term if cond is None else cond & term
+        else:
+            # existence is decided by ONE surviving row
+            inner = self._fresh(self._build_sub(q, ctes).limit(1))
+            cond = F.lit(True)
+        return df.join(inner, on=cond,
+                       how="left_anti" if negated else "left_semi")
+
     # --- SELECT -----------------------------------------------------------
     def _build_select(self, stmt: SelectStmt, ctes):
         from . import plan as P
@@ -1169,8 +1344,13 @@ class QueryBuilder:
             if _has_window(cond):
                 raise SqlParseError(
                     "window functions are not allowed in WHERE")
-            df = DataFrame(P.Filter(_resolve_or_err(cond, df._plan),
-                                    df._plan), self.session)
+            plain, sub_preds = _split_subquery_predicates(cond)
+            if plain is not None:
+                df = DataFrame(P.Filter(_resolve_or_err(plain, df._plan),
+                                        df._plan), self.session)
+            for pred, negated in sub_preds:
+                df = self._apply_subquery_predicate(df, pred, negated,
+                                                    scope, ctes)
 
         # resolve select list against the (joined, filtered) frame
         items: List[Tuple[str, Expression]] = []
@@ -1572,6 +1752,42 @@ def _resolve_or_err(e: Expression, plan) -> Expression:
     except KeyError as exc:
         raise SqlParseError(str(exc.args[0]) if exc.args else str(exc)) \
             from None
+
+
+def _split_and(e: Expression) -> List[Expression]:
+    """Flatten a conjunction tree into its AND-connected conjuncts."""
+    from .expressions.predicates import And
+    if isinstance(e, And):
+        return _split_and(e.children[0]) + _split_and(e.children[1])
+    return [e]
+
+
+def _and_all(conjuncts: Sequence[Expression]) -> Optional[Expression]:
+    from .expressions.predicates import And
+    out = None
+    for c in conjuncts:
+        out = c if out is None else And(out, c)
+    return out
+
+
+def _split_subquery_predicates(cond: Expression):
+    """(plain_condition_or_None, [(marker, negated)]) from a WHERE tree.
+    Markers must be AND-connected at the top level — anywhere else (under
+    OR, inside a CASE) has no join rewrite and is rejected."""
+    from .expressions.predicates import Not
+    plain: List[Expression] = []
+    subs = []
+    for c in _split_and(cond):
+        inner = c.children[0] if isinstance(c, Not) else c
+        if isinstance(inner, (ExistsSubquery, InSubquery)):
+            subs.append((inner, isinstance(c, Not)))
+            continue
+        if c.collect(lambda x: isinstance(x, (ExistsSubquery, InSubquery))):
+            raise SqlParseError(
+                "EXISTS/IN subqueries are only supported as AND-connected "
+                "top-level WHERE predicates")
+        plain.append(c)
+    return _and_all(plain), subs
 
 
 def _has_window(e: Expression) -> bool:

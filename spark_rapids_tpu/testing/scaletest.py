@@ -330,6 +330,41 @@ WHERE l_shipdate >= CAST('1994-01-01' AS date)
 """
 
 
+_TPCH_Q4_SQL = """
+SELECT o_orderpriority, count(*) AS order_count
+FROM orders
+WHERE o_orderdate >= CAST('1993-07-01' AS date)
+  AND o_orderdate < CAST('1993-10-01' AS date)
+  AND EXISTS (
+    SELECT 1 FROM lineitem
+    WHERE lineitem.l_orderkey = orders.o_orderkey
+      AND lineitem.l_commitdate < lineitem.l_receiptdate)
+GROUP BY o_orderpriority
+ORDER BY o_orderpriority
+"""
+
+
+def _tpch_q4_sql(sess, t, F):
+    """TPC-H q4 in its REAL spec form — correlated EXISTS rewritten to a
+    left-semi join (Spark RewritePredicateSubquery)."""
+    import datetime
+    sess.create_dataframe(t["orders"], num_partitions=4) \
+        .createOrReplaceTempView("orders")
+    sess.create_dataframe(t["lineitem"], num_partitions=4) \
+        .createOrReplaceTempView("lineitem")
+    got = sess.sql(_TPCH_Q4_SQL).collect().to_pandas()
+    op = t["orders"].to_pandas()
+    lp = t["lineitem"].to_pandas()
+    lo, hi = datetime.date(1993, 7, 1), datetime.date(1993, 10, 1)
+    late = set(lp.l_orderkey[lp.l_commitdate < lp.l_receiptdate])
+    op = op[(op.o_orderdate >= lo) & (op.o_orderdate < hi)
+            & op.o_orderkey.isin(late)]
+    exp = (op.groupby("o_orderpriority").size()
+           .sort_index().reset_index(name="order_count"))
+    assert list(got["o_orderpriority"]) == list(exp["o_orderpriority"])
+    assert np.array_equal(got["order_count"], exp["order_count"])
+
+
 def _tpch_q1_sql(sess, t, F):
     """TPC-H q1 executed from SQL text — the reference's actual query
     surface (Spark SQL in; SURVEY §1) — checked against a pandas oracle."""
@@ -560,6 +595,7 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("tpch_q6", _tpch_q6),
     ("tpch_q14_promo_case", _tpch_q14),
     ("tpch_q1_sql", _tpch_q1_sql),
+    ("tpch_q4_sql_exists", _tpch_q4_sql),
     ("tpch_q6_sql", _tpch_q6_sql),
     ("tpcds_q3_star_join", _tpcds_q3),
     ("tpcds_q7_star4_avgs", _tpcds_q7),

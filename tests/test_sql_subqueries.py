@@ -1,0 +1,116 @@
+"""WHERE EXISTS / IN subquery predicates — rewritten to semi/anti joins
+(Spark's RewritePredicateSubquery; the reference runs the resulting
+semi/anti joins on GpuHashJoin).  Oracles: pandas."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+
+
+@pytest.fixture()
+def rig():
+    rng = np.random.default_rng(5)
+    n = 3000
+    orders = pa.table({"o_key": np.arange(n // 4),
+                       "o_flag": rng.integers(0, 2, n // 4)})
+    items = pa.table({"i_okey": rng.integers(0, n // 2, n),
+                      "i_v": rng.random(n)})
+    sess = srt.session()
+    sess.create_dataframe(orders).createOrReplaceTempView("sq_orders")
+    sess.create_dataframe(items).createOrReplaceTempView("sq_items")
+    return sess, orders.to_pandas(), items.to_pandas()
+
+
+def test_in_subquery(rig):
+    sess, po, pi = rig
+    got = sess.sql(
+        "SELECT o_key FROM sq_orders WHERE o_key IN "
+        "(SELECT i_okey FROM sq_items WHERE i_v > 0.9)"
+    ).collect().to_pandas()
+    keys = set(pi.i_okey[pi.i_v > 0.9])
+    assert set(got["o_key"]) == set(po.o_key[po.o_key.isin(keys)])
+
+
+def test_not_in_subquery_null_aware(rig):
+    sess, po, pi = rig
+    got = sess.sql("SELECT o_key FROM sq_orders WHERE o_key NOT IN "
+                   "(SELECT i_okey FROM sq_items)").collect().to_pandas()
+    assert set(got["o_key"]) == set(po.o_key[~po.o_key.isin(set(pi.i_okey))])
+    # any NULL in the subquery result -> 3-valued logic filters every row
+    sess.create_dataframe(pa.table(
+        {"x": pa.array([1, None, 2], type=pa.int64())})
+    ).createOrReplaceTempView("sq_nulls")
+    got = sess.sql("SELECT o_key FROM sq_orders WHERE o_key NOT IN "
+                   "(SELECT x FROM sq_nulls)").collect()
+    assert got.num_rows == 0
+
+
+def test_correlated_exists_and_not_exists(rig):
+    sess, po, pi = rig
+    got = sess.sql(
+        "SELECT o_key FROM sq_orders o WHERE EXISTS (SELECT 1 FROM "
+        "sq_items i WHERE i.i_okey = o.o_key AND i.i_v > 0.95)"
+    ).collect().to_pandas()
+    keys = set(pi.i_okey[pi.i_v > 0.95])
+    assert set(got["o_key"]) == set(po.o_key[po.o_key.isin(keys)])
+    got = sess.sql(
+        "SELECT o_key FROM sq_orders o WHERE NOT EXISTS (SELECT 1 FROM "
+        "sq_items i WHERE i.i_okey = o.o_key)").collect().to_pandas()
+    assert set(got["o_key"]) == set(po.o_key[~po.o_key.isin(set(pi.i_okey))])
+
+
+def test_uncorrelated_exists_gates_whole_result(rig):
+    sess, po, pi = rig
+    got = sess.sql("SELECT o_key FROM sq_orders WHERE o_flag = 1 AND "
+                   "EXISTS (SELECT 1 FROM sq_items WHERE i_v > 2.0)"
+                   ).collect()
+    assert got.num_rows == 0
+    got = sess.sql("SELECT o_key FROM sq_orders WHERE o_flag = 1 AND "
+                   "EXISTS (SELECT 1 FROM sq_items WHERE i_v > 0.5)"
+                   ).collect()
+    assert got.num_rows == int((po.o_flag == 1).sum())
+
+
+def test_subquery_under_or_rejected(rig):
+    sess, _, _ = rig
+    with pytest.raises(ValueError, match="AND-connected"):
+        sess.sql("SELECT o_key FROM sq_orders WHERE o_flag = 1 OR "
+                 "o_key IN (SELECT i_okey FROM sq_items)").collect()
+
+
+def test_not_in_empty_subquery_keeps_null_needle(rig):
+    sess, _, _ = rig
+    sess.create_dataframe(pa.table(
+        {"x": pa.array([1, None, 5], type=pa.int64())})
+    ).createOrReplaceTempView("sq_t3")
+    sess.create_dataframe(pa.table(
+        {"y": pa.array([], type=pa.int64())})
+    ).createOrReplaceTempView("sq_empty")
+    # IN over the empty set is FALSE (not NULL) even for a null needle,
+    # so NOT IN keeps every row
+    got = sess.sql("SELECT x FROM sq_t3 WHERE x NOT IN "
+                   "(SELECT y FROM sq_empty)").collect()
+    assert got.num_rows == 3
+
+
+def test_correlated_exists_limit_semantics(rig):
+    sess, _, _ = rig
+    sess.create_dataframe(pa.table(
+        {"k": pa.array([1, 2, 3], type=pa.int64())})
+    ).createOrReplaceTempView("sq_o2")
+    sess.create_dataframe(pa.table(
+        {"ik": pa.array([1, 1, 3], type=pa.int64())})
+    ).createOrReplaceTempView("sq_i2")
+    # LIMIT n>0 inside EXISTS is per-outer-row, i.e. a no-op
+    got = sess.sql("SELECT k FROM sq_o2 WHERE EXISTS (SELECT 1 FROM "
+                   "sq_i2 WHERE sq_i2.ik = sq_o2.k LIMIT 1)"
+                   ).collect().to_pylist()
+    assert sorted(r["k"] for r in got) == [1, 3]
+    got = sess.sql("SELECT k FROM sq_o2 WHERE EXISTS (SELECT 1 FROM "
+                   "sq_i2 WHERE sq_i2.ik = sq_o2.k LIMIT 0)").collect()
+    assert got.num_rows == 0
+    with pytest.raises(ValueError, match="GROUP BY"):
+        sess.sql("SELECT k FROM sq_o2 WHERE EXISTS (SELECT ik FROM "
+                 "sq_i2 WHERE sq_i2.ik = sq_o2.k GROUP BY ik)").collect()
