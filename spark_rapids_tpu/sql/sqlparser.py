@@ -201,6 +201,33 @@ class InSubquery(Expression):
         return (id(self.stmt),)
 
 
+class ScalarSubquery(Expression):
+    """Uncorrelated ``(SELECT <one value>)`` in an expression position —
+    evaluated once at plan-build time into a Literal (the subquery result
+    is a single value by definition; Spark's ReuseSubquery evaluates it
+    once per query too, just lazily)."""
+
+    children: Tuple[Expression, ...] = ()
+    _unresolved = True
+
+    def __init__(self, stmt):
+        self.stmt = stmt
+
+    @property
+    def data_type(self):
+        raise SqlParseError(
+            "scalar subquery leaked past build-time evaluation")
+
+    def sql(self) -> str:
+        return "(<scalar subquery>)"
+
+    def with_children(self, children):
+        return self
+
+    def _key_extras(self):
+        return (id(self.stmt),)
+
+
 class UnresolvedQualified(Expression):
     """``t.a`` — bound to the aliased relation's attribute by the builder.
     Never reaches execution; data_type raises to catch leaks.  Marked
@@ -624,6 +651,12 @@ class Parser:
         if t.kind == "str":
             self.next()
             return Literal(t.text[1:-1].replace("''", "'"))
+        if t.kind == "op" and t.text == "(" and self.peek(1).kind == "ident" \
+                and self.peek(1).upper == "SELECT":
+            self.next()
+            q = self._query_term({})
+            self.expect_op(")")
+            return ScalarSubquery(q)
         if self.accept_op("("):
             e = self.parse_expression()
             self.expect_op(")")
@@ -1196,6 +1229,60 @@ class QueryBuilder:
         exprs = tuple(Alias(a, a.name) for a in df._plan.output)
         return DataFrame(P.Project(exprs, df._plan), self.session)
 
+    # --- scalar subqueries ------------------------------------------------
+    def _eval_scalar_expr(self, e: Expression, ctes) -> Expression:
+        def repl(x):
+            if not isinstance(x, ScalarSubquery):
+                return None
+            inner = self._build_sub(x.stmt, ctes)
+            if len(inner._plan.output) != 1:
+                raise SqlParseError(
+                    "scalar subquery must return exactly one column")
+            attr = inner._plan.output[0]
+            rows = inner.limit(2).collect().to_pylist()
+            if len(rows) > 1:
+                raise SqlParseError(
+                    "scalar subquery returned more than one row")
+            val = rows[0][attr.name] if rows else None
+            return Literal(val, attr.dtype)
+        return e.transform(repl)
+
+    def _eval_scalar_subqueries_stmt(self, stmt: SelectStmt, ctes):
+        """Replace uncorrelated scalar subqueries in every expression slot
+        with their (build-time evaluated) literal value."""
+        has = any(
+            isinstance(e, Expression)
+            and e.collect(lambda x: isinstance(x, ScalarSubquery))
+            for e in ([it.expr for it in stmt.items]
+                      + [stmt.where, stmt.having]
+                      + list(stmt.group_by)
+                      + [g for s in stmt.grouping_sets_raw for g in s]
+                      + [j.on for j in stmt.joins]
+                      + [oi.expr for oi in stmt.order_by])
+            if e is not None)
+        if not has:
+            return stmt
+        import dataclasses
+
+        def ev(e):
+            if e is None or not isinstance(e, Expression):
+                return e
+            return self._eval_scalar_expr(e, ctes)
+
+        return dataclasses.replace(
+            stmt,
+            items=[SelectItem(it.expr if isinstance(it.expr, Star)
+                              else ev(it.expr), it.alias)
+                   for it in stmt.items],
+            where=ev(stmt.where), having=ev(stmt.having),
+            group_by=[ev(g) for g in stmt.group_by],
+            grouping_sets_raw=[[ev(g) for g in s]
+                               for s in stmt.grouping_sets_raw],
+            joins=[dataclasses.replace(j, on=ev(j.on))
+                   for j in stmt.joins],
+            order_by=[dataclasses.replace(oi, expr=ev(oi.expr))
+                      for oi in stmt.order_by])
+
     # --- subquery predicates (EXISTS / IN) --------------------------------
     @staticmethod
     def _relation_aliases(stmt) -> set:
@@ -1208,8 +1295,9 @@ class QueryBuilder:
             + [j.right for j in stmt.joins]
         for r in refs:
             if isinstance(r, TableRef):
+                # an alias HIDES the base table name (SQL scoping): outer
+                # references to the unaliased name stay outer
                 out.add((r.alias or r.name).lower())
-                out.add(r.name.lower())
             elif isinstance(r, SubqueryRef) and r.alias:
                 out.add(r.alias.lower())
         return out
@@ -1285,6 +1373,10 @@ class QueryBuilder:
             # semantics; after decorrelation they would apply globally and
             # drop join keys.  LIMIT n>0 is a no-op for EXISTS; LIMIT 0
             # means the subquery is always empty.
+            if q.offset:
+                raise SqlParseError(
+                    "correlated EXISTS with OFFSET is not supported (it "
+                    "is per-outer-row and has no join rewrite)")
             limit = q.limit
             q2 = dataclasses.replace(
                 q,
@@ -1313,6 +1405,17 @@ class QueryBuilder:
         from . import plan as P
         from .dataframe import Column, DataFrame
 
+        stmt = self._eval_scalar_subqueries_stmt(stmt, ctes)
+        for slot, e in ([("SELECT list", it.expr) for it in stmt.items]
+                        + [("HAVING", stmt.having)]
+                        + [("GROUP BY", g) for g in stmt.group_by]
+                        + [("join condition", j.on) for j in stmt.joins]
+                        + [("ORDER BY", oi.expr) for oi in stmt.order_by]):
+            if isinstance(e, Expression) and e.collect(
+                    lambda x: isinstance(x, (ExistsSubquery, InSubquery))):
+                raise SqlParseError(
+                    f"EXISTS/IN subqueries are not supported in the {slot}"
+                    " — only as AND-connected WHERE predicates")
         scope: Dict[str, Any] = {}      # alias -> DataFrame
         if stmt.from_ is None:
             df = self.session.range(1)

@@ -114,3 +114,41 @@ def test_correlated_exists_limit_semantics(rig):
     with pytest.raises(ValueError, match="GROUP BY"):
         sess.sql("SELECT k FROM sq_o2 WHERE EXISTS (SELECT ik FROM "
                  "sq_i2 WHERE sq_i2.ik = sq_o2.k GROUP BY ik)").collect()
+
+
+def test_scalar_subquery(rig):
+    sess, _, pi = rig
+    got = sess.sql("SELECT i_okey FROM sq_items WHERE i_v > "
+                   "(SELECT avg(i_v) FROM sq_items)").collect()
+    assert got.num_rows == int((pi.i_v > pi.i_v.mean()).sum())
+    row = sess.sql("SELECT (SELECT max(i_v) FROM sq_items) AS mx "
+                   "FROM sq_orders LIMIT 1").collect().to_pylist()[0]
+    assert np.isclose(row["mx"], pi.i_v.max())
+    # empty result -> NULL; multiple rows -> error
+    row = sess.sql("SELECT (SELECT max(i_v) FROM sq_items WHERE i_v > 2) "
+                   "AS m FROM sq_orders LIMIT 1").collect().to_pylist()[0]
+    assert row["m"] is None
+    with pytest.raises(ValueError, match="more than one row"):
+        sess.sql("SELECT (SELECT i_v FROM sq_items) FROM sq_orders"
+                 ).collect()
+
+
+def test_subquery_guards_and_self_correlation(rig):
+    sess, _, _ = rig
+    sess.create_dataframe(pa.table(
+        {"k": pa.array([1, 2, 3], type=pa.int64())})
+    ).createOrReplaceTempView("sq_o3")
+    sess.create_dataframe(pa.table(
+        {"ik": pa.array([1, 1, 3], type=pa.int64())})
+    ).createOrReplaceTempView("sq_i3")
+    with pytest.raises(ValueError, match="OFFSET"):
+        sess.sql("SELECT k FROM sq_o3 WHERE EXISTS (SELECT 1 FROM sq_i3 "
+                 "WHERE sq_i3.ik = sq_o3.k LIMIT 1 OFFSET 1)").collect()
+    with pytest.raises(ValueError, match="not supported in the"):
+        sess.sql("SELECT EXISTS(SELECT 1 FROM sq_i3) AS e FROM sq_o3"
+                 ).collect()
+    # unaliased outer name stays visible when the inner aliases the same
+    # table (SQL scoping: an alias hides the base name)
+    got = sess.sql("SELECT k FROM sq_o3 WHERE EXISTS (SELECT 1 FROM "
+                   "sq_o3 l2 WHERE sq_o3.k = l2.k)").collect()
+    assert got.num_rows == 3
