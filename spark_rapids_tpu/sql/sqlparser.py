@@ -1234,6 +1234,20 @@ class QueryBuilder:
         def repl(x):
             if not isinstance(x, ScalarSubquery):
                 return None
+            if isinstance(x.stmt, SelectStmt):
+                inner_aliases = self._relation_aliases(x.stmt)
+                exprs = ([it.expr for it in x.stmt.items
+                          if isinstance(it.expr, Expression)]
+                         + [e2 for e2 in (x.stmt.where, x.stmt.having)
+                            if e2 is not None])
+                for e2 in exprs:
+                    if e2.collect(lambda n: isinstance(n, UnresolvedQualified)
+                                  and n.qualifier.lower()
+                                  not in inner_aliases):
+                        raise SqlParseError(
+                            "correlated scalar subqueries are not "
+                            "supported — rewrite as a join or a "
+                            "correlated EXISTS")
             inner = self._build_sub(x.stmt, ctes)
             if len(inner._plan.output) != 1:
                 raise SqlParseError(
@@ -1410,6 +1424,8 @@ class QueryBuilder:
                         + [("HAVING", stmt.having)]
                         + [("GROUP BY", g) for g in stmt.group_by]
                         + [("join condition", j.on) for j in stmt.joins]
+                        + [("GROUPING SETS", g)
+                           for s in stmt.grouping_sets_raw for g in s]
                         + [("ORDER BY", oi.expr) for oi in stmt.order_by]):
             if isinstance(e, Expression) and e.collect(
                     lambda x: isinstance(x, (ExistsSubquery, InSubquery))):

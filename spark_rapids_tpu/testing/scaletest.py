@@ -156,14 +156,25 @@ def build_tpch_tables(rows: int, seed: int = 23) -> Dict[str, pa.Table]:
             (ship + rng.integers(1, 31, rows).astype("timedelta64[D]"))
             .astype("datetime64[D]")),
     })
+    n_cust = max(rows // 8, 1)
     n_ord = max(rows // 4, 1)
     odate = base + rng.integers(0, 2406, n_ord).astype("timedelta64[D]")
     orders = pa.table({
         "o_orderkey": pa.array(np.arange(n_ord)),
+        "o_custkey": pa.array(rng.integers(0, 2 * n_cust, n_ord)),
         "o_orderdate": pa.array(odate.astype("datetime64[D]")),
         "o_orderpriority": pa.array(rng.choice(
             ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"],
             n_ord)),
+    })
+    cc = rng.integers(10, 35, n_cust)
+    customer = pa.table({
+        "c_custkey": pa.array(np.arange(n_cust)),
+        "c_phone": pa.array([f"{c}-{rng.integers(100, 999)}-"
+                             f"{rng.integers(1000, 9999)}"
+                             for c in cc]),
+        "c_acctbal": pa.array(np.round(rng.random(n_cust) * 10998.99
+                                       - 999.99, 2)),
     })
     n_part = max(rows // 8, 1)
     part = pa.table({
@@ -173,7 +184,8 @@ def build_tpch_tables(rows: int, seed: int = 23) -> Dict[str, pa.Table]:
              "STANDARD POLISHED TIN", "ECONOMY ANODIZED STEEL",
              "MEDIUM BRUSHED NICKEL"], n_part)),
     })
-    return {"lineitem": lineitem, "orders": orders, "part": part}
+    return {"lineitem": lineitem, "orders": orders, "part": part,
+            "customer": customer}
 
 
 def _q1_oracle_check(got, lineitem_table):
@@ -363,6 +375,50 @@ def _tpch_q4_sql(sess, t, F):
            .sort_index().reset_index(name="order_count"))
     assert list(got["o_orderpriority"]) == list(exp["o_orderpriority"])
     assert np.array_equal(got["order_count"], exp["order_count"])
+
+
+_TPCH_Q22_SQL = """
+SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM (SELECT substring(c_phone, 1, 2) AS cntrycode, c_acctbal, c_custkey
+      FROM customer
+      WHERE substring(c_phone, 1, 2) IN ('13', '31', '23', '29', '30')
+        AND c_acctbal > (SELECT avg(c_acctbal) FROM customer
+                         WHERE c_acctbal > 0.00
+                           AND substring(c_phone, 1, 2)
+                               IN ('13', '31', '23', '29', '30'))) custsale
+WHERE NOT EXISTS (SELECT 1 FROM orders
+                  WHERE orders.o_custkey = custsale.c_custkey)
+GROUP BY cntrycode
+ORDER BY cntrycode
+"""
+
+
+def _tpch_q22_sql(sess, t, F):
+    """TPC-H q22 shape (global sales opportunity): IN-list + scalar
+    subquery + correlated NOT EXISTS + FROM subquery + group/sort, all
+    from SQL text — the full new-subquery machinery on one benchmark
+    query."""
+    sess.create_dataframe(t["customer"], num_partitions=4) \
+        .createOrReplaceTempView("customer")
+    sess.create_dataframe(t["orders"], num_partitions=4) \
+        .createOrReplaceTempView("orders")
+    got = sess.sql(_TPCH_Q22_SQL).collect().to_pandas()
+    cp = t["customer"].to_pandas()
+    op = t["orders"].to_pandas()
+    codes = {"13", "31", "23", "29", "30"}
+    cc = cp.c_phone.str[:2]
+    sel = cp[cc.isin(codes)]
+    avg_bal = cp.c_acctbal[(cp.c_acctbal > 0.0) & cc.isin(codes)].mean()
+    sel = sel[sel.c_acctbal > avg_bal]
+    sel = sel[~sel.c_custkey.isin(set(op.o_custkey))]
+    exp = (sel.assign(cntrycode=sel.c_phone.str[:2])
+           .groupby("cntrycode")
+           .agg(numcust=("c_acctbal", "size"),
+                totacctbal=("c_acctbal", "sum"))
+           .sort_index().reset_index())
+    assert list(got["cntrycode"]) == list(exp["cntrycode"])
+    assert np.array_equal(got["numcust"], exp["numcust"])
+    assert np.allclose(got["totacctbal"], exp["totacctbal"])
 
 
 def _tpch_q1_sql(sess, t, F):
@@ -596,6 +652,7 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("tpch_q14_promo_case", _tpch_q14),
     ("tpch_q1_sql", _tpch_q1_sql),
     ("tpch_q4_sql_exists", _tpch_q4_sql),
+    ("tpch_q22_sql_subqueries", _tpch_q22_sql),
     ("tpch_q6_sql", _tpch_q6_sql),
     ("tpcds_q3_star_join", _tpcds_q3),
     ("tpcds_q7_star4_avgs", _tpcds_q7),

@@ -152,3 +152,19 @@ def test_subquery_guards_and_self_correlation(rig):
     got = sess.sql("SELECT k FROM sq_o3 WHERE EXISTS (SELECT 1 FROM "
                    "sq_o3 l2 WHERE sq_o3.k = l2.k)").collect()
     assert got.num_rows == 3
+
+
+def test_correlated_scalar_and_grouping_sets_guards(rig):
+    sess, _, _ = rig
+    sess.create_dataframe(pa.table(
+        {"k": pa.array([1, 2], type=pa.int64()), "v": [1.0, 2.0]})
+    ).createOrReplaceTempView("sq_out")
+    sess.create_dataframe(pa.table(
+        {"ik": pa.array([1, 2], type=pa.int64()), "iv": [5.0, 6.0]})
+    ).createOrReplaceTempView("sq_in2")
+    with pytest.raises(ValueError, match="correlated scalar"):
+        sess.sql("SELECT k FROM sq_out WHERE v > (SELECT max(iv) FROM "
+                 "sq_in2 WHERE sq_in2.ik = sq_out.k)").collect()
+    with pytest.raises(ValueError, match="not supported in the"):
+        sess.sql("SELECT count(*) FROM sq_out GROUP BY GROUPING SETS "
+                 "((k), (EXISTS(SELECT 1 FROM sq_in2)))").collect()
