@@ -251,6 +251,65 @@ class TruncDate(_TzIfTimestamp, Expression):
                          ctx.xp.zeros_like(d.validity))
 
 
+class AddCalendarInterval(Expression):
+    """date/timestamp +/- literal calendar interval, dispatched on the
+    OPERAND's type at resolution time (SQL: a sub-day part promotes a
+    DATE result to TIMESTAMP; month parts are calendar-aware).  The
+    interval is literal-only, like the reference's GpuTimeAdd/
+    GpuDateAddInterval restriction."""
+
+    _DAY_US = 86_400_000_000
+
+    def __init__(self, child, months=0, days=0, micros=0):
+        self.children = (resolve_expression(child),)
+        self.months, self.days, self.micros = (int(months), int(days),
+                                               int(micros))
+
+    def with_children(self, children):
+        return AddCalendarInterval(children[0], self.months, self.days,
+                                   self.micros)
+
+    def _key_extras(self):
+        return (self.months, self.days, self.micros)
+
+    def tag_for_device(self, conf=None):
+        ct = self.children[0].data_type
+        if not isinstance(ct, (T.DateType, T.TimestampType)):
+            return (f"INTERVAL arithmetic needs a date/timestamp operand, "
+                    f"got {ct}")
+        return None
+
+    @property
+    def data_type(self):
+        ct = self.children[0].data_type
+        if isinstance(ct, T.DateType) and self.micros == 0:
+            return T.DATE
+        return T.TIMESTAMP
+
+    def kernel(self, ctx, c):
+        xp = ctx.xp
+        ct = self.children[0].data_type
+        if isinstance(ct, T.DateType):
+            d = c.data
+            if self.months:
+                d = DT.add_months(xp, d, xp.full_like(d, self.months))
+            d = d + self.days
+            if self.micros == 0:
+                return fixed(T.DATE, d.astype(xp.int32), c.validity)
+            ts = d.astype(xp.int64) * self._DAY_US + self.micros
+            return fixed(T.TIMESTAMP, ts, c.validity)
+        # timestamp: split into day + intra-day parts so month arithmetic
+        # stays calendar-aware (floor division handles pre-epoch values)
+        ts = c.data
+        days = xp.floor_divide(ts, self._DAY_US)
+        rem = ts - days * self._DAY_US
+        if self.months:
+            days = DT.add_months(xp, days, xp.full_like(days, self.months))
+        days = days + self.days
+        out = days.astype(xp.int64) * self._DAY_US + rem + self.micros
+        return fixed(T.TIMESTAMP, out, c.validity)
+
+
 class TimeAdd(Expression):
     """timestamp + literal interval (micros only, like the reference's
     GpuTimeAdd literal restriction)."""

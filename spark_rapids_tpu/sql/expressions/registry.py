@@ -49,6 +49,7 @@ _reg(Dt.Year, Dt.Month, Dt.DayOfMonth, Dt.DayOfWeek, Dt.WeekDay,
      Dt.DayOfYear, Dt.WeekOfYear, Dt.Quarter, Dt.LastDay, Dt.Hour, Dt.Minute,
      Dt.Second, Dt.DateAdd, Dt.DateSub, Dt.DateDiff, Dt.AddMonths,
      Dt.MonthsBetween, Dt.TruncDate, Dt.TimeAdd, Dt.DateAddInterval,
+     Dt.AddCalendarInterval,
      Dt.MicrosToTimestamp, Dt.MillisToTimestamp, Dt.SecondsToTimestamp,
      Dt.PreciseTimestampConversion, Dt.UnixMicros, Dt.DateFormatClass,
      Dt.FromUnixTime, Dt.ToUnixTimestamp, Dt.UnixTimestamp, Dt.GetTimestamp,

@@ -228,6 +228,45 @@ class ScalarSubquery(Expression):
         return (id(self.stmt),)
 
 
+class IntervalLiteral(Expression):
+    """Parse-time ``INTERVAL 'n' unit`` value — only valid next to +/-
+    with a date/timestamp, where _additive folds it into DateAddInterval/
+    TimeAdd (the reference's GpuDateAddInterval/GpuTimeAdd literal
+    restriction)."""
+
+    children: Tuple[Expression, ...] = ()
+    _unresolved = True
+
+    def __init__(self, months: int, days: int, micros: int):
+        self.months, self.days, self.micros = months, days, micros
+
+    @property
+    def data_type(self):
+        raise SqlParseError(
+            "INTERVAL literals are only valid in date/timestamp +/- "
+            "arithmetic")
+
+    def sql(self) -> str:
+        return f"INTERVAL({self.months}mo {self.days}d {self.micros}us)"
+
+    def with_children(self, children):
+        return self
+
+    def _key_extras(self):
+        return (self.months, self.days, self.micros)
+
+
+_INTERVAL_UNITS = {
+    "year": (12, 0, 0), "years": (12, 0, 0),
+    "month": (1, 0, 0), "months": (1, 0, 0),
+    "week": (0, 7, 0), "weeks": (0, 7, 0),
+    "day": (0, 1, 0), "days": (0, 1, 0),
+    "hour": (0, 0, 3_600_000_000), "hours": (0, 0, 3_600_000_000),
+    "minute": (0, 0, 60_000_000), "minutes": (0, 0, 60_000_000),
+    "second": (0, 0, 1_000_000), "seconds": (0, 0, 1_000_000),
+}
+
+
 class UnresolvedQualified(Expression):
     """``t.a`` — bound to the aliased relation's attribute by the builder.
     Never reaches execution; data_type raises to catch leaks.  Marked
@@ -593,11 +632,35 @@ class Parser:
         e = self._multiplicative()
         while True:
             if self.accept_op("+"):
-                e = self._arith(A.Add, e, self._multiplicative())
+                e = self._fold_interval(A.Add, e, self._multiplicative())
             elif self.accept_op("-"):
-                e = self._arith(A.Subtract, e, self._multiplicative())
+                e = self._fold_interval(A.Subtract, e,
+                                        self._multiplicative())
             else:
                 return e
+
+    def _fold_interval(self, cls, a: Expression, b: Expression
+                       ) -> Expression:
+        """date/timestamp +/- INTERVAL folds to DateAddInterval/TimeAdd;
+        interval + date commutes; everything else is plain arithmetic."""
+        from .expressions import arithmetic as A
+        from .expressions.datetime import AddCalendarInterval
+        if isinstance(a, IntervalLiteral) and \
+                not isinstance(b, IntervalLiteral) and cls is A.Add:
+            a, b = b, a
+        if isinstance(b, IntervalLiteral):
+            if isinstance(a, IntervalLiteral):
+                raise SqlParseError("interval +/- interval is not supported")
+            sign = 1 if cls is A.Add else -1
+            # operand-type dispatch (date vs timestamp, sub-day promotion)
+            # happens inside AddCalendarInterval at resolution time
+            return AddCalendarInterval(a, months=sign * b.months,
+                                       days=sign * b.days,
+                                       micros=sign * b.micros)
+        if isinstance(a, IntervalLiteral):
+            raise SqlParseError(
+                "INTERVAL literals are only valid in +/- date arithmetic")
+        return self._arith(cls, a, b)
 
     @staticmethod
     def _arith(cls, a: Expression, b: Expression) -> Expression:
@@ -678,8 +741,49 @@ class Parser:
             if up == "CASE" and t.kind == "ident":
                 return self._case()
             if up == "INTERVAL" and t.kind == "ident":
-                raise SqlParseError("INTERVAL literals are not supported; "
-                                    "use date_add/add_months functions")
+                self.next()
+                months = days = micros = 0
+                saw = False
+                def unit_at(k: int) -> bool:
+                    u = self.peek(k)
+                    return (u.kind == "ident"
+                            and u.text.lower() in _INTERVAL_UNITS)
+
+                while True:
+                    # commit to a component only when a UNIT follows the
+                    # value — a trailing +/- or number belongs to the
+                    # enclosing arithmetic (INTERVAL '1' DAY - x)
+                    v = self.peek()
+                    if v.kind in ("str", "num") and unit_at(1):
+                        self.next()
+                        txt = v.text[1:-1] if v.kind == "str" else v.text
+                        try:
+                            n = int(txt)
+                        except ValueError:
+                            raise SqlParseError(
+                                f"bad INTERVAL value {v.text}") from None
+                    elif v.kind == "op" and v.text == "-" \
+                            and self.peek(1).kind in ("num", "str") \
+                            and unit_at(2):
+                        self.next()
+                        v2 = self.next()
+                        txt = v2.text[1:-1] if v2.kind == "str" else v2.text
+                        try:
+                            n = -int(txt)
+                        except ValueError:
+                            raise SqlParseError(
+                                f"bad INTERVAL value {v2.text}") from None
+                    else:
+                        break
+                    u = self.next()
+                    mo, d, us = _INTERVAL_UNITS[u.text.lower()]
+                    months += n * mo
+                    days += n * d
+                    micros += n * us
+                    saw = True
+                if not saw:
+                    raise SqlParseError("empty INTERVAL literal")
+                return IntervalLiteral(months, days, micros)
             name = self.expect_ident()
             # function call?
             if self.at_op("(") and t.kind == "ident":

@@ -314,8 +314,8 @@ def _tpch_q14(sess, t, F):
     assert np.allclose(got["promo_revenue"].fillna(0.0), exp)
 
 
-#: TPC-H q1 as SQL text (spec form; the interval-arithmetic cutoff is the
-#: spec's DATE '1998-12-01' - 90 days, written as the resolved literal)
+#: TPC-H q1 as SQL text, exactly the spec's form (the cutoff is interval
+#: arithmetic: DATE '1998-12-01' - INTERVAL '90' DAY = 1998-09-02)
 _TPCH_Q1_SQL = """
 SELECT l_returnflag, l_linestatus,
        sum(l_quantity) AS sum_qty,
@@ -327,7 +327,7 @@ SELECT l_returnflag, l_linestatus,
        avg(l_discount) AS avg_disc,
        count(*) AS count_order
 FROM lineitem
-WHERE l_shipdate <= CAST('1998-09-02' AS date)
+WHERE l_shipdate <= CAST('1998-12-01' AS date) - INTERVAL '90' DAY
 GROUP BY l_returnflag, l_linestatus
 ORDER BY l_returnflag, l_linestatus
 """

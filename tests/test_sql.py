@@ -396,3 +396,52 @@ def test_catalog(spark, t):
     spark.sql("SELECT 1").collect()               # catalog untouched
     assert spark.catalog.dropTempView("t")
     assert not spark.catalog.tableExists("t")
+
+
+def test_interval_arithmetic(spark):
+    """INTERVAL 'n' unit in date/timestamp +/- arithmetic (TPC-H spec
+    cutoffs: DATE '1998-12-01' - INTERVAL '90' DAY)."""
+    import datetime
+    import pyarrow as pa
+    rng = np.random.default_rng(3)
+    n = 2000
+    base = np.datetime64("1996-01-01")
+    d = (base + rng.integers(0, 1000, n).astype("timedelta64[D]")
+         ).astype("datetime64[D]")
+    t = pa.table({"d": pa.array(d)})
+    pdf = t.to_pandas()
+    spark.create_dataframe(t).createOrReplaceTempView("t_iv")
+    got = spark.sql(
+        "SELECT count(*) AS c FROM t_iv WHERE d <= "
+        "CAST('1998-12-01' AS date) - INTERVAL '90' DAY"
+    ).collect().to_pylist()[0]["c"]
+    cutoff = datetime.date(1998, 12, 1) - datetime.timedelta(days=90)
+    assert got == int((pdf.d <= cutoff).sum())
+    # month arithmetic clamps to month end; interval commutes over +
+    got = spark.sql("SELECT (INTERVAL '1' YEAR + CAST('2000-02-29' AS "
+                   "date)) AS d2").collect().to_pylist()[0]["d2"]
+    assert got == datetime.date(2001, 2, 28)
+    got = spark.sql("SELECT CAST('2000-01-01' AS date) + "
+                   "INTERVAL '1' MONTH 10 DAYS AS d2"
+                   ).collect().to_pylist()[0]["d2"]
+    assert got == datetime.date(2000, 2, 11)
+    with pytest.raises(ValueError):
+        spark.sql("SELECT INTERVAL '1' DAY + INTERVAL '2' DAY AS x"
+                  ).collect()
+    # operand-type dispatch: timestamp keeps sub-day precision, a date
+    # with a sub-day interval promotes to timestamp, month arithmetic is
+    # calendar-aware, and subtraction may CHAIN after an interval
+    ts = pa.table({"ts": pa.array(
+        [datetime.datetime(2020, 1, 31, 10)], type=pa.timestamp("us")),
+        "d2": pa.array([datetime.date(2020, 1, 31)], type=pa.date32())})
+    spark.create_dataframe(ts).createOrReplaceTempView("t_iv2")
+    r = spark.sql(
+        "SELECT ts + INTERVAL '1' MONTH AS b, d2 + INTERVAL '2' HOUR AS c,"
+        " ts + INTERVAL '1' DAY - INTERVAL '1' DAY AS f FROM t_iv2"
+    ).collect().to_pylist()[0]
+
+    def naive(x):
+        return x.replace(tzinfo=None) if getattr(x, "tzinfo", None) else x
+    assert naive(r["b"]) == datetime.datetime(2020, 2, 29, 10)
+    assert naive(r["c"]) == datetime.datetime(2020, 1, 31, 2)
+    assert naive(r["f"]) == datetime.datetime(2020, 1, 31, 10)
