@@ -69,6 +69,16 @@ def split_spillable_in_half(sb: SpillableColumnarBatch
     Halves inherit the parent's catalog and spill priority."""
     batch = sb.get()
     n = batch.num_rows_int
+    if n == 0:
+        # a 0-row batch holds (near) nothing: splitting is impossible but
+        # a retry is correct and bounded by the retry cap (degenerate
+        # batches arise in anti-join / empty-partition pipelines; failing
+        # the task here is useless).  Spill here — the SplitAndRetryOOM
+        # branch of with_retry does not — so the retry actually runs
+        # under relieved memory pressure.  The parent is RETURNED (not
+        # closed): the n==0 case re-queues it instead of replacing it.
+        BufferCatalog.get().spill_all_device()
+        return [sb]
     if n < 2:
         raise SplitAndRetryOOM(
             f"cannot split a {n}-row batch any further (GpuOOM)")
@@ -121,7 +131,10 @@ def with_retry(inputs: Iterable[A], fn: Callable[[A], B],
                 except SplitAndRetryOOM:
                     if split is None:
                         raise
-                    pieces = split(item)  # split closes the parent
+                    # split closes the parent and returns its pieces —
+                    # except the 0-row degenerate case, which re-queues
+                    # the SAME (unclosed) input after spilling
+                    pieces = split(item)
                     item = None
                     pieces.reverse()
                     stack.extend(pieces)

@@ -202,3 +202,78 @@ def test_nested_arrays_roundtrip(sess):
         assert g["a"] == e["a"]
         assert g["st"] == e["st"]
         assert g["sz"] == (len(e["a"]) if e["a"] is not None else -1)
+
+
+def test_rollup_vs_pandas(sess, data):
+    """Grouping sets at 100k rows with OOM injection armed: every level's
+    sums/counts must match pandas exactly."""
+    df = _df(sess, data)
+    got = (df.rollup("g", "b")
+           .agg(F.sum(df.d).alias("sv"), F.count("*").alias("c"),
+                F.grouping_id().alias("gid"))
+           .collect().to_pandas())
+    pdf = data.to_pandas()
+    l0 = (pdf.groupby(["g", "b"], dropna=False)
+          .agg(sv=("d", "sum"), c=("d", "size")).reset_index())
+    l1 = (pdf.groupby(["g"], dropna=False)
+          .agg(sv=("d", "sum"), c=("d", "size")).reset_index())
+    assert len(got) == len(l0) + len(l1) + 1
+    # key-wise comparison at every level (b is nullable: merge on both
+    # keys with NaN-safe equality via fillna sentinels)
+    g0 = (got[got.gid == 0].assign(bk=lambda x: x.b.fillna(-1))
+          .sort_values(["g", "bk"]).reset_index(drop=True))
+    e0 = (l0.assign(bk=lambda x: x.b.fillna(-1))
+          .sort_values(["g", "bk"]).reset_index(drop=True))
+    assert np.array_equal(g0["g"], e0["g"])
+    assert np.array_equal(g0["c"], e0["c"])
+    assert np.allclose(np.asarray(g0["sv"].fillna(0.0)),
+                       np.asarray(e0["sv"].fillna(0.0)))
+    g1 = got[got.gid == 1].sort_values("g").reset_index(drop=True)
+    e1 = l1.sort_values("g").reset_index(drop=True)
+    assert np.array_equal(g1["g"], e1["g"])
+    assert np.array_equal(g1["c"], e1["c"])
+    assert np.allclose(np.asarray(g1["sv"].fillna(0.0)),
+                       np.asarray(e1["sv"].fillna(0.0)))
+    tot = got[got.gid == 3]
+    assert int(tot["c"].iloc[0]) == len(pdf)
+    assert np.isclose(float(tot["sv"].iloc[0]), pdf.d.sum())
+
+
+def test_subquery_predicates_vs_pandas(sess, data):
+    """IN / NOT EXISTS subqueries at 100k rows against pandas."""
+    df = _df(sess, data)
+    df.createOrReplaceTempView("fz_t")
+    pdf = data.to_pandas()
+    got = sess.sql(
+        "SELECT g, count(*) AS c FROM fz_t WHERE g IN "
+        "(SELECT g FROM fz_t WHERE d > 0.98) GROUP BY g ORDER BY g"
+    ).collect().to_pandas()
+    keys = set(pdf.g[pdf.d > 0.98])
+    exp = (pdf[pdf.g.isin(keys)].groupby("g").size()
+           .sort_index().reset_index(name="c"))
+    assert np.array_equal(got["g"], exp["g"])
+    assert np.array_equal(got["c"], exp["c"])
+    got = sess.sql(
+        "SELECT count(*) AS c FROM fz_t a WHERE NOT EXISTS "
+        "(SELECT 1 FROM fz_t b WHERE b.g = a.g AND b.d > 0.98)"
+    ).collect().to_pylist()[0]["c"]
+    assert got == int((~pdf.g.isin(keys)).sum())
+
+
+def test_scalar_subquery_and_interval_vs_pandas(sess, data):
+    df = _df(sess, data)
+    df.createOrReplaceTempView("fz_t2")
+    pdf = data.to_pandas()
+    got = sess.sql(
+        "SELECT count(*) AS c FROM fz_t2 WHERE d > "
+        "(SELECT avg(d) FROM fz_t2)").collect().to_pylist()[0]["c"]
+    assert got == int((pdf.d > pdf.d.mean()).sum())
+    got = sess.sql(
+        "SELECT count(*) AS c FROM fz_t2 WHERE dt + INTERVAL '1' YEAR "
+        "<= CAST('2015-06-01' AS date)").collect().to_pylist()[0]["c"]
+    import datetime
+    shifted = pd.Series(pdf.dt.dropna()).map(
+        lambda x: datetime.date(x.year + 1, x.month,
+                                28 if (x.month == 2 and x.day == 29)
+                                else x.day))
+    assert got == int((shifted <= datetime.date(2015, 6, 1)).sum())
