@@ -75,6 +75,19 @@ from .config import RapidsConf  # noqa: F401
 from .columnar import ColumnarBatch, DeviceColumn  # noqa: F401
 
 
+def pin_host_platform() -> None:
+    """Flip this process to the CPU platform AND drop the persistent
+    compile cache.  For callers that decide on the host platform AFTER
+    importing this package (the import-time cache setup saw the ambient
+    TPU platform): XLA:CPU AOT cache entries fail the loader's
+    machine-feature check and have caused SIGILL-class crashes."""
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+        _jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+
+
 def session(conf=None, **conf_kwargs):
     """Create (or get) the TpuSession — entry point of the user API."""
     try:

@@ -77,7 +77,7 @@ def split_spillable_in_half(sb: SpillableColumnarBatch
         # branch of with_retry does not — so the retry actually runs
         # under relieved memory pressure.  The parent is RETURNED (not
         # closed): the n==0 case re-queues it instead of replacing it.
-        BufferCatalog.get().spill_all_device()
+        sb.catalog.spill_all_device()
         return [sb]
     if n < 2:
         raise SplitAndRetryOOM(

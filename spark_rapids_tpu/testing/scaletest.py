@@ -710,7 +710,10 @@ if __name__ == "__main__":
     # forever — flip the CONFIG to the host platform unless the caller
     # explicitly asks for the chip (SRT_SCALE_PLATFORM=axon)
     plat = os.environ.get("SRT_SCALE_PLATFORM", "cpu")
-    if plat:
+    if plat == "cpu":
+        from spark_rapids_tpu import pin_host_platform
+        pin_host_platform()  # also drops the CPU-hazardous compile cache
+    elif plat:
         import jax
         jax.config.update("jax_platforms", plat)
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000

@@ -225,6 +225,7 @@ def test_rollup_vs_pandas(sess, data):
     e0 = (l0.assign(bk=lambda x: x.b.fillna(-1))
           .sort_values(["g", "bk"]).reset_index(drop=True))
     assert np.array_equal(g0["g"], e0["g"])
+    assert np.array_equal(g0["bk"], e0["bk"])
     assert np.array_equal(g0["c"], e0["c"])
     assert np.allclose(np.asarray(g0["sv"].fillna(0.0)),
                        np.asarray(e0["sv"].fillna(0.0)))
