@@ -150,3 +150,45 @@ def test_mesh_tpcds_star_joins(qname, tpcds_rig):
     fn(sess, t, F)  # oracle asserts inside
     assert M.STATS["mesh_exchanges"] > before, \
         "star join did not ride the mesh data plane"
+
+
+def test_mesh_rollup(ici_sess, rng):
+    """Grouping sets over the mesh: Expand feeds a mesh-exchanged
+    aggregate; every level must match pandas."""
+    left, _ = make_tables(rng)
+    before = M.STATS["mesh_exchanges"]
+    df = ici_sess.create_dataframe(left, num_partitions=8)
+    got = (df.rollup("k")
+           .agg(F.sum(df.v).alias("sv"), F.grouping_id().alias("gid"))
+           .collect().to_pandas())
+    assert M.STATS["mesh_exchanges"] > before
+    pdf = left.to_pandas()
+    l1 = pdf.groupby("k").agg(sv=("v", "sum")).reset_index()
+    assert len(got) == len(l1) + 1
+    g0 = got[got.gid == 0].sort_values("k").reset_index(drop=True)
+    assert np.array_equal(g0["k"], l1["k"])
+    assert np.allclose(g0["sv"], l1["sv"])
+    assert np.isclose(float(got[got.gid == 1]["sv"].iloc[0]), pdf.v.sum())
+
+
+def test_mesh_subquery_semi_join(ici_sess, rng):
+    """EXISTS/IN rewrites produce semi/anti joins that ride the mesh."""
+    left, right = make_tables(rng)
+    sess = srt.session(**ICI_CONF,
+                       **{"spark.rapids.sql.autoBroadcastJoinThreshold": 1})
+    sess.create_dataframe(left, num_partitions=8) \
+        .createOrReplaceTempView("mesh_l")
+    sess.create_dataframe(right, num_partitions=4) \
+        .createOrReplaceTempView("mesh_r")
+    before = M.STATS["mesh_exchanges"]
+    got = sess.sql(
+        "SELECT k, count(*) AS c FROM mesh_l WHERE k IN "
+        "(SELECT k FROM mesh_r WHERE w > 500) GROUP BY k ORDER BY k"
+    ).collect().to_pandas()
+    assert M.STATS["mesh_exchanges"] > before
+    lp, rp = left.to_pandas(), right.to_pandas()
+    keys = set(rp.k[rp.w > 500])
+    exp = (lp[lp.k.isin(keys)].groupby("k").size()
+           .sort_index().reset_index(name="c"))
+    assert np.array_equal(got["k"], exp["k"])
+    assert np.array_equal(got["c"], exp["c"])
