@@ -1,5 +1,4 @@
-"""Benchmark harness — runs on the real TPU chip (ambient platform left
-as-is so the axon tunnel backend is used when present).
+"""Benchmark harness — fights for the real TPU chip for the whole budget.
 
 Workload: a TPC-H q1-shaped columnar pipeline (filter + projected arithmetic
 + group-by aggregation) over generated lineitem-like data, through the full
@@ -7,20 +6,29 @@ engine (DataFrame API -> overrides -> jitted XLA kernels).  Baseline: the
 same query via pandas on the host CPU — the stand-in for the reference's
 CPU-Spark baseline (BASELINE.md: ≥3× Spark-CPU is the north star).
 
-Robustness contract (round-1 postmortem): this script ALWAYS prints exactly
-one JSON line, even if the device backend hangs or the engine fails — a
-watchdog thread emits a partial record and exits before the driver's
-timeout.  Columns are float32 (TPU-native); repeats are few; rows default
-to 1M so a full run fits the driver budget.
+Architecture (round-2 postmortem: one 60s probe forfeited the whole round's
+perf evidence to a transiently-hung tunnel):
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  parent (this process, never imports jax)
+    ├── CPU-insurance child: runs the full measurement on the CPU platform
+    │     from t=0, concurrently — the fallback number costs no reserved
+    │     budget and is ready whenever the device attempts give up
+    └── device attempts, in a loop until the budget runs out:
+          fresh subprocess each time (a hung backend init cannot be retried
+          in-process), quick responsiveness probe, then the measurement.
+          Each probe outcome is timestamped; if the tunnel is dead all
+          round the JSON says exactly when it was tried.
+
+The parent ALWAYS prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import subprocess
 import sys
 import threading
 import time
@@ -28,9 +36,9 @@ import time
 import numpy as np
 
 #: TPC-H SF1 lineitem is ~6M rows; 8M keeps the workload representative
-#: of the actual benchmark target.  The bench banks a result at 1M first
+#: of the actual benchmark target.  The child banks a result at 1M first
 #: (fast even with a cold XLA compile cache), then upgrades to the full
-#: size if budget remains — the watchdog emits the best result so far.
+#: size — its watchdog emits the best result so far.
 try:
     ROWS = int(float(sys.argv[1])) if len(sys.argv) > 1 else 8_000_000
 except ValueError:
@@ -38,6 +46,16 @@ except ValueError:
 WARM_ROWS = min(1_000_000, ROWS)
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "270"))
+PROBE_S = float(os.environ.get("BENCH_PROBE_S", "30"))
+
+
+def _ts() -> str:
+    return time.strftime("%H:%M:%S", time.gmtime()) + "Z"
+
+
+# --------------------------------------------------------------------------
+# child: one measurement run (mode = "device" | "cpu")
+# --------------------------------------------------------------------------
 
 _lock = threading.Lock()
 _printed = False
@@ -46,7 +64,7 @@ _result = {"metric": "tpch_q1_like_rows_per_sec", "value": 0,
 
 
 def _emit(**extra) -> None:
-    """Print the single JSON result line exactly once."""
+    """Print the single JSON result line exactly once (child side)."""
     global _printed
     with _lock:
         if _printed:
@@ -56,11 +74,6 @@ def _emit(**extra) -> None:
         out.update(extra)
         sys.stdout.write(json.dumps(out) + "\n")
         sys.stdout.flush()
-
-
-def _watchdog() -> None:
-    _emit(note="watchdog: budget exceeded, partial result")
-    os._exit(0)
 
 
 def make_data(rows: int):
@@ -141,7 +154,7 @@ def run_engine(data) -> tuple:
 
 def _device_responsive(timeout_s: float) -> bool:
     """Probe the ambient device backend from a daemon thread; a hung TPU
-    tunnel must not take the whole bench (and its JSON line) with it."""
+    tunnel must not take the whole child (and its exit) with it."""
     ok: list = []
 
     def probe():
@@ -158,55 +171,49 @@ def _device_responsive(timeout_s: float) -> bool:
     return bool(ok)
 
 
-def main():
-    wd = threading.Timer(BUDGET_S, _watchdog)
+def child_main(mode: str) -> None:
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE",
+                                    time.time() + BUDGET_S))
+
+    def watchdog():
+        _emit(note="watchdog: budget exceeded, partial result")
+        os._exit(0)
+
+    wd = threading.Timer(max(deadline - time.time(), 1.0), watchdog)
     wd.daemon = True
     wd.start()
 
-    # Local-dev override: the ambient sitecustomize forces the axon tunnel
-    # platform via jax.config (env vars can't override it).  The driver
-    # leaves this unset so the real chip is used.  MUST run before the
-    # package import below — its persistent-cache setup is platform-gated
-    # (CPU AOT cache entries are a SIGILL hazard; TPU remote compiles are
-    # the thing worth caching).
-    plat = os.environ.get("BENCH_PLATFORM")
-    if plat:
+    if mode == "cpu":
+        # MUST run before the package import — its persistent-cache setup
+        # is platform-gated (CPU AOT cache entries are a SIGILL hazard;
+        # TPU remote compiles are the thing worth caching).
         import jax
-        jax.config.update("jax_platforms", plat)
+        jax.config.update("jax_platforms", "cpu")
 
-    # Persistent XLA compilation cache: first-compile on the TPU tunnel
-    # costs 20-60s per program; the package configures a host-scoped cache
-    # dir under the repo, amortizing compiles across driver runs.
     try:
-        import spark_rapids_tpu  # noqa: F401  (configures the cache + x64)
+        import spark_rapids_tpu  # noqa: F401  (configures cache + x64)
     except Exception:
         pass
 
-    if not plat and not _device_responsive(60.0):
-        # tunnel hung: re-exec onto the CPU platform so the bench still
-        # produces a real number (noted as the fallback it is)
-        import subprocess
-        env = dict(os.environ)
-        env["BENCH_PLATFORM"] = "cpu"
-        env["BENCH_BUDGET_S"] = str(max(BUDGET_S - 90, 60))
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
-            env=env, stdout=subprocess.PIPE, timeout=BUDGET_S - 75)
-        line = proc.stdout.decode().strip().splitlines()
-        out = json.loads(line[-1]) if line else {}
-        out["note"] = ("device backend unresponsive; CPU-platform "
-                       "fallback numbers")
-        sys.stdout.write(json.dumps(out) + "\n")
+    if mode == "device":
+        if not _device_responsive(PROBE_S):
+            sys.stdout.write(json.dumps({"probe": "hung"}) + "\n")
+            sys.stdout.flush()
+            os._exit(3)
+        # the parent extends its patience once the device answers
+        sys.stdout.write(json.dumps({"probe": "ok"}) + "\n")
         sys.stdout.flush()
-        os._exit(0)
+
+    import jax
+    platform = jax.default_backend()
 
     tol = 2e-3  # float32 accumulation vs pandas float64
     note = None
 
     def measure(rows: int):
-        """Bank one measurement into _result; returns the note (if any).
-        Called smallest-size first so a budget/watchdog cutoff mid-way
-        through the big size still reports a real number."""
+        """Bank one measurement into _result.  Called smallest-size first
+        so a budget/watchdog cutoff mid-way through the big size still
+        reports a real number."""
         nonlocal note
         data = make_data(rows)
         cpu_time, cpu_result = run_pandas(data)
@@ -225,7 +232,7 @@ def main():
                    f"{type(e).__name__}: {e}"
         _result.update(value=round(rows / eng_time),
                        vs_baseline=round(cpu_time / eng_time, 3),
-                       rows=rows)
+                       rows=rows, platform=platform)
 
     try:
         measure(WARM_ROWS)
@@ -236,13 +243,13 @@ def main():
             note = (note or "") + f"; larger size failed: " \
                 f"{type(e).__name__}: {e}"
         else:
-            _emit(note=f"engine failed: {type(e).__name__}: {e}")
+            _emit(note=f"engine failed: {type(e).__name__}: {e}",
+                  platform=platform)
             return
     # context: each host<->device sync over the axon tunnel costs a full
     # network round trip; with N sequential pipeline stages the floor is
     # N*rtt regardless of device speed, so report the measured rtt
     try:
-        import jax
         import jax.numpy as jnp
         x = jnp.ones(8)
         float(jnp.sum(x) + 1.0)  # warm the EXACT timed expression
@@ -251,15 +258,174 @@ def main():
         _result["sync_rtt_ms"] = round((time.perf_counter() - t0) * 1000, 1)
     except Exception:
         pass
-    if note:
-        _emit(note=note)
+    _emit(**({"note": note} if note else {}))
+
+
+# --------------------------------------------------------------------------
+# parent: orchestrate device attempts against the CPU insurance run
+# --------------------------------------------------------------------------
+
+class _Child:
+    """Subprocess whose stdout lines are collected by a reader thread, so
+    the parent can wait with timeouts without blocking on readline."""
+
+    def __init__(self, mode: str, deadline: float):
+        env = dict(os.environ)
+        env["BENCH_CHILD"] = mode
+        env["BENCH_CHILD_DEADLINE"] = str(deadline)
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        self.lines: queue.Queue = queue.Queue()
+        t = threading.Thread(target=self._read, daemon=True)
+        t.start()
+
+    def _read(self):
+        for raw in self.proc.stdout:
+            line = raw.decode(errors="replace").strip()
+            if line.startswith("{"):
+                try:
+                    self.lines.put(json.loads(line))
+                except ValueError:
+                    pass
+        self.lines.put(None)  # EOF
+
+    def next_record(self, timeout: float):
+        """Next JSON record, or None on EOF/timeout."""
+        try:
+            return self.lines.get(timeout=max(timeout, 0.1))
+        except queue.Empty:
+            return None
+
+    def pause(self):
+        """SIGSTOP — the insurance run must not contend for host CPU while
+        a device child runs its timed measurement (it would inflate the
+        device child's pandas baseline and thus vs_baseline)."""
+        import signal
+        try:
+            self.proc.send_signal(signal.SIGSTOP)
+        except OSError:
+            pass
+
+    def resume(self):
+        import signal
+        try:
+            self.proc.send_signal(signal.SIGCONT)
+        except OSError:
+            pass
+
+    def kill(self):
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+
+
+def _final(rec) -> bool:
+    return bool(rec) and "value" in rec and rec.get("rows")
+
+
+def orchestrate() -> None:
+    t0 = time.time()
+    deadline = t0 + BUDGET_S - 8  # leave room to print before driver cutoff
+    probes = []
+
+    # insurance: full measurement on the CPU platform, from t=0
+    cpu_child = _Child("cpu", deadline - 4)
+
+    device_result = None
+    attempt = 0
+    prev_error = None
+    while time.time() < deadline - (PROBE_S + 35):
+        attempt += 1
+        probe_t = _ts()
+        dev = _Child("device", deadline - 4)
+        # phase 1: wait for the probe verdict (import + probe + slack),
+        # clamped so a wedged child can never push us past the deadline
+        rec = dev.next_record(min(PROBE_S + 60, deadline - time.time()))
+        if rec is None:
+            probes.append(f"{probe_t} wedged")
+            dev.kill()
+        elif rec.get("probe") == "hung":
+            probes.append(f"{probe_t} hung")
+            dev.kill()
+        elif rec.get("probe") == "ok":
+            probes.append(f"{probe_t} ok")
+            # phase 2: device is answering — give it the rest of the
+            # budget, and stop the insurance run from contending for CPU
+            # while the device child times its pandas baseline
+            cpu_child.pause()
+            rec = dev.next_record(deadline - time.time())
+            if _final(rec):
+                device_result = rec
+                break
+            dev.kill()
+            cpu_child.resume()
+            err = rec.get("note") if rec else None
+            probes.append(f"{_ts()} error: {str(err)[:100]}" if err
+                          else f"{_ts()} died mid-run")
+            if err and err == prev_error:
+                break  # deterministic engine failure — retries won't help
+            prev_error = err
+        else:
+            # crashed before probing (e.g. import failure) — surface it
+            dev.kill()
+            err = rec.get("note", "unrecognized child record")
+            probes.append(f"{probe_t} error: {str(err)[:100]}")
+            if err == prev_error:
+                break
+            prev_error = err
+        # back off before hammering the tunnel again; probes are cheap but
+        # a recovering backend needs a gap
+        if time.time() < deadline - (PROBE_S + 90):
+            time.sleep(min(10.0 + 5.0 * attempt, 60.0))
+
+    if device_result is not None and device_result.get("platform") != "cpu":
+        cpu_child.kill()
+        device_result["probe_attempts"] = attempt
+        print(json.dumps(device_result))
+        return
+
+    # fall back to the insurance number (or a device child that turned out
+    # to be running on an ambient CPU platform — same thing)
+    cpu_child.resume()
+    fallback = device_result
+    while True:
+        rec = cpu_child.next_record(deadline - time.time())
+        if rec is None:
+            break
+        if _final(rec):
+            fallback = rec
+            break
+    cpu_child.kill()
+    if fallback is None:
+        fallback = {"metric": "tpch_q1_like_rows_per_sec", "value": 0,
+                    "unit": "rows/s", "vs_baseline": 0.0}
+    if device_result is not None and device_result.get("platform") == "cpu":
+        note = "no TPU backend in this environment; CPU-platform numbers"
+    elif not probes:
+        note = "no device attempt fit the budget; CPU-platform numbers"
+    elif any(p.endswith(" ok") for p in probes):
+        note = ("device answered probes but no measurement completed; "
+                "CPU-platform fallback numbers; probes: " + ", ".join(probes))
     else:
-        _emit()
+        note = ("device backend unresponsive; CPU-platform fallback "
+                "numbers; probes: " + ", ".join(probes))
+    fallback["note"] = note
+    print(json.dumps(fallback))
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except BaseException as e:  # contract: one JSON line, no matter what
-        _emit(note=f"unexpected failure: {type(e).__name__}: {e}")
-    os._exit(0)  # don't hang on stray non-daemon backend threads
+    mode = os.environ.get("BENCH_CHILD")
+    if mode:
+        try:
+            child_main(mode)
+        except BaseException as e:
+            _emit(note=f"unexpected failure: {type(e).__name__}: {e}")
+        os._exit(0)  # don't hang on stray non-daemon backend threads
+    else:
+        try:
+            orchestrate()
+        except BaseException as e:
+            _emit(note=f"orchestrator failure: {type(e).__name__}: {e}")
+        os._exit(0)
