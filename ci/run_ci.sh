@@ -12,35 +12,66 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/6] native libraries ==="
+echo "=== [1/7] native libraries ==="
 make -C native
 
-echo "=== [2/6] API contract validation ==="
+echo "=== [2/7] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/6] docgen drift check ==="
+echo "=== [3/7] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/6] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [4/7] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
-    python -m pytest tests/ -x -q
+    # the <3-minute smoke tier (markers assigned in tests/conftest.py)
+    python -m pytest tests/ -m quick -x -q
 else
     python -m pytest tests/ -q
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [5/6] scale rig ==="
+    echo "=== [5/7] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 1200 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [5/6] scale rig skipped (quick) ==="
+    echo "=== [5/7] scale rig skipped (quick) ==="
 fi
 
-echo "=== [6/6] driver entry checks ==="
+echo "=== [6/7] packaging: wheel builds and installs ==="
+WHEELDIR=$(mktemp -d)
+timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
+    -w "$WHEELDIR" -q
+VENV=$(mktemp -d)/venv
+python -m venv "$VENV"
+"$VENV/bin/pip" install -q --no-deps --no-index "$WHEELDIR"/*.whl
+# expose the ambient deps (jax/numpy/pyarrow are baked into the image,
+# not downloadable here) to the otherwise-clean venv
+python - "$VENV" <<'PYEOF'
+import os, site, sys, sysconfig
+venv = sys.argv[1]
+dst = None
+for root, dirs, files in os.walk(os.path.join(venv, "lib")):
+    if root.endswith("site-packages"):
+        dst = root
+        break
+src = sysconfig.get_paths()["purelib"]
+with open(os.path.join(dst, "ambient_deps.pth"), "w") as fh:
+    fh.write(src + "\n")
+PYEOF
+JAX_PLATFORMS=cpu timeout 300 env -C "$WHEELDIR" "$VENV/bin/python" -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import spark_rapids_tpu, pyarrow as pa
+s = spark_rapids_tpu.session()
+t = s.create_dataframe(pa.table({'k': [1, 2, 1]})).groupBy('k').count().collect()
+assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
+print('wheel OK', spark_rapids_tpu.__version__)
+"
+
+echo "=== [7/7] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 

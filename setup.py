@@ -1,0 +1,26 @@
+"""Build hook: bundle the repo-root ``native/*.cpp`` sources into the
+``spark_rapids_tpu.native`` package so installed artifacts are
+self-contained (native/_loader.py compiles them on first use).  All other
+metadata lives in pyproject.toml."""
+
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BundleNativeSources(build_py):
+    def run(self):
+        super().run()
+        root = os.path.dirname(os.path.abspath(__file__))
+        src_dir = os.path.join(root, "native")
+        dst_dir = os.path.join(self.build_lib, "spark_rapids_tpu", "native")
+        if os.path.isdir(src_dir) and os.path.isdir(dst_dir):
+            for name in os.listdir(src_dir):
+                if name.endswith(".cpp"):
+                    shutil.copy2(os.path.join(src_dir, name),
+                                 os.path.join(dst_dir, name))
+
+
+setup(cmdclass={"build_py": BundleNativeSources})

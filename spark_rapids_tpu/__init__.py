@@ -8,7 +8,7 @@ falling back to a host (Arrow/numpy) engine per-operator when anything is
 unsupported, while targeting bit-identical results to the host engine.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 import jax as _jax
 

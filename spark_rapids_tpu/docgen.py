@@ -129,7 +129,11 @@ def generate(root: str) -> List[str]:
     return written
 
 
-if __name__ == "__main__":
+def main() -> None:
     root = sys.argv[1] if len(sys.argv) > 1 else os.getcwd()
     for p in generate(root):
         print("wrote", p)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,8 +7,6 @@ toolchain exists (callers check ``available()``)."""
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Optional
 
@@ -19,29 +17,15 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _repo_native_dir() -> str:
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.normpath(os.path.join(here, "..", "..", "native"))
-
-
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        ndir = _repo_native_dir()
-        so = os.path.join(ndir, "libsrt_native.so")
-        src = os.path.join(ndir, "srt_native.cpp")
-        if not os.path.exists(so) and os.path.exists(src):
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-                     "-o", so, src],
-                    check=True, capture_output=True, timeout=120)
-            except Exception:
-                return None
-        if not os.path.exists(so):
+        from ._loader import find_or_build
+        so = find_or_build("libsrt_native.so", "srt_native.cpp")
+        if so is None:
             return None
         try:
             lib = ctypes.CDLL(so)

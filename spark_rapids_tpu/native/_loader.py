@@ -1,0 +1,75 @@
+"""Locate-or-build logic for the native C++ host libraries.
+
+Search order (reference analog: the JNI jar bundles prebuilt .so files,
+``dist/README.md``; here the wheel stays pure-Python and ships the C++
+sources, compiled on first use wherever a toolchain exists):
+
+1. a prebuilt ``.so`` next to this package (installed-wheel layout, when a
+   builder chose to ship binaries) or in the repo-root ``native/`` dir
+   (development checkout layout);
+2. failing that, the matching ``.cpp`` from either location, compiled with
+   g++ into the first writable directory (next to the source, else
+   ``~/.cache/spark_rapids_tpu/native``).
+
+Every caller has a pure-Python fallback, so returning ``None`` degrades
+features, never breaks them.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+
+def _candidate_dirs() -> list:
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.normpath(os.path.join(pkg, "..", "..", "native"))
+    return [pkg, repo]
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "spark_rapids_tpu", "native")
+
+
+def _src_tag(src: str) -> str:
+    """Short content hash — compile outputs carry it in their filename so
+    a library built from older sources can never shadow newer ones (the
+    repo-root dir is exempt: its plain-named .so is Makefile-managed)."""
+    import hashlib
+    with open(src, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()[:10]
+
+
+def find_or_build(libname: str, srcname: str,
+                  extra_flags: tuple = ()) -> Optional[str]:
+    """Path to a loadable shared library, building it if necessary."""
+    pkg, repo = _candidate_dirs()
+    repo_so = os.path.join(repo, libname)
+    if os.path.exists(repo_so):
+        return repo_so
+    stem, ext = os.path.splitext(libname)
+    for d in (pkg, repo):
+        src = os.path.join(d, srcname)
+        if not os.path.exists(src):
+            continue
+        tagged = f"{stem}-{_src_tag(src)}{ext}"
+        for outdir in (d, _cache_dir()):
+            so = os.path.join(outdir, tagged)
+            if os.path.exists(so):
+                return so
+            try:
+                os.makedirs(outdir, exist_ok=True)
+            except OSError:
+                continue
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                     *extra_flags, "-o", so, src],
+                    check=True, capture_output=True, timeout=120)
+                return so
+            except Exception:
+                continue
+    return None

@@ -16,8 +16,6 @@ shuffle manager).
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Dict, List, Optional
 
@@ -31,29 +29,16 @@ _tried = False
 _FOUND, _MISSING, _NETFAIL = 0, 1, 2
 
 
-def _native_dir() -> str:
-    here = os.path.dirname(os.path.abspath(__file__))
-    return os.path.normpath(os.path.join(here, "..", "..", "native"))
-
-
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        ndir = _native_dir()
-        so = os.path.join(ndir, "libsrt_transport.so")
-        src = os.path.join(ndir, "srt_transport.cpp")
-        if not os.path.exists(so) and os.path.exists(src):
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-                     "-pthread", "-o", so, src],
-                    check=True, capture_output=True, timeout=120)
-            except Exception:
-                return None
-        if not os.path.exists(so):
+        from ..native._loader import find_or_build
+        so = find_or_build("libsrt_transport.so", "srt_transport.cpp",
+                           extra_flags=("-pthread",))
+        if so is None:
             return None
         try:
             lib = ctypes.CDLL(so)

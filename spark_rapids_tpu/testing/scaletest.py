@@ -700,7 +700,7 @@ def run_suite(rows: int = 50_000, queries=None, tables=None,
     return report
 
 
-if __name__ == "__main__":
+def main() -> None:
     import json
     import os
     import sys
@@ -719,3 +719,7 @@ if __name__ == "__main__":
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     for entry in run_suite(rows):
         print(json.dumps(entry))
+
+
+if __name__ == "__main__":
+    main()

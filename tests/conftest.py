@@ -27,6 +27,23 @@ import pyarrow as pa  # noqa: E402
 import pytest  # noqa: E402
 
 
+#: the <3-minute smoke tier (`pytest -m quick`): one module per major
+#: layer — columnar model, expressions, SQL front-end+planner, joins,
+#: memory/spill/retry, native lib.  Everything else is marked slow; the
+#: full matrix runs in ci/run_ci.sh.
+QUICK_MODULES = {
+    "test_columnar", "test_expressions", "test_sql", "test_joins",
+    "test_memory", "test_native",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1] if item.module else ""
+        item.add_marker(pytest.mark.quick if mod in QUICK_MODULES
+                        else pytest.mark.slow)
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _release_compiled_programs():
     """Free XLA executables between test modules.
