@@ -227,6 +227,13 @@ OPTIMIZER_GPU_COST = register(
 OPTIMIZER_TRANSITION_COST = register(
     "spark.rapids.sql.optimizer.transition.default",
     "Cost (seconds/row) of a host<->device transition boundary.", 0.0001)
+OPTIMIZER_TRANSITION_FIXED = register(
+    "spark.rapids.sql.optimizer.transition.fixedSeconds",
+    "FIXED cost (seconds) of each host<->device transition boundary, "
+    "independent of row count.  On the TPU tunnel every host pull is a "
+    "full network round trip (~65ms measured, docs/perf_notes.md) that "
+    "dwarfs per-row costs for small batches.  -1 (default) = auto: "
+    "measure the sync round trip once per process and use that.", -1.0)
 
 # --- shuffle ---------------------------------------------------------------
 SHUFFLE_MODE = register(

@@ -20,7 +20,8 @@ from __future__ import annotations
 from typing import Dict, Optional, Tuple
 
 from ..config import (OPTIMIZER_CPU_COST, OPTIMIZER_GPU_COST,
-                      OPTIMIZER_TRANSITION_COST, RapidsConf)
+                      OPTIMIZER_TRANSITION_COST, OPTIMIZER_TRANSITION_FIXED,
+                      RapidsConf)
 from . import plan as P
 
 #: per-op cost multipliers relative to the default per-row cost — the
@@ -61,13 +62,59 @@ def _op_name(node) -> str:
     return type(node).__name__
 
 
+#: one-time measured host<->device sync round trip (seconds); on the TPU
+#: tunnel this is ~65ms of network latency, locally ~0.1ms — the single
+#: number that decides whether small queries are worth the device at all
+_MEASURED: Dict[str, Optional[float]] = {"rtt_s": None}
+
+
+def transition_fixed_seconds(conf: RapidsConf) -> float:
+    """Fixed per-boundary transition cost: the configured value, or (auto)
+    a once-per-process measured sync round trip on the ambient backend."""
+    v = float(conf.get(OPTIMIZER_TRANSITION_FIXED))
+    if v >= 0:
+        return v
+    if _MEASURED["rtt_s"] is None:
+        _MEASURED["rtt_s"] = _probe_sync_rtt()
+    return _MEASURED["rtt_s"]
+
+
+def _probe_sync_rtt() -> float:
+    """Measure one warm sync round trip — from a daemon thread, because a
+    hung TPU tunnel must not take the planner with it.  An unresponsive
+    backend reports a very high transition cost, which is the truthful
+    answer: every device boundary would block."""
+    import threading
+    import time
+    got: list = []
+
+    def probe():
+        try:
+            import jax.numpy as jnp
+            x = jnp.ones(8)
+            float(jnp.sum(x) + 1.0)  # warm the exact timed expression
+            t0 = time.perf_counter()
+            float(jnp.sum(x) + 1.0)
+            got.append(time.perf_counter() - t0)
+        except Exception:
+            # an ERRORING backend is as useless as a hung one — report
+            # the same prohibitive boundary cost, never a free one
+            got.append(10.0)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(15.0)
+    return got[0] if got else 10.0
+
+
 def _subtree_costs(meta, cpu_rate: float, dev_rate: float,
-                   trans_rate: float
+                   trans_rate: float, trans_fixed: float
                    ) -> Optional[Tuple[float, float]]:
     """(host_cost, device_cost) over the CONTIGUOUS device region rooted
     here.  Host-tagged descendants cost the same under both alternatives
     and are excluded; each tpu/cpu boundary charges the device alternative
-    one interior transition.  None when any row estimate is unknown."""
+    one interior transition (fixed latency + per-row).  None when any row
+    estimate is unknown."""
     rows = _row_estimate(meta)
     if rows is None:
         return None
@@ -79,9 +126,10 @@ def _subtree_costs(meta, cpu_rate: float, dev_rate: float,
             crows = _row_estimate(c)
             if crows is None:
                 return None
-            dev += crows * trans_rate  # interior host->device boundary
+            # interior host->device boundary
+            dev += trans_fixed + crows * trans_rate
             continue
-        sub = _subtree_costs(c, cpu_rate, dev_rate, trans_rate)
+        sub = _subtree_costs(c, cpu_rate, dev_rate, trans_rate, trans_fixed)
         if sub is None:
             return None
         host += sub[0]
@@ -93,10 +141,17 @@ def apply_cost_optimizer(meta, conf: RapidsConf) -> None:
     """Demote device subtrees that the cost model says are not worth the
     transitions.  Mutates ``meta.backend`` in place (pre-conversion).
     Unknown statistics keep the device placement (no evidence = no
-    demotion, matching the reference's conservative default-off stance)."""
+    demotion, matching the reference's conservative default-off stance).
+
+    Transition costs come from the MEASURED model (docs/perf_notes.md):
+    each boundary pays a fixed sync round trip (~65ms over the TPU
+    tunnel, auto-measured per process) plus a per-row transfer rate —
+    so a 100-row query is demoted to the host while an 8M-row query
+    keeps its device placement under the same configuration."""
     cpu_rate = float(conf.get(OPTIMIZER_CPU_COST))
     dev_rate = float(conf.get(OPTIMIZER_GPU_COST))
     trans_rate = float(conf.get(OPTIMIZER_TRANSITION_COST))
+    trans_fixed = transition_fixed_seconds(conf)
 
     def walk(m):
         if m.backend != "tpu":
@@ -104,12 +159,13 @@ def apply_cost_optimizer(meta, conf: RapidsConf) -> None:
                 walk(c)
             return
         rows = _row_estimate(m)
-        costs = _subtree_costs(m, cpu_rate, dev_rate, trans_rate)
+        costs = _subtree_costs(m, cpu_rate, dev_rate, trans_rate,
+                               trans_fixed)
         if rows is None or costs is None:
             return  # unknown stats: keep the device placement
         host, dev = costs
         # device data enters and leaves the subtree once each
-        dev_total = dev + 2 * rows * trans_rate
+        dev_total = dev + 2 * (trans_fixed + rows * trans_rate)
         if dev_total > host:
             _demote(m, dev_total, host)
         # a kept device subtree keeps its children on device too — the

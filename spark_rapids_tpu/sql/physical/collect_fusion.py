@@ -63,28 +63,43 @@ class _ReplaySource(PhysicalPlan):
 
 
 class FusedCollectExec(PhysicalPlan):
-    """``DeviceToHost(Sort?(HashAggregate(complete)))`` as one program.
+    """``DeviceToHost(Sort?(HashAggregate(complete|final)))`` as one program.
 
     Children: the aggregate's child (the device-side source).  The wrapped
     original subtree is kept for the fallback path.
+
+    Complete mode runs under a speculated group-table size (deferred
+    validation); final mode — the multi-partition shape, where the child
+    is the post-exchange coalesced partial — needs NO speculation: the
+    merge's group count is exact and rides home inside the same pack.
     """
 
     backend = CPU  # emits host batches, like the D2H transition it replaces
 
     def __init__(self, agg: HashAggregateExec, sort: Optional[SortExec],
                  fallback: DeviceToHostExec,
-                 topn: Optional["TakeOrderedAndProjectExec"] = None):
+                 topn: Optional["TakeOrderedAndProjectExec"] = None,
+                 skip_exchange=None, project=None):
         super().__init__(agg.children[0])
         self._agg = agg
         self._sort = sort
         self._topn = topn
         self._fallback = fallback
+        #: device rename/compute Project between the agg and the sort (the
+        #: SQL front-end's `__agg_N AS name` layer), composed into the
+        #: traced tail
+        self._project = project
+        #: the orderBy's range exchange between the sort and the final agg,
+        #: matched through at plan time; sound to skip only when every
+        #: live row lands in ONE reduce partition (decided at pid 0)
+        self._skip_ex = skip_exchange
+        self._decision: Optional[str] = None
 
     @property
     def output(self):
         return self._fallback.output
 
-    def _tail_key(self, spec: int, capacity: int):
+    def _tail_key(self, spec: Optional[int], capacity: int):
         from ...columnar.convert import _f64_as_pair, _pack_f64_enabled
         from .kernel_cache import exprs_key
         sort_key = (exprs_key(self._sort._bound)
@@ -96,23 +111,38 @@ class FusedCollectExec(PhysicalPlan):
                         exprs_key(t.project_exprs)
                         if t.project_exprs is not None else None,
                         tuple(a.name for a in t.output))
-        return ("tailcollect", spec, capacity,
-                self._agg._fused_complete_key(spec), sort_key, topn_key,
-                _f64_as_pair(), _pack_f64_enabled())
+        agg_key = (self._agg._fused_complete_key(spec) if spec is not None
+                   else ("mergefin",) + self._agg._finalize_key)
+        proj_key = (self._project._fuse_key()
+                    if self._project is not None else None)
+        return ("tailcollect", spec, capacity, agg_key, proj_key, sort_key,
+                topn_key, _f64_as_pair(), _pack_f64_enabled())
 
-    def _build(self, spec: int, batch: ColumnarBatch, key):
+    def _build(self, spec: Optional[int], batch: ColumnarBatch, key):
         """Compose agg body + sort + pack into one jitted fn for this
-        (speculated size, input signature)."""
+        (speculated size | final-merge, input signature)."""
         import jax
 
         from ...columnar.convert import pack_leaves_traced
         from .kernel_cache import cached_jit
-        agg_body = self._agg._fused_complete_body(spec)
+        agg = self._agg
+        if spec is not None:
+            agg_body = agg._fused_complete_body(spec)
+        else:
+            def agg_body(b):
+                fin = agg._finalize(agg._merge_compute(b))
+                return fin, fin.num_rows
+        proj_compute = (self._project._compute
+                        if self._project is not None else None)
         sort_compute = self._sort._compute if self._sort is not None else None
-        topn_step = self._topn_step(spec) if self._topn is not None else None
+        topn_step = (self._topn_step(spec if spec is not None
+                                     else batch.capacity)
+                     if self._topn is not None else None)
 
         def tail_body(b):
             fin, ng = agg_body(b)
+            if proj_compute is not None:
+                fin = proj_compute(fin)
             if sort_compute is not None:
                 fin = sort_compute(fin)
             if topn_step is not None:
@@ -165,30 +195,79 @@ class FusedCollectExec(PhysicalPlan):
         return step
 
     def execute(self, pid, tctx):
-        from ...memory.oom_guard import guard_device_oom
-        from ...memory.retry import SplitAndRetryOOM
-        from ...columnar.convert import unpack_buffers
         from . import speculation as SPEC
         agg = self._agg
-        if not SPEC.deferral_enabled() or agg._special:
+        is_final = agg.mode == "final"
+        if agg._special or (not is_final and not SPEC.deferral_enabled()):
             STATS["fallbacks"] += 1
             yield from self._fallback.execute(pid, tctx)
+            return
+        if self._skip_ex is not None:
+            yield from self._execute_skip(pid, tctx)
             return
         # peek one batch only — a many-batch child keeps streaming into
         # the fallback subtree's spillables, never pinned in a list here
         src = self.children[0].execute(pid, tctx)
         first = next(src, None)
         second = next(src, None) if first is not None else None
-        spec = _OUT_SPECULATION.get(agg._spec_key)
+        spec = None if is_final else _OUT_SPECULATION.get(agg._spec_key)
         single = (first is not None and second is None
                   and first.num_rows_bound > 0)
-        if not single or spec is None or spec > first.capacity:
+        if not single or (not is_final
+                          and (spec is None or spec > first.capacity)):
             from itertools import chain
             head = [b for b in (first, second) if b is not None]
             STATS["fallbacks"] += 1
             yield from self._run_fallback_on(chain(head, src), pid, tctx)
             return
-        batch = first
+        yield from self._fused_single(first, spec, pid, tctx)
+
+    def _execute_skip(self, pid, tctx):
+        """Sort-above-exchange shape.  The skipped range exchange only
+        redistributes rows for parallel sorting; when the final agg's
+        output all sits in one reduce partition (the AQE-coalesce common
+        case) a whole-batch sort gives the same global order, so the fused
+        single-program tail applies.  Otherwise run the original tree —
+        its exchanges are already materialized, so nothing recomputes."""
+        if pid > 0:
+            # decision was made at pid 0 (execute_all drives serially)
+            if self._decision == "fallback":
+                yield from self._fallback.execute(pid, tctx)
+            return
+        child = self.children[0]
+        src = child.execute(0, tctx)
+        first = next(src, None)
+        second = next(src, None) if first is not None else None
+        mat = getattr(child, "_materialized", None)
+        if mat is None:
+            others_live = True  # unknown layout: be conservative
+        else:
+            others_live = any(
+                b.num_rows_bound > 0
+                for t in range(1, child.num_partitions())
+                for b in (mat[t] or []))
+        single = (first is not None and second is None
+                  and first.num_rows_bound > 0)
+        is_final = self._agg.mode == "final"
+        spec = (None if is_final
+                else _OUT_SPECULATION.get(self._agg._spec_key))
+        if (not single or others_live
+                or (not is_final
+                    and (spec is None or spec > first.capacity))):
+            self._decision = "fallback"
+            STATS["fallbacks"] += 1
+            yield from self._fallback.execute(0, tctx)
+            return
+        self._decision = "fused"
+        yield from self._fused_single(first, spec, 0, tctx)
+
+    def _fused_single(self, batch, spec, pid, tctx):
+        from ...memory.oom_guard import guard_device_oom
+        from ...memory.retry import SplitAndRetryOOM
+        from ...columnar.convert import unpack_buffers
+        from . import speculation as SPEC
+        agg = self._agg
+        is_final = agg.mode == "final"
         pkey = self._tail_key(spec, batch.capacity)
         prog = _TAIL_PROGRAMS.get(pkey)
         if prog is None:
@@ -208,14 +287,15 @@ class FusedCollectExec(PhysicalPlan):
         host = [np.asarray(b) for b in bufs]
         leaves = unpack_buffers(host, sig)
         ng_host = int(leaves[-1])
-        # record/validate the speculation through the standard registry so
-        # the session's post-run validation and re-run loop apply
-        minimum = 64 if agg.grouping else 1
-        SPEC.register(spec, None,
-                      lambda ng, sk=agg._spec_key, m=minimum:
-                      record_speculation(sk, ng, m)).resolve(ng_host)
-        if ng_host > spec:
-            return  # wrong result discarded; session re-runs
+        if not is_final:
+            # record/validate the speculation through the standard registry
+            # so the session's post-run validation and re-run loop apply
+            minimum = 64 if agg.grouping else 1
+            SPEC.register(spec, None,
+                          lambda ng, sk=agg._spec_key, m=minimum:
+                          record_speculation(sk, ng, m)).resolve(ng_host)
+            if ng_host > spec:
+                return  # wrong result discarded; session re-runs
         STATS["fused_collects"] += 1
         tctx.inc_metric("fusedCollects")
         import jax
@@ -233,6 +313,10 @@ class FusedCollectExec(PhysicalPlan):
         agg2 = copy.copy(self._agg)
         agg2.children = (replay,)
         node: PhysicalPlan = agg2
+        if self._project is not None:
+            proj2 = copy.copy(self._project)
+            proj2.children = (node,)
+            node = proj2
         if self._topn is not None:
             topn2 = copy.copy(self._topn)
             topn2.children = (node,)
@@ -267,9 +351,11 @@ class FusedCollectExec(PhysicalPlan):
 
 
 def fuse_collect_tail(phys: PhysicalPlan) -> PhysicalPlan:
-    """Planner pass: replace ``DeviceToHost(Sort?(HashAggregate(complete)))``
-    or ``DeviceToHost(TakeOrderedAndProject(HashAggregate(complete)))``
-    (TPU backend throughout) with :class:`FusedCollectExec`."""
+    """Planner pass: replace ``DeviceToHost(Sort?(HashAggregate(complete |
+    final)))`` or ``DeviceToHost(TakeOrderedAndProject(HashAggregate(...)))``
+    (TPU backend throughout) with :class:`FusedCollectExec` — final mode is
+    the multi-partition shape (partial aggs + exchange below)."""
+    from .exchange import ShuffleExchangeExec
     from .sortlimit import TakeOrderedAndProjectExec
     if not isinstance(phys, DeviceToHostExec):
         return phys
@@ -284,13 +370,43 @@ def fuse_collect_tail(phys: PhysicalPlan) -> PhysicalPlan:
     elif isinstance(inner, SortExec) and inner.backend != CPU:
         sort = inner
         agg = inner.children[0]
-    if not isinstance(agg, HashAggregateExec):
+    from .basic import ProjectExec
+
+    def _agg_below(n):
+        """n, or its child past one device rename/compute Project (the
+        SQL front-end's `__agg_N AS name` layer), if a HashAggregateExec
+        sits there; else None.  Returns (project|None, agg)."""
+        if isinstance(n, HashAggregateExec):
+            return None, n
+        if (isinstance(n, ProjectExec) and n.backend != CPU
+                and isinstance(n.children[0], HashAggregateExec)):
+            return n, n.children[0]
+        return None, None
+
+    skip_ex = None
+    if (sort is not None and isinstance(agg, ShuffleExchangeExec)
+            and agg.backend != CPU
+            and _agg_below(agg.children[0])[1] is not None):
+        # orderBy plants Sort(RangeExchange(...)); the exchange only
+        # redistributes rows for parallel sorting, so the fused tail can
+        # look through it (skipped at runtime only when every live row
+        # sits in one reduce partition — _execute_skip)
+        skip_ex = agg
+        agg = agg.children[0]
+    proj, agg = _agg_below(agg)
+    if agg is None:
         return phys
-    if agg.backend == CPU or agg.mode != "complete" or agg._special:
+    if (agg.backend == CPU or agg.mode not in ("complete", "final")
+            or agg._special):
         return phys
-    if topn is not None and not _topn_fusable(topn):
+    if topn is not None and (not _topn_fusable(topn) or agg.mode == "final"):
+        # final-mode TopN must NOT fuse: TakeOrderedAndProjectExec merges
+        # all child partitions itself (num_partitions()==1), while the
+        # fused exec runs per exchange partition — each live partition
+        # would emit its own top-n (limit violated, order broken)
         return phys
-    return FusedCollectExec(agg, sort, phys, topn=topn)
+    return FusedCollectExec(agg, sort, phys, topn=topn,
+                            skip_exchange=skip_ex, project=proj)
 
 
 def _topn_fusable(t) -> bool:
