@@ -235,6 +235,25 @@ OPTIMIZER_TRANSITION_FIXED = register(
     "dwarfs per-row costs for small batches.  -1 (default) = auto: "
     "measure the sync round trip once per process and use that.", -1.0)
 
+BLOOM_JOIN_ENABLED = register(
+    "spark.rapids.sql.join.bloomFilter.enabled",
+    "Bloom-filter join runtime filters: the build side of a shuffled hash "
+    "join builds a bloom filter over its join keys and the probe side "
+    "drops non-members BELOW its exchange, shrinking both the shuffle and "
+    "the probe (reference GpuBloomFilterMightContain.scala, "
+    "shims/BloomFilterShims.scala spark330+).  Inner/left-semi joins only.",
+    True)
+BLOOM_JOIN_MAX_BUILD_ROWS = register(
+    "spark.rapids.sql.join.bloomFilter.maxBuildRows",
+    "Skip bloom construction when the build side exceeds this many rows "
+    "(the filter stores one device byte per bit position, i.e. "
+    "~bitsPerRow BYTES per build row after power-of-two rounding).",
+    4_000_000)
+BLOOM_JOIN_BITS_PER_ROW = register(
+    "spark.rapids.sql.join.bloomFilter.bitsPerRow",
+    "Bloom filter density; 8 bits/row with the derived hash count gives "
+    "a ~2% false-positive rate.", 8)
+
 # --- shuffle ---------------------------------------------------------------
 SHUFFLE_MODE = register(
     "spark.rapids.shuffle.mode",

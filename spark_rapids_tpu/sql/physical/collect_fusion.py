@@ -205,22 +205,32 @@ class FusedCollectExec(PhysicalPlan):
         if self._skip_ex is not None:
             yield from self._execute_skip(pid, tctx)
             return
-        # peek one batch only — a many-batch child keeps streaming into
-        # the fallback subtree's spillables, never pinned in a list here
-        src = self.children[0].execute(pid, tctx)
-        first = next(src, None)
-        second = next(src, None) if first is not None else None
-        spec = None if is_final else _OUT_SPECULATION.get(agg._spec_key)
-        single = (first is not None and second is None
-                  and first.num_rows_bound > 0)
-        if not single or (not is_final
-                          and (spec is None or spec > first.capacity)):
+        first, second, src, spec, fusable = self._peek_child(pid, tctx)
+        if not fusable:
             from itertools import chain
             head = [b for b in (first, second) if b is not None]
             STATS["fallbacks"] += 1
             yield from self._run_fallback_on(chain(head, src), pid, tctx)
             return
         yield from self._fused_single(first, spec, pid, tctx)
+
+    def _peek_child(self, pid, tctx):
+        """Peek ONE batch (a many-batch child keeps streaming into the
+        fallback subtree's spillables, never pinned in a list) and gate:
+        fusable = exactly one live batch AND (final mode, whose group
+        count is exact, OR a recorded speculation that fits the batch)."""
+        agg = self._agg
+        is_final = agg.mode == "final"
+        src = self.children[0].execute(pid, tctx)
+        first = next(src, None)
+        second = next(src, None) if first is not None else None
+        spec = None if is_final else _OUT_SPECULATION.get(agg._spec_key)
+        single = (first is not None and second is None
+                  and first.num_rows_bound > 0)
+        fusable = single and (is_final
+                              or (spec is not None
+                                  and spec <= first.capacity))
+        return first, second, src, spec, fusable
 
     def _execute_skip(self, pid, tctx):
         """Sort-above-exchange shape.  The skipped range exchange only
@@ -235,9 +245,7 @@ class FusedCollectExec(PhysicalPlan):
                 yield from self._fallback.execute(pid, tctx)
             return
         child = self.children[0]
-        src = child.execute(0, tctx)
-        first = next(src, None)
-        second = next(src, None) if first is not None else None
+        first, second, src, spec, fusable = self._peek_child(0, tctx)
         mat = getattr(child, "_materialized", None)
         if mat is None:
             others_live = True  # unknown layout: be conservative
@@ -246,14 +254,7 @@ class FusedCollectExec(PhysicalPlan):
                 b.num_rows_bound > 0
                 for t in range(1, child.num_partitions())
                 for b in (mat[t] or []))
-        single = (first is not None and second is None
-                  and first.num_rows_bound > 0)
-        is_final = self._agg.mode == "final"
-        spec = (None if is_final
-                else _OUT_SPECULATION.get(self._agg._spec_key))
-        if (not single or others_live
-                or (not is_final
-                    and (spec is None or spec > first.capacity))):
+        if not fusable or others_live:
             self._decision = "fallback"
             STATS["fallbacks"] += 1
             yield from self._fallback.execute(0, tctx)

@@ -43,6 +43,12 @@ class ShuffleExchangeExec(PhysicalPlan):
         self._coalescible = coalescible
         self._materialized: Optional[List[List[ColumnarBatch]]] = None
         self._split_fn = self._jit(self._split_one, key=("split",))
+        #: map-side runtime filter (bloom-filter join pushdown): applied to
+        #: each map partition's merged output BEFORE the split/write, so
+        #: dropped rows never ride the shuffle.  Installed by the join
+        #: after its build side materializes (ops/bloom.py; reference
+        #: GpuBloomFilterMightContain pushed below the exchange).
+        self.map_side_filter = None
 
     @property
     def output(self):
@@ -87,6 +93,10 @@ class ShuffleExchangeExec(PhysicalPlan):
                 got = list(child.execute(cpid, ctctx))
             map_out.append(ColumnarBatch.concat(got) if len(got) > 1
                            else (got[0] if got else None))
+
+        if self.map_side_filter is not None:
+            map_out = [self.map_side_filter(b) if b is not None else None
+                       for b in map_out]
 
         # AQE partition coalescing: a tiny total map output routes whole
         # to reduce partition 0 — equal keys stay co-located (trivially)

@@ -337,12 +337,97 @@ class BaseJoinExec(PhysicalPlan):
 class ShuffledHashJoinExec(BaseJoinExec):
     """Both sides co-partitioned by key hash (planner inserts the
     exchanges); per partition the build side is concatenated and each probe
-    batch is joined against it (reference ``GpuShuffledHashJoinExec``)."""
+    batch is joined against it (reference ``GpuShuffledHashJoinExec``).
+
+    Probe-filtering joins (inner/left-semi) additionally build a bloom
+    filter from the materialized build exchange and install it as the
+    probe exchange's map-side filter — the reference's AQE-gated
+    runtime-filter pushdown (``GpuBloomFilterMightContain.scala:1``),
+    re-shaped for this engine's eager exchange materialization: the build
+    exchange always materializes fully before the probe's map stage runs,
+    so the filter needs no separate aggregation plan."""
+
+    _bloom_tried = False
 
     def num_partitions(self):
         return self._probe.num_partitions()
 
+    def _maybe_install_bloom(self, tctx: TaskContext) -> None:
+        from ...config import (BLOOM_JOIN_BITS_PER_ROW, BLOOM_JOIN_ENABLED,
+                               BLOOM_JOIN_MAX_BUILD_ROWS)
+        from ...ops import bloom as B
+        from .basic import compact_batch
+        from .exchange import ShuffleExchangeExec
+        from .kernel_cache import exprs_key
+        from ..expressions.hashing import XxHash64
+        if self._bloom_tried:
+            return
+        self._bloom_tried = True
+        probe, build = self._probe, self._build
+        if (self._norm_how not in ("inner", "left_semi")
+                or self.backend != TPU
+                or not isinstance(probe, ShuffleExchangeExec)
+                or not isinstance(build, ShuffleExchangeExec)
+                or probe._materialized is not None
+                or probe.map_side_filter is not None
+                or not bool(tctx.conf.get(BLOOM_JOIN_ENABLED))):
+            return
+        # equal join-key values must hash identically on both sides; a
+        # dtype mismatch (missing analyzer cast) would make that false and
+        # a bloom false NEGATIVE drops matching rows — so require it
+        if any(p.data_type != b.data_type
+               for p, b in zip(self._bound_pkeys, self._bound_bkeys)):
+            return
+        build._ensure_materialized(tctx)
+        parts = [b for ps in build._materialized for b in ps
+                 if b is not None]
+        total = sum(b.num_rows_int for b in parts)
+        if total == 0 or total > int(tctx.conf.get(BLOOM_JOIN_MAX_BUILD_ROWS)):
+            return
+        xp = self.xp
+        bits_per_row = int(tctx.conf.get(BLOOM_JOIN_BITS_PER_ROW))
+        m, k = B.bloom_params(total, bits_per_row)
+        hb = XxHash64(*self._bound_bkeys)
+        hp = XxHash64(*self._bound_pkeys)
+
+        def build_step(bits, batch):
+            ctx = EvalContext(batch, xp=xp)
+            return B.bloom_build(xp, bits, hb.eval(ctx).data,
+                                 batch.row_mask(), k)
+
+        bkey = ("bloomb", m, k, exprs_key(self._bound_bkeys))
+        step = self._jit(build_step, key=bkey)
+        bits = xp.zeros(m, dtype=bool)
+        for b in parts:
+            bits = step(bits, b)
+
+        # bits is an ARGUMENT, not a closure: the kernel cache shares
+        # compiled programs by key across joins, so baking the bitset in
+        # as a trace constant would let a second join with the same key
+        # silently reuse the first join's filter
+        def probe_filter(bits_, batch):
+            ctx = EvalContext(batch, xp=xp)
+            keep = B.bloom_might_contain(xp, bits_, hp.eval(ctx).data, k) \
+                & batch.row_mask()
+            return compact_batch(xp, batch, keep)
+
+        fkey = ("bloomp", m, k, exprs_key(self._bound_pkeys))
+        filt = self._jit(probe_filter, key=fkey)
+
+        def map_filter(batch):
+            out = filt(bits, batch).shrunk()
+            B.STATS["probe_rows_in"] += batch.num_rows_int
+            B.STATS["probe_rows_kept"] += out.num_rows_int
+            tctx.inc_metric("bloomFilteredRows",
+                            batch.num_rows_int - out.num_rows_int)
+            return out
+
+        probe.map_side_filter = map_filter
+        B.STATS["blooms_built"] += 1
+        tctx.inc_metric("bloomFiltersBuilt")
+
     def execute(self, pid: int, tctx: TaskContext):
+        self._maybe_install_bloom(tctx)
         btctx = TaskContext(pid, tctx.conf, parent=tctx)
         with btctx.as_current():
             build_batches = list(self._build.execute(pid, btctx))

@@ -215,3 +215,90 @@ def test_full_join_nulls_both_sides(sess):
                                    sort_by=["lv", "rv"])
     # nulls never match: 3 unmatched left + 2 unmatched right + 0 matches
     assert len(out) == 5
+
+
+# ---------------------------------------------------------------------------
+# bloom-filter join runtime filters (GpuBloomFilterMightContain analog)
+# ---------------------------------------------------------------------------
+
+def _star_shapes(rng, n_fact=300_000, n_dim=400, key_space=80_000):
+    fact = pa.table({"fk": rng.integers(0, key_space, n_fact),
+                     "x": rng.random(n_fact)})
+    pks = rng.choice(key_space, size=n_dim, replace=False)
+    dim = pa.table({"pk": pks.astype(np.int64),
+                    "name": [f"d{i}" for i in range(n_dim)]})
+    return fact, dim
+
+
+def test_bloom_star_join_reduces_probe_rows():
+    """TPC-DS-shaped star join: a selective dimension must shrink the
+    fact-side shuffle via the map-side bloom filter, with results exactly
+    matching pandas (VERDICT r2 #4 done-criteria)."""
+    from spark_rapids_tpu.ops import bloom as B
+    rng = np.random.default_rng(11)
+    fact, dim = _star_shapes(rng)
+    sess = srt.session(**{"spark.rapids.sql.autoBroadcastJoinThreshold": -1})
+    f = sess.create_dataframe(fact, num_partitions=4)
+    d = sess.create_dataframe(dim, num_partitions=2)
+    built0 = B.STATS["blooms_built"]
+    in0, kept0 = B.STATS["probe_rows_in"], B.STATS["probe_rows_kept"]
+    got = f.join(d, f.fk == d.pk, "inner").collect().to_pandas()
+    exp = fact.to_pandas().merge(dim.to_pandas(), left_on="fk",
+                                 right_on="pk", how="inner")
+    assert len(got) == len(exp)
+    assert abs(got["x"].sum() - exp["x"].sum()) < 1e-6
+    assert B.STATS["blooms_built"] > built0
+    rows_in = B.STATS["probe_rows_in"] - in0
+    rows_kept = B.STATS["probe_rows_kept"] - kept0
+    assert rows_in >= 300_000
+    assert rows_kept < rows_in * 0.1, \
+        f"bloom kept {rows_kept}/{rows_in} — no real reduction"
+
+
+def test_bloom_left_semi_correct():
+    from spark_rapids_tpu.ops import bloom as B
+    rng = np.random.default_rng(12)
+    fact, dim = _star_shapes(rng, n_fact=100_000, n_dim=200)
+    sess = srt.session(**{"spark.rapids.sql.autoBroadcastJoinThreshold": -1})
+    f = sess.create_dataframe(fact, num_partitions=3)
+    d = sess.create_dataframe(dim, num_partitions=2)
+    built0 = B.STATS["blooms_built"]
+    got = f.join(d, f.fk == d.pk, "left_semi").collect().to_pandas()
+    exp = fact.to_pandas()[fact.to_pandas().fk.isin(dim.to_pandas().pk)]
+    assert len(got) == len(exp)
+    assert abs(got["x"].sum() - exp["x"].sum()) < 1e-6
+    assert B.STATS["blooms_built"] > built0
+
+
+def test_bloom_not_used_for_outer_joins():
+    """Left outer joins must emit unmatched probe rows — exactly the rows
+    the bloom filter would drop; it must not engage."""
+    from spark_rapids_tpu.ops import bloom as B
+    rng = np.random.default_rng(13)
+    fact, dim = _star_shapes(rng, n_fact=50_000, n_dim=100)
+    sess = srt.session(**{"spark.rapids.sql.autoBroadcastJoinThreshold": -1})
+    f = sess.create_dataframe(fact, num_partitions=3)
+    d = sess.create_dataframe(dim, num_partitions=2)
+    built0 = B.STATS["blooms_built"]
+    got = f.join(d, f.fk == d.pk, "left").collect().to_pandas()
+    assert B.STATS["blooms_built"] == built0
+    exp = fact.to_pandas().merge(dim.to_pandas(), left_on="fk",
+                                 right_on="pk", how="left")
+    assert len(got) == len(exp)
+
+
+def test_bloom_kill_switch():
+    from spark_rapids_tpu.ops import bloom as B
+    rng = np.random.default_rng(14)
+    fact, dim = _star_shapes(rng, n_fact=50_000, n_dim=100)
+    sess = srt.session(**{
+        "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.sql.join.bloomFilter.enabled": False})
+    f = sess.create_dataframe(fact, num_partitions=3)
+    d = sess.create_dataframe(dim, num_partitions=2)
+    built0 = B.STATS["blooms_built"]
+    got = f.join(d, f.fk == d.pk, "inner").collect()
+    assert B.STATS["blooms_built"] == built0
+    exp = fact.to_pandas().merge(dim.to_pandas(), left_on="fk",
+                                 right_on="pk", how="inner")
+    assert len(got) == len(exp)
