@@ -235,6 +235,20 @@ OPTIMIZER_TRANSITION_FIXED = register(
     "dwarfs per-row costs for small batches.  -1 (default) = auto: "
     "measure the sync round trip once per process and use that.", -1.0)
 
+APPROX_PERCENTILE_STRATEGY = register(
+    "spark.rapids.sql.approxPercentile.strategy",
+    "approx_percentile implementation: 'exact' = sorted ordinal selection "
+    "(Spark's exact-percentile rule; tighter than Spark's own sketch but "
+    "needs every group's rows co-resident), 'tdigest' = device t-digest "
+    "sketch (bounded O(groups x delta/2) state, interpolated results — "
+    "the reference's documented-incompat behavior, "
+    "GpuApproximatePercentile.scala), 'auto' = exact below "
+    "tdigestThresholdRows, t-digest above.", "auto")
+APPROX_PERCENTILE_TDIGEST_ROWS = register(
+    "spark.rapids.sql.approxPercentile.tdigestThresholdRows",
+    "In 'auto' mode, batches at or above this capacity digest via "
+    "t-digest instead of exact selection.", 1 << 18)
+
 BLOOM_JOIN_ENABLED = register(
     "spark.rapids.sql.join.bloomFilter.enabled",
     "Bloom-filter join runtime filters: the build side of a shuffled hash "

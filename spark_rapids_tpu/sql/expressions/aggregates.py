@@ -410,11 +410,25 @@ class CollectSet(CollectList):
     _distinct = True
 
 
+def _cast_back(xp, est_f64, dt):
+    """t-digest estimates are f64; Spark's approx_percentile returns the
+    input column's type, so integral inputs round back."""
+    if T.is_integral(dt):
+        return xp.round(est_f64).astype(dt.np_dtype)
+    return est_f64.astype(dt.np_dtype)
+
+
 class ApproximatePercentile(_ShuffleCompleteAggregate):
-    """approx_percentile(col, percentage[, accuracy]).  Implemented as
-    EXACT sorted selection (Spark's percentile ordinal rule); the
-    reference's t-digest is approximate and documented incompat, so exact
-    is a strictly tighter answer.  ``accuracy`` is accepted and ignored."""
+    """approx_percentile(col, percentage[, accuracy]).
+
+    Two device strategies (conf ``spark.rapids.sql.approxPercentile.
+    strategy``): EXACT sorted selection (Spark's percentile ordinal
+    rule — a strictly tighter answer than Spark's own sketch) and the
+    t-digest sketch (``ops/tdigest.py``) whose per-group state is a
+    fixed [delta/2] centroid layout — the reference's implementation
+    (``GpuApproximatePercentile.scala:1-222``, documented incompat:
+    interpolated values, not ordinals).  'auto' digests large batches
+    and keeps small ones exact."""
 
     def __init__(self, child: Expression, percentage, accuracy=10000):
         self.children = (child,)
@@ -428,15 +442,21 @@ class ApproximatePercentile(_ShuffleCompleteAggregate):
             if not (0.0 <= p <= 1.0):
                 raise ValueError(f"percentage {p} not in [0, 1]")
         self.accuracy = int(accuracy)
+        self._strategy = "auto"
+        self._tdigest_rows = 1 << 18
 
     def with_children(self, children):
         out = type(self)(children[0],
                          self.percentages if not self._scalar
                          else self.percentages[0], self.accuracy)
+        # binding copies must keep the tag-time strategy decision
+        out._strategy = self._strategy
+        out._tdigest_rows = self._tdigest_rows
         return out
 
     def _key_extras(self):
-        return (tuple(self.percentages), self._scalar)
+        return (tuple(self.percentages), self._scalar, self._strategy,
+                self._tdigest_rows, self.accuracy)
 
     @property
     def data_type(self):
@@ -450,17 +470,42 @@ class ApproximatePercentile(_ShuffleCompleteAggregate):
         dt = self.children[0].data_type
         if not T.is_numeric(dt):
             return "approx_percentile requires a numeric column"
+        if conf is not None:
+            from ...config import (APPROX_PERCENTILE_STRATEGY,
+                                   APPROX_PERCENTILE_TDIGEST_ROWS)
+            self._strategy = str(conf.get(APPROX_PERCENTILE_STRATEGY))
+            self._tdigest_rows = int(conf.get(APPROX_PERCENTILE_TDIGEST_ROWS))
         return None
 
     def pretty_name(self):
         return "approx_percentile"
 
+    def use_tdigest(self, capacity: int) -> bool:
+        if self._strategy == "exact":
+            return False
+        if self._strategy == "tdigest":
+            return True
+        return capacity >= self._tdigest_rows
+
+    def _dtype_sketchable(self) -> bool:
+        dt = self.children[0].data_type
+        return T.is_integral(dt) or T.is_floating(dt)
+
     def compute_grouped(self, ctx, in_col, rank, OUT: int, W: int,
                         row_mask, group_ok):
-        from ...ops.collect_ops import grouped_percentiles
         xp = ctx.xp
-        cols, counts = grouped_percentiles(xp, in_col, rank, row_mask, OUT,
-                                           self.percentages, group_ok)
+        if self.use_tdigest(int(rank.shape[0])) and self._dtype_sketchable():
+            cols, counts = self._tdigest_percentiles(
+                xp, in_col, rank, row_mask, OUT, group_ok)
+        else:
+            from ...ops.collect_ops import grouped_percentiles
+            cols, counts = grouped_percentiles(xp, in_col, rank, row_mask,
+                                               OUT, self.percentages,
+                                               group_ok)
+        return self.assemble_output(xp, cols, counts, group_ok)
+
+    def assemble_output(self, xp, cols, counts, group_ok):
+        """Final column(s) -> scalar or array<..> output column."""
         if self._scalar:
             return cols[0]
         from ...columnar.column import make_array_column
@@ -474,6 +519,49 @@ class ApproximatePercentile(_ShuffleCompleteAggregate):
         lengths = xp.where(counts > 0, w, 0).astype(xp.int32)
         return make_array_column(T.ArrayType(elem0.dtype), lengths, (elem,),
                                  group_ok & (counts > 0))
+
+    def _tdigest_percentiles(self, xp, in_col, rank, row_mask, OUT,
+                             group_ok):
+        """(per-p DeviceColumns, counts) via the t-digest sketch."""
+        from ...ops import tdigest as TD
+        delta = TD.delta_for_accuracy(self.accuracy)
+        n = int(rank.shape[0])
+        valid = (in_col.validity if in_col.validity is not None
+                 else xp.ones(n, dtype=bool))
+        means, wts, vmin, vmax, total = TD.build_grouped(
+            xp, in_col.data, xp.ones(n, dtype=xp.float64), valid,
+            rank, row_mask, OUT, delta)
+        return self._finish_tdigest(xp, means, wts, vmin, vmax, total,
+                                    group_ok)
+
+    def tdigest_from_weighted(self, xp, values, weights, lo, hi, rank,
+                              row_mask, OUT: int, delta: int, group_ok):
+        """Merge pre-digested centroids (weighted rows carrying their
+        source digests' true min/max) into a fresh digest and query it.
+        Returns (per-p DeviceColumns, counts)."""
+        from ...ops import tdigest as TD
+        n = int(rank.shape[0])
+        live = row_mask & (weights > 0)
+        means, wts, _vm, _vx, total = TD.build_grouped(
+            xp, values, weights, xp.ones(n, dtype=bool), rank, live,
+            OUT, delta)
+        g = xp.where(live, rank.astype(xp.int64), OUT)
+        vmin = TD._scatter_get(xp, xp.where(live, lo, xp.inf), g, OUT, "min")
+        vmax = TD._scatter_get(xp, xp.where(live, hi, -xp.inf), g, OUT,
+                               "max")
+        return self._finish_tdigest(xp, means, wts, vmin, vmax, total,
+                                    group_ok)
+
+    def _finish_tdigest(self, xp, means, wts, vmin, vmax, total, group_ok):
+        from ...ops import tdigest as TD
+        ests = TD.percentiles_grouped(xp, means, wts, vmin, vmax, total,
+                                      self.percentages)
+        counts = xp.round(total).astype(xp.int64)
+        ok = group_ok & (counts > 0)
+        out_dt = self.children[0].data_type
+        cols = [DeviceColumn(out_dt, _cast_back(xp, est, out_dt), ok)
+                for est in ests]
+        return cols, counts
 
 
 class PreMergedAggregate(AggregateFunction):

@@ -884,6 +884,146 @@ class HashAggregateExec(PhysicalPlan):
             return ColumnarBatch(tuple(names), tuple(cols), n)
         return impl
 
+    def _try_special_tdigest(self, batches, tctx):
+        """Digest-per-batch + centroid-merge execution for percentile-only
+        special aggregates.  Returns the output batch, or None when the
+        shape doesn't qualify (mixed aggregates, non-sketchable dtypes,
+        strategy says exact)."""
+        from ...columnar.column import bucket_capacity
+        from ...ops import tdigest as TD
+        from ..expressions.aggregates import ApproximatePercentile
+        funcs = self._agg_funcs
+        if set(self._special) != set(range(len(funcs))):
+            return None
+        if not all(isinstance(f, ApproximatePercentile) for f in funcs):
+            return None
+        total_cap = sum(b.capacity for b in batches)
+        if not all(f.use_tdigest(total_cap) and f._dtype_sketchable()
+                   for f in funcs):
+            return None
+        xp = self.xp
+        delta = max(TD.delta_for_accuracy(f.accuracy) for f in funcs)
+        C = TD.n_centroids(delta)
+        nf = len(funcs)
+        nk = len(self._bound_grouping)
+        key_names = tuple(f"__k{i}" for i in range(nk))
+        st_names = ("__anchor",) + tuple(f"__{t}{fi}" for fi in range(nf)
+                                         for t in ("v", "w", "lo", "hi"))
+
+        def digest_kernel(OUT):
+            def impl(batch2, mask, rank64, ng):
+                ctx = EvalContext(batch2, xp=xp)
+                keys = [g.eval(ctx) for g in self._bound_grouping]
+                gk, _gs, n = groupby_reduce(xp, keys, [], [], mask,
+                                            rank64=rank64, n_groups=ng,
+                                            out_size=OUT)
+                group_ok = xp.arange(OUT, dtype=xp.int32) < n
+                rank = rank64.astype(xp.int32)
+                cap = int(rank.shape[0])
+                slot = xp.arange(OUT * C, dtype=xp.int32)
+                gidx = slot // np.int32(C)
+                ok_row = group_ok[gidx]
+                cols = [k.gather(gidx, ok_row) for k in gk]
+                # anchor: one guaranteed-live row per live group, so a
+                # group whose percentile inputs are ALL NULL (every
+                # weight 0) still reaches the merge grouping and emits
+                # its (key, NULL) output row like the exact path does
+                anchor = (slot % np.int32(C) == 0) & ok_row
+                cols.append(DeviceColumn(T.BOOLEAN, anchor,
+                                         xp.ones(OUT * C, dtype=bool)))
+                for fi, f in enumerate(funcs):
+                    in_col = self._bound_inputs[fi][0].eval(ctx)
+                    valid = (in_col.validity if in_col.validity is not None
+                             else xp.ones(cap, dtype=bool))
+                    means, wts, vmin, vmax, _tot = TD.build_grouped(
+                        xp, in_col.data, xp.ones(cap, dtype=xp.float64),
+                        valid, rank, mask, OUT, delta)
+                    w = xp.where(ok_row, wts.reshape(-1), 0.0)
+                    live = w > 0
+                    for arr in (means.reshape(-1), w,
+                                vmin[gidx], vmax[gidx]):
+                        cols.append(DeviceColumn(T.DOUBLE,
+                                                 arr.astype(xp.float64),
+                                                 live))
+                return ColumnarBatch(
+                    key_names + st_names, tuple(cols),
+                    xp.asarray(OUT * C, dtype=xp.int32))
+            return impl
+
+        pseudo = []
+        total_groups = 0
+        for b in batches:
+            batch2, mask, rank64, ng = self._group_fn(b)
+            ng0 = int(ng)
+            total_groups += max(ng0, 1)
+            OUT = min(bucket_capacity(max(ng0, 1),
+                                      minimum=64 if self.grouping else 1),
+                      batch2.capacity)
+            key = ("tdigest-batch", OUT, C, self._partial_key,
+                   tuple(f._key_extras() for f in funcs))
+            fn = self._jit(digest_kernel(OUT), key=key)
+            pseudo.append(fn(batch2, mask, rank64, ng))
+        big = ColumnarBatch.concat(pseudo)
+        # merge: total distinct groups is bounded by the per-batch sum
+        OUTM = min(bucket_capacity(max(total_groups, 1),
+                                   minimum=64 if self.grouping else 1),
+                   big.capacity)
+
+        def merge_kernel(bigb):
+            mask = bigb.row_mask()
+            kcols = [bigb.column(nm) for nm in key_names]
+            any_w = bigb.column("__anchor").data
+            for fi in range(nf):
+                w = bigb.column(f"__w{fi}").data
+                any_w = any_w | (w > 0)
+            live = mask & any_w
+            rank64m, ngm = group_phase(xp, kcols, live,
+                                       expected_groups=OUTM)
+            gk, _gs, n = groupby_reduce(xp, kcols, [], [], live,
+                                        rank64=rank64m, n_groups=ngm,
+                                        out_size=OUTM)
+            group_ok = xp.arange(OUTM, dtype=xp.int32) < n
+            rank = rank64m.astype(xp.int32)
+            results = {}
+            for fi, f in enumerate(funcs):
+                cols_f, counts = f.tdigest_from_weighted(
+                    xp, bigb.column(f"__v{fi}").data,
+                    xp.where(bigb.column(f"__w{fi}").validity,
+                             bigb.column(f"__w{fi}").data, 0.0),
+                    bigb.column(f"__lo{fi}").data,
+                    bigb.column(f"__hi{fi}").data,
+                    rank, live, OUTM, delta, group_ok)
+                results[fi] = f.assemble_output(xp, cols_f, counts,
+                                                group_ok)
+            post_ctx = None
+            if self._post_exprs:
+                synth = ColumnarBatch(
+                    tuple(f"__fin{i}" for i in range(len(gk) + nf)),
+                    tuple(gk) + tuple(results[fi] for fi in range(nf)), n)
+                post_ctx = EvalContext(synth, xp=xp)
+            cols, names = [], []
+            for kind, idx, name in self._out_spec:
+                names.append(name)
+                if kind == "group":
+                    cols.append(gk[idx])
+                elif kind == "expr":
+                    cols.append(self._post_exprs[idx].eval(post_ctx))
+                else:
+                    cols.append(results[idx])
+            return ColumnarBatch(tuple(names), tuple(cols), n), ngm
+
+        mkey = ("tdigest-merge", OUTM, C, big.capacity,
+                self._finalize_key,
+                tuple(f._key_extras() for f in funcs))
+        out, ngm = self._jit(merge_kernel, key=mkey)(big)
+        if int(ngm) > OUTM:
+            # the bounded group probe gave up (pathologically clustered
+            # keys) and inflated the count — same overflow signal the
+            # speculation layer validates; discard and let the caller run
+            # the exact concat path
+            return None
+        return out.with_known_rows(int(out.num_rows))
+
     def _execute_special(self, pid: int, tctx: TaskContext):
         from ...columnar.column import bucket_capacity, bucket_width
         child = self.children[0]
@@ -899,6 +1039,17 @@ class HashAggregateExec(PhysicalPlan):
             # scalar slots so _empty_output's path would raise
             from .exchange import empty_batch_for
             batches = [empty_batch_for(child.output)]
+        if self.backend == TPU and len(batches) > 1:
+            # percentile-only aggregates over many batches: digest each
+            # batch into fixed [groups, C] centroid state and merge the
+            # digests — the concat of raw rows (the memory cliff of the
+            # shuffle-complete path) never happens (ops/tdigest.py;
+            # reference GpuApproximatePercentile merge path)
+            out = self._try_special_tdigest(batches, tctx)
+            if out is not None:
+                tctx.inc_metric("aggTdigestMergedBatches", len(batches))
+                yield out
+                return
         merged = ColumnarBatch.concat(batches) if len(batches) > 1 \
             else batches[0]
         tctx.inc_metric("aggSpecialBatches")
