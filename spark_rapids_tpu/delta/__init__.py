@@ -104,23 +104,33 @@ class DeltaTable:
     # --- read side ----------------------------------------------------------
     def toDF(self, version: Optional[int] = None):
         snap = self.log.snapshot(version)
+        adds = [snap.files[p] for p in snap.file_paths]
         paths = [os.path.join(self.path, p) for p in snap.file_paths]
         if not paths:
             empty = snap.schema.empty_arrow_table() if hasattr(
                 snap.schema, "empty_arrow_table") else self._empty(snap)
             return self._session.create_dataframe(empty)
-        # schema evolution: files written before a mergeSchema append lack
-        # the new columns — align them with nulls (real Delta fills
-        # missing columns at read); same-schema tables take the scan path
+        # Per-file alignment is needed in two interop cases: schema
+        # evolution (older files lack newer columns -> nulls), and real
+        # Delta partitioned tables, whose partition column VALUES live in
+        # add.partitionValues rather than in the data files (protocol
+        # spec; readers re-inject them as constants).
         want = self._empty(snap).schema
-        if any(pq.read_schema(p).names != want.names for p in paths):
+        has_pv = any(a.partition_values for a in adds)
+        if has_pv or any(pq.read_schema(p).names != want.names
+                         for p in paths):
             pieces = []
-            for p in paths:
+            for p, a in zip(paths, adds):
                 t = pq.read_table(p)
+                pv = a.partition_values or {}
                 arrays = []
                 for f in want:
                     if f.name in t.column_names:
                         arrays.append(t.column(f.name).cast(f.type))
+                    elif f.name in pv and pv[f.name] is not None:
+                        const = pa.array([pv[f.name]] * t.num_rows,
+                                         type=pa.string()).cast(f.type)
+                        arrays.append(const)
                     else:
                         arrays.append(pa.nulls(t.num_rows, f.type))
                 pieces.append(pa.table(dict(zip(want.names, arrays))))
@@ -314,8 +324,29 @@ class DeltaTable:
         return out
 
     # --- DML ----------------------------------------------------------------
-    def _file_df(self, rel_path: str):
-        return self._session.read.parquet(os.path.join(self.path, rel_path))
+    def _file_df(self, rel_path: str, snap: Optional[Snapshot] = None):
+        """One file as a DataFrame.  For foreign partitioned tables the
+        partition columns live in add.partitionValues, not the data file —
+        inject them so DML rewrites carry the values forward (the rewritten
+        file then stores the column physically, the engine-native form)."""
+        full = os.path.join(self.path, rel_path)
+        add = snap.files.get(rel_path) if snap is not None else None
+        pv = add.partition_values if add is not None else None
+        if not pv:
+            return self._session.read.parquet(full)
+        t = pq.read_table(full)
+        want = self._empty(snap).schema
+        arrays = []
+        for f in want:
+            if f.name in t.column_names:
+                arrays.append(t.column(f.name).cast(f.type))
+            elif f.name in pv and pv[f.name] is not None:
+                arrays.append(pa.array([pv[f.name]] * t.num_rows,
+                                       type=pa.string()).cast(f.type))
+            else:
+                arrays.append(pa.nulls(t.num_rows, f.type))
+        return self._session.create_dataframe(
+            pa.table(dict(zip(want.names, arrays))))
 
     def delete(self, condition=None) -> int:
         """DELETE FROM t WHERE condition; returns #rows deleted
@@ -329,7 +360,7 @@ class DeltaTable:
             cond0 = condition(dummy) if callable(condition) else condition
             candidates = self._files_matching(snap, cond0)
         for rel in candidates:
-            df = self._file_df(rel)
+            df = self._file_df(rel, snap)
             if condition is None:
                 deleted += df.count()
                 actions.append(remove_action(rel))
@@ -360,7 +391,7 @@ class DeltaTable:
         dummy = self._session.create_dataframe(self._empty(snap))
         cond0 = condition(dummy) if callable(condition) else condition
         for rel in self._files_matching(snap, cond0):
-            df = self._file_df(rel)
+            df = self._file_df(rel, snap)
             cond = condition(df) if callable(condition) else condition
             hits = df.filter(cond).count()
             if hits == 0:
@@ -480,7 +511,7 @@ class MergeBuilder:
         key_sets = set(key_rows)
 
         for rel in snap.file_paths:
-            df = t._file_df(rel)
+            df = t._file_df(rel, snap)
             tkeys = df.select(*keys).collect()
             rows = list(map(tuple, zip(*[tkeys[k].to_pylist()
                                          for k in keys]))) if \

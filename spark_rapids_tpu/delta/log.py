@@ -47,6 +47,50 @@ class AddFile:
     #: per-file column statistics for data skipping (real Delta's `stats`
     #: JSON: numRecords / minValues / maxValues / nullCount)
     stats: Optional[dict] = None
+    #: real Delta stores partition column VALUES per file (string-encoded)
+    #: rather than writing the columns into the data files; readers
+    #: re-inject them (`add.partitionValues` in the protocol spec)
+    partition_values: Optional[Dict[str, Optional[str]]] = None
+
+
+#: Spark-JSON-schema primitive names -> engine types (real Delta metaData
+#: carries `schemaString`, a JSON-serialized Spark StructType)
+_SPARK_PRIMITIVES = {
+    "long": T.LONG, "integer": T.INT, "short": T.SHORT, "byte": T.BYTE,
+    "double": T.DOUBLE, "float": T.FLOAT, "string": T.STRING,
+    "boolean": T.BOOLEAN, "binary": T.BINARY, "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+}
+
+
+def _spark_json_type(t):
+    if isinstance(t, str):
+        if t in _SPARK_PRIMITIVES:
+            return _SPARK_PRIMITIVES[t]
+        if t.startswith("decimal("):
+            p, s = t[8:-1].split(",")
+            return T.DecimalType(int(p), int(s))
+        raise ValueError(f"unsupported Spark schema type {t!r}")
+    kind = t.get("type")
+    if kind == "struct":
+        return T.StructType(tuple(
+            T.StructField(f["name"], _spark_json_type(f["type"]),
+                          bool(f.get("nullable", True)))
+            for f in t["fields"]))
+    if kind == "array":
+        return T.ArrayType(_spark_json_type(t["elementType"]))
+    if kind == "map":
+        return T.MapType(_spark_json_type(t["keyType"]),
+                         _spark_json_type(t["valueType"]))
+    raise ValueError(f"unsupported Spark schema type {t!r}")
+
+
+def schema_from_spark_json(schema_string: str) -> T.StructType:
+    """Parse real Delta's ``schemaString`` (JSON-serialized Spark
+    StructType) into the engine's schema model — the interop entry point
+    for tables written by Spark/delta-rs (Delta protocol spec §Change
+    Metadata; reference delta-lake/ readers consume the same shape)."""
+    return _spark_json_type(json.loads(schema_string))
 
 
 @dataclass
@@ -129,6 +173,8 @@ class DeltaLog:
             a = add_action(f.path, f.size, f.num_records, f.data_change,
                            stats=f.stats)
             a["add"]["modificationTime"] = f.modification_time
+            if f.partition_values is not None:
+                a["add"]["partitionValues"] = f.partition_values
             actions.append(a)
         tbl = pa.table({"action": pa.array([json.dumps(a) for a in actions],
                                            type=pa.string())})
@@ -140,10 +186,17 @@ class DeltaLog:
         return snap.version
 
     def _read_checkpoint(self, v: int) -> Optional[List[dict]]:
+        """None -> caller replays the JSON log from version 0 instead.
+        Spark-written checkpoints use a columnar layout (one column per
+        action type) this engine does not parse; they are detected and
+        skipped, which is correct as long as the JSON log has not been
+        cleaned up past the checkpoint."""
         import pyarrow.parquet as pq
         try:
             tbl = pq.read_table(self._checkpoint_file(v))
         except OSError:
+            return None
+        if "action" not in tbl.column_names:  # foreign checkpoint layout
             return None
         return [json.loads(s) for s in tbl.column("action").to_pylist()]
 
@@ -174,9 +227,21 @@ class DeltaLog:
             nonlocal schema, part_cols, configuration
             if "metaData" in action:
                 md = action["metaData"]
-                schema = _spec_to_schema(md["schema"])
+                if "schema" in md:          # engine-native spec form
+                    schema = _spec_to_schema(md["schema"])
+                else:                       # real Delta: schemaString
+                    schema = schema_from_spark_json(md["schemaString"])
                 part_cols = tuple(md.get("partitionColumns", ()))
                 configuration = dict(md.get("configuration", {}))
+            elif "protocol" in action:
+                # real Delta tables declare reader requirements; features
+                # past the base protocol (deletion vectors, column
+                # mapping) need reader support this engine doesn't have
+                mrv = int(action["protocol"].get("minReaderVersion", 1))
+                if mrv > 1:
+                    raise ValueError(
+                        f"unsupported Delta protocol: minReaderVersion="
+                        f"{mrv} (this reader implements version 1)")
             elif "add" in action:
                 a = action["add"]
                 stats = a.get("stats")
@@ -185,12 +250,14 @@ class DeltaLog:
                         stats = json.loads(stats)
                     except ValueError:
                         stats = None
+                num = a.get("numRecords")   # engine-native extension
+                if num is None:
+                    num = (stats or {}).get("numRecords", -1)
                 files[a["path"]] = AddFile(
-                    a["path"], a.get("size", 0),
-                    a.get("numRecords", -1),
+                    a["path"], a.get("size", 0), num,
                     a.get("dataChange", True),
                     a.get("modificationTime", 0),
-                    stats)
+                    stats, a.get("partitionValues") or None)
             elif "remove" in action:
                 files.pop(action["remove"]["path"], None)
 

@@ -296,9 +296,69 @@ def write_manifest(table_root: str, entries: Sequence[ManifestEntry]) -> str:
     return rel
 
 
+def normalize_data_path(p: str, table_root: str) -> str:
+    """Real Iceberg metadata stores full location URIs; the engine keys
+    files by table-relative paths.  Strip the scheme, relativize under
+    the root, and fall back to the conventional ``data/`` suffix for
+    tables that moved since they were written."""
+    if p.startswith("file:"):
+        # both URI forms appear in the wild: file:///abs (RFC) and
+        # file:/abs (Hadoop Path.toString())
+        p = "/" + p[len("file:"):].lstrip("/")
+    root = os.path.abspath(table_root)
+    if os.path.isabs(p):
+        ap = os.path.abspath(p)
+        if ap.startswith(root + os.sep):
+            return os.path.relpath(ap, root)
+        # moved table: fall back to the conventional directory suffix —
+        # the LAST occurrence, since the original root may itself contain
+        # a /data/ or /metadata/ segment
+        i = p.rfind("/data/")
+        if i >= 0:
+            return p[i + 1:]
+        i = p.rfind("/metadata/")
+        if i >= 0:
+            return p[i + 1:]
+    return p
+
+
+def _read_real_manifest(tab, table_root: str) -> List[ManifestEntry]:
+    """Manifest in the REAL Iceberg v2 avro layout: nested
+    ``manifest_entry{status, snapshot_id, data_file: r2{content,
+    file_path, file_format, partition, record_count,
+    file_size_in_bytes, ...}}`` records (Iceberg spec — Manifests;
+    reference iceberg/SparkBatchQueryScan reads the same).  Binary
+    single-value bounds are not decoded (no file skipping for foreign
+    manifests — correct, just unpruned)."""
+    out = []
+    for i in range(tab.num_rows):
+        status = tab["status"][i].as_py()
+        sid = tab["snapshot_id"][i].as_py() if "snapshot_id" in \
+            tab.column_names else None
+        d = tab["data_file"][i].as_py() or {}
+        part = d.get("partition")
+        if isinstance(part, dict):
+            partition = tuple(part.values())
+        else:
+            partition = ()
+        out.append(ManifestEntry(
+            int(status or 0), int(sid or 0),
+            DataFile(
+                file_path=normalize_data_path(d["file_path"], table_root),
+                content=int(d.get("content") or 0),
+                record_count=int(d.get("record_count") or 0),
+                file_size=int(d.get("file_size_in_bytes") or 0),
+                spec_id=int(d.get("spec_id") or 0),
+                partition=partition)))
+    return out
+
+
 def read_manifest(table_root: str, rel_path: str) -> List[ManifestEntry]:
     from ..io_.avro_reader import read_avro
-    tab = read_avro(os.path.join(table_root, rel_path))
+    tab = read_avro(os.path.join(table_root,
+                                 normalize_data_path(rel_path, table_root)))
+    if "data_file" in tab.column_names:  # real Iceberg nested layout
+        return _read_real_manifest(tab, table_root)
     out = []
     for i in range(tab.num_rows):
         row = {c: tab[c][i].as_py() for c in _MANIFEST_COLS}
@@ -327,9 +387,13 @@ def write_manifest_list(table_root: str, snapshot_id: int,
 
 
 def read_manifest_list(table_root: str, rel_path: str) -> List[str]:
+    """Works for both layouts: the engine's flat list and real Iceberg's
+    ``manifest_file`` records — both carry a ``manifest_path`` field."""
     from ..io_.avro_reader import read_avro
-    tab = read_avro(os.path.join(table_root, rel_path))
-    return [v.as_py() for v in tab["manifest_path"]]
+    tab = read_avro(os.path.join(table_root,
+                                 normalize_data_path(rel_path, table_root)))
+    return [normalize_data_path(v.as_py(), table_root)
+            for v in tab["manifest_path"]]
 
 
 # ---------------------------------------------------------------------------

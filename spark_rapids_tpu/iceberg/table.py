@@ -340,12 +340,15 @@ class IcebergTable:
     def _delete_position_map(self, snap: IceSnapshot) -> Dict[str, set]:
         """All position deletes for the snapshot, read ONCE per scan:
         {data_file_path: {deleted row positions}}."""
+        from .metadata import normalize_data_path
         out: Dict[str, set] = {}
         for df in self._delete_files(snap):
             tab = pq.read_table(os.path.join(self.path, df.file_path))
             for fp, p in zip(tab["file_path"].to_pylist(),
                              tab["pos"].to_pylist()):
-                out.setdefault(fp, set()).add(int(p))
+                # real delete files reference data files by full URI
+                out.setdefault(normalize_data_path(fp, self.path),
+                               set()).add(int(p))
         return out
 
     def _prune_files(self, files: List[DataFile],
@@ -401,6 +404,13 @@ class IcebergTable:
             meta = af.metadata or {}
             if _FIELD_ID_KEY in meta:
                 file_ids[int(meta[_FIELD_ID_KEY])] = af.name
+        if not file_ids:
+            # file carries no field ids (imported data): fall back to
+            # name mapping, which is exactly Iceberg's
+            # `schema.name-mapping.default` behavior for such files
+            names = set(ptab.schema.names)
+            file_ids = {f.field_id: f.name for f in schema.fields
+                        if f.name in names}
         arrays, fields = [], []
         n = ptab.num_rows
         for f in schema.fields:
