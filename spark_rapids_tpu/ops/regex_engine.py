@@ -84,10 +84,14 @@ _MAX_DFA_STATES = 256
 
 
 class _Parser:
-    def __init__(self, pattern: str):
+    def __init__(self, pattern: str, allow_lazy: bool = False):
         self.p = pattern
         self.i = 0
         self.ngroups = 0
+        #: membership-only callers (RLike) may treat lazy quantifiers as
+        #: greedy: laziness changes WHICH span matches, never WHETHER one
+        #: exists.  Span-consuming callers must keep rejecting them.
+        self.allow_lazy = allow_lazy
 
     def error(self, msg):
         raise RegexUnsupported(f"{msg} at {self.i} in {self.p!r}")
@@ -141,8 +145,18 @@ class _Parser:
                 return atom
             nxt = self.peek()
             if nxt in ("?", "+") and isinstance(atom, RRep):
-                # lazy / possessive quantifier: changes which match Java
-                # picks; a DFA cannot honor it
+                if nxt == "?" and self.allow_lazy:
+                    # membership-equivalent to greedy; drop the marker —
+                    # but a further quantifier on 'a*?' is Java's
+                    # "quantifier follows quantifier" error, not ours to
+                    # accept
+                    self.next()
+                    if self.peek() in ("*", "+", "?", "{"):
+                        self.error("quantifier after lazy quantifier")
+                    return atom
+                # lazy quantifier (extent callers) / possessive (always —
+                # it can REJECT strings the greedy form accepts): changes
+                # the Java result; a DFA cannot honor it
                 self.error(f"lazy/possessive quantifier '{nxt}'")
 
     def counted(self, atom):
@@ -151,12 +165,19 @@ class _Parser:
             self.error("unterminated {")
         body = self.p[self.i + 1:j]
         self.i = j + 1
+        def _digits(s):
+            # plain ASCII digits ONLY — int() also accepts '+2', ' 2',
+            # '1_0', all of which Java rejects as Illegal repetition
+            if not (s and s.isascii() and s.isdigit()):
+                self.error(f"malformed repetition {{{body}}}")
+            return int(s)
+
         if "," in body:
             lo_s, hi_s = body.split(",", 1)
-            lo = int(lo_s) if lo_s else 0
-            hi = int(hi_s) if hi_s else None
+            lo = _digits(lo_s) if lo_s else 0
+            hi = _digits(hi_s) if hi_s else None
         else:
-            lo = hi = int(body)
+            lo = hi = _digits(body)
         if lo < 0 or (hi is not None and hi < lo):
             # Java treats malformed counted braces as literal text
             self.error(f"malformed repetition {{{body}}}")
@@ -206,7 +227,16 @@ class _Parser:
                   "W": _ALL - _WORD, "s": _SPACE, "S": _ALL - _SPACE}
         if ch in simple:
             return RClass(frozenset(simple[ch]))
-        if ch in "bBAzZG":
+        if ch == "A":
+            # \A = start of input — exactly this engine's (non-multiline) ^
+            return RAnchor("^")
+        if ch in "zZ":
+            # \z = end of input = this engine's $ (strict end).  \Z (Java:
+            # before a final line terminator) is mapped the same way,
+            # matching how the engine already treats $ — the only
+            # divergence is inputs with a trailing line terminator.
+            return RAnchor("$")
+        if ch in "bBG":
             self.error(f"anchor \\{ch}")
         if ch.isdigit():
             self.error("backreference")
@@ -217,6 +247,11 @@ class _Parser:
         if ch == "x":
             h = self.p[self.i:self.i + 2]
             self.i += 2
+            if not (len(h) == 2
+                    and all(c in "0123456789abcdefABCDEF" for c in h)):
+                # exactly two hex digits, like Java; int() leniency
+                # ('+5', ' 5') would silently match bytes Java rejects
+                self.error(f"malformed hex escape \\x{h}")
             return RLit(int(h, 16))
         if ch in "pP":
             self.error("unicode property class")
@@ -486,7 +521,7 @@ def compile_regex(pattern: str, search_prefix: bool = False,
     match could have a different extent than Java's leftmost-first, so
     those expressions fall back to the host engine instead of silently
     diverging from Spark results."""
-    parser = _Parser(pattern)
+    parser = _Parser(pattern, allow_lazy=not extent_exact)
     ast = parser.parse()
     ast, anc_s, anc_e = _strip_anchors(ast)
     if extent_exact and not _extent_safe(ast):

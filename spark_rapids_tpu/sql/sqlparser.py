@@ -40,7 +40,7 @@ from .plan import SortOrder
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+|--[^\n]*)
   | (?P<num>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?[dDlLfF]?)
-  | (?P<str>'(?:[^']|'')*')
+  | (?P<str>'(?:[^'\\]|\\[\s\S]|'')*')
   | (?P<qident>`[^`]*`|"[^"]*")
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
   | (?P<op><=>|==|!=|<>|<=|>=|\|\||<<|>>>|>>|[-+*/%(),.<>=&|^~])
@@ -76,6 +76,57 @@ def tokenize(sql: str) -> List[Tok]:
 
 class SqlParseError(ValueError):
     pass
+
+
+def unescape_sql_string(body: str) -> str:
+    """Spark's default string-literal semantics (``unescapeSQLString``,
+    ``spark.sql.parser.escapedStringLiterals=false``): backslash escapes
+    are processed ('\\\\d' is a 2-char regex escape, '\\n' a newline),
+    '' is a quote, \\% and \\_ KEEP their backslash (LIKE escapes), an
+    unknown escaped char is the char itself, plus \\uXXXX and 3-digit
+    octal forms."""
+    out = []
+    i = 0
+    n = len(body)
+    mapped = {"0": "\0", "b": "\b", "n": "\n", "r": "\r", "t": "\t",
+              "Z": "\x1a", "\\": "\\", "'": "'", '"': '"'}
+    while i < n:
+        c = body[i]
+        if c == "'" and i + 1 < n and body[i + 1] == "'":
+            out.append("'")
+            i += 2
+            continue
+        if c == "\\" and i + 1 < n:
+            nx = body[i + 1]
+            # 3-digit octal BEFORE the single-char map: '\012' is a
+            # newline, not NUL + "12" (Spark checks octal first too)
+            oct3 = body[i + 1:i + 4]
+            if (len(oct3) == 3 and nx in "0123"
+                    and all(ch in "01234567" for ch in oct3)):
+                out.append(chr(int(oct3, 8)))
+                i += 4
+                continue
+            if nx in mapped:
+                out.append(mapped[nx])
+                i += 2
+                continue
+            if nx in "%_":
+                out.append("\\" + nx)
+                i += 2
+                continue
+            hex4 = body[i + 2:i + 6]
+            if (nx == "u" and len(hex4) == 4
+                    and all(ch in "0123456789abcdefABCDEF"
+                            for ch in hex4)):
+                out.append(chr(int(hex4, 16)))
+                i += 6
+                continue
+            out.append(nx)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 # --------------------------------------------------------------------------
@@ -713,7 +764,7 @@ class Parser:
             return self._number(self.next().text)
         if t.kind == "str":
             self.next()
-            return Literal(t.text[1:-1].replace("''", "'"))
+            return Literal(unescape_sql_string(t.text[1:-1]))
         if t.kind == "op" and t.text == "(" and self.peek(1).kind == "ident" \
                 and self.peek(1).upper == "SELECT":
             self.next()

@@ -63,12 +63,34 @@ def test_unsupported_patterns_fall_back(sess):
     df, t = str_df(sess)
     for pat, frag in [(r"(a)\1", "backreference"),
                       (r"a(?=b)", "group construct"),
-                      (r"a*?b", "lazy"),
+                      (r"a*+b", "possessive"),
                       (r"\bword", "anchor")]:
         q = df.select(df.u, F.rlike(df.s, pat).alias("m"))
         report = sess.explain(q)
         assert "cannot run on TPU" in report, pat
         assert frag in report, (pat, report)
+    # lazy quantifiers stay host-side for SPAN-consuming expressions
+    # (they change the match extent) ...
+    rep = sess.explain(df.select(
+        F.regexp_replace(df.s, r"a*?b", "X").alias("r")))
+    assert "cannot run on TPU" in rep and "lazy" in rep
+
+
+def test_rlike_lazy_and_input_anchors_on_device(sess):
+    """Membership is lazy-insensitive, so RLike keeps a*?b on device;
+    \\A and \\z compile as input anchors."""
+    import re
+    df, t = str_df(sess)
+    for pat in (r"a*?b", r"o+?", r"\Aab", r"ab\z", r"\Ax.*\z"):
+        q = df.select(df.u, F.rlike(df.s, pat).alias("m"))
+        assert "cannot run" not in sess.explain(q), pat
+        got = {r["u"]: r["m"] for r in q.collect().to_pylist()}
+        for u, s in zip(t["u"].to_pylist(), t["s"].to_pylist()):
+            if s is None:
+                continue
+            pyre = pat.replace(r"\A", "^").replace(r"\z", "$")
+            exp = re.search(pyre, s) is not None
+            assert got[u] == exp, (pat, s, got[u], exp)
 
 
 @pytest.mark.parametrize("pat,rep", [

@@ -8,6 +8,7 @@ equivalent DataFrame-API query.
 
 import numpy as np
 import pandas as pd
+import pyarrow as pa
 import pytest
 
 from spark_rapids_tpu.sql import functions as F
@@ -445,3 +446,18 @@ def test_interval_arithmetic(spark):
     assert naive(r["b"]) == datetime.datetime(2020, 2, 29, 10)
     assert naive(r["c"]) == datetime.datetime(2020, 1, 31, 2)
     assert naive(r["f"]) == datetime.datetime(2020, 1, 31, 10)
+
+
+def test_string_literal_backslash_escapes(spark):
+    """Spark default (escapedStringLiterals=false): '\\\\d' is the 2-char
+    regex escape, '\\n' a newline, '' a quote, \\% keeps its backslash."""
+    tt = pa.table({"s": ["alpha1", "x", "a\nb"]})
+    spark.create_dataframe(tt).createOrReplaceTempView("esc_t")
+    out = spark.sql(
+        r"SELECT s RLIKE '[a-z]+\\d+' AS m, 'a\nb' = s AS nl, "
+        r"length('it''s') AS q, 'p\\%q' AS pct FROM esc_t"
+    ).collect().to_pylist()
+    assert [r["m"] for r in out] == [True, False, False]
+    assert [r["nl"] for r in out] == [False, False, True]
+    assert out[0]["q"] == 4
+    assert out[0]["pct"] == "p\\%q"
