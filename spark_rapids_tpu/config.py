@@ -235,6 +235,13 @@ OPTIMIZER_TRANSITION_FIXED = register(
     "dwarfs per-row costs for small batches.  -1 (default) = auto: "
     "measure the sync round trip once per process and use that.", -1.0)
 
+RAGGED_STRING_SPLIT_BYTES = register(
+    "spark.rapids.sql.strings.raggedSplitBytes",
+    "Scans split a batch into string width classes when its padded "
+    "[capacity x width] byte-matrix footprint would exceed this many "
+    "bytes and the split saves >=4x — so one long string doesn't make "
+    "every row pay its width.  0 disables.", 16 << 20)
+
 APPROX_PERCENTILE_STRATEGY = register(
     "spark.rapids.sql.approxPercentile.strategy",
     "approx_percentile implementation: 'exact' = sorted ordinal selection "

@@ -129,11 +129,20 @@ class FileScanExec(PhysicalPlan):
     def execute(self, pid: int, tctx: TaskContext):
         import jax
 
-        def upload(table):
+        def upload_one(table):
             batch = arrow_to_device(table)
             if self.backend == CPU:
                 batch = jax.device_get(batch)
             return batch
+
+        def upload(table):
+            """One batch per string-width class (split_for_upload);
+            single-batch for the overwhelmingly common case."""
+            from ..columnar.convert import split_for_upload
+            pieces = split_for_upload(table, self.conf)
+            if len(pieces) > 1:
+                tctx.inc_metric("raggedStringSplits")
+            return [upload_one(p) for p in pieces]
 
         if self.reader_type == "COALESCING":
             import pyarrow as pa
@@ -142,7 +151,7 @@ class FileScanExec(PhysicalPlan):
                 tables = list(pool.map(lambda p: self._read(p, tctx),
                                        self.files))
             if tables:
-                yield upload(pa.concat_tables(tables, promote_options="default"))
+                yield from upload(pa.concat_tables(tables, promote_options="default"))
             return
 
         if pid >= len(self.files):
@@ -163,20 +172,20 @@ class FileScanExec(PhysicalPlan):
                 self._pool = ThreadPoolExecutor(
                     max_workers=int(self.conf.get(MULTITHREAD_READ_NUM_THREADS)))
             fut = self._pool.submit(self._read, self.files[pid], tctx)
-            yield upload(fut.result())
+            yield from upload(fut.result())
             return
         if self.node.fmt == "parquet" and bool(
                 self.conf.get(READER_CHUNKED)):
             for table in self._read_chunked(self.files[pid], tctx):
                 tctx.inc_metric("chunkedReadBatches")
-                yield upload(table)
+                yield from upload(table)
             return
         if self.node.fmt == "orc" and bool(self.conf.get(READER_CHUNKED)):
             for table in self._read_chunked_orc(self.files[pid], tctx):
                 tctx.inc_metric("chunkedReadBatches")
-                yield upload(table)
+                yield from upload(table)
             return
-        yield upload(self._read(self.files[pid], tctx))
+        yield from upload(self._read(self.files[pid], tctx))
 
     def simple_string(self):
         extra = ""

@@ -128,8 +128,14 @@ class _Parser:
 
     def quantified(self):
         atom = self.atom()
+        wrapped = False
         while True:
             ch = self.peek()
+            if ch in ("*", "+", "?", "{") and wrapped:
+                # Java AND Python both reject a quantifier applied
+                # directly to a quantifier (`a**`, `a*{2}`); accepting it
+                # on device would return rows where Spark errors
+                self.error("quantifier after quantifier")
             if ch == "*":
                 self.next()
                 atom = RRep(atom, 0, None)
@@ -143,6 +149,7 @@ class _Parser:
                 atom = self.counted(atom)
             else:
                 return atom
+            wrapped = True
             nxt = self.peek()
             if nxt in ("?", "+") and isinstance(atom, RRep):
                 if nxt == "?" and self.allow_lazy:
@@ -174,7 +181,13 @@ class _Parser:
 
         if "," in body:
             lo_s, hi_s = body.split(",", 1)
-            lo = _digits(lo_s) if lo_s else 0
+            if not lo_s:
+                # Java treats `a{,2}` as the LITERAL text (a `{` not
+                # followed by a digit is not a quantifier); Python's re
+                # reads {0,2}.  Reject to the host rather than silently
+                # matching the empty string on device.
+                self.error(f"malformed repetition {{{body}}}")
+            lo = _digits(lo_s)
             hi = _digits(hi_s) if hi_s else None
         else:
             lo = hi = _digits(body)

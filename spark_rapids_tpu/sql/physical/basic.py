@@ -42,13 +42,15 @@ def compact_batch(xp, batch: ColumnarBatch, keep) -> ColumnarBatch:
 _UPLOAD_CACHE: dict = {}
 
 
-def _cached_upload(table, backend: str) -> ColumnarBatch:
+def _cached_upload(table, backend: str, conf=None) -> list:
     """Decode+pad+upload a pyarrow table once per (table, backend); repeat
-    scans of the same in-memory relation reuse the resident batch (the
+    scans of the same in-memory relation reuse the resident batches (the
     engine-side analog of Spark's InMemoryRelation staying cached — and the
-    TPU-idiomatic move: keep hot data in HBM instead of re-uploading)."""
+    TPU-idiomatic move: keep hot data in HBM instead of re-uploading).
+    Ragged string tables split into width classes first (one long string
+    must not make every row pay its padded width)."""
     import weakref
-    from ...columnar.convert import arrow_to_device
+    from ...columnar.convert import arrow_to_device, split_for_upload
     key = id(table)
     ent = _UPLOAD_CACHE.get(key)
     if ent is None or ent[0]() is not table:
@@ -57,8 +59,9 @@ def _cached_upload(table, backend: str) -> ColumnarBatch:
         _UPLOAD_CACHE[key] = ent
     per_backend = ent[1]
     if backend not in per_backend:
-        per_backend[backend] = _to_backend_batch(arrow_to_device(table),
-                                                 backend)
+        per_backend[backend] = [
+            _to_backend_batch(arrow_to_device(p), backend)
+            for p in split_for_upload(table, conf)]
     return per_backend[backend]
 
 
@@ -83,7 +86,7 @@ class InMemoryScanExec(PhysicalPlan):
         return sum(t.nbytes for t in self._parts)
 
     def execute(self, pid: int, tctx: TaskContext):
-        yield _cached_upload(self._parts[pid], self.backend)
+        yield from _cached_upload(self._parts[pid], self.backend, tctx.conf)
 
     def simple_string(self):
         return f"{self.node_name()} [{', '.join(a.name for a in self._attrs)}]"

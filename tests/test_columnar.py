@@ -148,3 +148,55 @@ def test_object_column_concat_and_repad():
     assert device_to_arrow(b.repadded(16)).column("l").to_pylist() == vals
     with pytest.raises(ValueError):
         ColumnarBatch.concat([])
+
+
+# ---------------------------------------------------------------------------
+# ragged-string width-class splitting (VERDICT r2 weak #5)
+# ---------------------------------------------------------------------------
+
+class TestRaggedStringSplit:
+    def test_split_keeps_footprint_near_data_size(self):
+        """20k 1-byte strings + 3 10KB strings: unsplit the padded matrix
+        is cap(32768) x width(16384) = 512MB; split it must stay within a
+        few MB."""
+        import spark_rapids_tpu as srt
+        from spark_rapids_tpu.columnar.convert import split_ragged_strings
+        from spark_rapids_tpu.sql.physical.transitions import batch_nbytes
+        n = 20_000
+        vals = ["a"] * n + ["x" * 10_240] * 3
+        t = pa.table({"s": vals, "v": list(range(n + 3))})
+        pieces = split_ragged_strings(t, 16 << 20)
+        assert len(pieces) == 2
+        assert pieces[0].num_rows == n and pieces[1].num_rows == 3
+        # end-to-end through the scan: batches stay small
+        from spark_rapids_tpu.sql.physical.basic import _cached_upload
+        batches = _cached_upload(t, "tpu")
+        assert len(batches) == 2
+        total = sum(batch_nbytes(b) for b in batches)
+        assert total < 8 << 20, f"padded footprint {total} bytes"
+
+    def test_split_results_identical(self):
+        """Query results match the host oracle after splitting (order-
+        insensitive)."""
+        import spark_rapids_tpu as srt
+        from spark_rapids_tpu.sql import functions as F
+        rng = np.random.default_rng(0)
+        n = 20_000
+        vals = ["k" + str(int(i)) for i in rng.integers(0, 50, n)]
+        vals += ["L" * 9_000, "L" * 8_000]
+        t = pa.table({"s": vals, "v": list(range(len(vals)))})
+        sess = srt.session()
+        df = sess.create_dataframe(t)
+        got = (df.withColumn("ln", F.length(df.s))
+               .groupBy("ln").count().orderBy("ln")
+               .collect().to_pandas())
+        pdf = t.to_pandas()
+        exp = (pdf.assign(ln=pdf.s.str.len()).groupby("ln").size()
+               .reset_index(name="count").sort_values("ln"))
+        assert np.array_equal(got["ln"].values, exp["ln"].values)
+        assert np.array_equal(got["count"].values, exp["count"].values)
+
+    def test_uniform_strings_not_split(self):
+        from spark_rapids_tpu.columnar.convert import split_ragged_strings
+        t = pa.table({"s": ["abc"] * 10_000})
+        assert len(split_ragged_strings(t, 16 << 20)) == 1

@@ -53,6 +53,8 @@ def extract_corpus(ref_root: str):
                 continue
             if not lit.strip():
                 continue
+            if "${" in lit:      # Scala string-interpolation fragment,
+                continue         # not a regex pattern
             try:
                 re.compile(lit)
             except re.error:
