@@ -289,7 +289,6 @@ def split_ragged_strings(table: pa.Table,
 
     Returns [table] when splitting is unnecessary or unhelpful.
     """
-    import numpy as np_
     from .column import bucket_capacity, bucket_width
     n = table.num_rows
     if n < 2:
@@ -302,20 +301,20 @@ def split_ragged_strings(table: pa.Table,
         return [table]
     cap = bucket_capacity(n)
     # per-row max length across string columns decides the row's class
-    row_max = np_.zeros(n, dtype=np_.int64)
+    row_max = np.zeros(n, dtype=np.int64)
     widths = []
     for ci in str_cols:
         col = table.column(ci)
         lens = pa.compute.binary_length(col).fill_null(0)
-        lens_np = lens.to_numpy(zero_copy_only=False).astype(np_.int64)
+        lens_np = lens.to_numpy(zero_copy_only=False).astype(np.int64)
         widths.append(bucket_width(int(lens_np.max()) if n else 0))
-        np_.maximum(row_max, lens_np, out=row_max)
+        np.maximum(row_max, lens_np, out=row_max)
     footprint = cap * sum(widths)
     if footprint <= threshold_bytes:
         return [table]
     # short class at the 99th-percentile width; only split when it
     # actually pays
-    w_short = bucket_width(int(np_.percentile(row_max, 99.0)))
+    w_short = bucket_width(int(np.percentile(row_max, 99.0)))
     long_mask = row_max > w_short
     n_long = int(long_mask.sum())
     if n_long == 0 or n_long == n:

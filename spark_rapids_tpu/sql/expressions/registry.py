@@ -79,8 +79,9 @@ _reg(Str.Length, Str.OctetLength, Str.BitLength, Str.Upper, Str.Lower,
      Str.Md5)
 
 from . import udf as U  # noqa: E402
+from . import hive_udf as HU  # noqa: E402
 
-_reg(U.PythonUDF, U.PandasUDF, U.DeviceUDF)
+_reg(U.PythonUDF, U.PandasUDF, U.DeviceUDF, HU.HiveSimpleUDF)
 
 # aggregate + window classes run through dedicated exec kernels rather
 # than Expression.kernel, but they ARE device-supported — register them so

@@ -50,6 +50,7 @@ def _cached_upload(table, backend: str, conf=None) -> list:
     Ragged string tables split into width classes first (one long string
     must not make every row pay its padded width)."""
     import weakref
+    from ...config import RAGGED_STRING_SPLIT_BYTES, RapidsConf
     from ...columnar.convert import arrow_to_device, split_for_upload
     key = id(table)
     ent = _UPLOAD_CACHE.get(key)
@@ -58,11 +59,16 @@ def _cached_upload(table, backend: str, conf=None) -> list:
         ent = (ref, {})
         _UPLOAD_CACHE[key] = ent
     per_backend = ent[1]
-    if backend not in per_backend:
-        per_backend[backend] = [
+    # the split decision depends on the threshold conf — key it in, so
+    # changing raggedSplitBytes takes effect on already-scanned relations
+    thr = int((conf or RapidsConf.get_global())
+              .get(RAGGED_STRING_SPLIT_BYTES))
+    ck = (backend, thr)
+    if ck not in per_backend:
+        per_backend[ck] = [
             _to_backend_batch(arrow_to_device(p), backend)
             for p in split_for_upload(table, conf)]
-    return per_backend[backend]
+    return per_backend[ck]
 
 
 class InMemoryScanExec(PhysicalPlan):

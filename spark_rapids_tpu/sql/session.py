@@ -38,6 +38,9 @@ class TpuSession:
         self.conf = SessionConf(self._conf)
         self.last_query_metrics: dict = {}
         self._temp_views: dict = {}
+        #: name -> implementation object (Hive UDF bridge; hiveUDFs.scala
+        #: analog — populated by CREATE TEMPORARY FUNCTION or the API)
+        self._hive_udfs: dict = {}
 
     # ------------------------------------------------------------------
     @classmethod
@@ -81,6 +84,21 @@ class TpuSession:
         and execution path as the DataFrame API."""
         from .sqlparser import parse_query
         return parse_query(self, query)
+
+    def register_hive_function(self, name: str, impl) -> None:
+        """Register a Hive-style function (the CREATE TEMPORARY FUNCTION
+        surface): ``impl`` is an object/class with ``return_type`` and
+        ``evaluate(*row)`` (row-based, host) or
+        ``evaluate_columnar(ctx, *cols)`` (device SPI), or a
+        'module.Class' string resolved by import."""
+        from .expressions.hive_udf import (_impl_return_type,
+                                           resolve_hive_class)
+        if isinstance(impl, str):
+            impl = resolve_hive_class(impl)
+        elif isinstance(impl, type):
+            impl = impl()
+        _impl_return_type(impl)  # validate the declaration up front
+        self._hive_udfs[name.lower()] = impl
 
     def table(self, name: str) -> DataFrame:
         view = self._temp_views.get(name.lower())

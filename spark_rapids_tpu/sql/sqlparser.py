@@ -452,10 +452,13 @@ _RESERVED_STOP = {
 
 
 class Parser:
-    def __init__(self, sql: str):
+    def __init__(self, sql: str, udfs: Optional[Dict[str, Any]] = None):
         self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
+        #: session-registered Hive UDFs (name -> impl); consulted before
+        #: the builtin function table in _call
+        self.udfs = udfs or {}
 
     # --- token helpers ----------------------------------------------------
     def peek(self, ahead: int = 0) -> Tok:
@@ -928,6 +931,12 @@ class Parser:
             from .expressions import predicates as PR
             e = CaseWhen([(self._cmp(PR.EqualTo, args[0], args[1]),
                            Literal(None))], args[0])
+        elif lname in self.udfs:
+            from .expressions.hive_udf import HiveSimpleUDF
+            if distinct:
+                raise SqlParseError(
+                    f"DISTINCT is not supported inside {name}()")
+            e = HiveSimpleUDF(lname, self.udfs[lname], *args)
         else:
             fn = _functions().get(lname)
             if fn is None:
@@ -1010,7 +1019,52 @@ class Parser:
         return SortOrder(e, asc, nulls_first)
 
     # --- statements -------------------------------------------------------
+    def _maybe_function_ddl(self):
+        if self.accept_kw("CREATE"):
+            replace = False
+            if self.accept_kw("OR"):
+                self.expect_kw("REPLACE")
+                replace = True
+            if not self.accept_kw("TEMPORARY"):
+                return None
+            if not self.accept_kw("FUNCTION"):
+                return None
+            name = self.expect_ident()
+            self.expect_kw("AS")
+            t = self.peek()
+            if t.kind != "str":
+                raise SqlParseError(
+                    f"expected a quoted class path after AS at {t.pos}")
+            self.next()
+            path = unescape_sql_string(t.text[1:-1])
+            return CreateFunctionStmt(name, path, replace)
+        if self.accept_kw("DROP"):
+            if not self.accept_kw("TEMPORARY"):
+                return None
+            if not self.accept_kw("FUNCTION"):
+                return None
+            if_exists = False
+            if self.accept_kw("IF"):
+                self.expect_kw("EXISTS")
+                if_exists = True
+            return DropFunctionStmt(self.expect_ident(), if_exists)
+        return None
+
     def parse_statement(self):
+        # DDL: CREATE [OR REPLACE] TEMPORARY FUNCTION f AS 'module.Class'
+        # (the exact shape Spark uses to register Hive UDFs) / DROP
+        # TEMPORARY FUNCTION [IF EXISTS] f
+        if self.at_kw("CREATE") or self.at_kw("DROP"):
+            save = self.i
+            stmt = self._maybe_function_ddl()
+            if stmt is not None:
+                tail = self.peek()
+                if tail.kind != "eof":
+                    raise SqlParseError(
+                        f"unexpected trailing input {tail.text!r} at "
+                        f"{tail.pos} in {self.sql!r}")
+                return stmt
+            self.i = save
         ctes: Dict[str, Any] = {}
         if self.accept_kw("WITH"):
             while True:
@@ -1278,11 +1332,20 @@ class Parser:
 # Public expression-string entry points
 # --------------------------------------------------------------------------
 
+def _active_udfs():
+    """Hive UDFs of the active session — expression-string surfaces
+    (F.expr / selectExpr / string filters) see the same temporary
+    functions session.sql does, like Spark."""
+    from .session import TpuSession
+    s = TpuSession._active
+    return getattr(s, "_hive_udfs", None) if s is not None else None
+
+
 def parse_expr(sql: str):
     """``F.expr("...")`` — expression string to a Column (plain column
     names stay unresolved, resolved later against the target frame)."""
     from .dataframe import Column
-    p = Parser(sql)
+    p = Parser(sql, udfs=_active_udfs())
     e = p.parse_expression()
     alias = None
     if p.accept_kw("AS"):
@@ -1301,7 +1364,7 @@ def parse_expr(sql: str):
 
 def parse_select_item(sql: str):
     """One selectExpr entry: expression with optional alias, or '*'."""
-    p = Parser(sql)
+    p = Parser(sql, udfs=_active_udfs())
     item = p._select_item()
     tail = p.peek()
     if tail.kind != "eof":
@@ -1313,6 +1376,19 @@ def parse_select_item(sql: str):
 # --------------------------------------------------------------------------
 # Query builder: statement AST -> DataFrame
 # --------------------------------------------------------------------------
+
+@dataclass
+class CreateFunctionStmt:
+    name: str
+    class_path: str
+    replace: bool = False
+
+
+@dataclass
+class DropFunctionStmt:
+    name: str
+    if_exists: bool = False
+
 
 class QueryBuilder:
     """Builds DataFrames from parsed statements against a session's
@@ -2088,5 +2164,23 @@ def _auto_name(raw: Expression, resolved: Expression) -> str:
 
 def parse_query(session, sql: str):
     """``session.sql(...)`` entry point."""
-    stmt = Parser(sql).parse_statement()
+    stmt = Parser(sql, udfs=getattr(session, "_hive_udfs", None)
+                  ).parse_statement()
+    if isinstance(stmt, CreateFunctionStmt):
+        if not stmt.replace and stmt.name.lower() in session._hive_udfs:
+            raise ValueError(
+                f"function {stmt.name!r} already exists (use CREATE OR "
+                f"REPLACE TEMPORARY FUNCTION)")
+        session.register_hive_function(stmt.name, stmt.class_path)
+        return session.create_dataframe(_empty_ddl_result())
+    if isinstance(stmt, DropFunctionStmt):
+        if session._hive_udfs.pop(stmt.name.lower(), None) is None \
+                and not stmt.if_exists:
+            raise ValueError(f"function not found: {stmt.name}")
+        return session.create_dataframe(_empty_ddl_result())
     return QueryBuilder(session).build(stmt)
+
+
+def _empty_ddl_result():
+    import pyarrow as pa
+    return pa.schema([]).empty_table()
