@@ -50,7 +50,7 @@ try:
     ROWS = int(float(ARGS[0])) if ARGS else (
         500_000 if SUITE else 8_000_000)
 except ValueError:
-    ROWS = 8_000_000
+    ROWS = 500_000 if SUITE else 8_000_000
 WARM_ROWS = min(1_000_000, ROWS)
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S",
@@ -292,12 +292,13 @@ def _suite_child(platform: str) -> None:
     _result.update(metric="scale_suite_geomean_rows_per_sec",
                    platform=platform, queries=0)
     tables = scaletest.build_tables(rows)
+    extra: dict = {}  # per-prefix TPC table sets, generated once
     sess = srt.session()
     rates = []
     for name, _fn in scaletest.QUERIES:
         try:
             rep = scaletest.run_suite(rows, queries=[name], tables=tables,
-                                      sess=sess)
+                                      sess=sess, extra_tables=extra)
         except Exception as e:
             sys.stdout.write(json.dumps(
                 {"query": name, "error": f"{type(e).__name__}: {e}"}) + "\n")

@@ -286,6 +286,17 @@ SHUFFLE_TRANSPORT_CLASS = register(
     "LOCAL (in-process store) or TCP (cross-process block server + driver "
     "registry, the UCX-transport analog for cross-host fetches; "
     "RapidsShuffleTransport SPI).", "LOCAL")
+SHUFFLE_TOPOLOGY_SLICES = register(
+    "spark.rapids.shuffle.topology.numSlices",
+    "Number of TPU slices the job spans.  1 (default) = single-slice: "
+    "every exchange rides ICI (XLA collectives).  >1 enables the two-"
+    "tier plane: a slice's own reduce partitions stay on ICI while "
+    "blocks owned by peer slices cross DCN via the TCP transport "
+    "(parallel/topology.py; reference UCX transport + peer registry).",
+    1)
+SHUFFLE_TOPOLOGY_SLICE_ID = register(
+    "spark.rapids.shuffle.topology.sliceId",
+    "This process's slice ordinal in [0, numSlices).", 0)
 SHUFFLE_TCP_DRIVER_ENDPOINT = register(
     "spark.rapids.shuffle.tcp.driverEndpoint",
     "host:port of the driver heartbeat registry for the TCP transport "

@@ -1,0 +1,63 @@
+"""Slice topology — the two-tier interconnect model (SURVEY §2.8).
+
+A TPU pod job spans SLICES: chips within a slice are joined by ICI
+(exchanges ride XLA collectives inside compiled programs —
+``parallel/mesh.py``), while slices talk over DCN (the framed TCP
+transport with its driver registry — ``shuffle/tcp.py``,
+``native/srt_transport.cpp``).  This module is the routing brain the
+reference keeps in its UCX transport SPI + peer registry
+(``RapidsShuffleTransport.scala:1``, ``RapidsShuffleHeartbeatManager``):
+which slice owns a reduce partition, and therefore which tier a block
+crosses.
+
+Ownership is contiguous-block: with S slices and N reduce partitions,
+slice s owns partitions [s*ceil(N/S), (s+1)*ceil(N/S)) — keeping a
+slice's partitions adjacent so range-partitioned outputs stay clustered
+and a slice's ICI all_to_all never needs DCN hops for its own rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    num_slices: int
+    slice_id: int
+
+    def __post_init__(self):
+        if self.num_slices < 1:
+            raise ValueError("num_slices must be >= 1")
+        if not (0 <= self.slice_id < self.num_slices):
+            raise ValueError(
+                f"slice_id {self.slice_id} out of range for "
+                f"{self.num_slices} slices")
+
+    @property
+    def multi_slice(self) -> bool:
+        return self.num_slices > 1
+
+    def owner_of(self, reduce_id: int, num_partitions: int) -> int:
+        """Slice that owns a reduce partition."""
+        per = -(-num_partitions // self.num_slices)  # ceil division
+        return min(reduce_id // per, self.num_slices - 1)
+
+    def is_local(self, reduce_id: int, num_partitions: int) -> bool:
+        return self.owner_of(reduce_id, num_partitions) == self.slice_id
+
+    def local_partitions(self, num_partitions: int) -> List[int]:
+        return [r for r in range(num_partitions)
+                if self.is_local(r, num_partitions)]
+
+    @staticmethod
+    def from_conf(conf) -> Optional["SliceTopology"]:
+        """None for the default single-slice job (every partition
+        local; no DCN tier)."""
+        from ..config import (SHUFFLE_TOPOLOGY_SLICE_ID,
+                              SHUFFLE_TOPOLOGY_SLICES)
+        n = int(conf.get(SHUFFLE_TOPOLOGY_SLICES))
+        if n <= 1:
+            return None
+        return SliceTopology(n, int(conf.get(SHUFFLE_TOPOLOGY_SLICE_ID)))

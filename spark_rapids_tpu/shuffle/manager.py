@@ -60,6 +60,11 @@ def _transport_from_conf(conf: RapidsConf, executor_id: str):
     return LocalTransport(), ShuffleHeartbeatManager()
 
 
+#: two-tier plane accounting: blocks served from this slice's own store
+#: (ICI tier) vs fetched from a peer slice over the TCP plane (DCN tier)
+TIER_STATS = {"local_blocks": 0, "dcn_fetches": 0}
+
+
 class ShuffleManager:
     """One per 'executor'; local mode uses a single instance."""
 
@@ -69,6 +74,10 @@ class ShuffleManager:
                  heartbeats: Optional[ShuffleHeartbeatManager] = None):
         self.conf = conf or RapidsConf.get_global()
         self.mode = str(self.conf.get(SHUFFLE_MODE)).upper()
+        from ..parallel.topology import SliceTopology
+        #: None = single-slice; multi-slice jobs route peer-owned blocks
+        #: over the DCN (TCP) tier while their own stay on ICI
+        self.topology = SliceTopology.from_conf(self.conf)
         executor_id = executor_id or str(self.conf.get(SHUFFLE_EXECUTOR_ID))
         self.executor_id = executor_id
         if transport is None and heartbeats is None:
@@ -136,6 +145,8 @@ class ShuffleManager:
             if self.mode == "ICI":
                 me = PeerInfo(self.executor_id, "local")
                 frame = self.transport.fetch(me, block)
+                if frame is not None:
+                    TIER_STATS["local_blocks"] += 1
                 if frame is None:
                     # one heartbeat per reduce read, not per block (the
                     # driver registry round-trip is not free over TCP)
@@ -153,6 +164,7 @@ class ShuffleManager:
                             last_err = e
                             continue
                         if frame is not None:
+                            TIER_STATS["dcn_fetches"] += 1
                             break
                     if frame is None and last_err is not None:
                         raise last_err
@@ -258,10 +270,14 @@ def get_shuffle_manager(conf: Optional[RapidsConf] = None) -> ShuffleManager:
         c = conf or RapidsConf.get_global()
         # any shuffle-topology conf change rebuilds the manager (mode alone
         # would silently keep a stale transport)
+        from ..config import (SHUFFLE_TOPOLOGY_SLICE_ID,
+                              SHUFFLE_TOPOLOGY_SLICES)
         key = (str(c.get(SHUFFLE_MODE)).upper(),
                str(c.get(SHUFFLE_TRANSPORT_CLASS)).upper(),
                str(c.get(SHUFFLE_TCP_DRIVER_ENDPOINT)),
-               str(c.get(SHUFFLE_EXECUTOR_ID)))
+               str(c.get(SHUFFLE_EXECUTOR_ID)),
+               int(c.get(SHUFFLE_TOPOLOGY_SLICES)),
+               int(c.get(SHUFFLE_TOPOLOGY_SLICE_ID)))
         if _global_manager is None or getattr(_global_manager, "_key",
                                               None) != key:
             old = _global_manager

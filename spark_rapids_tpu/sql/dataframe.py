@@ -357,7 +357,8 @@ class DataFrame:
     def filter(self, cond) -> "DataFrame":
         if isinstance(cond, str):
             from .sqlparser import parse_expr
-            cond = parse_expr(cond)
+            cond = parse_expr(cond,
+                              udfs=getattr(self._session, "_hive_udfs", None))
         return DataFrame(P.Filter(_resolve_expr(_to_expr(cond), self._plan),
                                   self._plan), self._session)
 
@@ -367,8 +368,9 @@ class DataFrame:
         """SQL expression strings as a projection (pyspark selectExpr)."""
         from .sqlparser import Star, parse_select_item
         cols: List[Any] = []
+        udfs = getattr(self._session, "_hive_udfs", None)
         for s in exprs:
-            item = parse_select_item(s)
+            item = parse_select_item(s, udfs=udfs)
             if isinstance(item.expr, Star):
                 if item.expr.qualifier is not None:
                     raise ValueError(

@@ -131,10 +131,19 @@ class ShuffleExchangeExec(PhysicalPlan):
             mgr.write_map_output(shuffle_id, cpid, pieces)
 
         out: List[List[ColumnarBatch]] = []
+        topo = mgr.topology
         for t in range(nt):
+            if topo is not None and topo.multi_slice \
+                    and not topo.is_local(t, nt):
+                # two-tier plane: this slice assembles ONLY the reduce
+                # partitions it owns; peer slices pull their own blocks
+                # (published above) over the DCN transport
+                out.append([])
+                continue
             got = mgr.read_reduce_partition(shuffle_id, num_maps, t)
             out.append([got] if got is not None else [])
-        mgr.cleanup(shuffle_id)
+        if topo is None or not topo.multi_slice:
+            mgr.cleanup(shuffle_id)  # multi-slice: peers still fetching
         self._materialized = out
 
     def _empty_batch(self) -> ColumnarBatch:

@@ -1333,19 +1333,21 @@ class Parser:
 # --------------------------------------------------------------------------
 
 def _active_udfs():
-    """Hive UDFs of the active session — expression-string surfaces
-    (F.expr / selectExpr / string filters) see the same temporary
-    functions session.sql does, like Spark."""
+    """Hive UDFs of the active session — the fallback for surfaces with
+    no session in reach (bare F.expr)."""
     from .session import TpuSession
     s = TpuSession._active
     return getattr(s, "_hive_udfs", None) if s is not None else None
 
 
-def parse_expr(sql: str):
+def parse_expr(sql: str, udfs=None):
     """``F.expr("...")`` — expression string to a Column (plain column
-    names stay unresolved, resolved later against the target frame)."""
+    names stay unresolved, resolved later against the target frame).
+    ``udfs``: the owning session's Hive UDF registry (DataFrame surfaces
+    pass their own session's; bare F.expr falls back to the active
+    session)."""
     from .dataframe import Column
-    p = Parser(sql, udfs=_active_udfs())
+    p = Parser(sql, udfs=udfs if udfs is not None else _active_udfs())
     e = p.parse_expression()
     alias = None
     if p.accept_kw("AS"):
@@ -1362,9 +1364,9 @@ def parse_expr(sql: str):
     return Column(e)
 
 
-def parse_select_item(sql: str):
+def parse_select_item(sql: str, udfs=None):
     """One selectExpr entry: expression with optional alias, or '*'."""
-    p = Parser(sql, udfs=_active_udfs())
+    p = Parser(sql, udfs=udfs if udfs is not None else _active_udfs())
     item = p._select_item()
     tail = p.peek()
     if tail.kind != "eof":

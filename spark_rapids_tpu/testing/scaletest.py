@@ -666,15 +666,18 @@ _TABLE_SETS = {"tpch": build_tpch_tables, "tpcds": build_tpcds_tables}
 
 
 def run_suite(rows: int = 50_000, queries=None, tables=None,
-              sess=None) -> List[dict]:
-    """Runs the selected queries; pass ``tables``/``sess`` to amortize
-    datagen and session setup across calls.  ``seconds`` includes compile
-    plus the pandas oracle check; ``warm_seconds`` is the second run with
-    compiles amortized — the number to compare across rigs."""
+              sess=None, extra_tables=None) -> List[dict]:
+    """Runs the selected queries; pass ``tables``/``sess``/
+    ``extra_tables`` (a mutable dict, filled with the per-prefix TPC
+    table sets on first use) to amortize datagen and session setup
+    across calls.  ``seconds`` includes compile plus the pandas oracle
+    check; ``warm_seconds`` is the second run with compiles amortized —
+    the number to compare across rigs."""
     import spark_rapids_tpu as srt
     from ..sql import functions as F
     base_tables = tables if tables is not None else build_tables(rows)
-    extra: Dict[str, Dict[str, pa.Table]] = {}
+    extra: Dict[str, Dict[str, pa.Table]] = (
+        extra_tables if extra_tables is not None else {})
     sess = sess or srt.session()
     report = []
     for name, fn in QUERIES:
