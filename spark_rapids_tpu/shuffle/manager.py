@@ -98,6 +98,12 @@ class ShuffleManager:
             thread_name_prefix="shuffle-reader")
         base = str(self.conf.get(SPILL_DIR))
         self._dir = os.path.join(base, f"shuffle-{uuid.uuid4().hex[:8]}")
+        #: multi-slice deferred reclamation: shuffle_id -> publish time;
+        #: swept lazily so peer slices get a window to pull (a refcount/
+        #: ack protocol would need driver coordination this local-mode
+        #: engine doesn't have)
+        self._pending_cleanup: Dict[int, float] = {}
+        self.cleanup_ttl_s = 300.0
 
     # ------------------------------------------------------------------
     def new_shuffle_id(self) -> int:
@@ -187,6 +193,20 @@ class ShuffleManager:
         return concat_serialized(frames)
 
     # ------------------------------------------------------------------
+    def defer_cleanup(self, shuffle_id: int) -> None:
+        """Mark a shuffle for TTL-based reclamation (multi-slice: peers
+        may still be fetching its blocks) and sweep anything expired."""
+        import time as _time
+        now = _time.monotonic()
+        with self._lock:
+            self._pending_cleanup[shuffle_id] = now
+            expired = [s for s, ts in self._pending_cleanup.items()
+                       if now - ts > self.cleanup_ttl_s]
+        for s in expired:
+            self.cleanup(s)
+            with self._lock:
+                self._pending_cleanup.pop(s, None)
+
     def cleanup(self, shuffle_id: Optional[int] = None):
         if hasattr(self.transport, "clear"):
             self.transport.clear(shuffle_id)

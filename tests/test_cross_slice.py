@@ -126,3 +126,34 @@ def test_exchange_materializes_only_local_partitions():
     finally:
         srt.session(**{"spark.rapids.shuffle.topology.numSlices": 1,
                        "spark.sql.adaptive.enabled": True})
+
+
+def test_multi_slice_map_ids_namespaced():
+    """Engine exchanges namespace map ids by slice (base = sliceId *
+    num_maps) so two slices' blocks for the same shuffle never collide —
+    the condition that lets a reducing slice see BOTH slices'
+    contributions (review r3 finding)."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+    sess = srt.session(**{
+        "spark.rapids.shuffle.topology.numSlices": 2,
+        "spark.rapids.shuffle.topology.sliceId": 1,
+        "spark.sql.adaptive.enabled": False})
+    try:
+        rng = np.random.default_rng(2)
+        t = pa.table({"k": rng.integers(0, 500, 20_000),
+                      "v": rng.random(20_000)})
+        df = sess.create_dataframe(t, num_partitions=4)
+        df.groupBy("k").agg(F.sum(F.col("v")).alias("s")).collect()
+        mgr = get_shuffle_manager(sess._conf)
+        ids = {b.map_id for b in mgr._files}
+        assert ids, "no blocks published"
+        # slice 1's bases are num_maps*1 per exchange (4 and 8 here) — no
+        # id may sit in slice 0's namespace [0, num_maps)
+        assert min(ids) >= 4, sorted(ids)
+        # deferred cleanup keeps blocks for the peer's TTL window
+        assert mgr._pending_cleanup
+    finally:
+        srt.session(**{"spark.rapids.shuffle.topology.numSlices": 1,
+                       "spark.sql.adaptive.enabled": True})
