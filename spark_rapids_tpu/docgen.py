@@ -59,20 +59,38 @@ def supported_ops_md() -> str:
              "Exec | Description", "-----|------------"]
     for name, desc in _exec_rows():
         lines.append(f"{name} | {desc}")
+    from .sql import typesig as TS
+    from .sql.overrides import EXPR_SIGS
+    cats = [c for c, _ in TS.MATRIX_CATEGORIES]
     lines += ["", "## Expressions", "",
               f"{len(EXPRESSION_REGISTRY)} expression classes are "
               "registered for device execution (anything else runs on the "
-              "host engine per-operator):", ""]
+              "host engine per-operator).  Per-expression INPUT/OUTPUT "
+              "type matrices below are the live tagging data "
+              "(sql/overrides.py EXPR_SIGS; TypeChecks.scala analog) — "
+              "S = supported on device, NS = falls back to the host "
+              "engine for that type.", "",
+              "Expression | Side | " + " | ".join(cats),
+              "-----------|------|" + "|".join("---" for _ in cats)]
     for name in sorted(EXPRESSION_REGISTRY):
-        lines.append(f"- {name}")
+        es = EXPR_SIGS.get(name, TS.DEFAULT_EXPR_SIG)
+        lines.append(f"{name} | input | "
+                     + " | ".join(TS.matrix_row(es.input)))
+        lines.append(f"{name} | result | "
+                     + " | ".join(TS.matrix_row(es.output)))
     return "\n".join(lines) + "\n"
 
 
 def supported_exprs_csv() -> str:
+    from .sql import typesig as TS
     from .sql.expressions.registry import EXPRESSION_REGISTRY
-    rows = ["Expression,Supported,Notes"]
+    from .sql.overrides import EXPR_SIGS
+    cats = [c for c, _ in TS.MATRIX_CATEGORIES]
+    rows = ["Expression,Side," + ",".join(cats)]
     for name in sorted(EXPRESSION_REGISTRY):
-        rows.append(f"{name},S,")
+        es = EXPR_SIGS.get(name, TS.DEFAULT_EXPR_SIG)
+        rows.append(f"{name},input," + ",".join(TS.matrix_row(es.input)))
+        rows.append(f"{name},result," + ",".join(TS.matrix_row(es.output)))
     return "\n".join(rows) + "\n"
 
 

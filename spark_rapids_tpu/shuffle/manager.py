@@ -103,10 +103,12 @@ class ShuffleManager:
         #: ack protocol would need driver coordination this local-mode
         #: engine doesn't have)
         self._pending_cleanup: Dict[int, float] = {}
-        self.cleanup_ttl_s = 300.0
+        self._expired_shuffles: set = set()
+        self.cleanup_ttl_s = 3600.0
 
     # ------------------------------------------------------------------
     def new_shuffle_id(self) -> int:
+        self.sweep_deferred()  # TTL is real even between defer calls
         with self._lock:
             self._next_shuffle += 1
             return self._next_shuffle
@@ -143,6 +145,11 @@ class ShuffleManager:
     # --- read side ------------------------------------------------------
     def read_reduce_partition(self, shuffle_id: int, num_maps: int,
                               reduce_id: int) -> Optional[ColumnarBatch]:
+        if shuffle_id in self._expired_shuffles:
+            # reclaimed-by-TTL must not masquerade as an empty partition
+            raise RuntimeError(
+                f"shuffle {shuffle_id} was reclaimed by the deferred-"
+                f"cleanup TTL ({self.cleanup_ttl_s}s) before this read")
         blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
 
         peers_cache: List[Optional[List[PeerInfo]]] = [None]
@@ -195,17 +202,28 @@ class ShuffleManager:
     # ------------------------------------------------------------------
     def defer_cleanup(self, shuffle_id: int) -> None:
         """Mark a shuffle for TTL-based reclamation (multi-slice: peers
-        may still be fetching its blocks) and sweep anything expired."""
+        may still be fetching its blocks) and sweep anything expired.
+        Expired shuffles leave a tombstone so a LOCAL late read raises
+        instead of reporting an empty partition; a cross-slice reader
+        that outlives the peer's TTL still sees None (documented
+        limitation — a wire-level expiry marker needs an ack protocol
+        this local-mode engine doesn't have; size the TTL generously)."""
+        import time as _time
+        with self._lock:
+            self._pending_cleanup[shuffle_id] = _time.monotonic()
+        self.sweep_deferred()
+
+    def sweep_deferred(self) -> None:
         import time as _time
         now = _time.monotonic()
         with self._lock:
-            self._pending_cleanup[shuffle_id] = now
             expired = [s for s, ts in self._pending_cleanup.items()
                        if now - ts > self.cleanup_ttl_s]
         for s in expired:
             self.cleanup(s)
             with self._lock:
                 self._pending_cleanup.pop(s, None)
+                self._expired_shuffles.add(s)
 
     def cleanup(self, shuffle_id: Optional[int] = None):
         if hasattr(self.transport, "clear"):

@@ -104,7 +104,15 @@ class ShuffleExchangeExec(PhysicalPlan):
         # plan stops paying nt-1 empty split/launch/sync rounds
         # (GpuCustomShuffleReaderExec coalesced-partitions analog)
         from ...config import ADAPTIVE_COALESCE_ROWS, ADAPTIVE_ENABLED
+        from ...shuffle import get_shuffle_manager as _gsm
+        _topo = _gsm(tctx.conf).topology
         coalesce = (nt > 1 and self._coalescible
+                    # multi-slice: the coalesce decision is DATA-dependent
+                    # (local map row count), so two slices could partition
+                    # the same shuffle differently and split a key across
+                    # reduce partitions — same hazard as co-partitioned
+                    # sibling exchanges (coalescible=False); never coalesce
+                    and (_topo is None or not _topo.multi_slice)
                     and bool(tctx.conf.get(ADAPTIVE_ENABLED))
                     and sum(b.num_rows_int for b in map_out
                             if b is not None)

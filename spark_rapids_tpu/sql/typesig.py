@@ -80,3 +80,44 @@ EVERYTHING = ALL_DEVICE + TypeSig((T.ArrayType, T.MapType),
                                   nested=TypeSig(_ALL_BASIC + (T.ArrayType,
                                                                T.StructType,
                                                                T.MapType)))
+
+
+class ExprSig:
+    """Per-expression INPUT/OUTPUT type matrices — the shape of the
+    reference's per-context TypeChecks rows (``TypeChecks.scala``: each
+    ExprChecks declares param and result sigs; tagging, docs and the
+    tools CSVs all read the same data)."""
+
+    def __init__(self, input: TypeSig, output: Optional[TypeSig] = None,
+                 note: str = ""):
+        self.input = input
+        self.output = output if output is not None else input
+        self.note = note
+
+
+#: the fallback for expressions with no EXPR_SIGS entry — ONE place
+#: defines it, so tagging and the generated docs/CSVs cannot diverge
+DEFAULT_EXPR_SIG: "ExprSig" = None  # set below (needs ALL_DEVICE)
+
+
+#: the documented type categories, in the reference's column order
+#: (supported_ops.md), with a representative instance per category used
+#: to evaluate a TypeSig into an S/NS matrix row
+MATRIX_CATEGORIES = [
+    ("BOOLEAN", T.BOOLEAN), ("BYTE", T.BYTE), ("SHORT", T.SHORT),
+    ("INT", T.INT), ("LONG", T.LONG), ("FLOAT", T.FLOAT),
+    ("DOUBLE", T.DOUBLE), ("DECIMAL", T.DecimalType(18, 2)),
+    ("STRING", T.STRING), ("BINARY", T.BINARY), ("DATE", T.DATE),
+    ("TIMESTAMP", T.TIMESTAMP), ("NULL", T.NULL),
+    ("ARRAY", T.ArrayType(T.INT)), ("MAP", T.MapType(T.STRING, T.INT)),
+    ("STRUCT", T.StructType((T.StructField("f", T.INT, True),))),
+]
+
+
+def matrix_row(ts: TypeSig) -> List[str]:
+    """S/NS cell per MATRIX_CATEGORIES column for one TypeSig."""
+    return ["S" if ts.supports(inst) is None else "NS"
+            for _, inst in MATRIX_CATEGORIES]
+
+
+DEFAULT_EXPR_SIG = ExprSig(ALL_DEVICE)

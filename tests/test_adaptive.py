@@ -103,29 +103,39 @@ def test_cost_optimizer_demotes_when_device_expensive():
     sess = srt.session(**{
         "spark.rapids.sql.optimizer.enabled": True,
         "spark.rapids.sql.optimizer.gpu.exec.default": 100.0})
-    df = sess.create_dataframe(t)
-    q = df.select((df.a + 1).alias("a1"))
-    rep = sess.explain(q)
-    assert "CpuProject" in rep and "cost-based optimizer" in rep
-    out = q.collect().to_pylist()
-    assert out[5]["a1"] == 6
+    try:
+        df = sess.create_dataframe(t)
+        q = df.select((df.a + 1).alias("a1"))
+        rep = sess.explain(q)
+        assert "CpuProject" in rep and "cost-based optimizer" in rep
+        out = q.collect().to_pylist()
+        assert out[5]["a1"] == 6
+    finally:
+        srt.session(**{"spark.rapids.sql.optimizer.enabled": False,
+                       "spark.rapids.sql.optimizer.gpu.exec.default": 0.0001})
 
 
 def test_cost_optimizer_keeps_device_when_cheap():
     t = pa.table({"a": list(range(100))})
     sess = srt.session(**{"spark.rapids.sql.optimizer.enabled": True})
-    df = sess.create_dataframe(t)
-    rep = sess.explain(df.select((df.a + 1).alias("a1")))
-    assert "TpuProject" in rep
+    try:
+        df = sess.create_dataframe(t)
+        rep = sess.explain(df.select((df.a + 1).alias("a1")))
+        assert "TpuProject" in rep
+    finally:
+        srt.session(**{"spark.rapids.sql.optimizer.enabled": False})
 
 
 def test_cost_optimizer_off_by_default():
     t = pa.table({"a": list(range(10))})
     sess = srt.session(**{
         "spark.rapids.sql.optimizer.gpu.exec.default": 100.0})
-    df = sess.create_dataframe(t)
-    rep = sess.explain(df.select((df.a + 1).alias("a1")))
-    assert "TpuProject" in rep  # optimizer disabled -> no demotion
+    try:
+        df = sess.create_dataframe(t)
+        rep = sess.explain(df.select((df.a + 1).alias("a1")))
+        assert "TpuProject" in rep  # optimizer disabled -> no demotion
+    finally:
+        srt.session(**{"spark.rapids.sql.optimizer.gpu.exec.default": 0.0001})
 
 
 def test_cost_optimizer_unknown_stats_keep_device(tmp_path):
@@ -135,6 +145,9 @@ def test_cost_optimizer_unknown_stats_keep_device(tmp_path):
     p = str(tmp_path / "t.parquet")
     pq.write_table(pa.table({"a": list(range(50))}), p)
     sess = srt.session(**{"spark.rapids.sql.optimizer.enabled": True})
-    df = sess.read.parquet(p)
-    rep = sess.explain(df.select((df.a + 1).alias("a1")))
-    assert "CpuProject" not in rep
+    try:
+        df = sess.read.parquet(p)
+        rep = sess.explain(df.select((df.a + 1).alias("a1")))
+        assert "CpuProject" not in rep
+    finally:
+        srt.session(**{"spark.rapids.sql.optimizer.enabled": False})

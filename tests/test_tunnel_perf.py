@@ -419,11 +419,16 @@ class TestMeasuredTransitionCost:
         sess = srt.session(**{
             "spark.rapids.sql.optimizer.enabled": True,
             "spark.rapids.sql.optimizer.transition.fixedSeconds": 0.065})
-        df = sess.create_dataframe(t)
-        q = df.select((df.a + 1).alias("a1"))
-        rep = sess.explain(q)
-        assert "CpuProject" in rep and "cost-based optimizer" in rep
-        assert q.collect().to_pylist()[5]["a1"] == 6
+        try:
+            df = sess.create_dataframe(t)
+            q = df.select((df.a + 1).alias("a1"))
+            rep = sess.explain(q)
+            assert "CpuProject" in rep and "cost-based optimizer" in rep
+            assert q.collect().to_pylist()[5]["a1"] == 6
+        finally:
+            srt.session(**{
+                "spark.rapids.sql.optimizer.enabled": False,
+                "spark.rapids.sql.optimizer.transition.fixedSeconds": -1.0})
 
     def test_fixed_cost_keeps_large_query(self):
         """Same 65ms boundary cost: at 8M rows the fixed latency is noise
@@ -432,9 +437,14 @@ class TestMeasuredTransitionCost:
         sess = srt.session(**{
             "spark.rapids.sql.optimizer.enabled": True,
             "spark.rapids.sql.optimizer.transition.fixedSeconds": 0.065})
-        df = sess.range(8_000_000)
-        rep = sess.explain(df.select((df.id * 2).alias("x")))
-        assert "TpuProject" in rep
+        try:
+            df = sess.range(8_000_000)
+            rep = sess.explain(df.select((df.id * 2).alias("x")))
+            assert "TpuProject" in rep
+        finally:
+            srt.session(**{
+                "spark.rapids.sql.optimizer.enabled": False,
+                "spark.rapids.sql.optimizer.transition.fixedSeconds": -1.0})
 
     def test_auto_measurement_is_cached(self):
         from spark_rapids_tpu.sql import optimizer as O
