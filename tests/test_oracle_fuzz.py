@@ -278,3 +278,67 @@ def test_scalar_subquery_and_interval_vs_pandas(sess, data):
                                 28 if (x.month == 2 and x.day == 29)
                                 else x.day))
     assert got == int((shifted <= datetime.date(2015, 6, 1)).sum())
+
+
+def test_bloom_filtered_star_join_vs_pandas(sess, data):
+    """Shuffle join with the bloom runtime filter engaged (small dim,
+    broadcast disabled) under OOM injection — results must equal pandas
+    exactly; the filter may only DROP non-matching probe rows early."""
+    from spark_rapids_tpu.ops import bloom as B
+    dim = gen_table({
+        "g": IntegerGen(min_val=0, max_val=500, nullable=False),
+        "name": StringGen(max_len=8),
+    }, 60, seed=7)
+    # dedupe dim keys (dim tables are unique-keyed; keeps the oracle 1:1)
+    dim = dim.group_by("g").aggregate([("name", "max")]).rename_columns(
+        ["g", "name"])
+    prev_thr = sess.conf.get("spark.rapids.sql.autoBroadcastJoinThreshold",
+                             10 * 1024 * 1024)
+    sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    try:
+        df = _df(sess, data)
+        ddf = sess.create_dataframe(dim, num_partitions=2)
+        built0 = B.STATS["blooms_built"]
+        got = (df.join(ddf, df.g == ddf.g, "inner")
+               .select(df.i, df.g, F.col("name"))
+               .collect().to_pandas())
+        assert B.STATS["blooms_built"] > built0, "bloom did not engage"
+        exp = (data.to_pandas().merge(dim.to_pandas(), on="g",
+                                      how="inner")[["i", "g", "name"]])
+        assert len(got) == len(exp)
+        a = got.sort_values(["i", "g", "name"]).reset_index(drop=True)
+        b = exp.sort_values(["i", "g", "name"]).reset_index(drop=True)
+        assert a.equals(b.astype(a.dtypes.to_dict()))
+    finally:
+        sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold",
+                      prev_thr)
+
+
+def test_tdigest_percentile_vs_pandas_quantiles(sess, data):
+    """Grouped approx_percentile on the t-digest path under OOM
+    injection: each estimate must sit within 3.5% rank error of the
+    group's true distribution (pandas as the independent oracle; the
+    delta-200 sketch merged across OOM-split batches lands ~2.5%
+    worst-case on 200-row groups)."""
+    sess.conf.set("spark.rapids.sql.approxPercentile.strategy", "tdigest")
+    try:
+        df = _df(sess, data)
+        got = (df.filter(df.d.isNotNull()).groupBy("g")
+               .agg(F.percentile_approx(df.d, [0.25, 0.5, 0.75])
+                    .alias("pq"))
+               .collect().to_pandas())
+        pdf = data.to_pandas()
+        pdf = pdf[pdf.d.notna()]
+        checked = 0
+        for gi in got["g"].head(40):
+            gv = np.sort(pdf[pdf.g == gi].d.values)
+            if len(gv) < 50:
+                continue
+            row = got[got.g == gi].pq.iloc[0]
+            for est, p in zip(row, [0.25, 0.5, 0.75]):
+                rank = np.searchsorted(gv, est) / len(gv)
+                assert abs(rank - p) < 0.035, (gi, p, rank)
+            checked += 1
+        assert checked > 10
+    finally:
+        sess.conf.set("spark.rapids.sql.approxPercentile.strategy", "auto")
