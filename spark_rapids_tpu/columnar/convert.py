@@ -232,7 +232,8 @@ def bulk_device_get(tree):
     leaves are byte-packed by a compiled kernel and unpacked from the one
     fetched buffer on the host; non-device leaves pass through unchanged."""
     import jax
-    leaves, treedef = jax.tree.flatten(tree)
+    from ..shims import tree_flatten
+    leaves, treedef = tree_flatten(tree)
     dev_idx = [i for i, l in enumerate(leaves)
                if isinstance(l, jax.Array) and not isinstance(l, np.ndarray)]
     if not dev_idx:
@@ -266,7 +267,8 @@ def bulk_device_get(tree):
         return jax.device_get(tree)
     for i, leaf in zip(dev_idx, unpack_buffers(host, sig)):
         leaves[i] = leaf
-    return jax.tree.unflatten(treedef, leaves)
+    from ..shims import tree_unflatten
+    return tree_unflatten(treedef, leaves)
 
 
 # --------------------------------------------------------------------------

@@ -46,7 +46,8 @@ def batch_device_bytes(batch: ColumnarBatch) -> int:
     """Accounted size: sum of leaf array nbytes."""
     import jax
     total = 0
-    for leaf in jax.tree_util.tree_leaves(batch):
+    from ..shims import tree_flatten
+    for leaf in tree_flatten(batch)[0]:
         nb = getattr(leaf, "nbytes", None)
         if nb is not None:
             total += int(nb)
@@ -137,7 +138,8 @@ class BufferCatalog:
         accounted pool (spilling others first if needed); host-backend
         (numpy-leaf) batches start at the HOST tier and never count as HBM."""
         import jax
-        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        from ..shims import tree_flatten
+        leaves, treedef = tree_flatten(batch)
         was_device = any(isinstance(l, jax.Array) for l in leaves)
         size = batch_device_bytes(batch)
         if was_device and not self.ensure_headroom(size,
@@ -188,7 +190,8 @@ class BufferCatalog:
                 self._host_to_device(buf)
             leaves = buf.leaves
             treedef = buf.treedef
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+        from ..shims import tree_unflatten
+        return tree_unflatten(treedef, leaves)
 
     def remove(self, handle: int):
         with self._lock:

@@ -157,7 +157,8 @@ def fit_linear_regression(df, feature_cols: Sequence[str], label_col: str,
     @jax.jit
     def step(params):
         g = jax.grad(loss)(params)
-        return jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+        from ..shims import tree_map
+        return tree_map(lambda p, gg: p - lr * gg, params, g)
 
     params = (jnp.zeros(d, X.dtype), jnp.asarray(0.0, X.dtype))
     for _ in range(steps):

@@ -153,11 +153,12 @@ def mesh_shuffle_batches(mesh, batches: List, pids: List, nt: int) -> List:
     cap = batches[0].capacity
     names = batches[0].names
 
-    leaves0, treedef = jax.tree.flatten(batches[0].columns)
+    from ..shims import tree_flatten, tree_unflatten
+    leaves0, treedef = tree_flatten(batches[0].columns)
     folded_per_shard: List[List] = []
     ks: List[int] = []
     for b in batches:
-        leaves, td = jax.tree.flatten(b.columns)
+        leaves, td = tree_flatten(b.columns)
         if td != treedef or len(leaves) != len(leaves0):
             raise MeshShuffleUnsupported("shards disagree on batch treedef")
         folded = []
@@ -213,7 +214,7 @@ def mesh_shuffle_batches(mesh, batches: List, pids: List, nt: int) -> List:
                 leaf = leaf.reshape((out_cap * ks[j],)
                                     + tuple(leaf.shape[2:]))
             leaves_t.append(leaf)
-        cols = jax.tree.unflatten(treedef, leaves_t)
+        cols = tree_unflatten(treedef, leaves_t)
         result.append(ColumnarBatch.make(names, cols,
                                          int(counts[t])).shrunk())
     return result

@@ -123,8 +123,11 @@ _active: Optional[ShimProvider] = None
 
 
 def get_shim() -> ShimProvider:
-    """The active provider for the running jax (cached)."""
+    """The active provider for the running jax (cached; lock-free fast
+    path — the wrappers sit on per-batch hot paths)."""
     global _active
+    if _active is not None:
+        return _active
     with _lock:
         if _active is None:
             v = _jax_version()
@@ -141,3 +144,15 @@ def get_shim() -> ShimProvider:
 
 def shard_map():
     return get_shim().shard_map()
+
+
+def tree_map(f, *trees):
+    return get_shim().tree_map()(f, *trees)
+
+
+def tree_flatten(tree):
+    return get_shim().tree_flatten()(tree)
+
+
+def tree_unflatten(treedef, leaves):
+    return get_shim().tree_unflatten()(treedef, leaves)

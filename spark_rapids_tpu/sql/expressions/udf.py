@@ -41,7 +41,8 @@ def _col_from_pylist(ctx, values: list, dtype: T.DataType,
     col = arrow_to_device_column(arr, capacity)
     if ctx.xp.__name__ != "numpy":
         import jax
-        col = jax.tree.map(ctx.xp.asarray, col)
+        from ...shims import tree_map
+        col = tree_map(ctx.xp.asarray, col)
     return col
 
 

@@ -24,7 +24,8 @@ def _to_backend_batch(batch: ColumnarBatch, backend: str) -> ColumnarBatch:
     import jax
     import jax.numpy as jnp
     if backend == TPU:
-        return jax.tree.map(jnp.asarray, batch)
+        from ...shims import tree_map
+        return tree_map(jnp.asarray, batch)
     return jax.device_get(batch)
 
 

@@ -151,13 +151,14 @@ class FusedCollectExec(PhysicalPlan):
 
         # learn the result-tree structure without executing
         fin_sd, ng_sd = jax.eval_shape(tail_body, batch)
-        leaves_sd, treedef = jax.tree.flatten(fin_sd)
+        from ...shims import tree_flatten
+        leaves_sd, treedef = tree_flatten(fin_sd)
         sig = tuple((tuple(sd.shape), str(sd.dtype)) for sd in leaves_sd)
         sig = sig + ((tuple(ng_sd.shape), str(ng_sd.dtype)),)
 
         def full(b):
             fin, ng = tail_body(b)
-            leaves = jax.tree.flatten(fin)[0] + [ng]
+            leaves = tree_flatten(fin)[0] + [ng]
             return pack_leaves_traced(leaves, sig)
 
         fn = cached_jit(key, full)
@@ -299,8 +300,8 @@ class FusedCollectExec(PhysicalPlan):
                 return  # wrong result discarded; session re-runs
         STATS["fused_collects"] += 1
         tctx.inc_metric("fusedCollects")
-        import jax
-        out = jax.tree.unflatten(treedef, leaves[:-1])
+        from ...shims import tree_unflatten
+        out = tree_unflatten(treedef, leaves[:-1])
         tctx.inc_metric("d2h_bytes", batch_nbytes(out))
         rows_out = (min(ng_host, int(self._topn.n))
                     if self._topn is not None else ng_host)

@@ -37,11 +37,12 @@ class HostToDeviceExec(PhysicalPlan):
         return self.children[0].output
 
     def execute(self, pid, tctx):
-        import jax
         import jax.numpy as jnp
+
+        from ...shims import tree_map
         for batch in self.children[0].execute(pid, tctx):
             tctx.inc_metric("h2d_bytes", batch_nbytes(batch))
-            yield jax.tree.map(jnp.asarray, batch)
+            yield tree_map(jnp.asarray, batch)
 
     def node_name(self):
         return "HostToDevice"

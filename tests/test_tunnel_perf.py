@@ -181,6 +181,9 @@ class TestOomSyncPolicy:
         import spark_rapids_tpu.memory.oom_guard as G
         from spark_rapids_tpu.config import RapidsConf
         RapidsConf.get_global()
+        # an OOM-injecting test earlier in the session may have armed the
+        # defensive eager-sync window; this test asserts the IDLE policy
+        G._defensive_until = 0.0
         before = dict(G.STATS)
         wrapped = G.guard_device_oom(lambda: np.float32(1.0))
         wrapped()
