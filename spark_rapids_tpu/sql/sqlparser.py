@@ -1477,10 +1477,9 @@ class QueryBuilder:
                     if e2.collect(lambda n: isinstance(n, UnresolvedQualified)
                                   and n.qualifier.lower()
                                   not in inner_aliases):
-                        raise SqlParseError(
-                            "correlated scalar subqueries are not "
-                            "supported — rewrite as a join or a "
-                            "correlated EXISTS")
+                        # correlated: leave the node for the decorrelation
+                        # pass in _build_select (grouped-agg LEFT JOIN)
+                        return None
             inner = self._build_sub(x.stmt, ctes)
             if len(inner._plan.output) != 1:
                 raise SqlParseError(
@@ -1549,6 +1548,139 @@ class QueryBuilder:
                 out.add(r.alias.lower())
         return out
 
+    def _split_correlation(self, q, what: str):
+        """Split a subquery's WHERE into ([(outer_expr, inner_expr)],
+        [inner-only conjuncts]) — the decorrelation shared by correlated
+        EXISTS and correlated scalar subqueries (Spark's
+        RewriteCorrelatedScalarSubquery / RewritePredicateSubquery)."""
+        from .expressions import predicates as PR
+        inner_aliases = self._relation_aliases(q)
+
+        def outer_quals(e):
+            return e.collect(
+                lambda x: isinstance(x, UnresolvedQualified)
+                and x.qualifier.lower() not in inner_aliases)
+
+        corr_pairs = []
+        inner_conj = []
+        if isinstance(q, SelectStmt) and q.where is not None:
+            for c in _split_and(q.where):
+                oq = outer_quals(c)
+                if not oq:
+                    inner_conj.append(c)
+                    continue
+                if not isinstance(c, PR.EqualTo):
+                    raise SqlParseError(
+                        f"{what} supports only AND-connected "
+                        f"equality predicates, got {c.sql()!r}")
+                a, b = c.children
+                if outer_quals(a) and not outer_quals(b):
+                    corr_pairs.append((a, b))
+                elif outer_quals(b) and not outer_quals(a):
+                    corr_pairs.append((b, a))
+                else:
+                    raise SqlParseError(
+                        f"{what} equality must compare an outer "
+                        f"expression to an inner one: {c.sql()!r}")
+        return corr_pairs, inner_conj
+
+    def _decorrelate_scalar_subqueries(self, df, stmt: "SelectStmt",
+                                       scope, ctes):
+        """Rewrite correlated scalar subqueries in the WHERE clause and
+        SELECT list into a grouped-aggregate LEFT JOIN (TPC-H q2/q17
+        shape: ``v < (SELECT avg(x) FROM t2 WHERE t2.k = outer.k)``).
+        The aggregate-without-GROUP-BY requirement guarantees at most one
+        row per correlation key, so the join cannot duplicate outer rows.
+        Returns (joined df, stmt with the subquery nodes substituted)."""
+        import dataclasses
+
+        from .dataframe import Column
+        from .expressions import predicates as PR
+        from .expressions.conditional import Coalesce
+
+        subs = []
+        for e in ([it.expr for it in stmt.items
+                   if isinstance(it.expr, Expression)]
+                  + ([stmt.where] if stmt.where is not None else [])):
+            subs.extend(e.collect(
+                lambda x: isinstance(x, ScalarSubquery)))
+        replacements = {}
+        for sq in subs:
+            if id(sq) in replacements:
+                continue
+            q = sq.stmt
+            if not isinstance(q, SelectStmt):
+                raise SqlParseError(
+                    "correlated scalar subquery must be a simple SELECT")
+            corr_pairs, inner_conj = self._split_correlation(
+                q, "correlated scalar subquery")
+            if not corr_pairs:
+                # the evaluation pass only leaves a node here when it saw
+                # outer references SOMEWHERE (items/where/having); with no
+                # WHERE equality to decorrelate on, reject cleanly
+                raise SqlParseError(
+                    "correlated scalar subquery must correlate through "
+                    "AND-connected equality predicates in its WHERE "
+                    "clause (correlation in the SELECT list or HAVING "
+                    "has no join rewrite)")
+            if len(q.items) != 1 or isinstance(q.items[0].expr, Star):
+                raise SqlParseError(
+                    "scalar subquery must select exactly one expression")
+            item = q.items[0].expr
+            if not _has_agg(item):
+                raise SqlParseError(
+                    "correlated scalar subquery must be an aggregate "
+                    "(that is what guarantees one value per outer row); "
+                    "rewrite other shapes as a join")
+            if q.group_by or q.group_by_mode or q.having is not None \
+                    or q.limit is not None or q.offset:
+                raise SqlParseError(
+                    "correlated scalar subquery supports a single "
+                    "aggregate over AND-connected equality correlation "
+                    "only (no GROUP BY/HAVING/LIMIT)")
+            is_count = _count_only_agg(item)
+            if _has_count(item) and not is_count:
+                raise SqlParseError(
+                    "COUNT inside a compound correlated scalar subquery "
+                    "is not supported (empty groups would need per-outer-"
+                    "row evaluation); use a plain count(...) subquery")
+            key_items = [SelectItem(ie, f"__ck{i}")
+                         for i, (_, ie) in enumerate(corr_pairs)]
+            q2 = dataclasses.replace(
+                q, where=_and_all(inner_conj),
+                items=key_items + [SelectItem(item, "__sval")],
+                group_by=[ie for _, ie in corr_pairs],
+                order_by=[], distinct=False, limit=None, offset=None)
+            inner = self._fresh(self._build_sub(q2, ctes))
+            out = inner._plan.output
+            keys, val = out[:len(corr_pairs)], out[len(corr_pairs)]
+            cond = None
+            for (oe, _), k in zip(corr_pairs, keys):
+                o = _resolve_or_err(self._bind_quals(oe, scope), df._plan)
+                term = PR.EqualTo(o, k)
+                cond = term if cond is None else PR.And(cond, term)
+            df = df.join(inner, on=Column(cond), how="left")
+            rep: Expression = val
+            if is_count:
+                # the COUNT bug: an empty correlation group has no row in
+                # the grouped subquery, but count() over it must be 0
+                rep = Coalesce(val, Literal(0))
+            replacements[id(sq)] = rep
+        if not replacements:
+            return df, stmt
+
+        def repl(x):
+            return replacements.get(id(x))
+
+        stmt = dataclasses.replace(
+            stmt,
+            items=[SelectItem(it.expr if isinstance(it.expr, Star)
+                              else it.expr.transform(repl), it.alias)
+                   for it in stmt.items],
+            where=(stmt.where.transform(repl)
+                   if stmt.where is not None else None))
+        return df, stmt
+
     def _apply_subquery_predicate(self, df, pred, negated: bool,
                                   scope, ctes):
         """Rewrite one EXISTS/IN subquery predicate into a semi/anti join
@@ -1582,34 +1714,8 @@ class QueryBuilder:
         # EXISTS: extract equality correlation (inner.col = outer.col via
         # outer-alias-qualified references) into join keys
         q = pred.stmt
-        inner_aliases = self._relation_aliases(q)
-
-        def outer_quals(e):
-            return e.collect(
-                lambda x: isinstance(x, UnresolvedQualified)
-                and x.qualifier.lower() not in inner_aliases)
-
-        corr_pairs = []
-        inner_conj = []
-        if isinstance(q, SelectStmt) and q.where is not None:
-            for c in _split_and(q.where):
-                oq = outer_quals(c)
-                if not oq:
-                    inner_conj.append(c)
-                    continue
-                if not isinstance(c, PR.EqualTo):
-                    raise SqlParseError(
-                        "correlated EXISTS supports only AND-connected "
-                        f"equality predicates, got {c.sql()!r}")
-                a, b = c.children
-                if outer_quals(a) and not outer_quals(b):
-                    corr_pairs.append((a, b))
-                elif outer_quals(b) and not outer_quals(a):
-                    corr_pairs.append((b, a))
-                else:
-                    raise SqlParseError(
-                        "correlated EXISTS equality must compare an outer "
-                        f"expression to an inner one: {c.sql()!r}")
+        corr_pairs, inner_conj = self._split_correlation(
+            q, "correlated EXISTS")
         if corr_pairs:
             import dataclasses
             if q.group_by or q.having is not None or q.group_by_mode:
@@ -1687,6 +1793,20 @@ class QueryBuilder:
                         raise SqlParseError(
                             f"{step.how} join requires ON or USING")
                     df = df.crossJoin(rdf)
+
+        df, stmt = self._decorrelate_scalar_subqueries(df, stmt, scope,
+                                                       ctes)
+        for slot, e in ([("HAVING", stmt.having)]
+                        + [("GROUP BY", g) for g in stmt.group_by]
+                        + [("join condition", j.on) for j in stmt.joins]
+                        + [("GROUPING SETS", g)
+                           for sset in stmt.grouping_sets_raw for g in sset]
+                        + [("ORDER BY", oi.expr) for oi in stmt.order_by]):
+            if isinstance(e, Expression) and e.collect(
+                    lambda x: isinstance(x, ScalarSubquery)):
+                raise SqlParseError(
+                    "correlated scalar subqueries are only supported in "
+                    f"the WHERE clause and SELECT list (found in {slot})")
 
         if stmt.where is not None:
             cond = self._bind_quals(stmt.where, scope)
@@ -2154,6 +2274,21 @@ def _has_agg(e: Expression) -> bool:
     if isinstance(e, (AggregateFunction, AggregateExpression)):
         return True
     return any(_has_agg(c) for c in e.children)
+
+
+def _has_count(e: Expression) -> bool:
+    from .expressions.aggregates import Count
+    return bool(e.collect(lambda n: isinstance(n, Count)))
+
+
+def _count_only_agg(e: Expression) -> bool:
+    """e IS a bare count aggregate (possibly wrapped in the
+    AggregateExpression distinct marker) — the shape whose empty-group
+    result must be 0, not NULL, after decorrelation."""
+    from .expressions.aggregates import AggregateExpression, Count
+    if isinstance(e, Count):
+        return True
+    return isinstance(e, AggregateExpression) and isinstance(e.func, Count)
 
 
 def _auto_name(raw: Expression, resolved: Expression) -> str:

@@ -438,6 +438,25 @@ def _tpch_q6_sql(sess, t, F):
     _q6_oracle_check(got, t["lineitem"])
 
 
+def _tpch_q17_sql(sess, t, F):
+    """TPC-H q17 shape: correlated scalar subquery (avg quantity per
+    part) decorrelated into a grouped-agg LEFT JOIN, pandas-checked."""
+    li = t["lineitem"]
+    sess.create_dataframe(li, num_partitions=4) \
+        .createOrReplaceTempView("lineitem")
+    got = sess.sql(
+        "SELECT sum(l.l_extendedprice) / 7.0 AS avg_yearly "
+        "FROM lineitem l "
+        "WHERE l.l_quantity < (SELECT 0.2 * avg(l2.l_quantity) "
+        "FROM lineitem l2 WHERE l2.l_partkey = l.l_partkey)"
+    ).collect().to_pylist()[0]["avg_yearly"]
+    pdf = li.to_pandas()
+    th = pdf.groupby("l_partkey").l_quantity.mean() * 0.2
+    exp = pdf[pdf.l_quantity < pdf.l_partkey.map(th)] \
+        .l_extendedprice.sum() / 7.0
+    assert abs(got - exp) <= 1e-9 * max(abs(exp), 1.0), (got, exp)
+
+
 def build_tpcds_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
     """store_sales star schema subset for the hash-join-heavy TPC-DS
     milestone queries (BASELINE config 3: q3/q7/q19/q42 shapes)."""
@@ -654,6 +673,7 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("tpch_q4_sql_exists", _tpch_q4_sql),
     ("tpch_q22_sql_subqueries", _tpch_q22_sql),
     ("tpch_q6_sql", _tpch_q6_sql),
+    ("tpch_q17_corr_scalar", _tpch_q17_sql),
     ("tpcds_q3_star_join", _tpcds_q3),
     ("tpcds_q7_star4_avgs", _tpcds_q7),
     ("tpcds_q19_brand_rev", _tpcds_q19),

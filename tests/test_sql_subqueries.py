@@ -162,9 +162,62 @@ def test_correlated_scalar_and_grouping_sets_guards(rig):
     sess.create_dataframe(pa.table(
         {"ik": pa.array([1, 2], type=pa.int64()), "iv": [5.0, 6.0]})
     ).createOrReplaceTempView("sq_in2")
-    with pytest.raises(ValueError, match="correlated scalar"):
-        sess.sql("SELECT k FROM sq_out WHERE v > (SELECT max(iv) FROM "
-                 "sq_in2 WHERE sq_in2.ik = sq_out.k)").collect()
+    # round 3: this shape decorrelates into a grouped-agg LEFT JOIN
+    out = sess.sql("SELECT k FROM sq_out WHERE v > (SELECT max(iv) FROM "
+                   "sq_in2 WHERE sq_in2.ik = sq_out.k)").collect()
+    assert out.num_rows == 0  # v (1,2) never exceeds max(iv) (5,6)
     with pytest.raises(ValueError, match="not supported in the"):
         sess.sql("SELECT count(*) FROM sq_out GROUP BY GROUPING SETS "
                  "((k), (EXISTS(SELECT 1 FROM sq_in2)))").collect()
+
+
+# --- correlated scalar subqueries (RewriteCorrelatedScalarSubquery) --------
+
+def test_correlated_scalar_avg_in_where(session):
+    """TPC-H q17 shape: v < (SELECT 0.2*avg(x) FROM t2 WHERE t2.k = t.k)."""
+    rng = np.random.default_rng(3)
+    n = 20_000
+    li = pa.table({"partkey": rng.integers(0, 200, n),
+                   "quantity": rng.integers(1, 50, n).astype(np.float64),
+                   "price": rng.random(n) * 100})
+    session.create_dataframe(li, num_partitions=3) \
+        .createOrReplaceTempView("li17")
+    got = session.sql(
+        "SELECT sum(l.price) AS rev FROM li17 l "
+        "WHERE l.quantity < (SELECT 0.2 * avg(l2.quantity) FROM li17 l2 "
+        "WHERE l2.partkey = l.partkey)").collect().to_pylist()[0]["rev"]
+    pdf = li.to_pandas()
+    th = pdf.groupby("partkey").quantity.mean() * 0.2
+    exp = pdf[pdf.quantity < pdf.partkey.map(th)].price.sum()
+    assert abs(got - exp) < 1e-6 * max(abs(exp), 1)
+
+
+def test_correlated_scalar_in_select_list_and_count_bug(session):
+    session.create_dataframe(pa.table({"k": [1, 2, 3], "v": [10., 20., 30.]})
+                           ).createOrReplaceTempView("ca")
+    session.create_dataframe(pa.table({"k": [1, 1, 2], "w": [5., 7., 9.]})
+                           ).createOrReplaceTempView("cb")
+    out = session.sql(
+        "SELECT ca.k, (SELECT count(*) FROM cb WHERE cb.k = ca.k) AS c, "
+        "(SELECT sum(cb.w) FROM cb WHERE cb.k = ca.k) AS s "
+        "FROM ca ORDER BY ca.k").collect().to_pylist()
+    # k=3 has NO rows in cb: count must be 0 (the COUNT bug), sum NULL
+    assert out == [{"k": 1, "c": 2, "s": 12.0},
+                   {"k": 2, "c": 1, "s": 9.0},
+                   {"k": 3, "c": 0, "s": None}]
+
+
+def test_correlated_scalar_rejects_unsupported_shapes(session):
+    session.create_dataframe(pa.table({"k": [1], "v": [1.0]})
+                           ).createOrReplaceTempView("cs1")
+    session.create_dataframe(pa.table({"k": [1], "w": [2.0]})
+                           ).createOrReplaceTempView("cs2")
+    with pytest.raises(Exception, match="must be an aggregate"):
+        session.sql("SELECT (SELECT cs2.w FROM cs2 WHERE cs2.k = cs1.k)"
+                    " FROM cs1").collect()
+    with pytest.raises(Exception, match="equality"):
+        session.sql("SELECT (SELECT max(cs2.w) FROM cs2 WHERE "
+                    "cs2.k > cs1.k) FROM cs1").collect()
+    with pytest.raises(Exception, match="compound"):
+        session.sql("SELECT (SELECT count(*) + 1 FROM cs2 WHERE cs2.k ="
+                    " cs1.k) FROM cs1").collect()
