@@ -42,6 +42,15 @@ def lex_sort(xp, keys):
     sort costs one O(n log n) pass instead of k chained argsorts — the
     difference between beating and trailing a host engine on group-by/sort
     heavy queries.  numpy path uses the equivalent ``np.lexsort``.
+
+    64-bit integer keys are split into (hi int32, lo uint32) comparator
+    pairs: under the TPU toolchain's x64 rewrite a 64-bit sort comparator
+    lowers poorly (docs/perf_notes.md round-3 note — the split measured
+    faster to compile and no slower to run), and the lexicographic order
+    of (hi, lo-as-unsigned) equals the 64-bit order exactly (same hi =>
+    two's-complement low words compare unsigned).  Sorted key values are
+    reconstructed from the sorted pairs, so callers see the same
+    (perm, sorted_keys) contract.
     """
     keys = list(keys)
     if xp.__name__ == "numpy":
@@ -50,9 +59,40 @@ def lex_sort(xp, keys):
     import jax
     n = keys[0].shape[0]
     iota = xp.arange(n, dtype=xp.int32)
-    out = jax.lax.sort(tuple(keys) + (iota,), num_keys=len(keys),
+    sort_keys = []
+    split = []  # per original key: False, or the signedness of the 64-bit
+    for k in keys:
+        if k.dtype == xp.int64:
+            sort_keys.append((k >> 32).astype(xp.int32))
+            sort_keys.append((k & 0xFFFFFFFF).astype(xp.uint32))
+            split.append("i")
+        elif k.dtype == xp.uint64:
+            sort_keys.append((k >> xp.uint64(32)).astype(xp.uint32))
+            sort_keys.append((k & xp.uint64(0xFFFFFFFF)).astype(xp.uint32))
+            split.append("u")
+        else:
+            sort_keys.append(k)
+            split.append(False)
+    out = jax.lax.sort(tuple(sort_keys) + (iota,), num_keys=len(sort_keys),
                        is_stable=True)
-    return out[-1], list(out[:-1])
+    perm = out[-1]
+    sorted_keys = []
+    idx = 0
+    for tag in split:
+        if tag == "i":
+            hi, lo = out[idx], out[idx + 1]
+            idx += 2
+            sorted_keys.append((hi.astype(xp.int64) << 32)
+                               | lo.astype(xp.int64))
+        elif tag == "u":
+            hi, lo = out[idx], out[idx + 1]
+            idx += 2
+            sorted_keys.append((hi.astype(xp.uint64) << xp.uint64(32))
+                               | lo.astype(xp.uint64))
+        else:
+            sorted_keys.append(out[idx])
+            idx += 1
+    return perm, sorted_keys
 
 
 def dense_rank_from_sorted(xp, sorted_boundary_flags):

@@ -1598,6 +1598,7 @@ class QueryBuilder:
         from .expressions import predicates as PR
         from .expressions.conditional import Coalesce
 
+        visible = list(df._plan.output)  # pre-join schema for SELECT *
         subs = []
         for e in ([it.expr for it in stmt.items
                    if isinstance(it.expr, Expression)]
@@ -1605,6 +1606,7 @@ class QueryBuilder:
             subs.extend(e.collect(
                 lambda x: isinstance(x, ScalarSubquery)))
         replacements = {}
+        by_semantic = {}  # ReuseSubquery: identical subqueries share a join
         for sq in subs:
             if id(sq) in replacements:
                 continue
@@ -1638,6 +1640,10 @@ class QueryBuilder:
                     "correlated scalar subquery supports a single "
                     "aggregate over AND-connected equality correlation "
                     "only (no GROUP BY/HAVING/LIMIT)")
+            sem = _subquery_semantic_key(q)
+            if sem is not None and sem in by_semantic:
+                replacements[id(sq)] = by_semantic[sem]
+                continue
             is_count = _count_only_agg(item)
             if _has_count(item) and not is_count:
                 raise SqlParseError(
@@ -1666,20 +1672,31 @@ class QueryBuilder:
                 # the grouped subquery, but count() over it must be 0
                 rep = Coalesce(val, Literal(0))
             replacements[id(sq)] = rep
+            if sem is not None:
+                by_semantic[sem] = rep
         if not replacements:
-            return df, stmt
+            return df, stmt, None
 
         def repl(x):
             return replacements.get(id(x))
 
+        def item_sub(it):
+            if isinstance(it.expr, Star):
+                return it
+            new = it.expr.transform(repl)
+            if it.alias is None and isinstance(it.expr, ScalarSubquery) \
+                    and new is not it.expr:
+                # Spark names an unaliased scalar subquery column
+                # scalarsubquery(); never leak the internal __sval name
+                new = Alias(new, "scalarsubquery()")
+            return SelectItem(new, it.alias)
+
         stmt = dataclasses.replace(
             stmt,
-            items=[SelectItem(it.expr if isinstance(it.expr, Star)
-                              else it.expr.transform(repl), it.alias)
-                   for it in stmt.items],
+            items=[item_sub(it) for it in stmt.items],
             where=(stmt.where.transform(repl)
                    if stmt.where is not None else None))
-        return df, stmt
+        return df, stmt, visible
 
     def _apply_subquery_predicate(self, df, pred, negated: bool,
                                   scope, ctes):
@@ -1771,6 +1788,13 @@ class QueryBuilder:
                 raise SqlParseError(
                     f"EXISTS/IN subqueries are not supported in the {slot}"
                     " — only as AND-connected WHERE predicates")
+        for j in stmt.joins:
+            if isinstance(j.on, Expression) and j.on.collect(
+                    lambda x: isinstance(x, ScalarSubquery)):
+                raise SqlParseError(
+                    "correlated scalar subqueries are only supported in "
+                    "the WHERE clause and SELECT list (found in join "
+                    "condition)")
         scope: Dict[str, Any] = {}      # alias -> DataFrame
         if stmt.from_ is None:
             df = self.session.range(1)
@@ -1794,8 +1818,8 @@ class QueryBuilder:
                             f"{step.how} join requires ON or USING")
                     df = df.crossJoin(rdf)
 
-        df, stmt = self._decorrelate_scalar_subqueries(df, stmt, scope,
-                                                       ctes)
+        df, stmt, star_visible = self._decorrelate_scalar_subqueries(
+            df, stmt, scope, ctes)
         for slot, e in ([("HAVING", stmt.having)]
                         + [("GROUP BY", g) for g in stmt.group_by]
                         + [("join condition", j.on) for j in stmt.joins]
@@ -1837,7 +1861,10 @@ class QueryBuilder:
                     for a in src._plan.output:
                         items.append((a.name, a))
                 else:
-                    for a in df._plan.output:
+                    # a decorrelation join widened df with internal
+                    # __ck*/__sval columns; * sees the pre-join schema
+                    for a in (star_visible if star_visible is not None
+                              else df._plan.output):
                         items.append((a.name, a))
                 continue
             e = self._bind_quals(it.expr, scope)
@@ -2274,6 +2301,26 @@ def _has_agg(e: Expression) -> bool:
     if isinstance(e, (AggregateFunction, AggregateExpression)):
         return True
     return any(_has_agg(c) for c in e.children)
+
+
+def _subquery_semantic_key(q):
+    """Hashable identity for a correlated scalar subquery over simple
+    table FROMs (ReuseSubquery analog); None = don't dedupe."""
+    rels = []
+    refs = ([q.from_] if q.from_ is not None else []) \
+        + [j.right for j in q.joins]
+    for r in refs:
+        if not isinstance(r, TableRef) or r.path is not None:
+            return None
+        rels.append((r.name.lower(), (r.alias or "").lower()))
+    try:
+        return (tuple(rels),
+                tuple(it.alias or "" for it in q.items),
+                tuple(it.expr.sql() for it in q.items
+                      if isinstance(it.expr, Expression)),
+                q.where.sql() if q.where is not None else "")
+    except Exception:
+        return None
 
 
 def _has_count(e: Expression) -> bool:

@@ -221,3 +221,30 @@ def test_correlated_scalar_rejects_unsupported_shapes(session):
     with pytest.raises(Exception, match="compound"):
         session.sql("SELECT (SELECT count(*) + 1 FROM cs2 WHERE cs2.k ="
                     " cs1.k) FROM cs1").collect()
+
+
+def test_correlated_scalar_star_and_naming_and_dedup(session):
+    """SELECT * must not leak the decorrelation join's internal columns;
+    an unaliased subquery column is named scalarsubquery(); identical
+    subqueries share one join (ReuseSubquery analog)."""
+    session.create_dataframe(pa.table({"k": [1, 2, 3], "v": [10., 20., 30.]})
+                             ).createOrReplaceTempView("da")
+    session.create_dataframe(pa.table({"k": [1, 1, 2], "w": [5., 7., 9.]})
+                             ).createOrReplaceTempView("db")
+    out = session.sql(
+        "SELECT * FROM da WHERE da.v > "
+        "(SELECT sum(db.w) FROM db WHERE db.k = da.k)").collect()
+    assert out.column_names == ["k", "v"]
+    out2 = session.sql(
+        "SELECT (SELECT max(db.w) FROM db WHERE db.k = da.k) FROM da"
+    ).collect()
+    assert out2.column_names == ["scalarsubquery()"]
+    out3 = session.sql(
+        "SELECT da.k, (SELECT sum(db.w) FROM db WHERE db.k = da.k) AS s "
+        "FROM da WHERE (SELECT sum(db.w) FROM db WHERE db.k = da.k) > 10 "
+        "ORDER BY da.k").collect().to_pylist()
+    assert out3 == [{"k": 1, "s": 12.0}]
+    with pytest.raises(ValueError, match="join condition"):
+        session.sql(
+            "SELECT da.k FROM da JOIN db ON da.v = "
+            "(SELECT avg(db.w) FROM db WHERE db.k = da.k)").collect()
