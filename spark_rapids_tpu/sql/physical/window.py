@@ -384,10 +384,37 @@ class WindowExec(PhysicalPlan):
         carry: List[SpillableColumnarBatch] = []
         carry_rows = 0
 
+        def split_at_partition(sb):
+            """SplitAndRetryOOM handler: a head batch holds WHOLE window
+            partitions, so cutting at an interior partition boundary
+            halves the work without breaking any frame (row-halving, the
+            generic splitter, would).  A single-partition head cannot
+            split — spill everything and requeue it for a plain retry
+            (split_spillable_in_half's unsplittable convention; bounded
+            by the retry cap)."""
+            b = sb.get()
+            m = b.num_rows_int
+            last_le, first_gt = boundary(b, np.int32(max(m // 2 - 1, 0)))
+            cut = int(last_le)
+            if cut <= 0:
+                # a hot partition spans past the midpoint: cut right
+                # after it instead (same fallback emit_chunks uses)
+                cut = int(first_gt)
+            if cut <= 0 or cut >= m:
+                sb.catalog.spill_all_device()
+                return [sb]
+            out = [SpillableColumnarBatch.create(
+                       b.sliced(0, cut), ACTIVE_ON_DECK_PRIORITY),
+                   SpillableColumnarBatch.create(
+                       b.sliced(cut, m - cut), ACTIVE_ON_DECK_PRIORITY)]
+            sb.close()
+            return out
+
         def process(head):
             sb = SpillableColumnarBatch.create(head,
                                                ACTIVE_ON_DECK_PRIORITY)
-            return with_retry([sb], lambda s: self._fn(s.get()))
+            return with_retry([sb], lambda s: self._fn(s.get()),
+                              split=split_at_partition)
 
         def emit_chunks(final: bool):
             nonlocal carry, carry_rows

@@ -2315,6 +2315,10 @@ def _subquery_semantic_key(q):
         rels.append((r.name.lower(), (r.alias or "").lower()))
     try:
         return (tuple(rels),
+                tuple((j.how,
+                       j.on.sql() if isinstance(j.on, Expression) else "",
+                       tuple(j.using or ()))
+                      for j in q.joins),
                 tuple(it.alias or "" for it in q.items),
                 tuple(it.expr.sql() for it in q.items
                       if isinstance(it.expr, Expression)),

@@ -658,6 +658,46 @@ def _tpcds_q42(sess, t, F):
     assert np.allclose(got["total"], exp["total"])
 
 
+def _tpcds_q89_window(sess, t, F):
+    """TPC-DS q89 shape: monthly category revenue ranked by a window over
+    the star join (avg over the category partition; rows where the month
+    deviates most from the category average) — the window-over-join shape
+    the per-table micro queries don't cover."""
+    from ..sql.window_api import Window
+    ss = sess.create_dataframe(t["store_sales"], num_partitions=4)
+    dd = sess.create_dataframe(t["date_dim"], num_partitions=2)
+    it = sess.create_dataframe(t["item"], num_partitions=2)
+    monthly = (dd.join(ss, ss.ss_sold_date_sk == dd.d_date_sk)
+               .join(it, ss.ss_item_sk == it.i_item_sk)
+               .filter(dd.d_year == 2000)
+               .groupBy("i_category_id", "d_moy")
+               .agg(F.sum(F.col("ss_ext_sales_price")).alias("rev")))
+    w = Window.partitionBy("i_category_id")
+    got = (monthly
+           .withColumn("avg_rev", F.avg(F.col("rev")).over(w))
+           .filter(F.col("rev") > F.col("avg_rev"))
+           .orderBy("i_category_id", "d_moy")
+           .collect().to_pandas())
+    pdf = (t["store_sales"].to_pandas()
+           .merge(t["date_dim"].to_pandas(), left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+           .merge(t["item"].to_pandas(), left_on="ss_item_sk",
+                  right_on="i_item_sk"))
+    pdf = pdf[pdf.d_year == 2000]
+    m = (pdf.groupby(["i_category_id", "d_moy"])
+         .agg(rev=("ss_ext_sales_price", "sum")).reset_index())
+    m["avg_rev"] = m.groupby("i_category_id").rev.transform("mean")
+    exp = (m[m.rev > m.avg_rev]
+           .sort_values(["i_category_id", "d_moy"])
+           .reset_index(drop=True))
+    assert len(got) == len(exp)
+    assert np.array_equal(got["i_category_id"], exp["i_category_id"])
+    assert np.array_equal(got["d_moy"], exp["d_moy"])
+    assert np.allclose(got["rev"], exp["rev"])
+    assert np.allclose(got["avg_rev"], exp["avg_rev"])
+
+
+
 QUERIES: List[Tuple[str, Callable]] = [
     ("q1_filter_agg", _q1),
     ("q2_join_agg", _q2),
@@ -678,6 +718,7 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("tpcds_q7_star4_avgs", _tpcds_q7),
     ("tpcds_q19_brand_rev", _tpcds_q19),
     ("tpcds_q42_cat_rev", _tpcds_q42),
+    ("tpcds_q89_window_join", _tpcds_q89_window),
 ]
 
 #: table-set builders per query prefix (run_suite routes each query to

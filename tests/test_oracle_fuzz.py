@@ -342,3 +342,39 @@ def test_tdigest_percentile_vs_pandas_quantiles(sess, data):
         assert checked > 10
     finally:
         sess.conf.set("spark.rapids.sql.approxPercentile.strategy", "auto")
+
+
+def test_window_functions_vs_pandas(sess, data):
+    """Window functions over generated data under OOM injection:
+    row_number / whole-partition avg / lag, vs pandas oracles."""
+    from spark_rapids_tpu.sql.window_api import Window
+    df = _df(sess, data)
+    w = Window.partitionBy("g").orderBy("i", "l")
+    wp = Window.partitionBy("g")
+    got = (df.filter(df.i.isNotNull() & df.l.isNotNull())
+           .select(df.g, df.i, df.l, df.d,
+                   F.row_number().over(w).alias("rn"),
+                   F.avg(df.d).over(wp).alias("ga"),
+                   F.lag(df.i, 1).over(w).alias("pi"))
+           .collect().to_pandas()
+           .sort_values(["g", "i", "l"]).reset_index(drop=True))
+    pdf = data.to_pandas()
+    pdf = pdf[pdf.i.notna() & pdf.l.notna()].copy()
+    pdf = pdf.sort_values(["g", "i", "l"], kind="stable")
+    pdf["rn"] = pdf.groupby("g").cumcount() + 1
+    pdf["ga"] = pdf.groupby("g").d.transform("mean")
+    pdf["pi"] = pdf.groupby("g").i.shift(1)
+    exp = pdf.reset_index(drop=True)
+    assert len(got) == len(exp)
+    assert np.array_equal(got["g"].values, exp["g"].values)
+    # ties on (i, l) make rn order-dependent; per group the rank SET must
+    # still be exactly 1..n
+    for gi in got["g"].unique()[:30]:
+        rn = np.sort(got[got.g == gi].rn.values)
+        assert np.array_equal(rn, np.arange(1, len(rn) + 1)), gi
+    assert np.allclose(got["ga"].values, exp["ga"].values)
+    # lag: compare the multiset per group (tie order may differ)
+    for gi in got["g"].unique()[:25]:
+        a = sorted(got[got.g == gi].pi.dropna().values.tolist())
+        b = sorted(exp[exp.g == gi].pi.dropna().values.tolist())
+        assert a == b, gi
