@@ -102,7 +102,13 @@ class DeltaTable:
         return dt
 
     # --- read side ----------------------------------------------------------
-    def toDF(self, version: Optional[int] = None):
+    def toDF(self, version: Optional[int] = None,
+             timestamp_ms: Optional[int] = None):
+        if timestamp_ms is not None:
+            if version is not None:
+                raise ValueError(
+                    "specify versionAsOf OR timestampAsOf, not both")
+            version = self.log.version_as_of_timestamp(int(timestamp_ms))
         snap = self.log.snapshot(version)
         adds = [snap.files[p] for p in snap.file_paths]
         paths = [os.path.join(self.path, p) for p in snap.file_paths]

@@ -314,6 +314,40 @@ class DeltaLog:
         raise ConcurrentModificationException(
             f"could not commit after {max_retries} attempts")
 
+    def _commit_timestamp(self, v: int):
+        """First commitInfo timestamp of a version, scanning line by line
+        (no need to parse every add/remove of a large commit); None when
+        the commit carries no commitInfo (optional in the protocol)."""
+        with open(self._version_file(v)) as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                action = json.loads(line)
+                if "commitInfo" in action:
+                    return action["commitInfo"].get("timestamp")
+        return None
+
+    def version_as_of_timestamp(self, ts_ms: int) -> int:
+        """Latest version whose commit timestamp is <= ts_ms (Spark's
+        ``timestampAsOf``).  Commit timestamps are ADJUSTED to be
+        monotonically non-decreasing first — the protocol does not
+        guarantee ordering across writers/clock skew, and Delta applies
+        the same adjustment before searching.  Raises like Delta when
+        the timestamp precedes the table's first (adjusted) commit."""
+        best = None
+        prev = 0
+        for v in self.versions():
+            t = self._commit_timestamp(v)
+            t = prev if t is None else max(int(t), prev)
+            prev = t
+            if t <= ts_ms:
+                best = v
+        if best is None:
+            raise ValueError(
+                f"timestamp {ts_ms} is before the earliest commit of "
+                f"{self.table_path}")
+        return best
+
     # --- history -----------------------------------------------------------
     def history(self) -> List[dict]:
         out = []

@@ -260,9 +260,12 @@ class DataFrameReader:
                 if fmt == "delta":
                     from ..delta import DeltaTable
                     version = reader._options.get("versionAsOf")
+                    ts = reader._options.get("timestampAsOf")
                     dt = DeltaTable.forPath(reader._session, paths[0])
-                    return dt.toDF(int(version)
-                                   if version is not None else None)
+                    return dt.toDF(
+                        int(version) if version is not None else None,
+                        timestamp_ms=_parse_ts_ms(ts, reader._session)
+                        if ts is not None else None)
                 if fmt == "iceberg":
                     from ..iceberg import IcebergTable
                     it = IcebergTable.for_path(reader._session, paths[0])
@@ -274,6 +277,34 @@ class DataFrameReader:
                         else None)
                 return reader._scan(fmt, list(paths))
         return _F()
+
+
+def _parse_ts_ms(ts, session=None) -> int:
+    """timestampAsOf accepts epoch millis or 'YYYY-MM-DD[ HH:MM:SS]'
+    strings.  Date strings parse in the SESSION timezone like Spark
+    (spark.sql.session.timeZone), not hardcoded UTC."""
+    if isinstance(ts, (int, float)):
+        return int(ts)
+    import datetime as _dt
+    s = str(ts).strip()
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    tz = _dt.timezone.utc
+    if session is not None:
+        from ..config import SESSION_TIMEZONE
+        name = str(session._conf.get(SESSION_TIMEZONE))
+        if name and name.upper() != "UTC":
+            from zoneinfo import ZoneInfo
+            tz = ZoneInfo(name)
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            d = _dt.datetime.strptime(s, fmt)
+            return int(d.replace(tzinfo=tz).timestamp() * 1000)
+        except ValueError:
+            continue
+    raise ValueError(f"cannot parse timestampAsOf value {ts!r}")
 
 
 def _to_arrow_table(data, schema) -> pa.Table:

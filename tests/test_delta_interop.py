@@ -138,3 +138,59 @@ def test_foreign_checkpoint_layout_detected(sess, tmp_path):
         _json.dump({"version": v, "size": 2}, fh)
     got = DeltaTable.forPath(sess, work).toDF().collect().to_pandas()
     assert sorted(got["id"]) == [1, 2, 3, 6]
+
+
+def test_timestamp_as_of_time_travel(sess, tmp_path):
+    """timestampAsOf resolves the latest commit at-or-before the given
+    time (Spark's rule); earlier-than-first-commit errors like Delta."""
+    import json as _json
+    import shutil
+    work = str(tmp_path / "people")
+    shutil.copytree(os.path.join(GOLDEN, "people"), work)
+    # give the three commits distinct, known timestamps
+    logd = os.path.join(work, "_delta_log")
+    for v, ts in [(0, 1_000_000), (1, 2_000_000), (2, 3_000_000)]:
+        p = os.path.join(logd, f"{v:020d}.json")
+        lines = [_json.loads(ln) for ln in open(p)]
+        for a in lines:
+            if "commitInfo" in a:
+                a["commitInfo"]["timestamp"] = ts
+        with open(p, "w") as fh:
+            for a in lines:
+                fh.write(_json.dumps(a) + "\n")
+    t = DeltaTable.forPath(sess, work)
+    assert t.toDF(timestamp_ms=1_500_000).count() == 5   # v0
+    assert t.toDF(timestamp_ms=2_000_000).count() == 7   # v1 (inclusive)
+    assert t.toDF(timestamp_ms=9_999_999).count() == 4   # v2 (latest)
+    with pytest.raises(ValueError, match="before the earliest"):
+        t.toDF(timestamp_ms=999)
+    # reader-option surface, date-string form (far future => latest)
+    df = (sess.read.format("delta").option("timestampAsOf", "2030-01-01")
+          .load(work))
+    assert df.count() == 4
+    with pytest.raises(ValueError, match="not both"):
+        t.toDF(version=1, timestamp_ms=2_000_000)
+
+
+def test_timestamp_as_of_monotonic_adjustment(sess, tmp_path):
+    """Out-of-order commit timestamps (clock skew) and commitInfo-less
+    commits: timestamps adjust to be non-decreasing before the search,
+    like Delta."""
+    import json as _json
+    import shutil
+    work = str(tmp_path / "people")
+    shutil.copytree(os.path.join(GOLDEN, "people"), work)
+    logd = os.path.join(work, "_delta_log")
+    # v0: 1000, v1: 3000, v2: 2000 (skewed) -> adjusted [1000, 3000, 3000]
+    for v, ts in [(0, 1000), (1, 3000), (2, 2000)]:
+        p = os.path.join(logd, f"{v:020d}.json")
+        lines = [_json.loads(ln) for ln in open(p)]
+        for a in lines:
+            if "commitInfo" in a:
+                a["commitInfo"]["timestamp"] = ts
+        with open(p, "w") as fh:
+            for a in lines:
+                fh.write(_json.dumps(a) + "\n")
+    t = DeltaTable.forPath(sess, work)
+    assert t.toDF(timestamp_ms=2500).count() == 5   # v0 only (v1 adj 3000)
+    assert t.toDF(timestamp_ms=3000).count() == 4   # v2 (adjusted 3000)
