@@ -33,7 +33,7 @@ import pytest  # noqa: E402
 #: full matrix runs in ci/run_ci.sh.
 QUICK_MODULES = {
     "test_columnar", "test_expressions", "test_sql", "test_joins",
-    "test_memory", "test_native",
+    "test_memory", "test_native", "test_cross_slice", "test_hive_udf",
 }
 
 
