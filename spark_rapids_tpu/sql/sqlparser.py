@@ -1053,7 +1053,24 @@ class Parser:
     def parse_statement(self):
         # DDL: CREATE [OR REPLACE] TEMPORARY FUNCTION f AS 'module.Class'
         # (the exact shape Spark uses to register Hive UDFs) / DROP
-        # TEMPORARY FUNCTION [IF EXISTS] f
+        # TEMPORARY FUNCTION [IF EXISTS] f / SHOW TABLES /
+        # DESCRIBE [TABLE] name
+        if self.at_kw("SHOW"):
+            save = self.i
+            self.next()
+            if self.accept_kw("TABLES") and self.peek().kind == "eof":
+                return ShowTablesStmt()
+            self.i = save
+        if self.at_kw("DESCRIBE", "DESC"):
+            save = self.i
+            self.next()
+            self.accept_kw("TABLE")
+            t = self.peek()
+            if t.kind in ("ident", "qident"):
+                name = self.expect_ident()
+                if self.peek().kind == "eof":
+                    return DescribeTableStmt(name)
+            self.i = save
         if self.at_kw("CREATE") or self.at_kw("DROP"):
             save = self.i
             stmt = self._maybe_function_ddl()
@@ -1390,6 +1407,16 @@ class CreateFunctionStmt:
 class DropFunctionStmt:
     name: str
     if_exists: bool = False
+
+
+@dataclass
+class ShowTablesStmt:
+    pass
+
+
+@dataclass
+class DescribeTableStmt:
+    name: str
 
 
 class QueryBuilder:
@@ -2366,6 +2393,25 @@ def parse_query(session, sql: str):
                 and not stmt.if_exists:
             raise ValueError(f"function not found: {stmt.name}")
         return session.create_dataframe(_empty_ddl_result())
+    if isinstance(stmt, ShowTablesStmt):
+        import pyarrow as pa
+        names = sorted(session._temp_views)
+        return session.create_dataframe(pa.table({
+            "namespace": pa.array([""] * len(names), pa.string()),
+            "tableName": pa.array(names, pa.string()),
+            "isTemporary": pa.array([True] * len(names), pa.bool_()),
+        }))
+    if isinstance(stmt, DescribeTableStmt):
+        import pyarrow as pa
+        # session.table() is THE catalog resolution (same lookup, same
+        # error) — don't fork it here
+        attrs = session.table(stmt.name)._plan.output
+        return session.create_dataframe(pa.table({
+            "col_name": pa.array([a.name for a in attrs], pa.string()),
+            "data_type": pa.array([a.dtype.simple_string() for a in attrs],
+                                  pa.string()),
+            "comment": pa.array([None] * len(attrs), pa.string()),
+        }))
     return QueryBuilder(session).build(stmt)
 
 

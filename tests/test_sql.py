@@ -461,3 +461,14 @@ def test_string_literal_backslash_escapes(spark):
     assert [r["nl"] for r in out] == [False, False, True]
     assert out[0]["q"] == 4
     assert out[0]["pct"] == "p\\%q"
+
+
+def test_show_tables_and_describe(spark, t):
+    out = spark.sql("SHOW TABLES").collect().to_pylist()
+    assert any(r["tableName"] == "t" and r["isTemporary"] for r in out)
+    d = spark.sql("DESCRIBE TABLE t").collect().to_pylist()
+    assert [r["col_name"] for r in d] == ["k", "s", "v"]
+    assert [r["data_type"] for r in d] == ["int", "string", "double"]
+    assert spark.sql("DESC t").collect().num_rows == 3
+    with pytest.raises(ValueError, match="not found"):
+        spark.sql("DESCRIBE no_such_view").collect()
