@@ -82,6 +82,22 @@ def _coerce_pair(a: Expression, b: Expression) -> Tuple[Expression, Expression]:
     return a, b
 
 
+def _has_broadcast_hint(plan) -> bool:
+    """True when any node of the frame's plan tree carries the broadcast
+    marker (the hint survives transformations stacked above it)."""
+    seen = set()
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if getattr(n, "_broadcast_hint", False):
+            return True
+        stack.extend(n.children)
+    return False
+
+
 def _resolve_expr(e: Expression, plan: P.LogicalPlan) -> Expression:
     """Replace F.col() unresolved attributes with the plan's output attrs,
     then re-run binary type coercion bottom-up."""
@@ -617,6 +633,25 @@ class DataFrame:
 
     drop_duplicates = dropDuplicates
 
+    def hint(self, name: str, *params) -> "DataFrame":
+        """Join-strategy hints (pyspark parity).  "broadcast"/
+        "broadcastjoin"/"mapjoin" mark this frame as a broadcast build
+        side when it appears on the RIGHT of a join (the fact.join(
+        broadcast(dim)) pattern); the marker lives on the logical plan
+        node so select/filter/rename after the hint keep it (Spark's
+        ResolvedHint survives transformations the same way).  Other
+        hints are accepted and ignored like Spark ignores inapplicable
+        hints."""
+        if name.lower() in ("broadcast", "broadcastjoin", "mapjoin"):
+            # mark a FRESH pass-through Project (same attrs, same
+            # expr_ids) rather than the shared plan node — hinting one
+            # frame must not retroactively hint other frames built on
+            # the same node
+            marked = P.Project(tuple(self._plan.output), self._plan)
+            marked._broadcast_hint = True
+            return DataFrame(marked, self._session)
+        return self
+
     def repartition(self, n: int, *cols) -> "DataFrame":
         exprs = tuple(self._resolve(c) for c in cols)
         return DataFrame(P.Repartition(n, exprs, self._plan), self._session)
@@ -654,7 +689,8 @@ class DataFrame:
             joined = P.Join(self._plan, other._plan, "cross")
             resolved = _resolve_expr(on.expr, joined)
             lk, rk, cond = _extract_equi_keys(resolved, self._plan, other._plan)
-        j = P.Join(self._plan, other._plan, how, tuple(lk), tuple(rk), cond)
+        j = P.Join(self._plan, other._plan, how, tuple(lk), tuple(rk), cond,
+                   broadcast_hint=_has_broadcast_hint(other._plan))
         df = DataFrame(j, self._session)
         if drop_dup and how in ("inner", "left", "right", "full"):
             # USING-column semantics: single key column in output.  The

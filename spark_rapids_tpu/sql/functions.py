@@ -841,6 +841,12 @@ def input_file_block_length() -> Column:
     return Column(InputFileBlockLength())
 
 
+def broadcast(df):
+    """Mark a DataFrame as a broadcast join build side (pyspark
+    F.broadcast; honored when the frame is the right side of a join)."""
+    return df.hint("broadcast")
+
+
 def collect_list(c) -> Column:
     """Non-null values per group, insertion order."""
     return Column(AG.CollectList(_c(c)))

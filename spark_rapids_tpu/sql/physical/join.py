@@ -721,11 +721,13 @@ def plan_join(node, left: PhysicalPlan, right: PhysicalPlan, backend,
     from ...config import AUTO_BROADCAST_THRESHOLD
     threshold = int(conf.get(AUTO_BROADCAST_THRESHOLD))
     build_bytes = right.estimate_bytes()
+    hinted = bool(getattr(node, "broadcast_hint", False))
     can_broadcast = (how in ("inner", "left", "left_semi", "left_anti",
                              "existence")
-                     and build_bytes is not None
-                     and build_bytes <= threshold)
-    if can_broadcast and left.num_partitions() > 1:
+                     and (hinted
+                          or (build_bytes is not None
+                              and build_bytes <= threshold)))
+    if can_broadcast and (hinted or left.num_partitions() > 1):
         build = BroadcastExchangeExec(right, backend=backend)
         # dynamic partition pruning: a hive-partitioned probe scan joined
         # on its partition column skips files the broadcast keys rule out.

@@ -267,6 +267,10 @@ class Join(LogicalPlan):
     left_keys: Tuple[Expression, ...] = ()
     right_keys: Tuple[Expression, ...] = ()
     condition: Optional[Expression] = None  # non-equi residual
+    #: the BUILD (right) side carried a broadcast hint
+    #: (F.broadcast(df) / df.hint("broadcast")): the join planner skips
+    #: the size threshold, like Spark's ResolveHints + JoinSelection
+    broadcast_hint: bool = False
 
     def __post_init__(self):
         self.children = (self.left, self.right)

@@ -302,3 +302,55 @@ def test_bloom_kill_switch():
     exp = fact.to_pandas().merge(dim.to_pandas(), left_on="fk",
                                  right_on="pk", how="inner")
     assert len(got) == len(exp)
+
+
+def test_broadcast_hint_forces_broadcast(sess):
+    """F.broadcast(dim) / dim.hint('broadcast') skip the size threshold
+    (Spark's ResolveHints + JoinSelection)."""
+    rng = np.random.default_rng(21)
+    fact = pa.table({"fk": rng.integers(0, 500, 20_000),
+                     "x": rng.random(20_000)})
+    dim = pa.table({"pk": np.arange(500, dtype=np.int64),
+                    "n": [f"d{i}" for i in range(500)]})
+    sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold", 1)
+    try:
+        f = sess.create_dataframe(fact, num_partitions=3)
+        d = sess.create_dataframe(dim, num_partitions=2)
+        q = f.join(F.broadcast(d), f.fk == d.pk, "inner")
+        rep = str(sess.physical_plan(q).tree_string())
+        assert "BroadcastHashJoin" in rep
+        got = q.count()
+        exp = fact.to_pandas().merge(dim.to_pandas(), left_on="fk",
+                                     right_on="pk").shape[0]
+        assert got == exp
+        # unhinted stays off the broadcast path under the tiny threshold
+        rep2 = str(sess.physical_plan(
+            f.join(d, f.fk == d.pk, "inner")).tree_string())
+        assert "BroadcastHashJoin" not in rep2
+        # hint() surface, and unknown hints are ignored like Spark
+        assert "BroadcastHashJoin" in str(sess.physical_plan(
+            f.join(d.hint("broadcast"), f.fk == d.pk, "left")).tree_string())
+        assert d.hint("nosuchhint") is d
+    finally:
+        sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold",
+                      10 * 1024 * 1024)
+
+
+def test_broadcast_hint_survives_transformations(sess):
+    """select/filter/rename after the hint keep it (Spark's ResolvedHint
+    survives transformations)."""
+    rng = np.random.default_rng(22)
+    fact = pa.table({"fk": rng.integers(0, 100, 5_000)})
+    dim = pa.table({"pk": np.arange(100, dtype=np.int64),
+                    "n": [f"d{i}" for i in range(100)]})
+    sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold", 1)
+    try:
+        f = sess.create_dataframe(fact, num_partitions=3)
+        d = F.broadcast(sess.create_dataframe(dim, num_partitions=2))
+        d2 = d.filter(d.pk >= 0).withColumnRenamed("n", "name")
+        q = f.join(d2, f.fk == d2.pk, "inner")
+        assert "BroadcastHashJoin" in str(sess.physical_plan(q).tree_string())
+        assert q.count() == 5_000
+    finally:
+        sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold",
+                      10 * 1024 * 1024)
