@@ -83,8 +83,11 @@ def _coerce_pair(a: Expression, b: Expression) -> Tuple[Expression, Expression]:
 
 
 def _has_broadcast_hint(plan) -> bool:
-    """True when any node of the frame's plan tree carries the broadcast
-    marker (the hint survives transformations stacked above it)."""
+    """True when the frame's plan tree carries the broadcast marker ABOVE
+    any join (the hint survives unary transformations stacked over it,
+    but a join CONSUMES the hints of its children — Spark's ResolvedHint
+    never escapes through a Join to force-broadcast the whole join
+    result)."""
     seen = set()
     stack = [plan]
     while stack:
@@ -94,6 +97,8 @@ def _has_broadcast_hint(plan) -> bool:
         seen.add(id(n))
         if getattr(n, "_broadcast_hint", False):
             return True
+        if isinstance(n, P.Join):
+            continue  # children's hints were consumed by this join
         stack.extend(n.children)
     return False
 
@@ -680,6 +685,15 @@ class DataFrame:
             how = "cross" if how == "inner" else how
         elif isinstance(on, str):
             on = [on]
+        if (how == "inner" and isinstance(on, Column)
+                and _has_broadcast_hint(self._plan)
+                and not _has_broadcast_hint(other._plan)):
+            # left-side hint (the broadcast(small).join(big) ordering):
+            # inner joins commute, so build on the hinted LEFT by
+            # swapping sides and restoring the column order after
+            out_attrs = list(self._plan.output) + list(other._plan.output)
+            swapped = other.join(self, on=on, how="inner")
+            return swapped.select(*[Column(a) for a in out_attrs])
         if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
             for name in on:
                 lk.append(self._col(name).expr)

@@ -1025,8 +1025,15 @@ class Parser:
             if self.accept_kw("OR"):
                 self.expect_kw("REPLACE")
                 replace = True
-            if not self.accept_kw("TEMPORARY"):
+            if not self.accept_kw("TEMPORARY", "TEMP"):
                 return None
+            if self.accept_kw("VIEW"):
+                name = self.expect_ident()
+                self.expect_kw("AS")
+                ctes = self._parse_ctes()
+                sub = self._query_term(ctes)
+                sub.ctes = ctes
+                return CreateTempViewStmt(name, sub, replace)
             if not self.accept_kw("FUNCTION"):
                 return None
             name = self.expect_ident()
@@ -1039,8 +1046,13 @@ class Parser:
             path = unescape_sql_string(t.text[1:-1])
             return CreateFunctionStmt(name, path, replace)
         if self.accept_kw("DROP"):
-            if not self.accept_kw("TEMPORARY"):
-                return None
+            self.accept_kw("TEMPORARY", "TEMP")
+            if self.accept_kw("VIEW"):
+                if_exists = False
+                if self.accept_kw("IF"):
+                    self.expect_kw("EXISTS")
+                    if_exists = True
+                return DropViewStmt(self.expect_ident(), if_exists)
             if not self.accept_kw("FUNCTION"):
                 return None
             if_exists = False
@@ -1049,6 +1061,20 @@ class Parser:
                 if_exists = True
             return DropFunctionStmt(self.expect_ident(), if_exists)
         return None
+
+    def _parse_ctes(self):
+        ctes: Dict[str, Any] = {}
+        if self.accept_kw("WITH"):
+            while True:
+                name = self.expect_ident()
+                self.expect_kw("AS")
+                self.expect_op("(")
+                sub = self._query_term(ctes)
+                self.expect_op(")")
+                ctes[name.lower()] = sub
+                if not self.accept_op(","):
+                    break
+        return ctes
 
     def parse_statement(self):
         # DDL: CREATE [OR REPLACE] TEMPORARY FUNCTION f AS 'module.Class'
@@ -1082,17 +1108,7 @@ class Parser:
                         f"{tail.pos} in {self.sql!r}")
                 return stmt
             self.i = save
-        ctes: Dict[str, Any] = {}
-        if self.accept_kw("WITH"):
-            while True:
-                name = self.expect_ident()
-                self.expect_kw("AS")
-                self.expect_op("(")
-                sub = self._query_term(ctes)
-                self.expect_op(")")
-                ctes[name.lower()] = sub
-                if not self.accept_op(","):
-                    break
+        ctes = self._parse_ctes()
         stmt = self._query_term(ctes)
         stmt.ctes = ctes
         tail = self.peek()
@@ -1412,6 +1428,19 @@ class DropFunctionStmt:
 @dataclass
 class ShowTablesStmt:
     pass
+
+
+@dataclass
+class CreateTempViewStmt:
+    name: str
+    stmt: "Any"
+    replace: bool = False
+
+
+@dataclass
+class DropViewStmt:
+    name: str
+    if_exists: bool = False
 
 
 @dataclass
@@ -2392,6 +2421,19 @@ def parse_query(session, sql: str):
         if session._hive_udfs.pop(stmt.name.lower(), None) is None \
                 and not stmt.if_exists:
             raise ValueError(f"function not found: {stmt.name}")
+        return session.create_dataframe(_empty_ddl_result())
+    if isinstance(stmt, CreateTempViewStmt):
+        if not stmt.replace and stmt.name.lower() in session._temp_views:
+            raise ValueError(
+                f"temp view {stmt.name!r} already exists (use CREATE OR "
+                f"REPLACE TEMP VIEW)")
+        df = QueryBuilder(session).build(stmt.stmt)
+        df.createOrReplaceTempView(stmt.name)
+        return session.create_dataframe(_empty_ddl_result())
+    if isinstance(stmt, DropViewStmt):
+        if session._temp_views.pop(stmt.name.lower(), None) is None \
+                and not stmt.if_exists:
+            raise ValueError(f"view not found: {stmt.name}")
         return session.create_dataframe(_empty_ddl_result())
     if isinstance(stmt, ShowTablesStmt):
         import pyarrow as pa

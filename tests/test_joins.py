@@ -354,3 +354,32 @@ def test_broadcast_hint_survives_transformations(sess):
     finally:
         sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold",
                       10 * 1024 * 1024)
+
+
+def test_broadcast_hint_scoping(sess):
+    """A hint consumed by an inner join must not escape and broadcast the
+    whole join result; a LEFT-side hint is honored for inner
+    expression joins with output order preserved."""
+    rng = np.random.default_rng(23)
+    fact = sess.create_dataframe(
+        pa.table({"fk": rng.integers(0, 50, 5_000)}), num_partitions=3)
+    fact2 = sess.create_dataframe(
+        pa.table({"gk": rng.integers(0, 50, 5_000)}), num_partitions=3)
+    dim = sess.create_dataframe(
+        pa.table({"pk": np.arange(50, dtype=np.int64),
+                  "n": [f"d{i}" for i in range(50)]}))
+    sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold", 1)
+    try:
+        mid = fact2.join(F.broadcast(dim), fact2.gk == dim.pk, "inner")
+        rep = str(sess.physical_plan(
+            fact.join(mid, fact.fk == mid.gk, "inner")).tree_string())
+        assert rep.count("BroadcastExchange") <= 1, rep
+        q = F.broadcast(dim).join(fact, dim.pk == fact.fk, "inner")
+        assert "BroadcastHashJoin" in str(sess.physical_plan(q)
+                                          .tree_string())
+        out = q.collect()
+        assert out.column_names == ["pk", "n", "fk"]
+        assert out.num_rows == 5_000
+    finally:
+        sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold",
+                      10 * 1024 * 1024)

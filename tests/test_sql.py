@@ -472,3 +472,31 @@ def test_show_tables_and_describe(spark, t):
     assert spark.sql("DESC t").collect().num_rows == 3
     with pytest.raises(ValueError, match="not found"):
         spark.sql("DESCRIBE no_such_view").collect()
+
+
+def test_create_and_drop_temp_view(spark, t):
+    spark.sql("CREATE TEMP VIEW tv_agg AS "
+              "SELECT k, sum(v) AS s FROM t GROUP BY k")
+    out = spark.sql("SELECT * FROM tv_agg ORDER BY k").collect().to_pylist()
+    assert [r["k"] for r in out] == [1, 2, 3]
+    with pytest.raises(ValueError, match="already exists"):
+        spark.sql("CREATE TEMP VIEW tv_agg AS SELECT 1 AS x")
+    spark.sql("CREATE OR REPLACE TEMPORARY VIEW tv_agg AS "
+              "SELECT k FROM t WHERE k = 1")
+    assert spark.sql("SELECT count(*) AS c FROM tv_agg"
+                     ).collect().to_pylist()[0]["c"] == 3
+    spark.sql("DROP VIEW tv_agg")
+    with pytest.raises(Exception):
+        spark.sql("SELECT * FROM tv_agg").collect()
+    spark.sql("DROP VIEW IF EXISTS tv_agg")
+    with pytest.raises(ValueError, match="view not found"):
+        spark.sql("DROP VIEW tv_agg")
+
+
+def test_temp_view_with_cte_body(spark, t):
+    spark.sql("CREATE OR REPLACE TEMP VIEW tv_cte AS "
+              "WITH c AS (SELECT k FROM t WHERE k > 1) "
+              "SELECT count(*) AS c FROM c")
+    assert spark.sql("SELECT * FROM tv_cte").collect().to_pylist() == \
+        [{"c": 3}]
+    spark.sql("DROP VIEW tv_cte")
