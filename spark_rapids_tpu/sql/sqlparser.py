@@ -173,6 +173,15 @@ class OrderItem:
 
 
 @dataclass
+class LateralView:
+    outer: bool
+    func: str
+    arg: "Any"
+    table_alias: str
+    col_aliases: List[str]
+
+
+@dataclass
 class SelectStmt:
     items: List[SelectItem] = field(default_factory=list)
     distinct: bool = False
@@ -187,6 +196,9 @@ class SelectStmt:
     limit: Optional[int] = None
     offset: Optional[int] = None
     ctes: Dict[str, "Any"] = field(default_factory=dict)
+    #: Hive-style LATERAL VIEW [OUTER] explode(...) alias AS cols —
+    #: applied after the FROM/JOIN chain (the common placement)
+    lateral_views: List[LateralView] = field(default_factory=list)
 
 
 @dataclass
@@ -448,6 +460,7 @@ _RESERVED_STOP = {
     "NOT", "IS", "IN", "BETWEEN", "LIKE", "RLIKE", "ASC", "DESC", "NULLS",
     "BY", "SELECT", "DISTINCT", "ALL", "WITH", "OVER", "PARTITION", "ROWS",
     "RANGE", "PRECEDING", "FOLLOWING", "CURRENT", "UNBOUNDED", "SEMI", "ANTI",
+    "LATERAL",
 }
 
 
@@ -1178,9 +1191,33 @@ class Parser:
         if self.accept_kw("FROM"):
             stmt.from_ = self._table_ref(ctes)
             while True:
+                if self.at_kw("LATERAL"):
+                    self.next()
+                    self.expect_kw("VIEW")
+                    outer = self.accept_kw("OUTER")
+                    fname = self.expect_ident().lower()
+                    self.expect_op("(")
+                    arg = self.parse_expression()
+                    self.expect_op(")")
+                    talias = self.expect_ident()
+                    cols: List[str] = []
+                    if self.accept_kw("AS"):
+                        cols.append(self.expect_ident())
+                        while self.accept_op(","):
+                            cols.append(self.expect_ident())
+                    stmt.lateral_views.append(
+                        LateralView(outer, fname, arg, talias, cols))
+                    continue
                 step = self._join_step(ctes)
                 if step is None:
                     break
+                if stmt.lateral_views:
+                    # Spark's grammar puts LATERAL VIEW after all joins;
+                    # silently joining-then-exploding would reorder the
+                    # user's written evaluation, so reject like Spark
+                    raise SqlParseError(
+                        "JOIN after LATERAL VIEW is not supported — "
+                        "put all JOINs before the LATERAL VIEW clauses")
                 stmt.joins.append(step)
         if self.accept_kw("WHERE"):
             stmt.where = self.parse_expression()
@@ -1640,6 +1677,42 @@ class QueryBuilder:
                         f"expression to an inner one: {c.sql()!r}")
         return corr_pairs, inner_conj
 
+    def _apply_lateral_view(self, df, lv: "LateralView", scope):
+        """One LATERAL VIEW [OUTER] generator step -> a Generate node
+        over the running frame (Hive/Spark semantics: generated columns
+        join every source row; OUTER keeps rows whose array is
+        empty/null).  The view alias resolves qualified references to
+        the generated columns."""
+        from . import plan as P
+        from .dataframe import DataFrame
+        from .expressions.collections import Explode, PosExplode
+        cls = {"explode": Explode, "explode_outer": Explode,
+               "posexplode": PosExplode,
+               "posexplode_outer": PosExplode}.get(lv.func)
+        if cls is None:
+            raise SqlParseError(
+                f"unsupported LATERAL VIEW generator {lv.func!r} "
+                "(explode/posexplode[_outer])")
+        outer = lv.outer or lv.func.endswith("_outer")
+        arg = _resolve_or_err(self._bind_quals(lv.arg, scope), df._plan)
+        gen = cls(arg)
+        attrs = gen.gen_output_attrs()
+        if lv.col_aliases:
+            if len(lv.col_aliases) != len(attrs):
+                raise SqlParseError(
+                    f"LATERAL VIEW {lv.func} produces {len(attrs)} "
+                    f"column(s); {len(lv.col_aliases)} alias(es) given")
+            attrs = [a.renamed(n)
+                     for a, n in zip(attrs, lv.col_aliases)]
+        plan2 = P.Generate(gen, outer, tuple(attrs), df._plan)
+        out = DataFrame(plan2, self.session)
+        if lv.table_alias.lower() in scope:
+            raise SqlParseError(
+                f"duplicate relation alias {lv.table_alias!r}")
+        scope[lv.table_alias.lower()] = DataFrame(
+            P.Project(tuple(attrs), plan2), self.session)
+        return out
+
     def _decorrelate_scalar_subqueries(self, df, stmt: "SelectStmt",
                                        scope, ctes):
         """Rewrite correlated scalar subqueries in the WHERE clause and
@@ -1874,6 +1947,8 @@ class QueryBuilder:
                             f"{step.how} join requires ON or USING")
                     df = df.crossJoin(rdf)
 
+        for lv in stmt.lateral_views:
+            df = self._apply_lateral_view(df, lv, scope)
         df, stmt, star_visible = self._decorrelate_scalar_subqueries(
             df, stmt, scope, ctes)
         for slot, e in ([("HAVING", stmt.having)]

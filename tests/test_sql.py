@@ -500,3 +500,34 @@ def test_temp_view_with_cte_body(spark, t):
     assert spark.sql("SELECT * FROM tv_cte").collect().to_pylist() == \
         [{"c": 3}]
     spark.sql("DROP VIEW tv_cte")
+
+
+def test_lateral_view_explode(spark):
+    t = pa.table({"k": [1, 2, 3], "arr": [[10, 20], [30], []]})
+    spark.create_dataframe(t).createOrReplaceTempView("lv_t")
+    out = spark.sql("SELECT k, c FROM lv_t LATERAL VIEW explode(arr) x "
+                    "AS c ORDER BY k, c").collect().to_pylist()
+    assert out == [{"k": 1, "c": 10}, {"k": 1, "c": 20},
+                   {"k": 2, "c": 30}]
+    out2 = spark.sql("SELECT k, x.c FROM lv_t LATERAL VIEW OUTER "
+                     "explode(arr) x AS c ORDER BY k, c"
+                     ).collect().to_pylist()
+    assert out2[-1] == {"k": 3, "c": None}
+    out3 = spark.sql("SELECT k, p, c FROM lv_t LATERAL VIEW "
+                     "posexplode(arr) x AS p, c ORDER BY k, p"
+                     ).collect().to_pylist()
+    assert out3[:2] == [{"k": 1, "p": 0, "c": 10},
+                        {"k": 1, "p": 1, "c": 20}]
+    with pytest.raises(ValueError, match="unsupported LATERAL"):
+        spark.sql("SELECT 1 FROM lv_t LATERAL VIEW json_tuple(arr) x "
+                  "AS a").collect()
+
+
+def test_lateral_view_then_join_rejected(spark):
+    t = pa.table({"k": [1], "arr": [[1]]})
+    spark.create_dataframe(t).createOrReplaceTempView("lvj_t")
+    spark.create_dataframe(pa.table({"k": [1]})
+                           ).createOrReplaceTempView("lvj_u")
+    with pytest.raises(ValueError, match="JOIN after LATERAL VIEW"):
+        spark.sql("SELECT * FROM lvj_t LATERAL VIEW explode(arr) x AS c "
+                  "JOIN lvj_u ON lvj_t.k = lvj_u.k").collect()
