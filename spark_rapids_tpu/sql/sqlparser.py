@@ -78,6 +78,12 @@ class SqlParseError(ValueError):
     pass
 
 
+import itertools as _it
+
+#: distinct seeds for non-REPEATABLE TABLESAMPLEs
+_SAMPLE_SEEDS = _it.count(0x5EED)
+
+
 def unescape_sql_string(body: str) -> str:
     """Spark's default string-literal semantics (``unescapeSQLString``,
     ``spark.sql.parser.escapedStringLiterals=false``): backslash escapes
@@ -149,12 +155,14 @@ class TableRef:
     name: str                       # view/table name, or format for files
     alias: Optional[str] = None
     path: Optional[str] = None      # direct file relation
+    sample: Optional[tuple] = None  # ("percent"|"rows", value, seed)
 
 
 @dataclass
 class SubqueryRef:
     stmt: "Any"
     alias: Optional[str] = None
+    sample: Optional[tuple] = None  # ("percent"|"rows", value, seed)
 
 
 @dataclass
@@ -460,7 +468,7 @@ _RESERVED_STOP = {
     "NOT", "IS", "IN", "BETWEEN", "LIKE", "RLIKE", "ASC", "DESC", "NULLS",
     "BY", "SELECT", "DISTINCT", "ALL", "WITH", "OVER", "PARTITION", "ROWS",
     "RANGE", "PRECEDING", "FOLLOWING", "CURRENT", "UNBOUNDED", "SEMI", "ANTI",
-    "LATERAL",
+    "LATERAL", "TABLESAMPLE",
 }
 
 
@@ -1339,16 +1347,58 @@ class Parser:
         if self.accept_op("("):
             q = self._query_term(ctes)
             self.expect_op(")")
-            alias = self._table_alias()
-            return SubqueryRef(q, alias)
+            alias, sample = self._ref_suffix()
+            return SubqueryRef(q, alias, sample=sample)
         name = self.expect_ident()
         # direct file relation: parquet.`/path`
         if name.lower() in ("parquet", "orc", "csv", "json", "avro") and \
                 self.at_op(".") and self.peek(1).kind == "qident":
             self.next()
             path = self.expect_ident()
-            return TableRef(name.lower(), self._table_alias(), path=path)
-        return TableRef(name, self._table_alias())
+            alias, sample = self._ref_suffix()
+            return TableRef(name.lower(), alias, path=path, sample=sample)
+        alias, sample = self._ref_suffix()
+        return TableRef(name, alias, sample=sample)
+
+    def _ref_suffix(self):
+        """[alias] [TABLESAMPLE ...] [alias] after a relation — one place
+        for all three _table_ref branches."""
+        alias = self._table_alias()
+        sample = self._maybe_tablesample()
+        return alias or self._table_alias(), sample
+
+    def _maybe_tablesample(self):
+        """TABLESAMPLE (n PERCENT | n ROWS) [REPEATABLE (seed)] after a
+        relation (Spark's sample clause; PERCENT maps to the Sample
+        operator, ROWS to a limit)."""
+        if not self.accept_kw("TABLESAMPLE"):
+            return None
+        self.expect_op("(")
+        t = self.expect_kind("num")
+        try:
+            val = float(t.text)
+        except ValueError:
+            raise SqlParseError(
+                f"bad TABLESAMPLE value {t.text!r} at {t.pos} in "
+                f"{self.sql!r}") from None
+        if self.accept_kw("PERCENT"):
+            kind = "percent"
+        elif self.accept_kw("ROWS"):
+            kind = "rows"
+            if not t.text.isdigit():
+                raise SqlParseError(
+                    f"TABLESAMPLE ROWS expects an integer at {t.pos} in "
+                    f"{self.sql!r}, got {t.text!r}")
+        else:
+            raise SqlParseError(
+                "TABLESAMPLE supports 'n PERCENT' and 'n ROWS'")
+        self.expect_op(")")
+        seed = None  # None = fresh per sample (Spark's non-REPEATABLE)
+        if self.accept_kw("REPEATABLE"):
+            self.expect_op("(")
+            seed = self.expect_int()
+            self.expect_op(")")
+        return (kind, val, seed)
 
     def _table_alias(self) -> Optional[str]:
         if self.accept_kw("AS"):
@@ -1529,22 +1579,41 @@ class QueryBuilder:
             df = self._build_sub(ref.stmt, ctes)
             self._subq += 1
             alias = ref.alias or f"__subquery{self._subq}"
-            return self._fresh(df), alias
+            return self._apply_sample(self._fresh(df), ref.sample), alias
         assert isinstance(ref, TableRef)
         if ref.path is not None:
             reader = self.session.read
             df = getattr(reader, ref.name)(ref.path)
-            return self._fresh(df), ref.alias or ref.name
+            return (self._apply_sample(self._fresh(df), ref.sample),
+                    ref.alias or ref.name)
         key = ref.name.lower()
         if key in ctes:
             kind, payload = ctes[key]
             df = self._build_sub(payload, ctes) if kind == "stmt" else payload
-            return self._fresh(df), ref.alias or ref.name
+            return (self._apply_sample(self._fresh(df), ref.sample),
+                    ref.alias or ref.name)
         view = self.session._temp_views.get(key)
         if view is None:
             raise SqlParseError(f"table or view not found: {ref.name}")
-        return self._fresh(DataFrame(view._plan, self.session)), \
-            ref.alias or ref.name
+        df = self._fresh(DataFrame(view._plan, self.session))
+        return self._apply_sample(df, ref.sample), ref.alias or ref.name
+
+    @staticmethod
+    def _apply_sample(df, sample):
+        if sample is None:
+            return df
+        kind, val, seed = sample
+        if kind == "rows":
+            return df.limit(int(val))
+        if not (0.0 <= val <= 100.0):
+            raise SqlParseError(
+                f"TABLESAMPLE percentage {val} not in [0, 100]")
+        if seed is None:
+            # non-REPEATABLE: each sample gets a distinct seed so two
+            # samples of the same table in one query are independent
+            # (deterministic across reruns — engine-wide determinism)
+            seed = next(_SAMPLE_SEEDS)
+        return df.sample(val / 100.0, seed=seed)
 
     def _fresh(self, df):
         """Re-alias every output column under fresh expression ids, so two

@@ -531,3 +531,24 @@ def test_lateral_view_then_join_rejected(spark):
     with pytest.raises(ValueError, match="JOIN after LATERAL VIEW"):
         spark.sql("SELECT * FROM lvj_t LATERAL VIEW explode(arr) x AS c "
                   "JOIN lvj_u ON lvj_t.k = lvj_u.k").collect()
+
+
+def test_tablesample(spark):
+    t = pa.table({"k": list(range(10_000))})
+    spark.create_dataframe(t).createOrReplaceTempView("ts_t")
+    n = spark.sql("SELECT count(*) AS c FROM ts_t TABLESAMPLE (10 PERCENT)"
+                  " REPEATABLE (7)").collect().to_pylist()[0]["c"]
+    assert 500 < n < 1_500
+    n2 = spark.sql("SELECT count(*) AS c FROM ts_t TABLESAMPLE "
+                   "(10 PERCENT) REPEATABLE (7)"
+                   ).collect().to_pylist()[0]["c"]
+    assert n == n2  # deterministic under REPEATABLE
+    assert spark.sql("SELECT count(*) AS c FROM ts_t TABLESAMPLE (25 ROWS)"
+                     ).collect().to_pylist()[0]["c"] == 25
+    # both alias positions
+    assert len(spark.sql("SELECT x.k FROM ts_t TABLESAMPLE (5 ROWS) x"
+                         ).collect()) == 5
+    assert len(spark.sql("SELECT x.k FROM ts_t x TABLESAMPLE (5 ROWS)"
+                         ).collect()) == 5
+    with pytest.raises(ValueError, match="PERCENT"):
+        spark.sql("SELECT 1 FROM ts_t TABLESAMPLE (10 BUCKETS)").collect()
