@@ -387,6 +387,18 @@ def _final(rec) -> bool:
     return bool(rec) and "value" in rec and rec.get("rows")
 
 
+def _usable_capture_record(rec) -> bool:
+    """Acceptance predicate for a banked capture's FINAL record — shared
+    with tools/tunnel_watcher.sh (which imports bench and calls this to
+    decide whether a capture cycle banked anything replayable): a real
+    measurement (_final), on the device platform (the live chip registers
+    as "axon" — anything non-CPU), and not itself a replay ("captured_at"
+    marks those; replaying one would launder an old measurement under a
+    fresh timestamp)."""
+    return bool(_final(rec) and rec.get("platform") not in (None, "cpu")
+                and "captured_at" not in rec)
+
+
 def _load_capture():
     """Freshest tunnel-window capture matching this mode, if any.
 
@@ -422,14 +434,7 @@ def _load_capture():
                             recs.append(rec)
             except OSError:
                 continue
-            if recs and _final(recs[-1]) \
-                    and recs[-1].get("platform") not in (None, "cpu") \
-                    and "captured_at" not in recs[-1]:
-                # (the live chip registers as platform "axon", so accept
-                # any non-CPU platform; "captured_at" marks a record that
-                # is itself a replay — a watcher-invoked bench.py that
-                # fell back to replay must not launder an old measurement
-                # under a fresh timestamp)
+            if recs and _usable_capture_record(recs[-1]):
                 ts = os.path.basename(path).split("_")[1]
                 return ts, recs
     return None
@@ -529,8 +534,7 @@ def orchestrate() -> None:
     # would mask a live regression; let the CPU fallback carry the error
     # note.  "ok-cpu" probes — jax fell back to the CPU platform — count
     # as a dead tunnel here.)
-    if (device_result is None
-            or device_result.get("platform") == "cpu") \
+    if device_result is None \
             and not any(p.endswith(" ok") for p in probes):
         cap = _load_capture()
         if cap is not None:
@@ -548,9 +552,9 @@ def orchestrate() -> None:
             print(json.dumps(final), flush=True)
             return
 
-    # fall back to the insurance number (or a device child that turned out
-    # to be running on an ambient CPU platform — same thing; its per-query
-    # lines already streamed, so don't drain the duplicate insurance run)
+    # fall back to the insurance number (device_result is always None
+    # here: CPU-platform device children are killed at probe time, and a
+    # non-CPU result returned above)
     fallback = device_result
     if fallback is None:
         cpu_child.resume()
@@ -565,9 +569,7 @@ def orchestrate() -> None:
     if fallback is None:
         fallback = {"metric": "tpch_q1_like_rows_per_sec", "value": 0,
                     "unit": "rows/s", "vs_baseline": 0.0}
-    if device_result is not None and device_result.get("platform") == "cpu":
-        note = "no TPU backend in this environment; CPU-platform numbers"
-    elif probes and all(p.endswith(" ok-cpu") for p in probes):
+    if probes and all(p.endswith(" ok-cpu") for p in probes):
         note = ("no TPU backend (jax fell back to the CPU platform); "
                 "CPU-platform numbers; probes: " + ", ".join(probes))
     elif not probes:

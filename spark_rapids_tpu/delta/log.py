@@ -338,7 +338,16 @@ class DeltaLog:
         prev = 0
         for v in self.versions():
             t = self._commit_timestamp(v)
-            t = prev if t is None else max(int(t), prev)
+            if t is None:
+                # commitInfo is optional per the protocol; fall back to
+                # the commit file's modification time (Delta's
+                # DeltaHistoryManager does the same) rather than treating
+                # the commit as timestamp 0 — which would resolve ANY
+                # timestampAsOf to the latest version of a foreign table
+                # written without commitInfo, silently reading data
+                # committed after the requested time (advisor r3).
+                t = int(os.path.getmtime(self._version_file(v)) * 1000)
+            t = max(int(t), prev)
             prev = t
             if t <= ts_ms:
                 best = v

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -305,6 +306,25 @@ def normalize_data_path(p: str, table_root: str) -> str:
         # both URI forms appear in the wild: file:///abs (RFC) and
         # file:/abs (Hadoop Path.toString())
         p = "/" + p[len("file:"):].lstrip("/")
+    else:
+        m = re.match(r"^[A-Za-z][A-Za-z0-9+.-]*://[^/]*(/.*)$", p)
+        if m:
+            # s3://bucket/..., hdfs://nn/..., gs://... — not absolute OS
+            # paths, so without this they skipped the relativize/suffix
+            # fallback entirely and came back verbatim, producing a bogus
+            # os.path.join(table_root, uri) read later (advisor r3).
+            # Strip scheme://authority and let the /data/ / /metadata/
+            # suffix fallback key the file under the local table root.
+            inner = m.group(1)
+            i = inner.rfind("/data/")
+            if i < 0:
+                i = inner.rfind("/metadata/")
+            if i >= 0:
+                return inner[i + 1:]
+            raise ValueError(
+                f"unsupported Iceberg data file location {p!r}: remote "
+                f"scheme with no data/ or metadata/ path segment to "
+                f"relativize under table root {table_root!r}")
     root = os.path.abspath(table_root)
     if os.path.isabs(p):
         ap = os.path.abspath(p)

@@ -243,12 +243,15 @@ class _Parser:
         if ch == "A":
             # \A = start of input — exactly this engine's (non-multiline) ^
             return RAnchor("^")
-        if ch in "zZ":
-            # \z = end of input = this engine's $ (strict end).  \Z (Java:
-            # before a final line terminator) is mapped the same way,
-            # matching how the engine already treats $ — the only
-            # divergence is inputs with a trailing line terminator.
+        if ch == "z":
+            # \z = end of input = this engine's $ (strict end)
             return RAnchor("$")
+        if ch == "Z":
+            # Java's \Z also matches BEFORE a final line terminator; this
+            # engine's $ is strict end-of-input, so mapping \Z to it
+            # diverges for subjects ending in '\n' (advisor r3) — reject
+            # so the expression falls back to the host for exactness
+            self.error("anchor \\Z (final-line-terminator semantics)")
         if ch in "bBG":
             self.error(f"anchor \\{ch}")
         if ch.isdigit():

@@ -241,7 +241,17 @@ class FusedCollectExec(PhysicalPlan):
         single-program tail applies.  Otherwise run the original tree —
         its exchanges are already materialized, so nothing recomputes."""
         if pid > 0:
-            # decision was made at pid 0 (execute_all drives serially)
+            if self._decision is None:
+                # pid 0 normally decides first (execute_all drives
+                # partitions serially); under an out-of-order or parallel
+                # driver, don't treat "no decision yet" as fused (that
+                # silently dropped this partition's output — advisor r3).
+                # The fallback tree is correct for BOTH outcomes: when
+                # the fused path applies, every pid>0 partition is empty,
+                # so the fallback yields nothing extra.
+                STATS["fallbacks"] += 1
+                yield from self._fallback.execute(pid, tctx)
+                return
             if self._decision == "fallback":
                 yield from self._fallback.execute(pid, tctx)
             return

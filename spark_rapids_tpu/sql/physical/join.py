@@ -372,6 +372,16 @@ class ShuffledHashJoinExec(BaseJoinExec):
                 or probe.map_side_filter is not None
                 or not bool(tctx.conf.get(BLOOM_JOIN_ENABLED))):
             return
+        # multi-slice shuffles materialize only the slice-LOCAL reduce
+        # partitions here (peer-owned slots come back empty), so a bloom
+        # built from them would cover a SUBSET of build rows and its
+        # map-side filter would drop probe rows whose matches live in
+        # peer-owned partitions — a false negative.  Same guard as the
+        # AQE partition-coalescing one in exchange.py.
+        from ...shuffle.manager import get_shuffle_manager
+        topo = get_shuffle_manager(tctx.conf).topology
+        if topo is not None and topo.multi_slice:
+            return
         # equal join-key values must hash identically on both sides; a
         # dtype mismatch (missing analyzer cast) would make that false and
         # a bloom false NEGATIVE drops matching rows — so require it

@@ -194,3 +194,29 @@ def test_timestamp_as_of_monotonic_adjustment(sess, tmp_path):
     t = DeltaTable.forPath(sess, work)
     assert t.toDF(timestamp_ms=2500).count() == 5   # v0 only (v1 adj 3000)
     assert t.toDF(timestamp_ms=3000).count() == 4   # v2 (adjusted 3000)
+
+
+def test_timestamp_as_of_no_commit_info_uses_file_mtime(sess, tmp_path):
+    """commitInfo is optional in the protocol; a foreign writer may omit
+    it entirely.  The commit file's mtime then stands in for its
+    timestamp (Delta's DeltaHistoryManager rule) — previously such
+    commits were treated as timestamp 0, resolving ANY timestampAsOf to
+    the latest version (advisor r3)."""
+    import json as _json
+    import shutil
+    work = str(tmp_path / "people")
+    shutil.copytree(os.path.join(GOLDEN, "people"), work)
+    logd = os.path.join(work, "_delta_log")
+    for v, ts in [(0, 1_000_000), (1, 2_000_000), (2, 3_000_000)]:
+        p = os.path.join(logd, f"{v:020d}.json")
+        lines = [_json.loads(ln) for ln in open(p)
+                 if "commitInfo" not in ln]
+        with open(p, "w") as fh:
+            for a in lines:
+                fh.write(_json.dumps(a) + "\n")
+        os.utime(p, (ts / 1000, ts / 1000))
+    t = DeltaTable.forPath(sess, work)
+    assert t.toDF(timestamp_ms=1_500_000).count() == 5   # v0
+    assert t.toDF(timestamp_ms=2_000_000).count() == 7   # v1 (inclusive)
+    with pytest.raises(ValueError, match="before the earliest"):
+        t.toDF(timestamp_ms=999_999)

@@ -284,3 +284,21 @@ def test_metadata_tables_and_compaction(sess, tmp_path):
     # history keeps all operations incl. the replace
     ops = [h["operation"] for h in tab.history()]
     assert ops[-1] == "replace" and "delete" in ops
+
+
+def test_normalize_data_path_remote_schemes():
+    """Real Iceberg metadata commonly stores s3:// / hdfs:// / gs://
+    location URIs; they are not absolute OS paths, so they must take the
+    data/ / metadata/ suffix fallback rather than coming back verbatim
+    (advisor r3 — a verbatim URI joined under the table root produced an
+    opaque read error)."""
+    from spark_rapids_tpu.iceberg.metadata import normalize_data_path
+    root = "/tmp/tbl"
+    assert normalize_data_path(
+        "s3://bkt/wh/tbl/data/p=1/f.parquet", root) == "data/p=1/f.parquet"
+    assert normalize_data_path(
+        "hdfs://nn:8020/wh/tbl/metadata/m.avro", root) == "metadata/m.avro"
+    assert normalize_data_path(
+        "gs://b/x/data/f.parquet", root) == "data/f.parquet"
+    with pytest.raises(ValueError, match="unsupported"):
+        normalize_data_path("s3://bkt/elsewhere/f.parquet", root)

@@ -304,6 +304,42 @@ def test_bloom_kill_switch():
     assert len(got) == len(exp)
 
 
+def test_bloom_not_used_multi_slice():
+    """In a multi-slice topology the build exchange materializes only the
+    slice-LOCAL reduce partitions, so a bloom built from it would cover a
+    subset of build rows and its map-side probe filter would drop rows
+    whose matches live in peer-owned partitions (false negatives — the
+    one thing a bloom join must never do).  The bloom must not engage
+    (advisor r3 high finding)."""
+    from spark_rapids_tpu.ops import bloom as B
+    rng = np.random.default_rng(15)
+    fact, dim = _star_shapes(rng, n_fact=50_000, n_dim=100)
+    sess = srt.session(**{
+        "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.shuffle.topology.numSlices": 2,
+        "spark.rapids.shuffle.topology.sliceId": 0,
+        "spark.sql.adaptive.enabled": False})
+    try:
+        f = sess.create_dataframe(fact, num_partitions=4)
+        d = sess.create_dataframe(dim, num_partitions=2)
+        built0 = B.STATS["blooms_built"]
+        got = f.join(d, f.fk == d.pk, "inner").collect().to_pandas()
+        assert B.STATS["blooms_built"] == built0
+        # this slice returns its local partitions only — a strict subset,
+        # every row of which must match the oracle
+        exp = fact.to_pandas().merge(dim.to_pandas(), left_on="fk",
+                                     right_on="pk", how="inner")
+        assert 0 < len(got) < len(exp)
+        exp_keys = exp.groupby("fk").size()
+        for fk, cnt in got.groupby("fk").size().items():
+            assert exp_keys[fk] == cnt
+    finally:
+        srt.session(**{"spark.rapids.shuffle.topology.numSlices": 1,
+                       "spark.sql.adaptive.enabled": True,
+                       "spark.rapids.sql.autoBroadcastJoinThreshold":
+                           10 * 1024 * 1024})
+
+
 def test_broadcast_hint_forces_broadcast(sess):
     """F.broadcast(dim) / dim.hint('broadcast') skip the size threshold
     (Spark's ResolveHints + JoinSelection)."""

@@ -64,6 +64,9 @@ def test_unsupported_patterns_fall_back(sess):
     for pat, frag in [(r"(a)\1", "backreference"),
                       (r"a(?=b)", "group construct"),
                       (r"a*+b", "possessive"),
+                      # Java's \Z matches before a final line terminator;
+                      # the device $ is strict end-of-input (advisor r3)
+                      (r"ab\Z", "anchor"),
                       (r"\bword", "anchor")]:
         q = df.select(df.u, F.rlike(df.s, pat).alias("m"))
         report = sess.explain(q)

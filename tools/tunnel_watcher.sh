@@ -37,17 +37,19 @@ print('ALIVE', float(jnp.sum(jnp.ones(8))))" 2>/dev/null | grep ALIVE)
   if [ -n "$out" ]; then
     echo "$ts ALIVE" >> "$LOG"
     # clear a stale lock (a capture should never exceed ~4h)
-    if [ -f "$CAP/capture_running" ] && \
+    if [ -d "$CAP/capture_running" ] && \
        [ $(( $(date +%s) - $(stat -c %Y "$CAP/capture_running") )) -gt 14400 ]; then
-      rm -f "$CAP/capture_running"
+      rmdir "$CAP/capture_running" 2>/dev/null
     fi
     recent_done=0
     if [ -f "$CAP/capture_done" ] && \
        [ $(( $(date +%s) - $(stat -c %Y "$CAP/capture_done") )) -lt 7200 ]; then
       recent_done=1
     fi
-    if [ ! -f "$CAP/capture_running" ] && [ "$recent_done" = 0 ]; then
-      touch "$CAP/capture_running"
+    # mkdir is the test-and-set in one syscall: two watcher instances
+    # hitting the same ALIVE tick must not run two payloads against the
+    # one chip (contended numbers would be banked as official evidence)
+    if [ "$recent_done" = 0 ] && mkdir "$CAP/capture_running" 2>/dev/null; then
       (
         cd "$REPO"
         cycle_files=""
@@ -66,12 +68,15 @@ print('ALIVE', float(jnp.sum(jnp.ones(8))))" 2>/dev/null | grep ALIVE)
           cycle_files="$cycle_files $CAP/run_${ts2}_${mode}.out"
           echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture $mode done" >> "$LOG"
         done
-        # stamp capture_done ONLY if this cycle banked a usable on-chip
-        # record (a window that closed mid-capture yields CPU-fallback or
-        # replayed records, which bench.py's _load_capture rejects) — a
+        # stamp capture_done ONLY if this cycle banked a record that
+        # bench.py's replay will actually accept (the SAME predicate —
+        # bench._usable_capture_record — so the two can never drift); a
         # fruitless cycle must not suppress re-capture at the next window
-        if SRT_CYCLE_FILES="$cycle_files" python - <<'PYEOF'
+        if SRT_CYCLE_FILES="$cycle_files" JAX_PLATFORMS=cpu \
+           python - <<'PYEOF'
 import json, os, sys
+sys.path.insert(0, os.getcwd())
+import bench
 ok = False
 for path in os.environ["SRT_CYCLE_FILES"].split():
     try:
@@ -83,9 +88,7 @@ for path in os.environ["SRT_CYCLE_FILES"].split():
                 r = json.loads(line)
             except ValueError:
                 continue
-            if (r.get("platform") not in (None, "cpu")
-                    and "value" in r and r.get("rows")
-                    and "captured_at" not in r):
+            if bench._usable_capture_record(r):
                 ok = True
     except OSError:
         pass
@@ -96,7 +99,7 @@ PYEOF
         else
           echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture cycle banked no on-chip record" >> "$LOG"
         fi
-        rm -f "$CAP/capture_running"
+        rmdir "$CAP/capture_running" 2>/dev/null
       ) &
     fi
   else
