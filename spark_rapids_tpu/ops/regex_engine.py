@@ -256,8 +256,11 @@ class _Parser:
             self.error(f"anchor \\{ch}")
         if ch.isdigit():
             self.error("backreference")
+        # no "0" entry: the isdigit() backreference check above fires
+        # first for \0 (Java treats \0n as an octal escape anyway — the
+        # host fallback owns that corner)
         ctl = {"n": 0x0A, "t": 0x09, "r": 0x0D, "f": 0x0C, "a": 0x07,
-               "e": 0x1B, "0": 0x00}
+               "e": 0x1B}
         if ch in ctl:
             return RLit(ctl[ch])
         if ch == "x":
