@@ -247,6 +247,31 @@ class ExistsSubquery(Expression):
         return (id(self.stmt),)
 
 
+class _InnerUnit(Expression):
+    """Placeholder for a maximal inner-only subexpression lifted out of a
+    mixed correlated EXISTS conjunct (projected as __nq{idx} from the
+    subquery and substituted back into the join's residual condition)."""
+
+    children: Tuple[Expression, ...] = ()
+    _unresolved = True
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+    @property
+    def data_type(self):
+        raise TypeError("_InnerUnit must be substituted before typing")
+
+    def sql(self) -> str:
+        return f"<inner:{self.idx}>"
+
+    def with_children(self, children):
+        return self
+
+    def _key_extras(self):
+        return (self.idx,)
+
+
 class InSubquery(Expression):
     """``expr IN (SELECT ...)`` marker — LEFT SEMI join on equality;
     NOT IN is the null-aware LEFT ANTI form (SQL 3-valued logic: a null
@@ -815,6 +840,22 @@ class Parser:
                 return self._cast()
             if up == "CASE" and t.kind == "ident":
                 return self._case()
+            if up in ("DATE", "TIMESTAMP") and t.kind == "ident" \
+                    and self.peek(1).kind == "str":
+                # typed literal: DATE '1995-01-01' / TIMESTAMP '...' —
+                # the form the TPC-H query texts use everywhere.  Only
+                # when a string literal follows: bare `date` stays a
+                # valid column name.
+                import datetime as _dt
+                self.next()
+                s = unescape_sql_string(self.next().text[1:-1])
+                try:
+                    if up == "DATE":
+                        return Literal(_dt.date.fromisoformat(s))
+                    return Literal(_dt.datetime.fromisoformat(s))
+                except ValueError:
+                    raise SqlParseError(
+                        f"bad {up} literal {s!r}") from None
             if up == "INTERVAL" and t.kind == "ident":
                 self.next()
                 months = days = micros = 0
@@ -922,6 +963,32 @@ class Parser:
                                              Count, Max, Min, Sum)
         self.expect_op("(")
         lname = name.lower()
+        if lname == "extract":
+            # EXTRACT(unit FROM expr) — special syntactic form (SQL
+            # standard; TPC-H q7/q8/q9 use extract(year from ...)).
+            # Lowered onto the plain field-extraction functions.
+            unit_tok = self.next()
+            unit = unit_tok.text.lower()
+            fn = {"year": "year", "month": "month", "day": "day",
+                  "dayofmonth": "day", "hour": "hour", "minute": "minute",
+                  "second": "second", "quarter": "quarter",
+                  "week": "weekofyear", "dow": "dayofweek",
+                  "doy": "dayofyear"}.get(unit)
+            if fn is None or unit_tok.kind != "ident":
+                raise SqlParseError(
+                    f"unsupported EXTRACT unit {unit_tok.text!r}")
+            self.expect_kw("FROM")
+            arg = self.parse_expression()
+            self.expect_op(")")
+            from .dataframe import Column as _Col
+            res = _functions()[fn](_Col(arg))
+            e = res.expr if isinstance(res, _Col) else res
+            if unit == "dow":
+                # Spark's EXTRACT(DOW) is 0=Sunday..6; dayofweek() is
+                # 1=Sunday..7
+                from .expressions import arithmetic as A
+                e = A.Subtract(e, Literal(1))
+            return e
         distinct = False
         if self.accept_kw("DISTINCT"):
             distinct = True
@@ -1710,11 +1777,19 @@ class QueryBuilder:
                 out.add(r.alias.lower())
         return out
 
-    def _split_correlation(self, q, what: str):
+    def _split_correlation(self, q, what: str, allow_mixed: bool = False):
         """Split a subquery's WHERE into ([(outer_expr, inner_expr)],
-        [inner-only conjuncts]) — the decorrelation shared by correlated
-        EXISTS and correlated scalar subqueries (Spark's
-        RewriteCorrelatedScalarSubquery / RewritePredicateSubquery)."""
+        [inner-only conjuncts], [mixed conjuncts]) — the decorrelation
+        shared by correlated EXISTS and correlated scalar subqueries
+        (Spark's RewriteCorrelatedScalarSubquery /
+        RewritePredicateSubquery).
+
+        ``allow_mixed`` (EXISTS only): correlated conjuncts that are NOT
+        outer=inner equalities (TPC-H q21's ``l2.l_suppkey <>
+        l1.l_suppkey``) are returned in the third slot for the caller to
+        fold into the semi/anti join's residual condition; without it
+        they raise, since the scalar-subquery rewrite needs equality
+        keys to group on."""
         from .expressions import predicates as PR
         inner_aliases = self._relation_aliases(q)
 
@@ -1725,26 +1800,52 @@ class QueryBuilder:
 
         corr_pairs = []
         inner_conj = []
+        mixed = []
         if isinstance(q, SelectStmt) and q.where is not None:
             for c in _split_and(q.where):
                 oq = outer_quals(c)
                 if not oq:
                     inner_conj.append(c)
                     continue
-                if not isinstance(c, PR.EqualTo):
-                    raise SqlParseError(
-                        f"{what} supports only AND-connected "
-                        f"equality predicates, got {c.sql()!r}")
-                a, b = c.children
-                if outer_quals(a) and not outer_quals(b):
-                    corr_pairs.append((a, b))
-                elif outer_quals(b) and not outer_quals(a):
-                    corr_pairs.append((b, a))
-                else:
-                    raise SqlParseError(
-                        f"{what} equality must compare an outer "
-                        f"expression to an inner one: {c.sql()!r}")
-        return corr_pairs, inner_conj
+                if isinstance(c, PR.EqualTo):
+                    a, b = c.children
+                    if outer_quals(a) and not outer_quals(b):
+                        corr_pairs.append((a, b))
+                        continue
+                    if outer_quals(b) and not outer_quals(a):
+                        corr_pairs.append((b, a))
+                        continue
+                if allow_mixed:
+                    mixed.append(c)
+                    continue
+                raise SqlParseError(
+                    f"{what} supports only AND-connected "
+                    f"equality predicates, got {c.sql()!r}")
+        return corr_pairs, inner_conj, mixed
+
+    def _rewrite_mixed_conjunct(self, c, q, units):
+        """Replace each maximal inner-only subexpression of a mixed
+        correlated conjunct with an _InnerUnit placeholder (appending the
+        subexpression to ``units`` for the caller to project out of the
+        subquery); outer references stay in place for binding against the
+        outer frame."""
+        inner_aliases = self._relation_aliases(q)
+
+        def has_outer(e):
+            return bool(e.collect(
+                lambda x: isinstance(x, UnresolvedQualified)
+                and x.qualifier.lower() not in inner_aliases))
+
+        def walk(e):
+            if not has_outer(e):
+                if isinstance(e, Literal):
+                    return e
+                units.append(e)
+                return _InnerUnit(len(units) - 1)
+            kids = tuple(walk(ch) for ch in e.children)
+            return e.with_children(kids) if kids != e.children else e
+
+        return walk(c)
 
     def _apply_lateral_view(self, df, lv: "LateralView", scope):
         """One LATERAL VIEW [OUTER] generator step -> a Generate node
@@ -1812,7 +1913,7 @@ class QueryBuilder:
             if not isinstance(q, SelectStmt):
                 raise SqlParseError(
                     "correlated scalar subquery must be a simple SELECT")
-            corr_pairs, inner_conj = self._split_correlation(
+            corr_pairs, inner_conj, _ = self._split_correlation(
                 q, "correlated scalar subquery")
             if not corr_pairs:
                 # the evaluation pass only leaves a node here when it saw
@@ -1929,9 +2030,9 @@ class QueryBuilder:
         # EXISTS: extract equality correlation (inner.col = outer.col via
         # outer-alias-qualified references) into join keys
         q = pred.stmt
-        corr_pairs, inner_conj = self._split_correlation(
-            q, "correlated EXISTS")
-        if corr_pairs:
+        corr_pairs, inner_conj, mixed = self._split_correlation(
+            q, "correlated EXISTS", allow_mixed=True)
+        if corr_pairs or mixed:
             import dataclasses
             if q.group_by or q.having is not None or q.group_by_mode:
                 raise SqlParseError(
@@ -1946,20 +2047,39 @@ class QueryBuilder:
                     "correlated EXISTS with OFFSET is not supported (it "
                     "is per-outer-row and has no join rewrite)")
             limit = q.limit
+            # mixed conjuncts (non-equality correlation, TPC-H q21): lift
+            # each maximal inner-only subexpression into the projection
+            # and fold the rewritten predicate into the join's residual
+            # condition — the same plan Spark builds (semi/anti hash join
+            # with an extra non-equi condition)
+            units: list = []
+            mixed_rw = [self._rewrite_mixed_conjunct(c, q, units)
+                        for c in mixed]
             q2 = dataclasses.replace(
                 q,
                 where=_and_all(inner_conj),
                 items=[SelectItem(ie, f"__corr{i}")
-                       for i, (_, ie) in enumerate(corr_pairs)],
+                       for i, (_, ie) in enumerate(corr_pairs)]
+                + [SelectItem(u, f"__nq{i}")
+                   for i, u in enumerate(units)],
                 order_by=[], distinct=False, limit=None, offset=None)
             if limit is not None and limit <= 0:
                 return df.filter(F.lit(negated))
             inner = self._fresh(self._build_sub(q2, ctes))
+            unit_outs = inner._plan.output[len(corr_pairs):
+                                           len(corr_pairs) + len(units)]
             cond = None
             for i, (oe, _) in enumerate(corr_pairs):
                 outer_col = Column(_resolve_or_err(
                     self._bind_quals(oe, scope), df._plan))
                 term = outer_col == Column(inner._plan.output[i])
+                cond = term if cond is None else cond & term
+            for c in mixed_rw:
+                bound = c.transform(
+                    lambda x: unit_outs[x.idx]
+                    if isinstance(x, _InnerUnit) else None)
+                term = Column(_resolve_or_err(
+                    self._bind_quals(bound, scope), df._plan))
                 cond = term if cond is None else cond & term
         else:
             # existence is decided by ONE surviving row
@@ -1967,6 +2087,152 @@ class QueryBuilder:
             cond = F.lit(True)
         return df.join(inner, on=cond,
                        how="left_anti" if negated else "left_semi")
+
+    def _plan_comma_joins(self, stmt: "SelectStmt", ctes, scope):
+        """Join planning for a pure comma/CROSS FROM list — the analog of
+        Spark's PushPredicateThroughJoin + ReorderJoin, which run before
+        the reference plugin sees the plan (its GpuShuffledHashJoinExec
+        receives already-planned equi joins).
+
+        Splits the WHERE into conjuncts; pushes single-relation ones
+        beneath the joins; uses multi-relation conjuncts as inner-join
+        conditions, joining relations in connected order (greedy, driven
+        by equality conjuncts) so no unfiltered cross product ever
+        materializes; anything unplaceable (subquery predicates,
+        ambiguous references) stays in the residual WHERE.  Returns
+        (joined df, stmt with the consumed conjuncts removed)."""
+        import dataclasses
+
+        from . import plan as P
+        from .dataframe import Column, DataFrame
+        from .expressions import predicates as PR
+        from .functions import _UnresolvedAttribute
+
+        rels: List[str] = []
+
+        def add(ref):
+            rdf, ralias = self._resolve_relation(ref, ctes)
+            key = ralias.lower()
+            if key in scope:
+                raise SqlParseError(f"duplicate relation alias {ralias!r}")
+            scope[key] = rdf
+            rels.append(key)
+
+        add(stmt.from_)
+        for step in stmt.joins:
+            add(step.right)
+
+        col_owners: Dict[str, set] = {}
+        for a in rels:
+            for attr in scope[a]._plan.output:
+                col_owners.setdefault(attr.name.lower(), set()).add(a)
+
+        def conj_aliases(c):
+            """Relations a conjunct references, or None when a reference
+            cannot be attributed to exactly one relation (unknown alias,
+            ambiguous or missing bare name) — those conjuncts stay in
+            the residual WHERE where normal resolution reports errors."""
+            out = set()
+            for n in c.collect(lambda x: isinstance(
+                    x, (UnresolvedQualified, _UnresolvedAttribute))):
+                if isinstance(n, UnresolvedQualified):
+                    if n.qualifier.lower() not in scope:
+                        return None
+                    out.add(n.qualifier.lower())
+                else:
+                    owners = col_owners.get(n.name.lower(), set())
+                    if len(owners) != 1:
+                        return None
+                    out.add(next(iter(owners)))
+            return out
+
+        # the _build_select WHERE guards run only on the residual; pushed
+        # conjuncts must fail just as cleanly here
+        if stmt.where is not None:
+            if _has_agg(stmt.where):
+                raise SqlParseError(
+                    "aggregate functions are not allowed in WHERE")
+            if _has_window(stmt.where):
+                raise SqlParseError(
+                    "window functions are not allowed in WHERE")
+
+        residual: List[Expression] = []
+        singles: Dict[str, List[Expression]] = {a: [] for a in rels}
+        multis: List[Tuple[Expression, set]] = []
+        conjs = _split_and(stmt.where) if stmt.where is not None else []
+        for c in conjs:
+            if c.collect(lambda x: isinstance(
+                    x, (ExistsSubquery, InSubquery, ScalarSubquery))):
+                residual.append(c)
+                continue
+            al = conj_aliases(c)
+            if not al:
+                residual.append(c)
+            elif len(al) == 1:
+                singles[next(iter(al))].append(c)
+            else:
+                multis.append((c, al))
+
+        for a in rels:
+            if singles[a]:
+                rel = scope[a]
+                pred = None
+                for c in singles[a]:
+                    b = _resolve_or_err(self._bind_quals(c, scope),
+                                        rel._plan)
+                    pred = b if pred is None else PR.And(pred, b)
+                # Filter preserves the child's output attributes, so
+                # join conditions bound against the unfiltered plan stay
+                # valid
+                scope[a] = DataFrame(P.Filter(pred, rel._plan),
+                                     self.session)
+
+        joined = {rels[0]}
+        df = scope[rels[0]]
+        remaining = rels[1:]
+        used = [False] * len(multis)
+        while remaining:
+            pick = None
+            for want_eq in (True, False):
+                for a in remaining:
+                    if any(not used[i] and a in al
+                           and al <= joined | {a}
+                           and (isinstance(c, PR.EqualTo) or not want_eq)
+                           for i, (c, al) in enumerate(multis)):
+                        pick = a
+                        break
+                if pick is not None:
+                    break
+            connected = pick is not None
+            if pick is None:
+                pick = remaining[0]
+            conds = []
+            for i, (c, al) in enumerate(multis):
+                if not used[i] and al <= joined | {pick}:
+                    used[i] = True
+                    conds.append(self._bind_quals(c, scope))
+            rdf = scope[pick]
+            if connected and conds:
+                cond = conds[0]
+                for c in conds[1:]:
+                    cond = PR.And(cond, c)
+                df = df.join(rdf, on=Column(cond), how="inner")
+            else:
+                df = df.crossJoin(rdf)
+                for c in conds:  # subset-covered but disconnected
+                    df = df.filter(Column(c))
+            joined.add(pick)
+            remaining = [a for a in remaining if a != pick]
+
+        residual.extend(c for i, (c, _) in enumerate(multis)
+                        if not used[i])
+        # SELECT * must see columns in FROM-list order (SQL), not the
+        # greedy join order — restore it with a (free) projection
+        ordered = tuple(a for r in rels for a in scope[r]._plan.output)
+        if ordered != tuple(df._plan.output):
+            df = DataFrame(P.Project(ordered, df._plan), self.session)
+        return df, dataclasses.replace(stmt, where=_and_all(residual)
+                                       if residual else None)
 
     # --- SELECT -----------------------------------------------------------
     def _build_select(self, stmt: SelectStmt, ctes):
@@ -1996,6 +2262,13 @@ class QueryBuilder:
         scope: Dict[str, Any] = {}      # alias -> DataFrame
         if stmt.from_ is None:
             df = self.session.range(1)
+        elif stmt.joins and all(s.how == "cross" and s.on is None
+                                and not s.using for s in stmt.joins):
+            # comma-FROM (`FROM a, b, c WHERE ...`) — the TPC-H query
+            # texts' surface.  Naive left-to-right cross joins explode
+            # (part x supplier x partsupp x nation x region before any
+            # filter); plan them instead (see _plan_comma_joins).
+            df, stmt = self._plan_comma_joins(stmt, ctes, scope)
         else:
             df, alias = self._resolve_relation(stmt.from_, ctes)
             scope[alias.lower()] = df

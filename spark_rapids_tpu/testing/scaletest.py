@@ -18,6 +18,7 @@ import pandas as pd
 import pyarrow as pa
 
 from .datagen import (DoubleGen, IntegerGen, LongGen, StringGen, gen_table)
+from . import tpch_queries as _TQ
 
 
 def build_tables(rows: int, seed: int = 17) -> Dict[str, pa.Table]:
@@ -130,62 +131,11 @@ def _q6(sess, t, F):
 
 
 def build_tpch_tables(rows: int, seed: int = 23) -> Dict[str, pa.Table]:
-    """lineitem-shaped table for the TPC-H q1/q6 milestones (BASELINE
-    config 2) — column shapes and value ranges follow the spec's
-    lineitem, scaled by ``rows``."""
-    rng = np.random.default_rng(seed)
-    base = np.datetime64("1992-01-01")
-    ship = base + rng.integers(0, 2526, rows).astype("timedelta64[D]")
-    lineitem = pa.table({
-        "l_quantity": pa.array(rng.integers(1, 51, rows).astype(np.float64)),
-        "l_extendedprice": pa.array(np.round(rng.random(rows) * 104949 + 901,
-                                             2)),
-        "l_discount": pa.array(np.round(rng.integers(0, 11, rows) * 0.01,
-                                        2)),
-        "l_tax": pa.array(np.round(rng.integers(0, 9, rows) * 0.01, 2)),
-        "l_returnflag": pa.array(rng.choice(["A", "N", "R"], rows)),
-        "l_linestatus": pa.array(rng.choice(["O", "F"], rows)),
-        "l_shipdate": pa.array(ship.astype("datetime64[D]")),
-        # q4/q14 columns: order/part FKs + commit-vs-receipt lateness
-        "l_orderkey": pa.array(rng.integers(0, max(rows // 4, 1), rows)),
-        "l_partkey": pa.array(rng.integers(0, max(rows // 8, 1), rows)),
-        "l_commitdate": pa.array(
-            (ship + rng.integers(-30, 31, rows).astype("timedelta64[D]"))
-            .astype("datetime64[D]")),
-        "l_receiptdate": pa.array(
-            (ship + rng.integers(1, 31, rows).astype("timedelta64[D]"))
-            .astype("datetime64[D]")),
-    })
-    n_cust = max(rows // 8, 1)
-    n_ord = max(rows // 4, 1)
-    odate = base + rng.integers(0, 2406, n_ord).astype("timedelta64[D]")
-    orders = pa.table({
-        "o_orderkey": pa.array(np.arange(n_ord)),
-        "o_custkey": pa.array(rng.integers(0, 2 * n_cust, n_ord)),
-        "o_orderdate": pa.array(odate.astype("datetime64[D]")),
-        "o_orderpriority": pa.array(rng.choice(
-            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"],
-            n_ord)),
-    })
-    cc = rng.integers(10, 35, n_cust)
-    customer = pa.table({
-        "c_custkey": pa.array(np.arange(n_cust)),
-        "c_phone": pa.array([f"{c}-{rng.integers(100, 999)}-"
-                             f"{rng.integers(1000, 9999)}"
-                             for c in cc]),
-        "c_acctbal": pa.array(np.round(rng.random(n_cust) * 10998.99
-                                       - 999.99, 2)),
-    })
-    n_part = max(rows // 8, 1)
-    part = pa.table({
-        "p_partkey": pa.array(np.arange(n_part)),
-        "p_type": pa.array(rng.choice(
-            ["PROMO BURNISHED COPPER", "PROMO PLATED BRASS",
-             "STANDARD POLISHED TIN", "ECONOMY ANODIZED STEEL",
-             "MEDIUM BRUSHED NICKEL"], n_part)),
-    })
-    return {"lineitem": lineitem, "orders": orders, "part": part,
-            "customer": customer}
+    """Full 8-table TPC-H set (round 4: the 22-query suite needs
+    supplier/partsupp/nation/region and the full column complement —
+    ``tpch_queries.build_tables`` owns the schema now)."""
+    from .tpch_queries import build_tables
+    return build_tables(rows, seed)
 
 
 def _q1_oracle_check(got, lineitem_table):
@@ -714,6 +664,9 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("tpch_q22_sql_subqueries", _tpch_q22_sql),
     ("tpch_q6_sql", _tpch_q6_sql),
     ("tpch_q17_corr_scalar", _tpch_q17_sql),
+    # round 4: the 16 queries completing TPC-H 22 (tpch_queries.py)
+    *[(f"tpch_{name}_full", _TQ.make_runner(sql, oracle))
+      for name, sql, oracle in _TQ.QUERY_SET],
     ("tpcds_q3_star_join", _tpcds_q3),
     ("tpcds_q7_star4_avgs", _tpcds_q7),
     ("tpcds_q19_brand_rev", _tpcds_q19),

@@ -1,0 +1,835 @@
+"""Full TPC-H schema + the 16 queries completing the 22-query suite.
+
+The reference ships a q1-q38 scale suite (``integration_tests/.../
+scaletest/QuerySpecs.scala``) and its milestone ladder ends at full
+TPC-DS/TPC-H (BASELINE configs 3-4).  ``scaletest.py`` carried 6 TPC-H
+shapes through round 3; this module adds the remaining 16 (q2 q3 q5 q7
+q8 q9 q10 q11 q12 q13 q15 q16 q18 q19 q20 q21) in their REAL spec SQL
+form — multi-table comma FROM, correlated/scalar/IN subqueries, CTEs,
+typed date literals, extract(), mixed-predicate EXISTS — each checked
+against an independent pandas oracle.
+
+``build_tables`` generates the full 8-table schema.  Column
+distributions for the tables that existed in round 3 (lineitem, orders,
+customer, part) are IDENTICAL to the old ``build_tpch_tables`` so the
+existing q1/q4/q6/q14/q17/q22 oracles keep passing; new columns and the
+supplier/partsupp/nation/region tables extend them.
+
+Query predicates are the spec's, with constants tuned only where the
+scaled-down value ranges would return empty results (the point is
+covering the plan shapes, and a non-empty result is what actually
+exercises them).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+
+# ---------------------------------------------------------------------------
+# schema
+# ---------------------------------------------------------------------------
+
+#: the 25 spec nations with their spec region keys (region 0..4 =
+#: AFRICA, AMERICA, ASIA, EUROPE, MIDDLE EAST)
+_NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+             "HOUSEHOLD"]
+_SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+_SHIPINSTRUCT = ["DELIVER IN PERSON", "COLLECT COD", "NONE",
+                 "TAKE BACK RETURN"]
+_CONTAINERS = ["SM CASE", "SM BOX", "SM PACK", "SM PKG",
+               "MED BAG", "MED BOX", "MED PKG", "MED PACK",
+               "LG CASE", "LG BOX", "LG PACK", "LG PKG",
+               "JUMBO BAG", "JUMBO BOX", "WRAP CASE", "WRAP BOX"]
+_P_TYPES = ["PROMO BURNISHED COPPER", "PROMO PLATED BRASS",
+            "STANDARD POLISHED TIN", "ECONOMY ANODIZED STEEL",
+            "MEDIUM BRUSHED NICKEL"]
+_COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+           "blanched", "blue", "blush", "brown", "burlywood", "burnished",
+           "chartreuse", "chiffon", "chocolate", "coral", "cornflower",
+           "cream", "cyan", "dark", "deep", "dim", "dodger", "drab",
+           "firebrick", "floral", "forest", "frosted", "gainsboro",
+           "ghost", "gold", "goldenrod", "green", "grey", "honeydew",
+           "hot", "hotpink", "indian", "ivory", "khaki", "lace",
+           "lavender", "lawn", "lemon", "light", "lime", "linen"]
+
+
+def build_tables(rows: int, seed: int = 23) -> Dict[str, pa.Table]:
+    """Full 8-table TPC-H schema, scaled by ``rows`` (= lineitem rows).
+
+    The lineitem/orders/customer/part columns that existed in round 3
+    keep their value distributions (exact draws differ — the rng stream
+    interleaves the new columns); every scale-rig oracle recomputes from
+    the generated tables, so nothing depends on exact values."""
+    rng = np.random.default_rng(seed)
+    base = np.datetime64("1992-01-01")
+    n_ord = max(rows // 4, 1)
+    n_cust = max(rows // 8, 1)
+    n_part = max(rows // 8, 1)
+    n_supp = max(rows // 600, 10)
+
+    ship = base + rng.integers(0, 2526, rows).astype("timedelta64[D]")
+    l_orderkey = rng.integers(0, n_ord, rows)
+    l_partkey = rng.integers(0, n_part, rows)
+    lineitem = pa.table({
+        "l_quantity": pa.array(rng.integers(1, 51, rows).astype(np.float64)),
+        "l_extendedprice": pa.array(np.round(rng.random(rows) * 104949 + 901,
+                                             2)),
+        "l_discount": pa.array(np.round(rng.integers(0, 11, rows) * 0.01,
+                                        2)),
+        "l_tax": pa.array(np.round(rng.integers(0, 9, rows) * 0.01, 2)),
+        "l_returnflag": pa.array(rng.choice(["A", "N", "R"], rows)),
+        "l_linestatus": pa.array(rng.choice(["O", "F"], rows)),
+        "l_shipdate": pa.array(ship.astype("datetime64[D]")),
+        "l_orderkey": pa.array(l_orderkey),
+        "l_partkey": pa.array(l_partkey),
+        "l_commitdate": pa.array(
+            (ship + rng.integers(-30, 31, rows).astype("timedelta64[D]"))
+            .astype("datetime64[D]")),
+        "l_receiptdate": pa.array(
+            (ship + rng.integers(1, 31, rows).astype("timedelta64[D]"))
+            .astype("datetime64[D]")),
+        # q5/q7/q8/q9/q15/q20/q21 columns: supplier FK rides the partsupp
+        # relation (each part has 4 candidate suppliers) so lineitem
+        # (l_partkey, l_suppkey) pairs hit partsupp rows for q9/q20
+        "l_suppkey": pa.array((l_partkey
+                               + rng.integers(0, 4, rows)
+                               * max(n_supp // 4, 1)) % n_supp),
+        "l_shipmode": pa.array(rng.choice(_SHIPMODES, rows)),
+        "l_shipinstruct": pa.array(rng.choice(_SHIPINSTRUCT, rows)),
+    })
+
+    odate = base + rng.integers(0, 2406, n_ord).astype("timedelta64[D]")
+    # ~1.5% of order comments carry the q13 exclusion pattern
+    ocm = rng.choice(["carefully final deposits", "furiously even asymptot",
+                      "quickly regular pinto beans", "ironic packages wake",
+                      "express special packages requests",
+                      "blithely bold theodolites"],
+                     n_ord, p=[0.24, 0.24, 0.24, 0.2, 0.015, 0.065])
+    orders = pa.table({
+        "o_orderkey": pa.array(np.arange(n_ord)),
+        "o_custkey": pa.array(rng.integers(0, 2 * n_cust, n_ord)),
+        "o_orderdate": pa.array(odate.astype("datetime64[D]")),
+        "o_orderpriority": pa.array(rng.choice(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"],
+            n_ord)),
+        "o_totalprice": pa.array(np.round(rng.random(n_ord) * 450000 + 850,
+                                          2)),
+        "o_shippriority": pa.array(np.zeros(n_ord, dtype=np.int32)),
+        "o_orderstatus": pa.array(rng.choice(["F", "O", "P"], n_ord,
+                                             p=[0.49, 0.49, 0.02])),
+        "o_comment": pa.array(ocm),
+    })
+
+    cc = rng.integers(10, 35, n_cust)
+    customer = pa.table({
+        "c_custkey": pa.array(np.arange(n_cust)),
+        "c_phone": pa.array([f"{c}-{rng.integers(100, 999)}-"
+                             f"{rng.integers(1000, 9999)}"
+                             for c in cc]),
+        "c_acctbal": pa.array(np.round(rng.random(n_cust) * 10998.99
+                                       - 999.99, 2)),
+        "c_name": pa.array([f"Customer#{i:09d}" for i in range(n_cust)]),
+        "c_address": pa.array([f"addr {i % 997}" for i in range(n_cust)]),
+        "c_nationkey": pa.array(rng.integers(0, 25, n_cust)),
+        "c_mktsegment": pa.array(rng.choice(_SEGMENTS, n_cust)),
+        "c_comment": pa.array(rng.choice(
+            ["ironic ideas", "silent accounts", "bold requests"], n_cust)),
+    })
+
+    part = pa.table({
+        "p_partkey": pa.array(np.arange(n_part)),
+        "p_type": pa.array(rng.choice(_P_TYPES, n_part)),
+        "p_name": pa.array([" ".join(rng.choice(_COLORS, 2, replace=False))
+                            for _ in range(n_part)]),
+        "p_brand": pa.array([f"Brand#{m}{n}" for m, n in
+                             zip(rng.integers(1, 6, n_part),
+                                 rng.integers(1, 6, n_part))]),
+        "p_container": pa.array(rng.choice(_CONTAINERS, n_part)),
+        "p_size": pa.array(rng.integers(1, 51, n_part)),
+        "p_mfgr": pa.array([f"Manufacturer#{m}"
+                            for m in rng.integers(1, 6, n_part)]),
+        "p_retailprice": pa.array(np.round(900 + rng.random(n_part) * 1200,
+                                           2)),
+    })
+
+    scm = rng.choice(["blithely regular packages", "furiously final ideas",
+                      "slyly ironic Customer deposits Complaints haggle",
+                      "carefully even theodolites"],
+                     n_supp, p=[0.4, 0.35, 0.05, 0.2])
+    supplier = pa.table({
+        "s_suppkey": pa.array(np.arange(n_supp)),
+        "s_name": pa.array([f"Supplier#{i:09d}" for i in range(n_supp)]),
+        "s_address": pa.array([f"saddr {i % 499}" for i in range(n_supp)]),
+        "s_nationkey": pa.array(rng.integers(0, 25, n_supp)),
+        "s_phone": pa.array([f"{10 + i % 25}-{100 + i % 900}-0000"
+                             for i in range(n_supp)]),
+        "s_acctbal": pa.array(np.round(rng.random(n_supp) * 10998.99
+                                       - 999.99, 2)),
+        "s_comment": pa.array(scm),
+    })
+
+    ps_partkey = np.repeat(np.arange(n_part), 4)
+    ps_suppkey = (ps_partkey + np.tile(np.arange(4), n_part)
+                  * max(n_supp // 4, 1)) % n_supp
+    partsupp = pa.table({
+        "ps_partkey": pa.array(ps_partkey),
+        "ps_suppkey": pa.array(ps_suppkey),
+        "ps_availqty": pa.array(rng.integers(1, 10000, 4 * n_part)),
+        "ps_supplycost": pa.array(np.round(rng.random(4 * n_part) * 999 + 1,
+                                           2)),
+    })
+
+    nation = pa.table({
+        "n_nationkey": pa.array(np.arange(25)),
+        "n_name": pa.array([n for n, _ in _NATIONS]),
+        "n_regionkey": pa.array(np.array([r for _, r in _NATIONS])),
+    })
+    region = pa.table({
+        "r_regionkey": pa.array(np.arange(5)),
+        "r_name": pa.array(_REGIONS),
+    })
+    return {"lineitem": lineitem, "orders": orders, "part": part,
+            "customer": customer, "supplier": supplier,
+            "partsupp": partsupp, "nation": nation, "region": region}
+
+
+def register_views(sess, t: Dict[str, pa.Table], parts: int = 4) -> None:
+    for name, tab in t.items():
+        sess.create_dataframe(tab, num_partitions=parts) \
+            .createOrReplaceTempView(name)
+
+
+def _pandas(t: Dict[str, pa.Table]) -> Dict[str, pd.DataFrame]:
+    return {k: v.to_pandas() for k, v in t.items()}
+
+
+def _check_ordered(got, exp, float_cols, exact_cols, limit=None):
+    """Compare engine output to the oracle frame (already sorted the same
+    way).  With a LIMIT, sort-key ties make the exact row set ambiguous,
+    so assert the row count and the ordered FLOAT sort columns (allclose)
+    plus membership of exact columns in the oracle."""
+    if limit is not None:
+        exp_n = min(limit, len(exp))
+        assert len(got) == exp_n, (len(got), exp_n)
+        exp = exp.head(limit)
+        for c in float_cols:
+            assert np.allclose(np.asarray(got[c], dtype=np.float64),
+                               np.asarray(exp[c], dtype=np.float64)), c
+        for c in exact_cols:
+            # ties may permute rows within equal sort keys
+            assert set(got[c]) <= set(np.asarray(exp[c])) \
+                or list(got[c]) == list(exp[c]), c
+        return
+    assert len(got) == len(exp), (len(got), len(exp))
+    for c in exact_cols:
+        assert list(got[c]) == list(exp[c]), c
+    for c in float_cols:
+        assert np.allclose(np.asarray(got[c], dtype=np.float64),
+                           np.asarray(exp[c], dtype=np.float64)), c
+
+
+# ---------------------------------------------------------------------------
+# the 16 queries
+# ---------------------------------------------------------------------------
+
+Q2 = """
+SELECT s.s_acctbal, s.s_name, n.n_name, p.p_partkey, p.p_mfgr
+FROM part p, supplier s, partsupp ps, nation n, region r
+WHERE p.p_partkey = ps.ps_partkey AND s.s_suppkey = ps.ps_suppkey
+  AND p.p_size = 15 AND p.p_type LIKE '%BRASS'
+  AND s.s_nationkey = n.n_nationkey AND n.n_regionkey = r.r_regionkey
+  AND r.r_name = 'EUROPE'
+  AND ps.ps_supplycost = (SELECT min(ps2.ps_supplycost)
+                          FROM partsupp ps2, supplier s2, nation n2,
+                               region r2
+                          WHERE ps2.ps_partkey = p.p_partkey
+                            AND s2.s_suppkey = ps2.ps_suppkey
+                            AND s2.s_nationkey = n2.n_nationkey
+                            AND n2.n_regionkey = r2.r_regionkey
+                            AND r2.r_name = 'EUROPE')
+ORDER BY s.s_acctbal DESC, n.n_name, s.s_name, p.p_partkey
+LIMIT 100
+"""
+
+
+def q2_oracle(got, p):
+    m = p["part"].merge(p["partsupp"], left_on="p_partkey",
+                        right_on="ps_partkey") \
+        .merge(p["supplier"], left_on="ps_suppkey", right_on="s_suppkey") \
+        .merge(p["nation"], left_on="s_nationkey", right_on="n_nationkey") \
+        .merge(p["region"], left_on="n_regionkey", right_on="r_regionkey")
+    m = m[(m.r_name == "EUROPE")]
+    mins = m.groupby("p_partkey").ps_supplycost.min()
+    sel = m[(m.p_size == 15) & m.p_type.str.endswith("BRASS")
+            & (m.ps_supplycost == m.p_partkey.map(mins))]
+    exp = sel.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                          ascending=[False, True, True, True])
+    _check_ordered(got, exp, ["s_acctbal"], ["p_partkey"], limit=100)
+
+
+Q3 = """
+SELECT l.l_orderkey,
+       sum(l.l_extendedprice * (1 - l.l_discount)) AS revenue,
+       o.o_orderdate, o.o_shippriority
+FROM customer c, orders o, lineitem l
+WHERE c.c_mktsegment = 'BUILDING' AND c.c_custkey = o.o_custkey
+  AND l.l_orderkey = o.o_orderkey
+  AND o.o_orderdate < date '1995-03-15' AND l.l_shipdate > date '1995-03-15'
+GROUP BY l.l_orderkey, o.o_orderdate, o.o_shippriority
+ORDER BY revenue DESC, o.o_orderdate
+LIMIT 10
+"""
+
+
+def q3_oracle(got, p):
+    m = p["customer"].merge(p["orders"], left_on="c_custkey",
+                            right_on="o_custkey") \
+        .merge(p["lineitem"], left_on="o_orderkey", right_on="l_orderkey")
+    cut = datetime.date(1995, 3, 15)
+    m = m[(m.c_mktsegment == "BUILDING") & (m.o_orderdate < cut)
+          & (m.l_shipdate > cut)]
+    m = m.assign(rev=m.l_extendedprice * (1 - m.l_discount))
+    exp = (m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+           .rev.sum().reset_index(name="revenue")
+           .sort_values(["revenue", "o_orderdate"],
+                        ascending=[False, True]))
+    _check_ordered(got, exp, ["revenue"], ["l_orderkey"], limit=10)
+
+
+Q5 = """
+SELECT n.n_name, sum(l.l_extendedprice * (1 - l.l_discount)) AS revenue
+FROM customer c, orders o, lineitem l, supplier s, nation n, region r
+WHERE c.c_custkey = o.o_custkey AND l.l_orderkey = o.o_orderkey
+  AND l.l_suppkey = s.s_suppkey AND c.c_nationkey = s.s_nationkey
+  AND s.s_nationkey = n.n_nationkey AND n.n_regionkey = r.r_regionkey
+  AND r.r_name = 'ASIA'
+  AND o.o_orderdate >= date '1994-01-01'
+  AND o.o_orderdate < date '1995-01-01'
+GROUP BY n.n_name
+ORDER BY revenue DESC
+"""
+
+
+def q5_oracle(got, p):
+    m = p["customer"].merge(p["orders"], left_on="c_custkey",
+                            right_on="o_custkey") \
+        .merge(p["lineitem"], left_on="o_orderkey", right_on="l_orderkey") \
+        .merge(p["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+    m = m[m.c_nationkey == m.s_nationkey]
+    m = m.merge(p["nation"], left_on="s_nationkey", right_on="n_nationkey") \
+        .merge(p["region"], left_on="n_regionkey", right_on="r_regionkey")
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    m = m[(m.r_name == "ASIA") & (m.o_orderdate >= lo)
+          & (m.o_orderdate < hi)]
+    m = m.assign(rev=m.l_extendedprice * (1 - m.l_discount))
+    exp = (m.groupby("n_name").rev.sum().reset_index(name="revenue")
+           .sort_values("revenue", ascending=False))
+    _check_ordered(got, exp, ["revenue"], ["n_name"])
+
+
+Q7 = """
+SELECT supp_nation, cust_nation, l_year, sum(volume) AS revenue
+FROM (SELECT n1.n_name AS supp_nation, n2.n_name AS cust_nation,
+             year(l.l_shipdate) AS l_year,
+             l.l_extendedprice * (1 - l.l_discount) AS volume
+      FROM supplier s, lineitem l, orders o, customer c,
+           nation n1, nation n2
+      WHERE s.s_suppkey = l.l_suppkey AND o.o_orderkey = l.l_orderkey
+        AND c.c_custkey = o.o_custkey
+        AND s.s_nationkey = n1.n_nationkey
+        AND c.c_nationkey = n2.n_nationkey
+        AND ((n1.n_name = 'FRANCE' AND n2.n_name = 'GERMANY')
+             OR (n1.n_name = 'GERMANY' AND n2.n_name = 'FRANCE'))
+        AND l.l_shipdate BETWEEN date '1995-01-01' AND date '1996-12-31'
+     ) shipping
+GROUP BY supp_nation, cust_nation, l_year
+ORDER BY supp_nation, cust_nation, l_year
+"""
+
+
+def q7_oracle(got, p):
+    n = p["nation"]
+    m = p["supplier"].merge(p["lineitem"], left_on="s_suppkey",
+                            right_on="l_suppkey") \
+        .merge(p["orders"], left_on="l_orderkey", right_on="o_orderkey") \
+        .merge(p["customer"], left_on="o_custkey", right_on="c_custkey") \
+        .merge(n.add_suffix("_1"), left_on="s_nationkey",
+               right_on="n_nationkey_1") \
+        .merge(n.add_suffix("_2"), left_on="c_nationkey",
+               right_on="n_nationkey_2")
+    lo, hi = datetime.date(1995, 1, 1), datetime.date(1996, 12, 31)
+    m = m[(m.l_shipdate >= lo) & (m.l_shipdate <= hi)
+          & (((m.n_name_1 == "FRANCE") & (m.n_name_2 == "GERMANY"))
+             | ((m.n_name_1 == "GERMANY") & (m.n_name_2 == "FRANCE")))]
+    m = m.assign(l_year=m.l_shipdate.map(lambda d: d.year),
+                 volume=m.l_extendedprice * (1 - m.l_discount))
+    exp = (m.groupby(["n_name_1", "n_name_2", "l_year"])
+           .volume.sum().reset_index(name="revenue")
+           .rename(columns={"n_name_1": "supp_nation",
+                            "n_name_2": "cust_nation"})
+           .sort_values(["supp_nation", "cust_nation", "l_year"]))
+    _check_ordered(got, exp, ["revenue"],
+                   ["supp_nation", "cust_nation", "l_year"])
+
+
+Q8 = """
+SELECT o_year,
+       sum(CASE WHEN nation = 'BRAZIL' THEN volume ELSE 0 END)
+           / sum(volume) AS mkt_share
+FROM (SELECT year(o.o_orderdate) AS o_year,
+             l.l_extendedprice * (1 - l.l_discount) AS volume,
+             n2.n_name AS nation
+      FROM part p, supplier s, lineitem l, orders o, customer c,
+           nation n1, nation n2, region r
+      WHERE p.p_partkey = l.l_partkey AND s.s_suppkey = l.l_suppkey
+        AND l.l_orderkey = o.o_orderkey AND o.o_custkey = c.c_custkey
+        AND c.c_nationkey = n1.n_nationkey
+        AND n1.n_regionkey = r.r_regionkey AND r.r_name = 'AMERICA'
+        AND s.s_nationkey = n2.n_nationkey
+        AND o.o_orderdate BETWEEN date '1995-01-01' AND date '1996-12-31'
+        AND p.p_type = 'ECONOMY ANODIZED STEEL') all_nations
+GROUP BY o_year
+ORDER BY o_year
+"""
+
+
+def q8_oracle(got, p):
+    n = p["nation"]
+    m = p["part"].merge(p["lineitem"], left_on="p_partkey",
+                        right_on="l_partkey") \
+        .merge(p["supplier"], left_on="l_suppkey", right_on="s_suppkey") \
+        .merge(p["orders"], left_on="l_orderkey", right_on="o_orderkey") \
+        .merge(p["customer"], left_on="o_custkey", right_on="c_custkey") \
+        .merge(n.add_suffix("_1"), left_on="c_nationkey",
+               right_on="n_nationkey_1") \
+        .merge(p["region"], left_on="n_regionkey_1",
+               right_on="r_regionkey") \
+        .merge(n.add_suffix("_2"), left_on="s_nationkey",
+               right_on="n_nationkey_2")
+    lo, hi = datetime.date(1995, 1, 1), datetime.date(1996, 12, 31)
+    m = m[(m.r_name == "AMERICA") & (m.o_orderdate >= lo)
+          & (m.o_orderdate <= hi)
+          & (m.p_type == "ECONOMY ANODIZED STEEL")]
+    m = m.assign(o_year=m.o_orderdate.map(lambda d: d.year),
+                 volume=m.l_extendedprice * (1 - m.l_discount))
+    g = m.groupby("o_year").apply(
+        lambda x: x.volume[x.n_name_2 == "BRAZIL"].sum()
+        / x.volume.sum(), include_groups=False)
+    exp = g.reset_index(name="mkt_share").sort_values("o_year")
+    _check_ordered(got, exp, ["mkt_share"], ["o_year"])
+
+
+Q9 = """
+SELECT nation, o_year, sum(amount) AS sum_profit
+FROM (SELECT n.n_name AS nation, year(o.o_orderdate) AS o_year,
+             l.l_extendedprice * (1 - l.l_discount)
+               - ps.ps_supplycost * l.l_quantity AS amount
+      FROM part p, supplier s, lineitem l, partsupp ps, orders o, nation n
+      WHERE s.s_suppkey = l.l_suppkey
+        AND ps.ps_suppkey = l.l_suppkey AND ps.ps_partkey = l.l_partkey
+        AND p.p_partkey = l.l_partkey AND o.o_orderkey = l.l_orderkey
+        AND s.s_nationkey = n.n_nationkey
+        AND p.p_name LIKE '%green%') profit
+GROUP BY nation, o_year
+ORDER BY nation, o_year DESC
+"""
+
+
+def q9_oracle(got, p):
+    m = p["lineitem"].merge(p["part"], left_on="l_partkey",
+                            right_on="p_partkey") \
+        .merge(p["supplier"], left_on="l_suppkey", right_on="s_suppkey") \
+        .merge(p["partsupp"],
+               left_on=["l_partkey", "l_suppkey"],
+               right_on=["ps_partkey", "ps_suppkey"]) \
+        .merge(p["orders"], left_on="l_orderkey", right_on="o_orderkey") \
+        .merge(p["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    m = m[m.p_name.str.contains("green")]
+    m = m.assign(o_year=m.o_orderdate.map(lambda d: d.year),
+                 amount=m.l_extendedprice * (1 - m.l_discount)
+                 - m.ps_supplycost * m.l_quantity)
+    exp = (m.groupby(["n_name", "o_year"]).amount.sum()
+           .reset_index(name="sum_profit")
+           .rename(columns={"n_name": "nation"})
+           .sort_values(["nation", "o_year"], ascending=[True, False]))
+    _check_ordered(got, exp, ["sum_profit"], ["nation", "o_year"])
+
+
+Q10 = """
+SELECT c.c_custkey, c.c_name,
+       sum(l.l_extendedprice * (1 - l.l_discount)) AS revenue,
+       c.c_acctbal, n.n_name
+FROM customer c, orders o, lineitem l, nation n
+WHERE c.c_custkey = o.o_custkey AND l.l_orderkey = o.o_orderkey
+  AND o.o_orderdate >= date '1993-10-01'
+  AND o.o_orderdate < date '1994-01-01'
+  AND l.l_returnflag = 'R' AND c.c_nationkey = n.n_nationkey
+GROUP BY c.c_custkey, c.c_name, c.c_acctbal, n.n_name
+ORDER BY revenue DESC
+LIMIT 20
+"""
+
+
+def q10_oracle(got, p):
+    m = p["customer"].merge(p["orders"], left_on="c_custkey",
+                            right_on="o_custkey") \
+        .merge(p["lineitem"], left_on="o_orderkey", right_on="l_orderkey") \
+        .merge(p["nation"], left_on="c_nationkey", right_on="n_nationkey")
+    lo, hi = datetime.date(1993, 10, 1), datetime.date(1994, 1, 1)
+    m = m[(m.o_orderdate >= lo) & (m.o_orderdate < hi)
+          & (m.l_returnflag == "R")]
+    m = m.assign(rev=m.l_extendedprice * (1 - m.l_discount))
+    exp = (m.groupby(["c_custkey", "c_name", "c_acctbal", "n_name"])
+           .rev.sum().reset_index(name="revenue")
+           .sort_values("revenue", ascending=False))
+    _check_ordered(got, exp, ["revenue"], ["c_custkey"], limit=20)
+
+
+Q11 = """
+SELECT ps.ps_partkey, sum(ps.ps_supplycost * ps.ps_availqty) AS value
+FROM partsupp ps, supplier s, nation n
+WHERE ps.ps_suppkey = s.s_suppkey AND s.s_nationkey = n.n_nationkey
+  AND n.n_name = 'GERMANY'
+GROUP BY ps.ps_partkey
+HAVING sum(ps.ps_supplycost * ps.ps_availqty) >
+       (SELECT sum(ps2.ps_supplycost * ps2.ps_availqty) * 0.005
+        FROM partsupp ps2, supplier s2, nation n2
+        WHERE ps2.ps_suppkey = s2.s_suppkey
+          AND s2.s_nationkey = n2.n_nationkey AND n2.n_name = 'GERMANY')
+ORDER BY value DESC
+"""
+
+
+def q11_oracle(got, p):
+    m = p["partsupp"].merge(p["supplier"], left_on="ps_suppkey",
+                            right_on="s_suppkey") \
+        .merge(p["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    m = m[m.n_name == "GERMANY"]
+    m = m.assign(v=m.ps_supplycost * m.ps_availqty)
+    g = m.groupby("ps_partkey").v.sum()
+    exp = (g[g > g.sum() * 0.005].reset_index(name="value")
+           .sort_values("value", ascending=False))
+    _check_ordered(got, exp, ["value"], ["ps_partkey"])
+
+
+Q12 = """
+SELECT l.l_shipmode,
+       sum(CASE WHEN o.o_orderpriority = '1-URGENT'
+                  OR o.o_orderpriority = '2-HIGH'
+                THEN 1 ELSE 0 END) AS high_line_count,
+       sum(CASE WHEN o.o_orderpriority <> '1-URGENT'
+                 AND o.o_orderpriority <> '2-HIGH'
+                THEN 1 ELSE 0 END) AS low_line_count
+FROM orders o, lineitem l
+WHERE o.o_orderkey = l.l_orderkey AND l.l_shipmode IN ('MAIL', 'SHIP')
+  AND l.l_commitdate < l.l_receiptdate AND l.l_shipdate < l.l_commitdate
+  AND l.l_receiptdate >= date '1994-01-01'
+  AND l.l_receiptdate < date '1995-01-01'
+GROUP BY l.l_shipmode
+ORDER BY l.l_shipmode
+"""
+
+
+def q12_oracle(got, p):
+    m = p["orders"].merge(p["lineitem"], left_on="o_orderkey",
+                          right_on="l_orderkey")
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    m = m[m.l_shipmode.isin(["MAIL", "SHIP"])
+          & (m.l_commitdate < m.l_receiptdate)
+          & (m.l_shipdate < m.l_commitdate)
+          & (m.l_receiptdate >= lo) & (m.l_receiptdate < hi)]
+    hi_p = m.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    exp = (pd.DataFrame({"l_shipmode": m.l_shipmode, "hi": hi_p})
+           .groupby("l_shipmode")
+           .agg(high_line_count=("hi", "sum"),
+                low_line_count=("hi", lambda s: int((~s).sum())))
+           .sort_index().reset_index())
+    _check_ordered(got, exp, [], ["l_shipmode", "high_line_count",
+                                  "low_line_count"])
+
+
+Q13 = """
+SELECT c_count, count(*) AS custdist
+FROM (SELECT c.c_custkey AS c_custkey, count(o.o_orderkey) AS c_count
+      FROM customer c LEFT JOIN
+           (SELECT * FROM orders
+            WHERE o_comment NOT LIKE '%special%requests%') o
+           ON c.c_custkey = o.o_custkey
+      GROUP BY c.c_custkey) c_orders
+GROUP BY c_count
+ORDER BY custdist DESC, c_count DESC
+"""
+
+
+def q13_oracle(got, p):
+    o = p["orders"]
+    o = o[~o.o_comment.str.match(".*special.*requests.*")]
+    counts = o.groupby("o_custkey").size()
+    per_cust = p["customer"].c_custkey.map(counts).fillna(0).astype(int)
+    exp = (per_cust.value_counts().rename_axis("c_count")
+           .reset_index(name="custdist")
+           .sort_values(["custdist", "c_count"], ascending=[False, False]))
+    _check_ordered(got, exp, [], ["c_count", "custdist"])
+
+
+Q15 = """
+WITH revenue AS
+  (SELECT l_suppkey AS supplier_no,
+          sum(l_extendedprice * (1 - l_discount)) AS total_revenue
+   FROM lineitem
+   WHERE l_shipdate >= date '1996-01-01' AND l_shipdate < date '1996-04-01'
+   GROUP BY l_suppkey)
+SELECT s.s_suppkey, s.s_name, total_revenue
+FROM supplier s, revenue
+WHERE s.s_suppkey = supplier_no
+  AND total_revenue = (SELECT max(total_revenue) FROM revenue)
+ORDER BY s.s_suppkey
+"""
+
+
+def q15_oracle(got, p):
+    li = p["lineitem"]
+    lo, hi = datetime.date(1996, 1, 1), datetime.date(1996, 4, 1)
+    li = li[(li.l_shipdate >= lo) & (li.l_shipdate < hi)]
+    rev = (li.l_extendedprice * (1 - li.l_discount)) \
+        .groupby(li.l_suppkey).sum()
+    best = rev[rev == rev.max()].reset_index()
+    best.columns = ["s_suppkey", "total_revenue"]
+    exp = best.merge(p["supplier"], on="s_suppkey").sort_values("s_suppkey")
+    _check_ordered(got, exp, ["total_revenue"], ["s_suppkey"])
+
+
+Q16 = """
+SELECT p.p_brand, p.p_type, p.p_size,
+       count(DISTINCT ps.ps_suppkey) AS supplier_cnt
+FROM partsupp ps, part p
+WHERE p.p_partkey = ps.ps_partkey AND p.p_brand <> 'Brand#45'
+  AND p.p_type NOT LIKE 'MEDIUM POLISHED%'
+  AND p.p_size IN (49, 14, 23, 45, 19, 3, 36, 9)
+  AND ps.ps_suppkey NOT IN (SELECT s_suppkey FROM supplier
+                            WHERE s_comment LIKE '%Customer%Complaints%')
+GROUP BY p.p_brand, p.p_type, p.p_size
+ORDER BY supplier_cnt DESC, p.p_brand, p.p_type, p.p_size
+"""
+
+
+def q16_oracle(got, p):
+    bad = set(p["supplier"].s_suppkey[
+        p["supplier"].s_comment.str.match(".*Customer.*Complaints.*")])
+    m = p["partsupp"].merge(p["part"], left_on="ps_partkey",
+                            right_on="p_partkey")
+    m = m[(m.p_brand != "Brand#45")
+          & ~m.p_type.str.startswith("MEDIUM POLISHED")
+          & m.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+          & ~m.ps_suppkey.isin(bad)]
+    exp = (m.groupby(["p_brand", "p_type", "p_size"])
+           .ps_suppkey.nunique().reset_index(name="supplier_cnt")
+           .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                        ascending=[False, True, True, True]))
+    _check_ordered(got, exp, [], ["p_brand", "p_type", "p_size",
+                                  "supplier_cnt"])
+
+
+Q18 = """
+SELECT c.c_name, c.c_custkey, o.o_orderkey, o.o_orderdate,
+       o.o_totalprice, sum(l.l_quantity) AS total_qty
+FROM customer c, orders o, lineitem l
+WHERE o.o_orderkey IN (SELECT l_orderkey FROM lineitem
+                       GROUP BY l_orderkey HAVING sum(l_quantity) > 180)
+  AND c.c_custkey = o.o_custkey AND o.o_orderkey = l.l_orderkey
+GROUP BY c.c_name, c.c_custkey, o.o_orderkey, o.o_orderdate, o.o_totalprice
+ORDER BY o.o_totalprice DESC, o.o_orderdate
+LIMIT 100
+"""
+
+
+def q18_oracle(got, p):
+    li = p["lineitem"]
+    big = li.groupby("l_orderkey").l_quantity.sum()
+    big = set(big[big > 180].index)
+    m = p["customer"].merge(p["orders"], left_on="c_custkey",
+                            right_on="o_custkey") \
+        .merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    m = m[m.o_orderkey.isin(big)]
+    exp = (m.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                      "o_totalprice"])
+           .l_quantity.sum().reset_index(name="total_qty")
+           .sort_values(["o_totalprice", "o_orderdate"],
+                        ascending=[False, True]))
+    _check_ordered(got, exp, ["o_totalprice"], ["o_orderkey"], limit=100)
+
+
+Q19 = """
+SELECT sum(l.l_extendedprice * (1 - l.l_discount)) AS revenue
+FROM lineitem l, part p
+WHERE (p.p_partkey = l.l_partkey AND p.p_brand = 'Brand#12'
+       AND p.p_container IN ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+       AND l.l_quantity >= 1 AND l.l_quantity <= 11
+       AND p.p_size BETWEEN 1 AND 5
+       AND l.l_shipmode IN ('AIR', 'REG AIR')
+       AND l.l_shipinstruct = 'DELIVER IN PERSON')
+   OR (p.p_partkey = l.l_partkey AND p.p_brand = 'Brand#23'
+       AND p.p_container IN ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK')
+       AND l.l_quantity >= 10 AND l.l_quantity <= 20
+       AND p.p_size BETWEEN 1 AND 10
+       AND l.l_shipmode IN ('AIR', 'REG AIR')
+       AND l.l_shipinstruct = 'DELIVER IN PERSON')
+   OR (p.p_partkey = l.l_partkey AND p.p_brand = 'Brand#34'
+       AND p.p_container IN ('LG CASE', 'LG BOX', 'LG PACK', 'LG PKG')
+       AND l.l_quantity >= 20 AND l.l_quantity <= 30
+       AND p.p_size BETWEEN 1 AND 15
+       AND l.l_shipmode IN ('AIR', 'REG AIR')
+       AND l.l_shipinstruct = 'DELIVER IN PERSON')
+"""
+
+
+def q19_oracle(got, p):
+    m = p["lineitem"].merge(p["part"], left_on="l_partkey",
+                            right_on="p_partkey")
+    common = (m.l_shipmode.isin(["AIR", "REG AIR"])
+              & (m.l_shipinstruct == "DELIVER IN PERSON"))
+    b1 = ((m.p_brand == "Brand#12")
+          & m.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+          & (m.l_quantity >= 1) & (m.l_quantity <= 11)
+          & (m.p_size >= 1) & (m.p_size <= 5))
+    b2 = ((m.p_brand == "Brand#23")
+          & m.p_container.isin(["MED BAG", "MED BOX", "MED PKG",
+                                "MED PACK"])
+          & (m.l_quantity >= 10) & (m.l_quantity <= 20)
+          & (m.p_size >= 1) & (m.p_size <= 10))
+    b3 = ((m.p_brand == "Brand#34")
+          & m.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+          & (m.l_quantity >= 20) & (m.l_quantity <= 30)
+          & (m.p_size >= 1) & (m.p_size <= 15))
+    sel = m[common & (b1 | b2 | b3)]
+    exp = float((sel.l_extendedprice * (1 - sel.l_discount)).sum())
+    val = got["revenue"].iloc[0]
+    val = 0.0 if pd.isna(val) else float(val)
+    assert abs(val - exp) <= 1e-6 * max(abs(exp), 1.0), (val, exp)
+
+
+Q20 = """
+SELECT s.s_name, s.s_address
+FROM supplier s, nation n
+WHERE s.s_suppkey IN
+      (SELECT ps.ps_suppkey FROM partsupp ps
+       WHERE ps.ps_partkey IN (SELECT p_partkey FROM part
+                               WHERE p_name LIKE 'forest%')
+         AND ps.ps_availqty > (SELECT 0.5 * sum(l.l_quantity)
+                               FROM lineitem l
+                               WHERE l.l_partkey = ps.ps_partkey
+                                 AND l.l_suppkey = ps.ps_suppkey
+                                 AND l.l_shipdate >= date '1994-01-01'
+                                 AND l.l_shipdate < date '1995-01-01'))
+  AND s.s_nationkey = n.n_nationkey AND n.n_name = 'CANADA'
+ORDER BY s.s_name
+"""
+
+
+def q20_oracle(got, p):
+    li = p["lineitem"]
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    li = li[(li.l_shipdate >= lo) & (li.l_shipdate < hi)]
+    half = li.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum() * 0.5
+    forest = set(p["part"].p_partkey[
+        p["part"].p_name.str.startswith("forest")])
+    ps = p["partsupp"]
+    ps = ps[ps.ps_partkey.isin(forest)]
+    key = list(zip(ps.ps_partkey, ps.ps_suppkey))
+    th = pd.Series([half.get(k, np.nan) for k in key], index=ps.index)
+    good = set(ps.ps_suppkey[ps.ps_availqty > th])
+    s = p["supplier"].merge(p["nation"], left_on="s_nationkey",
+                            right_on="n_nationkey")
+    exp = s[(s.n_name == "CANADA") & s.s_suppkey.isin(good)] \
+        .sort_values("s_name")
+    _check_ordered(got, exp, [], ["s_name", "s_address"])
+
+
+Q21 = """
+SELECT s.s_name, count(*) AS numwait
+FROM supplier s, lineitem l1, orders o, nation n
+WHERE s.s_suppkey = l1.l_suppkey AND o.o_orderkey = l1.l_orderkey
+  AND o.o_orderstatus = 'F' AND l1.l_receiptdate > l1.l_commitdate
+  AND EXISTS (SELECT 1 FROM lineitem l2
+              WHERE l2.l_orderkey = l1.l_orderkey
+                AND l2.l_suppkey <> l1.l_suppkey)
+  AND NOT EXISTS (SELECT 1 FROM lineitem l3
+                  WHERE l3.l_orderkey = l1.l_orderkey
+                    AND l3.l_suppkey <> l1.l_suppkey
+                    AND l3.l_receiptdate > l3.l_commitdate)
+  AND s.s_nationkey = n.n_nationkey AND n.n_name = 'SAUDI ARABIA'
+GROUP BY s.s_name
+ORDER BY numwait DESC, s.s_name
+LIMIT 100
+"""
+
+
+def q21_oracle(got, p):
+    li = p["lineitem"]
+    late = li[li.l_receiptdate > li.l_commitdate]
+    # orders with >1 distinct supplier / >1 distinct LATE supplier
+    nsupp = li.groupby("l_orderkey").l_suppkey.nunique()
+    nlate = late.groupby("l_orderkey").l_suppkey.nunique()
+    m = p["supplier"].merge(late, left_on="s_suppkey",
+                            right_on="l_suppkey") \
+        .merge(p["orders"], left_on="l_orderkey", right_on="o_orderkey") \
+        .merge(p["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    m = m[(m.o_orderstatus == "F") & (m.n_name == "SAUDI ARABIA")]
+    multi = m.l_orderkey.map(nsupp) > 1
+    # NOT EXISTS other-late-supplier: this supplier is the ONLY late one
+    only_late = m.l_orderkey.map(nlate) == 1
+    sel = m[multi & only_late]
+    exp = (sel.groupby("s_name").size().reset_index(name="numwait")
+           .sort_values(["numwait", "s_name"], ascending=[False, True]))
+    _check_ordered(got, exp, [], ["s_name", "numwait"], limit=100)
+
+
+#: name -> (sql, oracle); names align with the spec numbering
+QUERY_SET: List[Tuple[str, str, Callable]] = [
+    ("q2", Q2, q2_oracle), ("q3", Q3, q3_oracle), ("q5", Q5, q5_oracle),
+    ("q7", Q7, q7_oracle), ("q8", Q8, q8_oracle), ("q9", Q9, q9_oracle),
+    ("q10", Q10, q10_oracle), ("q11", Q11, q11_oracle),
+    ("q12", Q12, q12_oracle), ("q13", Q13, q13_oracle),
+    ("q15", Q15, q15_oracle), ("q16", Q16, q16_oracle),
+    ("q18", Q18, q18_oracle), ("q19", Q19, q19_oracle),
+    ("q20", Q20, q20_oracle), ("q21", Q21, q21_oracle),
+]
+
+
+#: single-entry caches — run_suite calls each runner twice (cold+warm)
+#: over one shared table set; re-registering 8 views and re-converting 8
+#: tables to pandas inside every timed run would land in warm_seconds,
+#: the number the rig compares across machines
+_view_cache: list = [None]   # (id(sess), id(t))
+_pandas_cache: list = [None]  # (id(t), {name: DataFrame})
+
+
+def make_runner(sql: str, oracle: Callable) -> Callable:
+    """Adapt one query to the scaletest (sess, tables, F) protocol."""
+    def run(sess, t, F):
+        key = (id(sess), id(t))
+        if _view_cache[0] != key:
+            register_views(sess, t)
+            _view_cache[0] = key
+        if _pandas_cache[0] is None or _pandas_cache[0][0] != id(t):
+            _pandas_cache[0] = (id(t), _pandas(t))
+        got = sess.sql(sql).collect().to_pandas()
+        oracle(got, _pandas_cache[0][1])
+    return run
