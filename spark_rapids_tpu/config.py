@@ -210,6 +210,24 @@ ADAPTIVE_COALESCE_ROWS = register(
     "everything to one reduce partition (AQE partition coalescing, "
     "GpuCustomShuffleReaderExec analog): tiny post-aggregation states "
     "stop paying per-partition split/launch/sync overhead.", 1 << 16)
+SKEW_JOIN_ENABLED = register(
+    "spark.sql.adaptive.skewJoin.enabled",
+    "Skewed-partition splitting at exchange materialization (the "
+    "reference's GpuCustomShuffleReaderExec skewed-partition specs, "
+    "GpuCustomShuffleReaderExec.scala:87): a reduce partition whose row "
+    "count exceeds skewedPartitionFactor x the median non-empty "
+    "partition (and the row threshold) is kept as contiguous chunks "
+    "instead of one batch, so a downstream shuffled hash join probes it "
+    "chunk-by-chunk against the full build partition with bounded "
+    "memory — proactively, not via the OOM-retry path.", True)
+SKEW_JOIN_FACTOR = register(
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor",
+    "A partition is skewed when its rows exceed this factor times the "
+    "median non-empty partition's rows (Spark's default).", 5)
+SKEW_JOIN_ROWS = register(
+    "spark.sql.adaptive.skewJoin.skewedPartitionRowsThreshold",
+    "...and also exceed this absolute row count (the rows analog of "
+    "Spark's skewedPartitionThresholdInBytes).", 1 << 17)
 OPTIMIZER_ENABLED = register(
     "spark.rapids.sql.optimizer.enabled",
     "Cost-based optimizer: flips subtrees back to the host engine when the "

@@ -29,12 +29,23 @@ def empty_batch_for(attrs) -> ColumnarBatch:
         T.StructField(a.name, a.dtype, True) for a in attrs)))
 
 
+#: observability for the AQE skew-split reader (tests assert on these)
+STATS = {"skew_splits": 0, "skew_chunks": 0}
+
+
 class ShuffleExchangeExec(PhysicalPlan):
     def __init__(self, partitioning: Partitioning, child: PhysicalPlan,
-                 backend=TPU, coalescible: bool = True):
+                 backend=TPU, coalescible: bool = True,
+                 skew_splittable: bool = False):
         super().__init__(child)
         self.backend = backend
         self.partitioning = partitioning.bind(child.output)
+        #: skew splitting only pays off for consumers that STREAM their
+        #: per-partition batches (shuffled-hash-join probe sides); an
+        #: aggregate/sort/window would just concat the chunks back at
+        #: device-copy cost, so the join planner opts the probe exchange
+        #: in explicitly (same pattern as coalescible/map_side_filter)
+        self._skew_splittable = skew_splittable
         #: AQE partition coalescing is only sound when no sibling exchange
         #: must stay aligned with this one — the two exchanges feeding a
         #: co-partitioned join decide INDEPENDENTLY, so one coalescing
@@ -131,6 +142,7 @@ class ShuffleExchangeExec(PhysicalPlan):
             # publish nothing for the peer slices to pull
             if self._try_mesh_materialize(map_out, nt):
                 tctx.inc_metric("meshExchanges")
+                self._maybe_skew_split(tctx)
                 return
             tctx.inc_metric("meshFallbacks")
 
@@ -168,6 +180,54 @@ class ShuffleExchangeExec(PhysicalPlan):
             # reclamation to the TTL sweep instead of leaking forever
             mgr.defer_cleanup(shuffle_id)
         self._materialized = out
+        self._maybe_skew_split(tctx)
+
+    def _maybe_skew_split(self, tctx: TaskContext) -> None:
+        """AQE skew handling at the reader (reference
+        ``GpuCustomShuffleReaderExec.scala:87-91`` skewed-partition
+        specs): a materialized reduce partition whose row count exceeds
+        skewedPartitionFactor x the median non-empty partition (and the
+        absolute row threshold) is re-sliced into contiguous
+        median-sized chunks.  Downstream shuffled hash joins stream
+        probe batches, so each chunk joins against the full build
+        partition — one hot key no longer sends the join through the
+        OOM-retry path.  Chunks stay inside their partition, so key
+        co-location (and range order: slices are contiguous) is
+        untouched, which also keeps it safe for co-partitioned sibling
+        exchanges, unlike coalescing."""
+        from ...config import (ADAPTIVE_ENABLED, SKEW_JOIN_ENABLED,
+                               SKEW_JOIN_FACTOR, SKEW_JOIN_ROWS)
+        if not (self._skew_splittable
+                and bool(tctx.conf.get(ADAPTIVE_ENABLED))
+                and bool(tctx.conf.get(SKEW_JOIN_ENABLED))):
+            return
+        sizes = [sum(b.num_rows_int for b in p)
+                 for p in self._materialized]
+        nonzero = sorted(s for s in sizes if s > 0)
+        if len(nonzero) < 2:
+            return
+        median = nonzero[len(nonzero) // 2]
+        factor = int(tctx.conf.get(SKEW_JOIN_FACTOR))
+        thresh = int(tctx.conf.get(SKEW_JOIN_ROWS))
+        target = max(median, thresh // factor, 1)
+        for t, part in enumerate(self._materialized):
+            if sizes[t] <= thresh or sizes[t] <= factor * median:
+                continue
+            chunks: List[ColumnarBatch] = []
+            for b in part:
+                n = b.num_rows_int
+                k = -(-n // target)
+                if k <= 1:
+                    chunks.append(b)
+                    continue
+                step = -(-n // k)
+                for off in range(0, n, step):
+                    chunks.append(b.sliced(off, min(step, n - off)))
+            if len(chunks) > len(part):
+                STATS["skew_splits"] += 1
+                STATS["skew_chunks"] += len(chunks) - len(part)
+                tctx.inc_metric("skewSplitPartitions")
+                self._materialized[t] = chunks
 
     def _empty_batch(self) -> ColumnarBatch:
         return empty_batch_for(self.output)

@@ -678,7 +678,8 @@ class AdaptiveJoinExec(PhysicalPlan):
             from .exchange import ShuffleExchangeExec
             lx = ShuffleExchangeExec(
                 HashPartitioning(node.left_keys, n), left,
-                backend=self.backend, coalescible=False)
+                backend=self.backend, coalescible=False,
+                skew_splittable=node.how != "full")
             rx = ShuffleExchangeExec(
                 HashPartitioning(node.right_keys, n), right_m,
                 backend=self.backend, coalescible=False)
@@ -763,7 +764,7 @@ def plan_join(node, left: PhysicalPlan, right: PhysicalPlan, backend,
         n = int(conf.shuffle_partitions)
         left = ShuffleExchangeExec(
             HashPartitioning(node.left_keys, n), left, backend=backend,
-            coalescible=False)
+            coalescible=False, skew_splittable=how != "full")
         right = ShuffleExchangeExec(
             HashPartitioning(node.right_keys, n), right, backend=backend,
             coalescible=False)

@@ -151,3 +151,81 @@ def test_cost_optimizer_unknown_stats_keep_device(tmp_path):
         assert "CpuProject" not in rep
     finally:
         srt.session(**{"spark.rapids.sql.optimizer.enabled": False})
+
+
+def test_skew_split_at_exchange(rng):
+    """AQE skew handling (GpuCustomShuffleReaderExec skewed-partition
+    specs): a hot-key reduce partition is re-sliced into median-sized
+    chunks at materialization, the shuffled hash join probes chunk by
+    chunk, results still match pandas, and the OOM-retry path never
+    fires (VERDICT r3 #3 done-criteria)."""
+    from spark_rapids_tpu.memory import oom_guard
+    from spark_rapids_tpu.sql.physical import exchange as EX
+
+    n, n_keys = 120_000, 400
+    # 50% of probe rows land on ONE key -> one reduce partition ~50x the
+    # median
+    hot = np.zeros(n // 2, dtype=np.int64)
+    cold = rng.integers(1, n_keys, n - n // 2)
+    keys = np.concatenate([hot, cold])
+    rng.shuffle(keys)
+    fact = pa.table({"k": pa.array(keys), "v": rng.random(n)})
+    dim = pa.table({"k": pa.array(np.arange(n_keys, dtype=np.int64)),
+                    "w": rng.random(n_keys)})
+    sess = srt.session(**{
+        "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
+        "spark.sql.adaptive.skewJoin.skewedPartitionRowsThreshold": 2000,
+    })
+    try:
+        f = sess.create_dataframe(fact, num_partitions=4)
+        d = sess.create_dataframe(dim, num_partitions=2)
+        splits0 = EX.STATS["skew_splits"]
+        oom0 = oom_guard.STATS["oom_caught"]
+        got = (f.join(d, on="k", how="inner")
+               .groupBy("k").agg(F.sum(F.col("v")).alias("sv"),
+                                 F.count("*").alias("c"))
+               .orderBy("k").collect().to_pandas())
+        assert EX.STATS["skew_splits"] > splits0, "skew split did not fire"
+        assert EX.STATS["skew_chunks"] > 0
+        assert oom_guard.STATS["oom_caught"] == oom0
+        m = fact.to_pandas().merge(dim.to_pandas(), on="k")
+        exp = (m.groupby("k").agg(sv=("v", "sum"), c=("v", "size"))
+               .sort_index().reset_index())
+        assert np.array_equal(got["k"], exp["k"])
+        assert np.array_equal(got["c"], exp["c"])
+        assert np.allclose(got["sv"], exp["sv"])
+    finally:
+        sess.conf.set(
+            "spark.sql.adaptive.skewJoin.skewedPartitionRowsThreshold",
+            1 << 17)
+        sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold",
+                      10 * 1024 * 1024)
+
+
+def test_skew_split_kill_switch(rng):
+    from spark_rapids_tpu.sql.physical import exchange as EX
+    n = 60_000
+    keys = np.concatenate([np.zeros(n // 2, dtype=np.int64),
+                           rng.integers(1, 200, n - n // 2)])
+    fact = pa.table({"k": pa.array(keys), "v": rng.random(n)})
+    dim = pa.table({"k": pa.array(np.arange(200, dtype=np.int64)),
+                    "w": rng.random(200)})
+    sess = srt.session(**{
+        "spark.rapids.sql.autoBroadcastJoinThreshold": -1,
+        "spark.sql.adaptive.skewJoin.enabled": False,
+        "spark.sql.adaptive.skewJoin.skewedPartitionRowsThreshold": 2000,
+    })
+    try:
+        f = sess.create_dataframe(fact, num_partitions=4)
+        d = sess.create_dataframe(dim, num_partitions=2)
+        splits0 = EX.STATS["skew_splits"]
+        n_got = f.join(d, on="k", how="inner").count()
+        assert EX.STATS["skew_splits"] == splits0
+        assert n_got == n
+    finally:
+        sess.conf.set("spark.sql.adaptive.skewJoin.enabled", True)
+        sess.conf.set(
+            "spark.sql.adaptive.skewJoin.skewedPartitionRowsThreshold",
+            1 << 17)
+        sess.conf.set("spark.rapids.sql.autoBroadcastJoinThreshold",
+                      10 * 1024 * 1024)
