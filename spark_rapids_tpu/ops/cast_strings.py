@@ -515,6 +515,84 @@ def parse_decimal(xp, chars, lengths, validity, precision: int,
     return signed, ok
 
 
+def parse_decimal128(xp, chars, lengths, validity, precision: int,
+                     scale: int):
+    """(lo, hi, ok): string -> decimal(19 <= p <= 38, s) as 128-bit
+    (lo, hi) int64 word pairs, exact integer arithmetic.
+
+    Unlike the <=18 path (single uint64 mantissa, post-hoc scale shift),
+    this computes a per-digit RESULT exponent e = digits-after + shift
+    and buckets each digit's contribution directly: e in [19, 37] ->
+    high accumulator A (place 10^(e-19)), e in [0, 18] -> low
+    accumulator B, e == -1 -> the HALF_UP rounding digit (round up iff
+    >= 5), e < -1 -> below the ulp.  The value is then A*10^19 + B (+1),
+    assembled with the chunked 128-bit ops (ops/decimal128.py), so a
+    variable per-row shift never needs a >2^31 multiplier."""
+    from . import decimal128 as D
+    width = chars.shape[1]
+    pos = xp.arange(width, dtype=xp.int32)[None, :]
+    c = chars.astype(xp.int32)
+    start, end = _trimmed(xp, chars, lengths)
+    mp = _mantissa_parts(xp, c, pos, start, end)
+    in_mant = mp["in_int"] | mp["in_frac"]
+    is_digit = mp["is_digit"]
+    n_frac = xp.sum(mp["in_frac"].astype(xp.int32), axis=1)
+    n_mant = xp.sum(in_mant.astype(xp.int32), axis=1)
+    bigw = xp.asarray(width, dtype=xp.int32)
+
+    nonzero = in_mant & is_digit & (c != _ZERO)
+    first_sig = xp.min(xp.where(nonzero, pos, bigw), axis=1) \
+        .astype(xp.int32)
+    sig = in_mant & (pos >= first_sig[:, None])
+    sig_idx = xp.cumsum(sig.astype(xp.int32), axis=1) - sig.astype(
+        xp.int32)
+    # keep 39 digits: precision + 1 GUARD digit, so a 39th significant
+    # digit can still land at e == -1 and drive HALF_UP (same reason the
+    # <=18 path keeps 19)
+    kept = sig & (sig_idx < 39)
+    n_sig = xp.sum(sig.astype(xp.int32), axis=1)
+    dropped = n_sig - xp.minimum(n_sig, 39)
+    after = (xp.cumsum(kept[:, ::-1].astype(xp.int32), axis=1)[:, ::-1]
+             - kept.astype(xp.int32))
+
+    exp_val, exp_ok = _exponent_value(xp, c, pos, mp, end)
+    shift = scale - n_frac + exp_val + dropped
+    e = after + shift[:, None]          # result-place exponent per digit
+
+    pow10 = xp.asarray((10 ** np.arange(20, dtype=np.uint64))
+                       .astype(np.uint64))
+    d_u = (c - _ZERO).astype(xp.uint64)
+    hi_mask = kept & (e >= 19) & (e <= 37)
+    lo_mask = kept & (e >= 0) & (e <= 18)
+    a = xp.sum(xp.where(hi_mask, d_u * pow10[xp.clip(e - 19, 0, 18)],
+                        xp.asarray(0, dtype=xp.uint64)), axis=1)
+    b = xp.sum(xp.where(lo_mask, d_u * pow10[xp.clip(e, 0, 18)],
+                        xp.asarray(0, dtype=xp.uint64)), axis=1)
+    round_up = xp.any(kept & (e == -1) & (c >= _ZERO + 5), axis=1)
+    too_big = xp.any(kept & nonzero & (e > 37), axis=1)
+
+    # value = A * 10^19 + B (+ round_up), in chunk space
+    a_lo = a.astype(xp.int64)
+    zero = xp.zeros_like(a_lo)
+    vlo, vhi, _ = D.mul_small(xp, a_lo, zero, 10 ** 9)
+    vlo, vhi, _ = D.mul_small(xp, vlo, vhi, 10 ** 9)
+    vlo, vhi, _ = D.mul_small(xp, vlo, vhi, 10)
+    add = b.astype(xp.int64) + xp.where(round_up, 1, 0).astype(xp.int64)
+    # B + round_up < 10^19 never overflows uint64; 128-bit add of the
+    # non-negative addend via chunk merge
+    c0, c1, c2, c3 = D.split_chunks(xp, vlo, vhi)
+    b0, b1, _, _ = D.split_chunks(xp, add, zero)
+    vlo, vhi, _ = D.carry_merge(xp, c0 + b0, c1 + b1, c2, c3)
+
+    oob = D.out_of_bounds(xp, vlo, vhi, precision)
+    ok = (validity & (n_mant >= 1) & (mp["n_dots"] <= 1)
+          & mp["digits_ok"] & exp_ok & ~too_big & ~oob)
+    nlo, nhi = D.neg128(xp, vlo, vhi)
+    lo = xp.where(mp["neg"], nlo, vlo)
+    hi = xp.where(mp["neg"], nhi, vhi)
+    return lo, hi, ok
+
+
 def format_decimal(xp, unscaled, validity, scale: int, width: int = 24):
     """int64 unscaled decimal(p<=18, s) -> byte matrix: sign, integer
     digits (at least one), '.' + exactly ``scale`` fraction digits when

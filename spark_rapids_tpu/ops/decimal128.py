@@ -1,0 +1,247 @@
+"""128-bit decimal arithmetic as chunked int64 XLA programs.
+
+The reference aggregates decimal128 on device by splitting each value
+into four int32 chunks, summing the chunks into int64 accumulators
+(which cannot overflow below 2^31 rows per group), and carry-merging the
+chunk sums back into a 128-bit result with an overflow check
+(``AggregateFunctions.scala:902`` ``Aggregation128Utils.extractInt32Chunk``
++ JNI kernels).  This module is that design expressed as jax-traceable
+int64 ops: everything here runs under jit on the MXU host's VPU lanes —
+no Python ints, no host round trips.
+
+Representation: a decimal128 unscaled value is a pair ``(lo, hi)`` of
+int64 words (lo = low 64 bits as a raw bit pattern, hi = high 64 bits,
+two's complement) — matching ``DeviceColumn.data``/``.aux``.
+
+All functions take ``xp`` (the array namespace) first so they stay
+backend-agnostic and trivially testable against numpy.
+"""
+
+from __future__ import annotations
+
+_M32 = 0xFFFFFFFF
+_MIN64 = -(1 << 63)
+
+
+def split_chunks(xp, lo, hi):
+    """(lo, hi) -> four int64 arrays holding the 32-bit chunks c0..c3.
+    c0..c2 are the unsigned values of bits 0-31 / 32-63 / 64-95; c3 is
+    the SIGNED top chunk (bits 96-127), carrying the value's sign."""
+    c0 = lo & _M32
+    c1 = (lo >> 32) & _M32
+    c2 = hi & _M32
+    c3 = hi >> 32              # arithmetic shift: signed top chunk
+    return c0, c1, c2, c3
+
+
+def sign_extend_lo(xp, lo):
+    """hi word for a long-backed (64-bit) unscaled value."""
+    return lo >> 63
+
+
+def dec_words(xp, col):
+    """(lo, hi) int64 word pair for a decimal DeviceColumn — the ONE
+    place that knows the aux-column contract (aux carries the high word
+    only for 128-bit-backed columns; long-backed values sign-extend)."""
+    lo = col.data.astype(xp.int64)
+    dt = col.dtype
+    if getattr(dt, "is_long_backed", True) is False and col.aux is not None:
+        return lo, col.aux
+    return lo, sign_extend_lo(xp, lo)
+
+
+def carry_merge(xp, s0, s1, s2, s3):
+    """Merge four int64 chunk sums back into (lo, hi, overflow).
+
+    Each s_i may exceed 32 bits (it is a SUM of 32-bit chunks) and may be
+    negative (top chunks are signed).  Standard ripple-carry with
+    arithmetic shifts propagates both positive carries and borrows.
+    ``overflow`` flags results outside the signed 128-bit range."""
+    t0 = s0 & _M32
+    c = s0 >> 32
+    u1 = s1 + c
+    t1 = u1 & _M32
+    c = u1 >> 32
+    u2 = s2 + c
+    t2 = u2 & _M32
+    c = u2 >> 32
+    u3 = s3 + c
+    t3 = u3 & _M32
+    lo = t0 | (t1 << 32)
+    hi = t2 | (t3 << 32)
+    # the true top chunk u3 must equal the sign-extension the packed hi
+    # word implies, else the value left the 128-bit range
+    overflow = u3 != (hi >> 32)
+    return lo, hi, overflow
+
+
+def cmp_unsigned_gt(xp, a, b):
+    """a > b comparing int64 bit patterns as UNSIGNED 64-bit."""
+    return (a ^ _MIN64) > (b ^ _MIN64)
+
+
+def gt_const(xp, lo, hi, const: int):
+    """(hi, lo) > const, const a Python int within signed 128-bit."""
+    chi, clo = const >> 64, const & ((1 << 64) - 1)
+    clo_signed = clo - (1 << 64) if clo >= (1 << 63) else clo
+    return (hi > chi) | ((hi == chi) & cmp_unsigned_gt(xp, lo, clo_signed))
+
+
+def lt_const(xp, lo, hi, const: int):
+    chi, clo = const >> 64, const & ((1 << 64) - 1)
+    clo_signed = clo - (1 << 64) if clo >= (1 << 63) else clo
+    return (hi < chi) | ((hi == chi) & cmp_unsigned_gt(xp, clo_signed, lo))
+
+
+def out_of_bounds(xp, lo, hi, precision: int):
+    """|value| exceeds the given decimal precision (10^p - 1)."""
+    bound = 10 ** precision - 1
+    return gt_const(xp, lo, hi, bound) | lt_const(xp, lo, hi, -bound)
+
+
+def neg128(xp, lo, hi):
+    """Two's-complement negation of (lo, hi)."""
+    nlo = (~lo) + 1
+    borrow = (nlo == 0) & (lo != 0)   # ~lo+1 wrapped -> carry into hi
+    # carry exists only when lo == 0 (then ~lo+1 wraps to 0 with carry)
+    carry = xp.where(lo == 0, 1, 0)
+    nhi = (~hi) + carry
+    del borrow
+    return nlo, nhi
+
+
+def abs128(xp, lo, hi):
+    """(|value| as (lo, hi), sign) — sign is -1/+1 int64."""
+    neg = hi < 0
+    nlo, nhi = neg128(xp, lo, hi)
+    alo = xp.where(neg, nlo, lo)
+    ahi = xp.where(neg, nhi, hi)
+    sign = xp.where(neg, -1, 1)
+    return alo, ahi, sign
+
+
+def mul_small(xp, lo, hi, m: int):
+    """(lo, hi) * m for a small non-negative Python int (m < 2^31),
+    returning (lo, hi, overflow).  Chunked schoolbook: each 32-bit chunk
+    times m fits int64; ripple the carries."""
+    c0, c1, c2, c3 = split_chunks(xp, lo, hi)
+    return carry_merge(xp, c0 * m, c1 * m, c2 * m, c3 * m)
+
+
+def divmod_nonneg_small(xp, lo, hi, d):
+    """(lo, hi) // d and remainder, value NON-NEGATIVE, d a positive
+    int64 array (or scalar) < 2^31.  Chunked long division, top chunk
+    first: the running remainder stays < d < 2^31, so r*2^32 + chunk
+    fits int64."""
+    c0, c1, c2, c3 = split_chunks(xp, lo, hi)
+    q3, r = xp.divmod(c3, d)
+    cur = (r << 32) | c2
+    q2, r = xp.divmod(cur, d)
+    cur = (r << 32) | c1
+    q1, r = xp.divmod(cur, d)
+    cur = (r << 32) | c0
+    q0, r = xp.divmod(cur, d)
+    qlo = q0 | (q1 << 32)
+    qhi = q2 | (q3 << 32)
+    return qlo, qhi, r
+
+
+def add128(xp, alo, ahi, blo, bhi):
+    """Signed 128-bit a + b -> (lo, hi, overflow)."""
+    a0, a1, a2, a3 = split_chunks(xp, alo, ahi)
+    b0, b1, b2, b3 = split_chunks(xp, blo, bhi)
+    return carry_merge(xp, a0 + b0, a1 + b1, a2 + b2, a3 + b3)
+
+
+def sub128(xp, alo, ahi, blo, bhi):
+    """Signed 128-bit a - b -> (lo, hi, overflow)."""
+    a0, a1, a2, a3 = split_chunks(xp, alo, ahi)
+    b0, b1, b2, b3 = split_chunks(xp, blo, bhi)
+    return carry_merge(xp, a0 - b0, a1 - b1, a2 - b2, a3 - b3)
+
+
+def _split16(xp, lo, hi):
+    """Eight 16-bit chunks (int64 each) — the multiply representation:
+    16x16 partial products stay < 2^32, so a column of eight partials
+    plus carry fits int64 with room to spare."""
+    m16 = 0xFFFF
+    return [(lo >> s) & m16 for s in (0, 16, 32, 48)] + \
+           [(hi >> s) & m16 for s in (0, 16, 32, 48)]
+
+
+def mul128(xp, alo, ahi, blo, bhi):
+    """Signed 128-bit a * b -> (lo, hi, overflow).  Schoolbook over
+    16-bit chunks on magnitudes; overflow when any partial product lands
+    at or above chunk 8, or the magnitude exceeds the signed range."""
+    alo_m, ahi_m, sa = abs128(xp, alo, ahi)
+    blo_m, bhi_m, sb = abs128(xp, blo, bhi)
+    a = _split16(xp, alo_m, ahi_m)
+    b = _split16(xp, blo_m, bhi_m)
+    m16 = 0xFFFF
+    cols = [xp.zeros_like(alo) for _ in range(8)]
+    high_spill = xp.zeros_like(alo, dtype=bool)
+    for i in range(8):
+        for j in range(8):
+            p = a[i] * b[j]
+            k = i + j
+            if k < 8:
+                cols[k] = cols[k] + p
+            else:
+                high_spill = high_spill | (p != 0)
+    # ripple 16-bit carries (columns hold sums of <=8 products < 2^35)
+    out = []
+    carry = xp.zeros_like(alo)
+    for k in range(8):
+        v = cols[k] + carry
+        out.append(v & m16)
+        carry = v >> 16
+    high_spill = high_spill | (carry != 0)
+    lo = out[0] | (out[1] << 16) | (out[2] << 32) | (out[3] << 48)
+    hi = out[4] | (out[5] << 16) | (out[6] << 32) | (out[7] << 48)
+    # magnitude must fit signed 127 bits (hi's sign bit clear), except
+    # the exact value -2^127 which we simply flag as overflow too (it
+    # cannot be a valid decimal anyway: 10^38 < 2^127)
+    ovf = high_spill | (hi < 0)
+    neg = (sa * sb) < 0
+    nlo, nhi = neg128(xp, lo, hi)
+    return (xp.where(neg, nlo, lo), xp.where(neg, nhi, hi), ovf)
+
+
+def rescale_div_round(xp, lo, hi, mul: int, d):
+    """Signed ((lo, hi) * mul) / d with HALF_UP rounding, WITHOUT the
+    128-bit intermediate overflowing when |value| * mul exceeds 2^127
+    (decimal AVG: sum x 10^4 can top 128 bits even when the quotient is
+    tiny).  Divides first and propagates the remainder:
+
+        (v * mul) / d  =  (v // d) * mul  +  (v % d) * mul / d
+
+    where v % d < d < 2^31 keeps the second term in int64.  Returns
+    (lo, hi, overflow) — overflow only when the RESULT leaves the
+    128-bit range."""
+    alo, ahi, sign = abs128(xp, lo, hi)
+    qlo, qhi, r1 = divmod_nonneg_small(xp, alo, ahi, d)
+    qlo, qhi, ovf = mul_small(xp, qlo, qhi, mul)
+    t = r1 * mul
+    q2, r2 = xp.divmod(t, d)
+    add = q2 + xp.where((2 * r2) >= d, 1, 0)
+    c0, c1, c2, c3 = split_chunks(xp, qlo, qhi)
+    a0, a1, _, _ = split_chunks(xp, add, xp.zeros_like(add))
+    rlo, rhi, ovf2 = carry_merge(xp, c0 + a0, c1 + a1, c2, c3)
+    nlo, nhi = neg128(xp, rlo, rhi)
+    return (xp.where(sign < 0, nlo, rlo),
+            xp.where(sign < 0, nhi, rhi),
+            ovf | ovf2)
+
+
+def div_round_half_up(xp, lo, hi, d):
+    """Signed (lo, hi) / d with HALF_UP rounding (Spark decimal
+    division for AVG), d positive int64 < 2^31.  Returns (lo, hi)."""
+    alo, ahi, sign = abs128(xp, lo, hi)
+    qlo, qhi, r = divmod_nonneg_small(xp, alo, ahi, d)
+    bump = (2 * r) >= d
+    blo = qlo + xp.where(bump, 1, 0)
+    carry = xp.where(cmp_unsigned_gt(xp, qlo, blo), 1, 0)  # wrapped
+    bhi = qhi + carry
+    nlo, nhi = neg128(xp, blo, bhi)
+    return (xp.where(sign < 0, nlo, blo),
+            xp.where(sign < 0, nhi, bhi))

@@ -65,7 +65,24 @@ def _sum_result_type(dt: T.DataType) -> T.DataType:
     return T.DOUBLE
 
 
+def _dec128_chunk_values(ctx, col, in_dt):
+    """Four per-row int32-chunk columns (as int64) for a decimal input —
+    the device-side ``Aggregation128Utils.extractInt32Chunk`` analog."""
+    from ...ops import decimal128 as D
+    del in_dt  # the column dtype carries everything dec_words needs
+    lo, hi = D.dec_words(ctx.xp, col)
+    return D.split_chunks(ctx.xp, lo, hi)
+
+
 class Sum(AggregateFunction):
+    """SUM.  Decimal results above 18 digits take the chunked-int32 path
+    (four int64 chunk-sum slots + carry merge, reference
+    ``AggregateFunctions.scala:902`` / ``Aggregation128Utils``): chunk
+    accumulators cannot overflow below 2^31 rows per group, and the
+    merge phase stays pure addition, so two-phase distributed
+    aggregation falls out unchanged.  Overflow past the result precision
+    nulls the group (Spark nullOnOverflow)."""
+
     def __init__(self, child: Expression):
         self.children = (child,)
 
@@ -76,7 +93,15 @@ class Sum(AggregateFunction):
     def data_type(self):
         return _sum_result_type(self.children[0].data_type)
 
+    def _dec128(self) -> bool:
+        dt = self.data_type
+        return isinstance(dt, T.DecimalType) and not dt.is_long_backed
+
     def slots(self):
+        if self._dec128():
+            return [BufferSlot(f"c{i}", T.LONG, SUM, SUM)
+                    for i in range(4)] + \
+                [BufferSlot("cnt", T.LONG, COUNT, SUM)]
         dt = self.data_type
         return [BufferSlot("sum", dt, SUM, SUM),
                 BufferSlot("cnt", T.LONG, COUNT, SUM)]
@@ -84,13 +109,29 @@ class Sum(AggregateFunction):
     def update_values(self, ctx, cols):
         c = cols[0]
         xp = ctx.xp
+        ones = (DeviceColumn(T.LONG,
+                             xp.ones_like(c.validity, dtype=xp.int64),
+                             c.validity), c.validity)
+        if self._dec128():
+            chunks = _dec128_chunk_values(ctx, c,
+                                          self.children[0].data_type)
+            return [(DeviceColumn(T.LONG, ch, c.validity), c.validity)
+                    for ch in chunks] + [ones]
         target = self.data_type.np_dtype
         data = c.data.astype(target)
-        return [(DeviceColumn(self.data_type, data, c.validity), c.validity),
-                (DeviceColumn(T.LONG, xp.ones_like(c.validity, dtype=xp.int64),
-                              c.validity), c.validity)]
+        return [(DeviceColumn(self.data_type, data, c.validity),
+                 c.validity), ones]
 
     def evaluate(self, ctx, buffers):
+        if self._dec128():
+            from ...ops import decimal128 as D
+            xp = ctx.xp
+            s0, s1, s2, s3, cnt = buffers
+            lo, hi, ovf = D.carry_merge(xp, s0.data, s1.data, s2.data,
+                                        s3.data)
+            dt: T.DecimalType = self.data_type  # type: ignore[assignment]
+            ovf = ovf | D.out_of_bounds(xp, lo, hi, dt.precision)
+            return DeviceColumn(dt, lo, (cnt.data > 0) & ~ovf, aux=hi)
         s, cnt = buffers
         return fixed(self.data_type, s.data, cnt.data > 0)
 
@@ -185,7 +226,15 @@ class Average(AggregateFunction):
             return T.DecimalType.bounded(ct.precision + 4, ct.scale + 4)
         return T.DOUBLE
 
+    def _dec128_sum(self) -> bool:
+        st = _sum_result_type(self.children[0].data_type)
+        return isinstance(st, T.DecimalType) and not st.is_long_backed
+
     def slots(self):
+        if self._dec128_sum():
+            return [BufferSlot(f"c{i}", T.LONG, SUM, SUM)
+                    for i in range(4)] + \
+                [BufferSlot("cnt", T.LONG, COUNT, SUM)]
         ct = self.children[0].data_type
         sum_t = _sum_result_type(ct)
         return [BufferSlot("sum", sum_t, SUM, SUM),
@@ -193,23 +242,51 @@ class Average(AggregateFunction):
 
     def update_values(self, ctx, cols):
         c = cols[0]
+        ones = (DeviceColumn(T.LONG,
+                             ctx.xp.ones_like(c.validity,
+                                              dtype=ctx.xp.int64),
+                             c.validity), c.validity)
+        if self._dec128_sum():
+            chunks = _dec128_chunk_values(ctx, c,
+                                          self.children[0].data_type)
+            return [(DeviceColumn(T.LONG, ch, c.validity), c.validity)
+                    for ch in chunks] + [ones]
         sum_t = _sum_result_type(self.children[0].data_type)
-        return [(DeviceColumn(sum_t, c.data.astype(sum_t.np_dtype), c.validity),
-                 c.validity),
-                (DeviceColumn(T.LONG,
-                              ctx.xp.ones_like(c.validity, dtype=ctx.xp.int64),
-                              c.validity), c.validity)]
+        return [(DeviceColumn(sum_t, c.data.astype(sum_t.np_dtype),
+                              c.validity), c.validity), ones]
 
     def evaluate(self, ctx, buffers):
         xp = ctx.xp
+        dt = self.data_type
+        if self._dec128_sum():
+            # 128-bit: carry-merge the chunk sums, rescale to the result
+            # scale (x10^4: chunked multiply), then divide by the count
+            # with chunked long division, HALF_UP (the whole pipeline is
+            # int64 XLA ops — no host round trip)
+            from ...ops import decimal128 as D
+            s0, s1, s2, s3, cnt = buffers
+            valid = cnt.data > 0
+            denom = xp.where(valid, cnt.data, 1)
+            lo, hi, ovf = D.carry_merge(xp, s0.data, s1.data, s2.data,
+                                        s3.data)
+            ct: T.DecimalType = _sum_result_type(
+                self.children[0].data_type)  # type: ignore[assignment]
+            shift = dt.scale - ct.scale  # type: ignore[union-attr]
+            lo, hi, movf = D.rescale_div_round(xp, lo, hi, 10 ** shift,
+                                               denom)
+            ovf = ovf | movf
+            ovf = ovf | D.out_of_bounds(
+                xp, lo, hi, dt.precision)  # type: ignore[union-attr]
+            valid = valid & ~ovf
+            aux = hi if not dt.is_long_backed else None  # type: ignore
+            return DeviceColumn(dt, lo, valid, aux=aux)
         s, cnt = buffers
         valid = cnt.data > 0
         denom = xp.where(valid, cnt.data, 1)
-        dt = self.data_type
         if isinstance(dt, T.DecimalType):
-            ct: T.DecimalType = _sum_result_type(self.children[0].data_type)  # type: ignore
+            ct2: T.DecimalType = _sum_result_type(self.children[0].data_type)  # type: ignore
             # rescale sum to result scale then divide rounding HALF_UP
-            shift = dt.scale - ct.scale
+            shift = dt.scale - ct2.scale
             num = s.data * (10 ** shift)
             q = num // denom
             r = num - q * denom

@@ -16,6 +16,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Tuple
 
+import numpy as np
+
 from ... import types as T
 from ...columnar.column import DeviceColumn
 from .core import (EvalContext, Expression, fixed, null_safe_binary,
@@ -63,6 +65,46 @@ class BinaryArithmetic(Expression):
         return f"({self.children[0].sql()} {self.symbol} {self.children[1].sql()})"
 
 
+def _dec128_involved(*dts) -> bool:
+    return any(isinstance(dt, T.DecimalType) and not dt.is_long_backed
+               for dt in dts)
+
+
+def _py_unscaled(col) -> list:
+    """Host-side: per-row Python-int unscaled values (exact 128-bit).
+    Only callable off the device path (numpy arrays)."""
+    lo = np.asarray(col.data, dtype=np.int64)
+    if isinstance(col.dtype, T.DecimalType) and not col.dtype.is_long_backed \
+            and col.aux is not None:
+        hi = np.asarray(col.aux, dtype=np.int64)
+        return [(int(h) << 64) + (int(lv) & ((1 << 64) - 1))
+                for lv, h in zip(lo, hi)]
+    return [int(x) for x in lo]
+
+
+def _py_decimal_result(ctx, dt: "T.DecimalType", vals: list):
+    """list of Python-int unscaled (None = null) -> decimal DeviceColumn;
+    values beyond the precision become null (Spark nullOnOverflow)."""
+    xp = ctx.xp
+    bound = 10 ** dt.precision - 1
+    ok = np.array([v is not None and -bound <= v <= bound for v in vals])
+    lov, hiv = [], []
+    for v in vals:
+        u = (v if v is not None else 0) & ((1 << 128) - 1)
+        l, h = u & ((1 << 64) - 1), (u >> 64) & ((1 << 64) - 1)
+        lov.append(l - (1 << 64) if l >= (1 << 63) else l)
+        hiv.append(h - (1 << 64) if h >= (1 << 63) else h)
+    lo = xp.asarray(np.array(lov, dtype=np.int64))
+    aux = xp.asarray(np.array(hiv, dtype=np.int64)) \
+        if not dt.is_long_backed else None
+    return DeviceColumn(dt, lo, xp.asarray(ok), aux=aux)
+
+
+def _dec_words(ctx, col):
+    from ...ops import decimal128 as D128
+    return D128.dec_words(ctx.xp, col)
+
+
 class Add(BinaryArithmetic):
     symbol = "+"
 
@@ -76,16 +118,41 @@ class Add(BinaryArithmetic):
                 + max(lt.scale, rt.scale) + 1, max(lt.scale, rt.scale))
         return lt
 
+    def _dec128_kernel(self, ctx, a, b, op):
+        """128-bit add/sub on the (lo, hi) word pairs (the int64-only
+        fast path silently truncated these — round-4 fix); overflow past
+        the result precision nulls the row (Spark nullOnOverflow)."""
+        from ...ops import decimal128 as D128
+        xp = ctx.xp
+        alo, ahi = _dec_words(ctx, a)
+        blo, bhi = _dec_words(ctx, b)
+        lo, hi, ovf = op(xp, alo, ahi, blo, bhi)
+        dt: T.DecimalType = self.data_type  # type: ignore[assignment]
+        ovf = ovf | D128.out_of_bounds(xp, lo, hi, dt.precision)
+        valid = valid_and(xp, a, b) & ~ovf
+        aux = hi if not dt.is_long_backed else None
+        return DeviceColumn(dt, lo, valid, aux=aux)
+
     def kernel(self, ctx, a, b):
-        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x + y)
+        dt = self.data_type
+        if _dec128_involved(dt, a.dtype, b.dtype):
+            from ...ops import decimal128 as D128
+            return self._dec128_kernel(ctx, a, b, D128.add128)
+        return null_safe_binary(ctx, dt, a, b, lambda x, y: x + y)
 
 
-class Subtract(BinaryArithmetic):
+class Subtract(Add):
     symbol = "-"
-    data_type = Add.data_type
 
     def kernel(self, ctx, a, b):
-        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x - y)
+        dt = self.data_type
+        if _dec128_involved(dt, a.dtype, b.dtype):
+            from ...ops import decimal128 as D128
+            return self._dec128_kernel(ctx, a, b, D128.sub128)
+        return null_safe_binary(ctx, dt, a, b, lambda x, y: x - y)
+
+    def with_children(self, children):
+        return Subtract(*children)
 
 
 class Multiply(BinaryArithmetic):
@@ -100,12 +167,58 @@ class Multiply(BinaryArithmetic):
                                          lt.scale + rt.scale)
         return lt
 
+    def tag_for_device(self, conf=None):
+        dt = self.data_type
+        if isinstance(dt, T.DecimalType):
+            lt, rt = (c.data_type for c in self.children)
+            if isinstance(lt, T.DecimalType) and isinstance(
+                    rt, T.DecimalType) \
+                    and dt.scale != lt.scale + rt.scale:
+                # precision clamp reduced the scale: the product needs a
+                # rounding rescale the device kernel does not implement
+                return ("decimal multiply with scale reduction "
+                        f"({lt.scale}+{rt.scale} -> {dt.scale}) "
+                        "runs on the host")
+        return None
+
     def kernel(self, ctx, a, b):
-        if isinstance(self.data_type, T.DecimalType):
-            # children keep their own scales; product scale = s1+s2 already
-            return null_safe_binary(ctx, self.data_type, a, b,
-                                    lambda x, y: x * y)
-        return null_safe_binary(ctx, self.data_type, a, b, lambda x, y: x * y)
+        dt = self.data_type
+        if isinstance(dt, T.DecimalType):
+            lt, rt = (c.data_type for c in self.children)
+            red = (isinstance(lt, T.DecimalType)
+                   and isinstance(rt, T.DecimalType)
+                   and dt.scale != lt.scale + rt.scale)
+            if red:
+                # scale-reduced product (host-only; device is tagged
+                # off): exact Python-int product + HALF_UP rescale
+                av, bv = _py_unscaled(a), _py_unscaled(b)
+                va = np.asarray(a.validity) & np.asarray(b.validity)
+                down = 10 ** (lt.scale + rt.scale - dt.scale)
+                out = []
+                for x, y, ok in zip(av, bv, va):
+                    if not ok:
+                        out.append(None)
+                        continue
+                    p = x * y
+                    q, r = divmod(abs(p), down)
+                    if 2 * r >= down:
+                        q += 1
+                    out.append(-q if p < 0 else q)
+                return _py_decimal_result(ctx, dt, out)
+            if _dec128_involved(dt, a.dtype, b.dtype):
+                # exact 128-bit chunked product (16-bit schoolbook); the
+                # int64 fast path would wrap silently
+                from ...ops import decimal128 as D128
+                xp = ctx.xp
+                alo, ahi = _dec_words(ctx, a)
+                blo, bhi = _dec_words(ctx, b)
+                lo, hi, ovf = D128.mul128(xp, alo, ahi, blo, bhi)
+                ddt: T.DecimalType = dt  # type: ignore[assignment]
+                ovf = ovf | D128.out_of_bounds(xp, lo, hi, ddt.precision)
+                valid = valid_and(xp, a, b) & ~ovf
+                aux = hi if not ddt.is_long_backed else None
+                return DeviceColumn(ddt, lo, valid, aux=aux)
+        return null_safe_binary(ctx, dt, a, b, lambda x, y: x * y)
 
 
 class Divide(BinaryArithmetic):
@@ -122,17 +235,53 @@ class Divide(BinaryArithmetic):
             return T.DecimalType.bounded(prec, scale)
         return lt
 
+    def _dec_wide(self) -> bool:
+        """True when the decimal divide needs >64-bit intermediates: any
+        128-bit operand/result, or a rescaled numerator that can leave
+        int64 (lt.precision + shift > 18)."""
+        dt = self.data_type
+        if not isinstance(dt, T.DecimalType):
+            return False
+        lt: T.DecimalType = self.children[0].data_type  # type: ignore
+        rt: T.DecimalType = self.children[1].data_type  # type: ignore
+        shift = dt.scale - lt.scale + rt.scale
+        return (_dec128_involved(dt, lt, rt)
+                or lt.precision + shift > 18)
+
+    def tag_for_device(self, conf=None):
+        if self._dec_wide():
+            # wide decimal division needs a variable-divisor 128/128
+            # long-division kernel (reference: cuDF DECIMAL128 div JNI);
+            # the host path computes it exactly with Python integers
+            return "wide decimal division runs on the host"
+        return None
+
     def kernel(self, ctx, a, b):
         xp = ctx.xp
         dt = self.data_type
         if isinstance(dt, T.DecimalType):
             lt: T.DecimalType = self.children[0].data_type  # type: ignore
             rt: T.DecimalType = self.children[1].data_type  # type: ignore
+            shift = dt.scale - lt.scale + rt.scale
+            if self._dec_wide():
+                # host-only exact path (the device plan is tagged off)
+                av, bv = _py_unscaled(a), _py_unscaled(b)
+                va = np.asarray(a.validity) & np.asarray(b.validity)
+                out = []
+                for x, y, ok in zip(av, bv, va):
+                    if not ok or y == 0:
+                        out.append(None)
+                        continue
+                    num = x * 10 ** shift
+                    q, r = divmod(abs(num), abs(y))
+                    if 2 * r >= abs(y):
+                        q += 1
+                    out.append(-q if (num < 0) != (y < 0) else q)
+                return _py_decimal_result(ctx, dt, out)
             valid = valid_and(xp, a, b) & (b.data != 0)
             bd = xp.where(b.data == 0, xp.asarray(1, dtype=b.data.dtype), b.data)
             # rescale numerator so unscaled result has target scale:
             # (a/10^ls) / (b/10^rs) * 10^ts  == a * 10^(ts - ls + rs) / b
-            shift = dt.scale - lt.scale + rt.scale
             num = a.data * xp.asarray(10 ** shift, dtype=xp.int64)
             q = trunc_div(xp, num, bd)
             r = trunc_mod(xp, num, bd)

@@ -79,7 +79,7 @@ def _int_bounds(dt: T.DataType):
 def device_string_cast_supported(ft, tt) -> bool:
     if isinstance(ft, T.StringType):
         if isinstance(tt, T.DecimalType):
-            return tt.is_long_backed  # decimal128 parse stays host-side
+            return True  # <=18: uint64 mantissa; 19-38: parse_decimal128
         return (T.is_integral(tt) or isinstance(tt, (T.FloatType,
                                                      T.DoubleType,
                                                      T.BooleanType,
@@ -121,6 +121,10 @@ def _device_string_cast(ctx, c: DeviceColumn, ft, tt):
             v, ok = CS.parse_decimal(xp, chars, lengths, valid,
                                      tt.precision, tt.scale)
             return fixed(tt, v, ok)
+        if isinstance(tt, T.DecimalType):
+            lo, hi, ok = CS.parse_decimal128(xp, chars, lengths, valid,
+                                             tt.precision, tt.scale)
+            return DeviceColumn(tt, lo, ok, aux=hi)
         return None
     if isinstance(tt, T.StringType):
         if isinstance(ft, T.BooleanType):

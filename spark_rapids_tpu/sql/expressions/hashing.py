@@ -32,6 +32,33 @@ def _float_bits64(xp, x):
                     xp.asarray(0x7ff8000000000000, dtype=xp.int64), bits)
 
 
+def _dec128_byte_matrix(xp, col: DeviceColumn):
+    """Decimal(p > 18) hashed exactly like Spark: the unscaled
+    ``BigInteger.toByteArray()`` — MINIMAL two's-complement big-endian
+    bytes — through the byte-array hash (``HashExpression.scala``: long
+    path only for precision <= 18).  Returns (bytes[n, 16] uint8,
+    lengths int32): the 16-byte image left-shifted past its redundant
+    sign bytes."""
+    from ...ops.decimal128 import dec_words
+    lo, hi = dec_words(xp, col)
+    words = [(hi >> s) & 0xFF for s in (56, 48, 40, 32, 24, 16, 8, 0)] \
+        + [(lo >> s) & 0xFF for s in (56, 48, 40, 32, 24, 16, 8, 0)]
+    b = xp.stack(words, axis=1)                       # [n, 16] int64
+    fill = xp.where(hi < 0, 0xFF, 0x00)[:, None]
+    is_fill = b == fill
+    # a leading byte is redundant when everything before it is the sign
+    # fill, it is the fill itself, and dropping it keeps the sign (the
+    # next byte's top bit already matches); the last byte never drops
+    nxt_top = xp.concatenate([b[:, 1:], b[:, -1:]], axis=1) & 0x80
+    cand = is_fill & (nxt_top == (fill & 0x80))
+    cand = cand & (xp.arange(16)[None, :] < 15)
+    run = xp.cumprod(cand.astype(xp.int32), axis=1).astype(bool)
+    start = xp.sum(run.astype(xp.int32), axis=1)
+    idx = xp.clip(start[:, None] + xp.arange(16)[None, :], 0, 15)
+    shifted = xp.take_along_axis(b, idx, axis=1)
+    return shifted.astype(xp.uint8), (16 - start).astype(xp.int32)
+
+
 def _update_murmur3(xp, h_u32, col: DeviceColumn):
     dt = col.dtype
     if col.lengths is not None:
@@ -48,6 +75,9 @@ def _update_murmur3(xp, h_u32, col: DeviceColumn):
         new = H.murmur3_long(xp, _float_bits64(xp, col.data), h_u32).astype(xp.uint32)
     elif isinstance(dt, T.DecimalType) and dt.is_long_backed:
         new = H.murmur3_long(xp, col.data, h_u32).astype(xp.uint32)
+    elif isinstance(dt, T.DecimalType):
+        chars, lengths = _dec128_byte_matrix(xp, col)
+        new = H.murmur3_bytes(xp, chars, lengths, h_u32).astype(xp.uint32)
     elif isinstance(dt, T.StructType):
         new = h_u32
         for ch in col.children:
@@ -78,6 +108,9 @@ def _update_xxhash64(xp, h_u64, col: DeviceColumn):
         new = H.xxhash64_long(xp, _float_bits64(xp, col.data), h_u64)
     elif isinstance(dt, T.DecimalType) and dt.is_long_backed:
         new = H.xxhash64_long(xp, col.data, h_u64)
+    elif isinstance(dt, T.DecimalType):
+        chars, lengths = _dec128_byte_matrix(xp, col)
+        new = H.xxhash64_bytes(xp, chars, lengths, h_u64)
     elif isinstance(dt, T.StructType):
         new = h_u64
         for ch in col.children:

@@ -423,7 +423,15 @@ class HashAggregateExec(PhysicalPlan):
         from .kernel_cache import exprs_key
         self._pre_steps: List = []  # fused upstream filter/project chain
         slots_key = tuple(
-            (type(f).__name__, f._key_extras(),
+            # result dtype is program identity: evaluate() bakes
+            # dtype-derived Python constants (decimal128 rescale factors,
+            # precision bounds) into the traced finalize program, and the
+            # chunked-decimal slots are all LONG — without the result
+            # dtype two decimal aggs of different (p, s) would share a
+            # compiled finalize (observed: avg's 10^4 rescale applied to
+            # a different query's sum)
+            (type(f).__name__, f._key_extras(), str(f.data_type),
+             tuple(str(c.data_type) for c in f.children),
              tuple((s.op, s.merge_op, s.dtype) for s in f.slots()))
             for f in self._agg_funcs)
         self._slots_key = slots_key

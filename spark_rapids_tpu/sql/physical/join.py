@@ -762,11 +762,15 @@ def plan_join(node, left: PhysicalPlan, right: PhysicalPlan, backend,
         return AdaptiveJoinExec(node, left, right, backend, conf)
     if nparts > 1:
         n = int(conf.shuffle_partitions)
+        # the PROBE side gets skew splitting; right joins flip sides in
+        # BaseJoinExec (probe=right, build=left), full joins concat
+        # their probe batches back (join.py execute), so neither benefits
         left = ShuffleExchangeExec(
             HashPartitioning(node.left_keys, n), left, backend=backend,
-            coalescible=False, skew_splittable=how != "full")
+            coalescible=False,
+            skew_splittable=how not in ("full", "right"))
         right = ShuffleExchangeExec(
             HashPartitioning(node.right_keys, n), right, backend=backend,
-            coalescible=False)
+            coalescible=False, skew_splittable=how == "right")
     return ShuffledHashJoinExec(how, node.left_keys, node.right_keys,
                                 node.condition, left, right, backend=backend)

@@ -107,6 +107,10 @@ MATRIX_CATEGORIES = [
     ("BOOLEAN", T.BOOLEAN), ("BYTE", T.BYTE), ("SHORT", T.SHORT),
     ("INT", T.INT), ("LONG", T.LONG), ("FLOAT", T.FLOAT),
     ("DOUBLE", T.DOUBLE), ("DECIMAL", T.DecimalType(18, 2)),
+    # 128-bit decimals are a distinct support axis (round 4: chunked
+    # int64 device kernels for agg/add/sub/mul/cast; precision-dependent
+    # shapes like wide division still tag to the host dynamically)
+    ("DECIMAL128", T.DecimalType(38, 6)),
     ("STRING", T.STRING), ("BINARY", T.BINARY), ("DATE", T.DATE),
     ("TIMESTAMP", T.TIMESTAMP), ("NULL", T.NULL),
     ("ARRAY", T.ArrayType(T.INT)), ("MAP", T.MapType(T.STRING, T.INT)),
