@@ -18,7 +18,7 @@ import numpy as np
 
 from ... import types as T
 from ...columnar.column import DeviceColumn
-from .core import EvalContext, Expression, fixed
+from .core import EvalContext, Expression, Literal, fixed
 
 # segmented ops understood by the physical layer
 SUM, MIN, MAX, COUNT, FIRST, LAST = "sum", "min", "max", "count", "first", "last"
@@ -30,6 +30,12 @@ class BufferSlot:
     dtype: T.DataType
     op: str           # one of the segmented ops
     merge_op: str     # op used when merging partial buffers
+    #: FIRST/LAST merges normally take the first/last PARTIAL regardless
+    #: of slot validity (First(ignore_nulls=False) semantics: a null
+    #: first row must win).  Slots whose merge must instead pick the
+    #: first partial that actually HAS a value (PivotFirst: a partial
+    #: with no matching pivot row holds null, cnt=0) set this flag.
+    merge_valid_only: bool = False
 
 
 class AggregateFunction(Expression):
@@ -338,6 +344,95 @@ class First(_FirstLast):
 
 class Last(_FirstLast):
     _op = LAST
+
+
+class PivotFirst(AggregateFunction):
+    """Pivot aggregation (reference ``GpuOverrides.scala:2098`` GpuPivotFirst
+    / ``AggregateFunctions.scala`` PivotFirst): aggregates (pivot, value)
+    rows into an ARRAY with one slot per requested pivot value — first
+    non-null value per slot.  ``GroupedData.pivot`` lowers to per-value
+    conditional aggregates (the same compute, one OUTPUT COLUMN per
+    value); this expression is the direct analog for plans carrying
+    PivotFirst itself.
+
+    ``children`` are (value, match_1, ..., match_K): the match
+    predicates are built at construction as ``pivot == Literal(v_k)`` so
+    every pivot dtype the engine can compare (strings included) works
+    without a comparison kernel here."""
+
+    def __init__(self, pivot: Expression, value: Expression,
+                 pivot_values: Sequence):
+        from .predicates import EqualTo
+        from .core import resolve_expression
+        pivot = resolve_expression(pivot)
+        value = resolve_expression(value)
+        self.pivot_values = tuple(pivot_values)
+        if not self.pivot_values:
+            raise ValueError("PivotFirst needs at least one pivot value")
+        matches = tuple(EqualTo(pivot, Literal(v))
+                        for v in self.pivot_values)
+        self.children = (value,) + matches
+
+    def with_children(self, children):
+        out = PivotFirst.__new__(PivotFirst)
+        out.pivot_values = self.pivot_values
+        out.children = tuple(children)
+        return out
+
+    def _key_extras(self):
+        return (self.pivot_values,)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def pretty_name(self):
+        return "pivotfirst"
+
+    def slots(self):
+        vt = self.children[0].data_type
+        if isinstance(vt, (T.ArrayType, T.MapType, T.StructType)):
+            # tagging keeps this off the device; the host engine drives
+            # the same slot machinery, so fail clearly there too rather
+            # than deep inside the array interleave
+            raise ValueError(
+                f"pivot over {vt.simple_string()} values is not "
+                "supported — project a flat value column first")
+        out = []
+        for k in range(len(self.pivot_values)):
+            out.append(BufferSlot(f"v{k}", vt, FIRST, FIRST,
+                                  merge_valid_only=True))
+            out.append(BufferSlot(f"n{k}", T.LONG, COUNT, SUM))
+        return out
+
+    def update_values(self, ctx, cols):
+        xp = ctx.xp
+        value, matches = cols[0], cols[1:]
+        out = []
+        for m in matches:
+            contrib = m.data & m.validity & value.validity
+            out.append((value, contrib))
+            out.append((DeviceColumn(
+                T.LONG, xp.ones_like(contrib, dtype=xp.int64), contrib),
+                contrib))
+        return out
+
+    def evaluate(self, ctx, buffers):
+        from dataclasses import replace as _replace
+        from .collections import _interleave_columns
+        from ...columnar.column import bucket_width, make_array_column
+        xp = ctx.xp
+        k = len(self.pivot_values)
+        slots = []
+        for i in range(k):
+            v, cnt = buffers[2 * i], buffers[2 * i + 1]
+            slots.append(_replace(v, validity=v.validity & (cnt.data > 0)))
+        w = bucket_width(k)
+        elem = _interleave_columns(xp, slots, w)
+        cap = slots[0].capacity if slots else ctx.capacity
+        lengths = xp.full(cap, k, dtype=xp.int32)
+        return make_array_column(self.data_type, lengths, (elem,),
+                                 xp.ones(cap, dtype=bool))
 
 
 class _CentralMoment(AggregateFunction):

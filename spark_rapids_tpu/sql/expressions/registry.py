@@ -91,7 +91,7 @@ from . import windows as W  # noqa: E402
 
 _reg(Agg.AggregateExpression, Agg.Sum, Agg.Count, Agg.Min, Agg.Max,
      Agg.Average, Agg.First, Agg.Last, Agg.VarianceSamp, Agg.VariancePop,
-     Agg.StddevSamp, Agg.StddevPop)
+     Agg.StddevSamp, Agg.StddevPop, Agg.PivotFirst)
 _reg(W.WindowExpression, W.WindowSpecDefinition, W.RowNumber, W.Rank,
      W.DenseRank, W.PercentRank, W.CumeDist, W.NTile, W.Lead, W.Lag,
      W.NthValue)

@@ -86,6 +86,11 @@ _SPECIFIC_SIGS: Dict[str, TS.ExprSig] = {
     "ApproximatePercentile": TS.ExprSig(
         TS.NUMERIC + TS.NULL,
         TS.NUMERIC + TS.TypeSig((T.ArrayType,), nested=TS.NUMERIC)),
+    # flat/string values only: evaluate() interleaves value buffers into
+    # an array column, which nested/binary children cannot ride
+    "PivotFirst": TS.ExprSig(
+        TS.BASIC,
+        TS.TypeSig((T.ArrayType,), nested=TS.BASIC)),
 }
 
 
@@ -142,7 +147,8 @@ _EXEC_ENABLE_KEYS = {
 _SUPPORTED_AGGS = (AGG.Sum, AGG.Count, AGG.Min, AGG.Max, AGG.Average,
                    AGG.First, AGG.Last, AGG.StddevPop, AGG.StddevSamp,
                    AGG.VariancePop, AGG.VarianceSamp, AGG.CollectList,
-                   AGG.CollectSet, AGG.ApproximatePercentile)
+                   AGG.CollectSet, AGG.ApproximatePercentile,
+                   AGG.PivotFirst)
 
 
 class ExprMeta:

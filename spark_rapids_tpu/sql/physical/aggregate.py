@@ -737,7 +737,8 @@ class HashAggregateExec(PhysicalPlan):
             for s in f.slots():
                 ops.append(s.merge_op)
                 col = slots[si]
-                if s.merge_op in (FIRST, LAST):
+                if s.merge_op in (FIRST, LAST) \
+                        and not s.merge_valid_only:
                     contribs.append(batch.row_mask())
                 else:
                     contribs.append(col.validity)

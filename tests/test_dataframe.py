@@ -390,3 +390,73 @@ class TestSetOperations:
             EXPRESSION_REGISTRY
         assert "ReplicateRows" in EXPRESSION_REGISTRY
         assert "DynamicPruningExpression" in EXPRESSION_REGISTRY
+
+
+def test_pivot_first_expression_direct():
+    """PivotFirst used directly as an aggregate (the reference's
+    GpuPivotFirst, GpuOverrides.scala:2098): one array slot per pivot
+    value, first non-null value wins, missing slots null."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql.dataframe import Column
+    from spark_rapids_tpu.sql.expressions.aggregates import PivotFirst
+    sess = srt.session()
+    t = pa.table({"y": [2024, 2024, 2024, 2025, 2025],
+                  "q": ["a", "b", "a", "b", "b"],
+                  "v": [1.0, 2.0, 9.0, 3.0, 4.0]})
+    df = sess.create_dataframe(t)
+    pf = PivotFirst(df._col("q").expr, df._col("v").expr, ["a", "b", "c"])
+    out = (df.groupBy("y").agg(Column(pf).alias("p"))
+           .orderBy("y").collect().to_pylist())
+    assert out[0]["y"] == 2024 and out[0]["p"] == [1.0, 2.0, None]
+    assert out[1]["y"] == 2025 and out[1]["p"] == [None, 3.0, None]
+
+
+def test_pivot_first_string_values():
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql.dataframe import Column
+    from spark_rapids_tpu.sql.expressions.aggregates import PivotFirst
+    sess = srt.session()
+    t = pa.table({"g": [1, 1, 2], "q": ["x", "y", "x"],
+                  "s": ["hello", "world", "tpu"]})
+    df = sess.create_dataframe(t)
+    pf = PivotFirst(df._col("q").expr, df._col("s").expr, ["x", "y"])
+    out = (df.groupBy("g").agg(Column(pf).alias("p"))
+           .orderBy("g").collect().to_pylist())
+    assert out[0]["p"] == ["hello", "world"]
+    assert out[1]["p"] == ["tpu", None]
+
+
+def test_pivot_first_multi_partition_merge():
+    """The value slots merge by 'first VALID partial' (merge_valid_only),
+    not 'first partial' — a partial with no matching pivot row must not
+    shadow a later partial's value (review r4 finding)."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql.dataframe import Column
+    from spark_rapids_tpu.sql.expressions.aggregates import PivotFirst
+    sess = srt.session()
+    n = 50
+    t = pa.table({"g": [1] * n, "q": ["b"] * (n - 2) + ["a", "b"],
+                  "v": [0.0] * (n - 2) + [99.0, 0.0]})
+    df = sess.create_dataframe(t, num_partitions=4)
+    out = (df.groupBy("g")
+           .agg(Column(PivotFirst(df._col("q").expr, df._col("v").expr,
+                                  ["a", "b"])).alias("p"))
+           .collect().to_pylist())
+    assert out[0]["p"] == [99.0, 0.0]
+
+
+def test_pivot_first_nested_value_rejected():
+    import pytest as _pytest
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.sql.dataframe import Column
+    from spark_rapids_tpu.sql.expressions.aggregates import PivotFirst
+    sess = srt.session()
+    t = pa.table({"g": [1], "q": ["a"],
+                  "v": pa.array([[1, 2]], type=pa.list_(pa.int64()))})
+    df = sess.create_dataframe(t)
+    q = df.groupBy("g").agg(Column(PivotFirst(
+        df._col("q").expr, df._col("v").expr, ["a"])).alias("p"))
+    with _pytest.raises(ValueError, match="project a flat value"):
+        q.collect()
+    with _pytest.raises(ValueError, match="at least one"):
+        PivotFirst(df._col("q").expr, df._col("g").expr, [])
