@@ -39,6 +39,7 @@ FORMAT_VERSION = 2
 #: manifest entry / data file content codes (Iceberg spec)
 DATA = 0
 POSITION_DELETES = 1
+EQUALITY_DELETES = 2
 
 STATUS_EXISTING = 0
 STATUS_ADDED = 1
@@ -183,12 +184,15 @@ class IceSnapshot:
     parent_id: Optional[int] = None
     schema_id: int = 0
     summary: Dict[str, str] = field(default_factory=dict)
+    #: v2 data sequence number (ordering for row-level delete scoping)
+    sequence_number: int = 0
 
     def to_json(self) -> dict:
         d = {"snapshot-id": self.snapshot_id,
              "timestamp-ms": self.timestamp_ms,
              "manifest-list": self.manifest_list,
              "schema-id": self.schema_id,
+             "sequence-number": self.sequence_number,
              "summary": self.summary}
         if self.parent_id is not None:
             d["parent-snapshot-id"] = self.parent_id
@@ -199,7 +203,8 @@ class IceSnapshot:
         return IceSnapshot(d["snapshot-id"], d["timestamp-ms"],
                            d["manifest-list"],
                            d.get("parent-snapshot-id"),
-                           d.get("schema-id", 0), d.get("summary", {}))
+                           d.get("schema-id", 0), d.get("summary", {}),
+                           d.get("sequence-number", 0))
 
 
 # ---------------------------------------------------------------------------
@@ -221,6 +226,13 @@ class DataFile:
     lower_bounds: Dict[int, Any] = field(default_factory=dict)
     upper_bounds: Dict[int, Any] = field(default_factory=dict)
     null_counts: Dict[int, int] = field(default_factory=dict)
+    #: v2 row-level deletes: the field ids an EQUALITY_DELETES file
+    #: matches on (GpuDeleteFilter.java:94 equalityFieldIds), and the
+    #: data sequence number ordering which deletes apply to which data
+    #: (a delete applies to STRICTLY OLDER sequence numbers; 0 = unknown
+    #: / oldest, so later deletes still apply)
+    equality_ids: Tuple[int, ...] = ()
+    sequence_number: int = 0
 
 
 @dataclass
@@ -267,7 +279,8 @@ def _bounds_unjson(s: str) -> Dict[int, Any]:
 
 _MANIFEST_COLS = ["status", "snapshot_id", "content", "file_path",
                   "record_count", "file_size", "spec_id", "partition",
-                  "lower_bounds", "upper_bounds", "null_counts"]
+                  "lower_bounds", "upper_bounds", "null_counts",
+                  "equality_ids", "sequence_number"]
 
 
 def write_manifest(table_root: str, entries: Sequence[ManifestEntry]) -> str:
@@ -291,6 +304,10 @@ def write_manifest(table_root: str, entries: Sequence[ManifestEntry]) -> str:
                          for e in entries],
         "null_counts": [_bounds_json(e.data_file.null_counts)
                         for e in entries],
+        "equality_ids": [json.dumps(list(e.data_file.equality_ids))
+                         for e in entries],
+        "sequence_number": [e.data_file.sequence_number
+                            for e in entries],
     }
     tab = pa.table({c: rows[c] for c in _MANIFEST_COLS})
     write_avro(tab, os.path.join(table_root, rel))
@@ -355,6 +372,8 @@ def _read_real_manifest(tab, table_root: str) -> List[ManifestEntry]:
         status = tab["status"][i].as_py()
         sid = tab["snapshot_id"][i].as_py() if "snapshot_id" in \
             tab.column_names else None
+        seq = tab["sequence_number"][i].as_py() \
+            if "sequence_number" in tab.column_names else None
         d = tab["data_file"][i].as_py() or {}
         part = d.get("partition")
         if isinstance(part, dict):
@@ -369,7 +388,9 @@ def _read_real_manifest(tab, table_root: str) -> List[ManifestEntry]:
                 record_count=int(d.get("record_count") or 0),
                 file_size=int(d.get("file_size_in_bytes") or 0),
                 spec_id=int(d.get("spec_id") or 0),
-                partition=partition)))
+                partition=partition,
+                equality_ids=tuple(d.get("equality_ids") or ()),
+                sequence_number=int(seq or 0))))
     return out
 
 
@@ -381,7 +402,8 @@ def read_manifest(table_root: str, rel_path: str) -> List[ManifestEntry]:
         return _read_real_manifest(tab, table_root)
     out = []
     for i in range(tab.num_rows):
-        row = {c: tab[c][i].as_py() for c in _MANIFEST_COLS}
+        row = {c: (tab[c][i].as_py() if c in tab.column_names else None)
+               for c in _MANIFEST_COLS}
         df = DataFile(
             file_path=row["file_path"], content=int(row["content"]),
             record_count=int(row["record_count"]),
@@ -391,7 +413,9 @@ def read_manifest(table_root: str, rel_path: str) -> List[ManifestEntry]:
             lower_bounds=_bounds_unjson(row["lower_bounds"]),
             upper_bounds=_bounds_unjson(row["upper_bounds"]),
             null_counts={k: int(v) for k, v in
-                         _bounds_unjson(row["null_counts"]).items()})
+                         _bounds_unjson(row["null_counts"]).items()},
+            equality_ids=tuple(json.loads(row["equality_ids"] or "[]")),
+            sequence_number=int(row["sequence_number"] or 0))
         out.append(ManifestEntry(int(row["status"]),
                                  int(row["snapshot_id"]), df))
     return out
@@ -431,6 +455,7 @@ class TableMetadata:
     default_spec_id: int = 0
     partition_specs: List[PartitionSpec] = field(default_factory=list)
     current_snapshot_id: Optional[int] = None
+    last_sequence_number: int = 0
     snapshots: List[IceSnapshot] = field(default_factory=list)
     snapshot_log: List[dict] = field(default_factory=list)
     properties: Dict[str, str] = field(default_factory=dict)
@@ -480,6 +505,7 @@ class TableMetadata:
             "default-spec-id": self.default_spec_id,
             "partition-specs": [s.to_json() for s in self.partition_specs],
             "current-snapshot-id": self.current_snapshot_id,
+            "last-sequence-number": self.last_sequence_number,
             "snapshots": [s.to_json() for s in self.snapshots],
             "snapshot-log": self.snapshot_log,
             "properties": self.properties,
@@ -497,6 +523,7 @@ class TableMetadata:
             partition_specs=[PartitionSpec.from_json(s)
                              for s in d.get("partition-specs", [])],
             current_snapshot_id=d.get("current-snapshot-id"),
+            last_sequence_number=d.get("last-sequence-number", 0),
             snapshots=[IceSnapshot.from_json(s)
                        for s in d.get("snapshots", [])],
             snapshot_log=d.get("snapshot-log", []),

@@ -146,10 +146,13 @@ class IcebergTable:
                          carried_manifests: List[str],
                          operation: str) -> IceSnapshot:
         sid = int(uuid.uuid4().int % (1 << 62))
+        seq = self.meta.last_sequence_number + 1
         manifests = list(carried_manifests)
         if new_entries:
             for e in new_entries:
                 e.snapshot_id = sid
+                if not e.data_file.sequence_number:
+                    e.data_file.sequence_number = seq
             manifests.append(write_manifest(self.path, new_entries))
         mlist = write_manifest_list(self.path, sid, manifests)
         now = int(time.time() * 1000)
@@ -158,7 +161,9 @@ class IcebergTable:
             parent_id=self.meta.current_snapshot_id,
             schema_id=self.meta.current_schema_id,
             summary={"operation": operation,
-                     "added-files": str(len(new_entries))})
+                     "added-files": str(len(new_entries))},
+            sequence_number=seq)
+        self.meta.last_sequence_number = seq
         self.meta.snapshots.append(snap)
         self.meta.current_snapshot_id = sid
         self.meta.snapshot_log.append(
@@ -223,17 +228,30 @@ class IcebergTable:
         snap = self.meta.snapshot()
         if snap is None:
             return 0
-        files = self._live_data_files(snap)
+        files, pos_files, eq_files = self._snapshot_files(snap)
         deleted = 0
         del_rows: Dict[str, List[int]] = {}
-        delete_map = self._delete_position_map(snap)
+        delete_map = self._delete_position_map(snap, pos_files)
         # predicates address the CURRENT schema names (same contract as
         # scan()'s current reads)
         cur_schema = self.meta.schema()
+        eq_deletes = self._equality_deletes(snap, cur_schema, eq_files)
         for df in files:
             tab = self._read_data_file(df, cur_schema)
             existing = delete_map.get(df.file_path, set())
             mask = self._eval_predicate(tab, predicate)
+            # rows already removed by equality deletes must not count as
+            # (re-)deleted — compute the live mask the scan would see
+            if eq_deletes:
+                live = np.ones(tab.num_rows, dtype=bool)
+                for seq, names, keys in eq_deletes:
+                    if not keys or (df.sequence_number
+                                    and seq <= df.sequence_number):
+                        continue
+                    vals = list(zip(*[tab[n].to_pylist() for n in names]))
+                    live &= np.array([t not in keys for t in vals],
+                                     dtype=bool)
+                mask = mask & live
             for pos in np.nonzero(mask)[0]:
                 if int(pos) not in existing:
                     del_rows.setdefault(df.file_path, []).append(int(pos))
@@ -256,6 +274,37 @@ class IcebergTable:
                 file_size=os.path.getsize(full))))
         self._commit_snapshot(entries, self._current_manifests(), "delete")
         return deleted
+
+    def delete_where_equality(self, keys: "pa.Table") -> "IcebergTable":
+        """Commit an EQUALITY_DELETES file: every (current or future-read)
+        data row whose values for ``keys``' columns equal one of the key
+        rows is deleted.  Columns resolve against the current schema."""
+        from .metadata import EQUALITY_DELETES
+        schema = self.meta.schema()
+        fids = []
+        for name in keys.column_names:
+            f = schema.field_by_name(name)
+            if f is None:
+                raise KeyError(name)
+            fids.append(f.field_id)
+        rel = os.path.join("data", f"eqdel-{uuid.uuid4().hex}.parquet")
+        full = os.path.join(self.path, rel)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        # stamp PARQUET:field_id so the delete keeps applying across
+        # column renames (and foreign readers resolve it by id)
+        stamped = pa.schema([
+            pa.field(f.name, f.type,
+                     metadata={b"PARQUET:field_id":
+                               str(fid).encode()})
+            for f, fid in zip(keys.schema, fids)])
+        pq.write_table(keys.cast(stamped), full)
+        entry = ManifestEntry(STATUS_ADDED, 0, DataFile(
+            file_path=rel, content=EQUALITY_DELETES,
+            record_count=keys.num_rows,
+            file_size=os.path.getsize(full),
+            equality_ids=tuple(fids)))
+        self._commit_snapshot([entry], self._current_manifests(), "delete")
+        return self
 
     def _eval_predicate(self, tab: pa.Table, predicate) -> np.ndarray:
         if callable(predicate):
@@ -321,28 +370,103 @@ class IcebergTable:
     # ------------------------------------------------------------------
     # scan planning
     # ------------------------------------------------------------------
-    def _live_data_files(self, snap: IceSnapshot) -> List[DataFile]:
-        out = []
+    def _snapshot_files(self, snap: IceSnapshot):
+        """ONE manifest pass per scan, classified by content:
+        (data_files, position_delete_files, equality_delete_files).
+        Entries whose sequence number is null (real writers rely on v2
+        INHERITANCE) resolve to the sequence of the snapshot that added
+        them — mapping null to 0 would both let older equality deletes
+        eat re-inserted rows and let newer deletes be skipped."""
+        seq_of = {s.snapshot_id: s.sequence_number
+                  for s in self.meta.snapshots}
+        data: List[DataFile] = []
+        pos: List[DataFile] = []
+        eq: List[DataFile] = []
         for mrel in read_manifest_list(self.path, snap.manifest_list):
             for e in read_manifest(self.path, mrel):
-                if e.status != 2 and e.data_file.content == DATA:
-                    out.append(e.data_file)
-        return out
+                if e.status == 2:
+                    continue
+                df = e.data_file
+                if not df.sequence_number:
+                    df.sequence_number = seq_of.get(e.snapshot_id, 0)
+                if df.content == DATA:
+                    data.append(df)
+                elif df.content == POSITION_DELETES:
+                    pos.append(df)
+                else:
+                    eq.append(df)
+        return data, pos, eq
+
+    def _live_data_files(self, snap: IceSnapshot) -> List[DataFile]:
+        return self._snapshot_files(snap)[0]
 
     def _delete_files(self, snap: IceSnapshot) -> List[DataFile]:
+        return self._snapshot_files(snap)[1]
+
+    def _equality_deletes(self, snap: IceSnapshot, schema,
+                          eq_files=None):
+        """[(sequence_number, key column names, {key tuples})] for every
+        live EQUALITY_DELETES file (reference ``GpuDeleteFilter.java:94``
+        equalityFieldIds): a data row is dropped when its values for the
+        delete's field ids equal a delete row's (null == null, like
+        Iceberg's equality delete semantics), and the delete's sequence
+        number is strictly newer than the data file's."""
+        if eq_files is None:
+            eq_files = self._snapshot_files(snap)[2]
         out = []
-        for mrel in read_manifest_list(self.path, snap.manifest_list):
-            for e in read_manifest(self.path, mrel):
-                if e.status != 2 and e.data_file.content == POSITION_DELETES:
-                    out.append(e.data_file)
+        for df in eq_files:
+                tab = pq.read_table(os.path.join(self.path, df.file_path))
+                names = []
+                for fid in df.equality_ids:
+                    f = schema.field_by_id(int(fid))
+                    if f is None:
+                        raise ValueError(
+                            f"equality delete {df.file_path} references "
+                            f"unknown field id {fid}")
+                    names.append(f.name)
+                # delete files may carry historical column names; match
+                # columns by embedded field id first, then by name
+                cols = []
+                for fid, name in zip(df.equality_ids, names):
+                    idx = None
+                    for j, pf in enumerate(tab.schema):
+                        md = pf.metadata or {}
+                        if md.get(b"PARQUET:field_id") == \
+                                str(fid).encode():
+                            idx = j
+                            break
+                    if idx is None:
+                        idx = tab.column_names.index(name) \
+                            if name in tab.column_names else None
+                    if idx is None:
+                        raise ValueError(
+                            f"equality delete {df.file_path} lacks a "
+                            f"column for field id {fid} ({name})")
+                    cols.append(tab.column(idx).to_pylist())
+                keys = set(zip(*cols)) if cols else set()
+                out.append((df.sequence_number, names, keys))
         return out
 
-    def _delete_position_map(self, snap: IceSnapshot) -> Dict[str, set]:
+    @staticmethod
+    def _apply_equality_deletes(tab: pa.Table, file_seq: int,
+                                eq_deletes) -> pa.Table:
+        for seq, names, keys in eq_deletes:
+            if not keys or (file_seq and seq <= file_seq):
+                continue  # delete is not newer than the data
+            vals = list(zip(*[tab[n].to_pylist() for n in names]))
+            mask = pa.array([t not in keys for t in vals],
+                            type=pa.bool_())
+            tab = tab.filter(mask)
+        return tab
+
+    def _delete_position_map(self, snap: IceSnapshot,
+                             pos_files=None) -> Dict[str, set]:
         """All position deletes for the snapshot, read ONCE per scan:
         {data_file_path: {deleted row positions}}."""
         from .metadata import normalize_data_path
         out: Dict[str, set] = {}
-        for df in self._delete_files(snap):
+        for df in (pos_files if pos_files is not None
+                   else self._delete_files(snap)):
             tab = pq.read_table(os.path.join(self.path, df.file_path))
             for fp, p in zip(tab["file_path"].to_pylist(),
                              tab["pos"].to_pylist()):
@@ -453,9 +577,10 @@ class IcebergTable:
         if snap is None:
             return []
         schema = self.meta.schema(schema_id)
-        files = self._prune_files(self._live_data_files(snap), filters,
-                                  schema)
-        delete_map = self._delete_position_map(snap)
+        data_files, pos_files, eq_files = self._snapshot_files(snap)
+        files = self._prune_files(data_files, filters, schema)
+        delete_map = self._delete_position_map(snap, pos_files)
+        eq_deletes = self._equality_deletes(snap, schema, eq_files)
         out = []
         for df in files:
             tab = self._read_data_file(df, schema)
@@ -464,6 +589,9 @@ class IcebergTable:
                 keep = np.setdiff1d(np.arange(tab.num_rows),
                                     np.fromiter(dels, dtype=np.int64))
                 tab = tab.take(pa.array(keep, type=pa.int64()))
+            if eq_deletes:
+                tab = self._apply_equality_deletes(
+                    tab, df.sequence_number, eq_deletes)
             out.append(tab)
         return out
 

@@ -66,3 +66,72 @@ def test_history_and_snapshots(sess):
     t = IcebergTable.for_path(sess, GOLDEN)
     ops = [h["operation"] for h in t.history()]
     assert ops == ["append", "delete"]
+
+
+def test_foreign_equality_deletes(sess):
+    """orders_eqdel golden fixture: a foreign v2 table whose second
+    snapshot commits an EQUALITY delete (field id 1 = order_id, ids 2 and
+    5, written under a HISTORICAL column name so only field-id matching
+    finds it).  The scan must drop exactly those rows (reference
+    GpuDeleteFilter.java:94 equalityFieldIds)."""
+    t = IcebergTable.for_path(
+        sess, os.path.join(os.path.dirname(GOLDEN), "orders_eqdel"))
+    df = t.to_df()
+    got = df.collect().to_pandas().sort_values("order_id")
+    assert list(got["order_id"]) == [1, 3, 4, 6]
+    assert list(got["amount"]) == [10.0, 30.0, 5.25, 42.0]
+
+
+def test_engine_equality_delete_roundtrip(sess, tmp_path):
+    """Engine-written equality deletes: delete_where_equality commits an
+    EQUALITY_DELETES file; a fresh reader applies it.  Data appended
+    AFTER the delete (higher sequence number) is NOT affected —
+    sequence-number scoping, the part position deletes don't have."""
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T2
+    path = str(tmp_path / "eqtbl")
+    t = IcebergTable.create(sess, path, T2.StructType((
+        T2.StructField("id", T2.LONG, True),
+        T2.StructField("v", T2.DOUBLE, True))))
+    t.append(pa.table({"id": pa.array([1, 2, 3], pa.int64()),
+                       "v": [1.0, 2.0, 3.0]}))
+    t.delete_where_equality(pa.table({"id": pa.array([2], pa.int64())}))
+    # re-append id=2 AFTER the delete: must survive (newer sequence)
+    t.append(pa.table({"id": pa.array([2], pa.int64()), "v": [99.0]}))
+    fresh = IcebergTable.for_path(sess, path)
+    got = fresh.to_df().collect().to_pandas().sort_values(["id", "v"])
+    assert list(got["id"]) == [1, 2, 3]
+    assert list(got["v"]) == [1.0, 99.0, 3.0]
+
+
+def test_equality_delete_survives_rename(sess, tmp_path):
+    """The delete file is stamped with PARQUET:field_id, so the delete
+    keeps applying after the key column is renamed (field-id resolution,
+    like foreign readers)."""
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T2
+    path = str(tmp_path / "rn")
+    t = IcebergTable.create(sess, path, T2.StructType((
+        T2.StructField("id", T2.LONG, True),
+        T2.StructField("v", T2.DOUBLE, True))))
+    t.append(pa.table({"id": pa.array([1, 2, 3], pa.int64()),
+                       "v": [1.0, 2.0, 3.0]}))
+    t.delete_where_equality(pa.table({"id": pa.array([2], pa.int64())}))
+    t.rename_column("id", "ident")
+    got = (IcebergTable.for_path(sess, path).to_df()
+           .collect().to_pandas().sort_values("ident"))
+    assert list(got["ident"]) == [1, 3]
+
+
+def test_delete_where_skips_eq_deleted_rows(sess, tmp_path):
+    """delete_where must not count (or re-delete) rows an equality
+    delete already removed (review r4 finding)."""
+    import pyarrow as pa
+    from spark_rapids_tpu import types as T2
+    path = str(tmp_path / "dw")
+    t = IcebergTable.create(sess, path, T2.StructType((
+        T2.StructField("id", T2.LONG, True),)))
+    t.append(pa.table({"id": pa.array([1, 2, 3], pa.int64())}))
+    t.delete_where_equality(pa.table({"id": pa.array([2], pa.int64())}))
+    n = t.delete_where(("id", "=", 2))
+    assert n == 0, n

@@ -238,6 +238,135 @@ def make_orders():
         fh.write("2")
 
 
+MANIFEST_ENTRY_SCHEMA_V2SEQ = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"], "default": None},
+        {"name": "sequence_number", "type": ["null", "long"],
+         "default": None},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "r102", "fields": []}},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+                {"name": "equality_ids",
+                 "type": ["null", {"type": "array", "items": "int"}],
+                 "default": None},
+            ]}},
+    ]}
+
+
+def _entry_v2(status, snapshot_id, seq, path, content, records, size,
+              equality_ids=None):
+    return {"status": status, "snapshot_id": snapshot_id,
+            "sequence_number": seq,
+            "data_file": {"content": content, "file_path": path,
+                          "file_format": "PARQUET", "partition": {},
+                          "record_count": records,
+                          "file_size_in_bytes": size,
+                          "equality_ids": equality_ids}}
+
+
+def make_orders_eqdel():
+    """orders_eqdel: snapshot 1 appends two data files (seq 1), snapshot 2
+    commits an EQUALITY delete on order_id (seq 2) removing ids 2 and 5 —
+    the v2 row-level delete shape the reference applies via
+    GpuDeleteFilter.equalityFieldIds."""
+    t = os.path.join(ROOT, "orders_eqdel")
+    shutil.rmtree(t, ignore_errors=True)
+
+    def data_file(name, tbl):
+        rel = f"data/{name}"
+        full = os.path.join(t, rel)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        pq.write_table(tbl, full)
+        return rel, os.path.getsize(full), tbl.num_rows
+
+    def fid_schema(pairs):
+        return pa.schema([
+            pa.field(n, ty, metadata={b"PARQUET:field_id":
+                                      str(i).encode()})
+            for i, (n, ty) in enumerate(pairs, start=1)])
+
+    sch = fid_schema([("order_id", pa.int64()), ("amount", pa.float64())])
+    f0 = pa.table({"order_id": pa.array([1, 2, 3, 4], pa.int64()),
+                   "amount": [10.0, 20.5, 30.0, 5.25]}).cast(sch)
+    f1 = pa.table({"order_id": pa.array([5, 6], pa.int64()),
+                   "amount": [99.0, 42.0]}).cast(sch)
+    r0, s0, n0 = data_file(f"00000-0-{uuid.uuid4()}.parquet", f0)
+    r1, s1, n1 = data_file(f"00001-0-{uuid.uuid4()}.parquet", f1)
+
+    # real writers leave ADDED entries' sequence_number NULL and rely on
+    # v2 inheritance from the committing snapshot — the reader must
+    # resolve these to snapshot 2001's sequence (1), not 0
+    m1 = f"metadata/{uuid.uuid4()}-m0.avro"
+    write_avro_file(os.path.join(t, m1), MANIFEST_ENTRY_SCHEMA_V2SEQ, [
+        _entry_v2(1, 2001, None, r0, 0, n0, s0),
+        _entry_v2(1, 2001, None, r1, 0, n1, s1)])
+    l1 = "metadata/snap-2001-1-x.avro"
+    write_avro_file(os.path.join(t, l1), MANIFEST_FILE_SCHEMA, [
+        {"manifest_path": m1,
+         "manifest_length": os.path.getsize(os.path.join(t, m1)),
+         "partition_spec_id": 0, "added_snapshot_id": 2001}])
+
+    # equality delete on field id 1 (order_id): drop ids 2 and 5 —
+    # written under a HISTORICAL column name to force field-id matching
+    dsch = pa.schema([pa.field("order_id_v1", pa.int64(),
+                               metadata={b"PARQUET:field_id": b"1"})])
+    dtab = pa.table({"order_id_v1": pa.array([2, 5], pa.int64())}).cast(dsch)
+    rd, sd, nd = data_file(f"00002-eqdel-{uuid.uuid4()}.parquet", dtab)
+    m2 = f"metadata/{uuid.uuid4()}-m0.avro"
+    write_avro_file(os.path.join(t, m2), MANIFEST_ENTRY_SCHEMA_V2SEQ, [
+        _entry_v2(1, 2002, 2, rd, 2, nd, sd, equality_ids=[1])])
+    l2 = "metadata/snap-2002-1-x.avro"
+    write_avro_file(os.path.join(t, l2), MANIFEST_FILE_SCHEMA, [
+        {"manifest_path": m1,
+         "manifest_length": os.path.getsize(os.path.join(t, m1)),
+         "partition_spec_id": 0, "added_snapshot_id": 2001},
+        {"manifest_path": m2,
+         "manifest_length": os.path.getsize(os.path.join(t, m2)),
+         "partition_spec_id": 0, "added_snapshot_id": 2002}])
+
+    meta = {
+        "format-version": 2,
+        "table-uuid": str(uuid.uuid4()),
+        "location": "file:///warehouse/orders_eqdel",
+        "last-updated-ms": 1735689600000,
+        "last-column-id": 2,
+        "last-sequence-number": 2,
+        "current-schema-id": 0,
+        "schemas": [{"type": "struct", "schema-id": 0, "fields": [
+            {"id": 1, "name": "order_id", "required": False,
+             "type": "long"},
+            {"id": 2, "name": "amount", "required": False,
+             "type": "double"}]}],
+        "default-spec-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": []}],
+        "current-snapshot-id": 2002,
+        "snapshots": [
+            {"snapshot-id": 2001, "timestamp-ms": 1735689600000,
+             "sequence-number": 1, "manifest-list": l1,
+             "summary": {"operation": "append"}},
+            {"snapshot-id": 2002, "timestamp-ms": 1735689700000,
+             "sequence-number": 2, "manifest-list": l2,
+             "summary": {"operation": "delete"}}],
+        "snapshot-log": [
+            {"snapshot-id": 2001, "timestamp-ms": 1735689600000},
+            {"snapshot-id": 2002, "timestamp-ms": 1735689700000}],
+        "properties": {"write.format.default": "parquet"},
+    }
+    d = os.path.join(t, "metadata")
+    with open(os.path.join(d, "v2.metadata.json"), "w") as fh:
+        json.dump(meta, fh, indent=1)
+    with open(os.path.join(d, "version-hint.text"), "w") as fh:
+        fh.write("2")
+
+
 if __name__ == "__main__":
     make_orders()
+    make_orders_eqdel()
     print("golden iceberg table written under", ROOT)
