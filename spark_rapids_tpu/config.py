@@ -408,6 +408,13 @@ FATAL_ERROR_EXIT = register(
     "error so an external scheduler replaces it (the reference "
     "executor's behavior, Plugin.scala:515-539). Off by default: this "
     "engine usually runs inside the user's process.", False)
+PYTHON_WORKER_ISOLATED = register(
+    "spark.rapids.python.worker.isolated",
+    "Run pandas UDFs in separate worker PROCESSES with Arrow IPC "
+    "exchange (reference python/rapids/daemon.py): a user function that "
+    "kills its interpreter fails the task, not the session, and the "
+    "concurrentPythonWorkers cap gates real processes.  false = "
+    "in-process fast path (no crash containment).", True)
 CONCURRENT_PYTHON_WORKERS = register(
     "spark.rapids.python.concurrentPythonWorkers",
     "Max concurrently-running user-Python sections (pandas UDFs, "

@@ -44,6 +44,13 @@ def _semaphore_released(backend: str, tctx: TaskContext):
             sem.acquire_if_necessary(tctx.partition_id, tctx)
 
 
+def _run_job(tctx: TaskContext, job_fn, pdfs):
+    """Route a pandas job through the out-of-process worker pool
+    (pyworker.py; in-process when worker.isolated=false)."""
+    from ...pyworker import run_pandas_job
+    return run_pandas_job(tctx.conf, job_fn, pdfs)
+
+
 def _to_pandas(batch: ColumnarBatch):
     from ...columnar.convert import device_to_arrow
     return device_to_arrow(batch).to_pandas()
@@ -86,9 +93,14 @@ class MapInPandasExec(PhysicalPlan):
                 for b in self.children[0].execute(pid, tctx)]
         if not pdfs:
             return
+        func = self.func
+
+        def job(frames):
+            return [o for o in func(iter(frames))
+                    if o is not None and len(o)]
+
         with _semaphore_released(self.backend, tctx):
-            outs = [pdf for pdf in self.func(iter(pdfs))
-                    if pdf is not None and len(pdf)]
+            outs = _run_job(tctx, job, pdfs)
         for pdf in outs:
             yield _from_pandas(pdf, self.out_schema, self.backend)
 
@@ -125,13 +137,18 @@ class FlatMapGroupsInPandasExec(PhysicalPlan):
         pdf = _to_pandas(merged)
         if not len(pdf):
             return
-        outs = []
+        groups = [g for _, g in pdf.groupby(self.grouping_names,
+                                            sort=False, dropna=False)]
+        del pdf, merged, batches  # group slices are copies; drop the
+        # originals before the Arrow serialization doubles them again
+        func = self.func
+
+        def job(frames):
+            return [o for o in (func(g) for g in frames)
+                    if o is not None and len(o)]
+
         with _semaphore_released(self.backend, tctx):
-            for _, group in pdf.groupby(self.grouping_names, sort=False,
-                                        dropna=False):
-                out = self.func(group)
-                if out is not None and len(out):
-                    outs.append(out)
+            outs = _run_job(tctx, job, groups)
         for out in outs:
             yield _from_pandas(out, self.out_schema, self.backend)
 
@@ -180,26 +197,33 @@ class AggregateInPandasExec(PhysicalPlan):
         arg_names = []
         for _name, u in self.agg_udfs:
             arg_names.append([getattr(c, "name", str(c)) for c in u.children])
-        rows = []
-        with _semaphore_released(self.backend, tctx):
-            if not self.grouping_names:
-                # global aggregation: one group spanning the whole input
+        grouping_names = self.grouping_names
+        udfs = [(name, u.func) for name, u in self.agg_udfs]
+
+        def job(frames):
+            import pandas as _pd
+            f = frames[0]
+            out_rows = []
+            if not grouping_names:
                 row = {}
-                for (name, u), cols in zip(self.agg_udfs, arg_names):
-                    row[name] = u.func(*[pdf[c] for c in cols])
-                rows.append(row)
+                for (name, fn), cols in zip(udfs, arg_names):
+                    row[name] = fn(*[f[c] for c in cols])
+                out_rows.append(row)
             else:
-                for key, group in pdf.groupby(self.grouping_names,
-                                              sort=False, dropna=False):
+                for key, group in f.groupby(grouping_names, sort=False,
+                                            dropna=False):
                     if not isinstance(key, tuple):
                         key = (key,)
-                    row = dict(zip(self.grouping_names, key))
-                    for (name, u), cols in zip(self.agg_udfs, arg_names):
-                        row[name] = u.func(*[group[c] for c in cols])
-                    rows.append(row)
+                    row = dict(zip(grouping_names, key))
+                    for (name, fn), cols in zip(udfs, arg_names):
+                        row[name] = fn(*[group[c] for c in cols])
+                    out_rows.append(row)
+            return [_pd.DataFrame(out_rows)]
+
+        with _semaphore_released(self.backend, tctx):
+            out_pdf = _run_job(tctx, job, [pdf])[0]
         out_schema = T.StructType(tuple(
             T.StructField(a.name, a.data_type, True) for a in self.output))
-        out_pdf = pd.DataFrame(rows)
         yield _from_pandas(out_pdf, out_schema, self.backend)
 
     def simple_string(self):
@@ -263,14 +287,22 @@ class FlatMapCoGroupsInPandasExec(PhysicalPlan):
         if not lgroups and not rgroups:
             return
         keys = list(dict.fromkeys(list(lgroups) + list(rgroups)))
-        outs = []
+        frames = []
+        for k in keys:
+            frames.append(lgroups.get(k, lempty))
+            frames.append(rgroups.get(k, rempty))
+        func = self.func
+
+        def job(fs):
+            out_ = []
+            for i in range(0, len(fs), 2):
+                o = func(fs[i], fs[i + 1])
+                if o is not None and len(o):
+                    out_.append(o)
+            return out_
+
         with _semaphore_released(self.backend, tctx):
-            for k in keys:
-                lg = lgroups.get(k, lempty)
-                rg = rgroups.get(k, rempty)
-                out = self.func(lg, rg)
-                if out is not None and len(out):
-                    outs.append(out)
+            outs = _run_job(tctx, job, frames)
         for out in outs:
             yield _from_pandas(out, self.out_schema, self.backend)
 

@@ -378,3 +378,122 @@ def test_grouped_agg_udf_global_and_aliased_key(sess):
     out2 = (df.groupBy(df.k.alias("kk")).agg(s(df.v).alias("t"))
             .orderBy("kk").collect())
     assert out2.to_pylist() == [{"kk": 1, "t": 3.0}, {"kk": 2, "t": 9.0}]
+
+
+# ---------------------------------------------------------------------------
+# out-of-process worker pool (python/rapids/daemon.py analog, VERDICT r3 #9)
+# ---------------------------------------------------------------------------
+
+def test_udf_worker_crash_fails_task_not_session(sess):
+    """A UDF that kills its interpreter takes down its WORKER process;
+    the task fails with WorkerCrashed, and the session keeps serving
+    queries afterwards (the done-criteria of VERDICT r3 #9)."""
+    import pytest as _pytest
+    from spark_rapids_tpu.pyworker import STATS, WorkerCrashed
+    t = pa.table({"x": [1.0, 2.0, 3.0]})
+    df = sess.create_dataframe(t)
+
+    def killer(it):
+        import os
+        os._exit(42)
+        yield  # pragma: no cover
+
+    crashes0 = STATS["crashes"]
+    with _pytest.raises(Exception) as ei:
+        df.mapInPandas(killer, T.StructType((
+            T.StructField("x", T.DOUBLE, True),))).collect()
+    assert isinstance(ei.value, WorkerCrashed) or \
+        "worker died" in str(ei.value)
+    assert STATS["crashes"] == crashes0 + 1
+    # session is alive: both a plain query and a fresh UDF still work
+    assert df.count() == 3
+    out = df.mapInPandas(
+        lambda it: (p.assign(x=p.x * 2) for p in it),
+        T.StructType((T.StructField("x", T.DOUBLE, True),))
+    ).collect().to_pandas()
+    assert sorted(out["x"]) == [2.0, 4.0, 6.0]
+
+
+def test_udf_worker_error_carries_traceback(sess):
+    import pytest as _pytest
+    t = pa.table({"x": [1.0]})
+    df = sess.create_dataframe(t)
+
+    def boom(it):
+        raise RuntimeError("sentinel-broke-here")
+        yield  # pragma: no cover
+
+    with _pytest.raises(Exception, match="sentinel-broke-here"):
+        df.mapInPandas(boom, T.StructType((
+            T.StructField("x", T.DOUBLE, True),))).collect()
+
+
+def test_udf_worker_print_does_not_corrupt_protocol(sess):
+    t = pa.table({"x": [1.0, 2.0]})
+    df = sess.create_dataframe(t)
+
+    def chatty(it):
+        for p in it:
+            print("user print must go to stderr, not the frame pipe")
+            yield p
+
+    out = df.mapInPandas(chatty, T.StructType((
+        T.StructField("x", T.DOUBLE, True),))).collect()
+    assert out.num_rows == 2
+
+
+def test_udf_worker_pool_reuse_and_gating(sess):
+    """Workers are reused across jobs, and the pool never holds more
+    live workers than the concurrentPythonWorkers cap."""
+    from spark_rapids_tpu.pyworker import STATS, PythonWorkerPool
+    t = pa.table({"x": [1.0, 2.0]})
+    df = sess.create_dataframe(t)
+    schema = T.StructType((T.StructField("x", T.DOUBLE, True),))
+    spawned0 = STATS["spawned"]
+    for _ in range(3):
+        df.mapInPandas(lambda it: it, schema).collect()
+    assert STATS["spawned"] - spawned0 <= 1, "workers were not reused"
+    pool = PythonWorkerPool.get(sess._conf)
+    assert STATS["peak_workers"] <= pool.capacity
+
+
+def test_udf_in_process_kill_switch(sess):
+    """worker.isolated=false restores the in-process path (object
+    identity survives, no Arrow round-trip)."""
+    sess.conf.set("spark.rapids.python.worker.isolated", False)
+    try:
+        from spark_rapids_tpu.pyworker import STATS
+        jobs0 = STATS["jobs"]
+        t = pa.table({"x": [1.0]})
+        df = sess.create_dataframe(t)
+        out = df.mapInPandas(
+            lambda it: (p for p in it),
+            T.StructType((T.StructField("x", T.DOUBLE, True),))
+        ).collect()
+        assert out.num_rows == 1
+        assert STATS["jobs"] == jobs0  # pool untouched
+    finally:
+        sess.conf.set("spark.rapids.python.worker.isolated", True)
+
+
+def test_udf_worker_reraises_original_exception_type(sess):
+    """User exceptions cross the worker boundary with their ORIGINAL
+    type (picklable case), so `except ValueError:` written against the
+    in-process path keeps working — and the worker survives user errors
+    (no respawn per exception)."""
+    import pytest as _pytest
+    from spark_rapids_tpu.pyworker import STATS
+    t = pa.table({"x": [1.0]})
+    df = sess.create_dataframe(t)
+    schema = T.StructType((T.StructField("x", T.DOUBLE, True),))
+
+    def raiser(it):
+        raise ValueError("typed-error-sentinel")
+        yield  # pragma: no cover
+
+    df.mapInPandas(lambda it: it, schema).collect()  # warm a worker
+    spawned0 = STATS["spawned"]
+    with _pytest.raises(ValueError, match="typed-error-sentinel"):
+        df.mapInPandas(raiser, schema).collect()
+    df.mapInPandas(lambda it: it, schema).collect()
+    assert STATS["spawned"] == spawned0, "user error must not kill worker"
