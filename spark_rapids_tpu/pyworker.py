@@ -216,13 +216,20 @@ class PythonWorkerPool:
             self._live = 0
 
 
-def run_pandas_job(conf, job_fn, pdfs: List) -> List:
-    """Run ``job_fn(pdfs) -> list[pd.DataFrame]`` — isolated in a worker
-    process (default) or in-process when
-    ``spark.rapids.python.worker.isolated=false``."""
-    if not bool(conf.get(PYTHON_WORKER_ISOLATED)):
-        return list(job_fn(pdfs))
+def run_pandas_job(conf, job_fn, tables: List) -> List:
+    """Run ``job_fn(list[pd.DataFrame]) -> list[pd.DataFrame]`` over
+    Arrow tables — isolated in a worker process (default) or in-process
+    when ``spark.rapids.python.worker.isolated=false``.
+
+    Arrow in, Arrow out on BOTH paths: the pandas conversion happens
+    exactly once, inside the job (worker-side when isolated), so the
+    two modes hand user code identical frames (same RangeIndex, same
+    dtype normalization) and the isolated path never pays a redundant
+    pandas round trip in the parent."""
     import pyarrow as pa
-    tables = [pa.Table.from_pandas(p, preserve_index=False) for p in pdfs]
-    out = PythonWorkerPool.get(conf).run_job(job_fn, tables)
-    return [t.to_pandas() for t in out]
+    if not bool(conf.get(PYTHON_WORKER_ISOLATED)):
+        outs = job_fn([t.to_pandas() for t in tables])
+        return [o if isinstance(o, pa.Table)
+                else pa.Table.from_pandas(o, preserve_index=False)
+                for o in outs]
+    return PythonWorkerPool.get(conf).run_job(job_fn, tables)

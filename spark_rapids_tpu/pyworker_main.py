@@ -14,6 +14,7 @@ little-endian uint64):
   worker -> parent:
       [status u8]  0: [ntables] ([len][arrow IPC stream])*
                    1: [len][utf-8 traceback]
+                      [len][cloudpickle(exception) or 0 bytes]
 
 ``job_fn(list[pd.DataFrame]) -> list[pd.DataFrame]`` carries the user
 function AND the exec's shape logic (map-iterator, per-group, pairs) as
@@ -65,10 +66,15 @@ def main() -> None:
         if not head or len(head) < 8:
             break  # parent closed the pipe: clean shutdown
         (n,) = struct.unpack("<Q", head)
-        job_fn = cloudpickle.loads(_read_exact(proto_in, n))
+        job_blob = _read_exact(proto_in, n)
         (k,) = struct.unpack("<Q", _read_exact(proto_in, 8))
         tables = [read_table() for _ in range(k)]
         try:
+            # unpickle INSIDE the job try: a closure that fails to
+            # deserialize (missing module in the worker) must report as
+            # a typed error, not kill the worker and masquerade as an
+            # interpreter crash
+            job_fn = cloudpickle.loads(job_blob)
             pdfs = [t.to_pandas() for t in tables]
             outs = job_fn(pdfs)
             # serialize EVERYTHING before the status byte: a failure

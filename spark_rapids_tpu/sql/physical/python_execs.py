@@ -44,24 +44,25 @@ def _semaphore_released(backend: str, tctx: TaskContext):
             sem.acquire_if_necessary(tctx.partition_id, tctx)
 
 
-def _run_job(tctx: TaskContext, job_fn, pdfs):
-    """Route a pandas job through the out-of-process worker pool
-    (pyworker.py; in-process when worker.isolated=false)."""
+def _run_job(tctx: TaskContext, job_fn, tables):
+    """Route a pandas job (Arrow tables in/out) through the
+    out-of-process worker pool (pyworker.py; in-process when
+    worker.isolated=false)."""
     from ...pyworker import run_pandas_job
-    return run_pandas_job(tctx.conf, job_fn, pdfs)
+    return run_pandas_job(tctx.conf, job_fn, tables)
 
 
-def _to_pandas(batch: ColumnarBatch):
+def _to_arrow(batch: ColumnarBatch):
     from ...columnar.convert import device_to_arrow
-    return device_to_arrow(batch).to_pandas()
+    return device_to_arrow(batch)
 
 
-def _from_pandas(pdf, schema: T.StructType, backend: str) -> ColumnarBatch:
+def _from_arrow(table, schema: T.StructType, backend: str) -> ColumnarBatch:
     import pyarrow as pa
     from ...columnar.convert import arrow_to_device
-    table = pa.Table.from_pandas(pdf, preserve_index=False).cast(
-        pa.schema([pa.field(f.name, T.to_arrow(f.data_type))
-                   for f in schema.fields]))
+    table = table.cast(pa.schema([
+        pa.field(f.name, T.to_arrow(f.data_type))
+        for f in schema.fields]))
     batch = arrow_to_device(table)
     if backend != TPU:
         import jax
@@ -89,9 +90,9 @@ class MapInPandasExec(PhysicalPlan):
         # device->host transfer happens BEFORE the semaphore is released
         # (GpuArrowPythonRunner ordering); user Python then runs without
         # holding the chip
-        pdfs = [_to_pandas(b)
-                for b in self.children[0].execute(pid, tctx)]
-        if not pdfs:
+        tables = [_to_arrow(b)
+                  for b in self.children[0].execute(pid, tctx)]
+        if not tables:
             return
         func = self.func
 
@@ -100,9 +101,9 @@ class MapInPandasExec(PhysicalPlan):
                     if o is not None and len(o)]
 
         with _semaphore_released(self.backend, tctx):
-            outs = _run_job(tctx, job, pdfs)
-        for pdf in outs:
-            yield _from_pandas(pdf, self.out_schema, self.backend)
+            outs = _run_job(tctx, job, tables)
+        for tab in outs:
+            yield _from_arrow(tab, self.out_schema, self.backend)
 
     def simple_string(self):
         return (f"{self.node_name()} "
@@ -134,23 +135,26 @@ class FlatMapGroupsInPandasExec(PhysicalPlan):
             return
         merged = (ColumnarBatch.concat(batches) if len(batches) > 1
                   else batches[0])
-        pdf = _to_pandas(merged)
-        if not len(pdf):
+        table = _to_arrow(merged)
+        if not table.num_rows:
             return
-        groups = [g for _, g in pdf.groupby(self.grouping_names,
-                                            sort=False, dropna=False)]
-        del pdf, merged, batches  # group slices are copies; drop the
-        # originals before the Arrow serialization doubles them again
         func = self.func
+        grouping_names = self.grouping_names
 
         def job(frames):
-            return [o for o in (func(g) for g in frames)
-                    if o is not None and len(o)]
+            # grouping runs INSIDE the job (worker-side when isolated):
+            # one table crosses the pipe instead of one per group, and
+            # both modes hand user code identical group frames
+            f = frames[0]
+            return [o for o in (
+                func(g) for _, g in f.groupby(grouping_names, sort=False,
+                                              dropna=False))
+                if o is not None and len(o)]
 
         with _semaphore_released(self.backend, tctx):
-            outs = _run_job(tctx, job, groups)
+            outs = _run_job(tctx, job, [table])
         for out in outs:
-            yield _from_pandas(out, self.out_schema, self.backend)
+            yield _from_arrow(out, self.out_schema, self.backend)
 
     def simple_string(self):
         keys = ", ".join(self.grouping_names)
@@ -184,14 +188,13 @@ class AggregateInPandasExec(PhysicalPlan):
         return keys + aggs
 
     def execute(self, pid: int, tctx: TaskContext):
-        import pandas as pd
         batches = list(self.children[0].execute(pid, tctx))
         if not batches:
             return
         merged = (ColumnarBatch.concat(batches) if len(batches) > 1
                   else batches[0])
-        pdf = _to_pandas(merged)
-        if not len(pdf):
+        table = _to_arrow(merged)
+        if not table.num_rows:
             return
         # argument column names per udf (children are resolved attributes)
         arg_names = []
@@ -221,10 +224,10 @@ class AggregateInPandasExec(PhysicalPlan):
             return [_pd.DataFrame(out_rows)]
 
         with _semaphore_released(self.backend, tctx):
-            out_pdf = _run_job(tctx, job, [pdf])[0]
+            out_tab = _run_job(tctx, job, [table])[0]
         out_schema = T.StructType(tuple(
             T.StructField(a.name, a.data_type, True) for a in self.output))
-        yield _from_pandas(out_pdf, out_schema, self.backend)
+        yield _from_arrow(out_tab, out_schema, self.backend)
 
     def simple_string(self):
         keys = ", ".join(self.grouping_names)
@@ -257,54 +260,54 @@ class FlatMapCoGroupsInPandasExec(PhysicalPlan):
     def num_partitions(self):
         return self.children[0].num_partitions()
 
-    def _side_groups(self, child: PhysicalPlan, names: List[str], pid: int,
-                     tctx: TaskContext):
-        """Groups keyed by VALUE tuple (sides may use different key
-        names); an empty side still carries the child's full schema so
-        the user function can touch any column (PySpark contract)."""
-        import pandas as pd
+    def _side_table(self, child: PhysicalPlan, pid: int,
+                    tctx: TaskContext):
+        """One Arrow table per side; an empty side still carries the
+        child's full schema so the user function can touch any column
+        (PySpark contract)."""
+        import pyarrow as pa
         stctx = TaskContext(pid, tctx.conf, parent=tctx)
         with stctx.as_current():
             batches = list(child.execute(pid, stctx))
         if batches:
             merged = (ColumnarBatch.concat(batches) if len(batches) > 1
                       else batches[0])
-            pdf = _to_pandas(merged)
-        else:
-            pdf = pd.DataFrame({a.name: pd.Series(dtype="object")
-                                for a in child.output})
-        groups = {}
-        if len(pdf):
-            for k, g in pdf.groupby(names, sort=False, dropna=False):
-                groups[k if isinstance(k, tuple) else (k,)] = g
-        return pdf.iloc[0:0], groups
+            return _to_arrow(merged)
+        return pa.schema([pa.field(a.name, T.to_arrow(a.dtype))
+                          for a in child.output]).empty_table()
 
     def execute(self, pid: int, tctx: TaskContext):
-        lempty, lgroups = self._side_groups(self.children[0],
-                                            self.left_names, pid, tctx)
-        rempty, rgroups = self._side_groups(self.children[1],
-                                            self.right_names, pid, tctx)
-        if not lgroups and not rgroups:
+        ltab = self._side_table(self.children[0], pid, tctx)
+        rtab = self._side_table(self.children[1], pid, tctx)
+        if not ltab.num_rows and not rtab.num_rows:
             return
-        keys = list(dict.fromkeys(list(lgroups) + list(rgroups)))
-        frames = []
-        for k in keys:
-            frames.append(lgroups.get(k, lempty))
-            frames.append(rgroups.get(k, rempty))
         func = self.func
+        lnames, rnames = self.left_names, self.right_names
 
         def job(fs):
+            # group + VALUE-tuple pairing inside the job (worker-side
+            # when isolated): two tables cross the pipe, not 2 x groups
+            lf, rf = fs
+            lgroups, rgroups = {}, {}
+            if len(lf):
+                for k, g in lf.groupby(lnames, sort=False, dropna=False):
+                    lgroups[k if isinstance(k, tuple) else (k,)] = g
+            if len(rf):
+                for k, g in rf.groupby(rnames, sort=False, dropna=False):
+                    rgroups[k if isinstance(k, tuple) else (k,)] = g
+            keys = list(dict.fromkeys(list(lgroups) + list(rgroups)))
             out_ = []
-            for i in range(0, len(fs), 2):
-                o = func(fs[i], fs[i + 1])
+            for k in keys:
+                o = func(lgroups.get(k, lf.iloc[0:0]),
+                         rgroups.get(k, rf.iloc[0:0]))
                 if o is not None and len(o):
                     out_.append(o)
             return out_
 
         with _semaphore_released(self.backend, tctx):
-            outs = _run_job(tctx, job, frames)
+            outs = _run_job(tctx, job, [ltab, rtab])
         for out in outs:
-            yield _from_pandas(out, self.out_schema, self.backend)
+            yield _from_arrow(out, self.out_schema, self.backend)
 
     def simple_string(self):
         keys = ", ".join(self.grouping_names)

@@ -34,6 +34,9 @@ import pytest  # noqa: E402
 QUICK_MODULES = {
     "test_columnar", "test_expressions", "test_sql", "test_joins",
     "test_memory", "test_native", "test_cross_slice", "test_hive_udf",
+    # both jax ShimProviders exercised end-to-end every CI run — the
+    # parallel-world guarantee (VERDICT r3 #8)
+    "test_shims",
 }
 
 
