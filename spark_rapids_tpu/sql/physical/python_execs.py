@@ -138,17 +138,21 @@ class FlatMapGroupsInPandasExec(PhysicalPlan):
         table = _to_arrow(merged)
         if not table.num_rows:
             return
+        del merged, batches  # free the device batch before user Python
+        # runs with the semaphore released (another task may need HBM)
         func = self.func
         grouping_names = self.grouping_names
 
         def job(frames):
             # grouping runs INSIDE the job (worker-side when isolated):
             # one table crosses the pipe instead of one per group, and
-            # both modes hand user code identical group frames
+            # both modes hand user code identical group frames — each
+            # with the fresh RangeIndex PySpark's applyInPandas gives
             f = frames[0]
             return [o for o in (
-                func(g) for _, g in f.groupby(grouping_names, sort=False,
-                                              dropna=False))
+                func(g.reset_index(drop=True))
+                for _, g in f.groupby(grouping_names, sort=False,
+                                      dropna=False))
                 if o is not None and len(o)]
 
         with _semaphore_released(self.backend, tctx):
@@ -196,6 +200,7 @@ class AggregateInPandasExec(PhysicalPlan):
         table = _to_arrow(merged)
         if not table.num_rows:
             return
+        del merged, batches  # free the device batch before user Python
         # argument column names per udf (children are resolved attributes)
         arg_names = []
         for _name, u in self.agg_udfs:
@@ -298,8 +303,10 @@ class FlatMapCoGroupsInPandasExec(PhysicalPlan):
             keys = list(dict.fromkeys(list(lgroups) + list(rgroups)))
             out_ = []
             for k in keys:
-                o = func(lgroups.get(k, lf.iloc[0:0]),
-                         rgroups.get(k, rf.iloc[0:0]))
+                o = func(lgroups.get(k, lf.iloc[0:0])
+                         .reset_index(drop=True),
+                         rgroups.get(k, rf.iloc[0:0])
+                         .reset_index(drop=True))
                 if o is not None and len(o):
                     out_.append(o)
             return out_

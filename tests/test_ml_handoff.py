@@ -141,3 +141,66 @@ def test_fit_linear_regression_recovers_weights(sess):
     assert abs(float(w[0]) - 3.0) < 0.05
     assert abs(float(w[1]) + 2.0) < 0.05
     assert abs(float(bias) - 0.5) < 0.05
+
+
+def test_gradient_boosting_multi_batch_device_resident(sess):
+    """BASELINE config 5 depth (VERDICT r3 #10): a GBT-shaped model
+    trains on MULTI-BATCH engine output with the training data resident
+    on device throughout, and actually fits a nonlinear target a linear
+    model cannot."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import ml
+    rng = np.random.default_rng(5)
+    n = 6000
+    x1, x2 = rng.random(n) * 4 - 2, rng.random(n) * 4 - 2
+    # nonlinear, axis-aligned target: ideal for trees, hopeless for OLS
+    y = np.where((x1 > 0) ^ (x2 > 0.5), 3.0, -1.0) + rng.normal(0, .05, n)
+    t = pa.table({"x1": x1, "x2": x2, "y": y})
+    df = sess.create_dataframe(t, num_partitions=4)  # multi-batch input
+    q = df.filter(df.x1 > -10)  # through the engine, stays on device
+    from spark_rapids_tpu.ml import columnar_rdd
+    assert len(columnar_rdd(q.select("x1", "x2", "y"))) > 1, \
+        "input must arrive as multiple device batches"
+    X, yv = ml.to_features(q, ["x1", "x2"], "y")
+    assert isinstance(X, jax.Array)  # device residency of training data
+    predict, model, mse = ml.fit_gradient_boosting(
+        q, ["x1", "x2"], "y", n_trees=25, max_depth=3)
+    var = float(jnp.var(yv))
+    assert mse < 0.15 * var, (mse, var)   # fits the XOR-ish structure
+    _w, _b, lin_mse = ml.fit_linear_regression(q, ["x1", "x2"], "y")
+    assert mse < 0.25 * lin_mse, (mse, lin_mse)  # beats linear soundly
+    # jitted inference on fresh device data
+    Xq = jnp.stack([jnp.asarray([1.0, -1.0]),
+                    jnp.asarray([-1.5, 1.0])], axis=1).T
+    preds = np.asarray(predict(jnp.asarray(Xq)))
+    assert preds.shape == (2,)
+
+
+def test_to_features_sharded_multichip(sess):
+    """Partitioned handoff: (X, y) come back row-sharded over the
+    virtual 8-device mesh, ready for pjit training with no resharding."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import ml
+    from spark_rapids_tpu.parallel.mesh import device_mesh
+    if len(jax.devices()) < 2:
+        import pytest as _p
+        _p.skip("needs the multi-device CPU mesh")
+    rng = np.random.default_rng(6)
+    n = 1001  # deliberately NOT divisible by the device count
+    t = pa.table({"a": rng.random(n), "b": rng.random(n),
+                  "y": rng.random(n)})
+    df = sess.create_dataframe(t, num_partitions=3)
+    X, y, live = ml.to_features_sharded(df, ["a", "b"], "y")
+    mesh = device_mesh()
+    n_dev = mesh.devices.size
+    assert live == n and X.shape[0] % n_dev == 0
+    assert len(X.sharding.device_set) == n_dev  # genuinely row-sharded
+    assert len(y.sharding.device_set) == n_dev
+    # a sharded reduction consumes it without host gather
+    mask = jnp.arange(X.shape[0]) < live
+    tot = float(jnp.sum(jnp.where(mask, y, 0.0)))
+    exp = float(np.sum(t["y"].to_numpy()))
+    # float32 feature dtype: tolerance scales with the magnitude
+    assert abs(tot - exp) < 1e-4 * max(abs(exp), 1.0)

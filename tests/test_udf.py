@@ -497,3 +497,19 @@ def test_udf_worker_reraises_original_exception_type(sess):
         df.mapInPandas(raiser, schema).collect()
     df.mapInPandas(lambda it: it, schema).collect()
     assert STATS["spawned"] == spawned0, "user error must not kill worker"
+
+
+def test_apply_in_pandas_group_gets_range_index(sess):
+    """PySpark contract: each applyInPandas group arrives with a fresh
+    RangeIndex (g.loc[0] works for every group) — review r4 finding."""
+    t = pa.table({"k": [1, 1, 2, 2, 2], "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    df = sess.create_dataframe(t)
+
+    def first_row(g):
+        return g.loc[[0]]  # KeyError unless the index was reset
+
+    out = (df.groupBy("k").applyInPandas(first_row, T.StructType((
+        T.StructField("k", T.LONG, True),
+        T.StructField("v", T.DOUBLE, True))))
+        .collect().to_pandas().sort_values("k"))
+    assert len(out) == 2
