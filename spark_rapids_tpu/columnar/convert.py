@@ -48,6 +48,16 @@ def _word_packable(dt: str) -> bool:
     return d.kind in ("i", "u") and d.itemsize in (1, 2, 4, 8)
 
 
+def u64_to_i64(u):
+    """Two's-complement uint64 -> int64 WITHOUT 64-bit bitcast-convert
+    (the TPU X64 rewrite doesn't implement it).  The one shared copy of
+    this trick — device_parquet and ranks.f64_bits_i64 both route here."""
+    big = u >= (jnp.uint64(1) << jnp.uint64(63))
+    low = (u & jnp.uint64((1 << 63) - 1)).astype(jnp.int64)
+    int64_min = jnp.int64(-(2 ** 62)) + jnp.int64(-(2 ** 62))
+    return jnp.where(big, low + int64_min, low)
+
+
 def _f64_bits(x):
     """IEEE-754 bit pattern of float64 as uint64, WITHOUT bitcast-convert
     (traced; exact).  The exponent is recovered by a 10-step power-of-two

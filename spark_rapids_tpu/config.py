@@ -369,6 +369,17 @@ JSON_ENABLED = register(
     "spark.rapids.sql.format.json.enabled", "Accelerate JSON.", False)
 AVRO_ENABLED = register(
     "spark.rapids.sql.format.avro.enabled", "Accelerate Avro.", False)
+PARQUET_DEVICE_DECODE = register(
+    "spark.rapids.sql.format.parquet.deviceDecode.enabled",
+    "Decode parquet pages on the device: the host parses only structure "
+    "(footer, page headers, RLE/bit-packed run boundaries) and XLA "
+    "programs do all per-value work — bit-unpacking, dictionary gather, "
+    "def-level null scatter, physical->logical finishing.  Columns "
+    "outside the envelope (nested, mixed-encoding, exotic codecs) fall "
+    "back to host decode individually.  Applies to PERFILE and "
+    "MULTITHREADED parquet scans; COALESCING reads stay on the host "
+    "decode (reference device decode: GpuParquetScan.scala:2649 "
+    "Table.readParquet).", True)
 PARQUET_PUSHDOWN_ENABLED = register(
     "spark.rapids.sql.format.parquet.filterPushdown.enabled",
     "Prune parquet row groups with footer column statistics against "

@@ -17,9 +17,9 @@ import numpy as np
 
 from .. import types as T
 from ..columnar.convert import arrow_to_device
-from ..config import (MULTITHREAD_READ_NUM_THREADS, PARQUET_PUSHDOWN_ENABLED,
-                      PARQUET_READER_TYPE, READER_CHUNKED,
-                      READER_CHUNKED_TARGET_ROWS, RapidsConf)
+from ..config import (MULTITHREAD_READ_NUM_THREADS, PARQUET_DEVICE_DECODE,
+                      PARQUET_PUSHDOWN_ENABLED, PARQUET_READER_TYPE,
+                      READER_CHUNKED, READER_CHUNKED_TARGET_ROWS, RapidsConf)
 from ..sql.physical.base import CPU, TPU, PhysicalPlan, TaskContext
 from . import registry
 from .filecache import resolve_read_path
@@ -60,10 +60,8 @@ class FileScanExec(PhysicalPlan):
             pf = pq.ParquetFile(path)
             keep = prune_row_groups(pf, self.pushed_filters)
             if keep is not None:
-                total = pf.metadata.num_row_groups
-                if tctx is not None:
-                    tctx.inc_metric("rowGroupsTotal", total)
-                    tctx.inc_metric("rowGroupsPruned", total - len(keep))
+                self._emit_prune_stats(
+                    (pf.metadata.num_row_groups, len(keep)), tctx)
                 if not keep:
                     return pf.schema_arrow.empty_table()
                 return pf.read_row_groups(keep)
@@ -97,34 +95,89 @@ class FileScanExec(PhysicalPlan):
         """Yield one pa.Table per run of row groups up to the chunk-row
         target (parquet PERFILE path only): peak memory is bounded by the
         chunk, not the file."""
-        import pyarrow.parquet as pq
-        from .pushdown import prune_row_groups
         path = resolve_read_path(path, self.conf)
-        pf = pq.ParquetFile(path)
-        keep = None
-        if self.pushed_filters and bool(
-                self.conf.get(PARQUET_PUSHDOWN_ENABLED)):
-            keep = prune_row_groups(pf, self.pushed_filters)
-        groups = list(range(pf.metadata.num_row_groups)) \
-            if keep is None else keep
-        if tctx is not None and keep is not None:
-            tctx.inc_metric("rowGroupsTotal", pf.metadata.num_row_groups)
-            tctx.inc_metric("rowGroupsPruned",
-                            pf.metadata.num_row_groups - len(keep))
-        if not groups:
+        pf, runs, prune_stats = self._parquet_runs(path)
+        self._emit_prune_stats(prune_stats, tctx)
+        if not runs:
             yield pf.schema_arrow.empty_table()
             return
+        for run in runs:
+            yield pf.read_row_groups(run)
+
+    def _parquet_runs(self, path: str):
+        """The ONE implementation of prune-then-split for parquet reads
+        (both the host chunked path and the device-decode path use it, so
+        the two can't drift): pushdown pruning, then row-group runs sized
+        by the chunked-read row target (a single run when chunked reads
+        are off).  Returns ``(pf, runs, prune_stats)`` with prune_stats
+        either None or ``(total_groups, kept_groups)`` — the caller that
+        commits to a path emits the metrics exactly once."""
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(path)
+        keep = None
+        stats = None
+        if self.pushed_filters and bool(
+                self.conf.get(PARQUET_PUSHDOWN_ENABLED)):
+            from .pushdown import prune_row_groups
+            keep = prune_row_groups(pf, self.pushed_filters)
+            if keep is not None:
+                stats = (pf.metadata.num_row_groups, len(keep))
+        groups = list(range(pf.metadata.num_row_groups)) \
+            if keep is None else keep
+        if not bool(self.conf.get(READER_CHUNKED)):
+            return pf, ([groups] if groups else []), stats
         target = int(self.conf.get(READER_CHUNKED_TARGET_ROWS))
+        runs: List[List[int]] = []
         run: List[int] = []
         rows = 0
         for rg in groups:
             run.append(rg)
             rows += pf.metadata.row_group(rg).num_rows
             if rows >= target:
-                yield pf.read_row_groups(run)
+                runs.append(run)
                 run, rows = [], 0
         if run:
-            yield pf.read_row_groups(run)
+            runs.append(run)
+        return pf, runs, stats
+
+    @staticmethod
+    def _emit_prune_stats(stats, tctx: Optional[TaskContext]) -> None:
+        if stats is not None and tctx is not None:
+            total, kept = stats
+            tctx.inc_metric("rowGroupsTotal", total)
+            tctx.inc_metric("rowGroupsPruned", total - kept)
+
+    def _execute_parquet_device(self, path: str, tctx: TaskContext,
+                                upload):
+        """Unified parquet partition executor when device decode is on:
+        ONE footer parse + prune (``_parquet_runs``), then per-run device
+        decode with per-run host fallback — the fallback reuses the open
+        ``pf`` and goes through ``upload`` so the ragged-string width-class
+        splitting applies exactly as on the host pipeline."""
+        import jax
+
+        from .device_parquet import decode_file
+
+        path = resolve_read_path(path, self.conf)
+        pf, runs, prune_stats = self._parquet_runs(path)
+        self._emit_prune_stats(prune_stats, tctx)
+        chunked = bool(self.conf.get(READER_CHUNKED))
+        if not runs:
+            yield from upload(pf.schema_arrow.empty_table())
+            return
+        declined = False   # a whole-file decline holds for every run
+        for run in runs:
+            if chunked:
+                tctx.inc_metric("chunkedReadBatches")
+            batch = None if declined else decode_file(
+                path, run, tctx, pf=pf, conf=self.conf)
+            if batch is None:
+                declined = True
+                yield from upload(pf.read_row_groups(run))
+            else:
+                if self.backend == CPU:
+                    batch = jax.device_get(batch)
+                yield batch
 
     def execute(self, pid: int, tctx: TaskContext):
         import jax
@@ -165,6 +218,15 @@ class FileScanExec(PhysicalPlan):
             tctx.input_block_length = _os.path.getsize(self.files[pid])
         except OSError:
             tctx.input_block_length = -1
+        # device decode covers PERFILE and MULTITHREADED parquet scans
+        # (COALESCING concatenates host tables first); with it, the heavy
+        # per-value work is on the device, so losing the host-decode
+        # prefetch overlap in the MULTITHREADED case is a win, not a loss
+        if self.node.fmt == "parquet" and bool(
+                self.conf.get(PARQUET_DEVICE_DECODE)):
+            yield from self._execute_parquet_device(self.files[pid], tctx,
+                                                    upload)
+            return
         if self.reader_type == "MULTITHREADED":
             # per-partition prefetch through a shared pool: submit this file
             # read on a worker thread so decode overlaps device compute

@@ -206,7 +206,7 @@ def fit_gradient_boosting(df, feature_cols: Sequence[str], label_col: str,
     qs = jnp.linspace(0.0, 1.0, n_bins + 2)[1:-1]
     thresholds = jnp.quantile(X, qs, axis=0).T          # [d, n_bins]
 
-    def level_scores(resid, leaf_ids, level):
+    def level_scores(Xa, thra, resid, leaf_ids, level):
         """Gain for every (feature, bin) candidate at one level."""
         def one_feature(xcol, thrs):
             def one_thr(t):
@@ -217,21 +217,24 @@ def fit_gradient_boosting(df, feature_cols: Sequence[str], label_col: str,
                                         num_segments=seg)
                 return jnp.sum(s * s / jnp.maximum(c, 1.0))
             return jax.vmap(one_thr)(thrs)
-        return jax.vmap(one_feature, in_axes=(1, 0))(X, thresholds)
+        return jax.vmap(one_feature, in_axes=(1, 0))(Xa, thra)
 
+    # X/thresholds are jit ARGUMENTS, not closure captures: capturing
+    # would bake the dataset into the executable as a constant (compile
+    # time and HBM scale with the data, doubling residency)
     @jax.jit
-    def build_tree(resid):
+    def build_tree(resid, Xa, thra):
         leaf_ids = jnp.zeros(n, dtype=jnp.int32)
         feats = jnp.zeros(max_depth, dtype=jnp.int32)
-        thrs = jnp.zeros(max_depth, dtype=X.dtype)
+        thrs = jnp.zeros(max_depth, dtype=Xa.dtype)
         for level in range(max_depth):      # static unroll: D is small
-            scores = level_scores(resid, leaf_ids, level)  # [d, n_bins]
+            scores = level_scores(Xa, thra, resid, leaf_ids, level)
             flat = jnp.argmax(scores)
             f, b = flat // n_bins, flat % n_bins
-            t = thresholds[f, b]
+            t = thra[f, b]
             feats = feats.at[level].set(f.astype(jnp.int32))
             thrs = thrs.at[level].set(t)
-            leaf_ids = leaf_ids * 2 + (X[:, f] > t).astype(jnp.int32)
+            leaf_ids = leaf_ids * 2 + (Xa[:, f] > t).astype(jnp.int32)
         s = jax.ops.segment_sum(resid, leaf_ids, num_segments=n_leaves)
         c = jax.ops.segment_sum(jnp.ones_like(resid), leaf_ids,
                                 num_segments=n_leaves)
@@ -242,7 +245,7 @@ def fit_gradient_boosting(df, feature_cols: Sequence[str], label_col: str,
     pred = jnp.full(n, base, dtype=X.dtype)
     all_f, all_t, all_v = [], [], []
     for _ in range(n_trees):
-        feats, thrs, values, delta = build_tree(y - pred)
+        feats, thrs, values, delta = build_tree(y - pred, X, thresholds)
         pred = pred + delta
         all_f.append(feats)
         all_t.append(thrs)

@@ -58,8 +58,9 @@ def murmur3_long(xp, values_i64, seed_u32):
     if xp.__name__ != "numpy":
         # real chip: VPU Pallas kernel (bit-identical; validated against
         # the C++ oracle and this jnp path in tests/test_native.py)
-        from .pallas_kernels import murmur3_long_pallas, on_tpu
-        if on_tpu() and values_i64.ndim == 1:
+        from .pallas_kernels import (murmur3_available, murmur3_long_pallas,
+                                     on_tpu)
+        if on_tpu() and values_i64.ndim == 1 and murmur3_available():
             return murmur3_long_pallas(values_i64, seed_u32)
     low = values_i64.astype(xp.uint32)
     high = (values_i64.astype(xp.uint64) >> np.uint64(32)).astype(xp.uint32)

@@ -170,6 +170,33 @@ def on_tpu() -> bool:
 _SEG_SUM_OK = {}
 
 
+_MURMUR3_OK = {}
+
+
+def murmur3_available() -> bool:
+    """One-time end-to-end probe of the murmur3 kernel on this backend
+    (compile + execute + check against the portable jnp path).  Round-4
+    lesson from the first live-tunnel window: the axon backend's Mosaic
+    rejected a kernel the CPU interpreter accepted — EVERY pallas_call
+    site needs a probe gate like seg_sum's, not just an on_tpu() check."""
+    import jax
+    key = jax.default_backend()
+    ok = _MURMUR3_OK.get(key)
+    if ok is None:
+        try:
+            import jax.numpy as jnp
+            vals = jnp.asarray([0, 1, -1, 2**62, -(2**62)], jnp.int64)
+            got = np.asarray(murmur3_long_pallas(vals, np.uint32(42)))
+            from .hashing import murmur3_long as _jnp_murmur3
+            want = np.asarray(_jnp_murmur3(np, np.asarray(vals),
+                                           np.uint32(42)))
+            ok = bool(np.array_equal(got, want))
+        except Exception:
+            ok = False
+        _MURMUR3_OK[key] = ok
+    return ok
+
+
 def seg_sum_available() -> bool:
     """One-time end-to-end probe of the segmented-sum kernel on this
     backend (compile + execute + check a known answer).  A Mosaic

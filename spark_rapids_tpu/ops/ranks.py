@@ -122,11 +122,27 @@ def dense_rank_pairs(xp, a, b):
     return _ranks_from_lex(xp, perm, sorted_keys)
 
 
+def f64_bits_i64(x):
+    """float64 -> its IEEE-754 bit pattern as int64 on device, WITHOUT
+    64-bit bitcast-convert — the TPU X64 rewrite doesn't implement it
+    (first live-chip run failed here; CPU accepts the bitcast, so this
+    branches on backend).  The arithmetic path flushes denormals to
+    signed zero, matching the engine's f64 DAZ semantics on TPU."""
+    import jax
+    import jax.numpy as jnp
+    if jax.default_backend() == "cpu":
+        return jax.lax.bitcast_convert_type(x, jnp.int64)
+    from ..columnar.convert import _f64_bits, u64_to_i64
+    return u64_to_i64(_f64_bits(x))
+
+
 def _float_orderable_bits(xp, x, bits_dtype, canonical_nan):
     """Map floats to integers whose order matches Spark float ordering
     (-inf < ... < -0=0 < ... < inf < NaN), with NaN canonicalized."""
     if xp.__name__ == "numpy":
         b = x.view(bits_dtype)
+    elif bits_dtype == xp.int64:
+        b = f64_bits_i64(x)
     else:
         import jax
         b = jax.lax.bitcast_convert_type(x, bits_dtype)

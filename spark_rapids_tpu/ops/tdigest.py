@@ -108,8 +108,8 @@ def _value_keys(xp, v64):
     if xp.__name__ == "numpy":
         bits = v64.view(np.int64)
     else:
-        import jax.lax as lax
-        bits = lax.bitcast_convert_type(v64, xp.int64)
+        from .ranks import f64_bits_i64
+        bits = f64_bits_i64(v64)
     key = xp.where(bits < 0, xp.asarray(-(2**63), dtype=xp.int64) - bits - 1,
                    bits)
     return [key]

@@ -1,0 +1,287 @@
+"""Device-side parquet decode (io_/device_parquet.py) vs the pyarrow
+oracle: every supported (dtype x encoding x codec x page-version x nulls)
+combination must produce a batch identical to uploading pyarrow's own
+decode, and unsupported shapes must fall back per column, not per file."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.columnar.convert import arrow_to_device, device_to_arrow
+from spark_rapids_tpu.io_.device_parquet import decode_file
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _with_nulls(arr, frac, rng):
+    if frac <= 0:
+        return arr
+    mask = rng.random(len(arr)) < frac
+    return pa.array([None if m else v for m, v in
+                     zip(mask, arr.to_pylist())], type=arr.type)
+
+
+def _mixed_table(n=5000, null_frac=0.15, seed=7):
+    rng = _rng(seed)
+    cols = {
+        "i32": pa.array(rng.integers(-2**31, 2**31 - 1, n), pa.int32()),
+        "i64": pa.array(rng.integers(-2**62, 2**62, n), pa.int64()),
+        "i8": pa.array(rng.integers(-128, 127, n).astype(np.int8)),
+        "i16": pa.array(rng.integers(-2**15, 2**15 - 1, n).astype(np.int16)),
+        "f32": pa.array(rng.standard_normal(n).astype(np.float32)),
+        "f64": pa.array(rng.standard_normal(n) * 1e12),
+        "b": pa.array(rng.random(n) < 0.5),
+        "s": pa.array([f"row-{i % 97}" for i in range(n)]),
+        "d": pa.array(rng.integers(0, 20000, n).astype(np.int32),
+                      pa.date32()),
+        "ts": pa.array(rng.integers(0, 2**45, n), pa.timestamp("us")),
+    }
+    return pa.table({k: _with_nulls(v, null_frac, rng)
+                     for k, v in cols.items()})
+
+
+def _check_file(tmp_path, table, name="t.parquet", **write_kwargs):
+    path = str(tmp_path / name)
+    pq.write_table(table, path, **write_kwargs)
+    batch = decode_file(path)
+    assert batch is not None, "no column took the device path"
+    got = device_to_arrow(batch)
+    want = device_to_arrow(arrow_to_device(pq.read_table(path)))
+    assert got.schema.names == want.schema.names
+    for c in want.schema.names:
+        assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+    return batch
+
+
+@pytest.mark.quick
+def test_plain_roundtrip(tmp_path):
+    _check_file(tmp_path, _mixed_table(), use_dictionary=False)
+
+
+@pytest.mark.quick
+def test_dictionary_roundtrip(tmp_path):
+    _check_file(tmp_path, _mixed_table(), use_dictionary=True)
+
+
+@pytest.mark.parametrize("codec", ["snappy", "zstd", "gzip", "none"])
+def test_codecs(tmp_path, codec):
+    _check_file(tmp_path, _mixed_table(n=2000), compression=codec)
+
+
+@pytest.mark.parametrize("version", ["1.0", "2.4", "2.6"])
+def test_format_versions(tmp_path, version):
+    _check_file(tmp_path, _mixed_table(n=2000), version=version)
+
+
+def test_data_page_v2(tmp_path):
+    _check_file(tmp_path, _mixed_table(n=3000),
+                data_page_version="2.0")
+
+
+def test_data_page_v2_uncompressed(tmp_path):
+    _check_file(tmp_path, _mixed_table(n=1000),
+                data_page_version="2.0", compression="none")
+
+
+def test_multiple_row_groups(tmp_path):
+    _check_file(tmp_path, _mixed_table(n=10_000), row_group_size=1024)
+
+
+def test_multiple_pages_per_chunk(tmp_path):
+    # tiny data pages force many pages (and hybrid runs) per column chunk
+    _check_file(tmp_path, _mixed_table(n=20_000),
+                data_page_size=1024, use_dictionary=False)
+
+
+def test_dictionary_many_row_groups(tmp_path):
+    # one writer => per-group dictionaries are prefixes of the same stream
+    _check_file(tmp_path, _mixed_table(n=8000), row_group_size=1000,
+                use_dictionary=True)
+
+
+def test_divergent_dictionaries_remap_on_device(tmp_path):
+    """Per-row-group dictionaries in first-occurrence order diverge for
+    random data; the union+remap path must keep every column on device."""
+    rng = _rng(23)
+    n = 12_000
+    t = pa.table({
+        "i": pa.array(rng.integers(0, 500, n), pa.int32()),
+        "s": pa.array([f"val-{v}" for v in
+                       rng.integers(0, 300, n)]),
+        "f": pa.array(rng.integers(0, 200, n).astype(np.float64)),
+    })
+    path = str(tmp_path / "dd.parquet")
+    pq.write_table(t, path, row_group_size=997, use_dictionary=True)
+
+    class Ctx:
+        metrics = {}
+
+        def inc_metric(self, k, v=1):
+            self.metrics[k] = self.metrics.get(k, 0) + v
+
+    ctx = Ctx()
+    batch = decode_file(path, tctx=ctx)
+    assert ctx.metrics.get("parquetDeviceDecodedColumns", 0) == 3
+    assert not ctx.metrics.get("parquetHostDecodedColumns", 0)
+    got = device_to_arrow(batch)
+    want = device_to_arrow(arrow_to_device(pq.read_table(path)))
+    for c in want.schema.names:
+        assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+
+
+def test_ragged_string_dictionary_declines_whole_file(tmp_path):
+    """One huge dictionary entry would blow the dense string matrix; the
+    file must decline the DEVICE path entirely (host split_for_upload is
+    table-level, so per-column fallback would rebuild the same matrix)."""
+    t = pa.table({
+        "i": pa.array(list(range(4000)), pa.int64()),
+        "s": pa.array((["x" * 9000] + ["short"] * 999) * 4),
+    })
+    path = str(tmp_path / "rag.parquet")
+    pq.write_table(t, path)
+
+    class Conf:
+        def get(self, key):
+            return 1 << 20          # 1MB ragged threshold
+
+    assert decode_file(path, conf=Conf()) is None
+
+
+def test_no_nulls_required_columns(tmp_path):
+    t = _mixed_table(n=1500, null_frac=0.0)
+    # declare non-nullable so max_def == 0 (no def levels at all)
+    fields = [pa.field(f.name, f.type, nullable=False) for f in t.schema]
+    t = t.cast(pa.schema(fields))
+    _check_file(tmp_path, t)
+
+
+def test_all_null_column(tmp_path):
+    t = pa.table({
+        "x": pa.array([None] * 500, pa.int64()),
+        "y": pa.array(list(range(500)), pa.int32()),
+    })
+    _check_file(tmp_path, t)
+
+
+def test_empty_file(tmp_path):
+    t = pa.table({"x": pa.array([], pa.int64())})
+    path = str(tmp_path / "e.parquet")
+    pq.write_table(t, path)
+    # zero row groups -> engine host path; decode_file declines cleanly
+    assert decode_file(path) is None or \
+        device_to_arrow(decode_file(path)).num_rows == 0
+
+
+def test_row_group_subset(tmp_path):
+    t = _mixed_table(n=6000)
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(t, path, row_group_size=1000)
+    batch = decode_file(path, row_groups=[1, 3, 5])
+    got = device_to_arrow(batch)
+    want = device_to_arrow(arrow_to_device(
+        pq.ParquetFile(path).read_row_groups([1, 3, 5])))
+    for c in want.schema.names:
+        assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+
+
+def test_decimal_columns(tmp_path):
+    import decimal
+    rng = _rng(3)
+    vals = [decimal.Decimal(int(v)).scaleb(-2)
+            for v in rng.integers(-10**9, 10**9, 800)]
+    t = pa.table({
+        "d9": pa.array(vals, pa.decimal128(9, 2)),
+        "d18": pa.array(vals, pa.decimal128(18, 2)),
+    })
+    path = str(tmp_path / "d.parquet")
+    # INT32/INT64-backed decimals are in the device envelope; the default
+    # FIXED_LEN_BYTE_ARRAY storage falls back to host (also covered below)
+    pq.write_table(t, path, store_decimal_as_integer=True)
+    batch = decode_file(path)
+    got = device_to_arrow(batch)
+    want = device_to_arrow(arrow_to_device(pq.read_table(path)))
+    for c in want.schema.names:
+        assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+    # default FLBA-backed decimals: whole file declines the device path
+    path2 = str(tmp_path / "d2.parquet")
+    pq.write_table(t, path2)
+    assert decode_file(path2) is None
+
+
+def test_nested_column_falls_back_per_column(tmp_path):
+    t = pa.table({
+        "flat": pa.array(list(range(400)), pa.int64()),
+        "lst": pa.array([[i, i + 1] for i in range(400)],
+                        pa.list_(pa.int32())),
+    })
+    path = str(tmp_path / "n.parquet")
+    pq.write_table(t, path)
+
+    class Ctx:
+        metrics = {}
+
+        def inc_metric(self, k, v=1):
+            self.metrics[k] = self.metrics.get(k, 0) + v
+
+    ctx = Ctx()
+    batch = decode_file(path, tctx=ctx)
+    assert batch is not None
+    assert ctx.metrics.get("parquetDeviceDecodedColumns", 0) >= 1
+    assert ctx.metrics.get("parquetHostDecodedColumns", 0) >= 1
+    got = device_to_arrow(batch)
+    want = device_to_arrow(arrow_to_device(pq.read_table(path)))
+    for c in want.schema.names:
+        assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+
+
+def test_timestamp_millis(tmp_path):
+    rng = _rng(11)
+    t = pa.table({"ts": pa.array(rng.integers(0, 2**40, 700),
+                                 pa.timestamp("ms"))})
+    _check_file(tmp_path, t)
+
+
+def test_float_specials(tmp_path):
+    vals = [0.0, -0.0, np.inf, -np.inf, np.nan, 1e-300, -1e300,
+            np.finfo(np.float64).max, np.finfo(np.float64).min] * 50
+    t = pa.table({"f": pa.array(vals, pa.float64()),
+                  "g": pa.array([np.float32(v) for v in vals],
+                                pa.float32())})
+    path = str(tmp_path / "f.parquet")
+    pq.write_table(t, path, use_dictionary=False)
+    batch = decode_file(path)
+    got = device_to_arrow(batch)
+    want = device_to_arrow(arrow_to_device(pq.read_table(path)))
+    for c in ("f", "g"):
+        g = got.column(c).to_pylist()
+        w = want.column(c).to_pylist()
+        for a, b in zip(g, w):
+            if b is None or (b != b):          # null or NaN
+                assert a is None or a != a
+            else:
+                assert a == b, (c, a, b)
+
+
+@pytest.mark.quick
+def test_scan_exec_uses_device_decode(tmp_path):
+    """End-to-end: session.read.parquet equality with the flag on vs off,
+    and the device-decode metric fires."""
+    import spark_rapids_tpu as srt
+
+    t = _mixed_table(n=3000)
+    path = str(tmp_path / "scan.parquet")
+    pq.write_table(t, path, row_group_size=512)
+    sess = srt.session()
+    on = sess.read.parquet(path).orderBy("i32").collect().to_pandas()
+    sess.conf.set(
+        "spark.rapids.sql.format.parquet.deviceDecode.enabled", "false")
+    try:
+        off = sess.read.parquet(path).orderBy("i32").collect().to_pandas()
+    finally:
+        sess.conf.set(
+            "spark.rapids.sql.format.parquet.deviceDecode.enabled", "true")
+    import pandas as pd
+    pd.testing.assert_frame_equal(on, off)
