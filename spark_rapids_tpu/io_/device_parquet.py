@@ -72,14 +72,8 @@ class _ThriftReader:
         return b
 
     def varint(self) -> int:
-        out = 0
-        shift = 0
-        while True:
-            b = self._byte()
-            out |= (b & 0x7F) << shift
-            if not b & 0x80:
-                return out
-            shift += 7
+        out, self.pos = _read_uleb(self.buf, self.pos)
+        return out
 
     def zigzag(self) -> int:
         v = self.varint()
@@ -318,13 +312,17 @@ def _read_uleb(buf: bytes, pos: int) -> Tuple[int, int]:
 
 def _walk_hybrid(buf: bytes, start: int, end: int, bit_width: int,
                  num_values: int, out_base: int, base_bit: int,
-                 runs: _Runs) -> None:
+                 runs: _Runs, count_eq: Optional[int] = None) -> int:
     """Walk RLE/bit-packed hybrid run headers in ``buf[start:end)`` covering
     ``num_values`` logical values, appending descriptors.  ``base_bit`` is
     the absolute bit position of ``buf[start]`` in the device buffer (chunk
-    bytes upload verbatim, so source positions line up 1:1)."""
+    bytes upload verbatim, so source positions line up 1:1).  When
+    ``count_eq`` is given, also counts values == count_eq in the SAME walk
+    (vectorized popcount for packed groups) — the def-level non-null count
+    the dense-stream offsets need, without a second pass."""
     pos = start
     produced = 0
+    hits = 0
     vbytes = (bit_width + 7) // 8
     n0 = len(runs)
     while produced < num_values and pos < end:
@@ -336,6 +334,17 @@ def _walk_hybrid(buf: bytes, start: int, end: int, bit_width: int,
             count = min(groups * 8, num_values - produced)
             runs.add_packed(out_base + produced,
                             base_bit + (pos - start) * 8, bit_width)
+            if count_eq is not None:
+                nbytes = groups * bit_width
+                chunk = np.frombuffer(buf, np.uint8, nbytes, pos)
+                bits = np.unpackbits(chunk, bitorder="little")
+                if bit_width == 1:
+                    hits += int(np.count_nonzero(bits[:count] == count_eq))
+                else:
+                    vals = bits[:count * bit_width].reshape(count, bit_width)
+                    weights = (1 << np.arange(bit_width)).astype(np.int64)
+                    hits += int(np.count_nonzero(
+                        vals @ weights == count_eq))
             pos += groups * bit_width        # groups * 8 values * w bits / 8
             produced += count
         else:                                # RLE run
@@ -344,43 +353,11 @@ def _walk_hybrid(buf: bytes, start: int, end: int, bit_width: int,
                 if vbytes else 0
             pos += vbytes
             runs.add_rle(out_base + produced, val)
+            if count_eq is not None and val == count_eq:
+                hits += count
             produced += count
     if produced < num_values:
         raise _Unsupported("short hybrid stream")
-
-
-def _count_def_hits(buf: bytes, start: int, end: int, bit_width: int,
-                    num_values: int, max_def: int) -> int:
-    """Count def-level == max_def in a v1 hybrid stream (host; vectorized
-    popcount for the packed groups).  Flat columns have bit_width == 1."""
-    pos = start
-    produced = 0
-    hits = 0
-    vbytes = (bit_width + 7) // 8
-    while produced < num_values and pos < end:
-        header, pos = _read_uleb(buf, pos)
-        if header & 1:
-            groups = header >> 1
-            count = min(groups * 8, num_values - produced)
-            nbytes = groups * bit_width
-            chunk = np.frombuffer(buf, np.uint8, nbytes, pos)
-            bits = np.unpackbits(chunk, bitorder="little")
-            if bit_width == 1:
-                hits += int(np.count_nonzero(bits[:count] == max_def))
-            else:
-                vals = bits[:count * bit_width].reshape(count, bit_width)
-                weights = (1 << np.arange(bit_width)).astype(np.int64)
-                hits += int(np.count_nonzero(vals @ weights == max_def))
-            pos += nbytes
-            produced += count
-        else:
-            count = min(header >> 1, num_values - produced)
-            val = int.from_bytes(buf[pos:pos + vbytes], "little") \
-                if vbytes else 0
-            pos += vbytes
-            if val == max_def:
-                hits += count
-            produced += count
     return hits
 
 
@@ -575,11 +552,9 @@ def _plan_chunk(raw: bytes, cc, phys: str, nullable: bool) -> _ChunkPlan:
                 if h.def_encoding != _ENC_RLE:
                     raise _Unsupported("non-RLE def levels")
                 (dlen,) = struct.unpack_from("<i", data, 0)
-                _walk_hybrid(data, 4, 4 + dlen, 1, h.num_values,
-                             plan.total_values, piece_bits + 32,
-                             plan.def_runs)
-                nonnull = _count_def_hits(data, 4, 4 + dlen, 1,
-                                          h.num_values, max_def)
+                nonnull = _walk_hybrid(data, 4, 4 + dlen, 1, h.num_values,
+                                       plan.total_values, piece_bits + 32,
+                                       plan.def_runs, count_eq=max_def)
                 vstart = 4 + dlen
             enc = h.encoding
         elif h.type == 3:                     # data page v2

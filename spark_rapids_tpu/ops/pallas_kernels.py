@@ -167,53 +167,46 @@ def on_tpu() -> bool:
         return False
 
 
-_SEG_SUM_OK = {}
+#: (kernel name, backend) -> bool.  EVERY pallas_call site needs a probe
+#: gate, not just an on_tpu() check: a Mosaic lowering gap raises at
+#: COMPILE time — outside any try/except around the traced call site —
+#: and the real backend rejects kernels the CPU interpreter accepts
+#: (round-4 lesson from the first live-tunnel window: murmur3's i64
+#: scalar compiled on CPU, failed on axon).
+_PROBE_OK: dict = {}
 
 
-_MURMUR3_OK = {}
+def _probe(name: str, check) -> bool:
+    """One-time end-to-end probe per (kernel, backend): compile + execute
+    + verify a known answer.  ``check()`` returns truthiness; any raise
+    counts as unavailable."""
+    import jax
+    key = (name, jax.default_backend())
+    ok = _PROBE_OK.get(key)
+    if ok is None:
+        try:
+            ok = bool(check())
+        except Exception:
+            ok = False
+        _PROBE_OK[key] = ok
+    return ok
 
 
 def murmur3_available() -> bool:
-    """One-time end-to-end probe of the murmur3 kernel on this backend
-    (compile + execute + check against the portable jnp path).  Round-4
-    lesson from the first live-tunnel window: the axon backend's Mosaic
-    rejected a kernel the CPU interpreter accepted — EVERY pallas_call
-    site needs a probe gate like seg_sum's, not just an on_tpu() check."""
-    import jax
-    key = jax.default_backend()
-    ok = _MURMUR3_OK.get(key)
-    if ok is None:
-        try:
-            import jax.numpy as jnp
-            vals = jnp.asarray([0, 1, -1, 2**62, -(2**62)], jnp.int64)
-            got = np.asarray(murmur3_long_pallas(vals, np.uint32(42)))
-            from .hashing import murmur3_long as _jnp_murmur3
-            want = np.asarray(_jnp_murmur3(np, np.asarray(vals),
-                                           np.uint32(42)))
-            ok = bool(np.array_equal(got, want))
-        except Exception:
-            ok = False
-        _MURMUR3_OK[key] = ok
-    return ok
+    def check():
+        import jax.numpy as jnp
+        vals = jnp.asarray([0, 1, -1, 2**62, -(2**62)], jnp.int64)
+        got = np.asarray(murmur3_long_pallas(vals, np.uint32(42)))
+        from .hashing import murmur3_long as _jnp_murmur3
+        want = np.asarray(_jnp_murmur3(np, np.asarray(vals), np.uint32(42)))
+        return np.array_equal(got, want)
+    return _probe("murmur3", check)
 
 
 def seg_sum_available() -> bool:
-    """One-time end-to-end probe of the segmented-sum kernel on this
-    backend (compile + execute + check a known answer).  A Mosaic
-    lowering gap raises at COMPILE time — outside any try/except around
-    the traced call site — so callers must gate on this probe rather
-    than catching at dispatch."""
-    import jax
-    key = jax.default_backend()
-    ok = _SEG_SUM_OK.get(key)
-    if ok is None:
-        try:
-            import jax.numpy as jnp
-            out = np.asarray(seg_sum_f32_pallas(
-                jnp.ones((1, 300), jnp.float32),
-                jnp.zeros(300, jnp.int32), 8))
-            ok = abs(float(out[0, 0]) - 300.0) < 1e-3
-        except Exception:
-            ok = False
-        _SEG_SUM_OK[key] = ok
-    return ok
+    def check():
+        import jax.numpy as jnp
+        out = np.asarray(seg_sum_f32_pallas(
+            jnp.ones((1, 300), jnp.float32), jnp.zeros(300, jnp.int32), 8))
+        return abs(float(out[0, 0]) - 300.0) < 1e-3
+    return _probe("seg_sum", check)

@@ -35,6 +35,28 @@ def choose(xp, mask, a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
         children)
 
 
+def _first_concrete_type(exprs):
+    """The result type of a multi-branch conditional: the first branch
+    whose type is not the NULL literal's NullType (Spark's common-type
+    resolution restricted to the engine's homogeneous-branch rule).
+    CASE WHEN p THEN NULL ELSE x END must type as x, not as NULL —
+    found by the SQL grammar fuzzer: nullif() always returned NULL."""
+    from ... import types as T
+    for e in exprs:
+        if not isinstance(e.data_type, T.NullType):
+            return e.data_type
+    return exprs[0].data_type
+
+
+def _concretize(ctx, col: DeviceColumn, dtype) -> DeviceColumn:
+    """Rebuild a NULL-literal branch column as an all-null column of the
+    conditional's result type so ``choose`` blends matching layouts."""
+    from ... import types as T
+    if isinstance(col.dtype, T.NullType) and not isinstance(dtype, T.NullType):
+        return _null_like(ctx, dtype, col)
+    return col
+
+
 class If(Expression):
     def __init__(self, pred: Expression, t: Expression, f: Expression):
         self.children = (pred, t, f)
@@ -44,11 +66,13 @@ class If(Expression):
 
     @property
     def data_type(self):
-        return self.children[1].data_type
+        return _first_concrete_type(self.children[1:])
 
     def kernel(self, ctx, p, t, f):
         take_true = p.validity & p.data  # null predicate -> else branch
-        return choose(ctx.xp, take_true, t, f)
+        dt = self.data_type
+        return choose(ctx.xp, take_true, _concretize(ctx, t, dt),
+                      _concretize(ctx, f, dt))
 
     def sql(self):
         p, t, f = self.children
@@ -78,22 +102,26 @@ class CaseWhen(Expression):
 
     @property
     def data_type(self):
-        return self.children[1].data_type
+        vals = [self.children[2 * i + 1] for i in range(self._n_branches)]
+        if self._has_else:
+            vals.append(self.children[2 * self._n_branches])
+        return _first_concrete_type(vals)
 
     def _key_extras(self):
         return (self._n_branches, self._has_else)
 
     def kernel(self, ctx, *cols):
-        from ...columnar.column import null_column
         xp = ctx.xp
         n = self._n_branches
+        dt = self.data_type
         if self._has_else:
-            acc = cols[2 * n]
+            acc = _concretize(ctx, cols[2 * n], dt)
         else:
-            acc = _null_like(ctx, self.data_type, cols[1])
+            acc = _null_like(ctx, dt, cols[1])
         for i in reversed(range(n)):
             p, v = cols[2 * i], cols[2 * i + 1]
-            acc = choose(xp, p.validity & p.data, v, acc)
+            acc = choose(xp, p.validity & p.data, _concretize(ctx, v, dt),
+                         acc)
         return acc
 
 
@@ -121,7 +149,7 @@ class Coalesce(Expression):
 
     @property
     def data_type(self):
-        return self.children[0].data_type
+        return _first_concrete_type(self.children)
 
     @property
     def nullable(self):
@@ -129,8 +157,10 @@ class Coalesce(Expression):
 
     def kernel(self, ctx, *cols):
         xp = ctx.xp
-        acc = cols[-1]
+        dt = self.data_type
+        acc = _concretize(ctx, cols[-1], dt)
         for c in reversed(cols[:-1]):
+            c = _concretize(ctx, c, dt)
             acc = choose(xp, c.validity, c, acc)
         return acc
 

@@ -276,6 +276,8 @@ def test_scan_exec_uses_device_decode(tmp_path):
     pq.write_table(t, path, row_group_size=512)
     sess = srt.session()
     on = sess.read.parquet(path).orderBy("i32").collect().to_pandas()
+    m = sess.last_query_metrics
+    assert m.get("parquetDeviceDecodedColumns", 0) > 0, m
     sess.conf.set(
         "spark.rapids.sql.format.parquet.deviceDecode.enabled", "false")
     try:
