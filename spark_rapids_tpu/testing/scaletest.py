@@ -18,6 +18,7 @@ import pandas as pd
 import pyarrow as pa
 
 from .datagen import (DoubleGen, IntegerGen, LongGen, StringGen, gen_table)
+from . import tpcds_queries as _TDS
 from . import tpch_queries as _TQ
 
 
@@ -407,70 +408,11 @@ def _tpch_q17_sql(sess, t, F):
     assert abs(got - exp) <= 1e-9 * max(abs(exp), 1.0), (got, exp)
 
 
-def build_tpcds_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
-    """store_sales star schema subset for the hash-join-heavy TPC-DS
-    milestone queries (BASELINE config 3: q3/q7/q19/q42 shapes)."""
-    rng = np.random.default_rng(seed)
-    n_items = max(rows // 50, 20)
-    n_dates = 365 * 5
-    store_sales = pa.table({
-        "ss_sold_date_sk": pa.array(rng.integers(0, n_dates, rows),
-                                    type=pa.int64()),
-        "ss_item_sk": pa.array(rng.integers(0, n_items, rows),
-                               type=pa.int64()),
-        "ss_ext_sales_price": pa.array(
-            np.round(rng.random(rows) * 1000, 2)),
-    })
-    date_dim = pa.table({
-        "d_date_sk": pa.array(np.arange(n_dates), type=pa.int64()),
-        "d_year": pa.array(1998 + (np.arange(n_dates) // 365),
-                           type=pa.int32()),
-        "d_moy": pa.array(1 + (np.arange(n_dates) % 365) // 31 % 12,
-                          type=pa.int32()),
-    })
-    item = pa.table({
-        "i_item_sk": pa.array(np.arange(n_items), type=pa.int64()),
-        "i_manufact_id": pa.array(rng.integers(0, 100, n_items),
-                                  type=pa.int32()),
-        "i_brand_id": pa.array(rng.integers(0, 40, n_items),
-                               type=pa.int32()),
-        "i_category_id": pa.array(rng.integers(0, 10, n_items),
-                                  type=pa.int32()),
-        "i_manager_id": pa.array(rng.integers(0, 100, n_items),
-                                 type=pa.int32()),
-    })
-    n_cd = 200
-    customer_demographics = pa.table({
-        "cd_demo_sk": pa.array(np.arange(n_cd), type=pa.int64()),
-        "cd_gender": pa.array(rng.choice(["M", "F"], n_cd)),
-        "cd_marital_status": pa.array(rng.choice(["S", "M", "D", "W"],
-                                                 n_cd)),
-        "cd_education_status": pa.array(rng.choice(
-            ["College", "Primary", "Secondary", "Advanced Degree"], n_cd)),
-    })
-    n_promo = 50
-    promotion = pa.table({
-        "p_promo_sk": pa.array(np.arange(n_promo), type=pa.int64()),
-        "p_channel_email": pa.array(rng.choice(["Y", "N"], n_promo)),
-        "p_channel_event": pa.array(rng.choice(["Y", "N"], n_promo)),
-    })
-    # fact foreign keys into the new dims
-    store_sales = store_sales.append_column(
-        "ss_cdemo_sk", pa.array(rng.integers(0, n_cd, rows),
-                                type=pa.int64()))
-    store_sales = store_sales.append_column(
-        "ss_promo_sk", pa.array(rng.integers(0, n_promo, rows),
-                                type=pa.int64()))
-    store_sales = store_sales.append_column(
-        "ss_quantity", pa.array(rng.integers(1, 100, rows),
-                                type=pa.int32()))
-    store_sales = store_sales.append_column(
-        "ss_list_price", pa.array(np.round(rng.random(rows) * 200, 2)))
-    store_sales = store_sales.append_column(
-        "ss_coupon_amt", pa.array(np.round(rng.random(rows) * 50, 2)))
-    return {"store_sales": store_sales, "date_dim": date_dim,
-            "item": item, "customer_demographics": customer_demographics,
-            "promotion": promotion}
+def build_tpcds_tables(rows: int, seed: int = 31):
+    """Delegates to the full star schema (``tpcds_queries.build_tables``
+    owns it now — a column-superset of the round-3 5-table subset, so
+    existing callers keep working)."""
+    return _TDS.build_tables(rows, seed)
 
 
 def _tpcds_q3(sess, t, F):
@@ -672,11 +614,14 @@ QUERIES: List[Tuple[str, Callable]] = [
     ("tpcds_q19_brand_rev", _tpcds_q19),
     ("tpcds_q42_cat_rev", _tpcds_q42),
     ("tpcds_q89_window_join", _tpcds_q89_window),
+    # round 4: 12 more TPC-DS spec-SQL shapes (tpcds_queries.py)
+    *[(f"tpcds_{name}", _TDS.make_runner(sql, oracle))
+      for name, sql, oracle in _TDS.QUERY_SET],
 ]
 
 #: table-set builders per query prefix (run_suite routes each query to
 #: the tables it expects)
-_TABLE_SETS = {"tpch": build_tpch_tables, "tpcds": build_tpcds_tables}
+_TABLE_SETS = {"tpch": build_tpch_tables, "tpcds": _TDS.build_tables}
 
 
 def run_suite(rows: int = 50_000, queries=None, tables=None,

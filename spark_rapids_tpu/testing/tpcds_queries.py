@@ -1,0 +1,647 @@
+"""TPC-DS breadth for the scale rig (VERDICT r3 missing #3 follow-up).
+
+The reference's milestone ladder ends at full TPC-DS (BASELINE configs
+3-4) and its scale suite spans join/agg/window shapes
+(``integration_tests/.../scaletest/QuerySpecs.scala``).  Round 3 carried
+5 TPC-DS shapes; this module adds 11 more in their REAL spec SQL form —
+comma FROM star joins, derived tables, window-over-aggregate via
+subquery, multi-alias dimension reuse, cross-joined scalar-subquery
+blocks (q88), HAVING-range ticket analyses (q34/q73) — each checked
+against an independent pandas oracle.
+
+``build_tables`` is a superset of round 3's ``build_tpcds_tables``: the
+original columns keep their names so the existing q3/q7/q19/q42/q89
+runners work unchanged; new dimensions (store, household_demographics,
+time_dim, customer, customer_address) and fact columns extend the star.
+Filter constants are the spec's where possible, tuned only so scaled-down
+data keeps results non-empty (plan-shape coverage is the point).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+# ---------------------------------------------------------------------------
+# schema
+# ---------------------------------------------------------------------------
+
+_BUY_POTENTIAL = ["0-500", "501-1000", "1001-5000", "5001-10000", ">10000"]
+_CITIES = ["Fairview", "Midway", "Oakdale", "Springdale", "Riverside",
+           "Centerville", "Glendale", "Marion"]
+_COUNTIES = ["C1", "C2", "C3", "C4"]
+_STORE_NAMES = ["ese", "ought", "able", "pri", "bar"]
+_FIRST = ["Ann", "Bob", "Cara", "Dev", "Eli", "Fay", "Gus", "Hana"]
+_LAST = ["Ames", "Brown", "Cole", "Diaz", "Egan", "Ford", "Gray", "Hale"]
+
+
+def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
+    rng = np.random.default_rng(seed)
+    n_items = max(rows // 50, 20)
+    n_dates = 365 * 5
+    n_cd = 200
+    n_promo = 50
+    n_stores = 12
+    n_hd = 144
+    n_times = 24 * 12            # 5-minute buckets
+    n_cust = max(rows // 20, 50)
+    n_addr = max(n_cust // 2, 25)
+
+    day = np.arange(n_dates)
+    date_dim = pa.table({
+        "d_date_sk": pa.array(day, type=pa.int64()),
+        "d_year": pa.array(1998 + (day // 365), type=pa.int32()),
+        "d_moy": pa.array(1 + (day % 365) // 31 % 12, type=pa.int32()),
+        "d_dom": pa.array(1 + day % 28, type=pa.int32()),
+        "d_dow": pa.array(day % 7, type=pa.int32()),
+        "d_qoy": pa.array(1 + ((day % 365) // 92) % 4, type=pa.int32()),
+    })
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(n_items), type=pa.int64()),
+        "i_manufact_id": pa.array(rng.integers(0, 100, n_items),
+                                  type=pa.int32()),
+        "i_brand_id": pa.array(rng.integers(0, 40, n_items),
+                               type=pa.int32()),
+        "i_category_id": pa.array(rng.integers(0, 10, n_items),
+                                  type=pa.int32()),
+        "i_manager_id": pa.array(rng.integers(0, 100, n_items),
+                                 type=pa.int32()),
+        "i_brand": pa.array([f"brand#{b}" for b in
+                             rng.integers(0, 40, n_items)]),
+        "i_item_id": pa.array([f"ITEM{k:08d}" for k in range(n_items)]),
+        "i_class_id": pa.array(rng.integers(0, 16, n_items),
+                               type=pa.int32()),
+        "i_current_price": pa.array(np.round(rng.random(n_items) * 99, 2)),
+    })
+    customer_demographics = pa.table({
+        "cd_demo_sk": pa.array(np.arange(n_cd), type=pa.int64()),
+        "cd_gender": pa.array(rng.choice(["M", "F"], n_cd)),
+        "cd_marital_status": pa.array(rng.choice(["S", "M", "D", "W"],
+                                                 n_cd)),
+        "cd_education_status": pa.array(rng.choice(
+            ["College", "Primary", "Secondary", "Advanced Degree"], n_cd)),
+    })
+    promotion = pa.table({
+        "p_promo_sk": pa.array(np.arange(n_promo), type=pa.int64()),
+        "p_channel_email": pa.array(rng.choice(["Y", "N"], n_promo)),
+        "p_channel_event": pa.array(rng.choice(["Y", "N"], n_promo)),
+    })
+    store = pa.table({
+        "s_store_sk": pa.array(np.arange(n_stores), type=pa.int64()),
+        "s_store_name": pa.array(rng.choice(_STORE_NAMES, n_stores)),
+        "s_city": pa.array(rng.choice(_CITIES, n_stores)),
+        "s_county": pa.array(rng.choice(_COUNTIES, n_stores)),
+        "s_number_employees": pa.array(rng.integers(150, 350, n_stores),
+                                       type=pa.int32()),
+    })
+    household_demographics = pa.table({
+        "hd_demo_sk": pa.array(np.arange(n_hd), type=pa.int64()),
+        "hd_dep_count": pa.array(rng.integers(0, 10, n_hd),
+                                 type=pa.int32()),
+        "hd_vehicle_count": pa.array(rng.integers(0, 5, n_hd),
+                                     type=pa.int32()),
+        "hd_buy_potential": pa.array(rng.choice(_BUY_POTENTIAL, n_hd)),
+    })
+    tmark = np.arange(n_times)
+    time_dim = pa.table({
+        "t_time_sk": pa.array(tmark, type=pa.int64()),
+        "t_hour": pa.array(tmark // 12, type=pa.int32()),
+        "t_minute": pa.array((tmark % 12) * 5, type=pa.int32()),
+    })
+    customer = pa.table({
+        "c_customer_sk": pa.array(np.arange(n_cust), type=pa.int64()),
+        "c_first_name": pa.array(rng.choice(_FIRST, n_cust)),
+        "c_last_name": pa.array(rng.choice(_LAST, n_cust)),
+        "c_current_addr_sk": pa.array(rng.integers(0, n_addr, n_cust),
+                                      type=pa.int64()),
+    })
+    customer_address = pa.table({
+        "ca_address_sk": pa.array(np.arange(n_addr), type=pa.int64()),
+        "ca_city": pa.array(rng.choice(_CITIES, n_addr)),
+        "ca_county": pa.array(rng.choice(_COUNTIES, n_addr)),
+    })
+
+    # ticket-coherent fact generation: a ticket (basket) shares ONE
+    # date/time/store/hdemo/customer/addr across its line items — the
+    # property q34/q68/q73/q79's per-ticket count/sum semantics rely on
+    # (per-row-independent dims would scatter each ticket across filters
+    # and leave count-range predicates empty)
+    n_tickets = max(rows // 8, 10)
+    tk_date = rng.integers(0, n_dates, n_tickets)
+    tk_time = rng.integers(0, n_times, n_tickets)
+    tk_store = rng.integers(0, n_stores, n_tickets)
+    tk_hd = rng.integers(0, n_hd, n_tickets)
+    tk_cust = rng.integers(0, n_cust, n_tickets)
+    tk_addr = rng.integers(0, n_addr, n_tickets)
+    ticket = rng.integers(0, n_tickets, rows)
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(tk_date[ticket], type=pa.int64()),
+        "ss_item_sk": pa.array(rng.integers(0, n_items, rows),
+                               type=pa.int64()),
+        "ss_ext_sales_price": pa.array(
+            np.round(rng.random(rows) * 1000, 2)),
+        "ss_cdemo_sk": pa.array(rng.integers(0, n_cd, rows),
+                                type=pa.int64()),
+        "ss_promo_sk": pa.array(rng.integers(0, n_promo, rows),
+                                type=pa.int64()),
+        "ss_quantity": pa.array(rng.integers(1, 100, rows),
+                                type=pa.int32()),
+        "ss_list_price": pa.array(np.round(rng.random(rows) * 200, 2)),
+        "ss_coupon_amt": pa.array(np.round(rng.random(rows) * 50, 2)),
+        "ss_store_sk": pa.array(tk_store[ticket], type=pa.int64()),
+        "ss_hdemo_sk": pa.array(tk_hd[ticket], type=pa.int64()),
+        "ss_sold_time_sk": pa.array(tk_time[ticket], type=pa.int64()),
+        "ss_ticket_number": pa.array(ticket, type=pa.int64()),
+        "ss_customer_sk": pa.array(tk_cust[ticket], type=pa.int64()),
+        "ss_addr_sk": pa.array(tk_addr[ticket], type=pa.int64()),
+        "ss_net_profit": pa.array(np.round(rng.random(rows) * 100 - 20, 2)),
+        "ss_sales_price": pa.array(np.round(rng.random(rows) * 150, 2)),
+        "ss_ext_list_price": pa.array(np.round(rng.random(rows) * 250, 2)),
+        "ss_ext_tax": pa.array(np.round(rng.random(rows) * 30, 2)),
+    })
+    return {
+        "store_sales": store_sales, "date_dim": date_dim, "item": item,
+        "customer_demographics": customer_demographics,
+        "promotion": promotion, "store": store,
+        "household_demographics": household_demographics,
+        "time_dim": time_dim, "customer": customer,
+        "customer_address": customer_address,
+    }
+
+
+# ---------------------------------------------------------------------------
+# oracle helpers
+# ---------------------------------------------------------------------------
+
+def _sorted_frames(got: pd.DataFrame, exp: pd.DataFrame):
+    """Sort both frames by the non-float columns first (every query here
+    projects a unique non-float key set, so these fully determine row
+    order), with rounded floats as inert tiebreakers."""
+    def prep(df):
+        df = df.copy()
+        df.columns = list(range(len(df.columns)))
+        keys = {}
+        for c in df.columns:
+            if df[c].dtype.kind not in "fc":
+                keys[f"a{c}"] = df[c]
+        for c in df.columns:
+            if df[c].dtype.kind in "fc":
+                keys[f"z{c}"] = df[c].astype(float).round(3)
+        key_df = pd.DataFrame(keys)
+        order = key_df.sort_values(list(key_df.columns),
+                                   na_position="first").index
+        return df.loc[order].reset_index(drop=True)
+    return prep(got), prep(exp)
+
+
+def _assert_rows(got: pd.DataFrame, exp: pd.DataFrame):
+    """Order-insensitive frame equality with float tolerance (ORDER BY
+    columns in these queries are not total orders, so row order between
+    engines is not comparable — the multiset is)."""
+    assert len(got) == len(exp), f"{len(got)} rows != {len(exp)}"
+    assert len(got.columns) == len(exp.columns)
+    assert len(exp) > 0, "oracle produced empty result — tune constants"
+    g, e = _sorted_frames(got, exp)
+    for c in g.columns:
+        if g[c].dtype.kind == "f" or e[c].dtype.kind == "f":
+            assert np.allclose(g[c].astype(float).fillna(np.nan),
+                               e[c].astype(float).fillna(np.nan),
+                               rtol=1e-6, atol=1e-6, equal_nan=True), c
+        else:
+            assert (g[c].fillna("\0").values ==
+                    e[c].fillna("\0").values).all(), c
+
+
+#: to_pandas results per table-set, STRONG-ref keyed by identity (the
+#: strong ref makes id() recycling impossible; the rig passes one table
+#: dict per suite, so at most one entry is live)
+_pd_cache = [None, None]         # [tables_dict, {name: DataFrame}]
+
+
+def _pd(t: Dict[str, pa.Table], name: str) -> pd.DataFrame:
+    if _pd_cache[0] is not t:
+        _pd_cache[0] = t
+        _pd_cache[1] = {}
+    cache = _pd_cache[1]
+    if name not in cache:
+        cache[name] = t[name].to_pandas()
+    return cache[name].copy()
+
+
+def _merged(t: Dict[str, pa.Table], with_: List[str]) -> pd.DataFrame:
+    """store_sales joined to the requested dims, pandas-side (cached
+    conversions: oracle pandas work lands in warm_seconds otherwise)."""
+    keys = {
+        "date_dim": ("ss_sold_date_sk", "d_date_sk"),
+        "item": ("ss_item_sk", "i_item_sk"),
+        "store": ("ss_store_sk", "s_store_sk"),
+        "household_demographics": ("ss_hdemo_sk", "hd_demo_sk"),
+        "time_dim": ("ss_sold_time_sk", "t_time_sk"),
+        "customer": ("ss_customer_sk", "c_customer_sk"),
+        "customer_demographics": ("ss_cdemo_sk", "cd_demo_sk"),
+        "customer_address": ("ss_addr_sk", "ca_address_sk"),
+    }
+    pdf = _pd(t, "store_sales")
+    for name in with_:
+        l, r = keys[name]
+        pdf = pdf.merge(_pd(t, name), left_on=l, right_on=r)
+    return pdf
+
+
+# ---------------------------------------------------------------------------
+# queries: (name, sql, oracle(got_pdf, tables))
+# ---------------------------------------------------------------------------
+
+def _oracle_q34(got, t):
+    pdf = _merged(t, ["date_dim", "store", "household_demographics"])
+    pdf = pdf[((pdf.d_dom.between(1, 3)) | (pdf.d_dom.between(25, 28)))
+              & (pdf.hd_buy_potential == "1001-5000")
+              & (pdf.hd_vehicle_count > 0)
+              & (pdf.d_year.isin([1998, 1999, 2000]))
+              & (pdf.s_county == "C1")]
+    dn = (pdf.groupby(["ss_ticket_number", "ss_customer_sk"])
+          .size().reset_index(name="cnt"))
+    dn = dn[dn.cnt.between(2, 20)]
+    cust = _pd(t, "customer")
+    exp = dn.merge(cust, left_on="ss_customer_sk",
+                   right_on="c_customer_sk")[
+        ["c_last_name", "c_first_name", "ss_ticket_number", "cnt"]]
+    _assert_rows(got, exp)
+
+
+_Q34 = """
+SELECT c_last_name, c_first_name, ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) AS cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND (d_dom BETWEEN 1 AND 3 OR d_dom BETWEEN 25 AND 28)
+        AND hd_buy_potential = '1001-5000' AND hd_vehicle_count > 0
+        AND d_year IN (1998, 1999, 2000) AND s_county = 'C1'
+      GROUP BY ss_ticket_number, ss_customer_sk) dn, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 2 AND 20
+ORDER BY c_last_name, c_first_name, ss_ticket_number DESC
+"""
+
+
+def _oracle_q52(got, t):
+    pdf = _merged(t, ["date_dim", "item"])
+    pdf = pdf[(pdf.i_manager_id <= 10) & (pdf.d_moy == 11)
+              & (pdf.d_year == 2000)]
+    exp = (pdf.groupby(["d_year", "i_brand_id"])
+           .agg(ext_price=("ss_ext_sales_price", "sum")).reset_index())
+    _assert_rows(got, exp)
+
+
+_Q52 = """
+SELECT d_year, i_brand_id, sum(ss_ext_sales_price) AS ext_price
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND i_manager_id <= 10 AND d_moy = 11 AND d_year = 2000
+GROUP BY d_year, i_brand_id
+ORDER BY d_year, ext_price DESC
+"""
+
+
+def _oracle_q53(got, t):
+    pdf = _merged(t, ["item", "date_dim", "store"])
+    pdf = pdf[pdf.d_qoy.isin([1, 2]) & (pdf.i_class_id < 8)]
+    grouped = (pdf.groupby(["i_manufact_id", "d_qoy"])
+               .agg(sum_sales=("ss_sales_price", "sum")).reset_index())
+    grouped["avg_quarterly_sales"] = grouped.groupby(
+        "i_manufact_id")["sum_sales"].transform("mean")
+    exp = grouped[["i_manufact_id", "d_qoy", "sum_sales",
+                   "avg_quarterly_sales"]]
+    _assert_rows(got, exp)
+
+
+_Q53 = """
+SELECT i_manufact_id, d_qoy, sum_sales,
+       avg(sum_sales) OVER (PARTITION BY i_manufact_id)
+         AS avg_quarterly_sales
+FROM (SELECT i_manufact_id, d_qoy, sum(ss_sales_price) AS sum_sales
+      FROM item, store_sales, date_dim, store
+      WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk AND d_qoy IN (1, 2)
+        AND i_class_id < 8
+      GROUP BY i_manufact_id, d_qoy) tmp1
+ORDER BY avg_quarterly_sales, sum_sales, i_manufact_id
+"""
+
+
+def _oracle_q55(got, t):
+    pdf = _merged(t, ["date_dim", "item"])
+    pdf = pdf[(pdf.i_manager_id.between(20, 40)) & (pdf.d_moy == 11)
+              & (pdf.d_year == 1999)]
+    exp = (pdf.groupby(["i_brand", "i_brand_id"])
+           .agg(ext_price=("ss_ext_sales_price", "sum")).reset_index())
+    exp = exp[["i_brand_id", "i_brand", "ext_price"]]
+    _assert_rows(got, exp)
+
+
+_Q55 = """
+SELECT i_brand_id, i_brand, sum(ss_ext_sales_price) AS ext_price
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND i_manager_id BETWEEN 20 AND 40 AND d_moy = 11 AND d_year = 1999
+GROUP BY i_brand, i_brand_id
+ORDER BY ext_price DESC, i_brand_id
+"""
+
+
+def _oracle_q68(got, t):
+    pdf = _merged(t, ["date_dim", "store", "household_demographics",
+                      "customer_address"])
+    pdf = pdf[(pdf.d_dom.between(1, 2))
+              & ((pdf.hd_dep_count == 4) | (pdf.hd_vehicle_count == 3))
+              & (pdf.d_year.isin([1998, 1999, 2000]))
+              & (pdf.s_city.isin(["Fairview", "Midway"]))]
+    dn = (pdf.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                       "ca_city"])
+          .agg(extended_price=("ss_ext_sales_price", "sum"),
+               list_price=("ss_ext_list_price", "sum"),
+               extended_tax=("ss_ext_tax", "sum")).reset_index()
+          .rename(columns={"ca_city": "bought_city"}))
+    cust = _pd(t, "customer")
+    addr = _pd(t, "customer_address")
+    exp = (dn.merge(cust, left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+           .merge(addr, left_on="c_current_addr_sk",
+                  right_on="ca_address_sk"))
+    exp = exp[exp.ca_city != exp.bought_city][
+        ["c_last_name", "c_first_name", "ca_city", "bought_city",
+         "ss_ticket_number", "ss_addr_sk", "extended_price",
+         "extended_tax", "list_price"]]
+    _assert_rows(got, exp)
+
+
+_Q68 = """
+SELECT c_last_name, c_first_name, current_addr.ca_city, bought_city,
+       ss_ticket_number, ss_addr_sk, extended_price, extended_tax,
+       list_price
+FROM (SELECT ss_ticket_number, ss_customer_sk, ss_addr_sk,
+             ca_city AS bought_city,
+             sum(ss_ext_sales_price) AS extended_price,
+             sum(ss_ext_list_price) AS list_price,
+             sum(ss_ext_tax) AS extended_tax
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk AND ss_addr_sk = ca_address_sk
+        AND d_dom BETWEEN 1 AND 2
+        AND (hd_dep_count = 4 OR hd_vehicle_count = 3)
+        AND d_year IN (1998, 1999, 2000)
+        AND s_city IN ('Fairview', 'Midway')
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name, ss_ticket_number
+"""
+
+
+def _oracle_q73(got, t):
+    pdf = _merged(t, ["date_dim", "store", "household_demographics"])
+    pdf = pdf[(pdf.d_dom.between(1, 2))
+              & (pdf.hd_buy_potential.isin(["501-1000", ">10000"]))
+              & (pdf.hd_vehicle_count > 0)
+              & (pdf.d_year.isin([1998, 1999, 2000]))
+              & (pdf.s_county.isin(["C1", "C2"]))]
+    dn = (pdf.groupby(["ss_ticket_number", "ss_customer_sk"])
+          .size().reset_index(name="cnt"))
+    dn = dn[dn.cnt.between(1, 5)]
+    cust = _pd(t, "customer")
+    exp = dn.merge(cust, left_on="ss_customer_sk",
+                   right_on="c_customer_sk")[
+        ["c_last_name", "c_first_name", "ss_ticket_number", "cnt"]]
+    _assert_rows(got, exp)
+
+
+_Q73 = """
+SELECT c_last_name, c_first_name, ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) AS cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk AND d_dom BETWEEN 1 AND 2
+        AND hd_buy_potential IN ('501-1000', '>10000')
+        AND hd_vehicle_count > 0 AND d_year IN (1998, 1999, 2000)
+        AND s_county IN ('C1', 'C2')
+      GROUP BY ss_ticket_number, ss_customer_sk) dj, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 1 AND 5
+ORDER BY cnt DESC, c_last_name
+"""
+
+
+def _oracle_q79(got, t):
+    pdf = _merged(t, ["date_dim", "store", "household_demographics"])
+    pdf = pdf[((pdf.hd_dep_count == 6) | (pdf.hd_vehicle_count > 2))
+              & (pdf.d_dow == 1) & (pdf.d_year.isin([1998, 1999, 2000]))
+              & (pdf.s_number_employees.between(200, 295))]
+    ms = (pdf.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                       "s_city"])
+          .agg(amt=("ss_coupon_amt", "sum"),
+               profit=("ss_net_profit", "sum")).reset_index())
+    cust = _pd(t, "customer")
+    exp = ms.merge(cust, left_on="ss_customer_sk",
+                   right_on="c_customer_sk")
+    exp["city30"] = exp.s_city.str[:30]
+    exp = exp[["c_last_name", "c_first_name", "city30",
+               "ss_ticket_number", "ss_addr_sk", "amt", "profit"]]
+    _assert_rows(got, exp)
+
+
+_Q79 = """
+SELECT c_last_name, c_first_name, substr(s_city, 1, 30) AS city30,
+       ss_ticket_number, ss_addr_sk, amt, profit
+FROM (SELECT ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city,
+             sum(ss_coupon_amt) AS amt, sum(ss_net_profit) AS profit
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND ss_hdemo_sk = hd_demo_sk
+        AND (hd_dep_count = 6 OR hd_vehicle_count > 2)
+        AND d_dow = 1 AND d_year IN (1998, 1999, 2000)
+        AND s_number_employees BETWEEN 200 AND 295
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city) ms,
+     customer
+WHERE ss_customer_sk = c_customer_sk
+ORDER BY c_last_name, c_first_name, city30, profit
+"""
+
+
+def _count_bucket(t, h0, m0, m1, dep):
+    pdf = _merged(t, ["household_demographics", "time_dim", "store"])
+    pdf = pdf[(pdf.t_hour == h0) & (pdf.t_minute >= m0)
+              & (pdf.t_minute < m1) & (pdf.hd_dep_count == dep)
+              & (pdf.s_store_name == "ese")]
+    return len(pdf)
+
+
+def _oracle_q88(got, t):
+    exp = pd.DataFrame({
+        "h8_30_to_9": [_count_bucket(t, 8, 30, 60, 3)],
+        "h9_to_9_30": [_count_bucket(t, 9, 0, 30, 3)],
+        "h9_30_to_10": [_count_bucket(t, 9, 30, 60, 3)],
+        "h10_to_10_30": [_count_bucket(t, 10, 0, 30, 3)],
+    })
+    _assert_rows(got, exp)
+
+
+def _q88_block(alias, hour, m0, m1):
+    cmp_m = f"t_minute >= {m0} AND t_minute < {m1}"
+    return (f"(SELECT count(*) AS {alias} "
+            f"FROM store_sales, household_demographics, time_dim, store "
+            f"WHERE ss_sold_time_sk = t_time_sk "
+            f"AND ss_hdemo_sk = hd_demo_sk AND ss_store_sk = s_store_sk "
+            f"AND t_hour = {hour} AND {cmp_m} "
+            f"AND hd_dep_count = 3 AND s_store_name = 'ese')")
+
+
+_Q88 = f"""
+SELECT * FROM
+ {_q88_block('h8_30_to_9', 8, 30, 60)} s1,
+ {_q88_block('h9_to_9_30', 9, 0, 30)} s2,
+ {_q88_block('h9_30_to_10', 9, 30, 60)} s3,
+ {_q88_block('h10_to_10_30', 10, 0, 30)} s4
+"""
+
+
+def _oracle_q96(got, t):
+    pdf = _merged(t, ["household_demographics", "time_dim", "store"])
+    pdf = pdf[(pdf.t_hour == 20) & (pdf.t_minute >= 30)
+              & (pdf.hd_dep_count == 7) & (pdf.s_store_name == "ese")]
+    _assert_rows(got, pd.DataFrame({"cnt": [len(pdf)]}))
+
+
+_Q96 = """
+SELECT count(*) AS cnt
+FROM store_sales, household_demographics, time_dim, store
+WHERE ss_sold_time_sk = t_time_sk AND ss_hdemo_sk = hd_demo_sk
+  AND ss_store_sk = s_store_sk AND t_hour = 20 AND t_minute >= 30
+  AND hd_dep_count = 7 AND s_store_name = 'ese'
+"""
+
+
+def _oracle_q98(got, t):
+    pdf = _merged(t, ["date_dim", "item"])
+    pdf = pdf[pdf.i_category_id.isin([1, 2, 3]) & (pdf.d_year == 1999)]
+    grouped = (pdf.groupby(["i_item_id", "i_category_id", "i_class_id",
+                            "i_current_price"])
+               .agg(itemrevenue=("ss_ext_sales_price", "sum"))
+               .reset_index())
+    grouped["revenueratio"] = (grouped.itemrevenue * 100 /
+                               grouped.groupby("i_class_id")["itemrevenue"]
+                               .transform("sum"))
+    _assert_rows(got, grouped)
+
+
+_Q98 = """
+SELECT i_item_id, i_category_id, i_class_id, i_current_price,
+       itemrevenue,
+       itemrevenue * 100 / sum(itemrevenue)
+         OVER (PARTITION BY i_class_id) AS revenueratio
+FROM (SELECT i_item_id, i_category_id, i_class_id, i_current_price,
+             sum(ss_ext_sales_price) AS itemrevenue
+      FROM store_sales, item, date_dim
+      WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND i_category_id IN (1, 2, 3) AND d_year = 1999
+      GROUP BY i_item_id, i_category_id, i_class_id,
+               i_current_price) grouped
+ORDER BY i_category_id, i_class_id, i_item_id, revenueratio
+"""
+
+
+def _oracle_q42(got, t):
+    pdf = _merged(t, ["date_dim", "item"])
+    pdf = pdf[(pdf.i_manager_id <= 15) & (pdf.d_moy == 12)
+              & (pdf.d_year == 2000)]
+    exp = (pdf.groupby(["d_year", "i_category_id"])
+           .agg(s=("ss_ext_sales_price", "sum")).reset_index())
+    _assert_rows(got, exp)
+
+
+_Q42_SQL = """
+SELECT d_year, i_category_id, sum(ss_ext_sales_price) AS s
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND i_manager_id <= 15 AND d_moy = 12 AND d_year = 2000
+GROUP BY d_year, i_category_id
+ORDER BY s DESC, d_year, i_category_id
+"""
+
+
+def _oracle_q59ish(got, t):
+    """Weekly revenue by (store, dow) with a self-comparison ratio across
+    two year halves — the q59 shape reduced to one join level."""
+    pdf = _merged(t, ["date_dim", "store"])
+    h1 = pdf[pdf.d_year == 1998]
+    h2 = pdf[pdf.d_year == 1999]
+    a = (h1.groupby(["s_store_name", "d_dow"])
+         .agg(rev1=("ss_ext_sales_price", "sum")).reset_index())
+    b = (h2.groupby(["s_store_name", "d_dow"])
+         .agg(rev2=("ss_ext_sales_price", "sum")).reset_index())
+    exp = a.merge(b, on=["s_store_name", "d_dow"])
+    exp["ratio"] = exp.rev2 / exp.rev1
+    _assert_rows(got, exp)
+
+
+_Q59ISH = """
+SELECT y1.s_store_name, y1.d_dow, y1.rev1, y2.rev2,
+       y2.rev2 / y1.rev1 AS ratio
+FROM (SELECT s_store_name, d_dow, sum(ss_ext_sales_price) AS rev1
+      FROM store_sales, date_dim, store
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND d_year = 1998
+      GROUP BY s_store_name, d_dow) y1,
+     (SELECT s_store_name, d_dow, sum(ss_ext_sales_price) AS rev2
+      FROM store_sales, date_dim, store
+      WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+        AND d_year = 1999
+      GROUP BY s_store_name, d_dow) y2
+WHERE y1.s_store_name = y2.s_store_name AND y1.d_dow = y2.d_dow
+ORDER BY y1.s_store_name, y1.d_dow
+"""
+
+
+#: (name, sql, oracle) — consumed by scaletest.QUERIES via make_runner
+QUERY_SET: List[Tuple[str, str, Callable]] = [
+    ("q34_ticket_counts", _Q34, _oracle_q34),
+    ("q42_category_rev_sql", _Q42_SQL, _oracle_q42),
+    ("q52_brand_rev", _Q52, _oracle_q52),
+    ("q53_manufact_window", _Q53, _oracle_q53),
+    ("q55_brand_rev_mgr", _Q55, _oracle_q55),
+    ("q59_weekly_ratio", _Q59ISH, _oracle_q59ish),
+    ("q68_city_tickets", _Q68, _oracle_q68),
+    ("q73_ticket_counts", _Q73, _oracle_q73),
+    ("q79_amt_profit", _Q79, _oracle_q79),
+    ("q88_time_buckets", _Q88, _oracle_q88),
+    ("q96_time_count", _Q96, _oracle_q96),
+    ("q98_revenue_ratio", _Q98, _oracle_q98),
+]
+
+
+# view registration cache: STRONG refs compared with `is`, so freed
+# objects can never alias a cache hit via id() reuse
+_view_cache = [None, None]
+
+
+def register_views(sess, t: Dict[str, pa.Table]) -> None:
+    parts = {"store_sales": 4}
+    for name, tbl in t.items():
+        sess.create_dataframe(
+            tbl, num_partitions=parts.get(name, 2)
+        ).createOrReplaceTempView(name)
+
+
+def make_runner(sql: str, oracle: Callable) -> Callable:
+    """Adapt one query to the scaletest (sess, tables, F) protocol."""
+    def run(sess, t, F):
+        if _view_cache[0] is not sess or _view_cache[1] is not t:
+            register_views(sess, t)
+            _view_cache[0], _view_cache[1] = sess, t
+        got = sess.sql(sql).collect().to_pandas()
+        oracle(got, t)
+    return run
