@@ -190,6 +190,9 @@ class Planner:
             if _mixed_distinct_applies(node, distinct, regular):
                 return self._plan_mixed_distinct(node, child, be, distinct,
                                                  regular)
+            if _expand_distinct_applies(node, distinct, regular):
+                return self._plan_expand_distinct(node, child, be,
+                                                  distinct, regular)
             raise NotImplementedError(UNSUPPORTED_DISTINCT_MSG)
         nparts = child.num_partitions()
         special = any(
@@ -348,6 +351,207 @@ class Planner:
                     lo, hi = ranges[id(e)]
                     return PreMergedAggregate(e, *slot_attrs[lo:hi])
                 return e
+            if not getattr(e, "children", ()):
+                return e
+            return e.with_children(tuple(rewrite(c) for c in e.children))
+
+        outer_outs = []
+        for e in node.aggregates:
+            if isinstance(e, AttributeReference):
+                idx = [j for j, g in enumerate(node.grouping) if g is e
+                       or (isinstance(g, AttributeReference)
+                           and g.expr_id == e.expr_id)]
+                if not idx:
+                    raise NotImplementedError(UNSUPPORTED_DISTINCT_MSG)
+                outer_outs.append(Alias(key_attrs[idx[0]], e.name))
+            else:
+                outer_outs.append(rewrite(e))
+        return HashAggregateExec(tuple(key_attrs), tuple(outer_outs),
+                                 "complete", mid, backend=be)
+
+    def _plan_expand_distinct(self, node: P.Aggregate, child, be,
+                              distinct, regular):
+        """DISTINCT aggregates over SEVERAL child sets (+ optional plain
+        aggregates) — Spark's ``RewriteDistinctAggregates`` Expand
+        construction (reference executes the resulting ExpandExec via
+        ``GpuExpandExec.scala``):
+
+        1. EXPAND each row into m+1 projections: gid 0 carries the
+           regular-aggregate inputs (all child columns) and a constant-1
+           marker; gid j carries ONLY group j's distinct child
+           expressions (everything else typed-NULL).  Grouping keys stay
+           live on every projection.
+        2. Partial aggregate grouped by (keys, gid, all distinct cols):
+           plain funcs with their inputs masked to gid 0, so gid>0 rows
+           contribute identity slots.  count(*) counts the marker.
+        3. Hash-exchange by keys, merge on the full grouping tuple (each
+           (keys, gid, d-tuple) survives exactly once).
+        4. Complete aggregate by keys: distinct funcs run as PLAIN funcs
+           over their d-columns masked to their own gid (null inputs from
+           other gids are ignored by aggregate semantics); plain funcs
+           re-merge their slots via PreMergedAggregate.
+        """
+        from .expressions.aggregates import (AggregateExpression,
+                                             AggregateFunction, Count,
+                                             PreMergedAggregate)
+        from .expressions.conditional import If
+        from .expressions.core import Alias, Literal
+        from .expressions.predicates import EqualTo
+        from .. import types as T
+
+        # distinct groups, gid 1..m in first-seen order
+        group_of: dict = {}
+        group_children: list = []
+        for d in distinct:
+            k = tuple(c.semantic_key() for c in d.func.children)
+            if k not in group_of:
+                group_of[k] = len(group_children) + 1
+                group_children.append(list(d.func.children))
+        m = len(group_children)
+
+        child_attrs = tuple(child.output)
+        # grouping keys must stay live on EVERY projection.  Plain-column
+        # keys pass through; expression keys are evaluated into their own
+        # expand column (the projection still sees all child columns, so
+        # the expression computes even on rows whose other outputs are
+        # nulled).
+        key_ids = {g.expr_id for g in node.grouping
+                   if isinstance(g, AttributeReference)}
+        gkey_attrs = []
+        gkey_exprs = []            # what to project per grouping key
+        for i, g in enumerate(node.grouping):
+            if isinstance(g, AttributeReference):
+                gkey_attrs.append(g)
+            else:
+                gkey_attrs.append(AttributeReference(
+                    f"__gk{i}", g.data_type, True))
+            gkey_exprs.append(g)
+        extra_keys = [(a, g) for a, g in zip(gkey_attrs, gkey_exprs)
+                      if not isinstance(g, AttributeReference)]
+        gid_attr = AttributeReference("__did", T.LONG, False)
+        marker_attr = AttributeReference("__d0", T.LONG, True)
+        dcol_attrs = []
+        dcol_pos: dict = {}        # (gid, child_idx) -> index into dcols
+        for j, children in enumerate(group_children, start=1):
+            for i, c in enumerate(children):
+                dcol_pos[(j, i)] = len(dcol_attrs)
+                dcol_attrs.append(AttributeReference(
+                    f"__d{j}_{i}", c.data_type, True))
+        nd = len(dcol_attrs)
+
+        def null_of(dt):
+            return Literal(None, dt)
+
+        # child columns stage 1 actually reads: regular-func inputs (the
+        # rest project as typed NULLs everywhere — Spark's rewrite also
+        # restricts the regular projection to referenced columns)
+        used_ids = set(key_ids)
+        for f in regular:
+            base = f.func if isinstance(f, AggregateExpression) else f
+            for c in base.children:
+                for a in c.collect(
+                        lambda x: isinstance(x, AttributeReference)):
+                    used_ids.add(a.expr_id)
+
+        projections = []
+        if regular:     # distinct-only queries need no gid-0 projection
+            projections.append(
+                tuple(a if a.expr_id in used_ids else null_of(a.data_type)
+                      for a in child_attrs)
+                + tuple(g for _a, g in extra_keys)
+                + tuple(null_of(a.data_type) for a in dcol_attrs)
+                + (Literal(0, T.LONG), Literal(1, T.LONG)))
+        for j, children in enumerate(group_children, start=1):
+            row = [a if a.expr_id in key_ids else null_of(a.data_type)
+                   for a in child_attrs]
+            dvals = [null_of(a.data_type) for a in dcol_attrs]
+            for i, c in enumerate(children):
+                dvals[dcol_pos[(j, i)]] = c
+            projections.append(tuple(row)
+                               + tuple(g for _a, g in extra_keys)
+                               + tuple(dvals)
+                               + (Literal(j, T.LONG), null_of(T.LONG)))
+        expand = ExpandExec(
+            projections,
+            child_attrs + tuple(a for a, _g in extra_keys)
+            + tuple(dcol_attrs) + (gid_attr, marker_attr),
+            child, backend=be)
+
+        # stage-1 regular funcs: inputs masked to gid 0 (nulls elsewhere
+        # make gid>0 rows identity contributions even for literal inputs)
+        gid0 = EqualTo(gid_attr, Literal(0, T.LONG))
+
+        def stage1_base(f):
+            base = f.func if isinstance(f, AggregateExpression) else f
+            if not base.children:
+                return Count(marker_attr)      # count(*) over the marker
+            return base.with_children(tuple(
+                If(gid0, c, null_of(c.data_type)) for c in base.children))
+
+        inner_aggs = tuple(Alias(AggregateExpression(stage1_base(f)),
+                                 f"__r{i}")
+                           for i, f in enumerate(regular))
+        nk = len(node.grouping)
+        g1 = tuple(gkey_attrs) + (gid_attr,) + tuple(dcol_attrs)
+        inner = HashAggregateExec(g1, inner_aggs, "partial", expand,
+                                  backend=be)
+        mid = inner
+        if child.num_partitions() > 1 or m > 1:
+            key_refs = inner.output[:nk]
+            part = (HashPartitioning(key_refs,
+                                     int(self.conf.shuffle_partitions))
+                    if node.grouping else SinglePartitioning())
+            exchanged = ShuffleExchangeExec(part, inner, backend=be)
+            mid = HashAggregateExec(
+                tuple(inner.output[:nk + 1 + nd]), inner_aggs, "merge",
+                exchanged, backend=be)
+
+        key_attrs = inner.output[:nk]
+        gid_out = inner.output[nk]
+        d_out = inner.output[nk + 1:nk + 1 + nd]
+        slot_attrs = inner.output[nk + 1 + nd:]
+
+        # slot range per regular func (dedup identical funcs the same way
+        # HashAggregateExec.register_agg does)
+        ranges = {}
+        seen_ranges = {}
+        off = 0
+        for f in regular:
+            fk = stage1_base(f).semantic_key()
+            if fk not in seen_ranges:
+                n = len(stage1_base(f).slots())
+                seen_ranges[fk] = (off, off + n)
+                off += n
+            ranges[id(f)] = seen_ranges[fk]
+
+        def masked_distinct(e):
+            j = group_of[tuple(c.semantic_key() for c in e.func.children)]
+            pred = EqualTo(gid_out, Literal(j, T.LONG))
+            cols = tuple(
+                If(pred, d_out[dcol_pos[(j, i)]],
+                   null_of(d_out[dcol_pos[(j, i)]].data_type))
+                for i in range(len(e.func.children)))
+            return e.func.with_children(cols)
+
+        gkey_by_sem = {g.semantic_key(): key_attrs[i]
+                       for i, g in enumerate(gkey_exprs)}
+
+        def rewrite(e):
+            if isinstance(e, AggregateExpression):
+                if e.is_distinct:
+                    return masked_distinct(e)
+                lo, hi = ranges[id(e)]
+                return PreMergedAggregate(stage1_base(e),
+                                          *slot_attrs[lo:hi])
+            if isinstance(e, AggregateFunction):
+                if id(e) in ranges:
+                    lo, hi = ranges[id(e)]
+                    return PreMergedAggregate(stage1_base(e),
+                                              *slot_attrs[lo:hi])
+                return e
+            sk = e.semantic_key()
+            if sk in gkey_by_sem:     # (sub)expression IS a grouping key
+                return gkey_by_sem[sk]
             if not getattr(e, "children", ()):
                 return e
             return e.with_children(tuple(rewrite(c) for c in e.children))
@@ -552,10 +756,37 @@ def _annotate_window_group_limits(node, out, parents) -> None:
 
 
 UNSUPPORTED_DISTINCT_MSG = (
-    "DISTINCT aggregates are only supported when every aggregate in the "
-    "statement is DISTINCT over the same non-empty column list, with "
-    "plain-column grouping keys and no FILTER clause (mixed forms need "
-    "Spark's Expand plan, which no engine path implements yet)")
+    "DISTINCT aggregates need non-empty DISTINCT child lists, no FILTER "
+    "clauses, and (when mixed with plain aggregates) slot-based "
+    "null-ignoring plain functions — first()/last() without ignoreNulls "
+    "and collect/percentile aggregates can't share a node with DISTINCT")
+
+
+def _expand_distinct_applies(node: "P.Aggregate", distinct, regular) -> bool:
+    """The Expand plan (multiple DISTINCT child sets) needs: non-empty
+    child lists, no FILTER clauses anywhere, slot-based NULL-IGNORING
+    regular funcs, and count(*) as the only zero-child regular function.
+    Grouping keys may be expressions (evaluated into their own expand
+    column).  first()/last() without ignoreNulls contribute EVERY live
+    row — including the injected gid>0 rows whose inputs the plan masks
+    to NULL — so they must take another path."""
+    from .expressions.aggregates import (AggregateExpression, Count,
+                                         _FirstLast)
+    if any(d.filter is not None for d in distinct):
+        return False
+    if not all(d.func.children for d in distinct):
+        return False
+    for f in regular:
+        base = f.func if isinstance(f, AggregateExpression) else f
+        if getattr(base, "requires_shuffle_complete", False):
+            return False
+        if isinstance(f, AggregateExpression) and f.filter is not None:
+            return False
+        if not base.children and not isinstance(base, Count):
+            return False
+        if isinstance(base, _FirstLast) and not base.ignore_nulls:
+            return False
+    return True
 
 
 def _collect_distinct(node: "P.Aggregate"):

@@ -1,0 +1,25 @@
+"""Shared scale-rig runner plumbing (used by tpch_queries and
+tpcds_queries so the caching idiom exists exactly once)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import pyarrow as pa
+
+
+class ViewCache:
+    """Registers a table set as temp views once per (session, tables)
+    pair.  Strong refs compared with ``is``: id() of a freed object can
+    be recycled by the allocator, which would alias a stale cache hit and
+    skip registration on a fresh session."""
+
+    def __init__(self, register: Callable):
+        self._register = register
+        self._sess = None
+        self._tables = None
+
+    def ensure(self, sess, tables: Dict[str, pa.Table]) -> None:
+        if self._sess is not sess or self._tables is not tables:
+            self._register(sess, tables)
+            self._sess, self._tables = sess, tables

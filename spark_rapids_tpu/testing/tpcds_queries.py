@@ -623,11 +623,6 @@ QUERY_SET: List[Tuple[str, str, Callable]] = [
 ]
 
 
-# view registration cache: STRONG refs compared with `is`, so freed
-# objects can never alias a cache hit via id() reuse
-_view_cache = [None, None]
-
-
 def register_views(sess, t: Dict[str, pa.Table]) -> None:
     parts = {"store_sales": 4}
     for name, tbl in t.items():
@@ -636,12 +631,15 @@ def register_views(sess, t: Dict[str, pa.Table]) -> None:
         ).createOrReplaceTempView(name)
 
 
+from .rig_util import ViewCache  # noqa: E402  (needs register_views)
+
+_views = ViewCache(register_views)
+
+
 def make_runner(sql: str, oracle: Callable) -> Callable:
     """Adapt one query to the scaletest (sess, tables, F) protocol."""
     def run(sess, t, F):
-        if _view_cache[0] is not sess or _view_cache[1] is not t:
-            register_views(sess, t)
-            _view_cache[0], _view_cache[1] = sess, t
+        _views.ensure(sess, t)
         got = sess.sql(sql).collect().to_pandas()
         oracle(got, t)
     return run

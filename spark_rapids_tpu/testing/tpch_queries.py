@@ -817,18 +817,16 @@ QUERY_SET: List[Tuple[str, str, Callable]] = [
 #: over one shared table set; re-registering 8 views and re-converting 8
 #: tables to pandas inside every timed run would land in warm_seconds,
 #: the number the rig compares across machines
-_view_cache: list = [None, None]   # [sess, tables] strong refs, `is`-compared
+from .rig_util import ViewCache
+
+_views = ViewCache(lambda sess, t: register_views(sess, t))
 _pandas_cache: list = [None]  # (id(t), {name: DataFrame})
 
 
 def make_runner(sql: str, oracle: Callable) -> Callable:
     """Adapt one query to the scaletest (sess, tables, F) protocol."""
     def run(sess, t, F):
-        # strong refs compared with `is`: id() of a freed object can be
-        # recycled, which would skip registration on a fresh session
-        if _view_cache[0] is not sess or _view_cache[1] is not t:
-            register_views(sess, t)
-            _view_cache[0], _view_cache[1] = sess, t
+        _views.ensure(sess, t)
         if _pandas_cache[0] is None or _pandas_cache[0][0] is not t:
             _pandas_cache[0] = (t, _pandas(t))
         got = sess.sql(sql).collect().to_pandas()

@@ -234,3 +234,58 @@ def test_mixed_distinct_with_duplicate_regular_aggs(sess):
     assert np.allclose(got["a"], pdf["a"])
     assert np.allclose(got["b"], pdf["a"])
     assert np.allclose(got["m"], pdf["m"])
+
+
+def test_multi_set_distinct_expand_plan(sess):
+    """DISTINCT aggregates over SEVERAL child sets plus plain aggregates:
+    Spark's RewriteDistinctAggregates Expand construction
+    (planner._plan_expand_distinct; reference GpuExpandExec.scala)."""
+    import numpy as np
+    rng = np.random.default_rng(7)
+    n = 8000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 6, n), pa.int64()),
+        "a": pa.array([None if x % 9 == 0 else int(x)
+                       for x in rng.integers(0, 60, n)], pa.int64()),
+        "b": pa.array(rng.integers(0, 25, n), pa.int64()),
+        "w": pa.array(rng.random(n)),
+    })
+    df = sess.create_dataframe(t, num_partitions=4)
+    got = (df.groupBy("k")
+           .agg(F.countDistinct("a").alias("ca"),
+                F.countDistinct("b").alias("cb"),
+                F.sum_distinct(F.col("b")).alias("sb"),
+                F.sum(df.w).alias("sw"),
+                F.count("*").alias("n"))
+           .orderBy("k").collect().to_pandas())
+    pdf = t.to_pandas().groupby("k").agg(
+        ca=("a", "nunique"), cb=("b", "nunique"),
+        sb=("b", lambda s: s.dropna().unique().sum()),
+        sw=("w", "sum"), n=("k", "size")).reset_index()
+    assert np.array_equal(got["ca"], pdf["ca"])
+    assert np.array_equal(got["cb"], pdf["cb"])
+    assert np.array_equal(got["sb"], pdf["sb"])
+    assert np.allclose(got["sw"], pdf["sw"])
+    assert np.array_equal(got["n"], pdf["n"])
+
+
+def test_multi_set_distinct_global_and_sql(sess):
+    """Global (ungrouped) multi-set DISTINCT and the SQL surface."""
+    import numpy as np
+    rng = np.random.default_rng(8)
+    n = 3000
+    t = pa.table({
+        "a": pa.array(rng.integers(0, 30, n), pa.int64()),
+        "s": pa.array([f"x{v}" for v in rng.integers(0, 11, n)]),
+        "w": pa.array(rng.random(n)),
+    })
+    sess.create_dataframe(t, num_partitions=3).createOrReplaceTempView(
+        "md_t")
+    got = sess.sql(
+        "SELECT count(DISTINCT a) ca, count(DISTINCT s) cs, "
+        "avg(w) aw, count(*) n FROM md_t").collect().to_pandas()
+    pdf = t.to_pandas()
+    assert int(got["ca"][0]) == pdf.a.nunique()
+    assert int(got["cs"][0]) == pdf.s.nunique()
+    assert abs(float(got["aw"][0]) - pdf.w.mean()) < 1e-9
+    assert int(got["n"][0]) == n

@@ -151,17 +151,20 @@ class Gen:
         return f"(NOT {self.pred(d)})"
 
     def agg(self, t: str, depth: int) -> str:
-        """Non-DISTINCT aggregates only — the engine supports DISTINCT
-        aggregation when EVERY aggregate is DISTINCT over one column list
-        (planner.py UNSUPPORTED_DISTINCT_MSG), so the fuzzer emits
-        distinct-only queries as a separate shape."""
+        """Includes DISTINCT mixed with plain aggregates and across
+        different child sets — the engine's Expand-distinct path
+        (planner._plan_expand_distinct) covers those."""
         r = self.rng
         pick = r.random()
         e = self.expr(t, depth)
-        if pick < 0.18:
+        if pick < 0.15:
             return "count(*)"
-        if pick < 0.36:
+        if pick < 0.3:
             return f"count({e})"
+        if pick < 0.42:
+            d = r.choice(self.cols[t]) if (self.cols[t]
+                                           and r.random() < 0.6) else e
+            return f"count(DISTINCT {d})"
         if pick < 0.58 and t != "str":
             return f"sum({e})"
         if pick < 0.74:
