@@ -294,6 +294,14 @@ BLOOM_JOIN_BITS_PER_ROW = register(
     "a ~2% false-positive rate.", 8)
 
 # --- shuffle ---------------------------------------------------------------
+SHUFFLE_DEVICE_RESIDENT = register(
+    "spark.rapids.shuffle.localDeviceResident.enabled",
+    "Keep local SORT/MULTITHREADED shuffle blocks device-resident in the "
+    "spill catalog instead of serializing to host, when the producer and "
+    "consumer share one process and slice.  Skips a D2H+H2D round trip "
+    "per block (~65ms each over the TPU tunnel); the spill catalog still "
+    "demotes blocks under memory pressure (reference device-direct "
+    "shuffle: ShuffleBufferCatalog.scala + RapidsCachingWriter).", True)
 SHUFFLE_MODE = register(
     "spark.rapids.shuffle.mode",
     "UCX|MULTITHREADED|SORT in the reference; here ICI|MULTITHREADED|SORT — "

@@ -105,6 +105,19 @@ class ShuffleManager:
         self._pending_cleanup: Dict[int, float] = {}
         self._expired_shuffles: set = set()
         self.cleanup_ttl_s = 3600.0
+        #: device-resident local tier: blocks stay in the spill catalog as
+        #: SpillableColumnarBatch (reference RapidsCachingWriter storing
+        #: into ShuffleBufferCatalog) — no D2H serialization when producer
+        #: and consumer share this process.  ICI mode keeps its transport
+        #: SPI path (that SPI *is* its contract); multi-slice blocks must
+        #: serialize for DCN peers.
+        from ..config import SHUFFLE_DEVICE_RESIDENT
+        self._resident: Dict[BlockId, List] = {}
+        self.device_resident = (
+            bool(self.conf.get(SHUFFLE_DEVICE_RESIDENT))
+            and isinstance(self.transport, LocalTransport)
+            and self.mode != "ICI"
+            and (self.topology is None or not self.topology.multi_slice))
 
     # ------------------------------------------------------------------
     def new_shuffle_id(self) -> int:
@@ -152,6 +165,17 @@ class ShuffleManager:
                 f"cleanup TTL ({self.cleanup_ttl_s}s) before this read")
         blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
 
+        resident_batches: List[ColumnarBatch] = []
+        if self.device_resident:
+            with self._lock:
+                spillables = [sb for b in blocks
+                              for sb in self._resident.get(b, ())]
+            # get() outside the lock: an unspill (disk read + H2D) must
+            # not stall every concurrent shuffle writer/reader
+            resident_batches = [sb.get() for sb in spillables]
+            # residency and blobs can coexist mid-stream (budget/fallback
+            # writers), so the blob path below still runs for these blocks
+
         peers_cache: List[Optional[List[PeerInfo]]] = [None]
 
         def read_one(block: BlockId) -> Optional[bytes]:
@@ -195,9 +219,14 @@ class ShuffleManager:
             blobs = [read_one(b) for b in blocks]
         frames = [f for blob in blobs if blob is not None
                   for f in split_frames(blob)]
-        if not frames:
+        if not frames and not resident_batches:
             return None
-        return concat_serialized(frames)
+        pieces = list(resident_batches)
+        if frames:
+            pieces.append(concat_serialized(frames))
+        if len(pieces) == 1:
+            return pieces[0]
+        return ColumnarBatch.concat(pieces)
 
     # ------------------------------------------------------------------
     def defer_cleanup(self, shuffle_id: int) -> None:
@@ -236,6 +265,13 @@ class ShuffleManager:
                     os.unlink(self._files.pop(b))
                 except OSError:
                     pass
+            res_victims = [b for b in self._resident
+                           if shuffle_id is None
+                           or b.shuffle_id == shuffle_id]
+            spillables = [sb for b in res_victims
+                          for sb in self._resident.pop(b)]
+        for sb in spillables:      # outside the lock: close touches catalog
+            sb.close()
 
 
     def close(self) -> None:
@@ -277,8 +313,19 @@ class MapTaskWriter:
         self.map_id = map_id
         self._frames: Dict[int, List[bytes]] = {}
         self._futures = []
+        self._resident_pieces: List = []     # (reduce_id, spillable)
 
     def add(self, reduce_id: int, batch: ColumnarBatch) -> None:
+        if self.mgr.device_resident:
+            from ..memory.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY,
+                                        SpillableColumnarBatch)
+            # shuffle output is idle until its reader arrives — it must be
+            # the FIRST spill victim, not tied with live working sets
+            self._resident_pieces.append(
+                (reduce_id, SpillableColumnarBatch.create(
+                    batch, OUTPUT_FOR_SHUFFLE_PRIORITY)))
+            return
+
         def ser(b=batch):
             return serialize_batch(b, self.mgr.conf)
         if self.mgr.mode == "MULTITHREADED":
@@ -289,6 +336,12 @@ class MapTaskWriter:
             self._frames.setdefault(reduce_id, []).append(ser())
 
     def commit(self) -> None:
+        if self._resident_pieces:
+            with self.mgr._lock:
+                for reduce_id, sb in self._resident_pieces:
+                    block = BlockId(self.shuffle_id, self.map_id, reduce_id)
+                    self.mgr._resident.setdefault(block, []).append(sb)
+            self._resident_pieces = []
         for reduce_id, fut in self._futures:
             self._frames.setdefault(reduce_id, []).append(fut.result())
         self._futures = []

@@ -496,7 +496,7 @@ class Planner:
         inner = HashAggregateExec(g1, inner_aggs, "partial", expand,
                                   backend=be)
         mid = inner
-        if child.num_partitions() > 1 or m > 1:
+        if child.num_partitions() > 1:
             key_refs = inner.output[:nk]
             part = (HashPartitioning(key_refs,
                                      int(self.conf.shuffle_partitions))

@@ -819,7 +819,7 @@ QUERY_SET: List[Tuple[str, str, Callable]] = [
 #: the number the rig compares across machines
 from .rig_util import ViewCache
 
-_views = ViewCache(lambda sess, t: register_views(sess, t))
+_views = ViewCache(register_views)
 _pandas_cache: list = [None]  # (id(t), {name: DataFrame})
 
 

@@ -185,3 +185,31 @@ def test_ici_mesh_data_plane():
         m = (hk % n_dev) == d
         assert ks[d] == hk[m].sum(), d
         assert np.isclose(vs[d], hv[m].sum()), d
+
+
+def test_device_resident_local_tier(tmp_path):
+    """Local SORT/MULTITHREADED blocks stay device-resident in the spill
+    catalog (no serialize round trip) and serialize only when the tier is
+    off (reference RapidsCachingWriter + ShuffleBufferCatalog)."""
+    for resident, mode in ((True, "MULTITHREADED"), (False, "SORT")):
+        conf = RapidsConf()
+        conf.set("spark.rapids.shuffle.mode", mode)
+        conf.set("spark.rapids.memory.spillDir", str(tmp_path))
+        conf.set("spark.rapids.shuffle.localDeviceResident.enabled",
+                 str(resident).lower())
+        mgr = ShuffleManager(conf)
+        t = rich_table(64)
+        b = arrow_to_device(t)
+        sid = mgr.new_shuffle_id()
+        for m in range(2):
+            mgr.write_map_output(sid, m, [b.sliced(0, 30), b.sliced(30, 34)])
+        if resident:
+            assert mgr._resident and not mgr._files
+        else:
+            assert mgr._files and not mgr._resident
+        r0 = mgr.read_reduce_partition(sid, 2, 0)
+        r1 = mgr.read_reduce_partition(sid, 2, 1)
+        assert r0.num_rows_int == 60 and r1.num_rows_int == 68
+        mgr.cleanup(sid)
+        assert not mgr._resident and not mgr._files
+        assert mgr.read_reduce_partition(sid, 2, 0) is None
