@@ -547,8 +547,38 @@ def _load_capture():
                 continue
             if recs and _usable_capture_record(recs[-1]):
                 ts = os.path.basename(path).split("_")[1]
+                if not SUITE and not recs[-1].get("extra_metrics"):
+                    _graft_extra_metrics(cap_dir, recs[-1])
                 return ts, recs
     return None
+
+
+def _graft_extra_metrics(cap_dir, final) -> None:
+    """A watchdog-cut main run can bank its q1 number without the join/
+    window extra metrics; pull those from any other on-chip capture in
+    the same round so the driver artifact still carries all three
+    shapes."""
+    import glob
+    for path in sorted(glob.glob(os.path.join(cap_dir, "run_*.out")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if _usable_capture_record(rec) and \
+                            rec.get("extra_metrics"):
+                        final["extra_metrics"] = dict(rec["extra_metrics"])
+                        final["extra_metrics"]["_from_capture"] = \
+                            os.path.basename(path).split("_")[1]
+                        return
+        except OSError:
+            continue
 
 
 def _await_final(child: _Child, deadline: float, attempt: int = 0):
@@ -645,7 +675,10 @@ def orchestrate() -> None:
     # would mask a live regression; let the CPU fallback carry the error
     # note.  "ok-cpu" probes — jax fell back to the CPU platform — count
     # as a dead tunnel here.)
-    if device_result is None and probes \
+    # empty probes (budget too small for even one attempt) also replays:
+    # a banked on-chip number beats a CPU fallback in every no-live-device
+    # outcome except a probe that REACHED the device (live regression)
+    if device_result is None \
             and not any(p.endswith(" ok") for p in probes):
         cap = _load_capture()
         if cap is not None:

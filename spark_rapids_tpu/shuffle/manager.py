@@ -137,10 +137,14 @@ class ShuffleManager:
                          pieces: List[Optional[ColumnarBatch]]) -> None:
         """Convenience one-shot form of map_writer()."""
         w = self.map_writer(shuffle_id, map_id)
-        for r, b in enumerate(pieces):
-            if b is not None and b.num_rows_int > 0:
-                w.add(r, b)
-        w.commit()
+        try:
+            for r, b in enumerate(pieces):
+                if b is not None and b.num_rows_int > 0:
+                    w.add(r, b)
+            w.commit()
+        except BaseException:
+            w.abort()
+            raise
 
     def _store_blob(self, block: BlockId, blob: bytes) -> None:
         if self.mode == "ICI":
@@ -223,7 +227,11 @@ class ShuffleManager:
             return None
         pieces = list(resident_batches)
         if frames:
-            pieces.append(concat_serialized(frames))
+            blob_batch = concat_serialized(frames)
+            if blob_batch is not None:      # None: all frames zero-row
+                pieces.append(blob_batch)
+        if not pieces:
+            return None
         if len(pieces) == 1:
             return pieces[0]
         return ColumnarBatch.concat(pieces)
@@ -335,6 +343,17 @@ class MapTaskWriter:
         else:
             self._frames.setdefault(reduce_id, []).append(ser())
 
+    def abort(self) -> None:
+        """Release catalog registrations from a failed map task — pieces
+        added but never committed are invisible to mgr.cleanup(), so
+        dropping the writer without this would permanently inflate the
+        catalog's device-byte accounting."""
+        pieces, self._resident_pieces = self._resident_pieces, []
+        for _r, sb in pieces:
+            sb.close()
+        self._frames = {}
+        self._futures = []
+
     def commit(self) -> None:
         if self._resident_pieces:
             with self.mgr._lock:
@@ -361,14 +380,16 @@ def get_shuffle_manager(conf: Optional[RapidsConf] = None) -> ShuffleManager:
         c = conf or RapidsConf.get_global()
         # any shuffle-topology conf change rebuilds the manager (mode alone
         # would silently keep a stale transport)
-        from ..config import (SHUFFLE_TOPOLOGY_SLICE_ID,
+        from ..config import (SHUFFLE_DEVICE_RESIDENT,
+                              SHUFFLE_TOPOLOGY_SLICE_ID,
                               SHUFFLE_TOPOLOGY_SLICES)
         key = (str(c.get(SHUFFLE_MODE)).upper(),
                str(c.get(SHUFFLE_TRANSPORT_CLASS)).upper(),
                str(c.get(SHUFFLE_TCP_DRIVER_ENDPOINT)),
                str(c.get(SHUFFLE_EXECUTOR_ID)),
                int(c.get(SHUFFLE_TOPOLOGY_SLICES)),
-               int(c.get(SHUFFLE_TOPOLOGY_SLICE_ID)))
+               int(c.get(SHUFFLE_TOPOLOGY_SLICE_ID)),
+               bool(c.get(SHUFFLE_DEVICE_RESIDENT)))
         if _global_manager is None or getattr(_global_manager, "_key",
                                               None) != key:
             old = _global_manager

@@ -407,7 +407,6 @@ class Planner:
             if k not in group_of:
                 group_of[k] = len(group_children) + 1
                 group_children.append(list(d.func.children))
-        m = len(group_children)
 
         child_attrs = tuple(child.output)
         # grouping keys must stay live on EVERY projection.  Plain-column
