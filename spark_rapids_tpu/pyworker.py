@@ -216,10 +216,15 @@ class PythonWorkerPool:
             self._live = 0
 
 
-def run_pandas_job(conf, job_fn, tables: List) -> List:
+def run_pandas_job(conf, job_fn, tables: List,
+                   force_inprocess: bool = False) -> List:
     """Run ``job_fn(list[pd.DataFrame]) -> list[pd.DataFrame]`` over
     Arrow tables — isolated in a worker process (default) or in-process
     when ``spark.rapids.python.worker.isolated=false``.
+
+    ``force_inprocess`` overrides isolation for SIDE-EFFECTING callers
+    (df.foreach/foreachPartition): their whole contract is mutations the
+    caller observes, which a worker process would silently swallow.
 
     Arrow in, Arrow out on BOTH paths: the pandas conversion happens
     exactly once, inside the job (worker-side when isolated), so the
@@ -227,7 +232,7 @@ def run_pandas_job(conf, job_fn, tables: List) -> List:
     dtype normalization) and the isolated path never pays a redundant
     pandas round trip in the parent."""
     import pyarrow as pa
-    if not bool(conf.get(PYTHON_WORKER_ISOLATED)):
+    if force_inprocess or not bool(conf.get(PYTHON_WORKER_ISOLATED)):
         outs = job_fn([t.to_pandas() for t in tables])
         return [o if isinstance(o, pa.Table)
                 else pa.Table.from_pandas(o, preserve_index=False)

@@ -891,13 +891,15 @@ class DataFrame:
     def foreachPartition(self, f) -> None:
         """Invoke f once PER PARTITION with an iterator of row dicts
         (pyspark contract: per-partition resource setup must see each
-        partition separately)."""
+        partition separately).  Marked in-process: the caller observes
+        f's side effects, which an isolated worker would swallow."""
         def runner(it):
             rows = []
             for pdf in it:
                 rows.extend(pdf.to_dict("records"))
             f(iter(rows))
             return iter(())
+        runner.__srt_force_inprocess__ = True
         self.mapInPandas(runner, "p long").count()
 
     # --- na / stat accessors (pyspark df.na / df.stat) -------------------

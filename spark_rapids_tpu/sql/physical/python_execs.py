@@ -44,12 +44,15 @@ def _semaphore_released(backend: str, tctx: TaskContext):
             sem.acquire_if_necessary(tctx.partition_id, tctx)
 
 
-def _run_job(tctx: TaskContext, job_fn, tables):
+def _run_job(tctx: TaskContext, job_fn, tables, user_fn=None):
     """Route a pandas job (Arrow tables in/out) through the
     out-of-process worker pool (pyworker.py; in-process when
-    worker.isolated=false)."""
+    worker.isolated=false).  A user fn marked __srt_force_inprocess__
+    (df.foreach/foreachPartition — side effects ARE the output) always
+    runs in-process."""
     from ...pyworker import run_pandas_job
-    return run_pandas_job(tctx.conf, job_fn, tables)
+    force = bool(getattr(user_fn, "__srt_force_inprocess__", False))
+    return run_pandas_job(tctx.conf, job_fn, tables, force_inprocess=force)
 
 
 def _to_arrow(batch: ColumnarBatch):
@@ -101,7 +104,7 @@ class MapInPandasExec(PhysicalPlan):
                     if o is not None and len(o)]
 
         with _semaphore_released(self.backend, tctx):
-            outs = _run_job(tctx, job, tables)
+            outs = _run_job(tctx, job, tables, user_fn=func)
         for tab in outs:
             yield _from_arrow(tab, self.out_schema, self.backend)
 

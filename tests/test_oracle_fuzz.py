@@ -378,3 +378,118 @@ def test_window_functions_vs_pandas(sess, data):
         a = sorted(got[got.g == gi].pi.dropna().values.tolist())
         b = sorted(exp[exp.g == gi].pi.dropna().values.tolist())
         assert a == b, gi
+
+
+def test_lateral_view_explode_fuzz_vs_pandas(sess):
+    """Randomized LATERAL VIEW [OUTER] explode/posexplode over generated
+    nested rows vs pandas explode (VERDICT r3 weak #4: round-3 surfaces
+    had example-based tests only)."""
+    rng = np.random.default_rng(61)
+    n = 4000
+    lens = rng.integers(0, 5, n)
+    arrs = [None if i % 37 == 0 else
+            [int(v) for v in rng.integers(-50, 50, lens[i])]
+            for i in range(n)]
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 30, n), pa.int64()),
+        "arr": pa.array(arrs, pa.list_(pa.int64())),
+    })
+    sess.create_dataframe(t, num_partitions=3).createOrReplaceTempView(
+        "lvf_t")
+    pdf = t.to_pandas()
+
+    for outer in (False, True):
+        kw = "LATERAL VIEW OUTER" if outer else "LATERAL VIEW"
+        got = sess.sql(
+            f"SELECT k, c FROM lvf_t {kw} explode(arr) x AS c"
+        ).collect().to_pandas()
+        exp = pdf[["k", "arr"]].explode("arr").rename(columns={"arr": "c"})
+        if not outer:
+            exp = exp.dropna(subset=["c"])
+        else:
+            # OUTER keeps null/empty rows with c = NULL — pandas explode
+            # already yields NaN for both empty lists and None
+            pass
+        g = sorted(map(tuple, got.fillna(-10**9).values.tolist()))
+        e = sorted((int(k), int(c) if c == c and c is not None else -10**9)
+                   for k, c in exp.values.tolist())
+        assert g == e, (outer, g[:5], e[:5])
+
+    got = sess.sql(
+        "SELECT k, p, c FROM lvf_t LATERAL VIEW posexplode(arr) x AS p, c"
+    ).collect().to_pandas()
+    rows = []
+    for k, arr in pdf[["k", "arr"]].values.tolist():
+        if arr is None or (hasattr(arr, "__len__") and len(arr) == 0):
+            continue
+        for p, c in enumerate(arr):
+            rows.append((int(k), p, int(c)))
+    assert sorted(map(tuple, got.values.tolist())) == sorted(rows)
+
+
+def test_tablesample_fuzz_properties(sess):
+    """TABLESAMPLE (n PERCENT | n ROWS) REPEATABLE: determinism, subset
+    property, and row-count bounds over random fractions."""
+    rng = np.random.default_rng(62)
+    n = 20_000
+    t = pa.table({
+        "id": pa.array(list(range(n)), pa.int64()),
+        "v": pa.array(rng.random(n)),
+    })
+    sess.create_dataframe(t, num_partitions=4).createOrReplaceTempView(
+        "tsf_t")
+    all_ids = set(range(n))
+    for trial in range(5):
+        pct = int(rng.integers(5, 60))
+        seed = int(rng.integers(0, 10_000))
+        q = (f"SELECT id FROM tsf_t TABLESAMPLE ({pct} PERCENT) "
+             f"REPEATABLE ({seed})")
+        a = sess.sql(q).collect().column("id").to_pylist()
+        b = sess.sql(q).collect().column("id").to_pylist()
+        assert a == b, "REPEATABLE sample must be deterministic"
+        assert set(a) <= all_ids and len(set(a)) == len(a)
+        # Bernoulli sampling: expect pct% +- 5 sigma
+        import math
+        sigma = math.sqrt(n * (pct / 100) * (1 - pct / 100))
+        assert abs(len(a) - n * pct / 100) < 5 * sigma + 10, (pct, len(a))
+    for rows in (17, 1003):
+        got = sess.sql(
+            f"SELECT id FROM tsf_t TABLESAMPLE ({rows} ROWS)"
+        ).collect().num_rows
+        assert got == rows
+
+
+def test_interval_arithmetic_fuzz_vs_pandas(sess):
+    """Randomized INTERVAL +/- over date/timestamp columns vs pandas
+    DateOffset/timedelta semantics (month arithmetic clamps to month end
+    the way Spark does)."""
+    rng = np.random.default_rng(63)
+    n = 3000
+    days = rng.integers(0, 20000, n)
+    micros = rng.integers(0, 2**44, n)
+    t = pa.table({
+        "d": pa.array(days.astype("int32"), pa.date32()),
+        "ts": pa.array(micros, pa.timestamp("us")),
+    })
+    sess.create_dataframe(t, num_partitions=2).createOrReplaceTempView(
+        "ivf_t")
+    pdf = t.to_pandas()
+    for trial in range(4):
+        nd = int(rng.integers(1, 400))
+        nm = int(rng.integers(1, 30))
+        nh = int(rng.integers(1, 100))
+        got = sess.sql(
+            f"SELECT d + INTERVAL '{nd}' DAY AS d1, "
+            f"d - INTERVAL '{nm}' MONTH AS d2, "
+            f"ts + INTERVAL '{nh}' HOUR AS t1 "
+            f"FROM ivf_t").collect().to_pandas()
+        exp_d1 = pdf.d + pd.Timedelta(days=nd)
+        exp_d2 = (pd.to_datetime(pdf.d) - pd.DateOffset(months=nm)).dt.date
+        exp_t1 = pdf.ts + pd.Timedelta(hours=nh)
+        assert (pd.to_datetime(got.d1) ==
+                pd.to_datetime(exp_d1)).all(), (trial, nd)
+        assert (got.d2 == exp_d2).all(), (trial, nm)
+        got_t1 = pd.to_datetime(got.t1)
+        if got_t1.dt.tz is not None:      # engine returns tz-aware UTC
+            got_t1 = got_t1.dt.tz_localize(None)
+        assert (got_t1 == exp_t1).all(), (trial, nh)
