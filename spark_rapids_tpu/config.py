@@ -359,6 +359,14 @@ SHUFFLE_MAX_BYTES_IN_FLIGHT = register(
     "spark.rapids.shuffle.maxBytesInFlight",
     "Cap on in-flight fetched shuffle bytes.", 128 << 20)
 
+SORT_RADIX = register(
+    "spark.rapids.sql.sort.radix",
+    "auto|on|off: stable LSD radix argsort (1-bit cumsum+scatter passes "
+    "— linear VPU work instead of lax.sort's bitonic O(n log^2 n) "
+    "compare-exchange network on TPU).  auto runs a one-time bake-off "
+    "per backend and keeps the winner (ops/radix_sort.py; the reference "
+    "leans on cuDF's GPU radix sort for the same reason).", "auto")
+
 # --- I/O -------------------------------------------------------------------
 PARQUET_READER_TYPE = register(
     "spark.rapids.sql.format.parquet.reader.type",

@@ -1,0 +1,163 @@
+"""Radix argsort as an XLA program — the TPU-first alternative to the
+comparator sort (`jax.lax.sort` lowers to a bitonic network on TPU,
+O(n log^2 n) compare-exchange passes; the reference leans on cuDF's GPU
+radix sort for exactly this reason, SURVEY §2.10 ``Table.sort``).
+
+Construction: classic stable LSD 1-bit splits.  Each pass is pure
+VPU-friendly vector work — bit extract, two cumsums, a select, and a
+scatter — so an int64 sort costs 64 linear passes instead of ~log^2(n)
+full-width compare-exchange stages.  Stability follows from cumsum
+preserving original order within each bit class, which also makes the
+chained multi-key form lexicographic.
+
+Whether this beats ``lax.sort`` depends on backend and size, so the
+engine decides by a one-time BAKE-OFF per backend (measure both on a
+representative input, cache the winner) rather than by assumption —
+``spark.rapids.sql.sort.radix`` = auto|on|off.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+#: conf key registered in config.py (string to avoid import cycles)
+_CONF_KEY = "spark.rapids.sql.sort.radix"
+
+#: per-(backend, n_keys) bake-off verdicts
+_BAKEOFF: dict = {}
+
+#: bake-off input size — big enough that fixed overheads don't decide,
+#: small enough to stay cheap at first use
+_PROBE_N = 1 << 18
+
+
+def _to_orderable_u64(xp, k):
+    """Integer key -> uint64 whose unsigned order equals the key's order
+    (sign-bit flip); n_bits = the key's true width so narrow dtypes pay
+    narrow passes."""
+    dt = k.dtype
+    if dt == xp.int64:
+        u = k.astype(xp.uint64) ^ (xp.uint64(1) << xp.uint64(63))
+        return u, 64
+    if dt == xp.uint64:
+        return k, 64
+    if dt in (xp.int32, xp.int16, xp.int8):
+        bits = np.dtype(str(dt)).itemsize * 8
+        u = (k.astype(xp.int64) + (1 << (bits - 1))).astype(xp.uint64)
+        return u, bits
+    if dt in (xp.uint32, xp.uint16, xp.uint8):
+        bits = np.dtype(str(dt)).itemsize * 8
+        return k.astype(xp.uint64), bits
+    if dt == xp.bool_:
+        return k.astype(xp.uint64), 1
+    return None, 0
+
+
+def _radix_pass(xp, u, perm, b):
+    bit = ((u >> xp.uint64(b)) & xp.uint64(1)).astype(xp.int32)
+    ones_before = xp.cumsum(bit)
+    zeros_before = xp.cumsum(1 - bit)
+    total0 = zeros_before[-1]
+    pos = xp.where(bit == 1, total0 + ones_before - 1, zeros_before - 1)
+    u = xp.zeros_like(u).at[pos].set(u)
+    perm = xp.zeros_like(perm).at[pos].set(perm)
+    return u, perm
+
+
+def radix_argsort(xp, keys: List, n_bits_list: Optional[List[int]] = None):
+    """Stable lexicographic argsort of integer key arrays (most-
+    significant key first) via chained LSD radix: sort by the LAST key
+    first; stability makes the chain lexicographic.  Returns perm
+    (int32).  Caller guarantees every key maps through
+    ``_to_orderable_u64``."""
+    n = keys[0].shape[0]
+    perm = xp.arange(n, dtype=xp.int32)
+    for ki in range(len(keys) - 1, -1, -1):
+        u, bits = _to_orderable_u64(xp, keys[ki])
+        if n_bits_list is not None:
+            bits = n_bits_list[ki]
+        u = u[perm]
+        for b in range(bits):
+            u, perm = _radix_pass(xp, u, perm, b)
+    return perm
+
+
+def supported_keys(xp, keys) -> bool:
+    """Radix path envelope: up to two integer/bool keys (more keys make
+    the pass count grow past the comparator sort's break-even)."""
+    if len(keys) > 2:
+        return False
+    for k in keys:
+        u, bits = _to_orderable_u64(xp, k)
+        if u is None:
+            return False
+    return True
+
+
+def radix_wins(xp, n_keys: int) -> bool:
+    """One-time bake-off per (backend, key count): time radix vs
+    lax.sort on a representative input and cache the winner.  Timing
+    includes a one-element fetch — ``block_until_ready`` does not
+    reliably wait over the TPU tunnel (docs/perf_notes.md)."""
+    import jax
+
+    from ..config import RapidsConf
+    try:
+        mode = str(RapidsConf.get_global().get(_CONF_KEY, "auto")).lower()
+    except Exception:
+        mode = "auto"
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    key = (jax.default_backend(), n_keys)
+    verdict = _BAKEOFF.get(key)
+    if verdict is not None:
+        return verdict
+    if jax.default_backend() == "cpu":
+        # measured: XLA:CPU's comparator sort beats the 64-pass radix
+        # ~3x (docs/perf_notes.md) — don't tax every process's first
+        # sort with a probe to rediscover it
+        _BAKEOFF[key] = False
+        return False
+
+    try:
+        rng = np.random.default_rng(0)
+        ks = [xp.asarray(rng.integers(-(1 << 62), 1 << 62, _PROBE_N))
+              for _ in range(n_keys)]
+
+        # probe inputs are jit ARGUMENTS, never closure constants: XLA
+        # constant-folds closed-over arrays, i.e. it would run the whole
+        # 64-pass sort in the COMPILER (minutes, and it segfaulted the
+        # CPU backend on the full suite)
+        def run_radix(*ks):
+            return radix_argsort(xp, list(ks))
+
+        def run_lax(*ks):
+            iota = xp.arange(_PROBE_N, dtype=xp.int32)
+            cols = []
+            for k in ks:
+                cols.append((k >> 32).astype(xp.int32))
+                cols.append((k & 0xFFFFFFFF).astype(xp.uint32))
+            return jax.lax.sort(tuple(cols) + (iota,),
+                                num_keys=len(cols), is_stable=True)[-1]
+
+        jit_radix = jax.jit(run_radix)
+        jit_lax = jax.jit(run_lax)
+
+        def timed(f):
+            _ = np.asarray(f(*ks)[:1])       # compile + settle
+            t0 = time.perf_counter()
+            _ = np.asarray(f(*ks)[:1])
+            return time.perf_counter() - t0
+
+        t_radix = timed(jit_radix)
+        t_lax = timed(jit_lax)
+        verdict = t_radix < t_lax * 0.9      # win by a clear margin only
+    except Exception:
+        verdict = False
+    _BAKEOFF[key] = verdict
+    return verdict

@@ -57,6 +57,11 @@ def lex_sort(xp, keys):
         perm = np.lexsort(tuple(reversed(keys)))  # lexsort: LAST key primary
         return perm, [k[perm] for k in keys]
     import jax
+
+    from .radix_sort import radix_argsort, radix_wins, supported_keys
+    if supported_keys(xp, keys) and radix_wins(xp, len(keys)):
+        perm = radix_argsort(xp, keys)
+        return perm, [k[perm] for k in keys]
     n = keys[0].shape[0]
     iota = xp.arange(n, dtype=xp.int32)
     sort_keys = []

@@ -1,0 +1,87 @@
+"""Radix argsort (ops/radix_sort.py): stable-equality with np.lexsort,
+the lex_sort integration under forced modes, and bake-off behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.ops import radix_sort
+from spark_rapids_tpu.ops.radix_sort import radix_argsort, supported_keys
+from spark_rapids_tpu.ops.ranks import lex_sort
+
+
+@pytest.mark.parametrize("case", [
+    "i64", "i32_small", "two_keys", "bools", "dupes", "empty_range"])
+def test_radix_matches_lexsort(case):
+    rng = np.random.default_rng(11)
+    n = 50_000
+    cases = {
+        "i64": [rng.integers(-2**62, 2**62, n)],
+        "i32_small": [rng.integers(-5, 5, n).astype(np.int32)],
+        "two_keys": [rng.integers(0, 40, n),
+                     rng.integers(-2**40, 2**40, n)],
+        "bools": [rng.integers(0, 2, n).astype(bool)],
+        "dupes": [np.repeat(rng.integers(-3, 3, n // 100), 100)],
+        "empty_range": [np.zeros(256, np.int64)],
+    }
+    keys_np = cases[case]
+    keys = [jnp.asarray(k) for k in keys_np]
+    perm = np.asarray(jax.jit(
+        lambda *ks: radix_argsort(jnp, list(ks)))(*keys))
+    want = np.lexsort(tuple(reversed(keys_np)))
+    assert np.array_equal(perm, want), case   # np.lexsort is stable too
+
+
+def test_supported_keys_envelope():
+    a = jnp.zeros(8, jnp.int64)
+    f = jnp.zeros(8, jnp.float64)
+    assert supported_keys(jnp, [a])
+    assert supported_keys(jnp, [a, a])
+    assert not supported_keys(jnp, [a, a, a])     # pass count blow-up
+    assert not supported_keys(jnp, [f])           # floats go via lax.sort
+
+
+def test_lex_sort_forced_radix_end_to_end():
+    """Force the radix path through lex_sort and a real window query."""
+    conf = RapidsConf.get_global()
+    old = conf.get("spark.rapids.sql.sort.radix", "auto")
+    radix_sort._BAKEOFF.clear()
+    conf.set("spark.rapids.sql.sort.radix", "on")
+    try:
+        rng = np.random.default_rng(5)
+        a = jnp.asarray(rng.integers(-1000, 1000, 20_000))
+        b = jnp.asarray(rng.integers(0, 7, 20_000))
+        perm, skeys = lex_sort(jnp, [b, a])
+        want = np.lexsort((np.asarray(a), np.asarray(b)))
+        assert np.array_equal(np.asarray(perm), want)
+
+        import pyarrow as pa
+
+        from spark_rapids_tpu.sql import functions as F
+        from spark_rapids_tpu.sql.window_api import Window
+        sess = srt.session()
+        n = 30_000
+        t = pa.table({"g": rng.integers(0, 50, n), "v": rng.random(n),
+                      "o": rng.integers(0, 10**9, n)})
+        df = sess.create_dataframe(t, num_partitions=3)
+        w = Window.partitionBy("g").orderBy("o")
+        got = (df.select(df.g, F.row_number().over(w).alias("rn"))
+               .filter(F.col("rn") <= 2).collect().to_pandas())
+        pdf = t.to_pandas().sort_values(["g", "o"]).groupby("g").head(2)
+        assert len(got) == len(pdf)
+        assert sorted(got.g.tolist()) == sorted(pdf.g.tolist())
+    finally:
+        conf.set("spark.rapids.sql.sort.radix", str(old))
+        radix_sort._BAKEOFF.clear()
+
+
+def test_bakeoff_picks_a_winner_and_caches():
+    radix_sort._BAKEOFF.clear()
+    v1 = radix_sort.radix_wins(jnp, 1)
+    assert isinstance(v1, (bool, np.bool_))
+    key = (jax.default_backend(), 1)
+    assert key in radix_sort._BAKEOFF
+    assert radix_sort.radix_wins(jnp, 1) == v1   # cached
