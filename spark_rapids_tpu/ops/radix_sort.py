@@ -56,14 +56,18 @@ def _to_orderable_u64(xp, k):
     return None, 0
 
 
-def _radix_pass(xp, u, perm, b):
+def _radix_pass(xp, u, perm, b, iota1):
     bit = ((u >> xp.uint64(b)) & xp.uint64(1)).astype(xp.int32)
     ones_before = xp.cumsum(bit)
-    zeros_before = xp.cumsum(1 - bit)
+    # zeros_before[i] == (i+1) - ones_before[i]: one scan per pass, the
+    # second is arithmetic
+    zeros_before = iota1 - ones_before
     total0 = zeros_before[-1]
     pos = xp.where(bit == 1, total0 + ones_before - 1, zeros_before - 1)
-    u = xp.zeros_like(u).at[pos].set(u)
-    perm = xp.zeros_like(perm).at[pos].set(perm)
+    # pos is a permutation by construction — tell the scatter lowering
+    scatter = dict(unique_indices=True, mode="promise_in_bounds")
+    u = xp.zeros_like(u).at[pos].set(u, **scatter)
+    perm = xp.zeros_like(perm).at[pos].set(perm, **scatter)
     return u, perm
 
 
@@ -75,26 +79,31 @@ def radix_argsort(xp, keys: List, n_bits_list: Optional[List[int]] = None):
     ``_to_orderable_u64``."""
     n = keys[0].shape[0]
     perm = xp.arange(n, dtype=xp.int32)
+    if n == 0:
+        return perm
+    iota1 = xp.arange(1, n + 1, dtype=xp.int32)
     for ki in range(len(keys) - 1, -1, -1):
         u, bits = _to_orderable_u64(xp, keys[ki])
         if n_bits_list is not None:
             bits = n_bits_list[ki]
         u = u[perm]
         for b in range(bits):
-            u, perm = _radix_pass(xp, u, perm, b)
+            u, perm = _radix_pass(xp, u, perm, b, iota1)
     return perm
+
+
+#: dtype names the radix path can order (matches _to_orderable_u64)
+_SUPPORTED_DTYPES = {"int64", "uint64", "int32", "uint32", "int16",
+                     "uint16", "int8", "uint8", "bool"}
 
 
 def supported_keys(xp, keys) -> bool:
     """Radix path envelope: up to two integer/bool keys (more keys make
-    the pass count grow past the comparator sort's break-even)."""
-    if len(keys) > 2:
+    the pass count grow past the comparator sort's break-even).  Pure
+    dtype predicate — no device work."""
+    if not keys or len(keys) > 2:
         return False
-    for k in keys:
-        u, bits = _to_orderable_u64(xp, k)
-        if u is None:
-            return False
-    return True
+    return all(str(k.dtype) in _SUPPORTED_DTYPES for k in keys)
 
 
 def radix_wins(xp, n_keys: int) -> bool:
@@ -157,7 +166,10 @@ def radix_wins(xp, n_keys: int) -> bool:
         t_radix = timed(jit_radix)
         t_lax = timed(jit_lax)
         verdict = t_radix < t_lax * 0.9      # win by a clear margin only
-    except Exception:
+    except Exception as e:
+        import warnings
+        warnings.warn(f"radix bake-off probe failed ({e!r}); keeping the "
+                      f"comparator sort on {key[0]}")
         verdict = False
     _BAKEOFF[key] = verdict
     return verdict

@@ -32,6 +32,30 @@ _LOCK = threading.Lock()
 _STATS = {"hits": 0, "misses": 0, "evictions": 0}
 
 
+def _trace_salt() -> Tuple:
+    """Global knobs that change TRACED PROGRAMS without appearing in any
+    exec's own key (the _jit contract: the key must capture everything
+    that affects the trace).  Today: the radix-sort decision — lex_sort
+    branches on it inside sort kernels, so flipping the conf or a fresh
+    bake-off verdict must not reuse comparator-sort programs."""
+    try:
+        import jax
+
+        from ...config import RapidsConf
+        from ...ops import radix_sort
+        mode = str(RapidsConf.get_global().get(
+            "spark.rapids.sql.sort.radix", "auto")).lower()
+        if mode == "auto":
+            backend = jax.default_backend()
+            verdicts = tuple(sorted(
+                (k, v) for k, v in radix_sort._BAKEOFF.items()
+                if k[0] == backend))
+            return ("radix-auto", verdicts)
+        return ("radix", mode)
+    except Exception:
+        return ()
+
+
 def cached_jit(key: Tuple, fn: Callable) -> Callable:
     """Return the process-wide jitted callable for ``key``.
 
@@ -40,6 +64,7 @@ def cached_jit(key: Tuple, fn: Callable) -> Callable:
     everything that affects the trace).  Least-recently-used entries are
     evicted past ``_MAX_ENTRIES``.
     """
+    key = key + _trace_salt()
     with _LOCK:
         cached = _CACHE.get(key)
         if cached is not None:
