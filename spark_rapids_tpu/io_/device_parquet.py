@@ -393,6 +393,78 @@ def _expand_runs_u32(words, out_start, src_bit, width, rle_val, out_cap):
     return jnp.where(w == 0, rle_val[r].astype(jnp.uint32), raw & mask)
 
 
+@partial(jax.jit, static_argnames=("out_cap", "width"))
+def _expand_flba(words, out_start, src_bit, out_cap, width):
+    """FIXED_LEN_BYTE_ARRAY expansion: each value is `width` big-endian
+    two's-complement bytes (parquet decimal storage) -> sign-extended
+    (lo, hi) uint64 words.  Static byte loop (width <= 16)."""
+    idx = jnp.arange(out_cap, dtype=jnp.int32)
+    r = jnp.clip(jnp.searchsorted(out_start, idx, side="right") - 1,
+                 0, out_start.shape[0] - 1)
+    local = (idx - out_start[r]).astype(jnp.int64)
+    base = src_bit[r] + local * (width * 8)
+    lo = jnp.zeros(out_cap, jnp.uint64)
+    hi = jnp.zeros(out_cap, jnp.uint64)
+    first_byte = None
+    for k in range(width):
+        bitpos = base + k * 8
+        w0 = jnp.clip((bitpos >> 5).astype(jnp.int32), 0,
+                      words.shape[0] - 2)
+        sh = (bitpos & 31).astype(jnp.uint32)
+        b = ((words[w0] >> sh)
+             | jnp.where(sh == 0, jnp.uint32(0),
+                         words[w0 + 1] << (jnp.uint32(32) - sh))
+             ) & jnp.uint32(0xFF)
+        if k == 0:
+            first_byte = b
+        b64 = b.astype(jnp.uint64)
+        pos = (width - 1 - k) * 8
+        if pos < 64:
+            lo = lo | (b64 << jnp.uint64(pos))
+        else:
+            hi = hi | (b64 << jnp.uint64(pos - 64))
+    neg = (first_byte & jnp.uint32(0x80)) != 0
+    if width < 8:
+        fill_lo = jnp.uint64((~((1 << (width * 8)) - 1)) & ((1 << 64) - 1))
+        lo = jnp.where(neg, lo | fill_lo, lo)
+        hi = jnp.where(neg, jnp.uint64((1 << 64) - 1), hi)
+    elif width == 8:
+        hi = jnp.where(neg, jnp.uint64((1 << 64) - 1), hi)
+    elif width < 16:
+        fill_hi = jnp.uint64(
+            (~((1 << ((width - 8) * 8)) - 1)) & ((1 << 64) - 1))
+        hi = jnp.where(neg, hi | fill_hi, hi)
+    return lo, hi
+
+
+def _flba_bytes_to_words(entries, width: int):
+    """Host: sequence of `width`-byte big-endian values -> (lo, hi) int64
+    numpy arrays (used for small dictionary pages only)."""
+    n = len(entries)
+    if n == 0:
+        return np.zeros(1, np.int64), np.zeros(1, np.int64)
+    raw = np.frombuffer(b"".join(entries), np.uint8).reshape(n, width)
+    lo = np.zeros(n, np.uint64)
+    hi = np.zeros(n, np.uint64)
+    for k in range(width):
+        b = raw[:, k].astype(np.uint64)
+        pos = (width - 1 - k) * 8
+        if pos < 64:
+            lo |= b << np.uint64(pos)
+        else:
+            hi |= b << np.uint64(pos - 64)
+    neg = raw[:, 0] >= 128
+    if width < 8:
+        lo[neg] |= np.uint64((~((1 << (width * 8)) - 1)) & ((1 << 64) - 1))
+        hi[neg] = np.uint64((1 << 64) - 1)
+    elif width == 8:
+        hi[neg] = np.uint64((1 << 64) - 1)
+    elif width < 16:
+        hi[neg] |= np.uint64(
+            (~((1 << ((width - 8) * 8)) - 1)) & ((1 << 64) - 1))
+    return lo.view(np.int64), hi.view(np.int64)
+
+
 @partial(jax.jit, static_argnames=("out_cap",))
 def _expand_runs_u64(words, out_start, src_bit, out_cap):
     """64-bit PLAIN expansion: each value is assembled from two 32-bit
@@ -509,13 +581,18 @@ def _plain_dict_strings(data: bytes, n: int) -> Tuple[np.ndarray, np.ndarray]:
     return _strings_matrix(vals, lens)
 
 
-def _plan_chunk(raw: bytes, cc, phys: str, nullable: bool) -> _ChunkPlan:
+def _plan_chunk(raw: bytes, cc, phys: str, nullable: bool,
+                type_length: int = 0) -> _ChunkPlan:
     """Parse one column chunk's pages into a decode plan.  Raises
     ``_Unsupported`` for anything outside the device-decode envelope."""
     codec = _CODECS.get(cc.compression, "?")
     if codec == "?":
         raise _Unsupported(f"codec {cc.compression}")
     itembits = _PHYS_ITEMBITS.get(phys)
+    if phys == "FIXED_LEN_BYTE_ARRAY":
+        if not 0 < type_length <= 16:
+            raise _Unsupported(f"FLBA width {type_length}")
+        itembits = type_length * 8
     if itembits is None and phys != "BYTE_ARRAY":
         raise _Unsupported(f"physical type {phys}")
     plan = _ChunkPlan(nullable=nullable)
@@ -540,6 +617,11 @@ def _plan_chunk(raw: bytes, cc, phys: str, nullable: bool) -> _ChunkPlan:
             data = _decompress(codec, body, h.uncompressed_size)
             if phys == "BYTE_ARRAY":
                 plan.dict_strings = _plain_dict_strings(data, h.num_values)
+            elif phys == "FIXED_LEN_BYTE_ARRAY":
+                W = type_length
+                plan.dict_values = np.asarray(
+                    [data[i * W:(i + 1) * W]
+                     for i in range(h.num_values)], dtype=object)
             else:
                 plan.dict_values = _plain_dict_values(phys, data,
                                                       h.num_values)
@@ -661,7 +743,8 @@ def _unify_dictionaries(plans: List[_ChunkPlan], phys: str,
                 [mat[i, :lens[i]].tobytes() for i in range(len(lens))],
                 dtype=object))
     else:
-        np_t = _PHYS_NP[phys]
+        np_t = (object if phys == "FIXED_LEN_BYTE_ARRAY"
+                else _PHYS_NP[phys])
         for p in plans:
             if p.dict_values is None:
                 if p.total_nonnull:
@@ -767,9 +850,24 @@ def _finish(v, phys: str, dtype, arrow_type):
     return v.astype(dtype.np_dtype)
 
 
+def _finish_decimal_words(lo, hi, valid, dtype, n_rows: int,
+                          capacity: int):
+    """(lo, hi) sign-extended int64 words -> the engine's decimal column
+    layout: scaled int64 ``data`` for precision <= 18, else lo in ``data``
+    and hi in ``aux`` (Aggregation128Utils-equivalent layout,
+    columnar/column.py)."""
+    from ..columnar.column import DeviceColumn
+    data, v = _scatter_nonnull(lo, valid, jnp.int32(n_rows), capacity)
+    if dtype.is_long_backed:
+        return DeviceColumn(dtype, data, v)
+    aux, _ = _scatter_nonnull(hi, valid, jnp.int32(n_rows), capacity)
+    return DeviceColumn(dtype, data, v, aux=aux)
+
+
 def _decode_column_device(plan: _ChunkPlan, phys: str, dtype, arrow_type,
                           capacity: int, n_rows: int,
-                          max_str_bytes: int = 1 << 62):
+                          max_str_bytes: int = 1 << 62,
+                          type_length: int = 0):
     """Run the device programs for one merged chunk plan -> DeviceColumn."""
     from ..columnar.column import DeviceColumn
 
@@ -816,12 +914,25 @@ def _decode_column_device(plan: _ChunkPlan, phys: str, dtype, arrow_type,
             lengths, _ = _scatter_nonnull(dlen[idx], valid,
                                           jnp.int32(n_rows), capacity)
             return DeviceColumn(dtype, data, v, lengths=lengths)
+        if phys == "FIXED_LEN_BYTE_ARRAY":
+            # decimal dictionary: host-decoded (lo, hi) words, two gathers
+            entries = plan.dict_values if plan.dict_values is not None \
+                else np.empty(0, object)
+            lo_np, hi_np = _flba_bytes_to_words(list(entries), type_length)
+            dlo, dhi = jnp.asarray(lo_np), jnp.asarray(hi_np)
+            idx = jnp.clip(idx, 0, dlo.shape[0] - 1)
+            return _finish_decimal_words(dlo[idx], dhi[idx], valid, dtype,
+                                         n_rows, capacity)
         dvals = plan.dict_values
         if dvals is None or not len(dvals):
             dvals = np.zeros(1, _PHYS_NP[phys])
         darr = jnp.asarray(dvals)
         idx = jnp.clip(idx, 0, darr.shape[0] - 1)
         dense = _finish(darr[idx], phys, dtype, arrow_type)
+    elif phys == "FIXED_LEN_BYTE_ARRAY":
+        lo_u, hi_u = _expand_flba(words, v_os, v_sb, nn_cap, type_length)
+        return _finish_decimal_words(_u64_to_i64(lo_u), _u64_to_i64(hi_u),
+                                     valid, dtype, n_rows, capacity)
     elif phys == "INT64":
         raw = _expand_runs_u64(words, v_os, v_sb, nn_cap)
         dense = _finish(_u64_to_i64(raw), phys, dtype, arrow_type)
@@ -858,8 +969,8 @@ def _dtype_supported(dtype, arrow_type) -> bool:
     if isinstance(dtype, (T.ArrayType, T.MapType, T.StructType, T.NullType,
                           T.BinaryType)):
         return False
-    if isinstance(dtype, T.DecimalType) and not dtype.is_long_backed:
-        return False
+    # decimals of every precision are in the envelope: INT32/INT64 backed
+    # directly, FIXED_LEN_BYTE_ARRAY via the (lo, hi) word kernels
     if pa.types.is_timestamp(arrow_type) and arrow_type.unit not in (
             "us", "ms"):
         # ns -> us is lossy; the host path's safe cast raises — keep one
@@ -951,11 +1062,19 @@ def decode_file(path: str, row_groups: Optional[Sequence[int]] = None,
             try:
                 plans = []
                 phys = None
+                type_length = int(getattr(md.schema.column(li), "length",
+                                          0) or 0)
                 for rg in rgs:
                     cc = md.row_group(rg).column(li)
                     phys = cc.physical_type
                     if cc.file_path:
                         raise _Unsupported("external chunk file")
+                    if phys == "BYTE_ARRAY" and \
+                            isinstance(dtype, T.DecimalType):
+                        # legacy writers annotate variable-length
+                        # BYTE_ARRAY as decimal — that shape is host-only
+                        # (the string-dictionary kernel would mislabel it)
+                        raise _Unsupported("BYTE_ARRAY decimal")
                     _precheck_chunk_meta(cc)
                     # offset 0 can never be a real page (files start with
                     # the PAR1 magic) — some writers emit 0 for "absent"
@@ -964,11 +1083,12 @@ def decode_file(path: str, row_groups: Optional[Sequence[int]] = None,
                             if o is not None and o > 0]
                     fobj.seek(min(offs))
                     raw = fobj.read(cc.total_compressed_size)
-                    plans.append(_plan_chunk(raw, cc, phys, fld.nullable))
+                    plans.append(_plan_chunk(raw, cc, phys, fld.nullable,
+                                             type_length))
                 merged = _merge_plans(plans, phys)
                 device_cols[fi] = _decode_column_device(
                     merged, phys, dtype, fld.type, capacity, n_rows,
-                    max_str_bytes)
+                    max_str_bytes, type_length)
                 if tctx is not None:
                     tctx.inc_metric("parquetDeviceDecodedColumns")
             except _Unsupported:

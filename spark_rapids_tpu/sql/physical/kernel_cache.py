@@ -37,20 +37,22 @@ def _trace_salt() -> Tuple:
     exec's own key (the _jit contract: the key must capture everything
     that affects the trace).  Today: the radix-sort decision — lex_sort
     branches on it inside sort kernels, so flipping the conf or a fresh
-    bake-off verdict must not reuse comparator-sort programs."""
+    bake-off verdict must not reuse comparator-sort programs.
+
+    The bake-off verdicts are RESOLVED HERE (radix_wins probes and caches
+    on first call) so the salt is stable from the first cached_jit on —
+    a verdict landing mid-session would otherwise flip the salt and
+    invalidate the whole kernel cache.  Reading specific verdicts instead
+    of iterating the dict also sidesteps the mutation race."""
     try:
-        import jax
+        import jax.numpy as jnp
 
         from ...config import RapidsConf
-        from ...ops import radix_sort
+        from ...ops.radix_sort import radix_wins
         mode = str(RapidsConf.get_global().get(
             "spark.rapids.sql.sort.radix", "auto")).lower()
         if mode == "auto":
-            backend = jax.default_backend()
-            verdicts = tuple(sorted(
-                (k, v) for k, v in radix_sort._BAKEOFF.items()
-                if k[0] == backend))
-            return ("radix-auto", verdicts)
+            return ("radix-auto", radix_wins(jnp, 1), radix_wins(jnp, 2))
         return ("radix", mode)
     except Exception:
         return ()

@@ -187,28 +187,63 @@ def test_row_group_subset(tmp_path):
         assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
 
 
-def test_decimal_columns(tmp_path):
+@pytest.mark.parametrize("storage", ["integer", "flba"])
+def test_decimal_columns(tmp_path, storage):
+    """Decimals decode on device in BOTH parquet storages: INT32/INT64
+    (store_decimal_as_integer) and the default FIXED_LEN_BYTE_ARRAY
+    big-endian two's complement, incl. precision > 18 into the engine's
+    (lo=data, hi=aux) 128-bit layout."""
     import decimal
     rng = _rng(3)
     vals = [decimal.Decimal(int(v)).scaleb(-2)
             for v in rng.integers(-10**9, 10**9, 800)]
-    t = pa.table({
+    vals = [None if i % 13 == 0 else v for i, v in enumerate(vals)]
+    big = [None if v is None else v * (10 ** 12) for v in vals]
+    cols = {
         "d9": pa.array(vals, pa.decimal128(9, 2)),
         "d18": pa.array(vals, pa.decimal128(18, 2)),
-    })
-    path = str(tmp_path / "d.parquet")
-    # INT32/INT64-backed decimals are in the device envelope; the default
-    # FIXED_LEN_BYTE_ARRAY storage falls back to host (also covered below)
-    pq.write_table(t, path, store_decimal_as_integer=True)
-    batch = decode_file(path)
+    }
+    if storage == "flba":
+        cols["d30"] = pa.array(big, pa.decimal128(30, 2))
+        cols["dneg"] = pa.array(
+            [None if v is None else -v for v in big],
+            pa.decimal128(30, 2))
+    t = pa.table(cols)
+    path = str(tmp_path / f"d_{storage}.parquet")
+    pq.write_table(t, path,
+                   store_decimal_as_integer=(storage == "integer"))
+
+    class Ctx:
+        metrics = {}
+
+        def inc_metric(self, k, v=1):
+            self.metrics[k] = self.metrics.get(k, 0) + v
+
+    ctx = Ctx()
+    batch = decode_file(path, tctx=ctx)
+    assert batch is not None
+    assert ctx.metrics.get("parquetDeviceDecodedColumns", 0) == len(cols)
     got = device_to_arrow(batch)
     want = device_to_arrow(arrow_to_device(pq.read_table(path)))
     for c in want.schema.names:
         assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
-    # default FLBA-backed decimals: whole file declines the device path
-    path2 = str(tmp_path / "d2.parquet")
-    pq.write_table(t, path2)
-    assert decode_file(path2) is None
+
+
+def test_decimal_flba_plain_pages(tmp_path):
+    """PLAIN (non-dictionary) FLBA decimals exercise the byte-expansion
+    kernel rather than the dictionary gather."""
+    import decimal
+    rng = _rng(9)
+    vals = [decimal.Decimal(int(v)) * decimal.Decimal("0.001")
+            for v in rng.integers(-10**15, 10**15, 600)]
+    t = pa.table({"x": pa.array(vals, pa.decimal128(25, 3))})
+    path = str(tmp_path / "dp.parquet")
+    pq.write_table(t, path, use_dictionary=False)
+    batch = decode_file(path)
+    assert batch is not None
+    got = device_to_arrow(batch)
+    want = device_to_arrow(arrow_to_device(pq.read_table(path)))
+    assert got.column("x").to_pylist() == want.column("x").to_pylist()
 
 
 def test_nested_column_falls_back_per_column(tmp_path):
