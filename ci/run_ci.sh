@@ -30,7 +30,15 @@ if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
 else
-    python -m pytest tests/ -q
+    # SHARDED into separate processes: one process compiling the whole
+    # suite exhausts the XLA:CPU JIT code region and segfaults inside
+    # backend_compile_and_load at ~500 tests (per-module cache release in
+    # conftest delays but does not prevent it — round-4 postmortem after
+    # two identical crashes at the same cumulative-compile point)
+    python -m pytest tests/test_[a-e]*.py -q
+    python -m pytest tests/test_[f-n]*.py -q
+    python -m pytest tests/test_[o-r]*.py -q
+    python -m pytest tests/test_[s-z]*.py -q
 fi
 
 if [ "$MODE" != quick ]; then
