@@ -35,10 +35,14 @@ else
     # backend_compile_and_load at ~500 tests (per-module cache release in
     # conftest delays but does not prevent it — round-4 postmortem after
     # two identical crashes at the same cumulative-compile point)
-    python -m pytest tests/test_[a-e]*.py -q
-    python -m pytest tests/test_[f-n]*.py -q
-    python -m pytest tests/test_[o-r]*.py -q
-    python -m pytest tests/test_[s-z]*.py -q
+    # run EVERY shard even when one fails (set -e would stop at the
+    # first, hiding failures in the remaining three quarters)
+    rc=0
+    python -m pytest tests/test_[a-e]*.py -q || rc=1
+    python -m pytest tests/test_[f-n]*.py -q || rc=1
+    python -m pytest tests/test_[o-r]*.py -q || rc=1
+    python -m pytest tests/test_[s-z]*.py -q || rc=1
+    [ "$rc" -eq 0 ]
 fi
 
 if [ "$MODE" != quick ]; then

@@ -53,6 +53,10 @@ class IcebergTable:
         self._session = session
         self.path = path
         self.meta = meta or read_table_metadata(path)
+        #: (file_path, schema_id) -> bool: footer-vs-schema match verdicts
+        #: so a wide deletes-free table doesn't re-read every footer per
+        #: query (files are immutable; schema changes change the key)
+        self._schema_match_cache: Dict[Tuple[str, int], bool] = {}
 
     # ------------------------------------------------------------------
     # creation / loading
@@ -606,11 +610,69 @@ class IcebergTable:
                 self._prune_files(self._live_data_files(snap), filters,
                                   schema)]
 
+    def _trivial_scan_paths(self, filters, snapshot_id,
+                            as_of_timestamp_ms):
+        """When a scan needs NO host-side rewriting — no position or
+        equality deletes, every file's columns match the snapshot schema
+        by NAME, arrow type AND Iceberg field id — the read can ride
+        FileScanExec and its device parquet decode
+        (io_/device_parquet.py) instead of the host assembly path.
+        Field ids matter: drop+re-add of a same-named column allocates a
+        fresh id, and the old file's stale values must null-fill (the
+        host path resolves by id), not pass through.  Returns the file
+        paths, or None."""
+        snap, schema_id = self._select_snapshot(snapshot_id,
+                                                as_of_timestamp_ms)
+        if snap is None:
+            return None
+        schema = self.meta.schema(schema_id)
+        data_files, pos_files, eq_files = self._snapshot_files(snap)
+        if pos_files or eq_files:
+            return None
+        files = self._prune_files(data_files, filters, schema)
+        if not files:
+            return None
+        want = [(f.name, f.field_id,
+                 T.to_arrow(ice_to_type_cached(f.type_str)))
+                for f in schema.fields]
+        paths = []
+        for df in files:
+            full = os.path.join(self.path, df.file_path)
+            verdict = self._schema_match_cache.get(
+                (df.file_path, schema_id))
+            if verdict is None:
+                try:
+                    fs = pq.read_schema(full)
+                except OSError:
+                    return None
+                got = []
+                for af in fs:
+                    meta = af.metadata or {}
+                    fid = (int(meta[_FIELD_ID_KEY])
+                           if _FIELD_ID_KEY in meta else None)
+                    got.append((af.name, fid, af.type))
+                # files without embedded ids resolve by name (Iceberg
+                # name-mapping) — exactly what read.parquet does too
+                verdict = all(
+                    g[0] == w[0] and g[2] == w[2]
+                    and (g[1] is None or g[1] == w[1])
+                    for g, w in zip(got, want)) and len(got) == len(want)
+                self._schema_match_cache[(df.file_path, schema_id)] = \
+                    verdict
+            if not verdict:
+                return None
+            paths.append(full)
+        return paths
+
     def to_df(self, filters: Sequence[Tuple[str, str, Any]] = (),
               snapshot_id: Optional[int] = None,
               as_of_timestamp_ms: Optional[int] = None):
         """DataFrame over the scan: partitions = data files, so the engine
         parallelizes per-file like FileScanExec."""
+        trivial = self._trivial_scan_paths(filters, snapshot_id,
+                                           as_of_timestamp_ms)
+        if trivial is not None:
+            return self._session.read.parquet(*trivial)
         parts = self.scan(filters, snapshot_id, as_of_timestamp_ms)
         if not parts:
             _snap, schema_id = self._select_snapshot(snapshot_id,

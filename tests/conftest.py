@@ -47,21 +47,24 @@ def pytest_collection_modifyitems(config, items):
                         else pytest.mark.slow)
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _release_compiled_programs():
-    """Free XLA executables between test modules.
-
-    A full-suite run compiles thousands of programs into one process;
-    past ~90% of the suite the XLA:CPU JIT segfaulted inside
-    backend_compile_and_load (reproduced twice, never in any module run
-    alone — accumulated compiled-code state, not a specific test).
-    Dropping the engine's kernel wrappers AND jax's executable caches per
-    module keeps the compiler's footprint bounded; modules recompile
-    their shared kernels, which is noise next to the crash it prevents."""
-    yield
+def release_compiled_caches():
+    """The ONE recipe for freeing XLA executables (used per-module here
+    and per-query in test_scale): the engine's kernel wrappers AND jax's
+    executable caches — accumulated compiled-code state segfaults the
+    XLA:CPU JIT inside backend_compile_and_load past a few hundred
+    programs (reproduced repeatedly, never in isolation)."""
     from spark_rapids_tpu.sql.physical import kernel_cache
     kernel_cache.clear_cache()
     jax.clear_caches()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs():
+    """Free XLA executables between test modules (see
+    release_compiled_caches); modules recompile their shared kernels,
+    which is noise next to the crash it prevents."""
+    yield
+    release_compiled_caches()
 
 
 @pytest.fixture(scope="session")

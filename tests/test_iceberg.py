@@ -302,3 +302,23 @@ def test_normalize_data_path_remote_schemes():
         "gs://b/x/data/f.parquet", root) == "data/f.parquet"
     with pytest.raises(ValueError, match="unsupported"):
         normalize_data_path("s3://bkt/elsewhere/f.parquet", root)
+
+
+def test_trivial_scan_rides_device_decode(sess, tmp_path):
+    """A deletes-free, evolution-free scan routes through FileScanExec
+    and its device parquet decode (table._trivial_scan_paths) instead of
+    the host assembly path — and still matches it exactly."""
+    t = IcebergTable.create(sess, str(tmp_path / "t"), SCHEMA)
+    t.append(make_batch(0, 4000))
+    t.append(make_batch(4000, 8000, tag="b"))
+    assert t._trivial_scan_paths((), None, None) is not None
+    got = t.to_df().orderBy("id").collect()
+    m = sess.last_query_metrics
+    assert m.get("parquetDeviceDecodedColumns", 0) > 0, m
+    assert got["id"].to_pylist() == list(range(8000))
+
+    # a position delete flips the scan back to the host assembly path
+    t.delete_where(("id", "=", 7))
+    assert t._trivial_scan_paths((), None, None) is None
+    after = t.to_df().collect()
+    assert after.num_rows == 7999

@@ -31,11 +31,8 @@ def _release_per_query():
     instead — each plan recompiles anyway, so only truly shared kernels
     (transitions, serializers) pay again."""
     yield
-    import jax
-
-    from spark_rapids_tpu.sql.physical import kernel_cache
-    kernel_cache.clear_cache()
-    jax.clear_caches()
+    from conftest import release_compiled_caches
+    release_compiled_caches()
 
 
 @pytest.mark.parametrize("name", [n for n, _ in QUERIES])
