@@ -19,14 +19,15 @@ representative input, cache the winner) rather than by assumption —
 from __future__ import annotations
 
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 #: conf key registered in config.py (string to avoid import cycles)
 _CONF_KEY = "spark.rapids.sql.sort.radix"
 
-#: per-(backend, n_keys) bake-off verdicts
+#: backend -> (radix_us_for_64_passes, lax_us) frozen base measurement,
+#: or None (CPU / failed probe: comparator sort)
 _BAKEOFF: dict = {}
 
 #: bake-off input size — big enough that fixed overheads don't decide,
@@ -92,27 +93,93 @@ def radix_argsort(xp, keys: List, n_bits_list: Optional[List[int]] = None):
     return perm
 
 
-#: dtype names the radix path can order (matches _to_orderable_u64)
-_SUPPORTED_DTYPES = {"int64", "uint64", "int32", "uint32", "int16",
-                     "uint16", "int8", "uint8", "bool"}
+#: dtype name -> radix pass count (bit width); matches _to_orderable_u64
+_DTYPE_BITS = {"int64": 64, "uint64": 64, "int32": 32, "uint32": 32,
+               "int16": 16, "uint16": 16, "int8": 8, "uint8": 8,
+               "bool": 1}
+
+#: pass budget: beyond this the linear passes lose to the comparator
+#: sort regardless of backend (three full int64 keys = 192)
+_MAX_PASSES = 160
+
+
+def total_passes(keys) -> Optional[int]:
+    """Total radix passes for a key list, or None when any dtype is
+    outside the envelope.  Pure dtype predicate — no device work."""
+    bits = 0
+    for k in keys:
+        b = _DTYPE_BITS.get(str(k.dtype))
+        if b is None:
+            return None
+        bits += b
+    return bits
 
 
 def supported_keys(xp, keys) -> bool:
-    """Radix path envelope: up to two integer/bool keys (more keys make
-    the pass count grow past the comparator sort's break-even).  Pure
-    dtype predicate — no device work."""
-    if not keys or len(keys) > 2:
+    if not keys:
         return False
-    return all(str(k.dtype) in _SUPPORTED_DTYPES for k in keys)
+    p = total_passes(keys)
+    return p is not None and p <= _MAX_PASSES
 
 
-def radix_wins(xp, n_keys: int) -> bool:
-    """One-time bake-off per (backend, key count): time radix vs
-    lax.sort on a representative input and cache the winner.  Timing
+def bakeoff_base(xp) -> Optional[Tuple[int, int]]:
+    """ONE frozen measurement per backend: (radix microseconds for a
+    64-pass sort, lax.sort microseconds) at _PROBE_N.  Every pass-count
+    verdict derives from it linearly, so the kernel-cache trace salt
+    stays a single stable value.  None on CPU (measured: the comparator
+    sort wins ~3x there — no probe tax) and on probe failure.  Timing
     includes a one-element fetch — ``block_until_ready`` does not
     reliably wait over the TPU tunnel (docs/perf_notes.md)."""
     import jax
+    backend = jax.default_backend()
+    if backend in _BAKEOFF:
+        return _BAKEOFF[backend]
+    if backend == "cpu":
+        _BAKEOFF[backend] = None
+        return None
+    try:
+        rng = np.random.default_rng(0)
+        k = xp.asarray(rng.integers(-(1 << 62), 1 << 62, _PROBE_N))
 
+        # probe inputs are jit ARGUMENTS, never closure constants: XLA
+        # constant-folds closed-over arrays, i.e. it would run the whole
+        # 64-pass sort in the COMPILER (minutes, and it segfaulted the
+        # CPU backend on the full suite)
+        def run_radix(k):
+            return radix_argsort(xp, [k])
+
+        def run_lax(k):
+            iota = xp.arange(_PROBE_N, dtype=xp.int32)
+            cols = ((k >> 32).astype(xp.int32),
+                    (k & 0xFFFFFFFF).astype(xp.uint32))
+            return jax.lax.sort(cols + (iota,), num_keys=2,
+                                is_stable=True)[-1]
+
+        jit_radix = jax.jit(run_radix)
+        jit_lax = jax.jit(run_lax)
+
+        def timed(f):
+            _ = np.asarray(f(k)[:1])         # compile + settle
+            t0 = time.perf_counter()
+            _ = np.asarray(f(k)[:1])
+            return time.perf_counter() - t0
+
+        base = (max(int(timed(jit_radix) * 1e6), 1),
+                max(int(timed(jit_lax) * 1e6), 1))
+    except Exception as e:
+        import warnings
+        warnings.warn(f"radix bake-off probe failed ({e!r}); keeping the "
+                      f"comparator sort on {backend}")
+        base = None
+    _BAKEOFF[backend] = base
+    return base
+
+
+def radix_wins(xp, passes: int) -> bool:
+    """Derive the verdict for a total pass count from the frozen base
+    measurement: per-pass cost scales linearly; the lax.sort baseline is
+    held constant across key widths (slightly optimistic for it — the
+    0.9 win margin absorbs the slop)."""
     from ..config import RapidsConf
     try:
         mode = str(RapidsConf.get_global().get(_CONF_KEY, "auto")).lower()
@@ -122,54 +189,8 @@ def radix_wins(xp, n_keys: int) -> bool:
         return True
     if mode == "off":
         return False
-    key = (jax.default_backend(), n_keys)
-    verdict = _BAKEOFF.get(key)
-    if verdict is not None:
-        return verdict
-    if jax.default_backend() == "cpu":
-        # measured: XLA:CPU's comparator sort beats the 64-pass radix
-        # ~3x (docs/perf_notes.md) — don't tax every process's first
-        # sort with a probe to rediscover it
-        _BAKEOFF[key] = False
+    base = bakeoff_base(xp)
+    if base is None:
         return False
-
-    try:
-        rng = np.random.default_rng(0)
-        ks = [xp.asarray(rng.integers(-(1 << 62), 1 << 62, _PROBE_N))
-              for _ in range(n_keys)]
-
-        # probe inputs are jit ARGUMENTS, never closure constants: XLA
-        # constant-folds closed-over arrays, i.e. it would run the whole
-        # 64-pass sort in the COMPILER (minutes, and it segfaulted the
-        # CPU backend on the full suite)
-        def run_radix(*ks):
-            return radix_argsort(xp, list(ks))
-
-        def run_lax(*ks):
-            iota = xp.arange(_PROBE_N, dtype=xp.int32)
-            cols = []
-            for k in ks:
-                cols.append((k >> 32).astype(xp.int32))
-                cols.append((k & 0xFFFFFFFF).astype(xp.uint32))
-            return jax.lax.sort(tuple(cols) + (iota,),
-                                num_keys=len(cols), is_stable=True)[-1]
-
-        jit_radix = jax.jit(run_radix)
-        jit_lax = jax.jit(run_lax)
-
-        def timed(f):
-            _ = np.asarray(f(*ks)[:1])       # compile + settle
-            t0 = time.perf_counter()
-            _ = np.asarray(f(*ks)[:1])
-            return time.perf_counter() - t0
-
-        t_radix = timed(jit_radix)
-        t_lax = timed(jit_lax)
-        verdict = t_radix < t_lax * 0.9      # win by a clear margin only
-    except Exception as e:
-        import warnings
-        warnings.warn(f"radix bake-off probe failed ({e!r}); keeping the "
-                      f"comparator sort on {key[0]}")
-        verdict = False
-    _BAKEOFF[key] = verdict
-    return verdict
+    t_radix64, t_lax = base
+    return (t_radix64 / 64.0) * passes < t_lax * 0.9

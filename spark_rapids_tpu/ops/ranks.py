@@ -58,8 +58,12 @@ def lex_sort(xp, keys):
         return perm, [k[perm] for k in keys]
     import jax
 
-    from .radix_sort import radix_argsort, radix_wins, supported_keys
-    if supported_keys(xp, keys) and radix_wins(xp, len(keys)):
+    from .radix_sort import (_MAX_PASSES, radix_argsort, radix_wins,
+                             total_passes)
+    passes = total_passes(keys)
+    # the pass budget binds in EVERY mode: mode=on must not unroll a
+    # 300-pass program for a wide string sort (compile-time blowup)
+    if passes is not None and passes <= _MAX_PASSES             and radix_wins(xp, passes):
         perm = radix_argsort(xp, keys)
         return perm, [k[perm] for k in keys]
     n = keys[0].shape[0]
@@ -203,7 +207,7 @@ def column_sort_keys(xp, col: DeviceColumn):
     if isinstance(col.dtype, T.StructType):
         keys = []
         for ch in col.children:
-            keys.append(ch.validity.astype(xp.int64))
+            keys.append(ch.validity)   # bool: one radix pass, not 64
             keys.extend(column_sort_keys(xp, ch))
         return keys
     if col.lengths is not None:
@@ -219,12 +223,13 @@ def dense_rank_columns(xp, cols, num_rows_mask=None):
     with live groups (callers still mask them out)."""
     keys = []
     if num_rows_mask is not None:
-        keys.append((~num_rows_mask).astype(xp.int64))
-    for c in cols:
-        keys.append((~c.validity).astype(xp.int64))
+        keys.append(~num_rows_mask)            # bool flags stay narrow:
+    for c in cols:                             # one radix pass, not 64
+        keys.append(~c.validity)
         keys.extend(column_sort_keys(xp, c))
     if len(keys) == 1 and num_rows_mask is not None:
-        # no key columns: mask is the only key (0 live / 1 dead)
-        return keys[0]
+        # no key columns: mask is the only key (0 live / 1 dead); callers
+        # expect int64 ranks, not the raw bool flag
+        return keys[0].astype(xp.int64)
     perm, sorted_keys = lex_sort(xp, keys)
     return _ranks_from_lex(xp, perm, sorted_keys)

@@ -22,9 +22,11 @@ def sort_permutation(xp, specs: Sequence[Tuple[DeviceColumn, bool, bool]],
     """specs: [(column, ascending, nulls_first), ...] in sort-priority order
     (most significant first).  row_mask: bool[capacity] live-row mask.
     Returns int32 permutation putting rows in order, dead rows last."""
-    keys = [(~row_mask).astype(xp.int64)]  # dead rows last, most significant
+    # flags stay NARROW (bool / int8): under the radix sort path each
+    # key costs one pass per bit, so a 0/1 flag must not be an int64
+    keys = [~row_mask]                     # dead rows last, most significant
     for col, asc, nulls_first in specs:
-        null_flag = (~col.validity).astype(xp.int64)
+        null_flag = (~col.validity).astype(xp.int8)
         keys.append(-null_flag if nulls_first else null_flag)
         for k in column_sort_keys(xp, col):  # most-significant first
             keys.append(k if asc else ~k)

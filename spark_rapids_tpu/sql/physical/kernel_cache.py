@@ -39,20 +39,21 @@ def _trace_salt() -> Tuple:
     branches on it inside sort kernels, so flipping the conf or a fresh
     bake-off verdict must not reuse comparator-sort programs.
 
-    The bake-off verdicts are RESOLVED HERE (radix_wins probes and caches
-    on first call) so the salt is stable from the first cached_jit on —
-    a verdict landing mid-session would otherwise flip the salt and
-    invalidate the whole kernel cache.  Reading specific verdicts instead
-    of iterating the dict also sidesteps the mutation race."""
+    The frozen bake-off base measurement is RESOLVED HERE (bakeoff_base
+    probes once per backend) so the salt is stable from the first
+    cached_jit on — a measurement landing mid-session would otherwise
+    flip the salt and invalidate the whole kernel cache.  All pass-count
+    verdicts derive deterministically from that one base."""
     try:
         import jax.numpy as jnp
 
         from ...config import RapidsConf
-        from ...ops.radix_sort import radix_wins
+
         mode = str(RapidsConf.get_global().get(
             "spark.rapids.sql.sort.radix", "auto")).lower()
         if mode == "auto":
-            return ("radix-auto", radix_wins(jnp, 1), radix_wins(jnp, 2))
+            from ...ops.radix_sort import bakeoff_base
+            return ("radix-auto", bakeoff_base(jnp))
         return ("radix", mode)
     except Exception:
         return ()
