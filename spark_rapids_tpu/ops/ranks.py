@@ -63,7 +63,8 @@ def lex_sort(xp, keys):
     passes = total_passes(keys)
     # the pass budget binds in EVERY mode: mode=on must not unroll a
     # 300-pass program for a wide string sort (compile-time blowup)
-    if passes is not None and passes <= _MAX_PASSES             and radix_wins(xp, passes):
+    if (passes is not None and passes <= _MAX_PASSES
+            and radix_wins(xp, passes)):
         perm = radix_argsort(xp, keys)
         return perm, [k[perm] for k in keys]
     n = keys[0].shape[0]

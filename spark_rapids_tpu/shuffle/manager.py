@@ -113,6 +113,10 @@ class ShuffleManager:
         #: serialize for DCN peers.
         from ..config import SHUFFLE_DEVICE_RESIDENT
         self._resident: Dict[BlockId, List] = {}
+        #: shuffle_id -> spillables displaced by a re-executed map task's
+        #: overwriting commit; closed at cleanup (not at commit — a reader
+        #: holding the old snapshot may still be fetching them)
+        self._displaced: Dict[int, List] = {}
         self.device_resident = (
             bool(self.conf.get(SHUFFLE_DEVICE_RESIDENT))
             and isinstance(self.transport, LocalTransport)
@@ -278,6 +282,10 @@ class ShuffleManager:
                            or b.shuffle_id == shuffle_id]
             spillables = [sb for b in res_victims
                           for sb in self._resident.pop(b)]
+            disp_victims = [s for s in self._displaced
+                            if shuffle_id is None or s == shuffle_id]
+            spillables += [sb for s in disp_victims
+                           for sb in self._displaced.pop(s)]
         for sb in spillables:      # outside the lock: close touches catalog
             sb.close()
 
@@ -356,10 +364,22 @@ class MapTaskWriter:
 
     def commit(self) -> None:
         if self._resident_pieces:
+            # overwrite semantics, matching _store_blob: a re-executed map
+            # task replaces its previous output (appending would duplicate
+            # rows in the resident tier while the file tier dedupes)
+            fresh: Dict[BlockId, List] = {}
+            for reduce_id, sb in self._resident_pieces:
+                block = BlockId(self.shuffle_id, self.map_id, reduce_id)
+                fresh.setdefault(block, []).append(sb)
             with self.mgr._lock:
-                for reduce_id, sb in self._resident_pieces:
-                    block = BlockId(self.shuffle_id, self.map_id, reduce_id)
-                    self.mgr._resident.setdefault(block, []).append(sb)
+                for block, sbs in fresh.items():
+                    # displaced batches are NOT closed here: a reader may
+                    # have snapshotted them under the lock and be mid-get()
+                    # outside it — they close with the shuffle's cleanup()
+                    self.mgr._displaced.setdefault(
+                        self.shuffle_id, []).extend(
+                        self.mgr._resident.get(block, ()))
+                    self.mgr._resident[block] = sbs
             self._resident_pieces = []
         for reduce_id, fut in self._futures:
             self._frames.setdefault(reduce_id, []).append(fut.result())
