@@ -531,11 +531,10 @@ def _decimal_words(arr: pa.Array, capacity: int
 
 def device_to_arrow(batch: ColumnarBatch) -> pa.Table:
     # ONE bulk transfer for every leaf: per-array pulls each cost a full
-    # host<->device round trip (~65ms over the TPU tunnel), while a single
-    # device_get issues all copies concurrently — per-column conversion
-    # below then touches only host memory
-    import jax
-    batch = jax.device_get(batch)
+    # host<->device round trip (~65ms over the TPU tunnel); large batches
+    # additionally narrow on device first (columnar/prepack.py)
+    from .prepack import prepacked_device_get
+    batch = prepacked_device_get(batch)
     n = batch.num_rows_int
     arrays = [device_column_to_arrow(c, n) for c in batch.columns]
     return pa.table(arrays, names=list(batch.names))

@@ -158,6 +158,20 @@ OOM_SYNC_MODE = register(
     "materialization point), 'auto' syncs only under memory pressure — "
     "accounted pool usage above oom.syncWatermark, armed test OOM "
     "injection, or a recently observed device OOM.", "auto")
+D2H_PREPACK = register(
+    "spark.rapids.tpu.d2h.prepack",
+    "Device-side pre-pack for host fetches (shuffle frames, spill, result "
+    "collection): integer bit-width narrowing, lossless float64->float32 "
+    "and bool bit-packing shrink bytes before they cross the host link "
+    "(reference: nvcomp device codecs, NvcompLZ4CompressionCodec.scala). "
+    "'auto' enables it when the device is remote (TPU tunnel), 'true' "
+    "forces it everywhere (CPU-mesh measurement), 'false' disables.",
+    "auto")
+D2H_PREPACK_MIN_BYTES = register(
+    "spark.rapids.tpu.d2h.prepack.minBytes",
+    "Minimum narrowable payload (bytes) before the pre-pack probe round "
+    "trip pays for itself; smaller batches ride the plain packed fetch.",
+    1 << 20)
 D2H_PACK_F64 = register(
     "spark.rapids.tpu.d2h.packFloat64",
     "Include float64 columns in the packed single-transfer D2H fetch. "

@@ -100,9 +100,11 @@ def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
 
 
 def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
-    import jax
-    # one concurrent D2H for all buffers (see device_to_arrow)
-    batch = jax.device_get(batch)
+    # one transfer for all buffers, with device-side narrowing when the
+    # batch is big enough to pay for the probe (columnar/prepack.py —
+    # bytes shrink BEFORE they cross the tunnel, nvcomp-codec analog)
+    from ..columnar.prepack import prepacked_device_get
+    batch = prepacked_device_get(batch)
     n = batch.num_rows_int
     body = io.BytesIO()
     metas = []

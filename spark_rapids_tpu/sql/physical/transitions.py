@@ -59,14 +59,15 @@ class DeviceToHostExec(PhysicalPlan):
         return self.children[0].output
 
     def execute(self, pid, tctx):
-        from ...columnar.convert import bulk_device_get
+        from ...columnar.prepack import prepacked_device_get
         from ...memory.oom_guard import guard_device_oom
         from . import speculation
         # the fetch is a materialization point: with syncMode=auto a
         # deferred execution-time OOM surfaces HERE, so it runs under the
-        # guard's spill-and-retry protocol like any kernel.  bulk_device_get
-        # byte-packs the whole batch into ONE device->host transfer
-        fetch = guard_device_oom(bulk_device_get)
+        # guard's spill-and-retry protocol like any kernel.  The fetch
+        # byte-packs the whole batch into ONE device->host transfer, and
+        # big batches narrow on device first (columnar/prepack.py)
+        fetch = guard_device_oom(prepacked_device_get)
         for batch in self.children[0].execute(pid, tctx):
             tctx.inc_metric("d2h_bytes", batch_nbytes(batch))
             # bundle pending speculation scalars into the SAME pull as the

@@ -624,6 +624,24 @@ QUERIES: List[Tuple[str, Callable]] = [
 _TABLE_SETS = {"tpch": build_tpch_tables, "tpcds": _TDS.build_tables}
 
 
+class _RecordingTables(dict):
+    """Table dict that records which tables a query touches, so the rig
+    can report bytes-scanned per query instead of the whole set."""
+
+    def __init__(self, base):
+        super().__init__(base)
+        self.accessed: set = set()
+
+    def __getitem__(self, key):
+        self.accessed.add(key)
+        return super().__getitem__(key)
+
+    def get(self, key, default=None):
+        if key in self:
+            self.accessed.add(key)
+        return super().get(key, default)
+
+
 def run_suite(rows: int = 50_000, queries=None, tables=None,
               sess=None, extra_tables=None) -> List[dict]:
     """Runs the selected queries; pass ``tables``/``sess``/
@@ -649,16 +667,22 @@ def run_suite(rows: int = 50_000, queries=None, tables=None,
             t = extra[prefix]
         else:
             t = base_tables
+        rec = _RecordingTables(t)
         t0 = time.perf_counter()
-        fn(sess, t, F)
+        fn(sess, rec, F)
         total = time.perf_counter() - t0
         t0 = time.perf_counter()
-        fn(sess, t, F)  # warm engine + oracle again; compile amortized
+        fn(sess, rec, F)  # warm engine + oracle again; compile amortized
         warm = time.perf_counter() - t0
         report.append({"query": name,
                        "seconds": round(total, 3),
                        "warm_seconds": round(warm, 3),
-                       "rows": rows})
+                       "rows": rows,
+                       # bytes of the tables the query actually touched
+                       # (warm_seconds also includes the pandas oracle
+                       # re-check, so derived GB/s stays conservative)
+                       "tables_bytes": sum(t[k].nbytes
+                                           for k in rec.accessed)})
     return report
 
 
