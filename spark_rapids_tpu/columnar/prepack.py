@@ -294,9 +294,10 @@ def prepacked_device_get(tree):
             b.copy_to_host_async()
         mins, maxs, flags = (np.asarray(mins_d), np.asarray(maxs_d),
                              np.asarray(flags_d))
+        probe_nbytes = mins.nbytes + maxs.nbytes + flags.nbytes
         with _LOCK:  # shuffle writer/reader pools fetch concurrently
-            STATS["probe_bytes"] += (mins.nbytes + maxs.nbytes
-                                     + flags.nbytes)
+            STATS["probe_bytes"] += probe_nbytes
+            STATS["bytes_on_wire"] += probe_nbytes  # probe crossed too
         codes = _choose_codes(sig, mins, maxs, flags)
         if all(c == "keep" for c in codes):
             return bulk_device_get(tree)

@@ -399,6 +399,16 @@ JSON_ENABLED = register(
     "spark.rapids.sql.format.json.enabled", "Accelerate JSON.", False)
 AVRO_ENABLED = register(
     "spark.rapids.sql.format.avro.enabled", "Accelerate Avro.", False)
+ORC_DEVICE_DECODE = register(
+    "spark.rapids.sql.format.orc.deviceDecode.enabled",
+    "Decode ORC stripes on the device: the host parses only structure "
+    "(protobuf footers, compression block framing, RLEv2/byte-RLE run "
+    "headers) and XLA programs do the per-value work — MSB bit-unpack, "
+    "zigzag, DELTA prefix sums, PRESENT bit expansion, null scatter, "
+    "dictionary remap, string-matrix gather.  Columns outside the "
+    "envelope (timestamps, decimals, nested, RLEv1, PATCHED_BASE) fall "
+    "back to host decode individually (reference device decode: "
+    "GpuOrcScan.scala:893 Table.readORC).", True)
 PARQUET_DEVICE_DECODE = register(
     "spark.rapids.sql.format.parquet.deviceDecode.enabled",
     "Decode parquet pages on the device: the host parses only structure "

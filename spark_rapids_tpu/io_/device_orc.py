@@ -1,0 +1,1126 @@
+"""Device-side ORC decode (reference ``GpuOrcScan.scala:893`` —
+``Table.readORC`` takes a host buffer and decodes stripes on the GPU;
+2726-LoC file).  Same architecture as :mod:`.device_parquet`: the host
+parses *structure* (protobuf postscript/footer/stripe footers, compression
+block framing, RLE run headers — all O(metadata)) and builds run-descriptor
+tables; compiled XLA programs then do the per-value work on device:
+MSB-first bit unpacking, zigzag decode, DELTA prefix sums, PRESENT bit
+expansion, null scatter, dictionary remap and string-matrix gather.
+
+Scope (per-column decline-to-host, like the parquet decoder's envelope):
+
+  * types: boolean, tinyint..bigint, float, double, date, string/binary/
+    varchar/char (DIRECT_V2 and DICTIONARY_V2);
+  * integer RLEv2 sub-encodings SHORT_REPEAT / DIRECT / DELTA
+    (PATCHED_BASE declines the column — rare: only outlier-heavy data);
+  * compression NONE / ZLIB / SNAPPY / ZSTD (LZO/LZ4 decline the file);
+  * timestamps, decimals, nested types, RLEv1 (pre-hive-0.12 writers)
+    decline per column and ride the host pyarrow read.
+
+Floats note: ORC stores IEEE little-endian raw streams — already the
+device layout, so "decode" is a zero-copy host view plus the normal
+upload; the device still does the null scatter.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device_parquet import _pad_pow2, _scatter_nonnull, _Unsupported
+
+# --------------------------------------------------------------------------
+# Minimal protobuf wire reader (hand-rolled, like device_parquet's thrift)
+# --------------------------------------------------------------------------
+
+
+class _ProtoReader:
+    """Protobuf wire-format walker: yields (field_number, wire_type, value)
+    where value is int (varint/fixed) or memoryview (length-delimited)."""
+
+    def __init__(self, buf, pos: int = 0, end: Optional[int] = None):
+        self.buf = memoryview(buf)
+        self.pos = pos
+        self.end = len(buf) if end is None else end
+
+    def varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def fields(self):
+        while self.pos < self.end:
+            key = self.varint()
+            fid, wt = key >> 3, key & 7
+            if wt == 0:
+                yield fid, wt, self.varint()
+            elif wt == 1:
+                v = struct.unpack_from("<Q", self.buf, self.pos)[0]
+                self.pos += 8
+                yield fid, wt, v
+            elif wt == 2:
+                ln = self.varint()
+                v = self.buf[self.pos:self.pos + ln]
+                self.pos += ln
+                yield fid, wt, v
+            elif wt == 5:
+                v = struct.unpack_from("<I", self.buf, self.pos)[0]
+                self.pos += 4
+                yield fid, wt, v
+            else:
+                raise _Unsupported(f"proto wire type {wt}")
+
+
+def _packed_uints(mv) -> List[int]:
+    r = _ProtoReader(mv)
+    out = []
+    while r.pos < r.end:
+        out.append(r.varint())
+    return out
+
+
+@dataclass
+class _Stripe:
+    offset: int = 0
+    index_length: int = 0
+    data_length: int = 0
+    footer_length: int = 0
+    num_rows: int = 0
+
+
+@dataclass
+class _OrcType:
+    kind: int = 0
+    subtypes: List[int] = field(default_factory=list)
+    field_names: List[str] = field(default_factory=list)
+
+
+_COMPRESSION = {0: None, 1: "zlib", 2: "snappy", 3: "lzo", 4: "lz4",
+                5: "zstd"}
+
+_KIND_BOOLEAN, _KIND_BYTE, _KIND_SHORT, _KIND_INT, _KIND_LONG = 0, 1, 2, 3, 4
+_KIND_FLOAT, _KIND_DOUBLE, _KIND_STRING, _KIND_BINARY = 5, 6, 7, 8
+_KIND_DATE, _KIND_VARCHAR, _KIND_CHAR = 15, 16, 17
+
+_STREAM_PRESENT, _STREAM_DATA, _STREAM_LENGTH = 0, 1, 2
+_STREAM_DICTIONARY_DATA = 3
+_ENC_DIRECT, _ENC_DICTIONARY, _ENC_DIRECT_V2, _ENC_DICTIONARY_V2 = 0, 1, 2, 3
+
+
+def _parse_postscript(buf: bytes) -> Tuple[int, Optional[str], int, int]:
+    """(footer_length, codec, compression_block_size, metadata_length)."""
+    footer_len = comp = block = meta_len = 0
+    for fid, _wt, v in _ProtoReader(buf).fields():
+        if fid == 1:
+            footer_len = v
+        elif fid == 2:
+            comp = v
+        elif fid == 3:
+            block = v
+        elif fid == 5:
+            meta_len = v
+    if comp not in _COMPRESSION or _COMPRESSION[comp] in ("lzo", "lz4"):
+        raise _Unsupported(f"ORC compression kind {comp}")
+    return footer_len, _COMPRESSION[comp], block or 262144, meta_len
+
+
+def _parse_footer(buf) -> Tuple[List[_Stripe], List[_OrcType], int]:
+    stripes: List[_Stripe] = []
+    types: List[_OrcType] = []
+    num_rows = 0
+    for fid, _wt, v in _ProtoReader(buf).fields():
+        if fid == 3:
+            s = _Stripe()
+            for f2, _w2, v2 in _ProtoReader(v).fields():
+                if f2 == 1:
+                    s.offset = v2
+                elif f2 == 2:
+                    s.index_length = v2
+                elif f2 == 3:
+                    s.data_length = v2
+                elif f2 == 4:
+                    s.footer_length = v2
+                elif f2 == 5:
+                    s.num_rows = v2
+            stripes.append(s)
+        elif fid == 4:
+            t = _OrcType()
+            for f2, w2, v2 in _ProtoReader(v).fields():
+                if f2 == 1:
+                    t.kind = v2
+                elif f2 == 2:
+                    if w2 == 2:
+                        t.subtypes.extend(_packed_uints(v2))
+                    else:
+                        t.subtypes.append(v2)
+                elif f2 == 3:
+                    t.field_names.append(bytes(v2).decode())
+            types.append(t)
+        elif fid == 6:
+            num_rows = v
+    return stripes, types, num_rows
+
+
+@dataclass
+class _StreamInfo:
+    kind: int
+    column: int
+    length: int
+    offset: int  # absolute file offset
+
+
+def _parse_stripe_footer(buf, stripe: _Stripe
+                         ) -> Tuple[List[_StreamInfo], Dict[int, Tuple[int, int]]]:
+    """(streams with absolute offsets, {column: (encoding, dict_size)})."""
+    streams: List[_StreamInfo] = []
+    encodings: Dict[int, Tuple[int, int]] = {}
+    col_i = 0
+    pos = stripe.offset
+    for fid, _wt, v in _ProtoReader(buf).fields():
+        if fid == 1:
+            kind = column = length = 0
+            for f2, _w2, v2 in _ProtoReader(v).fields():
+                if f2 == 1:
+                    kind = v2
+                elif f2 == 2:
+                    column = v2
+                elif f2 == 3:
+                    length = v2
+            streams.append(_StreamInfo(kind, column, length, pos))
+            pos += length
+        elif fid == 2:
+            enc = dict_size = 0
+            for f2, _w2, v2 in _ProtoReader(v).fields():
+                if f2 == 1:
+                    enc = v2
+                elif f2 == 2:
+                    dict_size = v2
+            encodings[col_i] = (enc, dict_size)
+            col_i += 1
+    return streams, encodings
+
+
+# --------------------------------------------------------------------------
+# Compression block framing (per stream)
+# --------------------------------------------------------------------------
+
+def _decompress_stream(raw: bytes, codec: Optional[str]) -> bytes:
+    if codec is None:
+        return raw
+    out = []
+    pos = 0
+    n = len(raw)
+    while pos + 3 <= n:
+        h = raw[pos] | (raw[pos + 1] << 8) | (raw[pos + 2] << 16)
+        pos += 3
+        is_original = h & 1
+        ln = h >> 1
+        chunk = raw[pos:pos + ln]
+        pos += ln
+        if is_original:
+            out.append(chunk)
+        elif codec == "zlib":
+            out.append(zlib.decompress(chunk, wbits=-15))
+        elif codec == "snappy":
+            import pyarrow as pa
+            # raw snappy's preamble is the uncompressed length (uleb128),
+            # which pyarrow wants passed explicitly
+            size, _p = _read_varint(chunk, 0)
+            out.append(pa.Codec("snappy").decompress(
+                chunk, decompressed_size=size).to_pybytes())
+        elif codec == "zstd":
+            import zstandard
+            out.append(zstandard.ZstdDecompressor().decompress(
+                chunk, max_output_size=1 << 26))
+        else:  # pragma: no cover - gated at postscript parse
+            raise _Unsupported(f"codec {codec}")
+    return b"".join(out)
+
+
+# --------------------------------------------------------------------------
+# Host walks: byte-RLE and RLEv2 -> run/segment descriptors
+# --------------------------------------------------------------------------
+
+_MAX_RUNS = 1 << 18  # structure-vs-data guard, like device_parquet
+
+
+@dataclass
+class _MsbRuns:
+    """RLE/packed descriptors for the MSB expansion kernel (ORC packs
+    values MSB-first, unlike parquet's LSB hybrid)."""
+
+    out_start: List[int] = field(default_factory=list)
+    src_bit: List[int] = field(default_factory=list)
+    width: List[int] = field(default_factory=list)
+    rle_val: List[int] = field(default_factory=list)
+
+    def add_rle(self, out_start: int, value: int) -> None:
+        self.out_start.append(out_start)
+        self.src_bit.append(0)
+        self.width.append(0)
+        self.rle_val.append(value)
+
+    def add_packed(self, out_start: int, src_bit: int, width: int) -> None:
+        self.out_start.append(out_start)
+        self.src_bit.append(src_bit)
+        self.width.append(width)
+        self.rle_val.append(0)
+
+    def __len__(self) -> int:
+        return len(self.out_start)
+
+
+@dataclass
+class _DeltaSegs:
+    out_start: List[int] = field(default_factory=list)
+    count: List[int] = field(default_factory=list)
+    base: List[int] = field(default_factory=list)
+    delta0: List[int] = field(default_factory=list)
+    width: List[int] = field(default_factory=list)
+    src_bit: List[int] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.out_start)
+
+
+#: RLEv2 5-bit width code -> actual bit width ("closest fixed bits")
+_FBS = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18,
+        19, 20, 21, 22, 23, 24, 26, 28, 30, 32, 40, 48, 56, 64]
+
+
+def _read_varint(buf, pos: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _walk_rlev2(buf, start: int, end: int, num_values: int, signed: bool,
+                out_base: int, base_bit: int, runs: _MsbRuns,
+                deltas: _DeltaSegs) -> None:
+    """Walk RLEv2 run headers in ``buf[start:end)`` covering ``num_values``
+    values.  SHORT_REPEAT/DIRECT append to ``runs`` (device unpacks and,
+    for signed streams, zigzag-decodes); DELTA appends ready-to-sum
+    segments (base/delta0 decoded host-side — they are per-run varints,
+    i.e. structure, not data)."""
+    pos = start
+    produced = 0
+    while produced < num_values and pos < end:
+        if len(runs) + len(deltas) > _MAX_RUNS:
+            raise _Unsupported("ORC run count guard")
+        h = buf[pos]
+        enc = h >> 6
+        if enc == 0:                              # SHORT_REPEAT
+            nbytes = ((h >> 3) & 0x7) + 1
+            count = (h & 0x7) + 3
+            val = int.from_bytes(bytes(buf[pos + 1:pos + 1 + nbytes]),
+                                 "big")
+            runs.add_rle(out_base + produced, val)
+            pos += 1 + nbytes
+            produced += count
+        elif enc == 1:                            # DIRECT
+            width = _FBS[(h >> 1) & 0x1F]
+            count = (((h & 1) << 8) | buf[pos + 1]) + 1
+            pos += 2
+            runs.add_packed(out_base + produced,
+                            base_bit + (pos - start) * 8, width)
+            pos += (count * width + 7) // 8
+            produced += count
+        elif enc == 3:                            # DELTA
+            wcode = (h >> 1) & 0x1F
+            width = 0 if wcode == 0 else _FBS[wcode]
+            count = (((h & 1) << 8) | buf[pos + 1]) + 1
+            pos += 2
+            if signed:
+                raw, pos = _read_varint(buf, pos)
+                base = _zigzag(raw)
+            else:
+                base, pos = _read_varint(buf, pos)
+            raw, pos = _read_varint(buf, pos)
+            delta0 = _zigzag(raw)
+            deltas.out_start.append(out_base + produced)
+            deltas.count.append(count)
+            deltas.base.append(base)
+            deltas.delta0.append(delta0)
+            deltas.width.append(width)
+            deltas.src_bit.append(base_bit + (pos - start) * 8)
+            if width and count > 2:
+                pos += ((count - 2) * width + 7) // 8
+            produced += count
+        else:                                     # PATCHED_BASE
+            raise _Unsupported("RLEv2 PATCHED_BASE")
+    if produced < num_values:
+        raise _Unsupported("short RLEv2 stream")
+
+
+def _popcount_msb_prefix(value: int, k: int) -> int:
+    """Set bits among the first ``k`` MSB-first bits of a byte."""
+    return bin(value >> (8 - k)).count("1") if k else 0
+
+
+def _walk_byte_rle(buf, start: int, end: int, num_bytes: int,
+                   out_base: int, base_bit: int, runs: _MsbRuns,
+                   count_bits_upto: Optional[int] = None) -> int:
+    """Byte-RLE (PRESENT / boolean / tinyint streams).  Byte-aligned, so
+    the MSB/LSB distinction vanishes and runs reuse the same expansion
+    kernel with width=8.  When ``count_bits_upto`` is given, also counts
+    the set bits among the first that-many bits (MSB-first within each
+    byte) — the PRESENT non-null count, in the same walk."""
+    pos = start
+    produced = 0
+    bits = 0
+    nbits = count_bits_upto or 0
+
+    def _count(value: int, byte_lo: int, byte_hi: int) -> int:
+        if not count_bits_upto:
+            return 0
+        full_end = min(byte_hi, nbits // 8)
+        got = 0
+        if full_end > byte_lo:
+            got += bin(value).count("1") * (full_end - byte_lo)
+        if byte_lo <= nbits // 8 < byte_hi and nbits % 8:
+            got += _popcount_msb_prefix(value, nbits % 8)
+        return got
+
+    while produced < num_bytes and pos < end:
+        if len(runs) > _MAX_RUNS:
+            raise _Unsupported("ORC run count guard")
+        c = buf[pos]
+        pos += 1
+        if c < 128:                               # run
+            count = min(c + 3, num_bytes - produced)
+            val = buf[pos]
+            runs.add_rle(out_base + produced, val)
+            bits += _count(val, produced, produced + count)
+            pos += 1
+            produced += count
+        else:                                     # literals
+            count = min(256 - c, num_bytes - produced)
+            runs.add_packed(out_base + produced,
+                            base_bit + (pos - start) * 8, 8)
+            if count_bits_upto:
+                for k in range(count):
+                    bits += _count(buf[pos + k], produced + k,
+                                   produced + k + 1)
+            pos += count
+            produced += count
+    if produced < num_bytes:
+        raise _Unsupported("short byte-RLE stream")
+    return bits
+
+
+def _host_rlev2(buf, start: int, end: int, n: int, signed: bool
+                ) -> np.ndarray:
+    """Host expansion of a small RLEv2 stream (string LENGTH streams and
+    stripe dictionaries: O(n) numpy with per-run vector ops — these
+    streams are tiny next to the data they describe)."""
+    out = np.zeros(n, dtype=np.int64)
+    pos = start
+    produced = 0
+    while produced < n and pos < end:
+        h = buf[pos]
+        enc = h >> 6
+        if enc == 0:
+            nbytes = ((h >> 3) & 0x7) + 1
+            count = min((h & 0x7) + 3, n - produced)
+            val = int.from_bytes(bytes(buf[pos + 1:pos + 1 + nbytes]),
+                                 "big")
+            if signed:
+                val = _zigzag(val)
+            out[produced:produced + count] = val
+            pos += 1 + nbytes
+            produced += count
+        elif enc == 1:
+            width = _FBS[(h >> 1) & 0x1F]
+            count = (((h & 1) << 8) | buf[pos + 1]) + 1
+            pos += 2
+            nbytes = (count * width + 7) // 8
+            chunk = np.frombuffer(buf, np.uint8, nbytes, pos)
+            vals = _unpack_msb_host(chunk, count, width)
+            if signed:
+                vals = (vals >> 1) ^ -(vals & 1)
+            take = min(count, n - produced)
+            out[produced:produced + take] = vals[:take]
+            pos += nbytes
+            produced += take
+        elif enc == 3:
+            wcode = (h >> 1) & 0x1F
+            width = 0 if wcode == 0 else _FBS[wcode]
+            count = (((h & 1) << 8) | buf[pos + 1]) + 1
+            pos += 2
+            if signed:
+                raw, pos = _read_varint(buf, pos)
+                base = _zigzag(raw)
+            else:
+                base, pos = _read_varint(buf, pos)
+            raw, pos = _read_varint(buf, pos)
+            delta0 = _zigzag(raw)
+            vals = np.zeros(count, dtype=np.int64)
+            vals[0] = base
+            if count > 1:
+                inc = np.zeros(count, dtype=np.int64)
+                inc[1] = delta0
+                if count > 2:
+                    if width:
+                        nbytes = ((count - 2) * width + 7) // 8
+                        chunk = np.frombuffer(buf, np.uint8, nbytes, pos)
+                        mags = _unpack_msb_host(chunk, count - 2, width)
+                        pos += nbytes
+                    else:
+                        mags = np.full(count - 2, abs(delta0),
+                                       dtype=np.int64)
+                    inc[2:] = np.where(delta0 < 0, -mags, mags)
+                vals = base + np.cumsum(inc)
+            take = min(count, n - produced)
+            out[produced:produced + take] = vals[:take]
+            produced += take
+        else:
+            raise _Unsupported("RLEv2 PATCHED_BASE")
+    if produced < n:
+        raise _Unsupported("short RLEv2 stream")
+    return out
+
+
+def _unpack_msb_host(chunk: np.ndarray, count: int, width: int
+                     ) -> np.ndarray:
+    bits = np.unpackbits(chunk)  # MSB-first by default
+    take = bits[:count * width].reshape(count, width).astype(np.int64)
+    weights = (1 << np.arange(width - 1, -1, -1)).astype(np.int64)
+    return take @ weights
+
+
+# --------------------------------------------------------------------------
+# Device kernels
+# --------------------------------------------------------------------------
+
+def _byte_at(words, k):
+    """Byte ``k`` of the uploaded little-endian word buffer (traced)."""
+    w = jnp.clip((k >> 2).astype(jnp.int32), 0, words.shape[0] - 1)
+    return (words[w] >> ((k & 3).astype(jnp.uint32) * 8)) & jnp.uint32(0xFF)
+
+
+def _win32_msb(words, bitpos):
+    """32 MSB-first bits starting at absolute bit ``bitpos`` (traced):
+    five consecutive stream bytes assembled big-endian, then shifted."""
+    q = (bitpos >> 3).astype(jnp.int64)
+    r = (bitpos & 7).astype(jnp.uint64)
+    acc = jnp.zeros(bitpos.shape, jnp.uint64)
+    for k in range(5):
+        acc = (acc << jnp.uint64(8)) | _byte_at(words, q + k).astype(jnp.uint64)
+    return (acc >> (jnp.uint64(8) - r)) & jnp.uint64(0xFFFFFFFF)
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _expand_runs_msb(words, out_start, src_bit, width, rle_val, out_cap):
+    """ORC MSB-first run expansion -> uint64 raw values (width <= 64).
+    RLE runs broadcast; packed runs window-read.  Tail values past the
+    last run are garbage — callers mask by row count."""
+    idx = jnp.arange(out_cap, dtype=jnp.int32)
+    r = jnp.clip(jnp.searchsorted(out_start, idx, side="right") - 1,
+                 0, out_start.shape[0] - 1)
+    local = (idx - out_start[r]).astype(jnp.int64)
+    w = width[r].astype(jnp.int64)
+    bitpos = src_bit[r] + local * w
+    hi = _win32_msb(words, bitpos)
+    lo = _win32_msb(words, bitpos + 32)
+    v64 = (hi << jnp.uint64(32)) | lo
+    wshift = jnp.uint64(64) - w.astype(jnp.uint64)
+    raw = v64 >> wshift
+    return jnp.where(w == 0, rle_val[r].astype(jnp.uint64), raw)
+
+
+@jax.jit
+def _zigzag_device(u):
+    from ..columnar.convert import u64_to_i64
+    half = (u >> jnp.uint64(1)).astype(jnp.int64)
+    return jnp.where((u & jnp.uint64(1)) > 0, -half - 1, half)
+
+
+def _u64_as_i64(u):
+    from ..columnar.convert import u64_to_i64
+    return u64_to_i64(u)
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _expand_delta(words, out_start, count, base, delta0, width, src_bit,
+                  out_cap):
+    """DELTA segments -> int64 values via one global cumsum: increment 0
+    at each segment head, delta0 at local 1, sign(delta0)*|packed| after;
+    value = base[seg] + (c[i] - c[seg head])."""
+    idx = jnp.arange(out_cap, dtype=jnp.int32)
+    s = jnp.clip(jnp.searchsorted(out_start, idx, side="right") - 1,
+                 0, out_start.shape[0] - 1)
+    local = (idx - out_start[s]).astype(jnp.int64)
+    w = width[s].astype(jnp.int64)
+    bitpos = src_bit[s] + jnp.maximum(local - 2, 0) * w
+    hi = _win32_msb(words, bitpos)
+    lo = _win32_msb(words, bitpos + 32)
+    raw = ((hi << jnp.uint64(32)) | lo) >> (jnp.uint64(64)
+                                            - w.astype(jnp.uint64))
+    mag = _u64_as_i64(raw)
+    sign = jnp.where(delta0[s] < 0, jnp.int64(-1), jnp.int64(1))
+    fixed = jnp.abs(delta0[s])
+    step = jnp.where(w == 0, fixed, mag) * sign
+    inc = jnp.where(local <= 0, jnp.int64(0),
+                    jnp.where(local == 1, delta0[s], step))
+    in_seg = (local >= 0) & (local < count[s])
+    inc = jnp.where(in_seg, inc, 0)
+    c = jnp.cumsum(inc)
+    head = out_start[s]
+    return base[s] + c - c[jnp.clip(head, 0, out_cap - 1)]
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _present_bits(byte_vals, row_base, byte_base, out_cap):
+    """Expanded PRESENT bytes -> bool validity.  Bit streams restart per
+    stripe (a stripe's rows need not be a multiple of 8), so logical row
+    i maps through its stripe: local = i - row_base[s], byte =
+    byte_base[s] + local>>3, bit = 7 - local&7 (MSB-first)."""
+    i = jnp.arange(out_cap, dtype=jnp.int64)
+    s = jnp.clip(jnp.searchsorted(row_base, i, side="right") - 1,
+                 0, row_base.shape[0] - 1)
+    local = i - row_base[s]
+    k = jnp.clip(byte_base[s] + (local >> 3), 0, byte_vals.shape[0] - 1)
+    b = byte_vals[k]
+    return ((b >> (jnp.uint64(7) - (local & 7).astype(jnp.uint64)))
+            & jnp.uint64(1)) > 0
+
+
+@partial(jax.jit, static_argnames=("width", "cap"))
+def _gather_string_matrix(words, starts, lens, width, cap):
+    """DIRECT_V2 strings: blob bytes -> [cap, width] matrix (row r byte j
+    = blob[starts[r] + j], zero past the row's length)."""
+    r = jnp.arange(cap, dtype=jnp.int64)[:, None]
+    j = jnp.arange(width, dtype=jnp.int64)[None, :]
+    pos = starts[:, None] + j
+    b = _byte_at(words, pos)
+    live = j < lens[:, None]
+    return jnp.where(live, b, 0).astype(jnp.uint8)
+
+
+@partial(jax.jit, static_argnames=("width", "cap"))
+def _gather_dict_matrix(dict_mat, dict_lens, idx, width, cap):
+    safe = jnp.clip(idx, 0, dict_mat.shape[0] - 1)
+    return dict_mat[safe][:, :width], dict_lens[safe]
+
+
+# --------------------------------------------------------------------------
+# Column decode plans
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _ColPlan:
+    """Accumulated per-column state across the selected stripes."""
+
+    buf: bytearray = field(default_factory=bytearray)     # device bytes
+    present_runs: _MsbRuns = field(default_factory=_MsbRuns)
+    has_present: bool = False
+    #: per-stripe (first logical row, first PRESENT byte in the expanded
+    #: byte axis) — bit streams restart per stripe
+    present_row_base: List[int] = field(default_factory=list)
+    present_byte_base: List[int] = field(default_factory=list)
+    present_bytes: int = 0
+    #: boolean DATA is also a bit stream, on the dense (non-null) axis
+    bool_dense_base: List[int] = field(default_factory=list)
+    bool_byte_base: List[int] = field(default_factory=list)
+    bool_bytes: int = 0
+    val_runs: _MsbRuns = field(default_factory=_MsbRuns)
+    val_deltas: _DeltaSegs = field(default_factory=_DeltaSegs)
+    total_rows: int = 0
+    total_nonnull: int = 0
+    # strings
+    str_starts: List[np.ndarray] = field(default_factory=list)  # per stripe
+    str_lens: List[np.ndarray] = field(default_factory=list)
+    dict_mats: List[np.ndarray] = field(default_factory=list)
+    dict_lens: List[np.ndarray] = field(default_factory=list)
+    #: per-stripe first dense index (dictionary index offsetting)
+    dense_base: List[int] = field(default_factory=list)
+    is_dict: Optional[bool] = None
+    # floats: dense host views concatenated at decode time
+    float_parts: List[np.ndarray] = field(default_factory=list)
+
+    def append_buf(self, data: bytes) -> int:
+        """Add stream bytes to the device buffer (8-byte aligned segments
+        so bit positions stay word-local); returns the base bit."""
+        pad = (-len(self.buf)) % 8
+        self.buf.extend(b"\0" * pad)
+        base_bit = len(self.buf) * 8
+        self.buf.extend(data)
+        return base_bit
+
+
+def _runs_to_device(runs: _MsbRuns):
+    n = _pad_pow2(len(runs), 8)
+
+    def pad(a, fill=0):
+        out = np.full(n, fill, dtype=np.int64)
+        out[:len(runs)] = a
+        return jnp.asarray(out)
+
+    big = np.iinfo(np.int64).max
+    out_start = np.full(n, big, dtype=np.int64)
+    out_start[:len(runs)] = runs.out_start
+    return (jnp.asarray(out_start), pad(runs.src_bit), pad(runs.width),
+            pad(runs.rle_val))
+
+
+def _deltas_to_device(segs: _DeltaSegs):
+    n = _pad_pow2(len(segs), 8)
+    big = np.iinfo(np.int64).max
+
+    def pad(a, fill=0):
+        out = np.full(n, fill, dtype=np.int64)
+        out[:len(segs)] = a
+        return jnp.asarray(out)
+
+    out_start = np.full(n, big, dtype=np.int64)
+    out_start[:len(segs)] = segs.out_start
+    return (jnp.asarray(out_start), pad(segs.count), pad(segs.base),
+            pad(segs.delta0), pad(segs.width), pad(segs.src_bit))
+
+
+def _buf_to_words(buf) -> jnp.ndarray:
+    data = bytes(buf) + b"\0" * 16
+    pad = (-len(data)) % 4
+    data += b"\0" * pad
+    return jnp.asarray(np.frombuffer(data, dtype="<u4"))
+
+
+def _int_values_device(plan: _ColPlan, n_dense: int, signed: bool):
+    """Dense int64 values from the accumulated RLEv2 runs + delta segs."""
+    cap = _pad_pow2(n_dense)
+    words = _buf_to_words(plan.buf)
+    vals = None
+    if len(plan.val_runs):
+        rs = _runs_to_device(plan.val_runs)
+        raw = _expand_runs_msb(words, *rs, cap)
+        vals = _zigzag_device(raw) if signed else _u64_as_i64(raw)
+    if len(plan.val_deltas):
+        ds = _deltas_to_device(plan.val_deltas)
+        dvals = _expand_delta(words, *ds, cap)
+        if vals is None:
+            vals = dvals
+        else:
+            # membership test: index inside a delta segment's range
+            idx = jnp.arange(cap, dtype=jnp.int64)
+            s = jnp.clip(jnp.searchsorted(ds[0], idx, side="right") - 1,
+                         0, ds[0].shape[0] - 1)
+            in_delta = (idx >= ds[0][s]) & (idx < ds[0][s] + ds[1][s])
+            vals = jnp.where(in_delta, dvals, vals)
+    if vals is None:
+        vals = jnp.zeros(cap, jnp.int64)
+    return vals
+
+
+def _stripe_bases(rows: List[int], bytes_: List[int]):
+    n = _pad_pow2(len(rows), 8)
+    big = np.iinfo(np.int64).max
+    rb = np.full(n, big, dtype=np.int64)
+    rb[:len(rows)] = rows
+    bb = np.zeros(n, dtype=np.int64)
+    bb[:len(bytes_)] = bytes_
+    return jnp.asarray(rb), jnp.asarray(bb)
+
+
+def _validity_device(plan: _ColPlan, n_rows: int, cap: int):
+    if not plan.has_present:
+        return jnp.ones(cap, bool) \
+            if n_rows == cap else (jnp.arange(cap) < n_rows)
+    byte_cap = _pad_pow2(plan.present_bytes)
+    words = _buf_to_words(plan.buf)
+    rs = _runs_to_device(plan.present_runs)
+    bvals = _expand_runs_msb(words, *rs, byte_cap)
+    rb, bb = _stripe_bases(plan.present_row_base, plan.present_byte_base)
+    valid = _present_bits(bvals, rb, bb, cap)
+    return valid & (jnp.arange(cap) < n_rows)
+
+
+# --------------------------------------------------------------------------
+# Per-stripe stream collection (host)
+# --------------------------------------------------------------------------
+
+_DEVICE_KINDS = {_KIND_BOOLEAN, _KIND_BYTE, _KIND_SHORT, _KIND_INT,
+                 _KIND_LONG, _KIND_FLOAT, _KIND_DOUBLE, _KIND_DATE,
+                 _KIND_STRING, _KIND_BINARY, _KIND_VARCHAR, _KIND_CHAR}
+
+_STR_KINDS = {_KIND_STRING, _KIND_BINARY, _KIND_VARCHAR, _KIND_CHAR}
+
+
+def _collect_stripe(plan: _ColPlan, kind: int, enc: int, dict_size: int,
+                    streams: Dict[int, bytes], stripe_rows: int) -> None:
+    """Fold one stripe's decompressed streams for one column into the
+    accumulated plan.  Raises _Unsupported to decline the column."""
+    if kind in _STR_KINDS:
+        if enc == _ENC_DIRECT_V2:
+            is_dict = False
+        elif enc == _ENC_DICTIONARY_V2:
+            is_dict = True
+        else:
+            raise _Unsupported(f"string encoding {enc}")
+        if plan.is_dict is None:
+            plan.is_dict = is_dict
+        elif plan.is_dict != is_dict:
+            raise _Unsupported("mixed string encodings across stripes")
+    elif enc not in (_ENC_DIRECT, _ENC_DIRECT_V2):
+        raise _Unsupported(f"encoding {enc} for kind {kind}")
+    v2 = enc in (_ENC_DIRECT_V2, _ENC_DICTIONARY_V2)
+    if kind in (_KIND_SHORT, _KIND_INT, _KIND_LONG, _KIND_DATE) and not v2:
+        raise _Unsupported("RLEv1 integer stream")
+
+    present = streams.get(_STREAM_PRESENT)
+    nonnull = stripe_rows
+    if present is not None:
+        plan.has_present = True
+        nbytes = (stripe_rows + 7) // 8
+        base_bit = plan.append_buf(present)
+        plan.present_row_base.append(plan.total_rows)
+        plan.present_byte_base.append(plan.present_bytes)
+        nonnull = _walk_byte_rle(present, 0, len(present), nbytes,
+                                 plan.present_bytes, base_bit,
+                                 plan.present_runs,
+                                 count_bits_upto=stripe_rows)
+        plan.present_bytes += nbytes
+    elif plan.has_present:
+        # earlier stripes had nulls, this one doesn't: an all-ones
+        # present run keeps the mapping uniform
+        nbytes = (stripe_rows + 7) // 8
+        plan.present_row_base.append(plan.total_rows)
+        plan.present_byte_base.append(plan.present_bytes)
+        plan.present_runs.add_rle(plan.present_bytes, 0xFF)
+        plan.present_bytes += nbytes
+
+    data = streams.get(_STREAM_DATA, b"")
+    plan.dense_base.append(plan.total_nonnull)
+    if kind == _KIND_BOOLEAN:
+        nbytes = (nonnull + 7) // 8
+        base_bit = plan.append_buf(data)
+        plan.bool_dense_base.append(plan.total_nonnull)
+        plan.bool_byte_base.append(plan.bool_bytes)
+        _walk_byte_rle(data, 0, len(data), nbytes, plan.bool_bytes,
+                       base_bit, plan.val_runs)
+        plan.bool_bytes += nbytes
+    elif kind == _KIND_BYTE:
+        base_bit = plan.append_buf(data)
+        _walk_byte_rle(data, 0, len(data), nonnull, plan.total_nonnull,
+                       base_bit, plan.val_runs)
+    elif kind in (_KIND_SHORT, _KIND_INT, _KIND_LONG, _KIND_DATE):
+        base_bit = plan.append_buf(data)
+        _walk_rlev2(data, 0, len(data), nonnull, True,
+                    plan.total_nonnull, base_bit, plan.val_runs,
+                    plan.val_deltas)
+    elif kind in (_KIND_FLOAT, _KIND_DOUBLE):
+        dt = np.dtype("<f4" if kind == _KIND_FLOAT else "<f8")
+        want = nonnull * dt.itemsize
+        if len(data) < want:
+            raise _Unsupported("short float stream")
+        plan.float_parts.append(np.frombuffer(data, dt, count=nonnull))
+    elif kind in _STR_KINDS:
+        lens_buf = streams.get(_STREAM_LENGTH, b"")
+        if plan.is_dict:
+            ddata = streams.get(_STREAM_DICTIONARY_DATA, b"")
+            dlens = _host_rlev2(lens_buf, 0, len(lens_buf), dict_size,
+                                False).astype(np.int64)
+            starts = np.zeros(dict_size + 1, dtype=np.int64)
+            np.cumsum(dlens, out=starts[1:])
+            if int(starts[-1]) > len(ddata):
+                raise _Unsupported("short dictionary blob")
+            w = int(dlens.max()) if dict_size else 0
+            mat = np.zeros((max(dict_size, 1), max(w, 1)), dtype=np.uint8)
+            blob = np.frombuffer(ddata, np.uint8, count=int(starts[-1]))
+            for r in range(dict_size):
+                ln = int(dlens[r])
+                mat[r, :ln] = blob[starts[r]:starts[r] + ln]
+            plan.dict_mats.append(mat)
+            plan.dict_lens.append(dlens.astype(np.int32))
+            base_bit = plan.append_buf(data)
+            _walk_rlev2(data, 0, len(data), nonnull, False,
+                        plan.total_nonnull, base_bit, plan.val_runs,
+                        plan.val_deltas)
+        else:
+            lens = _host_rlev2(lens_buf, 0, len(lens_buf), nonnull, False)
+            total = int(lens.sum())
+            if total > len(data):
+                raise _Unsupported("short string blob")
+            base_bit = plan.append_buf(data)
+            starts = (np.cumsum(lens) - lens) + base_bit // 8
+            plan.str_starts.append(starts)
+            plan.str_lens.append(lens.astype(np.int32))
+    else:  # pragma: no cover - gated by _DEVICE_KINDS
+        raise _Unsupported(f"kind {kind}")
+    plan.total_rows += stripe_rows
+    plan.total_nonnull += nonnull
+
+
+# --------------------------------------------------------------------------
+# Column finishing: plans -> DeviceColumn
+# --------------------------------------------------------------------------
+
+
+def _finish_column(plan: _ColPlan, kind: int, dtype, n_rows: int,
+                   capacity: int, max_str_bytes: int):
+    from ..columnar.column import DeviceColumn, bucket_width
+    valid = _validity_device(plan, n_rows, capacity)
+    n_dense = plan.total_nonnull
+
+    if kind == _KIND_BOOLEAN:
+        byte_cap = _pad_pow2(plan.bool_bytes)
+        words = _buf_to_words(plan.buf)
+        rs = _runs_to_device(plan.val_runs)
+        bvals = _expand_runs_msb(words, *rs, byte_cap)
+        db, bb = _stripe_bases(plan.bool_dense_base, plan.bool_byte_base)
+        dense = _present_bits(bvals, db, bb, _pad_pow2(n_dense))
+        data, valid = _scatter_nonnull(dense, valid, n_rows, capacity)
+        return DeviceColumn(dtype, data, valid)
+
+    if kind in (_KIND_BYTE, _KIND_SHORT, _KIND_INT, _KIND_LONG,
+                _KIND_DATE):
+        signed_walk = kind not in (_KIND_BYTE,)
+        vals = _int_values_device(plan, max(n_dense, 1),
+                                  signed=False if kind == _KIND_BYTE
+                                  else True)
+        np_dt = {_KIND_BYTE: jnp.int8, _KIND_SHORT: jnp.int16,
+                 _KIND_INT: jnp.int32, _KIND_LONG: jnp.int64,
+                 _KIND_DATE: jnp.int32}[kind]
+        if kind == _KIND_BYTE:
+            # tinyint bytes are raw two's-complement
+            vals = ((vals + 128) % 256) - 128
+        dense = vals.astype(np_dt)
+        data, valid = _scatter_nonnull(dense, valid, n_rows, capacity)
+        return DeviceColumn(dtype, data, valid)
+
+    if kind in (_KIND_FLOAT, _KIND_DOUBLE):
+        parts = plan.float_parts or [np.zeros(0, np.float32)]
+        host = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        pad = _pad_pow2(max(len(host), 1))
+        buf = np.zeros(pad, dtype=host.dtype)
+        buf[:len(host)] = host
+        dense = jnp.asarray(buf)
+        if kind == _KIND_DOUBLE:
+            dense = dense.astype(jnp.float64)
+        else:
+            dense = dense.astype(jnp.float32)
+        data, valid = _scatter_nonnull(dense, valid, n_rows, capacity)
+        return DeviceColumn(dtype, data, valid)
+
+    # strings
+    if plan.is_dict:
+        mats = plan.dict_mats
+        w = max((m.shape[1] for m in mats), default=1)
+        w = bucket_width(w)
+        total_dict = sum(m.shape[0] for m in mats)
+        if capacity * w > max_str_bytes:
+            raise _Unsupported("string matrix too large")
+        combined = np.zeros((max(total_dict, 1), w), dtype=np.uint8)
+        lens_np = np.zeros(max(total_dict, 1), dtype=np.int32)
+        offs = []
+        at = 0
+        for m, dl in zip(mats, plan.dict_lens):
+            offs.append(at)
+            combined[at:at + m.shape[0], :m.shape[1]] = m
+            lens_np[at:at + m.shape[0]] = dl
+            at += m.shape[0]
+        idx = _int_values_device(plan, max(n_dense, 1), signed=False)
+        # per-stripe dictionary offset by dense position
+        db, ob = _stripe_bases(plan.dense_base, offs)
+        j = jnp.arange(idx.shape[0], dtype=jnp.int64)
+        s = jnp.clip(jnp.searchsorted(db, j, side="right") - 1,
+                     0, db.shape[0] - 1)
+        gidx = idx + ob[s]
+        mat_d = jnp.asarray(combined)
+        lens_d = jnp.asarray(lens_np)
+        chars, lens = _gather_dict_matrix(mat_d, lens_d, gidx, w,
+                                          idx.shape[0])
+        data, valid = _scatter_nonnull(chars, valid, n_rows, capacity)
+        lens_data, _ = _scatter_nonnull(lens, valid, n_rows, capacity)
+        return DeviceColumn(dtype, data, valid,
+                            lengths=lens_data.astype(jnp.int32))
+
+    starts = (np.concatenate(plan.str_starts) if len(plan.str_starts) > 1
+              else (plan.str_starts[0] if plan.str_starts
+                    else np.zeros(0, np.int64)))
+    lens = (np.concatenate(plan.str_lens) if len(plan.str_lens) > 1
+            else (plan.str_lens[0] if plan.str_lens
+                  else np.zeros(0, np.int32)))
+    w = bucket_width(int(lens.max()) if len(lens) else 0)
+    if capacity * w > max_str_bytes:
+        raise _Unsupported("string matrix too large")
+    pad = _pad_pow2(max(len(starts), 1))
+    sp = np.zeros(pad, np.int64)
+    sp[:len(starts)] = starts
+    lp = np.zeros(pad, np.int32)
+    lp[:len(lens)] = lens
+    words = _buf_to_words(plan.buf)
+    chars = _gather_string_matrix(words, jnp.asarray(sp), jnp.asarray(lp),
+                                  w, pad)
+    data, valid = _scatter_nonnull(chars, valid, n_rows, capacity)
+    lens_data, _ = _scatter_nonnull(jnp.asarray(lp), valid, n_rows,
+                                    capacity)
+    return DeviceColumn(dtype, data, valid,
+                        lengths=lens_data.astype(jnp.int32))
+
+
+# --------------------------------------------------------------------------
+# Entry point
+# --------------------------------------------------------------------------
+
+
+def _dtype_ok(kind: int, dtype) -> bool:
+    from .. import types as T
+    want = {_KIND_BOOLEAN: T.BooleanType, _KIND_BYTE: T.ByteType,
+            _KIND_SHORT: T.ShortType, _KIND_INT: T.IntegerType,
+            _KIND_LONG: T.LongType, _KIND_FLOAT: T.FloatType,
+            _KIND_DOUBLE: T.DoubleType, _KIND_DATE: T.DateType,
+            _KIND_STRING: (T.StringType,), _KIND_VARCHAR: (T.StringType,),
+            _KIND_CHAR: (T.StringType,), _KIND_BINARY: (T.BinaryType,)}
+    w = want.get(kind)
+    if w is None:
+        return False
+    return isinstance(dtype, w if isinstance(w, tuple) else (w,))
+
+
+def decode_file(path: str, stripes: Optional[List[int]] = None,
+                tctx=None, orc_file=None, conf=None):
+    """Decode (a subset of stripes of) one ORC file into a
+    :class:`ColumnarBatch`, device-decoding every column the envelope
+    supports and falling back to pyarrow per column otherwise.  Returns
+    ``None`` when no column takes the device path (callers use their
+    host read wholesale) — the same contract as
+    :func:`.device_parquet.decode_file`."""
+    import pyarrow.orc as pa_orc
+
+    from .. import types as T
+    from ..columnar.batch import ColumnarBatch
+    from ..columnar.column import bucket_capacity
+    from ..columnar.convert import arrow_to_device_column
+    from .device_parquet import _max_string_matrix_bytes
+
+    if orc_file is None:
+        orc_file = pa_orc.ORCFile(path)
+    schema = orc_file.schema
+
+    with open(path, "rb") as f:
+        raw = f.read()
+    # file tail: ... postscript | ps_len-byte; the postscript's last
+    # field is the magic, so bytes -4:-1 read b"ORC"
+    if len(raw) < 5 or raw[-4:-1] != b"ORC" or raw[-1] == 0:
+        return None
+    ps_len = raw[-1]
+    try:
+        footer_len, codec, _block, _meta = _parse_postscript(
+            raw[-1 - ps_len:-1])
+        footer = _decompress_stream(
+            raw[-1 - ps_len - footer_len:-1 - ps_len], codec)
+        all_stripes, types, total_rows = _parse_footer(footer)
+    except (_Unsupported, IndexError, ValueError, struct.error):
+        return None
+    if not types or types[0].subtypes != list(
+            range(1, len(types[0].subtypes) + 1)):
+        # non-flat root layouts (nested types shift ids) decline per
+        # column below via the id map; a wholly unexpected tree declines
+        if not types:
+            return None
+    sel = list(range(len(all_stripes))) if stripes is None else list(stripes)
+    if not sel:
+        return None
+    n_rows = sum(all_stripes[s].num_rows for s in sel)
+    capacity = bucket_capacity(n_rows)
+    max_str_bytes = _max_string_matrix_bytes(conf)
+
+    root = types[0]
+    field_type_id = {i: tid for i, tid in enumerate(root.subtypes)}
+
+    # stripe footers parsed once, shared across columns
+    stripe_meta = []
+    try:
+        for s in sel:
+            st = all_stripes[s]
+            foot_raw = raw[st.offset + st.index_length + st.data_length:
+                           st.offset + st.index_length + st.data_length
+                           + st.footer_length]
+            streams, encodings = _parse_stripe_footer(
+                _decompress_stream(foot_raw, codec), st)
+            stripe_meta.append((st, streams, encodings))
+    except (_Unsupported, IndexError, ValueError, struct.error):
+        return None
+
+    device_cols: Dict[int, object] = {}
+    host_fields: List[int] = []
+    for fi, fld in enumerate(schema):
+        tid = field_type_id.get(fi)
+        try:
+            dtype = T.from_arrow(fld.type)
+        except Exception:
+            dtype = None
+        if (tid is None or tid >= len(types)
+                or types[tid].kind not in _DEVICE_KINDS
+                or dtype is None or not _dtype_ok(types[tid].kind, dtype)):
+            host_fields.append(fi)
+            continue
+        kind = types[tid].kind
+        plan = _ColPlan()
+        try:
+            for st, streams, encodings in stripe_meta:
+                enc, dict_size = encodings.get(tid, (0, 0))
+                col_streams: Dict[int, bytes] = {}
+                for si in streams:
+                    if si.column == tid and si.kind in (
+                            _STREAM_PRESENT, _STREAM_DATA, _STREAM_LENGTH,
+                            _STREAM_DICTIONARY_DATA):
+                        body = raw[si.offset:si.offset + si.length]
+                        col_streams[si.kind] = _decompress_stream(body,
+                                                                  codec)
+                _collect_stripe(plan, kind, enc, dict_size, col_streams,
+                                st.num_rows)
+            device_cols[fi] = _finish_column(plan, kind, dtype, n_rows,
+                                             capacity, max_str_bytes)
+            if tctx is not None:
+                tctx.inc_metric("orcDeviceDecodedColumns")
+        except _Unsupported:
+            host_fields.append(fi)
+        except (ValueError, IndexError, KeyError, struct.error, OSError):
+            if tctx is not None:
+                tctx.inc_metric("orcDeviceDecodeErrors")
+            host_fields.append(fi)
+
+    if not device_cols:
+        return None
+    if host_fields:
+        names = [schema.field(fi).name for fi in host_fields]
+        tbl = orc_file.read(columns=names)
+        if stripes is not None:
+            # pyarrow has no stripe-subset read; assemble from read_stripe
+            import pyarrow as pa
+            parts = [pa.Table.from_batches(
+                [orc_file.read_stripe(s, columns=names)]) for s in sel]
+            tbl = pa.concat_tables(parts)
+        for k, fi in enumerate(host_fields):
+            device_cols[fi] = arrow_to_device_column(tbl.column(k),
+                                                     capacity)
+            if tctx is not None:
+                tctx.inc_metric("orcHostDecodedColumns")
+
+    cols = [device_cols[fi] for fi in range(len(schema))]
+    return ColumnarBatch.make([f.name for f in schema], cols, n_rows)
