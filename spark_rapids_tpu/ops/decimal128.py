@@ -245,3 +245,38 @@ def div_round_half_up(xp, lo, hi, d):
     nlo, nhi = neg128(xp, blo, bhi)
     return (xp.where(sign < 0, nlo, blo),
             xp.where(sign < 0, nhi, bhi))
+
+
+def scale_up(xp, lo, hi, k: int):
+    """(lo, hi) * 10^k via <=9-digit mul_small steps (each multiplier
+    stays < 2^31).  Returns (lo, hi, overflow)."""
+    ovf = xp.zeros_like(lo, dtype=bool)
+    while k > 0:
+        step = min(k, 9)
+        lo, hi, o = mul_small(xp, lo, hi, 10 ** step)
+        ovf = ovf | o
+        k -= step
+    return lo, hi, ovf
+
+
+def scale_down_half_up(xp, lo, hi, k: int):
+    """(lo, hi) / 10^k with HALF_UP rounding.  HALF_UP over a k-digit
+    drop depends only on the FIRST dropped digit, so truncating k-1
+    digits (in <=9-digit steps on the magnitude) then one half-up
+    divide-by-10 is exact for any k."""
+    if k <= 0:
+        return lo, hi
+    alo, ahi, sign = abs128(xp, lo, hi)
+    rem = k - 1
+    while rem > 0:
+        step = min(rem, 9)
+        alo, ahi, _r = divmod_nonneg_small(xp, alo, ahi, 10 ** step)
+        rem -= step
+    qlo, qhi, r = divmod_nonneg_small(xp, alo, ahi, 10)
+    bump = r >= 5
+    blo = qlo + xp.where(bump, 1, 0)
+    carry = xp.where(cmp_unsigned_gt(xp, qlo, blo), 1, 0)  # lo wrapped
+    bhi = qhi + carry
+    nlo, nhi = neg128(xp, blo, bhi)
+    return (xp.where(sign < 0, nlo, blo),
+            xp.where(sign < 0, nhi, bhi))
