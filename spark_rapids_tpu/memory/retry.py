@@ -41,13 +41,19 @@ class OomInjectionState(threading.local):
         self.retry_ooms = int(retry)
         self.split_ooms = int(split)
 
-    def maybe_throw(self):
+    def maybe_throw(self, splittable: bool = True):
         if self.retry_ooms > 0:
             self.retry_ooms -= 1
             raise RetryOOM("injected RetryOOM (test hook)")
-        if self.split_ooms > 0:
+        # split injections only land on sites that CAN split — a no-split
+        # site receiving SplitAndRetryOOM is a task failure by contract,
+        # and the reference's forceSplitAndRetryOOM likewise targets the
+        # splittable retry iterators (RmmSpark test hooks)
+        if self.split_ooms > 0 and splittable:
             self.split_ooms -= 1
-            raise SplitAndRetryOOM("injected SplitAndRetryOOM (test hook)")
+            e = SplitAndRetryOOM("injected SplitAndRetryOOM (test hook)")
+            e.injected = True
+            raise e
 
 
 _injection = OomInjectionState()
@@ -120,7 +126,7 @@ def with_retry(inputs: Iterable[A], fn: Callable[[A], B],
                     raise MemoryError(
                         f"giving up after {_MAX_RETRIES} OOM retries (GpuOOM)")
                 try:
-                    _injection.maybe_throw()
+                    _injection.maybe_throw(splittable=split is not None)
                     result = fn(item)
                     _close(item)
                     item = None
@@ -128,13 +134,24 @@ def with_retry(inputs: Iterable[A], fn: Callable[[A], B],
                     break
                 except RetryOOM:
                     catalog.spill_all_device()
-                except SplitAndRetryOOM:
+                except SplitAndRetryOOM as soom:
                     if split is None:
                         raise
                     # split closes the parent and returns its pieces —
                     # except the 0-row degenerate case, which re-queues
                     # the SAME (unclosed) input after spilling
-                    pieces = split(item)
+                    try:
+                        pieces = split(item)
+                    except SplitAndRetryOOM:
+                        # unsplittable input: a REAL device OOM here is a
+                        # task failure by contract, but an INJECTED one
+                        # degrades to spill+retry — the test hook must
+                        # exercise recovery paths, not invent failures
+                        # real memory pressure would not cause
+                        if getattr(soom, "injected", False):
+                            catalog.spill_all_device()
+                            continue
+                        raise
                     item = None
                     pieces.reverse()
                     stack.extend(pieces)

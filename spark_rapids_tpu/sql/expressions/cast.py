@@ -162,17 +162,17 @@ def _device_string_cast(ctx, c: DeviceColumn, ft, tt):
 def _cast_fixed(xp, c: DeviceColumn, ft: T.DataType, tt: T.DataType):
     x, valid = c.data, c.validity
 
-    # --- from bool
+    # --- from bool (bool -> decimal is served by _cast_decimal_aware)
     if isinstance(ft, T.BooleanType):
         if isinstance(tt, T.BooleanType):
             return x, valid
-        if isinstance(tt, T.DecimalType):
-            return x.astype(xp.int64) * (10 ** tt.scale), valid
         return x.astype(tt.np_dtype), valid
 
-    # --- from decimal
+    # --- from decimal: every decimal -> decimal/float/bool/integral
+    # combo is served by _cast_decimal_aware before _cast_fixed runs;
+    # only genuinely unsupported targets (date/timestamp) reach here
     if isinstance(ft, T.DecimalType):
-        return _from_decimal(xp, x, valid, ft, tt)
+        raise NotImplementedError(f"cast {ft} -> {tt}")
 
     # --- temporal sources
     if isinstance(ft, T.DateType):
@@ -314,48 +314,47 @@ def _cast_decimal_aware(xp, c: DeviceColumn, ft, tt):
     if T.is_floating(ft) and not tt.is_long_backed:
         x = c.data.astype(xp.float64)
         ax = xp.abs(x)
-        # integral doubles (every double >= 2^52 is one) expand EXACTLY:
-        # decompose the <=53-significant-bit integer into 128-bit words,
-        # then scale up in decimal space — CAST(1e19 AS DECIMAL(38,10))
-        # must be 10^19 * 10^10 exactly, not the float64 product's
-        # neighborhood.  Fractional doubles below 2^53*10^-scale keep the
-        # (exact there) float64 product; in between, digits beyond the
-        # double's precision follow the float64 product (Spark carries
-        # the full dyadic expansion — documented divergence).
-        integral = (ax == xp.floor(ax)) & xp.isfinite(x)
-        a = xp.where(integral, ax, 0.0)
-        hi_f = xp.floor(a / (2.0 ** 64))
-        lo_f = a - hi_f * (2.0 ** 64)      # exact: <=53 significant bits
-        lo_u = xp.where(lo_f >= 2.0 ** 63, lo_f - 2.0 ** 64, lo_f)
-        ilo = lo_u.astype(xp.int64)        # unsigned bit pattern
-        ihi = hi_f.astype(xp.int64)
-        ilo, ihi, iovf = D128.scale_up(xp, ilo, ihi, tt.scale)
-        nlo, nhi = D128.neg128(xp, ilo, ihi)
-        neg = x < 0
-        ilo = xp.where(neg, nlo, ilo)
-        ihi = xp.where(neg, nhi, ihi)
 
-        f = x * (10.0 ** tt.scale)
-        r = xp.sign(f) * xp.floor(xp.abs(f) + 0.5)  # HALF_UP at scale
-        fok = xp.isfinite(f) & (xp.abs(r) < 2.0 ** 62)
-        flo = xp.where(fok, r, 0.0).astype(xp.int64)
-        fhi = D128.sign_extend_lo(xp, flo)
+        def decompose(a):
+            """Non-negative integral float64 (<2^127) -> 128-bit words.
+            Exact: a carries <=53 significant bits, and both the 2^64
+            quotient and the remainder are therefore exactly
+            representable."""
+            hi_f = xp.floor(a / (2.0 ** 64))
+            lo_f = a - hi_f * (2.0 ** 64)
+            lo_u = xp.where(lo_f >= 2.0 ** 63, lo_f - 2.0 ** 64, lo_f)
+            return lo_u.astype(xp.int64), hi_f.astype(xp.int64)
+
+        # integral doubles (every double >= 2^52 is one) expand EXACTLY:
+        # decompose into 128-bit words, then scale up in DECIMAL space —
+        # CAST(1e19 AS DECIMAL(38,10)) is 10^19 * 10^10 exactly, not the
+        # float64 product's neighborhood.  Fractional doubles round
+        # HALF_UP at target scale in float64 and decompose the (then
+        # integral) product; digits beyond the double's 53-bit precision
+        # follow the float64 product (Spark carries the full dyadic
+        # expansion — documented divergence).
+        integral = (ax == xp.floor(ax)) & xp.isfinite(x)
+        ilo, ihi = decompose(xp.where(integral & (ax < 2.0 ** 127),
+                                      ax, 0.0))
+        ilo, ihi, iovf = D128.scale_up(xp, ilo, ihi, tt.scale)
+
+        f = ax * (10.0 ** tt.scale)
+        r = xp.floor(f + 0.5)              # HALF_UP at scale (magnitude)
+        fok = xp.isfinite(f) & (r < 2.0 ** 127)
+        flo, fhi = decompose(xp.where(fok, r, 0.0))
 
         lo = xp.where(integral, ilo, flo)
         hi = xp.where(integral, ihi, fhi)
-        ok = valid & xp.where(integral, ~iovf & (a < 2.0 ** 127), fok)
+        nlo, nhi = D128.neg128(xp, lo, hi)
+        neg = x < 0
+        lo = xp.where(neg, nlo, lo)
+        hi = xp.where(neg, nhi, hi)
+        ok = valid & xp.where(integral, ~iovf & (ax < 2.0 ** 127), fok)
         ok = ok & ~D128.out_of_bounds(xp, lo, hi, tt.precision)
         lo = xp.where(ok, lo, 0)
         hi = xp.where(ok, hi, 0)
         return DeviceColumn(tt, lo, ok, aux=hi)
     return None
-
-
-def _from_decimal(xp, x, valid, ft: T.DecimalType, tt: T.DataType):
-    # every decimal -> decimal/float/bool/integral combo is served by
-    # _cast_decimal_aware before _cast_fixed runs; only the genuinely
-    # unsupported targets (date/timestamp) fall through to here
-    raise NotImplementedError(f"cast {ft} -> {tt}")
 
 
 # --------------------------------------------------------------------------

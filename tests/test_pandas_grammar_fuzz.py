@@ -286,6 +286,8 @@ def _norm(v):
     if v is None or v is pd.NaT or (isinstance(v, float) and np.isnan(v)):
         return (1, "")
     if isinstance(v, Decimal):
+        if v == 0:
+            v = abs(v)  # Decimal('-0') normalizes to '-0'; engine says '0'
         return (0, str(v.normalize()))
     if isinstance(v, (pd.Timestamp, np.datetime64)):
         return (0, pd.Timestamp(v).date().isoformat())
