@@ -50,6 +50,25 @@ int64_t srt_unpack_strings(const uint8_t* matrix, const int32_t* lens,
     return pos;
 }
 
+// PLAIN BYTE_ARRAY walk (parquet: sequence of u32le length + payload).
+// Fills starts (payload offsets into data, int64[n]) and lens (int32[n]).
+// Returns bytes consumed, or -1 on overrun/negative length.
+int64_t srt_byte_array_walk(const uint8_t* data, int64_t size, int64_t n,
+                            int64_t* starts, int32_t* lens) {
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (pos + 4 > size) return -1;
+        uint32_t len;
+        std::memcpy(&len, data + pos, 4);
+        pos += 4;
+        if (len > static_cast<uint64_t>(size - pos)) return -1;
+        starts[i] = pos;
+        lens[i] = static_cast<int32_t>(len);
+        pos += len;
+    }
+    return pos;
+}
+
 // ---------------------------------------------------------------------------
 // Spark-exact murmur3-x86-32 (reference jni.Hash semantics) — the
 // independent host oracle the device kernels are validated against.

@@ -515,10 +515,7 @@ def _unpack_msb_host(chunk: np.ndarray, count: int, width: int
 # Device kernels
 # --------------------------------------------------------------------------
 
-def _byte_at(words, k):
-    """Byte ``k`` of the uploaded little-endian word buffer (traced)."""
-    w = jnp.clip((k >> 2).astype(jnp.int32), 0, words.shape[0] - 1)
-    return (words[w] >> ((k & 3).astype(jnp.uint32) * 8)) & jnp.uint32(0xFF)
+from .device_parquet import byte_at_words as _byte_at  # shared kernel
 
 
 def _win32_msb(words, bitpos):
@@ -608,16 +605,7 @@ def _present_bits(byte_vals, row_base, byte_base, out_cap):
             & jnp.uint64(1)) > 0
 
 
-@partial(jax.jit, static_argnames=("width", "cap"))
-def _gather_string_matrix(words, starts, lens, width, cap):
-    """DIRECT_V2 strings: blob bytes -> [cap, width] matrix (row r byte j
-    = blob[starts[r] + j], zero past the row's length)."""
-    r = jnp.arange(cap, dtype=jnp.int64)[:, None]
-    j = jnp.arange(width, dtype=jnp.int64)[None, :]
-    pos = starts[:, None] + j
-    b = _byte_at(words, pos)
-    live = j < lens[:, None]
-    return jnp.where(live, b, 0).astype(jnp.uint8)
+from .device_parquet import gather_string_matrix as _gather_string_matrix
 
 
 @partial(jax.jit, static_argnames=("width", "cap"))

@@ -31,27 +31,42 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(so)
         except OSError:
             return None
-        lib.srt_pack_strings.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
-        lib.srt_unpack_strings.restype = ctypes.c_int64
-        lib.srt_unpack_strings.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
-        lib.srt_murmur3_i32.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
-            ctypes.c_void_p]
-        lib.srt_murmur3_i64.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
-            ctypes.c_void_p]
-        lib.srt_murmur3_bytes.restype = ctypes.c_int32
-        lib.srt_murmur3_bytes.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
-        lib.srt_xxhash64_bytes.restype = ctypes.c_uint64
-        lib.srt_xxhash64_bytes.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+        try:
+            _register(lib)
+        except AttributeError:
+            # a stale prebuilt .so missing newer symbols must degrade to
+            # the pure-Python fallbacks, not crash the first caller
+            return None
         _lib = lib
         return _lib
+
+
+def _register(lib: ctypes.CDLL) -> None:
+    """Declare every exported symbol's signature; raises AttributeError
+    when the loaded .so predates a symbol (caller degrades to Python)."""
+    lib.srt_pack_strings.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.srt_unpack_strings.restype = ctypes.c_int64
+    lib.srt_unpack_strings.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+    lib.srt_byte_array_walk.restype = ctypes.c_int64
+    lib.srt_byte_array_walk.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.srt_murmur3_i32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.c_void_p]
+    lib.srt_murmur3_i64.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.c_void_p]
+    lib.srt_murmur3_bytes.restype = ctypes.c_int32
+    lib.srt_murmur3_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
+    lib.srt_xxhash64_bytes.restype = ctypes.c_uint64
+    lib.srt_xxhash64_bytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
 
 
 def available() -> bool:
@@ -78,6 +93,23 @@ def pack_strings(flat: np.ndarray, offsets: np.ndarray, width: int,
         flat.ctypes.data, offsets.ctypes.data, n, width,
         matrix.ctypes.data, lens.ctypes.data)
     return matrix, lens
+
+
+def byte_array_walk(data: np.ndarray, n: int):
+    """(starts int64[n], lens int32[n]) for a PLAIN BYTE_ARRAY section
+    (u32le length-prefixed values); None when the native lib is absent,
+    raises ValueError on a truncated/overrunning section."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    starts = np.empty(n, dtype=np.int64)
+    lens = np.empty(n, dtype=np.int32)
+    used = lib.srt_byte_array_walk(data.ctypes.data, len(data), n,
+                                   starts.ctypes.data, lens.ctypes.data)
+    if used < 0:
+        raise ValueError("truncated BYTE_ARRAY section")
+    return starts, lens
 
 
 def unpack_strings(matrix: np.ndarray, lens: np.ndarray, n: int):

@@ -322,3 +322,83 @@ def test_scan_exec_uses_device_decode(tmp_path):
             "spark.rapids.sql.format.parquet.deviceDecode.enabled", "true")
     import pandas as pd
     pd.testing.assert_frame_equal(on, off)
+
+
+# --------------------------------------------------------------------------
+# round 5: PLAIN (non-dictionary) BYTE_ARRAY strings on device
+# --------------------------------------------------------------------------
+
+def _plain_string_metric(tmp_path, table, **kw):
+    path = str(tmp_path / "ps.parquet")
+    pq.write_table(table, path, use_dictionary=False, **kw)
+
+    class _Ctx:
+        metrics: dict = {}
+
+        def inc_metric(self, k, v=1):
+            self.metrics[k] = self.metrics.get(k, 0) + v
+
+    ctx = _Ctx()
+    batch = decode_file(path, tctx=ctx)
+    assert batch is not None
+    got = device_to_arrow(batch)
+    want = pq.read_table(path)
+    for c in want.schema.names:
+        assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+    return ctx.metrics
+
+
+def test_plain_strings_device(tmp_path):
+    rng = _rng(11)
+    n = 8000
+    t = pa.table({
+        "s": pa.array([f"plain-{i % 211}-{'x' * (i % 13)}"
+                       for i in range(n)]),
+        "v": pa.array(rng.random(n)),
+    })
+    m = _plain_string_metric(tmp_path, t)
+    assert m.get("parquetDeviceDecodedColumns", 0) == 2, m
+
+
+def test_plain_strings_with_nulls_and_empties(tmp_path):
+    rng = _rng(12)
+    n = 6000
+    vals = [None if rng.random() < 0.2
+            else ("" if rng.random() < 0.2 else f"v{i}")
+            for i in range(n)]
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    m = _plain_string_metric(tmp_path, t)
+    assert m.get("parquetDeviceDecodedColumns", 0) == 1, m
+
+
+def test_plain_strings_multi_row_group_compressed(tmp_path):
+    n = 20000
+    t = pa.table({
+        "s": pa.array([f"key-{i % 37:04d}" for i in range(n)]),
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+    })
+    m = _plain_string_metric(tmp_path, t, row_group_size=3000,
+                             compression="zstd")
+    assert m.get("parquetDeviceDecodedColumns", 0) == 2, m
+
+
+def test_byte_array_walk_native_matches_python():
+    from spark_rapids_tpu import native
+    import struct as _s
+    rng = _rng(13)
+    vals = [bytes(rng.integers(0, 256, rng.integers(0, 20)).astype(
+        np.uint8)) for _ in range(500)]
+    raw = b"".join(_s.pack("<I", len(v)) + v for v in vals)
+    data = np.frombuffer(raw, np.uint8)
+    out = native.byte_array_walk(data, len(vals))
+    if out is None:
+        pytest.skip("native lib unavailable")
+    starts, lens = out
+    pos = 0
+    for i, v in enumerate(vals):
+        pos += 4
+        assert starts[i] == pos and lens[i] == len(v), i
+        pos += len(v)
+    # truncation must raise, not overrun
+    with pytest.raises(ValueError):
+        native.byte_array_walk(data[:-1], len(vals))
