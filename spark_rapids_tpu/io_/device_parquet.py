@@ -1094,8 +1094,9 @@ def _precheck_chunk_meta(cc) -> None:
     # bounded PER CHUNK before any decompression happens
     if cc.physical_type == "BYTE_ARRAY" and not (
             encs & {"PLAIN_DICTIONARY", "RLE_DICTIONARY"}):
-        from ..native import available
-        if not available() and cc.num_values > _PY_WALK_MAX:
+        from ..native import has
+        if not has("srt_byte_array_walk") \
+                and cc.num_values > _PY_WALK_MAX:
             raise _Unsupported(
                 "PLAIN byte-array walk without native helper")
 

@@ -234,6 +234,44 @@ class FileScanExec(PhysicalPlan):
                     batch = jax.device_get(batch)
                 yield batch
 
+    def _coalescing_device(self, infos, schema0, tctx: TaskContext,
+                           upload):
+        """COALESCING with device decode: decode each (pruned) file and
+        concat on device.  Ragged-string fallbacks that split into width
+        classes stay separate batches — re-concatenating them into one
+        max-width matrix would rebuild exactly the blow-up the split
+        exists to prevent."""
+        import jax
+
+        from ..columnar.batch import ColumnarBatch
+        from .device_parquet import decode_file
+        batches = []
+        extra = []
+        for path, pf, groups, prune_stats in infos:
+            self._emit_prune_stats(prune_stats, tctx)
+            if not groups:
+                continue
+            batch = decode_file(path, groups, tctx, pf=pf, conf=self.conf)
+            if batch is None:
+                pieces = upload(pf.read_row_groups(groups))
+                if len(pieces) == 1:
+                    batches.append(pieces[0])
+                else:
+                    extra.extend(pieces)
+            else:
+                batches.append(batch)
+        if batches:
+            tctx.inc_metric("coalescedDeviceConcat")
+            out = ColumnarBatch.concat(batches)
+            if self.backend == CPU:
+                out = jax.device_get(out)
+            yield out
+        elif not extra:
+            # everything pruned away: same empty-schema batch the host
+            # path produces
+            yield from upload(schema0.empty_table())
+        yield from extra
+
     def execute(self, pid: int, tctx: TaskContext):
         import jax
 
@@ -254,6 +292,36 @@ class FileScanExec(PhysicalPlan):
 
         if self.reader_type == "COALESCING":
             import pyarrow as pa
+            # device decode per file + device concat (round 5): small
+            # files combine ON DEVICE; per-file declines host-read and
+            # join the same concat.  Footer-only schema agreement is
+            # checked BEFORE any decode (a late mismatch must not throw
+            # completed device work away); mismatched schemas take the
+            # host promote-concat path below.
+            if self.node.fmt == "parquet" and self.files and bool(
+                    self.conf.get(PARQUET_DEVICE_DECODE)):
+                infos = []
+                schema0 = None
+                ok = True
+                for p in self.files:
+                    path = resolve_read_path(p, self.conf)
+                    try:
+                        # honors pushdown row-group pruning, like _read
+                        pf, runs, prune_stats = self._parquet_runs(path)
+                    except OSError:
+                        ok = False
+                        break
+                    if schema0 is None:
+                        schema0 = pf.schema_arrow
+                    elif pf.schema_arrow != schema0:
+                        ok = False  # promotion needed: host concat path
+                        break
+                    groups = [g for run in runs for g in run]
+                    infos.append((path, pf, groups, prune_stats))
+                if ok:
+                    yield from self._coalescing_device(infos, schema0,
+                                                       tctx, upload)
+                    return
             n_threads = int(self.conf.get(MULTITHREAD_READ_NUM_THREADS))
             with ThreadPoolExecutor(max_workers=n_threads) as pool:
                 tables = list(pool.map(lambda p: self._read(p, tctx),

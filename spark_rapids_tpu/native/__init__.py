@@ -31,46 +31,69 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(so)
         except OSError:
             return None
-        try:
-            _register(lib)
-        except AttributeError:
-            # a stale prebuilt .so missing newer symbols must degrade to
-            # the pure-Python fallbacks, not crash the first caller
-            return None
+        _register(lib)
         _lib = lib
         return _lib
 
 
+#: (name, restype, argtypes) for every exported symbol
+_SYMBOLS = [
+    ("srt_pack_strings", None,
+     [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+      ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]),
+    ("srt_unpack_strings", ctypes.c_int64,
+     [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+      ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]),
+    ("srt_byte_array_walk", ctypes.c_int64,
+     [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+      ctypes.c_void_p, ctypes.c_void_p]),
+    ("srt_murmur3_i32", None,
+     [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+      ctypes.c_void_p]),
+    ("srt_murmur3_i64", None,
+     [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+      ctypes.c_void_p]),
+    ("srt_murmur3_bytes", ctypes.c_int32,
+     [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]),
+    ("srt_xxhash64_bytes", ctypes.c_uint64,
+     [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]),
+]
+
+
 def _register(lib: ctypes.CDLL) -> None:
-    """Declare every exported symbol's signature; raises AttributeError
-    when the loaded .so predates a symbol (caller degrades to Python)."""
-    lib.srt_pack_strings.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
-    lib.srt_unpack_strings.restype = ctypes.c_int64
-    lib.srt_unpack_strings.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
-    lib.srt_byte_array_walk.restype = ctypes.c_int64
-    lib.srt_byte_array_walk.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_void_p, ctypes.c_void_p]
-    lib.srt_murmur3_i32.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
-        ctypes.c_void_p]
-    lib.srt_murmur3_i64.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
-        ctypes.c_void_p]
-    lib.srt_murmur3_bytes.restype = ctypes.c_int32
-    lib.srt_murmur3_bytes.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32]
-    lib.srt_xxhash64_bytes.restype = ctypes.c_uint64
-    lib.srt_xxhash64_bytes.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+    """Declare symbol signatures PER SYMBOL: a stale prebuilt .so missing
+    only newer symbols keeps its older fast paths; wrappers for absent
+    symbols degrade to pure Python via :func:`_sym`."""
+    for name, restype, argtypes in _SYMBOLS:
+        try:
+            fn = getattr(lib, name)
+        except AttributeError:
+            continue
+        if restype is not None:
+            fn.restype = restype
+        fn.argtypes = argtypes
+
+
+def _sym(name: str):
+    """The ctypes function for ``name``, or None when the lib or the
+    symbol is unavailable (pure-Python fallback)."""
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        return getattr(lib, name)
+    except AttributeError:
+        return None
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def has(name: str) -> bool:
+    """Whether a specific exported symbol is loadable (stale prebuilt
+    libraries may lack newer symbols while keeping the rest)."""
+    return _sym(name) is not None
 
 
 # ---------------------------------------------------------------------------
@@ -99,14 +122,14 @@ def byte_array_walk(data: np.ndarray, n: int):
     """(starts int64[n], lens int32[n]) for a PLAIN BYTE_ARRAY section
     (u32le length-prefixed values); None when the native lib is absent,
     raises ValueError on a truncated/overrunning section."""
-    lib = _load()
-    if lib is None:
+    fn = _sym("srt_byte_array_walk")
+    if fn is None:
         return None
     data = np.ascontiguousarray(data, dtype=np.uint8)
     starts = np.empty(n, dtype=np.int64)
     lens = np.empty(n, dtype=np.int32)
-    used = lib.srt_byte_array_walk(data.ctypes.data, len(data), n,
-                                   starts.ctypes.data, lens.ctypes.data)
+    used = fn(data.ctypes.data, len(data), n,
+              starts.ctypes.data, lens.ctypes.data)
     if used < 0:
         raise ValueError("truncated BYTE_ARRAY section")
     return starts, lens
