@@ -399,6 +399,15 @@ JSON_ENABLED = register(
     "spark.rapids.sql.format.json.enabled", "Accelerate JSON.", False)
 AVRO_ENABLED = register(
     "spark.rapids.sql.format.avro.enabled", "Accelerate Avro.", False)
+CSV_DEVICE_DECODE = register(
+    "spark.rapids.sql.format.csv.deviceDecode.enabled",
+    "Parse CSV on the device: the host scans only newline/delimiter "
+    "structure (vectorized); field bytes gather into matrices and parse "
+    "through the same Spark-exact cast_strings kernels the CAST matrix "
+    "uses.  Quoted fields, custom null markers, CRLF, ragged rows and "
+    "parse failures against the plan schema decline to the host pyarrow "
+    "reader (reference device parse: GpuCSVScan.scala:355 "
+    "Table.readCSV).", True)
 ORC_DEVICE_DECODE = register(
     "spark.rapids.sql.format.orc.deviceDecode.enabled",
     "Decode ORC stripes on the device: the host parses only structure "

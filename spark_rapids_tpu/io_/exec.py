@@ -17,10 +17,11 @@ import numpy as np
 
 from .. import types as T
 from ..columnar.convert import arrow_to_device
-from ..config import (MULTITHREAD_READ_NUM_THREADS, ORC_DEVICE_DECODE,
-                      PARQUET_DEVICE_DECODE, PARQUET_PUSHDOWN_ENABLED,
-                      PARQUET_READER_TYPE, READER_CHUNKED,
-                      READER_CHUNKED_TARGET_ROWS, RapidsConf)
+from ..config import (CSV_DEVICE_DECODE, MULTITHREAD_READ_NUM_THREADS,
+                      ORC_DEVICE_DECODE, PARQUET_DEVICE_DECODE,
+                      PARQUET_PUSHDOWN_ENABLED, PARQUET_READER_TYPE,
+                      READER_CHUNKED, READER_CHUNKED_TARGET_ROWS,
+                      RapidsConf)
 from ..sql.physical.base import CPU, TPU, PhysicalPlan, TaskContext
 from . import registry
 from .filecache import resolve_read_path
@@ -355,6 +356,32 @@ class FileScanExec(PhysicalPlan):
             yield from self._execute_orc_device(self.files[pid], tctx,
                                                 upload)
             return
+        if bool(self.conf.get(CSV_DEVICE_DECODE)):
+            opts = dict(self.node.options)
+            if registry._normalize_fmt(self.node.fmt, opts) == "csv":
+                from .device_csv import decode_file as _csv_decode
+                path = resolve_read_path(self.files[pid], self.conf)
+                try:
+                    with open(path, "rb") as f:
+                        raw = f.read()
+                except OSError:
+                    raw = None
+                batch = None if raw is None else _csv_decode(
+                    path, opts, self.node.output, tctx, self.conf,
+                    raw=raw)
+                if batch is not None:
+                    if self.backend == CPU:
+                        batch = jax.device_get(batch)
+                    yield batch
+                    return
+                if raw is not None:
+                    # decline: re-parse the SAME bytes on host — no
+                    # second disk/cloud read
+                    import io as _io
+                    yield from upload(registry.read_csv_source(
+                        _io.BytesIO(raw), opts))
+                    return
+                # unreadable file: the host path raises its own error
         if self.reader_type == "MULTITHREADED":
             # per-partition prefetch through a shared pool: submit this file
             # read on a worker thread so decode overlaps device compute

@@ -66,6 +66,28 @@ def infer_schema(fmt: str, paths: Sequence[str], options: Dict) -> T.StructType:
         for f in schema))
 
 
+def read_csv_source(src, options: Dict,
+                    columns: Optional[List[str]] = None) -> pa.Table:
+    """CSV parse over a path OR a file-like source (the device decoder's
+    decline path re-parses the bytes it already read)."""
+    import pyarrow.csv as pcsv
+    has_header = str(options.get("header", "true")).lower() == "true"
+    sep = options.get("sep", options.get("delimiter", ","))
+    read_opts = pcsv.ReadOptions(
+        autogenerate_column_names=not has_header)
+    parse_opts = pcsv.ParseOptions(delimiter=sep)
+    convert = pcsv.ConvertOptions(
+        null_values=[options.get("nullValue", "")],
+        strings_can_be_null=True)
+    t = pcsv.read_csv(src, read_options=read_opts,
+                      parse_options=parse_opts, convert_options=convert)
+    if not has_header:
+        t = t.rename_columns([f"_c{i}" for i in range(t.num_columns)])
+    if columns:
+        t = t.select(columns)
+    return t
+
+
 def read_file(fmt: str, path: str, options: Dict,
               columns: Optional[List[str]] = None,
               head_rows: Optional[int] = None) -> pa.Table:
@@ -77,22 +99,7 @@ def read_file(fmt: str, path: str, options: Dict,
         import pyarrow.orc as orc
         return orc.ORCFile(path).read(columns=columns)
     if fmt == "csv":
-        import pyarrow.csv as pcsv
-        has_header = str(options.get("header", "true")).lower() == "true"
-        sep = options.get("sep", options.get("delimiter", ","))
-        read_opts = pcsv.ReadOptions(
-            autogenerate_column_names=not has_header)
-        parse_opts = pcsv.ParseOptions(delimiter=sep)
-        convert = pcsv.ConvertOptions(
-            null_values=[options.get("nullValue", "")],
-            strings_can_be_null=True)
-        t = pcsv.read_csv(path, read_options=read_opts,
-                          parse_options=parse_opts, convert_options=convert)
-        if not has_header:
-            t = t.rename_columns([f"_c{i}" for i in range(t.num_columns)])
-        if columns:
-            t = t.select(columns)
-        return t
+        return read_csv_source(path, options, columns)
     if fmt == "json":
         import pyarrow.json as pjson
         t = pjson.read_json(path)

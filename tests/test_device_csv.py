@@ -1,0 +1,149 @@
+"""Device-side CSV parse (io_/device_csv.py) — oracle-equal against the
+host pyarrow reader; every out-of-envelope shape must DECLINE (return
+None), never mis-parse.  Reference: ``GpuCSVScan.scala:355``."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.io_.device_csv import decode_file
+from spark_rapids_tpu.columnar import device_to_arrow
+
+
+class _F:
+    def __init__(self, name, dtype):
+        self.name = name
+        self.dtype = dtype
+
+
+def _decode(path, fields, options=None):
+    return decode_file(str(path), options or {"header": "true"}, fields)
+
+
+def test_basic_types(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "i,f,s,b,d\n"
+        "1,1.5,alpha,true,2020-01-31\n"
+        "-42,2.25e3,beta,false,1999-12-01\n"
+        ",,,,\n"
+        "7,-0.125,,TRUE,2024-02-29\n")
+    fields = [_F("i", T.LongType()), _F("f", T.DoubleType()),
+              _F("s", T.StringType()), _F("b", T.BooleanType()),
+              _F("d", T.DateType())]
+    b = _decode(p, fields)
+    assert b is not None
+    got = device_to_arrow(b)
+    assert got.column("i").to_pylist() == [1, -42, None, 7]
+    assert got.column("f").to_pylist() == [1.5, 2250.0, None, -0.125]
+    assert got.column("s").to_pylist() == ["alpha", "beta", None, None]
+    assert got.column("b").to_pylist() == [True, False, None, True]
+    import datetime
+    assert got.column("d").to_pylist() == [
+        datetime.date(2020, 1, 31), datetime.date(1999, 12, 1), None,
+        datetime.date(2024, 2, 29)]
+
+
+def test_int_widths_and_bounds(tmp_path):
+    p = tmp_path / "w.csv"
+    p.write_text("a,b\n127,32767\n-128,-32768\n")
+    fields = [_F("a", T.ByteType()), _F("b", T.ShortType())]
+    got = device_to_arrow(_decode(p, fields))
+    assert got.column("a").to_pylist() == [127, -128]
+    assert got.column("b").to_pylist() == [32767, -32768]
+    # out-of-range for the plan type -> decline (sample-inference drift)
+    p2 = tmp_path / "w2.csv"
+    p2.write_text("a\n127\n300\n")
+    assert _decode(p2, [_F("a", T.ByteType())]) is None
+
+
+def test_parse_failure_declines_not_nulls(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("i\n1\n2\nnot-a-number\n")
+    assert _decode(p, [_F("i", T.LongType())]) is None
+
+
+@pytest.mark.parametrize("content", [
+    b'a,b\n"q",2\n',               # quoted field
+    b"a,b\r\n1,2\r\n",             # CRLF
+    b"a,b\n1\n",                   # ragged row
+    b"a,b\n1,2\n\n3,4\n",          # blank interior line
+    b"\xef\xbb\xbfa,b\n1,2\n",     # BOM (raw bytes)
+])
+def test_out_of_envelope_declines(tmp_path, content):
+    p = tmp_path / "d.csv"
+    p.write_bytes(content)
+    assert _decode(p, [_F("a", T.LongType()),
+                       _F("b", T.LongType())]) is None
+
+
+def test_custom_separator_and_headerless(tmp_path):
+    p = tmp_path / "h.csv"
+    p.write_text("1|x\n2|y\n")
+    fields = [_F("_c0", T.LongType()), _F("_c1", T.StringType())]
+    got = device_to_arrow(decode_file(
+        str(p), {"header": "false", "sep": "|"}, fields))
+    assert got.column("_c0").to_pylist() == [1, 2]
+    assert got.column("_c1").to_pylist() == ["x", "y"]
+
+
+def test_hive_text_ctrl_a(tmp_path):
+    p = tmp_path / "hive.txt"
+    p.write_bytes(b"5\x01alpha\n6\x01beta\n")
+    fields = [_F("k", T.LongType()), _F("v", T.StringType())]
+    got = device_to_arrow(decode_file(
+        str(p), {"header": "false", "sep": "\x01"}, fields))
+    assert got.column("k").to_pylist() == [5, 6]
+    assert got.column("v").to_pylist() == ["alpha", "beta"]
+
+
+def test_no_trailing_newline_and_utf8(tmp_path):
+    p = tmp_path / "nt.csv"
+    p.write_bytes("s,v\ncafé,1\nüber,2".encode("utf-8"))
+    fields = [_F("s", T.StringType()), _F("v", T.LongType())]
+    got = device_to_arrow(_decode(p, fields))
+    assert got.column("s").to_pylist() == ["café", "über"]
+    assert got.column("v").to_pylist() == [1, 2]
+
+
+def test_full_engine_csv_scan_device(tmp_path):
+    """Session read.csv rides the device parse by default; results equal
+    the host pipeline's and the metric proves engagement."""
+    rng = np.random.default_rng(4)
+    n = 5000
+    lines = ["k,v,s"]
+    for i in range(n):
+        lines.append(f"{rng.integers(0, 50)},{rng.random():.6f},tag-{i % 7}")
+    p = tmp_path / "big.csv"
+    p.write_text("\n".join(lines) + "\n")
+    sess = srt.session()
+    from spark_rapids_tpu.sql import functions as F
+    q = (sess.read.csv(str(p)).groupBy("s")
+         .agg(F.count("*").alias("n"), F.sum(F.col("v")).alias("sv"))
+         .orderBy("s"))
+    got = {r["s"]: r for r in q.collect().to_pylist()}
+    m = sess.last_query_metrics
+    assert m.get("csvDeviceDecodedFiles", 0) >= 1, m
+    import pandas as pd
+    pdf = pd.read_csv(p)
+    exp = pdf.groupby("s").agg(n=("v", "count"), sv=("v", "sum"))
+    assert len(got) == len(exp)
+    for s, row in exp.iterrows():
+        assert got[s]["n"] == int(row["n"])
+        assert abs(got[s]["sv"] - row["sv"]) < 1e-6
+    # off-switch exercises the host path with equal results
+    sess2 = srt.session(**{
+        "spark.rapids.sql.format.csv.deviceDecode.enabled": "false"})
+    got2 = sess2.read.csv(str(p)).orderBy("k", "s", "v").collect()
+    want = sess.read.csv(str(p)).orderBy("k", "s", "v").collect()
+    for c in want.column_names:
+        a = want.column(c).to_pylist()
+        b = got2.column(c).to_pylist()
+        if c == "v":
+            # parse_double is the engine's CAST parser — documented to
+            # sit within 1 ULP of strtod on some literals
+            assert np.allclose(a, b, rtol=1e-12), c
+        else:
+            assert a == b, c
