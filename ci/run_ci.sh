@@ -47,7 +47,7 @@ fi
 
 if [ "$MODE" != quick ]; then
     echo "=== [5/7] scale rig ==="
-    SRT_SCALE_PLATFORM=cpu timeout 1200 \
+    SRT_SCALE_PLATFORM=cpu timeout 2700 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
     echo "=== [5/7] scale rig skipped (quick) ==="
