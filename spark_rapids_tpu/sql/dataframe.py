@@ -1119,6 +1119,79 @@ def _extract_windows(exprs, plan):
     return new_exprs, plan
 
 
+def _factor_common_disjuncts(e: Expression) -> Expression:
+    """OR-of-ANDs -> common conjuncts AND (OR of per-disjunct residuals).
+
+    TPC-H q19's join condition repeats ``p_partkey = l_partkey`` inside
+    every OR branch; without factoring, no equi key is visible and the
+    join degrades to a cartesian product (Spark's optimizer performs the
+    same extraction before the reference plugin sees the plan).  The
+    common-conjunct test keys on ``semantic_key()`` (the CSE identity:
+    encodes attribute expr_ids and non-deterministic seeds), so
+    same-named columns of different relations — and independent rand()
+    draws — never falsely merge."""
+    from .expressions.predicates import And, Or
+    if not isinstance(e, Or):
+        return e
+
+    disjuncts: List[Expression] = []
+
+    def flat_or(x):
+        if isinstance(x, Or):
+            flat_or(x.children[0])
+            flat_or(x.children[1])
+        else:
+            disjuncts.append(x)
+    flat_or(e)
+
+    def flat_and(x, out):
+        if isinstance(x, And):
+            flat_and(x.children[0], out)
+            flat_and(x.children[1], out)
+        else:
+            out.append(x)
+
+    def key(x: Expression):
+        return x.semantic_key()
+
+    sets: List[List[Expression]] = []
+    for d in disjuncts:
+        cs: List[Expression] = []
+        flat_and(d, cs)
+        sets.append(cs)
+    common_keys = set(map(key, sets[0]))
+    for cs in sets[1:]:
+        common_keys &= set(map(key, cs))
+    if not common_keys:
+        return e
+    common: List[Expression] = []
+    seen = set()
+    for c in sets[0]:
+        k = key(c)
+        if k in common_keys and k not in seen:
+            seen.add(k)
+            common.append(c)
+    rests: Optional[List[Expression]] = []
+    for cs in sets:
+        rest = [c for c in cs if key(c) not in common_keys]
+        r: Optional[Expression] = None
+        for c in rest:
+            r = c if r is None else And(r, c)
+        if r is None:
+            rests = None  # a disjunct fully covered: the OR is TRUE
+            break
+        rests.append(r)
+    out: Optional[Expression] = None
+    for c in common:
+        out = c if out is None else And(out, c)
+    if rests is not None:
+        disj: Optional[Expression] = None
+        for r in rests:
+            disj = r if disj is None else Or(disj, r)
+        out = And(out, disj)
+    return out
+
+
 def _extract_equi_keys(cond: Expression, left_plan, right_plan):
     """Split a join condition into equi-keys + residual, like the
     reference's join key extraction."""
@@ -1141,7 +1214,13 @@ def _extract_equi_keys(cond: Expression, left_plan, right_plan):
             flatten(e.children[0])
             flatten(e.children[1])
         else:
-            conjuncts.append(e)
+            # q19-style OR-of-ANDs conjuncts expose their shared
+            # equalities here (may themselves flatten further)
+            factored = _factor_common_disjuncts(e)
+            if factored is not e:
+                flatten(factored)
+            else:
+                conjuncts.append(e)
     flatten(cond)
 
     lk, rk, residual = [], [], []
