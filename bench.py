@@ -562,28 +562,20 @@ def _suite_child(platform: str) -> None:
     # the device wedges on every query
     _result.update(metric="scale_suite_geomean_rows_per_sec",
                    platform=platform, queries=0)
-    tables = scaletest.build_tables(rows)
-    extra: dict = {}  # per-prefix TPC table sets, generated once
-    sess = srt.session()
     rates = []
-    for name, _fn in scaletest.QUERIES:
-        try:
-            rep = scaletest.run_suite(rows, queries=[name], tables=tables,
-                                      sess=sess, extra_tables=extra)
-        except Exception as e:
-            sys.stdout.write(json.dumps(
-                {"query": name, "error": f"{type(e).__name__}: {e}"}) + "\n")
-            sys.stdout.flush()
-            continue
-        for r in rep:
-            r["rows_per_sec"] = round(rows / max(r["warm_seconds"], 1e-9))
-            r["platform"] = platform
-            if r.get("tables_bytes"):
-                r["gb_per_s_per_chip"] = _gb_per_s(r["tables_bytes"],
-                                                   r["warm_seconds"])
+    for r in scaletest.iter_suite(rows):
+        if "error" in r:
             sys.stdout.write(json.dumps(r) + "\n")
             sys.stdout.flush()
-            rates.append(r["rows_per_sec"])
+            continue
+        r["rows_per_sec"] = round(rows / max(r["warm_seconds"], 1e-9))
+        r["platform"] = platform
+        if r.get("tables_bytes"):
+            r["gb_per_s_per_chip"] = _gb_per_s(r["tables_bytes"],
+                                               r["warm_seconds"])
+        sys.stdout.write(json.dumps(r) + "\n")
+        sys.stdout.flush()
+        rates.append(r["rows_per_sec"])
         # keep the banked summary current so the watchdog emits progress
         if rates:
             geo = math.exp(sum(math.log(max(x, 1)) for x in rates)

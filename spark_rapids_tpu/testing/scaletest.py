@@ -624,6 +624,45 @@ QUERIES: List[Tuple[str, Callable]] = [
 _TABLE_SETS = {"tpch": build_tpch_tables, "tpcds": _TDS.build_tables}
 
 
+def iter_suite(rows: int, queries=None, tables=None, sess=None,
+               extra_tables=None):
+    """Per-query streaming driver over :data:`QUERIES` with amortized
+    tables/session: yields each report record as its query completes, or
+    an ``{"query", "error"}`` record for a failing query.  The one
+    iteration loop `main()` and bench.py's suite child both consume."""
+    import spark_rapids_tpu as srt
+    tables = tables if tables is not None else build_tables(rows)
+    extra = extra_tables if extra_tables is not None else {}
+    sess = sess or srt.session()
+    for name, _fn in QUERIES:
+        if queries and name not in queries:
+            continue
+        try:
+            rep = run_suite(rows, queries=[name], tables=tables,
+                            sess=sess, extra_tables=extra)
+        except Exception as e:
+            yield {"query": name,
+                   "error": f"{type(e).__name__}: {e}"[:200]}
+            continue
+        for entry in rep:
+            yield entry
+
+
+def release_compiled_programs() -> None:
+    """Free compiled XLA executables — the ONE recipe (tests/conftest.py
+    uses the same): accumulated compiled-code state segfaults the
+    XLA:CPU JIT inside backend_compile_and_load past a few hundred
+    programs (round-4 postmortem; adding the round-5 queries pushed the
+    single-process 60-query rig over the edge again, as an
+    'LLVM compilation error: Cannot allocate memory' crash).  Each query
+    recompiles its own plan anyway; only shared kernels pay again."""
+    import jax
+
+    from ..sql.physical import kernel_cache
+    kernel_cache.clear_cache()
+    jax.clear_caches()
+
+
 class _RecordingTables(dict):
     """Table dict that records which tables a query touches, so the rig
     can report bytes-scanned per query instead of the whole set."""
@@ -668,12 +707,17 @@ def run_suite(rows: int = 50_000, queries=None, tables=None,
         else:
             t = base_tables
         rec = _RecordingTables(t)
-        t0 = time.perf_counter()
-        fn(sess, rec, F)
-        total = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        fn(sess, rec, F)  # warm engine + oracle again; compile amortized
-        warm = time.perf_counter() - t0
+        try:
+            t0 = time.perf_counter()
+            fn(sess, rec, F)
+            total = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            fn(sess, rec, F)  # warm again; compile amortized
+            warm = time.perf_counter() - t0
+        finally:
+            # ALSO on failure: a raising query must not leak its
+            # compiled programs toward the JIT-region crash
+            release_compiled_programs()
         report.append({"query": name,
                        "seconds": round(total, 3),
                        "warm_seconds": round(warm, 3),
@@ -703,8 +747,15 @@ def main() -> None:
         import jax
         jax.config.update("jax_platforms", plat)
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
-    for entry in run_suite(rows):
-        print(json.dumps(entry))
+    # stream per query (amortized tables/session) so a timeout or crash
+    # still leaves the completed queries' evidence on stdout
+    failed = 0
+    for entry in iter_suite(rows):
+        if "error" in entry:
+            failed += 1
+        print(json.dumps(entry), flush=True)
+    if failed:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -53,9 +53,8 @@ def release_compiled_caches():
     executable caches — accumulated compiled-code state segfaults the
     XLA:CPU JIT inside backend_compile_and_load past a few hundred
     programs (reproduced repeatedly, never in isolation)."""
-    from spark_rapids_tpu.sql.physical import kernel_cache
-    kernel_cache.clear_cache()
-    jax.clear_caches()
+    from spark_rapids_tpu.testing.scaletest import release_compiled_programs
+    release_compiled_programs()
 
 
 @pytest.fixture(scope="module", autouse=True)
