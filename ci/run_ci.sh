@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/7] native libraries ==="
+echo "=== [1/8] native libraries ==="
 make -C native
 
-echo "=== [2/7] API contract validation ==="
+echo "=== [2/8] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/7] docgen drift check ==="
+echo "=== [3/8] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/7] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [4/8] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -46,14 +46,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [5/7] scale rig ==="
+    echo "=== [5/8] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 2700 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [5/7] scale rig skipped (quick) ==="
+    echo "=== [5/8] scale rig skipped (quick) ==="
 fi
 
-echo "=== [6/7] packaging: wheel builds and installs ==="
+echo "=== [6/8] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -83,8 +83,46 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [7/7] driver entry checks ==="
+echo "=== [7/8] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
+
+echo "=== [8/8] second-jax shim world (gated) ==="
+# The parallel-world leg the reference proves with its 14-version shim
+# matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
+# one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg
+# GATES on a second interpreter rather than simulating one: point
+# SRT_SECOND_JAX_PYTHON at any python whose jax version differs from
+# the primary's, or drop one under /opt/pyenvs/*/bin/python3, and CI
+# runs provider probing + the quick tier inside that world for real.
+SECOND_PY="${SRT_SECOND_JAX_PYTHON:-}"
+if [ -z "$SECOND_PY" ]; then
+    primary_ver=$(python -c "import jax; print(jax.__version__)")
+    for cand in /opt/pyenvs/*/bin/python3 /opt/python*/bin/python3; do
+        [ -x "$cand" ] || continue
+        # probe runnability, not just presence: a stray env with jax
+        # but no pytest/pyarrow must be skipped, not fail CI red
+        v=$("$cand" -c "import jax, pytest, pyarrow, numpy, pandas; \
+print(jax.__version__)" 2>/dev/null) || continue
+        if [ -n "$v" ] && [ "$v" != "$primary_ver" ]; then
+            SECOND_PY="$cand"
+            break
+        fi
+    done
+fi
+if [ -n "$SECOND_PY" ]; then
+    echo "second jax world: $SECOND_PY"
+    "$SECOND_PY" - <<'PYEOF'
+import jax
+from spark_rapids_tpu.shims import get_shim
+print(f"jax {jax.__version__} -> provider: "
+      f"{type(get_shim()).__name__}: {get_shim().description()}")
+PYEOF
+    JAX_PLATFORMS=cpu "$SECOND_PY" -m pytest tests/ -m quick -x -q
+else
+    echo "SKIPPED: no second jax installation found (single-jaxlib" \
+         "image, zero pip egress — see docs/perf_notes.md); set" \
+         "SRT_SECOND_JAX_PYTHON to enable this leg"
+fi
 
 echo "CI PASSED"

@@ -109,6 +109,20 @@ def clear_cache() -> None:
     _OUT_SPECULATION.clear()
 
 
+def release_compiled_programs() -> None:
+    """Free compiled XLA executables — the ONE recipe (tests/conftest.py
+    per test module, scaletest.run_suite per query): the engine kernel
+    wrappers AND jax's executable caches.  Accumulated compiled-code
+    state segfaults the XLA:CPU JIT inside backend_compile_and_load past
+    a few hundred programs (round-4 postmortem; the round-5 60-query rig
+    reproduced it as 'LLVM compilation error: Cannot allocate memory').
+    Callers recompile their own plans anyway; only shared kernels pay
+    again."""
+    import jax
+    clear_cache()
+    jax.clear_caches()
+
+
 def expr_key(e) -> Tuple:
     """Stable structural key for a bound expression (or SortOrder)."""
     from ..plan import SortOrder

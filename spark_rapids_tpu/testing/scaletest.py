@@ -648,19 +648,11 @@ def iter_suite(rows: int, queries=None, tables=None, sess=None,
             yield entry
 
 
-def release_compiled_programs() -> None:
-    """Free compiled XLA executables — the ONE recipe (tests/conftest.py
-    uses the same): accumulated compiled-code state segfaults the
-    XLA:CPU JIT inside backend_compile_and_load past a few hundred
-    programs (round-4 postmortem; adding the round-5 queries pushed the
-    single-process 60-query rig over the edge again, as an
-    'LLVM compilation error: Cannot allocate memory' crash).  Each query
-    recompiles its own plan anyway; only shared kernels pay again."""
-    import jax
-
-    from ..sql.physical import kernel_cache
-    kernel_cache.clear_cache()
-    jax.clear_caches()
+#: re-export — the recipe lives at engine level (kernel_cache) so the
+#: test conftest does not have to import the whole 60-query rig module
+#: just to clear two caches
+from ..sql.physical.kernel_cache import (  # noqa: E402
+    release_compiled_programs)
 
 
 class _RecordingTables(dict):

@@ -48,12 +48,14 @@ def pytest_collection_modifyitems(config, items):
 
 
 def release_compiled_caches():
-    """The ONE recipe for freeing XLA executables (used per-module here
-    and per-query in test_scale): the engine's kernel wrappers AND jax's
-    executable caches — accumulated compiled-code state segfaults the
-    XLA:CPU JIT inside backend_compile_and_load past a few hundred
-    programs (reproduced repeatedly, never in isolation)."""
-    from spark_rapids_tpu.testing.scaletest import release_compiled_programs
+    """Free XLA executables (per test module here; scaletest.run_suite
+    does the same per query) — accumulated compiled-code state segfaults
+    the XLA:CPU JIT inside backend_compile_and_load past a few hundred
+    programs (reproduced repeatedly, never in isolation).  Engine-level
+    import: pulling in the whole scale rig here would turn any rig-corpus
+    import error into a suite-wide teardown failure."""
+    from spark_rapids_tpu.sql.physical.kernel_cache import (
+        release_compiled_programs)
     release_compiled_programs()
 
 

@@ -21,20 +21,6 @@ def sess():
     return srt.session()
 
 
-@pytest.fixture(autouse=True)
-def _release_per_query():
-    """conftest releases compiled programs per MODULE; this module alone
-    compiles hundreds of programs (48 query plans), which exhausts the
-    XLA:CPU JIT code region around query ~33 even in a fresh process
-    (round-4 postmortem: segfault in backend_compile_and_load, twice,
-    position-stable, every query green in isolation).  Release per QUERY
-    instead — each plan recompiles anyway, so only truly shared kernels
-    (transitions, serializers) pay again."""
-    yield
-    from conftest import release_compiled_caches
-    release_compiled_caches()
-
-
 @pytest.mark.parametrize("name", [n for n, _ in QUERIES])
 def test_scale_query(name, tables, sess):
     report = run_suite(ROWS, queries={name}, tables=tables, sess=sess)
