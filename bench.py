@@ -698,18 +698,22 @@ def _load_capture():
                 continue
             if recs and _usable_capture_record(recs[-1]):
                 ts = os.path.basename(path).split("_")[1]
-                if not SUITE and not recs[-1].get("extra_metrics"):
+                if not SUITE:
                     _graft_extra_metrics(cap_dir, recs[-1])
                 return ts, recs
     return None
 
 
 def _graft_extra_metrics(cap_dir, final) -> None:
-    """A watchdog-cut main run can bank its q1 number without the join/
-    window extra metrics; pull those from any other on-chip capture in
-    the same round so the driver artifact still carries all three
-    shapes."""
+    """A watchdog-cut main run banks only the shapes that finished before
+    the cut (cold remote compiles can eat most of a window's budget).
+    Merge the MISSING extra-metric keys from every other on-chip capture
+    in the round, newest first — the freshest measurement of each shape
+    wins, and a partial newest capture no longer hides a more complete
+    older one."""
     import glob
+    extras = final.setdefault("extra_metrics", {})
+    grafted_from = []
     for path in sorted(glob.glob(os.path.join(cap_dir, "run_*.out")),
                        reverse=True):
         try:
@@ -722,14 +726,23 @@ def _graft_extra_metrics(cap_dir, final) -> None:
                         rec = json.loads(line)
                     except ValueError:
                         continue
-                    if _usable_capture_record(rec) and \
-                            rec.get("extra_metrics"):
-                        final["extra_metrics"] = dict(rec["extra_metrics"])
-                        final["extra_metrics"]["_from_capture"] = \
-                            os.path.basename(path).split("_")[1]
-                        return
+                    if not (_usable_capture_record(rec)
+                            and rec.get("extra_metrics")):
+                        continue
+                    missing = {k: v
+                               for k, v in rec["extra_metrics"].items()
+                               if not k.startswith("_")
+                               and k not in extras}
+                    if missing:
+                        extras.update(missing)
+                        grafted_from.append(
+                            os.path.basename(path).split("_")[1])
         except OSError:
             continue
+    if grafted_from:
+        extras["_grafted_from"] = grafted_from
+    if not extras:
+        del final["extra_metrics"]
 
 
 def _await_final(child: _Child, deadline: float, attempt: int = 0):

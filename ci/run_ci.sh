@@ -47,7 +47,7 @@ fi
 
 if [ "$MODE" != quick ]; then
     echo "=== [5/8] scale rig ==="
-    SRT_SCALE_PLATFORM=cpu timeout 2700 \
+    SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
     echo "=== [5/8] scale rig skipped (quick) ==="
@@ -86,6 +86,12 @@ print('wheel OK', spark_rapids_tpu.__version__)
 echo "=== [7/8] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
+
+if [ "$MODE" = quick ]; then
+    echo "=== [8/8] second-jax shim world skipped (quick) ==="
+    echo "CI PASSED"
+    exit 0
+fi
 
 echo "=== [8/8] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim

@@ -36,6 +36,14 @@ _COUNTIES = ["C1", "C2", "C3", "C4"]
 _STORE_NAMES = ["ese", "ought", "able", "pri", "bar"]
 _FIRST = ["Ann", "Bob", "Cara", "Dev", "Eli", "Fay", "Gus", "Hana"]
 _LAST = ["Ames", "Brown", "Cole", "Diaz", "Egan", "Ford", "Gray", "Hale"]
+_STATES = ["CA", "WA", "GA", "TX", "NY", "OH", "FL", "MI"]
+_ZIPS = [f"{z:05d}" for z in
+         (85669, 86197, 88274, 83405, 80348, 81891, 60099, 90831,
+          73065, 24128, 41904, 12477, 31678, 56557, 62544, 29741,
+          48933, 74330, 95315, 67853)]
+_SM_TYPES = ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]
+_WH_NAMES = ["Conventional childr", "Important issues liv",
+             "Doors canno", "Bad cards must make", "Rooms cook"]
 
 
 def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
@@ -124,6 +132,23 @@ def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
         "ca_address_sk": pa.array(np.arange(n_addr), type=pa.int64()),
         "ca_city": pa.array(rng.choice(_CITIES, n_addr)),
         "ca_county": pa.array(rng.choice(_COUNTIES, n_addr)),
+        "ca_state": pa.array(rng.choice(_STATES, n_addr)),
+        "ca_zip": pa.array(rng.choice(_ZIPS, n_addr)),
+    })
+    n_wh = 5
+    warehouse = pa.table({
+        "w_warehouse_sk": pa.array(np.arange(n_wh), type=pa.int64()),
+        "w_warehouse_name": pa.array(_WH_NAMES[:n_wh]),
+        # deterministic round-robin, NOT rng.choice: q94/q95 filter on
+        # w_state = 'CA' and a seed that drew no CA warehouse would
+        # empty them at every scale
+        "w_state": pa.array([_STATES[i % len(_STATES)]
+                             for i in range(n_wh)]),
+    })
+    n_sm = len(_SM_TYPES)
+    ship_mode = pa.table({
+        "sm_ship_mode_sk": pa.array(np.arange(n_sm), type=pa.int64()),
+        "sm_type": pa.array(_SM_TYPES),
     })
 
     # ticket-coherent fact generation: a ticket (basket) shares ONE
@@ -182,11 +207,51 @@ def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
         "cs_list_price": pa.array(np.round(rng.random(n_cs) * 200, 2)),
         "cs_ext_sales_price": pa.array(np.round(rng.random(n_cs) * 1000,
                                                 2)),
+        "cs_sales_price": pa.array(np.round(rng.random(n_cs) * 600, 2)),
+        "cs_net_profit": pa.array(np.round(rng.random(n_cs) * 120 - 25,
+                                           2)),
+        "cs_sold_time_sk": pa.array(rng.integers(0, n_times, n_cs),
+                                    type=pa.int64()),
+        "cs_order_number": pa.array(
+            rng.integers(0, max(n_cs // 3, 8), n_cs), type=pa.int64()),
+        "cs_warehouse_sk": pa.array(rng.integers(0, n_wh, n_cs),
+                                    type=pa.int64()),
+        "cs_cdemo_sk": pa.array(rng.integers(0, n_cd, n_cs),
+                                type=pa.int64()),
+        "cs_promo_sk": pa.array(rng.integers(0, n_promo, n_cs),
+                                type=pa.int64()),
+    })
+    n_cr = max(n_cs // 5, 8)
+    cr_idx = rng.choice(n_cs, size=n_cr, replace=False)
+    catalog_returns = pa.table({
+        "cr_order_number": pa.array(
+            np.asarray(catalog_sales.column("cs_order_number"))[cr_idx],
+            type=pa.int64()),
+        "cr_item_sk": pa.array(
+            np.asarray(catalog_sales.column("cs_item_sk"))[cr_idx],
+            type=pa.int64()),
+        "cr_refunded_cash": pa.array(np.round(rng.random(n_cr) * 80, 2)),
+    })
+    n_inv = max(rows // 2, 40)
+    # inventory concentrates on 50 items so per-(warehouse,item,month)
+    # groups hold several samples — q39's stddev/mean needs group sizes
+    # > 1 (stddev_samp of a singleton is NULL and the group drops)
+    inv_items = min(n_items, 50)
+    inventory = pa.table({
+        "inv_date_sk": pa.array(rng.integers(800, 1100, n_inv),
+                                type=pa.int64()),
+        "inv_item_sk": pa.array(rng.integers(0, inv_items, n_inv),
+                                type=pa.int64()),
+        "inv_warehouse_sk": pa.array(rng.integers(0, n_wh, n_inv),
+                                     type=pa.int64()),
+        "inv_quantity_on_hand": pa.array(rng.integers(0, 1000, n_inv),
+                                         type=pa.int32()),
     })
     n_ws = max(rows // 3, 20)
+    ws_sold = rng.integers(0, n_dates, n_ws)
+    n_orders = max(n_ws // 3, 8)
     web_sales = pa.table({
-        "ws_sold_date_sk": pa.array(rng.integers(0, n_dates, n_ws),
-                                    type=pa.int64()),
+        "ws_sold_date_sk": pa.array(ws_sold, type=pa.int64()),
         "ws_bill_customer_sk": pa.array(rng.integers(0, n_cust, n_ws),
                                         type=pa.int64()),
         "ws_item_sk": pa.array(rng.integers(0, n_items, n_ws),
@@ -196,6 +261,33 @@ def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
         "ws_list_price": pa.array(np.round(rng.random(n_ws) * 200, 2)),
         "ws_ext_sales_price": pa.array(np.round(rng.random(n_ws) * 1000,
                                                 2)),
+        # shipping lag spreads across the 30/60/90/120-day bucket edges
+        # (q62's CASE counts need every bucket populated)
+        "ws_ship_date_sk": pa.array(
+            np.minimum(ws_sold + rng.integers(1, 140, n_ws), n_dates - 1),
+            type=pa.int64()),
+        "ws_sold_time_sk": pa.array(rng.integers(0, n_times, n_ws),
+                                    type=pa.int64()),
+        "ws_order_number": pa.array(rng.integers(0, n_orders, n_ws),
+                                    type=pa.int64()),
+        "ws_warehouse_sk": pa.array(rng.integers(0, n_wh, n_ws),
+                                    type=pa.int64()),
+        "ws_ship_mode_sk": pa.array(rng.integers(0, n_sm, n_ws),
+                                    type=pa.int64()),
+        "ws_ship_hdemo_sk": pa.array(rng.integers(0, n_hd, n_ws),
+                                     type=pa.int64()),
+        "ws_ext_discount_amt": pa.array(np.round(rng.random(n_ws) * 80,
+                                                 2)),
+        "ws_ext_ship_cost": pa.array(np.round(rng.random(n_ws) * 40, 2)),
+        "ws_net_profit": pa.array(np.round(rng.random(n_ws) * 110 - 20,
+                                           2)),
+    })
+    n_wr = max(n_orders // 4, 4)
+    web_returns = pa.table({
+        "wr_order_number": pa.array(
+            rng.choice(n_orders, size=n_wr, replace=False),
+            type=pa.int64()),
+        "wr_return_amt": pa.array(np.round(rng.random(n_wr) * 200, 2)),
     })
     n_sr = max(rows // 5, 10)
     ret_idx = rng.choice(rows, size=n_sr, replace=False)
@@ -215,6 +307,7 @@ def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
             np.asarray(store_sales.column("ss_ticket_number"))[ret_idx],
             type=pa.int64()),
         "sr_return_amt": pa.array(np.round(rng.random(n_sr) * 300, 2)),
+        "sr_net_loss": pa.array(np.round(rng.random(n_sr) * 90, 2)),
     })
     return {
         "store_sales": store_sales, "date_dim": date_dim, "item": item,
@@ -224,7 +317,9 @@ def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
         "time_dim": time_dim, "customer": customer,
         "customer_address": customer_address,
         "catalog_sales": catalog_sales, "web_sales": web_sales,
-        "store_returns": store_returns,
+        "store_returns": store_returns, "warehouse": warehouse,
+        "ship_mode": ship_mode, "web_returns": web_returns,
+        "catalog_returns": catalog_returns, "inventory": inventory,
     }
 
 
@@ -1180,6 +1275,847 @@ ORDER BY i_item_id, s_county
 """
 
 
+# ---------------------------------------------------------------------------
+# round-5 wave 2: shipping/returns/promotion shapes over the extended star
+# (warehouse, ship_mode, web_returns; zip/state address attributes; time-
+# keyed catalog/web facts).  New plan shapes vs wave 1: fact-fact-fact
+# chain joins (q25), IN-subquery channel CTEs (q33), date-lag CASE
+# buckets (q50/q62), scalar-block ratio cross joins (q61/q90), correlated
+# threshold subqueries (q92), and DISTINCT-count over a non-equi
+# correlated EXISTS self-join (q94).
+# ---------------------------------------------------------------------------
+
+def _oracle_q15(got, t):
+    dd = _pd(t, "date_dim")
+    cs = (_pd(t, "catalog_sales")
+          .merge(_pd(t, "customer"), left_on="cs_bill_customer_sk",
+                 right_on="c_customer_sk")
+          .merge(_pd(t, "customer_address"), left_on="c_current_addr_sk",
+                 right_on="ca_address_sk")
+          .merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk"))
+    cs = cs[(cs.d_qoy == 1) & (cs.d_year == 2000)
+            & (cs.ca_zip.str[:5].isin(_ZIPS[:5])
+               | cs.ca_state.isin(["CA", "WA", "GA"])
+               | (cs.cs_sales_price > 500))]
+    exp = (cs.groupby("ca_zip")["cs_sales_price"].sum()
+           .reset_index(name="total"))
+    _assert_rows(got, exp)
+
+
+_Q15 = f"""
+SELECT ca_zip, sum(cs_sales_price) AS total
+FROM catalog_sales, customer, customer_address, date_dim
+WHERE cs_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND (substr(ca_zip, 1, 5) IN ({", ".join(repr(z) for z in _ZIPS[:5])})
+       OR ca_state IN ('CA', 'WA', 'GA') OR cs_sales_price > 500)
+  AND cs_sold_date_sk = d_date_sk AND d_qoy = 1 AND d_year = 2000
+GROUP BY ca_zip
+ORDER BY ca_zip
+"""
+
+
+def _oracle_q25(got, t):
+    dd = _pd(t, "date_dim").set_index("d_date_sk")["d_year"]
+    ss = _pd(t, "store_sales")
+    ss = ss[ss.ss_sold_date_sk.map(dd) == 2000]
+    sr = _pd(t, "store_returns")
+    sr = sr[sr.sr_returned_date_sk.map(dd).isin([2000, 2001])]
+    cs = _pd(t, "catalog_sales")
+    cs = cs[cs.cs_sold_date_sk.map(dd).isin([2000, 2001])]
+    m = ss.merge(sr, left_on=["ss_customer_sk", "ss_item_sk",
+                              "ss_ticket_number"],
+                 right_on=["sr_customer_sk", "sr_item_sk",
+                           "sr_ticket_number"])
+    m = m.merge(cs, left_on=["sr_customer_sk", "sr_item_sk"],
+                right_on=["cs_bill_customer_sk", "cs_item_sk"])
+    m = (m.merge(_pd(t, "item"), left_on="ss_item_sk",
+                 right_on="i_item_sk")
+         .merge(_pd(t, "store"), left_on="ss_store_sk",
+                right_on="s_store_sk"))
+    exp = (m.groupby(["i_item_id", "s_store_name"])
+           .agg(store_profit=("ss_net_profit", "sum"),
+                return_loss=("sr_net_loss", "sum"),
+                catalog_profit=("cs_net_profit", "sum")).reset_index())
+    _assert_rows(got, exp)
+
+
+_Q25 = """
+SELECT i_item_id, s_store_name,
+       sum(ss_net_profit) AS store_profit,
+       sum(sr_net_loss) AS return_loss,
+       sum(cs_net_profit) AS catalog_profit
+FROM store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, item, store
+WHERE d1.d_year = 2000 AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk AND d2.d_year IN (2000, 2001)
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk AND d3.d_year IN (2000, 2001)
+GROUP BY i_item_id, s_store_name
+ORDER BY i_item_id, s_store_name
+"""
+
+
+def _oracle_q33(got, t):
+    item = _pd(t, "item")
+    dd = _pd(t, "date_dim").set_index("d_date_sk")["d_year"]
+    manufacts = set(item[item.i_category_id == 3].i_manufact_id)
+
+    def chan(fact, item_col, date_col, price):
+        f = _pd(t, fact)
+        f = f[f[date_col].map(dd) == 1999]
+        m = f.merge(item, left_on=item_col, right_on="i_item_sk")
+        m = m[m.i_manufact_id.isin(manufacts)]
+        return m.groupby("i_manufact_id")[price].sum()
+    tot = (chan("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                "ss_ext_sales_price")
+           .add(chan("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                     "cs_ext_sales_price"), fill_value=0)
+           .add(chan("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                     "ws_ext_sales_price"), fill_value=0))
+    exp = tot.reset_index()
+    exp.columns = ["i_manufact_id", "total_sales"]
+    _assert_rows(got, exp)
+
+
+def _q33_chan(fact, item_col, date_col, price):
+    return f"""
+  SELECT i_manufact_id, sum({price}) AS total_sales
+  FROM {fact}, date_dim, item
+  WHERE {date_col} = d_date_sk AND {item_col} = i_item_sk
+    AND i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category_id = 3)
+    AND d_year = 1999
+  GROUP BY i_manufact_id"""
+
+
+_Q33 = f"""
+WITH ss AS ({_q33_chan('store_sales', 'ss_item_sk', 'ss_sold_date_sk',
+                       'ss_ext_sales_price')}),
+cs AS ({_q33_chan('catalog_sales', 'cs_item_sk', 'cs_sold_date_sk',
+                  'cs_ext_sales_price')}),
+ws AS ({_q33_chan('web_sales', 'ws_item_sk', 'ws_sold_date_sk',
+                  'ws_ext_sales_price')})
+SELECT i_manufact_id, sum(total_sales) AS total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_manufact_id
+ORDER BY i_manufact_id
+"""
+
+
+#: the 30/60/90/120-day lag buckets shared by q50 (return lag) and q62
+#: (ship lag) — one definition each for the SQL CASE chain and the
+#: oracle columns so a bucket-edge tweak cannot desynchronize them
+_LAG_EDGES = [(None, 30, "d30"), (30, 60, "d60"), (60, 90, "d90"),
+              (90, 120, "d120"), (120, None, "dmore")]
+
+
+def _lag_bucket_sql(lag_expr: str) -> str:
+    parts = []
+    for lo, hi, name in _LAG_EDGES:
+        conds = []
+        if lo is not None:
+            conds.append(f"{lag_expr} > {lo}")
+        if hi is not None:
+            conds.append(f"{lag_expr} <= {hi}")
+        parts.append(f"  sum(CASE WHEN {' AND '.join(conds)}\n"
+                     f"           THEN 1 ELSE 0 END) AS {name}")
+    return ",\n".join(parts)
+
+
+def _lag_bucket_agg(m: pd.DataFrame, lag: pd.Series, keys: List[str]):
+    cols = {}
+    for lo, hi, name in _LAG_EDGES:
+        mask = pd.Series(True, index=lag.index)
+        if lo is not None:
+            mask &= lag > lo
+        if hi is not None:
+            mask &= lag <= hi
+        cols[name] = mask.astype(int)
+    return (m.assign(**cols).groupby(keys)
+            [[name for _, _, name in _LAG_EDGES]].sum().reset_index())
+
+
+def _oracle_q50(got, t):
+    dd = _pd(t, "date_dim")
+    ss = _pd(t, "store_sales")
+    sr = _pd(t, "store_returns")
+    m = ss.merge(sr, left_on=["ss_ticket_number", "ss_item_sk",
+                              "ss_customer_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk",
+                           "sr_customer_sk"])
+    m = m.merge(dd, left_on="sr_returned_date_sk", right_on="d_date_sk")
+    m = m[m.d_year == 2000]
+    m = m.merge(_pd(t, "store"), left_on="ss_store_sk",
+                right_on="s_store_sk")
+    exp = _lag_bucket_agg(m, m.sr_returned_date_sk - m.ss_sold_date_sk,
+                          ["s_store_name"])
+    _assert_rows(got, exp)
+
+
+_Q50 = f"""
+SELECT s_store_name,
+{_lag_bucket_sql('sr_returned_date_sk - ss_sold_date_sk')}
+FROM store_sales, store_returns, store, date_dim d2
+WHERE ss_ticket_number = sr_ticket_number AND ss_item_sk = sr_item_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND sr_returned_date_sk = d2.d_date_sk AND d2.d_year = 2000
+  AND ss_store_sk = s_store_sk
+GROUP BY s_store_name
+ORDER BY s_store_name
+"""
+
+
+def _oracle_q61(got, t):
+    base = _merged(t, ["date_dim", "store", "customer", "item"])
+    base = base.merge(_pd(t, "customer_address"),
+                      left_on="c_current_addr_sk",
+                      right_on="ca_address_sk")
+    base = base[(base.d_year == 2000) & (base.s_county == "C1")
+                & (base.ca_county.isin(["C1", "C2"]))
+                & (base.i_category_id == 3)]
+    promo = base.merge(_pd(t, "promotion"), left_on="ss_promo_sk",
+                       right_on="p_promo_sk")
+    promo = promo[(promo.p_channel_email == "Y")
+                  | (promo.p_channel_event == "Y")]
+    p, tot = promo.ss_ext_sales_price.sum(), base.ss_ext_sales_price.sum()
+    exp = pd.DataFrame({"promotions": [p], "total": [tot],
+                        "ratio": [p / tot * 100]})
+    _assert_rows(got, exp)
+
+
+_Q61_COMMON = """
+  FROM store_sales{extra_tables}, store, date_dim, customer,
+       customer_address, item
+  WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+    AND ss_customer_sk = c_customer_sk
+    AND ca_address_sk = c_current_addr_sk AND ss_item_sk = i_item_sk
+    AND s_county = 'C1' AND ca_county IN ('C1', 'C2')
+    AND i_category_id = 3 AND d_year = 2000"""
+
+_Q61 = f"""
+SELECT promotions, total, promotions / total * 100 AS ratio
+FROM (SELECT sum(ss_ext_sales_price) AS promotions
+  {_Q61_COMMON.format(extra_tables=', promotion')}
+    AND ss_promo_sk = p_promo_sk
+    AND (p_channel_email = 'Y' OR p_channel_event = 'Y')) promotional,
+ (SELECT sum(ss_ext_sales_price) AS total
+  {_Q61_COMMON.format(extra_tables='')}) all_sales
+"""
+
+
+def _oracle_q62(got, t):
+    ws = _pd(t, "web_sales")
+    m = (ws.merge(_pd(t, "warehouse"), left_on="ws_warehouse_sk",
+                  right_on="w_warehouse_sk")
+         .merge(_pd(t, "ship_mode"), left_on="ws_ship_mode_sk",
+                right_on="sm_ship_mode_sk")
+         .merge(_pd(t, "date_dim"), left_on="ws_ship_date_sk",
+                right_on="d_date_sk"))
+    m = m[m.d_year == 2000]
+    m = m.assign(wname=m.w_warehouse_name.str[:20])
+    exp = _lag_bucket_agg(m, m.ws_ship_date_sk - m.ws_sold_date_sk,
+                          ["wname", "sm_type"])
+    _assert_rows(got, exp)
+
+
+_Q62 = f"""
+SELECT substr(w_warehouse_name, 1, 20) AS wname, sm_type,
+{_lag_bucket_sql('ws_ship_date_sk - ws_sold_date_sk')}
+FROM web_sales, warehouse, ship_mode, date_dim
+WHERE ws_ship_date_sk = d_date_sk AND d_year = 2000
+  AND ws_warehouse_sk = w_warehouse_sk
+  AND ws_ship_mode_sk = sm_ship_mode_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type
+ORDER BY wname, sm_type
+"""
+
+
+def _oracle_q71(got, t):
+    item = _pd(t, "item")
+    item = item[item.i_manager_id <= 20]
+    dd = _pd(t, "date_dim")
+    td = _pd(t, "time_dim")
+
+    def chan(fact, item_col, date_col, time_col, price):
+        f = _pd(t, fact)
+        m = f.merge(dd, left_on=date_col, right_on="d_date_sk")
+        m = m[(m.d_moy == 11) & (m.d_year == 1999)]
+        return pd.DataFrame({"price": m[price], "item_sk": m[item_col],
+                             "time_sk": m[time_col]})
+    allc = pd.concat([
+        chan("web_sales", "ws_item_sk", "ws_sold_date_sk",
+             "ws_sold_time_sk", "ws_ext_sales_price"),
+        chan("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+             "cs_sold_time_sk", "cs_ext_sales_price"),
+        chan("store_sales", "ss_item_sk", "ss_sold_date_sk",
+             "ss_sold_time_sk", "ss_ext_sales_price")])
+    m = (allc.merge(item, left_on="item_sk", right_on="i_item_sk")
+         .merge(td, left_on="time_sk", right_on="t_time_sk"))
+    m = m[m.t_hour.between(8, 10)]
+    exp = (m.groupby(["i_brand_id", "i_brand", "t_hour", "t_minute"])
+           ["price"].sum().reset_index(name="ext_price"))
+    exp = exp[["i_brand_id", "i_brand", "t_hour", "t_minute",
+               "ext_price"]]
+    _assert_rows(got, exp)
+
+
+_Q71 = """
+SELECT i_brand_id, i_brand, t_hour, t_minute,
+       sum(ext_price) AS ext_price
+FROM item,
+ (SELECT ws_ext_sales_price AS ext_price, ws_item_sk AS sold_item_sk,
+         ws_sold_time_sk AS time_sk
+  FROM web_sales, date_dim
+  WHERE d_date_sk = ws_sold_date_sk AND d_moy = 11 AND d_year = 1999
+  UNION ALL
+  SELECT cs_ext_sales_price, cs_item_sk, cs_sold_time_sk
+  FROM catalog_sales, date_dim
+  WHERE d_date_sk = cs_sold_date_sk AND d_moy = 11 AND d_year = 1999
+  UNION ALL
+  SELECT ss_ext_sales_price, ss_item_sk, ss_sold_time_sk
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk AND d_moy = 11
+    AND d_year = 1999) tmp,
+ time_dim
+WHERE sold_item_sk = i_item_sk AND i_manager_id <= 20
+  AND time_sk = t_time_sk AND t_hour BETWEEN 8 AND 10
+GROUP BY i_brand_id, i_brand, t_hour, t_minute
+ORDER BY ext_price DESC, i_brand_id, t_hour, t_minute
+"""
+
+
+def _q90_count(t, h0, h1):
+    ws = _pd(t, "web_sales")
+    m = (ws.merge(_pd(t, "household_demographics"),
+                  left_on="ws_ship_hdemo_sk", right_on="hd_demo_sk")
+         .merge(_pd(t, "time_dim"), left_on="ws_sold_time_sk",
+                right_on="t_time_sk"))
+    return len(m[(m.t_hour.between(h0, h1)) & (m.hd_dep_count == 3)])
+
+
+def _oracle_q90(got, t):
+    amc, pmc = _q90_count(t, 7, 9), _q90_count(t, 17, 19)
+    exp = pd.DataFrame({"am_pm_ratio": [amc * 1.0 / pmc]})
+    _assert_rows(got, exp)
+
+
+def _q90_block(alias, h0, h1):
+    return (f"(SELECT count(*) AS {alias} "
+            f"FROM web_sales, household_demographics, time_dim "
+            f"WHERE ws_ship_hdemo_sk = hd_demo_sk "
+            f"AND ws_sold_time_sk = t_time_sk "
+            f"AND t_hour BETWEEN {h0} AND {h1} "
+            f"AND hd_dep_count = 3)")
+
+
+_Q90 = f"""
+SELECT amc * 1.0 / pmc AS am_pm_ratio
+FROM {_q90_block('amc', 7, 9)} at, {_q90_block('pmc', 17, 19)} pt
+"""
+
+
+def _oracle_q92(got, t):
+    dd = _pd(t, "date_dim").set_index("d_date_sk")["d_year"]
+    ws = _pd(t, "web_sales")
+    ws = ws[ws.ws_sold_date_sk.map(dd) == 2000]
+    item = _pd(t, "item")
+    thresh = (ws.groupby("ws_item_sk")["ws_ext_discount_amt"]
+              .mean() * 1.3)
+    m = ws.merge(item, left_on="ws_item_sk", right_on="i_item_sk")
+    m = m[m.i_manufact_id <= 30]
+    m = m[m.ws_ext_discount_amt > m.ws_item_sk.map(thresh)]
+    exp = pd.DataFrame({"excess": [m.ws_ext_discount_amt.sum()]})
+    _assert_rows(got, exp)
+
+
+_Q92 = """
+SELECT sum(ws_ext_discount_amt) AS excess
+FROM web_sales ws1, item, date_dim
+WHERE i_item_sk = ws1.ws_item_sk AND i_manufact_id <= 30
+  AND ws1.ws_sold_date_sk = d_date_sk AND d_year = 2000
+  AND ws1.ws_ext_discount_amt >
+      (SELECT 1.3 * avg(ws_ext_discount_amt)
+       FROM web_sales ws2, date_dim d2
+       WHERE ws2.ws_item_sk = ws1.ws_item_sk
+         AND ws2.ws_sold_date_sk = d2.d_date_sk AND d2.d_year = 2000)
+"""
+
+
+def _ws_order_stats(t, returned_polarity: bool):
+    """Shared q94/q95 oracle: multi-warehouse CA-shipped year-2000 web
+    orders, kept (q95) or excluded (q94) by web_returns membership;
+    returns the (order_count, shipping, profit) frame with SQL's
+    sum-over-zero-rows-is-NULL semantics."""
+    dd = _pd(t, "date_dim").set_index("d_date_sk")["d_year"]
+    ws = _pd(t, "web_sales")
+    wh_per_order = ws.groupby("ws_order_number")["ws_warehouse_sk"] \
+        .nunique()
+    returned = set(_pd(t, "web_returns").wr_order_number)
+    m = ws[ws.ws_ship_date_sk.map(dd) == 2000]
+    m = m.merge(_pd(t, "warehouse"), left_on="ws_warehouse_sk",
+                right_on="w_warehouse_sk")
+    m = m[m.w_state == "CA"]
+    m = m[m.ws_order_number.map(wh_per_order) > 1]
+    is_ret = m.ws_order_number.isin(returned)
+    m = m[is_ret] if returned_polarity else m[~is_ret]
+    return pd.DataFrame({
+        "order_count": [m.ws_order_number.nunique()],
+        "total_shipping_cost": [m.ws_ext_ship_cost.sum()
+                                if len(m) else np.nan],
+        "total_net_profit": [m.ws_net_profit.sum()
+                             if len(m) else np.nan],
+    })
+
+
+def _oracle_q94(got, t):
+    _assert_rows(got, _ws_order_stats(t, returned_polarity=False))
+
+
+_Q94 = """
+SELECT count(DISTINCT ws_order_number) AS order_count,
+       sum(ws_ext_ship_cost) AS total_shipping_cost,
+       sum(ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, warehouse
+WHERE ws1.ws_ship_date_sk = d_date_sk AND d_year = 2000
+  AND ws1.ws_warehouse_sk = w_warehouse_sk AND w_state = 'CA'
+  AND EXISTS (SELECT * FROM web_sales ws2
+              WHERE ws1.ws_order_number = ws2.ws_order_number
+                AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  AND NOT EXISTS (SELECT * FROM web_returns wr1
+                  WHERE ws1.ws_order_number = wr1.wr_order_number)
+"""
+
+
+# ---------------------------------------------------------------------------
+# round-5 wave 3: inventory / catalog-returns shapes.  New plan stress:
+# HAVING on a ratio of conditional sums (q21), inventory semi-join window
+# (q37), LEFT JOIN on a composite key + coalesce in a CASE split (q40),
+# day-of-week CASE pivot (q43), OR-of-ANDs with join predicates inside
+# the disjunction — the common-conjunct factoring path (q48), and
+# CTE-backed IN-subquery chains over a self-join (q95).
+# ---------------------------------------------------------------------------
+
+def _oracle_q21(got, t):
+    inv = _pd(t, "inventory")
+    inv = inv[(inv.inv_date_sk >= 840) & (inv.inv_date_sk <= 960)]
+    m = (inv.merge(_pd(t, "warehouse"), left_on="inv_warehouse_sk",
+                   right_on="w_warehouse_sk")
+         .merge(_pd(t, "item"), left_on="inv_item_sk",
+                right_on="i_item_sk"))
+    m = m.assign(
+        before=np.where(m.inv_date_sk < 900, m.inv_quantity_on_hand, 0),
+        after=np.where(m.inv_date_sk >= 900, m.inv_quantity_on_hand, 0))
+    g = (m.groupby(["w_warehouse_name", "i_item_id"])
+         .agg(inv_before=("before", "sum"),
+              inv_after=("after", "sum")).reset_index())
+    exp = g[(g.inv_before > 0) & (g.inv_after * 3 >= g.inv_before * 2)
+            & (g.inv_after * 2 <= g.inv_before * 3)]
+    _assert_rows(got, exp)
+
+
+_Q21 = """
+SELECT w_warehouse_name, i_item_id,
+       sum(CASE WHEN inv_date_sk < 900
+                THEN inv_quantity_on_hand ELSE 0 END) AS inv_before,
+       sum(CASE WHEN inv_date_sk >= 900
+                THEN inv_quantity_on_hand ELSE 0 END) AS inv_after
+FROM inventory, warehouse, item, date_dim
+WHERE inv_item_sk = i_item_sk AND inv_warehouse_sk = w_warehouse_sk
+  AND inv_date_sk = d_date_sk AND d_date_sk BETWEEN 840 AND 960
+GROUP BY w_warehouse_name, i_item_id
+HAVING sum(CASE WHEN inv_date_sk < 900
+                THEN inv_quantity_on_hand ELSE 0 END) > 0
+   AND sum(CASE WHEN inv_date_sk >= 900
+                THEN inv_quantity_on_hand ELSE 0 END) * 3
+       >= sum(CASE WHEN inv_date_sk < 900
+                   THEN inv_quantity_on_hand ELSE 0 END) * 2
+   AND sum(CASE WHEN inv_date_sk >= 900
+                THEN inv_quantity_on_hand ELSE 0 END) * 2
+       <= sum(CASE WHEN inv_date_sk < 900
+                   THEN inv_quantity_on_hand ELSE 0 END) * 3
+ORDER BY w_warehouse_name, i_item_id
+"""
+
+
+def _oracle_q37(got, t):
+    item = _pd(t, "item")
+    item = item[item.i_current_price.between(20, 50)
+                & (item.i_manufact_id <= 40)]
+    inv = _pd(t, "inventory")
+    inv = inv[(inv.inv_date_sk.between(900, 960))
+              & (inv.inv_quantity_on_hand.between(100, 500))]
+    cs_items = set(_pd(t, "catalog_sales").cs_item_sk)
+    m = item[item.i_item_sk.isin(set(inv.inv_item_sk)) &
+             item.i_item_sk.isin(cs_items)]
+    exp = (m[["i_item_id", "i_current_price"]].drop_duplicates())
+    _assert_rows(got, exp)
+
+
+_Q37 = """
+SELECT i_item_id, i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 20 AND 50 AND i_manufact_id <= 40
+  AND inv_item_sk = i_item_sk AND d_date_sk = inv_date_sk
+  AND d_date_sk BETWEEN 900 AND 960
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_current_price
+ORDER BY i_item_id
+"""
+
+
+def _oracle_q40(got, t):
+    cs = _pd(t, "catalog_sales")
+    cr = _pd(t, "catalog_returns")
+    m = cs.merge(cr, left_on=["cs_order_number", "cs_item_sk"],
+                 right_on=["cr_order_number", "cr_item_sk"], how="left")
+    m = (m.merge(_pd(t, "warehouse"), left_on="cs_warehouse_sk",
+                 right_on="w_warehouse_sk")
+         .merge(_pd(t, "item"), left_on="cs_item_sk",
+                right_on="i_item_sk"))
+    m = m[m.i_current_price.between(20, 70)
+          & m.cs_sold_date_sk.between(840, 960)]
+    net = m.cs_sales_price - m.cr_refunded_cash.fillna(0.0)
+    m = m.assign(before=np.where(m.cs_sold_date_sk < 900, net, 0.0),
+                 after=np.where(m.cs_sold_date_sk >= 900, net, 0.0))
+    exp = (m.groupby(["w_state", "i_item_id"])
+           .agg(sales_before=("before", "sum"),
+                sales_after=("after", "sum")).reset_index())
+    _assert_rows(got, exp)
+
+
+_Q40 = """
+SELECT w_state, i_item_id,
+  sum(CASE WHEN cs_sold_date_sk < 900
+           THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+           ELSE 0 END) AS sales_before,
+  sum(CASE WHEN cs_sold_date_sk >= 900
+           THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+           ELSE 0 END) AS sales_after
+FROM catalog_sales LEFT JOIN catalog_returns
+  ON (cs_order_number = cr_order_number AND cs_item_sk = cr_item_sk),
+  warehouse, item, date_dim
+WHERE i_current_price BETWEEN 20 AND 70 AND i_item_sk = cs_item_sk
+  AND cs_warehouse_sk = w_warehouse_sk AND cs_sold_date_sk = d_date_sk
+  AND d_date_sk BETWEEN 840 AND 960
+GROUP BY w_state, i_item_id
+ORDER BY w_state, i_item_id
+"""
+
+
+def _oracle_q43(got, t):
+    pdf = _merged(t, ["date_dim", "store"])
+    pdf = pdf[pdf.d_year == 2000]
+    cols = {}
+    for d, nm in enumerate(("sun", "mon", "tue", "wed", "thu", "fri",
+                            "sat")):
+        cols[f"{nm}_sales"] = np.where(pdf.d_dow == d,
+                                       pdf.ss_sales_price, 0.0)
+    exp = (pd.DataFrame({"s_store_name": pdf.s_store_name, **cols})
+           .groupby("s_store_name").sum().reset_index())
+    _assert_rows(got, exp)
+
+
+_Q43 = """
+SELECT s_store_name,
+  sum(CASE WHEN d_dow = 0 THEN ss_sales_price ELSE 0 END) AS sun_sales,
+  sum(CASE WHEN d_dow = 1 THEN ss_sales_price ELSE 0 END) AS mon_sales,
+  sum(CASE WHEN d_dow = 2 THEN ss_sales_price ELSE 0 END) AS tue_sales,
+  sum(CASE WHEN d_dow = 3 THEN ss_sales_price ELSE 0 END) AS wed_sales,
+  sum(CASE WHEN d_dow = 4 THEN ss_sales_price ELSE 0 END) AS thu_sales,
+  sum(CASE WHEN d_dow = 5 THEN ss_sales_price ELSE 0 END) AS fri_sales,
+  sum(CASE WHEN d_dow = 6 THEN ss_sales_price ELSE 0 END) AS sat_sales
+FROM date_dim, store_sales, store
+WHERE d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND d_year = 2000
+GROUP BY s_store_name
+ORDER BY s_store_name
+"""
+
+
+def _oracle_q48(got, t):
+    ss = _pd(t, "store_sales")
+    cd = _pd(t, "customer_demographics")
+    ca = _pd(t, "customer_address")
+    m = (ss.merge(_pd(t, "store"), left_on="ss_store_sk",
+                  right_on="s_store_sk")
+         .merge(_pd(t, "date_dim"), left_on="ss_sold_date_sk",
+                right_on="d_date_sk")
+         .merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+         .merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk"))
+    m = m[m.d_year == 2000]
+    c1 = ((m.cd_marital_status == "M")
+          & (m.cd_education_status == "Advanced Degree")
+          & m.ss_sales_price.between(100.0, 150.0))
+    c2 = ((m.cd_marital_status == "S")
+          & (m.cd_education_status == "College")
+          & m.ss_sales_price.between(50.0, 100.0))
+    c3 = ((m.cd_marital_status == "W")
+          & (m.cd_education_status == "Secondary")
+          & m.ss_sales_price.between(0.0, 50.0))
+    a1 = m.ca_state.isin(["CA", "WA"]) & m.ss_net_profit.between(0, 50)
+    a2 = m.ca_state.isin(["GA", "TX"]) & m.ss_net_profit.between(50, 80)
+    a3 = m.ca_state.isin(["NY", "OH"]) & m.ss_net_profit.between(-20, 20)
+    m = m[(c1 | c2 | c3) & (a1 | a2 | a3)]
+    exp = pd.DataFrame({"total_quantity": [int(m.ss_quantity.sum())]})
+    _assert_rows(got, exp)
+
+
+_Q48 = """
+SELECT sum(ss_quantity) AS total_quantity
+FROM store_sales, store, customer_demographics, customer_address,
+     date_dim
+WHERE s_store_sk = ss_store_sk AND ss_sold_date_sk = d_date_sk
+  AND d_year = 2000
+  AND ((cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'M'
+        AND cd_education_status = 'Advanced Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00)
+    OR (cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'S'
+        AND cd_education_status = 'College'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00)
+    OR (cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'W'
+        AND cd_education_status = 'Secondary'
+        AND ss_sales_price BETWEEN 0.00 AND 50.00))
+  AND ((ss_addr_sk = ca_address_sk AND ca_state IN ('CA', 'WA')
+        AND ss_net_profit BETWEEN 0 AND 50)
+    OR (ss_addr_sk = ca_address_sk AND ca_state IN ('GA', 'TX')
+        AND ss_net_profit BETWEEN 50 AND 80)
+    OR (ss_addr_sk = ca_address_sk AND ca_state IN ('NY', 'OH')
+        AND ss_net_profit BETWEEN -20 AND 20))
+"""
+
+
+def _oracle_q95(got, t):
+    # q95's second IN keeps only orders that appear in web_returns (the
+    # join to ws_wh re-asserts multi-warehouse): inverted polarity vs q94
+    _assert_rows(got, _ws_order_stats(t, returned_polarity=True))
+
+
+_Q95 = """
+WITH ws_wh AS (
+  SELECT ws1.ws_order_number
+  FROM web_sales ws1, web_sales ws2
+  WHERE ws1.ws_order_number = ws2.ws_order_number
+    AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+SELECT count(DISTINCT ws_order_number) AS order_count,
+       sum(ws_ext_ship_cost) AS total_shipping_cost,
+       sum(ws_net_profit) AS total_net_profit
+FROM web_sales ws1, date_dim, warehouse
+WHERE ws1.ws_ship_date_sk = d_date_sk AND d_year = 2000
+  AND ws1.ws_warehouse_sk = w_warehouse_sk AND w_state = 'CA'
+  AND ws1.ws_order_number IN (SELECT ws_order_number FROM ws_wh)
+  AND ws1.ws_order_number IN (SELECT wr_order_number
+                              FROM web_returns, ws_wh
+                              WHERE wr_order_number = ws_wh.ws_order_number)
+"""
+
+
+# ---------------------------------------------------------------------------
+# round-5 wave 4: catalog demographics (q26), inventory coefficient-of-
+# variation with STDDEV_SAMP + month self-join (q39), three-channel
+# revenue-band join over a thrice-reused CTE (q58), 3-level ROLLUP over
+# the catalog star (q18 shape).
+# ---------------------------------------------------------------------------
+
+def _oracle_q26(got, t):
+    m = (_pd(t, "catalog_sales")
+         .merge(_pd(t, "customer_demographics"), left_on="cs_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(_pd(t, "date_dim"), left_on="cs_sold_date_sk",
+                right_on="d_date_sk")
+         .merge(_pd(t, "item"), left_on="cs_item_sk",
+                right_on="i_item_sk")
+         .merge(_pd(t, "promotion"), left_on="cs_promo_sk",
+                right_on="p_promo_sk"))
+    m = m[(m.cd_gender == "F") & (m.cd_marital_status == "S")
+          & (m.cd_education_status == "College")
+          & ((m.p_channel_email == "N") | (m.p_channel_event == "N"))
+          & (m.d_year == 2000)]
+    exp = (m.groupby("i_item_id")
+           .agg(agg1=("cs_quantity", "mean"),
+                agg2=("cs_list_price", "mean"),
+                agg3=("cs_sales_price", "mean")).reset_index())
+    _assert_rows(got, exp)
+
+
+_Q26 = """
+SELECT i_item_id, avg(cs_quantity) AS agg1,
+       avg(cs_list_price) AS agg2, avg(cs_sales_price) AS agg3
+FROM catalog_sales, customer_demographics, date_dim, item, promotion
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_cdemo_sk = cd_demo_sk AND cs_promo_sk = p_promo_sk
+  AND cd_gender = 'F' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND (p_channel_email = 'N' OR p_channel_event = 'N')
+  AND d_year = 2000
+GROUP BY i_item_id
+ORDER BY i_item_id
+"""
+
+
+def _inv_cov(t, moy):
+    m = (_pd(t, "inventory")
+         .merge(_pd(t, "date_dim"), left_on="inv_date_sk",
+                right_on="d_date_sk"))
+    m = m[(m.d_year == 2000) & (m.d_moy == moy)]
+    g = (m.groupby(["inv_warehouse_sk", "inv_item_sk"])
+         ["inv_quantity_on_hand"].agg(["mean", "std"]).reset_index())
+    g = g[g["std"] / g["mean"] > 0.5]
+    g["cov"] = g["std"] / g["mean"]
+    return g
+
+
+def _oracle_q39(got, t):
+    a, b = _inv_cov(t, 4), _inv_cov(t, 5)
+    exp = a.merge(b, on=["inv_warehouse_sk", "inv_item_sk"],
+                  suffixes=("_1", "_2"))[
+        ["inv_warehouse_sk", "inv_item_sk", "mean_1", "cov_1",
+         "mean_2", "cov_2"]]
+    _assert_rows(got, exp)
+
+
+def _q39_cte(moy):
+    return f"""
+  SELECT inv_warehouse_sk AS w, inv_item_sk AS i,
+         avg(inv_quantity_on_hand) AS qty_mean,
+         stddev_samp(inv_quantity_on_hand)
+           / avg(inv_quantity_on_hand) AS qty_cov
+  FROM inventory, date_dim
+  WHERE inv_date_sk = d_date_sk AND d_year = 2000 AND d_moy = {moy}
+  GROUP BY inv_warehouse_sk, inv_item_sk
+  HAVING stddev_samp(inv_quantity_on_hand)
+           / avg(inv_quantity_on_hand) > 0.5"""
+
+
+_Q39 = f"""
+WITH inv1 AS ({_q39_cte(4)}), inv2 AS ({_q39_cte(5)})
+SELECT inv1.w, inv1.i, inv1.qty_mean AS mean_1, inv1.qty_cov AS cov_1,
+       inv2.qty_mean AS mean_2, inv2.qty_cov AS cov_2
+FROM inv1, inv2
+WHERE inv1.w = inv2.w AND inv1.i = inv2.i
+ORDER BY inv1.w, inv1.i
+"""
+
+
+def _oracle_q58(got, t):
+    dd = _pd(t, "date_dim").set_index("d_date_sk")["d_year"]
+    item = _pd(t, "item")
+
+    def chan(fact, item_col, date_col, price):
+        f = _pd(t, fact)
+        f = f[f[date_col].map(dd) == 1999]
+        m = f.merge(item, left_on=item_col, right_on="i_item_sk")
+        return m.groupby("i_item_id")[price].sum()
+    ss = chan("store_sales", "ss_item_sk", "ss_sold_date_sk",
+              "ss_ext_sales_price")
+    cs = chan("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+              "cs_ext_sales_price")
+    ws = chan("web_sales", "ws_item_sk", "ws_sold_date_sk",
+              "ws_ext_sales_price")
+    j = (ss.rename("ss_rev").to_frame()
+         .join(cs.rename("cs_rev"), how="inner")
+         .join(ws.rename("ws_rev"), how="inner"))
+    avg = (j.ss_rev + j.cs_rev + j.ws_rev) / 3.0
+    keep = ((j.ss_rev.between(0.5 * avg, 2.0 * avg))
+            & (j.cs_rev.between(0.5 * avg, 2.0 * avg))
+            & (j.ws_rev.between(0.5 * avg, 2.0 * avg)))
+    exp = j[keep].reset_index()
+    exp["average"] = avg[keep].values
+    _assert_rows(got, exp)
+
+
+def _q58_cte(alias, fact, item_col, date_col, price):
+    return f"""
+{alias} AS (
+  SELECT i_item_id AS item_id, sum({price}) AS revenue
+  FROM {fact}, item, date_dim
+  WHERE {item_col} = i_item_sk AND {date_col} = d_date_sk
+    AND d_year = 1999
+  GROUP BY i_item_id)"""
+
+
+_Q58 = f"""
+WITH {_q58_cte('ss_items', 'store_sales', 'ss_item_sk',
+               'ss_sold_date_sk', 'ss_ext_sales_price')},
+{_q58_cte('cs_items', 'catalog_sales', 'cs_item_sk', 'cs_sold_date_sk',
+          'cs_ext_sales_price')},
+{_q58_cte('ws_items', 'web_sales', 'ws_item_sk', 'ws_sold_date_sk',
+          'ws_ext_sales_price')}
+SELECT ss_items.item_id, ss_items.revenue AS ss_rev,
+       cs_items.revenue AS cs_rev, ws_items.revenue AS ws_rev,
+       (ss_items.revenue + cs_items.revenue + ws_items.revenue) / 3
+         AS average
+FROM ss_items, cs_items, ws_items
+WHERE ss_items.item_id = cs_items.item_id
+  AND ss_items.item_id = ws_items.item_id
+  AND ss_items.revenue BETWEEN
+      0.5 * (ss_items.revenue + cs_items.revenue + ws_items.revenue) / 3
+      AND 2.0 * (ss_items.revenue + cs_items.revenue + ws_items.revenue) / 3
+  AND cs_items.revenue BETWEEN
+      0.5 * (ss_items.revenue + cs_items.revenue + ws_items.revenue) / 3
+      AND 2.0 * (ss_items.revenue + cs_items.revenue + ws_items.revenue) / 3
+  AND ws_items.revenue BETWEEN
+      0.5 * (ss_items.revenue + cs_items.revenue + ws_items.revenue) / 3
+      AND 2.0 * (ss_items.revenue + cs_items.revenue + ws_items.revenue) / 3
+ORDER BY ss_items.item_id
+"""
+
+
+def _oracle_q18(got, t):
+    m = (_pd(t, "catalog_sales")
+         .merge(_pd(t, "customer_demographics"), left_on="cs_cdemo_sk",
+                right_on="cd_demo_sk")
+         .merge(_pd(t, "customer"), left_on="cs_bill_customer_sk",
+                right_on="c_customer_sk")
+         .merge(_pd(t, "customer_address"), left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+         .merge(_pd(t, "date_dim"), left_on="cs_sold_date_sk",
+                right_on="d_date_sk")
+         .merge(_pd(t, "item"), left_on="cs_item_sk",
+                right_on="i_item_sk"))
+    m = m[(m.cd_gender == "F") & (m.cd_education_status == "College")
+          & (m.d_year == 2000)]
+
+    def level(keys):
+        if keys:
+            g = (m.groupby(keys)
+                 .agg(agg1=("cs_quantity", "mean"),
+                      agg2=("cs_list_price", "mean")).reset_index())
+        else:
+            g = pd.DataFrame({"agg1": [m.cs_quantity.mean()],
+                              "agg2": [m.cs_list_price.mean()]})
+        for col in ("i_item_id", "ca_state", "ca_county"):
+            if col not in g.columns:
+                g[col] = np.nan
+        return g[["i_item_id", "ca_state", "ca_county", "agg1", "agg2"]]
+    exp = pd.concat([level(["i_item_id", "ca_state", "ca_county"]),
+                     level(["i_item_id", "ca_state"]),
+                     level(["i_item_id"]), level([])],
+                    ignore_index=True)
+    _assert_rows(got, exp)
+
+
+_Q18 = """
+SELECT i_item_id, ca_state, ca_county,
+       avg(cs_quantity) AS agg1, avg(cs_list_price) AS agg2
+FROM catalog_sales, customer_demographics, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cs_cdemo_sk = cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND cd_gender = 'F' AND cd_education_status = 'College'
+  AND d_year = 2000
+GROUP BY ROLLUP(i_item_id, ca_state, ca_county)
+ORDER BY i_item_id, ca_state, ca_county
+"""
+
+
 #: (name, sql, oracle) — consumed by scaletest.QUERIES via make_runner
 QUERY_SET: List[Tuple[str, str, Callable]] = [
     ("q34_ticket_counts", _Q34, _oracle_q34),
@@ -1207,6 +2143,29 @@ QUERY_SET: List[Tuple[str, str, Callable]] = [
     ("q87_except", _Q87, _oracle_q87),
     ("q93_returns_net", _Q93, _oracle_q93),
     ("q97_full_outer", _Q97, _oracle_q97),
+    # round-5 wave 2: shipping/returns/promotion shapes
+    ("q15_zip_or_filter", _Q15, _oracle_q15),
+    ("q25_fact_chain", _Q25, _oracle_q25),
+    ("q33_in_subq_channels", _Q33, _oracle_q33),
+    ("q50_return_lag", _Q50, _oracle_q50),
+    ("q61_promo_ratio", _Q61, _oracle_q61),
+    ("q62_ship_lag", _Q62, _oracle_q62),
+    ("q71_brand_time", _Q71, _oracle_q71),
+    ("q90_am_pm", _Q90, _oracle_q90),
+    ("q92_excess_discount", _Q92, _oracle_q92),
+    ("q94_multi_warehouse", _Q94, _oracle_q94),
+    # round-5 wave 3: inventory / catalog-returns shapes
+    ("q21_inventory_ratio", _Q21, _oracle_q21),
+    ("q37_inventory_window", _Q37, _oracle_q37),
+    ("q40_returns_split", _Q40, _oracle_q40),
+    ("q43_dow_pivot", _Q43, _oracle_q43),
+    ("q48_or_of_ands", _Q48, _oracle_q48),
+    ("q95_cte_in_chains", _Q95, _oracle_q95),
+    # round-5 wave 4: catalog demographics / inventory CoV / revenue bands
+    ("q18_rollup3", _Q18, _oracle_q18),
+    ("q26_catalog_demo", _Q26, _oracle_q26),
+    ("q39_inventory_cov", _Q39, _oracle_q39),
+    ("q58_revenue_bands", _Q58, _oracle_q58),
 ]
 
 
