@@ -408,6 +408,17 @@ CSV_DEVICE_DECODE = register(
     "parse failures against the plan schema decline to the host pyarrow "
     "reader (reference device parse: GpuCSVScan.scala:355 "
     "Table.readCSV).", True)
+JSON_DEVICE_DECODE = register(
+    "spark.rapids.sql.format.json.deviceDecode.enabled",
+    "Parse JSON-lines on the device: the host scans only structure "
+    "(quote spans by parity, structural colons/commas/braces outside "
+    "strings, key and value byte spans — all vectorized), and value "
+    "bytes gather into matrices and parse through the same Spark-exact "
+    "cast_strings kernels the CAST matrix uses.  Escapes, nested "
+    "objects/arrays, multiLine mode, single-quote syntax, CRLF and any "
+    "value failing to parse as the plan schema's type decline to the "
+    "host pyarrow reader (reference device parse: GpuJsonScan via "
+    "GpuTextBasedPartitionReader, Table.readJSON).", True)
 ORC_DEVICE_DECODE = register(
     "spark.rapids.sql.format.orc.deviceDecode.enabled",
     "Decode ORC stripes on the device: the host parses only structure "

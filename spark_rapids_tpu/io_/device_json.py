@@ -1,0 +1,343 @@
+"""Device-side JSON-lines decode (reference ``GpuJsonScan`` riding
+``GpuTextBasedPartitionReader.scala`` — ``Table.readJSON`` takes a host
+buffer and parses on the GPU).  Same architecture as the CSV decoder
+(:mod:`.device_csv`): the host does O(structure) work ONLY — vectorized
+numpy scans locating quote spans (by quote-count parity), the structural
+colons/commas/braces that sit OUTSIDE strings, and from them the key and
+value byte spans per row — and the device does the per-value work: value
+bytes gather into matrices (:func:`.device_parquet.gather_string_matrix`)
+and parse through the Spark-exact ``ops/cast_strings`` kernels, so
+JSON-parsed and CAST-parsed values can never disagree.
+
+Decline-to-host discipline (pyarrow keeps serving what's outside the
+envelope): any backslash escape, nested objects/arrays, single-quote
+syntax, multiLine mode, CRLF/BOM, blank interior lines, non-object rows,
+duplicate keys, malformed token structure, non-numeric number tokens
+(``NaN``/``Infinity``/``-inf`` — the ``allowNonNumericNumbers`` surface
+stays host-side; number spans are checked against the JSON number
+character set so the permissive cast parsers can never see them) — and
+any present value that fails to parse as the plan schema's type.  One
+deliberate permissive edge vs strict Jackson: leading zeros in integers
+parse rather than erroring.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import (DeviceColumn, bucket_capacity, bucket_width,
+                               null_column)
+from .device_parquet import (_buf_to_words, _max_string_matrix_bytes,
+                             gather_string_matrix)
+
+_QUOTE, _COLON, _COMMA = ord('"'), ord(':'), ord(',')
+_OBRACE, _CBRACE, _OBRACKET = ord('{'), ord('}'), ord('[')
+_SPACE, _TAB, _NL = 32, 9, 10
+
+#: value-token classes (host-side classification of the trimmed span)
+_NUMBER, _STRING, _TRUE, _FALSE, _NULL = 0, 1, 2, 3, 4
+
+
+def _in_string(q: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    """True where ``pos`` falls strictly inside a quoted span.  With no
+    escapes in the file (declined earlier), quotes strictly alternate
+    open/close, so a position after an odd number of quotes is inside."""
+    return (np.searchsorted(q, pos) % 2) == 1
+
+
+def _structural(buf: np.ndarray, q: np.ndarray, byte: int) -> np.ndarray:
+    pos = np.flatnonzero(buf == byte).astype(np.int64)
+    return pos[~_in_string(q, pos)]
+
+
+def _trim(buf: np.ndarray, vs: np.ndarray, ve: np.ndarray):
+    """Trim spaces/tabs from both ends of half-open spans [vs, ve) —
+    bounded iteration (each pass is one vectorized step; >32 pad spaces
+    around a JSON value does not occur in machine-written data, and the
+    caller declines if any span still starts/ends with whitespace)."""
+    for _ in range(32):
+        lead = (vs < ve) & np.isin(buf[np.minimum(vs, len(buf) - 1)],
+                                   (_SPACE, _TAB))
+        if not lead.any():
+            break
+        vs = vs + lead
+    for _ in range(32):
+        trail = (vs < ve) & np.isin(
+            buf[np.maximum(ve - 1, 0)], (_SPACE, _TAB))
+        if not trail.any():
+            break
+        ve = ve - trail
+    return vs, ve
+
+
+def decode_file(path: str, options: Dict, out_fields, tctx=None,
+                conf=None, raw: Optional[bytes] = None
+                ) -> Optional[ColumnarBatch]:
+    """Decode one JSON-lines file into a :class:`ColumnarBatch` typed by
+    the plan's output fields, or ``None`` to decline to the host reader.
+    Callers that already read the file pass ``raw`` so a decline does
+    not re-read it from disk."""
+    if str(options.get("multiLine", "false")).lower() == "true":
+        return None
+    if str(options.get("allowComments", "false")).lower() == "true":
+        return None
+    if str(options.get("primitivesAsString", "false")).lower() == "true":
+        return None
+
+    if raw is None:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None
+    if not raw or raw.startswith(b"\xef\xbb\xbf"):
+        return None
+    if b"\\" in raw or b"\r" in raw:
+        return None  # escapes / CRLF: host
+    buf = np.frombuffer(raw, np.uint8)
+
+    q = np.flatnonzero(buf == _QUOTE).astype(np.int64)
+    if len(q) % 2:
+        return None  # unbalanced quotes
+    # raw newline inside a string is invalid JSON anyway: host decides
+    nl = np.flatnonzero(buf == _NL).astype(np.int64)
+    if _in_string(q, nl).any():
+        return None
+    if raw[-1:] == b"\n":
+        ends = nl
+    else:
+        ends = np.append(nl, len(raw)).astype(np.int64)
+    starts = np.concatenate([[0], nl + 1]).astype(np.int64)[:len(ends)]
+    if len(starts) == 0 or (starts == ends).any():
+        return None  # blank lines: host
+    n = len(starts)
+    # every row must be exactly {...} with no padding around the braces
+    if (buf[starts] != _OBRACE).any() or (buf[ends - 1] != _CBRACE).any():
+        return None
+
+    if len(_structural(buf, q, _OBRACKET)):
+        return None  # arrays: host
+    if len(_structural(buf, q, ord("'"))):
+        return None  # single-quote syntax (allowSingleQuotes): host
+    obr = _structural(buf, q, _OBRACE)
+    cbr = _structural(buf, q, _CBRACE)
+    if not (np.array_equal(obr, starts) and np.array_equal(cbr, ends - 1)):
+        return None  # nested objects / stray braces: host
+    colons = _structural(buf, q, _COLON)
+    commas = _structural(buf, q, _COMMA)
+
+    # nonspace prefix sums: NS[b] - NS[a] = nonspace count in [a, b)
+    NS = np.concatenate(
+        [[0], np.cumsum(~np.isin(buf, (_SPACE, _TAB)))]).astype(np.int64)
+
+    def all_space(a, b):  # vectorized over span arrays
+        return NS[b] - NS[a] == 0
+
+    # ---- keys: the quote pair immediately before each structural colon
+    qi = np.searchsorted(q, colons)
+    if (qi < 2).any():
+        return None
+    kclose = q[qi - 1]
+    kopen = q[qi - 2]
+    if not all_space(kclose + 1, colons).all():
+        return None  # junk between key close-quote and colon
+    # the token before each key's open quote must be '{' or ',' —
+    # catches missing commas ({"a":1 "b":2}) and leading commas
+    ts = np.sort(np.concatenate([obr, cbr, colons, commas]))
+    pi = np.searchsorted(ts, kopen) - 1
+    if (pi < 0).any():
+        return None
+    pred = ts[pi]
+    if (~np.isin(buf[pred], (_OBRACE, _COMMA))).any():
+        return None
+    if not all_space(pred + 1, kopen).all():
+        return None
+    # every comma must introduce a key (no trailing/dangling commas)
+    if not np.array_equal(np.unique(pred[buf[pred] == _COMMA]), commas):
+        return None
+
+    line_of = np.searchsorted(starts, colons, side="right") - 1
+    # empty-object rows ({} / {  }) are valid: all columns null there
+    ncolons = np.bincount(line_of, minlength=n)
+    empty_rows = np.flatnonzero(ncolons == 0)
+    if len(empty_rows) and not all_space(starts[empty_rows] + 1,
+                                         ends[empty_rows] - 1).all():
+        return None
+
+    # ---- values: colon+1 up to the next structural comma / close brace
+    term = np.sort(np.concatenate([commas, cbr]))
+    tix = np.searchsorted(term, colons)
+    if (tix >= len(term)).any():
+        return None
+    vend = term[tix]
+    vs, ve = _trim(buf, colons + 1, vend)
+    if (vs >= ve).any():
+        return None  # empty value
+    lead = buf[vs]
+    trail = buf[ve - 1]
+    if np.isin(lead, (_SPACE, _TAB)).any() or \
+            np.isin(trail, (_SPACE, _TAB)).any():
+        return None  # >32 pad spaces: outside the envelope
+
+    # classify each value span
+    cls = np.full(len(colons), -1, np.int8)
+    is_num = ((lead >= ord("0")) & (lead <= ord("9"))) | (lead == ord("-"))
+    if is_num.any():
+        # every byte of a number span must be in the JSON number
+        # character set — otherwise tokens like ``-inf`` would reach the
+        # (deliberately permissive) Spark cast parsers and mis-parse
+        # where the host oracle errors
+        num_ok = np.zeros(256, bool)
+        for ch in b"0123456789.eE+-":
+            num_ok[ch] = True
+        BADNUM = np.concatenate(
+            [[0], np.cumsum(~num_ok[buf])]).astype(np.int64)
+        if (BADNUM[ve[is_num]] - BADNUM[vs[is_num]] != 0).any():
+            return None
+    cls[is_num] = _NUMBER
+    quoted = lead == _QUOTE
+    if quoted.any():
+        sq = np.searchsorted(q, vs[quoted])
+        okq = ((sq % 2 == 0) & (sq + 1 < len(q)) & (q[sq] == vs[quoted])
+               & (q[sq + 1] == ve[quoted] - 1))
+        if not okq.all():
+            return None  # value not exactly one quoted span
+        cls[quoted] = _STRING
+    lit = ~is_num & ~quoted
+    if lit.any():
+        lvs, lve = vs[lit], ve[lit]
+        llen = lve - lvs
+        five = buf[np.minimum(lvs[:, None] + np.arange(5), len(buf) - 1)]
+        m_true = (llen == 4) & (five[:, :4] == np.frombuffer(
+            b"true", np.uint8)).all(1)
+        m_false = (llen == 5) & (five == np.frombuffer(
+            b"false", np.uint8)).all(1)
+        m_null = (llen == 4) & (five[:, :4] == np.frombuffer(
+            b"null", np.uint8)).all(1)
+        if not (m_true | m_false | m_null).all():
+            return None  # bare token that is not true/false/null
+        sub = np.full(len(lvs), _NULL, np.int8)
+        sub[m_true] = _TRUE
+        sub[m_false] = _FALSE
+        cls[lit] = sub
+    # string content spans exclude the quotes
+    vs = np.where(cls == _STRING, vs + 1, vs)
+    ve = np.where(cls == _STRING, ve - 1, ve)
+
+    # ---- key -> column matching
+    klen = kclose - kopen - 1
+    kstart = kopen + 1
+    names = [f.name for f in out_fields]
+    maxk = max((len(s.encode()) for s in names), default=1) or 1
+    kbytes = buf[np.minimum(kstart[:, None] + np.arange(maxk),
+                            len(buf) - 1)]
+
+    capacity = bucket_capacity(n)
+    max_bytes = _max_string_matrix_bytes(conf)
+    words = _buf_to_words(raw)
+    from ..ops import cast_strings as CS
+    cols = []
+    fail_counts = []
+    for fld in out_fields:
+        dt = fld.dtype if hasattr(fld, "dtype") else fld.data_type
+        nb = np.frombuffer(fld.name.encode(), np.uint8)
+        if len(nb) == 0 or len(nb) > maxk:
+            return None
+        hit = (klen == len(nb)) & (
+            kbytes[:, :len(nb)] == nb[None, :]).all(1)
+        rows = line_of[hit]
+        if len(rows) and np.bincount(rows).max() > 1:
+            return None  # duplicate key in a row: host decides
+        if isinstance(dt, T.NullType):
+            if (cls[hit] != _NULL).any():
+                return None  # inferred all-null column has a value
+            cols.append(null_column(dt, capacity))
+            continue
+        vcls = np.full(n, _NULL, np.int8)
+        vcls[rows] = cls[hit]
+        starts_np = np.zeros(n, np.int64)
+        starts_np[rows] = vs[hit]
+        lens_np = np.zeros(n, np.int64)
+        lens_np[rows] = (ve - vs)[hit]
+        present_np = vcls != _NULL
+
+        # per-type token-class envelope (Jackson/Spark semantics: a
+        # wrong-class token is a corrupt record, so: host)
+        if isinstance(dt, T.StringType):
+            want = vcls == _STRING
+        elif isinstance(dt, T.BooleanType):
+            want = (vcls == _TRUE) | (vcls == _FALSE)
+        elif isinstance(dt, (T.DateType, T.TimestampType)):
+            want = vcls == _STRING
+        elif T.is_integral(dt) or isinstance(
+                dt, (T.FloatType, T.DoubleType, T.DecimalType)):
+            want = vcls == _NUMBER
+        else:
+            return None  # nested/unsupported plan type
+        if (present_np & ~want).any():
+            if tctx is not None:
+                tctx.inc_metric("jsonDeviceParseDeclines")
+            return None
+
+        w = bucket_width(int(lens_np.max()) if len(rows) else 1)
+        if capacity * w > max_bytes:
+            return None  # ragged guard: the host path width-splits
+        sp = np.zeros(capacity, np.int64)
+        sp[:n] = starts_np
+        lp = np.zeros(capacity, np.int32)
+        lp[:n] = lens_np
+        pv = np.zeros(capacity, bool)
+        pv[:n] = present_np
+        starts_d = jnp.asarray(sp)
+        lens_d = jnp.asarray(lp)
+        present = jnp.asarray(pv)
+        chars = gather_string_matrix(words, starts_d, lens_d, w, capacity)
+        if isinstance(dt, T.StringType):
+            cols.append(DeviceColumn(
+                dt, chars, present,
+                lengths=jnp.where(present, lens_d, 0)))
+            continue
+        if T.is_integral(dt):
+            v, ok = CS.parse_long(jnp, chars, lens_d, present)
+            if dt.np_dtype.itemsize < 8:
+                info = np.iinfo(dt.np_dtype)
+                ok = ok & (v >= int(info.min)) & (v <= int(info.max))
+            data = v.astype(dt.np_dtype)
+        elif isinstance(dt, (T.FloatType, T.DoubleType)):
+            v, ok = CS.parse_double(jnp, chars, lens_d, present)
+            data = v.astype(dt.np_dtype)
+        elif isinstance(dt, T.BooleanType):
+            data, ok = CS.parse_bool(jnp, chars, lens_d, present)
+        elif isinstance(dt, T.DateType):
+            data, ok = CS.parse_date(jnp, chars, lens_d, present)
+        elif isinstance(dt, T.TimestampType):
+            data, ok = CS.parse_timestamp(jnp, chars, lens_d, present)
+        elif isinstance(dt, T.DecimalType) and dt.is_long_backed:
+            data, ok = CS.parse_decimal(jnp, chars, lens_d, present,
+                                        dt.precision, dt.scale)
+        else:  # decimal128
+            lo, hi, ok = CS.parse_decimal128(jnp, chars, lens_d, present,
+                                             dt.precision, dt.scale)
+            fail_counts.append(jnp.sum(present & ~ok))
+            cols.append(DeviceColumn(dt, lo, ok & present, aux=hi))
+            continue
+        # a present value the parser rejected means the plan's type
+        # doesn't fit the data — decline, never null-fill
+        fail_counts.append(jnp.sum(present & ~ok))
+        valid = ok & present
+        cols.append(DeviceColumn(dt, jnp.where(valid, data, 0), valid))
+
+    if fail_counts:
+        total = int(jnp.stack(fail_counts).sum())
+        if total:
+            if tctx is not None:
+                tctx.inc_metric("jsonDeviceParseDeclines")
+            return None
+    if tctx is not None:
+        tctx.inc_metric("jsonDeviceDecodedFiles")
+    return ColumnarBatch.make(tuple(names), cols, n)

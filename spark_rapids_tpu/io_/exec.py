@@ -17,7 +17,8 @@ import numpy as np
 
 from .. import types as T
 from ..columnar.convert import arrow_to_device
-from ..config import (CSV_DEVICE_DECODE, MULTITHREAD_READ_NUM_THREADS,
+from ..config import (CSV_DEVICE_DECODE, JSON_DEVICE_DECODE,
+                      MULTITHREAD_READ_NUM_THREADS,
                       ORC_DEVICE_DECODE, PARQUET_DEVICE_DECODE,
                       PARQUET_PUSHDOWN_ENABLED, PARQUET_READER_TYPE,
                       READER_CHUNKED, READER_CHUNKED_TARGET_ROWS,
@@ -273,6 +274,35 @@ class FileScanExec(PhysicalPlan):
             yield from upload(schema0.empty_table())
         yield from extra
 
+    def _text_device_scan(self, pid, tctx, upload, opts, decode_fn,
+                          host_read_fn):
+        """Shared read-decode-decline protocol for the text-format device
+        parsers (CSV and JSON): read the bytes once, try the device
+        decoder, and on decline re-parse the SAME bytes on host — no
+        second disk/cloud read.  Yields the batches and returns True when
+        this path served the partition; False (unreadable file / decoder
+        wants the full host machinery) lets the caller's host path run
+        and raise its own errors."""
+        import io as _io
+
+        import jax
+        path = resolve_read_path(self.files[pid], self.conf)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return False
+        batch = decode_fn(path, opts, self.node.output, tctx, self.conf,
+                          raw=raw)
+        if batch is not None:
+            if self.backend == CPU:
+                batch = jax.device_get(batch)
+            yield batch
+            return True
+        for piece in upload(host_read_fn(_io.BytesIO(raw), opts)):
+            yield piece
+        return True
+
     def execute(self, pid: int, tctx: TaskContext):
         import jax
 
@@ -356,32 +386,22 @@ class FileScanExec(PhysicalPlan):
             yield from self._execute_orc_device(self.files[pid], tctx,
                                                 upload)
             return
-        if bool(self.conf.get(CSV_DEVICE_DECODE)):
-            opts = dict(self.node.options)
-            if registry._normalize_fmt(self.node.fmt, opts) == "csv":
-                from .device_csv import decode_file as _csv_decode
-                path = resolve_read_path(self.files[pid], self.conf)
-                try:
-                    with open(path, "rb") as f:
-                        raw = f.read()
-                except OSError:
-                    raw = None
-                batch = None if raw is None else _csv_decode(
-                    path, opts, self.node.output, tctx, self.conf,
-                    raw=raw)
-                if batch is not None:
-                    if self.backend == CPU:
-                        batch = jax.device_get(batch)
-                    yield batch
-                    return
-                if raw is not None:
-                    # decline: re-parse the SAME bytes on host — no
-                    # second disk/cloud read
-                    import io as _io
-                    yield from upload(registry.read_csv_source(
-                        _io.BytesIO(raw), opts))
-                    return
-                # unreadable file: the host path raises its own error
+        opts = dict(self.node.options)
+        text_fmt = registry._normalize_fmt(self.node.fmt, opts)
+        if text_fmt == "csv" and bool(self.conf.get(CSV_DEVICE_DECODE)):
+            from .device_csv import decode_file as _decode
+            done = yield from self._text_device_scan(
+                pid, tctx, upload, opts, _decode,
+                registry.read_csv_source)
+            if done:
+                return
+        if text_fmt == "json" and bool(self.conf.get(JSON_DEVICE_DECODE)):
+            from .device_json import decode_file as _decode
+            done = yield from self._text_device_scan(
+                pid, tctx, upload, opts, _decode,
+                registry.read_json_source)
+            if done:
+                return
         if self.reader_type == "MULTITHREADED":
             # per-partition prefetch through a shared pool: submit this file
             # read on a worker thread so decode overlaps device compute

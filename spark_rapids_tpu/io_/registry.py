@@ -88,6 +88,17 @@ def read_csv_source(src, options: Dict,
     return t
 
 
+def read_json_source(src, options: Dict,
+                     columns: Optional[List[str]] = None) -> pa.Table:
+    """JSON-lines parse over a path OR a file-like source (the device
+    decoder's decline path re-parses the bytes it already read)."""
+    import pyarrow.json as pjson
+    t = pjson.read_json(src)
+    if columns:
+        t = t.select(columns)
+    return t
+
+
 def read_file(fmt: str, path: str, options: Dict,
               columns: Optional[List[str]] = None,
               head_rows: Optional[int] = None) -> pa.Table:
@@ -101,11 +112,7 @@ def read_file(fmt: str, path: str, options: Dict,
     if fmt == "csv":
         return read_csv_source(path, options, columns)
     if fmt == "json":
-        import pyarrow.json as pjson
-        t = pjson.read_json(path)
-        if columns:
-            t = t.select(columns)
-        return t
+        return read_json_source(path, options, columns)
     if fmt == "avro":
         from .avro_reader import read_avro
         t = read_avro(path)

@@ -1,0 +1,196 @@
+"""Device-side JSON-lines parse (io_/device_json.py) — oracle-equal
+against the host pyarrow reader; every out-of-envelope shape must
+DECLINE (return None), never mis-parse.  Reference: ``GpuJsonScan`` via
+``GpuTextBasedPartitionReader.scala``."""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import device_to_arrow
+from spark_rapids_tpu.io_.device_json import decode_file
+
+
+class _F:
+    def __init__(self, name, dtype):
+        self.name = name
+        self.dtype = dtype
+
+
+def _decode(path, fields, options=None):
+    return decode_file(str(path), options or {}, fields)
+
+
+def test_basic_types(tmp_path):
+    p = tmp_path / "t.json"
+    p.write_text(
+        '{"i": 1, "f": 1.5, "s": "alpha", "b": true, "d": "2020-01-31"}\n'
+        '{"i": -42, "f": 2.25e3, "s": "beta", "b": false,'
+        ' "d": "1999-12-01"}\n'
+        '{"i": null, "f": null, "s": null, "b": null, "d": null}\n'
+        '{"i": 7, "f": -0.125, "b": true, "d": "2024-02-29"}\n')
+    fields = [_F("i", T.LongType()), _F("f", T.DoubleType()),
+              _F("s", T.StringType()), _F("b", T.BooleanType()),
+              _F("d", T.DateType())]
+    b = _decode(p, fields)
+    assert b is not None
+    got = device_to_arrow(b)
+    assert got.column("i").to_pylist() == [1, -42, None, 7]
+    assert got.column("f").to_pylist() == [1.5, 2250.0, None, -0.125]
+    assert got.column("s").to_pylist() == ["alpha", "beta", None, None]
+    assert got.column("b").to_pylist() == [True, False, None, True]
+    assert got.column("d").to_pylist() == [
+        datetime.date(2020, 1, 31), datetime.date(1999, 12, 1), None,
+        datetime.date(2024, 2, 29)]
+
+
+def test_key_order_and_extra_keys(tmp_path):
+    p = tmp_path / "k.json"
+    p.write_text(
+        '{"a": 1, "b": 2, "zzz": 9}\n'
+        '{"b": 20, "a": 10}\n'
+        '{}\n')
+    fields = [_F("a", T.LongType()), _F("b", T.LongType())]
+    got = device_to_arrow(_decode(p, fields))
+    assert got.column("a").to_pylist() == [1, 10, None]
+    assert got.column("b").to_pylist() == [2, 20, None]
+
+
+def test_strings_with_structural_chars(tmp_path):
+    p = tmp_path / "s.json"
+    p.write_text(
+        '{"s": "x:y, {z}", "n": 1}\n'
+        '{"s": "", "n": 2}\n'
+        '{"s": "it\'s [fine]", "n": 3}\n')
+    fields = [_F("s", T.StringType()), _F("n", T.LongType())]
+    got = device_to_arrow(_decode(p, fields))
+    assert got.column("s").to_pylist() == ["x:y, {z}", "", "it's [fine]"]
+    assert got.column("n").to_pylist() == [1, 2, 3]
+
+
+def test_whitespace_and_empty_objects(tmp_path):
+    p = tmp_path / "w.json"
+    p.write_text('{"a":1,"b":  2 }\n{  }\n{"b":3}\n')
+    fields = [_F("a", T.LongType()), _F("b", T.LongType())]
+    got = device_to_arrow(_decode(p, fields))
+    assert got.column("a").to_pylist() == [1, None, None]
+    assert got.column("b").to_pylist() == [2, None, 3]
+
+
+def test_int_widths_and_timestamp(tmp_path):
+    p = tmp_path / "w.json"
+    p.write_text(
+        '{"a": 127, "t": "2021-06-01 12:34:56"}\n'
+        '{"a": -128, "t": "1970-01-01 00:00:00"}\n')
+    fields = [_F("a", T.ByteType()), _F("t", T.TimestampType())]
+    got = device_to_arrow(_decode(p, fields))
+    assert got.column("a").to_pylist() == [127, -128]
+    assert [t.replace(tzinfo=None) for t in got.column("t").to_pylist()] \
+        == [datetime.datetime(2021, 6, 1, 12, 34, 56),
+            datetime.datetime(1970, 1, 1)]
+    # out-of-range for the plan type -> decline (inference drift)
+    p2 = tmp_path / "w2.json"
+    p2.write_text('{"a": 127}\n{"a": 300}\n')
+    assert _decode(p2, [_F("a", T.ByteType())]) is None
+
+
+def test_decimal(tmp_path):
+    p = tmp_path / "d.json"
+    p.write_text('{"x": 12.34}\n{"x": -0.05}\n{"x": null}\n')
+    dt = T.DecimalType(9, 2)
+    got = device_to_arrow(_decode(p, [_F("x", dt)]))
+    import decimal
+    assert got.column("x").to_pylist() == [
+        decimal.Decimal("12.34"), decimal.Decimal("-0.05"), None]
+
+
+def test_wrong_token_class_declines(tmp_path):
+    # quoted number for a long column: Jackson calls it corrupt -> host
+    p = tmp_path / "q.json"
+    p.write_text('{"a": "1"}\n')
+    assert _decode(p, [_F("a", T.LongType())]) is None
+    # bare number for a string column -> host
+    p2 = tmp_path / "q2.json"
+    p2.write_text('{"s": 5}\n')
+    assert _decode(p2, [_F("s", T.StringType())]) is None
+    # parse failure against plan type -> decline, never null-fill
+    p3 = tmp_path / "q3.json"
+    p3.write_text('{"a": 1}\n{"a": 1.5}\n')
+    assert _decode(p3, [_F("a", T.LongType())]) is None
+
+
+@pytest.mark.parametrize("content", [
+    b'{"a": "x\\ny"}\n',            # escape sequence
+    b'{"a": {"b": 1}}\n',           # nested object
+    b'{"a": [1, 2]}\n',             # array
+    b"{'a': 1}\n",                  # single-quote syntax
+    b'{"a": 1}\r\n',                # CRLF
+    b'{"a": 1}\n\n{"a": 2}\n',      # blank interior line
+    b'\xef\xbb\xbf{"a": 1}\n',      # BOM
+    b'{"a": 1,}\n',                 # trailing comma
+    b'{"a": 1 "b": 2}\n',           # missing comma
+    b'{"a": }\n',                   # empty value
+    b'{"a": tru}\n',                # bad literal
+    b'{"a": 1} \n',                 # padding outside braces
+    b'[{"a": 1}]\n',                # top-level array
+    b'{"a": 1, "a": 2}\n',          # duplicate key
+    b'{"a": "unterminated}\n',      # unbalanced quote
+])
+def test_out_of_envelope_declines(tmp_path, content):
+    p = tmp_path / "d.json"
+    p.write_bytes(content)
+    assert _decode(p, [_F("a", T.LongType()),
+                       _F("b", T.LongType())]) is None
+
+
+@pytest.mark.parametrize("tok", ["-inf", "-Infinity", "Infinity", "NaN",
+                                 "-INFINITY", "1f", "0x10"])
+def test_non_numeric_number_tokens_decline(tmp_path, tok):
+    """The cast parsers are deliberately permissive (Spark CAST accepts
+    'Infinity'); the JSON number envelope must keep such tokens on the
+    host where the oracle errors — never a device mis-parse."""
+    p = tmp_path / "n.json"
+    p.write_text('{"x": %s}\n' % tok)
+    assert _decode(p, [_F("x", T.DoubleType())]) is None
+
+
+def test_options_decline(tmp_path):
+    p = tmp_path / "o.json"
+    p.write_text('{"a": 1}\n')
+    f = [_F("a", T.LongType())]
+    assert _decode(p, f, {"multiLine": "true"}) is None
+    assert _decode(p, f, {"primitivesAsString": "true"}) is None
+
+
+def test_engine_end_to_end_oracle(tmp_path):
+    """Through the session read path: device decode must agree with the
+    pyarrow oracle and the engagement metric must fire."""
+    import pyarrow.json as pjson
+    sess = srt.session()
+    rng = np.random.default_rng(11)
+    n = 500
+    path = tmp_path / "e.json"
+    with open(path, "w") as f:
+        for k in range(n):
+            parts = []
+            if k % 7:
+                parts.append(f'"i": {int(rng.integers(-10**9, 10**9))}')
+            parts.append(f'"f": {float(rng.random()):.6f}')
+            parts.append(f'"s": "v-{k}"' if k % 3 else '"s": null')
+            parts.append(f'"b": {"true" if k % 2 else "false"}')
+            f.write("{" + ", ".join(parts) + "}\n")
+    exp = pjson.read_json(str(path))
+    got = sess.read.json(str(path)).collect()
+    assert got.num_rows == n
+    for col in ("i", "s", "b"):
+        assert got.column(col).to_pylist() == \
+            exp.column(col).to_pylist(), col
+    # string->double conversion may differ from pyarrow's by 1 ulp
+    assert np.allclose(got.column("f").to_pylist(),
+                       exp.column("f").to_pylist(), rtol=1e-12)
+    m = sess.last_query_metrics
+    assert m.get("jsonDeviceDecodedFiles", 0) >= 1, m
