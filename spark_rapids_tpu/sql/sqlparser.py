@@ -2088,6 +2088,120 @@ class QueryBuilder:
         return df.join(inner, on=cond,
                        how="left_anti" if negated else "left_semi")
 
+    def _apply_embedded_subqueries(self, df, conjuncts, scope, ctes):
+        """[NOT] IN / EXISTS predicates nested under OR/CASE: the
+        existence-join rewrite (Spark's RewritePredicateSubquery
+        ExistenceJoin form, reference ``ExistenceJoin.scala``).  Each
+        subquery contributes marker columns — a LEFT OUTER join against
+        its DISTINCT keys plus, for null-aware IN, a one-row aggregate of
+        (count(*), count(key)) cross-joined in — and the predicate node
+        is replaced by a boolean expression over the markers with exact
+        three-valued semantics:
+
+            IN  =  TRUE   when a key matched
+                   FALSE  when the subquery is empty
+                   NULL   when the needle is null, or no match and the
+                          subquery result contains a null
+                   FALSE  otherwise
+
+        so ``NOT (x IN (...))`` filters correctly too.  The helper
+        columns are projected away after the filter, restoring the
+        pre-rewrite schema."""
+        from . import functions as F
+        from . import plan as P
+        from .dataframe import Column, DataFrame
+
+        visible = tuple(df._plan.output)
+        k_counter = [0]
+
+        def attr_by_name(frame, name):
+            for a in frame._plan.output:
+                if a.name == name:
+                    return Column(a)
+            raise AssertionError(name)
+
+        def rewrite(e: Expression) -> Expression:
+            nonlocal df
+            if isinstance(e, InSubquery):
+                k = k_counter[0]
+                k_counter[0] += 1
+                inner = self._fresh(self._build_sub(e.stmt, ctes))
+                if len(inner._plan.output) != 1:
+                    raise SqlParseError(
+                        "IN subquery must return exactly one column")
+                key = Column(inner._plan.output[0])
+                needle = Column(_resolve_or_err(
+                    self._bind_quals(e.children[0], scope), df._plan))
+                keys = inner.select(key.alias(f"__exk{k}"),
+                                    F.lit(True).alias(f"__exm{k}")
+                                    ).distinct()
+                flags = inner.agg(
+                    F.count(F.lit(1)).alias(f"__exc{k}"),
+                    F.count(key).alias(f"__exn{k}"))
+                df = df.join(
+                    keys, on=needle == Column(keys._plan.output[0]),
+                    how="left")
+                df = df.crossJoin(flags)
+                m = attr_by_name(df, f"__exm{k}")
+                cnt = attr_by_name(df, f"__exc{k}")
+                cntk = attr_by_name(df, f"__exn{k}")
+                null_b = F.lit(None).cast("boolean")
+                val = (F.when(m.isNotNull(), F.lit(True))
+                       .when(cnt == 0, F.lit(False))
+                       .when(needle.isNull(), null_b)
+                       .when(cnt > cntk, null_b)
+                       .otherwise(F.lit(False)))
+                return val.expr
+            if isinstance(e, ExistsSubquery):
+                k = k_counter[0]
+                k_counter[0] += 1
+                q = e.stmt
+                corr_pairs, inner_conj, mixed = self._split_correlation(
+                    q, "correlated EXISTS", allow_mixed=True)
+                if mixed:
+                    raise SqlParseError(
+                        "non-equality-correlated EXISTS is only supported "
+                        "as an AND-connected top-level WHERE predicate")
+                if corr_pairs:
+                    import dataclasses
+                    if q.group_by or q.having is not None \
+                            or q.group_by_mode:
+                        raise SqlParseError(
+                            "correlated EXISTS with GROUP BY/HAVING is "
+                            "not supported — aggregate in a FROM "
+                            "subquery instead")
+                    q2 = dataclasses.replace(
+                        q, where=_and_all(inner_conj),
+                        items=[SelectItem(ie, f"__exq{k}_{i}")
+                               for i, (_, ie) in enumerate(corr_pairs)],
+                        order_by=[], distinct=False, limit=None,
+                        offset=None)
+                    inner = self._fresh(self._build_sub(q2, ctes))
+                    keys = inner.select(
+                        *[Column(a).alias(f"__exk{k}_{i}")
+                          for i, a in enumerate(inner._plan.output)],
+                        F.lit(True).alias(f"__exm{k}")).distinct()
+                    cond = None
+                    for i, (oe, _) in enumerate(corr_pairs):
+                        outer_col = Column(_resolve_or_err(
+                            self._bind_quals(oe, scope), df._plan))
+                        term = outer_col == Column(keys._plan.output[i])
+                        cond = term if cond is None else cond & term
+                    df = df.join(keys, on=cond, how="left")
+                    return attr_by_name(df, f"__exm{k}").isNotNull().expr
+                flags = self._fresh(self._build_sub(q, ctes)).limit(1).agg(
+                    F.count(F.lit(1)).alias(f"__exc{k}"))
+                df = df.crossJoin(flags)
+                return (attr_by_name(df, f"__exc{k}") > 0).expr
+            if not e.children:
+                return e
+            return e.with_children(tuple(rewrite(c) for c in e.children))
+
+        new_cond = _and_all([rewrite(c) for c in conjuncts])
+        df = DataFrame(P.Filter(_resolve_or_err(new_cond, df._plan),
+                                df._plan), self.session)
+        return DataFrame(P.Project(visible, df._plan), self.session)
+
     def _plan_comma_joins(self, stmt: "SelectStmt", ctes, scope):
         """Join planning for a pure comma/CROSS FROM list — the analog of
         Spark's PushPredicateThroughJoin + ReorderJoin, which run before
@@ -2313,13 +2427,16 @@ class QueryBuilder:
             if _has_window(cond):
                 raise SqlParseError(
                     "window functions are not allowed in WHERE")
-            plain, sub_preds = _split_subquery_predicates(cond)
+            plain, sub_preds, embedded = _split_subquery_predicates(cond)
             if plain is not None:
                 df = DataFrame(P.Filter(_resolve_or_err(plain, df._plan),
                                         df._plan), self.session)
             for pred, negated in sub_preds:
                 df = self._apply_subquery_predicate(df, pred, negated,
                                                     scope, ctes)
+            if embedded:
+                df = self._apply_embedded_subqueries(df, embedded, scope,
+                                                     ctes)
 
         # resolve select list against the (joined, filtered) frame
         items: List[Tuple[str, Expression]] = []
@@ -2463,9 +2580,23 @@ class QueryBuilder:
                 if e.semantic_key() == key:
                     return attr
             if isinstance(e, WindowExpression):
-                raise SqlParseError(
-                    "window functions cannot be combined with GROUP BY in "
-                    "the same query block — aggregate in a subquery first")
+                # windows evaluate AFTER aggregation (Spark's
+                # ExtractWindowExpressions over an Aggregate): the window
+                # node stays in the post-agg projection; its function's
+                # OWN aggregate is the window computation, while nested
+                # aggregates and group keys inside it resolve against the
+                # Aggregate output (avg(sum(x)) OVER (PARTITION BY
+                # grouping(k), ...) — the spec-TPC-DS idiom)
+                def strip_fn(fn: Expression) -> Expression:
+                    if isinstance(fn, AggregateExpression):
+                        return fn.with_children(
+                            tuple(strip_fn(c) for c in fn.children))
+                    if isinstance(fn, AggregateFunction):
+                        return fn.with_children(
+                            tuple(strip(c) for c in fn.children))
+                    return strip(fn)
+                rest = tuple(strip(c) for c in e.children[1:])
+                return e.with_children((strip_fn(e.children[0]),) + rest)
             if isinstance(e, (AggregateFunction, AggregateExpression)):
                 key = e.semantic_key()
                 if key not in agg_aliases:
@@ -2476,6 +2607,9 @@ class QueryBuilder:
             return e.with_children(tuple(strip(c) for c in e.children))
 
         new_items = [(name, strip(e)) for name, e in items]
+        if having is not None and _has_window(having):
+            raise SqlParseError(
+                "window functions are not allowed in HAVING")
         new_having = strip(having) if having is not None else None
 
         # ORDER BY must be stripped BEFORE the Aggregate plan is frozen so
@@ -2743,23 +2877,25 @@ def _and_all(conjuncts: Sequence[Expression]) -> Optional[Expression]:
 
 
 def _split_subquery_predicates(cond: Expression):
-    """(plain_condition_or_None, [(marker, negated)]) from a WHERE tree.
-    Markers must be AND-connected at the top level — anywhere else (under
-    OR, inside a CASE) has no join rewrite and is rejected."""
+    """(plain_condition_or_None, [(marker, negated)], [embedded]) from a
+    WHERE tree.  Top-level AND-connected markers get the efficient
+    semi/anti join rewrite; conjuncts with subqueries embedded deeper
+    (under OR, inside CASE/NOT) go to ``embedded`` for the existence-join
+    rewrite (reference ``ExistenceJoin.scala``)."""
     from .expressions.predicates import Not
     plain: List[Expression] = []
     subs = []
+    embedded: List[Expression] = []
     for c in _split_and(cond):
         inner = c.children[0] if isinstance(c, Not) else c
         if isinstance(inner, (ExistsSubquery, InSubquery)):
             subs.append((inner, isinstance(c, Not)))
             continue
         if c.collect(lambda x: isinstance(x, (ExistsSubquery, InSubquery))):
-            raise SqlParseError(
-                "EXISTS/IN subqueries are only supported as AND-connected "
-                "top-level WHERE predicates")
+            embedded.append(c)
+            continue
         plain.append(c)
-    return _and_all(plain), subs
+    return _and_all(plain), subs, embedded
 
 
 def _has_window(e: Expression) -> bool:

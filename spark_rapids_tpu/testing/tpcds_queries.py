@@ -309,6 +309,32 @@ def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
         "sr_return_amt": pa.array(np.round(rng.random(n_sr) * 300, 2)),
         "sr_net_loss": pa.array(np.round(rng.random(n_sr) * 90, 2)),
     })
+    # round-5 wave 5 extensions, drawn from a SEPARATE rng and appended
+    # to the already-built tables so every earlier draw — and therefore
+    # every existing table's bytes and every tuned oracle constant —
+    # stays identical.  store.s_state is deterministic round-robin like
+    # warehouse.w_state (rank/rollup queries must see every state at
+    # every scale).
+    rng2 = np.random.default_rng(seed + 101)
+    store = store.append_column(
+        "s_state", pa.array([_STATES[i % len(_STATES)]
+                             for i in range(n_stores)]))
+    n_cc = 6
+    call_center = pa.table({
+        "cc_call_center_sk": pa.array(np.arange(n_cc), type=pa.int64()),
+        "cc_name": pa.array([f"call center {i}" for i in range(n_cc)]),
+    })
+    cs_sold = np.asarray(catalog_sales.column("cs_sold_date_sk"))
+    catalog_sales = catalog_sales.append_column(
+        "cs_ship_date_sk", pa.array(
+            np.minimum(cs_sold + rng2.integers(1, 140, n_cs), n_dates - 1),
+            type=pa.int64()))
+    catalog_sales = catalog_sales.append_column(
+        "cs_ship_mode_sk", pa.array(rng2.integers(0, n_sm, n_cs),
+                                    type=pa.int64()))
+    catalog_sales = catalog_sales.append_column(
+        "cs_call_center_sk", pa.array(rng2.integers(0, n_cc, n_cs),
+                                      type=pa.int64()))
     return {
         "store_sales": store_sales, "date_dim": date_dim, "item": item,
         "customer_demographics": customer_demographics,
@@ -320,6 +346,7 @@ def build_tables(rows: int, seed: int = 31) -> Dict[str, pa.Table]:
         "store_returns": store_returns, "warehouse": warehouse,
         "ship_mode": ship_mode, "web_returns": web_returns,
         "catalog_returns": catalog_returns, "inventory": inventory,
+        "call_center": call_center,
     }
 
 
