@@ -107,6 +107,26 @@ JOIN_OUTPUT_CHUNK_ROWS = register(
     "Join outputs larger than this many rows are gathered in chunks of "
     "this size instead of one worst-case buffer (reference "
     "JoinGatherer.scala:730 lazy chunked gather).", 1 << 22)
+JOIN_BUILD_CACHE_ENABLED = register(
+    "spark.rapids.sql.join.buildSideCache.enabled",
+    "Cache the sorted build-side join keys on the build batch so a "
+    "broadcast/shuffled hash join sorts its build side once and every "
+    "probe batch only binary-searches it (the sort-based analog of the "
+    "reference building its hash table once per build side, "
+    "GpuHashJoin.scala:298).  Off falls back to the union-rank path, "
+    "which re-sorts probe+build per probe batch.", True)
+JOIN_SPECULATIVE_SIZING = register(
+    "spark.rapids.sql.join.speculativeSizing.enabled",
+    "Dispatch each probe batch's join gather at an output capacity "
+    "predicted from the previous batch's selectivity BEFORE the blocking "
+    "count readback, so the one sizing fetch overlaps the gather instead "
+    "of serializing it; an overflow of the predicted bucket re-gathers "
+    "at the exact size.", True)
+JOIN_INITIAL_SELECTIVITY = register(
+    "spark.rapids.sql.join.speculativeSizing.initialSelectivity",
+    "First-batch output-rows-per-probe-row estimate used by speculative "
+    "join output sizing before any realized selectivity is observed.",
+    1.0)
 CONCURRENT_TASKS = register(
     "spark.rapids.sql.concurrentGpuTasks",
     "Number of tasks that may hold the device semaphore concurrently "

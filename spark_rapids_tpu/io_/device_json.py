@@ -75,6 +75,45 @@ def _trim(buf: np.ndarray, vs: np.ndarray, ve: np.ndarray):
     return vs, ve
 
 
+#: vectorized DFA over the JSON number grammar
+#: ``-?\d+(\.\d+)?([eE][+-]?\d+)?`` — the strict production with the
+#: integer part relaxed from ``(0|[1-9]\d*)`` to ``\d+``, the module's one
+#: documented permissive edge (leading zeros in integers parse).  Rejects
+#: everything else the permissive cast parsers would otherwise accept
+#: where the host oracle errors: ``12.``, ``-.5``, ``1.e3``, ``-inf``,
+#: bare ``-``, ``+5`` never reaches here (lead byte check).
+#: states: 0 START, 1 SIGN, 2 INT(accept), 3 DOT, 4 FRAC(accept),
+#: 5 EXP, 6 ESIGN, 7 EDIG(accept), 8 ERR
+_NUM_DIGIT = np.array([2, 2, 2, 4, 4, 7, 7, 7, 8], np.int8)
+_NUM_MINUS = np.array([1, 8, 8, 8, 8, 6, 8, 8, 8], np.int8)
+_NUM_PLUS = np.array([8, 8, 8, 8, 8, 6, 8, 8, 8], np.int8)
+_NUM_DOT = np.array([8, 8, 3, 8, 8, 8, 8, 8, 8], np.int8)
+_NUM_E = np.array([8, 8, 5, 8, 5, 8, 8, 8, 8], np.int8)
+
+
+def _number_grammar_ok(buf: np.ndarray, vs: np.ndarray,
+                       ve: np.ndarray) -> bool:
+    """True when every [vs, ve) span matches the JSON number grammar
+    (vectorized: one table-lookup DFA step per byte column)."""
+    lens = ve - vs
+    w = int(lens.max())
+    pos = np.minimum(vs[:, None] + np.arange(w), len(buf) - 1)
+    b = buf[pos]
+    live = np.arange(w)[None, :] < lens[:, None]
+    state = np.zeros(len(vs), np.int8)
+    for j in range(w):
+        bj = b[:, j]
+        ns = np.where((bj >= ord("0")) & (bj <= ord("9")),
+                      _NUM_DIGIT[state], np.int8(8))
+        ns = np.where(bj == ord("-"), _NUM_MINUS[state], ns)
+        ns = np.where(bj == ord("+"), _NUM_PLUS[state], ns)
+        ns = np.where(bj == ord("."), _NUM_DOT[state], ns)
+        ns = np.where((bj == ord("e")) | (bj == ord("E")),
+                      _NUM_E[state], ns)
+        state = np.where(live[:, j], ns, state)
+    return bool(np.isin(state, (2, 4, 7)).all())
+
+
 def decode_file(path: str, options: Dict, out_fields, tctx=None,
                 conf=None, raw: Optional[bytes] = None
                 ) -> Optional[ColumnarBatch]:
@@ -162,6 +201,23 @@ def decode_file(path: str, options: Dict, out_fields, tctx=None,
         return None
 
     line_of = np.searchsorted(starts, colons, side="right") - 1
+    # duplicate keys: Jackson keeps the LAST occurrence, Spark flags the
+    # row — decline so the host oracle decides.  Checked across ALL keys
+    # per row, not just the pruned plan schema's: a duplicate of a pruned
+    # column still makes the row's answer host-semantics-dependent
+    if len(colons):
+        klen_all = (q[np.searchsorted(q, colons) - 1]
+                    - q[np.searchsorted(q, colons) - 2] - 1)
+        kstart_all = q[np.searchsorted(q, colons) - 2] + 1
+        wk = max(int(klen_all.max()), 1)
+        kb = buf[np.minimum(kstart_all[:, None] + np.arange(wk),
+                            len(buf) - 1)]
+        kb = np.where(np.arange(wk)[None, :] < klen_all[:, None], kb, 0)
+        rec = np.concatenate(
+            [line_of[:, None], klen_all[:, None],
+             kb.astype(np.int64)], axis=1)
+        if len(np.unique(rec, axis=0)) < len(colons):
+            return None
     # empty-object rows ({} / {  }) are valid: all columns null there
     ncolons = np.bincount(line_of, minlength=n)
     empty_rows = np.flatnonzero(ncolons == 0)
@@ -187,18 +243,8 @@ def decode_file(path: str, options: Dict, out_fields, tctx=None,
     # classify each value span
     cls = np.full(len(colons), -1, np.int8)
     is_num = ((lead >= ord("0")) & (lead <= ord("9"))) | (lead == ord("-"))
-    if is_num.any():
-        # every byte of a number span must be in the JSON number
-        # character set — otherwise tokens like ``-inf`` would reach the
-        # (deliberately permissive) Spark cast parsers and mis-parse
-        # where the host oracle errors
-        num_ok = np.zeros(256, bool)
-        for ch in b"0123456789.eE+-":
-            num_ok[ch] = True
-        BADNUM = np.concatenate(
-            [[0], np.cumsum(~num_ok[buf])]).astype(np.int64)
-        if (BADNUM[ve[is_num]] - BADNUM[vs[is_num]] != 0).any():
-            return None
+    if is_num.any() and not _number_grammar_ok(buf, vs[is_num], ve[is_num]):
+        return None
     cls[is_num] = _NUMBER
     quoted = lead == _QUOTE
     if quoted.any():
@@ -250,9 +296,7 @@ def decode_file(path: str, options: Dict, out_fields, tctx=None,
             return None
         hit = (klen == len(nb)) & (
             kbytes[:, :len(nb)] == nb[None, :]).all(1)
-        rows = line_of[hit]
-        if len(rows) and np.bincount(rows).max() > 1:
-            return None  # duplicate key in a row: host decides
+        rows = line_of[hit]  # duplicates already declined (all-key check)
         if isinstance(dt, T.NullType):
             if (cls[hit] != _NULL).any():
                 return None  # inferred all-null column has a value

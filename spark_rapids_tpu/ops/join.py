@@ -24,12 +24,24 @@ Dead padding rows are likewise sentineled out.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Sequence
+from contextlib import nullcontext
+from typing import NamedTuple, Sequence, Tuple
 
 import numpy as np
 
+from .. import types as T
 from ..columnar.column import DeviceColumn
-from .ranks import dense_rank_columns, stable_argsort
+from .ranks import (column_sort_keys, dense_rank_columns, lex_sort,
+                    stable_argsort, tuple_searchsorted)
+
+
+def _scope(xp, name: str):
+    """jax.named_scope on the device backend (shows up as a named region in
+    jax.profiler traces — the per-stage join profile), no-op under numpy."""
+    if xp.__name__ == "numpy":
+        return nullcontext()
+    import jax
+    return jax.named_scope(name)
 
 
 def concat_full_columns(xp, a: DeviceColumn, b: DeviceColumn) -> DeviceColumn:
@@ -133,6 +145,175 @@ def join_build(xp, lkeys: Sequence[DeviceColumn], rkeys: Sequence[DeviceColumn],
     n_unb = xp.sum(b_unmatched.astype(xp.int64))
     return JoinInfo(counts, csum, lo, perm_b, l_unmatched, b_unmatched,
                     total, n_unl, n_unb)
+
+
+class JoinBuildSide(NamedTuple):
+    """Build-side preparation, computed ONCE per build batch and cached on
+    it (the reference builds its hash table once per broadcast build side,
+    ``GpuHashJoin.scala:298``; the sort-based analog is one variadic sort).
+
+    ``sorted_keys`` are the build rows' search-key arrays permuted into
+    lexicographic order by ``perm_b``, with all BAD rows (dead padding,
+    and null-keyed rows unless null_safe) sorted to the back so the live
+    prefix ``[0, n_good)`` is purely value-ordered; probe batches locate
+    match-range starts with ONE :func:`tuple_searchsorted` over that
+    prefix and read the range ends from ``run_end`` (the precomputed
+    end-of-equal-run per sorted position) — no union rank, no re-sort,
+    no second binary search."""
+    sorted_keys: Tuple["np.ndarray", ...]
+    perm_b: "np.ndarray"       # int32[rcap] build rows in key-sorted order
+    n_good: "np.ndarray"       # int32 scalar: live matchable rows (prefix)
+    run_end: "np.ndarray"      # int32[rcap] end of each position's key run
+
+
+def join_search_keys(xp, key_cols: Sequence[DeviceColumn],
+                     null_safe: bool = False):
+    """Search-key arrays for the tuple-search fast path: per key column
+    its :func:`column_sort_keys` arrays (plus the null flag under
+    null-safe equality, where NULL==NULL).  Rows excluded from matching
+    (dead padding; null-keyed rows unless null_safe) are NOT encoded here
+    — the build side sorts them behind the good prefix and the probe side
+    zeroes their counts, which keeps the per-iteration search gathers to
+    the value keys only."""
+    keys = []
+    for c in key_cols:
+        if null_safe:
+            keys.append(~c.validity)
+        keys.extend(column_sort_keys(xp, c))
+    return keys
+
+
+def _bad_rows(xp, key_cols: Sequence[DeviceColumn], mask, null_safe: bool):
+    """Rows that can never match: dead padding, plus null-keyed rows under
+    SQL ``=`` semantics (the union path's -1/-2 sentinel-rank set)."""
+    bad = ~mask
+    if not null_safe:
+        for c in key_cols:
+            if c.validity is not None:
+                bad = bad | ~c.validity
+    return bad
+
+
+def fastpath_supported(dtypes: Sequence["T.DataType"]) -> bool:
+    """True when every join-key type has an exact :func:`column_sort_keys`
+    encoding (everything except array/map keys, which fall back to the
+    union-rank path)."""
+    def ok(dt):
+        if isinstance(dt, (T.ArrayType, T.MapType)):
+            return False
+        if isinstance(dt, T.StructType):
+            return all(ok(f.data_type) for f in dt.fields)
+        return True
+    return all(ok(dt) for dt in dtypes)
+
+
+def prepare_build_side(xp, rkeys: Sequence[DeviceColumn], rmask,
+                       null_safe: bool = False) -> JoinBuildSide:
+    """Sort the build side's key tuples once.  Jittable per build capacity;
+    the result is cached on the build batch so B probe batches pay for ONE
+    build sort instead of B union sorts."""
+    rcap = rmask.shape[0]
+    with _scope(xp, "join.build.key_transform"):
+        bad = _bad_rows(xp, rkeys, rmask, null_safe)
+        skeys = join_search_keys(xp, rkeys, null_safe)
+    with _scope(xp, "join.build.sort"):
+        # bad rows sort LAST (the bool key), good rows by value keys only
+        perm, sorted_all = lex_sort(xp, [bad] + skeys)
+        sorted_keys = tuple(sorted_all[1:])
+    n_good = xp.sum((~bad).astype(xp.int32))
+    # run_end[i]: end of the equal-key run containing sorted position i —
+    # a reverse min-scan over next-run starts, computed once so probes
+    # read match-range ENDS with one gather instead of a second search
+    with _scope(xp, "join.build.run_ends"):
+        if rcap > 1:
+            nxt_diff = sorted_all[0][1:] != sorted_all[0][:-1]
+            for k in sorted_keys:
+                nxt_diff = nxt_diff | (k[1:] != k[:-1])
+            idx = xp.arange(rcap - 1, dtype=xp.int32)
+            ends = xp.where(nxt_diff, idx + 1,
+                            xp.asarray(rcap, dtype=xp.int32))
+            ends = xp.concatenate(
+                [ends, xp.asarray([rcap], dtype=xp.int32)])
+            if xp.__name__ == "numpy":
+                run_end = np.minimum.accumulate(ends[::-1])[::-1]
+            else:
+                import jax
+                run_end = jax.lax.cummin(ends, axis=0, reverse=True)
+        else:
+            run_end = xp.full((rcap,), rcap, dtype=xp.int32)
+    return JoinBuildSide(sorted_keys, perm.astype(xp.int32),
+                         n_good, run_end)
+
+
+def probe_join_info(xp, lkeys: Sequence[DeviceColumn], lmask, rmask,
+                    build: JoinBuildSide, null_safe: bool = False,
+                    need_b_matched: bool = True,
+                    need_l_unmatched: bool = True) -> JoinInfo:
+    """Probe-only phase 1: transform probe keys with the same
+    :func:`column_sort_keys` encoding, then find each probe row's match
+    range in the pre-sorted build side: ONE multi-key binary search over
+    the good-row prefix for the range start, one ``run_end`` gather for
+    the range end.  Returns the same :class:`JoinInfo` contract as
+    :func:`join_build` (``gather_pairs`` is shared), but costs
+    O(L·k·log R) instead of an O((L+R)·k) union sort per probe batch.
+
+    ``need_b_matched=False`` / ``need_l_unmatched=False`` (static) skip
+    the unmatched-row flags for join types that never consume them
+    (b: everything except full outer; l: everything except left/full) —
+    fewer materialized outputs keeps the XLA:CPU program fused."""
+    lcap = lmask.shape[0]
+    rcap = rmask.shape[0]
+    with _scope(xp, "join.probe.key_transform"):
+        bad = _bad_rows(xp, lkeys, lmask, null_safe)
+        qkeys = join_search_keys(xp, lkeys, null_safe)
+    with _scope(xp, "join.probe.search"):
+        lo = tuple_searchsorted(xp, build.sorted_keys, qkeys, side="left",
+                                hi_init=build.n_good)
+        loc = xp.clip(lo, 0, max(rcap - 1, 0))
+        hit = ~bad & (lo < build.n_good)
+        for s, q in zip(build.sorted_keys, qkeys):
+            hit = hit & (s[loc] == q)
+        hi = xp.where(hit, build.run_end[loc], lo)
+    counts = xp.where(hit, hi - lo, 0).astype(xp.int64)
+    csum = xp.cumsum(counts)
+    total = csum[lcap - 1] if lcap else xp.asarray(0, dtype=xp.int64)
+    if need_l_unmatched:
+        l_unmatched = lmask & (counts == 0)
+        n_unl = xp.sum(l_unmatched.astype(xp.int64))
+    else:
+        l_unmatched = xp.zeros(lcap, dtype=bool)
+        n_unl = xp.asarray(0, dtype=xp.int64)
+
+    if need_b_matched:
+        # build-side match flags WITHOUT sorting the probe: each matched
+        # probe row covers sorted-build positions [lo, hi); an
+        # interval-cover scatter (+1 at lo, -1 at hi, prefix-sum > 0)
+        # marks covered positions in O(L + R) — equal keys are contiguous
+        # in the sorted build side, so covered <=> some live probe row
+        # carries an equal key tuple
+        with _scope(xp, "join.probe.build_cover"):
+            lo_c = xp.where(hit, lo, rcap).astype(xp.int32)
+            hi_c = xp.where(hit, hi, rcap).astype(xp.int32)
+            if xp.__name__ == "numpy":
+                cover = np.zeros(rcap + 1, dtype=np.int32)
+                np.add.at(cover, lo_c, 1)
+                np.add.at(cover, hi_c, -1)
+                covered_sorted = np.cumsum(cover[:-1]) > 0
+                b_matched = np.zeros(rcap, dtype=bool)
+                b_matched[build.perm_b] = covered_sorted
+            else:
+                cover = (xp.zeros(rcap + 1, dtype=xp.int32)
+                         .at[lo_c].add(1).at[hi_c].add(-1))
+                covered_sorted = xp.cumsum(cover[:-1]) > 0
+                b_matched = (xp.zeros(rcap, dtype=bool)
+                             .at[build.perm_b].set(covered_sorted))
+        b_unmatched = rmask & ~b_matched
+        n_unb = xp.sum(b_unmatched.astype(xp.int64))
+    else:
+        b_unmatched = xp.zeros(rcap, dtype=bool)
+        n_unb = xp.asarray(0, dtype=xp.int64)
+    return JoinInfo(counts, csum, lo.astype(xp.int64), build.perm_b,
+                    l_unmatched, b_unmatched, total, n_unl, n_unb)
 
 
 class PairMaps(NamedTuple):

@@ -105,6 +105,47 @@ def lex_sort(xp, keys):
     return perm, sorted_keys
 
 
+def tuple_searchsorted(xp, sorted_keys, query_keys, side="left",
+                       hi_init=None):
+    """Vectorized multi-key ``searchsorted``: insertion points of the query
+    key *tuples* into the lexicographically sorted key tuples, without ever
+    materializing a combined rank (the probe-only half of the join fast
+    path — the build side is sorted once, probes just binary-search it).
+
+    ``sorted_keys`` / ``query_keys`` are parallel lists of key arrays,
+    most-significant first, with matching dtypes per position (the
+    :func:`column_sort_keys` contract).  The sorted length is static, so
+    the search is a fixed ``ceil(log2(n))+1`` rounds of gather+compare —
+    no sort, no dynamic shapes, jittable.
+
+    ``hi_init`` (traced scalar ok) restricts the search to the prefix
+    ``[0, hi_init)`` — the join fast path searches only the good-row
+    prefix of the sorted build side, which keeps sentinel/category keys
+    OUT of the per-iteration gathers entirely."""
+    n = int(sorted_keys[0].shape[0])
+    m = query_keys[0].shape[0]
+    lo = xp.zeros(m, dtype=xp.int32)
+    hi = (xp.full(m, n, dtype=xp.int32) if hi_init is None
+          else xp.broadcast_to(xp.asarray(hi_init, dtype=xp.int32), (m,)))
+    if n == 0:
+        return lo
+    for _ in range(n.bit_length() + 1):
+        mid = (lo + hi) >> 1
+        midc = xp.clip(mid, 0, n - 1)
+        lt = xp.zeros(m, dtype=bool)
+        eq = xp.ones(m, dtype=bool)
+        for s, q in zip(sorted_keys, query_keys):
+            sv = s[midc]
+            lt = lt | (eq & (sv < q))
+            eq = eq & (sv == q)
+        go = (lt | eq) if side == "right" else lt
+        go = go & (lo < hi)
+        stay = ~go & (lo < hi)
+        lo = xp.where(go, mid + 1, lo)
+        hi = xp.where(stay, mid, hi)
+    return lo
+
+
 def dense_rank_from_sorted(xp, sorted_boundary_flags):
     """Given boundary flags in sorted order (True at the first row of each
     distinct key), returns 0-based dense ranks in sorted order."""

@@ -350,7 +350,15 @@ class ShuffleExchangeExec(PhysicalPlan):
 class BroadcastExchangeExec(PhysicalPlan):
     """Materialize the (small) child once as a single concatenated batch,
     served to every consumer partition (reference serializes to host and
-    re-uploads per task; locally the device batch is just shared)."""
+    re-uploads per task; locally the device batch is just shared).
+
+    Build-cache contract: consumers attach derived build-side artifacts to
+    the batch itself (``_join_build_sides`` — the hash-join fast path's
+    sorted key tuples, keyed by bound build-key signature), so every probe
+    partition and every probe batch of every join over this broadcast
+    shares ONE build-side preparation, exactly like the reference builds
+    its broadcast hash table once (``GpuHashJoin.scala:298``).  The dict
+    lives on the batch, not the exec, so it dies with the batch."""
 
     def __init__(self, child: PhysicalPlan, backend=TPU):
         super().__init__(child)
@@ -376,6 +384,13 @@ class BroadcastExchangeExec(PhysicalPlan):
             else:
                 self._cached = (ColumnarBatch.concat(batches)
                                 if len(batches) > 1 else batches[0])
+            # seed the artifact cache eagerly: a concat result could be a
+            # pass-through of a child batch that already carries artifacts
+            # from an unrelated join over different keys — the per-key
+            # signatures keep those distinct, but the dict must exist on
+            # THIS object for all consumers to share one instance
+            if getattr(self._cached, "_join_build_sides", None) is None:
+                self._cached._join_build_sides = {}
         return self._cached
 
     def execute(self, pid, tctx):

@@ -14,23 +14,49 @@ joins exactly like the reference (``GpuSortMergeJoinMeta.scala``).
 
 from __future__ import annotations
 
+import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ... import types as T
 from ...columnar.batch import ColumnarBatch
 from ...columnar.column import bucket_capacity
-from ...ops.join import (JoinInfo, compact_indices, cross_pairs, gather_pairs,
-                         join_build, matched_per_row, PairMaps)
+from ...ops.join import (JoinBuildSide, JoinInfo, compact_indices,
+                         cross_pairs, fastpath_supported, gather_pairs,
+                         join_build, matched_per_row, PairMaps,
+                         prepare_build_side, probe_join_info)
 from ..expressions.core import (AttributeReference, EvalContext, Expression,
                                 bind_references)
-from .base import TPU, PhysicalPlan, TaskContext
+from .base import PROFILING, TPU, PhysicalPlan, TaskContext
 from .exchange import BroadcastExchangeExec
 
 _PAIR_JOINS = ("inner", "left", "full", "cross")
 _FILTER_JOINS = ("left_semi", "left_anti", "existence")
 
-#: observability for tests
-STATS = {"chunked_joins": 0}
+#: observability for tests: build_sorts counts ACTUAL build-side sort
+#: program executions (a broadcast join with B probe batches must show 1,
+#: not B); host_readbacks counts blocking device->host scalar fetches on
+#: the sizing path; spec_hits/spec_misses track speculative output sizing
+STATS = {"chunked_joins": 0, "build_sorts": 0, "fastpath_probes": 0,
+         "fallback_probes": 0, "spec_hits": 0, "spec_misses": 0,
+         "host_readbacks": 0}
+
+#: realized join selectivity (inner pairs per probe row) per program
+#: identity — the speculative output-sizing seed, learned from the first
+#: batch so later batches dispatch their gather without waiting for the
+#: count readback (aggregate.py _OUT_SPECULATION analog; cleared with the
+#: kernel cache)
+_JOIN_SELECTIVITY: Dict[tuple, float] = {}
+
+
+def record_selectivity(spec_key, sel: float) -> None:
+    """Record observed selectivity, max-joined: a low-match tail batch
+    must not shrink the prediction a dense batch needs (which would make
+    every later dense batch mis-speculate and gather twice, forever)."""
+    if len(_JOIN_SELECTIVITY) > 1024:
+        _JOIN_SELECTIVITY.clear()  # keys embed literals (kernel-cache rule)
+    prev = _JOIN_SELECTIVITY.get(spec_key, 0.0)
+    _JOIN_SELECTIVITY[spec_key] = max(prev, sel)
 
 
 class BaseJoinExec(PhysicalPlan):
@@ -76,6 +102,15 @@ class BaseJoinExec(PhysicalPlan):
         self._build_fn = self._jit(self._build_info,
                                    key=("build", self._sig))
         self._gather_cache: Dict[int, object] = {}
+        # join fast path: build-side sort cached per build batch + probe-only
+        # tuple search; array/map keys keep the union-rank fallback
+        self._fast_ok = fastpath_supported(
+            [e.data_type for e in self._bound_pkeys + self._bound_bkeys])
+        self._bs_key = ("bs", exprs_key(self._bound_bkeys))
+        self._prep_fn = self._jit(self._prepare_build,
+                                  key=("prep", self._bs_key))
+        self._probe_fn = self._jit(self._probe_info,
+                                   key=("probe", self._sig))
 
     # --- schema -----------------------------------------------------------
     @property
@@ -107,6 +142,116 @@ class BaseJoinExec(PhysicalPlan):
         pkeys = [e.eval(pctx) for e in self._bound_pkeys]
         bkeys = [e.eval(bctx) for e in self._bound_bkeys]
         return join_build(xp, pkeys, bkeys, probe.row_mask(), build.row_mask())
+
+    def _prepare_build(self, build: ColumnarBatch) -> JoinBuildSide:
+        """Fast-path phase 0: sort the build side's key tuples (one jitted
+        program per build capacity, result cached on the build batch)."""
+        xp = self.xp
+        bctx = EvalContext(build, xp=xp)
+        bkeys = [e.eval(bctx) for e in self._bound_bkeys]
+        return prepare_build_side(xp, bkeys, build.row_mask())
+
+    def _probe_info(self, probe: ColumnarBatch, build: ColumnarBatch,
+                    bs: JoinBuildSide) -> JoinInfo:
+        """Fast-path phase 1: probe-only — key transform + one multi-key
+        binary search against the pre-sorted build side (plus run-end
+        lookups).  Build-unmatched flags are only materialized for full
+        joins, the one type that emits them (_norm_how is in the jit
+        sig, so the static flag can't alias programs)."""
+        xp = self.xp
+        pctx = EvalContext(probe, xp=xp)
+        pkeys = [e.eval(pctx) for e in self._bound_pkeys]
+        return probe_join_info(
+            xp, pkeys, probe.row_mask(), build.row_mask(), bs,
+            need_b_matched=self._norm_how == "full",
+            need_l_unmatched=self._norm_how in ("left", "full"))
+
+    @contextmanager
+    def _stage(self, tctx: Optional[TaskContext], name: str):
+        """Per-stage join profiling: a jax.profiler TraceAnnotation around
+        the host-side stage (dispatch or blocking fetch) plus a wall-time
+        metric in last_query_metrics (joinStage<Name>Ms)."""
+        ann = None
+        if PROFILING["on"] and self.backend == TPU:
+            import jax.profiler
+            ann = jax.profiler.TraceAnnotation(f"join:{name}")
+            ann.__enter__()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if tctx is not None:
+                tctx.inc_metric(f"joinStage{name[0].upper()}{name[1:]}Ms",
+                                (time.perf_counter() - t0) * 1e3)
+            if ann is not None:
+                ann.__exit__(None, None, None)
+
+    def _fast_path_on(self, tctx: Optional[TaskContext]) -> bool:
+        if not self._fast_ok:
+            return False
+        from ...config import JOIN_BUILD_CACHE_ENABLED
+        conf = tctx.conf if tctx is not None else None
+        if conf is None:
+            from ...config import RapidsConf
+            conf = RapidsConf.get_global()
+        return bool(conf.get(JOIN_BUILD_CACHE_ENABLED))
+
+    def _get_build_side(self, build: ColumnarBatch,
+                        tctx: Optional[TaskContext]) -> JoinBuildSide:
+        """The build batch's cached :class:`JoinBuildSide` for this join's
+        build keys, computing (and caching) it on first use — a broadcast
+        build side shared by B probe batches/partitions sorts exactly
+        once."""
+        cache = getattr(build, "_join_build_sides", None)
+        if cache is None:
+            cache = {}
+            build._join_build_sides = cache
+        key = (self.backend,) + self._bs_key
+        bs = cache.get(key)
+        if bs is None:
+            with self._stage(tctx, "buildSort"):
+                bs = self._prep_fn(build)
+            STATS["build_sorts"] += 1
+            if tctx is not None:
+                tctx.inc_metric("joinBuildSorts")
+            cache[key] = bs
+        return bs
+
+    def _join_info(self, probe: ColumnarBatch, build: ColumnarBatch,
+                   tctx: Optional[TaskContext]) -> JoinInfo:
+        """Phase 1 dispatch: cached-build-side probe search when the key
+        shapes support it, union-rank fallback otherwise.  Both produce
+        the same :class:`JoinInfo` contract (parity-tested)."""
+        if self._fast_path_on(tctx):
+            bs = self._get_build_side(build, tctx)
+            STATS["fastpath_probes"] += 1
+            if tctx is not None:
+                tctx.inc_metric("joinFastpathProbes")
+            with self._stage(tctx, "probeSearch"):
+                return self._probe_fn(probe, build, bs)
+        STATS["fallback_probes"] += 1
+        if tctx is not None:
+            tctx.inc_metric("joinFallbackProbes")
+        with self._stage(tctx, "unionRankBuild"):
+            return self._build_fn(probe, build)
+
+    def _fetch_totals(self, info: JoinInfo,
+                      tctx: Optional[TaskContext]) -> Tuple[int, int, int]:
+        """The ONE blocking host readback per probe batch: all three sizing
+        scalars ride a single batched ``jax.device_get`` instead of three
+        per-scalar ``int()`` syncs (each a full tunnel round trip)."""
+        STATS["host_readbacks"] += 1
+        if tctx is not None:
+            tctx.inc_metric("joinHostReadbacks")
+        with self._stage(tctx, "readback"):
+            if self.backend == TPU:
+                import jax
+                tot, unl, unb = jax.device_get(
+                    [info.total, info.n_unmatched_l, info.n_unmatched_b])
+            else:
+                tot, unl, unb = (info.total, info.n_unmatched_l,
+                                 info.n_unmatched_b)
+        return int(tot), int(unl), int(unb)
 
     # --- phase 2 ----------------------------------------------------------
     def _gather_fn(self, out_cap: int):
@@ -237,18 +382,44 @@ class BaseJoinExec(PhysicalPlan):
         return ColumnarBatch(names, cols, maps.num_out)
 
     # --- sizing -----------------------------------------------------------
-    def _out_capacity(self, info: JoinInfo, n_probe: int, n_build: int) -> int:
+    def _out_capacity(self, info: JoinInfo, n_probe: int, n_build: int,
+                      tctx: Optional[TaskContext] = None) -> int:
         how = self._norm_how
         if how in _FILTER_JOINS and self._bound_cond is None:
             return 8  # unused; filter joins reuse the probe capacity
-        total = int(info.total)
+        total, unl, unb = self._fetch_totals(info, tctx)
         if self._bound_cond is not None:
             extra = (n_probe if how in ("left", "full") else 0) + \
                 (n_build if how == "full" else 0)
             return bucket_capacity(total + extra)
-        extra = (int(info.n_unmatched_l) if how in ("left", "full") else 0) + \
-            (int(info.n_unmatched_b) if how == "full" else 0)
+        extra = (unl if how in ("left", "full") else 0) + \
+            (unb if how == "full" else 0)
         return bucket_capacity(total + extra)
+
+    def _speculative_capacity(self, probe: ColumnarBatch,
+                              build: ColumnarBatch,
+                              tctx: TaskContext) -> Optional[int]:
+        """Predicted output bucket from the learned (or configured initial)
+        selectivity — host-only arithmetic on row-count BOUNDS, zero device
+        syncs.  Outer-join null-extension slack is bounded exactly (≤ live
+        probe/build rows), so only the inner-pair count is a guess."""
+        from ...config import (JOIN_INITIAL_SELECTIVITY,
+                               JOIN_SPECULATIVE_SIZING)
+        if not bool(tctx.conf.get(JOIN_SPECULATIVE_SIZING)):
+            return None
+        how = self._norm_how
+        n_probe = probe.num_rows_bound
+        sel = _JOIN_SELECTIVITY.get(self._sig)
+        if sel is None:
+            sel = float(tctx.conf.get(JOIN_INITIAL_SELECTIVITY))
+        pred = int(sel * max(n_probe, 1)) + 1
+        pred += (n_probe if how in ("left", "full") else 0)
+        pred += (build.num_rows_bound if how == "full" else 0)
+        return bucket_capacity(pred)
+
+    def _record_selectivity(self, probe: ColumnarBatch, total: int) -> None:
+        record_selectivity(self._sig,
+                           total / max(probe.num_rows_bound, 1))
 
     def _cached_kernel(self, tag: str, chunk_cap: int, make_impl):
         """Get-or-build the jitted windowed kernel for (tag, chunk_cap) —
@@ -277,40 +448,73 @@ class BaseJoinExec(PhysicalPlan):
             return impl
         return self._cached_kernel("gather_chunk", chunk_cap, make)
 
-    def _join_one(self, probe: ColumnarBatch, build: ColumnarBatch
-                  ) -> ColumnarBatch:
-        info = self._build_fn(probe, build)
+    def _join_one(self, probe: ColumnarBatch, build: ColumnarBatch,
+                  tctx: Optional[TaskContext] = None) -> ColumnarBatch:
+        info = self._join_info(probe, build, tctx)
         out_cap = self._out_capacity(info, probe.num_rows_int,
-                                     build.num_rows_int)
-        return self._gather_fn(out_cap)(probe, build, info)
+                                     build.num_rows_int, tctx)
+        with self._stage(tctx, "gather"):
+            return self._gather_fn(out_cap)(probe, build, info)
 
     def _join_batches(self, probe: ColumnarBatch, build: ColumnarBatch,
                       tctx: TaskContext):
         """Yield the join output, chunked when it exceeds the configured
         chunk rows (condition/filter joins keep the single-buffer path —
-        their residual bookkeeping spans the whole pair space)."""
+        their residual bookkeeping spans the whole pair space).
+
+        Non-blocking output sizing: the gather for the PREDICTED output
+        bucket dispatches before any host readback, so the one batched
+        sizing fetch overlaps the gather's device execution instead of
+        serializing build -> readback -> gather.  Only an overflow of the
+        predicted bucket (realized rows > capacity) pays a re-gather."""
         how = self._norm_how
         if (self._bound_cond is not None or how in _FILTER_JOINS):
-            yield self._join_one(probe, build)
+            yield self._join_one(probe, build, tctx)
             return
         from ...config import JOIN_OUTPUT_CHUNK_ROWS
         chunk = int(tctx.conf.get(JOIN_OUTPUT_CHUNK_ROWS))
-        info = self._build_fn(probe, build)
-        total_out = int(info.total) + \
-            (int(info.n_unmatched_l) if how in ("left", "full") else 0) + \
-            (int(info.n_unmatched_b) if how == "full" else 0)
+        info = self._join_info(probe, build, tctx)
+        spec_cap = self._speculative_capacity(probe, build, tctx)
+
+        def total_out_of(tot, unl, unb):
+            return tot + (unl if how in ("left", "full") else 0) + \
+                (unb if how == "full" else 0)
+
+        if spec_cap is not None and spec_cap <= bucket_capacity(chunk):
+            with self._stage(tctx, "gather"):
+                out = self._gather_fn(spec_cap)(probe, build, info)
+            tot, unl, unb = self._fetch_totals(info, tctx)
+            self._record_selectivity(probe, tot)
+            total_out = total_out_of(tot, unl, unb)
+            if total_out <= spec_cap:
+                STATS["spec_hits"] += 1
+                tctx.inc_metric("joinSpecHits")
+                yield out.with_known_rows(total_out)
+                return
+            # overflow: the realized output exceeds the predicted bucket —
+            # re-gather at the exact capacity (the totals are on the host
+            # already, so this costs no extra readback)
+            STATS["spec_misses"] += 1
+            tctx.inc_metric("joinSpecMisses")
+        else:
+            tot, unl, unb = self._fetch_totals(info, tctx)
+            self._record_selectivity(probe, tot)
+            total_out = total_out_of(tot, unl, unb)
         if total_out <= chunk:
-            out_cap = self._out_capacity(info, probe.num_rows_int,
-                                         build.num_rows_int)
-            yield self._gather_fn(out_cap)(probe, build, info)
+            out_cap = bucket_capacity(total_out)
+            with self._stage(tctx, "gather"):
+                out = self._gather_fn(out_cap)(probe, build, info)
+            yield out.with_known_rows(total_out)
             return
         STATS["chunked_joins"] += 1
         chunk_cap = bucket_capacity(chunk)
         fn = self._chunk_fn(chunk_cap)
         xp = self.xp
         for off in range(0, total_out, chunk_cap):
-            yield fn(probe, build, info,
-                     xp.asarray(off, dtype=xp.int64)).shrunk()
+            with self._stage(tctx, "gather"):
+                got = fn(probe, build, info,
+                         xp.asarray(off, dtype=xp.int64))
+            yield got.shrunk()
 
     # --- helpers ----------------------------------------------------------
     def _empty_batch(self, attrs) -> ColumnarBatch:
@@ -484,8 +688,8 @@ class NestedLoopJoinExec(BaseJoinExec):
     def _build_info(self, probe, build):  # not used
         raise NotImplementedError
 
-    def _join_one(self, probe: ColumnarBatch, build: ColumnarBatch
-                  ) -> ColumnarBatch:
+    def _join_one(self, probe: ColumnarBatch, build: ColumnarBatch,
+                  tctx: Optional[TaskContext] = None) -> ColumnarBatch:
         n_probe = probe.num_rows_int
         n_build = build.num_rows_int
         how = self._norm_how
@@ -511,13 +715,13 @@ class NestedLoopJoinExec(BaseJoinExec):
         inner/cross products; everything else keeps the one-buffer path."""
         how = self._norm_how
         if self._bound_cond is not None or how not in ("inner", "cross"):
-            yield self._join_one(probe, build)
+            yield self._join_one(probe, build, tctx)
             return
         from ...config import JOIN_OUTPUT_CHUNK_ROWS
         chunk = int(tctx.conf.get(JOIN_OUTPUT_CHUNK_ROWS))
         total = probe.num_rows_int * build.num_rows_int
         if total <= chunk:
-            yield self._join_one(probe, build)
+            yield self._join_one(probe, build, tctx)
             return
         STATS["chunked_joins"] += 1
         chunk_cap = bucket_capacity(chunk)

@@ -107,6 +107,10 @@ def clear_cache() -> None:
     # mis-speculate
     from .aggregate import _OUT_SPECULATION
     _OUT_SPECULATION.clear()
+    # same rule for learned join selectivities: a stale prediction would
+    # recompile gather programs for sizes that immediately mis-speculate
+    from .join import _JOIN_SELECTIVITY
+    _JOIN_SELECTIVITY.clear()
 
 
 def release_compiled_programs() -> None:

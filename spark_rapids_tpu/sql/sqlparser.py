@@ -2170,6 +2170,19 @@ class QueryBuilder:
                             "correlated EXISTS with GROUP BY/HAVING is "
                             "not supported — aggregate in a FROM "
                             "subquery instead")
+                    # LIMIT/OFFSET are per-OUTER-row in a correlated
+                    # EXISTS; after decorrelation they would apply
+                    # globally and drop join keys (same guard as the
+                    # top-level rewrite above).  LIMIT n>0 is a no-op for
+                    # EXISTS; LIMIT <=0 makes the subquery always empty,
+                    # so the marker is constant FALSE.
+                    if q.offset:
+                        raise SqlParseError(
+                            "correlated EXISTS with OFFSET is not "
+                            "supported (it is per-outer-row and has no "
+                            "join rewrite)")
+                    if q.limit is not None and q.limit <= 0:
+                        return F.lit(False).expr
                     q2 = dataclasses.replace(
                         q, where=_and_all(inner_conj),
                         items=[SelectItem(ie, f"__exq{k}_{i}")

@@ -194,3 +194,49 @@ def test_engine_end_to_end_oracle(tmp_path):
                        exp.column("f").to_pylist(), rtol=1e-12)
     m = sess.last_query_metrics
     assert m.get("jsonDeviceDecodedFiles", 0) >= 1, m
+
+
+@pytest.mark.parametrize("tok", ["12.", "-.5", "1.e3", ".5", "1e", "1e+",
+                                 "5.e-2", "--1", "1.2.3", "1e2e3", "-"])
+def test_malformed_number_grammar_declines(tmp_path, tok):
+    """Number tokens must match -?\\d+(\\.\\d+)?([eE][+-]?\\d+)? (leading
+    zeros allowed — the documented permissive edge); anything else parses
+    permissively on device but errors in the host oracle, so decline."""
+    p = tmp_path / "g.json"
+    p.write_text('{"x": %s}\n' % tok)
+    assert _decode(p, [_F("x", T.DoubleType())]) is None
+
+
+@pytest.mark.parametrize("tok,val", [
+    ("12.5", 12.5), ("-0.5e3", -500.0), ("007", 7.0), ("-00.25", -0.25),
+    ("1E+2", 100.0), ("0.5e-1", 0.05), ("1e2", 100.0)])
+def test_valid_number_grammar_parses(tmp_path, tok, val):
+    p = tmp_path / "gv.json"
+    p.write_text('{"x": %s}\n' % tok)
+    b = _decode(p, [_F("x", T.DoubleType())])
+    assert b is not None
+    assert device_to_arrow(b).column("x").to_pylist() == [val]
+
+
+def test_duplicate_key_outside_schema_declines(tmp_path):
+    """Duplicate keys make the row host-semantics-dependent even when the
+    duplicated key is pruned from the plan schema."""
+    p = tmp_path / "dup.json"
+    p.write_text('{"a": 1, "b": 2, "b": 3}\n')
+    assert _decode(p, [_F("a", T.LongType())]) is None
+    # same key on different rows is fine
+    p2 = tmp_path / "dup2.json"
+    p2.write_text('{"a": 1, "b": 2}\n{"a": 2, "b": 3}\n')
+    b = _decode(p2, [_F("a", T.LongType())])
+    assert b is not None
+    assert device_to_arrow(b).column("a").to_pylist() == [1, 2]
+
+
+def test_same_prefix_keys_not_confused_as_duplicates(tmp_path):
+    p = tmp_path / "pref.json"
+    p.write_text('{"ab": 1, "a": 2, "abc": 3}\n')
+    b = _decode(p, [_F("a", T.LongType()), _F("ab", T.LongType())])
+    assert b is not None
+    got = device_to_arrow(b)
+    assert got.column("a").to_pylist() == [2]
+    assert got.column("ab").to_pylist() == [1]

@@ -1,0 +1,303 @@
+"""Join fast path — cached build-side sort + probe-only tuple search +
+speculative output sizing (ISSUE 2 tentpole).
+
+Parity contract: the cached-build path must be bit-identical to the
+union-rank path across every join type, null handling mode, string and
+multi-column keys.  Efficiency contract: ONE build-side sort per build
+batch and at most ONE blocking host readback per probe batch when output
+speculation hits.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.columnar import arrow_to_device
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.ops import join as OJ
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.physical import join as PJ
+
+
+@pytest.fixture()
+def sess():
+    return srt.session()
+
+
+def _sess_with(overrides):
+    return srt.session(conf=RapidsConf.get_global().copy(
+        {k: str(v) for k, v in overrides.items()}))
+
+
+# --------------------------------------------------------------------------
+# ops-level parity: union-rank join_build vs prepare_build_side + probe
+# --------------------------------------------------------------------------
+
+def _key_batches(kind):
+    if kind == "int":
+        l = pa.table({"k": pa.array([1, 2, 2, None, 7, 5, 2],
+                                    type=pa.int64())})
+        r = pa.table({"k": pa.array([2, 2, None, 5, 9],
+                                    type=pa.int64())})
+    elif kind == "string":
+        l = pa.table({"k": pa.array(["aa", "b", None, "ccc", "b",
+                                     "longer-string-key"])})
+        r = pa.table({"k": pa.array(["b", None, "ccc", "zz",
+                                     "longer-string-key"])})
+    elif kind == "multi":
+        l = pa.table({"k1": pa.array([1, 1, 2, 2, None, 3],
+                                     type=pa.int64()),
+                      "k2": pa.array(["x", "y", "x", None, "x", "y"])})
+        r = pa.table({"k1": pa.array([1, 2, 2, None, 3],
+                                     type=pa.int64()),
+                      "k2": pa.array(["y", "x", "x", "x", None])})
+    elif kind == "float":
+        l = pa.table({"k": pa.array([1.5, float("nan"), -0.0, 2.5, None])})
+        r = pa.table({"k": pa.array([0.0, float("nan"), 2.5, None])})
+    else:
+        raise AssertionError(kind)
+    return arrow_to_device(l), arrow_to_device(r)
+
+
+@pytest.mark.parametrize("kind", ["int", "string", "multi", "float"])
+@pytest.mark.parametrize("null_safe", [False, True])
+def test_ops_parity_info_and_pairs(kind, null_safe):
+    """JoinInfo match structure and every gather-map variant agree exactly
+    between the two phase-1 implementations."""
+    import jax.numpy as jnp
+    lb, rb = _key_batches(kind)
+    lmask, rmask = lb.row_mask(), rb.row_mask()
+    lkeys, rkeys = list(lb.columns), list(rb.columns)
+
+    ref = OJ.join_build(jnp, lkeys, rkeys, lmask, rmask,
+                        null_safe=null_safe)
+    bs = OJ.prepare_build_side(jnp, rkeys, rmask, null_safe=null_safe)
+    got = OJ.probe_join_info(jnp, lkeys, lmask, rmask, bs,
+                             null_safe=null_safe)
+
+    np.testing.assert_array_equal(np.asarray(ref.counts),
+                                  np.asarray(got.counts))
+    np.testing.assert_array_equal(np.asarray(ref.csum),
+                                  np.asarray(got.csum))
+    assert int(ref.total) == int(got.total)
+    np.testing.assert_array_equal(np.asarray(ref.l_unmatched),
+                                  np.asarray(got.l_unmatched))
+    np.testing.assert_array_equal(np.asarray(ref.b_unmatched),
+                                  np.asarray(got.b_unmatched))
+    assert int(ref.n_unmatched_l) == int(got.n_unmatched_l)
+    assert int(ref.n_unmatched_b) == int(got.n_unmatched_b)
+
+    out_cap = 64
+    for wl, wr in ((False, False), (True, False), (True, True)):
+        mref = OJ.gather_pairs(jnp, ref, out_cap, with_unmatched_left=wl,
+                               with_unmatched_right=wr)
+        mgot = OJ.gather_pairs(jnp, got, out_cap, with_unmatched_left=wl,
+                               with_unmatched_right=wr)
+        assert int(mref.num_out) == int(mgot.num_out)
+        n = int(mref.num_out)
+        for fld in ("l_idx", "r_idx", "l_ok", "r_ok"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(mref, fld))[:n],
+                np.asarray(getattr(mgot, fld))[:n], err_msg=fld)
+
+
+def test_tuple_searchsorted_matches_numpy():
+    from spark_rapids_tpu.ops.ranks import tuple_searchsorted
+    rng = np.random.default_rng(3)
+    s = np.sort(rng.integers(0, 50, 257))
+    q = rng.integers(-5, 60, 100)
+    for side in ("left", "right"):
+        got = tuple_searchsorted(np, [s], [q], side=side)
+        np.testing.assert_array_equal(got, np.searchsorted(s, q, side=side))
+
+
+# --------------------------------------------------------------------------
+# exec-level parity: buildSideCache on vs off, all public join types
+# --------------------------------------------------------------------------
+
+_L = pa.table({
+    "k": pa.array([1, 2, 2, 3, None, 5], type=pa.int64()),
+    "s": pa.array(["a", "b", "b", None, "c", "d"]),
+    "lv": pa.array([10, 20, 21, 30, 40, 50], type=pa.int64()),
+})
+_R = pa.table({
+    "k": pa.array([2, 2, 3, 4, None], type=pa.int64()),
+    "s": pa.array(["b", "x", None, "y", "b"]),
+    "rv": pa.array([200, 201, 300, 400, 500], type=pa.int64()),
+})
+
+
+def _rows(df, cols):
+    return sorted(
+        (tuple((v is None, v) for v in (row[c] for c in cols))
+         for row in df.collect().to_pylist()))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+@pytest.mark.parametrize("keys", [["k"], ["k", "s"]])
+def test_exec_parity_fast_vs_fallback(how, keys):
+    out = {}
+    for mode in (True, False):
+        sess = _sess_with({
+            "spark.rapids.sql.join.buildSideCache.enabled": mode})
+        l = sess.create_dataframe(_L, num_partitions=2)
+        r = sess.create_dataframe(_R, num_partitions=2)
+        cond = None
+        for k in keys:
+            term = l[k] == r[k]
+            cond = term if cond is None else cond & term
+        q = l.join(r, cond, how)
+        cols = [a.name for a in q._plan.output]
+        out[mode] = _rows(q, cols)
+    assert out[True] == out[False]
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_exec_parity_string_key_broadcast(how):
+    out = {}
+    for mode in (True, False):
+        sess = _sess_with({
+            "spark.rapids.sql.join.buildSideCache.enabled": mode})
+        l = sess.create_dataframe(_L, num_partitions=3)
+        r = sess.create_dataframe(_R.select(["s", "rv"]))
+        q = l.join(r, l.s == r.s, how)
+        cols = [a.name for a in q._plan.output]
+        out[mode] = _rows(q, cols)
+    assert out[True] == out[False]
+
+
+def test_exec_parity_existence_join():
+    """EXISTS under OR plans an existence join; both phase-1 paths must
+    produce the same marker column."""
+    out = {}
+    for mode in (True, False):
+        sess = _sess_with({
+            "spark.rapids.sql.join.buildSideCache.enabled": mode})
+        sess.create_dataframe(_L).createOrReplaceTempView("fx_l")
+        sess.create_dataframe(_R).createOrReplaceTempView("fx_r")
+        got = sess.sql(
+            "SELECT lv FROM fx_l WHERE lv >= 40 OR EXISTS "
+            "(SELECT 1 FROM fx_r WHERE fx_r.k = fx_l.k)").collect()
+        out[mode] = sorted(r["lv"] for r in got.to_pylist())
+    assert out[True] == out[False]
+    assert out[True] == [20, 21, 30, 40, 50]
+
+
+# --------------------------------------------------------------------------
+# efficiency contracts
+# --------------------------------------------------------------------------
+
+def _stats_snap():
+    return dict(PJ.STATS)
+
+
+def _stats_delta(snap):
+    return {k: PJ.STATS[k] - snap[k] for k in snap}
+
+
+def test_broadcast_build_sorted_once():
+    """A broadcast join with several probe partitions computes the
+    build-side sort exactly once (the tentpole's headline contract)."""
+    rng = np.random.default_rng(11)
+    fact = pa.table({"fk": rng.integers(0, 50, 5000),
+                     "x": rng.random(5000)})
+    dim = pa.table({"pk": np.arange(50, dtype=np.int64),
+                    "c": rng.integers(0, 4, 50)})
+    sess = _sess_with({"spark.rapids.sql.adaptive.enabled": "false"})
+    f = sess.create_dataframe(fact, num_partitions=4)
+    d = sess.create_dataframe(dim)
+    q = f.join(d, f.fk == d.pk, "inner").groupBy("c").agg(
+        F.count("*").alias("n"))
+    snap = _stats_snap()
+    got = {r["c"]: r["n"] for r in q.collect().to_pylist()}
+    delta = _stats_delta(snap)
+    assert delta["build_sorts"] == 1, delta
+    assert delta["fastpath_probes"] >= 4, delta
+    assert delta["fallback_probes"] == 0, delta
+    # oracle
+    m = pd.DataFrame(fact.to_pydict()).merge(
+        pd.DataFrame(dim.to_pydict()), left_on="fk", right_on="pk")
+    exp = m.groupby("c").size().to_dict()
+    assert got == {int(k): int(v) for k, v in exp.items()}
+
+
+def test_at_most_one_readback_per_probe_batch():
+    """Speculation hit => exactly one blocking readback per probe batch
+    (the three sizing scalars ride one batched device_get)."""
+    rng = np.random.default_rng(12)
+    fact = pa.table({"fk": rng.integers(0, 64, 4096),
+                     "x": rng.random(4096)})
+    dim = pa.table({"pk": np.arange(64, dtype=np.int64),
+                    "y": rng.random(64)})
+    sess = _sess_with({"spark.rapids.sql.adaptive.enabled": "false"})
+    f = sess.create_dataframe(fact, num_partitions=4)
+    d = sess.create_dataframe(dim)
+    q = f.join(d, f.fk == d.pk, "inner")
+    snap = _stats_snap()
+    n = q.collect().num_rows
+    delta = _stats_delta(snap)
+    assert n == 4096
+    assert delta["fastpath_probes"] >= 1
+    # the hard contract: no probe batch paid more than one readback
+    assert delta["host_readbacks"] <= delta["fastpath_probes"] \
+        + delta["fallback_probes"], delta
+    assert delta["spec_misses"] == 0, delta
+    assert delta["spec_hits"] == delta["fastpath_probes"], delta
+
+
+def test_speculation_overflow_regathers_correctly():
+    """A many-to-many join whose output overflows the predicted bucket
+    must fall back to the exact re-gather — correct rows, miss counted,
+    and the learned selectivity turns the NEXT run into hits."""
+    l = pa.table({"k": np.repeat(np.arange(8, dtype=np.int64), 4),
+                  "lv": np.arange(32, dtype=np.int64)})
+    r = pa.table({"k": np.repeat(np.arange(8, dtype=np.int64), 8),
+                  "rv": np.arange(64, dtype=np.int64)})
+    sess = _sess_with({"spark.rapids.sql.adaptive.enabled": "false"})
+    PJ._JOIN_SELECTIVITY.clear()
+    ldf = sess.create_dataframe(l)
+    rdf = sess.create_dataframe(r)
+    q = ldf.join(rdf, ldf.k == rdf.k, "inner")
+    snap = _stats_snap()
+    assert q.collect().num_rows == 32 * 8  # 4x8 pairs per key, 8 keys
+    delta = _stats_delta(snap)
+    assert delta["spec_misses"] >= 1, delta
+    snap = _stats_snap()
+    assert q.collect().num_rows == 32 * 8
+    delta = _stats_delta(snap)
+    assert delta["spec_misses"] == 0, delta
+    assert delta["spec_hits"] >= 1, delta
+
+
+def test_speculation_kill_switch():
+    sess = _sess_with({
+        "spark.rapids.sql.join.speculativeSizing.enabled": "false",
+        "spark.rapids.sql.adaptive.enabled": "false"})
+    l = sess.create_dataframe(_L)
+    r = sess.create_dataframe(_R)
+    snap = _stats_snap()
+    q = l.join(r, l.k == r.k, "inner")
+    assert q.collect().num_rows == 5  # k=2: 2x2 pairs, k=3: 1
+    delta = _stats_delta(snap)
+    assert delta["spec_hits"] == 0 and delta["spec_misses"] == 0, delta
+
+
+def test_join_stage_metrics_reported(sess):
+    """last_query_metrics carries the per-stage join breakdown the bench
+    artifact banks (readback/sort/search counts + stage times)."""
+    l = sess.create_dataframe(_L)
+    r = sess.create_dataframe(_R)
+    l.join(r, l.k == r.k, "inner").collect()
+    m = sess.last_query_metrics
+    assert m.get("joinHostReadbacks", 0) >= 1, m
+    assert any(k.startswith("joinStage") for k in m), m
+
+
+def test_selectivity_cleared_with_kernel_cache():
+    from spark_rapids_tpu.sql.physical.kernel_cache import clear_cache
+    PJ._JOIN_SELECTIVITY[("probe-key",)] = 2.0
+    clear_cache()
+    assert not PJ._JOIN_SELECTIVITY

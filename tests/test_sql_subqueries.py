@@ -73,11 +73,16 @@ def test_uncorrelated_exists_gates_whole_result(rig):
     assert got.num_rows == int((po.o_flag == 1).sum())
 
 
-def test_subquery_under_or_rejected(rig):
-    sess, _, _ = rig
-    with pytest.raises(ValueError, match="AND-connected"):
-        sess.sql("SELECT o_key FROM sq_orders WHERE o_flag = 1 OR "
-                 "o_key IN (SELECT i_okey FROM sq_items)").collect()
+def test_subquery_under_or(rig):
+    """IN under OR takes the embedded existence-join rewrite (it predates
+    this test's old expectation of a parse rejection)."""
+    sess, po, pi = rig
+    got = sess.sql("SELECT o_key FROM sq_orders WHERE o_flag = 1 OR "
+                   "o_key IN (SELECT i_okey FROM sq_items)"
+                   ).collect().to_pandas()
+    keys = set(pi.i_okey)
+    exp = po.o_key[(po.o_flag == 1) | po.o_key.isin(keys)]
+    assert set(got["o_key"]) == set(exp)
 
 
 def test_not_in_empty_subquery_keeps_null_needle(rig):
@@ -248,3 +253,40 @@ def test_correlated_scalar_star_and_naming_and_dedup(session):
         session.sql(
             "SELECT da.k FROM da JOIN db ON da.v = "
             "(SELECT avg(db.w) FROM db WHERE db.k = da.k)").collect()
+
+
+def test_embedded_correlated_exists_limit_zero(session):
+    """Embedded (under OR) correlated EXISTS with LIMIT 0: the subquery is
+    per-outer-row empty, so the marker must be constant FALSE — the
+    rewrite used to drop the LIMIT and return [10, 40] where Spark
+    returns [40] (ADVICE r5, sqlparser.py:2173)."""
+    session.create_dataframe(pa.table(
+        {"k": pa.array([1, 2], type=pa.int64()),
+         "v": pa.array([10, 40], type=pa.int64())})
+    ).createOrReplaceTempView("el_o")
+    session.create_dataframe(pa.table(
+        {"ik": pa.array([1, 1], type=pa.int64())})
+    ).createOrReplaceTempView("el_i")
+    got = session.sql(
+        "SELECT v FROM el_o WHERE v = 40 OR EXISTS (SELECT 1 FROM el_i "
+        "WHERE el_i.ik = el_o.k LIMIT 0)").collect().to_pylist()
+    assert sorted(r["v"] for r in got) == [40]
+    # LIMIT n>0 stays a no-op for EXISTS
+    got = session.sql(
+        "SELECT v FROM el_o WHERE v = 40 OR EXISTS (SELECT 1 FROM el_i "
+        "WHERE el_i.ik = el_o.k LIMIT 1)").collect().to_pylist()
+    assert sorted(r["v"] for r in got) == [10, 40]
+
+
+def test_embedded_correlated_exists_offset_rejected(session):
+    session.create_dataframe(pa.table(
+        {"k": pa.array([1], type=pa.int64()),
+         "v": pa.array([10], type=pa.int64())})
+    ).createOrReplaceTempView("eo_o")
+    session.create_dataframe(pa.table(
+        {"ik": pa.array([1], type=pa.int64())})
+    ).createOrReplaceTempView("eo_i")
+    with pytest.raises(ValueError, match="OFFSET"):
+        session.sql(
+            "SELECT v FROM eo_o WHERE v = 40 OR EXISTS (SELECT 1 FROM "
+            "eo_i WHERE eo_i.ik = eo_o.k LIMIT 1 OFFSET 1)").collect()
