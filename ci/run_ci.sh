@@ -12,20 +12,47 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/8] native libraries ==="
+echo "=== [1/9] native libraries ==="
 make -C native
 
-echo "=== [2/8] API contract validation ==="
+echo "=== [2/9] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/8] docgen drift check ==="
+echo "=== [3/9] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/8] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [4/9] traced query + chrome-trace schema check ==="
+SRT_TRACE_OUT=$(mktemp -d)/trace.json
+JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
+import sys
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+sess = srt.session(**{"spark.rapids.tpu.profile.enabled": True})
+rng = np.random.default_rng(3)
+n = 50_000
+fact = sess.create_dataframe(pa.table(
+    {"fk": rng.integers(0, 1000, n), "x": rng.random(n)}), num_partitions=2)
+dim = sess.create_dataframe(pa.table(
+    {"pk": np.arange(1000, dtype=np.int64), "cat": rng.integers(0, 8, 1000)}))
+out = (fact.join(dim, fact.fk == dim.pk, "inner").groupBy("cat")
+       .agg(F.count("*").alias("n"), F.sum(F.col("x")).alias("sx"))
+       .orderBy("cat")).collect()
+assert out.num_rows == 8, out.num_rows
+summary = sess.last_query_trace_summary
+assert summary and summary["sync_count"] >= 1, summary
+print("trace summary:", summary)
+print(sess.profile_last_query())
+sess.export_chrome_trace(sys.argv[1])
+PYEOF
+timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
+
+echo "=== [5/9] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -46,14 +73,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [5/8] scale rig ==="
+    echo "=== [6/9] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [5/8] scale rig skipped (quick) ==="
+    echo "=== [6/9] scale rig skipped (quick) ==="
 fi
 
-echo "=== [6/8] packaging: wheel builds and installs ==="
+echo "=== [7/9] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -83,17 +110,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [7/8] driver entry checks ==="
+echo "=== [8/9] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [8/8] second-jax shim world skipped (quick) ==="
+    echo "=== [9/9] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [8/8] second-jax shim world (gated) ==="
+echo "=== [9/9] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

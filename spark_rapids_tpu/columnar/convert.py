@@ -9,6 +9,7 @@ is a single ``jnp.asarray`` per buffer.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -16,6 +17,7 @@ import numpy as np
 import pyarrow as pa
 
 from .. import types as T
+from ..observability import tracer as _trace
 from .batch import ColumnarBatch
 from .column import (DeviceColumn, bucket_capacity, bucket_width,
                      is_string_like, null_column)
@@ -256,7 +258,9 @@ def bulk_device_get(tree):
         try:
             np.dtype(dt)
         except TypeError:
-            return jax.device_get(tree)  # e.g. bfloat16: numpy can't view it
+            # e.g. bfloat16: numpy can't view it
+            with _trace.span("d2h", "device_get.fallback", leaves=len(devs)):
+                return jax.device_get(tree)
     # layout depends on the f64 encoding mode (backend + packFloat64
     # config), which can change mid-session — it must be part of the key
     cache_key = (sig, _f64_as_pair(), _pack_f64_enabled())
@@ -266,6 +270,8 @@ def bulk_device_get(tree):
         if len(_PACK_CACHE) > 512:
             _PACK_CACHE.clear()
             _PACK_CACHE[cache_key] = pack
+    tracing = _trace.TRACING["on"]
+    t0 = time.perf_counter() if tracing else 0.0
     try:
         bufs = pack(*devs)
         for b in bufs:  # overlap the (few) transfers: one latency, not N
@@ -274,7 +280,12 @@ def bulk_device_get(tree):
     except Exception:
         # e.g. an exotic dtype the pack program can't lower on this
         # toolchain — correctness first, one pull per leaf as before
-        return jax.device_get(tree)
+        with _trace.span("d2h", "device_get.fallback", leaves=len(devs)):
+            return jax.device_get(tree)
+    if tracing:
+        _trace.get_tracer().complete(
+            "d2h", "bulk_device_get", t0, time.perf_counter() - t0,
+            bytes=sum(b.nbytes for b in host), leaves=len(devs))
     for i, leaf in zip(dev_idx, unpack_buffers(host, sig)):
         leaves[i] = leaf
     from ..shims import tree_unflatten
@@ -354,9 +365,10 @@ def arrow_to_device(table: pa.Table, capacity: Optional[int] = None
                     ) -> ColumnarBatch:
     n = table.num_rows
     cap = capacity or bucket_capacity(n)
-    cols = [arrow_to_device_column(table.column(i), cap)
-            for i in range(table.num_columns)]
-    return ColumnarBatch.make(table.column_names, cols, n)
+    with _trace.span("h2d", "arrow_to_device", bytes=table.nbytes, rows=n):
+        cols = [arrow_to_device_column(table.column(i), cap)
+                for i in range(table.num_columns)]
+        return ColumnarBatch.make(table.column_names, cols, n)
 
 
 def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:

@@ -281,6 +281,10 @@ def prepacked_device_get(tree):
             narrowable += count * isz
     if narrowable < _min_bytes():
         return bulk_device_get(tree)
+    from ..observability import tracer as _trace
+    tracing = _trace.TRACING["on"]
+    import time as _time
+    t0 = _time.perf_counter() if tracing else 0.0
     try:
         with _LOCK:
             probe = _PROBE_CACHE.get(sig)
@@ -321,10 +325,18 @@ def prepacked_device_get(tree):
         from .convert import unpack_buffers
         narrowed_host = unpack_buffers(host, nsig)
         widened = _widen(narrowed_host, sig, codes)
+        wire = sum(b.nbytes for b in host)
         with _LOCK:
             STATS["prepacked_fetches"] += 1
-            STATS["bytes_on_wire"] += sum(b.nbytes for b in host)
+            STATS["bytes_on_wire"] += wire
             STATS["bytes_naive"] += naive
+        if tracing:
+            # probe + narrowed fetch: both crossings in one d2h span (the
+            # fallback paths above land in bulk_device_get's own span)
+            _trace.get_tracer().complete(
+                "d2h", "prepacked_device_get", t0,
+                _time.perf_counter() - t0, bytes=wire + probe_nbytes,
+                bytes_naive=naive, leaves=len(devs))
     except Exception:  # pragma: no cover - toolchain-specific lowerings
         with _LOCK:
             STATS["fallbacks"] += 1

@@ -538,6 +538,21 @@ TRACE_ENABLED = register(
     "spark.rapids.tpu.trace.enabled",
     "Emit jax.profiler TraceMe ranges around operator execution "
     "(NVTX-range equivalent).", False)
+TRACE_SINK = register(
+    "spark.rapids.tpu.trace.sink",
+    "Query-timeline tracer sink: '' (off), 'memory' (keep the ring "
+    "buffer in process for profile_last_query() / "
+    "session.export_chrome_trace(path)), or a directory path — each "
+    "query additionally appends its timeline as a JSONL event log "
+    "(query-<pid>-<n>.jsonl, the Spark eventLog/history analog).  The "
+    "tracer attributes blocked readbacks, kernel trace+compile and "
+    "H2D/D2H bytes to exec nodes; spark.rapids.tpu.profile.enabled "
+    "implies sink=memory.", "")
+TRACE_BUFFER_EVENTS = register(
+    "spark.rapids.tpu.trace.bufferEvents",
+    "Capacity of the tracer's bounded event ring buffer.  On overflow "
+    "the OLDEST events are dropped (newest kept) and the trace summary "
+    "reports dropped_events.", 65536)
 PROFILE_ENABLED = register(
     "spark.rapids.tpu.profile.enabled",
     "Record per-exec wall time + batch counts during execution; read the "

@@ -16,6 +16,7 @@ import time
 from typing import Dict, Optional
 
 from ..config import CONCURRENT_TASKS, RapidsConf
+from ..observability import tracer as _trace
 
 
 class TpuSemaphore:
@@ -81,6 +82,9 @@ class TpuSemaphore:
                 self._cond.notify_all()
         if tctx is not None:
             tctx.inc_metric("semaphoreWaitTime", waited)
+        if waited > 1e-6 and _trace.TRACING["on"]:
+            _trace.get_tracer().complete("sem_wait", "semaphore.acquire",
+                                         t0, waited, task=task_id)
 
     def release_if_necessary(self, task_id: int):
         with self._lock:

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..columnar.batch import ColumnarBatch
 from ..config import HOST_SPILL_STORAGE_SIZE, SPILL_DIR, RapidsConf
+from ..observability import tracer as _trace
 from .device import DeviceManager
 
 # spill order: lower value spills first (SpillPriorities.scala:83 semantics,
@@ -274,7 +275,8 @@ class BufferCatalog:
         import jax
         # one concurrent D2H for all leaves (per-array pulls each cost a
         # full tunnel round trip)
-        buf.leaves = list(jax.device_get(buf.leaves))
+        with _trace.span("spill", "spill.deviceToHost", bytes=buf.size):
+            buf.leaves = list(jax.device_get(buf.leaves))
         buf.tier = HOST
         self.device_bytes -= buf.size
         self.host_bytes += buf.size
@@ -293,8 +295,9 @@ class BufferCatalog:
     def _host_to_disk(self, buf: _Buffer):
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir, f"buf-{uuid.uuid4().hex}.spill")
-        with open(path, "wb") as f:
-            pickle.dump(buf.leaves, f, protocol=pickle.HIGHEST_PROTOCOL)
+        with _trace.span("spill", "spill.hostToDisk", bytes=buf.size):
+            with open(path, "wb") as f:
+                pickle.dump(buf.leaves, f, protocol=pickle.HIGHEST_PROTOCOL)
         buf.leaves = None
         buf.disk_path = path
         buf.tier = DISK
@@ -302,8 +305,9 @@ class BufferCatalog:
         self.disk_bytes += buf.size
 
     def _disk_to_host(self, buf: _Buffer):
-        with open(buf.disk_path, "rb") as f:
-            buf.leaves = pickle.load(f)
+        with _trace.span("spill", "spill.diskToHost", bytes=buf.size):
+            with open(buf.disk_path, "rb") as f:
+                buf.leaves = pickle.load(f)
         os.unlink(buf.disk_path)
         buf.disk_path = None
         buf.tier = HOST
@@ -319,8 +323,9 @@ class BufferCatalog:
         # a real allocation failure during unspill is caught by the
         # kernel-level oom_guard on the next device op instead
         self.ensure_headroom(buf.size)
-        buf.leaves = [jax.device_put(l) if isinstance(l, np.ndarray) else l
-                      for l in buf.leaves]
+        with _trace.span("spill", "spill.unspillToDevice", bytes=buf.size):
+            buf.leaves = [jax.device_put(l) if isinstance(l, np.ndarray)
+                          else l for l in buf.leaves]
         buf.tier = DEVICE
         self.host_bytes -= buf.size
         self.device_bytes += buf.size

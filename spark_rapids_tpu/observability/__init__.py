@@ -1,0 +1,25 @@
+"""Query-timeline observability — structured tracer, Chrome-trace/JSONL
+export, and per-query attribution reports.
+
+The engine's perf story lives or dies on data-movement accounting (the
+Theseus / "GPU-era analytical processing" argument): a rows/s number
+without knowing how much wall time was blocked readbacks, kernel
+trace+compile, or H2D/D2H bytes is not a diagnosis.  This package is the
+TPU analog of the reference's SQL-UI GpuMetric plumbing + NVTX ranges +
+Spark eventLog, recast as one in-process timeline:
+
+* :mod:`.tracer` — thread-safe bounded ring buffer of span/counter
+  events (categories ``op``/``kernel_compile``/``sync``/``h2d``/``d2h``/
+  ``spill``/``shuffle``/``sem_wait``), near-zero overhead when disabled.
+* :mod:`.export` — Chrome trace-event JSON (Perfetto-loadable) and an
+  append-only JSONL event log per query (eventLog/history analog).
+* :mod:`.report` — per-query attribution: blocking-readback count & ms
+  per exec, kernel hit/miss & compile ms, bytes on the wire, spill and
+  semaphore-wait time.
+"""
+
+from .tracer import (TRACING, QueryTracer, current_exec, get_tracer,
+                     pop_exec, push_exec, span)
+
+__all__ = ["TRACING", "QueryTracer", "get_tracer", "span", "push_exec",
+           "pop_exec", "current_exec"]

@@ -1,0 +1,111 @@
+"""Trace export — Chrome trace-event JSON (Perfetto-loadable) and an
+append-only JSONL event log per query (the Spark eventLog/history analog).
+
+Chrome trace-event schema (the subset we emit, validated by
+tools/check_trace.py and the tracer tests):
+
+* every event carries ``ph``, ``ts``, ``pid``, ``tid``, ``name``;
+* spans are ``ph == "X"`` complete events with ``dur`` (µs);
+* aggregate counters export as ``ph == "C"`` counter events;
+* thread/process names ride ``ph == "M"`` metadata events.
+
+JSONL log layout: line 1 is a ``{"meta": ...}`` header (query id, wall
+epoch, capacity, drop count, counters); each following line is one event
+exactly as the tracer recorded it — so a round trip through
+:func:`write_event_log`/:func:`read_event_log` is lossless.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def chrome_trace(events: List[Dict[str, Any]],
+                 meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Events (tracer snapshot) -> Chrome trace-event JSON object."""
+    meta = meta or {}
+    pid = int(meta.get("pid", os.getpid()))
+    out: List[Dict[str, Any]] = []
+    # compact tids: Perfetto renders raw pthread ids poorly
+    tid_map: Dict[int, int] = {}
+
+    def tid_of(raw) -> int:
+        t = tid_map.get(raw)
+        if t is None:
+            t = tid_map[raw] = len(tid_map)
+        return t
+
+    for ev in events:
+        args = dict(ev.get("args") or {})
+        if ev.get("exec"):
+            args["exec"] = ev["exec"]
+        out.append({
+            "ph": "X", "cat": ev.get("cat", ""), "name": ev["name"],
+            "ts": round(float(ev["ts"]), 3),
+            "dur": round(float(ev.get("dur", 0.0)), 3),
+            "pid": pid, "tid": tid_of(ev.get("tid", 0)),
+            "args": args,
+        })
+    end_ts = max((e["ts"] + e["dur"] for e in out), default=0.0)
+    for name, value in (meta.get("counters") or {}).items():
+        out.append({"ph": "C", "name": name, "ts": round(end_ts, 3),
+                    "pid": pid, "tid": 0, "args": {"value": value}})
+    out.append({"ph": "M", "name": "process_name", "ts": 0, "pid": pid,
+                "tid": 0, "args": {"name": "spark_rapids_tpu"}})
+    for raw, t in tid_map.items():
+        out.append({"ph": "M", "name": "thread_name", "ts": 0, "pid": pid,
+                    "tid": t, "args": {"name": f"thread-{t} ({raw})"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {k: v for k, v in meta.items()
+                          if k not in ("counters",)}}
+
+
+def write_chrome_trace(path: str, events: List[Dict[str, Any]],
+                       meta: Optional[Dict[str, Any]] = None) -> str:
+    with open(path, "w") as fh:
+        json.dump(chrome_trace(events, meta), fh)
+    return path
+
+
+# --------------------------------------------------------------------------
+# JSONL event log (eventLog/history analog)
+# --------------------------------------------------------------------------
+
+def write_event_log(path: str, events: List[Dict[str, Any]],
+                    meta: Optional[Dict[str, Any]] = None) -> str:
+    """Append one query's timeline to ``path`` (header line + events).
+    Append-only: successive queries pointed at the same file stack their
+    logs, each self-delimited by its meta header."""
+    with open(path, "a") as fh:
+        fh.write(json.dumps({"meta": meta or {}}) + "\n")
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    return path
+
+
+def read_event_log(path: str
+                   ) -> List[Tuple[Dict[str, Any], List[Dict[str, Any]]]]:
+    """Parse a JSONL event log back into [(meta, events), ...] — one
+    entry per appended query."""
+    out: List[Tuple[Dict[str, Any], List[Dict[str, Any]]]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if "meta" in rec and "name" not in rec:
+                out.append((rec["meta"], []))
+            elif out:
+                out[-1][1].append(rec)
+            else:  # tolerate logs whose header line was truncated away
+                out.append(({}, [rec]))
+    return out
+
+
+def event_log_path(sink_dir: str, query_id: int) -> str:
+    """Per-query log file under the configured sink directory."""
+    os.makedirs(sink_dir, exist_ok=True)
+    return os.path.join(sink_dir, f"query-{os.getpid()}-{query_id}.jsonl")

@@ -1,0 +1,220 @@
+"""Structured query-timeline tracer.
+
+One process-wide :class:`QueryTracer` holds a thread-safe bounded ring
+buffer of events.  Instrumented chokepoints (columnar/convert.py,
+sql/physical/transitions.py, kernel_cache.py, memory/spill.py,
+memory/semaphore.py, shuffle/serializer.py, the join sizing readbacks)
+guard every emission on the module-level ``TRACING`` flag — the same
+single-dict pattern as ``PROFILING`` in sql/physical/base.py — so a
+disabled tracer costs one dict lookup per chokepoint, nothing else.
+
+Event categories:
+
+=================  =========================================================
+``op``             exec-node batch production (and join pipeline stages)
+``kernel_compile`` a cached_jit kernel's trace+compile (first call / new
+                   input signature)
+``sync``           blocking scalar readbacks (join sizing, speculation)
+``h2d``            host -> device uploads (arrow decode, transitions)
+``d2h``            device -> host fetches (bulk/prepacked device_get)
+``spill``          spill-catalog tier movement
+``shuffle``        exchange materialization + frame (de)serialization
+``sem_wait``       device-semaphore acquisition waits
+=================  =========================================================
+
+Spans attribute to the *owning exec node* via a thread-local exec stack:
+the profiled ``execute`` wrapper (base.py) pushes each node's name around
+its own batch production, so the innermost executing exec is always on
+top — a ``d2h`` fetch fired while ``DeviceToHost`` pulls a batch lands on
+``DeviceToHost`` even though outer nodes are also mid-pull.  The stack
+composes with :meth:`TaskContext.as_current` nesting (exchange map-side
+tasks): pushes/pops are strictly scoped, so a nested task restores the
+outer attribution on exit.
+
+Concurrency model: the tracer is PROCESS-wide, like the reference's
+per-executor GpuMetric sinks.  The engine runs a single driver per
+process (sessions execute queries serially on the calling thread; only
+the shuffle/IO pools fan out, and those belong to the one running query),
+so per-query reset-and-snapshot from the session is sound.  Two sessions
+collecting *concurrently* from different threads would interleave events
+— that configuration is unsupported for tracing, documented in
+docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: master switch — flipped per query by the session (restored in a
+#: ``finally``, so an exception mid-query cannot leak tracing into the
+#: next session's query).  Near-zero overhead when off.
+TRACING = {"on": False}
+
+#: known span categories (exported traces may add more; the checker and
+#: the report treat unknown categories as opaque)
+CATEGORIES = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
+              "shuffle", "sem_wait")
+
+#: default ring capacity (spark.rapids.tpu.trace.bufferEvents)
+DEFAULT_CAPACITY = 65536
+
+
+# --------------------------------------------------------------------------
+# exec-node attribution stack (thread-local)
+# --------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def _stack() -> List[str]:
+    s = getattr(_tls, "exec_stack", None)
+    if s is None:
+        s = _tls.exec_stack = []
+    return s
+
+
+def push_exec(name: str) -> None:
+    """Mark ``name`` as the exec producing batches on this thread."""
+    _stack().append(name)
+
+
+def pop_exec() -> None:
+    s = _stack()
+    if s:
+        s.pop()
+
+
+def current_exec() -> str:
+    """Innermost exec node executing on this thread ('' outside a plan —
+    e.g. the driver's final result fetch)."""
+    s = _stack()
+    return s[-1] if s else ""
+
+
+# --------------------------------------------------------------------------
+# the tracer
+# --------------------------------------------------------------------------
+
+class QueryTracer:
+    """Bounded ring buffer of trace events (newest kept on overflow, with
+    a ``dropped_events`` counter) plus aggregate named counters."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(16, int(capacity)))
+        self.dropped_events = 0
+        self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()
+        self.counters: Dict[str, float] = {}
+
+    # --- lifecycle --------------------------------------------------------
+    def reset(self, capacity: Optional[int] = None) -> None:
+        """Start a fresh timeline (called by the session at query start)."""
+        with self._lock:
+            if capacity is not None and \
+                    int(capacity) != self._events.maxlen:
+                self._events = deque(maxlen=max(16, int(capacity)))
+            else:
+                self._events.clear()
+            self.dropped_events = 0
+            self.counters = {}
+            self._epoch = time.perf_counter()
+            self._epoch_wall = time.time()
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen or 0
+
+    # --- emission ---------------------------------------------------------
+    def complete(self, cat: str, name: str, t0: float, dur_s: float,
+                 exec_: Optional[str] = None, **args: Any) -> None:
+        """Record a retroactive complete span: ``t0`` is the
+        ``time.perf_counter()`` at span start, ``dur_s`` its duration in
+        seconds.  ``exec_`` defaults to the thread's current exec node."""
+        ev: Dict[str, Any] = {
+            "cat": cat, "name": name,
+            "ts": (t0 - self._epoch) * 1e6,          # µs from trace epoch
+            "dur": max(dur_s, 0.0) * 1e6,
+            "tid": threading.get_ident(),
+            "exec": current_exec() if exec_ is None else exec_,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped_events += 1
+            self._events.append(ev)
+
+    def counter(self, name: str, value: float = 1.0) -> None:
+        """Accumulate a named aggregate counter (no per-event storage)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    # --- readout ----------------------------------------------------------
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Events oldest-first (a copy; safe to hold across resets)."""
+        with self._lock:
+            return list(self._events)
+
+    def meta(self) -> Dict[str, Any]:
+        """Trace metadata for exports: wall-clock epoch + drop stats."""
+        import os
+        with self._lock:
+            return {"epoch_unix_s": self._epoch_wall,
+                    "pid": os.getpid(),
+                    "capacity": self._events.maxlen,
+                    "dropped_events": self.dropped_events,
+                    "counters": dict(self.counters)}
+
+
+_TRACER = QueryTracer()
+
+
+def get_tracer() -> QueryTracer:
+    return _TRACER
+
+
+# --------------------------------------------------------------------------
+# span context manager (null-object when disabled)
+# --------------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared no-op span — the disabled-path cost is one flag lookup."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("cat", "name", "args", "t0")
+
+    def __init__(self, cat: str, name: str, args: Dict[str, Any]):
+        self.cat, self.name, self.args = cat, name, args
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _TRACER.complete(self.cat, self.name, self.t0,
+                         time.perf_counter() - self.t0, **self.args)
+        return False
+
+
+def span(cat: str, name: str, **args: Any):
+    """Context manager recording a complete span when tracing is on; a
+    shared null object otherwise.  Callers computing *expensive* span
+    args should guard on ``TRACING["on"]`` themselves."""
+    if not TRACING["on"]:
+        return _NULL_SPAN
+    return _Span(cat, name, args)

@@ -98,6 +98,14 @@ class JoinInfo(NamedTuple):
     n_unmatched_l: "np.ndarray"  # int64 scalar
     n_unmatched_b: "np.ndarray"  # int64 scalar
 
+    def sizing_scalars(self) -> tuple:
+        """The three output-sizing scalars — THE one blocking host
+        readback of the join path.  Exposed as a tuple so the exec layer
+        fetches all three in a single batched ``device_get`` (one tunnel
+        round trip, not three) and the tracer can attribute that sync to
+        the join in one place."""
+        return (self.total, self.n_unmatched_l, self.n_unmatched_b)
+
 
 def _sentinel_ranks(xp, rank, key_cols: Sequence[DeviceColumn], mask, sentinel):
     """Replace ranks of dead rows and null-keyed rows with a sentinel that

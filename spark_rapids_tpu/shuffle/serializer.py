@@ -19,11 +19,13 @@ from __future__ import annotations
 import io
 import json
 import struct
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import types as T
+from ..observability import tracer as _trace
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import DeviceColumn, bucket_capacity, make_array_column
 
@@ -100,6 +102,17 @@ def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
 
 
 def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
+    tracing = _trace.TRACING["on"]
+    t0 = time.perf_counter() if tracing else 0.0
+    frame = _serialize_batch(batch, conf)
+    if tracing:
+        _trace.get_tracer().complete(
+            "shuffle", "serialize_batch", t0, time.perf_counter() - t0,
+            bytes=len(frame), rows=batch.num_rows_int)
+    return frame
+
+
+def _serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
     # one transfer for all buffers, with device-side narrowing when the
     # batch is big enough to pay for the probe (columnar/prepack.py —
     # bytes shrink BEFORE they cross the tunnel, nvcomp-codec analog)
@@ -241,6 +254,12 @@ def _deserialize_column(buf: memoryview, pos: int, dt: T.DataType, n: int,
 
 def deserialize_batch(frame: bytes, capacity: Optional[int] = None
                      ) -> ColumnarBatch:
+    with _trace.span("shuffle", "deserialize_batch", bytes=len(frame)):
+        return _deserialize_batch(frame, capacity)
+
+
+def _deserialize_batch(frame: bytes, capacity: Optional[int] = None
+                       ) -> ColumnarBatch:
     head = struct.unpack_from("<4sHHII", frame, 0)
     if head[0] != _MAGIC:
         raise ValueError("bad shuffle frame magic")

@@ -18,6 +18,7 @@ import numpy as np
 
 from ...columnar.batch import ColumnarBatch
 from ...config import RapidsConf
+from ...observability import tracer as _trace
 from ..expressions.core import AttributeReference
 
 TPU, CPU = "tpu", "cpu"
@@ -82,7 +83,15 @@ class TaskContext:
 
 #: process-wide profiling switch, flipped per query by the session from
 #: spark.rapids.tpu.profile.enabled (single-driver model, like the
-#: reference's per-query GpuMetric wiring)
+#: reference's per-query GpuMetric wiring).  The session SAVES and
+#: RESTORES the previous value around each query (finally-guarded), so a
+#: query raising mid-flight — or a session that enables profiling — can
+#: never leak the flag into a later query or another session.  The flag
+#: being process-wide is sound only under the single-driver model: one
+#: query executes at a time per process (sessions run queries serially on
+#: the calling thread; the shuffle/IO pools belong to that one query).
+#: Concurrent collect() calls from two threads are unsupported for
+#: profiling/tracing — see docs/observability.md.
 PROFILING = {"on": False}
 
 
@@ -97,34 +106,51 @@ class PhysicalPlan:
         self._prof_batches = 0
 
     def __init_subclass__(cls, **kw):
-        """Wrap every exec's ``execute`` with the profiling shim (the
-        SQL-UI per-op metric plumbing of ``GpuExec.scala:49-141``): when
-        profiling is on, time spent pulling each batch from this node's
-        iterator (children included) accrues to the node; the report
-        derives self-time as inclusive minus children."""
+        """Wrap every exec's ``execute`` with the profiling/tracing shim
+        (the SQL-UI per-op metric plumbing of ``GpuExec.scala:49-141``):
+        when profiling or tracing is on, time spent pulling each batch
+        from this node's iterator (children included) accrues to the
+        node; the report derives self-time as inclusive minus children.
+        When tracing is on, each pull additionally emits an ``op`` span
+        and brackets itself on the tracer's exec stack — a nested child
+        pull pushes the child on top, so chokepoint spans (sync/h2d/d2h/
+        spill) fired during the pull attribute to the innermost executing
+        exec."""
         super().__init_subclass__(**kw)
         orig = cls.__dict__.get("execute")
         if orig is None or getattr(orig, "_profiled", False):
             return
 
         def execute(self, pid, tctx, _orig=orig):
-            if not PROFILING["on"]:
+            if not (PROFILING["on"] or _trace.TRACING["on"]):
                 return _orig(self, pid, tctx)
             import time as _t
 
             def gen():
+                tracing = _trace.TRACING["on"]
+                name = self.node_name() if tracing else ""
                 t0 = _t.perf_counter_ns()
                 it = iter(_orig(self, pid, tctx))
                 self._prof_ns += _t.perf_counter_ns() - t0
                 while True:
                     t1 = _t.perf_counter_ns()
+                    if tracing:
+                        _trace.push_exec(name)
                     try:
                         b = next(it)
                     except StopIteration:
                         self._prof_ns += _t.perf_counter_ns() - t1
                         return
-                    self._prof_ns += _t.perf_counter_ns() - t1
+                    finally:
+                        if tracing:
+                            _trace.pop_exec()
+                    dt = _t.perf_counter_ns() - t1
+                    self._prof_ns += dt
                     self._prof_batches += 1
+                    if tracing:
+                        _trace.get_tracer().complete(
+                            "op", name, t1 / 1e9, dt / 1e9, exec_=name,
+                            partition=pid)
                     yield b
             return gen()
 
@@ -174,6 +200,11 @@ class PhysicalPlan:
         tracing = bool(cfg.get(TRACE_ENABLED))
         for pid in range(self.num_partitions()):
             tctx = TaskContext(pid, conf)
+            # save/restore the PREVIOUS context like as_current() does: a
+            # nested execute_all (map-side subquery / broadcast build run
+            # under an outer exchange task) must not wipe the outer
+            # task's thread-local on exit
+            prev_ctx = TaskContext.current()
             TaskContext._set_current(tctx)
             arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
                               int(tctx.conf.get(TEST_INJECT_SPLIT_OOM)))
@@ -198,7 +229,7 @@ class PhysicalPlan:
                 # disarm: unconsumed synthetic OOMs must not leak into the
                 # next task or into direct with_retry callers (tests)
                 arm_oom_injection(0, 0)
-                TaskContext._set_current(None)
+                TaskContext._set_current(prev_ctx)
                 sem.release_if_necessary(pid)
                 for k, v in tctx.metrics.items():
                     self.metrics[k] = self.metrics.get(k, 0.0) + v

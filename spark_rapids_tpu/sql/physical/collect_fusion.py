@@ -294,9 +294,18 @@ class FusedCollectExec(PhysicalPlan):
             STATS["fallbacks"] += 1
             yield from self._run_fallback_on([batch], pid, tctx)
             return
+        from ...observability import tracer as _trace
+        tracing = _trace.TRACING["on"]
+        import time as _time
+        t0 = _time.perf_counter() if tracing else 0.0
         for b in bufs:  # overlap transfers: one latency, not N
             b.copy_to_host_async()
         host = [np.asarray(b) for b in bufs]
+        if tracing:
+            _trace.get_tracer().complete(
+                "d2h", "fused_collect.fetch", t0,
+                _time.perf_counter() - t0,
+                bytes=sum(b.nbytes for b in host))
         leaves = unpack_buffers(host, sig)
         ng_host = int(leaves[-1])
         if not is_final:

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ...columnar.batch import ColumnarBatch
+from ...observability import tracer as _trace
 from ...parallel.partitioning import (HashPartitioning, Partitioning,
                                       RangePartitioning, RoundRobinPartitioning,
                                       SinglePartitioning)
@@ -76,6 +77,13 @@ class ShuffleExchangeExec(PhysicalPlan):
 
     # --- materialization --------------------------------------------------
     def _ensure_materialized(self, tctx: TaskContext):
+        if self._materialized is not None:
+            return
+        with _trace.span("shuffle", "exchange.materialize",
+                         partitions=self.num_partitions()):
+            self._materialize(tctx)
+
+    def _materialize(self, tctx: TaskContext):
         """Map side: split each child batch by target and hand the pieces to
         the shuffle manager (serializer + SORT/MULTITHREADED/ICI data
         plane); reduce side then fetches + host-concats per partition
@@ -85,8 +93,6 @@ class ShuffleExchangeExec(PhysicalPlan):
         through ONE compiled all_to_all program instead
         (parallel/mesh.py) — the planned-query analog of the reference's
         UCX device-direct path."""
-        if self._materialized is not None:
-            return
         from ...shuffle import get_shuffle_manager
         child = self.children[0]
         nt = self.num_partitions()
@@ -375,10 +381,12 @@ class BroadcastExchangeExec(PhysicalPlan):
     def broadcast_batch(self, tctx: TaskContext) -> ColumnarBatch:
         if self._cached is None:
             batches = []
-            for cpid in range(self.children[0].num_partitions()):
-                ctctx = TaskContext(cpid, tctx.conf, parent=tctx)
-                with ctctx.as_current():
-                    batches.extend(self.children[0].execute(cpid, ctctx))
+            with _trace.span("shuffle", "broadcast.materialize"):
+                for cpid in range(self.children[0].num_partitions()):
+                    ctctx = TaskContext(cpid, tctx.conf, parent=tctx)
+                    with ctctx.as_current():
+                        batches.extend(
+                            self.children[0].execute(cpid, ctctx))
             if not batches:
                 self._cached = empty_batch_for(self.output)
             else:

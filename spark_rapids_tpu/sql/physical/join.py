@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ... import types as T
 from ...columnar.batch import ColumnarBatch
+from ...observability import tracer as _tracer
 from ...columnar.column import bucket_capacity
 from ...ops.join import (JoinBuildSide, JoinInfo, compact_indices,
                          cross_pairs, fastpath_supported, gather_pairs,
@@ -166,11 +167,16 @@ class BaseJoinExec(PhysicalPlan):
             need_b_matched=self._norm_how == "full",
             need_l_unmatched=self._norm_how in ("left", "full"))
 
+    #: tracer category per join stage: the sizing readback is a blocking
+    #: device sync; every other stage is host-side dispatch work
+    _STAGE_CAT = {"readback": "sync"}
+
     @contextmanager
     def _stage(self, tctx: Optional[TaskContext], name: str):
         """Per-stage join profiling: a jax.profiler TraceAnnotation around
         the host-side stage (dispatch or blocking fetch) plus a wall-time
-        metric in last_query_metrics (joinStage<Name>Ms)."""
+        metric in last_query_metrics (joinStage<Name>Ms) and a tracer
+        span (cat ``sync`` for the sizing readback)."""
         ann = None
         if PROFILING["on"] and self.backend == TPU:
             import jax.profiler
@@ -180,9 +186,13 @@ class BaseJoinExec(PhysicalPlan):
         try:
             yield
         finally:
+            dt = time.perf_counter() - t0
             if tctx is not None:
                 tctx.inc_metric(f"joinStage{name[0].upper()}{name[1:]}Ms",
-                                (time.perf_counter() - t0) * 1e3)
+                                dt * 1e3)
+            if _tracer.TRACING["on"]:
+                _tracer.get_tracer().complete(
+                    self._STAGE_CAT.get(name, "op"), f"join.{name}", t0, dt)
             if ann is not None:
                 ann.__exit__(None, None, None)
 
@@ -246,11 +256,9 @@ class BaseJoinExec(PhysicalPlan):
         with self._stage(tctx, "readback"):
             if self.backend == TPU:
                 import jax
-                tot, unl, unb = jax.device_get(
-                    [info.total, info.n_unmatched_l, info.n_unmatched_b])
+                tot, unl, unb = jax.device_get(list(info.sizing_scalars()))
             else:
-                tot, unl, unb = (info.total, info.n_unmatched_l,
-                                 info.n_unmatched_b)
+                tot, unl, unb = info.sizing_scalars()
         return int(tot), int(unl), int(unb)
 
     # --- phase 2 ----------------------------------------------------------

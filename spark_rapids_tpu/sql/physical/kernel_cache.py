@@ -17,8 +17,11 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Tuple
+
+from ...observability import tracer as _trace
 
 #: LRU bound — each entry pins its exec instance (and that exec's child
 #: subtree) via the jitted closure, and keys embed literal values, so an
@@ -29,7 +32,53 @@ _MAX_ENTRIES = int(os.environ.get("SRT_KERNEL_CACHE_SIZE", "1024"))
 
 _CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
 _LOCK = threading.Lock()
-_STATS = {"hits": 0, "misses": 0, "evictions": 0}
+_STATS = {"hits": 0, "misses": 0, "evictions": 0,
+          "compiles": 0, "compile_ms": 0.0}
+
+#: per-key trace+compile accounting (observability report: "compile ms
+#: per key"); keyed by the human-readable kernel label
+_COMPILE_BY_KEY: Dict[str, Dict[str, float]] = {}
+
+
+class _TrackedKernel:
+    """Thin wrapper over a jitted callable that detects re-traces (via
+    the jit wrapper's ``_cache_size``) and accounts trace+compile wall
+    time per kernel key — the tracer's ``kernel_compile`` spans.
+
+    Cost model: when tracing is OFF this is one dict lookup + one extra
+    Python call per kernel launch (launches are per batch per op, never
+    per row).  When ON, a ``_cache_size()`` probe brackets the call; a
+    size increase means this call traced+compiled, and its wall time
+    (dispatch included — XLA compiles synchronously inside the call) is
+    recorded against the key.
+    """
+
+    __slots__ = ("_fn", "_label")
+
+    def __init__(self, fn: Callable, label: str):
+        self._fn = fn
+        self._label = label
+
+    def __call__(self, *args, **kwargs):
+        if not _trace.TRACING["on"]:
+            return self._fn(*args, **kwargs)
+        cs = getattr(self._fn, "_cache_size", None)
+        before = cs() if cs is not None else -1
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if cs is not None and cs() > before:
+            ms = dt * 1e3
+            with _LOCK:
+                _STATS["compiles"] += 1
+                _STATS["compile_ms"] += ms
+                e = _COMPILE_BY_KEY.setdefault(
+                    self._label, {"compiles": 0, "ms": 0.0})
+                e["compiles"] += 1
+                e["ms"] += ms
+            _trace.get_tracer().complete("kernel_compile", self._label,
+                                         t0, dt)
+        return out
 
 
 def _trace_salt() -> Tuple:
@@ -83,7 +132,8 @@ def cached_jit(key: Tuple, fn: Callable) -> Callable:
             return cached
         _STATS["misses"] += 1
         import jax
-        wrapper = jax.jit(fn)
+        label = f"{key[0]}#{abs(hash(key)) & 0xFFFF:04x}"
+        wrapper = _TrackedKernel(jax.jit(fn), label)
         _CACHE[key] = wrapper
         while len(_CACHE) > _MAX_ENTRIES:
             _CACHE.popitem(last=False)
@@ -96,12 +146,22 @@ def cache_stats() -> Dict[str, int]:
         return dict(_STATS, size=len(_CACHE))
 
 
+def compile_stats_by_key() -> Dict[str, Dict[str, float]]:
+    """Per-kernel-key trace+compile accounting (label -> compiles, ms);
+    only accrues while tracing is on."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _COMPILE_BY_KEY.items()}
+
+
 def clear_cache() -> None:
     with _LOCK:
         _CACHE.clear()
+        _COMPILE_BY_KEY.clear()
         _STATS["hits"] = 0
         _STATS["misses"] = 0
         _STATS["evictions"] = 0
+        _STATS["compiles"] = 0
+        _STATS["compile_ms"] = 0.0
     # stale group-size speculations point at programs just dropped; a
     # speculated miss would recompile a size that may immediately
     # mis-speculate

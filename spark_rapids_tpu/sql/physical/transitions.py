@@ -14,6 +14,7 @@ from typing import Iterator, List
 import numpy as np
 
 from ...columnar.batch import ColumnarBatch
+from ...observability import tracer as _trace
 from .base import CPU, TPU, PhysicalPlan, TaskContext
 
 
@@ -41,8 +42,13 @@ class HostToDeviceExec(PhysicalPlan):
 
         from ...shims import tree_map
         for batch in self.children[0].execute(pid, tctx):
-            tctx.inc_metric("h2d_bytes", batch_nbytes(batch))
-            yield tree_map(jnp.asarray, batch)
+            nb = batch_nbytes(batch)
+            tctx.inc_metric("h2d_bytes", nb)
+            # span covers the upload dispatch only, not downstream
+            # consumption of the yielded batch
+            with _trace.span("h2d", "HostToDevice.upload", bytes=nb):
+                up = tree_map(jnp.asarray, batch)
+            yield up
 
     def node_name(self):
         return "HostToDevice"

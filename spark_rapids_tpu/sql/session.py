@@ -37,6 +37,9 @@ class TpuSession:
         self._conf = base.copy(conf_kwargs or None)
         self.conf = SessionConf(self._conf)
         self.last_query_metrics: dict = {}
+        #: compact tracer summary of the last traced query (sync count/ms,
+        #: compile ms, bytes on the wire); None when tracing was off
+        self.last_query_trace_summary: Optional[dict] = None
         self._temp_views: dict = {}
         #: name -> implementation object (Hive UDF bridge; hiveUDFs.scala
         #: analog — populated by CREATE TEMPORARY FUNCTION or the API)
@@ -115,10 +118,78 @@ class TpuSession:
     # ------------------------------------------------------------------
     def _execute(self, logical: P.LogicalPlan) -> pa.Table:
         from ..columnar.convert import device_to_arrow
-        from ..config import PROFILE_ENABLED
+        from ..config import PROFILE_ENABLED, TRACE_BUFFER_EVENTS, TRACE_SINK
+        from ..observability import tracer as OT
         from .physical import speculation
         from .physical.base import PROFILING
-        PROFILING["on"] = bool(self._conf.get(PROFILE_ENABLED))
+        from .physical.kernel_cache import cache_stats
+        profiling = bool(self._conf.get(PROFILE_ENABLED))
+        sink = str(self._conf.get(TRACE_SINK) or "").strip()
+        # profile.enabled implies an in-memory trace so the profile report
+        # carries sync/compile/transfer attribution, not just wall time
+        tracing = profiling or bool(sink)
+        # save/restore the process-wide flags (finally-guarded): a query
+        # raising mid-flight, or one session enabling profiling, must not
+        # leak the flags into a later query or another session's.  The
+        # flags being process-global at all rests on the single-driver
+        # model — see PROFILING in physical/base.py.
+        prev_prof, prev_trace = PROFILING["on"], OT.TRACING["on"]
+        PROFILING["on"] = profiling or tracing
+        if tracing:
+            OT.get_tracer().reset(int(self._conf.get(TRACE_BUFFER_EVENTS)))
+        OT.TRACING["on"] = tracing
+        cache_stats0 = cache_stats()
+        self._query_seq = getattr(self, "_query_seq", 0) + 1
+        ok = False
+        try:
+            out = self._execute_traced(logical, device_to_arrow,
+                                       speculation)
+            ok = True
+            return out
+        finally:
+            PROFILING["on"] = prev_prof
+            OT.TRACING["on"] = prev_trace
+            self._finish_trace(tracing, sink, cache_stats0, ok)
+
+    def _finish_trace(self, tracing: bool, sink: str, cache_stats0: dict,
+                      ok: bool) -> None:
+        """Per-query trace epilogue: fold kernel-cache deltas into
+        last_query_metrics, snapshot the tracer (the ring is process-wide
+        and resets at the next traced query), build the compact summary,
+        and append the JSONL event log when the sink is a directory."""
+        from .physical.kernel_cache import cache_stats
+        cs1 = cache_stats()
+        if ok:  # on failure last_query_metrics is still the prior query's
+            m = self.last_query_metrics
+            for src, dst in (("hits", "kernelCacheHits"),
+                             ("misses", "kernelCacheMisses"),
+                             ("compiles", "kernelCompiles"),
+                             ("compile_ms", "kernelCompileMs")):
+                m[dst] = round(cs1[src] - cache_stats0[src], 3)
+        if not tracing:
+            self.last_query_trace_summary = None
+            # an older traced query's events must not be joined with THIS
+            # query's plan by profile_last_query/export_chrome_trace
+            self._last_trace_events = None
+            return
+        from ..observability import report as OR
+        from ..observability import tracer as OT
+        tr = OT.get_tracer()
+        self._last_trace_events = tr.snapshot()
+        self._last_trace_meta = dict(tr.meta(), query=self._query_seq)
+        self.last_query_trace_summary = OR.trace_summary(
+            self._last_trace_events, tr.counters, tr.dropped_events)
+        if sink and sink != "memory":
+            from ..observability import export as OE
+            try:
+                OE.write_event_log(
+                    OE.event_log_path(sink, self._query_seq),
+                    self._last_trace_events, self._last_trace_meta)
+            except OSError:  # the sink must never fail the query
+                pass
+
+    def _execute_traced(self, logical: P.LogicalPlan, device_to_arrow,
+                        speculation) -> pa.Table:
         planner = Planner(self._conf)
         phys = planner.plan_for_collect(logical)
         # collect has no side effects, so speculative results may be
@@ -180,12 +251,34 @@ class TpuSession:
 
     def profile_last_query(self) -> str:
         """Per-exec wall-time/batch profile of the most recent collect
-        (requires spark.rapids.tpu.profile.enabled during execution)."""
+        (requires spark.rapids.tpu.profile.enabled during execution).
+        With the tracer on (profile.enabled implies it), the report also
+        attributes blocking sync/readback time, kernel trace+compile
+        time, and H2D/D2H bytes to each exec node."""
         phys = getattr(self, "_last_phys", None)
         if phys is None:
             return "no query executed yet"
+        events = getattr(self, "_last_trace_events", None)
+        if events:
+            from ..observability.report import attribution_table
+            meta = getattr(self, "_last_trace_meta", {})
+            return attribution_table(phys, events,
+                                     int(meta.get("dropped_events", 0)))
         from .physical.base import profile_report
         return profile_report(phys)
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the last traced query's timeline as Chrome trace-event
+        JSON (load in Perfetto / chrome://tracing).  Requires the query to
+        have run with spark.rapids.tpu.trace.sink or profile.enabled."""
+        events = getattr(self, "_last_trace_events", None)
+        if not events:
+            raise RuntimeError(
+                "no traced query: set spark.rapids.tpu.trace.sink "
+                "(or spark.rapids.tpu.profile.enabled) before collect()")
+        from ..observability.export import write_chrome_trace
+        return write_chrome_trace(path, events,
+                                  getattr(self, "_last_trace_meta", None))
 
     def explain(self, df: DataFrame, all_ops: bool = True) -> str:
         """Placement report (spark.rapids.sql.explain=ALL equivalent) plus

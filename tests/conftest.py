@@ -35,6 +35,9 @@ QUICK_MODULES = {
     "test_columnar", "test_expressions", "test_sql", "test_joins",
     "test_join_fastpath",
     "test_memory", "test_native", "test_cross_slice", "test_hive_udf",
+    # observability tracer: tier-1 per ISSUE 3 (trace regressions must
+    # surface in the quick gate, not only in full CI)
+    "test_tracer",
     # both jax ShimProviders exercised end-to-end every CI run — the
     # parallel-world guarantee (VERDICT r3 #8)
     "test_shims",

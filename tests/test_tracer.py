@@ -1,0 +1,295 @@
+"""Query-timeline tracer (observability/): ring-buffer semantics,
+thread safety, Chrome-trace/JSONL export schema, session wiring
+(profile_last_query attribution, export_chrome_trace, kernel-cache
+deltas in last_query_metrics), flag restore-on-exception, and the
+nested-TaskContext regression (PR 3 satellites)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.observability import export as OE
+from spark_rapids_tpu.observability import report as OR
+from spark_rapids_tpu.observability import tracer as OT
+from spark_rapids_tpu.sql import functions as F
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def tracing_on():
+    """Fresh tracer + flag on, restored afterwards."""
+    prev = OT.TRACING["on"]
+    OT.get_tracer().reset(256)
+    OT.TRACING["on"] = True
+    yield OT.get_tracer()
+    OT.TRACING["on"] = prev
+    OT.get_tracer().reset()
+
+
+# --------------------------------------------------------------------------
+# ring buffer + thread safety
+# --------------------------------------------------------------------------
+
+def test_disabled_span_is_null_object():
+    prev = OT.TRACING["on"]
+    OT.TRACING["on"] = False
+    try:
+        OT.get_tracer().reset()
+        with OT.span("sync", "x", bytes=1):
+            pass
+        assert OT.get_tracer().snapshot() == []
+    finally:
+        OT.TRACING["on"] = prev
+
+
+def test_ring_overflow_keeps_newest_and_counts_drops(tracing_on):
+    tr = tracing_on
+    tr.reset(capacity=16)
+    for i in range(40):
+        with OT.span("op", f"e{i}"):
+            pass
+    events = tr.snapshot()
+    assert len(events) == 16
+    # newest events kept (the last 16 emitted)
+    assert [e["name"] for e in events] == [f"e{i}" for i in range(24, 40)]
+    assert tr.dropped_events == 24
+
+
+def test_thread_safety_under_pool(tracing_on):
+    """Concurrent emitters (the shuffle writer/reader pool shape) must
+    neither crash nor lose accounting: events kept + dropped == emitted."""
+    tr = tracing_on
+    tr.reset(capacity=64)
+    n_threads, per_thread = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def emit(t):
+        barrier.wait()
+        for i in range(per_thread):
+            tr.complete("shuffle", f"t{t}-{i}", 0.0, 0.001, bytes=i)
+
+    threads = [threading.Thread(target=emit, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = tr.snapshot()
+    assert len(events) == 64
+    assert len(events) + tr.dropped_events == n_threads * per_thread
+
+
+def test_exec_stack_nests_and_attributes(tracing_on):
+    tr = tracing_on
+    assert OT.current_exec() == ""
+    OT.push_exec("Outer")
+    OT.push_exec("Inner")
+    tr.complete("sync", "readback", 0.0, 0.002)
+    OT.pop_exec()
+    tr.complete("sync", "readback", 0.0, 0.003)
+    OT.pop_exec()
+    assert OT.current_exec() == ""
+    evs = tr.snapshot()
+    assert evs[0]["exec"] == "Inner" and evs[1]["exec"] == "Outer"
+    agg = OR.aggregate_by_exec(evs)
+    assert agg["Inner"]["sync_n"] == 1 and agg["Outer"]["sync_n"] == 1
+
+
+# --------------------------------------------------------------------------
+# export schema
+# --------------------------------------------------------------------------
+
+def _check_chrome_schema(doc):
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        for field in ("ph", "ts", "pid", "tid", "name"):
+            assert field in ev, (field, ev)
+        assert ev["ph"] in ("X", "C", "i", "M", "B", "E")
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+
+
+def test_chrome_trace_schema(tracing_on, tmp_path):
+    tr = tracing_on
+    with OT.span("d2h", "fetch", bytes=128):
+        pass
+    tr.counter("readbacks", 2)
+    path = str(tmp_path / "trace.json")
+    OE.write_chrome_trace(path, tr.snapshot(), tr.meta())
+    with open(path) as fh:
+        doc = json.load(fh)
+    _check_chrome_schema(doc)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert spans[0]["name"] == "fetch" and spans[0]["cat"] == "d2h"
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert counters and counters[0]["args"]["value"] == 2
+
+
+def test_check_trace_tool(tracing_on, tmp_path):
+    """tools/check_trace.py (the CI validator) accepts a real export and
+    rejects a broken one."""
+    tr = tracing_on
+    with OT.span("sync", "s"):
+        pass
+    good = str(tmp_path / "good.json")
+    OE.write_chrome_trace(good, tr.snapshot(), tr.meta())
+    tool = os.path.join(REPO, "tools", "check_trace.py")
+    assert subprocess.run([sys.executable, tool, good]).returncode == 0
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump({"traceEvents": [{"ph": "X", "ts": 0}]}, fh)
+    assert subprocess.run([sys.executable, tool, bad]).returncode != 0
+
+
+def test_jsonl_event_log_round_trip(tracing_on, tmp_path):
+    tr = tracing_on
+    with OT.span("spill", "spill.deviceToHost", bytes=64):
+        pass
+    with OT.span("h2d", "upload", bytes=32):
+        pass
+    path = str(tmp_path / "log.jsonl")
+    meta = dict(tr.meta(), query=1)
+    OE.write_event_log(path, tr.snapshot(), meta)
+    # append-only: a second query's log stacks in the same file
+    OE.write_event_log(path, tr.snapshot(), dict(meta, query=2))
+    logs = OE.read_event_log(path)
+    assert len(logs) == 2
+    for got_meta, got_events in logs:
+        assert got_events == tr.snapshot()
+    assert logs[0][0]["query"] == 1 and logs[1][0]["query"] == 2
+
+
+# --------------------------------------------------------------------------
+# session wiring (end-to-end on the join micro-shape)
+# --------------------------------------------------------------------------
+
+def _join_query(sess, n=20000, salt=0):
+    rng = np.random.default_rng(7)
+    fact = pa.table({"fk": rng.integers(0, 500, n), "x": rng.random(n)})
+    dim = pa.table({"pk": np.arange(500, dtype=np.int64),
+                    "cat": rng.integers(0, 8, 500)})
+    f = sess.create_dataframe(fact, num_partitions=2)
+    d = sess.create_dataframe(dim)
+    return (f.join(d, f.fk == d.pk, "inner")
+            .filter(F.col("x") >= float(salt))  # salt -> fresh kernel keys
+            .groupBy("cat")
+            .agg(F.count("*").alias("n"), F.sum(F.col("x")).alias("sx"))
+            .orderBy("cat"))
+
+
+def test_traced_join_attribution_and_export(tmp_path):
+    sess = srt.session(**{"spark.rapids.tpu.profile.enabled": True})
+    _join_query(sess).collect()
+    report = sess.profile_last_query()
+    # per-exec columns for self-time, sync, compile, h2d/d2h bytes
+    for col in ("self_ms", "sync_ms", "compile_ms", "h2d", "d2h"):
+        assert col in report, report
+    assert "Join" in report
+    summary = sess.last_query_trace_summary
+    assert summary["sync_count"] >= 1          # join sizing readback
+    assert summary["h2d_bytes"] > 0            # arrow -> device upload
+    assert summary["d2h_bytes"] > 0            # result fetch
+    path = str(tmp_path / "join_trace.json")
+    assert sess.export_chrome_trace(path) == path
+    with open(path) as fh:
+        doc = json.load(fh)
+    _check_chrome_schema(doc)
+    cats = {e.get("cat") for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "op" in cats and ("sync" in cats or "d2h" in cats)
+    # a join sizing readback attributed to a join exec node
+    syncs = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e.get("cat") == "sync"]
+    assert any("Join" in e["args"].get("exec", "") for e in syncs), syncs
+
+
+def test_kernel_cache_stats_in_last_query_metrics():
+    sess = srt.session(**{"spark.rapids.tpu.trace.sink": "memory"})
+    q = _join_query(sess, salt=1)  # distinct literal -> fresh kernels
+    q.collect()
+    cold = dict(sess.last_query_metrics)
+    assert cold["kernelCacheMisses"] > 0
+    assert cold["kernelCompiles"] > 0
+    assert cold["kernelCompileMs"] > 0
+    q.collect()
+    warm = dict(sess.last_query_metrics)
+    assert warm["kernelCacheHits"] > 0
+    assert warm["kernelCompiles"] == 0
+    assert warm["kernelCompileMs"] == 0
+
+
+def test_trace_sink_writes_jsonl_per_query(tmp_path):
+    sink = str(tmp_path / "eventlog")
+    sess = srt.session(**{"spark.rapids.tpu.trace.sink": sink})
+    _join_query(sess).collect()
+    files = os.listdir(sink)
+    assert len(files) == 1 and files[0].endswith(".jsonl")
+    logs = OE.read_event_log(os.path.join(sink, files[0]))
+    assert len(logs) == 1
+    meta, events = logs[0]
+    assert events and meta["capacity"] > 0
+
+
+def test_tracing_off_by_default_and_zero_events():
+    # explicit default conf: a bare srt.session() would return the
+    # process's active session, which another test may have profiled
+    sess = srt.session(**{"spark.rapids.tpu.profile.enabled": False})
+    tr = OT.get_tracer()
+    tr.reset()
+    _join_query(sess).collect()
+    assert OT.TRACING["on"] is False
+    assert tr.snapshot() == []
+    assert sess.last_query_trace_summary is None
+
+
+# --------------------------------------------------------------------------
+# flag hygiene (satellite: session-scoped-safe process flags)
+# --------------------------------------------------------------------------
+
+def test_flags_restored_on_exception():
+    from spark_rapids_tpu.sql.physical.base import PROFILING
+    prev_prof, prev_trace = PROFILING["on"], OT.TRACING["on"]
+    sess = srt.session(**{"spark.rapids.tpu.profile.enabled": True})
+    f = F.udf(lambda a: {}[a], returnType=srt.DOUBLE)  # raises KeyError
+    df = sess.create_dataframe(pa.table({"a": [1.0, 2.0]}))
+    with pytest.raises(Exception):
+        df.select(f(df.a).alias("b")).collect()
+    assert PROFILING["on"] == prev_prof
+    assert OT.TRACING["on"] == prev_trace
+
+
+def test_profiling_does_not_leak_across_sessions():
+    from spark_rapids_tpu.sql.physical.base import PROFILING
+    sess1 = srt.session(**{"spark.rapids.tpu.profile.enabled": True})
+    _join_query(sess1).collect()
+    assert PROFILING["on"] is False  # restored after the query
+    sess2 = srt.session(**{"spark.rapids.tpu.profile.enabled": False})
+    _join_query(sess2).collect()
+    assert sess2.last_query_trace_summary is None
+
+
+# --------------------------------------------------------------------------
+# nested TaskContext restore (satellite: execute_all clobbered the outer)
+# --------------------------------------------------------------------------
+
+def test_execute_all_restores_outer_task_context():
+    from spark_rapids_tpu.sql.physical.base import TaskContext
+    sess = srt.session()
+    df = sess.create_dataframe(pa.table({"k": [1, 2, 3]}))
+    phys = sess.physical_plan(df.groupBy("k").count())
+    outer = TaskContext(99)
+    TaskContext._set_current(outer)
+    try:
+        # a nested map-side execute_all (subquery/broadcast under an
+        # outer exchange task) must restore the OUTER context, not None
+        phys.execute_all(sess._conf)
+        assert TaskContext.current() is outer
+    finally:
+        TaskContext._set_current(None)

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Validate an exported Chrome trace-event JSON file against the
+trace-event schema subset the tracer emits (observability/export.py):
+every event must carry ph/ts/pid/tid/name; "X" complete events must
+carry a non-negative dur.  Used by ci/run_ci.sh after the traced-query
+step and by tests/test_tracer.py.
+
+Usage: python tools/check_trace.py <trace.json> [--min-events N]
+Exit 0 on a valid trace, 1 otherwise.
+"""
+
+import json
+import sys
+
+REQUIRED = ("ph", "ts", "pid", "tid", "name")
+KNOWN_PH = ("X", "C", "i", "M", "B", "E")
+
+
+def check(path: str, min_events: int = 1):
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError("traceEvents is not a list")
+    spans = 0
+    cats = set()
+    for i, ev in enumerate(events):
+        for field in REQUIRED:
+            if field not in ev:
+                raise ValueError(f"event {i} missing required field "
+                                 f"{field!r}: {ev}")
+        if ev["ph"] not in KNOWN_PH:
+            raise ValueError(f"event {i} has unknown ph {ev['ph']!r}")
+        if not isinstance(ev["ts"], (int, float)):
+            raise ValueError(f"event {i} ts is not numeric: {ev['ts']!r}")
+        if ev["ph"] == "X":
+            if "dur" not in ev or not isinstance(ev["dur"], (int, float)) \
+                    or ev["dur"] < 0:
+                raise ValueError(f"event {i} 'X' span needs dur >= 0: {ev}")
+            spans += 1
+            cats.add(ev.get("cat", ""))
+    if spans < min_events:
+        raise ValueError(f"expected at least {min_events} span event(s), "
+                         f"found {spans}")
+    return spans, sorted(c for c in cats if c)
+
+
+def main(argv) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 1
+    min_events = 1
+    if "--min-events" in argv:
+        i = argv.index("--min-events")
+        min_events = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    rc = 0
+    for path in argv:
+        try:
+            spans, cats = check(path, min_events)
+            print(f"OK {path}: {spans} span events, "
+                  f"categories: {', '.join(cats) or '(none)'}")
+        except (OSError, ValueError, KeyError) as e:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
