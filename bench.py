@@ -241,6 +241,27 @@ def run_engine(data, measure_trace_overhead: bool = False) -> tuple:
             pass
         finally:
             sess.conf.set("spark.rapids.tpu.trace.sink", "")
+        # chaos chokepoint overhead on the q1 shape: registry armed but
+        # never firing (p=0) vs the untraced min above — bounds what the
+        # fault-injection hooks cost a production (chaos-off) run, where
+        # each chokepoint is one dict lookup cheaper still
+        try:
+            from spark_rapids_tpu.robustness import arm_chaos, disarm_chaos
+            arm_chaos(seed=0, sites=None, probability=0.0)
+            ctimes = []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                q.collect()
+                ctimes.append(time.perf_counter() - t0)
+            trace_info["chaos_overhead"] = round(
+                min(ctimes) / max(eng_time, 1e-9) - 1.0, 4)
+        except Exception:
+            pass
+        finally:
+            try:
+                disarm_chaos()
+            except Exception:
+                pass
     trace_info.pop("traced_seconds", None)
     return eng_time, out, trace_info
 

@@ -264,11 +264,14 @@ struct Server {
 struct Client {
   std::mutex mu;
   std::unordered_map<std::string, int> conns;
+  // conf-driven socket timeout (spark.rapids.shuffle.tcp.readTimeoutMs);
+  // SO_SNDTIMEO also bounds connect() on Linux
+  int timeout_ms = 10000;
 
   int connect_to(const std::string& host, int port) {
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
-    timeval tv{10, 0};
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
     int one = 1;
@@ -492,6 +495,13 @@ int64_t srt_shuffle_client_new() {
   int64_t h = g_next++;
   g_clients[h] = std::make_unique<Client>();
   return h;
+}
+
+// applies to connections established AFTER the call (pooled sockets
+// keep the timeout they were created with)
+void srt_shuffle_client_set_timeout_ms(int64_t h, int ms) {
+  Client* c = client_of(h);
+  if (c && ms > 0) c->timeout_ms = ms;
 }
 
 int srt_shuffle_client_fetch(int64_t h, const char* host, int port,

@@ -244,7 +244,9 @@ def bulk_device_get(tree):
     leaves are byte-packed by a compiled kernel and unpacked from the one
     fetched buffer on the host; non-device leaves pass through unchanged."""
     import jax
+    from ..robustness import faults as _faults
     from ..shims import tree_flatten
+    _faults.maybe_inject("transfer.d2h", exc=ConnectionError)
     leaves, treedef = tree_flatten(tree)
     dev_idx = [i for i, l in enumerate(leaves)
                if isinstance(l, jax.Array) and not isinstance(l, np.ndarray)]
@@ -363,8 +365,11 @@ def split_for_upload(table: pa.Table, conf=None) -> list:
 
 def arrow_to_device(table: pa.Table, capacity: Optional[int] = None
                     ) -> ColumnarBatch:
+    from ..robustness import faults as _faults
     n = table.num_rows
     cap = capacity or bucket_capacity(n)
+    _faults.maybe_inject("transfer.h2d", exc=ConnectionError,
+                         bytes=table.nbytes)
     with _trace.span("h2d", "arrow_to_device", bytes=table.nbytes, rows=n):
         cols = [arrow_to_device_column(table.column(i), cap)
                 for i in range(table.num_columns)]

@@ -392,6 +392,68 @@ SHUFFLE_CHECKSUM = register(
 SHUFFLE_MAX_BYTES_IN_FLIGHT = register(
     "spark.rapids.shuffle.maxBytesInFlight",
     "Cap on in-flight fetched shuffle bytes.", 128 << 20)
+SHUFFLE_TCP_CONNECT_TIMEOUT_MS = register(
+    "spark.rapids.shuffle.tcp.connectTimeoutMs",
+    "Connect timeout for TCP shuffle block fetches and the driver "
+    "registry client (previously hardcoded at 10s).", 10_000)
+SHUFFLE_TCP_READ_TIMEOUT_MS = register(
+    "spark.rapids.shuffle.tcp.readTimeoutMs",
+    "Socket read/write timeout for TCP shuffle block fetches; a peer "
+    "that accepts the connection but stops responding mid-frame "
+    "surfaces as ShuffleFetchFailed instead of hanging the reduce "
+    "task forever.", 30_000)
+
+# --- robustness: resilient shuffle fetch ------------------------------------
+SHUFFLE_FETCH_MAX_RETRIES = register(
+    "spark.rapids.tpu.shuffle.fetch.maxRetries",
+    "Bounded retries per shuffle block fetch before the manager falls "
+    "back to lost-block recompute (or fails the read).  Each retry "
+    "backs off exponentially from fetch.backoffMs with jitter.", 4)
+SHUFFLE_FETCH_BACKOFF_MS = register(
+    "spark.rapids.tpu.shuffle.fetch.backoffMs",
+    "Base backoff between shuffle fetch retries; attempt N sleeps "
+    "backoffMs * 2^(N-1) (+ up to 25% jitter), capped by the remaining "
+    "per-reduce deadline.", 10)
+SHUFFLE_FETCH_DEADLINE_MS = register(
+    "spark.rapids.tpu.shuffle.fetch.deadlineMs",
+    "Wall-clock deadline for assembling one reduce partition; retries "
+    "stop when it expires (the FetchFailed->stage-retry analog of "
+    "spark.network.timeout).", 30_000)
+SHUFFLE_FETCH_BLACKLIST_AFTER = register(
+    "spark.rapids.tpu.shuffle.fetch.blacklistAfter",
+    "Consecutive fetch failures from one peer before it is transiently "
+    "blacklisted (moved to last-resort ordering, not dropped — "
+    "correctness never depends on the blacklist).", 2)
+SHUFFLE_FETCH_BLACKLIST_MS = register(
+    "spark.rapids.tpu.shuffle.fetch.blacklistMs",
+    "How long a blacklisted peer stays benched; the next heartbeat "
+    "refresh after expiry reinstates it with a clean slate.", 5_000)
+
+# --- robustness: seeded chaos / fault injection -----------------------------
+CHAOS_ENABLED = register(
+    "spark.rapids.tpu.chaos.enabled",
+    "Master switch for the seeded fault-injection registry "
+    "(robustness/faults.py).  Off (default) costs one dict lookup per "
+    "instrumented chokepoint; on, each armed site draws a deterministic "
+    "seeded decision per traversal and raises a site-appropriate "
+    "injected fault.  The unified surface also drives the synthetic-OOM "
+    "sites the retry framework previously armed separately.", False)
+CHAOS_SEED = register(
+    "spark.rapids.tpu.chaos.seed",
+    "Seed for the deterministic fault schedule: site X's Nth traversal "
+    "makes the same inject/pass decision on every run with the same "
+    "seed, independent of thread interleaving across sites.", 0)
+CHAOS_SITES = register(
+    "spark.rapids.tpu.chaos.sites",
+    "Comma list of armed injection sites, each optionally 'site:prob' "
+    "to override the global probability (e.g. "
+    "'shuffle.fetch:0.3,spill.disk_read').  Empty arms EVERY site — "
+    "note sites without a built-in recovery protocol (transfer.h2d, "
+    "transfer.d2h, kernel.compile) then fail queries by design.  See "
+    "docs/robustness.md for the site catalog.", "", type_=str)
+CHAOS_PROBABILITY = register(
+    "spark.rapids.tpu.chaos.probability",
+    "Default injection probability per armed-site traversal.", 0.05)
 
 SORT_RADIX = register(
     "spark.rapids.sql.sort.radix",

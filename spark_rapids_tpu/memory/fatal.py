@@ -38,7 +38,13 @@ class FatalDeviceError(RuntimeError):
 
 def is_fatal_device_error(exc: BaseException) -> bool:
     """XlaRuntimeError that is NOT a memory condition (those go through
-    the spill/retry protocol in oom_guard)."""
+    the spill/retry protocol in oom_guard).  Chaos-injected faults are
+    never fatal: the device did not actually fail, so the fatal handler
+    must not dump diagnostics or (with fatalErrorExit) kill the process
+    over a synthetic error."""
+    from ..robustness.faults import InjectedFault
+    if isinstance(exc, InjectedFault):
+        return False
     from .oom_guard import is_device_oom
     name = type(exc).__name__
     if "XlaRuntimeError" not in name:

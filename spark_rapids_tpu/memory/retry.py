@@ -5,6 +5,12 @@ synchronous spill, and one that raises :class:`SplitAndRetryOOM` has its
 input split in half and each half retried (`:371,439`).  Synthetic OOM
 injection for tests mirrors ``spark.rapids.sql.test.injectRetryOOM``
 (`RapidsConf.scala:1371`, throw site `RmmRapidsRetryIterator.scala:562`).
+
+The seeded chaos registry (robustness/faults.py) folds these hooks into
+its unified surface: arming the ``memory.oom.retry`` / ``memory.oom.split``
+sites via ``spark.rapids.tpu.chaos.*`` injects the same RetryOOM /
+SplitAndRetryOOM faults through the same recovery protocol, so one conf
+controls every fault site in the engine.
 """
 
 from __future__ import annotations
@@ -12,6 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
 
+from ..robustness.faults import maybe_inject_oom
 from .spill import BufferCatalog, SpillableColumnarBatch
 
 A = TypeVar("A")
@@ -127,6 +134,9 @@ def with_retry(inputs: Iterable[A], fn: Callable[[A], B],
                         f"giving up after {_MAX_RETRIES} OOM retries (GpuOOM)")
                 try:
                     _injection.maybe_throw(splittable=split is not None)
+                    # unified chaos surface: seeded OOM injection rides
+                    # the exact same recovery path as the legacy hook
+                    maybe_inject_oom(splittable=split is not None)
                     result = fn(item)
                     _close(item)
                     item = None

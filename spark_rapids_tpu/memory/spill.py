@@ -31,7 +31,26 @@ import numpy as np
 from ..columnar.batch import ColumnarBatch
 from ..config import HOST_SPILL_STORAGE_SIZE, SPILL_DIR, RapidsConf
 from ..observability import tracer as _trace
+from ..robustness import faults as _faults
 from .device import DeviceManager
+
+#: bounded retries for the disk tier's reads/writes: a transiently torn
+#: spill I/O (or a chaos-injected one) re-attempts with a short backoff
+#: instead of failing the query; a persistent error still raises
+_DISK_IO_ATTEMPTS = 5
+
+
+def _retry_disk_io(fn, what: str):
+    delay = 0.001
+    for attempt in range(_DISK_IO_ATTEMPTS):
+        try:
+            return fn()
+        except OSError:
+            if attempt == _DISK_IO_ATTEMPTS - 1:
+                raise
+            import time as _time
+            _time.sleep(delay)
+            delay *= 2
 
 # spill order: lower value spills first (SpillPriorities.scala:83 semantics,
 # inverted to "priority = keep-on-device desire")
@@ -295,9 +314,16 @@ class BufferCatalog:
     def _host_to_disk(self, buf: _Buffer):
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir, f"buf-{uuid.uuid4().hex}.spill")
-        with _trace.span("spill", "spill.hostToDisk", bytes=buf.size):
+
+        def _write():
+            # the chaos site sits inside the retried closure so every
+            # attempt re-draws its own seeded decision
+            _faults.maybe_inject("spill.disk_write", exc=OSError,
+                                 bytes=buf.size)
             with open(path, "wb") as f:
                 pickle.dump(buf.leaves, f, protocol=pickle.HIGHEST_PROTOCOL)
+        with _trace.span("spill", "spill.hostToDisk", bytes=buf.size):
+            _retry_disk_io(_write, "spill.disk_write")
         buf.leaves = None
         buf.disk_path = path
         buf.tier = DISK
@@ -305,9 +331,13 @@ class BufferCatalog:
         self.disk_bytes += buf.size
 
     def _disk_to_host(self, buf: _Buffer):
-        with _trace.span("spill", "spill.diskToHost", bytes=buf.size):
+        def _read():
+            _faults.maybe_inject("spill.disk_read", exc=OSError,
+                                 bytes=buf.size)
             with open(buf.disk_path, "rb") as f:
-                buf.leaves = pickle.load(f)
+                return pickle.load(f)
+        with _trace.span("spill", "spill.diskToHost", bytes=buf.size):
+            buf.leaves = _retry_disk_io(_read, "spill.disk_read")
         os.unlink(buf.disk_path)
         buf.disk_path = None
         buf.tier = HOST

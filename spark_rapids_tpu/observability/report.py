@@ -16,7 +16,7 @@ _BLOCKING_CATS = ("sync", "d2h")
 
 _ZERO = {"sync_ms": 0.0, "sync_n": 0, "compile_ms": 0.0, "compile_n": 0,
          "h2d_bytes": 0, "d2h_bytes": 0, "spill_ms": 0.0,
-         "sem_wait_ms": 0.0, "shuffle_ms": 0.0}
+         "sem_wait_ms": 0.0, "shuffle_ms": 0.0, "fault_n": 0}
 
 
 def aggregate_by_exec(events: List[Dict[str, Any]]
@@ -49,6 +49,8 @@ def aggregate_by_exec(events: List[Dict[str, Any]]
             row["sem_wait_ms"] += ms
         elif cat == "shuffle":
             row["shuffle_ms"] += ms
+        elif cat == "fault":
+            row["fault_n"] += 1
     return out
 
 
@@ -73,6 +75,8 @@ def trace_summary(events: List[Dict[str, Any]],
         "sem_wait_ms": round(tot["sem_wait_ms"], 3),
         "events": len(events),
     }
+    if tot["fault_n"]:
+        out["fault_count"] = int(tot["fault_n"])
     if dropped:
         out["dropped_events"] = int(dropped)
     if counters:

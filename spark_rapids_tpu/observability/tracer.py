@@ -20,6 +20,8 @@ Event categories:
 ``spill``          spill-catalog tier movement
 ``shuffle``        exchange materialization + frame (de)serialization
 ``sem_wait``       device-semaphore acquisition waits
+``fault``          chaos fault injections, shuffle fetch retries, peer
+                   blacklisting, lost-block recompute (robustness/)
 =================  =========================================================
 
 Spans attribute to the *owning exec node* via a thread-local exec stack:
@@ -56,7 +58,7 @@ TRACING = {"on": False}
 #: known span categories (exported traces may add more; the checker and
 #: the report treat unknown categories as opaque)
 CATEGORIES = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
-              "shuffle", "sem_wait")
+              "shuffle", "sem_wait", "fault")
 
 #: default ring capacity (spark.rapids.tpu.trace.bufferEvents)
 DEFAULT_CAPACITY = 65536

@@ -1,0 +1,27 @@
+"""Robustness subsystem: seeded chaos fault injection (faults.py) and the
+process-wide counters the session folds into ``last_query_metrics`` —
+the degraded-conditions proof layer (docs/robustness.md)."""
+
+from .faults import (CHAOS, SITES, STATS, ChaosRegistry, InjectedFault,
+                     apply_conf, arm_chaos, disarm_chaos, fault_type,
+                     get_registry, injected_counts, maybe_inject,
+                     maybe_inject_oom, should_fire)
+
+__all__ = [
+    "CHAOS", "SITES", "STATS", "ChaosRegistry", "InjectedFault",
+    "apply_conf", "arm_chaos", "disarm_chaos", "fault_type", "get_registry",
+    "injected_counts", "maybe_inject", "maybe_inject_oom", "should_fire",
+    "stats_snapshot",
+]
+
+
+def stats_snapshot() -> dict:
+    """Monotonic robustness counters; the session snapshots this at query
+    start and folds the delta into ``last_query_metrics``."""
+    from ..shuffle.manager import FETCH_STATS
+    return {
+        "faultsInjected": STATS["faults_injected"],
+        "shuffleFetchRetries": FETCH_STATS["retries"],
+        "shuffleBlocksRecomputed": FETCH_STATS["recomputed"],
+        "peersBlacklisted": FETCH_STATS["blacklisted"],
+    }

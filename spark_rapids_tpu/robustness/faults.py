@@ -1,0 +1,276 @@
+"""Deterministic, seeded fault-injection registry — the chaos-testing
+backbone the reference grows out of ``spark.rapids.sql.test.injectRetryOOM``
+(``RapidsConf.scala:1371``), generalized to every data-movement chokepoint
+the tracer already instruments.
+
+Named sites wrap the engine's failure-prone edges:
+
+====================  =====================================================
+``shuffle.fetch``     a shuffle block read (file open/read, transport
+                      fetch) fails transiently
+``shuffle.connect``   the TCP transport cannot establish a peer connection
+``shuffle.block.lost`` a committed shuffle block is PERMANENTLY destroyed
+                      (the backing file is unlinked) — exercises lost-block
+                      recompute, not just retry
+``peer.death``        a peer dies mid-stream: every fetch against it fails
+``spill.disk_write``  the spill disk tier's write tears
+``spill.disk_read``   the spill disk tier's read tears
+``transfer.h2d``      a host->device upload fails
+``transfer.d2h``      a device->host fetch fails
+``kernel.compile``    kernel dispatch/compile fails
+``memory.oom.retry``  a retryable device OOM (RetryOOM) — the site the old
+                      ``memory/retry.py`` injection hooks armed
+``memory.oom.split``  a split-requiring device OOM (SplitAndRetryOOM)
+====================  =====================================================
+
+Determinism contract: with ``seed`` fixed, the inject/pass decision for
+the Nth traversal of site S is a pure function of ``(seed, S, N)`` — the
+schedule is reproducible run-to-run and independent of how threads
+interleave traversals of *different* sites.  (Within one site, the
+thread-pool arrival order decides which caller receives ordinal N; the
+*set* of injected ordinals is still fixed.)
+
+Overhead contract: with chaos off (the default), every chokepoint costs
+exactly one module-dict lookup (``CHAOS["on"]``) — the same pattern as
+the tracer's ``TRACING`` flag and ``PROFILING`` in physical/base.py.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Optional, Type
+
+from ..observability import tracer as _trace
+
+#: master switch — the only thing a disabled chokepoint ever reads
+CHAOS = {"on": False}
+
+#: the injection-site catalog (docs/robustness.md documents each)
+SITES = (
+    "shuffle.fetch", "shuffle.connect", "shuffle.block.lost", "peer.death",
+    "spill.disk_write", "spill.disk_read", "transfer.h2d", "transfer.d2h",
+    "kernel.compile", "memory.oom.retry", "memory.oom.split",
+)
+
+#: process-wide observability (sessions fold per-query deltas into
+#: ``last_query_metrics`` as ``faultsInjected``)
+STATS = {"faults_injected": 0}
+
+#: monotonic per-site injection totals — unlike a registry's ``injected``
+#: (which dies with the registry at query end), these survive disarm so
+#: soak rigs can attribute coverage per site across queries
+SITE_STATS: Dict[str, int] = {}
+
+
+class InjectedFault(Exception):
+    """Marker mixin: every chaos-injected exception is an instance, so
+    recovery code (and the fatal-error classifier) can tell a synthetic
+    fault from a real one.  Concrete raised types are dynamic subclasses
+    of (site-appropriate exception, InjectedFault) — an injected
+    ``OSError`` is caught by ``except OSError`` like the real thing."""
+
+
+_FAULT_TYPES: Dict[type, type] = {}
+_FAULT_TYPES_LOCK = threading.Lock()
+
+
+def fault_type(exc_type: Type[BaseException]) -> type:
+    """The cached dynamic ``(exc_type, InjectedFault)`` subclass."""
+    t = _FAULT_TYPES.get(exc_type)
+    if t is None:
+        with _FAULT_TYPES_LOCK:
+            t = _FAULT_TYPES.get(exc_type)
+            if t is None:
+                t = type("Injected" + exc_type.__name__,
+                         (exc_type, InjectedFault), {})
+                _FAULT_TYPES[exc_type] = t
+    return t
+
+
+def _decision(seed: int, site: str, ordinal: int) -> float:
+    """Pure deterministic draw in [0, 1) for (seed, site, ordinal).
+    ``random.Random`` seeded with a string hashes it through sha512 —
+    stable across runs, platforms and PYTHONHASHSEED."""
+    return random.Random(f"{seed}\x1f{site}\x1f{ordinal}").random()
+
+
+class ChaosRegistry:
+    """Armed-site table + per-site traversal counters.  Thread-safe: the
+    ordinal increment is the only shared mutation and sits under a lock."""
+
+    def __init__(self, seed: int = 0, sites=None, probability: float = 0.05):
+        self.seed = int(seed)
+        self.probability = float(probability)
+        #: None = every catalog site armed at the global probability;
+        #: else {site: probability}
+        self._sites: Optional[Dict[str, float]] = None
+        if sites:
+            if isinstance(sites, str):
+                sites = [s for s in sites.split(",") if s.strip()]
+            armed: Dict[str, float] = {}
+            for s in sites:
+                s = s.strip()
+                if ":" in s:
+                    name, _, p = s.rpartition(":")
+                    armed[name.strip()] = float(p)
+                else:
+                    armed[s] = self.probability
+            self._sites = armed
+        self.hits: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def site_probability(self, site: str) -> float:
+        if self._sites is None:
+            return self.probability
+        return self._sites.get(site, 0.0)
+
+    def armed_sites(self):
+        return tuple(self._sites) if self._sites is not None else SITES
+
+    def decide(self, site: str) -> bool:
+        """Consume this site's next ordinal and return the (deterministic)
+        inject decision.  Unarmed sites do not consume ordinals, so
+        arming site A never shifts site B's schedule."""
+        p = self.site_probability(site)
+        if p <= 0.0:
+            return False
+        with self._lock:
+            n = self.hits.get(site, 0)
+            self.hits[site] = n + 1
+        if _decision(self.seed, site, n) >= p:
+            return False
+        with self._lock:
+            self.injected[site] = self.injected.get(site, 0) + 1
+        return True
+
+
+_REGISTRY: Optional[ChaosRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+#: True when the current arming came from a session conf (apply_conf);
+#: a session whose conf has chaos DISABLED only disarms what a conf
+#: armed — manual arm_chaos() calls (tests) are never clobbered.
+_ARMED_BY_CONF = [False]
+
+
+def get_registry() -> Optional[ChaosRegistry]:
+    return _REGISTRY
+
+
+def arm_chaos(seed: int = 0, sites=None,
+              probability: float = 0.05) -> ChaosRegistry:
+    """Install a fresh registry and flip the master switch on."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        _REGISTRY = ChaosRegistry(seed, sites, probability)
+        CHAOS["on"] = True
+        return _REGISTRY
+
+
+def disarm_chaos() -> None:
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        CHAOS["on"] = False
+        _REGISTRY = None
+        _ARMED_BY_CONF[0] = False
+
+
+def snapshot_arming():
+    """Opaque arming state for save/restore around a query — the same
+    finally-guarded discipline the session applies to the tracing flags,
+    so a session whose conf arms chaos never leaks an armed registry
+    into a later query or another session's."""
+    with _REGISTRY_LOCK:
+        return (CHAOS["on"], _REGISTRY, _ARMED_BY_CONF[0])
+
+
+def restore_arming(state) -> None:
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        CHAOS["on"], _REGISTRY, _ARMED_BY_CONF[0] = state
+
+
+def apply_conf(conf) -> None:
+    """Arm/disarm from ``spark.rapids.tpu.chaos.*`` — called by the
+    session at query start (the same per-query flip the tracing flags
+    get).  Disabling only undoes a conf-driven arming."""
+    from ..config import (CHAOS_ENABLED, CHAOS_PROBABILITY, CHAOS_SEED,
+                          CHAOS_SITES)
+    if bool(conf.get(CHAOS_ENABLED)):
+        arm_chaos(int(conf.get(CHAOS_SEED)),
+                  str(conf.get(CHAOS_SITES) or ""),
+                  float(conf.get(CHAOS_PROBABILITY)))
+        _ARMED_BY_CONF[0] = True
+    elif _ARMED_BY_CONF[0]:
+        disarm_chaos()
+
+
+def injected_counts() -> Dict[str, int]:
+    """Per-site injection counts of the live registry ({} when off)."""
+    reg = _REGISTRY
+    if reg is None:
+        return {}
+    with reg._lock:
+        return dict(reg.injected)
+
+
+def _record(site: str, ctx: dict) -> None:
+    STATS["faults_injected"] += 1
+    SITE_STATS[site] = SITE_STATS.get(site, 0) + 1
+    if _trace.TRACING["on"]:
+        t0 = time.perf_counter()
+        _trace.get_tracer().complete("fault", f"fault.{site}", t0, 0.0,
+                                     **ctx)
+        _trace.get_tracer().counter("faultsInjected")
+
+
+def should_fire(site: str, **ctx) -> bool:
+    """Non-raising chokepoint: returns True when the schedule injects
+    here, leaving the failure semantics to the caller (e.g. the shuffle
+    manager destroys the block for ``shuffle.block.lost``)."""
+    if not CHAOS["on"]:
+        return False
+    reg = _REGISTRY
+    if reg is None or not reg.decide(site):
+        return False
+    _record(site, ctx)
+    return True
+
+
+def maybe_inject(site: str, exc: Type[BaseException] = RuntimeError,
+                 **ctx) -> None:
+    """Raising chokepoint: when the seeded schedule injects at ``site``,
+    raise a dynamic subclass of ``(exc, InjectedFault)``."""
+    if not CHAOS["on"]:
+        return
+    reg = _REGISTRY
+    if reg is None or not reg.decide(site):
+        return
+    _record(site, ctx)
+    detail = ", ".join(f"{k}={v}" for k, v in ctx.items())
+    raise fault_type(exc)(
+        f"chaos-injected fault at {site}" + (f" ({detail})" if detail else ""))
+
+
+def maybe_inject_oom(splittable: bool = True) -> None:
+    """The unified OOM sites: one conf surface drives what
+    ``memory/retry.py``'s count-based hooks armed separately.  Injected
+    OOMs ride the normal spill-and-retry protocol; a SplitAndRetryOOM
+    carries ``injected=True`` so unsplittable sites degrade to
+    spill+retry exactly like the legacy hook's faults."""
+    if not CHAOS["on"]:
+        return
+    reg = _REGISTRY
+    if reg is None:
+        return
+    from ..memory.retry import RetryOOM, SplitAndRetryOOM
+    if reg.decide("memory.oom.retry"):
+        _record("memory.oom.retry", {})
+        raise fault_type(RetryOOM)("chaos-injected RetryOOM")
+    if splittable and reg.decide("memory.oom.split"):
+        _record("memory.oom.split", {})
+        e = fault_type(SplitAndRetryOOM)("chaos-injected SplitAndRetryOOM")
+        e.injected = True
+        raise e

@@ -1,9 +1,11 @@
 from .serializer import (deserialize_batch, serialize_batch,
-                         concat_serialized)
-from .manager import ShuffleManager, get_shuffle_manager
-from .transport import (LocalTransport, ShuffleHeartbeatManager,
+                         concat_serialized, FrameCorrupt)
+from .manager import FETCH_STATS, ShuffleManager, get_shuffle_manager
+from .transport import (LocalTransport, PeerBlacklist,
+                        ShuffleFetchFailed, ShuffleHeartbeatManager,
                         ShuffleTransport)
 
 __all__ = ["serialize_batch", "deserialize_batch", "concat_serialized",
-           "ShuffleManager", "get_shuffle_manager", "ShuffleTransport",
-           "LocalTransport", "ShuffleHeartbeatManager"]
+           "FrameCorrupt", "ShuffleManager", "get_shuffle_manager",
+           "ShuffleTransport", "LocalTransport", "PeerBlacklist",
+           "ShuffleFetchFailed", "ShuffleHeartbeatManager", "FETCH_STATS"]

@@ -17,19 +17,29 @@
 from __future__ import annotations
 
 import os
+import random
+import struct as _struct
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..columnar.batch import ColumnarBatch
-from ..config import (RapidsConf, SHUFFLE_EXECUTOR_ID, SHUFFLE_MODE,
+from ..config import (RapidsConf, SHUFFLE_EXECUTOR_ID,
+                      SHUFFLE_FETCH_BACKOFF_MS,
+                      SHUFFLE_FETCH_BLACKLIST_AFTER,
+                      SHUFFLE_FETCH_BLACKLIST_MS, SHUFFLE_FETCH_DEADLINE_MS,
+                      SHUFFLE_FETCH_MAX_RETRIES, SHUFFLE_MODE,
                       SHUFFLE_READER_THREADS, SHUFFLE_TCP_DRIVER_ENDPOINT,
                       SHUFFLE_TRANSPORT_CLASS, SHUFFLE_WRITER_THREADS,
                       SPILL_DIR)
-from .serializer import concat_serialized, serialize_batch
-from .transport import (BlockId, LocalTransport, PeerInfo,
-                        ShuffleHeartbeatManager, ShuffleTransport)
+from ..observability import tracer as _trace
+from ..robustness import faults as _faults
+from .serializer import FrameCorrupt, concat_serialized, serialize_batch
+from .transport import (BlockId, LocalTransport, PeerBlacklist, PeerInfo,
+                        ShuffleFetchFailed, ShuffleHeartbeatManager,
+                        ShuffleTransport)
 
 
 def _transport_from_conf(conf: RapidsConf, executor_id: str):
@@ -37,9 +47,14 @@ def _transport_from_conf(conf: RapidsConf, executor_id: str):
     or the TCP block server + driver registry client (shuffle/tcp.py)."""
     kind = str(conf.get(SHUFFLE_TRANSPORT_CLASS)).upper()
     if kind == "TCP":
-        from ..config import SHUFFLE_TCP_BIND_HOST, SHUFFLE_TCP_NATIVE
+        from ..config import (SHUFFLE_TCP_BIND_HOST,
+                              SHUFFLE_TCP_CONNECT_TIMEOUT_MS,
+                              SHUFFLE_TCP_NATIVE,
+                              SHUFFLE_TCP_READ_TIMEOUT_MS)
         from .tcp import TcpHeartbeatClient, TcpShuffleTransport
         host = str(conf.get(SHUFFLE_TCP_BIND_HOST))
+        connect_s = int(conf.get(SHUFFLE_TCP_CONNECT_TIMEOUT_MS)) / 1e3
+        read_s = int(conf.get(SHUFFLE_TCP_READ_TIMEOUT_MS)) / 1e3
         transport = None
         if conf.get_bool(SHUFFLE_TCP_NATIVE.key, True):
             # C++ data plane (epoll block server + pooled client); wire-
@@ -48,16 +63,37 @@ def _transport_from_conf(conf: RapidsConf, executor_id: str):
             if native_tcp.available():
                 try:
                     transport = native_tcp.NativeTcpShuffleTransport(
-                        executor_id, host=host)
+                        executor_id, host=host, read_timeout_s=read_s)
                 except RuntimeError:
                     transport = None
         if transport is None:
-            transport = TcpShuffleTransport(executor_id, host=host)
+            transport = TcpShuffleTransport(
+                executor_id, host=host, connect_timeout_s=connect_s,
+                read_timeout_s=read_s)
         driver = str(conf.get(SHUFFLE_TCP_DRIVER_ENDPOINT))
-        heartbeats = (TcpHeartbeatClient(driver) if driver
+        heartbeats = (TcpHeartbeatClient(driver, connect_timeout_s=connect_s,
+                                         read_timeout_s=read_s) if driver
                       else ShuffleHeartbeatManager())
         return transport, heartbeats
     return LocalTransport(), ShuffleHeartbeatManager()
+
+
+#: process-wide resilient-fetch accounting; the session folds per-query
+#: deltas into ``last_query_metrics`` (robustness.stats_snapshot)
+FETCH_STATS = {"retries": 0, "recomputed": 0, "blacklisted": 0}
+
+
+class FetchPolicy:
+    """Retry/backoff/deadline knobs for one reduce read, resolved from
+    the conf at read time so a session tweak is honored without
+    rebuilding the manager."""
+
+    __slots__ = ("max_retries", "backoff_s", "deadline_s")
+
+    def __init__(self, conf: RapidsConf):
+        self.max_retries = int(conf.get(SHUFFLE_FETCH_MAX_RETRIES))
+        self.backoff_s = int(conf.get(SHUFFLE_FETCH_BACKOFF_MS)) / 1e3
+        self.deadline_s = int(conf.get(SHUFFLE_FETCH_DEADLINE_MS)) / 1e3
 
 
 #: two-tier plane accounting: blocks served from this slice's own store
@@ -105,6 +141,23 @@ class ShuffleManager:
         self._pending_cleanup: Dict[int, float] = {}
         self._expired_shuffles: set = set()
         self.cleanup_ttl_s = 3600.0
+        #: blocks this manager COMMITTED (file/transport tier): a read
+        #: that finds one of these gone is a LOST block (recompute/fail),
+        #: not an authoritatively-empty partition
+        self._committed: set = set()
+        #: chaos bookkeeping: the shuffle.block.lost site destroys a
+        #: given block at most ONCE (a disk ate the file; the recomputed
+        #: replacement is not re-destroyed, matching the one-shot loss
+        #: the FetchFailed->stage-retry contract recovers from)
+        self._chaos_lost: set = set()
+        #: shuffle_id -> map-task recompute callback (wired by the
+        #: exchange exec from its lineage); invoked when every replica
+        #: of a block is exhausted, to regenerate + republish the map
+        #: output instead of failing the query
+        self._recompute: Dict[int, Callable[[int], None]] = {}
+        self._blacklist = PeerBlacklist(
+            int(self.conf.get(SHUFFLE_FETCH_BLACKLIST_AFTER)),
+            int(self.conf.get(SHUFFLE_FETCH_BLACKLIST_MS)) / 1e3)
         #: device-resident local tier: blocks stay in the spill catalog as
         #: SpillableColumnarBatch (reference RapidsCachingWriter storing
         #: into ShuffleBufferCatalog) — no D2H serialization when producer
@@ -153,6 +206,8 @@ class ShuffleManager:
     def _store_blob(self, block: BlockId, blob: bytes) -> None:
         if self.mode == "ICI":
             self.transport.publish(self.executor_id, block, blob)
+            with self._lock:
+                self._committed.add(block)
             return
         os.makedirs(self._dir, exist_ok=True)
         path = os.path.join(
@@ -162,6 +217,7 @@ class ShuffleManager:
             fh.write(blob)
         with self._lock:
             self._files[block] = path
+            self._committed.add(block)
 
     # --- read side ------------------------------------------------------
     def read_reduce_partition(self, shuffle_id: int, num_maps: int,
@@ -185,48 +241,19 @@ class ShuffleManager:
             # writers), so the blob path below still runs for these blocks
 
         peers_cache: List[Optional[List[PeerInfo]]] = [None]
+        policy = FetchPolicy(self.conf)
+        # one wall-clock deadline for the whole reduce read, shared by
+        # every block's retry loop
+        deadline = time.monotonic() + policy.deadline_s
 
-        def read_one(block: BlockId) -> Optional[bytes]:
-            if self.mode == "ICI":
-                me = PeerInfo(self.executor_id, "local")
-                frame = self.transport.fetch(me, block)
-                if frame is not None:
-                    TIER_STATS["local_blocks"] += 1
-                if frame is None:
-                    # one heartbeat per reduce read, not per block (the
-                    # driver registry round-trip is not free over TCP)
-                    if peers_cache[0] is None:
-                        peers_cache[0] = self.heartbeats.heartbeat(
-                            self.executor_id)
-                    # a network failure must not masquerade as an empty
-                    # partition: only "every reachable peer says missing"
-                    # may return None (FetchFailed contract, tcp.py)
-                    last_err: Optional[Exception] = None
-                    for peer in peers_cache[0]:
-                        try:
-                            frame = self.transport.fetch(peer, block)
-                        except ConnectionError as e:
-                            last_err = e
-                            continue
-                        if frame is not None:
-                            TIER_STATS["dcn_fetches"] += 1
-                            break
-                    if frame is None and last_err is not None:
-                        raise last_err
-                return frame
-            with self._lock:
-                path = self._files.get(block)
-            if path is None:
-                return None
-            with open(path, "rb") as fh:
-                return fh.read()
+        def read_one(block: BlockId) -> Optional[List[bytes]]:
+            return self._fetch_block(block, peers_cache, policy, deadline)
 
         if self.mode == "MULTITHREADED" and len(blocks) > 1:
-            blobs = list(self._reader_pool.map(read_one, blocks))
+            frame_lists = list(self._reader_pool.map(read_one, blocks))
         else:
-            blobs = [read_one(b) for b in blocks]
-        frames = [f for blob in blobs if blob is not None
-                  for f in split_frames(blob)]
+            frame_lists = [read_one(b) for b in blocks]
+        frames = [f for fl in frame_lists if fl is not None for f in fl]
         if not frames and not resident_batches:
             return None
         pieces = list(resident_batches)
@@ -239,6 +266,163 @@ class ShuffleManager:
         if len(pieces) == 1:
             return pieces[0]
         return ColumnarBatch.concat(pieces)
+
+    # --- resilient fetch protocol ---------------------------------------
+    def _fetch_block(self, block: BlockId, peers_cache, policy: FetchPolicy,
+                     deadline: float) -> Optional[List[bytes]]:
+        """Fetch one block's frame list with bounded retries, exponential
+        backoff + jitter under the shared reduce deadline, and — when
+        every replica is exhausted — lost-block recompute via the
+        registered lineage callback.  Returns None only when the block is
+        authoritatively missing (empty partitions are never published);
+        every network-level failure surfaces as ShuffleFetchFailed."""
+        attempt = 0
+        recomputed = False
+        last_err: Optional[BaseException] = None
+        while True:
+            try:
+                return self._fetch_once(block, peers_cache)
+            except (ConnectionError, OSError, FrameCorrupt) as e:
+                last_err = e
+            now = time.monotonic()
+            attempt += 1
+            # a committed block whose file is GONE cannot heal by
+            # retrying — skip straight to recompute
+            lost = isinstance(last_err, FileNotFoundError)
+            if lost or attempt > policy.max_retries or now >= deadline:
+                if not recomputed and self._recompute_block(block):
+                    recomputed = True
+                    attempt = 0       # fresh retry budget post-republish
+                    continue
+                raise ShuffleFetchFailed(
+                    f"block {block} unrecoverable after {attempt} "
+                    f"attempt(s)"
+                    + (" + lineage recompute" if recomputed else "")
+                    + f": {type(last_err).__name__}: {last_err}"
+                ) from last_err
+            FETCH_STATS["retries"] += 1
+            if _trace.TRACING["on"]:
+                _trace.get_tracer().counter("shuffleFetchRetries")
+            delay = policy.backoff_s * (2 ** (attempt - 1))
+            delay *= 1.0 + 0.25 * random.random()       # decorrelate peers
+            delay = min(delay, max(0.0, deadline - now))
+            if _trace.TRACING["on"]:
+                t0 = time.perf_counter()
+                _trace.get_tracer().complete(
+                    "fault", "shuffle.fetch.retry", t0, delay,
+                    block=str(block), attempt=attempt,
+                    error=type(last_err).__name__)
+            if delay > 0:
+                time.sleep(delay)
+            # refresh the peer view next attempt: a restarted peer
+            # re-registers, and expired blacklist benches reinstate
+            peers_cache[0] = None
+
+    def _fetch_once(self, block: BlockId,
+                    peers_cache) -> Optional[List[bytes]]:
+        """One fetch attempt; parses the blob's frame stream so a torn
+        blob fails INSIDE the retry loop, not at decode time."""
+        if self.mode != "ICI":
+            with self._lock:
+                path = self._files.get(block)
+                committed = block in self._committed
+            if path is None:
+                if committed:
+                    raise FileNotFoundError(
+                        f"committed block {block} has no backing file")
+                return None                 # authoritatively empty
+            _faults.maybe_inject("shuffle.fetch", exc=OSError,
+                                 block=str(block))
+            if block not in self._chaos_lost and _faults.should_fire(
+                    "shuffle.block.lost", block=str(block)):
+                # chaos destroys the committed block permanently: the
+                # open() below fails and only recompute can bring it back
+                with self._lock:
+                    self._chaos_lost.add(block)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            with open(path, "rb") as fh:
+                return split_frames(fh.read())
+
+        me = PeerInfo(self.executor_id, "local")
+        frame = self.transport.fetch(me, block)
+        if frame is not None:
+            TIER_STATS["local_blocks"] += 1
+            return split_frames(frame)
+        # one heartbeat per reduce read, not per block (the driver
+        # registry round-trip is not free over TCP); refreshes also
+        # reinstate expired blacklist benches
+        if peers_cache[0] is None:
+            peers_cache[0] = self.heartbeats.heartbeat(self.executor_id)
+            self._blacklist.reinstate_expired()
+        # a network failure must not masquerade as an empty partition:
+        # only "every reachable peer says missing" may return None
+        # (FetchFailed contract); blacklisted peers are tried LAST
+        errors: List[BaseException] = []
+        for peer in self._blacklist.order(peers_cache[0]):
+            try:
+                _faults.maybe_inject("peer.death", exc=ShuffleFetchFailed,
+                                     peer=peer.executor_id)
+                frame = self.transport.fetch(peer, block)
+            except (ConnectionError, OSError) as e:
+                errors.append(e)
+                if self._blacklist.record_failure(peer.executor_id):
+                    FETCH_STATS["blacklisted"] += 1
+                    if _trace.TRACING["on"]:
+                        t0 = time.perf_counter()
+                        _trace.get_tracer().complete(
+                            "fault", "peer.blacklisted", t0, 0.0,
+                            peer=peer.executor_id)
+                continue
+            self._blacklist.record_success(peer.executor_id)
+            if frame is not None:
+                TIER_STATS["dcn_fetches"] += 1
+                return split_frames(frame)
+        if errors:
+            raise ShuffleFetchFailed(
+                f"block {block}: {len(errors)} peer fetch failure(s), "
+                f"last: {type(errors[-1]).__name__}: {errors[-1]}"
+            ) from errors[-1]
+        return None
+
+    # --- lost-block recompute -------------------------------------------
+    def register_recompute(self, shuffle_id: int,
+                           fn: Callable[[int], None]) -> None:
+        """Register the map-task recompute callback for a shuffle: called
+        with a map_id, it must regenerate that map task's output and
+        republish it through write_map_output (overwrite semantics).
+        Wired by the exchange exec from its lineage; dropped at
+        cleanup()."""
+        with self._lock:
+            self._recompute[shuffle_id] = fn
+
+    def unregister_recompute(self, shuffle_id: int) -> None:
+        """Drop the lineage callback (and whatever map outputs its
+        closure pins) once the registering exec finished its reads;
+        cleanup() also drops it."""
+        with self._lock:
+            self._recompute.pop(shuffle_id, None)
+
+    def _recompute_block(self, block: BlockId) -> bool:
+        """Regenerate the map output that produced ``block`` — the
+        FetchFailed -> stage-retry contract at batch granularity.
+        Returns False when no lineage callback is registered (the read
+        then fails with ShuffleFetchFailed)."""
+        with self._lock:
+            fn = self._recompute.get(block.shuffle_id)
+        if fn is None:
+            return False
+        t0 = time.perf_counter()
+        fn(block.map_id)
+        FETCH_STATS["recomputed"] += 1
+        if _trace.TRACING["on"]:
+            _trace.get_tracer().complete(
+                "fault", "shuffle.recompute", t0,
+                time.perf_counter() - t0, block=str(block))
+            _trace.get_tracer().counter("shuffleBlocksRecomputed")
+        return True
 
     # ------------------------------------------------------------------
     def defer_cleanup(self, shuffle_id: int) -> None:
@@ -277,6 +461,13 @@ class ShuffleManager:
                     os.unlink(self._files.pop(b))
                 except OSError:
                     pass
+            self._committed = {b for b in self._committed
+                               if shuffle_id is not None
+                               and b.shuffle_id != shuffle_id}
+            if shuffle_id is None:
+                self._recompute.clear()
+            else:
+                self._recompute.pop(shuffle_id, None)
             res_victims = [b for b in self._resident
                            if shuffle_id is None
                            or b.shuffle_id == shuffle_id]
@@ -298,9 +489,6 @@ class ShuffleManager:
         self.transport.close()
 
 
-import struct as _struct
-
-
 def pack_frames(frames: List[bytes]) -> bytes:
     """Length-prefixed frame stream: one blob may carry several serialized
     batches (one per map-side input batch — the streaming writer's unit)."""
@@ -312,11 +500,23 @@ def pack_frames(frames: List[bytes]) -> bytes:
 
 
 def split_frames(blob: bytes) -> List[bytes]:
+    """Parse a length-prefixed frame stream; a torn/truncated blob raises
+    :class:`FrameCorrupt` (a retryable fetch failure) instead of silently
+    yielding short frames that would decode as garbage or lost rows."""
     frames = []
     pos = 0
-    while pos < len(blob):
+    total = len(blob)
+    while pos < total:
+        if pos + 8 > total:
+            raise FrameCorrupt(
+                f"torn frame stream: length prefix truncated at byte "
+                f"{pos}/{total}")
         (n,) = _struct.unpack_from("<Q", blob, pos)
         pos += 8
+        if pos + n > total:
+            raise FrameCorrupt(
+                f"torn frame stream: frame of {n} bytes overruns blob "
+                f"({total - pos} bytes left)")
         frames.append(blob[pos:pos + n])
         pos += n
     return frames

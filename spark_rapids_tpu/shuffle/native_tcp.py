@@ -19,7 +19,8 @@ import ctypes
 import threading
 from typing import Dict, List, Optional
 
-from .tcp import ShuffleFetchFailed
+from ..robustness import faults as _faults
+from .tcp import ShuffleFetchFailed, _conf_timeouts
 from .transport import BlockId, PeerInfo, ShuffleTransport
 
 _lock = threading.Lock()
@@ -68,6 +69,9 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.srt_shuffle_client_fetch.argtypes = [
             i64, ctypes.c_char_p, ctypes.c_int, i64, i64, i64, u8pp, u64p]
         lib.srt_shuffle_client_close.argtypes = [i64]
+        if hasattr(lib, "srt_shuffle_client_set_timeout_ms"):
+            lib.srt_shuffle_client_set_timeout_ms.argtypes = [
+                i64, ctypes.c_int]
         lib.srt_transport_buf_free.argtypes = [
             ctypes.POINTER(ctypes.c_uint8)]
         _lib = lib
@@ -94,7 +98,7 @@ class NativeTcpShuffleTransport(ShuffleTransport):
     """
 
     def __init__(self, executor_id: str = "exec-0", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, read_timeout_s: Optional[float] = None):
         lib = _load()
         if lib is None:
             raise RuntimeError("native transport library unavailable")
@@ -107,6 +111,12 @@ class NativeTcpShuffleTransport(ShuffleTransport):
                                f"{host}:{port}")
         self._port = lib.srt_shuffle_server_port(self._server)
         self._client = lib.srt_shuffle_client_new()
+        # conf-driven socket timeout (guarded: a stale prebuilt .so from
+        # before the setter existed keeps its baked-in 10s default)
+        _, read_s = _conf_timeouts(None, read_timeout_s)
+        if hasattr(lib, "srt_shuffle_client_set_timeout_ms"):
+            lib.srt_shuffle_client_set_timeout_ms(
+                self._client, int(read_s * 1000))
         self._closed = False
 
     @property
@@ -121,6 +131,8 @@ class NativeTcpShuffleTransport(ShuffleTransport):
 
     def fetch(self, peer: PeerInfo, block: BlockId) -> Optional[bytes]:
         lib = self._lib
+        _faults.maybe_inject("shuffle.fetch", exc=ShuffleFetchFailed,
+                             peer=peer.executor_id, block=str(block))
         ptr = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_uint64()
         if peer.executor_id == self.executor_id or peer.endpoint in (

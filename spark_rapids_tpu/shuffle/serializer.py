@@ -36,6 +36,13 @@ _FLAG_ZSTD = 1
 _FLAG_CRC = 2   # trailing xxhash64 of the (possibly compressed) payload
 
 
+class FrameCorrupt(ValueError):
+    """A shuffle frame failed structural validation (bad magic, torn
+    length prefix, checksum mismatch).  Subclasses ValueError for
+    back-compat; the shuffle manager treats it as a retryable fetch
+    failure (re-fetch / lost-block recompute), never as data."""
+
+
 def _codec(conf) -> str:
     from ..config import SHUFFLE_COMPRESSION_CODEC, RapidsConf
     conf = conf or RapidsConf.get_global()
@@ -135,9 +142,16 @@ def _serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
     flags = 0
     raw = sj + payload
     if _codec(conf) == "zstd":
-        import zstandard
-        raw = zstandard.ZstdCompressor(level=1).compress(raw)
-        flags |= _FLAG_ZSTD
+        try:
+            import zstandard
+        except ImportError:
+            # codec library missing: degrade to uncompressed frames (the
+            # flag bit tells readers) instead of failing every shuffle
+            # write — readers only need zstd for frames that USED it
+            zstandard = None
+        if zstandard is not None:
+            raw = zstandard.ZstdCompressor(level=1).compress(raw)
+            flags |= _FLAG_ZSTD
     # xxhash64 frame checksum — corruption on the wire/disk fails loudly
     # instead of deserializing garbage.  "auto" only engages the native
     # library (the pure-Python fallback would dominate the hot path).
@@ -260,9 +274,11 @@ def deserialize_batch(frame: bytes, capacity: Optional[int] = None
 
 def _deserialize_batch(frame: bytes, capacity: Optional[int] = None
                        ) -> ColumnarBatch:
+    if len(frame) < 20:
+        raise FrameCorrupt(f"shuffle frame truncated ({len(frame)} bytes)")
     head = struct.unpack_from("<4sHHII", frame, 0)
     if head[0] != _MAGIC:
-        raise ValueError("bad shuffle frame magic")
+        raise FrameCorrupt("bad shuffle frame magic")
     flags, n, ncols = head[2], head[3], head[4]
     (sj_len,) = struct.unpack_from("<I", frame, 16)
     raw = frame[20:]
@@ -272,7 +288,7 @@ def _deserialize_batch(frame: bytes, capacity: Optional[int] = None
         (want,) = struct.unpack("<Q", tail)
         got = xxhash64_bytes(raw, seed=len(raw))
         if got != want:
-            raise ValueError(
+            raise FrameCorrupt(
                 f"shuffle frame checksum mismatch "
                 f"(got {got:#x}, want {want:#x}) — corrupt frame")
     if flags & _FLAG_ZSTD:

@@ -28,7 +28,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .transport import BlockId, PeerInfo, ShuffleTransport
+from ..robustness import faults as _faults
+from .transport import (BlockId, PeerInfo, ShuffleFetchFailed,
+                        ShuffleTransport)
 
 _MAGIC = 0x53525054  # "SRPT"
 _OP_FETCH = 1
@@ -43,10 +45,17 @@ _JSON_RESP = struct.Struct(">I")
 _FOUND, _MISSING = 0, 1
 
 
-class ShuffleFetchFailed(ConnectionError):
-    """Network-level fetch failure (the reference's FetchFailed analog) —
-    distinct from a peer authoritatively reporting the block missing
-    (which is legitimate: empty reduce partitions are never published)."""
+def _conf_timeouts(connect_timeout_s=None, read_timeout_s=None):
+    """Resolve the (connect, read) socket timeouts: explicit args win,
+    else the registered confs (previously hardcoded at 10s)."""
+    from ..config import (SHUFFLE_TCP_CONNECT_TIMEOUT_MS,
+                          SHUFFLE_TCP_READ_TIMEOUT_MS, RapidsConf)
+    conf = RapidsConf.get_global()
+    if connect_timeout_s is None:
+        connect_timeout_s = int(conf.get(SHUFFLE_TCP_CONNECT_TIMEOUT_MS)) / 1e3
+    if read_timeout_s is None:
+        read_timeout_s = int(conf.get(SHUFFLE_TCP_READ_TIMEOUT_MS)) / 1e3
+    return float(connect_timeout_s), float(read_timeout_s)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -128,13 +137,16 @@ class TcpShuffleTransport(ShuffleTransport):
     a pooled connection (own blocks short-circuit to the local store)."""
 
     def __init__(self, executor_id: str = "exec-0", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, connect_timeout_s: Optional[float] = None,
+                 read_timeout_s: Optional[float] = None):
         self.executor_id = executor_id
         self._store: Dict[BlockId, bytes] = {}
         self._lock = threading.Lock()
         self._server = _Server(self._handle, host, port)
         self._conns: Dict[str, socket.socket] = {}
         self._conn_lock = threading.Lock()
+        self._connect_timeout, self._read_timeout = _conf_timeouts(
+            connect_timeout_s, read_timeout_s)
         # request-response pairs must not interleave on a pooled socket
         self._endpoint_locks: Dict[str, threading.Lock] = {}
 
@@ -159,6 +171,8 @@ class TcpShuffleTransport(ShuffleTransport):
         the block missing, and raises :class:`ShuffleFetchFailed` on
         network failure — callers must NOT treat a failure as an empty
         partition (silent data loss)."""
+        _faults.maybe_inject("shuffle.fetch", exc=ShuffleFetchFailed,
+                             peer=peer.executor_id, block=str(block))
         if peer.executor_id == self.executor_id or peer.endpoint in (
                 "local", self.endpoint):
             with self._lock:
@@ -195,10 +209,25 @@ class TcpShuffleTransport(ShuffleTransport):
             sock = self._conns.get(endpoint)
             if sock is not None:
                 return sock
+            sock = None
             try:
+                _faults.maybe_inject("shuffle.connect", exc=OSError,
+                                     endpoint=endpoint)
                 host, port = endpoint.rsplit(":", 1)
-                sock = socket.create_connection((host, int(port)), timeout=10)
+                sock = socket.create_connection(
+                    (host, int(port)), timeout=self._connect_timeout)
+                # reads after connect get their own (longer) budget; a
+                # peer stalling mid-frame surfaces as socket.timeout
+                # instead of hanging the reduce task
+                sock.settimeout(self._read_timeout)
             except OSError:
+                # a partially-established socket must not leak on the
+                # error path
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
                 return None
             self._conns[endpoint] = sock
             return sock
@@ -288,11 +317,15 @@ class TcpHeartbeatClient:
     ``ShuffleHeartbeatManager`` (register/heartbeat -> peer list) so the
     shuffle manager is transport-agnostic."""
 
-    def __init__(self, driver_endpoint: str):
+    def __init__(self, driver_endpoint: str,
+                 connect_timeout_s: Optional[float] = None,
+                 read_timeout_s: Optional[float] = None):
         self._endpoint = driver_endpoint
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._my_endpoint = ""  # remembered at register for re-registration
+        self._connect_timeout, self._read_timeout = _conf_timeouts(
+            connect_timeout_s, read_timeout_s)
 
     def _request(self, op: int, payload: dict) -> List[PeerInfo]:
         body = json.dumps(payload).encode()
@@ -302,7 +335,9 @@ class TcpHeartbeatClient:
                     if self._sock is None:
                         host, port = self._endpoint.rsplit(":", 1)
                         self._sock = socket.create_connection(
-                            (host, int(port)), timeout=10)
+                            (host, int(port)),
+                            timeout=self._connect_timeout)
+                        self._sock.settimeout(self._read_timeout)
                     self._sock.sendall(
                         _REQ.pack(_MAGIC, op, len(body), 0, 0) + body)
                     (n,) = _JSON_RESP.unpack(

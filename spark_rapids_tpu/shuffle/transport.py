@@ -21,6 +21,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..robustness import faults as _faults
+
 
 @dataclass(frozen=True)
 class BlockId:
@@ -36,6 +38,71 @@ class PeerInfo:
     executor_id: str
     endpoint: str        # opaque address (host:port for a real transport)
     last_heartbeat: float = 0.0
+
+
+class ShuffleFetchFailed(ConnectionError):
+    """Network-level fetch failure (the reference's FetchFailed analog) —
+    distinct from a peer authoritatively reporting the block missing
+    (which is legitimate: empty reduce partitions are never published).
+    EVERY network-level failure in the fetch path (socket.timeout,
+    ConnectionError, OSError subclasses, torn frames) must surface as
+    this type, never as a bare transport exception and never as a silent
+    None that masquerades as an empty partition."""
+
+
+class PeerBlacklist:
+    """Transient peer benching after repeated fetch failures — the
+    reference's FetchFailed -> executor-blacklist bookkeeping at peer
+    granularity.  Benched peers drop to LAST-RESORT ordering (they are
+    still tried when nothing else has the block — correctness never
+    depends on the blacklist); the first heartbeat refresh after the TTL
+    expires reinstates them with a clean slate, and any successful fetch
+    clears the strikes immediately."""
+
+    def __init__(self, threshold: int = 2, ttl_s: float = 5.0):
+        self.threshold = max(1, int(threshold))
+        self.ttl_s = float(ttl_s)
+        self._strikes: Dict[str, int] = {}
+        self._until: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def record_failure(self, executor_id: str) -> bool:
+        """Returns True when this failure NEWLY blacklists the peer."""
+        now = time.monotonic()
+        with self._lock:
+            n = self._strikes.get(executor_id, 0) + 1
+            self._strikes[executor_id] = n
+            if n >= self.threshold and executor_id not in self._until:
+                self._until[executor_id] = now + self.ttl_s
+                return True
+        return False
+
+    def record_success(self, executor_id: str) -> None:
+        with self._lock:
+            self._strikes.pop(executor_id, None)
+            self._until.pop(executor_id, None)
+
+    def is_blacklisted(self, executor_id: str) -> bool:
+        with self._lock:
+            return executor_id in self._until
+
+    def reinstate_expired(self) -> List[str]:
+        """Called on heartbeat refresh: peers whose bench expired get a
+        clean slate (heartbeat-driven reinstatement)."""
+        now = time.monotonic()
+        with self._lock:
+            done = [e for e, t in self._until.items() if now >= t]
+            for e in done:
+                del self._until[e]
+                self._strikes.pop(e, None)
+            return done
+
+    def order(self, peers: List["PeerInfo"]) -> List["PeerInfo"]:
+        """Usable peers first, benched ones last (still present)."""
+        with self._lock:
+            benched = set(self._until)
+        return ([p for p in peers if p.executor_id not in benched]
+                + [p for p in peers if p.executor_id in benched])
 
 
 class ShuffleTransport:
@@ -70,6 +137,8 @@ class LocalTransport(ShuffleTransport):
             self._store[(executor_id, block)] = frame
 
     def fetch(self, peer: PeerInfo, block: BlockId) -> Optional[bytes]:
+        _faults.maybe_inject("shuffle.fetch", exc=ShuffleFetchFailed,
+                             peer=peer.executor_id, block=str(block))
         if self.fetch_hook is not None:
             hooked = self.fetch_hook(peer, block)
             if hooked is not None:

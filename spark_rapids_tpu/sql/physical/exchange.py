@@ -156,9 +156,8 @@ class ShuffleExchangeExec(PhysicalPlan):
         # blocks never collide with ours (symmetric deployments: every
         # slice runs the same plan, so num_maps agrees — docs/distributed)
         map_base = topo.slice_id * num_maps if multi else 0
-        for cpid, merged in enumerate(map_out):
-            if merged is None:
-                continue
+
+        def _write_map(cpid: int, merged: ColumnarBatch) -> None:
             if nt == 1 or coalesce:
                 pieces: List[Optional[ColumnarBatch]] = [merged]
             else:
@@ -167,6 +166,28 @@ class ShuffleExchangeExec(PhysicalPlan):
                 pieces = [self._split_fn(merged, pids, t).shrunk()
                           for t in range(nt)]
             mgr.write_map_output(shuffle_id, map_base + cpid, pieces)
+
+        for cpid, merged in enumerate(map_out):
+            if merged is None:
+                continue
+            _write_map(cpid, merged)
+
+        # lost-block recompute lineage: the collected map outputs + the
+        # bound partitioner (range bounds already fixed above) make the
+        # re-split deterministic, so a recomputed block is bit-identical
+        # to the lost one.  Only THIS slice's maps are recomputable; a
+        # peer slice's lost block keeps the FetchFailed contract.
+        def _recompute_map(map_id: int) -> None:
+            local = map_id - map_base
+            if not (0 <= local < num_maps):
+                from ...shuffle import ShuffleFetchFailed
+                raise ShuffleFetchFailed(
+                    f"map {map_id} belongs to a peer slice; no local "
+                    f"lineage to recompute it")
+            merged = map_out[local]
+            if merged is not None:
+                _write_map(local, merged)
+        mgr.register_recompute(shuffle_id, _recompute_map)
 
         total_maps = num_maps * (topo.num_slices if multi else 1)
         out: List[List[ColumnarBatch]] = []
@@ -183,7 +204,11 @@ class ShuffleExchangeExec(PhysicalPlan):
             mgr.cleanup(shuffle_id)
         else:
             # peers may still be fetching this shuffle's blocks — defer
-            # reclamation to the TTL sweep instead of leaking forever
+            # reclamation to the TTL sweep instead of leaking forever.
+            # The recompute lineage is only reachable from OUR read loop
+            # (a peer's failed fetch fails in the peer's manager), so it
+            # must not pin the map outputs across the TTL window.
+            mgr.unregister_recompute(shuffle_id)
             mgr.defer_cleanup(shuffle_id)
         self._materialized = out
         self._maybe_skew_split(tctx)

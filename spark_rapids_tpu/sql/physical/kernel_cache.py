@@ -22,6 +22,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, Tuple
 
 from ...observability import tracer as _trace
+from ...robustness import faults as _faults
 
 #: LRU bound — each entry pins its exec instance (and that exec's child
 #: subtree) via the jitted closure, and keys embed literal values, so an
@@ -60,6 +61,8 @@ class _TrackedKernel:
         self._label = label
 
     def __call__(self, *args, **kwargs):
+        _faults.maybe_inject("kernel.compile", exc=RuntimeError,
+                             kernel=self._label)
         if not _trace.TRACING["on"]:
             return self._fn(*args, **kwargs)
         cs = getattr(self._fn, "_cache_size", None)

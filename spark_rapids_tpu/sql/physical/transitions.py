@@ -41,9 +41,12 @@ class HostToDeviceExec(PhysicalPlan):
         import jax.numpy as jnp
 
         from ...shims import tree_map
+        from ...robustness import faults as _faults
         for batch in self.children[0].execute(pid, tctx):
             nb = batch_nbytes(batch)
             tctx.inc_metric("h2d_bytes", nb)
+            _faults.maybe_inject("transfer.h2d", exc=ConnectionError,
+                                 bytes=nb)
             # span covers the upload dispatch only, not downstream
             # consumption of the yielded batch
             with _trace.span("h2d", "HostToDevice.upload", bytes=nb):

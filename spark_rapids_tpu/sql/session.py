@@ -120,9 +120,18 @@ class TpuSession:
         from ..columnar.convert import device_to_arrow
         from ..config import PROFILE_ENABLED, TRACE_BUFFER_EVENTS, TRACE_SINK
         from ..observability import tracer as OT
+        from ..robustness import faults as _faults
+        from ..robustness import stats_snapshot
         from .physical import speculation
         from .physical.base import PROFILING
         from .physical.kernel_cache import cache_stats
+        # arm/disarm the seeded chaos registry from this session's conf
+        # for the duration of THIS query, restore-on-exit like the
+        # tracing flags below (a disabled conf only undoes a conf-driven
+        # arming, so tests arming chaos directly keep their schedule)
+        prev_chaos = _faults.snapshot_arming()
+        _faults.apply_conf(self._conf)
+        rob0 = stats_snapshot()
         profiling = bool(self._conf.get(PROFILE_ENABLED))
         sink = str(self._conf.get(TRACE_SINK) or "").strip()
         # profile.enabled implies an in-memory trace so the profile report
@@ -149,14 +158,17 @@ class TpuSession:
         finally:
             PROFILING["on"] = prev_prof
             OT.TRACING["on"] = prev_trace
-            self._finish_trace(tracing, sink, cache_stats0, ok)
+            _faults.restore_arming(prev_chaos)
+            self._finish_trace(tracing, sink, cache_stats0, rob0, ok)
 
     def _finish_trace(self, tracing: bool, sink: str, cache_stats0: dict,
-                      ok: bool) -> None:
-        """Per-query trace epilogue: fold kernel-cache deltas into
-        last_query_metrics, snapshot the tracer (the ring is process-wide
-        and resets at the next traced query), build the compact summary,
-        and append the JSONL event log when the sink is a directory."""
+                      rob0: dict, ok: bool) -> None:
+        """Per-query trace epilogue: fold kernel-cache and robustness
+        deltas into last_query_metrics, snapshot the tracer (the ring is
+        process-wide and resets at the next traced query), build the
+        compact summary, and append the JSONL event log when the sink is
+        a directory."""
+        from ..robustness import stats_snapshot
         from .physical.kernel_cache import cache_stats
         cs1 = cache_stats()
         if ok:  # on failure last_query_metrics is still the prior query's
@@ -166,6 +178,12 @@ class TpuSession:
                              ("compiles", "kernelCompiles"),
                              ("compile_ms", "kernelCompileMs")):
                 m[dst] = round(cs1[src] - cache_stats0[src], 3)
+            # resilience counters: faults injected, fetch retries, lost
+            # blocks recomputed, peers blacklisted — per-query deltas of
+            # the process-wide robustness stats
+            rob1 = stats_snapshot()
+            for k, v0 in rob0.items():
+                m[k] = rob1[k] - v0
         if not tracing:
             self.last_query_trace_summary = None
             # an older traced query's events must not be joined with THIS

@@ -1,0 +1,235 @@
+"""Chaos soak — the degraded-conditions proof rig (docs/robustness.md).
+
+Runs a small TPC-H-ish query suite twice over identical data: once
+fault-free, once under a seeded random fault schedule (shuffle fetch
+failures, permanently destroyed shuffle blocks, torn spill-disk I/O,
+injected retryable OOMs), and asserts the chaos run's results are
+BIT-IDENTICAL to the clean run's — the paper's transparent-acceleration
+promise must survive data-movement failure, not just the happy path
+(arXiv:2508.04701's correctness-under-degradation argument;
+arXiv:2508.05029 treats data-movement failure as a first-class concern).
+
+The schedule is deterministic (robustness/faults.py): a given
+(seed, sites, probability) either passes forever or fails forever, so CI
+can pin one.
+
+Run standalone:  python -m spark_rapids_tpu.testing.chaos [rows]
+                     [--seed N] [--trace /path/trace.json]
+CI runs it in ci/run_ci.sh with two primary fault sites armed and
+validates the exported trace carries ``fault``-category spans.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+#: the default armed schedule: every site with a built-in recovery
+#: protocol that this suite's query shapes actually traverse
+DEFAULT_SITES = ("shuffle.fetch:0.25,shuffle.block.lost:0.12,"
+                 "spill.disk_read:0.25,spill.disk_write:0.25,"
+                 "memory.oom.retry:0.25")
+
+
+def _q_agg(sess, t, F):
+    df = sess.create_dataframe(t["fact"], num_partitions=4)
+    return (df.groupBy("q").agg(F.sum(F.col("v")).alias("sv"),
+                                F.count("*").alias("c"))
+            .orderBy("q").collect())
+
+
+def _q_join_agg(sess, t, F):
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    dim = sess.create_dataframe(t["dim"], num_partitions=2)
+    return (fact.join(dim, on="k", how="inner")
+            .groupBy("cat").agg(F.count("*").alias("n"),
+                                F.sum(fact.v).alias("sv"))
+            .orderBy("cat").collect())
+
+
+def _q_left_join(sess, t, F):
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    dim = sess.create_dataframe(t["dim"], num_partitions=2)
+    return (fact.join(dim, on="k", how="left").filter(fact.q >= 90)
+            .select(fact.k, fact.v, dim.w)
+            .orderBy("k", "v").collect())
+
+
+def _q_sort(sess, t, F):
+    # out-of-core sort (targetRows is forced small below): spillable runs
+    # + k-way merge give the spill/OOM fault sites real traffic
+    df = sess.create_dataframe(t["fact"], num_partitions=4)
+    return (df.orderBy(df.v.desc_nulls_first(), "k")
+            .select("k", "v", "q").collect())
+
+
+QUERIES: List[Tuple[str, Callable]] = [
+    ("agg", _q_agg),
+    ("join_agg", _q_join_agg),
+    ("left_join", _q_left_join),
+    ("ooc_sort", _q_sort),
+]
+
+
+def _canonical(table: pa.Table) -> pd.DataFrame:
+    df = table.to_pandas()
+    return df.sort_values(list(df.columns), kind="mergesort") \
+        .reset_index(drop=True)
+
+
+def _base_conf(tmp: str) -> Dict[str, object]:
+    """Shared clean/chaos session confs: the serializing (resident-off)
+    shuffle plane so block fetches actually happen, a small out-of-core
+    sort target so the spill tier sees traffic, and an
+    environment-independent codec."""
+    return {
+        "spark.rapids.shuffle.localDeviceResident.enabled": False,
+        "spark.rapids.shuffle.compression.codec": "none",
+        "spark.rapids.sql.sort.outOfCore.targetRows": 2048,
+        "spark.rapids.memory.spillDir": tmp,
+        # shuffled (not broadcast) joins: both join inputs ride exchanges
+        "spark.rapids.sql.autoBroadcastJoinThreshold": 1,
+    }
+
+
+def run_soak(rows: int = 20_000, seed: int = 11,
+             sites: str = DEFAULT_SITES,
+             queries: Optional[List[str]] = None,
+             trace_path: Optional[str] = None,
+             strict: bool = True) -> dict:
+    """Returns the soak report; raises AssertionError on any parity or
+    counter-visibility failure.  ``strict=False`` (reduced smoke runs)
+    keeps the bit-parity and faults-injected asserts but skips the
+    per-site coverage floor (small row counts may not traverse every
+    armed site)."""
+    import spark_rapids_tpu as srt
+    from ..config import RapidsConf
+    from ..memory.spill import BufferCatalog
+    from ..robustness import disarm_chaos
+    from ..robustness.faults import SITE_STATS
+    from ..sql import functions as F
+    from .scaletest import build_tables
+
+    tables = build_tables(rows)
+    tmp = tempfile.mkdtemp(prefix="srt-chaos-")
+    selected = [(n, fn) for n, fn in QUERIES
+                if queries is None or n in queries]
+    from ..sql.session import TpuSession
+    prev_active = TpuSession._active
+
+    # tiny host spill budget: an injected RetryOOM's spill_all_device
+    # overflows straight to the DISK tier, so spill.disk_read/write see
+    # real traffic.  Shared by both runs (the tier move is value-exact,
+    # so the clean run's results are unaffected).
+    BufferCatalog.reset(RapidsConf({
+        "spark.rapids.memory.host.spillStorageSize": 1,
+        "spark.rapids.memory.spillDir": tmp,
+    }))
+    try:
+        clean_sess = srt.session(conf=RapidsConf.get_global().copy(
+            _base_conf(tmp)))
+        clean: Dict[str, pd.DataFrame] = {}
+        for name, fn in selected:
+            clean[name] = _canonical(fn(clean_sess, tables, F))
+
+        chaos_conf = dict(_base_conf(tmp))
+        chaos_conf.update({
+            "spark.rapids.tpu.chaos.enabled": True,
+            "spark.rapids.tpu.chaos.seed": seed,
+            "spark.rapids.tpu.chaos.sites": sites,
+            "spark.rapids.tpu.shuffle.fetch.backoffMs": 1,
+        })
+        if trace_path:
+            chaos_conf["spark.rapids.tpu.profile.enabled"] = True
+        chaos_sess = srt.session(conf=RapidsConf.get_global().copy(
+            chaos_conf))
+
+        counters = {"faultsInjected": 0, "shuffleFetchRetries": 0,
+                    "shuffleBlocksRecomputed": 0, "peersBlacklisted": 0}
+        by_site: Dict[str, int] = {}
+        per_query = {}
+        mismatches = []
+        for name, fn in selected:
+            site0 = dict(SITE_STATS)
+            got = _canonical(fn(chaos_sess, tables, F))
+            m = chaos_sess.last_query_metrics
+            q = {k: int(m.get(k, 0)) for k in counters}
+            for k in counters:
+                counters[k] += q[k]
+            # per-site coverage: the monotonic totals survive the
+            # query-scoped registry (re-armed per query, gone at query end)
+            for site, n in SITE_STATS.items():
+                d = n - site0.get(site, 0)
+                if d:
+                    by_site[site] = by_site.get(site, 0) + d
+            per_query[name] = q
+            try:
+                pd.testing.assert_frame_equal(got, clean[name],
+                                              check_exact=True)
+            except AssertionError as e:
+                mismatches.append(f"{name}: {e}")
+            if trace_path and q["faultsInjected"] > 0:
+                # keep the last trace that actually carries fault spans
+                chaos_sess.export_chrome_trace(trace_path)
+
+        report = {
+            "rows": rows, "seed": seed, "sites": sites,
+            "queries": per_query, "counters": counters,
+            "faults_by_site": by_site,
+            "bit_identical": not mismatches,
+        }
+        assert not mismatches, \
+            "chaos run diverged from the fault-free run:\n" + \
+            "\n".join(mismatches)
+        assert counters["faultsInjected"] > 0, report
+        assert counters["shuffleFetchRetries"] > 0, report
+        if strict:
+            assert counters["shuffleBlocksRecomputed"] > 0, report
+            assert by_site.get("shuffle.fetch", 0) > 0, report
+            assert by_site.get("spill.disk_read", 0) > 0, report
+        return report
+    finally:
+        disarm_chaos()
+        BufferCatalog.reset()
+        # don't leave the chaos-confed session as the cached active one:
+        # a later bare ``srt.session()`` would inherit it and re-arm
+        # chaos on its next query
+        TpuSession._active = prev_active
+
+
+def main() -> None:
+    import os
+
+    # the ambient sitecustomize may force the axon TPU tunnel; this rig
+    # runs on the host platform unless told otherwise (scaletest.main
+    # does the same)
+    plat = os.environ.get("SRT_SCALE_PLATFORM", "cpu")
+    if plat == "cpu":
+        from spark_rapids_tpu import pin_host_platform
+        pin_host_platform()
+    argv = sys.argv[1:]
+    trace_path = None
+    seed = 11
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        trace_path = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    if "--seed" in argv:
+        i = argv.index("--seed")
+        seed = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    rows = int(argv[0]) if argv else 20_000
+    report = run_soak(rows, seed=seed, trace_path=trace_path)
+    print(json.dumps(report, indent=2))
+    print("CHAOS SOAK PASSED: results bit-identical under "
+          f"{report['counters']['faultsInjected']} injected faults")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,10 @@ QUICK_MODULES = {
     # observability tracer: tier-1 per ISSUE 3 (trace regressions must
     # surface in the quick gate, not only in full CI)
     "test_tracer",
+    # robustness: chaos-schedule determinism + the resilient shuffle
+    # fetch protocol (retry/deadline/blacklist/recompute) are tier-1 per
+    # ISSUE 4 — a silent regression here only shows up under failure
+    "test_chaos", "test_shuffle",
     # both jax ShimProviders exercised end-to-end every CI run — the
     # parallel-world guarantee (VERDICT r3 #8)
     "test_shims",

@@ -6,6 +6,9 @@ carry a non-negative dur.  Used by ci/run_ci.sh after the traced-query
 step and by tests/test_tracer.py.
 
 Usage: python tools/check_trace.py <trace.json> [--min-events N]
+           [--require-cat CAT]
+``--require-cat`` additionally fails unless at least one span event
+carries that category (e.g. ``fault`` for chaos-soak traces).
 Exit 0 on a valid trace, 1 otherwise.
 """
 
@@ -15,8 +18,14 @@ import sys
 REQUIRED = ("ph", "ts", "pid", "tid", "name")
 KNOWN_PH = ("X", "C", "i", "M", "B", "E")
 
+#: categories the tracer emits today (observability/tracer.py
+#: CATEGORIES); unknown categories stay opaque — listed for reference
+#: and for --require-cat hints, not validated
+KNOWN_CATS = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
+              "shuffle", "sem_wait", "fault")
 
-def check(path: str, min_events: int = 1):
+
+def check(path: str, min_events: int = 1, require_cat: str = ""):
     with open(path) as fh:
         doc = json.load(fh)
     events = doc["traceEvents"] if isinstance(doc, dict) else doc
@@ -42,6 +51,10 @@ def check(path: str, min_events: int = 1):
     if spans < min_events:
         raise ValueError(f"expected at least {min_events} span event(s), "
                          f"found {spans}")
+    if require_cat and require_cat not in cats:
+        raise ValueError(
+            f"no span event with category {require_cat!r} "
+            f"(found: {sorted(c for c in cats if c)})")
     return spans, sorted(c for c in cats if c)
 
 
@@ -50,14 +63,19 @@ def main(argv) -> int:
         print(__doc__)
         return 1
     min_events = 1
+    require_cat = ""
     if "--min-events" in argv:
         i = argv.index("--min-events")
         min_events = int(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
+    if "--require-cat" in argv:
+        i = argv.index("--require-cat")
+        require_cat = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     rc = 0
     for path in argv:
         try:
-            spans, cats = check(path, min_events)
+            spans, cats = check(path, min_events, require_cat)
             print(f"OK {path}: {spans} span events, "
                   f"categories: {', '.join(cats) or '(none)'}")
         except (OSError, ValueError, KeyError) as e:
