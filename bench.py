@@ -189,6 +189,49 @@ def _shape_trace(sess, collect) -> dict:
     return out
 
 
+class PhaseTimeout(Exception):
+    """A bench phase exhausted its own watchdog budget."""
+
+
+def _run_phase(label: str, fn, budget_s: float):
+    """Run one bench phase on a daemon thread under its OWN watchdog
+    budget (BENCH_r05 postmortem: a hung join micro consumed the whole
+    run's budget and forced a stale replayed capture).  The phase's
+    ``budget_ms``/``elapsed_ms``/``timed_out`` are banked into the
+    artifact either way; on timeout the thread is abandoned (daemon) and
+    PhaseTimeout raised so the caller can move to the next phase."""
+    rec = {"budget_ms": int(budget_s * 1000)}
+    box: dict = {}
+
+    def wrap():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            box["err"] = e
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=wrap, daemon=True,
+                          name=f"bench-{label}")
+    th.start()
+    th.join(max(budget_s, 1.0))
+    rec["elapsed_ms"] = int((time.perf_counter() - t0) * 1000)
+    rec["timed_out"] = th.is_alive()
+    with _lock:
+        _result.setdefault("phases", {})[label] = rec
+    _bank_partial()
+    if th.is_alive():
+        raise PhaseTimeout(f"phase {label} exceeded its "
+                           f"{budget_s:.0f}s budget")
+    if "err" in box:
+        raise box["err"]
+    return box.get("out")
+
+
+def _phase_budget(deadline: float, frac: float, cap: float) -> float:
+    """Fraction of the remaining budget, capped, floored at 10s."""
+    return max(10.0, min(cap, (deadline - time.time()) * frac))
+
+
 def run_engine(data, measure_trace_overhead: bool = False) -> tuple:
     import pyarrow as pa
     import spark_rapids_tpu as srt
@@ -224,46 +267,57 @@ def run_engine(data, measure_trace_overhead: bool = False) -> tuple:
     # one traced run per size: the artifact's q1 entry carries its own
     # sync/compile/transfer diagnosis next to the rows/s number
     trace_info = _shape_trace(sess, q.collect)
+
+    overhead_fn = None
     if measure_trace_overhead:
-        # tracing overhead on the q1 shape: min-of-repeats traced vs the
-        # untraced min above (the first traced collect above already
-        # warmed the tracer's code paths)
-        try:
-            sess.conf.set("spark.rapids.tpu.trace.sink", "memory")
-            ttimes = []
-            for _ in range(REPEATS):
-                t0 = time.perf_counter()
-                q.collect()
-                ttimes.append(time.perf_counter() - t0)
-            trace_info["trace_overhead"] = round(
-                min(ttimes) / max(eng_time, 1e-9) - 1.0, 4)
-        except Exception:
-            pass
-        finally:
-            sess.conf.set("spark.rapids.tpu.trace.sink", "")
-        # chaos chokepoint overhead on the q1 shape: registry armed but
-        # never firing (p=0) vs the untraced min above — bounds what the
-        # fault-injection hooks cost a production (chaos-off) run, where
-        # each chokepoint is one dict lookup cheaper still
-        try:
-            from spark_rapids_tpu.robustness import arm_chaos, disarm_chaos
-            arm_chaos(seed=0, sites=None, probability=0.0)
-            ctimes = []
-            for _ in range(REPEATS):
-                t0 = time.perf_counter()
-                q.collect()
-                ctimes.append(time.perf_counter() - t0)
-            trace_info["chaos_overhead"] = round(
-                min(ctimes) / max(eng_time, 1e-9) - 1.0, 4)
-        except Exception:
-            pass
-        finally:
+        # the trace/chaos overhead measurements run as their OWN bench
+        # phase (own watchdog budget), so a wedged overhead rerun can't
+        # eat the q1 phase's budget — hence a closure handed back to
+        # child_main instead of measuring inline
+        def overhead_fn() -> dict:
+            info = {}
+            # tracing overhead on the q1 shape: min-of-repeats traced vs
+            # the untraced min above (the first traced collect above
+            # already warmed the tracer's code paths)
             try:
-                disarm_chaos()
+                sess.conf.set("spark.rapids.tpu.trace.sink", "memory")
+                ttimes = []
+                for _ in range(REPEATS):
+                    t0 = time.perf_counter()
+                    q.collect()
+                    ttimes.append(time.perf_counter() - t0)
+                info["trace_overhead"] = round(
+                    min(ttimes) / max(eng_time, 1e-9) - 1.0, 4)
             except Exception:
                 pass
+            finally:
+                sess.conf.set("spark.rapids.tpu.trace.sink", "")
+            # chaos chokepoint overhead on the q1 shape: registry armed
+            # but never firing (p=0) vs the untraced min above — bounds
+            # what the fault-injection hooks cost a production
+            # (chaos-off) run, where each chokepoint is one dict lookup
+            # cheaper still
+            try:
+                from spark_rapids_tpu.robustness import (arm_chaos,
+                                                         disarm_chaos)
+                arm_chaos(seed=0, sites=None, probability=0.0)
+                ctimes = []
+                for _ in range(REPEATS):
+                    t0 = time.perf_counter()
+                    q.collect()
+                    ctimes.append(time.perf_counter() - t0)
+                info["chaos_overhead"] = round(
+                    min(ctimes) / max(eng_time, 1e-9) - 1.0, 4)
+            except Exception:
+                pass
+            finally:
+                try:
+                    disarm_chaos()
+                except Exception:
+                    pass
+            return info
     trace_info.pop("traced_seconds", None)
-    return eng_time, out, trace_info
+    return eng_time, out, trace_info, overhead_fn
 
 
 _RESIDENT_KEY = "spark.rapids.shuffle.localDeviceResident.enabled"
@@ -501,6 +555,29 @@ def _measure_sort(rows: int) -> dict:
     return out
 
 
+def _measure_pipeline(rows: int) -> dict:
+    """Serial vs pipelined engine over the TPC-H-ish multi-partition
+    suite (testing/pipeline.py): wall-clock delta with a bit-parity
+    assert, banked as ``pipeline_off_seconds`` / ``pipeline_on_seconds``
+    / ``pipeline_speedup``.  On a single-core host there is little
+    latency for the overlap to hide (the note says so); on the tunnel
+    every transfer is a ~65ms round trip and the delta is the point."""
+    from spark_rapids_tpu.testing import pipeline as _pl
+    out = _pl.measure(rows, repeats=max(2, REPEATS - 1))
+    try:
+        import os as _os
+        cores = len(_os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = 0
+    out["pipeline_host_cores"] = cores
+    if cores <= 1:
+        out["pipeline_note"] = (
+            "single-core host: thread overlap cannot exceed 1x unless "
+            "the workload blocks on I/O or device round trips; the "
+            "tunnel-RTT overlap is the target claim")
+    return out
+
+
 def _device_responsive(timeout_s: float) -> bool:
     """Probe the ambient device backend from a daemon thread; a hung TPU
     tunnel must not take the whole child (and its exit) with it."""
@@ -566,6 +643,7 @@ def child_main(mode: str) -> None:
 
     tol = 2e-3  # float32 accumulation vs pandas float64
     note = None
+    overhead_box: dict = {}
 
     def measure(rows: int):
         """Bank one measurement into _result.  Called smallest-size first
@@ -575,8 +653,10 @@ def child_main(mode: str) -> None:
         data = make_data(rows)
         n_bytes = sum(v.nbytes for v in data.values())
         cpu_time, cpu_result = run_pandas(data)
-        eng_time, eng_result, trace_info = run_engine(
+        eng_time, eng_result, trace_info, ofn = run_engine(
             data, measure_trace_overhead=(rows == WARM_ROWS))
+        if ofn is not None:
+            overhead_box["fn"] = ofn
         try:
             got = {(r["returnflag"], r["linestatus"]): r
                    for r in eng_result.to_pylist()}
@@ -596,10 +676,14 @@ def child_main(mode: str) -> None:
                        **trace_info)
         _bank_partial()
 
+    # each q1 size is its own watchdog-budgeted phase: a hung warm-up no
+    # longer forfeits the full-size attempt and vice versa
     try:
-        measure(WARM_ROWS)
+        _run_phase("q1_warm", lambda: measure(WARM_ROWS),
+                   _phase_budget(deadline, 0.40, 150.0))
         if ROWS > WARM_ROWS:
-            measure(ROWS)
+            _run_phase("q1_full", lambda: measure(ROWS),
+                       _phase_budget(deadline, 0.45, 240.0))
     except BaseException as e:
         if _result.get("rows"):
             note = (note or "") + f"; larger size failed: " \
@@ -608,6 +692,18 @@ def child_main(mode: str) -> None:
             _emit(note=f"engine failed: {type(e).__name__}: {e}",
                   platform=platform)
             return
+    # trace/chaos overhead reruns: own phase, own budget (the BENCH_r05
+    # failure mode was exactly an unbudgeted rerun eating the run)
+    if "fn" in overhead_box:
+        try:
+            info = _run_phase("q1_overheads", overhead_box["fn"],
+                              _phase_budget(deadline, 0.25, 90.0))
+            if info:
+                _result.update(info)
+                _bank_partial()
+        except BaseException as e:
+            note = (note or "") + f"; overhead phase failed: " \
+                f"{type(e).__name__}: {e}"
     # join/window/sort shapes ride along (banked incrementally so a
     # watchdog cutoff keeps whatever finished); q1 stays the primary
     # metric for cross-round comparability.  Resident-on runs come first
@@ -626,27 +722,49 @@ def child_main(mode: str) -> None:
     except Exception:
         pass
     shuffle_rows = min(ROWS, 2_000_000)
-    for label, fn in (
-            ("join", lambda: _measure_join(join_rows)),
-            ("window", lambda: _measure_window(window_rows)),
-            ("sort", lambda: _measure_sort(min(ROWS, 2_000_000))),
-            # forced shuffle join: the shape the resident tier serves —
-            # the default join may broadcast its small dim side
-            ("join_shuffle",
-             lambda: _measure_join(shuffle_rows, force_shuffle=True)),
-            # the shuffle-join on/off delta is THE claim (VERDICT r4 #1)
-            # — bank it before the pricier broadcast-shape rerun
-            ("join_shuffle_resident_off",
-             lambda: _measure_join(shuffle_rows, resident=False,
-                                   force_shuffle=True)),
-            ("window_resident_off",
-             lambda: _measure_window(window_rows, resident=False)),
-            ("join_resident_off",
-             lambda: _measure_join(join_rows, resident=False))):
-        if time.time() > deadline - 20:
+    # pipeline-off vs pipeline-on over the TPC-H-ish multi-partition
+    # suite (ISSUE 5 acceptance evidence): its own dedicated phase with
+    # a real budget — inside the generic shape loop its 4-query double
+    # suite (serial + pipelined, warm + repeats) outlives the loop's
+    # 20-90s slice and the timeout would drop the acceptance metrics
+    try:
+        got = _run_phase("pipeline",
+                         lambda: _measure_pipeline(min(ROWS // 16,
+                                                       250_000)),
+                         _phase_budget(deadline, 0.35, 150.0))
+        _result.setdefault("extra_metrics", {}).update(got)
+        _bank_partial()
+    except BaseException as e:
+        note = (note or "") + f"; pipeline shape failed: " \
+            f"{type(e).__name__}: {e}"
+    shapes = (
+        ("join", lambda: _measure_join(join_rows)),
+        ("window", lambda: _measure_window(window_rows)),
+        ("sort", lambda: _measure_sort(min(ROWS, 2_000_000))),
+        # forced shuffle join: the shape the resident tier serves —
+        # the default join may broadcast its small dim side
+        ("join_shuffle",
+         lambda: _measure_join(shuffle_rows, force_shuffle=True)),
+        # the shuffle-join on/off delta is THE claim (VERDICT r4 #1)
+        # — bank it before the pricier broadcast-shape rerun
+        ("join_shuffle_resident_off",
+         lambda: _measure_join(shuffle_rows, resident=False,
+                               force_shuffle=True)),
+        ("window_resident_off",
+         lambda: _measure_window(window_rows, resident=False)),
+        ("join_resident_off",
+         lambda: _measure_join(join_rows, resident=False)))
+    for i, (label, fn) in enumerate(shapes):
+        remaining = deadline - time.time()
+        if remaining < 25:
             break
+        # every shape is its own watchdog-budgeted phase: one hung micro
+        # (the BENCH_r05 join) can no longer consume the whole run
+        budget = max(20.0, min(90.0, (remaining - 15)
+                               / max(1, len(shapes) - i)))
         try:
-            _result.setdefault("extra_metrics", {}).update(fn())
+            got = _run_phase(label, fn, budget)
+            _result.setdefault("extra_metrics", {}).update(got)
             _bank_partial()  # each shape banks the moment it completes
         except BaseException as e:
             note = (note or "") + f"; {label} shape failed: " \

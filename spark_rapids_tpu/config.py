@@ -592,6 +592,44 @@ OPTIMIZER_DEFAULT_GPU_COST = register(
     "spark.rapids.sql.optimizer.gpu.exec.default",
     "Default accelerator cost per row per op (seconds).", 0.0001)
 
+# --- pipelined async execution ----------------------------------------------
+TASK_PARALLELISM = register(
+    "spark.rapids.tpu.task.parallelism",
+    "Number of partitions execute_all runs concurrently on a bounded "
+    "thread pool (the local-mode analog of Spark running N tasks per "
+    "executor; reference SURVEY §2.7 per-task concurrency under the GPU "
+    "semaphore).  1 (default) is the serial driver loop — bit-identical "
+    "results either way: per-partition batch order and cross-partition "
+    "result order are both preserved.  Device admission is still gated "
+    "by spark.rapids.sql.concurrentGpuTasks; set it >= this value to "
+    "actually overlap host and device work.  Nested plans (exchange map "
+    "sides, broadcast builds, subqueries) always run serially inside "
+    "their owning task.", 1, commonly_used=True)
+PREFETCH_ENABLED = register(
+    "spark.rapids.tpu.prefetch.enabled",
+    "Insert AsyncPrefetchExec boundaries after planning: a bounded "
+    "background queue decouples the expensive seams (file scans, "
+    "host->device uploads, exchange reduce sides) from their consumer, "
+    "so host decode/upload overlaps downstream compute (the reference's "
+    "multithreaded reader prefetch, GpuMultiFileReader.scala:176).  "
+    "Exceptions (including injected chaos faults) propagate through the "
+    "queue to the consumer with their original type.  Off (default) "
+    "keeps the fully synchronous pipeline.", False, commonly_used=True)
+PREFETCH_DEPTH = register(
+    "spark.rapids.tpu.prefetch.depth",
+    "Bound on batches buffered per AsyncPrefetchExec queue; the producer "
+    "blocks when the consumer falls this many batches behind (memory "
+    "backpressure, the maxBytesInFlight analog at pipeline seams).", 2)
+TRANSFER_DOUBLE_BUFFER = register(
+    "spark.rapids.tpu.transfer.doubleBuffer.enabled",
+    "Double-buffer backend transitions: HostToDeviceExec dispatches "
+    "batch N+1's upload while batch N is consumed downstream, and "
+    "DeviceToHostExec issues the prepacked fetch for batch N+1 before "
+    "yielding batch N's result — at most ONE transfer in flight ahead "
+    "of the consumer, still under the OOM-guard/spill protocol "
+    "(reference stream-overlapped transfers, SURVEY §2.2).  Off "
+    "(default) keeps transfers serialized with compute.", False)
+
 # --- metrics / debug -------------------------------------------------------
 METRICS_LEVEL = register(
     "spark.rapids.sql.metrics.level",

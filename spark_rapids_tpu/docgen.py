@@ -47,7 +47,12 @@ def _exec_rows() -> List[tuple]:
         ("AdaptiveJoinExec", "AQE runtime broadcast-vs-shuffle re-decision"),
         ("MapInPandasExec", "mapInPandas (Arrow-fed Python)"),
         ("FlatMapGroupsInPandasExec", "applyInPandas per key group"),
-        ("HostToDeviceExec / DeviceToHostExec", "backend transitions"),
+        ("HostToDeviceExec / DeviceToHostExec", "backend transitions "
+         "(double-buffered when spark.rapids.tpu.transfer.doubleBuffer."
+         "enabled)"),
+        ("AsyncPrefetchExec", "bounded background prefetch queue at "
+         "pipeline seams (scans, uploads, exchange reduce sides); "
+         "spark.rapids.tpu.prefetch.enabled"),
         ("CoalesceBatchesExec", "batch-size normalization"),
     ]
 

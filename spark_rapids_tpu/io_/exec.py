@@ -10,6 +10,7 @@ chunked multi-batch reads (``spark.rapids.sql.reader.chunked``,
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
@@ -40,6 +41,7 @@ class FileScanExec(PhysicalPlan):
         if self.reader_type == "AUTO":
             self.reader_type = "MULTITHREADED" if len(self.files) > 1 else "PERFILE"
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_lock = threading.Lock()
         #: (col, op, literal) conjuncts attached by the planner from a
         #: scan-adjacent filter; used for row-group pruning only — the
         #: device filter above still applies the full predicate
@@ -404,10 +406,16 @@ class FileScanExec(PhysicalPlan):
                 return
         if self.reader_type == "MULTITHREADED":
             # per-partition prefetch through a shared pool: submit this file
-            # read on a worker thread so decode overlaps device compute
+            # read on a worker thread so decode overlaps device compute.
+            # Lazy init is locked: under the parallel partition scheduler
+            # several partitions race in here, and a lost pool would leak
+            # its threads for the process lifetime.
             if self._pool is None:
-                self._pool = ThreadPoolExecutor(
-                    max_workers=int(self.conf.get(MULTITHREAD_READ_NUM_THREADS)))
+                with self._pool_lock:
+                    if self._pool is None:
+                        self._pool = ThreadPoolExecutor(
+                            max_workers=int(self.conf.get(
+                                MULTITHREAD_READ_NUM_THREADS)))
             fut = self._pool.submit(self._read, self.files[pid], tctx)
             yield from upload(fut.result())
             return

@@ -22,6 +22,8 @@ Event categories:
 ``sem_wait``       device-semaphore acquisition waits
 ``fault``          chaos fault injections, shuffle fetch retries, peer
                    blacklisting, lost-block recompute (robustness/)
+``queue``          async-prefetch queue waits (consumer blocked on the
+                   bounded prefetch queue; sql/physical/async_exec.py)
 =================  =========================================================
 
 Spans attribute to the *owning exec node* via a thread-local exec stack:
@@ -58,7 +60,7 @@ TRACING = {"on": False}
 #: known span categories (exported traces may add more; the checker and
 #: the report treat unknown categories as opaque)
 CATEGORIES = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
-              "shuffle", "sem_wait", "fault")
+              "shuffle", "sem_wait", "fault", "queue")
 
 #: default ring capacity (spark.rapids.tpu.trace.bufferEvents)
 DEFAULT_CAPACITY = 65536

@@ -39,9 +39,17 @@ class TaskContext:
         self.conf = conf or RapidsConf.get_global()
         # contexts spawned INSIDE another task (exchange map side, join
         # build collection) share the parent's metrics dict, so the work
-        # below an exchange still shows up in last_query_metrics
-        self.metrics: Dict[str, float] = (parent.metrics if parent is not None
-                                          else {})
+        # below an exchange still shows up in last_query_metrics.  The
+        # metrics lock is shared along with the dict: with the pipelined
+        # execution layer (task.parallelism / prefetch / double-buffered
+        # transfers) one task's metrics may be incremented from its
+        # prefetch and transfer helper threads concurrently.
+        if parent is not None:
+            self.metrics: Dict[str, float] = parent.metrics
+            self._metrics_lock = parent._metrics_lock
+        else:
+            self.metrics = {}
+            self._metrics_lock = threading.Lock()
         from ...config import METRICS_LEVEL
         self._rank = _METRIC_RANK.get(
             str(self.conf.get(METRICS_LEVEL)).upper(), 1)
@@ -50,7 +58,8 @@ class TaskContext:
                    level: str = "MODERATE"):
         if _METRIC_RANK.get(level, 1) > self._rank:
             return
-        self.metrics[name] = self.metrics.get(name, 0.0) + value
+        with self._metrics_lock:
+            self.metrics[name] = self.metrics.get(name, 0.0) + value
 
     # --- thread-local current task (Spark TaskContext.get() analog) -------
     _tls = threading.local()
@@ -93,6 +102,16 @@ class TaskContext:
 #: Concurrent collect() calls from two threads are unsupported for
 #: profiling/tracing — see docs/observability.md.
 PROFILING = {"on": False}
+
+#: serializes task-metric merges onto a plan's ``metrics`` dict — one
+#: process-wide lock (merges are per task, never per batch, so contention
+#: is negligible next to the read-modify-write race it closes under the
+#: parallel partition scheduler).  Note the per-exec ``_prof_ns``
+#: profiling accumulators deliberately stay lock-free: under
+#: task.parallelism > 1 their wall-clock attribution is approximate
+#: anyway (overlapping tasks double-count inclusive time); use the
+#: tracer for parallel-mode timing.
+_PLAN_METRICS_LOCK = threading.Lock()
 
 
 class PhysicalPlan:
@@ -182,63 +201,141 @@ class PhysicalPlan:
 
     def execute_all(self, conf: Optional[RapidsConf] = None
                     ) -> List[ColumnarBatch]:
-        """Run every partition serially (local mode driver).  Each task
-        acquires the device semaphore, arms test OOM injection
-        (conftest.py:113-265 analog), and fires completion callbacks.
-        With ``spark.rapids.tpu.trace.enabled`` each task runs inside a
+        """Run every partition (local mode driver) — serially by default,
+        or on a bounded thread pool when
+        ``spark.rapids.tpu.task.parallelism`` > 1.  Each task acquires
+        the device semaphore, arms test OOM injection (conftest.py:113-265
+        analog), and fires completion callbacks.  With
+        ``spark.rapids.tpu.trace.enabled`` each task runs inside a
         ``jax.profiler`` TraceAnnotation (NVTX-range analog); task metrics
-        accumulate onto ``self.metrics`` for the session to report."""
+        accumulate onto ``self.metrics`` for the session to report.
+
+        Ordering guarantee (docs/async_pipeline.md): batches within a
+        partition keep their order, and the returned list concatenates
+        partitions in pid order — identical to the serial loop in both
+        modes.  Nested execute_all calls (map-side subquery / broadcast
+        build under an outer exchange task) always run serially: pools
+        don't nest, and the outer task owns the thread-local seams
+        (TaskContext, OOM arming, speculation deferral)."""
+        from ...config import TASK_PARALLELISM
+        cfg = conf or RapidsConf.get_global()
+        nparts = self.num_partitions()
+        par = max(1, int(cfg.get(TASK_PARALLELISM)))
+        if par > 1 and nparts > 1 and TaskContext.current() is None:
+            return self._execute_all_parallel(conf, cfg, min(par, nparts))
+        out: List[ColumnarBatch] = []
+        for pid in range(nparts):
+            out.extend(self._run_partition(pid, conf))
+        return out
+
+    def _run_partition(self, pid: int, conf: Optional[RapidsConf]
+                       ) -> List[ColumnarBatch]:
+        """The one-task protocol shared by the serial loop and the
+        parallel scheduler: TaskContext install, OOM-injection arming
+        (thread-local, so each pool worker arms its own), semaphore
+        acquire/release, metric merge, completion callbacks."""
         from ...config import (DUMP_ON_ERROR_PATH, TEST_INJECT_RETRY_OOM,
                                TEST_INJECT_SPLIT_OOM, TRACE_ENABLED)
         from ...memory.completion import ScalableTaskCompletion
         from ...memory.retry import arm_oom_injection
         from ...memory.semaphore import TpuSemaphore
-        out: List[ColumnarBatch] = []
         sem = TpuSemaphore.get()
         stc = ScalableTaskCompletion.get()
-        cfg = conf or RapidsConf.get_global()
-        tracing = bool(cfg.get(TRACE_ENABLED))
-        for pid in range(self.num_partitions()):
-            tctx = TaskContext(pid, conf)
-            # save/restore the PREVIOUS context like as_current() does: a
-            # nested execute_all (map-side subquery / broadcast build run
-            # under an outer exchange task) must not wipe the outer
-            # task's thread-local on exit
-            prev_ctx = TaskContext.current()
-            TaskContext._set_current(tctx)
-            arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
-                              int(tctx.conf.get(TEST_INJECT_SPLIT_OOM)))
-            sem.acquire_if_necessary(pid, tctx)
-            failed = False
-            try:
-                with np.errstate(all="ignore"):
-                    if tracing:
-                        import jax.profiler
-                        with jax.profiler.TraceAnnotation(
-                                f"{self.node_name()}:task{pid}"):
-                            out.extend(self.execute(pid, tctx))
-                    else:
+        tracing = bool((conf or RapidsConf.get_global()).get(TRACE_ENABLED))
+        out: List[ColumnarBatch] = []
+        tctx = TaskContext(pid, conf)
+        # save/restore the PREVIOUS context like as_current() does: a
+        # nested execute_all (map-side subquery / broadcast build run
+        # under an outer exchange task) must not wipe the outer
+        # task's thread-local on exit
+        prev_ctx = TaskContext.current()
+        TaskContext._set_current(tctx)
+        arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
+                          int(tctx.conf.get(TEST_INJECT_SPLIT_OOM)))
+        sem.acquire_if_necessary(pid, tctx)
+        failed = False
+        try:
+            with np.errstate(all="ignore"):
+                if tracing:
+                    import jax.profiler
+                    with jax.profiler.TraceAnnotation(
+                            f"{self.node_name()}:task{pid}"):
                         out.extend(self.execute(pid, tctx))
-            except BaseException as e:
-                failed = True
-                dump_dir = str(tctx.conf.get(DUMP_ON_ERROR_PATH))
-                if dump_dir:
-                    _dump_failure(dump_dir, self, pid, e, out)
-                raise
-            finally:
-                # disarm: unconsumed synthetic OOMs must not leak into the
-                # next task or into direct with_retry callers (tests)
-                arm_oom_injection(0, 0)
-                TaskContext._set_current(prev_ctx)
-                sem.release_if_necessary(pid)
+                else:
+                    out.extend(self.execute(pid, tctx))
+        except BaseException as e:
+            failed = True
+            dump_dir = str(tctx.conf.get(DUMP_ON_ERROR_PATH))
+            if dump_dir:
+                _dump_failure(dump_dir, self, pid, e, out)
+            raise
+        finally:
+            # disarm: unconsumed synthetic OOMs must not leak into the
+            # next task or into direct with_retry callers (tests)
+            arm_oom_injection(0, 0)
+            TaskContext._set_current(prev_ctx)
+            sem.release_if_necessary(pid)
+            # merge under a lock: concurrent tasks of the parallel
+            # scheduler all land their metrics on this one plan object
+            with _PLAN_METRICS_LOCK:
                 for k, v in tctx.metrics.items():
                     self.metrics[k] = self.metrics.get(k, 0.0) + v
-                try:
-                    stc.task_completed(pid)
-                except Exception:
-                    # never mask the task's own failure with a cleanup error
-                    if not failed:
-                        raise
+            try:
+                stc.task_completed(pid)
+            except Exception:
+                # never mask the task's own failure with a cleanup error
+                if not failed:
+                    raise
+        return out
+
+    def _execute_all_parallel(self, conf: Optional[RapidsConf],
+                              cfg: RapidsConf, workers: int
+                              ) -> List[ColumnarBatch]:
+        """Bounded-pool partition scheduler
+        (``spark.rapids.tpu.task.parallelism``): independent partitions
+        run concurrently, each under the full task protocol.  Device
+        admission stays gated by ``spark.rapids.sql.concurrentGpuTasks``
+        — the semaphore is (re)sized from THIS query's conf so session
+        overrides take effect (the serial path never contends, so it
+        keeps whatever instance exists).  Results are assembled in pid
+        order; on failure the lowest-failing-pid exception propagates
+        with its original type, and not-yet-started tasks are skipped.
+
+        Thread-local seams (speculation deferral, OOM-injection arming,
+        the tracer's exec stack) stay correct by construction: pool
+        workers start with deferral OFF, so speculative paths fall back
+        to their exact variants — see docs/async_pipeline.md."""
+        from concurrent.futures import ThreadPoolExecutor
+        from ...config import CONCURRENT_TASKS
+        from ...memory.semaphore import TpuSemaphore
+        sem = TpuSemaphore.get()
+        want = max(1, int(cfg.get(CONCURRENT_TASKS)))
+        if sem.permits != want and sem.active_tasks() == 0:
+            TpuSemaphore.initialize(permits=want)
+        nparts = self.num_partitions()
+        slots: List[Optional[List[ColumnarBatch]]] = [None] * nparts
+        errors: Dict[int, BaseException] = {}
+        abort = threading.Event()
+
+        def run_task(pid: int) -> None:
+            if abort.is_set():
+                return  # a prior task failed; its exception wins
+            try:
+                slots[pid] = self._run_partition(pid, conf)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors[pid] = e
+                abort.set()
+
+        with ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="srt-task") as pool:
+            list(pool.map(run_task, range(nparts)))
+        if errors:
+            raise errors[min(errors)]
+        out: List[ColumnarBatch] = []
+        for got in slots:
+            if got:
+                out.extend(got)
         return out
 
     # --- jit plumbing for device execs ------------------------------------

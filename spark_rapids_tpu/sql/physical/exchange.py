@@ -10,6 +10,7 @@ per-partition) is identical, mirroring the reference's shuffle-manager SPI.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -54,6 +55,12 @@ class ShuffleExchangeExec(PhysicalPlan):
         #: planner passes coalescible=False for both sides
         self._coalescible = coalescible
         self._materialized: Optional[List[List[ColumnarBatch]]] = None
+        #: serializes one-shot materialization: under the parallel
+        #: partition scheduler (and prefetch producer threads) several
+        #: reduce partitions race into the first execute — a double
+        #: materialize would run the whole map side twice and double-write
+        #: shuffle blocks
+        self._mat_lock = threading.Lock()
         self._split_fn = self._jit(self._split_one, key=("split",))
         #: map-side runtime filter (bloom-filter join pushdown): applied to
         #: each map partition's merged output BEFORE the split/write, so
@@ -79,9 +86,12 @@ class ShuffleExchangeExec(PhysicalPlan):
     def _ensure_materialized(self, tctx: TaskContext):
         if self._materialized is not None:
             return
-        with _trace.span("shuffle", "exchange.materialize",
-                         partitions=self.num_partitions()):
-            self._materialize(tctx)
+        with self._mat_lock:
+            if self._materialized is not None:
+                return
+            with _trace.span("shuffle", "exchange.materialize",
+                             partitions=self.num_partitions()):
+                self._materialize(tctx)
 
     def _materialize(self, tctx: TaskContext):
         """Map side: split each child batch by target and hand the pieces to
@@ -395,6 +405,9 @@ class BroadcastExchangeExec(PhysicalPlan):
         super().__init__(child)
         self.backend = backend
         self._cached: Optional[ColumnarBatch] = None
+        #: parallel consumer partitions race into the first
+        #: broadcast_batch; the build must run exactly once
+        self._mat_lock = threading.Lock()
 
     @property
     def output(self):
@@ -404,6 +417,12 @@ class BroadcastExchangeExec(PhysicalPlan):
         return 1
 
     def broadcast_batch(self, tctx: TaskContext) -> ColumnarBatch:
+        if self._cached is not None:
+            return self._cached
+        with self._mat_lock:
+            return self._broadcast_batch_locked(tctx)
+
+    def _broadcast_batch_locked(self, tctx: TaskContext) -> ColumnarBatch:
         if self._cached is None:
             batches = []
             with _trace.span("shuffle", "broadcast.materialize"):

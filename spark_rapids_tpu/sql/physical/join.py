@@ -14,6 +14,7 @@ joins exactly like the reference (``GpuSortMergeJoinMeta.scala``).
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -72,6 +73,10 @@ class BaseJoinExec(PhysicalPlan):
         self.backend = backend
         self.how = how
         self.condition = condition
+        #: one-shot per-join setup (bloom install, AQE choice) must run
+        #: exactly once even when the parallel partition scheduler drives
+        #: several probe partitions into execute concurrently
+        self._setup_lock = threading.Lock()
         self._flipped = how == "right"
         if self._flipped:
             # right outer == left outer with sides swapped + column reorder
@@ -649,7 +654,8 @@ class ShuffledHashJoinExec(BaseJoinExec):
         tctx.inc_metric("bloomFiltersBuilt")
 
     def execute(self, pid: int, tctx: TaskContext):
-        self._maybe_install_bloom(tctx)
+        with self._setup_lock:
+            self._maybe_install_bloom(tctx)
         btctx = TaskContext(pid, tctx.conf, parent=tctx)
         with btctx.as_current():
             build_batches = list(self._build.execute(pid, btctx))
@@ -850,6 +856,7 @@ class AdaptiveJoinExec(PhysicalPlan):
         self._node = node
         self._conf = conf
         self._chosen: Optional[PhysicalPlan] = None
+        self._choose_lock = threading.Lock()
         self.chosen_strategy: Optional[str] = None
         # static shape only (output schema / explain); never executed
         self._shape = ShuffledHashJoinExec(
@@ -866,6 +873,11 @@ class AdaptiveJoinExec(PhysicalPlan):
     def _choose(self, tctx: TaskContext):
         if self._chosen is not None:
             return
+        with self._choose_lock:
+            if self._chosen is None:
+                self._choose_locked(tctx)
+
+    def _choose_locked(self, tctx: TaskContext):
         from ...config import AUTO_BROADCAST_THRESHOLD
         node, left, right = self._node, self.children[0], self.children[1]
         parts = []

@@ -29,9 +29,24 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
-#: observability for tests/metrics
+#: observability for tests/metrics.  Increments go through
+#: :func:`_bump` — with double-buffered transfers the bundled-fetch count
+#: is bumped from the transfer stager thread while the driver may be
+#: registering checks, and lost updates would break tests that assert on
+#: exact deltas.
 STATS = {"registered": 0, "bundled_fetches": 0, "mis_speculations": 0,
          "reruns": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        STATS[key] += n
+
+
+def count_bundled_fetch() -> None:
+    """A pending speculation scalar rode a result fetch (D2H transition)."""
+    _bump("bundled_fetches")
 
 
 class DeferredCheck:
@@ -51,10 +66,16 @@ class DeferredCheck:
         self.on_result = on_result
 
     def resolve(self, ng_host: int) -> None:
-        if self.ng_host is None:
+        # under double-buffered transfers two in-flight fetches can both
+        # bundle a not-yet-resolved check; first resolution wins (both
+        # carry the same device scalar, so the value is identical either
+        # way — the lock just keeps on_result to exactly one call)
+        with _STATS_LOCK:
+            if self.ng_host is not None:
+                return
             self.ng_host = int(ng_host)
             self.ng = None  # drop the device ref
-            self.on_result(self.ng_host)
+        self.on_result(self.ng_host)
 
     @property
     def failed(self) -> bool:
@@ -89,7 +110,7 @@ def register(spec: int, ng, on_result: Callable[[int], None]
              ) -> DeferredCheck:
     c = DeferredCheck(spec, ng, on_result)
     _state.pending.append(c)
-    STATS["registered"] += 1
+    _bump("registered")
     return c
 
 

@@ -5,15 +5,27 @@
 Here both backends are columnar (host = numpy, device = jnp), so transitions
 are pure buffer moves: one ``device_put`` per column upload, one fetch per
 download — no row format in the middle.
+
+With ``spark.rapids.tpu.transfer.doubleBuffer.enabled`` both transitions
+pipeline: a one-slot stager thread carries transfer N+1 while batch N is
+consumed downstream (≤ 1 transfer in flight ahead of the consumer — the
+reference's stream-overlapped copy model).  The child is pulled on the
+CALLING thread (a one-batch lookahead), so thread-local seams —
+speculation registration, OOM-injection arming — stay on the task thread;
+only the transfer itself moves to the stager.  Exceptions raised in the
+stager (device OOM, injected chaos faults) re-raise on the consumer with
+their original type via ``Future.result()``.
 """
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, List
 
 import numpy as np
 
 from ...columnar.batch import ColumnarBatch
+from ...config import TRANSFER_DOUBLE_BUFFER
 from ...observability import tracer as _trace
 from .base import CPU, TPU, PhysicalPlan, TaskContext
 
@@ -25,6 +37,32 @@ def batch_nbytes(batch: ColumnarBatch) -> int:
             if arr is not None:
                 total += arr.size * arr.dtype.itemsize
     return total
+
+
+def _staged(it, transfer, name: str):
+    """Shared double-buffer loop: pull batch N+1 from ``it`` on the
+    calling thread, dispatch its ``transfer`` on the one-slot stager,
+    THEN yield batch N's completed result — ≤ 1 transfer in flight ahead
+    of the consumer.  The stager brackets itself on the tracer's exec
+    stack so its spans attribute to the owning transition."""
+
+    def run(batch):
+        _trace.push_exec(name)
+        try:
+            return transfer(batch)
+        finally:
+            _trace.pop_exec()
+
+    with ThreadPoolExecutor(max_workers=1,
+                            thread_name_prefix=f"srt-{name}") as stager:
+        fut = None
+        for batch in it:
+            nxt = stager.submit(run, batch)
+            if fut is not None:
+                yield fut.result()
+            fut = nxt
+        if fut is not None:
+            yield fut.result()
 
 
 class HostToDeviceExec(PhysicalPlan):
@@ -42,7 +80,8 @@ class HostToDeviceExec(PhysicalPlan):
 
         from ...shims import tree_map
         from ...robustness import faults as _faults
-        for batch in self.children[0].execute(pid, tctx):
+
+        def upload(batch):
             nb = batch_nbytes(batch)
             tctx.inc_metric("h2d_bytes", nb)
             _faults.maybe_inject("transfer.h2d", exc=ConnectionError,
@@ -50,8 +89,15 @@ class HostToDeviceExec(PhysicalPlan):
             # span covers the upload dispatch only, not downstream
             # consumption of the yielded batch
             with _trace.span("h2d", "HostToDevice.upload", bytes=nb):
-                up = tree_map(jnp.asarray, batch)
-            yield up
+                return tree_map(jnp.asarray, batch)
+
+        it = self.children[0].execute(pid, tctx)
+        if bool(tctx.conf.get(TRANSFER_DOUBLE_BUFFER)):
+            tctx.inc_metric("h2dDoubleBuffered", level="DEBUG")
+            yield from _staged(it, upload, self.node_name())
+            return
+        for batch in it:
+            yield upload(batch)
 
     def node_name(self):
         return "HostToDevice"
@@ -77,20 +123,34 @@ class DeviceToHostExec(PhysicalPlan):
         # byte-packs the whole batch into ONE device->host transfer, and
         # big batches narrow on device first (columnar/prepack.py)
         fetch = guard_device_oom(prepacked_device_get)
-        for batch in self.children[0].execute(pid, tctx):
+
+        def fetch_one(batch, pending):
             tctx.inc_metric("d2h_bytes", batch_nbytes(batch))
             # bundle pending speculation scalars into the SAME pull as the
             # result — on the tunnel each separate pull is a ~65ms round
             # trip, and this one was happening anyway
-            pending = speculation.unresolved()
             if pending:
                 host_b, vals = fetch((batch, [c.ng for c in pending]))
                 for c, v in zip(pending, vals):
                     c.resolve(int(v))
-                speculation.STATS["bundled_fetches"] += 1
-                yield host_b
-            else:
-                yield fetch(batch)  # ONE concurrent D2H for all leaves
+                speculation.count_bundled_fetch()
+                return host_b
+            return fetch(batch)  # ONE concurrent D2H for all leaves
+
+        it = self.children[0].execute(pid, tctx)
+        if bool(tctx.conf.get(TRANSFER_DOUBLE_BUFFER)):
+            # the pending-check snapshot must happen on the task thread
+            # (speculation state is thread-local), so pair each batch with
+            # its checks BEFORE handing it to the stager
+            def paired():
+                for batch in it:
+                    yield batch, speculation.unresolved()
+            yield from _staged(paired(),
+                               lambda bp: fetch_one(bp[0], bp[1]),
+                               self.node_name())
+            return
+        for batch in it:
+            yield fetch_one(batch, speculation.unresolved())
 
     def node_name(self):
         return "DeviceToHost"
@@ -116,6 +176,7 @@ class CoalesceBatchesExec(PhysicalPlan):
         pending: List[ColumnarBatch] = []
         rows = 0
         nbytes = 0
+        emitted = False
         for batch in self.children[0].execute(pid, tctx):
             n = batch.num_rows_int
             if n == 0:
@@ -124,9 +185,22 @@ class CoalesceBatchesExec(PhysicalPlan):
             rows += n
             nbytes += batch_nbytes(batch)
             if rows >= self.target_rows or nbytes >= self.target_bytes:
+                emitted = True
                 yield (ColumnarBatch.concat(pending) if len(pending) > 1
                        else pending[0])
                 pending, rows, nbytes = [], 0, 0
         if pending:
             yield (ColumnarBatch.concat(pending) if len(pending) > 1
                    else pending[0])
+        elif not emitted:
+            # every input batch was empty (or the child yielded nothing):
+            # emit ONE empty batch with the correct schema instead of a
+            # zero-batch partition — downstream execs (and the
+            # committed-block tracking of the resilient shuffle fetch)
+            # must be able to tell "empty partition" from "lost block"
+            from .exchange import empty_batch_for
+            empty = empty_batch_for(self.output)
+            if self.backend == CPU:
+                import jax
+                empty = jax.device_get(empty)
+            yield empty

@@ -57,6 +57,13 @@ class Planner:
         if bool(self.conf.get(FUSION_ENABLED)):
             from .physical.collect_fusion import fuse_collect_tail
             phys = fuse_collect_tail(phys)
+        # async prefetch boundaries go in LAST (after fuse_stages and the
+        # collect-tail fusion) so the fusion passes pattern-match the
+        # unwrapped tree; see sql/physical/async_exec.py for the seams
+        from ..config import PREFETCH_ENABLED
+        if bool(self.conf.get(PREFETCH_ENABLED)):
+            from .physical.async_exec import insert_prefetch
+            phys = insert_prefetch(phys, self.conf)
         return phys
 
     # ------------------------------------------------------------------

@@ -212,7 +212,13 @@ class TpuSession:
         phys = planner.plan_for_collect(logical)
         # collect has no side effects, so speculative results may be
         # validated AFTER the fetch (zero extra pulls); a mis-speculation
-        # recorded the corrected group-table size — re-plan and re-run
+        # recorded the corrected group-table size — re-plan and re-run.
+        # Deferral is THREAD-local: under the pipelined execution layer
+        # (task.parallelism > 1 / prefetch producer threads) work running
+        # off this thread sees deferral OFF and takes the exact paths, so
+        # the drain below only ever validates driver-thread speculation —
+        # correctness never depends on cross-thread check handoff
+        # (docs/async_pipeline.md).
         speculation.clear()
         try:
             oom_retried = False
@@ -248,8 +254,8 @@ class TpuSession:
                 if not bad or attempt >= 2:
                     break
                 attempt += 1
-                speculation.STATS["mis_speculations"] += len(bad)
-                speculation.STATS["reruns"] += 1
+                speculation._bump("mis_speculations", len(bad))
+                speculation._bump("reruns")
                 phys = planner.plan_for_collect(logical)
         finally:
             speculation.set_deferral(False)

@@ -102,12 +102,20 @@ def run_soak(rows: int = 20_000, seed: int = 11,
              sites: str = DEFAULT_SITES,
              queries: Optional[List[str]] = None,
              trace_path: Optional[str] = None,
-             strict: bool = True) -> dict:
+             strict: bool = True,
+             pipeline: bool = False) -> dict:
     """Returns the soak report; raises AssertionError on any parity or
     counter-visibility failure.  ``strict=False`` (reduced smoke runs)
     keeps the bit-parity and faults-injected asserts but skips the
     per-site coverage floor (small row counts may not traverse every
-    armed site)."""
+    armed site).
+
+    ``pipeline=True`` runs the CHAOS session under the async execution
+    layer (task.parallelism=4 + prefetch queues + double-buffered
+    transfers, concurrentGpuTasks left at 1 so semaphore contention —
+    ``sem_wait`` spans — is guaranteed) while the clean run stays serial:
+    injected faults must recover bit-identically even when they surface
+    on prefetch producer / transfer stager / pool worker threads."""
     import spark_rapids_tpu as srt
     from ..config import RapidsConf
     from ..memory.spill import BufferCatalog
@@ -145,6 +153,17 @@ def run_soak(rows: int = 20_000, seed: int = 11,
             "spark.rapids.tpu.chaos.sites": sites,
             "spark.rapids.tpu.shuffle.fetch.backoffMs": 1,
         })
+        if pipeline:
+            chaos_conf.update({
+                "spark.rapids.tpu.task.parallelism": 4,
+                "spark.rapids.tpu.prefetch.enabled": True,
+                "spark.rapids.tpu.prefetch.depth": 2,
+                "spark.rapids.tpu.transfer.doubleBuffer.enabled": True,
+                # permits intentionally BELOW the pool width: the soak
+                # doubles as the sem_wait-span source for CI's
+                # check_trace --require-cat sem_wait validation
+                "spark.rapids.sql.concurrentGpuTasks": 1,
+            })
         if trace_path:
             chaos_conf["spark.rapids.tpu.profile.enabled"] = True
         chaos_sess = srt.session(conf=RapidsConf.get_global().copy(
@@ -180,6 +199,7 @@ def run_soak(rows: int = 20_000, seed: int = 11,
 
         report = {
             "rows": rows, "seed": seed, "sites": sites,
+            "pipeline": pipeline,
             "queries": per_query, "counters": counters,
             "faults_by_site": by_site,
             "bit_identical": not mismatches,
@@ -216,6 +236,15 @@ def main() -> None:
     argv = sys.argv[1:]
     trace_path = None
     seed = 11
+    pipeline = False
+    if "--pipeline" in argv:
+        # pipelined soak: chaos session under parallelism=4 + prefetch +
+        # double-buffered transfers vs the SERIAL clean run.  The
+        # per-site coverage floor is owned by the serial soak (ordinal
+        # assignment shifts with thread interleaving), so this leg runs
+        # strict=False — bit-parity and fault-visibility asserts remain.
+        pipeline = True
+        argv.remove("--pipeline")
     if "--trace" in argv:
         i = argv.index("--trace")
         trace_path = argv[i + 1]
@@ -225,9 +254,11 @@ def main() -> None:
         seed = int(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
     rows = int(argv[0]) if argv else 20_000
-    report = run_soak(rows, seed=seed, trace_path=trace_path)
+    report = run_soak(rows, seed=seed, trace_path=trace_path,
+                      strict=not pipeline, pipeline=pipeline)
     print(json.dumps(report, indent=2))
-    print("CHAOS SOAK PASSED: results bit-identical under "
+    mode = "pipelined " if pipeline else ""
+    print(f"CHAOS SOAK PASSED: {mode}results bit-identical under "
           f"{report['counters']['faultsInjected']} injected faults")
 
 

@@ -45,6 +45,10 @@ QUICK_MODULES = {
     # both jax ShimProviders exercised end-to-end every CI run — the
     # parallel-world guarantee (VERDICT r3 #8)
     "test_shims",
+    # pipelined async execution (ISSUE 5): scheduler/prefetch/transfer
+    # bit-parity and exception propagation are tier-1 — a silent
+    # ordering or queue-hang regression must surface in the quick gate
+    "test_async_pipeline",
 }
 
 

@@ -1,0 +1,215 @@
+"""Pipelined async execution (ISSUE 5): bit-parity across the scheduler /
+prefetch / double-buffer matrix, exception propagation through prefetch
+queues, tracer visibility (sem_wait + queue-depth), the CoalesceBatches
+empty-partition contract, and plan-shape reversion when disabled."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.sql import functions as F
+
+
+ROWS = 4000
+
+
+@pytest.fixture(scope="module")
+def tables():
+    from spark_rapids_tpu.testing.scaletest import build_tables
+    return build_tables(ROWS)
+
+
+def _suite(sess, tables):
+    """The TPC-H-ish multi-partition suite (the chaos soak's queries),
+    canonicalized for exact comparison."""
+    from spark_rapids_tpu.testing.pipeline import run_suite
+    return run_suite(sess, tables)
+
+
+def _sess(**overrides):
+    base = {"spark.rapids.sql.autoBroadcastJoinThreshold": 1}
+    base.update(overrides)
+    return srt.session(conf=RapidsConf.get_global().copy(base))
+
+
+def test_bit_parity_matrix(tables):
+    """parallelism {1,4} x prefetch on/off x double-buffer on/off: every
+    combination returns BIT-identical results to the serial engine."""
+    serial = _suite(_sess(), tables)
+    for par in (1, 4):
+        for prefetch in (False, True):
+            for dbl in (False, True):
+                if par == 1 and not prefetch and not dbl:
+                    continue  # that's the baseline itself
+                sess = _sess(**{
+                    "spark.rapids.tpu.task.parallelism": par,
+                    "spark.rapids.tpu.prefetch.enabled": prefetch,
+                    "spark.rapids.tpu.transfer.doubleBuffer.enabled": dbl,
+                })
+                got = _suite(sess, tables)
+                for name, frame in serial.items():
+                    pd.testing.assert_frame_equal(
+                        got[name], frame, check_exact=True), \
+                        (par, prefetch, dbl, name)
+
+
+def test_prefetch_preserves_exception_type(tables):
+    """A chaos shuffle.fetch fault below a prefetch queue surfaces to the
+    caller as ShuffleFetchFailed — original type, no queue hang."""
+    from spark_rapids_tpu.shuffle import ShuffleFetchFailed
+    sess = _sess(**{
+        "spark.rapids.tpu.task.parallelism": 4,
+        "spark.rapids.tpu.prefetch.enabled": True,
+        "spark.rapids.tpu.transfer.doubleBuffer.enabled": True,
+        # fetches must actually traverse the fetch path, and every
+        # traversal (including recompute re-reads) must fail fast
+        "spark.rapids.shuffle.localDeviceResident.enabled": False,
+        "spark.rapids.tpu.chaos.enabled": True,
+        "spark.rapids.tpu.chaos.seed": 3,
+        "spark.rapids.tpu.chaos.sites": "shuffle.fetch:1.0",
+        "spark.rapids.tpu.shuffle.fetch.maxRetries": 1,
+        "spark.rapids.tpu.shuffle.fetch.backoffMs": 1,
+        "spark.rapids.tpu.shuffle.fetch.deadlineMs": 400,
+    })
+    df = sess.create_dataframe(tables["fact"], num_partitions=4)
+    q = df.groupBy("q").agg(F.sum(F.col("v")).alias("sv")).orderBy("q")
+    with pytest.raises(ShuffleFetchFailed):
+        q.collect()
+
+
+def test_injected_oom_recovers_under_pipeline(tables):
+    """memory.oom.retry faults injected while the pipeline is on still
+    ride the spill-and-retry protocol to a correct answer."""
+    clean = _suite(_sess(), tables)
+    sess = _sess(**{
+        "spark.rapids.tpu.task.parallelism": 4,
+        "spark.rapids.tpu.prefetch.enabled": True,
+        "spark.rapids.tpu.chaos.enabled": True,
+        "spark.rapids.tpu.chaos.seed": 5,
+        "spark.rapids.tpu.chaos.sites": "memory.oom.retry:0.2",
+    })
+    got = _suite(sess, tables)
+    for name, frame in clean.items():
+        pd.testing.assert_frame_equal(got[name], frame, check_exact=True)
+
+
+def test_tracer_sem_wait_and_queue_metrics(tables):
+    """With the pipeline on and tracing enabled, the trace carries
+    sem_wait spans (pool contention on the 1-permit semaphore) and
+    queue-wait spans, and last_query_metrics carries the prefetch
+    queue counters."""
+    sess = _sess(**{
+        "spark.rapids.tpu.task.parallelism": 4,
+        "spark.rapids.sql.concurrentGpuTasks": 1,
+        "spark.rapids.tpu.prefetch.enabled": True,
+        "spark.rapids.tpu.profile.enabled": True,
+    })
+    df = sess.create_dataframe(tables["fact"], num_partitions=4)
+    (df.groupBy("q").agg(F.sum(F.col("v")).alias("sv"))
+       .orderBy("q").collect())
+    m = sess.last_query_metrics
+    assert m.get("prefetchBatches", 0) > 0, m
+    assert "prefetchWaitMs" in m, m
+    assert m.get("semaphoreWaitTime", 0) > 0, m
+    events = sess._last_trace_events
+    cats = {e["cat"] for e in events}
+    assert "sem_wait" in cats, cats
+    assert "queue" in cats, cats
+    # queue spans carry the observed depth for the report
+    qev = [e for e in events if e["cat"] == "queue"]
+    assert all("depth" in e.get("args", {}) for e in qev), qev[:3]
+    # the summary still builds with the new category present
+    assert sess.last_query_trace_summary is not None
+
+
+def test_double_buffer_transfer_accounting(tables):
+    """Double-buffered transitions move the same bytes as the serial
+    path — the stager changes WHEN transfers run, not what they carry."""
+    q = lambda s: (s.create_dataframe(tables["fact"], num_partitions=2)
+                   .filter(F.col("q") < 50)
+                   .select("q", "v").collect())
+    s1 = _sess()
+    q(s1)
+    m1 = s1.last_query_metrics
+    s2 = _sess(**{"spark.rapids.tpu.transfer.doubleBuffer.enabled": True})
+    q(s2)
+    m2 = s2.last_query_metrics
+    assert m2.get("d2h_bytes") == m1.get("d2h_bytes"), (m1, m2)
+    assert m2.get("h2d_bytes") == m1.get("h2d_bytes"), (m1, m2)
+
+
+def test_prefetch_off_keeps_plan_shape(tables):
+    """Defaults revert to today's behavior: no AsyncPrefetch nodes in the
+    plan unless the conf enables them."""
+    sess_off = _sess()
+    df = sess_off.create_dataframe(tables["fact"], num_partitions=2)
+    q = df.groupBy("q").agg(F.count("*").alias("c"))
+    assert "AsyncPrefetch" not in sess_off.physical_plan(q).tree_string()
+    sess_on = _sess(**{"spark.rapids.tpu.prefetch.enabled": True})
+    df2 = sess_on.create_dataframe(tables["fact"], num_partitions=2)
+    q2 = df2.groupBy("q").agg(F.count("*").alias("c"))
+    assert "AsyncPrefetch" in sess_on.physical_plan(q2).tree_string()
+
+
+def test_prefetch_early_close_cancels_producer(tables):
+    """An early-closed consumer (limit) cancels the producer thread
+    instead of leaving it blocked on a full queue."""
+    import threading
+    before = {t.name for t in threading.enumerate()}
+    sess = _sess(**{"spark.rapids.tpu.prefetch.enabled": True,
+                    "spark.rapids.tpu.prefetch.depth": 1})
+    df = sess.create_dataframe(tables["fact"], num_partitions=4)
+    out = df.select("q", "v").limit(5).collect()
+    assert out.num_rows == 5
+    import time
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("srt-prefetch")
+                  and t.name not in before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, leaked
+
+
+def test_coalesce_empty_input_yields_schema_batch():
+    """CoalesceBatchesExec over an all-empty partition emits ONE empty
+    batch with the correct schema instead of a zero-batch partition
+    (indistinguishable from a lost block post-PR4)."""
+    from spark_rapids_tpu.sql.physical.base import TaskContext
+    from spark_rapids_tpu.sql.physical.basic import InMemoryScanExec
+    from spark_rapids_tpu.sql.physical.transitions import (
+        CoalesceBatchesExec)
+    from spark_rapids_tpu.sql.expressions.core import AttributeReference
+    from spark_rapids_tpu import types as T
+    empty = pa.table({"a": pa.array([], type=pa.int64()),
+                      "b": pa.array([], type=pa.float64())})
+    attrs = [AttributeReference("a", T.LONG, True),
+             AttributeReference("b", T.DOUBLE, True)]
+    scan = InMemoryScanExec(attrs, [empty])
+    co = CoalesceBatchesExec(scan)
+    tctx = TaskContext(0, RapidsConf.get_global())
+    out = list(co.execute(0, tctx))
+    assert len(out) == 1
+    assert out[0].num_rows_int == 0
+    assert list(out[0].names) == ["a", "b"]
+    # non-empty inputs are untouched by the fix
+    full = pa.table({"a": [1, 2], "b": [0.5, 0.25]})
+    scan2 = InMemoryScanExec(attrs, [full])
+    out2 = list(CoalesceBatchesExec(scan2).execute(0, tctx))
+    assert sum(b.num_rows_int for b in out2) == 2
+
+
+def test_parallel_scheduler_preserves_partition_order(tables):
+    """Cross-partition result order matches the serial engine even when
+    partitions complete out of order (execute_all assembles by pid)."""
+    serial = _sess().create_dataframe(
+        tables["fact"], num_partitions=4).select("k", "v").collect()
+    par = _sess(**{"spark.rapids.tpu.task.parallelism": 4}) \
+        .create_dataframe(tables["fact"], num_partitions=4) \
+        .select("k", "v").collect()
+    assert serial.equals(par)

@@ -33,6 +33,19 @@ def forced_shim(request):
         shims._active = old
 
 
+def _shard_map_or_skip(provider):
+    """The provider's shard_map entry point, or skip — the same
+    availability skip tests/test_shuffle.py uses: the installed jax may
+    not expose the FORCED provider's entry point (e.g. jax 0.4.x has no
+    top-level ``jax.shard_map`` for JaxModernShim), and tier-1 must be
+    green-or-skip on such environments."""
+    try:
+        return provider.shard_map()
+    except (ImportError, AttributeError):
+        pytest.skip("this provider's shard_map entry point is "
+                    "unavailable in this environment")
+
+
 def test_provider_probing_matches_versions():
     assert shims.JaxModernShim.matches((0, 6, 0))
     assert shims.JaxModernShim.matches((0, 9, 0))
@@ -57,7 +70,7 @@ def test_both_providers_supply_working_apis(forced_shim):
     assert len(leaves) == 2
     back = shims.tree_unflatten(treedef, leaves)
     assert np.array_equal(back["a"], tree["a"])
-    assert callable(s.shard_map())
+    assert callable(_shard_map_or_skip(s))
 
 
 def test_engine_query_end_to_end_under_each_provider(forced_shim):
@@ -93,12 +106,12 @@ def test_mesh_shard_map_under_each_provider(forced_shim):
     if len(jax.devices()) < 2:
         pytest.skip("needs the multi-device CPU mesh")
     from spark_rapids_tpu.parallel.mesh import device_mesh
-    from spark_rapids_tpu.shims import shard_map as get_sm
+    from spark_rapids_tpu.shims import get_shim
     from jax.sharding import PartitionSpec as P
     mesh = device_mesh(len(jax.devices()))
     if mesh is None:
         pytest.skip("no mesh available")
-    sm = get_sm()
+    sm = _shard_map_or_skip(get_shim())
     import jax.numpy as jnp
 
     def body(x):
