@@ -446,6 +446,83 @@ def _measure_join(rows: int, resident: bool = True,
     return out
 
 
+def _measure_encoded_vs_raw(rows: int) -> dict:
+    """Encoded columnar execution proof (docs/encoded_columns.md): each
+    shape runs encoded-ON and encoded-OFF over identical data on the
+    serializing shuffle plane (resident tier off, so wire bytes exist),
+    banking bytes-on-wire and GB/s/chip per shape plus the wire
+    reduction and a bit-parity flag.  The join shape is STRING-keyed on
+    purpose: probing on integer codes instead of padded byte matrices is
+    the fix aimed at the weakest measured shape (BENCH_r05 join 0.027x
+    baseline)."""
+    import pyarrow as pa
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.sql import functions as F
+
+    rng = np.random.default_rng(21)
+    cats = [f"cat_{i:03d}" for i in range(24)]
+    fact = pa.table({
+        "k": [cats[i] for i in rng.integers(0, 24, rows)],
+        "q": rng.integers(0, 100, rows),
+        "v": rng.random(rows)})
+    dim = pa.table({"k": cats, "w": np.arange(24.0)})
+    n_bytes = fact.nbytes + dim.nbytes
+
+    def mk(sess, shape):
+        f = sess.create_dataframe(fact, num_partitions=4)
+        d = sess.create_dataframe(dim, num_partitions=2)
+        if shape == "agg":
+            return (f.groupBy("k")
+                    .agg(F.sum(F.col("v")).alias("sv"),
+                         F.count("*").alias("c")).orderBy("k"))
+        if shape == "filter_agg":
+            return (f.filter(F.col("k") <= "cat_011").groupBy("k")
+                    .agg(F.sum(F.col("q")).alias("sq")).orderBy("k"))
+        return (f.join(d, on="k", how="inner").groupBy("k")
+                .agg(F.count("*").alias("n"),
+                     F.sum(F.col("v")).alias("sv")).orderBy("k"))
+
+    out: dict = {}
+    for shape in ("agg", "filter_agg", "join"):
+        per = {}
+        results = {}
+        for enc in (True, False):
+            conf = RapidsConf.get_global().copy({
+                "spark.rapids.tpu.sql.encoded.enabled": enc,
+                _RESIDENT_KEY: "false",
+                "spark.rapids.sql.autoBroadcastJoinThreshold": 1,
+            })
+            sess = srt.session(conf=conf)
+            q = mk(sess, shape)
+            got = q.collect()  # warm-up: compiles + upload cache
+            times = []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                got = q.collect()
+                times.append(time.perf_counter() - t0)
+            el = min(times)
+            m = sess.last_query_metrics
+            tag = "encoded" if enc else "raw"
+            per[tag] = {
+                "rows_per_sec": round(rows / el),
+                "gb_per_s_per_chip": _gb_per_s(n_bytes, el),
+                "bytes_on_wire": int(m.get("shuffleBytesOnWire", 0)),
+                "encoded_bytes_saved": int(
+                    m.get("shuffleEncodedBytesSaved", 0)),
+            }
+            results[tag] = got.to_pylist()
+        rec = {"encoded": per["encoded"], "raw": per["raw"],
+               "parity": results["encoded"] == results["raw"],
+               "rows": rows}
+        raw_wire = per["raw"]["bytes_on_wire"]
+        if raw_wire:
+            rec["wire_reduction"] = round(
+                1 - per["encoded"]["bytes_on_wire"] / raw_wire, 4)
+        out[shape] = rec
+    return {"encoded_vs_raw": out}
+
+
 def _measure_window(rows: int, resident: bool = True) -> dict:
     """Window-heavy shape: per-key running sum + global reduction."""
     import pandas as pd
@@ -741,6 +818,10 @@ def child_main(mode: str) -> None:
         ("join", lambda: _measure_join(join_rows)),
         ("window", lambda: _measure_window(window_rows)),
         ("sort", lambda: _measure_sort(min(ROWS, 2_000_000))),
+        # encoded-vs-raw (ISSUE 6 acceptance): bytes-on-wire + GB/s/chip
+        # per shape, both representations, on the serializing plane
+        ("encoded",
+         lambda: _measure_encoded_vs_raw(min(ROWS // 4, 1_000_000))),
         # forced shuffle join: the shape the resident tier serves —
         # the default join may broadcast its small dim side
         ("join_shuffle",

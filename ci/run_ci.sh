@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/11] native libraries ==="
+echo "=== [1/12] native libraries ==="
 make -C native
 
-echo "=== [2/11] API contract validation ==="
+echo "=== [2/12] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/11] docgen drift check ==="
+echo "=== [3/12] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/11] traced query + chrome-trace schema check ==="
+echo "=== [4/12] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,7 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/11] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [5/12] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -64,7 +64,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [6/11] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [6/12] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -78,7 +78,27 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [7/11] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [7/12] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+# Encoded columnar execution (docs/encoded_columns.md) under seeded
+# faults AND the async pipeline matrix: the chaos session keeps
+# dictionary/RLE columns encoded through filters/joins/group-bys and
+# the shuffle wire while running parallelism=4 + prefetch queues +
+# double-buffered transfers; the clean reference run stays RAW and
+# serial — results must be bit-identical, proving encoded frames
+# (narrowed codes + dictionaries/refs) survive fetch retries, destroyed
+# blocks, and lost-block recompute on pool/prefetch threads.  The
+# exported trace must carry `encode` spans (scan-side dictionary
+# encodes).  A second short SERIAL encoded soak covers the
+# pipeline-off leg of the matrix.
+SRT_ENC_TRACE=$(mktemp -d)/encoded_trace.json
+JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
+    20000 --seed 11 --encoded --pipeline --trace "$SRT_ENC_TRACE"
+timeout 60 python tools/check_trace.py --require-cat encode \
+    "$SRT_ENC_TRACE"
+JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
+    8000 --seed 11 --encoded
+
+echo "=== [8/12] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -99,14 +119,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [8/11] scale rig ==="
+    echo "=== [9/12] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [8/11] scale rig skipped (quick) ==="
+    echo "=== [9/12] scale rig skipped (quick) ==="
 fi
 
-echo "=== [9/11] packaging: wheel builds and installs ==="
+echo "=== [10/12] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -136,17 +156,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [10/11] driver entry checks ==="
+echo "=== [11/12] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [11/11] second-jax shim world skipped (quick) ==="
+    echo "=== [12/12] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [11/11] second-jax shim world (gated) ==="
+echo "=== [12/12] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

@@ -199,6 +199,14 @@ class ColumnarBatch:
 def _concat_columns(cols: Sequence[DeviceColumn], counts: Sequence[int],
                     out_capacity: int) -> DeviceColumn:
     from .column import DeviceColumn as DC
+    from .encoded import DictEncodedColumn, try_concat_dict_columns
+    if any(isinstance(c, DictEncodedColumn) for c in cols):
+        if all(isinstance(c, DictEncodedColumn) for c in cols):
+            enc = try_concat_dict_columns(cols, counts, out_capacity)
+            if enc is not None:
+                return enc
+        # mixed / over-budget: fall through — the .data/.lengths property
+        # accesses below materialize the encoded pieces (decline path)
     dtype = cols[0].dtype
     if cols[0].is_array_like:
         # align slot widths, then concat children at width-scaled counts

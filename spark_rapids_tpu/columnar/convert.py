@@ -363,20 +363,20 @@ def split_for_upload(table: pa.Table, conf=None) -> list:
     return split_ragged_strings(table, thr) if thr > 0 else [table]
 
 
-def arrow_to_device(table: pa.Table, capacity: Optional[int] = None
-                    ) -> ColumnarBatch:
+def arrow_to_device(table: pa.Table, capacity: Optional[int] = None,
+                    conf=None) -> ColumnarBatch:
     from ..robustness import faults as _faults
     n = table.num_rows
     cap = capacity or bucket_capacity(n)
     _faults.maybe_inject("transfer.h2d", exc=ConnectionError,
                          bytes=table.nbytes)
     with _trace.span("h2d", "arrow_to_device", bytes=table.nbytes, rows=n):
-        cols = [arrow_to_device_column(table.column(i), cap)
+        cols = [arrow_to_device_column(table.column(i), cap, conf=conf)
                 for i in range(table.num_columns)]
         return ColumnarBatch.make(table.column_names, cols, n)
 
 
-def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:
+def arrow_to_device_column(arr, capacity: int, conf=None) -> DeviceColumn:
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     dtype = T.from_arrow(arr.type)
@@ -390,14 +390,24 @@ def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:
         return null_column(dtype, capacity).with_validity(validity)
 
     if isinstance(dtype, (T.ArrayType, T.MapType)):
-        return _list_to_device(arr, dtype, capacity, validity, n)
+        return _list_to_device(arr, dtype, capacity, validity, n, conf=conf)
 
     if isinstance(dtype, T.StructType):
-        children = tuple(arrow_to_device_column(arr.field(i), capacity)
+        children = tuple(arrow_to_device_column(arr.field(i), capacity,
+                                                conf=conf)
                          for i in range(arr.type.num_fields))
         return DeviceColumn(dtype, None, validity, children=children)
 
     if is_string_like(dtype):
+        # scan-side encoded retention: low-cardinality strings stay as
+        # codes + dictionary (columnar/encoded.py) instead of eagerly
+        # materializing the padded byte matrix — the decline path falls
+        # through to the raw layout below
+        from .encoded import enabled as _enc_on, encode_string_arrow
+        if _enc_on(conf):
+            enc = encode_string_arrow(arr, dtype, capacity, conf=conf)
+            if enc is not None:
+                return enc
         chars, lengths = _strings_to_matrix(arr, capacity)
         return DeviceColumn(dtype, jnp.asarray(chars), validity,
                             lengths=jnp.asarray(lengths))
@@ -411,10 +421,16 @@ def arrow_to_device_column(arr, capacity: int) -> DeviceColumn:
     out = np.zeros(capacity, dtype=dtype.np_dtype)
     out[:n] = np_data
     out[:n][~valid_np[:n]] = 0  # dead data zeroed for deterministic kernels
+    if np.dtype(dtype.np_dtype).kind in ("i", "u"):
+        from .encoded import enabled as _enc_on, encode_rle_numpy
+        if _enc_on(conf):
+            rle = encode_rle_numpy(dtype, out, valid_np, n, capacity)
+            if rle is not None:
+                return rle
     return DeviceColumn(dtype, jnp.asarray(out), validity)
 
 
-def _list_to_device(arr, dtype, capacity: int, validity, n: int
+def _list_to_device(arr, dtype, capacity: int, validity, n: int, conf=None
                     ) -> DeviceColumn:
     """Arrow List/Map -> padded row-block layout: child element r*w+j is
     slot j of row r; slots past the row's length are dead."""
@@ -447,7 +463,7 @@ def _list_to_device(arr, dtype, capacity: int, validity, n: int
         if isinstance(ch, pa.ChunkedArray):
             ch = ch.combine_chunks()
         children.append(arrow_to_device_column(pc.take(ch, idx),
-                                               capacity * width))
+                                               capacity * width, conf=conf))
     lengths = np.zeros(capacity, dtype=np.int32)
     lengths[:n] = lengths_np
     return make_array_column(dtype, jnp.asarray(lengths), tuple(children),

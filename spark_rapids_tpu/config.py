@@ -201,6 +201,61 @@ D2H_PACK_F64 = register(
     "in the f32-denormal range loses those low bits (device arithmetic "
     "flushes them identically).  Set false to fetch f64 columns with "
     "full storage fidelity at one extra transfer round trip each.", True)
+# --- encoded columnar execution (docs/encoded_columns.md) ------------------
+ENCODED_ENABLED = register(
+    "spark.rapids.tpu.sql.encoded.enabled",
+    "Keep dictionary/RLE-encoded columns encoded THROUGH the engine "
+    "instead of materializing at the scan: filters evaluate predicates "
+    "on the dictionary, joins probe on integer codes, group-bys/sorts "
+    "run on codes, and the shuffle serializer ships narrowed codes with "
+    "each dictionary sent once per batch (or once per exchange via the "
+    "ref cache).  This is the structural kill switch: off means no "
+    "encoded column is ever created, so every plan takes the raw path.",
+    True, commonly_used=True)
+ENCODED_MAX_CARDINALITY = register(
+    "spark.rapids.tpu.sql.encoded.maxDictionaryCardinality",
+    "Columns with more distinct values than this decline dictionary "
+    "encoding at the scan (and dictionary unification declines at "
+    "concat).  Encoding also declines when distinct values exceed half "
+    "the rows.", 4096)
+ENCODED_FILTER_ENABLED = register(
+    "spark.rapids.tpu.sql.encoded.filter.enabled",
+    "Evaluate eligible single-column filter predicates once over the "
+    "dictionary (plus its null slot) and select rows by code lookup "
+    "instead of evaluating on every row.  Read at kernel-trace time.",
+    True)
+ENCODED_JOIN_ENABLED = register(
+    "spark.rapids.tpu.sql.encoded.join.enabled",
+    "Lower equi-join keys whose both sides are dictionary-encoded into "
+    "the build side's integer code space (probe codes remapped on the "
+    "host via the dictionary registry) so the join sorts/searches int32 "
+    "codes instead of padded string matrices.", True)
+ENCODED_AGG_SORT_ENABLED = register(
+    "spark.rapids.tpu.sql.encoded.aggSort.enabled",
+    "Group and sort dictionary-encoded columns by their integer codes "
+    "(sorted dictionaries make code order == value order).  Read at "
+    "kernel-trace time.", True)
+ENCODED_SHUFFLE_ENABLED = register(
+    "spark.rapids.tpu.sql.encoded.shuffle.enabled",
+    "Ship encoded columns over the shuffle/broadcast wire as narrowed "
+    "codes + dictionary (the encoded-batch wire format, frame version "
+    "2) instead of materialized value buffers.", True)
+ENCODED_SHUFFLE_DICT_REFS = register(
+    "spark.rapids.tpu.sql.encoded.shuffle.dictRefs.enabled",
+    "Replace repeated dictionaries in shuffle frames with a content-hash "
+    "reference resolved from the in-process dictionary registry, so "
+    "repeated batches of one exchange pay only code bytes.  Automatically "
+    "bypassed (inline dictionaries) on multi-slice topologies where "
+    "frames cross process boundaries.", True)
+
+#: per-op opt-out lookup used by columnar.encoded.op_enabled
+ENCODED_OP_CONFS = {
+    "filter": ENCODED_FILTER_ENABLED,
+    "join": ENCODED_JOIN_ENABLED,
+    "aggsort": ENCODED_AGG_SORT_ENABLED,
+    "shuffle": ENCODED_SHUFFLE_ENABLED,
+}
+
 OOM_SYNC_WATERMARK = register(
     "spark.rapids.memory.oom.syncWatermark",
     "Accounted-pool usage fraction above which syncMode=auto blocks "

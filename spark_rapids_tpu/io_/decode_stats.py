@@ -1,0 +1,88 @@
+"""Device-decode engagement counters (VERDICT round 5, Weak #7).
+
+Every device decoder (parquet/ORC/CSV/JSON/Avro) either ENGAGES a file
+(builds device columns straight from raw bytes) or DECLINES it to the
+host pyarrow path.  The decline is silent by design (correctness first),
+which made the engagement *rate* unobservable — a regression that
+declined every file would still pass every test.  This module is the
+shared scoreboard: files/bytes engaged vs declined per format, with a
+per-reason decline breakdown, surfaced per query in
+``last_query_metrics`` (``<fmt>DecodeFilesEngaged`` / ``…Declined`` /
+``…BytesEngaged`` / ``…BytesDeclined``) and in the scale-rig report.
+
+Decoders that know WHY they declined call :func:`set_decline_reason`
+just before returning None; the exec layer folds it into the per-reason
+map (default reason: ``decoder-declined``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+FORMATS = ("parquet", "orc", "csv", "json", "avro")
+
+#: per-format counters; decline_reasons maps reason -> file count
+DECODE_STATS: Dict[str, dict] = {
+    fmt: {"files_engaged": 0, "files_declined": 0,
+          "bytes_engaged": 0, "bytes_declined": 0,
+          "decline_reasons": {}}
+    for fmt in FORMATS}
+
+_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+def set_decline_reason(reason: str) -> None:
+    """Record the reason for the decline this thread is about to report
+    (consumed once by the next :func:`record_declined`)."""
+    _TLS.reason = reason
+
+
+def _take_reason(default: str) -> str:
+    r = getattr(_TLS, "reason", None)
+    _TLS.reason = None
+    return r or default
+
+
+def record_engaged(fmt: str, nbytes: int = 0) -> None:
+    _TLS.reason = None  # stale hints must not leak into a later decline
+    if fmt not in DECODE_STATS:
+        return
+    with _LOCK:
+        s = DECODE_STATS[fmt]
+        s["files_engaged"] += 1
+        s["bytes_engaged"] += int(nbytes)
+
+
+def record_declined(fmt: str, nbytes: int = 0,
+                    reason: Optional[str] = None) -> None:
+    if fmt not in DECODE_STATS:
+        return
+    reason = reason or _take_reason("decoder-declined")
+    with _LOCK:
+        s = DECODE_STATS[fmt]
+        s["files_declined"] += 1
+        s["bytes_declined"] += int(nbytes)
+        s["decline_reasons"][reason] = \
+            s["decline_reasons"].get(reason, 0) + 1
+
+
+def snapshot() -> Dict[str, float]:
+    """Flat counter snapshot (reasons excluded) — the per-query metrics
+    delta base, mirroring robustness.stats_snapshot."""
+    out: Dict[str, float] = {}
+    with _LOCK:
+        for fmt, s in DECODE_STATS.items():
+            out[f"{fmt}DecodeFilesEngaged"] = s["files_engaged"]
+            out[f"{fmt}DecodeFilesDeclined"] = s["files_declined"]
+            out[f"{fmt}DecodeBytesEngaged"] = s["bytes_engaged"]
+            out[f"{fmt}DecodeBytesDeclined"] = s["bytes_declined"]
+    return out
+
+
+def report() -> Dict[str, dict]:
+    """Deep copy for human-facing reports (scale rig, bench artifacts)."""
+    with _LOCK:
+        return {fmt: {**s, "decline_reasons": dict(s["decline_reasons"])}
+                for fmt, s in DECODE_STATS.items()}

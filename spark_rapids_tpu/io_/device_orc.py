@@ -868,7 +868,7 @@ def _collect_stripe(plan: _ColPlan, kind: int, enc: int, dict_size: int,
 
 
 def _finish_column(plan: _ColPlan, kind: int, dtype, n_rows: int,
-                   capacity: int, max_str_bytes: int):
+                   capacity: int, max_str_bytes: int, conf=None):
     from ..columnar.column import DeviceColumn, bucket_width
     valid = _validity_device(plan, n_rows, capacity)
     n_dense = plan.total_nonnull
@@ -937,6 +937,16 @@ def _finish_column(plan: _ColPlan, kind: int, dtype, n_rows: int,
         s = jnp.clip(jnp.searchsorted(db, j, side="right") - 1,
                      0, db.shape[0] - 1)
         gidx = idx + ob[s]
+        # encoded scan retention: keep the (single-stripe, or identical-
+        # across-stripes) ORC dictionary as codes+dict; repeated values
+        # across stripe dictionaries make the helper decline -> gather
+        from ..columnar.encoded import retain_scan_dictionary
+        enc = retain_scan_dictionary(
+            dtype, combined, lens_np, gidx, valid, n_rows, capacity,
+            lambda dense: _scatter_nonnull(dense, valid, n_rows, capacity),
+            conf)
+        if enc is not None:
+            return enc
         mat_d = jnp.asarray(combined)
         lens_d = jnp.asarray(lens_np)
         chars, lens = _gather_dict_matrix(mat_d, lens_d, gidx, w,
@@ -1014,6 +1024,8 @@ def decode_file(path: str, stripes: Optional[List[int]] = None,
     # file tail: ... postscript | ps_len-byte; the postscript's last
     # field is the magic, so bytes -4:-1 read b"ORC"
     if len(raw) < 5 or raw[-4:-1] != b"ORC" or raw[-1] == 0:
+        from .decode_stats import set_decline_reason
+        set_decline_reason("malformed-tail")
         return None
     ps_len = raw[-1]
     try:
@@ -1023,6 +1035,8 @@ def decode_file(path: str, stripes: Optional[List[int]] = None,
             raw[-1 - ps_len - footer_len:-1 - ps_len], codec)
         all_stripes, types, total_rows = _parse_footer(footer)
     except (_Unsupported, IndexError, ValueError, struct.error):
+        from .decode_stats import set_decline_reason
+        set_decline_reason("unsupported-footer")
         return None
     if not types or types[0].subtypes != list(
             range(1, len(types[0].subtypes) + 1)):
@@ -1052,6 +1066,8 @@ def decode_file(path: str, stripes: Optional[List[int]] = None,
                 _decompress_stream(foot_raw, codec), st)
             stripe_meta.append((st, streams, encodings))
     except (_Unsupported, IndexError, ValueError, struct.error):
+        from .decode_stats import set_decline_reason
+        set_decline_reason("unsupported-stripe-footer")
         return None
 
     device_cols: Dict[int, object] = {}
@@ -1083,7 +1099,8 @@ def decode_file(path: str, stripes: Optional[List[int]] = None,
                 _collect_stripe(plan, kind, enc, dict_size, col_streams,
                                 st.num_rows)
             device_cols[fi] = _finish_column(plan, kind, dtype, n_rows,
-                                             capacity, max_str_bytes)
+                                             capacity, max_str_bytes,
+                                             conf=conf)
             if tctx is not None:
                 tctx.inc_metric("orcDeviceDecodedColumns")
         except _Unsupported:
@@ -1094,6 +1111,8 @@ def decode_file(path: str, stripes: Optional[List[int]] = None,
             host_fields.append(fi)
 
     if not device_cols:
+        from .decode_stats import set_decline_reason
+        set_decline_reason("no-device-columns")
         return None
     if host_fields:
         names = [schema.field(fi).name for fi in host_fields]

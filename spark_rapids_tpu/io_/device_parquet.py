@@ -934,7 +934,7 @@ def _finish_decimal_words(lo, hi, valid, dtype, n_rows: int,
 def _decode_column_device(plan: _ChunkPlan, phys: str, dtype, arrow_type,
                           capacity: int, n_rows: int,
                           max_str_bytes: int = 1 << 62,
-                          type_length: int = 0):
+                          type_length: int = 0, conf=None):
     """Run the device programs for one merged chunk plan -> DeviceColumn."""
     from ..columnar.column import DeviceColumn
 
@@ -972,6 +972,17 @@ def _decode_column_device(plan: _ChunkPlan, phys: str, dtype, arrow_type,
             # the scan's host pipeline then splits via split_for_upload.
             if capacity * mat.shape[1] > max_str_bytes:
                 raise _DeclineFile("string matrix exceeds ragged guard")
+            # encoded scan retention (docs/encoded_columns.md): keep the
+            # parquet dictionary page as codes+dict instead of eagerly
+            # gathering the padded byte matrix; None = decline -> gather
+            from ..columnar.encoded import retain_scan_dictionary
+            enc = retain_scan_dictionary(
+                dtype, mat, lens, idx, valid, n_rows, capacity,
+                lambda dense: _scatter_nonnull(dense, valid,
+                                               jnp.int32(n_rows), capacity),
+                conf)
+            if enc is not None:
+                return enc
             dmat = jnp.asarray(mat)
             dlen = jnp.asarray(lens if len(lens) else
                                np.zeros(1, np.int32))
@@ -1183,12 +1194,14 @@ def decode_file(path: str, row_groups: Optional[Sequence[int]] = None,
                 merged = _merge_plans(plans, phys)
                 device_cols[fi] = _decode_column_device(
                     merged, phys, dtype, fld.type, capacity, n_rows,
-                    max_str_bytes, type_length)
+                    max_str_bytes, type_length, conf=conf)
                 if tctx is not None:
                     tctx.inc_metric("parquetDeviceDecodedColumns")
             except _Unsupported:
                 host_fields.append(fi)
             except _DeclineFile:
+                from .decode_stats import set_decline_reason
+                set_decline_reason("ragged-strings")
                 return None
             except (ValueError, IndexError, KeyError, struct.error,
                     OSError):
@@ -1200,6 +1213,8 @@ def decode_file(path: str, row_groups: Optional[Sequence[int]] = None,
                 host_fields.append(fi)
 
     if not device_cols:
+        from .decode_stats import set_decline_reason
+        set_decline_reason("no-device-columns")
         return None
     if host_fields:
         names = [schema.field(fi).name for fi in host_fields]

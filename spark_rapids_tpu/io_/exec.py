@@ -170,19 +170,25 @@ class FileScanExec(PhysicalPlan):
         if not runs:
             yield from upload(pf.schema_arrow.empty_table())
             return
+        from . import decode_stats as DS
         declined = False   # a whole-file decline holds for every run
         for run in runs:
             if chunked:
                 tctx.inc_metric("chunkedReadBatches")
+            run_bytes = sum(pf.metadata.row_group(rg).total_byte_size
+                            for rg in run)
             batch = None if declined else decode_file(
                 path, run, tctx, pf=pf, conf=self.conf)
             if batch is None:
+                DS.record_declined(
+                    "parquet", run_bytes,
+                    reason="prior-decline" if declined else None)
                 declined = True
                 yield from upload(pf.read_row_groups(run))
             else:
-                if self.backend == CPU:
-                    batch = jax.device_get(batch)
-                yield batch
+                DS.record_engaged("parquet", run_bytes)
+                yield batch if self.backend != CPU \
+                    else jax.device_get(batch)
 
     def _execute_orc_device(self, path: str, tctx: TaskContext, upload):
         """ORC partition executor when device decode is on: stripe-run
@@ -218,14 +224,24 @@ class FileScanExec(PhysicalPlan):
                 runs.append(run)
         else:
             runs = [stripes]
+        from . import decode_stats as DS
+        import os as _os
+        try:
+            fsize = _os.path.getsize(path)
+        except OSError:
+            fsize = 0
         declined = False
         for run in runs:
             if len(runs) > 1:
                 tctx.inc_metric("chunkedReadBatches")
+            run_bytes = fsize * len(run) // max(f.nstripes, 1)
             batch = None if declined else decode_file(
                 path, run if len(runs) > 1 else None, tctx,
                 orc_file=f, conf=self.conf)
             if batch is None:
+                DS.record_declined(
+                    "orc", run_bytes,
+                    reason="prior-decline" if declined else None)
                 declined = True
                 if len(runs) > 1:
                     parts = [pa.Table.from_batches([f.read_stripe(s)])
@@ -234,6 +250,7 @@ class FileScanExec(PhysicalPlan):
                 else:
                     yield from upload(f.read())
             else:
+                DS.record_engaged("orc", run_bytes)
                 if self.backend == CPU:
                     batch = jax.device_get(batch)
                 yield batch
@@ -255,14 +272,19 @@ class FileScanExec(PhysicalPlan):
             self._emit_prune_stats(prune_stats, tctx)
             if not groups:
                 continue
+            from . import decode_stats as DS
+            nb = sum(pf.metadata.row_group(rg).total_byte_size
+                     for rg in groups)
             batch = decode_file(path, groups, tctx, pf=pf, conf=self.conf)
             if batch is None:
+                DS.record_declined("parquet", nb)
                 pieces = upload(pf.read_row_groups(groups))
                 if len(pieces) == 1:
                     batches.append(pieces[0])
                 else:
                     extra.extend(pieces)
             else:
+                DS.record_engaged("parquet", nb)
                 batches.append(batch)
         if batches:
             tctx.inc_metric("coalescedDeviceConcat")
@@ -294,13 +316,17 @@ class FileScanExec(PhysicalPlan):
                 raw = f.read()
         except OSError:
             return False
+        from . import decode_stats as DS
+        fmt = registry._normalize_fmt(self.node.fmt, opts)
         batch = decode_fn(path, opts, self.node.output, tctx, self.conf,
                           raw=raw)
         if batch is not None:
+            DS.record_engaged(fmt, len(raw))
             if self.backend == CPU:
                 batch = jax.device_get(batch)
             yield batch
             return True
+        DS.record_declined(fmt, len(raw))
         for piece in upload(host_read_fn(_io.BytesIO(raw), opts)):
             yield piece
         return True
@@ -309,7 +335,7 @@ class FileScanExec(PhysicalPlan):
         import jax
 
         def upload_one(table):
-            batch = arrow_to_device(table)
+            batch = arrow_to_device(table, conf=self.conf)
             if self.backend == CPU:
                 batch = jax.device_get(batch)
             return batch

@@ -24,6 +24,8 @@ Event categories:
                    blacklisting, lost-block recompute (robustness/)
 ``queue``          async-prefetch queue waits (consumer blocked on the
                    bounded prefetch queue; sql/physical/async_exec.py)
+``encode``         encoded-column lifecycle: scan-side dictionary encode
+                   and decline-site materializations (columnar/encoded.py)
 =================  =========================================================
 
 Spans attribute to the *owning exec node* via a thread-local exec stack:
@@ -60,7 +62,7 @@ TRACING = {"on": False}
 #: known span categories (exported traces may add more; the checker and
 #: the report treat unknown categories as opaque)
 CATEGORIES = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
-              "shuffle", "sem_wait", "fault", "queue")
+              "shuffle", "sem_wait", "fault", "queue", "encode")
 
 #: default ring capacity (spark.rapids.tpu.trace.bufferEvents)
 DEFAULT_CAPACITY = 65536

@@ -184,9 +184,22 @@ def join_search_keys(xp, key_cols: Sequence[DeviceColumn],
     zeroes their counts, which keeps the per-iteration search gathers to
     the value keys only."""
     keys = []
+    from ..columnar.encoded import DictEncodedColumn
     for c in key_cols:
         if null_safe:
             keys.append(~c.validity)
+        if isinstance(c, DictEncodedColumn):
+            # join keys compare ACROSS two batches, so bare codes are only
+            # sound when the exec layer lowered BOTH sides into one code
+            # space (encoded.lower_join_codes sets join_codes pairwise:
+            # build side keeps its sorted-dict codes, probe codes are
+            # remapped with -1 for misses).  Without that coordination the
+            # column materializes and takes the raw string-chunk path —
+            # a structure mismatch here would corrupt the search silently.
+            if c.join_codes is not None:
+                keys.append(c.join_codes.astype(xp.int64))
+                continue
+            c = c.materialized()
         keys.extend(column_sort_keys(xp, c))
     return keys
 

@@ -246,6 +246,17 @@ def column_sort_keys(xp, col: DeviceColumn):
     Equality of all keys <=> Spark equality; lexicographic order of keys ==
     Spark ascending null-last order of *values* (null handling is separate,
     via the validity array)."""
+    from ..columnar.encoded import DictEncodedColumn, op_enabled
+    if isinstance(col, DictEncodedColumn):
+        # Sorted dictionaries make code order == value order, so sorts and
+        # group-bys run on ONE int32-code key instead of width/8 string
+        # chunks + a length key.  Only sound within a single column (one
+        # shared dictionary); cross-column comparability (joins) goes
+        # through join_search_keys, which requires exec-layer coordinated
+        # join_codes and never takes this branch.
+        if col.dictionary.sorted and op_enabled("aggsort"):
+            return [col.codes.astype(xp.int64)]
+        col = col.materialized()
     if isinstance(col.dtype, T.StructType):
         keys = []
         for ch in col.children:

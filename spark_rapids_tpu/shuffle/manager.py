@@ -542,7 +542,16 @@ class MapTaskWriter:
                     batch, OUTPUT_FOR_SHUFFLE_PRIORITY)))
             return
 
-        def ser(b=batch):
+        # capture the calling task's context: pool-thread serialization
+        # must land its wire-byte metrics (shuffleBytesOnWire) on the
+        # query's metrics dict, not drop them on an anonymous thread
+        from ..sql.physical.base import TaskContext
+        tctx = TaskContext.current()
+
+        def ser(b=batch, tctx=tctx):
+            if tctx is not None:
+                with tctx.as_current():
+                    return serialize_batch(b, self.mgr.conf)
             return serialize_batch(b, self.mgr.conf)
         if self.mgr.mode == "MULTITHREADED":
             # serialization (D2H + compress) overlaps with the next split

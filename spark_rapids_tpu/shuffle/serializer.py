@@ -12,7 +12,20 @@ Frame layout (little-endian):
 Buffers are written packed to live rows only (capacity padding is NOT
 shipped); the reader re-pads into a fresh capacity bucket.  Optional
 whole-frame compression (zstd) mirrors the reference's nvcomp codecs
-(``TableCompressionCodec.scala``)."""
+(``TableCompressionCodec.scala``).
+
+Encoded-batch wire format (frame version 2, docs/encoded_columns.md):
+dictionary-encoded columns ship their codes NARROWED to the smallest
+unsigned width that holds the dictionary size (u1/u2/u4) plus the
+dictionary itself, written once per frame — or replaced by a content-hash
+reference when the in-process dictionary registry already holds it
+(``spark.rapids.tpu.sql.encoded.shuffle.dictRefs.enabled``; bypassed on
+multi-slice topologies, whose frames cross process boundaries).  RLE
+columns ship run values + run ends.  Version-2 readers accept version-1
+frames unchanged (per-column ``enc`` metadata is simply absent); a
+version-1 reader must not see version-2 frames — bump the version again
+on any layout change so mixed-version deployments fail loudly on the
+header instead of mis-parsing."""
 
 from __future__ import annotations
 
@@ -30,7 +43,13 @@ from ..columnar.batch import ColumnarBatch
 from ..columnar.column import DeviceColumn, bucket_capacity, make_array_column
 
 _MAGIC = b"TPUB"
-_VERSION = 1
+#: v2 = encoded-batch wire format (dict codes + dictionaries / RLE runs)
+_VERSION = 2
+
+#: map-side sent-set for dictionary refs: content hashes known to be
+#: resolvable from the process-global dictionary registry.  Ship each
+#: dictionary once per process; repeated batches pay only code bytes.
+_SENT_DICTS: set = set()
 
 _FLAG_ZSTD = 1
 _FLAG_CRC = 2   # trailing xxhash64 of the (possibly compressed) payload
@@ -74,9 +93,91 @@ def _type_str(dt: T.DataType) -> str:
     return dt.json_repr() if hasattr(dt, "json_repr") else dt.simple_string()
 
 
+def _code_dtype(dict_size: int):
+    if dict_size <= 0xFF:
+        return np.uint8
+    if dict_size <= 0xFFFF:
+        return np.uint16
+    return np.uint32
+
+
+def _dict_refs_on(conf) -> bool:
+    from ..config import ENCODED_SHUFFLE_DICT_REFS, RapidsConf
+    conf = conf or RapidsConf.get_global()
+    if not bool(conf.get(ENCODED_SHUFFLE_DICT_REFS)):
+        return False
+    # multi-slice topologies fetch peer blocks across process boundaries,
+    # where the reader cannot resolve this process's registry — inline
+    try:
+        from .manager import get_shuffle_manager
+        topo = get_shuffle_manager(conf).topology
+        return topo is None or not topo.multi_slice
+    except Exception:  # pragma: no cover - manager not initialized
+        return False
+
+
+def _serialize_encoded(out: io.BytesIO, col, n: int, meta: dict,
+                       conf) -> bool:
+    """Encoded-column wire write (frame v2).  Returns False to decline —
+    the caller then materializes and writes the raw layout."""
+    from ..columnar import encoded as E
+    if not (E.op_enabled("shuffle", conf)):
+        return False
+    if isinstance(col, E.DictEncodedColumn):
+        d = col.dictionary
+        validity = np.asarray(col.validity)[:n]
+        _write_buf(out, np.packbits(validity, bitorder="little"))
+        cdt = _code_dtype(d.size)
+        codes = np.asarray(col.codes)[:n].astype(cdt)
+        _write_buf(out, codes)
+        meta["enc"] = "dict"
+        meta["dsize"] = d.size
+        meta["dsorted"] = bool(d.sorted)
+        meta["dhash"] = f"{d.content_hash:x}"
+        dc = d.column
+        raw_matrix = (n * (dc.width or 0)) + 4 * n  # chars + lengths
+        dict_bytes = 0
+        if _dict_refs_on(conf) and d.content_hash in _SENT_DICTS:
+            meta["dref"] = True
+            E._bump("wire_dict_refs")
+        else:
+            dmeta: dict = {}
+            pos0 = out.tell()
+            _serialize_column(out, dc, d.size, dmeta, conf)
+            dict_bytes = out.tell() - pos0
+            meta["dmeta"] = dmeta
+            E._bump("wire_dict_inline")
+            if _dict_refs_on(conf) \
+                    and E.registered_dictionary(d.content_hash) is not None:
+                _SENT_DICTS.add(d.content_hash)
+        E._bump("wire_code_bytes", codes.nbytes)
+        E.add_wire_saved(max(0, raw_matrix - codes.nbytes - dict_bytes))
+        return True
+    if isinstance(col, E.RLEColumn):
+        validity = np.asarray(col.validity)[:n]
+        _write_buf(out, np.packbits(validity, bitorder="little"))
+        k = col.num_runs
+        meta["enc"] = "rle"
+        meta["nruns"] = k
+        _write_buf(out, np.asarray(col.run_ends)[:k].astype(np.int32))
+        rmeta: dict = {}
+        _serialize_column(out, col.run_values, k, rmeta, conf)
+        meta["rmeta"] = rmeta
+        rv = col.run_values
+        item = np.asarray(rv.data).dtype.itemsize
+        E.add_wire_saved(max(0, (n - k) * item - 4 * k))
+        return True
+    return False
+
+
 def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
-                      meta: dict):
+                      meta: dict, conf=None):
     """Packed (live rows only) column write; meta collects shape info."""
+    from ..columnar.encoded import DictEncodedColumn, RLEColumn
+    if isinstance(col, (DictEncodedColumn, RLEColumn)):
+        if _serialize_encoded(out, col, n, meta, conf):
+            return
+        col = col.materialized()
     validity = np.asarray(col.validity)[:n] if col.validity is not None \
         else np.ones(n, dtype=bool)
     _write_buf(out, np.packbits(validity, bitorder="little"))
@@ -87,7 +188,7 @@ def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
         kids = []
         for ch in col.children:
             km: dict = {}
-            _serialize_column(out, ch, n * w, km)
+            _serialize_column(out, ch, n * w, km, conf)
             kids.append(km)
         meta["children"] = kids
         return
@@ -95,7 +196,7 @@ def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
         kids = []
         for ch in col.children:
             km = {}
-            _serialize_column(out, ch, n, km)
+            _serialize_column(out, ch, n, km, conf)
             kids.append(km)
         meta["children"] = kids
         return
@@ -111,11 +212,25 @@ def _serialize_column(out: io.BytesIO, col: DeviceColumn, n: int,
 def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
     tracing = _trace.TRACING["on"]
     t0 = time.perf_counter() if tracing else 0.0
+    from ..columnar import encoded as E
+    # thread-local wire accounting: exact per-frame delta even when pool
+    # threads serialize other frames concurrently
+    tok = E.begin_wire_account()
     frame = _serialize_batch(batch, conf)
+    saved = E.end_wire_account(tok)
     if tracing:
         _trace.get_tracer().complete(
             "shuffle", "serialize_batch", t0, time.perf_counter() - t0,
             bytes=len(frame), rows=batch.num_rows_int)
+    # per-query wire accounting (last_query_metrics): actual frame bytes
+    # plus the encoded representation's saving vs raw value buffers
+    from ..sql.physical.base import TaskContext
+    t = TaskContext.current()
+    if t is not None:
+        t.inc_metric("shuffleBytesOnWire", len(frame))
+        t.inc_metric("shuffleFramesWritten")
+        if saved:
+            t.inc_metric("shuffleEncodedBytesSaved", saved)
     return frame
 
 
@@ -130,7 +245,7 @@ def _serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
     metas = []
     for col in batch.columns:
         m: dict = {}
-        _serialize_column(body, col, n, m)
+        _serialize_column(body, col, n, m, conf)
         metas.append(m)
     schema = {
         "names": list(batch.names),
@@ -214,11 +329,65 @@ def _spec_to_type(spec) -> T.DataType:
     return _SIMPLE[k]()
 
 
+def _deserialize_encoded(buf: memoryview, pos: int, dt: T.DataType, n: int,
+                         cap: int, meta: dict) -> Tuple[DeviceColumn, int]:
+    """Read a v2 encoded column (host numpy buffers).  With the encoded
+    kill switch off the column materializes immediately on the host, so a
+    disabled session never observes encoded representations."""
+    from ..columnar import encoded as E
+    enc = meta["enc"]
+    bits, pos = _read_buf(buf, pos, np.uint8, (-1,))
+    validity = np.zeros(cap, dtype=bool)
+    if n:
+        validity[:n] = np.unpackbits(bits, count=n, bitorder="little") \
+            .astype(bool)
+    if enc == "dict":
+        dsize = int(meta["dsize"])
+        codes_np, pos = _read_buf(buf, pos, _code_dtype(dsize), (-1,))
+        codes = np.zeros(cap, dtype=np.int32)
+        if n:
+            codes[:n] = codes_np.astype(np.int32)
+            codes[:n][~validity[:n]] = 0
+        dhash = int(meta["dhash"], 16)
+        if meta.get("dref"):
+            d = E.registered_dictionary(dhash)
+            if d is None:
+                raise FrameCorrupt(
+                    f"shuffle frame references unknown dictionary "
+                    f"{meta['dhash']} — registry miss (cross-process "
+                    f"frame?); refetch/recompute will inline it")
+        else:
+            dcap = bucket_capacity(dsize + 1)
+            dcol, pos = _deserialize_column(buf, pos, dt, dsize, dcap,
+                                            meta["dmeta"])
+            d = E.dictionary_from_wire(dcol, dsize, bool(meta["dsorted"]),
+                                       dhash)
+        col = E.DictEncodedColumn(dt, codes, d, validity)
+        if not E.enabled():
+            return E.materialize_np(col), pos
+        return col, pos
+    if enc == "rle":
+        k = int(meta["nruns"])
+        ends_np, pos = _read_buf(buf, pos, np.int32, (-1,))
+        run_cap = bucket_capacity(k)
+        rends = np.full(run_cap, cap, dtype=np.int32)
+        rends[:k] = ends_np
+        rv, pos = _deserialize_column(buf, pos, dt, k, run_cap,
+                                      meta["rmeta"])
+        col = E.RLEColumn(dt, rv, rends, k, validity)
+        if not E.enabled():
+            return E.materialize_np(col), pos
+        return col, pos
+    raise FrameCorrupt(f"unknown encoded column kind {enc!r}")
+
+
 def _deserialize_column(buf: memoryview, pos: int, dt: T.DataType, n: int,
                         cap: int, meta: dict) -> Tuple[DeviceColumn, int]:
     # host (numpy) buffers: the device upload happens naturally when a
     # jitted exec traces the batch (jnp.asarray on trace), so host-side
     # consumers never see device arrays
+    if "enc" in meta:
+        return _deserialize_encoded(buf, pos, dt, n, cap, meta)
     bits, pos = _read_buf(buf, pos, np.uint8, (-1,))
     validity = np.zeros(cap, dtype=bool)
     if n:

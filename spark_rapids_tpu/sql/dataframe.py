@@ -225,6 +225,11 @@ class Column:
         items = vals[0] if len(vals) == 1 and isinstance(vals[0], (list, tuple)) \
             else vals
         dt = self.expr.data_type
+        if isinstance(dt, T.NullType):
+            # unresolved column (bare name): let Literal infer each value's
+            # type instead of stamping the placeholder void type, which is
+            # unevaluable for string items
+            return Column(PR.In(self.expr, tuple(Literal(v) for v in items)))
         return Column(PR.In(self.expr, tuple(Literal(v, dt) for v in items)))
 
     def between(self, lo, hi):

@@ -64,10 +64,14 @@ def _cached_upload(table, backend: str, conf=None) -> list:
     # changing raggedSplitBytes takes effect on already-scanned relations
     thr = int((conf or RapidsConf.get_global())
               .get(RAGGED_STRING_SPLIT_BYTES))
-    ck = (backend, thr)
+    # the encoded-retention decision changes the cached batches' column
+    # representation — key it in, so flipping the encoded kill switch
+    # takes effect on already-scanned relations
+    from ...columnar.encoded import encode_params
+    ck = (backend, thr, encode_params(conf))
     if ck not in per_backend:
         per_backend[ck] = [
-            _to_backend_batch(arrow_to_device(p), backend)
+            _to_backend_batch(arrow_to_device(p, conf=conf), backend)
             for p in split_for_upload(table, conf)]
     return per_backend[ck]
 
@@ -149,41 +153,122 @@ class ProjectExec(PhysicalPlan):
         return f"{self.node_name()} [{', '.join(e.sql() for e in self.exprs)}]"
 
 
+#: expression modules safe for dictionary-space predicate evaluation:
+#: deterministic, row-local (value-in -> value-out).  Excluded by absence:
+#: context_fns (rand/partition-id/input-file), udf/hive_udf (opaque),
+#: aggregates/windows (not row-local), subquery placeholders.
+_DICT_FILTER_MODULES = frozenset({
+    "core", "predicates", "strings", "arithmetic", "math_fns",
+    "conditional", "cast", "regexp", "datetime", "json_fns", "hashing",
+    "collections"})
+
+
+def _dict_filter_plan(bound: Expression, batch: ColumnarBatch):
+    """Trace-time eligibility for the filter-on-dictionary fast path: the
+    predicate references exactly ONE column, that column arrives
+    dict-encoded, and every node is a deterministic row-local expression.
+    Returns (ordinal, column) or None."""
+    from ...columnar.encoded import DictEncodedColumn
+    ords = set()
+    stack = [bound]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, BoundReference):
+            ords.add(e.ordinal)
+            continue
+        mod = type(e).__module__.rsplit(".", 1)[-1]
+        if mod not in _DICT_FILTER_MODULES:
+            return None
+        stack.extend(e.children)
+    if len(ords) != 1:
+        return None
+    i = ords.pop()
+    col = batch.columns[i]
+    if not isinstance(col, DictEncodedColumn):
+        return None
+    return i, col
+
+
 class FilterExec(PhysicalPlan):
     """Predicate + row compaction (stable partition of live rows to the
-    front, the static-shape analog of cudf ``Table.filter``)."""
+    front, the static-shape analog of cudf ``Table.filter``).
+
+    Dictionary fast path (docs/encoded_columns.md): an eligible predicate
+    over one dict-encoded column evaluates ONCE over the dictionary's
+    |dict|+1 entries (the spare all-null row supplies the predicate's
+    null-input verdict exactly) and each data row just looks its verdict
+    up by code — O(|dict|) predicate work instead of O(rows), and the
+    selection gather keeps every pass-through column encoded."""
 
     def __init__(self, condition: Expression, child: PhysicalPlan, backend=TPU):
         super().__init__(child)
         self.backend = backend
         self.condition = condition
         self._bound = bind_references(condition, child.output)
+        from ...columnar.encoded import op_enabled
+        self._enc_filter = op_enabled("filter")
         from .kernel_cache import expr_key
-        self._fn = self._jit(self._compute, key=(expr_key(self._bound),))
+        self._fn = self._jit(self._compute,
+                             key=(expr_key(self._bound), self._enc_filter))
 
     @property
     def output(self):
         return self.children[0].output
 
+    def _dict_keep(self, batch: ColumnarBatch, xp):
+        """Per-row keep verdict via dictionary lookup, or None when the
+        fast path does not apply (decided at trace time from the batch's
+        static structure)."""
+        if not self._enc_filter:
+            return None
+        plan = _dict_filter_plan(self._bound, batch)
+        if plan is None:
+            return None
+        from ...columnar.column import null_column
+        from ...columnar.encoded import _bump
+        i, col = plan
+        d = col.dictionary
+        dcol = d.column
+        dcap = dcol.capacity
+        child_out = self.children[0].output
+        cols = tuple(dcol if j == i else null_column(a.dtype, dcap)
+                     for j, a in enumerate(child_out))
+        dict_batch = ColumnarBatch.make(
+            tuple(a.name for a in child_out), cols, dcap)
+        ctx = EvalContext(dict_batch, xp=xp)
+        v = self._bound.eval(ctx)
+        dict_keep = v.data & v.validity
+        # valid rows look up their code's verdict; null rows look up the
+        # spare all-null entry at index d.size — the exact null-input
+        # verdict of the predicate, whatever its null semantics
+        sel = xp.where(col.validity, col.codes, d.size)
+        _bump("dict_filters")
+        return dict_keep[xp.clip(sel, 0, dcap - 1)]
+
     def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
         xp = self.xp
-        ctx = EvalContext(batch, xp=xp)
-        cond = self._bound.eval(ctx)
-        keep = cond.validity & cond.data & batch.row_mask()
-        return compact_batch(xp, batch, keep)
+        keep = self._dict_keep(batch, xp)
+        if keep is None:
+            ctx = EvalContext(batch, xp=xp)
+            cond = self._bound.eval(ctx)
+            keep = cond.validity & cond.data
+        return compact_batch(xp, batch, keep & batch.row_mask())
 
     # --- whole-stage fusion protocol --------------------------------------
     def _fuse_step(self, batch: ColumnarBatch, mask, xp):
         """Fused filters never compact: the predicate just ANDs into the
         live mask; the stage terminal (agg mask / one final compaction)
         realizes it."""
-        ctx = EvalContext(batch, xp=xp)
-        cond = self._bound.eval(ctx)
-        return batch, mask & cond.validity & cond.data
+        keep = self._dict_keep(batch, xp)
+        if keep is None:
+            ctx = EvalContext(batch, xp=xp)
+            cond = self._bound.eval(ctx)
+            keep = cond.validity & cond.data
+        return batch, mask & keep
 
     def _fuse_key(self):
         from .kernel_cache import expr_key
-        return ("F", expr_key(self._bound))
+        return ("F", expr_key(self._bound), self._enc_filter)
 
     def execute(self, pid, tctx):
         for batch in self.children[0].execute(pid, tctx):

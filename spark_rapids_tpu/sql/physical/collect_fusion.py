@@ -280,7 +280,15 @@ class FusedCollectExec(PhysicalPlan):
         from . import speculation as SPEC
         agg = self._agg
         is_final = agg.mode == "final"
-        pkey = self._tail_key(spec, batch.capacity)
+        # the input batch's pytree structure joins the key: encoded columns
+        # make the traced OUTPUT structure (and so the unpack signature)
+        # depend on the input representation, not just the schema/capacity
+        from ...shims import tree_flatten
+        in_leaves, in_tdef = tree_flatten(batch)
+        in_sig = (in_tdef, tuple(
+            (getattr(l, "shape", ()), str(getattr(l, "dtype", "")))
+            for l in in_leaves))
+        pkey = self._tail_key(spec, batch.capacity) + (in_sig,)
         prog = _TAIL_PROGRAMS.get(pkey)
         if prog is None:
             if len(_TAIL_PROGRAMS) > 512:

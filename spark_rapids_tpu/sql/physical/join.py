@@ -211,6 +211,70 @@ class BaseJoinExec(PhysicalPlan):
             conf = RapidsConf.get_global()
         return bool(conf.get(JOIN_BUILD_CACHE_ENABLED))
 
+    def _lower_encoded_keys(self, probe: ColumnarBatch, build: ColumnarBatch,
+                            tctx: Optional[TaskContext]
+                            ) -> Tuple[ColumnarBatch, ColumnarBatch]:
+        """Encoded join lowering (docs/encoded_columns.md): for every key
+        pair that is a bare column reference to a dict-encoded string
+        column on BOTH sides, remap the probe side's codes into the build
+        dictionary's (sorted) code space and mark both columns with
+        ``join_codes`` — the jitted join programs then sort/search ONE
+        int32 key per string key instead of width/8 byte-chunk keys.
+
+        Invariant kept pairwise: a key position either carries join_codes
+        on BOTH sides or on NEITHER (a one-sided marking would make
+        ``join_search_keys`` emit mismatched key structures).  The lowered
+        build batch shares the original's build-side artifact cache; its
+        lowering signature joins the cache key so code-space and raw sorts
+        never alias."""
+        from ...columnar import encoded as E
+        from ..expressions.core import BoundReference
+        conf = tctx.conf if tctx is not None else None
+        if not (E.op_enabled("join", conf) and self._fast_ok):
+            return probe, build
+        lowered: List[Tuple[int, int, object, object]] = []
+        for pk, bk in zip(self._bound_pkeys, self._bound_bkeys):
+            if not (isinstance(pk, BoundReference)
+                    and isinstance(bk, BoundReference)):
+                continue
+            pcol = probe.columns[pk.ordinal]
+            bcol = build.columns[bk.ordinal]
+            if not (isinstance(pcol, E.DictEncodedColumn)
+                    and isinstance(bcol, E.DictEncodedColumn)) \
+                    or pcol.dtype != bcol.dtype:
+                continue
+            pair = E.lower_join_codes(pcol, bcol)
+            if pair is None:
+                E._bump("join_code_declines")
+                continue
+            lowered.append((pk.ordinal, bk.ordinal) + pair)
+        if not lowered:
+            return probe, build
+        pcols = list(probe.columns)
+        bcols = list(build.columns)
+        for po, bo, p2, b2 in lowered:
+            pcols[po] = p2
+            bcols[bo] = b2
+        new_probe = ColumnarBatch(probe.names, tuple(pcols), probe.num_rows)
+        new_build = ColumnarBatch(build.names, tuple(bcols), build.num_rows)
+        for src, dst in ((probe, new_probe), (build, new_build)):
+            cached = getattr(src, "_nrows_host", None)
+            if cached is not None:
+                dst._nrows_host = cached
+        # share the artifact cache so the build sort still happens once per
+        # (build batch, lowering signature) across all probe batches
+        cache = getattr(build, "_join_build_sides", None)
+        if cache is None:
+            cache = build._join_build_sides = {}
+        new_build._join_build_sides = cache
+        new_build._enc_lower_sig = tuple(
+            (bo, bcols[bo].dictionary.content_hash)
+            for _, bo, _, _ in lowered)
+        E._bump("join_code_lowerings", len(lowered))
+        if tctx is not None:
+            tctx.inc_metric("joinCodeLowerings", len(lowered))
+        return new_probe, new_build
+
     def _get_build_side(self, build: ColumnarBatch,
                         tctx: Optional[TaskContext]) -> JoinBuildSide:
         """The build batch's cached :class:`JoinBuildSide` for this join's
@@ -221,7 +285,8 @@ class BaseJoinExec(PhysicalPlan):
         if cache is None:
             cache = {}
             build._join_build_sides = cache
-        key = (self.backend,) + self._bs_key
+        key = (self.backend,) + self._bs_key \
+            + (getattr(build, "_enc_lower_sig", None),)
         bs = cache.get(key)
         if bs is None:
             with self._stage(tctx, "buildSort"):
@@ -480,6 +545,7 @@ class BaseJoinExec(PhysicalPlan):
         sizing fetch overlaps the gather's device execution instead of
         serializing build -> readback -> gather.  Only an overflow of the
         predicted bucket (realized rows > capacity) pays a re-gather."""
+        probe, build = self._lower_encoded_keys(probe, build, tctx)
         how = self._norm_how
         if (self._bound_cond is not None or how in _FILTER_JOINS):
             yield self._join_one(probe, build, tctx)

@@ -28,6 +28,34 @@ class SessionConf:
         return self._conf.get(key, default)
 
 
+def _aux_stats_snapshot() -> dict:
+    """Flat snapshot of the process-wide encoded/prepack/decode counters
+    whose per-query deltas fold into last_query_metrics (the robustness
+    stats_snapshot pattern)."""
+    from ..columnar import encoded as _enc
+    from ..columnar import prepack as _pp
+    from ..io_ import decode_stats as _ds
+    out = dict(_ds.snapshot())
+    es = _enc.stats_snapshot()
+    out.update({
+        "encodedColumnsEncoded": es["columns_encoded"]
+        + es["rle_columns_encoded"],
+        "encodedColumnsDeclined": es["columns_declined"],
+        "encodedMaterializations": es["materializations"],
+        "encodedDictFilters": es["dict_filters"],
+        "encodedConcatUnified": es["concat_unified"],
+        "encodedWireDictInline": es["wire_dict_inline"],
+        "encodedWireDictRefs": es["wire_dict_refs"],
+        "encodedWireBytesSaved": es["wire_bytes_saved"],
+    })
+    out.update({
+        "prepackBytesOnWire": _pp.STATS["bytes_on_wire"],
+        "prepackBytesNaive": _pp.STATS["bytes_naive"],
+        "prepackFetches": _pp.STATS["prepacked_fetches"],
+    })
+    return out
+
+
 class TpuSession:
     _lock = threading.Lock()
     _active: Optional["TpuSession"] = None
@@ -132,6 +160,7 @@ class TpuSession:
         prev_chaos = _faults.snapshot_arming()
         _faults.apply_conf(self._conf)
         rob0 = stats_snapshot()
+        aux0 = _aux_stats_snapshot()
         profiling = bool(self._conf.get(PROFILE_ENABLED))
         sink = str(self._conf.get(TRACE_SINK) or "").strip()
         # profile.enabled implies an in-memory trace so the profile report
@@ -159,10 +188,12 @@ class TpuSession:
             PROFILING["on"] = prev_prof
             OT.TRACING["on"] = prev_trace
             _faults.restore_arming(prev_chaos)
-            self._finish_trace(tracing, sink, cache_stats0, rob0, ok)
+            self._finish_trace(tracing, sink, cache_stats0, rob0, ok,
+                               aux0=aux0)
 
     def _finish_trace(self, tracing: bool, sink: str, cache_stats0: dict,
-                      rob0: dict, ok: bool) -> None:
+                      rob0: dict, ok: bool, aux0: Optional[dict] = None
+                      ) -> None:
         """Per-query trace epilogue: fold kernel-cache and robustness
         deltas into last_query_metrics, snapshot the tracer (the ring is
         process-wide and resets at the next traced query), build the
@@ -184,6 +215,16 @@ class TpuSession:
             rob1 = stats_snapshot()
             for k, v0 in rob0.items():
                 m[k] = rob1[k] - v0
+            # encoded-execution / prepack / device-decode engagement
+            # deltas (decode counters only when scans actually ran, so
+            # in-memory queries don't carry two dozen zero keys)
+            if aux0 is not None:
+                aux1 = _aux_stats_snapshot()
+                for k, v0 in aux0.items():
+                    d = aux1.get(k, v0) - v0
+                    if d or not k.endswith(
+                            ("Engaged", "Declined")):
+                        m[k] = d
         if not tracing:
             self.last_query_trace_summary = None
             # an older traced query's events must not be joined with THIS

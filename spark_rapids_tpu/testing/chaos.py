@@ -69,12 +69,53 @@ def _q_sort(sess, t, F):
             .select("k", "v", "q").collect())
 
 
+def _q_enc_str_join(sess, t, F):
+    # low-cardinality STRING-keyed filter+join+group: the shape the
+    # encoded columnar path (docs/encoded_columns.md) rewrites — dict
+    # filter on the scan, code-space join probe, group-by on codes, and
+    # encoded frames (narrowed codes + dictionaries) over the serializing
+    # shuffle plane.  Kept LAST in QUERIES so the exported chaos trace
+    # carries its encode spans alongside the fault spans.
+    fact = sess.create_dataframe(t["fact"], num_partitions=4)
+    cdim = sess.create_dataframe(t["cdim"], num_partitions=2)
+    return (fact.filter(F.col("ck") <= "cat_11")
+            .join(cdim, on="ck", how="inner")
+            .groupBy("ck").agg(F.count("*").alias("n"),
+                               F.sum(fact.v).alias("sv"))
+            .orderBy("ck").collect())
+
+
 QUERIES: List[Tuple[str, Callable]] = [
     ("agg", _q_agg),
     ("join_agg", _q_join_agg),
     ("left_join", _q_left_join),
     ("ooc_sort", _q_sort),
+    ("enc_str_join", _q_enc_str_join),
 ]
+
+
+def augment_tables(t: dict) -> dict:
+    """Add the low-cardinality string key column (and its dimension) the
+    `enc_str_join` query needs, IN PLACE and idempotently — callers that
+    reuse one tables dict across runs (the pipeline rig's timing loop,
+    test fixtures) keep stable table identities, so the engine's upload
+    cache still amortizes."""
+    if "cdim" not in t:
+        rng = np.random.default_rng(5)
+        cats = [f"cat_{i:02d}" for i in range(16)]
+        n = t["fact"].num_rows
+        t["fact"] = t["fact"].append_column(
+            "ck", pa.array([cats[i] for i in rng.integers(0, 16, n)]))
+        t["cdim"] = pa.table({"ck": pa.array(cats),
+                              "cw": np.arange(float(len(cats)))})
+    return t
+
+
+def _soak_tables(rows: int) -> dict:
+    """scaletest tables + the dictionary-encoded string key columns so
+    the suite traverses the encoded paths."""
+    from .scaletest import build_tables
+    return augment_tables(dict(build_tables(rows)))
 
 
 def _canonical(table: pa.Table) -> pd.DataFrame:
@@ -103,7 +144,8 @@ def run_soak(rows: int = 20_000, seed: int = 11,
              queries: Optional[List[str]] = None,
              trace_path: Optional[str] = None,
              strict: bool = True,
-             pipeline: bool = False) -> dict:
+             pipeline: bool = False,
+             encoded: bool = False) -> dict:
     """Returns the soak report; raises AssertionError on any parity or
     counter-visibility failure.  ``strict=False`` (reduced smoke runs)
     keeps the bit-parity and faults-injected asserts but skips the
@@ -115,16 +157,21 @@ def run_soak(rows: int = 20_000, seed: int = 11,
     transfers, concurrentGpuTasks left at 1 so semaphore contention —
     ``sem_wait`` spans — is guaranteed) while the clean run stays serial:
     injected faults must recover bit-identically even when they surface
-    on prefetch producer / transfer stager / pool worker threads."""
+    on prefetch producer / transfer stager / pool worker threads.
+
+    ``encoded=True`` runs the CHAOS session with encoded columnar
+    execution ON while the clean run stays on the RAW path
+    (``spark.rapids.tpu.sql.encoded.enabled=false``): encoded shuffle
+    frames (narrowed codes + dictionaries/refs) must survive fetch
+    retries, destroyed blocks, and lost-block recompute bit-identically
+    to the raw clean run — the ISSUE 6 acceptance leg."""
     import spark_rapids_tpu as srt
     from ..config import RapidsConf
     from ..memory.spill import BufferCatalog
     from ..robustness import disarm_chaos
     from ..robustness.faults import SITE_STATS
     from ..sql import functions as F
-    from .scaletest import build_tables
-
-    tables = build_tables(rows)
+    tables = _soak_tables(rows)
     tmp = tempfile.mkdtemp(prefix="srt-chaos-")
     selected = [(n, fn) for n, fn in QUERIES
                 if queries is None or n in queries]
@@ -140,8 +187,14 @@ def run_soak(rows: int = 20_000, seed: int = 11,
         "spark.rapids.memory.spillDir": tmp,
     }))
     try:
+        clean_conf = dict(_base_conf(tmp))
+        if encoded:
+            # clean baseline on the RAW path: the soak then proves
+            # encoded-under-faults == raw-without-faults, not just
+            # encoded == encoded
+            clean_conf["spark.rapids.tpu.sql.encoded.enabled"] = False
         clean_sess = srt.session(conf=RapidsConf.get_global().copy(
-            _base_conf(tmp)))
+            clean_conf))
         clean: Dict[str, pd.DataFrame] = {}
         for name, fn in selected:
             clean[name] = _canonical(fn(clean_sess, tables, F))
@@ -153,6 +206,8 @@ def run_soak(rows: int = 20_000, seed: int = 11,
             "spark.rapids.tpu.chaos.sites": sites,
             "spark.rapids.tpu.shuffle.fetch.backoffMs": 1,
         })
+        if encoded:
+            chaos_conf["spark.rapids.tpu.sql.encoded.enabled"] = True
         if pipeline:
             chaos_conf.update({
                 "spark.rapids.tpu.task.parallelism": 4,
@@ -174,6 +229,7 @@ def run_soak(rows: int = 20_000, seed: int = 11,
         by_site: Dict[str, int] = {}
         per_query = {}
         mismatches = []
+        exported_has_encode = False
         for name, fn in selected:
             site0 = dict(SITE_STATS)
             got = _canonical(fn(chaos_sess, tables, F))
@@ -194,12 +250,19 @@ def run_soak(rows: int = 20_000, seed: int = 11,
             except AssertionError as e:
                 mismatches.append(f"{name}: {e}")
             if trace_path and q["faultsInjected"] > 0:
-                # keep the last trace that actually carries fault spans
-                chaos_sess.export_chrome_trace(trace_path)
+                # keep the last trace carrying fault spans, preferring
+                # one that ALSO carries encode spans (scan-side encode
+                # fires only on each table's first upload, so later
+                # queries' traces lack cat `encode` — CI's encoded leg
+                # validates both categories in one export)
+                has_enc = int(m.get("encodedColumnsEncoded", 0)) > 0
+                if has_enc or not exported_has_encode:
+                    chaos_sess.export_chrome_trace(trace_path)
+                    exported_has_encode = exported_has_encode or has_enc
 
         report = {
             "rows": rows, "seed": seed, "sites": sites,
-            "pipeline": pipeline,
+            "pipeline": pipeline, "encoded": encoded,
             "queries": per_query, "counters": counters,
             "faults_by_site": by_site,
             "bit_identical": not mismatches,
@@ -237,6 +300,13 @@ def main() -> None:
     trace_path = None
     seed = 11
     pipeline = False
+    encoded = False
+    if "--encoded" in argv:
+        # encoded soak: chaos session runs with encoded columnar
+        # execution ON against a RAW clean baseline (ISSUE 6 acceptance:
+        # bit-identical under faults with encoding enabled)
+        encoded = True
+        argv.remove("--encoded")
     if "--pipeline" in argv:
         # pipelined soak: chaos session under parallelism=4 + prefetch +
         # double-buffered transfers vs the SERIAL clean run.  The
@@ -255,9 +325,11 @@ def main() -> None:
         argv = argv[:i] + argv[i + 2:]
     rows = int(argv[0]) if argv else 20_000
     report = run_soak(rows, seed=seed, trace_path=trace_path,
-                      strict=not pipeline, pipeline=pipeline)
+                      strict=not pipeline, pipeline=pipeline,
+                      encoded=encoded)
     print(json.dumps(report, indent=2))
-    mode = "pipelined " if pipeline else ""
+    mode = ("pipelined " if pipeline else "") + \
+        ("encoded " if encoded else "")
     print(f"CHAOS SOAK PASSED: {mode}results bit-identical under "
           f"{report['counters']['faultsInjected']} injected faults")
 

@@ -51,7 +51,8 @@ def _suite_conf() -> Dict[str, object]:
 def run_suite(sess, tables) -> Dict[str, pd.DataFrame]:
     """Canonicalized result frames for every suite query."""
     from ..sql import functions as F
-    from .chaos import QUERIES, _canonical
+    from .chaos import QUERIES, _canonical, augment_tables
+    tables = augment_tables(tables)
     return {name: _canonical(fn(sess, tables, F)) for name, fn in QUERIES}
 
 

@@ -722,6 +722,58 @@ def run_suite(rows: int = 50_000, queries=None, tables=None,
     return report
 
 
+def scan_engagement_report(rows: int = 20_000, tmpdir=None) -> dict:
+    """File-scan leg of the rig (VERDICT round 5, Weak #7): write the
+    fact table to parquet AND ORC (ORC with dictionary encoding on, the
+    encoded-retention shape), scan each back with a filter+agg, and
+    return the device-decode engagement scoreboard per format from the
+    queries' ``last_query_metrics``.  A regression that silently declines
+    every file to the host pyarrow path still returns bit-correct
+    results — this record is what makes it VISIBLE (test_encoded asserts
+    ``files_engaged >= 1`` for both formats)."""
+    import os
+    import shutil
+    import tempfile
+
+    import pyarrow.orc as pa_orc
+    import pyarrow.parquet as pq
+
+    import spark_rapids_tpu as srt
+    from ..io_ import decode_stats as DS
+    from ..sql import functions as F
+    own = tmpdir is None
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="srt_scan_rig_")
+    try:
+        fact = build_tables(max(rows, 1000))["fact"]
+        sess = srt.session()
+        out: Dict[str, dict] = {}
+        for fmt in ("parquet", "orc"):
+            path = os.path.join(tmpdir, f"fact.{fmt}")
+            if fmt == "parquet":
+                pq.write_table(fact, path)
+            else:
+                pa_orc.write_table(fact, path,
+                                   dictionary_key_size_threshold=1.0)
+            q = (getattr(sess.read, fmt)(path)
+                 .filter(F.col("q") < 50).groupBy("q")
+                 .agg(F.count("*").alias("c"),
+                      F.sum(F.col("v")).alias("sv")))
+            q.collect()
+            m = sess.last_query_metrics
+            out[fmt] = {
+                "files_engaged": int(m.get(f"{fmt}DecodeFilesEngaged", 0)),
+                "files_declined": int(
+                    m.get(f"{fmt}DecodeFilesDeclined", 0)),
+                "bytes_engaged": int(m.get(f"{fmt}DecodeBytesEngaged", 0)),
+                "columns_encoded": int(m.get("encodedColumnsEncoded", 0)),
+            }
+        out["decode_stats"] = DS.report()
+        return out
+    finally:
+        if own:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main() -> None:
     import json
     import os
@@ -746,6 +798,16 @@ def main() -> None:
         if "error" in entry:
             failed += 1
         print(json.dumps(entry), flush=True)
+    # device-decode engagement leg: the rig report must show the
+    # parquet/ORC scans actually ENGAGING the device decoders
+    scan = scan_engagement_report(min(rows, 20_000))
+    print(json.dumps({"scan_engagement": scan}), flush=True)
+    for fmt in ("parquet", "orc"):
+        if scan[fmt]["files_engaged"] < 1:
+            print(json.dumps({"error": f"{fmt} scan did not engage the "
+                              f"device decoder", "scan": scan[fmt]}),
+                  flush=True)
+            failed += 1
     if failed:
         raise SystemExit(1)
 

@@ -49,6 +49,11 @@ QUICK_MODULES = {
     # bit-parity and exception propagation are tier-1 — a silent
     # ordering or queue-hang regression must surface in the quick gate
     "test_async_pipeline",
+    # encoded columnar execution (ISSUE 6): representation round-trips,
+    # op parity encoded-on vs -off, the encoded wire format, and the
+    # kill-switch reversion are tier-1 — an encoding bug is silent data
+    # corruption, not a crash
+    "test_encoded",
 }
 
 

@@ -29,21 +29,11 @@ def make_batch(table: pa.Table):
 
 
 def to_host_batch(batch):
-    """Same layout, numpy arrays (host engine input)."""
-    import numpy as np
-    from dataclasses import replace
-    from spark_rapids_tpu.columnar.batch import ColumnarBatch
-
-    def conv(c):
-        return replace(
-            c,
-            data=None if c.data is None else np.asarray(c.data),
-            validity=None if c.validity is None else np.asarray(c.validity),
-            lengths=None if c.lengths is None else np.asarray(c.lengths),
-            aux=None if c.aux is None else np.asarray(c.aux),
-            children=tuple(conv(ch) for ch in c.children))
-    return ColumnarBatch(batch.names, tuple(conv(c) for c in batch.columns),
-                         np.asarray(batch.num_rows))
+    """Same layout (encoded columns included), numpy arrays (host engine
+    input) — a pytree fetch, so it doesn't care which DeviceColumn
+    representation each column uses."""
+    import jax
+    return jax.device_get(batch)
 
 
 def attr(name, dtype):

@@ -377,6 +377,119 @@ def test_decimal_group_agg_fuzz(env):
         _check(sess, pdf, sql, cols)
 
 
+# --------------------------------------------------------------------------
+# encoded columnar execution parity (ISSUE 6): the same grammar idea over
+# LOW-CARDINALITY strings and REPETITIVE ints — the columns the scan keeps
+# dictionary/RLE-encoded — with every generated query run encoded-ON vs
+# encoded-OFF and the two engines compared bit-identically.  The oracle
+# here is the RAW engine itself: the kill switch is structural, so any
+# divergence is an encoding bug by definition.
+# --------------------------------------------------------------------------
+
+ENC_N = 4000
+_ENC_CATS = [f"c{i:02d}" for i in range(12)]
+
+
+class EncodedGen(DualGen):
+    """String/repetitive-int extension used only by the encoded-parity
+    fuzz (SQL emission only — the raw engine is the oracle)."""
+
+    def strx(self, depth: int):
+        r = self.rng
+        if depth <= 0 or r.random() < 0.5:
+            return r.choice(["s", "s2"])
+        d = depth - 1
+        p = self.epred(d)
+        a = self.strx(d)
+        b = self.strx(d)
+        return f"(CASE WHEN {p} THEN {a} ELSE {b} END)"
+
+    def epred(self, depth: int):
+        r = self.rng
+        if depth <= 0 or r.random() < 0.5:
+            pick = r.random()
+            if pick < 0.3:
+                a = self.strx(0)
+                op = r.choice(["<", "<=", ">", ">=", "=", "<>"])
+                lit = r.choice(_ENC_CATS)
+                return f"({a} {op} '{lit}')"
+            if pick < 0.5:
+                a = self.strx(0)
+                items = ", ".join(
+                    f"'{c}'" for c in r.sample(_ENC_CATS, r.randint(1, 4)))
+                return f"({a} IN ({items}))"
+            if pick < 0.65:
+                a = self.strx(0)
+                neg = "NOT " if r.random() < 0.5 else ""
+                return f"({a} IS {neg}NULL)"
+            if pick < 0.85:
+                col = r.choice(["r", "j"])
+                op = r.choice(["<", "<=", ">", ">=", "="])
+                return f"({col} {op} {r.randint(0, 30)})"
+            a, b = self.strx(0), self.strx(0)
+            op = r.choice(["<", "=", ">="])
+            return f"({a} {op} {b})"
+        d = depth - 1
+        a, b = self.epred(d), self.epred(d)
+        pick = r.random()
+        if pick < 0.45:
+            return f"({a} AND {b})"
+        if pick < 0.9:
+            return f"({a} OR {b})"
+        return f"(NOT {a})"
+
+
+def _enc_table():
+    rng = np.random.default_rng(23)
+
+    def strs(frac_null):
+        idx = rng.integers(0, len(_ENC_CATS), ENC_N)
+        mask = rng.random(ENC_N) < frac_null
+        return [None if m else _ENC_CATS[i] for m, i in zip(mask, idx)]
+    return pa.table({
+        "s": pa.array(strs(0.08)),
+        "s2": pa.array(strs(0.15)),
+        "r": pa.array(np.repeat(
+            np.arange(ENC_N // 100, dtype=np.int64), 100)),
+        "j": pa.array(rng.integers(0, 20, ENC_N), pa.int64()),
+        "v": pa.array(rng.random(ENC_N)),
+    })
+
+
+def _enc_run(sess, sql):
+    tbl = sess.sql(sql).collect()
+    return sorted(tuple(_norm(v) for v in row)
+                  for row in zip(*[tbl.column(i).to_pylist()
+                                   for i in range(tbl.num_columns)]))
+
+
+def test_encoded_vs_raw_parity_fuzz():
+    rng = random.Random(404)
+    g = EncodedGen(rng)
+    queries = []
+    for _ in range(16):
+        p = g.epred(2)
+        if rng.random() < 0.5:
+            sels = ", ".join(f"{g.strx(2)} AS c{k}"
+                             for k in range(rng.randint(1, 2)))
+            queries.append(f"SELECT {sels}, r, v FROM eg WHERE {p}")
+        else:
+            queries.append(
+                f"SELECT s, count(*) AS n, sum(v) AS sv, min(s2) AS m, "
+                f"max(r) AS mr FROM eg WHERE {p} GROUP BY s")
+    t = _enc_table()
+    results = {}
+    for on in (True, False):
+        sess = srt.session(**{
+            "spark.rapids.tpu.sql.encoded.enabled": on,
+            "spark.rapids.sql.autoBroadcastJoinThreshold": 1})
+        sess.create_dataframe(t, num_partitions=3) \
+            .createOrReplaceTempView("eg")
+        results[on] = [_enc_run(sess, sql) for sql in queries]
+    for sql, enc, raw in zip(queries, results[True], results[False]):
+        assert enc == raw, sql
+
+
 def test_lateral_view_fuzz(env):
     sess, pdf = env
     rng = random.Random(909)

@@ -55,10 +55,16 @@ def test_serializer_roundtrip_rich_types():
 
 
 def test_serializer_packs_live_rows_only():
+    from spark_rapids_tpu.config import RapidsConf
     t = rich_table(10)
     b = arrow_to_device(t, capacity=4096)  # huge padding
-    frame_padded = serialize_batch(b)
-    frame_tight = serialize_batch(arrow_to_device(t))
+    # dictionary refs off: the second frame would otherwise replace its
+    # (identical) dictionary with a registry ref, shrinking it for a
+    # reason unrelated to the padding contract under test
+    conf = RapidsConf(
+        {"spark.rapids.tpu.sql.encoded.shuffle.dictRefs.enabled": False})
+    frame_padded = serialize_batch(b, conf)
+    frame_tight = serialize_batch(arrow_to_device(t), conf)
     # padding must not be shipped: both frames within a small delta
     assert abs(len(frame_padded) - len(frame_tight)) < 128
 
