@@ -523,6 +523,96 @@ def _measure_encoded_vs_raw(rows: int) -> dict:
     return {"encoded_vs_raw": out}
 
 
+def _measure_whole_stage(rows: int) -> dict:
+    """Whole-stage fusion evidence (ISSUE 7 acceptance): each shape runs
+    fused (default: whole-stage + donation on) and killswitched
+    (fusion.enabled=false, the per-op baseline) over identical data,
+    banking the STAGE-SCOPE device dispatch count (stageOpDispatches:
+    filters/projects/agg-partial/join-probe programs — the ops fusion
+    absorbs), total compiled-program launches, sync-span counts from a
+    traced run, rows/s, and a bit-parity flag.  The acceptance bar is a
+    >= 3x dispatch drop on the filter_agg and join shapes."""
+    import pyarrow as pa
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.sql import functions as F
+
+    rng = np.random.default_rng(23)
+    keyspace = max(rows // 20, 100)
+    fact = pa.table({
+        "k": rng.integers(0, 16, rows).astype(np.int64),
+        "q": rng.integers(0, 100, rows).astype(np.int64),
+        "x": rng.random(rows),
+        "fk": rng.integers(0, keyspace, rows).astype(np.int64)})
+    dim = pa.table({"pk": np.arange(keyspace, dtype=np.int64),
+                    "cat": rng.integers(0, 8, keyspace).astype(np.int64)})
+    n_bytes = fact.nbytes + dim.nbytes
+
+    def mk(sess, shape):
+        f = sess.create_dataframe(fact, num_partitions=4)
+        if shape == "filter_agg":
+            # filter -> project -> partial agg: ONE stage program fused
+            return (f.filter(F.col("q") < 50)
+                    .withColumn("y", F.col("x") * 2.0)
+                    .groupBy("k")
+                    .agg(F.sum(F.col("y")).alias("sy"),
+                         F.count("*").alias("c"))
+                    .orderBy("k"))
+        # join: selective filter -> project -> broadcast probe terminal
+        d = sess.create_dataframe(dim)
+        return (f.filter(F.col("q") < 5)
+                .withColumn("y", F.col("x") + 1.0)
+                .join(d, f.fk == d.pk, "inner"))
+
+    out: dict = {}
+    for shape in ("filter_agg", "join"):
+        per = {}
+        results = {}
+        for fused in (True, False):
+            conf = RapidsConf.get_global().copy({
+                "spark.rapids.tpu.sql.fusion.enabled": fused,
+                "spark.rapids.tpu.sql.wholeStage.enabled": fused,
+                "spark.rapids.tpu.sql.wholeStage.donation.enabled": fused,
+            })
+            sess = srt.session(conf=conf)
+            q = mk(sess, shape)
+            got = q.collect()  # warm: compiles + speculation recording
+            got = q.collect()  # second warm: spec-hit steady state
+            times = []
+            for _ in range(REPEATS):
+                t0 = time.perf_counter()
+                got = q.collect()
+                times.append(time.perf_counter() - t0)
+            el = min(times)
+            m = sess.last_query_metrics
+            tag = "fused" if fused else "unfused"
+            per[tag] = {
+                "rows_per_sec": round(rows / el),
+                "gb_per_s_per_chip": _gb_per_s(n_bytes, el),
+                "stage_dispatches": int(m.get("stageOpDispatches", 0)),
+                "device_dispatches": int(m.get("deviceDispatches", 0)),
+                "whole_stage_ops": int(m.get("wholeStageOps", 0)),
+                "unfused_ops": int(m.get("unfusedOps", 0)),
+                "donated_batches": int(
+                    m.get("wholeStageDonatedBatches", 0)),
+            }
+            ts = _shape_trace(sess, q.collect).get("trace_summary")
+            if ts:
+                per[tag]["sync_count"] = ts.get("sync_count")
+                per[tag]["trace_summary"] = ts
+            results[tag] = sorted(
+                tuple(sorted(r.items())) for r in got.to_pylist())
+        rec = {"fused": per["fused"], "unfused": per["unfused"],
+               "parity": results["fused"] == results["unfused"],
+               "rows": rows}
+        fd = per["fused"]["stage_dispatches"]
+        if fd:
+            rec["dispatch_reduction"] = round(
+                per["unfused"]["stage_dispatches"] / fd, 2)
+        out[shape] = rec
+    return {"whole_stage": out}
+
+
 def _measure_window(rows: int, resident: bool = True) -> dict:
     """Window-heavy shape: per-key running sum + global reduction."""
     import pandas as pd
@@ -817,6 +907,10 @@ def child_main(mode: str) -> None:
     shapes = (
         ("join", lambda: _measure_join(join_rows)),
         ("window", lambda: _measure_window(window_rows)),
+        # whole-stage fused vs killswitched dispatch/sync evidence
+        # (ISSUE 7 acceptance: >= 3x stage-dispatch drop, bit parity)
+        ("whole_stage",
+         lambda: _measure_whole_stage(min(ROWS // 8, 1_000_000))),
         ("sort", lambda: _measure_sort(min(ROWS, 2_000_000))),
         # encoded-vs-raw (ISSUE 6 acceptance): bytes-on-wire + GB/s/chip
         # per shape, both representations, on the serializing plane

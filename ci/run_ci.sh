@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/12] native libraries ==="
+echo "=== [1/13] native libraries ==="
 make -C native
 
-echo "=== [2/12] API contract validation ==="
+echo "=== [2/13] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/12] docgen drift check ==="
+echo "=== [3/13] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/12] traced query + chrome-trace schema check ==="
+echo "=== [4/13] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,7 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/12] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [5/13] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -64,7 +64,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [6/12] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [6/13] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -78,7 +78,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [7/12] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+echo "=== [7/13] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
 # Encoded columnar execution (docs/encoded_columns.md) under seeded
 # faults AND the async pipeline matrix: the chaos session keeps
 # dictionary/RLE columns encoded through filters/joins/group-bys and
@@ -98,7 +98,64 @@ timeout 60 python tools/check_trace.py --require-cat encode \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     8000 --seed 11 --encoded
 
-echo "=== [8/12] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [8/13] whole-stage fusion: plan shape + donation chaos soak ==="
+# Whole-stage XLA compilation (docs/whole_stage.md): (a) the TPC-H-ish
+# suite's plans must contain fused whole-stage nodes — an aggregate
+# terminal (FusedStageExec wrapping the partial agg) and a probe-absorbed
+# hash join; (b) the chaos soak runs with whole-stage + donation forced
+# ON against a serial UNFUSED clean baseline, bit-identical under
+# injected faults, and its trace must carry `stage` spans.
+JAX_PLATFORMS=cpu timeout 300 python - <<'PYEOF'
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.physical.fusion import FusedStageExec
+from spark_rapids_tpu.sql.physical.aggregate import HashAggregateExec
+from spark_rapids_tpu.sql.physical.join import BaseJoinExec
+
+def find(plan, pred):
+    out, stack = [], [plan]
+    while stack:
+        n = stack.pop()
+        if pred(n):
+            out.append(n)
+        stack.extend(n.children)
+    return out
+
+sess = srt.session()
+rng = np.random.default_rng(3)
+n = 50_000
+fact = sess.create_dataframe(pa.table(
+    {"fk": rng.integers(0, 1000, n), "q": rng.integers(0, 100, n),
+     "x": rng.random(n)}), num_partitions=4)
+dim = sess.create_dataframe(pa.table(
+    {"pk": np.arange(1000, dtype=np.int64),
+     "cat": rng.integers(0, 8, 1000)}))
+# q1-ish: scan -> filter -> project -> partial agg must plan as ONE
+# FusedStageExec with a HashAggregate terminal
+q1 = (fact.filter(F.col("q") < 50).withColumn("y", F.col("x") * 2.0)
+      .groupBy("q").agg(F.sum(F.col("y")).alias("sy")))
+p1 = sess.physical_plan(q1)
+stages = find(p1, lambda m: isinstance(m, FusedStageExec)
+              and isinstance(m.terminal, HashAggregateExec))
+assert stages, "no aggregate-terminal whole-stage node:\n" + p1.tree_string()
+# q3-ish: the broadcast join must absorb the probe-side chain
+q2 = (fact.filter(F.col("q") < 30).join(dim, fact.fk == dim.pk, "inner"))
+p2 = sess.physical_plan(q2)
+joins = find(p2, lambda m: isinstance(m, BaseJoinExec))
+assert joins and joins[0]._probe_steps, \
+    "probe chain not absorbed:\n" + p2.tree_string()
+print("plan-shape OK:", stages[0].simple_string())
+print("plan-shape OK:", joins[0].simple_string())
+PYEOF
+SRT_WS_TRACE=$(mktemp -d)/whole_stage_trace.json
+JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
+    20000 --seed 11 --whole-stage --trace "$SRT_WS_TRACE"
+timeout 60 python tools/check_trace.py --require-cat stage \
+    "$SRT_WS_TRACE"
+
+echo "=== [9/13] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -119,14 +176,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [9/12] scale rig ==="
+    echo "=== [10/13] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [9/12] scale rig skipped (quick) ==="
+    echo "=== [10/13] scale rig skipped (quick) ==="
 fi
 
-echo "=== [10/12] packaging: wheel builds and installs ==="
+echo "=== [11/13] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -156,17 +213,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [11/12] driver entry checks ==="
+echo "=== [12/13] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [12/12] second-jax shim world skipped (quick) ==="
+    echo "=== [13/13] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [12/12] second-jax shim world (gated) ==="
+echo "=== [13/13] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

@@ -189,7 +189,18 @@ class ColumnarBatch:
         for ci in range(batches[0].num_cols):
             pieces = [b.columns[ci] for b in batches]
             out_cols.append(_concat_columns(pieces, [b.num_rows_int for b in batches], cap))
-        return ColumnarBatch.make(names, out_cols, total)
+        out = ColumnarBatch.make(names, out_cols, total)
+        # a real multi-batch concat gathers into fresh buffers: mark it
+        # donation-eligible (memory/retention.py) — EXCEPT when an input
+        # was encoded (dictionary objects are shared with the inputs);
+        # may_donate declines encoded batches structurally anyway, but an
+        # unmarked batch is the cheaper decline
+        from ..memory.retention import mark_transient
+        from .encoded import DictEncodedColumn, RLEColumn
+        if not any(isinstance(c, (DictEncodedColumn, RLEColumn))
+                   for c in out_cols):
+            mark_transient(out)
+        return out
 
     def __repr__(self) -> str:  # pragma: no cover
         return (f"ColumnarBatch(rows={self.num_rows_int}, cap={self.capacity}, "

@@ -140,6 +140,27 @@ FUSION_ENABLED = register(
     "one compiled XLA program per pipeline stage — whole-stage codegen, "
     "the TPU analog of the reference's tiered projection + kernel reuse "
     "(basicPhysicalOperators.scala:500, SURVEY §3.3).", True)
+WHOLE_STAGE_ENABLED = register(
+    "spark.rapids.tpu.sql.wholeStage.enabled",
+    "Deepened whole-stage formation (docs/whole_stage.md): hash "
+    "aggregates (partial/complete) and hash-join probe phases become "
+    "stage TERMINALS — the upstream filter/project chain compiles into "
+    "the terminal's own program under one stage-signature kernel-cache "
+    "key, the fused filter mask feeds the aggregate/probe directly, and "
+    "intermediates never materialize.  Off keeps only >=2-op map-chain "
+    "fusion (requires spark.rapids.tpu.sql.fusion.enabled).", True)
+WHOLE_STAGE_DONATION = register(
+    "spark.rapids.tpu.sql.wholeStage.donation.enabled",
+    "Donate a fused map-stage's input buffers to its compiled program "
+    "(XLA donate_argnums) so the stage output reuses the input's HBM. "
+    "Guarded by the batch retention registry (memory/retention.py): "
+    "donation is declined whenever the batch is pinned by the scan "
+    "upload cache, a broadcast, a materialized shuffle partition, the "
+    "spill tier, a prefetch queue, or a transfer stager — or when its "
+    "provenance is unknown or it carries shared-dictionary encoded "
+    "columns.  Buffers are only physically reclaimed on real device "
+    "backends (XLA:CPU ignores donation); the safety decision runs "
+    "everywhere.", True)
 IMPROVED_FLOAT = register(
     "spark.rapids.sql.improvedFloatOps.enabled",
     "Allow float ops whose results may differ from CPU in ULPs.", True)

@@ -25,6 +25,10 @@ def _exec_rows() -> List[tuple]:
         ("RangeExec", "range generation"),
         ("ProjectExec", "projection (fusable into whole-stage programs)"),
         ("FilterExec", "filter (fusable into whole-stage programs)"),
+        ("FusedStageExec", "whole-stage program: filter/project chain + "
+         "optional hash-aggregate terminal compiled as ONE donated-buffer "
+         "XLA program (docs/whole_stage.md); "
+         "spark.rapids.tpu.sql.wholeStage.enabled"),
         ("SampleExec", "random sampling"),
         ("ExpandExec", "grouping-sets expansion"),
         ("UnionExec", "union all"),

@@ -76,9 +76,15 @@ def is_device_oom(exc: BaseException) -> bool:
     return False
 
 
-def guard_device_oom(fn: Callable) -> Callable:
+def guard_device_oom(fn: Callable, retriable: bool = True) -> Callable:
     """Wrap a compiled kernel: on device OOM, spill-all + retry once, then
-    escalate to SplitAndRetryOOM (input halving)."""
+    escalate to SplitAndRetryOOM (input halving).
+
+    ``retriable=False`` is the donated-buffer contract (whole-stage
+    donation, docs/whole_stage.md): a call whose inputs were donated to
+    XLA cannot be re-run with the same arguments — the donor buffers are
+    already invalid — so the guard spills and escalates immediately; the
+    session's whole-query retry loop re-materializes the inputs."""
 
     def _sync(result, force: bool = False):
         # jit dispatch is ASYNC: an execution-time OOM surfaces when the
@@ -118,6 +124,15 @@ def guard_device_oom(fn: Callable) -> Callable:
             _defensive_until = _time.monotonic() + _DEFENSIVE_WINDOW_S
             from .spill import BufferCatalog
             BufferCatalog.get().spill_all_device()
+            if not retriable:
+                # donated inputs are gone; escalate without a same-args
+                # retry (the whole-query retry re-plans and re-runs)
+                STATS["oom_split_raised"] += 1
+                from .retry import SplitAndRetryOOM
+                raise SplitAndRetryOOM(
+                    f"device OOM in a donated-buffer program (inputs "
+                    f"invalidated, same-args retry impossible): {e}"
+                ) from None
             try:
                 result = _sync(fn(*args, **kwargs), force=True)
             except Exception as e2:  # noqa: BLE001

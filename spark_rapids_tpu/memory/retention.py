@@ -1,0 +1,156 @@
+"""Batch retention registry — the donation-safety contract for whole-stage
+XLA programs (docs/whole_stage.md).
+
+A fused stage may hand its input batch to XLA with ``donate_argnums`` so
+the output reuses the input's HBM instead of allocating fresh buffers.
+Donation invalidates the donor arrays, so it is ONLY sound when the stage
+is the batch's sole owner.  This module tracks the two facts that decide
+that:
+
+* **pins** — a refcount per batch object, taken by every subsystem that
+  RETAINS batches beyond a single producer->consumer handoff: the scan
+  upload cache (basic.py ``_cached_upload``), broadcast exchanges,
+  materialized shuffle partitions, the spill catalog, async prefetch
+  queues while a batch is enqueued, and the double-buffer transfer stager
+  while a transfer is in flight.  A pinned batch is never donated.
+* **transient marks** — an opt-in marker set by producers whose outputs
+  are freshly computed, single-owner device buffers (range generation,
+  host->device uploads, multi-batch concats, join gathers, fused-stage
+  outputs).  Unmarked batches are declined: a batch of unknown provenance
+  may share leaf arrays with a retained batch (column-level aliasing that
+  object-identity pins cannot see — e.g. a rename wrapper over a cached
+  upload), so the safe default is "not donatable".
+
+Both checks are conservative: a false pin or a missing mark only costs a
+skipped donation, never correctness.  Encoded columns are declined
+structurally — their dictionaries are shared ACROSS batches by design
+(docs/encoded_columns.md), so donating one batch's pytree would free a
+dictionary other batches still reference.
+
+Pins key on ``id(batch)``; a pinner holds a strong reference for the
+pin's lifetime, so the id cannot be recycled while the pin is live.  A
+weakref reaper drops stale entries when a pinned batch is garbage
+collected without an explicit unpin (e.g. spill-catalog registrants whose
+handle outlives the batch object).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, Tuple
+
+#: observability for tests (test_whole_stage.py donation-safety suite)
+STATS = {"pins": 0, "unpins": 0, "donated": 0, "declined_pinned": 0,
+         "declined_not_transient": 0, "declined_encoded": 0}
+
+_LOCK = threading.Lock()
+_PINS: Dict[int, int] = {}          # id(batch) -> refcount
+_REAPERS: Dict[int, Any] = {}       # id(batch) -> weakref (GC cleanup)
+
+
+def _drop(bid: int) -> None:
+    with _LOCK:
+        _PINS.pop(bid, None)
+        _REAPERS.pop(bid, None)
+
+
+def pin_batch(batch) -> None:
+    """Record that ``batch`` is retained by a subsystem (see module doc).
+    Idempotent per retainer via refcounting; pair with :func:`unpin_batch`
+    at release, or rely on the GC reaper for retainers whose release point
+    is the batch's own death."""
+    if batch is None:
+        return
+    with _LOCK:
+        bid = id(batch)
+        _PINS[bid] = _PINS.get(bid, 0) + 1
+        STATS["pins"] += 1
+        if bid not in _REAPERS:
+            try:
+                _REAPERS[bid] = weakref.ref(
+                    batch, lambda _r, bid=bid: _drop(bid))
+            except TypeError:  # non-weakrefable carrier: entry stays until
+                pass           # explicitly unpinned (conservative)
+
+
+def unpin_batch(batch) -> None:
+    if batch is None:
+        return
+    with _LOCK:
+        bid = id(batch)
+        n = _PINS.get(bid)
+        if n is None:
+            return
+        STATS["unpins"] += 1
+        if n <= 1:
+            _PINS.pop(bid, None)
+            _REAPERS.pop(bid, None)
+        else:
+            _PINS[bid] = n - 1
+
+
+def is_pinned(batch) -> bool:
+    with _LOCK:
+        return _PINS.get(id(batch), 0) > 0
+
+
+def pinned_count() -> int:
+    with _LOCK:
+        return len(_PINS)
+
+
+# --------------------------------------------------------------------------
+# transient provenance marks
+# --------------------------------------------------------------------------
+
+def mark_transient(batch):
+    """Mark ``batch`` as freshly computed and single-owner (set only at
+    producer sites whose output buffers cannot alias retained batches).
+    Returns the batch for chaining."""
+    try:
+        batch._srt_transient = True
+    except AttributeError:  # pragma: no cover - frozen/odd carriers
+        pass
+    return batch
+
+
+def is_transient(batch) -> bool:
+    return bool(getattr(batch, "_srt_transient", False))
+
+
+# --------------------------------------------------------------------------
+# the donation verdict
+# --------------------------------------------------------------------------
+
+def _has_encoded_columns(batch) -> bool:
+    from ..columnar.encoded import DictEncodedColumn, RLEColumn
+    return any(isinstance(c, (DictEncodedColumn, RLEColumn))
+               for c in getattr(batch, "columns", ()))
+
+
+def may_donate(batch) -> Tuple[bool, str]:
+    """(ok, decline_reason) — whether a fused stage may donate ``batch``'s
+    buffers to its compiled program.  Reasons: ``not_transient`` (unknown
+    provenance), ``pinned`` (retained by the upload cache / broadcast /
+    materialized shuffle / spill tier / prefetch queue / transfer stager),
+    ``encoded`` (dictionary buffers are shared across batches)."""
+    if not is_transient(batch):
+        STATS["declined_not_transient"] += 1
+        return False, "not_transient"
+    if is_pinned(batch):
+        STATS["declined_pinned"] += 1
+        return False, "pinned"
+    if _has_encoded_columns(batch):
+        STATS["declined_encoded"] += 1
+        return False, "encoded"
+    return True, ""
+
+
+def count_donated() -> None:
+    STATS["donated"] += 1
+
+
+def stats_snapshot() -> Dict[str, int]:
+    with _LOCK:
+        return dict(STATS)

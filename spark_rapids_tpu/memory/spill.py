@@ -159,6 +159,12 @@ class BufferCatalog:
         (numpy-leaf) batches start at the HOST tier and never count as HBM."""
         import jax
         from ..shims import tree_flatten
+        # spill-tier retention pin (donation-safety, memory/retention.py):
+        # the registrant's batch shares leaves with the catalog record, so
+        # a fused stage must never donate it while registered.  The pin
+        # lifts via the registry's GC reaper when the batch object dies.
+        from . import retention as _ret
+        _ret.pin_batch(batch)
         leaves, treedef = tree_flatten(batch)
         was_device = any(isinstance(l, jax.Array) for l in leaves)
         size = batch_device_bytes(batch)

@@ -16,7 +16,8 @@ _BLOCKING_CATS = ("sync", "d2h")
 
 _ZERO = {"sync_ms": 0.0, "sync_n": 0, "compile_ms": 0.0, "compile_n": 0,
          "h2d_bytes": 0, "d2h_bytes": 0, "spill_ms": 0.0,
-         "sem_wait_ms": 0.0, "shuffle_ms": 0.0, "fault_n": 0}
+         "sem_wait_ms": 0.0, "shuffle_ms": 0.0, "fault_n": 0,
+         "stage_ms": 0.0, "stage_n": 0}
 
 
 def aggregate_by_exec(events: List[Dict[str, Any]]
@@ -51,6 +52,9 @@ def aggregate_by_exec(events: List[Dict[str, Any]]
             row["shuffle_ms"] += ms
         elif cat == "fault":
             row["fault_n"] += 1
+        elif cat == "stage":
+            row["stage_ms"] += ms
+            row["stage_n"] += 1
     return out
 
 
@@ -75,6 +79,13 @@ def trace_summary(events: List[Dict[str, Any]],
         "sem_wait_ms": round(tot["sem_wait_ms"], 3),
         "events": len(events),
     }
+    if tot["stage_n"]:
+        # whole-stage evidence (docs/whole_stage.md): fused-stage batch
+        # spans + total device dispatches per traced query
+        out["stage_count"] = int(tot["stage_n"])
+        out["stage_ms"] = round(tot["stage_ms"], 3)
+    if counters and counters.get("deviceDispatches"):
+        out["device_dispatches"] = int(counters["deviceDispatches"])
     if tot["fault_n"]:
         out["fault_count"] = int(tot["fault_n"])
     if dropped:

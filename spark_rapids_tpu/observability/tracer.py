@@ -26,6 +26,9 @@ Event categories:
                    bounded prefetch queue; sql/physical/async_exec.py)
 ``encode``         encoded-column lifecycle: scan-side dictionary encode
                    and decline-site materializations (columnar/encoded.py)
+``stage``          whole-stage program execution: one span per fused-stage
+                   batch (map-chain program call or terminal-stage batch
+                   production; sql/physical/fusion.py)
 =================  =========================================================
 
 Spans attribute to the *owning exec node* via a thread-local exec stack:
@@ -62,7 +65,7 @@ TRACING = {"on": False}
 #: known span categories (exported traces may add more; the checker and
 #: the report treat unknown categories as opaque)
 CATEGORIES = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
-              "shuffle", "sem_wait", "fault", "queue", "encode")
+              "shuffle", "sem_wait", "fault", "queue", "encode", "stage")
 
 #: default ring capacity (spark.rapids.tpu.trace.bufferEvents)
 DEFAULT_CAPACITY = 65536

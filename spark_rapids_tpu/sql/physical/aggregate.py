@@ -440,16 +440,17 @@ class HashAggregateExec(PhysicalPlan):
                 "partial", exprs_key(self._bound_grouping),
                 tuple(zip(slots_key,
                           (exprs_key(i) for i in self._bound_inputs))))
-            self._partial_fn = self._jit(self._make_partial_fn(()),
-                                         key=self._partial_key)
-            self._group_fn = self._jit(self._make_group_fn(()),
-                                       key=("grp",) + self._partial_key)
+            # programs built lazily on first use (whole-stage laziness
+            # contract): plan construction, AQE re-plans and CPU-fallback
+            # discards must register nothing in the kernel cache
+            self._partial_fn = None
+            self._group_fn = None
             self._reduce_fns: dict = {}
             self._fused_fns: dict = {}
             self._fused_complete_fns: dict = {}
             self._spec_key = self._partial_key  # no pre-steps yet
-        merge_key = ("merge", len(self.grouping), slots_key)
-        self._merge_fn = self._jit(self._merge_compute, key=merge_key)
+        self._merge_key = ("merge", len(self.grouping), slots_key)
+        self._merge_fn = None
         from .kernel_cache import exprs_key as _ek
         self._finalize_key = (
             "finalize", len(self.grouping), slots_key,
@@ -472,18 +473,44 @@ class HashAggregateExec(PhysicalPlan):
         """Whole-stage fusion: inline an upstream Filter/Project chain into
         the partial kernel (fusion.py).  The chain reproduces the old
         child's schema, so existing bound expressions stay valid; fused
-        filters contribute a live-row mask instead of compacting."""
+        filters contribute a live-row mask instead of compacting.  The
+        stage becomes the unit of the kernel cache: one stage-signature
+        key (partial key + member fuse keys) replaces the members' per-op
+        keys, and the programs stay lazy — nothing registers until the
+        first batch executes."""
         self._pre_steps = list(steps)
         self.children = (new_child,)
-        key = self._partial_key + tuple(s._fuse_key() for s in steps)
-        self._partial_fn = self._jit(self._make_partial_fn(steps), key=key)
-        self._group_fn = self._jit(self._make_group_fn(steps),
-                                   key=("grp",) + key)
+        self._partial_fn = None
+        self._group_fn = None
         self._reduce_fns = {}
         self._fused_fns = {}
         self._fused_complete_fns = {}
         self._spec_key = self._partial_key + tuple(
             s._fuse_key() for s in steps)
+
+    def _stage_partial_key(self):
+        return self._partial_key + tuple(
+            s._fuse_key() for s in self._pre_steps)
+
+    def _get_partial_fn(self):
+        if self._partial_fn is None:
+            self._partial_fn = self._jit(
+                self._make_partial_fn(self._pre_steps),
+                key=self._stage_partial_key())
+        return self._partial_fn
+
+    def _get_group_fn(self):
+        if self._group_fn is None:
+            self._group_fn = self._jit(
+                self._make_group_fn(self._pre_steps),
+                key=("grp",) + self._stage_partial_key())
+        return self._group_fn
+
+    def _get_merge_fn(self):
+        if self._merge_fn is None:
+            self._merge_fn = self._jit(self._merge_compute,
+                                       key=self._merge_key)
+        return self._merge_fn
 
     # --- schema -----------------------------------------------------------
     @property
@@ -664,6 +691,8 @@ class HashAggregateExec(PhysicalPlan):
             fused = self._fused_complete_fns[spec] = \
                 self._fused_complete_fn(spec)
         from ...memory.retry import SplitAndRetryOOM
+        from .base import count_stage_dispatch
+        count_stage_dispatch()
         try:
             out, ng = fused(batch)
         except SplitAndRetryOOM:
@@ -684,8 +713,10 @@ class HashAggregateExec(PhysicalPlan):
         that size and run group+reduce as ONE program with ONE sync — on
         the TPU tunnel every extra program boundary and sync is a full
         network round trip."""
+        from .base import count_stage_dispatch
         if self.backend != TPU:
-            return self._partial_fn(batch)
+            count_stage_dispatch()
+            return self._get_partial_fn()(batch)
         from ...columnar.column import bucket_capacity
         spec_key = self._spec_key
         spec = _OUT_SPECULATION.get(spec_key)
@@ -693,13 +724,15 @@ class HashAggregateExec(PhysicalPlan):
             fused = self._fused_fns.get(spec)
             if fused is None:
                 fused = self._fused_fns[spec] = self._fused_partial_fn(spec)
+            count_stage_dispatch()
             out, ng = fused(batch)
             ng_host = int(ng)
             if ng_host <= spec:
                 return out.with_known_rows(ng_host)
             # mis-speculation: groups past `spec` were dropped — discard
             # and take the exact path below (which re-records the size)
-        batch2, mask, rank64, ng = self._group_fn(batch)
+        count_stage_dispatch(2)  # group phase + sized reduce
+        batch2, mask, rank64, ng = self._get_group_fn()(batch)
         ng_host = int(ng)
         n = max(ng_host, 1)
         out_size = min(bucket_capacity(n, minimum=64), batch2.capacity)
@@ -811,7 +844,7 @@ class HashAggregateExec(PhysicalPlan):
             batches = [p.get() for p in g.parts]
             merged = batches[0] if len(batches) == 1 else \
                 ColumnarBatch.concat(batches)
-            return self._merge_fn(merged).shrunk()
+            return self._get_merge_fn()(merged).shrunk()
 
         def split_group(g: "_Group"):
             if len(g.parts) >= 2:
@@ -962,7 +995,7 @@ class HashAggregateExec(PhysicalPlan):
         pseudo = []
         total_groups = 0
         for b in batches:
-            batch2, mask, rank64, ng = self._group_fn(b)
+            batch2, mask, rank64, ng = self._get_group_fn()(b)
             ng0 = int(ng)
             total_groups += max(ng0, 1)
             OUT = min(bucket_capacity(max(ng0, 1),
@@ -1079,7 +1112,9 @@ class HashAggregateExec(PhysicalPlan):
                       for fi in self._special}
             yield self._special_impl(OUT, widths)(b2, mask, rank64, ng)
             return
-        batch2, mask, rank64, ng = self._group_fn(merged)
+        from .base import count_stage_dispatch
+        count_stage_dispatch(2)  # group phase + special reduce
+        batch2, mask, rank64, ng = self._get_group_fn()(merged)
         ng0 = int(ng)  # ONE sync; global aggregates already floored to 1
         maxc = self._max_group_count(self.xp, rank64, mask,
                                      batch2.capacity)

@@ -86,6 +86,8 @@ class AsyncPrefetchExec(PhysicalPlan):
         q: "queue.Queue" = queue.Queue(self.depth)
         cancel = threading.Event()
 
+        from ...memory import retention as _ret
+
         def produce():
             try:
                 # the task's context must be visible on this thread
@@ -93,7 +95,12 @@ class AsyncPrefetchExec(PhysicalPlan):
                 # errstate is thread-local in numpy, mirror execute_all's
                 with tctx.as_current(), np.errstate(all="ignore"):
                     for batch in child.execute(pid, tctx):
+                        # pinned while enqueued: a queued batch is held by
+                        # TWO parties (queue + eventual consumer) and must
+                        # never be donation-eligible in that window
+                        _ret.pin_batch(batch)
                         if not _put(q, batch, cancel):
+                            _ret.unpin_batch(batch)  # consumer left
                             return
                 _put(q, _DONE, cancel)
             except BaseException as e:  # noqa: BLE001 - re-raised consumer-side
@@ -118,6 +125,8 @@ class AsyncPrefetchExec(PhysicalPlan):
                     break
                 if isinstance(item, _Raised):
                     raise item.exc
+                # handoff complete: the consumer is now the sole holder
+                _ret.unpin_batch(item)
                 produced += 1
                 yield item
         finally:

@@ -339,7 +339,7 @@ class PhysicalPlan:
         return out
 
     # --- jit plumbing for device execs ------------------------------------
-    def _jit(self, fn, key=None):
+    def _jit(self, fn, key=None, donate_argnums=None):
         """jit on the tpu backend, eager numpy on cpu.
 
         When ``key`` is given, the jitted wrapper is shared process-wide via
@@ -348,13 +348,20 @@ class PhysicalPlan:
         reference's kernel-reuse model (SURVEY §3.3).  The key must capture
         everything that affects the traced computation besides the input
         batch itself (bound expressions, static params, output names).
+
+        ``donate_argnums`` builds a donated-buffer program (whole-stage
+        donation): the key must carry a donation marker, the caller must
+        clear the arguments through ``retention.may_donate``, and the OOM
+        guard runs non-retriable (donated inputs cannot be re-presented).
         """
         if self.backend == TPU:
             from ...memory.oom_guard import guard_device_oom
             if key is not None:
                 from .kernel_cache import cached_jit
                 return guard_device_oom(
-                    cached_jit((type(self).__name__,) + tuple(key), fn))
+                    cached_jit((type(self).__name__,) + tuple(key), fn,
+                               donate_argnums=donate_argnums),
+                    retriable=not donate_argnums)
             import jax
             return guard_device_oom(jax.jit(fn))
         return fn
@@ -382,6 +389,18 @@ class PhysicalPlan:
         for c in self.children:
             lines.append(c.tree_string(level + 1))
         return "\n".join(lines)
+
+
+def count_stage_dispatch(n: float = 1) -> None:
+    """Account ``n`` device-program dispatches to the current task's
+    ``stageOpDispatches`` metric — the stage-scope dispatch counter
+    (docs/whole_stage.md): only ops that whole-stage fusion can absorb
+    (filters, projects, aggregate partial programs, join probe programs)
+    count here, so the fused-vs-unfused ratio isolates exactly the
+    dispatches fusion removes."""
+    t = TaskContext.current()
+    if t is not None:
+        t.inc_metric("stageOpDispatches", n)
 
 
 def profile_report(phys: "PhysicalPlan") -> str:

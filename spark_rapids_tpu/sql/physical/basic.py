@@ -70,9 +70,16 @@ def _cached_upload(table, backend: str, conf=None) -> list:
     from ...columnar.encoded import encode_params
     ck = (backend, thr, encode_params(conf))
     if ck not in per_backend:
-        per_backend[ck] = [
+        from ...memory import retention as _ret
+        batches = [
             _to_backend_batch(arrow_to_device(p, conf=conf), backend)
             for p in split_for_upload(table, conf)]
+        # resident batches are served to EVERY rescan of this relation:
+        # pin them so a downstream fused stage never donates their
+        # buffers (memory/retention.py donation-safety contract)
+        for b in batches:
+            _ret.pin_batch(b)
+        per_backend[ck] = batches
     return per_backend[ck]
 
 
@@ -120,9 +127,12 @@ class ProjectExec(PhysicalPlan):
                 self._out.append(AttributeReference(e.sql(), e.data_type,
                                                     e.nullable))
         from .kernel_cache import exprs_key
-        self._fn = self._jit(self._compute,
-                             key=(exprs_key(self._bound),
-                                  tuple(a.name for a in self._out)))
+        # program built lazily on first execute: a fused/discarded plan
+        # (whole-stage member, AQE re-plan, CPU fallback) must register
+        # nothing in the kernel cache
+        self._fn = None
+        self._fn_key = (exprs_key(self._bound),
+                        tuple(a.name for a in self._out))
 
     @property
     def output(self):
@@ -146,8 +156,12 @@ class ProjectExec(PhysicalPlan):
         return ("P", exprs_key(self._bound), tuple(a.name for a in self._out))
 
     def execute(self, pid, tctx):
+        fn = self._fn
+        if fn is None:
+            fn = self._fn = self._jit(self._compute, key=self._fn_key)
         for batch in self.children[0].execute(pid, tctx):
-            yield self._fn(batch)
+            tctx.inc_metric("stageOpDispatches")
+            yield fn(batch)
 
     def simple_string(self):
         return f"{self.node_name()} [{', '.join(e.sql() for e in self.exprs)}]"
@@ -208,8 +222,9 @@ class FilterExec(PhysicalPlan):
         from ...columnar.encoded import op_enabled
         self._enc_filter = op_enabled("filter")
         from .kernel_cache import expr_key
-        self._fn = self._jit(self._compute,
-                             key=(expr_key(self._bound), self._enc_filter))
+        # lazy program (see ProjectExec.__init__)
+        self._fn = None
+        self._fn_key = (expr_key(self._bound), self._enc_filter)
 
     @property
     def output(self):
@@ -271,8 +286,12 @@ class FilterExec(PhysicalPlan):
         return ("F", expr_key(self._bound), self._enc_filter)
 
     def execute(self, pid, tctx):
+        fn = self._fn
+        if fn is None:
+            fn = self._fn = self._jit(self._compute, key=self._fn_key)
         for batch in self.children[0].execute(pid, tctx):
-            yield self._fn(batch)
+            tctx.inc_metric("stageOpDispatches")
+            yield fn(batch)
 
     def simple_string(self):
         return f"{self.node_name()} ({self.condition.sql()})"
@@ -303,13 +322,15 @@ class RangeExec(PhysicalPlan):
         hi = min(lo + per, total)
         xp = self.xp
         pos = lo
+        from ...memory.retention import mark_transient
         while pos < hi:
             n = min(self.batch_rows, hi - pos)
             cap = bucket_capacity(n)
             ids = (self.start
                    + (xp.arange(cap, dtype=xp.int64) + pos) * self.step)
             col = DeviceColumn(T.LONG, ids, xp.ones(cap, dtype=bool))
-            yield ColumnarBatch.make(["id"], [col], n)
+            # freshly generated, single-owner buffers: donation-eligible
+            yield mark_transient(ColumnarBatch.make(["id"], [col], n))
             pos += n
 
     def simple_string(self):

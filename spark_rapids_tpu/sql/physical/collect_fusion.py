@@ -400,16 +400,29 @@ def fuse_collect_tail(phys: PhysicalPlan) -> PhysicalPlan:
         sort = inner
         agg = inner.children[0]
     from .basic import ProjectExec
+    from .fusion import FusedStageExec
+
+    def _unwrap_stage(n):
+        """A FusedStageExec wrapping an aggregate terminal IS that
+        aggregate for tail-fusion purposes: the absorbed pre-steps ride
+        inside the aggregate's own fused programs, so the collect tail
+        composes them the same way (docs/whole_stage.md)."""
+        if isinstance(n, FusedStageExec) \
+                and isinstance(n.terminal, HashAggregateExec):
+            return n.terminal
+        return n
 
     def _agg_below(n):
         """n, or its child past one device rename/compute Project (the
         SQL front-end's `__agg_N AS name` layer), if a HashAggregateExec
         sits there; else None.  Returns (project|None, agg)."""
+        n = _unwrap_stage(n)
         if isinstance(n, HashAggregateExec):
             return None, n
-        if (isinstance(n, ProjectExec) and n.backend != CPU
-                and isinstance(n.children[0], HashAggregateExec)):
-            return n, n.children[0]
+        if isinstance(n, ProjectExec) and n.backend != CPU:
+            inner = _unwrap_stage(n.children[0])
+            if isinstance(inner, HashAggregateExec):
+                return n, inner
         return None, None
 
     skip_ex = None

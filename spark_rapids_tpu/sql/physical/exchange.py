@@ -92,6 +92,13 @@ class ShuffleExchangeExec(PhysicalPlan):
             with _trace.span("shuffle", "exchange.materialize",
                              partitions=self.num_partitions()):
                 self._materialize(tctx)
+            # materialized partitions are RETAINED by this exec and may be
+            # re-served (shared-subtree parents, AQE readers): pin them so
+            # a downstream fused stage never donates their buffers
+            from ...memory import retention as _ret
+            for part in self._materialized or []:
+                for b in part:
+                    _ret.pin_batch(b)
 
     def _materialize(self, tctx: TaskContext):
         """Map side: split each child batch by target and hand the pieces to
@@ -443,6 +450,10 @@ class BroadcastExchangeExec(PhysicalPlan):
             # THIS object for all consumers to share one instance
             if getattr(self._cached, "_join_build_sides", None) is None:
                 self._cached._join_build_sides = {}
+            # the broadcast batch is shared by every probe partition for
+            # the plan's lifetime: pin it against whole-stage donation
+            from ...memory import retention as _ret
+            _ret.pin_batch(self._cached)
         return self._cached
 
     def execute(self, pid, tctx):

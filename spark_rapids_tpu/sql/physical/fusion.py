@@ -1,15 +1,40 @@
-"""Whole-stage fusion — one compiled XLA program per pipeline segment.
+"""Whole-stage fusion — one compiled XLA program per pipeline stage.
 
 The reference gets kernel fusion two ways: cuDF fuses within a kernel, and
 tiered projection dedups subexpressions (``basicPhysicalOperators.scala:500``).
 On TPU the equivalent (and bigger) lever is compiling a whole
-filter→project→…[→partial-agg] chain as ONE jitted program:
+scan→filter→project→…→terminal chain as ONE jitted program:
 
 * fused filters don't compact — the predicate ANDs into a live-row mask that
   threads through the stage (one compaction at the stage end, or none at all
-  when the terminal is a hash aggregate, which consumes the mask directly);
+  when the terminal is a hash aggregate or a join probe, which consume the
+  mask directly);
 * XLA fuses the elementwise project math into its consumers;
 * no intermediate batch materialization between member ops.
+
+Stage shapes (docs/whole_stage.md):
+
+* **map stage** — a chain of >= 2 Filter/Project ops compiled as one
+  program with a single terminal compaction.  The only shape eligible for
+  input-buffer DONATION (``wholeStage.donation.enabled``): when the
+  retention registry (memory/retention.py) proves the input batch is
+  sole-owner, the program is built with ``donate_argnums`` so the output
+  reuses the input's HBM.  Terminal stages never donate — their inputs
+  are registered with the spill tier for the OOM retry protocol.
+* **aggregate terminal** — ``HashAggregateExec`` (partial/complete)
+  absorbs the upstream chain into its own partial/group/reduce programs
+  (``absorb_pre_steps``) and the whole stage appears as one
+  ``FusedStageExec`` node wrapping the aggregate.
+* **probe terminal** — a hash join absorbs the probe-side chain
+  (``BaseJoinExec.absorb_probe_steps``); the fused filter mask feeds the
+  probe search directly and the cached build-side artifact enters the
+  program as a cross-call constant.  The join node itself is the stage
+  node (wrapping both children would desynchronize the probe/build
+  references the async planner pass relies on).
+
+Programs are built LAZILY on first execute under one stage-signature
+kernel-cache key (member ``_fuse_key``s + encode params + input layout),
+so AQE-replanned or CPU-fallback-discarded plans register nothing.
 
 The planner pass (``fuse_stages``) runs after transition insertion and only
 touches same-backend TPU chains; the CPU fallback path keeps per-op
@@ -18,27 +43,58 @@ execution, which also keeps it a more independent oracle.
 
 from __future__ import annotations
 
-from typing import List
+import time
+from typing import List, Optional
 
 from ...columnar.batch import ColumnarBatch
+from ...memory import retention as _ret
+from ...observability import tracer as _trace
 from .base import TPU, PhysicalPlan
 from .basic import FilterExec, ProjectExec, compact_batch
 
 
 class FusedStageExec(PhysicalPlan):
-    """A chain of Filter/Project members compiled as one program with a
-    single terminal compaction."""
+    """A whole pipeline stage: a chain of Filter/Project members plus an
+    optional terminal (hash aggregate), compiled as one program."""
 
-    def __init__(self, members: List[PhysicalPlan], child: PhysicalPlan):
+    def __init__(self, members: List[PhysicalPlan], child: PhysicalPlan,
+                 terminal: Optional[PhysicalPlan] = None):
         super().__init__(child)
         self.backend = TPU
-        self.members = members  # producer -> consumer order
-        key = ("stage",) + tuple(m._fuse_key() for m in members)
-        self._fn = self._jit(self._compute, key=key)
+        self.members = list(members)  # producer -> consumer order
+        #: stage terminal (HashAggregateExec partial/complete) — owns the
+        #: fused programs via its absorbed pre-steps; execution delegates
+        self.terminal = terminal
+        #: donate(bool) -> compiled program; built lazily on first execute
+        #: (plan-construction must register nothing in the kernel cache)
+        self._fns: dict = {}
 
     @property
     def output(self):
+        if self.terminal is not None:
+            return self.terminal.output
         return self.members[-1].output
+
+    def num_partitions(self):
+        return self.children[0].num_partitions()
+
+    def _stage_key(self, conf):
+        """The ONE stage-signature kernel-cache key replacing the members'
+        per-op keys: member fuse keys + encode params + input layout."""
+        from ...columnar.encoded import encode_params
+        layout = tuple((a.name, str(a.dtype))
+                       for a in self.children[0].output)
+        return (("stage",) + tuple(m._fuse_key() for m in self.members)
+                + (encode_params(conf), layout))
+
+    def _get_fn(self, donate: bool, conf):
+        fn = self._fns.get(donate)
+        if fn is None:
+            key = self._stage_key(conf) + (("donate",) if donate else ())
+            fn = self._jit(self._compute, key=key,
+                           donate_argnums=(0,) if donate else None)
+            self._fns[donate] = fn
+        return fn
 
     def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
         xp = self.xp
@@ -47,13 +103,71 @@ class FusedStageExec(PhysicalPlan):
             batch, mask = m._fuse_step(batch, mask, xp)
         return compact_batch(xp, batch, mask)
 
+    def _donation_on(self, tctx) -> bool:
+        from ...config import WHOLE_STAGE_DONATION
+        return (self.terminal is None
+                and bool(tctx.conf.get(WHOLE_STAGE_DONATION)))
+
+    def _stage_label(self) -> str:
+        inner = "+".join(m.node_name() for m in self.members)
+        if self.terminal is not None:
+            inner += "+" + self.terminal.node_name()
+        return f"stage.{inner}"
+
     def execute(self, pid, tctx):
+        if self.terminal is not None:
+            yield from self._execute_terminal(pid, tctx)
+            return
+        donate_on = self._donation_on(tctx)
+        label = self._stage_label()
         for batch in self.children[0].execute(pid, tctx):
             tctx.inc_metric("fusedStageBatches")
-            yield self._fn(batch)
+            tctx.inc_metric("wholeStageDispatches")
+            tctx.inc_metric("stageOpDispatches")
+            donate = False
+            if donate_on:
+                donate, _why = _ret.may_donate(batch)
+                if donate:
+                    tctx.inc_metric("wholeStageDonatedBatches")
+                    _ret.count_donated()
+                else:
+                    tctx.inc_metric("wholeStageDonationDeclined")
+            fn = self._get_fn(donate, tctx.conf)
+            with _trace.span("stage", label, partition=pid):
+                out = fn(batch)
+            yield _ret.mark_transient(out)
+
+    def _execute_terminal(self, pid, tctx):
+        """Delegate to the terminal exec (its absorbed pre-steps ARE the
+        fused stage program).  The terminal's child references are re-synced
+        from this node's children first, so planner rewrites applied above
+        this node (async prefetch wrappers, AQE substitutions) stay
+        visible to the delegated execution.  Under the parallel partition
+        scheduler every task writes the SAME post-planning tuple, so the
+        concurrent re-sync is idempotent."""
+        t = self.terminal
+        t.children = self.children
+        label = self._stage_label()
+        tracing = _trace.TRACING["on"]
+        it = t.execute(pid, tctx)
+        while True:
+            t0 = time.perf_counter() if tracing else 0.0
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            if tracing:
+                _trace.get_tracer().complete(
+                    "stage", label, t0, time.perf_counter() - t0,
+                    partition=pid)
+            tctx.inc_metric("fusedStageBatches")
+            yield batch
 
     def simple_string(self):
         inner = " -> ".join(m.node_name() for m in self.members)
+        if self.terminal is not None:
+            inner += (" -> " if inner else "") \
+                + self.terminal.simple_string()
         return f"{self.node_name()} [{inner}]"
 
 
@@ -74,24 +188,82 @@ def _collect_chain(plan: PhysicalPlan):
     return chain, node
 
 
-def fuse_stages(plan: PhysicalPlan) -> PhysicalPlan:
+def fuse_stages(plan: PhysicalPlan, conf=None) -> PhysicalPlan:
     """Bottom-up rewrite: absorb Filter/Project chains into their terminal
-    hash aggregate's partial kernel, and collapse remaining chains of >= 2
-    map ops into a FusedStageExec."""
+    hash aggregate's partial kernel or a hash join's probe phase (stage
+    terminals, gated by ``spark.rapids.tpu.sql.wholeStage.enabled``), and
+    collapse remaining chains of >= 2 map ops into a FusedStageExec."""
+    from ...config import WHOLE_STAGE_ENABLED, RapidsConf
     from .aggregate import HashAggregateExec
+    from .join import BroadcastHashJoinExec, ShuffledHashJoinExec
 
-    if (isinstance(plan, HashAggregateExec) and plan.backend == TPU
+    conf = conf or RapidsConf.get_global()
+    whole = bool(conf.get(WHOLE_STAGE_ENABLED))
+
+    if (whole and isinstance(plan, HashAggregateExec)
+            and plan.backend == TPU
             and plan.mode in ("partial", "complete")):
         chain, below = _collect_chain(plan.children[0])
         if chain:
             plan.absorb_pre_steps(chain, below)
+            fused = FusedStageExec(chain, below, terminal=plan)
+            fused.children = (fuse_stages(below, conf),)
+            return fused
+
+    if (whole and plan.backend == TPU
+            and isinstance(plan, (ShuffledHashJoinExec,
+                                  BroadcastHashJoinExec))):
+        pi = 1 if plan._flipped else 0
+        chain, below = _collect_chain(plan.children[pi])
+        if chain:
+            plan.absorb_probe_steps(chain, below)
 
     if _fusible(plan):
         chain, below = _collect_chain(plan)
         if len(chain) >= 2:
             fused = FusedStageExec(chain, below)
-            fused.children = (fuse_stages(below),)
+            fused.children = (fuse_stages(below, conf),)
             return fused
 
-    plan.children = tuple(fuse_stages(c) for c in plan.children)
+    plan.children = tuple(fuse_stages(c, conf) for c in plan.children)
+    return plan
+
+
+def annotate_stage_coverage(plan: PhysicalPlan) -> PhysicalPlan:
+    """Record plan-time fusion coverage on the root's metrics:
+    ``wholeStageOps`` counts ops executing inside a fused stage program
+    (map members + terminals), ``unfusedOps`` counts stage-eligible ops
+    (Filter/Project/partial-or-complete HashAggregate/hash-join probes)
+    left on per-op dispatch.  Folded into last_query_metrics via the
+    standard collect_metrics walk."""
+    from .aggregate import HashAggregateExec
+    from .collect_fusion import FusedCollectExec
+    from .join import BaseJoinExec, NestedLoopJoinExec
+
+    fused = unfused = 0
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, FusedStageExec):
+            fused += len(n.members) + (1 if n.terminal is not None else 0)
+        elif isinstance(n, FusedCollectExec):
+            fused += 1 + len(getattr(n._agg, "_pre_steps", ()))
+        elif isinstance(n, (FilterExec, ProjectExec)):
+            unfused += 1
+        elif isinstance(n, HashAggregateExec) \
+                and n.mode in ("partial", "complete"):
+            if n._pre_steps:
+                fused += 1 + len(n._pre_steps)
+            else:
+                unfused += 1
+        elif isinstance(n, BaseJoinExec) \
+                and not isinstance(n, NestedLoopJoinExec):
+            steps = getattr(n, "_probe_steps", ())
+            if steps:
+                fused += 1 + len(steps)
+            else:
+                unfused += 1
+        stack.extend(n.children)
+    plan.metrics["wholeStageOps"] = float(fused)
+    plan.metrics["unfusedOps"] = float(unfused)
     return plan

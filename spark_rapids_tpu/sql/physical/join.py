@@ -90,6 +90,11 @@ class BaseJoinExec(PhysicalPlan):
 
         self._out_left = list(left.output)
         self._out_right = list(right.output)
+        #: pair-layout schemas, frozen at construction: absorb_probe_steps
+        #: rewires self._probe BELOW the fused chain, but the pair batch is
+        #: built from the POST-chain probe the join was bound against
+        self._probe_attrs = list(self._probe.output)
+        self._build_attrs = list(self._build.output)
         self._bound_pkeys = [bind_references(e, self._probe.output)
                              for e in self._probe_keys]
         self._bound_bkeys = [bind_references(e, self._build.output)
@@ -105,18 +110,72 @@ class BaseJoinExec(PhysicalPlan):
                      expr_key(self._bound_cond)
                      if self._bound_cond is not None else None,
                      tuple(a.name for a in self.output))
-        self._build_fn = self._jit(self._build_info,
-                                   key=("build", self._sig))
+        #: whole-stage probe terminal (docs/whole_stage.md): a fused
+        #: upstream Filter/Project chain applied INSIDE every probe-side
+        #: program — the fused filter mask feeds the probe search
+        #: directly, nothing compacts or materializes between the scan
+        #: and the search
+        self._probe_steps: tuple = ()
         self._gather_cache: Dict[int, object] = {}
+        # programs built lazily on first use (whole-stage laziness
+        # contract — AQE shape-only instances register nothing)
+        self._build_fn = None
+        self._prep_fn = None
+        self._probe_fn = None
         # join fast path: build-side sort cached per build batch + probe-only
         # tuple search; array/map keys keep the union-rank fallback
         self._fast_ok = fastpath_supported(
             [e.data_type for e in self._bound_pkeys + self._bound_bkeys])
         self._bs_key = ("bs", exprs_key(self._bound_bkeys))
-        self._prep_fn = self._jit(self._prepare_build,
-                                  key=("prep", self._bs_key))
-        self._probe_fn = self._jit(self._probe_info,
-                                   key=("probe", self._sig))
+
+    # --- whole-stage probe fusion ----------------------------------------
+    def absorb_probe_steps(self, steps, new_probe: PhysicalPlan) -> None:
+        """Fuse an upstream probe-side Filter/Project chain into this
+        join's probe phase (fusion.py).  The chain reproduced the probe
+        schema this join was bound against, so bound keys/conditions and
+        the output layout stay valid; fused filters contribute a live-row
+        mask consumed by the probe search instead of compacting.  The
+        stage signature joins ``_sig``, so probe/gather programs never
+        alias their unfused counterparts, and the compiled-fn caches are
+        reset (they are lazy, so nothing was registered yet at plan
+        time)."""
+        self._probe_steps = tuple(steps)
+        self._probe = new_probe
+        kids = list(self.children)
+        kids[1 if self._flipped else 0] = new_probe
+        self.children = tuple(kids)
+        self._sig = self._sig + (
+            ("stage",) + tuple(s._fuse_key() for s in steps),)
+        self._build_fn = None
+        self._probe_fn = None
+        self._gather_cache = {}
+
+    def _apply_probe_steps(self, probe: ColumnarBatch, xp):
+        """(post-chain batch, live mask) — runs INSIDE jitted programs;
+        elementwise step math re-evaluated per program fuses into its
+        consumer, costing zero extra dispatches."""
+        mask = probe.row_mask()
+        for s in self._probe_steps:
+            probe, mask = s._fuse_step(probe, mask, xp)
+        return probe, mask
+
+    def _get_build_fn(self):
+        if self._build_fn is None:
+            self._build_fn = self._jit(self._build_info,
+                                       key=("build", self._sig))
+        return self._build_fn
+
+    def _get_prep_fn(self):
+        if self._prep_fn is None:
+            self._prep_fn = self._jit(self._prepare_build,
+                                      key=("prep", self._bs_key))
+        return self._prep_fn
+
+    def _get_probe_fn(self):
+        if self._probe_fn is None:
+            self._probe_fn = self._jit(self._probe_info,
+                                       key=("probe", self._sig))
+        return self._probe_fn
 
     # --- schema -----------------------------------------------------------
     @property
@@ -143,11 +202,12 @@ class BaseJoinExec(PhysicalPlan):
     def _build_info(self, probe: ColumnarBatch, build: ColumnarBatch
                     ) -> JoinInfo:
         xp = self.xp
+        probe, lmask = self._apply_probe_steps(probe, xp)
         pctx = EvalContext(probe, xp=xp)
         bctx = EvalContext(build, xp=xp)
         pkeys = [e.eval(pctx) for e in self._bound_pkeys]
         bkeys = [e.eval(bctx) for e in self._bound_bkeys]
-        return join_build(xp, pkeys, bkeys, probe.row_mask(), build.row_mask())
+        return join_build(xp, pkeys, bkeys, lmask, build.row_mask())
 
     def _prepare_build(self, build: ColumnarBatch) -> JoinBuildSide:
         """Fast-path phase 0: sort the build side's key tuples (one jitted
@@ -159,16 +219,20 @@ class BaseJoinExec(PhysicalPlan):
 
     def _probe_info(self, probe: ColumnarBatch, build: ColumnarBatch,
                     bs: JoinBuildSide) -> JoinInfo:
-        """Fast-path phase 1: probe-only — key transform + one multi-key
-        binary search against the pre-sorted build side (plus run-end
-        lookups).  Build-unmatched flags are only materialized for full
-        joins, the one type that emits them (_norm_how is in the jit
-        sig, so the static flag can't alias programs)."""
+        """Fast-path phase 1: probe-only — fused probe steps + key
+        transform + one multi-key binary search against the pre-sorted
+        build side (plus run-end lookups).  With absorbed probe steps the
+        fused filter mask IS the probe live mask: filtered-out rows are
+        dead rows to the search, exactly like compaction padding.
+        Build-unmatched flags are only materialized for full joins, the
+        one type that emits them (_norm_how is in the jit sig, so the
+        static flag can't alias programs)."""
         xp = self.xp
+        probe, lmask = self._apply_probe_steps(probe, xp)
         pctx = EvalContext(probe, xp=xp)
         pkeys = [e.eval(pctx) for e in self._bound_pkeys]
         return probe_join_info(
-            xp, pkeys, probe.row_mask(), build.row_mask(), bs,
+            xp, pkeys, lmask, build.row_mask(), bs,
             need_b_matched=self._norm_how == "full",
             need_l_unmatched=self._norm_how in ("left", "full"))
 
@@ -232,6 +296,14 @@ class BaseJoinExec(PhysicalPlan):
         conf = tctx.conf if tctx is not None else None
         if not (E.op_enabled("join", conf) and self._fast_ok):
             return probe, build
+        from .basic import ProjectExec
+        if any(isinstance(s, ProjectExec) for s in self._probe_steps):
+            # fused probe projections change the probe schema, so the
+            # bound key ordinals no longer address the PRE-chain batch
+            # this host-side lowering inspects; decline (bit-identical by
+            # the decline-to-materialize property, docs/encoded_columns.md)
+            E._bump("join_code_declines")
+            return probe, build
         lowered: List[Tuple[int, int, object, object]] = []
         for pk, bk in zip(self._bound_pkeys, self._bound_bkeys):
             if not (isinstance(pk, BoundReference)
@@ -290,7 +362,7 @@ class BaseJoinExec(PhysicalPlan):
         bs = cache.get(key)
         if bs is None:
             with self._stage(tctx, "buildSort"):
-                bs = self._prep_fn(build)
+                bs = self._get_prep_fn()(build)
             STATS["build_sorts"] += 1
             if tctx is not None:
                 tctx.inc_metric("joinBuildSorts")
@@ -301,19 +373,23 @@ class BaseJoinExec(PhysicalPlan):
                    tctx: Optional[TaskContext]) -> JoinInfo:
         """Phase 1 dispatch: cached-build-side probe search when the key
         shapes support it, union-rank fallback otherwise.  Both produce
-        the same :class:`JoinInfo` contract (parity-tested)."""
+        the same :class:`JoinInfo` contract (parity-tested).  One device
+        dispatch either way — the stage-scope dispatch counter's probe
+        terminal (fused probe steps ride the same program)."""
+        from .base import count_stage_dispatch
+        count_stage_dispatch()
         if self._fast_path_on(tctx):
             bs = self._get_build_side(build, tctx)
             STATS["fastpath_probes"] += 1
             if tctx is not None:
                 tctx.inc_metric("joinFastpathProbes")
             with self._stage(tctx, "probeSearch"):
-                return self._probe_fn(probe, build, bs)
+                return self._get_probe_fn()(probe, build, bs)
         STATS["fallback_probes"] += 1
         if tctx is not None:
             tctx.inc_metric("joinFallbackProbes")
         with self._stage(tctx, "unionRankBuild"):
-            return self._build_fn(probe, build)
+            return self._get_build_fn()(probe, build)
 
     def _fetch_totals(self, info: JoinInfo,
                       tctx: Optional[TaskContext]) -> Tuple[int, int, int]:
@@ -345,8 +421,8 @@ class BaseJoinExec(PhysicalPlan):
                     maps: PairMaps) -> ColumnarBatch:
         lb = probe.gather(maps.l_idx, maps.l_ok, maps.num_out)
         rb = build.gather(maps.r_idx, maps.r_ok, maps.num_out)
-        names = tuple(a.name for a in self._probe.output) + \
-            tuple(a.name for a in self._build.output)
+        names = tuple(a.name for a in self._probe_attrs) + \
+            tuple(a.name for a in self._build_attrs)
         return ColumnarBatch(names, lb.columns + rb.columns, maps.num_out)
 
     def _eval_condition(self, pair: ColumnarBatch, inner_ok):
@@ -360,11 +436,16 @@ class BaseJoinExec(PhysicalPlan):
         xp = self.xp
         how = self._norm_how
         cond = self._bound_cond
+        # fused probe steps re-applied inside this program: the pair
+        # gather reads POST-chain columns and the live mask excludes
+        # filtered-out probe rows (elementwise recompute, zero extra
+        # dispatches — XLA fuses it into the gathers)
+        probe, lmask = self._apply_probe_steps(probe, xp)
         lcap, rcap = probe.capacity, build.capacity
 
         if how in _FILTER_JOINS and cond is None:
             matched = info.counts > 0
-            return self._emit_filter_join(probe, matched)
+            return self._emit_filter_join(probe, matched, lmask)
 
         if cond is None:
             maps = gather_pairs(xp, info, out_cap,
@@ -380,21 +461,25 @@ class BaseJoinExec(PhysicalPlan):
 
         if how in _FILTER_JOINS:
             matched = matched_per_row(xp, pass_mask, maps.l_idx, lcap) > 0
-            return self._emit_filter_join(probe, matched)
+            return self._emit_filter_join(probe, matched, lmask)
 
         final = self._assemble_with_pass(probe, build, maps, pass_mask,
-                                         out_cap)
+                                         out_cap, lmask)
         pair = self._pair_batch(probe, build, final)
         return self._project_output(pair, final)
 
     def _assemble_with_pass(self, probe: ColumnarBatch, build: ColumnarBatch,
-                            maps: PairMaps, pass_mask, out_cap: int
-                            ) -> PairMaps:
+                            maps: PairMaps, pass_mask, out_cap: int,
+                            lmask=None) -> PairMaps:
         """Compact pairs surviving the residual condition to the front, then
-        append unmatched-left/right rows per the (normalized) join type."""
+        append unmatched-left/right rows per the (normalized) join type.
+        ``lmask`` is the probe live mask (the fused-stage mask when probe
+        steps are absorbed; defaults to the batch's row mask)."""
         xp = self.xp
         how = self._norm_how
         lcap, rcap = probe.capacity, build.capacity
+        if lmask is None:
+            lmask = probe.row_mask()
         cp = compact_indices(xp, pass_mask)
         n_pass = xp.sum(pass_mask).astype(xp.int64)
         k = xp.arange(out_cap, dtype=xp.int64)
@@ -408,7 +493,7 @@ class BaseJoinExec(PhysicalPlan):
 
         if how in ("left", "full"):
             m = matched_per_row(xp, pass_mask, maps.l_idx, lcap) > 0
-            unl = probe.row_mask() & ~m
+            unl = lmask & ~m
             n_unl = xp.sum(unl.astype(xp.int64))
             ul = compact_indices(xp, unl)
             sel = (k >= num_out) & (k < num_out + n_unl)
@@ -430,17 +515,27 @@ class BaseJoinExec(PhysicalPlan):
         return PairMaps(l_idx.astype(xp.int32), r_idx.astype(xp.int32),
                         l_ok, r_ok, num_out.astype(xp.int32))
 
-    def _emit_filter_join(self, probe: ColumnarBatch, matched):
-        """semi/anti/existence output (left rows only)."""
+    def _emit_filter_join(self, probe: ColumnarBatch, matched, lmask=None):
+        """semi/anti/existence output (left rows only).  ``lmask`` is the
+        probe live mask (the fused-stage mask when probe steps are
+        absorbed — filtered-out rows must not resurface here)."""
         xp = self.xp
         how = self._norm_how
-        lmask = probe.row_mask()
+        if lmask is None:
+            lmask = probe.row_mask()
         if how == "existence":
             from ...columnar.column import DeviceColumn
             ex = DeviceColumn(T.BOOLEAN, matched & lmask,
                               xp.ones_like(matched))
             names = tuple(a.name for a in self._out_left) + ("exists",)
-            return ColumnarBatch(names, probe.columns + (ex,), probe.num_rows)
+            out = ColumnarBatch(names, probe.columns + (ex,),
+                                probe.num_rows)
+            if self._probe_steps:
+                # fused filters never compacted upstream — rows they
+                # dropped must not ride the existence passthrough out
+                from .basic import compact_batch
+                out = compact_batch(xp, out, lmask)
+            return out
         keep = lmask & (matched if how == "left_semi" else ~matched)
         n = xp.sum(keep).astype(xp.int32)
         perm = compact_indices(xp, keep)
@@ -450,7 +545,7 @@ class BaseJoinExec(PhysicalPlan):
     def _project_output(self, pair: ColumnarBatch, maps: PairMaps
                         ) -> ColumnarBatch:
         """Reorder pair columns [probe][build] into [left][right] output."""
-        np_, nb = len(self._probe.output), len(self._build.output)
+        np_, nb = len(self._probe_attrs), len(self._build_attrs)
         if self._flipped:
             idx = list(range(np_, np_ + nb)) + list(range(np_))
         else:
@@ -516,6 +611,7 @@ class BaseJoinExec(PhysicalPlan):
 
         def make():
             def impl(probe, build, info, offset):
+                probe, _lmask = self._apply_probe_steps(probe, self.xp)
                 maps = gather_pairs(
                     self.xp, info, chunk_cap,
                     with_unmatched_left=how in ("left", "full"),
@@ -536,6 +632,17 @@ class BaseJoinExec(PhysicalPlan):
 
     def _join_batches(self, probe: ColumnarBatch, build: ColumnarBatch,
                       tctx: TaskContext):
+        """Join output with donation provenance: gather-built outputs are
+        freshly computed device buffers, so they are marked transient for
+        downstream fused-stage donation (memory/retention.py).  Existence
+        outputs may alias probe columns (passthrough) and stay unmarked."""
+        from ...memory.retention import mark_transient
+        passthrough = self._norm_how == "existence"
+        for b in self._join_batches_impl(probe, build, tctx):
+            yield b if passthrough else mark_transient(b)
+
+    def _join_batches_impl(self, probe: ColumnarBatch,
+                           build: ColumnarBatch, tctx: TaskContext):
         """Yield the join output, chunked when it exceeds the configured
         chunk rows (condition/filter joins keep the single-buffer path —
         their residual bookkeeping spans the whole pair space).
@@ -614,6 +721,9 @@ class BaseJoinExec(PhysicalPlan):
         keys = ", ".join(f"{l.sql()}={r.sql()}" for l, r in
                          zip(self._probe_keys, self._build_keys))
         c = f" cond={self.condition.sql()}" if self.condition is not None else ""
+        if self._probe_steps:
+            chain = " -> ".join(s.node_name() for s in self._probe_steps)
+            c += f" [fusedProbe: {chain}]"
         return f"{self.node_name()} {self.how} [{keys}]{c}"
 
 
@@ -789,8 +899,8 @@ class NestedLoopJoinExec(BaseJoinExec):
             self._gather_cache[out_cap] = fn
         return fn
 
-    def _join_batches(self, probe: ColumnarBatch, build: ColumnarBatch,
-                      tctx: TaskContext):
+    def _join_batches_impl(self, probe: ColumnarBatch,
+                           build: ColumnarBatch, tctx: TaskContext):
         """Chunk the (probe x build) pair space for condition-free
         inner/cross products; everything else keeps the one-buffer path."""
         how = self._norm_how

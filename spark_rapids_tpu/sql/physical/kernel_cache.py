@@ -19,7 +19,7 @@ import os
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from ...observability import tracer as _trace
 from ...robustness import faults as _faults
@@ -34,7 +34,7 @@ _MAX_ENTRIES = int(os.environ.get("SRT_KERNEL_CACHE_SIZE", "1024"))
 _CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
 _LOCK = threading.Lock()
 _STATS = {"hits": 0, "misses": 0, "evictions": 0,
-          "compiles": 0, "compile_ms": 0.0}
+          "compiles": 0, "compile_ms": 0.0, "dispatches": 0}
 
 #: per-key trace+compile accounting (observability report: "compile ms
 #: per key"); keyed by the human-readable kernel label
@@ -63,8 +63,14 @@ class _TrackedKernel:
     def __call__(self, *args, **kwargs):
         _faults.maybe_inject("kernel.compile", exc=RuntimeError,
                              kernel=self._label)
+        # device-dispatch accounting (whole-stage fusion evidence,
+        # docs/whole_stage.md): one increment per compiled-program launch.
+        # Deliberately lock-free — a lost increment under contention is
+        # metric noise, a per-launch lock is hot-path cost.
+        _STATS["dispatches"] = _STATS["dispatches"] + 1
         if not _trace.TRACING["on"]:
             return self._fn(*args, **kwargs)
+        _trace.get_tracer().counter("deviceDispatches")
         cs = getattr(self._fn, "_cache_size", None)
         before = cs() if cs is not None else -1
         t0 = time.perf_counter()
@@ -118,13 +124,32 @@ def _trace_salt() -> Tuple:
         return ()
 
 
-def cached_jit(key: Tuple, fn: Callable) -> Callable:
+def donation_supported() -> bool:
+    """XLA:CPU accepts but ignores donate_argnums (and warns per unusable
+    buffer); only real device backends reclaim donated HBM.  The donation
+    DECISION (memory/retention.py) runs everywhere — this gates only
+    whether the marker reaches jax.jit."""
+    try:
+        import jax
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover - backend probe failure
+        return False
+
+
+def cached_jit(key: Tuple, fn: Callable,
+               donate_argnums: Optional[Tuple[int, ...]] = None) -> Callable:
     """Return the process-wide jitted callable for ``key``.
 
     ``fn`` is jitted and cached on first sight of ``key``; later callers get
     the cached wrapper (their own ``fn`` is dropped — the key must capture
     everything that affects the trace).  Least-recently-used entries are
     evicted past ``_MAX_ENTRIES``.
+
+    ``donate_argnums`` requests XLA input-buffer donation for those
+    argument positions (whole-stage fusion, docs/whole_stage.md).  The
+    caller owns BOTH safety obligations: the key must distinguish donating
+    from non-donating programs, and donated arguments must be sole-owner
+    batches (retention.may_donate) that are never touched after the call.
     """
     key = key + _trace_salt()
     with _LOCK:
@@ -135,8 +160,12 @@ def cached_jit(key: Tuple, fn: Callable) -> Callable:
             return cached
         _STATS["misses"] += 1
         import jax
+        if donate_argnums and donation_supported():
+            jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums))
+        else:
+            jitted = jax.jit(fn)
         label = f"{key[0]}#{abs(hash(key)) & 0xFFFF:04x}"
-        wrapper = _TrackedKernel(jax.jit(fn), label)
+        wrapper = _TrackedKernel(jitted, label)
         _CACHE[key] = wrapper
         while len(_CACHE) > _MAX_ENTRIES:
             _CACHE.popitem(last=False)
@@ -165,6 +194,7 @@ def clear_cache() -> None:
         _STATS["evictions"] = 0
         _STATS["compiles"] = 0
         _STATS["compile_ms"] = 0.0
+        _STATS["dispatches"] = 0
     # stale group-size speculations point at programs just dropped; a
     # speculated miss would recompile a size that may immediately
     # mis-speculate

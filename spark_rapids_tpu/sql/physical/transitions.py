@@ -44,7 +44,14 @@ def _staged(it, transfer, name: str):
     calling thread, dispatch its ``transfer`` on the one-slot stager,
     THEN yield batch N's completed result — ≤ 1 transfer in flight ahead
     of the consumer.  The stager brackets itself on the tracer's exec
-    stack so its spans attribute to the owning transition."""
+    stack so its spans attribute to the owning transition.  While a
+    transfer is in flight its input batch is pinned in the retention
+    registry (donation-safety: a staged batch is held by two threads)."""
+    from ...memory import retention as _ret
+
+    def _carried(item):
+        # D2H pairs each batch with its speculation checks — pin the batch
+        return item[0] if isinstance(item, tuple) else item
 
     def run(batch):
         _trace.push_exec(name)
@@ -56,13 +63,19 @@ def _staged(it, transfer, name: str):
     with ThreadPoolExecutor(max_workers=1,
                             thread_name_prefix=f"srt-{name}") as stager:
         fut = None
+        fut_in = None
         for batch in it:
+            _ret.pin_batch(_carried(batch))
             nxt = stager.submit(run, batch)
             if fut is not None:
-                yield fut.result()
-            fut = nxt
+                out = fut.result()
+                _ret.unpin_batch(_carried(fut_in))
+                yield out
+            fut, fut_in = nxt, batch
         if fut is not None:
-            yield fut.result()
+            out = fut.result()
+            _ret.unpin_batch(_carried(fut_in))
+            yield out
 
 
 class HostToDeviceExec(PhysicalPlan):
@@ -81,6 +94,8 @@ class HostToDeviceExec(PhysicalPlan):
         from ...shims import tree_map
         from ...robustness import faults as _faults
 
+        from ...memory.retention import mark_transient
+
         def upload(batch):
             nb = batch_nbytes(batch)
             tctx.inc_metric("h2d_bytes", nb)
@@ -89,7 +104,8 @@ class HostToDeviceExec(PhysicalPlan):
             # span covers the upload dispatch only, not downstream
             # consumption of the yielded batch
             with _trace.span("h2d", "HostToDevice.upload", bytes=nb):
-                return tree_map(jnp.asarray, batch)
+                # fresh single-owner device buffers: donation-eligible
+                return mark_transient(tree_map(jnp.asarray, batch))
 
         it = self.children[0].execute(pid, tctx)
         if bool(tctx.conf.get(TRANSFER_DOUBLE_BUFFER)):

@@ -46,7 +46,7 @@ class Planner:
         from ..config import FUSION_ENABLED
         if bool(self.conf.get(FUSION_ENABLED)):
             from .physical.fusion import fuse_stages
-            phys = fuse_stages(phys)
+            phys = fuse_stages(phys, self.conf)
         return phys
 
     def plan_for_collect(self, logical: P.LogicalPlan) -> PhysicalPlan:
@@ -64,7 +64,10 @@ class Planner:
         if bool(self.conf.get(PREFETCH_ENABLED)):
             from .physical.async_exec import insert_prefetch
             phys = insert_prefetch(phys, self.conf)
-        return phys
+        # plan-time fusion coverage counters (wholeStageOps/unfusedOps)
+        # fold into last_query_metrics via the collect_metrics walk
+        from .physical.fusion import annotate_stage_coverage
+        return annotate_stage_coverage(phys)
 
     # ------------------------------------------------------------------
     def _convert(self, meta: PlanMeta) -> PhysicalPlan:

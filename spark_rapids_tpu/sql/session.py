@@ -207,7 +207,10 @@ class TpuSession:
             for src, dst in (("hits", "kernelCacheHits"),
                              ("misses", "kernelCacheMisses"),
                              ("compiles", "kernelCompiles"),
-                             ("compile_ms", "kernelCompileMs")):
+                             ("compile_ms", "kernelCompileMs"),
+                             # total compiled-program launches this query
+                             # (whole-stage dispatch evidence)
+                             ("dispatches", "deviceDispatches")):
                 m[dst] = round(cs1[src] - cache_stats0[src], 3)
             # resilience counters: faults injected, fetch retries, lost
             # blocks recomputed, peers blacklisted — per-query deltas of

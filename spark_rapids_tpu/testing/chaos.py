@@ -145,7 +145,8 @@ def run_soak(rows: int = 20_000, seed: int = 11,
              trace_path: Optional[str] = None,
              strict: bool = True,
              pipeline: bool = False,
-             encoded: bool = False) -> dict:
+             encoded: bool = False,
+             whole_stage: bool = False) -> dict:
     """Returns the soak report; raises AssertionError on any parity or
     counter-visibility failure.  ``strict=False`` (reduced smoke runs)
     keeps the bit-parity and faults-injected asserts but skips the
@@ -164,7 +165,15 @@ def run_soak(rows: int = 20_000, seed: int = 11,
     (``spark.rapids.tpu.sql.encoded.enabled=false``): encoded shuffle
     frames (narrowed codes + dictionaries/refs) must survive fetch
     retries, destroyed blocks, and lost-block recompute bit-identically
-    to the raw clean run — the ISSUE 6 acceptance leg."""
+    to the raw clean run — the ISSUE 6 acceptance leg.
+
+    ``whole_stage=True`` runs the CHAOS session with whole-stage fusion +
+    buffer donation forced ON while the clean run disables fusion
+    entirely (``spark.rapids.tpu.sql.fusion.enabled=false``, the serial
+    unfused per-op baseline): fused stage programs, absorbed aggregate /
+    probe terminals, and the donation-safety guard must stay
+    bit-identical under injected data-movement faults — the ISSUE 7
+    acceptance leg (docs/whole_stage.md)."""
     import spark_rapids_tpu as srt
     from ..config import RapidsConf
     from ..memory.spill import BufferCatalog
@@ -193,6 +202,10 @@ def run_soak(rows: int = 20_000, seed: int = 11,
             # encoded-under-faults == raw-without-faults, not just
             # encoded == encoded
             clean_conf["spark.rapids.tpu.sql.encoded.enabled"] = False
+        if whole_stage:
+            # clean baseline fully UNFUSED: the soak proves
+            # fused-and-donating-under-faults == per-op-without-faults
+            clean_conf["spark.rapids.tpu.sql.fusion.enabled"] = False
         clean_sess = srt.session(conf=RapidsConf.get_global().copy(
             clean_conf))
         clean: Dict[str, pd.DataFrame] = {}
@@ -208,6 +221,12 @@ def run_soak(rows: int = 20_000, seed: int = 11,
         })
         if encoded:
             chaos_conf["spark.rapids.tpu.sql.encoded.enabled"] = True
+        if whole_stage:
+            chaos_conf.update({
+                "spark.rapids.tpu.sql.fusion.enabled": True,
+                "spark.rapids.tpu.sql.wholeStage.enabled": True,
+                "spark.rapids.tpu.sql.wholeStage.donation.enabled": True,
+            })
         if pipeline:
             chaos_conf.update({
                 "spark.rapids.tpu.task.parallelism": 4,
@@ -263,6 +282,7 @@ def run_soak(rows: int = 20_000, seed: int = 11,
         report = {
             "rows": rows, "seed": seed, "sites": sites,
             "pipeline": pipeline, "encoded": encoded,
+            "whole_stage": whole_stage,
             "queries": per_query, "counters": counters,
             "faults_by_site": by_site,
             "bit_identical": not mismatches,
@@ -301,6 +321,13 @@ def main() -> None:
     seed = 11
     pipeline = False
     encoded = False
+    whole_stage = False
+    if "--whole-stage" in argv:
+        # whole-stage soak: chaos session with fusion + donation forced
+        # on vs a fully UNFUSED serial clean baseline (ISSUE 7
+        # acceptance: bit-identical under faults with whole-stage on)
+        whole_stage = True
+        argv.remove("--whole-stage")
     if "--encoded" in argv:
         # encoded soak: chaos session runs with encoded columnar
         # execution ON against a RAW clean baseline (ISSUE 6 acceptance:
@@ -326,10 +353,11 @@ def main() -> None:
     rows = int(argv[0]) if argv else 20_000
     report = run_soak(rows, seed=seed, trace_path=trace_path,
                       strict=not pipeline, pipeline=pipeline,
-                      encoded=encoded)
+                      encoded=encoded, whole_stage=whole_stage)
     print(json.dumps(report, indent=2))
     mode = ("pipelined " if pipeline else "") + \
-        ("encoded " if encoded else "")
+        ("encoded " if encoded else "") + \
+        ("whole-stage " if whole_stage else "")
     print(f"CHAOS SOAK PASSED: {mode}results bit-identical under "
           f"{report['counters']['faultsInjected']} injected faults")
 

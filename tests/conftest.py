@@ -54,6 +54,10 @@ QUICK_MODULES = {
     # kill-switch reversion are tier-1 — an encoding bug is silent data
     # corruption, not a crash
     "test_encoded",
+    # whole-stage XLA compilation (ISSUE 7): terminal stage formation,
+    # fused-vs-killswitched bit parity, and the donation-safety guard
+    # are tier-1 — a fusion or donation bug is silent data corruption
+    "test_whole_stage",
 }
 
 
