@@ -167,8 +167,11 @@ def _run_pandas_once(data) -> tuple:
 def _shape_trace(sess, collect) -> dict:
     """One traced collect -> compact sync/compile/transfer summary
     (observability tracer; VERDICT r5 Missing #2: every banked shape
-    carries its own diagnosis).  Also returns the traced collect's wall
-    time so callers can report tracing overhead.  Must never take the
+    carries its own diagnosis) PLUS the bottleneck doctor's ranked
+    verdict (observability/doctor.py) — so every banked shape names its
+    bottleneck, closing the "diagnose the 0.027x join" debt on any
+    window this runs in.  Also returns the traced collect's wall time so
+    callers can report tracing overhead.  Must never take the
     measurement down."""
     out = {}
     try:
@@ -179,6 +182,11 @@ def _shape_trace(sess, collect) -> dict:
         summary = sess.last_query_trace_summary
         if summary:
             out["trace_summary"] = summary
+        try:
+            from spark_rapids_tpu.observability import doctor as _doc
+            out["doctor"] = _doc.compact(sess.diagnose_last_query())
+        except Exception:
+            pass
     except Exception:
         pass
     finally:
@@ -440,9 +448,11 @@ def _measure_join(rows: int, resident: bool = True,
            f"{tag}_rows": rows,
            f"{tag}_gb_per_s_per_chip": _gb_per_s(n_bytes, eng_time),
            f"{tag}_stage_metrics": join_stages}
-    ts = _shape_trace(sess, q.collect).get("trace_summary")
-    if ts:
-        out[f"{tag}_trace_summary"] = ts
+    ti = _shape_trace(sess, q.collect)
+    if ti.get("trace_summary"):
+        out[f"{tag}_trace_summary"] = ti["trace_summary"]
+    if ti.get("doctor"):
+        out[f"{tag}_doctor"] = ti["doctor"]
     return out
 
 
@@ -596,10 +606,13 @@ def _measure_whole_stage(rows: int) -> dict:
                 "donated_batches": int(
                     m.get("wholeStageDonatedBatches", 0)),
             }
-            ts = _shape_trace(sess, q.collect).get("trace_summary")
+            ti = _shape_trace(sess, q.collect)
+            ts = ti.get("trace_summary")
             if ts:
                 per[tag]["sync_count"] = ts.get("sync_count")
                 per[tag]["trace_summary"] = ts
+            if ti.get("doctor"):
+                per[tag]["doctor"] = ti["doctor"]
             results[tag] = sorted(
                 tuple(sorted(r.items())) for r in got.to_pylist())
         rec = {"fused": per["fused"], "unfused": per["unfused"],
@@ -660,9 +673,11 @@ def _measure_window(rows: int, resident: bool = True) -> dict:
            "window_vs_baseline": round(cpu_time / eng_time, 3),
            "window_rows": rows,
            "window_gb_per_s_per_chip": _gb_per_s(n_bytes, eng_time)}
-    ts = _shape_trace(sess, q.collect).get("trace_summary")
-    if ts:
-        out["window_trace_summary"] = ts
+    ti = _shape_trace(sess, q.collect)
+    if ti.get("trace_summary"):
+        out["window_trace_summary"] = ti["trace_summary"]
+    if ti.get("doctor"):
+        out["window_doctor"] = ti["doctor"]
     return out
 
 
@@ -705,9 +720,11 @@ def _measure_sort(rows: int) -> dict:
            "sort_vs_baseline": round(cpu_time / eng_time, 3),
            "sort_rows": rows,
            "sort_gb_per_s_per_chip": _gb_per_s(n_bytes, eng_time)}
-    ts = _shape_trace(sess, q.collect).get("trace_summary")
-    if ts:
-        out["sort_trace_summary"] = ts
+    ti = _shape_trace(sess, q.collect)
+    if ti.get("trace_summary"):
+        out["sort_trace_summary"] = ti["trace_summary"]
+    if ti.get("doctor"):
+        out["sort_doctor"] = ti["doctor"]
     try:
         import jax.numpy as jnp
 
@@ -1296,6 +1313,10 @@ def orchestrate() -> None:
         cpu_child.kill()
         device_result["probe_attempts"] = attempt
         device_result["probe_timeline"] = probes
+        # evidence class is first-class (ROADMAP item 5: stale replays
+        # must never masquerade as results): this is a real measurement
+        # from THIS round's live tunnel window
+        device_result["evidence"] = "live"
         print(json.dumps(device_result), flush=True)
         return
 
@@ -1327,6 +1348,12 @@ def orchestrate() -> None:
                              " (tunnel dead at driver bench time; probes: " +
                              ", ".join(probes) + ")")
             final["probe_timeline"] = probes
+            # a replay is NOT a result from this round — say so loudly at
+            # the top level, not only buried in the note (bench_diff.py
+            # refuses live-vs-stale comparison without --allow-stale)
+            final["evidence"] = "stale-replay"
+            final["outcome"] = "NO-LIVE-TUNNEL-WINDOW: numbers replayed " \
+                               "from capture " + ts
             print(json.dumps(final), flush=True)
             return
 
@@ -1354,6 +1381,9 @@ def orchestrate() -> None:
         fallback = {"metric": "tpch_q1_like_rows_per_sec", "value": 0,
                     "unit": "rows/s", "vs_baseline": 0.0}
     fallback["probe_timeline"] = probes
+    fallback["evidence"] = "cpu-fallback"
+    fallback["outcome"] = ("NO-LIVE-TUNNEL-WINDOW: CPU-platform "
+                           "insurance numbers, not device evidence")
     if probes and all(p.endswith(" ok-cpu") for p in probes):
         note = ("no TPU backend (jax fell back to the CPU platform); "
                 "CPU-platform numbers; probes: " + ", ".join(probes))

@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/13] native libraries ==="
+echo "=== [1/14] native libraries ==="
 make -C native
 
-echo "=== [2/13] API contract validation ==="
+echo "=== [2/14] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/13] docgen drift check ==="
+echo "=== [3/14] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/13] traced query + chrome-trace schema check ==="
+echo "=== [4/14] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,67 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/13] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [5/14] performance flight recorder: metrics + history + doctor + bench_diff ==="
+# ISSUE 8 acceptance: a traced query with the metrics registry and the
+# flight recorder enabled must produce (a) a Prometheus export that
+# passes the exposition-contract check, (b) a doctor diagnosis whose
+# JSON passes the srt-doctor/1 schema check with a named verdict, and
+# (c) a query_history record carrying the plan fingerprint + trace
+# summary.  bench_diff then diffs the two banked round artifacts as a
+# sentinel smoke test (same evidence class: both stale replays), and
+# must REFUSE a live-vs-stale comparison without --allow-stale.
+SRT_FR_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 300 python - "$SRT_FR_DIR" <<'PYEOF'
+import sys, json, os
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+out = sys.argv[1]
+sess = srt.session(**{"spark.rapids.tpu.metrics.enabled": True,
+                      "spark.rapids.tpu.profile.enabled": True,
+                      "spark.rapids.tpu.history.path":
+                          os.path.join(out, "history.jsonl")})
+rng = np.random.default_rng(3)
+n = 50_000
+fact = sess.create_dataframe(pa.table(
+    {"fk": rng.integers(0, 1000, n), "x": rng.random(n)}), num_partitions=2)
+dim = sess.create_dataframe(pa.table(
+    {"pk": np.arange(1000, dtype=np.int64), "cat": rng.integers(0, 8, 1000)}))
+q = (fact.join(dim, fact.fk == dim.pk, "inner").groupBy("cat")
+     .agg(F.count("*").alias("n"), F.sum(F.col("x")).alias("sx"))
+     .orderBy("cat"))
+assert q.collect().num_rows == 8
+with open(os.path.join(out, "metrics.prom"), "w") as fh:
+    fh.write(sess.metrics_prometheus())
+snap = sess.metrics_snapshot()
+assert any(c["name"] == "device_dispatches_total" for c in snap["counters"])
+diag = sess.diagnose_last_query()
+with open(os.path.join(out, "doctor.json"), "w") as fh:
+    json.dump(diag, fh, indent=1)
+print("doctor verdict:", diag["verdict"],
+      [r["category"] for r in diag["ranked"][:3]])
+hist = sess.query_history(1)
+assert hist and hist[0]["plan_fingerprint"] and hist[0]["trace_summary"]
+from spark_rapids_tpu.observability.history import read_history_file
+assert read_history_file(os.path.join(out, "history.jsonl"))
+print("flight recorder OK:", hist[0]["plan_fingerprint"],
+      f"{hist[0]['duration_ms']:.0f}ms")
+PYEOF
+timeout 60 python tools/check_trace.py \
+    --prometheus "$SRT_FR_DIR/metrics.prom" \
+    --doctor "$SRT_FR_DIR/doctor.json"
+# sentinel smoke: diff the two banked rounds (both stale replays -> same
+# evidence class, allowed); then prove the live-vs-stale gate refuses
+timeout 60 python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+printf '{"metric":"x","value":9,"rows":1,"platform":"tpu","evidence":"live"}' \
+    > "$SRT_FR_DIR/live.json"
+if python tools/bench_diff.py "$SRT_FR_DIR/live.json" BENCH_r05.json \
+        >/dev/null 2>&1; then
+    echo "ERROR: bench_diff failed to refuse live-vs-stale"; exit 1
+fi
+
+echo "=== [6/14] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -64,7 +124,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [6/13] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [7/14] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -78,7 +138,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [7/13] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+echo "=== [8/14] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
 # Encoded columnar execution (docs/encoded_columns.md) under seeded
 # faults AND the async pipeline matrix: the chaos session keeps
 # dictionary/RLE columns encoded through filters/joins/group-bys and
@@ -98,7 +158,7 @@ timeout 60 python tools/check_trace.py --require-cat encode \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     8000 --seed 11 --encoded
 
-echo "=== [8/13] whole-stage fusion: plan shape + donation chaos soak ==="
+echo "=== [9/14] whole-stage fusion: plan shape + donation chaos soak ==="
 # Whole-stage XLA compilation (docs/whole_stage.md): (a) the TPC-H-ish
 # suite's plans must contain fused whole-stage nodes — an aggregate
 # terminal (FusedStageExec wrapping the partial agg) and a probe-absorbed
@@ -155,7 +215,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat stage \
     "$SRT_WS_TRACE"
 
-echo "=== [9/13] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [10/14] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -176,14 +236,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [10/13] scale rig ==="
+    echo "=== [11/14] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [10/13] scale rig skipped (quick) ==="
+    echo "=== [11/14] scale rig skipped (quick) ==="
 fi
 
-echo "=== [11/13] packaging: wheel builds and installs ==="
+echo "=== [12/14] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -213,17 +273,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [12/13] driver entry checks ==="
+echo "=== [13/14] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [13/13] second-jax shim world skipped (quick) ==="
+    echo "=== [14/14] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [13/13] second-jax shim world (gated) ==="
+echo "=== [14/14] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

@@ -734,6 +734,38 @@ PROFILE_ENABLED = register(
     "Record per-exec wall time + batch counts during execution; read the "
     "report with session.profile_last_query() (the SQL-UI per-op "
     "GpuMetric view).", False)
+METRICS_ENABLED = register(
+    "spark.rapids.tpu.metrics.enabled",
+    "Feed the process-wide metrics registry (observability/metrics.py): "
+    "counters, gauges and log-bucketed latency histograms (p50/p95/p99) "
+    "from the tracer, shuffle, spill/retention and kernel-cache "
+    "chokepoints, labeled by query id and session id.  Export with "
+    "session.metrics_prometheus() / metrics_snapshot().  Off (default) "
+    "costs one dict lookup per chokepoint.", False, commonly_used=True)
+METRICS_MAX_SERIES = register(
+    "spark.rapids.tpu.metrics.maxSeries",
+    "Cardinality bound on the metrics registry: past this many distinct "
+    "(name, labels) series, NEW series are dropped and counted in "
+    "metrics_dropped_series — an exec-name or label explosion can never "
+    "OOM the driver.", 4096)
+HISTORY_ENABLED = register(
+    "spark.rapids.tpu.history.enabled",
+    "Query flight recorder (observability/history.py): every query "
+    "leaves one record (plan fingerprint, duration, last_query_metrics, "
+    "trace_summary, decode engagement, wire bytes) in a bounded "
+    "in-memory ring read back via session.query_history().  One dict "
+    "build + list append per query.", True)
+HISTORY_MAX_QUERIES = register(
+    "spark.rapids.tpu.history.maxQueries",
+    "Flight-recorder ring bound, in memory and on disk (the JSONL file "
+    "compacts to the newest maxQueries records when it outgrows twice "
+    "this).", 128)
+HISTORY_PATH = register(
+    "spark.rapids.tpu.history.path",
+    "On-disk JSONL ring for the query flight recorder (the Spark "
+    "history-server analog at flight-recorder weight); empty (default) "
+    "keeps history in memory only.  Read back with "
+    "observability.history.read_history_file().", "")
 DUMP_ON_ERROR_PATH = register(
     "spark.rapids.sql.debug.dumpPath",
     "If set, dump failing batches to parquet here (DumpUtils equivalent).",

@@ -40,6 +40,8 @@ import threading
 import weakref
 from typing import Any, Dict, Tuple
 
+from ..observability import metrics as _om
+
 #: observability for tests (test_whole_stage.py donation-safety suite)
 STATS = {"pins": 0, "unpins": 0, "donated": 0, "declined_pinned": 0,
          "declined_not_transient": 0, "declined_encoded": 0}
@@ -137,18 +139,22 @@ def may_donate(batch) -> Tuple[bool, str]:
     ``encoded`` (dictionary buffers are shared across batches)."""
     if not is_transient(batch):
         STATS["declined_not_transient"] += 1
+        _om.inc("donation_declined_total", reason="not_transient")
         return False, "not_transient"
     if is_pinned(batch):
         STATS["declined_pinned"] += 1
+        _om.inc("donation_declined_total", reason="pinned")
         return False, "pinned"
     if _has_encoded_columns(batch):
         STATS["declined_encoded"] += 1
+        _om.inc("donation_declined_total", reason="encoded")
         return False, "encoded"
     return True, ""
 
 
 def count_donated() -> None:
     STATS["donated"] += 1
+    _om.inc("donation_granted_total")
 
 
 def stats_snapshot() -> Dict[str, int]:

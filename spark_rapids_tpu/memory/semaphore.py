@@ -16,6 +16,7 @@ import time
 from typing import Dict, Optional
 
 from ..config import CONCURRENT_TASKS, RapidsConf
+from ..observability import metrics as _om
 from ..observability import tracer as _trace
 
 
@@ -85,6 +86,7 @@ class TpuSemaphore:
         if waited > 1e-6 and _trace.TRACING["on"]:
             _trace.get_tracer().complete("sem_wait", "semaphore.acquire",
                                          t0, waited, task=task_id)
+        _om.observe("sem_wait_ms", waited * 1e3)
 
     def release_if_necessary(self, task_id: int):
         with self._lock:

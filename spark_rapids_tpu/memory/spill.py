@@ -30,6 +30,7 @@ import numpy as np
 
 from ..columnar.batch import ColumnarBatch
 from ..config import HOST_SPILL_STORAGE_SIZE, SPILL_DIR, RapidsConf
+from ..observability import metrics as _om
 from ..observability import tracer as _trace
 from ..robustness import faults as _faults
 from .device import DeviceManager
@@ -302,6 +303,7 @@ class BufferCatalog:
         # full tunnel round trip)
         with _trace.span("spill", "spill.deviceToHost", bytes=buf.size):
             buf.leaves = list(jax.device_get(buf.leaves))
+        _om.inc("spill_bytes_total", buf.size, dir="deviceToHost")
         buf.tier = HOST
         self.device_bytes -= buf.size
         self.host_bytes += buf.size
@@ -330,6 +332,7 @@ class BufferCatalog:
                 pickle.dump(buf.leaves, f, protocol=pickle.HIGHEST_PROTOCOL)
         with _trace.span("spill", "spill.hostToDisk", bytes=buf.size):
             _retry_disk_io(_write, "spill.disk_write")
+        _om.inc("spill_bytes_total", buf.size, dir="hostToDisk")
         buf.leaves = None
         buf.disk_path = path
         buf.tier = DISK
@@ -344,6 +347,7 @@ class BufferCatalog:
                 return pickle.load(f)
         with _trace.span("spill", "spill.diskToHost", bytes=buf.size):
             buf.leaves = _retry_disk_io(_read, "spill.disk_read")
+        _om.inc("spill_bytes_total", buf.size, dir="diskToHost")
         os.unlink(buf.disk_path)
         buf.disk_path = None
         buf.tier = HOST
@@ -362,6 +366,7 @@ class BufferCatalog:
         with _trace.span("spill", "spill.unspillToDevice", bytes=buf.size):
             buf.leaves = [jax.device_put(l) if isinstance(l, np.ndarray)
                           else l for l in buf.leaves]
+        _om.inc("spill_bytes_total", buf.size, dir="unspillToDevice")
         buf.tier = DEVICE
         self.host_bytes -= buf.size
         self.device_bytes += buf.size

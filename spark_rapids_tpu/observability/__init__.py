@@ -16,10 +16,20 @@ Spark eventLog, recast as one in-process timeline:
 * :mod:`.report` — per-query attribution: blocking-readback count & ms
   per exec, kernel hit/miss & compile ms, bytes on the wire, spill and
   semaphore-wait time.
+* :mod:`.metrics` — process-wide registry (counters / gauges /
+  log-bucketed p50/p95/p99 histograms) fed by the tracer, shuffle,
+  spill/retention and kernel-cache chokepoints; Prometheus + JSON export.
+* :mod:`.history` — bounded query flight recorder (plan fingerprint,
+  metrics, trace summary per query; in-memory ring + on-disk JSONL).
+* :mod:`.doctor` — ranked bottleneck attribution (sync / compile /
+  h2d-d2h / dispatch / sem_wait / spill / shuffle -bound verdicts with
+  the exec-level spans and counters that justify them).
 """
 
+from .metrics import METRICS, MetricsRegistry, get_registry
 from .tracer import (TRACING, QueryTracer, current_exec, get_tracer,
                      pop_exec, push_exec, span)
 
 __all__ = ["TRACING", "QueryTracer", "get_tracer", "span", "push_exec",
-           "pop_exec", "current_exec"]
+           "pop_exec", "current_exec", "METRICS", "MetricsRegistry",
+           "get_registry"]

@@ -54,6 +54,12 @@ def chrome_trace(events: List[Dict[str, Any]],
                     "pid": pid, "tid": 0, "args": {"value": value}})
     out.append({"ph": "M", "name": "process_name", "ts": 0, "pid": pid,
                 "tid": 0, "args": {"name": "spark_rapids_tpu"}})
+    if meta.get("session_id"):
+        # session id as a Perfetto process label, so traces from several
+        # sessions stay distinguishable after merging
+        out.append({"ph": "M", "name": "process_labels", "ts": 0,
+                    "pid": pid, "tid": 0,
+                    "args": {"labels": f"session={meta['session_id']}"}})
     for raw, t in tid_map.items():
         out.append({"ph": "M", "name": "thread_name", "ts": 0, "pid": pid,
                     "tid": t, "args": {"name": f"thread-{t} ({raw})"}})

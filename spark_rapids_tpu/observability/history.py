@@ -1,0 +1,170 @@
+"""Query flight recorder — a bounded ring of per-query records, in
+memory always and as an on-disk JSONL ring when a path is configured.
+
+Every executed query leaves one record: plan fingerprint + outline,
+wall-clock duration, ``last_query_metrics``, the compact
+``trace_summary`` (when traced), decode-engagement and wire-byte
+sub-views, session/query ids, and status.  ``sess.query_history()``
+reads it back; the on-disk ring survives the process (the Spark
+history-server analog at flight-recorder weight: JSONL, newest last,
+compacted in place when it outgrows twice the bound).
+
+Write cost per query is one dict build + one appended JSON line —
+negligible next to a collect — so the in-memory recorder is ON by
+default (``spark.rapids.tpu.history.enabled``); the disk ring engages
+only when ``spark.rapids.tpu.history.path`` is set.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+DEFAULT_MAX_QUERIES = 128
+
+#: metric-key prefixes folded into the record's ``decode_engagement``
+#: sub-view (io_/decode_stats.py + encoded-execution counters)
+_ENGAGEMENT_PREFIXES = ("parquet", "orc", "csv", "json", "encoded")
+#: metric keys folded into the ``wire`` sub-view
+_WIRE_KEYS = ("shuffleBytesOnWire", "shuffleFramesWritten",
+              "shuffleEncodedBytesSaved", "prepackBytesOnWire",
+              "prepackBytesNaive")
+
+
+def plan_fingerprint(phys) -> str:
+    """Stable fingerprint of a physical plan's SHAPE: node names over the
+    tree structure, independent of literals and instance identity — two
+    runs of the same query shape share a fingerprint, which is what the
+    plan-fingerprint → cached-result tier (ROADMAP item 1) keys on."""
+    parts: List[str] = []
+
+    def walk(node, depth: int) -> None:
+        parts.append(f"{depth}:{node.node_name()}")
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(phys, 0)
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+def plan_outline(phys, max_nodes: int = 40) -> List[str]:
+    """Indented node-name outline (bounded) for human-readable records."""
+    out: List[str] = []
+
+    def walk(node, depth: int) -> None:
+        if len(out) >= max_nodes:
+            return
+        out.append("  " * depth + node.node_name())
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(phys, 0)
+    if len(out) >= max_nodes:
+        out.append("...")
+    return out
+
+
+def build_record(*, query_id: int, session_id: str, ok: bool,
+                 duration_ms: float, phys=None,
+                 metrics: Optional[Dict[str, Any]] = None,
+                 trace_summary: Optional[Dict[str, Any]] = None,
+                 error: Optional[str] = None) -> Dict[str, Any]:
+    """One flight-recorder record (schema documented in
+    docs/observability.md)."""
+    rec: Dict[str, Any] = {
+        "ts": round(time.time(), 3),
+        "query": int(query_id),
+        "session": session_id,
+        "status": "ok" if ok else "failed",
+        "duration_ms": round(float(duration_ms), 3),
+    }
+    if phys is not None:
+        rec["plan_fingerprint"] = plan_fingerprint(phys)
+        rec["plan"] = plan_outline(phys)
+    if error:
+        rec["error"] = str(error)[:500]
+    if metrics:
+        rec["metrics"] = {k: v for k, v in metrics.items()}
+        engagement = {k: v for k, v in metrics.items()
+                      if k.startswith(_ENGAGEMENT_PREFIXES)}
+        if engagement:
+            rec["decode_engagement"] = engagement
+        wire = {k: metrics[k] for k in _WIRE_KEYS if metrics.get(k)}
+        if wire:
+            rec["wire"] = wire
+    if trace_summary:
+        rec["trace_summary"] = trace_summary
+    return rec
+
+
+class QueryHistory:
+    """Bounded in-memory ring + optional on-disk JSONL ring."""
+
+    def __init__(self, max_queries: int = DEFAULT_MAX_QUERIES,
+                 path: str = ""):
+        self._lock = threading.Lock()
+        self.max_queries = max(1, int(max_queries))
+        self.path = path or ""
+        self._ring: deque = deque(maxlen=self.max_queries)
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append(rec)
+            if self.path:
+                try:
+                    self._append_disk(rec)
+                except OSError:
+                    pass  # the recorder must never fail the query
+
+    def _append_disk(self, rec: Dict[str, Any]) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        # compact once the file holds > 2x the bound: rewrite the newest
+        # max_queries records atomically (tmp + rename)
+        try:
+            with open(self.path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            return
+        if len(lines) <= 2 * self.max_queries:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.writelines(lines[-self.max_queries:])
+        os.replace(tmp, self.path)
+
+    def tail(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Newest-last records; ``n`` bounds the result (None = all)."""
+        with self._lock:
+            out = list(self._ring)
+        if n is not None:
+            out = out[-max(0, int(n)):]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+def read_history_file(path: str) -> List[Dict[str, Any]]:
+    """Parse an on-disk history ring back into records (newest last);
+    tolerates a torn final line from a killed writer."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

@@ -1,0 +1,325 @@
+"""Process-wide metrics registry — counters, gauges and log-bucketed
+latency histograms with Prometheus text-format and JSON snapshot export.
+
+The tracer answers "where did THIS query's wall time go"; the registry
+answers "what does this PROCESS look like over many queries": spill
+bytes by tier direction, shuffle frame sizes, kernel-cache hit rates,
+semaphore wait distributions, per-category span latencies — each series
+labeled with the owning query id and session id (and, for span-fed
+series, the exec node), which is the groundwork the multi-tenant serving
+layer (ROADMAP item 1) needs for per-tenant accounting.
+
+Cost model: the same single-dict-lookup gate as ``TRACING`` /
+``PROFILING`` — every feed site checks ``METRICS["on"]`` before building
+anything, so a disabled registry costs one dict lookup per chokepoint.
+The session flips the flag per query from
+``spark.rapids.tpu.metrics.enabled`` (save/restore like the tracing
+flags, so an exception mid-query cannot leak it).
+
+Histograms are log2-bucketed: bucket upper bounds are powers of two over
+a fixed span, so ``observe`` is a ``frexp`` + one locked increment, and
+p50/p95/p99 are interpolated from the cumulative bucket counts — the
+fidelity/overhead point the Theseus-style movement-distribution argument
+needs (a flat millisecond sum hides the one 300ms sync among a thousand
+30µs ones).
+
+Cardinality is bounded (``spark.rapids.tpu.metrics.maxSeries``): past
+the cap, NEW series are dropped and counted in ``metrics_dropped_series``
+so an exec-name explosion can never OOM the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+#: master switch — flipped per query by the session (restored in a
+#: ``finally``).  Near-zero overhead when off: one dict lookup per site.
+METRICS = {"on": False}
+
+#: histogram bucket upper bounds: powers of two from 2^-14 (~61µs when
+#: observing ms, ~0.06 when observing counts) through 2^20, plus +Inf.
+#: Chosen so both millisecond latencies (0.1ms..10s) and byte/row sizes
+#: land in the resolved middle of the span.
+_BUCKET_EXP_LO = -14
+_BUCKET_EXP_HI = 20
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    float(2.0 ** e) for e in range(_BUCKET_EXP_LO, _BUCKET_EXP_HI + 1)
+) + (math.inf,)
+
+_N_BUCKETS = len(BUCKET_BOUNDS)
+
+
+def _bucket_index(value: float) -> int:
+    """Index of the first bucket whose upper bound is >= value — one
+    frexp, no search.  Non-positive values land in bucket 0."""
+    if value <= 0.0 or value != value:  # <=0 or NaN
+        return 0
+    # frexp: value = m * 2**e with 0.5 <= m < 1  =>  2**(e-1) <= v < 2**e
+    # bucket bound 2**e covers it unless v == 2**(e-1) exactly (m == 0.5)
+    m, e = math.frexp(value)
+    exp = e if m > 0.5 else e - 1
+    idx = exp - _BUCKET_EXP_LO
+    if idx < 0:
+        return 0
+    if idx >= _N_BUCKETS:
+        return _N_BUCKETS - 1
+    return idx
+
+
+class _Histogram:
+    __slots__ = ("buckets", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.buckets = [0] * _N_BUCKETS
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        self.buckets[_bucket_index(value)] += 1
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def quantile(self, q: float) -> float:
+        """Interpolated quantile from cumulative bucket counts; exact at
+        the recorded min/max ends, linear within a bucket."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, n in enumerate(self.buckets):
+            if n == 0:
+                continue
+            if cum + n >= target:
+                lo = 0.0 if i == 0 else BUCKET_BOUNDS[i - 1]
+                hi = BUCKET_BOUNDS[i]
+                if hi == math.inf:
+                    return self.max
+                frac = (target - cum) / n
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                # never report outside the observed range
+                return max(self.min, min(self.max, est))
+            cum += n
+        return self.max
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {"count": self.count, "sum": round(self.sum, 6)}
+        if self.count:
+            out.update(min=round(self.min, 6), max=round(self.max, 6),
+                       p50=round(self.quantile(0.50), 6),
+                       p95=round(self.quantile(0.95), 6),
+                       p99=round(self.quantile(0.99), 6))
+        return out
+
+
+#: series key: (name, tuple(sorted(label items)))
+_SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+class MetricsRegistry:
+    """Thread-safe registry of counters / gauges / histograms keyed by
+    (name, labels).  One instance per process (:func:`get_registry`)."""
+
+    def __init__(self, max_series: int = 4096):
+        self._lock = threading.Lock()
+        self.max_series = int(max_series)
+        self._counters: Dict[_SeriesKey, float] = {}
+        self._gauges: Dict[_SeriesKey, float] = {}
+        self._hists: Dict[_SeriesKey, _Histogram] = {}
+        self.dropped_series = 0
+        #: labels merged into every series (query id / session id) —
+        #: set by the session at query start, single-driver model
+        self._default_labels: Dict[str, str] = {}
+
+    # --- lifecycle --------------------------------------------------------
+    def reset(self, max_series: Optional[int] = None) -> None:
+        with self._lock:
+            if max_series is not None:
+                self.max_series = int(max_series)
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self.dropped_series = 0
+
+    def set_default_labels(self, **labels: Any) -> None:
+        """Labels stamped on every subsequently-recorded series (the
+        session sets ``query``/``session`` here per query)."""
+        with self._lock:
+            self._default_labels = {k: str(v) for k, v in labels.items()
+                                    if v is not None and str(v) != ""}
+
+    # --- recording --------------------------------------------------------
+    def _key(self, name: str, labels: Dict[str, Any]) -> _SeriesKey:
+        if self._default_labels:
+            merged = dict(self._default_labels)
+            merged.update(labels)
+        else:
+            merged = labels
+        return (name, tuple(sorted(
+            (k, str(v)) for k, v in merged.items())))
+
+    def _admit(self, table: dict, key: _SeriesKey) -> bool:
+        """New-series cardinality gate (callers hold the lock)."""
+        if key in table:
+            return True
+        total = (len(self._counters) + len(self._gauges)
+                 + len(self._hists))
+        if total >= self.max_series:
+            self.dropped_series += 1
+            return False
+        return True
+
+    def inc(self, name: str, value: float = 1.0, **labels: Any) -> None:
+        with self._lock:
+            key = self._key(name, labels)
+            if not self._admit(self._counters, key):
+                return
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels: Any) -> None:
+        with self._lock:
+            key = self._key(name, labels)
+            if not self._admit(self._gauges, key):
+                return
+            self._gauges[key] = float(value)
+
+    def observe(self, name: str, value: float, **labels: Any) -> None:
+        with self._lock:
+            key = self._key(name, labels)
+            h = self._hists.get(key)
+            if h is None:
+                if not self._admit(self._hists, key):
+                    return
+                h = self._hists[key] = _Histogram()
+            h.observe(float(value))
+
+    # --- readout ----------------------------------------------------------
+    def json_snapshot(self) -> Dict[str, Any]:
+        """Structured snapshot: {counters: [...], gauges: [...],
+        histograms: [...]}, each entry {name, labels, ...values}."""
+
+        def row(key: _SeriesKey, body: Dict[str, Any]) -> Dict[str, Any]:
+            name, labels = key
+            return dict({"name": name, "labels": dict(labels)}, **body)
+
+        with self._lock:
+            return {
+                "counters": [row(k, {"value": round(v, 6)})
+                             for k, v in sorted(self._counters.items())],
+                "gauges": [row(k, {"value": round(v, 6)})
+                           for k, v in sorted(self._gauges.items())],
+                "histograms": [row(k, h.snapshot())
+                               for k, h in sorted(self._hists.items())],
+                "dropped_series": self.dropped_series,
+            }
+
+    def prometheus_text(self, prefix: str = "srt_") -> str:
+        """Prometheus exposition text format.  Counters export with a
+        ``_total`` suffix, histograms as cumulative ``_bucket{le=...}``
+        series plus ``_sum``/``_count`` — the standard scrape contract,
+        validated by ``tools/check_trace.py --prometheus``."""
+        lines: List[str] = []
+
+        def fmt_labels(labels, extra: str = "") -> str:
+            parts = [f'{_sanitize(k)}="{_escape(v)}"' for k, v in labels]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        def fmt_val(v: float) -> str:
+            if v == math.inf:
+                return "+Inf"
+            if v == int(v) and abs(v) < 1e15:
+                return str(int(v))
+            return repr(float(v))
+
+        with self._lock:
+            by_name: Dict[str, List[Tuple[_SeriesKey, Any]]] = {}
+            for k, v in self._counters.items():
+                by_name.setdefault((k[0], "counter"), []).append((k, v))
+            for k, v in self._gauges.items():
+                by_name.setdefault((k[0], "gauge"), []).append((k, v))
+            for k, h in self._hists.items():
+                by_name.setdefault((k[0], "histogram"), []).append((k, h))
+            for (name, typ), rows in sorted(by_name.items()):
+                base = prefix + _sanitize(name)
+                if typ == "counter" and not base.endswith("_total"):
+                    base += "_total"
+                lines.append(f"# TYPE {base} {typ}")
+                for key, v in sorted(rows):
+                    labels = key[1]
+                    if typ in ("counter", "gauge"):
+                        lines.append(
+                            f"{base}{fmt_labels(labels)} {fmt_val(v)}")
+                        continue
+                    cum = 0
+                    for i, n in enumerate(v.buckets):
+                        cum += n
+                        if n == 0 and BUCKET_BOUNDS[i] != math.inf:
+                            continue  # sparse: emit touched + +Inf only
+                        le = f'le="{fmt_val(BUCKET_BOUNDS[i])}"'
+                        lines.append(f"{base}_bucket"
+                                     f"{fmt_labels(labels, le)} {cum}")
+                    lines.append(f"{base}_sum{fmt_labels(labels)} "
+                                 f"{fmt_val(round(v.sum, 6))}")
+                    lines.append(f"{base}_count{fmt_labels(labels)} "
+                                 f"{v.count}")
+            lines.append(f"# TYPE {prefix}metrics_dropped_series gauge")
+            lines.append(f"{prefix}metrics_dropped_series "
+                         f"{self.dropped_series}")
+        return "\n".join(lines) + "\n"
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+# --------------------------------------------------------------------------
+# guarded convenience feeds (one flag lookup when off — call these from
+# chokepoints instead of reaching for the registry directly)
+# --------------------------------------------------------------------------
+
+def inc(name: str, value: float = 1.0, **labels: Any) -> None:
+    if METRICS["on"]:
+        _REGISTRY.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels: Any) -> None:
+    if METRICS["on"]:
+        _REGISTRY.set_gauge(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels: Any) -> None:
+    if METRICS["on"]:
+        _REGISTRY.observe(name, value, **labels)
+
+
+def write_json_snapshot(path: str) -> str:
+    with open(path, "w") as fh:
+        json.dump(_REGISTRY.json_snapshot(), fh, indent=1)
+    return path
+
+
+def write_prometheus(path: str) -> str:
+    with open(path, "w") as fh:
+        fh.write(_REGISTRY.prometheus_text())
+    return path

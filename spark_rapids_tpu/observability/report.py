@@ -88,6 +88,9 @@ def trace_summary(events: List[Dict[str, Any]],
         out["device_dispatches"] = int(counters["deviceDispatches"])
     if tot["fault_n"]:
         out["fault_count"] = int(tot["fault_n"])
+    # truncation is first-class: a doctor/bench consumer must never have
+    # to infer from an absent key that the ring did NOT overflow
+    out["trace_truncated"] = bool(dropped)
     if dropped:
         out["dropped_events"] = int(dropped)
     if counters:

@@ -57,6 +57,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from . import metrics as _metrics
+
 #: master switch — flipped per query by the session (restored in a
 #: ``finally``, so an exception mid-query cannot leak tracing into the
 #: next session's query).  Near-zero overhead when off.
@@ -115,12 +117,21 @@ class QueryTracer:
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max(16, int(capacity)))
         self.dropped_events = 0
+        #: most events the ring ever held this query — with
+        #: dropped_events, the evidence that a truncated trace cannot
+        #: silently skew doctor attribution (high_water == capacity and
+        #: dropped > 0 means the window was too small)
+        self.high_water = 0
+        #: stable session label stamped on every event (``sid``) — set by
+        #: the session at query start, groundwork for per-tenant metrics
+        self.session_label = ""
         self._epoch = time.perf_counter()
         self._epoch_wall = time.time()
         self.counters: Dict[str, float] = {}
 
     # --- lifecycle --------------------------------------------------------
-    def reset(self, capacity: Optional[int] = None) -> None:
+    def reset(self, capacity: Optional[int] = None,
+              session: Optional[str] = None) -> None:
         """Start a fresh timeline (called by the session at query start)."""
         with self._lock:
             if capacity is not None and \
@@ -129,6 +140,9 @@ class QueryTracer:
             else:
                 self._events.clear()
             self.dropped_events = 0
+            self.high_water = 0
+            if session is not None:
+                self.session_label = str(session)
             self.counters = {}
             self._epoch = time.perf_counter()
             self._epoch_wall = time.time()
@@ -150,12 +164,22 @@ class QueryTracer:
             "tid": threading.get_ident(),
             "exec": current_exec() if exec_ is None else exec_,
         }
+        if self.session_label:
+            ev["sid"] = self.session_label
         if args:
             ev["args"] = args
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self.dropped_events += 1
             self._events.append(ev)
+            if len(self._events) > self.high_water:
+                self.high_water = len(self._events)
+        # registry feed: per-category latency distribution, exec-labeled
+        # (one dict lookup when the registry is off)
+        if _metrics.METRICS["on"]:
+            _metrics.get_registry().observe(
+                "trace_span_ms", max(dur_s, 0.0) * 1e3,
+                cat=cat, exec=ev["exec"] or "(driver)")
 
     def counter(self, name: str, value: float = 1.0) -> None:
         """Accumulate a named aggregate counter (no per-event storage)."""
@@ -172,11 +196,15 @@ class QueryTracer:
         """Trace metadata for exports: wall-clock epoch + drop stats."""
         import os
         with self._lock:
-            return {"epoch_unix_s": self._epoch_wall,
-                    "pid": os.getpid(),
-                    "capacity": self._events.maxlen,
-                    "dropped_events": self.dropped_events,
-                    "counters": dict(self.counters)}
+            out = {"epoch_unix_s": self._epoch_wall,
+                   "pid": os.getpid(),
+                   "capacity": self._events.maxlen,
+                   "dropped_events": self.dropped_events,
+                   "ring_high_water": self.high_water,
+                   "counters": dict(self.counters)}
+            if self.session_label:
+                out["session_id"] = self.session_label
+            return out
 
 
 _TRACER = QueryTracer()

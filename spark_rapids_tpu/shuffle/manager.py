@@ -34,6 +34,7 @@ from ..config import (RapidsConf, SHUFFLE_EXECUTOR_ID,
                       SHUFFLE_READER_THREADS, SHUFFLE_TCP_DRIVER_ENDPOINT,
                       SHUFFLE_TRANSPORT_CLASS, SHUFFLE_WRITER_THREADS,
                       SPILL_DIR)
+from ..observability import metrics as _om
 from ..observability import tracer as _trace
 from ..robustness import faults as _faults
 from .serializer import FrameCorrupt, concat_serialized, serialize_batch
@@ -301,6 +302,7 @@ class ShuffleManager:
                     + f": {type(last_err).__name__}: {last_err}"
                 ) from last_err
             FETCH_STATS["retries"] += 1
+            _om.inc("shuffle_fetch_retries_total")
             if _trace.TRACING["on"]:
                 _trace.get_tracer().counter("shuffleFetchRetries")
             delay = policy.backoff_s * (2 ** (attempt - 1))
@@ -417,6 +419,7 @@ class ShuffleManager:
         t0 = time.perf_counter()
         fn(block.map_id)
         FETCH_STATS["recomputed"] += 1
+        _om.inc("shuffle_blocks_recomputed_total")
         if _trace.TRACING["on"]:
             _trace.get_tracer().complete(
                 "fault", "shuffle.recompute", t0,

@@ -38,6 +38,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import types as T
+from ..observability import metrics as _om
 from ..observability import tracer as _trace
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import DeviceColumn, bucket_capacity, make_array_column
@@ -231,6 +232,10 @@ def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
         t.inc_metric("shuffleFramesWritten")
         if saved:
             t.inc_metric("shuffleEncodedBytesSaved", saved)
+    if _om.METRICS["on"]:
+        reg = _om.get_registry()
+        reg.observe("shuffle_frame_bytes", len(frame))
+        reg.inc("shuffle_bytes_on_wire_total", len(frame))
     return frame
 
 

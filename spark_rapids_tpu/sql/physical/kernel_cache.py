@@ -21,6 +21,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ...observability import metrics as _om
 from ...observability import tracer as _trace
 from ...robustness import faults as _faults
 
@@ -68,6 +69,8 @@ class _TrackedKernel:
         # Deliberately lock-free — a lost increment under contention is
         # metric noise, a per-launch lock is hot-path cost.
         _STATS["dispatches"] = _STATS["dispatches"] + 1
+        if _om.METRICS["on"]:
+            _om.get_registry().inc("device_dispatches_total")
         if not _trace.TRACING["on"]:
             return self._fn(*args, **kwargs)
         _trace.get_tracer().counter("deviceDispatches")
@@ -87,6 +90,9 @@ class _TrackedKernel:
                 e["ms"] += ms
             _trace.get_tracer().complete("kernel_compile", self._label,
                                          t0, dt)
+            if _om.METRICS["on"]:
+                _om.get_registry().observe("kernel_compile_ms", ms,
+                                           kernel=self._label)
         return out
 
 
@@ -157,8 +163,10 @@ def cached_jit(key: Tuple, fn: Callable,
         if cached is not None:
             _STATS["hits"] += 1
             _CACHE.move_to_end(key)
+            _om.inc("kernel_cache_hits_total")
             return cached
         _STATS["misses"] += 1
+        _om.inc("kernel_cache_misses_total")
         import jax
         if donate_argnums and donation_supported():
             jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums))

@@ -59,6 +59,9 @@ def _aux_stats_snapshot() -> dict:
 class TpuSession:
     _lock = threading.Lock()
     _active: Optional["TpuSession"] = None
+    #: atomic under the GIL (a plain int += under _lock would deadlock:
+    #: get_or_create constructs sessions while already holding _lock)
+    _session_seq = __import__("itertools").count(1)
 
     def __init__(self, conf: Optional[RapidsConf] = None, **conf_kwargs):
         base = conf or RapidsConf.get_global()
@@ -72,6 +75,13 @@ class TpuSession:
         #: name -> implementation object (Hive UDF bridge; hiveUDFs.scala
         #: analog — populated by CREATE TEMPORARY FUNCTION or the API)
         self._hive_udfs: dict = {}
+        #: stable session identity stamped on every span, metric series
+        #: and flight-recorder record (groundwork for per-tenant metrics,
+        #: ROADMAP item 1); also exported as a Chrome-trace process label
+        import os as _os
+        self.session_id = (f"sess-{_os.getpid()}-"
+                           f"{next(TpuSession._session_seq)}")
+        self._history = None  # lazily built from conf on first record
 
     # ------------------------------------------------------------------
     @classmethod
@@ -145,8 +155,12 @@ class TpuSession:
     # execution
     # ------------------------------------------------------------------
     def _execute(self, logical: P.LogicalPlan) -> pa.Table:
+        import time as _time
         from ..columnar.convert import device_to_arrow
-        from ..config import PROFILE_ENABLED, TRACE_BUFFER_EVENTS, TRACE_SINK
+        from ..config import (METRICS_ENABLED, METRICS_MAX_SERIES,
+                              PROFILE_ENABLED, TRACE_BUFFER_EVENTS,
+                              TRACE_SINK)
+        from ..observability import metrics as OM
         from ..observability import tracer as OT
         from ..robustness import faults as _faults
         from ..robustness import stats_snapshot
@@ -166,44 +180,65 @@ class TpuSession:
         # profile.enabled implies an in-memory trace so the profile report
         # carries sync/compile/transfer attribution, not just wall time
         tracing = profiling or bool(sink)
+        metrics_on = bool(self._conf.get(METRICS_ENABLED))
         # save/restore the process-wide flags (finally-guarded): a query
         # raising mid-flight, or one session enabling profiling, must not
         # leak the flags into a later query or another session's.  The
         # flags being process-global at all rests on the single-driver
         # model — see PROFILING in physical/base.py.
         prev_prof, prev_trace = PROFILING["on"], OT.TRACING["on"]
+        prev_metrics = OM.METRICS["on"]
         PROFILING["on"] = profiling or tracing
-        if tracing:
-            OT.get_tracer().reset(int(self._conf.get(TRACE_BUFFER_EVENTS)))
-        OT.TRACING["on"] = tracing
-        cache_stats0 = cache_stats()
         self._query_seq = getattr(self, "_query_seq", 0) + 1
+        if tracing:
+            OT.get_tracer().reset(int(self._conf.get(TRACE_BUFFER_EVENTS)),
+                                  session=self.session_id)
+        OT.TRACING["on"] = tracing
+        if metrics_on:
+            reg = OM.get_registry()
+            reg.max_series = int(self._conf.get(METRICS_MAX_SERIES))
+            reg.set_default_labels(query=self._query_seq,
+                                   session=self.session_id)
+        OM.METRICS["on"] = metrics_on
+        cache_stats0 = cache_stats()
         ok = False
+        err: Optional[BaseException] = None
+        t0 = _time.perf_counter()
         try:
             out = self._execute_traced(logical, device_to_arrow,
                                        speculation)
             ok = True
             return out
+        except BaseException as e:
+            err = e
+            raise
         finally:
+            duration_s = _time.perf_counter() - t0
             PROFILING["on"] = prev_prof
             OT.TRACING["on"] = prev_trace
+            OM.METRICS["on"] = prev_metrics
             _faults.restore_arming(prev_chaos)
             self._finish_trace(tracing, sink, cache_stats0, rob0, ok,
-                               aux0=aux0)
+                               aux0=aux0, duration_s=duration_s, err=err,
+                               metrics_on=metrics_on)
 
     def _finish_trace(self, tracing: bool, sink: str, cache_stats0: dict,
-                      rob0: dict, ok: bool, aux0: Optional[dict] = None
-                      ) -> None:
+                      rob0: dict, ok: bool, aux0: Optional[dict] = None,
+                      duration_s: float = 0.0,
+                      err: Optional[BaseException] = None,
+                      metrics_on: bool = False) -> None:
         """Per-query trace epilogue: fold kernel-cache and robustness
         deltas into last_query_metrics, snapshot the tracer (the ring is
         process-wide and resets at the next traced query), build the
-        compact summary, and append the JSONL event log when the sink is
-        a directory."""
+        compact summary, append the JSONL event log when the sink is a
+        directory, land the query in the flight recorder, and feed the
+        whole-query metrics series."""
         from ..robustness import stats_snapshot
         from .physical.kernel_cache import cache_stats
         cs1 = cache_stats()
         if ok:  # on failure last_query_metrics is still the prior query's
             m = self.last_query_metrics
+            m["sessionId"] = self.session_id
             for src, dst in (("hits", "kernelCacheHits"),
                              ("misses", "kernelCacheMisses"),
                              ("compiles", "kernelCompiles"),
@@ -233,22 +268,60 @@ class TpuSession:
             # an older traced query's events must not be joined with THIS
             # query's plan by profile_last_query/export_chrome_trace
             self._last_trace_events = None
+        else:
+            from ..observability import report as OR
+            from ..observability import tracer as OT
+            tr = OT.get_tracer()
+            self._last_trace_events = tr.snapshot()
+            self._last_trace_meta = dict(tr.meta(), query=self._query_seq)
+            self.last_query_trace_summary = OR.trace_summary(
+                self._last_trace_events, tr.counters, tr.dropped_events)
+            if ok:
+                # a truncated ring can never silently skew doctor
+                # attribution: the drop count and how full the ring got
+                # ride every traced query's metrics
+                self.last_query_metrics["traceDroppedEvents"] = \
+                    tr.dropped_events
+                self.last_query_metrics["traceRingHighWater"] = \
+                    tr.high_water
+            if sink and sink != "memory":
+                from ..observability import export as OE
+                try:
+                    OE.write_event_log(
+                        OE.event_log_path(sink, self._query_seq),
+                        self._last_trace_events, self._last_trace_meta)
+                except OSError:  # the sink must never fail the query
+                    pass
+        self._record_history(ok, duration_s, err)
+        if metrics_on:
+            from ..observability import metrics as OM
+            status = "ok" if ok else "failed"
+            OM.get_registry().observe("query_ms", duration_s * 1e3,
+                                      status=status)
+            OM.get_registry().inc("queries_total", status=status)
+
+    def _record_history(self, ok: bool, duration_s: float,
+                        err: Optional[BaseException]) -> None:
+        """Land one flight-recorder record (must never fail the query)."""
+        from ..config import HISTORY_ENABLED, HISTORY_MAX_QUERIES, \
+            HISTORY_PATH
+        if not bool(self._conf.get(HISTORY_ENABLED)):
             return
-        from ..observability import report as OR
-        from ..observability import tracer as OT
-        tr = OT.get_tracer()
-        self._last_trace_events = tr.snapshot()
-        self._last_trace_meta = dict(tr.meta(), query=self._query_seq)
-        self.last_query_trace_summary = OR.trace_summary(
-            self._last_trace_events, tr.counters, tr.dropped_events)
-        if sink and sink != "memory":
-            from ..observability import export as OE
-            try:
-                OE.write_event_log(
-                    OE.event_log_path(sink, self._query_seq),
-                    self._last_trace_events, self._last_trace_meta)
-            except OSError:  # the sink must never fail the query
-                pass
+        try:
+            from ..observability import history as OH
+            if self._history is None:
+                self._history = OH.QueryHistory(
+                    int(self._conf.get(HISTORY_MAX_QUERIES)),
+                    str(self._conf.get(HISTORY_PATH) or ""))
+            self._history.record(OH.build_record(
+                query_id=self._query_seq, session_id=self.session_id,
+                ok=ok, duration_ms=duration_s * 1e3,
+                phys=getattr(self, "_last_phys", None) if ok else None,
+                metrics=self.last_query_metrics if ok else None,
+                trace_summary=self.last_query_trace_summary,
+                error=f"{type(err).__name__}: {err}" if err else None))
+        except Exception:
+            pass
 
     def _execute_traced(self, logical: P.LogicalPlan, device_to_arrow,
                         speculation) -> pa.Table:
@@ -347,6 +420,46 @@ class TpuSession:
         from ..observability.export import write_chrome_trace
         return write_chrome_trace(path, events,
                                   getattr(self, "_last_trace_meta", None))
+
+    def query_history(self, n: Optional[int] = None) -> List[dict]:
+        """Flight-recorder records for this session's queries, oldest
+        first (``spark.rapids.tpu.history.enabled``); ``n`` bounds the
+        result to the newest n."""
+        if self._history is None:
+            return []
+        return self._history.tail(n)
+
+    def metrics_snapshot(self) -> dict:
+        """JSON snapshot of the process-wide metrics registry (series
+        recorded while ``spark.rapids.tpu.metrics.enabled`` queries
+        ran) — counters, gauges, histograms with p50/p95/p99."""
+        from ..observability.metrics import get_registry
+        return get_registry().json_snapshot()
+
+    def metrics_prometheus(self) -> str:
+        """The metrics registry in Prometheus exposition text format."""
+        from ..observability.metrics import get_registry
+        return get_registry().prometheus_text()
+
+    def diagnose_last_query(self) -> dict:
+        """Ranked bottleneck diagnosis of the most recent traced query
+        (observability/doctor.py): named verdict + supporting exec-level
+        spans and counters.  Requires the query to have run with
+        spark.rapids.tpu.trace.sink or profile.enabled."""
+        events = getattr(self, "_last_trace_events", None)
+        if not events:
+            raise RuntimeError(
+                "no traced query: set spark.rapids.tpu.trace.sink "
+                "(or spark.rapids.tpu.profile.enabled) before collect()")
+        from ..observability import doctor as OD
+        meta = getattr(self, "_last_trace_meta", {})
+        hist = self.query_history(1)
+        wall = hist[-1]["duration_ms"] if hist else None
+        return OD.diagnose(events, counters=meta.get("counters"),
+                           metrics=self.last_query_metrics,
+                           wall_ms=wall,
+                           dropped_events=int(
+                               meta.get("dropped_events", 0)))
 
     def explain(self, df: DataFrame, all_ops: bool = True) -> str:
         """Placement report (spark.rapids.sql.explain=ALL equivalent) plus

@@ -58,6 +58,11 @@ QUICK_MODULES = {
     # fused-vs-killswitched bit parity, and the donation-safety guard
     # are tier-1 — a fusion or donation bug is silent data corruption
     "test_whole_stage",
+    # performance flight recorder (ISSUE 8): metrics-registry accounting
+    # under the parallel scheduler, doctor verdicts on known injected
+    # bottlenecks, and the bench_diff evidence gate are tier-1 — wrong
+    # attribution silently misdirects every perf decision downstream
+    "test_metrics_registry", "test_doctor",
 }
 
 

@@ -1,0 +1,355 @@
+"""Metrics registry (observability/metrics.py) + query flight recorder
+(observability/history.py): histogram bucketing and quantiles, thread
+safety under the PR 5 parallel-scheduler shape, ~0 off-overhead,
+Prometheus/JSON export schema, cardinality bound, session wiring
+(query/session labels, query_history) — ISSUE 8 tier-1 coverage."""
+
+import json
+import math
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.observability import history as OH
+from spark_rapids_tpu.observability import metrics as OM
+from spark_rapids_tpu.sql import functions as F
+
+
+@pytest.fixture
+def registry():
+    """Fresh process registry with the flag ON, restored afterwards."""
+    prev = OM.METRICS["on"]
+    reg = OM.get_registry()
+    reg.reset(max_series=4096)
+    reg.set_default_labels()
+    OM.METRICS["on"] = True
+    yield reg
+    OM.METRICS["on"] = prev
+    reg.reset()
+    reg.set_default_labels()
+
+
+# --------------------------------------------------------------------------
+# histogram bucketing + quantiles
+# --------------------------------------------------------------------------
+
+def test_bucket_index_bounds_cover_values():
+    """Every value lands in a bucket whose upper bound is >= value and
+    (for in-range values) whose lower neighbour is < value."""
+    for v in (1e-9, 0.001, 0.06104, 0.5, 1.0, 1.5, 2.0, 3.7, 1000.0,
+              1048576.0, 1e12):
+        i = OM._bucket_index(v)
+        assert v <= OM.BUCKET_BOUNDS[i] or i == len(OM.BUCKET_BOUNDS) - 1
+        if 0 < i < len(OM.BUCKET_BOUNDS) - 1 \
+                and v <= OM.BUCKET_BOUNDS[-2]:
+            assert v > OM.BUCKET_BOUNDS[i - 1]
+    # exact powers of two sit at their own bound (le semantics)
+    assert OM.BUCKET_BOUNDS[OM._bucket_index(1.0)] == 1.0
+    assert OM.BUCKET_BOUNDS[OM._bucket_index(256.0)] == 256.0
+    # non-positive and NaN land in bucket 0 instead of raising
+    assert OM._bucket_index(0.0) == 0
+    assert OM._bucket_index(-5.0) == 0
+    assert OM._bucket_index(float("nan")) == 0
+
+
+def test_histogram_count_sum_min_max_and_quantiles(registry):
+    values = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0]
+    for v in values:
+        registry.observe("lat_ms", v)
+    snap = registry.json_snapshot()["histograms"]
+    assert len(snap) == 1
+    h = snap[0]
+    assert h["name"] == "lat_ms"
+    assert h["count"] == 10
+    assert h["sum"] == pytest.approx(sum(values))
+    assert h["min"] == 1.0 and h["max"] == 512.0
+    # log-bucketed quantiles: p50 in the middle decades, p99 near max
+    assert 4.0 <= h["p50"] <= 64.0
+    assert h["p95"] >= 128.0
+    assert h["p99"] >= h["p95"]
+    assert h["p99"] <= 512.0  # never outside the observed range
+
+
+def test_histogram_quantile_single_value(registry):
+    registry.observe("one", 42.0)
+    h = registry.json_snapshot()["histograms"][0]
+    assert h["p50"] == 42.0 and h["p99"] == 42.0
+
+
+# --------------------------------------------------------------------------
+# thread safety (the PR 5 parallel-scheduler shape: pool workers feeding
+# one registry concurrently)
+# --------------------------------------------------------------------------
+
+def test_thread_safety_exact_accounting(registry):
+    n_threads, per_thread = 8, 400
+    barrier = threading.Barrier(n_threads)
+
+    def work(t):
+        barrier.wait()
+        for i in range(per_thread):
+            registry.inc("ops_total")
+            registry.observe("op_ms", float(i % 37) + 0.5, worker=str(t))
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = registry.json_snapshot()
+    counter = [c for c in snap["counters"] if c["name"] == "ops_total"]
+    assert counter[0]["value"] == n_threads * per_thread
+    hists = [h for h in snap["histograms"] if h["name"] == "op_ms"]
+    assert len(hists) == n_threads  # one series per worker label
+    assert sum(h["count"] for h in hists) == n_threads * per_thread
+
+
+# --------------------------------------------------------------------------
+# off-overhead ~ 0: the disabled path records nothing and does no work
+# beyond one flag lookup
+# --------------------------------------------------------------------------
+
+def test_disabled_feeds_are_noops():
+    prev = OM.METRICS["on"]
+    OM.METRICS["on"] = False
+    reg = OM.get_registry()
+    reg.reset()
+    try:
+        OM.inc("should_not_exist", 5)
+        OM.observe("nor_this", 1.0)
+        OM.set_gauge("nor_that", 2.0)
+        snap = reg.json_snapshot()
+        assert snap["counters"] == [] and snap["histograms"] == [] \
+            and snap["gauges"] == []
+    finally:
+        OM.METRICS["on"] = prev
+
+
+def test_metrics_off_by_default_query_records_nothing():
+    reg = OM.get_registry()
+    reg.reset()
+    sess = srt.session(**{"spark.rapids.tpu.metrics.enabled": False})
+    df = sess.create_dataframe(pa.table({"k": [1, 2, 1, 3]}))
+    df.groupBy("k").count().collect()
+    assert OM.METRICS["on"] is False
+    snap = reg.json_snapshot()
+    assert snap["counters"] == [] and snap["histograms"] == []
+
+
+# --------------------------------------------------------------------------
+# export schema
+# --------------------------------------------------------------------------
+
+def _parse_prom(text):
+    """{series_name: [(labels_str, value)]} + type lines."""
+    series, types = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split()
+            types[name] = typ
+            continue
+        assert not line.startswith("#")
+        metric, val = line.rsplit(" ", 1)
+        series.setdefault(metric, []).append(val)
+    return series, types
+
+
+def test_prometheus_export_schema(registry):
+    registry.inc("frames_total", 3, plane="local")
+    registry.set_gauge("ring_fill", 0.5)
+    for v in (1.0, 10.0, 100.0):
+        registry.observe("wait_ms", v, exec="TpuSort")
+    text = registry.prometheus_text()
+    series, types = _parse_prom(text)
+    assert types["srt_frames_total"] == "counter"
+    assert types["srt_ring_fill"] == "gauge"
+    assert types["srt_wait_ms"] == "histogram"
+    assert 'srt_frames_total{plane="local"}' in series
+    # histogram contract: cumulative non-decreasing buckets, +Inf bucket,
+    # _sum and _count present and consistent
+    buckets = [(k, int(v[0])) for k, v in series.items()
+               if k.startswith("srt_wait_ms_bucket")]
+    assert any('le="+Inf"' in k for k, _ in buckets)
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts)
+    assert int(series['srt_wait_ms_count{exec="TpuSort"}'][0]) == 3
+    assert float(series['srt_wait_ms_sum{exec="TpuSort"}'][0]) == 111.0
+    inf_count = [v for k, v in buckets if 'le="+Inf"' in k][0]
+    assert inf_count == 3
+
+
+def test_prometheus_counter_total_suffix_not_doubled(registry):
+    registry.inc("a_total")
+    registry.inc("b")
+    text = registry.prometheus_text()
+    assert "srt_a_total " in text and "srt_a_total_total" not in text
+    assert "srt_b_total " in text
+
+
+def test_json_snapshot_schema(registry):
+    registry.inc("c", 2, k="v")
+    registry.observe("h", 1.5)
+    snap = registry.json_snapshot()
+    assert json.loads(json.dumps(snap)) == snap  # JSON-clean
+    assert snap["counters"][0] == {"name": "c", "labels": {"k": "v"},
+                                   "value": 2}
+    h = snap["histograms"][0]
+    for field in ("name", "labels", "count", "sum", "p50", "p95", "p99"):
+        assert field in h
+    assert snap["dropped_series"] == 0
+
+
+def test_max_series_cardinality_bound(registry):
+    registry.reset(max_series=3)
+    for i in range(10):
+        registry.inc("exploding", 1, label=str(i))
+    snap = registry.json_snapshot()
+    assert len(snap["counters"]) == 3
+    assert snap["dropped_series"] == 7
+    # existing series still accumulate past the cap
+    registry.inc("exploding", 1, label="0")
+    snap = registry.json_snapshot()
+    assert [c for c in snap["counters"]
+            if c["labels"]["label"] == "0"][0]["value"] == 2
+
+
+def test_default_labels_merge_and_override(registry):
+    registry.set_default_labels(session="s1", query=7)
+    registry.inc("x")
+    registry.inc("y", 1, session="override")
+    snap = registry.json_snapshot()
+    by_name = {c["name"]: c["labels"] for c in snap["counters"]}
+    assert by_name["x"] == {"session": "s1", "query": "7"}
+    assert by_name["y"]["session"] == "override"
+
+
+# --------------------------------------------------------------------------
+# session wiring: per-query labels, parallel scheduler, flight recorder
+# --------------------------------------------------------------------------
+
+def _query(sess, parts=2):
+    rng = np.random.default_rng(5)
+    n = 8000
+    fact = pa.table({"fk": rng.integers(0, 200, n), "x": rng.random(n)})
+    dim = pa.table({"pk": np.arange(200, dtype=np.int64),
+                    "cat": rng.integers(0, 8, 200)})
+    f = sess.create_dataframe(fact, num_partitions=parts)
+    d = sess.create_dataframe(dim)
+    return (f.join(d, f.fk == d.pk, "inner").groupBy("cat")
+            .agg(F.count("*").alias("n")).orderBy("cat"))
+
+
+def test_session_feeds_registry_with_labels():
+    OM.get_registry().reset()
+    sess = srt.session(**{"spark.rapids.tpu.metrics.enabled": True})
+    _query(sess).collect()
+    assert OM.METRICS["on"] is False  # restored after the query
+    snap = sess.metrics_snapshot()
+    counters = {c["name"]: c for c in snap["counters"]}
+    assert "device_dispatches_total" in counters
+    assert counters["device_dispatches_total"]["value"] >= 1
+    labels = counters["device_dispatches_total"]["labels"]
+    assert labels["session"] == sess.session_id
+    assert labels["query"]
+    assert any(c["name"] == "queries_total" for c in snap["counters"])
+    assert any(h["name"] == "query_ms" for h in snap["histograms"])
+    prom = sess.metrics_prometheus()
+    assert "srt_device_dispatches_total{" in prom
+
+
+def test_metrics_with_tracer_spans_and_parallel_scheduler():
+    """metrics + tracing + task.parallelism=4: pool workers feed span
+    histograms concurrently without breaking accounting."""
+    OM.get_registry().reset()
+    sess = srt.session(**{"spark.rapids.tpu.metrics.enabled": True,
+                          "spark.rapids.tpu.trace.sink": "memory",
+                          "spark.rapids.tpu.task.parallelism": 4})
+    got = _query(sess, parts=4).collect()
+    assert got.num_rows == 8
+    snap = sess.metrics_snapshot()
+    spans = [h for h in snap["histograms"] if h["name"] == "trace_span_ms"]
+    assert spans, snap["histograms"]
+    assert all(h["labels"].get("cat") for h in spans)
+    # exec label rides the span series (per-exec distributions)
+    assert any(h["labels"].get("exec", "").startswith(("Tpu", "Cpu", "("))
+               for h in spans)
+
+
+def test_metrics_flag_restored_on_failure():
+    prev = OM.METRICS["on"]
+    sess = srt.session(**{"spark.rapids.tpu.metrics.enabled": True})
+    f = F.udf(lambda a: {}[a], returnType=srt.DOUBLE)
+    df = sess.create_dataframe(pa.table({"a": [1.0]}))
+    with pytest.raises(Exception):
+        df.select(f(df.a).alias("b")).collect()
+    assert OM.METRICS["on"] == prev
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+def test_query_history_records_and_bounds(tmp_path):
+    sess = srt.session(**{"spark.rapids.tpu.history.maxQueries": 3,
+                          "spark.rapids.tpu.trace.sink": "memory"})
+    q = _query(sess)
+    for _ in range(5):
+        q.collect()
+    hist = sess.query_history()
+    assert len(hist) == 3  # ring bound
+    rec = hist[-1]
+    assert rec["status"] == "ok"
+    assert rec["session"] == sess.session_id
+    assert rec["duration_ms"] > 0
+    assert rec["plan_fingerprint"]
+    assert rec["trace_summary"]["sync_count"] >= 0
+    assert "kernelCacheHits" in rec["metrics"]
+    # same query shape -> same fingerprint across runs
+    assert hist[0]["plan_fingerprint"] == rec["plan_fingerprint"]
+    assert sess.query_history(1) == [rec]
+
+
+def test_query_history_disk_ring_compacts(tmp_path):
+    path = str(tmp_path / "hist" / "ring.jsonl")
+    h = OH.QueryHistory(max_queries=4, path=path)
+    for i in range(12):
+        h.record({"query": i, "ts": i})
+    recs = OH.read_history_file(path)
+    assert len(recs) <= 2 * 4
+    assert recs[-1]["query"] == 11
+    # the newest max_queries are always present
+    got = [r["query"] for r in recs]
+    assert got == sorted(got)
+    assert set(range(8, 12)) <= set(got)
+
+
+def test_query_history_failed_query_recorded():
+    sess = srt.session()
+    f = F.udf(lambda a: {}[a], returnType=srt.DOUBLE)
+    df = sess.create_dataframe(pa.table({"a": [1.0]}))
+    with pytest.raises(Exception):
+        df.select(f(df.a).alias("b")).collect()
+    hist = sess.query_history()
+    assert hist and hist[-1]["status"] == "failed"
+    assert "error" in hist[-1]
+
+
+def test_history_disabled_records_nothing():
+    sess = srt.session(**{"spark.rapids.tpu.history.enabled": False})
+    sess.create_dataframe(pa.table({"k": [1]})).collect()
+    assert sess.query_history() == []
+
+
+def test_plan_fingerprint_distinguishes_shapes():
+    sess = srt.session()
+    df = sess.create_dataframe(pa.table({"k": [1, 2], "v": [1.0, 2.0]}))
+    p1 = sess.physical_plan(df.groupBy("k").count())
+    p2 = sess.physical_plan(df.orderBy("k"))
+    assert OH.plan_fingerprint(p1) != OH.plan_fingerprint(p2)
+    assert OH.plan_fingerprint(p1) == OH.plan_fingerprint(
+        sess.physical_plan(df.groupBy("k").count()))

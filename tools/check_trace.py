@@ -5,11 +5,17 @@ every event must carry ph/ts/pid/tid/name; "X" complete events must
 carry a non-negative dur.  Used by ci/run_ci.sh after the traced-query
 step and by tests/test_tracer.py.
 
-Usage: python tools/check_trace.py <trace.json> [--min-events N]
-           [--require-cat CAT]
+Usage: python tools/check_trace.py [<trace.json> ...] [--min-events N]
+           [--require-cat CAT] [--prometheus FILE] [--doctor FILE]
 ``--require-cat`` additionally fails unless at least one span event
 carries that category (e.g. ``fault`` for chaos-soak traces).
-Exit 0 on a valid trace, 1 otherwise.
+``--prometheus`` validates a metrics-registry export against the
+Prometheus exposition contract (typed series, cumulative histogram
+buckets ending at +Inf, consistent _sum/_count).
+``--doctor`` validates a doctor diagnosis JSON against the
+srt-doctor/1 schema (known verdict, ranked entries with
+category/ms/share/evidence).
+Exit 0 when every requested check passes, 1 otherwise.
 """
 
 import json
@@ -58,12 +64,105 @@ def check(path: str, min_events: int = 1, require_cat: str = ""):
     return spans, sorted(c for c in cats if c)
 
 
+#: the doctor's verdict taxonomy (observability/doctor.py VERDICTS)
+DOCTOR_VERDICTS = ("sync-bound", "compile-bound", "h2d-d2h-bound",
+                   "dispatch-bound", "sem_wait-bound", "spill-bound",
+                   "shuffle-bound", "no-bottleneck")
+
+
+def check_prometheus(path: str):
+    """Validate Prometheus exposition text: every sample belongs to a
+    # TYPE-declared family; histogram buckets are cumulative and end at
+    +Inf with a count matching _count."""
+    import re
+    types = {}
+    samples = []
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, typ = line.split()
+                if typ not in ("counter", "gauge", "histogram"):
+                    raise ValueError(f"line {ln}: unknown type {typ!r}")
+                types[name] = typ
+                continue
+            if line.startswith("#"):
+                continue
+            m = re.match(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})? "
+                         r"([0-9.eE+-]+|\+Inf|NaN)$", line)
+            if not m:
+                raise ValueError(f"line {ln}: malformed sample: {line!r}")
+            samples.append((m.group(1), m.group(2) or "", m.group(3)))
+    if not samples:
+        raise ValueError("no samples")
+    fams = set(types)
+    buckets = {}
+    for name, labels, value in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in fams:
+                base = name[:-len(suffix)]
+        if base not in fams:
+            raise ValueError(f"sample {name!r} has no # TYPE declaration")
+        if name.endswith("_bucket") and types.get(base) == "histogram":
+            series = labels.replace('le="', "\0").split("\0")[0]
+            buckets.setdefault((base, series), []).append(
+                (labels, float("inf") if "+Inf" in labels
+                 else None, int(float(value))))
+    for (base, _), rows in buckets.items():
+        counts = [v for _, _, v in rows]
+        if counts != sorted(counts):
+            raise ValueError(f"{base}: bucket counts not cumulative")
+        if not any(le == float("inf") for _, le, _ in rows):
+            raise ValueError(f"{base}: histogram missing +Inf bucket")
+    return len(samples), sorted(types)
+
+
+def check_doctor(path: str):
+    """Validate a doctor diagnosis JSON (srt-doctor/1 schema)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != "srt-doctor/1":
+        raise ValueError(f"schema is {doc.get('schema')!r}, "
+                         f"expected 'srt-doctor/1'")
+    if doc.get("verdict") not in DOCTOR_VERDICTS:
+        raise ValueError(f"unknown verdict {doc.get('verdict')!r}")
+    ranked = doc.get("ranked")
+    if not isinstance(ranked, list):
+        raise ValueError("ranked is not a list")
+    if doc["verdict"] != "no-bottleneck" and not ranked:
+        raise ValueError("non-trivial verdict with empty ranked list")
+    last_ms = float("inf")
+    for i, e in enumerate(ranked):
+        for field in ("category", "ms", "count", "share", "evidence"):
+            if field not in e:
+                raise ValueError(f"ranked[{i}] missing {field!r}: {e}")
+        if e["category"] not in DOCTOR_VERDICTS:
+            raise ValueError(f"ranked[{i}] unknown category "
+                             f"{e['category']!r}")
+        if not 0.0 <= e["share"] <= 1.0:
+            raise ValueError(f"ranked[{i}] share out of range: "
+                             f"{e['share']}")
+        if e["ms"] > last_ms + 1e-9:
+            raise ValueError("ranked list not sorted by ms desc")
+        last_ms = e["ms"]
+    if ranked and doc["verdict"] != ranked[0]["category"]:
+        raise ValueError("verdict != top ranked category")
+    if not isinstance(doc.get("trace_truncated"), bool):
+        raise ValueError("trace_truncated missing or not bool")
+    return doc["verdict"], len(ranked)
+
+
 def main(argv) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 1
     min_events = 1
     require_cat = ""
+    prom_paths = []
+    doctor_paths = []
     if "--min-events" in argv:
         i = argv.index("--min-events")
         min_events = int(argv[i + 1])
@@ -72,12 +171,34 @@ def main(argv) -> int:
         i = argv.index("--require-cat")
         require_cat = argv[i + 1]
         argv = argv[:i] + argv[i + 2:]
+    while "--prometheus" in argv:
+        i = argv.index("--prometheus")
+        prom_paths.append(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    while "--doctor" in argv:
+        i = argv.index("--doctor")
+        doctor_paths.append(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
     rc = 0
     for path in argv:
         try:
             spans, cats = check(path, min_events, require_cat)
             print(f"OK {path}: {spans} span events, "
                   f"categories: {', '.join(cats) or '(none)'}")
+        except (OSError, ValueError, KeyError) as e:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+            rc = 1
+    for path in prom_paths:
+        try:
+            n, fams = check_prometheus(path)
+            print(f"OK {path}: {n} samples, {len(fams)} families")
+        except (OSError, ValueError, KeyError) as e:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+            rc = 1
+    for path in doctor_paths:
+        try:
+            verdict, n = check_doctor(path)
+            print(f"OK {path}: verdict {verdict}, {n} ranked entries")
         except (OSError, ValueError, KeyError) as e:
             print(f"FAIL {path}: {e}", file=sys.stderr)
             rc = 1
