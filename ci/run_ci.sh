@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/14] native libraries ==="
+echo "=== [1/15] native libraries ==="
 make -C native
 
-echo "=== [2/14] API contract validation ==="
+echo "=== [2/15] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/14] docgen drift check ==="
+echo "=== [3/15] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/14] traced query + chrome-trace schema check ==="
+echo "=== [4/15] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,7 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/14] performance flight recorder: metrics + history + doctor + bench_diff ==="
+echo "=== [5/15] performance flight recorder: metrics + history + doctor + bench_diff ==="
 # ISSUE 8 acceptance: a traced query with the metrics registry and the
 # flight recorder enabled must produce (a) a Prometheus export that
 # passes the exposition-contract check, (b) a doctor diagnosis whose
@@ -112,7 +112,7 @@ if python tools/bench_diff.py "$SRT_FR_DIR/live.json" BENCH_r05.json \
     echo "ERROR: bench_diff failed to refuse live-vs-stale"; exit 1
 fi
 
-echo "=== [6/14] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [6/15] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -124,7 +124,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [7/14] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [7/15] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -138,7 +138,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [8/14] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+echo "=== [8/15] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
 # Encoded columnar execution (docs/encoded_columns.md) under seeded
 # faults AND the async pipeline matrix: the chaos session keeps
 # dictionary/RLE columns encoded through filters/joins/group-bys and
@@ -158,7 +158,7 @@ timeout 60 python tools/check_trace.py --require-cat encode \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     8000 --seed 11 --encoded
 
-echo "=== [9/14] whole-stage fusion: plan shape + donation chaos soak ==="
+echo "=== [9/15] whole-stage fusion: plan shape + donation chaos soak ==="
 # Whole-stage XLA compilation (docs/whole_stage.md): (a) the TPC-H-ish
 # suite's plans must contain fused whole-stage nodes — an aggregate
 # terminal (FusedStageExec wrapping the partial agg) and a probe-absorbed
@@ -215,7 +215,100 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat stage \
     "$SRT_WS_TRACE"
 
-echo "=== [10/14] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [10/15] multi-tenant serving: concurrent sessions smoke ==="
+# ISSUE 9 acceptance: N tenant sessions against one ServingEngine —
+# (a) weighted-fair admission: a heavy flood cannot starve a light
+# tenant (bounded wait, grant-order assertion at the controller);
+# (b) cross-query result cache: a repeated query is served from the
+# cache (hit counter) bit-identically; (c) the engine trace carries
+# tenant-labeled spans and the Prometheus export carries the `tenant`
+# label with zero dropped series (maxSeries bound respected); and the
+# multi-session chaos soak proves bit-identical results for every
+# tenant under injected faults.
+SRT_SERVE_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 600 python - "$SRT_SERVE_DIR" <<'PYEOF'
+import sys, os, json, threading
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.serving import AdmissionController, ServingEngine
+from spark_rapids_tpu.serving import result_cache as RC
+out = sys.argv[1]
+
+# (a) admission fairness: heavy floods 8, light submits 2, one slot —
+# with equal weights the light tenant's grants interleave near the front
+ctrl = AdmissionController(max_concurrent=1)
+blocker = ctrl.acquire("blocker")
+order = []
+def w(t):
+    tk = ctrl.acquire(t); order.append(t); ctrl.release(tk)
+ths = [threading.Thread(target=w, args=(t,))
+       for t in ["heavy"]*8 + ["light"]*2]
+[t.start() for t in ths]
+import time
+while ctrl.snapshot()["queued"] < 10: time.sleep(0.005)
+ctrl.release(blocker)
+[t.join(30) for t in ths]
+pos = [i for i, t in enumerate(order) if t == "light"]
+assert pos[0] <= 2 and pos[1] <= 4, f"light tenant starved: {order}"
+print("admission fairness OK: light granted at", pos)
+
+# (b)+(c) engine with result cache + metrics + tracing, 2 tenants
+RC.clear()
+eng = ServingEngine(**{
+    "spark.rapids.tpu.metrics.enabled": True,
+    "spark.rapids.tpu.profile.enabled": True,
+    "spark.rapids.tpu.serving.resultCache.enabled": True,
+    "spark.rapids.tpu.serving.broadcastShare.enabled": True,
+    "spark.rapids.tpu.serving.maxConcurrentQueries": 2})
+rng = np.random.default_rng(3)
+n = 30_000
+fact_t = pa.table({"fk": rng.integers(0, 100, n), "x": rng.random(n)})
+dim_t = pa.table({"pk": np.arange(100, dtype=np.int64),
+                  "cat": rng.integers(0, 8, 100)})
+def q(sess):
+    fact = sess.create_dataframe(fact_t, num_partitions=2)
+    dim = sess.create_dataframe(dim_t)
+    return (fact.join(dim, fact.fk == dim.pk, "inner").groupBy("cat")
+            .agg(F.count("*").alias("n"), F.sum(F.col("x")).alias("sx"))
+            .orderBy("cat")).collect()
+res = {}
+def tenant_worker(t):
+    s = eng.session(tenant=t)
+    res[t] = [q(s), q(s)]
+ths = [threading.Thread(target=tenant_worker, args=(f"t{i}",))
+       for i in range(2)]
+[t.start() for t in ths]; [t.join(120) for t in ths]
+assert res["t0"][0].equals(res["t1"][0]), "cross-tenant parity"
+assert res["t0"][0].equals(res["t0"][1]), "repeat parity"
+rcs = RC.stats()
+assert rcs["hits"] >= 2, f"result cache never hit: {rcs}"
+print("result cache OK:", {k: rcs[k] for k in ("hits", "misses", "stores")})
+hist = eng.query_history()
+assert {r.get("tenant") for r in hist} == {"t0", "t1"}
+diag = eng.diagnose_tenants()
+assert set(diag) == {"t0", "t1"}
+print("per-tenant verdicts:",
+      {t: d["diagnosis"]["verdict"] for t, d in diag.items()})
+snap = eng.metrics_snapshot()
+assert snap["dropped_series"] == 0, "tenant label blew the maxSeries bound"
+with open(os.path.join(out, "serving.prom"), "w") as fh:
+    fh.write(eng.metrics_prometheus())
+eng.export_chrome_trace(os.path.join(out, "serving_trace.json"))
+eng.close()
+print("serving smoke OK: admission", eng.admission_stats()["admitted"],
+      "admitted,", len(hist), "history records")
+PYEOF
+timeout 60 python tools/check_trace.py --require-cat admission \
+    --require-arg tenant "$SRT_SERVE_DIR/serving_trace.json" \
+    --prometheus "$SRT_SERVE_DIR/serving.prom" --prometheus-label tenant
+# multi-session chaos soak: >=2 tenants concurrently under faults,
+# every tenant bit-identical to the serial clean run
+JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
+    10000 --seed 11 --multi-session
+
+echo "=== [11/15] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -236,14 +329,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [11/14] scale rig ==="
+    echo "=== [12/15] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [11/14] scale rig skipped (quick) ==="
+    echo "=== [12/15] scale rig skipped (quick) ==="
 fi
 
-echo "=== [12/14] packaging: wheel builds and installs ==="
+echo "=== [13/15] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -273,17 +366,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [13/14] driver entry checks ==="
+echo "=== [14/15] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [14/14] second-jax shim world skipped (quick) ==="
+    echo "=== [15/15] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [14/14] second-jax shim world (gated) ==="
+echo "=== [15/15] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

@@ -773,6 +773,74 @@ DUMP_ON_ERROR_PATH = register(
 STABLE_SORT = register(
     "spark.rapids.sql.stableSort.enabled", "Force stable device sorts.", False)
 
+# --- multi-tenant serving (serving/, docs/serving.md) -----------------------
+SERVING_TENANT = register(
+    "spark.rapids.tpu.serving.tenant",
+    "Tenant identity of this session.  Stamped on metric series (the "
+    "registry's `tenant` label), trace spans, and flight-recorder "
+    "records; the serving tier's admission queue schedules and budgets "
+    "by it.  Empty (default) means the anonymous single-tenant mode.",
+    "")
+SERVING_MAX_CONCURRENT = register(
+    "spark.rapids.tpu.serving.maxConcurrentQueries",
+    "How many admitted queries a ServingEngine lets execute at once "
+    "across ALL tenants.  This caps driver-side concurrency; device "
+    "admission below it is still arbitrated per task by "
+    "spark.rapids.sql.concurrentGpuTasks and the device semaphore.",
+    8, commonly_used=True)
+SERVING_ADMISSION_TIMEOUT_MS = register(
+    "spark.rapids.tpu.serving.admission.timeoutMs",
+    "Upper bound on how long a query may wait in the admission queue "
+    "before AdmissionTimeout is raised; 0 (default) waits forever.", 0)
+SERVING_TENANT_WEIGHTS = register(
+    "spark.rapids.tpu.serving.tenant.weights",
+    "Comma list of tenant:weight pairs (e.g. 'etl:4,adhoc:1') for the "
+    "weighted-fair admission queue: a tenant's share of admission slots "
+    "is proportional to its weight.  Tenants not listed get "
+    "spark.rapids.tpu.serving.tenant.defaultWeight.", "")
+SERVING_TENANT_DEFAULT_WEIGHT = register(
+    "spark.rapids.tpu.serving.tenant.defaultWeight",
+    "Admission weight for tenants absent from "
+    "spark.rapids.tpu.serving.tenant.weights.", 1.0)
+SERVING_TENANT_BUDGETS = register(
+    "spark.rapids.tpu.serving.tenant.memoryBudgets",
+    "Comma list of tenant:bytes pairs capping the estimated input bytes "
+    "a tenant may have ADMITTED at once.  The budget gates admission "
+    "only — actual device memory stays arbitrated by the semaphore, "
+    "OOM-guard and spill machinery.  A query whose lone estimate "
+    "exceeds the budget still admits when the tenant has nothing else "
+    "in flight (a budget must throttle, never wedge).", "")
+SERVING_TENANT_DEFAULT_BUDGET = register(
+    "spark.rapids.tpu.serving.tenant.defaultMemoryBudgetBytes",
+    "Admission memory budget for tenants absent from "
+    "spark.rapids.tpu.serving.tenant.memoryBudgets; 0 (default) means "
+    "unbudgeted.", 0)
+SERVING_RESULT_CACHE_ENABLED = register(
+    "spark.rapids.tpu.serving.resultCache.enabled",
+    "Cross-query result cache: a collect whose plan content fingerprint "
+    "(operators + literals + input identity) matches a cached entry "
+    "returns the cached Arrow table without executing.  Entries are "
+    "invalidated when any input file's mtime/size changes and on every "
+    "write through io_/writers.py; plans containing non-deterministic "
+    "expressions or opaque UDFs are never cached.  Off (default) "
+    "outside serving engines.", False, commonly_used=True)
+SERVING_RESULT_CACHE_MAX_BYTES = register(
+    "spark.rapids.tpu.serving.resultCache.maxBytes",
+    "Byte bound on the result cache (Arrow table nbytes); least-"
+    "recently-used entries evict past it.", 256 << 20)
+SERVING_BROADCAST_SHARE = register(
+    "spark.rapids.tpu.serving.broadcastShare.enabled",
+    "Share materialized broadcast batches ACROSS queries and sessions "
+    "by plan-content key (child subtree + literals + input identity + "
+    "encode params).  Shared batches are pinned in the retention "
+    "registry so whole-stage donation stays safe; entries follow the "
+    "same file-mtime/write invalidation contract as the result cache.  "
+    "Off (default) keeps broadcasts per-plan.", False)
+SERVING_BROADCAST_SHARE_MAX_BYTES = register(
+    "spark.rapids.tpu.serving.broadcastShare.maxBytes",
+    "Byte bound on the shared broadcast cache; LRU entries evict (and "
+    "unpin) past it.", 256 << 20)
+
 # --- TPU-specific ----------------------------------------------------------
 BUCKET_MIN_ROWS = register(
     "spark.rapids.tpu.shapeBucket.minRows",

@@ -39,6 +39,11 @@ _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv",
 
 def write_table(fmt: str, table: pa.Table, path: str, options: Dict) -> None:
     from .registry import _normalize_fmt
+    from ..serving import note_write
+    # per-file invalidation sweep (serving result/broadcast caches); the
+    # job-level sweep in run_write_job covers the directory, this covers
+    # direct single-file writes (delta/iceberg data files, tests)
+    note_write(path)
     fmt = _normalize_fmt(fmt, options)
     if fmt == "parquet":
         import pyarrow.parquet as pq
@@ -229,4 +234,10 @@ def run_write_job(child: PhysicalPlan, fmt: str, path: str, mode: str,
     # job commit marker (Hadoop committer analog)
     with open(os.path.join(path, "_SUCCESS"), "w"):
         pass
+    # serving-tier invalidation contract (docs/serving.md): every write
+    # through this path sweeps the cross-query result/broadcast caches —
+    # a cached result over files this job just rewrote must never be
+    # served again
+    from ..serving import note_write
+    note_write(path)
     return write_exec.job_stats

@@ -57,11 +57,12 @@ _CAT_TO_VERDICT = {
     "spill": "spill-bound",
     "shuffle": "shuffle-bound",
     "queue": "shuffle-bound",
+    "admission": "admission-bound",
 }
 
 VERDICTS = ("sync-bound", "compile-bound", "h2d-d2h-bound",
             "dispatch-bound", "sem_wait-bound", "spill-bound",
-            "shuffle-bound")
+            "shuffle-bound", "admission-bound")
 
 #: per-launch overhead floor used to estimate dispatch-bound time when
 #: the trace cannot attribute it directly (Python dispatch + XLA launch;
@@ -268,6 +269,64 @@ def diagnose_summary(summary: Dict[str, Any],
     }
     if wall_ms is not None:
         out["wall_ms"] = round(float(wall_ms), 3)
+    return out
+
+
+def diagnose_tenants(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-TENANT bottleneck verdicts from flight-recorder records (the
+    serving tier's ``engine.diagnose_tenants()``): records group by their
+    ``tenant`` stamp, each group's trace summaries aggregate into one
+    degraded-fidelity :func:`diagnose_summary`, and admission-queue wait
+    (``admissionWaitMs`` in each record's metrics) joins the ranking as
+    ``admission-bound`` — a tenant whose time goes to waiting for slots
+    needs a weight/budget change, not a kernel fix."""
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        groups.setdefault(str(rec.get("tenant") or "default"),
+                          []).append(rec)
+    out: Dict[str, Any] = {}
+    for tenant, recs in sorted(groups.items()):
+        durs = sorted(float(r.get("duration_ms", 0.0)) for r in recs)
+        agg: Dict[str, float] = {}
+        adm_ms = 0.0
+        adm_n = 0
+        for r in recs:
+            for k, v in (r.get("trace_summary") or {}).items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    agg[k] = agg.get(k, 0.0) + v
+            w = (r.get("metrics") or {}).get("admissionWaitMs", 0.0)
+            if w:
+                adm_ms += float(w)
+                adm_n += 1
+        wall = sum(durs) + adm_ms
+        diag = diagnose_summary(agg, wall_ms=wall or None)
+        if adm_ms > 0:
+            diag["ranked"].append(_verdict_entry(
+                "admission-bound", adm_ms, adm_n,
+                {"note": "time queued before execution; levers: tenant "
+                         "weight, memory budget, maxConcurrentQueries"}))
+            diag["ranked"].sort(key=lambda e: -e["ms"])
+            denom = wall or sum(e["ms"] for e in diag["ranked"]) or 1.0
+            for e in diag["ranked"]:
+                e["share"] = round(min(1.0, e["ms"] / max(denom, 1e-9)), 4)
+            diag["verdict"] = diag["ranked"][0]["category"]
+            diag["attributed_ms"] = round(
+                sum(e["ms"] for e in diag["ranked"]), 3)
+
+        def _pctl(q: float) -> float:
+            if not durs:
+                return 0.0
+            return durs[min(len(durs) - 1, int(q * len(durs)))]
+
+        out[tenant] = {
+            "queries": len(recs),
+            "failed": sum(1 for r in recs
+                          if r.get("status") != "ok"),
+            "p50_ms": round(_pctl(0.50), 3),
+            "p99_ms": round(_pctl(0.99), 3),
+            "admission_wait_ms": round(adm_ms, 3),
+            "diagnosis": compact(diag),
+        }
     return out
 
 

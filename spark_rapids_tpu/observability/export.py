@@ -41,6 +41,12 @@ def chrome_trace(events: List[Dict[str, Any]],
         args = dict(ev.get("args") or {})
         if ev.get("exec"):
             args["exec"] = ev["exec"]
+        # per-event tenant/session identity (serving tier: one engine
+        # trace interleaves N sessions, so identity rides the events)
+        if ev.get("tenant"):
+            args["tenant"] = ev["tenant"]
+        if ev.get("sid"):
+            args["sid"] = ev["sid"]
         out.append({
             "ph": "X", "cat": ev.get("cat", ""), "name": ev["name"],
             "ts": round(float(ev["ts"]), 3),

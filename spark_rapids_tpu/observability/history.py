@@ -73,7 +73,8 @@ def build_record(*, query_id: int, session_id: str, ok: bool,
                  duration_ms: float, phys=None,
                  metrics: Optional[Dict[str, Any]] = None,
                  trace_summary: Optional[Dict[str, Any]] = None,
-                 error: Optional[str] = None) -> Dict[str, Any]:
+                 error: Optional[str] = None,
+                 tenant: str = "") -> Dict[str, Any]:
     """One flight-recorder record (schema documented in
     docs/observability.md)."""
     rec: Dict[str, Any] = {
@@ -83,6 +84,8 @@ def build_record(*, query_id: int, session_id: str, ok: bool,
         "status": "ok" if ok else "failed",
         "duration_ms": round(float(duration_ms), 3),
     }
+    if tenant:
+        rec["tenant"] = tenant
     if phys is not None:
         rec["plan_fingerprint"] = plan_fingerprint(phys)
         rec["plan"] = plan_outline(phys)
@@ -100,6 +103,30 @@ def build_record(*, query_id: int, session_id: str, ok: bool,
     if trace_summary:
         rec["trace_summary"] = trace_summary
     return rec
+
+
+#: one QueryHistory per on-disk path, process-wide: concurrent sessions
+#: configured with the same JSONL ring MUST share one instance (and thus
+#: one append lock) — separate instances would interleave partial lines
+#: through independent file handles and double-compact each other's
+#: rewrites.  In-memory-only histories stay per-session (empty path).
+_SHARED_LOCK = threading.Lock()
+_SHARED: Dict[str, "QueryHistory"] = {}
+
+
+def shared_history(max_queries: int, path: str) -> "QueryHistory":
+    """The process-wide QueryHistory for ``path`` (a fresh private one
+    when ``path`` is empty).  All appends to one file serialize through
+    the shared instance's lock; ``tail(session=...)`` filters a shared
+    ring back down to one session's queries."""
+    if not path:
+        return QueryHistory(max_queries, "")
+    key = os.path.abspath(path)
+    with _SHARED_LOCK:
+        h = _SHARED.get(key)
+        if h is None:
+            h = _SHARED[key] = QueryHistory(max_queries, path)
+        return h
 
 
 class QueryHistory:
@@ -141,10 +168,20 @@ class QueryHistory:
             fh.writelines(lines[-self.max_queries:])
         os.replace(tmp, self.path)
 
-    def tail(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
-        """Newest-last records; ``n`` bounds the result (None = all)."""
+    def tail(self, n: Optional[int] = None,
+             session: Optional[str] = None,
+             tenant: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Newest-last records; ``n`` bounds the result (None = all).
+        ``session``/``tenant`` filter a SHARED ring down to one owner's
+        records (the multi-session contract: every record is stamped
+        with both, so a session reading a ring other sessions also feed
+        still sees exactly its own queries)."""
         with self._lock:
             out = list(self._ring)
+        if session is not None:
+            out = [r for r in out if r.get("session") == session]
+        if tenant is not None:
+            out = [r for r in out if r.get("tenant", "") == tenant]
         if n is not None:
             out = out[-max(0, int(n)):]
         return out

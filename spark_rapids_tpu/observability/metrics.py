@@ -138,6 +138,11 @@ class MetricsRegistry:
         #: labels merged into every series (query id / session id) —
         #: set by the session at query start, single-driver model
         self._default_labels: Dict[str, str] = {}
+        #: per-THREAD label overlay (tenant / session / query under the
+        #: serving tier, where N driver threads record concurrently and
+        #: one global default would cross-stamp tenants).  Thread labels
+        #: override default labels; explicit call labels override both.
+        self._tls = threading.local()
 
     # --- lifecycle --------------------------------------------------------
     def reset(self, max_series: Optional[int] = None) -> None:
@@ -156,10 +161,25 @@ class MetricsRegistry:
             self._default_labels = {k: str(v) for k, v in labels.items()
                                     if v is not None and str(v) != ""}
 
+    def set_thread_labels(self, **labels: Any) -> None:
+        """Labels stamped on series recorded from THIS thread (the
+        serving tier sets ``tenant``/``session``/``query`` per admitted
+        query on its driver thread).  Pool/prefetch helper threads do
+        not inherit them — their series keep engine-scope labels only
+        (docs/serving.md)."""
+        self._tls.labels = {k: str(v) for k, v in labels.items()
+                            if v is not None and str(v) != ""}
+
+    def clear_thread_labels(self) -> None:
+        self._tls.labels = None
+
     # --- recording --------------------------------------------------------
     def _key(self, name: str, labels: Dict[str, Any]) -> _SeriesKey:
-        if self._default_labels:
+        thread_labels = getattr(self._tls, "labels", None)
+        if self._default_labels or thread_labels:
             merged = dict(self._default_labels)
+            if thread_labels:
+                merged.update(thread_labels)
             merged.update(labels)
         else:
             merged = labels

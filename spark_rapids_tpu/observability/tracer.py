@@ -67,7 +67,8 @@ TRACING = {"on": False}
 #: known span categories (exported traces may add more; the checker and
 #: the report treat unknown categories as opaque)
 CATEGORIES = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
-              "shuffle", "sem_wait", "fault", "queue", "encode", "stage")
+              "shuffle", "sem_wait", "fault", "queue", "encode", "stage",
+              "admission")
 
 #: default ring capacity (spark.rapids.tpu.trace.bufferEvents)
 DEFAULT_CAPACITY = 65536
@@ -103,6 +104,26 @@ def current_exec() -> str:
     e.g. the driver's final result fetch)."""
     s = _stack()
     return s[-1] if s else ""
+
+
+def set_thread_context(tenant: str = "", sid: str = "") -> None:
+    """Stamp ``tenant`` (and an overriding ``sid``) on spans emitted from
+    THIS thread — the serving tier's per-admitted-query attribution: the
+    tracer ring is engine-scoped under concurrent sessions (one reset for
+    the engine's lifetime), so per-query identity rides the events
+    instead of the ring's single session label.  Spans from pool/prefetch
+    helper threads keep the engine-scope label only (docs/serving.md)."""
+    _tls.tenant = tenant
+    _tls.sid = sid
+
+
+def clear_thread_context() -> None:
+    _tls.tenant = ""
+    _tls.sid = ""
+
+
+def thread_tenant() -> str:
+    return getattr(_tls, "tenant", "")
 
 
 # --------------------------------------------------------------------------
@@ -164,8 +185,12 @@ class QueryTracer:
             "tid": threading.get_ident(),
             "exec": current_exec() if exec_ is None else exec_,
         }
-        if self.session_label:
-            ev["sid"] = self.session_label
+        tsid = getattr(_tls, "sid", "")
+        if tsid or self.session_label:
+            ev["sid"] = tsid or self.session_label
+        tenant = getattr(_tls, "tenant", "")
+        if tenant:
+            ev["tenant"] = tenant
         if args:
             ev["args"] = args
         with self._lock:

@@ -1,0 +1,18 @@
+"""Multi-tenant query serving (docs/serving.md, ROADMAP item 1): N
+concurrent sessions against one engine process, fronted by a
+weighted-fair admission queue with per-tenant memory budgets, with
+cross-query sharing tiers (process-scoped kernel cache + learned
+selectivities, shared broadcast materializations, a plan-fingerprint →
+cached-result tier) and per-tenant observability riding the metrics
+registry, tracer, flight recorder and doctor."""
+
+from .admission import (AdmissionController, AdmissionTimeout,  # noqa: F401
+                        estimate_query_bytes)
+from .engine import ServingEngine  # noqa: F401
+
+
+def note_write(path: str) -> None:
+    """Invalidation hook for io_/writers.py: a write landed at ``path``;
+    sweep every sharing tier whose entries could depend on it."""
+    from . import result_cache
+    result_cache.note_write(path)

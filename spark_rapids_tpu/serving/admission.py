@@ -1,0 +1,295 @@
+"""Admission control — the serving tier's query gate (docs/serving.md).
+
+Weighted-fair queueing over tenants: each admission request is stamped a
+virtual finish time ``vft = max(vclock, tenant's last vft) + 1/weight``
+(start-time fair queueing with unit query cost), and free slots always go
+to the ELIGIBLE waiter with the smallest vft.  A tenant flooding the
+queue only advances its own virtual clock, so a light tenant's requests
+keep small vfts and interleave at a rate proportional to its weight —
+the "heavy tenant cannot starve a light one" guarantee the fairness test
+asserts (bounded admission-wait p99, tests/test_serving.py).
+
+Memory budgets cap what a tenant may have ADMITTED at once — the sum of
+admitted queries' *estimated input bytes* (:func:`estimate_query_bytes`)
+stays under ``spark.rapids.tpu.serving.tenant.memoryBudgets``.  The
+budget gates admission only; actual device memory remains arbitrated by
+the existing semaphore, OOM-guard and spill machinery.  An over-budget
+waiter is SKIPPED (not head-of-line blocking other tenants) until its
+own releases free budget; a lone query whose estimate exceeds the whole
+budget admits when the tenant has nothing else in flight, so a budget
+throttles but can never wedge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..observability import metrics as _om
+
+
+class AdmissionTimeout(RuntimeError):
+    """Raised when a query waited longer than
+    spark.rapids.tpu.serving.admission.timeoutMs for an admission slot."""
+
+
+@dataclass
+class Ticket:
+    tenant: str
+    est_bytes: int
+    vft: float
+    wait_s: float = 0.0
+    _released: bool = field(default=False, repr=False)
+
+
+class _Waiter:
+    __slots__ = ("tenant", "est_bytes", "vft", "seq", "granted")
+
+    def __init__(self, tenant: str, est_bytes: int, vft: float, seq: int):
+        self.tenant = tenant
+        self.est_bytes = est_bytes
+        self.vft = vft
+        self.seq = seq
+        self.granted = False
+
+
+def _parse_pairs(raw: str, cast) -> Dict[str, float]:
+    """'a:2,b:1' -> {'a': 2, 'b': 1} (bad fragments ignored)."""
+    out: Dict[str, float] = {}
+    for frag in str(raw or "").split(","):
+        frag = frag.strip()
+        if not frag or ":" not in frag:
+            continue
+        name, _, val = frag.rpartition(":")
+        try:
+            out[name.strip()] = cast(val.strip())
+        except ValueError:
+            continue
+    return out
+
+
+class AdmissionController:
+    """Thread-safe weighted-fair admission queue with per-tenant memory
+    budgets.  ``acquire`` blocks until granted (or raises
+    :class:`AdmissionTimeout`); ``release`` frees the slot and budget and
+    dispatches the next eligible waiters."""
+
+    def __init__(self, max_concurrent: int = 8,
+                 default_weight: float = 1.0,
+                 weights: Optional[Dict[str, float]] = None,
+                 default_budget: int = 0,
+                 budgets: Optional[Dict[str, int]] = None,
+                 timeout_ms: int = 0):
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.default_weight = max(1e-6, float(default_weight))
+        self.weights = dict(weights or {})
+        self.default_budget = max(0, int(default_budget))
+        self.budgets = {k: int(v) for k, v in (budgets or {}).items()}
+        self.timeout_ms = max(0, int(timeout_ms))
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._running = 0
+        self._seq = 0
+        self._vclock = 0.0
+        self._tenant_vft: Dict[str, float] = {}
+        self._inflight_bytes: Dict[str, int] = {}
+        self._inflight_count: Dict[str, int] = {}
+        self._waiting: List[_Waiter] = []
+        #: per-tenant wait evidence: count/sum/max plus a bounded list of
+        #: recent waits for p99 (fairness tests and engine stats)
+        self._waits: Dict[str, List[float]] = {}
+        self.stats = {"admitted": 0, "timeouts": 0, "peak_queued": 0}
+
+    @classmethod
+    def from_conf(cls, conf) -> "AdmissionController":
+        from ..config import (SERVING_ADMISSION_TIMEOUT_MS,
+                              SERVING_MAX_CONCURRENT,
+                              SERVING_TENANT_BUDGETS,
+                              SERVING_TENANT_DEFAULT_BUDGET,
+                              SERVING_TENANT_DEFAULT_WEIGHT,
+                              SERVING_TENANT_WEIGHTS)
+        return cls(
+            max_concurrent=int(conf.get(SERVING_MAX_CONCURRENT)),
+            default_weight=float(conf.get(SERVING_TENANT_DEFAULT_WEIGHT)),
+            weights=_parse_pairs(conf.get(SERVING_TENANT_WEIGHTS), float),
+            default_budget=int(conf.get(SERVING_TENANT_DEFAULT_BUDGET)),
+            budgets={k: int(v) for k, v in _parse_pairs(
+                conf.get(SERVING_TENANT_BUDGETS), float).items()},
+            timeout_ms=int(conf.get(SERVING_ADMISSION_TIMEOUT_MS)))
+
+    # --- the WFQ scheduler --------------------------------------------------
+    def _weight(self, tenant: str) -> float:
+        return max(1e-6, float(self.weights.get(tenant,
+                                                self.default_weight)))
+
+    def _budget(self, tenant: str) -> int:
+        return int(self.budgets.get(tenant, self.default_budget))
+
+    def _eligible(self, w: _Waiter) -> bool:
+        budget = self._budget(w.tenant)
+        if budget <= 0:
+            return True
+        used = self._inflight_bytes.get(w.tenant, 0)
+        if used + w.est_bytes <= budget:
+            return True
+        # lone-query exemption: an estimate above the whole budget must
+        # still run eventually — admit when nothing of the tenant's is in
+        # flight (the budget throttles concurrency, it never wedges)
+        return self._inflight_count.get(w.tenant, 0) == 0
+
+    def _dispatch_locked(self) -> None:
+        """Grant free slots to eligible waiters in vft order (FIFO within
+        a tenant by seq).  Ineligible (over-budget) waiters are skipped so
+        one tenant's budget stall never blocks another tenant's queue."""
+        if not self._waiting:
+            return
+        changed = False
+        for w in sorted(self._waiting, key=lambda w: (w.vft, w.seq)):
+            if self._running >= self.max_concurrent:
+                break
+            if w.granted or not self._eligible(w):
+                continue
+            w.granted = True
+            self._running += 1
+            self._vclock = max(self._vclock, w.vft)
+            self._inflight_bytes[w.tenant] = \
+                self._inflight_bytes.get(w.tenant, 0) + w.est_bytes
+            self._inflight_count[w.tenant] = \
+                self._inflight_count.get(w.tenant, 0) + 1
+            changed = True
+        if changed:
+            self._cond.notify_all()
+
+    # --- public API ---------------------------------------------------------
+    def acquire(self, tenant: str, est_bytes: int = 0,
+                timeout_ms: Optional[int] = None) -> Ticket:
+        tenant = tenant or "default"
+        est_bytes = max(0, int(est_bytes))
+        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
+        deadline = (time.perf_counter() + timeout_ms / 1e3
+                    if timeout_ms > 0 else None)
+        t0 = time.perf_counter()
+        with self._lock:
+            self._seq += 1
+            vft = max(self._vclock,
+                      self._tenant_vft.get(tenant, 0.0)) \
+                + 1.0 / self._weight(tenant)
+            self._tenant_vft[tenant] = vft
+            w = _Waiter(tenant, est_bytes, vft, self._seq)
+            self._waiting.append(w)
+            self.stats["peak_queued"] = max(self.stats["peak_queued"],
+                                            len(self._waiting))
+            self._dispatch_locked()
+            while not w.granted:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        self._waiting.remove(w)
+                        self.stats["timeouts"] += 1
+                        _om.inc("admission_timeouts_total", tenant=tenant)
+                        raise AdmissionTimeout(
+                            f"tenant {tenant!r} waited "
+                            f">{timeout_ms}ms for an admission slot "
+                            f"({self._running} running, "
+                            f"{len(self._waiting)} queued)")
+                self._cond.wait(remaining)
+            self._waiting.remove(w)
+            wait_s = time.perf_counter() - t0
+            self.stats["admitted"] += 1
+            self._waits.setdefault(tenant, []).append(wait_s * 1e3)
+            if len(self._waits[tenant]) > 4096:
+                del self._waits[tenant][:2048]
+        return Ticket(tenant, est_bytes, vft, wait_s)
+
+    def release(self, ticket: Ticket) -> None:
+        with self._lock:
+            if ticket._released:
+                return
+            ticket._released = True
+            self._running -= 1
+            t = ticket.tenant
+            self._inflight_bytes[t] = max(
+                0, self._inflight_bytes.get(t, 0) - ticket.est_bytes)
+            self._inflight_count[t] = max(
+                0, self._inflight_count.get(t, 0) - 1)
+            self._dispatch_locked()
+            self._cond.notify_all()
+
+    # --- evidence -----------------------------------------------------------
+    def wait_ms_percentile(self, tenant: str, q: float) -> float:
+        with self._lock:
+            waits = sorted(self._waits.get(tenant, ()))
+        if not waits:
+            return 0.0
+        return waits[min(len(waits) - 1, int(q * len(waits)))]
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            tenants = sorted(set(self._waits) | set(self._inflight_count))
+            per_tenant = {}
+            for t in tenants:
+                waits = sorted(self._waits.get(t, ()))
+                per_tenant[t] = {
+                    "admitted": len(waits),
+                    "in_flight": self._inflight_count.get(t, 0),
+                    "in_flight_bytes": self._inflight_bytes.get(t, 0),
+                    "weight": self._weight(t),
+                    "budget_bytes": self._budget(t),
+                    "wait_ms_max": round(waits[-1], 3) if waits else 0.0,
+                    "wait_ms_p50": round(
+                        waits[min(len(waits) - 1, len(waits) // 2)], 3)
+                    if waits else 0.0,
+                    "wait_ms_p99": round(
+                        waits[min(len(waits) - 1,
+                                  int(0.99 * len(waits)))], 3)
+                    if waits else 0.0,
+                }
+            return {
+                "max_concurrent": self.max_concurrent,
+                "running": self._running,
+                "queued": len(self._waiting),
+                "admitted": self.stats["admitted"],
+                "timeouts": self.stats["timeouts"],
+                "peak_queued": self.stats["peak_queued"],
+                "per_tenant": per_tenant,
+            }
+
+
+def estimate_query_bytes(logical) -> int:
+    """Budget-gate estimate for a logical plan: the sum of its leaf input
+    sizes (in-memory table nbytes, file sizes on disk, 8B/row ranges).
+    Deliberately an INPUT-side bound — join blowup and agg fan-in are the
+    OOM-guard's problem; admission only needs a stable, cheap, monotone
+    proxy for how much a tenant is pulling in at once."""
+    import os
+    from ..sql import plan as P
+    total = 0
+    seen = set()
+    stack = [logical]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if isinstance(node, P.Relation) and node.table is not None:
+            total += int(node.table.nbytes)
+        elif isinstance(node, P.ScanRelation):
+            for path in node.paths:
+                try:
+                    if os.path.isdir(path):
+                        for root, _dirs, files in os.walk(path):
+                            total += sum(
+                                os.path.getsize(os.path.join(root, f))
+                                for f in files)
+                    else:
+                        total += os.path.getsize(path)
+                except OSError:
+                    continue
+        elif isinstance(node, P.Range):
+            n = max(0, -(-(node.end - node.start) // (node.step or 1)))
+            total += 8 * n
+        stack.extend(getattr(node, "children", ()))
+    return total

@@ -1,0 +1,143 @@
+"""Shared broadcast cache — cross-query/cross-session reuse of
+materialized broadcast batches (docs/serving.md sharing tier 2).
+
+``BroadcastExchangeExec`` already builds its small side exactly once per
+PLAN and attaches derived join artifacts to the batch
+(``_join_build_sides``) so every probe partition shares one preparation.
+This tier lifts that to the PROCESS: when
+``spark.rapids.tpu.serving.broadcastShare.enabled`` is on, the exec keys
+its child subtree by content (operators + literals + input identity +
+encode params — :mod:`serving.fingerprint`) and consults this cache
+before materializing, so the SAME dimension table broadcast by N queries
+across N sessions uploads, concatenates and build-side-sorts once.
+
+Donation safety: every stored batch is pinned in the retention registry
+(``memory/retention.py``) for as long as it is cached — a downstream
+fused stage can never donate a buffer other queries will re-serve.
+Eviction (LRU past ``broadcastShare.maxBytes``) unpins.  Invalidation
+follows the result cache's contract: stat drift re-checked per hit, and
+writes through ``io_/writers.py`` sweep this cache via the listener
+registered with :func:`result_cache.register_write_listener`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+from ..observability import metrics as _om
+from .fingerprint import ContentKey, plan_content_key
+from . import result_cache as _rc
+
+STATS = {"hits": 0, "misses": 0, "stores": 0, "evictions": 0,
+         "invalidations": 0, "declined": 0}
+
+_LOCK = threading.Lock()
+#: digest -> (ContentKey, ColumnarBatch, nbytes); ordered for LRU
+_ENTRIES: "OrderedDict[str, Tuple[ContentKey, Any, int]]" = OrderedDict()
+_TOTAL_BYTES = [0]
+_MAX_BYTES = [256 << 20]
+
+
+def set_max_bytes(n: int) -> None:
+    with _LOCK:
+        _MAX_BYTES[0] = max(0, int(n))
+        _evict_locked()
+
+
+def content_key(child_phys, conf) -> Optional[ContentKey]:
+    """Content key for a broadcast child subtree.  Encode params join
+    the key because they change the cached batch's COLUMN REPRESENTATION
+    (a dict-encoded batch served to an encoding-off query would decode
+    late instead of never encoding)."""
+    from ..columnar.encoded import encode_params
+    key = plan_content_key(child_phys, conf,
+                           extra=("bcast", encode_params(conf)))
+    if key is None:
+        STATS["declined"] += 1
+    return key
+
+
+def lookup(key: ContentKey):
+    with _LOCK:
+        ent = _ENTRIES.get(key.digest)
+        if ent is None:
+            STATS["misses"] += 1
+            _om.inc("broadcast_share_misses_total")
+            return None
+        stored_key, batch, nbytes = ent
+    if not stored_key.still_valid():
+        _drop(key.digest, reason="invalidations")
+        STATS["misses"] += 1
+        _om.inc("broadcast_share_misses_total")
+        return None
+    with _LOCK:
+        if key.digest in _ENTRIES:
+            _ENTRIES.move_to_end(key.digest)
+        STATS["hits"] += 1
+        _om.inc("broadcast_share_hits_total")
+    return batch
+
+
+def store(key: ContentKey, batch, nbytes: int) -> None:
+    from ..memory import retention as _ret
+    nbytes = max(0, int(nbytes))
+    with _LOCK:
+        if nbytes > _MAX_BYTES[0] or key.digest in _ENTRIES:
+            return
+        # pinned for the cache's hold: served batches must never donate
+        _ret.pin_batch(batch)
+        _ENTRIES[key.digest] = (key, batch, nbytes)
+        _TOTAL_BYTES[0] += nbytes
+        STATS["stores"] += 1
+        _evict_locked()
+
+
+def _evict_locked() -> None:
+    from ..memory import retention as _ret
+    while _ENTRIES and _TOTAL_BYTES[0] > _MAX_BYTES[0]:
+        _d, (_k, batch, nbytes) = _ENTRIES.popitem(last=False)
+        _TOTAL_BYTES[0] -= nbytes
+        _ret.unpin_batch(batch)
+        STATS["evictions"] += 1
+
+
+def _drop(digest: str, reason: str = "invalidations") -> None:
+    from ..memory import retention as _ret
+    with _LOCK:
+        ent = _ENTRIES.pop(digest, None)
+        if ent is None:
+            return
+        _k, batch, nbytes = ent
+        _TOTAL_BYTES[0] -= nbytes
+        STATS[reason] += 1
+    _ret.unpin_batch(batch)
+
+
+def _on_write(path: str) -> None:
+    with _LOCK:
+        doomed = [d for d, (k, _b, _n) in _ENTRIES.items()
+                  if k.depends_on_path(path)]
+    for d in doomed:
+        _drop(d, reason="invalidations")
+
+
+def clear() -> None:
+    from ..memory import retention as _ret
+    with _LOCK:
+        entries = list(_ENTRIES.values())
+        _ENTRIES.clear()
+        _TOTAL_BYTES[0] = 0
+    for _k, batch, _n in entries:
+        _ret.unpin_batch(batch)
+
+
+def stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(STATS, entries=len(_ENTRIES),
+                    bytes=_TOTAL_BYTES[0], max_bytes=_MAX_BYTES[0])
+
+
+# one write hook sweeps every sharing tier (io_/writers.py -> note_write)
+_rc.register_write_listener(_on_write)

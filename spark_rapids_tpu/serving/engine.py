@@ -1,0 +1,181 @@
+"""ServingEngine — N concurrent tenant sessions against one engine
+process (docs/serving.md, ROADMAP item 1).
+
+The engine owns everything that is PROCESS-scoped under concurrency and
+was previously armed per query by a single driver:
+
+* **flags** — tracing/profiling/metrics switches flip ONCE for the
+  engine's lifetime (save/restore around ``close()``); per-query
+  identity rides thread-local labels (metrics registry) and per-event
+  ``tenant``/``sid`` stamps (tracer) instead of global per-query resets.
+* **chaos arming** — a chaos-confed engine arms the seeded fault
+  registry once; serving sessions skip the per-query snapshot/restore
+  dance that would race across driver threads.
+* **admission** — one :class:`AdmissionController` gates every session's
+  collects with weighted-fair scheduling and per-tenant memory budgets.
+* **history** — one shared flight recorder; every record stamps
+  ``tenant`` + ``session`` so ``sess.query_history()`` filters per
+  session and ``engine.query_history()`` sees the whole fleet.
+* **sharing tiers** — the process-scoped kernel cache and learned
+  selectivities already hit across sessions (kernel_cache.py); the
+  engine additionally sizes/enables the result cache and the shared
+  broadcast cache from its conf.
+
+Sessions handed out by :meth:`session` are ordinary
+:class:`~spark_rapids_tpu.sql.session.TpuSession` objects in serving
+mode: one session per submitting thread (a session's per-query state —
+``last_query_metrics``, ``_last_phys`` — is not itself thread-safe).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..config import RapidsConf
+from .admission import AdmissionController
+
+
+class ServingEngine:
+    """One per process (several can exist for tests, but they share the
+    process-scoped caches and flags — last close wins the restore)."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None, **conf_kwargs):
+        from ..config import (METRICS_ENABLED, METRICS_MAX_SERIES,
+                              PROFILE_ENABLED,
+                              SERVING_BROADCAST_SHARE_MAX_BYTES,
+                              SERVING_RESULT_CACHE_ENABLED,
+                              SERVING_RESULT_CACHE_MAX_BYTES,
+                              TRACE_BUFFER_EVENTS, TRACE_SINK)
+        from ..observability import metrics as OM
+        from ..observability import tracer as OT
+        from ..robustness import faults as _faults
+        from ..sql.physical.base import PROFILING
+        from . import broadcast_cache as BC
+        from . import result_cache as RC
+        base = conf or RapidsConf.get_global()
+        self._conf = base.copy(conf_kwargs or None)
+        self.engine_id = f"engine-{os.getpid()}-{id(self) & 0xFFFF:04x}"
+        self.admission = AdmissionController.from_conf(self._conf)
+        self.result_cache_enabled = bool(
+            self._conf.get(SERVING_RESULT_CACHE_ENABLED))
+        RC.set_max_bytes(int(self._conf.get(SERVING_RESULT_CACHE_MAX_BYTES)))
+        BC.set_max_bytes(int(
+            self._conf.get(SERVING_BROADCAST_SHARE_MAX_BYTES)))
+        self._closed = False
+        self._lock = threading.Lock()
+        self._sessions: List[Any] = []
+        # shared flight recorder: one ring (and one on-disk lock) for all
+        # tenant sessions; records stamp tenant + session for filtering
+        from ..config import HISTORY_MAX_QUERIES, HISTORY_PATH
+        from ..observability import history as OH
+        self.history = OH.shared_history(
+            int(self._conf.get(HISTORY_MAX_QUERIES)),
+            str(self._conf.get(HISTORY_PATH) or ""))
+        # --- engine-scoped flag arming (save/restore in close()) ---------
+        self._prev_flags = (PROFILING["on"], OT.TRACING["on"],
+                            OM.METRICS["on"])
+        self._prev_chaos = _faults.snapshot_arming()
+        _faults.apply_conf(self._conf)
+        profiling = bool(self._conf.get(PROFILE_ENABLED))
+        sink = str(self._conf.get(TRACE_SINK) or "").strip()
+        self._tracing = profiling or bool(sink)
+        metrics_on = bool(self._conf.get(METRICS_ENABLED))
+        if metrics_on:
+            reg = OM.get_registry()
+            reg.max_series = int(self._conf.get(METRICS_MAX_SERIES))
+        if self._tracing:
+            OT.get_tracer().reset(int(self._conf.get(TRACE_BUFFER_EVENTS)),
+                                  session=self.engine_id)
+        PROFILING["on"] = profiling or self._tracing
+        OT.TRACING["on"] = self._tracing
+        OM.METRICS["on"] = metrics_on
+
+    # --- sessions -----------------------------------------------------------
+    def session(self, tenant: str = "default", **conf_overrides):
+        """A serving-mode session bound to ``tenant``.  Use one session
+        per submitting thread; sessions are cheap (they share every
+        process-scoped cache)."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        from ..config import SERVING_TENANT
+        from ..sql.session import TpuSession
+        overrides = dict(conf_overrides)
+        overrides[SERVING_TENANT.key] = tenant
+        sess = TpuSession(self._conf.copy(overrides))
+        sess._serving = self
+        sess._history = self.history
+        with self._lock:
+            self._sessions.append(sess)
+        return sess
+
+    # --- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        """Restore the process flags and chaos arming this engine set.
+        Sessions keep working afterwards as plain single-driver sessions
+        (their ``_serving`` ref is cleared)."""
+        if self._closed:
+            return
+        self._closed = True
+        from ..observability import metrics as OM
+        from ..observability import tracer as OT
+        from ..robustness import faults as _faults
+        from ..sql.physical.base import PROFILING
+        with self._lock:
+            for s in self._sessions:
+                s._serving = None
+        PROFILING["on"], OT.TRACING["on"], OM.METRICS["on"] = \
+            self._prev_flags
+        _faults.restore_arming(self._prev_chaos)
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- fleet observability ------------------------------------------------
+    def query_history(self, n: Optional[int] = None,
+                      tenant: Optional[str] = None) -> List[dict]:
+        """Flight-recorder records across ALL tenant sessions (newest
+        last); ``tenant`` filters to one tenant."""
+        return self.history.tail(n, tenant=tenant)
+
+    def diagnose_tenants(self) -> Dict[str, Any]:
+        """Per-tenant bottleneck verdicts over the engine's recorded
+        queries (observability/doctor.py): admission-wait joins the
+        ranking, so a starved tenant reads ``admission-bound``."""
+        from ..observability import doctor as OD
+        return OD.diagnose_tenants(self.history.tail())
+
+    def admission_stats(self) -> Dict[str, Any]:
+        return self.admission.snapshot()
+
+    def metrics_snapshot(self) -> dict:
+        from ..observability.metrics import get_registry
+        return get_registry().json_snapshot()
+
+    def metrics_prometheus(self) -> str:
+        from ..observability.metrics import get_registry
+        return get_registry().prometheus_text()
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the ENGINE-scoped trace ring (all sessions' spans, each
+        stamped with tenant + sid) as Chrome trace-event JSON."""
+        if not self._tracing:
+            raise RuntimeError(
+                "engine tracing off: set spark.rapids.tpu.trace.sink or "
+                "spark.rapids.tpu.profile.enabled on the engine conf")
+        from ..observability import export as OE
+        from ..observability import tracer as OT
+        tr = OT.get_tracer()
+        return OE.write_chrome_trace(path, tr.snapshot(), tr.meta())
+
+    def cache_stats(self) -> Dict[str, Any]:
+        """One snapshot of every cross-query sharing tier."""
+        from ..sql.physical.kernel_cache import cache_stats
+        from . import broadcast_cache as BC
+        from . import result_cache as RC
+        return {"kernel": cache_stats(), "result": RC.stats(),
+                "broadcast": BC.stats()}

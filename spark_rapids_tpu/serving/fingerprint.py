@@ -1,0 +1,188 @@
+"""Plan CONTENT fingerprints — the keying contract shared by the
+cross-query result cache and the shared broadcast cache
+(docs/serving.md).
+
+``observability.history.plan_fingerprint`` deliberately keys on plan
+SHAPE only (node names), so two runs of the same query template share a
+fingerprint regardless of literals.  A cache that returns *results* needs
+the opposite: two plans share a content key only when they compute the
+same value over the same inputs.  The key therefore folds in:
+
+* every node's ``simple_string()`` — expressions render with their
+  literals via ``Expression.sql()``;
+* leaf input identity — in-memory relations by table object identity
+  (held as weakrefs: a dead table invalidates the entry, and an ``id``
+  recycled onto a new table can never alias a live entry) plus
+  rows/bytes; file scans by resolved path list with a stat snapshot
+  (``mtime_ns``, ``size``) per file, re-checked at every cache hit;
+* the encode/layout params that change cached BATCH representation
+  (broadcast cache only — Arrow results are representation-independent)
+  and the result-affecting session confs (ANSI mode, session timezone).
+
+Plans that cannot be proven deterministic are DECLINED (key ``None``):
+non-deterministic expressions (rand/uuid/current_timestamp...), opaque
+Python/Hive UDFs, and leaves this walker does not recognize.  Declining
+only costs a skipped cache, never correctness.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+#: substrings of a plan's rendered text that mark it non-deterministic or
+#: time-dependent (conservative, textual: expressions render via sql()).
+#: Opaque host code (UDF/python/hive execs) is matched on NODE names too.
+_NONDETERMINISTIC_TOKENS = (
+    "rand(", "randn(", "random(", "uuid(", "shuffle(",
+    "current_timestamp", "current_date", "now()", "unix_timestamp()",
+    "input_file_name", "spark_partition_id",
+)
+_OPAQUE_NODE_TOKENS = ("Python", "Udf", "UDF", "Hive", "MapInPandas",
+                       "FlatMapGroups")
+
+#: observability for tests
+STATS = {"declined_nondeterministic": 0, "declined_opaque": 0,
+         "declined_unknown_leaf": 0, "declined_stat": 0}
+
+
+@dataclass
+class ContentKey:
+    """A hashable digest plus the validity evidence a cache entry must
+    re-check on every hit."""
+    digest: str
+    #: path -> (mtime_ns, size) at key-build time
+    file_deps: Dict[str, tuple] = field(default_factory=dict)
+    #: weakrefs to the in-memory input tables; a dead ref kills the entry
+    table_refs: List[Any] = field(default_factory=list)
+
+    def still_valid(self) -> bool:
+        for ref in self.table_refs:
+            if ref() is None:
+                return False
+        for path, snap in self.file_deps.items():
+            if _stat_snapshot(path) != snap:
+                return False
+        return True
+
+    def depends_on_path(self, written: str) -> bool:
+        """Whether a write landing at ``written`` (file or directory)
+        can touch any of this key's file deps."""
+        w = os.path.abspath(written)
+        for path in self.file_deps:
+            p = os.path.abspath(path)
+            if p == w or p.startswith(w + os.sep) \
+                    or w.startswith(p + os.sep):
+                return True
+        return False
+
+
+def _stat_snapshot(path: str) -> Optional[tuple]:
+    try:
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+
+
+def plan_content_key(phys, conf=None,
+                     extra: tuple = ()) -> Optional[ContentKey]:
+    """Content key for a PHYSICAL (sub)tree, or None when the plan is not
+    safely cacheable.  ``extra`` folds caller context into the digest
+    (e.g. encode params for batch-level caches, conf digests)."""
+    parts: List[str] = []
+    file_deps: Dict[str, tuple] = {}
+    table_refs: List[Any] = []
+
+    def walk(node, depth: int) -> bool:
+        name = node.node_name()
+        if any(t in name for t in _OPAQUE_NODE_TOKENS):
+            STATS["declined_opaque"] += 1
+            return False
+        s = _node_content(node)
+        low = s.lower()
+        if any(t in low for t in _NONDETERMINISTIC_TOKENS):
+            STATS["declined_nondeterministic"] += 1
+            return False
+        parts.append(f"{depth}:{s}")
+        if not node.children:
+            if not _leaf_identity(node, parts, file_deps, table_refs):
+                return False
+        return all(walk(c, depth + 1) for c in node.children)
+
+    if not walk(phys, 0):
+        return None
+    for x in extra:
+        parts.append(f"extra:{x!r}")
+    digest = hashlib.sha1("|".join(parts).encode()).hexdigest()
+    return ContentKey(digest, file_deps, table_refs)
+
+
+def _node_content(node) -> str:
+    """A node's CONTENT string: ``simple_string()`` plus the full
+    rendering of any ABSORBED sub-execs whose literals the display
+    string drops — a whole-stage node prints its members' node names
+    only ('Filter -> Project -> HashAggregate'), so two stages fusing
+    filters with different thresholds would otherwise collide, and the
+    result cache would serve one threshold's rows for the other."""
+    s = node.simple_string()
+    members = getattr(node, "members", None)
+    if members:  # FusedStageExec absorbed pre-steps
+        s += "{" + "|".join(m.simple_string() for m in members) + "}"
+    steps = getattr(node, "_probe_steps", None)
+    if steps:  # hash join absorbed probe-side chain
+        s += "{" + "|".join(m.simple_string() for m in steps) + "}"
+    cond = getattr(node, "condition", None)
+    if cond is not None and hasattr(cond, "sql") and \
+            cond.sql() not in s:
+        s += f"{{cond:{cond.sql()}}}"
+    return s
+
+
+def _leaf_identity(node, parts: List[str], file_deps: Dict[str, tuple],
+                   table_refs: List[Any]) -> bool:
+    """Append a leaf's input identity; False declines the whole plan."""
+    from ..io_.exec import FileScanExec
+    from ..sql.physical.basic import InMemoryScanExec, RangeExec
+    if isinstance(node, RangeExec):
+        parts.append(f"range:{node.start}:{node.end}:{node.step}:"
+                     f"{node.num_slices}")
+        return True
+    if isinstance(node, InMemoryScanExec):
+        for t in node._parts:
+            try:
+                table_refs.append(weakref.ref(t))
+            except TypeError:
+                STATS["declined_unknown_leaf"] += 1
+                return False
+            parts.append(f"mem:{id(t)}:{t.num_rows}:{t.nbytes}")
+        return True
+    if isinstance(node, FileScanExec):
+        parts.append(f"scan:{node.node.fmt}:"
+                     f"{sorted(map(str, node.node.options.items()))}")
+        for path in node.files:
+            snap = _stat_snapshot(path)
+            if snap is None:
+                STATS["declined_stat"] += 1
+                return False
+            file_deps[path] = snap
+            parts.append(f"file:{path}")
+        return True
+    # exchanges/broadcasts never appear as leaves; anything else
+    # (hand-built exec, future source) is declined conservatively
+    STATS["declined_unknown_leaf"] += 1
+    return False
+
+
+def conf_digest(conf) -> tuple:
+    """The result-affecting session confs folded into result-cache keys.
+    Deliberately small: layout/perf knobs (batch sizes, parallelism,
+    fusion, encoding) change HOW a result is computed, never its Arrow
+    value — the bit-parity suites are the proof."""
+    from ..config import ANSI_ENABLED, SESSION_TIMEZONE
+    return (bool(conf.get(ANSI_ENABLED)),
+            str(conf.get(SESSION_TIMEZONE, "") or ""),
+            str(conf.get("spark.sql.caseSensitive", "") or ""))

@@ -1,0 +1,168 @@
+"""Cross-query result cache — plan-content fingerprint → cached Arrow
+table (docs/serving.md sharing tier 3).
+
+A collect whose physical plan produces the same :class:`ContentKey`
+digest as a cached entry returns the cached ``pa.Table`` without
+executing — the serving tier's short-circuit for repeated queries
+("millions of users" traffic repeats the same dashboards, not novel
+SQL).  Arrow tables are immutable, so the cached object is returned
+directly; no copy, no re-upload.
+
+Invalidation contract (docs/serving.md):
+
+* **stat drift** — every hit re-checks each input file's
+  ``(mtime_ns, size)`` snapshot and every in-memory table weakref; any
+  drift or dead ref drops the entry and misses.
+* **engine writes** — every write through ``io_/writers.py`` calls
+  :func:`note_write`; entries whose file deps intersect the written path
+  (either direction of prefix: writing a directory invalidates files
+  under it, writing a file invalidates a scan of its directory) are
+  dropped, as are listeners' (the shared broadcast cache registers its
+  own invalidator here so one write sweeps both tiers).
+* **bounded bytes** — LRU eviction past ``maxBytes``
+  (``spark.rapids.tpu.serving.resultCache.maxBytes``).
+
+The cache is process-scoped and thread-safe; hits/misses/stores are
+observable in ``STATS`` and (when the registry is on) the
+``result_cache_{hits,misses}_total`` counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..observability import metrics as _om
+from .fingerprint import ContentKey, conf_digest, plan_content_key
+
+STATS = {"hits": 0, "misses": 0, "stores": 0, "evictions": 0,
+         "invalidations": 0, "declined": 0}
+
+_LOCK = threading.Lock()
+#: digest -> (ContentKey, pa.Table, nbytes); ordered for LRU
+_ENTRIES: "OrderedDict[str, Tuple[ContentKey, Any, int]]" = OrderedDict()
+_TOTAL_BYTES = [0]
+_MAX_BYTES = [256 << 20]
+
+#: write-invalidation listeners (the broadcast cache registers here so
+#: io_/writers.py only needs ONE hook for every sharing tier)
+_WRITE_LISTENERS: List[Callable[[str], None]] = []
+
+
+def set_max_bytes(n: int) -> None:
+    with _LOCK:
+        _MAX_BYTES[0] = max(0, int(n))
+        _evict_locked()
+
+
+def _evict_locked() -> None:
+    while _ENTRIES and _TOTAL_BYTES[0] > _MAX_BYTES[0]:
+        _d, (_k, _t, nbytes) = _ENTRIES.popitem(last=False)
+        _TOTAL_BYTES[0] -= nbytes
+        STATS["evictions"] += 1
+
+
+def key_for(phys, conf) -> Optional[ContentKey]:
+    """Content key for a collect over ``phys`` under ``conf`` (None =
+    uncacheable plan)."""
+    key = plan_content_key(phys, conf, extra=conf_digest(conf))
+    if key is None:
+        STATS["declined"] += 1
+    return key
+
+
+def lookup_logical(logical, conf) -> Tuple[Optional[ContentKey], Any]:
+    """Plan ``logical`` and consult the cache: (key, table|None).  A
+    ``(None, None)`` return means the plan is uncacheable (planning
+    failed or content declined) — the caller executes normally and
+    stores nothing."""
+    try:
+        from ..sql.planner import Planner
+        phys = Planner(conf).plan_for_collect(logical)
+    except Exception:
+        STATS["declined"] += 1
+        return None, None
+    key = key_for(phys, conf)
+    if key is None:
+        return None, None
+    return key, lookup(key)
+
+
+def lookup(key: ContentKey):
+    """The cached table for ``key`` (validity re-checked), or None."""
+    with _LOCK:
+        ent = _ENTRIES.get(key.digest)
+        if ent is None:
+            STATS["misses"] += 1
+            _om.inc("result_cache_misses_total")
+            return None
+        stored_key, table, nbytes = ent
+    # stat re-check outside the lock (it's I/O)
+    if not stored_key.still_valid():
+        with _LOCK:
+            if _ENTRIES.get(key.digest) is ent:
+                del _ENTRIES[key.digest]
+                _TOTAL_BYTES[0] -= nbytes
+                STATS["invalidations"] += 1
+        STATS["misses"] += 1
+        _om.inc("result_cache_misses_total")
+        return None
+    with _LOCK:
+        if key.digest in _ENTRIES:
+            _ENTRIES.move_to_end(key.digest)
+        STATS["hits"] += 1
+        _om.inc("result_cache_hits_total")
+    return table
+
+
+def store(key: ContentKey, table) -> None:
+    """Cache ``table`` under ``key`` (skipped when it alone exceeds the
+    byte bound)."""
+    nbytes = int(getattr(table, "nbytes", 0))
+    with _LOCK:
+        if nbytes > _MAX_BYTES[0]:
+            return
+        old = _ENTRIES.pop(key.digest, None)
+        if old is not None:
+            _TOTAL_BYTES[0] -= old[2]
+        _ENTRIES[key.digest] = (key, table, nbytes)
+        _TOTAL_BYTES[0] += nbytes
+        STATS["stores"] += 1
+        _evict_locked()
+
+
+def note_write(path: str) -> None:
+    """A write landed at ``path`` through io_/writers.py: drop every
+    entry (here and in registered listeners) whose inputs it can touch."""
+    with _LOCK:
+        doomed = [d for d, (k, _t, _n) in _ENTRIES.items()
+                  if k.depends_on_path(path)]
+        for d in doomed:
+            _k, _t, nbytes = _ENTRIES.pop(d)
+            _TOTAL_BYTES[0] -= nbytes
+            STATS["invalidations"] += 1
+        listeners = list(_WRITE_LISTENERS)
+    for fn in listeners:
+        try:
+            fn(path)
+        except Exception:
+            pass  # invalidation fan-out must never fail the write
+
+
+def register_write_listener(fn: Callable[[str], None]) -> None:
+    with _LOCK:
+        if fn not in _WRITE_LISTENERS:
+            _WRITE_LISTENERS.append(fn)
+
+
+def clear() -> None:
+    with _LOCK:
+        _ENTRIES.clear()
+        _TOTAL_BYTES[0] = 0
+
+
+def stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(STATS, entries=len(_ENTRIES),
+                    bytes=_TOTAL_BYTES[0], max_bytes=_MAX_BYTES[0])

@@ -14,6 +14,7 @@ Partial/PartialMerge modes.
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -144,6 +145,9 @@ def group_phase(xp, key_cols: Sequence[DeviceColumn], row_mask,
 #: reduce into one program sized to it (bounded: keys embed literals, so
 #: reuse the kernel cache's eviction philosophy at small scale)
 _OUT_SPECULATION: dict = {}
+#: guards the speculation dict against concurrent sessions (and a clear
+#: racing a record — same contract as join._SEL_LOCK, docs/serving.md)
+_SPEC_LOCK = threading.Lock()
 
 
 def record_speculation(spec_key, ng_host: int, minimum: int) -> None:
@@ -152,11 +156,23 @@ def record_speculation(spec_key, ng_host: int, minimum: int) -> None:
     size a large batch needs, which would make every later large batch
     mis-speculate and execute twice, forever)."""
     from ...columnar.column import bucket_capacity
-    prev = _OUT_SPECULATION.get(spec_key, 0)
-    if len(_OUT_SPECULATION) > 1024:
-        _OUT_SPECULATION.clear()  # unbounded keys embed literals
-    _OUT_SPECULATION[spec_key] = max(
-        prev, bucket_capacity(max(int(ng_host), 1), minimum=minimum))
+    with _SPEC_LOCK:
+        prev = _OUT_SPECULATION.get(spec_key, 0)
+        if len(_OUT_SPECULATION) > 1024:
+            _OUT_SPECULATION.clear()  # unbounded keys embed literals
+        _OUT_SPECULATION[spec_key] = max(
+            prev, bucket_capacity(max(int(ng_host), 1), minimum=minimum))
+
+
+def lookup_speculation(spec_key):
+    with _SPEC_LOCK:
+        return _OUT_SPECULATION.get(spec_key)
+
+
+def clear_speculation() -> None:
+    """Called by kernel_cache.clear_cache (after its generation bump)."""
+    with _SPEC_LOCK:
+        _OUT_SPECULATION.clear()
 
 #: largest group table served by the one-hot matmul reduction (the
 #: [rows, OUT] one-hot must stay cheap even if XLA doesn't fuse it away)
@@ -683,7 +699,7 @@ class HashAggregateExec(PhysicalPlan):
         if len(live) != 1:
             return None
         batch = live[0]
-        spec = _OUT_SPECULATION.get(self._spec_key)
+        spec = lookup_speculation(self._spec_key)
         if spec is None or spec > batch.capacity:
             return None
         fused = self._fused_complete_fns.get(spec)
@@ -719,7 +735,7 @@ class HashAggregateExec(PhysicalPlan):
             return self._get_partial_fn()(batch)
         from ...columnar.column import bucket_capacity
         spec_key = self._spec_key
-        spec = _OUT_SPECULATION.get(spec_key)
+        spec = lookup_speculation(spec_key)
         if spec is not None and spec <= batch.capacity:
             fused = self._fused_fns.get(spec)
             if fused is None:
@@ -739,10 +755,11 @@ class HashAggregateExec(PhysicalPlan):
         # max-join: a small tail batch must not clobber the spec a large
         # batch needs (that would make every later large batch
         # mis-speculate and execute twice, forever)
-        prev = _OUT_SPECULATION.get(spec_key, 0)
-        if len(_OUT_SPECULATION) > 1024:
-            _OUT_SPECULATION.clear()  # unbounded keys embed literals
-        _OUT_SPECULATION[spec_key] = max(prev, out_size)
+        with _SPEC_LOCK:
+            prev = _OUT_SPECULATION.get(spec_key, 0)
+            if len(_OUT_SPECULATION) > 1024:
+                _OUT_SPECULATION.clear()  # unbounded keys embed literals
+            _OUT_SPECULATION[spec_key] = max(prev, out_size)
         out = self._reduce_fn(out_size)(batch2, mask, rank64, ng)
         # output row count == observed group count (ng already folds in the
         # one-row floor for global aggregates), known on the host — seed it

@@ -41,6 +41,21 @@ def compact_batch(xp, batch: ColumnarBatch, keep) -> ColumnarBatch:
 
 
 _UPLOAD_CACHE: dict = {}
+#: guards the cache maps under concurrent sessions (the serving tier runs
+#: N driver threads against this one process-scoped cache); uploads
+#: themselves run OUTSIDE the lock, with per-entry events so two sessions
+#: scanning the same relation share one decode+upload instead of racing
+#: two and dropping one (a lost entry would double HBM residency)
+import threading as _threading
+_UPLOAD_LOCK = _threading.Lock()
+
+
+class _PendingUpload:
+    __slots__ = ("event", "error")
+
+    def __init__(self):
+        self.event = _threading.Event()
+        self.error = None
 
 
 def _cached_upload(table, backend: str, conf=None) -> list:
@@ -49,17 +64,13 @@ def _cached_upload(table, backend: str, conf=None) -> list:
     engine-side analog of Spark's InMemoryRelation staying cached — and the
     TPU-idiomatic move: keep hot data in HBM instead of re-uploading).
     Ragged string tables split into width classes first (one long string
-    must not make every row pay its padded width)."""
+    must not make every row pay its padded width).  Thread-safe: the
+    entry keyed by (table identity, backend, split/encode params) is
+    claimed under a lock and built outside it; concurrent scanners of the
+    same relation wait on the builder instead of uploading twice."""
     import weakref
     from ...config import RAGGED_STRING_SPLIT_BYTES, RapidsConf
     from ...columnar.convert import arrow_to_device, split_for_upload
-    key = id(table)
-    ent = _UPLOAD_CACHE.get(key)
-    if ent is None or ent[0]() is not table:
-        ref = weakref.ref(table, lambda _r, k=key: _UPLOAD_CACHE.pop(k, None))
-        ent = (ref, {})
-        _UPLOAD_CACHE[key] = ent
-    per_backend = ent[1]
     # the split decision depends on the threshold conf — key it in, so
     # changing raggedSplitBytes takes effect on already-scanned relations
     thr = int((conf or RapidsConf.get_global())
@@ -68,19 +79,52 @@ def _cached_upload(table, backend: str, conf=None) -> list:
     # representation — key it in, so flipping the encoded kill switch
     # takes effect on already-scanned relations
     from ...columnar.encoded import encode_params
+    key = id(table)
     ck = (backend, thr, encode_params(conf))
-    if ck not in per_backend:
+    with _UPLOAD_LOCK:
+        ent = _UPLOAD_CACHE.get(key)
+        if ent is None or ent[0]() is not table:
+            ref = weakref.ref(
+                table, lambda _r, k=key: _UPLOAD_CACHE.pop(k, None))
+            ent = (ref, {})
+            _UPLOAD_CACHE[key] = ent
+        per_backend = ent[1]
+        got = per_backend.get(ck)
+        if got is None:
+            got = per_backend[ck] = _PendingUpload()
+            builder = True
+        else:
+            builder = False
+    if isinstance(got, _PendingUpload):
+        if not builder:
+            got.event.wait()
+            if got.error is not None:
+                raise got.error
+            with _UPLOAD_LOCK:
+                return per_backend[ck]
+        try:
+            batches = [
+                _to_backend_batch(arrow_to_device(p, conf=conf), backend)
+                for p in split_for_upload(table, conf)]
+        except BaseException as e:
+            # failed build must not wedge waiters or poison the entry
+            with _UPLOAD_LOCK:
+                if per_backend.get(ck) is got:
+                    del per_backend[ck]
+            got.error = e
+            got.event.set()
+            raise
         from ...memory import retention as _ret
-        batches = [
-            _to_backend_batch(arrow_to_device(p, conf=conf), backend)
-            for p in split_for_upload(table, conf)]
         # resident batches are served to EVERY rescan of this relation:
         # pin them so a downstream fused stage never donates their
         # buffers (memory/retention.py donation-safety contract)
         for b in batches:
             _ret.pin_batch(b)
-        per_backend[ck] = batches
-    return per_backend[ck]
+        with _UPLOAD_LOCK:
+            per_backend[ck] = batches
+        got.event.set()
+        return batches
+    return got
 
 
 class InMemoryScanExec(PhysicalPlan):

@@ -27,7 +27,7 @@ from typing import List, Optional
 import numpy as np
 
 from ...columnar.batch import ColumnarBatch
-from .aggregate import (_OUT_SPECULATION, HashAggregateExec,
+from .aggregate import (HashAggregateExec, lookup_speculation,
                         record_speculation)
 from .base import CPU, PhysicalPlan
 from .sortlimit import SortExec
@@ -225,7 +225,7 @@ class FusedCollectExec(PhysicalPlan):
         src = self.children[0].execute(pid, tctx)
         first = next(src, None)
         second = next(src, None) if first is not None else None
-        spec = None if is_final else _OUT_SPECULATION.get(agg._spec_key)
+        spec = None if is_final else lookup_speculation(agg._spec_key)
         single = (first is not None and second is None
                   and first.num_rows_bound > 0)
         fusable = single and (is_final

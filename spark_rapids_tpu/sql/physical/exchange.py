@@ -431,6 +431,27 @@ class BroadcastExchangeExec(PhysicalPlan):
 
     def _broadcast_batch_locked(self, tctx: TaskContext) -> ColumnarBatch:
         if self._cached is None:
+            # cross-query broadcast sharing (docs/serving.md): key the
+            # child subtree by content and serve a process-cached batch —
+            # the same dimension table broadcast by N queries/sessions
+            # uploads and build-prepares once.  The shared batch stays
+            # pinned by the cache, so donation safety is unchanged.
+            from ...config import SERVING_BROADCAST_SHARE
+            share_key = None
+            if bool(tctx.conf.get(SERVING_BROADCAST_SHARE)):
+                from ...serving import broadcast_cache as _bc
+                share_key = _bc.content_key(self.children[0], tctx.conf)
+                if share_key is not None:
+                    got = _bc.lookup(share_key)
+                    if got is not None:
+                        # this exec takes its OWN pin (below) so a cache
+                        # eviction can never unpin a batch a live plan
+                        # still serves; the artifact dict already exists
+                        # from the original build
+                        self._cached = got
+                        from ...memory import retention as _ret
+                        _ret.pin_batch(self._cached)
+                        return self._cached
             batches = []
             with _trace.span("shuffle", "broadcast.materialize"):
                 for cpid in range(self.children[0].num_partitions()):
@@ -443,6 +464,10 @@ class BroadcastExchangeExec(PhysicalPlan):
             else:
                 self._cached = (ColumnarBatch.concat(batches)
                                 if len(batches) > 1 else batches[0])
+            if share_key is not None:
+                from ...serving import broadcast_cache as _bc
+                _bc.store(share_key, self._cached,
+                          int(self.children[0].estimate_bytes() or 0))
             # seed the artifact cache eagerly: a concat result could be a
             # pass-through of a child batch that already carries artifacts
             # from an unrelated join over different keys — the per-key

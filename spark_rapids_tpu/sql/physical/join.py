@@ -49,16 +49,45 @@ STATS = {"chunked_joins": 0, "build_sorts": 0, "fastpath_probes": 0,
 #: count readback (aggregate.py _OUT_SPECULATION analog; cleared with the
 #: kernel cache)
 _JOIN_SELECTIVITY: Dict[tuple, float] = {}
+#: guards the selectivity dict against concurrent sessions — a plain-dict
+#: read-modify-write racing a clear could resurrect state for a dead
+#: kernel-cache generation (docs/serving.md clearing contract)
+_SEL_LOCK = threading.Lock()
 
 
-def record_selectivity(spec_key, sel: float) -> None:
+def record_selectivity(spec_key, sel: float,
+                       generation: Optional[int] = None) -> None:
     """Record observed selectivity, max-joined: a low-match tail batch
     must not shrink the prediction a dense batch needs (which would make
-    every later dense batch mis-speculate and gather twice, forever)."""
-    if len(_JOIN_SELECTIVITY) > 1024:
-        _JOIN_SELECTIVITY.clear()  # keys embed literals (kernel-cache rule)
-    prev = _JOIN_SELECTIVITY.get(spec_key, 0.0)
-    _JOIN_SELECTIVITY[spec_key] = max(prev, sel)
+    every later dense batch mis-speculate and gather twice, forever).
+
+    ``generation`` is the kernel-cache generation the caller captured
+    when it LOOKED UP the prediction; if the cache was cleared in
+    between, the write is dropped — a concurrent clearKernelCache must
+    never be repopulated with learning from the dead generation."""
+    from .kernel_cache import cache_generation
+    with _SEL_LOCK:
+        if generation is not None and generation != cache_generation():
+            STATS["stale_selectivity_drops"] = \
+                STATS.get("stale_selectivity_drops", 0) + 1
+            return
+        if len(_JOIN_SELECTIVITY) > 1024:
+            # keys embed literals (kernel-cache rule)
+            _JOIN_SELECTIVITY.clear()
+        prev = _JOIN_SELECTIVITY.get(spec_key, 0.0)
+        _JOIN_SELECTIVITY[spec_key] = max(prev, sel)
+
+
+def lookup_selectivity(spec_key) -> Optional[float]:
+    with _SEL_LOCK:
+        return _JOIN_SELECTIVITY.get(spec_key)
+
+
+def clear_selectivity() -> None:
+    """Called by kernel_cache.clear_cache AFTER the generation bump —
+    the bump-then-clear order is what makes racing recorders drop."""
+    with _SEL_LOCK:
+        _JOIN_SELECTIVITY.clear()
 
 
 class BaseJoinExec(PhysicalPlan):
@@ -582,7 +611,13 @@ class BaseJoinExec(PhysicalPlan):
             return None
         how = self._norm_how
         n_probe = probe.num_rows_bound
-        sel = _JOIN_SELECTIVITY.get(self._sig)
+        # capture the cache generation WITH the prediction: if a
+        # concurrent clearKernelCache lands before this batch's observed
+        # selectivity records, the record is dropped instead of seeding
+        # the fresh generation with learning from dead programs
+        from .kernel_cache import cache_generation
+        self._sel_generation = cache_generation()
+        sel = lookup_selectivity(self._sig)
         if sel is None:
             sel = float(tctx.conf.get(JOIN_INITIAL_SELECTIVITY))
         pred = int(sel * max(n_probe, 1)) + 1
@@ -592,7 +627,9 @@ class BaseJoinExec(PhysicalPlan):
 
     def _record_selectivity(self, probe: ColumnarBatch, total: int) -> None:
         record_selectivity(self._sig,
-                           total / max(probe.num_rows_bound, 1))
+                           total / max(probe.num_rows_bound, 1),
+                           generation=getattr(self, "_sel_generation",
+                                              None))
 
     def _cached_kernel(self, tag: str, chunk_cap: int, make_impl):
         """Get-or-build the jitted windowed kernel for (tag, chunk_cap) —

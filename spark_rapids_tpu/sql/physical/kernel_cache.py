@@ -37,6 +37,17 @@ _LOCK = threading.Lock()
 _STATS = {"hits": 0, "misses": 0, "evictions": 0,
           "compiles": 0, "compile_ms": 0.0, "dispatches": 0}
 
+#: cache GENERATION, bumped under ``_LOCK`` by every :func:`clear_cache`.
+#: The concurrent-sessions clearing contract (docs/serving.md): a clear
+#: while another session executes never breaks in-flight work — handed-out
+#: ``_TrackedKernel`` wrappers keep their jitted callables (the dict only
+#: drops ITS references) — and learned state derived from a dead
+#: generation's programs (join selectivities, aggregate group-size
+#: speculations) is dropped instead of written back: learners capture the
+#: generation when they first consult the cache and the recorders refuse
+#: the write when it no longer matches.
+_GENERATION = [0]
+
 #: per-key trace+compile accounting (observability report: "compile ms
 #: per key"); keyed by the human-readable kernel label
 _COMPILE_BY_KEY: Dict[str, Dict[str, float]] = {}
@@ -186,6 +197,15 @@ def cache_stats() -> Dict[str, int]:
         return dict(_STATS, size=len(_CACHE))
 
 
+def cache_generation() -> int:
+    """Current cache generation (bumped by every clear) — learners of
+    cache-coupled state (join selectivities, agg size speculations)
+    capture this at lookup time and pass it back at record time so a
+    concurrent clear drops, rather than resurrects, their learning."""
+    with _LOCK:
+        return _GENERATION[0]
+
+
 def compile_stats_by_key() -> Dict[str, Dict[str, float]]:
     """Per-kernel-key trace+compile accounting (label -> compiles, ms);
     only accrues while tracing is on."""
@@ -194,7 +214,15 @@ def compile_stats_by_key() -> Dict[str, Dict[str, float]]:
 
 
 def clear_cache() -> None:
+    """Drop every cached program and the learned state coupled to them.
+
+    Safe under concurrent sessions: the generation bumps BEFORE the
+    learned-state dicts clear, so a query mid-flight that learned against
+    the old programs fails its generation check at record time instead of
+    repopulating a dead generation's state; its already-handed-out kernel
+    wrappers keep working (they own their jitted callables)."""
     with _LOCK:
+        _GENERATION[0] += 1
         _CACHE.clear()
         _COMPILE_BY_KEY.clear()
         _STATS["hits"] = 0
@@ -206,12 +234,12 @@ def clear_cache() -> None:
     # stale group-size speculations point at programs just dropped; a
     # speculated miss would recompile a size that may immediately
     # mis-speculate
-    from .aggregate import _OUT_SPECULATION
-    _OUT_SPECULATION.clear()
+    from .aggregate import clear_speculation
+    clear_speculation()
     # same rule for learned join selectivities: a stale prediction would
     # recompile gather programs for sizes that immediately mis-speculate
-    from .join import _JOIN_SELECTIVITY
-    _JOIN_SELECTIVITY.clear()
+    from .join import clear_selectivity
+    clear_selectivity()
 
 
 def release_compiled_programs() -> None:

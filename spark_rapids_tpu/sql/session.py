@@ -82,6 +82,14 @@ class TpuSession:
         self.session_id = (f"sess-{_os.getpid()}-"
                            f"{next(TpuSession._session_seq)}")
         self._history = None  # lazily built from conf on first record
+        #: tenant identity (spark.rapids.tpu.serving.tenant): stamped on
+        #: metric series, trace spans and flight-recorder records; the
+        #: serving tier's admission queue schedules and budgets by it
+        from ..config import SERVING_TENANT
+        self.tenant = str(self._conf.get(SERVING_TENANT) or "")
+        #: owning ServingEngine when this session runs in serving mode
+        #: (set by ServingEngine.session); None = classic single-driver
+        self._serving = None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -100,7 +108,13 @@ class TpuSession:
         if partitions is not None:
             parts = list(partitions)
         else:
-            parts = _split_table(table, num_partitions) \
+            # split through the process-wide dedupe cache: repeated
+            # create_dataframe calls over the SAME table object yield the
+            # same partition slice objects, so the scan upload cache (and
+            # the serving tier's content-keyed result/broadcast caches,
+            # which key in-memory leaves by table identity) hit across
+            # queries and sessions instead of re-uploading per query
+            parts = _split_table_cached(table, num_partitions) \
                 if num_partitions > 1 else None
         rel = P.Relation(table, parts)
         return DataFrame(rel, self)
@@ -155,11 +169,16 @@ class TpuSession:
     # execution
     # ------------------------------------------------------------------
     def _execute(self, logical: P.LogicalPlan) -> pa.Table:
+        if self._serving is not None:
+            # serving mode: no per-query global flag flips (the engine
+            # armed them for its lifetime), admission-gated execution,
+            # thread-scoped tenant attribution — see _execute_serving
+            return self._execute_serving(logical)
         import time as _time
         from ..columnar.convert import device_to_arrow
         from ..config import (METRICS_ENABLED, METRICS_MAX_SERIES,
-                              PROFILE_ENABLED, TRACE_BUFFER_EVENTS,
-                              TRACE_SINK)
+                              PROFILE_ENABLED, SERVING_RESULT_CACHE_ENABLED,
+                              TRACE_BUFFER_EVENTS, TRACE_SINK)
         from ..observability import metrics as OM
         from ..observability import tracer as OT
         from ..robustness import faults as _faults
@@ -167,6 +186,15 @@ class TpuSession:
         from .physical import speculation
         from .physical.base import PROFILING
         from .physical.kernel_cache import cache_stats
+        # cross-query result cache (docs/serving.md): a content-key hit
+        # short-circuits the whole query — no flag arming, no execution
+        rc_key = None
+        if bool(self._conf.get(SERVING_RESULT_CACHE_ENABLED)):
+            from ..serving import result_cache as RC
+            rc_key, hit = RC.lookup_logical(logical, self._conf)
+            if hit is not None:
+                self._note_result_cache_hit(hit)
+                return hit
         # arm/disarm the seeded chaos registry from this session's conf
         # for the duration of THIS query, restore-on-exit like the
         # tracing flags below (a disabled conf only undoes a conf-driven
@@ -197,8 +225,11 @@ class TpuSession:
         if metrics_on:
             reg = OM.get_registry()
             reg.max_series = int(self._conf.get(METRICS_MAX_SERIES))
-            reg.set_default_labels(query=self._query_seq,
-                                   session=self.session_id)
+            labels = {"query": self._query_seq,
+                      "session": self.session_id}
+            if self.tenant:
+                labels["tenant"] = self.tenant
+            reg.set_default_labels(**labels)
         OM.METRICS["on"] = metrics_on
         cache_stats0 = cache_stats()
         ok = False
@@ -208,6 +239,9 @@ class TpuSession:
             out = self._execute_traced(logical, device_to_arrow,
                                        speculation)
             ok = True
+            if rc_key is not None:
+                from ..serving import result_cache as RC
+                RC.store(rc_key, out)
             return out
         except BaseException as e:
             err = e
@@ -221,6 +255,101 @@ class TpuSession:
             self._finish_trace(tracing, sink, cache_stats0, rob0, ok,
                                aux0=aux0, duration_s=duration_s, err=err,
                                metrics_on=metrics_on)
+
+    def _execute_serving(self, logical: P.LogicalPlan) -> pa.Table:
+        """Serving-mode execution (docs/serving.md): result-cache
+        short-circuit, admission slot (weighted-fair + tenant budget),
+        thread-scoped tenant/session attribution on metrics and trace
+        spans, shared flight-recorder record — and NO per-query global
+        flag churn: tracing/profiling/metrics/chaos were armed once by
+        the owning ServingEngine, because N driver threads saving and
+        restoring process flags would race each other.
+
+        Per-query kernel-cache deltas are deliberately absent here
+        (concurrent queries would smear each other's compiles); use the
+        engine-scoped registry/cache_stats views instead."""
+        import time as _time
+        from ..columnar.convert import device_to_arrow
+        from ..observability import metrics as OM
+        from ..observability import tracer as OT
+        from .physical import speculation
+        eng = self._serving
+        tenant = self.tenant or "default"
+        rc_key = None
+        if eng.result_cache_enabled:
+            # hits bypass admission entirely: a cached result consumes
+            # no slot, no budget, no device time
+            from ..serving import result_cache as RC
+            rc_key, hit = RC.lookup_logical(logical, self._conf)
+            if hit is not None:
+                self._note_result_cache_hit(hit)
+                return hit
+        from ..serving.admission import estimate_query_bytes
+        est = estimate_query_bytes(logical)
+        t_sub = _time.perf_counter()
+        ticket = eng.admission.acquire(tenant, est)
+        wait_s = _time.perf_counter() - t_sub
+        if OT.TRACING["on"] and wait_s > 1e-6:
+            OT.get_tracer().complete("admission", f"admit.{tenant}",
+                                     t_sub, wait_s, tenant=tenant,
+                                     est_bytes=est)
+        self._query_seq = getattr(self, "_query_seq", 0) + 1
+        OT.set_thread_context(tenant=tenant, sid=self.session_id)
+        if OM.METRICS["on"]:
+            OM.get_registry().set_thread_labels(
+                tenant=tenant, session=self.session_id,
+                query=self._query_seq)
+        ok = False
+        err: Optional[BaseException] = None
+        t0 = _time.perf_counter()
+        try:
+            out = self._execute_traced(logical, device_to_arrow,
+                                       speculation)
+            ok = True
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            duration_s = _time.perf_counter() - t0
+            OT.clear_thread_context()
+            OM.get_registry().clear_thread_labels()
+            eng.admission.release(ticket)
+            self.last_query_trace_summary = None  # engine-scoped trace
+            if ok:
+                m = self.last_query_metrics
+                m["sessionId"] = self.session_id
+                m["tenant"] = tenant
+                m["admissionWaitMs"] = round(wait_s * 1e3, 3)
+                m["admissionEstBytes"] = est
+            self._record_history(ok, duration_s, err)
+            status = "ok" if ok else "failed"
+            OM.observe("query_ms", duration_s * 1e3, status=status,
+                       tenant=tenant, session=self.session_id)
+            OM.inc("queries_total", status=status, tenant=tenant)
+            OM.observe("admission_wait_ms", wait_s * 1e3, tenant=tenant)
+        if rc_key is not None:
+            from ..serving import result_cache as RC
+            RC.store(rc_key, out)
+        return out
+
+    def _note_result_cache_hit(self, table) -> None:
+        """Epilogue for a result served from the cross-query cache: the
+        query still leaves metrics + a flight-recorder record (hit
+        visibility is the contract CI asserts), just no execution."""
+        from ..observability import metrics as OM
+        self._query_seq = getattr(self, "_query_seq", 0) + 1
+        tenant = self.tenant or ""
+        self.last_query_metrics = {
+            "resultCacheHit": 1, "sessionId": self.session_id,
+            "numOutputRows": int(getattr(table, "num_rows", 0)),
+        }
+        if tenant:
+            self.last_query_metrics["tenant"] = tenant
+        self.last_query_trace_summary = None
+        self._last_phys = None
+        self._record_history(True, 0.0, None)
+        OM.inc("result_cache_served_total",
+               **({"tenant": tenant} if tenant else {}))
 
     def _finish_trace(self, tracing: bool, sink: str, cache_stats0: dict,
                       rob0: dict, ok: bool, aux0: Optional[dict] = None,
@@ -239,6 +368,8 @@ class TpuSession:
         if ok:  # on failure last_query_metrics is still the prior query's
             m = self.last_query_metrics
             m["sessionId"] = self.session_id
+            if self.tenant:
+                m["tenant"] = self.tenant
             for src, dst in (("hits", "kernelCacheHits"),
                              ("misses", "kernelCacheMisses"),
                              ("compiles", "kernelCompiles"),
@@ -310,7 +441,10 @@ class TpuSession:
         try:
             from ..observability import history as OH
             if self._history is None:
-                self._history = OH.QueryHistory(
+                # shared per path: concurrent sessions configured with
+                # one JSONL ring serialize their appends through a
+                # single process-wide instance (docs/serving.md)
+                self._history = OH.shared_history(
                     int(self._conf.get(HISTORY_MAX_QUERIES)),
                     str(self._conf.get(HISTORY_PATH) or ""))
             self._history.record(OH.build_record(
@@ -319,7 +453,8 @@ class TpuSession:
                 phys=getattr(self, "_last_phys", None) if ok else None,
                 metrics=self.last_query_metrics if ok else None,
                 trace_summary=self.last_query_trace_summary,
-                error=f"{type(err).__name__}: {err}" if err else None))
+                error=f"{type(err).__name__}: {err}" if err else None,
+                tenant=self.tenant))
         except Exception:
             pass
 
@@ -424,10 +559,12 @@ class TpuSession:
     def query_history(self, n: Optional[int] = None) -> List[dict]:
         """Flight-recorder records for this session's queries, oldest
         first (``spark.rapids.tpu.history.enabled``); ``n`` bounds the
-        result to the newest n."""
+        result to the newest n.  The ring may be SHARED (on-disk path /
+        serving engine) — filtering by this session's id keeps the view
+        per-session either way."""
         if self._history is None:
             return []
-        return self._history.tail(n)
+        return self._history.tail(n, session=self.session_id)
 
     def metrics_snapshot(self) -> dict:
         """JSON snapshot of the process-wide metrics registry (series
@@ -623,6 +760,29 @@ def _split_table(table: pa.Table, n: int) -> List[pa.Table]:
         hi = min(lo + per, rows)
         parts.append(table.slice(lo, hi - lo))
     return parts
+
+
+#: (id(table) -> (weakref(table), {n: [slices]})) — slice identity dedupe
+#: (see create_dataframe).  Entries die with their table; slices are
+#: zero-copy views, so retaining them costs metadata only.
+_SPLIT_CACHE: dict = {}
+_SPLIT_LOCK = threading.Lock()
+
+
+def _split_table_cached(table: pa.Table, n: int) -> List[pa.Table]:
+    import weakref
+    key = id(table)
+    with _SPLIT_LOCK:
+        ent = _SPLIT_CACHE.get(key)
+        if ent is None or ent[0]() is not table:
+            ref = weakref.ref(
+                table, lambda _r, k=key: _SPLIT_CACHE.pop(k, None))
+            ent = (ref, {})
+            _SPLIT_CACHE[key] = ent
+        parts = ent[1].get(n)
+        if parts is None:
+            parts = ent[1][n] = _split_table(table, n)
+        return parts
 
 
 class Catalog:

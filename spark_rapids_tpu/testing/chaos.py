@@ -306,6 +306,130 @@ def run_soak(rows: int = 20_000, seed: int = 11,
         TpuSession._active = prev_active
 
 
+def run_multi_session_soak(rows: int = 12_000, seed: int = 11,
+                           sites: str = DEFAULT_SITES,
+                           tenants: int = 2,
+                           queries: Optional[List[str]] = None,
+                           trace_path: Optional[str] = None) -> dict:
+    """Multi-tenant chaos soak (docs/serving.md): ``tenants`` serving
+    sessions run the TPC-H-ish suite CONCURRENTLY through one
+    ServingEngine while the seeded fault registry is armed engine-scoped
+    — every tenant's results must be bit-identical to the serial clean
+    run.  This is the serving tier's correctness floor: admission
+    interleaving, shared caches (kernel/broadcast/upload), and fault
+    recovery on N driver threads at once must not perturb a single bit.
+
+    The per-site coverage floor stays with the serial soak (fault
+    ordinals shift under thread interleaving, like the --pipeline leg);
+    here the asserts are bit-parity, fault visibility, per-tenant
+    history attribution, and admission accounting for every tenant."""
+    import threading
+
+    import spark_rapids_tpu as srt  # noqa: F401 - engine init path
+    from ..config import RapidsConf
+    from ..memory.spill import BufferCatalog
+    from ..robustness import disarm_chaos, stats_snapshot
+    from ..serving import ServingEngine
+    from ..sql import functions as F
+    from ..sql.session import TpuSession
+    tables = _soak_tables(rows)
+    tmp = tempfile.mkdtemp(prefix="srt-mtchaos-")
+    selected = [(n, fn) for n, fn in QUERIES
+                if queries is None or n in queries]
+    prev_active = TpuSession._active
+    BufferCatalog.reset(RapidsConf({
+        "spark.rapids.memory.host.spillStorageSize": 1,
+        "spark.rapids.memory.spillDir": tmp,
+    }))
+    eng = None
+    try:
+        clean_sess = srt.session(conf=RapidsConf.get_global().copy(
+            _base_conf(tmp)))
+        clean: Dict[str, pd.DataFrame] = {}
+        for name, fn in selected:
+            clean[name] = _canonical(fn(clean_sess, tables, F))
+
+        eng_conf = dict(_base_conf(tmp))
+        eng_conf.update({
+            "spark.rapids.tpu.chaos.enabled": True,
+            "spark.rapids.tpu.chaos.seed": seed,
+            "spark.rapids.tpu.chaos.sites": sites,
+            "spark.rapids.tpu.shuffle.fetch.backoffMs": 1,
+            "spark.rapids.tpu.serving.maxConcurrentQueries": max(
+                2, tenants),
+            # result sharing OFF: every tenant must EXECUTE every query
+            # under faults — a cache hit would prove nothing
+            "spark.rapids.tpu.serving.resultCache.enabled": False,
+            "spark.rapids.tpu.serving.broadcastShare.enabled": True,
+        })
+        if trace_path:
+            eng_conf["spark.rapids.tpu.profile.enabled"] = True
+        rob0 = stats_snapshot()
+        eng = ServingEngine(conf=RapidsConf.get_global().copy(eng_conf))
+        results: Dict[str, Dict[str, pd.DataFrame]] = {}
+        errors: Dict[str, BaseException] = {}
+
+        def run_tenant(tname: str) -> None:
+            try:
+                sess = eng.session(tenant=tname)
+                got = {}
+                for name, fn in selected:
+                    got[name] = _canonical(fn(sess, tables, F))
+                results[tname] = got
+            except BaseException as e:  # noqa: BLE001 - reported below
+                errors[tname] = e
+
+        threads = [threading.Thread(target=run_tenant,
+                                    args=(f"tenant{i}",),
+                                    name=f"srt-tenant{i}")
+                   for i in range(tenants)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, f"tenant queries raised: {errors}"
+        rob1 = stats_snapshot()
+        faults = rob1["faultsInjected"] - rob0["faultsInjected"]
+        mismatches = []
+        for tname, got in sorted(results.items()):
+            for name, frame in got.items():
+                try:
+                    pd.testing.assert_frame_equal(frame, clean[name],
+                                                  check_exact=True)
+                except AssertionError as e:
+                    mismatches.append(f"{tname}/{name}: {e}")
+        if trace_path:
+            eng.export_chrome_trace(trace_path)
+        adm = eng.admission_stats()
+        hist = eng.query_history()
+        per_tenant_hist = {t: len(eng.query_history(tenant=t))
+                           for t in sorted(results)}
+        report = {
+            "rows": rows, "seed": seed, "sites": sites,
+            "tenants": tenants, "faults_injected": faults,
+            "queries_per_tenant": len(selected),
+            "bit_identical": not mismatches,
+            "admission": adm,
+            "history_records": len(hist),
+            "history_per_tenant": per_tenant_hist,
+        }
+        assert not mismatches, \
+            "multi-session chaos run diverged from the clean run:\n" + \
+            "\n".join(mismatches)
+        assert faults > 0, report
+        # every tenant's queries must be attributed in the shared ring
+        for t, n in per_tenant_hist.items():
+            assert n == len(selected), (t, n, report)
+        assert adm["admitted"] == tenants * len(selected), report
+        return report
+    finally:
+        if eng is not None:
+            eng.close()
+        disarm_chaos()
+        BufferCatalog.reset()
+        TpuSession._active = prev_active
+
+
 def main() -> None:
     import os
 
@@ -322,6 +446,14 @@ def main() -> None:
     pipeline = False
     encoded = False
     whole_stage = False
+    multi_session = False
+    if "--multi-session" in argv:
+        # multi-tenant soak: >=2 serving sessions run the suite
+        # concurrently through one ServingEngine under engine-scoped
+        # chaos; every tenant bit-identical to the serial clean run
+        # (ISSUE 9 acceptance — docs/serving.md)
+        multi_session = True
+        argv.remove("--multi-session")
     if "--whole-stage" in argv:
         # whole-stage soak: chaos session with fusion + donation forced
         # on vs a fully UNFUSED serial clean baseline (ISSUE 7
@@ -351,6 +483,14 @@ def main() -> None:
         seed = int(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
     rows = int(argv[0]) if argv else 20_000
+    if multi_session:
+        report = run_multi_session_soak(rows, seed=seed,
+                                        trace_path=trace_path)
+        print(json.dumps(report, indent=2))
+        print(f"CHAOS SOAK PASSED: {report['tenants']} concurrent "
+              f"tenants bit-identical under "
+              f"{report['faults_injected']} injected faults")
+        return
     report = run_soak(rows, seed=seed, trace_path=trace_path,
                       strict=not pipeline, pipeline=pipeline,
                       encoded=encoded, whole_stage=whole_stage)

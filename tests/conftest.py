@@ -63,6 +63,12 @@ QUICK_MODULES = {
     # bottlenecks, and the bench_diff evidence gate are tier-1 — wrong
     # attribution silently misdirects every perf decision downstream
     "test_metrics_registry", "test_doctor",
+    # multi-tenant serving (ISSUE 9): weighted-fair admission, tenant
+    # budgets, the cross-query result/broadcast sharing tiers and the
+    # generation-safe kernel-cache clear are tier-1 — a sharing bug is
+    # silent cross-tenant data corruption, an admission bug is silent
+    # starvation
+    "test_serving",
 }
 
 

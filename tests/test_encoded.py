@@ -171,7 +171,11 @@ def test_filter_fast_path_engages(tables):
     _rows(_q_filter(sess, fact, dim))
     m = sess.last_query_metrics
     assert m.get("encodedDictFilters", 0) >= 1, m
-    assert m.get("encodedColumnsEncoded", 0) >= 1, m
+    # NOTE: encodedColumnsEncoded counts NEW encodes at upload; since
+    # the serving tier made the upload/split caches process-shared
+    # (docs/serving.md), a table another test already scanned encoded
+    # serves its resident dict batches with zero fresh encodes — the
+    # dict-filter engagement above is the proof encoded columns flowed
 
 
 def test_filter_null_semantics_parity(tables):
@@ -359,8 +363,16 @@ def test_killswitch_reverts_every_path(tables):
     assert m.get("joinCodeLowerings", 0) in (0.0, 0, None), m
     assert m.get("encodedWireDictInline", 0) == 0, m
     # and the scan upload cache keys on the switch: flipping it ON in a
-    # fresh session over the SAME tables re-encodes
+    # fresh session over the SAME tables serves ENCODED batches (the
+    # dict filter fast path engages), never the raw entries the OFF
+    # session just cached.  The upload cache is process-shared across
+    # sessions (docs/serving.md), so the encode itself may have happened
+    # in an earlier test over these module-scoped tables — assert the
+    # representation served, not a fresh-encode counter delta.
+    from spark_rapids_tpu.sql.physical.kernel_cache import (
+        release_compiled_programs)
+    release_compiled_programs()  # dict_filters counts trace-time hits
     sess_on = _sess(True, **{
         "spark.rapids.sql.autoBroadcastJoinThreshold": 1})
     _rows(_q_filter(sess_on, fact, dim))
-    assert sess_on.last_query_metrics.get("encodedColumnsEncoded", 0) >= 1
+    assert sess_on.last_query_metrics.get("encodedDictFilters", 0) >= 1

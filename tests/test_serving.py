@@ -1,0 +1,511 @@
+"""Multi-tenant query serving (ISSUE 9, docs/serving.md): weighted-fair
+admission + per-tenant budgets, cross-query sharing tiers (result cache,
+shared broadcasts, generation-safe kernel-cache clearing), per-tenant
+observability (metrics labels, trace spans, shared history, doctor), and
+the multi-session chaos soak — tier-1 because an admission or sharing
+bug is either silent cross-tenant data corruption or silent starvation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.serving import (AdmissionController, AdmissionTimeout,
+                                      ServingEngine, estimate_query_bytes)
+from spark_rapids_tpu.serving import broadcast_cache as BC
+from spark_rapids_tpu.serving import result_cache as RC
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql import plan as P
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _clean_shared_tiers():
+    def reset():
+        RC.clear()
+        BC.clear()
+        for d in (RC.STATS, BC.STATS):
+            for k in d:
+                d[k] = 0
+    reset()
+    yield
+    reset()
+
+
+def _drain(ctrl, tenants, order):
+    """Enqueue one blocked waiter per (tenant, i), then release the
+    blocker and let grants run one at a time; returns the grant order."""
+    blocker = ctrl.acquire("blocker")
+    threads = []
+
+    def worker(tenant):
+        t = ctrl.acquire(tenant)
+        order.append(tenant)
+        ctrl.release(t)
+
+    for tenant in tenants:
+        th = threading.Thread(target=worker, args=(tenant,))
+        th.start()
+        threads.append(th)
+    deadline = time.time() + 10
+    while ctrl.snapshot()["queued"] < len(tenants):
+        assert time.time() < deadline, "waiters failed to enqueue"
+        time.sleep(0.005)
+    ctrl.release(blocker)
+    for th in threads:
+        th.join(20)
+        assert not th.is_alive()
+
+
+# --------------------------------------------------------------------------
+# admission control
+# --------------------------------------------------------------------------
+
+def test_wfq_weighted_light_tenant_first():
+    # light weight 4x heavy: light vfts (0.25, 0.5) < heavy's (1..8), so
+    # both light queries admit before ANY heavy one regardless of
+    # enqueue interleaving
+    ctrl = AdmissionController(max_concurrent=1, weights={"light": 4.0})
+    order = []
+    _drain(ctrl, ["heavy"] * 8 + ["light"] * 2, order)
+    assert order[:2] == ["light", "light"], order
+    assert len(order) == 10
+
+
+def test_wfq_equal_weights_interleave():
+    # equal weights: a flood of 8 heavy requests cannot push the 2 light
+    # ones to the back — vfts interleave 1:1, so both light queries are
+    # admitted within the first ~2*k grants (bounded p99 admission wait,
+    # the no-starvation contract)
+    ctrl = AdmissionController(max_concurrent=1)
+    order = []
+    _drain(ctrl, ["heavy"] * 8 + ["light"] * 2, order)
+    positions = [i for i, t in enumerate(order) if t == "light"]
+    assert positions[0] <= 2 and positions[1] <= 4, order
+    snap = ctrl.snapshot()
+    assert snap["admitted"] == 11  # blocker + 10
+    assert snap["per_tenant"]["light"]["wait_ms_p99"] >= 0.0
+
+
+def test_admission_memory_budget_blocks_and_releases():
+    ctrl = AdmissionController(max_concurrent=4,
+                               budgets={"a": 100})
+    t1 = ctrl.acquire("a", est_bytes=60)
+    got = {}
+
+    def second():
+        got["t"] = ctrl.acquire("a", est_bytes=60)
+
+    th = threading.Thread(target=second)
+    th.start()
+    th.join(0.3)
+    assert th.is_alive(), "second query admitted over budget"
+    # another tenant is not blocked by a's budget stall
+    tb = ctrl.acquire("b", est_bytes=60)
+    ctrl.release(tb)
+    ctrl.release(t1)
+    th.join(10)
+    assert not th.is_alive()
+    ctrl.release(got["t"])
+
+
+def test_admission_budget_lone_oversized_query_admits():
+    ctrl = AdmissionController(max_concurrent=2, budgets={"a": 100})
+    t = ctrl.acquire("a", est_bytes=500)  # over budget, nothing in flight
+    ctrl.release(t)
+
+
+def test_admission_timeout_raises():
+    ctrl = AdmissionController(max_concurrent=1)
+    t = ctrl.acquire("x")
+    with pytest.raises(AdmissionTimeout):
+        ctrl.acquire("y", timeout_ms=60)
+    ctrl.release(t)
+    snap = ctrl.snapshot()
+    assert snap["timeouts"] == 1 and snap["queued"] == 0
+
+
+def test_estimate_query_bytes_counts_inputs(tmp_path):
+    table = pa.table({"a": np.arange(1000), "b": np.arange(1000.0)})
+    rel = P.Relation(table, None)
+    assert estimate_query_bytes(rel) == table.nbytes
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(table, path)
+    scan = P.ScanRelation("parquet", (path,), None, {})
+    assert estimate_query_bytes(scan) == os.path.getsize(path)
+
+
+# --------------------------------------------------------------------------
+# the serving engine end to end
+# --------------------------------------------------------------------------
+
+def _mk_tables(n=8_000, seed=7):
+    rng = np.random.default_rng(seed)
+    fact = pa.table({"fk": rng.integers(0, 50, n), "x": rng.random(n),
+                     "q": rng.integers(0, 100, n)})
+    dim = pa.table({"pk": np.arange(50, dtype=np.int64),
+                    "cat": rng.integers(0, 8, 50)})
+    return fact, dim
+
+
+def _join_q(sess, fact_t, dim_t, thresh=50):
+    fact = sess.create_dataframe(fact_t, num_partitions=2)
+    dim = sess.create_dataframe(dim_t)
+    return (fact.filter(F.col("q") < thresh)
+            .join(dim, fact.fk == dim.pk, "inner").groupBy("cat")
+            .agg(F.count("*").alias("n"), F.sum(F.col("x")).alias("sx"))
+            .orderBy("cat")).collect()
+
+
+def test_engine_concurrent_tenants_end_to_end(tmp_path):
+    fact_t, dim_t = _mk_tables()
+    eng = ServingEngine(**{
+        "spark.rapids.tpu.metrics.enabled": True,
+        "spark.rapids.tpu.profile.enabled": True,
+        "spark.rapids.tpu.serving.resultCache.enabled": True,
+        "spark.rapids.tpu.serving.broadcastShare.enabled": True,
+        "spark.rapids.tpu.serving.maxConcurrentQueries": 2,
+    })
+    try:
+        results, hists = {}, {}
+
+        def worker(tenant):
+            s = eng.session(tenant=tenant)
+            results[tenant] = [_join_q(s, fact_t, dim_t),
+                               _join_q(s, fact_t, dim_t)]
+            hists[tenant] = s.query_history()
+            results[tenant + "_metrics"] = dict(s.last_query_metrics)
+
+        threads = [threading.Thread(target=worker, args=(f"t{i}",))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert results["t0"][0].equals(results["t1"][0])
+        assert results["t0"][0].equals(results["t0"][1])
+        # repeats hit the result tier (either tenant may have seeded it)
+        assert RC.stats()["hits"] >= 2
+        # per-session history views are disjoint and tenant-stamped
+        assert len(hists["t0"]) == 2 and len(hists["t1"]) == 2
+        assert {r["tenant"] for r in hists["t0"]} == {"t0"}
+        fleet = eng.query_history()
+        assert len(fleet) == 4
+        assert {r["tenant"] for r in fleet} == {"t0", "t1"}
+        # admission accounting covers executed queries (cache hits
+        # bypass admission by design)
+        adm = eng.admission_stats()
+        assert adm["admitted"] >= 2
+        # per-tenant metric labels reached the registry
+        prom = eng.metrics_prometheus()
+        assert 'tenant="t0"' in prom and 'tenant="t1"' in prom
+        assert "result_cache_served_total" in prom
+        # engine-scoped trace carries tenant-stamped spans
+        path = str(tmp_path / "trace.json")
+        eng.export_chrome_trace(path)
+        evs = json.load(open(path))["traceEvents"]
+        assert any(e.get("args", {}).get("tenant") for e in evs)
+        # per-tenant doctor verdicts exist for both tenants
+        diag = eng.diagnose_tenants()
+        assert set(diag) == {"t0", "t1"}
+        for rep in diag.values():
+            assert rep["queries"] == 2
+            assert rep["diagnosis"]["verdict"]
+    finally:
+        eng.close()
+    # engine close restored the process flags
+    from spark_rapids_tpu.observability.metrics import METRICS
+    from spark_rapids_tpu.observability.tracer import TRACING
+    assert not METRICS["on"] and not TRACING["on"]
+
+
+def test_engine_close_restores_chaos_arming():
+    from spark_rapids_tpu.robustness.faults import CHAOS, snapshot_arming
+    prev = snapshot_arming()
+    eng = ServingEngine(**{
+        "spark.rapids.tpu.chaos.enabled": True,
+        "spark.rapids.tpu.chaos.seed": 3,
+        "spark.rapids.tpu.chaos.sites": "shuffle.fetch:0.5",
+    })
+    assert CHAOS["on"], "engine conf must arm chaos engine-scoped"
+    eng.close()
+    assert snapshot_arming()[0] == prev[0]
+    from spark_rapids_tpu.robustness import disarm_chaos
+    disarm_chaos()
+
+
+# --------------------------------------------------------------------------
+# result cache
+# --------------------------------------------------------------------------
+
+def _rc_session(**extra):
+    conf = {"spark.rapids.tpu.serving.resultCache.enabled": True}
+    conf.update(extra)
+    return TpuSession(RapidsConf.get_global().copy(conf))
+
+
+def test_result_cache_hits_in_memory_inputs():
+    fact_t, dim_t = _mk_tables()
+    sess = _rc_session()
+    r1 = _join_q(sess, fact_t, dim_t)
+    assert RC.stats()["stores"] == 1
+    r2 = _join_q(sess, fact_t, dim_t)
+    assert r1.equals(r2)
+    assert RC.stats()["hits"] == 1
+    assert sess.last_query_metrics.get("resultCacheHit") == 1
+    # the hit still left a flight-recorder record
+    hist = sess.query_history()
+    assert len(hist) == 2
+    # different literal = different entry, not a false hit
+    r3 = _join_q(sess, fact_t, dim_t, thresh=30)
+    assert not r3.equals(r1)
+    assert RC.stats()["hits"] == 1 and RC.stats()["stores"] == 2
+
+
+def test_result_cache_file_stat_invalidation(tmp_path):
+    path = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"a": [1, 2, 3]}), path)
+    sess = _rc_session()
+    q = lambda: sess.read.parquet(path).groupBy().agg(  # noqa: E731
+        F.sum(F.col("a")).alias("s")).collect()
+    assert q().to_pylist() == [{"s": 6}]
+    assert q().to_pylist() == [{"s": 6}]
+    assert RC.stats()["hits"] == 1
+    pq.write_table(pa.table({"a": [10, 20, 30, 40]}), path)
+    assert q().to_pylist() == [{"s": 100}], \
+        "stale cached result served after the input file changed"
+    assert RC.stats()["invalidations"] >= 1
+
+
+def test_result_cache_write_through_writers_invalidates(tmp_path):
+    src = str(tmp_path / "src")
+    sess = _rc_session()
+    base = sess.create_dataframe(pa.table({"a": [1, 2, 3]}))
+    base.write.parquet(src)
+    q = lambda: sess.read.parquet(src).groupBy().agg(  # noqa: E731
+        F.sum(F.col("a")).alias("s")).collect()
+    assert q().to_pylist() == [{"s": 6}]
+    assert q().to_pylist() == [{"s": 6}]
+    assert RC.stats()["hits"] >= 1
+    inv0 = RC.stats()["invalidations"]
+    # an engine write over the scanned directory sweeps the entry
+    sess.create_dataframe(pa.table({"a": [5, 5]})) \
+        .write.mode("overwrite").parquet(src)
+    assert RC.stats()["invalidations"] > inv0
+    assert q().to_pylist() == [{"s": 10}]
+
+
+def test_result_cache_declines_nondeterministic():
+    sess = _rc_session()
+    df = sess.range(100).withColumn("r", F.rand(seed=None)) \
+        if hasattr(F, "rand") else None
+    if df is None:
+        pytest.skip("no rand()")
+    df.agg(F.sum(F.col("r")).alias("s")).collect()
+    assert RC.stats()["stores"] == 0, \
+        "non-deterministic plan must not be cached"
+
+
+def test_result_cache_lru_byte_bound():
+    RC.set_max_bytes(1)  # below any result's nbytes
+    sess = _rc_session()
+    sess.create_dataframe(pa.table({"a": [1, 2]})).groupBy().agg(
+        F.sum(F.col("a")).alias("s")).collect()
+    assert RC.stats()["entries"] == 0  # too big to store
+    RC.set_max_bytes(256 << 20)
+
+
+def test_result_cache_dead_table_never_hits():
+    sess = _rc_session()
+    t = pa.table({"a": list(range(100))})
+    sess.create_dataframe(t).groupBy().agg(
+        F.sum(F.col("a")).alias("s")).collect()
+    assert RC.stats()["stores"] == 1
+    del t  # input table dies; id() may be recycled by a new table
+    t2 = pa.table({"a": [9, 9, 9]})
+    got = sess.create_dataframe(t2).groupBy().agg(
+        F.sum(F.col("a")).alias("s")).collect()
+    assert got.to_pylist() == [{"s": 27}]
+    assert RC.stats()["hits"] == 0
+
+
+# --------------------------------------------------------------------------
+# shared broadcast cache
+# --------------------------------------------------------------------------
+
+def test_broadcast_share_across_sessions():
+    fact_t, dim_t = _mk_tables()
+    conf = {"spark.rapids.tpu.serving.broadcastShare.enabled": True}
+    s1 = TpuSession(RapidsConf.get_global().copy(conf))
+    s2 = TpuSession(RapidsConf.get_global().copy(conf))
+    r1 = _join_q(s1, fact_t, dim_t)
+    assert BC.stats()["stores"] == 1
+    r2 = _join_q(s2, fact_t, dim_t, thresh=30)  # different query, same dim
+    assert BC.stats()["hits"] >= 1, BC.stats()
+    # parity against a share-disabled session
+    s3 = TpuSession(RapidsConf.get_global())
+    assert _join_q(s3, fact_t, dim_t).equals(r1)
+    assert _join_q(s3, fact_t, dim_t, thresh=30).equals(r2)
+
+
+def test_broadcast_share_entries_pinned():
+    from spark_rapids_tpu.memory import retention
+    fact_t, dim_t = _mk_tables()
+    conf = {"spark.rapids.tpu.serving.broadcastShare.enabled": True}
+    s1 = TpuSession(RapidsConf.get_global().copy(conf))
+    _join_q(s1, fact_t, dim_t)
+    ent = list(BC._ENTRIES.values())
+    assert ent and retention.is_pinned(ent[0][1])
+    BC.clear()
+    # the cache's own pin released on clear (plan pins may remain)
+    assert BC.stats()["entries"] == 0
+
+
+# --------------------------------------------------------------------------
+# kernel-cache clearing under concurrency (satellite 1)
+# --------------------------------------------------------------------------
+
+def test_clear_cache_bumps_generation_and_drops_stale_learning():
+    from spark_rapids_tpu.sql.physical import join as PJ
+    from spark_rapids_tpu.sql.physical.kernel_cache import (
+        cache_generation, clear_cache)
+    g0 = cache_generation()
+    PJ.record_selectivity(("k",), 1.5, generation=g0)
+    assert PJ.lookup_selectivity(("k",)) == 1.5
+    clear_cache()
+    assert cache_generation() == g0 + 1
+    assert PJ.lookup_selectivity(("k",)) is None
+    # a recorder that learned against the dead generation is dropped
+    PJ.record_selectivity(("k",), 2.5, generation=g0)
+    assert PJ.lookup_selectivity(("k",)) is None
+    assert PJ.STATS.get("stale_selectivity_drops", 0) >= 1
+    # a current-generation recorder lands
+    PJ.record_selectivity(("k",), 2.5, generation=g0 + 1)
+    assert PJ.lookup_selectivity(("k",)) == 2.5
+    clear_cache()
+
+
+def test_clear_cache_keeps_inflight_kernel_handles():
+    from spark_rapids_tpu.sql.physical.kernel_cache import (cached_jit,
+                                                            clear_cache)
+    fn = cached_jit(("test_serving_inflight", 1), lambda x: x + 1)
+    clear_cache()
+    # the handed-out wrapper still owns its program: in-flight execution
+    # survives a concurrent clear
+    assert int(fn(np.int64(41))) == 42
+    clear_cache()
+
+
+def test_concurrent_queries_with_concurrent_clears_bit_identical():
+    # hammer: 2 sessions run the same join repeatedly while a third
+    # thread clears the kernel cache — results must stay correct
+    fact_t, dim_t = _mk_tables(n=4_000)
+    ref = _join_q(TpuSession(RapidsConf.get_global()), fact_t, dim_t)
+    from spark_rapids_tpu.sql.physical.kernel_cache import clear_cache
+    stop = threading.Event()
+    errors = []
+
+    def clearer():
+        while not stop.is_set():
+            clear_cache()
+            time.sleep(0.002)
+
+    def runner():
+        try:
+            s = TpuSession(RapidsConf.get_global())
+            for _ in range(3):
+                got = _join_q(s, fact_t, dim_t)
+                assert got.equals(ref)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    cl = threading.Thread(target=clearer)
+    rs = [threading.Thread(target=runner) for _ in range(2)]
+    cl.start()
+    for t in rs:
+        t.start()
+    for t in rs:
+        t.join(120)
+    stop.set()
+    cl.join(10)
+    assert not errors, errors
+
+
+# --------------------------------------------------------------------------
+# shared query history (satellite 2)
+# --------------------------------------------------------------------------
+
+def test_history_jsonl_shared_and_filtered(tmp_path):
+    from spark_rapids_tpu.observability.history import read_history_file
+    path = str(tmp_path / "hist.jsonl")
+    fact_t, dim_t = _mk_tables(n=2_000)
+    conf = {"spark.rapids.tpu.history.path": path,
+            "spark.rapids.tpu.serving.tenant": "shared-t"}
+    sessions = [TpuSession(RapidsConf.get_global().copy(conf))
+                for _ in range(3)]
+    # concurrent sessions share ONE history instance (and append lock)
+    assert sessions[0]._history is None  # lazy until first record
+    threads = [threading.Thread(
+        target=lambda s=s: [_join_q(s, fact_t, dim_t) for _ in range(3)])
+        for s in sessions]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert sessions[0]._history is sessions[1]._history is \
+        sessions[2]._history
+    # no torn/interleaved lines: every line parses, all records present
+    recs = read_history_file(path)
+    raw_lines = [ln for ln in open(path) if ln.strip()]
+    assert len(raw_lines) == len(recs) == 9
+    assert all(r.get("tenant") == "shared-t" for r in recs)
+    # per-session filtering over the shared ring
+    for s in sessions:
+        mine = s.query_history()
+        assert len(mine) == 3
+        assert {r["session"] for r in mine} == {s.session_id}
+
+
+# --------------------------------------------------------------------------
+# per-tenant doctor
+# --------------------------------------------------------------------------
+
+def test_diagnose_tenants_ranks_admission_wait():
+    from spark_rapids_tpu.observability.doctor import diagnose_tenants
+    recs = [
+        {"tenant": "a", "status": "ok", "duration_ms": 10.0,
+         "metrics": {"admissionWaitMs": 500.0},
+         "trace_summary": {"sync_ms": 1.0, "sync_count": 1}},
+        {"tenant": "b", "status": "ok", "duration_ms": 50.0,
+         "metrics": {},
+         "trace_summary": {"sync_ms": 40.0, "sync_count": 4}},
+    ]
+    out = diagnose_tenants(recs)
+    assert out["a"]["diagnosis"]["verdict"] == "admission-bound"
+    assert out["b"]["diagnosis"]["verdict"] == "sync-bound"
+    assert out["a"]["admission_wait_ms"] == 500.0
+    assert out["a"]["p50_ms"] == 10.0
+
+
+# --------------------------------------------------------------------------
+# multi-session chaos soak (satellite 3, reduced tier-1 variant)
+# --------------------------------------------------------------------------
+
+def test_multi_session_chaos_soak_small():
+    from spark_rapids_tpu.testing.chaos import run_multi_session_soak
+    report = run_multi_session_soak(
+        rows=4_000, seed=11, tenants=2,
+        queries=["agg", "join_agg", "ooc_sort"])
+    assert report["bit_identical"]
+    assert report["faults_injected"] > 0
+    assert report["history_per_tenant"] == {"tenant0": 3, "tenant1": 3}
+    assert report["admission"]["admitted"] == 6

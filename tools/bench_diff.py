@@ -38,14 +38,20 @@ import json
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
-#: metric-name fragments whose value improves DOWNWARD
+#: metric-name fragments whose value improves DOWNWARD.  Serving-tier
+#: latency records (docs/serving.md) join here: p50/p99 latency and
+#: admission wait improve DOWN while qps improves UP (the default), so
+#: "QPS up is IMPROVED, p99 up is REGRESSED" falls out of the fragments.
 _LOWER_BETTER = ("sync_count", "sync_ms", "compile_ms", "compile_count",
                  "bytes_on_wire", "dispatches", "spill_ms", "sem_wait_ms",
                  "dropped_events", "h2d_bytes", "d2h_bytes", "seconds",
-                 "_us")
+                 "_us", "p50", "p95", "p99", "latency", "wait_ms",
+                 "wall_s")
 #: keys that are identifiers/context, never diffed
 _SKIP = ("rows", "chips", "queries", "probe_attempts", "budget_ms",
-         "elapsed_ms", "partial_banked_at", "pipeline_host_cores")
+         "elapsed_ms", "partial_banked_at", "pipeline_host_cores",
+         "workload_queries", "parallelism", "tenants",
+         "distinct_queries", "serving_rows")
 
 
 def load_artifact(path: str) -> Dict[str, Any]:

@@ -6,9 +6,15 @@ carry a non-negative dur.  Used by ci/run_ci.sh after the traced-query
 step and by tests/test_tracer.py.
 
 Usage: python tools/check_trace.py [<trace.json> ...] [--min-events N]
-           [--require-cat CAT] [--prometheus FILE] [--doctor FILE]
+           [--require-cat CAT] [--require-arg KEY]
+           [--prometheus FILE] [--prometheus-label KEY]
+           [--doctor FILE]
 ``--require-cat`` additionally fails unless at least one span event
 carries that category (e.g. ``fault`` for chaos-soak traces).
+``--require-arg`` fails unless at least one span event carries that
+args key (e.g. ``tenant`` for serving-engine traces).
+``--prometheus-label`` fails unless at least one Prometheus sample
+carries that label key (e.g. ``tenant`` for serving metrics).
 ``--prometheus`` validates a metrics-registry export against the
 Prometheus exposition contract (typed series, cumulative histogram
 buckets ending at +Inf, consistent _sum/_count).
@@ -28,10 +34,12 @@ KNOWN_PH = ("X", "C", "i", "M", "B", "E")
 #: CATEGORIES); unknown categories stay opaque — listed for reference
 #: and for --require-cat hints, not validated
 KNOWN_CATS = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
-              "shuffle", "sem_wait", "fault", "queue", "encode", "stage")
+              "shuffle", "sem_wait", "fault", "queue", "encode", "stage",
+              "admission")
 
 
-def check(path: str, min_events: int = 1, require_cat: str = ""):
+def check(path: str, min_events: int = 1, require_cat: str = "",
+          require_arg: str = ""):
     with open(path) as fh:
         doc = json.load(fh)
     events = doc["traceEvents"] if isinstance(doc, dict) else doc
@@ -39,6 +47,7 @@ def check(path: str, min_events: int = 1, require_cat: str = ""):
         raise ValueError("traceEvents is not a list")
     spans = 0
     cats = set()
+    arg_keys = set()
     for i, ev in enumerate(events):
         for field in REQUIRED:
             if field not in ev:
@@ -54,6 +63,8 @@ def check(path: str, min_events: int = 1, require_cat: str = ""):
                 raise ValueError(f"event {i} 'X' span needs dur >= 0: {ev}")
             spans += 1
             cats.add(ev.get("cat", ""))
+            for k in (ev.get("args") or {}):
+                arg_keys.add(k)
     if spans < min_events:
         raise ValueError(f"expected at least {min_events} span event(s), "
                          f"found {spans}")
@@ -61,16 +72,20 @@ def check(path: str, min_events: int = 1, require_cat: str = ""):
         raise ValueError(
             f"no span event with category {require_cat!r} "
             f"(found: {sorted(c for c in cats if c)})")
+    if require_arg and require_arg not in arg_keys:
+        raise ValueError(
+            f"no span event carrying args[{require_arg!r}] "
+            f"(found arg keys: {sorted(arg_keys)})")
     return spans, sorted(c for c in cats if c)
 
 
 #: the doctor's verdict taxonomy (observability/doctor.py VERDICTS)
 DOCTOR_VERDICTS = ("sync-bound", "compile-bound", "h2d-d2h-bound",
                    "dispatch-bound", "sem_wait-bound", "spill-bound",
-                   "shuffle-bound", "no-bottleneck")
+                   "shuffle-bound", "admission-bound", "no-bottleneck")
 
 
-def check_prometheus(path: str):
+def check_prometheus(path: str, require_label: str = ""):
     """Validate Prometheus exposition text: every sample belongs to a
     # TYPE-declared family; histogram buckets are cumulative and end at
     +Inf with a count matching _count."""
@@ -97,6 +112,9 @@ def check_prometheus(path: str):
             samples.append((m.group(1), m.group(2) or "", m.group(3)))
     if not samples:
         raise ValueError("no samples")
+    if require_label and not any(
+            f'{require_label}="' in labels for _n, labels, _v in samples):
+        raise ValueError(f"no sample carries label {require_label!r}")
     fams = set(types)
     buckets = {}
     for name, labels, value in samples:
@@ -161,6 +179,8 @@ def main(argv) -> int:
         return 1
     min_events = 1
     require_cat = ""
+    require_arg = ""
+    prom_label = ""
     prom_paths = []
     doctor_paths = []
     if "--min-events" in argv:
@@ -170,6 +190,14 @@ def main(argv) -> int:
     if "--require-cat" in argv:
         i = argv.index("--require-cat")
         require_cat = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    if "--require-arg" in argv:
+        i = argv.index("--require-arg")
+        require_arg = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    if "--prometheus-label" in argv:
+        i = argv.index("--prometheus-label")
+        prom_label = argv[i + 1]
         argv = argv[:i] + argv[i + 2:]
     while "--prometheus" in argv:
         i = argv.index("--prometheus")
@@ -182,7 +210,8 @@ def main(argv) -> int:
     rc = 0
     for path in argv:
         try:
-            spans, cats = check(path, min_events, require_cat)
+            spans, cats = check(path, min_events, require_cat,
+                                require_arg)
             print(f"OK {path}: {spans} span events, "
                   f"categories: {', '.join(cats) or '(none)'}")
         except (OSError, ValueError, KeyError) as e:
@@ -190,7 +219,7 @@ def main(argv) -> int:
             rc = 1
     for path in prom_paths:
         try:
-            n, fams = check_prometheus(path)
+            n, fams = check_prometheus(path, prom_label)
             print(f"OK {path}: {n} samples, {len(fams)} families")
         except (OSError, ValueError, KeyError) as e:
             print(f"FAIL {path}: {e}", file=sys.stderr)
