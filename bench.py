@@ -949,6 +949,135 @@ def _measure_serving(rows: int) -> dict:
     }}
 
 
+def _measure_lifecycle(rows: int) -> dict:
+    """Query lifecycle bench (ISSUE 10, docs/robustness.md): banks
+
+    * cancel-latency p50/p99 — cancel ISSUE to worker-threads-DRAINED,
+      measured by the session epilogue (`last_cancel_latency_ms`) over N
+      mid-flight cancels of a parallel join+agg query;
+    * deadline-enforcement accuracy — how far past its deadline a doomed
+      query actually runs before QueryDeadlineExceeded surfaces (poll
+      latency + the longest uninterruptible dispatch);
+    * QPS with pressure-aware degradation ON vs OFF under a saturating
+      serving workload (thresholds forced low so every admitted query
+      plans degraded), plus bit parity between the legs.
+    """
+    import pandas as pd
+    from concurrent.futures import ThreadPoolExecutor
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.serving import ServingEngine, lifecycle as lc
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.testing.scaletest import build_tables
+    tables = build_tables(rows)
+
+    def q(sess):
+        fact = sess.create_dataframe(tables["fact"], num_partitions=8)
+        dim = sess.create_dataframe(tables["dim"])
+        return (fact.join(dim, on="k", how="inner")
+                .groupBy("cat").agg(F.count("*").alias("n"),
+                                    F.sum(fact.v).alias("sv"))
+                .orderBy("cat").collect())
+
+    def pctl(seq, frac):
+        seq = sorted(seq)
+        return seq[min(len(seq) - 1, int(frac * len(seq)))]
+
+    # --- cancel latency: issue -> threads drained ----------------------
+    import spark_rapids_tpu as srt
+    sess = srt.session(**{"spark.rapids.tpu.task.parallelism": 4})
+    q(sess)  # warm compiles so latency measures the drain, not XLA
+    cancel_lat = []
+    for i in range(10):
+        timer = threading.Timer(0.02, sess.cancel)
+        timer.start()
+        try:
+            q(sess)
+        except lc.QueryCancelled:
+            if sess.last_cancel_latency_ms is not None:
+                cancel_lat.append(sess.last_cancel_latency_ms)
+        finally:
+            timer.cancel()
+
+    # --- deadline accuracy --------------------------------------------
+    deadline_ms = 25
+    doomed = srt.session(**{
+        "spark.rapids.tpu.task.parallelism": 4,
+        "spark.rapids.tpu.query.deadlineMs": deadline_ms})
+    overshoot = []
+    for i in range(6):
+        t0 = time.perf_counter()
+        try:
+            q(doomed)
+        except lc.QueryCancelled:
+            overshoot.append(
+                (time.perf_counter() - t0) * 1e3 - deadline_ms)
+
+    # --- pressure-aware degradation: QPS on vs off ---------------------
+    N_Q, PAR = 24, 8
+
+    def serving_leg(pressure_on: bool):
+        eng = ServingEngine(conf=RapidsConf.get_global().copy({
+            "spark.rapids.tpu.serving.maxConcurrentQueries": 2,
+            "spark.rapids.tpu.serving.pressure.enabled": pressure_on,
+            # saturate instantly: any queue at all reads as pressure
+            "spark.rapids.tpu.serving.pressure.queueDepth": 1,
+            "spark.rapids.sql.concurrentGpuTasks": 2,
+            "spark.rapids.tpu.task.parallelism": 4,
+        }))
+        sessions: dict = {}
+        results: list = [None] * N_Q
+        degraded = [0]
+
+        def run_one(i):
+            key = threading.get_ident()
+            s = sessions.get(key)
+            if s is None:
+                s = sessions[key] = eng.session(tenant=f"t{i % 2}")
+            results[i] = q(s)
+            if s.last_query_metrics.get("pressureDegraded"):
+                degraded[0] += 1
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=PAR) as pool:
+            list(pool.map(run_one, range(N_Q)))
+        wall = time.perf_counter() - t0
+        eng.close()
+        return {"qps": round(N_Q / wall, 3),
+                "degraded_queries": degraded[0]}, results
+
+    off, ref = serving_leg(False)
+    on, got = serving_leg(True)
+    parity = True
+    for a, b in zip(ref, got):
+        ca = a.to_pandas().sort_values(list(a.column_names),
+                                       kind="mergesort")
+        cb = b.to_pandas().sort_values(list(b.column_names),
+                                       kind="mergesort")
+        try:
+            pd.testing.assert_frame_equal(ca.reset_index(drop=True),
+                                          cb.reset_index(drop=True),
+                                          check_exact=True)
+        except AssertionError:
+            parity = False
+    return {"lifecycle": {
+        "lifecycle_rows": rows,
+        "cancel_latency_ms_p50": round(pctl(cancel_lat, 0.50), 3)
+        if cancel_lat else None,
+        "cancel_latency_ms_p99": round(pctl(cancel_lat, 0.99), 3)
+        if cancel_lat else None,
+        "cancels_measured": len(cancel_lat),
+        "deadline_ms": deadline_ms,
+        "deadline_overshoot_ms_p50": round(pctl(overshoot, 0.50), 3)
+        if overshoot else None,
+        "deadline_overshoot_ms_max": round(max(overshoot), 3)
+        if overshoot else None,
+        "pressure_off": off,
+        "pressure_on": on,
+        "pressure_parity": parity,
+        "pressure_qps_delta": round(
+            on["qps"] / max(off["qps"], 1e-9), 3),
+    }}
+
+
 def _device_responsive(timeout_s: float) -> bool:
     """Probe the ambient device backend from a daemon thread; a hung TPU
     tunnel must not take the whole child (and its exit) with it."""
@@ -1121,6 +1250,20 @@ def child_main(mode: str) -> None:
         _bank_partial()
     except BaseException as e:
         note = (note or "") + f"; serving shape failed: " \
+            f"{type(e).__name__}: {e}"
+    # query lifecycle (ISSUE 10 acceptance): cancel-latency p50/p99,
+    # deadline-enforcement accuracy, and the pressure-degradation QPS
+    # delta under saturation — its own phase so a wedged cancel (the
+    # exact regression this guards) cannot eat the shape loop's budget
+    try:
+        got = _run_phase("lifecycle",
+                         lambda: _measure_lifecycle(min(ROWS // 16,
+                                                        250_000)),
+                         _phase_budget(deadline, 0.30, 150.0))
+        _result.setdefault("extra_metrics", {}).update(got)
+        _bank_partial()
+    except BaseException as e:
+        note = (note or "") + f"; lifecycle shape failed: " \
             f"{type(e).__name__}: {e}"
     shapes = (
         ("join", lambda: _measure_join(join_rows)),

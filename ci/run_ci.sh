@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/15] native libraries ==="
+echo "=== [1/16] native libraries ==="
 make -C native
 
-echo "=== [2/15] API contract validation ==="
+echo "=== [2/16] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/15] docgen drift check ==="
+echo "=== [3/16] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/15] traced query + chrome-trace schema check ==="
+echo "=== [4/16] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,7 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/15] performance flight recorder: metrics + history + doctor + bench_diff ==="
+echo "=== [5/16] performance flight recorder: metrics + history + doctor + bench_diff ==="
 # ISSUE 8 acceptance: a traced query with the metrics registry and the
 # flight recorder enabled must produce (a) a Prometheus export that
 # passes the exposition-contract check, (b) a doctor diagnosis whose
@@ -112,7 +112,7 @@ if python tools/bench_diff.py "$SRT_FR_DIR/live.json" BENCH_r05.json \
     echo "ERROR: bench_diff failed to refuse live-vs-stale"; exit 1
 fi
 
-echo "=== [6/15] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [6/16] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -124,7 +124,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [7/15] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [7/16] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -138,7 +138,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [8/15] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+echo "=== [8/16] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
 # Encoded columnar execution (docs/encoded_columns.md) under seeded
 # faults AND the async pipeline matrix: the chaos session keeps
 # dictionary/RLE columns encoded through filters/joins/group-bys and
@@ -158,7 +158,7 @@ timeout 60 python tools/check_trace.py --require-cat encode \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     8000 --seed 11 --encoded
 
-echo "=== [9/15] whole-stage fusion: plan shape + donation chaos soak ==="
+echo "=== [9/16] whole-stage fusion: plan shape + donation chaos soak ==="
 # Whole-stage XLA compilation (docs/whole_stage.md): (a) the TPC-H-ish
 # suite's plans must contain fused whole-stage nodes — an aggregate
 # terminal (FusedStageExec wrapping the partial agg) and a probe-absorbed
@@ -215,7 +215,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat stage \
     "$SRT_WS_TRACE"
 
-echo "=== [10/15] multi-tenant serving: concurrent sessions smoke ==="
+echo "=== [10/16] multi-tenant serving: concurrent sessions smoke ==="
 # ISSUE 9 acceptance: N tenant sessions against one ServingEngine —
 # (a) weighted-fair admission: a heavy flood cannot starve a light
 # tenant (bounded wait, grant-order assertion at the controller);
@@ -308,7 +308,60 @@ timeout 60 python tools/check_trace.py --require-cat admission \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     10000 --seed 11 --multi-session
 
-echo "=== [11/15] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [11/16] query lifecycle: leak sentinel + cancel semantics ==="
+# ISSUE 10 acceptance: (a) the bounded leak sentinel — 2 tenants of
+# mixed traffic with cancel races, per-query deadlines and fatal
+# injection armed — must bank a CLEAN verdict (retention pins, catalog
+# handles and registry cardinality return to the healthy baseline after
+# the armed waves); (b) a deadline-cancelled traced query must export
+# `cancel`-category spans (the issue->drained evidence the bench
+# lifecycle phase banks as p50/p99) and leave zero held semaphore
+# permits or live query contexts.
+SRT_LC_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 600 python tools/leak_sentinel.py \
+    --seconds 45 --tenants 2 --rows 6000 --out "$SRT_LC_DIR/leak.json"
+JAX_PLATFORMS=cpu timeout 300 python - "$SRT_LC_DIR" <<'PYEOF'
+import sys, threading, time
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.serving import lifecycle as lc
+from spark_rapids_tpu.sql import functions as F
+out = sys.argv[1]
+sess = srt.session(**{"spark.rapids.tpu.profile.enabled": True,
+                      "spark.rapids.tpu.task.parallelism": 4})
+rng = np.random.default_rng(3)
+n = 200_000
+fact = sess.create_dataframe(pa.table(
+    {"fk": rng.integers(0, 1000, n), "x": rng.random(n)}),
+    num_partitions=8)
+dim = sess.create_dataframe(pa.table(
+    {"pk": np.arange(1000, dtype=np.int64),
+     "cat": rng.integers(0, 8, 1000)}))
+q = (fact.join(dim, fact.fk == dim.pk, "inner").groupBy("cat")
+     .agg(F.count("*").alias("n"), F.sum(F.col("x")).alias("sx"))
+     .orderBy("cat"))
+assert q.collect().num_rows == 8  # warm compiles
+timer = threading.Timer(0.02, sess.cancel)
+timer.start()
+try:
+    q.collect()
+    raise SystemExit("ERROR: cancel did not interrupt the query")
+except lc.QueryCancelled:
+    pass
+finally:
+    timer.cancel()
+assert sess.last_cancel_latency_ms is not None
+print(f"cancel drained in {sess.last_cancel_latency_ms:.1f}ms")
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+assert TpuSemaphore.get().active_tasks() == 0
+assert not lc.live_queries()
+sess.export_chrome_trace(out + "/cancel_trace.json")
+PYEOF
+timeout 60 python tools/check_trace.py --require-cat cancel \
+    "$SRT_LC_DIR/cancel_trace.json"
+
+echo "=== [12/16] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -329,14 +382,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [12/15] scale rig ==="
+    echo "=== [13/16] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [12/15] scale rig skipped (quick) ==="
+    echo "=== [13/16] scale rig skipped (quick) ==="
 fi
 
-echo "=== [13/15] packaging: wheel builds and installs ==="
+echo "=== [14/16] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -366,17 +419,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [14/15] driver entry checks ==="
+echo "=== [15/16] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [15/15] second-jax shim world skipped (quick) ==="
+    echo "=== [16/16] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [15/15] second-jax shim world (gated) ==="
+echo "=== [16/16] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

@@ -525,8 +525,10 @@ CHAOS_SITES = register(
     "to override the global probability (e.g. "
     "'shuffle.fetch:0.3,spill.disk_read').  Empty arms EVERY site — "
     "note sites without a built-in recovery protocol (transfer.h2d, "
-    "transfer.d2h, kernel.compile) then fail queries by design.  See "
-    "docs/robustness.md for the site catalog.", "", type_=str)
+    "transfer.d2h, kernel.compile, device.fatal, query.cancel.race) "
+    "then fail queries by design — with a TYPED error, never a wedged "
+    "thread.  See docs/robustness.md for the site catalog.", "",
+    type_=str)
 CHAOS_PROBABILITY = register(
     "spark.rapids.tpu.chaos.probability",
     "Default injection probability per armed-site traversal.", 0.05)
@@ -840,6 +842,67 @@ SERVING_BROADCAST_SHARE_MAX_BYTES = register(
     "spark.rapids.tpu.serving.broadcastShare.maxBytes",
     "Byte bound on the shared broadcast cache; LRU entries evict (and "
     "unpin) past it.", 256 << 20)
+
+# --- query lifecycle: cancellation, deadlines, degradation, quarantine ------
+QUERY_DEADLINE_MS = register(
+    "spark.rapids.tpu.query.deadlineMs",
+    "Per-query wall-clock deadline: a collect running past it raises "
+    "QueryDeadlineExceeded at the next lifecycle poll site (partition "
+    "scheduler, prefetch queues, transfer stager, shuffle fetch, "
+    "semaphore wait, spill I/O), releasing the semaphore, unpinning "
+    "retention and draining prefetch queues on the way out.  0 "
+    "(default) means no deadline.  Enforcement latency is bounded by "
+    "the 50ms poll interval plus the longest uninterruptible device "
+    "dispatch (serving/lifecycle.py).", 0, commonly_used=True)
+QUERY_CANCEL_POLL_SITES = register(
+    "spark.rapids.tpu.query.cancel.pollSites",
+    "Comma list restricting which chokepoints poll the query's "
+    "cancellation token (site catalog: admission, partition, sem_wait, "
+    "prefetch, stager, shuffle, exchange, spill — docs/robustness.md). "
+    "Empty (default) polls every site; a restricted list trades drain "
+    "latency for even less poll overhead.", "", type_=str)
+PRESSURE_ENABLED = register(
+    "spark.rapids.tpu.serving.pressure.enabled",
+    "Admission-aware graceful degradation (kill switch): when the "
+    "serving admission queue is under pressure (depth or recent-wait "
+    "thresholds below), newly-admitted queries plan with a shrunken "
+    "resource profile — reduced concurrentGpuTasks share, smaller "
+    "batch-rows target, speculative join sizing off — so a saturated "
+    "engine degrades throughput-per-query gracefully instead of piling "
+    "device working sets.  Off (default) plans every query identically "
+    "regardless of queue state.", False, commonly_used=True)
+PRESSURE_QUEUE_DEPTH = register(
+    "spark.rapids.tpu.serving.pressure.queueDepth",
+    "Admission queue depth at or above which the PressureSignal reports "
+    "pressure (serving/lifecycle.py).", 4)
+PRESSURE_WAIT_MS = register(
+    "spark.rapids.tpu.serving.pressure.waitMs",
+    "Recent admission-wait (rolling median across tenants) at or above "
+    "which the PressureSignal reports pressure; 0 disables the wait "
+    "signal (depth still applies).", 250.0)
+PRESSURE_SHARE = register(
+    "spark.rapids.tpu.serving.pressure.concurrentShare",
+    "Fraction of spark.rapids.sql.concurrentGpuTasks a degraded plan "
+    "keeps (floored at 1 task).", 0.5)
+PRESSURE_BATCH_ROWS = register(
+    "spark.rapids.tpu.serving.pressure.batchTargetRows",
+    "Batch-rows target cap applied to degraded plans (only ever "
+    "lowers spark.rapids.sql.batchSizeRows).", 1 << 18)
+QUARANTINE_TTL_MS = register(
+    "spark.rapids.tpu.serving.quarantine.ttlMs",
+    "How long a plan fingerprint whose execution produced a "
+    "FatalDeviceError stays quarantined (immediate retries raise "
+    "QueryQuarantined instead of re-killing the device); 0 disables "
+    "quarantine.", 60_000)
+QUARANTINE_MAX_ENTRIES = register(
+    "spark.rapids.tpu.serving.quarantine.maxEntries",
+    "Size bound on the quarantine registry; oldest entries evict past "
+    "it.", 128)
+DEGRADED_PROBE_INTERVAL_MS = register(
+    "spark.rapids.tpu.serving.degraded.probeIntervalMs",
+    "Minimum spacing between device probe attempts while the engine is "
+    "degraded after a fatal device error; admissions arriving between "
+    "probes are refused with EngineDegraded.", 1_000)
 
 # --- TPU-specific ----------------------------------------------------------
 BUCKET_MIN_ROWS = register(

@@ -56,6 +56,36 @@ def _diagnostics(exc: BaseException) -> str:
     lines = [f"fatal device error at {time.strftime('%Y-%m-%dT%H:%M:%S')}",
              "", "exception:",
              "".join(traceback.format_exception(exc)).rstrip(), ""]
+    # identity stamps: WHICH tenant/session/query hit the fatal — the
+    # quarantine protocol (serving/lifecycle.py) fails only that query,
+    # so the post-mortem must not have to guess whose plan it was
+    try:
+        from ..serving import lifecycle as _lc
+        from ..sql.physical.base import TaskContext
+        t = TaskContext.current()
+        q = _lc.current()
+        lines.append(
+            "query identity: "
+            f"tenant={((q.tenant if q else '') or (t.tenant if t else '')) or '(none)'} "
+            f"session={(q.session_id if q else '') or '(none)'} "
+            f"query={(q.query_id if q else 0) or '(none)'} "
+            f"partition={t.partition_id if t else '(none)'}")
+        if q is not None and q.cancelled:
+            lines.append(f"query was cancelled: {q.reason}")
+    except Exception:
+        pass
+    # the last bottleneck-doctor verdict recorded in this process: what
+    # the engine believed it was bound on right before the device died
+    try:
+        from ..observability import doctor as _doc
+        lv = getattr(_doc, "LAST_VERDICT", None)
+        if lv:
+            lines.append(
+                f"last doctor verdict: {lv.get('verdict')} "
+                f"(age {time.monotonic() - lv.get('at', 0.0):.1f}s)")
+    except Exception:
+        pass
+    lines.append("")
     try:
         import jax
         lines.append(f"jax {jax.__version__}, backend "

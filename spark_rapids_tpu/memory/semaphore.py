@@ -59,6 +59,14 @@ class TpuSemaphore:
 
     # --- acquire/release ---------------------------------------------------
     def acquire_if_necessary(self, task_id: int, tctx=None):
+        from ..serving import lifecycle as _lc
+        # lifecycle poll site `sem_wait`: polled BEFORE the first acquire
+        # attempt (so a cancel landing pre-wait is honored even when the
+        # permit is free) and between 50ms acquire polls while blocked —
+        # a cancelled task leaves the wait within one poll interval
+        # holding nothing; the raise below the _acquiring guard is safe
+        # (the finally clears the guard and notifies)
+        _lc.check_cancel("sem_wait")
         with self._lock:
             # wait out another thread of the SAME task that is mid-acquire,
             # so one task never takes two permits
@@ -71,7 +79,8 @@ class TpuSemaphore:
         t0 = time.perf_counter()
         acquired = False
         try:
-            self._sem.acquire()
+            while not self._sem.acquire(timeout=_lc.POLL_S):
+                _lc.check_cancel("sem_wait")
             acquired = True
         finally:
             waited = time.perf_counter() - t0

@@ -42,15 +42,19 @@ _DISK_IO_ATTEMPTS = 5
 
 
 def _retry_disk_io(fn, what: str):
+    from ..serving import lifecycle as _lc
     delay = 0.001
     for attempt in range(_DISK_IO_ATTEMPTS):
+        # lifecycle poll site `spill`: a cancelled query abandons its
+        # disk-tier I/O (and the retry backoff) instead of finishing a
+        # spill nobody will read
+        _lc.check_cancel("spill")
         try:
             return fn()
         except OSError:
             if attempt == _DISK_IO_ATTEMPTS - 1:
                 raise
-            import time as _time
-            _time.sleep(delay)
+            _lc.cancellable_sleep(delay, "spill")
             delay *= 2
 
 # spill order: lower value spills first (SpillPriorities.scala:83 semantics,
@@ -87,6 +91,7 @@ class _Buffer:
     was_device: bool = True                # False for host-backend batches
     seq: int = 0                           # tie-break: older spills first
     origin: str = ""                       # registration site (debug mode)
+    tenant: str = ""                       # registering task's tenant
 
 
 class BufferCatalog:
@@ -111,6 +116,11 @@ class BufferCatalog:
         self.unspill_count = 0
         from ..config import GPU_DEBUG
         self.debug = bool(conf.get(GPU_DEBUG))
+        #: tenant -> device-byte budget for tenant-aware spill ordering
+        #: (set by ServingEngine from the admission budgets; 0/absent =
+        #: unbudgeted).  Over-budget tenants' buffers spill FIRST.
+        self._tenant_budgets: Dict[str, int] = {}
+        self._tenant_default_budget = 0
 
     @classmethod
     def get(cls) -> "BufferCatalog":
@@ -188,6 +198,12 @@ class BufferCatalog:
                     origin = (f"{frame.filename}:{frame.lineno} "
                               f"{frame.name}")
                     break
+        # tenant plumbed from the running task (TaskContext.tenant) so
+        # the spill policy can evict the over-budget tenant's batches
+        # first (docs/serving.md "pressure-aware degradation")
+        from ..sql.physical.base import TaskContext
+        _t = TaskContext.current()
+        tenant = _t.tenant if _t is not None else ""
         with self._lock:
             h = self._next_handle
             self._next_handle += 1
@@ -195,7 +211,8 @@ class BufferCatalog:
             tier = DEVICE if was_device else HOST
             self._buffers[h] = _Buffer(h, tier, size, priority, treedef,
                                        list(leaves), was_device=was_device,
-                                       seq=self._seq, origin=origin)
+                                       seq=self._seq, origin=origin,
+                                       tenant=tenant)
             if was_device:
                 self.device_bytes += size
             else:
@@ -249,15 +266,46 @@ class BufferCatalog:
             return self._buffers[handle].tier
 
     # --- spill policy ------------------------------------------------------
+    def set_tenant_budgets(self, budgets: Dict[str, int],
+                           default_budget: int = 0) -> None:
+        """Install per-tenant device-byte budgets for spill ordering
+        (ServingEngine wires the admission budgets here).  Budgets only
+        reorder eviction — they never block registration."""
+        with self._lock:
+            self._tenant_budgets = {k: int(v) for k, v in budgets.items()}
+            self._tenant_default_budget = max(0, int(default_budget))
+
+    def _over_budget_tenants(self) -> set:
+        """Tenants whose DEVICE-tier registered bytes exceed their budget
+        (callers hold the lock).  O(buffers) — spill decisions are rare
+        next to the D2H work they trigger."""
+        if not self._tenant_budgets and self._tenant_default_budget <= 0:
+            return set()
+        usage: Dict[str, int] = {}
+        for b in self._buffers.values():
+            if b.tier == DEVICE and b.tenant:
+                usage[b.tenant] = usage.get(b.tenant, 0) + b.size
+        over = set()
+        for t, used in usage.items():
+            budget = int(self._tenant_budgets.get(
+                t, self._tenant_default_budget))
+            if budget > 0 and used > budget:
+                over.add(t)
+        return over
+
     def synchronous_spill(self, target_device_bytes: int) -> int:
-        """Spill device buffers (lowest priority, oldest first) until
-        accounted device usage <= target.  Returns bytes spilled
-        (``RapidsBufferCatalog.synchronousSpill`` `:589`)."""
+        """Spill device buffers until accounted device usage <= target.
+        Eviction order is ``(tenant_over_budget, priority, seq)``: an
+        over-budget tenant's batches spill FIRST (tenant-aware pressure
+        response, docs/serving.md), then lowest priority, oldest first
+        (the ``RapidsBufferCatalog.synchronousSpill`` `:589` contract)."""
         spilled = 0
         with self._lock:
+            over = self._over_budget_tenants()
             candidates = sorted(
                 (b for b in self._buffers.values() if b.tier == DEVICE),
-                key=lambda b: (b.priority, b.seq))
+                key=lambda b: (0 if b.tenant in over else 1,
+                               b.priority, b.seq))
             for buf in candidates:
                 if self.device_bytes <= target_device_bytes:
                     break

@@ -47,6 +47,11 @@ from typing import Any, Dict, List, Optional
 
 SCHEMA = "srt-doctor/1"
 
+#: most recent diagnose() verdict in this process ({"verdict", "at"}) —
+#: stamped into fatal-device diagnostic dumps (memory/fatal.py) so a
+#: quarantine event records what the engine was bound on pre-mortem
+LAST_VERDICT: "Optional[Dict[str, Any]]" = None
+
 #: tracer category -> verdict category
 _CAT_TO_VERDICT = {
     "sync": "sync-bound",
@@ -213,6 +218,11 @@ def diagnose(events: List[Dict[str, Any]],
     }
     if wall_ms is not None:
         out["wall_ms"] = round(float(wall_ms), 3)
+    # remembered process-wide so a later fatal-device dump can record
+    # what the engine believed it was bound on (memory/fatal.py)
+    global LAST_VERDICT
+    import time as _t
+    LAST_VERDICT = {"verdict": out["verdict"], "at": _t.monotonic()}
     return out
 
 

@@ -68,7 +68,7 @@ TRACING = {"on": False}
 #: the report treat unknown categories as opaque)
 CATEGORIES = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
               "shuffle", "sem_wait", "fault", "queue", "encode", "stage",
-              "admission")
+              "admission", "cancel", "fatal")
 
 #: default ring capacity (spark.rapids.tpu.trace.bufferEvents)
 DEFAULT_CAPACITY = 65536

@@ -21,6 +21,17 @@ Named sites wrap the engine's failure-prone edges:
 ``memory.oom.retry``  a retryable device OOM (RetryOOM) — the site the old
                       ``memory/retry.py`` injection hooks armed
 ``memory.oom.split``  a split-requiring device OOM (SplitAndRetryOOM)
+``query.cancel.race`` a cooperative cancellation lands at a lifecycle
+                      poll site (serving/lifecycle.py) — exercises the
+                      cancel drain path at every chokepoint; recovery is
+                      a typed QueryCancelled, never a wedged thread
+``admission.pressure`` the serving PressureSignal reports queue pressure
+                      regardless of actual depth/wait — exercises
+                      pressure-aware plan degradation
+``device.fatal``      a task hits a fatal (non-OOM) device error —
+                      exercises the poison-query quarantine + degraded-
+                      engine protocol; queries fail by design with
+                      FatalDeviceError
 ====================  =====================================================
 
 Determinism contract: with ``seed`` fixed, the inject/pass decision for
@@ -52,6 +63,7 @@ SITES = (
     "shuffle.fetch", "shuffle.connect", "shuffle.block.lost", "peer.death",
     "spill.disk_write", "spill.disk_read", "transfer.h2d", "transfer.d2h",
     "kernel.compile", "memory.oom.retry", "memory.oom.split",
+    "query.cancel.race", "admission.pressure", "device.fatal",
 )
 
 #: process-wide observability (sessions fold per-query deltas into

@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..observability import metrics as _om
 
@@ -53,6 +53,11 @@ class _Waiter:
         self.vft = vft
         self.seq = seq
         self.granted = False
+
+
+#: how often a blocked admission wait re-checks its cancellation token
+#: (serving/lifecycle.py poll bound)
+_CANCEL_POLL_S = 0.05
 
 
 def _parse_pairs(raw: str, cast) -> Dict[str, float]:
@@ -100,6 +105,8 @@ class AdmissionController:
         #: per-tenant wait evidence: count/sum/max plus a bounded list of
         #: recent waits for p99 (fairness tests and engine stats)
         self._waits: Dict[str, List[float]] = {}
+        #: rolling cross-tenant wait window feeding pressure_snapshot()
+        self._recent_waits: List[float] = []
         self.stats = {"admitted": 0, "timeouts": 0, "peak_queued": 0}
 
     @classmethod
@@ -163,13 +170,36 @@ class AdmissionController:
             self._cond.notify_all()
 
     # --- public API ---------------------------------------------------------
+    def _abandon_locked(self, w: _Waiter, tenant: str) -> None:
+        """An un-granted waiter leaves the queue (timeout or cancel):
+        roll the tenant's WFQ virtual finish time back by this waiter's
+        cost so an abandoned wait does not tax the tenant's FUTURE share
+        — without this, a tenant timing out repeatedly accumulates
+        phantom vft and its eventual real query is scheduled as if the
+        tenant had already consumed those slots."""
+        self._waiting.remove(w)
+        cost = 1.0 / self._weight(tenant)
+        cur = self._tenant_vft.get(tenant, 0.0)
+        # exact inverse of the advance in acquire(); a value below the
+        # vclock is harmless (the next acquire max()es it back up)
+        self._tenant_vft[tenant] = max(0.0, cur - cost)
+
     def acquire(self, tenant: str, est_bytes: int = 0,
-                timeout_ms: Optional[int] = None) -> Ticket:
+                timeout_ms: Optional[int] = None,
+                cancel=None) -> Ticket:
+        """Block until granted.  ``cancel`` is an optional lifecycle
+        token (serving/lifecycle.py QueryContext): the wait polls it
+        every 50ms and a cancelled/expired query leaves the queue with
+        its typed error AND its tenant-vft contribution rolled back —
+        the `admission` poll site of the cancellation race matrix."""
         tenant = tenant or "default"
         est_bytes = max(0, int(est_bytes))
         timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.perf_counter() + timeout_ms / 1e3
                     if timeout_ms > 0 else None)
+        if cancel is not None:
+            # a cancel issued BEFORE admission must not enqueue at all
+            cancel.check("admission")
         t0 = time.perf_counter()
         with self._lock:
             self._seq += 1
@@ -187,7 +217,7 @@ class AdmissionController:
                 if deadline is not None:
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
-                        self._waiting.remove(w)
+                        self._abandon_locked(w, tenant)
                         self.stats["timeouts"] += 1
                         _om.inc("admission_timeouts_total", tenant=tenant)
                         raise AdmissionTimeout(
@@ -195,14 +225,38 @@ class AdmissionController:
                             f">{timeout_ms}ms for an admission slot "
                             f"({self._running} running, "
                             f"{len(self._waiting)} queued)")
+                if cancel is not None:
+                    if remaining is None:
+                        remaining = _CANCEL_POLL_S
+                    else:
+                        remaining = min(remaining, _CANCEL_POLL_S)
                 self._cond.wait(remaining)
+                if cancel is not None and not w.granted:
+                    try:
+                        cancel.check("admission")
+                    except BaseException:
+                        self._abandon_locked(w, tenant)
+                        raise
             self._waiting.remove(w)
             wait_s = time.perf_counter() - t0
             self.stats["admitted"] += 1
             self._waits.setdefault(tenant, []).append(wait_s * 1e3)
+            self._recent_waits.append(wait_s * 1e3)
+            if len(self._recent_waits) > 64:
+                del self._recent_waits[:32]
             if len(self._waits[tenant]) > 4096:
                 del self._waits[tenant][:2048]
         return Ticket(tenant, est_bytes, vft, wait_s)
+
+    def pressure_snapshot(self) -> "Tuple[int, float]":
+        """(queue depth, recent admission-wait median ms) — the cheap
+        signal the PressureSignal (serving/lifecycle.py) consults at
+        planning time."""
+        with self._lock:
+            depth = len(self._waiting)
+            recent = sorted(self._recent_waits)
+        med = recent[len(recent) // 2] if recent else 0.0
+        return depth, med
 
     def release(self, ticket: Ticket) -> None:
         with self._lock:

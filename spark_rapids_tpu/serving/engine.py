@@ -58,6 +58,26 @@ class ServingEngine:
         self._conf = base.copy(conf_kwargs or None)
         self.engine_id = f"engine-{os.getpid()}-{id(self) & 0xFFFF:04x}"
         self.admission = AdmissionController.from_conf(self._conf)
+        # --- query lifecycle (serving/lifecycle.py) ---------------------
+        from ..config import DEGRADED_PROBE_INTERVAL_MS
+        from . import lifecycle as _lc
+        #: pressure-aware plan degradation (kill-switched)
+        self.pressure = _lc.PressureSignal(self._conf)
+        #: plan fingerprints that produced a FatalDeviceError (TTL'd)
+        self.quarantine = _lc.QuarantineRegistry.from_conf(self._conf)
+        #: degraded-engine state: reason string while degraded, None
+        #: when healthy; new admissions are refused until a probe query
+        #: succeeds (EngineDegraded)
+        self._degraded: Optional[str] = None
+        self._probe_interval_s = max(
+            0.0, int(self._conf.get(DEGRADED_PROBE_INTERVAL_MS)) / 1e3)
+        self._next_probe = 0.0
+        # tenant-aware spill: the admission memory budgets double as the
+        # catalog's eviction-priority budgets (over-budget tenants'
+        # batches spill first, memory/spill.py)
+        from ..memory.spill import BufferCatalog
+        BufferCatalog.get().set_tenant_budgets(
+            dict(self.admission.budgets), self.admission.default_budget)
         self.result_cache_enabled = bool(
             self._conf.get(SERVING_RESULT_CACHE_ENABLED))
         RC.set_max_bytes(int(self._conf.get(SERVING_RESULT_CACHE_MAX_BYTES)))
@@ -134,6 +154,93 @@ class ServingEngine:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # --- query lifecycle ----------------------------------------------------
+    def cancel_tenant(self, tenant: str,
+                      reason: str = "tenant cancelled") -> int:
+        """Cooperatively cancel every live query of ``tenant`` across
+        all this engine's sessions (admission waiters included); each
+        raises :class:`QueryCancelled` within the poll bound.  Returns
+        how many queries were cancelled."""
+        from . import lifecycle as _lc
+        return _lc.cancel_tenant(tenant, reason)
+
+    def is_degraded(self) -> bool:
+        return self._degraded is not None
+
+    def note_fatal(self, exc: BaseException, fingerprint: str,
+                   tenant: str = "") -> None:
+        """A serving query died with a fatal device error: quarantine
+        its plan fingerprint (bounded TTL) and mark the engine degraded
+        so new admissions are refused until a probe succeeds.  Only the
+        offending query fails — in-flight siblings run to completion."""
+        from . import lifecycle as _lc
+        from ..observability import metrics as OM
+        from ..observability import tracer as OT
+        if fingerprint:
+            self.quarantine.add(fingerprint)
+        self._degraded = (f"fatal device error in tenant "
+                          f"{tenant or 'unknown'}: {exc}")
+        self._next_probe = 0.0  # first probe attempt is immediate
+        _lc.STATS["degraded_marks"] += 1
+        OM.inc("engine_degraded_total",
+               **({"tenant": tenant} if tenant else {}))
+        if OT.TRACING["on"]:
+            import time as _t
+            OT.get_tracer().complete(
+                "fatal", "engine.degraded", _t.perf_counter(), 0.0,
+                **({"tenant": tenant} if tenant else {}))
+
+    def check_admittable(self, fingerprint: str = "") -> None:
+        """Refuse quarantined plans and — while degraded — everything
+        until a probe query proves the device answers again.  Raises
+        :class:`QueryQuarantined` / :class:`EngineDegraded`."""
+        from . import lifecycle as _lc
+        if self._degraded is not None and not self._probe():
+            raise _lc.EngineDegraded(
+                f"engine refusing admissions while degraded "
+                f"({self._degraded}); next probe in "
+                f"<= {self._probe_interval_s:.1f}s")
+        if fingerprint and self.quarantine.quarantined(fingerprint):
+            raise _lc.QueryQuarantined(
+                f"plan fingerprint {fingerprint[:16]}... is quarantined "
+                f"after a fatal device error (TTL "
+                f"{self.quarantine.ttl_s:.0f}s); retrying it now would "
+                f"likely re-kill the device")
+
+    def _probe(self) -> bool:
+        """One throttled device probe: a trivial compiled computation
+        must round-trip.  Success clears the degraded mark (and traces
+        ``probe``); failure re-arms the probe interval."""
+        import time as _t
+        from . import lifecycle as _lc
+        from ..observability import metrics as OM
+        from ..observability import tracer as OT
+        with self._lock:
+            if self._degraded is None:
+                return True
+            now = _t.monotonic()
+            if now < self._next_probe:
+                return False
+            self._next_probe = now + self._probe_interval_s
+        t0 = _t.perf_counter()
+        try:
+            import jax
+            import jax.numpy as jnp
+            got = jax.device_get(jnp.add(jnp.int32(20), jnp.int32(22)))
+            ok = int(got) == 42
+        except Exception:
+            ok = False
+        if ok:
+            with self._lock:
+                self._degraded = None
+            _lc.STATS["probe_recoveries"] += 1
+            OM.inc("engine_probe_recoveries_total")
+        if OT.TRACING["on"]:
+            OT.get_tracer().complete(
+                "fatal", "engine.probe", t0, _t.perf_counter() - t0,
+                ok=ok)
+        return ok
 
     # --- fleet observability ------------------------------------------------
     def query_history(self, n: Optional[int] = None,

@@ -1,0 +1,472 @@
+"""Query lifecycle manager — cooperative cancellation, per-query
+deadlines, pressure-aware degradation and poison-query quarantine
+(docs/serving.md, docs/robustness.md).
+
+A serving stack that fronts millions of users must survive the queries
+themselves, not just data-movement faults: a slow query must not run
+forever, a cancelled one must not wedge a worker thread, and a fatal
+device error in one tenant's query must not poison the shared engine
+process.  This module owns the four pieces:
+
+* **QueryContext** — a cancellation token + optional deadline created by
+  the session for every query and visible to every thread that works on
+  that query's behalf (pool workers, prefetch producers, transfer
+  stagers inherit it through :class:`TaskContext`).  The existing
+  execution chokepoints — partition scheduler, prefetch queues, the
+  double-buffer stager, shuffle fetch retry loops, semaphore waits,
+  spill disk I/O — poll :func:`check_cancel` and raise the typed
+  :class:`QueryCancelled` / :class:`QueryDeadlineExceeded` within one
+  poll interval, unwinding through the same ``finally`` blocks that
+  release the semaphore, unpin retention and drain prefetch queues.
+* **PressureSignal** — admission-aware graceful degradation: under
+  queue pressure (depth / recent-wait signal from the
+  :class:`~spark_rapids_tpu.serving.admission.AdmissionController`)
+  newly-admitted plans shrink — a lower ``concurrentGpuTasks`` share,
+  smaller batch targets, speculative sizing off — via conf overrides
+  consulted at planning time (kill switch
+  ``spark.rapids.tpu.serving.pressure.enabled``).
+* **QuarantineRegistry** — a bounded-TTL table of plan fingerprints
+  whose execution produced a :class:`FatalDeviceError`; immediate
+  retries of the same plan are refused with :class:`QueryQuarantined`
+  instead of re-killing the device.
+* the **degraded-engine protocol** — a fatal error marks the owning
+  :class:`ServingEngine` degraded; it refuses new admissions
+  (:class:`EngineDegraded`) until a probe query succeeds.
+
+Overhead contract: with no live QueryContext, every chokepoint costs
+exactly one module-dict lookup (``LIFECYCLE["on"]``) — the same pattern
+as the tracer's ``TRACING`` flag and ``CHAOS`` in robustness/faults.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..observability import metrics as _om
+from ..observability import tracer as _trace
+from ..robustness import faults as _faults
+
+#: master switch — flipped while >= 1 QueryContext is registered; the
+#: only thing a chokepoint reads when no query is cancellable
+LIFECYCLE = {"on": False}
+
+#: how often blocking chokepoints (semaphore wait, prefetch queue get,
+#: cancellable sleeps) re-check cancellation: the drain-latency bound
+POLL_S = 0.05
+
+#: observability for tests (folded into last_query_metrics as deltas is
+#: overkill here — these are process totals, like faults.STATS)
+STATS = {"cancelled": 0, "deadline_exceeded": 0, "quarantined": 0,
+         "degraded_marks": 0, "probe_recoveries": 0, "pressure_degraded": 0}
+
+#: the poll-site catalog (docs/robustness.md documents each; the conf
+#: spark.rapids.tpu.query.cancel.pollSites can restrict checks to a
+#: subset — empty means all)
+POLL_SITES = ("admission", "partition", "sem_wait", "prefetch", "stager",
+              "shuffle", "exchange", "spill")
+
+
+class QueryCancelled(RuntimeError):
+    """The query was cooperatively cancelled (``sess.cancel`` /
+    ``ServingEngine.cancel_tenant`` / chaos ``query.cancel.race``);
+    its worker threads drained and released every held resource."""
+
+    def __init__(self, message: str, query_id: int = 0, reason: str = ""):
+        super().__init__(message)
+        self.query_id = query_id
+        self.reason = reason
+
+
+class QueryDeadlineExceeded(QueryCancelled):
+    """The query ran past ``spark.rapids.tpu.query.deadlineMs``."""
+
+
+class EngineDegraded(RuntimeError):
+    """The serving engine saw a fatal device error and refuses new
+    admissions until a probe query succeeds."""
+
+
+class QueryQuarantined(RuntimeError):
+    """This plan fingerprint produced a FatalDeviceError within the
+    quarantine TTL; retrying it now would likely re-kill the device."""
+
+
+class QueryContext:
+    """Per-query cancellation token + deadline.  Created by the session
+    (classic and serving paths), registered process-wide so
+    ``sess.cancel(qid)`` / ``engine.cancel_tenant(...)`` can reach it,
+    and inherited by every TaskContext created for the query — helper
+    threads installing the task via ``as_current()`` see it too."""
+
+    __slots__ = ("query_id", "session_id", "tenant", "deadline",
+                 "deadline_ms", "reason", "cancelled_at", "_cancelled",
+                 "_sites")
+
+    def __init__(self, query_id: int, session_id: str = "",
+                 tenant: str = "", deadline_ms: int = 0,
+                 poll_sites: Optional[frozenset] = None):
+        self.query_id = int(query_id)
+        self.session_id = session_id
+        self.tenant = tenant
+        self.deadline_ms = max(0, int(deadline_ms))
+        self.deadline = (time.monotonic() + self.deadline_ms / 1e3
+                         if self.deadline_ms > 0 else None)
+        self.reason = ""
+        #: perf_counter stamp of the cancel() call — the session's
+        #: epilogue derives cancel latency (issue -> threads drained)
+        self.cancelled_at: Optional[float] = None
+        self._cancelled = threading.Event()
+        self._sites = poll_sites  # None = every site polls
+
+    # --- the token ---------------------------------------------------------
+    def cancel(self, reason: str = "cancelled") -> bool:
+        """Idempotent; returns True on the first (effective) call."""
+        if self._cancelled.is_set():
+            return False
+        self.reason = reason
+        self.cancelled_at = time.perf_counter()
+        self._cancelled.set()
+        STATS["cancelled"] += 1
+        if _trace.TRACING["on"]:
+            _trace.get_tracer().complete(
+                "cancel", "query.cancel", self.cancelled_at, 0.0,
+                query=self.query_id, reason=reason,
+                **({"tenant": self.tenant} if self.tenant else {}))
+        _om.inc("query_cancels_total",
+                **({"tenant": self.tenant} if self.tenant else {}))
+        return True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def expired(self) -> bool:
+        return self.deadline is not None \
+            and time.monotonic() >= self.deadline
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def check(self, site: str = "") -> None:
+        """Raise the typed error if cancelled or past deadline.  The
+        chaos site ``query.cancel.race`` fires HERE, so an armed soak
+        exercises a cancel landing at every instrumented chokepoint."""
+        if _faults.CHAOS["on"] and _faults.should_fire(
+                "query.cancel.race", at=site, query=self.query_id):
+            self.cancel(f"chaos-injected cancel at {site or 'query'}")
+        if self._cancelled.is_set():
+            raise QueryCancelled(
+                f"query {self.query_id} cancelled"
+                + (f" at {site}" if site else "")
+                + (f": {self.reason}" if self.reason else ""),
+                self.query_id, self.reason)
+        if self.expired():
+            # deadline counts as a cancellation for drain purposes: the
+            # stamp lets the epilogue measure enforcement latency
+            if self.cancelled_at is None:
+                self.cancelled_at = time.perf_counter()
+                self.reason = f"deadline {self.deadline_ms}ms exceeded"
+                STATS["deadline_exceeded"] += 1
+                if _trace.TRACING["on"]:
+                    _trace.get_tracer().complete(
+                        "cancel", "query.deadline", self.cancelled_at,
+                        0.0, query=self.query_id,
+                        deadline_ms=self.deadline_ms)
+                _om.inc("query_deadline_exceeded_total",
+                        **({"tenant": self.tenant} if self.tenant else {}))
+            raise QueryDeadlineExceeded(
+                f"query {self.query_id} exceeded its "
+                f"{self.deadline_ms}ms deadline"
+                + (f" (at {site})" if site else ""),
+                self.query_id, self.reason)
+
+    def polls(self, site: str) -> bool:
+        return self._sites is None or site in self._sites
+
+
+# --------------------------------------------------------------------------
+# registry + thread plumbing
+# --------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+#: (session_id, query_id) -> live QueryContext
+_LIVE: Dict[Tuple[str, int], QueryContext] = {}
+_TLS = threading.local()
+
+
+def register(qctx: QueryContext) -> None:
+    with _LOCK:
+        _LIVE[(qctx.session_id, qctx.query_id)] = qctx
+        LIFECYCLE["on"] = True
+
+
+def unregister(qctx: QueryContext) -> None:
+    with _LOCK:
+        _LIVE.pop((qctx.session_id, qctx.query_id), None)
+        LIFECYCLE["on"] = bool(_LIVE)
+
+
+def live_queries() -> List[QueryContext]:
+    with _LOCK:
+        return list(_LIVE.values())
+
+
+def cancel_session(session_id: str, query_id: Optional[int] = None,
+                   reason: str = "cancelled") -> int:
+    """Cancel one (or all) of a session's live queries; returns how many
+    tokens flipped."""
+    n = 0
+    for q in live_queries():
+        if q.session_id != session_id:
+            continue
+        if query_id is not None and q.query_id != query_id:
+            continue
+        if q.cancel(reason):
+            n += 1
+    return n
+
+
+def cancel_tenant(tenant: str, reason: str = "tenant cancelled") -> int:
+    """Cancel every live query belonging to ``tenant``."""
+    n = 0
+    for q in live_queries():
+        if q.tenant == tenant and q.cancel(reason):
+            n += 1
+    return n
+
+
+def ambient() -> Optional[QueryContext]:
+    """The thread-local QueryContext only (no TaskContext fallback) —
+    what TaskContext.__init__ captures on the creating thread."""
+    return getattr(_TLS, "qctx", None)
+
+
+def current() -> Optional[QueryContext]:
+    """The QueryContext this thread works for: the installed thread-local
+    (driver threads), else the current TaskContext's (pool workers,
+    prefetch producers, stager threads — any thread that installed the
+    task via ``as_current()``)."""
+    q = getattr(_TLS, "qctx", None)
+    if q is not None:
+        return q
+    from ..sql.physical.base import TaskContext
+    t = TaskContext.current()
+    return getattr(t, "query_ctx", None) if t is not None else None
+
+
+class installed:
+    """Context manager installing ``qctx`` as this thread's query
+    context (None is a no-op).  Used by the session around execution and
+    by the parallel partition scheduler on its pool workers."""
+
+    __slots__ = ("_qctx", "_prev")
+
+    def __init__(self, qctx: Optional[QueryContext]):
+        self._qctx = qctx
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "qctx", None)
+        if self._qctx is not None:
+            _TLS.qctx = self._qctx
+        return self._qctx
+
+    def __exit__(self, *exc):
+        _TLS.qctx = self._prev
+
+
+# --- test hook: deterministic cancel at a named poll site ------------------
+#: {"site": name|None, "after": int} — the (after+1)th check at `site`
+#: cancels the current query (the race-matrix suite's trigger)
+_CANCEL_TRIGGER = {"site": None, "after": 0, "hits": 0}
+
+
+def set_cancel_trigger(site: Optional[str], after: int = 0) -> None:
+    _CANCEL_TRIGGER["site"] = site
+    _CANCEL_TRIGGER["after"] = int(after)
+    _CANCEL_TRIGGER["hits"] = 0
+
+
+def check_cancel(site: str) -> None:
+    """The chokepoint: near-free when no query is cancellable, else
+    resolve this thread's QueryContext and poll it."""
+    if not LIFECYCLE["on"]:
+        return
+    q = current()
+    if q is None or not q.polls(site):
+        return
+    trig = _CANCEL_TRIGGER
+    if trig["site"] == site:
+        trig["hits"] += 1
+        if trig["hits"] > trig["after"]:
+            trig["site"] = None
+            q.cancel(f"test trigger at {site}")
+    q.check(site)
+
+
+def cancellable_sleep(seconds: float, site: str) -> None:
+    """Sleep in POLL_S chunks, polling cancellation between chunks —
+    backoff sleeps (shuffle fetch retry) must not delay a cancel past
+    the poll bound."""
+    if seconds <= 0:
+        return
+    if not LIFECYCLE["on"]:
+        time.sleep(seconds)
+        return
+    end = time.monotonic() + seconds
+    while True:
+        check_cancel(site)
+        left = end - time.monotonic()
+        if left <= 0:
+            return
+        time.sleep(min(POLL_S, left))
+
+
+def parse_poll_sites(raw: str) -> Optional[frozenset]:
+    """Conf value -> poll-site set (None = all sites poll)."""
+    names = frozenset(s.strip() for s in str(raw or "").split(",")
+                      if s.strip())
+    return names or None
+
+
+# --------------------------------------------------------------------------
+# pressure-aware graceful degradation
+# --------------------------------------------------------------------------
+
+class PressureSignal:
+    """Admission-queue pressure -> plan-time conf overrides.
+
+    Consulted by the serving execution path AFTER admission: when the
+    controller's queue depth or recent admission wait crosses the
+    configured thresholds (or chaos injects ``admission.pressure``),
+    the newly-admitted query plans with a shrunken resource profile —
+    a reduced ``spark.rapids.sql.concurrentGpuTasks`` share, a smaller
+    batch-rows target, and speculative join sizing disabled — so a
+    saturated engine degrades throughput-per-query instead of piling
+    working sets until the OOM machinery thrashes.  Entirely
+    kill-switched by ``spark.rapids.tpu.serving.pressure.enabled``."""
+
+    def __init__(self, conf):
+        from ..config import (PRESSURE_BATCH_ROWS, PRESSURE_ENABLED,
+                              PRESSURE_QUEUE_DEPTH, PRESSURE_SHARE,
+                              PRESSURE_WAIT_MS)
+        self.enabled = bool(conf.get(PRESSURE_ENABLED))
+        self.queue_depth = max(1, int(conf.get(PRESSURE_QUEUE_DEPTH)))
+        self.wait_ms = float(conf.get(PRESSURE_WAIT_MS))
+        self.share = min(1.0, max(0.0, float(conf.get(PRESSURE_SHARE))))
+        self.batch_rows = max(1, int(conf.get(PRESSURE_BATCH_ROWS)))
+
+    def under_pressure(self, admission) -> bool:
+        if not self.enabled:
+            return False
+        if _faults.CHAOS["on"] and _faults.should_fire("admission.pressure"):
+            return True
+        depth, recent_wait_ms = admission.pressure_snapshot()
+        return depth >= self.queue_depth or (
+            self.wait_ms > 0 and recent_wait_ms >= self.wait_ms)
+
+    def plan_overrides(self, admission, conf) -> Dict[str, object]:
+        """{} when calm; conf-key overrides to plan degraded when under
+        pressure (also counts/traces the degradation)."""
+        if not self.under_pressure(admission):
+            return {}
+        from ..config import (BATCH_SIZE_ROWS, CONCURRENT_TASKS,
+                              JOIN_SPECULATIVE_SIZING)
+        cur_tasks = max(1, int(conf.get(CONCURRENT_TASKS)))
+        cur_rows = max(1, int(conf.get(BATCH_SIZE_ROWS)))
+        over = {
+            CONCURRENT_TASKS.key: max(1, int(cur_tasks * self.share)),
+            BATCH_SIZE_ROWS.key: min(cur_rows, self.batch_rows),
+            JOIN_SPECULATIVE_SIZING.key: False,
+        }
+        STATS["pressure_degraded"] += 1
+        _om.inc("pressure_degraded_total")
+        if _trace.TRACING["on"]:
+            _trace.get_tracer().complete(
+                "admission", "pressure.degrade", time.perf_counter(), 0.0,
+                concurrent=over[CONCURRENT_TASKS.key],
+                batch_rows=over[BATCH_SIZE_ROWS.key])
+        return over
+
+
+# --------------------------------------------------------------------------
+# poison-query quarantine
+# --------------------------------------------------------------------------
+
+class QuarantineRegistry:
+    """Bounded-TTL table of plan fingerprints that produced a fatal
+    device error.  ``quarantined`` purges expired entries on read; the
+    size bound evicts oldest-first so a fingerprint storm cannot grow
+    the table without bound."""
+
+    def __init__(self, ttl_ms: int = 60_000, max_entries: int = 128):
+        self.ttl_s = max(0.0, int(ttl_ms) / 1e3)
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: Dict[str, float] = {}  # fingerprint -> expiry
+
+    @classmethod
+    def from_conf(cls, conf) -> "QuarantineRegistry":
+        from ..config import QUARANTINE_MAX_ENTRIES, QUARANTINE_TTL_MS
+        return cls(int(conf.get(QUARANTINE_TTL_MS)),
+                   int(conf.get(QUARANTINE_MAX_ENTRIES)))
+
+    def add(self, fingerprint: str) -> None:
+        if not fingerprint or self.ttl_s <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._entries[fingerprint] = now + self.ttl_s
+            while len(self._entries) > self.max_entries:
+                oldest = min(self._entries, key=self._entries.get)
+                del self._entries[oldest]
+        STATS["quarantined"] += 1
+        _om.inc("quarantine_count")
+        if _trace.TRACING["on"]:
+            _trace.get_tracer().complete(
+                "fatal", "query.quarantine", time.perf_counter(), 0.0,
+                fingerprint=fingerprint[:16])
+
+    def quarantined(self, fingerprint: str) -> bool:
+        if not fingerprint:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            exp = self._entries.get(fingerprint)
+            if exp is None:
+                return False
+            if now >= exp:
+                del self._entries[fingerprint]
+                return False
+            return True
+
+    def size(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            for fp in [f for f, e in self._entries.items() if now >= e]:
+                del self._entries[fp]
+            return len(self._entries)
+
+
+def quarantine_key(logical, conf) -> str:
+    """Stable fingerprint for quarantine lookups: the plan CONTENT key
+    when the plan is fingerprintable (fingerprint.py), else the shape
+    fingerprint (observability/history.py) over a fresh physical plan.
+    Planning here is acceptable: the key is only computed when the
+    engine is degraded, has quarantine entries, or just saw a fatal —
+    never on the hot path."""
+    try:
+        from ..observability.history import plan_fingerprint
+        from ..sql.planner import Planner
+        from .fingerprint import plan_content_key
+        phys = Planner(conf).plan_for_collect(logical)
+        key = plan_content_key(phys, conf)
+        if key is not None:
+            return key.digest
+        return plan_fingerprint(phys)
+    except Exception:
+        return ""

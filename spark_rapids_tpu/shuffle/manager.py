@@ -246,9 +246,16 @@ class ShuffleManager:
         # one wall-clock deadline for the whole reduce read, shared by
         # every block's retry loop
         deadline = time.monotonic() + policy.deadline_s
+        # the reader pool's threads have no TaskContext: capture the
+        # calling task's lifecycle token here so the per-block retry
+        # loops still poll the right query's cancellation
+        from ..serving import lifecycle as _lc
+        qctx = _lc.current()
 
         def read_one(block: BlockId) -> Optional[List[bytes]]:
-            return self._fetch_block(block, peers_cache, policy, deadline)
+            with _lc.installed(qctx):
+                return self._fetch_block(block, peers_cache, policy,
+                                         deadline)
 
         if self.mode == "MULTITHREADED" and len(blocks) > 1:
             frame_lists = list(self._reader_pool.map(read_one, blocks))
@@ -277,10 +284,15 @@ class ShuffleManager:
         registered lineage callback.  Returns None only when the block is
         authoritatively missing (empty partitions are never published);
         every network-level failure surfaces as ShuffleFetchFailed."""
+        from ..serving import lifecycle as _lc
         attempt = 0
         recomputed = False
         last_err: Optional[BaseException] = None
         while True:
+            # lifecycle poll site `shuffle`: a cancelled/expired query
+            # abandons the fetch (and its backoff sleeps below) within
+            # one poll interval instead of burning the retry budget
+            _lc.check_cancel("shuffle")
             try:
                 return self._fetch_once(block, peers_cache)
             except (ConnectionError, OSError, FrameCorrupt) as e:
@@ -315,7 +327,7 @@ class ShuffleManager:
                     block=str(block), attempt=attempt,
                     error=type(last_err).__name__)
             if delay > 0:
-                time.sleep(delay)
+                _lc.cancellable_sleep(delay, "shuffle")
             # refresh the peer view next attempt: a restarted peer
             # re-registers, and expired blacklist benches reinstate
             peers_cache[0] = None

@@ -88,6 +88,8 @@ class AsyncPrefetchExec(PhysicalPlan):
 
         from ...memory import retention as _ret
 
+        from ...serving import lifecycle as _lc
+
         def produce():
             try:
                 # the task's context must be visible on this thread
@@ -95,6 +97,10 @@ class AsyncPrefetchExec(PhysicalPlan):
                 # errstate is thread-local in numpy, mirror execute_all's
                 with tctx.as_current(), np.errstate(all="ignore"):
                     for batch in child.execute(pid, tctx):
+                        # lifecycle poll site `prefetch` (producer side):
+                        # a cancelled query's producer must stop pulling
+                        # the child, not fill the queue to the brim first
+                        _lc.check_cancel("prefetch")
                         # pinned while enqueued: a queued batch is held by
                         # TWO parties (queue + eventual consumer) and must
                         # never be donation-eligible in that window
@@ -114,7 +120,14 @@ class AsyncPrefetchExec(PhysicalPlan):
         try:
             while True:
                 t0 = time.perf_counter()
-                item = q.get()
+                while True:
+                    try:
+                        # polled get: a cancel must not leave the consumer
+                        # blocked forever on a wedged/slow producer
+                        item = q.get(timeout=_POLL_S)
+                        break
+                    except queue.Empty:
+                        _lc.check_cancel("prefetch")
                 dt = time.perf_counter() - t0
                 waited_s += dt
                 if dt > 1e-6 and _trace.TRACING["on"]:
@@ -131,6 +144,19 @@ class AsyncPrefetchExec(PhysicalPlan):
                 yield item
         finally:
             cancel.set()
+            # deterministic drain (cancel/deadline/early-LIMIT exits):
+            # the producer exits within one poll interval, then any
+            # batches still enqueued are unpinned HERE — retention
+            # accounting returns to baseline without waiting for the GC
+            # reaper (the leak-sentinel/race-matrix contract)
+            t.join(timeout=4 * _POLL_S)
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _DONE and not isinstance(item, _Raised):
+                    _ret.unpin_batch(item)
             tctx.inc_metric("prefetchBatches", produced)
             tctx.inc_metric("prefetchWaitMs", waited_s * 1e3)
             if _trace.TRACING["on"]:

@@ -50,9 +50,26 @@ class TaskContext:
         else:
             self.metrics = {}
             self._metrics_lock = threading.Lock()
-        from ...config import METRICS_LEVEL
+        from ...config import METRICS_LEVEL, SERVING_TENANT
         self._rank = _METRIC_RANK.get(
             str(self.conf.get(METRICS_LEVEL)).upper(), 1)
+        #: tenant identity for tenant-aware spill eviction (the catalog
+        #: stamps it on every registered buffer, memory/spill.py)
+        self.tenant = (parent.tenant if parent is not None
+                       else str(self.conf.get(SERVING_TENANT) or ""))
+        #: the owning query's lifecycle token (serving/lifecycle.py):
+        #: inherited from the parent task or captured from the creating
+        #: thread, so helper threads installing this task via
+        #: as_current() poll the right query's cancellation
+        if parent is not None:
+            self.query_ctx = parent.query_ctx
+        else:
+            cur = TaskContext.current()
+            if cur is not None:
+                self.query_ctx = cur.query_ctx
+            else:
+                from ...serving.lifecycle import ambient
+                self.query_ctx = ambient()
 
     def inc_metric(self, name: str, value: float = 1.0,
                    level: str = "MODERATE"):
@@ -76,7 +93,14 @@ class TaskContext:
 
     def as_current(self):
         """Context manager installing this task as the thread's current one
-        (nested map-side tasks under exchanges/joins restore the outer)."""
+        (nested map-side tasks under exchanges/joins restore the outer).
+
+        The restore is CONDITIONAL on this context still being the
+        thread's current one: a generator abandoned mid-iteration (LIMIT
+        early-close, query cancellation) has its ``finally`` run at
+        GC-close time — possibly on a different thread, during a LATER
+        query — and an unconditional restore would clobber that thread's
+        live context with a stale one."""
         from contextlib import contextmanager
 
         @contextmanager
@@ -86,7 +110,8 @@ class TaskContext:
             try:
                 yield self
             finally:
-                TaskContext._set_current(prev)
+                if TaskContext.current() is self:
+                    TaskContext._set_current(prev)
         return _cm()
 
 
@@ -239,6 +264,8 @@ class PhysicalPlan:
         from ...memory.completion import ScalableTaskCompletion
         from ...memory.retry import arm_oom_injection
         from ...memory.semaphore import TpuSemaphore
+        from ...robustness import faults as _faults
+        from ...serving import lifecycle as _lc
         sem = TpuSemaphore.get()
         stc = ScalableTaskCompletion.get()
         tracing = bool((conf or RapidsConf.get_global()).get(TRACE_ENABLED))
@@ -250,19 +277,38 @@ class PhysicalPlan:
         # task's thread-local on exit
         prev_ctx = TaskContext.current()
         TaskContext._set_current(tctx)
-        arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
-                          int(tctx.conf.get(TEST_INJECT_SPLIT_OOM)))
-        sem.acquire_if_necessary(pid, tctx)
         failed = False
+
+        def _drain(it) -> None:
+            # per-batch poll: a mid-partition cancel drains at batch
+            # granularity, unwinding through the finally below (semaphore
+            # release, metric merge, completion callbacks)
+            for b in it:
+                out.append(b)
+                _lc.check_cancel("partition")
         try:
+            # everything below runs under the finally: the lifecycle
+            # poll, the chaos site and the (now cancellable) semaphore
+            # acquire can all RAISE, and a raise here must still restore
+            # the thread context and release whatever was taken
+            # -- lifecycle poll site `partition`: a cancel/deadline
+            # landing before the task touches the device costs nothing
+            _lc.check_cancel("partition")
+            if _faults.CHAOS["on"]:
+                from ...memory.fatal import FatalDeviceError
+                _faults.maybe_inject("device.fatal", exc=FatalDeviceError,
+                                     partition=pid)
+            arm_oom_injection(int(tctx.conf.get(TEST_INJECT_RETRY_OOM)),
+                              int(tctx.conf.get(TEST_INJECT_SPLIT_OOM)))
+            sem.acquire_if_necessary(pid, tctx)
             with np.errstate(all="ignore"):
                 if tracing:
                     import jax.profiler
                     with jax.profiler.TraceAnnotation(
                             f"{self.node_name()}:task{pid}"):
-                        out.extend(self.execute(pid, tctx))
+                        _drain(self.execute(pid, tctx))
                 else:
-                    out.extend(self.execute(pid, tctx))
+                    _drain(self.execute(pid, tctx))
         except BaseException as e:
             failed = True
             dump_dir = str(tctx.conf.get(DUMP_ON_ERROR_PATH))
@@ -308,6 +354,7 @@ class PhysicalPlan:
         from concurrent.futures import ThreadPoolExecutor
         from ...config import CONCURRENT_TASKS
         from ...memory.semaphore import TpuSemaphore
+        from ...serving import lifecycle as _lc
         sem = TpuSemaphore.get()
         want = max(1, int(cfg.get(CONCURRENT_TASKS)))
         if sem.permits != want and sem.active_tasks() == 0:
@@ -316,12 +363,16 @@ class PhysicalPlan:
         slots: List[Optional[List[ColumnarBatch]]] = [None] * nparts
         errors: Dict[int, BaseException] = {}
         abort = threading.Event()
+        # the pool workers must see the driver thread's query context:
+        # a cancel/deadline is one token shared by every task
+        qctx = _lc.current()
 
         def run_task(pid: int) -> None:
             if abort.is_set():
                 return  # a prior task failed; its exception wins
             try:
-                slots[pid] = self._run_partition(pid, conf)
+                with _lc.installed(qctx):
+                    slots[pid] = self._run_partition(pid, conf)
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 errors[pid] = e
                 abort.set()

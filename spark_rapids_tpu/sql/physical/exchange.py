@@ -121,7 +121,13 @@ class ShuffleExchangeExec(PhysicalPlan):
         # collected map outputs
         num_maps = child.num_partitions()
         map_out: List[Optional[ColumnarBatch]] = []
+        from ...serving import lifecycle as _lc
         for cpid in range(num_maps):
+            # lifecycle poll site `exchange`: the map side is the one
+            # place a query re-runs its whole subtree serially — a
+            # cancel/deadline must drain between map tasks, not after
+            # all of them
+            _lc.check_cancel("exchange")
             ctctx = TaskContext(cpid, tctx.conf, parent=tctx)
             with ctctx.as_current():
                 got = list(child.execute(cpid, ctctx))
@@ -208,15 +214,24 @@ class ShuffleExchangeExec(PhysicalPlan):
 
         total_maps = num_maps * (topo.num_slices if multi else 1)
         out: List[List[ColumnarBatch]] = []
-        for t in range(nt):
-            if multi and not topo.is_local(t, nt):
-                # two-tier plane: this slice assembles ONLY the reduce
-                # partitions it owns; peer slices pull their own blocks
-                # (published above) over the DCN transport
-                out.append([])
-                continue
-            got = mgr.read_reduce_partition(shuffle_id, total_maps, t)
-            out.append([got] if got is not None else [])
+        try:
+            for t in range(nt):
+                if multi and not topo.is_local(t, nt):
+                    # two-tier plane: this slice assembles ONLY the reduce
+                    # partitions it owns; peer slices pull their own blocks
+                    # (published above) over the DCN transport
+                    out.append([])
+                    continue
+                got = mgr.read_reduce_partition(shuffle_id, total_maps, t)
+                out.append([got] if got is not None else [])
+        except BaseException:
+            # an aborted materialization (query cancel/deadline, fetch
+            # failure) must not leave the lineage closure — which pins
+            # every map output batch — registered in the process-wide
+            # manager forever (found by tools/leak_sentinel.py)
+            mgr.unregister_recompute(shuffle_id)
+            mgr.cleanup(shuffle_id)
+            raise
         if not multi:
             mgr.cleanup(shuffle_id)
         else:

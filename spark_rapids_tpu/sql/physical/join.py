@@ -1007,6 +1007,17 @@ class NestedLoopJoinExec(BaseJoinExec):
             yield from self._join_batches(probe, build, tctx)
 
 
+def _release_catalog_handles(catalog, handles) -> None:
+    """weakref.finalize target (must not reference the finalized object):
+    drop the spill-catalog registrations a dead MaterializedExec owned.
+    ``remove`` is a no-op for handles already gone (catalog reset)."""
+    for h in handles:
+        try:
+            catalog.remove(h)
+        except Exception:  # pragma: no cover - teardown must never raise
+            pass
+
+
 class MaterializedExec(PhysicalPlan):
     """Leaf serving pre-computed batches per partition — the runtime-stats
     carrier AQE re-plans over (GpuCustomShuffleReaderExec's shuffle-stage
@@ -1021,7 +1032,9 @@ class MaterializedExec(PhysicalPlan):
         self._attrs = list(attrs)
         self._nbytes = 0
         if backend == TPU:
-            from ...memory.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY,
+            import weakref
+            from ...memory.spill import (BufferCatalog,
+                                         OUTPUT_FOR_SHUFFLE_PRIORITY,
                                          SpillableColumnarBatch,
                                          batch_device_bytes)
             self._nbytes = sum(batch_device_bytes(b)
@@ -1029,6 +1042,15 @@ class MaterializedExec(PhysicalPlan):
             self._parts = [[SpillableColumnarBatch.create(
                 b, OUTPUT_FOR_SHUFFLE_PRIORITY) for b in bs]
                 for bs in parts]
+            # the spillables live as long as this node (AQE may re-serve
+            # them to every probe partition), so their catalog handles
+            # are released when the PLAN dies — without this every
+            # adaptive join leaked its materialized build side until
+            # process exit (found by tools/leak_sentinel.py)
+            catalog = BufferCatalog.get()
+            handles = [sb._handle for bs in self._parts for sb in bs]
+            self._finalizer = weakref.finalize(
+                self, _release_catalog_handles, catalog, handles)
         else:
             self._parts = parts
 

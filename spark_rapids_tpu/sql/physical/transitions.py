@@ -60,22 +60,41 @@ def _staged(it, transfer, name: str):
         finally:
             _trace.pop_exec()
 
+    from ...serving import lifecycle as _lc
     with ThreadPoolExecutor(max_workers=1,
                             thread_name_prefix=f"srt-{name}") as stager:
         fut = None
         fut_in = None
-        for batch in it:
-            _ret.pin_batch(_carried(batch))
-            nxt = stager.submit(run, batch)
+        try:
+            for batch in it:
+                # lifecycle poll site `stager`: a cancelled query stops
+                # feeding transfers; the one in-flight transfer completes
+                # (bounded) and its pin is released in the finally below
+                _lc.check_cancel("stager")
+                _ret.pin_batch(_carried(batch))
+                nxt = stager.submit(run, batch)
+                if fut is not None:
+                    out = fut.result()
+                    prev_in, fut = fut_in, None
+                    _ret.unpin_batch(_carried(prev_in))
+                    yield out
+                fut, fut_in = nxt, batch
             if fut is not None:
                 out = fut.result()
-                _ret.unpin_batch(_carried(fut_in))
+                prev_in, fut = fut_in, None
+                _ret.unpin_batch(_carried(prev_in))
                 yield out
-            fut, fut_in = nxt, batch
-        if fut is not None:
-            out = fut.result()
-            _ret.unpin_batch(_carried(fut_in))
-            yield out
+        finally:
+            if fut is not None:
+                # cancel/error/early-close with a transfer still staged:
+                # wait it out (<= one transfer) and release the pin so
+                # retention accounting returns to baseline without the
+                # GC reaper; its own failure must not mask the original
+                try:
+                    fut.result()
+                except BaseException:  # noqa: BLE001 - original wins
+                    pass
+                _ret.unpin_batch(_carried(fut_in))
 
 
 class HostToDeviceExec(PhysicalPlan):

@@ -71,6 +71,10 @@ class TpuSession:
         #: compact tracer summary of the last traced query (sync count/ms,
         #: compile ms, bytes on the wire); None when tracing was off
         self.last_query_trace_summary: Optional[dict] = None
+        #: drain latency of the most recent cancelled/deadline-expired
+        #: query (cancel issue -> worker threads unwound), ms; None
+        #: until a cancellation happens (serving/lifecycle.py)
+        self.last_cancel_latency_ms: Optional[float] = None
         self._temp_views: dict = {}
         #: name -> implementation object (Hive UDF bridge; hiveUDFs.scala
         #: analog — populated by CREATE TEMPORARY FUNCTION or the API)
@@ -218,6 +222,7 @@ class TpuSession:
         prev_metrics = OM.METRICS["on"]
         PROFILING["on"] = profiling or tracing
         self._query_seq = getattr(self, "_query_seq", 0) + 1
+        qctx = self._new_query_ctx()
         if tracing:
             OT.get_tracer().reset(int(self._conf.get(TRACE_BUFFER_EVENTS)),
                                   session=self.session_id)
@@ -236,8 +241,10 @@ class TpuSession:
         err: Optional[BaseException] = None
         t0 = _time.perf_counter()
         try:
-            out = self._execute_traced(logical, device_to_arrow,
-                                       speculation)
+            from ..serving import lifecycle as _lc
+            with _lc.installed(qctx):
+                out = self._execute_traced(logical, device_to_arrow,
+                                           speculation)
             ok = True
             if rc_key is not None:
                 from ..serving import result_cache as RC
@@ -252,26 +259,31 @@ class TpuSession:
             OT.TRACING["on"] = prev_trace
             OM.METRICS["on"] = prev_metrics
             _faults.restore_arming(prev_chaos)
+            self._finish_query_ctx(qctx)
             self._finish_trace(tracing, sink, cache_stats0, rob0, ok,
                                aux0=aux0, duration_s=duration_s, err=err,
                                metrics_on=metrics_on)
 
     def _execute_serving(self, logical: P.LogicalPlan) -> pa.Table:
         """Serving-mode execution (docs/serving.md): result-cache
-        short-circuit, admission slot (weighted-fair + tenant budget),
-        thread-scoped tenant/session attribution on metrics and trace
-        spans, shared flight-recorder record — and NO per-query global
-        flag churn: tracing/profiling/metrics/chaos were armed once by
-        the owning ServingEngine, because N driver threads saving and
-        restoring process flags would race each other.
+        short-circuit, degraded-engine/quarantine gate, admission slot
+        (weighted-fair + tenant budget, cancellable), pressure-aware
+        plan degradation, thread-scoped tenant/session attribution on
+        metrics and trace spans, shared flight-recorder record — and NO
+        per-query global flag churn: tracing/profiling/metrics/chaos
+        were armed once by the owning ServingEngine, because N driver
+        threads saving and restoring process flags would race each
+        other.
 
         Per-query kernel-cache deltas are deliberately absent here
         (concurrent queries would smear each other's compiles); use the
         engine-scoped registry/cache_stats views instead."""
         import time as _time
         from ..columnar.convert import device_to_arrow
+        from ..memory.fatal import FatalDeviceError
         from ..observability import metrics as OM
         from ..observability import tracer as OT
+        from ..serving import lifecycle as _lc
         from .physical import speculation
         eng = self._serving
         tenant = self.tenant or "default"
@@ -284,16 +296,38 @@ class TpuSession:
             if hit is not None:
                 self._note_result_cache_hit(hit)
                 return hit
+        # poison-query gate: only computed when the engine is degraded
+        # or has live quarantine entries — the healthy path never pays
+        # for a fingerprint (docs/serving.md "query lifecycle")
+        qkey = None
+        if eng.is_degraded() or eng.quarantine.size():
+            qkey = _lc.quarantine_key(logical, self._conf)
+            eng.check_admittable(qkey)
         from ..serving.admission import estimate_query_bytes
         est = estimate_query_bytes(logical)
+        self._query_seq = getattr(self, "_query_seq", 0) + 1
+        # the lifecycle token exists BEFORE admission so a cancel fired
+        # while the query is still queued unblocks the admission wait
+        # (and rolls the tenant's WFQ virtual finish time back)
+        qctx = self._new_query_ctx()
         t_sub = _time.perf_counter()
-        ticket = eng.admission.acquire(tenant, est)
+        try:
+            ticket = eng.admission.acquire(tenant, est, cancel=qctx)
+        except BaseException:
+            self._finish_query_ctx(qctx)
+            raise
         wait_s = _time.perf_counter() - t_sub
         if OT.TRACING["on"] and wait_s > 1e-6:
             OT.get_tracer().complete("admission", f"admit.{tenant}",
                                      t_sub, wait_s, tenant=tenant,
                                      est_bytes=est)
-        self._query_seq = getattr(self, "_query_seq", 0) + 1
+        # pressure-aware graceful degradation: a saturated admission
+        # queue shrinks THIS query's plan (kill-switched; lifecycle.py)
+        conf = self._conf
+        pressure_over = eng.pressure.plan_overrides(eng.admission,
+                                                    self._conf)
+        if pressure_over:
+            conf = self._conf.copy(pressure_over)
         OT.set_thread_context(tenant=tenant, sid=self.session_id)
         if OM.METRICS["on"]:
             OM.get_registry().set_thread_labels(
@@ -303,9 +337,19 @@ class TpuSession:
         err: Optional[BaseException] = None
         t0 = _time.perf_counter()
         try:
-            out = self._execute_traced(logical, device_to_arrow,
-                                       speculation)
+            with _lc.installed(qctx):
+                out = self._execute_traced(logical, device_to_arrow,
+                                           speculation, conf=conf)
             ok = True
+        except FatalDeviceError as e:
+            # poison query: fail ONLY this query, quarantine its plan
+            # fingerprint, mark the engine degraded until a probe
+            # succeeds — sibling tenants' in-flight queries finish
+            err = e
+            eng.note_fatal(e, qkey
+                           or _lc.quarantine_key(logical, self._conf),
+                           tenant=tenant)
+            raise
         except BaseException as e:
             err = e
             raise
@@ -314,6 +358,7 @@ class TpuSession:
             OT.clear_thread_context()
             OM.get_registry().clear_thread_labels()
             eng.admission.release(ticket)
+            self._finish_query_ctx(qctx)
             self.last_query_trace_summary = None  # engine-scoped trace
             if ok:
                 m = self.last_query_metrics
@@ -321,6 +366,8 @@ class TpuSession:
                 m["tenant"] = tenant
                 m["admissionWaitMs"] = round(wait_s * 1e3, 3)
                 m["admissionEstBytes"] = est
+                if pressure_over:
+                    m["pressureDegraded"] = 1
             self._record_history(ok, duration_s, err)
             status = "ok" if ok else "failed"
             OM.observe("query_ms", duration_s * 1e3, status=status,
@@ -331,6 +378,55 @@ class TpuSession:
             from ..serving import result_cache as RC
             RC.store(rc_key, out)
         return out
+
+    # ------------------------------------------------------------------
+    # query lifecycle (serving/lifecycle.py, docs/robustness.md)
+    # ------------------------------------------------------------------
+    def _new_query_ctx(self):
+        """Create + register the lifecycle token for query
+        ``self._query_seq`` (cooperative cancellation + deadline)."""
+        from ..config import QUERY_CANCEL_POLL_SITES, QUERY_DEADLINE_MS
+        from ..serving import lifecycle as _lc
+        qctx = _lc.QueryContext(
+            self._query_seq, session_id=self.session_id,
+            tenant=self.tenant,
+            deadline_ms=int(self._conf.get(QUERY_DEADLINE_MS)),
+            poll_sites=_lc.parse_poll_sites(
+                self._conf.get(QUERY_CANCEL_POLL_SITES)))
+        _lc.register(qctx)
+        return qctx
+
+    def _finish_query_ctx(self, qctx) -> None:
+        """Unregister the token; when the query was cancelled (or hit
+        its deadline), bank the drain latency — cancel issue to worker
+        threads unwound — as the ``cancel_latency_ms`` series and a
+        ``cancel`` trace span (the bench `lifecycle` phase's p50/p99)."""
+        import time as _time
+        from ..observability import metrics as OM
+        from ..observability import tracer as OT
+        from ..serving import lifecycle as _lc
+        _lc.unregister(qctx)
+        if qctx.cancelled_at is None:
+            return
+        lat_s = _time.perf_counter() - qctx.cancelled_at
+        self.last_cancel_latency_ms = lat_s * 1e3
+        OM.observe("cancel_latency_ms", lat_s * 1e3,
+                   **({"tenant": self.tenant} if self.tenant else {}))
+        if OT.TRACING["on"]:
+            OT.get_tracer().complete(
+                "cancel", "query.drained", qctx.cancelled_at, lat_s,
+                query=qctx.query_id, reason=qctx.reason)
+
+    def cancel(self, query_id: Optional[int] = None,
+               reason: str = "cancelled by user") -> int:
+        """Cooperatively cancel this session's running query (or the
+        specific ``query_id``).  Worker threads observe the token at the
+        lifecycle poll sites and unwind within the poll bound, releasing
+        the device semaphore, retention pins and prefetch queues; the
+        waiting ``collect()`` raises :class:`QueryCancelled`.  Returns
+        how many live queries were cancelled (0 = nothing running)."""
+        from ..serving import lifecycle as _lc
+        return _lc.cancel_session(self.session_id, query_id, reason)
 
     def _note_result_cache_hit(self, table) -> None:
         """Epilogue for a result served from the cross-query cache: the
@@ -459,8 +555,13 @@ class TpuSession:
             pass
 
     def _execute_traced(self, logical: P.LogicalPlan, device_to_arrow,
-                        speculation) -> pa.Table:
-        planner = Planner(self._conf)
+                        speculation, conf: Optional[RapidsConf] = None
+                        ) -> pa.Table:
+        # conf defaults to the session's; the serving path passes a
+        # pressure-degraded copy (lifecycle.PressureSignal) so a
+        # saturated engine plans smaller without mutating session state
+        conf = conf or self._conf
+        planner = Planner(conf)
         phys = planner.plan_for_collect(logical)
         # collect has no side effects, so speculative results may be
         # validated AFTER the fetch (zero extra pulls); a mis-speculation
@@ -480,7 +581,7 @@ class TpuSession:
                 # always terminates with a validated result
                 speculation.set_deferral(attempt < 2)
                 try:
-                    batches = phys.execute_all(self._conf)
+                    batches = phys.execute_all(conf)
                 except Exception as e:
                     # with syncMode=auto a deferred execution-time OOM can
                     # surface at the D2H fetch, where the kernel guard

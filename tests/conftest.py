@@ -69,6 +69,12 @@ QUICK_MODULES = {
     # silent cross-tenant data corruption, an admission bug is silent
     # starvation
     "test_serving",
+    # query lifecycle (ISSUE 10): the cancellation race matrix
+    # (semaphore/retention/queue accounting at every poll site), the
+    # WFQ vft rollback, pressure degradation and the poison-query
+    # quarantine are tier-1 — a cancel leak is a slow engine death, a
+    # quarantine bug re-kills the device
+    "test_lifecycle",
 }
 
 

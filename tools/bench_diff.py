@@ -46,12 +46,17 @@ _LOWER_BETTER = ("sync_count", "sync_ms", "compile_ms", "compile_count",
                  "bytes_on_wire", "dispatches", "spill_ms", "sem_wait_ms",
                  "dropped_events", "h2d_bytes", "d2h_bytes", "seconds",
                  "_us", "p50", "p95", "p99", "latency", "wait_ms",
-                 "wall_s")
+                 "wall_s",
+                 # query-lifecycle records (docs/robustness.md): cancel
+                 # drain latency, deadline overshoot and quarantine
+                 # counts all improve DOWN
+                 "cancel_latency", "overshoot", "quarantine_count")
 #: keys that are identifiers/context, never diffed
 _SKIP = ("rows", "chips", "queries", "probe_attempts", "budget_ms",
          "elapsed_ms", "partial_banked_at", "pipeline_host_cores",
          "workload_queries", "parallelism", "tenants",
-         "distinct_queries", "serving_rows")
+         "distinct_queries", "serving_rows", "deadline_ms",
+         "cancels_measured", "degraded_queries")
 
 
 def load_artifact(path: str) -> Dict[str, Any]:

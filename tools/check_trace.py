@@ -35,7 +35,7 @@ KNOWN_PH = ("X", "C", "i", "M", "B", "E")
 #: and for --require-cat hints, not validated
 KNOWN_CATS = ("op", "kernel_compile", "sync", "h2d", "d2h", "spill",
               "shuffle", "sem_wait", "fault", "queue", "encode", "stage",
-              "admission")
+              "admission", "cancel", "fatal")
 
 
 def check(path: str, min_events: int = 1, require_cat: str = "",
