@@ -89,6 +89,39 @@ def _emit(**extra) -> None:
         out.update(extra)
         sys.stdout.write(json.dumps(out) + "\n")
         sys.stdout.flush()
+    _bank_telemetry()
+
+
+def _bank_telemetry() -> None:
+    """Bank a telemetry snapshot beside the capture when the watcher
+    asks for one (SRT_BENCH_TELEMETRY_DIR, set per bench mode by
+    tools/tunnel_watcher.sh): the run's /metrics exposition text and
+    the doctor's last ranked verdict, pid-stamped so orchestrator and
+    children never clobber each other.  Best-effort — a diagnostics
+    write must never take the measurement down."""
+    sink = os.environ.get("SRT_BENCH_TELEMETRY_DIR")
+    if not sink:
+        return
+    try:
+        os.makedirs(sink, exist_ok=True)
+        from spark_rapids_tpu.observability import doctor as OD
+        from spark_rapids_tpu.observability import tracer as OT
+        from spark_rapids_tpu.observability.metrics import get_registry
+        pid = os.getpid()
+        with open(os.path.join(sink, f"metrics-{pid}.prom"), "w") as f:
+            f.write(get_registry().prometheus_text())
+        tr = OT.get_tracer()
+        events = tr.snapshot()
+        if events:
+            meta = tr.meta()
+            doc = OD.diagnose(events, counters=meta.get("counters"),
+                              dropped_events=int(
+                                  meta.get("dropped_events", 0)))
+            with open(os.path.join(sink,
+                                   f"doctor-{pid}.json"), "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _bank_partial() -> None:

@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/16] native libraries ==="
+echo "=== [1/17] native libraries ==="
 make -C native
 
-echo "=== [2/16] API contract validation ==="
+echo "=== [2/17] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/16] docgen drift check ==="
+echo "=== [3/17] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/16] traced query + chrome-trace schema check ==="
+echo "=== [4/17] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,7 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/16] performance flight recorder: metrics + history + doctor + bench_diff ==="
+echo "=== [5/17] performance flight recorder: metrics + history + doctor + bench_diff ==="
 # ISSUE 8 acceptance: a traced query with the metrics registry and the
 # flight recorder enabled must produce (a) a Prometheus export that
 # passes the exposition-contract check, (b) a doctor diagnosis whose
@@ -112,7 +112,7 @@ if python tools/bench_diff.py "$SRT_FR_DIR/live.json" BENCH_r05.json \
     echo "ERROR: bench_diff failed to refuse live-vs-stale"; exit 1
 fi
 
-echo "=== [6/16] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [6/17] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -124,7 +124,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [7/16] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [7/17] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -138,7 +138,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [8/16] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+echo "=== [8/17] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
 # Encoded columnar execution (docs/encoded_columns.md) under seeded
 # faults AND the async pipeline matrix: the chaos session keeps
 # dictionary/RLE columns encoded through filters/joins/group-bys and
@@ -158,7 +158,7 @@ timeout 60 python tools/check_trace.py --require-cat encode \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     8000 --seed 11 --encoded
 
-echo "=== [9/16] whole-stage fusion: plan shape + donation chaos soak ==="
+echo "=== [9/17] whole-stage fusion: plan shape + donation chaos soak ==="
 # Whole-stage XLA compilation (docs/whole_stage.md): (a) the TPC-H-ish
 # suite's plans must contain fused whole-stage nodes — an aggregate
 # terminal (FusedStageExec wrapping the partial agg) and a probe-absorbed
@@ -215,7 +215,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat stage \
     "$SRT_WS_TRACE"
 
-echo "=== [10/16] multi-tenant serving: concurrent sessions smoke ==="
+echo "=== [10/17] multi-tenant serving: concurrent sessions smoke ==="
 # ISSUE 9 acceptance: N tenant sessions against one ServingEngine —
 # (a) weighted-fair admission: a heavy flood cannot starve a light
 # tenant (bounded wait, grant-order assertion at the controller);
@@ -308,7 +308,7 @@ timeout 60 python tools/check_trace.py --require-cat admission \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     10000 --seed 11 --multi-session
 
-echo "=== [11/16] query lifecycle: leak sentinel + cancel semantics ==="
+echo "=== [11/17] query lifecycle: leak sentinel + cancel semantics ==="
 # ISSUE 10 acceptance: (a) the bounded leak sentinel — 2 tenants of
 # mixed traffic with cancel races, per-query deadlines and fatal
 # injection armed — must bank a CLEAN verdict (retention pins, catalog
@@ -361,7 +361,157 @@ PYEOF
 timeout 60 python tools/check_trace.py --require-cat cancel \
     "$SRT_LC_DIR/cancel_trace.json"
 
-echo "=== [12/16] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [12/17] live telemetry plane: scrape + trace stitching over the shuffle wire ==="
+# ISSUE 12 acceptance: (a) the embedded telemetry server answers
+# /metrics (Prometheus contract with the tenant label, validated both
+# from the scraped body and live via check_trace --endpoint) and
+# /healthz WHILE tenant queries are in flight, and a degraded engine
+# flips /healthz to 503; (b) a genuine two-process traced shuffle read
+# leaves a requester fetch span in the driver's ring and a serve span
+# under the SAME trace id in the peer process's ring; trace_merge.py
+# merges the two event logs into one Perfetto trace whose
+# cross-process flow events pass check_trace --flow; (c) engine close
+# releases the port and the serve thread (leak-free).
+SRT_TP_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 600 python - "$SRT_TP_DIR" <<'PYEOF'
+import json, os, socket, subprocess, sys, threading
+import urllib.error, urllib.request
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.observability import tracer as OT
+from spark_rapids_tpu.observability.export import write_event_log
+from spark_rapids_tpu.serving import ServingEngine
+from spark_rapids_tpu.shuffle.manager import ShuffleManager
+from spark_rapids_tpu.shuffle.tcp import TcpHeartbeatServer
+from spark_rapids_tpu.sql import functions as F
+out = sys.argv[1]
+
+CHILD = r'''
+import sys
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.columnar.convert import arrow_to_device
+from spark_rapids_tpu.observability import tracer as OT
+from spark_rapids_tpu.observability.export import write_event_log
+from spark_rapids_tpu.shuffle.manager import ShuffleManager
+elog, driver = sys.argv[1], sys.argv[2]
+OT.get_tracer().reset(session="peer-proc")
+OT.TRACING["on"] = True
+conf = srt.RapidsConf.get_global().copy({
+    "spark.rapids.shuffle.mode": "ICI",
+    "spark.rapids.shuffle.transport.type": "TCP",
+    "spark.rapids.shuffle.tcp.native.enabled": False,
+    "spark.rapids.shuffle.tcp.driverEndpoint": driver,
+})
+m = ShuffleManager(conf, executor_id="peer-exec")
+rng = np.random.default_rng(7)
+t = pa.table({"k": rng.integers(0, 8, 512), "v": rng.random(512)})
+m.write_map_output(9, 0, [arrow_to_device(t)])
+print("READY", flush=True)
+sys.stdin.readline()   # parent fetched: dump the serve-side ring
+tr = OT.get_tracer()
+write_event_log(elog, tr.snapshot(), tr.meta())
+m.close()
+'''
+
+srv = TcpHeartbeatServer()
+child = subprocess.Popen(
+    [sys.executable, "-c", CHILD, os.path.join(out, "peer.jsonl"),
+     srv.endpoint],
+    stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+    env=dict(os.environ, JAX_PLATFORMS="cpu"))
+assert child.stdout.readline().strip() == "READY"
+
+eng = ServingEngine(**{
+    "spark.rapids.tpu.metrics.enabled": True,
+    "spark.rapids.tpu.profile.enabled": True,
+    "spark.rapids.tpu.telemetry.enabled": True,
+    "spark.rapids.tpu.telemetry.port": 0})
+host, port = eng.telemetry.host, eng.telemetry.port
+base = eng.telemetry.endpoint
+
+def get(route):
+    try:
+        with urllib.request.urlopen(base + route, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+# (a) scrape mid-workload: the main thread hits every route while the
+# worker still has tenant queries left to run
+sess = eng.session(tenant="t0")
+first_done = threading.Event()
+def work():
+    rng = np.random.default_rng(3)
+    for i in range(3):
+        df = sess.create_dataframe(pa.table(
+            {"k": rng.integers(0, 8, 20_000),
+             "x": rng.random(20_000)}), num_partitions=2)
+        assert (df.groupBy("k").agg(F.sum(F.col("x")).alias("sx"))
+                .orderBy("k")).collect().num_rows == 8
+        first_done.set()
+w = threading.Thread(target=work)
+w.start()
+assert first_done.wait(180)
+st, body = get("/metrics")
+assert st == 200 and "srt_" in body, (st, body[:200])
+with open(os.path.join(out, "scrape.prom"), "w") as fh:
+    fh.write(body)
+st, hz = get("/healthz")
+assert st == 200 and json.loads(hz)["status"] == "ok", (st, hz)
+for route in ("/queries", "/doctor", "/slo"):
+    st, b = get(route)
+    assert st == 200, (route, st, b[:200])
+    json.loads(b)
+sys.path.insert(0, "tools")
+import check_trace
+assert check_trace.main(["--endpoint", base + "/metrics"]) == 0
+w.join(180)
+
+# (b) two-process traced shuffle read through the engine-armed tracer
+conf = srt.RapidsConf.get_global().copy({
+    "spark.rapids.shuffle.mode": "ICI",
+    "spark.rapids.shuffle.transport.type": "TCP",
+    "spark.rapids.shuffle.tcp.native.enabled": False,
+    "spark.rapids.shuffle.tcp.driverEndpoint": srv.endpoint,
+})
+mp = ShuffleManager(conf, executor_id="driver-exec")
+got = mp.read_reduce_partition(9, num_maps=1, reduce_id=0)
+assert got is not None and got.num_rows_int == 512
+mp.close()
+tr = OT.get_tracer()
+evs = tr.snapshot()
+assert any(e["name"] == "shuffle.fetch.remote" for e in evs), \
+    sorted({e["name"] for e in evs})
+write_event_log(os.path.join(out, "driver.jsonl"), evs, tr.meta())
+child.stdin.write("done\n"); child.stdin.flush()
+assert child.wait(60) == 0
+
+# (c) degraded -> 503; close -> port free, serve thread gone
+eng.note_fatal(RuntimeError("injected for CI"), fingerprint="",
+               tenant="t0")
+st, hz = get("/healthz")
+assert st == 503 and json.loads(hz)["status"] == "degraded", (st, hz)
+eng.close()
+probe = socket.socket()
+probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+probe.bind((host, port))
+probe.close()
+assert not [t for t in threading.enumerate()
+            if t.name.startswith("srt-telemetry-")]
+srv.close()
+print("telemetry plane OK:", base)
+PYEOF
+timeout 60 python tools/check_trace.py \
+    --prometheus "$SRT_TP_DIR/scrape.prom" --prometheus-label tenant
+timeout 60 python tools/trace_merge.py "$SRT_TP_DIR/merged.json" \
+    "$SRT_TP_DIR/driver.jsonl" "$SRT_TP_DIR/peer.jsonl"
+timeout 60 python tools/check_trace.py --flow "$SRT_TP_DIR/merged.json" \
+    --min-events 2 "$SRT_TP_DIR/merged.json"
+
+echo "=== [13/17] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -382,14 +532,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [13/16] scale rig ==="
+    echo "=== [14/17] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [13/16] scale rig skipped (quick) ==="
+    echo "=== [14/17] scale rig skipped (quick) ==="
 fi
 
-echo "=== [14/16] packaging: wheel builds and installs ==="
+echo "=== [15/17] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -419,17 +569,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [15/16] driver entry checks ==="
+echo "=== [16/17] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [16/16] second-jax shim world skipped (quick) ==="
+    echo "=== [17/17] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [16/16] second-jax shim world (gated) ==="
+echo "=== [17/17] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

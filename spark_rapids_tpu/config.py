@@ -904,6 +904,46 @@ DEGRADED_PROBE_INTERVAL_MS = register(
     "degraded after a fatal device error; admissions arriving between "
     "probes are refused with EngineDegraded.", 1_000)
 
+# --- telemetry plane: scrape/health endpoint + SLO objectives ---------------
+TELEMETRY_ENABLED = register(
+    "spark.rapids.tpu.telemetry.enabled",
+    "Kill switch for the embedded telemetry HTTP server "
+    "(observability/server.py): a daemon-thread ThreadingHTTPServer "
+    "bound to 127.0.0.1 serving /metrics (Prometheus exposition), "
+    "/healthz (degraded/quarantine/admission/semaphore state, non-200 "
+    "when the engine is degraded), /queries (flight-recorder ring), "
+    "/doctor (last ranked verdicts) and /slo (per-tenant burn rates). "
+    "Owned by the ServingEngine when serving, else by the TpuSession; "
+    "shutdown is leak-free (no lingering thread or bound port).  Off "
+    "(default) starts nothing and changes no behavior.",
+    False, commonly_used=True)
+TELEMETRY_PORT = register(
+    "spark.rapids.tpu.telemetry.port",
+    "TCP port for the telemetry server; 0 (default) binds an ephemeral "
+    "port (read it back from engine.telemetry.port / "
+    "session.telemetry.port).", 0, commonly_used=True)
+SLO_LATENCY_MS = register(
+    "spark.rapids.tpu.slo.latencyObjectiveMs",
+    "Per-tenant latency objective: a query slower than this is a "
+    "'slow' event against the latency error budget (observability/"
+    "slo.py reads the per-tenant query_ms histograms).  0 (default) "
+    "disables the latency SLO leg.", 0.0, commonly_used=True)
+SLO_LATENCY_TARGET = register(
+    "spark.rapids.tpu.slo.latencyTarget",
+    "Fraction of queries that must meet the latency objective (the "
+    "latency error budget is 1 - target).", 0.99)
+SLO_ERROR_TARGET = register(
+    "spark.rapids.tpu.slo.availabilityTarget",
+    "Fraction of queries that must succeed (status=ok in "
+    "queries_total); the availability error budget is 1 - target.",
+    0.999)
+SLO_WINDOWS_S = register(
+    "spark.rapids.tpu.slo.burnWindowsS",
+    "Comma list of burn-rate window lengths in seconds, shortest "
+    "first; a tenant burning its error budget at rate >= 1 in the "
+    "shortest window is 'burning' (slo-burn doctor verdict).",
+    "300,3600", type_=str)
+
 # --- TPU-specific ----------------------------------------------------------
 BUCKET_MIN_ROWS = register(
     "spark.rapids.tpu.shapeBucket.minRows",

@@ -67,7 +67,11 @@ _CAT_TO_VERDICT = {
 
 VERDICTS = ("sync-bound", "compile-bound", "h2d-d2h-bound",
             "dispatch-bound", "sem_wait-bound", "spill-bound",
-            "shuffle-bound", "admission-bound")
+            "shuffle-bound", "admission-bound",
+            # a tenant consuming its declared SLO error budget faster
+            # than allotted (observability/slo.py names the tenant and
+            # its dominant bottleneck in the entry's evidence)
+            "slo-burn")
 
 #: per-launch overhead floor used to estimate dispatch-bound time when
 #: the trace cannot attribute it directly (Python dispatch + XLA launch;

@@ -1,0 +1,297 @@
+"""Per-tenant SLO objectives and multi-window burn-rate tracking.
+
+Objectives are declared in conf (``spark.rapids.tpu.slo.*``): a latency
+objective (queries slower than ``latencyObjectiveMs`` are *slow* events
+against a ``1 - latencyTarget`` error budget) and an availability
+objective (non-ok ``queries_total`` outcomes against a
+``1 - availabilityTarget`` budget).  The tracker is fed entirely from
+the metrics registry's per-tenant series — the ``query_ms`` histograms
+and ``queries_total{status}`` counters the serving session already
+emits — so it adds no new instrumentation to the query path.
+
+The registry is lifetime-cumulative, so the tracker owns the windowing:
+every :meth:`SloTracker.report` appends a timestamped cumulative
+snapshot to a bounded ring and computes, for each conf window (shortest
+first, e.g. 5m/1h), the delta-rate of bad events over that window
+divided by the error budget — the classic *burn rate*.  Burn >= 1 in
+the shortest window means the tenant is consuming its budget faster
+than allotted: the tenant is **burning**, surfaces in ``/slo``, and
+yields a ranked ``slo-burn`` doctor verdict naming the tenant and its
+dominant bottleneck (from the flight recorder's per-tenant diagnosis).
+
+Hook point: the ServingEngine wires :meth:`SloTracker.admission_hint`
+onto ``AdmissionController.slo_hook`` — the admission controller does
+not consult it yet, but a later PR can shed or deprioritize a burning
+tenant at the acquire site without new plumbing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from . import metrics as _metrics
+
+SCHEMA = "srt-slo/1"
+
+#: doctor category emitted for a burning tenant (registered in
+#: observability/doctor.py VERDICTS and tools/check_trace.py)
+SLO_BURN = "slo-burn"
+
+
+class SloObjectives:
+    """Declared objectives, resolved from conf once at tracker build."""
+
+    __slots__ = ("latency_ms", "latency_target", "error_target",
+                 "windows_s")
+
+    def __init__(self, latency_ms: float = 0.0,
+                 latency_target: float = 0.99,
+                 error_target: float = 0.999,
+                 windows_s: Optional[List[float]] = None):
+        self.latency_ms = float(latency_ms)
+        self.latency_target = min(float(latency_target), 1.0 - 1e-9)
+        self.error_target = min(float(error_target), 1.0 - 1e-9)
+        self.windows_s = sorted(windows_s or [300.0, 3600.0])
+
+    @classmethod
+    def from_conf(cls, conf) -> "SloObjectives":
+        from ..config import (SLO_ERROR_TARGET, SLO_LATENCY_MS,
+                              SLO_LATENCY_TARGET, SLO_WINDOWS_S)
+        windows = [float(w) for w in
+                   str(conf.get(SLO_WINDOWS_S)).split(",") if w.strip()]
+        return cls(latency_ms=float(conf.get(SLO_LATENCY_MS)),
+                   latency_target=float(conf.get(SLO_LATENCY_TARGET)),
+                   error_target=float(conf.get(SLO_ERROR_TARGET)),
+                   windows_s=windows or [300.0, 3600.0])
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"latencyObjectiveMs": self.latency_ms,
+                "latencyTarget": self.latency_target,
+                "availabilityTarget": self.error_target,
+                "windowsS": list(self.windows_s)}
+
+
+def _count_under(hist, bound_ms: float) -> float:
+    """Observations <= bound in a registry log2 histogram, linearly
+    interpolated within the straddling bucket (same estimator as
+    ``_Histogram.quantile``, inverted)."""
+    bounds = _metrics.BUCKET_BOUNDS
+    cum = 0.0
+    lo = 0.0
+    for i, n in enumerate(hist.buckets):
+        hi = bounds[i]
+        if hi <= bound_ms:
+            cum += n
+        elif lo < bound_ms:
+            cum += n * (bound_ms - lo) / (hi - lo)
+        else:
+            break
+        lo = hi
+    return cum
+
+
+class SloTracker:
+    """Bounded ring of cumulative per-tenant samples + burn computation.
+
+    Thread-safe; reads the registry under its lock, never blocks the
+    query path (the query path never calls in here — only scrapes,
+    doctor runs and the admission hook do).
+    """
+
+    #: plenty for days of scrape-driven sampling; entries older than the
+    #: longest window are pruned anyway
+    _MAX_SAMPLES = 4096
+
+    def __init__(self, objectives: SloObjectives,
+                 clock: Callable[[], float] = time.monotonic):
+        self.objectives = objectives
+        self._clock = clock
+        self._lock = threading.Lock()
+        # seed with an empty baseline at build time: the first report is
+        # a delta from "engine start", not an undefined window
+        self._samples: deque = deque([(clock(), {})],
+                                     maxlen=self._MAX_SAMPLES)
+        self._last_report: Optional[Dict[str, Any]] = None
+
+    # --- sampling ---------------------------------------------------------
+    def _snapshot(self, reg) -> Dict[str, Dict[str, float]]:
+        """Cumulative per-tenant counts from the registry (summed across
+        the query/session label dimensions)."""
+        per: Dict[str, Dict[str, float]] = {}
+
+        def row(tenant: str) -> Dict[str, float]:
+            return per.setdefault(tenant, {
+                "total": 0.0, "errors": 0.0,
+                "lat_count": 0.0, "lat_slow": 0.0, "lat_sum_ms": 0.0})
+
+        latency_ms = self.objectives.latency_ms
+        with reg._lock:
+            for (name, labels), v in reg._counters.items():
+                if name != "queries_total":
+                    continue
+                lab = dict(labels)
+                d = row(lab.get("tenant", ""))
+                d["total"] += v
+                if lab.get("status", "ok") != "ok":
+                    d["errors"] += v
+            for (name, labels), h in reg._hists.items():
+                if name != "query_ms":
+                    continue
+                lab = dict(labels)
+                d = row(lab.get("tenant", ""))
+                d["lat_count"] += h.count
+                d["lat_sum_ms"] += h.sum
+                if latency_ms > 0:
+                    d["lat_slow"] += h.count - _count_under(h, latency_ms)
+        return per
+
+    # --- burn computation -------------------------------------------------
+    def report(self, registry=None, now: Optional[float] = None
+               ) -> Dict[str, Any]:
+        """Sample the registry and return the burn report (srt-slo/1)."""
+        reg = registry or _metrics.get_registry()
+        t = self._clock() if now is None else now
+        cur = self._snapshot(reg)
+        with self._lock:
+            self._samples.append((t, cur))
+            horizon = t - max(self.objectives.windows_s) * 2
+            while len(self._samples) > 1 and self._samples[0][0] < horizon:
+                self._samples.popleft()
+            samples = list(self._samples)
+        tenants: Dict[str, Any] = {}
+        burning: List[str] = []
+        budget_err = 1.0 - self.objectives.error_target
+        budget_lat = 1.0 - self.objectives.latency_target
+        for tenant, c in sorted(cur.items()):
+            windows: Dict[str, Any] = {}
+            max_burn = 0.0
+            for w in self.objectives.windows_s:
+                # newest sample at or before the window's left edge; the
+                # seed baseline bounds the delta when history is short
+                old_t, old = samples[0]
+                for st, snap in samples:
+                    if st <= t - w:
+                        old_t, old = st, snap
+                    else:
+                        break
+                o = old.get(tenant, {})
+                d_total = c["total"] - o.get("total", 0.0)
+                d_err = c["errors"] - o.get("errors", 0.0)
+                d_lat = c["lat_count"] - o.get("lat_count", 0.0)
+                d_slow = c["lat_slow"] - o.get("lat_slow", 0.0)
+                err_rate = d_err / d_total if d_total > 0 else 0.0
+                slow_rate = d_slow / d_lat if d_lat > 0 else 0.0
+                err_burn = err_rate / budget_err
+                lat_burn = (slow_rate / budget_lat
+                            if self.objectives.latency_ms > 0 else 0.0)
+                max_burn = max(max_burn, err_burn, lat_burn)
+                windows[f"{int(w)}s"] = {
+                    "queries": round(d_total, 3),
+                    "error_rate": round(err_rate, 6),
+                    "error_burn": round(err_burn, 3),
+                    "slow_rate": round(slow_rate, 6),
+                    "latency_burn": round(lat_burn, 3),
+                    "covered_s": round(t - old_t, 3),
+                }
+            # burning = budget consumed faster than allotted in the
+            # SHORTEST window (the fast-burn page condition)
+            shortest = windows[f"{int(self.objectives.windows_s[0])}s"]
+            is_burning = max(shortest["error_burn"],
+                             shortest["latency_burn"]) >= 1.0
+            tenants[tenant] = {"windows": windows,
+                               "max_burn": round(max_burn, 3),
+                               "burning": is_burning,
+                               "bad_events": round(
+                                   c["errors"] + c["lat_slow"], 3),
+                               "lat_sum_ms": round(c["lat_sum_ms"], 3)}
+            if is_burning:
+                burning.append(tenant)
+        out = {"schema": SCHEMA,
+               "objectives": self.objectives.as_dict(),
+               "tenants": tenants,
+               "burning": burning}
+        with self._lock:
+            self._last_report = out
+        return out
+
+    # --- consumers --------------------------------------------------------
+    def admission_hint(self, tenant: str) -> Dict[str, Any]:
+        """Hook point for the admission controller (wired onto
+        ``AdmissionController.slo_hook``): cheap read of the last burn
+        report for one tenant — no registry scan on the acquire path."""
+        with self._lock:
+            rep = self._last_report
+        info = (rep or {}).get("tenants", {}).get(tenant)
+        if not info:
+            return {"burning": False, "max_burn": 0.0}
+        return {"burning": info["burning"], "max_burn": info["max_burn"]}
+
+    def doctor_verdict(self, registry=None,
+                       tenant_diagnoses: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        """A ranked srt-doctor/1 verdict over the burn report: one
+        ``slo-burn`` entry per burning tenant (sorted by the query
+        milliseconds spent violating the objective), naming the tenant
+        and its dominant bottleneck from the per-tenant diagnosis."""
+        rep = self.report(registry)
+        ranked = []
+        for tenant in rep["burning"]:
+            info = rep["tenants"][tenant]
+            shortest = next(iter(info["windows"].values()))
+            bad = info["bad_events"]
+            total = max(1.0, shortest["queries"])
+            bad_frac = min(1.0, max(shortest["error_rate"],
+                                    shortest["slow_rate"]))
+            dominant = ""
+            diag = (tenant_diagnoses or {}).get(tenant) or {}
+            dv = (diag.get("diagnosis") or {}).get("verdict") \
+                or diag.get("verdict")
+            if dv:
+                dominant = f"; dominant bottleneck: {dv}"
+            ranked.append({
+                "category": SLO_BURN,
+                # query milliseconds spent in violation (approx: tenant
+                # query time weighted by the bad fraction)
+                "ms": round(info["lat_sum_ms"] * bad_frac, 3),
+                "count": int(bad),
+                "share": round(bad_frac, 4),
+                "evidence": (
+                    f"tenant {tenant!r} burning error budget at "
+                    f"{info['max_burn']}x (shortest window: "
+                    f"error_burn {shortest['error_burn']}, latency_burn "
+                    f"{shortest['latency_burn']}, {shortest['queries']} "
+                    f"queries){dominant}"),
+                "tenant": tenant,
+            })
+        ranked.sort(key=lambda e: -e["ms"])
+        return {"schema": "srt-doctor/1",
+                "verdict": ranked[0]["category"] if ranked
+                else "no-bottleneck",
+                "ranked": ranked,
+                "trace_truncated": False,
+                "caveats": [] if ranked else
+                ["no tenant is burning its SLO budget"],
+                "slo": {"burning": rep["burning"],
+                        "objectives": rep["objectives"]}}
+
+
+# --------------------------------------------------------------------------
+# module singleton (one engine per process is the supported serving
+# configuration — docs/serving.md)
+# --------------------------------------------------------------------------
+
+_TRACKER: Optional[SloTracker] = None
+
+
+def configure(conf) -> SloTracker:
+    """(Re)build the process tracker from conf; returns it."""
+    global _TRACKER
+    _TRACKER = SloTracker(SloObjectives.from_conf(conf))
+    return _TRACKER
+
+
+def get_tracker() -> Optional[SloTracker]:
+    return _TRACKER

@@ -52,6 +52,8 @@ docs/observability.md.
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -127,6 +129,52 @@ def thread_tenant() -> str:
 
 
 # --------------------------------------------------------------------------
+# distributed trace context (cross-process stitching)
+# --------------------------------------------------------------------------
+
+_SPAN_SEQ = itertools.count(1)
+
+
+def next_span_id() -> str:
+    """Process-unique span id stamped on wire-crossing spans (shuffle
+    remote fetch/serve, frame serialization) so tools/trace_merge.py can
+    connect the two sides of a cross-process edge with a flow event."""
+    return f"{os.getpid():x}.{next(_SPAN_SEQ)}"
+
+
+def current_trace_context() -> Optional[Dict[str, Any]]:
+    """Trace context of the query running on THIS thread:
+    ``{trace, query, tenant}``.  Derived from the installed lifecycle
+    token (valid on shuffle reader-pool threads too — the manager
+    reinstalls the query context there), falling back to the tracer's
+    session label for untracked callers.  None when tracing is off."""
+    if not TRACING["on"]:
+        return None
+    from ..serving import lifecycle as _lc  # deferred: avoid import cycle
+    q = _lc.current()
+    if q is not None:
+        return {"trace": f"{q.session_id}:q{q.query_id}",
+                "query": q.query_id,
+                "tenant": q.tenant or thread_tenant()}
+    sid = getattr(_tls, "sid", "") or _TRACER.session_label
+    return {"trace": sid or f"pid-{os.getpid()}", "query": 0,
+            "tenant": thread_tenant()}
+
+
+def set_fetch_trace(ctx: Optional[Dict[str, Any]]) -> None:
+    """Install the trace context the transport should propagate on the
+    next shuffle fetch from THIS thread (shuffle/manager.py sets it
+    around ``transport.fetch``; shuffle/tcp.py reads it).  Riding a
+    thread-local keeps the ShuffleTransport SPI ``fetch(peer, block)``
+    signature unchanged, so duck-typed test transports keep working."""
+    _tls.fetch_trace = ctx
+
+
+def fetch_trace() -> Optional[Dict[str, Any]]:
+    return getattr(_tls, "fetch_trace", None)
+
+
+# --------------------------------------------------------------------------
 # the tracer
 # --------------------------------------------------------------------------
 
@@ -138,6 +186,10 @@ class QueryTracer:
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max(16, int(capacity)))
         self.dropped_events = 0
+        #: high-water value last pushed to the metrics registry gauge —
+        #: the feed is strided (every 1024 events) so the scrape surface
+        #: sees ring fill without one registry write per span
+        self._hw_reported = 0
         #: most events the ring ever held this query — with
         #: dropped_events, the evidence that a truncated trace cannot
         #: silently skew doctor attribution (high_water == capacity and
@@ -162,11 +214,14 @@ class QueryTracer:
                 self._events.clear()
             self.dropped_events = 0
             self.high_water = 0
+            self._hw_reported = 0
             if session is not None:
                 self.session_label = str(session)
             self.counters = {}
             self._epoch = time.perf_counter()
             self._epoch_wall = time.time()
+        if _metrics.METRICS["on"]:
+            _metrics.get_registry().set_gauge("trace_ring_high_water", 0)
 
     @property
     def capacity(self) -> int:
@@ -194,17 +249,32 @@ class QueryTracer:
         if args:
             ev["args"] = args
         with self._lock:
-            if len(self._events) == self._events.maxlen:
+            dropped = len(self._events) == self._events.maxlen
+            if dropped:
                 self.dropped_events += 1
             self._events.append(ev)
             if len(self._events) > self.high_water:
                 self.high_water = len(self._events)
+            report_hw = 0
+            if self._hw_reported == 0 \
+                    or self.high_water >= self._hw_reported + 1024 \
+                    or (dropped and self._hw_reported < self.high_water):
+                # a full ring always reports at the true high-water:
+                # the scraper must see "at capacity" the moment events
+                # start dropping, not a stride later
+                report_hw = self._hw_reported = self.high_water
         # registry feed: per-category latency distribution, exec-labeled
-        # (one dict lookup when the registry is off)
+        # (one dict lookup when the registry is off); ring health rides
+        # along so a scrape sees trace truncation without a query
+        # epilogue (gauge strided; the drop counter is exact)
         if _metrics.METRICS["on"]:
-            _metrics.get_registry().observe(
-                "trace_span_ms", max(dur_s, 0.0) * 1e3,
-                cat=cat, exec=ev["exec"] or "(driver)")
+            reg = _metrics.get_registry()
+            reg.observe("trace_span_ms", max(dur_s, 0.0) * 1e3,
+                        cat=cat, exec=ev["exec"] or "(driver)")
+            if dropped:
+                reg.inc("trace_dropped_events_total")
+            if report_hw:
+                reg.set_gauge("trace_ring_high_water", report_hw)
 
     def counter(self, name: str, value: float = 1.0) -> None:
         """Accumulate a named aggregate counter (no per-event storage)."""

@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..observability import metrics as _om
 
@@ -108,6 +108,12 @@ class AdmissionController:
         #: rolling cross-tenant wait window feeding pressure_snapshot()
         self._recent_waits: List[float] = []
         self.stats = {"admitted": 0, "timeouts": 0, "peak_queued": 0}
+        #: SLO hook point (observability/slo.py): the ServingEngine wires
+        #: ``SloTracker.admission_hint`` here — ``slo_hook(tenant)`` ->
+        #: ``{"burning": bool, "max_burn": float}``.  Not consulted by
+        #: acquire() yet; a later PR can shed or deprioritize a burning
+        #: tenant at this seam without new plumbing.
+        self.slo_hook: Optional[Callable[[str], Dict[str, Any]]] = None
 
     @classmethod
     def from_conf(cls, conf) -> "AdmissionController":

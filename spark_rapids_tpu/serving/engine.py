@@ -111,6 +111,24 @@ class ServingEngine:
         PROFILING["on"] = profiling or self._tracing
         OT.TRACING["on"] = self._tracing
         OM.METRICS["on"] = metrics_on
+        # --- telemetry plane (observability/server.py + slo.py) ----------
+        # SLO objectives always get a tracker (cheap; /slo and the
+        # slo-burn doctor read it), and the admission controller gets the
+        # hook point it may consult in a later PR
+        from ..observability import slo as OSLO
+        self.slo = OSLO.configure(self._conf)
+        self.admission.slo_hook = self.slo.admission_hint
+        self.telemetry = None
+        from ..config import TELEMETRY_ENABLED, TELEMETRY_PORT
+        if bool(self._conf.get(TELEMETRY_ENABLED)):
+            from ..observability.server import TelemetryServer
+            self.telemetry = TelemetryServer(
+                metrics_text=self.metrics_prometheus,
+                healthz=self._healthz,
+                queries=self.query_history,
+                doctor=self._doctor_payload,
+                slo=lambda: self.slo.report(),
+                port=int(self._conf.get(TELEMETRY_PORT)))
 
     # --- sessions -----------------------------------------------------------
     def session(self, tenant: str = "default", **conf_overrides):
@@ -119,10 +137,13 @@ class ServingEngine:
         process-scoped cache)."""
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
-        from ..config import SERVING_TENANT
+        from ..config import SERVING_TENANT, TELEMETRY_ENABLED
         from ..sql.session import TpuSession
         overrides = dict(conf_overrides)
         overrides[SERVING_TENANT.key] = tenant
+        # the engine owns the one telemetry server; tenant sessions must
+        # not each spin their own off the inherited engine conf
+        overrides.setdefault(TELEMETRY_ENABLED.key, False)
         sess = TpuSession(self._conf.copy(overrides))
         sess._serving = self
         sess._history = self.history
@@ -142,6 +163,9 @@ class ServingEngine:
         from ..observability import tracer as OT
         from ..robustness import faults as _faults
         from ..sql.physical.base import PROFILING
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
         with self._lock:
             for s in self._sessions:
                 s._serving = None
@@ -258,6 +282,45 @@ class ServingEngine:
 
     def admission_stats(self) -> Dict[str, Any]:
         return self.admission.snapshot()
+
+    def slo_report(self) -> Dict[str, Any]:
+        """Per-tenant multi-window SLO burn rates (observability/slo.py)."""
+        return self.slo.report()
+
+    # --- telemetry-server sources -------------------------------------------
+    def _healthz(self):
+        """(healthy, payload) for the /healthz route: degraded state,
+        quarantine size, admission queue depth and device-semaphore
+        saturation — a load balancer drains on the 503 alone."""
+        from ..memory.semaphore import TpuSemaphore
+        adm = self.admission.snapshot()
+        sem = TpuSemaphore.get()
+        active = sem.active_tasks()
+        degraded = self.is_degraded()
+        payload = {
+            "status": "degraded" if degraded else "ok",
+            "engine": self.engine_id,
+            "degraded_reason": self._degraded,
+            "quarantine_entries": self.quarantine.size(),
+            "admission": {"queued": adm.get("queued", 0),
+                          "running": adm.get("running", 0),
+                          "max_concurrent": adm.get("max_concurrent", 0)},
+            "semaphore": {"active": active, "permits": sem.permits,
+                          "saturation": round(
+                              active / max(1, sem.permits), 4)},
+        }
+        return (not degraded), payload
+
+    def _doctor_payload(self) -> Dict[str, Any]:
+        """Last ranked verdicts for the /doctor route: the most recent
+        per-query diagnosis, the per-tenant fleet view, and the SLO burn
+        verdict (which names any burning tenant)."""
+        from ..observability import doctor as OD
+        tenants = self.diagnose_tenants()
+        return {"last": OD.LAST_VERDICT,
+                "tenants": tenants,
+                "slo": self.slo.doctor_verdict(
+                    tenant_diagnoses=tenants)}
 
     def metrics_snapshot(self) -> dict:
         from ..observability.metrics import get_registry

@@ -379,7 +379,7 @@ class ShuffleManager:
             try:
                 _faults.maybe_inject("peer.death", exc=ShuffleFetchFailed,
                                      peer=peer.executor_id)
-                frame = self.transport.fetch(peer, block)
+                frame = self._remote_fetch(peer, block)
             except (ConnectionError, OSError) as e:
                 errors.append(e)
                 if self._blacklist.record_failure(peer.executor_id):
@@ -400,6 +400,34 @@ class ShuffleManager:
                 f"last: {type(errors[-1]).__name__}: {errors[-1]}"
             ) from errors[-1]
         return None
+
+    def _remote_fetch(self, peer, block: BlockId) -> Optional[bytes]:
+        """One peer fetch, wrapped in the requester-side distributed
+        trace edge: a ``shuffle.fetch.remote`` span carrying a fresh
+        span id, with the same context installed as the thread's fetch
+        trace so a trace-capable transport (shuffle/tcp.py) propagates
+        it to the serving peer — the peer's ``shuffle.serve`` span
+        records this span id as its ``parent_span``, and
+        tools/trace_merge.py connects the two with a flow event."""
+        if not _trace.TRACING["on"]:
+            return self.transport.fetch(peer, block)
+        tctx = _trace.current_trace_context() or {}
+        span_id = _trace.next_span_id()
+        ctx = dict(tctx, span=span_id)
+        frame = None
+        t0 = time.perf_counter()
+        _trace.set_fetch_trace(ctx)
+        try:
+            frame = self.transport.fetch(peer, block)
+            return frame
+        finally:
+            _trace.set_fetch_trace(None)
+            _trace.get_tracer().complete(
+                "shuffle", "shuffle.fetch.remote", t0,
+                time.perf_counter() - t0,
+                peer=peer.executor_id, block=str(block),
+                trace_id=str(ctx.get("trace", "")), span_id=span_id,
+                bytes=len(frame) if frame is not None else 0)
 
     # --- lost-block recompute -------------------------------------------
     def register_recompute(self, shuffle_id: int,

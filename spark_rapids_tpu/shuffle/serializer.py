@@ -217,12 +217,19 @@ def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
     # thread-local wire accounting: exact per-frame delta even when pool
     # threads serialize other frames concurrently
     tok = E.begin_wire_account()
-    frame = _serialize_batch(batch, conf)
+    # the wire span id is stamped both into the frame's schema json and
+    # onto this span, so the consumer's deserialize span (which surfaces
+    # the frame's producer_span) can be flow-connected back to here
+    tctx = _trace.current_trace_context() if tracing else None
+    wire_span = _trace.next_span_id() if tctx else ""
+    frame = _serialize_batch(batch, conf, wire_span=wire_span)
     saved = E.end_wire_account(tok)
     if tracing:
+        extra = ({"trace_id": tctx.get("trace", ""),
+                  "span_id": wire_span} if wire_span else {})
         _trace.get_tracer().complete(
             "shuffle", "serialize_batch", t0, time.perf_counter() - t0,
-            bytes=len(frame), rows=batch.num_rows_int)
+            bytes=len(frame), rows=batch.num_rows_int, **extra)
     # per-query wire accounting (last_query_metrics): actual frame bytes
     # plus the encoded representation's saving vs raw value buffers
     from ..sql.physical.base import TaskContext
@@ -239,7 +246,8 @@ def serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
     return frame
 
 
-def _serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
+def _serialize_batch(batch: ColumnarBatch, conf=None,
+                     wire_span: str = "") -> bytes:
     # one transfer for all buffers, with device-side narrowing when the
     # batch is big enough to pay for the probe (columnar/prepack.py —
     # bytes shrink BEFORE they cross the tunnel, nvcomp-codec analog)
@@ -257,6 +265,19 @@ def _serialize_batch(batch: ColumnarBatch, conf=None) -> bytes:
         "metas": metas,
         "specs": [_spec_of(c.dtype) for c in batch.columns],
     }
+    # versioned header extension: the producer's distributed trace
+    # context rides the schema json.  Readers only consume the
+    # names/metas/specs keys, so pre-extension peers ignore it without a
+    # layout version bump; trace-aware readers surface it on their
+    # deserialize span (producer_trace/producer_span), letting
+    # tools/trace_merge.py connect frame producer and consumer across
+    # processes.
+    if _trace.TRACING["on"]:
+        tctx = _trace.current_trace_context()
+        if tctx and tctx.get("trace"):
+            schema["trace"] = {"trace": tctx["trace"],
+                               "span": wire_span or _trace.next_span_id(),
+                               "tenant": tctx.get("tenant", "")}
     sj = json.dumps(schema).encode()
     payload = body.getvalue()
     flags = 0
@@ -442,11 +463,26 @@ def _deserialize_column(buf: memoryview, pos: int, dt: T.DataType, n: int,
 
 def deserialize_batch(frame: bytes, capacity: Optional[int] = None
                      ) -> ColumnarBatch:
-    with _trace.span("shuffle", "deserialize_batch", bytes=len(frame)):
+    if not _trace.TRACING["on"]:
         return _deserialize_batch(frame, capacity)
+    # surface the frame's embedded producer trace context on the
+    # consumer span (producer_trace/producer_span) — the cross-process
+    # edge trace_merge.py stitches for frames that moved between event
+    # logs
+    t0 = time.perf_counter()
+    trace_out: list = []
+    batch = _deserialize_batch(frame, capacity, trace_out=trace_out)
+    args = {"bytes": len(frame)}
+    if trace_out:
+        args.update(producer_trace=str(trace_out[0].get("trace", "")),
+                    producer_span=str(trace_out[0].get("span", "")))
+    _trace.get_tracer().complete("shuffle", "deserialize_batch", t0,
+                                 time.perf_counter() - t0, **args)
+    return batch
 
 
-def _deserialize_batch(frame: bytes, capacity: Optional[int] = None
+def _deserialize_batch(frame: bytes, capacity: Optional[int] = None,
+                       trace_out: Optional[list] = None
                        ) -> ColumnarBatch:
     if len(frame) < 20:
         raise FrameCorrupt(f"shuffle frame truncated ({len(frame)} bytes)")
@@ -469,6 +505,8 @@ def _deserialize_batch(frame: bytes, capacity: Optional[int] = None
         import zstandard
         raw = zstandard.ZstdDecompressor().decompress(raw)
     schema = json.loads(raw[:sj_len])
+    if trace_out is not None and isinstance(schema.get("trace"), dict):
+        trace_out.append(schema["trace"])
     buf = memoryview(raw)[sj_len:]
     cap = capacity or bucket_capacity(n)
     cols = []

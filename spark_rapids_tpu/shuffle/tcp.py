@@ -17,6 +17,18 @@ Wire protocol (all big-endian):
 * registry ops: request ``magic u32 | op u8 | len u32 | json``;
   response ``len u32 | json`` (peer list).  One driver process serves the
   registry; executors register their (executor_id, host:port) and poll.
+* traced fetch (op 4, versioned extension): request uses the registry-op
+  framing with a json body ``{"block": [s, m, r], "from": executor,
+  "trace": {...}}`` carrying the requester's distributed trace context;
+  response ``len u32 | json head | payload`` where the head is
+  ``{"status", "len", "serve_span"}``.  A pre-extension peer parses the
+  request safely via the registry framing and answers ``{"error": ...}``
+  — the client then marks that endpoint trace-incapable and falls back
+  to the plain fetch op on the same pooled connection, so old and new
+  peers interoperate in both directions.  The serving side records a
+  ``shuffle.serve`` span under the inbound trace id in its local ring,
+  which tools/trace_merge.py later stitches to the requester's fetch
+  span with a flow event.
 """
 
 from __future__ import annotations
@@ -28,6 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..observability import tracer as _trace
 from ..robustness import faults as _faults
 from .transport import (BlockId, PeerInfo, ShuffleFetchFailed,
                         ShuffleTransport)
@@ -36,6 +49,11 @@ _MAGIC = 0x53525054  # "SRPT"
 _OP_FETCH = 1
 _OP_REGISTER = 2
 _OP_HEARTBEAT = 3
+_OP_FETCH_TRACED = 4  # registry-op framing + json-head response
+
+#: sentinel: the peer answered the traced op with an error (pre-trace
+#: build) — retry the same socket with the plain fetch op
+_TRACE_UNSUPPORTED = object()
 
 _REQ = struct.Struct(">IBqqq")
 _RESP_HEAD = struct.Struct(">BQ")
@@ -115,11 +133,16 @@ class _Server:
                         else:
                             conn.sendall(_RESP_HEAD.pack(_FOUND, len(payload))
                                          + payload)
-                    else:  # registry op: a carries the json length
+                    else:  # registry-style op: a carries the json length
                         body = _recv_exact(conn, a)
                         out = self._handler(op, None, json.loads(body))
+                        payload = b""
+                        if isinstance(out, tuple):
+                            # traced fetch: (json head, raw payload)
+                            out, payload = out[0], out[1] or b""
                         blob = json.dumps(out).encode()
-                        conn.sendall(_JSON_RESP.pack(len(blob)) + blob)
+                        conn.sendall(_JSON_RESP.pack(len(blob)) + blob
+                                     + payload)
         except (ConnectionError, OSError):
             return
 
@@ -149,17 +172,52 @@ class TcpShuffleTransport(ShuffleTransport):
             connect_timeout_s, read_timeout_s)
         # request-response pairs must not interleave on a pooled socket
         self._endpoint_locks: Dict[str, threading.Lock] = {}
+        # endpoints that answered the traced fetch op with an error
+        # (pre-trace peers): use the plain op there from then on
+        self._no_trace: Dict[str, bool] = {}
 
     @property
     def endpoint(self) -> str:
         return self._server.endpoint
 
     # --- server side ------------------------------------------------------
-    def _handle(self, op: int, block: Optional[BlockId], _js):
+    def _handle(self, op: int, block: Optional[BlockId], js):
+        if op == _OP_FETCH_TRACED and js is not None:
+            return self._handle_traced(js)
         if op != _OP_FETCH:
             return {"error": "not a registry endpoint"}
         with self._lock:
             return self._store.get(block)
+
+    def _handle_traced(self, js):
+        """Serve a fetch that carries the requester's trace context:
+        record this service as a ``shuffle.serve`` span under the
+        INBOUND trace id in the local ring (the requester's span id as
+        ``parent_span``), so the two process-local event logs can be
+        stitched into one trace by tools/trace_merge.py."""
+        t0 = time.perf_counter()
+        try:
+            block = BlockId(*(int(x) for x in js["block"]))
+        except (KeyError, TypeError, ValueError):
+            return {"error": "bad traced fetch request"}
+        with self._lock:
+            payload = self._store.get(block)
+        head = {"status": "found" if payload is not None else "missing",
+                "len": len(payload or b"")}
+        if _trace.TRACING["on"]:
+            tctx = js.get("trace") or {}
+            serve_span = _trace.next_span_id()
+            head["serve_span"] = serve_span
+            _trace.get_tracer().complete(
+                "shuffle", "shuffle.serve", t0,
+                time.perf_counter() - t0, exec_="(shuffle-server)",
+                block=str(block), requester=str(js.get("from", "")),
+                trace_id=str(tctx.get("trace", "")),
+                parent_span=str(tctx.get("span", "")),
+                span_id=serve_span,
+                tenant=str(tctx.get("tenant", "")),
+                bytes=len(payload or b""))
+        return head, payload or b""
 
     # --- SPI --------------------------------------------------------------
     def publish(self, executor_id: str, block: BlockId, frame: bytes) -> None:
@@ -180,12 +238,20 @@ class TcpShuffleTransport(ShuffleTransport):
         with self._conn_lock:
             ep_lock = self._endpoint_locks.setdefault(peer.endpoint,
                                                       threading.Lock())
+        tctx = _trace.fetch_trace() if _trace.TRACING["on"] else None
         with ep_lock:
             for attempt in (0, 1):  # one reconnect on a stale pooled socket
                 sock = self._connection(peer.endpoint, fresh=attempt > 0)
                 if sock is None:
                     continue
                 try:
+                    if tctx is not None \
+                            and peer.endpoint not in self._no_trace:
+                        got = self._fetch_traced(sock, peer, block, tctx)
+                        if got is not _TRACE_UNSUPPORTED:
+                            return got
+                        # pre-trace peer: fall through to the plain op
+                        # on the same pooled connection
                     sock.sendall(_REQ.pack(_MAGIC, _OP_FETCH,
                                            block.shuffle_id, block.map_id,
                                            block.reduce_id))
@@ -199,6 +265,25 @@ class TcpShuffleTransport(ShuffleTransport):
         raise ShuffleFetchFailed(
             f"cannot fetch block {block} from {peer.executor_id} "
             f"({peer.endpoint})")
+
+    def _fetch_traced(self, sock: socket.socket, peer: PeerInfo,
+                      block: BlockId, tctx: dict):
+        """One traced fetch over an established socket; returns the
+        frame/None like :meth:`fetch`, or ``_TRACE_UNSUPPORTED`` when
+        the peer predates the extension (caller retries plain)."""
+        body = json.dumps({
+            "block": [block.shuffle_id, block.map_id, block.reduce_id],
+            "from": self.executor_id, "trace": tctx}).encode()
+        sock.sendall(_REQ.pack(_MAGIC, _OP_FETCH_TRACED, len(body), 0, 0)
+                     + body)
+        (n,) = _JSON_RESP.unpack(_recv_exact(sock, _JSON_RESP.size))
+        head = json.loads(_recv_exact(sock, n))
+        if "error" in head:
+            self._no_trace[peer.endpoint] = True
+            return _TRACE_UNSUPPORTED
+        if head.get("status") == "missing":
+            return None
+        return _recv_exact(sock, int(head.get("len", 0)))
 
     # --- connection pool --------------------------------------------------
     def _connection(self, endpoint: str, fresh: bool = False

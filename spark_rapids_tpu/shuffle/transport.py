@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..observability import tracer as _trace
 from ..robustness import faults as _faults
 
 
@@ -143,8 +144,24 @@ class LocalTransport(ShuffleTransport):
             hooked = self.fetch_hook(peer, block)
             if hooked is not None:
                 return hooked
+        t0 = time.perf_counter()
         with self._lock:
-            return self._store.get((peer.executor_id, block))
+            frame = self._store.get((peer.executor_id, block))
+        # single-process parity with the TCP transport's traced fetch:
+        # record the serve side under the inbound trace context so the
+        # stitching path (manager fetch span -> serve span flow) is
+        # exercised without sockets
+        tctx = _trace.fetch_trace() if _trace.TRACING["on"] else None
+        if tctx is not None:
+            _trace.get_tracer().complete(
+                "shuffle", "shuffle.serve", t0,
+                time.perf_counter() - t0, exec_="(shuffle-server)",
+                block=str(block), requester=peer.executor_id,
+                trace_id=str(tctx.get("trace", "")),
+                parent_span=str(tctx.get("span", "")),
+                span_id=_trace.next_span_id(),
+                bytes=len(frame) if frame is not None else 0)
+        return frame
 
     def blocks_of(self, executor_id: str) -> List[BlockId]:
         with self._lock:

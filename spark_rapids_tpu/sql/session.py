@@ -94,6 +94,15 @@ class TpuSession:
         #: owning ServingEngine when this session runs in serving mode
         #: (set by ServingEngine.session); None = classic single-driver
         self._serving = None
+        #: embedded telemetry server (observability/server.py) when
+        #: spark.rapids.tpu.telemetry.enabled and this session is NOT
+        #: under a ServingEngine (the engine owns the plane there and
+        #: forces the conf off for its sessions); stop with
+        #: :meth:`close_telemetry` — leak-free by contract
+        self.telemetry = None
+        from ..config import TELEMETRY_ENABLED
+        if bool(self._conf.get(TELEMETRY_ENABLED)):
+            self._start_telemetry()
 
     # ------------------------------------------------------------------
     @classmethod
@@ -698,6 +707,49 @@ class TpuSession:
                            wall_ms=wall,
                            dropped_events=int(
                                meta.get("dropped_events", 0)))
+
+    # --- telemetry plane (observability/server.py) --------------------
+    def _start_telemetry(self) -> None:
+        from ..config import TELEMETRY_PORT
+        from ..observability import slo as OSLO
+        from ..observability.server import TelemetryServer
+        tracker = OSLO.configure(self._conf)
+        self.telemetry = TelemetryServer(
+            metrics_text=self.metrics_prometheus,
+            healthz=self._telemetry_healthz,
+            queries=self.query_history,
+            doctor=self._telemetry_doctor,
+            slo=lambda: tracker.report(),
+            port=int(self._conf.get(TELEMETRY_PORT)))
+
+    def close_telemetry(self) -> None:
+        """Stop this session's embedded telemetry server (no-op when it
+        never started); leak-free — the serve thread joins and the port
+        rebinds."""
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
+
+    def _telemetry_healthz(self):
+        """(healthy, payload) for a classic session: no engine, so no
+        degraded state — liveness plus semaphore saturation."""
+        from ..memory.semaphore import TpuSemaphore
+        sem = TpuSemaphore.get()
+        active = sem.active_tasks()
+        return True, {
+            "status": "ok", "session": self.session_id,
+            "semaphore": {"active": active, "permits": sem.permits,
+                          "saturation": round(
+                              active / max(1, sem.permits), 4)},
+        }
+
+    def _telemetry_doctor(self):
+        from ..observability import doctor as OD
+        try:
+            return {"last": OD.LAST_VERDICT,
+                    "query": self.diagnose_last_query()}
+        except RuntimeError as e:
+            return {"last": OD.LAST_VERDICT, "note": str(e)}
 
     def explain(self, df: DataFrame, all_ops: bool = True) -> str:
         """Placement report (spark.rapids.sql.explain=ALL equivalent) plus

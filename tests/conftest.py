@@ -75,6 +75,10 @@ QUICK_MODULES = {
     # quarantine are tier-1 — a cancel leak is a slow engine death, a
     # quarantine bug re-kills the device
     "test_lifecycle",
+    # the telemetry plane is pure-stdlib and loopback-local (embedded
+    # HTTP server, SLO arithmetic, wire trace stitching) — fast, and a
+    # regression here blinds every production scrape target
+    "test_telemetry",
 }
 
 

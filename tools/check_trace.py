@@ -8,7 +8,7 @@ step and by tests/test_tracer.py.
 Usage: python tools/check_trace.py [<trace.json> ...] [--min-events N]
            [--require-cat CAT] [--require-arg KEY]
            [--prometheus FILE] [--prometheus-label KEY]
-           [--doctor FILE]
+           [--doctor FILE] [--flow FILE] [--endpoint URL]
 ``--require-cat`` additionally fails unless at least one span event
 carries that category (e.g. ``fault`` for chaos-soak traces).
 ``--require-arg`` fails unless at least one span event carries that
@@ -21,6 +21,13 @@ buckets ending at +Inf, consistent _sum/_count).
 ``--doctor`` validates a doctor diagnosis JSON against the
 srt-doctor/1 schema (known verdict, ranked entries with
 category/ms/share/evidence).
+``--flow`` validates a merged trace (tools/trace_merge.py output):
+every flow id must have both an "s" start and an "f" finish, each
+anchored inside a real span on the same pid/tid, and every pid with
+spans must carry process_name metadata.
+``--endpoint`` scrapes a live telemetry server's /metrics URL
+(observability/server.py) and runs the Prometheus contract on the
+response body instead of a file.
 Exit 0 when every requested check passes, 1 otherwise.
 """
 
@@ -28,7 +35,7 @@ import json
 import sys
 
 REQUIRED = ("ph", "ts", "pid", "tid", "name")
-KNOWN_PH = ("X", "C", "i", "M", "B", "E")
+KNOWN_PH = ("X", "C", "i", "M", "B", "E", "s", "t", "f")
 
 #: categories the tracer emits today (observability/tracer.py
 #: CATEGORIES); unknown categories stay opaque — listed for reference
@@ -82,34 +89,104 @@ def check(path: str, min_events: int = 1, require_cat: str = "",
 #: the doctor's verdict taxonomy (observability/doctor.py VERDICTS)
 DOCTOR_VERDICTS = ("sync-bound", "compile-bound", "h2d-d2h-bound",
                    "dispatch-bound", "sem_wait-bound", "spill-bound",
-                   "shuffle-bound", "admission-bound", "no-bottleneck")
+                   "shuffle-bound", "admission-bound", "slo-burn",
+                   "no-bottleneck")
+
+
+def check_flow(path: str, min_flows: int = 1):
+    """Validate cross-process flow stitching in a merged trace: every
+    flow id pairs an "s" with an "f", both landing inside a span on the
+    same pid/tid, and every pid that has spans is named via "M"
+    process_name metadata."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    spans_by_track = {}
+    named_pids = set()
+    span_pids = set()
+    flows = {}
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if ph == "X":
+            spans_by_track.setdefault(
+                (ev["pid"], ev["tid"]), []).append(
+                (float(ev["ts"]), float(ev.get("dur", 0.0))))
+            span_pids.add(ev["pid"])
+        elif ph == "M" and ev.get("name") == "process_name":
+            named_pids.add(ev["pid"])
+        elif ph in ("s", "t", "f"):
+            if "id" not in ev:
+                raise ValueError(f"event {i} flow event missing 'id'")
+            flows.setdefault(ev["id"], {})[ph] = ev
+    if len(flows) < min_flows:
+        raise ValueError(f"expected at least {min_flows} flow id(s), "
+                         f"found {len(flows)}")
+    for fid, phases in flows.items():
+        for need in ("s", "f"):
+            if need not in phases:
+                raise ValueError(f"flow {fid}: missing {need!r} phase "
+                                 f"(has {sorted(phases)})")
+        if phases["s"].get("name") != phases["f"].get("name") \
+                or phases["s"].get("cat") != phases["f"].get("cat"):
+            raise ValueError(f"flow {fid}: s/f name or cat mismatch")
+        for ph, ev in phases.items():
+            ts = float(ev["ts"])
+            track = spans_by_track.get((ev["pid"], ev["tid"]), [])
+            if not any(t0 - 1e-6 <= ts <= t0 + dur + 1e-6
+                       for t0, dur in track):
+                raise ValueError(
+                    f"flow {fid} {ph!r} at ts={ts} not inside any span "
+                    f"on pid={ev['pid']} tid={ev['tid']}")
+    cross = sum(1 for p in flows.values()
+                if p["s"]["pid"] != p["f"]["pid"])
+    for pid in span_pids:
+        if pid not in named_pids:
+            raise ValueError(f"pid {pid} has spans but no process_name "
+                             f"metadata")
+    return len(flows), cross, len(span_pids)
 
 
 def check_prometheus(path: str, require_label: str = ""):
+    """Validate a Prometheus exposition FILE (see _check_prom_lines)."""
+    with open(path) as fh:
+        return _check_prom_lines(fh, require_label)
+
+
+def check_endpoint(url: str, require_label: str = ""):
+    """Scrape a live /metrics URL and validate the response body
+    against the Prometheus exposition contract."""
+    import urllib.request
+    if not url.startswith(("http://", "https://")):
+        url = "http://" + url
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        body = resp.read().decode("utf-8", "replace")
+    return _check_prom_lines(body.splitlines(), require_label)
+
+
+def _check_prom_lines(lines, require_label: str = ""):
     """Validate Prometheus exposition text: every sample belongs to a
     # TYPE-declared family; histogram buckets are cumulative and end at
     +Inf with a count matching _count."""
     import re
     types = {}
     samples = []
-    with open(path) as fh:
-        for ln, line in enumerate(fh, 1):
-            line = line.rstrip("\n")
-            if not line.strip():
-                continue
-            if line.startswith("# TYPE "):
-                _, _, name, typ = line.split()
-                if typ not in ("counter", "gauge", "histogram"):
-                    raise ValueError(f"line {ln}: unknown type {typ!r}")
-                types[name] = typ
-                continue
-            if line.startswith("#"):
-                continue
-            m = re.match(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})? "
-                         r"([0-9.eE+-]+|\+Inf|NaN)$", line)
-            if not m:
-                raise ValueError(f"line {ln}: malformed sample: {line!r}")
-            samples.append((m.group(1), m.group(2) or "", m.group(3)))
+    for ln, line in enumerate(lines, 1):
+        line = line.rstrip("\n")
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split()
+            if typ not in ("counter", "gauge", "histogram"):
+                raise ValueError(f"line {ln}: unknown type {typ!r}")
+            types[name] = typ
+            continue
+        if line.startswith("#"):
+            continue
+        m = re.match(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})? "
+                     r"([0-9.eE+-]+|\+Inf|NaN)$", line)
+        if not m:
+            raise ValueError(f"line {ln}: malformed sample: {line!r}")
+        samples.append((m.group(1), m.group(2) or "", m.group(3)))
     if not samples:
         raise ValueError("no samples")
     if require_label and not any(
@@ -183,6 +260,8 @@ def main(argv) -> int:
     prom_label = ""
     prom_paths = []
     doctor_paths = []
+    flow_paths = []
+    endpoints = []
     if "--min-events" in argv:
         i = argv.index("--min-events")
         min_events = int(argv[i + 1])
@@ -207,6 +286,14 @@ def main(argv) -> int:
         i = argv.index("--doctor")
         doctor_paths.append(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
+    while "--flow" in argv:
+        i = argv.index("--flow")
+        flow_paths.append(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    while "--endpoint" in argv:
+        i = argv.index("--endpoint")
+        endpoints.append(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
     rc = 0
     for path in argv:
         try:
@@ -230,6 +317,21 @@ def main(argv) -> int:
             print(f"OK {path}: verdict {verdict}, {n} ranked entries")
         except (OSError, ValueError, KeyError) as e:
             print(f"FAIL {path}: {e}", file=sys.stderr)
+            rc = 1
+    for path in flow_paths:
+        try:
+            n, cross, pids = check_flow(path)
+            print(f"OK {path}: {n} flow edge(s) "
+                  f"({cross} cross-process) over {pids} process(es)")
+        except (OSError, ValueError, KeyError) as e:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+            rc = 1
+    for url in endpoints:
+        try:
+            n, fams = check_endpoint(url, prom_label)
+            print(f"OK {url}: {n} samples, {len(fams)} families")
+        except Exception as e:  # urllib raises many flavours
+            print(f"FAIL {url}: {e}", file=sys.stderr)
             rc = 1
     return rc
 

@@ -21,8 +21,16 @@ to the post-warmup baseline, and the BOUNDED gauges (metrics series,
 ring high-water) must respect their caps — a process serving millions of
 users must look the same after wave 50 as after wave 1.
 
+``--telemetry`` runs the soak with the embedded telemetry server
+enabled (observability/server.py): /metrics and /healthz are scraped
+mid-soak to prove the plane serves under load, and after engine close
+the leg asserts the server left nothing behind — no lingering
+``srt-telemetry-*`` thread and the port rebindable (the series-cap
+bound already covers scrape-driven cardinality growth).
+
 Usage:  python tools/leak_sentinel.py [--seconds 60] [--tenants 2]
-            [--rows 8000] [--arm cancel,deadline,fatal] [--out FILE]
+            [--rows 8000] [--arm cancel,deadline,fatal] [--telemetry]
+            [--out FILE]
 Exit 0 = clean verdict; 1 = leak (per-gauge evidence in the report).
 """
 
@@ -51,6 +59,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "deadline-doomed query per wave), fatal "
                         "(device.fatal -> quarantine + probe)")
     p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--telemetry", action="store_true",
+                   help="soak with the telemetry server enabled and "
+                        "assert leak-free shutdown (thread + port)")
     p.add_argument("--out", default="", help="write the JSON report here")
     return p
 
@@ -77,10 +88,24 @@ def _gauges() -> dict:
     }
 
 
+def _scrape(host: str, port: int, route: str) -> tuple:
+    """(status, body) from the embedded telemetry server; 503 on a
+    degraded /healthz is a valid answer, not an error."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}{route}", timeout=5) as resp:
+            return resp.status, resp.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8", "replace")
+
+
 def run_sentinel(seconds: float = 60.0, tenants: int = 2,
                  rows: int = 8000, seed: int = 11,
                  arm: str = "cancel,deadline,fatal",
-                 max_waves: int = 1000) -> dict:
+                 max_waves: int = 1000,
+                 telemetry: bool = False) -> dict:
     """Returns the report dict; report["verdict"] is "clean" or "leak"."""
     import spark_rapids_tpu as srt  # noqa: F401 - engine init path
     from spark_rapids_tpu.config import RapidsConf
@@ -108,6 +133,11 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
         "spark.rapids.tpu.profile.enabled": True,
         "spark.rapids.tpu.serving.maxConcurrentQueries": max(2, tenants),
     })
+    if telemetry:
+        eng_conf.update({
+            "spark.rapids.tpu.telemetry.enabled": True,
+            "spark.rapids.tpu.telemetry.port": 0,  # ephemeral
+        })
     typed = {"cancelled": 0, "deadline": 0, "fatal": 0, "quarantined": 0,
              "degraded_refusals": 0, "ok": 0, "unexpected": 0}
     eng = ServingEngine(conf=RapidsConf.get_global().copy(eng_conf))
@@ -116,7 +146,14 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
     # baseline" is meaningful (the default TTL parks them for an hour)
     get_shuffle_manager().cleanup_ttl_s = -1.0
     samples = []
+    telem: dict = {}
+    t_host, t_port = "", 0
     try:
+        if telemetry:
+            if eng.telemetry is None:
+                raise AssertionError("telemetry enabled but no server")
+            t_host, t_port = eng.telemetry.host, eng.telemetry.port
+            telem["endpoint"] = eng.telemetry.endpoint
         sessions = {f"tenant{i}": eng.session(tenant=f"tenant{i}")
                     for i in range(tenants)}
         if "deadline" in legs:
@@ -213,6 +250,16 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
             run_wave(wave, armed=True)
             settle()
             samples.append(dict(_gauges(), wave=wave))
+            if telemetry and wave == 1:
+                # the plane must serve mid-soak; /healthz may honestly
+                # answer 503 here (fatal legs degrade the engine)
+                st, body = _scrape(t_host, t_port, "/metrics")
+                telem["metrics_scrape"] = {
+                    "status": st,
+                    "lines": body.count("\n"),
+                }
+                telem["healthz_status"] = _scrape(
+                    t_host, t_port, "/healthz")[0]
         _faults.disarm_chaos()
         for w in range(2):
             run_wave(wave + 1 + w, armed=False)
@@ -231,6 +278,31 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
             if s["trace_ring_high_water"] > s["trace_ring_capacity"]:
                 leaks.append(f"wave {s['wave']}: ring high-water over "
                              f"capacity")
+        if telemetry:
+            if telem.get("metrics_scrape", {}).get("status") != 200:
+                leaks.append(
+                    f"/metrics scrape mid-soak did not answer 200: "
+                    f"{telem.get('metrics_scrape')}")
+            # shutdown must be leak-free: close NOW (idempotent; the
+            # finally re-closes harmlessly) and probe thread + port
+            eng.close()
+            import socket
+            lingering = [t.name for t in threading.enumerate()
+                         if t.name.startswith("srt-telemetry-")]
+            if lingering:
+                leaks.append(f"telemetry thread(s) lingering after "
+                             f"engine close: {lingering}")
+            try:
+                probe = socket.socket()
+                probe.setsockopt(socket.SOL_SOCKET,
+                                 socket.SO_REUSEADDR, 1)
+                probe.bind((t_host or "127.0.0.1", t_port))
+                probe.close()
+            except OSError as e:
+                leaks.append(f"telemetry port {t_port} still bound "
+                             f"after engine close: {e}")
+            telem["shutdown"] = "clean" if not any(
+                "telemetry" in leak for leak in leaks) else "leak"
         report = {
             "schema": "srt-leak-sentinel/1",
             "verdict": "clean" if not leaks else "leak",
@@ -244,6 +316,8 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
             "samples": samples[-5:],
             "leaks": leaks,
         }
+        if telemetry:
+            report["telemetry"] = telem
         return report
     finally:
         eng.close()
@@ -267,7 +341,8 @@ def main() -> int:
     args = build_arg_parser().parse_args()
     report = run_sentinel(seconds=args.seconds, tenants=args.tenants,
                           rows=args.rows, seed=args.seed, arm=args.arm,
-                          max_waves=args.max_waves)
+                          max_waves=args.max_waves,
+                          telemetry=args.telemetry)
     print(json.dumps(report, indent=2))
     if args.out:
         with open(args.out, "w") as fh:

@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""Merge N per-process JSONL trace event logs into ONE Perfetto-loadable
+Chrome trace with per-process tracks and cross-process flow events.
+
+Each input is an event log written by observability/export.py
+(``write_event_log``: a ``{"meta": ...}`` header per query followed by
+raw tracer events).  The merge:
+
+* gives every source process its own pid track (from the log's meta,
+  de-colliding copies) with ``process_name``/``process_labels``/
+  ``thread_name`` metadata;
+* aligns timelines onto one clock: each log's event timestamps are
+  µs from its own trace epoch, so events shift by the wall-clock delta
+  between that epoch and the earliest epoch across all logs;
+* stitches the distributed trace context the shuffle wire propagates
+  (shuffle/tcp.py op 4, shuffle/serializer.py frame schema): spans
+  carrying ``args.span_id`` are flow SOURCES (the requester's
+  ``shuffle.fetch.remote``, the producer's ``serialize_batch``); spans
+  carrying ``args.parent_span`` / ``args.producer_span`` naming such an
+  id are flow SINKS (the peer's ``shuffle.serve``, the consumer's
+  ``deserialize_batch``).  Every matched pair emits a Chrome flow start
+  (``ph: "s"``) anchored on the source span and a binding-enclosing
+  finish (``ph: "f"``, ``bp: "e"``) on the sink span, which Perfetto
+  renders as an arrow from the requester's fetch to the peer's serve.
+
+Usage:  python tools/trace_merge.py OUT.json LOG1.jsonl LOG2.jsonl ...
+Prints a one-line summary (processes, spans, flows); exits non-zero on
+unreadable input.  Validate the output with
+``python tools/check_trace.py OUT.json --flow``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+from typing import Any, Dict, List
+
+
+def merge(paths: List[str]) -> Dict[str, Any]:
+    """Merged Chrome trace object for the given event logs."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from spark_rapids_tpu.observability.export import read_event_log
+
+    entries = []  # (pid, meta, events) — one per appended query per file
+    used_pids: Dict[int, str] = {}
+    for fi, path in enumerate(paths):
+        for meta, events in read_event_log(path):
+            pid = int(meta.get("pid", 0)) or (90000 + fi)
+            # two logs from the same pid are genuinely one process's
+            # tracks; a COPIED log (same pid, different file AND epoch)
+            # would interleave misleadingly — offset it to its own track
+            owner = used_pids.setdefault(pid, path)
+            if owner != path and not _same_process(entries, pid, meta):
+                pid = pid + 100000 * (fi + 1)
+            entries.append((pid, meta, events))
+    if not entries:
+        raise ValueError("no event-log entries in inputs")
+
+    epoch0 = min(float(m.get("epoch_unix_s", 0.0)) for _, m, _ in entries)
+    out: List[Dict[str, Any]] = []
+    span_index: List[Dict[str, Any]] = []
+    named_pids: set = set()
+    for pid, meta, events in entries:
+        shift_us = (float(meta.get("epoch_unix_s", 0.0)) - epoch0) * 1e6
+        tid_map: Dict[Any, int] = {}
+        for ev in events:
+            raw_tid = ev.get("tid", 0)
+            tid = tid_map.get(raw_tid)
+            if tid is None:
+                tid = tid_map[raw_tid] = len(tid_map)
+            args = dict(ev.get("args") or {})
+            if ev.get("exec"):
+                args["exec"] = ev["exec"]
+            if ev.get("tenant"):
+                args["tenant"] = ev["tenant"]
+            if ev.get("sid"):
+                args["sid"] = ev["sid"]
+            span = {
+                "ph": "X", "cat": ev.get("cat", ""), "name": ev["name"],
+                "ts": round(float(ev["ts"]) + shift_us, 3),
+                "dur": round(float(ev.get("dur", 0.0)), 3),
+                "pid": pid, "tid": tid, "args": args,
+            }
+            out.append(span)
+            if args.get("span_id") or args.get("parent_span") \
+                    or args.get("producer_span"):
+                span_index.append(span)
+        if pid not in named_pids:
+            named_pids.add(pid)
+            label = meta.get("session_id", "")
+            out.append({"ph": "M", "name": "process_name", "ts": 0,
+                        "pid": pid, "tid": 0,
+                        "args": {"name": "spark_rapids_tpu"}})
+            out.append({"ph": "M", "name": "process_labels", "ts": 0,
+                        "pid": pid, "tid": 0,
+                        "args": {"labels":
+                                 f"pid={pid}"
+                                 + (f" session={label}" if label else "")}})
+        for raw, t in tid_map.items():
+            out.append({"ph": "M", "name": "thread_name", "ts": 0,
+                        "pid": pid, "tid": t,
+                        "args": {"name": f"thread-{t} ({raw})"}})
+
+    flows = _stitch(span_index, out)
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"merged_from": [os.path.basename(p)
+                                          for p in paths],
+                          "processes": sorted(named_pids),
+                          "flows": flows}}
+
+
+def _same_process(entries, pid: int, meta) -> bool:
+    """Same pid across files counts as one process only when the trace
+    epochs agree (a multi-query sink directory from one process)."""
+    for p, m, _ in entries:
+        if p == pid and abs(float(m.get("epoch_unix_s", 0.0))
+                            - float(meta.get("epoch_unix_s", 0.0))) < 1e-6:
+            return True
+    return False
+
+
+def _stitch(span_index: List[Dict[str, Any]],
+            out: List[Dict[str, Any]]) -> int:
+    """Emit s/f flow-event pairs for every sink span whose parent/
+    producer span id resolves to a source span."""
+    sources: Dict[str, Dict[str, Any]] = {}
+    for span in span_index:
+        sid = span["args"].get("span_id")
+        if sid:
+            sources[str(sid)] = span
+    flows = 0
+    for span in span_index:
+        ref = span["args"].get("parent_span") \
+            or span["args"].get("producer_span")
+        src = sources.get(str(ref)) if ref else None
+        if src is None or src is span:
+            continue
+        # stable id per edge; cat/name must match across the s/f pair
+        fid = zlib.crc32(f"{ref}->{span['pid']}/{span['ts']}".encode())
+        trace_id = span["args"].get("trace_id") \
+            or span["args"].get("producer_trace") or ""
+        common = {"cat": "shuffle_flow", "name": "shuffle.edge",
+                  "id": fid, "args": {"trace_id": trace_id}}
+        out.append(dict(common, ph="s", pid=src["pid"], tid=src["tid"],
+                        ts=src["ts"]))
+        out.append(dict(common, ph="f", bp="e", pid=span["pid"],
+                        tid=span["tid"], ts=span["ts"]))
+        flows += 1
+    return flows
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) < 2 or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 1
+    out_path, inputs = argv[0], argv[1:]
+    try:
+        doc = merge(inputs)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"trace_merge: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh)
+    od = doc["otherData"]
+    spans = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+    print(f"OK {out_path}: {len(od['processes'])} process(es), "
+          f"{spans} spans, {od['flows']} flow edge(s) "
+          f"from {len(inputs)} log(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

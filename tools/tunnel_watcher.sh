@@ -59,6 +59,10 @@ print('ALIVE', float(jnp.sum(jnp.ones(8))))" 2>/dev/null | grep ALIVE)
         for mode in main warm suite; do
           ts2=$(date -u +%Y-%m-%dT%H:%M:%SZ)
           echo "$ts2 capture $mode start" >> "$LOG"
+          # bank the run's telemetry (metrics exposition + doctor
+          # verdict, pid-stamped — see bench._bank_telemetry) beside
+          # the capture so each banked number carries its diagnosis
+          export SRT_BENCH_TELEMETRY_DIR="$CAP/telemetry_${ts2}_${mode}"
           case $mode in
             main)  BENCH_BUDGET_S=1800 timeout 1900 \
                      python bench.py ;;
@@ -68,6 +72,7 @@ print('ALIVE', float(jnp.sum(jnp.ones(8))))" 2>/dev/null | grep ALIVE)
                      python bench.py --suite ;;
           esac > "$CAP/run_${ts2}_${mode}.out" \
               2> "$CAP/run_${ts2}_${mode}.err"
+          unset SRT_BENCH_TELEMETRY_DIR
           cycle_files="$cycle_files $CAP/run_${ts2}_${mode}.out"
           echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture $mode done" >> "$LOG"
         done
