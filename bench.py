@@ -574,7 +574,13 @@ def _measure_whole_stage(rows: int) -> dict:
     filters/projects/agg-partial/join-probe programs — the ops fusion
     absorbs), total compiled-program launches, sync-span counts from a
     traced run, rows/s, and a bit-parity flag.  The acceptance bar is a
-    >= 3x dispatch drop on the filter_agg and join shapes."""
+    >= 3x dispatch drop on the filter_agg and join shapes.
+
+    ISSUE 14 extends the banked set: ``sort_stage`` and ``window_stage``
+    cover the sort/window stage terminals (>= 2x stage-dispatch
+    reduction target), and the join record carries
+    ``launches_per_probe_batch`` (fused single-program probe target:
+    <= 12) plus the dispatch-coalescer counters when it engaged."""
     import pyarrow as pa
     import spark_rapids_tpu as srt
     from spark_rapids_tpu.config import RapidsConf
@@ -601,6 +607,20 @@ def _measure_whole_stage(rows: int) -> dict:
                     .agg(F.sum(F.col("y")).alias("sy"),
                          F.count("*").alias("c"))
                     .orderBy("k"))
+        if shape == "sort_stage":
+            # filter -> project -> project -> SORT terminal: one program
+            return (f.filter(F.col("q") < 50)
+                    .withColumn("y", F.col("x") * 2.0)
+                    .withColumn("z", F.col("y") + F.col("q"))
+                    .orderBy("k", "z"))
+        if shape == "window_stage":
+            # filter -> projects -> absorbed sort -> WINDOW terminal
+            from spark_rapids_tpu.sql.window_api import Window as W
+            w = W.partitionBy("k").orderBy("q")
+            return (f.filter(F.col("q") < 50)
+                    .withColumn("y", F.col("x") * 2.0)
+                    .withColumn("z", F.col("y") + F.col("q"))
+                    .withColumn("rn", F.row_number().over(w)))
         # join: selective filter -> project -> broadcast probe terminal
         d = sess.create_dataframe(dim)
         return (f.filter(F.col("q") < 5)
@@ -608,7 +628,7 @@ def _measure_whole_stage(rows: int) -> dict:
                 .join(d, f.fk == d.pk, "inner"))
 
     out: dict = {}
-    for shape in ("filter_agg", "join"):
+    for shape in ("filter_agg", "join", "sort_stage", "window_stage"):
         per = {}
         results = {}
         for fused in (True, False):
@@ -616,6 +636,10 @@ def _measure_whole_stage(rows: int) -> dict:
                 "spark.rapids.tpu.sql.fusion.enabled": fused,
                 "spark.rapids.tpu.sql.wholeStage.enabled": fused,
                 "spark.rapids.tpu.sql.wholeStage.donation.enabled": fused,
+                "spark.rapids.tpu.sql.wholeStage.sortWindowTerminal"
+                ".enabled": fused,
+                "spark.rapids.tpu.sql.join.fusedProbe.enabled": fused,
+                "spark.rapids.tpu.sql.dispatch.coalesce.enabled": fused,
             })
             sess = srt.session(conf=conf)
             q = mk(sess, shape)
@@ -639,6 +663,17 @@ def _measure_whole_stage(rows: int) -> dict:
                 "donated_batches": int(
                     m.get("wholeStageDonatedBatches", 0)),
             }
+            probes = int(m.get("joinFastpathProbes", 0)
+                         + m.get("joinFallbackProbes", 0))
+            if probes:
+                per[tag]["probe_batches"] = probes
+                per[tag]["launches_per_probe_batch"] = round(
+                    per[tag]["device_dispatches"] / probes, 2)
+            if m.get("dispatchCoalescedLaunches"):
+                per[tag]["coalesced_launches"] = int(
+                    m["dispatchCoalescedLaunches"])
+                per[tag]["coalesced_batches"] = int(
+                    m.get("dispatchCoalescedBatches", 0))
             ti = _shape_trace(sess, q.collect)
             ts = ti.get("trace_summary")
             if ts:

@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/17] native libraries ==="
+echo "=== [1/18] native libraries ==="
 make -C native
 
-echo "=== [2/17] API contract validation ==="
+echo "=== [2/18] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/17] docgen drift check ==="
+echo "=== [3/18] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/17] traced query + chrome-trace schema check ==="
+echo "=== [4/18] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,7 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/17] performance flight recorder: metrics + history + doctor + bench_diff ==="
+echo "=== [5/18] performance flight recorder: metrics + history + doctor + bench_diff ==="
 # ISSUE 8 acceptance: a traced query with the metrics registry and the
 # flight recorder enabled must produce (a) a Prometheus export that
 # passes the exposition-contract check, (b) a doctor diagnosis whose
@@ -112,7 +112,7 @@ if python tools/bench_diff.py "$SRT_FR_DIR/live.json" BENCH_r05.json \
     echo "ERROR: bench_diff failed to refuse live-vs-stale"; exit 1
 fi
 
-echo "=== [6/17] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [6/18] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -124,7 +124,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [7/17] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [7/18] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -138,7 +138,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [8/17] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+echo "=== [8/18] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
 # Encoded columnar execution (docs/encoded_columns.md) under seeded
 # faults AND the async pipeline matrix: the chaos session keeps
 # dictionary/RLE columns encoded through filters/joins/group-bys and
@@ -158,7 +158,7 @@ timeout 60 python tools/check_trace.py --require-cat encode \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     8000 --seed 11 --encoded
 
-echo "=== [9/17] whole-stage fusion: plan shape + donation chaos soak ==="
+echo "=== [9/18] whole-stage fusion: plan shape + donation chaos soak ==="
 # Whole-stage XLA compilation (docs/whole_stage.md): (a) the TPC-H-ish
 # suite's plans must contain fused whole-stage nodes — an aggregate
 # terminal (FusedStageExec wrapping the partial agg) and a probe-absorbed
@@ -215,7 +215,137 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat stage \
     "$SRT_WS_TRACE"
 
-echo "=== [10/17] multi-tenant serving: concurrent sessions smoke ==="
+echo "=== [10/18] dispatch pipeline: sort/window terminals + fused probe + coalescer ==="
+# ISSUE 14 acceptance: (a) plans form sort/window STAGE TERMINALS (the
+# sort absorbs the map chain; a window over a matching sort absorbs the
+# sort) and the broadcast join still absorbs its probe chain with the
+# fused single-program probe armed; (b) the chaos soak runs with the
+# full dispatch set armed (coalescer + terminals + fused probe) vs the
+# serial unfused clean baseline, bit-identical under injected faults;
+# (c) a traced coalesced stage run exports `stage` spans carrying
+# `coalesced_n`, validated by check_trace --require-cat stage.
+JAX_PLATFORMS=cpu timeout 300 python - <<'PYEOF'
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.window_api import Window as W
+from spark_rapids_tpu.sql.physical.join import BaseJoinExec
+from spark_rapids_tpu.sql.physical.sortlimit import SortExec
+from spark_rapids_tpu.sql.physical.window import WindowExec
+
+def find(plan, pred):
+    out, stack = [], [plan]
+    while stack:
+        n = stack.pop()
+        if pred(n):
+            out.append(n)
+        stack.extend(n.children)
+    return out
+
+sess = srt.session()
+rng = np.random.default_rng(5)
+n = 40_000
+fact = sess.create_dataframe(pa.table(
+    {"k": rng.integers(0, 16, n), "q": rng.integers(0, 100, n),
+     "x": rng.random(n), "fk": rng.integers(0, 500, n)}))
+dim = sess.create_dataframe(pa.table(
+    {"pk": np.arange(500, dtype=np.int64),
+     "cat": rng.integers(0, 8, 500)}))
+# sort terminal: the ORDER BY absorbs the map chain into its program
+q1 = (fact.filter(F.col("q") < 60).withColumn("y", F.col("x") * 2.0)
+      .orderBy("k", "y"))
+p1 = sess.physical_plan(q1)
+sorts = find(p1, lambda m: isinstance(m, SortExec) and m._pre_steps)
+assert sorts, "no sort-terminal stage:\n" + p1.tree_string()
+# window terminal: the window absorbs its partition sort (and the sort
+# absorbs the chain below it)
+w = W.partitionBy("k").orderBy("q")
+q2 = (fact.filter(F.col("q") < 60).withColumn("y", F.col("x") * 2.0)
+      .withColumn("rn", F.row_number().over(w)))
+p2 = sess.physical_plan(q2)
+wins = find(p2, lambda m: isinstance(m, WindowExec)
+            and m._sorter is not None)
+assert wins, "no window-terminal stage:\n" + p2.tree_string()
+# fused probe: the join still absorbs the probe-side chain
+q3 = (fact.filter(F.col("q") < 30).join(dim, fact.fk == dim.pk, "inner"))
+p3 = sess.physical_plan(q3)
+joins = find(p3, lambda m: isinstance(m, BaseJoinExec))
+assert joins and joins[0]._probe_steps, \
+    "probe chain not absorbed:\n" + p3.tree_string()
+print("plan-shape OK:", sorts[0].simple_string())
+print("plan-shape OK:", wins[0].simple_string())
+print("plan-shape OK:", joins[0].simple_string())
+PYEOF
+SRT_CO_TRACE=$(mktemp -d)/coalesce_trace.json
+JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
+    20000 --seed 11 --coalesce --trace "$SRT_CO_TRACE"
+timeout 60 python tools/check_trace.py --require-cat stage \
+    "$SRT_CO_TRACE"
+# coalesced stage spans: drive a stage over a multi-batch stream (the
+# exec-level harness tests/test_dispatch_budget.py pins) and assert the
+# exported trace carries `coalesced_n` on a `stage` span
+SRT_CON_TRACE=$(mktemp -d)/coalesced_n_trace.json
+JAX_PLATFORMS=cpu SRT_CON_TRACE="$SRT_CON_TRACE" timeout 300 python - <<'PYEOF'
+import json, os
+import numpy as np, pyarrow as pa
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.physical.base import TaskContext
+from spark_rapids_tpu.sql.physical.fusion import FusedStageExec
+from spark_rapids_tpu.observability import tracer as OT
+
+sess = srt.session()
+rng = np.random.default_rng(7)
+tab = pa.table({"k": rng.integers(0, 9, 512), "v": rng.random(512)})
+df = (sess.create_dataframe(tab).filter(F.col("v") < 0.8)
+      .withColumn("y", F.col("v") * 2.0).select("k", "y"))
+plan = sess.physical_plan(df)
+stack, stage = [plan], None
+while stack:
+    m = stack.pop()
+    if isinstance(m, FusedStageExec):
+        stage = m
+        break
+    stack.extend(m.children)
+assert stage is not None, plan.tree_string()
+inner = stage.children[0]
+
+class Stub:
+    output = inner.output
+    children = ()
+    def execute(self, pid, tctx):
+        for _ in range(4):
+            yield from inner.execute(pid, tctx)
+    def num_partitions(self):
+        return 1
+
+stage.children = (Stub(),)
+OT.get_tracer().reset(2048)
+OT.TRACING["on"] = True
+tctx = TaskContext(0, RapidsConf.get_global())
+with tctx.as_current():
+    outs = list(stage.execute(0, tctx))
+events = OT.get_tracer().snapshot()
+OT.TRACING["on"] = False
+spans = [e for e in events if e.get("cat") == "stage"
+         and (e.get("args") or {}).get("coalesced_n")]
+assert spans, events
+assert spans[0]["args"]["coalesced_n"] == 4, spans[0]
+doc = {"traceEvents": [
+    {"ph": "X", "cat": e["cat"], "name": e["name"], "ts": e["ts"],
+     "dur": e["dur"], "pid": 1, "tid": e.get("tid", 0),
+     "args": e.get("args") or {}} for e in events]}
+with open(os.environ["SRT_CON_TRACE"], "w") as fh:
+    json.dump(doc, fh)
+print("coalesced_n span OK:", spans[0]["args"])
+PYEOF
+timeout 60 python tools/check_trace.py --require-cat stage \
+    "$SRT_CON_TRACE"
+grep -q coalesced_n "$SRT_CON_TRACE"
+
+echo "=== [11/18] multi-tenant serving: concurrent sessions smoke ==="
 # ISSUE 9 acceptance: N tenant sessions against one ServingEngine —
 # (a) weighted-fair admission: a heavy flood cannot starve a light
 # tenant (bounded wait, grant-order assertion at the controller);
@@ -308,7 +438,7 @@ timeout 60 python tools/check_trace.py --require-cat admission \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     10000 --seed 11 --multi-session
 
-echo "=== [11/17] query lifecycle: leak sentinel + cancel semantics ==="
+echo "=== [12/18] query lifecycle: leak sentinel + cancel semantics ==="
 # ISSUE 10 acceptance: (a) the bounded leak sentinel — 2 tenants of
 # mixed traffic with cancel races, per-query deadlines and fatal
 # injection armed — must bank a CLEAN verdict (retention pins, catalog
@@ -361,7 +491,7 @@ PYEOF
 timeout 60 python tools/check_trace.py --require-cat cancel \
     "$SRT_LC_DIR/cancel_trace.json"
 
-echo "=== [12/17] live telemetry plane: scrape + trace stitching over the shuffle wire ==="
+echo "=== [13/18] live telemetry plane: scrape + trace stitching over the shuffle wire ==="
 # ISSUE 12 acceptance: (a) the embedded telemetry server answers
 # /metrics (Prometheus contract with the tenant label, validated both
 # from the scraped body and live via check_trace --endpoint) and
@@ -511,7 +641,7 @@ timeout 60 python tools/trace_merge.py "$SRT_TP_DIR/merged.json" \
 timeout 60 python tools/check_trace.py --flow "$SRT_TP_DIR/merged.json" \
     --min-events 2 "$SRT_TP_DIR/merged.json"
 
-echo "=== [13/17] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [14/18] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -532,14 +662,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [14/17] scale rig ==="
+    echo "=== [15/18] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [14/17] scale rig skipped (quick) ==="
+    echo "=== [15/18] scale rig skipped (quick) ==="
 fi
 
-echo "=== [15/17] packaging: wheel builds and installs ==="
+echo "=== [16/18] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -569,17 +699,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [16/17] driver entry checks ==="
+echo "=== [17/18] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [17/17] second-jax shim world skipped (quick) ==="
+    echo "=== [18/18] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [17/17] second-jax shim world (gated) ==="
+echo "=== [18/18] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

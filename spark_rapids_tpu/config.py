@@ -161,6 +161,42 @@ WHOLE_STAGE_DONATION = register(
     "columns.  Buffers are only physically reclaimed on real device "
     "backends (XLA:CPU ignores donation); the safety decision runs "
     "everywhere.", True)
+WHOLE_STAGE_SORT_WINDOW = register(
+    "spark.rapids.tpu.sql.wholeStage.sortWindowTerminal.enabled",
+    "Sort/window stage terminals (docs/whole_stage.md): a SortExec or "
+    "WindowExec absorbs the upstream filter/project chain into its own "
+    "compiled program, and a WindowExec additionally absorbs the "
+    "planner-inserted partition sort — partition sort + segmented frame "
+    "evaluation ride ONE stage program instead of one dispatch per op. "
+    "Requires spark.rapids.tpu.sql.wholeStage.enabled.", True)
+JOIN_FUSED_PROBE = register(
+    "spark.rapids.tpu.sql.join.fusedProbe.enabled",
+    "Single-program probe pipeline: each probe batch runs multi-key "
+    "search + run-end expansion + pair generation + the gather of ALL "
+    "output columns on both sides as ONE compiled program that also "
+    "returns the sizing scalars — at most two device launches per probe "
+    "batch (the optional second handles a speculative-bucket overflow "
+    "re-gather), with the one batched sizing readback unchanged.  Off "
+    "keeps the separate probe-search and gather programs.", True)
+DISPATCH_COALESCE_ENABLED = register(
+    "spark.rapids.tpu.sql.dispatch.coalesce.enabled",
+    "Dispatch coalescer for the many-small-partitions regime "
+    "(docs/whole_stage.md): consecutive small same-shape batches entering "
+    "a fused map stage are stacked on a leading axis and the stage "
+    "program is vmapped over the stack INSIDE one compiled program — N "
+    "batches, one device launch.  Only batches whose padded capacity "
+    "bucket and column layout match coalesce (the padding buckets are "
+    "the existing capacity quantization); tracer stage spans carry "
+    "coalesced_n and deviceDispatches counts real launches.", True)
+DISPATCH_COALESCE_MAX_BATCHES = register(
+    "spark.rapids.tpu.sql.dispatch.coalesce.maxBatches",
+    "Upper bound on the number of batches stacked into one coalesced "
+    "stage launch.", 8)
+DISPATCH_COALESCE_MAX_ROWS = register(
+    "spark.rapids.tpu.sql.dispatch.coalesce.maxRows",
+    "Only batches whose padded capacity is at or below this many rows "
+    "are eligible for dispatch coalescing — large batches already "
+    "amortize their launch overhead.", 1 << 16)
 IMPROVED_FLOAT = register(
     "spark.rapids.sql.improvedFloatOps.enabled",
     "Allow float ops whose results may differ from CPU in ULPs.", True)

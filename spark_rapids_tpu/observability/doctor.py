@@ -83,6 +83,44 @@ DEFAULT_DISPATCH_COST_MS = 0.05
 #: launches below this count never yield a dispatch-bound verdict
 DISPATCH_FLOOR = 32
 
+#: kernel keys named in dispatch-bound evidence (top launch sources)
+DISPATCH_TOP_K = 5
+
+
+def _dispatch_evidence(dispatches: int,
+                       metrics: Dict[str, Any],
+                       dispatch_by_key: Optional[Dict[str, int]]
+                       ) -> Dict[str, Any]:
+    """Actionable dispatch-bound evidence: WHICH programs launch and HOW
+    OFTEN per unit of work.  ``launches_per_probe_batch`` is the join
+    perf-model number (ISSUE 14: one probe batch should cost ≤12
+    launches end to end); ``top_kernels`` ranks the per-key launch
+    counters (``dispatches{kernel}``) so the verdict names the
+    originating exec instead of just a total — kernel labels are
+    ``ExecName#hash``, so the heaviest key IS the exec to fuse."""
+    ev: Dict[str, Any] = {"device_dispatches": dispatches}
+    probes = int(metrics.get("joinFastpathProbes", 0)
+                 + metrics.get("joinFallbackProbes", 0))
+    if probes > 0:
+        ev["probe_batches"] = probes
+        ev["launches_per_probe_batch"] = round(
+            dispatches / max(1, probes), 2)
+    if dispatch_by_key is None:
+        # in-process diagnosis: the kernel cache's per-key launch
+        # counters are live and scoped to the last clear_cache()
+        try:
+            from ..sql.physical import kernel_cache as _kc
+            dispatch_by_key = _kc.dispatch_stats_by_key()
+        except Exception:  # pragma: no cover - import cycle safety
+            dispatch_by_key = {}
+    if dispatch_by_key:
+        top = sorted(dispatch_by_key.items(), key=lambda kv: -kv[1])
+        top = top[:DISPATCH_TOP_K]
+        ev["top_kernels"] = [
+            {"kernel": k, "launches": int(n)} for k, n in top]
+        ev["top_exec"] = top[0][0].split("#", 1)[0]
+    return ev
+
 
 def _verdict_entry(category: str, ms: float, count: int,
                    evidence: Dict[str, Any]) -> Dict[str, Any]:
@@ -133,7 +171,8 @@ def diagnose(events: List[Dict[str, Any]],
              metrics: Optional[Dict[str, Any]] = None,
              wall_ms: Optional[float] = None,
              dropped_events: int = 0,
-             dispatch_cost_ms: float = DEFAULT_DISPATCH_COST_MS
+             dispatch_cost_ms: float = DEFAULT_DISPATCH_COST_MS,
+             dispatch_by_key: Optional[Dict[str, int]] = None
              ) -> Dict[str, Any]:
     """Ranked bottleneck diagnosis from a tracer snapshot.
 
@@ -190,13 +229,13 @@ def diagnose(events: List[Dict[str, Any]],
         if wall_ms is not None:
             est = min(est, max(0.0, wall_ms - attributed_ms))
         if est > 0:
+            ev = _dispatch_evidence(dispatches, metrics, dispatch_by_key)
+            ev["stage_op_dispatches"] = int(
+                metrics.get("stageOpDispatches", 0))
+            ev["estimated"] = True
+            ev["per_dispatch_ms"] = dispatch_cost_ms
             ranked.append(_verdict_entry(
-                "dispatch-bound", est, dispatches,
-                {"device_dispatches": dispatches,
-                 "stage_op_dispatches": int(
-                     metrics.get("stageOpDispatches", 0)),
-                 "estimated": True,
-                 "per_dispatch_ms": dispatch_cost_ms}))
+                "dispatch-bound", est, dispatches, ev))
 
     ranked.sort(key=lambda e: -e["ms"])
     denom = wall_ms if wall_ms else (attributed_ms or 1.0)
@@ -261,8 +300,14 @@ def diagnose_summary(summary: Dict[str, Any],
     dispatches = int(summary.get("device_dispatches",
                                  metrics.get("deviceDispatches", 0)) or 0)
     if dispatches >= DISPATCH_FLOOR:
+        # summaries may bank the per-key launch table (bench artifacts);
+        # when absent, evidence degrades to totals + probe-batch ratio
+        ev = _dispatch_evidence(
+            dispatches, metrics,
+            dict(summary.get("dispatch_by_key") or {}))
+        ev["estimated"] = True
         add("dispatch-bound", dispatches * DEFAULT_DISPATCH_COST_MS,
-            dispatches, device_dispatches=dispatches, estimated=True)
+            dispatches, **ev)
     ranked.sort(key=lambda e: -e["ms"])
     attributed_ms = sum(e["ms"] for e in ranked)
     denom = wall_ms if wall_ms else (attributed_ms or 1.0)
@@ -352,7 +397,8 @@ def compact(diag: Dict[str, Any], top: int = 3) -> Dict[str, Any]:
         row = {"category": e["category"], "ms": e["ms"],
                "share": e.get("share", 0.0), "count": e["count"]}
         ev = e.get("evidence", {})
-        for k in ("bytes", "device_dispatches", "h2d_bytes", "d2h_bytes"):
+        for k in ("bytes", "device_dispatches", "h2d_bytes", "d2h_bytes",
+                  "launches_per_probe_batch", "top_exec", "top_kernels"):
             if ev.get(k):
                 row[k] = ev[k]
         rows.append(row)

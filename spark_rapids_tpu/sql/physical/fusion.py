@@ -31,6 +31,18 @@ Stage shapes (docs/whole_stage.md):
   program as a cross-call constant.  The join node itself is the stage
   node (wrapping both children would desynchronize the probe/build
   references the async planner pass relies on).
+* **sort/window terminal** — ``SortExec`` absorbs the upstream chain
+  into its first-touch program (``absorb_pre_steps``); ``WindowExec``
+  additionally absorbs the planner's partition sort (``absorb_sort``) so
+  single-chunk inputs evaluate chain + sort + window in ONE program.
+  Gated by ``wholeStage.sortWindowTerminal.enabled``.
+
+Map stages additionally run the **dispatch coalescer**
+(``dispatch.coalesce.{enabled,maxBatches,maxRows}``): consecutive
+same-signature small batches are stacked on a leading axis INSIDE one
+jitted program and the stage computation is vmapped over them — N
+batches, one real device launch (``deviceDispatches`` counts launches;
+the ``stage`` trace span carries ``coalesced_n``).
 
 Programs are built LAZILY on first execute under one stage-signature
 kernel-cache key (member ``_fuse_key``s + encode params + input layout),
@@ -51,6 +63,36 @@ from ...memory import retention as _ret
 from ...observability import tracer as _trace
 from .base import TPU, PhysicalPlan
 from .basic import FilterExec, ProjectExec, compact_batch
+
+
+def _col_coalesce_sig(c):
+    """Structural stack-compatibility signature for one column, or None
+    when the column can't coalesce (encoded columns carry per-dictionary
+    aux data — content hashes — that break the common treedef)."""
+    from ...columnar.column import DeviceColumn
+    if type(c) is not DeviceColumn:
+        return None
+    kids = tuple(_col_coalesce_sig(ch) for ch in c.children)
+    if any(k is None for k in kids):
+        return None
+    return (str(c.dtype),
+            None if c.data is None else (tuple(c.data.shape),
+                                         str(c.data.dtype)),
+            None if c.validity is None else tuple(c.validity.shape),
+            None if c.lengths is None else str(c.lengths.dtype),
+            None if c.aux is None else (tuple(c.aux.shape),
+                                        str(c.aux.dtype)),
+            kids)
+
+
+def coalesce_signature(batch: ColumnarBatch):
+    """Batches with equal signatures stack leaf-for-leaf into one
+    batch-of-batches launch (same names, capacity bucket, and per-column
+    array structure — string widths included).  None = not coalescible."""
+    sigs = tuple(_col_coalesce_sig(c) for c in batch.columns)
+    if any(s is None for s in sigs):
+        return None
+    return (batch.names, batch.capacity, sigs)
 
 
 class FusedStageExec(PhysicalPlan):
@@ -96,6 +138,30 @@ class FusedStageExec(PhysicalPlan):
             self._fns[donate] = fn
         return fn
 
+    def _get_coalesced_fn(self, n: int, conf):
+        """One program for N stacked same-signature batches: the stack,
+        the vmapped stage computation, AND the unstack all trace into a
+        single jitted program — exactly one real device launch replaces
+        N (the dispatch coalescer, docs/whole_stage.md).  Coalesced
+        groups never donate (N inputs share one program invocation; the
+        sole-owner proof is per-batch)."""
+        key = ("coalesce", n)
+        fn = self._fns.get(key)
+        if fn is None:
+            def impl(*batches):
+                import jax
+                xp = self.xp
+                stacked = jax.tree_util.tree_map(
+                    lambda *ls: xp.stack(ls), *batches)
+                outs = jax.vmap(self._compute)(stacked)
+                return tuple(
+                    jax.tree_util.tree_map(lambda l, i=i: l[i], outs)
+                    for i in range(n))
+            fn = self._jit(impl,
+                           key=self._stage_key(conf) + (("coalesce", n),))
+            self._fns[key] = fn
+        return fn
+
     def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
         xp = self.xp
         mask = batch.row_mask()
@@ -120,7 +186,14 @@ class FusedStageExec(PhysicalPlan):
             return
         donate_on = self._donation_on(tctx)
         label = self._stage_label()
-        for batch in self.children[0].execute(pid, tctx):
+        from ...config import (DISPATCH_COALESCE_ENABLED,
+                               DISPATCH_COALESCE_MAX_BATCHES,
+                               DISPATCH_COALESCE_MAX_ROWS)
+        co_max = (int(tctx.conf.get(DISPATCH_COALESCE_MAX_BATCHES))
+                  if bool(tctx.conf.get(DISPATCH_COALESCE_ENABLED)) else 1)
+        co_rows = int(tctx.conf.get(DISPATCH_COALESCE_MAX_ROWS))
+
+        def run_one(batch):
             tctx.inc_metric("fusedStageBatches")
             tctx.inc_metric("wholeStageDispatches")
             tctx.inc_metric("stageOpDispatches")
@@ -135,7 +208,46 @@ class FusedStageExec(PhysicalPlan):
             fn = self._get_fn(donate, tctx.conf)
             with _trace.span("stage", label, partition=pid):
                 out = fn(batch)
-            yield _ret.mark_transient(out)
+            return _ret.mark_transient(out)
+
+        pending: list = []
+        pending_sig = None
+
+        def flush():
+            nonlocal pending, pending_sig
+            group, pending, pending_sig = pending, [], None
+            if not group:
+                return
+            if len(group) == 1:
+                yield run_one(group[0])
+                return
+            n = len(group)
+            tctx.inc_metric("fusedStageBatches", n)
+            tctx.inc_metric("wholeStageDispatches")
+            tctx.inc_metric("stageOpDispatches")
+            tctx.inc_metric("dispatchCoalescedBatches", n)
+            tctx.inc_metric("dispatchCoalescedLaunches")
+            fn = self._get_coalesced_fn(n, tctx.conf)
+            with _trace.span("stage", label, partition=pid,
+                             coalesced_n=n):
+                outs = fn(*group)
+            for out in outs:
+                yield _ret.mark_transient(out)
+
+        for batch in self.children[0].execute(pid, tctx):
+            if co_max > 1 and batch.num_rows_bound <= co_rows:
+                sig = coalesce_signature(batch)
+                if sig is not None:
+                    if pending and sig != pending_sig:
+                        yield from flush()
+                    pending.append(batch)
+                    pending_sig = sig
+                    if len(pending) >= co_max:
+                        yield from flush()
+                    continue
+            yield from flush()
+            yield run_one(batch)
+        yield from flush()
 
     def _execute_terminal(self, pid, tctx):
         """Delegate to the terminal exec (its absorbed pre-steps ARE the
@@ -193,16 +305,50 @@ def fuse_stages(plan: PhysicalPlan, conf=None) -> PhysicalPlan:
     hash aggregate's partial kernel or a hash join's probe phase (stage
     terminals, gated by ``spark.rapids.tpu.sql.wholeStage.enabled``), and
     collapse remaining chains of >= 2 map ops into a FusedStageExec."""
-    from ...config import WHOLE_STAGE_ENABLED, RapidsConf
+    from ...config import (WHOLE_STAGE_ENABLED, WHOLE_STAGE_SORT_WINDOW,
+                           RapidsConf)
     from .aggregate import HashAggregateExec
     from .join import BroadcastHashJoinExec, ShuffledHashJoinExec
+    from .sortlimit import SortExec
+    from .window import WindowExec
 
     conf = conf or RapidsConf.get_global()
     whole = bool(conf.get(WHOLE_STAGE_ENABLED))
+    sortwin = whole and bool(conf.get(WHOLE_STAGE_SORT_WINDOW))
 
     if (whole and isinstance(plan, HashAggregateExec)
             and plan.backend == TPU
             and plan.mode in ("partial", "complete")):
+        chain, below = _collect_chain(plan.children[0])
+        if chain:
+            plan.absorb_pre_steps(chain, below)
+            fused = FusedStageExec(chain, below, terminal=plan)
+            fused.children = (fuse_stages(below, conf),)
+            return fused
+
+    if (sortwin and isinstance(plan, WindowExec) and plan.backend == TPU
+            and plan._sorter is None
+            and isinstance(plan.children[0], SortExec)
+            and plan.children[0].backend == TPU
+            and not plan.children[0]._pre_steps
+            and plan.can_absorb_sort(plan.children[0])):
+        # window terminal: absorb the planner's partition sort (and any
+        # chain below it) — single-chunk inputs run chain + sort +
+        # window as ONE program
+        sort = plan.children[0]
+        chain, below = _collect_chain(sort.children[0])
+        if chain:
+            sort.absorb_pre_steps(chain, below)
+        plan.absorb_sort(sort)
+        if chain:
+            fused = FusedStageExec(chain, below, terminal=plan)
+            fused.children = (fuse_stages(below, conf),)
+            return fused
+        plan.children = tuple(fuse_stages(c, conf) for c in plan.children)
+        return plan
+
+    if (sortwin and isinstance(plan, SortExec) and plan.backend == TPU
+            and not plan._pre_steps):
         chain, below = _collect_chain(plan.children[0])
         if chain:
             plan.absorb_pre_steps(chain, below)
@@ -239,6 +385,7 @@ def annotate_stage_coverage(plan: PhysicalPlan) -> PhysicalPlan:
     from .aggregate import HashAggregateExec
     from .collect_fusion import FusedCollectExec
     from .join import BaseJoinExec, NestedLoopJoinExec
+    from .window import WindowExec
 
     fused = unfused = 0
     stack = [plan]
@@ -246,6 +393,11 @@ def annotate_stage_coverage(plan: PhysicalPlan) -> PhysicalPlan:
         n = stack.pop()
         if isinstance(n, FusedStageExec):
             fused += len(n.members) + (1 if n.terminal is not None else 0)
+            if getattr(n.terminal, "_sorter", None) is not None:
+                fused += 1  # the window terminal's absorbed partition sort
+        elif isinstance(n, WindowExec) \
+                and getattr(n, "_sorter", None) is not None:
+            fused += 2  # sort-only absorption: window + its partition sort
         elif isinstance(n, FusedCollectExec):
             fused += 1 + len(getattr(n._agg, "_pre_steps", ()))
         elif isinstance(n, (FilterExec, ProjectExec)):

@@ -41,7 +41,7 @@ _FILTER_JOINS = ("left_semi", "left_anti", "existence")
 #: the sizing path; spec_hits/spec_misses track speculative output sizing
 STATS = {"chunked_joins": 0, "build_sorts": 0, "fastpath_probes": 0,
          "fallback_probes": 0, "spec_hits": 0, "spec_misses": 0,
-         "host_readbacks": 0}
+         "host_readbacks": 0, "fused_probes": 0}
 
 #: realized join selectivity (inner pairs per probe row) per program
 #: identity — the speculative output-sizing seed, learned from the first
@@ -304,6 +304,17 @@ class BaseJoinExec(PhysicalPlan):
             conf = RapidsConf.get_global()
         return bool(conf.get(JOIN_BUILD_CACHE_ENABLED))
 
+    def _fused_probe_on(self, tctx: Optional[TaskContext]) -> bool:
+        """Single-program probe pipeline kill switch: probe search +
+        run-end expansion + pair generation + the all-columns gather ride
+        ONE compiled program that also returns the sizing scalars."""
+        from ...config import JOIN_FUSED_PROBE
+        conf = tctx.conf if tctx is not None else None
+        if conf is None:
+            from ...config import RapidsConf
+            conf = RapidsConf.get_global()
+        return bool(conf.get(JOIN_FUSED_PROBE))
+
     def _lower_encoded_keys(self, probe: ColumnarBatch, build: ColumnarBatch,
                             tctx: Optional[TaskContext]
                             ) -> Tuple[ColumnarBatch, ColumnarBatch]:
@@ -444,6 +455,26 @@ class BaseJoinExec(PhysicalPlan):
                 return self._gather_impl(probe, build, info, out_cap)
             fn = self._jit(impl, key=("gather", self._sig, out_cap))
             self._gather_cache[out_cap] = fn
+        return fn
+
+    def _fused_probe_fn(self, out_cap: int):
+        """The single-program probe pipeline (ISSUE 14 tentpole): fused
+        probe steps + key transform + multi-key tuple search + run-end
+        expansion + pair generation + the pytree-at-once gather of every
+        output column on both sides, ONE compiled program per (sig,
+        out_cap).  It also returns the :class:`JoinInfo` pytree so the
+        sizing scalars for the one batched readback — and the overflow
+        re-gather's inputs — ride the same launch instead of a separate
+        probe program."""
+        key = ("fusedprobe", out_cap)
+        fn = self._gather_cache.get(key)
+        if fn is None:
+            def impl(probe, build, bs):
+                info = self._probe_info(probe, build, bs)
+                out = self._gather_impl(probe, build, info, out_cap)
+                return out, info
+            fn = self._jit(impl, key=("fusedprobe", self._sig, out_cap))
+            self._gather_cache[key] = fn
         return fn
 
     def _pair_batch(self, probe: ColumnarBatch, build: ColumnarBatch,
@@ -696,19 +727,41 @@ class BaseJoinExec(PhysicalPlan):
             return
         from ...config import JOIN_OUTPUT_CHUNK_ROWS
         chunk = int(tctx.conf.get(JOIN_OUTPUT_CHUNK_ROWS))
-        info = self._join_info(probe, build, tctx)
         spec_cap = self._speculative_capacity(probe, build, tctx)
+        speculating = spec_cap is not None \
+            and spec_cap <= bucket_capacity(chunk)
 
         def total_out_of(tot, unl, unb):
             return tot + (unl if how in ("left", "full") else 0) + \
                 (unb if how == "full" else 0)
 
-        if spec_cap is not None and spec_cap <= bucket_capacity(chunk):
-            with self._stage(tctx, "gather"):
-                out = self._gather_fn(spec_cap)(probe, build, info)
-            tot, unl, unb = self._fetch_totals(info, tctx)
-            self._record_selectivity(probe, tot)
-            total_out = total_out_of(tot, unl, unb)
+        if speculating and self._fused_probe_on(tctx) \
+                and self._fast_path_on(tctx):
+            # single-program probe pipeline: search + expansion + pair
+            # generation + the all-columns gather are ONE launch, with the
+            # JoinInfo returned alongside for the one batched sizing
+            # readback.  At most a second launch (the exact re-gather) on
+            # bucket overflow — the fused-vs-two-program choice is a host
+            # decision, so outputs stay bit-identical either way.
+            from .base import count_stage_dispatch
+            count_stage_dispatch()
+            bs = self._get_build_side(build, tctx)
+            STATS["fastpath_probes"] += 1
+            STATS["fused_probes"] += 1
+            tctx.inc_metric("joinFastpathProbes")
+            tctx.inc_metric("joinFusedProbes")
+            with self._stage(tctx, "fusedProbe"):
+                out, info = self._fused_probe_fn(spec_cap)(probe, build, bs)
+        else:
+            info = self._join_info(probe, build, tctx)
+            if speculating:
+                with self._stage(tctx, "gather"):
+                    out = self._gather_fn(spec_cap)(probe, build, info)
+
+        tot, unl, unb = self._fetch_totals(info, tctx)
+        self._record_selectivity(probe, tot)
+        total_out = total_out_of(tot, unl, unb)
+        if speculating:
             if total_out <= spec_cap:
                 STATS["spec_hits"] += 1
                 tctx.inc_metric("joinSpecHits")
@@ -719,10 +772,6 @@ class BaseJoinExec(PhysicalPlan):
             # already, so this costs no extra readback)
             STATS["spec_misses"] += 1
             tctx.inc_metric("joinSpecMisses")
-        else:
-            tot, unl, unb = self._fetch_totals(info, tctx)
-            self._record_selectivity(probe, tot)
-            total_out = total_out_of(tot, unl, unb)
         if total_out <= chunk:
             out_cap = bucket_capacity(total_out)
             with self._stage(tctx, "gather"):
@@ -737,7 +786,10 @@ class BaseJoinExec(PhysicalPlan):
             with self._stage(tctx, "gather"):
                 got = fn(probe, build, info,
                          xp.asarray(off, dtype=xp.int64))
-            yield got.shrunk()
+            # chunk row counts are host arithmetic — shrunk() must not pay
+            # a per-chunk num_rows sync (a hidden second blocking readback)
+            yield got.with_known_rows(
+                min(chunk_cap, total_out - off)).shrunk()
 
     # --- helpers ----------------------------------------------------------
     def _empty_batch(self, attrs) -> ColumnarBatch:

@@ -52,6 +52,12 @@ _GENERATION = [0]
 #: per key"); keyed by the human-readable kernel label
 _COMPILE_BY_KEY: Dict[str, Dict[str, float]] = {}
 
+#: per-key LAUNCH accounting (doctor's dispatch-bound evidence names the
+#: top kernel keys); lock-free like _STATS["dispatches"] — a lost
+#: increment under contention is metric noise, a per-launch lock is
+#: hot-path cost.  Keyed by the human-readable kernel label.
+_DISPATCH_BY_KEY: Dict[str, int] = {}
+
 
 class _TrackedKernel:
     """Thin wrapper over a jitted callable that detects re-traces (via
@@ -80,8 +86,15 @@ class _TrackedKernel:
         # Deliberately lock-free — a lost increment under contention is
         # metric noise, a per-launch lock is hot-path cost.
         _STATS["dispatches"] = _STATS["dispatches"] + 1
+        _DISPATCH_BY_KEY[self._label] = \
+            _DISPATCH_BY_KEY.get(self._label, 0) + 1
         if _om.METRICS["on"]:
-            _om.get_registry().inc("device_dispatches_total")
+            reg = _om.get_registry()
+            reg.inc("device_dispatches_total")
+            # kernel-labeled series: the doctor's dispatch-bound verdict
+            # names the top-K launch sources from these
+            reg.inc("device_dispatches_by_kernel_total",
+                    kernel=self._label)
         if not _trace.TRACING["on"]:
             return self._fn(*args, **kwargs)
         _trace.get_tracer().counter("deviceDispatches")
@@ -213,6 +226,12 @@ def compile_stats_by_key() -> Dict[str, Dict[str, float]]:
         return {k: dict(v) for k, v in _COMPILE_BY_KEY.items()}
 
 
+def dispatch_stats_by_key() -> Dict[str, int]:
+    """Per-kernel-key launch counts (label -> dispatches) since the last
+    cache clear — the doctor's dispatch-bound evidence source."""
+    return dict(_DISPATCH_BY_KEY)
+
+
 def clear_cache() -> None:
     """Drop every cached program and the learned state coupled to them.
 
@@ -231,6 +250,7 @@ def clear_cache() -> None:
         _STATS["compiles"] = 0
         _STATS["compile_ms"] = 0.0
         _STATS["dispatches"] = 0
+        _DISPATCH_BY_KEY.clear()
     # stale group-size speculations point at programs just dropped; a
     # speculated miss would recompile a size that may immediately
     # mis-speculate

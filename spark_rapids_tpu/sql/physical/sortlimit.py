@@ -42,11 +42,59 @@ class SortExec(PhysicalPlan):
         self._bound = [SortOrder(bind_references(o.child, child.output),
                                  o.ascending, o.nulls_first)
                        for o in self.orders]
+        #: whole-stage sort terminal (docs/whole_stage.md): an absorbed
+        #: upstream Filter/Project chain rides the first-touch sort
+        #: program (_stage_fn); the pure-sort program (_fn) stays
+        #: separate because the out-of-core merge re-sorts batches the
+        #: chain already processed (its steps are not idempotent)
+        self._pre_steps: tuple = ()
+        self._out_attrs = None
+        # programs built lazily on first use (whole-stage laziness
+        # contract — plan construction registers nothing)
+        self._fn_cache = None
+        self._stage_fn_cache = None
+
+    @property
+    def _fn(self):
+        """Pure-sort program: merge-safe (no absorbed steps)."""
+        if self._fn_cache is None:
+            from .kernel_cache import exprs_key
+            self._fn_cache = self._jit(self._compute,
+                                       key=(exprs_key(self._bound),))
+        return self._fn_cache
+
+    @property
+    def _stage_fn(self):
+        """First-touch program: absorbed chain + compaction + sort, one
+        launch.  Without absorbed steps this IS the pure-sort program."""
+        if not self._pre_steps:
+            return self._fn
+        if self._stage_fn_cache is None:
+            self._stage_fn_cache = self._jit(self._stage_compute,
+                                             key=self._fuse_sig())
+        return self._stage_fn_cache
+
+    def _fuse_sig(self):
         from .kernel_cache import exprs_key
-        self._fn = self._jit(self._compute, key=(exprs_key(self._bound),))
+        return (exprs_key(self._bound),
+                ("stage",) + tuple(s._fuse_key() for s in self._pre_steps))
+
+    def absorb_pre_steps(self, steps, new_child) -> None:
+        """Fuse an upstream Filter/Project chain into this sort's
+        first-touch program (fusion.py sort/window terminal).  The chain
+        reproduced the schema the orders were bound against, so the bound
+        sort keys stay valid; fused filters compact INSIDE the program
+        (the sort gather consumes the survivors directly)."""
+        self._pre_steps = tuple(steps)
+        self._out_attrs = list(steps[-1].output)
+        self.children = (new_child,)
+        self._fn_cache = None
+        self._stage_fn_cache = None
 
     @property
     def output(self):
+        if self._pre_steps:
+            return self._out_attrs
         return self.children[0].output
 
     def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
@@ -59,8 +107,26 @@ class SortExec(PhysicalPlan):
         cols = tuple(c.gather(perm, live) for c in batch.columns)
         return ColumnarBatch(batch.names, cols, batch.num_rows)
 
+    def _stage_compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Absorbed chain + compaction + sort, inside one program — the
+        compaction's gather and the sort's permutation gather fuse."""
+        from .basic import compact_batch
+        xp = self.xp
+        mask = batch.row_mask()
+        for s in self._pre_steps:
+            batch, mask = s._fuse_step(batch, mask, xp)
+        if self._pre_steps:
+            batch = compact_batch(xp, batch, mask)
+        return self._compute(batch)
+
     def execute(self, pid, tctx):
-        batches = list(self.children[0].execute(pid, tctx))
+        yield from self.execute_batches(
+            list(self.children[0].execute(pid, tctx)), tctx)
+
+    def execute_batches(self, batches, tctx):
+        """Sort an already-materialized batch list (WindowExec's stage
+        terminal feeds its key-batched fallback from here so the absorbed
+        chain still rides the sort program)."""
         if not batches:
             return
         from ...config import SORT_OOC_TARGET_ROWS
@@ -73,7 +139,15 @@ class SortExec(PhysicalPlan):
             yield from self._out_of_core(batches, target)
             return
         merged = ColumnarBatch.concat(batches) if len(batches) > 1 else batches[0]
-        out = self._fn(merged)
+        from .base import count_stage_dispatch
+        count_stage_dispatch()
+        out = self._stage_fn(merged)
+        if self._pre_steps:
+            # absorbed filters can drop rows, so the count is no longer
+            # host-known — only bounded by the pre-filter total
+            out.with_rows_bound(total)
+            yield out
+            return
         known = getattr(merged, "_nrows_host", None)
         if known is not None:
             out.with_known_rows(known)  # sort permutes, never drops rows
@@ -99,8 +173,16 @@ class SortExec(PhysicalPlan):
             if b.num_rows_int > 0]
         runs: list = []
         try:
-            for sorted_b in with_retry(spillables,
-                                       lambda sb: self._fn(sb.get()),
+            # first touch runs the STAGE program (absorbed chain + sort);
+            # the phase-2 merge below re-sorts already-processed rows and
+            # must use the pure-sort program only
+            from .base import count_stage_dispatch
+
+            def run_sort(sb):
+                count_stage_dispatch()
+                return self._stage_fn(sb.get())
+
+            for sorted_b in with_retry(spillables, run_sort,
                                        split_spillable_in_half):
                 run: deque = deque()
                 n = sorted_b.num_rows_int
@@ -158,6 +240,7 @@ class SortExec(PhysicalPlan):
                         hb.num_rows))
                 union = (ColumnarBatch.concat(heads) if len(heads) > 1
                          else heads[0])
+                count_stage_dispatch()
                 merged = self._fn(union)
                 e = min(target, merged.num_rows_int)
                 emit = merged.sliced(0, e)
@@ -190,7 +273,11 @@ class SortExec(PhysicalPlan):
                     sb.close()
 
     def simple_string(self):
-        return f"{self.node_name()} [{', '.join(o.sql() for o in self.orders)}]"
+        s = f"{self.node_name()} [{', '.join(o.sql() for o in self.orders)}]"
+        if self._pre_steps:
+            chain = " -> ".join(st.node_name() for st in self._pre_steps)
+            s += f" [fusedPre: {chain}]"
+        return s
 
 
 class TakeOrderedAndProjectExec(PhysicalPlan):

@@ -81,17 +81,73 @@ class WindowExec(PhysicalPlan):
         self._bound_orders = [
             SortOrder(bind_references(o.child, out), o.ascending,
                       o.nulls_first) for o in self.order_spec]
+        #: whole-stage window terminal (docs/whole_stage.md): the
+        #: planner-inserted partition sort below this node, absorbed by
+        #: fuse_stages so single-chunk inputs evaluate chain + sort +
+        #: window in ONE program (kept as an exec for the key-batched
+        #: large-input fallback)
+        self._sorter = None
+        self._in_attrs = None
+        # programs built lazily on first use (whole-stage laziness
+        # contract — plan construction registers nothing)
+        self._fn_cache = None
+        self._fused_fn_cache = None
+        self._boundary_fn_cache = None
+
+    def _win_key(self):
         from .kernel_cache import exprs_key
-        self._fn = self._jit(
-            self._compute,
-            key=(exprs_key(a.child for a in self._bound_exprs),
-                 tuple(a.name for a in self.window_exprs),
-                 exprs_key(self._bound_parts),
-                 exprs_key(self._bound_orders)))
+        return (exprs_key(a.child for a in self._bound_exprs),
+                tuple(a.name for a in self.window_exprs),
+                exprs_key(self._bound_parts),
+                exprs_key(self._bound_orders))
+
+    @property
+    def _fn(self):
+        if self._fn_cache is None:
+            self._fn_cache = self._jit(self._compute, key=self._win_key())
+        return self._fn_cache
+
+    @property
+    def _fused_fn(self):
+        """All-in-one stage-terminal program: absorbed chain + compaction
+        + partition sort + window evaluation, one launch.  Correct only
+        for a single key-complete chunk (sorting inside the program is
+        then exactly the planner's sort) — the caller guarantees it."""
+        if self._fused_fn_cache is None:
+            def impl(batch):
+                return self._compute(self._sorter._stage_compute(batch))
+            self._fused_fn_cache = self._jit(
+                impl,
+                key=("wstage",) + self._win_key() + self._sorter._fuse_sig())
+        return self._fused_fn_cache
+
+    def can_absorb_sort(self, sort_exec) -> bool:
+        """The sort below must be exactly the partition sort the planner
+        inserts for this window — (partition keys asc nulls-first, then
+        the order spec) — or absorbing it would change what the window's
+        segment scan sees."""
+        from .kernel_cache import exprs_key
+        want = exprs_key(
+            [SortOrder(e, True, True) for e in self._bound_parts]
+            + self._bound_orders)
+        return exprs_key(sort_exec._bound) == want
+
+    def absorb_sort(self, sort_exec) -> None:
+        """Absorb the planner's partition sort (fusion.py window
+        terminal).  The sort exec is retained to drive the key-batched
+        fallback for inputs too large for one chunk."""
+        self._sorter = sort_exec
+        self._in_attrs = list(sort_exec.output)
+        self.children = tuple(sort_exec.children)
+        self._fn_cache = None
+        self._fused_fn_cache = None
+        self._boundary_fn_cache = None
 
     @property
     def output(self):
-        return list(self.children[0].output) + [
+        base = (self._in_attrs if self._sorter is not None
+                else list(self.children[0].output))
+        return list(base) + [
             a.to_attribute() for a in self.window_exprs]
 
     # ------------------------------------------------------------------
@@ -366,11 +422,13 @@ class WindowExec(PhysicalPlan):
             first_gt = xp.min(xp.where(is_start & (idx > 0), idx,
                                        batch.num_rows))
             return last_le, first_gt
-        from .kernel_cache import exprs_key
-        return self._jit(impl, key=("wbound",
-                                    exprs_key(self._bound_parts)))
+        if self._boundary_fn_cache is None:
+            from .kernel_cache import exprs_key
+            self._boundary_fn_cache = self._jit(
+                impl, key=("wbound", exprs_key(self._bound_parts)))
+        return self._boundary_fn_cache
 
-    def _execute_key_batched(self, pid, tctx, target: int):
+    def _execute_key_batched(self, pid, tctx, target: int, source=None):
         """Process sorted input in key-complete chunks (reference
         ``GpuKeyBatchingIterator.scala``): every chunk holds whole
         partitions and at most ~``target`` rows (grown to the largest
@@ -410,10 +468,15 @@ class WindowExec(PhysicalPlan):
             sb.close()
             return out
 
+        def run_window(s):
+            from .base import count_stage_dispatch
+            count_stage_dispatch()
+            return self._fn(s.get())
+
         def process(head):
             sb = SpillableColumnarBatch.create(head,
                                                ACTIVE_ON_DECK_PRIORITY)
-            return with_retry([sb], lambda s: self._fn(s.get()),
+            return with_retry([sb], run_window,
                               split=split_at_partition)
 
         def emit_chunks(final: bool):
@@ -458,8 +521,10 @@ class WindowExec(PhysicalPlan):
                 tctx.inc_metric("windowKeyBatches")
                 yield from process(merged)
 
+        if source is None:
+            source = self.children[0].execute(pid, tctx)
         try:
-            for batch in self.children[0].execute(pid, tctx):
+            for batch in source:
                 n = batch.num_rows_int
                 if n == 0:
                     continue
@@ -475,6 +540,9 @@ class WindowExec(PhysicalPlan):
     def execute(self, pid, tctx):
         from ...config import WINDOW_BATCH_TARGET_ROWS
         target = int(tctx.conf.get(WINDOW_BATCH_TARGET_ROWS))
+        if self._sorter is not None:
+            yield from self._execute_stage_terminal(pid, tctx, target)
+            return
         if self._bound_parts:
             yield from self._execute_key_batched(pid, tctx, target)
             return
@@ -485,11 +553,43 @@ class WindowExec(PhysicalPlan):
             return
         merged = (ColumnarBatch.concat(batches) if len(batches) > 1
                   else batches[0])
+        from .base import count_stage_dispatch
+        count_stage_dispatch()
         yield self._fn(merged)
 
+    def _execute_stage_terminal(self, pid, tctx, target: int):
+        """Sort/window stage terminal: the absorbed partition sort (and
+        any chain absorbed into it) rides the window's program.  A
+        single key-complete chunk — the whole input fits ``target`` rows,
+        or there are no partition keys to cut on — evaluates chain +
+        sort + window in ONE launch; larger inputs run the sort's stage
+        program once and feed the sorted stream to the key-complete
+        chunker (still dropping every per-op boundary dispatch)."""
+        s = self._sorter
+        # re-sync like FusedStageExec._execute_terminal: planner rewrites
+        # above this node must stay visible to the retained sort
+        s.children = self.children
+        batches = list(self.children[0].execute(pid, tctx))
+        if not batches:
+            return
+        total = sum(b.num_rows_bound for b in batches)
+        if not self._bound_parts or total <= target:
+            merged = (ColumnarBatch.concat(batches) if len(batches) > 1
+                      else batches[0])
+            tctx.inc_metric("windowStageFusedBatches")
+            from .base import count_stage_dispatch
+            count_stage_dispatch()
+            yield self._fused_fn(merged)
+            return
+        yield from self._execute_key_batched(
+            pid, tctx, target, source=s.execute_batches(batches, tctx))
+
     def simple_string(self):
-        return (f"{self.node_name()} "
-                f"[{', '.join(a.child.sql() for a in self.window_exprs)}]")
+        s = (f"{self.node_name()} "
+             f"[{', '.join(a.child.sql() for a in self.window_exprs)}]")
+        if self._sorter is not None:
+            s += f" [fusedSort: {self._sorter.simple_string()}]"
+        return s
 
 
 class WindowGroupLimitExec(PhysicalPlan):

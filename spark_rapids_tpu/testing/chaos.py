@@ -146,7 +146,8 @@ def run_soak(rows: int = 20_000, seed: int = 11,
              strict: bool = True,
              pipeline: bool = False,
              encoded: bool = False,
-             whole_stage: bool = False) -> dict:
+             whole_stage: bool = False,
+             coalesce: bool = False) -> dict:
     """Returns the soak report; raises AssertionError on any parity or
     counter-visibility failure.  ``strict=False`` (reduced smoke runs)
     keeps the bit-parity and faults-injected asserts but skips the
@@ -173,7 +174,14 @@ def run_soak(rows: int = 20_000, seed: int = 11,
     unfused per-op baseline): fused stage programs, absorbed aggregate /
     probe terminals, and the donation-safety guard must stay
     bit-identical under injected data-movement faults — the ISSUE 7
-    acceptance leg (docs/whole_stage.md)."""
+    acceptance leg (docs/whole_stage.md).
+
+    ``coalesce=True`` additionally arms the ISSUE 14 dispatch set on the
+    CHAOS session — the small-batch dispatch coalescer, the sort/window
+    stage terminals, and the fused single-program join probe — against
+    the same serial unfused clean baseline: coalesced batch-of-batches
+    launches and fused terminals must recover bit-identically under
+    injected faults."""
     import spark_rapids_tpu as srt
     from ..config import RapidsConf
     from ..memory.spill import BufferCatalog
@@ -202,10 +210,17 @@ def run_soak(rows: int = 20_000, seed: int = 11,
             # encoded-under-faults == raw-without-faults, not just
             # encoded == encoded
             clean_conf["spark.rapids.tpu.sql.encoded.enabled"] = False
-        if whole_stage:
+        if whole_stage or coalesce:
             # clean baseline fully UNFUSED: the soak proves
             # fused-and-donating-under-faults == per-op-without-faults
             clean_conf["spark.rapids.tpu.sql.fusion.enabled"] = False
+        if coalesce:
+            clean_conf.update({
+                "spark.rapids.tpu.sql.dispatch.coalesce.enabled": False,
+                "spark.rapids.tpu.sql.join.fusedProbe.enabled": False,
+                "spark.rapids.tpu.sql.wholeStage.sortWindowTerminal"
+                ".enabled": False,
+            })
         clean_sess = srt.session(conf=RapidsConf.get_global().copy(
             clean_conf))
         clean: Dict[str, pd.DataFrame] = {}
@@ -221,11 +236,20 @@ def run_soak(rows: int = 20_000, seed: int = 11,
         })
         if encoded:
             chaos_conf["spark.rapids.tpu.sql.encoded.enabled"] = True
-        if whole_stage:
+        if whole_stage or coalesce:
             chaos_conf.update({
                 "spark.rapids.tpu.sql.fusion.enabled": True,
                 "spark.rapids.tpu.sql.wholeStage.enabled": True,
                 "spark.rapids.tpu.sql.wholeStage.donation.enabled": True,
+            })
+        if coalesce:
+            chaos_conf.update({
+                "spark.rapids.tpu.sql.dispatch.coalesce.enabled": True,
+                # small cap so groups actually form at soak row counts
+                "spark.rapids.tpu.sql.dispatch.coalesce.maxBatches": 4,
+                "spark.rapids.tpu.sql.join.fusedProbe.enabled": True,
+                "spark.rapids.tpu.sql.wholeStage.sortWindowTerminal"
+                ".enabled": True,
             })
         if pipeline:
             chaos_conf.update({
@@ -282,7 +306,7 @@ def run_soak(rows: int = 20_000, seed: int = 11,
         report = {
             "rows": rows, "seed": seed, "sites": sites,
             "pipeline": pipeline, "encoded": encoded,
-            "whole_stage": whole_stage,
+            "whole_stage": whole_stage, "coalesce": coalesce,
             "queries": per_query, "counters": counters,
             "faults_by_site": by_site,
             "bit_identical": not mismatches,
@@ -454,6 +478,14 @@ def main() -> None:
         # (ISSUE 9 acceptance — docs/serving.md)
         multi_session = True
         argv.remove("--multi-session")
+    coalesce = False
+    if "--coalesce" in argv:
+        # dispatch soak: chaos session with the coalescer, sort/window
+        # stage terminals, and the fused join probe armed vs the serial
+        # unfused clean baseline (ISSUE 14 acceptance: bit-identical
+        # under faults with the dispatch set on)
+        coalesce = True
+        argv.remove("--coalesce")
     if "--whole-stage" in argv:
         # whole-stage soak: chaos session with fusion + donation forced
         # on vs a fully UNFUSED serial clean baseline (ISSUE 7
@@ -493,11 +525,13 @@ def main() -> None:
         return
     report = run_soak(rows, seed=seed, trace_path=trace_path,
                       strict=not pipeline, pipeline=pipeline,
-                      encoded=encoded, whole_stage=whole_stage)
+                      encoded=encoded, whole_stage=whole_stage,
+                      coalesce=coalesce)
     print(json.dumps(report, indent=2))
     mode = ("pipelined " if pipeline else "") + \
         ("encoded " if encoded else "") + \
-        ("whole-stage " if whole_stage else "")
+        ("whole-stage " if whole_stage else "") + \
+        ("coalesce-armed " if coalesce else "")
     print(f"CHAOS SOAK PASSED: {mode}results bit-identical under "
           f"{report['counters']['faultsInjected']} injected faults")
 

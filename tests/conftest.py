@@ -79,6 +79,11 @@ QUICK_MODULES = {
     # HTTP server, SLO arithmetic, wire trace stitching) — fast, and a
     # regression here blinds every production scrape target
     "test_telemetry",
+    # dispatch budgets (ISSUE 14): per-shape launch counts, the fused
+    # join probe's <=1-readback contract, and dispatch-coalescer parity
+    # are tier-1 — a launch-count regression is a silent perf cliff on
+    # the tunnel that no correctness test would ever fail
+    "test_dispatch_budget",
 }
 
 

@@ -1,0 +1,333 @@
+"""Per-shape DISPATCH-BUDGET regression suite (ISSUE 14): the perf model
+is launches-per-batch, and a silent regression there never fails a
+correctness test — so each canonical shape pins its compiled-program
+launch budget, fused vs kill-switched, and asserts bit parity between
+the two.  Also covers the fused join probe's readback budget (<= 1
+blocking host fetch per probe batch, hit AND overflow paths) and the
+dispatch coalescer (N same-signature small batches -> ONE launch).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.physical import join as J
+from spark_rapids_tpu.sql.physical import kernel_cache as kc
+from spark_rapids_tpu.sql.window_api import Window as W
+
+ROWS = 3000
+
+#: ISSUE 14 acceptance: a probe batch costs at most this many launches
+#: end to end on the fused path (the pre-fusion baseline was ~107)
+JOIN_LAUNCH_BUDGET = 12
+
+
+def _tables():
+    rng = np.random.default_rng(29)
+    fact = pa.table({
+        "k": rng.integers(0, 9, ROWS).astype(np.int64),
+        "q": rng.integers(0, 100, ROWS).astype(np.int64),
+        "v": rng.random(ROWS),
+        "fk": rng.integers(0, 160, ROWS).astype(np.int64),
+    })
+    dim = pa.table({"pk": np.arange(0, 160, 2, dtype=np.int64),
+                    "w": rng.random(80)})
+    return fact, dim
+
+
+FACT, DIM = _tables()
+
+
+def _session(fused=True, encoded=False, coalesce=True, **extra):
+    over = {
+        "spark.rapids.tpu.sql.fusion.enabled": fused,
+        "spark.rapids.tpu.sql.wholeStage.enabled": fused,
+        "spark.rapids.tpu.sql.wholeStage.sortWindowTerminal.enabled":
+            fused,
+        "spark.rapids.tpu.sql.join.fusedProbe.enabled": fused,
+        "spark.rapids.tpu.sql.encoded.enabled": encoded,
+        "spark.rapids.tpu.sql.dispatch.coalesce.enabled": coalesce,
+    }
+    over.update(extra)
+    return srt.session(conf=RapidsConf.get_global().copy(over))
+
+
+def _canon(table: pa.Table) -> pd.DataFrame:
+    df = table.to_pandas()
+    return df.sort_values(list(df.columns), kind="mergesort") \
+        .reset_index(drop=True)
+
+
+def _q_join(sess):
+    f = sess.create_dataframe(FACT, num_partitions=2)
+    d = sess.create_dataframe(DIM)
+    return (f.filter(F.col("q") < 70)
+            .withColumn("y", F.col("v") * 3.0)
+            .join(d, f.fk == d.pk, "inner"))
+
+
+def _q_sort(sess):
+    f = sess.create_dataframe(FACT)
+    return (f.filter(F.col("q") < 70)
+            .withColumn("y", F.col("v") * 2.0)
+            .orderBy("k", "y"))
+
+
+def _q_window(sess):
+    f = sess.create_dataframe(FACT)
+    w = W.partitionBy("k").orderBy("q")
+    return (f.filter(F.col("q") < 70)
+            .withColumn("y", F.col("v") * 2.0)
+            .withColumn("rn", F.row_number().over(w)))
+
+
+SHAPES = {"join": _q_join, "sort": _q_sort, "window": _q_window}
+
+
+def _run(shape, fused, encoded, coalesce):
+    sess = _session(fused=fused, encoded=encoded, coalesce=coalesce)
+    q = SHAPES[shape](sess)
+    q.collect()  # warm: compiles + speculation learning
+    kc.clear_cache()
+    out = _canon(q.collect())
+    stats = kc.cache_stats()
+    return out, stats, dict(sess.last_query_metrics)
+
+
+# --------------------------------------------------------------------------
+# fused vs kill-switched parity x encoded x coalescer
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", sorted(SHAPES))
+@pytest.mark.parametrize("encoded", [False, True])
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_fused_parity_and_budget(shape, encoded, coalesce):
+    """Fused output is bit-identical to the kill-switched per-op
+    baseline under every encoded/coalescer combination, and never costs
+    MORE launches than the baseline."""
+    out_f, st_f, _ = _run(shape, True, encoded, coalesce)
+    out_u, st_u, _ = _run(shape, False, encoded, coalesce)
+    pd.testing.assert_frame_equal(out_f, out_u)
+    assert st_f["dispatches"] <= st_u["dispatches"], (
+        f"{shape}: fused path launched MORE programs "
+        f"({st_f['dispatches']} > {st_u['dispatches']})")
+
+
+@pytest.mark.parametrize("shape", ["sort", "window"])
+def test_stage_terminal_dispatch_reduction(shape):
+    """Sort/window stage terminals: >= 2x fewer stage-scope launches
+    than the kill-switched per-op chain (ISSUE 14 acceptance)."""
+    _, st_f, m_f = _run(shape, True, False, False)
+    _, st_u, m_u = _run(shape, False, False, False)
+    fused = int(m_f.get("stageOpDispatches", 0)) or st_f["dispatches"]
+    unfused = int(m_u.get("stageOpDispatches", 0)) or st_u["dispatches"]
+    assert fused * 2 <= unfused, (
+        f"{shape}: stage dispatches fused={fused} unfused={unfused}")
+
+
+def test_join_launches_per_probe_batch_budget():
+    """The fused probe pipeline keeps the whole join under the
+    per-probe-batch launch budget (search + expansion + pairs + gather
+    in ONE program; the pre-fusion baseline was ~107 launches)."""
+    _, stats, m = _run("join", True, False, False)
+    probes = int(m.get("joinFastpathProbes", 0)
+                 + m.get("joinFallbackProbes", 0))
+    assert probes > 0, m
+    assert int(m.get("joinFusedProbes", 0)) > 0, m
+    per_probe = stats["dispatches"] / probes
+    assert per_probe <= JOIN_LAUNCH_BUDGET, (
+        f"{per_probe:.1f} launches/probe batch > {JOIN_LAUNCH_BUDGET} "
+        f"(dispatches={stats['dispatches']} probes={probes})")
+
+
+# --------------------------------------------------------------------------
+# readback budget: <= 1 blocking host fetch per probe batch, both paths
+# --------------------------------------------------------------------------
+
+def _readbacks_for(chunk_rows=None):
+    over = {}
+    if chunk_rows is not None:
+        over["spark.rapids.sql.join.outputChunkRows"] = chunk_rows
+    sess = _session(**over)
+    q = _q_join(sess)
+    q.collect()  # warm + selectivity learning
+    before = dict(J.STATS)
+    out = _canon(q.collect())
+    m = dict(sess.last_query_metrics)
+    probes = (J.STATS["fastpath_probes"] - before["fastpath_probes"]) + \
+        (J.STATS["fallback_probes"] - before["fallback_probes"])
+    reads = J.STATS["host_readbacks"] - before["host_readbacks"]
+    return out, probes, reads, m
+
+
+def test_join_hit_path_single_readback():
+    out, probes, reads, _ = _readbacks_for()
+    assert probes > 0
+    assert reads <= probes, (
+        f"{reads} blocking readbacks for {probes} probe batches")
+    assert len(out) > 0
+
+
+def test_join_overflow_and_chunked_paths_single_readback():
+    """Forcing tiny output chunks drives every probe batch down the
+    overflow/chunked path; the re-gather and per-chunk row counts are
+    host arithmetic over the ONE sizing fetch — a second blocking
+    readback per probe batch is the regression this test pins."""
+    base, _, _, _ = _readbacks_for()
+    out, probes, reads, m = _readbacks_for(chunk_rows=256)
+    assert probes > 0
+    assert reads <= probes, (
+        f"{reads} blocking readbacks for {probes} probe batches on the "
+        f"chunked path")
+    pd.testing.assert_frame_equal(out, base)  # chunking is invisible
+
+
+# --------------------------------------------------------------------------
+# dispatch coalescer
+# --------------------------------------------------------------------------
+
+def _stage_with_stub_child(sess, k):
+    """A real planned FusedStageExec whose child is replaced by a stub
+    yielding the scan's batch K times — partition streams are naturally
+    single-batch in this engine, so coalescer engagement is pinned at
+    the exec level."""
+    from spark_rapids_tpu.sql.physical.fusion import FusedStageExec
+    df = (sess.create_dataframe(FACT)
+          .filter(F.col("q") < 80)
+          .withColumn("y", F.col("v") * 2.0)
+          .select("k", "y"))
+    plan = sess.physical_plan(df)
+    stack = [plan]
+    stage = None
+    while stack:
+        n = stack.pop()
+        if isinstance(n, FusedStageExec):
+            stage = n
+            break
+        stack.extend(n.children)
+    assert stage is not None, plan.tree_string()
+    inner = stage.children[0]
+
+    class Stub:
+        output = inner.output
+        children = ()
+
+        def execute(self, pid, tctx):
+            for _ in range(k):
+                yield from inner.execute(pid, tctx)
+
+        def num_partitions(self):
+            return 1
+
+    stage.children = (Stub(),)
+    stage._fns = {}
+    return stage
+
+
+def _drive(stage, coalesce, max_batches=8):
+    from spark_rapids_tpu.sql.physical.base import TaskContext
+    conf = RapidsConf.get_global().copy({
+        "spark.rapids.tpu.sql.dispatch.coalesce.enabled": coalesce,
+        "spark.rapids.tpu.sql.dispatch.coalesce.maxBatches": max_batches,
+    })
+    stage._fns = {}
+    kc.clear_cache()
+    tctx = TaskContext(0, conf)
+    with tctx.as_current():
+        outs = list(stage.execute(0, tctx))
+    return outs, kc.cache_stats()["dispatches"], dict(tctx.metrics)
+
+
+def test_coalescer_one_launch_and_parity():
+    sess = _session()
+    stage = _stage_with_stub_child(sess, k=5)
+    outs_on, d_on, m_on = _drive(stage, True)
+    outs_off, d_off, m_off = _drive(stage, False)
+    assert d_on == 1 and d_off == 5, (d_on, d_off)
+    assert m_on["dispatchCoalescedBatches"] == 5
+    assert m_on["dispatchCoalescedLaunches"] == 1
+    assert "dispatchCoalescedLaunches" not in m_off
+    assert len(outs_on) == len(outs_off) == 5
+    for a, b in zip(outs_on, outs_off):
+        assert a.num_rows_int == b.num_rows_int
+        for i in range(len(a.names)):
+            np.testing.assert_array_equal(
+                np.asarray(a.column(i).data)[:a.num_rows_int],
+                np.asarray(b.column(i).data)[:b.num_rows_int])
+
+
+def test_coalescer_respects_max_batches():
+    sess = _session()
+    stage = _stage_with_stub_child(sess, k=5)
+    outs, dispatches, m = _drive(stage, True, max_batches=2)
+    # 5 batches at maxBatches=2 -> groups of 2+2+1: two coalesced
+    # launches + one singleton
+    assert len(outs) == 5
+    assert m["dispatchCoalescedLaunches"] == 2
+    assert m["dispatchCoalescedBatches"] == 4
+    assert dispatches == 3, dispatches
+
+
+def test_coalescer_declines_encoded_batches():
+    """Encoded columns carry per-instance aux data (dictionary identity)
+    — stacking them would collide treedefs, so their signature is None
+    and each batch runs the per-batch program."""
+    from spark_rapids_tpu.columnar.encoded import DictEncodedColumn
+    from spark_rapids_tpu.sql.physical.fusion import coalesce_signature
+    sess = _session(encoded=True)
+    df = (sess.create_dataframe(pa.table(
+        {"s": pa.array(["a", "b", "a", "c"] * 8)}))
+        .filter(F.col("s") <= "b"))
+    plan = sess.physical_plan(df)
+    # upload through the planned scan and check the signature contract
+    from spark_rapids_tpu.sql.physical.base import TaskContext
+    tctx = TaskContext(0, sess._conf)
+    with tctx.as_current():
+        stack = [plan]
+        scan = None
+        while stack:
+            n = stack.pop()
+            if not n.children:
+                scan = n
+            stack.extend(n.children)
+        batches = list(scan.execute(0, tctx))
+    assert batches
+    b = batches[0]
+    if any(isinstance(c, DictEncodedColumn) for c in b.columns):
+        assert coalesce_signature(b) is None
+    else:  # encoded session may keep plain columns for tiny tables
+        assert coalesce_signature(b) is not None
+
+
+def test_coalesced_span_carries_n():
+    from spark_rapids_tpu.observability import tracer as OT
+    sess = _session()
+    stage = _stage_with_stub_child(sess, k=3)
+    prev = OT.TRACING["on"]
+    OT.get_tracer().reset(512)
+    OT.TRACING["on"] = True
+    try:
+        _drive(stage, True)
+        events = OT.get_tracer().snapshot()
+    finally:
+        OT.TRACING["on"] = prev
+        OT.get_tracer().reset()
+    spans = [e for e in events if e.get("cat") == "stage"
+             and (e.get("args") or {}).get("coalesced_n")]
+    assert spans, events
+    assert spans[0]["args"]["coalesced_n"] == 3
+
+
+def test_plan_construction_registers_no_kernels():
+    """Laziness contract extends to the new terminals: building a plan
+    with sort/window terminals and fused probes must not register any
+    kernel-cache entry (cold planning stays readback- and compile-free)."""
+    sess = _session()
+    before = kc.cache_stats()["misses"]
+    for shape in sorted(SHAPES):
+        sess.physical_plan(SHAPES[shape](sess))
+    assert kc.cache_stats()["misses"] == before

@@ -76,6 +76,17 @@ print('ALIVE', float(jnp.sum(jnp.ones(8))))" 2>/dev/null | grep ALIVE)
           cycle_files="$cycle_files $CAP/run_${ts2}_${mode}.out"
           echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture $mode done" >> "$LOG"
         done
+        # leak-sentinel soak on the SAME live window (ISSUE 14): steady
+        # dispatch/memory behaviour on-chip is evidence the coalescer and
+        # fused probe don't leak buffers across queries.  Short and last
+        # — the bench numbers above must never wait behind a soak.
+        ts3=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+        echo "$ts3 capture soak start" >> "$LOG"
+        timeout 700 python tools/leak_sentinel.py --seconds 600 \
+            --tenants 2 --rows 8000 \
+            --out "$CAP/soak_${ts3}.json" \
+            > "$CAP/soak_${ts3}.out" 2> "$CAP/soak_${ts3}.err"
+        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) capture soak done" >> "$LOG"
         # stamp capture_done ONLY if this cycle banked a record that
         # bench.py's replay will actually accept (the SAME predicate —
         # bench._usable_capture_record — so the two can never drift); a
