@@ -234,13 +234,17 @@ class PhaseTimeout(Exception):
     """A bench phase exhausted its own watchdog budget."""
 
 
-def _run_phase(label: str, fn, budget_s: float):
+def _run_phase(label: str, fn, budget_s: float, result: dict = None):
     """Run one bench phase on a daemon thread under its OWN watchdog
     budget (BENCH_r05 postmortem: a hung join micro consumed the whole
     run's budget and forced a stale replayed capture).  The phase's
     ``budget_ms``/``elapsed_ms``/``timed_out`` are banked into the
     artifact either way; on timeout the thread is abandoned (daemon) and
-    PhaseTimeout raised so the caller can move to the next phase."""
+    PhaseTimeout raised so the caller can move to the next phase.
+
+    ``result`` redirects the phase record into a caller-owned artifact
+    dict (run_shape_set / the perf sentry) instead of the module-global
+    child artifact — those callers bank their own partials."""
     rec = {"budget_ms": int(budget_s * 1000)}
     box: dict = {}
 
@@ -258,8 +262,10 @@ def _run_phase(label: str, fn, budget_s: float):
     rec["elapsed_ms"] = int((time.perf_counter() - t0) * 1000)
     rec["timed_out"] = th.is_alive()
     with _lock:
-        _result.setdefault("phases", {})[label] = rec
-    _bank_partial()
+        (_result if result is None
+         else result).setdefault("phases", {})[label] = rec
+    if result is None:
+        _bank_partial()
     if th.is_alive():
         raise PhaseTimeout(f"phase {label} exceeded its "
                            f"{budget_s:.0f}s budget")
@@ -1146,23 +1152,145 @@ def _measure_lifecycle(rows: int) -> dict:
     }}
 
 
-def _device_responsive(timeout_s: float) -> bool:
-    """Probe the ambient device backend from a daemon thread; a hung TPU
-    tunnel must not take the whole child (and its exit) with it."""
-    ok: list = []
+def _probe_device(timeout_s: float) -> dict:
+    """Cancellable bounded-timeout device probe with a classified
+    outcome (``ok | degraded | timeout | refused``) and per-attempt
+    timing — sentry.device_probe's QueryContext deadline machinery, the
+    same cancellation path queries use.  A hung TPU tunnel orphans one
+    daemon probe thread; it never takes the child (and its exit) with
+    it, and it is never again a free-text "hung" string in the note."""
+    try:
+        from spark_rapids_tpu.observability import sentry as _sentry
+        return _sentry.device_probe(timeout_s)
+    except Exception:  # package half-importable: degrade, don't die
+        box: dict = {}
 
-    def probe():
+        def probe():
+            try:
+                import jax
+                import jax.numpy as jnp
+                float(jnp.sum(jnp.ones(8)))
+                box["platform"] = str(jax.default_backend())
+            except BaseException as e:  # noqa: BLE001 - classified
+                box["error"] = f"{type(e).__name__}: {e}"
+
+        t0 = time.perf_counter()
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        out = {"elapsed_ms": round((time.perf_counter() - t0) * 1000, 1)}
+        if t.is_alive():
+            out["outcome"] = "timeout"
+        elif "error" in box:
+            out["outcome"] = "refused"
+            out["error"] = str(box["error"])[:200]
+        else:
+            plat = box.get("platform")
+            out["outcome"] = ("degraded" if plat in (None, "cpu")
+                              else "ok")
+            if plat:
+                out["platform"] = plat
+        return out
+
+
+# --------------------------------------------------------------------------
+# callable shape-set entrypoint (perf sentry / observability.sentry)
+# --------------------------------------------------------------------------
+
+#: the sentry's default capture set — join/sort/window/coalesce plus the
+#: encoded-vs-raw wire comparison (``coalesce`` is the whole-stage fused
+#: dispatch shape; vocabulary of spark.rapids.tpu.sentry.shapes)
+SHAPE_SET = ("join", "sort", "window", "coalesce", "encoded")
+
+
+def run_shape_set(shapes=None, rows: int = 4_000_000,
+                  budget_s: float = None, artifact_path: str = None,
+                  evidence: str = None, prepack: bool = True) -> dict:
+    """Run the bench shape set as a LIBRARY call (the perf sentry's
+    capture step) instead of the shell-only child protocol.  Each shape
+    runs under its own ``_run_phase`` watchdog with an even split of the
+    remaining budget, banking into a caller-owned artifact dict — one
+    wedged shape forfeits neither the other shapes nor the window.  The
+    artifact is rewritten atomically at ``artifact_path`` after every
+    shape, so a caller that kills this process mid-set still recovers
+    everything that finished.
+
+    ``evidence`` overrides the platform-derived evidence class (the CI
+    simulated-window mode stamps ``live`` while honestly marking
+    ``simulated`` in its ledger record).  Imports jax in THIS process —
+    the sentry daemon calls it via subprocess_shape_set.
+    """
+    shapes = [str(s) for s in (shapes if shapes is not None
+                               else SHAPE_SET)]
+    budget = float(BUDGET_S if budget_s is None else budget_s)
+    deadline = time.time() + budget
+    import jax
+    platform = str(jax.default_backend())
+    art = {"metric": "sentry_shape_set", "value": 0, "unit": "rows/s",
+           "baseline": "pandas-1core", "chips": 1, "rows": int(rows),
+           "platform": platform, "shapes": shapes,
+           "evidence": evidence or ("cpu-fallback" if platform == "cpu"
+                                    else "live")}
+
+    def _bank():
+        if not artifact_path:
+            return
         try:
-            import jax.numpy as jnp
-            float(jnp.sum(jnp.ones(8)))
-            ok.append(True)
-        except BaseException:
-            pass
+            parent = os.path.dirname(os.path.abspath(artifact_path))
+            os.makedirs(parent, exist_ok=True)
+            tmp = f"{artifact_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(art, default=str) + "\n")
+            os.replace(tmp, artifact_path)
+        except OSError:
+            pass  # banking must never take the measurement down
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return bool(ok)
+    if prepack:
+        # same rationale as the orchestrated run: prepack's 'auto' is
+        # off on the CPU platform, and wire accounting must exist on
+        # every capture this produces
+        try:
+            from spark_rapids_tpu.config import RapidsConf
+            RapidsConf.get_global().set(
+                "spark.rapids.tpu.d2h.prepack", "true")
+        except Exception:
+            pass
+    fns = {
+        "join": lambda: _measure_join(min(rows, 4_000_000)),
+        "sort": lambda: _measure_sort(min(rows, 2_000_000)),
+        "window": lambda: _measure_window(min(rows, 2_000_000)),
+        "coalesce": lambda: _measure_whole_stage(
+            min(max(rows // 8, 1), 1_000_000)),
+        "encoded": lambda: _measure_encoded_vs_raw(
+            min(max(rows // 4, 1), 1_000_000)),
+    }
+    notes = [f"unknown shape {s!r} skipped"
+             for s in shapes if s not in fns]
+    todo = [s for s in shapes if s in fns]
+    for i, name in enumerate(todo):
+        remaining = deadline - time.time()
+        if remaining < 10:
+            notes.append(f"budget exhausted before {name}")
+            break
+        slice_s = max(10.0, remaining / max(1, len(todo) - i))
+        try:
+            got = _run_phase(f"shape_{name}", fns[name], slice_s,
+                             result=art)
+            art.setdefault("extra_metrics", {}).update(got or {})
+        except BaseException as e:  # noqa: BLE001 - next shape anyway
+            notes.append(f"{name} shape failed: "
+                         f"{type(e).__name__}: {e}")
+        em = art.get("extra_metrics", {})
+        for k in ("join_rows_per_sec", "sort_rows_per_sec",
+                  "window_rows_per_sec", "whole_stage_rows_per_sec"):
+            if em.get(k):
+                art["value"] = em[k]
+                break
+        _bank()  # each shape banks the moment it completes
+    if notes:
+        art["note"] = "; ".join(notes)
+        _bank()
+    return art
 
 
 def child_main(mode: str) -> None:
@@ -1190,17 +1318,16 @@ def child_main(mode: str) -> None:
         pass
 
     if mode == "device":
-        if not _device_responsive(PROBE_S):
-            sys.stdout.write(json.dumps({"probe": "hung"}) + "\n")
-            sys.stdout.flush()
-            os._exit(3)
-        # the parent extends its patience once the device answers — and
-        # needs the platform to tell a live tunnel from jax silently
-        # falling back to CPU after a failed TPU-plugin init
-        import jax
+        att = _probe_device(PROBE_S)
+        # the probe record IS the verdict line: classified outcome plus
+        # per-attempt timing for the parent's probe_attempts bank; the
+        # platform tells a live tunnel from jax silently falling back to
+        # CPU after a failed TPU-plugin init (outcome=degraded)
         sys.stdout.write(json.dumps(
-            {"probe": "ok", "platform": jax.default_backend()}) + "\n")
+            dict(att, probe=att.get("outcome", "refused"))) + "\n")
         sys.stdout.flush()
+        if att.get("outcome") not in ("ok", "degraded"):
+            os._exit(3)
 
     import jax
     platform = jax.default_backend()
@@ -1651,6 +1778,15 @@ def orchestrate() -> None:
 
     device_result = None
     dev_partials = []
+    attempts = []  # structured per-attempt telemetry (srt-ledger bank)
+
+    def _bank_attempt(at, outcome, rec=None):
+        att = {"at": at, "outcome": outcome}
+        for k in ("elapsed_ms", "platform", "error"):
+            if rec and rec.get(k) is not None:
+                att[k] = rec[k]
+        attempts.append(att)
+
     attempt = 0
     prev_error = None
     while time.time() < deadline - (PROBE_S + 35):
@@ -1663,24 +1799,33 @@ def orchestrate() -> None:
         # clamped so a wedged child can never push us past the deadline
         rec = dev.next_record(min(PROBE_S + 60, deadline - time.time()))
         if rec is None:
-            probes.append(f"{probe_t} wedged")
+            # child died/wedged before a probe verdict landed
+            probes.append(f"{probe_t} timeout")
+            _bank_attempt(probe_t, "timeout")
             dev.kill()
-        elif rec.get("probe") == "hung":
-            probes.append(f"{probe_t} hung")
+        elif rec.get("probe") in ("timeout", "refused", "hung"):
+            # "hung" is the legacy spelling of timeout (pre-sentry child)
+            outcome = ("timeout" if rec.get("probe") == "hung"
+                       else rec["probe"])
+            probes.append(f"{probe_t} {outcome}")
+            _bank_attempt(probe_t, outcome, rec)
             dev.kill()
-        elif rec.get("probe") == "ok" and rec.get("platform") == "cpu":
+        elif rec.get("probe") == "degraded" or (
+                rec.get("probe") == "ok" and rec.get("platform") == "cpu"):
             # the "device" child came up on the ambient CPU platform —
             # a dead tunnel in its fail-fast mode (TPU-plugin init error,
             # jax falls back to CPU).  Its measurement would duplicate
             # the insurance child, so kill it; two in a row means the
             # backend is deterministically CPU-only and retries are
             # pointless.
-            probes.append(f"{probe_t} ok-cpu")
+            probes.append(f"{probe_t} degraded")
+            _bank_attempt(probe_t, "degraded", rec)
             dev.kill()
-            if len(probes) >= 2 and probes[-2].endswith(" ok-cpu"):
+            if len(probes) >= 2 and probes[-2].endswith(" degraded"):
                 break
         elif rec.get("probe") == "ok":
             probes.append(f"{probe_t} ok")
+            _bank_attempt(probe_t, "ok", rec)
             # phase 2: device is answering — give it the rest of the
             # budget, and stop the insurance run from contending for CPU
             # while the device child times its pandas baseline
@@ -1702,6 +1847,8 @@ def orchestrate() -> None:
             dev.kill()
             err = rec.get("note", "unrecognized child record")
             probes.append(f"{probe_t} error: {str(err)[:100]}")
+            _bank_attempt(probe_t, "refused",
+                          {"error": str(err)[:200]})
             if err == prev_error:
                 break
             prev_error = err
@@ -1723,7 +1870,9 @@ def orchestrate() -> None:
 
     if device_result is not None and device_result.get("platform") != "cpu":
         cpu_child.kill()
-        device_result["probe_attempts"] = attempt
+        # structured per-attempt telemetry (outcome + elapsed_ms), not a
+        # bare count: the sentry ledger and bench_diff both read it
+        device_result["probe_attempts"] = attempts
         device_result["probe_timeline"] = probes
         # evidence class is first-class (ROADMAP item 5: stale replays
         # must never masquerade as results): this is a real measurement
@@ -1739,8 +1888,8 @@ def orchestrate() -> None:
     # (not when a probe succeeded ON the device: then the tunnel is alive
     # and the engine itself failed — replaying an old healthy number
     # would mask a live regression; let the CPU fallback carry the error
-    # note.  "ok-cpu" probes — jax fell back to the CPU platform — count
-    # as a dead tunnel here.)
+    # note.  "degraded" probes — jax fell back to the CPU platform —
+    # count as a dead tunnel here.)
     # empty probes (budget too small for even one attempt) also replays:
     # a banked on-chip number beats a CPU fallback in every no-live-device
     # outcome except a probe that REACHED the device (live regression)
@@ -1760,6 +1909,7 @@ def orchestrate() -> None:
                              " (tunnel dead at driver bench time; probes: " +
                              ", ".join(probes) + ")")
             final["probe_timeline"] = probes
+            final["probe_attempts"] = attempts
             # a replay is NOT a result from this round — say so loudly at
             # the top level, not only buried in the note (bench_diff.py
             # refuses live-vs-stale comparison without --allow-stale)
@@ -1793,10 +1943,11 @@ def orchestrate() -> None:
         fallback = {"metric": "tpch_q1_like_rows_per_sec", "value": 0,
                     "unit": "rows/s", "vs_baseline": 0.0}
     fallback["probe_timeline"] = probes
+    fallback["probe_attempts"] = attempts
     fallback["evidence"] = "cpu-fallback"
     fallback["outcome"] = ("NO-LIVE-TUNNEL-WINDOW: CPU-platform "
                            "insurance numbers, not device evidence")
-    if probes and all(p.endswith(" ok-cpu") for p in probes):
+    if probes and all(p.endswith(" degraded") for p in probes):
         note = ("no TPU backend (jax fell back to the CPU platform); "
                 "CPU-platform numbers; probes: " + ", ".join(probes))
     elif not probes:

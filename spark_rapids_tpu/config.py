@@ -980,6 +980,42 @@ SLO_WINDOWS_S = register(
     "shortest window is 'burning' (slo-burn doctor verdict).",
     "300,3600", type_=str)
 
+# --- self-driving perf sentry (observability/sentry.py) ---------------------
+SENTRY_ENABLED = register(
+    "spark.rapids.tpu.sentry.enabled",
+    "Master switch for the self-driving perf sentry "
+    "(observability/sentry.py): an autonomous daemon that probes for a "
+    "live tunnel window with cancellable bounded-timeout device probes, "
+    "runs the bench shape set on detection, diffs against the last "
+    "live-evidence baseline and appends the verdict to the evidence "
+    "ledger.  Consulted by tools/perf_sentry.py and "
+    "sentry.maybe_start_from_conf(); nothing starts one implicitly — "
+    "off (default) means the CLI exits without probing, so a conf push "
+    "stops every sentry in the fleet.", False, commonly_used=True)
+SENTRY_PROBE_INTERVAL_MS = register(
+    "spark.rapids.tpu.sentry.probeIntervalMs",
+    "Base interval between device probes while no window is open; "
+    "failed probes back off exponentially from this interval (capped "
+    "at 8x), a live window resets it.", 480_000, commonly_used=True)
+SENTRY_PROBE_TIMEOUT_MS = register(
+    "spark.rapids.tpu.sentry.probeTimeoutMs",
+    "Hard per-probe budget: a probe still unanswered at the deadline "
+    "is cancelled (QueryContext deadline machinery) and banked as "
+    "outcome=timeout — a wedged tunnel can never hang the sentry.",
+    30_000, commonly_used=True)
+SENTRY_LEDGER_PATH = register(
+    "spark.rapids.tpu.sentry.ledgerPath",
+    "Append-only evidence ledger (srt-ledger/1 JSONL): one record per "
+    "captured window with artifact path, evidence class, bench_diff "
+    "verdict vs the last live baseline, doctor verdict and the "
+    "machine-named next-bottleneck follow-up.  Empty (default) uses "
+    "<repo>/.bench_capture/ledger.jsonl.", "", type_=str)
+SENTRY_SHAPES = register(
+    "spark.rapids.tpu.sentry.shapes",
+    "Comma list of bench shapes the sentry runs on a live window "
+    "(bench.run_shape_set vocabulary: join, sort, window, coalesce, "
+    "encoded).", "join,sort,window,coalesce,encoded", type_=str)
+
 # --- TPU-specific ----------------------------------------------------------
 BUCKET_MIN_ROWS = register(
     "spark.rapids.tpu.shapeBucket.minRows",

@@ -73,6 +73,92 @@ VERDICTS = ("sync-bound", "compile-bound", "h2d-d2h-bound",
             # its dominant bottleneck in the entry's evidence)
             "slo-burn")
 
+#: verdict -> the remedial lever the follow-up names.  Every verdict
+#: kind carries quantified lever evidence (``evidence.levers``) with the
+#: same precision dispatch-bound always had, so the sentry's
+#: machine-named follow-ups (observability/sentry.py) are actionable for
+#: any bottleneck, not just launch counts.
+LEVERS = {
+    "sync-bound": "fuse/pipeline the blocking readbacks (async d2h)",
+    "compile-bound": "warm the persistent kernel cache / shape buckets",
+    "h2d-d2h-bound": "prepack + device-resident tier (cut wire bytes)",
+    "dispatch-bound": "whole-stage fusion (cut launches per stage)",
+    "sem_wait-bound": "raise semaphore permits or admission weights",
+    "spill-bound": "raise the memory budget / spill tier sizing",
+    "shuffle-bound": "device-resident shuffle tier / coalesced exchange",
+    "admission-bound": "tenant weight, memory budget, "
+                       "maxConcurrentQueries",
+    "slo-burn": "rebalance the burning tenant's SLO budget or load",
+}
+
+
+def _lever_evidence(entry: Dict[str, Any],
+                    stages: int = 0) -> Dict[str, Any]:
+    """Quantified lever numbers for one ranked entry, keyed by what the
+    verdict's lever actually moves (readbacks for sync, launches for
+    dispatch, bytes for transfer...).  Best-effort from the entry's
+    existing evidence — degraded summaries simply carry fewer keys."""
+    cat = entry["category"]
+    ms, n = float(entry["ms"]), int(entry["count"])
+    ev = entry.get("evidence") or {}
+    lv: Dict[str, Any] = {}
+    if cat == "sync-bound":
+        lv["readbacks"] = n
+        if stages and n:
+            lv["readbacks_per_stage"] = round(n / stages, 2)
+        if n:
+            lv["ms_per_readback"] = round(ms / n, 3)
+    elif cat == "compile-bound":
+        lv["compiles"] = n
+        if n:
+            lv["ms_per_compile"] = round(ms / n, 3)
+    elif cat == "h2d-d2h-bound":
+        for k in ("h2d_bytes", "d2h_bytes", "bytes"):
+            if ev.get(k):
+                lv[k] = int(ev[k])
+    elif cat == "dispatch-bound":
+        lv["device_dispatches"] = int(ev.get("device_dispatches", n))
+        if ev.get("launches_per_probe_batch") is not None:
+            lv["launches_per_probe_batch"] = \
+                ev["launches_per_probe_batch"]
+    elif cat == "sem_wait-bound":
+        lv["wait_ms"] = round(ms, 3)
+        if n:
+            lv["waits"] = n
+    elif cat == "spill-bound":
+        lv["spill_ms"] = round(ms, 3)
+    elif cat == "shuffle-bound":
+        lv["shuffle_ms"] = round(ms, 3)
+        if ev.get("bytes"):
+            lv["bytes_on_wire"] = int(ev["bytes"])
+    elif cat == "admission-bound":
+        lv["wait_ms"] = round(ms, 3)
+        lv["waiters"] = n
+    elif cat == "slo-burn":
+        for k in ("tenant", "burn_rate", "window_s"):
+            if ev.get(k) is not None:
+                lv[k] = ev[k]
+    top = None
+    execs = ev.get("top_execs")
+    if execs:
+        top = execs[0].get("exec")
+    if ev.get("top_exec"):
+        top = ev["top_exec"]
+    if top:
+        lv["top_exec"] = top
+    return lv
+
+
+def _stamp_levers(ranked: List[Dict[str, Any]], stages: int = 0) -> None:
+    """Stamp ``evidence.levers`` (quantified) + ``evidence.lever`` (the
+    named remedy) onto every ranked entry — ISSUE 18: every verdict
+    kind, not just dispatch-bound, must justify its follow-up with
+    numbers.  Idempotent."""
+    for e in ranked:
+        ev = e.setdefault("evidence", {})
+        ev["levers"] = _lever_evidence(e, stages)
+        ev["lever"] = LEVERS.get(e["category"], "")
+
 #: per-launch overhead floor used to estimate dispatch-bound time when
 #: the trace cannot attribute it directly (Python dispatch + XLA launch;
 #: on the real tunnel each uncovered launch can cost a full RTT, so this
@@ -241,6 +327,8 @@ def diagnose(events: List[Dict[str, Any]],
     denom = wall_ms if wall_ms else (attributed_ms or 1.0)
     for e in ranked:
         e["share"] = round(min(1.0, e["ms"] / max(denom, 1e-9)), 4)
+    _stamp_levers(ranked, stages=sum(
+        1 for ev in events if ev.get("cat") == "stage"))
 
     caveats: List[str] = []
     truncated = bool(dropped_events)
@@ -271,11 +359,20 @@ def diagnose(events: List[Dict[str, Any]],
 
 def diagnose_summary(summary: Dict[str, Any],
                      metrics: Optional[Dict[str, Any]] = None,
-                     wall_ms: Optional[float] = None) -> Dict[str, Any]:
+                     wall_ms: Optional[float] = None,
+                     evidence: Optional[str] = None,
+                     evidence_age_s: Optional[float] = None
+                     ) -> Dict[str, Any]:
     """Degraded-fidelity diagnosis from a compact ``trace_summary``
     (bench artifacts / replay captures — no per-exec evidence; note the
     summary's ``sync_ms`` already folds blocking d2h time in, so the
-    transfer verdict here rides byte counts + the residual)."""
+    transfer verdict here rides byte counts + the residual).
+
+    ``evidence``/``evidence_age_s`` stamp the measurement's provenance
+    (bench.py evidence classes).  A non-live class is marked loudly —
+    :func:`followup` refuses to name a next bottleneck from it: a
+    replay's bottleneck was true hours ago and chasing it wastes the
+    next live window (ISSUE 18)."""
     metrics = metrics or {}
     ranked: List[Dict[str, Any]] = []
 
@@ -313,6 +410,7 @@ def diagnose_summary(summary: Dict[str, Any],
     denom = wall_ms if wall_ms else (attributed_ms or 1.0)
     for e in ranked:
         e["share"] = round(min(1.0, e["ms"] / max(denom, 1e-9)), 4)
+    _stamp_levers(ranked)
     caveats = ["diagnosed from compact trace_summary: no exec-level "
                "spans, transfer time folded into sync-bound"]
     if summary.get("trace_truncated") or summary.get("dropped_events"):
@@ -328,7 +426,109 @@ def diagnose_summary(summary: Dict[str, Any],
     }
     if wall_ms is not None:
         out["wall_ms"] = round(float(wall_ms), 3)
+    if evidence is not None:
+        out["evidence"] = str(evidence)
+        if evidence_age_s is not None:
+            out["evidence_age_s"] = round(float(evidence_age_s), 1)
+        if evidence != "live":
+            age = (f" aged {float(evidence_age_s):.0f}s"
+                   if evidence_age_s is not None else "")
+            caveats.append(
+                f"STALE-EVIDENCE: diagnosed from {evidence} "
+                f"evidence{age} — next-bottleneck follow-ups are "
+                f"refused until a live window recaptures")
     return out
+
+
+def evidence_age_s(captured_at: Any,
+                   now: Optional[float] = None) -> Optional[float]:
+    """Seconds since a capture's UTC ``captured_at`` stamp
+    (``%Y-%m-%dT%H:%M:%SZ``, the tunnel-watcher filename stamp bench.py
+    grafts onto replays), or None when unparseable."""
+    import calendar
+    import time as _t
+    try:
+        then = calendar.timegm(
+            _t.strptime(str(captured_at), "%Y-%m-%dT%H:%M:%SZ"))
+    except (ValueError, TypeError, OverflowError):
+        return None
+    return max(0.0, (now if now is not None else _t.time()) - then)
+
+
+def diagnose_artifact(rec: Dict[str, Any],
+                      now: Optional[float] = None) -> Dict[str, Any]:
+    """Degraded diagnosis over one whole bench artifact: every
+    ``*trace_summary`` dict in it (q1 + each shape) aggregates into one
+    summary, and the artifact's evidence class + replay age stamp the
+    output so the stale-evidence gate applies (ISSUE 18 — the sentry's
+    ledger verdicts ride this)."""
+    agg: Dict[str, float] = {}
+
+    def walk(obj: Any) -> None:
+        if not isinstance(obj, dict):
+            return
+        for k, v in obj.items():
+            if k.endswith("trace_summary") and isinstance(v, dict):
+                for sk, sv in v.items():
+                    if isinstance(sv, (int, float)) \
+                            and not isinstance(sv, bool):
+                        agg[sk] = agg.get(sk, 0.0) + sv
+            elif isinstance(v, dict):
+                walk(v)
+
+    walk(rec)
+    ev = rec.get("evidence")
+    if not ev:
+        if "captured_at" in rec:
+            ev = "stale-replay"
+        elif rec.get("platform") in (None, "cpu"):
+            ev = "cpu-fallback"
+        else:
+            ev = "live"
+    age = (evidence_age_s(rec.get("captured_at"), now=now)
+           if "captured_at" in rec else None)
+    return diagnose_summary(agg, evidence=str(ev), evidence_age_s=age)
+
+
+def followup(diag: Dict[str, Any],
+             evidence: Optional[str] = None,
+             evidence_age_s: Optional[float] = None) -> str:
+    """Machine-named next-bottleneck follow-up with quantified lever
+    evidence, e.g. ``sync-bound: readbacks=18, ms_per_readback=6.7,
+    top_exec=ShuffleExchangeExec; lever: fuse/pipeline the blocking
+    readbacks``.  Provenance defaults to the diagnosis's own
+    ``evidence`` stamps; anything non-live gets a loud STALE-EVIDENCE
+    marker instead of a follow-up — a bottleneck measured on a replay
+    is not a bottleneck to chase now."""
+    if evidence is None:
+        evidence = str(diag.get("evidence") or "live")
+    if evidence_age_s is None:
+        evidence_age_s = diag.get("evidence_age_s")
+    verdict = diag.get("verdict", "no-bottleneck")
+    if evidence != "live":
+        age = (f" aged {float(evidence_age_s):.0f}s"
+               if evidence_age_s is not None else "")
+        return (f"STALE-EVIDENCE: verdict '{verdict}' from {evidence} "
+                f"evidence{age} — follow-up refused; recapture on a "
+                f"live window")
+    ranked = diag.get("ranked") or []
+    if verdict == "no-bottleneck" or not ranked:
+        return "no-bottleneck: nothing to chase"
+    top = ranked[0]
+    lv = dict((top.get("evidence") or {}).get("levers") or {})
+    if not lv:
+        # compact() rows inline their quantified keys instead
+        for k in ("readbacks_per_stage", "device_dispatches",
+                  "launches_per_probe_batch", "bytes", "h2d_bytes",
+                  "d2h_bytes", "top_exec"):
+            if top.get(k) is not None:
+                lv[k] = top[k]
+        if not lv:
+            lv = {"ms": top.get("ms"), "count": top.get("count")}
+    parts = ", ".join(f"{k}={v}" for k, v in lv.items())
+    lever = LEVERS.get(verdict, "")
+    return (f"{verdict}: {parts}"
+            + (f"; lever: {lever}" if lever else ""))
 
 
 def diagnose_tenants(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -371,6 +571,7 @@ def diagnose_tenants(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             diag["verdict"] = diag["ranked"][0]["category"]
             diag["attributed_ms"] = round(
                 sum(e["ms"] for e in diag["ranked"]), 3)
+            _stamp_levers(diag["ranked"])
 
         def _pctl(q: float) -> float:
             if not durs:
@@ -398,7 +599,8 @@ def compact(diag: Dict[str, Any], top: int = 3) -> Dict[str, Any]:
                "share": e.get("share", 0.0), "count": e["count"]}
         ev = e.get("evidence", {})
         for k in ("bytes", "device_dispatches", "h2d_bytes", "d2h_bytes",
-                  "launches_per_probe_batch", "top_exec", "top_kernels"):
+                  "launches_per_probe_batch", "top_exec", "top_kernels",
+                  "levers"):
             if ev.get(k):
                 row[k] = ev[k]
         rows.append(row)

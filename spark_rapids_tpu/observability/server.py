@@ -19,6 +19,13 @@ adding any dependency:
                 including the ``slo-burn`` verdict when a tenant burns
 ``/slo``        per-tenant multi-window SLO burn rates
                 (observability/slo.py)
+``/sentry``     perf-sentry status (observability/sentry.py,
+                ``srt-sentry/1``): current phase, probe telemetry,
+                evidence-ledger tail and last-live-evidence age.  By
+                default served from the process's active sentry (a
+                'none' payload that still reports ledger staleness when
+                no sentry runs here); owners may inject their own
+                source.
 ==============  ===========================================================
 
 Ownership and lifecycle: the ServingEngine starts one server in
@@ -58,9 +65,11 @@ class TelemetryServer:
                  queries: Callable[[], Any],
                  doctor: Callable[[], Any],
                  slo: Callable[[], Any],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 sentry: Optional[Callable[[], Any]] = None):
         self._routes: Dict[str, Callable[[], Any]] = {
-            "/queries": queries, "/doctor": doctor, "/slo": slo}
+            "/queries": queries, "/doctor": doctor, "/slo": slo,
+            "/sentry": sentry or _default_sentry_source}
         self._metrics_text = metrics_text
         self._healthz = healthz
         self._httpd: Optional[ThreadingHTTPServer] = ThreadingHTTPServer(
@@ -115,7 +124,8 @@ class TelemetryServer:
                         body = _to_json(
                             {"error": f"no route {path!r}",
                              "routes": ["/metrics", "/healthz",
-                                        "/queries", "/doctor", "/slo"]})
+                                        "/queries", "/doctor", "/slo",
+                                        "/sentry"]})
                         ctype = "application/json"
                         status = 404
                 except Exception as e:  # noqa: BLE001 — route isolation
@@ -136,6 +146,12 @@ class TelemetryServer:
                 pass  # no per-request stderr chatter
 
         return _Handler
+
+
+def _default_sentry_source() -> Any:
+    # lazy: the sentry module is only imported when /sentry is hit
+    from . import sentry as _sentry
+    return _sentry.status_payload()
 
 
 def _to_json(obj: Any) -> bytes:

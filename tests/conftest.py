@@ -84,6 +84,11 @@ QUICK_MODULES = {
     # are tier-1 — a launch-count regression is a silent perf cliff on
     # the tunnel that no correctness test would ever fail
     "test_dispatch_budget",
+    # perf sentry (ISSUE 18): probe classification, evidence-ledger
+    # append-only/torn-line safety, live-over-stale baseline resolution
+    # and the /sentry route contract are tier-1 — a sentry regression
+    # silently starves every future round of live evidence
+    "test_sentry",
 }
 
 

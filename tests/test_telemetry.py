@@ -422,7 +422,7 @@ def test_check_trace_endpoint_scrape_mode():
         doctor=lambda: {}, slo=lambda: {})
     try:
         url = srv.endpoint + "/metrics"
-        assert check_trace.check_endpoint(url) == (1, ["srt_q_total"])
+        assert check_trace.check_endpoint(url) == "1 samples, 1 families"
         assert check_trace.main(
             ["--endpoint", url, "--prometheus-label", "tenant"]) == 0
         with pytest.raises(ValueError):

@@ -22,14 +22,24 @@ capture — bench.py stamps ``evidence``/``captured_at``) or
 ``--allow-stale``: a stale replay masquerading as the "before" side
 manufactures phantom regressions/improvements.
 
+Ledger mode (the perf sentry's evidence ledger,
+.bench_capture/ledger.jsonl, srt-ledger/1): ``--ledger <path>`` resolves
+the comparison baseline (side A) automatically as the artifact of the
+NEWEST ``evidence: live`` ledger entry — a stale replay never becomes
+the baseline no matter how recently it was appended.  With no live
+entry the diff is REFUSED (exit 2); ``--allow-stale`` degrades the
+resolution to the newest entry of any evidence class, with the usual
+cross-evidence warning.
+
 Usage:
   python tools/bench_diff.py A.json B.json [--threshold 0.10]
          [--allow-stale] [--fail-on-regress] [--json]
+  python tools/bench_diff.py --ledger LEDGER.jsonl B.json [flags...]
 
 Accepts driver round artifacts ({"parsed": {...}}), raw bench stdout
 (last JSON line wins), or a bare result object.  Exit codes: 0 ok,
-1 usage/parse error, 2 evidence mismatch refused, 3 regressions found
-(only with --fail-on-regress).
+1 usage/parse error, 2 evidence mismatch / baseline resolution refused,
+3 regressions found (only with --fail-on-regress).
 """
 
 from __future__ import annotations
@@ -101,6 +111,42 @@ def evidence_of(rec: Dict[str, Any]) -> str:
     if rec.get("platform") == "cpu" or rec.get("platform") is None:
         return "cpu-fallback"
     return "live"
+
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    """Parse an srt-ledger/1 evidence ledger (append-only JSONL),
+    skipping torn or foreign lines — mirrors
+    observability/sentry.EvidenceLedger.entries() without importing the
+    package (this tool stays dependency-free)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn line (crash mid-append)
+            if isinstance(rec, dict) and rec.get("schema") == "srt-ledger/1":
+                out.append(rec)
+    return out
+
+
+def resolve_baseline(entries: List[Dict[str, Any]],
+                     allow_stale: bool = False) -> Optional[str]:
+    """Baseline artifact path from ledger entries: the newest
+    ``evidence: live`` entry carrying an artifact path.  ``allow_stale``
+    falls back to the newest entry of ANY evidence class — the evidence
+    gate in run() then prints the cross-evidence warning."""
+    for rec in reversed(entries):
+        if rec.get("evidence") == "live" and rec.get("artifact"):
+            return str(rec["artifact"])
+    if allow_stale:
+        for rec in reversed(entries):
+            if rec.get("artifact"):
+                return str(rec["artifact"])
+    return None
 
 
 def _flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
@@ -235,6 +281,28 @@ def main(argv: List[str]) -> int:
         i = argv.index("--threshold")
         threshold = float(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
+    if "--ledger" in argv:
+        i = argv.index("--ledger")
+        ledger_path = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+        if len(argv) != 1:
+            print(__doc__)
+            return 1
+        try:
+            entries = read_ledger(ledger_path)
+        except OSError as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 1
+        baseline = resolve_baseline(entries, allow_stale=allow_stale)
+        if baseline is None:
+            print(f"REFUSED: no 'evidence: live' entry with an artifact "
+                  f"in ledger {ledger_path} ({len(entries)} entries) — "
+                  f"there is no live baseline to diff against.  Capture "
+                  f"a live window first, or rerun with --allow-stale to "
+                  f"fall back to the newest entry of any evidence "
+                  f"class.", file=sys.stderr)
+            return 2
+        argv = [baseline] + argv
     if len(argv) != 2:
         print(__doc__)
         return 1
