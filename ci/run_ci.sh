@@ -12,20 +12,20 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 
-echo "=== [1/19] native libraries ==="
+echo "=== [1/20] native libraries ==="
 make -C native
 
-echo "=== [2/19] API contract validation ==="
+echo "=== [2/20] API contract validation ==="
 timeout 300 python tools/api_validation.py
 
-echo "=== [3/19] docgen drift check ==="
+echo "=== [3/20] docgen drift check ==="
 timeout 300 python -m spark_rapids_tpu.docgen
 if ! git diff --quiet -- docs tools/generated_files 2>/dev/null; then
     echo "WARNING: generated docs drifted from the committed copies:"
     git --no-pager diff --stat -- docs tools/generated_files || true
 fi
 
-echo "=== [4/19] traced query + chrome-trace schema check ==="
+echo "=== [4/20] traced query + chrome-trace schema check ==="
 SRT_TRACE_OUT=$(mktemp -d)/trace.json
 JAX_PLATFORMS=cpu timeout 300 python - "$SRT_TRACE_OUT" <<'PYEOF'
 import sys
@@ -52,7 +52,7 @@ sess.export_chrome_trace(sys.argv[1])
 PYEOF
 timeout 60 python tools/check_trace.py --min-events 10 "$SRT_TRACE_OUT"
 
-echo "=== [5/19] performance flight recorder: metrics + history + doctor + bench_diff ==="
+echo "=== [5/20] performance flight recorder: metrics + history + doctor + bench_diff ==="
 # ISSUE 8 acceptance: a traced query with the metrics registry and the
 # flight recorder enabled must produce (a) a Prometheus export that
 # passes the exposition-contract check, (b) a doctor diagnosis whose
@@ -112,7 +112,7 @@ if python tools/bench_diff.py "$SRT_FR_DIR/live.json" BENCH_r05.json \
     echo "ERROR: bench_diff failed to refuse live-vs-stale"; exit 1
 fi
 
-echo "=== [6/19] chaos soak: seeded faults, bit-identical results ==="
+echo "=== [6/20] chaos soak: seeded faults, bit-identical results ==="
 # Short seeded soak (docs/robustness.md): shuffle.fetch + spill.disk_read
 # (and the other recoverable sites) armed over the TPC-H-ish suite; the
 # harness itself asserts bit-identical results vs the clean run and that
@@ -124,7 +124,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat fault \
     "$SRT_CHAOS_TRACE"
 
-echo "=== [7/19] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
+echo "=== [7/20] pipelined chaos soak: parallelism=4 + prefetch, bit-identical ==="
 # The async execution layer (docs/async_pipeline.md) under seeded faults:
 # the chaos session runs with task.parallelism=4 + prefetch queues +
 # double-buffered transfers while the clean reference run stays serial —
@@ -138,7 +138,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat sem_wait \
     "$SRT_PIPE_TRACE"
 
-echo "=== [8/19] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
+echo "=== [8/20] encoded chaos soak: encoding x parallelism 4 x prefetch ==="
 # Encoded columnar execution (docs/encoded_columns.md) under seeded
 # faults AND the async pipeline matrix: the chaos session keeps
 # dictionary/RLE columns encoded through filters/joins/group-bys and
@@ -158,7 +158,7 @@ timeout 60 python tools/check_trace.py --require-cat encode \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     8000 --seed 11 --encoded
 
-echo "=== [9/19] whole-stage fusion: plan shape + donation chaos soak ==="
+echo "=== [9/20] whole-stage fusion: plan shape + donation chaos soak ==="
 # Whole-stage XLA compilation (docs/whole_stage.md): (a) the TPC-H-ish
 # suite's plans must contain fused whole-stage nodes — an aggregate
 # terminal (FusedStageExec wrapping the partial agg) and a probe-absorbed
@@ -215,7 +215,7 @@ JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
 timeout 60 python tools/check_trace.py --require-cat stage \
     "$SRT_WS_TRACE"
 
-echo "=== [10/19] dispatch pipeline: sort/window terminals + fused probe + coalescer ==="
+echo "=== [10/20] dispatch pipeline: sort/window terminals + fused probe + coalescer ==="
 # ISSUE 14 acceptance: (a) plans form sort/window STAGE TERMINALS (the
 # sort absorbs the map chain; a window over a matching sort absorbs the
 # sort) and the broadcast join still absorbs its probe chain with the
@@ -345,7 +345,7 @@ timeout 60 python tools/check_trace.py --require-cat stage \
     "$SRT_CON_TRACE"
 grep -q coalesced_n "$SRT_CON_TRACE"
 
-echo "=== [11/19] multi-tenant serving: concurrent sessions smoke ==="
+echo "=== [11/20] multi-tenant serving: concurrent sessions smoke ==="
 # ISSUE 9 acceptance: N tenant sessions against one ServingEngine —
 # (a) weighted-fair admission: a heavy flood cannot starve a light
 # tenant (bounded wait, grant-order assertion at the controller);
@@ -438,7 +438,7 @@ timeout 60 python tools/check_trace.py --require-cat admission \
 JAX_PLATFORMS=cpu timeout 600 python -m spark_rapids_tpu.testing.chaos \
     10000 --seed 11 --multi-session
 
-echo "=== [12/19] query lifecycle: leak sentinel + cancel semantics ==="
+echo "=== [12/20] query lifecycle: leak sentinel + cancel semantics ==="
 # ISSUE 10 acceptance: (a) the bounded leak sentinel — 2 tenants of
 # mixed traffic with cancel races, per-query deadlines and fatal
 # injection armed — must bank a CLEAN verdict (retention pins, catalog
@@ -495,7 +495,30 @@ PYEOF
 timeout 60 python tools/check_trace.py --require-cat cancel \
     "$SRT_LC_DIR/cancel_trace.json"
 
-echo "=== [13/19] live telemetry plane: scrape + trace stitching over the shuffle wire ==="
+echo "=== [13/20] pod-scale fault domain: process-kill chaos cluster ==="
+# ISSUE 19 acceptance: a REAL 3-process shuffle topology survives a
+# seeded SIGKILL mid-query (failure detection -> immediate failover ->
+# lineage recompute, bit-identical to the no-fault digest) AND the
+# zombie scenario (SIGSTOP past deadMs, re-registration bumps the
+# fencing epoch, SIGCONT resumes the stale process) proves epoch
+# fencing for real: zero stale blocks served, recovery bit-identical.
+# The merged per-process traces must carry `fault`-category spans
+# (peer.dead / fetch.failover / shuffle.recompute evidence).
+SRT_CHAOS_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu timeout 600 python tools/chaos_cluster.py \
+    --procs 3 --seed 7 --scenario sigkill --scenario zombie \
+    --out "$SRT_CHAOS_DIR"
+timeout 60 python tools/trace_merge.py "$SRT_CHAOS_DIR/merged.json" \
+    "$SRT_CHAOS_DIR"/*/*.jsonl
+timeout 60 python tools/check_trace.py --require-cat fault \
+    --min-events 2 "$SRT_CHAOS_DIR/merged.json"
+# the cluster leg of the leak sentinel: a kill/recover cycle must drain
+# every heartbeat thread and fault-domain table at manager close
+JAX_PLATFORMS=cpu timeout 300 python tools/leak_sentinel.py \
+    --seconds 6 --rows 2000 --cluster \
+    --out "$SRT_CHAOS_DIR/cluster_leak.json"
+
+echo "=== [14/20] live telemetry plane: scrape + trace stitching over the shuffle wire ==="
 # ISSUE 12 acceptance: (a) the embedded telemetry server answers
 # /metrics (Prometheus contract with the tenant label, validated both
 # from the scraped body and live via check_trace --endpoint) and
@@ -645,7 +668,7 @@ timeout 60 python tools/trace_merge.py "$SRT_TP_DIR/merged.json" \
 timeout 60 python tools/check_trace.py --flow "$SRT_TP_DIR/merged.json" \
     --min-events 2 "$SRT_TP_DIR/merged.json"
 
-echo "=== [14/19] perf sentry: simulated-window e2e + evidence ledger ==="
+echo "=== [15/20] perf sentry: simulated-window e2e + evidence ledger ==="
 # ISSUE 18 acceptance: the self-driving sentry, run unattended from
 # tools/perf_sentry.py in simulated-window mode, must (a) append
 # well-formed srt-ledger/1 records — artifact path on disk, evidence
@@ -709,7 +732,7 @@ print(json.loads(lines[-1])['artifact'])")
 timeout 60 python tools/bench_diff.py \
     --ledger "$SRT_SENTRY_DIR/ledger.jsonl" "$SRT_SENTRY_FRESH"
 
-echo "=== [15/19] test suite (virtual 8-device CPU mesh) ==="
+echo "=== [16/20] test suite (virtual 8-device CPU mesh) ==="
 if [ "$MODE" = quick ]; then
     # the <3-minute smoke tier (markers assigned in tests/conftest.py)
     python -m pytest tests/ -m quick -x -q
@@ -730,14 +753,14 @@ else
 fi
 
 if [ "$MODE" != quick ]; then
-    echo "=== [16/19] scale rig ==="
+    echo "=== [17/20] scale rig ==="
     SRT_SCALE_PLATFORM=cpu timeout 3600 \
         python -m spark_rapids_tpu.testing.scaletest 100000
 else
-    echo "=== [16/19] scale rig skipped (quick) ==="
+    echo "=== [17/20] scale rig skipped (quick) ==="
 fi
 
-echo "=== [17/19] packaging: wheel builds and installs ==="
+echo "=== [18/20] packaging: wheel builds and installs ==="
 WHEELDIR=$(mktemp -d)
 timeout 600 python -m pip wheel . --no-deps --no-build-isolation \
     -w "$WHEELDIR" -q
@@ -767,17 +790,17 @@ assert sorted(r['count'] for r in t.to_pylist()) == [1, 2]
 print('wheel OK', spark_rapids_tpu.__version__)
 "
 
-echo "=== [18/19] driver entry checks ==="
+echo "=== [19/20] driver entry checks ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" timeout 900 \
     python __graft_entry__.py
 
 if [ "$MODE" = quick ]; then
-    echo "=== [19/19] second-jax shim world skipped (quick) ==="
+    echo "=== [20/20] second-jax shim world skipped (quick) ==="
     echo "CI PASSED"
     exit 0
 fi
 
-echo "=== [19/19] second-jax shim world (gated) ==="
+echo "=== [20/20] second-jax shim world (gated) ==="
 # The parallel-world leg the reference proves with its 14-version shim
 # matrix (ShimLoader probing, SURVEY §2.11).  This image ships exactly
 # one jaxlib and pip has zero egress (docs/perf_notes.md), so the leg

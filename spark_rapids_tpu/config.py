@@ -540,6 +540,50 @@ SHUFFLE_FETCH_BLACKLIST_MS = register(
     "spark.rapids.tpu.shuffle.fetch.blacklistMs",
     "How long a blacklisted peer stays benched; the next heartbeat "
     "refresh after expiry reinstates it with a clean slate.", 5_000)
+SHUFFLE_FETCH_SPECULATIVE_P99 = register(
+    "spark.rapids.tpu.shuffle.fetch.speculativeP99Factor",
+    "Straggler mitigation for remote shuffle fetches: when a fetch "
+    "against one peer runs longer than this factor times the rolling "
+    "p99 of recent remote-fetch latencies, a speculative duplicate "
+    "fetch is issued against the next candidate peer and the first "
+    "answer wins (the hung fetch is abandoned, its socket dropped).  "
+    "0 (default) disables speculation.", 0.0)
+
+# --- robustness: pod-scale peer failure domain ------------------------------
+PEERS_HEARTBEAT_MS = register(
+    "spark.rapids.tpu.peers.heartbeatMs",
+    "Interval of the shuffle manager's background heartbeat loop "
+    "against the driver peer registry, which also feeds the phi-accrual "
+    "failure detector (robustness/failure_detector.py).  0 (default) "
+    "disables the background loop: heartbeats then ride fetch-time "
+    "refreshes only, as before the failure detector existed.", 0)
+PEERS_SUSPECT_MS = register(
+    "spark.rapids.tpu.peers.suspectMs",
+    "A peer with no heartbeat for this long (scaled by the phi-accrual "
+    "estimate of its normal arrival jitter) transitions alive -> "
+    "suspect: it drops to last-resort fetch ordering but is still "
+    "tried.  Hysteresis: returning to alive requires consecutive "
+    "on-time heartbeats, so a flapping peer doesn't thrash the "
+    "ordering.", 3_000)
+PEERS_DEAD_MS = register(
+    "spark.rapids.tpu.peers.deadMs",
+    "A peer with no heartbeat for this long is declared dead: in-flight "
+    "fetches against it fail over immediately (no retry/backoff "
+    "budget), its blocks recompute proactively via registered lineage "
+    "callbacks, and its registry entry is fenced — re-registration "
+    "bumps the peer's epoch so a zombie returning later cannot serve "
+    "stale blocks.", 10_000)
+
+# --- mesh data plane robustness ---------------------------------------------
+MESH_COLLECTIVE_DEADLINE_MS = register(
+    "spark.rapids.tpu.mesh.collectiveDeadlineMs",
+    "Wall-clock deadline for one compiled mesh all_to_all exchange "
+    "(parallel/mesh.py).  On expiry the exchange raises a typed "
+    "timeout and the stage degrades to the local/TCP shuffle plane "
+    "with a loud metric (mesh_collective_timeouts_total) instead of "
+    "hanging; the launched program itself cannot be recalled (the "
+    "watchdog is cooperative, like query deadlines).  0 (default) "
+    "disables the watchdog and runs the collective inline.", 0)
 
 # --- robustness: seeded chaos / fault injection -----------------------------
 CHAOS_ENABLED = register(

@@ -19,6 +19,7 @@ multithreaded shuffle and IO pools touch the catalog concurrently.
 
 from __future__ import annotations
 
+import errno
 import os
 import pickle
 import threading
@@ -41,6 +42,15 @@ from .device import DeviceManager
 _DISK_IO_ATTEMPTS = 5
 
 
+class SpillDiskFull(OSError):
+    """ENOSPC from the spill disk tier — NON-retriable: a full disk does
+    not heal on a millisecond backoff, and retrying five times just
+    multiplies the latency of the inevitable.  The overflow path catches
+    this and keeps the buffer RESIDENT at host (over-limit but correct)
+    instead of failing the query; ``spill_disk_full_total`` counts the
+    events."""
+
+
 def _retry_disk_io(fn, what: str):
     from ..serving import lifecycle as _lc
     delay = 0.001
@@ -51,7 +61,12 @@ def _retry_disk_io(fn, what: str):
         _lc.check_cancel("spill")
         try:
             return fn()
-        except OSError:
+        except OSError as e:
+            if getattr(e, "errno", None) == errno.ENOSPC:
+                _om.inc("spill_disk_full_total")
+                raise SpillDiskFull(
+                    errno.ENOSPC,
+                    f"spill disk full during {what}") from e
             if attempt == _DISK_IO_ATTEMPTS - 1:
                 raise
             _lc.cancellable_sleep(delay, "spill")
@@ -365,7 +380,14 @@ class BufferCatalog:
         for buf in candidates:
             if self.host_bytes <= self.host_limit:
                 break
-            self._host_to_disk(buf)
+            try:
+                self._host_to_disk(buf)
+            except SpillDiskFull:
+                # disk-full fallback: keep this (and the remaining
+                # lowest-priority) buffers resident at host — the tier
+                # runs over its limit, loudly, rather than failing the
+                # query on an unwritable spill
+                break
 
     def _host_to_disk(self, buf: _Buffer):
         os.makedirs(self.spill_dir, exist_ok=True)
@@ -379,7 +401,14 @@ class BufferCatalog:
             with open(path, "wb") as f:
                 pickle.dump(buf.leaves, f, protocol=pickle.HIGHEST_PROTOCOL)
         with _trace.span("spill", "spill.hostToDisk", bytes=buf.size):
-            _retry_disk_io(_write, "spill.disk_write")
+            try:
+                _retry_disk_io(_write, "spill.disk_write")
+            except SpillDiskFull:
+                try:
+                    os.unlink(path)   # a partial file must not leak
+                except OSError:
+                    pass
+                raise
         _om.inc("spill_bytes_total", buf.size, dir="hostToDisk")
         buf.leaves = None
         buf.disk_path = path

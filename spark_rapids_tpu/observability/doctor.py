@@ -71,7 +71,12 @@ VERDICTS = ("sync-bound", "compile-bound", "h2d-d2h-bound",
             # a tenant consuming its declared SLO error budget faster
             # than allotted (observability/slo.py names the tenant and
             # its dominant bottleneck in the entry's evidence)
-            "slo-burn")
+            "slo-burn",
+            # the query paid for the pod-scale fault domain: peers
+            # declared dead, zombie responses fenced, failovers and
+            # recomputes (shuffle/manager.py + robustness/
+            # failure_detector.py quantify the evidence)
+            "peer-failure")
 
 #: verdict -> the remedial lever the follow-up names.  Every verdict
 #: kind carries quantified lever evidence (``evidence.levers``) with the
@@ -89,6 +94,8 @@ LEVERS = {
     "admission-bound": "tenant weight, memory budget, "
                        "maxConcurrentQueries",
     "slo-burn": "rebalance the burning tenant's SLO budget or load",
+    "peer-failure": "replace/restart the dead peer; tighten "
+                    "peers.{suspectMs,deadMs} to detect sooner",
 }
 
 
@@ -138,6 +145,12 @@ def _lever_evidence(entry: Dict[str, Any],
         for k in ("tenant", "burn_rate", "window_s"):
             if ev.get(k) is not None:
                 lv[k] = ev[k]
+    elif cat == "peer-failure":
+        for k in ("dead_peers", "stale_epochs", "dead_failovers",
+                  "proactive_recomputes"):
+            if ev.get(k) is not None:
+                lv[k] = ev[k]
+        lv["recovery_ms"] = round(ms, 3)
     top = None
     execs = ev.get("top_execs")
     if execs:
@@ -322,6 +335,27 @@ def diagnose(events: List[Dict[str, Any]],
             ev["per_dispatch_ms"] = dispatch_cost_ms
             ranked.append(_verdict_entry(
                 "dispatch-bound", est, dispatches, ev))
+
+    # peer-failure: the query crossed the pod-scale fault domain —
+    # quantified from the fault-domain metric deltas, with the ms cost
+    # attributed from the fault-cat trace spans (dead declarations,
+    # fenced zombie responses, recomputes)
+    dead_peers = int(metrics.get("peersDeclaredDead", 0) or 0)
+    stale_epochs = int(metrics.get("staleEpochsRefused", 0) or 0)
+    failovers = int(metrics.get("deadPeerFailovers", 0) or 0)
+    if dead_peers or stale_epochs or failovers:
+        pf_ms = sum(
+            self_ms[i] for i, ev in enumerate(events)
+            if ev.get("cat") == "fault"
+            and str(ev.get("name", "")).startswith(
+                ("peer.", "shuffle.recompute", "shuffle.fetch.stale")))
+        pf_ev = {"dead_peers": dead_peers, "stale_epochs": stale_epochs,
+                 "dead_failovers": failovers,
+                 "proactive_recomputes": int(
+                     metrics.get("proactiveRecomputes", 0) or 0)}
+        ranked.append(_verdict_entry(
+            "peer-failure", pf_ms,
+            dead_peers + stale_epochs + failovers, pf_ev))
 
     ranked.sort(key=lambda e: -e["ms"])
     denom = wall_ms if wall_ms else (attributed_ms or 1.0)

@@ -29,9 +29,64 @@ class MeshShuffleUnsupported(Exception):
     host columns, ragged leaves); callers fall back to the local plane."""
 
 
+class MeshCollectiveTimeout(MeshShuffleUnsupported):
+    """A compiled mesh collective exceeded its deadline
+    (``spark.rapids.tpu.mesh.collectiveDeadlineMs``).  Subclasses
+    MeshShuffleUnsupported ON PURPOSE: the exchange exec's existing
+    fallback catch degrades the stage to the local/TCP plane instead of
+    hanging it — but LOUDLY (``mesh_collective_timeouts_total`` counter
+    + a fault-cat trace span), never silently."""
+
+
 #: observability: exchanges that actually rode the mesh plane (tests assert
 #: on this; the metrics layer reads it for the shuffle mode report)
-STATS = {"mesh_exchanges": 0, "fallbacks": 0}
+STATS = {"mesh_exchanges": 0, "fallbacks": 0, "collective_timeouts": 0}
+
+
+def _collective_timed_out(detail: str) -> MeshCollectiveTimeout:
+    """The LOUD part of the degrade path, shared by the real watchdog
+    and the chaos site: counter + fault span, then the typed timeout."""
+    import time as _time
+
+    from ..observability import metrics as _om
+    from ..observability import tracer as _trace
+    STATS["collective_timeouts"] += 1
+    _om.inc("mesh_collective_timeouts_total")
+    if _trace.TRACING["on"]:
+        t0 = _time.perf_counter()
+        _trace.get_tracer().complete(
+            "fault", "mesh.collective.timeout", t0, 0.0, detail=detail)
+    return MeshCollectiveTimeout(
+        f"mesh collective exceeded its deadline ({detail}); "
+        f"degrading stage to the local plane")
+
+
+def _run_with_deadline(fn, deadline_s: float):
+    """Cooperative collective watchdog: a compiled program cannot be
+    recalled once dispatched, so the call runs on a worker thread and a
+    deadline overrun abandons it (the thread parks on the runtime; the
+    stage degrades instead of hanging).  deadline_s <= 0 = inline."""
+    if deadline_s <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # noqa: BLE001 — marshalled to caller
+            box["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name="srt-mesh-collective",
+                         daemon=True)
+    t.start()
+    if not done.wait(deadline_s):
+        raise _collective_timed_out(f"deadline {deadline_s:.3f}s")
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
 
 
 _mesh_lock = threading.Lock()
@@ -135,6 +190,16 @@ def mesh_shuffle_batches(mesh, batches: List, pids: List, nt: int) -> List:
     int32 [capacity] array of target partitions for shard i's rows (dead
     rows' ids are ignored).  Returns one (shrunk) batch per target.
     """
+    # lifecycle poll site `mesh` — the one chokepoint family PR 10 never
+    # covered: a cancelled query abandons the exchange BEFORE dispatching
+    # a compiled collective it could not recall.  Sits ahead of every
+    # device check so single-device tests reach it too.
+    from ..robustness import faults as _faults
+    from ..serving import lifecycle as _lc
+    _lc.check_cancel("mesh")
+    if _faults.CHAOS["on"] and _faults.should_fire(
+            "mesh.collective.timeout", n_dev=len(batches)):
+        raise _collective_timed_out("chaos-injected")
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -200,8 +265,16 @@ def mesh_shuffle_batches(mesh, batches: List, pids: List, nt: int) -> List:
         step, mesh=mesh,
         in_specs=(P("data"),) * (2 + nleaves),
         out_specs=(P("data"),) * (1 + nleaves)))
-    with mesh:
-        counts, *outs = jitted(g_valid, g_pids, *g_leaves)
+
+    from ..config import MESH_COLLECTIVE_DEADLINE_MS, RapidsConf
+    deadline_s = int(RapidsConf.get_global().get(
+        MESH_COLLECTIVE_DEADLINE_MS)) / 1e3
+
+    def dispatch():
+        with mesh:
+            return jitted(g_valid, g_pids, *g_leaves)
+
+    counts, *outs = _run_with_deadline(dispatch, deadline_s)
     counts = np.asarray(counts)
     STATS["mesh_exchanges"] += 1
 

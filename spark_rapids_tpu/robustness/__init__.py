@@ -1,14 +1,19 @@
-"""Robustness subsystem: seeded chaos fault injection (faults.py) and the
-process-wide counters the session folds into ``last_query_metrics`` —
-the degraded-conditions proof layer (docs/robustness.md)."""
+"""Robustness subsystem: seeded chaos fault injection (faults.py), the
+peer failure detector + epoch fencing of the pod-scale fault domain
+(failure_detector.py), and the process-wide counters the session folds
+into ``last_query_metrics`` — the degraded-conditions proof layer
+(docs/robustness.md)."""
 
+from .failure_detector import (ALIVE, DEAD, SUSPECT, FailureDetector,
+                               HeartbeatLoop)
 from .faults import (CHAOS, SITES, STATS, ChaosRegistry, InjectedFault,
                      apply_conf, arm_chaos, disarm_chaos, fault_type,
                      get_registry, injected_counts, maybe_inject,
                      maybe_inject_oom, should_fire)
 
 __all__ = [
-    "CHAOS", "SITES", "STATS", "ChaosRegistry", "InjectedFault",
+    "ALIVE", "CHAOS", "DEAD", "SITES", "STATS", "SUSPECT", "ChaosRegistry",
+    "FailureDetector", "HeartbeatLoop", "InjectedFault",
     "apply_conf", "arm_chaos", "disarm_chaos", "fault_type", "get_registry",
     "injected_counts", "maybe_inject", "maybe_inject_oom", "should_fire",
     "stats_snapshot",
@@ -19,9 +24,19 @@ def stats_snapshot() -> dict:
     """Monotonic robustness counters; the session snapshots this at query
     start and folds the delta into ``last_query_metrics``."""
     from ..shuffle.manager import FETCH_STATS
+    from .failure_detector import STATS as _FD_STATS
     return {
         "faultsInjected": STATS["faults_injected"],
         "shuffleFetchRetries": FETCH_STATS["retries"],
         "shuffleBlocksRecomputed": FETCH_STATS["recomputed"],
         "peersBlacklisted": FETCH_STATS["blacklisted"],
+        "staleEpochsRefused": FETCH_STATS["stale_epoch"],
+        "deadPeerFailovers": FETCH_STATS["dead_failovers"],
+        "proactiveRecomputes": FETCH_STATS["proactive_recomputes"],
+        "speculativeFetches": FETCH_STATS["speculated"],
+        "speculativeFetchWins": FETCH_STATS["speculative_wins"],
+        "peersSuspected": _FD_STATS["suspected"],
+        "peersDeclaredDead": _FD_STATS["declared_dead"],
+        "peersRecovered": _FD_STATS["recovered"],
+        "peersRevived": _FD_STATS["revived"],
     }

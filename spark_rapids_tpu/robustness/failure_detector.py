@@ -1,0 +1,320 @@
+"""Peer failure detector — the phi-accrual heartbeat layer of the
+pod-scale fault domain (docs/robustness.md "peer lifecycle").
+
+Sits over the shuffle peer table (shuffle/transport.py): every heartbeat
+arrival for a peer feeds a sliding window of interarrival times, and the
+detector drives the peer's state machine
+
+    alive  ->  suspect  ->  dead
+      ^_________|              (suspect heals with hysteresis)
+
+* **suspect** — no heartbeat for ``suspectMs`` (scaled up by the peer's
+  observed arrival jitter, the phi-accrual idea: a peer whose heartbeats
+  normally wobble gets proportionally more grace).  Suspect peers drop
+  to last-resort fetch ordering but are still tried.  Healing back to
+  alive requires ``recover_beats`` consecutive on-time heartbeats —
+  hysteresis, so a flapping peer doesn't thrash the ordering.
+* **dead** — no heartbeat for ``deadMs`` (a hard bound; jitter scaling
+  never extends it).  Dead is STICKY: only an explicit :meth:`revive`
+  (the re-registration path, which bumps the peer's fencing epoch)
+  returns a dead peer to alive.  Dead declaration fires the registered
+  ``on_transition`` callbacks — the shuffle manager uses this for
+  immediate fetch failover and proactive lineage recompute.
+
+The phi value itself (``-log10 P(heartbeat still coming)`` under a
+normal approximation of the interarrival distribution, Hayashibara et
+al.) is exported for observability; the state machine uses the
+ms-threshold form because operators reason in milliseconds, not phi.
+
+Chaos sites (robustness/faults.py) let the single-process soak exercise
+the same code paths the process-kill harness proves for real:
+``peer.kill`` force-declares a drawn peer dead, ``peer.stall`` drops one
+heartbeat observation (the suspect path).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..observability import tracer as _trace
+from . import faults as _faults
+
+ALIVE, SUSPECT, DEAD = "alive", "suspect", "dead"
+
+#: process-wide detector accounting (robustness.stats_snapshot folds
+#: these into last_query_metrics)
+STATS = {"suspected": 0, "declared_dead": 0, "recovered": 0, "revived": 0}
+
+
+class _PeerHealth:
+    __slots__ = ("last", "intervals", "state", "on_time", "stalled")
+
+    def __init__(self, now: float):
+        self.last = now
+        self.intervals: deque = deque(maxlen=32)
+        self.state = ALIVE
+        self.on_time = 0          # consecutive on-time beats (hysteresis)
+        self.stalled = False      # chaos peer.stall dropped the last beat
+
+
+class FailureDetector:
+    """Heartbeat-driven peer state machine with phi-accrual grace and
+    hysteresis.  Thread-safe; transition callbacks run OUTSIDE the lock
+    (they may touch the shuffle manager, which takes its own)."""
+
+    def __init__(self, suspect_ms: float = 3_000.0,
+                 dead_ms: float = 10_000.0,
+                 recover_beats: int = 2,
+                 jitter_scale: float = 4.0):
+        self.suspect_s = max(0.001, float(suspect_ms) / 1e3)
+        self.dead_s = max(self.suspect_s, float(dead_ms) / 1e3)
+        self.recover_beats = max(1, int(recover_beats))
+        self.jitter_scale = float(jitter_scale)
+        self._peers: Dict[str, _PeerHealth] = {}
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable[[str, str, str], None]] = []
+        #: bumped on every dead declaration; fetch backoff loops compare
+        #: it to skip the remaining sleep when any peer just died
+        self.death_generation = 0
+
+    # --- feeding ----------------------------------------------------------
+    def observe(self, executor_id: str,
+                now: Optional[float] = None) -> None:
+        """One heartbeat arrived from ``executor_id``.  Chaos: the
+        ``peer.stall`` site drops this observation (the peer looks
+        stalled); ``peer.kill`` force-declares the peer dead."""
+        now = time.monotonic() if now is None else now
+        if _faults.CHAOS["on"]:
+            if _faults.should_fire("peer.kill", peer=executor_id):
+                self.force_dead(executor_id, reason="chaos peer.kill",
+                                now=now)
+                return
+            if _faults.should_fire("peer.stall", peer=executor_id):
+                with self._lock:
+                    h = self._peers.get(executor_id)
+                    if h is not None:
+                        h.stalled = True
+                return
+        transitions: List[Tuple[str, str, str]] = []
+        with self._lock:
+            h = self._peers.get(executor_id)
+            if h is None:
+                self._peers[executor_id] = _PeerHealth(now)
+                return
+            if h.state == DEAD:
+                return              # sticky: only revive() resurrects
+            dt = now - h.last
+            h.last = now
+            if not h.stalled and dt > 0:
+                h.intervals.append(dt)
+            h.stalled = False
+            if h.state == SUSPECT:
+                if dt <= self._suspect_after(h):
+                    h.on_time += 1
+                    if h.on_time >= self.recover_beats:
+                        h.state = ALIVE
+                        h.on_time = 0
+                        STATS["recovered"] += 1
+                        transitions.append((executor_id, SUSPECT, ALIVE))
+                else:
+                    h.on_time = 0
+        self._fire(transitions)
+
+    def forget(self, executor_id: str) -> None:
+        with self._lock:
+            self._peers.pop(executor_id, None)
+
+    def revive(self, executor_id: str,
+               now: Optional[float] = None) -> None:
+        """Re-registration path: a dead peer came back.  The CALLER must
+        have bumped the peer's fencing epoch first — revive only resets
+        the health record."""
+        now = time.monotonic() if now is None else now
+        transitions = []
+        with self._lock:
+            h = self._peers.get(executor_id)
+            old = h.state if h is not None else None
+            self._peers[executor_id] = _PeerHealth(now)
+            if old == DEAD:
+                STATS["revived"] += 1
+                transitions.append((executor_id, DEAD, ALIVE))
+        self._fire(transitions)
+
+    def force_dead(self, executor_id: str, reason: str = "",
+                   now: Optional[float] = None) -> None:
+        """Immediate dead declaration (chaos ``peer.kill``, or an
+        authoritative out-of-band signal like a closed registry
+        entry)."""
+        now = time.monotonic() if now is None else now
+        transitions = []
+        with self._lock:
+            h = self._peers.setdefault(executor_id, _PeerHealth(now))
+            if h.state != DEAD:
+                transitions.append((executor_id, h.state, DEAD))
+                h.state = DEAD
+                STATS["declared_dead"] += 1
+                self.death_generation += 1
+        self._declare(transitions, reason)
+
+    # --- advancing the state machine --------------------------------------
+    def sweep(self, now: Optional[float] = None
+              ) -> List[Tuple[str, str, str]]:
+        """Advance every peer's state from elapsed silence; returns the
+        transitions (callbacks already fired).  Called from the
+        heartbeat loop each interval and from fetch-time refreshes."""
+        now = time.monotonic() if now is None else now
+        transitions: List[Tuple[str, str, str]] = []
+        with self._lock:
+            for eid, h in self._peers.items():
+                if h.state == DEAD:
+                    continue
+                silent = now - h.last
+                if silent >= self.dead_s:
+                    transitions.append((eid, h.state, DEAD))
+                    h.state = DEAD
+                    STATS["declared_dead"] += 1
+                    self.death_generation += 1
+                elif h.state == ALIVE and silent >= self._suspect_after(h):
+                    transitions.append((eid, ALIVE, SUSPECT))
+                    h.state = SUSPECT
+                    h.on_time = 0
+                    STATS["suspected"] += 1
+        self._declare(transitions, "heartbeats stopped")
+        return transitions
+
+    def _suspect_after(self, h: _PeerHealth) -> float:
+        """Suspect threshold for one peer: the conf floor, raised by the
+        phi-accrual jitter estimate (mean + jitter_scale * std of its
+        interarrivals) but never past the hard dead bound."""
+        if len(h.intervals) >= 4:
+            mean = sum(h.intervals) / len(h.intervals)
+            var = (sum((x - mean) ** 2 for x in h.intervals)
+                   / len(h.intervals))
+            est = mean + self.jitter_scale * math.sqrt(var)
+            return min(self.dead_s, max(self.suspect_s, est))
+        return self.suspect_s
+
+    # --- reading ----------------------------------------------------------
+    def state(self, executor_id: str) -> str:
+        with self._lock:
+            h = self._peers.get(executor_id)
+            return h.state if h is not None else ALIVE
+
+    def is_dead(self, executor_id: str) -> bool:
+        with self._lock:
+            h = self._peers.get(executor_id)
+            return h is not None and h.state == DEAD
+
+    def phi(self, executor_id: str,
+            now: Optional[float] = None) -> float:
+        """Hayashibara phi: suspicion level of ``executor_id`` now.
+        0 right after a heartbeat, grows without bound with silence."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            h = self._peers.get(executor_id)
+            if h is None:
+                return 0.0
+            elapsed = max(0.0, now - h.last)
+            if len(h.intervals) >= 2:
+                mean = sum(h.intervals) / len(h.intervals)
+                std = math.sqrt(sum((x - mean) ** 2 for x in h.intervals)
+                                / len(h.intervals))
+            else:
+                mean, std = self.suspect_s / 2.0, 0.0
+            std = max(std, mean / 4.0, 1e-6)
+        # P(next heartbeat later than elapsed) under N(mean, std)
+        z = (elapsed - mean) / std
+        p_later = 0.5 * math.erfc(z / math.sqrt(2.0))
+        return -math.log10(max(p_later, 1e-12))
+
+    def snapshot(self) -> Dict[str, object]:
+        """Peer liveness for /healthz and the doctor: per-state lists +
+        per-peer phi."""
+        now = time.monotonic()
+        with self._lock:
+            states = {eid: h.state for eid, h in self._peers.items()}
+        by_state: Dict[str, List[str]] = {ALIVE: [], SUSPECT: [], DEAD: []}
+        for eid, st in sorted(states.items()):
+            by_state[st].append(eid)
+        return {
+            "alive": by_state[ALIVE],
+            "suspect": by_state[SUSPECT],
+            "dead": by_state[DEAD],
+            "phi": {eid: round(self.phi(eid, now), 3) for eid in states},
+        }
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out = {ALIVE: 0, SUSPECT: 0, DEAD: 0}
+            for h in self._peers.values():
+                out[h.state] += 1
+            return out
+
+    def peer_count(self) -> int:
+        with self._lock:
+            return len(self._peers)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._peers.clear()
+
+    # --- transition plumbing ----------------------------------------------
+    def on_transition(self, fn: Callable[[str, str, str], None]) -> None:
+        """Register ``fn(executor_id, old_state, new_state)``; fired
+        outside the detector lock."""
+        self._callbacks.append(fn)
+
+    def _declare(self, transitions, reason: str) -> None:
+        for eid, old, new in transitions:
+            if new == DEAD and _trace.TRACING["on"]:
+                _trace.get_tracer().complete(
+                    "fault", "peer.dead", time.perf_counter(), 0.0,
+                    peer=eid, reason=reason)
+        self._fire(transitions)
+
+    def _fire(self, transitions) -> None:
+        for eid, old, new in transitions:
+            for fn in self._callbacks:
+                try:
+                    fn(eid, old, new)
+                except Exception:  # noqa: BLE001 — detector must survive
+                    pass           # a failing observer callback
+
+
+#: every heartbeat-loop thread name starts with this; the leak
+#: sentinel's --cluster leg asserts none survive a manager close
+THREAD_PREFIX = "srt-peer-hb"
+
+
+class HeartbeatLoop:
+    """Background heartbeat driver: calls ``fn()`` every ``interval_s``
+    on a daemon thread until :meth:`close`.  ``close()`` is leak-free by
+    contract — it joins the thread, which tools/leak_sentinel.py's
+    ``--cluster`` leg asserts."""
+
+    THREAD_PREFIX = THREAD_PREFIX
+
+    def __init__(self, fn: Callable[[], None], interval_s: float,
+                 name: str = ""):
+        self._fn = fn
+        self._interval = max(0.01, float(interval_s))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"{self.THREAD_PREFIX}-{name or 'loop'}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._fn()
+            except Exception:  # noqa: BLE001 — a failing beat must not
+                pass           # kill the loop (the registry may be down)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

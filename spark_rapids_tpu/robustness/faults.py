@@ -32,6 +32,19 @@ Named sites wrap the engine's failure-prone edges:
                       exercises the poison-query quarantine + degraded-
                       engine protocol; queries fail by design with
                       FatalDeviceError
+``peer.kill``         a peer process dies abruptly: the failure detector
+                      sees its heartbeats stop cold (alive -> suspect ->
+                      dead) — exercises dead-declaration, immediate
+                      fetch failover and proactive lineage recompute
+``peer.stall``        a peer stalls (GC pause / SIGSTOP analog): one
+                      heartbeat observation is dropped — exercises the
+                      suspect state and the hysteresis back to alive
+``peer.partition``    a network partition: fetches against the drawn
+                      peer fail while its process stays alive —
+                      exercises failover without dead-declaration
+``mesh.collective.timeout`` a compiled mesh all_to_all exceeds its
+                      deadline — exercises the degrade-to-local-plane
+                      fallback (loud metric, never a hung stage)
 ====================  =====================================================
 
 Determinism contract: with ``seed`` fixed, the inject/pass decision for
@@ -64,6 +77,7 @@ SITES = (
     "spill.disk_write", "spill.disk_read", "transfer.h2d", "transfer.d2h",
     "kernel.compile", "memory.oom.retry", "memory.oom.split",
     "query.cancel.race", "admission.pressure", "device.fatal",
+    "peer.kill", "peer.stall", "peer.partition", "mesh.collective.timeout",
 )
 
 #: process-wide observability (sessions fold per-query deltas into

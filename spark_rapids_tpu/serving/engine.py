@@ -309,6 +309,22 @@ class ServingEngine:
                           "saturation": round(
                               active / max(1, sem.permits), 4)},
         }
+        # peer liveness (pod-scale fault domain): surfaced only when a
+        # shuffle manager is live — building one from /healthz would
+        # side-effect the engine's shuffle topology
+        from ..shuffle.manager import _global_manager
+        if _global_manager is not None:
+            try:
+                live = _global_manager.peer_liveness()
+                payload["peers"] = {
+                    "alive": len(live.get("alive", ())),
+                    "suspect": list(live.get("suspect", ())),
+                    "dead": list(live.get("dead", ())),
+                    "epoch": live.get("epoch", 0),
+                    "detector_armed": bool(live.get("armed", False)),
+                }
+            except Exception:  # noqa: BLE001 — liveness is advisory;
+                pass           # /healthz must never 500 on it
         return (not degraded), payload
 
     def _doctor_payload(self) -> Dict[str, Any]:

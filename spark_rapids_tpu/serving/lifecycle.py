@@ -65,7 +65,7 @@ STATS = {"cancelled": 0, "deadline_exceeded": 0, "quarantined": 0,
 #: spark.rapids.tpu.query.cancel.pollSites can restrict checks to a
 #: subset — empty means all)
 POLL_SITES = ("admission", "partition", "sem_wait", "prefetch", "stager",
-              "shuffle", "exchange", "spill")
+              "shuffle", "exchange", "spill", "mesh")
 
 
 class QueryCancelled(RuntimeError):

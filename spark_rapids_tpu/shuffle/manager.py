@@ -36,11 +36,13 @@ from ..config import (RapidsConf, SHUFFLE_EXECUTOR_ID,
                       SPILL_DIR)
 from ..observability import metrics as _om
 from ..observability import tracer as _trace
+from ..robustness import failure_detector as _fd
 from ..robustness import faults as _faults
 from .serializer import FrameCorrupt, concat_serialized, serialize_batch
-from .transport import (BlockId, LocalTransport, PeerBlacklist, PeerInfo,
-                        ShuffleFetchFailed, ShuffleHeartbeatManager,
-                        ShuffleTransport)
+from .transport import (BlockId, LocalTransport, PeerBlacklist, PeerDead,
+                        PeerInfo, ShuffleFetchFailed,
+                        ShuffleHeartbeatManager, ShuffleTransport,
+                        StaleBlockEpoch)
 
 
 def _transport_from_conf(conf: RapidsConf, executor_id: str):
@@ -81,7 +83,10 @@ def _transport_from_conf(conf: RapidsConf, executor_id: str):
 
 #: process-wide resilient-fetch accounting; the session folds per-query
 #: deltas into ``last_query_metrics`` (robustness.stats_snapshot)
-FETCH_STATS = {"retries": 0, "recomputed": 0, "blacklisted": 0}
+FETCH_STATS = {"retries": 0, "recomputed": 0, "blacklisted": 0,
+               "stale_epoch": 0, "dead_failovers": 0,
+               "proactive_recomputes": 0, "speculated": 0,
+               "speculative_wins": 0}
 
 
 class FetchPolicy:
@@ -176,6 +181,131 @@ class ShuffleManager:
             and isinstance(self.transport, LocalTransport)
             and self.mode != "ICI"
             and (self.topology is None or not self.topology.multi_slice))
+        # --- pod-scale fault domain: failure detector + epoch fencing ---
+        from ..config import (PEERS_DEAD_MS, PEERS_HEARTBEAT_MS,
+                              PEERS_SUSPECT_MS,
+                              SHUFFLE_FETCH_SPECULATIVE_P99)
+        self.detector = _fd.FailureDetector(
+            suspect_ms=int(self.conf.get(PEERS_SUSPECT_MS)),
+            dead_ms=int(self.conf.get(PEERS_DEAD_MS)))
+        self.detector.on_transition(self._on_peer_transition)
+        #: highest fencing epoch seen per peer (from registry responses);
+        #: a served block stamped BELOW this is refused as LOST
+        self._peer_epochs: Dict[str, int] = {}
+        #: this manager's own serving epoch (registry-assigned; persisted
+        #: beside committed-block state so a restart can prove it moved)
+        self.epoch = 0
+        #: which peer served each remotely-fetched block — the proactive
+        #: recompute set when that peer is declared dead
+        self._block_sources: Dict[BlockId, str] = {}
+        #: rolling remote-fetch latencies (s) for the speculative budget
+        self._fetch_latencies: List[float] = []
+        self._speculative_factor = float(
+            self.conf.get(SHUFFLE_FETCH_SPECULATIVE_P99))
+        self._spec_pool: Optional[ThreadPoolExecutor] = None
+        hb_ms = int(self.conf.get(PEERS_HEARTBEAT_MS))
+        #: detector-driven failover/fencing engages only when the
+        #: background heartbeat loop runs (heartbeatMs > 0) — with it
+        #: off (the default) fetch behavior is exactly the pre-detector
+        #: protocol, so single-process jobs pay nothing
+        self.detector_armed = hb_ms > 0
+        self._refresh_own_epoch()
+        self._learn_peers(self.peers)
+        self._hb_loop = (_fd.HeartbeatLoop(self._beat, hb_ms / 1e3,
+                                           name=executor_id)
+                         if hb_ms > 0 else None)
+
+    # --- pod-scale fault domain -----------------------------------------
+    def _refresh_own_epoch(self) -> None:
+        """Learn this executor's fencing epoch from the registry (the
+        TCP client exposes the last response's ``own_epoch``; the
+        in-process registry is queried directly), push it into the
+        serving transport's response stamp, and persist it beside the
+        committed-block state."""
+        ep = getattr(self.heartbeats, "own_epoch", 0)
+        if not ep and hasattr(self.heartbeats, "epoch_of"):
+            ep = self.heartbeats.epoch_of(self.executor_id)
+        if ep and int(ep) != self.epoch:
+            self.epoch = int(ep)
+            if hasattr(self.transport, "epoch"):
+                self.transport.epoch = self.epoch
+            try:
+                os.makedirs(self._dir, exist_ok=True)
+                with open(os.path.join(self._dir, "EPOCH"), "w") as fh:
+                    fh.write(str(self.epoch))
+            except OSError:
+                pass                 # fencing works without persistence
+
+    def _learn_peers(self, peers: Optional[List[PeerInfo]]) -> None:
+        """Fold a registry response into the fault domain: epoch bumps
+        fence (and revive) re-registered peers, every listed peer counts
+        as one heartbeat observation."""
+        for p in peers or ():
+            prev = self._peer_epochs.get(p.executor_id, 0)
+            if p.epoch > prev:
+                self._peer_epochs[p.executor_id] = p.epoch
+                if prev and self.detector.is_dead(p.executor_id):
+                    # a dead peer re-registered under a bumped epoch:
+                    # its pre-death blocks are fenced, so it may serve
+                    self.detector.revive(p.executor_id)
+                    continue
+            self.detector.observe(p.executor_id)
+
+    def _beat(self) -> None:
+        """One background heartbeat: refresh the peer view, feed the
+        detector, advance the state machine, export liveness gauges."""
+        try:
+            peers = self.heartbeats.heartbeat(self.executor_id)
+        except (ConnectionError, OSError):
+            peers = None             # registry unreachable: sweep anyway
+        if peers is not None:
+            self.peers = peers
+            self._refresh_own_epoch()
+            self._learn_peers(peers)
+            self._blacklist.reinstate_expired()
+        self.detector.sweep()
+        for state, n in self.detector.counts().items():
+            _om.set_gauge("shuffle_peers", n, state=state)
+
+    def _on_peer_transition(self, eid: str, old: str, new: str) -> None:
+        if new != _fd.DEAD:
+            return
+        _om.inc("shuffle_peer_deaths_total")
+        self._proactive_recompute(eid)
+
+    def _proactive_recompute(self, dead_eid: str) -> None:
+        """Dead-declaration recovery: regenerate map outputs this
+        process fetched FROM the dead peer for every shuffle that still
+        has a lineage callback — still-running queries re-read locally
+        instead of discovering the loss fetch-by-fetch."""
+        with self._lock:
+            victims = sorted({(b.shuffle_id, b.map_id)
+                              for b, src in self._block_sources.items()
+                              if src == dead_eid
+                              and b.shuffle_id in self._recompute})
+        for shuffle_id, map_id in victims:
+            try:
+                if self._recompute_block(BlockId(shuffle_id, map_id, 0)):
+                    FETCH_STATS["proactive_recomputes"] += 1
+                    _om.inc("shuffle_proactive_recomputes_total")
+                    with self._lock:
+                        for b in [b for b, src in
+                                  self._block_sources.items()
+                                  if src == dead_eid
+                                  and b.shuffle_id == shuffle_id
+                                  and b.map_id == map_id]:
+                            del self._block_sources[b]
+            except Exception:  # noqa: BLE001 — recompute is best-effort
+                pass           # here; the fetch path retries lazily
+
+    def peer_liveness(self) -> Dict[str, object]:
+        """Detector snapshot + fencing epochs for /healthz and the
+        doctor."""
+        snap = self.detector.snapshot()
+        snap["epoch"] = self.epoch
+        snap["peer_epochs"] = dict(self._peer_epochs)
+        snap["armed"] = self.detector_armed
+        return snap
 
     # ------------------------------------------------------------------
     def new_shuffle_id(self) -> int:
@@ -300,13 +430,22 @@ class ShuffleManager:
             now = time.monotonic()
             attempt += 1
             # a committed block whose file is GONE cannot heal by
-            # retrying — skip straight to recompute
-            lost = isinstance(last_err, FileNotFoundError)
+            # retrying — skip straight to recompute; same for a holder
+            # declared DEAD (failover must not wait out the backoff
+            # budget) and a zombie's stale-epoch response (fenced = LOST)
+            lost = isinstance(last_err, (FileNotFoundError, PeerDead,
+                                         StaleBlockEpoch))
             if lost or attempt > policy.max_retries or now >= deadline:
                 if not recomputed and self._recompute_block(block):
                     recomputed = True
                     attempt = 0       # fresh retry budget post-republish
                     continue
+                if recomputed and isinstance(last_err, PeerDead):
+                    # the lineage already re-ran this map task locally:
+                    # a block STILL absent after the republish is an
+                    # authoritatively-empty partition, not a loss (the
+                    # dead peer merely made absence ambiguous)
+                    return None
                 raise ShuffleFetchFailed(
                     f"block {block} unrecoverable after {attempt} "
                     f"attempt(s)"
@@ -367,22 +506,52 @@ class ShuffleManager:
             return split_frames(frame)
         # one heartbeat per reduce read, not per block (the driver
         # registry round-trip is not free over TCP); refreshes also
-        # reinstate expired blacklist benches
+        # reinstate expired blacklist benches and feed the detector
         if peers_cache[0] is None:
             peers_cache[0] = self.heartbeats.heartbeat(self.executor_id)
             self._blacklist.reinstate_expired()
+            if self.detector_armed:
+                self._refresh_own_epoch()
+                self._learn_peers(peers_cache[0])
+                self.detector.sweep()
         # a network failure must not masquerade as an empty partition:
         # only "every reachable peer says missing" may return None
-        # (FetchFailed contract); blacklisted peers are tried LAST
+        # (FetchFailed contract); blacklisted peers are tried LAST and
+        # DEAD peers not at all (immediate failover — a dead holder is
+        # PeerDead, which skips the retry budget straight to recompute)
+        ordered = self._blacklist.order(peers_cache[0])
+        dead_skipped = 0
+        if self.detector_armed:
+            live = [p for p in ordered
+                    if not self.detector.is_dead(p.executor_id)]
+            dead_skipped = len(ordered) - len(live)
+            # suspects drop to last-resort ordering (stable within each
+            # bucket, so the blacklist's ordering still decides ties)
+            live.sort(key=lambda p:
+                      self.detector.state(p.executor_id) == _fd.SUSPECT)
+            ordered = live
         errors: List[BaseException] = []
-        for peer in self._blacklist.order(peers_cache[0]):
+        for i, peer in enumerate(ordered):
+            # snapshot the blacklist generation BEFORE the attempt: if
+            # the peer is reinstated while this fetch is in flight, the
+            # stale failure report below must not re-bench it
+            gen = self._blacklist.generation(peer.executor_id)
             try:
                 _faults.maybe_inject("peer.death", exc=ShuffleFetchFailed,
                                      peer=peer.executor_id)
-                frame = self._remote_fetch(peer, block)
+                _faults.maybe_inject("peer.partition",
+                                     exc=ShuffleFetchFailed,
+                                     peer=peer.executor_id)
+                t_fetch = time.monotonic()
+                frame = self._maybe_speculative_fetch(
+                    peer, ordered[i + 1:], block)
+                self._record_latency(time.monotonic() - t_fetch)
+            except StaleBlockEpoch:
+                raise               # fenced zombie response: LOST, not a
+                                    # transient peer failure
             except (ConnectionError, OSError) as e:
                 errors.append(e)
-                if self._blacklist.record_failure(peer.executor_id):
+                if self._blacklist.record_failure(peer.executor_id, gen):
                     FETCH_STATS["blacklisted"] += 1
                     if _trace.TRACING["on"]:
                         t0 = time.perf_counter()
@@ -393,13 +562,118 @@ class ShuffleManager:
             self._blacklist.record_success(peer.executor_id)
             if frame is not None:
                 TIER_STATS["dcn_fetches"] += 1
+                with self._lock:
+                    self._block_sources[block] = peer.executor_id
                 return split_frames(frame)
+        if dead_skipped and not errors:
+            FETCH_STATS["dead_failovers"] += 1
+            _om.inc("shuffle_dead_peer_failovers_total")
+            raise PeerDead(
+                f"block {block}: no live peer has it; {dead_skipped} "
+                f"dead peer(s) skipped — failing over to recompute")
+        if self.detector_armed and not errors:
+            # a dead peer eventually EXPIRES out of the registry: its
+            # blocks must stay LOST (recompute), never silently read as
+            # authoritatively-empty partitions.  The last-known holder
+            # being gone from the peer list (or declared dead) is the
+            # loss signal.
+            with self._lock:
+                src = self._block_sources.get(block)
+            holder_gone = src is not None and (
+                self.detector.is_dead(src)
+                or all(p.executor_id != src for p in ordered))
+            # with no recorded source, ANY known death makes absence
+            # ambiguous — the block may have lived on the dead peer.
+            # Recompute resolves it: _fetch_block treats a post-recompute
+            # absence as authoritative, so genuinely-empty partitions
+            # still read as empty.
+            if holder_gone or (src is None
+                               and self.detector.counts().get(
+                                   _fd.DEAD, 0) > 0):
+                FETCH_STATS["dead_failovers"] += 1
+                _om.inc("shuffle_dead_peer_failovers_total")
+                raise PeerDead(
+                    f"block {block}: "
+                    + (f"last-known holder {src} is dead or gone from "
+                       f"the registry" if holder_gone else
+                       "no live peer has it and a peer death made "
+                       "absence ambiguous")
+                    + " — failing over to recompute")
         if errors:
             raise ShuffleFetchFailed(
                 f"block {block}: {len(errors)} peer fetch failure(s), "
                 f"last: {type(errors[-1]).__name__}: {errors[-1]}"
             ) from errors[-1]
         return None
+
+    def _record_latency(self, dt: float) -> None:
+        if self._speculative_factor <= 0:
+            return
+        with self._lock:
+            self._fetch_latencies.append(dt)
+            if len(self._fetch_latencies) > 256:
+                del self._fetch_latencies[:128]
+
+    def _fetch_p99(self) -> Optional[float]:
+        """Rolling p99 of remote-fetch latency; None until the window
+        has enough samples to mean anything."""
+        with self._lock:
+            lat = sorted(self._fetch_latencies)
+        if len(lat) < 8:
+            return None
+        return lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    def _maybe_speculative_fetch(self, peer, backups: List[PeerInfo],
+                                 block: BlockId) -> Optional[bytes]:
+        """Straggler mitigation: when the primary fetch exceeds
+        ``speculativeP99Factor`` x the rolling p99, race a duplicate
+        fetch against the next candidate peer; first result wins (the
+        loser's socket work is abandoned to its pool thread).  Off by
+        default (factor 0) and inert without a backup peer or a warm
+        latency window."""
+        budget = (self._fetch_p99() if self._speculative_factor > 0
+                  and backups else None)
+        if budget is None:
+            return self._remote_fetch(peer, block)
+        budget *= self._speculative_factor
+        if self._spec_pool is None:
+            with self._lock:
+                if self._spec_pool is None:
+                    self._spec_pool = ThreadPoolExecutor(
+                        max_workers=4,
+                        thread_name_prefix="shuffle-speculative")
+        from concurrent.futures import FIRST_COMPLETED, wait
+        from concurrent.futures import TimeoutError as _FutTimeout
+        primary = self._spec_pool.submit(self._remote_fetch, peer, block)
+        try:
+            return primary.result(timeout=budget)
+        except (TimeoutError, _FutTimeout):
+            pass
+        FETCH_STATS["speculated"] += 1
+        _om.inc("shuffle_fetch_speculated_total")
+        if _trace.TRACING["on"]:
+            t0 = time.perf_counter()
+            _trace.get_tracer().complete(
+                "fault", "shuffle.fetch.speculative", t0, 0.0,
+                block=str(block), slow_peer=peer.executor_id,
+                backup=backups[0].executor_id, budget_ms=budget * 1e3)
+        backup = self._spec_pool.submit(self._remote_fetch, backups[0],
+                                        block)
+        pending = {primary, backup}
+        while pending:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                if fut.exception() is None and fut.result() is not None:
+                    if fut is backup:
+                        FETCH_STATS["speculative_wins"] += 1
+                        _om.inc("shuffle_fetch_speculative_wins_total")
+                        with self._lock:
+                            self._block_sources[block] = \
+                                backups[0].executor_id
+                    return fut.result()
+        # neither produced a frame: propagate the primary's outcome so
+        # error semantics match the non-speculative path
+        return primary.result()
 
     def _remote_fetch(self, peer, block: BlockId) -> Optional[bytes]:
         """One peer fetch, wrapped in the requester-side distributed
@@ -410,7 +684,7 @@ class ShuffleManager:
         records this span id as its ``parent_span``, and
         tools/trace_merge.py connects the two with a flow event."""
         if not _trace.TRACING["on"]:
-            return self.transport.fetch(peer, block)
+            return self._fenced_fetch(peer, block)
         tctx = _trace.current_trace_context() or {}
         span_id = _trace.next_span_id()
         ctx = dict(tctx, span=span_id)
@@ -418,7 +692,7 @@ class ShuffleManager:
         t0 = time.perf_counter()
         _trace.set_fetch_trace(ctx)
         try:
-            frame = self.transport.fetch(peer, block)
+            frame = self._fenced_fetch(peer, block)
             return frame
         finally:
             _trace.set_fetch_trace(None)
@@ -428,6 +702,32 @@ class ShuffleManager:
                 peer=peer.executor_id, block=str(block),
                 trace_id=str(ctx.get("trace", "")), span_id=span_id,
                 bytes=len(frame) if frame is not None else 0)
+
+    def _fenced_fetch(self, peer, block: BlockId) -> Optional[bytes]:
+        """Transport fetch + the zombie fence: when the registry has
+        assigned this peer an epoch, fetch via the epoch-stamped op and
+        REFUSE a response served under an older epoch — that is a peer
+        declared dead still answering its socket, and its blocks may
+        predate the post-death recompute.  Refusal surfaces as
+        StaleBlockEpoch (= LOST -> lineage recompute), never as data."""
+        expected = self._peer_epochs.get(peer.executor_id, 0)
+        if not expected:
+            return self.transport.fetch(peer, block)
+        frame, served = self.transport.fetch_with_epoch(peer, block)
+        if served is not None and served < expected:
+            FETCH_STATS["stale_epoch"] += 1
+            _om.inc("shuffle_stale_epoch_total")
+            if _trace.TRACING["on"]:
+                t0 = time.perf_counter()
+                _trace.get_tracer().complete(
+                    "fault", "shuffle.fetch.stale_epoch", t0, 0.0,
+                    peer=peer.executor_id, block=str(block),
+                    served_epoch=served, fenced_epoch=expected)
+            raise StaleBlockEpoch(
+                f"peer {peer.executor_id} served {block} at epoch "
+                f"{served} < fenced epoch {expected}: zombie response "
+                f"refused")
+        return frame
 
     # --- lost-block recompute -------------------------------------------
     def register_recompute(self, shuffle_id: int,
@@ -509,8 +809,12 @@ class ShuffleManager:
                                and b.shuffle_id != shuffle_id}
             if shuffle_id is None:
                 self._recompute.clear()
+                self._block_sources.clear()
             else:
                 self._recompute.pop(shuffle_id, None)
+                for b in [b for b in self._block_sources
+                          if b.shuffle_id == shuffle_id]:
+                    del self._block_sources[b]
             res_victims = [b for b in self._resident
                            if shuffle_id is None
                            or b.shuffle_id == shuffle_id]
@@ -525,7 +829,19 @@ class ShuffleManager:
 
 
     def close(self) -> None:
-        """Release pools, transport blocks and shuffle files."""
+        """Release pools, transport blocks and shuffle files.  The fault
+        domain drains COMPLETELY: heartbeat thread joined, detector peer
+        table and epoch map cleared (the leak sentinel's --cluster leg
+        asserts all three return to baseline)."""
+        if self._hb_loop is not None:
+            self._hb_loop.close()
+            self._hb_loop = None
+        self.detector.clear()
+        self._peer_epochs.clear()
+        self._block_sources.clear()
+        if self._spec_pool is not None:
+            self._spec_pool.shutdown(wait=False)
+            self._spec_pool = None
         self.cleanup()
         self._writer_pool.shutdown(wait=False)
         self._reader_pool.shutdown(wait=False)

@@ -15,13 +15,20 @@ Wire protocol (all big-endian):
 * block fetch:  request  ``magic u32 | op u8 | shuffle i64 | map i64 |
   reduce i64``; response ``status u8 | len u64 | payload``.
 * registry ops: request ``magic u32 | op u8 | len u32 | json``;
-  response ``len u32 | json`` (peer list).  One driver process serves the
-  registry; executors register their (executor_id, host:port) and poll.
+  response ``len u32 | json`` (peer list; each peer entry and the
+  caller's own ``"epoch"`` carry the registry's fencing epochs — old
+  builds simply omit/ignore the extra keys).  One driver process serves
+  the registry; executors register their (executor_id, host:port) and
+  poll.
 * traced fetch (op 4, versioned extension): request uses the registry-op
   framing with a json body ``{"block": [s, m, r], "from": executor,
-  "trace": {...}}`` carrying the requester's distributed trace context;
-  response ``len u32 | json head | payload`` where the head is
-  ``{"status", "len", "serve_span"}``.  A pre-extension peer parses the
+  "trace": {...}}`` carrying the requester's distributed trace context
+  (``"trace"`` optional — the op doubles as the epoch-fenced fetch when
+  tracing is off); response ``len u32 | json head | payload`` where the
+  head is ``{"status", "len", "serve_span", "epoch"}`` — ``epoch`` is
+  the serving side's fencing token; a requester holding a NEWER epoch
+  for that peer refuses the payload as LOST (zombie fencing, see
+  docs/robustness.md).  A pre-extension peer parses the
   request safely via the registry framing and answers ``{"error": ...}``
   — the client then marks that endpoint trace-incapable and falls back
   to the plain fetch op on the same pooled connection, so old and new
@@ -175,6 +182,12 @@ class TcpShuffleTransport(ShuffleTransport):
         # endpoints that answered the traced fetch op with an error
         # (pre-trace peers): use the plain op there from then on
         self._no_trace: Dict[str, bool] = {}
+        #: this executor's SERVING epoch (fencing token) — the shuffle
+        #: manager sets it from the registry's register/heartbeat
+        #: response and persists it beside committed-block state.  0 =
+        #: epochs not in play; traced-fetch responses then omit the
+        #: stamp and requesters skip the fence for this peer.
+        self.epoch = 0
 
     @property
     def endpoint(self) -> str:
@@ -204,6 +217,10 @@ class TcpShuffleTransport(ShuffleTransport):
             payload = self._store.get(block)
         head = {"status": "found" if payload is not None else "missing",
                 "len": len(payload or b"")}
+        if self.epoch:
+            # fencing stamp: which registration generation served this
+            # block — a requester holding a NEWER epoch for us refuses it
+            head["epoch"] = self.epoch
         if _trace.TRACING["on"]:
             tctx = js.get("trace") or {}
             serve_span = _trace.next_span_id()
@@ -229,12 +246,24 @@ class TcpShuffleTransport(ShuffleTransport):
         the block missing, and raises :class:`ShuffleFetchFailed` on
         network failure — callers must NOT treat a failure as an empty
         partition (silent data loss)."""
+        return self._fetch_impl(peer, block, want_epoch=False)[0]
+
+    def fetch_with_epoch(self, peer: PeerInfo, block: BlockId
+                         ) -> Tuple[Optional[bytes], Optional[int]]:
+        """Fetch via the json-framed op so the response carries the
+        serving side's fencing epoch.  ``(frame, None)`` when the peer
+        predates epochs (old build / plain-op fallback) — fencing
+        degrades to off for that fetch instead of failing it."""
+        return self._fetch_impl(peer, block, want_epoch=True)
+
+    def _fetch_impl(self, peer: PeerInfo, block: BlockId, want_epoch: bool
+                    ) -> Tuple[Optional[bytes], Optional[int]]:
         _faults.maybe_inject("shuffle.fetch", exc=ShuffleFetchFailed,
                              peer=peer.executor_id, block=str(block))
         if peer.executor_id == self.executor_id or peer.endpoint in (
                 "local", self.endpoint):
             with self._lock:
-                return self._store.get(block)
+                return self._store.get(block), (self.epoch or None)
         with self._conn_lock:
             ep_lock = self._endpoint_locks.setdefault(peer.endpoint,
                                                       threading.Lock())
@@ -245,21 +274,22 @@ class TcpShuffleTransport(ShuffleTransport):
                 if sock is None:
                     continue
                 try:
-                    if tctx is not None \
+                    if (tctx is not None or want_epoch) \
                             and peer.endpoint not in self._no_trace:
-                        got = self._fetch_traced(sock, peer, block, tctx)
+                        got, epoch = self._fetch_traced(sock, peer, block,
+                                                        tctx)
                         if got is not _TRACE_UNSUPPORTED:
-                            return got
-                        # pre-trace peer: fall through to the plain op
-                        # on the same pooled connection
+                            return got, epoch
+                        # pre-extension peer: fall through to the plain
+                        # op on the same pooled connection
                     sock.sendall(_REQ.pack(_MAGIC, _OP_FETCH,
                                            block.shuffle_id, block.map_id,
                                            block.reduce_id))
                     status, n = _RESP_HEAD.unpack(
                         _recv_exact(sock, _RESP_HEAD.size))
                     if status == _MISSING:
-                        return None
-                    return _recv_exact(sock, n)
+                        return None, None
+                    return _recv_exact(sock, n), None
                 except (ConnectionError, OSError):
                     self._drop_connection(peer.endpoint)
         raise ShuffleFetchFailed(
@@ -267,23 +297,29 @@ class TcpShuffleTransport(ShuffleTransport):
             f"({peer.endpoint})")
 
     def _fetch_traced(self, sock: socket.socket, peer: PeerInfo,
-                      block: BlockId, tctx: dict):
-        """One traced fetch over an established socket; returns the
-        frame/None like :meth:`fetch`, or ``_TRACE_UNSUPPORTED`` when
-        the peer predates the extension (caller retries plain)."""
-        body = json.dumps({
-            "block": [block.shuffle_id, block.map_id, block.reduce_id],
-            "from": self.executor_id, "trace": tctx}).encode()
+                      block: BlockId, tctx: Optional[dict]):
+        """One json-framed fetch over an established socket; returns
+        ``(frame_or_None, serving_epoch_or_None)``, or
+        ``(_TRACE_UNSUPPORTED, None)`` when the peer predates the
+        extension (caller retries plain).  ``tctx`` may be None —
+        fetch_with_epoch uses this op for the fencing stamp even with
+        tracing off."""
+        req = {"block": [block.shuffle_id, block.map_id, block.reduce_id],
+               "from": self.executor_id}
+        if tctx is not None:
+            req["trace"] = tctx
+        body = json.dumps(req).encode()
         sock.sendall(_REQ.pack(_MAGIC, _OP_FETCH_TRACED, len(body), 0, 0)
                      + body)
         (n,) = _JSON_RESP.unpack(_recv_exact(sock, _JSON_RESP.size))
         head = json.loads(_recv_exact(sock, n))
         if "error" in head:
             self._no_trace[peer.endpoint] = True
-            return _TRACE_UNSUPPORTED
+            return _TRACE_UNSUPPORTED, None
+        epoch = int(head["epoch"]) if "epoch" in head else None
         if head.get("status") == "missing":
-            return None
-        return _recv_exact(sock, int(head.get("len", 0)))
+            return None, epoch
+        return _recv_exact(sock, int(head.get("len", 0))), epoch
 
     # --- connection pool --------------------------------------------------
     def _connection(self, endpoint: str, fresh: bool = False
@@ -358,6 +394,7 @@ class TcpHeartbeatServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  heartbeat_timeout_s: float = 60.0):
         self._peers: Dict[str, PeerInfo] = {}
+        self._epochs: Dict[str, int] = {}     # fencing: survives expiry
         self._lock = threading.Lock()
         self._timeout = heartbeat_timeout_s
         self._server = _Server(self._handle, host, port)
@@ -378,16 +415,35 @@ class TcpHeartbeatServer:
                 # visibility instead of being invisible forever
                 endpoint = js.get("endpoint", "")
                 if op == _OP_REGISTER or endpoint:
-                    self._peers[eid] = PeerInfo(eid, endpoint, now)
+                    if eid not in self._peers:
+                        # fencing bump: first join or a re-join after
+                        # expiry — the comeback serves under a NEW epoch
+                        self._epochs[eid] = self._epochs.get(eid, 0) + 1
+                    self._peers[eid] = PeerInfo(
+                        eid, endpoint, now, epoch=self._epochs[eid])
             else:
                 self._peers[eid].last_heartbeat = now
             dead = [e for e, p in self._peers.items()
                     if now - p.last_heartbeat > self._timeout]
             for e in dead:
-                del self._peers[e]
+                del self._peers[e]   # epoch survives for the comeback
             return {"peers": [
-                {"executor_id": p.executor_id, "endpoint": p.endpoint}
-                for e, p in self._peers.items() if e != eid]}
+                {"executor_id": p.executor_id, "endpoint": p.endpoint,
+                 "epoch": p.epoch}
+                for e, p in self._peers.items() if e != eid],
+                "epoch": self._epochs.get(eid, 0)}
+
+    def epoch_of(self, executor_id: str) -> int:
+        """Current fencing epoch for an executor (0 = never registered)."""
+        with self._lock:
+            return self._epochs.get(executor_id, 0)
+
+    def expire_now(self, executor_id: str) -> None:
+        """Authoritative eviction: drop the peer from the live table so
+        its next register bumps the epoch (the dead-declaration path the
+        chaos harness drives directly)."""
+        with self._lock:
+            self._peers.pop(executor_id, None)
 
     def executors(self) -> List[str]:
         with self._lock:
@@ -411,6 +467,9 @@ class TcpHeartbeatClient:
         self._my_endpoint = ""  # remembered at register for re-registration
         self._connect_timeout, self._read_timeout = _conf_timeouts(
             connect_timeout_s, read_timeout_s)
+        #: this executor's fencing epoch per the registry's last
+        #: response (0 until the first register, or an old registry)
+        self.own_epoch = 0
 
     def _request(self, op: int, payload: dict) -> List[PeerInfo]:
         body = json.dumps(payload).encode()
@@ -428,7 +487,9 @@ class TcpHeartbeatClient:
                     (n,) = _JSON_RESP.unpack(
                         _recv_exact(self._sock, _JSON_RESP.size))
                     out = json.loads(_recv_exact(self._sock, n))
-                    return [PeerInfo(p["executor_id"], p["endpoint"])
+                    self.own_epoch = int(out.get("epoch", 0))
+                    return [PeerInfo(p["executor_id"], p["endpoint"],
+                                     epoch=int(p.get("epoch", 0)))
                             for p in out.get("peers", [])]
                 except (ConnectionError, OSError):
                     if self._sock is not None:

@@ -39,6 +39,11 @@ class PeerInfo:
     executor_id: str
     endpoint: str        # opaque address (host:port for a real transport)
     last_heartbeat: float = 0.0
+    #: fencing token: the registry bumps this each time the executor
+    #: (re-)registers after having been dropped/declared dead.  0 means
+    #: "unknown" (an old registry that doesn't speak epochs) — fencing
+    #: degrades to off for that peer rather than failing fetches.
+    epoch: int = 0
 
 
 class ShuffleFetchFailed(ConnectionError):
@@ -51,6 +56,23 @@ class ShuffleFetchFailed(ConnectionError):
     None that masquerades as an empty partition."""
 
 
+class PeerDead(ShuffleFetchFailed):
+    """The block's only reachable holder was declared DEAD by the
+    failure detector: the fetch fails over immediately — no retry or
+    backoff budget is spent waiting out a peer that will not answer —
+    and the retry loop goes straight to lineage recompute."""
+
+
+class StaleBlockEpoch(ShuffleFetchFailed):
+    """A peer served a block stamped with an OLDER epoch than the
+    registry's current epoch for that peer: a zombie — a process that was
+    declared dead (and whose outputs were recomputed under a bumped
+    epoch) but is still answering its socket.  The block is treated as
+    LOST (lineage recompute), never consumed: the zombie's copy may
+    predate the recompute and mixing the two generations breaks
+    exactly-once shuffle semantics."""
+
+
 class PeerBlacklist:
     """Transient peer benching after repeated fetch failures — the
     reference's FetchFailed -> executor-blacklist bookkeeping at peer
@@ -58,19 +80,42 @@ class PeerBlacklist:
     still tried when nothing else has the block — correctness never
     depends on the blacklist); the first heartbeat refresh after the TTL
     expires reinstates them with a clean slate, and any successful fetch
-    clears the strikes immediately."""
+    clears the strikes immediately.
+
+    Reinstatement race: a failure observed BEFORE a peer was reinstated
+    can land AFTER (a fetch thread paused mid-backoff reports its stale
+    outcome late) and instantly re-blacklist the fresh peer, flapping
+    it.  Every reinstatement/success bumps a per-peer *generation*;
+    callers snapshot ``generation(eid)`` before attempting the fetch and
+    pass it to ``record_failure`` — a report carrying a stale generation
+    is dropped on the floor."""
 
     def __init__(self, threshold: int = 2, ttl_s: float = 5.0):
         self.threshold = max(1, int(threshold))
         self.ttl_s = float(ttl_s)
         self._strikes: Dict[str, int] = {}
         self._until: Dict[str, float] = {}
+        self._gen: Dict[str, int] = {}
         self._lock = threading.Lock()
 
-    def record_failure(self, executor_id: str) -> bool:
-        """Returns True when this failure NEWLY blacklists the peer."""
+    def generation(self, executor_id: str) -> int:
+        """Snapshot BEFORE a fetch attempt; pass to record_failure so a
+        report that straddled a reinstatement can be discarded."""
+        with self._lock:
+            return self._gen.get(executor_id, 0)
+
+    def record_failure(self, executor_id: str,
+                       generation: Optional[int] = None) -> bool:
+        """Returns True when this failure NEWLY blacklists the peer.
+        ``generation`` (from :meth:`generation` before the attempt) makes
+        the report drop-on-stale: if the peer was reinstated or succeeded
+        since the snapshot, the failure predates the clean slate and must
+        not count against it."""
         now = time.monotonic()
         with self._lock:
+            if (generation is not None
+                    and generation != self._gen.get(executor_id, 0)):
+                return False
             n = self._strikes.get(executor_id, 0) + 1
             self._strikes[executor_id] = n
             if n >= self.threshold and executor_id not in self._until:
@@ -81,7 +126,8 @@ class PeerBlacklist:
     def record_success(self, executor_id: str) -> None:
         with self._lock:
             self._strikes.pop(executor_id, None)
-            self._until.pop(executor_id, None)
+            if self._until.pop(executor_id, None) is not None:
+                self._gen[executor_id] = self._gen.get(executor_id, 0) + 1
 
     def is_blacklisted(self, executor_id: str) -> bool:
         with self._lock:
@@ -89,13 +135,16 @@ class PeerBlacklist:
 
     def reinstate_expired(self) -> List[str]:
         """Called on heartbeat refresh: peers whose bench expired get a
-        clean slate (heartbeat-driven reinstatement)."""
+        clean slate (heartbeat-driven reinstatement).  Bumps each
+        reinstated peer's generation so in-flight failure reports from
+        before the reinstatement cannot re-bench it."""
         now = time.monotonic()
         with self._lock:
             done = [e for e, t in self._until.items() if now >= t]
             for e in done:
                 del self._until[e]
                 self._strikes.pop(e, None)
+                self._gen[e] = self._gen.get(e, 0) + 1
             return done
 
     def order(self, peers: List["PeerInfo"]) -> List["PeerInfo"]:
@@ -115,6 +164,13 @@ class ShuffleTransport:
     def fetch(self, peer: PeerInfo, block: BlockId) -> Optional[bytes]:
         raise NotImplementedError
 
+    def fetch_with_epoch(self, peer: PeerInfo, block: BlockId
+                         ) -> Tuple[Optional[bytes], Optional[int]]:
+        """Fetch + the SERVING side's fencing epoch, or None when the
+        transport/peer doesn't speak epochs (fencing degrades to off
+        for that fetch rather than failing it)."""
+        return self.fetch(peer, block), None
+
     def fetch_many(self, peer: PeerInfo, blocks: List[BlockId]
                    ) -> List[Optional[bytes]]:
         return [self.fetch(peer, b) for b in blocks]
@@ -132,6 +188,14 @@ class LocalTransport(ShuffleTransport):
         self._lock = threading.Lock()
         self.fetch_hook: Optional[Callable[[PeerInfo, BlockId],
                                            Optional[bytes]]] = None
+        #: per-executor SERVING epochs (the fencing test seam: a test
+        #: plays zombie by leaving this behind the registry's epoch)
+        self.serving_epochs: Dict[str, int] = {}
+
+    def fetch_with_epoch(self, peer: PeerInfo, block: BlockId
+                         ) -> Tuple[Optional[bytes], Optional[int]]:
+        return self.fetch(peer, block), self.serving_epochs.get(
+            peer.executor_id)
 
     def publish(self, executor_id: str, block: BlockId, frame: bytes) -> None:
         with self._lock:
@@ -180,16 +244,28 @@ class LocalTransport(ShuffleTransport):
 class ShuffleHeartbeatManager:
     """Driver-side peer registry: executors register + heartbeat, receive
     the current peer set (``RapidsShuffleHeartbeatManager.scala:255`` +
-    driver RPC receive ``Plugin.scala:290-301``)."""
+    driver RPC receive ``Plugin.scala:290-301``).
+
+    The registry is also the EPOCH AUTHORITY of the fencing protocol:
+    each executor's epoch starts at 1 and is bumped every time it
+    registers while absent from the live peer table (first join, or a
+    re-join after expiry/dead-declaration).  Epochs survive expiry on
+    purpose — a peer that comes back gets a HIGHER epoch, which is what
+    fences its pre-death blocks."""
 
     def __init__(self, heartbeat_timeout_s: float = 60.0):
         self._peers: Dict[str, PeerInfo] = {}
+        self._epochs: Dict[str, int] = {}     # persists across expiry
         self._lock = threading.Lock()
         self._timeout = heartbeat_timeout_s
 
     def register(self, executor_id: str, endpoint: str) -> List[PeerInfo]:
         with self._lock:
-            info = PeerInfo(executor_id, endpoint, time.monotonic())
+            if executor_id not in self._peers:
+                self._epochs[executor_id] = (
+                    self._epochs.get(executor_id, 0) + 1)
+            info = PeerInfo(executor_id, endpoint, time.monotonic(),
+                            epoch=self._epochs[executor_id])
             self._peers[executor_id] = info
             return [p for e, p in self._peers.items() if e != executor_id]
 
@@ -199,11 +275,23 @@ class ShuffleHeartbeatManager:
             if executor_id in self._peers:
                 self._peers[executor_id].last_heartbeat = now
             # expire dead peers so fetches fail fast and retry elsewhere
+            # (their epoch survives: a comeback re-registers one higher)
             dead = [e for e, p in self._peers.items()
                     if now - p.last_heartbeat > self._timeout]
             for e in dead:
                 del self._peers[e]
             return [p for e, p in self._peers.items() if e != executor_id]
+
+    def epoch_of(self, executor_id: str) -> int:
+        """Current fencing epoch for an executor (0 = never registered)."""
+        with self._lock:
+            return self._epochs.get(executor_id, 0)
+
+    def expire_now(self, executor_id: str) -> None:
+        """Authoritative eviction (dead-declaration path): drop the peer
+        from the live table so its next register bumps the epoch."""
+        with self._lock:
+            self._peers.pop(executor_id, None)
 
     def executors(self) -> List[str]:
         with self._lock:

@@ -84,6 +84,11 @@ QUICK_MODULES = {
     # are tier-1 — a launch-count regression is a silent perf cliff on
     # the tunnel that no correctness test would ever fail
     "test_dispatch_budget",
+    # pod-scale fault domain (ISSUE 19): the phi-accrual detector state
+    # machine, epoch fencing, speculative fetch and the blacklist
+    # generation race are tier-1 — a regression here is silent data
+    # loss that only manifests when a peer actually dies
+    "test_failure_detector",
     # perf sentry (ISSUE 18): probe classification, evidence-ledger
     # append-only/torn-line safety, live-over-stale baseline resolution
     # and the /sentry route contract are tier-1 — a sentry regression
@@ -94,6 +99,8 @@ QUICK_MODULES = {
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
+        if item.get_closest_marker("slow"):
+            continue     # an explicit slow mark wins over module tiering
         mod = item.module.__name__.rsplit(".", 1)[-1] if item.module else ""
         item.add_marker(pytest.mark.quick if mod in QUICK_MODULES
                         else pytest.mark.slow)

@@ -1,0 +1,515 @@
+"""Pod-scale fault domain tests — the phi-accrual failure detector's
+state machine (hysteresis, sticky dead, revive), epoch fencing at the
+transport SPI seam (reference strategy: unit-test distributed logic with
+a mock transport, no cluster), speculative duplicate fetches, the
+blacklist reinstatement-race regression, spill disk-full handling, and
+the mesh collective watchdog.  The real N-process scenarios live in
+``testing/chaos_cluster.py`` (slow-marked smoke here; CI runs the full
+harness)."""
+
+import errno
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.convert import arrow_to_device
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.robustness import arm_chaos, disarm_chaos
+from spark_rapids_tpu.robustness import failure_detector as fd
+from spark_rapids_tpu.shuffle import (FETCH_STATS, LocalTransport,
+                                      PeerBlacklist,
+                                      ShuffleHeartbeatManager,
+                                      ShuffleManager)
+from spark_rapids_tpu.shuffle.transport import (BlockId, PeerInfo,
+                                                StaleBlockEpoch)
+
+
+def small_table(n=24, seed=3):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 8, n), "v": rng.random(n)})
+
+
+# ---------------------------------------------------------------------------
+# detector state machine (time-controlled: every API takes an explicit now)
+# ---------------------------------------------------------------------------
+
+def _beats(det, eid, start, n, dt):
+    t = start
+    for _ in range(n):
+        det.observe(eid, now=t)
+        t += dt
+    return t - dt   # time of the last beat
+
+
+def test_detector_alive_suspect_dead():
+    det = fd.FailureDetector(suspect_ms=300, dead_ms=800)
+    last = _beats(det, "p", 0.0, 6, 0.1)
+    assert det.state("p") == fd.ALIVE
+    det.sweep(now=last + 0.29)
+    assert det.state("p") == fd.ALIVE       # inside the grace window
+    det.sweep(now=last + 0.35)
+    assert det.state("p") == fd.SUSPECT     # silent past suspectMs
+    det.sweep(now=last + 0.9)
+    assert det.state("p") == fd.DEAD        # silent past the hard bound
+    assert det.is_dead("p")
+
+
+def test_detector_suspect_heals_with_hysteresis():
+    # jitter_scale=0 pins the on-time threshold at suspectMs, so the
+    # late beat below is unambiguously off-time
+    det = fd.FailureDetector(suspect_ms=300, dead_ms=800, recover_beats=2,
+                             jitter_scale=0.0)
+    last = _beats(det, "p", 0.0, 6, 0.1)
+    det.sweep(now=last + 0.4)
+    assert det.state("p") == fd.SUSPECT
+    # the late re-arrival beat is off-time: no credit
+    det.observe("p", now=last + 0.4)
+    assert det.state("p") == fd.SUSPECT
+    # one on-time beat is NOT enough (hysteresis) ...
+    det.observe("p", now=last + 0.5)
+    assert det.state("p") == fd.SUSPECT
+    # ... two consecutive on-time beats heal it
+    det.observe("p", now=last + 0.6)
+    assert det.state("p") == fd.ALIVE
+    assert fd.STATS["recovered"] >= 1
+
+
+def test_detector_dead_is_sticky_until_revive():
+    det = fd.FailureDetector(suspect_ms=100, dead_ms=200)
+    before = fd.STATS["revived"]
+    det.observe("p", now=0.0)
+    det.observe("p", now=0.1)
+    det.sweep(now=1.0)
+    assert det.is_dead("p")
+    # heartbeats from a zombie must NOT resurrect it
+    det.observe("p", now=1.1)
+    det.observe("p", now=1.2)
+    assert det.is_dead("p")
+    # only the re-registration path (epoch bump first) revives
+    det.revive("p", now=1.3)
+    assert det.state("p") == fd.ALIVE
+    assert fd.STATS["revived"] == before + 1
+
+
+def test_detector_transition_callbacks_and_death_generation():
+    det = fd.FailureDetector(suspect_ms=100, dead_ms=200)
+    seen = []
+    det.on_transition(lambda e, old, new: seen.append((e, old, new)))
+    gen0 = det.death_generation
+    det.observe("p", now=0.0)
+    det.observe("p", now=0.1)
+    det.sweep(now=5.0)
+    assert ("p", fd.SUSPECT, fd.DEAD) in seen or \
+        ("p", fd.ALIVE, fd.DEAD) in seen
+    assert det.death_generation == gen0 + 1
+
+
+def test_detector_phi_grows_with_silence():
+    det = fd.FailureDetector()
+    last = _beats(det, "p", 0.0, 8, 0.1)
+    early = det.phi("p", now=last + 0.05)
+    late = det.phi("p", now=last + 2.0)
+    assert late > early >= 0.0
+
+
+def test_detector_jitter_scales_suspect_grace():
+    """Phi-accrual: a peer whose heartbeats normally wobble gets
+    proportionally more grace before SUSPECT; a steady peer does not."""
+    det = fd.FailureDetector(suspect_ms=300, dead_ms=5_000,
+                             jitter_scale=4.0)
+    t = 0.0
+    for i in range(10):                      # jittery: dt alternates
+        det.observe("wobbly", now=t)
+        t += 0.1 if i % 2 == 0 else 0.3
+    wob_last = t - (0.3 if (10 - 1) % 2 == 1 else 0.1)
+    steady_last = _beats(det, "steady", 0.0, 10, 0.1)
+    det.sweep(now=max(wob_last, steady_last) + 0.45)
+    assert det.state("steady") == fd.SUSPECT
+    assert det.state("wobbly") == fd.ALIVE
+
+
+def test_chaos_peer_kill_and_stall_sites():
+    try:
+        arm_chaos(seed=3, sites="peer.kill:1.0")
+        det = fd.FailureDetector()
+        det.observe("p")       # the drawn kill force-declares dead
+        det.observe("p")
+        assert det.is_dead("p")
+        disarm_chaos()
+        arm_chaos(seed=3, sites="peer.stall:1.0")
+        det2 = fd.FailureDetector(suspect_ms=100, dead_ms=10_000)
+        det2.observe("q", now=0.0)
+        det2.observe("q", now=0.1)   # dropped observation: q looks stalled
+        assert det2.state("q") == fd.ALIVE
+    finally:
+        disarm_chaos()
+
+
+def test_heartbeat_loop_close_joins_thread():
+    hits = []
+    loop = fd.HeartbeatLoop(lambda: hits.append(1), 0.01, name="t")
+    time.sleep(0.08)
+    loop.close()
+    assert hits                      # it beat at least once
+    assert not any(t.name.startswith(fd.THREAD_PREFIX)
+                   for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing at the SPI seam
+# ---------------------------------------------------------------------------
+
+def _ici_conf(**extra):
+    conf = RapidsConf()
+    conf.set("spark.rapids.shuffle.mode", "ICI")
+    for k, v in extra.items():
+        conf.set(k.replace("__", "."), v)
+    return conf
+
+
+def test_epoch_fencing_refuses_stale_blocks():
+    """A zombie (old process serving after its executor id re-registered
+    under a bumped epoch) must have every response refused as LOST and
+    recovered via lineage — bit-identically."""
+    conf = RapidsConf()
+    conf.set("spark.rapids.shuffle.mode", "ICI")
+    conf.set("spark.rapids.tpu.peers.heartbeatMs", 60_000)  # armed
+    hb = ShuffleHeartbeatManager()
+    transport = LocalTransport()
+    a = ShuffleManager(conf, transport, "exec-A", hb)
+    b = ShuffleManager(conf, transport, "exec-B", hb)
+    try:
+        t = small_table(30)
+        b.write_map_output(11, 0, [arrow_to_device(t)])
+        a._beat()                     # learn B + its epoch (1)
+        assert a._peer_epochs.get("exec-B") == 1
+        # epoch-matched serving passes the fence
+        transport.serving_epochs["exec-B"] = 1
+        got = a.read_reduce_partition(11, 1, 0)
+        assert got is not None and got.num_rows_int == 30
+
+        # B's executor id re-registers (epoch bump) but the OLD process
+        # still serves at epoch 1: every fetch must refuse it
+        hb.expire_now("exec-B")
+        hb.register("exec-B", "local")
+        assert hb.epoch_of("exec-B") == 2
+        a._beat()
+        assert a._peer_epochs["exec-B"] == 2
+        stale0 = FETCH_STATS["stale_epoch"]
+        rec0 = FETCH_STATS["recomputed"]
+        # a fresh shuffle from B forces the remote path: the zombie's
+        # response is stamped epoch 1 < expected 2 -> refused as LOST
+        # and recovered via lineage, bit-identically
+        b.write_map_output(13, 0, [arrow_to_device(t)])
+        a.register_recompute(
+            13, lambda mid: a.write_map_output(
+                13, mid, [arrow_to_device(t)]))
+        got3 = a.read_reduce_partition(13, 1, 0)
+        assert got3 is not None and got3.num_rows_int == 30
+        assert FETCH_STATS["stale_epoch"] > stale0
+        assert FETCH_STATS["recomputed"] > rec0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fencing_degrades_off_for_epochless_transports():
+    """A transport that cannot stamp epochs (served=None — old peers,
+    the plain-op wire path) must never be refused."""
+    conf = _ici_conf()
+    hb = ShuffleHeartbeatManager()
+    transport = LocalTransport()
+    a = ShuffleManager(conf, transport, "exec-A", hb)
+    b = ShuffleManager(conf, transport, "exec-B", hb)
+    try:
+        b.write_map_output(17, 0, [arrow_to_device(small_table(12))])
+        # no serving_epochs entry: fetch_with_epoch reports None
+        got = a.read_reduce_partition(17, 1, 0)
+        assert got is not None and got.num_rows_int == 12
+    finally:
+        a.close()
+        b.close()
+
+
+def test_registry_epochs_survive_expiry():
+    # authoritative eviction path (dead declaration)
+    hb = ShuffleHeartbeatManager()
+    hb.register("e1", "ep1")
+    assert hb.epoch_of("e1") == 1
+    hb.register("e1", "ep1")       # re-register while PRESENT: no bump
+    assert hb.epoch_of("e1") == 1
+    hb.expire_now("e1")
+    hb.register("e1", "ep1")       # re-registration AFTER eviction
+    assert hb.epoch_of("e1") == 2  # the fencing token moved
+
+    # heartbeat-timeout expiry path bumps the same token
+    hb2 = ShuffleHeartbeatManager(heartbeat_timeout_s=0.0)
+    hb2.register("e1", "ep1")
+    time.sleep(0.002)
+    hb2.heartbeat("e2")            # prunes e1 (silent past timeout 0)
+    assert "e1" not in hb2.executors()
+    hb2.register("e1", "ep1")
+    assert hb2.epoch_of("e1") == 2
+
+
+# ---------------------------------------------------------------------------
+# blacklist reinstatement race (generation fencing)
+# ---------------------------------------------------------------------------
+
+def test_blacklist_generation_drops_stale_reports():
+    bl = PeerBlacklist(threshold=1, ttl_s=0.02)
+    gen = bl.generation("p")
+    assert bl.record_failure("p", gen) is True   # benched
+    time.sleep(0.03)
+    assert bl.reinstate_expired() == ["p"]       # generation bumps
+    # the stale report from before the bench/reinstate cycle must not
+    # re-bench the peer
+    assert bl.record_failure("p", gen) is False
+    assert not bl.is_blacklisted("p")
+    # a fresh-generation report counts again
+    assert bl.record_failure("p", bl.generation("p")) is True
+
+
+def test_blacklist_generation_race_with_paused_fetch_thread():
+    """Regression: a fetch thread snapshots the generation, stalls
+    mid-fetch while the peer is benched AND reinstated, then reports its
+    (stale) failure — the report must be dropped, not re-bench the
+    freshly reinstated peer."""
+    bl = PeerBlacklist(threshold=1, ttl_s=0.02)
+    snapped = threading.Event()
+    resume = threading.Event()
+    verdict = []
+
+    def paused_fetcher():
+        gen = bl.generation("exec-R")
+        snapped.set()
+        resume.wait(5.0)             # ... fetch in flight, very slowly
+        verdict.append(bl.record_failure("exec-R", gen))
+
+    th = threading.Thread(target=paused_fetcher)
+    th.start()
+    assert snapped.wait(5.0)
+    # meanwhile: the peer fails for someone else, gets benched, the
+    # bench expires, and a heartbeat refresh reinstates it
+    assert bl.record_failure("exec-R", bl.generation("exec-R")) is True
+    time.sleep(0.03)
+    assert bl.reinstate_expired() == ["exec-R"]
+    resume.set()
+    th.join(5.0)
+    assert verdict == [False]
+    assert not bl.is_blacklisted("exec-R")
+
+
+def test_blacklist_success_bumps_generation():
+    bl = PeerBlacklist(threshold=1, ttl_s=60.0)
+    gen = bl.generation("p")
+    assert bl.record_failure("p", gen) is True
+    bl.record_success("p")           # un-benched by a served fetch
+    assert not bl.is_blacklisted("p")
+    assert bl.record_failure("p", gen) is False   # stale report dropped
+
+
+# ---------------------------------------------------------------------------
+# speculative duplicate fetch
+# ---------------------------------------------------------------------------
+
+def test_speculative_fetch_backup_wins():
+    conf = RapidsConf()
+    conf.set("spark.rapids.shuffle.mode", "ICI")
+    conf.set("spark.rapids.tpu.shuffle.fetch.speculativeP99Factor", 2.0)
+    hb = ShuffleHeartbeatManager()
+    transport = LocalTransport()
+    a = ShuffleManager(conf, transport, "exec-A", hb)
+    slow = ShuffleManager(conf, transport, "exec-SLOW", hb)
+    fast = ShuffleManager(conf, transport, "exec-FAST", hb)
+    try:
+        batch = arrow_to_device(small_table(16))
+        fast.write_map_output(31, 0, [batch])
+
+        def hook(peer, block):
+            if peer.executor_id == "exec-SLOW":
+                time.sleep(0.25)     # straggler
+            return None              # fall through to the real store
+
+        transport.fetch_hook = hook
+        # warm the latency window so the p99 budget is tiny
+        with a._lock:
+            a._fetch_latencies.extend([0.005] * 16)
+        sp0, wins0 = (FETCH_STATS["speculated"],
+                      FETCH_STATS["speculative_wins"])
+        got = a.read_reduce_partition(31, 1, 0)
+        assert got is not None and got.num_rows_int == 16
+        assert FETCH_STATS["speculated"] > sp0
+        assert FETCH_STATS["speculative_wins"] > wins0
+    finally:
+        a.close()
+        slow.close()
+        fast.close()
+
+
+def test_speculation_off_by_default():
+    conf = _ici_conf()
+    hb = ShuffleHeartbeatManager()
+    a = ShuffleManager(conf, LocalTransport(), "exec-A", hb)
+    try:
+        assert a._speculative_factor == 0.0
+        assert a._fetch_p99() is None
+        assert a._spec_pool is None
+    finally:
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# detector-armed manager wiring
+# ---------------------------------------------------------------------------
+
+def test_manager_detector_disarmed_by_default():
+    conf = _ici_conf()
+    a = ShuffleManager(conf, LocalTransport(), "exec-A",
+                       ShuffleHeartbeatManager())
+    try:
+        assert a.detector_armed is False
+        assert a._hb_loop is None
+    finally:
+        a.close()
+
+
+def test_manager_close_drains_fault_domain_state():
+    conf = RapidsConf()
+    conf.set("spark.rapids.shuffle.mode", "ICI")
+    conf.set("spark.rapids.tpu.peers.heartbeatMs", 20)
+    hb = ShuffleHeartbeatManager()
+    transport = LocalTransport()
+    a = ShuffleManager(conf, transport, "exec-A", hb)
+    b = ShuffleManager(conf, transport, "exec-B", hb)
+    try:
+        assert a.detector_armed and a._hb_loop is not None
+        time.sleep(0.08)             # a few beats observe the peers
+        assert a.detector.peer_count() >= 1
+    finally:
+        a.close()
+        b.close()
+    assert a.detector.peer_count() == 0
+    assert a._peer_epochs == {} and a._block_sources == {}
+    assert not any(t.name.startswith(fd.THREAD_PREFIX)
+                   for t in threading.enumerate())
+
+
+def test_healthz_exposes_peer_liveness():
+    conf = RapidsConf()
+    conf.set("spark.rapids.shuffle.mode", "ICI")
+    conf.set("spark.rapids.tpu.peers.heartbeatMs", 60_000)
+    a = ShuffleManager(conf, LocalTransport(), "exec-A",
+                       ShuffleHeartbeatManager())
+    try:
+        live = a.peer_liveness()
+        assert live["armed"] is True
+        assert set(live) >= {"alive", "suspect", "dead", "epoch",
+                             "peer_epochs", "phi"}
+        assert a.epoch == 1          # registry-assigned serving epoch
+    finally:
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# spill disk-full (satellite)
+# ---------------------------------------------------------------------------
+
+def test_spill_enospc_is_non_retriable():
+    from spark_rapids_tpu.memory import spill as sp
+    calls = []
+
+    def fails_enospc():
+        calls.append(1)
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    with pytest.raises(sp.SpillDiskFull):
+        sp._retry_disk_io(fails_enospc, "test-write")
+    assert len(calls) == 1           # no retry budget burned
+
+
+def test_spill_transient_oserror_still_retries():
+    from spark_rapids_tpu.memory import spill as sp
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError(errno.EIO, "transient")
+        return "ok"
+
+    assert sp._retry_disk_io(flaky, "test-write") == "ok"
+    assert len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# mesh collective watchdog (satellite)
+# ---------------------------------------------------------------------------
+
+def test_mesh_collective_deadline_watchdog():
+    from spark_rapids_tpu.parallel import mesh as M
+    # inline fast path: no deadline, runs on the calling thread
+    assert M._run_with_deadline(lambda: 42, 0.0) == 42
+    # a collective overrunning its deadline degrades loudly
+    t0 = M.STATS["collective_timeouts"]
+    with pytest.raises(M.MeshCollectiveTimeout):
+        M._run_with_deadline(lambda: time.sleep(0.5) or 1, 0.05)
+    assert M.STATS["collective_timeouts"] == t0 + 1
+    # errors inside the deadline marshal back to the caller
+    def boom():
+        raise ValueError("inner")
+    with pytest.raises(ValueError):
+        M._run_with_deadline(boom, 5.0)
+
+
+def test_mesh_collective_timeout_degrades_to_fallback():
+    """MeshCollectiveTimeout subclasses MeshShuffleUnsupported ON
+    PURPOSE: the exchange exec's existing fallback catch must degrade
+    the stage to the local plane instead of failing the query."""
+    from spark_rapids_tpu.parallel import mesh as M
+    assert issubclass(M.MeshCollectiveTimeout, M.MeshShuffleUnsupported)
+
+
+def test_mesh_collective_timeout_chaos_site():
+    from spark_rapids_tpu.parallel import mesh as M
+    try:
+        arm_chaos(seed=5, sites="mesh.collective.timeout:1.0")
+        with pytest.raises(M.MeshCollectiveTimeout):
+            M.mesh_shuffle_batches(None, [], [], 0)
+    finally:
+        disarm_chaos()
+
+
+# ---------------------------------------------------------------------------
+# observability folding
+# ---------------------------------------------------------------------------
+
+def test_stats_snapshot_includes_fault_domain_counters():
+    from spark_rapids_tpu.robustness import stats_snapshot
+    snap = stats_snapshot()
+    for key in ("staleEpochsRefused", "deadPeerFailovers",
+                "proactiveRecomputes", "speculativeFetches",
+                "speculativeFetchWins", "peersSuspected",
+                "peersDeclaredDead", "peersRecovered", "peersRevived"):
+        assert key in snap, key
+
+
+# ---------------------------------------------------------------------------
+# the real N-process harness (slow: CI runs the full scenario suite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_cluster_sigkill_smoke():
+    from spark_rapids_tpu.testing.chaos_cluster import run_sigkill
+    r = run_sigkill(nprocs=3, seed=11, rows=256)
+    assert r["ok"] and r["blocks_recomputed"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_cluster_zombie_fencing():
+    from spark_rapids_tpu.testing.chaos_cluster import run_zombie
+    r = run_zombie(nprocs=3, seed=11, rows=256)
+    assert r["ok"] and r["stale_epochs_refused"] > 0

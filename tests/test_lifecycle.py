@@ -518,6 +518,34 @@ def test_cancel_is_idempotent_and_registry_scoped():
     assert not lc.LIFECYCLE["on"]
 
 
+def test_cancel_at_mesh_poll_site_zero_leak():
+    """ISSUE 19 satellite: a cancel landing at the ``mesh`` poll site —
+    polled at the top of ``mesh_shuffle_batches``, BEFORE any device
+    check or collective dispatch — must surface QueryCancelled with zero
+    leaked pins or permits (the exchange is abandoned before the plane
+    acquires anything)."""
+    from spark_rapids_tpu.parallel import mesh as M
+    assert "mesh" in lc.POLL_SITES
+    pins0 = retention.pinned_count()
+    q = lc.QueryContext(91, session_id="sMesh")
+    lc.register(q)
+    try:
+        with lc.installed(q):
+            lc.set_cancel_trigger("mesh")
+            with pytest.raises(lc.QueryCancelled) as ei:
+                M.mesh_shuffle_batches(None, [], [], 0)
+            assert "mesh" in str(ei.value)
+            # not the degrade path: a cancel must FAIL the query, never
+            # silently fall back to the local shuffle plane
+            assert not isinstance(ei.value, M.MeshShuffleUnsupported)
+    finally:
+        lc.set_cancel_trigger(None)
+        lc.unregister(q)
+    assert retention.pinned_count() == pins0
+    assert TpuSemaphore.get().active_tasks() == 0
+    assert not lc.live_queries()
+
+
 def test_cancellable_sleep_bounded():
     q = lc.QueryContext(1, session_id="sC")
     lc.register(q)

@@ -60,7 +60,10 @@ _LOWER_BETTER = ("sync_count", "sync_ms", "compile_ms", "compile_count",
                  # query-lifecycle records (docs/robustness.md): cancel
                  # drain latency, deadline overshoot and quarantine
                  # counts all improve DOWN
-                 "cancel_latency", "overshoot", "quarantine_count")
+                 "cancel_latency", "overshoot", "quarantine_count",
+                 # fault_recovery records (testing/chaos_cluster.py):
+                 # detection / recompute / query latencies improve DOWN
+                 "detection_ms", "recompute_ms", "query_ms")
 #: keys that are identifiers/context, never diffed
 _SKIP = ("rows", "chips", "queries", "probe_attempts", "budget_ms",
          "elapsed_ms", "partial_banked_at", "pipeline_host_cores",

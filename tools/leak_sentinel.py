@@ -36,9 +36,17 @@ the daemon drained to baseline: no lingering ``srt-sentry*`` thread, no
 live ``sentry`` query contexts, and at least one valid ledger entry
 appended.
 
+``--cluster`` runs the pod-scale fault-domain leg: a real N-process
+shuffle cluster (testing/chaos_cluster.py) through kill/recover cycles
+— SIGKILL a peer mid-query, wait out the failure detector's dead
+declaration, assert bit-identical recovery — and after each cluster
+close asserts the fault-domain state drained to baseline: no lingering
+``srt-peer-hb`` heartbeat threads, an empty detector peer table, and no
+retained peer-epoch or block-source state on the closed manager.
+
 Usage:  python tools/leak_sentinel.py [--seconds 60] [--tenants 2]
             [--rows 8000] [--arm cancel,deadline,fatal] [--telemetry]
-            [--sentry] [--out FILE]
+            [--sentry] [--cluster] [--out FILE]
 Exit 0 = clean verdict; 1 = leak (per-gauge evidence in the report).
 """
 
@@ -74,6 +82,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="run a perf sentry daemon alongside the soak "
                         "and assert its thread + probe contexts drain "
                         "to baseline after stop()")
+    p.add_argument("--cluster", action="store_true",
+                   help="run N-process kill/recover cycles through the "
+                        "chaos cluster harness and assert heartbeat "
+                        "threads, the detector peer table and epoch "
+                        "state drain to baseline on close")
     p.add_argument("--out", default="", help="write the JSON report here")
     return p
 
@@ -100,6 +113,75 @@ def _gauges() -> dict:
     }
 
 
+def run_cluster_leg(seconds: float, seed: int,
+                    rows: int = 256) -> tuple:
+    """Pod-scale fault-domain leg: kill/recover cycles through a REAL
+    3-process shuffle cluster, asserting after every cluster close that
+    the fault-domain state drained — no ``srt-peer-hb`` heartbeat
+    threads beyond the pre-leg count, an empty detector peer table, and
+    no retained peer-epoch / block-source state.  Returns
+    (leg_report, leaks)."""
+    from spark_rapids_tpu.robustness.failure_detector import THREAD_PREFIX
+    from spark_rapids_tpu.testing.chaos_cluster import (ChaosCluster,
+                                                        expected_digest)
+
+    def hb_threads():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith(THREAD_PREFIX)]
+
+    leaks = []
+    baseline = len(hb_threads())
+    detections, cycles = [], 0
+    deadline = time.monotonic() + max(seconds, 1.0)
+    while cycles == 0 or (cycles < 3 and time.monotonic() < deadline):
+        cseed = seed + cycles
+        exp = expected_digest(cseed, 3, rows)
+        cl = ChaosCluster(3, cseed, rows)
+        try:
+            clean = cl.query()
+            if any(r["digest"] != exp for r in clean):
+                leaks.append(f"cluster cycle {cycles}: clean-run digest "
+                             f"mismatch")
+            cl.kill_victim()
+            cl.expire_victim()
+            detections.append(round(cl.wait_dead(), 1))
+            degraded = cl.query(cl.survivors)
+            if any(r["digest"] != exp for r in degraded):
+                leaks.append(f"cluster cycle {cycles}: post-kill digest "
+                             f"mismatch (recovery broke parity)")
+        finally:
+            mgr = cl.driver
+            cl.close()
+        # drain-to-baseline asserts (the leg's whole point): close()
+        # must tear down the heartbeat loop, detector and fencing state
+        grace = time.monotonic() + 5.0
+        while len(hb_threads()) > baseline \
+                and time.monotonic() < grace:
+            time.sleep(0.05)
+        left = hb_threads()
+        if len(left) > baseline:
+            leaks.append(f"cluster cycle {cycles}: heartbeat thread(s) "
+                         f"lingering after close: {left}")
+        if mgr.detector.peer_count() != 0:
+            leaks.append(f"cluster cycle {cycles}: detector peer table "
+                         f"not drained: {mgr.detector.snapshot()}")
+        if mgr._peer_epochs:
+            leaks.append(f"cluster cycle {cycles}: peer epochs retained "
+                         f"after close: {mgr._peer_epochs}")
+        if mgr._block_sources:
+            leaks.append(f"cluster cycle {cycles}: block-source map "
+                         f"retained after close")
+        cycles += 1
+    leg = {
+        "cycles": cycles,
+        "detection_ms": detections,
+        "hb_threads_baseline": baseline,
+        "hb_threads_final": len(hb_threads()),
+        "shutdown": "clean" if not leaks else "leak",
+    }
+    return leg, leaks
+
+
 def _scrape(host: str, port: int, route: str) -> tuple:
     """(status, body) from the embedded telemetry server; 503 on a
     degraded /healthz is a valid answer, not an error."""
@@ -118,7 +200,8 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
                  arm: str = "cancel,deadline,fatal",
                  max_waves: int = 1000,
                  telemetry: bool = False,
-                 sentry: bool = False) -> dict:
+                 sentry: bool = False,
+                 cluster: bool = False) -> dict:
     """Returns the report dict; report["verdict"] is "clean" or "leak"."""
     import spark_rapids_tpu as srt  # noqa: F401 - engine init path
     from spark_rapids_tpu.config import RapidsConf
@@ -385,6 +468,13 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
                 "shutdown": "clean" if not any(
                     "sentry" in leak for leak in leaks) else "leak",
             })
+        cluster_leg = None
+        if cluster:
+            # the fault-domain leg runs after the engine soak (its own
+            # subprocesses; the engine's gauges are already sampled)
+            cluster_leg, cluster_leaks = run_cluster_leg(
+                min(seconds, 30.0), seed)
+            leaks.extend(cluster_leaks)
         report = {
             "schema": "srt-leak-sentinel/1",
             "verdict": "clean" if not leaks else "leak",
@@ -402,6 +492,8 @@ def run_sentinel(seconds: float = 60.0, tenants: int = 2,
             report["telemetry"] = telem
         if sentry:
             report["sentry"] = sentry_leg
+        if cluster_leg is not None:
+            report["cluster"] = cluster_leg
         return report
     finally:
         if sentry_obj is not None:
@@ -429,7 +521,8 @@ def main() -> int:
                           rows=args.rows, seed=args.seed, arm=args.arm,
                           max_waves=args.max_waves,
                           telemetry=args.telemetry,
-                          sentry=args.sentry)
+                          sentry=args.sentry,
+                          cluster=args.cluster)
     print(json.dumps(report, indent=2))
     if args.out:
         with open(args.out, "w") as fh:
